@@ -1,0 +1,162 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+Capability parity with the reference (SunNy820828449/Paddle, PaddlePaddle
+v2.1/2.2-era) re-designed for TPU: jax/XLA is the compute substrate, Pallas
+supplies custom kernels, a single jax.sharding.Mesh carries every parallelism
+axis. See SURVEY.md for the capability map and ARCHITECTURE notes in README.
+
+Import as a drop-in shape: ``import paddle_tpu as paddle``.
+"""
+from __future__ import annotations
+
+from . import device as _device_mod
+from . import dtype as _dtype_mod
+from . import random as _random_mod
+from .autograd.tape import (  # noqa: F401
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+from .device import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    NPUPlace,
+    Place,
+    TPUPlace,
+    XPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_npu,
+    is_compiled_with_tpu,
+    is_compiled_with_xpu,
+    set_device,
+)
+from .dtype import (  # noqa: F401
+    bfloat16,
+    bool,  # noqa: A004
+    complex64,
+    complex128,
+    dtype,
+    finfo,
+    float16,
+    float32,
+    float64,
+    iinfo,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+)
+from .random import get_rng_state, seed, set_rng_state  # noqa: F401
+from .tensor import Tensor, is_tensor, to_tensor  # noqa: F401
+
+# the whole functional op surface lands at top level (paddle.add, paddle.matmul...)
+from .ops import *  # noqa: F401,F403
+from . import ops  # noqa: F401
+
+__version__ = "0.1.0"
+
+# ---------------------------------------------------------------------------
+# dygraph/static mode toggles (parity: paddle.enable_static/disable_static).
+# This framework is always eager-first; "static mode" routes through
+# paddle_tpu.static's Program tracer.
+# ---------------------------------------------------------------------------
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode
+
+
+# lazy submodule surface: these import Layer/ops machinery and would otherwise
+# create import cycles at package-load time.
+_LAZY_SUBMODULES = (
+    "nn",
+    "optimizer",
+    "amp",
+    "jit",
+    "io",
+    "static",
+    "distributed",
+    "vision",
+    "text",
+    "metric",
+    "hapi",
+    "autograd",
+    "incubate",
+    "utils",
+    "profiler",
+    "framework",
+    "sysconfig",
+    "onnx",
+    "inference",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name == "save":
+        from .framework.io import save
+
+        return save
+    if name == "load":
+        from .framework.io import load
+
+        return load
+    if name == "summary":
+        from .hapi.model_summary import summary
+
+        return summary
+    if name == "flops":
+        from .hapi.dynamic_flops import flops
+
+        return flops
+    if name == "Model":
+        from .hapi.model import Model
+
+        return Model
+    if name == "DataParallel":
+        from .distributed.parallel import DataParallel
+
+        return DataParallel
+    if name == "ParamAttr":
+        from .nn.param_attr import ParamAttr
+
+        return ParamAttr
+    if name == "get_flags":
+        from .flags import get_flags
+
+        return get_flags
+    if name == "set_flags":
+        from .flags import set_flags
+
+        return set_flags
+    if name == "set_default_dtype":
+        from .framework.dtype_default import set_default_dtype
+
+        return set_default_dtype
+    if name == "get_default_dtype":
+        from .framework.dtype_default import get_default_dtype
+
+        return get_default_dtype
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

@@ -1,0 +1,33 @@
+"""paddle_tpu.autograd — eager autodiff (tape), PyLayer, functional API."""
+from .tape import (  # noqa: F401
+    backward,
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+
+__all__ = [
+    "backward",
+    "grad",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "PyLayer",
+]
+
+
+def __getattr__(name):
+    # PyLayer / functional live in submodules that import ops; load lazily to
+    # keep the core import graph acyclic.
+    if name == "PyLayer":
+        from .py_layer import PyLayer
+
+        return PyLayer
+    if name in ("jacobian", "hessian", "vjp", "jvp"):
+        from . import functional
+
+        return getattr(functional, name)
+    raise AttributeError(name)

@@ -1,0 +1,234 @@
+"""Eager autograd tape.
+
+Parity: the reference's dygraph engine — ``imperative::Tracer::TraceOp``
+records a grad-op graph (/root/reference/paddle/fluid/imperative/tracer.cc:146,
+CreateGradOpNode :236) and ``BasicEngine::Execute``
+(/root/reference/paddle/fluid/imperative/basic_engine.cc:379) replays it with
+ref-counted topological order and gradient accumulation
+(gradient_accumulator.cc).
+
+TPU-native redesign: instead of per-op hand-written grad kernels, each traced
+op captures a ``jax.vjp`` closure (XLA computes and fuses the backward pass).
+The tape is a Wengert list — reverse creation order is a valid topological
+order, which replaces the reference's ref-count scheduling. Double-grad
+(create_graph) re-enters the same machinery because vjp closures are
+themselves traceable.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Node",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "backward",
+    "grad",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.counter = 0
+
+
+_state = _State()
+
+
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    _state.grad_enabled = bool(mode)
+
+
+@contextlib.contextmanager
+def no_grad():
+    prev = _state.grad_enabled
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = _state.grad_enabled
+    _state.grad_enabled = True
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+class Node:
+    """One taped op: maps output cotangents to input cotangents.
+
+    ``vjp_fn`` is the closure returned by ``jax.vjp`` over the op's
+    differentiable inputs; ``inputs`` are the input Tensors in the same order.
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "index", "name", "released")
+
+    def __init__(self, vjp_fn: Callable, inputs: Sequence[Any], out_avals, name: str = ""):
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)
+        self.out_avals = list(out_avals)  # (shape, dtype) per output
+        _state.counter += 1
+        self.index = _state.counter
+        self.name = name
+        self.released = False
+
+    def __repr__(self):
+        return f"<Node #{self.index} {self.name}>"
+
+
+def _accumulate(t, g):
+    """Accumulate cotangent g into tensor t's .grad (paddle semantics: grads
+    accumulate across backward() calls until clear_grad)."""
+    from ..tensor import Tensor  # local import to avoid cycle
+
+    if g.dtype != t._data.dtype:
+        g = g.astype(t._data.dtype)
+    if t._hooks:
+        for h in t._hooks:
+            if h is None:
+                continue
+            r = h(Tensor(g, stop_gradient=True))
+            if r is not None:
+                g = r._data if hasattr(r, "_data") else jnp.asarray(g)
+    if t.grad is None:
+        t.grad = Tensor(g, stop_gradient=True)
+    else:
+        t.grad = Tensor(t.grad._data + g, stop_gradient=True)
+
+
+def backward(tensor, grad_tensor=None, retain_graph: bool = False):
+    """Run reverse-mode autodiff from ``tensor`` to all reachable leaves.
+
+    Parity: Tensor.backward / BasicEngine. Cotangents propagate node-by-node
+    in reverse creation order; leaf tensors (stop_gradient=False with no
+    producing node) and retained non-leaves receive ``.grad``.
+    """
+    if tensor._node is None:
+        if not tensor.stop_gradient:
+            # a leaf: d(t)/d(t) = 1
+            g = jnp.ones_like(tensor._data) if grad_tensor is None else grad_tensor._data
+            _accumulate(tensor, g)
+        return
+
+    if grad_tensor is None:
+        if tensor._data.size != 1:
+            raise RuntimeError(
+                "backward() on a non-scalar tensor requires an explicit grad_tensor"
+            )
+        seed_grad = jnp.ones_like(tensor._data)
+    else:
+        seed_grad = grad_tensor._data if hasattr(grad_tensor, "_data") else jnp.asarray(grad_tensor)
+
+    # Gather reachable subgraph.
+    nodes = {}
+    stack = [tensor._node]
+    while stack:
+        n = stack.pop()
+        if n.index in nodes or n.released:
+            continue
+        nodes[n.index] = n
+        for inp in n.inputs:
+            if inp._node is not None:
+                stack.append(inp._node)
+
+    # cotangent buckets: keyed by (node index, out position) for op outputs.
+    cots = {}
+    cots[(tensor._node.index, tensor._out_idx)] = seed_grad
+
+    for idx in sorted(nodes, reverse=True):
+        node = nodes[idx]
+        if node.released:
+            raise RuntimeError(
+                "Trying to backward through a released graph; pass retain_graph=True"
+            )
+        out_cots = []
+        any_seen = False
+        for pos, (shape, dt) in enumerate(node.out_avals):
+            g = cots.pop((idx, pos), None)
+            if g is None:
+                g = jnp.zeros(shape, dt)
+            else:
+                any_seen = True
+            out_cots.append(g)
+        if not any_seen:
+            continue
+        in_cots = node.vjp_fn(tuple(out_cots) if len(out_cots) > 1 else out_cots[0])
+        for inp, g in zip(node.inputs, in_cots):
+            if g is None or inp.stop_gradient:
+                continue
+            if inp._node is not None:
+                k = (inp._node.index, inp._out_idx)
+                if inp._retain_grad:
+                    _accumulate(inp, g)
+                cots[k] = g if k not in cots else cots[k] + g
+            else:
+                _accumulate(inp, g)
+        if not retain_graph:
+            node.released = True
+            node.vjp_fn = None
+            node.inputs = []
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph: Optional[bool] = None,
+    create_graph: bool = False,
+    allow_unused: bool = False,
+):
+    """``paddle.grad`` parity (reference: imperative/partial_grad_engine.cc).
+
+    Computes grads of ``outputs`` w.r.t. ``inputs`` without touching ``.grad``
+    slots. ``create_graph`` is supported by rerunning the captured forward
+    closures under jax tracing (vjp-of-vjp).
+    """
+    from ..tensor import Tensor
+
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    grad_outputs = grad_outputs if isinstance(grad_outputs, (list, tuple)) else [grad_outputs]
+    retain = True if retain_graph is None else retain_graph
+
+    # Temporarily swap .grad slots, run backward, harvest, restore.
+    saved = [(t, t.grad, t._retain_grad) for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t._retain_grad = True
+    try:
+        for o, go in zip(outputs, grad_outputs):
+            backward(o, go, retain_graph=retain)
+        results = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "One of the differentiated tensors appears unused; "
+                        "pass allow_unused=True to return None for it"
+                    )
+                results.append(None)
+            else:
+                results.append(Tensor(t.grad._data, stop_gradient=not create_graph))
+    finally:
+        for t, g, r in saved:
+            t.grad, t._retain_grad = g, r
+    return results

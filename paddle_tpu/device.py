@@ -1,0 +1,170 @@
+"""Device / Place model.
+
+Parity: /root/reference/paddle/fluid/platform/place.h:37 (CPUPlace, CUDAPlace,
+XPUPlace, NPUPlace, CUDAPinnedPlace) and python/paddle/device/__init__.py
+(set_device / get_device). TPU-native redesign: a Place is a selector over
+``jax.devices()``; there is no DeviceContext/stream model — XLA owns streams
+and scheduling, so the reference's DeviceContextPool collapses into this file.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = [
+    "Place",
+    "CPUPlace",
+    "TPUPlace",
+    "CUDAPlace",
+    "CUDAPinnedPlace",
+    "set_device",
+    "get_device",
+    "get_default_place",
+    "device_count",
+    "is_compiled_with_tpu",
+    "is_compiled_with_cuda",
+    "is_compiled_with_xpu",
+    "is_compiled_with_npu",
+    "XPUPlace",
+    "NPUPlace",
+]
+
+
+class Place:
+    """Base class for device selectors."""
+
+    device_type: str = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    # --- jax bridge -------------------------------------------------------
+    def jax_device(self):
+        """Resolve this place to a concrete jax.Device."""
+        platform = "cpu" if self.device_type == "cpu" else None
+        if platform is not None:
+            devs = jax.devices("cpu")
+        else:
+            devs = jax.local_devices()
+        if self.device_id >= len(devs):
+            raise ValueError(
+                f"{self!r}: device id out of range ({len(devs)} local devices)"
+            )
+        return devs[self.device_id]
+
+    def is_cpu_place(self):
+        return self.device_type == "cpu"
+
+    def is_tpu_place(self):
+        return self.device_type == "tpu"
+
+    # the reference API spells these gpu; accelerator == tpu here
+    def is_gpu_place(self):
+        return self.device_type == "tpu"
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+# Compatibility aliases so reference-style user code ports unchanged: on this
+# framework the accelerator is the TPU chip.
+CUDAPlace = TPUPlace
+XPUPlace = TPUPlace
+NPUPlace = TPUPlace
+
+
+class CUDAPinnedPlace(CPUPlace):
+    """Host memory place. TPU transfers stage through host RAM managed by
+    PJRT; a distinct pinned pool is unnecessary (reference:
+    paddle/fluid/memory/allocation/pinned_allocator.cc)."""
+
+
+_current_device: Optional[str] = None
+
+
+def _accelerator_available() -> bool:
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def set_device(device: str):
+    """Set the global default place. Accepts 'cpu', 'tpu', 'tpu:0', and the
+    reference spellings 'gpu'/'gpu:0' (mapped to tpu)."""
+    global _current_device
+    device = device.lower().replace("gpu", "tpu").replace("xpu", "tpu").replace("npu", "tpu")
+    if not (device == "cpu" or device.startswith("tpu")):
+        raise ValueError(f"Unsupported device {device!r}")
+    _current_device = device
+    return get_default_place()
+
+
+def get_device() -> str:
+    if _current_device is not None:
+        return _current_device
+    return "tpu:0" if _accelerator_available() else "cpu"
+
+
+def get_default_place() -> Place:
+    dev = get_device()
+    if dev == "cpu":
+        return CPUPlace(0)
+    idx = int(dev.split(":")[1]) if ":" in dev else 0
+    return TPUPlace(idx)
+
+
+def device_count() -> int:
+    return len(jax.local_devices())
+
+
+def is_compiled_with_tpu() -> bool:
+    return _accelerator_available()
+
+
+def is_compiled_with_cuda() -> bool:
+    # honest answer: this framework never targets CUDA
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def _place_from(place) -> Place:
+    if place is None:
+        return get_default_place()
+    if isinstance(place, Place):
+        return place
+    if isinstance(place, str):
+        saved = _current_device
+        try:
+            p = set_device(place)
+        finally:
+            globals()["_current_device"] = saved
+        return p
+    raise TypeError(f"Expected Place or str, got {type(place)}")

@@ -1,0 +1,157 @@
+"""Dtype model for paddle_tpu.
+
+Mirrors the reference's ``proto::VarType`` dtype surface
+(/root/reference/paddle/fluid/framework/framework.proto:92-120) but is a thin
+mapping onto numpy/jax dtypes — on TPU there is no separate typed-tensor IR;
+XLA carries dtype through the HLO.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "dtype",
+    "uint8",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "float16",
+    "bfloat16",
+    "float32",
+    "float64",
+    "complex64",
+    "complex128",
+    "bool",
+    "convert_dtype",
+    "to_jax_dtype",
+    "is_floating_point_dtype",
+    "is_integer_dtype",
+    "iinfo",
+    "finfo",
+]
+
+
+class dtype:
+    """A framework dtype: a named wrapper around a numpy/jax dtype.
+
+    Compares equal to its string name, to numpy dtypes and to other ``dtype``
+    instances so user code can say ``x.dtype == 'float32'`` like the reference
+    API allows.
+    """
+
+    __slots__ = ("name", "np_dtype")
+
+    _registry: dict = {}
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        dtype._registry[name] = self
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, dtype):
+            return self.name == other.name
+        if isinstance(other, str):
+            return other in (self.name, f"paddle.{self.name}", f"paddle_tpu.{self.name}")
+        try:
+            return np.dtype(other) == self.np_dtype and _np_name(other) == self.name
+        except TypeError:
+            return NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return NotImplemented if eq is NotImplemented else not eq
+
+
+def _np_name(other) -> str:
+    # bfloat16 is not a numpy builtin; ml_dtypes gives it name 'bfloat16'
+    return np.dtype(other).name
+
+
+uint8 = dtype("uint8", np.uint8)
+int8 = dtype("int8", np.int8)
+int16 = dtype("int16", np.int16)
+int32 = dtype("int32", np.int32)
+int64 = dtype("int64", np.int64)
+float16 = dtype("float16", np.float16)
+bfloat16 = dtype("bfloat16", jnp.bfloat16)
+float32 = dtype("float32", np.float32)
+float64 = dtype("float64", np.float64)
+complex64 = dtype("complex64", np.complex64)
+complex128 = dtype("complex128", np.complex128)
+bool = dtype("bool", np.bool_)  # noqa: A001 - mirrors paddle.bool
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bool_": "bool",
+    "bfloat16": "bfloat16",
+    "uint16": "bfloat16",  # the reference stores bf16 as VarType.BF16/uint16
+}
+
+
+def convert_dtype(d) -> str:
+    """Normalize any dtype spec (str / numpy / jax / framework dtype) to its
+    canonical string name. Parity: python/paddle/fluid/data_feeder.py convert_dtype."""
+    if isinstance(d, dtype):
+        return d.name
+    if isinstance(d, str):
+        name = d.split(".")[-1]
+        name = _ALIASES.get(name, name)
+        if name in dtype._registry:
+            return name
+        raise ValueError(f"Unknown dtype string: {d!r}")
+    if d is float:
+        return "float32"
+    if d is int:
+        return "int64"
+    if d is builtins.bool:
+        return "bool"
+    try:
+        name = np.dtype(d).name
+    except TypeError as e:
+        raise ValueError(f"Cannot convert {d!r} to a dtype") from e
+    name = _ALIASES.get(name, name)
+    if name in dtype._registry:
+        return name
+    raise ValueError(f"Unsupported dtype: {d!r}")
+
+
+def to_paddle_dtype(d) -> dtype:
+    return dtype._registry[convert_dtype(d)]
+
+
+def to_jax_dtype(d):
+    """Resolve any dtype spec to the jnp dtype used on device."""
+    return dtype._registry[convert_dtype(d)].np_dtype
+
+
+def is_floating_point_dtype(d) -> builtins.bool:
+    name = convert_dtype(d)
+    return name in ("float16", "bfloat16", "float32", "float64")
+
+
+def is_integer_dtype(d):
+    name = convert_dtype(d)
+    return name in ("uint8", "int8", "int16", "int32", "int64")
+
+
+def iinfo(d):
+    return np.iinfo(to_jax_dtype(d))
+
+
+def finfo(d):
+    return jnp.finfo(to_jax_dtype(d))

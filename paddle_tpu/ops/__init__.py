@@ -1,0 +1,190 @@
+"""paddle_tpu.ops — the functional op surface.
+
+Aggregates every op module and attaches tensor methods + dunders onto
+``Tensor`` (parity: the reference monkey-patches methods in
+python/paddle/fluid/dygraph/varbase_patch_methods.py and
+python/paddle/tensor/__init__.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from . import creation, linalg, logic, manipulation, math, random_ops, search
+from ._primitive import primitive, unwrap, wrap
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403 — note: no __all__, exports by name below
+from .math import *  # noqa: F401,F403
+from .random_ops import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+
+# manipulation has no __all__; re-export its public names explicitly
+from .manipulation import (  # noqa: F401
+    broadcast_shape,
+    broadcast_tensors,
+    broadcast_to,
+    cast,
+    chunk,
+    concat,
+    expand,
+    expand_as,
+    flatten,
+    flip,
+    gather,
+    gather_nd,
+    index_sample,
+    index_select,
+    masked_select,
+    moveaxis,
+    nonzero,
+    pad,
+    put_along_axis,
+    repeat_interleave,
+    reshape,
+    reshape_,
+    roll,
+    rot90,
+    scatter,
+    scatter_nd,
+    scatter_nd_add,
+    shard_index,
+    slice,  # noqa: A004
+    split,
+    squeeze,
+    stack,
+    strided_slice,
+    swapaxes,
+    take_along_axis,
+    tile,
+    transpose,
+    unbind,
+    unique,
+    unique_consecutive,
+    unsqueeze,
+    unstack,
+    where,
+)
+
+# ---------------------------------------------------------------------------
+# Tensor method + operator attachment
+# ---------------------------------------------------------------------------
+
+_METHODS = {
+    # math
+    "abs": math.abs, "acos": math.acos, "asin": math.asin, "atan": math.atan,
+    "ceil": math.ceil, "cos": math.cos, "cosh": math.cosh, "exp": math.exp,
+    "floor": math.floor, "log": math.log, "log2": math.log2, "log10": math.log10,
+    "log1p": math.log1p, "neg": math.neg, "reciprocal": math.reciprocal,
+    "round": math.round, "rsqrt": math.rsqrt, "sigmoid": math.sigmoid,
+    "sign": math.sign, "sin": math.sin, "sinh": math.sinh, "sqrt": math.sqrt,
+    "square": math.square, "tan": math.tan, "tanh": math.tanh, "erf": math.erf,
+    "lgamma": math.lgamma, "digamma": math.digamma, "trunc": math.trunc,
+    "conj": math.conj, "real": math.real, "imag": math.imag, "angle": math.angle,
+    "add": math.add, "subtract": math.subtract, "multiply": math.multiply,
+    "divide": math.divide, "floor_divide": math.floor_divide, "mod": math.mod,
+    "remainder": math.remainder, "pow": math.pow, "maximum": math.maximum,
+    "minimum": math.minimum, "fmax": math.fmax, "fmin": math.fmin,
+    "atan2": math.atan2, "kron": math.kron, "scale": math.scale,
+    "clip": math.clip, "lerp": math.lerp,
+    "sum": math.sum, "mean": math.mean, "prod": math.prod, "max": math.max,
+    "min": math.min, "amax": math.amax, "amin": math.amin, "std": math.std,
+    "var": math.var, "median": math.median, "quantile": math.quantile,
+    "nanmean": math.nanmean, "nansum": math.nansum, "logsumexp": math.logsumexp,
+    "all": math.all, "any": math.any, "numel": math.numel,
+    "count_nonzero": math.count_nonzero,
+    "cumsum": math.cumsum, "cumprod": math.cumprod, "diff": math.diff,
+    "addmm": math.addmm, "inner": math.inner, "outer": math.outer,
+    "trace": math.trace, "isfinite": math.isfinite, "isinf": math.isinf,
+    "isnan": math.isnan, "nan_to_num": math.nan_to_num, "logit": math.logit,
+    "frac": math.frac, "heaviside": math.heaviside,
+    # manipulation
+    "reshape": reshape, "reshape_": reshape_, "flatten": flatten,
+    "transpose": transpose, "squeeze": squeeze, "unsqueeze": unsqueeze,
+    "expand": expand, "expand_as": expand_as, "broadcast_to": broadcast_to,
+    "tile": tile, "roll": roll, "flip": flip, "concat": concat,
+    "split": split, "chunk": chunk, "unbind": unbind, "gather": gather,
+    "gather_nd": gather_nd, "scatter": scatter, "scatter_nd_add": scatter_nd_add,
+    "index_select": index_select, "index_sample": index_sample,
+    "masked_select": masked_select, "where": where, "nonzero": nonzero,
+    "unique": unique, "slice": slice, "strided_slice": strided_slice,
+    "cast": cast, "pad": pad, "tril": creation.tril, "triu": creation.triu,
+    "take_along_axis": take_along_axis, "put_along_axis": put_along_axis,
+    "repeat_interleave": repeat_interleave, "moveaxis": moveaxis,
+    "masked_fill": search.masked_fill,
+    # linalg
+    "matmul": linalg.matmul, "bmm": linalg.bmm, "dot": linalg.dot,
+    "mv": linalg.mv, "t": linalg.t, "norm": linalg.norm, "dist": linalg.dist,
+    "cholesky": linalg.cholesky, "inverse": linalg.inverse,
+    "matrix_power": linalg.matrix_power,
+    # logic
+    "equal": logic.equal, "not_equal": logic.not_equal,
+    "less_than": logic.less_than, "less_equal": logic.less_equal,
+    "greater_than": logic.greater_than, "greater_equal": logic.greater_equal,
+    "equal_all": logic.equal_all, "allclose": logic.allclose,
+    "isclose": logic.isclose, "logical_and": logic.logical_and,
+    "logical_or": logic.logical_or, "logical_not": logic.logical_not,
+    "logical_xor": logic.logical_xor, "bitwise_and": logic.bitwise_and,
+    "bitwise_or": logic.bitwise_or, "bitwise_xor": logic.bitwise_xor,
+    "bitwise_not": logic.bitwise_not,
+    # search
+    "argmax": search.argmax, "argmin": search.argmin, "argsort": search.argsort,
+    "sort": search.sort, "topk": search.topk, "kthvalue": search.kthvalue,
+    "mode": search.mode, "searchsorted": search.searchsorted,
+    # creation-ish
+    "clone": creation.clone, "diagonal": None,  # placeholder filled below
+    "zero_": None,
+}
+
+
+@primitive
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+_METHODS["diagonal"] = diagonal
+del _METHODS["zero_"]  # defined directly on Tensor
+
+for _name, _fn in _METHODS.items():
+    if _fn is not None:
+        Tensor._register_method(_name, _fn)
+
+
+def _swap(fn):
+    return lambda a, b: fn(b, a)
+
+
+_DUNDERS = {
+    "__add__": math.add,
+    "__radd__": _swap(math.add),
+    "__sub__": math.subtract,
+    "__rsub__": _swap(math.subtract),
+    "__mul__": math.multiply,
+    "__rmul__": _swap(math.multiply),
+    "__truediv__": math.divide,
+    "__rtruediv__": _swap(math.divide),
+    "__floordiv__": math.floor_divide,
+    "__rfloordiv__": _swap(math.floor_divide),
+    "__mod__": math.mod,
+    "__rmod__": _swap(math.mod),
+    "__pow__": math.pow,
+    "__rpow__": _swap(math.pow),
+    "__matmul__": linalg.matmul,
+    "__rmatmul__": _swap(linalg.matmul),
+    "__neg__": math.neg,
+    "__abs__": math.abs,
+    "__eq__": logic.equal,
+    "__ne__": logic.not_equal,
+    "__lt__": logic.less_than,
+    "__le__": logic.less_equal,
+    "__gt__": logic.greater_than,
+    "__ge__": logic.greater_equal,
+    "__and__": logic.bitwise_and,
+    "__or__": logic.bitwise_or,
+    "__xor__": logic.bitwise_xor,
+    "__invert__": logic.bitwise_not,
+}
+
+for _name, _fn in _DUNDERS.items():
+    Tensor._register_method(_name, _fn)

@@ -1,0 +1,137 @@
+"""The op-definition machinery: pure-jax functions become taped eager ops.
+
+Parity: this file plays the role of the reference's entire operator dispatch
+stack — ``OperatorWithKernel::RunImpl`` kernel choice
+(/root/reference/paddle/fluid/framework/operator.cc:1081,1211), dygraph
+``Tracer::TraceOp`` (/root/reference/paddle/fluid/imperative/tracer.cc:146) and
+the generated ``core.ops.*`` fast path
+(/root/reference/paddle/fluid/pybind/op_function_generator.cc:551).
+
+TPU-native redesign: an "op" is just a pure jax function. Eager execution is
+the function call itself (XLA compiles + caches per shape); gradient recording
+is a ``jax.vjp`` closure pushed on the tape. There is no kernel registry, no
+InferShape pass, no device transform — XLA's tracing subsumes all three.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape
+from ..tensor import Tensor
+
+__all__ = ["primitive", "unwrap", "wrap"]
+
+
+def unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def wrap(x, stop_gradient=True):
+    return Tensor(x, stop_gradient=stop_gradient) if isinstance(x, jax.Array) else x
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _flatten_call(args, kwargs):
+    flat, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    tensor_pos = [i for i, x in enumerate(flat) if _is_tensor(x)]
+    return flat, treedef, tensor_pos
+
+
+def primitive(fn: Callable = None, *, nondiff: bool = False, aux: int = 0, name: str = None):
+    """Wrap a pure jax function into an eager, taped framework op.
+
+    - Tensor args (incl. inside lists/tuples) are unwrapped to jax arrays.
+    - If grad is enabled and any floating input requires grad, the call runs
+      under ``jax.vjp`` and a tape Node is recorded.
+    - ``nondiff``: op has no gradient (indices, comparisons, rng...).
+    - ``aux``: the last ``aux`` outputs are non-differentiable extras
+      (e.g. ``topk`` indices).
+    """
+
+    if fn is None:
+        return functools.partial(primitive, nondiff=nondiff, aux=aux, name=name)
+
+    op_name = name or fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        flat, treedef, tensor_pos = _flatten_call(args, kwargs)
+        in_tensors = [flat[i] for i in tensor_pos]
+
+        need_grad = (
+            not nondiff
+            and tape.is_grad_enabled()
+            and any(
+                not t.stop_gradient and jnp.issubdtype(t._data.dtype, jnp.inexact)
+                for t in in_tensors
+            )
+        )
+
+        if not need_grad:
+            flat2 = list(flat)
+            for i in tensor_pos:
+                flat2[i] = flat[i]._data
+            a2, k2 = jax.tree_util.tree_unflatten(treedef, flat2)
+            out = fn(*a2, **k2)
+            return jax.tree_util.tree_map(wrap, out)
+
+        # differentiate w.r.t. floating tensors that require grad; others are
+        # closed-over constants
+        diff_pos = [
+            i
+            for i in tensor_pos
+            if not flat[i].stop_gradient
+            and jnp.issubdtype(flat[i]._data.dtype, jnp.inexact)
+        ]
+        diff_tensors = [flat[i] for i in diff_pos]
+
+        def pure(*diff_arrs):
+            flat2 = list(flat)
+            for i in tensor_pos:
+                flat2[i] = flat[i]._data
+            for i, a in zip(diff_pos, diff_arrs):
+                flat2[i] = a
+            a2, k2 = jax.tree_util.tree_unflatten(treedef, flat2)
+            out = fn(*a2, **k2)
+            if aux:
+                outs = out if isinstance(out, tuple) else (out,)
+                return outs[:-aux] if len(outs) - aux > 1 else outs[0], outs[-aux:]
+            return out
+
+        if aux:
+            out, vjp_fn, aux_out = jax.vjp(
+                pure, *[t._data for t in diff_tensors], has_aux=True
+            )
+        else:
+            out, vjp_fn = jax.vjp(pure, *[t._data for t in diff_tensors])
+            aux_out = ()
+
+        out_arrays = out if isinstance(out, tuple) else (out,)
+        node = tape.Node(
+            vjp_fn,
+            diff_tensors,
+            [(a.shape, a.dtype) for a in out_arrays],
+            name=op_name,
+        )
+        out_tensors = []
+        for pos, a in enumerate(out_arrays):
+            t = Tensor(a, stop_gradient=False)
+            t._node = node
+            t._out_idx = pos
+            out_tensors.append(t)
+        aux_tensors = [wrap(a) for a in aux_out]
+        results = tuple(out_tensors) + tuple(aux_tensors)
+        if len(results) == 1:
+            return results[0]
+        return results
+
+    wrapper.raw = fn  # the pure-jax function, for use inside jit/shard_map
+    wrapper.op_name = op_name
+    return wrapper

@@ -1,0 +1,168 @@
+"""Tensor creation ops.
+
+Parity: paddle.tensor.creation (python/paddle/tensor/creation.py in the
+reference) — fill_constant, arange, linspace, eye, tril/triu, meshgrid, etc.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..dtype import to_jax_dtype
+from ..tensor import Tensor, to_tensor
+from ._primitive import primitive, unwrap, wrap
+
+__all__ = [
+    "to_tensor",
+    "zeros",
+    "ones",
+    "full",
+    "empty",
+    "zeros_like",
+    "ones_like",
+    "full_like",
+    "empty_like",
+    "arange",
+    "linspace",
+    "logspace",
+    "eye",
+    "diag",
+    "diagflat",
+    "meshgrid",
+    "tril",
+    "triu",
+    "clone",
+    "assign",
+    "complex",
+    "create_parameter",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) if not isinstance(s, (int, np.integer)) else int(s) for s in shape)
+
+
+def zeros(shape, dtype="float32"):
+    return wrap(jnp.zeros(_shape(shape), to_jax_dtype(dtype)))
+
+
+def ones(shape, dtype="float32"):
+    return wrap(jnp.ones(_shape(shape), to_jax_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype="float32"):
+    fill_value = unwrap(fill_value)
+    return wrap(jnp.full(_shape(shape), fill_value, to_jax_dtype(dtype)))
+
+
+def empty(shape, dtype="float32"):
+    # XLA has no uninitialized buffers; zeros is the honest equivalent
+    return zeros(shape, dtype)
+
+
+@primitive
+def _like_zeros(x):
+    return jnp.zeros_like(x)
+
+
+def zeros_like(x, dtype=None):
+    x = unwrap(x)
+    return wrap(jnp.zeros_like(x, dtype=to_jax_dtype(dtype) if dtype else None))
+
+
+def ones_like(x, dtype=None):
+    x = unwrap(x)
+    return wrap(jnp.ones_like(x, dtype=to_jax_dtype(dtype) if dtype else None))
+
+
+def full_like(x, fill_value, dtype=None):
+    x = unwrap(x)
+    return wrap(jnp.full_like(x, unwrap(fill_value), dtype=to_jax_dtype(dtype) if dtype else None))
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    jdt = to_jax_dtype(dtype) if dtype is not None else None
+    if jdt is None:
+        py_floats = any(isinstance(v, float) for v in (start, end, step))
+        jdt = jnp.float32 if py_floats else jnp.int64
+    return wrap(jnp.arange(start, end, step, dtype=jdt))
+
+
+def linspace(start, stop, num, dtype="float32"):
+    return wrap(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)), dtype=to_jax_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype="float32"):
+    return wrap(
+        jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)), base=base, dtype=to_jax_dtype(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    return wrap(jnp.eye(num_rows, num_columns, dtype=to_jax_dtype(dtype)))
+
+
+@primitive
+def diag(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, k=offset)
+        mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+        return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+    return jnp.diag(x, k=offset)
+
+
+@primitive
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+def meshgrid(*args):
+    args = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = jnp.meshgrid(*[unwrap(a) for a in args], indexing="ij")
+    return [wrap(o) for o in outs]
+
+
+@primitive
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@primitive
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@primitive
+def assign(x):
+    """Copy (parity: assign op). Output is a fresh tensor with grad link."""
+    return x + 0 if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact) else jnp.asarray(x)
+
+
+def clone(x):
+    return assign(x)
+
+
+@primitive
+def complex(real, imag):  # noqa: A001
+    return jnp.asarray(real) + 1j * jnp.asarray(imag)
+
+
+def create_parameter(shape, dtype="float32", default_initializer=None):
+    from ..nn import initializer as init_mod
+
+    init = default_initializer or init_mod.XavierNormal()
+    data = init(_shape(shape), to_jax_dtype(dtype))
+    t = Tensor(data, stop_gradient=False)
+    t.persistable = True
+    return t

@@ -1,0 +1,281 @@
+"""Linear algebra ops.
+
+Parity: python/paddle/tensor/linalg.py and the reference's matmul_v2
+(/root/reference/paddle/fluid/operators/matmul_v2_op.cc:354-380), bmm, mv,
+svd/eig/cholesky/solve family. On TPU every matmul lowers to the MXU; the
+reference's Blas wrapper (operators/math/blas.h) has no equivalent because
+XLA owns GEMM selection.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._primitive import primitive, unwrap, wrap
+
+__all__ = [
+    "matmul",
+    "bmm",
+    "dot",
+    "mv",
+    "t",
+    "norm",
+    "dist",
+    "cholesky",
+    "inverse",
+    "det",
+    "slogdet",
+    "svd",
+    "qr",
+    "eig",
+    "eigh",
+    "eigvals",
+    "eigvalsh",
+    "solve",
+    "triangular_solve",
+    "cholesky_solve",
+    "lstsq",
+    "matrix_power",
+    "matrix_rank",
+    "pinv",
+    "multi_dot",
+    "cross",
+    "histogram",
+    "bincount",
+    "einsum",
+    "cov",
+    "corrcoef",
+    "lu",
+]
+
+
+@primitive
+def _matmul(x, y, transpose_x, transpose_y):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):  # noqa: ARG001
+    return _matmul(x, y, transpose_x, transpose_y)
+
+
+@primitive
+def bmm(x, y):
+    return jnp.einsum("bij,bjk->bik", x, y)
+
+
+@primitive
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@primitive
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def t(x):
+    xa = unwrap(x)
+    if xa.ndim < 2:
+        from .creation import assign
+
+        return assign(x)
+    from .manipulation import transpose
+
+    return transpose(x, [1, 0])
+
+
+@primitive
+def _p_norm(x, p, axis, keepdim):
+    if p == "fro" or p == 2:
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == "nuc":
+        s = jnp.linalg.svd(x, compute_uv=False)
+        return jnp.sum(s, axis=-1)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    if p == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p)
+
+
+def norm(x, p="fro", axis=None, keepdim=False):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    elif axis is not None:
+        axis = int(axis)
+    return _p_norm(x, p, axis, keepdim)
+
+
+def p_norm(x, p=2, axis=None, keepdim=False):
+    return norm(x, p, axis, keepdim)
+
+
+@primitive
+def dist(x, y, p=2):
+    d = x - y
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+
+@primitive
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@primitive
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@primitive
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(unwrap(x))
+    return wrap(jnp.stack([sign, logdet]))
+
+
+def svd(x, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(unwrap(x), full_matrices=full_matrices)
+    return wrap(u), wrap(s), wrap(jnp.swapaxes(vh, -1, -2))
+
+
+def qr(x, mode="reduced"):
+    q, r = jnp.linalg.qr(unwrap(x), mode=mode)
+    return wrap(q), wrap(r)
+
+
+def eig(x):
+    # jnp.linalg.eig is CPU-only; route through host
+    import numpy as np
+
+    w, v = np.linalg.eig(np.asarray(unwrap(x)))
+    return wrap(jnp.asarray(w)), wrap(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L"):
+    w, v = jnp.linalg.eigh(unwrap(x), UPLO=UPLO)
+    return wrap(w), wrap(v)
+
+
+def eigvals(x):
+    import numpy as np
+
+    return wrap(jnp.asarray(np.linalg.eigvals(np.asarray(unwrap(x)))))
+
+
+def eigvalsh(x, UPLO="L"):
+    return wrap(jnp.linalg.eigvalsh(unwrap(x), UPLO=UPLO))
+
+
+@primitive
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@primitive
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+    )
+
+
+@primitive
+def cholesky_solve(x, y, upper=False):
+    # solve A z = x where A = L L^T given Cholesky factor y
+    L = jnp.swapaxes(y, -1, -2) if upper else y
+    z = jax.scipy.linalg.solve_triangular(L, x, lower=True)
+    return jax.scipy.linalg.solve_triangular(jnp.swapaxes(L, -1, -2), z, lower=False)
+
+
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(unwrap(x), unwrap(y), rcond=rcond)
+    return wrap(sol), wrap(res), wrap(rank), wrap(sv)
+
+
+@primitive
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    return wrap(jnp.linalg.matrix_rank(unwrap(x), rtol=tol))
+
+
+@primitive
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@primitive
+def _multi_dot(xs):
+    out = xs[0]
+    for m in xs[1:]:
+        out = out @ m
+    return out
+
+
+def multi_dot(x):
+    return _multi_dot(list(x))
+
+
+@primitive
+def cross(x, y, axis=9):
+    axis = -1 if axis == 9 else axis
+    return jnp.cross(x, y, axis=axis)
+
+
+def histogram(input, bins=100, min=0, max=0):  # noqa: A002
+    arr = unwrap(input)
+    if min == 0 and max == 0:
+        lo, hi = float(jnp.min(arr)), float(jnp.max(arr))
+    else:
+        lo, hi = float(min), float(max)
+    hist, _ = jnp.histogram(arr, bins=bins, range=(lo, hi))
+    return wrap(hist.astype(jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0):
+    return wrap(jnp.bincount(unwrap(x), weights=unwrap(weights), minlength=minlength))
+
+
+@primitive
+def _einsum(equation, operands):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    return _einsum(equation, list(operands))
+
+
+@primitive
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fweights, aweights=aweights)
+
+
+@primitive
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def lu(x, pivot=True):
+    lu_, piv = jax.scipy.linalg.lu_factor(unwrap(x))
+    return wrap(lu_), wrap(piv.astype(jnp.int32) + 1)  # paddle pivots are 1-based

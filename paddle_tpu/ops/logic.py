@@ -1,0 +1,77 @@
+"""Comparison / logical / bitwise ops.
+
+Parity: python/paddle/tensor/logic.py and the reference's compare ops
+(/root/reference/paddle/fluid/operators/controlflow/compare_op.cc,
+logical_op.cc, bitwise ops). All nondifferentiable.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._primitive import unwrap, wrap
+
+__all__ = [
+    "equal",
+    "not_equal",
+    "less_than",
+    "less_equal",
+    "greater_than",
+    "greater_equal",
+    "equal_all",
+    "allclose",
+    "isclose",
+    "logical_and",
+    "logical_or",
+    "logical_not",
+    "logical_xor",
+    "bitwise_and",
+    "bitwise_or",
+    "bitwise_not",
+    "bitwise_xor",
+    "is_empty",
+]
+
+
+def _cmp(jfn):
+    def fn(x, y=None, name=None):  # noqa: ARG001
+        return wrap(jfn(jnp.asarray(unwrap(x)), jnp.asarray(unwrap(y))))
+
+    return fn
+
+
+equal = _cmp(jnp.equal)
+not_equal = _cmp(jnp.not_equal)
+less_than = _cmp(jnp.less)
+less_equal = _cmp(jnp.less_equal)
+greater_than = _cmp(jnp.greater)
+greater_equal = _cmp(jnp.greater_equal)
+logical_and = _cmp(jnp.logical_and)
+logical_or = _cmp(jnp.logical_or)
+logical_xor = _cmp(jnp.logical_xor)
+bitwise_and = _cmp(jnp.bitwise_and)
+bitwise_or = _cmp(jnp.bitwise_or)
+bitwise_xor = _cmp(jnp.bitwise_xor)
+
+
+def logical_not(x, name=None):  # noqa: ARG001
+    return wrap(jnp.logical_not(unwrap(x)))
+
+
+def bitwise_not(x, name=None):  # noqa: ARG001
+    return wrap(jnp.bitwise_not(unwrap(x)))
+
+
+def equal_all(x, y):
+    return wrap(jnp.array_equal(unwrap(x), unwrap(y)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return wrap(jnp.allclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return wrap(jnp.isclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def is_empty(x):
+    return wrap(jnp.asarray(unwrap(x).size == 0))

@@ -1,0 +1,523 @@
+"""Shape / layout / gather-scatter manipulation ops.
+
+Parity: python/paddle/tensor/manipulation.py and the reference operators
+reshape2, transpose2, concat, split, gather(_nd), scatter(_nd_add), slice,
+strided_slice, expand_v2, tile, unique, where_index
+(/root/reference/paddle/fluid/operators/). Dynamic-shape outputs
+(masked_select, nonzero, unique) are eager-only on TPU — under jit they must
+be expressed with masks; both facts documented per-op.
+"""
+from __future__ import annotations
+
+import builtins
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dtype import to_jax_dtype
+from ..tensor import Tensor
+from ._primitive import primitive, unwrap, wrap
+
+
+def _ints(seq):
+    if isinstance(seq, Tensor):
+        return tuple(int(v) for v in seq.numpy())
+    if isinstance(seq, (int, np.integer)):
+        return (int(seq),)
+    return tuple(int(unwrap(v)) for v in seq)
+
+
+# ---------------------------------------------------------------------------
+# shape
+# ---------------------------------------------------------------------------
+
+
+@primitive
+def _reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape):
+    return _reshape(x, _ints(shape))
+
+
+def reshape_(x, shape):
+    x._set_data(jnp.reshape(x._data, _ints(shape)))
+    return x
+
+
+@primitive
+def _flatten(x, start, stop):
+    shp = x.shape
+    stop = stop if stop >= 0 else len(shp) + stop
+    new = shp[:start] + (-1,) + shp[stop + 1 :]
+    return jnp.reshape(x, new)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    return _flatten(x, start_axis, stop_axis)
+
+
+@primitive
+def _transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm):
+    return _transpose(x, _ints(perm))
+
+
+@primitive
+def _squeeze(x, axis):
+    if axis is None:
+        return jnp.squeeze(x)
+    axis = tuple(a for a in axis if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+def squeeze(x, axis=None):
+    if axis is not None:
+        axis = _ints(axis if isinstance(axis, (list, tuple)) else [axis])
+        axis = tuple(a if a >= 0 else a + unwrap(x).ndim for a in axis)
+    return _squeeze(x, axis)
+
+
+@primitive
+def _unsqueeze(x, axis):
+    for a in sorted(axis):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+def unsqueeze(x, axis):
+    axis = _ints(axis if isinstance(axis, (list, tuple, Tensor)) else [axis])
+    return _unsqueeze(x, axis)
+
+
+@primitive
+def _expand(x, shape):
+    shape = tuple(
+        x.shape[i - (len(shape) - x.ndim)] if s == -1 and i >= len(shape) - x.ndim else s
+        for i, s in enumerate(shape)
+    )
+    return jnp.broadcast_to(x, shape)
+
+
+def expand(x, shape):
+    return _expand(x, _ints(shape))
+
+
+def expand_as(x, y):
+    return _expand(x, tuple(unwrap(y).shape))
+
+
+def broadcast_to(x, shape):
+    return _expand(x, _ints(shape))
+
+
+def broadcast_tensors(inputs):
+    arrs = jnp.broadcast_arrays(*[unwrap(i) for i in inputs])
+    return [wrap(a) for a in arrs]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@primitive
+def _tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+def tile(x, repeat_times):
+    return _tile(x, _ints(repeat_times))
+
+
+@primitive
+def _roll(x, shifts, axis):
+    return jnp.roll(x, shifts, axis)
+
+
+def roll(x, shifts, axis=None):
+    return _roll(x, shifts, axis)
+
+
+@primitive
+def _flip(x, axis):
+    return jnp.flip(x, axis)
+
+
+def flip(x, axis):
+    return _flip(x, _ints(axis if isinstance(axis, (list, tuple)) else [axis]))
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return wrap(jnp.rot90(unwrap(x), k=k, axes=tuple(axes)))
+
+
+@primitive
+def _moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def moveaxis(x, source, destination):
+    return _moveaxis(x, _ints(source), _ints(destination))
+
+
+def swapaxes(x, axis0, axis1):
+    perm = list(range(unwrap(x).ndim))
+    perm[axis0], perm[axis1] = perm[axis1], perm[axis0]
+    return transpose(x, perm)
+
+
+transpose_ = swapaxes
+
+
+@primitive
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@primitive
+def as_complex(x):
+    return x[..., 0] + 1j * x[..., 1]
+
+
+# ---------------------------------------------------------------------------
+# join / split
+# ---------------------------------------------------------------------------
+
+
+@primitive
+def _concat(xs, axis):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0):
+    axis = int(unwrap(axis))
+    return _concat(list(x), axis)
+
+
+@primitive
+def _stack(xs, axis):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0):
+    return _stack(list(x), axis)
+
+
+@primitive
+def _split_sections(x, indices, axis):
+    return tuple(jnp.split(x, indices, axis=axis))
+
+
+def split(x, num_or_sections, axis=0):
+    axis = int(unwrap(axis))
+    n = unwrap(x).shape[axis]
+    if isinstance(num_or_sections, int):
+        idx = [n // num_or_sections * i for i in range(1, num_or_sections)]
+    else:
+        sections = list(num_or_sections)
+        total_known = builtins.sum(s for s in sections if s != -1)
+        sections = [n - total_known if s == -1 else s for s in sections]
+        idx = list(np.cumsum(sections)[:-1])
+    out = _split_sections(x, idx, axis)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0):
+    n = unwrap(x).shape[axis]
+    parts = split(x, n, axis)
+    return [squeeze(p, [axis]) for p in parts]
+
+
+unstack = unbind
+
+
+# ---------------------------------------------------------------------------
+# indexing / gather / scatter
+# ---------------------------------------------------------------------------
+
+
+@primitive
+def _getitem_diff(x, idx):
+    return x[idx]
+
+
+def _getitem(x, idx):
+    raw_idx = idx if isinstance(idx, tuple) else (idx,)
+    has_bool = builtins.any(
+        (isinstance(i, Tensor) and i.dtype == "bool")
+        or (isinstance(i, (jnp.ndarray, np.ndarray)) and i.dtype == np.bool_)
+        for i in raw_idx
+    )
+    idx2 = tuple(unwrap(i) for i in raw_idx)
+    if len(idx2) == 1:
+        idx2 = idx2[0]
+    if has_bool:
+        # dynamic output shape: eager-only, no grad
+        return wrap(unwrap(x)[idx2])
+    return _getitem_diff(x, idx2)
+
+
+@primitive
+def _gather(x, index, axis):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather(x, index, axis=0):
+    index = unwrap(index)
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    return _gather(x, wrap(index), int(unwrap(axis)))
+
+
+@primitive
+def _gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def gather_nd(x, index):
+    return _gather_nd(x, index)
+
+
+@primitive
+def _scatter(x, index, updates, overwrite):
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle scatter(overwrite=False): zero the rows then add (sum duplicates)
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True):
+    return _scatter(x, index, updates, overwrite)
+
+
+@primitive
+def _scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    return _scatter_nd_add(x, index, updates)
+
+
+def scatter_nd(index, updates, shape):
+    from .creation import zeros
+
+    zero = zeros(shape, dtype=unwrap(updates).dtype)
+    return _scatter_nd_add(zero, index, updates)
+
+
+@primitive
+def _index_select(x, index, axis):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0):
+    return _index_select(x, wrap(jnp.reshape(unwrap(index), (-1,))), axis)
+
+
+@primitive
+def _index_sample(x, index):
+    rows = jnp.arange(x.shape[0])[:, None]
+    return x[rows, index]
+
+
+def index_sample(x, index):
+    return _index_sample(x, index)
+
+
+@primitive
+def _take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def take_along_axis(x, indices, axis):
+    return _take_along_axis(x, indices, axis)
+
+
+@primitive
+def _put_along_axis(x, indices, values, axis, reduce):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    dims = [jnp.arange(s) for s in x.shape]
+    grids = jnp.meshgrid(*dims, indexing="ij")
+    grids[axis] = jnp.broadcast_to(indices, grids[axis].shape)
+    idx = tuple(grids)
+    if reduce == "add":
+        return x.at[idx].add(jnp.broadcast_to(values, x.shape))
+    if reduce == "multiply" or reduce == "mul":
+        return x.at[idx].multiply(jnp.broadcast_to(values, x.shape))
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    return _put_along_axis(x, indices, unwrap(values), axis, reduce)
+
+
+@primitive
+def _repeat_interleave(x, repeats, axis):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    if axis is None:
+        x = reshape(x, [-1])
+        axis = 0
+    return _repeat_interleave(x, unwrap(repeats), axis)
+
+
+def masked_select(x, mask):
+    """Dynamic-shape: eager-only on TPU (executes on host-visible shapes)."""
+    return wrap(unwrap(x)[unwrap(mask)])
+
+
+@primitive
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return _where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    """Dynamic-shape: eager-only."""
+    arrs = jnp.nonzero(unwrap(x))
+    if as_tuple:
+        return tuple(wrap(a[:, None]) for a in arrs)
+    return wrap(jnp.stack(arrs, axis=1))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    """Dynamic-shape: eager-only."""
+    res = jnp.unique(
+        unwrap(x),
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if isinstance(res, tuple):
+        return tuple(wrap(r) for r in res)
+    return wrap(res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    arr = np.asarray(unwrap(x))
+    vals = []
+    counts = []
+    inverse = np.zeros(arr.size, dtype=np.int64)
+    flat = arr.reshape(-1) if axis is None else arr
+    prev = None
+    for i, v in enumerate(flat.tolist()):
+        if prev is None or v != prev:
+            vals.append(v)
+            counts.append(1)
+        else:
+            counts[-1] += 1
+        inverse[i] = len(vals) - 1
+        prev = v
+    out = [wrap(jnp.asarray(np.asarray(vals, dtype=arr.dtype)))]
+    if return_inverse:
+        out.append(wrap(jnp.asarray(inverse)))
+    if return_counts:
+        out.append(wrap(jnp.asarray(np.asarray(counts, dtype=np.int64))))
+    return tuple(out) if len(out) > 1 else out[0]
+
+
+# ---------------------------------------------------------------------------
+# slice family
+# ---------------------------------------------------------------------------
+
+
+@primitive
+def _slice(x, axes, starts, ends):
+    idx = [builtins.slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = builtins.slice(s, e)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    return _slice(x, _ints(axes), _ints(starts), _ints(ends))
+
+
+@primitive
+def _strided_slice(x, axes, starts, ends, strides):
+    idx = [builtins.slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = builtins.slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    return _strided_slice(x, _ints(axes), _ints(starts), _ints(ends), _ints(strides))
+
+
+@primitive
+def _pad_nd(x, pad, mode, value):
+    return jnp.pad(x, pad, mode=mode, constant_values=value) if mode == "constant" else jnp.pad(x, pad, mode=mode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):  # noqa: A002
+    """paddle.nn.functional.pad semantics: `pad` is [l,r] pairs from the last
+    dim backwards when len(pad) < 2*ndim (conv-style), else full spec."""
+    x_arr = unwrap(x)
+    nd = x_arr.ndim
+    pad = _ints(pad)
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # conv-style: applies to spatial dims per data_format
+        npairs = len(pad) // 2
+        width = [(0, 0)] * nd
+        if data_format.endswith("C") and nd >= 3:  # NHWC/NLC/NDHWC
+            spatial = list(range(1, 1 + npairs))
+        else:  # NCHW-style
+            spatial = list(range(nd - npairs, nd))
+        for k, d in enumerate(spatial):
+            width[d] = (pad[2 * k], pad[2 * k + 1])
+    return _pad_nd(x, tuple(width), jmode, value)
+
+
+# ---------------------------------------------------------------------------
+# cast / dtype
+# ---------------------------------------------------------------------------
+
+
+@primitive
+def _cast_f(x, dt):
+    return x.astype(dt)
+
+
+def cast(x, dtype):
+    jdt = to_jax_dtype(dtype)
+    if jnp.issubdtype(jdt, jnp.inexact):
+        return _cast_f(x, jdt)
+    return wrap(unwrap(x).astype(jdt))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    """Parity: shard_index op (used by parallel vocab partitioning)."""
+    arr = unwrap(input)
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    in_shard = (arr >= lo) & (arr < lo + shard_size)
+    return wrap(jnp.where(in_shard, arr - lo, ignore_value))

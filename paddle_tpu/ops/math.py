@@ -1,0 +1,405 @@
+"""Elementwise + reduction math ops.
+
+Parity: the reference's elementwise/, reduce_ops/, activation and scalar math
+operators (/root/reference/paddle/fluid/operators/elementwise/,
+reduce_ops/reduce_op.cu.h, activation_op.cc) and the python surface
+python/paddle/tensor/math.py. Broadcasting, dtype promotion and fusion are
+XLA's job here — the reference's hand-written broadcast fast paths
+(elementwise_op_function.h) have no equivalent because the compiler owns them.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..dtype import to_jax_dtype
+from ..tensor import Tensor
+from ._primitive import primitive, unwrap, wrap
+
+# ---------------------------------------------------------------------------
+# unary elementwise
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs,
+    "acos": jnp.arccos,
+    "asin": jnp.arcsin,
+    "atan": jnp.arctan,
+    "acosh": jnp.arccosh,
+    "asinh": jnp.arcsinh,
+    "atanh": jnp.arctanh,
+    "ceil": jnp.ceil,
+    "cos": jnp.cos,
+    "cosh": jnp.cosh,
+    "digamma": jax.scipy.special.digamma,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "floor": jnp.floor,
+    "i0": lambda x: jax.scipy.special.i0(x),
+    "lgamma": jax.scipy.special.gammaln,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "neg": jnp.negative,
+    "reciprocal": jnp.reciprocal,
+    "rsqrt": jax.lax.rsqrt,
+    "sign": jnp.sign,
+    "sin": jnp.sin,
+    "sinh": jnp.sinh,
+    "sqrt": jnp.sqrt,
+    "square": jnp.square,
+    "tan": jnp.tan,
+    "tanh": jnp.tanh,
+    "trunc": jnp.trunc,
+    "angle": jnp.angle,
+    "conj": jnp.conj,
+    "real": jnp.real,
+    "imag": jnp.imag,
+    "deg2rad": jnp.deg2rad,
+    "rad2deg": jnp.rad2deg,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+_g = globals()
+for _name, _fn in _UNARY.items():
+    _g[_name] = primitive(_fn, name=_name)
+
+
+@primitive
+def round(x):  # noqa: A001
+    return jnp.round(x)
+
+
+@primitive
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@primitive
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@primitive
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise
+# ---------------------------------------------------------------------------
+
+
+def _binop(jfn, name):
+    def fn(x, y, name=None):  # noqa: ARG001 - paddle passes name kwarg
+        return _prim(x, y)
+
+    _prim = primitive(lambda x, y: jfn(jnp.asarray(unwrap(x)), jnp.asarray(unwrap(y))), name=name)
+    fn.__name__ = name
+    fn.raw = jfn
+    return fn
+
+
+add = _binop(jnp.add, "add")
+subtract = _binop(jnp.subtract, "subtract")
+multiply = _binop(jnp.multiply, "multiply")
+divide = _binop(jnp.true_divide, "divide")
+floor_divide = _binop(jnp.floor_divide, "floor_divide")
+remainder = _binop(jnp.remainder, "remainder")
+mod = remainder
+floor_mod = remainder
+pow = _binop(jnp.power, "pow")  # noqa: A001
+maximum = _binop(jnp.maximum, "maximum")
+minimum = _binop(jnp.minimum, "minimum")
+fmax = _binop(jnp.fmax, "fmax")
+fmin = _binop(jnp.fmin, "fmin")
+atan2 = _binop(jnp.arctan2, "atan2")
+heaviside = _binop(jnp.heaviside, "heaviside")
+kron = _binop(jnp.kron, "kron")
+gcd = _binop(jnp.gcd, "gcd")
+lcm = _binop(jnp.lcm, "lcm")
+logaddexp = _binop(jnp.logaddexp, "logaddexp")
+hypot = _binop(jnp.hypot, "hypot")
+copysign = _binop(jnp.copysign, "copysign")
+nextafter = _binop(jnp.nextafter, "nextafter")
+ldexp = _binop(lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)), "ldexp")
+
+
+@primitive
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    scale = jnp.asarray(scale, x.dtype) if not hasattr(scale, "dtype") else scale.astype(x.dtype)
+    if bias_after_scale:
+        out = x * scale + jnp.asarray(bias, x.dtype)
+    else:
+        out = (x + jnp.asarray(bias, x.dtype)) * scale
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    return out
+
+
+@primitive
+def clip(x, min=None, max=None):  # noqa: A002
+    return jnp.clip(x, unwrap(min), unwrap(max))
+
+
+@primitive
+def lerp(x, y, weight):
+    return x + unwrap(weight) * (y - x)
+
+
+@primitive
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@primitive
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)  # [n, batch, ...]
+    idx = jnp.reshape(index, (-1,))
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+def increment(x, value=1.0):
+    x._set_data(x._data + jnp.asarray(value, x._data.dtype))
+    return x
+
+
+def assign(x, output=None):
+    from .creation import assign as _assign
+
+    out = _assign(x)
+    if output is not None:
+        output._set_data(out._data)
+        return output
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@primitive
+def _sum(x, axis, keepdim, dtype):
+    return jnp.sum(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    return _sum(x, _axis(axis), keepdim, to_jax_dtype(dtype) if dtype else None)
+
+
+@primitive
+def _mean(x, axis, keepdim):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return _mean(x, _axis(axis), keepdim)
+
+
+@primitive
+def _prod(x, axis, keepdim, dtype):
+    return jnp.prod(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return _prod(x, _axis(axis), keepdim, to_jax_dtype(dtype) if dtype else None)
+
+
+@primitive
+def _max(x, axis, keepdim):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return _max(x, _axis(axis), keepdim)
+
+
+@primitive
+def _min(x, axis, keepdim):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return _min(x, _axis(axis), keepdim)
+
+
+amax = max
+amin = min
+
+
+@primitive
+def _logsumexp(x, axis, keepdim):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return _logsumexp(x, _axis(axis), keepdim)
+
+
+@primitive
+def _std(x, axis, unbiased, keepdim):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return _std(x, _axis(axis), unbiased, keepdim)
+
+
+@primitive
+def _var(x, axis, unbiased, keepdim):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return _var(x, _axis(axis), unbiased, keepdim)
+
+
+@primitive
+def _median(x, axis, keepdim):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return _median(x, _axis(axis), keepdim)
+
+
+@primitive
+def _quantile(x, q, axis, keepdim):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return _quantile(x, q, _axis(axis), keepdim)
+
+
+@primitive
+def _nanmean(x, axis, keepdim):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return _nanmean(x, _axis(axis), keepdim)
+
+
+@primitive
+def _nansum(x, axis, keepdim):
+    return jnp.nansum(x, axis=axis, keepdims=keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False):  # noqa: ARG001
+    return _nansum(x, _axis(axis), keepdim)
+
+
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return wrap(jnp.all(unwrap(x), axis=_axis(axis), keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return wrap(jnp.any(unwrap(x), axis=_axis(axis), keepdims=keepdim))
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return wrap(jnp.count_nonzero(unwrap(x), axis=_axis(axis), keepdims=keepdim))
+
+
+def numel(x):
+    return wrap(jnp.asarray(unwrap(x).size, jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# cumulative / running
+# ---------------------------------------------------------------------------
+
+
+@primitive
+def _cumsum(x, axis):
+    return jnp.cumsum(x, axis=axis)
+
+
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        from .manipulation import reshape
+
+        out = _cumsum(reshape(x, [-1]), 0)
+    else:
+        out = _cumsum(x, int(axis))
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+@primitive
+def _cumprod(x, axis):
+    return jnp.cumprod(x, axis=axis)
+
+
+def cumprod(x, dim=None, dtype=None):
+    out = _cumprod(x, int(dim))
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+@primitive
+def cummax_values(x, axis):
+    return jax.lax.cummax(x, axis=axis)
+
+
+@primitive
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# linear-algebra-lite that lives in paddle.tensor.math
+# ---------------------------------------------------------------------------
+
+
+@primitive
+def addmm(input, x, y, beta=1.0, alpha=1.0):  # noqa: A002
+    return beta * input + alpha * (x @ y)
+
+
+@primitive
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@primitive
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@primitive
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def isfinite(x):
+    return wrap(jnp.isfinite(unwrap(x)))
+
+
+def isinf(x):
+    return wrap(jnp.isinf(unwrap(x)))
+
+
+def isnan(x):
+    return wrap(jnp.isnan(unwrap(x)))

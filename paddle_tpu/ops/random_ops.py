@@ -1,0 +1,140 @@
+"""Random sampling ops.
+
+Parity: uniform_random / gaussian_random / randint / randperm / multinomial /
+bernoulli operators (/root/reference/paddle/fluid/operators/uniform_random_op.cc
+etc.) and python/paddle/tensor/random.py.
+
+TPU-native: every call draws a fresh subkey from the global stateful Generator
+(paddle_tpu.random) — functional jax PRNG under a stateful API, so results are
+reproducible under paddle.seed() yet safe inside jit traces.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..dtype import to_jax_dtype
+from ..random import split_key
+from ..tensor import Tensor
+from ._primitive import unwrap, wrap
+
+__all__ = [
+    "uniform",
+    "uniform_",
+    "rand",
+    "randn",
+    "normal",
+    "standard_normal",
+    "randint",
+    "randint_like",
+    "randperm",
+    "bernoulli",
+    "multinomial",
+    "poisson",
+    "exponential_",
+    "gumbel_softmax",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(unwrap(s)) for s in shape)
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0):  # noqa: A002
+    key = jax.random.key(seed) if seed else split_key()
+    return wrap(
+        jax.random.uniform(key, _shape(shape), to_jax_dtype(dtype), minval=min, maxval=max)
+    )
+
+
+def uniform_(x, min=-1.0, max=1.0):  # noqa: A002
+    x._set_data(
+        jax.random.uniform(split_key(), tuple(x._data.shape), x._data.dtype, minval=min, maxval=max)
+    )
+    return x
+
+
+def rand(shape, dtype="float32"):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype="float32"):
+    return wrap(jax.random.normal(split_key(), _shape(shape), to_jax_dtype(dtype)))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = jnp.asarray(unwrap(mean)), jnp.asarray(unwrap(std))
+        out_shape = jnp.broadcast_shapes(m.shape, s.shape)
+        return wrap(m + s * jax.random.normal(split_key(), out_shape, jnp.float32))
+    return wrap(mean + std * jax.random.normal(split_key(), _shape(shape), jnp.float32))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return wrap(jax.random.randint(split_key(), _shape(shape), low, high, to_jax_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    arr = unwrap(x)
+    return randint(low, high, tuple(arr.shape), dtype or str(arr.dtype))
+
+
+def randperm(n, dtype="int64"):
+    return wrap(jax.random.permutation(split_key(), n).astype(to_jax_dtype(dtype)))
+
+
+def bernoulli(x):
+    arr = unwrap(x)
+    return wrap(jax.random.bernoulli(split_key(), arr, arr.shape).astype(arr.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    arr = unwrap(x)
+    logits = jnp.log(jnp.maximum(arr, 1e-30))
+    if replacement:
+        out = jax.random.categorical(split_key(), logits, axis=-1, shape=(num_samples,) + arr.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1) if arr.ndim > 1 else out
+    else:
+        # Gumbel top-k sampling without replacement
+        g = jax.random.gumbel(split_key(), arr.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return wrap(out.astype(jnp.int64))
+
+
+def poisson(x):
+    arr = unwrap(x)
+    return wrap(jax.random.poisson(split_key(), arr, arr.shape).astype(arr.dtype))
+
+
+def exponential_(x, lam=1.0):
+    x._set_data(jax.random.exponential(split_key(), tuple(x._data.shape), x._data.dtype) / lam)
+    return x
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    from ._primitive import primitive
+
+    g = jax.random.gumbel(split_key(), tuple(unwrap(x).shape), unwrap(x).dtype)
+
+    @primitive
+    def _gs(x):
+        y = jax.nn.softmax((x + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.put_along_axis(
+                jnp.zeros_like(y), idx, jnp.asarray(1.0, y.dtype), axis=axis, inplace=False
+            )
+            # straight-through estimator: forward y_hard, grad through soft y
+            y = y + jax.lax.stop_gradient(y_hard - y)
+        return y
+
+    return _gs(x)

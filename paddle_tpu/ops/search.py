@@ -1,0 +1,125 @@
+"""Search / sort ops.
+
+Parity: argmax/argmin/argsort/top_k_v2/searchsorted/kthvalue/mode/sort
+(/root/reference/paddle/fluid/operators/arg_max_op.cc, top_k_v2_op.cc,
+argsort_op.cc). Index outputs are nondifferentiable; value outputs carry grad.
+"""
+from __future__ import annotations
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+from ..dtype import to_jax_dtype
+from ._primitive import primitive, unwrap, wrap
+
+__all__ = [
+    "argmax",
+    "argmin",
+    "argsort",
+    "sort",
+    "topk",
+    "kthvalue",
+    "mode",
+    "searchsorted",
+    "masked_fill",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(unwrap(x), axis=axis, keepdims=keepdim if axis is not None else False)
+    return wrap(out.astype(to_jax_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(unwrap(x), axis=axis, keepdims=keepdim if axis is not None else False)
+    return wrap(out.astype(to_jax_dtype(dtype)))
+
+
+def argsort(x, axis=-1, descending=False):
+    arr = unwrap(x)
+    idx = jnp.argsort(-arr if descending else arr, axis=axis, stable=True)
+    return wrap(idx.astype(jnp.int64))
+
+
+@primitive
+def _sort(x, axis, descending):
+    s = jnp.sort(x, axis=axis)
+    return jnp.flip(s, axis=axis) if descending else s
+
+
+def sort(x, axis=-1, descending=False):
+    return _sort(x, axis, descending)
+
+
+@primitive(aux=1)
+def _topk(x, k, axis, largest):
+    if axis != -1 and axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+    else:
+        xm = x
+    vals, idx = _lax_topk(xm, k, largest)
+    if axis != -1 and axis != x.ndim - 1:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def _lax_topk(x, k, largest):
+    if largest:
+        return lax.top_k(x, k)
+    vals, idx = lax.top_k(-x, k)
+    return -vals, idx
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    k = int(unwrap(k))
+    return _topk(x, k, axis, largest)
+
+
+@primitive(aux=1)
+def _kthvalue(x, k, axis, keepdim):
+    s = jnp.sort(x, axis=axis)
+    si = jnp.argsort(x, axis=axis)
+    vals = jnp.take(s, k - 1, axis=axis)
+    idx = jnp.take(si, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    return _kthvalue(x, k, axis, keepdim)
+
+
+def mode(x, axis=-1, keepdim=False):
+    import numpy as np
+    import scipy.stats
+
+    arr = np.asarray(unwrap(x))
+    m = scipy.stats.mode(arr, axis=axis, keepdims=keepdim)
+    vals = m.mode
+    # indices: last occurrence along axis equal to mode (paddle semantics)
+    expanded = vals if keepdim else np.expand_dims(vals, axis)
+    eq = arr == expanded
+    n = arr.shape[axis]
+    pos = np.arange(n).reshape([-1 if i == (axis % arr.ndim) else 1 for i in range(arr.ndim)])
+    idx = np.max(np.where(eq, pos, -1), axis=axis, keepdims=keepdim)
+    return wrap(jnp.asarray(vals)), wrap(jnp.asarray(idx.astype(np.int64)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    seq, vals = unwrap(sorted_sequence), unwrap(values)
+    if seq.ndim == 1:
+        out = jnp.searchsorted(seq, vals, side=side)
+    else:
+        out = jnp.stack(
+            [jnp.searchsorted(seq[i], vals[i], side=side) for i in range(seq.shape[0])]
+        )
+    return wrap(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+@primitive
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)

@@ -1,0 +1,134 @@
+"""Stateful RNG over jax's functional PRNG.
+
+Parity surface: ``paddle.seed`` (python/paddle/fluid/framework.py generator
+seeding), ``paddle/fluid/pybind/generator_py.cc``, and the tensor-parallel RNG
+state tracker (/root/reference/python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/random.py — get_rng_state_tracker) used to keep dropout masks
+identical or distinct across TP ranks.
+
+TPU-native design: one global Generator holds a jax PRNG key; every random op
+splits off a fresh subkey (functional under the hood, stateful at the API).
+Inside jit-traced code the split is traced, so randomness stays reproducible
+and compile-cache friendly.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+
+__all__ = [
+    "seed",
+    "Generator",
+    "default_generator",
+    "get_rng_state",
+    "set_rng_state",
+    "split_key",
+    "RNGStatesTracker",
+    "get_rng_state_tracker",
+]
+
+
+class Generator:
+    """Stateful wrapper over a jax PRNG key chain."""
+
+    def __init__(self, seed_: int = 0):
+        self._seed = int(seed_)
+        self._key = jax.random.key(self._seed)
+
+    def manual_seed(self, seed_: int):
+        self._seed = int(seed_)
+        self._key = jax.random.key(self._seed)
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def split(self):
+        """Return a fresh subkey; advances internal state."""
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+
+default_generator = Generator(0)
+
+
+def seed(value: int) -> Generator:
+    """Seed the global generator (parity: paddle.seed)."""
+    default_generator.manual_seed(value)
+    get_rng_state_tracker()._reseed_base(value)
+    return default_generator
+
+
+def split_key():
+    """Get a fresh PRNG subkey from the global generator."""
+    return default_generator.split()
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)
+
+
+class RNGStatesTracker:
+    """Named RNG streams for tensor-parallel determinism.
+
+    Parity: meta_parallel/parallel_layers/random.py RNGStatesTracker — dropout
+    inside a TP region must draw from a per-rank stream ('local_seed') while
+    non-TP dropout draws from the shared stream ('global_seed').
+    """
+
+    MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+    def __init__(self):
+        self._states: Dict[str, Generator] = {}
+
+    def reset(self):
+        self._states.clear()
+
+    def add(self, name: str, seed_: int):
+        if name in self._states:
+            raise ValueError(f"rng state {name} already exists")
+        self._states[name] = Generator(seed_)
+
+    def _reseed_base(self, base_seed: int):
+        # re-derive any registered streams deterministically from the new seed
+        for i, name in enumerate(sorted(self._states)):
+            self._states[name] = Generator(base_seed + 1000 + i)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        """Temporarily make the named stream the global default stream."""
+        if name not in self._states:
+            raise ValueError(f"rng state {name} was not added")
+        global default_generator
+        prev = default_generator
+        default_generator = self._states[name]
+        try:
+            yield
+        finally:
+            default_generator = prev
+
+    def get_states_tracker(self):
+        return {k: g.get_state() for k, g in self._states.items()}
+
+    def set_states_tracker(self, states):
+        for k, s in states.items():
+            self._states.setdefault(k, Generator(0)).set_state(s)
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker

@@ -1,0 +1,306 @@
+"""paddle_tpu.Tensor — the user-facing tensor.
+
+Parity: the reference's dual tensor stack — C++ ``framework::Tensor``
+(/root/reference/paddle/fluid/framework/tensor.h:89) plus dygraph ``VarBase``
+(/root/reference/paddle/fluid/imperative/layer.h:66) with numpy interop from
+pybind/tensor_py.h.
+
+TPU-native redesign: one thin mutable wrapper around an immutable
+``jax.Array``. No LoD (ragged batches are expressed with masks / segment ids —
+see ops.sequence), no Place-keyed allocator (PJRT owns memory), no
+DataLayout (XLA picks layouts). Autograd state lives here: ``stop_gradient``
+(paddle's inverted requires_grad), ``grad``, and the producing tape Node.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import device as device_mod
+from .autograd import tape
+from .dtype import to_jax_dtype as _to_jax_dtype
+from .dtype import to_paddle_dtype as _to_paddle_dtype
+
+__all__ = ["Tensor", "to_tensor", "is_tensor"]
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "grad",
+        "_node",
+        "_out_idx",
+        "_retain_grad",
+        "name",
+        "persistable",
+        "trainable",
+        "_hooks",
+        "__weakref__",
+    )
+
+    def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_idx = 0
+        self._retain_grad = False
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._hooks = None
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def value(self):
+        """The underlying jax.Array."""
+        return self._data
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def rank(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self):
+        return _to_paddle_dtype(self._data.dtype)
+
+    @property
+    def place(self):
+        try:
+            dev = next(iter(self._data.devices()))
+        except Exception:
+            return device_mod.CPUPlace(0)
+        if dev.platform == "tpu":
+            return device_mod.TPUPlace(dev.id)
+        return device_mod.CPUPlace(dev.id)
+
+    @property
+    def T(self):
+        from .ops import manipulation
+
+        return manipulation.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    # ------------------------------------------------------------------
+    # conversion
+    # ------------------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dt):
+        from .ops import manipulation
+
+        return manipulation.cast(self, dt)
+
+    cast = astype
+
+    def clone(self):
+        from .ops import math as math_ops
+
+        return math_ops.assign(self)
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def cpu(self):
+        return Tensor(
+            jax.device_put(self._data, jax.devices("cpu")[0]),
+            stop_gradient=self.stop_gradient,
+        )
+
+    def to(self, place):
+        p = device_mod._place_from(place)
+        return Tensor(
+            jax.device_put(self._data, p.jax_device()), stop_gradient=self.stop_gradient
+        )
+
+    def pin_memory(self):
+        return self.cpu()
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        tape.backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def retain_grads(self):
+        self._retain_grad = True
+
+    def register_hook(self, hook):
+        """Grad hook (parity: VarBase::AddGradVarHook). Called with the grad
+        Tensor when backward reaches this tensor; may return a replacement."""
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+        handle_idx = len(self._hooks) - 1
+
+        class _Handle:
+            def remove(_self):
+                self._hooks[handle_idx] = None
+
+        return _Handle()
+
+    # ------------------------------------------------------------------
+    # mutation (paddle-style in-place on the wrapper)
+    # ------------------------------------------------------------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        arr = jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(arr.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {arr.shape} vs {self._data.shape}"
+            )
+        self._data = arr
+        return self
+
+    def _set_data(self, arr):
+        """Internal: rebind storage without shape check (optimizer updates)."""
+        self._data = arr
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    # ------------------------------------------------------------------
+    # python protocol
+    # ------------------------------------------------------------------
+    def __repr__(self):
+        grad_flag = f", stop_gradient={self.stop_gradient}"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}{grad_flag},\n"
+            f"       {np.array2string(self.numpy(), prefix='       ')})"
+        )
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.numpy().item(), spec)
+        return object.__format__(self, spec)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __getitem__(self, idx):
+        from .ops import manipulation
+
+        return manipulation._getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        idx = tuple(
+            i._data if isinstance(i, Tensor) else i
+            for i in (idx if isinstance(idx, tuple) else (idx,))
+        )
+        self._data = self._data.at[idx].set(value)
+
+    def __hash__(self):
+        return id(self)
+
+    # arithmetic dunders are attached by ops/__init__.py via _register_methods
+    @classmethod
+    def _register_method(cls, name, fn):
+        setattr(cls, name, fn)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor parity."""
+    if isinstance(data, Tensor):
+        arr = data._data
+    elif isinstance(data, jax.Array):
+        arr = data
+    else:
+        arr = np.asarray(data)
+        # paddle promotes python float lists to float32 by default (numpy gives f64)
+        if dtype is None and arr.dtype == np.float64:
+            arr = arr.astype(np.float32)
+    if dtype is not None:
+        arr = jnp.asarray(arr, dtype=_to_jax_dtype(dtype))
+    else:
+        arr = jnp.asarray(arr)
+    if place is not None:
+        arr = jax.device_put(arr, device_mod._place_from(place).jax_device())
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+# jax pytree registration: a Tensor flattens to its array. This is what lets
+# whole Layers / optimizer states cross the jit boundary as pytrees.
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t._data,), (t.stop_gradient, t.name)),
+    lambda aux, children: Tensor(children[0], stop_gradient=aux[0], name=aux[1]),
+)

@@ -1,0 +1,31 @@
+"""Test harness config.
+
+Forces an 8-virtual-device CPU platform (parity with the reference's
+single-host multi-device test strategy, SURVEY.md §4.3) so every sharding /
+collective / pipeline test runs without TPU hardware.
+
+Note: jax is already imported by a pytest plugin before this file runs, so we
+use jax.config.update (honored until backend init) rather than env vars.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import paddle_tpu as paddle
+
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,108 @@
+"""OpTest-style harness: numpy-golden correctness + finite-difference grads.
+
+Parity: the reference's keystone op test pattern
+(/root/reference/python/paddle/fluid/tests/unittests/op_test.py:277 —
+check_output compares op vs numpy; check_grad compares analytic grads against
+get_numeric_gradient finite differences :110). TPU translation per SURVEY.md
+§4: numpy golden vs eager-XLA, plus an extra eager-vs-jit consistency check
+that the reference expresses as dygraph/static consistency.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(op_fn, np_fn, inputs, atol=1e-5, rtol=2e-4, kwargs=None):
+    """Run op_fn on Tensors and np_fn on numpy arrays; compare all outputs."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(a) for a in inputs]
+    got = op_fn(*tensors, **kwargs)
+    want = np_fn(*[np.asarray(a) for a in inputs], **kwargs)
+    _assert_all_close(got, want, atol, rtol)
+    return got
+
+
+def _flatten_out(out):
+    if isinstance(out, (list, tuple)):
+        res = []
+        for o in out:
+            res.extend(_flatten_out(o))
+        return res
+    return [out]
+
+
+def _assert_all_close(got, want, atol, rtol):
+    got_list = _flatten_out(got)
+    want_list = _flatten_out(want)
+    assert len(got_list) == len(want_list), f"{len(got_list)} outputs vs {len(want_list)}"
+    for g, w in zip(got_list, want_list):
+        g_np = g.numpy() if isinstance(g, paddle.Tensor) else np.asarray(g)
+        np.testing.assert_allclose(
+            np.asarray(g_np, dtype=np.float64) if np.issubdtype(g_np.dtype, np.floating) else g_np,
+            np.asarray(w, dtype=np.float64) if np.issubdtype(np.asarray(w).dtype, np.floating) else w,
+            atol=atol,
+            rtol=rtol,
+        )
+
+
+def get_numeric_gradient(fn, inputs, wrt_idx, delta=1e-3):
+    """Central finite differences of sum(fn(*inputs)) w.r.t. inputs[wrt_idx]."""
+    inputs = [np.asarray(a, dtype=np.float64) for a in inputs]
+    x = inputs[wrt_idx]
+    grad = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        orig = x[idx]
+        x[idx] = orig + delta
+        hi = float(np.sum(fn(*inputs)))
+        x[idx] = orig - delta
+        lo = float(np.sum(fn(*inputs)))
+        x[idx] = orig
+        grad[idx] = (hi - lo) / (2 * delta)
+        it.iternext()
+    return grad
+
+
+def check_grad(op_fn, np_fn, inputs, wrt=(0,), atol=5e-3, rtol=5e-3, kwargs=None):
+    """Compare tape-computed grads against finite differences."""
+    kwargs = kwargs or {}
+    tensors = [
+        paddle.to_tensor(np.asarray(a, dtype=np.float64), stop_gradient=False) for a in inputs
+    ]
+    out = op_fn(*tensors, **kwargs)
+    outs = _flatten_out(out)
+    loss = outs[0].sum() if outs[0].size > 1 else outs[0]
+    for o in outs[1:]:
+        if o.dtype in ("float32", "float64"):
+            loss = loss + o.sum()
+    loss.backward()
+    for i in wrt:
+        got = tensors[i].grad.numpy()
+        want = get_numeric_gradient(lambda *a: np_fn(*a, **kwargs), inputs, i)
+        np.testing.assert_allclose(got, want, atol=atol, rtol=rtol, err_msg=f"grad wrt input {i}")
+
+
+def check_eager_vs_jit(op_fn, inputs, kwargs=None, atol=1e-6):
+    """Eager vs jit consistency (≙ reference dygraph/static equivalence)."""
+    kwargs = kwargs or {}
+    arrays = [np.asarray(a) for a in inputs]
+    eager = op_fn(*[paddle.to_tensor(a) for a in arrays], **kwargs)
+
+    raw = getattr(op_fn, "raw", None)
+    if raw is None:
+        def raw_call(*arrs):
+            with paddle.no_grad():
+                out = op_fn(*[paddle.to_tensor(a) for a in arrs], **kwargs)
+            outs = _flatten_out(out)
+            return [o.value for o in outs]
+        jitted = jax.jit(raw_call)
+        got = jitted(*arrays)
+        _assert_all_close([paddle.Tensor(g) for g in got], [o.numpy() for o in _flatten_out(eager)], atol, atol)
+    else:
+        jitted = jax.jit(lambda *arrs: raw(*arrs, **kwargs))
+        got = jitted(*arrays)
+        _assert_all_close(got, [o.numpy() for o in _flatten_out(eager)], atol, atol)

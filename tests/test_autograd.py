@@ -1,0 +1,108 @@
+"""Tape autograd tests (parity: reference BasicEngine / imperative tests)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain():
+    x = paddle.to_tensor(np.array(0.4, np.float32), stop_gradient=False)
+    y = paddle.tanh(x * 3.0)
+    z = y * y
+    z.backward()
+    t = np.tanh(1.2)
+    np.testing.assert_allclose(x.grad.numpy(), 2 * t * (1 - t * t) * 3, rtol=1e-4)
+
+
+def test_accumulation_and_clear():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2])
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5, 5, 5])  # accumulated
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_fanout():
+    x = paddle.to_tensor(np.array(3.0, np.float32), stop_gradient=False)
+    a = x * 2
+    b = a + a * a
+    b.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 + 2 * 2 * 2 * 3.0)
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    y = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=True)
+    (x * y).sum().backward()
+    assert x.grad is not None and y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+
+
+def test_no_grad():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient and y._node is None
+
+
+def test_multi_output_op():
+    x = paddle.to_tensor(np.array([[3.0, 1.0, 2.0]], np.float32), stop_gradient=False)
+    parts = paddle.split(x, 3, axis=1)
+    (parts[0] * 5 + parts[2] * 2).backward(paddle.to_tensor(np.array([[1.0]], np.float32)))
+    np.testing.assert_allclose(x.grad.numpy(), [[5.0, 0.0, 2.0]])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor(np.array(2.0, np.float32), stop_gradient=False)
+    y = x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), 4.0)
+    assert x.grad is None  # grad() must not pollute .grad
+
+
+def test_register_hook():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6, 6])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(np.array(2.0, np.float32), stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 8.0)
+
+
+def test_non_scalar_backward_requires_grad_tensor():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = x * 2
+    try:
+        y.backward()
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError:
+        pass

@@ -1,0 +1,236 @@
+"""Manipulation / indexing / search / linalg op tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+
+def _rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+class TestShape:
+    def test_reshape(self):
+        check_output(lambda x: paddle.reshape(x, [2, 6]), lambda x: x.reshape(2, 6), [_rand(3, 4)])
+        check_output(lambda x: paddle.reshape(x, [-1]), lambda x: x.reshape(-1), [_rand(3, 4)])
+        check_grad(lambda x: paddle.reshape(x, [4, 3]), lambda x: x.reshape(4, 3), [_rand(3, 4)])
+
+    def test_transpose(self):
+        check_output(
+            lambda x: paddle.transpose(x, [1, 0, 2]), lambda x: x.transpose(1, 0, 2), [_rand(2, 3, 4)]
+        )
+        check_grad(lambda x: paddle.transpose(x, [1, 0]), lambda x: x.T, [_rand(3, 4)])
+
+    def test_squeeze_unsqueeze(self):
+        check_output(lambda x: paddle.squeeze(x, [1]), lambda x: x.squeeze(1), [_rand(3, 1, 4)])
+        check_output(lambda x: paddle.unsqueeze(x, 0), lambda x: x[None], [_rand(3, 4)])
+        check_output(lambda x: paddle.unsqueeze(x, [0, 2]), lambda x: np.expand_dims(x[None], 2), [_rand(3,)])
+
+    def test_flatten(self):
+        check_output(
+            lambda x: paddle.flatten(x, 1, 2), lambda x: x.reshape(2, 12, 5), [_rand(2, 3, 4, 5)]
+        )
+
+    def test_expand_tile(self):
+        check_output(lambda x: paddle.expand(x, [3, 4]), lambda x: np.broadcast_to(x, (3, 4)), [_rand(1, 4)])
+        check_output(lambda x: paddle.tile(x, [2, 3]), lambda x: np.tile(x, (2, 3)), [_rand(2, 2)])
+
+    def test_flip_roll(self):
+        check_output(lambda x: paddle.flip(x, [0]), lambda x: np.flip(x, 0), [_rand(3, 4)])
+        check_output(lambda x: paddle.roll(x, 2, 0), lambda x: np.roll(x, 2, 0), [_rand(5, 2)])
+
+
+class TestJoinSplit:
+    def test_concat(self):
+        a, b = _rand(2, 3), _rand(4, 3)
+        got = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_allclose(got.numpy(), np.concatenate([a, b], 0))
+
+    def test_concat_grad(self):
+        a, b = paddle.to_tensor(_rand(2, 3), stop_gradient=False), paddle.to_tensor(
+            _rand(2, 3), stop_gradient=False
+        )
+        out = paddle.concat([a, b], axis=1).sum()
+        out.backward()
+        np.testing.assert_allclose(a.grad.numpy(), np.ones((2, 3)))
+        np.testing.assert_allclose(b.grad.numpy(), np.ones((2, 3)))
+
+    def test_stack(self):
+        a, b = _rand(2, 3), _rand(2, 3)
+        got = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1)
+        np.testing.assert_allclose(got.numpy(), np.stack([a, b], 1))
+
+    def test_split(self):
+        x = _rand(6, 4)
+        parts = paddle.split(paddle.to_tensor(x), 3, axis=0)
+        assert len(parts) == 3
+        np.testing.assert_allclose(parts[1].numpy(), x[2:4])
+        parts = paddle.split(paddle.to_tensor(x), [1, 2, -1], axis=0)
+        np.testing.assert_allclose(parts[2].numpy(), x[3:])
+
+    def test_unbind(self):
+        x = _rand(3, 4)
+        parts = paddle.unbind(paddle.to_tensor(x), 0)
+        assert len(parts) == 3 and parts[0].shape == [4]
+
+
+class TestIndexing:
+    def test_gather(self):
+        x, idx = _rand(5, 3), np.array([0, 2, 4])
+        got = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(got.numpy(), x[idx])
+
+    def test_gather_nd(self):
+        x = _rand(3, 4, 5)
+        idx = np.array([[0, 1], [2, 3]])
+        got = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(got.numpy(), x[[0, 2], [1, 3]])
+
+    def test_scatter(self):
+        x = np.zeros((4, 2), np.float32)
+        idx = np.array([1, 3])
+        upd = _rand(2, 2)
+        got = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx), paddle.to_tensor(upd))
+        want = x.copy()
+        want[idx] = upd
+        np.testing.assert_allclose(got.numpy(), want)
+
+    def test_index_select(self):
+        x = _rand(4, 5)
+        got = paddle.index_select(paddle.to_tensor(x), paddle.to_tensor(np.array([1, 1, 3])), axis=0)
+        np.testing.assert_allclose(got.numpy(), x[[1, 1, 3]])
+
+    def test_getitem(self):
+        x = _rand(4, 5, 6)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(t[1].numpy(), x[1])
+        np.testing.assert_allclose(t[1:3, ::2].numpy(), x[1:3, ::2])
+        np.testing.assert_allclose(t[..., -1].numpy(), x[..., -1])
+
+    def test_getitem_grad(self):
+        t = paddle.to_tensor(_rand(4, 5), stop_gradient=False)
+        t[1:3].sum().backward()
+        want = np.zeros((4, 5))
+        want[1:3] = 1
+        np.testing.assert_allclose(t.grad.numpy(), want)
+
+    def test_setitem(self):
+        t = paddle.to_tensor(np.zeros((3, 3), np.float32))
+        t[1] = 5.0
+        assert t.numpy()[1].sum() == 15
+
+    def test_where(self):
+        c = np.array([[True, False], [False, True]])
+        a, b = _rand(2, 2), _rand(2, 2)
+        got = paddle.where(paddle.to_tensor(c), paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(got.numpy(), np.where(c, a, b))
+
+    def test_masked_select_nonzero(self):
+        x = np.array([[1.0, -2.0], [3.0, -4.0]], np.float32)
+        got = paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(x > 0))
+        np.testing.assert_allclose(got.numpy(), np.array([1.0, 3.0]))
+        nz = paddle.nonzero(paddle.to_tensor(x > 0))
+        np.testing.assert_allclose(nz.numpy(), np.array([[0, 0], [1, 0]]))
+
+
+class TestSearchSort:
+    def test_argmax_argmin(self):
+        x = _rand(3, 4)
+        assert paddle.argmax(paddle.to_tensor(x)).item() == np.argmax(x)
+        np.testing.assert_allclose(
+            paddle.argmax(paddle.to_tensor(x), axis=1).numpy(), np.argmax(x, 1)
+        )
+
+    def test_sort_argsort(self):
+        x = _rand(3, 5)
+        np.testing.assert_allclose(paddle.sort(paddle.to_tensor(x), axis=1).numpy(), np.sort(x, 1))
+        np.testing.assert_allclose(
+            paddle.argsort(paddle.to_tensor(x), axis=1).numpy(), np.argsort(x, 1, kind="stable")
+        )
+
+    def test_topk(self):
+        x = _rand(3, 6)
+        vals, idx = paddle.topk(paddle.to_tensor(x), 2, axis=1)
+        want = np.sort(x, 1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(vals.numpy(), want)
+        np.testing.assert_allclose(np.take_along_axis(x, idx.numpy(), 1), want)
+
+    def test_topk_grad(self):
+        t = paddle.to_tensor(np.array([1.0, 5.0, 3.0], np.float32), stop_gradient=False)
+        vals, _ = paddle.topk(t, 2)
+        vals.sum().backward()
+        np.testing.assert_allclose(t.grad.numpy(), np.array([0.0, 1.0, 1.0]))
+
+
+class TestLinalg:
+    def test_matmul(self):
+        check_output(paddle.matmul, np.matmul, [_rand(3, 4), _rand(4, 5)])
+        check_grad(paddle.matmul, np.matmul, [_rand(3, 4), _rand(4, 5)], wrt=(0, 1))
+
+    def test_matmul_transpose(self):
+        a, b = _rand(4, 3), _rand(4, 5)
+        got = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b), transpose_x=True)
+        np.testing.assert_allclose(got.numpy(), a.T @ b, rtol=1e-5)
+
+    def test_batched_matmul(self):
+        check_output(paddle.matmul, np.matmul, [_rand(2, 3, 4), _rand(2, 4, 5)])
+        check_output(paddle.bmm, np.matmul, [_rand(2, 3, 4), _rand(2, 4, 5)])
+
+    def test_norm_inverse_solve(self):
+        x = _rand(4, 4) + 4 * np.eye(4, dtype=np.float32)
+        np.testing.assert_allclose(
+            paddle.inverse(paddle.to_tensor(x)).numpy(), np.linalg.inv(x), atol=1e-4
+        )
+        b = _rand(4, 2)
+        np.testing.assert_allclose(
+            paddle.linalg.solve(paddle.to_tensor(x), paddle.to_tensor(b)).numpy(),
+            np.linalg.solve(x, b),
+            atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            paddle.norm(paddle.to_tensor(x)).numpy(), np.linalg.norm(x), rtol=1e-5
+        )
+
+    def test_einsum(self):
+        a, b = _rand(3, 4), _rand(4, 5)
+        got = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(got.numpy(), a @ b, rtol=1e-5)
+
+
+class TestCastDtype:
+    def test_cast(self):
+        x = _rand(3, 3)
+        t = paddle.to_tensor(x).astype("float64")
+        assert t.dtype == "float64"
+        i = paddle.to_tensor(x).cast("int32")
+        assert i.dtype == paddle.int32
+
+    def test_dtype_objects(self):
+        t = paddle.ones([2], dtype=paddle.float32)
+        assert t.dtype == paddle.float32 and t.dtype == "float32"
+
+
+class TestCreation:
+    def test_basic(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], dtype="int64").dtype == "int64"
+        np.testing.assert_allclose(paddle.full([2, 2], 3.5).numpy(), np.full((2, 2), 3.5))
+        np.testing.assert_allclose(paddle.arange(1, 7, 2).numpy(), np.arange(1, 7, 2))
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+
+    def test_tril_triu(self):
+        x = _rand(4, 4)
+        np.testing.assert_allclose(paddle.tril(paddle.to_tensor(x)).numpy(), np.tril(x))
+        np.testing.assert_allclose(paddle.triu(paddle.to_tensor(x), 1).numpy(), np.triu(x, 1))
+
+    def test_randoms(self):
+        paddle.seed(7)
+        a = paddle.rand([100])
+        assert 0 <= float(a.min()) and float(a.max()) <= 1
+        paddle.seed(7)
+        b = paddle.rand([100])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))

@@ -1,0 +1,138 @@
+"""Math/reduction op correctness vs numpy + gradient checks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_eager_vs_jit, check_grad, check_output
+
+
+def _rand(*shape):
+    return np.random.uniform(0.1, 1.0, shape).astype(np.float32)
+
+
+class TestUnary:
+    @pytest.mark.parametrize(
+        "name,np_fn",
+        [
+            ("exp", np.exp),
+            ("log", np.log),
+            ("sqrt", np.sqrt),
+            ("tanh", np.tanh),
+            ("abs", np.abs),
+            ("sin", np.sin),
+            ("cos", np.cos),
+            ("floor", np.floor),
+            ("ceil", np.ceil),
+            ("square", np.square),
+            ("sign", np.sign),
+            ("log1p", np.log1p),
+        ],
+    )
+    def test_forward(self, name, np_fn):
+        check_output(getattr(paddle, name), np_fn, [_rand(3, 4)])
+
+    @pytest.mark.parametrize("name", ["exp", "log", "sqrt", "tanh", "sin", "square"])
+    def test_grad(self, name):
+        np_fn = {"exp": np.exp, "log": np.log, "sqrt": np.sqrt, "tanh": np.tanh,
+                 "sin": np.sin, "square": np.square}[name]
+        check_grad(getattr(paddle, name), np_fn, [_rand(3, 4)])
+
+    def test_sigmoid(self):
+        check_output(paddle.sigmoid, lambda x: 1 / (1 + np.exp(-x)), [_rand(5)])
+
+    def test_rsqrt(self):
+        check_output(paddle.rsqrt, lambda x: 1 / np.sqrt(x), [_rand(5)], atol=1e-4)
+
+    def test_clip(self):
+        x = np.random.randn(4, 5).astype(np.float32)
+        got = paddle.clip(paddle.to_tensor(x), -0.5, 0.5)
+        np.testing.assert_allclose(got.numpy(), np.clip(x, -0.5, 0.5))
+
+
+class TestBinary:
+    @pytest.mark.parametrize(
+        "name,np_fn",
+        [
+            ("add", np.add),
+            ("subtract", np.subtract),
+            ("multiply", np.multiply),
+            ("divide", np.divide),
+            ("maximum", np.maximum),
+            ("minimum", np.minimum),
+            ("pow", np.power),
+        ],
+    )
+    def test_forward(self, name, np_fn):
+        check_output(getattr(paddle, name), np_fn, [_rand(3, 4), _rand(3, 4)])
+
+    def test_broadcast(self):
+        check_output(paddle.add, np.add, [_rand(3, 1, 4), _rand(2, 1)])
+
+    def test_grad_add_mul(self):
+        check_grad(paddle.add, np.add, [_rand(3, 4), _rand(3, 4)], wrt=(0, 1))
+        check_grad(paddle.multiply, np.multiply, [_rand(3, 4), _rand(3, 4)], wrt=(0, 1))
+
+    def test_grad_broadcast(self):
+        check_grad(paddle.add, np.add, [_rand(3, 4), _rand(4)], wrt=(0, 1))
+
+    def test_dunders(self):
+        a, b = paddle.to_tensor(_rand(3)), paddle.to_tensor(_rand(3))
+        np.testing.assert_allclose((a + b).numpy(), a.numpy() + b.numpy(), rtol=1e-6)
+        np.testing.assert_allclose((a - 1.0).numpy(), a.numpy() - 1.0, rtol=1e-6)
+        np.testing.assert_allclose((2.0 * a).numpy(), 2.0 * a.numpy(), rtol=1e-6)
+        np.testing.assert_allclose((a / b).numpy(), a.numpy() / b.numpy(), rtol=1e-6)
+        np.testing.assert_allclose((-a).numpy(), -a.numpy(), rtol=1e-6)
+        assert bool((a == a).all())
+
+
+class TestReduce:
+    @pytest.mark.parametrize(
+        "name,np_fn",
+        [("sum", np.sum), ("mean", np.mean), ("max", np.max), ("min", np.min), ("prod", np.prod)],
+    )
+    @pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False), (1, True), ([0, 1], False)])
+    def test_forward(self, name, np_fn, axis, keepdim):
+        np_axis = tuple(axis) if isinstance(axis, list) else axis
+        check_output(
+            lambda x: getattr(paddle, name)(x, axis=axis, keepdim=keepdim),
+            lambda x: np_fn(x, axis=np_axis, keepdims=keepdim),
+            [_rand(3, 4, 5)],
+        )
+
+    def test_grad_sum_mean(self):
+        check_grad(lambda x: paddle.sum(x, axis=1), lambda x: np.sum(x, axis=1), [_rand(3, 4)])
+        check_grad(lambda x: paddle.mean(x), lambda x: np.mean(x), [_rand(3, 4)])
+
+    def test_std_var(self):
+        x = _rand(4, 6)
+        check_output(paddle.std, lambda a: np.std(a, ddof=1), [x], atol=1e-5)
+        check_output(paddle.var, lambda a: np.var(a, ddof=1), [x], atol=1e-5)
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as sls
+
+        check_output(paddle.logsumexp, lambda a: sls(a), [_rand(3, 4)])
+
+    def test_cumsum(self):
+        check_output(lambda x: paddle.cumsum(x, axis=1), lambda x: np.cumsum(x, axis=1), [_rand(3, 4)])
+        check_grad(lambda x: paddle.cumsum(x, axis=0), lambda x: np.cumsum(x, axis=0), [_rand(3, 2)])
+
+
+class TestJitConsistency:
+    def test_eager_vs_jit(self):
+        check_eager_vs_jit(paddle.tanh, [_rand(4, 4)])
+        check_eager_vs_jit(paddle.add, [_rand(2, 3), _rand(2, 3)])
+
+
+class TestScaleTrace:
+    def test_scale(self):
+        x = _rand(3, 3)
+        got = paddle.scale(paddle.to_tensor(x), scale=2.0, bias=1.0)
+        np.testing.assert_allclose(got.numpy(), x * 2 + 1, rtol=1e-6)
+
+    def test_trace_addmm(self):
+        x = _rand(3, 3)
+        np.testing.assert_allclose(paddle.trace(paddle.to_tensor(x)).numpy(), np.trace(x), rtol=1e-6)
+        a, b, c = _rand(2, 2), _rand(2, 3), _rand(3, 2)
+        got = paddle.addmm(paddle.to_tensor(a), paddle.to_tensor(b), paddle.to_tensor(c), beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(got.numpy(), 0.5 * a + 2.0 * (b @ c), rtol=1e-4)
