@@ -113,18 +113,27 @@ def _accumulate(t, g):
         t.grad = Tensor(t.grad._data + g, stop_gradient=True)
 
 
-def backward(tensor, grad_tensor=None, retain_graph: bool = False):
+def backward(tensor, grad_tensor=None, retain_graph: bool = False, only_into=None):
     """Run reverse-mode autodiff from ``tensor`` to all reachable leaves.
 
     Parity: Tensor.backward / BasicEngine. Cotangents propagate node-by-node
     in reverse creation order; leaf tensors (stop_gradient=False with no
     producing node) and retained non-leaves receive ``.grad``.
+
+    ``only_into``: optional set of tensor ids — when given, ``.grad`` is only
+    written for those tensors (used by ``grad()`` to avoid polluting other
+    leaves' slots).
     """
+
+    def acc(t, g):
+        if only_into is None or id(t) in only_into:
+            _accumulate(t, g)
+
     if tensor._node is None:
         if not tensor.stop_gradient:
             # a leaf: d(t)/d(t) = 1
             g = jnp.ones_like(tensor._data) if grad_tensor is None else grad_tensor._data
-            _accumulate(tensor, g)
+            acc(tensor, g)
         return
 
     if grad_tensor is None:
@@ -136,13 +145,20 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False):
     else:
         seed_grad = grad_tensor._data if hasattr(grad_tensor, "_data") else jnp.asarray(grad_tensor)
 
-    # Gather reachable subgraph.
+    # Gather reachable subgraph. Any released node in the cone means the
+    # graph was freed by a prior backward() — error, like the reference
+    # engine (basic_engine.cc asserts grad-op buffers are live).
     nodes = {}
     stack = [tensor._node]
     while stack:
         n = stack.pop()
-        if n.index in nodes or n.released:
+        if n.index in nodes:
             continue
+        if n.released:
+            raise RuntimeError(
+                "Trying to backward through a graph that has already been "
+                "freed; pass retain_graph=True to the first backward() call"
+            )
         nodes[n.index] = n
         for inp in n.inputs:
             if inp._node is not None:
@@ -154,10 +170,6 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False):
 
     for idx in sorted(nodes, reverse=True):
         node = nodes[idx]
-        if node.released:
-            raise RuntimeError(
-                "Trying to backward through a released graph; pass retain_graph=True"
-            )
         out_cots = []
         any_seen = False
         for pos, (shape, dt) in enumerate(node.out_avals):
@@ -176,10 +188,10 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False):
             if inp._node is not None:
                 k = (inp._node.index, inp._out_idx)
                 if inp._retain_grad:
-                    _accumulate(inp, g)
+                    acc(inp, g)
                 cots[k] = g if k not in cots else cots[k] + g
             else:
-                _accumulate(inp, g)
+                acc(inp, g)
         if not retain_graph:
             node.released = True
             node.vjp_fn = None
@@ -197,10 +209,19 @@ def grad(
     """``paddle.grad`` parity (reference: imperative/partial_grad_engine.cc).
 
     Computes grads of ``outputs`` w.r.t. ``inputs`` without touching ``.grad``
-    slots. ``create_graph`` is supported by rerunning the captured forward
-    closures under jax tracing (vjp-of-vjp).
+    slots of other leaves. ``create_graph=True`` (double grad through the
+    eager tape) is not supported in v1 — use ``paddle_tpu.autograd.functional``
+    (jacobian/hessian/vjp over pure functions, where jax composes derivatives
+    natively).
     """
     from ..tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported on the eager tape; use "
+            "paddle_tpu.autograd.functional (jacobian/hessian/vjp) for "
+            "higher-order derivatives"
+        )
 
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
@@ -214,9 +235,10 @@ def grad(
     for t in inputs:
         t.grad = None
         t._retain_grad = True
+    wanted = {id(t) for t in inputs}
     try:
         for o, go in zip(outputs, grad_outputs):
-            backward(o, go, retain_graph=retain)
+            backward(o, go, retain_graph=retain, only_into=wanted)
         results = []
         for t in inputs:
             if t.grad is None:
@@ -227,7 +249,7 @@ def grad(
                     )
                 results.append(None)
             else:
-                results.append(Tensor(t.grad._data, stop_gradient=not create_graph))
+                results.append(Tensor(t.grad._data, stop_gradient=True))
     finally:
         for t, g, r in saved:
             t.grad, t._retain_grad = g, r

@@ -1,0 +1,20 @@
+"""paddle_tpu.nn — layers, functional ops, initializers, clipping.
+
+Parity: python/paddle/nn/__init__.py surface of the reference.
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .functional_attention import scaled_dot_product_attention  # noqa: F401
+from .layer import Layer, LayerList, Parameter, ParameterList, Sequential  # noqa: F401
+from .layers.activation import *  # noqa: F401,F403
+from .layers.common import *  # noqa: F401,F403
+from .layers.conv import *  # noqa: F401,F403
+from .layers.loss import *  # noqa: F401,F403
+from .layers.norm import *  # noqa: F401,F403
+from .layers.pooling import *  # noqa: F401,F403
+from .layers.rnn import *  # noqa: F401,F403
+from .layers.transformer import *  # noqa: F401,F403
+from .param_attr import ParamAttr  # noqa: F401
+
+initializer.set_global_initializer = lambda *a, **k: None  # parity stub

@@ -1,0 +1,97 @@
+"""Gradient clipping.
+
+Parity: python/paddle/fluid/clip.py (ClipGradByValue, ClipGradByNorm,
+ClipGradByGlobalNorm). Functional cores are pure so the same logic runs inside
+jitted distributed train steps (where the reference re-implements global-norm
+clip inside HybridParallelOptimizer, dygraph_optimizer/hybrid_parallel_optimizer.py:45).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm", "clip_grads_functional"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        """params_grads: list of (param, grad Tensor). Returns new list."""
+        raise NotImplementedError
+
+    def _clip_arrays(self, grads):
+        """Pure: list of jax arrays -> list of clipped arrays."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _clip_arrays(self, grads):
+        return [None if g is None else jnp.clip(g, self.min, self.max) for g in grads]
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor(jnp.clip(g._data, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_arrays(self, grads):
+        outs = []
+        for g in grads:
+            if g is None:
+                outs.append(None)
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            outs.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return outs
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor(self._clip_arrays([g._data])[0])))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _clip_arrays(self, grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads if g is not None]
+        if not sq:
+            return grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [None if g is None else (g.astype(jnp.float32) * scale).astype(g.dtype) for g in grads]
+
+    def __call__(self, params_grads):
+        clippable = [(p, g) for p, g in params_grads if g is not None and getattr(p, "need_clip", True)]
+        rest = [(p, g) for p, g in params_grads if g is None or not getattr(p, "need_clip", True)]
+        clipped = self._clip_arrays([g._data for _, g in clippable])
+        return [(p, Tensor(cg)) for (p, _), cg in zip(clippable, clipped)] + rest
+
+
+def clip_grads_functional(clip, grads_tree):
+    """Apply a ClipGradBase to a pytree of grad arrays (for jitted steps)."""
+    import jax
+
+    if clip is None:
+        return grads_tree
+    leaves, treedef = jax.tree_util.tree_flatten(grads_tree)
+    return jax.tree_util.tree_unflatten(treedef, clip._clip_arrays(leaves))

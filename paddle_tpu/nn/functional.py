@@ -1,0 +1,956 @@
+"""nn functional ops.
+
+Parity: python/paddle/nn/functional/ in the reference (activation.py, common.py,
+conv.py, loss.py, norm.py, pooling.py, input.py) over the C++ kernels in
+/root/reference/paddle/fluid/operators/ (conv_cudnn_op.cu, pool_op.cu,
+layer_norm_op.cu, softmax_with_cross_entropy_op.cu, lookup_table_v2_op.cu ...).
+
+TPU-native: convs/matmuls lower to the MXU through lax.conv_general_dilated /
+jnp.matmul; XLA fuses the elementwise epilogues that the reference implements
+as fused_* CUDA ops. Dropout draws from the global seeded PRNG (TP-aware via
+paddle_tpu.random's state tracker).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops._primitive import primitive, unwrap, wrap
+from ..random import split_key
+from ..tensor import Tensor
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+relu = primitive(jax.nn.relu, name="relu")
+relu6 = primitive(jax.nn.relu6, name="relu6")
+elu = primitive(lambda x, alpha=1.0: jax.nn.elu(x, alpha), name="elu")
+selu = primitive(
+    lambda x, scale=1.0507009873554805, alpha=1.6732632423543772: scale
+    * jnp.where(x > 0, x, alpha * jnp.expm1(x)),
+    name="selu",
+)
+celu = primitive(lambda x, alpha=1.0: jax.nn.celu(x, alpha), name="celu")
+silu = primitive(jax.nn.silu, name="silu")
+swish = silu
+mish = primitive(lambda x: x * jnp.tanh(jax.nn.softplus(x)), name="mish")
+sigmoid = primitive(jax.nn.sigmoid, name="sigmoid")
+log_sigmoid = primitive(jax.nn.log_sigmoid, name="log_sigmoid")
+tanh = primitive(jnp.tanh, name="tanh")
+softsign = primitive(jax.nn.soft_sign, name="softsign")
+tanhshrink = primitive(lambda x: x - jnp.tanh(x), name="tanhshrink")
+
+
+@primitive
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@primitive
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@primitive
+def prelu(x, weight, data_format="NCHW"):
+    if weight.size == 1:
+        w = weight.reshape(())
+    else:
+        shape = [1] * x.ndim
+        ch_axis = 1 if data_format == "NCHW" else x.ndim - 1
+        shape[ch_axis] = weight.size
+        w = weight.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+@primitive
+def hardtanh(x, min=-1.0, max=1.0):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+@primitive
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@primitive
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@primitive
+def hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@primitive
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@primitive
+def softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(x * beta > threshold, x, jax.nn.softplus(x * beta) / beta)
+
+
+@primitive
+def maxout(x, groups, axis=1):
+    shape = list(x.shape)
+    c = shape[axis]
+    shape[axis : axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+@primitive
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@primitive
+def softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@primitive
+def log_softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@primitive
+def temperature_scaled_softmax(x, temperature=1.0, axis=-1):
+    return jax.nn.softmax(x / temperature, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding
+# ---------------------------------------------------------------------------
+
+
+@primitive
+def linear(x, weight, bias=None):
+    """y = x @ W (+ b); paddle weight layout [in_features, out_features]
+    (reference: matmul_v2 + elementwise_add, python/paddle/nn/functional/common.py)."""
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@primitive
+def _embedding(weight, ids, padding_idx):
+    if padding_idx is not None:
+        # freeze the padding row: value passes through, grad is zeroed
+        row = jax.lax.stop_gradient(weight[padding_idx])
+        weight = weight.at[padding_idx].set(row)
+    return jnp.take(weight, ids, axis=0)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):  # noqa: ARG001 - sparse n/a on TPU
+    return _embedding(weight, unwrap(x), padding_idx)
+
+
+def one_hot(x, num_classes):
+    return wrap(jax.nn.one_hot(unwrap(x), num_classes, dtype=jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
+    """Parity: dropout op (reference operators/dropout_op.cu);
+    'upscale_in_train' (default) and 'downscale_in_infer' modes."""
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            from ..ops import math as M
+
+            return M.scale(x, scale=1.0 - p)
+        return x
+    if p == 1.0:
+        from ..ops import creation
+
+        return creation.zeros_like(x) * x if isinstance(x, Tensor) else wrap(jnp.zeros_like(unwrap(x)))
+    arr = unwrap(x)
+    mask_shape = list(arr.shape)
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        mask_shape = [s if i in axes else 1 for i, s in enumerate(mask_shape)]
+    keep = jax.random.bernoulli(split_key(), 1.0 - p, tuple(mask_shape))
+
+    @primitive
+    def _dropout(x):
+        scaled = x / (1.0 - p) if mode == "upscale_in_train" else x
+        return jnp.where(keep, scaled, 0.0).astype(x.dtype)
+
+    return _dropout(x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale_ = 1.0507009873554805
+    alpha_p = -alpha * scale_
+    arr = unwrap(x)
+    keep = jax.random.bernoulli(split_key(), 1.0 - p, tuple(arr.shape))
+    a = (1.0 - p + p * alpha_p**2) ** -0.5
+    b = -a * p * alpha_p
+
+    @primitive
+    def _ad(x):
+        return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
+
+    return _ad(x)
+
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    v = tuple(int(i) for i in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _conv_padding(padding, nd, strides, dilations, ksize):
+    """Convert paddle padding spec to lax padding list of (lo, hi)."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * nd
+    padding = list(padding)
+    if len(padding) == nd and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(nd)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        return [tuple(int(v) for v in p) for p in padding]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd, data_format, transpose=False, output_padding=0):
+    strides = _norm_tuple(stride, nd)
+    dilations = _norm_tuple(dilation, nd)
+    spatial = "DHW"[-nd:]
+    if data_format in (f"NC{spatial}", "NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + spatial
+    else:
+        lhs_spec = "N" + spatial + "C"
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(
+        unwrap(x).shape, unwrap(weight).shape, (lhs_spec, rhs_spec, out_spec)
+    )
+    pad = _conv_padding(padding, nd, strides, dilations, None)
+
+    @primitive
+    def _conv(x, weight, bias):
+        if not transpose:
+            out = jax.lax.conv_general_dilated(
+                x,
+                weight,
+                window_strides=strides,
+                padding=pad,
+                rhs_dilation=dilations,
+                dimension_numbers=dn,
+                feature_group_count=groups,
+            )
+        else:
+            # conv_transpose: gradient of conv. weight layout [in_c, out_c/groups, *k]
+            pads = pad
+            if isinstance(pads, str):
+                pads_l = pads
+            else:
+                k_eff = [
+                    (weight.shape[2 + i] - 1) * dilations[i] + 1 for i in range(nd)
+                ]
+                opad = _norm_tuple(output_padding, nd)
+                pads_l = [
+                    (k_eff[i] - 1 - pads[i][0], k_eff[i] - 1 - pads[i][1] + opad[i])
+                    for i in range(nd)
+                ]
+            w = jnp.swapaxes(weight, 0, 1)  # -> [out_c/g, in_c, *k]
+            w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+            if groups > 1:
+                # grouped transpose conv: block-diagonal equivalent
+                w_groups = jnp.split(w, groups, axis=1)
+                x_groups = jnp.split(x, groups, axis=1 if lhs_spec.startswith("NC") else -1)
+                outs = [
+                    jax.lax.conv_general_dilated(
+                        xg,
+                        wg,
+                        window_strides=(1,) * nd,
+                        padding=pads_l,
+                        lhs_dilation=strides,
+                        dimension_numbers=dn,
+                    )
+                    for xg, wg in zip(x_groups, w_groups)
+                ]
+                out = jnp.concatenate(outs, axis=1 if lhs_spec.startswith("NC") else -1)
+            else:
+                out = jax.lax.conv_general_dilated(
+                    x,
+                    w,
+                    window_strides=(1,) * nd,
+                    padding=pads_l,
+                    lhs_dilation=strides,
+                    dimension_numbers=dn,
+                )
+        if bias is not None:
+            bshape = [1] * out.ndim
+            bshape[1 if lhs_spec.startswith("NC") else -1] = bias.shape[0]
+            out = out + bias.reshape(bshape)
+        return out
+
+    return _conv(x, weight, bias)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCL"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, data_format, transpose=True, output_padding=output_padding)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCHW"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format, transpose=True, output_padding=output_padding)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCDHW"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format, transpose=True, output_padding=output_padding)
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+
+def _pool_nd(x, kernel_size, stride, padding, nd, data_format, kind, exclusive=True, ceil_mode=False):
+    ks = _norm_tuple(kernel_size, nd)
+    st = _norm_tuple(stride if stride is not None else kernel_size, nd)
+    pad = _conv_padding(padding, nd, st, (1,) * nd, ks)
+    channel_first = data_format.startswith("NC")
+    if channel_first:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = [(0, 0), (0, 0)] + (pad if isinstance(pad, list) else [])
+    else:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pads = [(0, 0)] + (pad if isinstance(pad, list) else []) + [(0, 0)]
+    if isinstance(pad, str):
+        pads = pad
+
+    @primitive
+    def _pool(x):
+        if ceil_mode and not isinstance(pads, str):
+            # extend hi padding so the last partial window is included
+            sp_dims = range(2, 2 + nd) if channel_first else range(1, 1 + nd)
+            new_pads = list(pads)
+            for i, d in enumerate(sp_dims):
+                size = x.shape[d] + pads[d][0] + pads[d][1]
+                rem = (size - ks[i]) % st[i]
+                if rem != 0:
+                    lo, hi = new_pads[d]
+                    new_pads[d] = (lo, hi + (st[i] - rem))
+            eff_pads = new_pads
+        else:
+            eff_pads = pads
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            return jax.lax.reduce_window(x, init, jax.lax.max, window, strides, eff_pads)
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, eff_pads)
+        if exclusive and (isinstance(eff_pads, str) or any(p != (0, 0) for p in eff_pads)):
+            ones = jnp.ones_like(x)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, eff_pads)
+            return s / cnt
+        return s / float(np.prod(ks))
+
+    return _pool(x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCL"):
+    out = _pool_nd(x, kernel_size, stride, padding, 1, data_format, "max", ceil_mode=ceil_mode)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCHW"):
+    return _pool_nd(x, kernel_size, stride, padding, 2, data_format, "max", ceil_mode=ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCDHW"):
+    return _pool_nd(x, kernel_size, stride, padding, 3, data_format, "max", ceil_mode=ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL"):
+    return _pool_nd(x, kernel_size, stride, padding, 1, data_format, "avg", exclusive, ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW"):
+    return _pool_nd(x, kernel_size, stride, padding, 2, data_format, "avg", exclusive, ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW"):
+    return _pool_nd(x, kernel_size, stride, padding, 3, data_format, "avg", exclusive, ceil_mode)
+
+
+def _adaptive_pool(x, output_size, nd, kind, data_format):
+    out_size = _norm_tuple(output_size, nd)
+    channel_first = data_format.startswith("NC")
+
+    @primitive
+    def _apool(x):
+        sp = x.shape[2 : 2 + nd] if channel_first else x.shape[1 : 1 + nd]
+        out = x
+        for i in range(nd):
+            in_s, out_s = sp[i], out_size[i]
+            axis = (2 + i) if channel_first else (1 + i)
+            if in_s % out_s == 0:
+                k = in_s // out_s
+                shape = list(out.shape)
+                shape[axis : axis + 1] = [out_s, k]
+                r = out.reshape(shape)
+                out = jnp.max(r, axis=axis + 1) if kind == "max" else jnp.mean(r, axis=axis + 1)
+            else:
+                # general adaptive: per-output-bin segment reduce
+                starts = [math.floor(j * in_s / out_s) for j in range(out_s)]
+                ends = [math.ceil((j + 1) * in_s / out_s) for j in range(out_s)]
+                pieces = []
+                for s_, e_ in zip(starts, ends):
+                    sl = [builtins_slice(None)] * out.ndim
+                    sl[axis] = builtins_slice(s_, e_)
+                    seg = out[tuple(sl)]
+                    red = jnp.max(seg, axis=axis, keepdims=True) if kind == "max" else jnp.mean(seg, axis=axis, keepdims=True)
+                    pieces.append(red)
+                out = jnp.concatenate(pieces, axis=axis)
+        return out
+
+    return _apool(x)
+
+
+builtins_slice = slice
+
+
+def adaptive_avg_pool1d(x, output_size, data_format="NCL"):
+    return _adaptive_pool(x, output_size, 1, "avg", data_format)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, data_format="NCL"):
+    return _adaptive_pool(x, output_size, 1, "max", data_format)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
+    return _adaptive_pool(x, output_size, 2, "max", data_format)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, data_format="NCDHW"):
+    return _adaptive_pool(x, output_size, 3, "max", data_format)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+@primitive
+def _ln(x, weight, bias, eps, begin_axis):
+    axes = tuple(range(begin_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    begin = unwrap(x).ndim - len(tuple(normalized_shape))
+    return _ln(x, weight, bias, epsilon, begin)
+
+
+@primitive
+def _bn_infer(x, mean, var, weight, bias, eps, ch_axis):
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    out = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + eps)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@primitive(aux=2)
+def _bn_train(x, weight, bias, eps, ch_axis):
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    out = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + eps)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, jax.lax.stop_gradient(mean), jax.lax.stop_gradient(var)
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    data_format="NCHW",
+    use_global_stats=None,
+):
+    """Parity: batch_norm op (reference operators/batch_norm_op.cu). Updates
+    running stats in-place on the provided Tensors when training."""
+    ch_axis = 1 if data_format.startswith("NC") or data_format in ("NC", "NCL") else unwrap(x).ndim - 1
+    if use_global_stats is None:
+        use_global_stats = not training
+    if use_global_stats:
+        return _bn_infer(x, running_mean, running_var, weight, bias, epsilon, ch_axis)
+    out, batch_mean, batch_var = _bn_train(x, weight, bias, epsilon, ch_axis)
+    if running_mean is not None:
+        # reference updates running_var with the BIASED batch variance
+        # (batch_norm_op.cc:380-416) — keep that exactly for eval parity
+        running_mean._set_data(momentum * running_mean._data + (1 - momentum) * unwrap(batch_mean))
+        running_var._set_data(momentum * running_var._data + (1 - momentum) * unwrap(batch_var))
+    return out
+
+
+builtins_max = max
+
+
+@primitive
+def _in_norm(x, weight, bias, eps):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        shape = [1, -1] + [1] * (x.ndim - 2)
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        shape = [1, -1] + [1] * (x.ndim - 2)
+        out = out + bias.reshape(shape)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW"):
+    return _in_norm(x, weight, bias, eps)
+
+
+@primitive
+def _gn(x, weight, bias, eps, groups):
+    n, c = x.shape[0], x.shape[1]
+    g = groups
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    if weight is not None:
+        shape = [1, c] + [1] * (x.ndim - 2)
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        shape = [1, c] + [1] * (x.ndim - 2)
+        out = out + bias.reshape(shape)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW"):
+    return _gn(x, weight, bias, epsilon, num_groups)
+
+
+@primitive
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    n = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=True), 1.0 / p)
+    return x / jnp.maximum(n, epsilon)
+
+
+@primitive
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW"):
+    sq = jnp.square(x)
+    half = size // 2
+    c = x.shape[1]
+    pads = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
+    padded = jnp.pad(sq, pads)
+    acc = sum(padded[:, i : i + c] for i in range(size))
+    return x / jnp.power(k + alpha * acc / size, beta)
+
+
+# ---------------------------------------------------------------------------
+# vision ops
+# ---------------------------------------------------------------------------
+
+
+@primitive
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3)
+    return out.reshape(n, c // (r * r), h * r, w * r)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW"):
+    """Parity: *_interp_v2 ops. Supports nearest/bilinear/bicubic/trilinear/
+    linear/area via jax.image.resize; align_corners handled with a custom grid."""
+    arr = unwrap(x)
+    nd = arr.ndim - 2
+    if size is None:
+        sf = _norm_tuple(scale_factor, nd)
+        size = [int(arr.shape[2 + i] * sf[i]) for i in range(nd)]
+    else:
+        size = [int(unwrap(s)) for s in (size if isinstance(size, (list, tuple, Tensor)) else [size])]
+    method = {
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "linear": "linear",
+        "trilinear": "linear",
+        "bicubic": "cubic",
+        "area": "linear",
+    }[mode]
+
+    @primitive
+    def _resize(x):
+        out_shape = x.shape[:2] + tuple(size)
+        if not align_corners or method == "nearest":
+            return jax.image.resize(x, out_shape, method=method)
+        # align_corners=True: gather on an endpoint-inclusive grid
+        out = x
+        for i in range(nd):
+            axis = 2 + i
+            in_s, out_s = x.shape[axis], size[i]
+            if out_s == 1:
+                coords = jnp.zeros((1,))
+            else:
+                coords = jnp.linspace(0.0, in_s - 1, out_s)
+            lo = jnp.floor(coords).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, in_s - 1)
+            w_hi = (coords - lo).astype(x.dtype)
+            out_lo = jnp.take(out, lo, axis=axis)
+            out_hi = jnp.take(out, hi, axis=axis)
+            bshape = [1] * out.ndim
+            bshape[axis] = out_s
+            w_hi = w_hi.reshape(bshape)
+            out = out_lo * (1 - w_hi) + out_hi * w_hi
+        return out
+
+    return _resize(x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW"):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+@primitive
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (reference operators/unfold_op.cc)."""
+    ks = _norm_tuple(kernel_sizes, 2)
+    st = _norm_tuple(strides, 2)
+    dl = _norm_tuple(dilations, 2)
+    pd = _norm_tuple(paddings, 2) if not isinstance(paddings, (list, tuple)) or len(paddings) <= 2 else tuple(paddings)
+    if len(pd) == 2:
+        pd = (pd[0], pd[0], pd[1], pd[1])
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, [(0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])])
+    out_h = (xp.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+    out_w = (xp.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+    cols = []
+    for i in range(ks[0]):
+        for j in range(ks[1]):
+            patch = xp[
+                :,
+                :,
+                i * dl[0] : i * dl[0] + out_h * st[0] : st[0],
+                j * dl[1] : j * dl[1] + out_w * st[1] : st[1],
+            ]
+            cols.append(patch)
+    out = jnp.stack(cols, axis=2)  # [n, c, k*k, oh, ow]
+    return out.reshape(n, c * ks[0] * ks[1], out_h * out_w)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(
+    input,  # noqa: A002
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+):
+    """Parity: softmax_with_cross_entropy / cross_entropy2
+    (reference operators/softmax_with_cross_entropy_op.cu)."""
+
+    @primitive
+    def _ce(input, label, weight):
+        logp = jax.nn.log_softmax(input, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(input, 1e-30)
+        )
+        if soft_label:
+            loss = -jnp.sum(label * logp, axis=axis)
+            if weight is not None:
+                loss = loss * jnp.sum(label * weight, axis=axis)
+            return _reduce_loss(loss, reduction)
+        lbl = label
+        if lbl.ndim == logp.ndim:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        lbl = lbl.astype(jnp.int32)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis)
+        loss = -jnp.squeeze(picked, axis=axis)
+        if weight is not None:
+            w = weight[safe]
+            loss = loss * w
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            if weight is not None:
+                denom = jnp.sum(jnp.where(valid, weight[safe], 0.0))
+            else:
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce_loss(loss, reduction)
+
+    return _ce(input, unwrap(label), weight)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, axis=-1, return_softmax=False):
+    loss = cross_entropy(logits, label, reduction="none", soft_label=soft_label, ignore_index=ignore_index, axis=axis)
+    from ..ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis if axis >= 0 else loss.ndim + 1 + axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+@primitive
+def _mse(input, label, reduction):
+    return _reduce_loss(jnp.square(input - label), reduction)
+
+
+def mse_loss(input, label, reduction="mean"):  # noqa: A002
+    return _mse(input, unwrap(label), reduction)
+
+
+@primitive
+def _l1(input, label, reduction):
+    return _reduce_loss(jnp.abs(input - label), reduction)
+
+
+def l1_loss(input, label, reduction="mean"):  # noqa: A002
+    return _l1(input, unwrap(label), reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):  # noqa: A002
+    @primitive
+    def _nll(input, label, weight):
+        lbl = label.astype(jnp.int32)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(input, safe[:, None], axis=1)[:, 0]
+        loss = -picked
+        if weight is not None:
+            loss = loss * weight[safe]
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = (
+                jnp.sum(jnp.where(valid, weight[safe], 0.0))
+                if weight is not None
+                else jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            )
+            return jnp.sum(loss) / denom
+        return _reduce_loss(loss, reduction)
+
+    return _nll(input, unwrap(label), weight)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):  # noqa: A002
+    @primitive
+    def _bce(input, label, weight):
+        eps = 1e-12
+        loss = -(label * jnp.log(jnp.maximum(input, eps)) + (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+        if weight is not None:
+            loss = loss * weight
+        return _reduce_loss(loss, reduction)
+
+    return _bce(input, unwrap(label), weight)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None):
+    @primitive
+    def _bcel(logit, label, weight, pos_weight):
+        neg_abs = -jnp.abs(logit)
+        loss = jnp.maximum(logit, 0.0) - logit * label + jnp.log1p(jnp.exp(neg_abs))
+        if pos_weight is not None:
+            log_w = (pos_weight - 1.0) * label + 1.0
+            loss = loss * log_w
+        if weight is not None:
+            loss = loss * weight
+        return _reduce_loss(loss, reduction)
+
+    return _bcel(logit, unwrap(label), weight, pos_weight)
+
+
+@primitive
+def _sl1(input, label, reduction, delta):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce_loss(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):  # noqa: A002
+    return _sl1(input, unwrap(label), reduction, delta)
+
+
+def kl_div(input, label, reduction="mean"):  # noqa: A002
+    @primitive
+    def _kl(input, label):
+        loss = label * (jnp.log(jnp.maximum(label, 1e-30)) - input)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / input.shape[0]
+        return _reduce_loss(loss, reduction)
+
+    return _kl(input, unwrap(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):  # noqa: A002
+    @primitive
+    def _mr(input, other, label):
+        loss = jnp.maximum(-label * (input - other) + margin, 0.0)
+        return _reduce_loss(loss, reduction)
+
+    return _mr(input, other, unwrap(label))
+
+
+@primitive
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@primitive
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum"):
+    @primitive
+    def _focal(logit, label, normalizer):
+        p = jax.nn.sigmoid(logit)
+        ce = jnp.maximum(logit, 0.0) - logit * label + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        p_t = p * label + (1 - p) * (1 - label)
+        a_t = alpha * label + (1 - alpha) * (1 - label)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if normalizer is not None:
+            loss = loss / normalizer
+        return _reduce_loss(loss, reduction)
+
+    return _focal(logit, unwrap(label), normalizer)
+
+
+def square_error_cost(input, label):  # noqa: A002
+    @primitive
+    def _sec(input, label):
+        return jnp.square(input - label)
+
+    return _sec(input, unwrap(label))
+
+
+# ---------------------------------------------------------------------------
+# sequence utilities
+# ---------------------------------------------------------------------------
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    from ..dtype import to_jax_dtype
+
+    arr = unwrap(lengths)
+    if maxlen is None:
+        maxlen = int(jnp.max(arr))
+    mask = jnp.arange(maxlen)[None, :] < arr[..., None]
+    return wrap(mask.astype(to_jax_dtype(dtype)))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):  # noqa: A002
+    @primitive
+    def _de(input):
+        out = jnp.zeros(input.shape + (input.shape[-1],), input.dtype)
+        idx = jnp.arange(input.shape[-1])
+        out = out.at[..., idx, idx].set(input)
+        return out
+
+    return _de(input)

@@ -1,0 +1,91 @@
+"""Scaled dot-product attention with TPU kernel dispatch.
+
+This is the single attention entry point for the whole framework (MHA layers,
+fused transformer blocks, GPT/BERT models). Parity target: the reference's
+fused attention CUDA ops (/root/reference/paddle/fluid/operators/fused/
+fused_attention_op.cu, fmha_ref.h).
+
+Dispatch policy:
+- TPU + no-weights-needed + supported shapes → Pallas flash-attention kernel
+  (paddle_tpu/ops/pallas/flash_attention.py) — O(T) memory, fused softmax.
+- otherwise → plain XLA einsum path (still fuses well on TPU for short T).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._primitive import primitive, unwrap
+from ..random import split_key
+
+__all__ = ["scaled_dot_product_attention"]
+
+_FLASH_MIN_SEQ = 512  # below this the XLA path is as fast and simpler
+
+
+def _use_flash(q, k, dropout_p, need_weights, attn_mask, is_causal):
+    if need_weights or dropout_p > 0.0:
+        return False
+    if attn_mask is not None and not is_causal:
+        return False  # general additive masks go through the XLA path
+    try:
+        dev = jax.devices()[0].platform
+    except RuntimeError:
+        return False
+    if dev != "tpu":
+        return False
+    T, S, D = q.shape[-2], k.shape[-2], q.shape[-1]
+    return T >= _FLASH_MIN_SEQ and S >= _FLASH_MIN_SEQ and D % 128 == 0 and T % 128 == 0 and S % 128 == 0
+
+
+def scaled_dot_product_attention(
+    q,
+    k,
+    v,
+    attn_mask=None,
+    dropout_p: float = 0.0,
+    is_causal: bool = False,
+    scale: Optional[float] = None,
+    return_weights: bool = False,
+):
+    """q,k,v: [B, H, T, D]; attn_mask: additive float mask broadcastable to
+    [B, H, T, S]. Returns (out, weights_or_None)."""
+    q_arr = unwrap(q)
+    scale = scale if scale is not None else 1.0 / math.sqrt(q_arr.shape[-1])
+
+    if _use_flash(q_arr, unwrap(k), dropout_p, return_weights, attn_mask, is_causal):
+        from ..ops.pallas.flash_attention import flash_attention
+
+        @primitive
+        def _flash(q, k, v):
+            return flash_attention(q, k, v, causal=is_causal, sm_scale=scale)
+
+        return _flash(q, k, v), None
+
+    keep = None
+    if dropout_p > 0.0:
+        b, h, t = q_arr.shape[0], q_arr.shape[1], q_arr.shape[2]
+        s = unwrap(k).shape[2]
+        keep = jax.random.bernoulli(split_key(), 1.0 - dropout_p, (b, h, t, s))
+
+    @primitive(aux=1)
+    def _attn(q, k, v, attn_mask):
+        logits = jnp.einsum("bhtd,bhsd->bhts", q, k) * scale
+        if is_causal:
+            t, s = logits.shape[-2], logits.shape[-1]
+            causal = jnp.tril(jnp.ones((t, s), bool), k=s - t)
+            logits = jnp.where(causal, logits, jnp.asarray(-1e9, logits.dtype))
+        if attn_mask is not None:
+            logits = logits + attn_mask
+        weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+        w = weights
+        if keep is not None:
+            w = jnp.where(keep, w / (1.0 - dropout_p), 0.0)
+        out = jnp.einsum("bhts,bhsd->bhtd", w, v)
+        return out, jax.lax.stop_gradient(weights)
+
+    out, weights = _attn(q, k, v, attn_mask)
+    return out, (weights if return_weights else None)

@@ -1,0 +1,483 @@
+"""Layer — the module system.
+
+Parity: the reference dygraph ``Layer``
+(/root/reference/python/paddle/fluid/dygraph/layers.py — sublayer registry,
+parameter registry, forward pre/post hooks, state_dict/set_state_dict,
+train/eval, apply, buffers) and ``ParamBase``
+(framework.py ParamBase over VarBase).
+
+TPU-native notes: a Layer is also a pytree-convertible parameter container —
+``layer.state_pytree()`` / ``functional_call`` bridge eager Layers into pure
+``jit``/``pjit`` train steps (this replaces the reference's
+program-translation path as the performance story).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Iterator, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..dtype import to_jax_dtype
+from ..tensor import Tensor
+from . import initializer as init_mod
+from .param_attr import ParamAttr
+
+__all__ = ["Layer", "Parameter", "Sequential", "LayerList", "ParameterList"]
+
+
+class Parameter(Tensor):
+    """Trainable tensor (parity: framework.py ParamBase)."""
+
+    def __init__(self, data, trainable: bool = True, name: Optional[str] = None):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+_layer_counter = {}
+
+
+def _unique_name(prefix: str) -> str:
+    idx = _layer_counter.get(prefix, 0)
+    _layer_counter[prefix] = idx + 1
+    return f"{prefix}_{idx}"
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: OrderedDict, idx: int):
+        self._hooks = hooks
+        self._idx = idx
+
+    def remove(self):
+        self._hooks.pop(self._idx, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffer_names", set())
+        self.training = True
+        self._dtype = dtype
+        self._full_name = _unique_name(name_scope or type(self).__name__.lower())
+        self._forward_pre_hooks: OrderedDict = OrderedDict()
+        self._forward_post_hooks: OrderedDict = OrderedDict()
+        self._hook_counter = 0
+
+    # ------------------------------------------------------------------
+    # registration
+    # ------------------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if subs is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            subs[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    del params[name]
+                else:
+                    raise TypeError(f"cannot assign non-Parameter to parameter {name}")
+            elif subs is not None and name in subs and value is None:
+                del subs[name]
+            elif buffers is not None and name in buffers:
+                if value is None:
+                    del buffers[name]
+                else:
+                    buffers[name] = value if isinstance(value, Tensor) else Tensor(value)
+                    return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__} has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]) -> Optional[Parameter]:
+        if parameter is not None:
+            self._parameters[str(name)] = parameter
+        return parameter
+
+    def register_buffer(self, name: str, tensor, persistable: bool = True):
+        t = tensor if isinstance(tensor, Tensor) or tensor is None else Tensor(tensor)
+        self._buffers[str(name)] = t
+        if not persistable:
+            self._non_persistable_buffer_names.add(str(name))
+        return t
+
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias: bool = False,
+        default_initializer=None,
+    ) -> Parameter:
+        """Parity: Layer.create_parameter (layers.py). ParamAttr carries name /
+        initializer / trainable / learning-rate scaling."""
+        attr = ParamAttr._to_attr(attr)
+        dtype = to_jax_dtype(dtype or self._dtype)
+        init = None
+        if attr is not None and attr.initializer is not None:
+            init = attr.initializer
+        elif default_initializer is not None:
+            init = default_initializer
+        else:
+            init = init_mod.Constant(0.0) if is_bias else init_mod.XavierNormal()
+        data = init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(data, trainable=(attr.trainable if attr else True))
+        p.name = attr.name if attr and attr.name else _unique_name(self._full_name + ".w")
+        if attr is not None:
+            p.optimize_attr = {"learning_rate": attr.learning_rate}
+            p.regularizer = attr.regularizer
+            p.need_clip = attr.need_clip
+        else:
+            p.optimize_attr = {"learning_rate": 1.0}
+            p.regularizer = None
+            p.need_clip = True
+        return p
+
+    # ------------------------------------------------------------------
+    # forward
+    # ------------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError(f"{type(self).__name__}.forward not implemented")
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            out = hook(self, inputs, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
+
+    def register_forward_pre_hook(self, hook: Callable) -> HookRemoveHelper:
+        self._hook_counter += 1
+        self._forward_pre_hooks[self._hook_counter] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_counter)
+
+    def register_forward_post_hook(self, hook: Callable) -> HookRemoveHelper:
+        self._hook_counter += 1
+        self._forward_post_hooks[self._hook_counter] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_counter)
+
+    # ------------------------------------------------------------------
+    # traversal
+    # ------------------------------------------------------------------
+    def parameters(self, include_sublayers: bool = True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(
+        self, prefix: str = "", include_sublayers: bool = True
+    ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for layer_name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            if not include_sublayers and layer is not self:
+                continue
+            for pname, p in layer._parameters.items():
+                if id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{layer_name}.{pname}" if layer_name else pname), p
+
+    def sublayers(self, include_self: bool = False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(
+        self, prefix: str = "", include_self: bool = False, layers_set=None
+    ) -> Iterator[Tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_sublayers(prefix=sub_prefix, include_self=True, layers_set=layers_set)
+
+    def children(self):
+        return [l for _, l in self.named_children()]
+
+    def named_children(self):
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True):
+        seen = set()
+        for layer_name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            if not include_sublayers and layer is not self:
+                continue
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{layer_name}.{bname}" if layer_name else bname), b
+
+    def buffers(self, include_sublayers: bool = True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    # ------------------------------------------------------------------
+    # modes / functional
+    # ------------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn: Callable[["Layer"], None]):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):  # noqa: ARG002
+        if dtype is not None:
+            jdt = to_jax_dtype(dtype)
+            for _, p in self.named_parameters():
+                if jnp.issubdtype(p._data.dtype, jnp.floating):
+                    p._set_data(p._data.astype(jdt))
+            for _, b in self.named_buffers():
+                if jnp.issubdtype(b._data.dtype, jnp.floating):
+                    b._set_data(b._data.astype(jdt))
+        if device is not None:
+            import jax as _jax
+
+            from ..device import _place_from
+
+            dev = _place_from(device).jax_device()
+            for _, p in self.named_parameters():
+                p._set_data(_jax.device_put(p._data, dev))
+            for _, b in self.named_buffers():
+                b._set_data(_jax.device_put(b._data, dev))
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def full_name(self):
+        return self._full_name
+
+    # ------------------------------------------------------------------
+    # state dict
+    # ------------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers: bool = True, use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            dest[name] = p
+        for layer_name, layer in self.named_sublayers(include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                key = f"{layer_name}.{bname}" if layer_name else bname
+                dest[key] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        own = self.state_dict()
+        missing = []
+        for name, t in own.items():
+            if name not in state_dict:
+                missing.append(name)
+                continue
+            src = state_dict[name]
+            arr = src._data if isinstance(src, Tensor) else jnp.asarray(np.asarray(src))
+            if tuple(arr.shape) != tuple(t._data.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: {tuple(arr.shape)} vs {tuple(t._data.shape)}"
+                )
+            t._set_data(arr.astype(t._data.dtype))
+        unexpected = [k for k in state_dict if k not in own]
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------------
+    # pytree bridge for jit/pjit training (TPU-native extension)
+    # ------------------------------------------------------------------
+    def state_pytree(self, trainable_only: bool = False):
+        """Return {name: jax.Array} of params (+buffers unless trainable_only)."""
+        out = {}
+        for name, p in self.named_parameters():
+            if trainable_only and p.stop_gradient:
+                continue
+            out[name] = p._data
+        if not trainable_only:
+            for name, b in self.named_buffers():
+                out[f"buffer:{name}"] = b._data
+        return out
+
+    def load_state_pytree(self, tree):
+        params = dict(self.named_parameters())
+        buffers = dict(self.named_buffers())
+        for name, arr in tree.items():
+            if name.startswith("buffer:"):
+                buffers[name[len("buffer:"):]]._set_data(arr)
+            else:
+                params[name]._set_data(arr)
+
+    def functional_call(self, tree, *inputs, **kwargs):
+        """Run forward with parameters taken from ``tree`` (pure w.r.t. the
+        tree): temporarily swaps arrays in, calls forward, restores. Used by
+        jit'd train steps to express the Layer as a pure function."""
+        params = dict(self.named_parameters())
+        buffers = dict(self.named_buffers())
+        saved = {}
+        try:
+            for name, arr in tree.items():
+                if name.startswith("buffer:"):
+                    t = buffers[name[len("buffer:"):]]
+                else:
+                    t = params[name]
+                saved[name] = t._data
+                t._set_data(arr)
+            return self(*inputs, **kwargs)
+        finally:
+            for name, arr in saved.items():
+                if name.startswith("buffer:"):
+                    buffers[name[len("buffer:"):]]._set_data(arr)
+                else:
+                    params[name]._set_data(arr)
+
+    def __repr__(self):
+        lines = [type(self).__name__ + "("]
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            lines.append(f"  ({name}): " + ("\n  ".join(sub_repr)))
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 else type(self).__name__ + "()"
+
+
+class Sequential(Layer):
+    """Parity: paddle.nn.Sequential."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and not isinstance(layers[0], Layer):
+            layers = layers[0]
+        for i, l in enumerate(layers):
+            if isinstance(l, (list, tuple)):
+                name, l = l
+                self.add_sublayer(str(name), l)
+            else:
+                self.add_sublayer(str(i), l)
+
+    def forward(self, x):
+        for l in self._sub_layers.values():
+            x = l(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def append(self, sublayer):
+        self.add_sublayer(str(len(self._sub_layers)), sublayer)
+        return self
+
+    def insert(self, index, sublayer):
+        layers = list(self._sub_layers.values())
+        layers.insert(index, sublayer)
+        self._sub_layers.clear()
+        for i, l in enumerate(layers):
+            self._sub_layers[str(i)] = l
+
+    def extend(self, sublayers):
+        for l in sublayers:
+            self.append(l)
+        return self
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self._sub_layers.values())[idx]
+        return self._sub_layers[str(idx if idx >= 0 else len(self) + idx)]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx)] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx if idx >= 0 else len(self) + idx)]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())

@@ -1,0 +1,238 @@
+"""Loss layers. Parity: python/paddle/nn/layer/loss.py."""
+from __future__ import annotations
+
+from .. import functional as F
+from ..layer import Layer
+
+__all__ = [
+    "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
+    "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "MarginRankingLoss",
+    "CTCLoss", "HSigmoidLoss",
+]
+
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean", soft_label=False,
+                 axis=-1, use_softmax=True, name=None):
+        super().__init__()
+        self.weight = weight
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+        self.soft_label = soft_label
+        self.axis = axis
+        self.use_softmax = use_softmax
+
+    def forward(self, input, label):  # noqa: A002
+        return F.cross_entropy(
+            input, label, weight=self.weight, ignore_index=self.ignore_index,
+            reduction=self.reduction, soft_label=self.soft_label, axis=self.axis,
+            use_softmax=self.use_softmax,
+        )
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.mse_loss(input, label, self.reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.l1_loss(input, label, self.reduction)
+
+
+class NLLLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.nll_loss(input, label, self.weight, self.ignore_index, self.reduction)
+
+
+class BCELoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.binary_cross_entropy(input, label, self.weight, self.reduction)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", pos_weight=None, name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+        self.pos_weight = pos_weight
+
+    def forward(self, logit, label):
+        return F.binary_cross_entropy_with_logits(logit, label, self.weight, self.reduction, self.pos_weight)
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.kl_div(input, label, self.reduction)
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction="mean", delta=1.0, name=None):
+        super().__init__()
+        self.reduction = reduction
+        self.delta = delta
+
+    def forward(self, input, label):  # noqa: A002
+        return F.smooth_l1_loss(input, label, self.reduction, self.delta)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__()
+        self.margin = margin
+        self.reduction = reduction
+
+    def forward(self, input, other, label):  # noqa: A002
+        return F.margin_ranking_loss(input, other, label, self.margin, self.reduction)
+
+
+class CTCLoss(Layer):
+    """CTC loss (parity: warpctc op). Log-domain forward algorithm in jax."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths, norm_by_times=False):
+        import jax
+        import jax.numpy as jnp
+
+        from ...ops._primitive import primitive, unwrap
+
+        blank = self.blank
+        reduction = self.reduction
+
+        @primitive
+        def _ctc(log_probs, labels, input_lengths, label_lengths):
+            # log_probs: [T, B, C] (paddle warpctc layout), labels: [B, L]
+            T, B, C = log_probs.shape
+            L = labels.shape[1]
+            S = 2 * L + 1
+            lbl = labels.astype(jnp.int32)
+            ext = jnp.full((B, S), blank, jnp.int32)
+            ext = ext.at[:, 1::2].set(lbl)
+            neg_inf = jnp.asarray(-1e30, log_probs.dtype)
+
+            lp0 = log_probs[0]  # [B, C]
+            alpha0 = jnp.full((B, S), neg_inf, log_probs.dtype)
+            alpha0 = alpha0.at[:, 0].set(lp0[:, blank])
+            alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(lp0, ext[:, 1:2], axis=1)[:, 0])
+
+            same = ext == jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+
+            def step(alpha, lp):
+                a1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=-1e30)[:, :S]
+                a2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=-1e30)[:, :S]
+                a2 = jnp.where(same, neg_inf, a2)
+                m = jnp.maximum(jnp.maximum(alpha, a1), a2)
+                m_safe = jnp.where(m <= -1e29, 0.0, m)
+                s = (
+                    jnp.exp(alpha - m_safe) + jnp.exp(a1 - m_safe) + jnp.exp(a2 - m_safe)
+                )
+                new = m_safe + jnp.log(jnp.maximum(s, 1e-37))
+                new = jnp.where(m <= -1e29, neg_inf, new)
+                emit = jnp.take_along_axis(lp, ext, axis=1)
+                return new + emit, new + emit
+
+            alphas_last, alphas = jax.lax.scan(step, alpha0, log_probs[1:])
+            all_alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+            t_idx = (input_lengths.astype(jnp.int32) - 1).clip(0)
+            alpha_T = jnp.take_along_axis(
+                all_alphas, t_idx[None, :, None].repeat(S, axis=2), axis=0
+            )[0]  # [B, S]
+            s_last = 2 * label_lengths.astype(jnp.int32)
+            a_end = jnp.take_along_axis(alpha_T, s_last[:, None], axis=1)[:, 0]
+            a_end2 = jnp.take_along_axis(alpha_T, (s_last - 1).clip(0)[:, None], axis=1)[:, 0]
+            m = jnp.maximum(a_end, a_end2)
+            ll = m + jnp.log(jnp.exp(a_end - m) + jnp.exp(a_end2 - m))
+            loss = -ll
+            if reduction == "mean":
+                return jnp.mean(loss / label_lengths.astype(loss.dtype).clip(1))
+            if reduction == "sum":
+                return jnp.sum(loss)
+            return loss
+
+        return _ctc(log_probs, unwrap(labels), unwrap(input_lengths), unwrap(label_lengths))
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid (parity: hierarchical_sigmoid op) — default
+    complete-binary-tree mode."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None, bias_attr=None,
+                 is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError("custom-tree hsigmoid not supported in v1")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter([num_classes - 1, feature_size], attr=weight_attr)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter([num_classes - 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label):  # noqa: A002
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ...ops._primitive import primitive, unwrap
+
+        num_classes = self.num_classes
+        # precompute path codes on host (labels are data-dependent: eager-only)
+        lbl = np.asarray(unwrap(label)).reshape(-1)
+        max_depth = int(np.ceil(np.log2(num_classes)))
+        paths = np.zeros((len(lbl), max_depth), np.int32)
+        codes = np.zeros((len(lbl), max_depth), np.float32)
+        mask = np.zeros((len(lbl), max_depth), np.float32)
+        for i, y in enumerate(lbl):
+            node = int(y) + num_classes - 1  # leaf index in full tree
+            d = 0
+            chain = []
+            while node > 0:
+                parent = (node - 1) // 2
+                is_right = node == 2 * parent + 2
+                chain.append((parent, 1.0 if is_right else 0.0))
+                node = parent
+            for d, (p, c) in enumerate(reversed(chain)):
+                if d < max_depth and p < num_classes - 1:
+                    paths[i, d] = p
+                    codes[i, d] = c
+                    mask[i, d] = 1.0
+
+        paths_j, codes_j, mask_j = jnp.asarray(paths), jnp.asarray(codes), jnp.asarray(mask)
+
+        @primitive
+        def _hs(input, weight, bias):
+            w = weight[paths_j]  # [N, D, feat]
+            logits = jnp.einsum("nf,ndf->nd", input, w)
+            if bias is not None:
+                logits = logits + bias[paths_j]
+            # sigmoid cross entropy with code targets
+            loss = jnp.maximum(logits, 0) - logits * codes_j + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+            return jnp.sum(loss * mask_j, axis=1, keepdims=True)
+
+        return _hs(input, self.weight, self.bias)

@@ -1,0 +1,156 @@
+"""Pooling layers. Parity: python/paddle/nn/layer/pooling.py."""
+from __future__ import annotations
+
+from .. import functional as F
+from ..layer import Layer
+
+__all__ = [
+    "MaxPool1D", "MaxPool2D", "MaxPool3D",
+    "AvgPool1D", "AvgPool2D", "AvgPool3D",
+    "AdaptiveAvgPool1D", "AdaptiveAvgPool2D", "AdaptiveAvgPool3D",
+    "AdaptiveMaxPool1D", "AdaptiveMaxPool2D", "AdaptiveMaxPool3D",
+]
+
+
+class _Pool(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False,
+                 exclusive=True, divisor_override=None, data_format=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+
+    def forward(self, x):
+        return type(self)._fn(x, self.kernel_size, self.stride, self.padding,
+                              ceil_mode=self.ceil_mode, data_format=self.data_format)
+
+
+class MaxPool1D(_Pool):
+    _fn = staticmethod(F.max_pool1d)
+
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode, data_format="NCL")
+
+
+class MaxPool2D(_Pool):
+    _fn = staticmethod(F.max_pool2d)
+
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+                 data_format="NCHW", name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode, data_format=data_format)
+
+
+class MaxPool3D(_Pool):
+    _fn = staticmethod(F.max_pool3d)
+
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+                 data_format="NCDHW", name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode, data_format=data_format)
+
+
+class _AvgPool(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
+                 divisor_override=None, data_format=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.exclusive = exclusive
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+
+    def forward(self, x):
+        return type(self)._fn(x, self.kernel_size, self.stride, self.padding,
+                              ceil_mode=self.ceil_mode, exclusive=self.exclusive,
+                              data_format=self.data_format)
+
+
+class AvgPool1D(_AvgPool):
+    @staticmethod
+    def _fn(x, k, s, p, ceil_mode=False, exclusive=True, data_format="NCL"):
+        return F.avg_pool1d(x, k, s, p, exclusive=exclusive, ceil_mode=ceil_mode, data_format=data_format)
+
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+        super().__init__(kernel_size, stride, padding, exclusive, ceil_mode, data_format="NCL")
+
+
+class AvgPool2D(_AvgPool):
+    _fn = staticmethod(F.avg_pool2d)
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+                 divisor_override=None, data_format="NCHW", name=None):
+        super().__init__(kernel_size, stride, padding, exclusive, ceil_mode, data_format=data_format)
+
+
+class AvgPool3D(_AvgPool):
+    _fn = staticmethod(F.avg_pool3d)
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+                 divisor_override=None, data_format="NCDHW", name=None):
+        super().__init__(kernel_size, stride, padding, exclusive, ceil_mode, data_format=data_format)
+
+
+class _AdaptivePool(Layer):
+    _fn = None
+
+    def __init__(self, output_size, data_format=None, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return type(self)._fn(x, self.output_size, data_format=self.data_format)
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_avg_pool1d)
+
+    def __init__(self, output_size, name=None):
+        super().__init__(output_size, "NCL")
+
+
+class AdaptiveAvgPool2D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_avg_pool2d)
+
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__(output_size, data_format)
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_avg_pool3d)
+
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__(output_size, data_format)
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    @staticmethod
+    def _fn(x, output_size, data_format="NCL"):
+        return F.adaptive_max_pool1d(x, output_size, data_format=data_format)
+
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size, "NCL")
+
+
+class AdaptiveMaxPool2D(_AdaptivePool):
+    @staticmethod
+    def _fn(x, output_size, data_format="NCHW"):
+        return F.adaptive_max_pool2d(x, output_size, data_format=data_format)
+
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size, "NCHW")
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    @staticmethod
+    def _fn(x, output_size, data_format="NCDHW"):
+        return F.adaptive_max_pool3d(x, output_size, data_format=data_format)
+
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size, "NCDHW")

@@ -1,0 +1,324 @@
+"""Recurrent layers.
+
+Parity: python/paddle/nn/layer/rnn.py (SimpleRNNCell/LSTMCell/GRUCell, RNN,
+BiRNN, SimpleRNN/LSTM/GRU multi-layer stacks) over the reference's
+cudnn-backed rnn_op (/root/reference/paddle/fluid/operators/rnn_op.cu.cc).
+
+TPU-native: the time loop is ``jax.lax.scan`` (compiles to one fused while
+loop on TPU); gate matmuls batch onto the MXU. Weight layout matches paddle:
+weight_ih [gates*hidden, input], weight_hh [gates*hidden, hidden].
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops._primitive import primitive, unwrap, wrap
+from .. import initializer as init_mod
+from ..layer import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+def _cell_step(mode, x, h, c, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+    if mode == "GRU":
+        # paddle GRU: r,z,c gate layout with separate hh bias on candidate
+        xr, xz, xc = jnp.split(x @ w_ih.T + (b_ih if b_ih is not None else 0.0), 3, axis=-1)
+        hr, hz, hc = jnp.split(h @ w_hh.T + (b_hh if b_hh is not None else 0.0), 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        cand = jnp.tanh(xc + r * hc)
+        h_new = (1.0 - z) * cand + z * h
+        return h_new, None
+    gates = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih
+    if b_hh is not None:
+        gates = gates + b_hh
+    if mode == "LSTM":
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    h_new = act(gates)
+    return h_new, None
+
+
+class _RNNCellBase(Layer):
+    def state_shape(self):
+        raise NotImplementedError
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32", init_value=0.0, batch_dim_idx=0):
+        from ...ops import creation
+
+        batch = unwrap(batch_ref).shape[batch_dim_idx]
+        shapes = shape or self.state_shape()
+        if isinstance(shapes, tuple):
+            return tuple(creation.full([batch] + list(s), init_value, dtype) for s in shapes)
+        return creation.full([batch] + list(shapes), init_value, dtype)
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        std = 1.0 / np.sqrt(hidden_size)
+        u = init_mod.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], weight_hh_attr, default_initializer=u)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter([hidden_size], bias_ih_attr, default_initializer=u)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter([hidden_size], bias_hh_attr, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = self.activation
+
+        @primitive
+        def _step(x, h, w_ih, w_hh, b_ih, b_hh):
+            h_new, _ = _cell_step("RNN", x, h, None, w_ih, w_hh, b_ih, b_hh, act)
+            return h_new
+
+        h = _step(inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    def state_shape(self):
+        return [self.hidden_size]
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        u = init_mod.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], weight_hh_attr, default_initializer=u)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter([4 * hidden_size], bias_ih_attr, default_initializer=u)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter([4 * hidden_size], bias_hh_attr, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        @primitive
+        def _step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+            return _cell_step("LSTM", x, h, c, w_ih, w_hh, b_ih, b_hh)
+
+        h_new, c_new = _step(inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        return h_new, (h_new, c_new)
+
+    def state_shape(self):
+        return ([self.hidden_size], [self.hidden_size])
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        u = init_mod.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], weight_hh_attr, default_initializer=u)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter([3 * hidden_size], bias_ih_attr, default_initializer=u)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter([3 * hidden_size], bias_hh_attr, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        @primitive
+        def _step(x, h, w_ih, w_hh, b_ih, b_hh):
+            h_new, _ = _cell_step("GRU", x, h, None, w_ih, w_hh, b_ih, b_hh)
+            return h_new
+
+        h = _step(inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    def state_shape(self):
+        return [self.hidden_size]
+
+
+class RNN(Layer):
+    """Wrap a cell into a scan over time (parity: nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as manip
+
+        x = inputs if self.time_major else manip.transpose(inputs, [1, 0, 2])
+        steps = x.shape[0]
+        if self.is_reverse:
+            x = manip.flip(x, [0])
+        states = initial_states if initial_states is not None else self.cell.get_initial_states(
+            inputs, batch_dim_idx=1 if self.time_major else 0
+        )
+        outs = []
+        for t in range(steps):
+            out, states = self.cell(x[t], states)
+            outs.append(out)
+        y = manip.stack(outs, axis=0)
+        if self.is_reverse:
+            y = manip.flip(y, [0])
+        if not self.time_major:
+            y = manip.transpose(y, [1, 0, 2])
+        return y, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as manip
+
+        s_fw, s_bw = (None, None) if initial_states is None else initial_states
+        y_fw, fs = self.rnn_fw(inputs, s_fw, sequence_length)
+        y_bw, bs = self.rnn_bw(inputs, s_bw, sequence_length)
+        return manip.concat([y_fw, y_bw], axis=-1), (fs, bs)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional stack executed as a single lax.scan per
+    layer/direction inside one primitive — the TPU-fast path."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirectional else 1
+        gates = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[mode]
+        std = 1.0 / np.sqrt(hidden_size)
+        u = init_mod.Uniform(-std, std)
+        self._weights = []
+        for layer in range(num_layers):
+            for direction in range(self.num_directions):
+                in_size = input_size if layer == 0 else hidden_size * self.num_directions
+                sfx = f"l{layer}" + ("_reverse" if direction else "")
+                w_ih = self.create_parameter([gates * hidden_size, in_size], weight_ih_attr, default_initializer=u)
+                w_hh = self.create_parameter([gates * hidden_size, hidden_size], weight_hh_attr, default_initializer=u)
+                b_ih = None if bias_ih_attr is False else self.create_parameter(
+                    [gates * hidden_size], bias_ih_attr, default_initializer=u
+                )
+                b_hh = None if bias_hh_attr is False else self.create_parameter(
+                    [gates * hidden_size], bias_hh_attr, default_initializer=u
+                )
+                self.add_parameter(f"weight_ih_{sfx}", w_ih)
+                self.add_parameter(f"weight_hh_{sfx}", w_hh)
+                if b_ih is not None:
+                    self.add_parameter(f"bias_ih_{sfx}", b_ih)
+                if b_hh is not None:
+                    self.add_parameter(f"bias_hh_{sfx}", b_hh)
+                self._weights.append((w_ih, w_hh, b_ih, b_hh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = "RNN" if self.mode.startswith("RNN") else self.mode
+        activation = "relu" if self.mode == "RNN_RELU" else "tanh"
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        time_major = self.time_major
+        is_lstm = mode == "LSTM"
+        dropout = self.dropout if self.training else 0.0
+
+        batch = unwrap(inputs).shape[1 if time_major else 0]
+        if initial_states is None:
+            from ...ops import creation
+
+            z = creation.zeros([nl * nd, batch, hs], "float32")
+            initial_states = (z, creation.zeros([nl * nd, batch, hs], "float32")) if is_lstm else z
+        h0 = initial_states[0] if is_lstm else initial_states
+        c0 = initial_states[1] if is_lstm else None
+
+        drop_keys = [jax.random.key(0)] * 0
+        if dropout > 0.0:
+            from ...random import split_key
+
+            drop_keys = [split_key() for _ in range(nl - 1)]
+
+        flat_w = [w for tup in self._weights for w in tup]
+
+        @primitive(aux=0)
+        def _run(x, h0, c0, *weights):
+            xs = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, F]
+            layer_in = xs
+            h_finals, c_finals = [], []
+            for layer in range(nl):
+                outs_dir = []
+                for d in range(nd):
+                    wi = (layer * nd + d) * 4
+                    w_ih, w_hh, b_ih, b_hh = weights[wi : wi + 4]
+                    idx = layer * nd + d
+                    h_init = h0[idx]
+                    c_init = c0[idx] if is_lstm else jnp.zeros_like(h0[idx])
+                    seq = jnp.flip(layer_in, 0) if d == 1 else layer_in
+
+                    def step(carry, x_t, w_ih=w_ih, w_hh=w_hh, b_ih=b_ih, b_hh=b_hh):
+                        h, c = carry
+                        h_new, c_new = _cell_step(mode, x_t, h, c, w_ih, w_hh, b_ih, b_hh, activation)
+                        c_new = c_new if c_new is not None else c
+                        return (h_new, c_new), h_new
+
+                    (h_f, c_f), ys = jax.lax.scan(step, (h_init, c_init), seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    outs_dir.append(ys)
+                    h_finals.append(h_f)
+                    c_finals.append(c_f)
+                layer_in = jnp.concatenate(outs_dir, axis=-1) if nd == 2 else outs_dir[0]
+                if dropout > 0.0 and layer < nl - 1:
+                    keep = jax.random.bernoulli(drop_keys[layer], 1.0 - dropout, layer_in.shape)
+                    layer_in = jnp.where(keep, layer_in / (1.0 - dropout), 0.0)
+            y = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+            h_out = jnp.stack(h_finals, 0)
+            if is_lstm:
+                return y, h_out, jnp.stack(c_finals, 0)
+            return y, h_out
+
+        if is_lstm:
+            y, h_n, c_n = _run(inputs, h0, c0, *flat_w)
+            return y, (h_n, c_n)
+        y, h_n = _run(inputs, h0, c0, *flat_w)
+        return y, h_n
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        mode = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)

@@ -1,0 +1,44 @@
+"""ParamAttr — parameter configuration.
+
+Parity: python/paddle/fluid/param_attr.py (name, initializer, learning_rate,
+regularizer, trainable, need_clip; WeightNormParamAttr omitted v1).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["ParamAttr"]
+
+
+class ParamAttr:
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        initializer=None,
+        learning_rate: float = 1.0,
+        regularizer=None,
+        trainable: bool = True,
+        need_clip: bool = True,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        from . import initializer as init_mod
+
+        if attr is None:
+            return None
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, init_mod.Initializer):
+            return ParamAttr(initializer=attr)
+        if attr is False:
+            return ParamAttr(trainable=False)
+        raise TypeError(f"cannot convert {attr!r} to ParamAttr")

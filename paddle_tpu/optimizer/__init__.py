@@ -1,0 +1,18 @@
+"""paddle_tpu.optimizer — optimizers + LR schedulers.
+
+Parity: python/paddle/optimizer/__init__.py.
+"""
+from . import lr  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Lars,
+    Momentum,
+    RMSProp,
+)

@@ -1,0 +1,216 @@
+"""Optimizer base.
+
+Parity: python/paddle/optimizer/optimizer.py + the reference's per-op GPU
+optimizer kernels (/root/reference/paddle/fluid/operators/optimizers/).
+
+TPU-native two-level design:
+- **eager**: ``opt.step()`` reads ``param.grad`` slots and applies a jitted
+  pure update per parameter (XLA caches by shape — the dygraph path).
+- **functional**: ``init_state(params)`` / ``apply_gradients(params, grads,
+  state, lr)`` operate on pytrees of arrays, for use inside jit/pjit train
+  steps; sharding the state pytree on the 'fsdp' axis IS ZeRO-1 (SURVEY §2.7).
+Both levels share the same ``_update`` math, so eager and jitted training are
+bit-identical.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    # subclasses define: _slot_names: tuple[str,...]; _update(...) staticmethod
+    _slot_names: tuple = ()
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        parameters=None,
+        weight_decay=None,
+        grad_clip=None,
+        name=None,
+        multi_precision=False,
+    ):
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._name = name
+        self._multi_precision = multi_precision
+        if weight_decay is None:
+            self._weight_decay_coeff = 0.0
+        elif isinstance(weight_decay, (int, float)):
+            self._weight_decay_coeff = float(weight_decay)
+        else:  # L2Decay-like object with _coeff / _regularization_coeff
+            self._weight_decay_coeff = float(
+                getattr(weight_decay, "_regularization_coeff", getattr(weight_decay, "_coeff", 0.0))
+            )
+        self._accumulators: Dict[int, Dict[str, jax.Array]] = {}
+        self._global_step = 0
+        self._jit_update = jax.jit(type(self)._update, static_argnames=("hyper",))
+
+    # ------------------------------------------------------------------
+    # lr
+    # ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("optimizer's learning rate is an LRScheduler; call scheduler.step()")
+        self._learning_rate = float(value)
+
+    # ------------------------------------------------------------------
+    # hyper / slots — subclass API
+    # ------------------------------------------------------------------
+    def _hyper(self) -> tuple:
+        """Static hyper-parameters baked into the jitted update."""
+        return ()
+
+    def _hyper_for(self, param) -> tuple:
+        """Per-parameter hyper override (e.g. AdamW's apply_decay_param_fun).
+        Distinct tuples retrace the shared jitted update once each and stay
+        cached."""
+        return self._hyper()
+
+    def _init_slots(self, param_arr) -> Dict[str, jax.Array]:
+        return {name: jnp.zeros_like(param_arr) for name in self._slot_names}
+
+    @staticmethod
+    def _update(p, g, slots, lr, step, hyper):
+        """Pure: (param, grad, slots dict, lr, step, hyper tuple) ->
+        (new_param, new_slots). Implemented by subclasses."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # eager path
+    # ------------------------------------------------------------------
+    def _decay_grad(self, p, g):
+        """L2 regularization into the gradient (reference: regularizer applied
+        in append_regularization_ops). AdamW overrides for decoupled decay."""
+        if self._weight_decay_coeff and getattr(p, "regularizer", None) is None:
+            return g + self._weight_decay_coeff * p._data
+        return g
+
+    @property
+    def _param_groups(self) -> List:
+        if self._parameter_list is None:
+            raise ValueError("optimizer constructed without a parameters list")
+        return self._parameter_list
+
+    def step(self):
+        params_grads = [(p, p.grad) for p in self._param_groups if p.grad is not None and not p.stop_gradient]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        self._global_step += 1
+        for p, g in params_grads:
+            if g is None:
+                continue
+            hyper = self._hyper_for(p)
+            garr = g._data if isinstance(g, Tensor) else g
+            garr = self._decay_grad(p, garr.astype(p._data.dtype))
+            slots = self._accumulators.get(id(p))
+            if slots is None:
+                slots = self._init_slots(p._data)
+                self._accumulators[id(p)] = slots
+            p_lr = lr * getattr(p, "optimize_attr", {"learning_rate": 1.0}).get("learning_rate", 1.0)
+            new_p, new_slots = self._jit_update(
+                p._data, garr, slots, jnp.asarray(p_lr, jnp.float32),
+                jnp.asarray(self._global_step, jnp.int32), hyper,
+            )
+            p._set_data(new_p)
+            self._accumulators[id(p)] = new_slots
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._param_groups:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._param_groups]
+
+    # ------------------------------------------------------------------
+    # functional path (jit/pjit training)
+    # ------------------------------------------------------------------
+    def init_state(self, params_tree):
+        """params_tree: pytree of arrays -> state pytree {slots, step}."""
+        slots = jax.tree_util.tree_map(lambda p: self._init_slots(p), params_tree)
+        return {"slots": slots, "step": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients(self, params_tree, grads_tree, state, lr=None):
+        """Pure pytree update; returns (new_params, new_state)."""
+        from ..nn.clip import clip_grads_functional
+
+        lr = self.get_lr() if lr is None else lr
+        hyper = self._hyper()
+        step = state["step"] + 1
+        grads_tree = clip_grads_functional(self._grad_clip, grads_tree)
+        wd = self._weight_decay_coeff
+
+        def upd(p, g, slots):
+            g = g.astype(p.dtype)
+            if wd and type(self).__name__ not in ("AdamW",):
+                g = g + wd * p
+            return type(self)._update(p, g, slots, jnp.asarray(lr, jnp.float32), step, hyper)
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params_tree)
+        flat_g = treedef.flatten_up_to(grads_tree)
+        flat_s = treedef.flatten_up_to(state["slots"])
+        new_p, new_s = [], []
+        for p, g, s in zip(flat_p, flat_g, flat_s):
+            np_, ns_ = upd(p, g, s)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (
+            jax.tree_util.tree_unflatten(treedef, new_p),
+            {"slots": jax.tree_util.tree_unflatten(treedef, new_s), "step": step},
+        )
+
+    # ------------------------------------------------------------------
+    # state
+    # ------------------------------------------------------------------
+    def _param_key(self, p, i: int) -> str:
+        return p.name if p.name else f"param_{i}"
+
+    def state_dict(self):
+        sd = {}
+        for i, p in enumerate(self._param_groups):
+            slots = self._accumulators.get(id(p))
+            if slots:
+                for k, v in slots.items():
+                    sd[f"{self._param_key(p, i)}.{k}"] = Tensor(v)
+        sd["global_step"] = self._global_step
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.get("global_step", 0))
+        if isinstance(self._learning_rate, LRScheduler) and "LR_Scheduler" in state_dict:
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for i, p in enumerate(self._param_groups):
+            slots = {}
+            for name in self._slot_names:
+                key = f"{self._param_key(p, i)}.{name}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    slots[name] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            if slots:
+                existing = self._accumulators.get(id(p), self._init_slots(p._data))
+                existing.update(slots)
+                self._accumulators[id(p)] = existing
+
+    set_dict = set_state_dict

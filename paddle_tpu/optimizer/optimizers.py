@@ -1,0 +1,291 @@
+"""Concrete optimizers.
+
+Parity: python/paddle/optimizer/{sgd,momentum,adam,adamw,adamax,adagrad,
+adadelta,rmsprop,lamb}.py and the reference CUDA kernels in
+/root/reference/paddle/fluid/operators/optimizers/ (sgd_op, momentum_op,
+adam_op.cu, lamb_op, lars_momentum_op, adadelta_op, adagrad_op, rmsprop_op).
+Update math follows the reference ops exactly (e.g. paddle momentum's
+velocity = mu*v + g; p -= lr * (g + mu*v_new) when use_nesterov).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = [
+    "SGD",
+    "Momentum",
+    "Adam",
+    "AdamW",
+    "Adamax",
+    "Adagrad",
+    "Adadelta",
+    "RMSProp",
+    "Lamb",
+    "Lars",
+]
+
+
+class SGD(Optimizer):
+    _slot_names = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _hyper(self):
+        return ()
+
+    @staticmethod
+    def _update(p, g, slots, lr, step, hyper):
+        return (p - lr.astype(p.dtype) * g), slots
+
+
+class Momentum(Optimizer):
+    _slot_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = float(momentum)
+        self._use_nesterov = bool(use_nesterov)
+
+    def _hyper(self):
+        return (self._momentum, self._use_nesterov)
+
+    @staticmethod
+    def _update(p, g, slots, lr, step, hyper):
+        mu, nesterov = hyper
+        v = mu * slots["velocity"] + g
+        if nesterov:
+            p_new = p - lr.astype(p.dtype) * (g + mu * v)
+        else:
+            p_new = p - lr.astype(p.dtype) * v
+        return p_new, {"velocity": v}
+
+
+class Adam(Optimizer):
+    _slot_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = float(beta1), float(beta2), float(epsilon)
+
+    def _hyper(self):
+        return (self._beta1, self._beta2, self._epsilon, 0.0)
+
+    @staticmethod
+    def _update(p, g, slots, lr, step, hyper):
+        b1, b2, eps, wd = hyper
+        g32 = g.astype(jnp.float32)
+        m = b1 * slots["moment1"] + (1 - b1) * g32
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - b1**t)
+        vhat = v / (1 - b2**t)
+        upd = mhat / (jnp.sqrt(vhat) + eps)
+        if wd:
+            upd = upd + wd * p.astype(jnp.float32)
+        p_new = (p.astype(jnp.float32) - lr * upd).astype(p.dtype)
+        return p_new, {"moment1": m, "moment2": v}
+
+    def _init_slots(self, param_arr):
+        return {n: jnp.zeros(param_arr.shape, jnp.float32) for n in self._slot_names}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: adamw applies decay on param
+    directly, python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip,
+                         lazy_mode, multi_precision, name)
+        self._wd = float(weight_decay) if isinstance(weight_decay, (int, float)) else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _hyper(self):
+        return (self._beta1, self._beta2, self._epsilon, self._wd)
+
+    def _decay_grad(self, p, g):
+        return g  # decay handled inside _update (decoupled)
+
+    def _hyper_for(self, p):
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            return (self._beta1, self._beta2, self._epsilon, 0.0)
+        return self._hyper()
+
+
+class Adamax(Optimizer):
+    _slot_names = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = float(beta1), float(beta2), float(epsilon)
+
+    def _hyper(self):
+        return (self._beta1, self._beta2, self._epsilon)
+
+    @staticmethod
+    def _update(p, g, slots, lr, step, hyper):
+        b1, b2, eps = hyper
+        m = b1 * slots["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * slots["inf_norm"], jnp.abs(g) + eps)
+        t = step.astype(jnp.float32)
+        lr_t = (lr / (1 - b1**t)).astype(p.dtype)
+        p_new = p - lr_t * m / u
+        return p_new, {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    _slot_names = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = float(epsilon)
+        self._init_val = float(initial_accumulator_value)
+
+    def _hyper(self):
+        return (self._epsilon,)
+
+    def _init_slots(self, param_arr):
+        return {"moment": jnp.full_like(param_arr, self._init_val)}
+
+    @staticmethod
+    def _update(p, g, slots, lr, step, hyper):
+        (eps,) = hyper
+        m = slots["moment"] + jnp.square(g)
+        p_new = p - lr.astype(p.dtype) * g / (jnp.sqrt(m) + eps)
+        return p_new, {"moment": m}
+
+
+class Adadelta(Optimizer):
+    _slot_names = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = float(epsilon), float(rho)
+
+    def _hyper(self):
+        return (self._epsilon, self._rho)
+
+    @staticmethod
+    def _update(p, g, slots, lr, step, hyper):
+        eps, rho = hyper
+        sg = rho * slots["avg_squared_grad"] + (1 - rho) * jnp.square(g)
+        upd = g * jnp.sqrt(slots["avg_squared_update"] + eps) / jnp.sqrt(sg + eps)
+        su = rho * slots["avg_squared_update"] + (1 - rho) * jnp.square(upd)
+        return p - lr.astype(p.dtype) * upd, {"avg_squared_grad": sg, "avg_squared_update": su}
+
+
+class RMSProp(Optimizer):
+    _slot_names = ("mean_square", "mean_grad", "momentum")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = float(rho), float(epsilon)
+        self._momentum, self._centered = float(momentum), bool(centered)
+
+    def _hyper(self):
+        return (self._rho, self._epsilon, self._momentum, self._centered)
+
+    @staticmethod
+    def _update(p, g, slots, lr, step, hyper):
+        rho, eps, mom, centered = hyper
+        ms = rho * slots["mean_square"] + (1 - rho) * jnp.square(g)
+        if centered:
+            mg = rho * slots["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+        else:
+            mg = slots["mean_grad"]
+            denom = jnp.sqrt(ms + eps)
+        v = mom * slots["momentum"] + lr.astype(p.dtype) * g / denom
+        return p - v, {"mean_square": ms, "mean_grad": mg, "momentum": v}
+
+
+class Lamb(Optimizer):
+    """LAMB (reference: lamb_op.cu + python/paddle/optimizer/lamb.py)."""
+
+    _slot_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = float(beta1), float(beta2), float(epsilon)
+        self._lamb_wd = float(lamb_weight_decay)
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _hyper(self):
+        return (self._beta1, self._beta2, self._epsilon, self._lamb_wd)
+
+    def _hyper_for(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return (self._beta1, self._beta2, self._epsilon, 0.0)
+        return self._hyper()
+
+    @staticmethod
+    def _update(p, g, slots, lr, step, hyper):
+        b1, b2, eps, wd = hyper
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = b1 * slots["moment1"] + (1 - b1) * g32
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - b1**t)
+        vhat = v / (1 - b2**t)
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        p_new = (p32 - lr * trust * r).astype(p.dtype)
+        return p_new, {"moment1": m, "moment2": v}
+
+    def _init_slots(self, param_arr):
+        return {n: jnp.zeros(param_arr.shape, jnp.float32) for n in self._slot_names}
+
+
+class Lars(Optimizer):
+    """LARS momentum (reference: lars_momentum_op.cu; fleet lars meta-opt)."""
+
+    _slot_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = float(momentum)
+        self._lars_coeff = float(lars_coeff)
+        self._lars_wd = float(lars_weight_decay)
+        self._eps = float(epsilon)
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _hyper(self):
+        return (self._momentum, self._lars_coeff, self._lars_wd, self._eps)
+
+    def _hyper_for(self, p):
+        name = p.name or ""
+        if any(token in name for token in self._exclude):
+            return (self._momentum, self._lars_coeff, 0.0, self._eps)
+        return self._hyper()
+
+    @staticmethod
+    def _update(p, g, slots, lr, step, hyper):
+        mu, coeff, wd, eps = hyper
+        p32, g32 = p.astype(jnp.float32), g.astype(jnp.float32)
+        p_norm = jnp.linalg.norm(p32)
+        g_norm = jnp.linalg.norm(g32)
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            coeff * p_norm / (g_norm + wd * p_norm + eps),
+            1.0,
+        )
+        v = mu * slots["velocity"] + lr * local_lr * (g32 + wd * p32)
+        return (p32 - v).astype(p.dtype), {"velocity": v}

@@ -1,0 +1,10 @@
+"""paddle_tpu.vision — models, transforms, datasets."""
+from . import models  # noqa: F401
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in ("transforms", "datasets", "ops"):
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(name)

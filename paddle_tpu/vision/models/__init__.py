@@ -1,0 +1,32 @@
+"""Vision model zoo. Parity: python/paddle/vision/models/ in the reference
+(lenet, alexnet, vgg, resnet, mobilenet v1/v2, inception — added over rounds)."""
+from .lenet import LeNet  # noqa: F401
+
+
+def __getattr__(name):
+    import importlib
+
+    _mods = {
+        "resnet18": "resnet", "resnet34": "resnet", "resnet50": "resnet",
+        "resnet101": "resnet", "resnet152": "resnet", "ResNet": "resnet",
+        "wide_resnet50_2": "resnet", "wide_resnet101_2": "resnet",
+        "VGG": "vgg", "vgg11": "vgg", "vgg13": "vgg", "vgg16": "vgg", "vgg19": "vgg",
+        "AlexNet": "alexnet", "alexnet": "alexnet",
+        "MobileNetV1": "mobilenetv1", "mobilenet_v1": "mobilenetv1",
+        "MobileNetV2": "mobilenetv2", "mobilenet_v2": "mobilenetv2",
+        "GoogLeNet": "googlenet", "googlenet": "googlenet",
+        "InceptionV3": "inceptionv3", "inception_v3": "inceptionv3",
+        "SqueezeNet": "squeezenet", "squeezenet1_0": "squeezenet", "squeezenet1_1": "squeezenet",
+        "DenseNet": "densenet", "densenet121": "densenet", "densenet161": "densenet",
+        "densenet169": "densenet", "densenet201": "densenet", "densenet264": "densenet",
+        "ResNeXt": "resnext", "resnext50_32x4d": "resnext", "resnext50_64x4d": "resnext",
+        "resnext101_32x4d": "resnext", "resnext101_64x4d": "resnext", "resnext152_32x4d": "resnext",
+        "ShuffleNetV2": "shufflenetv2", "shufflenet_v2_x0_25": "shufflenetv2",
+        "shufflenet_v2_x0_33": "shufflenetv2", "shufflenet_v2_x0_5": "shufflenetv2",
+        "shufflenet_v2_x1_0": "shufflenetv2", "shufflenet_v2_x1_5": "shufflenetv2",
+        "shufflenet_v2_x2_0": "shufflenetv2",
+    }
+    if name in _mods:
+        mod = importlib.import_module(f".{_mods[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(name)

@@ -1,0 +1,73 @@
+"""End-to-end training smoke: LeNet learns a synthetic MNIST-like task.
+
+Parity: the reference's book/ tests (unittests/book/test_recognize_digits.py)
+— tiny end-to-end convergence runs (SURVEY.md §4.6). BASELINE config #1.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.vision.models import LeNet
+
+
+def _synthetic_digits(n=256, seed=0):
+    """Well-separated class blobs rendered into 28x28 images."""
+    rng = np.random.RandomState(seed)
+    protos = rng.randn(10, 28, 28).astype(np.float32)
+    ys = rng.randint(0, 10, n)
+    xs = protos[ys] + 0.3 * rng.randn(n, 28, 28).astype(np.float32)
+    return xs[:, None, :, :], ys.astype(np.int64)
+
+
+def test_lenet_converges():
+    paddle.seed(42)
+    xs, ys = _synthetic_digits(256)
+    model = LeNet()
+    optimizer = opt.Adam(1e-3, parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    bs = 64
+    losses = []
+    for epoch in range(6):
+        for i in range(0, len(xs), bs):
+            xb = paddle.to_tensor(xs[i : i + bs])
+            yb = paddle.to_tensor(ys[i : i + bs])
+            logits = model(xb)
+            loss = loss_fn(logits, yb)
+            optimizer.clear_grad()
+            loss.backward()
+            optimizer.step()
+        losses.append(float(loss))
+    assert losses[-1] < 0.3, f"did not converge: {losses}"
+
+    model.eval()
+    logits = model(paddle.to_tensor(xs))
+    acc = (logits.numpy().argmax(1) == ys).mean()
+    assert acc > 0.9, f"train accuracy too low: {acc}"
+
+
+def test_lenet_eager_vs_functional_grads():
+    """The tape grads must match jax.grad over the functional form."""
+    import jax
+
+    paddle.seed(1)
+    model = LeNet()
+    xs, ys = _synthetic_digits(8, seed=3)
+    x, y = paddle.to_tensor(xs), paddle.to_tensor(ys)
+
+    loss = nn.CrossEntropyLoss()(model(x), y)
+    loss.backward()
+    eager_grads = {n: p.grad.numpy() for n, p in model.named_parameters()}
+
+    params = model.state_pytree(trainable_only=True)
+
+    def pure_loss(tree):
+        with paddle.no_grad():
+            pass
+        out = model.functional_call(tree, x)
+        return nn.CrossEntropyLoss()(out, y).value
+
+    jg = jax.grad(pure_loss)(params)
+    for n in eager_grads:
+        np.testing.assert_allclose(eager_grads[n], np.asarray(jg[n]), atol=1e-4, err_msg=n)

@@ -1,0 +1,311 @@
+"""Layer system + nn layer correctness tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+class TestLayerSystem:
+    def test_registration(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+        assert len(net.parameters()) == 4
+        assert len(net.sublayers()) == 2
+        out = net(paddle.to_tensor(_rand(3, 4)))
+        assert out.shape == [3, 2]
+
+    def test_state_dict_roundtrip(self):
+        net1, net2 = nn.Linear(4, 3), nn.Linear(4, 3)
+        sd = net1.state_dict()
+        net2.set_state_dict(sd)
+        x = paddle.to_tensor(_rand(2, 4))
+        np.testing.assert_allclose(net1(x).numpy(), net2(x).numpy())
+
+    def test_train_eval(self):
+        net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        x = paddle.to_tensor(_rand(10, 4))
+        np.testing.assert_allclose(net(x).numpy(), net(x).numpy())
+        net.train()
+        assert net[1].training
+
+    def test_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        h = net.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        net(paddle.to_tensor(_rand(1, 2)))
+        assert calls == [1]
+        h.remove()
+        net(paddle.to_tensor(_rand(1, 2)))
+        assert calls == [1]
+
+    def test_buffers(self):
+        class B(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.register_buffer("running", paddle.zeros([3]))
+
+            def forward(self, x):
+                return x
+
+        b = B()
+        assert "running" in b.state_dict()
+
+    def test_layerlist_paramlist(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3 and len(list(ll.parameters())) == 6
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+
+
+class TestLayers:
+    def test_linear_grad(self):
+        fc = nn.Linear(3, 2)
+        x = paddle.to_tensor(_rand(4, 3), stop_gradient=False)
+        y = fc(x).sum()
+        y.backward()
+        assert fc.weight.grad is not None and fc.weight.grad.shape == [3, 2]
+        assert fc.bias.grad is not None
+        np.testing.assert_allclose(fc.bias.grad.numpy(), [4.0, 4.0])
+
+    def test_conv2d(self):
+        conv = nn.Conv2D(3, 8, 3, stride=1, padding=1)
+        x = paddle.to_tensor(_rand(2, 3, 8, 8))
+        out = conv(x)
+        assert out.shape == [2, 8, 8, 8]
+
+    def test_conv2d_matches_torch_semantics(self):
+        import torch
+        import torch.nn.functional as TF
+
+        x = _rand(2, 3, 6, 6)
+        w = _rand(4, 3, 3, 3)
+        b = _rand(4)
+        got = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b), stride=2, padding=1)
+        want = TF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b), stride=2, padding=1)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), atol=2e-5)
+
+    def test_conv2d_grouped(self):
+        import torch
+        import torch.nn.functional as TF
+
+        x, w = _rand(1, 4, 5, 5), _rand(6, 2, 3, 3)
+        got = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), groups=2)
+        want = TF.conv2d(torch.tensor(x), torch.tensor(w), groups=2)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), atol=2e-5)
+
+    def test_conv2d_transpose(self):
+        import torch
+        import torch.nn.functional as TF
+
+        x, w = _rand(1, 3, 5, 5), _rand(3, 4, 3, 3)
+        got = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w), stride=2, padding=1, output_padding=1)
+        want = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w), stride=2, padding=1, output_padding=1)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), atol=2e-5)
+
+    def test_maxpool_avgpool(self):
+        import torch
+        import torch.nn.functional as TF
+
+        x = _rand(2, 3, 8, 8)
+        got = F.max_pool2d(paddle.to_tensor(x), 2, 2)
+        want = TF.max_pool2d(torch.tensor(x), 2, 2)
+        np.testing.assert_allclose(got.numpy(), want.numpy())
+        got = F.avg_pool2d(paddle.to_tensor(x), 3, 2, 1)
+        want = TF.avg_pool2d(torch.tensor(x), 3, 2, 1, count_include_pad=False)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), atol=1e-6)
+
+    def test_adaptive_pool(self):
+        x = _rand(2, 3, 8, 8)
+        out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 1)
+        np.testing.assert_allclose(out.numpy()[:, :, 0, 0], x.mean(axis=(2, 3)), atol=1e-6)
+        out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 3)
+        assert out.shape == [2, 3, 3, 3]
+
+    def test_batchnorm(self):
+        bn = nn.BatchNorm2D(4)
+        x = paddle.to_tensor(_rand(8, 4, 5, 5) * 3 + 1)
+        bn.train()
+        out = bn(x)
+        np.testing.assert_allclose(out.numpy().mean(axis=(0, 2, 3)), np.zeros(4), atol=1e-5)
+        np.testing.assert_allclose(out.numpy().std(axis=(0, 2, 3)), np.ones(4), atol=1e-2)
+        # running stats moved
+        assert abs(bn._mean.numpy()).max() > 0
+        bn.eval()
+        out2 = bn(x)
+        assert not np.allclose(out2.numpy(), out.numpy())
+
+    def test_layernorm(self):
+        import torch
+
+        x = _rand(2, 3, 8)
+        ln = nn.LayerNorm(8)
+        got = ln(paddle.to_tensor(x))
+        tln = torch.nn.LayerNorm(8)
+        want = tln(torch.tensor(x)).detach()
+        np.testing.assert_allclose(got.numpy(), want.numpy(), atol=1e-5)
+
+    def test_groupnorm(self):
+        import torch
+
+        x = _rand(2, 6, 4, 4)
+        got = F.group_norm(paddle.to_tensor(x), 3)
+        want = torch.nn.functional.group_norm(torch.tensor(x), 3)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), atol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+        out = emb(ids)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+    def test_embedding_padding_idx_grad(self):
+        emb = nn.Embedding(5, 3, padding_idx=0)
+        ids = paddle.to_tensor(np.array([0, 1, 2]))
+        emb(ids).sum().backward()
+        g = emb.weight.grad.numpy()
+        np.testing.assert_allclose(g[0], np.zeros(3))
+        np.testing.assert_allclose(g[1], np.ones(3))
+
+    def test_dropout_scale(self):
+        x = paddle.to_tensor(np.ones((1000,), np.float32))
+        out = F.dropout(x, 0.5, training=True)
+        kept = out.numpy()[out.numpy() > 0]
+        np.testing.assert_allclose(kept, 2.0 * np.ones_like(kept))
+        out_eval = F.dropout(x, 0.5, training=False)
+        np.testing.assert_allclose(out_eval.numpy(), x.numpy())
+
+    def test_activations_vs_torch(self):
+        import torch
+        import torch.nn.functional as TF
+
+        x = _rand(4, 5)
+        for ours, theirs in [
+            (F.relu, TF.relu), (F.gelu, TF.gelu), (F.silu, TF.silu),
+            (F.softplus, TF.softplus), (F.elu, TF.elu),
+            (F.hardswish, TF.hardswish), (F.log_sigmoid, TF.logsigmoid),
+        ]:
+            np.testing.assert_allclose(
+                ours(paddle.to_tensor(x)).numpy(), theirs(torch.tensor(x)).numpy(),
+                atol=2e-5, err_msg=str(theirs),
+            )
+
+    def test_softmax_ce(self):
+        import torch
+        import torch.nn.functional as TF
+
+        logits = _rand(6, 5)
+        labels = np.random.randint(0, 5, (6,))
+        got = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        want = TF.cross_entropy(torch.tensor(logits), torch.tensor(labels))
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-5)
+
+    def test_ce_ignore_index(self):
+        import torch
+        import torch.nn.functional as TF
+
+        logits = _rand(6, 5)
+        labels = np.array([0, 1, -100, 3, -100, 2])
+        got = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels), ignore_index=-100)
+        want = TF.cross_entropy(torch.tensor(logits), torch.tensor(labels), ignore_index=-100)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        import torch
+        import torch.nn.functional as TF
+
+        x, y = _rand(4, 3), np.random.randint(0, 2, (4, 3)).astype(np.float32)
+        got = F.binary_cross_entropy_with_logits(paddle.to_tensor(x), paddle.to_tensor(y))
+        want = TF.binary_cross_entropy_with_logits(torch.tensor(x), torch.tensor(y))
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-5)
+
+    def test_interpolate(self):
+        x = _rand(1, 2, 4, 4)
+        out = F.interpolate(paddle.to_tensor(x), scale_factor=2, mode="nearest")
+        assert out.shape == [1, 2, 8, 8]
+        np.testing.assert_allclose(out.numpy()[0, 0, ::2, ::2], x[0, 0])
+
+    def test_mha_shapes(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(_rand(2, 6, 16))
+        out = mha(x, x, x)
+        assert out.shape == [2, 6, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.to_tensor(_rand(2, 5, 16))
+        out = enc(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_lstm(self):
+        lstm = nn.LSTM(4, 8, num_layers=2, direction="bidirectional")
+        x = paddle.to_tensor(_rand(3, 7, 4))
+        y, (h, c) = lstm(x)
+        assert y.shape == [3, 7, 16]
+        assert h.shape == [4, 3, 8] and c.shape == [4, 3, 8]
+
+    def test_lstm_vs_torch(self):
+        import torch
+
+        tl = torch.nn.LSTM(3, 5, num_layers=1, batch_first=True)
+        ours = nn.LSTM(3, 5, num_layers=1)
+        ours.weight_ih_l0.set_value(tl.weight_ih_l0.detach().numpy())
+        ours.weight_hh_l0.set_value(tl.weight_hh_l0.detach().numpy())
+        ours.bias_ih_l0.set_value(tl.bias_ih_l0.detach().numpy())
+        ours.bias_hh_l0.set_value(tl.bias_hh_l0.detach().numpy())
+        x = _rand(2, 6, 3)
+        y_t, (h_t, c_t) = tl(torch.tensor(x))
+        y_o, (h_o, c_o) = ours(paddle.to_tensor(x))
+        np.testing.assert_allclose(y_o.numpy(), y_t.detach().numpy(), atol=1e-5)
+        np.testing.assert_allclose(h_o.numpy(), h_t.detach().numpy(), atol=1e-5)
+
+    def test_gru_vs_torch(self):
+        import torch
+
+        tl = torch.nn.GRU(3, 5, num_layers=1, batch_first=True)
+        ours = nn.GRU(3, 5, num_layers=1)
+        ours.weight_ih_l0.set_value(tl.weight_ih_l0.detach().numpy())
+        ours.weight_hh_l0.set_value(tl.weight_hh_l0.detach().numpy())
+        ours.bias_ih_l0.set_value(tl.bias_ih_l0.detach().numpy())
+        ours.bias_hh_l0.set_value(tl.bias_hh_l0.detach().numpy())
+        x = _rand(2, 6, 3)
+        y_t, h_t = tl(torch.tensor(x))
+        y_o, h_o = ours(paddle.to_tensor(x))
+        np.testing.assert_allclose(y_o.numpy(), y_t.detach().numpy(), atol=1e-5)
+
+
+class TestClip:
+    def test_global_norm(self):
+        g1 = paddle.to_tensor(np.full((3,), 3.0, np.float32))
+        g2 = paddle.to_tensor(np.full((4,), 4.0, np.float32))
+        p1, p2 = nn.Parameter(np.zeros(3, np.float32)), nn.Parameter(np.zeros(4, np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        out = clip([(p1, g1), (p2, g2)])
+        total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+    def test_by_value(self):
+        clip = nn.ClipGradByValue(0.5)
+        p = nn.Parameter(np.zeros(3, np.float32))
+        g = paddle.to_tensor(np.array([-1.0, 0.2, 1.0], np.float32))
+        (pp, gg), = clip([(p, g)])
+        np.testing.assert_allclose(gg.numpy(), [-0.5, 0.2, 0.5])
