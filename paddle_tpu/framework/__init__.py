@@ -1,0 +1,3 @@
+"""paddle_tpu.framework — save/load, defaults, misc framework surface."""
+from .io import load, save  # noqa: F401
+from .dtype_default import get_default_dtype, set_default_dtype  # noqa: F401

@@ -1,0 +1,18 @@
+"""Default dtype (parity: paddle.set_default_dtype/get_default_dtype)."""
+from __future__ import annotations
+
+from ..dtype import convert_dtype
+
+_default_dtype = "float32"
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    name = convert_dtype(d)
+    if name not in ("float16", "bfloat16", "float32", "float64"):
+        raise TypeError(f"set_default_dtype only accepts float types, got {d}")
+    _default_dtype = name
+
+
+def get_default_dtype() -> str:
+    return _default_dtype

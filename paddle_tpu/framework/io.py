@@ -1,0 +1,76 @@
+"""paddle.save / paddle.load — pickled state dicts.
+
+Parity: /root/reference/python/paddle/framework/io.py:553 (save), :769 (load)
+— pickled nested dicts of tensors (Layer.state_dict / Optimizer.state_dict),
+>4GB protocol, path conventions (.pdparams / .pdopt by convention only).
+
+TPU-native: tensors serialize as numpy arrays (device-independent); loading
+device-puts lazily on first use (jax default device).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+class _TensorPayload:
+    """Pickle surrogate: stores the numpy value + tensor metadata."""
+
+    def __init__(self, array: np.ndarray, stop_gradient: bool, name):
+        self.array = array
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+
+def _pack(obj: Any):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._data), obj.stop_gradient, obj.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy: bool):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Tensor(obj.array, stop_gradient=obj.stop_gradient, name=obj.name)
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL, **configs):
+    if isinstance(path, str):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "wb") as f:
+            pickle.dump(_pack(obj), f, protocol=protocol)
+    else:  # file-like
+        pickle.dump(_pack(obj), path, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs):
+    if isinstance(path, str):
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+    else:
+        obj = pickle.load(path)
+    return _unpack(obj, return_numpy)

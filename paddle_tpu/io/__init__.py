@@ -1,0 +1,39 @@
+"""paddle_tpu.io — Dataset / DataLoader.
+
+Parity: python/paddle/io/ in the reference (Dataset, IterableDataset,
+TensorDataset, Sampler family, BatchSampler, DataLoader with num_workers,
+fluid/reader.py + C++ reader/buffered_reader.cc double-buffering).
+
+TPU-native: worker processes produce numpy batches over a multiprocessing
+queue; a background prefetch thread overlaps host→device transfer with
+compute (the buffered_reader role). Device placement happens at iteration so
+batches land on TPU ahead of the step that consumes them.
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)
+from .dataloader import DataLoader, get_worker_info  # noqa: F401
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split",
+    "Sampler", "SequenceSampler", "RandomSampler", "SubsetRandomSampler",
+    "WeightedRandomSampler", "BatchSampler", "DistributedBatchSampler",
+    "DataLoader", "get_worker_info",
+]

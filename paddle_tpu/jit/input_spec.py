@@ -1,0 +1,32 @@
+"""InputSpec — declared input signature for tracing.
+
+Parity: python/paddle/static/input.py InputSpec in the reference. A None dim
+means "polymorphic": we trace per concrete size and cache (XLA requires
+static shapes; the cache gives the same UX).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..dtype import convert_dtype
+
+__all__ = ["InputSpec"]
+
+
+class InputSpec:
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32", name: Optional[str] = None):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor._data.shape), str(tensor._data.dtype), name)
+
+    def compatible_with(self, arr) -> bool:
+        if len(arr.shape) != len(self.shape):
+            return False
+        return all(s == -1 or s == a for s, a in zip(self.shape, arr.shape))

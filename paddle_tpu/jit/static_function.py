@@ -1,0 +1,227 @@
+"""StaticFunction — the @to_static engine.
+
+Parity: fluid/dygraph/dygraph_to_static/program_translator.py
+(StaticFunction.__call__:302, ConcreteProgram cached by CacheKey:144).
+TPU-native: a ConcreteProgram is a jax.jit-compiled pure function; CacheKey is
+(input shapes/dtypes, static-arg values, training flag). Autograd
+integration: the whole compiled forward is one tape Node (jax.vjp over the
+pure function), so ``loss.backward()`` after a jitted forward costs exactly
+XLA's fused backward pass — there is no per-op interpreter loop on the TPU.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import tape
+from ..random import get_rng_state, set_rng_state, split_key
+from ..tensor import Tensor
+
+__all__ = ["StaticFunction", "to_static", "not_to_static"]
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _is_traced_leaf(x):
+    return isinstance(x, (Tensor, jax.Array, np.ndarray))
+
+
+class StaticFunction:
+    """Callable wrapper that traces/compiles per input signature."""
+
+    def __init__(self, fn: Callable, input_spec=None, layer=None):
+        self._fn = fn
+        self._input_spec = input_spec
+        self._layer = layer
+        self._cache: Dict[Any, Tuple] = {}
+        try:
+            functools.wraps(fn)(self)
+        except Exception:
+            pass
+
+    @property
+    def _bound_layer(self):
+        if self._layer is not None:
+            return self._layer
+        return getattr(self._fn, "__self__", None)
+
+    def __get__(self, instance, owner):
+        # support decorating methods: bind to the instance as layer
+        if instance is None:
+            return self
+        bound = StaticFunction(self._fn.__get__(instance, owner), self._input_spec)
+        return bound
+
+    def __call__(self, *args, **kwargs):
+        flat, treedef = jax.tree_util.tree_flatten(args, is_leaf=_is_tensor)
+        traced_pos = [i for i, x in enumerate(flat) if _is_traced_leaf(x)]
+        arrays = [
+            flat[i]._data if _is_tensor(flat[i]) else jnp.asarray(flat[i]) for i in traced_pos
+        ]
+        static_leaves = tuple(
+            (i, repr(x)) for i, x in enumerate(flat) if not _is_traced_leaf(x)
+        )
+        kwargs_static = tuple(sorted((k, repr(v)) for k, v in kwargs.items()))
+        layer = self._bound_layer
+        training = layer.training if layer is not None else True
+        key = (
+            tuple((tuple(a.shape), str(a.dtype)) for a in arrays),
+            treedef,
+            static_leaves,
+            kwargs_static,
+            training,
+        )
+
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(flat, treedef, traced_pos, kwargs)
+            self._cache[key] = entry
+        jitted, cell = entry
+
+        if layer is not None:
+            param_tensors = dict(layer.named_parameters())
+            buffer_tensors = dict(layer.named_buffers())
+        else:
+            param_tensors, buffer_tensors = {}, {}
+        params_tree = {n: p._data for n, p in param_tensors.items()}
+        buffers_tree = {n: b._data for n, b in buffer_tensors.items()}
+        rng_key = split_key()
+
+        need_grad = tape.is_grad_enabled() and (
+            any(not p.stop_gradient for p in param_tensors.values())
+            or any(
+                _is_tensor(flat[i]) and not flat[i].stop_gradient for i in traced_pos
+            )
+        )
+
+        if not need_grad:
+            out_arrays, new_buffers = jitted(params_tree, buffers_tree, rng_key, *arrays)
+            self._write_buffers(buffer_tensors, new_buffers)
+            outs = [Tensor(a) for a in out_arrays]
+            return jax.tree_util.tree_unflatten(cell["out_treedef"], outs)
+
+        diff_names = [
+            n for n, p in param_tensors.items()
+            if not p.stop_gradient and jnp.issubdtype(p._data.dtype, jnp.inexact)
+        ]
+        diff_arr_idx = [
+            j for j, i in enumerate(traced_pos)
+            if _is_tensor(flat[i]) and not flat[i].stop_gradient
+            and jnp.issubdtype(arrays[j].dtype, jnp.inexact)
+        ]
+        nondiff_params = {n: a for n, a in params_tree.items() if n not in diff_names}
+
+        def diff_fn(diff_params, *diff_xs):
+            full = dict(nondiff_params)
+            full.update(diff_params)
+            xs = list(arrays)
+            for j, a in zip(diff_arr_idx, diff_xs):
+                xs[j] = a
+            return jitted(full, buffers_tree, rng_key, *xs)
+
+        diff_params = {n: params_tree[n] for n in diff_names}
+        diff_xs = [arrays[j] for j in diff_arr_idx]
+        out_arrays, vjp_fn, new_buffers = jax.vjp(diff_fn, diff_params, *diff_xs, has_aux=True)
+        self._write_buffers(buffer_tensors, new_buffers)
+
+        input_tensors = [param_tensors[n] for n in diff_names] + [
+            flat[traced_pos[j]] for j in diff_arr_idx
+        ]
+
+        def tape_vjp(out_cots):
+            cots = out_cots if isinstance(out_cots, tuple) else (out_cots,)
+            dparams, *dxs = vjp_fn(tuple(cots))
+            return tuple(dparams[n] for n in diff_names) + tuple(dxs)
+
+        node = tape.Node(
+            tape_vjp,
+            input_tensors,
+            [(a.shape, a.dtype) for a in out_arrays],
+            name=f"jit:{getattr(self._fn, '__name__', 'fn')}",
+        )
+        outs = []
+        for pos, a in enumerate(out_arrays):
+            t = Tensor(a, stop_gradient=False)
+            t._node = node
+            t._out_idx = pos
+            outs.append(t)
+        return jax.tree_util.tree_unflatten(cell["out_treedef"], outs)
+
+    def _build(self, flat_template, treedef, traced_pos, kwargs):
+        layer = self._bound_layer
+        fn = self._fn
+        cell: Dict[str, Any] = {}
+        static_flat = [
+            None if i in set(traced_pos) else x for i, x in enumerate(flat_template)
+        ]
+
+        def pure(params_tree, buffers_tree, rng_key, *xs):
+            saved = get_rng_state()
+            set_rng_state(rng_key)
+            try:
+                with tape.no_grad():
+                    flat2 = list(static_flat)
+                    for i, x in zip(traced_pos, xs):
+                        flat2[i] = Tensor(x)
+                    args = jax.tree_util.tree_unflatten(treedef, flat2)
+                    if layer is not None:
+                        out, new_buffers = layer.functional_call_with_state(
+                            params_tree, buffers_tree, *args, _call_fn=fn, **kwargs
+                        )
+                    else:
+                        out = fn(*args, **kwargs)
+                        new_buffers = {}
+            finally:
+                set_rng_state(saved)
+            out_flat, out_treedef = jax.tree_util.tree_flatten(out, is_leaf=_is_tensor)
+            cell["out_treedef"] = out_treedef
+            out_arrays = tuple(
+                o._data if _is_tensor(o) else jnp.asarray(o) for o in out_flat
+            )
+            return out_arrays, new_buffers
+
+        return jax.jit(pure), cell
+
+    @staticmethod
+    def _write_buffers(buffer_tensors, new_buffers):
+        for n, arr in new_buffers.items():
+            if n in buffer_tensors:
+                buffer_tensors[n]._set_data(arr)
+
+    @property
+    def code(self):
+        import inspect
+
+        return inspect.getsource(self._fn)
+
+    def rollback(self):
+        return self._fn
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, **kwargs):
+    """Decorator / wrapper. ``build_strategy`` accepted for parity, unused —
+    XLA owns fusion decisions (reference BuildStrategy, pybind.cc:2692)."""
+
+    def deco(fn):
+        from ..nn.layer import Layer
+
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, input_spec, layer=fn)
+            object.__setattr__(fn, "forward", sf)
+            return fn
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn

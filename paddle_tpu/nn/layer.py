@@ -366,6 +366,29 @@ class Layer:
             else:
                 params[name]._set_data(arr)
 
+    def functional_call_with_state(self, params_tree, buffers_tree, *inputs, _call_fn=None, **kwargs):
+        """Pure-style call for jit tracing: swap params+buffers in, run
+        forward, read back mutated buffer values (BN running stats), restore
+        originals. Returns (outputs, new_buffers_tree). ``_call_fn`` overrides
+        the callable (used by to_static to reach the pre-wrap forward)."""
+        params = dict(self.named_parameters())
+        buffers = dict(self.named_buffers())
+        saved_p = {n: params[n]._data for n in params_tree}
+        saved_b = {n: buffers[n]._data for n in buffers_tree}
+        try:
+            for n, arr in params_tree.items():
+                params[n]._set_data(arr)
+            for n, arr in buffers_tree.items():
+                buffers[n]._set_data(arr)
+            out = (_call_fn or self.__call__)(*inputs, **kwargs)
+            new_buffers = {n: buffers[n]._data for n in buffers_tree}
+            return out, new_buffers
+        finally:
+            for n, arr in saved_p.items():
+                params[n]._set_data(arr)
+            for n, arr in saved_b.items():
+                buffers[n]._set_data(arr)
+
     def functional_call(self, tree, *inputs, **kwargs):
         """Run forward with parameters taken from ``tree`` (pure w.r.t. the
         tree): temporarily swaps arrays in, calls forward, restores. Used by

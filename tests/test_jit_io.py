@@ -1,0 +1,215 @@
+"""to_static / jit.save+load / paddle.save+load / DataLoader tests."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.jit import InputSpec, load as jit_load, save as jit_save, to_static
+
+
+def _rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.fc2 = nn.Linear(8, 2)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+class TestToStatic:
+    def test_matches_eager(self):
+        net = Net()
+        x = paddle.to_tensor(_rand(3, 4))
+        eager = net(x).numpy()
+        snet = to_static(Net())
+        snet.set_state_dict(net.state_dict())
+        got = snet(x).numpy()
+        np.testing.assert_allclose(got, eager, atol=1e-6)
+
+    def test_cache_reuse_and_retrace(self):
+        net = to_static(Net())
+        x3 = paddle.to_tensor(_rand(3, 4))
+        x5 = paddle.to_tensor(_rand(5, 4))
+        net(x3)
+        net(x3)
+        assert len(net.forward._cache) == 1
+        net(x5)
+        assert len(net.forward._cache) == 2
+
+    def test_backward_through_jit(self):
+        net = to_static(Net())
+        x = paddle.to_tensor(_rand(6, 4))
+        loss = net(x).sum()
+        loss.backward()
+        g = net.fc1.weight.grad
+        assert g is not None and g.shape == [4, 8]
+        # compare against eager clone
+        net2 = Net()
+        net2.set_state_dict(net.state_dict())
+        loss2 = net2(x).sum()
+        loss2.backward()
+        np.testing.assert_allclose(g.numpy(), net2.fc1.weight.grad.numpy(), atol=1e-5)
+
+    def test_training_with_jit_converges(self):
+        paddle.seed(0)
+        net = to_static(Net())
+        o = opt.Adam(0.01, parameters=net.parameters())
+        X = _rand(64, 4)
+        w = _rand(4, 2)
+        Y = (X @ w).argmax(1)
+        for _ in range(100):
+            loss = nn.CrossEntropyLoss()(net(paddle.to_tensor(X)), paddle.to_tensor(Y))
+            o.clear_grad()
+            loss.backward()
+            o.step()
+        assert float(loss) < 0.2
+
+    def test_function_decorator(self):
+        @to_static
+        def f(x, y):
+            return paddle.tanh(x) + y
+
+        a, b = paddle.to_tensor(_rand(3)), paddle.to_tensor(_rand(3))
+        np.testing.assert_allclose(f(a, b).numpy(), np.tanh(a.numpy()) + b.numpy(), atol=1e-6)
+
+    def test_bn_buffer_update_under_jit(self):
+        net = to_static(nn.BatchNorm1D(4, data_format="NC"))
+        before = net._mean.numpy().copy()
+        net.train()
+        net(paddle.to_tensor(_rand(16, 4) + 3.0))
+        after = net._mean.numpy()
+        assert not np.allclose(before, after)
+
+    def test_dropout_differs_across_jit_calls(self):
+        net = to_static(nn.Dropout(0.5))
+        x = paddle.to_tensor(np.ones((100,), np.float32))
+        a, b = net(x).numpy(), net(x).numpy()
+        assert not np.allclose(a, b)
+
+
+class TestJitSaveLoad:
+    def test_roundtrip(self):
+        net = Net()
+        net.eval()
+        x = _rand(2, 4)
+        want = net(paddle.to_tensor(x)).numpy()
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "model")
+            jit_save(net, path, input_spec=[InputSpec([-1, 4], "float32")])
+            assert os.path.exists(path + ".pdmodel")
+            loaded = jit_load(path)
+            got = loaded(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+class TestSaveLoad:
+    def test_state_dict_roundtrip(self):
+        net = Net()
+        o = opt.Adam(0.01, parameters=net.parameters())
+        loss = net(paddle.to_tensor(_rand(4, 4))).sum()
+        loss.backward()
+        o.step()
+        with tempfile.TemporaryDirectory() as d:
+            paddle.save(net.state_dict(), os.path.join(d, "m.pdparams"))
+            paddle.save(o.state_dict(), os.path.join(d, "m.pdopt"))
+            net2 = Net()
+            o2 = opt.Adam(0.01, parameters=net2.parameters())
+            net2.set_state_dict(paddle.load(os.path.join(d, "m.pdparams")))
+            o2.set_state_dict(paddle.load(os.path.join(d, "m.pdopt")))
+        x = paddle.to_tensor(_rand(2, 4))
+        np.testing.assert_allclose(net(x).numpy(), net2(x).numpy())
+        assert o2._global_step == 1
+
+    def test_nested_objects(self):
+        obj = {"a": paddle.to_tensor(_rand(3)), "b": [1, "s", paddle.ones([2])]}
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "obj.pkl")
+            paddle.save(obj, p)
+            back = paddle.load(p)
+        np.testing.assert_allclose(back["a"].numpy(), obj["a"].numpy())
+        assert back["b"][1] == "s"
+
+
+class TestDataLoader:
+    def test_basic_batching(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+
+        xs, ys = _rand(10, 3), np.arange(10)
+        dl = DataLoader(TensorDataset([xs, ys]), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        assert batches[0][0].shape == [4, 3]
+        assert batches[2][0].shape == [2, 3]
+        np.testing.assert_allclose(batches[0][1].numpy(), [0, 1, 2, 3])
+
+    def test_shuffle_drop_last(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+
+        dl = DataLoader(TensorDataset([np.arange(10)]), batch_size=3, shuffle=True, drop_last=True)
+        batches = list(dl)
+        assert len(batches) == 3
+        seen = np.concatenate([b[0].numpy() for b in batches])
+        assert len(set(seen.tolist())) == 9
+
+    def test_custom_dataset_and_collate(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 6
+
+            def __getitem__(self, i):
+                return {"x": np.full((2,), i, np.float32), "y": i}
+
+        dl = DataLoader(DS(), batch_size=2)
+        b = next(iter(dl))
+        assert b["x"].shape == [2, 2] and b["y"].shape == [2]
+
+    def test_multiprocess_workers(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 20
+
+            def __getitem__(self, i):
+                return np.full((3,), i, np.float32)
+
+        dl = DataLoader(DS(), batch_size=5, num_workers=2)
+        batches = list(dl)
+        assert len(batches) == 4
+        np.testing.assert_allclose(batches[0].numpy()[:, 0], [0, 1, 2, 3, 4])
+
+    def test_iterable_dataset(self):
+        from paddle_tpu.io import DataLoader, IterableDataset
+
+        class Stream(IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.float32(i)
+
+        dl = DataLoader(Stream(), batch_size=3)
+        batches = list(dl)
+        assert len(batches) == 3 and batches[2].shape == [1]
+
+    def test_distributed_batch_sampler(self):
+        from paddle_tpu.io import DistributedBatchSampler, TensorDataset
+
+        ds = TensorDataset([np.arange(10)])
+        s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+        s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert len(i0) == len(i1) == 5
+        assert set(i0) | set(i1) == set(range(10))
