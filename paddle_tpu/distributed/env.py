@@ -1,0 +1,157 @@
+"""Parallel environment + global mesh bootstrap.
+
+Parity: ParallelEnv (fluid/dygraph/parallel.py:72 — reads PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS set by the launcher,
+launch_utils.py:490-501) and init_parallel_env (distributed/parallel.py).
+
+TPU-native: multi-host bootstrap is jax.distributed.initialize (the TPU
+runtime rendezvous replaces the reference's TCP nccl-id exchange). The global
+**device mesh** is process-wide state: every parallelism axis (dp/fsdp/mp/pp/
+sp/ep) lives on one jax.sharding.Mesh created here.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "ParallelEnv",
+    "init_parallel_env",
+    "get_rank",
+    "get_world_size",
+    "get_mesh",
+    "set_mesh",
+    "init_mesh",
+]
+
+_global_mesh = None
+_initialized = False
+
+
+class ParallelEnv:
+    """Reads the launcher env contract (Appendix B of SURVEY)."""
+
+    def __init__(self):
+        self._rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._device_id = int(os.getenv("FLAGS_selected_tpus", os.getenv("FLAGS_selected_gpus", "0")).split(",")[0])
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+        self._trainer_endpoints = os.getenv("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+    # legacy aliases
+    local_rank = rank
+    nranks = world_size
+    dev_id = device_id
+
+
+def init_parallel_env():
+    """Initialize multi-process jax (multi-host TPU pods) if the launcher env
+    says we're one of several processes; otherwise single-controller mode."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    env = ParallelEnv()
+    if env.world_size > 1 and os.getenv("PADDLE_TPU_SINGLE_CONTROLLER", "0") != "1":
+        import jax
+
+        coordinator = env.trainer_endpoints[0] if env.trainer_endpoints[0] else None
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=env.world_size,
+            process_id=env.rank,
+        )
+    from .group import Group, _set_default_group
+
+    _set_default_group(Group(id=0, axis_name=None))
+    _initialized = True
+    return env
+
+
+def get_rank(group=None) -> int:
+    import jax
+
+    if group is not None and group.ranks:
+        return group.get_group_rank(ParallelEnv().rank)
+    try:
+        return jax.process_index()
+    except Exception:
+        return ParallelEnv().rank
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    env_n = ParallelEnv().world_size
+    if env_n > 1:
+        return env_n
+    import jax
+
+    try:
+        return jax.process_count() if jax.process_count() > 1 else 1
+    except Exception:
+        return 1
+
+
+# ---------------------------------------------------------------------------
+# the global mesh
+# ---------------------------------------------------------------------------
+
+
+def init_mesh(axes: Dict[str, int], devices=None):
+    """Create + install the global mesh, e.g. init_mesh({'dp': 2, 'mp': 4}).
+
+    Axis order is layout-significant: later axes are placed on
+    faster/closer device dimensions (keep 'mp' innermost so tensor-parallel
+    collectives ride the fastest ICI links, like the reference's ring order
+    in fleet/base/topology.py).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    global _global_mesh
+    if devices is None:
+        devices = np.array(jax.devices())
+    total = int(np.prod(list(axes.values())))
+    if total > len(np.ravel(devices)):
+        raise ValueError(f"mesh needs {total} devices, have {len(np.ravel(devices))}")
+    dev_grid = np.array(np.ravel(devices)[:total]).reshape(tuple(axes.values()))
+    _global_mesh = Mesh(dev_grid, tuple(axes.keys()))
+    return _global_mesh
+
+
+def set_mesh(mesh):
+    global _global_mesh
+    _global_mesh = mesh
+    return mesh
+
+
+def get_mesh():
+    return _global_mesh
+
+
+def _axis_size(axis_name: str) -> int:
+    if _global_mesh is None or axis_name not in _global_mesh.shape:
+        return 1
+    return int(_global_mesh.shape[axis_name])

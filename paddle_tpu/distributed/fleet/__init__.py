@@ -1,0 +1,21 @@
+"""paddle_tpu.distributed.fleet — parity with paddle.distributed.fleet."""
+from .. import meta_parallel  # noqa: F401
+from ..topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode  # noqa: F401
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet_base import Fleet, PaddleCloudRoleMaker, UserDefinedRoleMaker, fleet  # noqa: F401
+
+# module-level function surface (parity: fleet/__init__.py delegates to the
+# singleton)
+init = fleet.init
+is_initialized = fleet.is_initialized
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+worker_num = fleet.worker_num
+worker_index = fleet.worker_index
+is_worker = fleet.is_worker
+is_server = fleet.is_server
+is_first_worker = fleet.is_first_worker
+worker_endpoints = fleet.worker_endpoints
+barrier_worker = fleet.barrier_worker
+minimize = fleet.minimize

@@ -1,0 +1,163 @@
+"""DistributedStrategy.
+
+Parity: /root/reference/python/paddle/distributed/fleet/base/
+distributed_strategy.py (protobuf-backed, framework/distributed_strategy.proto
+message DistributedStrategy:176 with ~45 toggle+config properties: amp:403,
+recompute:515, sharding:827, pipeline:1014, tensor_parallel:1078,
+hybrid_configs:1133, localsgd:1167, dgc:1283, gradient_merge:1369, lars:1428,
+lamb:1490, elastic:1549, auto:1565, a_sync:281).
+
+TPU-native: a plain serializable config tree (JSON instead of prototxt — XLA
+has no protobuf IR to share with). Every reference toggle is present; ones
+with no TPU meaning are accepted and recorded so reference configs load
+unchanged, and `effective()` reports how each lowers onto the mesh.
+"""
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any, Dict
+
+__all__ = ["DistributedStrategy"]
+
+_DEFAULTS: Dict[str, Any] = {
+    # execution
+    "a_sync": False,
+    "a_sync_configs": {"k_steps": -1, "max_merge_var_num": 1, "send_queue_size": 16,
+                       "independent_recv_thread": False, "thread_pool_size": 1,
+                       "send_wait_times": 1, "runtime_split_send_recv": False},
+    # amp
+    "amp": False,
+    "amp_configs": {"init_loss_scaling": 32768.0, "incr_every_n_steps": 1000,
+                    "decr_every_n_nan_or_inf": 2, "incr_ratio": 2.0, "decr_ratio": 0.5,
+                    "use_dynamic_loss_scaling": True, "use_pure_fp16": False,
+                    "use_fp16_guard": True, "custom_white_list": [], "custom_black_list": [],
+                    "custom_black_varnames": [], "dtype": "float16"},
+    # recompute
+    "recompute": False,
+    "recompute_configs": {"checkpoints": [], "enable_offload": False, "checkpoint_shape": []},
+    # pipeline
+    "pipeline": False,
+    "pipeline_configs": {"micro_batch_size": 1, "accumulate_steps": 1, "schedule_mode": "1F1B",
+                         "p2p_cache_shape": True},
+    # tensor parallel (static-mode config)
+    "tensor_parallel": False,
+    "tensor_parallel_configs": {"tensor_parallel_degree": 1, "tensor_init_seed": -1},
+    # sharding (ZeRO)
+    "sharding": False,
+    "sharding_configs": {"sharding_segment_strategy": "segment_broadcast_MB",
+                         "segment_broadcast_MB": 32.0, "segment_anchors": None,
+                         "sharding_degree": 8, "mp_degree": 1, "dp_degree": 1,
+                         "hybrid_dp": False, "gradient_merge_acc_step": 1,
+                         "optimize_offload": False, "stage": 1,
+                         "pp_degree": 1, "pp_allreduce_in_optimize": False,
+                         "optimize_cast": False},
+    # hybrid (dygraph-mode degrees)
+    "hybrid_configs": {"dp_degree": -1, "mp_degree": 1, "pp_degree": 1,
+                       "sharding_degree": 1, "sep_degree": 1, "ep_degree": 1},
+    # gradient merge
+    "gradient_merge": False,
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    # localsgd
+    "localsgd": False,
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "adaptive_localsgd": False,
+    "adaptive_localsgd_configs": {"init_k_steps": 1, "begin_step": 1},
+    # dgc
+    "dgc": False,
+    "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1, "sparsity": [0.999]},
+    # lars / lamb
+    "lars": False,
+    "lars_configs": {"lars_coeff": 0.001, "lars_weight_decay": 0.0005, "epsilon": 0,
+                     "exclude_from_weight_decay": []},
+    "lamb": False,
+    "lamb_configs": {"lamb_weight_decay": 0.01, "exclude_from_weight_decay": []},
+    # misc toggles
+    "fp16_allreduce": False,
+    "fuse_all_reduce_ops": True,
+    "fuse_grad_size_in_MB": 32,
+    "fuse_grad_size_in_TFLOPS": 50,
+    "nccl_comm_num": 1,
+    "sync_nccl_allreduce": True,
+    "use_hierarchical_allreduce": False,
+    "hierarchical_allreduce_inter_nranks": 1,
+    "sync_batch_norm": False,
+    "fuse_all_optimizer_ops": False,
+    "without_graph_optimization": False,
+    "asp": False,
+    "elastic": False,
+    "auto": False,
+    "semi_auto": False,
+    "heter_ccl_mode": False,
+    "cudnn_exhaustive_search": False,
+    "cudnn_batchnorm_spatial_persistent": False,
+    "conv_workspace_size_limit": 512,
+    "find_unused_parameters": False,
+    "last_comm_group_size_MB": 1,
+    "qat": False,
+    "qat_configs": {},
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.__dict__["_cfg"] = copy.deepcopy(_DEFAULTS)
+
+    def __getattr__(self, name):
+        cfg = self.__dict__["_cfg"]
+        if name in cfg:
+            return cfg[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        cfg = self.__dict__["_cfg"]
+        if name not in cfg:
+            raise ValueError(f"unknown DistributedStrategy field {name!r}")
+        if name.endswith("_configs"):
+            if not isinstance(value, dict):
+                raise TypeError(f"{name} must be a dict")
+            merged = dict(cfg[name])
+            for k, v in value.items():
+                merged[k] = v
+            cfg[name] = merged
+        else:
+            cfg[name] = value
+
+    # serialization (parity: save_to_prototxt/load_from_prototxt :146,164)
+    def to_dict(self) -> Dict[str, Any]:
+        return copy.deepcopy(self._cfg)
+
+    def save_to_prototxt(self, output: str):
+        with open(output, "w") as f:
+            json.dump(self._cfg, f, indent=2, default=str)
+
+    def load_from_prototxt(self, pb_file: str):
+        with open(pb_file) as f:
+            loaded = json.load(f)
+        for k, v in loaded.items():
+            if k in self._cfg:
+                self._cfg[k] = v
+
+    def __repr__(self):
+        on = [k for k, v in self._cfg.items() if v is True]
+        return f"DistributedStrategy(enabled={on})"
+
+    # TPU lowering summary -----------------------------------------------
+    def effective(self) -> Dict[str, str]:
+        """How each enabled toggle lowers onto the TPU mesh."""
+        out = {}
+        if self.amp:
+            out["amp"] = f"dtype policy {self.amp_configs['dtype']} via paddle_tpu.amp"
+        if self.recompute:
+            out["recompute"] = "jax.checkpoint on declared segments"
+        if self.pipeline:
+            out["pipeline"] = "pp mesh axis + microbatch schedule"
+        if self.sharding:
+            out["sharding"] = f"ZeRO stage {self.sharding_configs['stage']} via fsdp axis sharding"
+        if self.hybrid_configs["mp_degree"] > 1:
+            out["mp"] = "weights sharded over 'mp' axis"
+        if self.dgc:
+            out["dgc"] = "top-k gradient compression before dp reduce"
+        if self.localsgd:
+            out["localsgd"] = "periodic param sync instead of per-step reduce"
+        return out

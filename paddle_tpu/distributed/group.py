@@ -1,0 +1,107 @@
+"""Process groups and ReduceOp.
+
+Parity: the reference's comm-group model — ``new_group`` / ``Group``
+(python/paddle/distributed/collective.py:120 Group, :209 new_group) where a
+group wraps an NCCL ring (``ring_id``).
+
+TPU-native: a Group wraps a **mesh axis name** (or an explicit rank list) on
+the global jax device mesh. Where the reference exchanges nccl ids over TCP
+(c_gen_nccl_id_op.cc) and creates comms per ring
+(platform/collective_helper.cc:102), here XLA materializes the collective
+over the named axis at compile time — there is no id exchange and no stream
+management.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "destroy_process_group"]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communication group = a named mesh axis (TPU) and the rank list."""
+
+    _next_id = 0
+
+    def __init__(self, ranks: Optional[List[int]] = None, axis_name: Optional[str] = None, id: Optional[int] = None):  # noqa: A002
+        if id is None:
+            Group._next_id += 1
+            id = Group._next_id  # noqa: A001
+        self.id = id
+        self.axis_name = axis_name
+        self.ranks = ranks if ranks is not None else []
+
+    @property
+    def nranks(self) -> int:
+        if self.ranks:
+            return len(self.ranks)
+        if self.axis_name is not None:
+            from .env import _axis_size
+
+            return _axis_size(self.axis_name)
+        from .env import get_world_size
+
+        return get_world_size()
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    def get_group_rank(self, rank: int) -> int:
+        if not self.ranks:
+            return rank
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def name(self):
+        return f"group_{self.id}" if self.axis_name is None else self.axis_name
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis_name}, ranks={self.ranks})"
+
+
+_groups = {}
+_default_group: Optional[Group] = None
+
+
+def _set_default_group(g: Group):
+    global _default_group
+    _default_group = g
+    _groups[0] = g
+
+
+def get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        _default_group = Group(id=0, axis_name=None)
+        _groups[0] = _default_group
+    return _default_group
+
+
+def new_group(ranks: Optional[List[int]] = None, backend=None, axis_name: Optional[str] = None) -> Group:
+    """Parity: paddle.distributed.new_group. ``axis_name`` is the TPU-native
+    extension: bind the group to a mesh axis for use inside shard_map."""
+    g = Group(ranks=ranks, axis_name=axis_name)
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid: int) -> Optional[Group]:
+    return _groups.get(gid)
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+    else:
+        _groups.pop(group.id, None)

@@ -1,0 +1,154 @@
+"""Launcher CLI: ``python -m paddle_tpu.distributed.launch train.py args...``
+
+Parity: /root/reference/python/paddle/distributed/fleet/launch.py (:611
+launch region) + launch_utils.py (:466 start_local_trainers, :490-501 env
+protocol, watch_local_trainers child monitoring). The env contract
+(PADDLE_TRAINER_ID / PADDLE_CURRENT_ENDPOINT / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS) is preserved so reference launch scripts port
+unchanged; device selection uses TPU visible chips.
+
+TPU-native notes: on a TPU pod each HOST runs one process that owns its local
+chips (single-controller-per-host), so nproc_per_node defaults to 1 with all
+local chips visible — unlike the reference's one-proc-per-GPU. The elastic
+path (restart on membership change) is in paddle_tpu.distributed.elastic.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["launch", "get_cluster_from_args", "start_local_trainers", "watch_local_trainers", "terminate_local_procs"]
+
+
+class TrainerProc:
+    def __init__(self, proc, rank, log_fn=None):
+        self.proc = proc
+        self.rank = rank
+        self.log_fn = log_fn
+
+
+def find_free_ports(num: int) -> List[int]:
+    import socket
+
+    ports = []
+    socks = []
+    for _ in range(num):
+        s = socket.socket()
+        s.bind(("", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def get_cluster_from_args(args):
+    ips = args.ips.split(",")
+    nproc = args.nproc_per_node
+    ports = find_free_ports(nproc) if len(ips) == 1 else [args.start_port + i for i in range(nproc)]
+    endpoints = []
+    for ip in ips:
+        for p in ports:
+            endpoints.append(f"{ip}:{p}")
+    return endpoints
+
+
+def start_local_trainers(endpoints: List[str], node_rank: int, nproc_per_node: int,
+                         training_script: str, training_script_args: List[str],
+                         log_dir: Optional[str] = None, envs=None) -> List[TrainerProc]:
+    procs = []
+    world = len(endpoints)
+    for local_rank in range(nproc_per_node):
+        rank = node_rank * nproc_per_node + local_rank
+        env = dict(os.environ)
+        env.update(envs or {})
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "FLAGS_selected_tpus": str(local_rank),
+        })
+        cmd = [sys.executable, "-u", training_script] + list(training_script_args)
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            fout = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+            proc = subprocess.Popen(cmd, env=env, stdout=fout, stderr=subprocess.STDOUT)
+        else:
+            fout = None
+            proc = subprocess.Popen(cmd, env=env)
+        procs.append(TrainerProc(proc, rank, fout))
+    return procs
+
+
+def watch_local_trainers(procs: List[TrainerProc]) -> bool:
+    """Returns True while all children are healthy; raises on abnormal exit
+    (parity: launch_utils.py watch_local_trainers)."""
+    alive = False
+    for tp in procs:
+        ret = tp.proc.poll()
+        if ret is None:
+            alive = True
+        elif ret != 0:
+            terminate_local_procs(procs)
+            raise RuntimeError(f"trainer rank {tp.rank} exited with code {ret}")
+    return alive
+
+
+def terminate_local_procs(procs: List[TrainerProc]):
+    for tp in procs:
+        if tp.proc.poll() is None:
+            tp.proc.terminate()
+    deadline = time.time() + 10
+    for tp in procs:
+        try:
+            tp.proc.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            tp.proc.kill()
+        if tp.log_fn:
+            tp.log_fn.close()
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--ips", default="127.0.0.1", help="comma-separated host ips")
+    p.add_argument("--nproc_per_node", type=int,
+                   default=int(os.getenv("PADDLE_TPU_NPROC_PER_NODE", "1")))
+    p.add_argument("--node_rank", type=int, default=int(os.getenv("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--start_port", type=int, default=6070)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("--elastic_level", type=int, default=-1)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None):
+    args = _parse_args(argv)
+    endpoints = get_cluster_from_args(args)
+    procs = start_local_trainers(
+        endpoints, args.node_rank, args.nproc_per_node,
+        args.training_script, args.training_script_args, args.log_dir,
+    )
+
+    def handler(signum, frame):
+        terminate_local_procs(procs)
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, handler)
+    signal.signal(signal.SIGINT, handler)
+    try:
+        while watch_local_trainers(procs):
+            time.sleep(1)
+    finally:
+        terminate_local_procs(procs)
+
+
+if __name__ == "__main__":
+    launch()

@@ -1,0 +1,14 @@
+"""meta_parallel — hybrid-parallel wrappers and parallel layers.
+
+Parity: python/paddle/distributed/fleet/meta_parallel/ in the reference.
+"""
+from .hybrid_optimizer import DygraphShardingOptimizer, HybridParallelOptimizer  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .tensor_parallel import TensorParallel  # noqa: F401

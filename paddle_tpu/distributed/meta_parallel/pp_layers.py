@@ -1,0 +1,136 @@
+"""Pipeline layer description + segmentation.
+
+Parity: /root/reference/python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/pp_layers.py — LayerDesc, SharedLayerDesc (:49, tied
+embeddings), PipelineLayer (:132) with ``_segment_network`` (:282) by
+'uniform' or 'layer:<Class>' seg_method.
+
+TPU-native: segmentation metadata is kept for ALL stages (single-controller
+SPMD owns every stage's params); stage assignment becomes a mapping
+layer-index → 'pp' mesh coordinate used by the pipeline schedule, instead of
+each process building only its local sublayers.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, List, Optional
+
+from ...nn.layer import Layer, LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages: Optional[int] = None, topology=None,
+                 loss_fn=None, seg_method: str = "uniform", recompute_interval: int = 0,
+                 recompute_ctx=None, num_virtual_pipeline_stages: int = 1):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._seg_method = seg_method
+        self._recompute_interval = recompute_interval
+        self._topo = topology
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = num_stages or 1
+
+        self._layer_descs: List = list(layers)
+        self._shared: dict = {}
+        built: List[Layer] = []
+        for i, d in enumerate(self._layer_descs):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    base = self._shared[d.layer_name]
+                    inst = d.build_layer()
+                    # tie the shared weight to the first instance's tensor
+                    setattr(inst, d.shared_weight_attr, getattr(base, d.shared_weight_attr))
+                    inst._shared_forward = d.forward_func
+                    built.append(inst)
+                else:
+                    inst = d.build_layer()
+                    inst._shared_forward = d.forward_func
+                    self._shared[d.layer_name] = inst
+                    built.append(inst)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(_FuncLayer(d))
+            else:
+                raise TypeError(f"bad pipeline entry {d!r}")
+        self.run_function = LayerList(built)
+        self.segment_parts = self._segment_network(len(built), self._num_stages, seg_method)
+
+    # ------------------------------------------------------------------
+    def _segment_network(self, n_layers: int, n_stages: int, seg_method: str) -> List[int]:
+        """Return stage boundary indices, len == n_stages+1 (parity:
+        _segment_network:282 — 'uniform' or 'layer:Class' balancing)."""
+        if seg_method.startswith("layer:"):
+            cls_name = seg_method.split(":", 1)[1]
+            marks = [
+                i
+                for i, l in enumerate(self.run_function)
+                if type(l).__name__ == cls_name
+            ]
+            if len(marks) < n_stages:
+                raise ValueError(f"only {len(marks)} {cls_name} layers for {n_stages} stages")
+            per = len(marks) / n_stages
+            bounds = [0]
+            for s in range(1, n_stages):
+                bounds.append(marks[math.floor(s * per)])
+            bounds.append(n_layers)
+            return bounds
+        per = n_layers / n_stages
+        return [math.floor(i * per) for i in range(n_stages)] + [n_layers]
+
+    def get_stage_layers(self, stage_id: int) -> List[Layer]:
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        return list(self.run_function)[lo:hi]
+
+    def forward(self, x):
+        """Full-model forward (all stages in order) — correct semantics on a
+        single program; the pipeline schedule partitions this by stage."""
+        for i, l in enumerate(self.run_function):
+            fwd = getattr(l, "_shared_forward", None)
+            if fwd is not None and not _is_first_shared(self, l):
+                x = fwd(l, x)
+            else:
+                x = l(x) if not isinstance(x, tuple) else l(*x)
+        return x
+
+
+def _is_first_shared(pipe: PipelineLayer, layer: Layer) -> bool:
+    return any(v is layer for v in pipe._shared.values())
+
+
+class _FuncLayer(Layer):
+    def __init__(self, fn: Callable):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args):
+        return self._fn(*args)

@@ -1,0 +1,44 @@
+"""TensorParallel model wrapper.
+
+Parity: meta_parallel/tensor_parallel.py in the reference — broadcasts mp
+params from rank 0 and syncs inputs. TPU-native: parameter "broadcast" is a
+device_put with the layer's partition_spec (replicated specs are identical on
+every shard by construction), so this wrapper mostly installs shardings.
+"""
+from __future__ import annotations
+
+from ...nn.layer import Layer
+from ..spmd import P, shard_array
+
+__all__ = ["TensorParallel"]
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers: Layer, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        import jax
+
+        for _, p in layers.named_parameters():
+            spec = getattr(p, "partition_spec", P())
+            if not isinstance(p._data, jax.core.Tracer):
+                try:
+                    shard_array(p, spec)
+                except Exception:
+                    pass  # mesh absent (pure-eager unit tests)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__.get("_sub_layers", {}).get("_layers"), name)

@@ -1,0 +1,127 @@
+"""DataParallel.
+
+Parity: ``paddle.DataParallel`` (fluid/dygraph/parallel.py:389) + the C++
+``Reducer`` gradient-bucketing engine
+(/root/reference/paddle/fluid/imperative/reducer.cc — InitializeGroups,
+MarkVarReady, FusedAllReduceSchedule).
+
+TPU-native redesign: **there is no reducer.** Under SPMD, parameters are
+replicated over the 'dp' mesh axis and the batch is sharded; XLA inserts one
+fused all-reduce for every gradient at compile time, already bucketed and
+overlapped with the backward pass — which is exactly what the 1122-line C++
+Reducer hand-builds at runtime. DataParallel therefore:
+- installs input sharding (batch over 'dp') via a forward pre-hook,
+- constrains parameters to replicated,
+- exposes the reference surface (scale_loss, no_sync, state_dict passthrough).
+The cross-rank gradient sync the reference does eagerly is what pjit's
+compiled backward does implicitly; the eager fallback (`apply_collective_grads`)
+pmeans grads inside a shard_map for the few users who train un-jitted.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+
+from ..nn.layer import Layer
+from ..tensor import Tensor
+from .env import get_mesh
+from .group import Group
+from .spmd import P, shard_array, with_sharding_constraint
+
+__all__ = ["DataParallel", "scale_loss"]
+
+
+def scale_loss(loss, world_size: Optional[int] = None):
+    """Parity: parallel.py scale_loss — 1/nranks scaling before backward."""
+    if world_size is None:
+        from .env import get_world_size
+
+        world_size = get_world_size()
+    if world_size <= 1:
+        return loss
+    return loss / world_size
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size: int = 25,
+                 last_comm_buffer_size: int = 1, find_unused_parameters: bool = False,
+                 group: Optional[Group] = None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self._grad_sync_enabled = True
+        mesh = get_mesh()
+        self._dp_axis = (group.axis_name if group else None) or (
+            "dp" if mesh is not None and "dp" in mesh.shape else None
+        )
+        if mesh is not None and self._dp_axis is not None:
+            # replicate parameters across dp (jax array placement)
+            for _, p in layers.named_parameters():
+                if not isinstance(p._data, jax.core.Tracer):
+                    shard_array(p, P())
+
+    def forward(self, *inputs, **kwargs):
+        mesh = get_mesh()
+        if mesh is not None and self._dp_axis is not None:
+            sharded = []
+            for x in inputs:
+                if isinstance(x, Tensor) and x.ndim >= 1 and not isinstance(x._data, jax.core.Tracer):
+                    sharded.append(shard_array(x, P(self._dp_axis)))
+                else:
+                    sharded.append(x)
+            inputs = tuple(sharded)
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Parity: DataParallel.no_sync — grads accumulate locally. Under
+        SPMD this is only meaningful for the eager shard_map path."""
+        prev = self._grad_sync_enabled
+        self._grad_sync_enabled = False
+        try:
+            yield
+        finally:
+            self._grad_sync_enabled = prev
+
+    def apply_collective_grads(self):
+        """Eager fallback ≙ fused_allreduce_gradients
+        (fleet/utils/hybrid_parallel_util.py:118): pmean every .grad over dp.
+        No-op when world is 1 or grads already synced by a jitted step."""
+        if not self._grad_sync_enabled:
+            return
+        mesh = get_mesh()
+        if mesh is None or self._dp_axis is None or mesh.shape.get(self._dp_axis, 1) <= 1:
+            return
+        from .spmd import run_on_mesh
+
+        grads = [p.grad for p in self._layers.parameters() if p.grad is not None]
+        if not grads:
+            return
+        axis = self._dp_axis
+
+        def pmean_all(*gs):
+            return tuple(jax.lax.pmean(g, axis) for g in gs)
+
+        spec = tuple(P() for _ in grads)
+        fn = run_on_mesh(pmean_all, in_specs=spec, out_specs=spec)
+        outs = fn(*[g._data for g in grads])
+        for g, o in zip(grads, outs):
+            g._set_data(o)
+
+    # surface passthrough ------------------------------------------------
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def scale_loss(self, loss):
+        return loss  # SPMD pmean handles scaling; kept for API parity
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__.get("_sub_layers", {}).get("_layers"), name)

@@ -1,0 +1,233 @@
+"""Hybrid-parallel topology.
+
+Parity: /root/reference/python/paddle/distributed/fleet/base/topology.py —
+``CommunicateTopology`` (:36, cartesian rank mesh), ``HybridCommunicateGroup``
+(:117, builds dp/mp/pp/sharding comm groups + p2p groups :225), ``ParallelMode``
+enum (:29).
+
+TPU-native: the cartesian topology IS a jax.sharding.Mesh; "creating a comm
+group" costs nothing (groups are axis names). HybridCommunicateGroup also
+*installs* the global mesh so pjit/shard_map see the same axes the user's
+Fleet config declared.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .env import init_mesh
+from .group import Group, new_group
+
+__all__ = ["ParallelMode", "CommunicateTopology", "HybridCommunicateGroup"]
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4  # sequence/context parallel (TPU-native addition)
+    EXPERT_PARALLEL = 5
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names: Sequence[str] = ("data", "pipe", "sharding", "model"),
+                 dims: Sequence[int] = (1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(*[range(d) for d in dims]))
+        self._rank2coord = {i: c for i, c in enumerate(self.coordinate)}
+        self._coord2rank = {c: i for i, c in enumerate(self.coordinate)}
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank: int):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for r, c in self._rank2coord.items() if c[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """All rank-groups that vary only along axis_name."""
+        axis = self._parallel_names.index(axis_name)
+        other = [i for i in range(len(self._dims)) if i != axis]
+        groups = []
+        for combo in itertools.product(*[range(self._dims[i]) for i in other]):
+            ranks = []
+            for v in range(self._dims[axis]):
+                coord = [0] * len(self._dims)
+                for i, o in zip(combo, other):
+                    coord[o] = i
+                coord[axis] = v
+                ranks.append(self._coord2rank[tuple(coord)])
+            groups.append(ranks)
+        return groups
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        coord = list(self.get_coord(global_rank))
+        for k, v in kwargs.items():
+            coord[self._parallel_names.index(k)] = v
+        return self._coord2rank[tuple(coord)]
+
+
+class HybridCommunicateGroup:
+    """Builds all parallel groups from degrees and installs the global mesh.
+
+    Axis order (data, pipe, sharding, sp, model) keeps 'model' innermost so
+    TP collectives ride the fastest ICI dimension.
+    """
+
+    _AXIS_TO_MESH = {"data": "dp", "pipe": "pp", "sharding": "sharding", "sep": "sp", "model": "mp"}
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None, *,
+                 dp_degree: int = 1, mp_degree: int = 1, pp_degree: int = 1,
+                 sharding_degree: int = 1, sep_degree: int = 1, rank: Optional[int] = None):
+        if topology is not None:
+            self._topo = topology
+        else:
+            names, dims = [], []
+            for n, d in (("data", dp_degree), ("pipe", pp_degree),
+                         ("sharding", sharding_degree), ("sep", sep_degree),
+                         ("model", mp_degree)):
+                names.append(n)
+                dims.append(d)
+            self._topo = CommunicateTopology(names, dims)
+        names = self._topo.get_hybrid_group_names()
+        self._dp_degree = self._topo.get_dim("data") if "data" in names else 1
+        self._mp_degree = self._topo.get_dim("model") if "model" in names else 1
+        self._pp_degree = self._topo.get_dim("pipe") if "pipe" in names else 1
+        self._sharding_degree = self._topo.get_dim("sharding") if "sharding" in names else 1
+        self._sep_degree = self._topo.get_dim("sep") if "sep" in names else 1
+
+        from .env import get_rank
+
+        self.global_rank = rank if rank is not None else get_rank()
+
+        # install the global mesh with only the >1 axes (plus dp always)
+        mesh_axes: Dict[str, int] = {}
+        for name in names:
+            mesh_name = self._AXIS_TO_MESH.get(name, name)
+            mesh_axes[mesh_name] = self._topo.get_dim(name)
+        try:
+            self.mesh = init_mesh(mesh_axes)
+        except ValueError:
+            self.mesh = None  # not enough local devices (multi-process mode)
+
+        self._dp_group = new_group(axis_name="dp")
+        self._mp_group = new_group(axis_name="mp")
+        self._pp_group = new_group(axis_name="pp")
+        self._sharding_group = new_group(axis_name="sharding")
+        self._sep_group = new_group(axis_name="sp")
+        self._check_group = Group(ranks=list(range(self._topo.world_size())))
+
+    # ------------------------------------------------------------------
+    def get_parallel_mode(self):
+        if self._mp_degree == 1 and self._pp_degree == 1 and self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        if self._mp_degree == 1 and self._pp_degree == 1:
+            return ParallelMode.DATA_PARALLEL
+        if self._mp_degree > 1 and self._pp_degree == 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    def _coord(self):
+        return self._topo.get_coord(self.global_rank)
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_rank(self):
+        return self._coord()[self._topo.get_hybrid_group_names().index("data")]
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._topo.get_axis_list("data", 0)[0] if self._dp_degree else 0
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self):
+        return self._coord()[self._topo.get_hybrid_group_names().index("model")]
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # pipeline
+    def get_stage_id(self):
+        return self._coord()[self._topo.get_hybrid_group_names().index("pipe")]
+
+    def get_pipe_parallel_rank(self):
+        return self.get_stage_id()
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    # sharding
+    def get_sharding_parallel_rank(self):
+        return self._coord()[self._topo.get_hybrid_group_names().index("sharding")]
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return 0
+
+    # sequence parallel (TPU-native addition; absent in the reference §5.7)
+    def get_sep_parallel_rank(self):
+        names = self._topo.get_hybrid_group_names()
+        return self._coord()[names.index("sep")] if "sep" in names else 0
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_check_parallel_group(self):
+        return self._check_group
+
+    def get_rank_from_stage(self, stage_id: int, **kwargs):
+        return self._topo.get_rank_from_stage(self.global_rank, pipe=stage_id, **kwargs)
