@@ -24,6 +24,7 @@ __all__ = [
     "get_mesh",
     "set_mesh",
     "init_mesh",
+    "clear_mesh",
 ]
 
 _global_mesh = None
@@ -145,6 +146,12 @@ def set_mesh(mesh):
     global _global_mesh
     _global_mesh = mesh
     return mesh
+
+
+def clear_mesh():
+    """Uninstall the global mesh (tests / re-init)."""
+    global _global_mesh
+    _global_mesh = None
 
 
 def get_mesh():

@@ -30,7 +30,7 @@ from jax.sharding import NamedSharding
 from ..nn.layer import Layer
 from ..tensor import Tensor
 from .env import get_mesh
-from .spmd import P
+from .spmd import P, sanitize_spec
 
 __all__ = ["ParallelTrainer", "build_pipeline_step"]
 
@@ -87,13 +87,14 @@ class ParallelTrainer:
         self.compute_dtype = compute_dtype
         self.recompute = recompute
         self.accumulate_steps = accumulate_steps
+        self.donate = donate
 
         # --- parameter placement ---------------------------------------
         self._param_tensors = dict(model.named_parameters())
         self._buffer_tensors = dict(model.named_buffers())
         self.param_specs: Dict[str, P] = {}
         for n, p in self._param_tensors.items():
-            spec = _spec_of(p)
+            spec = sanitize_spec(_spec_of(p), mesh)
             if self.fsdp_axis:
                 spec = _fsdp_spec(tuple(p._data.shape), self.fsdp_axis,
                                   int(mesh.shape[self.fsdp_axis]), spec)
@@ -123,6 +124,14 @@ class ParallelTrainer:
                 ),
                 "step": self.opt_state["step"],
             }
+
+        # every opt-state leaf must live on the mesh (the scalar step etc.)
+        self.opt_state = jax.tree_util.tree_map(
+            lambda a: a if (isinstance(a, jax.Array)
+                            and isinstance(a.sharding, NamedSharding))
+            else jax.device_put(jnp.asarray(a), NamedSharding(mesh, P())),
+            self.opt_state,
+        )
 
         self._jit_step = None
         self._jit_eval = None
@@ -198,18 +207,20 @@ class ParallelTrainer:
             new_params, new_opt = self.optimizer.apply_gradients(params, grads, opt_state)
             return new_params, new_opt, new_buffers, loss
 
-        in_shardings = (
-            {n: NamedSharding(mesh, s) for n, s in self.param_specs.items()},
-            None,  # opt state: keep placement as initialized
-            None,
-            NamedSharding(mesh, P(dp) if dp else P()),
-            NamedSharding(mesh, P(dp) if dp else P()),
-            None,
+        param_sh = {n: NamedSharding(mesh, s) for n, s in self.param_specs.items()}
+        opt_sh = jax.tree_util.tree_map(
+            lambda a: a.sharding if isinstance(a, jax.Array) else None,
+            self.opt_state,
         )
+        buf_sh = {n: NamedSharding(mesh, P()) for n in self.buffers}
+        batch_sh = NamedSharding(mesh, P(dp) if dp else P())
         self._jit_step = jax.jit(
             step,
-            in_shardings=in_shardings,
-            donate_argnums=(0, 1),
+            in_shardings=(param_sh, opt_sh, buf_sh, batch_sh, batch_sh, None),
+            # pin outputs to the input placements so donated buffers round-
+            # trip bit-identically across steps
+            out_shardings=(param_sh, opt_sh, buf_sh, NamedSharding(mesh, P())),
+            donate_argnums=(0, 1) if self.donate else (),
         )
 
     # ------------------------------------------------------------------

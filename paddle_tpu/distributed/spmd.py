@@ -21,7 +21,7 @@ from .env import get_mesh
 
 P = PartitionSpec
 
-__all__ = ["P", "PartitionSpec", "run_on_mesh", "shard_array", "with_sharding_constraint", "shard_tensor_to", "replicate"]
+__all__ = ["P", "PartitionSpec", "run_on_mesh", "shard_array", "sanitize_spec", "with_sharding_constraint", "shard_tensor_to", "replicate"]
 
 
 def run_on_mesh(fn: Callable, in_specs, out_specs, mesh: Optional[Mesh] = None, jit: bool = True):
@@ -49,9 +49,33 @@ def replicate(x, mesh: Optional[Mesh] = None):
     return shard_array(x, P(), mesh)
 
 
+def sanitize_spec(spec: PartitionSpec, mesh) -> PartitionSpec:
+    """Drop spec axes the mesh doesn't have (e.g. 'mp' annotations on a
+    dp-only mesh) so any model runs under any topology."""
+    axes = set(mesh.shape)
+    dims = []
+    for d in spec:
+        if d is None:
+            dims.append(None)
+        elif isinstance(d, str):
+            dims.append(d if d in axes else None)
+        else:
+            kept = tuple(a for a in d if a in axes)
+            dims.append(kept if kept else None)
+    return PartitionSpec(*dims)
+
+
 def with_sharding_constraint(x, spec: PartitionSpec, mesh: Optional[Mesh] = None):
-    """In-jit resharding hint (≙ auto_parallel shard_tensor annotation)."""
+    """In-jit resharding hint (≙ auto_parallel shard_tensor annotation).
+
+    Axes the mesh lacks are dropped from the spec (and the call is a no-op
+    without a mesh) so model code can annotate unconditionally and still
+    run under any topology.
+    """
     mesh = mesh or get_mesh()
+    if mesh is None:
+        return x
+    spec = sanitize_spec(spec, mesh)
     arr = x._data if isinstance(x, Tensor) else x
     out = jax.lax.with_sharding_constraint(arr, NamedSharding(mesh, spec))
     return Tensor(out) if isinstance(x, Tensor) else out

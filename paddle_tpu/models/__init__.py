@@ -1,0 +1,15 @@
+"""Flagship model families built on the framework's parallel layers.
+
+Parity role: the reference ships its transformer models through PaddleNLP
+on top of fleet meta-parallel layers; here the model zoo is in-tree, built
+directly on paddle_tpu.distributed.meta_parallel so every parallelism
+axis (dp/mp/pp/sharding/sp/ep) applies to each family.
+"""
+from . import gpt  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTModel,
+    GPTForPretraining,
+    GPTPretrainingCriterion,
+    gpt_config,
+)

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — the framework's hand-written kernel library.
+
+Parity role: replaces the reference's hand-written fused CUDA kernels
+(/root/reference/paddle/fluid/operators/fused/ — fused_attention_op.cu,
+fmha_ref.h, fused_dropout_helper.h) with TPU-native Pallas kernels that
+tile onto the MXU/VPU and keep working sets in VMEM.
+"""
