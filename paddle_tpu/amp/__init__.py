@@ -1,0 +1,9 @@
+"""paddle.amp parity package (O1/O2 autocast + dynamic loss scaling)."""
+from .amp_lists import BLACK_LIST, WHITE_LIST, build_lists  # noqa: F401
+from .auto_cast import amp_guard, amp_state, amp_wrap_fn, auto_cast, decorate  # noqa: F401
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
+
+__all__ = [
+    "auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler",
+    "WHITE_LIST", "BLACK_LIST",
+]

@@ -1,0 +1,48 @@
+"""AMP O1 op lists.
+
+Parity: /root/reference/python/paddle/fluid/contrib/mixed_precision/
+fp16_lists.py (white/black/gray lists consumed by rewrite_program) and the
+dygraph AmpOperators sets (/root/reference/paddle/fluid/imperative/
+amp_auto_cast.cc). Names here are this framework's op names (the function
+names wrapped by ops._primitive.primitive).
+"""
+from __future__ import annotations
+
+# Names are matched after stripping the internal "_" prefix convention.
+# ops that are numerically safe and fast in reduced precision (MXU-bound)
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "dot", "mv", "linear",
+    "conv1d", "conv2d", "conv3d", "conv_nd",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+    "einsum", "addmm", "flash", "attn", "flash_attention",
+}
+
+# numerically sensitive ops forced to float32
+BLACK_LIST = {
+    "exp", "expm1", "log", "log2", "log10", "log1p",
+    "pow", "square", "sqrt", "rsqrt", "cumprod",
+    "mean", "sum", "prod", "logsumexp",
+    "softmax", "log_softmax",
+    "cross_entropy", "nll_loss", "kl_div",
+    "sigmoid_cross_entropy_with_logits", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "softmax_with_cross_entropy",
+    "pce",  # ParallelCrossEntropy kernel
+    "layer_norm", "ln", "batch_norm", "bn_train", "bn_infer",
+    "instance_norm", "group_norm", "local_response_norm",
+    "cos_sim", "norm", "p_norm", "dist",
+    "erf", "erfinv", "lgamma", "digamma",
+}
+
+# everything else is "gray": runs in whatever dtype its inputs carry
+
+
+def build_lists(custom_white_list=None, custom_black_list=None):
+    white = set(WHITE_LIST)
+    black = set(BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    return white, black

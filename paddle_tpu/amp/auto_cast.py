@@ -1,0 +1,126 @@
+"""Eager AMP autocast.
+
+Parity: paddle.amp.auto_cast (/root/reference/python/paddle/amp/auto_cast.py)
+and the dygraph cast insertion in
+/root/reference/paddle/fluid/imperative/amp_auto_cast.cc — ``AmpLevel`` O1
+(white/black-list casting per op) and O2 (pure reduced precision except the
+black list), plus ``decorate`` for O2 model/optimizer preparation.
+
+TPU-native: the "cast op insertion" happens inside ops._primitive — each op
+asks :func:`amp_wrap_fn` for a casting wrapper, so casts are part of the
+traced computation and their VJP restores parameter-dtype gradients.
+bfloat16 is the default reduced dtype on TPU (no loss scaling needed);
+float16 is kept for parity with GradScaler.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .amp_lists import build_lists
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_state", "amp_wrap_fn"]
+
+
+class _AmpState:
+    __slots__ = ("enable", "dtype", "level", "white", "black", "version")
+
+    def __init__(self):
+        self.enable = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.white, self.black = build_lists()
+        self.version = 0  # bumped on every config change; keys the fn cache
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def _cast_tree(tree, pred, target):
+    def cast(x):
+        if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jnp.floating) and pred(x.dtype):
+            return x.astype(target)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+_wrap_cache: dict = {}  # (id(fn), version) -> wrapped fn
+
+
+def amp_wrap_fn(fn, op_name: str):
+    """Return fn wrapped with the casts AMP mandates for this op (or fn).
+
+    Wrapped fns are cached per (fn, amp-config version) to keep the eager
+    hot path free of per-call closure allocation.
+    """
+    if not _state.enable:
+        return fn
+    key = (id(fn), _state.version)
+    cached = _wrap_cache.get(key)
+    if cached is not None:
+        return cached
+    op_name = op_name.lstrip("_")  # internal primitives are _-prefixed
+    amp_dtype = _state.dtype
+    if op_name in _state.black:
+        def wrapped(*a, **k):
+            a, k = _cast_tree((a, k), lambda dt: dt in (jnp.float16, jnp.bfloat16), jnp.float32)
+            return fn(*a, **k)
+    elif _state.level == "O2" or op_name in _state.white:
+        def wrapped(*a, **k):
+            a, k = _cast_tree((a, k), lambda dt: dt == jnp.float32, amp_dtype)
+            return fn(*a, **k)
+    else:
+        wrapped = fn
+    if len(_wrap_cache) > 4096:
+        _wrap_cache.clear()
+    _wrap_cache[key] = wrapped
+    return wrapped
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=None,
+              level: str = "O1", dtype: str = "bfloat16"):
+    """paddle.amp.auto_cast parity context manager."""
+    assert level in ("O0", "O1", "O2")
+    prev = (_state.enable, _state.dtype, _state.level, _state.white, _state.black)
+    _state.enable = enable and level != "O0"
+    _state.dtype = jnp.float16 if str(dtype) in ("float16", "fp16") else jnp.bfloat16
+    _state.level = level
+    _state.white, _state.black = build_lists(custom_white_list, custom_black_list)
+    _state.version += 1
+    try:
+        yield
+    finally:
+        (_state.enable, _state.dtype, _state.level, _state.white, _state.black) = prev
+        _state.version += 1
+
+
+amp_guard = auto_cast  # fluid.dygraph.amp.amp_guard alias
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight: Optional[bool] = None, save_dtype: Optional[str] = None):
+    """O2 preparation: cast model params to the reduced dtype.
+
+    Master fp32 copies live in the optimizer slots (the jitted trainer path
+    keeps fp32 params and casts per-step instead — both parities exist).
+    """
+    target = jnp.float16 if str(dtype) in ("float16", "fp16") else jnp.bfloat16
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if jnp.issubdtype(p._data.dtype, jnp.floating):
+                    p._set_data(p._data.astype(target))
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers

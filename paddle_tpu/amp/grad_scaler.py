@@ -1,0 +1,131 @@
+"""Dynamic loss scaling.
+
+Parity: paddle.amp.GradScaler (/root/reference/python/paddle/amp/
+grad_scaler.py:26) whose device side is the check_finite_and_unscale and
+update_loss_scaling CUDA ops (/root/reference/paddle/fluid/operators/amp/).
+The scale-update state machine is identical: grow by ``incr_ratio`` after
+``incr_every_n_steps`` consecutive finite steps, shrink by ``decr_ratio``
+after ``decr_every_n_nan_or_inf`` non-finite steps (skipping the update).
+
+On TPU bf16 training needs no scaler (same exponent range as fp32); this
+exists for fp16 parity and for the jitted trainer's in-graph variant
+(ParallelTrainer use_loss_scaling).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["GradScaler", "AmpScaler"]
+
+
+class GradScaler:
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000, decr_every_n_nan_or_inf: int = 1,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    # ------------------------------------------------------------------
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._use_dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def _iter_grads(self, optimizer):
+        for p in optimizer._param_groups:
+            if p.grad is not None and not p.stop_gradient:
+                yield p
+
+    def unscale_(self, optimizer):
+        """check_finite_and_unscale parity: divide grads by the scale and
+        flag non-finite values."""
+        if not self._enable or self._unscaled:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in self._iter_grads(optimizer):
+            g = p.grad._data if isinstance(p.grad, Tensor) else p.grad
+            g = (g.astype(jnp.float32) * inv).astype(g.dtype)
+            found = found or (not bool(jnp.isfinite(g).all()))
+            p.grad = Tensor(g)
+        self._found_inf = found
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        """update_loss_scaling parity: advance the dynamic-scale machine."""
+        if not (self._enable and self._use_dynamic):
+            self._unscaled = False
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def minimize(self, optimizer, scaled_loss):  # noqa: ARG002 - loss already backpropped
+        self.step(optimizer)
+        self.update()
+
+    # ------------------------------------------------------------------
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+            "incr_count": self._good_steps,
+            "decr_count": self._bad_steps,
+            "use_dynamic_loss_scaling": self._use_dynamic,
+        }
+
+    def load_state_dict(self, state):
+        self._scale = float(state["scale"])
+        self._incr_ratio = state["incr_ratio"]
+        self._decr_ratio = state["decr_ratio"]
+        self._incr_every_n_steps = state["incr_every_n_steps"]
+        self._decr_every_n_nan_or_inf = state["decr_every_n_nan_or_inf"]
+        self._good_steps = state.get("incr_count", 0)
+        self._bad_steps = state.get("decr_count", 0)
+        self._use_dynamic = state.get("use_dynamic_loss_scaling", True)
+
+
+AmpScaler = GradScaler  # fluid.dygraph.AmpScaler alias

@@ -74,6 +74,7 @@ class ParallelTrainer:
         recompute: bool = False,
         accumulate_steps: int = 1,
         donate: bool = True,
+        scaler=None,
     ):
         self.model = model
         self.loss_fn = loss_fn
@@ -88,6 +89,18 @@ class ParallelTrainer:
         self.recompute = recompute
         self.accumulate_steps = accumulate_steps
         self.donate = donate
+
+        # in-graph dynamic loss scaling (amp ops check_finite_and_unscale +
+        # update_loss_scaling as pure functions in the jitted step)
+        self._scaler = scaler if (scaler is not None and scaler.is_enable()) else None
+        if self._scaler is not None:
+            self.scale_state = {
+                "loss_scale": jnp.asarray(scaler.get_loss_scaling(), jnp.float32),
+                "good_steps": jnp.asarray(scaler._good_steps, jnp.int32),
+                "bad_steps": jnp.asarray(scaler._bad_steps, jnp.int32),
+            }
+        else:
+            self.scale_state = {}
 
         # --- parameter placement ---------------------------------------
         self._param_tensors = dict(model.named_parameters())
@@ -173,9 +186,27 @@ class ParallelTrainer:
             # remat the forward; XLA recomputes activations in backward
             loss_fn = jax.checkpoint(loss_fn, static_argnums=())
 
-        def step(params, opt_state, buffers, xb, yb, rng_key):
+        use_scaling = self._scaler is not None
+        if use_scaling:
+            incr_every = int(self._scaler._incr_every_n_steps)
+            incr_ratio = float(self._scaler._incr_ratio)
+            decr_ratio = float(self._scaler._decr_ratio)
+            decr_every = int(self._scaler._decr_every_n_nan_or_inf)
+            dynamic = bool(self._scaler.is_use_dynamic_loss_scaling())
+
+        def step(params, opt_state, buffers, xb, yb, rng_key, scale_state):
+            scale = scale_state["loss_scale"] if use_scaling else None
+
+            base_loss_fn = loss_fn
+            if use_scaling:
+                def loss_fn_(p, b, mx, my, k):
+                    l, nb = base_loss_fn(p, b, mx, my, k)
+                    return l * scale, nb
+            else:
+                loss_fn_ = base_loss_fn
+
             if acc <= 1:
-                (loss, new_buffers), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                (loss, new_buffers), grads = jax.value_and_grad(loss_fn_, has_aux=True)(
                     params, buffers, xb, yb, rng_key
                 )
             else:
@@ -188,7 +219,7 @@ class ParallelTrainer:
                 def body(carry, mb):
                     g_acc, l_acc, bufs = carry
                     mx, my, k = mb
-                    (l, nb), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                    (l, nb), g = jax.value_and_grad(loss_fn_, has_aux=True)(
                         params, bufs, mx, my, k
                     )
                     g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
@@ -204,8 +235,38 @@ class ParallelTrainer:
                 grads = jax.tree_util.tree_map(lambda g: g / acc, grads)
                 loss = loss_sum / acc
 
-            new_params, new_opt = self.optimizer.apply_gradients(params, grads, opt_state)
-            return new_params, new_opt, new_buffers, loss
+            if use_scaling:
+                # check_finite_and_unscale
+                grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
+                loss = loss / scale
+                finite = jnp.asarray(True)
+                for g in jax.tree_util.tree_leaves(grads):
+                    finite = finite & jnp.all(jnp.isfinite(g))
+                new_params, new_opt = self.optimizer.apply_gradients(params, grads, opt_state)
+                keep = lambda new, old: jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(finite, a, b), new, old)
+                new_params = keep(new_params, params)
+                new_opt = keep(new_opt, opt_state)
+                # update_loss_scaling state machine (mirror of the eager
+                # GradScaler.update incl. static-scale + decr_every modes)
+                if dynamic:
+                    good = jnp.where(finite, scale_state["good_steps"] + 1, 0)
+                    bad = jnp.where(finite, 0, scale_state["bad_steps"] + 1)
+                    grown = jnp.where(good >= incr_every, scale * incr_ratio, scale)
+                    good = jnp.where(good >= incr_every, 0, good)
+                    shrunk = jnp.where(bad >= decr_every,
+                                       jnp.maximum(scale * decr_ratio, 1.0), scale)
+                    bad = jnp.where(bad >= decr_every, 0, bad)
+                    new_scale = jnp.where(finite, grown, shrunk)
+                    new_scale_state = {"loss_scale": new_scale,
+                                       "good_steps": good, "bad_steps": bad}
+                else:
+                    new_scale_state = scale_state
+            else:
+                new_params, new_opt = self.optimizer.apply_gradients(params, grads, opt_state)
+                new_scale_state = scale_state
+
+            return new_params, new_opt, new_buffers, loss, new_scale_state
 
         param_sh = {n: NamedSharding(mesh, s) for n, s in self.param_specs.items()}
         opt_sh = jax.tree_util.tree_map(
@@ -214,12 +275,14 @@ class ParallelTrainer:
         )
         buf_sh = {n: NamedSharding(mesh, P()) for n in self.buffers}
         batch_sh = NamedSharding(mesh, P(dp) if dp else P())
+        repl = NamedSharding(mesh, P())
+        scale_sh = {k: repl for k in self.scale_state}
         self._jit_step = jax.jit(
             step,
-            in_shardings=(param_sh, opt_sh, buf_sh, batch_sh, batch_sh, None),
+            in_shardings=(param_sh, opt_sh, buf_sh, batch_sh, batch_sh, None, scale_sh),
             # pin outputs to the input placements so donated buffers round-
             # trip bit-identically across steps
-            out_shardings=(param_sh, opt_sh, buf_sh, NamedSharding(mesh, P())),
+            out_shardings=(param_sh, opt_sh, buf_sh, repl, scale_sh),
             donate_argnums=(0, 1) if self.donate else (),
         )
 
@@ -231,8 +294,9 @@ class ParallelTrainer:
             self._build()
         xb = x._data if isinstance(x, Tensor) else jnp.asarray(x)
         yb = y._data if isinstance(y, Tensor) else jnp.asarray(y)
-        self.params, self.opt_state, self.buffers, loss = self._jit_step(
-            self.params, self.opt_state, self.buffers, xb, yb, split_key()
+        self.params, self.opt_state, self.buffers, loss, self.scale_state = self._jit_step(
+            self.params, self.opt_state, self.buffers, xb, yb, split_key(),
+            self.scale_state,
         )
         return Tensor(loss)
 
@@ -255,6 +319,15 @@ class ParallelTrainer:
             self._param_tensors[n]._set_data(arr)
         for n, arr in self.buffers.items():
             self._buffer_tensors[n]._set_data(arr)
+        self.sync_scaler()
+
+    def sync_scaler(self):
+        """Write the in-graph scale state back into the GradScaler so its
+        state_dict()/get_loss_scaling() reflect training (checkpointing)."""
+        if self._scaler is not None and self.scale_state:
+            self._scaler._scale = float(self.scale_state["loss_scale"])
+            self._scaler._good_steps = int(self.scale_state["good_steps"])
+            self._scaler._bad_steps = int(self.scale_state["bad_steps"])
 
 
 def build_pipeline_step(pipe_layer, hcg, optimizer, accumulate_steps: int = 1, scaler=None):
@@ -268,6 +341,7 @@ def build_pipeline_step(pipe_layer, hcg, optimizer, accumulate_steps: int = 1, s
         lambda out, y: loss_fn(out, y),
         optimizer,
         accumulate_steps=accumulate_steps,
+        scaler=scaler,
     )
 
     def run(x, y):

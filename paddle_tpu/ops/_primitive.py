@@ -20,6 +20,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
+from ..amp.auto_cast import amp_state, amp_wrap_fn
 from ..autograd import tape
 from ..tensor import Tensor
 
@@ -62,6 +63,11 @@ def primitive(fn: Callable = None, *, nondiff: bool = False, aux: int = 0, name:
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
+        # AMP autocast hook (≙ dygraph amp_auto_cast.cc cast insertion):
+        # the casting wrapper keeps casts inside the traced fn so their VJP
+        # restores parameter-dtype gradients
+        fn_ = amp_wrap_fn(fn, op_name) if amp_state().enable else fn
+
         flat, treedef, tensor_pos = _flatten_call(args, kwargs)
         in_tensors = [flat[i] for i in tensor_pos]
 
@@ -79,7 +85,7 @@ def primitive(fn: Callable = None, *, nondiff: bool = False, aux: int = 0, name:
             for i in tensor_pos:
                 flat2[i] = flat[i]._data
             a2, k2 = jax.tree_util.tree_unflatten(treedef, flat2)
-            out = fn(*a2, **k2)
+            out = fn_(*a2, **k2)
             return jax.tree_util.tree_map(wrap, out)
 
         # differentiate w.r.t. floating tensors that require grad; others are
@@ -99,7 +105,7 @@ def primitive(fn: Callable = None, *, nondiff: bool = False, aux: int = 0, name:
             for i, a in zip(diff_pos, diff_arrs):
                 flat2[i] = a
             a2, k2 = jax.tree_util.tree_unflatten(treedef, flat2)
-            out = fn(*a2, **k2)
+            out = fn_(*a2, **k2)
             if aux:
                 outs = out if isinstance(out, tuple) else (out,)
                 return outs[:-aux] if len(outs) - aux > 1 else outs[0], outs[-aux:]

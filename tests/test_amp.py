@@ -1,0 +1,150 @@
+"""AMP: autocast O1/O2, GradScaler state machine, in-graph loss scaling.
+
+Parity: reference AMP tests (test_amp_check_finite_and_scale_op.py,
+test_update_loss_scaling_op.py, test_imperative_auto_mixed_precision.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import amp
+
+
+def test_autocast_o1_white_black():
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    w = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        y = paddle.matmul(x, w)       # white -> bf16
+        s = paddle.nn.functional.softmax(y)  # black -> fp32
+    assert str(y.dtype).endswith("bfloat16")
+    assert str(s.dtype).endswith("float32")
+    # outside the context nothing is cast
+    y2 = paddle.matmul(x, w)
+    assert str(y2.dtype).endswith("float32")
+
+
+def test_autocast_grads_restore_param_dtype():
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    lin = nn.Linear(8, 2)
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = lin(x)
+        loss = out.sum()
+    loss.backward()
+    g = lin.weight.grad
+    assert g is not None
+    assert str(g._data.dtype if hasattr(g, "_data") else g.dtype).endswith("float32")
+
+
+def test_autocast_o2():
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    with amp.auto_cast(level="O2", dtype="bfloat16"):
+        y = x * 2.0 + 1.0  # gray op, O2 casts anyway
+    assert str(y.dtype).endswith("bfloat16")
+
+
+def test_decorate_o2_casts_params():
+    lin = nn.Linear(8, 2)
+    amp.decorate(lin, level="O2", dtype="bfloat16")
+    assert str(lin.weight._data.dtype) == "bfloat16"
+
+
+def test_grad_scaler_state_machine():
+    sc = amp.GradScaler(init_loss_scaling=8.0, incr_ratio=2.0, decr_ratio=0.5,
+                        incr_every_n_steps=2, decr_every_n_nan_or_inf=1)
+    # two finite steps -> grow
+    sc._found_inf = False; sc.update()
+    assert sc.get_loss_scaling() == 8.0
+    sc._found_inf = False; sc.update()
+    assert sc.get_loss_scaling() == 16.0
+    # one inf step -> shrink immediately
+    sc._found_inf = True; sc.update()
+    assert sc.get_loss_scaling() == 8.0
+    # state dict round trip
+    st = sc.state_dict()
+    sc2 = amp.GradScaler()
+    sc2.load_state_dict(st)
+    assert sc2.get_loss_scaling() == 8.0
+
+
+def test_grad_scaler_eager_step_skips_on_inf():
+    from paddle_tpu.optimizer.optimizers import SGD
+
+    lin = nn.Linear(4, 2)
+    opt = SGD(learning_rate=0.1, parameters=lin.parameters())
+    sc = amp.GradScaler(init_loss_scaling=4.0)
+    w0 = np.asarray(lin.weight._data).copy()
+
+    x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+    loss = sc.scale(lin(x).sum())
+    loss.backward()
+    # poison a gradient with inf
+    import jax.numpy as jnp
+    lin.weight.grad = paddle.Tensor(jnp.full_like(lin.weight.grad._data, jnp.inf))
+    sc.step(opt)
+    sc.update()
+    np.testing.assert_array_equal(np.asarray(lin.weight._data), w0)  # skipped
+    assert sc.get_loss_scaling() == 2.0  # shrunk
+
+
+def test_grad_scaler_eager_unscales():
+    from paddle_tpu.optimizer.optimizers import SGD
+
+    lin = nn.Linear(4, 1)
+    opt = SGD(learning_rate=0.0, parameters=lin.parameters())
+    sc = amp.GradScaler(init_loss_scaling=4.0)
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    loss = sc.scale(lin(x).sum())
+    loss.backward()
+    sc.unscale_(opt)
+    # d(sum(xW+b))/dW = sum over batch of x = 2s; scaled by 4 then unscaled
+    np.testing.assert_allclose(np.asarray(lin.weight.grad._data),
+                               np.full((4, 1), 2.0), rtol=1e-6)
+
+
+def test_trainer_in_graph_loss_scaling():
+    from paddle_tpu.distributed.env import init_mesh, clear_mesh
+    from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+    from paddle_tpu.optimizer.optimizers import AdamW
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 8))
+    loss_fn = lambda out, y: ((out - y) ** 2).mean()
+    init_mesh({"dp": 1})
+    try:
+        opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+        sc = amp.GradScaler(init_loss_scaling=1024.0, incr_every_n_steps=3)
+        tr = ParallelTrainer(model, loss_fn, opt, dp_axis=None, scaler=sc)
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        losses = [float(tr.step(x, y)._data) for _ in range(6)]
+        assert losses[-1] < losses[0]
+        # after 6 finite steps with incr_every=3, scale grew twice
+        assert float(tr.scale_state["loss_scale"]) == 4096.0
+        # sync back into the scaler for checkpointing
+        tr.sync_to_model()
+        assert sc.get_loss_scaling() == 4096.0
+    finally:
+        clear_mesh()
+
+
+def test_trainer_static_loss_scaling_stays_fixed():
+    from paddle_tpu.distributed.env import init_mesh, clear_mesh
+    from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+    from paddle_tpu.optimizer.optimizers import SGD
+
+    paddle.seed(0)
+    model = nn.Linear(4, 4)
+    init_mesh({"dp": 1})
+    try:
+        opt = SGD(learning_rate=1e-2, parameters=model.parameters())
+        sc = amp.GradScaler(init_loss_scaling=128.0, incr_every_n_steps=1,
+                            use_dynamic_loss_scaling=False)
+        tr = ParallelTrainer(model, lambda o, y: ((o - y) ** 2).mean(), opt,
+                             dp_axis=None, scaler=sc)
+        x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+        for _ in range(3):
+            tr.step(x, x)
+        assert float(tr.scale_state["loss_scale"]) == 128.0
+    finally:
+        clear_mesh()
