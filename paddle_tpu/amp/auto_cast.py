@@ -52,18 +52,24 @@ def _cast_tree(tree, pred, target):
     return jax.tree_util.tree_map(cast, tree)
 
 
-_wrap_cache: dict = {}  # (id(fn), version) -> wrapped fn
+_wrap_cache: dict = {}  # id(fn) -> wrapped fn, valid for _wrap_cache_version
+_wrap_cache_version: int = -1
 
 
 def amp_wrap_fn(fn, op_name: str):
     """Return fn wrapped with the casts AMP mandates for this op (or fn).
 
-    Wrapped fns are cached per (fn, amp-config version) to keep the eager
-    hot path free of per-call closure allocation.
+    Wrapped fns are cached per fn for the current amp-config version; a
+    version bump (auto_cast enter/exit) resets the cache wholesale so stale
+    entries die immediately and hot entries rebuild once.
     """
+    global _wrap_cache_version
     if not _state.enable:
         return fn
-    key = (id(fn), _state.version)
+    if _wrap_cache_version != _state.version:
+        _wrap_cache.clear()
+        _wrap_cache_version = _state.version
+    key = id(fn)
     cached = _wrap_cache.get(key)
     if cached is not None:
         return cached
@@ -80,6 +86,7 @@ def amp_wrap_fn(fn, op_name: str):
     else:
         wrapped = fn
     if len(_wrap_cache) > 4096:
+        # bound growth from per-call-defined closures (fresh id(fn) each call)
         _wrap_cache.clear()
     _wrap_cache[key] = wrapped
     return wrapped

@@ -63,13 +63,13 @@ class GradScaler:
         if not self._enable or self._unscaled:
             return
         inv = 1.0 / self._scale
-        found = False
+        finite = jnp.asarray(True)  # accumulate on-device; one host sync below
         for p in self._iter_grads(optimizer):
             g = p.grad._data if isinstance(p.grad, Tensor) else p.grad
             g = (g.astype(jnp.float32) * inv).astype(g.dtype)
-            found = found or (not bool(jnp.isfinite(g).all()))
+            finite = finite & jnp.isfinite(g).all()
             p.grad = Tensor(g)
-        self._found_inf = found
+        self._found_inf = not bool(finite)
         self._unscaled = True
 
     def step(self, optimizer):

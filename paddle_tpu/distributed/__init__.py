@@ -5,6 +5,7 @@ fleet/, launch, spawn, ParallelEnv) re-grounded on one jax.sharding.Mesh.
 """
 from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
 from .collective import (  # noqa: F401
     all_gather,
     all_reduce,

@@ -9,6 +9,7 @@ from .mp_layers import (  # noqa: F401
     RowParallelLinear,
     VocabParallelEmbedding,
 )
+from .moe_layer import ExpertFFN, MoELayer, top_k_gating  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
 from .tensor_parallel import TensorParallel  # noqa: F401

@@ -1,0 +1,76 @@
+"""paddle.distributed.utils parity — MoE all-to-all primitives.
+
+Parity: the reference's ``global_scatter``/``global_gather`` ops
+(/root/reference/paddle/fluid/operators/collective/global_scatter_op.cc:19-28,
+global_scatter_op.cu.cc; python surface
+/root/reference/python/paddle/distributed/utils.py) — the expert-parallel
+dispatch pair that routes rows of ``x`` to the ranks owning each expert and
+back.
+
+TPU-native redesign: the reference sends *variable* per-expert row counts
+(local_count/global_count) over NCCL. XLA requires static shapes, so the
+TPU-native form is the GShard capacity-padded layout: ``x`` is
+``[n_expert_global * capacity, d]`` ordered by global expert id, and the
+exchange is one ``lax.all_to_all`` over the 'ep' mesh axis. ``local_count`` /
+``global_count`` are accepted for API parity and may be used for masking by
+callers; the exchange itself is count-free.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from jax import lax
+
+from ...ops._primitive import unwrap as _unwrap
+from ...tensor import Tensor
+from ..collective import _axis_bound as _bound
+from ..group import Group, get_default_group
+
+__all__ = ["global_scatter", "global_gather"]
+
+EP_AXIS = "ep"
+
+
+def _axis(group: Optional[Group]):
+    if group is not None and group.axis_name:
+        return group.axis_name
+    return EP_AXIS
+
+
+def _exchange(x, axis_name):
+    """One tiled all_to_all on the leading (global-expert) dimension.
+
+    Input rows on each shard are grouped by destination rank (outer) —
+    ``[world * rows_per_rank, d]``; output rows are grouped by source rank.
+    This single collective is both global_scatter and global_gather (the op
+    is an involution up to the grouping dimension's meaning).
+    """
+    n = lax.axis_size(axis_name)
+    if x.shape[0] % n != 0:
+        raise ValueError(
+            f"global_scatter/gather input leading dim {x.shape[0]} must divide "
+            f"the expert-parallel world size {n} (capacity-padded layout)"
+        )
+    return lax.all_to_all(
+        x.reshape((n, x.shape[0] // n) + x.shape[1:]),
+        axis_name, split_axis=0, concat_axis=0, tiled=True,
+    ).reshape(x.shape)
+
+
+def global_scatter(x, local_count=None, global_count=None, group: Optional[Group] = None, use_calc_stream: bool = True):
+    """Route expert-grouped rows to the ranks owning each expert."""
+    arr = _unwrap(x)
+    axis_name = _axis(group)
+    if _bound(axis_name):
+        out = _exchange(arr, axis_name)
+        return Tensor(out) if isinstance(x, Tensor) else out
+    g = group or get_default_group()
+    if g is None or g.nranks <= 1:
+        return x
+    raise RuntimeError("eager global_scatter over a >1 group requires a mesh context")
+
+
+def global_gather(x, local_count=None, global_count=None, group: Optional[Group] = None, use_calc_stream: bool = True):
+    """Inverse of :func:`global_scatter` — return expert outputs to the ranks
+    that dispatched the corresponding tokens."""
+    return global_scatter(x, global_count, local_count, group, use_calc_stream)

@@ -60,6 +60,12 @@ class GPTConfig:
     attention_dropout_prob: float = 0.1
     layer_norm_epsilon: float = 1e-5
     use_recompute: bool = False
+    # MoE (ERNIE-MoE analog, BASELINE #5): 0 experts = dense model
+    num_experts: int = 0
+    moe_every: int = 2  # every moe_every-th block uses an MoE FFN
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 0.01
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -88,6 +94,11 @@ GPT_CONFIGS = {
                       num_attention_heads=32, max_position_embeddings=2048),
     "gpt3-6.7b": dict(vocab_size=50304, hidden_size=4096, num_layers=32,
                       num_attention_heads=32, max_position_embeddings=2048),
+    # ERNIE-3.0-style MoE (BASELINE #5): dense backbone + 64 experts every
+    # other layer, expert-parallel over the 'ep' mesh axis
+    "ernie-moe-base": dict(vocab_size=50304, hidden_size=768, num_layers=12,
+                           num_attention_heads=12, max_position_embeddings=2048,
+                           num_experts=64, moe_every=2),
 }
 
 
@@ -156,17 +167,32 @@ class GPTMLP(Layer):
 
 
 class GPTDecoderLayer(Layer):
-    """Pre-LN decoder block: x + attn(ln1(x)); x + mlp(ln2(x))."""
+    """Pre-LN decoder block: x + attn(ln1(x)); x + mlp(ln2(x)).
 
-    def __init__(self, config: GPTConfig):
+    With ``config.num_experts > 0``, every ``moe_every``-th block swaps the
+    dense MLP for an expert-parallel :class:`MoELayer` (all2all over 'ep').
+    """
+
+    def __init__(self, config: GPTConfig, layer_idx: int = 0):
         super().__init__()
         self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
         self.attn = GPTAttention(config)
         self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
-        self.mlp = GPTMLP(config)
+        self.is_moe = (config.num_experts > 0
+                       and (layer_idx + 1) % max(config.moe_every, 1) == 0)
+        if self.is_moe:
+            from ..distributed.meta_parallel.moe_layer import MoELayer
+
+            self.mlp = MoELayer(
+                config.hidden_size, config.intermediate_size, config.num_experts,
+                top_k=config.moe_top_k, capacity_factor=config.moe_capacity_factor)
+        else:
+            self.mlp = GPTMLP(config)
         self.dropout1 = Dropout(config.hidden_dropout_prob, mode="upscale_in_train")
         self.dropout2 = Dropout(config.hidden_dropout_prob, mode="upscale_in_train")
-        self._use_recompute = config.use_recompute
+        # remat of an MoE block would trap l_aux inside the checkpoint trace,
+        # so MoE blocks always run un-rematerialized
+        self._use_recompute = config.use_recompute and not self.is_moe
 
     def _block(self, x):
         x = x + self.dropout1(self.attn(self.ln_1(x)))
@@ -215,7 +241,7 @@ class GPTModel(Layer):
         super().__init__()
         self.config = config
         self.embeddings = GPTEmbeddings(config)
-        self.h = LayerList([GPTDecoderLayer(config) for _ in range(config.num_layers)])
+        self.h = LayerList([GPTDecoderLayer(config, i) for i in range(config.num_layers)])
         self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
 
     def forward(self, input_ids, position_ids=None):
@@ -223,6 +249,17 @@ class GPTModel(Layer):
         for block in self.h:
             x = block(x)
         return self.ln_f(x)
+
+    def aux_loss(self):
+        """Sum of MoE load-balancing losses from the latest forward (same
+        trace), pre-scaled by ``moe_aux_loss_weight``; 0.0 for dense models."""
+        total = None
+        for block in self.h:
+            if getattr(block, "is_moe", False) and block.mlp.l_aux is not None:
+                total = block.mlp.l_aux if total is None else total + block.mlp.l_aux
+        if total is None:
+            return 0.0
+        return total * self.config.moe_aux_loss_weight
 
 
 class GPTForPretraining(Layer):
@@ -243,6 +280,9 @@ class GPTForPretraining(Layer):
             return jnp.matmul(h, w.T)
 
         return _logits(x, w)
+
+    def aux_loss(self):
+        return self.gpt.aux_loss()
 
 
 class GPTPretrainingCriterion(Layer):

@@ -1,0 +1,198 @@
+"""MoE / expert-parallel tests on the 8-virtual-device mesh.
+
+Parity: the reference's global_scatter/global_gather collective ops
+(operators/collective/global_scatter_op.cc) and MoE dispatch — here verified
+as: all_to_all roundtrip identity, expert-parallel MoE == single-shard MoE
+with the same weights, and gating invariants (capacity, combine weights).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import P
+from paddle_tpu.distributed.meta_parallel.moe_layer import (
+    MoELayer,
+    _stacked_ffn,
+    top_k_gating,
+)
+from paddle_tpu.distributed.utils import global_gather, global_scatter
+
+
+@pytest.fixture
+def ep_mesh():
+    dist.init_mesh({"ep": 8})
+    yield
+    dist.env._global_mesh = None
+
+
+class TestGlobalScatterGather:
+    def test_roundtrip_identity(self, ep_mesh):
+        g = dist.new_group(axis_name="ep")
+
+        def fn(x):
+            return global_gather(global_scatter(x, group=g), group=g)
+
+        f = dist.run_on_mesh(fn, in_specs=P("ep"), out_specs=P("ep"))
+        x = np.random.randn(8 * 16, 4).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(f(x)), x, rtol=1e-6)
+
+    def test_scatter_routes_rows(self, ep_mesh):
+        # each shard sends row-block i to rank i; after scatter, shard r
+        # holds everyone's block r (grouped by source)
+        g = dist.new_group(axis_name="ep")
+        f = dist.run_on_mesh(
+            lambda x: global_scatter(x, group=g), in_specs=P("ep"), out_specs=P("ep")
+        )
+        # global input: shard r holds rows [r*8, (r+1)*8); value = 100*src + dst_block
+        x = np.zeros((64, 1), np.float32)
+        for src in range(8):
+            for dst in range(8):
+                x[src * 8 + dst] = 100 * src + dst
+        out = np.asarray(f(x))
+        for dst in range(8):
+            for src in range(8):
+                assert out[dst * 8 + src, 0] == 100 * src + dst
+
+    def test_world1_noop(self):
+        x = paddle.to_tensor(np.ones((4, 2), np.float32))
+        out = global_scatter(x)
+        np.testing.assert_allclose(np.asarray(out._data), 1.0)
+
+
+class TestGating:
+    def test_capacity_respected(self):
+        logits = jnp.asarray(np.random.randn(32, 4).astype(np.float32))
+        combine, dispatch, l_aux = top_k_gating(logits, 2, 4, 4)
+        assert combine.shape == (32, 4, 4)
+        # no capacity slot double-booked
+        per_slot = jnp.sum(dispatch.astype(jnp.int32), axis=0)
+        assert int(per_slot.max()) <= 1
+        assert float(l_aux) > 0
+
+    def test_top1_weights_are_gate_probs(self):
+        logits = jnp.asarray(np.random.randn(8, 4).astype(np.float32))
+        gates = jax.nn.softmax(logits, axis=-1)
+        combine, dispatch, _ = top_k_gating(logits, 1, 8, 4)
+        w = jnp.sum(combine, axis=(1, 2))
+        np.testing.assert_allclose(np.asarray(w), np.asarray(gates.max(axis=-1)), rtol=1e-6)
+
+    def test_top2_weights_normalized(self):
+        logits = jnp.asarray(np.random.randn(8, 4).astype(np.float32))
+        combine, _, _ = top_k_gating(logits, 2, 8, 4)
+        w = jnp.sum(combine, axis=(1, 2))
+        np.testing.assert_allclose(np.asarray(w), 1.0, rtol=1e-5)
+
+
+class TestMoELayer:
+    def test_single_shard_forward_backward(self):
+        paddle.seed(0)
+        layer = MoELayer(16, 32, 4, top_k=2, capacity_factor=2.0)
+        x = paddle.to_tensor(np.random.randn(2, 8, 16).astype(np.float32), stop_gradient=False)
+        out = layer(x)
+        assert tuple(out.shape) == (2, 8, 16)
+        loss = (out * out).mean() + layer.l_aux * 0.01
+        loss.backward()
+        assert layer.gate_weight.grad is not None
+        assert layer.experts.w1.grad is not None
+
+    def test_expert_parallel_matches_single_shard(self, ep_mesh):
+        """EP-sharded MoE == local MoE with the same weights (tokens replicated)."""
+        paddle.seed(0)
+        e, m, h, cap_f = 8, 16, 32, 8.0  # capacity ample so nothing drops
+        layer = MoELayer(m, h, e, top_k=2, capacity_factor=cap_f)
+        x = np.random.randn(8, m).astype(np.float32)  # 8 tokens, 1 per shard
+
+        # reference: single-shard forward on full weights
+        ref = np.asarray(layer(paddle.to_tensor(x))._data)
+
+        gw = np.asarray(layer.gate_weight._data)
+        w1 = np.asarray(layer.experts.w1._data)
+        b1 = np.asarray(layer.experts.b1._data)
+        w2 = np.asarray(layer.experts.w2._data)
+        b2 = np.asarray(layer.experts.b2._data)
+
+        def fn(x, gw, w1, b1, w2, b2):
+            from paddle_tpu.tensor import Tensor
+
+            layer.gate_weight._set_data(gw)
+            layer.experts.w1._set_data(w1)
+            layer.experts.b1._set_data(b1)
+            layer.experts.w2._set_data(w2)
+            layer.experts.b2._set_data(b2)
+            with paddle.no_grad():
+                return layer(Tensor(x))._data
+
+        f = dist.run_on_mesh(
+            fn,
+            in_specs=(P("ep"), P(), P("ep"), P("ep"), P("ep"), P("ep")),
+            out_specs=P("ep"),
+        )
+        out = np.asarray(f(x, gw, w1, b1, w2, b2))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_gspmd_pjit_path(self, ep_mesh):
+        """GSPMD path: jit the layer with ep-sharded expert weights."""
+        paddle.seed(0)
+        layer = MoELayer(16, 32, 8, top_k=1, capacity_factor=4.0)
+        x = np.random.randn(4, 16).astype(np.float32)
+        ref = np.asarray(layer(paddle.to_tensor(x))._data)
+
+        mesh = dist.get_mesh()
+        from jax.sharding import NamedSharding
+
+        arrs = {
+            "gw": layer.gate_weight._data,
+            "w1": jax.device_put(layer.experts.w1._data, NamedSharding(mesh, P("ep", None, None))),
+            "b1": jax.device_put(layer.experts.b1._data, NamedSharding(mesh, P("ep", None))),
+            "w2": jax.device_put(layer.experts.w2._data, NamedSharding(mesh, P("ep", None, None))),
+            "b2": jax.device_put(layer.experts.b2._data, NamedSharding(mesh, P("ep", None))),
+        }
+
+        @jax.jit
+        def f(a, x):
+            import paddle_tpu.distributed.meta_parallel.moe_layer as ml
+
+            g = x @ a["gw"]
+            combine, dispatch, _ = ml.top_k_gating(g, 1, layer._capacity(x.shape[0]), 8)
+            xin = jnp.einsum("gec,gm->ecm", dispatch.astype(x.dtype), x)
+            out = _stacked_ffn(xin, a["w1"], a["b1"], a["w2"], a["b2"], jax.nn.gelu)
+            return jnp.einsum("gec,ecm->gm", combine.astype(x.dtype), out)
+
+        out = np.asarray(f(arrs, x))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+class TestMoEGPT:
+    def test_moe_gpt_trains(self):
+        """ERNIE-MoE analog: GPT with MoE FFN blocks converges eagerly."""
+        from paddle_tpu.models.gpt import (
+            GPTForPretraining,
+            GPTPretrainingCriterion,
+            gpt_config,
+        )
+        from paddle_tpu.optimizer.optimizers import AdamW
+
+        paddle.seed(0)
+        cfg = gpt_config(
+            "ernie-moe-base", vocab_size=128, hidden_size=64, num_layers=2,
+            num_attention_heads=4, max_position_embeddings=64,
+            hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+            num_experts=4, moe_every=2, moe_capacity_factor=2.0)
+        model = GPTForPretraining(cfg)
+        assert model.gpt.h[1].is_moe and not model.gpt.h[0].is_moe
+        crit = GPTPretrainingCriterion()
+        opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
+        ids = paddle.to_tensor(
+            np.random.default_rng(0).integers(0, 128, (4, 16)).astype("int32"))
+        losses = []
+        for _ in range(8):
+            logits = model(ids)
+            loss = crit(logits, ids) + model.aux_loss()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss._data))
+        assert losses[-1] < losses[0], losses
