@@ -11,5 +11,11 @@ from .mp_layers import (  # noqa: F401
 )
 from .moe_layer import ExpertFFN, MoELayer, top_k_gating  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .sequence_parallel import (  # noqa: F401
+    gather_sequence,
+    ring_attention,
+    split_sequence,
+    ulysses_attention,
+)
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
 from .tensor_parallel import TensorParallel  # noqa: F401

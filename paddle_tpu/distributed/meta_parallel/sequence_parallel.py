@@ -1,0 +1,165 @@
+"""Sequence/context parallelism: ring attention + Ulysses all2all.
+
+The reference has NO sequence parallelism (SURVEY §5.7 — repo-wide grep for
+ring attention / context parallel / Ulysses finds nothing; long sequences
+rely on TP+PP+recompute only). This subsystem is a required TPU-native
+addition: long-context attention sharded over the 'sp' mesh axis.
+
+Two schemes, both SPMD-explicit (run inside shard_map with 'sp' bound):
+
+- **Ring attention** (`ring_attention`): K/V blocks rotate around the ring
+  via ``lax.ppermute`` while each shard's Q stays put; an online-softmax
+  (flash-attention style running max/sum in f32) accumulates exact attention
+  over the full sequence with O(T/n) memory per chip and comm overlapped by
+  XLA. Causal masking uses global token positions, so shard boundaries are
+  exact.
+- **Ulysses** (`ulysses_attention`): one ``lax.all_to_all`` re-shards
+  sequence→heads ([B, H, T/n, D] → [B, H/n, T, D]), full attention runs
+  locally per head group (dispatching to the Pallas flash kernel on TPU),
+  then the inverse all2all restores sequence sharding. Head count must
+  divide the sp degree. This reuses the same all2all machinery the MoE
+  layer uses (the reference expresses its all2all as global_scatter/
+  global_gather — SURVEY §5.7 notes SP should reuse it).
+
+Both are pure-jax functions differentiable end-to-end (ppermute/all_to_all
+have exact transposes), exposed eagerly through ``@primitive`` wrappers.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops._primitive import primitive, unwrap
+from ..collective import _axis_bound
+
+__all__ = [
+    "ring_attention",
+    "ulysses_attention",
+    "sp_axis_bound",
+    "split_sequence",
+    "gather_sequence",
+    "SP_AXIS",
+]
+
+SP_AXIS = "sp"
+_NEG = -1e9  # finite mask value — avoids -inf NaNs in the online softmax
+
+
+def sp_axis_bound(axis: str = SP_AXIS) -> bool:
+    return _axis_bound(axis)
+
+
+def split_sequence(x, axis_name: str = SP_AXIS, seq_axis: int = 1):
+    """Keep this shard's sequence slice (explicit-SPMD entry helper)."""
+    arr = unwrap(x)
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    size = arr.shape[seq_axis] // n
+    return lax.dynamic_slice_in_dim(arr, idx * size, size, axis=seq_axis)
+
+
+def gather_sequence(x, axis_name: str = SP_AXIS, seq_axis: int = 1):
+    """All-gather sequence shards back to the full sequence."""
+    return lax.all_gather(unwrap(x), axis_name, axis=seq_axis, tiled=True)
+
+
+def _ring_attention_raw(q, k, v, axis_name: str, causal: bool, sm_scale: Optional[float]):
+    """q,k,v: [B, H, T_loc, D] — this shard's contiguous sequence block."""
+    orig_dtype = q.dtype
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, t_loc, d = q.shape
+    qf = q.astype(jnp.float32) * scale
+
+    q_pos = my * t_loc + jnp.arange(t_loc)  # global positions of local queries
+
+    perm = [(i, (i + 1) % n) for i in range(n)]  # ring: shard i -> i+1
+
+    def step(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        src = (my - i) % n  # whose K/V block we hold at step i
+        logits = jnp.einsum("bhtd,bhsd->bhts", qf, k_blk.astype(jnp.float32))
+        if causal:
+            k_pos = src * t_loc + jnp.arange(t_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask, logits, _NEG)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhts,bhsd->bhtd", p, v_blk.astype(jnp.float32))
+        # rotate K/V around the ring for the next step (last rotation is a
+        # no-op consumer but keeps the loop shape-uniform)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return o, m_new, l, k_blk, v_blk
+
+    o0 = jnp.zeros((b, h, t_loc, d), jnp.float32)
+    m0 = jnp.full((b, h, t_loc), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, t_loc), jnp.float32)
+    o, m, l, _, _ = lax.fori_loop(0, n, step, (o0, m0, l0, k, v), unroll=True)
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(orig_dtype)
+
+
+def ring_attention(q, k, v, *, axis_name: str = SP_AXIS, causal: bool = False,
+                   sm_scale: Optional[float] = None):
+    """Exact attention over the ring-sharded sequence. Eager/taped wrapper."""
+
+    @primitive
+    def _ring(q, k, v):
+        return _ring_attention_raw(q, k, v, axis_name, causal, sm_scale)
+
+    return _ring(q, k, v)
+
+
+def _local_full_attention(q, k, v, causal: bool, scale: float):
+    """Plain XLA attention used inside Ulysses (flash kernel on TPU)."""
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        on_tpu = False
+    t, s, dd = q.shape[-2], k.shape[-2], q.shape[-1]
+    if on_tpu and t % 128 == 0 and s % 128 == 0 and dd % 128 == 0 and t >= 512:
+        from ...ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, sm_scale=scale)
+    logits = jnp.einsum("bhtd,bhsd->bhts", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, s), bool), k=s - t)
+        logits = jnp.where(mask, logits, _NEG)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhts,bhsd->bhtd", w, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _ulysses_raw(q, k, v, axis_name: str, causal: bool, sm_scale: Optional[float]):
+    """q,k,v: [B, H, T_loc, D] sequence-sharded → heads-sharded full-T attention."""
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    n = lax.axis_size(axis_name)
+    if q.shape[1] % n != 0:
+        raise ValueError(f"num_heads {q.shape[1]} must divide sp degree {n} for Ulysses")
+    # sequence→head re-shard: split heads, concat sequence
+    a2a = partial(lax.all_to_all, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True)
+    qh, kh, vh = a2a(q), a2a(k), a2a(v)  # [B, H/n, T, D]
+    out = _local_full_attention(qh, kh, vh, causal, scale)
+    # head→sequence re-shard back
+    return lax.all_to_all(out, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = SP_AXIS, causal: bool = False,
+                      sm_scale: Optional[float] = None):
+    """Ulysses all2all sequence-parallel attention. Eager/taped wrapper."""
+
+    @primitive
+    def _ulysses(q, k, v):
+        return _ulysses_raw(q, k, v, axis_name, causal, sm_scale)
+
+    return _ulysses(q, k, v)

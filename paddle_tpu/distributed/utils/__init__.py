@@ -48,8 +48,9 @@ def _exchange(x, axis_name):
     n = lax.axis_size(axis_name)
     if x.shape[0] % n != 0:
         raise ValueError(
-            f"global_scatter/gather input leading dim {x.shape[0]} must divide "
-            f"the expert-parallel world size {n} (capacity-padded layout)"
+            f"global_scatter/gather input leading dim {x.shape[0]} must be "
+            f"divisible by the expert-parallel world size {n} "
+            f"(capacity-padded layout)"
         )
     return lax.all_to_all(
         x.reshape((n, x.shape[0] // n) + x.shape[1:]),

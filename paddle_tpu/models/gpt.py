@@ -66,6 +66,9 @@ class GPTConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_weight: float = 0.01
+    # long-context sequence parallelism over the 'sp' mesh axis (explicit
+    # shard_map mode): "none" | "ring" | "ulysses"
+    sequence_parallel: str = "none"
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -132,6 +135,7 @@ class GPTAttention(Layer):
         self.num_heads = config.num_attention_heads
         self.head_dim = config.head_dim
         self.dropout_p = config.attention_dropout_prob
+        self.sequence_parallel = config.sequence_parallel
         h = config.hidden_size
         self.qkv_proj = ColumnParallelLinear(h, 3 * h, gather_output=False)
         self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
@@ -142,6 +146,27 @@ class GPTAttention(Layer):
         qkv = manip.reshape(qkv, [b, t, 3, self.num_heads, self.head_dim])
         qkv = manip.transpose(qkv, [2, 0, 3, 1, 4])  # [3, B, H, T, D]
         q, k, v = qkv[0], qkv[1], qkv[2]
+        if self.sequence_parallel != "none":
+            from ..distributed.meta_parallel.sequence_parallel import (
+                ring_attention,
+                sp_axis_bound,
+                ulysses_attention,
+            )
+
+            if sp_axis_bound():
+                # x is this shard's sequence slice [B, T/n, H]; attention
+                # spans the full sequence via ring ppermute / Ulysses a2a
+                if self.training and self.dropout_p > 0.0:
+                    raise ValueError(
+                        "attention_dropout_prob > 0 is not supported with "
+                        "sequence_parallel (ring/Ulysses attention has no "
+                        "weight-dropout path); set attention_dropout_prob=0 "
+                        "and use hidden_dropout_prob instead")
+                fn = ring_attention if self.sequence_parallel == "ring" else ulysses_attention
+                out = fn(q, k, v, causal=True)
+                out = manip.transpose(out, [0, 2, 1, 3])
+                out = manip.reshape(out, [b, t, self.num_heads * self.head_dim])
+                return self.out_proj(out)
         q = _constrain_heads(q)
         k = _constrain_heads(k)
         v = _constrain_heads(v)
@@ -227,11 +252,33 @@ class GPTEmbeddings(Layer):
         self.word_embeddings = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
         self.position_embeddings = Embedding(config.max_position_embeddings, config.hidden_size)
         self.dropout = Dropout(config.hidden_dropout_prob, mode="upscale_in_train")
+        self.sequence_parallel = config.sequence_parallel
 
     def forward(self, input_ids, position_ids=None):
         t = input_ids.shape[-1]
         if position_ids is None:
-            position_ids = creation.arange(0, t, dtype="int64")
+            if self.sequence_parallel != "none":
+                from ..distributed.meta_parallel.sequence_parallel import (
+                    SP_AXIS,
+                    sp_axis_bound,
+                )
+
+                if sp_axis_bound():
+                    # input_ids is this shard's sequence slice: positions are
+                    # GLOBAL (rank * t_loc + local offset)
+                    from ..ops._primitive import primitive
+
+                    @primitive(nondiff=True)
+                    def _global_pos(ids):
+                        import jax.numpy as jnp
+                        from jax import lax
+
+                        base = jnp.arange(t, dtype=jnp.int32) + lax.axis_index(SP_AXIS) * t
+                        return jnp.broadcast_to(base, ids.shape)
+
+                    position_ids = _global_pos(input_ids)
+            if position_ids is None:
+                position_ids = creation.arange(0, t, dtype="int64")
         emb = self.word_embeddings(input_ids) + self.position_embeddings(position_ids)
         return self.dropout(emb)
 
