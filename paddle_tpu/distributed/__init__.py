@@ -23,6 +23,7 @@ from .collective import (  # noqa: F401
 )
 from .env import (  # noqa: F401
     ParallelEnv,
+    clear_mesh,
     get_mesh,
     get_rank,
     get_world_size,

@@ -11,6 +11,7 @@ from .mp_layers import (  # noqa: F401
 )
 from .moe_layer import ExpertFFN, MoELayer, top_k_gating  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .pipeline_schedule import GPTPipelineModule, build_gpt_pipeline_step  # noqa: F401
 from .sequence_parallel import (  # noqa: F401
     gather_sequence,
     ring_attention,

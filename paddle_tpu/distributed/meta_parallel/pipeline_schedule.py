@@ -1,0 +1,270 @@
+"""Stage-parallel pipeline schedule: the ppermute-scan pipeline program.
+
+Parity: the reference's 1F1B pipeline — static-graph
+``PipelineOptimizer``/``SectionWorker`` (fluid/optimizer.py:4176,
+framework/section_worker.cc:62 schedule_mode==1) and dygraph
+``PipelineParallel.forward_backward_pipeline``
+(fleet/meta_parallel/pipeline_parallel.py:80) with send_v2/recv_v2 p2p ops.
+
+TPU-native redesign (the canonical GSPMD/praxis collective-permute
+pipeline): stages live on the 'pp' mesh axis under shard_map; each stage
+owns a contiguous slice of decoder layers whose parameters are STACKED on a
+leading stage dim (so each pp shard holds [1, k, ...] slices); the
+microbatch loop is one ``lax.scan`` of M + S - 1 ticks where activations
+rotate stage→stage+1 via ``lax.ppermute``. ``jax.grad`` through the scan
+yields the reverse (backward) schedule — the p2p transposes ARE the
+backward p2p of the reference — and ``jax.checkpoint`` on the per-tick
+stage body recovers 1F1B's O(S) activation memory bound.
+
+Scope: uniform-decoder-stack models (the GPT family — BASELINE #4's shape).
+Shared (tied) embedding + final-norm + head params are replicated over 'pp'
+with gradient psum, replacing the reference's SharedLayerDesc allreduce of
+tied-embedding grads (pp_layers.py:49).
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding
+
+from ...autograd import tape
+from ...tensor import Tensor
+from ..env import get_mesh
+from ..spmd import P
+
+__all__ = ["build_gpt_pipeline_step", "stack_layer_params", "GPTPipelineModule"]
+
+PP_AXIS = "pp"
+DP_AXIS = "dp"
+
+
+def stack_layer_params(blocks):
+    """[{name: arr}] per block → {name: arr[N, ...]} stacked."""
+    trees = [{n: p._data for n, p in blk.named_parameters()} for blk in blocks]
+    return {n: jnp.stack([t[n] for t in trees]) for n in trees[0]}
+
+
+class GPTPipelineModule:
+    """Functional pipeline program for a GPTForPretraining model.
+
+    Splits ``model.gpt.h`` (N uniform decoder blocks) into S = pp-degree
+    stages of k = N/S layers each. Parameters:
+      - ``stages``: {name: [S, k, ...]} — sharded P('pp') on dim 0
+      - ``shared``: tied wte/wpe + final LN — replicated
+    """
+
+    def __init__(self, model, num_stages: int, microbatches: int):
+        cfg = model.gpt.config
+        if cfg.hidden_dropout_prob or cfg.attention_dropout_prob:
+            raise ValueError("pipeline schedule requires dropout probs = 0 "
+                             "(per-tick RNG plumbing lands with the dygraph "
+                             "dropout path)")
+        if getattr(cfg, "num_experts", 0):
+            raise ValueError("pipeline schedule requires a uniform decoder "
+                             "stack; MoE configs interleave MoE/dense blocks "
+                             "with different parameter structures — use "
+                             "ParallelTrainer (ep axis) for MoE models")
+        n_layers = len(model.gpt.h)
+        if n_layers % num_stages != 0:
+            raise ValueError(f"layer count {n_layers} must be divisible by "
+                             f"the stage count {num_stages}")
+        self.model = model
+        self.cfg = cfg
+        self.num_stages = num_stages
+        self.layers_per_stage = n_layers // num_stages
+        self.microbatches = microbatches
+        self._block = model.gpt.h[0]  # structural template for all blocks
+
+        stacked = stack_layer_params(list(model.gpt.h))
+        self.stage_params = {
+            n: a.reshape((num_stages, self.layers_per_stage) + a.shape[1:])
+            for n, a in stacked.items()
+        }
+        emb = model.gpt.embeddings
+        self.shared_params = {
+            "wte": emb.word_embeddings.weight._data,
+            "wpe": emb.position_embeddings.weight._data,
+            "ln_f.weight": model.gpt.ln_f.weight._data,
+            "ln_f.bias": model.gpt.ln_f.bias._data,
+        }
+
+    # -- functional pieces ------------------------------------------------
+    def _apply_block(self, layer_params, h):
+        """One decoder layer, pure: layer_params {name: arr}, h [mb, T, H]."""
+        with tape.no_grad():
+            out, _ = self._block.functional_call_with_state(layer_params, {}, Tensor(h))
+        return out._data
+
+    def _embed(self, shared, ids):
+        t = ids.shape[-1]
+        pos = jnp.arange(t)
+        return jnp.take(shared["wte"], ids, axis=0) + shared["wpe"][pos]
+
+    def _head_loss(self, shared, h, labels):
+        eps = self.cfg.layer_norm_epsilon
+        mu = h.mean(-1, keepdims=True)
+        var = ((h - mu) ** 2).mean(-1, keepdims=True)
+        hn = (h - mu) / jnp.sqrt(var + eps) * shared["ln_f.weight"] + shared["ln_f.bias"]
+        logits = jnp.einsum("bth,vh->btv", hn, shared["wte"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        lbl = labels.astype(jnp.int32)
+        valid = lbl != -100  # ignore_index parity with GPTPretrainingCriterion
+        safe = jnp.where(valid, lbl, 0)
+        ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        ll = jnp.where(valid, ll, 0.0)
+        return -ll.sum() / jnp.maximum(valid.sum(), 1)
+
+    # -- the pipelined local loss (runs inside shard_map over 'pp') -------
+    def local_loss(self, stage_params, shared, x, y):
+        """x, y: [M*mb, T] on this shard. Returns replicated mean loss."""
+        n = lax.axis_size(PP_AXIS)
+        s_idx = lax.axis_index(PP_AXIS)
+        m = self.microbatches
+        mb = x.shape[0] // m
+        x_mb = x.reshape((m, mb) + x.shape[1:])
+        y_mb = y.reshape((m, mb) + y.shape[1:])
+        local_stage = jax.tree_util.tree_map(lambda a: a[0], stage_params)  # [k, ...]
+
+        def stage_fn(h):
+            def body(h, lp):
+                return self._apply_block(lp, h), None
+
+            h, _ = lax.scan(body, h, local_stage)
+            return h
+
+        # 1F1B memory bound: recompute stage activations in backward
+        stage_fn = jax.checkpoint(stage_fn)
+
+        ticks = m + n - 1
+        t_seq, h_dim = x.shape[1], self.cfg.hidden_size
+        perm = [(i, i + 1) for i in range(n - 1)]  # stage i -> i+1 (no wrap)
+
+        def tick(carry, t):
+            h_in, loss_acc = carry
+            inj = self._embed(shared, x_mb[jnp.clip(t, 0, m - 1)])
+            h = jnp.where(s_idx == 0, inj, h_in)
+            h = stage_fn(h)
+            out_idx = t - (n - 1)
+            valid = (out_idx >= 0) & (out_idx < m)
+            lbl = y_mb[jnp.clip(out_idx, 0, m - 1)]
+            l = self._head_loss(shared, h, lbl)
+            loss_acc = loss_acc + jnp.where((s_idx == n - 1) & valid, l, 0.0)
+            h_next = lax.ppermute(h, PP_AXIS, perm)
+            return (h_next, loss_acc), None
+
+        h0 = jnp.zeros((mb, t_seq, h_dim), self.shared_params["wte"].dtype)
+        (_, loss_acc), _ = lax.scan(tick, (h0, jnp.zeros((), jnp.float32)),
+                                    jnp.arange(ticks))
+        # Only the last stage accumulated loss. Differentiate the LOCAL value
+        # (cross-stage credit flows through the ppermute transposes); the
+        # psum only replicates the VALUE — routing gradient through it would
+        # scale all grads by the pp degree (each shard's replicated copy
+        # would contribute cotangent 1).
+        local = loss_acc / m
+        total = lax.psum(loss_acc, PP_AXIS) / m
+        return local + lax.stop_gradient(total - local)
+
+    # -- write trained params back into the model -------------------------
+    def sync_to_model(self, stage_params, shared):
+        flat = {
+            n: a.reshape((self.num_stages * self.layers_per_stage,) + a.shape[2:])
+            for n, a in stage_params.items()
+        }
+        for i, blk in enumerate(self.model.gpt.h):
+            for n, p in blk.named_parameters():
+                p._set_data(flat[n][i])
+        emb = self.model.gpt.embeddings
+        emb.word_embeddings.weight._set_data(shared["wte"])
+        emb.position_embeddings.weight._set_data(shared["wpe"])
+        self.model.gpt.ln_f.weight._set_data(shared["ln_f.weight"])
+        self.model.gpt.ln_f.bias._set_data(shared["ln_f.bias"])
+
+
+def build_gpt_pipeline_step(model, optimizer, *, microbatches: int,
+                            num_stages: Optional[int] = None, mesh=None):
+    """Build the jitted stage-parallel train step for a GPT model.
+
+    Returns a callable ``step(x, y) -> loss`` holding sharded params +
+    optimizer state; ``step.sync_to_model()`` writes arrays back.
+    """
+    mesh = mesh or get_mesh()
+    if mesh is None or PP_AXIS not in mesh.shape:
+        raise RuntimeError("pipeline step needs a mesh with a 'pp' axis")
+    if "mp" in mesh.shape and int(mesh.shape["mp"]) > 1:
+        raise NotImplementedError("pp x mp hybrid pipeline lands via GSPMD "
+                                  "sharding specs; use ParallelTrainer for mp")
+    num_stages = num_stages or int(mesh.shape[PP_AXIS])
+    pipe = GPTPipelineModule(model, num_stages, microbatches)
+    has_dp = DP_AXIS in mesh.shape and int(mesh.shape[DP_AXIS]) > 1
+
+    params = {
+        "stages": jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, P(PP_AXIS))),
+            pipe.stage_params),
+        "shared": jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, P())),
+            pipe.shared_params),
+    }
+    opt_state = optimizer.init_state(params)
+    opt_state = {
+        "slots": {
+            "stages": jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, NamedSharding(mesh, P(PP_AXIS)))
+                if a.ndim >= 1 and a.shape[0] == num_stages else
+                jax.device_put(a, NamedSharding(mesh, P())),
+                opt_state["slots"]["stages"]),
+            "shared": jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, NamedSharding(mesh, P())),
+                opt_state["slots"]["shared"]),
+        },
+        "step": jax.device_put(opt_state["step"], NamedSharding(mesh, P())),
+    }
+
+    def spmd_step(params, opt_state, x, y):
+        def loss_fn(params):
+            return pipe.local_loss(params["stages"], params["shared"], x, y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # shared (tied/replicated) params were used by several stages:
+        # combine their grads over 'pp' (≙ SharedLayerDesc allreduce)
+        grads["shared"] = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, PP_AXIS), grads["shared"])
+        if has_dp:
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, DP_AXIS), grads)
+            loss = lax.pmean(loss, DP_AXIS)
+        new_params, new_opt = optimizer.apply_gradients(params, grads, opt_state)
+        return new_params, new_opt, loss
+
+    param_prefix = {"stages": P(PP_AXIS), "shared": P()}
+    opt_prefix = {"slots": {"stages": P(PP_AXIS), "shared": P()}, "step": P()}
+    data_spec = P(DP_AXIS) if has_dp else P()
+
+    from jax import shard_map
+
+    mapped = shard_map(
+        spmd_step, mesh=mesh,
+        in_specs=(param_prefix, opt_prefix, data_spec, data_spec),
+        out_specs=(param_prefix, opt_prefix, P()),
+        check_vma=False,
+    )
+    jitted = jax.jit(mapped, donate_argnums=(0, 1))
+
+    state = {"params": params, "opt": opt_state}
+
+    def step(x, y):
+        x = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        y = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        state["params"], state["opt"], loss = jitted(state["params"], state["opt"], x, y)
+        return loss
+
+    step.pipe = pipe
+    step.state = state
+    step.sync_to_model = lambda: pipe.sync_to_model(
+        state["params"]["stages"], state["params"]["shared"])
+    return step

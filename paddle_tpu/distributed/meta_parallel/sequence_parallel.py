@@ -16,8 +16,8 @@ Two schemes, both SPMD-explicit (run inside shard_map with 'sp' bound):
 - **Ulysses** (`ulysses_attention`): one ``lax.all_to_all`` re-shards
   sequence→heads ([B, H, T/n, D] → [B, H/n, T, D]), full attention runs
   locally per head group (dispatching to the Pallas flash kernel on TPU),
-  then the inverse all2all restores sequence sharding. Head count must
-  divide the sp degree. This reuses the same all2all machinery the MoE
+  then the inverse all2all restores sequence sharding. The sp degree must
+  divide the head count. This reuses the same all2all machinery the MoE
   layer uses (the reference expresses its all2all as global_scatter/
   global_gather — SURVEY §5.7 notes SP should reuse it).
 
@@ -59,6 +59,9 @@ def split_sequence(x, axis_name: str = SP_AXIS, seq_axis: int = 1):
     arr = unwrap(x)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
+    if arr.shape[seq_axis] % n != 0:
+        raise ValueError(f"sequence length {arr.shape[seq_axis]} must be "
+                         f"divisible by the sp degree {n}")
     size = arr.shape[seq_axis] // n
     return lax.dynamic_slice_in_dim(arr, idx * size, size, axis=seq_axis)
 
@@ -145,7 +148,8 @@ def _ulysses_raw(q, k, v, axis_name: str, causal: bool, sm_scale: Optional[float
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     n = lax.axis_size(axis_name)
     if q.shape[1] % n != 0:
-        raise ValueError(f"num_heads {q.shape[1]} must divide sp degree {n} for Ulysses")
+        raise ValueError(f"num_heads {q.shape[1]} must be divisible by the "
+                         f"sp degree {n} for Ulysses")
     # sequence→head re-shard: split heads, concat sequence
     a2a = partial(lax.all_to_all, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True)
     qh, kh, vh = a2a(q), a2a(k), a2a(v)  # [B, H/n, T, D]

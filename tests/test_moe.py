@@ -25,7 +25,7 @@ from paddle_tpu.distributed.utils import global_gather, global_scatter
 def ep_mesh():
     dist.init_mesh({"ep": 8})
     yield
-    dist.env._global_mesh = None
+    dist.clear_mesh()
 
 
 class TestGlobalScatterGather:

@@ -1,0 +1,149 @@
+"""Stage-parallel ppermute-scan pipeline tests (8-virtual-device mesh).
+
+Parity strategy per the reference's pipeline tests
+(test_parallel_dygraph_pipeline_layer.py): the pipelined model must match
+the NON-pipelined model — same loss on the same weights, and matching
+training trajectories.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import P
+from paddle_tpu.distributed.meta_parallel.pipeline_schedule import (
+    GPTPipelineModule,
+    build_gpt_pipeline_step,
+)
+from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+from paddle_tpu.optimizer.optimizers import SGD, AdamW
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=64, hidden_size=32, num_layers=4,
+                num_attention_heads=4, max_position_embeddings=32,
+                hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    base.update(kw)
+    return gpt_config("gpt2-small", **base)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    dist.clear_mesh()
+
+
+def _data(b, t=16, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, vocab, (b, t)).astype("int32")
+    return x, x.copy()
+
+
+def _dense_loss(model, x, y):
+    """Reference loss: full model + shifted-free CE (same as _head_loss)."""
+    logits = model(paddle.to_tensor(x))
+    logp = jax.nn.log_softmax(jnp.asarray(logits._data, jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, jnp.asarray(y)[..., None], axis=-1)
+    return float(-ll.mean())
+
+
+class TestPipelineLoss:
+    def test_pipeline_loss_matches_dense(self):
+        """pp=4 pipelined forward loss == single-device loss, same weights."""
+        dist.init_mesh({"pp": 4, "dp": 2})
+        paddle.seed(0)
+        model = GPTForPretraining(tiny_cfg())
+        model.eval()
+        x, y = _data(8)
+        ref = _dense_loss(model, x, y)
+
+        pipe = GPTPipelineModule(model, num_stages=4, microbatches=2)
+        mesh = dist.get_mesh()
+
+        from jax import shard_map
+
+        def fn(st, sh, x, y):
+            return jax.lax.pmean(pipe.local_loss(st, sh, x, y), "dp")
+
+        f = jax.jit(shard_map(
+            fn, mesh=mesh,
+            in_specs=({k: P("pp") for k in pipe.stage_params}, P(), P("dp"), P("dp")),
+            out_specs=P(),
+            check_vma=False,
+        ))
+        loss = float(f(pipe.stage_params, pipe.shared_params, x, y))
+        # mean over dp halves of the microbatch-mean CE == full-batch CE
+        assert abs(loss - ref) < 2e-4, (loss, ref)
+
+    def test_train_step_converges_pp4_dp2(self):
+        dist.init_mesh({"pp": 4, "dp": 2})
+        paddle.seed(0)
+        model = GPTForPretraining(tiny_cfg())
+        opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
+        step = build_gpt_pipeline_step(model, opt, microbatches=2)
+        x, y = _data(8)
+        losses = [float(step(x, y)) for _ in range(10)]
+        assert losses[-1] < losses[0] * 0.9, losses
+
+    def test_pipeline_matches_dense_training(self):
+        """One SGD step through the pipeline == one SGD step dense."""
+        dist.init_mesh({"pp": 4})
+        paddle.seed(0)
+        cfg = tiny_cfg()
+        model = GPTForPretraining(cfg)
+        x, y = _data(4, seed=3)
+
+        # dense reference: same functional loss, plain jax grad + sgd
+        pipe_ref = GPTPipelineModule(model, num_stages=4, microbatches=2)
+        lr = 0.1
+
+        def dense_loss(stages, shared):
+            h = pipe_ref._embed(shared, jnp.asarray(x))
+            flat = jax.tree_util.tree_map(
+                lambda a: a.reshape((4,) + a.shape[2:]), stages)
+            for i in range(4):
+                lp = jax.tree_util.tree_map(lambda a: a[i], flat)
+                h = pipe_ref._apply_block(lp, h)
+            return pipe_ref._head_loss(shared, h, jnp.asarray(y))
+
+        g_st, g_sh = jax.grad(dense_loss, argnums=(0, 1))(
+            pipe_ref.stage_params, pipe_ref.shared_params)
+        want_st = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, pipe_ref.stage_params, g_st)
+        want_sh = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, pipe_ref.shared_params, g_sh)
+
+        opt = SGD(learning_rate=lr, parameters=model.parameters())
+        step = build_gpt_pipeline_step(model, opt, microbatches=2)
+        step(x, y)
+        got_st = step.state["params"]["stages"]
+        got_sh = step.state["params"]["shared"]
+        for n in want_st:
+            np.testing.assert_allclose(
+                np.asarray(got_st[n]), np.asarray(want_st[n]),
+                rtol=2e-4, atol=2e-5, err_msg=n)
+        for n in want_sh:
+            np.testing.assert_allclose(
+                np.asarray(got_sh[n]), np.asarray(want_sh[n]),
+                rtol=2e-4, atol=2e-5, err_msg=n)
+
+    def test_sync_to_model_roundtrip(self):
+        dist.init_mesh({"pp": 4})
+        paddle.seed(0)
+        model = GPTForPretraining(tiny_cfg())
+        opt = SGD(learning_rate=0.01, parameters=model.parameters())
+        step = build_gpt_pipeline_step(model, opt, microbatches=2)
+        x, y = _data(4)
+        step(x, y)
+        step.sync_to_model()
+        # model now runs with trained weights eagerly
+        out = model(paddle.to_tensor(x))
+        assert list(out.shape) == [4, 16, 64]
+
+    def test_dropout_rejected(self):
+        dist.init_mesh({"pp": 4})
+        model = GPTForPretraining(tiny_cfg(hidden_dropout_prob=0.1))
+        with pytest.raises(ValueError, match="dropout"):
+            GPTPipelineModule(model, 4, 2)

@@ -26,7 +26,7 @@ B, H, T, D = 2, 8, 64, 16  # T sharded 8 ways -> 8 tokens per shard
 def sp_mesh():
     dist.init_mesh({"sp": 8})
     yield
-    dist.env._global_mesh = None
+    dist.clear_mesh()
 
 
 def _qkv(seed=0):
@@ -104,7 +104,7 @@ class TestUlysses:
 
     def test_head_divisibility_check(self, sp_mesh):
         q = np.zeros((B, 4, T, D), np.float32)  # 4 heads < 8 shards
-        with pytest.raises(Exception, match="divide"):
+        with pytest.raises(Exception, match="divisible"):
             _run_sharded(lambda q, k, v: _ulysses_raw(q, k, v, "sp", False, None), q, q, q)
 
 
