@@ -1,0 +1,158 @@
+"""paddle.vision.datasets parity — file-format loaders (no network egress).
+
+Parity: /root/reference/python/paddle/vision/datasets/{mnist,cifar}.py. The
+reference auto-downloads; this environment has no egress, so datasets load
+from a user-supplied local path (same file formats: idx-ubyte for MNIST,
+python-pickle batches for CIFAR) and raise a clear error otherwise.
+``FakeData`` provides deterministic synthetic samples for pipelines/tests.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+def _read_idx_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad idx image magic {magic}"
+        return np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad idx label magic {magic}"
+        return np.frombuffer(f.read(), np.uint8)
+
+
+class MNIST(Dataset):
+    """MNIST from local idx-ubyte files (image_path/label_path), parity with
+    the reference's MNIST(mode=...) surface."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path: Optional[str] = None, label_path: Optional[str] = None,
+                 mode: str = "train", transform: Optional[Callable] = None,
+                 download: bool = True, backend: str = "cv2"):
+        self.mode = mode.lower()
+        self.transform = transform
+        if (image_path is None) != (label_path is None):
+            raise ValueError("pass BOTH image_path and label_path, or neither")
+        if image_path is None:
+            base = os.environ.get("PADDLE_TPU_DATA_HOME", "")
+            stem = "train" if self.mode == "train" else "t10k"
+            cand_i = os.path.join(base, self.NAME, f"{stem}-images-idx3-ubyte.gz")
+            cand_l = os.path.join(base, self.NAME, f"{stem}-labels-idx1-ubyte.gz")
+            if base and os.path.exists(cand_i) and os.path.exists(cand_l):
+                image_path, label_path = cand_i, cand_l
+            else:
+                raise RuntimeError(
+                    f"{type(self).__name__}: no network egress in this build — "
+                    "pass image_path/label_path to local idx-ubyte files or set "
+                    "PADDLE_TPU_DATA_HOME with both image and label files "
+                    "present (use FakeData for synthetic samples)")
+        self.images = _read_idx_images(image_path)
+        self.labels = _read_idx_labels(label_path)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        lbl = int(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(lbl, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class _CifarBase(Dataset):
+    MODE_FLAG_MAP = {}
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = True,
+                 backend: str = "cv2"):
+        self.mode = mode.lower()
+        self.transform = transform
+        if data_file is None:
+            raise RuntimeError(
+                f"{type(self).__name__}: no network egress in this build — "
+                "pass data_file pointing at the local CIFAR python pickle dir "
+                "(use FakeData for synthetic samples)")
+        self.data = []
+        files = self._files(data_file)
+        for fp in files:
+            with open(fp, "rb") as f:
+                batch = pickle.load(f, encoding="bytes")
+            imgs = batch[b"data"].reshape(-1, 3, 32, 32)
+            labels = batch.get(self._label_key, batch.get(b"labels"))
+            for img, lbl in zip(imgs, labels):
+                self.data.append((img, int(lbl)))
+
+    def __getitem__(self, idx):
+        img, lbl = self.data[idx]
+        img = np.transpose(img, (1, 2, 0))  # HWC for transforms
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(lbl, np.int64)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar10(_CifarBase):
+    _label_key = b"labels"
+
+    def _files(self, root):
+        if os.path.isfile(root):
+            return [root]
+        if self.mode == "train":
+            return [os.path.join(root, f"data_batch_{i}") for i in range(1, 6)]
+        return [os.path.join(root, "test_batch")]
+
+
+class Cifar100(_CifarBase):
+    _label_key = b"fine_labels"
+
+    def _files(self, root):
+        if os.path.isfile(root):
+            return [root]
+        return [os.path.join(root, "train" if self.mode == "train" else "test")]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic dataset for pipeline tests/benchmarks."""
+
+    def __init__(self, size=100, image_shape=(3, 224, 224), num_classes=10,
+                 transform: Optional[Callable] = None, seed: int = 0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self.seed + idx)
+        img = rng.standard_normal(self.image_shape).astype(np.float32)
+        lbl = np.asarray(rng.integers(0, self.num_classes), np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl
+
+    def __len__(self):
+        return self.size

@@ -20,7 +20,8 @@ def __getattr__(name):
         "DenseNet": "densenet", "densenet121": "densenet", "densenet161": "densenet",
         "densenet169": "densenet", "densenet201": "densenet", "densenet264": "densenet",
         "ResNeXt": "resnext", "resnext50_32x4d": "resnext", "resnext50_64x4d": "resnext",
-        "resnext101_32x4d": "resnext", "resnext101_64x4d": "resnext", "resnext152_32x4d": "resnext",
+        "resnext101_32x4d": "resnext", "resnext101_64x4d": "resnext",
+        "resnext152_32x4d": "resnext", "resnext152_64x4d": "resnext",
         "ShuffleNetV2": "shufflenetv2", "shufflenet_v2_x0_25": "shufflenetv2",
         "shufflenet_v2_x0_33": "shufflenetv2", "shufflenet_v2_x0_5": "shufflenetv2",
         "shufflenet_v2_x1_0": "shufflenetv2", "shufflenet_v2_x1_5": "shufflenetv2",

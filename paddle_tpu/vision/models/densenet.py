@@ -1,0 +1,110 @@
+"""DenseNet. Parity: /root/reference/python/paddle/vision/models/densenet.py."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops import manipulation as manip
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFGS = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, num_input_features, growth_rate, bn_size, drop_rate):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(num_input_features)
+        self.relu1 = nn.ReLU()
+        self.conv1 = nn.Conv2D(num_input_features, bn_size * growth_rate, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.relu2 = nn.ReLU()
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                               bias_attr=False)
+        self.drop_rate = drop_rate
+        if drop_rate:
+            self.dropout = nn.Dropout(drop_rate)
+
+    def forward(self, x):
+        out = self.conv1(self.relu1(self.norm1(x)))
+        out = self.conv2(self.relu2(self.norm2(out)))
+        if self.drop_rate:
+            out = self.dropout(out)
+        return manip.concat([x, out], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, num_input_features, num_output_features):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(num_input_features)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(num_input_features, num_output_features, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(kernel_size=2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        num_init_features, growth_rate, block_config = _CFGS[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        feats = [
+            nn.Conv2D(3, num_init_features, kernel_size=7, stride=2, padding=3,
+                      bias_attr=False),
+            nn.BatchNorm2D(num_init_features),
+            nn.ReLU(),
+            nn.MaxPool2D(kernel_size=3, stride=2, padding=1),
+        ]
+        num_features = num_init_features
+        for i, num_layers in enumerate(block_config):
+            for j in range(num_layers):
+                feats.append(_DenseLayer(num_features + j * growth_rate, growth_rate,
+                                         bn_size, dropout))
+            num_features += num_layers * growth_rate
+            if i != len(block_config) - 1:
+                feats.append(_Transition(num_features, num_features // 2))
+                num_features //= 2
+        feats.extend([nn.BatchNorm2D(num_features), nn.ReLU()])
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(num_features, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = manip.flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(264, **kwargs)

@@ -1,0 +1,91 @@
+"""GoogLeNet (Inception v1). Parity:
+/root/reference/python/paddle/vision/models/googlenet.py — returns
+(out, out1, out2) aux logits in train mode like the reference."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops import manipulation as manip
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class Inception(nn.Layer):
+    def __init__(self, in_c, c1, c2_1, c2_3, c3_1, c3_5, c4):
+        super().__init__()
+        self.branch1 = nn.Sequential(nn.Conv2D(in_c, c1, 1), nn.ReLU())
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in_c, c2_1, 1), nn.ReLU(),
+            nn.Conv2D(c2_1, c2_3, 3, padding=1), nn.ReLU())
+        self.branch3 = nn.Sequential(
+            nn.Conv2D(in_c, c3_1, 1), nn.ReLU(),
+            nn.Conv2D(c3_1, c3_5, 5, padding=2), nn.ReLU())
+        self.branch4 = nn.Sequential(
+            nn.MaxPool2D(kernel_size=3, stride=1, padding=1),
+            nn.Conv2D(in_c, c4, 1), nn.ReLU())
+
+    def forward(self, x):
+        return manip.concat([self.branch1(x), self.branch2(x),
+                             self.branch3(x), self.branch4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(kernel_size=3, stride=2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(kernel_size=3, stride=2, padding=1),
+        )
+        self.ince3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.ince3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.maxpool3 = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.ince4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.ince4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.ince4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.ince4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.ince4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.maxpool4 = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.ince5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.ince5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            # aux classifiers (train-mode extra heads, parity with reference)
+            self.aux1 = nn.Sequential(
+                nn.AdaptiveAvgPool2D(4), nn.Flatten(),
+                nn.Linear(512 * 16, 1024), nn.ReLU(), nn.Dropout(0.7),
+                nn.Linear(1024, num_classes))
+            self.aux2 = nn.Sequential(
+                nn.AdaptiveAvgPool2D(4), nn.Flatten(),
+                nn.Linear(528 * 16, 1024), nn.ReLU(), nn.Dropout(0.7),
+                nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.maxpool3(self.ince3b(self.ince3a(x)))
+        x = self.ince4a(x)
+        a1 = x
+        x = self.ince4d(self.ince4c(self.ince4b(x)))
+        a2 = x
+        x = self.maxpool4(self.ince4e(x))
+        x = self.ince5b(self.ince5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.dropout(x)
+            x = manip.flatten(x, 1)
+            out = self.fc(x)
+            if self.training:
+                return out, self.aux1(a1), self.aux2(a2)
+            return out
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

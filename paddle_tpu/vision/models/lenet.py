@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 from ... import nn
+from ...ops.manipulation import flatten
 
 __all__ = ["LeNet"]
 
@@ -28,8 +29,6 @@ class LeNet(nn.Layer):
     def forward(self, inputs):
         x = self.features(inputs)
         if self.num_classes > 0:
-            from ...ops.manipulation import flatten
-
             x = flatten(x, 1)
             x = self.fc(x)
         return x

@@ -1,0 +1,44 @@
+"""ResNeXt. Parity: /root/reference/python/paddle/vision/models/resnext.py —
+expressed via the grouped-convolution Bottleneck of resnet.py (same math,
+one implementation; the reference duplicates the block code)."""
+from __future__ import annotations
+
+from .resnet import BottleneckBlock, ResNet
+
+__all__ = [
+    "ResNeXt", "resnext50_32x4d", "resnext50_64x4d", "resnext101_32x4d",
+    "resnext101_64x4d", "resnext152_32x4d", "resnext152_64x4d",
+]
+
+
+class ResNeXt(ResNet):
+    def __init__(self, depth=50, cardinality=32, base_width=4, num_classes=1000,
+                 with_pool=True):
+        layer_cfg = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
+        super().__init__(BottleneckBlock, None, width=base_width, groups=cardinality,
+                         num_classes=num_classes, with_pool=with_pool,
+                         layers=layer_cfg[depth])
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return ResNeXt(50, 32, 4, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return ResNeXt(50, 64, 4, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return ResNeXt(101, 32, 4, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return ResNeXt(101, 64, 4, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return ResNeXt(152, 32, 4, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return ResNeXt(152, 64, 4, **kwargs)

@@ -1,0 +1,117 @@
+"""ShuffleNetV2. Parity: /root/reference/python/paddle/vision/models/shufflenetv2.py."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops import manipulation as manip
+
+__all__ = [
+    "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+    "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+    "shufflenet_v2_x2_0",
+]
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+
+
+def channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = manip.reshape(x, [b, groups, c // groups, h, w])
+    x = manip.transpose(x, [0, 2, 1, 3, 4])
+    return manip.reshape(x, [b, c, h, w])
+
+
+def _conv_bn(in_c, out_c, k, stride=1, padding=0, groups=1, act=True):
+    layers = [nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                        groups=groups, bias_attr=False), nn.BatchNorm2D(out_c)]
+    if act:
+        layers.append(nn.ReLU())
+    return nn.Sequential(*layers)
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn(in_c // 2, branch_c, 1),
+                _conv_bn(branch_c, branch_c, 3, stride, 1, groups=branch_c, act=False),
+                _conv_bn(branch_c, branch_c, 1),
+            )
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn(in_c, in_c, 3, stride, 1, groups=in_c, act=False),
+                _conv_bn(in_c, branch_c, 1),
+            )
+            self.branch2 = nn.Sequential(
+                _conv_bn(in_c, branch_c, 1),
+                _conv_bn(branch_c, branch_c, 3, stride, 1, groups=branch_c, act=False),
+                _conv_bn(branch_c, branch_c, 1),
+            )
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = manip.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = manip.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_out = _STAGE_OUT[scale]
+        stage_repeats = [4, 8, 4]
+        self.conv1 = _conv_bn(3, stage_out[0], 3, stride=2, padding=1)
+        self.max_pool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
+        blocks = []
+        in_c = stage_out[0]
+        for stage_i, repeats in enumerate(stage_repeats):
+            out_c = stage_out[stage_i + 1]
+            for i in range(repeats):
+                blocks.append(InvertedResidual(in_c, out_c, stride=2 if i == 0 else 1))
+                in_c = out_c
+        self.blocks = nn.Sequential(*blocks)
+        self.conv_last = _conv_bn(in_c, stage_out[-1], 1)
+        if with_pool:
+            self.pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(stage_out[-1], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        x = self.blocks(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = manip.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def _make(scale):
+    def f(pretrained=False, **kwargs):
+        return ShuffleNetV2(scale=scale, **kwargs)
+    return f
+
+
+shufflenet_v2_x0_25 = _make(0.25)
+shufflenet_v2_x0_33 = _make(0.33)
+shufflenet_v2_x0_5 = _make(0.5)
+shufflenet_v2_x1_0 = _make(1.0)
+shufflenet_v2_x1_5 = _make(1.5)
+shufflenet_v2_x2_0 = _make(2.0)

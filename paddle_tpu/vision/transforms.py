@@ -1,0 +1,447 @@
+"""paddle.vision.transforms parity — host-side numpy preprocessing.
+
+Parity: /root/reference/python/paddle/vision/transforms/transforms.py +
+functional.py. TPU-native stance: transforms run on HOST numpy inside the
+DataLoader workers (the device should only see final batched arrays — no
+per-sample device traffic), mirroring the reference's CPU-side pipeline.
+
+Array convention: HWC uint8/float numpy in, unless noted; ``ToTensor``
+produces CHW float32 scaled to [0, 1].
+"""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import Sequence
+
+import numpy as np
+
+__all__ = [
+    "Compose", "BaseTransform", "ToTensor", "Resize", "RandomResizedCrop",
+    "CenterCrop", "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+    "Normalize", "Transpose", "Pad", "RandomRotation", "Grayscale",
+    "BrightnessTransform", "ContrastTransform", "SaturationTransform",
+    "HueTransform", "ColorJitter",
+    # functional
+    "to_tensor", "resize", "center_crop", "crop", "hflip", "vflip",
+    "normalize", "pad", "rotate", "to_grayscale", "adjust_brightness",
+    "adjust_contrast", "adjust_hue",
+]
+
+
+# ---------------------------------------------------------------- functional
+def _as_float(img):
+    return img.astype(np.float32)
+
+
+def resize(img, size, interpolation="bilinear"):
+    """size: int (short side) or (h, w)."""
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    if (oh, ow) == (h, w):
+        return img
+    # bilinear resize via jax-free numpy (host path): index-based sampling
+    ys = np.linspace(0, h - 1, oh)
+    xs = np.linspace(0, w - 1, ow)
+    if interpolation == "nearest":
+        out = img[np.round(ys).astype(int)][:, np.round(xs).astype(int)]
+        return out
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None]
+    wx = (xs - x0)[None, :]
+    if img.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    f = _as_float(img)
+    top = f[y0][:, x0] * (1 - wx) + f[y0][:, x1] * wx
+    bot = f[y1][:, x0] * (1 - wx) + f[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(img.dtype) if np.issubdtype(img.dtype, np.integer) else out
+
+
+def crop(img, top, left, height, width):
+    return img[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = img.shape[:2]
+    th, tw = output_size
+    top = int(round((h - th) / 2.0))
+    left = int(round((w - tw) / 2.0))
+    return crop(img, top, left, th, tw)
+
+
+def hflip(img):
+    return img[:, ::-1]
+
+
+def vflip(img):
+    return img[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    if isinstance(padding, numbers.Number):
+        padding = (padding,) * 4  # left, top, right, bottom
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    l, t, r, b = padding
+    pad_width = [(t, b), (l, r)] + [(0, 0)] * (img.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(img, pad_width, mode=mode, **kw)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    img = _as_float(img)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (img - mean[:, None, None]) / std[:, None, None]
+    return (img - mean) / std
+
+
+def to_tensor(img, data_format="CHW"):
+    """HWC uint8 [0,255] → CHW float32 [0,1] numpy array."""
+    f = _as_float(img)
+    if np.issubdtype(img.dtype, np.integer):
+        f = f / 255.0
+    if img.ndim == 2:
+        f = f[:, :, None]
+    if data_format == "CHW":
+        f = np.transpose(f, (2, 0, 1))
+    return f
+
+
+def to_grayscale(img, num_output_channels=1):
+    f = _as_float(img)
+    gray = f[..., 0] * 0.299 + f[..., 1] * 0.587 + f[..., 2] * 0.114
+    out = np.stack([gray] * num_output_channels, axis=-1)
+    return out.astype(img.dtype) if np.issubdtype(img.dtype, np.integer) else out
+
+
+def adjust_brightness(img, factor):
+    f = _as_float(img) * factor
+    if np.issubdtype(img.dtype, np.integer):
+        return np.clip(f, 0, 255).astype(img.dtype)
+    return f
+
+
+def adjust_contrast(img, factor):
+    f = _as_float(img)
+    mean = f.mean()
+    out = (f - mean) * factor + mean
+    if np.issubdtype(img.dtype, np.integer):
+        return np.clip(out, 0, 255).astype(img.dtype)
+    return out
+
+
+# ------------------------------------------------------------------ classes
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        if self.padding is not None:
+            img = pad(img, self.padding, self.fill, self.padding_mode)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            img = pad(img, (max(tw - w, 0), max(th - h, 0)), self.fill, self.padding_mode)
+            h, w = img.shape[:2]
+        top = random.randint(0, h - th)
+        left = random.randint(0, w - tw)
+        return crop(img, top, left, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * random.uniform(*self.scale)
+            aspect = np.exp(random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                return resize(crop(img, top, left, ch, cw), self.size, self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size, self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else img
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        m, s = self.mean, self.std
+        c = img.shape[0] if self.data_format == "CHW" else img.shape[-1]
+        if len(m) != c:
+            m = [m[0]] * c
+            s = [s[0]] * c
+        return normalize(img, m, s, self.data_format)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        if img.ndim == 2 and max(self.order) > 1:
+            img = img[:, :, None]
+        return np.transpose(img, self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+def rotate(img, angle, fill=0):
+    """Rotate by ``angle`` degrees about the center (nearest-neighbor
+    resampling on host numpy; out-of-bounds pixels take ``fill``)."""
+    h, w = img.shape[:2]
+    theta = np.deg2rad(angle)
+    cos, sin = np.cos(theta), np.sin(theta)
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    ys, xs = np.mgrid[0:h, 0:w]
+    # inverse-map output pixels to source coordinates
+    sx = cos * (xs - cx) + sin * (ys - cy) + cx
+    sy = -sin * (xs - cx) + cos * (ys - cy) + cy
+    sxi = np.round(sx).astype(int)
+    syi = np.round(sy).astype(int)
+    inside = (sxi >= 0) & (sxi < w) & (syi >= 0) & (syi < h)
+    out = np.full_like(img, fill)
+    out[inside] = img[syi[inside], sxi[inside]]
+    return out
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, self.fill)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_brightness(img, random.uniform(max(0, 1 - self.value), 1 + self.value))
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_contrast(img, random.uniform(max(0, 1 - self.value), 1 + self.value))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        gray = to_grayscale(img, img.shape[-1] if img.ndim == 3 else 1)
+        alpha = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        out = _as_float(img) * alpha + _as_float(gray) * (1 - alpha)
+        if np.issubdtype(img.dtype, np.integer):
+            return np.clip(out, 0, 255).astype(img.dtype)
+        return out
+
+
+def adjust_hue(img, factor):
+    """Shift hue by ``factor`` (in [-0.5, 0.5] turns) via RGB→HSV→RGB."""
+    was_int = np.issubdtype(img.dtype, np.integer)
+    f = _as_float(img) / (255.0 if was_int else 1.0)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    maxc = np.max(f, axis=-1)
+    minc = np.min(f, axis=-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(delta, 1e-12)
+    h = np.where(maxc == r, (g - b) / dz % 6,
+                 np.where(maxc == g, (b - r) / dz + 2, (r - g) / dz + 4)) / 6.0
+    h = np.where(delta == 0, 0.0, h)
+    h = (h + factor) % 1.0
+    # HSV → RGB
+    i = np.floor(h * 6).astype(int)
+    frac = h * 6 - i
+    p = v * (1 - s)
+    q = v * (1 - s * frac)
+    t = v * (1 - s * (1 - frac))
+    i = i % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1)
+    if was_int:
+        return np.clip(out * 255.0, 0, 255).astype(img.dtype)
+    return out.astype(np.float32)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        ts = list(self.transforms)
+        random.shuffle(ts)
+        for t in ts:
+            img = t(img)
+        return img

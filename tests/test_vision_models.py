@@ -1,0 +1,182 @@
+"""Vision model zoo: forward-shape tests (reference test style:
+python/paddle/tests/test_vision_models.py — instantiate, forward, check
+logit shape)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _run(model, size=64, channels=3, batch=1):
+    model.eval()
+    x = paddle.to_tensor(np.random.randn(batch, channels, size, size).astype("float32"))
+    return model(x)
+
+
+@pytest.mark.parametrize("name,kwargs,size", [
+    ("resnet18", {}, 64),
+    ("resnet50", {}, 64),
+    ("wide_resnet50_2", {}, 64),
+    ("resnext50_32x4d", {}, 64),
+    ("vgg11", {}, 64),
+    ("alexnet", {}, 96),
+    ("mobilenet_v1", {"scale": 0.25}, 64),
+    ("mobilenet_v2", {"scale": 0.25}, 64),
+    ("squeezenet1_0", {}, 96),
+    ("squeezenet1_1", {}, 96),
+    ("shufflenet_v2_x0_25", {}, 64),
+    ("densenet121", {}, 64),
+    ("inception_v3", {}, 75),
+])
+def test_model_forward_shape(name, kwargs, size):
+    ctor = getattr(models, name)
+    model = ctor(num_classes=10, **kwargs)
+    out = _run(model, size=size)
+    assert list(out.shape) == [1, 10]
+
+
+def test_googlenet_train_aux_heads():
+    model = models.googlenet(num_classes=10)
+    model.eval()
+    x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype("float32"))
+    out = model(x)
+    assert list(out.shape) == [1, 10]
+    model.train()
+    out, a1, a2 = model(x)
+    assert list(a1.shape) == [1, 10] and list(a2.shape) == [1, 10]
+
+
+def test_resnet_trains():
+    from paddle_tpu.optimizer.optimizers import SGD
+
+    paddle.seed(0)
+    model = models.resnet18(num_classes=4)
+    opt = SGD(learning_rate=0.05, parameters=model.parameters())
+    x = paddle.to_tensor(np.random.randn(8, 3, 32, 32).astype("float32"))
+    y = paddle.to_tensor(np.random.default_rng(0).integers(0, 4, (8,)).astype("int64"))
+    import paddle_tpu.nn.functional as F
+
+    losses = []
+    for _ in range(5):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss._data))
+    assert losses[-1] < losses[0], losses
+
+
+class TestTransforms:
+    def test_compose_pipeline(self):
+        from paddle_tpu.vision import transforms as T
+
+        t = T.Compose([
+            T.Resize(40), T.CenterCrop(32), T.RandomHorizontalFlip(0.5),
+            T.ToTensor(), T.Normalize([0.5] * 3, [0.5] * 3),
+        ])
+        img = (np.random.rand(48, 56, 3) * 255).astype(np.uint8)
+        out = t(img)
+        assert out.shape == (3, 32, 32)
+        assert out.dtype == np.float32
+        assert -1.01 <= out.min() and out.max() <= 1.01
+
+    def test_resize_shapes(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = (np.random.rand(32, 64, 3) * 255).astype(np.uint8)
+        assert T.resize(img, 16).shape[:2] == (16, 32)  # short side
+        assert T.resize(img, (20, 24)).shape[:2] == (20, 24)
+        assert T.resize(img, 16, "nearest").shape[:2] == (16, 32)
+
+    def test_pad_and_crop(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = np.ones((8, 8, 3), np.uint8)
+        assert T.pad(img, 2).shape == (12, 12, 3)
+        assert T.crop(img, 1, 2, 4, 5).shape == (4, 5, 3)
+        assert T.center_crop(img, 4).shape == (4, 4, 3)
+
+    def test_random_resized_crop(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = (np.random.rand(50, 60, 3) * 255).astype(np.uint8)
+        out = T.RandomResizedCrop(24)(img)
+        assert out.shape[:2] == (24, 24)
+
+    def test_color_ops(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+        assert T.adjust_brightness(img, 1.5).shape == img.shape
+        assert T.adjust_contrast(img, 0.5).shape == img.shape
+        assert T.to_grayscale(img, 3).shape == img.shape
+        assert T.ColorJitter(0.4, 0.4, 0.4)(img).shape == img.shape
+
+
+class TestDatasets:
+    def test_fake_data_loader(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.vision.datasets import FakeData
+
+        ds = FakeData(size=16, image_shape=(3, 8, 8), num_classes=4)
+        dl = DataLoader(ds, batch_size=4, shuffle=True)
+        batches = list(dl)
+        assert len(batches) == 4
+        xb, yb = batches[0]
+        assert tuple(xb.shape) == (4, 3, 8, 8)
+
+    def test_mnist_idx_parser(self, tmp_path):
+        import gzip
+        import struct
+
+        # write a tiny idx pair and read it back
+        imgs = (np.arange(2 * 28 * 28) % 255).astype(np.uint8).reshape(2, 28, 28)
+        lbls = np.asarray([3, 7], np.uint8)
+        ip = tmp_path / "imgs.gz"
+        lp = tmp_path / "lbls.gz"
+        with gzip.open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 2, 28, 28) + imgs.tobytes())
+        with gzip.open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, 2) + lbls.tobytes())
+
+        from paddle_tpu.vision.datasets import MNIST
+
+        ds = MNIST(image_path=str(ip), label_path=str(lp))
+        assert len(ds) == 2
+        img, lbl = ds[1]
+        assert img.shape == (28, 28) and int(lbl) == 7
+
+    def test_no_egress_error(self):
+        from paddle_tpu.vision.datasets import Cifar10
+
+        with pytest.raises(RuntimeError, match="egress"):
+            Cifar10()
+
+
+class TestNewTransforms:
+    def test_rotate_identity_and_90(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = np.arange(5 * 5, dtype=np.uint8).reshape(5, 5)
+        np.testing.assert_array_equal(T.rotate(img, 0), img)
+        np.testing.assert_array_equal(T.rotate(img, 90), np.rot90(img, -1))
+        # 90-degree rotation keeps all pixels (square, no fill needed)
+        assert set(T.rotate(img, 90).flatten()) == set(img.flatten())
+
+    def test_random_rotation_respects_degrees(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = np.arange(9, dtype=np.uint8).reshape(3, 3)
+        out = T.RandomRotation(0)(img)  # 0 degrees must be identity
+        np.testing.assert_array_equal(out, img)
+
+    def test_adjust_hue_roundtrip(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = (np.random.rand(6, 6, 3) * 255).astype(np.uint8)
+        np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=2)
+        shifted = T.adjust_hue(img, 0.25)
+        assert shifted.shape == img.shape and shifted.dtype == img.dtype
+        # full-turn shift restores the image
+        np.testing.assert_allclose(T.adjust_hue(img, 1.0), img, atol=2)
