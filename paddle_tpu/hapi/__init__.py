@@ -1,0 +1,5 @@
+"""hapi — the high-level Model API. Parity: python/paddle/hapi/."""
+from . import callbacks  # noqa: F401
+from .dynamic_flops import flops  # noqa: F401
+from .model import Model  # noqa: F401
+from .model_summary import summary  # noqa: F401

@@ -1,0 +1,87 @@
+"""paddle.flops parity: /root/reference/python/paddle/hapi/dynamic_flops.py.
+Forward-hook FLOP counting for the common layer types."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn import layers as L
+from ..nn.layer import Layer
+from ..tensor import Tensor
+
+__all__ = ["flops"]
+
+
+def _count_conv(layer, x, y):
+    out = y[0] if isinstance(y, (list, tuple)) else y
+    kernel_ops = int(np.prod(layer._kernel_size)) * (layer._in_channels // layer._groups)
+    bias_ops = 1 if layer.bias is not None else 0
+    out_numel = int(np.prod(out.shape))
+    return out_numel * (kernel_ops + bias_ops)
+
+
+def _count_linear(layer, x, y):
+    out = y[0] if isinstance(y, (list, tuple)) else y
+    out_numel = int(np.prod(out.shape))
+    return out_numel * layer.weight.shape[0] + (out_numel if layer.bias is not None else 0)
+
+
+def _count_bn(layer, x, y):
+    out = y[0] if isinstance(y, (list, tuple)) else y
+    return 2 * int(np.prod(out.shape))
+
+
+def _count_act(layer, x, y):
+    out = y[0] if isinstance(y, (list, tuple)) else y
+    return int(np.prod(out.shape))
+
+
+def _count_pool(layer, x, y):
+    out = y[0] if isinstance(y, (list, tuple)) else y
+    return int(np.prod(out.shape))
+
+
+def flops(net: Layer, input_size, custom_ops=None, print_detail=False) -> int:
+    """Total multiply-add count for one forward pass."""
+    counters = {
+        L.conv._ConvNd: _count_conv,
+        L.common.Linear: _count_linear,
+        L.norm._BatchNormBase: _count_bn,
+        L.norm.LayerNorm: _count_bn,
+        L.pooling._Pool: _count_pool,
+        L.pooling._AvgPool: _count_pool,
+    }
+    if custom_ops:
+        counters.update(custom_ops)
+    total = {"flops": 0}
+    rows = []
+    hooks = []
+
+    def make_hook(name, fn, lyr):
+        def hook(layer, inputs, outputs):
+            n = int(fn(layer, inputs, outputs))
+            total["flops"] += n
+            rows.append((name, type(layer).__name__, n))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        for cls, fn in counters.items():
+            if isinstance(sub, cls):
+                hooks.append(sub.register_forward_post_hook(make_hook(name, fn, sub)))
+                break
+
+    size = tuple(1 if d in (None, -1) else d for d in input_size)
+    was_training = net.training
+    net.eval()
+    try:
+        net(Tensor(np.zeros(size, np.float32)))
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    if print_detail:
+        for name, cls, n in rows:
+            print(f"{name} ({cls}): {n:,}")
+    print(f"Total Flops: {total['flops']:,}")
+    return total["flops"]

@@ -1,0 +1,239 @@
+"""hapi Model — fit/evaluate/predict high-level API.
+
+Parity: /root/reference/python/paddle/hapi/model.py (Model:906, fit:1556,
+evaluate:1786, predict:1889, save/load:1265-1419, train_batch:1060).
+
+TPU-native notes: the train loop is the framework's eager path (each op is a
+jitted XLA call); swap in ``paddle_tpu.distributed.ParallelTrainer`` or
+``jit.to_static`` for the fully-compiled step when throughput matters —
+``Model`` stays the orchestration/callback layer, same as the reference
+keeps hapi above the executor.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..framework import io as fio
+from ..io.dataloader import DataLoader
+from ..io.dataset import Dataset
+from ..metric import Metric
+from ..tensor import Tensor
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+        self._save_dir = None
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        ms = _to_list(metrics)
+        for m in ms:
+            assert isinstance(m, Metric), f"metrics must be Metric, got {type(m)}"
+        self._metrics = ms
+
+    # ------------------------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        outs = _to_list(outputs)
+        lbls = _to_list(labels)
+        if callable(self._loss) and not isinstance(self._loss, (list, tuple)):
+            loss = self._loss(*(outs + lbls))
+        else:
+            raise ValueError("prepare(loss=...) with a callable loss first")
+        return loss
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """One eager train step; returns [loss] (+ metric results)."""
+        self.network.train()
+        ins = [_to_tensor(x) for x in _to_list(inputs)]
+        lbls = [_to_tensor(x) for x in _to_list(labels)]
+        outputs = self.network(*ins)
+        loss = self._compute_loss(outputs, lbls)
+        loss.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m_in = m.compute(*(_to_list(outputs) + lbls))
+            metrics.append(m.update(*_to_list(m_in)))
+        return ([float(loss._data)], metrics) if metrics else [float(loss._data)]
+
+    def eval_batch(self, inputs, labels=None):
+        from ..autograd import tape
+
+        self.network.eval()
+        ins = [_to_tensor(x) for x in _to_list(inputs)]
+        lbls = [_to_tensor(x) for x in _to_list(labels)]
+        with tape.no_grad():
+            outputs = self.network(*ins)
+            loss = self._compute_loss(outputs, lbls) if self._loss else None
+        metrics = []
+        for m in self._metrics:
+            m_in = m.compute(*(_to_list(outputs) + lbls))
+            metrics.append(m.update(*_to_list(m_in)))
+        lv = [float(loss._data)] if loss is not None else []
+        return (lv, metrics) if metrics else lv
+
+    def predict_batch(self, inputs):
+        from ..autograd import tape
+
+        self.network.eval()
+        ins = [_to_tensor(x) for x in _to_list(inputs)]
+        with tape.no_grad():
+            outputs = self.network(*ins)
+        return [np.asarray(o._data) for o in _to_list(outputs)]
+
+    # ------------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers)
+        return data  # any iterable of batches
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return batch[:-1], batch[-1:]
+        return [batch], []
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        """Parity: hapi/model.py:1556."""
+        assert self._optimizer is not None and self._loss is not None, \
+            "call prepare(optimizer, loss) before fit"
+        self._save_dir = save_dir
+        loader = self._loader(train_data, batch_size, shuffle, num_workers)
+        eval_loader = self._loader(eval_data, batch_size, False, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs, steps=steps,
+                                log_freq=log_freq, verbose=verbose,
+                                save_freq=save_freq, save_dir=save_dir,
+                                metrics=self._metrics_names())
+        self.stop_training = False
+        cbks.on_train_begin()
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, lbls = self._split_batch(batch)
+                res = self.train_batch(ins, lbls)
+                logs = self._result_logs(res)
+                cbks.on_train_batch_end(step, logs)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size, verbose=0,
+                              callbacks=cbks)
+        cbks.on_train_end(logs if "logs" in dir() else None)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._loader(eval_data, batch_size, False, num_workers)
+        cbks = callbacks if callbacks is not None else config_callbacks(
+            None, model=self, verbose=verbose, metrics=self._metrics_names())
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, lbls = self._split_batch(batch)
+            res = self.eval_batch(ins, lbls)
+            logs = self._result_logs(res, prefix="")
+            cbks.on_eval_batch_end(step, logs)
+        # final accumulated metrics
+        for m in self._metrics:
+            logs[self._mname(m)] = m.accumulate()
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None, verbose=1):
+        loader = self._loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch) if isinstance(batch, (list, tuple)) \
+                else ([batch], [])
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    # ------------------------------------------------------------------
+    def _mname(self, m):
+        n = m.name()
+        return n[0] if isinstance(n, (list, tuple)) else n
+
+    def _metrics_names(self):
+        return ["loss"] + [self._mname(m) for m in self._metrics]
+
+    def _result_logs(self, res, prefix=""):
+        logs = {}
+        if isinstance(res, tuple):
+            losses, metrics = res
+        else:
+            losses, metrics = res, []
+        if losses:
+            logs[prefix + "loss"] = losses[0]
+        for m, val in zip(self._metrics, metrics):
+            logs[prefix + self._mname(m)] = val
+        return logs
+
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        """model.pdparams (+ .pdopt) like hapi save (model.py:1265)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = fio.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(fio.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,70 @@
+"""paddle.summary parity: /root/reference/python/paddle/hapi/model_summary.py.
+Hook-based layer table + parameter totals."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..nn.layer import Layer
+from ..tensor import Tensor
+
+__all__ = ["summary"]
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):  # noqa: A002
+    """Print a per-layer table; returns {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+            shape = list(out.shape) if hasattr(out, "shape") else []
+            n_params = sum(int(np.prod(p._data.shape)) for p in lyr._parameters.values()
+                           if p is not None)
+            rows.append((name, type(lyr).__name__, shape, n_params))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        if not sub._sub_layers:  # leaves only, like the reference table
+            hooks.append(sub.register_forward_post_hook(make_hook(name, sub)))
+
+    if input is not None:
+        x = input
+    else:
+        assert input_size is not None, "summary needs input_size or input"
+        sizes = input_size if isinstance(input_size, list) else [input_size]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else [dtypes] * len(sizes)
+        xs = []
+        for s, dt in zip(sizes, dts):
+            s = tuple(1 if d is None or d == -1 else d for d in s)
+            xs.append(Tensor(np.zeros(s, np.dtype(dt or "float32"))))
+        x = xs if len(xs) > 1 else xs[0]
+
+    was_training = net.training
+    net.eval()
+    try:
+        net(*x) if isinstance(x, list) else net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(int(np.prod(p._data.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p._data.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+
+    line = "-" * 80
+    print(line)
+    print(f"{'Layer (type)':<40}{'Output Shape':<24}{'Param #':>14}")
+    print(line)
+    for name, cls, shape, n in rows:
+        print(f"{name + ' (' + cls + ')':<40}{str(shape):<24}{n:>14,}")
+    print(line)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}

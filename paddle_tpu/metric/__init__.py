@@ -1,0 +1,189 @@
+"""paddle.metric parity: Metric base + Accuracy/Precision/Recall/Auc.
+
+Parity: /root/reference/python/paddle/metric/metrics.py (Metric:23,
+Accuracy:183, Precision:285, Recall:395, Auc:504). Metrics accumulate on
+HOST numpy (device work stays in the train step; metric update takes the
+already-computed predictions), same split as the reference's CPU-side
+metric ops.
+"""
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+
+
+def _to_np(x):
+    if hasattr(x, "_data"):
+        x = x._data
+    return np.asarray(x)
+
+
+class Metric(abc.ABC):
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    @abc.abstractmethod
+    def name(self):
+        ...
+
+    def compute(self, *args):
+        """Optional pre-processing run on device outputs; default passthrough."""
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy. compute() turns (pred, label) into per-sample
+    correctness like the reference (metrics.py:183)."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred = _to_np(pred)
+        label = _to_np(label)
+        idx = np.argsort(-pred, axis=-1)[..., : self.maxk]
+        if label.ndim == pred.ndim and label.shape[-1] == 1:
+            label = label[..., 0]
+        correct = idx == label[..., None]
+        return correct
+
+    def update(self, correct, *args):
+        correct = _to_np(correct)
+        accs = []
+        num = int(np.prod(correct.shape[:-1]))
+        for k in self.topk:
+            c = correct[..., :k].any(axis=-1).sum()
+            accs.append(float(c) / max(num, 1))
+            self.total[self.topk.index(k)] += float(c)
+            self.count[self.topk.index(k)] += num
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision over probability outputs (metrics.py:285)."""
+
+    def __init__(self, name="precision"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_np(preds).flatten()
+        labels = _to_np(labels).flatten()
+        pred_pos = np.round(preds).astype(np.int64) == 1
+        self.tp += int(np.sum(pred_pos & (labels == 1)))
+        self.fp += int(np.sum(pred_pos & (labels != 1)))
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall (metrics.py:395)."""
+
+    def __init__(self, name="recall"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_np(preds).flatten()
+        labels = _to_np(labels).flatten()
+        pred_pos = np.round(preds).astype(np.int64) == 1
+        self.tp += int(np.sum(pred_pos & (labels == 1)))
+        self.fn += int(np.sum(~pred_pos & (labels == 1)))
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC AUC via histogram buckets (metrics.py:504 — same thresholded
+    stat-accumulator design as the reference's auc op)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__()
+        self._name = name
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_np(preds)
+        labels = _to_np(labels).flatten()
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]  # P(positive)
+        preds = preds.flatten()
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64),
+                      0, self.num_thresholds)
+        for i, lbl in zip(idx, labels):
+            if lbl:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def accumulate(self):
+        tot_pos = tot_neg = 0.0
+        auc = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            p, n = self._stat_pos[i], self._stat_neg[i]
+            auc += n * tot_pos + p * n / 2.0
+            tot_pos += p
+            tot_neg += n
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        return float(auc) / (tot_pos * tot_neg)
+
+    def name(self):
+        return self._name
