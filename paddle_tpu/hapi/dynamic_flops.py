@@ -49,6 +49,12 @@ def flops(net: Layer, input_size, custom_ops=None, print_detail=False) -> int:
         L.norm.LayerNorm: _count_bn,
         L.pooling._Pool: _count_pool,
         L.pooling._AvgPool: _count_pool,
+        L.activation.ReLU: _count_act,
+        L.activation.ReLU6: _count_act,
+        L.activation.LeakyReLU: _count_act,
+        L.activation.Sigmoid: _count_act,
+        L.activation.Tanh: _count_act,
+        L.activation.GELU: _count_act,
     }
     if custom_ops:
         counters.update(custom_ops)

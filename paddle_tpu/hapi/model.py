@@ -109,18 +109,24 @@ class Model:
         return [np.asarray(o._data) for o in _to_list(outputs)]
 
     # ------------------------------------------------------------------
-    def _loader(self, data, batch_size, shuffle, num_workers):
+    def _loader(self, data, batch_size, shuffle, num_workers, drop_last=False):
         if data is None or isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
-                              num_workers=num_workers)
-        return data  # any iterable of batches
+                              num_workers=num_workers, drop_last=drop_last)
+        if iter(data) is data:
+            # a bare iterator/generator would be exhausted after one epoch;
+            # materialize so every epoch sees the data
+            return list(data)
+        return data  # any re-iterable of batches
 
     @staticmethod
     def _split_batch(batch):
-        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
-            return batch[:-1], batch[-1:]
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                return batch[:-1], batch[-1:]
+            return list(batch), []  # 1-tuple: unwrap, unlabeled
         return [batch], []
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
@@ -130,7 +136,8 @@ class Model:
         assert self._optimizer is not None and self._loss is not None, \
             "call prepare(optimizer, loss) before fit"
         self._save_dir = save_dir
-        loader = self._loader(train_data, batch_size, shuffle, num_workers)
+        loader = self._loader(train_data, batch_size, shuffle, num_workers,
+                              drop_last=drop_last)
         eval_loader = self._loader(eval_data, batch_size, False, num_workers)
         steps = len(loader) if hasattr(loader, "__len__") else None
         cbks = config_callbacks(callbacks, model=self, epochs=epochs, steps=steps,
@@ -161,19 +168,31 @@ class Model:
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None):
         loader = self._loader(eval_data, batch_size, False, num_workers)
-        cbks = callbacks if callbacks is not None else config_callbacks(
-            None, model=self, verbose=verbose, metrics=self._metrics_names())
+        if callbacks is None or isinstance(callbacks, (list, tuple)):
+            cbks = config_callbacks(callbacks, model=self, verbose=verbose,
+                                    metrics=self._metrics_names())
+        else:  # an already-configured CallbackList (fit's eval leg)
+            cbks = callbacks
         for m in self._metrics:
             m.reset()
         cbks.on_eval_begin()
         logs = {}
+        loss_sum, loss_n = 0.0, 0
         for step, batch in enumerate(loader):
             cbks.on_eval_batch_begin(step)
             ins, lbls = self._split_batch(batch)
             res = self.eval_batch(ins, lbls)
             logs = self._result_logs(res, prefix="")
+            if "loss" in logs:
+                bs = len(np.asarray(ins[0] if not isinstance(ins[0], Tensor)
+                                    else ins[0]._data))
+                loss_sum += logs["loss"] * bs
+                loss_n += bs
             cbks.on_eval_batch_end(step, logs)
+        # sample-weighted mean loss (reference averages eval loss) +
         # final accumulated metrics
+        if loss_n:
+            logs["loss"] = loss_sum / loss_n
         for m in self._metrics:
             logs[self._mname(m)] = m.accumulate()
         cbks.on_eval_end(logs)

@@ -163,11 +163,10 @@ class Auc(Metric):
         preds = preds.flatten()
         idx = np.clip((preds * self.num_thresholds).astype(np.int64),
                       0, self.num_thresholds)
-        for i, lbl in zip(idx, labels):
-            if lbl:
-                self._stat_pos[i] += 1
-            else:
-                self._stat_neg[i] += 1
+        pos = labels.astype(bool)
+        nb = self.num_thresholds + 1
+        self._stat_pos += np.bincount(idx[pos], minlength=nb)
+        self._stat_neg += np.bincount(idx[~pos], minlength=nb)
 
     def reset(self):
         self._stat_pos = np.zeros(self.num_thresholds + 1, np.int64)
