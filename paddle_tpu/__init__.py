@@ -144,11 +144,11 @@ def __getattr__(name):
 
         return ParamAttr
     if name == "get_flags":
-        from .flags import get_flags
+        from .framework.flags import get_flags
 
         return get_flags
     if name == "set_flags":
-        from .flags import set_flags
+        from .framework.flags import set_flags
 
         return set_flags
     if name == "set_default_dtype":

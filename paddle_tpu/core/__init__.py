@@ -1,0 +1,317 @@
+"""paddle_tpu.core — the native (C++) host-runtime layer.
+
+The reference keeps its host runtime in C++ (queues, allocators, shared
+memory, profiler — see SURVEY.md §2.1/§2.4/§5.1). On TPU, device-side
+execution belongs to XLA/PJRT, but the host side of the hot path — feeding
+batches, staging offloaded state, recording events — is still native here:
+``core.cc`` is compiled on first import (g++, cached by source hash) and
+bound over ctypes. Every facility has a pure-Python fallback so the package
+works on machines without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+__all__ = [
+    "native_available",
+    "BlockingQueue",
+    "PinnedPool",
+    "ShmRing",
+    "lib",
+]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "native", "core.cc")
+_lib = None
+_build_err = None
+
+
+def _build_and_load():
+    global _lib, _build_err
+    if _lib is not None or _build_err is not None:
+        return _lib
+    try:
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        so_path = os.path.join(_HERE, "native", f"libpaddle_tpu_core_{digest}.so")
+        if not os.path.exists(so_path):
+            tmp = so_path + f".tmp{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-fPIC", "-shared", "-pthread", "-std=c++14",
+                 _SRC, "-o", tmp, "-lrt"],
+                check=True, capture_output=True, timeout=120,
+            )
+            os.replace(tmp, so_path)  # atomic vs concurrent builders
+        lib = ctypes.CDLL(so_path)
+        _sig(lib)
+        _lib = lib
+    except Exception as e:  # no toolchain / sandbox — Python fallbacks take over
+        _build_err = e
+        _lib = None
+    return _lib
+
+
+def _sig(lib):
+    u64, i32, p = ctypes.c_uint64, ctypes.c_int, ctypes.c_void_p
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.pt_now_ns.restype = u64
+    lib.ptq_create.restype = p
+    lib.ptq_create.argtypes = [u64]
+    lib.ptq_push.restype = i32
+    lib.ptq_push.argtypes = [p, u8p, u64, i32]
+    lib.ptq_pop.restype = i32
+    lib.ptq_pop.argtypes = [p, ctypes.POINTER(u8p), ctypes.POINTER(u64), i32]
+    lib.ptq_size.restype = u64
+    lib.ptq_size.argtypes = [p]
+    lib.ptq_close.argtypes = [p]
+    lib.ptq_destroy.argtypes = [p]
+    lib.pt_free.argtypes = [p]
+    lib.ppool_create.restype = p
+    lib.ppool_create.argtypes = [u64, i32]
+    lib.ppool_alloc.restype = p
+    lib.ppool_alloc.argtypes = [p, u64]
+    lib.ppool_free.restype = i32
+    lib.ppool_free.argtypes = [p, p]
+    lib.ppool_stats.argtypes = [p, ctypes.POINTER(u64)]
+    lib.ppool_destroy.argtypes = [p]
+    lib.shmring_create.restype = p
+    lib.shmring_create.argtypes = [ctypes.c_char_p, u64, u64]
+    lib.shmring_attach.restype = p
+    lib.shmring_attach.argtypes = [ctypes.c_char_p]
+    lib.shmring_write.restype = i32
+    lib.shmring_write.argtypes = [p, u8p, u64, i32]
+    lib.shmring_read.restype = i32
+    lib.shmring_read.argtypes = [p, u8p, u64, ctypes.POINTER(u64), i32]
+    lib.shmring_count.restype = u64
+    lib.shmring_count.argtypes = [p]
+    lib.shmring_slot_size.restype = u64
+    lib.shmring_slot_size.argtypes = [p]
+    lib.shmring_close.argtypes = [p]
+    lib.shmring_destroy.argtypes = [p]
+    lib.prof_enable.argtypes = [i32]
+    lib.prof_is_enabled.restype = i32
+    lib.prof_push.argtypes = [ctypes.c_uint32]
+    lib.prof_pop.argtypes = []
+    lib.prof_collect.restype = u64
+    lib.prof_collect.argtypes = [ctypes.POINTER(u64), u64]
+    lib.prof_clear.argtypes = []
+
+
+def lib():
+    """The loaded native library, or None when unavailable."""
+    return _build_and_load()
+
+
+def native_available() -> bool:
+    return _build_and_load() is not None
+
+
+def build_error():
+    _build_and_load()
+    return _build_err
+
+
+# ---------------------------------------------------------------------------
+# BlockingQueue — parity: LoDTensorBlockingQueue (reader/
+# lod_tensor_blocking_queue.h). Bounded byte-blob queue; native when possible.
+# ---------------------------------------------------------------------------
+class _NativeQueue:
+    def __init__(self, capacity):
+        self._lib = lib()
+        self._h = self._lib.ptq_create(capacity)
+
+    def push(self, data: bytes, timeout_ms: int = -1) -> bool:
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        rc = self._lib.ptq_push(self._h, buf, len(data), timeout_ms)
+        if rc == -2:
+            raise RuntimeError("queue closed")
+        return rc == 0
+
+    def pop(self, timeout_ms: int = -1):
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_uint64()
+        rc = self._lib.ptq_pop(self._h, ctypes.byref(out), ctypes.byref(n), timeout_ms)
+        if rc == -1:
+            return None
+        if rc == -2:
+            raise EOFError("queue closed and drained")
+        try:
+            return ctypes.string_at(out, n.value)
+        finally:
+            self._lib.pt_free(out)
+
+    def size(self):
+        return self._lib.ptq_size(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.ptq_close(self._h)
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.ptq_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class _PyQueue:
+    def __init__(self, capacity):
+        import queue
+
+        self._q = queue.Queue(maxsize=capacity)
+        self._closed = threading.Event()
+
+    def push(self, data, timeout_ms=-1):
+        import queue
+
+        if self._closed.is_set():
+            raise RuntimeError("queue closed")
+        try:
+            self._q.put(data, timeout=None if timeout_ms < 0 else timeout_ms / 1000)
+            return True
+        except queue.Full:
+            return False
+
+    def pop(self, timeout_ms=-1):
+        import queue
+
+        remaining = None if timeout_ms < 0 else timeout_ms / 1000.0
+        while True:
+            wait = 0.05 if remaining is None else max(0.0, min(0.05, remaining))
+            try:
+                return self._q.get(timeout=wait)
+            except queue.Empty:
+                if self._closed.is_set() and self._q.empty():
+                    raise EOFError("queue closed and drained")
+                if remaining is not None:
+                    remaining -= 0.05
+                    if remaining <= 0:
+                        return None
+
+    def size(self):
+        return self._q.qsize()
+
+    def close(self):
+        self._closed.set()
+
+
+def BlockingQueue(capacity: int = 8):
+    return _NativeQueue(capacity) if native_available() else _PyQueue(capacity)
+
+
+# ---------------------------------------------------------------------------
+# PinnedPool — parity: AutoGrowthBestFitAllocator + pinned host memory
+# (memory/allocation/). Hands out numpy arrays backed by pool buffers.
+# ---------------------------------------------------------------------------
+class PinnedPool:
+    def __init__(self, chunk_size: int = 64 << 20, use_mlock: bool = False):
+        self._native = native_available()
+        if self._native:
+            self._lib = lib()
+            self._h = self._lib.ppool_create(chunk_size, 1 if use_mlock else 0)
+        self._live = {}
+
+    def alloc_array(self, shape, dtype):
+        """A numpy array on pool memory; free with :meth:`free_array`."""
+        import numpy as np
+
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize if len(shape) else dtype.itemsize
+        if not self._native:
+            return np.empty(shape, dtype)
+        ptr = self._lib.ppool_alloc(self._h, max(nbytes, 1))
+        if not ptr:
+            return np.empty(shape, dtype)
+        buf = (ctypes.c_uint8 * max(nbytes, 1)).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=dtype, count=int(np.prod(shape)) if len(shape) else 1).reshape(shape)
+        self._live[arr.__array_interface__["data"][0]] = ptr
+        return arr
+
+    def free_array(self, arr) -> bool:
+        if not self._native:
+            return True
+        addr = arr.__array_interface__["data"][0]
+        ptr = self._live.pop(addr, None)
+        if ptr is None:
+            return False
+        return self._lib.ppool_free(self._h, ptr) == 0
+
+    def stats(self):
+        if not self._native:
+            return {"total_alloc": 0, "in_use": 0, "chunks": 0, "free_blocks": 0}
+        out = (ctypes.c_uint64 * 4)()
+        self._lib.ppool_stats(self._h, out)
+        return {"total_alloc": out[0], "in_use": out[1], "chunks": out[2], "free_blocks": out[3]}
+
+    def __del__(self):
+        try:
+            if self._native and self._h:
+                self._lib.ppool_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# ShmRing — parity: mmap_allocator.cc + imperative/data_loader.cc shared-
+# memory DataLoader transport. Cross-process; attach by name.
+# ---------------------------------------------------------------------------
+class ShmRing:
+    def __init__(self, name: str, slot_size: int = 8 << 20, nslots: int = 8,
+                 create: bool = True):
+        if not native_available():
+            raise RuntimeError(f"native core unavailable: {build_error()}")
+        self._lib = lib()
+        self.name = name
+        if create:
+            self._h = self._lib.shmring_create(name.encode(), slot_size, nslots)
+        else:
+            self._h = self._lib.shmring_attach(name.encode())
+        if not self._h:
+            raise OSError(f"shmring_{'create' if create else 'attach'}({name}) failed")
+        self._rbuf = (ctypes.c_uint8 * self._lib.shmring_slot_size(self._h))()
+
+    def write(self, data: bytes, timeout_ms: int = -1) -> bool:
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        rc = self._lib.shmring_write(self._h, buf, len(data), timeout_ms)
+        if rc == -2:
+            raise EOFError("ring closed")
+        if rc == -3:
+            raise ValueError(f"payload {len(data)} exceeds slot size {self._lib.shmring_slot_size(self._h)}")
+        return rc == 0
+
+    def read(self, timeout_ms: int = -1):
+        buf = self._rbuf  # reused across calls; payload copied out below
+        n = ctypes.c_uint64()
+        rc = self._lib.shmring_read(self._h, buf, len(buf), ctypes.byref(n), timeout_ms)
+        if rc == -1:
+            return None
+        if rc == -2:
+            raise EOFError("ring closed and drained")
+        if rc == -4:
+            raise ValueError("slot payload larger than slot size (corrupt ring)")
+        return ctypes.string_at(buf, n.value)
+
+    def count(self):
+        return self._lib.shmring_count(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.shmring_close(self._h)
+
+    def destroy(self):
+        if self._h:
+            self._lib.shmring_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass

@@ -1,0 +1,574 @@
+// paddle_tpu native runtime core.
+//
+// TPU-native re-design of the reference's C++ host runtime pieces that are
+// NOT absorbed by XLA/PJRT:
+//   * BlockingQueue  — bounded byte-blob queue feeding the device prefetch
+//     pipeline (parity role: paddle/fluid/operators/reader/
+//     lod_tensor_blocking_queue.h + buffered_reader.cc).
+//   * PinnedPool     — auto-growth best-fit host allocator handing out
+//     aligned, optionally mlock'd buffers for batch collation and
+//     ZeRO-offload staging (parity role: paddle/fluid/memory/allocation/
+//     auto_growth_best_fit_allocator.cc + pinned_allocator.cc).
+//   * ShmRing        — process-shared ring of fixed slots over shm_open +
+//     process-shared pthread mutex/cond, the multiprocess DataLoader batch
+//     transport (parity role: paddle/fluid/memory/allocation/
+//     mmap_allocator.cc + imperative/data_loader.cc).
+//   * Profiler       — per-thread span recorder with nanosecond monotonic
+//     clocks (parity role: paddle/fluid/platform/profiler.cc RecordEvent);
+//     dumped to a flat file the Python side turns into chrome-trace.
+//
+// Exposed as a plain C ABI for ctypes (pybind11 is not in the image).
+// Build: g++ -O2 -fPIC -shared -pthread core.cc -o libpaddle_tpu_core.so
+
+#include <pthread.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <new>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// time
+// ---------------------------------------------------------------------------
+static inline uint64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+uint64_t pt_now_ns() { return now_ns(); }
+
+// ---------------------------------------------------------------------------
+// BlockingQueue: bounded queue of malloc'd byte blobs.
+// ---------------------------------------------------------------------------
+struct Blob {
+  uint8_t* data;
+  uint64_t size;
+};
+
+struct BlockingQueue {
+  std::mutex mu;
+  std::condition_variable not_empty;
+  std::condition_variable not_full;
+  std::deque<Blob> items;
+  size_t capacity;
+  bool closed = false;
+};
+
+void* ptq_create(uint64_t capacity) {
+  auto* q = new BlockingQueue();
+  q->capacity = capacity ? capacity : 1;
+  return q;
+}
+
+// returns 0 ok, -1 timeout, -2 closed
+int ptq_push(void* h, const uint8_t* data, uint64_t n, int timeout_ms) {
+  auto* q = static_cast<BlockingQueue*>(h);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto pred = [&] { return q->closed || q->items.size() < q->capacity; };
+  if (timeout_ms < 0) {
+    q->not_full.wait(lk, pred);
+  } else if (!q->not_full.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred)) {
+    return -1;
+  }
+  if (q->closed) return -2;
+  Blob b;
+  b.data = static_cast<uint8_t*>(malloc(n));
+  if (!b.data && n) return -3;
+  memcpy(b.data, data, n);
+  b.size = n;
+  q->items.push_back(b);
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// returns 0 ok (out malloc'd, caller frees via pt_free), -1 timeout, -2 closed+empty
+int ptq_pop(void* h, uint8_t** out, uint64_t* n, int timeout_ms) {
+  auto* q = static_cast<BlockingQueue*>(h);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto pred = [&] { return q->closed || !q->items.empty(); };
+  if (timeout_ms < 0) {
+    q->not_empty.wait(lk, pred);
+  } else if (!q->not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred)) {
+    return -1;
+  }
+  if (q->items.empty()) return -2;  // closed and drained
+  Blob b = q->items.front();
+  q->items.pop_front();
+  *out = b.data;
+  *n = b.size;
+  q->not_full.notify_one();
+  return 0;
+}
+
+uint64_t ptq_size(void* h) {
+  auto* q = static_cast<BlockingQueue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->items.size();
+}
+
+void ptq_close(void* h) {
+  auto* q = static_cast<BlockingQueue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->closed = true;
+  q->not_empty.notify_all();
+  q->not_full.notify_all();
+}
+
+void ptq_destroy(void* h) {
+  auto* q = static_cast<BlockingQueue*>(h);
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    for (auto& b : q->items) free(b.data);
+    q->items.clear();
+  }
+  delete q;
+}
+
+void pt_free(void* p) { free(p); }
+
+// ---------------------------------------------------------------------------
+// PinnedPool: best-fit, auto-growth chunked host allocator.
+// Free blocks keyed by size in a multimap; adjacent-free coalescing.
+// ---------------------------------------------------------------------------
+struct PoolBlock {
+  uint64_t offset;
+  uint64_t size;
+  int chunk;
+  bool free_;
+};
+
+struct PoolChunk {
+  uint8_t* base;
+  uint64_t size;
+};
+
+struct PinnedPool {
+  std::mutex mu;
+  std::vector<PoolChunk> chunks;
+  // offset-ordered block list per chunk for coalescing
+  std::map<std::pair<int, uint64_t>, PoolBlock> blocks;  // (chunk, offset) -> block
+  std::multimap<uint64_t, std::pair<int, uint64_t>> free_by_size;
+  std::unordered_map<void*, std::pair<int, uint64_t>> live;  // ptr -> key
+  uint64_t chunk_size;
+  uint64_t total_alloc = 0, total_in_use = 0;
+  bool use_mlock;
+  uint64_t alignment = 64;
+};
+
+static void pool_insert_free(PinnedPool* p, PoolBlock b) {
+  b.free_ = true;
+  auto key = std::make_pair(b.chunk, b.offset);
+  p->blocks[key] = b;
+  p->free_by_size.emplace(b.size, key);
+}
+
+static void pool_erase_free_index(PinnedPool* p, const PoolBlock& b) {
+  auto range = p->free_by_size.equal_range(b.size);
+  for (auto it = range.first; it != range.second; ++it) {
+    if (it->second == std::make_pair(b.chunk, b.offset)) {
+      p->free_by_size.erase(it);
+      return;
+    }
+  }
+}
+
+void* ppool_create(uint64_t chunk_size, int use_mlock) {
+  auto* p = new PinnedPool();
+  p->chunk_size = chunk_size ? chunk_size : (64ull << 20);
+  p->use_mlock = use_mlock != 0;
+  return p;
+}
+
+static int pool_grow(PinnedPool* p, uint64_t need) {
+  uint64_t sz = p->chunk_size;
+  while (sz < need) sz <<= 1;
+  void* mem = nullptr;
+  if (posix_memalign(&mem, 4096, sz) != 0) return -1;
+  if (p->use_mlock) mlock(mem, sz);  // best-effort pin
+  PoolChunk c{static_cast<uint8_t*>(mem), sz};
+  p->chunks.push_back(c);
+  p->total_alloc += sz;
+  PoolBlock b{0, sz, static_cast<int>(p->chunks.size() - 1), true};
+  pool_insert_free(p, b);
+  return 0;
+}
+
+void* ppool_alloc(void* h, uint64_t size) {
+  auto* p = static_cast<PinnedPool*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  uint64_t need = (size + p->alignment - 1) & ~(p->alignment - 1);
+  if (!need) need = p->alignment;
+  auto it = p->free_by_size.lower_bound(need);  // best fit
+  if (it == p->free_by_size.end()) {
+    if (pool_grow(p, need) != 0) return nullptr;
+    it = p->free_by_size.lower_bound(need);
+    if (it == p->free_by_size.end()) return nullptr;
+  }
+  auto key = it->second;
+  PoolBlock b = p->blocks[key];
+  p->free_by_size.erase(it);
+  p->blocks.erase(key);
+  if (b.size > need + p->alignment) {  // split tail back to free list
+    PoolBlock rest{b.offset + need, b.size - need, b.chunk, true};
+    pool_insert_free(p, rest);
+    b.size = need;
+  }
+  b.free_ = false;
+  p->blocks[std::make_pair(b.chunk, b.offset)] = b;
+  void* ptr = p->chunks[b.chunk].base + b.offset;
+  p->live[ptr] = std::make_pair(b.chunk, b.offset);
+  p->total_in_use += b.size;
+  return ptr;
+}
+
+int ppool_free(void* h, void* ptr) {
+  auto* p = static_cast<PinnedPool*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  auto lit = p->live.find(ptr);
+  if (lit == p->live.end()) return -1;
+  auto key = lit->second;
+  p->live.erase(lit);
+  PoolBlock b = p->blocks[key];
+  p->blocks.erase(key);
+  p->total_in_use -= b.size;
+  // coalesce with next
+  auto nkey = std::make_pair(b.chunk, b.offset + b.size);
+  auto nit = p->blocks.find(nkey);
+  if (nit != p->blocks.end() && nit->second.free_) {
+    pool_erase_free_index(p, nit->second);
+    b.size += nit->second.size;
+    p->blocks.erase(nit);
+  }
+  // coalesce with prev
+  auto pit = p->blocks.lower_bound(std::make_pair(b.chunk, b.offset));
+  if (pit != p->blocks.begin()) {
+    --pit;
+    if (pit->first.first == b.chunk && pit->second.free_ &&
+        pit->second.offset + pit->second.size == b.offset) {
+      pool_erase_free_index(p, pit->second);
+      b.offset = pit->second.offset;
+      b.size += pit->second.size;
+      p->blocks.erase(pit);
+    }
+  }
+  pool_insert_free(p, b);
+  return 0;
+}
+
+void ppool_stats(void* h, uint64_t* out4) {
+  auto* p = static_cast<PinnedPool*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  out4[0] = p->total_alloc;
+  out4[1] = p->total_in_use;
+  out4[2] = p->chunks.size();
+  out4[3] = p->free_by_size.size();
+}
+
+void ppool_destroy(void* h) {
+  auto* p = static_cast<PinnedPool*>(h);
+  for (auto& c : p->chunks) {
+    if (p->use_mlock) munlock(c.base, c.size);
+    free(c.base);
+  }
+  delete p;
+}
+
+// ---------------------------------------------------------------------------
+// ShmRing: fixed-slot ring in POSIX shared memory, process-shared
+// pthread mutex + conds in the header. Writers (dataloader workers) block
+// when full; the reader (trainer proc) blocks when empty.
+// Slot payload: uint64 len + bytes.
+// ---------------------------------------------------------------------------
+struct ShmHeader {
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+  uint64_t nslots;
+  uint64_t slot_size;
+  uint64_t head;   // next slot to read
+  uint64_t tail;   // next slot to write
+  uint64_t count;  // filled slots
+  int32_t closed;
+  int32_t _pad;
+};
+
+struct ShmRing {
+  ShmHeader* hdr;
+  uint8_t* slots;
+  uint64_t map_size;
+  std::string name;
+  bool owner;
+};
+
+static uint64_t shm_total_size(uint64_t slot_size, uint64_t nslots) {
+  return sizeof(ShmHeader) + nslots * (sizeof(uint64_t) + slot_size);
+}
+
+void* shmring_create(const char* name, uint64_t slot_size, uint64_t nslots) {
+  shm_unlink(name);  // stale from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t total = shm_total_size(slot_size, nslots);
+  if (ftruncate(fd, total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* hdr = static_cast<ShmHeader*>(mem);
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+#ifdef PTHREAD_MUTEX_ROBUST
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+#endif
+  pthread_mutex_init(&hdr->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&hdr->not_empty, &ca);
+  pthread_cond_init(&hdr->not_full, &ca);
+  hdr->nslots = nslots;
+  hdr->slot_size = slot_size;
+  hdr->head = hdr->tail = hdr->count = 0;
+  hdr->closed = 0;
+  auto* r = new ShmRing();
+  r->hdr = hdr;
+  r->slots = static_cast<uint8_t*>(mem) + sizeof(ShmHeader);
+  r->map_size = total;
+  r->name = name;
+  r->owner = true;
+  return r;
+}
+
+void* shmring_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* r = new ShmRing();
+  r->hdr = static_cast<ShmHeader*>(mem);
+  r->slots = static_cast<uint8_t*>(mem) + sizeof(ShmHeader);
+  r->map_size = st.st_size;
+  r->name = name;
+  r->owner = false;
+  return r;
+}
+
+static uint8_t* slot_ptr(ShmRing* r, uint64_t idx) {
+  return r->slots + idx * (sizeof(uint64_t) + r->hdr->slot_size);
+}
+
+// 0 ok, -1 timeout, -2 closed, -3 too large
+int shmring_write(void* h, const uint8_t* data, uint64_t n, int timeout_ms) {
+  auto* r = static_cast<ShmRing*>(h);
+  ShmHeader* hd = r->hdr;
+  if (n > hd->slot_size) return -3;
+  pthread_mutex_lock(&hd->mu);
+  while (hd->count == hd->nslots && !hd->closed) {
+    if (timeout_ms < 0) {
+      pthread_cond_wait(&hd->not_full, &hd->mu);
+    } else {
+      struct timespec ts;
+      clock_gettime(CLOCK_REALTIME, &ts);
+      ts.tv_sec += timeout_ms / 1000;
+      ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+      if (ts.tv_nsec >= 1000000000L) { ts.tv_sec++; ts.tv_nsec -= 1000000000L; }
+      if (pthread_cond_timedwait(&hd->not_full, &hd->mu, &ts) != 0) {
+        pthread_mutex_unlock(&hd->mu);
+        return -1;
+      }
+    }
+  }
+  if (hd->closed) {
+    pthread_mutex_unlock(&hd->mu);
+    return -2;
+  }
+  uint8_t* slot = slot_ptr(r, hd->tail);
+  memcpy(slot, &n, sizeof(uint64_t));
+  memcpy(slot + sizeof(uint64_t), data, n);
+  hd->tail = (hd->tail + 1) % hd->nslots;
+  hd->count++;
+  pthread_cond_signal(&hd->not_empty);
+  pthread_mutex_unlock(&hd->mu);
+  return 0;
+}
+
+// 0 ok, -1 timeout, -2 closed+drained, -4 buffer too small (len in *n)
+int shmring_read(void* h, uint8_t* buf, uint64_t cap, uint64_t* n, int timeout_ms) {
+  auto* r = static_cast<ShmRing*>(h);
+  ShmHeader* hd = r->hdr;
+  pthread_mutex_lock(&hd->mu);
+  while (hd->count == 0 && !hd->closed) {
+    if (timeout_ms < 0) {
+      pthread_cond_wait(&hd->not_empty, &hd->mu);
+    } else {
+      struct timespec ts;
+      clock_gettime(CLOCK_REALTIME, &ts);
+      ts.tv_sec += timeout_ms / 1000;
+      ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+      if (ts.tv_nsec >= 1000000000L) { ts.tv_sec++; ts.tv_nsec -= 1000000000L; }
+      if (pthread_cond_timedwait(&hd->not_empty, &hd->mu, &ts) != 0) {
+        pthread_mutex_unlock(&hd->mu);
+        return -1;
+      }
+    }
+  }
+  if (hd->count == 0) {
+    pthread_mutex_unlock(&hd->mu);
+    return -2;
+  }
+  uint8_t* slot = slot_ptr(r, hd->head);
+  uint64_t len;
+  memcpy(&len, slot, sizeof(uint64_t));
+  *n = len;
+  if (len > cap) {
+    pthread_mutex_unlock(&hd->mu);
+    return -4;
+  }
+  memcpy(buf, slot + sizeof(uint64_t), len);
+  hd->head = (hd->head + 1) % hd->nslots;
+  hd->count--;
+  pthread_cond_signal(&hd->not_full);
+  pthread_mutex_unlock(&hd->mu);
+  return 0;
+}
+
+uint64_t shmring_count(void* h) {
+  auto* r = static_cast<ShmRing*>(h);
+  pthread_mutex_lock(&r->hdr->mu);
+  uint64_t c = r->hdr->count;
+  pthread_mutex_unlock(&r->hdr->mu);
+  return c;
+}
+
+uint64_t shmring_slot_size(void* h) {
+  return static_cast<ShmRing*>(h)->hdr->slot_size;
+}
+
+void shmring_close(void* h) {
+  auto* r = static_cast<ShmRing*>(h);
+  pthread_mutex_lock(&r->hdr->mu);
+  r->hdr->closed = 1;
+  pthread_cond_broadcast(&r->hdr->not_empty);
+  pthread_cond_broadcast(&r->hdr->not_full);
+  pthread_mutex_unlock(&r->hdr->mu);
+}
+
+void shmring_destroy(void* h) {
+  auto* r = static_cast<ShmRing*>(h);
+  bool owner = r->owner;
+  std::string name = r->name;
+  munmap(r->hdr, r->map_size);
+  if (owner) shm_unlink(name.c_str());
+  delete r;
+}
+
+// ---------------------------------------------------------------------------
+// Profiler: per-thread span buffers. Python assigns names once via interning.
+// ---------------------------------------------------------------------------
+struct ProfEvent {
+  uint32_t name_id;
+  uint32_t depth;
+  uint64_t t0, t1;
+};
+
+struct ProfThreadBuf {
+  std::vector<ProfEvent> events;
+  std::vector<std::pair<uint32_t, uint64_t>> stack;  // (name_id, t0)
+  uint64_t tid;
+};
+
+static std::mutex g_prof_mu;
+static std::vector<ProfThreadBuf*> g_prof_bufs;
+static std::atomic<bool> g_prof_enabled{false};
+static std::atomic<uint64_t> g_tid_counter{0};
+
+static thread_local ProfThreadBuf* tl_buf = nullptr;
+
+static ProfThreadBuf* prof_buf() {
+  if (!tl_buf) {
+    tl_buf = new ProfThreadBuf();
+    tl_buf->tid = g_tid_counter.fetch_add(1);
+    std::lock_guard<std::mutex> lk(g_prof_mu);
+    g_prof_bufs.push_back(tl_buf);
+  }
+  return tl_buf;
+}
+
+void prof_enable(int on) { g_prof_enabled.store(on != 0); }
+int prof_is_enabled() { return g_prof_enabled.load() ? 1 : 0; }
+
+void prof_push(uint32_t name_id) {
+  if (!g_prof_enabled.load(std::memory_order_relaxed)) return;
+  auto* b = prof_buf();
+  b->stack.emplace_back(name_id, now_ns());
+}
+
+void prof_pop() {
+  if (!tl_buf || tl_buf->stack.empty()) return;
+  auto top = tl_buf->stack.back();
+  tl_buf->stack.pop_back();
+  ProfEvent e;
+  e.name_id = top.first;
+  e.depth = static_cast<uint32_t>(tl_buf->stack.size());
+  e.t0 = top.second;
+  e.t1 = now_ns();
+  tl_buf->events.push_back(e);
+}
+
+// Copies out as flat u64 quads: (tid, name_id | depth<<32, t0, t1). Returns
+// number of events copied; call with nullptr to query count.
+uint64_t prof_collect(uint64_t* out, uint64_t cap) {
+  std::lock_guard<std::mutex> lk(g_prof_mu);
+  uint64_t total = 0;
+  for (auto* b : g_prof_bufs) total += b->events.size();
+  if (!out) return total;
+  uint64_t written = 0;
+  for (auto* b : g_prof_bufs) {
+    for (auto& e : b->events) {
+      if (written >= cap) return written;
+      out[written * 4 + 0] = b->tid;
+      out[written * 4 + 1] = (static_cast<uint64_t>(e.depth) << 32) | e.name_id;
+      out[written * 4 + 2] = e.t0;
+      out[written * 4 + 3] = e.t1;
+      written++;
+    }
+  }
+  return written;
+}
+
+void prof_clear() {
+  std::lock_guard<std::mutex> lk(g_prof_mu);
+  for (auto* b : g_prof_bufs) b->events.clear();
+}
+
+}  // extern "C"

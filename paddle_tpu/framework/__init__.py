@@ -1,3 +1,5 @@
 """paddle_tpu.framework — save/load, defaults, misc framework surface."""
 from .io import load, save  # noqa: F401
 from .dtype_default import get_default_dtype, set_default_dtype  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+from . import monitor  # noqa: F401

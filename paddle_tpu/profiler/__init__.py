@@ -1,0 +1,258 @@
+"""paddle_tpu.profiler — host span profiler + TPU (xplane) bridge.
+
+Parity role: the reference instruments every op with ``platform::RecordEvent``
+RAII spans (platform/profiler.h:130), aggregates them into a summary table on
+``DisableProfiler`` (profiler_helper.h), correlates device kernels via CUPTI
+(device_tracer.cc), and exports a chrome-trace timeline through
+``fluid/profiler.py``. The TPU build keeps that API:
+
+* :class:`RecordEvent` — context-manager/decorator span. Recorded natively
+  (paddle_tpu.core prof_push/prof_pop, nanosecond steady clock) when the C++
+  core is available, else in Python.
+* :func:`start_profiler` / :func:`stop_profiler` / :func:`profiler` — the
+  fluid.profiler surface; ``stop_profiler`` prints the aggregate table and
+  optionally writes a chrome-trace JSON.
+* Device-side tracing is XLA's own: ``tracer_option='All'`` brackets the range
+  with ``jax.profiler.start_trace`` so TensorBoard xplane dumps land next to
+  the host trace (replacing the CUPTI DeviceTracer).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "RecordEvent",
+    "record_event",
+    "start_profiler",
+    "stop_profiler",
+    "profiler",
+    "export_chrome_tracing",
+    "summary",
+    "reset",
+]
+
+_lock = threading.Lock()
+_name_to_id: Dict[str, int] = {}
+_id_to_name: List[str] = []
+_enabled = False
+_jax_trace_dir: Optional[str] = None
+
+# python-fallback event store: list of (tid, depth, name_id, t0, t1)
+_py_events: List[tuple] = []
+_py_stack = threading.local()
+
+
+def _native():
+    from .. import core
+
+    return core.lib() if core.native_available() else None
+
+
+def _intern(name: str) -> int:
+    with _lock:
+        i = _name_to_id.get(name)
+        if i is None:
+            i = len(_id_to_name)
+            _name_to_id[name] = i
+            _id_to_name.append(name)
+        return i
+
+
+class RecordEvent:
+    """``with RecordEvent("forward"):`` — or use as a decorator via
+    :func:`record_event`. Nesting builds a flame stack."""
+
+    __slots__ = ("name", "_nid")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._nid = None
+
+    def begin(self):
+        if not _enabled:
+            return
+        self._nid = _intern(self.name)
+        lib = _native()
+        if lib is not None:
+            lib.prof_push(self._nid)
+        else:
+            stack = getattr(_py_stack, "s", None)
+            if stack is None:
+                stack = _py_stack.s = []
+            stack.append((self._nid, time.perf_counter_ns()))
+
+    def end(self):
+        if self._nid is None:
+            return
+        lib = _native()
+        if lib is not None:
+            lib.prof_pop()
+        else:
+            stack = getattr(_py_stack, "s", [])
+            if stack:
+                nid, t0 = stack.pop()
+                _py_events.append(
+                    (threading.get_ident(), len(stack), nid, t0, time.perf_counter_ns())
+                )
+        self._nid = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def record_event(name: str):
+    """Decorator form of :class:`RecordEvent`."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with RecordEvent(name):
+                return fn(*a, **k)
+
+        return wrapper
+
+    return deco
+
+
+def reset():
+    global _py_events
+    lib = _native()
+    if lib is not None:
+        lib.prof_clear()
+    _py_events = []
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default",
+                   trace_dir: Optional[str] = None):
+    """Parity: fluid.profiler.start_profiler. ``state`` kept for signature
+    compatibility ('CPU'|'GPU'|'All' — host spans always; device via XLA).
+    ``tracer_option='All'`` (or 'OpDetail') also starts a jax.profiler trace
+    into ``trace_dir`` (TensorBoard xplane)."""
+    global _enabled, _jax_trace_dir
+    reset()
+    _enabled = True
+    lib = _native()
+    if lib is not None:
+        lib.prof_enable(1)
+    if state in ("GPU", "All") and tracer_option in ("All", "OpDetail"):
+        try:
+            import jax
+
+            _jax_trace_dir = trace_dir or os.path.join(os.getcwd(), "xplane_trace")
+            jax.profiler.start_trace(_jax_trace_dir)
+        except Exception:
+            _jax_trace_dir = None
+
+
+def _collect():
+    """All finished spans as (tid, depth, name, t0_ns, t1_ns)."""
+    out = []
+    lib = _native()
+    if lib is not None:
+        import ctypes
+
+        n = lib.prof_collect(None, 0)
+        if n:
+            buf = (ctypes.c_uint64 * (4 * n))()
+            n = lib.prof_collect(buf, n)
+            for i in range(n):
+                tid = buf[i * 4]
+                packed = buf[i * 4 + 1]
+                nid, depth = packed & 0xFFFFFFFF, packed >> 32
+                name = _id_to_name[nid] if nid < len(_id_to_name) else f"event_{nid}"
+                out.append((tid, depth, name, buf[i * 4 + 2], buf[i * 4 + 3]))
+    for tid, depth, nid, t0, t1 in _py_events:
+        name = _id_to_name[nid] if nid < len(_id_to_name) else f"event_{nid}"
+        out.append((tid, depth, name, t0, t1))
+    return out
+
+
+def summary(sorted_by: str = "total") -> List[dict]:
+    """Aggregate table rows (parity: profiler_helper.h summary)."""
+    rows: Dict[str, dict] = {}
+    total_time = 0.0
+    for _tid, depth, name, t0, t1 in _collect():
+        dt = (t1 - t0) / 1e6  # ms
+        r = rows.setdefault(name, {"name": name, "calls": 0, "total_ms": 0.0,
+                                   "min_ms": float("inf"), "max_ms": 0.0})
+        r["calls"] += 1
+        r["total_ms"] += dt
+        r["min_ms"] = min(r["min_ms"], dt)
+        r["max_ms"] = max(r["max_ms"], dt)
+        if depth == 0:
+            total_time += dt
+    for r in rows.values():
+        r["avg_ms"] = r["total_ms"] / r["calls"]
+        r["ratio"] = (r["total_ms"] / total_time) if total_time else 0.0
+    key = {"total": "total_ms", "calls": "calls", "max": "max_ms",
+           "min": "min_ms", "ave": "avg_ms", "avg": "avg_ms"}.get(sorted_by, "total_ms")
+    return sorted(rows.values(), key=lambda r: r[key], reverse=True)
+
+
+def export_chrome_tracing(path: str):
+    """chrome://tracing-loadable JSON of the host spans (parity:
+    DeviceTracer GenProfile → timeline; tools/timeline.py)."""
+    events = []
+    for tid, _depth, name, t0, t1 in _collect():
+        events.append({"name": name, "ph": "X", "pid": os.getpid(), "tid": int(tid),
+                       "ts": t0 / 1e3, "dur": (t1 - t0) / 1e3, "cat": "host"})
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return path
+
+
+def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None,
+                  print_table: bool = True):
+    """Parity: fluid.profiler.stop_profiler — ends collection, prints the
+    summary table, optionally writes chrome trace to ``profile_path``."""
+    global _enabled, _jax_trace_dir
+    _enabled = False
+    lib = _native()
+    if lib is not None:
+        lib.prof_enable(0)
+    if _jax_trace_dir is not None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _jax_trace_dir = None
+    table = summary(sorted_key)
+    if print_table and table:
+        hdr = f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>10}{'Min(ms)':>10}{'Max(ms)':>10}{'Ratio':>8}"
+        print("-" * len(hdr))
+        print(hdr)
+        print("-" * len(hdr))
+        for r in table:
+            print(f"{r['name'][:39]:<40}{r['calls']:>8}{r['total_ms']:>12.3f}"
+                  f"{r['avg_ms']:>10.3f}{r['min_ms']:>10.3f}{r['max_ms']:>10.3f}"
+                  f"{r['ratio']:>8.2%}")
+        print("-" * len(hdr))
+    if profile_path:
+        export_chrome_tracing(profile_path)
+    return table
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             profile_path: Optional[str] = None, tracer_option: str = "Default",
+             print_table: bool = True):
+    """Parity: ``with fluid.profiler.profiler('All', 'total', path):``"""
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path, print_table)

@@ -1,0 +1,249 @@
+"""Native C++ core, profiler, flags, monitor tests.
+
+Parity targets: reader/lod_tensor_blocking_queue.h (queue),
+memory/allocation/auto_growth_best_fit_allocator.cc (pool),
+memory/allocation/mmap_allocator.cc (shm ring), platform/profiler.h
+(RecordEvent), platform/flags.cc + monitor.h (flags/stats).
+"""
+import multiprocessing as mp
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import core
+from paddle_tpu.framework import monitor
+from paddle_tpu.framework.flags import flag, get_flags, set_flags
+
+
+class TestBlockingQueue:
+    def test_fifo_roundtrip(self):
+        q = core.BlockingQueue(4)
+        for i in range(4):
+            assert q.push(bytes([i]) * (i + 1))
+        assert q.size() == 4
+        for i in range(4):
+            assert q.pop() == bytes([i]) * (i + 1)
+
+    def test_bounded_blocks_then_timeout(self):
+        q = core.BlockingQueue(1)
+        q.push(b"a")
+        t0 = time.time()
+        assert q.push(b"b", timeout_ms=80) is False
+        assert time.time() - t0 >= 0.05
+
+    def test_pop_timeout_returns_none(self):
+        q = core.BlockingQueue(1)
+        assert q.pop(timeout_ms=50) is None
+
+    def test_close_drains_then_eof(self):
+        q = core.BlockingQueue(4)
+        q.push(b"x")
+        q.close()
+        assert q.pop() == b"x"
+        with pytest.raises(EOFError):
+            q.pop(timeout_ms=100)
+
+    def test_producer_consumer_threads(self):
+        q = core.BlockingQueue(2)
+        got = []
+
+        def consumer():
+            while True:
+                try:
+                    item = q.pop(timeout_ms=2000)
+                except EOFError:
+                    return
+                if item is not None:
+                    got.append(item)
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        for i in range(50):
+            q.push(str(i).encode())
+        q.close()
+        t.join(timeout=5)
+        assert [int(x) for x in got] == list(range(50))
+
+
+class TestPinnedPool:
+    def test_alloc_reuse_and_coalesce(self):
+        pool = core.PinnedPool(chunk_size=1 << 20)
+        a = pool.alloc_array((256, 256), np.float32)
+        b = pool.alloc_array((128,), np.int64)
+        a[:] = 2.5
+        b[:] = 7
+        assert float(a.sum()) == 2.5 * 256 * 256
+        assert int(b.sum()) == 7 * 128
+        if core.native_available():
+            s = pool.stats()
+            assert s["in_use"] >= 256 * 256 * 4 + 128 * 8
+            assert pool.free_array(a) and pool.free_array(b)
+            # all freed blocks coalesce back into one chunk-sized block
+            s2 = pool.stats()
+            assert s2["in_use"] == 0
+            assert s2["free_blocks"] == 1
+
+    def test_auto_growth_beyond_chunk(self):
+        pool = core.PinnedPool(chunk_size=4096)
+        big = pool.alloc_array((1 << 16,), np.uint8)  # 64 KiB > chunk
+        big[:] = 1
+        assert int(big.sum()) == 1 << 16
+
+
+@pytest.mark.skipif(not core.native_available(), reason="needs native core")
+class TestShmRing:
+    def test_same_process_roundtrip(self):
+        r = core.ShmRing(f"/pt_t1_{os.getpid()}", slot_size=4096, nslots=2)
+        r.write(b"abc")
+        r.write(b"defg")
+        assert r.count() == 2
+        assert r.read() == b"abc"
+        assert r.read() == b"defg"
+        r.destroy()
+
+    def test_cross_process(self):
+        name = f"/pt_t2_{os.getpid()}"
+        r = core.ShmRing(name, slot_size=1 << 16, nslots=4)
+
+        def child(n):
+            from paddle_tpu.core import ShmRing
+
+            w = ShmRing(n, create=False)
+            for i in range(20):
+                w.write(np.full(100, i, np.int32).tobytes())
+            w._h = None
+
+        p = mp.get_context("fork").Process(target=child, args=(name,))
+        p.start()
+        vals = []
+        for _ in range(20):
+            data = r.read(timeout_ms=5000)
+            assert data is not None
+            vals.append(int(np.frombuffer(data, np.int32)[0]))
+        p.join(timeout=5)
+        r.destroy()
+        assert vals == list(range(20))
+
+    def test_oversize_rejected(self):
+        r = core.ShmRing(f"/pt_t3_{os.getpid()}", slot_size=64, nslots=2)
+        with pytest.raises(ValueError):
+            r.write(b"z" * 100)
+        r.destroy()
+
+
+class TestProfiler:
+    def test_record_and_summary(self):
+        from paddle_tpu import profiler
+
+        profiler.start_profiler("CPU")
+        with profiler.RecordEvent("outer"):
+            time.sleep(0.01)
+            with profiler.RecordEvent("inner"):
+                time.sleep(0.005)
+        with profiler.RecordEvent("outer"):
+            time.sleep(0.002)
+        table = profiler.stop_profiler(print_table=False)
+        rows = {r["name"]: r for r in table}
+        assert rows["outer"]["calls"] == 2
+        assert rows["inner"]["calls"] == 1
+        assert rows["outer"]["total_ms"] >= 10.0
+        assert rows["inner"]["total_ms"] >= 4.0
+
+    def test_chrome_trace_export(self, tmp_path):
+        import json
+
+        from paddle_tpu import profiler
+
+        profiler.start_profiler("CPU")
+        with profiler.RecordEvent("step"):
+            time.sleep(0.001)
+        path = str(tmp_path / "trace.json")
+        profiler.stop_profiler(profile_path=path, print_table=False)
+        with open(path) as f:
+            trace = json.load(f)
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert "step" in names
+
+    def test_disabled_is_noop(self):
+        from paddle_tpu import profiler
+
+        profiler.reset()
+        with profiler.RecordEvent("ignored"):
+            pass
+        assert all(r["name"] != "ignored" for r in profiler.summary())
+
+    def test_decorator(self):
+        from paddle_tpu import profiler
+
+        @profiler.record_event("fn_span")
+        def f(x):
+            return x + 1
+
+        profiler.start_profiler("CPU")
+        assert f(1) == 2
+        table = profiler.stop_profiler(print_table=False)
+        assert any(r["name"] == "fn_span" for r in table)
+
+
+class TestFlagsMonitor:
+    def test_set_get_roundtrip(self):
+        set_flags({"FLAGS_benchmark": True})
+        assert get_flags("FLAGS_benchmark")["FLAGS_benchmark"] is True
+        set_flags({"FLAGS_benchmark": "false"})
+        assert flag("FLAGS_benchmark") is False
+
+    def test_unknown_flag_raises(self):
+        with pytest.raises(ValueError):
+            set_flags({"FLAGS_does_not_exist": 1})
+
+    def test_get_all(self):
+        allf = get_flags()
+        assert "FLAGS_check_nan_inf" in allf
+        assert "FLAGS_allocator_strategy" in allf
+
+    def test_top_level_api(self):
+        import paddle_tpu as paddle
+
+        paddle.set_flags({"FLAGS_eager_delete_tensor_gb": 1.5})
+        assert paddle.get_flags("FLAGS_eager_delete_tensor_gb")[
+            "FLAGS_eager_delete_tensor_gb"] == 1.5
+
+    def test_check_nan_inf_toggles_debug_nans(self):
+        import jax
+
+        set_flags({"FLAGS_check_nan_inf": True})
+        assert jax.config.jax_debug_nans
+        set_flags({"FLAGS_check_nan_inf": False})
+        assert not jax.config.jax_debug_nans
+
+    def test_monitor_stats(self):
+        monitor.stat_reset()
+        monitor.stat_add("STAT_host_batches", 3)
+        monitor.stat_add("STAT_host_batches", 2)
+        monitor.stat_set("STAT_steps", 10)
+        assert monitor.stat_get("STAT_host_batches") == 5
+        assert monitor.all_stats()["STAT_steps"] == 10
+
+
+class TestDataLoaderShm:
+    def test_multiprocess_ring_loader(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.io.dataset import Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                return np.full((8, 8), i, np.float32), np.int64(i)
+
+        dl = DataLoader(DS(), batch_size=4, num_workers=2, shuffle=False,
+                        device_prefetch=False, use_shared_memory=True)
+        seen = []
+        for x, y in dl:
+            assert tuple(np.asarray(x.numpy()).shape) == (4, 8, 8)
+            seen.extend(np.asarray(y.numpy()).tolist())
+        assert seen == list(range(32))
