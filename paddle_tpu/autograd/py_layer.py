@@ -1,0 +1,122 @@
+"""PyLayer: user-defined eager ops with custom backward.
+
+Parity: ``paddle.autograd.PyLayer`` (reference
+python/paddle/autograd/py_layer.py; C++ side py_layer_op
+/root/reference/paddle/fluid/operators/py_layer_op.cc).
+
+TPU-native redesign: the reference routes custom backward through a dedicated
+``py_layer`` operator holding Python callables. Here a PyLayer is just a tape
+Node whose vjp closure calls the user's ``backward`` — no operator machinery.
+The forward runs eagerly under ``no_grad`` (its internal graph is discarded;
+only the user-provided backward defines the derivative), exactly matching the
+reference's semantics where forward ops are not double-recorded.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from . import tape
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    """Context passed to forward/backward; carries saved tensors and any
+    user attributes (parity: PyLayerContext.save_for_backward/saved_tensor)."""
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+def _is_tensor(x) -> bool:
+    from ..tensor import Tensor
+
+    return isinstance(x, Tensor)
+
+
+class PyLayer:
+    """Subclass with ``forward(ctx, *args)`` and ``backward(ctx, *grads)``
+    staticmethods; call via ``MyLayer.apply(*args)``.
+
+    ``backward`` must return one gradient (or ``None``) per Tensor argument of
+    ``forward``, in order — the reference enforces the same contract.
+    """
+
+    @staticmethod
+    def forward(ctx: PyLayerContext, *args: Any, **kwargs: Any):
+        raise NotImplementedError(
+            "PyLayer subclasses must implement a forward staticmethod"
+        )
+
+    @staticmethod
+    def backward(ctx: PyLayerContext, *grads: Any):
+        raise NotImplementedError(
+            "PyLayer subclasses must implement a backward staticmethod"
+        )
+
+    @classmethod
+    def apply(cls, *args: Any, **kwargs: Any):
+        from ..tensor import Tensor
+
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if _is_tensor(a)] + [
+            v for v in kwargs.values() if _is_tensor(v)
+        ]
+
+        with tape.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+
+        multi_out = isinstance(outs, (tuple, list))
+        out_seq = list(outs) if multi_out else [outs]
+        tensor_out_pos = [i for i, o in enumerate(out_seq) if _is_tensor(o)]
+        if not tensor_out_pos:
+            raise ValueError("PyLayer.forward must return at least one Tensor")
+
+        need_grad = tape.is_grad_enabled() and any(
+            not t.stop_gradient and jnp.issubdtype(t._data.dtype, jnp.inexact)
+            for t in tensor_inputs
+        )
+        if not need_grad:
+            return outs if multi_out else out_seq[0]
+
+        n_outs = len(tensor_out_pos)
+
+        def vjp_fn(cots):
+            cot_seq = cots if isinstance(cots, tuple) else (cots,)
+            grad_args = [Tensor(g, stop_gradient=True) for g in cot_seq]
+            with tape.no_grad():
+                grads = cls.backward(ctx, *grad_args)
+            grads = grads if isinstance(grads, (tuple, list)) else (grads,)
+            if len(grads) != len(tensor_inputs):
+                raise ValueError(
+                    f"{cls.__name__}.backward returned {len(grads)} gradients "
+                    f"for {len(tensor_inputs)} Tensor inputs"
+                )
+            return tuple(
+                None if g is None else (g._data if _is_tensor(g) else jnp.asarray(g))
+                for g in grads
+            )
+
+        node = tape.Node(
+            vjp_fn,
+            tensor_inputs,
+            [(out_seq[i]._data.shape, out_seq[i]._data.dtype) for i in tensor_out_pos],
+            name=f"py_layer:{cls.__name__}",
+        )
+        for pos, i in enumerate(tensor_out_pos):
+            t = Tensor(out_seq[i]._data, stop_gradient=False)
+            t._node = node
+            t._out_idx = pos
+            out_seq[i] = t
+
+        if not multi_out:
+            return out_seq[0]
+        return tuple(out_seq) if isinstance(outs, tuple) else out_seq

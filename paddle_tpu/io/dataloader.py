@@ -232,14 +232,16 @@ class DataLoader:
             return out_queue.get(timeout=self.timeout if self.timeout else None)
         waited = 0.0
         while True:
-            payload = ring.read(timeout_ms=20)
-            if payload is not None:
-                bid, data = pickle.loads(payload)
-                return bid, data, None
+            # overflow/error pipe first: oversized batches and worker errors
+            # must not pay the ring-read timeout on every iteration
             try:
                 return out_queue.get_nowait()
             except queue_mod.Empty:
                 pass
+            payload = ring.read(timeout_ms=20)
+            if payload is not None:
+                bid, data = pickle.loads(payload)
+                return bid, data, None
             waited += 0.02
             if self.timeout and waited >= self.timeout:
                 raise TimeoutError(f"DataLoader worker timed out after {self.timeout}s")

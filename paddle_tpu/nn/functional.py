@@ -181,12 +181,31 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
         from ..ops import creation
 
         return creation.zeros_like(x) * x if isinstance(x, Tensor) else wrap(jnp.zeros_like(unwrap(x)))
+    axes = None if axis is None else (axis if isinstance(axis, (list, tuple)) else [axis])
+
+    def _mask_shape(shape):
+        if axes is None:
+            return tuple(shape)
+        return tuple(s if i in axes else 1 for i, s in enumerate(shape))
+
+    from ..static.program import recording_active
+
+    if recording_active():
+        # static mode: the mask key is a per-run feed, shapes come from the
+        # runtime array (so symbolic batch dims stay correct at replay)
+        from ..static.program import record_rng_op
+
+        def _dropout_rng(key, arr):
+            keep = jax.random.bernoulli(key, 1.0 - p, _mask_shape(arr.shape))
+            scaled = arr / (1.0 - p) if mode == "upscale_in_train" else arr
+            return jnp.where(keep, scaled, 0.0).astype(arr.dtype)
+
+        out = record_rng_op(_dropout_rng, "dropout", (x,))
+        out._program.ops[-1].tags = {"dropout": True}
+        return out
+
     arr = unwrap(x)
-    mask_shape = list(arr.shape)
-    if axis is not None:
-        axes = axis if isinstance(axis, (list, tuple)) else [axis]
-        mask_shape = [s if i in axes else 1 for i, s in enumerate(mask_shape)]
-    keep = jax.random.bernoulli(split_key(), 1.0 - p, tuple(mask_shape))
+    keep = jax.random.bernoulli(split_key(), 1.0 - p, _mask_shape(arr.shape))
 
     @primitive
     def _dropout(x):
@@ -556,18 +575,70 @@ def batch_norm(
 ):
     """Parity: batch_norm op (reference operators/batch_norm_op.cu). Updates
     running stats in-place on the provided Tensors when training."""
-    ch_axis = 1 if data_format.startswith("NC") or data_format in ("NC", "NCL") else unwrap(x).ndim - 1
+    from ..static.program import recording_active
+
+    ch_axis = 1 if data_format.startswith("NC") or data_format in ("NC", "NCL") else (
+        x.ndim if hasattr(x, "ndim") else unwrap(x).ndim) - 1
     if use_global_stats is None:
         use_global_stats = not training
+
+    if recording_active():
+        # static mode: one moded op whose `training` literal Program.clone
+        # (for_test=True) can flip to inference behavior (parity: the
+        # reference's op attr rewrite in clone-for-test)
+        out, new_rm, new_rv = _bn_moded(
+            x, running_mean, running_var, weight, bias, epsilon, ch_axis,
+            momentum, not use_global_stats,
+        )
+        prog = out._program
+        rec = prog.ops[-1]
+        rec.tags = {"bn": True}
+        if not use_global_stats and running_mean is not None:
+            running_mean.set_value(new_rm)
+            running_var.set_value(new_rv)
+        return out
+
     if use_global_stats:
         return _bn_infer(x, running_mean, running_var, weight, bias, epsilon, ch_axis)
     out, batch_mean, batch_var = _bn_train(x, weight, bias, epsilon, ch_axis)
     if running_mean is not None:
         # reference updates running_var with the BIASED batch variance
-        # (batch_norm_op.cc:380-416) — keep that exactly for eval parity
-        running_mean._set_data(momentum * running_mean._data + (1 - momentum) * unwrap(batch_mean))
-        running_var._set_data(momentum * running_var._data + (1 - momentum) * unwrap(batch_var))
+        # (batch_norm_op.cc:380-416) — keep that exactly for eval parity.
+        # Routed through a primitive so static-mode recording captures the
+        # stat update as a program state write.
+        running_mean._set_data(_bn_stat_update(running_mean, batch_mean, momentum))
+        running_var._set_data(_bn_stat_update(running_var, batch_var, momentum))
     return out
+
+
+@primitive(nondiff=True)
+def _bn_stat_update(running, batch, momentum):
+    return momentum * running + (1.0 - momentum) * batch
+
+
+@primitive
+def _bn_moded(x, rm, rv, weight, bias, eps, ch_axis, momentum, training):
+    """Static-mode batch norm: `training` is a trace-time literal so the
+    recorded op can be flipped to inference by Program.clone(for_test=True)."""
+    if training:
+        axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+    else:
+        mean, var = rm, rv
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    out = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + eps)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if training:
+        new_rm = momentum * rm + (1.0 - momentum) * jax.lax.stop_gradient(mean)
+        new_rv = momentum * rv + (1.0 - momentum) * jax.lax.stop_gradient(var)
+    else:
+        new_rm, new_rv = rm, rv
+    return out, new_rm, new_rv
 
 
 builtins_max = max

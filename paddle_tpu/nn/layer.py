@@ -153,7 +153,12 @@ class Layer:
             init = default_initializer
         else:
             init = init_mod.Constant(0.0) if is_bias else init_mod.XavierNormal()
-        data = init(tuple(int(s) for s in shape), dtype)
+        # initializers always run eagerly — under static mode they play the
+        # startup-program role (params exist before Executor.run)
+        from ..static.program import dygraph_guard
+
+        with dygraph_guard():
+            data = init(tuple(int(s) for s in shape), dtype)
         p = Parameter(data, trainable=(attr.trainable if attr else True))
         p.name = attr.name if attr and attr.name else _unique_name(self._full_name + ".w")
         if attr is not None:

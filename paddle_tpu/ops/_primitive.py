@@ -28,7 +28,14 @@ __all__ = ["primitive", "unwrap", "wrap"]
 
 
 def unwrap(x):
-    return x._data if isinstance(x, Tensor) else x
+    if isinstance(x, Tensor):
+        d = x._data
+        if isinstance(d, jax.Array):
+            return d
+        # static-mode Variable (_data is an aval): keep the wrapper so
+        # record_op registers it as a graph input instead of a literal
+        return x
+    return x
 
 
 def wrap(x, stop_gradient=True):
@@ -63,6 +70,16 @@ def primitive(fn: Callable = None, *, nondiff: bool = False, aux: int = 0, name:
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
+        # static-graph recording hook (≙ the static paradigm: ops append to a
+        # Program instead of executing — framework.py append_op role)
+        import paddle_tpu as _pd
+
+        if _pd._static_mode:
+            from ..static import program as _sp
+
+            if _sp.recording_active():
+                return _sp.record_op(fn, op_name, args, kwargs)
+
         # AMP autocast hook (≙ dygraph amp_auto_cast.cc cast insertion):
         # the casting wrapper keeps casts inside the traced fn so their VJP
         # restores parameter-dtype gradients

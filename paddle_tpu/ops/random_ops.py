@@ -44,7 +44,27 @@ def _shape(shape):
     return tuple(int(unwrap(s)) for s in shape)
 
 
+def _static_rng(op_name, draw, args=()):
+    """Static-mode hook: record ``draw(key, *arrays)`` as a per-run rng op
+    (the Executor feeds a fresh root key each run). Returns None in eager."""
+    from ..static.program import record_rng_op, recording_active
+
+    if not recording_active():
+        return None
+    return record_rng_op(draw, op_name, args)
+
+
 def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0):  # noqa: A002
+    if not seed:
+        shp, jdt = _shape(shape), to_jax_dtype(dtype)
+        rec = _static_rng(
+            "uniform_random",
+            lambda key: jax.random.uniform(key, shp, jdt, minval=min, maxval=max),
+        )
+        if rec is not None:
+            return rec
+    # a fixed seed reproduces the same numbers every run — identical to the
+    # reference's seeded uniform_random op, so a static-capture constant is fine
     key = jax.random.key(seed) if seed else split_key()
     return wrap(
         jax.random.uniform(key, _shape(shape), to_jax_dtype(dtype), minval=min, maxval=max)
@@ -63,7 +83,11 @@ def rand(shape, dtype="float32"):
 
 
 def randn(shape, dtype="float32"):
-    return wrap(jax.random.normal(split_key(), _shape(shape), to_jax_dtype(dtype)))
+    shp, jdt = _shape(shape), to_jax_dtype(dtype)
+    rec = _static_rng("gaussian_random", lambda key: jax.random.normal(key, shp, jdt))
+    if rec is not None:
+        return rec
+    return wrap(jax.random.normal(split_key(), shp, jdt))
 
 
 standard_normal = randn
@@ -80,7 +104,11 @@ def normal(mean=0.0, std=1.0, shape=None):
 def randint(low=0, high=None, shape=(1,), dtype="int64"):
     if high is None:
         low, high = 0, low
-    return wrap(jax.random.randint(split_key(), _shape(shape), low, high, to_jax_dtype(dtype)))
+    shp, jdt = _shape(shape), to_jax_dtype(dtype)
+    rec = _static_rng("randint", lambda key: jax.random.randint(key, shp, low, high, jdt))
+    if rec is not None:
+        return rec
+    return wrap(jax.random.randint(split_key(), shp, low, high, jdt))
 
 
 def randint_like(x, low=0, high=None, dtype=None):
@@ -93,6 +121,13 @@ def randperm(n, dtype="int64"):
 
 
 def bernoulli(x):
+    rec = _static_rng(
+        "bernoulli",
+        lambda key, arr: jax.random.bernoulli(key, arr, arr.shape).astype(arr.dtype),
+        (x,),
+    )
+    if rec is not None:
+        return rec
     arr = unwrap(x)
     return wrap(jax.random.bernoulli(split_key(), arr, arr.shape).astype(arr.dtype))
 

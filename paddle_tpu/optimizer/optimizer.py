@@ -138,9 +138,28 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static.program import Variable as _StaticVariable
+
+        if isinstance(loss, _StaticVariable):
+            # static paradigm: attach this optimizer to the program — the
+            # Executor compiles forward+backward+update into one XLA step
+            # (parity: static minimize appending backward + optimizer ops).
+            # A parameter-less optimizer (the standard static idiom) falls
+            # back to every trainable capture of the program.
+            if parameters is not None:
+                params = parameters
+            elif self._parameter_list is not None:
+                params = self._param_groups
+            else:
+                params = [t for (t, _) in loss._program.captures() if t.trainable]
+            return loss._program._set_optimizer(self, loss, params)
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._param_groups]
+
+    def _on_static_step(self):
+        """Called by the static Executor after each optimized run."""
+        self._global_step += 1
 
     # ------------------------------------------------------------------
     # functional path (jit/pjit training)

@@ -1,0 +1,220 @@
+"""paddle_tpu.static — the static-graph ("fluid") paradigm.
+
+Parity: paddle.static (reference python/paddle/static/__init__.py;
+Program/Executor machinery python/paddle/fluid/framework.py + executor.py:1065;
+append_backward python/paddle/fluid/backward.py:1406;
+save/load_inference_model python/paddle/fluid/io.py:1246).
+
+TPU-native redesign notes live in program.py / executor.py: Program = recorded
+trace of pure-jax closures; Executor = whole-program jit with jax.grad
+backward; save_inference_model = StableHLO export.
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..jit.input_spec import InputSpec  # noqa: F401  (paddle.static.InputSpec)
+from ..tensor import Tensor
+from .executor import Executor, global_scope  # noqa: F401
+from .program import (  # noqa: F401
+    Program,
+    Variable,
+    data,
+    default_main_program,
+    default_startup_program,
+    dygraph_guard,
+    program_guard,
+)
+
+__all__ = [
+    "InputSpec",
+    "Program",
+    "Variable",
+    "Executor",
+    "global_scope",
+    "data",
+    "default_main_program",
+    "default_startup_program",
+    "program_guard",
+    "append_backward",
+    "gradients",
+    "save_inference_model",
+    "load_inference_model",
+    "cpu_places",
+    "cuda_places",
+    "xpu_places",
+    "nn",
+]
+
+
+def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Register gradient computation for ``loss`` (parity: fluid/backward.py
+    append_backward:1406). Returns ``[(param_var, grad_var)]``.
+
+    TPU-native: no grad ops are built — the Executor derives the backward with
+    ``jax.grad`` over the whole recorded program at compile time; this call
+    just marks sources and names the ``@GRAD`` fetch targets.
+    """
+    prog = loss._program
+    prog.loss_var = loss
+
+    if parameter_list:
+        sources = []
+        for p in parameter_list:
+            if isinstance(p, str):
+                v = prog.vars.get(p)
+                if v is None:
+                    raise KeyError(f"unknown parameter '{p}'")
+                # find the concrete tensor backing this capture
+                src = next(
+                    (t for (t, cv) in prog.captures() if cv is v), v
+                )
+                sources.append(src)
+            else:
+                sources.append(p)
+    else:
+        sources = [t for (t, _) in prog.captures() if t.trainable]
+
+    prog.grad_sources = sources
+    prog._exec_cache.clear()
+
+    pairs = []
+    for s in sources:
+        v = s if isinstance(s, Variable) else prog.capture(s)
+        g = prog.grad_map.get(v.name)
+        if g is None:
+            g = Variable(v._data, f"{v.name}@GRAD", prog, role="grad")
+            prog.grad_map[v.name] = g
+            prog._register(g)
+        pairs.append((v, g))
+    return pairs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None) -> List[Variable]:
+    """paddle.static.gradients parity: grads of ``targets`` w.r.t. ``inputs``
+    (parameters or feed Variables)."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if len(targets) != 1:
+        # multiple targets sum their gradients; model by summing losses
+        raise NotImplementedError("gradients() supports a single target in v1")
+    pairs = append_backward(targets[0], parameter_list=list(inputs))
+    return [g for (_, g) in pairs]
+
+
+# ---------------------------------------------------------------------------
+# inference export (parity: fluid/io.py save_inference_model:1246)
+# ---------------------------------------------------------------------------
+MODEL_SUFFIX = ".pdmodel"
+PARAMS_SUFFIX = ".pdiparams"
+META_SUFFIX = ".pdmeta"
+
+
+def save_inference_model(path_prefix: str, feed_vars: Sequence[Variable],
+                         fetch_vars: Sequence[Variable], executor: Executor,
+                         program: Optional[Program] = None, **kwargs):
+    """Serialize the feed→fetch slice of ``program`` as StableHLO + params."""
+    from .executor import _replay
+
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    program = program if program is not None else feed_vars[0]._program
+
+    captures = program.captures()
+    capture_names = [v.name for (_, v) in captures]
+    capture_arrays = [t._data for (t, _) in captures]
+    feed_names = [v.name for v in feed_vars]
+    rng_used = program.rng_used
+
+    def infer_fn(capture_arrays, rng_key, *feed_arrays):
+        env = dict(zip(capture_names, capture_arrays))
+        env.update(zip(feed_names, feed_arrays))
+        env["__rng_key__"] = rng_key
+        env = _replay(program, env)
+        return [env[v.name] for v in fetch_vars]
+
+    # symbolic dims exactly where the user declared None/-1 in static.data;
+    # all symbols must share one scope, so mint them in a single call
+    declared_shapes = [
+        getattr(v, "_declared_shape", None) or list(v._data.shape)
+        for v in feed_vars
+    ]
+    n_sym = sum(1 for d in declared_shapes for s in d if s is None)
+    syms = iter(
+        jax.export.symbolic_shape(",".join(f"b{i}" for i in range(n_sym)))
+        if n_sym else ()
+    )
+    specs = []
+    for v, declared in zip(feed_vars, declared_shapes):
+        shape = tuple(next(syms) if s is None else int(s) for s in declared)
+        specs.append(jax.ShapeDtypeStruct(shape, v._data.dtype))
+    cap_specs = [jax.ShapeDtypeStruct(tuple(a.shape), a.dtype) for a in capture_arrays]
+    key = jax.random.key(0)
+    key_spec = jax.ShapeDtypeStruct(key.shape, key.dtype)
+
+    exported = jax.export.export(jax.jit(infer_fn))(cap_specs, key_spec, *specs)
+    with open(path_prefix + MODEL_SUFFIX, "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + PARAMS_SUFFIX, "wb") as f:
+        np.savez(f, **{f"c{i}": np.asarray(a) for i, a in enumerate(capture_arrays)})
+    with open(path_prefix + META_SUFFIX, "w") as f:
+        json.dump({"feed_names": feed_names,
+                   "fetch_names": [v.name for v in fetch_vars],
+                   "n_captures": len(capture_arrays)}, f)
+
+
+class LoadedProgram:
+    """Deserialized inference program; Executor.run dispatches to it."""
+
+    def __init__(self, exported, capture_arrays, feed_names, fetch_names):
+        self._exported = exported
+        self._captures = capture_arrays
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+
+    def run(self, feed: dict):
+        feeds = [jnp.asarray(feed[n]._data if isinstance(feed[n], Tensor) else feed[n])
+                 for n in self.feed_names]
+        outs = self._exported.call(self._captures, jax.random.key(0), *feeds)
+        return [np.asarray(o) for o in outs]
+
+
+def load_inference_model(path_prefix: str, executor: Executor, **kwargs):
+    """Returns ``[program, feed_target_names, fetch_targets]`` (reference
+    contract); run via ``program.run(feed_dict)`` or ``exe.run(program, ...)``."""
+    with open(path_prefix + MODEL_SUFFIX, "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    with open(path_prefix + META_SUFFIX) as f:
+        meta = json.load(f)
+    arrs = np.load(path_prefix + PARAMS_SUFFIX)
+    captures = [jnp.asarray(arrs[f"c{i}"]) for i in range(meta["n_captures"])]
+    prog = LoadedProgram(exported, captures, meta["feed_names"], meta["fetch_names"])
+    return [prog, meta["feed_names"], meta["fetch_names"]]
+
+
+# place helpers (parity: paddle.static.cpu_places/cuda_places; TPU chips here)
+def cpu_places(device_count=None):
+    from .. import device as device_mod
+
+    n = device_count or 1
+    return [device_mod.CPUPlace(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from .. import device as device_mod
+
+    ids = device_ids if device_ids is not None else [0]
+    return [device_mod.TPUPlace(i) for i in ids]
+
+
+xpu_places = cuda_places
+
+
+# static nn helpers (parity: paddle.static.nn.fc/batch_norm/conv2d/embedding)
+from . import nn  # noqa: E402,F401

@@ -1,0 +1,257 @@
+"""Static-graph Executor: whole-program jit replay.
+
+Parity: ``paddle.static.Executor`` (reference python/paddle/fluid/executor.py
+:1065 Executor.run → C++ framework/executor.cc:170 per-op interpretation, and
+the new_executor/InterpreterCore async interpreter).
+
+TPU-native redesign: instead of interpreting ops one by one, ``run`` compiles
+the WHOLE program — forward replay, ``jax.grad`` backward, optimizer update,
+state writes — into a single XLA executable, cached per (program version,
+feed shapes, fetch set). Op-dispatch overhead (the reference's hot-loop cost,
+operator.cc:1081) is zero; scheduling/fusion belong to XLA, which replaces the
+SSA-graph executors and the BuildStrategy pass pipeline wholesale.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+from .program import OpRecord, Program, Variable, default_main_program
+
+__all__ = ["Executor", "global_scope"]
+
+
+class _Scope:
+    """Host-side name->value view over a program's captured state (parity:
+    the C++ global Scope; here state lives on the source Tensors)."""
+
+    def find_var(self, name: str):
+        return None
+
+    def var(self, name: str):
+        return None
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def _replay(program: Program, env: Dict[str, Any]):
+    """Execute the recorded op list over concrete (traced) arrays."""
+    for op in program.ops:
+        flat2 = []
+        for x in op.flat_args:
+            if isinstance(x, Variable):
+                flat2.append(env[x.name])
+            else:
+                flat2.append(x)
+        a2, k2 = jax.tree_util.tree_unflatten(op.treedef, flat2)
+        out = op.fn(*a2, **k2)
+        out_flat = jax.tree_util.tree_flatten(out)[0]
+        for v, a in zip(op.out_vars, out_flat):
+            env[v.name] = a
+    return env
+
+
+class Executor:
+    """paddle.static.Executor parity; ``place`` is accepted and ignored
+    (PJRT owns placement)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    # -- public API -----------------------------------------------------
+    def run(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        return_numpy: bool = True,
+    ):
+        program = program if program is not None else default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+
+        # startup programs / empty programs: nothing to do
+        if not program.ops and not fetch_list:
+            return []
+
+        fetch_vars = [self._resolve_fetch(program, f) for f in fetch_list]
+
+        feed_names = sorted(n for n in program.feed_vars if n != "__rng_key__")
+        missing = [n for n in feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"missing feed entries: {missing}")
+        feed_arrays = [jnp.asarray(self._feed_value(feed[n])) for n in feed_names]
+
+        captures = program.captures()
+        capture_arrays = [t._data for (t, _) in captures]
+
+        key = (
+            len(program.ops),
+            tuple(feed_names),
+            tuple((tuple(a.shape), str(a.dtype)) for a in feed_arrays),
+            tuple(v.name for v in fetch_vars),
+            program.optimizer is not None,
+            bool(program.grad_sources),
+        )
+        compiled = program._exec_cache.get(key)
+        if compiled is None:
+            compiled = self._compile(program, feed_names, fetch_vars, captures)
+            program._exec_cache[key] = compiled
+
+        rng_args = ()
+        if program.rng_used:
+            from ..random import split_key
+
+            rng_args = (split_key(),)
+
+        if program.optimizer is not None:
+            if program._opt_state is None:
+                param_arrays = [p._data for p in program.opt_params]
+                program._opt_state = program.optimizer.init_state(param_arrays)
+            lr = jnp.asarray(program.optimizer.get_lr(), jnp.float32)
+            fetches, new_params, new_state, new_writes = compiled(
+                feed_arrays, capture_arrays, program._opt_state, lr, *rng_args
+            )
+            program._opt_state = new_state
+            for p, a in zip(program.opt_params, new_params):
+                p._set_data(a)
+            program.optimizer._on_static_step()
+        else:
+            fetches, new_writes = compiled(feed_arrays, capture_arrays, *rng_args)
+
+        for (target, _), arr in zip(program.state_writes.values(), new_writes):
+            target._set_data(arr)
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    # -- internals ------------------------------------------------------
+    @staticmethod
+    def _feed_value(v):
+        if isinstance(v, Tensor):
+            return v._data
+        return np.asarray(v)
+
+    @staticmethod
+    def _resolve_fetch(program: Program, f):
+        if isinstance(f, Variable):
+            return f
+        if isinstance(f, str):
+            v = program.vars.get(f)
+            if v is None:
+                raise KeyError(f"fetch target '{f}' not found in program")
+            return v
+        raise TypeError(f"bad fetch target: {f!r}")
+
+    def _compile(self, program: Program, feed_names, fetch_vars, captures):
+        capture_names = [v.name for (_, v) in captures]
+        write_items = list(program.state_writes.values())
+        grad_requested = bool(program.grad_sources) or program.optimizer is not None
+
+        # differentiation sources: captures (parameters) and/or feed vars
+        cap_index_by_id = {id(t): i for i, (t, _) in enumerate(captures)}
+        feed_index = {n: i for i, n in enumerate(feed_names)}
+        diff_entries = []  # (kind, index, name) with kind in {"cap", "feed"}
+        if grad_requested:
+            sources = program.opt_params if program.optimizer else program.grad_sources
+            for s in sources:
+                if isinstance(s, Variable) and s._role == "feed":
+                    diff_entries.append(("feed", feed_index[s.name], s.name))
+                elif id(s) in cap_index_by_id:
+                    i = cap_index_by_id[id(s)]
+                    diff_entries.append(("cap", i, capture_names[i]))
+                else:
+                    raise ValueError(
+                        f"cannot differentiate w.r.t. {getattr(s, 'name', s)!r}: "
+                        "not a program input (parameter capture or feed)"
+                    )
+
+        def forward_env(feed_arrays, capture_arrays, rng_key=None):
+            env = {}
+            for n, a in zip(feed_names, feed_arrays):
+                env[n] = a
+            for n, a in zip(capture_names, capture_arrays):
+                env[n] = a
+            if rng_key is not None:
+                env["__rng_key__"] = rng_key
+            return _replay(program, env)
+
+        def harvest(env, grads_by_capture_name=None):
+            fetches = []
+            for v in fetch_vars:
+                if grads_by_capture_name is not None and v.name.endswith("@GRAD"):
+                    src = v.name[: -len("@GRAD")]
+                    if src in grads_by_capture_name:
+                        fetches.append(grads_by_capture_name[src])
+                        continue
+                if v.name not in env:
+                    raise KeyError(
+                        f"fetch '{v.name}' was never produced (is it a @GRAD "
+                        "var without append_backward/minimize?)"
+                    )
+                fetches.append(env[v.name])
+            writes = [env[wv.name] for (_, wv) in write_items]
+            return fetches, writes
+
+        if not grad_requested:
+
+            def step_fwd(feed_arrays, capture_arrays, *rng):
+                env = forward_env(feed_arrays, capture_arrays, *rng)
+                return harvest(env)
+
+            return jax.jit(step_fwd)
+
+        loss_var = program.loss_var
+        if loss_var is None:
+            raise RuntimeError("gradients requested but no loss was set")
+
+        opt = program.optimizer
+
+        def step_train(feed_arrays, capture_arrays, opt_state, lr, *rng):
+            def loss_fn(diff_arrays):
+                cap = list(capture_arrays)
+                fd = list(feed_arrays)
+                for (kind, i, _), a in zip(diff_entries, diff_arrays):
+                    (cap if kind == "cap" else fd)[i] = a
+                env = forward_env(fd, cap, *rng)
+                loss = env[loss_var.name]
+                return loss.astype(jnp.float32).sum(), env
+
+            diff_arrays = [
+                (capture_arrays if kind == "cap" else feed_arrays)[i]
+                for (kind, i, _) in diff_entries
+            ]
+            grads, env = jax.grad(loss_fn, has_aux=True)(diff_arrays)
+            grads_by_name = {
+                name: g for (_, _, name), g in zip(diff_entries, grads)
+            }
+            fetches, writes = harvest(env, grads_by_name)
+            if opt is None:
+                return fetches, diff_arrays, opt_state, writes
+            new_params, new_state = opt.apply_gradients(
+                diff_arrays, list(grads), opt_state, lr=lr
+            )
+            return fetches, new_params, new_state, writes
+
+        if program.optimizer is not None:
+            return jax.jit(step_train)
+
+        # grads requested (append_backward) but no optimizer: reuse the train
+        # path with a dummy opt state and identity update
+        def step_grads(feed_arrays, capture_arrays, *rng):
+            fetches, _, _, writes = step_train(
+                feed_arrays, capture_arrays, None, jnp.float32(0), *rng
+            )
+            return fetches, writes
+
+        return jax.jit(step_grads)

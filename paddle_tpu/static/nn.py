@@ -1,0 +1,90 @@
+"""paddle.static.nn parity — thin wrappers building nn layers inside a
+recorded Program (reference python/paddle/static/nn/__init__.py → fluid
+layers fc/conv2d/batch_norm/embedding).
+
+Each helper instantiates the matching ``paddle_tpu.nn`` Layer (parameters are
+created eagerly under ``dygraph_guard`` — the startup-program role) and calls
+it, which records into the current main program.
+"""
+from __future__ import annotations
+
+from .program import dygraph_guard
+
+__all__ = ["fc", "conv2d", "batch_norm", "embedding", "cond", "while_loop"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from .. import nn
+    from ..ops import manipulation
+
+    with dygraph_guard():
+        in_dim = 1
+        for s in x.shape[num_flatten_dims:]:
+            in_dim *= int(s)
+        layer = nn.Linear(in_dim, size, weight_attr=weight_attr, bias_attr=bias_attr)
+    if len(x.shape) > num_flatten_dims + 1:
+        lead = list(x.shape[:num_flatten_dims])
+        x = manipulation.reshape(x, lead + [in_dim])
+    out = layer(x)
+    if activation:
+        from ..nn import functional as F
+
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(x, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
+           data_format="NCHW"):
+    from .. import nn
+
+    with dygraph_guard():
+        layer = nn.Conv2D(int(x.shape[1]), num_filters, filter_size,
+                          stride=stride, padding=padding, dilation=dilation,
+                          groups=groups, weight_attr=param_attr,
+                          bias_attr=bias_attr, data_format=data_format)
+    out = layer(x)
+    if act:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None,
+               **kwargs):
+    from .. import nn
+
+    with dygraph_guard():
+        layer = nn.BatchNorm2D(int(input.shape[1]), momentum=momentum,
+                               epsilon=epsilon, data_format=data_layout)
+        if is_test:
+            layer.eval()
+    out = layer(input)
+    if act:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
+              dtype="float32", name=None):
+    from .. import nn
+
+    with dygraph_guard():
+        layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                             weight_attr=param_attr)
+    return layer(input)
+
+
+def cond(pred, true_fn=None, false_fn=None):
+    raise NotImplementedError(
+        "static control flow is not supported in v1; use @to_static over "
+        "python control flow (jax.lax.cond under jit) instead"
+    )
+
+
+while_loop = cond

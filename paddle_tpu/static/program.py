@@ -1,0 +1,428 @@
+"""Static-graph Program: the second execution paradigm.
+
+Parity: the reference's ``fluid`` static graph — Python builds a ``ProgramDesc``
+IR (/root/reference/paddle/fluid/framework/framework.proto:50-80; Program/
+Block/Variable/Operator in python/paddle/fluid/framework.py) which a C++
+``Executor`` interprets op-by-op (framework/executor.cc:170).
+
+TPU-native redesign: a Program is a *recorded trace*, not a protobuf IR. Ops
+are captured as pure-jax closures at build time (the same ``primitive``
+functions the eager path runs); the Executor replays the whole list inside ONE
+``jax.jit`` so XLA sees — and fuses — the entire step, including the backward
+pass (derived with ``jax.grad`` over the replay, replacing the reference's
+symbolic ``append_backward`` op-by-op grad construction,
+python/paddle/fluid/backward.py:1406) and the optimizer update. This is
+strictly more aggressive than the reference's per-op interpreter with fusion
+passes: the "pass pipeline" is XLA itself.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dtype import to_jax_dtype
+from ..tensor import Tensor
+
+__all__ = [
+    "Variable",
+    "Program",
+    "program_guard",
+    "default_main_program",
+    "default_startup_program",
+    "data",
+    "record_op",
+    "record_rng_op",
+    "recording_active",
+    "dygraph_guard",
+]
+
+
+class Variable(Tensor):
+    """A symbolic tensor inside a Program (parity: fluid.framework.Variable).
+
+    ``_data`` holds a ``jax.ShapeDtypeStruct`` — metadata only; values exist
+    only during Executor replay.
+    """
+
+    __slots__ = ("_program", "_role", "_declared_shape")
+
+    def __init__(self, aval, name: str, program: "Program", role: str = "op_out",
+                 stop_gradient: bool = True):
+        # bypass Tensor.__init__: _data is an aval, not an array
+        self._data = aval
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_idx = 0
+        self._retain_grad = False
+        self.name = name
+        self.persistable = role in ("param",)
+        self.trainable = not stop_gradient
+        self._hooks = None
+        self._program = program
+        self._role = role
+        self._declared_shape = None  # user shape incl. None dims (feeds only)
+
+    @property
+    def place(self):
+        from .. import device as device_mod
+
+        return device_mod.CPUPlace(0)
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' has no value at graph-build time; fetch it "
+            "through Executor.run(fetch_list=[...])"
+        )
+
+    def __repr__(self):
+        return (
+            f"Variable(name={self.name}, shape={list(self._data.shape)}, "
+            f"dtype={self._data.dtype}, role={self._role})"
+        )
+
+
+class OpRecord:
+    """One recorded op: a pure-jax closure plus its (symbolic) arg structure."""
+
+    __slots__ = ("fn", "name", "flat_args", "treedef", "out_tree", "out_vars",
+                 "rng", "tags")
+
+    def __init__(self, fn, name, flat_args, treedef, out_tree, out_vars, rng=False):
+        self.fn = fn
+        self.name = name
+        self.flat_args = flat_args      # leaves: Variable | literal
+        self.treedef = treedef
+        self.out_tree = out_tree
+        self.out_vars = out_vars        # flat list of Variables
+        self.rng = rng                  # if True, fn takes a leading PRNG key
+        self.tags = None                # op-kind markers for clone(for_test)
+
+    def copy(self) -> "OpRecord":
+        rec = OpRecord(self.fn, self.name, list(self.flat_args), self.treedef,
+                       self.out_tree, list(self.out_vars), self.rng)
+        rec.tags = dict(self.tags) if self.tags else None
+        return rec
+
+
+class Program:
+    """Recorded op list + captured state (parity: fluid.Program).
+
+    Captures (concrete Tensors touched by recorded ops — parameters, buffers)
+    play the role of the reference's persistable variables in the global Scope.
+    """
+
+    _counter = 0
+
+    def __init__(self):
+        Program._counter += 1
+        self.idx = Program._counter
+        self.ops: List[OpRecord] = []
+        self.vars: Dict[str, Variable] = {}
+        self.feed_vars: Dict[str, Variable] = {}
+        self._name_counter = 0
+        # id(source Tensor) -> (source Tensor, capture Variable)
+        self._captures: Dict[int, Tuple[Tensor, Variable]] = {}
+        # recorded writes to captured state (BN stats etc.):
+        # id(target) -> (target Tensor, value Variable)
+        self.state_writes: Dict[int, Tuple[Tensor, Variable]] = {}
+        # grads: capture Variable name -> grad Variable (append_backward)
+        self.grad_map: Dict[str, Variable] = {}
+        self.grad_sources: List[Tensor] = []   # param Tensors to differentiate
+        self.loss_var: Optional[Variable] = None
+        # optimizer attachment (minimize): (optimizer, loss_var, [param Tensor])
+        self.optimizer = None
+        self.opt_params: List[Tensor] = []
+        self._opt_state = None
+        self.rng_used = False
+        self._exec_cache: Dict[Any, Any] = {}
+
+    # -- naming ---------------------------------------------------------
+    def _unique_name(self, prefix: str) -> str:
+        self._name_counter += 1
+        return f"{prefix}_{self._name_counter}"
+
+    def _register(self, var: Variable):
+        self.vars[var.name] = var
+        return var
+
+    # -- capture --------------------------------------------------------
+    def capture(self, t: Tensor) -> Variable:
+        """Map a concrete Tensor (parameter/buffer/constant) to a stable
+        capture Variable; executor feeds its live value every run."""
+        hit = self._captures.get(id(t))
+        if hit is not None:
+            return hit[1]
+        name = t.name or self._unique_name("capture")
+        v = Variable(
+            jax.ShapeDtypeStruct(tuple(t._data.shape), t._data.dtype),
+            name, self, role="param", stop_gradient=t.stop_gradient,
+        )
+        self._captures[id(t)] = (t, v)
+        self._register(v)
+        return v
+
+    def captures(self) -> List[Tuple[Tensor, Variable]]:
+        return list(self._captures.values())
+
+    # -- mutation hooks -------------------------------------------------
+    def record_state_write(self, target: Tensor, value: Variable):
+        self.capture(target)  # ensure the old value is an input
+        self.state_writes[id(target)] = (target, value)
+        self._exec_cache.clear()
+
+    def _set_optimizer(self, optimizer, loss: Variable, params: Sequence[Tensor]):
+        self.optimizer = optimizer
+        self.loss_var = loss
+        # accept capture Variables (e.g. program.all_parameters()) by mapping
+        # them back to their concrete source Tensors
+        resolved = []
+        for p in params:
+            if isinstance(p, Variable):
+                src = next((t for (t, cv) in self._captures.values() if cv is p), None)
+                if src is None:
+                    raise ValueError(
+                        f"Variable {p.name!r} is not a parameter capture of this program"
+                    )
+                p = src
+            resolved.append(p)
+        self.opt_params = [p for p in resolved if not p.stop_gradient]
+        self._exec_cache.clear()
+        pairs = []
+        for p in self.opt_params:
+            cap = self.capture(p)
+            g = self.grad_map.get(cap.name)
+            if g is None:
+                g = Variable(cap._data, f"{cap.name}@GRAD", self, role="grad")
+                self.grad_map[cap.name] = g
+                self._register(g)
+            pairs.append((cap, g))
+        self.grad_sources = list(self.opt_params)
+        return None, pairs
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        return [v for (_, v) in self._captures.values() if v.trainable]
+
+    def list_vars(self):
+        return list(self.vars.values())
+
+    def clone(self, for_test: bool = False):
+        """Copy the program (parity: Program.clone, fluid/framework.py).
+
+        ``for_test=True`` additionally switches recorded dropout ops to
+        identity and batch-norm ops to inference stats, and drops state
+        writes / backward / optimizer — the reference's clone-for-test op
+        attr rewrite."""
+        p = Program()
+        p.ops = [rec.copy() for rec in self.ops]
+        p.vars = dict(self.vars)
+        p.feed_vars = dict(self.feed_vars)
+        p._name_counter = self._name_counter
+        p._captures = dict(self._captures)
+        p.state_writes = dict(self.state_writes)
+        p.grad_map = dict(self.grad_map)
+        p.grad_sources = list(self.grad_sources)
+        p.loss_var = self.loss_var
+        p.optimizer = self.optimizer
+        p.opt_params = list(self.opt_params)
+        p.rng_used = self.rng_used
+        if for_test:
+            p.optimizer = None
+            p.opt_params = []
+            p._opt_state = None
+            p.loss_var = None
+            p.grad_map = {}
+            p.grad_sources = []
+            p.state_writes = {}
+            for rec in p.ops:
+                tags = rec.tags or {}
+                if "dropout" in tags:
+                    rec.fn = lambda key, arr: arr
+                elif "bn" in tags:
+                    # the only bare-bool literal in a bn record is `training`
+                    rec.flat_args = [
+                        (False if a is True else a) for a in rec.flat_args
+                    ]
+        return p
+
+
+# ---------------------------------------------------------------------------
+# current-program stack
+# ---------------------------------------------------------------------------
+_default_main: Optional[Program] = None
+_default_startup: Optional[Program] = None
+_program_stack: List[Tuple[Program, Program]] = []
+_record_suspended = 0
+
+
+def default_main_program() -> Program:
+    global _default_main
+    if _program_stack:
+        return _program_stack[-1][0]
+    if _default_main is None:
+        _default_main = Program()
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    global _default_startup
+    if _program_stack:
+        return _program_stack[-1][1]
+    if _default_startup is None:
+        _default_startup = Program()
+    return _default_startup
+
+
+def _reset_default_programs():
+    global _default_main, _default_startup
+    _default_main = None
+    _default_startup = None
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    sp = startup_program if startup_program is not None else Program()
+    _program_stack.append((main_program, sp))
+    try:
+        yield
+    finally:
+        _program_stack.pop()
+
+
+@contextlib.contextmanager
+def dygraph_guard():
+    """Suspend recording (initializers, host-side computation) even when
+    static mode is enabled."""
+    global _record_suspended
+    _record_suspended += 1
+    try:
+        yield
+    finally:
+        _record_suspended -= 1
+
+
+def recording_active() -> bool:
+    if _record_suspended:
+        return False
+    import paddle_tpu as _pd
+
+    return bool(getattr(_pd, "_static_mode", False))
+
+
+# ---------------------------------------------------------------------------
+# feed declaration
+# ---------------------------------------------------------------------------
+def data(name: str, shape: Sequence[Optional[int]], dtype: str = "float32",
+         lod_level: int = 0) -> Variable:
+    """Declare a feed Variable (parity: paddle.static.data). ``None``/-1 dims
+    are symbolic (commonly the batch dim); replay re-traces per actual shape."""
+    prog = default_main_program()
+    jdt = to_jax_dtype(dtype)
+    # metadata shape: unknown dims recorded as 1 (only used for eval_shape)
+    meta_shape = tuple(1 if (s is None or s < 0) else int(s) for s in shape)
+    v = Variable(jax.ShapeDtypeStruct(meta_shape, jdt), name, prog, role="feed")
+    v._declared_shape = [None if (s is None or s < 0) else int(s) for s in shape]
+    prog.feed_vars[name] = v
+    prog._register(v)
+    return v
+
+
+# ---------------------------------------------------------------------------
+# op recording (called from ops/_primitive.py when static mode is on)
+# ---------------------------------------------------------------------------
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def record_op(fn: Callable, op_name: str, args, kwargs):
+    """Append an op to the current program; return symbolic outputs mirroring
+    the eager wrapper's return structure."""
+    prog = default_main_program()
+    flat, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+
+    in_avals = []
+    rec_flat = []
+    for x in flat:
+        if isinstance(x, Variable):
+            if x._program is not prog and x.name not in prog.vars:
+                # cross-program reference: capture by value is impossible for
+                # symbolic vars — reject loudly (clones share var names, so
+                # recording into a clone stays legal)
+                raise RuntimeError(
+                    f"Variable {x.name} belongs to a different Program"
+                )
+            rec_flat.append(x)
+            in_avals.append(x._data)
+        elif isinstance(x, Tensor):
+            v = prog.capture(x)
+            rec_flat.append(v)
+            in_avals.append(v._data)
+        else:
+            rec_flat.append(x)
+
+    var_pos = [i for i, x in enumerate(rec_flat) if isinstance(x, Variable)]
+
+    def pure(*arrs):
+        flat2 = list(rec_flat)
+        for i, a in zip(var_pos, arrs):
+            flat2[i] = a
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, flat2)
+        return fn(*a2, **k2)
+
+    out_shape = jax.eval_shape(pure, *in_avals)
+    out_flat, out_tree = jax.tree_util.tree_flatten(out_shape)
+    out_vars = [
+        Variable(a, prog._unique_name(op_name), prog, role="op_out",
+                 stop_gradient=False)
+        for a in out_flat
+    ]
+    for v in out_vars:
+        prog._register(v)
+    prog.ops.append(OpRecord(fn, op_name, rec_flat, treedef, out_tree, out_vars))
+    prog._exec_cache.clear()
+    out = jax.tree_util.tree_unflatten(out_tree, out_vars)
+    return out
+
+
+def record_rng_op(fn_with_key: Callable, op_name: str, args=(), kwargs=None):
+    """Record an op needing randomness. ``fn_with_key(key, *args, **kwargs)``
+    gets a per-op, per-run PRNG key (the Executor feeds a fresh root key each
+    run; parity with the reference's per-run dropout seeds)."""
+    kwargs = kwargs or {}
+    prog = default_main_program()
+    prog.rng_used = True
+    op_index = len(prog.ops)
+
+    def fn(key, *a, **k):
+        return fn_with_key(jax.random.fold_in(key, op_index), *a, **k)
+
+    key_var = _rng_var(prog)
+    return record_op(fn, op_name, (key_var,) + tuple(args), kwargs)
+
+
+def _rng_var(prog: Program) -> Variable:
+    v = prog.feed_vars.get("__rng_key__")
+    if v is None:
+        v = Variable(
+            jax.ShapeDtypeStruct((), jax.random.key(0).dtype),
+            "__rng_key__", prog, role="feed",
+        )
+        prog.feed_vars["__rng_key__"] = v
+        prog._register(v)
+    return v
+
+
+def handle_state_write(target: Tensor, value) -> bool:
+    """Called from Tensor.set_value/_set_data: if ``value`` is symbolic,
+    record a state write instead of assigning. Returns True when handled."""
+    if isinstance(value, Variable):
+        value._program.record_state_write(target, value)
+        return True
+    return False

@@ -189,6 +189,12 @@ class Tensor:
     # ------------------------------------------------------------------
     def set_value(self, value):
         if isinstance(value, Tensor):
+            if not isinstance(value._data, jax.Array):
+                # symbolic Variable (static recording): record a state write
+                from .static.program import handle_state_write
+
+                if handle_state_write(self, value):
+                    return self
             value = value._data
         arr = jnp.asarray(value, dtype=self._data.dtype)
         if tuple(arr.shape) != tuple(self._data.shape):
@@ -200,6 +206,13 @@ class Tensor:
 
     def _set_data(self, arr):
         """Internal: rebind storage without shape check (optimizer updates)."""
+        if isinstance(arr, Tensor):
+            if not isinstance(arr._data, jax.Array):
+                from .static.program import handle_state_write
+
+                if handle_state_write(self, arr):
+                    return
+            arr = arr._data
         self._data = arr
 
     def fill_(self, value):

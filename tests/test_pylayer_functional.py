@@ -1,0 +1,122 @@
+"""PyLayer + functional autodiff tests.
+
+Parity model: reference unittests test_pylayer_op.py and
+autograd/test_vjp_jvp.py / test_jacobian.py / test_hessian.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer, functional
+
+
+class TanhPyLayer(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        y = paddle.tanh(x)
+        ctx.save_for_backward(y)
+        return y
+
+    @staticmethod
+    def backward(ctx, dy):
+        (y,) = ctx.saved_tensor()
+        return dy * (1 - y * y)
+
+
+def test_pylayer_matches_builtin_grad():
+    x_np = np.random.randn(4, 5).astype("float32")
+    x1 = paddle.to_tensor(x_np, stop_gradient=False)
+    y1 = TanhPyLayer.apply(x1)
+    y1.sum().backward()
+
+    x2 = paddle.to_tensor(x_np, stop_gradient=False)
+    y2 = paddle.tanh(x2)
+    y2.sum().backward()
+
+    np.testing.assert_allclose(y1.numpy(), y2.numpy(), rtol=1e-6)
+    np.testing.assert_allclose(x1.grad.numpy(), x2.grad.numpy(), rtol=1e-5)
+
+
+class ScaleTwoOut(PyLayer):
+    @staticmethod
+    def forward(ctx, x, y):
+        return x * 2.0, y * 3.0
+
+    @staticmethod
+    def backward(ctx, dx, dy):
+        return dx * 2.0, dy * 3.0
+
+
+def test_pylayer_multi_io():
+    x = paddle.to_tensor(np.ones((3,), "float32"), stop_gradient=False)
+    y = paddle.to_tensor(np.ones((3,), "float32"), stop_gradient=False)
+    a, b = ScaleTwoOut.apply(x, y)
+    (a.sum() + b.sum()).backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((3,), 2.0), rtol=1e-6)
+    np.testing.assert_allclose(y.grad.numpy(), np.full((3,), 3.0), rtol=1e-6)
+
+
+def test_pylayer_wrong_grad_count_raises():
+    class Bad(PyLayer):
+        @staticmethod
+        def forward(ctx, x, y):
+            return x + y
+
+        @staticmethod
+        def backward(ctx, dz):
+            return dz  # should be two grads
+
+    x = paddle.to_tensor(np.ones((2,), "float32"), stop_gradient=False)
+    y = paddle.to_tensor(np.ones((2,), "float32"), stop_gradient=False)
+    z = Bad.apply(x, y)
+    with pytest.raises(ValueError):
+        z.sum().backward()
+
+
+def test_pylayer_no_grad_passthrough():
+    x = paddle.to_tensor(np.ones((2,), "float32"))  # stop_gradient=True
+    y = TanhPyLayer.apply(x)
+    assert y.stop_gradient
+
+
+def test_vjp():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+    out, g = functional.vjp(lambda t: (t * t).sum(), x)
+    np.testing.assert_allclose(out.numpy(), 14.0, rtol=1e-6)
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0, 6.0], rtol=1e-6)
+
+
+def test_jvp():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    v = paddle.to_tensor(np.array([1.0, 0.0], "float32"))
+    out, tangent = functional.jvp(lambda t: t * t, x, v)
+    np.testing.assert_allclose(tangent.numpy(), [2.0, 0.0], rtol=1e-6)
+
+
+def test_jacobian_single():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    jac = functional.jacobian(lambda t: t * t, x)
+    np.testing.assert_allclose(jac.numpy(), np.diag([2.0, 4.0]), rtol=1e-6)
+
+
+def test_jacobian_multi_input():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    y = paddle.to_tensor(np.array([3.0, 4.0], "float32"))
+    jac = functional.jacobian(lambda a, b: a * b, [x, y])
+    np.testing.assert_allclose(jac[0].numpy(), np.diag([3.0, 4.0]), rtol=1e-6)
+    np.testing.assert_allclose(jac[1].numpy(), np.diag([1.0, 2.0]), rtol=1e-6)
+
+
+def test_hessian():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    hes = functional.hessian(lambda t: (t * t * t).sum(), x)
+    np.testing.assert_allclose(hes.numpy(), np.diag([6.0, 12.0]), rtol=1e-6)
+
+
+def test_double_grad_via_functional():
+    # d2/dx2 of sin(x).sum() == -sin(x)
+    x = paddle.to_tensor(np.array([0.3, 0.7], "float32"))
+    hes = functional.hessian(lambda t: paddle.sin(t).sum(), x)
+    np.testing.assert_allclose(
+        np.diag(hes.numpy()), -np.sin([0.3, 0.7]), rtol=1e-5
+    )

@@ -1,0 +1,296 @@
+"""Static-graph paradigm tests.
+
+Parity model: reference unittests (test_executor_and_use_program_cache,
+book/test_fit_a_line, test_program_guard, interpreter/ standalone-executor
+equivalence — here static-vs-dygraph equivalence plays that role).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode_guard():
+    static.program._reset_default_programs() if hasattr(static.program, "_reset_default_programs") else None
+    yield
+    paddle.disable_static()
+
+
+def _fresh_program():
+    return static.Program(), static.Program()
+
+
+def test_forward_only():
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        y = x * 2.0 + 1.0
+    exe = static.Executor()
+    x_np = np.random.rand(3, 4).astype("float32")
+    (out,) = exe.run(main, feed={"x": x_np}, fetch_list=[y])
+    np.testing.assert_allclose(out, x_np * 2 + 1, rtol=1e-6)
+
+
+def test_linear_regression_training_converges():
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 2], "float32")
+        t = static.data("t", [None, 1], "float32")
+        lin = paddle.nn.Linear(2, 1)
+        pred = lin(x)
+        loss = ((pred - t) ** 2).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    rng = np.random.RandomState(0)
+    w_true = np.array([[2.0], [-3.0]], "float32")
+    losses = []
+    for _ in range(500):
+        x_np = rng.rand(16, 2).astype("float32")
+        t_np = x_np @ w_true + 0.5
+        (l,) = exe.run(main, feed={"x": x_np, "t": t_np}, fetch_list=[loss])
+        losses.append(float(l))
+    assert losses[-1] < 5e-3, f"did not converge: {losses[-1]}"
+    np.testing.assert_allclose(lin.weight.numpy(), w_true, atol=0.05)
+
+
+def test_static_matches_dygraph_forward():
+    # same parameters, same input -> identical result in both paradigms
+    x_np = np.random.rand(4, 8).astype("float32")
+    lin = paddle.nn.Linear(8, 3)
+    eager_out = lin(paddle.to_tensor(x_np)).numpy()
+
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        out = lin(x)
+    exe = static.Executor()
+    (static_out,) = exe.run(main, feed={"x": x_np}, fetch_list=[out])
+    np.testing.assert_allclose(static_out, eager_out, rtol=1e-5)
+
+
+def test_append_backward_grad_fetch():
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 3], "float32")
+        lin = paddle.nn.Linear(3, 1, bias_attr=False)
+        loss = lin(x).sum()
+        pairs = static.append_backward(loss)
+    assert len(pairs) == 1
+    exe = static.Executor()
+    x_np = np.ones((5, 3), "float32")
+    (g,) = exe.run(main, feed={"x": x_np}, fetch_list=[pairs[0][1]])
+    # dloss/dW = sum over batch of x -> 5.0 each
+    np.testing.assert_allclose(g, np.full((3, 1), 5.0), rtol=1e-6)
+
+
+def test_gradients_wrt_feed():
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 3], "float32")
+        loss = (x * x).sum()
+        (gx,) = static.gradients(loss, [x])
+    exe = static.Executor()
+    x_np = np.array([[1.0, 2.0, 3.0]], "float32")
+    (g,) = exe.run(main, feed={"x": x_np}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2 * x_np, rtol=1e-6)
+
+
+def test_static_dropout_varies_per_run():
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 64], "float32")
+        y = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    exe = static.Executor()
+    x_np = np.ones((2, 64), "float32")
+    (a,) = exe.run(main, feed={"x": x_np}, fetch_list=[y])
+    (b,) = exe.run(main, feed={"x": x_np}, fetch_list=[y])
+    assert not np.allclose(a, b), "dropout mask must differ between runs"
+    # upscale_in_train preserves expectation
+    assert 0.5 < a.mean() < 1.5
+
+
+def test_batchnorm_running_stats_update_in_static():
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        bn = paddle.nn.BatchNorm1D(4)
+        y = bn(x)
+    exe = static.Executor()
+    before = bn._mean.numpy().copy()
+    x_np = np.random.rand(8, 4).astype("float32") + 5.0
+    exe.run(main, feed={"x": x_np}, fetch_list=[y])
+    after = bn._mean.numpy()
+    assert not np.allclose(before, after), "running mean must update"
+    expected = 0.9 * before + 0.1 * x_np.mean(0)
+    np.testing.assert_allclose(after, expected, rtol=1e-4)
+
+
+def test_program_guard_isolation():
+    paddle.enable_static()
+    p1, s1 = _fresh_program()
+    p2, s2 = _fresh_program()
+    with static.program_guard(p1, s1):
+        x1 = static.data("x", [None, 2], "float32")
+        _ = x1 + 1.0
+    with static.program_guard(p2, s2):
+        x2 = static.data("x", [None, 2], "float32")
+        _ = x2 * 3.0
+    assert len(p1.ops) == 1 and len(p2.ops) == 1
+
+
+def test_batch_size_change_recompiles_transparently():
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        y = x.sum()
+    exe = static.Executor()
+    (a,) = exe.run(main, feed={"x": np.ones((2, 4), "float32")}, fetch_list=[y])
+    (b,) = exe.run(main, feed={"x": np.ones((7, 4), "float32")}, fetch_list=[y])
+    assert float(a) == 8.0 and float(b) == 28.0
+
+
+def test_save_load_inference_model(tmp_path):
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 6], "float32")
+        lin = paddle.nn.Linear(6, 2)
+        out = lin(x)
+    exe = static.Executor()
+    x_np = np.random.rand(3, 6).astype("float32")
+    (ref,) = exe.run(main, feed={"x": x_np}, fetch_list=[out])
+
+    prefix = str(tmp_path / "infer")
+    static.save_inference_model(prefix, [x], [out], exe)
+    prog, feed_names, fetch_names = static.load_inference_model(prefix, exe)
+    assert feed_names == ["x"]
+    (loaded,) = prog.run({"x": x_np})
+    np.testing.assert_allclose(loaded, ref, rtol=1e-5)
+    # different batch size through the symbolic dim
+    (l2,) = prog.run({"x": np.random.rand(5, 6).astype("float32")})
+    assert l2.shape == (5, 2)
+
+
+def test_adam_static_training_mnistish():
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 16], "float32")
+        label = static.data("label", [None], "int64")
+        net = paddle.nn.Sequential(
+            paddle.nn.Linear(16, 32), paddle.nn.ReLU(), paddle.nn.Linear(32, 4)
+        )
+        logits = net(x)
+        loss = paddle.nn.functional.cross_entropy(logits, label)
+        opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+        opt.minimize(loss)
+    exe = static.Executor()
+    rng = np.random.RandomState(1)
+    # learnable toy task: class = argmax of 4 chunks' sums
+    losses = []
+    for _ in range(150):
+        x_np = rng.rand(32, 16).astype("float32")
+        y_np = x_np.reshape(32, 4, 4).sum(-1).argmax(-1).astype("int64")
+        (l,) = exe.run(main, feed={"x": x_np, "label": y_np}, fetch_list=[loss])
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.5, f"{losses[0]} -> {losses[-1]}"
+
+
+def test_minimize_without_parameter_list():
+    # the standard static idiom: optimizer constructed with no parameters
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 3], "float32")
+        lin = paddle.nn.Linear(3, 1)
+        loss = (lin(x) ** 2).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.5)
+        opt.minimize(loss)
+    exe = static.Executor()
+    w0 = lin.weight.numpy().copy()
+    exe.run(main, feed={"x": np.ones((4, 3), "float32")}, fetch_list=[loss])
+    assert not np.allclose(lin.weight.numpy(), w0), "weights must update"
+
+
+def test_minimize_with_program_all_parameters():
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 3], "float32")
+        lin = paddle.nn.Linear(3, 1)
+        loss = (lin(x) ** 2).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.5)
+        opt.minimize(loss, parameters=main.all_parameters())
+    exe = static.Executor()
+    (l,) = exe.run(main, feed={"x": np.ones((4, 3), "float32")}, fetch_list=[loss])
+    assert np.isfinite(l)
+
+
+def test_clone_for_test_disables_dropout_and_bn_updates():
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        bn = paddle.nn.BatchNorm1D(8)
+        h = bn(x)
+        y = paddle.nn.functional.dropout(h, p=0.5, training=True)
+    test_prog = main.clone(for_test=True)
+    exe = static.Executor()
+    x_np = np.random.rand(16, 8).astype("float32") + 3.0
+    mean_before = bn._mean.numpy().copy()
+    (out,) = exe.run(test_prog, feed={"x": x_np}, fetch_list=[y])
+    # dropout off: nothing zeroed; bn in inference mode: stats untouched
+    assert (out != 0).all()
+    np.testing.assert_allclose(bn._mean.numpy(), mean_before)
+    # inference bn uses running stats (zeros mean, ones var at init)
+    expected = (x_np - mean_before) / np.sqrt(bn._variance.numpy() + 1e-5)
+    np.testing.assert_allclose(out, expected, rtol=1e-4)
+    # the training program still updates stats
+    exe.run(main, feed={"x": x_np}, fetch_list=[y])
+    assert not np.allclose(bn._mean.numpy(), mean_before)
+
+
+def test_clone_isolated_from_later_recording():
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        h = x * 2.0
+    test_prog = main.clone(for_test=True)
+    n_ops = len(test_prog.ops)
+    with static.program_guard(main, startup):
+        label = static.data("label", [None, 4], "float32")
+        _ = ((h - label) ** 2).mean()
+    assert len(test_prog.ops) == n_ops
+    assert "label" not in test_prog.feed_vars
+    exe = static.Executor()
+    (out,) = exe.run(test_prog, feed={"x": np.ones((2, 4), "float32")}, fetch_list=[h])
+    np.testing.assert_allclose(out, 2.0)
+
+
+def test_save_inference_model_middle_symbolic_dim(tmp_path):
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, None, 6], "float32")
+        lin = paddle.nn.Linear(6, 2)
+        out = lin(x)
+    exe = static.Executor()
+    prefix = str(tmp_path / "seq")
+    static.save_inference_model(prefix, [x], [out], exe)
+    prog, _, _ = static.load_inference_model(prefix, exe)
+    for T in (3, 11):
+        (o,) = prog.run({"x": np.random.rand(2, T, 6).astype("float32")})
+        assert o.shape == (2, T, 2)
