@@ -1,4 +1,5 @@
 """paddle_tpu.distributed.fleet — parity with paddle.distributed.fleet."""
+from . import elastic  # noqa: F401
 from .. import meta_parallel  # noqa: F401
 from ..topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode  # noqa: F401
 from .distributed_strategy import DistributedStrategy  # noqa: F401

@@ -1,0 +1,7 @@
+from .manager import (  # noqa: F401
+    ELASTIC_EXIT_CODE,
+    ElasticManager,
+    ElasticStatus,
+    enable_elastic,
+    launch_elastic,
+)

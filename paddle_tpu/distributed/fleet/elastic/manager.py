@@ -1,0 +1,220 @@
+"""Elastic training manager: node registry, membership watch, auto-relaunch.
+
+Parity: the reference's etcd-based ``ElasticManager``
+(/root/reference/python/paddle/distributed/fleet/elastic/manager.py:103 —
+registers the host under PADDLE_ELASTIC_* env :107-126, watches the node set
+(host_call_back:176), rewrites DISTRIBUTED_TRAINER_ENDPOINTS on change and
+relaunches training; elastic/__init__.py:41-60 restart loop where child exit
+code 101 requests a relaunch; fault-tolerance levels :118).
+
+TPU-native redesign: etcd is replaced by a shared-filesystem KV store
+(heartbeat files under PADDLE_ELASTIC_STORE_PATH — TPU pods mount shared NFS/
+GCS-fuse; single host works out of the box) and by SIGTERM-based preemption
+hooks (TPU preemption notice), wired to auto-checkpoint for resume. The
+restart protocol (exit code 101, endpoint env rewrite) is kept verbatim so
+reference launch scripts port unchanged.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, List, Optional
+
+__all__ = ["ElasticManager", "ElasticStatus", "enable_elastic", "launch_elastic",
+           "ELASTIC_EXIT_CODE"]
+
+ELASTIC_EXIT_CODE = 101  # child exit code meaning "please relaunch me"
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+def enable_elastic(args=None) -> bool:
+    """Elastic is on when PADDLE_ELASTIC_NP is set (parity:
+    elastic/__init__.py:26 enable_elastic checks elastic env)."""
+    return bool(os.environ.get("PADDLE_ELASTIC_NP"))
+
+
+class _FileStore:
+    """Minimal KV/heartbeat store on a shared filesystem (etcd stand-in)."""
+
+    def __init__(self, path: str, ttl: float = 10.0):
+        self.path = path
+        self.ttl = ttl
+        os.makedirs(path, exist_ok=True)
+
+    def register(self, node_id: str, value: str):
+        with open(os.path.join(self.path, node_id), "w") as f:
+            f.write(value)
+
+    def heartbeat(self, node_id: str):
+        os.utime(os.path.join(self.path, node_id), None)
+
+    def deregister(self, node_id: str):
+        try:
+            os.remove(os.path.join(self.path, node_id))
+        except FileNotFoundError:
+            pass
+
+    def nodes(self) -> List[str]:
+        now = time.time()
+        alive = []
+        for name in os.listdir(self.path):
+            p = os.path.join(self.path, name)
+            try:
+                if now - os.path.getmtime(p) <= self.ttl:
+                    alive.append(name)
+            except FileNotFoundError:
+                pass
+        return sorted(alive)
+
+    def endpoints(self) -> List[str]:
+        eps = []
+        for name in self.nodes():
+            with open(os.path.join(self.path, name)) as f:
+                eps.append(f.read().strip())
+        return eps
+
+
+class ElasticManager:
+    """Registers this node, watches membership, decides restart/exit.
+
+    Env protocol (parity: manager.py:107-126):
+      PADDLE_ELASTIC_NP            target node count (elastic on when set)
+      PADDLE_ELASTIC_JOB_ID        job key
+      PADDLE_ELASTIC_TIMEOUT       seconds to hold for stragglers (default 120)
+      PADDLE_ELASTIC_STORE_PATH    shared dir for the node registry
+      PADDLE_CURRENT_ENDPOINT      this node's endpoint
+    """
+
+    def __init__(self, args=None, store: Optional[_FileStore] = None):
+        self.np = int(os.environ.get("PADDLE_ELASTIC_NP", "0") or 0)
+        self.job_id = os.environ.get("PADDLE_ELASTIC_JOB_ID", "default_job")
+        self.timeout = int(os.environ.get("PADDLE_ELASTIC_TIMEOUT", "120"))
+        self.endpoint = os.environ.get(
+            "PADDLE_CURRENT_ENDPOINT", f"{socket.gethostname()}:0"
+        )
+        store_path = os.environ.get(
+            "PADDLE_ELASTIC_STORE_PATH",
+            os.path.join("/tmp", f"paddle_elastic_{self.job_id}"),
+        )
+        self.enable = self.np > 0
+        self.store = store or _FileStore(store_path)
+        self.node_id = self.endpoint.replace(":", "_").replace("/", "_")
+        self._hb_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._membership_at_launch: List[str] = []
+        self.preempted = False
+
+    # -- registry -------------------------------------------------------
+    def register(self):
+        self.store.register(self.node_id, self.endpoint)
+        self._membership_at_launch = self.store.nodes()
+        if self._hb_thread is None:
+            self._hb_thread = threading.Thread(target=self._beat, daemon=True)
+            self._hb_thread.start()
+
+    def _beat(self):
+        while not self._stop.wait(min(2.0, self.store.ttl / 3)):
+            try:
+                self.store.heartbeat(self.node_id)
+            except FileNotFoundError:
+                self.store.register(self.node_id, self.endpoint)
+
+    def exit(self):
+        self._stop.set()
+        self.store.deregister(self.node_id)
+
+    # -- membership -----------------------------------------------------
+    def changed(self) -> bool:
+        return self.store.nodes() != self._membership_at_launch
+
+    def endpoints_env(self) -> str:
+        return ",".join(self.store.endpoints())
+
+    def wait_for_np(self, np: Optional[int] = None) -> bool:
+        """Hold until the registry has the target node count (parity:
+        manager.py wait/HOLD state). Returns False on timeout."""
+        want = np or self.np
+        deadline = time.time() + self.timeout
+        while time.time() < deadline:
+            if len(self.store.nodes()) >= want:
+                return True
+            time.sleep(0.5)
+        return len(self.store.nodes()) >= want
+
+    # -- preemption -----------------------------------------------------
+    def install_preemption_handler(self, on_preempt: Optional[Callable] = None):
+        """SIGTERM = preemption notice: snapshot then request relaunch
+        (TPU-native stand-in for the reference's fault-tolerance levels)."""
+
+        def handler(signum, frame):
+            self.preempted = True
+            if on_preempt is not None:
+                on_preempt()
+            raise SystemExit(ELASTIC_EXIT_CODE)
+
+        signal.signal(signal.SIGTERM, handler)
+
+
+def launch_elastic(cmd: List[str], max_restarts: int = 3,
+                   manager: Optional[ElasticManager] = None,
+                   poll_interval: float = 1.0) -> int:
+    """Restart loop (parity: elastic/__init__.py:41-60).
+
+    Runs ``cmd`` as a child; relaunches it when it exits with
+    ELASTIC_EXIT_CODE or when cluster membership changes, refreshing
+    DISTRIBUTED_TRAINER_ENDPOINTS each launch. Returns the final exit code.
+    """
+    mgr = manager or ElasticManager()
+    mgr.register()
+    restarts = 0
+    try:
+        while True:
+            env = dict(os.environ)
+            env["DISTRIBUTED_TRAINER_ENDPOINTS"] = mgr.endpoints_env()
+            env["PADDLE_ELASTIC_RESTART_NUM"] = str(restarts)
+            proc = subprocess.Popen(cmd, env=env)
+            code = None
+            while code is None:
+                try:
+                    code = proc.wait(timeout=poll_interval)
+                except subprocess.TimeoutExpired:
+                    if mgr.enable and mgr.changed():
+                        proc.terminate()
+                        try:
+                            proc.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            proc.kill()
+                        code = ELASTIC_EXIT_CODE
+            if code == 0:
+                return 0
+            if code == ELASTIC_EXIT_CODE and restarts < max_restarts:
+                restarts += 1
+                mgr._membership_at_launch = mgr.store.nodes()
+                continue
+            return code
+    finally:
+        mgr.exit()
+
+
+def main():  # pragma: no cover
+    """CLI: python -m paddle_tpu.distributed.fleet.elastic -- <training cmd>"""
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    if not argv:
+        print("usage: python -m paddle_tpu.distributed.fleet.elastic -- cmd ...",
+              file=sys.stderr)
+        return 2
+    return launch_elastic(argv)

@@ -3,3 +3,5 @@ from .io import load, save  # noqa: F401
 from .dtype_default import get_default_dtype, set_default_dtype  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401
 from . import monitor  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import CheckpointManager, load_checkpoint, save_checkpoint  # noqa: F401

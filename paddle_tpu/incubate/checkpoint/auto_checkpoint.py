@@ -1,0 +1,134 @@
+"""Auto-checkpoint: transparent epoch-level snapshot/resume.
+
+Parity: ``paddle.fluid.incubate.checkpoint.auto_checkpoint``
+(/root/reference/python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py —
+``TrainEpochRange``:265 wraps the epoch loop and snapshots executor state,
+``AutoCheckpointChecker``:71 reads PADDLE_RUNNING_ENV to decide activation;
+snapshots go through checkpoint_saver.py keyed by job id).
+
+TPU-native: snapshots use the sharded CheckpointManager
+(framework/checkpoint.py) instead of HDFS scope dumps. Activation protocol is
+kept: ``PADDLE_RUNNING_ENV=PADDLE_EDL_AUTO_CHECKPOINT`` plus
+``PADDLE_JOB_ID`` and ``PADDLE_EDL_HDFS_CHECKPOINT_PATH`` (any writable dir
+here) — reference launch scripts work unchanged.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from ...framework.checkpoint import CheckpointManager
+
+__all__ = ["AutoCheckpointChecker", "TrainEpochRange", "train_epoch_range"]
+
+
+class AutoCheckpointChecker:
+    """Reads the activation env protocol (parity: auto_checkpoint.py:71)."""
+
+    def __init__(self):
+        self.running_env = os.environ.get("PADDLE_RUNNING_ENV", "")
+        self.job_id = os.environ.get("PADDLE_JOB_ID", "")
+        self.ckpt_path = os.environ.get("PADDLE_EDL_HDFS_CHECKPOINT_PATH", "")
+        self.save_inter = int(os.environ.get("PADDLE_EDL_SAVE_CHECKPOINT_INTER", "900"))
+
+    def valid(self) -> bool:
+        return (
+            self.running_env == "PADDLE_EDL_AUTO_CHECKPOINT"
+            and bool(self.job_id)
+            and bool(self.ckpt_path)
+        )
+
+    def job_dir(self, name: str) -> str:
+        return os.path.join(self.ckpt_path, self.job_id, name)
+
+
+class TrainEpochRange:
+    """Iterate epochs, persisting progress so a relaunched job resumes where
+    it stopped (parity: TrainEpochRange:265).
+
+    Usage::
+
+        r = TrainEpochRange(max_epoch_num=10, name="run1")
+        r.attach(model=model, optimizer=opt)      # state to snapshot
+        for epoch in r.get():
+            train_one_epoch(...)
+
+    On restart with the same env/job id, ``get()`` starts from the first
+    unfinished epoch and restores attached model/optimizer state.
+    """
+
+    def __init__(self, max_epoch_num: int, name: str,
+                 checkpoint_inter: Optional[int] = None, save_dir: Optional[str] = None):
+        self.max_epoch_num = max_epoch_num
+        self.name = name
+        self._checker = AutoCheckpointChecker()
+        self._model = None
+        self._optimizer = None
+        self._last_save = 0.0
+        if save_dir is not None:
+            self._dir = save_dir
+            self._active = True
+        elif self._checker.valid():
+            self._dir = self._checker.job_dir(name)
+            self._active = True
+        else:
+            self._dir = None
+            self._active = False
+        self.checkpoint_inter = (
+            checkpoint_inter if checkpoint_inter is not None else self._checker.save_inter
+        )
+        self._mgr = CheckpointManager(self._dir) if self._active else None
+        self.restored_from = None
+
+    def attach(self, model=None, optimizer=None):
+        self._model = model
+        self._optimizer = optimizer
+        return self
+
+    @property
+    def start_epoch(self) -> int:
+        if not self._active:
+            return 0
+        latest = self._mgr.latest_step()
+        return 0 if latest is None else latest + 1
+
+    def get(self):
+        start = self.start_epoch
+        if start > 0:
+            self._restore()
+            self.restored_from = start - 1
+        for epoch in range(start, self.max_epoch_num):
+            yield epoch
+            if self._active:
+                now = time.time()
+                if (now - self._last_save >= self.checkpoint_inter
+                        or epoch == self.max_epoch_num - 1):
+                    self._snapshot(epoch)
+                    self._last_save = now
+
+    # force a snapshot (e.g. from a preemption handler)
+    def save(self, epoch: int):
+        if self._active:
+            self._snapshot(epoch)
+
+    def _snapshot(self, epoch: int):
+        state = {"extra": {"name": self.name}}
+        if self._model is not None:
+            state["model"] = dict(self._model.state_dict())
+        if self._optimizer is not None:
+            state["optimizer"] = dict(self._optimizer.state_dict())
+        self._mgr.save(epoch, state, metadata={"epoch": epoch})
+
+    def _restore(self):
+        state, _ = self._mgr.load()
+        if self._model is not None and "model" in state:
+            self._model.set_state_dict(state["model"])
+        if self._optimizer is not None and "optimizer" in state:
+            self._optimizer.set_state_dict(state["optimizer"])
+
+
+def train_epoch_range(max_epoch_num: int, name: str = "default", **kw):
+    """Functional façade (parity: acp.train_epoch_range)."""
+    r = TrainEpochRange(max_epoch_num, name, **kw)
+    return r.get()

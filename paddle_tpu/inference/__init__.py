@@ -1,0 +1,113 @@
+"""paddle_tpu.inference — deployment predictor API.
+
+Parity: paddle.inference (reference paddle/fluid/inference/api/
+analysis_predictor.h:86 AnalysisPredictor + AnalysisConfig; python wrapper
+python/paddle/inference/__init__.py). The reference's pass pipeline /
+TensorRT subgraphs are replaced by XLA: a predictor executes a deserialized
+StableHLO program exported by ``paddle.static.save_inference_model`` or
+``paddle.jit.save`` — already fused and TPU-lowerable.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+
+
+class Config:
+    """AnalysisConfig parity: holds the model path; device/ir toggles are
+    accepted and recorded (XLA owns optimization/placement)."""
+
+    def __init__(self, prog_file: Optional[str] = None, params_file: Optional[str] = None):
+        # accept either a path prefix (our native form) or the reference's
+        # (model, params) file pair sharing a prefix
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self.model_prefix = prog_file
+        self._use_device = "tpu"
+        self.ir_optim = True
+        self._memory_pool_mb = 0
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        if prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self.model_prefix = prog_file
+
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100, device_id: int = 0):
+        self._use_device = "tpu"  # accelerator of this build
+        self._memory_pool_mb = memory_pool_init_size_mb
+
+    def disable_gpu(self):
+        self._use_device = "cpu"
+
+    def switch_ir_optim(self, flag: bool = True):
+        self.ir_optim = flag
+
+    def enable_memory_optim(self):
+        pass
+
+
+class PredictorTensor:
+    """ZeroCopy tensor handle parity (api/details/zero_copy_tensor.cc)."""
+
+    def __init__(self, name: str, owner: "Predictor", is_input: bool):
+        self.name = name
+        self._owner = owner
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._owner._feeds[self.name] = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return self._owner._outputs[self.name]
+
+    def shape(self):
+        a = (self._owner._feeds if self._is_input else self._owner._outputs).get(self.name)
+        return list(a.shape) if a is not None else []
+
+
+class Predictor:
+    """AnalysisPredictor parity over a StableHLO export."""
+
+    def __init__(self, config: Config):
+        from ..static import load_inference_model
+
+        if not config.model_prefix:
+            raise ValueError("Config has no model path")
+        prog, feed_names, fetch_names = load_inference_model(config.model_prefix, None)
+        self._prog = prog
+        self._feed_names = list(feed_names)
+        self._fetch_names = list(fetch_names)
+        self._feeds = {}
+        self._outputs = {}
+
+    # -- reference API --------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        return PredictorTensor(name, self, True)
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        return PredictorTensor(name, self, False)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """ZeroCopyRun parity; optionally positional inputs like the v2 API."""
+        if inputs is not None:
+            for n, a in zip(self._feed_names, inputs):
+                self._feeds[n] = np.asarray(a)
+        missing = [n for n in self._feed_names if n not in self._feeds]
+        if missing:
+            raise ValueError(f"missing inputs: {missing}")
+        outs = self._prog.run(self._feeds)
+        self._outputs = dict(zip(self._fetch_names, outs))
+        return [self._outputs[n] for n in self._fetch_names]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

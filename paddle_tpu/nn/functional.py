@@ -201,7 +201,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
             return jnp.where(keep, scaled, 0.0).astype(arr.dtype)
 
         out = record_rng_op(_dropout_rng, "dropout", (x,))
-        out._program.ops[-1].tags = {"dropout": True}
+        out._program.ops[-1].tags = {"dropout": True, "p": p, "mode": mode}
         return out
 
     arr = unwrap(x)
@@ -633,7 +633,7 @@ def _bn_moded(x, rm, rv, weight, bias, eps, ch_axis, momentum, training):
         out = out * weight.reshape(shape)
     if bias is not None:
         out = out + bias.reshape(shape)
-    if training:
+    if training and rm is not None:
         new_rm = momentum * rm + (1.0 - momentum) * jax.lax.stop_gradient(mean)
         new_rv = momentum * rv + (1.0 - momentum) * jax.lax.stop_gradient(var)
     else:

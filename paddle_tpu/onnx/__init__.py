@@ -1,0 +1,27 @@
+"""paddle_tpu.onnx — model export façade.
+
+Parity: paddle.onnx.export (reference python/paddle/onnx/export.py, which
+delegates to the external paddle2onnx package). That package is not available
+here and ONNX is not the TPU deployment path — ``export`` therefore emits the
+portable StableHLO artifact (via jit.save) next to a clear notice; StableHLO
+is this framework's cross-runtime interchange format the way ONNX is the
+reference's.
+"""
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9, **configs):
+    from ..jit import save as jit_save
+
+    warnings.warn(
+        "ONNX emission is unavailable (paddle2onnx not present); exporting "
+        "portable StableHLO instead — load with paddle_tpu.jit.load or any "
+        "StableHLO-consuming runtime",
+        stacklevel=2,
+    )
+    jit_save(layer, path, input_spec=input_spec)
+    return path

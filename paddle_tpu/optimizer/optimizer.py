@@ -212,6 +212,11 @@ class Optimizer:
                 for k, v in slots.items():
                     sd[f"{self._param_key(p, i)}.{k}"] = Tensor(v)
         sd["global_step"] = self._global_step
+        # positional alias so a restore can match slots even when the fresh
+        # process assigned different auto-generated parameter names
+        sd["__param_order__"] = [
+            self._param_key(p, i) for i, p in enumerate(self._param_groups)
+        ]
         if isinstance(self._learning_rate, LRScheduler):
             sd["LR_Scheduler"] = self._learning_rate.state_dict()
         return sd
@@ -220,10 +225,15 @@ class Optimizer:
         self._global_step = int(state_dict.get("global_step", 0))
         if isinstance(self._learning_rate, LRScheduler) and "LR_Scheduler" in state_dict:
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        order = state_dict.get("__param_order__")
         for i, p in enumerate(self._param_groups):
+            base = self._param_key(p, i)
+            if self._slot_names and f"{base}.{self._slot_names[0]}" not in state_dict \
+                    and order and i < len(order):
+                base = order[i]  # name skew: fall back to positional identity
             slots = {}
             for name in self._slot_names:
-                key = f"{self._param_key(p, i)}.{name}"
+                key = f"{base}.{name}"
                 if key in state_dict:
                     v = state_dict[key]
                     slots[name] = v._data if isinstance(v, Tensor) else jnp.asarray(v)

@@ -81,18 +81,20 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
     else:
         sources = [t for (t, _) in prog.captures() if t.trainable]
 
-    prog.grad_sources = sources
+    # merge with any previously registered sources (same rule as minimize)
+    merged = list(prog.grad_sources)
+    seen = {id(s) for s in merged}
+    for s in sources:
+        if id(s) not in seen:
+            merged.append(s)
+            seen.add(id(s))
+    prog.grad_sources = merged
     prog._exec_cache.clear()
 
     pairs = []
     for s in sources:
         v = s if isinstance(s, Variable) else prog.capture(s)
-        g = prog.grad_map.get(v.name)
-        if g is None:
-            g = Variable(v._data, f"{v.name}@GRAD", prog, role="grad")
-            prog.grad_map[v.name] = g
-            prog._register(g)
-        pairs.append((v, g))
+        pairs.append((v, prog.grad_var_for(v)))
     return pairs
 
 

@@ -79,6 +79,10 @@ class Executor:
         feed = feed or {}
         fetch_list = list(fetch_list or [])
 
+        # deserialized inference programs execute their StableHLO directly
+        if hasattr(program, "_exported"):
+            return program.run(feed)
+
         # startup programs / empty programs: nothing to do
         if not program.ops and not fetch_list:
             return []
@@ -162,8 +166,12 @@ class Executor:
         cap_index_by_id = {id(t): i for i, (t, _) in enumerate(captures)}
         feed_index = {n: i for i, n in enumerate(feed_names)}
         diff_entries = []  # (kind, index, name) with kind in {"cap", "feed"}
+        sources = []
         if grad_requested:
-            sources = program.opt_params if program.optimizer else program.grad_sources
+            # grad_sources is the merged set (append_backward/gradients +
+            # optimizer params); differentiate all of it so every registered
+            # @GRAD fetch resolves, then update only the optimizer's params
+            sources = program.grad_sources or program.opt_params
             for s in sources:
                 if isinstance(s, Variable) and s._role == "feed":
                     diff_entries.append(("feed", feed_index[s.name], s.name))
@@ -216,6 +224,8 @@ class Executor:
             raise RuntimeError("gradients requested but no loss was set")
 
         opt = program.optimizer
+        pos_by_id = {id(s): j for j, s in enumerate(sources)}
+        opt_positions = [pos_by_id[id(p)] for p in program.opt_params] if opt else []
 
         def step_train(feed_arrays, capture_arrays, opt_state, lr, *rng):
             def loss_fn(diff_arrays):
@@ -238,8 +248,10 @@ class Executor:
             fetches, writes = harvest(env, grads_by_name)
             if opt is None:
                 return fetches, diff_arrays, opt_state, writes
+            opt_arrays = [diff_arrays[j] for j in opt_positions]
+            opt_grads = [grads[j] for j in opt_positions]
             new_params, new_state = opt.apply_gradients(
-                diff_arrays, list(grads), opt_state, lr=lr
+                opt_arrays, opt_grads, opt_state, lr=lr
             )
             return fetches, new_params, new_state, writes
 

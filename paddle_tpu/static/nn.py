@@ -24,8 +24,9 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
             in_dim *= int(s)
         layer = nn.Linear(in_dim, size, weight_attr=weight_attr, bias_attr=bias_attr)
     if len(x.shape) > num_flatten_dims + 1:
-        lead = list(x.shape[:num_flatten_dims])
-        x = manipulation.reshape(x, lead + [in_dim])
+        # dim 0 may be symbolic (meta value 1): let reshape infer it with -1
+        target = [-1] + list(x.shape[1:num_flatten_dims]) + [in_dim]
+        x = manipulation.reshape(x, target)
     out = layer(x)
     if activation:
         from ..nn import functional as F

@@ -175,6 +175,15 @@ class Program:
         self.state_writes[id(target)] = (target, value)
         self._exec_cache.clear()
 
+    def grad_var_for(self, v: Variable) -> Variable:
+        """The ``<name>@GRAD`` Variable for a differentiation source."""
+        g = self.grad_map.get(v.name)
+        if g is None:
+            g = Variable(v._data, f"{v.name}@GRAD", self, role="grad")
+            self.grad_map[v.name] = g
+            self._register(g)
+        return g
+
     def _set_optimizer(self, optimizer, loss: Variable, params: Sequence[Tensor]):
         self.optimizer = optimizer
         self.loss_var = loss
@@ -194,14 +203,15 @@ class Program:
         self._exec_cache.clear()
         pairs = []
         for p in self.opt_params:
-            cap = self.capture(p)
-            g = self.grad_map.get(cap.name)
-            if g is None:
-                g = Variable(cap._data, f"{cap.name}@GRAD", self, role="grad")
-                self.grad_map[cap.name] = g
-                self._register(g)
-            pairs.append((cap, g))
-        self.grad_sources = list(self.opt_params)
+            pairs.append((self.capture(p), self.grad_var_for(self.capture(p))))
+        # merge (not overwrite) earlier append_backward/gradients() sources so
+        # their @GRAD fetches keep working during optimized training
+        merged = list(self.grad_sources)
+        seen = {id(s) for s in merged}
+        for p in self.opt_params:
+            if id(p) not in seen:
+                merged.append(p)
+        self.grad_sources = merged
         return None, pairs
 
     def global_block(self):
@@ -244,7 +254,11 @@ class Program:
             for rec in p.ops:
                 tags = rec.tags or {}
                 if "dropout" in tags:
-                    rec.fn = lambda key, arr: arr
+                    if tags.get("mode") == "downscale_in_infer":
+                        scale = 1.0 - tags.get("p", 0.0)
+                        rec.fn = (lambda s: lambda key, arr: arr * s)(scale)
+                    else:  # upscale_in_train: inference is identity
+                        rec.fn = lambda key, arr: arr
                 elif "bn" in tags:
                     # the only bare-bool literal in a bn record is `training`
                     rec.flat_args = [

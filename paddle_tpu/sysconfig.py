@@ -1,0 +1,14 @@
+"""paddle.sysconfig parity: include/lib dirs of the native core."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    return os.path.join(_PKG, "core", "native")
+
+
+def get_lib() -> str:
+    return os.path.join(_PKG, "core", "native")

@@ -1,0 +1,30 @@
+"""paddle_tpu.text — text datasets and sequence decoding.
+
+Parity: python/paddle/text (reference text/__init__.py exposes datasets;
+viterbi_decode op is operators/viterbi_decode_op.* with
+paddle.text.ViterbiDecoder in later versions).
+"""
+from . import datasets  # noqa: F401
+from .datasets import (  # noqa: F401
+    Conll05st,
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)
+from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401
+
+__all__ = [
+    "datasets",
+    "Conll05st",
+    "Imdb",
+    "Imikolov",
+    "Movielens",
+    "UCIHousing",
+    "WMT14",
+    "WMT16",
+    "ViterbiDecoder",
+    "viterbi_decode",
+]

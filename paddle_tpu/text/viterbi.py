@@ -1,0 +1,93 @@
+"""Viterbi decoding for linear-chain CRF tagging.
+
+Parity: viterbi_decode op (reference
+/root/reference/paddle/fluid/operators/... viterbi-family; crf_decoding
+operators/crf_decoding_op.h) — max-sum dynamic program over a transition
+matrix with optional start/stop augmentation via include_bos_eos_tag.
+
+TPU-native: the DP recurrence is a ``jax.lax.scan`` over time (compiles to a
+single fused loop on device; no per-step host dispatch), batched over
+sequences, with length masking instead of LoD.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._primitive import primitive, unwrap, wrap
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi_raw(potentials, transition, lengths, include_bos_eos_tag=True):
+    """potentials: (B, T, N) emission scores; transition: (N, N);
+    lengths: (B,) int. Returns (scores (B,), paths (B, T) int64)."""
+    B, T, N = potentials.shape
+    trans = transition
+    if include_bos_eos_tag:
+        # reference convention: tag N-2 = BOS, N-1 = EOS
+        start_mask = transition[N - 2]
+        stop_vec = transition[:, N - 1]
+    else:
+        start_mask = jnp.zeros((N,), potentials.dtype)
+        stop_vec = jnp.zeros((N,), potentials.dtype)
+
+    alpha0 = potentials[:, 0, :] + (start_mask if include_bos_eos_tag else 0.0)
+
+    def step(carry, t):
+        alpha, _ = carry
+        emit = potentials[:, t, :]                       # (B, N)
+        scores = alpha[:, :, None] + trans[None, :, :] + emit[:, None, :]
+        best_prev = jnp.argmax(scores, axis=1)           # (B, N)
+        new_alpha = jnp.max(scores, axis=1)              # (B, N)
+        active = (t < lengths)[:, None]
+        new_alpha = jnp.where(active, new_alpha, alpha)
+        return (new_alpha, None), jnp.where(active, best_prev, -1)
+
+    (alpha, _), backptrs = jax.lax.scan(
+        step, (alpha0, None), jnp.arange(1, T)
+    )  # backptrs: (T-1, B, N)
+
+    final = alpha + (stop_vec[None, :] if include_bos_eos_tag else 0.0)
+    scores = jnp.max(final, axis=-1)
+    last_tag = jnp.argmax(final, axis=-1)                # (B,)
+
+    def backtrack(carry, bp_t):
+        # walk backwards: bp_t is (B, N) pointers for step t
+        tag, t_idx, _ = carry
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        new_tag = jnp.where(prev >= 0, prev, tag)
+        return (new_tag, t_idx - 1, None), tag
+
+    (first_tag, _, _), rev_tags = jax.lax.scan(
+        backtrack, (last_tag, T - 2, None), backptrs, reverse=True
+    )  # rev_tags: (T-1, B) tags for positions 1..T-1
+    paths = jnp.concatenate([first_tag[None, :], rev_tags], axis=0)  # (T, B)
+    paths = jnp.transpose(paths).astype(jnp.int64)        # (B, T)
+    # positions past each sequence's length: repeat last valid tag -> mask to 0
+    pos = jnp.arange(T)[None, :]
+    paths = jnp.where(pos < lengths[:, None], paths, 0)
+    return scores, paths
+
+
+@primitive(nondiff=True)
+def _viterbi_op(potentials, transition, lengths, include_bos_eos_tag):
+    return _viterbi_raw(potentials, transition, lengths, include_bos_eos_tag)
+
+
+def viterbi_decode(potentials, transition_params, lengths, include_bos_eos_tag=True):
+    """Returns (scores, paths) — best-path scores and tag sequences."""
+    return _viterbi_op(potentials, transition_params, lengths, include_bos_eos_tag)
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper holding the transition matrix."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(
+            potentials, self.transitions, lengths, self.include_bos_eos_tag
+        )

@@ -1,0 +1,55 @@
+"""paddle_tpu.utils — misc user utilities.
+
+Parity: python/paddle/utils (download.py get_weights_path_from_url,
+lazy_import/try_import, deprecated decorator, install_check.py run_check).
+"""
+from . import download  # noqa: F401
+from .download import get_weights_path_from_url  # noqa: F401
+from .install_check import run_check  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
+
+__all__ = ["download", "get_weights_path_from_url", "try_import", "run_check",
+           "deprecated", "require_version"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = ""):
+    """Decorator emitting a DeprecationWarning (parity: utils/deprecated.py)."""
+    import functools
+    import warnings
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*a, **k):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+
+        return inner
+
+    return wrap
+
+
+def require_version(min_version: str, max_version: str = None):
+    """Check the installed framework version (parity: utils/__init__.py
+    require_version)."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(x) for x in v.split(".")[:3])
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"version {min_version} required, installed {__version__}"
+        )
+    if max_version and parse(max_version) < cur:
+        raise Exception(
+            f"version <= {max_version} required, installed {__version__}"
+        )
+    return True

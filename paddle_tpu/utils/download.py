@@ -1,0 +1,42 @@
+"""Weight-file resolution (parity: python/paddle/utils/download.py).
+
+This environment has zero network egress, so URLs resolve strictly against
+the local cache (``~/.cache/paddle_tpu/weights`` or ``$PADDLE_TPU_HOME``); a
+missing file raises with instructions instead of downloading.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["get_weights_path_from_url", "cache_dir"]
+
+
+def cache_dir() -> str:
+    root = os.environ.get(
+        "PADDLE_TPU_HOME", os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu")
+    )
+    d = os.path.join(root, "weights")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _md5check(path: str, md5sum: str) -> bool:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def get_weights_path_from_url(url: str, md5sum: str = None) -> str:
+    fname = os.path.basename(url)
+    path = os.path.join(cache_dir(), fname)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"pretrained weights {fname!r} not in local cache {cache_dir()!r} "
+            "and network downloads are disabled; place the file there manually"
+        )
+    if md5sum and not _md5check(path, md5sum):
+        raise IOError(f"md5 mismatch for cached file {path}")
+    return path

@@ -1,0 +1,30 @@
+"""paddle.utils.run_check parity (reference utils/install_check.py): train a
+tiny model end-to-end and report the device fleet."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    import jax
+
+    import paddle_tpu as paddle
+
+    devs = jax.devices()
+    print(f"paddle_tpu is installed; found {len(devs)} device(s): "
+          f"{devs[0].platform}")
+    net = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    x = paddle.to_tensor(np.random.rand(8, 4).astype("float32"))
+    losses = []
+    for _ in range(5):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] <= losses[0], "training smoke failed"
+    print("paddle_tpu works! single-device train smoke passed "
+          f"(loss {losses[0]:.4f} -> {losses[-1]:.4f})")

@@ -1,0 +1,15 @@
+"""try_import (parity: python/paddle/utils/lazy_import.py)."""
+import importlib
+
+__all__ = ["try_import"]
+
+
+def try_import(module_name: str, err_msg: str = None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        msg = err_msg or (
+            f"Optional dependency {module_name!r} is required for this "
+            f"feature; it is not installed in this environment"
+        )
+        raise ImportError(msg) from None

@@ -294,3 +294,63 @@ def test_save_inference_model_middle_symbolic_dim(tmp_path):
     for T in (3, 11):
         (o,) = prog.run({"x": np.random.rand(2, T, 6).astype("float32")})
         assert o.shape == (2, T, 2)
+
+
+def test_input_grad_fetch_during_optimized_training():
+    # adversarial-training pattern: fetch d(loss)/d(input) while minimizing
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 3], "float32")
+        lin = paddle.nn.Linear(3, 1)
+        loss = (lin(x) ** 2).mean()
+        (gx,) = static.gradients(loss, [x])
+        opt = paddle.optimizer.SGD(learning_rate=0.01)
+        opt.minimize(loss)
+    exe = static.Executor()
+    x_np = np.random.rand(4, 3).astype("float32")
+    w0 = lin.weight.numpy().copy()
+    g, l = exe.run(main, feed={"x": x_np}, fetch_list=[gx, loss])
+    assert g.shape == x_np.shape and np.isfinite(l)
+    assert not np.allclose(lin.weight.numpy(), w0), "params must still update"
+
+
+def test_exe_run_accepts_loaded_program(tmp_path):
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        out = x * 3.0
+    exe = static.Executor()
+    prefix = str(tmp_path / "m")
+    static.save_inference_model(prefix, [x], [out], exe)
+    prog, feeds, fetches = static.load_inference_model(prefix, exe)
+    x_np = np.ones((2, 4), "float32")
+    (o,) = exe.run(prog, feed={"x": x_np}, fetch_list=fetches)
+    np.testing.assert_allclose(o, 3.0)
+
+
+def test_clone_for_test_downscale_dropout_scales():
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 16], "float32")
+        y = paddle.nn.functional.dropout(x, p=0.5, training=True,
+                                         mode="downscale_in_infer")
+    test_prog = main.clone(for_test=True)
+    exe = static.Executor()
+    (out,) = exe.run(test_prog, feed={"x": np.ones((2, 16), "float32")},
+                     fetch_list=[y])
+    np.testing.assert_allclose(out, 0.5)
+
+
+def test_static_nn_fc_batch_gt_one():
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 3, 4], "float32")
+        out = static.nn.fc(x, 5)
+    exe = static.Executor()
+    (o,) = exe.run(main, feed={"x": np.ones((8, 3, 4), "float32")},
+                   fetch_list=[out])
+    assert o.shape == (8, 5)

@@ -1,0 +1,202 @@
+"""text / utils / inference / asp package tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ---------------------------------------------------------------------------
+# text
+# ---------------------------------------------------------------------------
+def test_text_datasets_synthetic():
+    from paddle_tpu.text import Imdb, Imikolov, UCIHousing, WMT14
+
+    housing = UCIHousing(mode="train")
+    x, y = housing[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert len(UCIHousing(mode="test")) > 0
+
+    imdb = Imdb(mode="train")
+    seq, label = imdb[0]
+    assert seq.dtype == np.int64 and label in (0, 1)
+
+    ng = Imikolov(window_size=5)
+    ctx, tgt = ng[0]
+    assert ctx.shape == (4,)
+
+    src, tin, tout = WMT14()[0]
+    assert len(tin) == len(tout)
+
+
+def test_text_dataset_missing_file_raises(tmp_path):
+    from paddle_tpu.text import UCIHousing
+
+    with pytest.raises(FileNotFoundError):
+        UCIHousing(data_file=str(tmp_path / "nope.data"))
+
+
+def test_viterbi_decode_matches_bruteforce():
+    from paddle_tpu.text import ViterbiDecoder
+
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 5, 4
+    pot = rng.randn(B, T, N).astype("float32")
+    trans = rng.randn(N, N).astype("float32")
+    lengths = np.array([5, 3, 4], "int64")
+
+    dec = ViterbiDecoder(paddle.to_tensor(trans), include_bos_eos_tag=False)
+    scores, paths = dec(paddle.to_tensor(pot), paddle.to_tensor(lengths))
+    scores, paths = scores.numpy(), paths.numpy()
+
+    # brute force per sequence
+    import itertools
+
+    for b in range(B):
+        L = int(lengths[b])
+        best, best_path = -1e30, None
+        for assign in itertools.product(range(N), repeat=L):
+            s = pot[b, 0, assign[0]]
+            for t in range(1, L):
+                s += trans[assign[t - 1], assign[t]] + pot[b, t, assign[t]]
+            if s > best:
+                best, best_path = s, assign
+        np.testing.assert_allclose(scores[b], best, rtol=1e-5)
+        np.testing.assert_array_equal(paths[b, :L], best_path)
+
+
+# ---------------------------------------------------------------------------
+# utils
+# ---------------------------------------------------------------------------
+def test_utils_try_import_and_version():
+    from paddle_tpu.utils import require_version, try_import
+
+    assert try_import("json") is not None
+    with pytest.raises(ImportError):
+        try_import("definitely_not_a_module_xyz")
+    assert require_version("0.0.1")
+    with pytest.raises(Exception):
+        require_version("999.0.0")
+
+
+def test_utils_run_check(capsys):
+    from paddle_tpu.utils import run_check
+
+    run_check()
+    out = capsys.readouterr().out
+    assert "works" in out
+
+
+def test_utils_download_cache_only(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_HOME", str(tmp_path))
+    from paddle_tpu.utils import get_weights_path_from_url
+
+    with pytest.raises(FileNotFoundError):
+        get_weights_path_from_url("https://example.com/w.pdparams")
+    target = tmp_path / "weights" / "w.pdparams"
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_bytes(b"hi")
+    assert get_weights_path_from_url("https://example.com/w.pdparams") == str(target)
+
+
+def test_deprecated_decorator():
+    from paddle_tpu.utils import deprecated
+
+    @deprecated(update_to="new_fn", since="0.1")
+    def old_fn():
+        return 5
+
+    with pytest.warns(DeprecationWarning):
+        assert old_fn() == 5
+
+
+# ---------------------------------------------------------------------------
+# inference predictor
+# ---------------------------------------------------------------------------
+def test_inference_predictor_roundtrip(tmp_path):
+    from paddle_tpu import inference, static
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            lin = paddle.nn.Linear(4, 3)
+            out = lin(x)
+        exe = static.Executor()
+        x_np = np.random.rand(2, 4).astype("float32")
+        (ref,) = exe.run(main, feed={"x": x_np}, fetch_list=[out])
+        prefix = str(tmp_path / "model")
+        static.save_inference_model(prefix, [x], [out], exe)
+    finally:
+        paddle.disable_static()
+
+    cfg = inference.Config(prefix + ".pdmodel")
+    pred = inference.create_predictor(cfg)
+    assert pred.get_input_names() == ["x"]
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(x_np)
+    pred.run()
+    got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# asp 2:4 sparsity
+# ---------------------------------------------------------------------------
+def test_asp_mask_and_prune():
+    from paddle_tpu.incubate import asp
+
+    w = np.random.randn(8, 16).astype("float32")
+    mask = asp.create_mask(w)
+    assert asp.check_mask_1d(mask)
+    assert abs(asp.calculate_density(mask) - 0.5) < 1e-6
+    # mask keeps the 2 largest magnitudes per group of 4
+    groups = (np.abs(w).reshape(-1, 4)).argsort(axis=1)[:, 2:]
+    kept = mask.reshape(-1, 4)
+    for g, idx in zip(kept, groups):
+        assert g[idx].all()
+
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 8), paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 4))
+    masks = asp.prune_model(net)
+    assert len(masks) == 2
+    assert asp.check_mask_1d(net[0].weight.numpy())
+
+
+def test_asp_optimizer_preserves_sparsity():
+    from paddle_tpu.incubate import asp
+
+    net = paddle.nn.Linear(8, 8, bias_attr=False)
+    asp.prune_model(net)
+    opt = asp.decorate(
+        paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters()),
+        model=net,
+    )
+    x = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+    for _ in range(3):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert asp.check_mask_1d(net.weight.numpy())
+
+
+def test_sysconfig_and_onnx(tmp_path):
+    import os
+
+    from paddle_tpu import sysconfig
+
+    assert os.path.isdir(sysconfig.get_lib())
+
+    from paddle_tpu import onnx as ponnx
+    from paddle_tpu.jit import InputSpec
+
+    net = paddle.nn.Linear(3, 2)
+    with pytest.warns(UserWarning):
+        ponnx.export(net, str(tmp_path / "m"),
+                     input_spec=[InputSpec([-1, 3], "float32")])
+    import paddle_tpu.jit as jit
+
+    loaded = jit.load(str(tmp_path / "m"))
+    x = paddle.to_tensor(np.random.rand(4, 3).astype("float32"))
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(), rtol=1e-5)
