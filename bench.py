@@ -47,8 +47,11 @@ def main():
     on_tpu = dev.platform == "tpu"
 
     if on_tpu:
+        # measured on v5e-1: recompute OFF at batch 8 is the throughput
+        # optimum (33.9k tok/s vs 29.2k with remat; batch 16 OOMs without
+        # remat, and remat at 16 is slower than no-remat at 8)
         cfg = gpt_config("gpt3-350m", hidden_dropout_prob=0.0,
-                         attention_dropout_prob=0.0, use_recompute=True)
+                         attention_dropout_prob=0.0, use_recompute=False)
         batch, seq, steps, warmup = 8, 1024, 10, 3
     else:  # CI / CPU smoke: tiny shapes, same code path
         cfg = gpt_config("gpt2-small", vocab_size=256, hidden_size=64,

@@ -45,7 +45,12 @@ from .spmd import (  # noqa: F401
 )
 from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode  # noqa: F401
 
-# auto-parallel front door (parity: auto_parallel/interface.py shard_tensor)
+# auto-parallel front door (parity: auto_parallel/interface.py shard_tensor).
+# The full ProcessMesh/shard_tensor/shard_op/Engine surface lives in
+# distributed.auto_parallel; this top-level alias keeps the mesh+placements
+# convenience form working.
+from . import auto_parallel  # noqa: F401,E402
+
 shard_tensor = shard_tensor_to
 
 

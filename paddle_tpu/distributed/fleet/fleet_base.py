@@ -143,6 +143,45 @@ class Fleet:
         if strategy is not None:
             self._strategy = strategy
         self._user_defined_optimizer = optimizer
+
+        # strategy-selected meta-optimizers (parity: MetaOptimizerFactory
+        # chain, fleet_base.py:1433 — each _can_apply'd rewrite wraps/replaces
+        # the user optimizer before the hybrid wrapper)
+        s = self._strategy
+        if s is not None and getattr(s, "dgc", False):
+            from ..meta_optimizers import DGCMomentum
+            from ...optimizer.optimizers import Momentum
+
+            if isinstance(optimizer, Momentum) and not isinstance(optimizer, DGCMomentum):
+                cfg = dict(getattr(s, "dgc_configs", {}) or {})
+                optimizer = DGCMomentum(
+                    learning_rate=optimizer._learning_rate,
+                    momentum=optimizer._momentum,
+                    parameters=optimizer._parameter_list,
+                    use_nesterov=optimizer._use_nesterov,
+                    rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                    rampup_step=cfg.get("rampup_step", 1),
+                    sparsity=cfg.get("sparsity", [0.999]),
+                    weight_decay=optimizer._weight_decay_coeff or None,
+                    grad_clip=optimizer._grad_clip,
+                )
+        if s is not None and getattr(s, "localsgd", False):
+            from ..meta_optimizers import LocalSGDOptimizer
+
+            cfg = dict(getattr(s, "localsgd_configs", {}) or {})
+            optimizer = LocalSGDOptimizer(
+                optimizer, k_steps=cfg.get("k_steps", 1),
+                begin_step=cfg.get("begin_step", 1),
+            )
+        elif s is not None and getattr(s, "adaptive_localsgd", False):
+            from ..meta_optimizers import AdaptiveLocalSGDOptimizer
+
+            cfg = dict(getattr(s, "adaptive_localsgd_configs", {}) or {})
+            optimizer = AdaptiveLocalSGDOptimizer(
+                optimizer, init_k_steps=cfg.get("init_k_steps", 1),
+                begin_step=cfg.get("begin_step", 1),
+            )
+
         from ..meta_parallel.hybrid_optimizer import HybridParallelOptimizer
 
         return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)

@@ -63,9 +63,15 @@ class HybridParallelOptimizer:
         self._inner_opt.step()
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        if hasattr(self._inner_opt, "record_loss"):
+            self._inner_opt.record_loss(loss)  # adaptive-localsgd k feedback
         loss.backward()
         self.step()
         return None, []
+
+    def __getattr__(self, name):
+        # delegate the remaining optimizer surface (get/set lr handled above)
+        return getattr(self.__dict__["_inner_opt"], name)
 
     # functional surface for the jitted trainer
     def init_state(self, params_tree):

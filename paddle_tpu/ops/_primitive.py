@@ -78,7 +78,11 @@ def primitive(fn: Callable = None, *, nondiff: bool = False, aux: int = 0, name:
             from ..static import program as _sp
 
             if _sp.recording_active():
-                return _sp.record_op(fn, op_name, args, kwargs)
+                # autocast applies at record time: the cast-inserting wrapper
+                # is baked into the recorded closure (parity: static AMP
+                # rewrite_program, contrib/mixed_precision/decorator.py:37)
+                fn_rec = amp_wrap_fn(fn, op_name) if amp_state().enable else fn
+                return _sp.record_op(fn_rec, op_name, args, kwargs)
 
         # AMP autocast hook (≙ dygraph amp_auto_cast.cc cast insertion):
         # the casting wrapper keeps casts inside the traced fn so their VJP

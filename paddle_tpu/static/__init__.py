@@ -220,3 +220,5 @@ xpu_places = cuda_places
 
 # static nn helpers (parity: paddle.static.nn.fc/batch_norm/conv2d/embedding)
 from . import nn  # noqa: E402,F401
+# static mixed precision (parity: fluid/contrib/mixed_precision)
+from . import amp  # noqa: E402,F401

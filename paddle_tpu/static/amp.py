@@ -1,0 +1,112 @@
+"""Static-graph mixed precision.
+
+Parity: the reference's static AMP (fluid/contrib/mixed_precision/
+decorator.py:37 OptimizerWithMixedPrecision — O1 ``rewrite_program`` inserts
+casts by white/black lists, O2 ``cast_model_to_fp16``:188; fp16_lists.py).
+
+TPU-native: cast insertion happens at record time — building the program
+inside ``amp.auto_cast`` (or after ``enable_operators``) bakes bf16 casts
+into the recorded closures; there is no separate rewrite pass. Loss scaling
+is generally unnecessary in bf16 (same exponent range as fp32 — the
+reference's fp16-driven scaling state machine is kept only for the fp16
+path via ``decorate(..., init_loss_scaling)``).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..amp.auto_cast import amp_state, auto_cast
+
+__all__ = ["decorate", "amp_guard", "CustomOpLists"]
+
+
+def CustomOpLists(custom_white_list=None, custom_black_list=None):
+    """Parity: AutoMixedPrecisionLists (fp16_lists.py)."""
+    return {"white": set(custom_white_list or ()),
+            "black": set(custom_black_list or ())}
+
+
+@contextlib.contextmanager
+def amp_guard(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """Build-time autocast context for static programs."""
+    with auto_cast(enable, custom_white_list, custom_black_list, level, dtype):
+        yield
+
+
+class OptimizerWithMixedPrecision:
+    """Wraps an optimizer for static AMP training (decorator.py:37 parity).
+
+    ``minimize`` enables autocast while the *caller-supplied builder* records
+    — but since in this framework the forward is usually already recorded by
+    the time minimize is called, the recommended flow is::
+
+        with paddle.static.amp.amp_guard(level="O2"):
+            out = net(x); loss = ...
+        opt = paddle.static.amp.decorate(paddle.optimizer.AdamW(...))
+        opt.minimize(loss)
+
+    Loss scaling: bf16 needs none (scale fixed at 1); an explicit
+    ``init_loss_scaling`` multiplies the loss and un-scales grads inside the
+    compiled step via the optimizer's grad hook.
+    """
+
+    def __init__(self, optimizer, amp_lists=None, init_loss_scaling=1.0,
+                 use_dynamic_loss_scaling=False, level="O1", dtype="bfloat16"):
+        import warnings
+
+        self._inner = optimizer
+        self._loss_scale = float(init_loss_scaling)
+        self.level = level
+        self.dtype = dtype
+        self._amp_lists = amp_lists
+        self._wrapped = False
+        if amp_lists:
+            warnings.warn(
+                "static.amp.decorate: pass custom white/black lists to "
+                "amp_guard(custom_white_list=..., custom_black_list=...) — "
+                "casting happens at record time, not in minimize",
+                stacklevel=3,
+            )
+        if use_dynamic_loss_scaling:
+            warnings.warn(
+                "static.amp.decorate: dynamic loss scaling is not implemented "
+                "for the static path (bf16 needs none); using the fixed "
+                f"init_loss_scaling={init_loss_scaling}",
+                stacklevel=3,
+            )
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        if self._loss_scale != 1.0:
+            import jax
+
+            loss = loss * self._loss_scale
+            if not self._wrapped:  # idempotent: never stack unscaling twice
+                scale = self._loss_scale
+                inner_apply = self._inner.apply_gradients
+
+                def unscaling_apply(params, grads, state, lr=None):
+                    grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
+                    return inner_apply(params, grads, state, lr=lr)
+
+                # instance-bound: the static Executor routes updates through
+                # apply_gradients inside the compiled step
+                self._inner.apply_gradients = unscaling_apply
+                self._wrapped = True
+        return self._inner.minimize(loss, startup_program=startup_program,
+                                    parameters=parameters, no_grad_set=no_grad_set)
+
+    def get_loss_scaling(self):
+        return self._loss_scale
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=1.0,
+             use_dynamic_loss_scaling=False, level="O1", dtype="bfloat16",
+             **kw):
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
+        level, dtype,
+    )

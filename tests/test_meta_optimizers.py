@@ -1,0 +1,162 @@
+"""DGC + LocalSGD meta-optimizer strategies.
+
+Parity model: reference test_dgc_optimizer.py / test_dgc_momentum_op.py and
+test_fleet_localsgd_meta_optimizer.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.meta_optimizers import (
+    AdaptiveLocalSGDOptimizer,
+    DGCMomentum,
+    LocalSGDOptimizer,
+)
+
+
+def _train(net, opt, data, steps):
+    losses = []
+    for i in range(steps):
+        x, y = data[i % len(data)]
+        loss = ((net(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+def _make_net(seed=0):
+    paddle.seed(seed)
+    return paddle.nn.Linear(6, 1, bias_attr=False)
+
+
+def _make_data(n=8):
+    rng = np.random.RandomState(0)
+    w = rng.randn(6, 1).astype("float32")
+    return [(x := rng.rand(16, 6).astype("float32"), x @ w) for _ in range(n)]
+
+
+def test_dgc_dense_phase_matches_momentum():
+    data = _make_data()
+    n1, n2 = _make_net(1), _make_net(1)
+    m = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                  parameters=n1.parameters())
+    d = DGCMomentum(learning_rate=0.05, momentum=0.9, parameters=n2.parameters(),
+                    rampup_begin_step=100)  # stays dense for all 10 steps
+    _train(n1, m, data, 10)
+    _train(n2, d, data, 10)
+    np.testing.assert_allclose(n1.weight.numpy(), n2.weight.numpy(), rtol=1e-5)
+
+
+def test_dgc_sparse_phase_masks_updates():
+    # one step in sparse phase: only ~top-(1-s) of coordinates may change
+    net = _make_net(2)
+    opt = DGCMomentum(learning_rate=0.1, momentum=0.0, parameters=net.parameters(),
+                      rampup_begin_step=0, rampup_step=1, sparsity=[0.5])
+    x = np.random.rand(4, 6).astype("float32")
+    y = np.random.rand(4, 1).astype("float32")
+    w0 = net.weight.numpy().copy()
+    loss = ((net(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+    loss.backward()
+    opt.step()
+    changed = (net.weight.numpy() != w0).sum()
+    assert changed <= 3 + 1, f"sparse step changed {changed}/6 coords"
+
+
+def test_dgc_still_converges():
+    data = _make_data()
+    net = _make_net(3)
+    opt = DGCMomentum(learning_rate=0.05, momentum=0.9, parameters=net.parameters(),
+                      rampup_begin_step=5, rampup_step=10, sparsity=[0.5, 0.75])
+    losses = _train(net, opt, data, 120)
+    assert losses[-1] < losses[0] * 0.1, f"{losses[0]} -> {losses[-1]}"
+
+
+def test_localsgd_sync_schedule(monkeypatch):
+    data = _make_data()
+    net = _make_net(4)
+    inner = paddle.optimizer.SGD(learning_rate=0.05, parameters=net.parameters())
+    opt = LocalSGDOptimizer(inner, k_steps=4, begin_step=2)
+    calls = []
+    monkeypatch.setattr(opt, "_sync_params", lambda: calls.append(opt._step_count))
+    _train(net, opt, data, 12)
+    assert calls == [4, 8, 12]
+
+
+def test_localsgd_world1_trains():
+    data = _make_data()
+    net = _make_net(5)
+    opt = LocalSGDOptimizer(
+        paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters()),
+        k_steps=2,
+    )
+    losses = _train(net, opt, data, 60)
+    assert losses[-1] < losses[0] * 0.1
+    # delegation surface
+    assert opt.get_lr() == pytest.approx(0.1)
+
+
+def test_adaptive_localsgd_k_grows_as_loss_drops():
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=_make_net(6).parameters())
+    opt = AdaptiveLocalSGDOptimizer(inner, init_k_steps=2, max_k_steps=8)
+    opt.record_loss(4.0)
+    assert opt._current_k() == 2
+    opt.record_loss(0.04)   # loss / 100 -> k x10, clipped to max
+    assert opt._current_k() == 8
+
+
+def test_fleet_strategy_selects_dgc_and_localsgd():
+    import paddle_tpu.distributed.fleet as fleet_mod
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+
+    net = _make_net(7)
+    strategy = DistributedStrategy()
+    strategy.dgc = True
+    strategy.dgc_configs = {"rampup_begin_step": 3, "sparsity": [0.9]}
+    base = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                     parameters=net.parameters())
+    fleet_mod.fleet.init(is_collective=True, strategy=strategy)
+    wrapped = fleet_mod.distributed_optimizer(base, strategy)
+    assert isinstance(wrapped._inner_opt, DGCMomentum)
+    assert wrapped._inner_opt._rampup_begin == 3
+
+    strategy2 = DistributedStrategy()
+    strategy2.localsgd = True
+    strategy2.localsgd_configs = {"k_steps": 3}
+    wrapped2 = fleet_mod.distributed_optimizer(
+        paddle.optimizer.SGD(learning_rate=0.01, parameters=net.parameters()),
+        strategy2,
+    )
+    assert isinstance(wrapped2._inner_opt, LocalSGDOptimizer)
+    assert wrapped2._inner_opt.k_steps == 3
+
+
+def test_dgc_rewrap_preserves_weight_decay_and_nesterov():
+    import paddle_tpu.distributed.fleet as fleet_mod
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+
+    net = _make_net(8)
+    strategy = DistributedStrategy()
+    strategy.dgc = True
+    base = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                     weight_decay=1e-4, use_nesterov=True,
+                                     parameters=net.parameters())
+    fleet_mod.fleet.init(is_collective=True, strategy=strategy)
+    wrapped = fleet_mod.distributed_optimizer(base, strategy)
+    inner = wrapped._inner_opt
+    assert isinstance(inner, DGCMomentum)
+    assert inner._weight_decay_coeff == pytest.approx(1e-4)
+    assert inner._use_nesterov is True
+
+
+def test_adaptive_localsgd_records_via_minimize():
+    net = _make_net(9)
+    inner = paddle.optimizer.SGD(learning_rate=0.05, parameters=net.parameters())
+    opt = AdaptiveLocalSGDOptimizer(inner, init_k_steps=2)
+    x = paddle.to_tensor(np.random.rand(4, 6).astype("float32"))
+    y = paddle.to_tensor(np.random.rand(4, 1).astype("float32"))
+    loss = ((net(x) - y) ** 2).mean()
+    opt.minimize(loss)
+    assert opt._loss0 is not None and opt._last_loss is not None

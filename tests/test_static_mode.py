@@ -354,3 +354,32 @@ def test_static_nn_fc_batch_gt_one():
     (o,) = exe.run(main, feed={"x": np.ones((8, 3, 4), "float32")},
                    fetch_list=[out])
     assert o.shape == (8, 5)
+
+
+def test_static_amp_autocast_records_bf16_and_trains():
+    import jax.numpy as jnp
+
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        t = static.data("t", [None, 1], "float32")
+        lin = paddle.nn.Linear(8, 1)
+        with static.amp.amp_guard(level="O2", dtype="bfloat16"):
+            pred = lin(x)
+        # matmul recorded under O2 produces bf16 activations
+        assert pred.value.dtype == jnp.bfloat16
+        loss = ((pred.astype("float32") - t) ** 2).mean()
+        opt = static.amp.decorate(
+            paddle.optimizer.SGD(learning_rate=0.05), init_loss_scaling=8.0)
+        opt.minimize(loss)
+    exe = static.Executor()
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(100):
+        xb = rng.rand(16, 8).astype("float32")
+        tb = (xb.sum(1, keepdims=True) * 0.5).astype("float32")
+        (l,) = exe.run(main, feed={"x": xb, "t": tb}, fetch_list=[loss])
+        losses.append(float(l))
+    # loss fetch is scaled by 8; training must still converge
+    assert losses[-1] < losses[0] * 0.2, f"{losses[0]} -> {losses[-1]}"
