@@ -105,6 +105,8 @@ _LAZY_SUBMODULES = (
     "sysconfig",
     "onnx",
     "inference",
+    "fft",
+    "signal",
 )
 
 

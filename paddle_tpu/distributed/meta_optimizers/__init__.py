@@ -154,6 +154,27 @@ class LocalSGDOptimizer:
         self.step()
         return None, []
 
+    # -- functional (jitted GSPMD) surface -------------------------------
+    # Under the pjit trainer, params are replicated and XLA averages grads
+    # every step — exact synchronous SGD, i.e. LocalSGD with k=1. The
+    # divergent-replica optimization (skipping per-step reduce) is only
+    # expressible in the eager ``.step()`` loop, so the functional path
+    # delegates to the inner optimizer and says so once instead of silently
+    # pretending k_steps applies.
+    def init_state(self, params_tree):
+        if self.k_steps > 1:
+            import warnings
+
+            warnings.warn(
+                "LocalSGD k_steps>1 only affects the eager .step() loop; the "
+                "jitted GSPMD trainer averages gradients every step (exact "
+                "sync-SGD, k=1). Proceeding with the inner optimizer.",
+                stacklevel=2)
+        return self._inner.init_state(params_tree)
+
+    def apply_gradients(self, params_tree, grads_tree, state, lr=None):
+        return self._inner.apply_gradients(params_tree, grads_tree, state, lr=lr)
+
     def __getattr__(self, name):
         return getattr(self._inner, name)
 

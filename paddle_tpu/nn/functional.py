@@ -1025,3 +1025,107 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1):  # noqa: A002
         return out
 
     return _de(input)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Connectionist temporal classification loss (parity: the warpctc op,
+    reference operators/warpctc_op.* and python/paddle/nn/functional/loss.py
+    ctc_loss). Like warpctc, inputs are unnormalized logits — log_softmax is
+    applied internally (idempotent if the input is already log-probs).
+
+    log_probs: (T, B, C); labels: (B, L) padded."""
+    from .layers.loss import CTCLoss
+
+    return CTCLoss(blank=blank, reduction=reduction)(
+        log_probs, labels, input_lengths, label_lengths, norm_by_times)
+
+
+def gather_tree(ids, parents):
+    """Backtrack beam-search trees: reconstruct full beams from per-step ids
+    and parent indices (parity: gather_tree op,
+    reference operators/gather_tree_op.cc; python/paddle/nn/functional —
+    used by fluid.layers.BeamSearchDecoder).
+
+    ids, parents: (max_time, batch, beam) int. Returns same shape."""
+
+    @primitive(nondiff=True)
+    def _gt(ids, parents):
+        T = ids.shape[0]
+        beam = ids.shape[2]
+        beam_idx = jnp.arange(beam, dtype=parents.dtype)
+
+        def step(parent, tp):
+            step_ids, step_parents = tp
+            out = jnp.take_along_axis(step_ids, parent, axis=-1)
+            new_parent = jnp.take_along_axis(step_parents, parent, axis=-1)
+            return new_parent, out
+
+        init = jnp.broadcast_to(beam_idx, ids.shape[1:])
+        # walk from the last step backwards
+        _, outs = jax.lax.scan(step, init, (ids, parents), reverse=True)
+        return outs
+
+    return _gt(ids, parents)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,  # noqa: A002
+                  input_length=None, label_length=None):
+    """Levenshtein distance per batch row (parity: edit_distance op,
+    reference operators/edit_distance_op.* and fluid/layers/nn.py). Padded
+    dense layout: input (B, L1), label (B, L2) int64 with optional lengths.
+
+    Returns (distance (B, 1) float32, sequence_num (1,) int64)."""
+
+    @primitive(nondiff=True)
+    def _ed(hyp, ref, hyp_len, ref_len):
+        B, L1 = hyp.shape
+        L2 = ref.shape[1]
+        row0 = jnp.broadcast_to(
+            jnp.arange(L2 + 1, dtype=jnp.float32), (B, L2 + 1))
+
+        def outer(row_prev, i):
+            # compute row i of the DP table for all batches
+            def inner(left, j):
+                # left = d[i][j-1]; row_prev[j-1] = d[i-1][j-1]; row_prev[j] = d[i-1][j]
+                sub = row_prev[:, j - 1] + (hyp[:, i - 1] != ref[:, j - 1])
+                val = jnp.minimum(jnp.minimum(row_prev[:, j] + 1.0, left + 1.0), sub)
+                return val, val
+
+            first = jnp.full((B,), i, jnp.float32)
+            _, rest = jax.lax.scan(inner, first, jnp.arange(1, L2 + 1))
+            row = jnp.concatenate([first[:, None], rest.T], axis=1)
+            return row, row
+
+        _, rows = jax.lax.scan(outer, row0, jnp.arange(1, L1 + 1))
+        table = jnp.concatenate([row0[None], rows], axis=0)  # (L1+1, B, L2+1)
+        d = table[hyp_len, jnp.arange(B), ref_len]
+        # all-empty hypothesis/reference corner: d(0, n) = n handled by table
+        return d
+
+    hyp = unwrap(input)
+    ref = unwrap(label)
+    B, L1 = hyp.shape
+    L2 = ref.shape[1]
+    hyp_len = unwrap(input_length).astype(jnp.int32) if input_length is not None \
+        else jnp.full((B,), L1, jnp.int32)
+    ref_len = unwrap(label_length).astype(jnp.int32) if label_length is not None \
+        else jnp.full((B,), L2, jnp.int32)
+
+    if ignored_tokens:
+        ign = jnp.asarray(list(ignored_tokens))
+
+        def _compress(seq, ln):
+            keep = ~jnp.isin(seq, ign) & (jnp.arange(seq.shape[1])[None] < ln[:, None])
+            # stable partition: kept tokens first, padding after
+            order = jnp.argsort(~keep, axis=1, stable=True)
+            return jnp.take_along_axis(seq, order, axis=1), keep.sum(1).astype(jnp.int32)
+
+        hyp, hyp_len = _compress(hyp, hyp_len)
+        ref, ref_len = _compress(ref, ref_len)
+
+    d = _ed(hyp, ref, hyp_len, ref_len)
+    dist = d._data if isinstance(d, Tensor) else d
+    if normalized:
+        dist = dist / jnp.maximum(ref_len.astype(jnp.float32), 1.0)
+    return wrap(dist[:, None]), wrap(jnp.asarray(np.array([B], dtype=np.int64)))

@@ -128,7 +128,10 @@ class CTCLoss(Layer):
 
         @primitive
         def _ctc(log_probs, labels, input_lengths, label_lengths):
-            # log_probs: [T, B, C] (paddle warpctc layout), labels: [B, L]
+            # log_probs: [T, B, C] (paddle warpctc layout), labels: [B, L].
+            # warpctc normalizes internally (softmax over C); log_softmax is
+            # idempotent so pre-normalized inputs are unaffected
+            log_probs = jax.nn.log_softmax(log_probs, axis=-1)
             T, B, C = log_probs.shape
             L = labels.shape[1]
             S = 2 * L + 1

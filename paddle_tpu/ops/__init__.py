@@ -34,6 +34,8 @@ from .manipulation import (  # noqa: F401
     flip,
     gather,
     gather_nd,
+    index_add,
+    index_put,
     index_sample,
     index_select,
     masked_select,

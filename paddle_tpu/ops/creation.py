@@ -35,6 +35,7 @@ __all__ = [
     "assign",
     "complex",
     "create_parameter",
+    "vander",
 ]
 
 
@@ -166,3 +167,13 @@ def create_parameter(shape, dtype="float32", default_initializer=None):
     t = Tensor(data, stop_gradient=False)
     t.persistable = True
     return t
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """Vandermonde matrix (parity: paddle.vander)."""
+
+    @primitive
+    def _vander(x, n, increasing):
+        return jnp.vander(x, N=n, increasing=increasing)
+
+    return _vander(x, n, increasing)

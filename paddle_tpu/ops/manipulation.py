@@ -521,3 +521,31 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: 
     lo = shard_id * shard_size
     in_shard = (arr >= lo) & (arr < lo + shard_size)
     return wrap(jnp.where(in_shard, arr - lo, ignore_value))
+
+
+def index_add(x, index, axis, value, name=None):
+    """Add `value` rows into x at `index` along `axis` (parity: index_add op;
+    duplicate indices accumulate)."""
+
+    @primitive
+    def _ia(x, index, value):
+        moved = jnp.moveaxis(x, axis, 0)
+        vmoved = jnp.moveaxis(value, axis, 0)
+        out = moved.at[index].add(vmoved)
+        return jnp.moveaxis(out, 0, axis)
+
+    return _ia(x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    """Put values at coordinates given by a tuple of index tensors
+    (parity: index_put op)."""
+
+    @primitive
+    def _ip(x, value, *indices):
+        if accumulate:
+            return x.at[tuple(indices)].add(value)
+        return x.at[tuple(indices)].set(value)
+
+    idx = tuple(indices) if isinstance(indices, (tuple, list)) else (indices,)
+    return _ip(x, value, *idx)

@@ -403,3 +403,68 @@ def isinf(x):
 
 def isnan(x):
     return wrap(jnp.isnan(unwrap(x)))
+
+
+@primitive
+def _logcumsumexp(x, axis):
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """Running log(sum(exp(x))) along an axis (parity: logcumsumexp op).
+    axis=None flattens first, like the reference."""
+    if dtype is not None:
+        from .manipulation import cast
+
+        x = cast(x, dtype)
+    if axis is None:
+        from .manipulation import flatten
+
+        return _logcumsumexp(flatten(x), 0)
+    return _logcumsumexp(x, axis)
+
+
+@primitive
+def _renorm(x, p, axis, max_norm):
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12), 1.0)
+    return x * scale
+
+
+def renorm(x, p, axis, max_norm):
+    """Clamp the p-norm of every sub-tensor along `axis` to max_norm
+    (parity: renorm op, reference operators/renorm_op.*)."""
+    return _renorm(x, float(p), axis % len(x.shape), float(max_norm))
+
+
+@primitive
+def _polygamma(x, n):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def polygamma(x, n, name=None):
+    """n-th derivative of digamma (parity: polygamma op)."""
+    return _polygamma(x, int(n))
+
+
+@primitive
+def _sgn(x):
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0.0 + 0.0j, x / jnp.maximum(mag, 1e-38))
+    return jnp.sign(x)
+
+
+def sgn(x, name=None):
+    """sign for real, x/|x| for complex (parity: paddle.sgn)."""
+    return _sgn(x)
+
+
+@primitive
+def _nanquantile(x, q, axis, keepdim):
+    return jnp.nanquantile(x, q, axis=axis, keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return _nanquantile(x, q, _axis(axis), keepdim)

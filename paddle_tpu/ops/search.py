@@ -22,6 +22,7 @@ __all__ = [
     "mode",
     "searchsorted",
     "masked_fill",
+    "bucketize",
 ]
 
 
@@ -123,3 +124,13 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False):
 @primitive
 def masked_fill(x, mask, value):
     return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    """Bucket index of each x in a 1-D sorted sequence (parity:
+    paddle.bucketize — searchsorted with a shared 1-D boundary tensor)."""
+    seq = unwrap(sorted_sequence)
+    if seq.ndim != 1:
+        raise ValueError("sorted_sequence should be a 1-D tensor for bucketize")
+    out = jnp.searchsorted(seq, unwrap(x), side="right" if right else "left")
+    return wrap(out.astype(jnp.int32 if out_int32 else jnp.int64))

@@ -1,0 +1,197 @@
+"""paddle_tpu.signal — short-time Fourier transform and framing ops.
+
+Parity: python/paddle/tensor/signal.py in the reference (frame:34,
+overlap_add:155, stft:238, istft — backed by the ``frame`` / ``overlap_add``
+operators, paddle/fluid/operators/frame_op.cc, overlap_add_op.cc, and
+spectral ops).
+
+TPU-native redesign: ``frame`` is a gather with a precomputed (frame_length,
+n_frames) index grid and ``overlap_add`` is its transpose — a scatter-add via
+``Array.at[].add`` — both static-shaped so XLA vectorizes them; the reference's
+dedicated CUDA kernels have no equivalent. stft/istft compose frame/overlap_add
+with the fft module and fold the window and NOLA normalization into the same
+XLA program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops._primitive import primitive
+from .tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_raw(x, frame_length, hop_length, axis):
+    if axis not in (0, -1):
+        raise ValueError(f"Unexpected axis: {axis}. It should be 0 or -1.")
+    seq_len = x.shape[-1] if axis == -1 else x.shape[0]
+    if frame_length > seq_len:
+        raise ValueError(
+            f"Attribute frame_length should be less equal than sequence length, "
+            f"but got ({frame_length}) > ({seq_len})."
+        )
+    n_frames = 1 + (seq_len - frame_length) // hop_length
+    idx = (
+        jnp.arange(frame_length)[:, None]
+        + jnp.arange(n_frames)[None, :] * hop_length
+    )  # (frame_length, n_frames)
+    if axis == -1:
+        return x[..., idx]
+    # axis == 0: (seq, ...) -> (n_frames, frame_length, ...)
+    return x[idx.T]
+
+
+@primitive
+def _frame_op(x, frame_length, hop_length, axis):
+    return _frame_raw(x, frame_length, hop_length, axis)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice a signal into (possibly overlapping) frames.
+
+    axis=-1: (..., seq_len) -> (..., frame_length, num_frames)
+    axis=0:  (seq_len, ...) -> (num_frames, frame_length, ...)
+    """
+    if hop_length < 1:
+        raise ValueError(f"Unexpected hop_length: {hop_length}. It should be an positive integer.")
+    return _frame_op(x, frame_length, hop_length, axis)
+
+
+def _overlap_add_raw(x, hop_length, axis):
+    if axis not in (0, -1):
+        raise ValueError(f"Unexpected axis: {axis}. It should be 0 or -1.")
+    if axis == -1:
+        frame_length, n_frames = x.shape[-2], x.shape[-1]
+        seq_len = (n_frames - 1) * hop_length + frame_length
+        idx = (
+            jnp.arange(frame_length)[:, None]
+            + jnp.arange(n_frames)[None, :] * hop_length
+        )
+        out = jnp.zeros(x.shape[:-2] + (seq_len,), dtype=x.dtype)
+        return out.at[..., idx].add(x)
+    # axis == 0: (n_frames, frame_length, ...) -> (seq_len, ...)
+    moved = jnp.moveaxis(x, (0, 1), (-1, -2))
+    out = _overlap_add_raw(moved, hop_length, -1)
+    return jnp.moveaxis(out, -1, 0)
+
+
+@primitive
+def _overlap_add_op(x, hop_length, axis):
+    return _overlap_add_raw(x, hop_length, axis)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Reconstruct a signal from framed slices by summing overlaps."""
+    if hop_length < 1:
+        raise ValueError(f"Unexpected hop_length: {hop_length}. It should be an positive integer.")
+    return _overlap_add_op(x, hop_length, axis)
+
+
+def _pad_center(w, size):
+    lpad = (size - w.shape[-1]) // 2
+    return jnp.pad(w, [(lpad, size - w.shape[-1] - lpad)])
+
+
+@primitive
+def _stft_op(x, window, n_fft, hop_length, center, pad_mode, normalized, onesided):
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)], mode=pad_mode)
+    frames = _frame_raw(x, n_fft, hop_length, -1)  # (..., n_fft, num_frames)
+    frames = frames * window[:, None]
+    norm = "ortho" if normalized else "backward"
+    if onesided:
+        return jnp.fft.rfft(frames, axis=-2, norm=norm)
+    return jnp.fft.fft(frames, axis=-2, norm=norm)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference tensor/signal.py:238).
+
+    x: (T,) or (N, T) real (complex allowed with onesided=False).
+    Returns (..., n_fft//2+1 if onesided else n_fft, num_frames).
+    """
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if xd.ndim not in (1, 2):
+        raise ValueError(f"x should be a 1D or 2D real tensor, but got rank {xd.ndim}")
+    if hop_length is None:
+        hop_length = n_fft // 4
+    if win_length is None:
+        win_length = n_fft
+    if not center and n_fft > xd.shape[-1]:
+        raise ValueError("n_fft should be in [0, seq_length] when center is False")
+    if window is not None:
+        wd = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+        if wd.shape[-1] != win_length:
+            raise ValueError(f"window length must equal win_length {win_length}")
+    else:
+        wd = jnp.ones(win_length, dtype=xd.real.dtype if jnp.iscomplexobj(xd) else xd.dtype)
+    wd = _pad_center(wd, n_fft)
+    if jnp.iscomplexobj(xd) and onesided:
+        raise ValueError("onesided should be False when input or window is a complex Tensor")
+    return _stft_op(Tensor(xd) if not isinstance(x, Tensor) else x, wd, n_fft,
+                    hop_length, center, pad_mode, normalized, onesided)
+
+
+@primitive
+def _istft_op(x, window, n_fft, hop_length, win_length, center, normalized,
+              onesided, length, return_complex):
+    norm = "ortho" if normalized else "backward"
+    if onesided:
+        frames = jnp.fft.irfft(x, n=n_fft, axis=-2, norm=norm)
+    else:
+        frames = jnp.fft.ifft(x, axis=-2, norm=norm)
+        if not return_complex:
+            frames = frames.real
+    # apply synthesis window and overlap-add (..., n_fft, num_frames) -> (..., T)
+    frames = frames * window[:, None]
+    y = _overlap_add_raw(frames, hop_length, -1)
+    # NOLA normalization: overlap-added squared window envelope
+    n_frames = x.shape[-1]
+    env = _overlap_add_raw(
+        jnp.tile((window * window)[:, None], (1, n_frames)), hop_length, -1
+    )
+    y = y / jnp.where(env > 1e-11, env, 1.0)
+    if center:
+        pad = n_fft // 2
+        y = y[..., pad: y.shape[-1] - pad]
+    if length is not None:
+        if y.shape[-1] >= length:
+            y = y[..., :length]
+        else:
+            y = jnp.pad(y, [(0, 0)] * (y.ndim - 1) + [(0, length - y.shape[-1])])
+    return y
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse short-time Fourier transform."""
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if xd.ndim not in (2, 3):
+        raise ValueError(f"x should be a 2D or 3D complex tensor, but got rank {xd.ndim}")
+    if onesided and return_complex:
+        raise ValueError(
+            "onesided output from a real signal cannot be complex: pass "
+            "onesided=False with return_complex=True")
+    if hop_length is None:
+        hop_length = n_fft // 4
+    if win_length is None:
+        win_length = n_fft
+    n_bins = xd.shape[-2]
+    expected = n_fft // 2 + 1 if onesided else n_fft
+    if n_bins != expected:
+        raise ValueError(f"Input x has {n_bins} frequency bins, expected {expected}")
+    if window is not None:
+        wd = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+        if wd.shape[-1] != win_length:
+            raise ValueError(f"window length must equal win_length {win_length}")
+    else:
+        wd = jnp.ones(win_length, dtype=jnp.float32)
+    wd = _pad_center(wd, n_fft)
+    return _istft_op(x if isinstance(x, Tensor) else Tensor(xd), wd, n_fft,
+                     hop_length, win_length, center, normalized, onesided,
+                     length, return_complex)

@@ -1,0 +1,189 @@
+"""fft + signal module parity vs numpy (reference test strategy: OpTest-style
+numpy-golden comparisons, python/paddle/fluid/tests/unittests/test_fft.py and
+test_signal.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+RTOL = 2e-4
+ATOL = 2e-4
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+class TestFFT:
+    x_real = np.random.default_rng(0).standard_normal((3, 8, 10)).astype("float32")
+    x_cplx = (x_real + 1j * np.roll(x_real, 1, -1)).astype("complex64")
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    @pytest.mark.parametrize("n,axis", [(None, -1), (6, -1), (12, 1)])
+    def test_fft_ifft(self, norm, n, axis):
+        got = paddle.fft.fft(paddle.to_tensor(self.x_cplx), n=n, axis=axis, norm=norm)
+        np.testing.assert_allclose(
+            _np(got), np.fft.fft(self.x_cplx, n=n, axis=axis, norm=norm),
+            rtol=RTOL, atol=ATOL)
+        got = paddle.fft.ifft(paddle.to_tensor(self.x_cplx), n=n, axis=axis, norm=norm)
+        np.testing.assert_allclose(
+            _np(got), np.fft.ifft(self.x_cplx, n=n, axis=axis, norm=norm),
+            rtol=RTOL, atol=ATOL)
+
+    def test_fft_real_input_promotes(self):
+        got = paddle.fft.fft(paddle.to_tensor(self.x_real))
+        assert _np(got).dtype == np.complex64
+        np.testing.assert_allclose(_np(got), np.fft.fft(self.x_real), rtol=RTOL, atol=ATOL)
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_rfft_irfft(self, norm):
+        got = paddle.fft.rfft(paddle.to_tensor(self.x_real), norm=norm)
+        want = np.fft.rfft(self.x_real, norm=norm)
+        np.testing.assert_allclose(_np(got), want, rtol=RTOL, atol=ATOL)
+        back = paddle.fft.irfft(got, n=10, norm=norm)
+        np.testing.assert_allclose(_np(back), self.x_real, rtol=RTOL, atol=ATOL)
+
+    def test_fft2_roundtrip(self):
+        got = paddle.fft.fft2(paddle.to_tensor(self.x_cplx))
+        np.testing.assert_allclose(_np(got), np.fft.fft2(self.x_cplx), rtol=RTOL, atol=1e-3)
+        back = paddle.fft.ifft2(got)
+        np.testing.assert_allclose(_np(back), self.x_cplx, rtol=RTOL, atol=ATOL)
+
+    def test_fftn_axes(self):
+        got = paddle.fft.fftn(paddle.to_tensor(self.x_cplx), axes=(0, 2))
+        np.testing.assert_allclose(
+            _np(got), np.fft.fftn(self.x_cplx, axes=(0, 2)), rtol=RTOL, atol=1e-3)
+
+    def test_rfftn_irfftn(self):
+        got = paddle.fft.rfftn(paddle.to_tensor(self.x_real))
+        np.testing.assert_allclose(_np(got), np.fft.rfftn(self.x_real), rtol=RTOL, atol=1e-3)
+        back = paddle.fft.irfftn(got, s=self.x_real.shape)
+        np.testing.assert_allclose(_np(back), self.x_real, rtol=RTOL, atol=ATOL)
+
+    def test_hfft_ihfft(self):
+        spec = np.fft.rfft(self.x_real).astype("complex64")
+        got = paddle.fft.hfft(paddle.to_tensor(spec), n=10)
+        np.testing.assert_allclose(_np(got), np.fft.hfft(spec, n=10), rtol=RTOL, atol=1e-3)
+        got = paddle.fft.ihfft(paddle.to_tensor(self.x_real))
+        np.testing.assert_allclose(_np(got), np.fft.ihfft(self.x_real), rtol=RTOL, atol=ATOL)
+
+    def test_hfft2_matches_composed_numpy(self):
+        # hfftn == forward c2c over leading axes then hfft over last axis
+        spec = (np.fft.rfft2(self.x_real)).astype("complex64")
+        got = paddle.fft.hfft2(paddle.to_tensor(spec), s=(8, 10))
+        want = np.fft.hfft(np.fft.fft(spec, axis=-2), n=10, axis=-1)
+        np.testing.assert_allclose(_np(got), want, rtol=2e-3, atol=2e-2)
+
+    def test_ihfft2_roundtrip_against_hfft2(self):
+        x = self.x_real
+        spec = paddle.fft.ihfft2(paddle.to_tensor(x))
+        back = paddle.fft.hfft2(spec, s=(8, 10))
+        np.testing.assert_allclose(_np(back), x, rtol=2e-3, atol=2e-2)
+
+    def test_fftfreq_shift(self):
+        np.testing.assert_allclose(
+            _np(paddle.fft.fftfreq(9, d=0.5)), np.fft.fftfreq(9, d=0.5).astype("float32"),
+            rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(
+            _np(paddle.fft.rfftfreq(9, d=0.5)), np.fft.rfftfreq(9, d=0.5).astype("float32"),
+            rtol=RTOL, atol=ATOL)
+        x = paddle.to_tensor(self.x_real)
+        np.testing.assert_allclose(
+            _np(paddle.fft.fftshift(x)), np.fft.fftshift(self.x_real))
+        np.testing.assert_allclose(
+            _np(paddle.fft.ifftshift(x, axes=(1,))), np.fft.ifftshift(self.x_real, axes=(1,)))
+
+    def test_fft_grad(self):
+        # d/dx sum(|fft(x)|^2) = 2*N*x by Parseval; checks the vjp tape path
+        x = paddle.to_tensor(self.x_real[0, 0])
+        x.stop_gradient = False
+        y = paddle.fft.fft(x)
+        loss = (y.abs() ** 2).sum()
+        loss.backward()
+        np.testing.assert_allclose(
+            _np(x.grad), 2 * 10 * self.x_real[0, 0], rtol=1e-3, atol=1e-3)
+
+
+class TestSignal:
+    rng = np.random.default_rng(1)
+
+    def _np_frame(self, x, frame_length, hop_length):
+        n = 1 + (x.shape[-1] - frame_length) // hop_length
+        out = np.stack(
+            [x[..., i * hop_length: i * hop_length + frame_length] for i in range(n)],
+            axis=-1)
+        return out
+
+    def test_frame_last_axis(self):
+        x = self.rng.standard_normal((2, 3, 20)).astype("float32")
+        got = paddle.signal.frame(paddle.to_tensor(x), frame_length=6, hop_length=3)
+        np.testing.assert_allclose(_np(got), self._np_frame(x, 6, 3))
+
+    def test_frame_axis0(self):
+        x = self.rng.standard_normal((20, 2)).astype("float32")
+        got = paddle.signal.frame(paddle.to_tensor(x), 6, 3, axis=0)
+        assert _np(got).shape == (5, 6, 2)
+        want = np.stack([x[i * 3: i * 3 + 6] for i in range(5)], axis=0)
+        np.testing.assert_allclose(_np(got), want)
+
+    def test_overlap_add_inverts_frame_when_nonoverlapping(self):
+        x = self.rng.standard_normal((2, 18)).astype("float32")
+        frames = paddle.signal.frame(paddle.to_tensor(x), 6, 6)
+        back = paddle.signal.overlap_add(frames, hop_length=6)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-6, atol=1e-6)
+
+    def test_overlap_add_sums_overlaps(self):
+        x = np.ones((4, 3), dtype="float32")  # frame_length 4, 3 frames
+        got = paddle.signal.overlap_add(paddle.to_tensor(x), hop_length=2)
+        want = np.zeros(8, dtype="float32")
+        for i in range(3):
+            want[i * 2: i * 2 + 4] += 1
+        np.testing.assert_allclose(_np(got), want)
+
+    def test_overlap_add_axis0(self):
+        x = self.rng.standard_normal((3, 4, 2)).astype("float32")  # (n_frames, frame_len, batch)
+        got = paddle.signal.overlap_add(paddle.to_tensor(x), hop_length=2, axis=0)
+        assert _np(got).shape == (8, 2)
+        want = np.zeros((8, 2), dtype="float32")
+        for i in range(3):
+            want[i * 2: i * 2 + 4] += x[i]
+        np.testing.assert_allclose(_np(got), want, rtol=1e-6, atol=1e-6)
+
+    def test_stft_matches_manual(self):
+        x = self.rng.standard_normal((2, 64)).astype("float32")
+        n_fft, hop = 16, 4
+        win = np.hanning(n_fft).astype("float32")
+        got = paddle.signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop,
+                                 window=paddle.to_tensor(win), center=False)
+        # manual: frame then rfft
+        frames = self._np_frame(x, n_fft, hop) * win[:, None]
+        want = np.fft.rfft(frames, axis=-2)
+        np.testing.assert_allclose(_np(got), want, rtol=1e-4, atol=1e-4)
+        assert _np(got).shape == (2, n_fft // 2 + 1, 1 + (64 - n_fft) // hop)
+
+    def test_stft_istft_roundtrip(self):
+        x = self.rng.standard_normal((3, 128)).astype("float32")
+        n_fft, hop = 32, 8
+        win = (np.hanning(n_fft) + 0.1).astype("float32")  # NOLA-safe
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop,
+                                  window=paddle.to_tensor(win))
+        back = paddle.signal.istft(spec, n_fft, hop_length=hop,
+                                   window=paddle.to_tensor(win), length=128)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-3, atol=1e-3)
+
+    def test_stft_normalized_twosided(self):
+        x = self.rng.standard_normal(64).astype("float32")
+        spec = paddle.signal.stft(paddle.to_tensor(x), 16, normalized=True,
+                                  onesided=False, center=True)
+        assert _np(spec).shape[0] == 16
+        back = paddle.signal.istft(spec, 16, normalized=True, onesided=False,
+                                   length=64)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-3, atol=1e-3)
+
+    def test_frame_grad_flows(self):
+        x = paddle.to_tensor(self.rng.standard_normal(16).astype("float32"))
+        x.stop_gradient = False
+        y = paddle.signal.frame(x, 4, 4)
+        y.sum().backward()
+        np.testing.assert_allclose(_np(x.grad), np.ones(16), rtol=1e-6, atol=1e-6)

@@ -1,0 +1,109 @@
+"""Small-op parity vs numpy (bucketize, logcumsumexp, renorm, index_add,
+index_put, vander, polygamma, sgn, nanquantile)."""
+import numpy as np
+from scipy import special
+
+import paddle_tpu as paddle
+
+
+rng = np.random.default_rng(5)
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def test_logcumsumexp():
+    x = rng.standard_normal((3, 4)).astype("float32")
+    got = paddle.logcumsumexp(paddle.to_tensor(x), axis=1)
+    want = np.logaddexp.accumulate(x, axis=1)
+    np.testing.assert_allclose(_np(got), want, rtol=1e-5, atol=1e-5)
+    # axis=None flattens
+    got = paddle.logcumsumexp(paddle.to_tensor(x))
+    np.testing.assert_allclose(_np(got), np.logaddexp.accumulate(x.ravel()),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bucketize():
+    edges = np.array([1.0, 3.0, 5.0], "float32")
+    x = np.array([[0.5, 1.0], [3.3, 7.0]], "float32")
+    got = paddle.bucketize(paddle.to_tensor(x), paddle.to_tensor(edges))
+    np.testing.assert_array_equal(_np(got), np.searchsorted(edges, x))
+    got_r = paddle.bucketize(paddle.to_tensor(x), paddle.to_tensor(edges), right=True)
+    np.testing.assert_array_equal(_np(got_r), np.searchsorted(edges, x, side="right"))
+
+
+def test_renorm():
+    x = rng.standard_normal((3, 4, 2)).astype("float32")
+    got = _np(paddle.renorm(paddle.to_tensor(x), p=2.0, axis=1, max_norm=1.0))
+    for j in range(4):
+        sub = x[:, j, :]
+        n = np.sqrt((sub ** 2).sum())
+        want = sub * min(1.0, 1.0 / n)
+        np.testing.assert_allclose(got[:, j, :], want, rtol=1e-5, atol=1e-5)
+
+
+def test_index_add_accumulates():
+    x = np.zeros((4, 3), "float32")
+    idx = np.array([1, 1, 3], "int32")
+    val = np.ones((3, 3), "float32")
+    got = paddle.index_add(paddle.to_tensor(x), paddle.to_tensor(idx), 0,
+                           paddle.to_tensor(val))
+    want = np.zeros((4, 3), "float32")
+    want[1] = 2
+    want[3] = 1
+    np.testing.assert_allclose(_np(got), want)
+
+
+def test_index_add_axis1_grad():
+    x = paddle.to_tensor(rng.standard_normal((2, 4)).astype("float32"))
+    x.stop_gradient = False
+    val = paddle.to_tensor(np.ones((2, 2), "float32"))
+    out = paddle.index_add(x, paddle.to_tensor(np.array([0, 2], "int32")), 1, val)
+    out.sum().backward()
+    np.testing.assert_allclose(_np(x.grad), np.ones((2, 4)))
+
+
+def test_index_put():
+    x = np.zeros((3, 3), "float32")
+    i = np.array([0, 2], "int32")
+    j = np.array([1, 2], "int32")
+    got = paddle.index_put(paddle.to_tensor(x),
+                           (paddle.to_tensor(i), paddle.to_tensor(j)),
+                           paddle.to_tensor(np.array([5.0, 7.0], "float32")))
+    want = x.copy()
+    want[0, 1] = 5
+    want[2, 2] = 7
+    np.testing.assert_allclose(_np(got), want)
+
+
+def test_vander():
+    x = np.array([1.0, 2.0, 3.0], "float32")
+    got = paddle.vander(paddle.to_tensor(x), 4)
+    np.testing.assert_allclose(_np(got), np.vander(x, 4))
+    got_inc = paddle.vander(paddle.to_tensor(x), 3, increasing=True)
+    np.testing.assert_allclose(_np(got_inc), np.vander(x, 3, increasing=True))
+
+
+def test_polygamma():
+    x = rng.uniform(0.5, 4.0, (5,)).astype("float32")
+    for n in (1, 2):
+        got = paddle.polygamma(paddle.to_tensor(x), n)
+        np.testing.assert_allclose(_np(got), special.polygamma(n, x),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_sgn():
+    z = np.array([3 + 4j, 0 + 0j, -1 - 1j], "complex64")
+    got = _np(paddle.sgn(paddle.to_tensor(z)))
+    want = np.where(np.abs(z) == 0, 0, z / np.where(np.abs(z) == 0, 1, np.abs(z)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    r = np.array([-2.0, 0.0, 5.0], "float32")
+    np.testing.assert_allclose(_np(paddle.sgn(paddle.to_tensor(r))), np.sign(r))
+
+
+def test_nanquantile():
+    x = np.array([[1.0, np.nan, 3.0], [4.0, 5.0, np.nan]], "float32")
+    got = paddle.nanquantile(paddle.to_tensor(x), 0.5, axis=1)
+    np.testing.assert_allclose(_np(got), np.nanquantile(x, 0.5, axis=1),
+                               rtol=1e-6)

@@ -1,0 +1,198 @@
+"""ctc_loss / gather_tree / edit_distance parity tests (reference:
+unittests/test_warpctc_op.py, test_gather_tree_op.py,
+test_edit_distance_op.py)."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+rng = np.random.default_rng(11)
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def brute_force_ctc(probs, labels, blank):
+    """-log P(labels | probs) by enumerating all alignments. probs: (T, C)."""
+    T, C = probs.shape
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        # collapse path: remove repeats then blanks
+        collapsed = [k for k, _ in itertools.groupby(path) if k != blank]
+        if collapsed == list(labels):
+            p = 1.0
+            for t, k in enumerate(path):
+                p *= probs[t, k]
+            total += p
+    return -np.log(total)
+
+
+class TestCTCLoss:
+    def test_vs_brute_force(self):
+        T, C = 4, 3
+        logits = rng.standard_normal((T, 1, C)).astype("float32")
+        probs = np.exp(logits[:, 0]) / np.exp(logits[:, 0]).sum(-1, keepdims=True)
+        labels = [1, 2]
+        want = brute_force_ctc(probs, labels, blank=0)
+        got = F.ctc_loss(
+            paddle.to_tensor(logits),
+            paddle.to_tensor(np.array([labels], np.int64)),
+            paddle.to_tensor(np.array([T], np.int64)),
+            paddle.to_tensor(np.array([2], np.int64)),
+            reduction="none")
+        np.testing.assert_allclose(_np(got)[0], want, rtol=1e-4, atol=1e-4)
+
+    def test_repeated_label(self):
+        T, C = 5, 3
+        logits = rng.standard_normal((T, 1, C)).astype("float32")
+        probs = np.exp(logits[:, 0]) / np.exp(logits[:, 0]).sum(-1, keepdims=True)
+        labels = [2, 2]  # repeat forces a blank between
+        want = brute_force_ctc(probs, labels, blank=0)
+        got = F.ctc_loss(paddle.to_tensor(logits),
+                         paddle.to_tensor(np.array([labels], np.int64)),
+                         paddle.to_tensor(np.array([T], np.int64)),
+                         paddle.to_tensor(np.array([2], np.int64)),
+                         reduction="none")
+        np.testing.assert_allclose(_np(got)[0], want, rtol=1e-4, atol=1e-4)
+
+    def test_batch_and_lengths(self):
+        T, B, C = 6, 3, 4
+        logits = rng.standard_normal((T, B, C)).astype("float32")
+        labels = np.array([[1, 2, 0], [3, 0, 0], [1, 1, 2]], np.int64)
+        in_len = np.array([6, 4, 6], np.int64)
+        lab_len = np.array([2, 1, 3], np.int64)
+        got = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                         paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                         reduction="none")
+        probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        for b in range(B):
+            want = brute_force_ctc(probs[:in_len[b], b],
+                                   list(labels[b, :lab_len[b]]), 0)
+            np.testing.assert_allclose(_np(got)[b], want, rtol=1e-4, atol=1e-4)
+
+    def test_nonzero_blank_and_reductions(self):
+        T, C = 4, 3
+        logits = rng.standard_normal((T, 1, C)).astype("float32")
+        probs = np.exp(logits[:, 0]) / np.exp(logits[:, 0]).sum(-1, keepdims=True)
+        want = brute_force_ctc(probs, [0, 1], blank=2)
+        got = F.ctc_loss(paddle.to_tensor(logits),
+                         paddle.to_tensor(np.array([[0, 1]], np.int64)),
+                         paddle.to_tensor(np.array([T], np.int64)),
+                         paddle.to_tensor(np.array([2], np.int64)),
+                         blank=2, reduction="none")
+        np.testing.assert_allclose(_np(got)[0], want, rtol=1e-4, atol=1e-4)
+        got_mean = F.ctc_loss(paddle.to_tensor(logits),
+                              paddle.to_tensor(np.array([[0, 1]], np.int64)),
+                              paddle.to_tensor(np.array([T], np.int64)),
+                              paddle.to_tensor(np.array([2], np.int64)),
+                              blank=2, reduction="mean")
+        np.testing.assert_allclose(_np(got_mean), want / 2, rtol=1e-4, atol=1e-4)
+
+    def test_grad(self):
+        logits = paddle.to_tensor(rng.standard_normal((5, 2, 4)).astype("float32"))
+        logits.stop_gradient = False
+        loss = F.ctc_loss(logits,
+                          paddle.to_tensor(np.array([[1, 2], [3, 1]], np.int64)),
+                          paddle.to_tensor(np.array([5, 5], np.int64)),
+                          paddle.to_tensor(np.array([2, 2], np.int64)))
+        loss.backward()
+        g = _np(logits.grad)
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    def test_layer(self):
+        from paddle_tpu.nn import CTCLoss
+
+        loss_fn = CTCLoss(blank=0, reduction="sum")
+        out = loss_fn(paddle.to_tensor(rng.standard_normal((4, 1, 3)).astype("float32")),
+                      paddle.to_tensor(np.array([[1]], np.int64)),
+                      paddle.to_tensor(np.array([4], np.int64)),
+                      paddle.to_tensor(np.array([1], np.int64)))
+        assert _np(out).shape == ()
+
+
+class TestGatherTree:
+    def test_vs_golden(self):
+        # reference test_gather_tree_op.py style: manual backtrack
+        T, B, K = 3, 2, 2
+        ids = rng.integers(0, 9, (T, B, K)).astype("int64")
+        parents = rng.integers(0, K, (T, B, K)).astype("int64")
+        got = F.gather_tree(paddle.to_tensor(ids), paddle.to_tensor(parents))
+        want = np.zeros_like(ids)
+        for b in range(B):
+            for k in range(K):
+                par = k
+                for t in range(T - 1, -1, -1):
+                    want[t, b, k] = ids[t, b, par]
+                    par = parents[t, b, par]
+        np.testing.assert_array_equal(_np(got), want)
+
+    def test_chain(self):
+        # simple known case: parents chain beams straight through
+        ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)
+        parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int64)
+        got = _np(F.gather_tree(paddle.to_tensor(ids), paddle.to_tensor(parents)))
+        # beam 0 at t=2: id 5, parent 0 -> t=1 id 3? parent at t=1 beam0 = 1
+        want = np.zeros_like(ids)
+        for k in range(2):
+            par = k
+            for t in range(2, -1, -1):
+                want[t, 0, k] = ids[t, 0, par]
+                par = parents[t, 0, par]
+        np.testing.assert_array_equal(got, want)
+
+
+def np_levenshtein(a, b):
+    d = np.zeros((len(a) + 1, len(b) + 1))
+    d[:, 0] = np.arange(len(a) + 1)
+    d[0, :] = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                          d[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return d[-1, -1]
+
+
+class TestEditDistance:
+    def test_vs_golden(self):
+        hyp = np.array([[1, 2, 3, 4], [5, 6, 7, 0]], np.int64)
+        ref = np.array([[1, 3, 4, 0, 0], [5, 6, 8, 9, 0]], np.int64)
+        hyp_len = np.array([4, 3], np.int64)
+        ref_len = np.array([3, 4], np.int64)
+        dist, num = F.edit_distance(
+            paddle.to_tensor(hyp), paddle.to_tensor(ref), normalized=False,
+            input_length=paddle.to_tensor(hyp_len),
+            label_length=paddle.to_tensor(ref_len))
+        for b in range(2):
+            want = np_levenshtein(hyp[b, :hyp_len[b]], ref[b, :ref_len[b]])
+            np.testing.assert_allclose(_np(dist)[b, 0], want)
+        assert _np(num)[0] == 2
+
+    def test_normalized(self):
+        hyp = np.array([[1, 2]], np.int64)
+        ref = np.array([[1, 3, 4]], np.int64)
+        dist, _ = F.edit_distance(paddle.to_tensor(hyp), paddle.to_tensor(ref),
+                                  normalized=True)
+        want = np_levenshtein([1, 2], [1, 3, 4]) / 3
+        np.testing.assert_allclose(_np(dist)[0, 0], want, rtol=1e-6)
+
+    def test_ignored_tokens(self):
+        hyp = np.array([[1, 0, 2, 0]], np.int64)
+        ref = np.array([[1, 2, 0, 0]], np.int64)
+        ln = paddle.to_tensor(np.array([4], np.int64))
+        dist, _ = F.edit_distance(paddle.to_tensor(hyp), paddle.to_tensor(ref),
+                                  normalized=False, ignored_tokens=[0],
+                                  input_length=ln, label_length=ln)
+        # after dropping 0s both are [1, 2]
+        np.testing.assert_allclose(_np(dist)[0, 0], 0.0)
+
+    def test_full_padded_no_lengths(self):
+        hyp = np.array([[1, 2, 3]], np.int64)
+        ref = np.array([[3, 2, 1]], np.int64)
+        dist, _ = F.edit_distance(paddle.to_tensor(hyp), paddle.to_tensor(ref),
+                                  normalized=False)
+        np.testing.assert_allclose(_np(dist)[0, 0],
+                                   np_levenshtein([1, 2, 3], [3, 2, 1]))

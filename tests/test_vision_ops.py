@@ -1,0 +1,436 @@
+"""Detection op parity vs independent numpy goldens (reference test strategy:
+unittests/test_roi_align_op.py, test_roi_pool_op.py, test_psroi_pool_op.py,
+test_yolo_box_op.py, test_yolov3_loss_op.py, test_deform_conv2d.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+rng = np.random.default_rng(7)
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def np_bilinear(fm, y, x):
+    C, H, W = fm.shape
+    if y < -1.0 or y > H or x < -1.0 or x > W:
+        return np.zeros(C, fm.dtype)
+    y = min(max(y, 0.0), H - 1.0)
+    x = min(max(x, 0.0), W - 1.0)
+    y0, x0 = int(np.floor(y)), int(np.floor(x))
+    y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+    ly, lx = y - y0, x - x0
+    return ((1 - ly) * (1 - lx) * fm[:, y0, x0] + (1 - ly) * lx * fm[:, y0, x1]
+            + ly * (1 - lx) * fm[:, y1, x0] + ly * lx * fm[:, y1, x1])
+
+
+def np_roi_align(x, boxes, batch_ids, out_hw, scale, sampling, aligned):
+    ph, pw = out_hw
+    s = sampling if sampling > 0 else 2
+    C = x.shape[1]
+    out = np.zeros((len(boxes), C, ph, pw), np.float32)
+    for bi, (bid, box) in enumerate(zip(batch_ids, boxes)):
+        off = 0.5 if aligned else 0.0
+        x1, y1, x2, y2 = box * scale - off
+        rw, rh = x2 - x1, y2 - y1
+        if not aligned:
+            rw, rh = max(rw, 1.0), max(rh, 1.0)
+        bh, bw = rh / ph, rw / pw
+        for i in range(ph):
+            for j in range(pw):
+                acc = np.zeros(C, np.float32)
+                for iy in range(s):
+                    for ix in range(s):
+                        yy = y1 + (i + (iy + 0.5) / s) * bh
+                        xx = x1 + (j + (ix + 0.5) / s) * bw
+                        acc += np_bilinear(x[bid], yy, xx)
+                out[bi, :, i, j] = acc / (s * s)
+    return out
+
+
+class TestRoIAlign:
+    def test_vs_golden(self):
+        x = rng.standard_normal((2, 3, 12, 16)).astype("float32")
+        boxes = np.array([[1, 1, 9, 7], [0, 2, 14, 11], [3.5, 2.5, 10.2, 9.9]],
+                         np.float32)
+        boxes_num = np.array([2, 1], np.int32)
+        got = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                          paddle.to_tensor(boxes_num), output_size=4,
+                          spatial_scale=0.5)
+        want = np_roi_align(x, boxes, [0, 0, 1], (4, 4), 0.5, -1, True)
+        np.testing.assert_allclose(_np(got), want, rtol=1e-4, atol=1e-4)
+
+    def test_not_aligned_with_ratio(self):
+        x = rng.standard_normal((1, 2, 10, 10)).astype("float32")
+        boxes = np.array([[0, 0, 8, 8]], np.float32)
+        got = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                          paddle.to_tensor(np.array([1], np.int32)),
+                          output_size=(2, 3), sampling_ratio=3, aligned=False)
+        want = np_roi_align(x, boxes, [0], (2, 3), 1.0, 3, False)
+        np.testing.assert_allclose(_np(got), want, rtol=1e-4, atol=1e-4)
+
+    def test_layer_and_grad(self):
+        x = paddle.to_tensor(rng.standard_normal((1, 2, 8, 8)).astype("float32"))
+        x.stop_gradient = False
+        layer = V.RoIAlign(output_size=2)
+        out = layer(x, paddle.to_tensor(np.array([[1, 1, 6, 6]], np.float32)),
+                    paddle.to_tensor(np.array([1], np.int32)))
+        assert tuple(out.shape) == (1, 2, 2, 2)
+        out.sum().backward()
+        assert np.isfinite(_np(x.grad)).all() and np.abs(_np(x.grad)).sum() > 0
+
+
+def np_roi_pool(x, boxes, batch_ids, out_hw, scale):
+    ph, pw = out_hw
+    C, H, W = x.shape[1:]
+    out = np.zeros((len(boxes), C, ph, pw), np.float32)
+    for bi, (bid, box) in enumerate(zip(batch_ids, boxes)):
+        x1, y1, x2, y2 = np.round(box * scale)
+        rh = max(y2 - y1 + 1, 1.0)
+        rw = max(x2 - x1 + 1, 1.0)
+        for i in range(ph):
+            hs = int(np.clip(np.floor(i * rh / ph + y1), 0, H))
+            he = int(np.clip(np.ceil((i + 1) * rh / ph + y1), 0, H))
+            for j in range(pw):
+                ws = int(np.clip(np.floor(j * rw / pw + x1), 0, W))
+                we = int(np.clip(np.ceil((j + 1) * rw / pw + x1), 0, W))
+                if he > hs and we > ws:
+                    out[bi, :, i, j] = x[bid][:, hs:he, ws:we].max(axis=(1, 2))
+    return out
+
+
+class TestRoIPool:
+    def test_vs_golden(self):
+        x = rng.standard_normal((2, 4, 14, 14)).astype("float32")
+        boxes = np.array([[0, 0, 13, 13], [2, 3, 10, 8], [5, 5, 6, 6]], np.float32)
+        boxes_num = np.array([1, 2], np.int32)
+        got = V.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(boxes_num), 3)
+        want = np_roi_pool(x, boxes, [0, 1, 1], (3, 3), 1.0)
+        np.testing.assert_allclose(_np(got), want, rtol=1e-5, atol=1e-5)
+
+    def test_scale(self):
+        x = rng.standard_normal((1, 1, 8, 8)).astype("float32")
+        boxes = np.array([[2, 2, 12, 12]], np.float32)
+        got = V.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1], np.int32)), 2, 0.5)
+        want = np_roi_pool(x, boxes, [0], (2, 2), 0.5)
+        np.testing.assert_allclose(_np(got), want, rtol=1e-5, atol=1e-5)
+
+
+def np_psroi_pool(x, boxes, batch_ids, out_hw, scale):
+    ph, pw = out_hw
+    C, H, W = x.shape[1:]
+    co = C // (ph * pw)
+    out = np.zeros((len(boxes), co, ph, pw), np.float32)
+    for bi, (bid, box) in enumerate(zip(batch_ids, boxes)):
+        x1, y1, x2, y2 = box * scale
+        rh = max(y2 - y1, 0.1)
+        rw = max(x2 - x1, 0.1)
+        for i in range(ph):
+            hs = int(np.clip(np.floor(i * rh / ph + y1), 0, H))
+            he = int(np.clip(np.ceil((i + 1) * rh / ph + y1), 0, H))
+            for j in range(pw):
+                ws = int(np.clip(np.floor(j * rw / pw + x1), 0, W))
+                we = int(np.clip(np.ceil((j + 1) * rw / pw + x1), 0, W))
+                for c in range(co):
+                    cin = (c * ph + i) * pw + j
+                    if he > hs and we > ws:
+                        out[bi, c, i, j] = x[bid, cin, hs:he, ws:we].mean()
+    return out
+
+
+class TestPSRoIPool:
+    def test_vs_golden(self):
+        x = rng.standard_normal((2, 2 * 3 * 3, 10, 12)).astype("float32")
+        boxes = np.array([[1, 2, 9, 9], [0, 0, 11, 9]], np.float32)
+        boxes_num = np.array([1, 1], np.int32)
+        got = V.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                           paddle.to_tensor(boxes_num), 3)
+        want = np_psroi_pool(x, boxes, [0, 1], (3, 3), 1.0)
+        np.testing.assert_allclose(_np(got), want, rtol=1e-4, atol=1e-4)
+
+    def test_channel_check(self):
+        x = paddle.to_tensor(rng.standard_normal((1, 10, 4, 4)).astype("float32"))
+        with pytest.raises(ValueError):
+            V.psroi_pool(x, paddle.to_tensor(np.zeros((1, 4), np.float32)),
+                         paddle.to_tensor(np.array([1], np.int32)), 3)
+
+
+class TestDeformConv2D:
+    def test_zero_offset_matches_conv(self):
+        """With zero offsets and unit mask, deform_conv2d == plain conv2d."""
+        import paddle_tpu.nn.functional as F
+
+        x = rng.standard_normal((2, 4, 9, 9)).astype("float32")
+        w = (rng.standard_normal((6, 4, 3, 3)) * 0.2).astype("float32")
+        b = rng.standard_normal(6).astype("float32")
+        off = np.zeros((2, 2 * 9, 9, 9), np.float32)
+        got = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                              paddle.to_tensor(w), paddle.to_tensor(b),
+                              stride=1, padding=1)
+        want = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                        paddle.to_tensor(b), stride=1, padding=1)
+        np.testing.assert_allclose(_np(got), _np(want), rtol=1e-4, atol=1e-4)
+
+    def test_integer_offset_shifts_sampling(self):
+        """A +1 x-offset on every kernel point equals convolving the
+        x-shifted image (interior pixels)."""
+        x = rng.standard_normal((1, 1, 8, 8)).astype("float32")
+        w = np.ones((1, 1, 1, 1), np.float32)
+        off = np.zeros((1, 2, 8, 8), np.float32)
+        off[:, 1] = 1.0  # x-offset
+        got = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                              paddle.to_tensor(w))
+        np.testing.assert_allclose(_np(got)[0, 0, :, :-1], x[0, 0, :, 1:],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_mask_and_groups(self):
+        x = rng.standard_normal((1, 4, 6, 6)).astype("float32")
+        w = (rng.standard_normal((4, 2, 3, 3)) * 0.1).astype("float32")
+        off = (rng.standard_normal((1, 2 * 2 * 9, 6, 6)) * 0.3).astype("float32")
+        mask = rng.uniform(0, 1, (1, 2 * 9, 6, 6)).astype("float32")
+        got = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                              paddle.to_tensor(w), padding=1, groups=2,
+                              deformable_groups=2,
+                              mask=paddle.to_tensor(mask))
+        assert tuple(got.shape) == (1, 4, 6, 6)
+        # half mask -> halve output (linearity in mask)
+        got2 = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                               paddle.to_tensor(w), padding=1, groups=2,
+                               deformable_groups=2,
+                               mask=paddle.to_tensor(mask * 0.5))
+        np.testing.assert_allclose(_np(got2), _np(got) * 0.5, rtol=1e-4, atol=1e-5)
+
+    def test_layer(self):
+        layer = V.DeformConv2D(3, 5, 3, padding=1)
+        x = paddle.to_tensor(rng.standard_normal((2, 3, 7, 7)).astype("float32"))
+        off = paddle.to_tensor(np.zeros((2, 18, 7, 7), np.float32))
+        out = layer(x, off)
+        assert tuple(out.shape) == (2, 5, 7, 7)
+        out.sum().backward()
+        assert layer.weight.grad is not None
+
+
+def np_yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample,
+                clip_bbox=True, scale_x_y=1.0):
+    n, _, h, w = x.shape
+    an_num = len(anchors) // 2
+    bias = -0.5 * (scale_x_y - 1.0)
+    in_h, in_w = downsample * h, downsample * w
+    body = x.reshape(n, an_num, 5 + class_num, h, w)
+    sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+    boxes = np.zeros((n, an_num * h * w, 4), np.float32)
+    scores = np.zeros((n, an_num * h * w, class_num), np.float32)
+    for i in range(n):
+        ih, iw = img_size[i]
+        for a in range(an_num):
+            for r in range(h):
+                for c in range(w):
+                    conf = sig(body[i, a, 4, r, c])
+                    if conf < conf_thresh:
+                        continue
+                    cx = (c + sig(body[i, a, 0, r, c]) * scale_x_y + bias) * iw / w
+                    cy = (r + sig(body[i, a, 1, r, c]) * scale_x_y + bias) * ih / h
+                    bw = np.exp(body[i, a, 2, r, c]) * anchors[2 * a] * iw / in_w
+                    bh = np.exp(body[i, a, 3, r, c]) * anchors[2 * a + 1] * ih / in_h
+                    k = a * h * w + r * w + c
+                    bb = [cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2]
+                    if clip_bbox:
+                        bb[0] = max(bb[0], 0)
+                        bb[1] = max(bb[1], 0)
+                        bb[2] = min(bb[2], iw - 1)
+                        bb[3] = min(bb[3], ih - 1)
+                    boxes[i, k] = bb
+                    scores[i, k] = conf * sig(body[i, a, 5:, r, c])
+    return boxes, scores
+
+
+class TestYoloBox:
+    def test_vs_golden(self):
+        np.random.seed(3)
+        n, an, C, h = 2, 2, 4, 5
+        x = rng.standard_normal((n, an * (5 + C), h, h)).astype("float32")
+        img = np.array([[320, 480], [416, 416]], np.int32)
+        anchors = [10, 13, 16, 30]
+        gb, gs = V.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                            anchors, C, 0.4, 32)
+        wb, ws = np_yolo_box(x, img, anchors, C, 0.4, 32)
+        np.testing.assert_allclose(_np(gb), wb, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(_np(gs), ws, rtol=1e-4, atol=1e-4)
+
+    def test_scale_xy_noclip(self):
+        x = rng.standard_normal((1, 9, 3, 3)).astype("float32")
+        img = np.array([[96, 96]], np.int32)
+        gb, gs = V.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                            [6, 8], 4, 0.0, 32, clip_bbox=False, scale_x_y=1.2)
+        wb, ws = np_yolo_box(x, img, [6, 8], 4, 0.0, 32, clip_bbox=False,
+                             scale_x_y=1.2)
+        np.testing.assert_allclose(_np(gb), wb, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(_np(gs), ws, rtol=1e-4, atol=1e-4)
+
+    def test_iou_aware(self):
+        n, an, C, h = 1, 2, 3, 4
+        x = rng.standard_normal((n, an * (6 + C), h, h)).astype("float32")
+        img = np.array([[128, 128]], np.int32)
+        gb, gs = V.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                            [10, 13, 16, 30], C, 0.0, 32, iou_aware=True,
+                            iou_aware_factor=0.4)
+        sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+        body = x[:, an:].reshape(n, an, 5 + C, h, h)
+        iou = sig(x[:, :an])
+        conf = sig(body[:, :, 4]) ** 0.6 * iou ** 0.4
+        assert _np(gs).max() <= conf.max() + 1e-5
+
+
+class TestYoloLoss:
+    def _loss(self, x, gt_box, gt_label, **kw):
+        return V.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt_box),
+                           paddle.to_tensor(gt_label), **kw)
+
+    def test_perfect_prediction_low_loss(self):
+        """Constructed logits that exactly hit one gt box give near-zero
+        location/class loss at the positive cell."""
+        h, C = 4, 3
+        anchors = [10, 14, 23, 27, 37, 58]
+        amask = [0, 1, 2]
+        down = 32
+        insz = down * h
+        # gt: centered box matching anchor 1 exactly
+        gw, gh = 23 / insz, 27 / insz
+        gt_box = np.array([[[0.5 + 1e-6, 0.5 + 1e-6, gw, gh]]], np.float32)
+        gt_label = np.array([[1]], np.int64)
+        x = np.zeros((1, 3 * (5 + C), h, h), np.float32)
+        body = x.reshape(1, 3, 5 + C, h, h)
+        gi = gj = int(0.5 * h)
+        # tx target = 0.5*h - gi = 0 -> logit -inf; use large negative
+        body[0, 1, 0, gj, gi] = -20  # sigmoid -> ~0
+        body[0, 1, 1, gj, gi] = -20
+        body[0, 1, 2, gj, gi] = 0.0  # tw target = log(1) = 0
+        body[0, 1, 3, gj, gi] = 0.0
+        body[0, 1, 4, gj, gi] = 20  # obj -> 1
+        body[0, 1, 5 + 1, gj, gi] = 20  # class 1 -> 1
+        body[0, 1, 5 + 0, gj, gi] = -20
+        body[0, 1, 5 + 2, gj, gi] = -20
+        loss = self._loss(x, gt_box, gt_label, anchors=anchors,
+                          anchor_mask=amask, class_num=C, ignore_thresh=0.7,
+                          downsample_ratio=down, use_label_smooth=False)
+        # remaining loss is just negative-objectness at the other cells
+        neg_cells = 3 * h * h - 1
+        expect_obj_neg = neg_cells * np.log1p(np.exp(0.0))
+        np.testing.assert_allclose(_np(loss)[0], expect_obj_neg, rtol=0.02)
+
+    def test_ignore_thresh_masks_obj(self):
+        """With ignore_thresh=0 every cell overlapping a gt is ignored, so
+        the only obj loss comes from zero-IoU cells."""
+        # gt matches an anchor outside anchor_mask -> no positive cell, so
+        # ignored cells (best_iou > thresh) directly reduce the obj loss
+        h, C = 2, 2
+        x = np.zeros((1, 1 * (5 + C), h, h), np.float32)
+        gt_box = np.array([[[0.5, 0.5, 0.9, 0.9]]], np.float32)
+        gt_label = np.array([[0]], np.int64)
+        kw = dict(anchor_mask=[0], class_num=C, downsample_ratio=32,
+                  use_label_smooth=False, anchors=[8, 8, 60, 60])
+        l_lo = self._loss(x, gt_box, gt_label, ignore_thresh=1e-6, **kw)
+        l_hi = self._loss(x, gt_box, gt_label, ignore_thresh=0.99, **kw)
+        assert _np(l_lo)[0] < _np(l_hi)[0]
+
+    def test_label_smooth_changes_class_loss(self):
+        h, C = 2, 4
+        x = rng.standard_normal((1, 5 + C, h, h)).astype("float32")
+        gt_box = np.array([[[0.5, 0.5, 0.25, 0.25]]], np.float32)
+        gt_label = np.array([[2]], np.int64)
+        kw = dict(anchors=[16, 16], anchor_mask=[0], class_num=C,
+                  ignore_thresh=0.7, downsample_ratio=32)
+        l_sm = self._loss(x, gt_box, gt_label, use_label_smooth=True, **kw)
+        l_ns = self._loss(x, gt_box, gt_label, use_label_smooth=False, **kw)
+        assert not np.allclose(_np(l_sm), _np(l_ns))
+
+    def test_grad_flows(self):
+        h, C = 3, 2
+        x = paddle.to_tensor(rng.standard_normal((2, 3 * (5 + C), h, h))
+                             .astype("float32"))
+        x.stop_gradient = False
+        gt_box = paddle.to_tensor(
+            np.array([[[0.4, 0.4, 0.3, 0.25]], [[0.6, 0.5, 0.2, 0.2]]],
+                     np.float32))
+        gt_label = paddle.to_tensor(np.array([[0], [1]], np.int64))
+        loss = V.yolo_loss(x, gt_box, gt_label,
+                           anchors=[10, 13, 16, 30, 33, 23],
+                           anchor_mask=[0, 1, 2], class_num=C,
+                           ignore_thresh=0.5, downsample_ratio=32)
+        loss.sum().backward()
+        g = _np(x.grad)
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    def test_gt_score_weights(self):
+        h, C = 2, 2
+        x = rng.standard_normal((1, 5 + C, h, h)).astype("float32")
+        gt_box = np.array([[[0.5, 0.5, 0.25, 0.25]]], np.float32)
+        gt_label = np.array([[1]], np.int64)
+        kw = dict(anchors=[16, 16], anchor_mask=[0], class_num=C,
+                  ignore_thresh=0.7, downsample_ratio=32,
+                  use_label_smooth=False)
+        l1 = self._loss(x, gt_box, gt_label,
+                        gt_score=paddle.to_tensor(np.array([[1.0]], np.float32)), **kw)
+        l_half = self._loss(x, gt_box, gt_label,
+                            gt_score=paddle.to_tensor(np.array([[0.5]], np.float32)), **kw)
+        assert not np.allclose(_np(l1), _np(l_half))
+
+
+class TestNMS:
+    def test_basic(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = V.nms(paddle.to_tensor(boxes), 0.5,
+                     paddle.to_tensor(scores))
+        np.testing.assert_array_equal(_np(keep), [0, 2])
+
+    def test_categories(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1], np.int32)
+        keep = V.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+                     category_idxs=paddle.to_tensor(cats),
+                     categories=[0, 1])
+        assert len(_np(keep)) == 2  # different categories never suppress
+
+    def test_top_k(self):
+        boxes = np.array([[0, 0, 5, 5], [10, 10, 15, 15], [20, 20, 25, 25]],
+                         np.float32)
+        scores = np.array([0.5, 0.9, 0.7], np.float32)
+        keep = V.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+                     top_k=2)
+        np.testing.assert_array_equal(_np(keep), [1, 2])
+
+
+class TestImageIO:
+    def test_read_decode_jpeg_roundtrip(self, tmp_path):
+        from PIL import Image
+
+        # smooth gradient image: survives lossy JPEG within tolerance
+        yy, xx = np.mgrid[0:16, 0:20]
+        arr = np.stack([yy * 8, xx * 6, (yy + xx) * 4], -1).astype("uint8")
+        p = tmp_path / "img.jpg"
+        Image.fromarray(arr).save(p, quality=95)
+        raw = V.read_file(str(p))
+        assert raw._data.dtype == np.uint8
+        img = V.decode_jpeg(raw)
+        assert tuple(img.shape) == (3, 16, 20)
+        # lossy codec: just check it's close-ish
+        got = np.asarray(img._data).transpose(1, 2, 0).astype("float32")
+        assert np.abs(got - arr.astype("float32")).mean() < 15
+
+    def test_decode_gray(self, tmp_path):
+        from PIL import Image
+
+        arr = (rng.uniform(0, 255, (8, 8, 3))).astype("uint8")
+        p = tmp_path / "g.jpg"
+        Image.fromarray(arr).save(p)
+        img = V.decode_jpeg(V.read_file(str(p)), mode="gray")
+        assert tuple(img.shape) == (1, 8, 8)
