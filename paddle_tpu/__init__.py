@@ -107,6 +107,13 @@ _LAZY_SUBMODULES = (
     "inference",
     "fft",
     "signal",
+    "quantization",
+    "distribution",
+    "regularizer",
+    "hub",
+    "dataset",
+    "reader",
+    "compat",
 )
 
 
@@ -161,4 +168,55 @@ def __getattr__(name):
         from .framework.dtype_default import get_default_dtype
 
         return get_default_dtype
+    if name in ("disable_signal_handler", "set_printoptions"):
+        from . import framework as _fw
+
+        return getattr(_fw, name)
+    if name in ("get_cuda_rng_state", "set_cuda_rng_state"):
+        # device-RNG aliases: on TPU the seeded global PRNG plays the role of
+        # the per-device curand states (parity: paddle.get/set_cuda_rng_state)
+        from .random import get_rng_state, set_rng_state
+
+        return get_rng_state if name == "get_cuda_rng_state" else set_rng_state
+    if name == "batch":
+        return _batch_reader
+    if name == "check_shape":
+        return _check_shape
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def _batch_reader(reader, batch_size, drop_last=False):
+    """Legacy reader decorator: group a sample generator into batches
+    (parity: python/paddle/batch.py in the reference)."""
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    if batch_size < 1:
+        raise ValueError("batch_size should be a positive integer")
+    return batched
+
+
+def _check_shape(shape):
+    """Validate a shape argument (parity: paddle.check_shape — list/tuple
+    entries must be non-negative ints; a Tensor shape must be integer)."""
+    from .tensor import Tensor as _T
+
+    if isinstance(shape, _T):
+        if not str(shape.dtype).endswith(("int32", "int64")):
+            raise TypeError("shape tensor dtype must be int32 or int64")
+        return
+    for ele in shape:
+        if isinstance(ele, _T):
+            continue
+        if not isinstance(ele, (int,)):
+            raise TypeError("All elements in ``shape`` must be integers")
+        if ele < 0:
+            raise ValueError("All elements in ``shape`` must be positive")

@@ -1,0 +1,61 @@
+"""paddle_tpu.compat — py2/3-era string helpers kept for API parity.
+
+Parity: python/paddle/compat.py in the reference (to_text/to_bytes over
+str/bytes and nested containers, plus rounding helpers).
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["long_type", "to_text", "to_bytes", "round", "floor_division",
+           "get_exception_message"]
+
+long_type = int
+
+
+def _convert(obj, conv):
+    if obj is None:
+        return obj
+    if isinstance(obj, (list, set, tuple)):
+        return type(obj)(_convert(o, conv) for o in obj)
+    if isinstance(obj, dict):
+        return {conv_key(k, conv): _convert(v, conv) for k, v in obj.items()}
+    return conv(obj)
+
+
+def conv_key(k, conv):
+    return conv(k) if isinstance(k, (str, bytes)) else k
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """bytes → str (recursively through containers)."""
+    def conv(o):
+        return o.decode(encoding) if isinstance(o, bytes) else o
+
+    return _convert(obj, conv)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """str → bytes (recursively through containers)."""
+    def conv(o):
+        return o.encode(encoding) if isinstance(o, str) else o
+
+    return _convert(obj, conv)
+
+
+def round(x, d=0):  # noqa: A001
+    """Python-2-style half-away-from-zero rounding."""
+    p = 10 ** d
+    if x > 0:
+        return float(math.floor(x * p + 0.5)) / p
+    if x < 0:
+        return float(math.ceil(x * p - 0.5)) / p
+    return 0.0
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

@@ -1,0 +1,66 @@
+"""paddle_tpu.dataset — legacy dataset namespace.
+
+Parity: python/paddle/dataset/ in the reference (mnist, cifar, imdb,
+imikolov, uci_housing, conll05, movielens, wmt14, wmt16 download-and-parse
+modules). The modern equivalents live in paddle_tpu.vision.datasets and
+paddle_tpu.text.datasets; this namespace re-exports them under the legacy
+layout so `paddle.dataset.mnist`-style imports port.
+"""
+from __future__ import annotations
+
+import importlib
+import types
+
+__all__ = ["mnist", "cifar", "imdb", "imikolov", "uci_housing", "conll05",
+           "movielens", "wmt14", "wmt16"]
+
+_CLASS_MAP = {
+    "mnist": ("paddle_tpu.vision.datasets", "MNIST"),
+    "cifar": ("paddle_tpu.vision.datasets", "Cifar10"),
+    "imdb": ("paddle_tpu.text.datasets", "Imdb"),
+    "imikolov": ("paddle_tpu.text.datasets", "Imikolov"),
+    "uci_housing": ("paddle_tpu.text.datasets", "UCIHousing"),
+    "conll05": ("paddle_tpu.text.datasets", "Conll05st"),
+    "movielens": ("paddle_tpu.text.datasets", "Movielens"),
+    "wmt14": ("paddle_tpu.text.datasets", "WMT14"),
+    "wmt16": ("paddle_tpu.text.datasets", "WMT16"),
+}
+
+
+def _make_legacy_module(name, mod_path, cls_name):
+    mod = types.ModuleType(f"{__name__}.{name}")
+
+    def _dataset(**kw):
+        cls = getattr(importlib.import_module(mod_path), cls_name)
+        return cls(**kw)
+
+    def train(**kw):
+        """Legacy reader: yields samples of the train split."""
+        ds = _dataset(mode="train", **kw)
+
+        def reader():
+            yield from iter(ds)
+
+        return reader
+
+    def test(**kw):
+        """Legacy reader: yields samples of the test split."""
+        ds = _dataset(mode="test", **kw)
+
+        def reader():
+            yield from iter(ds)
+
+        return reader
+
+    mod.dataset_class = lambda: getattr(importlib.import_module(mod_path), cls_name)
+    mod.train = train
+    mod.test = test
+    return mod
+
+
+def __getattr__(name):
+    if name in _CLASS_MAP:
+        mod = _make_legacy_module(name, *_CLASS_MAP[name])
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

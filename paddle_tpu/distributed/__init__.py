@@ -6,7 +6,11 @@ fleet/, launch, spawn, ParallelEnv) re-grounded on one jax.sharding.Mesh.
 from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from . import utils  # noqa: F401
+from . import launch as launch_module  # noqa: F401
 from .collective import (  # noqa: F401
+    all_gather_object,
+    irecv,
+    isend,
     all_gather,
     all_reduce,
     alltoall,

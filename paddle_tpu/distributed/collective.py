@@ -250,3 +250,67 @@ def split_group_axis(x, group=None, axis: int = 0):
         out = lax.dynamic_slice_in_dim(arr, idx * size, size, axis=axis)
         return Tensor(out) if isinstance(x, Tensor) else out
     return x
+
+
+def isend(tensor, dst: int = 0, group=None):
+    """Async-flavored send (parity: paddle.distributed.isend). XLA schedules
+    communication itself, so this is `send` returning a completed-task
+    handle with `.wait()`. Outside an SPMD trace (no bound axis) it is a
+    self-send no-op, like barrier."""
+    if _axis_bound(_axis(group)):
+        send(tensor, dst=dst, group=group, sync_op=False)
+    return _DoneTask()
+
+
+def irecv(tensor, src: int = 0, group=None):
+    """Async-flavored recv (parity: paddle.distributed.irecv)."""
+    if _axis_bound(_axis(group)):
+        out = recv(tensor, src=src, group=group, sync_op=False)
+    else:
+        out = tensor
+    return _DoneTask(out)
+
+
+class _DoneTask:
+    """Completed-communication handle: XLA has no user-visible in-flight
+    state, so is_completed is always True (the reference's task wraps a
+    ProcessGroup work object)."""
+
+    def __init__(self, result=None):
+        self._result = result
+
+    def is_completed(self):
+        return True
+
+    def wait(self):
+        return self._result
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Gather picklable python objects from every rank (parity:
+    paddle.distributed.all_gather_object): pickle -> uint8 tensor ->
+    padded all_gather -> unpickle."""
+    import pickle
+
+    import numpy as np
+
+    from ..tensor import Tensor
+
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    n = int(payload.size)
+    # exchange sizes first so rank payloads can be padded identically
+    import jax.numpy as jnp
+
+    size_t = Tensor(jnp.asarray(np.array([n], np.int32)))
+    sizes = []
+    all_gather(sizes, size_t, group=group)
+    max_n = int(max(int(np.asarray(s._data)[0]) for s in sizes))
+    padded = np.zeros(max_n, np.uint8)
+    padded[:n] = payload
+    gathered = []
+    all_gather(gathered, Tensor(jnp.asarray(padded)), group=group)
+    object_list.clear()
+    for s, g in zip(sizes, gathered):
+        ln = int(np.asarray(s._data)[0])
+        object_list.append(pickle.loads(bytes(np.asarray(g._data)[:ln])))
+    return object_list

@@ -1,0 +1,166 @@
+"""paddle_tpu.distribution — probability distributions.
+
+Parity: python/paddle/distribution.py in the reference (__all__:39 —
+Distribution, Uniform, Normal, Categorical; sample/entropy/log_prob/probs/
+kl_divergence surface), which lowers to distribution ops
+(uniform_random/gaussian_random kernels).
+
+TPU-native redesign: sampling draws from the framework's seeded global PRNG
+(paddle_tpu.random.split_key) so results are reproducible under paddle.seed
+and TP-rank aware; densities are pure jnp expressions that fuse under jit.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ops._primitive import unwrap, wrap
+from .random import split_key
+from .tensor import Tensor
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical", "kl_divergence"]
+
+
+def _arr(v, dtype=jnp.float32):
+    if isinstance(v, Tensor):
+        return v._data
+    return jnp.asarray(np.asarray(v), dtype)
+
+
+class Distribution:
+    """Abstract base (reference distribution.py Distribution)."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U[low, high) with broadcastable batch shape."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(self.low.shape, self.high.shape)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self._batch
+        u = jax.random.uniform(split_key(), shape, jnp.float32)
+        return wrap(self.low + u * (self.high - self.low))
+
+    def entropy(self):
+        return wrap(jnp.broadcast_to(jnp.log(self.high - self.low), self._batch))
+
+    def log_prob(self, value):
+        v = unwrap(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def probs(self, value):
+        return wrap(jnp.exp(unwrap(self.log_prob(value))))
+
+
+class Normal(Distribution):
+    """N(loc, scale^2) with broadcastable batch shape."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+
+    @property
+    def mean(self):
+        return wrap(jnp.broadcast_to(self.loc, self._batch))
+
+    @property
+    def variance(self):
+        return wrap(jnp.broadcast_to(self.scale * self.scale, self._batch))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self._batch
+        z = jax.random.normal(split_key(), shape, jnp.float32)
+        return wrap(self.loc + z * self.scale)
+
+    def entropy(self):
+        ent = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return wrap(jnp.broadcast_to(ent, self._batch))
+
+    def log_prob(self, value):
+        v = unwrap(value)
+        var = self.scale * self.scale
+        return wrap(-((v - self.loc) ** 2) / (2 * var)
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def probs(self, value):
+        return wrap(jnp.exp(unwrap(self.log_prob(value))))
+
+    def kl_divergence(self, other):
+        """KL(self || other) between two Normals (reference kl formula)."""
+        if not isinstance(other, Normal):
+            raise TypeError("kl_divergence expects another Normal")
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return wrap(0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio)))
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference Categorical —
+    constructed from `logits`, sampling proportional to softmax)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits)
+
+    def _probs(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        return wrap(jax.random.categorical(
+            split_key(), self.logits, shape=tuple(shape) + self.logits.shape[:-1]))
+
+    def entropy(self):
+        p = self._probs()
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return wrap(-(p * logp).sum(-1))
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        idx = unwrap(value).astype(jnp.int32)
+        if logp.ndim == 1:
+            return wrap(jnp.take(logp, idx))
+        return wrap(jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0])
+
+    def probs(self, value):
+        return wrap(jnp.exp(unwrap(self.log_prob(value))))
+
+    def kl_divergence(self, other):
+        if not isinstance(other, Categorical):
+            raise TypeError("kl_divergence expects another Categorical")
+        p = self._probs()
+        return wrap((p * (jax.nn.log_softmax(self.logits, -1)
+                          - jax.nn.log_softmax(other.logits, -1))).sum(-1))
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """Dispatching KL (reference paddle.distribution.kl_divergence)."""
+    return p.kl_divergence(q)

@@ -40,7 +40,7 @@ def _check_norm(norm):
     return norm
 
 
-def _axes_pair(x_ndim, s, axes, name):
+def _axes_pair(s, axes, name):
     if axes is None:
         axes = (-2, -1)
     if s is not None and len(s) != len(axes):
@@ -133,12 +133,12 @@ def ifft(x, n=None, axis=-1, norm="backward", name=None):
 
 
 def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    s, axes = _axes_pair(None, s, axes, "fft2")
+    s, axes = _axes_pair(s, axes, "fft2")
     return _fftn(x, s, axes, _check_norm(norm))
 
 
 def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    s, axes = _axes_pair(None, s, axes, "ifft2")
+    s, axes = _axes_pair(s, axes, "ifft2")
     return _ifftn(x, s, axes, _check_norm(norm))
 
 
@@ -159,12 +159,12 @@ def irfft(x, n=None, axis=-1, norm="backward", name=None):
 
 
 def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    s, axes = _axes_pair(None, s, axes, "rfft2")
+    s, axes = _axes_pair(s, axes, "rfft2")
     return _rfftn(x, s, axes, _check_norm(norm))
 
 
 def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    s, axes = _axes_pair(None, s, axes, "irfft2")
+    s, axes = _axes_pair(s, axes, "irfft2")
     return _irfftn(x, s, axes, _check_norm(norm))
 
 
@@ -192,12 +192,12 @@ def _norm_axes(x, axes):
 
 
 def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    s, axes = _axes_pair(None, s, axes, "hfft2")
+    s, axes = _axes_pair(s, axes, "hfft2")
     return _hfftn(x, s, _norm_axes(x, axes), _check_norm(norm))
 
 
 def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    s, axes = _axes_pair(None, s, axes, "ihfft2")
+    s, axes = _axes_pair(s, axes, "ihfft2")
     return _ihfftn(x, s, _norm_axes(x, axes), _check_norm(norm))
 
 

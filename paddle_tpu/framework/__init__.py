@@ -5,3 +5,28 @@ from .flags import get_flags, set_flags  # noqa: F401
 from . import monitor  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import CheckpointManager, load_checkpoint, save_checkpoint  # noqa: F401
+
+
+def disable_signal_handler():
+    """No-op on TPU (parity: fluid.framework.disable_signal_handler — the
+    reference unhooks its C++ fault handlers; jax installs none we own)."""
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Configure numpy print options used for Tensor reprs (parity:
+    paddle.set_printoptions)."""
+    import numpy as np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)

@@ -1,0 +1,60 @@
+"""paddle_tpu.hub — hubconf-based model loading.
+
+Parity: python/paddle/hub.py in the reference (list/help/load over a repo
+that exposes ``hubconf.py`` entrypoints). Network sources (github) are out of
+scope in this zero-egress build: only ``source='local'`` is supported; remote
+sources raise with a clear message.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} found in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise ValueError(
+            f"source={source!r} is not available in this build; only 'local' "
+            "repo directories are supported (no network egress)")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoint names exposed by the repo's hubconf."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    """Docstring of one entrypoint."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"model {model} not found in {repo_dir}/{_HUBCONF}")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, *args, source="local", force_reload=False, **kwargs):
+    """Instantiate one entrypoint."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"model {model} not found in {repo_dir}/{_HUBCONF}")
+    return getattr(mod, model)(*args, **kwargs)

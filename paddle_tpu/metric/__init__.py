@@ -186,3 +186,20 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A002
+    """Functional top-k accuracy (parity: accuracy op,
+    reference operators/metrics/accuracy_op.* and paddle.metric.accuracy).
+    input: (N, C) scores; label: (N, 1) or (N,) int. Returns a 0-D tensor."""
+    import jax.numpy as jnp
+
+    from ..ops._primitive import unwrap, wrap
+
+    scores = unwrap(input)
+    lab = unwrap(label)
+    if lab.ndim == 2:
+        lab = lab[:, 0]
+    topk_idx = jnp.argsort(-scores, axis=-1)[:, :k]
+    hit = (topk_idx == lab[:, None].astype(topk_idx.dtype)).any(axis=1)
+    return wrap(hit.mean(dtype=jnp.float32))

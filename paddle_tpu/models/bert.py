@@ -1,0 +1,245 @@
+"""BERT encoder family — masked-LM pretraining (BASELINE config #3).
+
+Parity: the reference trains BERT through PaddleNLP modeling on top of
+paddle.nn.TransformerEncoder (python/paddle/nn/layer/transformer.py) and the
+fused attention path (paddle/fluid/operators/fused/fused_attention_op.cu);
+this module rebuilds the same architecture on this framework's TP substrate
+(ColumnParallel/RowParallel/VocabParallelEmbedding, mp_layers parity).
+
+TPU-native design mirrors models/gpt.py: weights carry partition_spec
+annotations so GSPMD inserts the TP collectives; attention is bidirectional
+(is_causal=False) through the shared dispatch in nn.functional_attention;
+the MLM head ties the vocab-parallel embedding and the loss is the
+vocab-sharded ParallelCrossEntropy.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..distributed.meta_parallel.mp_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..nn import functional as F
+from ..nn.functional_attention import scaled_dot_product_attention
+from ..nn.layer import Layer, LayerList
+from ..nn.layers.common import Dropout, Embedding, Linear
+from ..nn.layers.norm import LayerNorm
+from ..ops import creation
+from ..ops import manipulation as manip
+from ..ops._primitive import primitive
+
+__all__ = [
+    "BertConfig",
+    "BertModel",
+    "BertForPretraining",
+    "BertPretrainingCriterion",
+    "bert_config",
+    "BERT_CONFIGS",
+]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30528  # padded to a 64-multiple for mp divisibility
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    layer_norm_epsilon: float = 1e-12
+    use_recompute: bool = False
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+BERT_CONFIGS = {
+    "bert-base": dict(hidden_size=768, num_layers=12, num_attention_heads=12),
+    "bert-large": dict(hidden_size=1024, num_layers=24, num_attention_heads=16),
+}
+
+
+def bert_config(name: str, **overrides) -> BertConfig:
+    cfg = dict(BERT_CONFIGS[name])
+    cfg.update(overrides)
+    return BertConfig(**cfg)
+
+
+class BertSelfAttention(Layer):
+    """Bidirectional self-attention, Megatron TP split (qkv column-parallel,
+    output row-parallel — mp_layers.py:97,170 parity)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.head_dim
+        self.dropout_p = config.attention_dropout_prob
+        h = config.hidden_size
+        self.qkv_proj = ColumnParallelLinear(h, 3 * h, gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
+
+    def forward(self, x, attn_mask=None):
+        b, t = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)
+        qkv = manip.reshape(qkv, [b, t, 3, self.num_heads, self.head_dim])
+        qkv = manip.transpose(qkv, [2, 0, 3, 1, 4])  # [3, B, H, T, D]
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        out, _ = scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=False,
+            dropout_p=self.dropout_p if self.training else 0.0,
+        )
+        out = manip.transpose(out, [0, 2, 1, 3])
+        out = manip.reshape(out, [b, t, self.num_heads * self.head_dim])
+        return self.out_proj(out)
+
+
+class BertLayer(Layer):
+    """Post-LN encoder block (original BERT): LN(x + attn(x)); LN(x + ffn)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.attn = BertSelfAttention(config)
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.fc_in = ColumnParallelLinear(config.hidden_size, config.intermediate_size,
+                                          gather_output=False)
+        self.fc_out = RowParallelLinear(config.intermediate_size, config.hidden_size,
+                                        input_is_parallel=True)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.dropout1 = Dropout(config.hidden_dropout_prob, mode="upscale_in_train")
+        self.dropout2 = Dropout(config.hidden_dropout_prob, mode="upscale_in_train")
+        self._use_recompute = config.use_recompute
+
+    def _block(self, x, attn_mask=None):
+        x = self.ln_1(x + self.dropout1(self.attn(x, attn_mask)))
+        h = self.fc_out(F.gelu(self.fc_in(x), approximate=True))
+        return self.ln_2(x + self.dropout2(h))
+
+    def forward(self, x, attn_mask=None):
+        if self._use_recompute and self.training:
+            import jax
+
+            @primitive
+            def _remat(h):
+                from ..tensor import Tensor
+
+                def raw(arr):
+                    return self._block(Tensor(arr), attn_mask)._data
+
+                return jax.checkpoint(raw)(h)
+
+            return _remat(x)
+        return self._block(x, attn_mask)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        self.position_embeddings = Embedding(config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = Embedding(config.type_vocab_size, config.hidden_size)
+        self.layer_norm = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.dropout = Dropout(config.hidden_dropout_prob, mode="upscale_in_train")
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        t = input_ids.shape[-1]
+        if position_ids is None:
+            position_ids = creation.arange(0, t, dtype="int64")
+        emb = self.word_embeddings(input_ids) + self.position_embeddings(position_ids)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertModel(Layer):
+    """Returns (sequence_output [B,T,H], pooled_output [B,H])."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = LayerList([BertLayer(config) for _ in range(config.num_layers)])
+        self.pooler = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                position_ids=None):
+        # attention_mask: [B, T] with 1 = attend, 0 = pad -> additive mask
+        attn_mask = None
+        if attention_mask is not None:
+            @primitive(nondiff=True)
+            def _additive(m):
+                return ((1.0 - m.astype(jnp.float32)) * -1e9)[:, None, None, :]
+
+            attn_mask = _additive(attention_mask)
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for block in self.encoder:
+            x = block(x, attn_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForPretraining(Layer):
+    """MLM head (transform + tied vocab-parallel decoder) and NSP head."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.transform_ln = LayerNorm(config.hidden_size,
+                                      epsilon=config.layer_norm_epsilon)
+        self.decoder_bias = self.create_parameter(
+            [config.vocab_size], is_bias=True)
+        self.nsp = Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_ln(F.gelu(self.transform(seq), approximate=True))
+        w = self.bert.embeddings.word_embeddings.weight  # [V, H] on 'mp'
+
+        @primitive
+        def _logits(h, w, b):
+            return jnp.matmul(h, w.T) + b
+
+        prediction_logits = _logits(h, w, self.decoder_bias)
+        nsp_logits = self.nsp(pooled)
+        return prediction_logits, nsp_logits
+
+
+class BertPretrainingCriterion(Layer):
+    """MLM loss over masked positions (+ NSP loss when labels given).
+
+    masked_lm_labels uses -100 for unmasked positions (ignore_index parity
+    with softmax_with_cross_entropy's ignore path)."""
+
+    def __init__(self, config: Optional[BertConfig] = None):
+        super().__init__()
+        self.ce = ParallelCrossEntropy(ignore_index=-100)
+
+    def forward(self, prediction_logits, masked_lm_labels,
+                nsp_logits=None, next_sentence_labels=None):
+        mlm = self.ce(prediction_logits, masked_lm_labels)  # [B, T, 1]
+
+        @primitive
+        def _masked_mean(losses, labels):
+            mask = (labels != -100).astype(losses.dtype)
+            return (losses[..., 0] * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+        loss = _masked_mean(mlm, masked_lm_labels)
+        if nsp_logits is not None and next_sentence_labels is not None:
+            nsp = F.softmax_with_cross_entropy(
+                nsp_logits, manip.reshape(next_sentence_labels, [-1, 1]))
+            loss = loss + nsp.mean()
+        return loss

@@ -1107,10 +1107,11 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,  # noqa: A
     ref = unwrap(label)
     B, L1 = hyp.shape
     L2 = ref.shape[1]
-    hyp_len = unwrap(input_length).astype(jnp.int32) if input_length is not None \
-        else jnp.full((B,), L1, jnp.int32)
-    ref_len = unwrap(label_length).astype(jnp.int32) if label_length is not None \
-        else jnp.full((B,), L2, jnp.int32)
+    # lengths may come as (B,) or paddle's documented (B, 1)
+    hyp_len = unwrap(input_length).astype(jnp.int32).reshape(-1) \
+        if input_length is not None else jnp.full((B,), L1, jnp.int32)
+    ref_len = unwrap(label_length).astype(jnp.int32).reshape(-1) \
+        if label_length is not None else jnp.full((B,), L2, jnp.int32)
 
     if ignored_tokens:
         ign = jnp.asarray(list(ignored_tokens))

@@ -23,6 +23,14 @@ from .search import *  # noqa: F401,F403
 # manipulation has no __all__; re-export its public names explicitly
 from .manipulation import (  # noqa: F401
     broadcast_shape,
+    crop,
+    rank,
+    reverse,
+    scatter_,
+    shape,
+    squeeze_,
+    tolist,
+    unsqueeze_,
     broadcast_tensors,
     broadcast_to,
     cast,
@@ -146,6 +154,11 @@ def diagonal(x, offset=0, axis1=0, axis2=1):
 
 
 _METHODS["diagonal"] = diagonal
+_METHODS["squeeze_"] = manipulation.squeeze_
+_METHODS["unsqueeze_"] = manipulation.unsqueeze_
+_METHODS["scatter_"] = manipulation.scatter_
+_METHODS["tanh_"] = math.tanh_
+_METHODS["tolist"] = manipulation.tolist
 del _METHODS["zero_"]  # defined directly on Tensor
 
 for _name, _fn in _METHODS.items():

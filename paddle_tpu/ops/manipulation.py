@@ -549,3 +549,77 @@ def index_put(x, indices, value, accumulate=False, name=None):
 
     idx = tuple(indices) if isinstance(indices, (tuple, list)) else (indices,)
     return _ip(x, value, *idx)
+
+
+def reverse(x, axis, name=None):
+    """Alias of flip with paddle's legacy name (reverse op)."""
+    return flip(x, axis)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Crop a sub-tensor: take `shape` elements starting at `offsets`
+    (parity: crop_tensor op, reference operators/crop_tensor_op.cc).
+    shape entries of -1 keep the remainder; offsets default to zeros."""
+    nd = len(x.shape)
+    if shape is None:
+        shape = list(x.shape)
+    shape = [int(s) for s in (unwrap(shape) if not isinstance(shape, (list, tuple)) else shape)]
+    if offsets is None:
+        offsets = [0] * nd
+    offsets = [int(o) for o in (unwrap(offsets) if not isinstance(offsets, (list, tuple)) else offsets)]
+    full = x.shape
+    ends = [o + (s if s != -1 else full[i] - o) for i, (o, s) in enumerate(zip(offsets, shape))]
+    for i, (o, e) in enumerate(zip(offsets, ends)):
+        if o < 0 or e > full[i]:
+            raise ValueError(
+                f"crop out of bounds on dim {i}: offset {o} + size {e - o} "
+                f"exceeds input extent {full[i]}")
+
+    @primitive
+    def _crop(x):
+        idx = tuple(jnp.s_[o:e] for o, e in zip(offsets, ends))
+        return x[idx]
+
+    return _crop(x)
+
+
+def squeeze_(x, axis=None):
+    arr = x._data
+    out = jnp.squeeze(arr, axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis)
+    x._set_data(out)
+    return x
+
+
+def unsqueeze_(x, axis):
+    arr = x._data
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    out = jnp.expand_dims(arr, tuple(axes))
+    x._set_data(out)
+    return x
+
+
+def scatter_(x, index, updates, overwrite=True):
+    out = scatter(x, index, updates, overwrite=overwrite)
+    x._set_data(out._data if hasattr(out, "_data") else out)
+    return x
+
+
+def tolist(x):
+    """Nested python list of the tensor's values (parity: paddle.tolist)."""
+    import numpy as _np
+
+    return _np.asarray(unwrap(x)).tolist()
+
+
+def shape(x, name=None):
+    """Runtime shape as an int32 tensor (parity: shape op)."""
+    import numpy as _np
+
+    return wrap(jnp.asarray(_np.array(list(unwrap(x).shape), dtype=_np.int32)))
+
+
+def rank(x, name=None):
+    """Tensor rank as a 0-D int32 tensor (parity: rank op)."""
+    import numpy as _np
+
+    return wrap(jnp.asarray(_np.int32(len(unwrap(x).shape))))

@@ -468,3 +468,52 @@ def _nanquantile(x, q, axis, keepdim):
 
 def nanquantile(x, q, axis=None, keepdim=False):
     return _nanquantile(x, q, _axis(axis), keepdim)
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (parity: sum op / paddle.add_n)."""
+    if isinstance(inputs, (list, tuple)):
+        @primitive
+        def _add_n(*xs):
+            out = xs[0]
+            for x in xs[1:]:
+                out = out + x
+            return out
+
+        return _add_n(*inputs)
+    return inputs
+
+
+def mm(input, mat2, name=None):  # noqa: A002
+    """Alias of matmul (parity: paddle.mm)."""
+    from .linalg import matmul
+
+    return matmul(input, mat2)
+
+
+@primitive
+def _tensordot(x, y, axes):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def tensordot(x, y, axes=2, name=None):
+    """Generalized tensor contraction (parity: paddle.tensordot).
+
+    axes: int (last-n of x vs first-n of y), a flat int list (contract those
+    axes of BOTH tensors, paddle semantics), or a pair of axis lists."""
+    import builtins
+
+    if isinstance(axes, (list, tuple)):
+        if builtins.all(isinstance(a, (int,)) for a in axes):
+            # flat list applies to both operands
+            axes = (tuple(axes), tuple(axes))
+        else:
+            axes = (tuple(axes[0]) if isinstance(axes[0], (list, tuple)) else (axes[0],),
+                    tuple(axes[1]) if isinstance(axes[1], (list, tuple)) else (axes[1],))
+    return _tensordot(x, y, axes)
+
+
+def tanh_(x, name=None):
+    """In-place tanh (parity: paddle.tanh_)."""
+    x._set_data(jnp.tanh(x._data))
+    return x

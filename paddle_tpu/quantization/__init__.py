@@ -1,0 +1,267 @@
+"""paddle_tpu.quantization — quantization-aware training and post-training
+quantization.
+
+Parity: python/paddle/fluid/contrib/slim/quantization in the reference —
+dygraph QAT `ImperativeQuantAware` (imperative/qat.py:40, quantizable types /
+abs_max + moving_average_abs_max quantizers :45-56, per-layer `skip_quant`
+:157), the fake-quant operator family (operators/fake_quantize_op.cc:
+fake_quantize_abs_max, fake_channel_wise_quantize_abs_max,
+fake_quantize_moving_average_abs_max, moving_average_abs_max_scale) and
+`PostTrainingQuantization` (post_training_quantization.py).
+
+TPU-native redesign: a fake-quant op is a pure quant-dequant function with a
+straight-through-estimator gradient (``jax.custom_vjp``), so the whole QAT
+graph stays jit-compilable; the reference's separate CUDA kernels and
+in-graph state ops become layer buffers updated functionally. INT8 inference
+lowering (TensorRT/mkldnn passes) is out of scope on TPU — the deliverable of
+QAT here is the quantization-robust weights plus the learned scales, exactly
+what the reference's QAT phase produces before engine export.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer
+from ..ops._primitive import primitive, unwrap, wrap
+from ..tensor import Tensor
+
+__all__ = [
+    "fake_quantize_abs_max",
+    "fake_channel_wise_quantize_abs_max",
+    "fake_quantize_moving_average_abs_max",
+    "moving_average_abs_max_scale",
+    "QuantizedLinear",
+    "QuantizedConv2D",
+    "ImperativeQuantAware",
+    "PostTrainingQuantization",
+]
+
+
+# ---------------------------------------------------------------------------
+# fake-quant primitives (quant->dequant with straight-through gradient)
+# ---------------------------------------------------------------------------
+
+@jax.custom_vjp
+def _qdq_ste(x, scale, levels):
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * levels), -levels, levels)
+    return q * s / levels
+
+
+def _qdq_fwd(x, scale, levels):
+    return _qdq_ste(x, scale, levels), None
+
+
+def _qdq_bwd(_, g):
+    # straight-through estimator: quantization is identity for the gradient
+    # (reference fake_quantize_dequantize grad kernels, fake_quantize_op.cc)
+    return g, None, None
+
+
+_qdq_ste.defvjp(_qdq_fwd, _qdq_bwd)
+
+
+def _levels(bits):
+    return float((1 << (bits - 1)) - 1)
+
+
+@primitive
+def _fq_abs_max(x, bits):
+    scale = jnp.max(jnp.abs(x))
+    return _qdq_ste(x, scale, _levels(bits)), scale
+
+
+def fake_quantize_abs_max(x, bit_length=8):
+    """Quant-dequant by the tensor-wide abs-max scale. Returns (out, scale)."""
+    return _fq_abs_max(x, int(bit_length))
+
+
+@primitive
+def _fq_channel(x, bits, axis):
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    out = _qdq_ste(x, scale, _levels(bits))
+    return out, scale.reshape(-1)
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length=8, quant_axis=0):
+    """Per-output-channel abs-max quant-dequant. Returns (out, scales)."""
+    return _fq_channel(x, int(bit_length), int(quant_axis))
+
+
+@primitive
+def _fq_fixed(x, scale, bits):
+    return _qdq_ste(x, scale, _levels(bits))
+
+
+def fake_quantize_moving_average_abs_max(x, state_scale, bit_length=8,
+                                         moving_rate=0.9, training=True):
+    """Quant-dequant with an EMA abs-max scale. Returns (out, new_scale).
+
+    state update (reference fake_quantize_op.cc moving-average rule):
+        scale = rate * scale + (1 - rate) * abs_max(x)
+    """
+    arr = unwrap(x)
+    cur = jnp.max(jnp.abs(arr if not isinstance(arr, Tensor) else arr._data))
+    old = unwrap(state_scale)
+    if training:
+        new_scale = moving_rate * old + (1.0 - moving_rate) * cur
+    else:
+        new_scale = old
+    out = _fq_fixed(x, new_scale, int(bit_length))
+    return out, wrap(new_scale) if not isinstance(new_scale, Tensor) else new_scale
+
+
+def moving_average_abs_max_scale(x, state_scale, moving_rate=0.9):
+    """Track the EMA abs-max of a tensor without quantizing (reference
+    moving_average_abs_max_scale op — used to record output scales)."""
+    cur = jnp.max(jnp.abs(unwrap(x)))
+    return wrap(moving_rate * unwrap(state_scale) + (1.0 - moving_rate) * cur)
+
+
+# ---------------------------------------------------------------------------
+# quantized layer wrappers
+# ---------------------------------------------------------------------------
+
+class _QuantWrapperBase(Layer):
+    def __init__(self, layer, weight_bits, activation_bits, moving_rate,
+                 weight_quantize_type, weight_quant_axis):
+        super().__init__()
+        self._inner = layer
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+        self._wtype = weight_quantize_type
+        self._waxis = weight_quant_axis
+        self.register_buffer("_act_scale", Tensor(jnp.zeros((), jnp.float32)))
+        self._calibrating = False
+
+    def _quant_weight(self, w):
+        if self._wtype == "channel_wise_abs_max":
+            out, _ = fake_channel_wise_quantize_abs_max(w, self._wbits, self._waxis)
+        else:
+            out, _ = fake_quantize_abs_max(w, self._wbits)
+        return out
+
+    def _quant_act(self, x):
+        out, new_scale = fake_quantize_moving_average_abs_max(
+            x, self._act_scale, self._abits, self._rate,
+            training=self.training or self._calibrating)
+        if self.training or self._calibrating:
+            self._act_scale._set_data(unwrap(new_scale))
+        return out
+
+    @property
+    def act_scale(self):
+        return float(np.asarray(self._act_scale._data))
+
+
+class QuantizedLinear(_QuantWrapperBase):
+    """Linear with fake-quantized weight + input activation (parity:
+    imperative/quant_layers QuantizedLinear). Weight layout (in, out) →
+    channel axis 1."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="abs_max"):
+        super().__init__(layer, weight_bits, activation_bits, moving_rate,
+                         weight_quantize_type, weight_quant_axis=1)
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        xq = self._quant_act(x)
+        wq = self._quant_weight(self._inner.weight)
+        return F.linear(xq, wq, self._inner.bias)
+
+
+class QuantizedConv2D(_QuantWrapperBase):
+    """Conv2D with fake-quantized weight + input (weight layout (out, in/g,
+    kh, kw) → channel axis 0)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="abs_max"):
+        super().__init__(layer, weight_bits, activation_bits, moving_rate,
+                         weight_quantize_type, weight_quant_axis=0)
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        xq = self._quant_act(x)
+        wq = self._quant_weight(self._inner.weight)
+        inner = self._inner
+        return F.conv2d(xq, wq, inner.bias, inner._stride, inner._padding,
+                        inner._dilation, inner._groups, inner._data_format)
+
+
+_WRAPPERS = {"Linear": QuantizedLinear, "Conv2D": QuantizedConv2D}
+
+
+class ImperativeQuantAware:
+    """Dygraph quantization-aware training driver (parity:
+    imperative/qat.py:40). ``quantize(model)`` replaces every quantizable
+    sublayer in place with its fake-quant wrapper; layers carrying
+    ``skip_quant = True`` are left untouched (reference qat.py:157)."""
+
+    def __init__(self, quantizable_layer_type=("Conv2D", "Linear"),
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 **unused):
+        for t in quantizable_layer_type:
+            if t not in _WRAPPERS:
+                raise ValueError(f"unsupported quantizable layer type: {t}")
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+            raise ValueError(f"unsupported weight_quantize_type: {weight_quantize_type}")
+        if activation_quantize_type != "moving_average_abs_max":
+            raise ValueError(
+                f"unsupported activation_quantize_type: {activation_quantize_type}")
+        self._types = tuple(quantizable_layer_type)
+        self._wtype = weight_quantize_type
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+
+    def quantize(self, model: Layer) -> Layer:
+        for layer in model.sublayers(include_self=True):
+            for name, sub in list(layer._sub_layers.items()):
+                if type(sub).__name__ in self._types and \
+                        not getattr(sub, "skip_quant", False):
+                    wrapper = _WRAPPERS[type(sub).__name__](
+                        sub, self._wbits, self._abits, self._rate, self._wtype)
+                    layer._sub_layers[name] = wrapper
+        return model
+
+
+class PostTrainingQuantization:
+    """Minimal PTQ (parity: post_training_quantization.py abs_max path):
+    wrap the model's quantizable layers, run calibration batches to settle
+    the activation EMA scales, then freeze them for eval."""
+
+    def __init__(self, model, data_loader, batch_nums=None,
+                 quantizable_layer_type=("Conv2D", "Linear"),
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_bits=8, weight_bits=8):
+        self._model = ImperativeQuantAware(
+            quantizable_layer_type=quantizable_layer_type,
+            weight_quantize_type=weight_quantize_type,
+            weight_bits=weight_bits, activation_bits=activation_bits,
+        ).quantize(model)
+        self._loader = data_loader
+        self._batch_nums = batch_nums
+
+    def quantize(self):
+        self._model.eval()
+        wrappers = [l for l in self._model.sublayers()  # noqa: E741
+                    if isinstance(l, _QuantWrapperBase)]
+        for w in wrappers:
+            w._calibrating = True
+        for i, batch in enumerate(self._loader):
+            if self._batch_nums is not None and i >= self._batch_nums:
+                break
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            self._model(x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)))
+        for w in wrappers:
+            w._calibrating = False
+        return self._model

@@ -1,0 +1,165 @@
+"""paddle_tpu.reader — legacy reader decorators.
+
+Parity: python/paddle/reader/decorator.py in the reference (map_readers,
+shuffle, chain, compose, buffered, firstn, cache, xmap_readers) — generator
+combinators predating paddle.io.DataLoader, kept so legacy pipelines port.
+The buffered/xmap variants use host threads (the TPU-side prefetch lives in
+paddle_tpu.io.DataLoader).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
+           "firstn", "cache", "xmap_readers"]
+
+
+def map_readers(func, *readers):
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        rng = np.random.default_rng()
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        for r in readers:
+            yield from r()
+
+    return chained
+
+
+def compose(*readers, check_alignment=True):
+    def composed():
+        iters = [r() for r in readers]
+        for items in zip(*iters):
+            out = []
+            for it in items:
+                if isinstance(it, tuple):
+                    out.extend(it)
+                else:
+                    out.append(it)
+            yield tuple(out)
+
+    return composed
+
+
+def buffered(reader, size):
+    """Prefetch up to `size` samples on a background thread."""
+    end = object()
+
+    def buffered_reader():
+        q = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for s in reader():
+                    q.put(s)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is end:
+                break
+            yield s
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def limited():
+        for i, s in enumerate(reader()):
+            if i >= n:
+                break
+            yield s
+
+    return limited
+
+
+def cache(reader):
+    all_data = []
+    filled = [False]
+
+    def cached():
+        if filled[0]:
+            yield from all_data
+            return
+        for s in reader():
+            all_data.append(s)
+            yield s
+        filled[0] = True
+
+    return cached
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker threads."""
+    end = object()
+
+    def xreader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feed():
+            for i, s in enumerate(reader()):
+                in_q.put((i, s))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    break
+                i, s = item
+                out_q.put((i, mapper(s)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        pending = {}
+        next_idx = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            if not order:
+                yield item[1]
+            else:
+                pending[item[0]] = item[1]
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return xreader

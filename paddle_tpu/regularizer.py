@@ -1,0 +1,39 @@
+"""paddle_tpu.regularizer — weight-decay regularizers.
+
+Parity: python/paddle/regularizer.py in the reference (L1Decay, L2Decay),
+consumed by optimizers as ``weight_decay=`` (the optimizer base already reads
+``_regularization_coeff``, optimizer/optimizer.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L2Decay:
+    """Adds coeff * param to the gradient (ridge/weight decay)."""
+
+    def __init__(self, coeff=0.0):
+        self._regularization_coeff = float(coeff)
+        self._coeff = float(coeff)
+
+    def __call__(self, param):
+        return self._regularization_coeff * param
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self._regularization_coeff})"
+
+
+class L1Decay:
+    """Adds coeff * sign(param) to the gradient (lasso)."""
+
+    def __init__(self, coeff=0.0):
+        self._regularization_coeff = float(coeff)
+        self._coeff = float(coeff)
+
+    def __call__(self, param):
+        return self._regularization_coeff * jnp.sign(param)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self._regularization_coeff})"

@@ -634,13 +634,14 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         cd = (category_idxs._data if isinstance(category_idxs, Tensor)
               else jnp.asarray(category_idxs))
         # offset boxes per category so cross-category pairs never overlap
-        offs = (cd.astype(bd.dtype) * (bd.max() + 1.0))[:, None]
+        # (span-sized stride handles negative coordinates)
+        offs = (cd.astype(bd.dtype) * (bd.max() - bd.min() + 1.0))[:, None]
         bd = bd + offs
     order, keep = _nms_keep_mask(bd, sd, iou_threshold)
     kept = np.asarray(order)[np.asarray(keep)]
     if top_k is not None:
         kept = kept[:top_k]
-    return Tensor(jnp.asarray(kept, dtype=jnp.int64))
+    return Tensor(jnp.asarray(kept.astype(np.int64)))
 
 
 # ---------------------------------------------------------------------------

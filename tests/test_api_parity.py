@@ -1,0 +1,188 @@
+"""Top-level API parity vs the reference's paddle/__init__.py __all__ plus
+the small compat modules (distribution, regularizer, hub, reader, dataset,
+compat, metric.accuracy)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+rng = np.random.default_rng(3)
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+REFERENCE_TOP_LEVEL = """
+    abs acos add add_n addmm all allclose any arange argmax argmin argsort
+    asin atan batch bernoulli bincount bmm broadcast_shape broadcast_tensors
+    broadcast_to cast ceil check_shape cholesky chunk clip clone concat conj
+    cos cosh crop cross cumprod cumsum diag diagonal digamma disable_signal_handler
+    dist divide dot empty empty_like equal equal_all erf exp expand expand_as
+    eye flatten flip floor floor_divide full full_like gather gather_nd
+    greater_equal greater_than histogram imag increment index_sample
+    index_select inverse is_tensor isfinite isinf isnan kron less_equal
+    less_than lgamma linspace log log10 log1p log2 logical_and logical_not
+    logical_or logical_xor logsumexp masked_select matmul max maximum mean
+    median meshgrid min minimum mm mod multinomial multiply mv neg nonzero
+    norm normal not_equal numel ones ones_like pow prod rand randint randn
+    randperm rank real reciprocal remainder reshape reverse roll round rsqrt
+    scale scatter scatter_nd scatter_nd_add seed shape shard_index sign sin
+    sinh slice sort split sqrt square squeeze stack stanh std strided_slice
+    subtract sum t tanh tensordot tile to_tensor tolist topk trace transpose
+    tril triu unbind uniform unique unsqueeze unstack var where zeros
+    zeros_like
+"""
+
+
+class TestTopLevelNames:
+    @pytest.mark.parametrize("name", REFERENCE_TOP_LEVEL.split())
+    def test_name_exists(self, name):
+        assert getattr(paddle, name, None) is not None, name
+
+    def test_lazy_modules(self):
+        for mod in ("fft", "signal", "distribution", "regularizer", "hub",
+                    "dataset", "reader", "compat", "quantization"):
+            assert getattr(paddle, mod) is not None
+
+
+class TestDistribution:
+    def test_normal(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+
+        paddle.seed(0)
+        d = Normal(0.0, 2.0)
+        s = d.sample((5000,))
+        assert abs(float(np.mean(_np(s)))) < 0.15
+        assert abs(float(np.std(_np(s))) - 2.0) < 0.15
+        lp = d.log_prob(paddle.to_tensor(np.array([0.0], "float32")))
+        want = -np.log(2.0) - 0.5 * np.log(2 * np.pi)
+        np.testing.assert_allclose(_np(lp)[0], want, rtol=1e-5)
+        ent = d.entropy()
+        np.testing.assert_allclose(float(_np(ent)), 0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0), rtol=1e-6)
+        kl = kl_divergence(Normal(0.0, 1.0), Normal(0.0, 1.0))
+        np.testing.assert_allclose(float(_np(kl)), 0.0, atol=1e-6)
+        kl2 = kl_divergence(Normal(1.0, 1.0), Normal(0.0, 1.0))
+        np.testing.assert_allclose(float(_np(kl2)), 0.5, rtol=1e-5)
+
+    def test_uniform(self):
+        from paddle_tpu.distribution import Uniform
+
+        paddle.seed(1)
+        d = Uniform(1.0, 3.0)
+        s = _np(d.sample((2000,)))
+        assert s.min() >= 1.0 and s.max() < 3.0
+        np.testing.assert_allclose(float(_np(d.entropy())), np.log(2.0), rtol=1e-6)
+        lp = d.log_prob(paddle.to_tensor(np.array([2.0, 5.0], "float32")))
+        np.testing.assert_allclose(_np(lp)[0], -np.log(2.0), rtol=1e-6)
+        assert _np(lp)[1] == -np.inf
+
+    def test_categorical(self):
+        from paddle_tpu.distribution import Categorical
+
+        paddle.seed(2)
+        logits = np.log(np.array([0.2, 0.3, 0.5], "float32"))
+        d = Categorical(logits)
+        s = _np(d.sample((4000,)))
+        freq = np.bincount(s, minlength=3) / 4000
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.04)
+        lp = d.log_prob(paddle.to_tensor(np.array([2], "int64")))
+        np.testing.assert_allclose(_np(lp)[0], np.log(0.5), rtol=1e-5)
+        ent = float(_np(d.entropy()))
+        want = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5))
+        np.testing.assert_allclose(ent, want, rtol=1e-5)
+
+
+class TestRegularizer:
+    def test_l2_decay_in_optimizer(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.regularizer import L2Decay
+
+        paddle.seed(0)
+        lin = nn.Linear(2, 2, bias_attr=False)
+        w0 = _np(lin.weight).copy()
+        sgd = opt.SGD(learning_rate=0.1, parameters=lin.parameters(),
+                      weight_decay=L2Decay(0.5))
+        out = lin(paddle.to_tensor(np.zeros((1, 2), "float32")))
+        out.sum().backward()
+        sgd.step()
+        # grad is zero, so update = -lr * coeff * w
+        np.testing.assert_allclose(_np(lin.weight), w0 * (1 - 0.1 * 0.5),
+                                   rtol=1e-5)
+
+
+class TestHub:
+    def test_local_hub(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def toy_model(scale=2):\n"
+            "    'build a toy'\n"
+            "    return {'scale': scale}\n")
+        assert paddle.hub.list(str(tmp_path)) == ["toy_model"]
+        assert "toy" in paddle.hub.help(str(tmp_path), "toy_model")
+        assert paddle.hub.load(str(tmp_path), "toy_model", scale=7) == {"scale": 7}
+
+    def test_remote_rejected(self):
+        with pytest.raises(ValueError):
+            paddle.hub.list("some/repo", source="github")
+
+
+class TestReaderDecorators:
+    def test_pipeline(self):
+        r = paddle.reader.chain(lambda: iter([1, 2]), lambda: iter([3]))
+        assert list(r()) == [1, 2, 3]
+        r2 = paddle.reader.firstn(lambda: iter(range(100)), 5)
+        assert list(r2()) == [0, 1, 2, 3, 4]
+        r3 = paddle.reader.map_readers(lambda a, b: a + b,
+                                       lambda: iter([1, 2]), lambda: iter([10, 20]))
+        assert list(r3()) == [11, 22]
+        r4 = paddle.reader.buffered(lambda: iter(range(10)), 3)
+        assert list(r4()) == list(range(10))
+        r5 = paddle.reader.cache(lambda: iter([5, 6]))
+        assert list(r5()) == [5, 6] and list(r5()) == [5, 6]
+        r6 = paddle.reader.xmap_readers(lambda x: x * 2,
+                                        lambda: iter(range(8)), 3, 4, order=True)
+        assert list(r6()) == [0, 2, 4, 6, 8, 10, 12, 14]
+        shuffled = sorted(paddle.reader.shuffle(lambda: iter(range(10)), 4)())
+        assert shuffled == list(range(10))
+
+    def test_batch(self):
+        b = paddle.batch(lambda: iter(range(7)), 3)
+        assert [len(x) for x in b()] == [3, 3, 1]
+        b2 = paddle.batch(lambda: iter(range(7)), 3, drop_last=True)
+        assert [len(x) for x in b2()] == [3, 3]
+
+
+class TestCompat:
+    def test_text_bytes(self):
+        assert paddle.compat.to_text(b"abc") == "abc"
+        assert paddle.compat.to_bytes("abc") == b"abc"
+        assert paddle.compat.to_text([b"a", {b"k": b"v"}]) == ["a", {"k": "v"}]
+        assert paddle.compat.round(2.5) == 3.0
+        assert paddle.compat.round(-2.5) == -3.0
+
+
+class TestDatasetNamespace:
+    def test_legacy_module_shape(self):
+        m = paddle.dataset.mnist
+        assert callable(m.train) and callable(m.test)
+
+
+class TestAsyncCollectives:
+    def test_all_gather_object_single(self):
+        import paddle_tpu.distributed as dist
+
+        out = []
+        dist.all_gather_object(out, {"rank": 0, "data": [1, 2]})
+        assert out == [{"rank": 0, "data": [1, 2]}]
+
+    def test_isend_irecv_handles(self):
+        import paddle_tpu.distributed as dist
+
+        t = paddle.to_tensor(np.ones(2, "float32"))
+        task = dist.isend(t, dst=0)
+        assert task.is_completed()
+        task.wait()

@@ -1,0 +1,132 @@
+"""QAT / fake-quant parity tests (reference:
+unittests/test_fake_quantize_op.py, test_imperative_qat.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import quantization as Q
+
+
+rng = np.random.default_rng(9)
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+class TestFakeQuantOps:
+    def test_abs_max(self):
+        x = rng.standard_normal((4, 5)).astype("float32")
+        out, scale = Q.fake_quantize_abs_max(paddle.to_tensor(x), 8)
+        s = np.abs(x).max()
+        np.testing.assert_allclose(_np(scale), s, rtol=1e-6)
+        want = np.clip(np.round(x / s * 127), -127, 127) * s / 127
+        np.testing.assert_allclose(_np(out), want, rtol=1e-5, atol=1e-6)
+        # quantization error bounded by half a level
+        assert np.abs(_np(out) - x).max() <= s / 127
+
+    def test_channel_wise(self):
+        w = rng.standard_normal((6, 3, 2, 2)).astype("float32")
+        out, scales = Q.fake_channel_wise_quantize_abs_max(
+            paddle.to_tensor(w), 8, quant_axis=0)
+        assert _np(scales).shape == (6,)
+        for c in range(6):
+            s = np.abs(w[c]).max()
+            np.testing.assert_allclose(_np(scales)[c], s, rtol=1e-6)
+            want = np.clip(np.round(w[c] / s * 127), -127, 127) * s / 127
+            np.testing.assert_allclose(_np(out)[c], want, rtol=1e-5, atol=1e-6)
+
+    def test_moving_average(self):
+        x1 = paddle.to_tensor(np.array([2.0, -4.0], "float32"))
+        state = paddle.to_tensor(np.asarray(1.0, dtype="float32"))
+        out, new_scale = Q.fake_quantize_moving_average_abs_max(
+            x1, state, 8, moving_rate=0.9)
+        np.testing.assert_allclose(_np(new_scale), 0.9 * 1.0 + 0.1 * 4.0, rtol=1e-6)
+        # eval mode: scale frozen
+        out2, frozen = Q.fake_quantize_moving_average_abs_max(
+            x1, new_scale, 8, moving_rate=0.9, training=False)
+        np.testing.assert_allclose(_np(frozen), _np(new_scale))
+
+    def test_ste_gradient(self):
+        x = paddle.to_tensor(rng.standard_normal((3, 3)).astype("float32"))
+        x.stop_gradient = False
+        out, _ = Q.fake_quantize_abs_max(x, 8)
+        out.sum().backward()
+        # straight-through: gradient of sum is all-ones
+        np.testing.assert_allclose(_np(x.grad), np.ones((3, 3)), rtol=1e-6)
+
+    def test_lower_bits(self):
+        x = rng.standard_normal((8,)).astype("float32")
+        out4, _ = Q.fake_quantize_abs_max(paddle.to_tensor(x), 4)
+        uniq = np.unique(_np(out4))
+        assert len(uniq) <= 15  # 4-bit signed: at most 15 levels
+
+
+class TestQATTraining:
+    def _make_model(self):
+        paddle.seed(1)
+        return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+
+    def test_quantize_replaces_layers(self):
+        model = self._make_model()
+        qat = Q.ImperativeQuantAware(weight_quantize_type="channel_wise_abs_max")
+        qat.quantize(model)
+        kinds = [type(m).__name__ for m in model.sublayers()]
+        assert kinds.count("QuantizedLinear") == 2
+
+    def test_skip_quant(self):
+        model = self._make_model()
+        model[0].skip_quant = True
+        Q.ImperativeQuantAware().quantize(model)
+        assert type(model[0]).__name__ == "Linear"
+        assert type(model[2]).__name__ == "QuantizedLinear"
+
+    def test_qat_trains_and_tracks_scales(self):
+        model = self._make_model()
+        Q.ImperativeQuantAware().quantize(model)
+        adam = opt.Adam(learning_rate=0.01, parameters=model.parameters())
+        X = rng.standard_normal((128, 8)).astype("float32")
+        W = rng.standard_normal((8, 1)).astype("float32")
+        Y = X @ W
+        first = last = None
+        for _ in range(100):
+            pred = model(paddle.to_tensor(X))
+            loss = ((pred - paddle.to_tensor(Y)) ** 2).mean()
+            loss.backward()
+            adam.step()
+            adam.clear_grad()
+            v = float(_np(loss))
+            first = v if first is None else first
+            last = v
+        assert last < 0.3 * first, (first, last)
+        # activation scale settled near the input abs-max
+        assert abs(model[0].act_scale - np.abs(X).max()) < 1.5
+
+    def test_state_dict_roundtrip(self):
+        model = self._make_model()
+        Q.ImperativeQuantAware().quantize(model)
+        model(paddle.to_tensor(rng.standard_normal((4, 8)).astype("float32")))
+        sd = model.state_dict()
+        model2 = self._make_model()
+        Q.ImperativeQuantAware().quantize(model2)
+        model2.set_state_dict(sd)
+        for (n1, p1), (n2, p2) in zip(model.named_parameters(),
+                                      model2.named_parameters()):
+            np.testing.assert_allclose(_np(p1), _np(p2))
+
+
+class TestPTQ:
+    def test_calibration_freezes_scales(self):
+        paddle.seed(2)
+        model = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+        data = [(paddle.to_tensor(rng.standard_normal((16, 4)).astype("float32")),)
+                for _ in range(10)]
+        ptq = Q.PostTrainingQuantization(model, data, batch_nums=8)
+        qmodel = ptq.quantize()
+        scale_after_cal = qmodel[0].act_scale
+        assert scale_after_cal > 0
+        # further eval passes do not move the scale
+        qmodel.eval()
+        qmodel(paddle.to_tensor(100 * rng.standard_normal((16, 4)).astype("float32")))
+        assert qmodel[0].act_scale == scale_after_cal
