@@ -3,6 +3,7 @@
 Parity: python/paddle/nn/__init__.py surface of the reference.
 """
 from . import functional  # noqa: F401
+from . import utils  # noqa: F401
 from . import initializer  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .functional_attention import scaled_dot_product_attention  # noqa: F401
@@ -15,6 +16,19 @@ from .layers.norm import *  # noqa: F401,F403
 from .layers.pooling import *  # noqa: F401,F403
 from .layers.rnn import *  # noqa: F401,F403
 from .layers.transformer import *  # noqa: F401,F403
+from .layers.extras import (  # noqa: F401
+    Bilinear,
+    LayerDict,
+    MaxUnPool2D,
+    PairwiseDistance,
+    Unfold,
+)
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 
 initializer.set_global_initializer = lambda *a, **k: None  # parity stub
+
+# reference-name aliases
+from .layers.activation import SiLU as Silu  # noqa: E402,F401
+from .layers.rnn import _RNNCellBase as RNNCellBase  # noqa: E402,F401
+from .layers import loss  # noqa: E402,F401

@@ -1,0 +1,126 @@
+"""Beam-search decoding (parity: fluid/layers/rnn.py BeamSearchDecoder +
+dynamic_decode in the reference, backed there by the beam_search /
+beam_search_decode / gather_tree ops).
+
+TPU-native notes: decoding is a host-driven loop over a jit-compiled step
+(each step is pure jnp through the framework's primitive funnel); the final
+backtrace reuses nn.functional.gather_tree. Scores use log-probabilities with
+the finished-beam convention of the reference: a finished beam can only
+extend with end_token at probability 1."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._primitive import unwrap, wrap
+from ..tensor import Tensor
+from . import functional as F
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+class BeamSearchDecoder:
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers ---------------------------------------------------------
+    def _merge(self, x):
+        """[batch, beam, ...] -> [batch*beam, ...]"""
+        arr = unwrap(x)
+        return wrap(arr.reshape((-1,) + arr.shape[2:]))
+
+    def _split(self, x):
+        arr = unwrap(x)
+        return wrap(arr.reshape((-1, self.beam_size) + arr.shape[1:]))
+
+    def _tile_beam(self, x):
+        arr = unwrap(x)
+        tiled = jnp.repeat(arr[:, None], self.beam_size, axis=1)
+        return tiled
+
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            lambda s: self._tile_beam(s).reshape((-1,) + unwrap(s).shape[1:]),
+            initial_cell_states, is_leaf=lambda v: isinstance(v, Tensor))
+        leaves = jax.tree_util.tree_leaves(
+            initial_cell_states, is_leaf=lambda v: isinstance(v, Tensor))
+        batch = unwrap(leaves[0]).shape[0]
+        log_probs = jnp.full((batch, self.beam_size), -1e9, jnp.float32)
+        log_probs = log_probs.at[:, 0].set(0.0)
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        lengths = jnp.zeros((batch, self.beam_size), jnp.int64)
+        tokens = jnp.full((batch, self.beam_size), self.start_token, jnp.int64)
+        return states, (log_probs, finished, lengths), tokens
+
+    def step(self, tokens, cell_states, beam_state):
+        log_probs, finished, lengths = beam_state
+        batch = log_probs.shape[0]
+        inputs = wrap(tokens.reshape(-1))
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(inputs)
+        cell_out, next_states = self.cell(inputs, cell_states)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = unwrap(cell_out).astype(jnp.float32)  # [batch*beam, V]
+        vocab = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(logits, -1).reshape(batch, self.beam_size, vocab)
+        # finished beams: only end_token continues, at log-prob 0
+        fin_row = jnp.full((vocab,), -1e9, jnp.float32).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[:, :, None], fin_row[None, None, :], step_lp)
+        scores = (log_probs[:, :, None] + step_lp).reshape(batch, -1)
+        top_scores, top_idx = jax.lax.top_k(scores, self.beam_size)
+        parents = (top_idx // vocab).astype(jnp.int64)
+        new_tokens = (top_idx % vocab).astype(jnp.int64)
+        was_finished = jnp.take_along_axis(finished, parents, axis=1)
+        new_finished = was_finished | (new_tokens == self.end_token)
+        new_lengths = jnp.take_along_axis(lengths, parents, axis=1) + \
+            (~was_finished).astype(jnp.int64)
+
+        # regroup cell states by parent beam
+        def regroup(s):
+            arr = unwrap(s).reshape((batch, self.beam_size) + unwrap(s).shape[1:])
+            idx = parents.reshape(parents.shape + (1,) * (arr.ndim - 2))
+            out = jnp.take_along_axis(arr, idx.astype(jnp.int32), axis=1)
+            return wrap(out.reshape((-1,) + arr.shape[2:]))
+
+        next_states = jax.tree_util.tree_map(
+            regroup, next_states, is_leaf=lambda v: isinstance(v, Tensor))
+        return (new_tokens, parents,
+                next_states, (top_scores, new_finished, new_lengths))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, return_length=False, **kwargs):
+    """Run the decoder until every beam finishes or max_step_num steps
+    (parity: fluid.layers.dynamic_decode). Returns (ids, scores) with ids of
+    shape [batch, T, beam] ([T, batch, beam] when time-major), plus lengths
+    when return_length=True."""
+    if max_step_num is None:
+        max_step_num = 256
+    cell_states, beam_state, tokens = decoder.initialize(inits)
+    step_ids, step_parents = [], []
+    for _ in range(int(max_step_num)):
+        tokens, parents, cell_states, beam_state = decoder.step(
+            tokens, cell_states, beam_state)
+        step_ids.append(tokens)
+        step_parents.append(parents)
+        if bool(np.asarray(beam_state[1]).all()):
+            break
+    ids = jnp.stack(step_ids)       # [T, batch, beam]
+    parents = jnp.stack(step_parents)
+    full = F.gather_tree(wrap(ids), wrap(parents))  # backtraced beams
+    out = unwrap(full)
+    if not output_time_major:
+        out = jnp.transpose(out, (1, 0, 2))
+    scores = beam_state[0]
+    if return_length:
+        return wrap(out), wrap(scores), wrap(beam_state[2])
+    return wrap(out), wrap(scores)

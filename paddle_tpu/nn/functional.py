@@ -429,7 +429,52 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_m
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCHW"):
+    if return_mask:
+        if data_format != "NCHW":
+            raise ValueError("return_mask requires NCHW")
+        return _max_pool2d_with_index(x, kernel_size, stride, padding, ceil_mode)
     return _pool_nd(x, kernel_size, stride, padding, 2, data_format, "max", ceil_mode=ceil_mode)
+
+
+def _max_pool2d_with_index(x, kernel_size, stride, padding, ceil_mode=False):
+    """max_pool2d returning flat-spatial argmax indices (parity:
+    max_pool2d_with_index op — the indices max_unpool2d consumes)."""
+    ks = _norm_tuple(kernel_size, 2)
+    st = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    pd = _norm_tuple(padding, 2)
+
+    @primitive(aux=1)
+    def _pool_idx(x):
+        n, c, h, w = x.shape
+        if ceil_mode:
+            hout = -((h + 2 * pd[0] - ks[0]) // -st[0]) + 1
+            wout = -((w + 2 * pd[1] - ks[1]) // -st[1]) + 1
+        else:
+            hout = (h + 2 * pd[0] - ks[0]) // st[0] + 1
+            wout = (w + 2 * pd[1] - ks[1]) // st[1] + 1
+        # window gather: positions (hout, kh) x (wout, kw) in padded coords
+        hy = jnp.arange(hout)[:, None] * st[0] + jnp.arange(ks[0])[None, :] - pd[0]
+        wx = jnp.arange(wout)[:, None] * st[1] + jnp.arange(ks[1])[None, :] - pd[1]
+        valid = ((hy >= 0) & (hy < h))[:, None, :, None] & ((wx >= 0) & (wx < w))[None, :, None, :]
+        hc = jnp.clip(hy, 0, h - 1)
+        wc = jnp.clip(wx, 0, w - 1)
+        win = x[:, :, hc[:, None, :, None], wc[None, :, None, :]]  # (n,c,hout,wout,kh,kw)
+        neg = jnp.asarray(-3.4e38, x.dtype)
+        win = jnp.where(valid[None, None], win, neg)
+        flat = win.reshape(n, c, hout, wout, ks[0] * ks[1])
+        out = flat.max(-1)
+        kbest = jnp.argmax(flat, axis=-1)
+        ky, kx = kbest // ks[1], kbest % ks[1]
+        src_h = jnp.take_along_axis(
+            jnp.broadcast_to(hc[None, None, :, None, :], (n, c, hout, wout, ks[0])),
+            ky[..., None], axis=-1)[..., 0]
+        src_w = jnp.take_along_axis(
+            jnp.broadcast_to(wc[None, None, None, :, :], (n, c, hout, wout, ks[1])),
+            kx[..., None], axis=-1)[..., 0]
+        idx = (src_h * w + src_w).astype(jnp.int32)
+        return out, idx
+
+    return _pool_idx(x)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCDHW"):
@@ -1130,3 +1175,422 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,  # noqa: A
     if normalized:
         dist = dist / jnp.maximum(ref_len.astype(jnp.float32), 1.0)
     return wrap(dist[:, None]), wrap(jnp.asarray(np.array([B], dtype=np.int64)))
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Inverse of max_pool2d(return_mask=True): scatter pooled values back
+    to their argmax positions (parity: unpool op, operators/unpool_op.*)."""
+    ks = _norm_tuple(kernel_size, 2)
+    st = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    pd = _norm_tuple(padding, 2)
+
+    @primitive
+    def _unpool(x, indices):
+        n, c, hout, wout = x.shape
+        if output_size is not None:
+            oh, ow = int(output_size[-2]), int(output_size[-1])
+        else:
+            oh = (hout - 1) * st[0] - 2 * pd[0] + ks[0]
+            ow = (wout - 1) * st[1] - 2 * pd[1] + ks[1]
+        flat = jnp.zeros((n, c, oh * ow), x.dtype)
+        idx = indices.reshape(n, c, hout * wout).astype(jnp.int32)
+        vals = x.reshape(n, c, hout * wout)
+        # assignment, not accumulation: overlapping windows sharing an argmax
+        # all carry the same source value (reference unpool writes out[idx]=v)
+        flat = flat.at[
+            jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None], idx
+        ].set(vals)
+        return flat.reshape(n, c, oh, ow)
+
+    return _unpool(x, indices)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    """x if x > threshold else 0 (parity: thresholded_relu op)."""
+
+    @primitive
+    def _tr(x):
+        return jnp.where(x > threshold, x, 0.0)
+
+    return _tr(x)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """Dice coefficient loss over the last (class-prob) axis (parity:
+    fluid.layers.dice_loss)."""
+
+    @primitive
+    def _dice(input, label):
+        lab = jax.nn.one_hot(label[..., 0].astype(jnp.int32), input.shape[-1],
+                             dtype=input.dtype)
+        red = tuple(range(1, input.ndim))
+        inter = (input * lab).sum(red)
+        union = input.sum(red) + lab.sum(red)
+        return 1.0 - ((2.0 * inter + epsilon) / (union + epsilon)).mean()
+
+    return _dice(input, unwrap(label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    """Negative log likelihood of a binary probability (parity: log_loss op)."""
+
+    @primitive
+    def _ll(input, label):
+        return (-label * jnp.log(input + epsilon)
+                - (1.0 - label) * jnp.log(1.0 - input + epsilon))
+
+    return _ll(input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair metric loss (parity: fluid.layers.npair_loss composition)."""
+
+    @primitive
+    def _npair(anchor, positive, labels):
+        lab = labels.reshape(-1)
+        batch = lab.shape[0]
+        same = (lab[:, None] == lab[None, :]).astype(anchor.dtype)
+        tgt = same / jnp.maximum(same.sum(-1, keepdims=True), 1.0)
+        logits = jnp.matmul(anchor, positive.T)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ce = -(tgt * logp).sum(-1).mean()
+        reg = (jnp.sum(anchor * anchor) + jnp.sum(positive * positive)) / batch
+        return ce + l2_reg * reg * 0.25
+
+    return _npair(anchor, positive, unwrap(labels))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    """Gumbel-softmax sampling with optional straight-through hard one-hot
+    (parity: gumbel_softmax op)."""
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(split_key(), unwrap(x).shape, jnp.float32, 1e-10, 1.0)))
+
+    @primitive
+    def _gs(x):
+        y = jax.nn.softmax((x + g.astype(x.dtype)) / temperature, axis=axis)
+        if hard:
+            oh = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis],
+                                axis=axis, dtype=y.dtype)
+            # straight-through: hard value, soft gradient
+            y = jax.lax.stop_gradient(oh - y) + y
+        return y
+
+    return _gs(x)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """TSM channel shift across the time axis (parity: temporal_shift op):
+    the first shift_ratio*C channels shift t-1, the next shift t+1."""
+    if data_format != "NCHW":
+        raise ValueError("temporal_shift supports NCHW")
+
+    @primitive
+    def _ts(x):
+        nt, c, h, w = x.shape
+        n = nt // seg_num
+        v = x.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        fwd = jnp.pad(v[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+        bwd = jnp.pad(v[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+        out = jnp.concatenate([fwd, bwd, v[:, :, c2:]], axis=2)
+        return out.reshape(nt, c, h, w)
+
+    return _ts(x)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Bilinear transform out[b, o] = x1[b] @ W[o] @ x2[b] (parity:
+    bilinear_tensor_product op)."""
+
+    @primitive
+    def _bl(x1, x2, weight, bias):
+        out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+        if bias is not None:
+            out = out + bias
+        return out
+
+    return _bl(x1, x2, weight, bias)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Affine sampling grid from 2x3 batch matrices (parity: affine_grid op).
+    Returns (N, H, W, 2) normalized coords."""
+    if not isinstance(out_shape, (list, tuple)):
+        out_shape = [int(v) for v in np.asarray(unwrap(out_shape))]
+    n, _, h, w = [int(v) for v in out_shape]
+
+    @primitive
+    def _ag(theta):
+        def axis_coords(size):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, size)
+            step = 2.0 / size
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+        ys = axis_coords(h)
+        xs = axis_coords(w)
+        gx, gy = jnp.meshgrid(xs, ys)  # (h, w)
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (h, w, 3)
+        return jnp.einsum("hwk,nck->nhwc", base, theta.astype(jnp.float32)
+                          ).astype(theta.dtype)
+
+    return _ag(theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x (N,C,H,W) at normalized grid coords (N,Hg,Wg,2) (parity:
+    grid_sampler op)."""
+
+    @primitive
+    def _gs(x, grid):
+        n, c, h, w = x.shape
+        gx = grid[..., 0].astype(jnp.float32)
+        gy = grid[..., 1].astype(jnp.float32)
+        if align_corners:
+            fx = (gx + 1.0) * (w - 1) / 2.0
+            fy = (gy + 1.0) * (h - 1) / 2.0
+        else:
+            fx = ((gx + 1.0) * w - 1.0) / 2.0
+            fy = ((gy + 1.0) * h - 1.0) / 2.0
+        if padding_mode == "border":
+            fx = jnp.clip(fx, 0, w - 1)
+            fy = jnp.clip(fy, 0, h - 1)
+        elif padding_mode == "reflection":
+            def reflect(v, size):
+                if align_corners:
+                    span = 2.0 * (size - 1)
+                    v = jnp.abs(jnp.mod(v, span))
+                    return jnp.where(v > size - 1, span - v, v)
+                span = 2.0 * size
+                v = jnp.abs(jnp.mod(v + 0.5, span))
+                v = jnp.where(v > size, span - v, v) - 0.5
+                return jnp.clip(v, 0, size - 1)
+
+            fx = reflect(fx, w)
+            fy = reflect(fy, h)
+
+        def sample_one(fm, yy, xx):
+            if mode == "nearest":
+                xi = jnp.round(xx).astype(jnp.int32)
+                yi = jnp.round(yy).astype(jnp.int32)
+                inb = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+                xi = jnp.clip(xi, 0, w - 1)
+                yi = jnp.clip(yi, 0, h - 1)
+                return jnp.where(inb[None], fm[:, yi, xi], 0.0)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            lx, ly = xx - x0, yy - y0
+
+            def tap(yi, xi, wgt):
+                inb = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+                v = fm[:, jnp.clip(yi, 0, h - 1), jnp.clip(xi, 0, w - 1)]
+                return jnp.where(inb[None], v, 0.0) * wgt[None]
+
+            return (tap(y0, x0, (1 - ly) * (1 - lx)) + tap(y0, x1, (1 - ly) * lx)
+                    + tap(y1, x0, ly * (1 - lx)) + tap(y1, x1, ly * lx))
+
+        return jax.vmap(sample_one)(x, fy, fx).astype(x.dtype)
+
+    return _gs(x, grid)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """Combined-margin softmax CE over cosine logits (parity:
+    margin_cross_entropy op, operators/margin_cross_entropy_op.cu —
+    ArcFace/CosFace family: target logit cos(m1*theta + m2) - m3)."""
+
+    @primitive
+    def _mce(logits, label):
+        lab = label.reshape(-1).astype(jnp.int32)
+        cos = jnp.clip(logits, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        oh = jax.nn.one_hot(lab, logits.shape[-1], dtype=logits.dtype)
+        out = jnp.where(oh > 0, tgt, cos) * scale
+        logp = jax.nn.log_softmax(out, axis=-1)
+        loss = -jnp.take_along_axis(logp, lab[:, None], axis=-1)
+        sm = jnp.exp(logp)
+        return loss, sm
+
+    loss, sm = _mce(logits, unwrap(label))
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample class centers: all positive classes plus random negatives up
+    to num_samples; labels remapped into the sampled list (parity:
+    class_center_sample op). Host-side sampling (eager data-prep op)."""
+    lab = np.asarray(unwrap(label)).reshape(-1)
+    pos = np.unique(lab)
+    if len(pos) > num_samples:
+        raise ValueError(
+            f"num_samples ({num_samples}) is smaller than the number of "
+            f"distinct positive classes in label ({len(pos)}); every positive "
+            "class must be kept")
+    if len(pos) == num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos, assume_unique=False)
+        rng_local = np.random.default_rng(int(np.abs(lab).sum()) + num_classes)
+        extra = rng_local.choice(neg_pool, num_samples - len(pos), replace=False)
+        sampled = np.concatenate([pos, np.sort(extra)])
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (wrap(jnp.asarray(remap[lab])), wrap(jnp.asarray(sampled.astype(np.int64))))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     name=None):
+    """Block-sparse attention given a CSR pattern (parity: sparse_attention
+    op, operators/sparse_attention_op.cu). TPU-native: the CSR pattern is
+    densified to an additive mask once (host side) and the product runs
+    through the fused XLA softmax path — HBM-efficient sparse kernels are
+    the flash/ring Pallas paths; this op exists for API parity."""
+    offs = np.asarray(unwrap(sparse_csr_offset))
+    cols = np.asarray(unwrap(sparse_csr_columns))
+    T = int(unwrap(query).shape[-2])
+    # build (..., T, T) mask from CSR in one vectorized shot: the row of
+    # nonzero j is the number of offset entries <= j, minus one
+    lead = offs.shape[:-1]
+    nnz = cols.shape[-1]
+    j = np.arange(nnz)
+    rows = (offs[..., :-1, None] <= j).sum(axis=-2) - 1  # (..., nnz)
+    valid = (j < offs[..., -1:])  # entries beyond offs[-1] are padding
+    # extra scrap slot absorbs padding writes without clobbering cell 0
+    mask = np.zeros(lead + (T * T + 1,), bool)
+    flat_idx = np.where(valid, np.clip(rows, 0, T - 1) * T + cols, T * T)
+    np.put_along_axis(mask, flat_idx, True, axis=-1)
+    mask = mask[..., : T * T].reshape(lead + (T, T))
+    amask = jnp.where(jnp.asarray(mask), 0.0, -1e9)
+
+    @primitive
+    def _sa(q, k, v):
+        s = jnp.einsum("...td,...sd->...ts", q, k) / math.sqrt(q.shape[-1])
+        w = jax.nn.softmax(s + amask.astype(s.dtype), axis=-1)
+        return jnp.einsum("...ts,...sd->...td", w, v)
+
+    return _sa(query, key, value)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               num_heads=None, name=None):
+    """Functional fused MHA block (parity: fused_attention op,
+    operators/fused/fused_attention_op.cu — LN + qkv matmul + attention +
+    out-proj + residual + LN, one graph for XLA to fuse)."""
+    from .functional_attention import scaled_dot_product_attention
+
+    residual = x
+    if pre_layer_norm:
+        x = layer_norm(x, x.shape[-1:], pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    qw = unwrap(qkv_weight)
+    # accept (3, H, D, hidden) paddle layout or (hidden, 3*hidden)
+    if qw.ndim == 4:
+        three, heads, hd, hidden = qw.shape
+
+        @primitive
+        def _qkv(x, qw, qb):
+            out = jnp.einsum("bth,nkdh->btnkd", x, qw)  # n=3, k=heads
+            if qb is not None:
+                out = out + qb.reshape(1, 1, three, heads, hd)
+            return out
+
+        qkv_out = _qkv(x, qkv_weight, qkv_bias)
+        from ..ops import manipulation as manip
+
+        q = manip.transpose(qkv_out[:, :, 0], [0, 2, 1, 3])
+        k = manip.transpose(qkv_out[:, :, 1], [0, 2, 1, 3])
+        v = manip.transpose(qkv_out[:, :, 2], [0, 2, 1, 3])
+    else:
+        hidden = qw.shape[0]
+        heads = num_heads
+        if heads is None:
+            raise ValueError("num_heads required with 2-D qkv_weight")
+        hd = hidden // heads
+        from ..ops import manipulation as manip
+
+        qkv_out = linear(x, qkv_weight, qkv_bias)
+        b, t = qkv_out.shape[0], qkv_out.shape[1]
+        qkv_out = manip.reshape(qkv_out, [b, t, 3, heads, hd])
+        qkv_out = manip.transpose(qkv_out, [2, 0, 3, 1, 4])
+        q, k, v = qkv_out[0], qkv_out[1], qkv_out[2]
+    out, _ = scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate)
+    from ..ops import manipulation as manip
+
+    b, t = out.shape[0], out.shape[2]
+    out = manip.transpose(out, [0, 2, 1, 3])
+    out = manip.reshape(out, [b, t, -1])
+    out = linear(out, linear_weight, linear_bias)
+    if dropout_rate:
+        out = dropout(out, p=dropout_rate)
+    out = residual + out
+    if not pre_layer_norm:
+        out = layer_norm(out, out.shape[-1:], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Functional hierarchical sigmoid (parity: hierarchical_sigmoid op,
+    default complete-binary-tree mode)."""
+
+    @primitive
+    def _hs(input, label, weight, bias):
+        # complete binary tree over num_classes leaves: internal nodes
+        # num_classes-1; path of leaf c = bits of (c + num_classes) walk
+        code_len = int(np.ceil(np.log2(max(num_classes, 2))))
+        lab = label.reshape(-1).astype(jnp.int32)
+        node = lab + num_classes
+        losses = jnp.zeros(lab.shape, input.dtype)
+        for _ in range(code_len):
+            parent = node // 2
+            bit = (node % 2).astype(input.dtype)  # 1 = right child
+            valid = parent >= 1
+            w = weight[jnp.clip(parent - 1, 0, weight.shape[0] - 1)]
+            logit = jnp.einsum("bh,bh->b", input, w)
+            if bias is not None:
+                logit = logit + bias[jnp.clip(parent - 1, 0, bias.shape[0] - 1)].reshape(-1)
+            step_loss = jnp.maximum(logit, 0) - logit * bit + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+            losses = losses + jnp.where(valid, step_loss, 0.0)
+            node = parent
+        return losses[:, None]  # per-sample [N, 1] (reference hsigmoid_loss)
+
+    return _hs(input, unwrap(label), weight, bias)
+
+
+# in-place activation variants (parity: paddle's *_ inplace APIs)
+def relu_(x):
+    x._set_data(jax.nn.relu(x._data))
+    return x
+
+
+def elu_(x, alpha=1.0):
+    x._set_data(jax.nn.elu(x._data, alpha))
+    return x
+
+
+def softmax_(x, axis=-1):
+    x._set_data(jax.nn.softmax(x._data, axis=axis))
+    return x
+
+
+# paddle.nn.functional re-exports of tensor ops sharing one implementation
+from ..ops.manipulation import pad  # noqa: E402,F401
+from ..ops.math import tanh_  # noqa: E402,F401

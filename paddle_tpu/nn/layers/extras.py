@@ -1,0 +1,135 @@
+"""Small layers completing the reference nn surface: Bilinear,
+PairwiseDistance, MaxUnPool2D, Unfold, LayerDict (parity:
+python/paddle/nn/layer/common.py Bilinear/Unfold, distance.py
+PairwiseDistance, pooling.py MaxUnPool2D, container.py LayerDict)."""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from .. import functional as F
+from .. import initializer as init_mod
+from ..layer import Layer
+
+__all__ = ["Bilinear", "PairwiseDistance", "MaxUnPool2D", "Unfold", "LayerDict"]
+
+
+class Bilinear(Layer):
+    """out = x1 @ W @ x2 + b per output feature."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        bound = float(np.sqrt(1.0 / in1_features))
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features], attr=weight_attr,
+            default_initializer=init_mod.Uniform(-bound, bound))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter([out_features], attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between paired rows."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        import jax.numpy as jnp
+
+        from ...ops._primitive import primitive
+
+        p, eps, keep = self.p, self.epsilon, self.keepdim
+
+        @primitive
+        def _pd(x, y):
+            d = x - y + eps
+            return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keep) ** (1.0 / p)
+
+        return _pd(x, y)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, output_size, data_format)
+
+    def forward(self, x, indices):
+        ks, st, pd, osz, df = self._args
+        return F.max_unpool2d(x, indices, ks, st, pd, osz, df)
+
+
+class Unfold(Layer):
+    """im2col sliding-window extraction (layer over F.unfold)."""
+
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+        super().__init__()
+        self._args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        ks, st, pd, dl = self._args
+        return F.unfold(x, ks, st, pd, dl)
+
+
+class LayerDict(Layer):
+    """Ordered string->Layer container (parity: nn.LayerDict)."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, sublayer):
+        self.add_sublayer(key, sublayer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        v = self._sub_layers[key]
+        del self._sub_layers[key]
+        return v
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def update(self, sublayers):
+        if isinstance(sublayers, (OrderedDict, dict)):
+            items = sublayers.items()
+        else:
+            items = sublayers
+        for k, v in items:
+            self[k] = v
+        return self

@@ -1,0 +1,89 @@
+"""paddle_tpu.nn.utils — weight reparameterization helpers.
+
+Parity: python/paddle/nn/utils/ in the reference (weight_norm.py,
+spectral_norm_hook.py): wrap a layer's weight parameter so every forward
+recomputes it from the reparameterized form.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops._primitive import primitive, unwrap
+from ..tensor import Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm"]
+
+
+def _norm_except(w, dim):
+    red = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=red, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize ``layer.<name>`` as g * v / ||v|| (weight_norm op
+    parity). Registers <name>_g and <name>_v; forward recomputes weight."""
+    w = getattr(layer, name)
+    dim = dim if dim is not None else 0
+    arr = unwrap(w)
+    g0 = _norm_except(arr, dim)
+    v = Tensor(arr, stop_gradient=False)
+    g = Tensor(g0, stop_gradient=False)
+    del layer._parameters[name]
+    layer._parameters[name + "_v"] = v
+    layer._parameters[name + "_g"] = g
+
+    orig_forward = layer.forward
+
+    @primitive
+    def _compose(v, g):
+        return g * v / jnp.maximum(_norm_except(v, dim), 1e-12)
+
+    def forward(*args, **kwargs):
+        object.__setattr__(layer, "_wn_cache", _compose(
+            layer._parameters[name + "_v"], layer._parameters[name + "_g"]))
+        layer.__dict__[name] = layer._wn_cache
+        try:
+            return orig_forward(*args, **kwargs)
+        finally:
+            layer.__dict__.pop(name, None)
+
+    layer.forward = forward
+    layer._wn_name, layer._wn_dim, layer._wn_orig_forward = name, dim, orig_forward
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a plain weight parameter."""
+    v = layer._parameters.pop(name + "_v")
+    g = layer._parameters.pop(name + "_g")
+    dim = layer._wn_dim
+    w = unwrap(g) * unwrap(v) / jnp.maximum(_norm_except(unwrap(v), dim), 1e-12)
+    layer._parameters[name] = Tensor(w, stop_gradient=False)
+    layer.forward = layer._wn_orig_forward
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    """Divide ``layer.<name>`` by its largest singular value each forward
+    (spectral_norm op parity; power iteration state persists on the layer)."""
+    from .layers.norm import SpectralNorm
+
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = SpectralNorm(list(unwrap(w).shape), dim=dim,
+                      power_iters=n_power_iterations, eps=eps)
+    layer.add_sublayer(name + "_spectral_norm", sn)
+    orig_forward = layer.forward
+    base = layer._parameters.pop(name)
+    layer._parameters[name + "_orig"] = base
+
+    def forward(*args, **kwargs):
+        layer.__dict__[name] = sn(layer._parameters[name + "_orig"])
+        try:
+            return orig_forward(*args, **kwargs)
+        finally:
+            layer.__dict__.pop(name, None)
+
+    layer.forward = forward
+    return layer

@@ -50,10 +50,25 @@ def chain(*readers):
     return chained
 
 
+class ComposeNotAligned(ValueError):
+    """Raised when composed readers yield different sample counts
+    (reference reader/decorator.py ComposeNotAligned)."""
+
+
 def compose(*readers, check_alignment=True):
     def composed():
+        end = object()
         iters = [r() for r in readers]
-        for items in zip(*iters):
+        while True:
+            items = [next(it, end) for it in iters]
+            done = [it is end for it in items]
+            if all(done):
+                return
+            if any(done):
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned (different lengths)")
+                return
             out = []
             for it in items:
                 if isinstance(it, tuple):

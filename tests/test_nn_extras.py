@@ -1,0 +1,325 @@
+"""Tests for the nn-surface completion batch: unpool, grid_sample,
+affine_grid, gumbel_softmax, temporal_shift, bilinear, margin CE,
+class_center_sample, sparse_attention, fused MHA, inplace activations,
+LayerDict, weight/spectral norm utils, beam-search decode."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+rng = np.random.default_rng(17)
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+class TestUnpool:
+    def test_pool_mask_roundtrip(self):
+        x = rng.standard_normal((2, 3, 8, 8)).astype("float32")
+        out, idx = F.max_pool2d(paddle.to_tensor(x), 2, return_mask=True)
+        assert tuple(out.shape) == (2, 3, 4, 4)
+        # indices point at the argmax source elements
+        flat = x.reshape(2, 3, 64)
+        picked = np.take_along_axis(flat, _np(idx).reshape(2, 3, 16), axis=2)
+        np.testing.assert_allclose(picked.reshape(2, 3, 4, 4), _np(out))
+        # unpool scatters back to those positions
+        up = F.max_unpool2d(out, idx, 2)
+        assert tuple(up.shape) == (2, 3, 8, 8)
+        nz = _np(up) != 0
+        assert nz.sum() <= 2 * 3 * 16
+        np.testing.assert_allclose(_np(up).sum(), _np(out).sum(), rtol=1e-5)
+
+    def test_pool_mask_with_padding(self):
+        x = rng.standard_normal((1, 1, 5, 5)).astype("float32")
+        out, idx = F.max_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1,
+                                return_mask=True)
+        ref = F.max_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1)
+        np.testing.assert_allclose(_np(out), _np(ref), rtol=1e-6)
+
+
+class TestGridSample:
+    def test_identity_grid(self):
+        x = rng.standard_normal((1, 2, 6, 6)).astype("float32")
+        theta = np.array([[[1, 0, 0], [0, 1, 0]]], "float32")
+        grid = F.affine_grid(paddle.to_tensor(theta), [1, 2, 6, 6])
+        out = F.grid_sample(paddle.to_tensor(x), grid)
+        np.testing.assert_allclose(_np(out), x, rtol=1e-4, atol=1e-5)
+
+    def test_translation(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        # shift sampling one pixel right: out[..., j] = x[..., j+1]
+        theta = np.array([[[1, 0, 2.0 / 3.0], [0, 1, 0]]], "float32")
+        grid = F.affine_grid(paddle.to_tensor(theta), [1, 1, 4, 4])
+        out = F.grid_sample(paddle.to_tensor(x), grid)
+        np.testing.assert_allclose(_np(out)[0, 0, :, :3], x[0, 0, :, 1:],
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_nearest_and_border(self):
+        x = rng.standard_normal((1, 1, 4, 4)).astype("float32")
+        g = np.zeros((1, 2, 2, 2), "float32")
+        g[..., 0] = 3.0  # far outside
+        out_z = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g))
+        assert np.allclose(_np(out_z), 0.0)
+        out_b = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g),
+                              padding_mode="border")
+        assert not np.allclose(_np(out_b), 0.0)
+        out_n = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g),
+                              mode="nearest", padding_mode="border")
+        assert np.isfinite(_np(out_n)).all()
+
+
+class TestMiscFunctional:
+    def test_gumbel_softmax(self):
+        paddle.seed(0)
+        x = paddle.to_tensor(rng.standard_normal((4, 6)).astype("float32"))
+        y = F.gumbel_softmax(x, temperature=0.5)
+        np.testing.assert_allclose(_np(y).sum(-1), np.ones(4), rtol=1e-5)
+        yh = F.gumbel_softmax(x, hard=True)
+        assert set(np.unique(_np(yh))).issubset({0.0, 1.0})
+        np.testing.assert_allclose(_np(yh).sum(-1), np.ones(4))
+
+    def test_temporal_shift(self):
+        nt, c, h, w = 4, 8, 2, 2  # n=2 segments of T=2
+        x = rng.standard_normal((nt, c, h, w)).astype("float32")
+        out = _np(F.temporal_shift(paddle.to_tensor(x), seg_num=2))
+        v = x.reshape(2, 2, c, h, w)
+        # fwd channels [0:2]: out[t] = v[t+1]; last t zero
+        np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[:, 0, :2], v[:, 1, :2])
+        assert np.allclose(out.reshape(2, 2, c, h, w)[:, 1, :2], 0)
+        # bwd channels [2:4]: out[t] = v[t-1]; first t zero
+        np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[:, 1, 2:4], v[:, 0, 2:4])
+        # rest unchanged
+        np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[:, :, 4:], v[:, :, 4:])
+
+    def test_bilinear_layer(self):
+        b = nn.Bilinear(3, 4, 5)
+        x1 = paddle.to_tensor(rng.standard_normal((2, 3)).astype("float32"))
+        x2 = paddle.to_tensor(rng.standard_normal((2, 4)).astype("float32"))
+        out = b(x1, x2)
+        assert tuple(out.shape) == (2, 5)
+        want = np.einsum("bi,oij,bj->bo", _np(x1), _np(b.weight), _np(x2)) + _np(b.bias)
+        np.testing.assert_allclose(_np(out), want, rtol=1e-4, atol=1e-5)
+
+    def test_pairwise_distance(self):
+        pd = nn.PairwiseDistance(p=2.0)
+        x = rng.standard_normal((3, 5)).astype("float32")
+        y = rng.standard_normal((3, 5)).astype("float32")
+        got = _np(pd(paddle.to_tensor(x), paddle.to_tensor(y)))
+        want = np.linalg.norm(x - y + 1e-6, axis=-1)
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_losses_sane(self):
+        probs = paddle.to_tensor(np.full((2, 3), 1 / 3, "float32"))
+        lab = paddle.to_tensor(np.array([[1], [2]], "int64"))
+        d = F.dice_loss(probs, lab)
+        assert 0 <= float(_np(d)) <= 1
+        p = paddle.to_tensor(np.array([0.9, 0.1], "float32"))
+        l = paddle.to_tensor(np.array([1.0, 0.0], "float32"))  # noqa: E741
+        ll = F.log_loss(p, l)
+        np.testing.assert_allclose(_np(ll), -np.log(np.array([0.9, 0.9]) + 1e-4),
+                                   rtol=1e-3)
+        anchor = paddle.to_tensor(rng.standard_normal((4, 8)).astype("float32"))
+        pos = paddle.to_tensor(rng.standard_normal((4, 8)).astype("float32"))
+        labels = paddle.to_tensor(np.array([0, 0, 1, 1], "int64"))
+        npl = F.npair_loss(anchor, pos, labels)
+        assert np.isfinite(float(_np(npl)))
+
+    def test_thresholded_relu(self):
+        x = paddle.to_tensor(np.array([-1.0, 0.5, 2.0], "float32"))
+        np.testing.assert_allclose(_np(F.thresholded_relu(x)), [0, 0, 2.0])
+
+    def test_inplace_variants(self):
+        x = paddle.to_tensor(np.array([-1.0, 1.0], "float32"))
+        F.relu_(x)
+        np.testing.assert_allclose(_np(x), [0.0, 1.0])
+        y = paddle.to_tensor(np.array([0.0, 1.0], "float32"))
+        F.softmax_(y)
+        np.testing.assert_allclose(_np(y).sum(), 1.0, rtol=1e-6)
+        z = paddle.to_tensor(np.array([0.5], "float32"))
+        F.tanh_(z)
+        np.testing.assert_allclose(_np(z), np.tanh(0.5), rtol=1e-6)
+        w = paddle.to_tensor(np.array([-1.0], "float32"))
+        F.elu_(w)
+        np.testing.assert_allclose(_np(w), np.expm1(-1.0), rtol=1e-5)
+
+
+class TestMarginCE:
+    def test_zero_margin_equals_softmax_ce(self):
+        cos = rng.uniform(-0.9, 0.9, (4, 10)).astype("float32")
+        lab = np.array([1, 3, 5, 7], "int64")
+        loss = F.margin_cross_entropy(paddle.to_tensor(cos),
+                                      paddle.to_tensor(lab), margin1=1.0,
+                                      margin2=0.0, margin3=0.0, scale=8.0,
+                                      reduction="none")
+        logits = cos * 8.0
+        lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        want = -lp[np.arange(4), lab]
+        np.testing.assert_allclose(_np(loss).reshape(-1), want, rtol=1e-4)
+
+    def test_margin_increases_loss(self):
+        cos = rng.uniform(-0.5, 0.5, (4, 10)).astype("float32")
+        lab = paddle.to_tensor(np.array([0, 1, 2, 3], "int64"))
+        l0 = F.margin_cross_entropy(paddle.to_tensor(cos), lab, margin2=0.0)
+        l1 = F.margin_cross_entropy(paddle.to_tensor(cos), lab, margin2=0.5)
+        assert float(_np(l1)) > float(_np(l0))
+
+    def test_class_center_sample(self):
+        lab = paddle.to_tensor(np.array([3, 7, 3, 11], "int64"))
+        remapped, sampled = F.class_center_sample(lab, num_classes=20,
+                                                  num_samples=8)
+        s = _np(sampled)
+        assert len(s) == 8 and {3, 7, 11}.issubset(set(s.tolist()))
+        r = _np(remapped)
+        for orig, rm in zip([3, 7, 3, 11], r):
+            assert s[rm] == orig
+
+
+class TestSparseAttention:
+    def test_full_csr_matches_dense(self):
+        B, H, T, D = 1, 2, 4, 8
+        q = rng.standard_normal((B, H, T, D)).astype("float32")
+        k = rng.standard_normal((B, H, T, D)).astype("float32")
+        v = rng.standard_normal((B, H, T, D)).astype("float32")
+        # full pattern: every row attends everything
+        offs = np.tile(np.arange(0, (T + 1) * T, T), (B, H, 1)).astype("int32")
+        cols = np.tile(np.tile(np.arange(T), T), (B, H, 1)).astype("int32")
+        out = F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                 paddle.to_tensor(v), paddle.to_tensor(offs),
+                                 paddle.to_tensor(cols))
+        s = np.einsum("bhtd,bhsd->bhts", q, k) / np.sqrt(D)
+        w = np.exp(s) / np.exp(s).sum(-1, keepdims=True)
+        want = np.einsum("bhts,bhsd->bhtd", w, v)
+        np.testing.assert_allclose(_np(out), want, rtol=1e-4, atol=1e-5)
+
+    def test_masked_rows(self):
+        B, H, T, D = 1, 1, 4, 4
+        q = rng.standard_normal((B, H, T, D)).astype("float32")
+        k = rng.standard_normal((B, H, T, D)).astype("float32")
+        v = rng.standard_normal((B, H, T, D)).astype("float32")
+        # each row attends only itself
+        offs = np.arange(T + 1, dtype="int32").reshape(1, 1, -1)
+        cols = np.arange(T, dtype="int32").reshape(1, 1, -1)
+        out = F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                 paddle.to_tensor(v), paddle.to_tensor(offs),
+                                 paddle.to_tensor(cols))
+        np.testing.assert_allclose(_np(out)[0, 0], v[0, 0], rtol=1e-4, atol=1e-5)
+
+
+class TestFusedMHA:
+    def test_matches_manual(self):
+        paddle.seed(0)
+        B, T, Hd, heads = 2, 5, 16, 4
+        x = rng.standard_normal((B, T, Hd)).astype("float32")
+        qkv_w = (rng.standard_normal((Hd, 3 * Hd)) * 0.1).astype("float32")
+        qkv_b = np.zeros(3 * Hd, "float32")
+        out_w = (rng.standard_normal((Hd, Hd)) * 0.1).astype("float32")
+        out_b = np.zeros(Hd, "float32")
+        got = F.fused_multi_head_attention(
+            paddle.to_tensor(x), paddle.to_tensor(qkv_w),
+            paddle.to_tensor(out_w), qkv_bias=paddle.to_tensor(qkv_b),
+            linear_bias=paddle.to_tensor(out_b), num_heads=heads,
+            ln_scale=paddle.to_tensor(np.ones(Hd, "float32")),
+            ln_bias=paddle.to_tensor(np.zeros(Hd, "float32")))
+        # manual: qkv -> attention -> proj -> residual -> LN
+        qkv = x @ qkv_w + qkv_b
+        qkv = qkv.reshape(B, T, 3, heads, Hd // heads).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        s = np.einsum("bhtd,bhsd->bhts", q, k) / np.sqrt(Hd // heads)
+        w = np.exp(s - s.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        att = np.einsum("bhts,bhsd->bhtd", w, v).transpose(0, 2, 1, 3).reshape(B, T, Hd)
+        y = x + (att @ out_w + out_b)
+        mu = y.mean(-1, keepdims=True)
+        var = y.var(-1, keepdims=True)
+        want = (y - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(_np(got), want, rtol=2e-3, atol=2e-3)
+
+
+class TestContainersAndUtils:
+    def test_layer_dict(self):
+        ld = nn.LayerDict({"a": nn.Linear(2, 2), "b": nn.ReLU()})
+        assert set(ld.keys()) == {"a", "b"}
+        assert "a" in ld and len(ld) == 2
+        ld["c"] = nn.Linear(2, 3)
+        assert isinstance(ld.pop("c"), nn.Linear)
+        # registered as sublayers -> parameters visible
+        assert len(list(ld.parameters())) == 2
+
+    def test_weight_norm(self):
+        lin = nn.Linear(4, 3)
+        w0 = _np(lin.weight).copy()
+        nn.utils.weight_norm(lin, dim=0)
+        names = dict(lin.named_parameters())
+        assert any(n.endswith("weight_g") for n in names)
+        x = paddle.to_tensor(rng.standard_normal((2, 4)).astype("float32"))
+        out1 = _np(lin(x))
+        # initial reparameterization reproduces the original weight
+        want = _np(x) @ w0 + _np(lin.bias)
+        np.testing.assert_allclose(out1, want, rtol=1e-4, atol=1e-5)
+        nn.utils.remove_weight_norm(lin)
+        np.testing.assert_allclose(_np(lin.weight), w0, rtol=1e-5, atol=1e-6)
+
+    def test_spectral_norm_util(self):
+        lin = nn.Linear(6, 4)
+        nn.utils.spectral_norm(lin, n_power_iterations=20)
+        x = paddle.to_tensor(rng.standard_normal((2, 6)).astype("float32"))
+        lin(x)
+        # after normalization the effective weight has unit top singular value
+        eff = _np(lin._parameters["weight_orig"])
+        sn_layer = lin._sub_layers["weight_spectral_norm"]
+        w_eff = _np(sn_layer(lin._parameters["weight_orig"]))
+        s = np.linalg.svd(w_eff, compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, rtol=0.05)
+
+
+class TestBeamSearch:
+    def test_beam1_equals_greedy(self):
+        """A deterministic 'cell' emitting fixed logits: beam size 1 must
+        reproduce greedy argmax decoding, ending at end_token."""
+        V = 6
+        chain = {0: 3, 3: 4, 4: 5, 5: 1}  # 1 = end token
+
+        class FixedCell:
+            def __call__(self, tokens, states):
+                t = _np(tokens).astype(int)
+                logits = np.full((len(t), V), -5.0, "float32")
+                for i, tok in enumerate(t):
+                    logits[i, chain.get(tok, 1)] = 5.0
+                return paddle.to_tensor(logits), states
+
+        dec = nn.BeamSearchDecoder(FixedCell(), start_token=0, end_token=1,
+                                   beam_size=1)
+        states = {"h": paddle.to_tensor(np.zeros((2, 3), "float32"))}
+        ids, scores = nn.dynamic_decode(dec, states, max_step_num=10)
+        seq = _np(ids)[0, :, 0].tolist()
+        assert seq[:4] == [3, 4, 5, 1]
+
+    def test_beam_finds_better_path(self):
+        """First step: token A slightly better than B, but B leads to a much
+        better continuation — beam 2 must pick the B path."""
+        V = 4  # tokens: 0 start, 1 end, 2 A, 3 B
+
+        class Cell:
+            def __call__(self, tokens, states):
+                t = _np(tokens).astype(int)
+                logits = np.zeros((len(t), V), "float32")
+                for i, tok in enumerate(t):
+                    if tok == 0:
+                        logits[i] = [-9, -9, 1.0, 0.9]  # A edges B
+                    elif tok == 2:  # after A: uniform (low-confidence) step
+                        logits[i] = [0.0, 0.0, 0.0, 0.0]
+                    elif tok == 3:  # after B: strong end
+                        logits[i] = [-9, 9.0, -9, -9]
+                    else:
+                        logits[i] = [-9, 9.0, -9, -9]
+                return paddle.to_tensor(logits), states
+
+        dec = nn.BeamSearchDecoder(Cell(), start_token=0, end_token=1, beam_size=2)
+        states = {"h": paddle.to_tensor(np.zeros((1, 2), "float32"))}
+        ids, scores = nn.dynamic_decode(dec, states, max_step_num=5)
+        best = _np(ids)[0, :, 0].tolist()
+        assert best[0] == 3  # beam search picked B despite lower step-1 score
