@@ -168,3 +168,9 @@ def _place_from(place) -> Place:
             globals()["_current_device"] = saved
         return p
     raise TypeError(f"Expected Place or str, got {type(place)}")
+
+
+def get_cudnn_version():
+    """Parity: paddle.device.get_cudnn_version — no cuDNN on TPU (None,
+    matching the reference's CPU-only answer)."""
+    return None

@@ -32,15 +32,6 @@ class BeamSearchDecoder:
         self.output_fn = output_fn
 
     # -- helpers ---------------------------------------------------------
-    def _merge(self, x):
-        """[batch, beam, ...] -> [batch*beam, ...]"""
-        arr = unwrap(x)
-        return wrap(arr.reshape((-1,) + arr.shape[2:]))
-
-    def _split(self, x):
-        arr = unwrap(x)
-        return wrap(arr.reshape((-1, self.beam_size) + arr.shape[1:]))
-
     def _tile_beam(self, x):
         arr = unwrap(x)
         tiled = jnp.repeat(arr[:, None], self.beam_size, axis=1)

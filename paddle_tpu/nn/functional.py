@@ -1435,17 +1435,18 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     class_center_sample op). Host-side sampling (eager data-prep op)."""
     lab = np.asarray(unwrap(label)).reshape(-1)
     pos = np.unique(lab)
-    if len(pos) > num_samples:
-        raise ValueError(
-            f"num_samples ({num_samples}) is smaller than the number of "
-            f"distinct positive classes in label ({len(pos)}); every positive "
-            "class must be kept")
-    if len(pos) == num_samples:
+    if len(pos) >= num_samples:
+        # reference semantics: every positive class is kept even when that
+        # exceeds num_samples (the output simply grows)
         sampled = pos
     else:
         neg_pool = np.setdiff1d(np.arange(num_classes), pos, assume_unique=False)
-        rng_local = np.random.default_rng(int(np.abs(lab).sum()) + num_classes)
-        extra = rng_local.choice(neg_pool, num_samples - len(pos), replace=False)
+        # negatives drawn from the framework PRNG stream (paddle.seed-driven,
+        # varies per call like dropout/gumbel keys)
+        seed = int(np.asarray(
+            jax.random.randint(split_key(), (), 0, 2**31 - 1)))
+        extra = np.random.default_rng(seed).choice(
+            neg_pool, num_samples - len(pos), replace=False)
         sampled = np.concatenate([pos, np.sort(extra)])
     remap = -np.ones(num_classes, np.int64)
     remap[sampled] = np.arange(len(sampled))

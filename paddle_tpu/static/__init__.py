@@ -222,3 +222,11 @@ xpu_places = cuda_places
 from . import nn  # noqa: E402,F401
 # static mixed precision (parity: fluid/contrib/mixed_precision)
 from . import amp  # noqa: E402,F401
+
+
+# compatibility surface (BuildStrategy/CompiledProgram/scope guards/EMA/
+# program-state io) — see compat.py
+from .compat import *  # noqa: E402,F401,F403
+from .compat import __all__ as _compat_all  # noqa: E402
+
+__all__ = list(__all__) + list(_compat_all)

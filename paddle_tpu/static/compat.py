@@ -1,0 +1,452 @@
+"""Static-graph compatibility surface completing paddle.static parity:
+BuildStrategy/ExecutionStrategy/CompiledProgram/ParallelExecutor shims,
+scope/name/device guards, Print/py_func, program-state save/load and
+serialization, EMA, and static metric wrappers.
+
+Parity: python/paddle/static/__init__.py of the reference over
+fluid/compiler.py (CompiledProgram, BuildStrategy pybind.cc:2692),
+fluid/executor.py scope machinery, fluid/io.py (save/load:1847,1955,
+load_program_state:2151, save_vars:286), fluid/optimizer.py EMA:3927.
+
+TPU-native: the strategy objects record the toggles the reference feeds to
+its SSA-graph builder — XLA owns fusion/placement, so they are accepted,
+stored and surfaced for inspection; CompiledProgram/ParallelExecutor thinly
+delegate to the whole-program-jit Executor.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+from typing import Optional
+
+import numpy as np
+
+from ..tensor import Tensor
+from .executor import Executor, global_scope
+from .program import Program, Variable, default_main_program
+
+__all__ = [
+    "BuildStrategy", "ExecutionStrategy", "CompiledProgram",
+    "ParallelExecutor", "Scope", "scope_guard", "name_scope", "device_guard",
+    "Print", "py_func", "accuracy", "auc", "create_parameter",
+    "create_global_var", "save", "load", "save_vars", "load_vars",
+    "load_program_state", "set_program_state", "serialize_program",
+    "deserialize_program", "serialize_persistables",
+    "deserialize_persistables", "save_to_file", "load_from_file",
+    "normalize_program", "ExponentialMovingAverage", "WeightNormParamAttr",
+    "npu_places",
+]
+
+
+class BuildStrategy:
+    """Graph-build toggles (pybind.cc:2692 parity). XLA performs the fusion
+    and scheduling these flags used to steer; values are recorded so strategy
+    code ports and can be introspected."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_all_reduce_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.enable_inplace = True
+        self.memory_optimize = None
+        self.sync_batch_norm = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+    def __repr__(self):
+        flags = {k: v for k, v in self.__dict__.items()}
+        return f"BuildStrategy({flags})"
+
+
+class ExecutionStrategy:
+    """Executor toggles (pybind.cc:2530 parity) — recorded only."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = False
+
+
+class CompiledProgram:
+    """fluid.compiler.CompiledProgram parity: wraps a Program; under XLA the
+    'compilation' already happens in Executor.run's whole-program jit, so
+    this is a labeled pass-through that keeps strategy objects."""
+
+    def __init__(self, program_or_graph, build_strategy: Optional[BuildStrategy] = None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = None
+        self._places = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        """Reference API: builds a multi-device SSA graph. Here DP comes from
+        the mesh (paddle_tpu.distributed); the call records its config and
+        returns self so legacy scripts run unchanged on one chip."""
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._exec_strategy = exec_strategy
+        self._places = places
+        return self
+
+    # Executor.run unwraps CompiledProgram via this hook
+    @property
+    def program(self):
+        return self._program
+
+
+class ParallelExecutor:
+    """Legacy ParallelExecutor (parallel_executor.cc:639 parity) as a shim
+    over the whole-program-jit Executor."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None, build_strategy=None,
+                 num_trainers=1, trainer_id=0, scope=None):
+        self._exe = Executor()
+        self._program = main_program or default_main_program()
+        self._loss_name = loss_name
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        return self._exe.run(self._program, feed=feed, fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+class Scope:
+    """Host-side scope (framework/scope.h:52 parity)."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        self._vars.setdefault(name, None)
+        return self._vars[name]
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_scope_stack = []
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """executor.scope_guard parity."""
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+@contextlib.contextmanager
+def name_scope(prefix):
+    """fluid.name_scope parity: prefixes recorded op names for debugging.
+    Tracing labels live in the profiler; this guard is a lightweight tag."""
+    from ..profiler import RecordEvent
+
+    with RecordEvent(f"name_scope/{prefix}"):
+        yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """fluid.device_guard parity: the reference pins ops to a device for
+    pipeline splitting; mesh shardings own placement here, so the guard
+    records the hint for PipelineLayer-style segmenters."""
+    from . import program as _prog
+
+    prev = getattr(_prog, "_current_device_hint", None)
+    _prog._current_device_hint = device
+    try:
+        yield
+    finally:
+        _prog._current_device_hint = prev
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,  # noqa: A002,N802
+          print_tensor_type=True, print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """print op parity: eager host print; identity pass-through."""
+    arr = input._data if isinstance(input, Tensor) else input
+    head = message or ""
+    if print_tensor_name and getattr(input, "name", None):
+        head += f" {input.name}"
+    try:
+        vals = np.asarray(arr).reshape(-1)[:summarize]
+        print(f"{head} shape={getattr(arr, 'shape', None)} values={vals}")
+    except Exception:
+        print(f"{head} <symbolic {arr}>")
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """py_func op parity: run a host python function eagerly on tensors."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*[np.asarray(t._data) if isinstance(t, Tensor) else t for t in xs])
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    ress = res if isinstance(res, (list, tuple)) else [res]
+    import jax.numpy as jnp
+
+    for o, r in zip(outs, ress):
+        o._set_data(jnp.asarray(np.asarray(r)))
+    return out
+
+
+def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):  # noqa: A002
+    """Static AUC wrapper over the streaming Auc metric (single batch)."""
+    from ..metric import Auc
+
+    m = Auc(num_thresholds=min(num_thresholds, 4095))
+    m.update(np.asarray(input._data), np.asarray(label._data))
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(np.float32(m.accumulate())))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..ops.creation import create_parameter as _cp
+
+    t = _cp(shape, dtype=dtype, default_initializer=default_initializer)
+    if name:
+        t.name = name
+    return t
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    import jax.numpy as jnp
+
+    from ..dtype import to_jax_dtype
+
+    t = Tensor(jnp.full(tuple(shape), value, to_jax_dtype(dtype)))
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+# ---------------------------------------------------------------------------
+# program state save/load (fluid/io.py parity)
+# ---------------------------------------------------------------------------
+
+def _program_state(program: Program) -> dict:
+    return {
+        (v.name or f"param_{i}"): np.asarray(t._data)
+        for i, (t, v) in enumerate(program.captures())
+    }
+
+
+def save(program: Program, model_path: str, protocol: int = 4):
+    """paddle.static.save parity: params -> .pdparams, (optimizer state is
+    owned by the attached optimizer) -> .pdopt, program -> .pdmodel."""
+    import jax
+
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(_program_state(program), f, protocol=protocol)
+    # static optimizer slots live functionally on the program (_opt_state
+    # pytree fed through the jitted step), not in eager accumulators
+    opt_state = getattr(program, "_opt_state", None)
+    blob = (jax.tree_util.tree_map(np.asarray, opt_state)
+            if opt_state is not None else None)
+    with open(model_path + ".pdopt", "wb") as f:
+        pickle.dump(blob, f, protocol=protocol)
+    with open(model_path + ".pdmodel", "wb") as f:
+        pickle.dump({"feeds": [v.name for v in getattr(program, "_feeds", [])]}, f)
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    """paddle.static.load parity: restore parameter values by name, plus the
+    attached optimizer's accumulators/step from the .pdopt file."""
+    state = load_program_state(model_path)
+    set_program_state(program, state)
+    opt_path = model_path + ".pdopt"
+    if os.path.exists(opt_path):
+        with open(opt_path, "rb") as f:
+            opt_state = pickle.load(f)
+        if opt_state is not None:
+            import jax
+            import jax.numpy as jnp
+
+            program._opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
+
+
+def load_program_state(model_path: str, var_list=None) -> dict:
+    path = model_path + ".pdparams" if not model_path.endswith(".pdparams") else model_path
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program: Program, state_dict: dict):
+    for i, (t, v) in enumerate(program.captures()):
+        key = v.name or f"param_{i}"
+        if key in state_dict:
+            import jax.numpy as jnp
+
+            t._set_data(jnp.asarray(state_dict[key]))
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,  # noqa: A002
+              filename=None):
+    prog = main_program or default_main_program()
+    state = _program_state(prog)
+    os.makedirs(dirname, exist_ok=True)
+    if filename:
+        with open(os.path.join(dirname, filename), "wb") as f:
+            pickle.dump(state, f)
+    else:
+        for k, v in state.items():
+            with open(os.path.join(dirname, k.replace("/", "_")), "wb") as f:
+                pickle.dump(v, f)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,  # noqa: A002
+              filename=None):
+    prog = main_program or default_main_program()
+    if filename:
+        with open(os.path.join(dirname, filename), "rb") as f:
+            set_program_state(prog, pickle.load(f))
+        return
+    state = {}
+    for i, (t, v) in enumerate(prog.captures()):
+        key = v.name or f"param_{i}"
+        p = os.path.join(dirname, key.replace("/", "_"))
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                state[key] = pickle.load(f)
+    set_program_state(prog, state)
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs) -> bytes:
+    prog = feed_vars[0]._program if feed_vars else default_main_program()
+    return pickle.dumps({
+        "feeds": [v.name for v in feed_vars],
+        "fetches": [v.name for v in fetch_vars],
+        "n_captures": len(prog.captures()),
+    })
+
+
+def deserialize_program(data: bytes):
+    return pickle.loads(data)
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs) -> bytes:
+    prog = feed_vars[0]._program if feed_vars else default_main_program()
+    return pickle.dumps(_program_state(prog))
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    set_program_state(program, pickle.loads(data))
+
+
+def save_to_file(path: str, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """Reference: prunes/normalizes for inference export. The recorded
+    Program is already minimal (pure closures); returns it unchanged."""
+    return program
+
+
+class ExponentialMovingAverage:
+    """EMA of parameter values (fluid/optimizer.py EMA:3927 parity):
+    ``update()`` after each step, ``apply()`` context swaps EMA values in,
+    ``restore()`` swaps back."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._backup = {}
+        self._step = 0
+        self._params = []
+
+    def update(self, parameters=None):
+        params = parameters if parameters is not None else [
+            t for (t, v) in default_main_program().captures() if v.trainable]
+        self._step += 1
+        for i, p in enumerate(params):
+            arr = np.asarray(p._data)
+            key = getattr(p, "name", None) or f"p{i}"
+            if key not in self._ema:
+                self._ema[key] = arr.copy()
+            else:
+                d = self._decay
+                self._ema[key] = d * self._ema[key] + (1 - d) * arr
+        self._params = list(params)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+
+        for i, p in enumerate(self._params):
+            key = getattr(p, "name", None) or f"p{i}"
+            self._backup[key] = np.asarray(p._data)
+            p._set_data(jnp.asarray(self._ema[key]))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        import jax.numpy as jnp
+
+        for i, p in enumerate(self._params):
+            key = getattr(p, "name", None) or f"p{i}"
+            if key in self._backup:
+                p._set_data(jnp.asarray(self._backup[key]))
+        self._backup = {}
+
+
+class WeightNormParamAttr:
+    """ParamAttr requesting weight normalization (parity:
+    paddle.static.WeightNormParamAttr). Consumed by layers that call
+    nn.utils.weight_norm on their weight."""
+
+    def __init__(self, dim=None, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+
+
+def npu_places(device_ids=None):
+    """NPU is out of scope on this build; mirrors the accelerator list
+    (reference static.npu_places)."""
+    from . import cuda_places
+
+    return cuda_places(device_ids)

@@ -76,6 +76,9 @@ class Executor:
         return_numpy: bool = True,
     ):
         program = program if program is not None else default_main_program()
+        # CompiledProgram (compat.py) wraps the recorded Program
+        if hasattr(program, "program") and not isinstance(program, Program):
+            program = program.program
         feed = feed or {}
         fetch_list = list(fetch_list or [])
 

@@ -1,0 +1,117 @@
+"""Mini end-to-end trainings (parity: the reference's unittests/book/ —
+fit_a_line, recognize_digits, word2vec: small models that must CONVERGE,
+asserting the whole stack end to end in both paradigms)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+import paddle_tpu.static as static
+
+
+rng = np.random.default_rng(31)
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+class TestFitALine:
+    """book/test_fit_a_line parity: linear regression to convergence."""
+
+    def test_dygraph(self):
+        paddle.seed(0)
+        true_w = np.array([[2.0], [-3.4], [1.7], [0.5]], "float32")
+        X = rng.standard_normal((256, 4)).astype("float32")
+        Y = X @ true_w + 4.2
+        model = nn.Linear(4, 1)
+        sgd = opt.SGD(learning_rate=0.05, parameters=model.parameters())
+        for _ in range(300):
+            loss = F.mse_loss(model(paddle.to_tensor(X)), paddle.to_tensor(Y))
+            loss.backward()
+            sgd.step()
+            sgd.clear_grad()
+        assert float(_np(loss)) < 1e-3
+        np.testing.assert_allclose(_np(model.weight), true_w, atol=0.05)
+        np.testing.assert_allclose(_np(model.bias)[0], 4.2, atol=0.05)
+
+    def test_static(self):
+        """Same regression through the static Program/Executor paradigm."""
+        paddle.seed(0)
+        true_w = np.array([[1.5], [-2.0]], "float32")
+        X = rng.standard_normal((128, 2)).astype("float32")
+        Y = X @ true_w + 1.0
+        try:
+            paddle.enable_static()
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [None, 2], "float32")
+                y = static.data("y", [None, 1], "float32")
+                lin = nn.Linear(2, 1)
+                pred = lin(x)
+                loss = F.mse_loss(pred, y)
+                sgd = opt.SGD(learning_rate=0.1)
+                sgd.minimize(loss)
+            exe = static.Executor()
+            exe.run(startup)
+            for _ in range(200):
+                (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            assert float(lv) < 1e-2
+        finally:
+            paddle.disable_static()
+
+
+class TestRecognizeDigits:
+    """book/test_recognize_digits parity: softmax-regression + MLP converge
+    on a separable synthetic 'digits' task."""
+
+    def _data(self, n=512):
+        labels = rng.integers(0, 10, n)
+        # class-dependent mean + noise: linearly separable-ish
+        centers = rng.standard_normal((10, 64)).astype("float32") * 2
+        X = centers[labels] + 0.3 * rng.standard_normal((n, 64)).astype("float32")
+        return X.astype("float32"), labels.astype("int64")
+
+    def test_mlp_converges(self):
+        paddle.seed(0)
+        X, y = self._data()
+        model = nn.Sequential(nn.Linear(64, 32), nn.ReLU(), nn.Linear(32, 10))
+        adam = opt.Adam(learning_rate=1e-2, parameters=model.parameters())
+        acc = 0.0
+        for _ in range(100):
+            logits = model(paddle.to_tensor(X))
+            loss = F.cross_entropy(logits, paddle.to_tensor(y))
+            loss.backward()
+            adam.step()
+            adam.clear_grad()
+        pred = _np(logits).argmax(-1)
+        acc = (pred == y).mean()
+        assert acc > 0.95, acc
+
+
+class TestWord2Vec:
+    """book/test_word2vec parity: skip-gram-style embedding learning — the
+    embedding of co-occurring tokens must end up closer than random pairs."""
+
+    def test_embeddings_learn_cooccurrence(self):
+        paddle.seed(0)
+        vocab, dim = 20, 8
+        # pairs: token 2i co-occurs with 2i+1
+        centers = np.repeat(np.arange(0, vocab, 2), 50)
+        contexts = centers + 1
+        emb = nn.Embedding(vocab, dim)
+        out = nn.Linear(dim, vocab)
+        adam = opt.Adam(learning_rate=5e-2,
+                        parameters=list(emb.parameters()) + list(out.parameters()))
+        for _ in range(60):
+            h = emb(paddle.to_tensor(centers.astype("int64")))
+            logits = out(h)
+            loss = F.cross_entropy(logits, paddle.to_tensor(contexts.astype("int64")))
+            loss.backward()
+            adam.step()
+            adam.clear_grad()
+        logits = _np(out(emb(paddle.to_tensor(centers.astype("int64")))))
+        acc = (logits.argmax(-1) == contexts).mean()
+        assert acc > 0.9, acc

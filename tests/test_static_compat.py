@@ -1,0 +1,203 @@
+"""paddle.static compat surface: CompiledProgram/ParallelExecutor shims,
+save/load program state, EMA, scope/name guards, Print/py_func, static
+metrics (reference: fluid/compiler.py, io.py, optimizer.py EMA)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+rng = np.random.default_rng(23)
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def _build_linear_program():
+    paddle.enable_static()
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        w = static.create_parameter([4, 2], "float32", name="w0")
+        out = paddle.matmul(x, w)
+    return main, startup, x, out, w
+
+
+class TestCompiledProgram:
+    def test_compiled_program_runs(self):
+        try:
+            main, startup, x, out, w = _build_linear_program()
+            exe = static.Executor()
+            exe.run(startup)
+            cp = static.CompiledProgram(main).with_data_parallel(loss_name=None)
+            feed = {"x": np.ones((3, 4), "float32")}
+            res = exe.run(cp, feed=feed, fetch_list=[out])
+            want = np.ones((3, 4)) @ _np(w)
+            np.testing.assert_allclose(res[0], want, rtol=1e-5)
+        finally:
+            paddle.disable_static()
+
+    def test_parallel_executor_shim(self):
+        try:
+            main, startup, x, out, w = _build_linear_program()
+            static.Executor().run(startup)
+            pe = static.ParallelExecutor(use_cuda=False, main_program=main)
+            res = pe.run(fetch_list=[out], feed={"x": np.zeros((2, 4), "float32")})
+            np.testing.assert_allclose(res[0], np.zeros((2, 2)), atol=1e-6)
+        finally:
+            paddle.disable_static()
+
+    def test_build_strategy_fields(self):
+        bs = static.BuildStrategy()
+        bs.fuse_all_reduce_ops = True
+        bs.reduce_strategy = static.BuildStrategy.ReduceStrategy.Reduce
+        assert "fuse_all_reduce_ops" in repr(bs)
+        es = static.ExecutionStrategy()
+        es.num_threads = 4
+
+
+class TestProgramStateIO:
+    def test_save_load_roundtrip(self, tmp_path):
+        try:
+            main, startup, x, out, w = _build_linear_program()
+            static.Executor().run(startup)
+            w_val = _np(w).copy()
+            path = str(tmp_path / "model")
+            static.save(main, path)
+            # clobber and restore
+            import jax.numpy as jnp
+
+            w._set_data(jnp.zeros_like(w._data))
+            static.load(main, path)
+            np.testing.assert_allclose(_np(w), w_val)
+            state = static.load_program_state(path)
+            assert "w0" in state
+        finally:
+            paddle.disable_static()
+
+    def test_save_load_vars_dir(self, tmp_path):
+        try:
+            main, startup, x, out, w = _build_linear_program()
+            exe = static.Executor()
+            exe.run(startup)
+            w_val = _np(w).copy()
+            static.save_vars(exe, str(tmp_path), main_program=main,
+                             filename="all_vars")
+            import jax.numpy as jnp
+
+            w._set_data(jnp.ones_like(w._data))
+            static.load_vars(exe, str(tmp_path), main_program=main,
+                             filename="all_vars")
+            np.testing.assert_allclose(_np(w), w_val)
+        finally:
+            paddle.disable_static()
+
+    def test_serialize_persistables(self):
+        try:
+            main, startup, x, out, w = _build_linear_program()
+            static.Executor().run(startup)
+            blob = static.serialize_persistables([x], [out])
+            import jax.numpy as jnp
+
+            old = _np(w).copy()
+            w._set_data(jnp.zeros_like(w._data))
+            static.deserialize_persistables(main, blob)
+            np.testing.assert_allclose(_np(w), old)
+        finally:
+            paddle.disable_static()
+
+
+class TestEMA:
+    def test_apply_restore(self):
+        p = paddle.to_tensor(np.ones(3, "float32"))
+        p.name = "p"
+        ema = static.ExponentialMovingAverage(decay=0.5)
+        ema.update([p])          # ema = 1
+        import jax.numpy as jnp
+
+        p._set_data(jnp.asarray(np.full(3, 3.0, "float32")))
+        ema.update([p])          # ema = 0.5*1 + 0.5*3 = 2
+        with ema.apply():
+            np.testing.assert_allclose(_np(p), 2.0)
+        np.testing.assert_allclose(_np(p), 3.0)  # restored
+
+
+class TestMiscStatic:
+    def test_scope_and_guards(self):
+        s = static.Scope()
+        with static.scope_guard(s):
+            pass
+        with static.name_scope("block1"):
+            pass
+        with static.device_guard("gpu:0"):
+            pass
+
+    def test_print_and_py_func(self, capsys):
+        t = paddle.to_tensor(np.arange(3, dtype="float32"))
+        out = static.Print(t, message="dbg")
+        assert out is t
+        assert "dbg" in capsys.readouterr().out
+        res = paddle.to_tensor(np.zeros(3, "float32"))
+        static.py_func(lambda a: a * 2, t, res)
+        np.testing.assert_allclose(_np(res), [0, 2, 4])
+
+    def test_static_metrics(self):
+        scores = paddle.to_tensor(np.array([[0.2, 0.8], [0.9, 0.1]], "float32"))
+        labels = paddle.to_tensor(np.array([[1], [0]], "int64"))
+        acc = static.accuracy(scores, labels)
+        np.testing.assert_allclose(float(_np(acc)), 1.0)
+        a = static.auc(scores, labels)
+        assert 0.0 <= float(_np(a)) <= 1.0
+
+    def test_create_global_var(self):
+        v = static.create_global_var([2, 2], 1.5, "float32", persistable=True)
+        np.testing.assert_allclose(_np(v), np.full((2, 2), 1.5))
+
+    def test_weight_norm_param_attr(self):
+        attr = static.WeightNormParamAttr(dim=0, name="wn")
+        assert attr.dim == 0
+
+
+class TestOptimizerStateResume:
+    def test_save_restores_opt_state(self, tmp_path):
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+
+        try:
+            paddle.enable_static()
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [None, 2], "float32")
+                y = static.data("y", [None, 1], "float32")
+                lin = nn.Linear(2, 1)
+                loss = F.mse_loss(lin(x), y)
+                adam = opt.Adam(learning_rate=0.01)
+                adam.minimize(loss)
+            exe = static.Executor()
+            exe.run(startup)
+            X = np.ones((4, 2), "float32")
+            Y = np.ones((4, 1), "float32")
+            for _ in range(3):
+                exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            path = str(tmp_path / "ckpt")
+            static.save(main, path)
+            import jax
+
+            before = jax.tree_util.tree_map(np.asarray, main._opt_state)
+            # clobber the functional slot state, then restore
+            main._opt_state = jax.tree_util.tree_map(np.zeros_like, before)
+            static.load(main, path)
+            after = jax.tree_util.tree_map(np.asarray, main._opt_state)
+            flat_b = jax.tree_util.tree_leaves(before)
+            flat_a = jax.tree_util.tree_leaves(after)
+            assert len(flat_b) == len(flat_a) and len(flat_b) > 0
+            for b, a in zip(flat_b, flat_a):
+                np.testing.assert_allclose(b, a, rtol=1e-6)
+            # adam moments are non-trivial after 3 steps
+            assert any(np.abs(leaf).sum() > 0 for leaf in flat_b)
+        finally:
+            paddle.disable_static()
