@@ -114,6 +114,7 @@ _LAZY_SUBMODULES = (
     "dataset",
     "reader",
     "compat",
+    "linalg",
 )
 
 

@@ -1,8 +1,23 @@
 """paddle_tpu.incubate — experimental subsystems (parity:
-python/paddle/incubate + fluid/incubate)."""
+python/paddle/incubate + fluid/incubate: auto-checkpoint, ASP sparsity,
+LookAhead/ModelAverage optimizers, fused softmax-mask ops, segment
+reductions)."""
 from . import checkpoint  # noqa: F401
+from .operators import (  # noqa: F401
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
 
-__all__ = ["checkpoint", "asp"]
+__all__ = [
+    "checkpoint", "asp", "LookAhead", "ModelAverage",
+    "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+]
 
 
 def __getattr__(name):

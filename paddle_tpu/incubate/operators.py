@@ -1,0 +1,105 @@
+"""Incubate fused operators: softmax_mask_fuse(_upper_triangle) and segment
+reductions.
+
+Parity: python/paddle/incubate/operators/softmax_mask_fuse.py (backed by
+fused_softmax_mask op, operators/fused_softmax_mask_op.cu) and
+incubate/tensor/math.py segment_* (segment_pool ops). TPU-native: softmax
+with an added mask is a single XLA fusion — the CUDA op's raison d'être
+(avoiding a materialized masked tensor) is what the compiler already does;
+segment reductions map to jax.ops.segment_*.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._primitive import primitive, unwrap, wrap
+
+__all__ = [
+    "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle",
+    "segment_sum",
+    "segment_mean",
+    "segment_max",
+    "segment_min",
+]
+
+
+@primitive
+def _smf(x, mask):
+    return jax.nn.softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fused graph. x: (B, H, T, S); mask
+    broadcastable additive mask (-10000 at masked positions)."""
+    return _smf(x, mask)
+
+
+@primitive
+def _smf_ut(x):
+    t, s = x.shape[-2], x.shape[-1]
+    causal = jnp.tril(jnp.ones((t, s), bool), k=s - t)
+    masked = jnp.where(causal, x, jnp.asarray(-1e4, x.dtype))
+    return jax.nn.softmax(masked, axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax (fused_softmax_mask_upper_triangle parity)."""
+    return _smf_ut(x)
+
+
+def _num_segments(segment_ids, num_segments):
+    """Static segment count: explicit arg, else from concrete eager ids.
+    Under jit tracing the count must be given explicitly."""
+    if num_segments is not None:
+        return int(num_segments)
+    import numpy as np
+
+    ids = unwrap(segment_ids)
+    if hasattr(ids, "aval") and not hasattr(ids, "__array__"):
+        raise ValueError(
+            "segment ops need an explicit num_segments when traced under jit "
+            "(segment_ids is abstract)")
+    arr = np.asarray(ids)
+    if arr.size == 0:
+        return 0
+    return int(arr.max()) + 1
+
+
+def _seg(fn_name, data, segment_ids, num_segments=None):
+    n = _num_segments(segment_ids, num_segments)
+    fn = getattr(jax.ops, fn_name)
+
+    @primitive
+    def _op(data, ids):
+        return fn(data, ids.astype(jnp.int32), num_segments=n)
+
+    return _op(data, segment_ids)
+
+
+def segment_sum(data, segment_ids, name=None, num_segments=None):
+    return _seg("segment_sum", data, segment_ids, num_segments)
+
+
+def segment_mean(data, segment_ids, name=None, num_segments=None):
+    n = _num_segments(segment_ids, num_segments)
+
+    @primitive
+    def _mean(data, ids):
+        ids = ids.astype(jnp.int32)
+        s = jax.ops.segment_sum(data, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(
+            jnp.ones(ids.shape + (1,) * (data.ndim - 1), data.dtype),
+            ids, num_segments=n)
+        return s / jnp.maximum(cnt, 1.0)
+
+    return _mean(data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None, num_segments=None):
+    return _seg("segment_max", data, segment_ids, num_segments)
+
+
+def segment_min(data, segment_ids, name=None, num_segments=None):
+    return _seg("segment_min", data, segment_ids, num_segments)

@@ -1,0 +1,134 @@
+"""Incubate optimizers: LookAhead and ModelAverage.
+
+Parity: python/paddle/incubate/optimizer/lookahead.py (k-step slow/fast
+weight interpolation) and fluid/optimizer.py ModelAverage:3927-region
+(accumulated parameter averages with apply()/restore()).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """Wraps an inner optimizer: every k steps the slow weights move
+    alpha toward the fast weights and the fast weights reset to them."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = None
+
+    def _params(self):
+        return list(self.inner_optimizer._param_groups)
+
+    def step(self):
+        # slow-weight baseline is the PRE-first-step parameter values
+        # (reference lookahead.py initializes slow params at construction)
+        if self._slow is None:
+            self._slow = [np.asarray(p._data) for p in self._params()]
+        self.inner_optimizer.step()
+        self._step_count += 1
+        params = self._params()
+        if self._step_count % self.k == 0:
+            for p, slow in zip(params, self._slow):
+                new_slow = slow + self.alpha * (np.asarray(p._data) - slow)
+                p._set_data(jnp.asarray(new_slow))
+            self._slow = [np.asarray(p._data) for p in params]
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, []
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_count
+        return sd
+
+    def __getattr__(self, name):
+        if name == "inner_optimizer":  # not yet set (e.g. during deepcopy)
+            raise AttributeError(name)
+        return getattr(self.inner_optimizer, name)
+
+
+class ModelAverage:
+    """Running average of parameter values over a sliding window; apply()
+    swaps the averages in for evaluation, restore() swaps back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000, name=None):
+        self._rate = float(average_window_rate)
+        self._min_w = int(min_average_window)
+        self._max_w = int(max_average_window)
+        self._params = list(parameters or [])
+        shape = lambda p: np.asarray(p._data).shape  # noqa: E731
+        # reference average_accumulates scheme: a fresh window (sum_1),
+        # a sealed previous window (sum_2) and long history (sum_3)
+        self._sum1 = [np.zeros(shape(p), np.float64) for p in self._params]
+        self._sum2 = [np.zeros(shape(p), np.float64) for p in self._params]
+        self._sum3 = [np.zeros(shape(p), np.float64) for p in self._params]
+        self._n1 = self._n2 = self._n3 = 0
+        self._updates = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate after the training optimizer's step (reference
+        average_accumulates op: window = max(min_w, min(max_w,
+        num_updates * rate)))."""
+        self._updates += 1
+        window = max(self._min_w, min(self._max_w,
+                                      int(self._updates * self._rate)))
+        if self._n1 >= window:
+            # seal the fresh window: fold old sealed into history
+            for s3, s2 in zip(self._sum3, self._sum2):
+                s3 += s2
+            self._n3 += self._n2
+            self._sum2, self._n2 = self._sum1, self._n1
+            self._sum1 = [np.zeros_like(s) for s in self._sum2]
+            self._n1 = 0
+            # history beyond the window is dropped (restart) like the
+            # reference when total exceeds max_average_window
+            if self._n3 + self._n2 > self._max_w:
+                self._sum3 = [np.zeros_like(s) for s in self._sum3]
+                self._n3 = 0
+        for s, p in zip(self._sum1, self._params):
+            s += np.asarray(p._data, np.float64)
+        self._n1 += 1
+
+    update = step
+
+    def _totals(self):
+        total_n = self._n1 + self._n2 + self._n3
+        sums = [a + b + c for a, b, c in zip(self._sum1, self._sum2, self._sum3)]
+        return sums, total_n
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        sums, total_n = self._totals()
+        if total_n == 0:
+            yield
+            return
+        self._backup = [np.asarray(p._data) for p in self._params]
+        for s, p in zip(sums, self._params):
+            p._set_data(jnp.asarray((s / total_n).astype(np.asarray(p._data).dtype)))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._set_data(jnp.asarray(b))
+            self._backup = None

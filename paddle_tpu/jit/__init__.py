@@ -20,3 +20,50 @@ from .save_load import load, save, TranslatedLayer  # noqa: F401
 from .input_spec import InputSpec  # noqa: F401
 
 __all__ = ["to_static", "not_to_static", "StaticFunction", "save", "load", "InputSpec", "TranslatedLayer"]
+
+
+class TracedLayer:
+    """Parity: fluid.dygraph.TracedLayer — trace a dygraph layer into a
+    static (jitted) callable with save_inference_model support. Here tracing
+    IS to_static, so the class wraps a StaticFunction of the layer."""
+
+    def __init__(self, layer, static_fn, example_inputs):
+        self._layer = layer
+        self._fn = static_fn
+        self._example_inputs = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        from .static_function import to_static
+
+        fn = to_static(lambda *xs: layer(*xs))
+        outs = fn(*inputs)
+        return outs, TracedLayer(layer, fn, inputs)
+
+    def __call__(self, *inputs):
+        return self._fn(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        from .input_spec import InputSpec
+        from .save_load import save as jit_save
+
+        # derive the spec from the traced example inputs
+        spec = [InputSpec(shape=list(t.shape), dtype=str(t.dtype))
+                for t in self._example_inputs]
+        jit_save(self._layer, path, input_spec=spec)
+
+
+def set_code_level(level=100):
+    """Parity: paddle.jit.set_code_level — the AST-transpiler debug dial.
+    This build traces instead of transpiling; the call records the level."""
+    import logging
+
+    logging.getLogger("paddle_tpu.jit").setLevel(logging.DEBUG if level else logging.INFO)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Parity: paddle.jit.set_verbosity."""
+    import logging
+
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level else logging.WARNING)

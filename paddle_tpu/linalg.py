@@ -1,0 +1,34 @@
+"""paddle_tpu.linalg — linear-algebra namespace (parity:
+python/paddle/linalg.py re-exporting tensor.linalg)."""
+from .ops.linalg import (  # noqa: F401
+    bmm,
+    cholesky,
+    cholesky_solve,
+    cond,
+    cov,
+    det,
+    eig,
+    eigh,
+    eigvals,
+    eigvalsh,
+    inverse,
+    lstsq,
+    matmul,
+    matrix_power,
+    matrix_rank,
+    multi_dot,
+    norm,
+    pinv,
+    qr,
+    slogdet,
+    solve,
+    svd,
+    triangular_solve,
+)
+
+__all__ = [
+    "cholesky", "cholesky_solve", "cond", "cov", "det", "eig", "eigh",
+    "eigvals", "eigvalsh", "inverse", "lstsq", "matmul", "matrix_power",
+    "matrix_rank", "multi_dot", "norm", "pinv", "qr", "slogdet", "solve",
+    "svd", "triangular_solve", "bmm",
+]

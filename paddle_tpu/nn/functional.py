@@ -1578,16 +1578,25 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
 
 # in-place activation variants (parity: paddle's *_ inplace APIs)
 def relu_(x):
+    from ..ops._primitive import inplace_guard
+
+    inplace_guard(x, "relu_")
     x._set_data(jax.nn.relu(x._data))
     return x
 
 
 def elu_(x, alpha=1.0):
+    from ..ops._primitive import inplace_guard
+
+    inplace_guard(x, "elu_")
     x._set_data(jax.nn.elu(x._data, alpha))
     return x
 
 
 def softmax_(x, axis=-1):
+    from ..ops._primitive import inplace_guard
+
+    inplace_guard(x, "softmax_")
     x._set_data(jax.nn.softmax(x._data, axis=axis))
     return x
 

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 
 from ..tensor import Tensor
 from . import creation, linalg, logic, manipulation, math, random_ops, search
-from ._primitive import primitive, unwrap, wrap
+from ._primitive import inplace_guard, primitive, unwrap, wrap
 from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
@@ -203,3 +203,72 @@ _DUNDERS = {
 
 for _name, _fn in _DUNDERS.items():
     Tensor._register_method(_name, _fn)
+
+
+# ---------------------------------------------------------------------------
+# in-place tensor-method variants (parity: paddle's *_ methods — the
+# reference's inplace ops, e.g. REGISTER inplace pass); on a functional
+# substrate "in place" rebinds the wrapper's storage
+# ---------------------------------------------------------------------------
+
+def _make_inplace(fn, name):
+    def method(self, *args, **kwargs):
+        inplace_guard(self, name)
+        out = fn(self, *args, **kwargs)
+        self._set_data(out._data if isinstance(out, Tensor) else out)
+        return self
+
+    method.__name__ = name
+    return method
+
+
+_INPLACE = {
+    "add_": math.add, "subtract_": math.subtract, "sub_": math.subtract,
+    "multiply_": math.multiply, "scale_": math.scale, "exp_": math.exp,
+    "sqrt_": math.sqrt, "rsqrt_": math.rsqrt, "clip_": math.clip,
+    "ceil_": math.ceil, "floor_": math.floor, "round_": math.round,
+    "reciprocal_": math.reciprocal,
+    "flatten_": manipulation.flatten,
+}
+for _name, _fn in _INPLACE.items():
+    Tensor._register_method(_name, _make_inplace(_fn, _name))
+
+
+def _uniform_(self, min=-1.0, max=1.0, seed=0):  # noqa: A002
+    inplace_guard(self, "uniform_")
+    return random_ops.uniform_(self, min=min, max=max)
+
+
+def _normal_(self, mean=0.0, std=1.0):
+    inplace_guard(self, "normal_")
+    from . import random_ops as _ro
+
+    out = _ro.normal(mean=mean, std=std, shape=self.shape)
+    self._set_data(out._data.astype(self._data.dtype))
+    return self
+
+
+def _copy_(self, other, blocking=True):
+    inplace_guard(self, "copy_")
+    src = other._data if isinstance(other, Tensor) else jnp.asarray(other)
+    self._set_data(src.astype(self._data.dtype))
+    return self
+
+
+def _element_size(self):
+    return int(jnp.dtype(self._data.dtype).itemsize)
+
+
+def _get_tensor(self):
+    """LoDTensor-handle parity: the tensor IS its own dense storage here."""
+    return self
+
+
+Tensor._register_method("uniform_", _uniform_)
+Tensor._register_method("normal_", _normal_)
+Tensor._register_method("copy_", _copy_)
+Tensor._register_method("element_size", _element_size)
+Tensor._register_method("get_tensor", _get_tensor)
+Tensor._register_method("dim", lambda self: len(self._data.shape))
+Tensor._register_method("ndimension", lambda self: len(self._data.shape))
+Tensor._register_method("cuda", lambda self, *a, **k: self)  # accelerator-resident already

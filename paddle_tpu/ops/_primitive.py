@@ -162,3 +162,17 @@ def primitive(fn: Callable = None, *, nondiff: bool = False, aux: int = 0, name:
     wrapper.raw = fn  # the pure-jax function, for use inside jit/shard_map
     wrapper.op_name = op_name
     return wrapper
+
+
+def inplace_guard(x, op_name: str):
+    """Paddle-parity safety for ``*_`` in-place APIs: the vjp tape records
+    input values by reference, so mutating a grad-requiring tensor would
+    silently corrupt gradients (the reference raises for leaf tensors for
+    the same reason). Raise instead of being wrong."""
+    from ..autograd import tape as _tape
+
+    if _tape.is_grad_enabled() and isinstance(x, Tensor) and not x.stop_gradient:
+        raise ValueError(
+            f"{op_name}(): in-place mutation of a tensor that requires grad "
+            "is not supported (it would corrupt recorded gradients); call "
+            "it under paddle.no_grad() or use the out-of-place variant")

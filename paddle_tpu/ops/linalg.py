@@ -44,6 +44,7 @@ __all__ = [
     "bincount",
     "einsum",
     "cov",
+    "cond",
     "corrcoef",
     "lu",
 ]
@@ -279,3 +280,14 @@ def corrcoef(x, rowvar=True):
 def lu(x, pivot=True):
     lu_, piv = jax.scipy.linalg.lu_factor(unwrap(x))
     return wrap(lu_), wrap(piv.astype(jnp.int32) + 1)  # paddle pivots are 1-based
+
+
+def cond(x, p=None, name=None):
+    """Condition number (parity: paddle.linalg.cond). p in {None/'fro',
+    'nuc', 1, -1, 2, -2, inf, -inf}; None means 2-norm like numpy."""
+
+    @primitive
+    def _cond(x):
+        return jnp.linalg.cond(x, p=p)
+
+    return _cond(x)

@@ -44,6 +44,9 @@ def reshape(x, shape):
 
 
 def reshape_(x, shape):
+    from ._primitive import inplace_guard
+
+    inplace_guard(x, "reshape_")
     x._set_data(jnp.reshape(x._data, _ints(shape)))
     return x
 
@@ -584,6 +587,9 @@ def crop(x, shape=None, offsets=None, name=None):
 
 
 def squeeze_(x, axis=None):
+    from ._primitive import inplace_guard
+
+    inplace_guard(x, "squeeze_")
     arr = x._data
     out = jnp.squeeze(arr, axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis)
     x._set_data(out)
@@ -591,6 +597,9 @@ def squeeze_(x, axis=None):
 
 
 def unsqueeze_(x, axis):
+    from ._primitive import inplace_guard
+
+    inplace_guard(x, "unsqueeze_")
     arr = x._data
     axes = axis if isinstance(axis, (list, tuple)) else [axis]
     out = jnp.expand_dims(arr, tuple(axes))
@@ -599,6 +608,9 @@ def unsqueeze_(x, axis):
 
 
 def scatter_(x, index, updates, overwrite=True):
+    from ._primitive import inplace_guard
+
+    inplace_guard(x, "scatter_")
     out = scatter(x, index, updates, overwrite=overwrite)
     x._set_data(out._data if hasattr(out, "_data") else out)
     return x

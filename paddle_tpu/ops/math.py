@@ -515,5 +515,8 @@ def tensordot(x, y, axes=2, name=None):
 
 def tanh_(x, name=None):
     """In-place tanh (parity: paddle.tanh_)."""
+    from ._primitive import inplace_guard
+
+    inplace_guard(x, "tanh_")
     x._set_data(jnp.tanh(x._data))
     return x

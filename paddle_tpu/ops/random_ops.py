@@ -72,6 +72,9 @@ def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0):  # noqa: A002
 
 
 def uniform_(x, min=-1.0, max=1.0):  # noqa: A002
+    from ._primitive import inplace_guard
+
+    inplace_guard(x, "uniform_")
     x._set_data(
         jax.random.uniform(split_key(), tuple(x._data.shape), x._data.dtype, minval=min, maxval=max)
     )

@@ -53,3 +53,17 @@ def require_version(min_version: str, max_version: str = None):
             f"version <= {max_version} required, installed {__version__}"
         )
     return True
+
+
+def __getattr__(name):
+    if name in ("unique_name", "dlpack", "cpp_extension"):
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name == "profiler":
+        from .. import profiler as mod
+
+        return mod
+    raise AttributeError(name)

@@ -1,0 +1,67 @@
+"""Custom C++ op building (parity: python/paddle/utils/cpp_extension/ —
+CppExtension/CUDAExtension/load/setup over setuptools + nvcc).
+
+TPU-native: custom device kernels are Pallas (ops/pallas/); this module
+covers the HOST-side native extension path the reference also serves —
+compile C++ to a shared library with g++ and bind via ctypes (the same
+toolchain paddle_tpu/core/native uses; pybind11 is not in this image)."""
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+__all__ = ["CppExtension", "CUDAExtension", "load", "get_build_directory"]
+
+
+def get_build_directory():
+    d = os.environ.get("PADDLE_TPU_EXTENSION_DIR",
+                       os.path.join(os.path.expanduser("~"), ".cache",
+                                    "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """Build spec for a host C++ extension (setuptools-style)."""
+
+    def __init__(self, sources, include_dirs=None, extra_compile_args=None,
+                 extra_link_args=None, name=None, **kw):
+        self.sources = list(sources)
+        self.include_dirs = list(include_dirs or [])
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.extra_link_args = list(extra_link_args or [])
+        self.name = name
+
+
+def CUDAExtension(*args, **kwargs):  # noqa: N802
+    """No CUDA on this build: device kernels are Pallas. Raises with
+    direction rather than silently producing a CPU stub."""
+    raise RuntimeError(
+        "CUDAExtension is unavailable on the TPU build — write device "
+        "kernels with Pallas (see paddle_tpu/ops/pallas) and host code via "
+        "CppExtension/load")
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_include_paths=None,
+         extra_ldflags=None, build_directory=None, verbose=False, **kw):
+    """Compile C++ sources into <build_dir>/<name>.so with g++ and return a
+    ctypes.CDLL handle (parity: cpp_extension.load's JIT path)."""
+    import ctypes
+
+    build_dir = build_directory or get_build_directory()
+    out = os.path.join(build_dir, f"{name}.so")
+    srcs = [str(s) for s in sources]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if not os.path.exists(out) or os.path.getmtime(out) < newest_src:
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", out]
+        for inc in (extra_include_paths or []):
+            cmd += ["-I", str(inc)]
+        cmd += ["-I", sysconfig.get_paths()["include"]]
+        cmd += (extra_cxx_cflags or [])
+        cmd += srcs
+        cmd += (extra_ldflags or [])
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(out)

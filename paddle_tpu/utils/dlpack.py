@@ -1,0 +1,23 @@
+"""DLPack interop (parity: python/paddle/utils/dlpack.py to_dlpack /
+from_dlpack) over jax's zero-copy dlpack bridge."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack provider (implements __dlpack__/__dlpack_device__;
+    modern consumers' from_dlpack take this directly, zero-copy where the
+    backend allows)."""
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def from_dlpack(capsule):
+    """DLPack capsule (or __dlpack__ provider, e.g. a torch tensor) ->
+    Tensor."""
+    return Tensor(jnp.from_dlpack(capsule))

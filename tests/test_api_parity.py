@@ -186,3 +186,155 @@ class TestAsyncCollectives:
         task = dist.isend(t, dst=0)
         assert task.is_completed()
         task.wait()
+
+
+class TestIncubate:
+    def test_segment_ops(self):
+        import paddle_tpu.incubate as inc
+
+        data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]], "float32"))
+        ids = paddle.to_tensor(np.array([0, 0, 1], "int32"))
+        np.testing.assert_allclose(_np(inc.segment_sum(data, ids)),
+                                   [[4, 6], [5, 6]])
+        np.testing.assert_allclose(_np(inc.segment_mean(data, ids)),
+                                   [[2, 3], [5, 6]])
+        np.testing.assert_allclose(_np(inc.segment_max(data, ids)),
+                                   [[3, 4], [5, 6]])
+        np.testing.assert_allclose(_np(inc.segment_min(data, ids)),
+                                   [[1, 2], [5, 6]])
+
+    def test_softmax_mask_fuse(self):
+        import paddle_tpu.incubate as inc
+
+        x = paddle.to_tensor(rng.standard_normal((1, 2, 3, 3)).astype("float32"))
+        mask = paddle.to_tensor(np.zeros((1, 1, 3, 3), "float32"))
+        out = _np(inc.softmax_mask_fuse(x, mask))
+        np.testing.assert_allclose(out.sum(-1), np.ones((1, 2, 3)), rtol=1e-5)
+        ut = _np(inc.softmax_mask_fuse_upper_triangle(x))
+        # causal: first row attends only position 0
+        np.testing.assert_allclose(ut[..., 0, 1:], 0.0, atol=1e-6)
+
+    def test_lookahead(self):
+        import paddle_tpu.incubate as inc
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+
+        paddle.seed(0)
+        lin = nn.Linear(2, 1)
+        inner = opt.SGD(learning_rate=0.1, parameters=lin.parameters())
+        la = inc.LookAhead(inner, alpha=0.5, k=2)
+        X = paddle.to_tensor(np.ones((4, 2), "float32"))
+        Y = paddle.to_tensor(np.zeros((4, 1), "float32"))
+        w0 = _np(lin.weight).copy()
+        for _ in range(4):
+            loss = ((lin(X) - Y) ** 2).mean()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+        assert not np.allclose(_np(lin.weight), w0)
+
+    def test_model_average(self):
+        import paddle_tpu.incubate as inc
+
+        p = paddle.to_tensor(np.ones(2, "float32"))
+        ma = inc.ModelAverage(parameters=[p])
+        ma.step()  # avg = 1
+        import jax.numpy as jnp
+
+        p._set_data(jnp.asarray(np.full(2, 3.0, "float32")))
+        ma.step()  # avg = 2
+        with ma.apply():
+            np.testing.assert_allclose(_np(p), 2.0)
+        np.testing.assert_allclose(_np(p), 3.0)
+
+
+class TestLinalgNamespace:
+    def test_cond_and_exports(self):
+        import paddle_tpu.linalg as L
+
+        m = paddle.to_tensor(np.diag([1.0, 4.0]).astype("float32"))
+        np.testing.assert_allclose(float(_np(L.cond(m))), 4.0, rtol=1e-5)
+        for n in ("svd", "qr", "solve", "pinv", "lstsq", "eigh"):
+            assert hasattr(L, n)
+
+
+class TestInplaceTensorMethods:
+    def test_inplace_chain(self):
+        t = paddle.to_tensor(np.full((2, 2), 4.0, "float32"))
+        t.sqrt_().add_(paddle.to_tensor(np.ones((2, 2), "float32"))).scale_(2.0)
+        np.testing.assert_allclose(_np(t), 6.0)
+
+    def test_random_inplace(self):
+        paddle.seed(0)
+        t = paddle.to_tensor(np.zeros((100,), "float32"))
+        t.uniform_(2.0, 3.0)
+        assert (_np(t) >= 2.0).all() and (_np(t) < 3.0).all()
+        t.normal_(0.0, 1.0)
+        assert abs(_np(t).mean()) < 0.5
+
+
+class TestUtilsExtras:
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+
+        a = unique_name.generate("fc")
+        b = unique_name.generate("fc")
+        assert a != b and a.startswith("fc_")
+        with unique_name.guard():
+            c = unique_name.generate("fc")
+            assert c == "fc_0"
+        d = unique_name.generate("fc")
+        assert d not in (a, b, c) or d.split("_")[-1] > b.split("_")[-1]
+
+    def test_dlpack_roundtrip(self):
+        from paddle_tpu.utils import dlpack
+
+        t = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+        back = dlpack.from_dlpack(dlpack.to_dlpack(t))
+        np.testing.assert_allclose(_np(back), _np(t))
+
+    def test_dlpack_torch_interop(self):
+        import torch
+
+        from paddle_tpu.utils import dlpack
+
+        tt = torch.arange(4, dtype=torch.float32)
+        jt = dlpack.from_dlpack(tt)
+        np.testing.assert_allclose(_np(jt), [0, 1, 2, 3])
+
+    def test_cpp_extension_load(self, tmp_path):
+        from paddle_tpu.utils import cpp_extension
+
+        src = tmp_path / "ext.cc"
+        src.write_text('extern "C" double mul2(double x) { return x * 2; }')
+        lib = cpp_extension.load("parity_ext", [str(src)],
+                                 build_directory=str(tmp_path))
+        import ctypes
+
+        lib.mul2.restype = ctypes.c_double
+        lib.mul2.argtypes = [ctypes.c_double]
+        assert lib.mul2(2.5) == 5.0
+
+    def test_cuda_extension_raises(self):
+        import pytest as _pytest
+
+        from paddle_tpu.utils import cpp_extension
+
+        with _pytest.raises(RuntimeError):
+            cpp_extension.CUDAExtension(sources=["x.cu"])
+
+
+class TestTracedLayer:
+    def test_trace_call_save(self, tmp_path):
+        import paddle_tpu.jit as jit
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        lin = nn.Linear(3, 2)
+        x = paddle.to_tensor(rng.standard_normal((2, 3)).astype("float32"))
+        outs, tl = jit.TracedLayer.trace(lin, [x])
+        np.testing.assert_allclose(_np(tl(x)), _np(lin(x)), rtol=1e-5)
+        tl.save_inference_model(str(tmp_path / "traced"))
+        loaded = jit.load(str(tmp_path / "traced"))
+        np.testing.assert_allclose(np.asarray(loaded(x)._data), _np(lin(x)),
+                                   rtol=1e-5)
