@@ -10,7 +10,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..tensor import Tensor
-from . import creation, linalg, logic, manipulation, math, random_ops, search
+from . import creation, linalg, logic, manipulation, math, random_ops, search, sequence
 from ._primitive import inplace_guard, primitive, unwrap, wrap
 from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
@@ -19,6 +19,7 @@ from .manipulation import *  # noqa: F401,F403 — note: no __all__, exports by 
 from .math import *  # noqa: F401,F403
 from .random_ops import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 
 # manipulation has no __all__; re-export its public names explicitly
 from .manipulation import (  # noqa: F401

@@ -1,0 +1,135 @@
+"""Sequence ops — the reference's LoD-tensor family re-designed for dense
+batches with explicit lengths.
+
+Parity: paddle/fluid/operators/sequence_ops/ (sequence_pad, sequence_unpad,
+sequence_expand, sequence_reverse, sequence_softmax, sequence_slice...) and
+python/paddle/fluid/layers/sequence_lod.py. The reference threads raggedness
+through LoD metadata on one flat tensor; TPU-native code wants static shapes,
+so here a ragged batch is (flat_data, lengths) in and padded (batch, max_len,
+...) out — the masks are XLA-friendly and jit-stable. sequence_mask lives in
+nn.functional (the keystone helper these build on).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._primitive import primitive, unwrap, wrap
+
+__all__ = [
+    "sequence_pad",
+    "sequence_unpad",
+    "sequence_expand",
+    "sequence_reverse",
+    "sequence_softmax",
+]
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """Pack a flat ragged batch into a padded dense one (sequence_pad op).
+
+    x: (sum(lengths), ...) flat rows; length: (B,) per-sequence row counts.
+    Returns (padded (B, maxlen, ...), lengths)."""
+    if length is None:
+        raise ValueError("sequence_pad needs `length` (the LoD replacement)")
+    lens = np.asarray(unwrap(length)).astype(np.int64)
+    B = len(lens)
+    ml = int(maxlen) if maxlen is not None else int(lens.max()) if B else 0
+    if B and ml < int(lens.max()):
+        raise ValueError(
+            f"maxlen ({ml}) must cover the longest sequence ({int(lens.max())}) "
+            "(reference sequence_pad enforces padded_length >= max length)")
+    starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
+
+    @primitive
+    def _pad(x, pad_value):
+        # gather row indices per (b, t); OOB slots point at row 0 and are
+        # overwritten by pad_value
+        idx = starts[:, None] + np.arange(ml)[None, :]
+        valid = np.arange(ml)[None, :] < lens[:, None]
+        idx = np.where(valid, np.clip(idx, 0, max(x.shape[0] - 1, 0)), 0)
+        out = x[jnp.asarray(idx)]
+        mask = jnp.asarray(valid).reshape((B, ml) + (1,) * (x.ndim - 1))
+        return jnp.where(mask, out, jnp.asarray(pad_value, x.dtype))
+
+    from ..tensor import Tensor as _T
+
+    out_lens = length if isinstance(length, _T) else wrap(jnp.asarray(lens))
+    return _pad(x, unwrap(pad_value)), out_lens
+
+
+def sequence_unpad(x, length, name=None):
+    """Inverse of sequence_pad: drop padding back to flat rows
+    (sequence_unpad op). Dynamic output rows — eager-only, like the
+    reference's LoD output; differentiable (concrete slice bounds inside
+    the taped closure)."""
+    lens = np.asarray(unwrap(length)).astype(np.int64)
+
+    @primitive
+    def _unpad(x):
+        rows = [x[b, : int(n)] for b, n in enumerate(lens)]
+        return jnp.concatenate(rows, axis=0) if rows else x[:0, 0]
+
+    return _unpad(x)
+
+
+def sequence_expand(x, y_lengths, ref_level=0, name=None):
+    """Repeat each row of x per the reference lengths (sequence_expand op's
+    common rank-0 use: x row i appears y_lengths[i] times)."""
+    if ref_level not in (0, -1):
+        raise NotImplementedError(
+            "sequence_expand supports the rank-0 repeat form "
+            "(ref_level 0 or -1); nested-LoD expansion has no dense analog")
+    lens = np.asarray(unwrap(y_lengths)).astype(np.int64)
+    if len(lens) != unwrap(x).shape[0]:
+        raise ValueError(
+            f"y_lengths has {len(lens)} entries but x has "
+            f"{unwrap(x).shape[0]} rows; each row needs a repeat count")
+
+    @primitive
+    def _exp(x):
+        idx = np.repeat(np.arange(len(lens)), lens)
+        return x[jnp.asarray(idx)]
+
+    return _exp(x)
+
+
+def sequence_reverse(x, length=None, name=None):
+    """Reverse each sequence's valid prefix, keeping padding in place
+    (sequence_reverse op). x: (B, T, ...); length optional (full reverse
+    when omitted)."""
+
+    @primitive
+    def _rev(x, lens):
+        T = x.shape[1]
+        pos = jnp.arange(T)[None, :]
+        if lens is None:
+            idx = T - 1 - pos
+            idx = jnp.broadcast_to(idx, x.shape[:2])
+        else:
+            ln = lens.astype(jnp.int32)[:, None]
+            valid = pos < ln
+            idx = jnp.where(valid, ln - 1 - pos, pos)
+        return jnp.take_along_axis(
+            x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32),
+            axis=1)
+
+    return _rev(x, None if length is None else unwrap(length))
+
+
+def sequence_softmax(x, length=None, name=None):
+    """Softmax over each sequence's valid prefix (sequence_softmax op).
+    x: (B, T); padding gets probability 0."""
+
+    @primitive
+    def _sm(x, lens):
+        if lens is None:
+            return jax.nn.softmax(x, axis=-1)
+        pos = jnp.arange(x.shape[1])[None, :]
+        valid = pos < lens.astype(jnp.int32)[:, None]
+        masked = jnp.where(valid, x, jnp.asarray(-1e9, x.dtype))
+        sm = jax.nn.softmax(masked, axis=-1)
+        return jnp.where(valid, sm, 0.0)
+
+    return _sm(x, None if length is None else unwrap(length))

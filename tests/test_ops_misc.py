@@ -107,3 +107,35 @@ def test_nanquantile():
     got = paddle.nanquantile(paddle.to_tensor(x), 0.5, axis=1)
     np.testing.assert_allclose(_np(got), np.nanquantile(x, 0.5, axis=1),
                                rtol=1e-6)
+
+
+class TestSequenceOps:
+    def test_pad_unpad_roundtrip(self):
+        flat = paddle.to_tensor(rng.standard_normal((7, 3)).astype("float32"))
+        lens = paddle.to_tensor(np.array([3, 4], "int64"))
+        padded, out_lens = paddle.sequence_pad(flat, -1.0, length=lens)
+        assert tuple(padded.shape) == (2, 4, 3)
+        assert np.allclose(_np(padded)[0, 3], -1.0)
+        back = paddle.sequence_unpad(padded, out_lens)
+        np.testing.assert_allclose(_np(back), _np(flat))
+
+    def test_pad_maxlen_and_grad(self):
+        flat = paddle.to_tensor(rng.standard_normal((4, 2)).astype("float32"))
+        flat.stop_gradient = False
+        padded, _ = paddle.sequence_pad(
+            flat, 0.0, maxlen=5, length=paddle.to_tensor(np.array([1, 3], "int64")))
+        assert tuple(padded.shape) == (2, 5, 2)
+        padded.sum().backward()
+        np.testing.assert_allclose(_np(flat.grad), np.ones((4, 2)))
+
+    def test_expand_reverse_softmax(self):
+        x = paddle.to_tensor(np.array([[1.0], [2.0]], "float32"))
+        exp = paddle.sequence_expand(x, paddle.to_tensor(np.array([1, 2], "int64")))
+        assert _np(exp)[:, 0].tolist() == [1, 2, 2]
+        seq = paddle.to_tensor(np.arange(8, dtype="float32").reshape(2, 4))
+        rev = paddle.sequence_reverse(seq, paddle.to_tensor(np.array([2, 4], "int64")))
+        np.testing.assert_allclose(_np(rev)[0], [1, 0, 2, 3])
+        np.testing.assert_allclose(_np(rev)[1], [7, 6, 5, 4])
+        sm = paddle.sequence_softmax(seq, paddle.to_tensor(np.array([2, 4], "int64")))
+        np.testing.assert_allclose(_np(sm).sum(-1), [1, 1], rtol=1e-6)
+        assert np.allclose(_np(sm)[0, 2:], 0)
