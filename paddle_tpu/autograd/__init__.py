@@ -16,16 +16,17 @@ __all__ = [
     "is_grad_enabled",
     "set_grad_enabled",
     "PyLayer",
+    "PyLayerContext",
 ]
 
 
 def __getattr__(name):
     # PyLayer / functional live in submodules that import ops; load lazily to
     # keep the core import graph acyclic.
-    if name == "PyLayer":
-        from .py_layer import PyLayer
+    if name in ("PyLayer", "PyLayerContext"):
+        from . import py_layer
 
-        return PyLayer
+        return getattr(py_layer, name)
     if name in ("jacobian", "hessian", "vjp", "jvp"):
         from . import functional
 

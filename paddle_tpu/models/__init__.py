@@ -5,7 +5,8 @@ on top of fleet meta-parallel layers; here the model zoo is in-tree, built
 directly on paddle_tpu.distributed.meta_parallel so every parallelism
 axis (dp/mp/pp/sharding/sp/ep) applies to each family.
 """
-from . import bert, gpt  # noqa: F401
+from . import bert, generation, gpt  # noqa: F401
+from .generation import generate  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig,
     BertForPretraining,

@@ -1,0 +1,106 @@
+"""Autoregressive generation for the GPT family with incremental KV cache.
+
+Parity role: the reference serves generation through its inference stack
+(AnalysisPredictor over exported programs plus PaddleNLP's generate);
+here generation is first-class on the flagship model: prefill once, then
+single-token steps against per-layer K/V caches (the standard
+incremental-decoding decomposition — each step is O(T) attention instead of
+re-running the O(T^2) full forward).
+
+Sampling: greedy, temperature, top-k and top-p (nucleus), driven by the
+framework's seeded PRNG so paddle.seed reproduces generations.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import no_grad
+from ..ops._primitive import unwrap, wrap
+from ..random import split_key
+from ..tensor import Tensor
+
+__all__ = ["generate"]
+
+
+def _attn_layers(model):
+    from .gpt import GPTAttention
+
+    return [m for m in model.sublayers() if isinstance(m, GPTAttention)]
+
+
+def _sample(logits, temperature, top_k, top_p):
+    """logits (B, V) -> token ids (B,)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k is not None and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -1e9, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p; find its cutoff logit
+        keep_n = jnp.sum(cum - probs < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, keep_n - 1, axis=-1)
+        logits = jnp.where(logits < cutoff, -1e9, logits)
+    return jax.random.categorical(split_key(), logits, axis=-1)
+
+
+def generate(model, input_ids, max_new_tokens=32, eos_token_id=None,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None, use_cache: bool = True):
+    """Generate continuations for a batch of prompts.
+
+    model: GPTForPretraining (or GPTModel + tied head via it).
+    input_ids: (B, T0) int tensor/array. Returns (B, T0 + n) int64 Tensor
+    (n <= max_new_tokens; shorter only when every row hit eos).
+    """
+    ids = unwrap(input_ids)
+    if isinstance(ids, Tensor):
+        ids = ids._data
+    ids = jnp.asarray(np.asarray(ids)).astype(jnp.int32)
+    b, t0 = ids.shape
+    was_training = model.training
+    model.eval()
+    attns = _attn_layers(model) if use_cache else []
+
+    def fwd(tokens, position_ids=None):
+        out = model(wrap(tokens) if not isinstance(tokens, Tensor) else tokens,
+                    position_ids)
+        return unwrap(out)
+
+    try:
+        with no_grad():
+            if use_cache:
+                for a in attns:
+                    a._gen_cache = {"k": None, "v": None}
+            logits = fwd(ids)  # prefill
+            finished = jnp.zeros((b,), bool)
+            for step in range(int(max_new_tokens)):
+                nxt = _sample(logits[:, -1].astype(jnp.float32),
+                              temperature, top_k, top_p).astype(jnp.int32)
+                if eos_token_id is not None:
+                    nxt = jnp.where(finished, eos_token_id, nxt)
+                    finished = finished | (nxt == eos_token_id)
+                ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+                if eos_token_id is not None and bool(finished.all()):
+                    break
+                if step == int(max_new_tokens) - 1:
+                    break  # no need to compute logits for an unused step
+                if use_cache:
+                    pos = wrap(jnp.full((b, 1), ids.shape[1] - 1, jnp.int32))
+                    logits = fwd(nxt[:, None], pos)
+                else:
+                    logits = fwd(ids)
+    finally:
+        for a in attns:
+            if hasattr(a, "_gen_cache"):
+                del a._gen_cache
+        if was_training:
+            model.train()
+    return wrap(ids.astype(jnp.int64))

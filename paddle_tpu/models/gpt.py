@@ -140,12 +140,31 @@ class GPTAttention(Layer):
         self.qkv_proj = ColumnParallelLinear(h, 3 * h, gather_output=False)
         self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
 
+    def _finish(self, out, b, t):
+        """Shared epilogue: [B, H, T, D] -> out_proj([B, T, H*D])."""
+        out = manip.transpose(out, [0, 2, 1, 3])
+        out = manip.reshape(out, [b, t, self.num_heads * self.head_dim])
+        return self.out_proj(out)
+
     def forward(self, x):
         b, t = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)  # [B, T, 3H]
         qkv = manip.reshape(qkv, [b, t, 3, self.num_heads, self.head_dim])
         qkv = manip.transpose(qkv, [2, 0, 3, 1, 4])  # [3, B, H, T, D]
         q, k, v = qkv[0], qkv[1], qkv[2]
+        # incremental-decoding KV cache (models/generation.py owns the
+        # lifecycle; None = normal training/eval forward)
+        cache = getattr(self, "_gen_cache", None)
+        if cache is not None:
+            if cache.get("k") is not None:
+                k = manip.concat([cache["k"], k], axis=2)
+                v = manip.concat([cache["v"], v], axis=2)
+            self._gen_cache = {"k": k, "v": v}
+            # prefill (q spans the whole prompt) needs the causal mask;
+            # single-token steps attend the full cache
+            causal = q.shape[2] == k.shape[2]
+            out, _ = scaled_dot_product_attention(q, k, v, is_causal=causal)
+            return self._finish(out, b, t)
         if self.sequence_parallel != "none":
             from ..distributed.meta_parallel.sequence_parallel import (
                 ring_attention,
@@ -164,9 +183,7 @@ class GPTAttention(Layer):
                         "and use hidden_dropout_prob instead")
                 fn = ring_attention if self.sequence_parallel == "ring" else ulysses_attention
                 out = fn(q, k, v, causal=True)
-                out = manip.transpose(out, [0, 2, 1, 3])
-                out = manip.reshape(out, [b, t, self.num_heads * self.head_dim])
-                return self.out_proj(out)
+                return self._finish(out, b, t)
         q = _constrain_heads(q)
         k = _constrain_heads(k)
         v = _constrain_heads(v)
@@ -174,9 +191,7 @@ class GPTAttention(Layer):
             q, k, v, is_causal=True,
             dropout_p=self.dropout_p if self.training else 0.0,
         )
-        out = manip.transpose(out, [0, 2, 1, 3])
-        out = manip.reshape(out, [b, t, self.num_heads * self.head_dim])
-        return self.out_proj(out)
+        return self._finish(out, b, t)
 
 
 class GPTMLP(Layer):

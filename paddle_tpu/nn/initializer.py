@@ -29,6 +29,7 @@ __all__ = [
     "KaimingUniform",
     "Assign",
     "calculate_gain",
+    "Bilinear",
 ]
 
 
@@ -159,3 +160,31 @@ def calculate_gain(nonlinearity: str, param: float = 0.0) -> float:
         "selu": 3.0 / 4.0,
     }
     return gains.get(nonlinearity, 1.0)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed conv (parity:
+    nn.initializer.Bilinear — weights such that conv_transpose performs
+    bilinear interpolation). Weight layout matches Conv*Transpose here:
+    (C_in, C_out/groups, kh, kw)."""
+
+    def __call__(self, shape, dtype=jnp.float32):
+        import numpy as np
+
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D weight")
+        c_in, c_out, kh, kw = shape
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        cy = f_h - 1 if kh % 2 == 1 else f_h - 0.5
+        cx = f_w - 1 if kw % 2 == 1 else f_w - 0.5
+        og = np.ogrid[:kh, :kw]
+        filt = ((1 - np.abs(og[0] - cy) / f_h)
+                * (1 - np.abs(og[1] - cx) / f_w)).astype(np.float32)
+        w = np.zeros(shape, np.float32)
+        # every (in, out) channel pair on the diagonal (mod the smaller
+        # extent) carries the interpolation filter so no channel is dead
+        for i in range(c_in):
+            for j in range(c_out):
+                if i % max(c_out, 1) == j or j % max(c_in, 1) == i:
+                    w[i, j] = filt
+        return jnp.asarray(w, dtype)

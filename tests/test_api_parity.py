@@ -338,3 +338,26 @@ class TestTracedLayer:
         loaded = jit.load(str(tmp_path / "traced"))
         np.testing.assert_allclose(np.asarray(loaded(x)._data), _np(lin(x)),
                                    rtol=1e-5)
+
+
+class TestInitializerExtras:
+    def test_bilinear_upsampling_kernel(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.initializer import Bilinear
+
+        # conv_transpose with the bilinear kernel interpolates a constant
+        # image back to a constant (partition of unity in the interior)
+        up = nn.Conv2DTranspose(1, 1, 4, stride=2, padding=1,
+                                weight_attr=None, bias_attr=False)
+        import jax.numpy as jnp
+
+        up.weight._set_data(jnp.asarray(np.asarray(Bilinear()(tuple(up.weight.shape)))))
+        x = paddle.to_tensor(np.ones((1, 1, 4, 4), "float32"))
+        out = _np(up(x))
+        assert out.shape == (1, 1, 8, 8)
+        np.testing.assert_allclose(out[0, 0, 2:6, 2:6], 1.0, rtol=1e-5)
+
+    def test_pylayer_context_export(self):
+        from paddle_tpu.autograd import PyLayer, PyLayerContext
+
+        assert PyLayer is not None and PyLayerContext is not None
