@@ -115,6 +115,7 @@ _LAZY_SUBMODULES = (
     "reader",
     "compat",
     "linalg",
+    "version",
 )
 
 

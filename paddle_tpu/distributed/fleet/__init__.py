@@ -3,7 +3,13 @@ from . import elastic  # noqa: F401
 from .. import meta_parallel  # noqa: F401
 from ..topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode  # noqa: F401
 from .distributed_strategy import DistributedStrategy  # noqa: F401
-from .fleet_base import Fleet, PaddleCloudRoleMaker, UserDefinedRoleMaker, fleet  # noqa: F401
+from .fleet_base import (  # noqa: F401
+    Fleet,
+    PaddleCloudRoleMaker,
+    UserDefinedRoleMaker,
+    UtilBase,
+    fleet,
+)
 
 # module-level function surface (parity: fleet/__init__.py delegates to the
 # singleton)
@@ -20,3 +26,11 @@ is_first_worker = fleet.is_first_worker
 worker_endpoints = fleet.worker_endpoints
 barrier_worker = fleet.barrier_worker
 minimize = fleet.minimize
+server_num = fleet.server_num
+init_worker = fleet.init_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+stop_worker = fleet.stop_worker
+save_persistables = fleet.save_persistables
+save_inference_model = fleet.save_inference_model
+util = fleet.util

@@ -123,6 +123,57 @@ class Fleet:
 
         barrier()
 
+    def server_num(self):
+        """PS servers don't exist on the TPU build (parity: fleet.server_num
+        — the embedding-table role is mesh-sharded, README out-of-scope)."""
+        return 0
+
+    def init_worker(self):
+        """PS worker init is a no-op on the collective TPU build."""
+
+    def init_server(self, *args, **kwargs):
+        raise RuntimeError(
+            "parameter-server mode is out of scope on the TPU build "
+            "(README); use collective training over the mesh")
+
+    def run_server(self):
+        raise RuntimeError(
+            "parameter-server mode is out of scope on the TPU build "
+            "(README); use collective training over the mesh")
+
+    def stop_worker(self):
+        """No persistent PS workers to stop (collective mode)."""
+
+    def save_persistables(self, executor, dirname, main_program=None, mode=0):
+        """Parity: fleet.save_persistables — static program-state save."""
+        from ...static.compat import save as static_save
+
+        if main_program is None:
+            from ...static.program import default_main_program
+
+            main_program = default_main_program()
+        import os
+
+        static_save(main_program, os.path.join(dirname, "fleet_ckpt"))
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True, mode=0):
+        """Parity: fleet.save_inference_model — name strings are resolved to
+        the program's feed Variables, then exported via StableHLO."""
+        import os
+
+        from ...static import save_inference_model as sim
+        from ...static.program import default_main_program
+
+        prog = main_program or default_main_program()
+        by_name = dict(getattr(prog, "feed_vars", {}))
+        feed_vars = [by_name[n] if isinstance(n, str) else n
+                     for n in (feeded_var_names or [])]
+        if not feed_vars:
+            raise ValueError("feeded_var_names must name at least one feed")
+        sim(os.path.join(dirname, "model"), feed_vars, target_vars, executor)
+
     # model/optimizer wrapping ----------------------------------------
     def distributed_model(self, model):
         """Parity: fleet.distributed_model — wraps by parallel mode."""
@@ -186,9 +237,14 @@ class Fleet:
 
         return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
 
-    # checkpoint surface ----------------------------------------------
-    def save_persistables(self, executor=None, dirname: str = "", main_program=None, mode=0):
-        raise NotImplementedError("use paddle.save(model.state_dict(), path) on TPU")
+    # checkpoint surface lives above (save_persistables / save_inference_model)
+
+    @property
+    def util(self):
+        """Shared UtilBase (reference exposes a module-level singleton)."""
+        if not hasattr(self, "_util"):
+            self._util = UtilBase()
+        return self._util
 
     def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
         """Dygraph parity path: backward + hybrid step."""
@@ -199,3 +255,58 @@ class Fleet:
 
 
 fleet = Fleet()
+
+
+class UtilBase:
+    """fleet.util parity (reference fleet/base/util_factory.py): small
+    cross-worker helpers over the collective API."""
+
+    def all_reduce(self, input, mode="sum"):  # noqa: A002
+        import numpy as np
+
+        from ..collective import all_reduce as _ar
+        from ...tensor import Tensor
+
+        import jax.numpy as jnp
+
+        t = input if isinstance(input, Tensor) else Tensor(jnp.asarray(np.asarray(input)))
+        from ..group import ReduceOp
+
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX, "min": ReduceOp.MIN}[mode]
+        return _ar(t, op=op)
+
+    def barrier(self):
+        from ..collective import barrier
+
+        barrier()
+
+    def all_gather(self, input):  # noqa: A002
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from ..collective import all_gather as _ag
+        from ...tensor import Tensor
+
+        t = input if isinstance(input, Tensor) else Tensor(jnp.asarray(np.asarray(input)))
+        out = []
+        _ag(out, t)
+        return out
+
+    def get_file_shard(self, files):
+        """Contiguous even split of a file list across workers (reference
+        util_factory.get_file_shard: blocks, remainder to the first ranks)."""
+        from ..env import get_rank, get_world_size
+
+        n, r = get_world_size(), get_rank()
+        base, rem = divmod(len(files), n)
+        begin = r * base + min(r, rem)
+        end = begin + base + (1 if r < rem else 0)
+        return list(files[begin:end])
+
+    def print_on_rank(self, message, rank_id=0):
+        from ..env import get_rank
+
+        if get_rank() == rank_id:
+            print(message)
+

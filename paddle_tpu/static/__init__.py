@@ -128,17 +128,32 @@ def save_inference_model(path_prefix: str, feed_vars: Sequence[Variable],
     fetch_vars = list(fetch_vars)
     program = program if program is not None else feed_vars[0]._program
 
+    # prune to the feed->fetch slice (parity: fluid io.py prunes the program
+    # before export — the loss/optimizer branch and its label feeds drop out)
+    needed = {v.name for v in fetch_vars}
+    pruned_ops = []
+    for op in reversed(program.ops):
+        if any(v.name in needed for v in op.out_vars):
+            pruned_ops.append(op)
+            for x in op.flat_args:
+                if isinstance(x, Variable):
+                    needed.add(x.name)
+    pruned_ops.reverse()
+
     captures = program.captures()
     capture_names = [v.name for (_, v) in captures]
     capture_arrays = [t._data for (t, _) in captures]
     feed_names = [v.name for v in feed_vars]
     rng_used = program.rng_used
 
+    class _PrunedView:
+        ops = pruned_ops
+
     def infer_fn(capture_arrays, rng_key, *feed_arrays):
         env = dict(zip(capture_names, capture_arrays))
         env.update(zip(feed_names, feed_arrays))
         env["__rng_key__"] = rng_key
-        env = _replay(program, env)
+        env = _replay(_PrunedView, env)
         return [env[v.name] for v in fetch_vars]
 
     # symbolic dims exactly where the user declared None/-1 in static.data;

@@ -1,0 +1,24 @@
+"""Version info (parity: paddle/version.py generated at build time)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = False
+commit = "tpu-native"
+with_mkl = "OFF"
+cuda_version = "False"  # TPU build
+cudnn_version = "False"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -361,3 +361,55 @@ class TestInitializerExtras:
         from paddle_tpu.autograd import PyLayer, PyLayerContext
 
         assert PyLayer is not None and PyLayerContext is not None
+
+
+class TestFleetSurface:
+    def test_ps_surface_and_util(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        fleet.init(is_collective=True)
+        assert fleet.server_num() == 0
+        fleet.init_worker()   # no-op in collective mode
+        fleet.stop_worker()
+        with pytest.raises(RuntimeError):
+            fleet.run_server()
+        u = fleet.fleet.util
+        assert u.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]
+        out = u.all_gather(np.array([1.0], "float32"))
+        assert len(out) >= 1
+
+    def test_version_module(self):
+        assert paddle.version.full_version == paddle.__version__
+        assert paddle.version.cuda() == "False"
+
+
+class TestFleetCheckpointSurface:
+    def test_save_persistables_and_inference_model(self, tmp_path):
+        import paddle_tpu.distributed.fleet as fleet
+        import paddle_tpu.static as static
+
+        fleet.init(is_collective=True)
+        try:
+            paddle.enable_static()
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [None, 3], "float32")
+                w = static.create_parameter([3, 2], "float32", name="w")
+                out = paddle.matmul(x, w)
+            exe = static.Executor()
+            exe.run(startup)
+            fleet.save_persistables(exe, str(tmp_path), main_program=main)
+            assert (tmp_path / "fleet_ckpt.pdparams").exists()
+            fleet.save_inference_model(exe, str(tmp_path), ["x"], [out],
+                                       main_program=main)
+            assert any(f.name.startswith("model") for f in tmp_path.iterdir())
+        finally:
+            paddle.disable_static()
+
+    def test_contiguous_file_shard(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        fleet.init(is_collective=True)
+        # world size 1: everything, in order
+        assert fleet.util.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]
