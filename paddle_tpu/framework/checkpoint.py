@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import json
 import os
-import pickle
 import shutil
 import tempfile
 import threading
@@ -33,7 +32,19 @@ __all__ = ["CheckpointManager", "save_checkpoint", "load_checkpoint"]
 
 _META = "meta.json"
 _ARRAYS = "arrays.npz"
-_PYTREE = "pytree.pkl"
+_PYTREE = "pytree.json"
+
+
+def _py_default(obj):
+    """JSON fallback for numpy scalars in pyvals. Arbitrary objects are
+    rejected on purpose: the pytree blob is plain JSON so loading an
+    untrusted checkpoint can never execute code (arrays already load via
+    np.load(allow_pickle=False))."""
+    if isinstance(obj, np.generic):
+        return obj.item()
+    raise TypeError(
+        f"checkpoint python values must be JSON-serializable, got "
+        f"{type(obj).__name__}; convert it before saving")
 
 
 def _spec_of(arr) -> Optional[list]:
@@ -77,8 +88,9 @@ class CheckpointManager:
     """Step-keyed snapshot directory: ``<dir>/step_<N>/``.
 
     ``state`` may be any nesting of dict/list/tuple holding Tensors, jax/numpy
-    arrays, and plain picklable python values (steps, RNG seeds, dataloader
-    cursors).
+    arrays, and JSON-serializable python values (steps, RNG seeds, dataloader
+    cursors); the structure blob is plain JSON so loading a checkpoint never
+    executes code.
     """
 
     def __init__(self, directory: str, keep_max: int = 3, async_save: bool = False):
@@ -111,31 +123,35 @@ class CheckpointManager:
             else:
                 arrays[path] = np.asarray(leaf)
         treedef = _TreeSpec.from_state(state)
+        # serialize the structure blob NOW, on the caller's thread: a
+        # non-JSON value must raise here, not vanish inside the async writer
+        tree_blob = json.dumps({"treedef": treedef.to_json(),
+                                "pyvals": pyvals}, default=_py_default)
 
         if self.async_save:
             self.wait()
             self._thread = threading.Thread(
                 target=self._write,
-                args=(step, arrays, pyvals, specs, prng_keys, treedef, metadata),
+                args=(step, arrays, tree_blob, specs, prng_keys, metadata),
                 daemon=True,
             )
             self._thread.start()
         else:
-            self._write(step, arrays, pyvals, specs, prng_keys, treedef, metadata)
+            self._write(step, arrays, tree_blob, specs, prng_keys, metadata)
 
     def wait(self):
         if self._thread is not None:
             self._thread.join()
             self._thread = None
 
-    def _write(self, step, arrays, pyvals, specs, prng_keys, treedef, metadata):
+    def _write(self, step, arrays, tree_blob, specs, prng_keys, metadata):
         final = os.path.join(self.directory, f"step_{step}")
         tmp = tempfile.mkdtemp(prefix=f".tmp_step_{step}_", dir=self.directory)
         try:
             with open(os.path.join(tmp, _ARRAYS), "wb") as f:
                 np.savez(f, **{k.replace("/", "|"): v for k, v in arrays.items()})
-            with open(os.path.join(tmp, _PYTREE), "wb") as f:
-                pickle.dump({"treedef": treedef, "pyvals": pyvals}, f)
+            with open(os.path.join(tmp, _PYTREE), "w") as f:
+                f.write(tree_blob)
             with open(os.path.join(tmp, _META), "w") as f:
                 json.dump({"step": step, "specs": specs,
                            "prng_keys": prng_keys,
@@ -181,8 +197,18 @@ class CheckpointManager:
         d = os.path.join(self.directory, f"step_{step}")
         with open(os.path.join(d, _META)) as f:
             meta = json.load(f)
-        with open(os.path.join(d, _PYTREE), "rb") as f:
-            tree = pickle.load(f)
+        tree_path = os.path.join(d, _PYTREE)
+        if not os.path.exists(tree_path) and os.path.exists(
+                os.path.join(d, "pytree.pkl")):
+            raise RuntimeError(
+                f"{d} holds a legacy pickle-format checkpoint; the pickle "
+                "format was dropped (loading untrusted pickles can execute "
+                "code). Re-save it with the current version, or load the "
+                "arrays directly from arrays.npz.")
+        with open(tree_path) as f:
+            raw = json.load(f)
+        tree = {"treedef": _TreeSpec.from_json(raw["treedef"]),
+                "pyvals": raw["pyvals"]}
         data = np.load(os.path.join(d, _ARRAYS), allow_pickle=False)
 
         if mesh is None:
@@ -213,12 +239,27 @@ class CheckpointManager:
 
 
 class _TreeSpec:
-    """Pickle-safe structure record mirroring _flatten_state's traversal."""
+    """JSON-safe structure record mirroring _flatten_state's traversal."""
 
     def __init__(self, kind, keys=None, children=None):
         self.kind = kind          # 'leaf' | 'py' | 'dict' | 'list' | 'tuple' | 'tensor'
         self.keys = keys
         self.children = children
+
+    def to_json(self):
+        out = {"kind": self.kind}
+        if self.keys is not None:
+            out["keys"] = self.keys
+        if self.children is not None:
+            out["children"] = [c.to_json() for c in self.children]
+        return out
+
+    @classmethod
+    def from_json(cls, d):
+        children = d.get("children")
+        return cls(d["kind"], keys=d.get("keys"),
+                   children=[cls.from_json(c) for c in children]
+                   if children is not None else None)
 
     @classmethod
     def from_state(cls, obj):
@@ -228,6 +269,13 @@ class _TreeSpec:
             return cls("leaf")
         if isinstance(obj, dict):
             keys = sorted(obj, key=str)
+            for k in keys:
+                # keys must round-trip through JSON unchanged; tuples etc.
+                # would save fine but make the snapshot unloadable
+                if not isinstance(k, (str, int, float, bool)):
+                    raise TypeError(
+                        f"checkpoint dict keys must be str/int/float/bool, "
+                        f"got {type(k).__name__}: {k!r}")
             return cls("dict", keys=keys,
                        children=[cls.from_state(obj[k]) for k in keys])
         if isinstance(obj, (list, tuple)):

@@ -15,17 +15,37 @@ __all__ = ["weight_norm", "remove_weight_norm", "spectral_norm"]
 
 
 def _norm_except(w, dim):
+    """L2 norm over all axes but ``dim`` (keepdims); whole-tensor scalar
+    norm when ``dim`` is None (reference weight_norm: norm_except_dim)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(w * w))
     red = tuple(i for i in range(w.ndim) if i != dim)
     return jnp.sqrt(jnp.sum(w * w, axis=red, keepdims=True))
 
 
+def _g_broadcast(g, ndim, dim):
+    """Reshape the stored g (vector [w.shape[dim]], or scalar for
+    dim=None) to its keepdims broadcast shape."""
+    if dim is None:
+        return g
+    shape = [1] * ndim
+    shape[dim] = -1
+    return g.reshape(shape)
+
+
 def weight_norm(layer, name="weight", dim=0):
     """Reparameterize ``layer.<name>`` as g * v / ||v|| (weight_norm op
-    parity). Registers <name>_g and <name>_v; forward recomputes weight."""
+    parity). Registers <name>_g and <name>_v; forward recomputes weight.
+    ``dim=None`` normalizes over the whole tensor with a scalar g; else
+    ``<name>_g`` is stored as a vector of length ``w.shape[dim]`` matching
+    the reference's state-dict shape."""
     w = getattr(layer, name)
-    dim = dim if dim is not None else 0
     arr = unwrap(w)
+    if dim is not None and dim < 0:
+        dim += arr.ndim
     g0 = _norm_except(arr, dim)
+    if dim is not None:
+        g0 = g0.reshape(-1)
     v = Tensor(arr, stop_gradient=False)
     g = Tensor(g0, stop_gradient=False)
     del layer._parameters[name]
@@ -36,7 +56,8 @@ def weight_norm(layer, name="weight", dim=0):
 
     @primitive
     def _compose(v, g):
-        return g * v / jnp.maximum(_norm_except(v, dim), 1e-12)
+        gb = _g_broadcast(g, v.ndim, dim)
+        return gb * v / jnp.maximum(_norm_except(v, dim), 1e-12)
 
     def forward(*args, **kwargs):
         object.__setattr__(layer, "_wn_cache", _compose(
@@ -57,7 +78,9 @@ def remove_weight_norm(layer, name="weight"):
     v = layer._parameters.pop(name + "_v")
     g = layer._parameters.pop(name + "_g")
     dim = layer._wn_dim
-    w = unwrap(g) * unwrap(v) / jnp.maximum(_norm_except(unwrap(v), dim), 1e-12)
+    varr = unwrap(v)
+    gb = _g_broadcast(unwrap(g), varr.ndim, dim)
+    w = gb * varr / jnp.maximum(_norm_except(varr, dim), 1e-12)
     layer._parameters[name] = Tensor(w, stop_gradient=False)
     layer.forward = layer._wn_orig_forward
     return layer

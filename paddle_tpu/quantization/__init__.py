@@ -96,29 +96,49 @@ def _fq_fixed(x, scale, bits):
     return _qdq_ste(x, scale, _levels(bits))
 
 
-def fake_quantize_moving_average_abs_max(x, state_scale, bit_length=8,
-                                         moving_rate=0.9, training=True):
-    """Quant-dequant with an EMA abs-max scale. Returns (out, new_scale).
+def _ma_update(cur, accum, state, moving_rate):
+    # bias-corrected moving-average rule (reference fake_quantize_op.h
+    # FindMovingAverageAbsMaxFunctor): the first step yields the full
+    # abs-max instead of a fraction of it.
+    accum = jnp.zeros((), jnp.float32) if accum is None else unwrap(accum)
+    state = jnp.zeros((), jnp.float32) if state is None else unwrap(state)
+    new_accum = moving_rate * accum + cur
+    new_state = moving_rate * state + 1.0
+    return new_accum / new_state, new_accum, new_state
 
-    state update (reference fake_quantize_op.cc moving-average rule):
-        scale = rate * scale + (1 - rate) * abs_max(x)
+
+def fake_quantize_moving_average_abs_max(x, scale, accum=None, state=None, *,
+                                         bit_length=8, moving_rate=0.9,
+                                         training=True):
+    """Quant-dequant with a bias-corrected moving-average abs-max scale.
+    Returns ``(out, scale, accum, state)``.
+
+    state update (reference fake_quantize_op.h moving-average rule):
+        accum = rate * accum + abs_max(x)
+        state = rate * state + 1
+        scale = accum / state
     """
     arr = unwrap(x)
     cur = jnp.max(jnp.abs(arr if not isinstance(arr, Tensor) else arr._data))
-    old = unwrap(state_scale)
     if training:
-        new_scale = moving_rate * old + (1.0 - moving_rate) * cur
+        new_scale, new_accum, new_state = _ma_update(cur, accum, state,
+                                                     moving_rate)
     else:
-        new_scale = old
+        zero = jnp.zeros((), jnp.float32)
+        new_scale = unwrap(scale)
+        new_accum = zero if accum is None else unwrap(accum)
+        new_state = zero if state is None else unwrap(state)
     out = _fq_fixed(x, new_scale, int(bit_length))
-    return out, wrap(new_scale) if not isinstance(new_scale, Tensor) else new_scale
+    return out, wrap(new_scale), wrap(new_accum), wrap(new_state)
 
 
-def moving_average_abs_max_scale(x, state_scale, moving_rate=0.9):
-    """Track the EMA abs-max of a tensor without quantizing (reference
-    moving_average_abs_max_scale op — used to record output scales)."""
+def moving_average_abs_max_scale(x, accum=None, state=None, moving_rate=0.9):
+    """Track the moving-average abs-max of a tensor without quantizing
+    (reference moving_average_abs_max_scale op — used to record output
+    scales). Returns ``(scale, accum, state)``."""
     cur = jnp.max(jnp.abs(unwrap(x)))
-    return wrap(moving_rate * unwrap(state_scale) + (1.0 - moving_rate) * cur)
+    new_scale, new_accum, new_state = _ma_update(cur, accum, state, moving_rate)
+    return wrap(new_scale), wrap(new_accum), wrap(new_state)
 
 
 # ---------------------------------------------------------------------------
@@ -136,6 +156,8 @@ class _QuantWrapperBase(Layer):
         self._wtype = weight_quantize_type
         self._waxis = weight_quant_axis
         self.register_buffer("_act_scale", Tensor(jnp.zeros((), jnp.float32)))
+        self.register_buffer("_act_accum", Tensor(jnp.zeros((), jnp.float32)))
+        self.register_buffer("_act_state", Tensor(jnp.zeros((), jnp.float32)))
         self._calibrating = False
 
     def _quant_weight(self, w):
@@ -146,11 +168,14 @@ class _QuantWrapperBase(Layer):
         return out
 
     def _quant_act(self, x):
-        out, new_scale = fake_quantize_moving_average_abs_max(
-            x, self._act_scale, self._abits, self._rate,
-            training=self.training or self._calibrating)
-        if self.training or self._calibrating:
-            self._act_scale._set_data(unwrap(new_scale))
+        updating = self.training or self._calibrating
+        out, scale, accum, state = fake_quantize_moving_average_abs_max(
+            x, self._act_scale, self._act_accum, self._act_state,
+            bit_length=self._abits, moving_rate=self._rate, training=updating)
+        if updating:
+            self._act_scale._set_data(unwrap(scale))
+            self._act_accum._set_data(unwrap(accum))
+            self._act_state._set_data(unwrap(state))
         return out
 
     @property

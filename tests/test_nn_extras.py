@@ -263,6 +263,28 @@ class TestContainersAndUtils:
         nn.utils.remove_weight_norm(lin)
         np.testing.assert_allclose(_np(lin.weight), w0, rtol=1e-5, atol=1e-6)
 
+    def test_weight_norm_g_shape(self):
+        # weight_g is stored as a vector [w.shape[dim]] (reference
+        # state-dict shape), not keepdims
+        lin = nn.Linear(4, 3)
+        nn.utils.weight_norm(lin, dim=1)
+        g = dict(lin.named_parameters())["weight_g"]
+        assert _np(g).shape == (3,)
+
+    def test_weight_norm_dim_none(self):
+        # dim=None: whole-tensor norm with scalar g
+        lin = nn.Linear(4, 3)
+        w0 = _np(lin.weight).copy()
+        nn.utils.weight_norm(lin, dim=None)
+        g = dict(lin.named_parameters())["weight_g"]
+        assert _np(g).shape == ()
+        np.testing.assert_allclose(_np(g), np.linalg.norm(w0), rtol=1e-6)
+        x = paddle.to_tensor(rng.standard_normal((2, 4)).astype("float32"))
+        np.testing.assert_allclose(
+            _np(lin(x)), _np(x) @ w0 + _np(lin.bias), rtol=1e-4, atol=1e-5)
+        nn.utils.remove_weight_norm(lin)
+        np.testing.assert_allclose(_np(lin.weight), w0, rtol=1e-5, atol=1e-6)
+
     def test_spectral_norm_util(self):
         lin = nn.Linear(6, 4)
         nn.utils.spectral_norm(lin, n_power_iterations=20)

@@ -39,14 +39,22 @@ class TestFakeQuantOps:
 
     def test_moving_average(self):
         x1 = paddle.to_tensor(np.array([2.0, -4.0], "float32"))
-        state = paddle.to_tensor(np.asarray(1.0, dtype="float32"))
-        out, new_scale = Q.fake_quantize_moving_average_abs_max(
-            x1, state, 8, moving_rate=0.9)
-        np.testing.assert_allclose(_np(new_scale), 0.9 * 1.0 + 0.1 * 4.0, rtol=1e-6)
+        scale0 = paddle.to_tensor(np.asarray(0.0, dtype="float32"))
+        # bias-corrected rule: first step yields the full abs-max
+        out, s1, a1, st1 = Q.fake_quantize_moving_average_abs_max(
+            x1, scale0, moving_rate=0.9)
+        np.testing.assert_allclose(_np(s1), 4.0, rtol=1e-6)
+        np.testing.assert_allclose(_np(a1), 4.0, rtol=1e-6)
+        np.testing.assert_allclose(_np(st1), 1.0, rtol=1e-6)
+        # second step: accum=0.9*4+2, state=0.9+1
+        x2 = paddle.to_tensor(np.array([2.0, -1.0], "float32"))
+        out2, s2, a2, st2 = Q.fake_quantize_moving_average_abs_max(
+            x2, s1, a1, st1, moving_rate=0.9)
+        np.testing.assert_allclose(_np(s2), (0.9 * 4.0 + 2.0) / 1.9, rtol=1e-6)
         # eval mode: scale frozen
-        out2, frozen = Q.fake_quantize_moving_average_abs_max(
-            x1, new_scale, 8, moving_rate=0.9, training=False)
-        np.testing.assert_allclose(_np(frozen), _np(new_scale))
+        out3, frozen, _, _ = Q.fake_quantize_moving_average_abs_max(
+            x1, s2, a2, st2, moving_rate=0.9, training=False)
+        np.testing.assert_allclose(_np(frozen), _np(s2))
 
     def test_ste_gradient(self):
         x = paddle.to_tensor(rng.standard_normal((3, 3)).astype("float32"))
