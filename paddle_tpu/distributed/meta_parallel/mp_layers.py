@@ -35,9 +35,57 @@ __all__ = [
     "RowParallelLinear",
     "ParallelCrossEntropy",
     "mp_axis_bound",
+    "mp_identity_array",
 ]
 
 MP_AXIS = "mp"
+
+
+@jax.custom_vjp
+def mp_identity_array(x):
+    """c_identity parity (c_identity_op.cc): forward identity, backward
+    all-reduce over 'mp'. Every explicit-SPMD column-parallel input must pass
+    through this so the partial input-cotangents of the mp ranks recombine —
+    without it, params upstream of a TP block (embeddings, layer norms)
+    would receive per-rank partial gradients."""
+    return x
+
+
+def _mp_identity_fwd(x):
+    return x, None
+
+
+def _mp_identity_bwd(_, ct):
+    return (lax.psum(ct, MP_AXIS),)
+
+
+mp_identity_array.defvjp(_mp_identity_fwd, _mp_identity_bwd)
+
+
+@jax.custom_vjp
+def mp_allreduce_array(x):
+    """c_allreduce_sum parity (c_allreduce_op.h): forward all-reduce over
+    'mp', backward identity — the replicated output cotangent flows to each
+    rank's partial contribution unchanged. (Without the custom vjp, jax's
+    conservative psum transpose under ``check_vma=False`` psums the
+    cotangent AGAIN, scaling mp-sharded grads by the mp degree.)"""
+    return lax.psum(x, MP_AXIS)
+
+
+def _mp_allreduce_fwd(x):
+    return lax.psum(x, MP_AXIS), None
+
+
+def _mp_allreduce_bwd(_, ct):
+    return (ct,)
+
+
+mp_allreduce_array.defvjp(_mp_allreduce_fwd, _mp_allreduce_bwd)
+
+
+@primitive(name="c_identity")
+def _c_identity(x):
+    return mp_identity_array(x)
 
 
 def mp_axis_bound() -> bool:
@@ -85,7 +133,7 @@ class VocabParallelEmbedding(Layer):
                 safe = jnp.where(in_range, local, 0)
                 emb = jnp.take(w, safe, axis=0)
                 emb = jnp.where(in_range[..., None], emb, 0.0)
-                return lax.psum(emb, MP_AXIS)
+                return mp_allreduce_array(emb)
 
             return _lookup(self.weight, unwrap(x))
         # GSPMD path: plain lookup; compiler handles the sharded gather
@@ -115,9 +163,9 @@ class ColumnParallelLinear(Layer):
 
     def forward(self, x):
         if mp_axis_bound():
-            # c_identity forward (input broadcast), local matmul over the
-            # out/world shard; gather_output => all_gather columns
-            out = F.linear(x, self.weight, self.bias)
+            # c_identity forward (input broadcast, psum backward), local
+            # matmul over the out/world shard; gather_output => all_gather
+            out = F.linear(_c_identity(x), self.weight, self.bias)
             if self.gather_output:
                 @primitive
                 def _gather(o):
@@ -161,8 +209,7 @@ class RowParallelLinear(Layer):
             # local matmul on the row shard, then mp_allreduce; bias after
             @primitive
             def _row(x, w, b):
-                y = jnp.matmul(x, w)
-                y = lax.psum(y, MP_AXIS)
+                y = mp_allreduce_array(jnp.matmul(x, w))
                 if b is not None:
                     y = y + b
                 return y
@@ -202,9 +249,9 @@ class ParallelCrossEntropy(Layer):
             vocab_local = logits.shape[-1]
             rank = lax.axis_index(MP_AXIS)
             start = rank * vocab_local
-            m = lax.pmax(jnp.max(logits, axis=-1, keepdims=True), MP_AXIS)
+            m = lax.pmax(lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True)), MP_AXIS)
             shifted = logits - m
-            sum_exp = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True), MP_AXIS)
+            sum_exp = mp_allreduce_array(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True))
             lbl = label.astype(jnp.int32)
             valid = lbl != ignore
             safe_lbl = jnp.where(valid, lbl, 0)
@@ -212,7 +259,7 @@ class ParallelCrossEntropy(Layer):
             in_range = (local >= 0) & (local < vocab_local)
             picked = jnp.take_along_axis(shifted, jnp.where(in_range, local, 0)[..., None], axis=-1)[..., 0]
             picked = jnp.where(in_range, picked, 0.0)
-            picked = lax.psum(picked, MP_AXIS)
+            picked = mp_allreduce_array(picked)
             loss = jnp.log(sum_exp[..., 0]) - picked
             loss = jnp.where(valid, loss, 0.0)
             return loss[..., None]

@@ -4,19 +4,33 @@ Parity: the reference's 1F1B pipeline — static-graph
 ``PipelineOptimizer``/``SectionWorker`` (fluid/optimizer.py:4176,
 framework/section_worker.cc:62 schedule_mode==1) and dygraph
 ``PipelineParallel.forward_backward_pipeline``
-(fleet/meta_parallel/pipeline_parallel.py:80) with send_v2/recv_v2 p2p ops.
+(fleet/meta_parallel/pipeline_parallel.py:80) with send_v2/recv_v2 p2p ops —
+composed with tensor parallelism (partial_send p2p-under-mp,
+fleet/meta_parallel/pp_utils/p2p_communication.py:149-155), ZeRO sharding
+(fleet/meta_optimizers/sharding_optimizer.py:140 hybrid mp x sharding x pp x
+dp degrees), and the TP RNG tracker for dropout determinism
+(fleet/meta_parallel/parallel_layers/random.py).
 
 TPU-native redesign (the canonical GSPMD/praxis collective-permute
-pipeline): stages live on the 'pp' mesh axis under shard_map; each stage
-owns a contiguous slice of decoder layers whose parameters are STACKED on a
-leading stage dim (so each pp shard holds [1, k, ...] slices); the
-microbatch loop is one ``lax.scan`` of M + S - 1 ticks where activations
-rotate stage→stage+1 via ``lax.ppermute``. ``jax.grad`` through the scan
-yields the reverse (backward) schedule — the p2p transposes ARE the
-backward p2p of the reference — and ``jax.checkpoint`` on the per-tick
-stage body recovers 1F1B's O(S) activation memory bound.
+pipeline): ONE shard_map over every mesh axis —
 
-Scope: uniform-decoder-stack models (the GPT family — BASELINE #4's shape).
+- 'pp'   — stages own a stacked [1, k, ...] slice of the decoder layers;
+  the microbatch loop is a ``lax.scan`` of M + S - 1 ticks where activations
+  rotate stage→stage+1 via ``lax.ppermute``. ``jax.grad`` through the scan
+  yields the reverse schedule (the p2p transposes ARE the backward p2p) and
+  ``jax.checkpoint`` on the per-tick stage body recovers 1F1B's O(S)
+  activation-memory bound.
+- 'mp'   — stage params carry their tensor-parallel shard (column/row
+  splits per ``partition_spec``); blocks run the explicit Megatron
+  algorithm (mp_layers' ``mp_axis_bound`` path: c_identity fwd/psum bwd,
+  row-parallel psum, sharded-vocab embedding + softmax-CE).
+- 'dp' / 'sharding' — both shard the batch; grads are pmean'd over 'dp'
+  and reduce-scattered over 'sharding' (ZeRO-2), optimizer slots live
+  sliced 1/n per sharding rank, updated params all-gather back.
+- dropout — per-(microbatch, layer) PRNG keys are folded in inside the
+  scan so masks are deterministic and reproducible by a sequential run
+  (replaces the reference's RNG state tracker).
+
 Shared (tied) embedding + final-norm + head params are replicated over 'pp'
 with gradient psum, replacing the reference's SharedLayerDesc allreduce of
 tied-embedding grads (pp_layers.py:49).
@@ -24,7 +38,6 @@ tied-embedding grads (pp_layers.py:49).
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Optional
 
 import jax
@@ -33,14 +46,23 @@ from jax import lax
 from jax.sharding import NamedSharding
 
 from ...autograd import tape
+from ...random import get_rng_state, set_rng_state
 from ...tensor import Tensor
 from ..env import get_mesh
-from ..spmd import P
+from ..spmd import P, sanitize_spec
+from .mp_layers import (
+    MP_AXIS,
+    mp_allreduce_array,
+    mp_axis_bound,
+    mp_identity_array,
+)
 
 __all__ = ["build_gpt_pipeline_step", "stack_layer_params", "GPTPipelineModule"]
 
 PP_AXIS = "pp"
 DP_AXIS = "dp"
+SH_AXIS = "sharding"
+_EMBED_FOLD = 1 << 20  # fold_in tag separating the embed-dropout stream
 
 
 def stack_layer_params(blocks):
@@ -49,21 +71,45 @@ def stack_layer_params(blocks):
     return {n: jnp.stack([t[n] for t in trees]) for n in trees[0]}
 
 
+def _only_mp(spec: P) -> P:
+    """Keep only 'mp' placements of a partition spec (dp/fsdp annotations
+    don't apply to stacked pipeline params)."""
+    dims = []
+    for d in spec:
+        if d == MP_AXIS or (isinstance(d, tuple) and MP_AXIS in d):
+            dims.append(MP_AXIS)
+        else:
+            dims.append(None)
+    return P(*dims)
+
+
+def _local_shape(global_shape, spec, mesh):
+    dims = list(spec) + [None] * (len(global_shape) - len(spec))
+    out = []
+    for s, d in zip(global_shape, dims):
+        if d is None:
+            out.append(s)
+        else:
+            axes = (d,) if isinstance(d, str) else tuple(d)
+            f = 1
+            for a in axes:
+                f *= int(mesh.shape[a])
+            out.append(s // f)
+    return tuple(out)
+
+
 class GPTPipelineModule:
     """Functional pipeline program for a GPTForPretraining model.
 
     Splits ``model.gpt.h`` (N uniform decoder blocks) into S = pp-degree
     stages of k = N/S layers each. Parameters:
-      - ``stages``: {name: [S, k, ...]} — sharded P('pp') on dim 0
-      - ``shared``: tied wte/wpe + final LN — replicated
+      - ``stages``: {name: [S, k, ...]} — dim 0 on 'pp', tensor-parallel
+        dims on 'mp' per the block's ``partition_spec`` annotations
+      - ``shared``: tied wte (vocab on 'mp') / wpe / final LN
     """
 
-    def __init__(self, model, num_stages: int, microbatches: int):
+    def __init__(self, model, num_stages: int, microbatches: int, mesh=None):
         cfg = model.gpt.config
-        if cfg.hidden_dropout_prob or cfg.attention_dropout_prob:
-            raise ValueError("pipeline schedule requires dropout probs = 0 "
-                             "(per-tick RNG plumbing lands with the dygraph "
-                             "dropout path)")
         if getattr(cfg, "num_experts", 0):
             raise ValueError("pipeline schedule requires a uniform decoder "
                              "stack; MoE configs interleave MoE/dense blocks "
@@ -73,6 +119,10 @@ class GPTPipelineModule:
         if n_layers % num_stages != 0:
             raise ValueError(f"layer count {n_layers} must be divisible by "
                              f"the stage count {num_stages}")
+        mesh = mesh or get_mesh()
+        self.mesh = mesh
+        self.mp_size = int(mesh.shape.get(MP_AXIS, 1)) if mesh is not None else 1
+        self.has_mp = self.mp_size > 1
         self.model = model
         self.cfg = cfg
         self.num_stages = num_stages
@@ -80,10 +130,21 @@ class GPTPipelineModule:
         self.microbatches = microbatches
         self._block = model.gpt.h[0]  # structural template for all blocks
 
+        # tensor-parallel placement per block param (Megatron column/row)
+        self.block_specs = {}
+        for n, p in self._block.named_parameters():
+            spec = getattr(p, "partition_spec", None) or P()
+            if mesh is not None:
+                spec = sanitize_spec(spec, mesh)
+            self.block_specs[n] = _only_mp(spec)
+
         stacked = stack_layer_params(list(model.gpt.h))
         self.stage_params = {
             n: a.reshape((num_stages, self.layers_per_stage) + a.shape[1:])
             for n, a in stacked.items()
+        }
+        self.stage_specs = {
+            n: P(PP_AXIS, None, *self.block_specs[n]) for n in self.stage_params
         }
         emb = model.gpt.embeddings
         self.shared_params = {
@@ -92,36 +153,81 @@ class GPTPipelineModule:
             "ln_f.weight": model.gpt.ln_f.weight._data,
             "ln_f.bias": model.gpt.ln_f.bias._data,
         }
+        self.shared_specs = {
+            "wte": P(MP_AXIS, None) if self.has_mp else P(),
+            "wpe": P(), "ln_f.weight": P(), "ln_f.bias": P(),
+        }
 
     # -- functional pieces ------------------------------------------------
     def _apply_block(self, layer_params, h):
-        """One decoder layer, pure: layer_params {name: arr}, h [mb, T, H]."""
+        """One decoder layer, pure: layer_params {name: arr}, h [mb, T, H].
+        Inside an 'mp' shard_map region the params are the local TP shards
+        and the block runs the explicit Megatron collectives."""
         with tape.no_grad():
             out, _ = self._block.functional_call_with_state(layer_params, {}, Tensor(h))
         return out._data
 
-    def _embed(self, shared, ids):
+    def _embed(self, shared, ids, key=None):
         t = ids.shape[-1]
         pos = jnp.arange(t)
-        return jnp.take(shared["wte"], ids, axis=0) + shared["wpe"][pos]
+        wte = shared["wte"]
+        if self.has_mp and mp_axis_bound():
+            # sharded-vocab lookup (c_embedding parity): mask + psum
+            per = wte.shape[0]
+            rank = lax.axis_index(MP_AXIS)
+            local = ids - rank * per
+            ok = (local >= 0) & (local < per)
+            emb = jnp.take(wte, jnp.where(ok, local, 0), axis=0)
+            emb = jnp.where(ok[..., None], emb, 0.0)
+            emb = mp_allreduce_array(emb)
+        else:
+            emb = jnp.take(wte, ids, axis=0)
+        h = emb + shared["wpe"][pos]
+        p = self.cfg.hidden_dropout_prob
+        if key is not None and p > 0.0:
+            keep = jax.random.bernoulli(key, 1.0 - p, h.shape)
+            h = jnp.where(keep, h / (1.0 - p), 0.0).astype(h.dtype)
+        return h
 
     def _head_loss(self, shared, h, labels):
         eps = self.cfg.layer_norm_epsilon
         mu = h.mean(-1, keepdims=True)
         var = ((h - mu) ** 2).mean(-1, keepdims=True)
         hn = (h - mu) / jnp.sqrt(var + eps) * shared["ln_f.weight"] + shared["ln_f.bias"]
-        logits = jnp.einsum("bth,vh->btv", hn, shared["wte"])
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         lbl = labels.astype(jnp.int32)
         valid = lbl != -100  # ignore_index parity with GPTPretrainingCriterion
         safe = jnp.where(valid, lbl, 0)
-        ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        if self.has_mp and mp_axis_bound():
+            # vocab-sharded softmax-CE (c_softmax_with_cross_entropy parity);
+            # identity-fwd/psum-bwd on h so ln_f sees the full cotangent
+            hn = mp_identity_array(hn)
+            logits = jnp.einsum("bth,vh->btv", hn, shared["wte"]).astype(jnp.float32)
+            per = logits.shape[-1]
+            start = lax.axis_index(MP_AXIS) * per
+            # stop_gradient BEFORE pmax: the max shift is grad-free and pmax
+            # has no JVP rule (zero-tangent operands skip it)
+            m = lax.pmax(lax.stop_gradient(jnp.max(logits, -1, keepdims=True)), MP_AXIS)
+            shifted = logits - m
+            sum_exp = mp_allreduce_array(jnp.sum(jnp.exp(shifted), -1, keepdims=True))
+            loc = safe - start
+            ok = (loc >= 0) & (loc < per)
+            picked = jnp.take_along_axis(shifted, jnp.where(ok, loc, 0)[..., None], -1)[..., 0]
+            picked = jnp.where(ok, picked, 0.0)
+            picked = mp_allreduce_array(picked)
+            ll = picked - jnp.log(sum_exp[..., 0])
+        else:
+            logits = jnp.einsum("bth,vh->btv", hn, shared["wte"])
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
         ll = jnp.where(valid, ll, 0.0)
         return -ll.sum() / jnp.maximum(valid.sum(), 1)
 
-    # -- the pipelined local loss (runs inside shard_map over 'pp') -------
-    def local_loss(self, stage_params, shared, x, y):
-        """x, y: [M*mb, T] on this shard. Returns replicated mean loss."""
+    # -- the pipelined local loss (runs inside shard_map) -----------------
+    def local_loss(self, stage_params, shared, x, y, key=None):
+        """x, y: [M*mb, T] on this (dp, sharding) shard; stage_params /
+        shared are this rank's (pp, mp) shards. ``key``: PRNG key for the
+        dropout streams (None ⇒ deterministic eval). Returns the replicated
+        mean loss."""
         n = lax.axis_size(PP_AXIS)
         s_idx = lax.axis_index(PP_AXIS)
         m = self.microbatches
@@ -129,12 +235,29 @@ class GPTPipelineModule:
         x_mb = x.reshape((m, mb) + x.shape[1:])
         y_mb = y.reshape((m, mb) + y.shape[1:])
         local_stage = jax.tree_util.tree_map(lambda a: a[0], stage_params)  # [k, ...]
+        k_layers = self.layers_per_stage
+        use_rng = key is not None and self.model.training and (
+            self.cfg.hidden_dropout_prob > 0 or self.cfg.attention_dropout_prob > 0)
+        if key is None:
+            key = jax.random.key(0)
 
-        def stage_fn(h):
-            def body(h, lp):
-                return self._apply_block(lp, h), None
+        def stage_fn(h, stage_key):
+            # per-layer dropout keys: fold the GLOBAL layer index into the
+            # microbatch key so a sequential run derives identical masks
+            layer_ids = jnp.arange(k_layers) + s_idx * k_layers
+            keys = jax.vmap(lambda i: jax.random.fold_in(stage_key, i))(layer_ids)
 
-            h, _ = lax.scan(body, h, local_stage)
+            def body(h, xs):
+                lp, lk = xs
+                saved = get_rng_state()
+                set_rng_state(lk)
+                try:
+                    out = self._apply_block(lp, h)
+                finally:
+                    set_rng_state(saved)
+                return out, None
+
+            h, _ = lax.scan(body, h, (local_stage, keys))
             return h
 
         # 1F1B memory bound: recompute stage activations in backward
@@ -146,9 +269,14 @@ class GPTPipelineModule:
 
         def tick(carry, t):
             h_in, loss_acc = carry
-            inj = self._embed(shared, x_mb[jnp.clip(t, 0, m - 1)])
+            inj_mb = jnp.clip(t, 0, m - 1)
+            inj_key = jax.random.fold_in(
+                jax.random.fold_in(key, inj_mb), _EMBED_FOLD)
+            inj = self._embed(shared, x_mb[inj_mb], inj_key if use_rng else None)
             h = jnp.where(s_idx == 0, inj, h_in)
-            h = stage_fn(h)
+            # stage s processes at tick t the microbatch injected at t - s
+            stage_key = jax.random.fold_in(key, jnp.clip(t - s_idx, 0, m - 1))
+            h = stage_fn(h, stage_key)
             out_idx = t - (n - 1)
             valid = (out_idx >= 0) & (out_idx < m)
             lbl = y_mb[jnp.clip(out_idx, 0, m - 1)]
@@ -185,9 +313,152 @@ class GPTPipelineModule:
         self.model.gpt.ln_f.bias._set_data(shared["ln_f.bias"])
 
 
+def _zero_slot_layout(pipe, optimizer, mesh, n_shard):
+    """ZeRO slot layout: every param leaf's slots live flattened + padded as
+    [S, M, n_shard, sz] (pp stack, mp parts, sharding slices) so each
+    (pp, mp, sharding) rank holds exactly the 1/n_shard slice it updates —
+    the reference's Shard._split_params (sharding/shard.py:22) re-expressed
+    as an array layout instead of a param-name map."""
+    layouts = {}
+    slots = {}
+    for grp, params, specs in (
+        ("stages", pipe.stage_params, pipe.stage_specs),
+        ("shared", pipe.shared_params, pipe.shared_specs),
+    ):
+        layouts[grp] = {}
+        slots[grp] = {}
+        for n, arr in params.items():
+            spec = specs[n]
+            local = _local_shape(arr.shape, spec, mesh)
+            size = 1
+            for s in local:
+                size *= s
+            sz = -(-size // n_shard)
+            s_dim = pipe.num_stages if grp == "stages" else 1
+            mp_sharded = any(d == MP_AXIS or (isinstance(d, tuple) and MP_AXIS in d)
+                             for d in spec)
+            m_dim = pipe.mp_size if mp_sharded else 1
+            full_shape = (s_dim, m_dim, n_shard, sz)
+            spec4 = P(PP_AXIS if grp == "stages" else None,
+                      MP_AXIS if mp_sharded else None,
+                      SH_AXIS if n_shard > 1 else None,
+                      None)
+            layouts[grp][n] = (size, sz, spec4)
+            init = optimizer._init_slots(jnp.zeros((sz,), arr.dtype))
+            slots[grp][n] = {
+                sn: jax.device_put(jnp.broadcast_to(sv, full_shape),
+                                   NamedSharding(mesh, spec4))
+                for sn, sv in init.items()
+            }
+    return layouts, slots
+
+
+def _clip_grads_meshaware(clip, grads, pipe, has_mp):
+    """Gradient clipping inside the shard_map body: the global norm must sum
+    squares over the 'pp' stack and the 'mp' shards of each leaf (reference:
+    sharding/utils ClipGradByGlobalNorm cross-rank norm reduce)."""
+    from ...nn.clip import ClipGradByGlobalNorm, ClipGradByValue
+
+    if isinstance(clip, ClipGradByValue):
+        from ...nn.clip import clip_grads_functional
+
+        return clip_grads_functional(clip, grads)  # elementwise: shard-safe
+    if not isinstance(clip, ClipGradByGlobalNorm):
+        raise NotImplementedError(
+            f"{type(clip).__name__} is shard-local; the hybrid pipeline "
+            "supports ClipGradByGlobalNorm / ClipGradByValue")
+    specs = {"stages": pipe.stage_specs, "shared": pipe.shared_specs}
+    sumsq = jnp.zeros((), jnp.float32)
+    for grp in grads:
+        for n, g in grads[grp].items():
+            s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            spec = specs[grp][n]
+            mp_sharded = any(d == MP_AXIS or (isinstance(d, tuple) and MP_AXIS in d)
+                             for d in spec)
+            if mp_sharded and has_mp:
+                s = lax.psum(s, MP_AXIS)
+            if grp == "stages":
+                s = lax.psum(s, PP_AXIS)  # each pp rank owns distinct layers
+            sumsq = sumsq + s
+    norm = jnp.sqrt(sumsq)
+    scale = clip.clip_norm / jnp.maximum(norm, clip.clip_norm)
+    return jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+
+def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
+                   has_mp):
+    """Optimizer apply with ZeRO-2 semantics over 'sharding': reduce-scatter
+    each (flattened) grad, update the local slot slice, all-gather params.
+    Runs inside the shard_map body. Parity: sharding_optimizer.py grad
+    reduce + Shard param split + broadcast-back."""
+    clip = optimizer._grad_clip
+    scatter = has_sh and n_shard > 1
+    sliced = False
+    if clip is not None:
+        if scatter:
+            # the norm needs fully reduced grads: trade the reduce-scatter
+            # for an all-reduce, then slice
+            grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, SH_AXIS), grads)
+            scatter = False
+            sliced = True
+        grads = _clip_grads_meshaware(clip, grads, pipe, has_mp)
+
+    wd = optimizer._weight_decay_coeff
+    decoupled = optimizer._decoupled_wd
+    hyper = optimizer._hyper()
+    lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+    step = opt_state["step"] + 1
+    upd = type(optimizer)._update
+
+    def leaf(p, g, slots):
+        g = g.astype(p.dtype)
+        if wd and not decoupled:
+            g = g + wd * p
+        size = p.size
+        sz = -(-size // n_shard)
+        pad = sz * n_shard - size
+        gf = jnp.pad(g.reshape(-1), (0, pad))
+        sl = {k: v.reshape(-1) for k, v in slots.items()}
+        if scatter or sliced:
+            if scatter:
+                gl = lax.psum_scatter(gf, SH_AXIS, scatter_dimension=0,
+                                      tiled=True) / n_shard
+            else:
+                gl = lax.dynamic_slice(
+                    gf, (lax.axis_index(SH_AXIS) * sz,), (sz,))
+            pf = jnp.pad(p.reshape(-1), (0, pad))
+            pl = lax.dynamic_slice(pf, (lax.axis_index(SH_AXIS) * sz,), (sz,))
+            pn, sn = upd(pl, gl, sl, lr, step, hyper)
+            pnew = lax.all_gather(pn, SH_AXIS, tiled=True)[:size].reshape(p.shape)
+        else:
+            pn, sn = upd(jnp.pad(p.reshape(-1), (0, pad)), gf, sl, lr, step, hyper)
+            pnew = pn[:size].reshape(p.shape)
+        return pnew, {k: v.reshape(slots[k].shape) for k, v in sn.items()}
+
+    new_p = {}
+    new_s = {}
+    for grp in params:
+        new_p[grp] = {}
+        new_s[grp] = {}
+        for n in params[grp]:
+            pn, sn = leaf(params[grp][n], grads[grp][n],
+                          opt_state["slots"][grp][n])
+            new_p[grp][n] = pn
+            new_s[grp][n] = sn
+    return new_p, {"slots": new_s, "step": step}
+
+
 def build_gpt_pipeline_step(model, optimizer, *, microbatches: int,
                             num_stages: Optional[int] = None, mesh=None):
-    """Build the jitted stage-parallel train step for a GPT model.
+    """Build the jitted hybrid train step for a GPT model: pp x mp x dp x
+    sharding composed in ONE shard_map program (the reference's north-star
+    hybrid, sharding_optimizer.py:140 degrees assertion).
+
+    The mesh may carry any subset of {'pp' (required), 'mp', 'dp',
+    'sharding'} with degree > 1. Batch dim 0 is sharded over
+    dp x sharding; per-param hyper overrides (AdamW apply_decay_param_fun)
+    are not applied on this path.
 
     Returns a callable ``step(x, y) -> loss`` holding sharded params +
     optimizer state; ``step.sync_to_model()`` writes arrays back.
@@ -195,41 +466,45 @@ def build_gpt_pipeline_step(model, optimizer, *, microbatches: int,
     mesh = mesh or get_mesh()
     if mesh is None or PP_AXIS not in mesh.shape:
         raise RuntimeError("pipeline step needs a mesh with a 'pp' axis")
-    if "mp" in mesh.shape and int(mesh.shape["mp"]) > 1:
-        raise NotImplementedError("pp x mp hybrid pipeline lands via GSPMD "
-                                  "sharding specs; use ParallelTrainer for mp")
     num_stages = num_stages or int(mesh.shape[PP_AXIS])
-    pipe = GPTPipelineModule(model, num_stages, microbatches)
+    pipe = GPTPipelineModule(model, num_stages, microbatches, mesh=mesh)
     has_dp = DP_AXIS in mesh.shape and int(mesh.shape[DP_AXIS]) > 1
+    has_sh = SH_AXIS in mesh.shape and int(mesh.shape[SH_AXIS]) > 1
+    n_shard = int(mesh.shape.get(SH_AXIS, 1))
 
+    param_specs = {"stages": pipe.stage_specs, "shared": pipe.shared_specs}
     params = {
-        "stages": jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, NamedSharding(mesh, P(PP_AXIS))),
-            pipe.stage_params),
-        "shared": jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, NamedSharding(mesh, P())),
-            pipe.shared_params),
+        grp: {
+            n: jax.device_put(a, NamedSharding(mesh, param_specs[grp][n]))
+            for n, a in src.items()
+        }
+        for grp, src in (("stages", pipe.stage_params),
+                         ("shared", pipe.shared_params))
     }
-    opt_state = optimizer.init_state(params)
+    layouts, slot_tree = _zero_slot_layout(pipe, optimizer, mesh, n_shard)
     opt_state = {
-        "slots": {
-            "stages": jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, NamedSharding(mesh, P(PP_AXIS)))
-                if a.ndim >= 1 and a.shape[0] == num_stages else
-                jax.device_put(a, NamedSharding(mesh, P())),
-                opt_state["slots"]["stages"]),
-            "shared": jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, NamedSharding(mesh, P())),
-                opt_state["slots"]["shared"]),
-        },
-        "step": jax.device_put(opt_state["step"], NamedSharding(mesh, P())),
+        "slots": slot_tree,
+        "step": jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P())),
+    }
+    slot_specs = {
+        grp: {n: {sn: layouts[grp][n][2] for sn in slot_tree[grp][n]}
+              for n in slot_tree[grp]}
+        for grp in slot_tree
     }
 
-    def spmd_step(params, opt_state, x, y):
+    def spmd_step(params, opt_state, x, y, kd):
+        key = jax.random.wrap_key_data(kd)
+
         def loss_fn(params):
-            return pipe.local_loss(params["stages"], params["shared"], x, y)
+            return pipe.local_loss(params["stages"], params["shared"], x, y, key)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
+        # local slot slices arrive [1, 1, 1, sz]: flatten for the update
+        local_opt = {
+            "slots": jax.tree_util.tree_map(
+                lambda a: a.reshape(a.shape[-1:]), opt_state["slots"]),
+            "step": opt_state["step"],
+        }
         # shared (tied/replicated) params were used by several stages:
         # combine their grads over 'pp' (≙ SharedLayerDesc allreduce)
         grads["shared"] = jax.tree_util.tree_map(
@@ -238,19 +513,29 @@ def build_gpt_pipeline_step(model, optimizer, *, microbatches: int,
             grads = jax.tree_util.tree_map(
                 lambda g: lax.pmean(g, DP_AXIS), grads)
             loss = lax.pmean(loss, DP_AXIS)
-        new_params, new_opt = optimizer.apply_gradients(params, grads, opt_state)
+        if has_sh:
+            loss = lax.pmean(loss, SH_AXIS)
+        new_params, new_opt = _apply_updates(
+            optimizer, params, grads, local_opt, n_shard, has_sh, pipe,
+            pipe.has_mp)
+        # restore the [1, 1, 1, sz] layout for the out specs
+        new_opt = {
+            "slots": jax.tree_util.tree_map(
+                lambda a: a.reshape((1, 1, 1) + a.shape), new_opt["slots"]),
+            "step": new_opt["step"],
+        }
         return new_params, new_opt, loss
 
-    param_prefix = {"stages": P(PP_AXIS), "shared": P()}
-    opt_prefix = {"slots": {"stages": P(PP_AXIS), "shared": P()}, "step": P()}
-    data_spec = P(DP_AXIS) if has_dp else P()
+    opt_prefix = {"slots": slot_specs, "step": P()}
+    data_axes = tuple(a for a in (DP_AXIS, SH_AXIS) if a in mesh.shape)
+    data_spec = P(data_axes) if data_axes else P()
 
     from jax import shard_map
 
     mapped = shard_map(
         spmd_step, mesh=mesh,
-        in_specs=(param_prefix, opt_prefix, data_spec, data_spec),
-        out_specs=(param_prefix, opt_prefix, P()),
+        in_specs=(param_specs, opt_prefix, data_spec, data_spec, P()),
+        out_specs=(param_specs, opt_prefix, P()),
         check_vma=False,
     )
     jitted = jax.jit(mapped, donate_argnums=(0, 1))
@@ -258,9 +543,13 @@ def build_gpt_pipeline_step(model, optimizer, *, microbatches: int,
     state = {"params": params, "opt": opt_state}
 
     def step(x, y):
+        from ...random import split_key
+
         x = x._data if isinstance(x, Tensor) else jnp.asarray(x)
         y = y._data if isinstance(y, Tensor) else jnp.asarray(y)
-        state["params"], state["opt"], loss = jitted(state["params"], state["opt"], x, y)
+        kd = jax.random.key_data(split_key())
+        state["params"], state["opt"], loss = jitted(
+            state["params"], state["opt"], x, y, kd)
         return loss
 
     step.pipe = pipe

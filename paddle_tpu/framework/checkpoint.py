@@ -127,24 +127,28 @@ class CheckpointManager:
         # non-JSON value must raise here, not vanish inside the async writer
         tree_blob = json.dumps({"treedef": treedef.to_json(),
                                 "pyvals": pyvals}, default=_py_default)
+        meta_blob = json.dumps({"step": step, "specs": specs,
+                                "prng_keys": prng_keys,
+                                "metadata": metadata or {}},
+                               default=_py_default)
 
         if self.async_save:
             self.wait()
             self._thread = threading.Thread(
                 target=self._write,
-                args=(step, arrays, tree_blob, specs, prng_keys, metadata),
+                args=(step, arrays, tree_blob, meta_blob),
                 daemon=True,
             )
             self._thread.start()
         else:
-            self._write(step, arrays, tree_blob, specs, prng_keys, metadata)
+            self._write(step, arrays, tree_blob, meta_blob)
 
     def wait(self):
         if self._thread is not None:
             self._thread.join()
             self._thread = None
 
-    def _write(self, step, arrays, tree_blob, specs, prng_keys, metadata):
+    def _write(self, step, arrays, tree_blob, meta_blob):
         final = os.path.join(self.directory, f"step_{step}")
         tmp = tempfile.mkdtemp(prefix=f".tmp_step_{step}_", dir=self.directory)
         try:
@@ -153,9 +157,7 @@ class CheckpointManager:
             with open(os.path.join(tmp, _PYTREE), "w") as f:
                 f.write(tree_blob)
             with open(os.path.join(tmp, _META), "w") as f:
-                json.dump({"step": step, "specs": specs,
-                           "prng_keys": prng_keys,
-                           "metadata": metadata or {}}, f)
+                f.write(meta_blob)
             if os.path.exists(final):
                 shutil.rmtree(final)
             os.rename(tmp, final)  # atomic publish

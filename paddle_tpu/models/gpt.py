@@ -114,10 +114,15 @@ def gpt_config(name: str, **overrides) -> GPTConfig:
 def _constrain_heads(x):
     """Hint GSPMD to keep the head dim on 'mp' for [B, H, T, D] tensors."""
     from ..distributed.env import get_mesh
+    from ..distributed.meta_parallel.mp_layers import mp_axis_bound
     from ..distributed.spmd import with_sharding_constraint
 
     mesh = get_mesh()
     if mesh is None or "mp" not in mesh.shape or int(mesh.shape["mp"]) == 1:
+        return x
+    if mp_axis_bound():
+        # explicit shard_map region: tensors are already the local head
+        # shard — GSPMD constraints don't apply to manual axes
         return x
     return with_sharding_constraint(x, P(None, "mp", None, None))
 
@@ -140,17 +145,32 @@ class GPTAttention(Layer):
         self.qkv_proj = ColumnParallelLinear(h, 3 * h, gather_output=False)
         self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
 
+    def _local_heads(self):
+        """Head count on this shard: under an explicit 'mp' shard_map region
+        the qkv projection produced the local head slice (Megatron head
+        parallelism), so reshapes must use num_heads / mp."""
+        from ..distributed.meta_parallel.mp_layers import MP_AXIS, mp_axis_bound
+
+        if mp_axis_bound():
+            import jax
+
+            return self.num_heads // jax.lax.axis_size(MP_AXIS)
+        return self.num_heads
+
     def _finish(self, out, b, t):
         """Shared epilogue: [B, H, T, D] -> out_proj([B, T, H*D])."""
         out = manip.transpose(out, [0, 2, 1, 3])
-        out = manip.reshape(out, [b, t, self.num_heads * self.head_dim])
+        out = manip.reshape(out, [b, t, -1])
         return self.out_proj(out)
 
     def forward(self, x):
         b, t = x.shape[0], x.shape[1]
-        qkv = self.qkv_proj(x)  # [B, T, 3H]
-        qkv = manip.reshape(qkv, [b, t, 3, self.num_heads, self.head_dim])
-        qkv = manip.transpose(qkv, [2, 0, 3, 1, 4])  # [3, B, H, T, D]
+        qkv = self.qkv_proj(x)  # [B, T, 3H] ([B, T, 3H/mp] per explicit shard)
+        # head-major interleaved qkv layout [nh, 3, hd]: a contiguous 1/mp
+        # column slice is a whole-head slice, so the Megatron explicit path
+        # and the GSPMD path read the same parameterization
+        qkv = manip.reshape(qkv, [b, t, self._local_heads(), 3, self.head_dim])
+        qkv = manip.transpose(qkv, [3, 0, 2, 1, 4])  # [3, B, H, T, D]
         q, k, v = qkv[0], qkv[1], qkv[2]
         # incremental-decoding KV cache (models/generation.py owns the
         # lifecycle; None = normal training/eval forward)

@@ -29,6 +29,9 @@ __all__ = ["Optimizer"]
 class Optimizer:
     # subclasses define: _slot_names: tuple[str,...]; _update(...) staticmethod
     _slot_names: tuple = ()
+    # True when _update applies weight decay itself (AdamW-style decoupled
+    # decay): functional callers must then NOT fold decay into the grad
+    _decoupled_wd: bool = False
 
     def __init__(
         self,
@@ -181,7 +184,7 @@ class Optimizer:
 
         def upd(p, g, slots):
             g = g.astype(p.dtype)
-            if wd and type(self).__name__ not in ("AdamW",):
+            if wd and not self._decoupled_wd:
                 g = g + wd * p
             return type(self)._update(p, g, slots, jnp.asarray(lr, jnp.float32), step, hyper)
 

@@ -99,6 +99,8 @@ class AdamW(Adam):
     """Decoupled weight decay (reference: adamw applies decay on param
     directly, python/paddle/optimizer/adamw.py)."""
 
+    _decoupled_wd = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False, name=None, **kw):
