@@ -76,11 +76,19 @@ class Node:
 
     ``vjp_fn`` is the closure returned by ``jax.vjp`` over the op's
     differentiable inputs; ``inputs`` are the input Tensors in the same order.
+    ``pure_fn`` (when available) is the pure function the vjp was derived
+    from — double grad re-derives the vjp through the taped-op machinery so
+    the backward itself lands on the tape (parity: the reference's
+    PartialGradEngine create_graph, partial_grad_engine.cc:1088, which
+    re-enters TraceOp for each grad op).
     """
 
-    __slots__ = ("vjp_fn", "inputs", "out_avals", "index", "name", "released")
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "index", "name", "released",
+                 "pure_fn", "has_aux", "tuple_out")
 
-    def __init__(self, vjp_fn: Callable, inputs: Sequence[Any], out_avals, name: str = ""):
+    def __init__(self, vjp_fn: Callable, inputs: Sequence[Any], out_avals, name: str = "",
+                 pure_fn: Optional[Callable] = None, has_aux: bool = False,
+                 tuple_out: Optional[bool] = None):
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)
         self.out_avals = list(out_avals)  # (shape, dtype) per output
@@ -88,6 +96,16 @@ class Node:
         self.index = _state.counter
         self.name = name
         self.released = False
+        self.pure_fn = pure_fn
+        self.has_aux = has_aux
+        # whether the differentiated function returned a tuple (the vjp
+        # cotangent must mirror that structure exactly — a 1-tuple output
+        # still needs a 1-tuple cotangent); None = infer from arity
+        self.tuple_out = tuple_out
+
+    def cot_struct(self, out_cots):
+        multi = self.tuple_out if self.tuple_out is not None else len(out_cots) > 1
+        return tuple(out_cots) if multi else out_cots[0]
 
     def __repr__(self):
         return f"<Node #{self.index} {self.name}>"
@@ -95,9 +113,25 @@ class Node:
 
 def _accumulate(t, g):
     """Accumulate cotangent g into tensor t's .grad (paddle semantics: grads
-    accumulate across backward() calls until clear_grad)."""
+    accumulate across backward() calls until clear_grad). ``g`` may be a
+    taped Tensor (create_graph): the graph is preserved on ``.grad``."""
     from ..tensor import Tensor  # local import to avoid cycle
 
+    if isinstance(g, Tensor):
+        gt = g
+        if gt._data.dtype != t._data.dtype:
+            from ..ops.manipulation import cast
+
+            gt = cast(gt, t._data.dtype)  # taped cast keeps the graph
+        if t._hooks:
+            for h in t._hooks:
+                if h is None:
+                    continue
+                r = h(gt)
+                if r is not None:
+                    gt = r
+        t.grad = gt if t.grad is None else t.grad + gt
+        return
     if g.dtype != t._data.dtype:
         g = g.astype(t._data.dtype)
     if t._hooks:
@@ -113,7 +147,37 @@ def _accumulate(t, g):
         t.grad = Tensor(t.grad._data + g, stop_gradient=True)
 
 
-def backward(tensor, grad_tensor=None, retain_graph: bool = False, only_into=None):
+def _apply_vjp(node: Node, out_cots, create_graph: bool):
+    """Map output cotangents to input cotangents. With ``create_graph`` the
+    vjp is re-derived THROUGH the taped-op machinery so the backward ops
+    land on the tape (enabling grad-of-grad)."""
+    if not create_graph:
+        return node.vjp_fn(node.cot_struct(out_cots))
+    if node.pure_fn is None:
+        raise RuntimeError(
+            f"op '{node.name}' does not record a re-differentiable function; "
+            "create_graph is unavailable through it")
+    from ..ops._primitive import primitive
+
+    n_in = len(node.inputs)
+    multi = node.tuple_out if node.tuple_out is not None else len(node.out_avals) > 1
+    pure_fn, has_aux = node.pure_fn, node.has_aux
+
+    @primitive(name=f"{node.name}_grad")
+    def vjp_op(*args):
+        prim, cots = args[:n_in], args[n_in:]
+        if has_aux:
+            _, f, _ = jax.vjp(pure_fn, *prim, has_aux=True)
+        else:
+            _, f = jax.vjp(pure_fn, *prim)
+        return f(tuple(cots) if multi else cots[0])
+
+    res = vjp_op(*node.inputs, *out_cots)
+    return res if isinstance(res, tuple) else (res,)
+
+
+def backward(tensor, grad_tensor=None, retain_graph: bool = False, only_into=None,
+             create_graph: bool = False):
     """Run reverse-mode autodiff from ``tensor`` to all reachable leaves.
 
     Parity: Tensor.backward / BasicEngine. Cotangents propagate node-by-node
@@ -122,8 +186,16 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False, only_into=Non
 
     ``only_into``: optional set of tensor ids — when given, ``.grad`` is only
     written for those tensors (used by ``grad()`` to avoid polluting other
-    leaves' slots).
+    leaves' slots). ``create_graph``: record the backward itself on the tape
+    (double grad; implies retain_graph).
     """
+    from ..tensor import Tensor
+
+    if create_graph:
+        retain_graph = True
+
+    def _wrap_cot(arr):
+        return Tensor(arr, stop_gradient=True) if create_graph else arr
 
     def acc(t, g):
         if only_into is None or id(t) in only_into:
@@ -133,7 +205,7 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False, only_into=Non
         if not tensor.stop_gradient:
             # a leaf: d(t)/d(t) = 1
             g = jnp.ones_like(tensor._data) if grad_tensor is None else grad_tensor._data
-            acc(tensor, g)
+            acc(tensor, _wrap_cot(g))
         return
 
     if grad_tensor is None:
@@ -141,9 +213,12 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False, only_into=Non
             raise RuntimeError(
                 "backward() on a non-scalar tensor requires an explicit grad_tensor"
             )
-        seed_grad = jnp.ones_like(tensor._data)
+        seed_grad = _wrap_cot(jnp.ones_like(tensor._data))
+    elif create_graph and isinstance(grad_tensor, Tensor):
+        seed_grad = grad_tensor  # keep any graph on the seed
     else:
-        seed_grad = grad_tensor._data if hasattr(grad_tensor, "_data") else jnp.asarray(grad_tensor)
+        seed_grad = _wrap_cot(
+            grad_tensor._data if hasattr(grad_tensor, "_data") else jnp.asarray(grad_tensor))
 
     # Gather reachable subgraph. Any released node in the cone means the
     # graph was freed by a prior backward() — error, like the reference
@@ -175,13 +250,13 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False, only_into=Non
         for pos, (shape, dt) in enumerate(node.out_avals):
             g = cots.pop((idx, pos), None)
             if g is None:
-                g = jnp.zeros(shape, dt)
+                g = _wrap_cot(jnp.zeros(shape, dt))
             else:
                 any_seen = True
             out_cots.append(g)
         if not any_seen:
             continue
-        in_cots = node.vjp_fn(tuple(out_cots) if len(out_cots) > 1 else out_cots[0])
+        in_cots = _apply_vjp(node, out_cots, create_graph)
         for inp, g in zip(node.inputs, in_cots):
             if g is None or inp.stop_gradient:
                 continue
@@ -209,26 +284,19 @@ def grad(
     """``paddle.grad`` parity (reference: imperative/partial_grad_engine.cc).
 
     Computes grads of ``outputs`` w.r.t. ``inputs`` without touching ``.grad``
-    slots of other leaves. ``create_graph=True`` (double grad through the
-    eager tape) is not supported in v1 — use ``paddle_tpu.autograd.functional``
-    (jacobian/hessian/vjp over pure functions, where jax composes derivatives
-    natively).
+    slots of other leaves. ``create_graph=True`` records the backward pass
+    itself on the tape (the returned grads carry grad history), enabling
+    double grad exactly like the reference's PartialGradEngine
+    (partial_grad_engine.cc:1088, matmul_v2_grad_grad etc.).
     """
     from ..tensor import Tensor
-
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is not supported on the eager tape; use "
-            "paddle_tpu.autograd.functional (jacobian/hessian/vjp) for "
-            "higher-order derivatives"
-        )
 
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
         grad_outputs = [None] * len(outputs)
     grad_outputs = grad_outputs if isinstance(grad_outputs, (list, tuple)) else [grad_outputs]
-    retain = True if retain_graph is None else retain_graph
+    retain = (True if retain_graph is None else retain_graph) or create_graph
 
     # Temporarily swap .grad slots, run backward, harvest, restore.
     saved = [(t, t.grad, t._retain_grad) for t in inputs]
@@ -238,7 +306,8 @@ def grad(
     wanted = {id(t) for t in inputs}
     try:
         for o, go in zip(outputs, grad_outputs):
-            backward(o, go, retain_graph=retain, only_into=wanted)
+            backward(o, go, retain_graph=retain, only_into=wanted,
+                     create_graph=create_graph)
         results = []
         for t in inputs:
             if t.grad is None:
@@ -248,6 +317,8 @@ def grad(
                         "pass allow_unused=True to return None for it"
                     )
                 results.append(None)
+            elif create_graph:
+                results.append(t.grad)  # keep the recorded backward graph
             else:
                 results.append(Tensor(t.grad._data, stop_gradient=True))
     finally:

@@ -149,14 +149,16 @@ class GPTPipelineModule:
         emb = model.gpt.embeddings
         self.shared_params = {
             "wte": emb.word_embeddings.weight._data,
-            "wpe": emb.position_embeddings.weight._data,
             "ln_f.weight": model.gpt.ln_f.weight._data,
             "ln_f.bias": model.gpt.ln_f.bias._data,
         }
         self.shared_specs = {
             "wte": P(MP_AXIS, None) if self.has_mp else P(),
-            "wpe": P(), "ln_f.weight": P(), "ln_f.bias": P(),
+            "ln_f.weight": P(), "ln_f.bias": P(),
         }
+        if getattr(emb, "use_wpe", True):  # rope configs carry no wpe
+            self.shared_params["wpe"] = emb.position_embeddings.weight._data
+            self.shared_specs["wpe"] = P()
 
     # -- functional pieces ------------------------------------------------
     def _apply_block(self, layer_params, h):
@@ -182,7 +184,7 @@ class GPTPipelineModule:
             emb = mp_allreduce_array(emb)
         else:
             emb = jnp.take(wte, ids, axis=0)
-        h = emb + shared["wpe"][pos]
+        h = emb + shared["wpe"][pos] if "wpe" in shared else emb
         p = self.cfg.hidden_dropout_prob
         if key is not None and p > 0.0:
             keep = jax.random.bernoulli(key, 1.0 - p, h.shape)
@@ -308,7 +310,8 @@ class GPTPipelineModule:
                 p._set_data(flat[n][i])
         emb = self.model.gpt.embeddings
         emb.word_embeddings.weight._set_data(shared["wte"])
-        emb.position_embeddings.weight._set_data(shared["wpe"])
+        if "wpe" in shared:
+            emb.position_embeddings.weight._set_data(shared["wpe"])
         self.model.gpt.ln_f.weight._set_data(shared["ln_f.weight"])
         self.model.gpt.ln_f.bias._set_data(shared["ln_f.bias"])
 

@@ -18,6 +18,9 @@ from ..ops._primitive import primitive, unwrap, wrap
 __all__ = [
     "softmax_mask_fuse",
     "softmax_mask_fuse_upper_triangle",
+    "fused_rotary_position_embedding",
+    "fused_swiglu",
+    "fused_dropout_add_ln",
     "segment_sum",
     "segment_mean",
     "segment_max",
@@ -103,3 +106,53 @@ def segment_max(data, segment_ids, name=None, num_segments=None):
 
 def segment_min(data, segment_ids, name=None, num_segments=None):
     return _seg("segment_min", data, segment_ids, num_segments)
+
+
+# ---------------------------------------------------------------------------
+# fused Pallas kernels (reference: operators/fused/ CUDA suite)
+# ---------------------------------------------------------------------------
+def fused_rotary_position_embedding(q, k, cos, sin, name=None):
+    """Fused RoPE on [B, H, T, D] q/k (ops/pallas/rope.py; reference analog:
+    the fused rotary kernels of the fused-attention family)."""
+    from ..ops.pallas.rope import rope
+
+    @primitive
+    def _op(q, k):
+        return rope(q, unwrap(cos), unwrap(sin)), rope(k, unwrap(cos), unwrap(sin))
+
+    return _op(q, k)
+
+
+def fused_swiglu(x, w_gate, w_up, name=None):
+    """Fused silu(x@w_gate)*(x@w_up) (ops/pallas/swiglu.py; reference analog
+    fused_transformer_op.h FFN fusion)."""
+    from ..ops.pallas.swiglu import swiglu
+
+    @primitive
+    def _op(x, wg, wu):
+        lead = x.shape[:-1]
+        out = swiglu(x.reshape(-1, x.shape[-1]), wg, wu)
+        return out.reshape(*lead, wg.shape[1])
+
+    return _op(x, w_gate, w_up)
+
+
+def fused_dropout_add_ln(x, residual, gamma, beta, p=0.0, epsilon=1e-5,
+                         training=True, name=None):
+    """Fused residual+dropout+LayerNorm returning (ln_out, new_residual)
+    (ops/pallas/fused_ln.py; reference fused_dropout_helper.h /
+    fused_layernorm_residual_dropout_bias.h)."""
+    from ..ops.pallas.fused_ln import fused_residual_dropout_ln
+    from ..random import split_key
+
+    p_eff = float(p) if training else 0.0
+    mask = None
+    if p_eff > 0.0:
+        mask = jax.random.bernoulli(split_key(), 1.0 - p_eff, unwrap(x).shape)
+
+    @primitive
+    def _op(x, residual, gamma, beta):
+        return fused_residual_dropout_ln(
+            x, residual, gamma, beta, p=p_eff, epsilon=float(epsilon), mask=mask)
+
+    return _op(x, residual, gamma, beta)

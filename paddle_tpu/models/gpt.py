@@ -69,6 +69,13 @@ class GPTConfig:
     # long-context sequence parallelism over the 'sp' mesh axis (explicit
     # shard_map mode): "none" | "ring" | "ulysses"
     sequence_parallel: str = "none"
+    # FFN activation: "gelu" (GPT-3) or "swiglu" (llama family) — swiglu
+    # runs the fused Pallas gate kernel (ops/pallas/swiglu.py) on TPU
+    activation: str = "gelu"
+    # positions: "learned" (GPT-3 wpe) or "rope" (llama family) — rope runs
+    # the fused Pallas rotary kernel (ops/pallas/rope.py) on TPU
+    position_embedding: str = "learned"
+    rope_base: float = 10000.0
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -102,6 +109,18 @@ GPT_CONFIGS = {
     "ernie-moe-base": dict(vocab_size=50304, hidden_size=768, num_layers=12,
                            num_attention_heads=12, max_position_embeddings=2048,
                            num_experts=64, moe_every=2),
+    # llama family: rope positions + fused-swiglu FFN (the Pallas kernels
+    # ops/pallas/{rope,swiglu}.py are the production path on TPU)
+    "llama-7b": dict(vocab_size=32000, hidden_size=4096, num_layers=32,
+                     num_attention_heads=32, max_position_embeddings=4096,
+                     intermediate_size=11008, activation="swiglu",
+                     position_embedding="rope", hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0),
+    "llama-1b": dict(vocab_size=32000, hidden_size=2048, num_layers=22,
+                     num_attention_heads=16, max_position_embeddings=4096,
+                     intermediate_size=5632, activation="swiglu",
+                     position_embedding="rope", hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0),
 }
 
 
@@ -141,9 +160,31 @@ class GPTAttention(Layer):
         self.head_dim = config.head_dim
         self.dropout_p = config.attention_dropout_prob
         self.sequence_parallel = config.sequence_parallel
+        self.use_rope = config.position_embedding == "rope"
+        self.rope_base = config.rope_base
+        self._rope_cache = None
         h = config.hidden_size
         self.qkv_proj = ColumnParallelLinear(h, 3 * h, gather_output=False)
         self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
+
+    def _apply_rope(self, q, k, offset: int = 0):
+        """Fused rotary embedding on q/k (ops/pallas/rope.py on TPU)."""
+        from ..ops._primitive import primitive
+        from ..ops.pallas.rope import build_rope_cache, rope
+
+        t = q.shape[2]
+        need = offset + t
+        if self._rope_cache is None or self._rope_cache[0].shape[0] < need:
+            self._rope_cache = build_rope_cache(
+                max(need, 32), self.head_dim, self.rope_base)
+        cos, sin = self._rope_cache
+        cos, sin = cos[offset:need], sin[offset:need]
+
+        @primitive
+        def _rope(q, k):
+            return rope(q, cos, sin), rope(k, cos, sin)
+
+        return _rope(q, k)
 
     def _local_heads(self):
         """Head count on this shard: under an explicit 'mp' shard_map region
@@ -176,6 +217,9 @@ class GPTAttention(Layer):
         # lifecycle; None = normal training/eval forward)
         cache = getattr(self, "_gen_cache", None)
         if cache is not None:
+            offset = cache["k"].shape[2] if cache.get("k") is not None else 0
+            if self.use_rope:
+                q, k = self._apply_rope(q, k, offset)
             if cache.get("k") is not None:
                 k = manip.concat([cache["k"], k], axis=2)
                 v = manip.concat([cache["v"], v], axis=2)
@@ -185,6 +229,8 @@ class GPTAttention(Layer):
             causal = q.shape[2] == k.shape[2]
             out, _ = scaled_dot_product_attention(q, k, v, is_causal=causal)
             return self._finish(out, b, t)
+        if self.use_rope:
+            q, k = self._apply_rope(q, k)
         if self.sequence_parallel != "none":
             from ..distributed.meta_parallel.sequence_parallel import (
                 ring_attention,
@@ -195,6 +241,11 @@ class GPTAttention(Layer):
             if sp_axis_bound():
                 # x is this shard's sequence slice [B, T/n, H]; attention
                 # spans the full sequence via ring ppermute / Ulysses a2a
+                if self.use_rope:
+                    raise ValueError(
+                        "position_embedding='rope' with sequence_parallel "
+                        "needs per-shard position offsets; not wired yet — "
+                        "use learned positions for sp runs")
                 if self.training and self.dropout_p > 0.0:
                     raise ValueError(
                         "attention_dropout_prob > 0 is not supported with "
@@ -215,14 +266,59 @@ class GPTAttention(Layer):
 
 
 class GPTMLP(Layer):
+    """Dense FFN: gelu (GPT-3) or fused-swiglu gate (llama family,
+    ops/pallas/swiglu.py on TPU)."""
+
     def __init__(self, config: GPTConfig):
         super().__init__()
-        self.fc_in = ColumnParallelLinear(config.hidden_size, config.intermediate_size,
-                                          gather_output=False)
-        self.fc_out = RowParallelLinear(config.intermediate_size, config.hidden_size,
-                                        input_is_parallel=True)
+        self.activation = config.activation
+        h, f = config.hidden_size, config.intermediate_size
+        if self.activation == "swiglu":
+            self.gate_proj = ColumnParallelLinear(h, f, gather_output=False,
+                                                  has_bias=False)
+            self.up_proj = ColumnParallelLinear(h, f, gather_output=False,
+                                                has_bias=False)
+        else:
+            self.fc_in = ColumnParallelLinear(h, f, gather_output=False)
+        self.fc_out = RowParallelLinear(f, h, input_is_parallel=True)
 
     def forward(self, x):
+        if self.activation == "swiglu":
+            from ..distributed.meta_parallel.mp_layers import (
+                _c_identity,
+                mp_axis_bound,
+            )
+            from ..ops._primitive import primitive
+            from ..ops.pallas.swiglu import swiglu, swiglu_reference
+
+            explicit_mp = mp_axis_bound()
+            from ..distributed.env import get_mesh
+
+            mesh = get_mesh()
+            gspmd_mp = (not explicit_mp and mesh is not None
+                        and int(mesh.shape.get("mp", 1)) > 1)
+            if explicit_mp:
+                x = _c_identity(x)  # column-parallel input identity/psum-bwd
+
+            @primitive
+            def _glu(x, wg, wu):
+                lead = x.shape[:-1]
+                x2 = x.reshape(-1, x.shape[-1])
+                if gspmd_mp:
+                    # GSPMD shards these matmuls; the pallas path would
+                    # force replication — use the fusable jnp form
+                    out = swiglu_reference(x2, wg, wu)
+                else:
+                    out = swiglu(x2, wg, wu)
+                return out.reshape(*lead, wg.shape[1])
+
+            h = _glu(x, self.gate_proj.weight, self.up_proj.weight)
+            if gspmd_mp:
+                from ..distributed.spmd import P, with_sharding_constraint
+
+                h = with_sharding_constraint(
+                    h, P(*([None] * (len(x.shape) - 1) + ["mp"])))
+            return self.fc_out(h)
         return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
 
 
@@ -285,11 +381,16 @@ class GPTEmbeddings(Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.word_embeddings = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
-        self.position_embeddings = Embedding(config.max_position_embeddings, config.hidden_size)
+        # rope configs (llama family) carry positions in attention, not here
+        self.use_wpe = config.position_embedding == "learned"
+        if self.use_wpe:
+            self.position_embeddings = Embedding(config.max_position_embeddings, config.hidden_size)
         self.dropout = Dropout(config.hidden_dropout_prob, mode="upscale_in_train")
         self.sequence_parallel = config.sequence_parallel
 
     def forward(self, input_ids, position_ids=None):
+        if not self.use_wpe:
+            return self.dropout(self.word_embeddings(input_ids))
         t = input_ids.shape[-1]
         if position_ids is None:
             if self.sequence_parallel != "none":

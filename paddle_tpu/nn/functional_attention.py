@@ -38,7 +38,9 @@ def _use_flash(q, k, dropout_p, need_weights, attn_mask, is_causal):
     if dev != "tpu":
         return False
     T, S, D = q.shape[-2], k.shape[-2], q.shape[-1]
-    return T >= _FLASH_MIN_SEQ and S >= _FLASH_MIN_SEQ and D % 128 == 0 and T % 128 == 0 and S % 128 == 0
+    # D=64 is viable since the whole-sequence-block layout (v5e-measured:
+    # beats the XLA einsum path at B8 H16 T1024 D64 — see flash_attention)
+    return T >= _FLASH_MIN_SEQ and S >= _FLASH_MIN_SEQ and D % 64 == 0 and T % 128 == 0 and S % 128 == 0
 
 
 def scaled_dot_product_attention(

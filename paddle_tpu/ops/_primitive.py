@@ -146,6 +146,9 @@ def primitive(fn: Callable = None, *, nondiff: bool = False, aux: int = 0, name:
             diff_tensors,
             [(a.shape, a.dtype) for a in out_arrays],
             name=op_name,
+            pure_fn=pure,  # re-differentiable source for create_graph
+            has_aux=bool(aux),
+            tuple_out=isinstance(out, tuple),
         )
         out_tensors = []
         for pos, a in enumerate(out_arrays):

@@ -33,8 +33,11 @@ __all__ = [
 
 
 def _cmp(jfn):
+    from ._primitive import primitive
+
+    @primitive(nondiff=True, name=jfn.__name__)
     def fn(x, y=None, name=None):  # noqa: ARG001
-        return wrap(jfn(jnp.asarray(unwrap(x)), jnp.asarray(unwrap(y))))
+        return jfn(jnp.asarray(x), jnp.asarray(y))
 
     return fn
 

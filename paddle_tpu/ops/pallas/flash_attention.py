@@ -48,8 +48,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
+        # feed the MXU native bf16 operands with f32 accumulation — casting
+        # to f32 first would force 8x-slower f32 systolic passes
+        q = q_ref[0]
+        k = k_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
@@ -66,8 +68,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         p = jnp.exp(s - m_new)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
 
-        v = v_ref[0].astype(jnp.float32)
-        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+        v = v_ref[0]
+        pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc_ref[:] = acc_ref[:] * alpha + pv
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -141,8 +143,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
@@ -151,12 +153,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             s = jnp.where(rows >= cols, s, NEG_INF)
         lse = lse_ref[0][:, :1]
         p = jnp.exp(s - lse)
-        do = do_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0]
+        v = v_ref[0]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         delta = delta_ref[0][:, :1]
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(k.dtype)
         acc_ref[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                           preferred_element_type=jnp.float32) * sm_scale
 
@@ -185,8 +187,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
@@ -195,15 +197,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(rows >= cols, s, NEG_INF)
         lse = lse_ref[0][:, :1]
         p = jnp.exp(s - lse)  # (bq, bk)
-        do = do_ref[0].astype(jnp.float32)
+        do = do_ref[0]
         # dv += p^T @ do
-        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        v = v_ref[0]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         delta = delta_ref[0][:, :1]
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         # dk += ds^T @ q
         dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32) * sm_scale
@@ -302,14 +305,24 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, causal=False, sm_scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    interpret=None):
+                    block_q=None, block_k=None, interpret=None):
     """Flash attention over [B, H, T, D] (or [BH, T, D]) arrays.
 
-    D must be a multiple of 128 and T/S multiples of the block sizes;
+    D must be a multiple of 64 and T/S multiples of 128;
     nn/functional_attention.py guards those preconditions and falls back
     to the XLA einsum path otherwise.
+
+    Default blocks come from v5e-measured sweeps (fwd+bwd, interleaved
+    A/B vs the XLA einsum path): at D=64 small blocks lose to per-block
+    overhead — whole-sequence blocks win (14.6 vs 15.6 ms XLA at
+    B8 H16 T1024); at D>=128 the score matrix forces bk<=512 for VMEM and
+    bq1024/bk512 wins (17.8 vs 26.8 ms XLA at B8 H16 T2048 D128).
     """
+    t_len, d_head = q.shape[-2], q.shape[-1]
+    if block_q is None:
+        block_q = min(t_len, 1024)
+    if block_k is None:
+        block_k = min(k.shape[-2], 1024 if d_head < 128 else 512)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:

@@ -1,0 +1,160 @@
+"""Fused residual + dropout + LayerNorm as a Pallas TPU kernel.
+
+Parity: the reference's fused_dropout_helper.h /
+fused_layernorm_residual_dropout_bias.h CUDA kernels — one pass computing
+
+    y   = residual + dropout(x)          (the pre-LN block boundary)
+    out = layer_norm(y) * gamma + beta
+
+returning BOTH ``y`` (the next residual stream) and ``out`` (the next
+sublayer input), so the [T, H] intermediate never makes an extra HBM
+round-trip and the mask/moments fuse with the normalization.
+
+TPU-native choice: the dropout mask is generated OUTSIDE with the
+framework's seeded jax PRNG and passed in as a bool array — keeping masks
+on the unified RNG stream (deterministic replay, TP rng-tracker parity)
+instead of a kernel-private curand state like the reference. XLA fuses the
+bernoulli into a cheap elementwise producer; the kernel fuses everything
+downstream of it.
+
+Backward is composed in jnp from the saved (y, mask, mean, rstd) — matching
+the reference's FusedDropoutLayerNormHelper<true> backward decomposition.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_residual_dropout_ln", "fused_residual_dropout_ln_reference"]
+
+BLOCK_M = 256
+
+
+def fused_residual_dropout_ln_reference(x, residual, mask, gamma, beta,
+                                        p: float, epsilon: float = 1e-5):
+    """Unfused jnp reference. mask: keep-mask bool (ignored when p == 0)."""
+    if p > 0.0:
+        y = residual + jnp.where(mask, x / (1.0 - p), 0.0).astype(x.dtype)
+    else:
+        y = residual + x
+    yf = y.astype(jnp.float32)
+    mu = yf.mean(-1, keepdims=True)
+    var = ((yf - mu) ** 2).mean(-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + epsilon)
+    out = ((yf - mu) * rstd * gamma + beta).astype(x.dtype)
+    return out, y
+
+
+def _fused_kernel(x_ref, res_ref, mask_ref, gamma_ref, beta_ref,
+                  out_ref, y_ref, *, p, epsilon):
+    x = x_ref[:].astype(jnp.float32)
+    if p > 0.0:
+        keep = mask_ref[:] != 0
+        x = jnp.where(keep, x / (1.0 - p), 0.0)
+    y = res_ref[:].astype(jnp.float32) + x
+    mu = y.mean(-1, keepdims=True)
+    var = ((y - mu) ** 2).mean(-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + epsilon)
+    out = (y - mu) * rstd * gamma_ref[:].astype(jnp.float32) \
+        + beta_ref[:].astype(jnp.float32)
+    out_ref[:] = out.astype(out_ref.dtype)
+    y_ref[:] = y.astype(y_ref.dtype)
+
+
+def _fwd_raw(x, residual, mask, gamma, beta, p, epsilon, block_m, interpret):
+    m, h = x.shape
+    kern = functools.partial(_fused_kernel, p=p, epsilon=epsilon)
+    return pl.pallas_call(
+        kern,
+        grid=(pl.cdiv(m, block_m),),
+        in_specs=[
+            pl.BlockSpec((block_m, h), lambda i: (i, 0)),
+            pl.BlockSpec((block_m, h), lambda i: (i, 0)),
+            pl.BlockSpec((block_m, h), lambda i: (i, 0)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_m, h), lambda i: (i, 0)),
+            pl.BlockSpec((block_m, h), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, h), x.dtype),
+            jax.ShapeDtypeStruct((m, h), x.dtype),
+        ],
+        interpret=interpret,
+    )(x, residual, mask, gamma, beta)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _fused(x, residual, mask, gamma, beta, p, epsilon, block_m, interpret):
+    return _fwd_raw(x, residual, mask, gamma, beta, p, epsilon, block_m, interpret)
+
+
+def _fused_vjp_fwd(x, residual, mask, gamma, beta, p, epsilon, block_m, interpret):
+    out, y = _fwd_raw(x, residual, mask, gamma, beta, p, epsilon, block_m, interpret)
+    return (out, y), (y, mask, gamma)
+
+
+def _fused_vjp_bwd(p, epsilon, block_m, interpret, res, cts):
+    y, mask, gamma = res
+    g_out, g_y = cts
+    yf = y.astype(jnp.float32)
+    mu = yf.mean(-1, keepdims=True)
+    var = ((yf - mu) ** 2).mean(-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + epsilon)
+    xhat = (yf - mu) * rstd
+    go = g_out.astype(jnp.float32)
+    dgamma = (go * xhat).sum(0)
+    dbeta = go.sum(0)
+    # LN input grad
+    gx = go * gamma.astype(jnp.float32)
+    h = y.shape[-1]
+    dy = rstd * (gx - gx.mean(-1, keepdims=True)
+                 - xhat * (gx * xhat).mean(-1, keepdims=True))
+    dy = dy + g_y.astype(jnp.float32)  # the y output feeds the residual stream
+    d_res = dy
+    if p > 0.0:
+        dx = jnp.where(mask != 0, dy / (1.0 - p), 0.0)
+    else:
+        dx = dy
+    return (dx.astype(y.dtype), d_res.astype(y.dtype), None,
+            dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype))
+
+
+_fused.defvjp(_fused_vjp_fwd, _fused_vjp_bwd)
+
+
+def fused_residual_dropout_ln(x, residual, gamma, beta, *, p: float = 0.0,
+                              epsilon: float = 1e-5, mask=None,
+                              block_m: int = BLOCK_M, interpret=None):
+    """``(layer_norm(residual + dropout(x)), residual + dropout(x))``.
+
+    ``mask``: keep-mask (bool, same shape) — required when ``p > 0``;
+    generate it from the framework PRNG (``jax.random.bernoulli(key, 1-p)``).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if p > 0.0 and mask is None:
+        raise ValueError("p > 0 requires an explicit keep-mask")
+    lead = x.shape[:-1]
+    h = x.shape[-1]
+    m = 1
+    for s in lead:
+        m *= s
+    if h % 128 != 0 or m % 8 != 0:
+        out, y = fused_residual_dropout_ln_reference(
+            x, residual, mask, gamma, beta, p, epsilon)
+        return out, y
+    x2 = x.reshape(m, h)
+    r2 = residual.reshape(m, h)
+    mk = (mask.reshape(m, h).astype(jnp.int8) if mask is not None
+          else jnp.ones((m, h), jnp.int8))
+    bm = min(block_m, m)
+    out, y = _fused(x2, r2, mk, gamma, beta, float(p), float(epsilon), bm,
+                    bool(interpret))
+    return out.reshape(*lead, h), y.reshape(*lead, h)

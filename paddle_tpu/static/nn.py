@@ -81,11 +81,195 @@ def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
     return layer(input)
 
 
-def cond(pred, true_fn=None, false_fn=None):
-    raise NotImplementedError(
-        "static control flow is not supported in v1; use @to_static over "
-        "python control flow (jax.lax.cond under jit) instead"
-    )
+def _trace_into_sub(outer, fn, args=(), placeholder_avals=None,
+                    ph_prefix="__loop_var"):
+    """Trace ``fn`` into a fresh sub-Program (reference: sub-block
+    construction in conditional_block_op.cc / while_op.cc).
+
+    ``placeholder_avals``: when given, fresh placeholder Variables with those
+    avals are created and passed as ``fn(*placeholders)`` (the while-loop
+    carry); otherwise ``fn(*args)`` runs directly.
+
+    Returns ``(sub, out_vars, out_tree, free, ph_names)`` where ``free`` maps
+    sub-scope variable names to the OUTER Variables the body closes over.
+    The sub-program continues the outer name sequence so a branch-local op
+    output can never shadow an enclosing-scope variable with the same
+    auto-generated name.
+    """
+    import jax
+
+    from ..tensor import Tensor
+    from .program import Program, Variable, program_guard
+
+    sub = Program()
+    sub.vars.update(outer.vars)  # allow references to enclosing-scope vars
+    sub._name_counter = outer._name_counter
+
+    phs, ph_names = [], []
+    if placeholder_avals is not None:
+        for i, aval in enumerate(placeholder_avals):
+            name = f"{ph_prefix}_{i}__"
+            ph = Variable(aval, name, sub, role="feed")
+            sub._register(ph)
+            phs.append(ph)
+            ph_names.append(name)
+        call_args = phs
+    else:
+        call_args = args
+
+    with program_guard(sub, Program()):
+        outs = fn(*call_args)
+    # later outer names must not collide with branch-internal ones either
+    outer._name_counter = max(outer._name_counter, sub._name_counter)
+
+    flat_outs, out_tree = jax.tree_util.tree_flatten(
+        outs, is_leaf=lambda x: isinstance(x, Tensor))
+    out_vars = []
+    for leaf in flat_outs:
+        if isinstance(leaf, Variable):
+            out_vars.append(leaf)
+        elif isinstance(leaf, Tensor):
+            out_vars.append(sub.capture(leaf))
+        else:
+            raise TypeError(f"control-flow fn returned a non-tensor leaf: {leaf!r}")
+
+    produced = {v.name for op in sub.ops for v in op.out_vars}
+    skip = set(ph_names)
+    free = {}
+
+    def note(v):
+        if v.name in produced or v.name in skip or v.name in free:
+            return
+        src = next((t for (t, cv) in sub._captures.values() if cv is v), None)
+        free[v.name] = outer.capture(src) if src is not None else v
+
+    for op in sub.ops:
+        for x in op.flat_args:
+            if isinstance(x, Variable):
+                note(x)
+    for v in out_vars:
+        note(v)
+    return sub, out_vars, out_tree, free, ph_names
 
 
-while_loop = cond
+def _branch_runner(sub, out_vars, names, ph_names=()):
+    """Pure function replaying the sub-program over bound arrays."""
+    from .executor import _replay
+
+    def run(closure_arrs, carry=()):
+        env = dict(zip(names, closure_arrs))
+        env.update(zip(ph_names, carry))
+        _replay(sub, env)
+        return tuple(env[v.name] for v in out_vars)
+
+    return run
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """paddle.static.nn.cond parity (reference conditional_block_op.cc +
+    fluid/layers/control_flow.py cond): both branches are traced into
+    sub-programs and lowered to ``lax.cond`` inside the Program jit.
+
+    Branches must be side-effect free (no dropout/BN-stat writes inside a
+    branch) and return matching structures — the XLA requirement that both
+    arms produce identical shapes/dtypes."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor
+    from .program import default_main_program, record_op, recording_active
+
+    if true_fn is None or false_fn is None:
+        raise ValueError("cond requires both true_fn and false_fn")
+    if not recording_active():
+        # dygraph: plain python dispatch (reference cond eager path)
+        import numpy as _np
+
+        p = pred._data if isinstance(pred, Tensor) else pred
+        return true_fn() if bool(_np.asarray(p).reshape(())) else false_fn()
+
+    outer = default_main_program()
+    t_sub, t_outs, t_tree, t_free, _ = _trace_into_sub(outer, true_fn)
+    f_sub, f_outs, f_tree, f_free, _ = _trace_into_sub(outer, false_fn)
+
+    # operand union: lax.cond passes the same operands to both arms
+    free = dict(t_free)
+    for n, v in f_free.items():
+        free.setdefault(n, v)
+    names = list(free)
+    inputs = [free[n] for n in names]
+    t_run = _branch_runner(t_sub, t_outs, names)
+    f_run = _branch_runner(f_sub, f_outs, names)
+
+    def fn(pred_arr, *arrs):
+        b = pred_arr.reshape(()).astype(jnp.bool_)
+        return jax.lax.cond(b, t_run, f_run, arrs)
+
+    outs = record_op(fn, "cond", (pred, *inputs), {})
+    flat = jax.tree_util.tree_flatten(outs, is_leaf=lambda x: isinstance(x, Tensor))[0]
+    return jax.tree_util.tree_unflatten(t_tree, flat)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop parity (reference while_op.cc +
+    layers/control_flow.py while_loop): condition and body are traced into
+    sub-programs and lowered to ``lax.while_loop`` inside the Program jit.
+    ``loop_vars`` shapes/dtypes must be loop-invariant (XLA's while
+    contract — matching the reference's requirement that the block's
+    outputs mirror its inputs)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from ..tensor import Tensor
+    from .program import default_main_program, record_op, recording_active
+
+    if not recording_active():
+        vars_ = list(loop_vars)
+        while True:
+            p = cond_fn(*vars_)
+            if not bool(_np.asarray(p._data if isinstance(p, Tensor) else p).reshape(())):
+                break
+            out = body_fn(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vars_
+
+    outer = default_main_program()
+    n_loop = len(loop_vars)
+    avals = []
+    for lv in loop_vars:
+        d = lv._data
+        avals.append(d if isinstance(d, jax.ShapeDtypeStruct)
+                     else jax.ShapeDtypeStruct(tuple(d.shape), d.dtype))
+
+    c_sub, c_outs, _, c_free, ph_names = _trace_into_sub(
+        outer, cond_fn, placeholder_avals=avals)
+    b_sub, b_outs, _, b_free, _ = _trace_into_sub(
+        outer, body_fn, placeholder_avals=avals)
+    if len(b_outs) != n_loop:
+        raise ValueError(
+            f"body_fn returned {len(b_outs)} vars, expected {n_loop}")
+
+    free = dict(c_free)
+    for n, v in b_free.items():
+        free.setdefault(n, v)
+    names = list(free)
+    inputs = [free[n] for n in names]
+    c_run = _branch_runner(c_sub, c_outs, names, ph_names)
+    b_run = _branch_runner(b_sub, b_outs, names, ph_names)
+
+    def fn(*args):
+        init = args[:n_loop]
+        closure = args[n_loop:]
+
+        def cond_f(carry):
+            (out,) = c_run(closure, carry)
+            return out.reshape(()).astype(jnp.bool_)
+
+        def body_f(carry):
+            return b_run(closure, carry)
+
+        return jax.lax.while_loop(cond_f, body_f, tuple(init))
+
+    outs = record_op(fn, "while", (*loop_vars, *inputs), {})
+    return list(outs) if isinstance(outs, (list, tuple)) else [outs]

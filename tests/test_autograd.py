@@ -106,3 +106,63 @@ def test_non_scalar_backward_requires_grad_tensor():
         raise AssertionError("expected RuntimeError")
     except RuntimeError:
         pass
+
+
+class TestDoubleGrad:
+    """create_graph=True: the backward lands on the tape (reference:
+    PartialGradEngine partial_grad_engine.cc:1088 + matmul_v2_grad_grad)."""
+
+    def test_elementwise_double_grad(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.autograd import tape
+
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x * x).sum()
+        (g1,) = tape.grad(y, [x], create_graph=True)
+        np.testing.assert_allclose(np.asarray(g1._data), [12.0, 27.0])
+        assert g1._node is not None  # backward was taped
+        (g2,) = tape.grad(g1.sum(), [x])
+        np.testing.assert_allclose(np.asarray(g2._data), [12.0, 18.0])  # 6x
+
+    def test_matmul_double_grad(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.autograd import tape
+
+        rng = np.random.default_rng(0)
+        a_np = rng.normal(size=(3, 4)).astype("float32")
+        b_np = rng.normal(size=(4, 2)).astype("float32")
+        a = paddle.to_tensor(a_np, stop_gradient=False)
+        b = paddle.to_tensor(b_np, stop_gradient=False)
+        z = paddle.matmul(a, b).sum()
+        (ga,) = tape.grad(z, [a], create_graph=True)
+        # dz/da = 1 @ b^T
+        np.testing.assert_allclose(
+            np.asarray(ga._data), np.ones((3, 2)) @ b_np.T, rtol=1e-5)
+        # d/d b of sum(ga * a) = d/db sum((1 @ b^T) * a) -> ones^T-weighted a
+        (gb,) = tape.grad((ga * a).sum(), [b])
+        want = (a_np.T @ np.ones((3, 2))).astype("float32")
+        np.testing.assert_allclose(np.asarray(gb._data), want, rtol=1e-5)
+
+    def test_activation_double_grad(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.autograd import tape
+
+        x = paddle.to_tensor([0.5, -0.3, 1.2], stop_gradient=False)
+        y = F.tanh(x).sum()
+        (g1,) = tape.grad(y, [x], create_graph=True)
+        (g2,) = tape.grad(g1.sum(), [x])
+        t = np.tanh(np.asarray([0.5, -0.3, 1.2]))
+        np.testing.assert_allclose(
+            np.asarray(g2._data), -2 * t * (1 - t * t), rtol=1e-5)
+
+    def test_double_backward_via_backward(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.autograd import tape
+
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = (x ** 2).sum()
+        (g1,) = tape.grad(y, [x], create_graph=True)
+        s = g1.sum()
+        s.backward()
+        np.testing.assert_allclose(np.asarray(x.grad._data), [2.0, 2.0])
