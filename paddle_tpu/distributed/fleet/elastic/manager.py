@@ -86,6 +86,44 @@ class _FileStore:
         return eps
 
 
+class _TcpStore:
+    """KV/heartbeat registry over the HTTP KV server — the cross-host etcd
+    equivalent (reference manager.py:103 etcd registry). Same interface as
+    :class:`_FileStore`, liveness by server-side write timestamps."""
+
+    def __init__(self, addr: str, scope: str, ttl: float = 10.0):
+        from ..utils.http_server import KVClient
+
+        self.client = KVClient(addr)
+        self.scope = f"elastic_{scope}"
+        self.ttl = ttl
+        self._values = {}
+
+    def register(self, node_id: str, value: str):
+        self._values[node_id] = value
+        self.client.put(self.scope, node_id, value)
+
+    def heartbeat(self, node_id: str):
+        val = self._values.get(node_id, "")
+        self.client.put(self.scope, node_id, val)
+
+    def deregister(self, node_id: str):
+        self.client.delete(self.scope, node_id)
+
+    def _alive(self):
+        """One snapshot: {node_id: endpoint} for live nodes (a second scan
+        could race a concurrent registration)."""
+        return {k: v for k, (v, age) in self.client.scan(self.scope).items()
+                if age <= self.ttl}
+
+    def nodes(self) -> List[str]:
+        return sorted(self._alive())
+
+    def endpoints(self) -> List[str]:
+        live = self._alive()
+        return [live[k] for k in sorted(live)]
+
+
 class ElasticManager:
     """Registers this node, watches membership, decides restart/exit.
 
@@ -93,11 +131,14 @@ class ElasticManager:
       PADDLE_ELASTIC_NP            target node count (elastic on when set)
       PADDLE_ELASTIC_JOB_ID        job key
       PADDLE_ELASTIC_TIMEOUT       seconds to hold for stragglers (default 120)
-      PADDLE_ELASTIC_STORE_PATH    shared dir for the node registry
+      PADDLE_ELASTIC_SERVER        host:port of the HTTP KV store (the etcd
+                                   stand-in; cross-host)
+      PADDLE_ELASTIC_STORE_PATH    shared dir fallback registry (single host
+                                   / shared FS)
       PADDLE_CURRENT_ENDPOINT      this node's endpoint
     """
 
-    def __init__(self, args=None, store: Optional[_FileStore] = None):
+    def __init__(self, args=None, store=None):
         self.np = int(os.environ.get("PADDLE_ELASTIC_NP", "0") or 0)
         self.job_id = os.environ.get("PADDLE_ELASTIC_JOB_ID", "default_job")
         self.timeout = int(os.environ.get("PADDLE_ELASTIC_TIMEOUT", "120"))
@@ -109,7 +150,13 @@ class ElasticManager:
             os.path.join("/tmp", f"paddle_elastic_{self.job_id}"),
         )
         self.enable = self.np > 0
-        self.store = store or _FileStore(store_path)
+        server = os.environ.get("PADDLE_ELASTIC_SERVER")
+        if store is not None:
+            self.store = store
+        elif server:
+            self.store = _TcpStore(server, self.job_id)
+        else:
+            self.store = _FileStore(store_path)
         self.node_id = self.endpoint.replace(":", "_").replace("/", "_")
         self._hb_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -199,8 +246,14 @@ def launch_elastic(cmd: List[str], max_restarts: int = 3,
                         code = ELASTIC_EXIT_CODE
             if code == 0:
                 return 0
-            if code == ELASTIC_EXIT_CODE and restarts < max_restarts:
+            # ELASTIC_EXIT_CODE always relaunches; under elastic mode ANY
+            # abnormal exit does too (fault-tolerance level 1: a preempted/
+            # killed worker re-registers and rejoins — reference
+            # manager.py fault tolerance + watch_local_trainers restart)
+            relaunchable = code == ELASTIC_EXIT_CODE or (mgr.enable and code != 0)
+            if relaunchable and restarts < max_restarts:
                 restarts += 1
+                mgr.register()  # re-register after a kill/preemption
                 mgr._membership_at_launch = mgr.store.nodes()
                 continue
             return code

@@ -1,0 +1,1 @@
+from .http_server import KVClient, KVServer  # noqa: F401

@@ -1,0 +1,165 @@
+"""Tiny HTTP key-value server — the cross-host rendezvous/elastic store.
+
+Parity: the reference's Gloo HTTP store
+(/root/reference/python/paddle/distributed/fleet/utils/http_server.py — a
+BaseHTTPRequestHandler KV server used for barrier/rendezvous) and the etcd
+registry of the elastic manager (fleet/elastic/manager.py:103). One tiny
+server process (or thread on node 0) replaces both: keys live in memory
+with write timestamps so clients implement TTL-based liveness.
+
+Protocol (scope = job id):
+  PUT    /<scope>/<key>   body = value        → store + stamp
+  GET    /<scope>/<key>                       → value (404 if absent)
+  DELETE /<scope>/<key>                       → remove
+  GET    /<scope>                             → json {key: [value, age_sec]}
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+__all__ = ["KVServer", "KVClient"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: Dict[str, Dict[str, Tuple[str, float]]] = {}
+    lock = threading.Lock()
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _parts(self):
+        parts = [p for p in self.path.split("/") if p]
+        return (parts[0], parts[1]) if len(parts) >= 2 else (parts[0] if parts else "", None)
+
+    def do_PUT(self):
+        scope, key = self._parts()
+        if key is None:
+            self.send_response(400)
+            self.end_headers()
+            return
+        n = int(self.headers.get("Content-Length", 0))
+        val = self.rfile.read(n).decode()
+        with self.lock:
+            self.store.setdefault(scope, {})[key] = (val, time.time())
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        scope, key = self._parts()
+        with self.lock:
+            bucket = dict(self.store.get(scope, {}))
+        if key is None:
+            now = time.time()
+            body = json.dumps(
+                {k: [v, now - ts] for k, (v, ts) in bucket.items()}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        hit = bucket.get(key)
+        if hit is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = hit[0].encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_DELETE(self):
+        scope, key = self._parts()
+        with self.lock:
+            self.store.get(scope, {}).pop(key, None)
+        self.send_response(200)
+        self.end_headers()
+
+
+class KVServer:
+    """In-process threaded KV server. ``with KVServer(port):`` or
+    start()/stop()."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class KVClient:
+    """Client for :class:`KVServer` (reference KVHandler http client role)."""
+
+    def __init__(self, addr: str, timeout: float = 5.0):
+        self.addr = addr  # "host:port"
+        self.timeout = timeout
+
+    def _conn(self):
+        host, port = self.addr.rsplit(":", 1)
+        return http.client.HTTPConnection(host, int(port), timeout=self.timeout)
+
+    def put(self, scope: str, key: str, value: str) -> bool:
+        try:
+            c = self._conn()
+            c.request("PUT", f"/{scope}/{key}", body=value.encode())
+            ok = c.getresponse().status == 200
+            c.close()
+            return ok
+        except OSError:
+            return False
+
+    def get(self, scope: str, key: str) -> Optional[str]:
+        try:
+            c = self._conn()
+            c.request("GET", f"/{scope}/{key}")
+            r = c.getresponse()
+            out = r.read().decode() if r.status == 200 else None
+            c.close()
+            return out
+        except OSError:
+            return None
+
+    def delete(self, scope: str, key: str) -> bool:
+        try:
+            c = self._conn()
+            c.request("DELETE", f"/{scope}/{key}")
+            ok = c.getresponse().status == 200
+            c.close()
+            return ok
+        except OSError:
+            return False
+
+    def scan(self, scope: str) -> Dict[str, Tuple[str, float]]:
+        """{key: (value, age_seconds)} for the whole scope."""
+        try:
+            c = self._conn()
+            c.request("GET", f"/{scope}")
+            r = c.getresponse()
+            if r.status != 200:
+                c.close()
+                return {}
+            data = json.loads(r.read().decode())
+            c.close()
+            return {k: (v[0], float(v[1])) for k, v in data.items()}
+        except (OSError, ValueError):
+            return {}

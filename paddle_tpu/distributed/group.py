@@ -47,8 +47,13 @@ class Group:
             from .env import _axis_size
 
             return _axis_size(self.axis_name)
-        from .env import get_world_size
+        # default (world) group: every device of the installed mesh is a
+        # rank (single-controller SPMD); fall back to the process world
+        from .env import get_mesh, get_world_size
 
+        mesh = get_mesh()
+        if mesh is not None:
+            return int(mesh.size)
         return get_world_size()
 
     @property

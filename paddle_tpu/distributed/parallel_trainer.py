@@ -75,7 +75,25 @@ class ParallelTrainer:
         accumulate_steps: int = 1,
         donate: bool = True,
         scaler=None,
+        offload_optimizer: bool = False,
+        strategy=None,
     ):
+        # DistributedStrategy wiring (the meta-optimizer config surface):
+        # sharding_configs.optimize_offload ≙ offload_helper.py,
+        # gradient_merge / recompute flags ≙ their meta-optimizers
+        if strategy is not None:
+            if getattr(strategy, "sharding", False):
+                cfgs = strategy.sharding_configs
+                offload_optimizer = offload_optimizer or bool(
+                    cfgs.get("optimize_offload", False))
+                if fsdp_axis is None and int(cfgs.get("stage", 1)) >= 2:
+                    fsdp_axis = "sharding"
+            if getattr(strategy, "recompute", False):
+                recompute = True
+            if getattr(strategy, "gradient_merge", False):
+                accumulate_steps = max(
+                    accumulate_steps,
+                    int(strategy.gradient_merge_configs.get("k_steps", 1)))
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -120,6 +138,41 @@ class ParallelTrainer:
             n: jax.device_put(b._data, NamedSharding(mesh, P()))
             for n, b in self._buffer_tensors.items()
         }
+
+        # --- ZeRO-offload: master params + slots live in HOST pinned
+        # memory, the device step only produces grads (reference:
+        # sharding/offload_helper.py — fp32 masters + moments on CPU,
+        # updates computed there, cast params copied back) ----------------
+        self.offload = bool(offload_optimizer)
+        if self.offload:
+            if self._scaler is not None:
+                raise NotImplementedError(
+                    "offload_optimizer with a GradScaler is not composed yet")
+            import numpy as np
+
+            from ..core import PinnedPool
+
+            self._cpu = jax.local_devices(backend="cpu")[0]
+            self._pool = PinnedPool()
+
+            def _host_buf(arr, dtype=None):
+                buf = self._pool.alloc_array(
+                    tuple(arr.shape), dtype or np.float32)
+                np.copyto(buf, np.asarray(arr, buf.dtype))
+                return buf
+
+            self._master = {n: _host_buf(p._data)
+                            for n, p in self._param_tensors.items()}
+            with jax.default_device(self._cpu):
+                st = optimizer.init_state(
+                    {n: jnp.asarray(a) for n, a in self._master.items()})
+            self._host_slots = jax.tree_util.tree_map(
+                lambda a: _host_buf(a, np.asarray(a).dtype), st["slots"])
+            self._host_step = st["step"]
+            self.opt_state = None  # nothing optimizer-side on device
+            self._jit_step = None
+            self._jit_eval = None
+            return
 
         # --- optimizer state placement (ZeRO-1/2 ≙ slot sharding) ------
         self.opt_state = optimizer.init_state(self.params)
@@ -269,6 +322,44 @@ class ParallelTrainer:
             return new_params, new_opt, new_buffers, loss, new_scale_state
 
         param_sh = {n: NamedSharding(mesh, s) for n, s in self.param_specs.items()}
+
+        if self.offload:
+            # device computes grads only; the update runs host-side
+            def grad_step(params, buffers, xb, yb, rng_key):
+                if acc <= 1:
+                    (l, nb), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                        params, buffers, xb, yb, rng_key)
+                    return g, l, nb
+                micro_x = xb.reshape((acc, xb.shape[0] // acc) + xb.shape[1:])
+                micro_y = yb.reshape((acc, yb.shape[0] // acc) + yb.shape[1:])
+                keys = jax.random.split(rng_key, acc)
+
+                def body(carry, mb):
+                    g_acc, l_acc, bufs = carry
+                    mx, my, k = mb
+                    (l, nb), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                        params, bufs, mx, my, k)
+                    return (jax.tree_util.tree_map(jnp.add, g_acc, g),
+                            l_acc + l, nb), None
+
+                zero_g = jax.tree_util.tree_map(
+                    lambda a: jnp.zeros(a.shape, jnp.float32), params)
+                (g, l_sum, nb), _ = jax.lax.scan(
+                    body, (zero_g, jnp.zeros((), jnp.float32), buffers),
+                    (micro_x, micro_y, keys))
+                g = jax.tree_util.tree_map(lambda x: x / acc, g)
+                return g, l_sum / acc, nb
+
+            buf_sh0 = {n: NamedSharding(mesh, P()) for n in self.buffers}
+            batch_sh0 = NamedSharding(mesh, P(dp) if dp else P())
+            repl0 = NamedSharding(mesh, P())
+            self._jit_step = jax.jit(
+                grad_step,
+                in_shardings=(param_sh, buf_sh0, batch_sh0, batch_sh0, None),
+                out_shardings=({n: repl0 for n in self.params}, repl0, buf_sh0),
+            )
+            return
+
         opt_sh = jax.tree_util.tree_map(
             lambda a: a.sharding if isinstance(a, jax.Array) else None,
             self.opt_state,
@@ -294,11 +385,44 @@ class ParallelTrainer:
             self._build()
         xb = x._data if isinstance(x, Tensor) else jnp.asarray(x)
         yb = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        if self.offload:
+            grads, loss, self.buffers = self._jit_step(
+                self.params, self.buffers, xb, yb, split_key())
+            self._host_apply(grads)
+            return Tensor(loss)
         self.params, self.opt_state, self.buffers, loss, self.scale_state = self._jit_step(
             self.params, self.opt_state, self.buffers, xb, yb, split_key(),
             self.scale_state,
         )
         return Tensor(loss)
+
+    def _host_apply(self, grads):
+        """ZeRO-offload update: D2H grads → fp32 master update on the host
+        CPU backend (slots in pinned-pool buffers) → H2D cast params."""
+        import numpy as np
+
+        host_grads = {
+            n: jax.device_put(np.asarray(g), self._cpu) for n, g in grads.items()
+        }
+        with jax.default_device(self._cpu):
+            masters = {n: jnp.asarray(a) for n, a in self._master.items()}
+            state = {
+                "slots": jax.tree_util.tree_map(jnp.asarray, self._host_slots),
+                "step": self._host_step,
+            }
+            new_master, new_state = self.optimizer.apply_gradients(
+                masters, host_grads, state)
+        for n, a in new_master.items():
+            np.copyto(self._master[n], np.asarray(a))
+        jax.tree_util.tree_map(
+            lambda dst, src: np.copyto(dst, np.asarray(src)),
+            self._host_slots, new_state["slots"])
+        self._host_step = new_state["step"]
+        mesh = self.mesh
+        for n in self.params:
+            self.params[n] = jax.device_put(
+                self._master[n].astype(self.params[n].dtype),
+                NamedSharding(mesh, self.param_specs[n]))
 
     def eval_step(self, x, y):
         from ..random import split_key

@@ -1,0 +1,401 @@
+"""dygraph-to-static AST conversion: data-dependent Python ``if``/``while``
+on Tensors become ``lax.cond`` / ``lax.while_loop`` under ``@to_static``.
+
+Parity: the reference's 25-file AST transpiler
+(/root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:768 ProgramTranslator + ifelse/loop transformers).
+TPU-native scope: a deliberately minimal, CONSERVATIVE pass —
+
+- an ``if``/``while`` is rewritten only when its body is expressible as a
+  pure closure: simple name assignments, no return/break/continue/yield.
+  Anything else keeps the original Python statement (which still works for
+  concrete predicates and raises jax's tracer error for traced ones).
+- rewritten constructs dispatch at RUN time: concrete predicates take the
+  plain Python path (bit-identical semantics), traced predicates lower to
+  ``lax.cond``/``lax.while_loop``.
+
+This covers the reference dygraph_to_static test shapes (tensor-valued
+if/else assignment, counting/accumulating while loops) without attempting
+the full transpiler; unconvertible control flow keeps a teachable error.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable, List, Optional, Set
+
+__all__ = ["convert_function", "pd_cond", "pd_while"]
+
+
+# ---------------------------------------------------------------------------
+# runtime dispatch helpers (injected as globals into converted functions)
+# ---------------------------------------------------------------------------
+def _is_traced(x):
+    import jax
+
+    return isinstance(x, jax.core.Tracer)
+
+
+def _pred_value(pred):
+    from ..tensor import Tensor
+
+    return pred._data if isinstance(pred, Tensor) else pred
+
+
+class _Undefined:
+    """Sentinel for names possibly unbound at the control-flow site
+    (reference dygraph_to_static UndefinedVar role). Merely holding it is
+    fine (the original code would simply leave the name unbound); USING it
+    raises the UnboundLocalError the untransformed code would have raised."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<pd-undefined>"
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            "variable was left undefined by the untaken branch of a "
+            "converted if/else (assign it on both paths)")
+
+    __bool__ = __add__ = __radd__ = __sub__ = __rsub__ = _raise
+    __mul__ = __rmul__ = __truediv__ = __rtruediv__ = _raise
+    __getitem__ = __iter__ = __len__ = __float__ = __int__ = _raise
+    __call__ = __array__ = __matmul__ = __neg__ = _raise
+    __lt__ = __le__ = __gt__ = __ge__ = _raise
+
+
+UNDEFINED = _Undefined()
+
+
+def pd_cond(pred, true_fn, false_fn, args=()):
+    """if/else dispatch: Python for concrete preds, lax.cond for traced."""
+    import numpy as np
+
+    p = _pred_value(pred)
+    if not _is_traced(p):
+        return true_fn(*args) if bool(np.asarray(p).reshape(())) else false_fn(*args)
+    import jax
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor
+
+    cell = {}
+
+    def wrap(fn):
+        def f(_):
+            out = fn(*args)
+            flat, tree = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            cell.setdefault("tree", tree)
+            arrs = []
+            for x in flat:
+                if isinstance(x, _Undefined):
+                    raise ValueError(
+                        "a tensor-dependent if/else leaves a variable "
+                        "undefined on one branch; assign it on both paths "
+                        "(lax.cond requires matching branch outputs)")
+                arrs.append(x._data if isinstance(x, Tensor) else jnp.asarray(x))
+            return tuple(arrs)
+
+        return f
+
+    res = jax.lax.cond(jnp.reshape(p, ()).astype(bool),
+                       wrap(true_fn), wrap(false_fn), ())
+    from ..tensor import Tensor as T
+
+    return jax.tree_util.tree_unflatten(cell["tree"], [T(a) for a in res])
+
+
+def pd_while(cond_fn, body_fn, init):
+    """while dispatch: Python loop for concrete conds, lax.while_loop for
+    traced. ``init`` is the tuple of loop-carried values (all tensor-like);
+    their shapes/dtypes must be loop-invariant on the traced path."""
+    import numpy as np
+
+    from ..tensor import Tensor
+
+    p0 = _pred_value(cond_fn(*init))
+    if not _is_traced(p0):
+        vals = tuple(init)
+        while bool(np.asarray(_pred_value(cond_fn(*vals))).reshape(())):
+            vals = tuple(body_fn(*vals))
+        return vals
+    import jax
+    import jax.numpy as jnp
+
+    def unwrap_all(vals):
+        return tuple(v._data if isinstance(v, Tensor) else jnp.asarray(v)
+                     for v in vals)
+
+    def wrap_all(arrs):
+        return tuple(Tensor(a) for a in arrs)
+
+    def c(carry):
+        return jnp.reshape(_pred_value(cond_fn(*wrap_all(carry))), ()).astype(bool)
+
+    def b(carry):
+        return unwrap_all(body_fn(*wrap_all(carry)))
+
+    out = jax.lax.while_loop(c, b, unwrap_all(init))
+    return wrap_all(out)
+
+
+# ---------------------------------------------------------------------------
+# the AST pass
+# ---------------------------------------------------------------------------
+def _assigned_names(stmts: List[ast.stmt]) -> Optional[Set[str]]:
+    """Names simply assigned in the statement list; None = unconvertible."""
+    names: Set[str] = set()
+    for st in ast.walk(ast.Module(body=list(stmts), type_ignores=[])):
+        if isinstance(st, (ast.Return, ast.Break, ast.Continue, ast.Yield,
+                           ast.YieldFrom, ast.Global, ast.Nonlocal,
+                           ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Try, ast.With, ast.Raise)):
+            return None
+        if isinstance(st, ast.Assign):
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+                elif isinstance(t, ast.Tuple) and all(
+                        isinstance(e, ast.Name) for e in t.elts):
+                    names.update(e.id for e in t.elts)
+                else:
+                    return None
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            if isinstance(st.target, ast.Name):
+                names.add(st.target.id)
+            else:
+                return None
+        elif isinstance(st, ast.NamedExpr):
+            if isinstance(st.target, ast.Name):
+                names.add(st.target.id)
+            else:
+                return None
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            t = st.target
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+            elif isinstance(t, ast.Tuple) and all(
+                    isinstance(e, ast.Name) for e in t.elts):
+                names.update(e.id for e in t.elts)
+            else:
+                return None
+    return names
+
+
+def _loaded_names(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _load_counts(node: ast.AST):
+    from collections import Counter
+
+    return Counter(n.id for n in ast.walk(node)
+                   if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load))
+
+
+def _fn_locals(fdef) -> Set[str]:
+    """All names that are locals of the function (args + any assignment)."""
+    out = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
+                           + fdef.args.kwonlyargs)}
+    if fdef.args.vararg:
+        out.add(fdef.args.vararg.arg)
+    if fdef.args.kwarg:
+        out.add(fdef.args.kwarg.arg)
+    for n in ast.walk(fdef):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store, ast.Del)):
+            out.add(n.id)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)) \
+                and n is not fdef:
+            out.add(n.name)
+    return out
+
+
+def _capture_prelude(params, tag):
+    """try: tmp = name / except: tmp = UNDEFINED — capture current values
+    (possibly unbound) to pass into the extracted closures by value."""
+    stmts, tmps = [], []
+    for i, p in enumerate(params):
+        tmp = f"__pd_v{tag}_{i}"
+        tmps.append(tmp)
+        stmts.append(ast.Try(
+            body=[ast.Assign(targets=[ast.Name(id=tmp, ctx=ast.Store())],
+                             value=ast.Name(id=p, ctx=ast.Load()))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple(elts=[ast.Name(id="NameError", ctx=ast.Load()),
+                                     ast.Name(id="UnboundLocalError", ctx=ast.Load())],
+                               ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(targets=[ast.Name(id=tmp, ctx=ast.Store())],
+                                 value=ast.Name(id="__pd_undef__", ctx=ast.Load()))])],
+            orelse=[], finalbody=[]))
+    return stmts, tmps
+
+
+def _fn_args(params):
+    return ast.arguments(posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+                         kwonlyargs=[], kw_defaults=[], defaults=[])
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self, fn_locals: Set[str], fn_load_counts=None):
+        self.counter = 0
+        self.converted = 0
+        self.fn_locals = fn_locals
+        self.fn_load_counts = fn_load_counts or {}
+
+    def _name(self, kind):
+        self.counter += 1
+        return f"__pd_{kind}_{self.counter}"
+
+    # -- if/else → pd_cond ---------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        t_names = _assigned_names(node.body)
+        f_names = _assigned_names(node.orelse) if node.orelse else set()
+        if t_names is None or f_names is None:
+            return node  # unconvertible construct: keep plain Python
+        # liveness: only names READ outside this if-subtree become outputs
+        # (a branch-local loop temp stays internal — matching the reference
+        # transformer's return-name analysis)
+        inner = _load_counts(node)
+        outs = sorted(n for n in (t_names | f_names)
+                      if self.fn_load_counts.get(n, 0) > inner.get(n, 0))
+        loaded = set()
+        for st in node.body + (node.orelse or []):
+            loaded |= _loaded_names(st)
+        # pass by value every name the branches read or write that is a
+        # local of the enclosing function — avoids UnboundLocalError when a
+        # branch both reads and assigns the same name
+        params = sorted(set(outs) | (loaded & self.fn_locals))
+        tn, fn_ = self._name("true"), self._name("false")
+        self.counter += 1
+        prelude, tmps = _capture_prelude(params, self.counter)
+
+        def branch(name, body):
+            ret = ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=o, ctx=ast.Load()) for o in outs],
+                ctx=ast.Load()))
+            return ast.FunctionDef(
+                name=name, args=_fn_args(params),
+                body=(list(body) or [ast.Pass()]) + [ret],
+                decorator_list=[])
+
+        call = ast.Call(
+            func=ast.Name(id="__pd_cond__", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=tn, ctx=ast.Load()),
+                  ast.Name(id=fn_, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=t, ctx=ast.Load()) for t in tmps],
+                            ctx=ast.Load())],
+            keywords=[])
+        if outs:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=o, ctx=ast.Store()) for o in outs],
+                    ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        self.converted += 1
+        return [branch(tn, node.body), branch(fn_, node.orelse or []),
+                *prelude, assign]
+
+    # -- while → pd_while ----------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse:
+            return node
+        body_names = _assigned_names(node.body)
+        if body_names is None:
+            return node
+        # carry = every name the loop mutates; read-only enclosing locals
+        # stay closure captures (loop-invariant)
+        carried = sorted(body_names)
+        if not carried:
+            return node
+        cn, bn = self._name("while_cond"), self._name("while_body")
+        self.counter += 1
+        prelude, tmps = _capture_prelude(carried, self.counter)
+        cond_def = ast.FunctionDef(
+            name=cn, args=_fn_args(carried),
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        body_ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=c, ctx=ast.Load()) for c in carried],
+            ctx=ast.Load()))
+        body_def = ast.FunctionDef(
+            name=bn, args=_fn_args(carried),
+            body=list(node.body) + [body_ret], decorator_list=[])
+        call = ast.Call(
+            func=ast.Name(id="__pd_while__", ctx=ast.Load()),
+            args=[ast.Name(id=cn, ctx=ast.Load()),
+                  ast.Name(id=bn, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=t, ctx=ast.Load()) for t in tmps],
+                            ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=c, ctx=ast.Store()) for c in carried],
+                ctx=ast.Store())],
+            value=call)
+        self.converted += 1
+        return [cond_def, body_def, *prelude, assign]
+
+
+@functools.lru_cache(maxsize=256)
+def _convert_cached(fn):
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return None
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fdef.decorator_list = []  # drop @to_static etc.
+    tr = _ControlFlowTransformer(_fn_locals(fdef), _load_counts(fdef))
+    tr.visit(tree)
+    if tr.converted == 0:
+        return None
+    ast.fix_missing_locations(tree)
+    code = compile(tree, f"<dy2static:{fn.__qualname__}>", "exec")
+    glb = dict(fn.__globals__)
+    glb["__pd_cond__"] = pd_cond
+    glb["__pd_while__"] = pd_while
+    glb["__pd_undef__"] = UNDEFINED
+    # closures: rebuild free variables from the original function
+    if fn.__closure__:
+        for name, cellv in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb.setdefault(name, cellv.cell_contents)
+            except ValueError:
+                pass
+    ns = {}
+    exec(code, glb, ns)  # noqa: S102 — compiling the user's own source
+    new_fn = ns[fdef.name]
+    new_fn.__wrapped_by_dy2static__ = fn
+    return new_fn
+
+
+def convert_function(fn: Callable) -> Callable:
+    """AST-convert ``fn`` (best effort). Returns the original function when
+    nothing was converted or the source is unavailable."""
+    if getattr(fn, "_not_to_static", False):
+        return fn
+    target = fn
+    bound_self = getattr(fn, "__self__", None)
+    if bound_self is not None:
+        target = fn.__func__
+    converted = _convert_cached(target)
+    if converted is None:
+        return fn
+    if bound_self is not None:
+        return converted.__get__(bound_self, type(bound_self))
+    return converted

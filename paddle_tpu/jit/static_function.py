@@ -154,8 +154,12 @@ class StaticFunction:
         return jax.tree_util.tree_unflatten(cell["out_treedef"], outs)
 
     def _build(self, flat_template, treedef, traced_pos, kwargs):
+        from .dy2static import convert_function
+
         layer = self._bound_layer
-        fn = self._fn
+        # dygraph-to-static AST pass: tensor-dependent if/while become
+        # lax.cond/lax.while_loop (reference program_translator.py:768)
+        fn = convert_function(self._fn)
         cell: Dict[str, Any] = {}
         static_flat = [
             None if i in set(traced_pos) else x for i, x in enumerate(flat_template)

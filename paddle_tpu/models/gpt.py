@@ -175,8 +175,10 @@ class GPTAttention(Layer):
         t = q.shape[2]
         need = offset + t
         if self._rope_cache is None or self._rope_cache[0].shape[0] < need:
+            # grow geometrically: rebuilding to exactly `need` would
+            # recompute the table every autoregressive decode step
             self._rope_cache = build_rope_cache(
-                max(need, 32), self.head_dim, self.rope_base)
+                max(need * 2, 64), self.head_dim, self.rope_base)
         cos, sin = self._rope_cache
         cos, sin = cos[offset:need], sin[offset:need]
 

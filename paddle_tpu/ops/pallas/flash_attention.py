@@ -319,10 +319,24 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
     bq1024/bk512 wins (17.8 vs 26.8 ms XLA at B8 H16 T2048 D128).
     """
     t_len, d_head = q.shape[-2], q.shape[-1]
+    s_len = k.shape[-2]
+
+    def _fit(n, cap):
+        # largest 128-multiple <= cap dividing n (the kernels have no
+        # tail-block masking, so blocks must divide the sequence)
+        b = min(n, cap)
+        while n % b:
+            b -= 128
+        return b
+
     if block_q is None:
-        block_q = min(t_len, 1024)
+        block_q = _fit(t_len, 1024)
     if block_k is None:
-        block_k = min(k.shape[-2], 1024 if d_head < 128 else 512)
+        block_k = _fit(s_len, 1024 if d_head < 128 else 512)
+    if t_len % block_q or s_len % block_k:
+        raise ValueError(
+            f"flash blocks must divide the sequence: T={t_len} S={s_len} "
+            f"bq={block_q} bk={block_k}")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:

@@ -14,6 +14,7 @@ from .datasets import (  # noqa: F401
     WMT14,
     WMT16,
 )
+from .tokenizer import BasicTokenizer, BertTokenizer, WordpieceTokenizer  # noqa: F401
 from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401
 
 __all__ = [
@@ -25,6 +26,9 @@ __all__ = [
     "UCIHousing",
     "WMT14",
     "WMT16",
+    "BasicTokenizer",
+    "BertTokenizer",
+    "WordpieceTokenizer",
     "ViterbiDecoder",
     "viterbi_decode",
 ]

@@ -156,35 +156,78 @@ class Imikolov(_FileBackedDataset):
 
 
 class Movielens(_FileBackedDataset):
-    """MovieLens rating prediction (parity: text/datasets/movielens.py).
-    Synthetic: (user_id, movie_id, rating) triples."""
+    """MovieLens rating prediction (parity: text/datasets/movielens.py,
+    which parses the ml-1m archive: ``ratings.dat`` / ``users.dat`` /
+    ``movies.dat`` with ``::``-separated fields). ``data_file``: the ml-1m
+    zip (or a directory with the .dat files). Samples mirror the reference:
+    (user_id, gender_id, age_id, job_id, movie_id, rating)."""
+
+    AGES = [1, 18, 25, 35, 45, 50, 56]
 
     def _load(self):
-        rng = np.random.RandomState(11)
         if self.data_file:
-            raise NotImplementedError(
-                "Movielens archive parsing is not implemented; pass no "
-                "data_file for the synthetic sample"
-            )
+            self.samples = self._parse_ml1m()
+            return
+        rng = np.random.RandomState(11)
         self.samples = [
-            (np.int64(rng.randint(0, 100)), np.int64(rng.randint(0, 500)),
-             np.float32(rng.randint(1, 6)))
+            (np.int64(rng.randint(0, 100)), np.int64(rng.randint(0, 2)),
+             np.int64(rng.randint(0, 7)), np.int64(rng.randint(0, 21)),
+             np.int64(rng.randint(0, 500)), np.float32(rng.randint(1, 6)))
             for _ in range(self._synthetic_size)
         ]
 
+    def _read_member(self, name):
+        import io
+        import zipfile
+
+        if os.path.isdir(self.data_file):
+            with open(os.path.join(self.data_file, name), "rb") as f:
+                return io.TextIOWrapper(io.BytesIO(f.read()),
+                                        encoding="latin-1").readlines()
+        with zipfile.ZipFile(self.data_file) as z:
+            cand = [n for n in z.namelist() if n.endswith(name)]
+            if not cand:
+                raise FileNotFoundError(f"{name} not in {self.data_file}")
+            return io.TextIOWrapper(io.BytesIO(z.read(cand[0])),
+                                    encoding="latin-1").readlines()
+
+    def _parse_ml1m(self):
+        age_idx = {a: i for i, a in enumerate(self.AGES)}
+        users = {}
+        for line in self._read_member("users.dat"):
+            parts = line.strip().split("::")
+            if len(parts) < 4:
+                continue
+            uid, gender, age, job = parts[0], parts[1], int(parts[2]), int(parts[3])
+            users[uid] = (np.int64(0 if gender == "M" else 1),
+                          np.int64(age_idx.get(age, 0)), np.int64(job))
+        samples = []
+        for line in self._read_member("ratings.dat"):
+            parts = line.strip().split("::")
+            if len(parts) < 3 or parts[0] not in users:
+                continue
+            g, a, j = users[parts[0]]
+            samples.append((np.int64(parts[0]), g, a, j,
+                            np.int64(parts[1]), np.float32(parts[2])))
+        return samples
+
 
 class _ParallelCorpus(_FileBackedDataset):
-    """Shared WMT-style source/target id sequences."""
+    """Shared WMT-style parallel corpus (parity: text/datasets/wmt14.py /
+    wmt16.py — tarballs of parallel ``<split>.src`` / ``<split>.trg`` token
+    files). ``data_file``: a tar(.gz) holding ``{mode}.src``/``{mode}.trg``
+    (or the reference's ``train/train.fr-en.{fr,en}``-style pairs — any two
+    same-stem members with distinct suffixes). Samples are
+    (src_ids, trg_ids[:-1], trg_ids[1:]) with <s>=0 </s>=1 <unk>=2."""
 
     src_vocab = 30
     tgt_vocab = 30
+    BOS, EOS, UNK = 0, 1, 2
 
     def _load(self):
         if self.data_file:
-            raise NotImplementedError(
-                f"{type(self).__name__} archive parsing is not implemented; "
-                "pass no data_file for the synthetic sample"
-            )
+            self.samples = self._parse_tar()
+            return
         rng = np.random.RandomState(5)
         self.samples = []
         for _ in range(self._synthetic_size):
@@ -192,6 +235,50 @@ class _ParallelCorpus(_FileBackedDataset):
             src = rng.randint(2, self.src_vocab, size=n).astype("int64")
             tgt = np.concatenate([[0], (src[::-1] % self.tgt_vocab)]).astype("int64")
             self.samples.append((src, tgt[:-1], tgt[1:]))
+
+    def _build_vocab(self, lines):
+        freq = {}
+        for line in lines:
+            for w in line.split():
+                freq[w] = freq.get(w, 0) + 1
+        idx = {"<s>": self.BOS, "<e>": self.EOS, "<unk>": self.UNK}
+        for w in sorted(freq, key=lambda w: (-freq[w], w)):
+            idx.setdefault(w, len(idx))
+        return idx
+
+    def _parse_tar(self):
+        pairs = {}
+        with tarfile.open(self.data_file) as tf:
+            for m in tf.getmembers():
+                if not m.isfile():
+                    continue
+                base = os.path.basename(m.name)
+                stem, _, suffix = base.rpartition(".")
+                if self.mode not in base or not suffix:
+                    continue
+                pairs.setdefault(stem, {})[suffix] = [
+                    l.decode("utf-8", "ignore").strip()
+                    for l in tf.extractfile(m).read().splitlines()
+                ]
+        two = next((v for v in pairs.values() if len(v) >= 2), None)
+        if two is None:
+            raise ValueError(
+                f"no parallel '{self.mode}' member pair in {self.data_file}")
+        suffixes = sorted(two)
+        src_lines, trg_lines = two[suffixes[0]], two[suffixes[1]]
+        self.src_idx = self._build_vocab(src_lines)
+        self.trg_idx = self._build_vocab(trg_lines)
+        samples = []
+        for s, t in zip(src_lines, trg_lines):
+            if not s or not t:
+                continue
+            src = np.array([self.src_idx.get(w, self.UNK) for w in s.split()],
+                           "int64")
+            trg = np.array(
+                [self.BOS] + [self.trg_idx.get(w, self.UNK) for w in t.split()]
+                + [self.EOS], "int64")
+            samples.append((src, trg[:-1], trg[1:]))
+        return samples
 
 
 class WMT14(_ParallelCorpus):
@@ -210,10 +297,8 @@ class Conll05st(_FileBackedDataset):
 
     def _load(self):
         if self.data_file:
-            raise NotImplementedError(
-                "Conll05st archive parsing is not implemented; pass no "
-                "data_file for the synthetic sample"
-            )
+            self.samples = self._parse()
+            return
         rng = np.random.RandomState(13)
         self.samples = []
         for _ in range(self._synthetic_size):
@@ -222,3 +307,34 @@ class Conll05st(_FileBackedDataset):
             pred = np.int64(rng.randint(0, n))
             labels = rng.randint(0, self.num_labels, size=n).astype("int64")
             self.samples.append((words, pred, labels))
+
+    def _parse(self):
+        """CoNLL column format (word / predicate-marker / SRL tag per line,
+        blank line between sentences), optionally gzipped — the reference's
+        words/props file pair flattened into one file per split."""
+        opener = gzip.open if self.data_file.endswith(".gz") else open
+        with opener(self.data_file, "rt") as f:
+            lines = [l.rstrip("\n") for l in f]
+        word_idx, label_idx = {}, {}
+        sents, cur = [], []
+        for line in lines + [""]:
+            if not line.strip():
+                if cur:
+                    sents.append(cur)
+                cur = []
+                continue
+            cols = line.split()
+            cur.append((cols[0].lower(), cols[1] if len(cols) > 1 else "-",
+                        cols[2] if len(cols) > 2 else "O"))
+        samples = []
+        for sent in sents:
+            for w, _, t in sent:
+                word_idx.setdefault(w, len(word_idx))
+                label_idx.setdefault(t, len(label_idx))
+            words = np.array([word_idx[w] for (w, _, _) in sent], "int64")
+            marks = [i for i, (_, m, _) in enumerate(sent) if m != "-"]
+            pred = np.int64(marks[0] if marks else 0)
+            labels = np.array([label_idx[t] for (_, _, t) in sent], "int64")
+            samples.append((words, pred, labels))
+        self.word_idx, self.label_idx = word_idx, label_idx
+        return samples

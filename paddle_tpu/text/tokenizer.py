@@ -1,0 +1,195 @@
+"""BERT-style tokenization: basic + wordpiece + a batch-encoding front end.
+
+Parity: the reference's ``faster_tokenizer`` C++ op
+(/root/reference/paddle/fluid/operators/string/faster_tokenizer_op.cc wraps
+BertTokenizer: BasicTokenizer whitespace/punct/CJK/accent handling +
+WordpieceTokenizer greedy longest-match with '##' continuation) — here a
+host-side tokenizer whose output feeds device arrays; tokenization is I/O
+preprocessing and stays on the host in the TPU design (the device never
+sees strings).
+"""
+from __future__ import annotations
+
+import unicodedata
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = ["BasicTokenizer", "WordpieceTokenizer", "BertTokenizer"]
+
+
+def _is_whitespace(ch):
+    return ch in " \t\n\r" or unicodedata.category(ch) == "Zs"
+
+
+def _is_control(ch):
+    if ch in ("\t", "\n", "\r"):
+        return False
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_punctuation(ch):
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp):
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+
+class BasicTokenizer:
+    """Whitespace/punct/CJK split with optional lowercasing+accent strip
+    (reference BasicTokenizer semantics)."""
+
+    def __init__(self, do_lower_case: bool = True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text: str) -> List[str]:
+        out_chars = []
+        for ch in text:
+            cp = ord(ch)
+            if cp == 0 or cp == 0xFFFD or _is_control(ch):
+                continue
+            if _is_cjk(cp):
+                out_chars.extend([" ", ch, " "])
+            elif _is_whitespace(ch):
+                out_chars.append(" ")
+            else:
+                out_chars.append(ch)
+        tokens = []
+        for tok in "".join(out_chars).split():
+            if self.do_lower_case:
+                tok = tok.lower()
+                tok = "".join(c for c in unicodedata.normalize("NFD", tok)
+                              if unicodedata.category(c) != "Mn")
+            cur = []
+            for ch in tok:
+                if _is_punctuation(ch):
+                    if cur:
+                        tokens.append("".join(cur))
+                        cur = []
+                    tokens.append(ch)
+                else:
+                    cur.append(ch)
+            if cur:
+                tokens.append("".join(cur))
+        return tokens
+
+
+class WordpieceTokenizer:
+    """Greedy longest-match-first subword split with '##' continuations."""
+
+    def __init__(self, vocab: Dict[str, int], unk_token: str = "[UNK]",
+                 max_input_chars_per_word: int = 100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_chars = max_input_chars_per_word
+
+    def tokenize(self, token: str) -> List[str]:
+        if len(token) > self.max_chars:
+            return [self.unk_token]
+        out = []
+        start = 0
+        while start < len(token):
+            end = len(token)
+            cur = None
+            while start < end:
+                piece = token[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    cur = piece
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_token]
+            out.append(cur)
+            start = end
+        return out
+
+
+class BertTokenizer:
+    """vocab-file-driven end-to-end tokenizer + batch encoder (parity:
+    faster_tokenizer op output contract: input_ids + token_type_ids with
+    [CLS]/[SEP], truncation and padding)."""
+
+    def __init__(self, vocab: Union[str, Dict[str, int], Sequence[str]],
+                 do_lower_case: bool = True, unk_token: str = "[UNK]",
+                 cls_token: str = "[CLS]", sep_token: str = "[SEP]",
+                 pad_token: str = "[PAD]"):
+        if isinstance(vocab, str):
+            with open(vocab, encoding="utf-8") as f:
+                words = [l.rstrip("\n") for l in f]
+            self.vocab = {w: i for i, w in enumerate(words)}
+        elif isinstance(vocab, dict):
+            self.vocab = dict(vocab)
+        else:
+            self.vocab = {w: i for i, w in enumerate(vocab)}
+        self.inv_vocab = {i: w for w, i in self.vocab.items()}
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordpieceTokenizer(self.vocab, unk_token)
+        self.unk_token, self.cls_token = unk_token, cls_token
+        self.sep_token, self.pad_token = sep_token, pad_token
+
+    def tokenize(self, text: str) -> List[str]:
+        out = []
+        for tok in self.basic.tokenize(text):
+            out.extend(self.wordpiece.tokenize(tok))
+        return out
+
+    def convert_tokens_to_ids(self, tokens: Sequence[str]) -> List[int]:
+        unk = self.vocab.get(self.unk_token, 0)
+        return [self.vocab.get(t, unk) for t in tokens]
+
+    def convert_ids_to_tokens(self, ids: Sequence[int]) -> List[str]:
+        return [self.inv_vocab.get(int(i), self.unk_token) for i in ids]
+
+    def __call__(self, text: Union[str, Sequence[str]],
+                 text_pair: Optional[Union[str, Sequence[str]]] = None,
+                 max_seq_len: Optional[int] = None,
+                 pad_to_max_seq_len: bool = False):
+        """Batch encode → {'input_ids', 'token_type_ids'} int64 arrays
+        (lists when unpadded; the faster_tokenizer op contract)."""
+        single = isinstance(text, str)
+        texts = [text] if single else list(text)
+        pairs = ([text_pair] if isinstance(text_pair, str)
+                 else list(text_pair) if text_pair is not None
+                 else [None] * len(texts))
+        cls_id = self.vocab.get(self.cls_token, 0)
+        sep_id = self.vocab.get(self.sep_token, 0)
+        pad_id = self.vocab.get(self.pad_token, 0)
+        all_ids, all_types = [], []
+        for t, p in zip(texts, pairs):
+            ids_a = self.convert_tokens_to_ids(self.tokenize(t))
+            ids_b = self.convert_tokens_to_ids(self.tokenize(p)) if p else []
+            if max_seq_len:
+                budget = max_seq_len - 2 - (1 if ids_b else 0)
+                if ids_b:
+                    # longest-first truncation
+                    while len(ids_a) + len(ids_b) > budget:
+                        (ids_a if len(ids_a) >= len(ids_b) else ids_b).pop()
+                else:
+                    ids_a = ids_a[:budget]
+            ids = [cls_id] + ids_a + [sep_id]
+            types = [0] * len(ids)
+            if ids_b:
+                ids += ids_b + [sep_id]
+                types += [1] * (len(ids_b) + 1)
+            if max_seq_len and pad_to_max_seq_len:
+                ids += [pad_id] * (max_seq_len - len(ids))
+                types += [0] * (max_seq_len - len(types))
+            all_ids.append(ids)
+            all_types.append(types)
+        if max_seq_len and pad_to_max_seq_len:
+            out = {"input_ids": np.asarray(all_ids, "int64"),
+                   "token_type_ids": np.asarray(all_types, "int64")}
+        else:
+            out = {"input_ids": all_ids, "token_type_ids": all_types}
+        if single:
+            return {k: v[0] for k, v in out.items()}
+        return out

@@ -5,6 +5,7 @@ and elastic tests (test_fleet_elastic_manager.py), plus orbax-style sharded
 save/reshard-on-load which the reference handles via reshard.py.
 """
 import os
+import signal
 import subprocess
 import sys
 import textwrap
@@ -179,3 +180,88 @@ def test_launch_elastic_restart_on_exit_code(tmp_path, monkeypatch):
     code = launch_elastic([sys.executable, str(script)], max_restarts=2)
     assert code == 0
     assert marker.read_text() == "0"
+
+
+def test_kv_server_and_tcp_store(monkeypatch):
+    """Cross-host elastic registry over the HTTP KV server (etcd stand-in;
+    reference fleet/utils/http_server.py + elastic manager.py:103)."""
+    from paddle_tpu.distributed.fleet.elastic.manager import _TcpStore
+    from paddle_tpu.distributed.fleet.utils import KVServer
+
+    with KVServer(0, host="127.0.0.1") as srv:
+        s1 = _TcpStore(f"127.0.0.1:{srv.port}", "job1", ttl=5.0)
+        s2 = _TcpStore(f"127.0.0.1:{srv.port}", "job1", ttl=5.0)
+        s1.register("node_a", "10.0.0.1:8000")
+        s2.register("node_b", "10.0.0.2:8000")
+        assert s1.nodes() == ["node_a", "node_b"]
+        assert s2.endpoints() == ["10.0.0.1:8000", "10.0.0.2:8000"]
+        s1.deregister("node_a")
+        assert s2.nodes() == ["node_b"]
+
+
+def test_elastic_manager_over_tcp(monkeypatch):
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.fleet.utils import KVServer
+
+    with KVServer(0, host="127.0.0.1") as srv:
+        monkeypatch.setenv("PADDLE_ELASTIC_NP", "1")
+        monkeypatch.setenv("PADDLE_ELASTIC_JOB_ID", "tcpjob")
+        monkeypatch.setenv("PADDLE_ELASTIC_SERVER", f"127.0.0.1:{srv.port}")
+        monkeypatch.setenv("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:9999")
+        mgr = ElasticManager()
+        mgr.register()
+        try:
+            assert mgr.wait_for_np(1)
+            assert mgr.endpoints_env() == "127.0.0.1:9999"
+            assert not mgr.changed()
+        finally:
+            mgr.exit()
+        assert mgr.store.nodes() == []
+
+
+def test_preemption_drill_sigkill_relaunches(tmp_path, monkeypatch):
+    """SIGKILL a launched child: the elastic loop must re-register the node
+    and relaunch (reference fault-tolerance + exit-101 restart protocol)."""
+    import subprocess
+    import sys
+    import threading
+    import time
+
+    from paddle_tpu.distributed.fleet.elastic import launch_elastic
+    from paddle_tpu.distributed.fleet.elastic.manager import ElasticManager
+    from paddle_tpu.distributed.fleet.utils import KVServer
+
+    marker = tmp_path / "runs.txt"
+    pidfile = tmp_path / "pid.txt"
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os, sys, time, pathlib\n"
+        f"m = pathlib.Path({str(marker)!r})\n"
+        f"p = pathlib.Path({str(pidfile)!r})\n"
+        "runs = (m.read_text() if m.exists() else '') + 'x'\n"
+        "m.write_text(runs)\n"
+        "p.write_text(str(os.getpid()))\n"
+        "if len(runs) == 1:\n"
+        "    time.sleep(60)  # first run: wait to be preempted (SIGKILL)\n"
+        "sys.exit(0)\n"
+    )
+
+    with KVServer(0, host="127.0.0.1") as srv:
+        monkeypatch.setenv("PADDLE_ELASTIC_NP", "1")
+        monkeypatch.setenv("PADDLE_ELASTIC_JOB_ID", "drill")
+        monkeypatch.setenv("PADDLE_ELASTIC_SERVER", f"127.0.0.1:{srv.port}")
+        monkeypatch.setenv("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:7777")
+
+        def killer():
+            deadline = time.time() + 30
+            while time.time() < deadline and not pidfile.exists():
+                time.sleep(0.1)
+            time.sleep(0.3)
+            os.kill(int(pidfile.read_text()), signal.SIGKILL)
+
+        t = threading.Thread(target=killer, daemon=True)
+        t.start()
+        code = launch_elastic([sys.executable, str(script)], max_restarts=2,
+                              poll_interval=0.2)
+        assert code == 0
+        assert marker.read_text() == "xx"  # ran twice: killed once, relaunched

@@ -340,3 +340,57 @@ class TestFleet:
         assert l0.weight is l1.weight
         n_params = len({id(p) for p in pipe.parameters()})
         assert n_params == 3  # tied weight + two biases
+
+
+class TestReplicatedEagerCollectives:
+    """Eager collectives over a >1 group under the single-controller model:
+    replicated-eager closed forms (reference dygraph metric-reduction idiom
+    `all_reduce(loss); loss /= nranks` must be exact)."""
+
+    def test_eager_all_reduce_closed_forms(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.collective import ReduceOp, all_reduce
+
+        dist.init_mesh({"dp": 8})
+        try:
+            t = paddle.to_tensor([2.0, 3.0])
+            out = all_reduce(t, op=ReduceOp.SUM)
+            np.testing.assert_allclose(np.asarray(out._data), [16.0, 24.0])
+            t2 = paddle.to_tensor([2.0])
+            assert float(all_reduce(t2, op=ReduceOp.MAX)._data[0]) == 2.0
+            from paddle_tpu.distributed.group import get_default_group
+
+            loss = all_reduce(paddle.to_tensor([4.0]))
+            loss = loss / get_default_group().nranks  # the metric idiom
+            np.testing.assert_allclose(np.asarray(loss._data), [4.0])
+        finally:
+            dist.clear_mesh()
+
+    def test_eager_all_gather_and_broadcast(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.collective import all_gather, broadcast
+
+        dist.init_mesh({"dp": 4})
+        try:
+            t = paddle.to_tensor([1.0, 2.0])
+            outs = []
+            all_gather(outs, t)
+            assert len(outs) == 4
+            np.testing.assert_allclose(np.asarray(outs[2]._data), [1.0, 2.0])
+            b = broadcast(paddle.to_tensor([5.0]), src=1)
+            np.testing.assert_allclose(np.asarray(b._data), [5.0])
+        finally:
+            dist.clear_mesh()
+
+    def test_rank_divergent_ops_raise_teachably(self):
+        import pytest as _pytest
+
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.collective import reduce_scatter
+
+        dist.init_mesh({"dp": 4})
+        try:
+            with _pytest.raises(RuntimeError, match="replicated-eager"):
+                reduce_scatter(paddle.to_tensor([1.0, 2.0, 3.0, 4.0]))
+        finally:
+            dist.clear_mesh()
