@@ -26,8 +26,10 @@ __all__ = ["KVServer", "KVClient"]
 
 
 class _Handler(BaseHTTPRequestHandler):
-    store: Dict[str, Dict[str, Tuple[str, float]]] = {}
-    lock = threading.Lock()
+    # `store`/`lock` are set per-server on a subclass (KVServer.__init__) —
+    # a class-level store would cross-contaminate servers in one process
+    store: Dict[str, Dict[str, Tuple[str, float]]]
+    lock: threading.Lock
 
     def log_message(self, *args):  # quiet
         pass
@@ -86,7 +88,9 @@ class KVServer:
     start()/stop()."""
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0"):
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        handler = type("_BoundHandler", (_Handler,),
+                       {"store": {}, "lock": threading.Lock()})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 

@@ -55,23 +55,52 @@ class Model:
         for m in ms:
             assert isinstance(m, Metric), f"metrics must be Metric, got {type(m)}"
         self._metrics = ms
+        # amp_configs parity (reference model.py prepare amp_configs): "O1"/
+        # "O2" string or {"level": ..., custom lists...}
+        self._amp_level = "O0"
+        self._amp_kwargs = {}
+        if amp_configs:
+            if isinstance(amp_configs, str):
+                self._amp_level = amp_configs
+            elif isinstance(amp_configs, dict):
+                cfg = dict(amp_configs)
+                self._amp_level = cfg.pop("level", "O1")
+                self._amp_kwargs = {
+                    k: v for k, v in cfg.items()
+                    if k in ("custom_white_list", "custom_black_list", "dtype")
+                }
 
     # ------------------------------------------------------------------
     def _compute_loss(self, outputs, labels):
         outs = _to_list(outputs)
         lbls = _to_list(labels)
-        if callable(self._loss) and not isinstance(self._loss, (list, tuple)):
-            loss = self._loss(*(outs + lbls))
-        else:
-            raise ValueError("prepare(loss=...) with a callable loss first")
-        return loss
+        if isinstance(self._loss, (list, tuple)):
+            # per-output losses (reference: loss list zipped with outputs),
+            # summed into the optimized scalar
+            losses = [fn(o, l) for fn, o, l in zip(self._loss, outs, lbls)]
+            total = losses[0]
+            for l in losses[1:]:
+                total = total + l
+            return total
+        if callable(self._loss):
+            return self._loss(*(outs + lbls))
+        raise ValueError("prepare(loss=...) with a callable loss first")
+
+    def _forward(self, ins):
+        if getattr(self, "_amp_level", "O0") in ("O1", "O2"):
+            from ..amp.auto_cast import auto_cast
+
+            with auto_cast(enable=True, level=self._amp_level,
+                           **getattr(self, "_amp_kwargs", {})):
+                return self.network(*ins)
+        return self.network(*ins)
 
     def train_batch(self, inputs, labels=None, update=True):
         """One eager train step; returns [loss] (+ metric results)."""
         self.network.train()
         ins = [_to_tensor(x) for x in _to_list(inputs)]
         lbls = [_to_tensor(x) for x in _to_list(labels)]
-        outputs = self.network(*ins)
+        outputs = self._forward(ins)
         loss = self._compute_loss(outputs, lbls)
         loss.backward()
         if update and self._optimizer is not None:
@@ -90,7 +119,7 @@ class Model:
         ins = [_to_tensor(x) for x in _to_list(inputs)]
         lbls = [_to_tensor(x) for x in _to_list(labels)]
         with tape.no_grad():
-            outputs = self.network(*ins)
+            outputs = self._forward(ins)
             loss = self._compute_loss(outputs, lbls) if self._loss else None
         metrics = []
         for m in self._metrics:
@@ -123,11 +152,18 @@ class Model:
             return list(data)
         return data  # any (re-)iterable of batches
 
-    @staticmethod
-    def _split_batch(batch):
+    def _split_batch(self, batch):
         if isinstance(batch, (list, tuple)):
+            # declared specs drive the split (reference: _update_inputs by
+            # the inputs/labels InputSpec counts); default: last = label
+            n_in = len(_to_list(self._inputs)) or None
+            n_lb = len(_to_list(self._labels)) or None
+            if n_in and len(batch) >= n_in:
+                return list(batch[:n_in]), list(batch[n_in:])
+            if n_lb and len(batch) > n_lb:
+                return list(batch[:-n_lb]), list(batch[-n_lb:])
             if len(batch) >= 2:
-                return batch[:-1], batch[-1:]
+                return list(batch[:-1]), list(batch[-1:])
             return list(batch), []  # 1-tuple: unwrap, unlabeled
         return [batch], []
 
@@ -237,10 +273,19 @@ class Model:
 
     # ------------------------------------------------------------------
     def save(self, path, training=True):
-        """model.pdparams (+ .pdopt) like hapi save (model.py:1265)."""
+        """training=True: model.pdparams (+ .pdopt) like hapi save
+        (model.py:1265). training=False: inference export through jit.save
+        (StableHLO program + params — the reference's save_inference_model
+        leg), using the declared ``inputs`` InputSpec."""
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        if not training:
+            from ..jit.save_load import save as jit_save
+
+            spec = _to_list(self._inputs) or None
+            jit_save(self.network, path, input_spec=spec)
+            return
         fio.save(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
             fio.save(self._optimizer.state_dict(), path + ".pdopt")

@@ -374,7 +374,9 @@ def _convert_cached(fn):
     if fn.__closure__:
         for name, cellv in zip(fn.__code__.co_freevars, fn.__closure__):
             try:
-                glb.setdefault(name, cellv.cell_contents)
+                # the freevar SHADOWS any same-named module global, exactly
+                # as in the original function's scope
+                glb[name] = cellv.cell_contents
             except ValueError:
                 pass
     ns = {}

@@ -383,9 +383,25 @@ def repeat_interleave(x, repeats, axis=None):
     return _repeat_interleave(x, unwrap(repeats), axis)
 
 
-def masked_select(x, mask):
-    """Dynamic-shape: eager-only on TPU (executes on host-visible shapes)."""
-    return wrap(unwrap(x)[unwrap(mask)])
+def masked_select(x, mask, size=None, fill_value=0):
+    """Dynamic-shape op, two modes (reference masked_select_op):
+    - ``size=None``: eager-only (host-visible output shape).
+    - ``size=N``: jit-capable static form — the first N selected elements,
+      padded with ``fill_value`` (the TPU-native paradigm; same convention
+      as jnp.nonzero's size argument)."""
+    if size is None:
+        return wrap(unwrap(x)[unwrap(mask)])
+
+    @primitive(name="masked_select")
+    def _ms(x, mask):
+        flat = x.reshape(-1)
+        m = jnp.broadcast_to(mask, x.shape).reshape(-1)
+        (idx,) = jnp.nonzero(m, size=size, fill_value=flat.shape[0])
+        padded = jnp.concatenate(
+            [flat, jnp.full((1,), fill_value, flat.dtype)])
+        return jnp.take(padded, idx)
+
+    return _ms(x, mask)
 
 
 @primitive
@@ -399,23 +415,47 @@ def where(condition, x=None, y=None):
     return _where(condition, x, y)
 
 
-def nonzero(x, as_tuple=False):
-    """Dynamic-shape: eager-only."""
-    arrs = jnp.nonzero(unwrap(x))
+def nonzero(x, as_tuple=False, size=None, fill_value=-1):
+    """Dynamic-shape op; ``size=N`` gives the jit-capable static form
+    (first N coordinates, rows padded with ``fill_value``)."""
+    if size is None:
+        arrs = jnp.nonzero(unwrap(x))
+    else:
+        @primitive(nondiff=True, name="nonzero")
+        def _nz(x):
+            return jnp.nonzero(x, size=size, fill_value=fill_value)
+
+        res = _nz(x)
+        arrs = [unwrap(a) for a in (res if isinstance(res, tuple) else (res,))]
     if as_tuple:
         return tuple(wrap(a[:, None]) for a in arrs)
-    return wrap(jnp.stack(arrs, axis=1))
+    return wrap(jnp.stack([unwrap(a) for a in arrs], axis=1))
 
 
-def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
-    """Dynamic-shape: eager-only."""
-    res = jnp.unique(
-        unwrap(x),
-        return_index=return_index,
-        return_inverse=return_inverse,
-        return_counts=return_counts,
-        axis=axis,
-    )
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, size=None, fill_value=None):
+    """Dynamic-shape op; ``size=N`` gives the jit-capable static form
+    (jnp.unique size/fill_value convention: sorted uniques padded to N)."""
+    if size is None:
+        res = jnp.unique(
+            unwrap(x),
+            return_index=return_index,
+            return_inverse=return_inverse,
+            return_counts=return_counts,
+            axis=axis,
+        )
+    else:
+        @primitive(nondiff=True, name="unique")
+        def _uq(x):
+            return jnp.unique(x, return_index=return_index,
+                              return_inverse=return_inverse,
+                              return_counts=return_counts, axis=axis,
+                              size=size, fill_value=fill_value)
+
+        res = _uq(x)
+        if isinstance(res, tuple):
+            return tuple(wrap(unwrap(r)) for r in res)
+        return wrap(unwrap(res))
     if isinstance(res, tuple):
         return tuple(wrap(r) for r in res)
     return wrap(res)

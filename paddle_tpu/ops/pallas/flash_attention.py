@@ -324,6 +324,8 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
     def _fit(n, cap):
         # largest 128-multiple <= cap dividing n (the kernels have no
         # tail-block masking, so blocks must divide the sequence)
+        if n % 128:
+            raise ValueError(f"flash attention needs T/S % 128 == 0, got {n}")
         b = min(n, cap)
         while n % b:
             b -= 128

@@ -265,3 +265,15 @@ def test_preemption_drill_sigkill_relaunches(tmp_path, monkeypatch):
                               poll_interval=0.2)
         assert code == 0
         assert marker.read_text() == "xx"  # ran twice: killed once, relaunched
+
+
+def test_kv_servers_are_isolated():
+    """Two servers in one process must not share state (regression:
+    class-level store)."""
+    from paddle_tpu.distributed.fleet.utils import KVClient, KVServer
+
+    with KVServer(0, host="127.0.0.1") as a:
+        KVClient(f"127.0.0.1:{a.port}").put("job", "n1", "e1")
+        with KVServer(0, host="127.0.0.1") as b:
+            assert KVClient(f"127.0.0.1:{b.port}").scan("job") == {}
+            assert KVClient(f"127.0.0.1:{a.port}").get("job", "n1") == "e1"
