@@ -130,7 +130,7 @@ def _local_full_attention(q, k, v, causal: bool, scale: float):
     except RuntimeError:
         on_tpu = False
     t, s, dd = q.shape[-2], k.shape[-2], q.shape[-1]
-    if on_tpu and t % 128 == 0 and s % 128 == 0 and dd % 128 == 0 and t >= 512:
+    if on_tpu and t % 128 == 0 and s % 128 == 0 and dd % 64 == 0 and t >= 512:
         from ...ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, sm_scale=scale)

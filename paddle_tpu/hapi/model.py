@@ -77,6 +77,10 @@ class Model:
         if isinstance(self._loss, (list, tuple)):
             # per-output losses (reference: loss list zipped with outputs),
             # summed into the optimized scalar
+            if not (len(self._loss) == len(outs) == len(lbls)):
+                raise ValueError(
+                    f"loss list ({len(self._loss)}) must match outputs "
+                    f"({len(outs)}) and labels ({len(lbls)})")
             losses = [fn(o, l) for fn, o, l in zip(self._loss, outs, lbls)]
             total = losses[0]
             for l in losses[1:]:

@@ -72,7 +72,19 @@ def _symbolic_specs(input_spec: Sequence[InputSpec]):
 
 
 def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
-    """Export ``layer`` (or a StaticFunction) for deployment."""
+    """Export ``layer`` (or a StaticFunction) for deployment.
+
+    ``precision="bfloat16"|"float16"``: inference-optimization pass — float
+    params/buffers are cast before export (weight-precision export: halves
+    parameter memory and load bandwidth; matmuls run in XLA mixed
+    precision). The reference analog is the analysis-pass pipeline's TRT
+    fp16 mode."""
+    precision = config.pop("precision", None)
+    # reference-parity keys accepted as no-ops (XLA owns pruning/combining)
+    for k in ("output_spec", "combine_params", "clip_extra", "skip_forward"):
+        config.pop(k, None)
+    if config:
+        raise TypeError(f"jit.save got unknown options: {sorted(config)}")
     if isinstance(layer, Layer):
         fwd = layer.forward
         sf = fwd if isinstance(fwd, StaticFunction) else StaticFunction(fwd, layer=layer)
@@ -82,6 +94,14 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
             raise ValueError("jit.save of a Layer requires input_spec")
         params = {n: p._data for n, p in layer.named_parameters()}
         buffers = {n: b._data for n, b in layer.named_buffers()}
+        if precision is not None:
+            dt = {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+                  "float16": jnp.float16, "fp16": jnp.float16}.get(precision)
+            if dt is None:
+                raise ValueError(f"unknown export precision {precision!r}")
+            cast = lambda a: a.astype(dt) if jnp.issubdtype(a.dtype, jnp.floating) else a
+            params = {n: cast(a) for n, a in params.items()}
+            buffers = {n: cast(a) for n, a in buffers.items()}
         was_training = layer.training
         layer.eval()
         try:
@@ -103,17 +123,32 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
         blob = exported.serialize()
         param_names = sorted(params)
         buffer_names = sorted(buffers)
+
+        def _store(a):
+            # np.savez writes bf16/fp16-ml_dtypes as raw void: view as u16
+            # and record the dtype for the loader
+            a = np.asarray(a)
+            if a.dtype.kind == "V" or str(a.dtype) in ("bfloat16",):
+                return a.view(np.uint16), str(jnp.asarray(a).dtype)
+            return a, None
+
+        cast_dtypes = {}
+        blobs = {}
+        for prefix, names, src_tree in (("p", param_names, params),
+                                        ("b", buffer_names, buffers)):
+            for n in names:
+                arr, cdt = _store(src_tree[n])
+                blobs[f"{prefix}:{n}"] = arr
+                if cdt:
+                    cast_dtypes[f"{prefix}:{n}"] = cdt
         with open(path + PARAMS_SUFFIX, "wb") as f:
-            np.savez(
-                f,
-                **{f"p:{n}": np.asarray(params[n]) for n in param_names},
-                **{f"b:{n}": np.asarray(buffers[n]) for n in buffer_names},
-            )
+            np.savez(f, **blobs)
         with open(path + MODEL_SUFFIX, "wb") as f:
             f.write(blob)
         meta = {
             "params": param_names,
             "buffers": buffer_names,
+            "cast_dtypes": cast_dtypes,
             "input_shapes": [list(np.asarray(a).shape) for a in arrays],
             "input_dtypes": [str(a.dtype) for a in arrays],
         }
@@ -158,6 +193,16 @@ def load(path: str):
     with open(path + META_SUFFIX) as f:
         meta = json.load(f)
     data = np.load(path + PARAMS_SUFFIX)
-    params = {n: jnp.asarray(data[f"p:{n}"]) for n in meta["params"]}
-    buffers = {n: jnp.asarray(data[f"b:{n}"]) for n in meta["buffers"]}
+    cast = meta.get("cast_dtypes", {})
+
+    def _restore(key):
+        arr = data[key]
+        if key in cast:
+            import ml_dtypes
+
+            return jnp.asarray(arr.view(getattr(ml_dtypes, cast[key])))
+        return jnp.asarray(arr)
+
+    params = {n: _restore(f"p:{n}") for n in meta["params"]}
+    buffers = {n: _restore(f"b:{n}") for n in meta["buffers"]}
     return TranslatedLayer(exported, params, buffers)

@@ -453,9 +453,6 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False,
                               size=size, fill_value=fill_value)
 
         res = _uq(x)
-        if isinstance(res, tuple):
-            return tuple(wrap(unwrap(r)) for r in res)
-        return wrap(unwrap(res))
     if isinstance(res, tuple):
         return tuple(wrap(r) for r in res)
     return wrap(res)

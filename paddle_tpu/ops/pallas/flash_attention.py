@@ -8,10 +8,11 @@ working set stays in VMEM (O(T) memory), with the backward pass
 recomputing probabilities blockwise from the saved logsumexp — the
 standard flash-attention-2 decomposition, laid out for the 128x128 MXU.
 
-Layout: q,k,v are [B, H, T, D] with D a multiple of 128 (the MXU lane
-width); callers with other head dims use the XLA einsum path in
-nn/functional_attention.py. Block sizes default to 128 rows of q / 128
-rows of k per grid step; the grid's innermost dimension walks k blocks so
+Layout: q,k,v are [B, H, T, D] with D a multiple of 64 (D=64 measured
+faster than the XLA path on v5e with whole-sequence blocks; D=128 fills
+the MXU lanes exactly); other head dims use the XLA einsum path in
+nn/functional_attention.py. Default blocks come from the measured policy
+in flash_attention(); the grid's innermost dimension walks k blocks so
 the VMEM accumulator/max/denominator scratch persists across the online
 softmax sweep (TPU grids execute sequentially).
 """

@@ -74,22 +74,47 @@ def sequence_unpad(x, length, name=None):
     return _unpad(x)
 
 
-def sequence_expand(x, y_lengths, ref_level=0, name=None):
-    """Repeat each row of x per the reference lengths (sequence_expand op's
-    common rank-0 use: x row i appears y_lengths[i] times)."""
+def sequence_expand(x, y_lengths, ref_level=0, name=None, x_lengths=None):
+    """sequence_expand op in the dense+lengths redesign.
+
+    Two forms (reference sequence_expand_op.cc semantics):
+    - row form (no ``x_lengths``): x row i repeats ``y_lengths[i]`` times —
+      the rank-0/LoD-level-1 case.
+    - nested form (``x_lengths`` given): x's flat rows are partitioned into
+      sequences by ``x_lengths``; SEQUENCE i (its whole row block) repeats
+      ``y_lengths[i]`` times — the reference's 2-level-LoD expansion where
+      ``ref_level`` indexes y's outer level (the dense redesign carries
+      that level's counts directly in ``y_lengths``).
+    """
     if ref_level not in (0, -1):
         raise NotImplementedError(
-            "sequence_expand supports the rank-0 repeat form "
-            "(ref_level 0 or -1); nested-LoD expansion has no dense analog")
+            "ref_level beyond the outer level: pass that level's counts as "
+            "y_lengths directly (dense+lengths redesign)")
     lens = np.asarray(unwrap(y_lengths)).astype(np.int64)
-    if len(lens) != unwrap(x).shape[0]:
-        raise ValueError(
-            f"y_lengths has {len(lens)} entries but x has "
-            f"{unwrap(x).shape[0]} rows; each row needs a repeat count")
+    if x_lengths is None:
+        if len(lens) != unwrap(x).shape[0]:
+            raise ValueError(
+                f"y_lengths has {len(lens)} entries but x has "
+                f"{unwrap(x).shape[0]} rows; each row needs a repeat count")
+        idx = np.repeat(np.arange(len(lens)), lens)
+    else:
+        xl = np.asarray(unwrap(x_lengths)).astype(np.int64)
+        if len(lens) != len(xl):
+            raise ValueError(
+                f"y_lengths has {len(lens)} entries but x_lengths defines "
+                f"{len(xl)} sequences")
+        offs = np.concatenate([[0], np.cumsum(xl)])
+        if offs[-1] != unwrap(x).shape[0]:
+            raise ValueError(
+                f"x_lengths sums to {offs[-1]} but x has "
+                f"{unwrap(x).shape[0]} rows")
+        parts = [np.tile(np.arange(offs[i], offs[i + 1]), int(r))
+                 for i, r in enumerate(lens)]
+        idx = (np.concatenate(parts) if parts
+               else np.zeros((0,), np.int64)).astype(np.int64)
 
     @primitive
     def _exp(x):
-        idx = np.repeat(np.arange(len(lens)), lens)
         return x[jnp.asarray(idx)]
 
     return _exp(x)

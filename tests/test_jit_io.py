@@ -213,3 +213,28 @@ class TestDataLoader:
         i1 = [i for b in s1 for i in b]
         assert len(i0) == len(i1) == 5
         assert set(i0) | set(i1) == set(range(10))
+
+
+def test_jit_save_bf16_precision_export(tmp_path):
+    """Inference-optimization pass: precision='bfloat16' exports a bf16
+    program (reference TRT fp16-mode analog)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit.input_spec import InputSpec
+    from paddle_tpu.jit.save_load import load as jit_load
+    from paddle_tpu.jit.save_load import save as jit_save
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    p = str(tmp_path / "m")
+    jit_save(net, p, input_spec=[InputSpec([None, 8], "float32", "x")],
+             precision="bfloat16")
+    loaded = jit_load(p)
+    # params restored as bf16
+    lp = next(iter(loaded._loaded_params.values()))
+    assert lp._data.dtype == jnp.bfloat16
+    x = np.random.default_rng(0).normal(size=(3, 8)).astype("float32")
+    want = np.asarray(net(paddle.to_tensor(x))._data)
+    got = np.asarray(jnp.asarray(loaded(paddle.to_tensor(x))._data, jnp.float32))
+    np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)  # bf16 tol

@@ -312,3 +312,22 @@ class TestBertTokenizer:
         assert ids[1][-1] == 0  # padded
         pair = tok("the cat", text_pair="sat", max_seq_len=8)
         assert pair["token_type_ids"].count(1) == 2  # sat + [SEP]
+
+
+def test_sequence_expand_nested():
+    """2-level-LoD expansion in the dense+lengths redesign: whole sequences
+    repeat (reference sequence_expand_op.cc ref_level semantics)."""
+    from paddle_tpu.ops.sequence import sequence_expand
+
+    # x: two sequences [a, b] (len 2) and [c] (len 1)
+    x = paddle.to_tensor(np.asarray([[1.0], [2.0], [3.0]], "float32"))
+    out = sequence_expand(x, y_lengths=[2, 3], x_lengths=[2, 1])
+    np.testing.assert_allclose(
+        np.asarray(out._data).ravel(),
+        [1, 2, 1, 2, 3, 3, 3])  # seq0 x2, seq1 x3
+    # differentiable: grads accumulate per source row
+    x2 = paddle.to_tensor(np.asarray([[1.0], [2.0], [3.0]], "float32"),
+                          stop_gradient=False)
+    out = sequence_expand(x2, y_lengths=[2, 3], x_lengths=[2, 1])
+    out.sum().backward()
+    np.testing.assert_allclose(np.asarray(x2.grad._data).ravel(), [2, 2, 3])
