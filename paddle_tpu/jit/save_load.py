@@ -78,7 +78,13 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
     params/buffers are cast before export (weight-precision export: halves
     parameter memory and load bandwidth; matmuls run in XLA mixed
     precision). The reference analog is the analysis-pass pipeline's TRT
-    fp16 mode."""
+    fp16 mode.
+
+    ``precision="int8"``: weight-only PTQ for the ARTIFACT (reference
+    post-training quantization role): float matrix params are stored as
+    per-output-channel symmetric int8 + scales (4x smaller file) and
+    dequantized to float at load — the program itself still runs at its
+    traced float precision."""
     precision = config.pop("precision", None)
     # reference-parity keys accepted as no-ops (XLA owns pruning/combining)
     for k in ("output_spec", "combine_params", "clip_extra", "skip_forward"):
@@ -94,7 +100,8 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
             raise ValueError("jit.save of a Layer requires input_spec")
         params = {n: p._data for n, p in layer.named_parameters()}
         buffers = {n: b._data for n, b in layer.named_buffers()}
-        if precision is not None:
+        int8_weights = precision == "int8"
+        if precision is not None and not int8_weights:
             dt = {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
                   "float16": jnp.float16, "fp16": jnp.float16}.get(precision)
             if dt is None:
@@ -133,14 +140,31 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
             return a, None
 
         cast_dtypes = {}
+        int8_scales = {}
         blobs = {}
         for prefix, names, src_tree in (("p", param_names, params),
                                         ("b", buffer_names, buffers)):
             for n in names:
-                arr, cdt = _store(src_tree[n])
-                blobs[f"{prefix}:{n}"] = arr
+                key = f"{prefix}:{n}"
+                a = np.asarray(src_tree[n])
+                if (int8_weights and prefix == "p" and a.ndim >= 2
+                        and a.dtype in (np.float32, np.float64)):
+                    # per-output-channel symmetric int8 (reference
+                    # abs-max weight quantization, fake_quantize_op family)
+                    amax = np.abs(a).max(axis=tuple(range(a.ndim - 1)),
+                                         keepdims=True)
+                    scale = np.maximum(amax, 1e-8) / 127.0
+                    q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
+                    blobs[key] = q
+                    int8_scales[key] = [scale.squeeze().tolist(),
+                                        str(a.dtype)]
+                    continue
+                arr, cdt = _store(a)
+                blobs[key] = arr
                 if cdt:
-                    cast_dtypes[f"{prefix}:{n}"] = cdt
+                    cast_dtypes[key] = cdt
+        for key, (scale, _) in int8_scales.items():
+            blobs[f"s:{key}"] = np.asarray(scale, np.float32)
         with open(path + PARAMS_SUFFIX, "wb") as f:
             np.savez(f, **blobs)
         with open(path + MODEL_SUFFIX, "wb") as f:
@@ -149,6 +173,7 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
             "params": param_names,
             "buffers": buffer_names,
             "cast_dtypes": cast_dtypes,
+            "int8_scales": {k: v[1] for k, v in int8_scales.items()},
             "input_shapes": [list(np.asarray(a).shape) for a in arrays],
             "input_dtypes": [str(a.dtype) for a in arrays],
         }
@@ -194,9 +219,14 @@ def load(path: str):
         meta = json.load(f)
     data = np.load(path + PARAMS_SUFFIX)
     cast = meta.get("cast_dtypes", {})
+    int8 = meta.get("int8_scales", {})
 
     def _restore(key):
         arr = data[key]
+        if key in int8:
+            scale = np.asarray(data[f"s:{key}"], np.float32)
+            scale = scale.reshape((1,) * (arr.ndim - 1) + (-1,))
+            return jnp.asarray((arr.astype(np.float32) * scale).astype(int8[key]))
         if key in cast:
             import ml_dtypes
 

@@ -1604,3 +1604,222 @@ def softmax_(x, axis=-1):
 # paddle.nn.functional re-exports of tensor ops sharing one implementation
 from ..ops.manipulation import pad  # noqa: E402,F401
 from ..ops.math import tanh_  # noqa: E402,F401
+
+
+# ---------------------------------------------------------------------------
+# round-2 gap fill: vision rearrange + loss family completion (reference
+# functional surface: pixel_unshuffle/channel_shuffle/fold + margin losses)
+# ---------------------------------------------------------------------------
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    @primitive(name="pixel_unshuffle")
+    def _op(x):
+        if data_format == "NCHW":
+            n, c, h, w = x.shape
+            x2 = x.reshape(n, c, h // r, r, w // r, r)
+            return x2.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = x.shape
+        x2 = x.reshape(n, h // r, r, w // r, r, c)
+        return x2.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // r, w // r, c * r * r)
+
+    return _op(x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    g = int(groups)
+
+    @primitive(name="channel_shuffle")
+    def _op(x):
+        if data_format == "NCHW":
+            n, c, h, w = x.shape
+            return x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = x.shape
+        return x.reshape(n, h, w, g, c // g).transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+    return _op(x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    l, r, t, b = (padding if isinstance(padding, (list, tuple))
+                  else (padding,) * 4)
+
+    @primitive(name="zeropad2d")
+    def _op(x):
+        if data_format == "NCHW":
+            return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r)))
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))
+
+    return _op(x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im (reference fold op): inverse of unfold with overlap-add."""
+    pair = lambda v: tuple(v) if isinstance(v, (list, tuple)) else (int(v),) * 2
+    H, W = pair(output_sizes)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    ph, pw = pair(paddings)
+    dh, dw = pair(dilations)
+    oh = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    @primitive(name="fold")
+    def _op(x):
+        n, ckk, l = x.shape
+        c = ckk // (kh * kw)
+        cols = x.reshape(n, c, kh, kw, oh, ow)
+        out = jnp.zeros((n, c, H + 2 * ph, W + 2 * pw), x.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :, i * dh: i * dh + sh * oh: sh,
+                             j * dw: j * dw + sw * ow: sw].add(cols[:, :, i, j])
+        return out[:, :, ph: ph + H, pw: pw + W]
+
+    return _op(x)
+
+
+def _reduce_loss_t(loss, reduction):
+    """Tensor-level reduction (taped ops; _reduce_loss works on raw arrays
+    inside primitive closures)."""
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    @primitive(name="soft_margin_loss")
+    def _op(x, y):
+        # softplus(-yx) == log1p(exp(-yx)), overflow-stable at large logits
+        return jax.nn.softplus(-y.astype(x.dtype) * x)
+
+    return _reduce_loss_t(_op(input, unwrap(label)), reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,  # noqa: A002
+                      reduction="mean", name=None):
+    @primitive(name="multi_margin_loss")
+    def _op(x, y):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), axis=1)
+        m = jnp.maximum(0.0, margin - correct + x) ** p
+        if weight is not None:
+            m = m * jnp.take(unwrap(weight), y.astype(jnp.int32))[:, None]
+        mask = jnp.arange(c)[None, :] != y[:, None]
+        return jnp.where(mask, m, 0.0).sum(-1) / c
+
+    return _reduce_loss_t(_op(input, unwrap(label)), reduction)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    @primitive(name="pairwise_distance")
+    def _op(x, y):
+        d = x - y + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+    return _op(x, y)
+
+
+def pdist(x, p=2.0, name=None):
+    @primitive(name="pdist")
+    def _op(x):
+        n = x.shape[0]
+        d = jnp.linalg.norm(x[:, None, :] - x[None, :, :] + 0.0, ord=p, axis=-1)
+        iu = jnp.triu_indices(n, k=1)
+        return d[iu]
+
+    return _op(x)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A002
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    dp = pairwise_distance(input, positive, p, epsilon)
+    dn = pairwise_distance(input, negative, p, epsilon)
+    if swap:
+        dn2 = pairwise_distance(positive, negative, p, epsilon)
+        from ..ops import math as M
+
+        dn = M.minimum(dn, dn2)
+
+    @primitive(name="triplet_margin_loss")
+    def _op(dp, dn):
+        return jnp.maximum(dp - dn + margin, 0.0)
+
+    return _reduce_loss_t(_op(dp, dn), reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    @primitive(name="cosine_embedding_loss")
+    def _op(a, b, y):
+        cos = (a * b).sum(-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        return jnp.where(y.astype(jnp.int32) == 1, 1.0 - cos,
+                         jnp.maximum(0.0, cos - margin))
+
+    return _reduce_loss_t(_op(input1, input2, unwrap(label)), reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,  # noqa: A002
+                      reduction="mean", name=None):
+    @primitive(name="gaussian_nll_loss")
+    def _op(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2.0 * jnp.pi, mu.dtype))
+        return loss
+
+    return _reduce_loss_t(_op(input, unwrap(label), unwrap(variance)), reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,  # noqa: A002
+                     epsilon=1e-8, reduction="mean", name=None):
+    @primitive(name="poisson_nll_loss")
+    def _op(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(jnp.maximum(x, epsilon))
+        if full:
+            # Stirling approximation for target! (reference semantics)
+            stir = y * jnp.log(jnp.maximum(y, 1.0)) - y + 0.5 * jnp.log(
+                jnp.maximum(2.0 * jnp.pi * y, 1.0))
+            loss = loss + jnp.where(y > 1, stir, 0.0)
+        return loss
+
+    return _reduce_loss_t(_op(input, unwrap(label)), reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,  # noqa: A002
+                                 reduction="mean", name=None):
+    @primitive(name="multi_label_soft_margin_loss")
+    def _op(x, y):
+        loss = y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x)
+        if weight is not None:
+            loss = loss * unwrap(weight)
+        return -loss.mean(-1)
+
+    return _reduce_loss_t(_op(input, unwrap(label)), reduction)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    if not training:
+        @primitive(name="rrelu")
+        def _op(x):
+            neg = (lower + upper) / 2.0
+            return jnp.where(x >= 0, x, x * neg)
+
+        return _op(x)
+    arr = unwrap(x)
+    slope = jax.random.uniform(split_key(), arr.shape, jnp.float32,
+                               lower, upper).astype(arr.dtype)
+
+    @primitive(name="rrelu_train")
+    def _op(x):
+        return jnp.where(x >= 0, x, x * slope)
+
+    return _op(x)

@@ -6,6 +6,7 @@ from .. import initializer as init_mod
 from ..layer import Layer
 
 __all__ = [
+    "RReLU",
     "ReLU", "ReLU6", "LeakyReLU", "PReLU", "ELU", "SELU", "CELU", "GELU",
     "Sigmoid", "LogSigmoid", "Tanh", "Tanhshrink", "Hardshrink", "Softshrink",
     "Hardsigmoid", "Hardswish", "Hardtanh", "Softplus", "Softsign", "Swish",
@@ -189,3 +190,12 @@ class ThresholdedReLU(Layer):
             return jnp.where(x > thr, x, 0.0)
 
         return _tr(x)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

@@ -103,6 +103,10 @@ _METHODS = {
     "min": math.min, "amax": math.amax, "amin": math.amin, "std": math.std,
     "var": math.var, "median": math.median, "quantile": math.quantile,
     "nanmean": math.nanmean, "nansum": math.nansum, "logsumexp": math.logsumexp,
+    "nanmedian": math.nanmedian, "trapezoid": math.trapezoid,
+    "take": math.take, "polar": math.polar,
+    "bitwise_left_shift": math.bitwise_left_shift,
+    "bitwise_right_shift": math.bitwise_right_shift,
     "all": math.all, "any": math.any, "numel": math.numel,
     "count_nonzero": math.count_nonzero,
     "cumsum": math.cumsum, "cumprod": math.cumprod, "diff": math.diff,
@@ -123,6 +127,8 @@ _METHODS = {
     "cast": cast, "pad": pad, "tril": creation.tril, "triu": creation.triu,
     "take_along_axis": take_along_axis, "put_along_axis": put_along_axis,
     "repeat_interleave": repeat_interleave, "moveaxis": moveaxis,
+    "index_fill": index_fill, "tril_indices": tril_indices,
+    "triu_indices": triu_indices, "view": view, "view_as": view_as,
     "masked_fill": search.masked_fill,
     # linalg
     "matmul": linalg.matmul, "bmm": linalg.bmm, "dot": linalg.dot,

@@ -672,3 +672,52 @@ def rank(x, name=None):
     import numpy as _np
 
     return wrap(jnp.asarray(_np.int32(len(unwrap(x).shape))))
+
+
+@primitive
+def _index_fill(x, index, axis, value):
+    idx = [builtins.slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(value)
+
+
+def index_fill(x, index, axis, value, name=None):
+    """index_fill op: rows at ``index`` along ``axis`` set to ``value``."""
+    return _index_fill(x, unwrap(index), int(axis), value)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, k=offset, m=col)
+    return wrap(jnp.asarray(np.stack([r, c]).astype(np.int64)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, k=offset, m=col)
+    return wrap(jnp.asarray(np.stack([r, c]).astype(np.int64)))
+
+
+def view(x, shape_or_dtype, name=None):
+    """paddle.view: zero-copy reshape/dtype reinterpret (XLA owns layout; a
+    reshape/bitcast is already copy-free under jit)."""
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    from ..dtype import to_jax_dtype
+
+    @primitive(name="view_dtype")
+    def _bitcast(x):
+        dt = to_jax_dtype(shape_or_dtype)
+        out = jax.lax.bitcast_convert_type(x, dt)
+        if out.ndim == x.ndim + 1:
+            # narrower dtype: fold the per-element axis into the last dim
+            out = out.reshape(out.shape[:-2] + (-1,))
+        elif out.ndim == x.ndim - 1:
+            pass  # widening view merged the last dim already
+        return out
+
+    return _bitcast(x)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, tuple(unwrap(other).shape))

@@ -520,3 +520,72 @@ def tanh_(x, name=None):
     inplace_guard(x, "tanh_")
     x._set_data(jnp.tanh(x._data))
     return x
+
+
+@primitive
+def _nanmedian(x, axis, keepdim):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return _nanmedian(x, axis, keepdim)
+
+
+@primitive
+def _trapezoid(y, x, dx, axis):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=dx, axis=axis)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """trapezoid op (numerical integration; reference paddle.trapezoid)."""
+    return _trapezoid(y, x, 1.0 if dx is None else float(dx), axis)
+
+
+@primitive
+def _take(x, index, mode):
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = index % n
+    elif mode == "clip":
+        idx = jnp.clip(index, 0, n - 1)
+    else:  # 'raise' semantics: jit can't raise; negatives count from the end
+        idx = jnp.clip(index, -n, n - 1)
+        idx = jnp.where(idx < 0, idx + n, idx)
+    return jnp.take(flat, idx, mode="wrap" if mode == "wrap" else "clip")
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index take (reference paddle.take)."""
+    return _take(x, unwrap(index), mode)
+
+
+def polar(abs, angle, name=None):  # noqa: A002
+    """polar op: complex from magnitude+angle."""
+    @primitive(name="polar")
+    def _polar(r, t):
+        return r * jnp.exp(1j * t.astype(jnp.result_type(t, jnp.complex64)))
+
+    return _polar(abs, angle)
+
+
+@primitive(nondiff=True)
+def _shift(x, y, direction, logical):
+    if direction == "left":
+        return jnp.left_shift(x, y)
+    if logical and jnp.issubdtype(x.dtype, jnp.signedinteger):
+        # logical shift: operate on the raw bit pattern (reference
+        # is_arithmetic=False semantics)
+        u = x.astype(jnp.dtype(f"uint{x.dtype.itemsize * 8}"))
+        return jnp.right_shift(u, y.astype(u.dtype)).astype(x.dtype)
+    return jnp.right_shift(x, y)
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    return _shift(x, unwrap(y), "left", not is_arithmetic)
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    return _shift(x, unwrap(y), "right", not is_arithmetic)

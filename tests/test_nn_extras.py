@@ -345,3 +345,76 @@ class TestBeamSearch:
         ids, scores = nn.dynamic_decode(dec, states, max_step_num=5)
         best = _np(ids)[0, :, 0].tolist()
         assert best[0] == 3  # beam search picked B despite lower step-1 score
+
+
+class TestRound2GapFill:
+    """Round-2 functional-surface completion: rearrange ops, fold/col2im,
+    margin/NLL loss family, pdist, rrelu, and the new tensor ops."""
+
+    def test_fold_inverts_unfold(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(2, 3, 8, 8)).astype("float32"))
+        u = F.unfold(x, 4, strides=4)
+        back = F.fold(u, 8, 4, strides=4)
+        np.testing.assert_allclose(np.asarray(back._data),
+                                   np.asarray(x._data), rtol=1e-6)
+
+    def test_pixel_unshuffle_roundtrip(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(np.random.default_rng(1).normal(
+            size=(2, 4, 6, 6)).astype("float32"))
+        y = F.pixel_unshuffle(x, 2)
+        assert list(y.shape) == [2, 16, 3, 3]
+        z = F.pixel_shuffle(y, 2)
+        np.testing.assert_allclose(np.asarray(z._data), np.asarray(x._data),
+                                   rtol=1e-6)
+
+    def test_loss_family_matches_manual(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(2)
+        a = rng.normal(size=(5,)).astype("float32")
+        y = np.asarray([1, -1, 1, -1, 1], "float32")
+        got = float(F.soft_margin_loss(paddle.to_tensor(a),
+                                       paddle.to_tensor(y))._data)
+        want = np.log1p(np.exp(-y * a)).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+        x1 = rng.normal(size=(4, 8)).astype("float32")
+        x2 = rng.normal(size=(4, 8)).astype("float32")
+        lab = np.asarray([1, -1, 1, -1], "float32")
+        got = float(F.cosine_embedding_loss(
+            paddle.to_tensor(x1), paddle.to_tensor(x2),
+            paddle.to_tensor(lab), margin=0.1)._data)
+        cos = (x1 * x2).sum(-1) / (np.linalg.norm(x1, axis=-1)
+                                   * np.linalg.norm(x2, axis=-1))
+        want = np.where(lab == 1, 1 - cos, np.maximum(0, cos - 0.1)).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_pdist_matches_scipy_style(self):
+        import paddle_tpu.nn.functional as F
+
+        x = np.random.default_rng(3).normal(size=(5, 4)).astype("float32")
+        got = np.asarray(F.pdist(paddle.to_tensor(x))._data)
+        want = []
+        for i in range(5):
+            for j in range(i + 1, 5):
+                want.append(np.linalg.norm(x[i] - x[j]))
+        np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5)
+
+    def test_new_tensor_ops(self):
+        x = paddle.to_tensor(np.asarray([[4.0, np.nan], [2.0, 8.0]]))
+        np.testing.assert_allclose(float(paddle.nanmedian(x)._data), 4.0)
+        t = paddle.take(paddle.to_tensor(np.arange(6.0).reshape(2, 3)),
+                        paddle.to_tensor(np.asarray([5, 0])))
+        np.testing.assert_allclose(np.asarray(t._data), [5.0, 0.0])
+        p = paddle.polar(paddle.to_tensor(np.asarray([1.0, 2.0])),
+                         paddle.to_tensor(np.asarray([0.0, np.pi / 2])))
+        np.testing.assert_allclose(np.asarray(p._data).real, [1.0, 0.0],
+                                   atol=1e-6)
+        s = paddle.bitwise_left_shift(
+            paddle.to_tensor(np.asarray([1, 2], "int32")), 2)
+        np.testing.assert_array_equal(np.asarray(s._data), [4, 8])

@@ -351,3 +351,39 @@ class TestPipelineDropout:
         x, y = _data(8)
         losses = [float(step(x, y)) for _ in range(10)]
         assert losses[-1] < losses[0] * 0.9, losses
+
+
+class TestPipelineCheckpoint:
+    def test_hybrid_state_checkpoint_resume(self, tmp_path):
+        """CheckpointManager round-trips the hybrid step's sharded state
+        (stacked stage params on 'pp'/'mp', ZeRO slot slices on 'sharding')
+        and training resumes bit-exactly (reference auto-checkpoint +
+        sharded save: SURVEY 5.4)."""
+        from paddle_tpu.framework.checkpoint import CheckpointManager
+
+        dist.init_mesh({"pp": 2, "mp": 2, "sharding": 2})
+        paddle.seed(0)
+        model = GPTForPretraining(tiny_cfg())
+        opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
+        step = build_gpt_pipeline_step(model, opt, microbatches=2)
+        x, y = _data(8)
+        for _ in range(3):
+            step(x, y)
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(3, step.state)
+        # keep training the original for a reference trajectory
+        ref_losses = [float(step(x, y)) for _ in range(3)]
+
+        # fresh process-equivalent: new model/opt/step, restore, resume
+        paddle.seed(0)
+        model2 = GPTForPretraining(tiny_cfg())
+        opt2 = AdamW(learning_rate=1e-3, parameters=model2.parameters())
+        step2 = build_gpt_pipeline_step(model2, opt2, microbatches=2)
+        restored, _meta = mgr.load(step=3)
+        step2.state["params"] = restored["params"]
+        step2.state["opt"] = restored["opt"]
+        paddle.seed(1234)  # dropout disabled: keys don't matter, but align
+        got_losses = [float(step2(x, y)) for _ in range(3)]
+        np.testing.assert_allclose(got_losses, ref_losses, rtol=1e-6)
+        dist.clear_mesh()
