@@ -149,15 +149,17 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
                 a = np.asarray(src_tree[n])
                 if (int8_weights and prefix == "p" and a.ndim >= 2
                         and a.dtype in (np.float32, np.float64)):
-                    # per-output-channel symmetric int8 (reference
-                    # abs-max weight quantization, fake_quantize_op family)
-                    amax = np.abs(a).max(axis=tuple(range(a.ndim - 1)),
-                                         keepdims=True)
+                    # per-output-channel symmetric int8 (reference abs-max
+                    # weight quantization): the output axis is LAST for 2-D
+                    # Linear (in, out) and FIRST for conv (cout, cin, kh, kw)
+                    ch_axis = a.ndim - 1 if a.ndim == 2 else 0
+                    red = tuple(i for i in range(a.ndim) if i != ch_axis)
+                    amax = np.abs(a).max(axis=red, keepdims=True)
                     scale = np.maximum(amax, 1e-8) / 127.0
                     q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
                     blobs[key] = q
                     int8_scales[key] = [scale.squeeze().tolist(),
-                                        str(a.dtype)]
+                                        str(a.dtype), ch_axis]
                     continue
                 arr, cdt = _store(a)
                 blobs[key] = arr
@@ -173,7 +175,7 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
             "params": param_names,
             "buffers": buffer_names,
             "cast_dtypes": cast_dtypes,
-            "int8_scales": {k: v[1] for k, v in int8_scales.items()},
+            "int8_scales": {k: [v[1], v[2]] for k, v in int8_scales.items()},
             "input_shapes": [list(np.asarray(a).shape) for a in arrays],
             "input_dtypes": [str(a.dtype) for a in arrays],
         }
@@ -224,9 +226,12 @@ def load(path: str):
     def _restore(key):
         arr = data[key]
         if key in int8:
+            dtype, ch_axis = int8[key]
             scale = np.asarray(data[f"s:{key}"], np.float32)
-            scale = scale.reshape((1,) * (arr.ndim - 1) + (-1,))
-            return jnp.asarray((arr.astype(np.float32) * scale).astype(int8[key]))
+            shape = [1] * arr.ndim
+            shape[ch_axis] = -1
+            scale = scale.reshape(shape)
+            return jnp.asarray((arr.astype(np.float32) * scale).astype(dtype))
         if key in cast:
             import ml_dtypes
 

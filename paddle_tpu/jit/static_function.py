@@ -139,11 +139,23 @@ class StaticFunction:
             dparams, *dxs = vjp_fn(tuple(cots))
             return tuple(dparams[n] for n in diff_names) + tuple(dxs)
 
+        n_params = len(diff_names)
+
+        def pure_positional(*arrs):
+            """Re-differentiable form for create_graph: the same jitted pure
+            call over positional (param..., x...) arrays (double grad
+            re-enters jax.vjp of this)."""
+            dp = {n: a for n, a in zip(diff_names, arrs[:n_params])}
+            return diff_fn(dp, *arrs[n_params:])
+
         node = tape.Node(
             tape_vjp,
             input_tensors,
             [(a.shape, a.dtype) for a in out_arrays],
             name=f"jit:{getattr(self._fn, '__name__', 'fn')}",
+            pure_fn=pure_positional,
+            has_aux=True,  # diff_fn returns (out_arrays, new_buffers)
+            tuple_out=True,
         )
         outs = []
         for pos, a in enumerate(out_arrays):

@@ -1724,12 +1724,17 @@ def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
 
 
 def pdist(x, p=2.0, name=None):
+    n = unwrap(x).shape[0]
+    import numpy as _np
+
+    i_idx, j_idx = _np.triu_indices(n, k=1)
+
     @primitive(name="pdist")
     def _op(x):
-        n = x.shape[0]
-        d = jnp.linalg.norm(x[:, None, :] - x[None, :, :] + 0.0, ord=p, axis=-1)
-        iu = jnp.triu_indices(n, k=1)
-        return d[iu]
+        # gather the distinct pairs FIRST: norms at exactly zero have NaN
+        # vjp, and the diagonal would poison gradients even when discarded
+        d = x[jnp.asarray(i_idx)] - x[jnp.asarray(j_idx)] + 1e-6
+        return jnp.linalg.norm(d, ord=p, axis=-1)
 
     return _op(x)
 

@@ -127,8 +127,7 @@ _METHODS = {
     "cast": cast, "pad": pad, "tril": creation.tril, "triu": creation.triu,
     "take_along_axis": take_along_axis, "put_along_axis": put_along_axis,
     "repeat_interleave": repeat_interleave, "moveaxis": moveaxis,
-    "index_fill": index_fill, "tril_indices": tril_indices,
-    "triu_indices": triu_indices, "view": view, "view_as": view_as,
+    "index_fill": index_fill, "view": view, "view_as": view_as,
     "masked_fill": search.masked_fill,
     # linalg
     "matmul": linalg.matmul, "bmm": linalg.bmm, "dot": linalg.dot,
@@ -167,6 +166,10 @@ _METHODS["scatter_"] = manipulation.scatter_
 _METHODS["tanh_"] = math.tanh_
 _METHODS["tolist"] = manipulation.tolist
 del _METHODS["zero_"]  # defined directly on Tensor
+
+# module-level functions that are NOT Tensor methods (their first arg is a
+# shape int, not a tensor)
+FREE_FUNCTIONS = {"tril_indices": tril_indices, "triu_indices": triu_indices}
 
 for _name, _fn in _METHODS.items():
     if _fn is not None:

@@ -707,13 +707,22 @@ def view(x, shape_or_dtype, name=None):
 
     @primitive(name="view_dtype")
     def _bitcast(x):
-        dt = to_jax_dtype(shape_or_dtype)
+        dt = jnp.dtype(to_jax_dtype(shape_or_dtype))
+        src_size = jnp.dtype(x.dtype).itemsize
+        if dt.itemsize > src_size:
+            # widening: group the last dim by the width ratio, bitcast
+            # removes the group axis -> (..., last // ratio)
+            ratio = dt.itemsize // src_size
+            if x.shape[-1] % ratio:
+                raise ValueError(
+                    f"cannot view last dim {x.shape[-1]} as {dt} "
+                    f"(needs a multiple of {ratio})")
+            grouped = x.reshape(x.shape[:-1] + (x.shape[-1] // ratio, ratio))
+            return jax.lax.bitcast_convert_type(grouped, dt)
         out = jax.lax.bitcast_convert_type(x, dt)
         if out.ndim == x.ndim + 1:
             # narrower dtype: fold the per-element axis into the last dim
             out = out.reshape(out.shape[:-2] + (-1,))
-        elif out.ndim == x.ndim - 1:
-            pass  # widening view merged the last dim already
         return out
 
     return _bitcast(x)

@@ -148,3 +148,21 @@ class TestConversionEdgeCases:
                                   fromlist=["UNDEFINED"]).UNDEFINED,))
         with pytest.raises(UnboundLocalError, match="untaken branch"):
             out[0] + 1
+
+
+class TestDoubleGradThroughJit:
+    def test_create_graph_through_to_static(self):
+        """paddle.grad(create_graph=True) across a @to_static boundary
+        (reference: double grad through a converted ProgramTranslator fn)."""
+        from paddle_tpu.autograd import tape
+
+        @to_static
+        def f(x):
+            return (x * x * x).sum()
+
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = f(x)
+        (g1,) = tape.grad(y, [x], create_graph=True)
+        np.testing.assert_allclose(np.asarray(g1._data), [3.0, 12.0])
+        (g2,) = tape.grad(g1.sum(), [x])
+        np.testing.assert_allclose(np.asarray(g2._data), [6.0, 12.0])
