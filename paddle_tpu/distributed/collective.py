@@ -141,6 +141,8 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis: int 
     # replicated-eager: every virtual rank holds the same tensor, so the
     # gather is n tiled copies (exact under the single-controller model)
     out = jnp.concatenate([x] * n, axis=axis)
+    # list inputs (tensor_list out-param form) were handled above; a raw
+    # array input gets the gathered array back, same as the axis-bound path
     return Tensor(out) if isinstance(tensor_or_list, Tensor) else out
 
 

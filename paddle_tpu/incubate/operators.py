@@ -146,6 +146,23 @@ def fused_dropout_add_ln(x, residual, gamma, beta, p=0.0, epsilon=1e-5,
     from ..random import split_key
 
     p_eff = float(p) if training else 0.0
+
+    from ..static.program import recording_active
+
+    if p_eff > 0.0 and recording_active():
+        # static mode: sample the mask inside the traced computation from a
+        # per-run feed key so each replayed step gets a fresh dropout pattern
+        from ..static.program import record_rng_op
+
+        def _traced(key, x, residual, gamma, beta):
+            mask = jax.random.bernoulli(key, 1.0 - p_eff, x.shape)
+            return fused_residual_dropout_ln(
+                x, residual, gamma, beta, p=p_eff, epsilon=float(epsilon),
+                mask=mask)
+
+        return record_rng_op(_traced, "fused_dropout_add_ln",
+                             (x, residual, gamma, beta))
+
     mask = None
     if p_eff > 0.0:
         mask = jax.random.bernoulli(split_key(), 1.0 - p_eff, unwrap(x).shape)

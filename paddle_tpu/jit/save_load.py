@@ -165,7 +165,7 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
                 blobs[key] = arr
                 if cdt:
                     cast_dtypes[key] = cdt
-        for key, (scale, _) in int8_scales.items():
+        for key, (scale, _dt, _ax) in int8_scales.items():
             blobs[f"s:{key}"] = np.asarray(scale, np.float32)
         with open(path + PARAMS_SUFFIX, "wb") as f:
             np.savez(f, **blobs)

@@ -421,6 +421,48 @@ class GPTEmbeddings(Layer):
         return self.dropout(emb)
 
 
+QKV_LAYOUT_VERSION = 2  # 2 = head-major interleaved [nh, 3, hd] qkv columns
+
+
+def _migrate_qkv_layout(model: Layer, state_dict, tag_key: str):
+    """Permute pre-v2 qkv weights ([3, nh, hd] column layout) to the
+    head-major interleaved layout the model now computes with.
+
+    Old checkpoints carry no ``qkv_layout`` buffer; their qkv weights have
+    identical shapes but permuted columns, so loading them silently computed
+    garbage attention. Detect the missing/old tag and permute on load.
+    """
+    import numpy as np
+
+    tag = state_dict.get(tag_key)
+    if tag is not None and int(np.asarray(
+            tag._data if hasattr(tag, "_data") else tag)) >= QKV_LAYOUT_VERSION:
+        return state_dict
+    out = dict(state_dict)
+    # stamp the migrated dict so the model's version buffer isn't overwritten
+    # with the stale tag (a re-save would otherwise double-permute on load)
+    out[tag_key] = np.asarray(QKV_LAYOUT_VERSION, np.int32)
+    for name, sub in model.named_sublayers(include_self=True):
+        if not isinstance(sub, GPTAttention):
+            continue
+        hd = sub.head_dim
+        for suffix, is_bias in ((".qkv_proj.weight", False), (".qkv_proj.bias", True)):
+            key = (name + suffix) if name else suffix[1:]
+            if key not in out:
+                continue
+            w = out[key]
+            arr = np.asarray(w._data if hasattr(w, "_data") else w)
+            cols = arr.shape[-1]
+            nh = cols // (3 * hd)
+            if is_bias:
+                arr = arr.reshape(3, nh, hd).transpose(1, 0, 2).reshape(cols)
+            else:
+                arr = (arr.reshape(arr.shape[0], 3, nh, hd)
+                       .transpose(0, 2, 1, 3).reshape(arr.shape[0], cols))
+            out[key] = arr
+    return out
+
+
 class GPTModel(Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
@@ -428,6 +470,18 @@ class GPTModel(Layer):
         self.embeddings = GPTEmbeddings(config)
         self.h = LayerList([GPTDecoderLayer(config, i) for i in range(config.num_layers)])
         self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        # layout/version tag saved with every state dict so old-layout qkv
+        # checkpoints are detected and permuted on load (see _migrate_qkv_layout)
+        import jax.numpy as jnp
+        from ..tensor import Tensor as _T
+
+        self.register_buffer("qkv_layout", _T(jnp.asarray(QKV_LAYOUT_VERSION, jnp.int32)))
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        state_dict = _migrate_qkv_layout(self, state_dict, "qkv_layout")
+        return super().set_state_dict(state_dict, use_structured_name)
+
+    load_dict = set_state_dict
 
     def forward(self, input_ids, position_ids=None):
         x = self.embeddings(input_ids, position_ids)
@@ -453,6 +507,12 @@ class GPTForPretraining(Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.gpt = GPTModel(config)
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        state_dict = _migrate_qkv_layout(self, state_dict, "gpt.qkv_layout")
+        return Layer.set_state_dict(self, state_dict, use_structured_name)
+
+    load_dict = set_state_dict
 
     def forward(self, input_ids, position_ids=None):
         x = self.gpt(input_ids, position_ids)

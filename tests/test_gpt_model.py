@@ -181,3 +181,41 @@ class TestGeneration:
         model.train()
         out = model(paddle.to_tensor(np.array([[1, 2, 3]], "int32")))
         assert tuple(out.shape) == (1, 3, 64)
+
+
+def test_qkv_layout_migration():
+    """Old checkpoints (pre head-major interleave, no qkv_layout tag) load
+    with columns permuted back so forward outputs are unchanged."""
+    import numpy as np
+
+    m = GPTForPretraining(tiny_cfg())
+    ids = _batch()
+    ref = np.asarray(m(ids)._data)
+    sd = {k: np.asarray(v._data) for k, v in m.state_dict().items()}
+    assert "gpt.qkv_layout" in sd
+
+    # simulate an old checkpoint: permute qkv columns [nh,3,hd]->[3,nh,hd]
+    # and drop the layout tag
+    old = dict(sd)
+    del old["gpt.qkv_layout"]
+    hd = m.gpt.config.head_dim
+    for k in list(old):
+        if k.endswith("qkv_proj.weight"):
+            w = old[k]
+            nh = w.shape[1] // (3 * hd)
+            old[k] = (w.reshape(w.shape[0], nh, 3, hd)
+                      .transpose(0, 2, 1, 3).reshape(w.shape))
+        elif k.endswith("qkv_proj.bias"):
+            b = old[k]
+            nh = b.shape[0] // (3 * hd)
+            old[k] = b.reshape(nh, 3, hd).transpose(1, 0, 2).reshape(b.shape)
+
+    m2 = GPTForPretraining(tiny_cfg())
+    m2.set_state_dict(old)
+    out_old = np.asarray(m2(ids)._data)
+    np.testing.assert_allclose(out_old, ref, rtol=1e-5, atol=1e-5)
+
+    # new-format dict (tag present) must load unpermuted
+    m3 = GPTForPretraining(tiny_cfg())
+    m3.set_state_dict(sd)
+    np.testing.assert_allclose(np.asarray(m3(ids)._data), ref, rtol=1e-5, atol=1e-5)

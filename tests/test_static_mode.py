@@ -383,3 +383,24 @@ def test_static_amp_autocast_records_bf16_and_trains():
         losses.append(float(l))
     # loss fetch is scaled by 8; training must still converge
     assert losses[-1] < losses[0] * 0.2, f"{losses[0]} -> {losses[-1]}"
+
+
+def test_fused_dropout_add_ln_fresh_mask_per_run():
+    """Static-mode fused_dropout_add_ln must sample its mask per run (not
+    bake it at trace time): two runs differ, p=0 path is deterministic."""
+    from paddle_tpu.incubate.operators import fused_dropout_add_ln
+
+    paddle.enable_static()
+    main, startup = _fresh_program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 8], "float32")
+        r = static.data("r", [4, 8], "float32")
+        g = paddle.to_tensor(np.ones(8, "float32"))
+        b = paddle.to_tensor(np.zeros(8, "float32"))
+        out, new_res = fused_dropout_add_ln(x, r, g, b, p=0.5, training=True)
+    exe = static.Executor()
+    x_np = np.random.RandomState(0).rand(4, 8).astype("float32")
+    r_np = np.zeros((4, 8), "float32")
+    (a1,) = exe.run(main, feed={"x": x_np, "r": r_np}, fetch_list=[new_res])
+    (a2,) = exe.run(main, feed={"x": x_np, "r": r_np}, fetch_list=[new_res])
+    assert not np.allclose(a1, a2), "dropout mask was baked in at trace time"
