@@ -47,8 +47,11 @@ def _peak_flops_bf16(device) -> float:
     return 197e12  # assume v5e-class
 
 
-def _train_tput(name, batch, seq, steps, warmup, on_tpu, recompute=False):
+def _train_tput(name, batch, seq, steps, warmup, on_tpu, recompute=False,
+                granularity="full", moment_dtype="bfloat16"):
     """tokens/sec for one config; returns (tok_per_sec, n_params, cfg)."""
+    import gc
+
     import paddle_tpu as paddle
     from paddle_tpu.distributed.env import clear_mesh, init_mesh
     from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
@@ -60,7 +63,7 @@ def _train_tput(name, batch, seq, steps, warmup, on_tpu, recompute=False):
     from paddle_tpu.optimizer.optimizers import AdamW
 
     overrides = dict(hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
-                     use_recompute=recompute)
+                     use_recompute=recompute, recompute_granularity=granularity)
     if not on_tpu:  # CI / CPU smoke: tiny shapes, same code path
         overrides.update(vocab_size=256, hidden_size=64, num_layers=2,
                          num_attention_heads=4, max_position_embeddings=64)
@@ -68,15 +71,17 @@ def _train_tput(name, batch, seq, steps, warmup, on_tpu, recompute=False):
 
     paddle.seed(0)
     clear_mesh()
+    gc.collect()
     init_mesh({"dp": 1})
     model = GPTForPretraining(cfg)
     crit = GPTPretrainingCriterion(cfg)
-    opt = AdamW(learning_rate=1e-4, parameters=model.parameters())
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                moment_dtype=moment_dtype)
     trainer = ParallelTrainer(
         model, lambda out, y: crit(out, y), opt,
         dp_axis=None,
         compute_dtype="bfloat16" if on_tpu else None,
-        recompute=recompute,
+        recompute=False,
     )
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(
@@ -98,6 +103,49 @@ def _train_tput(name, batch, seq, steps, warmup, on_tpu, recompute=False):
     return batch * seq * steps / dt, n_params, cfg
 
 
+def _eager_jit_speedup():
+    """Eager GPT-block fwd+bwd: op-by-op dispatch vs the transparent
+    per-layer jit cache (FLAGS_eager_layer_jit) — SURVEY §7 hard-part 4."""
+    import gc
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.models.gpt import GPTDecoderLayer, gpt_config
+
+    cfg = gpt_config("gpt3-350m", hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    clear_mesh()
+    gc.collect()
+    init_mesh({"dp": 1})
+    paddle.seed(0)
+    block = GPTDecoderLayer(cfg)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(
+        rng.standard_normal((8, 1024, cfg.hidden_size)).astype("float32"))
+
+    def fwd_bwd():
+        out = block(x)
+        loss = (out * out).mean()
+        loss.backward()
+        for p in block.parameters():
+            p.clear_grad()
+        return loss
+
+    results = {}
+    try:
+        for mode, iters in (("false", 3), ("force", 20)):
+            paddle.set_flags({"FLAGS_eager_layer_jit": mode})
+            float(np.asarray(fwd_bwd()._data))  # compile/warm
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss = fwd_bwd()
+            float(np.asarray(loss._data))
+            results[mode] = (time.perf_counter() - t0) / iters
+    finally:
+        paddle.set_flags({"FLAGS_eager_layer_jit": "true"})
+    return results["false"] / results["force"]
+
+
 def main():
     import jax
 
@@ -110,31 +158,36 @@ def main():
         return tok_per_sec * flops_per_token / peak
 
     if on_tpu:
-        # v5e-1 sweep (r2): batch 8 no-remat is the optimum for 350m
-        # (42.5k tok/s vs 35.0k at b16, 27.5k at b16+remat; flash attention
-        # at head_dim 64 runs whole-sequence blocks — see
-        # ops/pallas/flash_attention.py measurements)
-        seq, steps, warmup = 1024, 30, 3
-        tput, n_params, cfg = _train_tput("gpt3-350m", 8, seq, steps, warmup, True)
+        # v5e-1 sweep (r3, /tmp/sweep_r3.jsonl): bf16 Adam moments + the
+        # D-padded flash kernel (head_dim 96) made every config fit WITHOUT
+        # full rematerialization — 760m b8 no-remat = 57.6% MFU (was 33.6%
+        # with b4 + whole-block remat in r2) and the 1.3B north-star config
+        # now runs single-chip at b4 + full-block remat (f32 params 5.3GB +
+        # bf16 moments 5.3GB + rematerialized activations) at ~50% MFU.
+        seq = 1024
         secondary = {}
+        # north star first: GPT-3 1.3B (BASELINE.json config #4)
+        tput, n_params, cfg = _train_tput(
+            "gpt3-1.3b", 4, seq, 10, 2, True, recompute=True,
+            granularity="full", moment_dtype="bfloat16")
+        metric = "gpt3_1.3b_train_tokens_per_sec_chip"
         try:
-            # v5e-1: b8/b4 without remat OOM; b4 + remat is the fit point
-            t760, n760, c760 = _train_tput("gpt3-760m", 4, seq, 10, 2, True,
-                                           recompute=True)
+            t760, n760, c760 = _train_tput("gpt3-760m", 8, seq, 10, 2, True)
             secondary["gpt3_760m_tokens_per_sec_chip"] = round(t760, 2)
             secondary["gpt3_760m_mfu"] = round(mfu(t760, n760, c760, seq), 4)
         except Exception as e:  # pragma: no cover - device dependent
             secondary["gpt3_760m_tokens_per_sec_chip"] = f"failed: {type(e).__name__}"
-        # honest 1.3b single-chip status: measured OOM (f32 params+moments
-        # ~15.6 GB vs 16 GB HBM, with or without remat at batch 4/8);
-        # 1.3B is the multi-chip north-star config — the hybrid
-        # pp x mp x sharding step exists and is validated by
-        # dryrun_multichip + the 8-device CPU-mesh pipeline tests
-        secondary["gpt3_1.3b_single_chip"] = (
-            "OOM on 16GB v5e-1 (measured, batch 4-8, with/without remat): "
-            "f32 params+Adam moments ~15.6GB; runs via the hybrid "
-            "pp*mp*sharding step (dryrun_multichip) or ZeRO-offload")
-        metric = "gpt_350m_train_tokens_per_sec_chip"
+        try:
+            t350, n350, c350 = _train_tput("gpt3-350m", 8, seq, 20, 2, True)
+            secondary["gpt3_350m_tokens_per_sec_chip"] = round(t350, 2)
+            secondary["gpt3_350m_mfu"] = round(mfu(t350, n350, c350, seq), 4)
+        except Exception as e:  # pragma: no cover - device dependent
+            secondary["gpt3_350m_tokens_per_sec_chip"] = f"failed: {type(e).__name__}"
+        try:
+            secondary["eager_layer_jit_block_speedup"] = round(
+                _eager_jit_speedup(), 2)
+        except Exception as e:  # pragma: no cover - device dependent
+            secondary["eager_layer_jit_block_speedup"] = f"failed: {type(e).__name__}"
     else:
         seq, steps, warmup = 32, 3, 1
         tput, n_params, cfg = _train_tput("gpt2-small", 4, seq, steps, warmup, False)

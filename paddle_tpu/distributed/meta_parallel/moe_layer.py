@@ -150,6 +150,10 @@ class MoELayer(Layer):
     trace (add it to the training loss).
     """
 
+    # the aux-loss side output (self.l_aux) escapes forward as an attribute;
+    # tracing it inside a cached jit would leak a tracer — always run eager
+    _jit_forward_exempt = True
+
     def __init__(self, d_model: int, d_hidden: int, num_experts: int, *,
                  top_k: int = 2, capacity_factor: float = 1.25,
                  activation: str = "gelu", ep_group=None,

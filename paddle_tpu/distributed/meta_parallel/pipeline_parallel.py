@@ -105,7 +105,16 @@ class PipelineParallel(Layer):
         self.total_loss = loss
         return loss
 
+    def _sync_from_pipeline(self):
+        """Write the trained sharded params back into the eager Tensors
+        (lazy: only before reads — eval/state_dict — not every step)."""
+        fn = self._train_step_fn
+        step = getattr(fn, "_pipeline_step", None)
+        if step is not None:
+            step.sync_to_model()
+
     def eval_batch(self, data, compute_loss: bool = True):
+        self._sync_from_pipeline()
         x, y = data
         out = self._layers(x)
         if compute_loss and self._layers._loss_fn is not None:
@@ -113,6 +122,7 @@ class PipelineParallel(Layer):
         return out
 
     def state_dict(self, *a, **k):
+        self._sync_from_pipeline()
         return self._layers.state_dict(*a, **k)
 
     def set_state_dict(self, *a, **k):

@@ -1,34 +1,43 @@
 """Stage-parallel pipeline schedule: the ppermute-scan pipeline program.
 
-Parity: the reference's 1F1B pipeline — static-graph
+Parity: the reference's pipeline schedules — static-graph
 ``PipelineOptimizer``/``SectionWorker`` (fluid/optimizer.py:4176,
-framework/section_worker.cc:62 schedule_mode==1) and dygraph
-``PipelineParallel.forward_backward_pipeline``
+framework/section_worker.cc:62 schedule_mode==1 (1F1B), :139 (F-then-B)) and
+dygraph ``PipelineParallel.forward_backward_pipeline``
 (fleet/meta_parallel/pipeline_parallel.py:80) with send_v2/recv_v2 p2p ops —
 composed with tensor parallelism (partial_send p2p-under-mp,
 fleet/meta_parallel/pp_utils/p2p_communication.py:149-155), ZeRO sharding
 (fleet/meta_optimizers/sharding_optimizer.py:140 hybrid mp x sharding x pp x
-dp degrees), and the TP RNG tracker for dropout determinism
+dp degrees), expert parallelism (global_scatter/global_gather all2all,
+collective/global_scatter_op.cc:19), interleaved virtual stages
+(pp_layers.py get_stage_from_index with num_virtual_pipeline_stages), and
+the TP RNG tracker for dropout determinism
 (fleet/meta_parallel/parallel_layers/random.py).
 
 TPU-native redesign (the canonical GSPMD/praxis collective-permute
 pipeline): ONE shard_map over every mesh axis —
 
-- 'pp'   — stages own a stacked [1, k, ...] slice of the decoder layers;
-  the microbatch loop is a ``lax.scan`` of M + S - 1 ticks where activations
-  rotate stage→stage+1 via ``lax.ppermute``. ``jax.grad`` through the scan
-  yields the reverse schedule (the p2p transposes ARE the backward p2p) and
-  ``jax.checkpoint`` on the per-tick stage body recovers 1F1B's O(S)
-  activation-memory bound.
+- 'pp'   — stages own a stacked [1, k, ...] slice of the body layers; the
+  microbatch loop is a ``lax.scan`` where activations rotate stage→stage+1
+  (wrapping last→first for virtual-stage chunk transitions) via
+  ``lax.ppermute``. ``jax.grad`` through the scan yields the reverse
+  schedule (the p2p transposes ARE the backward p2p) and ``jax.checkpoint``
+  on the per-tick stage body recovers 1F1B's O(S) activation-memory bound.
+- interleaved virtual stages — with v > 1 each rank holds v chunks of
+  k/v layers (chunk c of rank s = global layers [(c*S+s)*kv, ...+kv)); a
+  microbatch circles the ring v times, shrinking the bubble from
+  (S-1)/(M+S-1) to (S-1)/(v*M+S-1) in ticks.
 - 'mp'   — stage params carry their tensor-parallel shard (column/row
   splits per ``partition_spec``); blocks run the explicit Megatron
-  algorithm (mp_layers' ``mp_axis_bound`` path: c_identity fwd/psum bwd,
-  row-parallel psum, sharded-vocab embedding + softmax-CE).
+  algorithm (mp_layers' ``mp_axis_bound`` path).
+- 'ep'   — expert-parallel MoE blocks run their lax.all_to_all exchange
+  inside the same shard_map; expert-stacked weights are sharded over 'ep'
+  while dense params are replicated over it (grads pmean'd).
 - 'dp' / 'sharding' — both shard the batch; grads are pmean'd over 'dp'
   and reduce-scattered over 'sharding' (ZeRO-2), optimizer slots live
   sliced 1/n per sharding rank, updated params all-gather back.
-- dropout — per-(microbatch, layer) PRNG keys are folded in inside the
-  scan so masks are deterministic and reproducible by a sequential run
+- dropout — per-(microbatch, global-layer) PRNG keys are folded in inside
+  the scan so masks are deterministic and reproducible by a sequential run
   (replaces the reference's RNG state tracker).
 
 Shared (tied) embedding + final-norm + head params are replicated over 'pp'
@@ -37,8 +46,7 @@ tied-embedding grads (pp_layers.py:49).
 """
 from __future__ import annotations
 
-import math
-from typing import Optional
+from typing import Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -57,11 +65,18 @@ from .mp_layers import (
     mp_identity_array,
 )
 
-__all__ = ["build_gpt_pipeline_step", "stack_layer_params", "GPTPipelineModule"]
+__all__ = [
+    "build_gpt_pipeline_step",
+    "build_pipeline_layer_step",
+    "stack_layer_params",
+    "GPTPipelineModule",
+    "PipelineModule",
+]
 
 PP_AXIS = "pp"
 DP_AXIS = "dp"
 SH_AXIS = "sharding"
+EP_AXIS = "ep"
 _EMBED_FOLD = 1 << 20  # fold_in tag separating the embed-dropout stream
 
 
@@ -71,16 +86,22 @@ def stack_layer_params(blocks):
     return {n: jnp.stack([t[n] for t in trees]) for n in trees[0]}
 
 
-def _only_mp(spec: P) -> P:
-    """Keep only 'mp' placements of a partition spec (dp/fsdp annotations
-    don't apply to stacked pipeline params)."""
+def _keep_axes(spec: P, axes=(MP_AXIS, EP_AXIS)) -> P:
+    """Keep only model-sharding placements ('mp'/'ep') of a partition spec
+    (dp/fsdp annotations don't apply to stacked pipeline params)."""
     dims = []
     for d in spec:
-        if d == MP_AXIS or (isinstance(d, tuple) and MP_AXIS in d):
-            dims.append(MP_AXIS)
-        else:
-            dims.append(None)
+        hit = None
+        for a in axes:
+            if d == a or (isinstance(d, tuple) and a in d):
+                hit = a
+                break
+        dims.append(hit)
     return P(*dims)
+
+
+def _spec_has(spec, axis) -> bool:
+    return any(d == axis or (isinstance(d, tuple) and axis in d) for d in spec)
 
 
 def _local_shape(global_shape, spec, mesh):
@@ -98,54 +119,337 @@ def _local_shape(global_shape, spec, mesh):
     return tuple(out)
 
 
-class GPTPipelineModule:
-    """Functional pipeline program for a GPTForPretraining model.
+def _block_signature(layer) -> tuple:
+    """Structural identity of a layer: type + named param shapes/dtypes.
+    Slots whose (stage, chunk) instances share a signature can be stacked."""
+    return (type(layer).__name__,
+            tuple((n, tuple(p._data.shape), str(p._data.dtype))
+                  for n, p in sorted(layer.named_parameters())))
 
-    Splits ``model.gpt.h`` (N uniform decoder blocks) into S = pp-degree
-    stages of k = N/S layers each. Parameters:
-      - ``stages``: {name: [S, k, ...]} — dim 0 on 'pp', tensor-parallel
-        dims on 'mp' per the block's ``partition_spec`` annotations
-      - ``shared``: tied wte (vocab on 'mp') / wpe / final LN
+
+class PipelineModule:
+    """Generic functional pipeline program over a uniform body of blocks.
+
+    ``blocks``: N layers forming the pipelined body. They are segmented as
+    N = S * v * kv (stages x virtual chunks x layers-per-chunk); chunk c of
+    stage s owns global layers [(c*S+s)*kv, (c*S+s+1)*kv) — the reference's
+    interleaved assignment (pp_layers.py get_stage_from_index). At every
+    slot position i in [0, kv), all (s, c) instances must be structurally
+    identical so their params stack to [S, v, ...]; heterogeneous patterns
+    (e.g. MoE-every-2) are legal as long as the pattern period divides kv.
+
+    Subclasses provide ``_inject`` (shared, x_mb, key) -> h0 (the stage-0
+    input computation) and ``_head_loss`` (shared, h, y_mb) -> scalar (the
+    last-stage loss), plus the shared (pp-replicated) param group.
     """
 
-    def __init__(self, model, num_stages: int, microbatches: int, mesh=None):
-        cfg = model.gpt.config
-        if getattr(cfg, "num_experts", 0):
-            raise ValueError("pipeline schedule requires a uniform decoder "
-                             "stack; MoE configs interleave MoE/dense blocks "
-                             "with different parameter structures — use "
-                             "ParallelTrainer (ep axis) for MoE models")
-        n_layers = len(model.gpt.h)
-        if n_layers % num_stages != 0:
-            raise ValueError(f"layer count {n_layers} must be divisible by "
-                             f"the stage count {num_stages}")
+    def __init__(self, blocks, num_stages: int, microbatches: int, *,
+                 mesh=None, num_virtual_stages: int = 1, training: bool = True,
+                 aux_of: Optional[Callable] = None, aux_weight: float = 0.0):
         mesh = mesh or get_mesh()
         self.mesh = mesh
         self.mp_size = int(mesh.shape.get(MP_AXIS, 1)) if mesh is not None else 1
         self.has_mp = self.mp_size > 1
+        self.num_stages = num_stages
+        self.num_virtual = int(num_virtual_stages)
+        self.microbatches = microbatches
+        self._training = training
+        self._aux_of = aux_of
+        self._aux_weight = aux_weight
+        n_layers = len(blocks)
+        sv = num_stages * self.num_virtual
+        if n_layers % sv != 0:
+            raise ValueError(
+                f"layer count {n_layers} must be divisible by stages x "
+                f"virtual chunks = {num_stages} x {self.num_virtual}")
+        self.layers_per_chunk = kv = n_layers // sv
+        self.layers_per_stage = kv * self.num_virtual  # rows per stage
+
+        # layer at (slot i, stage s, chunk c) = blocks[(c*S+s)*kv + i]
+        self._blocks = list(blocks)
+        self.slot_templates: List = [self._blocks[i] for i in range(kv)]
+        for i in range(kv):
+            sig0 = _block_signature(self.slot_templates[i])
+            for c in range(self.num_virtual):
+                for s in range(num_stages):
+                    blk = self._blocks[(c * num_stages + s) * kv + i]
+                    if _block_signature(blk) != sig0:
+                        raise ValueError(
+                            f"pipeline slot {i}: layer {(c*num_stages+s)*kv+i} "
+                            f"({type(blk).__name__}) does not match the slot "
+                            f"template ({type(self.slot_templates[i]).__name__});"
+                            " stage/chunk structures must align (e.g. "
+                            "moe_every must divide layers-per-chunk)")
+        homog = all(_block_signature(t) == _block_signature(self.slot_templates[0])
+                    for t in self.slot_templates)
+        self._scan_body = homog
+
+        # per-slot tensor placement (Megatron column/row + expert stacking)
+        def spec_of_block(blk):
+            out = {}
+            for n, p in blk.named_parameters():
+                spec = getattr(p, "partition_spec", None) or P()
+                if mesh is not None:
+                    spec = sanitize_spec(spec, mesh)
+                out[n] = _keep_axes(spec)
+            return out
+
+        # stage params: {name: [S, k, ...]} (k = v*kv rows per stage, chunk-
+        # major) when homogeneous — scanned; else {"slot{i}.name": [S, v, ...]}
+        self.stage_params = {}
+        self.stage_specs = {}
+        if self._scan_body:
+            rows = []  # per stage: list of blocks in (chunk, slot) order
+            for s in range(num_stages):
+                stage_rows = []
+                for c in range(self.num_virtual):
+                    for i in range(kv):
+                        stage_rows.append(self._blocks[(c * num_stages + s) * kv + i])
+                rows.append(stack_layer_params(stage_rows))
+            bspec = spec_of_block(self.slot_templates[0])
+            for n in rows[0]:
+                self.stage_params[n] = jnp.stack([r[n] for r in rows])
+                self.stage_specs[n] = P(PP_AXIS, None, *bspec[n])
+        else:
+            for i, tmpl in enumerate(self.slot_templates):
+                bspec = spec_of_block(tmpl)
+                insts = {}
+                for n, _ in tmpl.named_parameters():
+                    per_stage = []
+                    for s in range(num_stages):
+                        per_chunk = [
+                            dict(self._blocks[(c * num_stages + s) * kv + i]
+                                 .named_parameters())[n]._data
+                            for c in range(self.num_virtual)
+                        ]
+                        per_stage.append(jnp.stack(per_chunk))
+                    insts[n] = jnp.stack(per_stage)  # [S, v, ...]
+                for n, arr in insts.items():
+                    self.stage_params[f"slot{i}.{n}"] = arr
+                    self.stage_specs[f"slot{i}.{n}"] = P(PP_AXIS, None, *bspec[n])
+
+        self.shared_params = {}
+        self.shared_specs = {}
+
+    # -- hooks -----------------------------------------------------------
+    def _inject(self, shared, x_mb, key=None):
+        raise NotImplementedError
+
+    def _head_loss(self, shared, h, y_mb):
+        raise NotImplementedError
+
+    def _h0_shape_dtype(self, shared, x):
+        """Shape/dtype of the rotating activation, from the inject hook
+        (``shared`` is the rank-local tree when tracing inside shard_map)."""
+        mb = x.shape[0] // self.microbatches
+        spec = jax.eval_shape(
+            lambda sh, xa: self._inject(sh, xa), shared,
+            jax.ShapeDtypeStruct((mb,) + tuple(x.shape[1:]), x.dtype))
+        return spec.shape, spec.dtype
+
+    # -- functional pieces ------------------------------------------------
+    def _apply_slot(self, template, layer_params, h):
+        """One body layer, pure. Inside an 'mp'/'ep' shard_map region the
+        params are the local shards and the block runs the explicit
+        collectives (mp_layers / moe_layer bound paths). Returns (h, aux)."""
+        with tape.no_grad():
+            out, _ = template.functional_call_with_state(layer_params, {}, Tensor(h))
+        aux = self._aux_of(template) if self._aux_of is not None else None
+        if aux is None:
+            aux = jnp.zeros((), jnp.float32)
+        elif isinstance(aux, Tensor):
+            aux = aux._data
+        return out._data, jnp.asarray(aux, jnp.float32)
+
+    def _apply_block(self, layer_params, h):
+        """Single-template compat form (tests' dense references): apply one
+        body layer, return the hidden only."""
+        out, _ = self._apply_slot(self.slot_templates[0], layer_params, h)
+        return out
+
+    def _stage_apply(self, local_stage, c, s_idx, h, mb_key):
+        """Apply this rank's chunk ``c`` (kv layers) to h. local_stage leaves
+        are [k, ...] (scan layout, chunk-major rows) or [v, ...] per slot."""
+        kv = self.layers_per_chunk
+        n = self.num_stages
+        layer_base = (c * n + s_idx) * kv  # global index of the chunk's 1st layer
+
+        def run_layer(tmpl, lp, h, lk):
+            # nested remat: without it the stage backward materializes EVERY
+            # layer's residuals (e.g. f32 [k, mb, T, 4H] MLP intermediates)
+            # simultaneously — per-layer checkpoint bounds that to one layer
+            def _one(lp, h, lk):
+                saved = get_rng_state()
+                set_rng_state(lk)
+                try:
+                    out, aux = self._apply_slot(tmpl, lp, h)
+                finally:
+                    set_rng_state(saved)
+                return out, aux
+
+            return jax.checkpoint(_one)(lp, h, lk)
+
+        if self._scan_body:
+            chunk = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_slice_in_dim(a, c * kv, kv, axis=0),
+                local_stage)
+            keys = jax.vmap(lambda i: jax.random.fold_in(mb_key, i))(
+                jnp.arange(kv) + layer_base)
+            tmpl = self.slot_templates[0]
+
+            def body(h, xs):
+                lp, lk = xs
+                out, aux = run_layer(tmpl, lp, h, lk)
+                return out, aux
+
+            h, auxs = lax.scan(body, h, (chunk, keys))
+            return h, jnp.sum(auxs)
+        aux_sum = jnp.zeros((), jnp.float32)
+        for i, tmpl in enumerate(self.slot_templates):
+            prefix = f"slot{i}."
+            lp = {
+                name[len(prefix):]: lax.dynamic_index_in_dim(
+                    arr, c, axis=0, keepdims=False)
+                for name, arr in local_stage.items()
+                if name.startswith(prefix)
+            }
+            lk = jax.random.fold_in(mb_key, layer_base + i)
+            h, aux = run_layer(tmpl, lp, h, lk)
+            aux_sum = aux_sum + aux
+        return h, aux_sum
+
+    # -- the pipelined local loss (runs inside shard_map) -----------------
+    def local_loss(self, stage_params, shared, x, y, key=None):
+        """x, y: [M*mb, T...] on this data shard; stage_params / shared are
+        this rank's (pp, mp, ep) shards. ``key``: PRNG key for the dropout
+        streams (None ⇒ deterministic eval). Returns the replicated mean
+        loss (CE + weighted aux)."""
+        n = lax.axis_size(PP_AXIS)
+        s_idx = lax.axis_index(PP_AXIS)
+        m = self.microbatches
+        v = self.num_virtual
+        mb = x.shape[0] // m
+        x_mb = x.reshape((m, mb) + x.shape[1:])
+        y_mb = y.reshape((m, mb) + y.shape[1:])
+        local_stage = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        use_rng = key is not None and self._training and self._has_dropout()
+        if key is None:
+            key = jax.random.key(0)
+
+        def stage_fn(h, c, mb_key):
+            return self._stage_apply(local_stage, c, s_idx, h, mb_key)
+
+        # 1F1B memory bound: recompute stage activations in backward
+        stage_fn = jax.checkpoint(stage_fn)
+
+        # interleaved schedule: microbatches are injected in groups of n;
+        # group g's microbatch r enters the ring at tick g*v*n + r and
+        # circles it v times. ticks: v*m + n - 1 for m % n == 0.
+        ticks = self.schedule_ticks()
+        perm = [(i, (i + 1) % n) for i in range(n)]  # ring (wrap = next chunk)
+
+        def tick(carry, t):
+            h_in, loss_acc, aux_acc = carry
+            p = t - s_idx
+            r = jnp.where(p >= 0, p % n, 0)
+            q = jnp.where(p >= 0, (p - r) // n, 0)
+            c = q % v          # virtual chunk this rank applies at tick t
+            g = q // v
+            mb_i = g * n + r   # microbatch currently at this rank
+            valid = (p >= 0) & (mb_i < m)
+            mb_c = jnp.clip(mb_i, 0, m - 1)
+            inj_key = jax.random.fold_in(
+                jax.random.fold_in(key, mb_c), _EMBED_FOLD)
+            inj = self._inject(shared, x_mb[mb_c], inj_key if use_rng else None)
+            h = jnp.where((s_idx == 0) & (c == 0), inj, h_in)
+            mb_key = jax.random.fold_in(key, mb_c)
+            h, aux = stage_fn(h, c, mb_key)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+            l = self._head_loss(shared, h, y_mb[mb_c])
+            loss_acc = loss_acc + jnp.where(
+                (s_idx == n - 1) & (c == v - 1) & valid, l, 0.0)
+            h_next = lax.ppermute(h, PP_AXIS, perm)
+            return (h_next, loss_acc, aux_acc), None
+
+        h_shape, h_dtype = self._h0_shape_dtype(shared, x)
+        h0 = jnp.zeros(h_shape, h_dtype)
+        (_, loss_acc, aux_acc), _ = lax.scan(
+            tick, (h0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            jnp.arange(ticks))
+        # Only the last stage accumulated CE loss; every rank accumulated its
+        # own layers' aux. Differentiate the LOCAL value (cross-stage credit
+        # flows through the ppermute transposes); the psum only replicates
+        # the VALUE — routing gradient through it would scale all grads by
+        # the pp degree (each shard's replicated copy would contribute
+        # cotangent 1).
+        total = loss_acc / m
+        if self._aux_weight:
+            total = total + self._aux_weight * aux_acc / m
+        rep = lax.psum(total, PP_AXIS)
+        return total + lax.stop_gradient(rep - total)
+
+    def _has_dropout(self) -> bool:
+        return False
+
+    def schedule_ticks(self) -> int:
+        """Scan length of the schedule. Each tick applies one kv-layer chunk
+        per rank; per-rank useful work is microbatches x virtual chunks, so
+        the bubble fraction is 1 - m*v/ticks — interleaving (v > 1) shrinks
+        it from (S-1)/(m+S-1) toward (S-1)/(v*m+S-1) (section_worker.cc:62
+        1F1B vs :139 F-then-B schedules, Megatron interleaved analog)."""
+        m, n, v = self.microbatches, self.num_stages, self.num_virtual
+        return ((m - 1) // n) * (v * n) + ((m - 1) % n) + v * n
+
+    def bubble_fraction(self) -> float:
+        return 1.0 - (self.microbatches * self.num_virtual) / self.schedule_ticks()
+
+    # -- write trained params back into the model -------------------------
+    def sync_to_model(self, stage_params, shared):
+        kv = self.layers_per_chunk
+        n = self.num_stages
+        if self._scan_body:
+            for s in range(n):
+                for c in range(self.num_virtual):
+                    for i in range(kv):
+                        blk = self._blocks[(c * n + s) * kv + i]
+                        row = c * kv + i
+                        for pname, p in blk.named_parameters():
+                            p._set_data(stage_params[pname][s, row])
+        else:
+            for s in range(n):
+                for c in range(self.num_virtual):
+                    for i in range(kv):
+                        blk = self._blocks[(c * n + s) * kv + i]
+                        for pname, p in blk.named_parameters():
+                            p._set_data(stage_params[f"slot{i}.{pname}"][s, c])
+
+
+class GPTPipelineModule(PipelineModule):
+    """Pipeline program for a GPTForPretraining model.
+
+    Parameters:
+      - ``stages``: {name: [S, k, ...]} — dim 0 on 'pp', tensor-parallel
+        dims on 'mp' / expert dims on 'ep' per ``partition_spec``
+      - ``shared``: tied wte (vocab on 'mp') / wpe / final LN
+    """
+
+    def __init__(self, model, num_stages: int, microbatches: int, mesh=None,
+                 num_virtual_stages: int = 1):
+        cfg = model.gpt.config
+        aux_w = float(getattr(cfg, "moe_aux_loss_weight", 0.0) or 0.0)
+
+        def aux_of(blk):
+            if getattr(blk, "is_moe", False) and blk.mlp.l_aux is not None:
+                return blk.mlp.l_aux
+            return None
+
+        super().__init__(
+            list(model.gpt.h), num_stages, microbatches, mesh=mesh,
+            num_virtual_stages=num_virtual_stages, training=model.training,
+            aux_of=aux_of if getattr(cfg, "num_experts", 0) else None,
+            aux_weight=aux_w)
         self.model = model
         self.cfg = cfg
-        self.num_stages = num_stages
-        self.layers_per_stage = n_layers // num_stages
-        self.microbatches = microbatches
-        self._block = model.gpt.h[0]  # structural template for all blocks
-
-        # tensor-parallel placement per block param (Megatron column/row)
-        self.block_specs = {}
-        for n, p in self._block.named_parameters():
-            spec = getattr(p, "partition_spec", None) or P()
-            if mesh is not None:
-                spec = sanitize_spec(spec, mesh)
-            self.block_specs[n] = _only_mp(spec)
-
-        stacked = stack_layer_params(list(model.gpt.h))
-        self.stage_params = {
-            n: a.reshape((num_stages, self.layers_per_stage) + a.shape[1:])
-            for n, a in stacked.items()
-        }
-        self.stage_specs = {
-            n: P(PP_AXIS, None, *self.block_specs[n]) for n in self.stage_params
-        }
         emb = model.gpt.embeddings
         self.shared_params = {
             "wte": emb.word_embeddings.weight._data,
@@ -160,16 +464,15 @@ class GPTPipelineModule:
             self.shared_params["wpe"] = emb.position_embeddings.weight._data
             self.shared_specs["wpe"] = P()
 
-    # -- functional pieces ------------------------------------------------
-    def _apply_block(self, layer_params, h):
-        """One decoder layer, pure: layer_params {name: arr}, h [mb, T, H].
-        Inside an 'mp' shard_map region the params are the local TP shards
-        and the block runs the explicit Megatron collectives."""
-        with tape.no_grad():
-            out, _ = self._block.functional_call_with_state(layer_params, {}, Tensor(h))
-        return out._data
+    def _has_dropout(self) -> bool:
+        return (self.cfg.hidden_dropout_prob > 0
+                or self.cfg.attention_dropout_prob > 0)
 
-    def _embed(self, shared, ids, key=None):
+    def _h0_shape_dtype(self, shared, x):
+        mb = x.shape[0] // self.microbatches
+        return (mb, x.shape[1], self.cfg.hidden_size), shared["wte"].dtype
+
+    def _inject(self, shared, ids, key=None):
         t = ids.shape[-1]
         pos = jnp.arange(t)
         wte = shared["wte"]
@@ -190,6 +493,8 @@ class GPTPipelineModule:
             keep = jax.random.bernoulli(key, 1.0 - p, h.shape)
             h = jnp.where(keep, h / (1.0 - p), 0.0).astype(h.dtype)
         return h
+
+    _embed = _inject  # historical name (tests' dense references)
 
     def _head_loss(self, shared, h, labels):
         eps = self.cfg.layer_norm_epsilon
@@ -224,90 +529,8 @@ class GPTPipelineModule:
         ll = jnp.where(valid, ll, 0.0)
         return -ll.sum() / jnp.maximum(valid.sum(), 1)
 
-    # -- the pipelined local loss (runs inside shard_map) -----------------
-    def local_loss(self, stage_params, shared, x, y, key=None):
-        """x, y: [M*mb, T] on this (dp, sharding) shard; stage_params /
-        shared are this rank's (pp, mp) shards. ``key``: PRNG key for the
-        dropout streams (None ⇒ deterministic eval). Returns the replicated
-        mean loss."""
-        n = lax.axis_size(PP_AXIS)
-        s_idx = lax.axis_index(PP_AXIS)
-        m = self.microbatches
-        mb = x.shape[0] // m
-        x_mb = x.reshape((m, mb) + x.shape[1:])
-        y_mb = y.reshape((m, mb) + y.shape[1:])
-        local_stage = jax.tree_util.tree_map(lambda a: a[0], stage_params)  # [k, ...]
-        k_layers = self.layers_per_stage
-        use_rng = key is not None and self.model.training and (
-            self.cfg.hidden_dropout_prob > 0 or self.cfg.attention_dropout_prob > 0)
-        if key is None:
-            key = jax.random.key(0)
-
-        def stage_fn(h, stage_key):
-            # per-layer dropout keys: fold the GLOBAL layer index into the
-            # microbatch key so a sequential run derives identical masks
-            layer_ids = jnp.arange(k_layers) + s_idx * k_layers
-            keys = jax.vmap(lambda i: jax.random.fold_in(stage_key, i))(layer_ids)
-
-            def body(h, xs):
-                lp, lk = xs
-                saved = get_rng_state()
-                set_rng_state(lk)
-                try:
-                    out = self._apply_block(lp, h)
-                finally:
-                    set_rng_state(saved)
-                return out, None
-
-            h, _ = lax.scan(body, h, (local_stage, keys))
-            return h
-
-        # 1F1B memory bound: recompute stage activations in backward
-        stage_fn = jax.checkpoint(stage_fn)
-
-        ticks = m + n - 1
-        t_seq, h_dim = x.shape[1], self.cfg.hidden_size
-        perm = [(i, i + 1) for i in range(n - 1)]  # stage i -> i+1 (no wrap)
-
-        def tick(carry, t):
-            h_in, loss_acc = carry
-            inj_mb = jnp.clip(t, 0, m - 1)
-            inj_key = jax.random.fold_in(
-                jax.random.fold_in(key, inj_mb), _EMBED_FOLD)
-            inj = self._embed(shared, x_mb[inj_mb], inj_key if use_rng else None)
-            h = jnp.where(s_idx == 0, inj, h_in)
-            # stage s processes at tick t the microbatch injected at t - s
-            stage_key = jax.random.fold_in(key, jnp.clip(t - s_idx, 0, m - 1))
-            h = stage_fn(h, stage_key)
-            out_idx = t - (n - 1)
-            valid = (out_idx >= 0) & (out_idx < m)
-            lbl = y_mb[jnp.clip(out_idx, 0, m - 1)]
-            l = self._head_loss(shared, h, lbl)
-            loss_acc = loss_acc + jnp.where((s_idx == n - 1) & valid, l, 0.0)
-            h_next = lax.ppermute(h, PP_AXIS, perm)
-            return (h_next, loss_acc), None
-
-        h0 = jnp.zeros((mb, t_seq, h_dim), self.shared_params["wte"].dtype)
-        (_, loss_acc), _ = lax.scan(tick, (h0, jnp.zeros((), jnp.float32)),
-                                    jnp.arange(ticks))
-        # Only the last stage accumulated loss. Differentiate the LOCAL value
-        # (cross-stage credit flows through the ppermute transposes); the
-        # psum only replicates the VALUE — routing gradient through it would
-        # scale all grads by the pp degree (each shard's replicated copy
-        # would contribute cotangent 1).
-        local = loss_acc / m
-        total = lax.psum(loss_acc, PP_AXIS) / m
-        return local + lax.stop_gradient(total - local)
-
-    # -- write trained params back into the model -------------------------
     def sync_to_model(self, stage_params, shared):
-        flat = {
-            n: a.reshape((self.num_stages * self.layers_per_stage,) + a.shape[2:])
-            for n, a in stage_params.items()
-        }
-        for i, blk in enumerate(self.model.gpt.h):
-            for n, p in blk.named_parameters():
-                p._set_data(flat[n][i])
+        super().sync_to_model(stage_params, shared)
         emb = self.model.gpt.embeddings
         emb.word_embeddings.weight._set_data(shared["wte"])
         if "wpe" in shared:
@@ -316,10 +539,107 @@ class GPTPipelineModule:
         self.model.gpt.ln_f.bias._set_data(shared["ln_f.bias"])
 
 
+class _LayerStackPipelineModule(PipelineModule):
+    """PipelineModule over a PipelineLayer's built layers: the maximal
+    structurally-uniform run becomes the pipelined body; leading/trailing
+    layers run replicated as the inject/head edges (grads psum'd over 'pp',
+    the SharedLayerDesc treatment). Parity target:
+    fleet/meta_parallel/parallel_layers/pp_layers.py:132 `PipelineLayer` +
+    `_segment_network`:282."""
+
+    def __init__(self, pipe_layer, num_stages: int, microbatches: int, *,
+                 mesh=None, num_virtual_stages: int = 1, loss_fn=None):
+        layers = list(pipe_layer.run_function)
+        sv = num_stages * num_virtual_stages
+        lo, hi = _uniform_body_span(layers, sv)
+        if hi - lo < sv:
+            raise ValueError(
+                f"PipelineLayer has no structurally-uniform run of >= "
+                f"{sv} layers to pipeline (found {hi - lo}); use the GSPMD "
+                "fallback (ParallelTrainer)")
+        # trim the run to a multiple of S*v, pushing leftovers to the edges
+        extra = (hi - lo) % sv
+        hi -= extra
+        self._prefix = layers[:lo]
+        self._suffix = layers[hi:]
+        self._loss_fn = loss_fn or pipe_layer._loss_fn or (
+            lambda out, y: out.mean() if hasattr(out, "mean") else jnp.mean(out))
+        super().__init__(layers[lo:hi], num_stages, microbatches, mesh=mesh,
+                         num_virtual_stages=num_virtual_stages,
+                         training=pipe_layer.training)
+        self.pipe_layer = pipe_layer
+        # identity-dedup tied Parameters (SharedLayerDesc: the same tensor
+        # appears in several edge layers — one shared leaf, one update)
+        seen = {}
+        self._edge_keymaps = {"prefix": [], "suffix": []}
+        self._shared_param_tensors = {}
+        for group, edge in (("prefix", self._prefix), ("suffix", self._suffix)):
+            for j, lyr in enumerate(edge):
+                keymap = {}
+                for n, p in lyr.named_parameters():
+                    pid = id(p)
+                    if pid not in seen:
+                        key = f"{group}.{j}.{n}"
+                        seen[pid] = key
+                        spec = getattr(p, "partition_spec", None) or P()
+                        if self.mesh is not None:
+                            spec = sanitize_spec(spec, self.mesh)
+                        self.shared_params[key] = p._data
+                        self.shared_specs[key] = _keep_axes(spec)
+                        self._shared_param_tensors[key] = p
+                    keymap[n] = seen[pid]
+                self._edge_keymaps[group].append(keymap)
+
+    def _apply_edge(self, group, edge, shared, h):
+        from .pp_layers import _is_first_shared
+
+        for j, lyr in enumerate(edge):
+            keymap = self._edge_keymaps[group][j]
+            tree = {n: shared[keymap[n]] for n in keymap}
+            fwd = getattr(lyr, "_shared_forward", None)
+            call = None
+            if fwd is not None and not _is_first_shared(self.pipe_layer, lyr):
+                call = (lambda *a, _l=lyr, _f=fwd: _f(_l, *a))
+            with tape.no_grad():
+                out, _ = lyr.functional_call_with_state(
+                    tree, {}, Tensor(h), _call_fn=call)
+            h = out._data if isinstance(out, Tensor) else out
+        return h
+
+    def _inject(self, shared, x_mb, key=None):
+        return self._apply_edge("prefix", self._prefix, shared, x_mb)
+
+    def _head_loss(self, shared, h, y_mb):
+        out = self._apply_edge("suffix", self._suffix, shared, h)
+        loss = self._loss_fn(Tensor(out), Tensor(y_mb))
+        arr = loss._data if isinstance(loss, Tensor) else jnp.asarray(loss)
+        return arr.astype(jnp.float32)
+
+    def sync_to_model(self, stage_params, shared):
+        super().sync_to_model(stage_params, shared)
+        for key, p in self._shared_param_tensors.items():
+            p._set_data(shared[key])
+
+
+def _uniform_body_span(layers, min_len):
+    """(lo, hi) of the longest run of structurally-identical layers."""
+    sigs = [_block_signature(l) for l in layers]
+    best = (0, 0)
+    i = 0
+    while i < len(sigs):
+        j = i
+        while j < len(sigs) and sigs[j] == sigs[i]:
+            j += 1
+        if j - i > best[1] - best[0]:
+            best = (i, j)
+        i = j
+    return best
+
+
 def _zero_slot_layout(pipe, optimizer, mesh, n_shard):
     """ZeRO slot layout: every param leaf's slots live flattened + padded as
-    [S, M, n_shard, sz] (pp stack, mp parts, sharding slices) so each
-    (pp, mp, sharding) rank holds exactly the 1/n_shard slice it updates —
+    [S, M, n_shard, sz] (pp stack, mp/ep parts, sharding slices) so each
+    (pp, mp|ep, sharding) rank holds exactly the 1/n_shard slice it updates —
     the reference's Shard._split_params (sharding/shard.py:22) re-expressed
     as an array layout instead of a param-name map."""
     layouts = {}
@@ -338,12 +658,15 @@ def _zero_slot_layout(pipe, optimizer, mesh, n_shard):
                 size *= s
             sz = -(-size // n_shard)
             s_dim = pipe.num_stages if grp == "stages" else 1
-            mp_sharded = any(d == MP_AXIS or (isinstance(d, tuple) and MP_AXIS in d)
-                             for d in spec)
-            m_dim = pipe.mp_size if mp_sharded else 1
+            model_axis = None
+            for ax in (MP_AXIS, EP_AXIS):
+                if _spec_has(spec, ax):
+                    model_axis = ax
+                    break
+            m_dim = int(mesh.shape.get(model_axis, 1)) if model_axis else 1
             full_shape = (s_dim, m_dim, n_shard, sz)
             spec4 = P(PP_AXIS if grp == "stages" else None,
-                      MP_AXIS if mp_sharded else None,
+                      model_axis,
                       SH_AXIS if n_shard > 1 else None,
                       None)
             layouts[grp][n] = (size, sz, spec4)
@@ -356,10 +679,10 @@ def _zero_slot_layout(pipe, optimizer, mesh, n_shard):
     return layouts, slots
 
 
-def _clip_grads_meshaware(clip, grads, pipe, has_mp):
+def _clip_grads_meshaware(clip, grads, pipe, mesh_axes):
     """Gradient clipping inside the shard_map body: the global norm must sum
-    squares over the 'pp' stack and the 'mp' shards of each leaf (reference:
-    sharding/utils ClipGradByGlobalNorm cross-rank norm reduce)."""
+    squares over the 'pp' stack and the 'mp'/'ep' shards of each leaf
+    (reference: sharding/utils ClipGradByGlobalNorm cross-rank norm reduce)."""
     from ...nn.clip import ClipGradByGlobalNorm, ClipGradByValue
 
     if isinstance(clip, ClipGradByValue):
@@ -376,10 +699,9 @@ def _clip_grads_meshaware(clip, grads, pipe, has_mp):
         for n, g in grads[grp].items():
             s = jnp.sum(jnp.square(g.astype(jnp.float32)))
             spec = specs[grp][n]
-            mp_sharded = any(d == MP_AXIS or (isinstance(d, tuple) and MP_AXIS in d)
-                             for d in spec)
-            if mp_sharded and has_mp:
-                s = lax.psum(s, MP_AXIS)
+            for ax in (MP_AXIS, EP_AXIS):
+                if _spec_has(spec, ax) and ax in mesh_axes:
+                    s = lax.psum(s, ax)
             if grp == "stages":
                 s = lax.psum(s, PP_AXIS)  # each pp rank owns distinct layers
             sumsq = sumsq + s
@@ -389,8 +711,36 @@ def _clip_grads_meshaware(clip, grads, pipe, has_mp):
         lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
 
 
+def _decay_masks(pipe, optimizer):
+    """Per-leaf weight-decay applicability (AdamW apply_decay_param_fun,
+    python/paddle/optimizer/adamw.py _append_decoupled_weight_decay): the
+    stacked leaves of every (stage, chunk) instance share one decision,
+    taken from the slot template's Parameter (name + structure)."""
+    fn = getattr(optimizer, "_apply_decay_param_fun", None)
+    if fn is None:
+        return None
+    masks = {"stages": {}, "shared": {}}
+    kv = pipe.layers_per_chunk
+    if pipe._scan_body:
+        tmpl_params = dict(pipe.slot_templates[0].named_parameters())
+        for n in pipe.stage_params:
+            masks["stages"][n] = bool(fn(tmpl_params[n].name))
+    else:
+        for i, tmpl in enumerate(pipe.slot_templates):
+            tp = dict(tmpl.named_parameters())
+            for n in tp:
+                masks["stages"][f"slot{i}.{n}"] = bool(fn(tp[n].name))
+    shared_tensors = getattr(pipe, "_shared_param_tensors", None)
+    for n in pipe.shared_params:
+        pname = None
+        if shared_tensors and n in shared_tensors:
+            pname = shared_tensors[n].name
+        masks["shared"][n] = bool(fn(pname)) if pname is not None else bool(fn(n))
+    return masks
+
+
 def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
-                   has_mp):
+                   mesh_axes):
     """Optimizer apply with ZeRO-2 semantics over 'sharding': reduce-scatter
     each (flattened) grad, update the local slot slice, all-gather params.
     Runs inside the shard_map body. Parity: sharding_optimizer.py grad
@@ -405,19 +755,25 @@ def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
             grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, SH_AXIS), grads)
             scatter = False
             sliced = True
-        grads = _clip_grads_meshaware(clip, grads, pipe, has_mp)
+        grads = _clip_grads_meshaware(clip, grads, pipe, mesh_axes)
 
     wd = optimizer._weight_decay_coeff
     decoupled = optimizer._decoupled_wd
     hyper = optimizer._hyper()
+    decay_masks = _decay_masks(pipe, optimizer)
     lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
     step = opt_state["step"] + 1
     upd = type(optimizer)._update
 
-    def leaf(p, g, slots):
+    def leaf(p, g, slots, decay_ok):
         g = g.astype(p.dtype)
-        if wd and not decoupled:
-            g = g + wd * p
+        leaf_wd = wd if decay_ok else 0.0
+        leaf_hyper = hyper
+        if not decay_ok and decoupled and len(hyper) == 4:
+            # AdamW packs wd as hyper[3]; zero it for no-decay leaves
+            leaf_hyper = hyper[:3] + (0.0,)
+        if leaf_wd and not decoupled:
+            g = g + leaf_wd * p
         size = p.size
         sz = -(-size // n_shard)
         pad = sz * n_shard - size
@@ -432,10 +788,11 @@ def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
                     gf, (lax.axis_index(SH_AXIS) * sz,), (sz,))
             pf = jnp.pad(p.reshape(-1), (0, pad))
             pl = lax.dynamic_slice(pf, (lax.axis_index(SH_AXIS) * sz,), (sz,))
-            pn, sn = upd(pl, gl, sl, lr, step, hyper)
+            pn, sn = upd(pl, gl, sl, lr, step, leaf_hyper)
             pnew = lax.all_gather(pn, SH_AXIS, tiled=True)[:size].reshape(p.shape)
         else:
-            pn, sn = upd(jnp.pad(p.reshape(-1), (0, pad)), gf, sl, lr, step, hyper)
+            pn, sn = upd(jnp.pad(p.reshape(-1), (0, pad)), gf, sl, lr, step,
+                         leaf_hyper)
             pnew = pn[:size].reshape(p.shape)
         return pnew, {k: v.reshape(slots[k].shape) for k, v in sn.items()}
 
@@ -445,35 +802,26 @@ def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
         new_p[grp] = {}
         new_s[grp] = {}
         for n in params[grp]:
+            decay_ok = True if decay_masks is None else decay_masks[grp][n]
             pn, sn = leaf(params[grp][n], grads[grp][n],
-                          opt_state["slots"][grp][n])
+                          opt_state["slots"][grp][n], decay_ok)
             new_p[grp][n] = pn
             new_s[grp][n] = sn
     return new_p, {"slots": new_s, "step": step}
 
 
-def build_gpt_pipeline_step(model, optimizer, *, microbatches: int,
-                            num_stages: Optional[int] = None, mesh=None):
-    """Build the jitted hybrid train step for a GPT model: pp x mp x dp x
-    sharding composed in ONE shard_map program (the reference's north-star
-    hybrid, sharding_optimizer.py:140 degrees assertion).
-
-    The mesh may carry any subset of {'pp' (required), 'mp', 'dp',
-    'sharding'} with degree > 1. Batch dim 0 is sharded over
-    dp x sharding; per-param hyper overrides (AdamW apply_decay_param_fun)
-    are not applied on this path.
-
-    Returns a callable ``step(x, y) -> loss`` holding sharded params +
-    optimizer state; ``step.sync_to_model()`` writes arrays back.
-    """
-    mesh = mesh or get_mesh()
-    if mesh is None or PP_AXIS not in mesh.shape:
-        raise RuntimeError("pipeline step needs a mesh with a 'pp' axis")
-    num_stages = num_stages or int(mesh.shape[PP_AXIS])
-    pipe = GPTPipelineModule(model, num_stages, microbatches, mesh=mesh)
+def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
+    """Assemble the jitted hybrid train step for any PipelineModule:
+    pp x mp x ep x dp x sharding composed in ONE shard_map program (the
+    reference's north-star hybrid, sharding_optimizer.py:140 degrees
+    assertion). ``compute_dtype`` (e.g. bfloat16) casts floating params
+    inside the loss so the MXU runs bf16 while masters/grads stay f32 (AMP
+    O2 master-weight pattern)."""
     has_dp = DP_AXIS in mesh.shape and int(mesh.shape[DP_AXIS]) > 1
     has_sh = SH_AXIS in mesh.shape and int(mesh.shape[SH_AXIS]) > 1
+    has_ep = EP_AXIS in mesh.shape and int(mesh.shape[EP_AXIS]) > 1
     n_shard = int(mesh.shape.get(SH_AXIS, 1))
+    mesh_axes = set(mesh.shape)
 
     param_specs = {"stages": pipe.stage_specs, "shared": pipe.shared_specs}
     params = {
@@ -499,6 +847,10 @@ def build_gpt_pipeline_step(model, optimizer, *, microbatches: int,
         key = jax.random.wrap_key_data(kd)
 
         def loss_fn(params):
+            if compute_dtype is not None:
+                params = jax.tree_util.tree_map(
+                    lambda a: a.astype(compute_dtype)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
             return pipe.local_loss(params["stages"], params["shared"], x, y, key)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -516,11 +868,21 @@ def build_gpt_pipeline_step(model, optimizer, *, microbatches: int,
             grads = jax.tree_util.tree_map(
                 lambda g: lax.pmean(g, DP_AXIS), grads)
             loss = lax.pmean(loss, DP_AXIS)
+        if has_ep:
+            # batch is sharded over 'ep' too: dense (ep-replicated) params
+            # need their grads combined; expert-sharded leaves already got
+            # their cross-rank contributions through the all_to_all transpose
+            for grp, specs in (("stages", pipe.stage_specs),
+                               ("shared", pipe.shared_specs)):
+                for n, g in grads[grp].items():
+                    if not _spec_has(specs[n], EP_AXIS):
+                        grads[grp][n] = lax.pmean(g, EP_AXIS)
+            loss = lax.pmean(loss, EP_AXIS)
         if has_sh:
             loss = lax.pmean(loss, SH_AXIS)
         new_params, new_opt = _apply_updates(
             optimizer, params, grads, local_opt, n_shard, has_sh, pipe,
-            pipe.has_mp)
+            mesh_axes)
         # restore the [1, 1, 1, sz] layout for the out specs
         new_opt = {
             "slots": jax.tree_util.tree_map(
@@ -530,7 +892,8 @@ def build_gpt_pipeline_step(model, optimizer, *, microbatches: int,
         return new_params, new_opt, loss
 
     opt_prefix = {"slots": slot_specs, "step": P()}
-    data_axes = tuple(a for a in (DP_AXIS, SH_AXIS) if a in mesh.shape)
+    data_axes = tuple(a for a in (DP_AXIS, SH_AXIS, EP_AXIS)
+                      if a in mesh.shape)
     data_spec = P(data_axes) if data_axes else P()
 
     from jax import shard_map
@@ -560,3 +923,51 @@ def build_gpt_pipeline_step(model, optimizer, *, microbatches: int,
     step.sync_to_model = lambda: pipe.sync_to_model(
         state["params"]["stages"], state["params"]["shared"])
     return step
+
+
+def build_gpt_pipeline_step(model, optimizer, *, microbatches: int,
+                            num_stages: Optional[int] = None, mesh=None,
+                            num_virtual_stages: int = 1, compute_dtype=None):
+    """Build the jitted hybrid train step for a GPT model over a mesh with
+    any subset of {'pp' (required), 'mp', 'ep', 'dp', 'sharding'} axes.
+    Batch dim 0 is sharded over dp x sharding x ep. Per-param AdamW decay
+    overrides (apply_decay_param_fun) are honored.
+
+    Returns a callable ``step(x, y) -> loss`` holding sharded params +
+    optimizer state; ``step.sync_to_model()`` writes arrays back.
+    """
+    mesh = mesh or get_mesh()
+    if mesh is None or PP_AXIS not in mesh.shape:
+        raise RuntimeError("pipeline step needs a mesh with a 'pp' axis")
+    num_stages = num_stages or int(mesh.shape[PP_AXIS])
+    pipe = GPTPipelineModule(model, num_stages, microbatches, mesh=mesh,
+                             num_virtual_stages=num_virtual_stages)
+    # shared leaves ↔ live Parameters (decay-mask naming)
+    emb = model.gpt.embeddings
+    pipe._shared_param_tensors = {
+        "wte": emb.word_embeddings.weight,
+        "ln_f.weight": model.gpt.ln_f.weight,
+        "ln_f.bias": model.gpt.ln_f.bias,
+    }
+    if "wpe" in pipe.shared_params:
+        pipe._shared_param_tensors["wpe"] = emb.position_embeddings.weight
+    return _build_pipeline_step(pipe, optimizer, mesh, compute_dtype)
+
+
+def build_pipeline_layer_step(pipe_layer, optimizer, *, microbatches: int,
+                              num_stages: Optional[int] = None, mesh=None,
+                              num_virtual_stages: int = 1, loss_fn=None,
+                              compute_dtype=None):
+    """Real stage-parallel step for a generic ``PipelineLayer``: the
+    structurally-uniform body rotates over 'pp' (ppermute-scan), edge layers
+    run pp-replicated with psum'd grads. Raises ValueError when no uniform
+    body of >= stages x virtual-chunks layers exists (callers should fall
+    back to the GSPMD step loudly)."""
+    mesh = mesh or get_mesh()
+    if mesh is None or PP_AXIS not in mesh.shape:
+        raise RuntimeError("pipeline step needs a mesh with a 'pp' axis")
+    num_stages = num_stages or int(mesh.shape[PP_AXIS])
+    pipe = _LayerStackPipelineModule(
+        pipe_layer, num_stages, microbatches, mesh=mesh,
+        num_virtual_stages=num_virtual_stages, loss_fn=loss_fn)
+    return _build_pipeline_step(pipe, optimizer, mesh, compute_dtype)

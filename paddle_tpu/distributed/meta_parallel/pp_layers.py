@@ -52,6 +52,7 @@ class PipelineLayer(Layer):
         self._loss_fn = loss_fn
         self._seg_method = seg_method
         self._recompute_interval = recompute_interval
+        self._num_virtual_pipeline_stages = max(int(num_virtual_pipeline_stages or 1), 1)
         self._topo = topology
         if num_stages is None and topology is not None:
             num_stages = topology.get_dim("pipe")

@@ -112,12 +112,181 @@ def _ring_attention_raw(q, k, v, axis_name: str, causal: bool, sm_scale: Optiona
     return out.astype(orig_dtype)
 
 
+_RING_NEG = -1e30  # finite -inf stand-in: keeps the cross-hop merge NaN-free
+
+
+def _ring_hop_specs(t_loc: int, d: int):
+    from ...ops.pallas.flash_attention import _fit
+
+    block_q = _fit(t_loc, 1024)
+    block_k = _fit(t_loc, 1024 if d < 128 else 512)
+    return block_q, block_k
+
+
+def _hop_kind(my, src, causal):
+    """0 = fully masked (future block), 1 = diagonal (local causal),
+    2 = fully visible (past block)."""
+    if not causal:
+        return None
+    return jnp.where(src == my, 1, jnp.where(src < my, 2, 0)).astype(jnp.int32)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, causal, scale, block_q, block_k, interpret):
+    o, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q,
+                           block_k, interpret)
+    return o
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
+                    interpret):
+    """Per-hop Pallas flash kernels + online cross-hop merge: each hop
+    produces (o_hop, lse_hop) for one rotating K/V block; partial softmaxes
+    combine exactly via logaddexp — O(T_loc) memory, no [T, T] logits."""
+    from ...ops.pallas.flash_attention import _fwd
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    bh, t_loc, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    o_run = jnp.zeros((bh, t_loc, d), jnp.float32)
+    lse_run = jnp.full((bh, t_loc), _RING_NEG, jnp.float32)
+    k_blk, v_blk = k, v
+    for i in range(n):
+        src = (my - i) % n
+        kind = _hop_kind(my, src, causal)
+
+        def full_hop(q, kb, vb):
+            o, lse = _fwd(q, kb, vb, scale, False, block_q, block_k, interpret)
+            return o.astype(jnp.float32), lse
+
+        def diag_hop(q, kb, vb):
+            o, lse = _fwd(q, kb, vb, scale, True, block_q, block_k, interpret)
+            return o.astype(jnp.float32), lse
+
+        def masked_hop(q, kb, vb):
+            return (jnp.zeros((bh, t_loc, d), jnp.float32),
+                    jnp.full((bh, t_loc), _RING_NEG, jnp.float32))
+
+        if kind is None:
+            o_hop, lse_hop = full_hop(q, k_blk, v_blk)
+        else:
+            o_hop, lse_hop = lax.switch(
+                kind, [masked_hop, diag_hop, full_hop], q, k_blk, v_blk)
+        lse_new = jnp.logaddexp(lse_run, lse_hop)
+        # guard: rows with nothing visible yet keep lse at the finite floor
+        lse_new = jnp.maximum(lse_new, _RING_NEG)
+        o_run = (o_run * jnp.exp(lse_run - lse_new)[..., None]
+                 + o_hop * jnp.exp(lse_hop - lse_new)[..., None])
+        lse_run = lse_new
+        if i + 1 < n:
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+    out = o_run.astype(q.dtype)
+    return out, (q, k, v, out, lse_run)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, block_q, block_k, interpret,
+                    res, do):
+    """Ring backward: re-rotate K/V, run the flash backward kernels per hop
+    with the GLOBAL lse/delta (standard blockwise flash backward), and
+    rotate the dK/dV accumulators alongside so each lands back on its
+    owner after n hops."""
+    from ...ops.pallas.flash_attention import _bwd
+
+    q, k, v, o, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk_acc = jnp.zeros(k.shape, jnp.float32)
+    dv_acc = jnp.zeros(v.shape, jnp.float32)
+    k_blk, v_blk = k, v
+    for i in range(n):
+        src = (my - i) % n
+        kind = _hop_kind(my, src, causal)
+
+        def full_hop(q, kb, vb, o, lse, do):
+            return _bwd(scale, False, block_q, block_k, interpret,
+                        (q, kb, vb, o, lse), do)
+
+        def diag_hop(q, kb, vb, o, lse, do):
+            return _bwd(scale, True, block_q, block_k, interpret,
+                        (q, kb, vb, o, lse), do)
+
+        def masked_hop(q, kb, vb, o, lse, do):
+            return (jnp.zeros(q.shape, q.dtype), jnp.zeros(kb.shape, kb.dtype),
+                    jnp.zeros(vb.shape, vb.dtype))
+
+        if kind is None:
+            dq_h, dk_h, dv_h = full_hop(q, k_blk, v_blk, o, lse, do)
+        else:
+            dq_h, dk_h, dv_h = lax.switch(
+                kind, [masked_hop, diag_hop, full_hop],
+                q, k_blk, v_blk, o, lse, do)
+        dq = dq + dq_h.astype(jnp.float32)
+        dk_acc = dk_acc + dk_h.astype(jnp.float32)
+        dv_acc = dv_acc + dv_h.astype(jnp.float32)
+        # rotate K/V and their grad accumulators together; after the final
+        # rotation every accumulator is back on its owner rank
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+    return dq.astype(q.dtype), dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def _ring_attention_flash(q, k, v, axis_name, causal, sm_scale, interpret):
+    """[B, H, T_loc, D] wrapper: head-fold, lane-pad D, pick blocks."""
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    b, h, t_loc, d = q.shape
+    d_pad = (-d) % 64
+    if d_pad:
+        pad = [(0, 0)] * 3 + [(0, d_pad)]
+        q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
+    qf = q.reshape(b * h, t_loc, d + d_pad)
+    kf = k.reshape(b * h, t_loc, d + d_pad)
+    vf = v.reshape(b * h, t_loc, d + d_pad)
+    block_q, block_k = _ring_hop_specs(t_loc, d + d_pad)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out = _ring_flash(qf, kf, vf, axis_name, causal, float(scale),
+                      block_q, block_k, bool(interpret))
+    out = out.reshape(b, h, t_loc, d + d_pad)
+    return out[..., :d] if d_pad else out
+
+
+def _ring_use_flash(t_loc: int) -> bool:
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        on_tpu = False
+    return on_tpu and t_loc % 128 == 0 and t_loc >= 256
+
+
 def ring_attention(q, k, v, *, axis_name: str = SP_AXIS, causal: bool = False,
-                   sm_scale: Optional[float] = None):
-    """Exact attention over the ring-sharded sequence. Eager/taped wrapper."""
+                   sm_scale: Optional[float] = None,
+                   use_flash: Optional[bool] = None,
+                   interpret: Optional[bool] = None):
+    """Exact attention over the ring-sharded sequence. Eager/taped wrapper.
+
+    On TPU with 128-aligned shard lengths each ring hop runs the Pallas
+    flash kernel (O(T_loc) memory — no [T_loc, T_loc] logits); other shapes
+    use the einsum online-softmax fallback."""
+
+    t_loc = unwrap(q).shape[-2]
+    flash = _ring_use_flash(t_loc) if use_flash is None else use_flash
 
     @primitive
     def _ring(q, k, v):
+        if flash:
+            return _ring_attention_flash(q, k, v, axis_name, causal,
+                                         sm_scale, interpret)
         return _ring_attention_raw(q, k, v, axis_name, causal, sm_scale)
 
     return _ring(q, k, v)

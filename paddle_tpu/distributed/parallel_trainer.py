@@ -455,10 +455,39 @@ class ParallelTrainer:
 
 
 def build_pipeline_step(pipe_layer, hcg, optimizer, accumulate_steps: int = 1, scaler=None):
-    """General PipelineLayer train step: microbatch accumulation over the
-    full stage sequence under GSPMD (correct for any segmentation). The
-    ppermute-scan pipeline for uniform decoder stacks lives with the GPT
-    flagship (models.gpt.build_gpt_pipeline_step)."""
+    """PipelineLayer train step. On a mesh with pp > 1 this builds the REAL
+    ppermute-scan stage-parallel program
+    (meta_parallel.pipeline_schedule.build_pipeline_layer_step); when the
+    layer stack has no pipelineable uniform body, it falls back LOUDLY to
+    microbatch accumulation over the full stage sequence under GSPMD
+    (correct semantics, no stage parallelism)."""
+    mesh = get_mesh()
+    pp_degree = int(mesh.shape.get("pp", 1)) if mesh is not None else 1
+    if pp_degree > 1 and scaler is None:
+        from .meta_parallel.pipeline_schedule import build_pipeline_layer_step
+
+        n_virtual = int(getattr(pipe_layer, "_num_virtual_pipeline_stages", 1) or 1)
+        try:
+            step = build_pipeline_layer_step(
+                pipe_layer, optimizer,
+                microbatches=max(accumulate_steps, 1),
+                num_virtual_stages=n_virtual, mesh=mesh)
+        except ValueError as e:
+            import warnings
+
+            warnings.warn(
+                f"PipelineParallel: falling back to the NON-pipelined GSPMD "
+                f"step ({e}); pp={pp_degree} will not overlap stages",
+                RuntimeWarning, stacklevel=2)
+        else:
+            # no per-step sync: copying every sharded weight back into the
+            # eager Tensors each step would serialize against the jitted
+            # step — PipelineParallel syncs lazily before eval/state_dict
+            def run(x, y):
+                return Tensor(step(x, y))
+
+            run._pipeline_step = step
+            return run
     loss_fn = pipe_layer._loss_fn or (lambda out, y: out.mean())
     trainer = ParallelTrainer(
         pipe_layer,

@@ -131,8 +131,9 @@ register_flag("FLAGS_cudnn_deterministic", False,
               on_change=_on_deterministic)
 register_flag("FLAGS_use_pallas_attention", True,
               "route nn attention through the Pallas flash kernel on TPU")
-register_flag("FLAGS_eager_layer_jit", True,
-              "transparently jit-cache per-Layer forwards in dygraph mode")
+register_flag("FLAGS_eager_layer_jit", "true", type=str,
+              help="transparently jit-cache per-Layer forwards in dygraph "
+                   "mode: true (TPU only) | force (any backend) | false")
 register_flag("FLAGS_allocator_strategy", "auto_growth",
               "host pinned-pool strategy: auto_growth | naive_best_fit")
 register_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92,

@@ -48,9 +48,17 @@ class Model:
         self._save_dir = None
 
     # ------------------------------------------------------------------
-    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
+                strategy=None):
+        """``strategy``: a fleet ``DistributedStrategy`` (or True to
+        auto-detect the installed mesh) — swaps the inner train loop to the
+        jitted multi-device ``ParallelTrainer`` step (parity: the
+        reference's dist-hapi path, hapi/model.py:906 _strategy plumbing)."""
         self._optimizer = optimizer
         self._loss = loss
+        self._strategy = strategy
+        self._dist_trainer = None
+        self._dist_failed = False
         ms = _to_list(metrics)
         for m in ms:
             assert isinstance(m, Metric), f"metrics must be Metric, got {type(m)}"
@@ -99,11 +107,73 @@ class Model:
                 return self.network(*ins)
         return self.network(*ins)
 
+    def _maybe_dist_trainer(self):
+        """Build (once) the multi-device jitted step when a strategy or a
+        >1-device mesh is present and the configuration routes cleanly
+        (single input/label, scalar callable loss, no per-batch metrics)."""
+        if self._dist_trainer is not None:
+            return self._dist_trainer
+        if self._dist_failed or getattr(self, "_strategy", None) is None:
+            return None
+        if self._metrics or isinstance(self._loss, (list, tuple)) \
+                or not callable(self._loss):
+            import warnings
+
+            warnings.warn(
+                "Model.prepare(strategy=...): metrics / per-output loss "
+                "lists need per-batch outputs — falling back to the eager "
+                "loop", RuntimeWarning, stacklevel=3)
+            self._dist_failed = True
+            return None
+        from ..distributed.env import get_mesh
+        from ..distributed.parallel_trainer import ParallelTrainer
+
+        if get_mesh() is None:
+            import warnings
+
+            warnings.warn(
+                "Model.prepare(strategy=...) needs an installed mesh "
+                "(fleet.init / init_mesh) — falling back to the eager loop",
+                RuntimeWarning, stacklevel=3)
+            self._dist_failed = True
+            return None
+        strategy = None if self._strategy is True else self._strategy
+        loss_fn = self._loss
+        self._dist_trainer = ParallelTrainer(
+            self.network, lambda out, y: loss_fn(out, y), self._optimizer,
+            strategy=strategy,
+            compute_dtype="bfloat16" if self._amp_level in ("O1", "O2") else None,
+        )
+        return self._dist_trainer
+
+    def _dist_sync(self):
+        tr = getattr(self, "_dist_trainer", None)
+        if tr is not None:
+            tr.sync_to_model()
+
     def train_batch(self, inputs, labels=None, update=True):
         """One eager train step; returns [loss] (+ metric results)."""
         self.network.train()
         ins = [_to_tensor(x) for x in _to_list(inputs)]
         lbls = [_to_tensor(x) for x in _to_list(labels)]
+        routable = update and len(ins) == 1 and len(lbls) == 1
+        trainer = self._maybe_dist_trainer() if routable else None
+        if trainer is not None:
+            loss = trainer.step(ins[0], lbls[0])
+            return [float(np.asarray(loss._data))]
+        if not routable and getattr(self, "_dist_trainer", None) is not None:
+            # a trainer exists from earlier single-input steps but this call
+            # can't route: sync its progress back and retire it so a later
+            # _dist_sync can't clobber the eager training done from here on
+            import warnings
+
+            warnings.warn(
+                "Model.train_batch: multi-input/label batch cannot route "
+                "through the distributed trainer — continuing on the eager "
+                "loop", RuntimeWarning, stacklevel=2)
+            self._dist_sync()
+            self._dist_trainer = None
+            self._dist_failed = True
         outputs = self._forward(ins)
         loss = self._compute_loss(outputs, lbls)
         loss.backward()
@@ -119,6 +189,7 @@ class Model:
     def eval_batch(self, inputs, labels=None):
         from ..autograd import tape
 
+        self._dist_sync()  # trained shards -> eager weights
         self.network.eval()
         ins = [_to_tensor(x) for x in _to_list(inputs)]
         lbls = [_to_tensor(x) for x in _to_list(labels)]
@@ -135,6 +206,7 @@ class Model:
     def predict_batch(self, inputs):
         from ..autograd import tape
 
+        self._dist_sync()
         self.network.eval()
         ins = [_to_tensor(x) for x in _to_list(inputs)]
         with tape.no_grad():
@@ -205,6 +277,7 @@ class Model:
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_loader, batch_size=batch_size, verbose=0,
                               callbacks=cbks)
+        self._dist_sync()  # leave the eager weights trained
         cbks.on_train_end(logs if "logs" in dir() else None)
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
@@ -281,6 +354,7 @@ class Model:
         (model.py:1265). training=False: inference export through jit.save
         (StableHLO program + params — the reference's save_inference_model
         leg), using the declared ``inputs`` InputSpec."""
+        self._dist_sync()
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)

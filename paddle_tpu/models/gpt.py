@@ -60,6 +60,12 @@ class GPTConfig:
     attention_dropout_prob: float = 0.1
     layer_norm_epsilon: float = 1e-5
     use_recompute: bool = False
+    # remat policy (PaddleNLP recompute_granularity analog): 'full' remats
+    # the whole block (min memory, ~4/3x fwd flops); 'selective' keeps
+    # weight-matmul outputs (jax dots_with_no_batch_dims_saveable) and only
+    # recomputes elementwise/attention internals — near-no-remat MFU at a
+    # fraction of the activation memory
+    recompute_granularity: str = "full"
     # MoE (ERNIE-MoE analog, BASELINE #5): 0 experts = dense model
     num_experts: int = 0
     moe_every: int = 2  # every moe_every-th block uses an MoE FFN
@@ -351,6 +357,7 @@ class GPTDecoderLayer(Layer):
         # remat of an MoE block would trap l_aux inside the checkpoint trace,
         # so MoE blocks always run un-rematerialized
         self._use_recompute = config.use_recompute and not self.is_moe
+        self._recompute_granularity = config.recompute_granularity
 
     def _block(self, x):
         x = x + self.dropout1(self.attn(self.ln_1(x)))
@@ -359,15 +366,21 @@ class GPTDecoderLayer(Layer):
 
     def forward(self, x):
         if self._use_recompute and self.training:
-            # recompute_optimizer parity: remat the whole block so XLA
-            # recomputes its activations during backward
+            # recompute_optimizer parity: remat the block so XLA recomputes
+            # activations during backward; 'selective' granularity saves
+            # weight-matmul outputs so only cheap elementwise work reruns
             import jax
 
             from ..ops._primitive import primitive
 
+            if self._recompute_granularity == "selective":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            else:
+                policy = None
+
             @primitive
             def _remat(h):
-                return jax.checkpoint(self._raw_block)(h)
+                return jax.checkpoint(self._raw_block, policy=policy)(h)
 
             return _remat(x)
         return self._block(x)

@@ -39,8 +39,14 @@ def _use_flash(q, k, dropout_p, need_weights, attn_mask, is_causal):
         return False
     T, S, D = q.shape[-2], k.shape[-2], q.shape[-1]
     # D=64 is viable since the whole-sequence-block layout (v5e-measured:
-    # beats the XLA einsum path at B8 H16 T1024 D64 — see flash_attention)
-    return T >= _FLASH_MIN_SEQ and S >= _FLASH_MIN_SEQ and D % 64 == 0 and T % 128 == 0 and S % 128 == 0
+    # beats the XLA einsum path at B8 H16 T1024 D64 — see flash_attention);
+    # non-64-multiple D (e.g. 760M's 96) is zero-padded by the kernel
+    # wrapper, and ragged causal T==S is tail-padded exactly (masked keys)
+    if T < _FLASH_MIN_SEQ or S < _FLASH_MIN_SEQ or D < 32:
+        return False
+    if T % 128 == 0 and S % 128 == 0:
+        return True
+    return bool(is_causal) and T == S
 
 
 def scaled_dot_product_attention(

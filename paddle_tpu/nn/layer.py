@@ -87,6 +87,10 @@ class Layer:
                 raise RuntimeError("call Layer.__init__ before assigning sublayers")
             subs[name] = value
             self.__dict__.pop(name, None)
+            # structure changed: drop the eager-jit caches (sublayer walk +
+            # traced closures may be stale)
+            self.__dict__.pop("_jit_sub_cache", None)
+            self.__dict__.pop("_eager_jit_cache", None)
         else:
             if params is not None and name in params:
                 if value is None:
@@ -120,6 +124,8 @@ class Layer:
 
     def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
         self._sub_layers[str(name)] = sublayer
+        self.__dict__.pop("_jit_sub_cache", None)
+        self.__dict__.pop("_eager_jit_cache", None)
         return sublayer
 
     def add_parameter(self, name: str, parameter: Optional[Parameter]) -> Optional[Parameter]:
@@ -182,7 +188,10 @@ class Layer:
             out = hook(self, inputs)
             if out is not None:
                 inputs = out if isinstance(out, tuple) else (out,)
-        outputs = self.forward(*inputs, **kwargs)
+        if _jit_forward_applicable(self, inputs, kwargs):
+            outputs = _jit_forward_call(self, inputs)
+        else:
+            outputs = self.forward(*inputs, **kwargs)
         for hook in self._forward_post_hooks.values():
             out = hook(self, inputs, outputs)
             if out is not None:
@@ -509,3 +518,148 @@ class ParameterList(Layer):
 
     def __iter__(self):
         return iter(self._parameters.values())
+
+
+# ---------------------------------------------------------------------------
+# transparent per-layer jit caching for eager mode
+#
+# Parity: the reference's generated core.ops.* fast path
+# (/root/reference/paddle/fluid/pybind/op_function_generator.cc:551) — one
+# C-level call instead of per-op Python dispatch. TPU-native version: the
+# whole Layer.forward is traced ONCE into a jitted closure (keyed by layer
+# structure + input avals) and each eager call dispatches one XLA program
+# instead of one per op. Gradients still flow through the autograd tape: the
+# jitted forward is recorded as a single taped primitive whose vjp is the
+# compiled backward.
+#
+# Escape hatch: paddle.set_flags({"FLAGS_eager_layer_jit": False}). The
+# default (True) engages on TPU only — on CPU op-by-op dispatch is cheap and
+# tests exercise the un-jitted paths; the value "force" engages anywhere
+# (used by the parity tests).
+# ---------------------------------------------------------------------------
+_JIT_FORWARD_ACTIVE = False  # true while tracing a jitted layer forward
+
+
+def _eager_jit_mode():
+    from ..framework.flags import flag
+
+    v = str(flag("FLAGS_eager_layer_jit") or "").strip().lower()
+    if v == "force":
+        return "force"  # engage on any backend (parity tests)
+    if v in ("1", "true", "yes", "on"):
+        return True  # engage on TPU only
+    return None
+
+
+def _jit_forward_applicable(layer, inputs, kwargs) -> bool:
+    global _JIT_FORWARD_ACTIVE
+    if _JIT_FORWARD_ACTIVE:
+        return False
+    mode = _eager_jit_mode()
+    if mode is None:
+        return False
+    import paddle_tpu as _pd
+
+    if _pd._static_mode:
+        return False
+    if mode != "force":
+        import jax
+
+        try:
+            if jax.devices()[0].platform != "tpu":
+                return False
+        except RuntimeError:
+            return False
+    # only plain positional calls: every arg a Tensor or a hashable scalar
+    if kwargs:
+        return False
+    for x in inputs:
+        if isinstance(x, Tensor):
+            if not isinstance(x._data, jnp.ndarray):
+                return False  # static Variable / symbolic
+        elif not isinstance(x, (int, float, bool, str, type(None))):
+            return False
+    if not any(isinstance(x, Tensor) for x in inputs):
+        return False
+    return _jit_forward_supported(layer)
+
+
+def _jit_forward_supported(layer) -> bool:
+    """Structure gate: no exempt sublayers (MoE aux-loss side outputs), no
+    active generation caches, no floating (stats-like) buffers to write
+    back. The sublayer list is walked once and cached; registering a new
+    sublayer invalidates it (Layer.__setattr__/add_sublayer)."""
+    sub = layer.__dict__.get("_jit_sub_cache")
+    if sub is None:
+        sub = [l for _, l in layer.named_sublayers(include_self=True)]
+        layer.__dict__["_jit_sub_cache"] = sub
+    for l in sub:
+        if getattr(type(l), "_jit_forward_exempt", False):
+            return False
+        if "_gen_cache" in l.__dict__:
+            return False
+        for b in l._buffers.values():
+            if b is not None and jnp.issubdtype(b._data.dtype, jnp.floating):
+                return False
+    return True
+
+
+def _jit_forward_call(layer, inputs):
+    """Dispatch through the per-(training, amp, statics) cached jitted
+    closure; jax.jit's own aval cache handles input shapes/dtypes."""
+    global _JIT_FORWARD_ACTIVE
+    import jax
+
+    from ..amp.auto_cast import amp_state
+    from ..autograd import tape as _tape
+    from ..ops._primitive import primitive
+    from ..random import get_rng_state, set_rng_state, split_key
+
+    amp = amp_state()
+    statics = tuple(x if not isinstance(x, Tensor) else None for x in inputs)
+    key = (layer.training, bool(amp.enable), getattr(amp, "dtype", None),
+           getattr(amp, "level", None), statics, len(inputs))
+    cache = layer.__dict__.setdefault("_eager_jit_cache", {})
+    entry = cache.get(key)
+    if entry is None:
+        tensor_pos = [i for i, x in enumerate(inputs) if isinstance(x, Tensor)]
+        out_box = {}
+        # close over the NON-tensor args only (part of the cache key);
+        # closing over `inputs` would pin the first call's activations
+        static_args = list(statics)
+
+        def raw(ptree, btree, rng_key, *xs):
+            global _JIT_FORWARD_ACTIVE
+            args = list(static_args)
+            for i, a in zip(tensor_pos, xs):
+                args[i] = Tensor(a)
+            saved = get_rng_state()
+            set_rng_state(rng_key)
+            was = _JIT_FORWARD_ACTIVE
+            _JIT_FORWARD_ACTIVE = True
+            try:
+                with _tape.no_grad():
+                    out, _ = layer.functional_call_with_state(
+                        ptree, btree, *args)
+            finally:
+                _JIT_FORWARD_ACTIVE = was
+                set_rng_state(saved)
+            leaves, treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            leaves = [l._data if isinstance(l, Tensor) else l for l in leaves]
+            out_box["treedef"] = treedef
+            return tuple(leaves) if len(leaves) != 1 else leaves[0]
+
+        entry = (primitive(jax.jit(raw), name=f"jit:{type(layer).__name__}"),
+                 out_box, tensor_pos)
+        cache[key] = entry
+    wrapped, out_box, tensor_pos = entry
+
+    ptree = {n: p for n, p in layer.named_parameters()}
+    btree = {n: b._data for n, b in layer.named_buffers()}
+    rng_key = split_key()
+    out = wrapped(ptree, btree, rng_key,
+                  *[inputs[i] for i in tensor_pos])
+    treedef = out_box["treedef"]
+    leaves = list(out) if isinstance(out, tuple) else [out]
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -287,6 +287,17 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
 # ----------------------------------------------------------------------
 # public entry
 # ----------------------------------------------------------------------
+def _fit(n, cap):
+    """Largest 128-multiple <= cap dividing n (the kernels have no
+    tail-block masking, so blocks must divide the sequence)."""
+    if n % 128:
+        raise ValueError(f"flash attention needs T/S % 128 == 0, got {n}")
+    b = min(n, cap)
+    while n % b:
+        b -= 128
+    return b
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
@@ -322,15 +333,20 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
     t_len, d_head = q.shape[-2], q.shape[-1]
     s_len = k.shape[-2]
 
-    def _fit(n, cap):
-        # largest 128-multiple <= cap dividing n (the kernels have no
-        # tail-block masking, so blocks must divide the sequence)
-        if n % 128:
-            raise ValueError(f"flash attention needs T/S % 128 == 0, got {n}")
-        b = min(n, cap)
-        while n % b:
-            b -= 128
-        return b
+    # ragged (non-128-multiple) sequences, causal self-attention: right-pad
+    # Q/K/V with zeros to the next 128 multiple. Exact because (a) padded
+    # KEYS sit at positions >= the real length, so the causal mask hides
+    # them from every real query; (b) padded QUERY rows are sliced from the
+    # output, so their cotangent is zero and they contribute nothing to
+    # dK/dV. Non-causal ragged shapes fall back to the XLA path upstream.
+    t_pad = 0
+    if t_len % 128 and causal and t_len == s_len:
+        t_pad = (-t_len) % 128
+        pad = [(0, 0)] * (q.ndim - 2) + [(0, t_pad), (0, 0)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+        t_len = s_len = t_len + t_pad
 
     if block_q is None:
         block_q = _fit(t_len, 1024)
@@ -345,6 +361,17 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
+    # lane-pad the head dim to a 64-multiple (e.g. GPT-3 760M's D=96):
+    # zero columns leave q.k^T and the value matmul exact, and the padded
+    # output/grad columns are sliced away (dv/dk/dq grads of zero columns
+    # are zero, so the custom vjp stays exact)
+    d_pad = (-d_head) % 64
+    if d_pad:
+        pad = [(0, 0)] * (q.ndim - 1) + [(0, d_pad)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+
     squeeze4 = q.ndim == 4
     if squeeze4:
         b, h, t, d = q.shape
@@ -356,4 +383,8 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
                int(block_q), int(block_k), bool(interpret))
     if squeeze4:
         o = o.reshape(b, h, t, d)
+    if d_pad:
+        o = o[..., :d_head]
+    if t_pad:
+        o = o[..., : t_len - t_pad, :]
     return o

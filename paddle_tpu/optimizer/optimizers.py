@@ -69,9 +69,12 @@ class Adam(Optimizer):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, name=None, **kw):
+                 multi_precision=False, name=None, moment_dtype="float32", **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, multi_precision)
         self._beta1, self._beta2, self._epsilon = float(beta1), float(beta2), float(epsilon)
+        # bf16 moments halve optimizer-state HBM (update math stays f32;
+        # the slot dtype drives the cast in _update)
+        self._moment_dtype = jnp.dtype(moment_dtype)
 
     def _hyper(self):
         return (self._beta1, self._beta2, self._epsilon, 0.0)
@@ -80,8 +83,8 @@ class Adam(Optimizer):
     def _update(p, g, slots, lr, step, hyper):
         b1, b2, eps, wd = hyper
         g32 = g.astype(jnp.float32)
-        m = b1 * slots["moment1"] + (1 - b1) * g32
-        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g32)
+        m = b1 * slots["moment1"].astype(jnp.float32) + (1 - b1) * g32
+        v = b2 * slots["moment2"].astype(jnp.float32) + (1 - b2) * jnp.square(g32)
         t = step.astype(jnp.float32)
         mhat = m / (1 - b1**t)
         vhat = v / (1 - b2**t)
@@ -89,10 +92,12 @@ class Adam(Optimizer):
         if wd:
             upd = upd + wd * p.astype(jnp.float32)
         p_new = (p.astype(jnp.float32) - lr * upd).astype(p.dtype)
-        return p_new, {"moment1": m, "moment2": v}
+        return p_new, {"moment1": m.astype(slots["moment1"].dtype),
+                       "moment2": v.astype(slots["moment2"].dtype)}
 
     def _init_slots(self, param_arr):
-        return {n: jnp.zeros(param_arr.shape, jnp.float32) for n in self._slot_names}
+        return {n: jnp.zeros(param_arr.shape, self._moment_dtype)
+                for n in self._slot_names}
 
 
 class AdamW(Adam):
@@ -103,9 +108,10 @@ class AdamW(Adam):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
-                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None, **kw):
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None,
+                 moment_dtype="float32", **kw):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip,
-                         lazy_mode, multi_precision, name)
+                         lazy_mode, multi_precision, name, moment_dtype=moment_dtype)
         self._wd = float(weight_decay) if isinstance(weight_decay, (int, float)) else 0.01
         self._apply_decay_param_fun = apply_decay_param_fun
 

