@@ -103,6 +103,47 @@ def _train_tput(name, batch, seq, steps, warmup, on_tpu, recompute=False,
     return batch * seq * steps / dt, n_params, cfg
 
 
+def _pipeline_tput(name, batch, seq, steps=5, reps=3):
+    """tokens/s of the ppermute-scan hybrid step on a pp=1 mesh (exercises
+    the scan/slice/clip machinery; overhead vs the plain step is the BENCH
+    secondary VERDICT r2 #5 asked for)."""
+    import gc
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.distributed.meta_parallel.pipeline_schedule import (
+        build_gpt_pipeline_step,
+    )
+    from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+    from paddle_tpu.optimizer.optimizers import AdamW
+
+    cfg = gpt_config(name, hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(0)
+    clear_mesh()
+    gc.collect()
+    init_mesh({"pp": 1})
+    model = GPTForPretraining(cfg)
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                moment_dtype="bfloat16")
+    step = build_gpt_pipeline_step(model, opt, microbatches=2,
+                                   compute_dtype="bfloat16",
+                                   remat_policy="selective")
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32")
+    float(np.asarray(step(ids, ids)))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(ids, ids)
+        float(np.asarray(loss))
+        times.append(time.perf_counter() - t0)
+    med = sorted(times)[len(times) // 2]
+    del step, model
+    gc.collect()
+    return batch * seq * steps / med
+
+
 def _eager_jit_speedup():
     """Eager GPT-block fwd+bwd: op-by-op dispatch vs the transparent
     per-layer jit cache (FLAGS_eager_layer_jit) — SURVEY §7 hard-part 4."""
@@ -188,6 +229,14 @@ def main():
                 _eager_jit_speedup(), 2)
         except Exception as e:  # pragma: no cover - device dependent
             secondary["eager_layer_jit_block_speedup"] = f"failed: {type(e).__name__}"
+        try:
+            tp = _pipeline_tput("gpt3-350m", 8, seq)
+            secondary["pipeline_step_tokens_per_sec"] = round(tp, 2)
+            if isinstance(secondary.get("gpt3_350m_tokens_per_sec_chip"), float):
+                secondary["pipeline_step_overhead"] = round(
+                    secondary["gpt3_350m_tokens_per_sec_chip"] / tp - 1, 4)
+        except Exception as e:  # pragma: no cover - device dependent
+            secondary["pipeline_step_tokens_per_sec"] = f"failed: {type(e).__name__}"
     else:
         seq, steps, warmup = 32, 3, 1
         tput, n_params, cfg = _train_tput("gpt2-small", 4, seq, steps, warmup, False)

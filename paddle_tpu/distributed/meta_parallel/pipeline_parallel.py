@@ -100,6 +100,7 @@ class PipelineParallel(Layer):
                 scaler=scaler,
             )
         loss = self._train_step_fn(x, y)
+        self._pipe_dirty = True
         if lr_scheduler is not None:
             lr_scheduler.step()
         self.total_loss = loss
@@ -107,11 +108,15 @@ class PipelineParallel(Layer):
 
     def _sync_from_pipeline(self):
         """Write the trained sharded params back into the eager Tensors
-        (lazy: only before reads — eval/state_dict — not every step)."""
+        (lazy: only before reads — eval/state_dict — and only when a train
+        step ran since the last sync)."""
+        if not getattr(self, "_pipe_dirty", False):
+            return
         fn = self._train_step_fn
         step = getattr(fn, "_pipeline_step", None)
         if step is not None:
             step.sync_to_model()
+        self._pipe_dirty = False
 
     def eval_batch(self, data, compute_loss: bool = True):
         self._sync_from_pipeline()

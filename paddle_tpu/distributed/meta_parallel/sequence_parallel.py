@@ -229,10 +229,12 @@ def _ring_flash_bwd(axis_name, causal, scale, block_q, block_k, interpret,
         dq = dq + dq_h.astype(jnp.float32)
         dk_acc = dk_acc + dk_h.astype(jnp.float32)
         dv_acc = dv_acc + dv_h.astype(jnp.float32)
-        # rotate K/V and their grad accumulators together; after the final
-        # rotation every accumulator is back on its owner rank
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        # rotate the grad accumulators every hop (the final rotation lands
+        # each on its owner rank); K/V only need rotating while more hops
+        # will read them
+        if i + 1 < n:
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
         dk_acc = lax.ppermute(dk_acc, axis_name, perm)
         dv_acc = lax.ppermute(dv_acc, axis_name, perm)
     return dq.astype(q.dtype), dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)

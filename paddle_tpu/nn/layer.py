@@ -87,10 +87,10 @@ class Layer:
                 raise RuntimeError("call Layer.__init__ before assigning sublayers")
             subs[name] = value
             self.__dict__.pop(name, None)
-            # structure changed: drop the eager-jit caches (sublayer walk +
-            # traced closures may be stale)
-            self.__dict__.pop("_jit_sub_cache", None)
-            self.__dict__.pop("_eager_jit_cache", None)
+            # structure changed ANYWHERE: bump the global version so every
+            # layer's eager-jit caches (including ancestors whose cached
+            # sublayer walks contain this subtree) revalidate
+            _bump_structure_version()
         else:
             if params is not None and name in params:
                 if value is None:
@@ -124,8 +124,7 @@ class Layer:
 
     def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
         self._sub_layers[str(name)] = sublayer
-        self.__dict__.pop("_jit_sub_cache", None)
-        self.__dict__.pop("_eager_jit_cache", None)
+        _bump_structure_version()
         return sublayer
 
     def add_parameter(self, name: str, parameter: Optional[Parameter]) -> Optional[Parameter]:
@@ -538,6 +537,11 @@ class ParameterList(Layer):
 # (used by the parity tests).
 # ---------------------------------------------------------------------------
 _JIT_FORWARD_ACTIVE = False  # true while tracing a jitted layer forward
+_STRUCTURE_VERSION = [0]  # bumped on ANY sublayer registration (cache guard)
+
+
+def _bump_structure_version():
+    _STRUCTURE_VERSION[0] += 1
 
 
 def _eager_jit_mode():
@@ -587,12 +591,15 @@ def _jit_forward_applicable(layer, inputs, kwargs) -> bool:
 def _jit_forward_supported(layer) -> bool:
     """Structure gate: no exempt sublayers (MoE aux-loss side outputs), no
     active generation caches, no floating (stats-like) buffers to write
-    back. The sublayer list is walked once and cached; registering a new
-    sublayer invalidates it (Layer.__setattr__/add_sublayer)."""
-    sub = layer.__dict__.get("_jit_sub_cache")
-    if sub is None:
+    back. The sublayer list is walked once and cached against the GLOBAL
+    structure version (bumped by any sublayer registration, so ancestors'
+    cached walks revalidate too)."""
+    cached = layer.__dict__.get("_jit_sub_cache")
+    if cached is None or cached[0] != _STRUCTURE_VERSION[0]:
         sub = [l for _, l in layer.named_sublayers(include_self=True)]
-        layer.__dict__["_jit_sub_cache"] = sub
+        layer.__dict__["_jit_sub_cache"] = (_STRUCTURE_VERSION[0], sub)
+    else:
+        sub = cached[1]
     for l in sub:
         if getattr(type(l), "_jit_forward_exempt", False):
             return False
@@ -618,7 +625,8 @@ def _jit_forward_call(layer, inputs):
     amp = amp_state()
     statics = tuple(x if not isinstance(x, Tensor) else None for x in inputs)
     key = (layer.training, bool(amp.enable), getattr(amp, "dtype", None),
-           getattr(amp, "level", None), statics, len(inputs))
+           getattr(amp, "level", None), statics, len(inputs),
+           _STRUCTURE_VERSION[0])  # stale closures die on structure change
     cache = layer.__dict__.setdefault("_eager_jit_cache", {})
     entry = cache.get(key)
     if entry is None:

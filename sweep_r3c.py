@@ -1,0 +1,101 @@
+"""Fair pipeline-overhead A/B on the chip: hybrid ppermute-scan step at
+pp=1 (bf16 compute, selective per-layer remat) vs the plain bf16
+ParallelTrainer step — gpt3-350m b8. Appends to /tmp/sweep_r3c.jsonl."""
+import gc
+import json
+import time
+
+import numpy as np
+
+OUT = "/tmp/sweep_r3c.jsonl"
+
+
+def log(rec):
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(rec, flush=True)
+
+
+def sync(x):
+    return float(np.asarray(x if not hasattr(x, "_data") else x._data))
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.distributed.meta_parallel.pipeline_schedule import (
+        build_gpt_pipeline_step)
+    from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+    from paddle_tpu.models.gpt import (
+        GPTForPretraining, GPTPretrainingCriterion, gpt_config)
+    from paddle_tpu.optimizer.optimizers import AdamW
+
+    cfg = gpt_config("gpt3-350m", hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    b, seq, steps, reps = 8, 1024, 5, 6
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (b, seq)).astype("int32")
+
+    results = {}
+    for m, policy, unroll in ((1, "selective", 1),):
+        try:
+            paddle.seed(0)
+            clear_mesh()
+            gc.collect()
+            init_mesh({"pp": 1})
+            model = GPTForPretraining(cfg)
+            opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                        moment_dtype="bfloat16")
+            step = build_gpt_pipeline_step(
+                model, opt, microbatches=m, compute_dtype="bfloat16",
+                remat_policy=policy, scan_unroll=unroll)
+            sync(step(ids, ids))
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    l = step(ids, ids)
+                sync(l)
+                ts.append(time.perf_counter() - t0)
+            results[f"pipe_m{m}_{policy}_u{unroll}"] = sorted(ts)[len(ts) // 2]
+            log({"experiment": f"pipe_350m_b8_m{m}_{policy}_u{unroll}_bf16",
+                 "median_s": round(results[f'pipe_m{m}_{policy}_u{unroll}'], 3),
+                 "times": [round(t, 3) for t in ts]})
+            del step, model, opt
+            gc.collect()
+        except Exception as e:
+            log({"experiment": f"pipe_350m_b8_m{m}_{policy}_u{unroll}",
+                 "error": f"{type(e).__name__}: {str(e)[:150]}"})
+            gc.collect()
+
+    paddle.seed(0)
+    clear_mesh()
+    gc.collect()
+    init_mesh({"dp": 1})
+    model2 = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt2 = AdamW(learning_rate=1e-4, parameters=model2.parameters(),
+                 moment_dtype="bfloat16")
+    trainer = ParallelTrainer(model2, lambda o, y: crit(o, y), opt2,
+                              dp_axis=None, compute_dtype="bfloat16")
+    tids = paddle.to_tensor(ids)
+    sync(trainer.step(tids, tids))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            l = trainer.step(tids, tids)
+        sync(l)
+        ts.append(time.perf_counter() - t0)
+    plain = sorted(ts)[len(ts) // 2]
+    log({"experiment": "plain_350m_b8_bf16", "median_s": round(plain, 3),
+         "times": [round(t, 3) for t in ts]})
+    best = min(results.values()) if results else None
+    if best:
+        log({"experiment": "pipeline_step_overhead",
+             "overhead": round(best / plain - 1, 4),
+             "best_pipe_s": round(best, 3), "plain_s": round(plain, 3)})
+
+
+if __name__ == "__main__":
+    main()

@@ -128,3 +128,23 @@ def test_gpt_forward_parity(jit_forward):
     paddle.set_flags({"FLAGS_eager_layer_jit": False})
     out_e = np.asarray(m(ids)._data)
     np.testing.assert_allclose(out_j, out_e, rtol=1e-5, atol=1e-6)
+
+
+def test_structure_change_invalidates_ancestor_cache(jit_forward):
+    """Replacing a nested sublayer (e.g. swapping in a MoE layer) must
+    revalidate ANCESTOR layers' cached structure gates — the stale walk
+    would jit through the exempt layer and leak its aux tracer."""
+    from paddle_tpu.distributed.meta_parallel.moe_layer import MoELayer
+
+    paddle.seed(10)
+    net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+    x = _x((2, 8), seed=11)
+    net(x)
+    assert net.__dict__.get("_eager_jit_cache")
+
+    net.add_sublayer("1", MoELayer(8, 16, 2, top_k=1, capacity_factor=4.0))
+    out = net(x)  # must fall back to eager (MoE exempt)
+    # the aux loss must be a concrete value, not a leaked tracer
+    float(net[1].l_aux._data if hasattr(net[1].l_aux, "_data")
+          else net[1].l_aux)
+    assert out.shape[0] == 2

@@ -139,6 +139,25 @@ def test_inference_predictor_roundtrip(tmp_path):
     got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
     np.testing.assert_allclose(got, ref, rtol=1e-5)
 
+    # pass pipeline (analysis_predictor.cc:179 analog): the default passes
+    # are real and deletable; outputs identical with every combination
+    assert "stablehlo_jit_cache" in cfg.pass_builder().all_passes()
+    assert pred._jitted is not None
+
+    cfg2 = inference.Config(prefix + ".pdmodel")
+    cfg2.enable_memory_optim()
+    assert "input_buffer_donation" in cfg2.pass_builder().all_passes()
+    pred2 = inference.create_predictor(cfg2)
+    (got2,) = pred2.run([x_np])
+    np.testing.assert_allclose(got2, ref, rtol=1e-5)
+
+    cfg3 = inference.Config(prefix + ".pdmodel")
+    cfg3.switch_ir_optim(False)
+    pred3 = inference.create_predictor(cfg3)
+    assert pred3._jitted is None  # un-optimized replay path
+    (got3,) = pred3.run([x_np])
+    np.testing.assert_allclose(got3, ref, rtol=1e-5)
+
 
 # ---------------------------------------------------------------------------
 # asp 2:4 sparsity
