@@ -3,6 +3,11 @@
 Writes one JSON line per variant to /tmp/sweep_r3.jsonl as it goes
 (tunnel runs can die; partial results must survive).
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import gc
 import json
 import sys

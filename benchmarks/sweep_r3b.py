@@ -6,6 +6,11 @@
 
 Appends JSON lines to /tmp/sweep_r3b.jsonl.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import gc
 import json
 import time

@@ -1,6 +1,11 @@
 """Fair pipeline-overhead A/B on the chip: hybrid ppermute-scan step at
 pp=1 (bf16 compute, selective per-layer remat) vs the plain bf16
 ParallelTrainer step — gpt3-350m b8. Appends to /tmp/sweep_r3c.jsonl."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import gc
 import json
 import time

@@ -1,0 +1,103 @@
+"""Ring-flash at 8k tokens/shard on the real chip (sp=1 ring: one hop =
+the per-hop flash kernel + cross-hop merge machinery), A/B vs the einsum
+online-softmax ring hop and the plain flash kernel. fwd+bwd timings.
+Appends to /tmp/sweep_r3d.jsonl."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import gc
+import json
+import time
+
+import numpy as np
+
+OUT = "/tmp/sweep_r3d.jsonl"
+
+
+def log(rec):
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(rec, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.meta_parallel.sequence_parallel import (
+        _ring_attention_flash, _ring_attention_raw)
+    from paddle_tpu.distributed.spmd import P
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    b, h, t, d = 1, 8, 8192, 128
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+
+    dist.init_mesh({"sp": 1})
+
+    def time_fn(f, *args, iters=20, warmup=2):
+        for _ in range(warmup):
+            out = f(*args)
+        float(jnp.sum(out[0] if isinstance(out, tuple) else out).astype(jnp.float32))
+        reps = []
+        for _ in range(6):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = f(*args)
+            float(jnp.sum(out[0] if isinstance(out, tuple) else out)
+                  .astype(jnp.float32))
+            reps.append((time.perf_counter() - t0) / iters)
+        return sorted(reps)[len(reps) // 2]
+
+    # fwd+bwd through each attention path
+    def make_fb(attn):
+        def fb(q, k, v):
+            def loss(q, k, v):
+                return jnp.sum(attn(q, k, v).astype(jnp.float32))
+
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        return jax.jit(fb)
+
+    ring_flash = dist.run_on_mesh(
+        make_fb(lambda q, k, v: _ring_attention_flash(
+            q, k, v, "sp", True, None, None)),
+        in_specs=(P(None, None, "sp", None),) * 3,
+        out_specs=(P(None, None, "sp", None),) * 3)
+    try:
+        ms = time_fn(ring_flash, q, k, v) * 1e3
+        log({"experiment": "ring_flash_sp1_T8192_D128_bf16_fwdbwd",
+             "ms": round(ms, 2)})
+    except Exception as e:
+        log({"experiment": "ring_flash_8k", "error": str(e)[:200]})
+    gc.collect()
+
+    plain_flash = make_fb(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    try:
+        ms = time_fn(plain_flash, q, k, v) * 1e3
+        log({"experiment": "plain_flash_T8192_D128_bf16_fwdbwd",
+             "ms": round(ms, 2)})
+    except Exception as e:
+        log({"experiment": "plain_flash_8k", "error": str(e)[:200]})
+    gc.collect()
+
+    ring_einsum = dist.run_on_mesh(
+        make_fb(lambda q, k, v: _ring_attention_raw(q, k, v, "sp", True, None)),
+        in_specs=(P(None, None, "sp", None),) * 3,
+        out_specs=(P(None, None, "sp", None),) * 3)
+    try:
+        ms = time_fn(ring_einsum, q, k, v, iters=5) * 1e3
+        log({"experiment": "ring_einsum_sp1_T8192_D128_fwdbwd",
+             "ms": round(ms, 2)})
+    except Exception as e:
+        log({"experiment": "ring_einsum_8k",
+             "error": f"{type(e).__name__}: {str(e)[:160]}"})
+
+
+if __name__ == "__main__":
+    main()

@@ -757,7 +757,7 @@ def _decay_masks(pipe, optimizer):
 
 
 def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
-                   mesh_axes):
+                   mesh_axes, lr):
     """Optimizer apply with ZeRO-2 semantics over 'sharding': reduce-scatter
     each (flattened) grad, update the local slot slice, all-gather params.
     Runs inside the shard_map body. Parity: sharding_optimizer.py grad
@@ -778,7 +778,6 @@ def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
     decoupled = optimizer._decoupled_wd
     hyper = optimizer._hyper()
     decay_masks = _decay_masks(pipe, optimizer)
-    lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
     step = opt_state["step"] + 1
     upd = type(optimizer)._update
 
@@ -860,7 +859,7 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
         for grp in slot_tree
     }
 
-    def spmd_step(params, opt_state, x, y, kd):
+    def spmd_step(params, opt_state, x, y, kd, lr):
         key = jax.random.wrap_key_data(kd)
 
         def loss_fn(params):
@@ -899,7 +898,7 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
             loss = lax.pmean(loss, SH_AXIS)
         new_params, new_opt = _apply_updates(
             optimizer, params, grads, local_opt, n_shard, has_sh, pipe,
-            mesh_axes)
+            mesh_axes, lr)
         # restore the [1, 1, 1, sz] layout for the out specs
         new_opt = {
             "slots": jax.tree_util.tree_map(
@@ -917,7 +916,7 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
 
     mapped = shard_map(
         spmd_step, mesh=mesh,
-        in_specs=(param_specs, opt_prefix, data_spec, data_spec, P()),
+        in_specs=(param_specs, opt_prefix, data_spec, data_spec, P(), P()),
         out_specs=(param_specs, opt_prefix, P()),
         check_vma=False,
     )
@@ -931,8 +930,10 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
         x = x._data if isinstance(x, Tensor) else jnp.asarray(x)
         y = y._data if isinstance(y, Tensor) else jnp.asarray(y)
         kd = jax.random.key_data(split_key())
+        # lr as a runtime scalar: LR schedules apply to the compiled step
+        lr_now = jnp.asarray(float(optimizer.get_lr()), jnp.float32)
         state["params"], state["opt"], loss = jitted(
-            state["params"], state["opt"], x, y, kd)
+            state["params"], state["opt"], x, y, kd, lr_now)
         return loss
 
     step.pipe = pipe

@@ -247,7 +247,7 @@ class ParallelTrainer:
             decr_every = int(self._scaler._decr_every_n_nan_or_inf)
             dynamic = bool(self._scaler.is_use_dynamic_loss_scaling())
 
-        def step(params, opt_state, buffers, xb, yb, rng_key, scale_state):
+        def step(params, opt_state, buffers, xb, yb, rng_key, scale_state, lr):
             scale = scale_state["loss_scale"] if use_scaling else None
 
             base_loss_fn = loss_fn
@@ -295,7 +295,8 @@ class ParallelTrainer:
                 finite = jnp.asarray(True)
                 for g in jax.tree_util.tree_leaves(grads):
                     finite = finite & jnp.all(jnp.isfinite(g))
-                new_params, new_opt = self.optimizer.apply_gradients(params, grads, opt_state)
+                new_params, new_opt = self.optimizer.apply_gradients(
+                    params, grads, opt_state, lr=lr)
                 keep = lambda new, old: jax.tree_util.tree_map(
                     lambda a, b: jnp.where(finite, a, b), new, old)
                 new_params = keep(new_params, params)
@@ -316,7 +317,8 @@ class ParallelTrainer:
                 else:
                     new_scale_state = scale_state
             else:
-                new_params, new_opt = self.optimizer.apply_gradients(params, grads, opt_state)
+                new_params, new_opt = self.optimizer.apply_gradients(
+                    params, grads, opt_state, lr=lr)
                 new_scale_state = scale_state
 
             return new_params, new_opt, new_buffers, loss, new_scale_state
@@ -370,7 +372,8 @@ class ParallelTrainer:
         scale_sh = {k: repl for k in self.scale_state}
         self._jit_step = jax.jit(
             step,
-            in_shardings=(param_sh, opt_sh, buf_sh, batch_sh, batch_sh, None, scale_sh),
+            in_shardings=(param_sh, opt_sh, buf_sh, batch_sh, batch_sh, None,
+                          scale_sh, None),
             # pin outputs to the input placements so donated buffers round-
             # trip bit-identically across steps
             out_shardings=(param_sh, opt_sh, buf_sh, repl, scale_sh),
@@ -390,9 +393,12 @@ class ParallelTrainer:
                 self.params, self.buffers, xb, yb, split_key())
             self._host_apply(grads)
             return Tensor(loss)
+        # lr enters as a runtime scalar so LR schedules take effect on the
+        # compiled step (read at trace time it would be baked as a constant)
+        lr_now = jnp.asarray(float(self.optimizer.get_lr()), jnp.float32)
         self.params, self.opt_state, self.buffers, loss, self.scale_state = self._jit_step(
             self.params, self.opt_state, self.buffers, xb, yb, split_key(),
-            self.scale_state,
+            self.scale_state, lr_now,
         )
         return Tensor(loss)
 
