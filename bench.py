@@ -2,14 +2,12 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "secondary"}.
 
-Metric: tokens/sec/chip on gpt3-350m (the largest GPT config whose Adam
-training state fits a single v5e chip), with MFU derived from the standard
-causal-transformer FLOP count (below). vs_baseline is MFU / 0.40 (the
-BASELINE.json north-star 40% MFU target). "secondary" reports the larger
-configs: gpt3-760m throughput and the honest gpt3-1.3b single-chip status
-(its f32 params+Adam moments alone are ~15.6 GB vs 16 GB HBM — 1.3B is a
-multi-chip workload; the hybrid pp x mp x sharding path is validated by
-dryrun_multichip and the 8-device CPU-mesh tests).
+Metric: tokens/sec/chip on gpt3-1.3b — the BASELINE.json north-star config,
+fitting ONE v5e chip since r3 (f32 params 5.3GB + bf16 Adam moments 5.3GB +
+partial rematerialization). vs_baseline is MFU / 0.40 (the north-star 40%
+MFU target). "secondary" reports gpt3-760m and gpt3-350m throughput, the
+eager per-layer jit-cache speedup, and the ppermute-scan pipeline-step
+overhead at pp=1 (VERDICT r2 #5).
 
 MFU accounting (pinned so future rounds can't inflate it):
   flops/token = 6*N + 6*L*T*H
@@ -48,7 +46,8 @@ def _peak_flops_bf16(device) -> float:
 
 
 def _train_tput(name, batch, seq, steps, warmup, on_tpu, recompute=False,
-                granularity="full", moment_dtype="bfloat16"):
+                granularity="full", moment_dtype="bfloat16",
+                recompute_interval=1):
     """tokens/sec for one config; returns (tok_per_sec, n_params, cfg)."""
     import gc
 
@@ -63,7 +62,8 @@ def _train_tput(name, batch, seq, steps, warmup, on_tpu, recompute=False,
     from paddle_tpu.optimizer.optimizers import AdamW
 
     overrides = dict(hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
-                     use_recompute=recompute, recompute_granularity=granularity)
+                     use_recompute=recompute, recompute_granularity=granularity,
+                     recompute_interval=recompute_interval)
     if not on_tpu:  # CI / CPU smoke: tiny shapes, same code path
         overrides.update(vocab_size=256, hidden_size=64, num_layers=2,
                          num_attention_heads=4, max_position_embeddings=64)
@@ -207,10 +207,12 @@ def main():
         # bf16 moments 5.3GB + rematerialized activations) at ~50% MFU.
         seq = 1024
         secondary = {}
-        # north star first: GPT-3 1.3B (BASELINE.json config #4)
+        # north star first: GPT-3 1.3B (BASELINE.json config #4);
+        # recompute_interval=3 remats every 3rd block only — the partial-
+        # remat sweet spot (58% MFU vs 53% at interval 1, benchmarks/sweep_r3f)
         tput, n_params, cfg = _train_tput(
             "gpt3-1.3b", 4, seq, 10, 2, True, recompute=True,
-            granularity="full", moment_dtype="bfloat16")
+            granularity="full", moment_dtype="bfloat16", recompute_interval=3)
         metric = "gpt3_1.3b_train_tokens_per_sec_chip"
         try:
             t760, n760, c760 = _train_tput("gpt3-760m", 8, seq, 10, 2, True)

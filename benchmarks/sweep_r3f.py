@@ -1,0 +1,78 @@
+"""1.3B recompute_interval sweep: remat every k-th block only.
+Appends to /tmp/sweep_r3f.jsonl."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import gc
+import json
+import time
+
+import numpy as np
+
+OUT = "/tmp/sweep_r3f.jsonl"
+
+
+def log(rec):
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(rec, flush=True)
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+    from paddle_tpu.models.gpt import (
+        GPTForPretraining, GPTPretrainingCriterion, gpt_config)
+    from paddle_tpu.optimizer.optimizers import AdamW
+
+    seq = 1024
+    for batch, interval in ((4, 2), (2, 2), (4, 3), (2, 3)):
+        try:
+            cfg = gpt_config("gpt3-1.3b", hidden_dropout_prob=0.0,
+                             attention_dropout_prob=0.0, use_recompute=True,
+                             recompute_granularity="full",
+                             recompute_interval=interval)
+            paddle.seed(0)
+            clear_mesh()
+            gc.collect()
+            init_mesh({"dp": 1})
+            model = GPTForPretraining(cfg)
+            crit = GPTPretrainingCriterion(cfg)
+            opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                        moment_dtype="bfloat16")
+            trainer = ParallelTrainer(model, lambda o, y: crit(o, y), opt,
+                                      dp_axis=None, compute_dtype="bfloat16")
+            rng = np.random.default_rng(0)
+            ids = paddle.to_tensor(
+                rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+            for _ in range(2):
+                l = trainer.step(ids, ids)
+            float(np.asarray(l._data))
+            times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    l = trainer.step(ids, ids)
+                float(np.asarray(l._data))
+                times.append(time.perf_counter() - t0)
+            med = sorted(times)[len(times) // 2]
+            tput = batch * seq * 5 / med
+            n_params = sum(int(np.prod(p._data.shape))
+                           for p in model.parameters())
+            mfu = tput * (6 * n_params + 6 * 24 * seq * cfg.hidden_size) / 197e12
+            log({"experiment": f"1.3b b{batch} interval{interval}",
+                 "tok_s": round(tput, 1), "mfu": round(mfu, 4),
+                 "times": [round(t, 3) for t in times]})
+            del trainer, model
+            gc.collect()
+        except Exception as e:
+            log({"experiment": f"1.3b b{batch} interval{interval}",
+                 "error": f"{type(e).__name__}: {str(e)[:120]}"})
+            gc.collect()
+
+
+if __name__ == "__main__":
+    main()

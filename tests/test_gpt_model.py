@@ -219,3 +219,17 @@ def test_qkv_layout_migration():
     m3 = GPTForPretraining(tiny_cfg())
     m3.set_state_dict(sd)
     np.testing.assert_allclose(np.asarray(m3(ids)._data), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_recompute_interval_marks_every_kth_block():
+    """recompute_interval=3: blocks 0,3,6,... remat, the rest run saved
+    (reference PipelineLayer recompute_interval semantics)."""
+    m = GPTForPretraining(tiny_cfg(num_layers=6, use_recompute=True,
+                                   recompute_interval=3))
+    flags = [blk._use_recompute for blk in m.gpt.h]
+    assert flags == [True, False, False, True, False, False], flags
+    # still trains
+    ids = _batch()
+    loss = GPTPretrainingCriterion()(m(ids), ids)
+    loss.backward()
+    assert m.gpt.h[1].attn.qkv_proj.weight.grad is not None
