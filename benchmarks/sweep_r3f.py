@@ -62,7 +62,8 @@ def main():
             tput = batch * seq * 5 / med
             n_params = sum(int(np.prod(p._data.shape))
                            for p in model.parameters())
-            mfu = tput * (6 * n_params + 6 * 24 * seq * cfg.hidden_size) / 197e12
+            mfu = tput * (6 * n_params + 6 * cfg.num_layers * seq
+                          * cfg.hidden_size) / 197e12  # v5e bf16 peak
             log({"experiment": f"1.3b b{batch} interval{interval}",
                  "tok_s": round(tput, 1), "mfu": round(mfu, 4),
                  "times": [round(t, 3) for t in times]})

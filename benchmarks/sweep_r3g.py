@@ -1,0 +1,139 @@
+"""BASELINE configs 2-3 on one v5e chip: ResNet-50 (images/s) and
+BERT-base pretrain (tokens/s). Appends to /tmp/sweep_r3g.jsonl."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import gc
+import json
+import time
+
+import numpy as np
+
+OUT = "/tmp/sweep_r3g.jsonl"
+
+
+def log(rec):
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(rec, flush=True)
+
+
+def resnet50():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+    from paddle_tpu.optimizer.optimizers import Momentum
+    from paddle_tpu.vision.models import resnet50 as make
+
+    for batch in (64, 128):
+        try:
+            paddle.seed(0)
+            clear_mesh()
+            gc.collect()
+            init_mesh({"dp": 1})
+            model = make(num_classes=1000)
+            ce = paddle.nn.CrossEntropyLoss()
+            opt = Momentum(learning_rate=0.1, momentum=0.9,
+                           parameters=model.parameters())
+            trainer = ParallelTrainer(model, lambda o, y: ce(o, y), opt,
+                                      dp_axis=None, compute_dtype="bfloat16")
+            rng = np.random.default_rng(0)
+            x = paddle.to_tensor(
+                rng.standard_normal((batch, 3, 224, 224)).astype("float32"))
+            y = paddle.to_tensor(
+                rng.integers(0, 1000, (batch,)).astype("int64"))
+            for _ in range(2):
+                l = trainer.step(x, y)
+            float(np.asarray(l._data))
+            times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    l = trainer.step(x, y)
+                float(np.asarray(l._data))
+                times.append(time.perf_counter() - t0)
+            med = sorted(times)[len(times) // 2]
+            log({"experiment": f"resnet50 b{batch} train",
+                 "images_s": round(batch * 5 / med, 1),
+                 "times": [round(t, 3) for t in times]})
+            del trainer, model
+            gc.collect()
+        except Exception as e:
+            log({"experiment": f"resnet50 b{batch}",
+                 "error": f"{type(e).__name__}: {str(e)[:140]}"})
+            gc.collect()
+
+
+def bert_base():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+    from paddle_tpu.models.bert import (
+        BertForPretraining, BertPretrainingCriterion, bert_config)
+    from paddle_tpu.optimizer.optimizers import AdamW
+
+    seq = 512
+    for batch in (16, 32):
+        try:
+            cfg = bert_config("bert-base", hidden_dropout_prob=0.0,
+                              attention_dropout_prob=0.0)
+            paddle.seed(0)
+            clear_mesh()
+            gc.collect()
+            init_mesh({"dp": 1})
+            model = BertForPretraining(cfg)
+            crit = BertPretrainingCriterion(cfg)
+            opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                        moment_dtype="bfloat16")
+
+            rng = np.random.default_rng(0)
+            ids = paddle.to_tensor(
+                rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+            # MLM labels: -100 (ignore) everywhere but ~15% positions
+            mlm = np.full((batch, seq), -100, "int64")
+            mask_pos = rng.random((batch, seq)) < 0.15
+            mlm[mask_pos] = rng.integers(
+                0, cfg.vocab_size, mask_pos.sum()).astype("int64")
+            nsp = rng.integers(0, 2, (batch, 1)).astype("int64")
+            y = paddle.to_tensor(np.concatenate([mlm, nsp], axis=1))
+
+            def fwd_loss(out, yy):
+                pred, nsp_logits = out
+                mlm_labels = yy[:, :seq]
+                nsp_labels = yy[:, seq:]
+                return crit(pred, mlm_labels, nsp_logits, nsp_labels)
+
+            trainer = ParallelTrainer(model, fwd_loss, opt, dp_axis=None,
+                                      compute_dtype="bfloat16")
+            for _ in range(2):
+                l = trainer.step(ids, y)
+            float(np.asarray(l._data))
+            times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    l = trainer.step(ids, y)
+                float(np.asarray(l._data))
+                times.append(time.perf_counter() - t0)
+            med = sorted(times)[len(times) // 2]
+            tput = batch * seq * 5 / med
+            n_params = sum(int(np.prod(p._data.shape))
+                           for p in model.parameters())
+            mfu = tput * (6 * n_params + 6 * cfg.num_layers * seq
+                          * cfg.hidden_size) / 197e12
+            log({"experiment": f"bert-base T512 b{batch} pretrain",
+                 "tok_s": round(tput, 1), "mfu": round(mfu, 4),
+                 "times": [round(t, 3) for t in times]})
+            del trainer, model
+            gc.collect()
+        except Exception as e:
+            log({"experiment": f"bert-base b{batch}",
+                 "error": f"{type(e).__name__}: {str(e)[:140]}"})
+            gc.collect()
+
+
+if __name__ == "__main__":
+    resnet50()
+    bert_base()

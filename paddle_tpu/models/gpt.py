@@ -67,8 +67,8 @@ class GPTConfig:
     # fraction of the activation memory
     recompute_granularity: str = "full"
     # remat every k-th block only (reference PipelineLayer recompute_interval):
-    # 1 = every block; 2 = blocks 0,2,4,... — trades memory for fewer
-    # recompute flops when the model almost fits without remat
+    # 0 = off, 1 = every block, 2 = blocks 0,2,4,... — trades memory for
+    # fewer recompute flops when the model almost fits without remat
     recompute_interval: int = 1
     # MoE (ERNIE-MoE analog, BASELINE #5): 0 experts = dense model
     num_experts: int = 0
@@ -360,8 +360,11 @@ class GPTDecoderLayer(Layer):
         self.dropout2 = Dropout(config.hidden_dropout_prob, mode="upscale_in_train")
         # remat of an MoE block would trap l_aux inside the checkpoint trace,
         # so MoE blocks always run un-rematerialized
-        interval = max(int(getattr(config, "recompute_interval", 1) or 1), 1)
+        # interval semantics follow the reference PipelineLayer: 0 disables
+        # recompute entirely, k >= 1 remats blocks 0, k, 2k, ...
+        interval = int(getattr(config, "recompute_interval", 1))
         self._use_recompute = (config.use_recompute and not self.is_moe
+                               and interval >= 1
                                and layer_idx % interval == 0)
         self._recompute_granularity = config.recompute_granularity
 

@@ -290,6 +290,14 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd, data_format
 
     @primitive
     def _conv(x, weight, bias):
+        # mixed-precision harmonization: lax.conv requires matching dtypes;
+        # when weights were cast down (compute_dtype / AMP O2 master-weight
+        # pattern) the activations follow them onto the MXU
+        if x.dtype != weight.dtype and jnp.issubdtype(x.dtype, jnp.floating) \
+                and jnp.issubdtype(weight.dtype, jnp.floating):
+            low = min(x.dtype, weight.dtype, key=lambda d: jnp.finfo(d).bits)
+            x = x.astype(low)
+            weight = weight.astype(low)
         if not transpose:
             out = jax.lax.conv_general_dilated(
                 x,

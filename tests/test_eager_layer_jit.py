@@ -148,3 +148,19 @@ def test_structure_change_invalidates_ancestor_cache(jit_forward):
     float(net[1].l_aux._data if hasattr(net[1].l_aux, "_data")
           else net[1].l_aux)
     assert out.shape[0] == 2
+
+
+def test_double_grad_through_jitted_layer(jit_forward):
+    """paddle.grad(create_graph=True) re-differentiates the cached jitted
+    forward (the tape keeps its pure_fn; jax differentiates through jit)."""
+    paddle.seed(12)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    x = _x((4, 4), seed=13)
+    x.stop_gradient = False
+    out = net(x)
+    loss = (out * out).mean()
+    (gx,) = paddle.grad(loss, [x], create_graph=True)
+    gnorm = (gx * gx).sum()
+    gnorm.backward()
+    assert net.parameters()[0].grad is not None
+    assert float(gnorm._data) > 0

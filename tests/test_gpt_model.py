@@ -233,3 +233,10 @@ def test_recompute_interval_marks_every_kth_block():
     loss = GPTPretrainingCriterion()(m(ids), ids)
     loss.backward()
     assert m.gpt.h[1].attn.qkv_proj.weight.grad is not None
+
+
+def test_recompute_interval_zero_disables():
+    """interval 0 = recompute off (reference PipelineLayer default)."""
+    m = GPTForPretraining(tiny_cfg(num_layers=4, use_recompute=True,
+                                   recompute_interval=0))
+    assert all(not blk._use_recompute for blk in m.gpt.h)

@@ -180,3 +180,37 @@ class TestNewTransforms:
         assert shifted.shape == img.shape and shifted.dtype == img.dtype
         # full-turn shift restores the image
         np.testing.assert_allclose(T.adjust_hue(img, 1.0), img, atol=2)
+
+
+def test_conv_model_trains_under_compute_dtype_bf16():
+    """compute_dtype='bfloat16' (AMP O2 master-weight pattern) must work for
+    conv nets: lax.conv requires matching dtypes, so activations follow the
+    downcast weights onto the MXU (regression: ResNet-50 bench failure)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+    from paddle_tpu.optimizer.optimizers import Momentum
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    clear_mesh()
+    init_mesh({"dp": 1})
+    try:
+        m = LeNet()
+        ce = paddle.nn.CrossEntropyLoss()
+        opt = Momentum(learning_rate=0.05, momentum=0.9,
+                       parameters=m.parameters())
+        tr = ParallelTrainer(m, lambda o, y: ce(o, y), opt, dp_axis=None,
+                             compute_dtype="bfloat16")
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(
+            rng.standard_normal((16, 1, 28, 28)).astype("float32"))
+        y = paddle.to_tensor(rng.integers(0, 10, (16,)).astype("int64"))
+        l0 = float(tr.step(x, y)._data)
+        for _ in range(20):
+            l = float(tr.step(x, y)._data)
+        assert l < l0, (l0, l)
+    finally:
+        clear_mesh()
