@@ -121,8 +121,13 @@ def bert_base():
             tput = batch * seq * 5 / med
             n_params = sum(int(np.prod(p._data.shape))
                            for p in model.parameters())
-            mfu = tput * (6 * n_params + 6 * cfg.num_layers * seq
-                          * cfg.hidden_size) / 197e12
+            # BERT accounting: bidirectional attention (12*L*s*h — no causal
+            # halving) + the tied-decoder MLM logits matmul (6*h*V: the
+            # embedding weight's second use, not covered by the 6*N rule)
+            flops_tok = (6 * n_params
+                         + 12 * cfg.num_layers * seq * cfg.hidden_size
+                         + 6 * cfg.hidden_size * cfg.vocab_size)
+            mfu = tput * flops_tok / 197e12
             log({"experiment": f"bert-base T512 b{batch} pretrain",
                  "tok_s": round(tput, 1), "mfu": round(mfu, 4),
                  "times": [round(t, 3) for t in times]})

@@ -1,0 +1,79 @@
+"""BASELINE config 5: ERNIE-MoE pretrain throughput on one v5e chip
+(all experts chip-local; the ep-parallel path is exercised by the CPU-mesh
+tests + dryrun legs). Appends to /tmp/sweep_r3h.jsonl."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import gc
+import json
+import time
+
+import numpy as np
+
+OUT = "/tmp/sweep_r3h.jsonl"
+
+
+def log(rec):
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(rec, flush=True)
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+    from paddle_tpu.models.gpt import (
+        GPTForPretraining, GPTPretrainingCriterion, gpt_config)
+    from paddle_tpu.optimizer.optimizers import AdamW
+
+    seq = 1024
+    for batch, experts in ((8, 16), (4, 64)):
+        try:
+            cfg = gpt_config("ernie-moe-base", hidden_dropout_prob=0.0,
+                             attention_dropout_prob=0.0,
+                             num_experts=experts,
+                             moe_capacity_factor=1.25)
+            paddle.seed(0)
+            clear_mesh()
+            gc.collect()
+            init_mesh({"dp": 1})
+            model = GPTForPretraining(cfg)
+            crit = GPTPretrainingCriterion(cfg)
+            opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                        moment_dtype="bfloat16")
+            trainer = ParallelTrainer(
+                model, lambda o, y: crit(o, y) + model.aux_loss(), opt,
+                dp_axis=None, compute_dtype="bfloat16")
+            rng = np.random.default_rng(0)
+            ids = paddle.to_tensor(
+                rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+            for _ in range(2):
+                l = trainer.step(ids, ids)
+            float(np.asarray(l._data))
+            times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    l = trainer.step(ids, ids)
+                float(np.asarray(l._data))
+                times.append(time.perf_counter() - t0)
+            med = sorted(times)[len(times) // 2]
+            n_params = sum(int(np.prod(p._data.shape))
+                           for p in model.parameters())
+            log({"experiment": f"ernie-moe e{experts} b{batch} T{seq}",
+                 "tok_s": round(batch * seq * 5 / med, 1),
+                 "params_m": round(n_params / 1e6, 1),
+                 "times": [round(t, 3) for t in times]})
+            del trainer, model
+            gc.collect()
+        except Exception as e:
+            log({"experiment": f"ernie-moe e{experts} b{batch}",
+                 "error": f"{type(e).__name__}: {str(e)[:140]}"})
+            gc.collect()
+
+
+if __name__ == "__main__":
+    main()
