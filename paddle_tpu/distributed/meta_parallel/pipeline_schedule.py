@@ -777,6 +777,7 @@ def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
     wd = optimizer._weight_decay_coeff
     decoupled = optimizer._decoupled_wd
     hyper = optimizer._hyper()
+    hyper_no_decay = optimizer._hyper_no_decay()
     decay_masks = _decay_masks(pipe, optimizer)
     step = opt_state["step"] + 1
     upd = type(optimizer)._update
@@ -784,10 +785,9 @@ def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
     def leaf(p, g, slots, decay_ok):
         g = g.astype(p.dtype)
         leaf_wd = wd if decay_ok else 0.0
-        leaf_hyper = hyper
-        if not decay_ok and decoupled and len(hyper) == 4:
-            # AdamW packs wd as hyper[3]; zero it for no-decay leaves
-            leaf_hyper = hyper[:3] + (0.0,)
+        # optimizers that pack wd into their hyper tuple expose the
+        # zeroed variant via _hyper_no_decay (no positional assumptions)
+        leaf_hyper = hyper if decay_ok else hyper_no_decay
         if leaf_wd and not decoupled:
             g = g + leaf_wd * p
         size = p.size
@@ -887,12 +887,18 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
         if has_ep:
             # batch is sharded over 'ep' too: dense (ep-replicated) params
             # need their grads combined; expert-sharded leaves already got
-            # their cross-rank contributions through the all_to_all transpose
+            # their cross-rank contributions through the all_to_all
+            # transpose, but as a SUM of per-rank local-mean losses — scale
+            # by 1/ep so both kinds of leaf carry the grad of the global
+            # MEAN loss (consistent with the GSPMD ParallelTrainer EP path)
+            ep_size = int(mesh.shape[EP_AXIS])
             for grp, specs in (("stages", pipe.stage_specs),
                                ("shared", pipe.shared_specs)):
                 for n, g in grads[grp].items():
                     if not _spec_has(specs[n], EP_AXIS):
                         grads[grp][n] = lax.pmean(g, EP_AXIS)
+                    else:
+                        grads[grp][n] = g / ep_size
             loss = lax.pmean(loss, EP_AXIS)
         if has_sh:
             loss = lax.pmean(loss, SH_AXIS)

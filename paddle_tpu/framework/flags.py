@@ -150,3 +150,6 @@ register_flag("FLAGS_selected_tpus", "",
               "comma list of visible TPU chip ids (parity: FLAGS_selected_gpus)")
 register_flag("FLAGS_stop_check_timeout", 300,
               "elastic: seconds to wait for straggler before restart", type=int)
+register_flag("FLAGS_gpt_qkv_assume_legacy", False,
+              "treat untagged GPT state dicts as legacy [3, nh, hd] column-"
+              "layout qkv and permute to head-major on load")

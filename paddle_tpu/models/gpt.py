@@ -447,17 +447,37 @@ QKV_LAYOUT_VERSION = 2  # 2 = head-major interleaved [nh, 3, hd] qkv columns
 
 
 def _migrate_qkv_layout(model: Layer, state_dict, tag_key: str):
-    """Permute pre-v2 qkv weights ([3, nh, hd] column layout) to the
+    """Permute legacy qkv weights ([3, nh, hd] column layout) to the
     head-major interleaved layout the model now computes with.
 
-    Old checkpoints carry no ``qkv_layout`` buffer; their qkv weights have
-    identical shapes but permuted columns, so loading them silently computed
-    garbage attention. Detect the missing/old tag and permute on load.
+    Only dicts that carry an *explicit* old ``qkv_layout`` tag (< current
+    version) are auto-migrated. An **untagged** dict is ambiguous — it may
+    predate the layout change (column layout) or merely predate the tag
+    (already head-major) — so it is loaded as-is with a loud warning; pass
+    ``set_flags({"FLAGS_gpt_qkv_assume_legacy": True})`` to opt in to the
+    column→head-major permutation for genuinely old checkpoints.
     """
+    import warnings
+
     import numpy as np
 
+    from ..framework.flags import flag
+
     tag = state_dict.get(tag_key)
-    if tag is not None and int(np.asarray(
+    if tag is None:
+        if not bool(flag("FLAGS_gpt_qkv_assume_legacy")):
+            warnings.warn(
+                "state dict has no '%s' version tag; assuming the current "
+                "head-major qkv layout and NOT migrating. If this checkpoint "
+                "was saved with the pre-head-major column layout, set "
+                "FLAGS_gpt_qkv_assume_legacy=True before loading." % tag_key,
+                stacklevel=3)
+            return state_dict
+        warnings.warn(
+            "FLAGS_gpt_qkv_assume_legacy=True: migrating untagged state dict "
+            "from the legacy [3, nh, hd] column layout to head-major.",
+            stacklevel=3)
+    elif int(np.asarray(
             tag._data if hasattr(tag, "_data") else tag)) >= QKV_LAYOUT_VERSION:
         return state_dict
     out = dict(state_dict)

@@ -660,14 +660,23 @@ def _jit_forward_call(layer, inputs):
 
         entry = (primitive(jax.jit(raw), name=f"jit:{type(layer).__name__}"),
                  out_box, tensor_pos)
-        cache[key] = entry
     wrapped, out_box, tensor_pos = entry
 
     ptree = {n: p for n, p in layer.named_parameters()}
     btree = {n: b._data for n, b in layer.named_buffers()}
     rng_key = split_key()
+    # keyed per input avals: an output pytree whose structure varies with
+    # input shape must not reuse the treedef from a different trace
+    aval_key = tuple((tuple(inputs[i]._data.shape), str(inputs[i]._data.dtype))
+                     for i in tensor_pos)
     out = wrapped(ptree, btree, rng_key,
                   *[inputs[i] for i in tensor_pos])
-    treedef = out_box["treedef"]
+    # only publish the cache entry once a call has succeeded (a failed
+    # first trace must not leave an entry with no recorded treedef)
+    cache[key] = entry
+    by_aval = out_box.setdefault("by_aval", {})
+    if aval_key not in by_aval:
+        by_aval[aval_key] = out_box["treedef"]  # set by the trace just run
+    treedef = by_aval[aval_key]
     leaves = list(out) if isinstance(out, tuple) else [out]
     return jax.tree_util.tree_unflatten(treedef, leaves)

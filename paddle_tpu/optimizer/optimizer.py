@@ -59,6 +59,13 @@ class Optimizer:
         self._global_step = 0
         self._jit_update = jax.jit(type(self)._update, static_argnames=("hyper",))
 
+    def _hyper_no_decay(self):
+        """Hyper tuple for no-decay params. Optimizers that pack a
+        weight-decay coefficient into ``_hyper()`` (AdamW, Lamb, Lars)
+        override this to zero that slot; callers must use this instead of
+        assuming the decay coefficient's position in the tuple."""
+        return self._hyper()
+
     # ------------------------------------------------------------------
     # lr
     # ------------------------------------------------------------------

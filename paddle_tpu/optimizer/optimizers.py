@@ -118,12 +118,15 @@ class AdamW(Adam):
     def _hyper(self):
         return (self._beta1, self._beta2, self._epsilon, self._wd)
 
+    def _hyper_no_decay(self):
+        return (self._beta1, self._beta2, self._epsilon, 0.0)
+
     def _decay_grad(self, p, g):
         return g  # decay handled inside _update (decoupled)
 
     def _hyper_for(self, p):
         if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
-            return (self._beta1, self._beta2, self._epsilon, 0.0)
+            return self._hyper_no_decay()
         return self._hyper()
 
 
@@ -234,9 +237,12 @@ class Lamb(Optimizer):
     def _hyper(self):
         return (self._beta1, self._beta2, self._epsilon, self._lamb_wd)
 
+    def _hyper_no_decay(self):
+        return (self._beta1, self._beta2, self._epsilon, 0.0)
+
     def _hyper_for(self, p):
         if self._exclude_fn is not None and self._exclude_fn(p):
-            return (self._beta1, self._beta2, self._epsilon, 0.0)
+            return self._hyper_no_decay()
         return self._hyper()
 
     @staticmethod
@@ -278,10 +284,13 @@ class Lars(Optimizer):
     def _hyper(self):
         return (self._momentum, self._lars_coeff, self._lars_wd, self._eps)
 
+    def _hyper_no_decay(self):
+        return (self._momentum, self._lars_coeff, 0.0, self._eps)
+
     def _hyper_for(self, p):
         name = p.name or ""
         if any(token in name for token in self._exclude):
-            return (self._momentum, self._lars_coeff, 0.0, self._eps)
+            return self._hyper_no_decay()
         return self._hyper()
 
     @staticmethod

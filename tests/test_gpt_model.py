@@ -184,9 +184,14 @@ class TestGeneration:
 
 
 def test_qkv_layout_migration():
-    """Old checkpoints (pre head-major interleave, no qkv_layout tag) load
-    with columns permuted back so forward outputs are unchanged."""
+    """Explicitly old-tagged checkpoints auto-migrate; untagged dicts are
+    ambiguous (may already be head-major) so they load as-is with a warning
+    unless FLAGS_gpt_qkv_assume_legacy opts in to the permutation."""
+    import warnings
+
     import numpy as np
+
+    from paddle_tpu.framework.flags import set_flags
 
     m = GPTForPretraining(tiny_cfg())
     ids = _batch()
@@ -195,9 +200,7 @@ def test_qkv_layout_migration():
     assert "gpt.qkv_layout" in sd
 
     # simulate an old checkpoint: permute qkv columns [nh,3,hd]->[3,nh,hd]
-    # and drop the layout tag
     old = dict(sd)
-    del old["gpt.qkv_layout"]
     hd = m.gpt.config.head_dim
     for k in list(old):
         if k.endswith("qkv_proj.weight"):
@@ -210,15 +213,37 @@ def test_qkv_layout_migration():
             nh = b.shape[0] // (3 * hd)
             old[k] = b.reshape(nh, 3, hd).transpose(1, 0, 2).reshape(b.shape)
 
+    # (a) explicit old tag → auto-migrated, no flag needed
+    tagged_old = dict(old)
+    tagged_old["gpt.qkv_layout"] = np.asarray(1, np.int32)
     m2 = GPTForPretraining(tiny_cfg())
-    m2.set_state_dict(old)
-    out_old = np.asarray(m2(ids)._data)
-    np.testing.assert_allclose(out_old, ref, rtol=1e-5, atol=1e-5)
+    m2.set_state_dict(tagged_old)
+    np.testing.assert_allclose(np.asarray(m2(ids)._data), ref, rtol=1e-5, atol=1e-5)
 
-    # new-format dict (tag present) must load unpermuted
+    # (b) untagged head-major dict (saved between layout change and tag
+    # introduction) → warned, loaded verbatim, outputs unchanged
+    untagged_new = {k: v for k, v in sd.items() if k != "gpt.qkv_layout"}
     m3 = GPTForPretraining(tiny_cfg())
-    m3.set_state_dict(sd)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        m3.set_state_dict(untagged_new)
+    assert any("NOT migrating" in str(x.message) for x in w)
     np.testing.assert_allclose(np.asarray(m3(ids)._data), ref, rtol=1e-5, atol=1e-5)
+
+    # (c) untagged legacy dict + explicit opt-in flag → migrated
+    untagged_old = {k: v for k, v in old.items() if k != "gpt.qkv_layout"}
+    set_flags({"FLAGS_gpt_qkv_assume_legacy": True})
+    try:
+        m4 = GPTForPretraining(tiny_cfg())
+        m4.set_state_dict(untagged_old)
+    finally:
+        set_flags({"FLAGS_gpt_qkv_assume_legacy": False})
+    np.testing.assert_allclose(np.asarray(m4(ids)._data), ref, rtol=1e-5, atol=1e-5)
+
+    # (d) new-format dict (tag present) must load unpermuted
+    m5 = GPTForPretraining(tiny_cfg())
+    m5.set_state_dict(sd)
+    np.testing.assert_allclose(np.asarray(m5(ids)._data), ref, rtol=1e-5, atol=1e-5)
 
 
 def test_recompute_interval_marks_every_kth_block():
