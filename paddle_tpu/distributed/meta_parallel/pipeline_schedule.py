@@ -35,7 +35,11 @@ pipeline): ONE shard_map over every mesh axis —
   while dense params are replicated over it (grads pmean'd).
 - 'dp' / 'sharding' — both shard the batch; grads are pmean'd over 'dp'
   and reduce-scattered over 'sharding' (ZeRO-2), optimizer slots live
-  sliced 1/n per sharding rank, updated params all-gather back.
+  sliced 1/n per sharding rank, updated params all-gather back. With
+  ``sharding_stage=3`` the stage params themselves live sliced per rank
+  ([S, M, R, n_shard, szl] layout) and are all-gathered on use inside the
+  per-layer remat region — the gather's VJP reduce-scatters grads and
+  backward re-gathers, so peak param memory is one layer's full weights.
 - dropout — per-(microbatch, global-layer) PRNG keys are folded in inside
   the scan so masks are deterministic and reproducible by a sequential run
   (replaces the reference's RNG state tracker).
@@ -146,7 +150,8 @@ class PipelineModule:
     def __init__(self, blocks, num_stages: int, microbatches: int, *,
                  mesh=None, num_virtual_stages: int = 1, training: bool = True,
                  aux_of: Optional[Callable] = None, aux_weight: float = 0.0,
-                 remat_policy: str = "full", scan_unroll: int = 1):
+                 remat_policy: str = "full", scan_unroll: int = 1,
+                 sharding_stage: int = 2):
         mesh = mesh or get_mesh()
         self.mesh = mesh
         self.mp_size = int(mesh.shape.get(MP_AXIS, 1)) if mesh is not None else 1
@@ -239,6 +244,113 @@ class PipelineModule:
         self.shared_params = {}
         self.shared_specs = {}
 
+        # ZeRO stage-3 over 'sharding': stage-stacked params live sliced
+        # 1/n_shard per rank (per layer row) and are all-gathered on use
+        # inside the per-layer remat region — the gather's VJP is the
+        # reduce-scatter of grads, and backward re-gathers (gather-on-use
+        # both directions). Parity: sharding_optimizer.py stage=3 +
+        # sharding/shard.py:22 param split, redesigned as an array layout.
+        self._stage3 = False
+        self._s3meta = {}
+        n_shard = int(mesh.shape.get(SH_AXIS, 1)) if mesh is not None else 1
+        if int(sharding_stage) >= 3 and n_shard > 1:
+            self._to_stage3_layout(mesh, n_shard)
+
+    # -- ZeRO-3 layout ----------------------------------------------------
+    def _to_stage3_layout(self, mesh, n_shard):
+        """Re-lay stage params [S, R, *rest] → [S, M, R, n_shard, szl]:
+        model-axis parts explicit (dim 1), each layer row flattened, padded
+        and split into n_shard slices (dim 3). shard_map in_specs then give
+        each (pp, mp|ep, sharding) rank exactly its [R, szl] slice."""
+        new_params, new_specs = {}, {}
+        for n, arr in self.stage_params.items():
+            spec = self.stage_specs[n]
+            bspec = P(*tuple(spec)[2:])
+            rest = arr.shape[2:]
+            model_axis = next((ax for ax in (MP_AXIS, EP_AXIS)
+                               if _spec_has(bspec, ax)), None)
+            m_dim = int(mesh.shape.get(model_axis, 1)) if model_axis else 1
+            local_rest = _local_shape(rest, bspec, mesh)
+            lsz = 1
+            for s in local_rest:
+                lsz *= s
+            szl = -(-lsz // n_shard)
+            pad = szl * n_shard - lsz
+            S, R = arr.shape[:2]
+            if model_axis and m_dim > 1:
+                d = next(i for i, x in enumerate(tuple(bspec))
+                         if x == model_axis
+                         or (isinstance(x, tuple) and model_axis in x))
+                parts = jnp.split(arr, m_dim, axis=2 + d)
+            else:
+                parts = [arr]
+            flat = jnp.stack([p.reshape(S, R, lsz) for p in parts], axis=1)
+            flat = jnp.pad(flat, ((0, 0), (0, 0), (0, 0), (0, pad)))
+            new_params[n] = flat.reshape(S, m_dim, R, n_shard, szl)
+            new_specs[n] = P(PP_AXIS, model_axis, None, SH_AXIS, None)
+            self._s3meta[n] = (tuple(local_rest), lsz, szl, model_axis,
+                               tuple(rest))
+        self.stage_params = new_params
+        self.stage_specs = new_specs
+        self._stage3 = True
+        self._s3_nshard = n_shard
+
+    def _s3_gather(self, lp_flat, prefix=""):
+        """All-gather one layer's param slices over 'sharding' and restore
+        their (model-local) shapes. Runs inside the per-layer checkpoint so
+        backward re-gathers (ZeRO-3 allgather-on-use)."""
+        out = {}
+        for n, v in lp_flat.items():
+            local_rest, lsz, _szl, _ax, _rest = self._s3meta[prefix + n]
+            full = lax.all_gather(v, SH_AXIS, tiled=True)
+            out[n] = full[:lsz].reshape(local_rest)
+        return out
+
+    def maybe_from_stage3(self, stages):
+        """Inverse layout transform: [S, M, R, n_shard, szl] → [S, R, *rest]
+        (host side, for sync_to_model / tests)."""
+        if not self._stage3:
+            return stages
+        out = {}
+        for n, arr in stages.items():
+            local_rest, lsz, szl, model_axis, rest = self._s3meta[n]
+            S, m_dim, R = arr.shape[:3]
+            flat = arr.reshape(S, m_dim, R, arr.shape[3] * szl)[..., :lsz]
+            parts = flat.reshape((S, m_dim, R) + local_rest)
+            if model_axis and m_dim > 1:
+                # the model-sharded rest dim is the one whose size shrank
+                d = next(i for i in range(len(rest))
+                         if rest[i] != local_rest[i])
+                out[n] = jnp.concatenate(
+                    [parts[:, j] for j in range(m_dim)], axis=2 + d)
+            else:
+                out[n] = parts[:, 0]
+        return out
+
+    def param_memory_report(self):
+        """Per-rank stage-param bytes under the current layout (the ZeRO-3
+        accounting line: stage bytes ÷ (mp|ep parts × shard degree))."""
+        stage_global = 0
+        stage_local = 0
+        for n, arr in self.stage_params.items():
+            nbytes = arr.size * arr.dtype.itemsize
+            stage_global += nbytes
+            local = _local_shape(arr.shape, self.stage_specs[n], self.mesh)
+            lsize = 1
+            for s in local:
+                lsize *= s
+            stage_local += lsize * arr.dtype.itemsize
+        shared = sum(a.size * a.dtype.itemsize
+                     for a in self.shared_params.values())
+        return {
+            "stage_param_bytes_global": stage_global,
+            "stage_param_bytes_per_rank": stage_local,
+            "shared_param_bytes": shared,
+            "sharding_degree": getattr(self, "_s3_nshard", 1)
+            if self._stage3 else 1,
+            "stage3": self._stage3,
+        }
+
     # -- hooks -----------------------------------------------------------
     def _inject(self, shared, x_mb, key=None):
         raise NotImplementedError
@@ -285,7 +397,7 @@ class PipelineModule:
         policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                   if self._remat_policy == "selective" else None)
 
-        def run_layer(tmpl, lp, h, lk):
+        def run_layer(tmpl, lp, h, lk, prefix=""):
             # per-layer remat: without it the tick backward materializes
             # EVERY layer's residuals (e.g. [k, mb, T, 4H] MLP
             # intermediates) simultaneously — per-layer checkpoint bounds
@@ -294,6 +406,11 @@ class PipelineModule:
             # as well would recompute the forward twice (measured +35% step
             # time at 350m)
             def _one(lp, h, lk):
+                if self._stage3:
+                    # ZeRO-3 allgather-on-use inside the remat region: the
+                    # checkpoint saves only the [szl] slices; backward
+                    # re-gathers, and the gather's VJP reduce-scatters grads
+                    lp = self._s3_gather(lp, prefix)
                 saved = get_rng_state()
                 set_rng_state(lk)
                 try:
@@ -332,7 +449,7 @@ class PipelineModule:
                 if name.startswith(prefix)
             }
             lk = jax.random.fold_in(mb_key, layer_base + i)
-            h, aux = run_layer(tmpl, lp, h, lk)
+            h, aux = run_layer(tmpl, lp, h, lk, prefix=prefix)
             aux_sum = aux_sum + aux
         return h, aux_sum
 
@@ -350,6 +467,12 @@ class PipelineModule:
         x_mb = x.reshape((m, mb) + x.shape[1:])
         y_mb = y.reshape((m, mb) + y.shape[1:])
         local_stage = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        if self._stage3:
+            # [1, R, 1, szl] local slice → [R, szl] rows of flat slices
+            local_stage = {
+                n: a.reshape(a.shape[1], a.shape[3])
+                for n, a in local_stage.items()
+            }
         use_rng = key is not None and self._training and self._has_dropout()
         if key is None:
             key = jax.random.key(0)
@@ -450,7 +573,7 @@ class GPTPipelineModule(PipelineModule):
 
     def __init__(self, model, num_stages: int, microbatches: int, mesh=None,
                  num_virtual_stages: int = 1, remat_policy: str = "full",
-                 scan_unroll: int = 1):
+                 scan_unroll: int = 1, sharding_stage: int = 2):
         cfg = model.gpt.config
         aux_w = float(getattr(cfg, "moe_aux_loss_weight", 0.0) or 0.0)
 
@@ -464,7 +587,7 @@ class GPTPipelineModule(PipelineModule):
             num_virtual_stages=num_virtual_stages, training=model.training,
             aux_of=aux_of if getattr(cfg, "num_experts", 0) else None,
             aux_weight=aux_w, remat_policy=remat_policy,
-            scan_unroll=scan_unroll)
+            scan_unroll=scan_unroll, sharding_stage=sharding_stage)
         self.model = model
         self.cfg = cfg
         emb = model.gpt.embeddings
@@ -669,6 +792,22 @@ def _zero_slot_layout(pipe, optimizer, mesh, n_shard):
         slots[grp] = {}
         for n, arr in params.items():
             spec = specs[n]
+            if grp == "stages" and pipe._stage3:
+                # slots mirror the stage-3 param layout exactly: each rank
+                # updates its own [R, szl] slices in place
+                szl = arr.shape[-1]
+                local = _local_shape(arr.shape, spec, mesh)
+                lsize = 1
+                for s in local:
+                    lsize *= s
+                layouts[grp][n] = (lsize, szl, spec)
+                init = optimizer._init_slots(jnp.zeros((szl,), arr.dtype))
+                slots[grp][n] = {
+                    sn: jax.device_put(jnp.broadcast_to(sv, arr.shape),
+                                       NamedSharding(mesh, spec))
+                    for sn, sv in init.items()
+                }
+                continue
             local = _local_shape(arr.shape, spec, mesh)
             size = 1
             for s in local:
@@ -696,9 +835,10 @@ def _zero_slot_layout(pipe, optimizer, mesh, n_shard):
     return layouts, slots
 
 
-def _clip_grads_meshaware(clip, grads, pipe, mesh_axes):
+def _clip_grads_meshaware(clip, grads, pipe, mesh_axes, stage3=False):
     """Gradient clipping inside the shard_map body: the global norm must sum
-    squares over the 'pp' stack and the 'mp'/'ep' shards of each leaf
+    squares over the 'pp' stack and the 'mp'/'ep' shards of each leaf —
+    plus, under ZeRO-3, the 'sharding' slices of stage leaves
     (reference: sharding/utils ClipGradByGlobalNorm cross-rank norm reduce)."""
     from ...nn.clip import ClipGradByGlobalNorm, ClipGradByValue
 
@@ -721,6 +861,8 @@ def _clip_grads_meshaware(clip, grads, pipe, mesh_axes):
                     s = lax.psum(s, ax)
             if grp == "stages":
                 s = lax.psum(s, PP_AXIS)  # each pp rank owns distinct layers
+                if stage3:
+                    s = lax.psum(s, SH_AXIS)  # ZeRO-3: distinct slices/rank
             sumsq = sumsq + s
     norm = jnp.sqrt(sumsq)
     scale = clip.clip_norm / jnp.maximum(norm, clip.clip_norm)
@@ -764,15 +906,24 @@ def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
     reduce + Shard param split + broadcast-back."""
     clip = optimizer._grad_clip
     scatter = has_sh and n_shard > 1
+    stage3 = pipe._stage3
     sliced = False
     if clip is not None:
         if scatter:
             # the norm needs fully reduced grads: trade the reduce-scatter
-            # for an all-reduce, then slice
-            grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, SH_AXIS), grads)
+            # for an all-reduce, then slice. Stage-3 stage grads are already
+            # reduced slices — leave them; their sq-sums psum over
+            # 'sharding' inside _clip_grads_meshaware instead.
+            if stage3:
+                grads["shared"] = jax.tree_util.tree_map(
+                    lambda g: lax.pmean(g, SH_AXIS), grads["shared"])
+            else:
+                grads = jax.tree_util.tree_map(
+                    lambda g: lax.pmean(g, SH_AXIS), grads)
             scatter = False
             sliced = True
-        grads = _clip_grads_meshaware(clip, grads, pipe, mesh_axes)
+        grads = _clip_grads_meshaware(clip, grads, pipe, mesh_axes,
+                                      stage3=stage3 and has_sh)
 
     wd = optimizer._weight_decay_coeff
     decoupled = optimizer._decoupled_wd
@@ -782,7 +933,7 @@ def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
     step = opt_state["step"] + 1
     upd = type(optimizer)._update
 
-    def leaf(p, g, slots, decay_ok):
+    def leaf(p, g, slots, decay_ok, s3=False):
         g = g.astype(p.dtype)
         leaf_wd = wd if decay_ok else 0.0
         # optimizers that pack wd into their hyper tuple expose the
@@ -790,6 +941,15 @@ def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
         leaf_hyper = hyper if decay_ok else hyper_no_decay
         if leaf_wd and not decoupled:
             g = g + leaf_wd * p
+        if s3:
+            # ZeRO-3 leaf: p/g/slots are this rank's slices already — update
+            # in place, no re-sharding and no gather-back (the forward
+            # gathers on use)
+            sl = {k: v.reshape(-1) for k, v in slots.items()}
+            pn, sn = upd(p.reshape(-1), g.reshape(-1), sl, lr, step,
+                         leaf_hyper)
+            return (pn.reshape(p.shape),
+                    {k: v.reshape(slots[k].shape) for k, v in sn.items()})
         size = p.size
         sz = -(-size // n_shard)
         pad = sz * n_shard - size
@@ -820,7 +980,8 @@ def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
         for n in params[grp]:
             decay_ok = True if decay_masks is None else decay_masks[grp][n]
             pn, sn = leaf(params[grp][n], grads[grp][n],
-                          opt_state["slots"][grp][n], decay_ok)
+                          opt_state["slots"][grp][n], decay_ok,
+                          s3=stage3 and grp == "stages")
             new_p[grp][n] = pn
             new_s[grp][n] = sn
     return new_p, {"slots": new_s, "step": step}
@@ -870,16 +1031,23 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
             return pipe.local_loss(params["stages"], params["shared"], x, y, key)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
-        # local slot slices arrive [1, 1, 1, sz]: flatten for the update
+        # local slot slices arrive [1, 1, 1, sz] (ZeRO-2) or
+        # [1, 1, R, 1, szl] (ZeRO-3): flatten for the update
         local_opt = {
             "slots": jax.tree_util.tree_map(
-                lambda a: a.reshape(a.shape[-1:]), opt_state["slots"]),
+                lambda a: a.reshape(-1), opt_state["slots"]),
             "step": opt_state["step"],
         }
         # shared (tied/replicated) params were used by several stages:
         # combine their grads over 'pp' (≙ SharedLayerDesc allreduce)
         grads["shared"] = jax.tree_util.tree_map(
             lambda g: lax.psum(g, PP_AXIS), grads["shared"])
+        if has_sh and pipe._stage3:
+            # ZeRO-3 stage grads arrive reduce-scattered (all_gather VJP):
+            # the SUM over sharding ranks of per-rank local-mean losses —
+            # scale to the grad of the global MEAN loss
+            grads["stages"] = jax.tree_util.tree_map(
+                lambda g: g / n_shard, grads["stages"])
         if has_dp:
             grads = jax.tree_util.tree_map(
                 lambda g: lax.pmean(g, DP_AXIS), grads)
@@ -905,10 +1073,11 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
         new_params, new_opt = _apply_updates(
             optimizer, params, grads, local_opt, n_shard, has_sh, pipe,
             mesh_axes, lr)
-        # restore the [1, 1, 1, sz] layout for the out specs
+        # restore each slot's local layout for the out specs
         new_opt = {
             "slots": jax.tree_util.tree_map(
-                lambda a: a.reshape((1, 1, 1) + a.shape), new_opt["slots"]),
+                lambda new, old: new.reshape(old.shape),
+                new_opt["slots"], opt_state["slots"]),
             "step": new_opt["step"],
         }
         return new_params, new_opt, loss
@@ -945,18 +1114,22 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
     step.pipe = pipe
     step.state = state
     step.sync_to_model = lambda: pipe.sync_to_model(
-        state["params"]["stages"], state["params"]["shared"])
+        pipe.maybe_from_stage3(state["params"]["stages"]),
+        state["params"]["shared"])
     return step
 
 
 def build_gpt_pipeline_step(model, optimizer, *, microbatches: int,
                             num_stages: Optional[int] = None, mesh=None,
                             num_virtual_stages: int = 1, compute_dtype=None,
-                            remat_policy: str = "full", scan_unroll: int = 1):
+                            remat_policy: str = "full", scan_unroll: int = 1,
+                            sharding_stage: int = 2):
     """Build the jitted hybrid train step for a GPT model over a mesh with
     any subset of {'pp' (required), 'mp', 'ep', 'dp', 'sharding'} axes.
     Batch dim 0 is sharded over dp x sharding x ep. Per-param AdamW decay
-    overrides (apply_decay_param_fun) are honored.
+    overrides (apply_decay_param_fun) are honored. ``sharding_stage=3``
+    additionally shards the stage params over 'sharding' with
+    allgather-on-use (ZeRO-3; stage 2 shards grads + optimizer slots only).
 
     Returns a callable ``step(x, y) -> loss`` holding sharded params +
     optimizer state; ``step.sync_to_model()`` writes arrays back.
@@ -967,7 +1140,8 @@ def build_gpt_pipeline_step(model, optimizer, *, microbatches: int,
     num_stages = num_stages or int(mesh.shape[PP_AXIS])
     pipe = GPTPipelineModule(model, num_stages, microbatches, mesh=mesh,
                              num_virtual_stages=num_virtual_stages,
-                             remat_policy=remat_policy, scan_unroll=scan_unroll)
+                             remat_policy=remat_policy, scan_unroll=scan_unroll,
+                             sharding_stage=sharding_stage)
     # shared leaves ↔ live Parameters (decay-mask naming)
     emb = model.gpt.embeddings
     pipe._shared_param_tensors = {
