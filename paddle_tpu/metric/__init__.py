@@ -12,7 +12,8 @@ import abc
 
 import numpy as np
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc",
+           "accuracy", "mean_iou", "chunk_eval"]
 
 
 def _to_np(x):
@@ -203,3 +204,128 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A
     topk_idx = jnp.argsort(-scores, axis=-1)[:, :k]
     hit = (topk_idx == lab[:, None].astype(topk_idx.dtype)).any(axis=1)
     return wrap(hit.mean(dtype=jnp.float32))
+
+
+def mean_iou(input, label, num_classes, name=None):  # noqa: A002
+    """Segmentation mean IoU (parity: mean_iou_op.h MeanIoUKernel):
+    correct[c] = #(pred == label == c); wrong[c] counts both sides of every
+    mismatch; per-class IoU = correct / (correct + wrong); mean over classes
+    that appear. Returns (mean_iou scalar f32, out_wrong [C] i32,
+    out_correct [C] i32)."""
+    import jax.numpy as jnp
+
+    from ..ops._primitive import primitive, unwrap
+
+    @primitive(nondiff=True)
+    def _miou(pred, lab):
+        p = pred.reshape(-1).astype(jnp.int32)
+        y = lab.reshape(-1).astype(jnp.int32)
+        eq = p == y
+        correct = jnp.zeros((num_classes,), jnp.int32).at[
+            jnp.where(eq, p, num_classes)].add(1, mode="drop")
+        wrong = jnp.zeros((num_classes,), jnp.int32)
+        wrong = wrong.at[jnp.where(~eq, y, num_classes)].add(1, mode="drop")
+        wrong = wrong.at[jnp.where(~eq, p, num_classes)].add(1, mode="drop")
+        denom = correct + wrong
+        valid = (denom > 0).sum()
+        iou = correct.astype(jnp.float32) / jnp.maximum(denom, 1).astype(jnp.float32)
+        mean = iou.sum() / jnp.maximum(valid, 1).astype(jnp.float32)
+        return mean, wrong, correct
+
+    return _miou(unwrap(input), unwrap(label))
+
+
+def _chunk_segments(seq, scheme, num_chunk_types):
+    """Segment extraction per chunk_eval_op.h GetSegments/ChunkBegin/ChunkEnd.
+    Returns a set of (begin, end, type) with tags decoded as
+    tag = label % num_tag_types, type = label // num_tag_types; type ==
+    num_chunk_types is the 'other' (outside) class."""
+    schemes = {
+        "IOB": (2, 0, 1, -1, -1),
+        "IOE": (2, -1, 0, 1, -1),
+        "IOBES": (4, 0, 1, 2, 3),
+        "plain": (1, -1, -1, -1, -1),
+    }
+    ntag, t_begin, t_inside, t_end, t_single = schemes[scheme]
+    other = num_chunk_types
+
+    def is_end(pt, py, t, y):
+        if py == other:
+            return False
+        if y == other or y != py:
+            return True
+        if pt == t_begin or pt == t_inside:
+            return t in (t_begin, t_single)
+        return pt in (t_end, t_single)
+
+    def is_begin(pt, py, t, y):
+        if py == other:
+            return y != other
+        if y == other:
+            return False
+        if y != py:
+            return True
+        if t == t_begin or t == t_single:
+            return True
+        return t in (t_inside, t_end) and pt in (t_end, t_single)
+
+    segs = set()
+    in_chunk = False
+    start = 0
+    tag, typ = -1, other
+    for i, lab in enumerate(seq):
+        pt, py = tag, typ
+        tag, typ = int(lab) % ntag, int(lab) // ntag
+        if in_chunk and is_end(pt, py, tag, typ):
+            segs.add((start, i - 1, py))
+            in_chunk = False
+        if is_begin(pt, py, tag, typ):
+            start = i
+            in_chunk = True
+    if in_chunk:
+        segs.add((start, len(seq) - 1, typ))
+    return segs
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,  # noqa: A002
+               excluded_chunk_types=None, seq_length=None, name=None):
+    """Chunk (NER) F1 evaluation (parity: chunk_eval_op.h ChunkEvalKernel).
+    input/label: (B, T) int labels (padded; ``seq_length`` gives valid
+    lengths). Host op like the reference's CPU-only kernel. Returns
+    (precision, recall, f1, num_infer, num_label, num_correct)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..ops._primitive import unwrap, wrap
+
+    pred = np.asarray(unwrap(input))
+    lab = np.asarray(unwrap(label))
+    if pred.ndim == 1:
+        pred, lab = pred[None], lab[None]
+    B, T = pred.shape
+    if seq_length is None:
+        lens = np.full((B,), T, np.int64)
+    else:
+        lens = np.asarray(unwrap(seq_length)).astype(np.int64)
+    excluded = set(excluded_chunk_types or ())
+    n_inf = n_lab = n_cor = 0
+    for b in range(B):
+        sl = int(lens[b])
+        inf_segs = {s for s in _chunk_segments(pred[b, :sl], chunk_scheme,
+                                               num_chunk_types)
+                    if s[2] not in excluded}
+        lab_segs = {s for s in _chunk_segments(lab[b, :sl], chunk_scheme,
+                                               num_chunk_types)
+                    if s[2] not in excluded}
+        n_inf += len(inf_segs)
+        n_lab += len(lab_segs)
+        n_cor += len(inf_segs & lab_segs)
+    precision = n_cor / n_inf if n_inf else 0.0
+    recall = n_cor / n_lab if n_lab else 0.0
+    f1 = (2 * precision * recall / (precision + recall)
+          if n_cor else 0.0)
+    return (wrap(jnp.float32(precision)), wrap(jnp.float32(recall)),
+            wrap(jnp.float32(f1)),
+            wrap(jnp.int64(n_inf)), wrap(jnp.int64(n_lab)),
+            wrap(jnp.int64(n_cor)))

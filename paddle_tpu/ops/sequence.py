@@ -21,8 +21,21 @@ __all__ = [
     "sequence_pad",
     "sequence_unpad",
     "sequence_expand",
+    "sequence_expand_as",
     "sequence_reverse",
     "sequence_softmax",
+    "sequence_concat",
+    "sequence_pool",
+    "sequence_first_step",
+    "sequence_last_step",
+    "sequence_conv",
+    "sequence_enumerate",
+    "sequence_erase",
+    "sequence_reshape",
+    "sequence_scatter",
+    "sequence_slice",
+    "row_conv",
+    "im2sequence",
 ]
 
 
@@ -158,3 +171,286 @@ def sequence_softmax(x, length=None, name=None):
         return jnp.where(valid, sm, 0.0)
 
     return _sm(x, None if length is None else unwrap(length))
+
+
+def sequence_expand_as(x, y_lengths, name=None):
+    """sequence_expand_as op: row i of x repeats to the length of y's
+    sequence i (sequence_expand_as_op.cc — x's own LoD is ignored)."""
+    return sequence_expand(x, y_lengths, ref_level=0)
+
+
+def sequence_concat(inputs, lengths, name=None):
+    """Per-sequence concat of ragged batches (sequence_concat_op.cc): output
+    sequence b = input0's seq b ++ input1's seq b ++ ... Inputs are flat
+    (sum_i, ...) arrays with per-input lengths [B]. Returns
+    (flat out, out_lengths)."""
+    lens = [np.asarray(unwrap(ln)).astype(np.int64) for ln in lengths]
+    B = len(lens[0])
+    starts = [np.concatenate([[0], np.cumsum(ln)[:-1]]) for ln in lens]
+    # row indices into the concatenation of all inputs — one gather, not
+    # per-row slices
+    input_offs = np.concatenate(
+        [[0], np.cumsum([unwrap(x).shape[0] for x in inputs])[:-1]])
+    gather = []
+    for b in range(B):
+        for k in range(len(lens)):
+            s = int(input_offs[k] + starts[k][b])
+            gather.append(np.arange(s, s + int(lens[k][b])))
+    idx = (np.concatenate(gather) if gather else np.zeros((0,), np.int64))
+    out_lens = np.stack([ln for ln in lens]).sum(axis=0)
+
+    @primitive
+    def _cat(*xs):
+        return jnp.take(jnp.concatenate(list(xs), axis=0),
+                        jnp.asarray(idx), axis=0)
+
+    return _cat(*inputs), wrap(jnp.asarray(out_lens))
+
+
+def sequence_pool(x, pool_type, length=None, pad_value=0.0, name=None):
+    """Pool each sequence's valid prefix to one row (sequence_pool op,
+    math/sequence_pooling.cc SequencePoolFunctor). x: (B, T, ...) padded;
+    pool_type in SUM/AVERAGE/SQRT/MAX/LAST/FIRST. Empty sequences yield
+    ``pad_value``."""
+    ptype = pool_type.upper()
+    if ptype not in ("SUM", "AVERAGE", "SQRT", "MAX", "LAST", "FIRST"):
+        raise ValueError(f"unsupported pool_type {pool_type!r}")
+
+    @primitive
+    def _pool(x, lens):
+        T = x.shape[1]
+        if lens is None:
+            ln = jnp.full((x.shape[0],), T, jnp.int32)
+        else:
+            ln = lens.astype(jnp.int32)
+        pos = jnp.arange(T)[None, :]
+        valid = (pos < ln[:, None]).reshape(
+            (x.shape[0], T) + (1,) * (x.ndim - 2))
+        lnf = ln.astype(x.dtype).reshape((-1,) + (1,) * (x.ndim - 2))
+        if ptype == "MAX":
+            neg = jnp.asarray(jnp.finfo(x.dtype).min
+                              if jnp.issubdtype(x.dtype, jnp.floating)
+                              else jnp.iinfo(x.dtype).min, x.dtype)
+            out = jnp.max(jnp.where(valid, x, neg), axis=1)
+        elif ptype == "FIRST":
+            out = x[:, 0]
+        elif ptype == "LAST":
+            idx = jnp.maximum(ln - 1, 0)
+            out = jnp.take_along_axis(
+                x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1)[:, 0]
+        else:
+            s = jnp.sum(jnp.where(valid, x, 0), axis=1)
+            if ptype == "AVERAGE":
+                out = s / jnp.maximum(lnf, 1)
+            elif ptype == "SQRT":
+                out = s / jnp.sqrt(jnp.maximum(lnf, 1))
+            else:
+                out = s
+        empty = (ln == 0).reshape((-1,) + (1,) * (x.ndim - 2))
+        return jnp.where(empty, jnp.asarray(pad_value, x.dtype), out)
+
+    return _pool(x, None if length is None else unwrap(length))
+
+
+def sequence_first_step(x, length=None, name=None):
+    return sequence_pool(x, "FIRST", length=length)
+
+
+def sequence_last_step(x, length=None, name=None):
+    return sequence_pool(x, "LAST", length=length)
+
+
+def sequence_conv(x, weight, length=None, context_length=3, context_start=None,
+                  bias=None, name=None):
+    """Context-window projection (sequence_conv_op): each timestep gathers
+    rows [t+start, t+start+context_length) of ITS OWN sequence (zeros
+    outside), flattens to context_length*D and multiplies the filter
+    [context_length*D, out]. x: (B, T, D) padded."""
+    if context_start is None:
+        context_start = -(context_length // 2)  # reference python default
+
+    @primitive
+    def _conv(x, w, b, lens):
+        B, T, D = x.shape
+        if lens is None:
+            ln = jnp.full((B,), T, jnp.int32)
+        else:
+            ln = lens.astype(jnp.int32)
+        pos = jnp.arange(T)
+        cols = []
+        for j in range(context_length):
+            src = pos + context_start + j
+            ok = (src >= 0) & (src < ln[:, None])
+            g = jnp.take(x, jnp.clip(src, 0, T - 1), axis=1)
+            cols.append(jnp.where(ok[..., None], g, 0.0))
+        ctx = jnp.concatenate(cols, axis=-1)  # (B, T, ctx*D)
+        out = jnp.einsum("btk,ko->bto", ctx, w)
+        if b is not None:
+            out = out + b
+        # zero rows beyond each sequence's length
+        valid = (pos[None, :] < ln[:, None])[..., None]
+        return jnp.where(valid, out, 0.0)
+
+    return _conv(x, weight, bias, None if length is None else unwrap(length))
+
+
+def sequence_enumerate(x, win_size, pad_value=0, length=None, name=None):
+    """Rolling windows per sequence (sequence_enumerate_op): out[t] =
+    [x[t], ..., x[t+win-1]] with positions past the sequence end set to
+    pad_value. x: (B, T) int ids (dense form of the flat LoD input)."""
+
+    @primitive(nondiff=True)
+    def _enum(x, lens):
+        B, T = x.shape
+        if lens is None:
+            ln = jnp.full((B,), T, jnp.int32)
+        else:
+            ln = lens.astype(jnp.int32)
+        pos = jnp.arange(T)
+        outs = []
+        for j in range(win_size):
+            src = pos + j
+            ok = src < ln[:, None]
+            g = jnp.take(x, jnp.clip(src, 0, T - 1), axis=1)
+            outs.append(jnp.where(ok, g, jnp.asarray(pad_value, x.dtype)))
+        out = jnp.stack(outs, axis=-1)  # (B, T, win)
+        valid = pos[None, :] < ln[:, None]
+        return jnp.where(valid[..., None], out,
+                         jnp.asarray(pad_value, x.dtype))
+
+    return _enum(x, None if length is None else unwrap(length))
+
+
+def sequence_erase(x, tokens, length=None, name=None):
+    """Remove listed tokens from each sequence (sequence_erase_op). Dynamic
+    per-sequence lengths — eager host op, like the reference's LoD output.
+    x: (B, T) ids; returns (out (B, T) padded with 0, new_lengths)."""
+    xs = np.asarray(unwrap(x))
+    B, T = xs.shape
+    if length is None:
+        lens = np.full((B,), T, np.int64)
+    else:
+        lens = np.asarray(unwrap(length)).astype(np.int64)
+    drop = set(int(t) for t in tokens)
+    out = np.zeros_like(xs)
+    new_lens = np.zeros((B,), np.int64)
+    for b in range(B):
+        kept = [v for v in xs[b, : int(lens[b])] if int(v) not in drop]
+        out[b, : len(kept)] = kept
+        new_lens[b] = len(kept)
+    return wrap(jnp.asarray(out)), wrap(jnp.asarray(new_lens))
+
+
+def sequence_reshape(x, new_dim, length=None, name=None):
+    """Re-chunk each sequence's payload to ``new_dim`` columns
+    (sequence_reshape_op): sequence b's len[b]*D values become
+    len[b]*D/new_dim rows. x: flat (total, D) + lengths. Returns
+    (flat (total*D/new_dim, new_dim), new_lengths)."""
+    xs = unwrap(x)
+    D = xs.shape[1]
+    if length is None:
+        lens = np.asarray([xs.shape[0]], np.int64)
+    else:
+        lens = np.asarray(unwrap(length)).astype(np.int64)
+    if (lens * D % new_dim).any():
+        raise ValueError("each sequence's payload must divide new_dim "
+                         "(sequence_reshape_op InferShape)")
+
+    @primitive
+    def _rs(x):
+        return x.reshape(-1, new_dim)
+
+    return _rs(x), wrap(jnp.asarray(lens * D // new_dim))
+
+
+def sequence_scatter(x, index, updates, index_lengths=None, name=None):
+    """Scatter-add per-sequence updates into rows of x
+    (sequence_scatter_op): for sequence b, x[b, index[j]] += updates[j].
+    x: (B, D); index/updates: flat (sum_lens,) [+ (.., ) payload] with
+    per-sequence counts ``index_lengths``."""
+    idx = np.asarray(unwrap(index)).astype(np.int64)
+    if index_lengths is None:
+        lens = np.asarray([idx.shape[0]], np.int64)
+    else:
+        lens = np.asarray(unwrap(index_lengths)).astype(np.int64)
+    rows = np.repeat(np.arange(len(lens)), lens)
+
+    @primitive
+    def _scatter(x, updates):
+        return jnp.asarray(x).at[jnp.asarray(rows), jnp.asarray(idx)].add(
+            jnp.asarray(updates).astype(x.dtype))
+
+    return _scatter(x, updates)
+
+
+def sequence_slice(x, offset, length, seq_lengths=None, name=None):
+    """Per-sequence slice (sequence_slice_op): sequence b keeps rows
+    [offset[b], offset[b]+length[b]). x: (B, T, ...) padded. Returns
+    (out (B, max(length), ...) padded with 0, new lengths)."""
+    offs = np.asarray(unwrap(offset)).astype(np.int64).reshape(-1)
+    lns = np.asarray(unwrap(length)).astype(np.int64).reshape(-1)
+    ml = int(lns.max()) if lns.size else 0
+
+    @primitive
+    def _slice(x):
+        pos = jnp.arange(ml)[None, :]
+        src = jnp.asarray(offs)[:, None] + pos
+        ok = pos < jnp.asarray(lns)[:, None]
+        g = jnp.take_along_axis(
+            x, jnp.clip(src, 0, x.shape[1] - 1).reshape(
+                (x.shape[0], ml) + (1,) * (x.ndim - 2)), axis=1)
+        return jnp.where(ok.reshape((x.shape[0], ml) + (1,) * (x.ndim - 2)),
+                         g, 0)
+
+    return _slice(x), wrap(jnp.asarray(lns))
+
+
+def row_conv(x, weight, length=None, name=None):
+    """Lookahead row convolution (row_conv_op, DeepSpeech2): out[t] =
+    sum_j w[j] * x[t+j] over the future context window, within-sequence.
+    x: (B, T, D); weight: (context, D)."""
+
+    @primitive
+    def _rc(x, w, lens):
+        B, T, D = x.shape
+        if lens is None:
+            ln = jnp.full((B,), T, jnp.int32)
+        else:
+            ln = lens.astype(jnp.int32)
+        pos = jnp.arange(T)
+        out = jnp.zeros_like(x)
+        for j in range(w.shape[0]):
+            src = pos + j
+            ok = src < ln[:, None]
+            g = jnp.take(x, jnp.clip(src, 0, T - 1), axis=1)
+            out = out + jnp.where(ok[..., None], g, 0.0) * w[j]
+        valid = (pos[None, :] < ln[:, None])[..., None]
+        return jnp.where(valid, out, 0.0)
+
+    return _rc(x, weight, None if length is None else unwrap(length))
+
+
+def im2sequence(x, filter_size, stride=1, padding=0, name=None):
+    """Image patches → sequence rows (im2sequence_op): NCHW input becomes
+    (N*out_h*out_w, kh*kw*C) rows in raster order."""
+    kh, kw = ((filter_size, filter_size)
+              if isinstance(filter_size, int) else tuple(filter_size))
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    if isinstance(padding, int):
+        pad = (padding, padding, padding, padding)
+    else:
+        pad = tuple(padding)
+        if len(pad) == 2:
+            pad = pad + pad
+
+    @primitive
+    def _im2seq(x):
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), (sh, sw),
+            padding=((pad[0], pad[2]), (pad[1], pad[3])))
+        # patches: (N, C*kh*kw, oh, ow) with channel-major feature order
+        n, f, oh, ow = patches.shape
+        out = jnp.transpose(patches, (0, 2, 3, 1)).reshape(n * oh * ow, f)
+        return out
+
+    return _im2seq(x)

@@ -5,6 +5,6 @@ from . import models  # noqa: F401
 def __getattr__(name):
     import importlib
 
-    if name in ("transforms", "datasets", "ops"):
+    if name in ("transforms", "datasets", "ops", "detection"):
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(name)

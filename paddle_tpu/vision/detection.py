@@ -1,0 +1,922 @@
+"""paddle_tpu.vision.detection — the detection op family.
+
+Parity: paddle/fluid/operators/detection/ (34 op files) — prior_box_op.h,
+density_prior_box_op.h, anchor_generator_op.h, box_coder_op.h,
+iou_similarity_op.h, box_clip_op.h, bipartite_match_op.cc,
+multiclass_nms_op.cc (NMSFast/MultiClassNMS/MultiClassOutput),
+matrix_nms_op.cc (NMSMatrix decay), generate_proposals_op.cc /
+generate_proposals_v2_op.cc (+ bbox_util.h BoxCoder/FilterBoxes),
+distribute_fpn_proposals_op.h, collect_fpn_proposals_op.h,
+sigmoid_focal_loss_op.cc, target_assign_op.h, polygon_box_transform_op.cc,
+box_decoder_and_assign_op.h, mine_hard_examples_op.cc.
+
+TPU-native redesign: every op is a static-shape XLA program. Ops whose
+reference output is dynamically sized (NMS families, proposals, FPN
+distribute) follow the framework's LoD redesign — fixed-capacity padded
+arrays plus a valid-count (``rois_num``); padding rows carry label -1 and
+zero boxes. Greedy/sequential reference loops (NMS, bipartite match) become
+``lax.fori_loop`` programs over precomputed pairwise matrices so they jit.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops._primitive import primitive
+from ..tensor import Tensor
+
+__all__ = [
+    "prior_box",
+    "density_prior_box",
+    "anchor_generator",
+    "box_coder",
+    "iou_similarity",
+    "box_clip",
+    "bipartite_match",
+    "target_assign",
+    "sigmoid_focal_loss",
+    "multiclass_nms",
+    "multiclass_nms2",
+    "multiclass_nms3",
+    "matrix_nms",
+    "generate_proposals",
+    "generate_proposals_v2",
+    "distribute_fpn_proposals",
+    "collect_fpn_proposals",
+    "polygon_box_transform",
+    "box_decoder_and_assign",
+    "mine_hard_examples",
+]
+
+_BBOX_CLIP = math.log(1000.0 / 16.0)  # bbox_util.h kBBoxClipDefault
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    """prior_box_op.h ExpandAspectRatios: dedup, prepend 1, optionally add
+    reciprocals."""
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / float(ar))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# prior / anchor generators
+# ---------------------------------------------------------------------------
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),  # noqa: A002
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes (prior_box_op.h PriorBoxOpKernel). Returns
+    (boxes [H, W, P, 4] in normalized x1y1x2y2, variances [H, W, P, 4])."""
+    x = _arr(input)
+    img = _arr(image)
+    fh, fw = int(x.shape[2]), int(x.shape[3])
+    ih, iw = float(img.shape[2]), float(img.shape[3])
+    step_w = float(steps[0]) or iw / fw
+    step_h = float(steps[1]) or ih / fh
+    ars = _expand_aspect_ratios(list(aspect_ratios), flip)
+    min_sizes = [float(s) for s in np.atleast_1d(min_sizes)]
+    max_sizes = [float(s) for s in np.atleast_1d(max_sizes)] if max_sizes else []
+
+    # per-cell (half-)extents for each prior, in input pixels
+    ws, hs = [], []
+    for si, mn in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            ws.append(mn / 2.0), hs.append(mn / 2.0)
+            if max_sizes:
+                mx = math.sqrt(mn * max_sizes[si])
+                ws.append(mx / 2.0), hs.append(mx / 2.0)
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                ws.append(mn * math.sqrt(ar) / 2.0)
+                hs.append(mn / math.sqrt(ar) / 2.0)
+        else:
+            for ar in ars:
+                ws.append(mn * math.sqrt(ar) / 2.0)
+                hs.append(mn / math.sqrt(ar) / 2.0)
+            if max_sizes:
+                mx = math.sqrt(mn * max_sizes[si])
+                ws.append(mx / 2.0), hs.append(mx / 2.0)
+    half_w = jnp.asarray(ws, jnp.float32)  # [P]
+    half_h = jnp.asarray(hs, jnp.float32)
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w  # [W]
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h  # [H]
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, half_w.shape[0]))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, half_w.shape[0]))
+    boxes = jnp.stack([
+        (cxg - half_w) / iw, (cyg - half_h) / ih,
+        (cxg + half_w) / iw, (cyg + half_h) / ih,
+    ], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    return Tensor(boxes), Tensor(var)
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,  # noqa: A002
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """Density prior boxes (density_prior_box_op.h): per cell, each
+    (density, fixed_size) pair tiles density x density shifted centers with
+    every fixed_ratio."""
+    x = _arr(input)
+    img = _arr(image)
+    fh, fw = int(x.shape[2]), int(x.shape[3])
+    ih, iw = float(img.shape[2]), float(img.shape[3])
+    step_w = float(steps[0]) or iw / fw
+    step_h = float(steps[1]) or ih / fh
+
+    # enumerate per-cell prior offsets/extents (host loop — static config)
+    offs_x, offs_y, half_w, half_h = [], [], [], []
+    for size, density in zip(fixed_sizes, densities):
+        density = int(density)
+        shift = step_w / density
+        for ar in fixed_ratios:
+            bw = float(size) * math.sqrt(ar) / 2.0
+            bh = float(size) / math.sqrt(ar) / 2.0
+            for di in range(density):
+                for dj in range(density):
+                    offs_x.append(-step_w / 2.0 + shift / 2.0 + dj * shift)
+                    offs_y.append(-step_h / 2.0 + shift / 2.0 + di * shift)
+                    half_w.append(bw)
+                    half_h.append(bh)
+    ox = jnp.asarray(offs_x, jnp.float32)
+    oy = jnp.asarray(offs_y, jnp.float32)
+    hw = jnp.asarray(half_w, jnp.float32)
+    hh = jnp.asarray(half_h, jnp.float32)
+    p = ox.shape[0]
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    cxg = cx[None, :, None] + ox[None, None, :]
+    cyg = cy[:, None, None] + oy[None, None, :]
+    cxg = jnp.broadcast_to(cxg, (fh, fw, p))
+    cyg = jnp.broadcast_to(cyg, (fh, fw, p))
+    boxes = jnp.stack([
+        (cxg - hw) / iw, (cyg - hh) / ih,
+        (cxg + hw) / iw, (cyg + hh) / ih,
+    ], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    if flatten_to_2d:
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return Tensor(boxes), Tensor(var)
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variances, stride,  # noqa: A002
+                     offset=0.5, name=None):
+    """RPN anchors (anchor_generator_op.h): for each cell, one anchor per
+    (aspect_ratio, anchor_size); corners use the pixel (-1) convention."""
+    x = _arr(input)
+    fh, fw = int(x.shape[2]), int(x.shape[3])
+    sw, sh = float(stride[0]), float(stride[1])
+    widths, heights = [], []
+    for ar in aspect_ratios:
+        for size in anchor_sizes:
+            area = sw * sh
+            base_w = round(math.sqrt(area / ar))
+            base_h = round(base_w * ar)
+            widths.append(size / sw * base_w)
+            heights.append(size / sh * base_h)
+    aw = jnp.asarray(widths, jnp.float32)
+    ah = jnp.asarray(heights, jnp.float32)
+    xc = jnp.arange(fw, dtype=jnp.float32) * sw + offset * (sw - 1)
+    yc = jnp.arange(fh, dtype=jnp.float32) * sh + offset * (sh - 1)
+    xg = jnp.broadcast_to(xc[None, :, None], (fh, fw, aw.shape[0]))
+    yg = jnp.broadcast_to(yc[:, None, None], (fh, fw, aw.shape[0]))
+    anchors = jnp.stack([
+        xg - 0.5 * (aw - 1), yg - 0.5 * (ah - 1),
+        xg + 0.5 * (aw - 1), yg + 0.5 * (ah - 1),
+    ], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), anchors.shape)
+    return Tensor(anchors), Tensor(var)
+
+
+# ---------------------------------------------------------------------------
+# box geometry
+# ---------------------------------------------------------------------------
+
+def _box_wh(box, normalized):
+    off = 0.0 if normalized else 1.0
+    w = box[..., 2] - box[..., 0] + off
+    h = box[..., 3] - box[..., 1] + off
+    return w, h
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, variance=None, name=None):
+    """Encode/decode center-size box deltas (box_coder_op.h)."""
+    pb = _arr(prior_box).astype(jnp.float32)
+    tb = _arr(target_box).astype(jnp.float32)
+    pbv = None if prior_box_var is None else _arr(prior_box_var).astype(jnp.float32)
+    var_attr = (jnp.asarray(variance, jnp.float32)
+                if variance else None)
+
+    pw, ph = _box_wh(pb, box_normalized)
+    pcx = pb[..., 0] + pw / 2
+    pcy = pb[..., 1] + ph / 2
+
+    @primitive
+    def _encode(tb, pb_stats):
+        pcx, pcy, pw, ph = pb_stats  # each [M]
+        tw, th = _box_wh(tb, box_normalized)  # [N]
+        tcx = (tb[..., 2] + tb[..., 0]) / 2
+        tcy = (tb[..., 3] + tb[..., 1]) / 2
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        dh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)  # [N, M, 4]
+        if pbv is not None:
+            out = out / pbv[None, :, :]
+        elif var_attr is not None:
+            out = out / var_attr
+        return out
+
+    @primitive
+    def _decode(tb, pb_stats):
+        pcx, pcy, pw, ph = pb_stats
+        # broadcast prior stats along the non-prior axis
+        if axis == 0:
+            sh = (1, -1)
+        else:
+            sh = (-1, 1)
+        pcx, pcy = pcx.reshape(sh), pcy.reshape(sh)
+        pw, ph = pw.reshape(sh), ph.reshape(sh)
+        if pbv is not None:
+            v = pbv.reshape(sh + (4,))
+        elif var_attr is not None:
+            v = var_attr.reshape((1, 1, 4))
+        else:
+            v = jnp.ones((1, 1, 4), jnp.float32)
+        cx = v[..., 0] * tb[..., 0] * pw + pcx
+        cy = v[..., 1] * tb[..., 1] * ph + pcy
+        w = jnp.exp(v[..., 2] * tb[..., 2]) * pw
+        h = jnp.exp(v[..., 3] * tb[..., 3]) * ph
+        off = 0.0 if box_normalized else 1.0
+        return jnp.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - off, cy + h / 2 - off], axis=-1)
+
+    if code_type == "encode_center_size":
+        return _encode(tb, (pcx, pcy, pw, ph))
+    if code_type == "decode_center_size":
+        return _decode(tb, (pcx, pcy, pw, ph))
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+def _pairwise_iou(a, b, normalized, eps=1e-10):
+    """IoU matrix [N, M] (iou_similarity_op.h IOUSimilarity)."""
+    off = 0.0 if normalized else 1.0
+    area_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    area_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1 + off, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + off, 0.0)
+    inter = iw * ih
+    return inter / (area_a[:, None] + area_b[None, :] - inter + eps)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU [N, M] (iou_similarity_op)."""
+
+    @primitive
+    def _iou(x, y):
+        return _pairwise_iou(x.astype(jnp.float32), y.astype(jnp.float32),
+                             box_normalized)
+
+    return _iou(_arr(x), _arr(y))
+
+
+def box_clip(input, im_info, name=None):  # noqa: A002
+    """Clip boxes into [0, im - 1] (box_clip_op.h: im_info rows are
+    (height, width, scale); boxes clipped to the scaled image extent)."""
+
+    @primitive
+    def _clip(boxes, im_info):
+        im = im_info.astype(jnp.float32)
+        h = im[..., 0] / im[..., 2] - 1.0
+        w = im[..., 1] / im[..., 2] - 1.0
+        if boxes.ndim == 3:  # [N, M, 4]
+            w = w[:, None]
+            h = h[:, None]
+        x1 = jnp.clip(boxes[..., 0], 0.0, w)
+        y1 = jnp.clip(boxes[..., 1], 0.0, h)
+        x2 = jnp.clip(boxes[..., 2], 0.0, w)
+        y2 = jnp.clip(boxes[..., 3], 0.0, h)
+        return jnp.stack([x1, y1, x2, y2], axis=-1).astype(boxes.dtype)
+
+    return _clip(_arr(input), _arr(im_info))
+
+
+# ---------------------------------------------------------------------------
+# matching / assignment
+# ---------------------------------------------------------------------------
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=None,
+                    name=None):
+    """Greedy maximum bipartite matching (bipartite_match_op.cc): repeatedly
+    take the globally largest remaining distance, match that (row, col) pair
+    and retire both. ``per_prediction`` then argmax-fills unmatched columns
+    whose best row distance >= dist_threshold. Returns
+    (col_to_row_match_indices [1, M] int32, col_to_row_match_dist [1, M])."""
+
+    @primitive(nondiff=True)
+    def _match(dist):
+        dist = dist.astype(jnp.float32)
+        r, c = dist.shape
+        eps = 1e-6
+
+        def body(_, carry):
+            match, mdist, row_free = carry
+            masked = jnp.where(row_free[:, None] & (match < 0)[None, :]
+                               & (dist > eps), dist, -1.0)
+            flat = jnp.argmax(masked)
+            i, j = flat // c, flat % c
+            ok = masked[i, j] > 0
+            match = jnp.where(ok, match.at[j].set(i.astype(jnp.int32)), match)
+            mdist = jnp.where(ok, mdist.at[j].set(dist[i, j]), mdist)
+            row_free = jnp.where(ok, row_free.at[i].set(False), row_free)
+            return match, mdist, row_free
+
+        match = jnp.full((c,), -1, jnp.int32)
+        mdist = jnp.zeros((c,), jnp.float32)
+        row_free = jnp.ones((r,), bool)
+        match, mdist, _ = lax.fori_loop(0, min(r, c), body,
+                                        (match, mdist, row_free))
+        if match_type == "per_prediction":
+            thr = float(dist_threshold if dist_threshold is not None else 0.5)
+            best = jnp.max(dist, axis=0)
+            argbest = jnp.argmax(dist, axis=0).astype(jnp.int32)
+            fill = (match < 0) & (best >= thr) & (best > eps)
+            match = jnp.where(fill, argbest, match)
+            mdist = jnp.where(fill, best, mdist)
+        return match[None, :], mdist[None, :]
+
+    return _match(_arr(dist_matrix))
+
+
+def target_assign(input, matched_indices, negative_indices=None,  # noqa: A002
+                  negative_lengths=None, mismatch_value=0, name=None):
+    """Gather targets by match indices; unmatched (-1) slots get
+    mismatch_value with weight 0 (target_assign_op.h). ``negative_indices``
+    (flat per-image prior ids + ``negative_lengths`` counts, the LoD
+    redesign) marks hard-negative slots: they keep mismatch_value but get
+    weight 1 (NegTargetAssignFunctor). input: [M, K] rows indexed by
+    matched row id, matched_indices: [N, P]."""
+    neg_rows = neg_cols = None
+    if negative_indices is not None:
+        ni = np.asarray(_arr(negative_indices)).astype(np.int64).reshape(-1)
+        if negative_lengths is None:
+            nl = np.asarray([ni.shape[0]], np.int64)
+        else:
+            nl = np.asarray(_arr(negative_lengths)).astype(np.int64).reshape(-1)
+        neg_rows = np.repeat(np.arange(len(nl)), nl)
+        neg_cols = ni
+
+    @primitive
+    def _assign(x, idx):
+        safe = jnp.maximum(idx, 0)
+        out = jnp.take(x, safe, axis=0)  # [N, P, K]
+        miss = (idx < 0)[..., None]
+        out = jnp.where(miss, jnp.asarray(mismatch_value, x.dtype), out)
+        w = jnp.where(miss[..., 0], 0.0, 1.0)
+        if neg_rows is not None:
+            w = w.at[jnp.asarray(neg_rows), jnp.asarray(neg_cols)].set(1.0)
+        return out, w
+
+    return _assign(_arr(input), _arr(matched_indices))
+
+
+def sigmoid_focal_loss(x, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       name=None):
+    """Focal loss on per-class logits (sigmoid_focal_loss_op.cc): label is
+    the 1-based foreground class id (0 = background); class c's target is
+    1 when label == c + 1."""
+
+    @primitive
+    def _loss(x, label, fg_num):
+        xf = x.astype(jnp.float32)
+        c = x.shape[1]
+        tgt = (label.astype(jnp.int32)
+               == jnp.arange(1, c + 1, dtype=jnp.int32)[None, :])
+        tgt = tgt.astype(jnp.float32)
+        p = jax.nn.sigmoid(xf)
+        ce = (tgt * jax.nn.softplus(-xf) + (1 - tgt) * jax.nn.softplus(xf))
+        w = tgt * alpha * (1 - p) ** gamma + (1 - tgt) * (1 - alpha) * p ** gamma
+        loss = w * ce
+        if fg_num is not None:
+            loss = loss / jnp.maximum(fg_num.astype(jnp.float32), 1.0)
+        return loss
+
+    fg = None if normalizer is None else _arr(normalizer)
+    return _loss(_arr(x), _arr(label), fg)
+
+
+# ---------------------------------------------------------------------------
+# NMS family — fixed-capacity padded outputs + rois_num
+# ---------------------------------------------------------------------------
+
+def _greedy_nms_mask(boxes, scores, valid, nms_threshold, nms_eta, normalized):
+    """Sequential NMSFast (multiclass_nms_op.cc:140) as a fori_loop over the
+    score-sorted candidate list: keep candidate i iff its IoU with every
+    already-kept candidate <= the (eta-adaptive) threshold. Returns
+    (order, keep-mask-over-order)."""
+    m = boxes.shape[0]
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+    sb = boxes[order]
+    sv = valid[order]
+    iou = _pairwise_iou(sb, sb, normalized)
+    idx = jnp.arange(m)
+
+    def body(i, carry):
+        keep, thr = carry
+        sup = jnp.any(keep & (iou[i] > thr) & (idx < i))
+        ki = sv[i] & ~sup
+        keep = keep.at[i].set(ki)
+        thr = jnp.where(ki & (nms_eta < 1.0) & (thr > 0.5), thr * nms_eta, thr)
+        return keep, thr
+
+    keep, _ = lax.fori_loop(
+        0, m, body, (jnp.zeros((m,), bool), jnp.float32(nms_threshold)))
+    return order, keep
+
+
+def _multiclass_nms_single(bboxes, scores, score_threshold, nms_top_k,
+                           keep_top_k, nms_threshold, normalized, nms_eta,
+                           background_label):
+    """One image: bboxes [M, 4], scores [C, M] → (out [K, 6], index [K],
+    count). Padding rows: label -1, zeros."""
+    c, m = scores.shape
+    top = min(nms_top_k, m) if nms_top_k > -1 else m
+
+    def per_class(cls_scores):
+        valid = cls_scores > score_threshold
+        if top < m:
+            kth = -jnp.sort(-jnp.where(valid, cls_scores, -jnp.inf))[top - 1]
+            valid = valid & (cls_scores >= kth)
+        order, keep = _greedy_nms_mask(bboxes, cls_scores, valid,
+                                       nms_threshold, nms_eta, normalized)
+        mask = jnp.zeros((m,), bool).at[order].set(keep)
+        return mask
+
+    keep_cm = jax.vmap(per_class)(scores)  # [C, M]
+    if 0 <= background_label < c:
+        keep_cm = keep_cm.at[background_label].set(False)
+    flat_scores = jnp.where(keep_cm, scores, -jnp.inf).reshape(-1)  # [C*M]
+    k = keep_top_k if keep_top_k > -1 else c * m
+    k = min(k, c * m)
+    top_scores, top_idx = lax.top_k(flat_scores, k)
+    sel_valid = top_scores > -jnp.inf
+    cls_id = (top_idx // m).astype(jnp.float32)
+    box_id = top_idx % m
+    sel_boxes = jnp.take(bboxes, box_id, axis=0)
+    # reference row order: ascending class label, score-descending within a
+    # class (MultiClassOutput iterates the class-indexed map)
+    order2 = jnp.lexsort((-top_scores, jnp.where(sel_valid, cls_id, jnp.inf)))
+    top_scores = top_scores[order2]
+    sel_valid = sel_valid[order2]
+    cls_id = cls_id[order2]
+    box_id = box_id[order2]
+    sel_boxes = sel_boxes[order2]
+    out = jnp.concatenate([
+        jnp.where(sel_valid, cls_id, -1.0)[:, None],
+        jnp.where(sel_valid, top_scores, 0.0)[:, None],
+        jnp.where(sel_valid[:, None], sel_boxes, 0.0),
+    ], axis=1)
+    index = jnp.where(sel_valid, box_id, -1)
+    return out, index, jnp.sum(sel_valid.astype(jnp.int32))
+
+
+def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
+                    nms_top_k=400, keep_top_k=200, nms_threshold=0.3,
+                    normalized=True, nms_eta=1.0, background_label=0,
+                    return_index=False, name=None):
+    """Batched multiclass NMS (multiclass_nms_op.cc MultiClassNMS3).
+    bboxes [N, M, 4], scores [N, C, M]. Returns (out [N*K, 6],
+    index [N*K] into the flattened [N*M] boxes, nms_rois_num [N])."""
+    bb = _arr(bboxes).astype(jnp.float32)
+    sc = _arr(scores).astype(jnp.float32)
+
+    @primitive(nondiff=True)
+    def _nms(bb, sc):
+        n, m = bb.shape[0], bb.shape[1]
+
+        def one(b, s):
+            return _multiclass_nms_single(
+                b, s, score_threshold, nms_top_k, keep_top_k, nms_threshold,
+                normalized, nms_eta, background_label)
+
+        out, index, cnt = jax.vmap(one)(bb, sc)  # [N,K,6], [N,K], [N]
+        base = (jnp.arange(n, dtype=index.dtype) * m)[:, None]
+        index = jnp.where(index >= 0, index + base, -1)
+        k = out.shape[1]
+        return out.reshape(n * k, 6), index.reshape(n * k), cnt
+
+    out, index, cnt = _nms(bb, sc)
+    if return_index:
+        return out, index, cnt
+    return out, cnt
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=200, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None):
+    """v1: padded detections + per-image counts (≙ LoD output)."""
+    out, cnt = multiclass_nms3(
+        bboxes, scores, score_threshold=score_threshold, nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k, nms_threshold=nms_threshold,
+        normalized=normalized, nms_eta=nms_eta,
+        background_label=background_label)
+    return out, cnt
+
+
+def multiclass_nms2(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                    keep_top_k=200, nms_threshold=0.3, normalized=True,
+                    nms_eta=1.0, background_label=0, return_index=True,
+                    name=None):
+    """v2: adds the kept-box index output."""
+    return multiclass_nms3(
+        bboxes, scores, score_threshold=score_threshold, nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k, nms_threshold=nms_threshold,
+        normalized=normalized, nms_eta=nms_eta,
+        background_label=background_label, return_index=return_index)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix (soft) NMS (matrix_nms_op.cc NMSMatrix): per class, sort by
+    score, decay each score by min_j f(iou_ij, max_iou_j); keep decayed
+    scores > post_threshold, then global top keep_top_k."""
+    bb = _arr(bboxes).astype(jnp.float32)
+    sc = _arr(scores).astype(jnp.float32)
+
+    @primitive(nondiff=True)
+    def _mnms(bb, sc):
+        n, m = bb.shape[0], bb.shape[1]
+        c = sc.shape[1]
+        pre = min(nms_top_k, m) if nms_top_k > -1 else m
+
+        def per_class(boxes, cls_scores):
+            valid = cls_scores > score_threshold
+            s_sorted, order = lax.top_k(jnp.where(valid, cls_scores, -jnp.inf),
+                                        pre)
+            sv = s_sorted > -jnp.inf
+            sb = jnp.take(boxes, order, axis=0)
+            iou = _pairwise_iou(sb, sb, normalized)
+            idx = jnp.arange(pre)
+            lower = (idx[:, None] > idx[None, :]) & sv[None, :] & sv[:, None]
+            iou_l = jnp.where(lower, iou, 0.0)
+            iou_max = jnp.max(iou_l, axis=1)  # max_{j<i} iou[i, j]
+            if use_gaussian:
+                decay = jnp.exp((iou_max[None, :] ** 2 - iou_l ** 2)
+                                * gaussian_sigma)
+            else:
+                decay = (1.0 - iou_l) / (1.0 - iou_max[None, :] + 1e-10)
+            decay = jnp.where(lower, decay, 1.0)
+            min_decay = jnp.min(decay, axis=1)
+            ds = jnp.where(sv, min_decay * s_sorted, -jnp.inf)
+            ds = jnp.where(ds > post_threshold, ds, -jnp.inf)
+            return ds, order
+
+        def one(b, s):
+            ds, order = jax.vmap(lambda cs: per_class(b, cs))(s)  # [C, pre]
+            if 0 <= background_label < c:
+                ds = ds.at[background_label].set(-jnp.inf)
+            k = min(keep_top_k if keep_top_k > -1 else c * pre, c * pre)
+            top_s, top_i = lax.top_k(ds.reshape(-1), k)
+            ok = top_s > -jnp.inf
+            cls_id = (top_i // pre).astype(jnp.float32)
+            box_id = jnp.take(order.reshape(-1), top_i)
+            sel = jnp.take(b, box_id, axis=0)
+            # reference row order: class-ascending, score-desc within class
+            o2 = jnp.lexsort((-top_s, jnp.where(ok, cls_id, jnp.inf)))
+            top_s, ok, cls_id = top_s[o2], ok[o2], cls_id[o2]
+            box_id, sel = box_id[o2], sel[o2]
+            out = jnp.concatenate([
+                jnp.where(ok, cls_id, -1.0)[:, None],
+                jnp.where(ok, top_s, 0.0)[:, None],
+                jnp.where(ok[:, None], sel, 0.0),
+            ], axis=1)
+            return out, jnp.where(ok, box_id, -1), jnp.sum(ok.astype(jnp.int32))
+
+        out, index, cnt = jax.vmap(one)(bb, sc)
+        base = (jnp.arange(n, dtype=index.dtype) * m)[:, None]
+        index = jnp.where(index >= 0, index + base, -1)
+        k = out.shape[1]
+        return out.reshape(n * k, 6), index.reshape(n * k), cnt
+
+    out, index, cnt = _mnms(bb, sc)
+    res = [out]
+    if return_index:
+        res.append(index)
+    if return_rois_num:
+        res.append(cnt)
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+# ---------------------------------------------------------------------------
+# RPN proposals
+# ---------------------------------------------------------------------------
+
+def _decode_anchor_deltas(anchors, deltas, variances, pixel_offset):
+    """bbox_util.h BoxCoder: anchors+deltas → corner proposals."""
+    off = 1.0 if pixel_offset else 0.0
+    aw = anchors[:, 2] - anchors[:, 0] + off
+    ah = anchors[:, 3] - anchors[:, 1] + off
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    if variances is not None:
+        dx, dy, dw, dh = (variances[:, i] * deltas[:, i] for i in range(4))
+    else:
+        dx, dy, dw, dh = (deltas[:, i] for i in range(4))
+    cx = dx * aw + acx
+    cy = dy * ah + acy
+    w = jnp.exp(jnp.minimum(dw, _BBOX_CLIP)) * aw
+    h = jnp.exp(jnp.minimum(dh, _BBOX_CLIP)) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2,
+                      cx + w / 2 - off, cy + h / 2 - off], axis=-1)
+
+
+def generate_proposals_v2(scores, bbox_deltas, img_size, anchors, variances,
+                          pre_nms_top_n=6000, post_nms_top_n=1000,
+                          nms_thresh=0.5, min_size=0.1, eta=1.0,
+                          pixel_offset=True, return_rois_num=True, name=None):
+    """RPN proposal generation (generate_proposals_v2_op.cc ProposalForOneImage):
+    top pre_nms scores → decode deltas on anchors → clip to image → drop
+    boxes smaller than min_size → NMS → top post_nms. scores [N, A, H, W],
+    bbox_deltas [N, 4A, H, W], img_size [N, 2] (h, w), anchors [H, W, A, 4].
+    Returns (rois [N*post, 4] padded, roi_scores [N*post], rois_num [N])."""
+    sc = _arr(scores).astype(jnp.float32)
+    bd = _arr(bbox_deltas).astype(jnp.float32)
+    ims = _arr(img_size).astype(jnp.float32)
+    an = _arr(anchors).astype(jnp.float32).reshape(-1, 4)
+    va = _arr(variances).astype(jnp.float32).reshape(-1, 4)
+
+    @primitive(nondiff=True)
+    def _gen(sc, bd, ims):
+        n, a, h, w = sc.shape
+        total = h * w * a
+        pre = min(pre_nms_top_n, total)
+        post = min(post_nms_top_n, pre)
+        # layout: NCHW → (H, W, A) flatten, matching the anchor grid order
+        sc_f = jnp.transpose(sc, (0, 2, 3, 1)).reshape(n, total)
+        bd_f = jnp.transpose(bd.reshape(n, a, 4, h, w),
+                             (0, 3, 4, 1, 2)).reshape(n, total, 4)
+
+        def one(s, d, im):
+            top_s, top_i = lax.top_k(s, pre)
+            d_sel = jnp.take(d, top_i, axis=0)
+            a_sel = jnp.take(an, top_i, axis=0)
+            v_sel = jnp.take(va, top_i, axis=0)
+            props = _decode_anchor_deltas(a_sel, d_sel, v_sel, pixel_offset)
+            # clip to image (bbox_util.h ClipTiledBoxes)
+            off = 1.0 if pixel_offset else 0.0
+            hi = jnp.stack([im[1] - off, im[0] - off,
+                            im[1] - off, im[0] - off])
+            props = jnp.clip(props, 0.0, hi)
+            # FilterBoxes: both sides >= min_size; centers inside the image
+            ms = max(float(min_size), 1.0)
+            ws = props[:, 2] - props[:, 0] + off
+            hs = props[:, 3] - props[:, 1] + off
+            keep = (ws >= ms) & (hs >= ms)
+            if pixel_offset:
+                cx = props[:, 0] + ws / 2
+                cy = props[:, 1] + hs / 2
+                keep = keep & (cx <= im[1]) & (cy <= im[0])
+            order, kmask = _greedy_nms_mask(props, top_s, keep, nms_thresh,
+                                            eta, True)
+            # top post_nms in score order = first `post` kept rows of `order`
+            rank = jnp.cumsum(kmask.astype(jnp.int32)) - 1
+            slot = jnp.where(kmask, rank, post)
+            rois = jnp.zeros((post + 1, 4), jnp.float32)
+            rscore = jnp.zeros((post + 1,), jnp.float32)
+            rois = rois.at[slot].set(jnp.take(props, order, axis=0))[:post]
+            rscore = rscore.at[slot].set(jnp.take(top_s, order))[:post]
+            cnt = jnp.minimum(jnp.sum(kmask.astype(jnp.int32)), post)
+            return rois, rscore, cnt
+
+        rois, rscores, cnt = jax.vmap(one)(sc_f, bd_f, ims)
+        return rois.reshape(n * post, 4), rscores.reshape(n * post), cnt
+
+    rois, rscores, cnt = _gen(sc, bd, ims)
+    if return_rois_num:
+        return rois, rscores, cnt
+    return rois, rscores
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0, return_rois_num=True, name=None):
+    """v1 (generate_proposals_op.cc): im_info rows (h, w, scale); otherwise
+    the v2 pipeline with pixel_offset=True."""
+    im = _arr(im_info).astype(jnp.float32)
+    return generate_proposals_v2(
+        scores, bbox_deltas, im[:, :2], anchors, variances,
+        pre_nms_top_n=pre_nms_top_n, post_nms_top_n=post_nms_top_n,
+        nms_thresh=nms_thresh, min_size=min_size, eta=eta, pixel_offset=True,
+        return_rois_num=return_rois_num, name=name)
+
+
+# ---------------------------------------------------------------------------
+# FPN routing
+# ---------------------------------------------------------------------------
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=True, rois_num=None,
+                             name=None):
+    """Route RoIs to FPN levels by scale (distribute_fpn_proposals_op.h):
+    level = floor(log2(sqrt(area)/refer_scale + 1e-6)) + refer_level,
+    clamped. ``fpn_rois`` must be PACKED valid rows (no padding — slice a
+    padded generate_proposals output by its counts first); ``rois_num``
+    gives the per-image counts of that packed layout. Returns
+    (multi_rois: per-level [R, 4] padded arrays, restore_ind [R, 1],
+    per-level counts [L]) — with ``rois_num``, counts is replaced by
+    rois_num_per_level [L, N] (the reference's MultiLevelRoIsNum)."""
+    rois = _arr(fpn_rois).astype(jnp.float32)
+    img_of = None
+    if rois_num is not None:
+        rn = np.asarray(_arr(rois_num)).astype(np.int64).reshape(-1)
+        if int(rn.sum()) != int(rois.shape[0]):
+            raise ValueError(
+                f"rois_num sums to {int(rn.sum())} but fpn_rois has "
+                f"{int(rois.shape[0])} rows — pass packed valid rows "
+                "(slice padded proposals by their counts)")
+        img_of = np.repeat(np.arange(len(rn)), rn)
+
+    @primitive(nondiff=True)
+    def _route(rois):
+        r = rois.shape[0]
+        off = 1.0 if pixel_offset else 0.0
+        ws = rois[:, 2] - rois[:, 0] + off
+        hs = rois[:, 3] - rois[:, 1] + off
+        scale = jnp.sqrt(jnp.maximum(ws * hs, 0.0))
+        lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        num_level = max_level - min_level + 1
+        # stable sort by level keeps in-level input order (reference order)
+        order = jnp.argsort(lvl, stable=True)
+        sorted_rois = jnp.take(rois, order, axis=0)
+        sorted_lvl = jnp.take(lvl, order)
+        counts = jnp.sum(lvl[None, :] == (jnp.arange(num_level)[:, None]
+                                          + min_level), axis=1)
+        starts = jnp.concatenate([jnp.zeros((1,), counts.dtype),
+                                  jnp.cumsum(counts)[:-1]])
+        # per-level padded arrays: level rows land at [0, count)
+        outs = []
+        for li in range(num_level):
+            in_lvl = sorted_lvl == (li + min_level)
+            pos = jnp.cumsum(in_lvl.astype(jnp.int32)) - 1
+            slot = jnp.where(in_lvl, pos, r)
+            buf = jnp.zeros((r + 1, 4), jnp.float32)
+            outs.append(buf.at[slot].set(sorted_rois)[:r])
+        restore = jnp.zeros((r,), jnp.int32).at[order].set(
+            jnp.arange(r, dtype=jnp.int32))
+        if img_of is not None:
+            # per-level per-image counts (MultiLevelRoIsNum)
+            n_img = int(img_of.max()) + 1 if img_of.size else 0
+            in_lvl = lvl[None, :] == (jnp.arange(num_level)[:, None]
+                                      + min_level)  # [L, R]
+            in_img = (jnp.asarray(img_of)[None, :]
+                      == jnp.arange(n_img)[:, None])  # [N, R]
+            per = jnp.einsum("lr,nr->ln", in_lvl.astype(jnp.int32),
+                             in_img.astype(jnp.int32))
+            return tuple(outs) + (restore[:, None], per)
+        return tuple(outs) + (restore[:, None], counts)
+
+    res = _route(rois)
+    multi_rois, restore_ind, counts = list(res[:-2]), res[-2], res[-1]
+    return multi_rois, restore_ind, counts
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None, name=None):
+    """Merge per-level proposals by global score top-k
+    (collect_fpn_proposals_op.h). Inputs are the per-level padded arrays +
+    counts; returns (rois [post, 4], counts kept)."""
+    rois = jnp.concatenate([_arr(r) for r in multi_rois], axis=0)
+    scores = jnp.concatenate(
+        [_arr(s).reshape(-1) for s in multi_scores], axis=0)
+    if rois_num_per_level is not None:
+        counts = _arr(rois_num_per_level).reshape(-1)
+        sizes = [int(_arr(r).shape[0]) for r in multi_rois]
+        valids = []
+        for li, sz in enumerate(sizes):
+            valids.append(jnp.arange(sz) < counts[li])
+        valid = jnp.concatenate(valids)
+        scores = jnp.where(valid, scores, -jnp.inf)
+
+    @primitive(nondiff=True)
+    def _collect(rois, scores):
+        k = min(post_nms_top_n, rois.shape[0])
+        top_s, top_i = lax.top_k(scores, k)
+        ok = top_s > -jnp.inf
+        sel = jnp.where(ok[:, None], jnp.take(rois, top_i, axis=0), 0.0)
+        return sel, jnp.sum(ok.astype(jnp.int32))
+
+    return _collect(rois, scores)
+
+
+# ---------------------------------------------------------------------------
+# misc detection ops
+# ---------------------------------------------------------------------------
+
+def polygon_box_transform(input, name=None):  # noqa: A002
+    """EAST-style offset maps → absolute quad coordinates
+    (polygon_box_transform_op.cc: out = 4*index - in per coordinate plane,
+    where index is the pixel column for even channels, row for odd)."""
+
+    @primitive
+    def _pbt(x):
+        n, c, h, w = x.shape
+        col = jnp.broadcast_to(jnp.arange(w, dtype=x.dtype), (h, w))
+        row = jnp.broadcast_to(jnp.arange(h, dtype=x.dtype)[:, None], (h, w))
+        is_x = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+        idx = jnp.where(is_x, col[None, None], row[None, None])
+        return 4.0 * idx - x
+
+    return _pbt(_arr(input))
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=4.135, name=None):
+    """Decode per-class deltas then pick each box's best non-background
+    class (box_decoder_and_assign_op.h). target_box [M, 4*C],
+    box_score [M, C]. Returns (decoded [M, 4*C], assigned [M, 4])."""
+
+    @primitive(nondiff=True)
+    def _bda(pb, pbv, tb, sc):
+        m, c4 = tb.shape
+        c = c4 // 4
+        pw = pb[:, 2] - pb[:, 0] + 1.0
+        ph = pb[:, 3] - pb[:, 1] + 1.0
+        pcx = pb[:, 0] + 0.5 * pw
+        pcy = pb[:, 1] + 0.5 * ph
+        d = tb.reshape(m, c, 4) * pbv[:, None, :]
+        cx = d[..., 0] * pw[:, None] + pcx[:, None]
+        cy = d[..., 1] * ph[:, None] + pcy[:, None]
+        w = jnp.exp(jnp.minimum(d[..., 2], box_clip)) * pw[:, None]
+        h = jnp.exp(jnp.minimum(d[..., 3], box_clip)) * ph[:, None]
+        dec = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - 1, cy + h / 2 - 1], axis=-1)
+        best = jnp.argmax(sc[:, 1:], axis=1) + 1  # skip background class 0
+        assigned = jnp.take_along_axis(
+            dec, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+        return dec.reshape(m, c4), assigned
+
+    return _bda(_arr(prior_box).astype(jnp.float32),
+                _arr(prior_box_var).astype(jnp.float32),
+                _arr(target_box).astype(jnp.float32),
+                _arr(box_score).astype(jnp.float32))
+
+
+def mine_hard_examples(cls_loss, match_indices, neg_pos_ratio=3.0,
+                       neg_dist_threshold=0.5, loc_loss=None,
+                       mining_type="max_negative", sample_size=None,
+                       name=None):
+    """Hard negative mining (mine_hard_examples_op.cc max_negative mode):
+    per image, rank unmatched priors by loss and keep the top
+    neg_pos_ratio * num_pos as negatives. Returns (neg_mask [N, P] bool,
+    neg_count [N])."""
+
+    @primitive(nondiff=True)
+    def _mine(loss, match):
+        neg = match < 0
+        n_pos = jnp.sum((match >= 0).astype(jnp.int32), axis=1)
+        n_neg = (n_pos.astype(jnp.float32) * neg_pos_ratio).astype(jnp.int32)
+        n_neg = jnp.minimum(n_neg, jnp.sum(neg.astype(jnp.int32), axis=1))
+        masked = jnp.where(neg, loss, -jnp.inf)
+        order = jnp.argsort(-masked, axis=1)
+        rank = jnp.zeros_like(order).at[
+            jnp.arange(order.shape[0])[:, None], order
+        ].set(jnp.broadcast_to(jnp.arange(order.shape[1]), order.shape))
+        sel = neg & (rank < n_neg[:, None])
+        return sel, n_neg
+
+    total = _arr(cls_loss)
+    if loc_loss is not None:
+        total = total + _arr(loc_loss)
+    return _mine(total.astype(jnp.float32), _arr(match_indices))

@@ -21,6 +21,51 @@ jax.config.update("jax_enable_x64", True)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# fast / full split (≙ reference CI sharding, tools/parallel_UT_rule.py):
+# `pytest -m fast` is the <3-minute tier; the files below are the heavy
+# integration/parity suites (measured full run: ~42 min wall, r4) and only
+# run in the full tier. Everything else is auto-marked fast.
+# ---------------------------------------------------------------------------
+_SLOW_FILES = {
+    "test_pipeline_schedule.py",   # ~10 min: dense-parity hybrid meshes
+    "test_vision_models.py",       # ~7 min: 13 model families forward
+    "test_gpt_model.py",           # ~6.5 min: model-parallel parity
+    "test_moe.py",
+    "test_bert_model.py",
+    "test_sequence_parallel.py",
+    "test_hapi.py",
+    "test_mnist_e2e.py",
+    "test_launch_multiproc.py",    # forks subprocesses
+    "test_pallas_flash_attention.py",
+    "test_pallas_kernels.py",
+    "test_quantization.py",
+    "test_vision_ops.py",
+    "test_offload.py",
+    "test_distributed.py",
+    "test_checkpoint_elastic.py",
+    "test_book_e2e.py",
+    "test_eager_layer_jit.py",
+    "test_text_utils_inference.py",
+    "test_text_ops.py",
+    "test_nn_layers.py",
+    "test_fft_signal.py",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "fast: quick tier (<3 min total)")
+    config.addinivalue_line("markers", "full: heavy integration/parity tier")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        name = os.path.basename(str(item.fspath))
+        if name in _SLOW_FILES:
+            item.add_marker(pytest.mark.full)
+        else:
+            item.add_marker(pytest.mark.fast)
+
 
 @pytest.fixture(autouse=True)
 def _seed_everything():

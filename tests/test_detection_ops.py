@@ -1,0 +1,576 @@
+"""OpTest-style numpy-parity tests for paddle_tpu.vision.detection.
+
+Each test re-derives the reference op's semantics in plain numpy (the
+OpTest pattern, unittests/op_test.py:277) and compares against the XLA
+implementation. Reference kernels: paddle/fluid/operators/detection/*."""
+import math
+
+import numpy as np
+import pytest
+
+from paddle_tpu.tensor import Tensor
+from paddle_tpu.vision import detection as D
+
+
+def _np(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x)
+
+
+def _rand_boxes(rng, n, lo=0.0, hi=60.0):
+    x1 = rng.uniform(lo, hi, n)
+    y1 = rng.uniform(lo, hi, n)
+    w = rng.uniform(1.0, 20.0, n)
+    h = rng.uniform(1.0, 20.0, n)
+    return np.stack([x1, y1, x1 + w, y1 + h], axis=1).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# generators
+# ---------------------------------------------------------------------------
+
+def _np_prior_box(fh, fw, ih, iw, min_sizes, max_sizes, ars, flip, offset,
+                  mmorder):
+    out_ars = [1.0]
+    for ar in ars:
+        if any(abs(ar - o) < 1e-6 for o in out_ars):
+            continue
+        out_ars.append(ar)
+        if flip:
+            out_ars.append(1.0 / ar)
+    step_w, step_h = iw / fw, ih / fh
+    boxes = []
+    for hh in range(fh):
+        for ww in range(fw):
+            cx = (ww + offset) * step_w
+            cy = (hh + offset) * step_h
+            for si, mn in enumerate(min_sizes):
+                exts = []
+                if mmorder:
+                    exts.append((mn / 2, mn / 2))
+                    if max_sizes:
+                        m = math.sqrt(mn * max_sizes[si])
+                        exts.append((m / 2, m / 2))
+                    for ar in out_ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        exts.append((mn * math.sqrt(ar) / 2,
+                                     mn / math.sqrt(ar) / 2))
+                else:
+                    for ar in out_ars:
+                        exts.append((mn * math.sqrt(ar) / 2,
+                                     mn / math.sqrt(ar) / 2))
+                    if max_sizes:
+                        m = math.sqrt(mn * max_sizes[si])
+                        exts.append((m / 2, m / 2))
+                for bw, bh in exts:
+                    boxes.append([(cx - bw) / iw, (cy - bh) / ih,
+                                  (cx + bw) / iw, (cy + bh) / ih])
+    p = len(boxes) // (fh * fw)
+    return np.asarray(boxes, np.float32).reshape(fh, fw, p, 4)
+
+
+@pytest.mark.parametrize("mmorder", [False, True])
+def test_prior_box(mmorder):
+    feat = np.zeros((1, 8, 4, 6), np.float32)
+    img = np.zeros((1, 3, 64, 96), np.float32)
+    got, var = D.prior_box(feat, img, min_sizes=[8.0, 16.0], max_sizes=[16.0, 32.0],
+                           aspect_ratios=[2.0], flip=True, offset=0.5,
+                           min_max_aspect_ratios_order=mmorder)
+    want = _np_prior_box(4, 6, 64.0, 96.0, [8.0, 16.0], [16.0, 32.0], [2.0],
+                         True, 0.5, mmorder)
+    np.testing.assert_allclose(_np(got), want, rtol=1e-5, atol=1e-6)
+    assert _np(var).shape == want.shape
+    np.testing.assert_allclose(_np(var)[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_anchor_generator():
+    feat = np.zeros((1, 8, 3, 5), np.float32)
+    got, var = D.anchor_generator(feat, anchor_sizes=[32.0, 64.0],
+                                  aspect_ratios=[0.5, 1.0],
+                                  variances=[0.1, 0.1, 0.2, 0.2],
+                                  stride=[16.0, 16.0], offset=0.5)
+    # independent re-derivation (anchor_generator_op.h)
+    want = np.zeros((3, 5, 4, 4), np.float32)
+    for hi in range(3):
+        for wi in range(5):
+            xc = wi * 16.0 + 0.5 * 15.0
+            yc = hi * 16.0 + 0.5 * 15.0
+            i = 0
+            for ar in (0.5, 1.0):
+                for size in (32.0, 64.0):
+                    base_w = round(math.sqrt(16 * 16 / ar))
+                    base_h = round(base_w * ar)
+                    aw = size / 16.0 * base_w
+                    ah = size / 16.0 * base_h
+                    want[hi, wi, i] = [xc - 0.5 * (aw - 1), yc - 0.5 * (ah - 1),
+                                       xc + 0.5 * (aw - 1), yc + 0.5 * (ah - 1)]
+                    i += 1
+    np.testing.assert_allclose(_np(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_density_prior_box_shapes_and_centers():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+    boxes, var = D.density_prior_box(feat, img, densities=[2], fixed_sizes=[8.0],
+                                     fixed_ratios=[1.0])
+    b = _np(boxes)
+    assert b.shape == (2, 2, 4, 4)  # density^2 priors per cell
+    # all priors are 8x8 squares (fixed_ratio 1) in normalized coords
+    w = (b[..., 2] - b[..., 0]) * 32.0
+    np.testing.assert_allclose(w, 8.0, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# geometry
+# ---------------------------------------------------------------------------
+
+def _np_box_coder_encode(tb, pb, var, normalized):
+    off = 0.0 if normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + off
+    ph = pb[:, 3] - pb[:, 1] + off
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    tw = tb[:, 2] - tb[:, 0] + off
+    th = tb[:, 3] - tb[:, 1] + off
+    tcx = (tb[:, 2] + tb[:, 0]) / 2
+    tcy = (tb[:, 3] + tb[:, 1]) / 2
+    out = np.stack([
+        (tcx[:, None] - pcx[None, :]) / pw[None, :],
+        (tcy[:, None] - pcy[None, :]) / ph[None, :],
+        np.log(np.abs(tw[:, None] / pw[None, :])),
+        np.log(np.abs(th[:, None] / ph[None, :])),
+    ], axis=-1)
+    if var is not None:
+        out = out / var[None, :, :]
+    return out
+
+
+@pytest.mark.parametrize("normalized", [True, False])
+def test_box_coder_encode_decode_roundtrip(normalized):
+    rng = np.random.default_rng(0)
+    pb = _rand_boxes(rng, 6)
+    tb = _rand_boxes(rng, 4)
+    pbv = rng.uniform(0.1, 0.3, (6, 4)).astype(np.float32)
+
+    enc = D.box_coder(pb, pbv, tb, "encode_center_size", box_normalized=normalized)
+    want = _np_box_coder_encode(tb, pb, pbv, normalized)
+    np.testing.assert_allclose(_np(enc), want, rtol=1e-4, atol=1e-5)
+
+    # decode(encode(x)) == x: deltas [1, 4, 4] where column j holds target
+    # j's encoding on prior j; axis=0 applies prior j to column j
+    diag = _np(enc)[np.arange(4), np.arange(4)][None]  # [1, 4, 4]
+    dec = D.box_coder(pb[:4], pbv[:4], diag, "decode_center_size",
+                      box_normalized=normalized, axis=0)
+    full = _np(dec)  # [1, 4, 4]
+    # non-normalized roundtrip carries the reference's half-pixel shift:
+    # encode centers use (x1+x2)/2 while decode reconstructs corners from
+    # the (+1)-width convention (box_coder_op.h Encode/DecodeCenterSize)
+    shift = 0.0 if normalized else 0.5
+    for j in range(4):
+        np.testing.assert_allclose(full[0, j], tb[j] - shift,
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_iou_similarity():
+    rng = np.random.default_rng(1)
+    a = _rand_boxes(rng, 5)
+    b = _rand_boxes(rng, 7)
+    got = _np(D.iou_similarity(a, b))
+    want = np.zeros((5, 7), np.float32)
+    for i in range(5):
+        for j in range(7):
+            ix1 = max(a[i, 0], b[j, 0]); iy1 = max(a[i, 1], b[j, 1])
+            ix2 = min(a[i, 2], b[j, 2]); iy2 = min(a[i, 3], b[j, 3])
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            a1 = (a[i, 2] - a[i, 0]) * (a[i, 3] - a[i, 1])
+            a2 = (b[j, 2] - b[j, 0]) * (b[j, 3] - b[j, 1])
+            want[i, j] = inter / (a1 + a2 - inter + 1e-10)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_box_clip():
+    rng = np.random.default_rng(2)
+    boxes = _rand_boxes(rng, 8, lo=-10, hi=80)[None]  # [1, 8, 4]
+    im_info = np.array([[40.0, 50.0, 1.0]], np.float32)
+    got = _np(D.box_clip(boxes, im_info))
+    want = boxes.copy()
+    want[..., 0] = np.clip(want[..., 0], 0, 49)
+    want[..., 1] = np.clip(want[..., 1], 0, 39)
+    want[..., 2] = np.clip(want[..., 2], 0, 49)
+    want[..., 3] = np.clip(want[..., 3], 0, 39)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# matching
+# ---------------------------------------------------------------------------
+
+def _np_bipartite(dist):
+    r, c = dist.shape
+    match = np.full(c, -1, np.int32)
+    mdist = np.zeros(c, np.float32)
+    row_free = np.ones(r, bool)
+    for _ in range(min(r, c)):
+        masked = np.where(row_free[:, None] & (match < 0)[None, :]
+                          & (dist > 1e-6), dist, -1.0)
+        i, j = np.unravel_index(np.argmax(masked), masked.shape)
+        if masked[i, j] <= 0:
+            break
+        match[j] = i
+        mdist[j] = dist[i, j]
+        row_free[i] = False
+    return match, mdist
+
+
+def test_bipartite_match():
+    rng = np.random.default_rng(3)
+    dist = rng.uniform(0, 1, (5, 9)).astype(np.float32)
+    idx, md = D.bipartite_match(dist)
+    want_idx, want_dist = _np_bipartite(dist)
+    np.testing.assert_array_equal(_np(idx)[0], want_idx)
+    np.testing.assert_allclose(_np(md)[0], want_dist, rtol=1e-5)
+
+
+def test_bipartite_match_per_prediction():
+    rng = np.random.default_rng(4)
+    dist = rng.uniform(0, 1, (4, 10)).astype(np.float32)
+    idx, md = D.bipartite_match(dist, match_type="per_prediction",
+                                dist_threshold=0.6)
+    want_idx, want_dist = _np_bipartite(dist)
+    best = dist.max(0)
+    arg = dist.argmax(0)
+    fill = (want_idx < 0) & (best >= 0.6)
+    want_idx[fill] = arg[fill]
+    want_dist[fill] = best[fill]
+    np.testing.assert_array_equal(_np(idx)[0], want_idx)
+    np.testing.assert_allclose(_np(md)[0], want_dist, rtol=1e-5)
+
+
+def test_target_assign():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((6, 3)).astype(np.float32)
+    match = np.array([[2, -1, 0], [5, 1, -1]], np.int32)
+    out, w = D.target_assign(x, match, mismatch_value=0)
+    o = _np(out)
+    np.testing.assert_allclose(o[0, 0], x[2])
+    np.testing.assert_allclose(o[0, 1], 0.0)
+    np.testing.assert_allclose(o[1, 0], x[5])
+    np.testing.assert_array_equal(_np(w), [[1, 0, 1], [1, 1, 0]])
+
+
+def test_target_assign_negative_indices():
+    """Hard-negative slots keep mismatch_value but get weight 1
+    (NegTargetAssignFunctor in target_assign_op.h)."""
+    rng = np.random.default_rng(55)
+    x = rng.standard_normal((6, 2)).astype(np.float32)
+    match = np.array([[0, -1, 2], [-1, 1, -1]], np.int32)
+    neg = np.array([1, 0, 2])          # image 0: prior 1; image 1: priors 0, 2
+    neg_lens = np.array([1, 2])
+    out, w = D.target_assign(x, match, negative_indices=neg,
+                             negative_lengths=neg_lens, mismatch_value=0)
+    np.testing.assert_array_equal(_np(w), [[1, 1, 1], [1, 1, 1]])
+    np.testing.assert_allclose(_np(out)[0, 1], 0.0)  # still mismatch_value
+
+
+def test_sigmoid_focal_loss():
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((5, 3)).astype(np.float32)
+    label = np.array([1, 0, 3, 2, 0], np.int32)[:, None]
+    fg = np.array([3], np.int32)
+    got = _np(D.sigmoid_focal_loss(x, label, fg, alpha=0.25, gamma=2.0))
+    p = 1 / (1 + np.exp(-x))
+    tgt = (label == np.arange(1, 4)[None, :]).astype(np.float32)
+    ce = -(tgt * np.log(p) + (1 - tgt) * np.log(1 - p))
+    w = tgt * 0.25 * (1 - p) ** 2 + (1 - tgt) * 0.75 * p ** 2
+    want = w * ce / 3.0
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# NMS family
+# ---------------------------------------------------------------------------
+
+def _np_nms(boxes, scores, valid, thr):
+    order = np.argsort(-np.where(valid, scores, -np.inf), kind="stable")
+    kept = []
+    for i in order:
+        if not valid[i]:
+            continue
+        ok = True
+        for j in kept:
+            ix1 = max(boxes[i, 0], boxes[j, 0]); iy1 = max(boxes[i, 1], boxes[j, 1])
+            ix2 = min(boxes[i, 2], boxes[j, 2]); iy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a2 = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / (a1 + a2 - inter + 1e-10) > thr:
+                ok = False
+                break
+        if ok:
+            kept.append(i)
+    return kept
+
+
+def _np_multiclass_nms(bboxes, scores, score_thr, nms_thr, nms_top_k,
+                       keep_top_k, background):
+    """Reference MultiClassNMS (multiclass_nms_op.cc): per-class NMS then
+    global keep_top_k, output rows class-ascending / score-desc."""
+    c, m = scores.shape
+    sel = []  # (cls, box, score)
+    for cl in range(c):
+        if cl == background:
+            continue
+        s = scores[cl]
+        valid = s > score_thr
+        if nms_top_k > -1 and valid.sum() > nms_top_k:
+            kth = np.sort(-s[valid])[:nms_top_k][-1]
+            valid = valid & (s >= -kth)
+        kept = _np_nms(bboxes, s, valid, nms_thr)
+        for i in kept:
+            sel.append((cl, i, s[i]))
+    if keep_top_k > -1 and len(sel) > keep_top_k:
+        sel.sort(key=lambda t: -t[2])
+        sel = sel[:keep_top_k]
+    sel.sort(key=lambda t: (t[0], -t[2]))
+    return sel
+
+
+def test_multiclass_nms3_parity():
+    rng = np.random.default_rng(7)
+    n, m, c = 2, 24, 4
+    boxes = np.stack([_rand_boxes(rng, m, hi=40) for _ in range(n)])
+    scores = rng.uniform(0, 1, (n, c, m)).astype(np.float32)
+    out, index, cnt = D.multiclass_nms3(boxes, scores, score_threshold=0.3,
+                                        nms_top_k=12, keep_top_k=8,
+                                        nms_threshold=0.4, return_index=True)
+    out, index, cnt = _np(out), _np(index), _np(cnt)
+    k = out.shape[0] // n
+    for b in range(n):
+        want = _np_multiclass_nms(boxes[b], scores[b], 0.3, 0.4, 12, 8, 0)
+        assert cnt[b] == len(want), (b, cnt[b], len(want))
+        rows = out[b * k: b * k + cnt[b]]
+        idxs = index[b * k: b * k + cnt[b]]
+        for r, (cl, i, s) in enumerate(want):
+            assert rows[r, 0] == cl
+            np.testing.assert_allclose(rows[r, 1], s, rtol=1e-5)
+            np.testing.assert_allclose(rows[r, 2:], boxes[b, i], rtol=1e-5)
+            assert idxs[r] == b * m + i
+        # padding rows carry label -1
+        assert np.all(out[b * k + cnt[b]: (b + 1) * k, 0] == -1)
+
+
+def test_multiclass_nms_wrappers():
+    rng = np.random.default_rng(8)
+    boxes = _rand_boxes(rng, 10, hi=30)[None]
+    scores = rng.uniform(0, 1, (1, 3, 10)).astype(np.float32)
+    out1, cnt1 = D.multiclass_nms(boxes, scores, score_threshold=0.2)
+    out2, idx2, cnt2 = D.multiclass_nms2(boxes, scores, score_threshold=0.2)
+    np.testing.assert_allclose(_np(out1), _np(out2))
+    assert int(_np(cnt1)[0]) == int(_np(cnt2)[0])
+
+
+def test_matrix_nms_parity():
+    rng = np.random.default_rng(9)
+    m, c = 16, 3
+    boxes = _rand_boxes(rng, m, hi=40)[None]
+    scores = rng.uniform(0, 1, (1, c, m)).astype(np.float32)
+    out, idx, cnt = D.matrix_nms(boxes, scores, score_threshold=0.3,
+                                 post_threshold=0.2, nms_top_k=10,
+                                 keep_top_k=8, return_index=True)
+    out, idx, cnt = _np(out), _np(idx), _np(cnt)
+
+    # numpy re-derivation of NMSMatrix (matrix_nms_op.cc)
+    def iou(a, b):
+        ix1 = max(a[0], b[0]); iy1 = max(a[1], b[1])
+        ix2 = min(a[2], b[2]); iy2 = min(a[3], b[3])
+        inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+        a1 = (a[2] - a[0]) * (a[3] - a[1]); a2 = (b[2] - b[0]) * (b[3] - b[1])
+        return inter / (a1 + a2 - inter + 1e-10)
+
+    sel = []
+    for cl in range(1, c):  # skip background 0
+        s = scores[0, cl]
+        perm = [i for i in np.argsort(-s, kind="stable") if s[i] > 0.3][:10]
+        if not perm:
+            continue
+        iou_max = [0.0]
+        for i in range(1, len(perm)):
+            iou_max.append(max(iou(boxes[0, perm[i]], boxes[0, perm[j]])
+                               for j in range(i)))
+        if s[perm[0]] > 0.2:
+            sel.append((cl, perm[0], s[perm[0]]))
+        for i in range(1, len(perm)):
+            md = 1.0
+            for j in range(i):
+                v = iou(boxes[0, perm[i]], boxes[0, perm[j]])
+                md = min(md, (1 - v) / (1 - iou_max[j] + 1e-10))
+            ds = md * s[perm[i]]
+            if ds > 0.2:
+                sel.append((cl, perm[i], ds))
+    sel.sort(key=lambda t: -t[2])
+    sel = sel[:8]
+    sel.sort(key=lambda t: (t[0], -t[2]))
+    assert cnt[0] == len(sel)
+    for r, (cl, i, s) in enumerate(sel):
+        assert out[r, 0] == cl
+        np.testing.assert_allclose(out[r, 1], s, rtol=1e-4)
+        np.testing.assert_allclose(out[r, 2:], boxes[0, i], rtol=1e-5)
+        assert idx[r] == i
+
+
+# ---------------------------------------------------------------------------
+# proposals + FPN
+# ---------------------------------------------------------------------------
+
+def test_generate_proposals_v2():
+    rng = np.random.default_rng(10)
+    n, a, h, w = 1, 3, 4, 4
+    scores = rng.uniform(0, 1, (n, a, h, w)).astype(np.float32)
+    deltas = (rng.standard_normal((n, 4 * a, h, w)) * 0.1).astype(np.float32)
+    img_size = np.array([[64.0, 64.0]], np.float32)
+    anchors, variances = D.anchor_generator(
+        np.zeros((1, 8, h, w), np.float32), anchor_sizes=[16.0, 32.0],
+        aspect_ratios=[1.0, 2.0], variances=[1.0, 1.0, 1.0, 1.0],
+        stride=[16.0, 16.0])
+    anchors = _np(anchors)[:, :, :a]
+    variances = _np(variances)[:, :, :a]
+    rois, rscores, cnt = D.generate_proposals_v2(
+        scores, deltas, img_size, anchors, variances, pre_nms_top_n=30,
+        post_nms_top_n=10, nms_thresh=0.5, min_size=2.0)
+    rois, rscores, cnt = _np(rois), _np(rscores), _np(cnt)
+    assert rois.shape == (10, 4) and cnt.shape == (1,)
+    k = int(cnt[0])
+    assert 0 < k <= 10
+    # valid rois are inside the image and at least min_size
+    v = rois[:k]
+    assert np.all(v[:, 0] >= 0) and np.all(v[:, 2] <= 63.0)
+    assert np.all(v[:, 2] - v[:, 0] + 1 >= 2.0)
+    # scores are descending
+    assert np.all(np.diff(rscores[:k]) <= 1e-6)
+    # padding is zero
+    assert np.all(rois[k:] == 0)
+
+
+def test_generate_proposals_v1_im_info():
+    rng = np.random.default_rng(11)
+    scores = rng.uniform(0, 1, (1, 2, 3, 3)).astype(np.float32)
+    deltas = (rng.standard_normal((1, 8, 3, 3)) * 0.1).astype(np.float32)
+    im_info = np.array([[48.0, 48.0, 1.0]], np.float32)
+    anchors, variances = D.anchor_generator(
+        np.zeros((1, 8, 3, 3), np.float32), anchor_sizes=[16.0],
+        aspect_ratios=[1.0, 2.0], variances=[1.0, 1.0, 1.0, 1.0],
+        stride=[16.0, 16.0])
+    rois, rscores, cnt = D.generate_proposals(
+        scores, deltas, im_info, _np(anchors), _np(variances),
+        post_nms_top_n=6)
+    assert _np(rois).shape == (6, 4)
+    assert int(_np(cnt)[0]) > 0
+
+
+def test_distribute_fpn_proposals():
+    rng = np.random.default_rng(12)
+    sizes = np.array([8, 16, 32, 64, 128, 224, 16, 100], np.float32)
+    x1 = rng.uniform(0, 10, sizes.shape[0]).astype(np.float32)
+    rois = np.stack([x1, x1, x1 + sizes, x1 + sizes], axis=1)
+    multi_rois, restore, counts = D.distribute_fpn_proposals(
+        rois, min_level=2, max_level=5, refer_level=4, refer_scale=224)
+    counts = _np(counts)
+    # numpy reference
+    scale = np.sqrt((sizes + 1.0) ** 2)
+    lvl = np.floor(np.log2(scale / 224 + 1e-6)) + 4
+    lvl = np.clip(lvl, 2, 5).astype(int)
+    for li in range(4):
+        want_rows = rois[lvl == li + 2]
+        got = _np(multi_rois[li])[: counts[li]]
+        np.testing.assert_allclose(got, want_rows, rtol=1e-5)
+    # restore index reorders the packed concat back to the original order
+    packed = np.concatenate(
+        [_np(multi_rois[li])[: counts[li]] for li in range(4)], axis=0)
+    np.testing.assert_allclose(packed[_np(restore)[:, 0]], rois, rtol=1e-5)
+
+
+def test_distribute_fpn_proposals_rois_num():
+    """Packed multi-image input: per-level-per-image counts come back, and
+    padded inputs are rejected loudly."""
+    sizes = np.array([8, 224, 16, 100], np.float32)
+    x1 = np.zeros(4, np.float32)
+    rois = np.stack([x1, x1, x1 + sizes, x1 + sizes], axis=1)
+    multi_rois, restore, per = D.distribute_fpn_proposals(
+        rois, min_level=2, max_level=5, refer_level=4, refer_scale=224,
+        rois_num=np.array([2, 2]))
+    per = _np(per)  # [4 levels, 2 images]
+    assert per.shape == (4, 2)
+    assert per.sum() == 4
+    # image 0 contributes the size-8 (level 2) and size-224 (level 4) rois
+    assert per[0, 0] == 1 and per[2, 0] == 1
+    with pytest.raises(ValueError):
+        D.distribute_fpn_proposals(rois, 2, 5, 4, 224,
+                                   rois_num=np.array([1, 2]))
+
+
+def test_collect_fpn_proposals():
+    rng = np.random.default_rng(13)
+    r1 = _rand_boxes(rng, 5)
+    r2 = _rand_boxes(rng, 5)
+    s1 = rng.uniform(0, 1, 5).astype(np.float32)
+    s2 = rng.uniform(0, 1, 5).astype(np.float32)
+    counts = np.array([4, 3], np.int32)  # last rows of each level = padding
+    rois, cnt = D.collect_fpn_proposals([r1, r2], [s1, s2], 2, 3,
+                                        post_nms_top_n=5,
+                                        rois_num_per_level=counts)
+    allr = np.concatenate([r1[:4], r2[:3]])
+    alls = np.concatenate([s1[:4], s2[:3]])
+    order = np.argsort(-alls, kind="stable")[:5]
+    np.testing.assert_allclose(_np(rois), allr[order], rtol=1e-5)
+    assert int(_np(cnt)) == 5
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+def test_polygon_box_transform():
+    rng = np.random.default_rng(14)
+    x = rng.standard_normal((1, 8, 3, 4)).astype(np.float32)
+    got = _np(D.polygon_box_transform(x))
+    want = np.empty_like(x)
+    for c in range(8):
+        for hh in range(3):
+            for ww in range(4):
+                idx = ww if c % 2 == 0 else hh
+                want[0, c, hh, ww] = 4 * idx - x[0, c, hh, ww]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_box_decoder_and_assign():
+    rng = np.random.default_rng(15)
+    m, c = 4, 3
+    pb = _rand_boxes(rng, m)
+    pbv = np.full((m, 4), 0.1, np.float32)
+    tb = (rng.standard_normal((m, 4 * c)) * 0.2).astype(np.float32)
+    sc = rng.uniform(0, 1, (m, c)).astype(np.float32)
+    dec, assigned = D.box_decoder_and_assign(pb, pbv, tb, sc)
+    dec, assigned = _np(dec), _np(assigned)
+    assert dec.shape == (m, 4 * c) and assigned.shape == (m, 4)
+    best = sc[:, 1:].argmax(1) + 1
+    for i in range(m):
+        np.testing.assert_allclose(assigned[i], dec[i, best[i] * 4:(best[i] + 1) * 4],
+                                   rtol=1e-5)
+    # spot-check one decode against the formula
+    pw = pb[0, 2] - pb[0, 0] + 1
+    cx = pb[0, 0] + 0.5 * pw + tb[0, 0] * 0.1 * pw
+    w = np.exp(tb[0, 2] * 0.1) * pw
+    np.testing.assert_allclose(dec[0, 0], cx - w / 2, rtol=1e-4)
+
+
+def test_mine_hard_examples():
+    loss = np.array([[0.9, 0.1, 0.8, 0.4, 0.7],
+                     [0.2, 0.3, 0.1, 0.6, 0.5]], np.float32)
+    match = np.array([[0, -1, -1, -1, -1],
+                      [-1, 1, -1, 2, -1]], np.int32)
+    sel, n_neg = D.mine_hard_examples(loss, match, neg_pos_ratio=2.0)
+    sel, n_neg = _np(sel), _np(n_neg)
+    # image 0: 1 positive → 2 negatives, the highest-loss unmatched: idx 2, 4
+    assert n_neg[0] == 2 and set(np.where(sel[0])[0]) == {2, 4}
+    # image 1: 2 positives → 4 negatives but only 3 unmatched exist
+    assert n_neg[1] == 3 and set(np.where(sel[1])[0]) == {0, 2, 4}

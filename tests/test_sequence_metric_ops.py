@@ -1,0 +1,215 @@
+"""Numpy-parity tests for the new sequence_ops tranche and the
+chunk_eval / mean_iou metrics (OpTest pattern; reference kernels:
+operators/sequence_ops/*, chunk_eval_op.h, mean_iou_op.h)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.metric import chunk_eval, mean_iou
+from paddle_tpu.ops import sequence as S
+from paddle_tpu.tensor import Tensor
+
+
+def _np(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x)
+
+
+def test_sequence_concat():
+    x1 = np.arange(10, dtype=np.float32).reshape(5, 2)
+    x2 = 100 + np.arange(8, dtype=np.float32).reshape(4, 2)
+    l1 = np.array([2, 3])
+    l2 = np.array([3, 1])
+    out, lens = S.sequence_concat([x1, x2], [l1, l2])
+    want = np.concatenate([x1[:2], x2[:3], x1[2:5], x2[3:4]])
+    np.testing.assert_allclose(_np(out), want)
+    np.testing.assert_array_equal(_np(lens), [5, 4])
+
+
+def test_sequence_pool_all_types():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((3, 5, 2)).astype(np.float32)
+    lens = np.array([3, 5, 1])
+    mask = np.arange(5)[None, :] < lens[:, None]
+    for pt in ("SUM", "AVERAGE", "SQRT", "MAX", "LAST", "FIRST"):
+        got = _np(S.sequence_pool(x, pt, length=lens))
+        if pt == "SUM":
+            want = (x * mask[..., None]).sum(1)
+        elif pt == "AVERAGE":
+            want = (x * mask[..., None]).sum(1) / lens[:, None]
+        elif pt == "SQRT":
+            want = (x * mask[..., None]).sum(1) / np.sqrt(lens)[:, None]
+        elif pt == "MAX":
+            want = np.where(mask[..., None], x, -np.inf).max(1)
+        elif pt == "LAST":
+            want = x[np.arange(3), lens - 1]
+        else:
+            want = x[:, 0]
+        np.testing.assert_allclose(got, want, rtol=1e-5, err_msg=pt)
+
+
+def test_sequence_pool_empty_seq_pad_value():
+    x = np.ones((2, 3, 1), np.float32)
+    lens = np.array([0, 2])
+    got = _np(S.sequence_pool(x, "SUM", length=lens, pad_value=-7.0))
+    np.testing.assert_allclose(got[0], -7.0)
+    np.testing.assert_allclose(got[1], 2.0)
+
+
+def test_sequence_conv():
+    rng = np.random.default_rng(1)
+    B, T, D, O, L = 2, 4, 3, 5, 3
+    x = rng.standard_normal((B, T, D)).astype(np.float32)
+    w = rng.standard_normal((L * D, O)).astype(np.float32)
+    lens = np.array([4, 2])
+    start = -1
+    got = _np(S.sequence_conv(x, w, length=lens, context_length=L,
+                              context_start=start))
+    want = np.zeros((B, T, O), np.float32)
+    for b in range(B):
+        for t in range(int(lens[b])):
+            ctx = []
+            for j in range(L):
+                s = t + start + j
+                ctx.append(x[b, s] if 0 <= s < lens[b] else np.zeros(D))
+            want[b, t] = np.concatenate(ctx) @ w
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_enumerate():
+    x = np.array([[1, 2, 3, 4], [5, 6, 0, 0]], np.int32)
+    lens = np.array([4, 2])
+    got = _np(S.sequence_enumerate(x, win_size=2, pad_value=0, length=lens))
+    want = np.array([[[1, 2], [2, 3], [3, 4], [4, 0]],
+                     [[5, 6], [6, 0], [0, 0], [0, 0]]], np.int32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sequence_erase():
+    x = np.array([[2, 2, 6, 1, 3, 9], [1, 0, 0, 0, 0, 0]], np.int64)
+    lens = np.array([6, 1])
+    out, nl = S.sequence_erase(x, [2, 3, 5], length=lens)
+    np.testing.assert_array_equal(_np(nl), [3, 1])
+    np.testing.assert_array_equal(_np(out)[0, :3], [6, 1, 9])
+    np.testing.assert_array_equal(_np(out)[1, :1], [1])
+
+
+def test_sequence_expand_as():
+    x = np.array([[1.0], [2.0], [3.0]], np.float32)
+    got = _np(S.sequence_expand_as(x, np.array([2, 0, 3])))
+    np.testing.assert_allclose(got[:, 0], [1, 1, 3, 3, 3])
+
+
+def test_sequence_reshape():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    out, lens = S.sequence_reshape(x, new_dim=4, length=np.array([2, 4]))
+    np.testing.assert_array_equal(_np(lens), [1, 2])
+    np.testing.assert_allclose(_np(out), x.reshape(3, 4))
+
+
+def test_sequence_scatter():
+    x = np.zeros((2, 5), np.float32)
+    idx = np.array([1, 3, 0, 2])
+    upd = np.array([10.0, 20.0, 30.0, 40.0], np.float32)
+    got = _np(S.sequence_scatter(x, idx, upd, index_lengths=np.array([2, 2])))
+    want = np.zeros((2, 5), np.float32)
+    want[0, 1], want[0, 3] = 10, 20
+    want[1, 0], want[1, 2] = 30, 40
+    np.testing.assert_allclose(got, want)
+
+
+def test_sequence_slice():
+    x = np.arange(24, dtype=np.float32).reshape(2, 6, 2)
+    out, lens = S.sequence_slice(x, offset=np.array([1, 0]),
+                                 length=np.array([2, 3]))
+    np.testing.assert_array_equal(_np(lens), [2, 3])
+    np.testing.assert_allclose(_np(out)[0, :2], x[0, 1:3])
+    np.testing.assert_allclose(_np(out)[1, :3], x[1, 0:3])
+    np.testing.assert_allclose(_np(out)[0, 2], 0.0)
+
+
+def test_row_conv():
+    rng = np.random.default_rng(2)
+    B, T, D, C = 2, 5, 3, 2
+    x = rng.standard_normal((B, T, D)).astype(np.float32)
+    w = rng.standard_normal((C, D)).astype(np.float32)
+    lens = np.array([5, 3])
+    got = _np(S.row_conv(x, w, length=lens))
+    want = np.zeros_like(x)
+    for b in range(B):
+        for t in range(int(lens[b])):
+            for j in range(C):
+                if t + j < lens[b]:
+                    want[b, t] += w[j] * x[b, t + j]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_im2sequence():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((1, 2, 4, 4)).astype(np.float32)
+    got = _np(S.im2sequence(x, filter_size=2, stride=2))
+    assert got.shape == (4, 8)  # 2x2 output grid, 2*2*2 features
+    # first patch, channel-major feature order [C, kh, kw]
+    want0 = np.concatenate([x[0, 0, :2, :2].reshape(-1),
+                            x[0, 1, :2, :2].reshape(-1)])
+    np.testing.assert_allclose(got[0], want0, rtol=1e-5)
+
+
+def test_sequence_grad_flows():
+    """sequence_pool/conv are differentiable through the tape."""
+    x = paddle.to_tensor(np.ones((2, 3, 2), np.float32), stop_gradient=False)
+    out = S.sequence_pool(x, "SUM", length=np.array([2, 3]))
+    out.sum().backward()
+    g = _np(x.grad)
+    assert g[0, :2].sum() == 4 and g[0, 2].sum() == 0
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def test_mean_iou():
+    pred = np.array([[0, 1, 2, 2], [1, 1, 0, 0]], np.int32)
+    lab = np.array([[0, 1, 2, 1], [2, 1, 0, 0]], np.int32)
+    miou, wrong, correct = mean_iou(pred, lab, num_classes=3)
+    # correct: c0=3, c1=2, c2=1; wrong: mismatches (2,1) and (1,2):
+    # wrong[1] += 2, wrong[2] += 2
+    np.testing.assert_array_equal(_np(correct), [3, 2, 1])
+    np.testing.assert_array_equal(_np(wrong), [0, 2, 2])
+    want = (3 / 3 + 2 / 4 + 1 / 3) / 3
+    np.testing.assert_allclose(float(_np(miou)), want, rtol=1e-5)
+
+
+def test_chunk_eval_iob():
+    """IOB with 2 chunk types: labels 0=B-0, 1=I-0, 2=B-1, 3=I-1, 4=O."""
+    # label:  [B-0 I-0 O  B-1] → chunks (0,1,t0), (3,3,t1)
+    # pred:   [B-0 I-0 O  B-0] → chunks (0,1,t0), (3,3,t0)
+    lab = np.array([[0, 1, 4, 2]], np.int64)
+    pred = np.array([[0, 1, 4, 0]], np.int64)
+    p, r, f1, ni, nl, nc = chunk_eval(pred, lab, "IOB", num_chunk_types=2)
+    assert int(_np(ni)) == 2 and int(_np(nl)) == 2 and int(_np(nc)) == 1
+    np.testing.assert_allclose(float(_np(p)), 0.5)
+    np.testing.assert_allclose(float(_np(r)), 0.5)
+    np.testing.assert_allclose(float(_np(f1)), 0.5)
+
+
+def test_chunk_eval_iobes_exact():
+    """IOBES: 4 tags per type (B,I,E,S); 1 type + other=1.
+    labels: B=0 I=1 E=2 S=3, O=4."""
+    lab = np.array([[0, 1, 2, 4, 3]], np.int64)   # chunk (0,2), chunk (4,4)
+    pred = np.array([[0, 1, 2, 4, 4]], np.int64)  # chunk (0,2)
+    p, r, f1, ni, nl, nc = chunk_eval(pred, lab, "IOBES", num_chunk_types=1)
+    assert int(_np(ni)) == 1 and int(_np(nl)) == 2 and int(_np(nc)) == 1
+    np.testing.assert_allclose(float(_np(p)), 1.0)
+    np.testing.assert_allclose(float(_np(r)), 0.5)
+
+
+def test_chunk_eval_seq_length_and_excluded():
+    lab = np.array([[0, 1, 4, 0], [0, 4, 4, 4]], np.int64)
+    pred = lab.copy()
+    p, r, f1, ni, nl, nc = chunk_eval(pred, lab, "IOB", num_chunk_types=2,
+                                      seq_length=np.array([2, 1]))
+    assert int(_np(nc)) == 2 and float(_np(f1)) == 1.0
+    # excluding type 0 removes every chunk
+    p2, r2, f2, ni2, nl2, nc2 = chunk_eval(
+        pred, lab, "IOB", num_chunk_types=2, seq_length=np.array([2, 1]),
+        excluded_chunk_types=[0])
+    assert int(_np(ni2)) == 0 and float(_np(f2)) == 0.0
