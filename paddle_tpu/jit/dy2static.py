@@ -1,22 +1,28 @@
-"""dygraph-to-static AST conversion: data-dependent Python ``if``/``while``
-on Tensors become ``lax.cond`` / ``lax.while_loop`` under ``@to_static``.
+"""dygraph-to-static AST conversion: data-dependent Python control flow
+on Tensors becomes ``lax.cond`` / ``lax.while_loop`` under ``@to_static``.
 
 Parity: the reference's 25-file AST transpiler
 (/root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
-program_translator.py:768 ProgramTranslator + ifelse/loop transformers).
-TPU-native scope: a deliberately minimal, CONSERVATIVE pass —
+program_translator.py:768 ProgramTranslator + per-construct transformers:
+ifelse_transformer, loop_transformer, break_continue_transformer,
+return_transformer). TPU-native scope — a CONSERVATIVE layered pass:
 
-- an ``if``/``while`` is rewritten only when its body is expressible as a
-  pure closure: simple name assignments, no return/break/continue/yield.
-  Anything else keeps the original Python statement (which still works for
-  concrete predicates and raises jax's tracer error for traced ones).
-- rewritten constructs dispatch at RUN time: concrete predicates take the
-  plain Python path (bit-identical semantics), traced predicates lower to
-  ``lax.cond``/``lax.while_loop``.
+1. ``for i in range(...)`` loops lower to ``while`` with an explicit trip
+   count (loop_transformer role) so tensor-dependent bounds/carries trace.
+2. early ``return`` anywhere becomes a (done-flag, value) pair threaded
+   through the function; loops gain ``not done`` in their condition and
+   trailing statements are guarded (return_transformer role).
+3. ``break``/``continue`` become per-loop flags: following statements are
+   guarded, the loop condition gains ``not broken``, and ``else:`` on a
+   loop runs under ``not broken`` (break_continue_transformer role).
+4. the remaining ``if``/``while`` statements with pure-assignment bodies
+   extract to closures that dispatch at RUN time: concrete predicates take
+   the plain Python path (bit-identical semantics), traced predicates
+   lower to ``lax.cond``/``lax.while_loop``.
 
-This covers the reference dygraph_to_static test shapes (tensor-valued
-if/else assignment, counting/accumulating while loops) without attempting
-the full transpiler; unconvertible control flow keeps a teachable error.
+Anything still unconvertible keeps the original Python statement (which
+works for concrete predicates and raises jax's teachable tracer error for
+traced ones).
 """
 from __future__ import annotations
 
@@ -70,8 +76,55 @@ class _Undefined:
 UNDEFINED = _Undefined()
 
 
-def pd_cond(pred, true_fn, false_fn, args=()):
-    """if/else dispatch: Python for concrete preds, lax.cond for traced."""
+def _improper(v):
+    return v is None or isinstance(v, _Undefined)
+
+
+def _probe_structs(fn, args):
+    """Structure/aval discovery for a pure closure returning a tuple, via
+    jax.eval_shape — no ops are emitted into the enclosing trace. Improper
+    (None/undefined) positions are recorded out-of-band in ``kinds`` (the
+    python side of the trace runs concretely)."""
+    import jax
+
+    from ..tensor import Tensor
+
+    kinds = {}
+
+    def leaf(x):
+        return isinstance(x, (Tensor, _Undefined)) or x is None
+
+    def enc():
+        out = fn(*args)
+        res = []
+        for i, v in enumerate(out):
+            if _improper(v):
+                kinds[i] = "none" if v is None else "undef"
+                res.append(None)
+                continue
+            leaves, tree = jax.tree_util.tree_flatten(v, is_leaf=leaf)
+            if any(_improper(x) for x in leaves):
+                raise ValueError(
+                    "a container output of converted control flow holds an "
+                    "undefined element; assign it on all paths")
+            res.append(jax.tree_util.tree_unflatten(
+                tree, [x._data if isinstance(x, Tensor) else x
+                       for x in leaves]))
+        return tuple(res)
+
+    structs = jax.eval_shape(enc)
+    return structs, kinds
+
+
+def pd_cond(pred, true_fn, false_fn, args=(), soft=()):
+    """if/else dispatch: Python for concrete preds, lax.cond for traced.
+
+    ``soft``: output POSITIONS (indices into the branch-return tuple) owned
+    by the transformer's own threading variables (return value/flags).
+    When such a position is None/undefined on one branch, it unifies as
+    zeros of the other branch's avals — sound because the guard discipline
+    never reads the value unless the flag says its branch assigned it.
+    User variables (non-soft) keep the loud error."""
     import numpy as np
 
     p = _pred_value(pred)
@@ -82,99 +135,266 @@ def pd_cond(pred, true_fn, false_fn, args=()):
 
     from ..tensor import Tensor
 
-    cell = {}
+    st_t, kinds_t = _probe_structs(true_fn, args)
+    st_f, kinds_f = _probe_structs(false_fn, args)
+    n = len(st_t)
+    # per position: either a constant (improper on both sides), or a
+    # ref subtree whose leaves go through lax.cond
+    const_out, ref_tree, n_leaves = {}, {}, {}
+    for i in range(n):
+        imp_t, imp_f = i in kinds_t, i in kinds_f
+        if imp_t and imp_f:
+            const_out[i] = None if kinds_t[i] == "none" else UNDEFINED
+            continue
+        if imp_t or imp_f:
+            if i not in soft:
+                raise ValueError(
+                    "a tensor-dependent if/else leaves a variable "
+                    "undefined on one branch; assign it on both paths "
+                    "(lax.cond requires matching branch outputs)")
+            good = st_f[i] if imp_t else st_t[i]
+        else:
+            lt, tt = jax.tree_util.tree_flatten(st_t[i])
+            lf, tf = jax.tree_util.tree_flatten(st_f[i])
+            if tt != tf or [(x.shape, x.dtype) for x in lt] != [
+                    (x.shape, x.dtype) for x in lf]:
+                raise ValueError(
+                    "tensor-dependent if/else branches produce different "
+                    "structures/shapes for the same variable (lax.cond "
+                    "requires matching branch outputs)")
+            good = st_t[i]
+        leaves, tree = jax.tree_util.tree_flatten(good)
+        ref_tree[i] = tree
+        n_leaves[i] = len(leaves)
+
+    keep = sorted(ref_tree)
+    protos = {
+        i: [jnp.zeros(s.shape, s.dtype)
+            for s in jax.tree_util.tree_flatten(
+                st_f[i] if i in kinds_t else st_t[i])[0]]
+        for i in keep
+    }
+
+    def leaf(x):
+        return isinstance(x, (Tensor, _Undefined)) or x is None
 
     def wrap(fn):
         def f(_):
             out = fn(*args)
-            flat, tree = jax.tree_util.tree_flatten(
-                out, is_leaf=lambda x: isinstance(x, Tensor))
-            cell.setdefault("tree", tree)
             arrs = []
-            for x in flat:
-                if isinstance(x, _Undefined):
-                    raise ValueError(
-                        "a tensor-dependent if/else leaves a variable "
-                        "undefined on one branch; assign it on both paths "
-                        "(lax.cond requires matching branch outputs)")
-                arrs.append(x._data if isinstance(x, Tensor) else jnp.asarray(x))
+            for i in keep:
+                v = out[i]
+                if _improper(v):
+                    arrs.extend(protos[i])
+                    continue
+                leaves, _t = jax.tree_util.tree_flatten(v, is_leaf=leaf)
+                arrs.extend(x._data if isinstance(x, Tensor)
+                            else jnp.asarray(x) for x in leaves)
             return tuple(arrs)
 
         return f
 
     res = jax.lax.cond(jnp.reshape(p, ()).astype(bool),
                        wrap(true_fn), wrap(false_fn), ())
-    from ..tensor import Tensor as T
+    out, it = [], iter(res)
+    for i in range(n):
+        if i in const_out:
+            out.append(const_out[i])
+        else:
+            leaves = [Tensor(next(it)) for _ in range(n_leaves[i])]
+            out.append(jax.tree_util.tree_unflatten(ref_tree[i], leaves))
+    return tuple(out)
 
-    return jax.tree_util.tree_unflatten(cell["tree"], [T(a) for a in res])
+
+def pd_not(x):
+    """``not x`` that stays traceable (guards emitted by the return /
+    break-continue transformers)."""
+    p = _pred_value(x)
+    if _is_traced(p):
+        import jax.numpy as jnp
+
+        return jnp.logical_not(p)
+    import numpy as np
+
+    return not bool(np.asarray(p).reshape(()))
 
 
-def pd_while(cond_fn, body_fn, init):
+def pd_and(a, b):
+    """Eager-but-traceable ``a and b`` for transformed loop conditions."""
+    pa, pb = _pred_value(a), _pred_value(b)
+    if _is_traced(pa) or _is_traced(pb):
+        import jax.numpy as jnp
+
+        return jnp.logical_and(pa, pb)
+    import numpy as np
+
+    return bool(np.asarray(pa).reshape(())) and bool(np.asarray(pb).reshape(()))
+
+
+def pd_or(a, b):
+    pa, pb = _pred_value(a), _pred_value(b)
+    if _is_traced(pa) or _is_traced(pb):
+        import jax.numpy as jnp
+
+        return jnp.logical_or(pa, pb)
+    import numpy as np
+
+    return bool(np.asarray(pa).reshape(())) or bool(np.asarray(pb).reshape(()))
+
+
+def pd_range_len(start, stop, step):
+    """Trip count of range(start, stop, step), traceable."""
+    s, e, st = (_pred_value(v) for v in (start, stop, step))
+    if not any(_is_traced(v) for v in (s, e, st)):
+        return len(range(int(s), int(e), int(st)))
+    import jax.numpy as jnp
+
+    up = (e - s + st - 1) // st
+    down = (s - e + (-st) - 1) // (-st)
+    return jnp.maximum(0, jnp.where(st > 0, up, down))
+
+
+def pd_while(cond_fn, body_fn, init, soft=()):
     """while dispatch: Python loop for concrete conds, lax.while_loop for
     traced. ``init`` is the tuple of loop-carried values (all tensor-like);
-    their shapes/dtypes must be loop-invariant on the traced path."""
+    their shapes/dtypes must be loop-invariant on the traced path.
+
+    ``soft``: carry positions owned by the transformer's threading
+    variables (return value/flags). A soft carry that is None/undefined at
+    loop entry takes zeros of the aval the body assigns it (the guard
+    discipline never reads it before the flag says it was set)."""
     import numpy as np
 
     from ..tensor import Tensor
 
     p0 = _pred_value(cond_fn(*init))
     if not _is_traced(p0):
+        # concrete path — but a carry can BECOME traced mid-loop (e.g. a
+        # break flag set inside a converted tensor-if): re-check each
+        # iteration and hand the remaining iterations to lax.while_loop
         vals = tuple(init)
-        while bool(np.asarray(_pred_value(cond_fn(*vals))).reshape(())):
+        while True:
+            c = _pred_value(cond_fn(*vals))
+            if _is_traced(c):
+                return pd_while(cond_fn, body_fn, vals, soft)
+            if not bool(np.asarray(c).reshape(())):
+                return vals
             vals = tuple(body_fn(*vals))
-        return vals
     import jax
     import jax.numpy as jnp
 
-    def unwrap_all(vals):
-        return tuple(v._data if isinstance(v, Tensor) else jnp.asarray(v)
-                     for v in vals)
+    def improper(v):
+        return v is None or isinstance(v, _Undefined)
 
-    def wrap_all(arrs):
-        return tuple(Tensor(a) for a in arrs)
+    init = list(init)
+    const_pos = {}
+    bad = [i for i, v in enumerate(init) if improper(v)]
+    if bad:
+        if any(i not in soft for i in bad):
+            raise ValueError(
+                "a tensor-dependent while carries a variable that is "
+                "undefined at loop entry; assign it before the loop")
+        # aval discovery via eval_shape (no ops emitted into the trace)
+        structs, kinds = _probe_structs(body_fn, tuple(init))
+        for i in bad:
+            if i in kinds:
+                const_pos[i] = init[i]  # never assigned a tensor: constant
+                continue
+            leaves, _tree = jax.tree_util.tree_flatten(structs[i])
+            if len(leaves) != 1:
+                raise ValueError(
+                    "a while-carried return value must be a single tensor "
+                    "(return a tuple AFTER the loop instead)")
+            init[i] = Tensor(jnp.zeros(leaves[0].shape, leaves[0].dtype))
+
+    keep = [i for i in range(len(init)) if i not in const_pos]
+
+    def rebuild(arrs):
+        it = iter(arrs)
+        return tuple(const_pos[i] if i in const_pos else Tensor(next(it))
+                     for i in range(len(init)))
+
+    def unwrap_keep(vals):
+        return tuple(
+            vals[i]._data if isinstance(vals[i], Tensor)
+            else jnp.asarray(vals[i]) for i in keep)
 
     def c(carry):
-        return jnp.reshape(_pred_value(cond_fn(*wrap_all(carry))), ()).astype(bool)
+        return jnp.reshape(_pred_value(cond_fn(*rebuild(carry))), ()).astype(bool)
 
     def b(carry):
-        return unwrap_all(body_fn(*wrap_all(carry)))
+        return unwrap_keep(body_fn(*rebuild(carry)))
 
-    out = jax.lax.while_loop(c, b, unwrap_all(init))
-    return wrap_all(out)
+    out = jax.lax.while_loop(c, b, unwrap_keep(init))
+    return rebuild(out)
 
 
 # ---------------------------------------------------------------------------
 # the AST pass
 # ---------------------------------------------------------------------------
+def _is_capture_prelude_try(st: ast.Try) -> bool:
+    """Recognize our generated try/except shapes: the _capture_prelude
+    (__pd_v* tmp, iteration-local) and the for-lowering target guard
+    (name = name / except: name = start — the name is re-assigned by the
+    loop advance anyway, so neither contributes a carry here)."""
+    if not (len(st.body) == 1 and isinstance(st.body[0], ast.Assign)
+            and isinstance(st.body[0].targets[0], ast.Name)):
+        return False
+    tgt = st.body[0].targets[0].id
+    if tgt.startswith("__pd_v"):
+        return True
+    # target guard: try: n = n
+    return (isinstance(st.body[0].value, ast.Name)
+            and st.body[0].value.id == tgt)
+
+
 def _assigned_names(stmts: List[ast.stmt]) -> Optional[Set[str]]:
-    """Names simply assigned in the statement list; None = unconvertible."""
+    """Names simply assigned in the statement list; None = unconvertible.
+
+    Scope-aware: function defs (both user closures and the artifacts our
+    own if-conversion leaves behind — closure defs + capture preludes) are
+    allowed but contribute NO carried names, because they are re-bound
+    every iteration before use."""
     names: Set[str] = set()
-    for st in ast.walk(ast.Module(body=list(stmts), type_ignores=[])):
-        if isinstance(st, (ast.Return, ast.Break, ast.Continue, ast.Yield,
-                           ast.YieldFrom, ast.Global, ast.Nonlocal,
-                           ast.FunctionDef, ast.AsyncFunctionDef,
-                           ast.Try, ast.With, ast.Raise)):
-            return None
+
+    def visit_block(body) -> bool:
+        return all(visit_stmt(s) for s in body)
+
+    def visit_stmt(st) -> bool:
+        if isinstance(st, (ast.Return, ast.Break, ast.Continue,
+                           ast.Global, ast.Nonlocal, ast.AsyncFunctionDef,
+                           ast.With, ast.AsyncWith, ast.Raise,
+                           ast.AsyncFor)):
+            return False
+        # yields at THIS scope level make the body a generator → bail
+        for n in _walk_scope(st):
+            if isinstance(n, (ast.Yield, ast.YieldFrom)):
+                return False
+        if isinstance(st, ast.FunctionDef):
+            return True  # iteration-local binding; nothing carried
+        if isinstance(st, ast.Try):
+            return _is_capture_prelude_try(st)
         if isinstance(st, ast.Assign):
             for t in st.targets:
                 if isinstance(t, ast.Name):
-                    names.add(t.id)
+                    if not t.id.startswith("__pd_v"):
+                        names.add(t.id)
                 elif isinstance(t, ast.Tuple) and all(
                         isinstance(e, ast.Name) for e in t.elts):
                     names.update(e.id for e in t.elts)
                 else:
-                    return None
-        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+                    return False
+            return True
+        if isinstance(st, (ast.AugAssign, ast.AnnAssign)):
             if isinstance(st.target, ast.Name):
                 names.add(st.target.id)
-            else:
-                return None
-        elif isinstance(st, ast.NamedExpr):
-            if isinstance(st.target, ast.Name):
-                names.add(st.target.id)
-            else:
-                return None
-        elif isinstance(st, (ast.For, ast.AsyncFor)):
+                return True
+            return False
+        if isinstance(st, ast.If):
+            return visit_block(st.body) and visit_block(st.orelse)
+        if isinstance(st, (ast.While,)):
+            return not st.orelse and visit_block(st.body)
+        if isinstance(st, ast.For):
             t = st.target
             if isinstance(t, ast.Name):
                 names.add(t.id)
@@ -182,7 +402,27 @@ def _assigned_names(stmts: List[ast.stmt]) -> Optional[Set[str]]:
                     isinstance(e, ast.Name) for e in t.elts):
                 names.update(e.id for e in t.elts)
             else:
-                return None
+                return False
+            return not st.orelse and visit_block(st.body)
+        if isinstance(st, (ast.Expr, ast.Pass, ast.Assert, ast.Delete,
+                           ast.Import, ast.ImportFrom)):
+            # walrus targets inside expressions are carries
+            for n in _walk_scope(st):
+                if isinstance(n, ast.NamedExpr):
+                    if isinstance(n.target, ast.Name):
+                        names.add(n.target.id)
+                    else:
+                        return False
+            return True
+        return False
+
+    if not visit_block(list(stmts)):
+        return None
+    # walrus expressions nested in convertible statements' tests/values
+    for st in stmts:
+        for n in _walk_scope(st):
+            if isinstance(n, ast.NamedExpr) and isinstance(n.target, ast.Name):
+                names.add(n.target.id)
     return names
 
 
@@ -241,12 +481,273 @@ def _fn_args(params):
                          kwonlyargs=[], kw_defaults=[], defaults=[])
 
 
+_SCOPE_STOPS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+
+
+def _walk_scope(node):
+    """Walk without descending into nested function/class scopes."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, _SCOPE_STOPS):
+                continue
+            stack.append(c)
+
+
+def _assign(name, value):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=value)
+
+
+def _call(fn_name, args):
+    return ast.Call(func=ast.Name(id=fn_name, ctx=ast.Load()),
+                    args=args, keywords=[])
+
+
+def _name(n):
+    return ast.Name(id=n, ctx=ast.Load())
+
+
+class _Unsupported(Exception):
+    pass
+
+
+class _ForRangeLowering(ast.NodeTransformer):
+    """``for i in range(...)`` → explicit-trip-count ``while`` (reference
+    loop_transformer): tensor-dependent bounds and loop carries then trace
+    through the while machinery. The index/target assignments run BEFORE
+    the user body so a transformed ``continue`` cannot skip the advance."""
+
+    def __init__(self):
+        self.n = 0
+        self.changed = False
+
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3
+                and isinstance(node.target, ast.Name)):
+            return node
+        self.n += 1
+        self.changed = True
+        k = self.n
+        start = it.args[0] if len(it.args) >= 2 else ast.Constant(0)
+        stop = it.args[1] if len(it.args) >= 2 else it.args[0]
+        step = it.args[2] if len(it.args) == 3 else ast.Constant(1)
+        v_start, v_stop, v_step = (f"__pd_start{k}", f"__pd_stop{k}",
+                                   f"__pd_step{k}")
+        v_idx, v_trip = f"__pd_idx{k}", f"__pd_trip{k}"
+        pre = [
+            _assign(v_start, start), _assign(v_stop, stop),
+            _assign(v_step, step), _assign(v_idx, ast.Constant(0)),
+            _assign(v_trip, _call("__pd_range_len__",
+                                  [_name(v_start), _name(v_stop),
+                                   _name(v_step)])),
+            # the target is (re)assigned at the top of every iteration; this
+            # try-guard only gives the while carry a defined value/dtype
+            # WITHOUT clobbering a pre-existing binding (empty-range python
+            # semantics keep the old value)
+            ast.Try(
+                body=[_assign(node.target.id, _name(node.target.id))],
+                handlers=[ast.ExceptHandler(
+                    type=ast.Tuple(
+                        elts=[_name("NameError"), _name("UnboundLocalError")],
+                        ctx=ast.Load()),
+                    name=None,
+                    body=[_assign(node.target.id, _name(v_start))])],
+                orelse=[], finalbody=[]),
+        ]
+        advance = [
+            _assign(node.target.id, ast.BinOp(
+                left=_name(v_start), op=ast.Add(),
+                right=ast.BinOp(left=_name(v_idx), op=ast.Mult(),
+                                right=_name(v_step)))),
+            _assign(v_idx, ast.BinOp(left=_name(v_idx), op=ast.Add(),
+                                     right=ast.Constant(1))),
+        ]
+        w = ast.While(
+            test=ast.Compare(left=_name(v_idx), ops=[ast.Lt()],
+                             comparators=[_name(v_trip)]),
+            body=advance + node.body, orelse=node.orelse)
+        return pre + [w]
+
+
+_RET_VAL, _RET_FLAG = "__pd_ret_val", "__pd_ret_done"
+
+
+def _transform_returns(fdef) -> bool:
+    """Early returns → (done-flag, value) threading (reference
+    return_transformer). Returns True when the function was rewritten;
+    raises _Unsupported for constructs we refuse to guard (with/try
+    containing a return)."""
+    body = fdef.body
+    early = False
+    for n in _walk_scope(fdef):
+        if isinstance(n, ast.Return) and n not in body[-1:]:
+            early = True
+            break
+    if not early:
+        return False
+
+    def rewrite_block(stmts):
+        out, may = [], False
+        for i, st in enumerate(stmts):
+            if isinstance(st, ast.Return):
+                out.append(_assign(_RET_VAL, st.value or ast.Constant(None)))
+                out.append(_assign(_RET_FLAG, ast.Constant(True)))
+                return out, True  # rest of the block is dead
+            st, st_may = rewrite_stmt(st)
+            out.append(st)
+            if st_may:
+                rest, _ = rewrite_block(stmts[i + 1:])
+                if rest:
+                    out.append(ast.If(
+                        test=_call("__pd_not__", [_name(_RET_FLAG)]),
+                        body=rest, orelse=[]))
+                return out, True
+        return out, may
+
+    def rewrite_stmt(st):
+        if isinstance(st, ast.If):
+            st.body, m1 = rewrite_block(st.body)
+            st.orelse, m2 = rewrite_block(st.orelse) if st.orelse else ([], False)
+            return st, m1 or m2
+        if isinstance(st, ast.While):
+            st.body, m = rewrite_block(st.body)
+            if m:
+                st.test = _call("__pd_and__",
+                                [_call("__pd_not__", [_name(_RET_FLAG)]),
+                                 st.test])
+            return st, m
+        if isinstance(st, ast.For):
+            st.body, m = rewrite_block(st.body)
+            if m:
+                # python-level for: escape concretely (a traced return flag
+                # inside a plain for is unconvertible by design)
+                st.body.append(ast.If(test=_name(_RET_FLAG),
+                                      body=[ast.Break()], orelse=[]))
+            return st, m
+        if any(isinstance(n, ast.Return) for n in _walk_scope(st)):
+            raise _Unsupported("return inside with/try is not convertible")
+        return st, False
+
+    new_body, _ = rewrite_block(body)
+    fdef.body = ([_assign(_RET_FLAG, ast.Constant(False)),
+                  _assign(_RET_VAL, ast.Constant(None))]
+                 + new_body
+                 + [ast.Return(value=_name(_RET_VAL))])
+    return True
+
+
+def _direct_break_continue(stmts):
+    """Break/Continue nodes belonging to THIS loop level (not nested
+    loops)."""
+    has_b = has_c = False
+    stack = list(stmts)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Break):
+            has_b = True
+        elif isinstance(n, ast.Continue):
+            has_c = True
+        elif isinstance(n, (ast.While, ast.For) + _SCOPE_STOPS):
+            continue  # nested loop owns its own break/continue
+        else:
+            stack.extend(ast.iter_child_nodes(n))
+    return has_b, has_c
+
+
+class _BreakContinueTransformer(ast.NodeTransformer):
+    """break/continue → guard flags (reference
+    break_continue_transformer): statements after a (possibly conditional)
+    break/continue are wrapped in ``if not flag``, the while condition
+    gains ``not broken``, and a loop ``else`` runs under ``not broken``."""
+
+    def __init__(self):
+        self.n = 0
+        self.changed = False
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)  # inner loops first
+        has_b, has_c = _direct_break_continue(node.body)
+        if not (has_b or has_c):
+            return node
+        self.n += 1
+        self.changed = True
+        brk, cont = f"__pd_brk{self.n}", f"__pd_cont{self.n}"
+        flags = ([_name(brk)] if has_b else []) + ([_name(cont)] if has_c else [])
+
+        def guard_test():
+            t = flags[0]
+            for f in flags[1:]:
+                t = _call("__pd_or__", [t, f])
+            return _call("__pd_not__", [t])
+
+        def guard_block(stmts):
+            out = []
+            for i, st in enumerate(stmts):
+                if isinstance(st, ast.Break):
+                    out.append(_assign(brk, ast.Constant(True)))
+                    return out, True
+                if isinstance(st, ast.Continue):
+                    out.append(_assign(cont, ast.Constant(True)))
+                    return out, True
+                st, may = guard_stmt(st)
+                out.append(st)
+                if may:
+                    rest, _ = guard_block(stmts[i + 1:])
+                    if rest:
+                        out.append(ast.If(test=guard_test(), body=rest,
+                                          orelse=[]))
+                    return out, True
+            return out, False
+
+        def guard_stmt(st):
+            if isinstance(st, ast.If):
+                st.body, m1 = guard_block(st.body)
+                st.orelse, m2 = (guard_block(st.orelse) if st.orelse
+                                 else ([], False))
+                return st, m1 or m2
+            # nested loops own their break/continue; other statements can't
+            return st, False
+
+        body, _ = guard_block(node.body)
+        node.body = ([_assign(cont, ast.Constant(False))] if has_c else []) + body
+        out = []
+        if has_c:
+            # pre-loop init: the flag is re-set each iteration, but the
+            # while conversion carries it, so it must be bound before entry
+            out.append(_assign(cont, ast.Constant(False)))
+        if has_b:
+            out.append(_assign(brk, ast.Constant(False)))
+            node.test = _call("__pd_and__",
+                              [_call("__pd_not__", [_name(brk)]), node.test])
+        orelse = node.orelse
+        node.orelse = []
+        out.append(node)
+        if orelse:
+            if has_b:
+                out.append(ast.If(test=_call("__pd_not__", [_name(brk)]),
+                                  body=orelse, orelse=[]))
+            else:
+                out.extend(orelse)  # never broken → else always runs
+        return out
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
-    def __init__(self, fn_locals: Set[str], fn_load_counts=None):
+    def __init__(self, fn_locals: Set[str], root=None):
         self.counter = 0
         self.converted = 0
         self.fn_locals = fn_locals
-        self.fn_load_counts = fn_load_counts or {}
+        # liveness is computed against the CURRENT tree at each visit:
+        # inner conversions add loads (capture preludes, guard tests), so a
+        # pre-transform snapshot would under-count and drop outputs
+        self.root = root
 
     def _name(self, kind):
         self.counter += 1
@@ -263,8 +764,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         # (a branch-local loop temp stays internal — matching the reference
         # transformer's return-name analysis)
         inner = _load_counts(node)
+        outer = _load_counts(self.root) if self.root is not None else inner
         outs = sorted(n for n in (t_names | f_names)
-                      if self.fn_load_counts.get(n, 0) > inner.get(n, 0))
+                      if outer.get(n, 0) > inner.get(n, 0))
         loaded = set()
         for st in node.body + (node.orelse or []):
             loaded |= _loaded_names(st)
@@ -285,13 +787,15 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 body=(list(body) or [ast.Pass()]) + [ret],
                 decorator_list=[])
 
+        soft = tuple(i for i, o in enumerate(outs) if o.startswith("__pd_"))
         call = ast.Call(
             func=ast.Name(id="__pd_cond__", ctx=ast.Load()),
             args=[node.test,
                   ast.Name(id=tn, ctx=ast.Load()),
                   ast.Name(id=fn_, ctx=ast.Load()),
                   ast.Tuple(elts=[ast.Name(id=t, ctx=ast.Load()) for t in tmps],
-                            ctx=ast.Load())],
+                            ctx=ast.Load()),
+                  ast.Constant(soft)],
             keywords=[])
         if outs:
             assign = ast.Assign(
@@ -330,12 +834,15 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         body_def = ast.FunctionDef(
             name=bn, args=_fn_args(carried),
             body=list(node.body) + [body_ret], decorator_list=[])
+        soft = tuple(i for i, c_ in enumerate(carried)
+                     if c_.startswith("__pd_"))
         call = ast.Call(
             func=ast.Name(id="__pd_while__", ctx=ast.Load()),
             args=[ast.Name(id=cn, ctx=ast.Load()),
                   ast.Name(id=bn, ctx=ast.Load()),
                   ast.Tuple(elts=[ast.Name(id=t, ctx=ast.Load()) for t in tmps],
-                            ctx=ast.Load())],
+                            ctx=ast.Load()),
+                  ast.Constant(soft)],
             keywords=[])
         assign = ast.Assign(
             targets=[ast.Tuple(
@@ -360,9 +867,22 @@ def _convert_cached(fn):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
     fdef.decorator_list = []  # drop @to_static etc.
-    tr = _ControlFlowTransformer(_fn_locals(fdef), _load_counts(fdef))
+    # pre-passes (ordered): for-range lowering → return threading →
+    # break/continue flags; then the closure-extracting if/while pass
+    pre_changed = False
+    lower = _ForRangeLowering()
+    lower.visit(tree)
+    pre_changed |= lower.changed
+    try:
+        pre_changed |= _transform_returns(fdef)
+    except _Unsupported:
+        return None  # keep the original function untouched
+    bc = _BreakContinueTransformer()
+    bc.visit(tree)
+    pre_changed |= bc.changed
+    tr = _ControlFlowTransformer(_fn_locals(fdef), root=tree)
     tr.visit(tree)
-    if tr.converted == 0:
+    if tr.converted == 0 and not pre_changed:
         return None
     ast.fix_missing_locations(tree)
     code = compile(tree, f"<dy2static:{fn.__qualname__}>", "exec")
@@ -370,6 +890,10 @@ def _convert_cached(fn):
     glb["__pd_cond__"] = pd_cond
     glb["__pd_while__"] = pd_while
     glb["__pd_undef__"] = UNDEFINED
+    glb["__pd_not__"] = pd_not
+    glb["__pd_and__"] = pd_and
+    glb["__pd_or__"] = pd_or
+    glb["__pd_range_len__"] = pd_range_len
     # closures: rebuild free variables from the original function
     if fn.__closure__:
         for name, cellv in zip(fn.__code__.co_freevars, fn.__closure__):

@@ -821,7 +821,17 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
     scores = jnp.concatenate(
         [_arr(s).reshape(-1) for s in multi_scores], axis=0)
     if rois_num_per_level is not None:
-        counts = _arr(rois_num_per_level).reshape(-1)
+        # accept [L] totals or the [L, N] per-image counts that
+        # distribute_fpn_proposals emits — a level's valid-row count is the
+        # sum over images either way
+        counts = _arr(rois_num_per_level)
+        if counts.ndim > 1:
+            counts = counts.sum(axis=tuple(range(1, counts.ndim)))
+        counts = counts.reshape(-1)
+        if int(counts.shape[0]) != len(multi_rois):
+            raise ValueError(
+                f"rois_num_per_level has {int(counts.shape[0])} levels but "
+                f"{len(multi_rois)} level arrays were passed")
         sizes = [int(_arr(r).shape[0]) for r in multi_rois]
         valids = []
         for li, sz in enumerate(sizes):
@@ -901,6 +911,14 @@ def mine_hard_examples(cls_loss, match_indices, neg_pos_ratio=3.0,
     per image, rank unmatched priors by loss and keep the top
     neg_pos_ratio * num_pos as negatives. Returns (neg_mask [N, P] bool,
     neg_count [N])."""
+    if mining_type != "max_negative":
+        raise NotImplementedError(
+            f"mining_type {mining_type!r} is not implemented (only "
+            "'max_negative'; 'hard_example' needs sample_size sampling)")
+    if sample_size is not None:
+        raise NotImplementedError(
+            "sample_size belongs to mining_type='hard_example', which is "
+            "not implemented")
 
     @primitive(nondiff=True)
     def _mine(loss, match):

@@ -166,3 +166,242 @@ class TestDoubleGradThroughJit:
         np.testing.assert_allclose(np.asarray(g1._data), [3.0, 12.0])
         (g2,) = tape.grad(g1.sum(), [x])
         np.testing.assert_allclose(np.asarray(g2._data), [6.0, 12.0])
+
+
+class TestLoopBreadth:
+    """Round-4 breadth (reference loop_transformer / break_continue_
+    transformer / return_transformer test shapes, dygraph_to_static/
+    test_loop.py, test_break_continue.py, test_return.py)."""
+
+    def test_for_range_tensor_carry(self):
+        @to_static
+        def f(x):
+            acc = x * 0.0
+            for i in range(4):
+                acc = acc + x * float(i)
+            return acc
+
+        got = f(paddle.to_tensor([1.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(got._data), [6.0, 12.0])
+
+    def test_for_range_traced_bound(self):
+        @to_static
+        def f(x, n):
+            acc = x * 0.0
+            for i in range(n):
+                acc = acc + x
+            return acc
+
+        got = f(paddle.to_tensor([2.0]), paddle.to_tensor(3))
+        np.testing.assert_allclose(np.asarray(got._data), [6.0])
+
+    def test_for_range_start_step(self):
+        @to_static
+        def f(x):
+            acc = 0.0 * x
+            for i in range(1, 10, 3):  # 1, 4, 7
+                acc = acc + float(i) * x
+            return acc
+
+        got = f(paddle.to_tensor([1.0]))
+        np.testing.assert_allclose(np.asarray(got._data), [12.0])
+
+    def test_break_in_while(self):
+        @to_static
+        def f(x):
+            i = paddle.to_tensor(0.0)
+            while i < 10.0:
+                if (x + i).sum() > 3.0:
+                    break
+                i = i + 1.0
+            return i
+
+        got = f(paddle.to_tensor([1.0]))
+        np.testing.assert_allclose(np.asarray(got._data), 3.0)
+
+    def test_break_in_for_loop(self):
+        @to_static
+        def f(x):
+            acc = x * 0.0
+            for i in range(10):
+                if i >= 3:
+                    break
+                acc = acc + x
+            return acc
+
+        got = f(paddle.to_tensor([1.0]))
+        np.testing.assert_allclose(np.asarray(got._data), [3.0])
+
+    def test_continue_in_while(self):
+        @to_static
+        def f(x):
+            i = x * 0.0
+            acc = x * 0.0
+            while i.sum() < 5.0:
+                i = i + 1.0
+                if i.sum() % 2.0 == 0.0:
+                    continue
+                acc = acc + i
+            return acc  # 1 + 3 + 5
+
+        got = f(paddle.to_tensor([1.0]))
+        np.testing.assert_allclose(np.asarray(got._data), [9.0])
+
+    def test_continue_in_for(self):
+        @to_static
+        def f(x):
+            acc = x * 0.0
+            for i in range(6):
+                if i % 2 == 1:
+                    continue
+                acc = acc + float(i) * x
+            return acc  # 0 + 2 + 4
+
+        got = f(paddle.to_tensor([1.0]))
+        np.testing.assert_allclose(np.asarray(got._data), [6.0])
+
+    def test_early_return_in_if(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0.0:
+                return x * 2.0
+            return x * 3.0
+
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([1.0]))._data), [2.0])
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([-1.0]))._data), [-3.0])
+
+    def test_return_in_while(self):
+        @to_static
+        def f(x):
+            i = x * 0.0
+            while i.sum() < 100.0:
+                i = i + 1.0
+                if i.sum() >= 4.0:
+                    return i * 10.0
+            return i
+
+        got = f(paddle.to_tensor([1.0]))
+        np.testing.assert_allclose(np.asarray(got._data), [40.0])
+
+    def test_while_else_no_break(self):
+        @to_static
+        def f(x):
+            i = 0
+            while i < 3:
+                i += 1
+            else:
+                x = x + 100.0
+            return x
+
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([1.0]))._data), [101.0])
+
+    def test_for_else_with_break(self):
+        @to_static
+        def f(x, cut):
+            found = x * 0.0
+            for i in range(5):
+                if float(i) == cut:
+                    break
+            else:
+                found = found + 1.0
+            return found
+
+        # break taken → else skipped
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([1.0]), 2.0)._data), [0.0])
+        # loop exhausts → else runs
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([1.0]), 99.0)._data), [1.0])
+
+    def test_traced_everything_under_jit(self):
+        """The whole construct compiles inside one jax.jit trace."""
+        import jax
+
+        @to_static
+        def f(x):
+            acc = x * 0.0
+            for i in range(8):
+                if i >= 5:
+                    break
+                acc = acc + x
+            return acc
+
+        calls = []
+
+        def raw(a):
+            calls.append(1)
+            import paddle_tpu as pd
+
+            return f(pd.Tensor(a))._data
+
+        j = jax.jit(raw)
+        out = j(np.asarray([1.0], np.float32))
+        np.testing.assert_allclose(np.asarray(out), [5.0])
+
+    def test_empty_range_keeps_prebound_target(self):
+        """Python semantics: `for i in range(0)` leaves a pre-existing `i`
+        untouched (review r4: the lowering must not clobber it)."""
+        @to_static
+        def f(x):
+            i = 100.0
+            for i in range(0):
+                x = x + 1.0
+            return x + i
+
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([1.0]))._data), [101.0])
+
+    def test_soft_positions_with_container_output(self):
+        """A tuple-valued user variable sorting before __pd_ret_val must not
+        shift the soft-index mapping (review r4: per-position, not
+        per-leaf)."""
+        @to_static
+        def f(x):
+            Stats = (x * 2.0, x * 3.0)  # noqa: N806 — sorts before "__pd_*"
+            if x.sum() > 0.0:
+                return Stats[0] + Stats[1]
+            Stats = (x, x)
+            return Stats[0]
+
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([1.0]))._data), [5.0])
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([-2.0]))._data), [-2.0])
+
+    def test_sequential_early_returns(self):
+        """Two sequential early-return ifs: the outer guard's outputs must
+        survive inner conversions (regression: stale liveness counts
+        dropped __pd_ret_val assigned inside a nested guard)."""
+        @to_static
+        def f(x):
+            s = x.sum()
+            if s > 10.0:
+                return x * 0.0 + 2.0
+            if s > 0.0:
+                return x * 0.0 + 1.0
+            return x * 0.0
+
+        for v, want in [([5.0, 6.0], 2.0), ([1.0], 1.0), ([-3.0], 0.0)]:
+            got = float(f(paddle.to_tensor(v))._data[0])
+            assert got == want, (v, got, want)
+
+    def test_break_under_traced_if_in_concrete_loop(self):
+        """A concrete-bound loop whose break flag becomes traced mid-loop
+        hands the remaining iterations to lax.while_loop."""
+        @to_static
+        def f(w, x, y):
+            loss = ((w * x - y) ** 2).mean()
+            for _ in range(50):
+                if loss < 0.01:
+                    break
+                g = 2.0 * ((w * x - y) * x).mean()
+                w = w - 0.1 * g
+                loss = ((w * x - y) ** 2).mean()
+            return w
+
+        w = f(paddle.to_tensor([0.0]), paddle.to_tensor([1.0, 2.0]),
+              paddle.to_tensor([2.0, 4.0]))
+        assert abs(float(w._data[0]) - 2.0) < 0.1
