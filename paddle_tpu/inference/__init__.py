@@ -29,7 +29,15 @@ from typing import List, Optional
 import numpy as np
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor",
-           "PaddlePassBuilder"]
+           "PaddlePassBuilder", "save_for_generation", "GenerationPredictor"]
+
+
+def __getattr__(name):
+    if name in ("save_for_generation", "GenerationPredictor"):
+        from . import generation
+
+        return getattr(generation, name)
+    raise AttributeError(name)
 
 _DEFAULT_PASSES = [
     "stablehlo_jit_cache",
@@ -138,6 +146,17 @@ class Predictor:
 
         if not config.model_prefix:
             raise ValueError("Config has no model path")
+        # a .pdmodel prefix may hold either a static-Program export
+        # (static.save_inference_model) or a jit.save Layer artifact —
+        # AnalysisPredictor consumes both (the reference loads any exported
+        # inference program)
+        import json
+
+        with open(config.model_prefix + ".pdmeta") as f:
+            meta = json.load(f)
+        if "n_captures" not in meta:
+            self._init_from_jit_artifact(config, meta)
+            return
         prog, feed_names, fetch_names = load_inference_model(config.model_prefix, None)
         self._prog = prog
         self._feed_names = list(feed_names)
@@ -167,12 +186,50 @@ class Predictor:
                 call, donate_argnums=tuple(
                     range(2, 2 + len(self._feed_names))) if donate else ())
 
+    def _init_from_jit_artifact(self, config: Config, meta: dict):
+        """Load a jit.save (TranslatedLayer) artifact: feed/fetch names are
+        positional (x0.. / out0..); ir_optim routes runs through the
+        layer's exported.call under one jit closure."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..jit import load as jit_load
+
+        layer = jit_load(config.model_prefix)
+        layer.eval()
+        self._prog = None
+        self._layer = layer
+        n_in = len(meta.get("input_shapes", [])) or 1
+        self._feed_names = [f"x{i}" for i in range(n_in)]
+        n_out = meta.get("n_outputs")
+        # older artifacts lack n_outputs: resolved lazily on first run
+        self._fetch_names = ([f"out{i}" for i in range(int(n_out))]
+                             if n_out else None)
+        self._feeds = {}
+        self._outputs = {}
+        self._passes = set(config.pass_builder().all_passes())
+        exported = layer._exported
+        params = {n: p._data for n, p in layer._loaded_params.items()}
+        buffers = {n: b._data for n, b in layer._loaded_buffers.items()}
+        self._jitted = None
+        if "stablehlo_jit_cache" in self._passes:
+            donate = "input_buffer_donation" in self._passes
+
+            def call(params, buffers, key, *feeds):
+                out, _ = exported.call(params, buffers, key, *feeds)
+                return out
+
+            self._jitted = jax.jit(
+                call,
+                donate_argnums=tuple(range(3, 3 + n_in)) if donate else ())
+        self._jit_state = (params, buffers)
+
     # -- reference API --------------------------------------------------
     def get_input_names(self) -> List[str]:
         return list(self._feed_names)
 
     def get_output_names(self) -> List[str]:
-        return list(self._fetch_names)
+        return list(self._fetch_names or [])
 
     def get_input_handle(self, name: str) -> PredictorTensor:
         return PredictorTensor(name, self, True)
@@ -191,7 +248,18 @@ class Predictor:
         missing = [n for n in self._feed_names if n not in self._feeds]
         if missing:
             raise ValueError(f"missing inputs: {missing}")
-        if self._jitted is not None:
+        if self._prog is None:  # jit.save artifact mode
+            params, buffers = self._jit_state
+            feeds = [jnp.asarray(self._feeds[n]) for n in self._feed_names]
+            if self._jitted is not None:
+                outs = self._jitted(params, buffers, jax.random.key(0), *feeds)
+            else:
+                outs, _ = self._layer._exported.call(
+                    params, buffers, jax.random.key(0), *feeds)
+            outs = [np.asarray(o) for o in outs]
+            if self._fetch_names is None:
+                self._fetch_names = [f"out{i}" for i in range(len(outs))]
+        elif self._jitted is not None:
             feeds = [jnp.asarray(self._feeds[n]) for n in self._feed_names]
             outs = self._jitted(self._prog._captures, jax.random.key(0), *feeds)
             outs = [np.asarray(o) for o in outs]

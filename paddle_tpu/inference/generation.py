@@ -1,0 +1,195 @@
+"""KV-cache generation on EXPORTED artifacts.
+
+Parity: the reference serves autoregressive decoding through
+AnalysisPredictor over exported inference programs
+(inference/api/analysis_predictor.h:86, :173 ZeroCopyRun); PaddleNLP's
+FasterGeneration exports decoding loops as fused inference ops. TPU-native
+design: ``save_for_generation`` exports TWO StableHLO programs —
+
+- ``<path>.prefill``: (ids [B, T0]) → (last-token logits, K/V buffers
+  [L, B, H, S, D] written at [0, T0))
+- ``<path>.step``:    (tok [B, 1], pos [1], k, v) → (logits, new k, new v)
+  — one incremental token against the fixed-capacity cache, O(S)
+  attention via dynamic_update_slice at ``pos``
+
+``GenerationPredictor`` drives them exactly like the eager
+``models.generate`` loop, so generations match token-for-token (tested).
+Both artifacts accept jit.save's precision passes, including the int8
+weight-only PTQ artifact form.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["save_for_generation", "GenerationPredictor"]
+
+
+def _attn_layers(model):
+    from ..models.gpt import GPTAttention
+
+    return [m for m in model.sublayers() if isinstance(m, GPTAttention)]
+
+
+class _PrefillModule:
+    """Layer-like wrapper whose forward prefills the fixed-size cache."""
+
+    def __init__(self, model, max_seq_len):
+        self.model = model
+        self.max_seq_len = int(max_seq_len)
+
+    def build_layer(self):
+        from ..nn.layer import Layer
+        from ..ops import creation, manipulation as manip
+
+        model, s = self.model, self.max_seq_len
+        attns = _attn_layers(model)
+        cfg = model.gpt.config
+        heads, hd = cfg.num_attention_heads, cfg.head_dim
+
+        class Prefill(Layer):
+            def __init__(self):
+                super().__init__()
+                self.gpt_model = model  # registers params for export
+
+            def forward(self, ids):
+                b = ids.shape[0]
+                zeros = creation.zeros([b, heads, s, hd], dtype="float32")
+                pos0 = creation.zeros([1], dtype="int32")
+                for a in attns:
+                    a._gen_cache = {"mode": "buffer", "k": zeros, "v": zeros,
+                                    "pos": pos0}
+                try:
+                    logits = model(ids)
+                    ks = manip.stack([a._gen_cache["k"] for a in attns])
+                    vs = manip.stack([a._gen_cache["v"] for a in attns])
+                finally:
+                    for a in attns:
+                        if hasattr(a, "_gen_cache"):
+                            del a._gen_cache
+                return logits[:, -1], ks, vs
+
+        return Prefill()
+
+
+class _StepModule:
+    def __init__(self, model, max_seq_len):
+        self.model = model
+        self.max_seq_len = int(max_seq_len)
+
+    def build_layer(self):
+        from ..nn.layer import Layer
+        from ..ops import creation, manipulation as manip
+
+        model = self.model
+        attns = _attn_layers(model)
+
+        class Step(Layer):
+            def __init__(self):
+                super().__init__()
+                self.gpt_model = model
+
+            def forward(self, tok, pos, k_stack, v_stack):
+                # tok [B, 1]; pos [1] int32; stacks [L, B, H, S, D]
+                for li, a in enumerate(attns):
+                    a._gen_cache = {"mode": "buffer", "k": k_stack[li],
+                                    "v": v_stack[li], "pos": pos}
+                try:
+                    position_ids = manip.expand(
+                        manip.reshape(pos, [1, 1]), [tok.shape[0], 1])
+                    logits = model(tok, position_ids)
+                    ks = manip.stack([a._gen_cache["k"] for a in attns])
+                    vs = manip.stack([a._gen_cache["v"] for a in attns])
+                finally:
+                    for a in attns:
+                        if hasattr(a, "_gen_cache"):
+                            del a._gen_cache
+                return logits[:, -1], ks, vs
+
+        return Step()
+
+
+def save_for_generation(model, path: str, max_seq_len: int, batch_size: int = -1,
+                        prompt_len: int = -1, **save_config):
+    """Export a GPT model's prefill + incremental-decode programs.
+
+    ``max_seq_len``: KV-buffer capacity S (prompt + generated tokens must
+    fit). ``batch_size``/``prompt_len``: -1 = symbolic (any). Extra
+    ``save_config`` (e.g. precision="int8") forwards to jit.save for both
+    artifacts. Learned-position configs only (rope buffer offsets are not
+    wired)."""
+    from ..jit import InputSpec, save as jit_save
+    from ..models.gpt import GPTForPretraining
+
+    if not isinstance(model, GPTForPretraining):
+        raise TypeError("save_for_generation expects a GPTForPretraining")
+    cfg = model.gpt.config
+    L = cfg.num_layers
+    heads, hd = cfg.num_attention_heads, cfg.head_dim
+    was_training = model.training
+    model.eval()
+    try:
+        prefill = _PrefillModule(model, max_seq_len).build_layer()
+        jit_save(prefill, path + ".prefill",
+                 input_spec=[InputSpec([batch_size, prompt_len], "int32")],
+                 **save_config)
+        step = _StepModule(model, max_seq_len).build_layer()
+        jit_save(step, path + ".step", input_spec=[
+            InputSpec([batch_size, 1], "int32"),
+            InputSpec([1], "int32"),
+            InputSpec([L, batch_size, heads, max_seq_len, hd], "float32"),
+            InputSpec([L, batch_size, heads, max_seq_len, hd], "float32"),
+        ], **save_config)
+    finally:
+        if was_training:
+            model.train()
+
+
+class GenerationPredictor:
+    """Predictor-driven incremental decoding over save_for_generation
+    artifacts (greedy; the sampling policies live in models.generate —
+    deployment decoding is deterministic like the reference's inference
+    demos)."""
+
+    def __init__(self, path: str):
+        from ..jit import load as jit_load
+
+        self._prefill = jit_load(path + ".prefill")
+        self._step = jit_load(path + ".step")
+        self._prefill.eval()
+        self._step.eval()
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None) -> np.ndarray:
+        import paddle_tpu as paddle
+
+        ids = np.asarray(
+            input_ids._data if hasattr(input_ids, "_data") else input_ids
+        ).astype(np.int32)
+        b, t0 = ids.shape
+        logits, ks, vs = self._prefill(paddle.to_tensor(ids))
+        capacity = int(ks._data.shape[3])
+        if t0 + int(max_new_tokens) > capacity:
+            raise ValueError(
+                f"prompt ({t0}) + max_new_tokens ({int(max_new_tokens)}) "
+                f"exceeds the exported KV capacity max_seq_len={capacity}; "
+                "re-export with a larger max_seq_len")
+        out = [ids]
+        finished = np.zeros((b,), bool)
+        pos = t0
+        for step in range(int(max_new_tokens)):
+            nxt = np.asarray(logits._data).argmax(-1).astype(np.int32)
+            if eos_token_id is not None:
+                nxt = np.where(finished, eos_token_id, nxt)
+                finished = finished | (nxt == eos_token_id)
+            out.append(nxt[:, None])
+            if eos_token_id is not None and finished.all():
+                break
+            if step == int(max_new_tokens) - 1:
+                break
+            logits, ks, vs = self._step(
+                paddle.to_tensor(nxt[:, None]),
+                paddle.to_tensor(np.asarray([pos], np.int32)), ks, vs)
+            pos += 1
+        return np.concatenate(out, axis=1).astype(np.int64)

@@ -144,7 +144,11 @@ def pd_cond(pred, true_fn, false_fn, args=(), soft=()):
     for i in range(n):
         imp_t, imp_f = i in kinds_t, i in kinds_f
         if imp_t and imp_f:
-            const_out[i] = None if kinds_t[i] == "none" else UNDEFINED
+            # kinds disagreeing (None on one branch, unbound on the other)
+            # keep the loud-on-use sentinel: the runtime branch is unknown,
+            # and silently binding None would mask a use-before-assign
+            const_out[i] = (None if kinds_t[i] == kinds_f[i] == "none"
+                            else UNDEFINED)
             continue
         if imp_t or imp_f:
             if i not in soft:
@@ -283,12 +287,9 @@ def pd_while(cond_fn, body_fn, init, soft=()):
     import jax
     import jax.numpy as jnp
 
-    def improper(v):
-        return v is None or isinstance(v, _Undefined)
-
     init = list(init)
     const_pos = {}
-    bad = [i for i, v in enumerate(init) if improper(v)]
+    bad = [i for i, v in enumerate(init) if _improper(v)]
     if bad:
         if any(i not in soft for i in bad):
             raise ValueError(

@@ -171,6 +171,12 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
             np.savez(f, **blobs)
         with open(path + MODEL_SUFFIX, "wb") as f:
             f.write(blob)
+        # output arity = leaves of the (outs, new_buffers) return's first
+        # child (lets loaders resolve fetch names before the first run)
+        try:
+            n_outputs = exported.out_tree.children()[0].num_leaves
+        except Exception:
+            n_outputs = None
         meta = {
             "params": param_names,
             "buffers": buffer_names,
@@ -178,6 +184,7 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
             "int8_scales": {k: [v[1], v[2]] for k, v in int8_scales.items()},
             "input_shapes": [list(np.asarray(a).shape) for a in arrays],
             "input_dtypes": [str(a.dtype) for a in arrays],
+            "n_outputs": n_outputs,
         }
         with open(path + META_SUFFIX, "w") as f:
             json.dump(meta, f)

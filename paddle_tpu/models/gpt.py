@@ -228,6 +228,49 @@ class GPTAttention(Layer):
         # incremental-decoding KV cache (models/generation.py owns the
         # lifecycle; None = normal training/eval forward)
         cache = getattr(self, "_gen_cache", None)
+        if cache is not None and cache.get("mode") == "buffer":
+            # fixed-capacity export mode (inference.save_for_generation):
+            # K/V live in a [B, H, S, D] buffer written at `pos` via
+            # dynamic_update_slice, so the whole decode step jits with
+            # static shapes and ships as a StableHLO artifact
+            # (AnalysisPredictor KV-cache decoding role)
+            if self.use_rope:
+                raise NotImplementedError(
+                    "buffer-mode KV cache with rope positions is not wired "
+                    "(learned-position GPT configs only)")
+            from ..ops._primitive import primitive
+
+            scale = 1.0 / (self.head_dim ** 0.5)
+
+            @primitive
+            def _buffer_attn(q, k, v, bufk, bufv, pos):
+                import jax
+                import jax.numpy as jnp
+                from jax import lax
+
+                pos = pos.astype(jnp.int32).reshape(())
+                z = jnp.zeros((), jnp.int32)
+                bufk = lax.dynamic_update_slice(
+                    bufk, k.astype(bufk.dtype), (z, z, pos, z))
+                bufv = lax.dynamic_update_slice(
+                    bufv, v.astype(bufv.dtype), (z, z, pos, z))
+                s = bufk.shape[2]
+                tq = q.shape[2]
+                scores = jnp.einsum("bhtd,bhsd->bhts", q, bufk) * scale
+                j = jnp.arange(s)[None, None, None, :]
+                r = jnp.arange(tq)[None, None, :, None]
+                mask = j <= (pos + r)
+                scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
+                probs = jax.nn.softmax(
+                    scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+                out = jnp.einsum("bhts,bhsd->bhtd", probs, bufv)
+                return out, bufk, bufv
+
+            out, new_k, new_v = _buffer_attn(q, k, v, cache["k"], cache["v"],
+                                             cache["pos"])
+            self._gen_cache = {"mode": "buffer", "k": new_k, "v": new_v,
+                               "pos": cache["pos"]}
+            return self._finish(out, b, t)
         if cache is not None:
             offset = cache["k"].shape[2] if cache.get("k") is not None else 0
             if self.use_rope:

@@ -1,0 +1,113 @@
+"""KV-cache generation on exported artifacts + int8 PTQ artifacts through
+the Predictor (VERDICT r3 do#8; reference analysis_predictor.h:86,:173)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import (
+    Config,
+    GenerationPredictor,
+    create_predictor,
+    save_for_generation,
+)
+from paddle_tpu.models import generate
+from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+
+
+def _tiny_model():
+    paddle.seed(0)
+    cfg = gpt_config("gpt2-small", vocab_size=64, hidden_size=32, num_layers=2,
+                     num_attention_heads=4, max_position_embeddings=64,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+def test_exported_generation_matches_eager(tmp_path):
+    """Predictor-driven incremental decoding == eager KV-cache generate,
+    token for token."""
+    m = _tiny_model()
+    prompt = np.random.default_rng(0).integers(0, 64, (2, 5)).astype("int32")
+    want = np.asarray(generate(m, paddle.to_tensor(prompt),
+                               max_new_tokens=8)._data)
+    p = os.path.join(tmp_path, "gpt")
+    save_for_generation(m, p, max_seq_len=32, batch_size=2, prompt_len=5)
+    got = GenerationPredictor(p).generate(prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_exported_generation_eos_and_capacity(tmp_path):
+    m = _tiny_model()
+    prompt = np.random.default_rng(1).integers(0, 64, (1, 4)).astype("int32")
+    p = os.path.join(tmp_path, "gpt")
+    save_for_generation(m, p, max_seq_len=16, batch_size=1, prompt_len=4)
+    pred = GenerationPredictor(p)
+    out = pred.generate(prompt, max_new_tokens=6)
+    assert out.shape == (1, 10)
+    # eos early-stop mirrors eager semantics
+    eager = np.asarray(generate(m, paddle.to_tensor(prompt), max_new_tokens=6,
+                                eos_token_id=int(out[0, 4]))._data)
+    got = pred.generate(prompt, max_new_tokens=6, eos_token_id=int(out[0, 4]))
+    np.testing.assert_array_equal(got, eager)
+
+
+def test_int8_ptq_generation_artifact(tmp_path):
+    """precision='int8' weight-only PTQ artifacts drive the same decode
+    loop end-to-end (quantized weights → dequant at load → generation)."""
+    m = _tiny_model()
+    prompt = np.random.default_rng(2).integers(0, 64, (2, 5)).astype("int32")
+    p = os.path.join(tmp_path, "gpt8")
+    save_for_generation(m, p, max_seq_len=24, batch_size=2, prompt_len=5,
+                        precision="int8")
+    got = GenerationPredictor(p).generate(prompt, max_new_tokens=6)
+    assert got.shape == (2, 11)
+    assert got.dtype == np.int64
+    np.testing.assert_array_equal(got[:, :5], prompt)
+    assert (got >= 0).all() and (got < 64).all()
+    # int8 artifact files exist and carry scales
+    assert os.path.exists(p + ".step.pdiparams")
+    meta_blob = open(p + ".step.pdmeta").read()
+    assert "int8_scales" in meta_blob
+
+
+def test_int8_ptq_predictor_close_to_float(tmp_path):
+    """The plain Predictor accepts an int8 artifact; outputs stay close to
+    the float export (weight-only PTQ error bound)."""
+    from paddle_tpu.jit import InputSpec, save as jit_save
+
+    m = _tiny_model()
+    x = np.random.default_rng(3).integers(0, 64, (2, 6)).astype("int32")
+    pf = os.path.join(tmp_path, "f32")
+    p8 = os.path.join(tmp_path, "i8")
+    jit_save(m, pf, input_spec=[InputSpec([2, 6], "int32")])
+    jit_save(m, p8, input_spec=[InputSpec([2, 6], "int32")], precision="int8")
+    out_f = create_predictor(Config(pf)).run([x])[0]
+    out_8 = create_predictor(Config(p8)).run([x])[0]
+    assert out_f.shape == out_8.shape
+    # per-channel symmetric int8: logits track the float artifact closely
+    denom = np.abs(out_f).mean() + 1e-6
+    assert np.abs(out_f - out_8).mean() / denom < 0.1
+
+
+def test_capacity_overflow_raises(tmp_path):
+    m = _tiny_model()
+    prompt = np.random.default_rng(4).integers(0, 64, (1, 10)).astype("int32")
+    p = os.path.join(tmp_path, "gpt")
+    save_for_generation(m, p, max_seq_len=16, batch_size=1, prompt_len=10)
+    with pytest.raises(ValueError, match="KV capacity"):
+        GenerationPredictor(p).generate(prompt, max_new_tokens=32)
+
+
+def test_jit_artifact_output_names_before_run(tmp_path):
+    """Fetch names resolve at load time (reference pattern: bind output
+    handles before the first ZeroCopyRun)."""
+    from paddle_tpu.jit import InputSpec, save as jit_save
+
+    m = _tiny_model()
+    p = os.path.join(tmp_path, "m")
+    jit_save(m, p, input_spec=[InputSpec([2, 6], "int32")])
+    pred = create_predictor(Config(p))
+    assert pred.get_output_names() == ["out0"]
