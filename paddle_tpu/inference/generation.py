@@ -181,7 +181,9 @@ class GenerationPredictor:
         for step in range(int(max_new_tokens)):
             nxt = np.asarray(logits._data).argmax(-1).astype(np.int32)
             if eos_token_id is not None:
-                nxt = np.where(finished, eos_token_id, nxt)
+                # keep int32: numpy<2 promotes (python int, int32) to int64,
+                # which the exported step's int32 input spec rejects
+                nxt = np.where(finished, eos_token_id, nxt).astype(np.int32)
                 finished = finished | (nxt == eos_token_id)
             out.append(nxt[:, None])
             if eos_token_id is not None and finished.all():

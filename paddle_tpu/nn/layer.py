@@ -624,8 +624,12 @@ def _jit_forward_call(layer, inputs):
 
     amp = amp_state()
     statics = tuple(x if not isinstance(x, Tensor) else None for x in inputs)
+    # which positions are Tensors must be part of the key: a Tensor maps to
+    # None in `statics`, so f(ids, pos_tensor) and f(ids, None) would
+    # otherwise collide on one entry and silently drop/crash the other form
+    tpos_key = tuple(i for i, x in enumerate(inputs) if isinstance(x, Tensor))
     key = (layer.training, bool(amp.enable), getattr(amp, "dtype", None),
-           getattr(amp, "level", None), statics, len(inputs),
+           getattr(amp, "level", None), statics, len(inputs), tpos_key,
            _STRUCTURE_VERSION[0])  # stale closures die on structure change
     cache = layer.__dict__.setdefault("_eager_jit_cache", {})
     entry = cache.get(key)

@@ -18,7 +18,8 @@ import numpy as np
 
 from ..io.dataset import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]
 
 
 def _read_idx_images(path):
@@ -156,3 +157,208 @@ class FakeData(Dataset):
 
     def __len__(self):
         return self.size
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+def _pil_loader(path):
+    from PIL import Image
+
+    with open(path, "rb") as f:
+        return Image.open(f).convert("RGB")
+
+
+class DatasetFolder(Dataset):
+    """Class-per-subdirectory image dataset (parity:
+    python/paddle/vision/datasets/folder.py:65 DatasetFolder): classes are
+    the sorted subdirectory names of ``root``; samples are (image, class
+    index)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _pil_loader
+        extensions = extensions or (IMG_EXTENSIONS
+                                    if is_valid_file is None else None)
+        classes = sorted(d.name for d in os.scandir(root) if d.is_dir())
+        if not classes:
+            raise RuntimeError(f"no class folders found in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        if is_valid_file is None:
+            def is_valid_file(p):  # noqa: A001
+                return p.lower().endswith(tuple(extensions))
+        samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir, followlinks=True)):
+                for fname in sorted(files):
+                    p = os.path.join(dirpath, fname)
+                    if is_valid_file(p):
+                        samples.append((p, self.class_to_idx[c]))
+        if not samples:
+            raise RuntimeError(f"found 0 files in subfolders of {root}")
+        self.samples = samples
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Unlabeled image collection (parity: folder.py:222 ImageFolder):
+    every image under ``root`` (recursively), samples are [image]."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _pil_loader
+        extensions = extensions or (IMG_EXTENSIONS
+                                    if is_valid_file is None else None)
+        if is_valid_file is None:
+            def is_valid_file(p):  # noqa: A001
+                return p.lower().endswith(tuple(extensions))
+        samples = []
+        for dirpath, _, files in sorted(os.walk(root, followlinks=True)):
+            for fname in sorted(files):
+                p = os.path.join(dirpath, fname)
+                if is_valid_file(p):
+                    samples.append(p)
+        if not samples:
+            raise RuntimeError(f"found 0 files in {root}")
+        self.samples = samples
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (parity: vision/datasets/flowers.py:43): images
+    from the ``102flowers`` archive/dir, labels + train/valid/test splits
+    from the ``imagelabels.mat`` / ``setid.mat`` files. No auto-download
+    (zero-egress build): pass local ``data_file``/``label_file``/
+    ``setid_file``."""
+
+    _FLAGS = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file, label_file, setid_file, mode="train",
+                 transform=None, backend="cv2"):
+        import tarfile
+
+        import scipy.io as scio
+
+        assert mode in ("train", "valid", "test")
+        self.transform = transform
+        self.backend = backend
+        if os.path.isdir(data_file):
+            self.data_path = data_file
+        else:
+            stem = (data_file[:-len(".tgz")] if data_file.endswith(".tgz")
+                    else data_file)
+            self.data_path = stem + "/"
+            # extract atomically (tmp dir + rename): a half-finished
+            # extraction must not satisfy the exists() check forever
+            if not os.path.isdir(os.path.join(self.data_path, "jpg")):
+                tmp = stem + ".extracting"
+                if os.path.isdir(tmp):
+                    import shutil
+
+                    shutil.rmtree(tmp)
+                os.makedirs(tmp)
+                with tarfile.open(data_file) as t:
+                    t.extractall(tmp)
+                dst = self.data_path.rstrip("/")
+                if os.path.isdir(dst):  # stale partial extraction
+                    import shutil
+
+                    shutil.rmtree(dst)
+                os.replace(tmp, dst)
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[self._FLAGS[mode]][0]
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        index = int(self.indexes[idx])
+        label = np.array([self.labels[index - 1]], np.int64)
+        path = os.path.join(self.data_path, "jpg", "image_%05d.jpg" % index)
+        img = Image.open(path)
+        if self.backend != "pil":
+            img = np.array(img)
+        if self.transform is not None:
+            img = self.transform(img)
+        if self.backend == "pil":
+            return img, label
+        return np.asarray(img, np.float32), label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (parity:
+    vision/datasets/voc2012.py:40): (image, class-index mask) read from the
+    VOCtrainval tar (or an extracted VOCdevkit dir), split per
+    ImageSets/Segmentation/{trainval,train,val}.txt. No auto-download."""
+
+    _SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    _DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    _LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+    _MODE_FLAG = {"train": "trainval", "test": "train", "valid": "val"}
+
+    def __init__(self, data_file, mode="train", transform=None):
+        import tarfile
+
+        assert mode in ("train", "valid", "test")
+        self.transform = transform
+        self._tar = None
+        if os.path.isdir(data_file):
+            self._root = data_file
+            read = self._read_fs
+        else:
+            self._tar = tarfile.open(data_file)
+            self._members = {m.name: m for m in self._tar.getmembers()}
+            read = self._read_tar
+        self._read = read
+        names = read(self._SET_FILE.format(self._MODE_FLAG[mode])).decode()
+        self.ids = [ln.strip() for ln in names.splitlines() if ln.strip()]
+
+    def _read_fs(self, rel):
+        with open(os.path.join(self._root, rel), "rb") as f:
+            return f.read()
+
+    def _read_tar(self, rel):
+        return self._tar.extractfile(self._members[rel]).read()
+
+    def __getitem__(self, idx):
+        import io
+
+        from PIL import Image
+
+        name = self.ids[idx]
+        img = Image.open(io.BytesIO(self._read(self._DATA_FILE.format(name))))
+        lbl = Image.open(io.BytesIO(self._read(self._LABEL_FILE.format(name))))
+        img = np.asarray(img.convert("RGB"), np.float32)
+        lbl = np.asarray(lbl, np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl
+
+    def __len__(self):
+        return len(self.ids)

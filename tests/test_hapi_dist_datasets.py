@@ -1,0 +1,185 @@
+"""hapi Model.fit under the launcher (2-proc CPU) + the dataset tail
+(VERDICT r3 do#9; reference python/paddle/tests/dist_hapi_mnist_dynamic.py,
+vision/datasets/{folder,flowers,voc2012}.py)."""
+import io
+import json
+import os
+import subprocess
+import sys
+import tarfile
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DIST_HAPI_RUNNER = textwrap.dedent("""
+    import json, os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.env import ParallelEnv
+    from paddle_tpu.hapi.model import Model
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.sampler import DistributedBatchSampler
+    from paddle_tpu.optimizer.optimizers import Adam
+    from paddle_tpu.vision.datasets import FakeData
+
+    out_dir = sys.argv[1]
+    env = ParallelEnv()
+    paddle.seed(0)
+
+    ds = FakeData(size=32, image_shape=(8,), num_classes=4, seed=7)
+    sampler = DistributedBatchSampler(ds, batch_size=4,
+                                      num_replicas=env.world_size,
+                                      rank=env.rank, shuffle=False)
+    seen = [i for batch in sampler for i in batch]
+    loader = DataLoader(ds, batch_sampler=sampler)
+
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model = Model(net)
+    model.prepare(Adam(learning_rate=0.01, parameters=net.parameters()),
+                  loss=lambda out, y: nn.functional.cross_entropy(out, y))
+    hist = model.fit(loader, epochs=2, verbose=0)
+    evals = model.evaluate(loader, verbose=0)
+    with open(os.path.join(out_dir, f"rank{env.rank}.json"), "w") as f:
+        json.dump({"rank": env.rank, "world": env.world_size,
+                   "indices": seen, "loss": evals["loss"]}, f)
+""")
+
+
+def _launch(script, nproc, args=(), timeout=240):
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", str(nproc), str(script), *args]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def test_dist_hapi_fit_under_launcher(tmp_path):
+    """Model.fit runs under 2 launched processes; DistributedBatchSampler
+    shards the dataset disjointly and both ranks train + evaluate."""
+    script = tmp_path / "runner.py"
+    script.write_text(DIST_HAPI_RUNNER)
+    out = tmp_path / "out"
+    out.mkdir()
+    r = _launch(script, 2, args=(str(out),))
+    assert r.returncode == 0, r.stderr[-3000:]
+    recs = []
+    for rank in (0, 1):
+        with open(out / f"rank{rank}.json") as f:
+            recs.append(json.load(f))
+    assert recs[0]["world"] == recs[1]["world"] == 2
+    s0, s1 = set(recs[0]["indices"]), set(recs[1]["indices"])
+    assert not (s0 & s1), "ranks must see disjoint shards"
+    assert len(s0) + len(s1) == 32
+    for rec in recs:
+        assert np.isfinite(rec["loss"][0] if isinstance(rec["loss"], list)
+                           else rec["loss"])
+
+
+# ---------------------------------------------------------------------------
+# dataset tail
+# ---------------------------------------------------------------------------
+
+def _write_jpg(path, color, size=(8, 8)):
+    from PIL import Image
+
+    Image.new("RGB", size, color).save(path)
+
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    for ci, cls in enumerate(["cats", "dogs"]):
+        d = tmp_path / "root" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            _write_jpg(d / f"{i}.jpg", (ci * 100, 0, 0))
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+    ds = DatasetFolder(str(tmp_path / "root"))
+    assert ds.classes == ["cats", "dogs"]
+    assert len(ds) == 6
+    img, target = ds[0]
+    assert target == 0 and np.asarray(img).shape == (8, 8, 3)
+    img, target = ds[5]
+    assert target == 1
+
+    flat = ImageFolder(str(tmp_path / "root"))
+    assert len(flat) == 6
+    (img,) = flat[2]
+    assert np.asarray(img).shape == (8, 8, 3)
+
+    # transform applies
+    ds2 = DatasetFolder(str(tmp_path / "root"),
+                        transform=lambda im: np.asarray(im, np.float32) / 255)
+    img, _ = ds2[0]
+    assert img.dtype == np.float32 and img.max() <= 1.0
+
+
+def test_flowers_dataset(tmp_path):
+    import scipy.io as scio
+
+    jpg = tmp_path / "flowers" / "jpg"
+    jpg.mkdir(parents=True)
+    for i in range(1, 7):
+        _write_jpg(jpg / ("image_%05d.jpg" % i), (i * 20, 0, 0))
+    labels = np.arange(1, 7)[None]  # 1-based class labels
+    scio.savemat(tmp_path / "imagelabels.mat", {"labels": labels})
+    scio.savemat(tmp_path / "setid.mat", {
+        "trnid": np.asarray([[1, 2, 3, 4]]),
+        "valid": np.asarray([[5]]),
+        "tstid": np.asarray([[6]]),
+    })
+    from paddle_tpu.vision.datasets import Flowers
+
+    tr = Flowers(str(tmp_path / "flowers"), str(tmp_path / "imagelabels.mat"),
+                 str(tmp_path / "setid.mat"), mode="train")
+    assert len(tr) == 4
+    img, lbl = tr[1]
+    assert img.shape == (8, 8, 3) and lbl.tolist() == [2]
+    va = Flowers(str(tmp_path / "flowers"), str(tmp_path / "imagelabels.mat"),
+                 str(tmp_path / "setid.mat"), mode="valid")
+    assert len(va) == 1 and va[0][1].tolist() == [5]
+
+
+def test_voc2012_dataset_from_tar(tmp_path):
+    from PIL import Image
+
+    base = "VOCdevkit/VOC2012"
+    names = ["2007_000001", "2007_000002"]
+    tar_path = tmp_path / "voc.tar"
+    with tarfile.open(tar_path, "w") as t:
+        def add(rel, data):
+            info = tarfile.TarInfo(rel)
+            info.size = len(data)
+            t.addfile(info, io.BytesIO(data))
+
+        add(f"{base}/ImageSets/Segmentation/trainval.txt",
+            ("\n".join(names) + "\n").encode())
+        add(f"{base}/ImageSets/Segmentation/train.txt",
+            (names[0] + "\n").encode())
+        add(f"{base}/ImageSets/Segmentation/val.txt",
+            (names[1] + "\n").encode())
+        for i, n in enumerate(names):
+            buf = io.BytesIO()
+            Image.new("RGB", (6, 4), (i * 50, 0, 0)).save(buf, format="JPEG")
+            add(f"{base}/JPEGImages/{n}.jpg", buf.getvalue())
+            buf = io.BytesIO()
+            Image.fromarray(np.full((4, 6), i, np.uint8), "L").save(
+                buf, format="PNG")
+            add(f"{base}/SegmentationClass/{n}.png", buf.getvalue())
+
+    from paddle_tpu.vision.datasets import VOC2012
+
+    ds = VOC2012(str(tar_path), mode="train")
+    assert len(ds) == 2
+    img, lbl = ds[1]
+    assert img.shape == (4, 6, 3) and lbl.shape == (4, 6)
+    assert (lbl == 1).all()
+    va = VOC2012(str(tar_path), mode="valid")
+    assert len(va) == 1

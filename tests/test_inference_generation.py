@@ -111,3 +111,29 @@ def test_jit_artifact_output_names_before_run(tmp_path):
     jit_save(m, p, input_spec=[InputSpec([2, 6], "int32")])
     pred = create_predictor(Config(p))
     assert pred.get_output_names() == ["out0"]
+
+
+def test_export_then_eager_generate_with_layer_jit(tmp_path):
+    """Regression (review r4): the Step export calls layers with a
+    position_ids TENSOR while eager prefill passes None — the layer-jit
+    cache key must distinguish the two (a Tensor used to hash as None,
+    poisoning the cache: later eager generates crashed or emitted wrong
+    tokens on the TPU platform)."""
+    from paddle_tpu.framework.flags import set_flags
+
+    m = _tiny_model()
+    prompt = np.random.default_rng(5).integers(0, 64, (2, 5)).astype("int32")
+    set_flags({"FLAGS_eager_layer_jit": "force"})
+    try:
+        want = np.asarray(generate(m, paddle.to_tensor(prompt),
+                                   max_new_tokens=6)._data)
+        p = os.path.join(tmp_path, "gpt")
+        save_for_generation(m, p, max_seq_len=24, batch_size=2, prompt_len=5)
+        # eager generation AFTER export must still match (cache not poisoned)
+        again = np.asarray(generate(m, paddle.to_tensor(prompt),
+                                    max_new_tokens=6)._data)
+        np.testing.assert_array_equal(again, want)
+        got = GenerationPredictor(p).generate(prompt, max_new_tokens=6)
+        np.testing.assert_array_equal(got, want)
+    finally:
+        set_flags({"FLAGS_eager_layer_jit": "true"})
