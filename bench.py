@@ -235,6 +235,10 @@ def main():
             tp = _pipeline_tput("gpt3-350m", 8, seq)
             secondary["pipeline_step_tokens_per_sec"] = round(tp, 2)
             if isinstance(secondary.get("gpt3_350m_tokens_per_sec_chip"), float):
+                # ratio (pipeline/plain, target >= 0.90 per VERDICT r3 #7;
+                # pp=1 runs the schedule-free specialized path)
+                secondary["pipeline_step_ratio"] = round(
+                    tp / secondary["gpt3_350m_tokens_per_sec_chip"], 4)
                 secondary["pipeline_step_overhead"] = round(
                     secondary["gpt3_350m_tokens_per_sec_chip"] / tp - 1, 4)
         except Exception as e:  # pragma: no cover - device dependent

@@ -97,6 +97,45 @@ def top_k_gating(logits, k: int, capacity: int, num_experts: int):
     return combine, dispatch, l_aux
 
 
+def top_k_gating_compact(logits, k: int, capacity: int, num_experts: int):
+    """top_k_gating without the [g, e, c] one-hot tensors: returns per-token
+    (expert id, capacity slot, normalized gate, kept?) pairs plus l_aux.
+    Same assignment policy as top_k_gating (GShard cumsum capacity); the
+    caller dispatches by scatter/gather instead of einsum one-hots — O(g·e)
+    memory instead of O(g·e·c), which keeps large-expert-count compiles
+    tractable."""
+    gates = jax.nn.softmax(logits, axis=-1)  # [g, e]
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1_raw = jax.nn.one_hot(idx1, num_experts, dtype=logits.dtype)
+    density = jnp.mean(mask1_raw, axis=0)
+    density_proxy = jnp.mean(gates, axis=0)
+    l_aux = jnp.sum(density * density_proxy) * num_experts
+
+    locations1 = jnp.cumsum(mask1_raw, axis=0) - mask1_raw
+    mask1 = mask1_raw * (locations1 < capacity)
+    pos1 = jnp.sum(locations1 * mask1, axis=-1).astype(jnp.int32)
+    keep1 = jnp.sum(mask1, axis=-1) > 0
+    gate1 = jnp.sum(gates * mask1, axis=-1)
+
+    if k == 1:
+        return ((idx1.astype(jnp.int32), pos1, gate1, keep1),
+                None, l_aux)
+
+    logits2 = jnp.where(mask1_raw > 0, -jnp.inf, logits)
+    idx2 = jnp.argmax(logits2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, num_experts, dtype=logits.dtype)
+    locations2 = (jnp.cumsum(mask2, axis=0) - mask2
+                  + jnp.sum(mask1, axis=0, keepdims=True))
+    mask2 = mask2 * (locations2 < capacity)
+    pos2 = jnp.sum(locations2 * mask2, axis=-1).astype(jnp.int32)
+    keep2 = jnp.sum(mask2, axis=-1) > 0
+    gate2 = jnp.sum(gates * mask2, axis=-1)
+
+    denom = jnp.maximum(gate1 + gate2, jnp.finfo(gates.dtype).eps)
+    return ((idx1.astype(jnp.int32), pos1, gate1 / denom, keep1),
+            (idx2.astype(jnp.int32), pos2, gate2 / denom, keep2), l_aux)
+
+
 def _stacked_ffn(xin, w1, b1, w2, b2, act):
     """Batched expert FFN: xin [e, c, m] with stacked weights [e, m, h]/[e, h, m]."""
     h = jnp.einsum("ecm,emh->ech", xin, w1) + b1[:, None, :]
@@ -193,8 +232,22 @@ class MoELayer(Layer):
         def _moe(x, gate_w, w1, b1, w2, b2):
             g = x.reshape(-1, x.shape[-1])  # [tokens, m]
             logits = g @ gate_w
-            combine, dispatch, l_aux = top_k_gating(logits, k, cap, e)
-            xin = jnp.einsum("gec,gm->ecm", dispatch.astype(g.dtype), g)  # [e, c, m]
+            picks1, picks2, l_aux = top_k_gating_compact(logits, k, cap, e)
+            # scatter/gather dispatch: slot (expert, pos) ← token row; no
+            # [g, e, c] one-hot (compile-heavy at large expert counts)
+            gt = jnp.arange(g.shape[0], dtype=jnp.int32)
+            slot_src = jnp.full((e * cap,), g.shape[0], jnp.int32)
+            for p in (picks1, picks2):
+                if p is None:
+                    continue
+                eid, pos, _gt, keepm = p
+                flat_slot = eid * cap + pos
+                slot_src = slot_src.at[
+                    jnp.where(keepm, flat_slot, e * cap)
+                ].set(gt, mode="drop")
+            g_pad = jnp.concatenate(
+                [g, jnp.zeros((1, g.shape[-1]), g.dtype)], axis=0)
+            xin = jnp.take(g_pad, slot_src, axis=0).reshape(e, cap, -1)
             if bound:
                 # dispatch: send each rank its experts' rows
                 n = lax.axis_size(ep_axis)
@@ -211,7 +264,15 @@ class MoELayer(Layer):
                 out = out.reshape(e, cap, -1)
             else:
                 out = _stacked_ffn(xin, w1, b1, w2, b2, act)
-            y = jnp.einsum("gec,ecm->gm", combine.astype(g.dtype), out)
+            out_flat = out.reshape(e * cap, -1)
+            y = jnp.zeros_like(g)
+            for p in (picks1, picks2):
+                if p is None:
+                    continue
+                eid, pos, gate_n, keepm = p
+                rows = jnp.take(out_flat, eid * cap + pos, axis=0)
+                y = y + jnp.where(keepm[:, None],
+                                  gate_n[:, None].astype(g.dtype) * rows, 0.0)
             return y.reshape(x.shape), l_aux
 
         out, l_aux = _moe(x, self.gate_weight, self.experts.w1, self.experts.b1,

@@ -477,6 +477,16 @@ class PipelineModule:
         if key is None:
             key = jax.random.key(0)
 
+        if n == 1 and v == 1:
+            # degenerate pipeline: the ring permute is the identity and
+            # every tick is a whole microbatch — skip the schedule machinery
+            # entirely (the reference pays its schedule cost only when
+            # pp > 1, section_worker.cc:62) and run straight
+            # microbatch-accumulation with statically-indexed layers so XLA
+            # optimizes across layers like the plain step
+            return self._pp1_loss(local_stage, shared, x_mb, y_mb, key,
+                                  use_rng)
+
         def stage_fn(h, c, mb_key):
             return self._stage_apply(local_stage, c, s_idx, h, mb_key)
 
@@ -525,6 +535,62 @@ class PipelineModule:
             total = total + self._aux_weight * aux_acc / m
         rep = lax.psum(total, PP_AXIS)
         return total + lax.stop_gradient(rep - total)
+
+    def _pp1_loss(self, local_stage, shared, x_mb, y_mb, key, use_rng):
+        """pp=1, v=1 specialization: plain microbatch accumulation with
+        statically-indexed layers — no ppermute, no tick scan, no dynamic
+        weight slicing, no per-tick guards. PRNG folding matches the
+        scheduled path exactly (per-(microbatch, layer) keys), so dropout
+        masks are identical to a pp>1 run of the same program."""
+        kv = self.layers_per_chunk
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if self._remat_policy == "selective" else None)
+
+        def run_layer(tmpl, lp, h, lk, prefix=""):
+            def _one(lp, h, lk):
+                if self._stage3:
+                    lp = self._s3_gather(lp, prefix)
+                saved = get_rng_state()
+                set_rng_state(lk)
+                try:
+                    out, aux = self._apply_slot(tmpl, lp, h)
+                finally:
+                    set_rng_state(saved)
+                return out, aux
+
+            if self._remat_policy == "none":
+                return _one(lp, h, lk)
+            return jax.checkpoint(_one, policy=policy)(lp, h, lk)
+
+        total = jnp.zeros((), jnp.float32)
+        aux_acc = jnp.zeros((), jnp.float32)
+        for j in range(self.microbatches):
+            mb_key = jax.random.fold_in(key, j)
+            inj_key = jax.random.fold_in(mb_key, _EMBED_FOLD)
+            h = self._inject(shared, x_mb[j], inj_key if use_rng else None)
+            if self._scan_body:
+                tmpl = self.slot_templates[0]
+                for i in range(kv):
+                    lp = jax.tree_util.tree_map(lambda a, i=i: a[i],
+                                                local_stage)
+                    h, aux = run_layer(tmpl, lp, h,
+                                       jax.random.fold_in(mb_key, i))
+                    aux_acc = aux_acc + aux
+            else:
+                for i, tmpl in enumerate(self.slot_templates):
+                    prefix = f"slot{i}."
+                    lp = {nm[len(prefix):]: arr[0]
+                          for nm, arr in local_stage.items()
+                          if nm.startswith(prefix)}
+                    h, aux = run_layer(tmpl, lp, h,
+                                       jax.random.fold_in(mb_key, i),
+                                       prefix=prefix)
+                    aux_acc = aux_acc + aux
+            total = total + self._head_loss(shared, h, y_mb[j])
+        total = total / self.microbatches
+        if self._aux_weight:
+            total = total + self._aux_weight * aux_acc / self.microbatches
+        return total
 
     def _has_dropout(self) -> bool:
         return False

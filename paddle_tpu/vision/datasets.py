@@ -255,7 +255,10 @@ class Flowers(Dataset):
     (zero-egress build): pass local ``data_file``/``label_file``/
     ``setid_file``."""
 
-    _FLAGS = {"train": "trnid", "valid": "valid", "test": "tstid"}
+    # the reference deliberately SWAPS the official splits (flowers.py:37:
+    # "test data is more than train data. So we exchange the train data and
+    # test data") — keep that behavior for parity
+    _FLAGS = {"train": "tstid", "valid": "valid", "test": "trnid"}
 
     def __init__(self, data_file, label_file, setid_file, mode="train",
                  transform=None, backend="cv2"):
@@ -272,23 +275,28 @@ class Flowers(Dataset):
             stem = (data_file[:-len(".tgz")] if data_file.endswith(".tgz")
                     else data_file)
             self.data_path = stem + "/"
-            # extract atomically (tmp dir + rename): a half-finished
-            # extraction must not satisfy the exists() check forever
+            # crash-safe extraction: a half-finished extraction must not
+            # satisfy the exists() check forever. A per-pid tmp dir plus an
+            # exclusive rename also makes concurrent constructors (launcher
+            # ranks) safe: whoever renames first wins, the rest discard.
             if not os.path.isdir(os.path.join(self.data_path, "jpg")):
-                tmp = stem + ".extracting"
-                if os.path.isdir(tmp):
-                    import shutil
+                import shutil
 
-                    shutil.rmtree(tmp)
+                tmp = f"{stem}.extracting.{os.getpid()}"
                 os.makedirs(tmp)
-                with tarfile.open(data_file) as t:
-                    t.extractall(tmp)
-                dst = self.data_path.rstrip("/")
-                if os.path.isdir(dst):  # stale partial extraction
-                    import shutil
-
-                    shutil.rmtree(dst)
-                os.replace(tmp, dst)
+                try:
+                    with tarfile.open(data_file) as t:
+                        t.extractall(tmp)
+                    dst = self.data_path.rstrip("/")
+                    try:
+                        os.replace(tmp, dst)
+                    except OSError:
+                        # another process completed first; use its copy
+                        if not os.path.isdir(os.path.join(dst, "jpg")):
+                            raise
+                finally:
+                    if os.path.isdir(tmp):
+                        shutil.rmtree(tmp, ignore_errors=True)
         self.labels = scio.loadmat(label_file)["labels"][0]
         self.indexes = scio.loadmat(setid_file)[self._FLAGS[mode]][0]
 
@@ -328,12 +336,12 @@ class VOC2012(Dataset):
         assert mode in ("train", "valid", "test")
         self.transform = transform
         self._tar = None
+        self._tar_pid = None
+        self._data_file = data_file
         if os.path.isdir(data_file):
             self._root = data_file
             read = self._read_fs
         else:
-            self._tar = tarfile.open(data_file)
-            self._members = {m.name: m for m in self._tar.getmembers()}
             read = self._read_tar
         self._read = read
         names = read(self._SET_FILE.format(self._MODE_FLAG[mode])).decode()
@@ -344,7 +352,15 @@ class VOC2012(Dataset):
             return f.read()
 
     def _read_tar(self, rel):
-        return self._tar.extractfile(self._members[rel]).read()
+        import tarfile
+
+        # per-pid handle: forked DataLoader workers must not share one file
+        # offset (concurrent reads would interleave seeks → corrupt bytes)
+        pid = os.getpid()
+        if self._tar is None or self._tar_pid != pid:
+            self._tar = tarfile.open(self._data_file)
+            self._tar_pid = pid
+        return self._tar.extractfile(rel).read()
 
     def __getitem__(self, idx):
         import io

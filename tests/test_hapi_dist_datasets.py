@@ -137,10 +137,15 @@ def test_flowers_dataset(tmp_path):
     })
     from paddle_tpu.vision.datasets import Flowers
 
+    # parity quirk (flowers.py:37): the reference SWAPS trnid/tstid — the
+    # 'train' mode reads tstid and 'test' reads trnid
     tr = Flowers(str(tmp_path / "flowers"), str(tmp_path / "imagelabels.mat"),
                  str(tmp_path / "setid.mat"), mode="train")
-    assert len(tr) == 4
-    img, lbl = tr[1]
+    assert len(tr) == 1 and tr[0][1].tolist() == [6]
+    te = Flowers(str(tmp_path / "flowers"), str(tmp_path / "imagelabels.mat"),
+                 str(tmp_path / "setid.mat"), mode="test")
+    assert len(te) == 4
+    img, lbl = te[1]
     assert img.shape == (8, 8, 3) and lbl.tolist() == [2]
     va = Flowers(str(tmp_path / "flowers"), str(tmp_path / "imagelabels.mat"),
                  str(tmp_path / "setid.mat"), mode="valid")
