@@ -51,24 +51,36 @@ def resnet50_static(batch=128):
             exe = static.Executor()
             exe.run(startup)
             rng = np.random.default_rng(0)
-            xv = rng.standard_normal((batch, 3, 224, 224)).astype("float32")
-            yv = rng.integers(0, 1000, (batch,)).astype("int64")
+            # pre-uploaded feeds (what the direct path measures too): the
+            # tunnel's H2D bandwidth would otherwise dominate the step
+            xv = paddle.to_tensor(
+                rng.standard_normal((batch, 3, 224, 224)).astype("float32"))
+            yv = paddle.to_tensor(
+                rng.integers(0, 1000, (batch,)).astype("int64"))
             for _ in range(2):
                 (lv,) = exe.run(main, feed={"x": xv, "y": yv},
                                 fetch_list=[loss])
             float(np.asarray(lv))
-            times = []
-            for _ in range(5):
-                t0 = time.perf_counter()
+            # return_numpy=True forces a device sync per exe.run (a tunnel
+            # round-trip here; ~0.1 ms on a host-local chip). Measure both:
+            # the API-faithful per-step-sync form and the lazy-fetch form
+            # (return_numpy=False) that syncs once per rep like the direct
+            # ParallelTrainer loop.
+            for tag, rnumpy in (("sync-fetch", True), ("lazy-fetch", False)):
+                times = []
                 for _ in range(5):
-                    (lv,) = exe.run(main, feed={"x": xv, "y": yv},
-                                    fetch_list=[loss])
-                float(np.asarray(lv))
-                times.append(time.perf_counter() - t0)
-            med = sorted(times)[len(times) // 2]
-            log({"experiment": f"resnet50 b{batch} STATIC executor",
-                 "images_s": round(batch * 5 / med, 1),
-                 "times": [round(t, 3) for t in times]})
+                    t0 = time.perf_counter()
+                    for _ in range(5):
+                        (lv,) = exe.run(main, feed={"x": xv, "y": yv},
+                                        fetch_list=[loss],
+                                        return_numpy=rnumpy)
+                    float(np.asarray(lv._data if hasattr(lv, "_data") else lv))
+                    times.append(time.perf_counter() - t0)
+                med = sorted(times)[len(times) // 2]
+                log({"experiment":
+                     f"resnet50 b{batch} STATIC executor {tag}",
+                     "images_s": round(batch * 5 / med, 1),
+                     "times": [round(t, 3) for t in times]})
         finally:
             paddle.disable_static()
     except Exception as e:  # noqa: BLE001

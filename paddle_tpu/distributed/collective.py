@@ -13,6 +13,20 @@ TPU-native dual mode per op:
 - **eager, single process**: world_size==1 → identity (same as the reference
   when nranks==1); world>1 eager is routed through a jitted shard_map over
   the global mesh when the tensor is sharded over the group axis.
+
+EAGER SEMANTICS FOR UNSHARDED TENSORS (world > 1) — read this before
+porting reference eager-collective code: with one controller process there
+is exactly one copy of an unsharded tensor, so "each rank's tensor"
+degenerates to the replicated-eager model (every virtual rank holds the
+SAME value). Ops whose replicated closed form is exact run it:
+all_reduce(x) = world * x for SUM (each rank contributed the same x),
+all_gather = tile, broadcast = identity. Ops whose outputs would be
+rank-divergent (reduce_scatter slices, scatter, alltoall) CANNOT exist in
+this model and raise a teachable RuntimeError directing you to
+shard_map/run_on_mesh, where each shard genuinely is a rank. This differs
+from the reference's c_allreduce on a multi-process launch, where ranks
+hold independent values — that situation is expressed here by sharding
+the tensor over the group axis (then the op lowers to the XLA collective).
 """
 from __future__ import annotations
 
