@@ -433,11 +433,18 @@ def _dense_step_reference(pipe, x, y, lr):
         total = 0.0
         for j in range(m):
             h = pipe._embed(shared, x_mb[j])
-            flat = jax.tree_util.tree_map(
-                lambda a: a.reshape((n_layers,) + a.shape[2:]), stages)
-            for l in range(n_layers):
-                lp = jax.tree_util.tree_map(lambda a: a[l], flat)
-                h = pipe._apply_block(lp, h)
+            if pipe._unstacked_pp1:
+                for l in range(n_layers):
+                    prefix = f"L{l}."
+                    lp = {n[len(prefix):]: a for n, a in stages.items()
+                          if n.startswith(prefix)}
+                    h = pipe._apply_block(lp, h)
+            else:
+                flat = jax.tree_util.tree_map(
+                    lambda a: a.reshape((n_layers,) + a.shape[2:]), stages)
+                for l in range(n_layers):
+                    lp = jax.tree_util.tree_map(lambda a: a[l], flat)
+                    h = pipe._apply_block(lp, h)
             total = total + pipe._head_loss(shared, h, y_mb[j])
         return total / m
 
