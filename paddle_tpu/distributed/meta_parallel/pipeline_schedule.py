@@ -216,8 +216,9 @@ class PipelineModule:
         # one big buffer, which costs ~25% step time vs the plain layout on
         # v5e (XLA layouts/prefetch). sharding_stage=3 keeps the stacked
         # form (its flat-slice machinery needs the row dim).
+        mesh_pp = int(mesh.shape.get(PP_AXIS, 1)) if mesh is not None else 1
         unstack_ok = (num_stages == 1 and self.num_virtual == 1
-                      and int(sharding_stage) < 3)
+                      and mesh_pp == 1 and int(sharding_stage) < 3)
         self._unstacked_pp1 = bool(self._scan_body and unstack_ok)
         if self._scan_body and unstack_ok:
             bspec = spec_of_block(self.slot_templates[0])

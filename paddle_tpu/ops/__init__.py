@@ -10,9 +10,20 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..tensor import Tensor
-from . import creation, linalg, logic, manipulation, math, random_ops, search, sequence
+from . import (
+    creation,
+    linalg,
+    logic,
+    manipulation,
+    math,
+    misc_catalog,
+    random_ops,
+    search,
+    sequence,
+)
 from ._primitive import inplace_guard, primitive, unwrap, wrap
 from .creation import *  # noqa: F401,F403
+from .misc_catalog import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403 — note: no __all__, exports by name below

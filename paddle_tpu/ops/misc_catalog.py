@@ -1,0 +1,266 @@
+"""Catalog tail ops — the miscellaneous reference operators that belong to
+no big family (SURVEY App. A "PS/rec-sys special" generic rows + text
+positional encoding).
+
+Parity: add_position_encoding_op.h, sampling_id_op.h,
+squared_l2_distance_op.h, squared_l2_norm_op.h, center_loss_op.h,
+bpr_loss_op.h, fsp_op.h (flow-of-solution-procedure distillation),
+cos_sim_op.h, affine_channel_op.cc, shuffle_channel_op.h,
+space_to_depth_op.cc, random_crop_op.h, partial_concat_op.h,
+partial_sum_op.h.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._primitive import primitive, unwrap
+
+__all__ = [
+    "add_position_encoding",
+    "sampling_id",
+    "squared_l2_distance",
+    "squared_l2_norm",
+    "center_loss",
+    "bpr_loss",
+    "fsp_matrix",
+    "cos_sim",
+    "affine_channel",
+    "shuffle_channel",
+    "space_to_depth",
+    "random_crop",
+    "partial_concat",
+    "partial_sum",
+]
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0, name=None):
+    """Sinusoidal position encoding mixed into [B, T, 2H] features
+    (add_position_encoding_op.h): out[..., k] = alpha*x + beta*sin(pos/
+    10000^(k/(H-1))) for the first half, cos for the second."""
+
+    @primitive
+    def _ape(x):
+        b, t, e = x.shape
+        half = e // 2
+        pos = jnp.arange(t, dtype=jnp.float32)
+        k = jnp.arange(half, dtype=jnp.float32)
+        denom = jnp.power(10000.0, k / (half - 1 if half > 1 else 1))
+        val = pos[:, None] / denom[None, :]  # [T, half]
+        enc = jnp.concatenate([jnp.sin(val), jnp.cos(val)], axis=-1)
+        return (alpha * x + beta * enc[None].astype(x.dtype))
+
+    return _ape(x)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64", name=None):  # noqa: A002
+    """Sample one category id per row from probabilities [B, V]
+    (sampling_id_op.h: inverse-CDF on a uniform draw; driven by the
+    framework's seeded PRNG)."""
+    from ..random import split_key
+
+    @primitive(nondiff=True)
+    def _sid(x, key):
+        u = jax.random.uniform(key, (x.shape[0], 1), jnp.float32,
+                               minval=float(min), maxval=float(max))
+        cdf = jnp.cumsum(x.astype(jnp.float32), axis=-1)
+        idx = jnp.sum((cdf < u).astype(jnp.int32), axis=-1)
+        return jnp.clip(idx, 0, x.shape[1] - 1).astype(dtype)
+
+    return _sid(x, split_key())
+
+
+def squared_l2_distance(x, y, name=None):
+    """Row-wise squared L2 distance (squared_l2_distance_op.h). Returns
+    (out [N, 1], sub = x - y) like the reference (sub feeds its grad; here
+    AD covers it but the output surface matches)."""
+
+    @primitive
+    def _sqd(x, y):
+        sub = x - y
+        return jnp.sum(jnp.square(sub), axis=tuple(range(1, sub.ndim)),
+                       keepdims=True).reshape(x.shape[0], 1), sub
+
+    return _sqd(x, y)
+
+
+def squared_l2_norm(x, name=None):
+    """sum(x^2) as a 1-element tensor (squared_l2_norm_op.h)."""
+
+    @primitive
+    def _sqn(x):
+        return jnp.sum(jnp.square(x)).reshape(1)
+
+    return _sqn(x)
+
+
+def center_loss(x, label, centers, alpha=0.5, update_center=True, name=None):
+    """Center loss (center_loss_op.h, Wen et al.): per-sample
+    0.5*||x - centers[label]||^2; centers move toward their class means by
+    rate alpha with the reference's 1/(1+count) normalization. Returns
+    (loss [N, 1], new_centers)."""
+
+    @primitive
+    def _cl(x, label, centers):
+        lbl = label.reshape(-1).astype(jnp.int32)
+        c = jnp.take(centers, lbl, axis=0)
+        diff = x - c
+        loss = 0.5 * jnp.sum(jnp.square(diff), axis=-1, keepdims=True)
+        if not update_center:
+            return loss, centers
+        k = centers.shape[0]
+        counts = jnp.zeros((k,), jnp.float32).at[lbl].add(1.0)
+        sums = jnp.zeros_like(centers).at[lbl].add(diff.astype(centers.dtype))
+        upd = sums / (1.0 + counts)[:, None]
+        return loss, centers + alpha * upd
+
+    return _cl(x, unwrap(label), centers)
+
+
+def bpr_loss(input, label, name=None):  # noqa: A002
+    """Bayesian Personalized Ranking loss (bpr_loss_op.h): per row,
+    mean over negatives j != y of softplus(x_j - x_y)."""
+
+    @primitive
+    def _bpr(x, label):
+        n, c = x.shape
+        lbl = label.reshape(-1).astype(jnp.int32)
+        pos = jnp.take_along_axis(x, lbl[:, None], axis=-1)
+        sp = jax.nn.softplus(x - pos)  # log(1 + exp(x_j - x_pos))
+        mask = jnp.arange(c)[None, :] != lbl[:, None]
+        return (jnp.sum(jnp.where(mask, sp, 0.0), axis=-1,
+                        keepdims=True) / (c - 1))
+
+    return _bpr(input, unwrap(label))
+
+
+def fsp_matrix(x, y, name=None):
+    """Flow-of-solution-procedure matrix for distillation (fsp_op.h):
+    out[n, c1, c2] = mean over H*W of x[n, c1] * y[n, c2]."""
+
+    @primitive
+    def _fsp(x, y):
+        h, w = x.shape[2], x.shape[3]
+        return jnp.einsum("nchw,ndhw->ncd", x, y) / (h * w)
+
+    return _fsp(x, y)
+
+
+def cos_sim(x, y, name=None):
+    """Row-wise cosine similarity [N, 1] (cos_sim_op.h; y may be [1, D]
+    to broadcast one reference row)."""
+
+    @primitive
+    def _cs(x, y):
+        xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+        yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+        dot = jnp.sum(x * y, axis=-1, keepdims=True)
+        return dot / (xn * yn)
+
+    return _cs(x, y)
+
+
+def affine_channel(x, scale, bias, data_format="NCHW", name=None):
+    """Per-channel scale + bias (affine_channel_op.cc — the frozen-BN
+    replacement in detection models)."""
+
+    @primitive
+    def _ac(x, scale, bias):
+        if data_format == "NCHW":
+            shape = (1, -1) + (1,) * (x.ndim - 2)
+        else:
+            shape = (1,) * (x.ndim - 1) + (-1,)
+        return x * scale.reshape(shape) + bias.reshape(shape)
+
+    return _ac(x, scale, bias)
+
+
+def shuffle_channel(x, group, name=None):
+    """Channel shuffle (shuffle_channel_op.h; ShuffleNet)."""
+
+    @primitive
+    def _sc(x):
+        n, c, h, w = x.shape
+        return (x.reshape(n, group, c // group, h, w)
+                .transpose(0, 2, 1, 3, 4).reshape(n, c, h, w))
+
+    return _sc(x)
+
+
+def space_to_depth(x, blocksize, name=None):
+    """NCHW space→depth rearrange (space_to_depth_op.cc)."""
+
+    @primitive
+    def _s2d(x):
+        n, c, h, w = x.shape
+        bs = blocksize
+        out = x.reshape(n, c, h // bs, bs, w // bs, bs)
+        out = out.transpose(0, 3, 5, 1, 2, 4)
+        return out.reshape(n, c * bs * bs, h // bs, w // bs)
+
+    return _s2d(x)
+
+
+def random_crop(x, shape, seed=None, name=None):
+    """Random spatial crop to ``shape`` (trailing dims; random_crop_op.h),
+    driven by the framework PRNG."""
+    from ..random import split_key
+
+    shape = tuple(int(s) for s in shape)
+
+    @primitive(nondiff=True)
+    def _rc(x, key):
+        nd = len(shape)
+        lead = x.shape[: x.ndim - nd]
+        n_inst = 1
+        for s in lead:
+            n_inst *= s
+        flat = x.reshape((n_inst,) + x.shape[x.ndim - nd:])
+        keys = jax.random.split(key, n_inst * nd).reshape(n_inst, nd)
+
+        def crop_one(inst, ks):
+            starts = tuple(
+                jax.random.randint(ks[i], (), 0,
+                                   inst.shape[i] - shape[i] + 1).astype(jnp.int32)
+                for i in range(nd))
+            return jax.lax.dynamic_slice(inst, starts, shape)
+
+        # per-instance offsets (random_crop_op.h draws per ins_idx)
+        out = jax.vmap(crop_one)(flat, keys)
+        return out.reshape(tuple(lead) + shape)
+
+    return _rc(x, split_key())
+
+
+def _col_slice(x, start_index, length):
+    """Reference normalization (partial_concat_op.h): negative start wraps,
+    length -1 means to-the-end."""
+    start = start_index + x.shape[1] if start_index < 0 else start_index
+    end = x.shape[1] if length < 0 else start + length
+    return x[:, start:end]
+
+
+def partial_concat(inputs, start_index=0, length=-1, name=None):
+    """Concat the same column slice of every input (partial_concat_op.h)."""
+
+    @primitive
+    def _pc(*xs):
+        return jnp.concatenate(
+            [_col_slice(x, start_index, length) for x in xs], axis=1)
+
+    return _pc(*inputs)
+
+
+def partial_sum(inputs, start_index=0, length=-1, name=None):
+    """Sum the same column slice of every input (partial_sum_op.h)."""
+
+    @primitive
+    def _ps(*xs):
+        acc = None
+        for x in xs:
+            sl = _col_slice(x, start_index, length)
+            acc = sl if acc is None else acc + sl
+        return acc
+
+    return _ps(*inputs)

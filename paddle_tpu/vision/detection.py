@@ -46,6 +46,7 @@ __all__ = [
     "matrix_nms",
     "generate_proposals",
     "generate_proposals_v2",
+    "retinanet_detection_output",
     "distribute_fpn_proposals",
     "collect_fpn_proposals",
     "polygon_box_transform",
@@ -853,6 +854,92 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
 # ---------------------------------------------------------------------------
 # misc detection ops
 # ---------------------------------------------------------------------------
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               nms_threshold=0.3, keep_top_k=100,
+                               nms_eta=1.0, name=None):
+    """RetinaNet decode + NMS head (retinanet_detection_output_op.cc):
+    per FPN level, threshold the [cells*A, C] sigmoid scores and keep the
+    top nms_top_k candidates; decode their deltas on the level's anchors
+    (variance-free corner decode); pool levels; per-class greedy NMS; keep
+    the global top keep_top_k. bboxes: list of [N, M_l, 4] deltas; scores:
+    list of [N, M_l, C]; anchors: list of [M_l, 4]. Returns
+    (out [N*keep, 6], rois_num [N])."""
+    bb = [_arr(b).astype(jnp.float32) for b in bboxes]
+    sc = [_arr(s).astype(jnp.float32) for s in scores]
+    an = [_arr(a).astype(jnp.float32).reshape(-1, 4) for a in anchors]
+    im = _arr(im_info).astype(jnp.float32)
+
+    @primitive(nondiff=True)
+    def _rdo(im, *flat):
+        nlev = len(an)
+        bbs, scs = flat[:nlev], flat[nlev:]
+        n = bbs[0].shape[0]
+        c = scs[0].shape[-1]
+
+        def one(args):
+            per_level_deltas, per_level_scores, imi = args
+            cand_boxes, cand_scores, cand_cls = [], [], []
+            for li in range(nlev):
+                s = per_level_scores[li]  # [M_l, C]
+                m_l = s.shape[0]
+                top = min(nms_top_k, m_l * c)
+                flat_s = jnp.where(s > score_threshold, s, -jnp.inf).reshape(-1)
+                ts, ti = lax.top_k(flat_s, top)
+                box_id = ti // c
+                cls_id = ti % c
+                d = jnp.take(per_level_deltas[li], box_id, axis=0)
+                a = jnp.take(an[li], box_id, axis=0)
+                # +1 pixel convention (retinanet_detection_output_op.h:
+                # anchor w = x2-x1+1, corners cx±w/2∓1); boxes map back to
+                # ORIGINAL-image coords via im_scale before clipping
+                props = _decode_anchor_deltas(a, d, None, True)
+                props = props / imi[2]
+                hi = jnp.stack([imi[1], imi[0], imi[1], imi[0]]) / imi[2] - 1
+                props = jnp.clip(props, 0.0, hi)
+                cand_boxes.append(props)
+                cand_scores.append(ts)
+                cand_cls.append(cls_id)
+            boxes = jnp.concatenate(cand_boxes, axis=0)
+            scores_all = jnp.concatenate(cand_scores, axis=0)
+            cls_all = jnp.concatenate(cand_cls, axis=0)
+
+            def per_class(cl):
+                valid = (scores_all > -jnp.inf) & (cls_all == cl)
+                # normalized=False: +1 pixel-convention IoU (JaccardOverlap
+                # normalized=false in the reference kernel)
+                order, keep = _greedy_nms_mask(boxes, scores_all, valid,
+                                               nms_threshold, nms_eta, False)
+                mask = jnp.zeros((boxes.shape[0],), bool).at[order].set(keep)
+                return mask
+
+            keep_cm = jax.vmap(per_class)(jnp.arange(c))  # [C, M]
+            kept = jnp.any(keep_cm, axis=0)
+            final_s = jnp.where(kept, scores_all, -jnp.inf)
+            k = min(keep_top_k, final_s.shape[0])
+            ts, ti = lax.top_k(final_s, k)
+            ok = ts > -jnp.inf
+            sel_cls = jnp.take(cls_all, ti).astype(jnp.float32)
+            sel_box = jnp.take(boxes, ti, axis=0)
+            o2 = jnp.lexsort((-ts, jnp.where(ok, sel_cls, jnp.inf)))
+            ts, ok, sel_cls, sel_box = ts[o2], ok[o2], sel_cls[o2], sel_box[o2]
+            out = jnp.concatenate([
+                jnp.where(ok, sel_cls, -1.0)[:, None],
+                jnp.where(ok, ts, 0.0)[:, None],
+                jnp.where(ok[:, None], sel_box, 0.0),
+            ], axis=1)
+            return out, jnp.sum(ok.astype(jnp.int32))
+
+        outs, cnts = [], []
+        for b in range(n):
+            o, cn = one(([x[b] for x in bbs], [x[b] for x in scs], im[b]))
+            outs.append(o)
+            cnts.append(cn)
+        return jnp.concatenate(outs, axis=0), jnp.stack(cnts)
+
+    return _rdo(im, *bb, *sc)
+
 
 def polygon_box_transform(input, name=None):  # noqa: A002
     """EAST-style offset maps → absolute quad coordinates

@@ -1,0 +1,179 @@
+"""Numpy-parity tests for ops/misc_catalog.py + retinanet_detection_output
+(OpTest pattern; reference kernels named per-op in the module)."""
+import math
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import misc_catalog as M
+from paddle_tpu.tensor import Tensor
+
+
+def _np(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x)
+
+
+def test_add_position_encoding():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 3, 8)).astype(np.float32)
+    got = _np(M.add_position_encoding(x, alpha=0.5, beta=2.0))
+    half = 4
+    want = np.empty_like(x)
+    for j in range(3):
+        for k in range(half):
+            val = j / (10000.0 ** (k / (half - 1)))
+            want[:, j, k] = 0.5 * x[:, j, k] + 2.0 * math.sin(val)
+            want[:, j, half + k] = 0.5 * x[:, j, half + k] + 2.0 * math.cos(val)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_sampling_id_distribution():
+    paddle.seed(0)
+    probs = np.tile(np.array([[0.0, 0.0, 1.0, 0.0]], np.float32), (16, 1))
+    got = _np(M.sampling_id(probs))
+    assert (got == 2).all()
+
+
+def test_squared_l2_distance_and_norm():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((4, 5)).astype(np.float32)
+    y = rng.standard_normal((4, 5)).astype(np.float32)
+    out, sub = M.squared_l2_distance(x, y)
+    np.testing.assert_allclose(_np(out)[:, 0], ((x - y) ** 2).sum(-1), rtol=1e-5)
+    np.testing.assert_allclose(_np(sub), x - y, rtol=1e-6)
+    np.testing.assert_allclose(_np(M.squared_l2_norm(x))[0], (x ** 2).sum(),
+                               rtol=1e-5)
+
+
+def test_center_loss():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((4, 3)).astype(np.float32)
+    centers = rng.standard_normal((5, 3)).astype(np.float32)
+    label = np.array([1, 1, 0, 3])
+    loss, new_c = M.center_loss(x, label, centers, alpha=0.5)
+    want_loss = 0.5 * ((x - centers[label]) ** 2).sum(-1)
+    np.testing.assert_allclose(_np(loss)[:, 0], want_loss, rtol=1e-5)
+    # class 1 center moves by alpha * sum(diff)/(1+2)
+    diff1 = (x[0] - centers[1]) + (x[1] - centers[1])
+    np.testing.assert_allclose(_np(new_c)[1], centers[1] + 0.5 * diff1 / 3.0,
+                               rtol=1e-5)
+    np.testing.assert_allclose(_np(new_c)[2], centers[2], rtol=1e-6)  # unused
+
+
+def test_bpr_loss():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((3, 4)).astype(np.float32)
+    label = np.array([[1], [0], [3]])
+    got = _np(M.bpr_loss(x, label))[:, 0]
+    want = np.zeros(3)
+    for i in range(3):
+        y = label[i, 0]
+        s = sum(np.log1p(np.exp(x[i, j] - x[i, y])) for j in range(4) if j != y)
+        want[i] = s / 3
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_fsp_and_cos_sim():
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2, 3, 4, 5)).astype(np.float32)
+    y = rng.standard_normal((2, 6, 4, 5)).astype(np.float32)
+    got = _np(M.fsp_matrix(x, y))
+    want = np.einsum("nchw,ndhw->ncd", x, y) / 20.0
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    a = rng.standard_normal((3, 4)).astype(np.float32)
+    b = rng.standard_normal((3, 4)).astype(np.float32)
+    cs = _np(M.cos_sim(a, b))[:, 0]
+    want = (a * b).sum(-1) / (np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1))
+    np.testing.assert_allclose(cs, want, rtol=1e-5)
+
+
+def test_affine_shuffle_space():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((1, 4, 2, 2)).astype(np.float32)
+    s = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    b = np.array([0.5, 0.0, -1.0, 2.0], np.float32)
+    got = _np(M.affine_channel(x, s, b))
+    np.testing.assert_allclose(got, x * s[None, :, None, None] + b[None, :, None, None],
+                               rtol=1e-6)
+
+    x2 = np.arange(8, dtype=np.float32).reshape(1, 4, 1, 2)
+    got = _np(M.shuffle_channel(x2, group=2))
+    # channels [0,1,2,3] grouped (2,2) transposed -> [0,2,1,3]
+    np.testing.assert_allclose(got[0, :, 0, 0], x2[0, [0, 2, 1, 3], 0, 0])
+
+    x3 = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    got = _np(M.space_to_depth(x3, 2))
+    assert got.shape == (1, 4, 2, 2)
+    np.testing.assert_allclose(got[0, 0], x3[0, 0, ::2, ::2])
+
+
+def test_random_crop_shape_and_content():
+    paddle.seed(0)
+    x = np.arange(2 * 8 * 8, dtype=np.float32).reshape(2, 8, 8)
+    got = _np(M.random_crop(x, (4, 4)))
+    assert got.shape == (2, 4, 4)
+    # crop is a contiguous window: row deltas are 1, col deltas 8
+    assert np.allclose(np.diff(got[0], axis=1), 1.0)
+
+
+def test_partial_concat_sum():
+    x1 = np.arange(12, dtype=np.float32).reshape(3, 4)
+    x2 = 100 + x1
+    got = _np(M.partial_concat([x1, x2], start_index=1, length=2))
+    np.testing.assert_allclose(got, np.concatenate([x1[:, 1:3], x2[:, 1:3]], 1))
+    got = _np(M.partial_sum([x1, x2], start_index=1, length=2))
+    np.testing.assert_allclose(got, x1[:, 1:3] + x2[:, 1:3])
+
+
+def test_grads_flow_through_losses():
+    x = paddle.to_tensor(np.random.default_rng(6).standard_normal(
+        (3, 4)).astype(np.float32), stop_gradient=False)
+    loss = M.bpr_loss(x, np.array([[0], [1], [2]]))
+    loss.sum().backward()
+    assert np.isfinite(_np(x.grad)).all()
+
+
+def test_retinanet_detection_output():
+    from paddle_tpu.vision import detection as D
+
+    rng = np.random.default_rng(7)
+    # two levels, 1 image, 3 classes
+    anchors = [np.array([[0, 0, 15, 15], [8, 8, 31, 31]], np.float32),
+               np.array([[0, 0, 31, 31]], np.float32)]
+    deltas = [np.zeros((1, 2, 4), np.float32), np.zeros((1, 1, 4), np.float32)]
+    scores = [np.array([[[0.9, 0.01, 0.02], [0.01, 0.8, 0.01]]], np.float32),
+              np.array([[[0.02, 0.01, 0.7]]], np.float32)]
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    out, cnt = D.retinanet_detection_output(
+        deltas, scores, anchors, im_info, score_threshold=0.05,
+        nms_threshold=0.5, keep_top_k=10)
+    out, cnt = _np(out), _np(cnt)
+    assert cnt[0] == 3
+    rows = out[: cnt[0]]
+    # class-ascending rows; zero deltas decode back to the anchors
+    assert rows[0, 0] == 0 and abs(rows[0, 1] - 0.9) < 1e-5
+    np.testing.assert_allclose(rows[0, 2:], [0, 0, 15, 15], atol=1e-4)
+    assert rows[1, 0] == 1
+    assert rows[2, 0] == 2
+    np.testing.assert_allclose(rows[2, 2:], [0, 0, 31, 31], atol=1e-4)
+
+
+def test_retinanet_pixel_convention_and_im_scale():
+    """Non-zero deltas use the +1 width convention (w = x2-x1+1) and boxes
+    map back to original-image coords via im_info[2] (review r4)."""
+    from paddle_tpu.vision import detection as D
+
+    anchors = [np.array([[0, 0, 15, 15]], np.float32)]
+    # dw = log(2): reference width 16 -> 32
+    deltas = [np.array([[[0.0, 0.0, np.log(2.0), 0.0]]], np.float32)]
+    scores = [np.array([[[0.9]]], np.float32)]
+    im_info = np.array([[64.0, 64.0, 2.0]], np.float32)  # scaled 2x
+    out, cnt = D.retinanet_detection_output(deltas, scores, anchors, im_info,
+                                            keep_top_k=5)
+    out = _np(out)
+    assert _np(cnt)[0] == 1
+    # decode (+1 conv): aw=16, acx=8; w = exp(log2)*16 = 32 ->
+    # x1 = 8-16 = -8, x2 = 8+16-1 = 23; y stays [0, 15]
+    # /scale 2 -> [-4, 0, 11.5, 7.5], clip to [0, 31]
+    np.testing.assert_allclose(out[0, 2:], [0.0, 0.0, 11.5, 7.5], atol=1e-3)
