@@ -23,7 +23,16 @@ from jax.sharding import PartitionSpec as P
 
 from ...tensor import Tensor
 
-__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine"]
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine",
+           "ModelStats", "Plan", "plan_strategy"]
+
+
+def __getattr__(name):
+    if name in ("ModelStats", "Plan", "Candidate", "plan_strategy"):
+        from . import planner
+
+        return getattr(planner, name)
+    raise AttributeError(name)
 
 
 class ProcessMesh:
@@ -145,14 +154,69 @@ class Engine:
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = process_mesh
+        self.plan = None
+
+    @classmethod
+    def auto(cls, model, loss_fn, optimizer, *, global_batch: int,
+             seq_len: Optional[int] = None, n_devices: Optional[int] = None,
+             hbm_bytes: float = 16e9):
+        """Cost-model-planned Engine (the reference planner/cost_model role,
+        auto_parallel/cost_model.py): picks (dp, mp, pp, ZeRO, remat)
+        analytically and builds the mesh. ``engine.plan.explain()`` shows
+        every scored candidate."""
+        from .planner import ModelStats, plan_strategy
+
+        cfg = getattr(getattr(model, "gpt", model), "config", None)
+        if cfg is None:
+            raise ValueError("Engine.auto needs a model with a .config "
+                             "(GPT family); pass ModelStats to "
+                             "plan_strategy directly otherwise")
+        stats = ModelStats.from_gpt_config(cfg, seq_len=seq_len)
+        n_dev = n_devices or len(jax.devices())
+        plan = plan_strategy(stats, n_dev, global_batch, hbm_bytes=hbm_bytes)
+        axes = plan.best.axes
+        dims = list(axes.keys())
+        shape = [axes[d] for d in dims]
+        pm = ProcessMesh(np.arange(int(np.prod(shape))).reshape(shape), dims)
+        eng = cls(model, loss_fn, optimizer, pm)
+        eng.plan = plan
+        return eng
 
     def fit_step(self):
-        from ..parallel_trainer import ParallelTrainer
+        """Build the executor that REALIZES the plan: ParallelTrainer for
+        flat (dp/mp/sharding) meshes — GSPMD shards mp-annotated params
+        automatically — or the ppermute pipeline step when the plan chose
+        pp > 1 (with its ZeRO-2 slots / ZeRO-3 sharded stage params)."""
         from ..env import set_mesh
+        from ..parallel_trainer import ParallelTrainer
 
         set_mesh(self.mesh.jax_mesh())
         names = self.mesh.dim_names
+        best = self.plan.best if self.plan is not None else None
+        if best is not None and best.pp > 1:
+            from ...models.gpt import GPTForPretraining
+            from ..meta_parallel.pipeline_schedule import (
+                build_gpt_pipeline_step,
+            )
+
+            if not isinstance(self.model, GPTForPretraining):
+                raise NotImplementedError(
+                    "planned pp > 1 needs the GPT pipeline step; wrap your "
+                    "model as a PipelineModule or re-plan with pp=1 "
+                    "(pass n_devices/hbm accordingly)")
+            stepfn = build_gpt_pipeline_step(
+                self.model, self.optimizer,
+                microbatches=best.microbatches,
+                sharding_stage=3 if best.zero_stage >= 3 else 2)
+            stepfn.step = stepfn  # trainer-interface alias
+            return stepfn
+        # model axes must NEVER be used as the batch axis: dp falls back to
+        # None (single-replica) when the plan is pure model parallelism
+        dp_axis = next((n for n in names if n in ("dp", "sharding")), None)
+        fsdp = None
+        if best is not None and best.zero_stage >= 3 and "sharding" in names:
+            fsdp = "sharding"
         return ParallelTrainer(
             self.model, self.loss_fn, self.optimizer,
-            dp_axis=names[0] if names else None,
+            dp_axis=dp_axis, fsdp_axis=fsdp,
         )

@@ -46,7 +46,9 @@ def add_position_encoding(x, alpha=1.0, beta=1.0, name=None):
         half = e // 2
         pos = jnp.arange(t, dtype=jnp.float32)
         k = jnp.arange(half, dtype=jnp.float32)
-        denom = jnp.power(10000.0, k / (half - 1 if half > 1 else 1))
+        # half == 1: reference computes pos / 10000.0 directly
+        denom = (jnp.power(10000.0, k / (half - 1)) if half > 1
+                 else jnp.full((1,), 10000.0, jnp.float32))
         val = pos[:, None] / denom[None, :]  # [T, half]
         enc = jnp.concatenate([jnp.sin(val), jnp.cos(val)], axis=-1)
         return (alpha * x + beta * enc[None].astype(x.dtype))
@@ -189,15 +191,25 @@ def shuffle_channel(x, group, name=None):
 
 
 def space_to_depth(x, blocksize, name=None):
-    """NCHW space→depth rearrange (space_to_depth_op.cc)."""
+    """Darknet reorg (space_to_depth_op.h space_to_depth_compute): despite
+    the name, the reference kernel maps the CHANNEL-major input
+    [B, C, H, W] (C % bs^2 == 0) to [B, C/bs^2, H*bs, W*bs] with
+    out[b, c2, j*bs + off//bs, i*bs + off%bs] = x[b, off*out_c + c2, j, i].
+    Behavior parity over naming."""
+    bs = int(blocksize)
 
     @primitive
     def _s2d(x):
         n, c, h, w = x.shape
-        bs = blocksize
-        out = x.reshape(n, c, h // bs, bs, w // bs, bs)
-        out = out.transpose(0, 3, 5, 1, 2, 4)
-        return out.reshape(n, c * bs * bs, h // bs, w // bs)
+        if c % (bs * bs):
+            raise ValueError(
+                f"space_to_depth: channels ({c}) must be divisible by "
+                f"blocksize^2 ({bs * bs}) — reference InferShape")
+        out_c = c // (bs * bs)
+        # k = offset * out_c + c2, offset = dy*bs + dx
+        r = x.reshape(n, bs, bs, out_c, h, w)  # [b, dy, dx, c2, j, i]
+        r = r.transpose(0, 3, 4, 1, 5, 2)      # [b, c2, j, dy, i, dx]
+        return r.reshape(n, out_c, h * bs, w * bs)
 
     return _s2d(x)
 

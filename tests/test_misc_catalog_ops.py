@@ -13,6 +13,17 @@ def _np(x):
     return np.asarray(x._data if isinstance(x, Tensor) else x)
 
 
+def test_add_position_encoding_half1():
+    # enc_size == 2: reference computes val = pos / 10000.0
+    x = np.zeros((1, 3, 2), np.float32)
+    got = _np(M.add_position_encoding(x, alpha=1.0, beta=1.0))
+    for j in range(3):
+        np.testing.assert_allclose(got[0, j, 0], math.sin(j / 10000.0),
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(got[0, j, 1], math.cos(j / 10000.0),
+                                   rtol=1e-5)
+
+
 def test_add_position_encoding():
     rng = np.random.default_rng(0)
     x = rng.standard_normal((2, 3, 8)).astype(np.float32)
@@ -102,10 +113,23 @@ def test_affine_shuffle_space():
     # channels [0,1,2,3] grouped (2,2) transposed -> [0,2,1,3]
     np.testing.assert_allclose(got[0, :, 0, 0], x2[0, [0, 2, 1, 3], 0, 0])
 
-    x3 = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    # space_to_depth is the darknet reorg: [B, C, H, W] (C % bs^2 == 0)
+    # -> [B, C/bs^2, H*bs, W*bs]; check against the reference kernel's
+    # index formula (space_to_depth_op.h space_to_depth_compute)
+    x3 = np.arange(1 * 4 * 2 * 3, dtype=np.float32).reshape(1, 4, 2, 3)
     got = _np(M.space_to_depth(x3, 2))
-    assert got.shape == (1, 4, 2, 2)
-    np.testing.assert_allclose(got[0, 0], x3[0, 0, ::2, ::2])
+    assert got.shape == (1, 1, 4, 6)
+    want = np.zeros((1, 1, 4, 6), np.float32)
+    out_c = 1
+    for k in range(4):
+        for j in range(2):
+            for i in range(3):
+                c2, off = k % out_c, k // out_c
+                want[0, c2, j * 2 + off // 2, i * 2 + off % 2] = x3[0, k, j, i]
+    np.testing.assert_allclose(got, want)
+    import pytest
+    with pytest.raises(ValueError, match="blocksize"):
+        M.space_to_depth(np.zeros((1, 3, 4, 4), np.float32), 2)
 
 
 def test_random_crop_shape_and_content():
