@@ -29,6 +29,11 @@ from .sampler import (  # noqa: F401
     WeightedRandomSampler,
 )
 from .dataloader import DataLoader, get_worker_info  # noqa: F401
+from .dataset_channel import (  # noqa: F401
+    FileListDataset,
+    InMemoryDataset,
+    ShuffleChannel,
+)
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
@@ -36,4 +41,5 @@ __all__ = [
     "Sampler", "SequenceSampler", "RandomSampler", "SubsetRandomSampler",
     "WeightedRandomSampler", "BatchSampler", "DistributedBatchSampler",
     "DataLoader", "get_worker_info",
+    "FileListDataset", "ShuffleChannel", "InMemoryDataset",
 ]

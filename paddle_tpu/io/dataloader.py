@@ -97,6 +97,49 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, wid, num_workers, 
         ring.destroy()  # attach side: munmap only, owner unlinks
 
 
+def _iterable_worker_loop(dataset, out_queue, collate_fn, wid, num_workers,
+                          seed, batch_size, drop_last, ring_name=None):
+    """IterableDataset worker: the dataset's __iter__ consults
+    get_worker_info() to pick its shard (e.g. FileListDataset's worker
+    file stride — the data_feed.cc per-thread file pickup)."""
+    np.random.seed(seed + wid)
+    _worker_info.info = WorkerInfo(wid, num_workers, dataset, seed + wid)
+    ring = None
+    if ring_name is not None:
+        try:
+            from ..core import ShmRing
+
+            ring = ShmRing(ring_name, create=False)
+        except Exception:
+            ring = None
+
+    def emit(bid, batch):
+        # ring payloads are (bid, batch) 2-tuples (what _recv_batch decodes)
+        if ring is not None:
+            payload = pickle.dumps((bid, batch), protocol=4)
+            try:
+                ring.write(payload)
+                return
+            except ValueError:  # oversize → pipe path
+                pass
+        out_queue.put((bid, batch, None))
+
+    try:
+        it = iter(dataset)
+        while True:
+            chunk = list(itertools.islice(it, batch_size))
+            if not chunk:
+                break
+            if len(chunk) < batch_size and drop_last:
+                break
+            emit(0, collate_fn(chunk))
+    except Exception as e:  # propagate worker errors
+        out_queue.put((0, None, e))
+    emit(-1, None)  # EOF rides the same FIFO as this worker's batches
+    if ring is not None:
+        ring.destroy()
+
+
 class DataLoader:
     def __init__(
         self,
@@ -146,6 +189,9 @@ class DataLoader:
     # ------------------------------------------------------------------
     def _batches_numpy(self):
         if self._iterable_mode:
+            if self.num_workers > 0:
+                yield from self._batches_multiprocess_iterable()
+                return
             it = iter(self.dataset)
             while True:
                 chunk = list(itertools.islice(it, self.batch_size))
@@ -216,6 +262,56 @@ class DataLoader:
         finally:
             for _ in workers:
                 index_queue.put(None)
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()
+            if ring is not None:
+                ring.destroy()
+
+    def _batches_multiprocess_iterable(self):
+        """Parallel IterableDataset consumption (data_feed.cc per-thread
+        channels): each worker iterates ITS shard (the dataset's __iter__
+        reads get_worker_info) and streams batches; batches yield in
+        arrival order until every worker EOFs."""
+        ctx = mp.get_context("fork")
+        out_queue = ctx.Queue()
+        seed = np.random.randint(0, 2**31 - 1)
+        ring = None
+        ring_name = None
+        if self.use_shared_memory:
+            try:
+                from ..core import ShmRing
+
+                ring_name = f"/pt_dl_{os.getpid()}_{next(_ring_counter)}"
+                ring = ShmRing(ring_name,
+                               slot_size=self._shm_slot_size,
+                               nslots=max(4, self.num_workers * self.prefetch_factor))
+            except Exception:
+                ring, ring_name = None, None
+        workers = [
+            ctx.Process(
+                target=_iterable_worker_loop,
+                args=(self.dataset, out_queue, self.collate_fn, w,
+                      self.num_workers, seed, self.batch_size, self.drop_last,
+                      ring_name),
+                daemon=True,
+            )
+            for w in range(self.num_workers)
+        ]
+        for w in workers:
+            w.start()
+        done = 0
+        try:
+            while done < self.num_workers:
+                bid, data, err = self._recv_batch(ring, out_queue)
+                if err is not None:
+                    raise err
+                if bid == -1:
+                    done += 1
+                    continue
+                yield data
+        finally:
             for w in workers:
                 w.join(timeout=1)
                 if w.is_alive():

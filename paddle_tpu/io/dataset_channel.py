@@ -1,0 +1,228 @@
+"""High-throughput dataset-channel feeding (pod-scale input).
+
+Parity: the reference's C++ Dataset/DataFeed engine —
+paddle/fluid/framework/data_set.cc (file list ownership, per-thread file
+assignment, global/local shuffle through Channels) and data_feed.cc (the
+largest framework file: file-sharded parsing into channel queues consumed
+by trainer threads). SURVEY §2.1 DataFeed/Dataset row; VERDICT r3
+missing #5.
+
+TPU-native redesign: the channel machinery maps onto IterableDataset +
+the existing multiprocess DataLoader (which already owns the shm-ring /
+prefetch path):
+
+- ``FileListDataset``   — owns a file list; shards FILES over
+  (dist rank) x (dataloader worker) like data_set.cc hands files to
+  DataFeed threads; a user ``parser(path) -> iter(samples)`` turns each
+  file into a sample stream (MultiSlotDataFeed role).
+- ``ShuffleChannel``    — bounded reservoir between producer and consumer:
+  fill to capacity, then emit uniformly-random elements as new ones
+  arrive ("local shuffle" channel semantics, data_set.cc
+  LocalShuffle/Channel). Deterministic per (seed, epoch).
+- ``InMemoryDataset``   — the reference's InMemoryDataset surface:
+  load_into_memory() materializes parsed samples, local_shuffle() /
+  global_shuffle() reorder them (global = one shared permutation every
+  rank draws identically, then rank-strided — rank r sees slice r::world
+  of ONE global order, ≙ the brpc shuffle-to-all exchange).
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from .dataset import IterableDataset
+
+__all__ = ["FileListDataset", "ShuffleChannel", "InMemoryDataset"]
+
+
+def _worker_shard():
+    """(start, step) for this dataloader worker (composes with dist rank
+    sharding done by the caller)."""
+    from .dataloader import get_worker_info
+
+    info = get_worker_info()
+    if info is None:
+        return 0, 1
+    return info.id, info.num_workers
+
+
+class FileListDataset(IterableDataset):
+    """File-sharded streaming dataset (data_set.cc SetFileList +
+    per-thread file pickup).
+
+    files: paths; parser(path) -> iterable of samples. Files are sharded
+    rank-first (``rank``/``world_size`` — pass your dist rank, or they
+    default from the launcher env) then worker-strided inside the
+    DataLoader. ``set_epoch`` reshuffles the FILE ORDER deterministically
+    (global file shuffle, data_set.cc's epoch reshuffle).
+
+    CAUTION (lockstep SPMD): file-level sharding gives ranks UNEQUAL
+    sample counts when files differ in size or don't divide evenly — fine
+    for the reference's channel-draining PS trainers, but a lockstep dp
+    step will deadlock in its collective when one rank runs out first.
+    For lockstep training either make per-rank steps explicit
+    (steps_per_epoch) or use InMemoryDataset.global_shuffle (even to
+    within one sample)."""
+
+    def __init__(self, files: Sequence[str], parser: Callable[[str], Iterable],
+                 rank: Optional[int] = None, world_size: Optional[int] = None,
+                 shuffle_files: bool = True, seed: int = 0):
+        self.files = [str(f) for f in files]
+        if not self.files:
+            raise ValueError("FileListDataset needs at least one file")
+        if world_size is not None and world_size > len(self.files):
+            raise ValueError(
+                f"world_size ({world_size}) exceeds the file count "
+                f"({len(self.files)}): some ranks would get NO data and "
+                "lockstep training would hang — split the input into at "
+                "least one file per rank")
+        self.parser = parser
+        if rank is None or world_size is None:
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+            world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self.rank = rank
+        self.world_size = world_size
+        self.shuffle_files = shuffle_files
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = int(epoch)
+
+    def _epoch_files(self) -> List[str]:
+        order = list(range(len(self.files)))
+        if self.shuffle_files:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            rng.shuffle(order)
+        mine = order[self.rank::self.world_size]
+        return [self.files[i] for i in mine]
+
+    def __iter__(self):
+        files = self._epoch_files()
+        w0, wn = _worker_shard()
+        for path in files[w0::wn]:
+            yield from self.parser(path)
+
+
+class ShuffleChannel(IterableDataset):
+    """Bounded shuffle buffer over any iterable dataset (the Channel +
+    local-shuffle stage of data_feed.cc): keep up to ``capacity`` samples,
+    emit one uniformly at random per pull. Streaming — never materializes
+    the dataset."""
+
+    def __init__(self, source: Iterable, capacity: int = 1024, seed: int = 0):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.source = source
+        self.capacity = int(capacity)
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = int(epoch)
+        if hasattr(self.source, "set_epoch"):
+            self.source.set_epoch(epoch)
+
+    def __iter__(self):
+        from .dataloader import get_worker_info
+
+        info = get_worker_info()
+        wid = info.id if info is not None else 0
+        rng = np.random.RandomState(self.seed + 1000003 * self.epoch + wid)
+        buf = []
+        for sample in self.source:
+            if len(buf) < self.capacity:
+                buf.append(sample)
+                continue
+            j = rng.randint(0, self.capacity)
+            out, buf[j] = buf[j], sample
+            yield out
+        rng.shuffle(buf)
+        yield from buf
+
+
+class InMemoryDataset(IterableDataset):
+    """Materialized dataset with local/global shuffle (data_set.cc
+    InMemoryDataset: LoadIntoMemory -> LocalShuffle/GlobalShuffle ->
+    trainer consumption)."""
+
+    def __init__(self, rank: Optional[int] = None,
+                 world_size: Optional[int] = None, seed: int = 0):
+        if rank is None or world_size is None:
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+            world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self.rank = rank
+        self.world_size = world_size
+        self.seed = seed
+        self._files: List[str] = []
+        self._parser: Optional[Callable] = None
+        self._samples: List = []
+
+    def set_filelist(self, files: Sequence[str]):
+        self._files = [str(f) for f in files]
+
+    def set_parser(self, parser: Callable[[str], Iterable]):
+        self._parser = parser
+
+    def load_into_memory(self):
+        """Parse THIS RANK's file shard into memory (LoadIntoMemory)."""
+        if self._parser is None:
+            raise ValueError("set_parser first")
+        self._samples = []
+        for path in self._files[self.rank::self.world_size]:
+            self._samples.extend(self._parser(path))
+        return len(self._samples)
+
+    def local_shuffle(self, epoch: int = 0):
+        rng = np.random.RandomState(self.seed + epoch + 7919 * self.rank)
+        rng.shuffle(self._samples)
+
+    def global_shuffle(self, epoch: int = 0):
+        """Rank-strided slice of ONE shared permutation over the GLOBAL
+        sample index space — the reference's shuffle-exchange
+        (data_set.cc GlobalShuffle over brpc) without an RPC fabric.
+        Two passes so peak memory stays one RANK SHARD, not the corpus:
+        pass 1 counts samples per file (streaming), then every rank draws
+        the same permutation and keeps global indices r::world; pass 2
+        re-parses only the files holding this rank's indices. Requires
+        every rank to call with the same epoch."""
+        if self._parser is None:
+            raise ValueError("set_parser first")
+        # pass 1: per-file counts, streaming (nothing retained)
+        counts = []
+        for path in self._files:
+            n = 0
+            for _ in self._parser(path):
+                n += 1
+            counts.append(n)
+        total = int(np.sum(counts)) if counts else 0
+        rng = np.random.RandomState(self.seed + epoch)  # SHARED stream
+        order = rng.permutation(total)
+        mine = order[self.rank::self.world_size]
+        # map this rank's global indices to (file, in-file offset)
+        starts = np.concatenate([[0], np.cumsum(counts)])
+        wanted_by_file = {}
+        for pos, gi in enumerate(mine):
+            fi = int(np.searchsorted(starts, gi, side="right")) - 1
+            wanted_by_file.setdefault(fi, []).append((int(gi - starts[fi]), pos))
+        # pass 2: parse only needed files, keep only this rank's samples in
+        # the permuted order
+        self._samples = [None] * len(mine)
+        for fi, offsets in wanted_by_file.items():
+            want = dict(offsets)  # in-file offset -> output position
+            for off, sample in enumerate(self._parser(self._files[fi])):
+                if off in want:
+                    self._samples[want[off]] = sample
+        return len(self._samples)
+
+    def get_memory_data_size(self) -> int:
+        return len(self._samples)
+
+    def __iter__(self):
+        w0, wn = _worker_shard()
+        return iter(self._samples[w0::wn])
+
+    def __len__(self):
+        return len(self._samples)

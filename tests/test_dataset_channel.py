@@ -1,0 +1,172 @@
+"""Dataset-channel feeding engine (VERDICT r3 missing #5; reference
+framework/data_set.cc + data_feed.cc: file-sharded parsing, channel
+shuffle, InMemoryDataset local/global shuffle)."""
+import json
+import os
+
+import numpy as np
+
+from paddle_tpu.io import (
+    DataLoader,
+    FileListDataset,
+    InMemoryDataset,
+    ShuffleChannel,
+)
+
+
+def _write_files(tmp_path, n_files=6, per_file=10):
+    files = []
+    v = 0
+    for i in range(n_files):
+        p = tmp_path / f"part-{i:03d}.jsonl"
+        with open(p, "w") as f:
+            for _ in range(per_file):
+                f.write(json.dumps({"v": v}) + "\n")
+                v += 1
+        files.append(str(p))
+    return files
+
+
+def _parse(path):
+    with open(path) as f:
+        for line in f:
+            yield np.int64(json.loads(line)["v"])
+
+
+def test_file_list_rank_sharding(tmp_path):
+    files = _write_files(tmp_path)
+    seen = []
+    for rank in (0, 1):
+        ds = FileListDataset(files, _parse, rank=rank, world_size=2,
+                             shuffle_files=False)
+        seen.append({int(x) for x in ds})
+    # disjoint file shards covering everything
+    assert not (seen[0] & seen[1])
+    assert seen[0] | seen[1] == set(range(60))
+
+
+def test_file_list_epoch_reshuffle(tmp_path):
+    files = _write_files(tmp_path)
+    ds = FileListDataset(files, _parse, rank=0, world_size=1, seed=3)
+    ds.set_epoch(0)
+    e0 = [int(x) for x in ds]
+    ds.set_epoch(1)
+    e1 = [int(x) for x in ds]
+    assert sorted(e0) == sorted(e1) == list(range(60))
+    assert e0 != e1  # file order reshuffled
+    ds.set_epoch(0)
+    assert [int(x) for x in ds] == e0  # deterministic
+
+
+def _parse_tag_pid(path):
+    for v in _parse(path):
+        yield np.asarray([v, os.getpid()], np.int64)
+
+
+def test_file_list_under_dataloader_workers(tmp_path):
+    """Workers REALLY run in parallel processes, each parsing its own file
+    stride (review r4: iterable multiprocess path must engage)."""
+    files = _write_files(tmp_path)
+    ds = FileListDataset(files, _parse_tag_pid, rank=0, world_size=1,
+                         shuffle_files=False)
+    loader = DataLoader(ds, batch_size=5, num_workers=2)
+    rows = [np.asarray(b) for batch in loader
+            for b in np.asarray(batch[0] if isinstance(batch, (list, tuple))
+                                else batch).reshape(-1, 2)]
+    vals = sorted(int(r[0]) for r in rows)
+    pids = {int(r[1]) for r in rows}
+    assert vals == list(range(60))
+    assert os.getpid() not in pids, "parsing must happen in worker procs"
+    assert len(pids) == 2, "both workers must contribute"
+
+
+def test_world_size_exceeding_files_raises(tmp_path):
+    files = _write_files(tmp_path, n_files=2)
+    import pytest
+    with pytest.raises(ValueError, match="exceeds the file count"):
+        FileListDataset(files, _parse, rank=0, world_size=3)
+
+
+def test_shuffle_channel_streaming(tmp_path):
+    files = _write_files(tmp_path)
+    base = FileListDataset(files, _parse, rank=0, world_size=1,
+                           shuffle_files=False)
+    ch = ShuffleChannel(base, capacity=16, seed=1)
+    out = [int(x) for x in ch]
+    assert sorted(out) == list(range(60))
+    assert out != list(range(60))  # actually shuffled
+    # bounded displacement beyond the reservoir is not required, but
+    # determinism per (seed, epoch) is
+    assert [int(x) for x in ShuffleChannel(base, capacity=16, seed=1)] == out
+    ch.set_epoch(1)
+    assert [int(x) for x in ch] != out
+
+
+def test_in_memory_dataset_local_and_global(tmp_path):
+    files = _write_files(tmp_path)
+    # two ranks load disjoint shards
+    sizes = []
+    rank_data = []
+    for rank in (0, 1):
+        ds = InMemoryDataset(rank=rank, world_size=2, seed=5)
+        ds.set_filelist(files)
+        ds.set_parser(_parse)
+        n = ds.load_into_memory()
+        sizes.append(n)
+        rank_data.append({int(x) for x in ds})
+    assert sum(sizes) == 60 and not (rank_data[0] & rank_data[1])
+
+    # local shuffle permutes in place
+    ds = InMemoryDataset(rank=0, world_size=1, seed=5)
+    ds.set_filelist(files)
+    ds.set_parser(_parse)
+    ds.load_into_memory()
+    before = [int(x) for x in ds]
+    ds.local_shuffle(epoch=0)
+    after = [int(x) for x in ds]
+    assert sorted(after) == sorted(before) and after != before
+
+    # global shuffle: both ranks draw ONE shared permutation, strided
+    g = []
+    for rank in (0, 1):
+        ds = InMemoryDataset(rank=rank, world_size=2, seed=9)
+        ds.set_filelist(files)
+        ds.set_parser(_parse)
+        ds.global_shuffle(epoch=2)
+        g.append([int(x) for x in ds])
+    assert not (set(g[0]) & set(g[1]))
+    assert set(g[0]) | set(g[1]) == set(range(60))
+    # shard sizes even to within one
+    assert abs(len(g[0]) - len(g[1])) <= 1
+
+
+def test_channel_pipeline_feeds_training(tmp_path):
+    """End-to-end: file shards -> shuffle channel -> DataLoader -> a tiny
+    jitted train step consumes batches."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.optimizer.optimizers import Adam
+
+    files = _write_files(tmp_path, n_files=4, per_file=8)
+
+    def parse_xy(path):
+        for v in _parse(path):
+            x = np.asarray([v % 7, (v * 3) % 5], np.float32)
+            yield x, np.float32(x.sum())
+
+    ds = ShuffleChannel(
+        FileListDataset(files, parse_xy, rank=0, world_size=1, seed=2),
+        capacity=8, seed=2)
+    loader = DataLoader(ds, batch_size=8, num_workers=0)
+    paddle.seed(0)
+    net = nn.Linear(2, 1)
+    opt = Adam(learning_rate=0.1, parameters=net.parameters())
+    losses = []
+    for _epoch in range(6):
+        for xb, yb in loader:
+            loss = ((net(xb)[:, 0] - yb) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+    assert losses[-1] < losses[0]
