@@ -113,17 +113,18 @@ def _iterable_worker_loop(dataset, out_queue, collate_fn, wid, num_workers,
         except Exception:
             ring = None
 
-    def emit(bid, batch):
+    def emit(batch):
         # ring payloads are (bid, batch) 2-tuples (what _recv_batch decodes)
         if ring is not None:
-            payload = pickle.dumps((bid, batch), protocol=4)
+            payload = pickle.dumps((wid, batch), protocol=4)
             try:
                 ring.write(payload)
                 return
             except ValueError:  # oversize → pipe path
                 pass
-        out_queue.put((bid, batch, None))
+        out_queue.put((wid, batch, None))
 
+    sent = 0
     try:
         it = iter(dataset)
         while True:
@@ -132,10 +133,14 @@ def _iterable_worker_loop(dataset, out_queue, collate_fn, wid, num_workers,
                 break
             if len(chunk) < batch_size and drop_last:
                 break
-            emit(0, collate_fn(chunk))
+            emit(collate_fn(chunk))
+            sent += 1
     except Exception as e:  # propagate worker errors
-        out_queue.put((0, None, e))
-    emit(-1, None)  # EOF rides the same FIFO as this worker's batches
+        out_queue.put((wid, None, e))
+    # EOF goes through the PIPE and carries the batch count: the parent
+    # keeps draining (either channel) until every worker's count is met, so
+    # ring-vs-pipe ordering races cannot drop trailing batches
+    out_queue.put((-1, (wid, sent), None))
     if ring is not None:
         ring.destroy()
 
@@ -216,18 +221,7 @@ class DataLoader:
         # mp.Queue pipe + feeder thread (parity role: mmap_allocator.cc shm
         # path of the reference DataLoader). Oversized batches overflow to
         # the mp.Queue, so both channels are drained below.
-        ring = None
-        ring_name = None
-        if self.use_shared_memory:
-            try:
-                from ..core import ShmRing
-
-                ring_name = f"/pt_dl_{os.getpid()}_{next(_ring_counter)}"
-                ring = ShmRing(ring_name,
-                               slot_size=self._shm_slot_size,
-                               nslots=max(4, self.num_workers * self.prefetch_factor))
-            except Exception:
-                ring, ring_name = None, None
+        ring, ring_name = self._make_ring()
         workers = [
             ctx.Process(
                 target=_worker_loop,
@@ -262,12 +256,31 @@ class DataLoader:
         finally:
             for _ in workers:
                 index_queue.put(None)
-            for w in workers:
-                w.join(timeout=1)
-                if w.is_alive():
-                    w.terminate()
-            if ring is not None:
-                ring.destroy()
+            self._shutdown_workers(workers, ring)
+
+    def _make_ring(self):
+        """(ring, ring_name) for the shm transport, or (None, None)."""
+        if not self.use_shared_memory:
+            return None, None
+        try:
+            from ..core import ShmRing
+
+            ring_name = f"/pt_dl_{os.getpid()}_{next(_ring_counter)}"
+            ring = ShmRing(ring_name,
+                           slot_size=self._shm_slot_size,
+                           nslots=max(4, self.num_workers * self.prefetch_factor))
+            return ring, ring_name
+        except Exception:
+            return None, None
+
+    @staticmethod
+    def _shutdown_workers(workers, ring):
+        for w in workers:
+            w.join(timeout=1)
+            if w.is_alive():
+                w.terminate()
+        if ring is not None:
+            ring.destroy()
 
     def _batches_multiprocess_iterable(self):
         """Parallel IterableDataset consumption (data_feed.cc per-thread
@@ -277,18 +290,7 @@ class DataLoader:
         ctx = mp.get_context("fork")
         out_queue = ctx.Queue()
         seed = np.random.randint(0, 2**31 - 1)
-        ring = None
-        ring_name = None
-        if self.use_shared_memory:
-            try:
-                from ..core import ShmRing
-
-                ring_name = f"/pt_dl_{os.getpid()}_{next(_ring_counter)}"
-                ring = ShmRing(ring_name,
-                               slot_size=self._shm_slot_size,
-                               nslots=max(4, self.num_workers * self.prefetch_factor))
-            except Exception:
-                ring, ring_name = None, None
+        ring, ring_name = self._make_ring()
         workers = [
             ctx.Process(
                 target=_iterable_worker_loop,
@@ -301,23 +303,59 @@ class DataLoader:
         ]
         for w in workers:
             w.start()
-        done = 0
+        expected = {}   # wid -> batch count (from EOF sentinels)
+        received = {w: 0 for w in range(self.num_workers)}
         try:
-            while done < self.num_workers:
-                bid, data, err = self._recv_batch(ring, out_queue)
+            while True:
+                if (len(expected) == self.num_workers
+                        and all(received[w] >= n for w, n in expected.items())):
+                    break
+                item = self._recv_batch_poll(ring, out_queue, workers,
+                                             expected)
+                bid, data, err = item
                 if err is not None:
                     raise err
                 if bid == -1:
-                    done += 1
+                    wid, count = data
+                    expected[wid] = count
                     continue
+                received[bid] += 1
                 yield data
         finally:
-            for w in workers:
-                w.join(timeout=1)
-                if w.is_alive():
-                    w.terminate()
+            self._shutdown_workers(workers, ring)
+
+    def _recv_batch_poll(self, ring, out_queue, workers, expected):
+        """_recv_batch with a liveness check: a worker that dies without
+        its EOF sentinel (OOM-kill, segfaulting parser) must raise instead
+        of hanging the feed loop forever. A dead worker gets one extra
+        grace cycle so a sentinel still in the pipe's feeder buffer can
+        drain before we declare it lost."""
+        waited = 0.0
+        suspects = set()
+        while True:
+            try:
+                return out_queue.get(timeout=0.05 if ring is None else 0.001)
+            except queue_mod.Empty:
+                pass
             if ring is not None:
-                ring.destroy()
+                payload = ring.read(timeout_ms=50)
+                if payload is not None:
+                    bid, data = pickle.loads(payload)
+                    return bid, data, None
+            waited += 0.05
+            if waited >= 1.0 and waited % 1.0 < 0.05:
+                for wid, w in enumerate(workers):
+                    if w.is_alive() or wid in expected:
+                        continue
+                    if wid in suspects:
+                        raise RuntimeError(
+                            f"DataLoader worker {wid} (pid={w.pid}) died "
+                            f"with exit code {w.exitcode} before finishing "
+                            "its shard")
+                    suspects.add(wid)
+            if self.timeout and waited >= self.timeout:
+                raise TimeoutError(
+                    f"DataLoader worker timed out after {self.timeout}s")
 
     _shm_slot_size = 16 << 20
 

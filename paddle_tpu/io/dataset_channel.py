@@ -37,6 +37,20 @@ from .dataset import IterableDataset
 __all__ = ["FileListDataset", "ShuffleChannel", "InMemoryDataset"]
 
 
+def _resolve_rank_world(rank: Optional[int], world_size: Optional[int]):
+    """Default BOTH from the launcher env, or take BOTH explicitly —
+    passing exactly one is a silent-wrong-shard hazard and raises."""
+    if (rank is None) != (world_size is None):
+        raise ValueError(
+            "pass both rank and world_size, or neither (env defaults "
+            "PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM apply only when both "
+            "are omitted)")
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    return int(rank), int(world_size)
+
+
 def _worker_shard():
     """(start, step) for this dataloader worker (composes with dist rank
     sharding done by the caller)."""
@@ -72,16 +86,14 @@ class FileListDataset(IterableDataset):
         self.files = [str(f) for f in files]
         if not self.files:
             raise ValueError("FileListDataset needs at least one file")
-        if world_size is not None and world_size > len(self.files):
+        self.parser = parser
+        rank, world_size = _resolve_rank_world(rank, world_size)
+        if world_size > len(self.files):
             raise ValueError(
                 f"world_size ({world_size}) exceeds the file count "
                 f"({len(self.files)}): some ranks would get NO data and "
                 "lockstep training would hang — split the input into at "
                 "least one file per rank")
-        self.parser = parser
-        if rank is None or world_size is None:
-            rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
-            world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
         self.rank = rank
         self.world_size = world_size
         self.shuffle_files = shuffle_files
@@ -150,11 +162,7 @@ class InMemoryDataset(IterableDataset):
 
     def __init__(self, rank: Optional[int] = None,
                  world_size: Optional[int] = None, seed: int = 0):
-        if rank is None or world_size is None:
-            rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
-            world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
-        self.rank = rank
-        self.world_size = world_size
+        self.rank, self.world_size = _resolve_rank_world(rank, world_size)
         self.seed = seed
         self._files: List[str] = []
         self._parser: Optional[Callable] = None

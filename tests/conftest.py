@@ -50,6 +50,7 @@ _SLOW_FILES = {
     "test_text_ops.py",
     "test_nn_layers.py",
     "test_fft_signal.py",
+    "test_inference_generation.py",  # StableHLO export round-trips
 }
 
 

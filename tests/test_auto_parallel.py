@@ -11,6 +11,14 @@ import paddle_tpu as paddle
 from paddle_tpu.distributed.auto_parallel import Engine, ProcessMesh, shard_op, shard_tensor
 
 
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    yield
+    from paddle_tpu.distributed.env import clear_mesh
+
+    clear_mesh()
+
+
 def test_process_mesh_shape_and_names():
     pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
     assert pm.shape == [2, 4] and pm.ndim == 2

@@ -170,3 +170,34 @@ def test_channel_pipeline_feeds_training(tmp_path):
             opt.clear_grad()
             losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def _killer_parse(path):
+    # worker 1 dies mid-parse without an EOF sentinel (simulated OOM-kill)
+    from paddle_tpu.io import get_worker_info
+
+    info = get_worker_info()
+    if info is not None and info.id == 1:
+        os._exit(17)
+    yield from _parse(path)
+
+
+def test_dead_worker_raises_instead_of_hanging(tmp_path):
+    import pytest
+
+    files = _write_files(tmp_path, n_files=4)
+    ds = FileListDataset(files, _killer_parse, rank=0, world_size=1,
+                         shuffle_files=False)
+    loader = DataLoader(ds, batch_size=5, num_workers=2)
+    with pytest.raises(RuntimeError, match="died with exit code 17"):
+        list(loader)
+
+
+def test_rank_without_world_size_raises(tmp_path):
+    import pytest
+
+    files = _write_files(tmp_path, n_files=2)
+    with pytest.raises(ValueError, match="both rank and world_size"):
+        FileListDataset(files, _parse, rank=1)
+    with pytest.raises(ValueError, match="both rank and world_size"):
+        InMemoryDataset(world_size=2)
