@@ -13,7 +13,7 @@ import abc
 import numpy as np
 
 __all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc",
-           "accuracy", "mean_iou", "chunk_eval"]
+           "accuracy", "mean_iou", "chunk_eval", "DetectionMAP"]
 
 
 def _to_np(x):
@@ -329,3 +329,150 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,  # noqa: A002
             wrap(jnp.float32(f1)),
             wrap(jnp.int64(n_inf)), wrap(jnp.int64(n_lab)),
             wrap(jnp.int64(n_cor)))
+
+
+class DetectionMAP(Metric):
+    """Detection mean average precision (parity: detection_map_op.h —
+    CalcTrueAndFalsePositive greedy per-class matching with visited flags
+    and difficult handling, CalcMAP '11point' VOC2007 / 'integral' AP).
+    Host-side metric like the reference's CPU-only kernel.
+
+    update() takes the framework's dense+lengths detection convention:
+    detections [D, 6] rows (label, score, x1, y1, x2, y2) + per-image
+    det_counts [N]; ground truth gt [G, 5] rows (label, x1, y1, x2, y2)
+    + gt_counts [N]; optional difficult [G] flags."""
+
+    def __init__(self, overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_type="integral", background_label=0, name=None):
+        assert ap_type in ("integral", "11point")
+        self.overlap_threshold = float(overlap_threshold)
+        self.evaluate_difficult = bool(evaluate_difficult)
+        self.ap_type = ap_type
+        self.background_label = int(background_label)
+        self._name = name or "detection_map"
+        self.reset()
+
+    def reset(self):
+        self._label_pos = {}
+        self._tp = {}   # label -> list[(score, 0/1)]
+        self._fp = {}
+
+    @staticmethod
+    def _iou(a, b):
+        if b[0] > a[2] or b[2] < a[0] or b[1] > a[3] or b[3] < a[1]:
+            return 0.0
+        ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+        ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+        inter = (ix2 - ix1) * (iy2 - iy1)
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, detections, det_counts, gt, gt_counts, difficult=None):
+        from ..ops._primitive import unwrap
+
+        det = np.asarray(unwrap(detections), np.float64)
+        dc = np.asarray(unwrap(det_counts), np.int64).reshape(-1)
+        gtb = np.asarray(unwrap(gt), np.float64)
+        gc = np.asarray(unwrap(gt_counts), np.int64).reshape(-1)
+        if len(dc) != len(gc):
+            raise ValueError(
+                f"det_counts describes {len(dc)} images but gt_counts "
+                f"{len(gc)} (reference detection_map enforces equal batch "
+                "sizes)")
+        if int(dc.sum()) != len(det) or int(gc.sum()) != len(gtb):
+            raise ValueError("counts must sum to the provided row totals")
+        diff = (np.zeros(len(gtb), bool) if difficult is None
+                else np.asarray(unwrap(difficult)).astype(bool).reshape(-1))
+        d_off = g_off = 0
+        for n in range(len(dc)):
+            drows = det[d_off: d_off + int(dc[n])]
+            grows = gtb[g_off: g_off + int(gc[n])]
+            gdiff = diff[g_off: g_off + int(gc[n])]
+            d_off += int(dc[n])
+            g_off += int(gc[n])
+            # per-label positives count (difficult excluded unless evaluated)
+            img_gt = {}
+            for gi, row in enumerate(grows):
+                img_gt.setdefault(int(row[0]), []).append(
+                    (row[1:5], bool(gdiff[gi])))
+            for label, boxes in img_gt.items():
+                cnt = (len(boxes) if self.evaluate_difficult
+                       else sum(1 for _, d in boxes if not d))
+                if cnt:
+                    self._label_pos[label] = self._label_pos.get(label, 0) + cnt
+            # greedy matching per label, score-descending, visited flags
+            by_label = {}
+            for row in drows:
+                by_label.setdefault(int(row[0]), []).append(row)
+            for label, preds in by_label.items():
+                gts = img_gt.get(label)
+                tp = self._tp.setdefault(label, [])
+                fp = self._fp.setdefault(label, [])
+                if not gts:
+                    for row in preds:
+                        tp.append((float(row[1]), 0))
+                        fp.append((float(row[1]), 1))
+                    continue
+                visited = [False] * len(gts)
+                preds = sorted(preds, key=lambda r: -r[1])
+                for row in preds:
+                    box = np.clip(row[2:6], 0.0, 1.0)
+                    score = float(row[1])
+                    best, best_j = -1.0, 0
+                    for j, (gbox, _) in enumerate(gts):
+                        ov = self._iou(box, gbox)
+                        if ov > best:
+                            best, best_j = ov, j
+                    if best > self.overlap_threshold:
+                        if self.evaluate_difficult or not gts[best_j][1]:
+                            if not visited[best_j]:
+                                tp.append((score, 1))
+                                fp.append((score, 0))
+                                visited[best_j] = True
+                            else:
+                                tp.append((score, 0))
+                                fp.append((score, 1))
+                    else:
+                        tp.append((score, 0))
+                        fp.append((score, 1))
+
+    def accumulate(self):
+        m_ap, count = 0.0, 0
+        for label, npos in self._label_pos.items():
+            if npos == self.background_label:
+                continue
+            if label not in self._tp:
+                count += 1
+                continue
+            tp = sorted(self._tp[label], key=lambda p: -p[0])
+            fp = sorted(self._fp[label], key=lambda p: -p[0])
+            tp_sum = np.cumsum([f for _, f in tp])
+            fp_sum = np.cumsum([f for _, f in fp])
+            precision = tp_sum / np.maximum(tp_sum + fp_sum, 1e-12)
+            recall = tp_sum / npos
+            if self.ap_type == "11point":
+                maxp = np.zeros(11)
+                start = len(recall) - 1
+                for j in range(10, -1, -1):
+                    for i in range(start, -1, -1):
+                        if recall[i] < j / 10.0:
+                            start = i
+                            if j > 0:
+                                maxp[j - 1] = maxp[j]
+                            break
+                        if maxp[j] < precision[i]:
+                            maxp[j] = precision[i]
+                m_ap += maxp.sum() / 11
+            else:
+                ap, prev = 0.0, 0.0
+                for p, r in zip(precision, recall):
+                    if abs(r - prev) > 1e-6:
+                        ap += p * abs(r - prev)
+                    prev = r
+                m_ap += ap
+            count += 1
+        return m_ap / count if count else 0.0
+
+    def name(self):
+        return self._name

@@ -213,3 +213,58 @@ def test_chunk_eval_seq_length_and_excluded():
         pred, lab, "IOB", num_chunk_types=2, seq_length=np.array([2, 1]),
         excluded_chunk_types=[0])
     assert int(_np(ni2)) == 0 and float(_np(f2)) == 0.0
+
+
+def test_detection_map_integral_and_11point():
+    """mAP parity with a hand-computed VOC-style case
+    (detection_map_op.h CalcTrueAndFalsePositive + CalcMAP)."""
+    from paddle_tpu.metric import DetectionMAP
+
+    # one image, one class (label 1): 2 gt boxes, 3 detections
+    gt = np.array([[1, 0.1, 0.1, 0.4, 0.4],
+                   [1, 0.6, 0.6, 0.9, 0.9]], np.float32)
+    det = np.array([
+        [1, 0.9, 0.1, 0.1, 0.4, 0.4],   # TP (matches gt0)
+        [1, 0.8, 0.62, 0.62, 0.9, 0.9],  # TP (matches gt1)
+        [1, 0.7, 0.0, 0.0, 0.05, 0.05],  # FP
+    ], np.float32)
+    m = DetectionMAP(overlap_threshold=0.5, ap_type="integral")
+    m.update(det, np.array([3]), gt, np.array([2]))
+    # precision at ranks: 1/1, 2/2, 2/3; recall: .5, 1.0, 1.0
+    # integral AP = 1*0.5 + 1*0.5 = 1.0
+    np.testing.assert_allclose(m.accumulate(), 1.0, atol=1e-6)
+
+    # duplicate match on the same gt counts as FP (visited flag)
+    det2 = np.array([
+        [1, 0.9, 0.1, 0.1, 0.4, 0.4],
+        [1, 0.8, 0.11, 0.11, 0.4, 0.4],  # second hit on gt0 -> FP
+    ], np.float32)
+    m2 = DetectionMAP(overlap_threshold=0.5, ap_type="integral")
+    m2.update(det2, np.array([2]), gt, np.array([2]))
+    # ranks: p=1/1 r=.5; p=1/2 r=.5 -> AP = 0.5
+    np.testing.assert_allclose(m2.accumulate(), 0.5, atol=1e-6)
+
+    # 11point on the first case: recall thresholds 0..0.5 see p=1,
+    # 0.6..1.0 see max precision 1.0 (rank2 TP) -> all 11 points get 1.0
+    m3 = DetectionMAP(overlap_threshold=0.5, ap_type="11point")
+    m3.update(det, np.array([3]), gt, np.array([2]))
+    np.testing.assert_allclose(m3.accumulate(), 1.0, atol=1e-3)
+
+
+def test_detection_map_difficult_and_accumulate():
+    from paddle_tpu.metric import DetectionMAP
+
+    gt = np.array([[1, 0.1, 0.1, 0.4, 0.4],
+                   [1, 0.6, 0.6, 0.9, 0.9]], np.float32)
+    difficult = np.array([0, 1])
+    det = np.array([[1, 0.9, 0.1, 0.1, 0.4, 0.4]], np.float32)
+    m = DetectionMAP(overlap_threshold=0.5, evaluate_difficult=False)
+    m.update(det, np.array([1]), gt, np.array([2]), difficult=difficult)
+    # difficult gt excluded: npos=1, one TP -> AP 1.0
+    np.testing.assert_allclose(m.accumulate(), 1.0, atol=1e-6)
+    # accumulation across batches: a second image with a miss halves recall
+    m.update(np.zeros((0, 6), np.float32), np.array([0]),
+             np.array([[1, 0.2, 0.2, 0.5, 0.5]], np.float32), np.array([1]))
+    assert m.accumulate() < 1.0
+    m.reset()
+    assert m.accumulate() == 0.0
