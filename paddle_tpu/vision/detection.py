@@ -47,6 +47,7 @@ __all__ = [
     "generate_proposals",
     "generate_proposals_v2",
     "retinanet_detection_output",
+    "rpn_target_assign",
     "distribute_fpn_proposals",
     "collect_fpn_proposals",
     "polygon_box_transform",
@@ -939,6 +940,120 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
         return jnp.concatenate(outs, axis=0), jnp.stack(cnts)
 
     return _rdo(im, *bb, *sc)
+
+
+def rpn_target_assign(anchors, gt_boxes, im_info, gt_counts=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True, name=None):
+    """RPN training targets (rpn_target_assign_op.cc ScoreAssign +
+    SampleFgBgGt): per image, anchors inside the image (straddle filter)
+    are labeled fg when they are a gt's argmax anchor or IoU >=
+    positive_overlap, bg when max-IoU < negative_overlap; fg is
+    reservoir-subsampled to fg_fraction*batch and bg to the remainder.
+    Host op (CPU-only in the reference too) on the framework PRNG.
+
+    Deviation noted for parity readers: the reference's Detectron
+    "fake fg" bookkeeping (its own code comments it as a bug) is replaced
+    by the standard degenerate-case handling — images with no fg anchor
+    contribute one zero-inside-weight placeholder so downstream shapes
+    stay non-empty.
+
+    Returns per-image lists of dicts with loc_index, score_index,
+    tgt_label, tgt_bbox (encoded deltas), bbox_inside_weight arrays."""
+    from ..random import split_key
+
+    an = np.asarray(_arr(anchors), np.float64).reshape(-1, 4)
+    gtb = np.asarray(_arr(gt_boxes), np.float64).reshape(-1, 4)
+    im = np.asarray(_arr(im_info), np.float64).reshape(-1, 3)
+    if gt_counts is None:
+        gcs = np.asarray([len(gtb)], np.int64)
+    else:
+        gcs = np.asarray(_arr(gt_counts), np.int64).reshape(-1)
+    rng = np.random.default_rng(
+        np.asarray(jax.random.key_data(split_key())).ravel()[-1])
+    out = []
+    g_off = 0
+    for n in range(len(gcs)):
+        gt = gtb[g_off: g_off + int(gcs[n])]
+        g_off += int(gcs[n])
+        h, w = im[n, 0], im[n, 1]
+        if rpn_straddle_thresh >= 0:
+            keep = np.where(
+                (an[:, 0] >= -rpn_straddle_thresh)
+                & (an[:, 1] >= -rpn_straddle_thresh)
+                & (an[:, 2] < w + rpn_straddle_thresh)
+                & (an[:, 3] < h + rpn_straddle_thresh))[0]
+        else:
+            keep = np.arange(len(an))
+        a = an[keep]
+        if len(a) == 0:  # every anchor straddles: nothing to assign
+            out.append({
+                "loc_index": np.zeros(0, np.int64),
+                "score_index": np.zeros(0, np.int64),
+                "tgt_label": np.zeros(0, np.int32),
+                "tgt_bbox": np.zeros((0, 4), np.float32),
+                "bbox_inside_weight": np.zeros((0, 4), np.float32),
+            })
+            continue
+        iou = np.asarray(_pairwise_iou(
+            jnp.asarray(a, jnp.float32), jnp.asarray(gt, jnp.float32), False))
+        a2g_max = iou.max(axis=1) if len(gt) else np.zeros(len(a))
+        a2g_arg = iou.argmax(axis=1) if len(gt) else np.zeros(len(a), int)
+        g2a_max = iou.max(axis=0) if len(gt) else np.zeros(0)
+        is_best = np.zeros(len(a), bool)
+        for j in range(len(gt)):
+            if g2a_max[j] > 0:  # a gt overlapping nothing marks no anchor
+                is_best |= np.abs(iou[:, j] - g2a_max[j]) < 1e-5
+        fg_mask = is_best | (a2g_max >= rpn_positive_overlap)
+        fg_inds = np.where(fg_mask)[0]
+        n_fg = int(rpn_fg_fraction * rpn_batch_size_per_im)
+        if len(fg_inds) > n_fg:  # cap applies in both sampling modes
+            fg_inds = (rng.choice(fg_inds, n_fg, replace=False)
+                       if use_random else fg_inds[:n_fg])
+        bg_inds = np.where((a2g_max < rpn_negative_overlap) & ~fg_mask)[0]
+        n_bg = rpn_batch_size_per_im - len(fg_inds)
+        if len(bg_inds) > n_bg:
+            bg_inds = (rng.choice(bg_inds, n_bg, replace=False)
+                       if use_random else bg_inds[:n_bg])
+        inside_w = np.ones((len(fg_inds), 4), np.float32)
+        if len(fg_inds) == 0 and len(bg_inds) > 0:
+            # degenerate image: borrow one bg anchor as a zero-loss-weight
+            # fg placeholder (and REMOVE it from bg so score_index stays
+            # duplicate-free and within the batch budget)
+            fg_inds = bg_inds[:1]
+            bg_inds = bg_inds[1:]
+            inside_w = np.zeros((1, 4), np.float32)
+        # encoded regression targets for the fg anchors
+        if len(gt) and len(fg_inds):
+            ga = gt[a2g_arg[fg_inds]]
+            aa = a[fg_inds]
+            aw = aa[:, 2] - aa[:, 0] + 1.0
+            ah = aa[:, 3] - aa[:, 1] + 1.0
+            acx = aa[:, 0] + 0.5 * aw
+            acy = aa[:, 1] + 0.5 * ah
+            gw = ga[:, 2] - ga[:, 0] + 1.0
+            gh = ga[:, 3] - ga[:, 1] + 1.0
+            gcx = ga[:, 0] + 0.5 * gw
+            gcy = ga[:, 1] + 0.5 * gh
+            tgt_bbox = np.stack([
+                (gcx - acx) / aw, (gcy - acy) / ah,
+                np.log(gw / aw), np.log(gh / ah)], axis=1).astype(np.float32)
+        else:
+            tgt_bbox = np.zeros((len(fg_inds), 4), np.float32)
+        score_index = np.concatenate([fg_inds, bg_inds]).astype(np.int64)
+        labels = np.concatenate([
+            np.ones(len(fg_inds), np.int32) * (0 if inside_w.sum() == 0
+                                               else 1),
+            np.zeros(len(bg_inds), np.int32)])
+        out.append({
+            "loc_index": keep[fg_inds].astype(np.int64),
+            "score_index": keep[score_index],
+            "tgt_label": labels,
+            "tgt_bbox": tgt_bbox,
+            "bbox_inside_weight": inside_w,
+        })
+    return out
 
 
 def polygon_box_transform(input, name=None):  # noqa: A002

@@ -574,3 +574,61 @@ def test_mine_hard_examples():
     assert n_neg[0] == 2 and set(np.where(sel[0])[0]) == {2, 4}
     # image 1: 2 positives → 4 negatives but only 3 unmatched exist
     assert n_neg[1] == 3 and set(np.where(sel[1])[0]) == {0, 2, 4}
+
+
+def test_rpn_target_assign():
+    """RPN fg/bg assignment + encoded targets (rpn_target_assign_op.cc
+    semantics: argmax-per-gt anchors are fg even below the threshold;
+    straddling anchors excluded; deterministic under paddle.seed)."""
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    anchors = np.array([
+        [0, 0, 15, 15],      # IoU-matched to gt0
+        [0, 0, 31, 31],      # partial overlap (argmax for gt0? no)
+        [40, 40, 55, 55],    # far: bg
+        [-20, -20, 5, 5],    # straddles: excluded
+    ], np.float32)
+    gt = np.array([[0, 0, 15, 15]], np.float32)
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    (res,) = D.rpn_target_assign(anchors, gt, im_info,
+                                 rpn_batch_size_per_im=4,
+                                 rpn_positive_overlap=0.7,
+                                 rpn_negative_overlap=0.3,
+                                 use_random=False)
+    assert 0 in res["loc_index"]          # exact-match anchor is fg
+    assert 3 not in res["score_index"]    # straddler excluded
+    assert 2 in res["score_index"]        # far anchor sampled as bg
+    fg_pos = list(res["score_index"]).index(0)
+    assert res["tgt_label"][fg_pos] == 1
+    # exact match → zero deltas
+    np.testing.assert_allclose(res["tgt_bbox"][0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(res["bbox_inside_weight"], 1.0)
+
+    # degenerate: no positive anchors → one zero-weight placeholder
+    gt_far = np.array([[60, 60, 63, 63]], np.float32)
+    (res2,) = D.rpn_target_assign(anchors[:3], gt_far, im_info,
+                                  rpn_batch_size_per_im=4, use_random=False)
+    assert res2["bbox_inside_weight"].sum() == 0.0
+
+
+def test_rpn_target_assign_edge_cases():
+    """Review r4: all-straddling images return empty targets; the
+    degenerate placeholder is removed from bg (no duplicate score_index)."""
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    im_info = np.array([[8, 8, 1.0]], np.float32)
+    big = np.array([[-10, -10, 30, 30]], np.float32)  # always straddles
+    gt = np.array([[0, 0, 5, 5]], np.float32)
+    (res,) = D.rpn_target_assign(big, gt, im_info, use_random=False)
+    assert len(res["score_index"]) == 0 and len(res["loc_index"]) == 0
+
+    anchors = np.array([[0, 0, 3, 3], [4, 4, 7, 7]], np.float32)
+    gt_far = np.zeros((0, 4), np.float32)
+    (res2,) = D.rpn_target_assign(anchors, gt_far, im_info,
+                                  gt_counts=np.array([0]),
+                                  rpn_batch_size_per_im=4, use_random=False)
+    si = list(res2["score_index"])
+    assert len(si) == len(set(si)), "no duplicate anchors in score_index"
+    assert res2["bbox_inside_weight"].sum() == 0.0
