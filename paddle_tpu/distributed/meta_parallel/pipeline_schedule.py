@@ -78,6 +78,16 @@ __all__ = [
 ]
 
 PP_AXIS = "pp"
+
+
+def _remat_jax_policy(remat_policy: str):
+    """Map a schedule remat_policy name to a jax.checkpoint policy — the
+    shared table in ops/pallas/flash_attention.py, where 'selective'
+    additionally saves the flash forward via its checkpoint_name tags so
+    the backward never replays the Pallas kernel."""
+    from ...ops.pallas.flash_attention import granularity_policy
+
+    return granularity_policy(remat_policy)
 DP_AXIS = "dp"
 SH_AXIS = "sharding"
 EP_AXIS = "ep"
@@ -162,10 +172,12 @@ class PipelineModule:
         self._training = training
         self._aux_of = aux_of
         self._aux_weight = aux_weight
-        if remat_policy not in ("full", "selective", "none"):
+        if remat_policy not in ("full", "selective", "core_attn", "none"):
             raise ValueError("remat_policy must be 'full' (recompute each "
                              "layer, min memory), 'selective' (keep "
-                             "weight-matmul outputs, fewer recompute flops) "
+                             "weight-matmul and flash-attention outputs, "
+                             "fewer recompute flops), 'core_attn' (keep only "
+                             "flash-attention outputs) "
                              "or 'none' (save everything, max speed)")
         self._remat_policy = remat_policy
         self._scan_unroll = max(int(scan_unroll), 1)
@@ -410,8 +422,7 @@ class PipelineModule:
         n = self.num_stages
         layer_base = (c * n + s_idx) * kv  # global index of the chunk's 1st layer
 
-        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                  if self._remat_policy == "selective" else None)
+        policy = _remat_jax_policy(self._remat_policy)
 
         def run_layer(tmpl, lp, h, lk, prefix=""):
             # per-layer remat: without it the tick backward materializes
@@ -562,8 +573,7 @@ class PipelineModule:
         scheduled path exactly (per-(microbatch, layer) keys), so dropout
         masks are identical to a pp>1 run of the same program."""
         kv = self.layers_per_chunk
-        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                  if self._remat_policy == "selective" else None)
+        policy = _remat_jax_policy(self._remat_policy)
 
         def run_layer(tmpl, lp, h, lk, prefix=""):
             def _one(lp, h, lk):

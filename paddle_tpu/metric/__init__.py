@@ -13,7 +13,8 @@ import abc
 import numpy as np
 
 __all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc",
-           "accuracy", "mean_iou", "chunk_eval", "DetectionMAP"]
+           "accuracy", "mean_iou", "chunk_eval", "DetectionMAP",
+           "precision_recall", "positive_negative_pair"]
 
 
 def _to_np(x):
@@ -476,3 +477,99 @@ class DetectionMAP(Metric):
 
     def name(self):
         return self._name
+
+
+def precision_recall(max_probs, indices, labels, class_number, weights=None,
+                     states_info=None, name=None):
+    """Static precision_recall op (reference:
+    operators/metrics/precision_recall_op.h PrecisionRecallKernel): per-class
+    TP/FP/TN/FN state accumulation over top-1 predictions plus macro/micro
+    metrics. Returns (batch_metrics [6], accum_metrics [6],
+    accum_states [class_number, 4]) where the 6 metrics are
+    [macro-P, macro-R, macro-F1, micro-P, micro-R, micro-F1] and state
+    columns are [TP, FP, TN, FN]. ``max_probs`` is accepted for op-signature
+    parity and unused by the math (the reference kernel reads Indices only).
+    """
+    idx = _to_np(indices).reshape(-1).astype(np.int64)
+    lab = _to_np(labels).reshape(-1).astype(np.int64)
+    c = int(class_number)
+    w = (_to_np(weights).reshape(-1).astype(np.float64)
+         if weights is not None else np.ones(idx.shape[0]))
+    if idx.size and (idx.min() < 0 or idx.max() >= c):
+        raise ValueError("precision_recall: class index out of range")
+    if lab.size and (lab.min() < 0 or lab.max() >= c):
+        raise ValueError("precision_recall: label out of range")
+
+    states = np.zeros((c, 4), np.float64)  # TP FP TN FN
+    hit = idx == lab
+    np.add.at(states[:, 0], idx[hit], w[hit])                  # TP
+    np.add.at(states[:, 1], idx[~hit], w[~hit])                # FP
+    np.add.at(states[:, 3], lab[~hit], w[~hit])                # FN
+    # TN: every sample adds w to all classes except its idx (and its label
+    # when mispredicted)
+    states[:, 2] = w.sum()
+    np.subtract.at(states[:, 2], idx, w)
+    np.subtract.at(states[:, 2], lab[~hit], w[~hit])
+
+    def metrics(st):
+        tp, fp, fn = st[:, 0], st[:, 1], st[:, 3]
+        prec = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1e-30), 1.0)
+        rec = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1e-30), 1.0)
+        mac_p, mac_r = prec.mean(), rec.mean()
+        mac_f = (2 * mac_p * mac_r / (mac_p + mac_r)
+                 if mac_p + mac_r > 0 else 0.0)
+        ttp, tfp, tfn = tp.sum(), fp.sum(), fn.sum()
+        mic_p = ttp / (ttp + tfp) if ttp + tfp > 0 else 1.0
+        mic_r = ttp / (ttp + tfn) if ttp + tfn > 0 else 1.0
+        mic_f = (2 * mic_p * mic_r / (mic_p + mic_r)
+                 if mic_p + mic_r > 0 else 0.0)
+        return np.array([mac_p, mac_r, mac_f, mic_p, mic_r, mic_f])
+
+    batch_metrics = metrics(states)
+    accum_states = states.copy()
+    if states_info is not None:
+        accum_states += _to_np(states_info).astype(np.float64)
+    accum_metrics = metrics(accum_states)
+    return batch_metrics, accum_metrics, accum_states
+
+
+def positive_negative_pair(score, label, query_id, weight=None,
+                           accum_positive=0.0, accum_negative=0.0,
+                           accum_neutral=0.0, column=-1, name=None):
+    """Ranking pair statistics (reference: operators/
+    positive_negative_pair_op.h): within each query group, every pair of
+    documents with different labels counts toward positive (score order
+    agrees with label order) or negative pairs; equal scores additionally
+    count a neutral pair. Pair weight = mean of the two doc weights.
+    Returns (positive, negative, neutral) including the accumulate inputs.
+    """
+    sc = _to_np(score).astype(np.float64)
+    if sc.ndim == 1:
+        sc = sc[:, None]
+    col = int(column)
+    if col < 0:
+        col += sc.shape[1]
+    s = sc[:, col]
+    lab = _to_np(label).reshape(-1).astype(np.float64)
+    qid = _to_np(query_id).reshape(-1).astype(np.int64)
+    w = (_to_np(weight).reshape(-1).astype(np.float64)
+         if weight is not None else np.ones(s.shape[0]))
+    pos = float(accum_positive)
+    neg = float(accum_negative)
+    neu = float(accum_neutral)
+    # pair enumeration per query group (bounds memory to sum of group
+    # sizes squared, like the reference's per-query document lists)
+    for q in np.unique(qid):
+        sel = qid == q
+        gs, gl, gw = s[sel], lab[sel], w[sel]
+        i, j = np.triu_indices(gs.shape[0], k=1)
+        m = gl[i] != gl[j]
+        pw = (gw[i[m]] + gw[j[m]]) * 0.5
+        ds = gs[i[m]] - gs[j[m]]
+        dl = gl[i[m]] - gl[j[m]]
+        pos += pw[ds * dl > 0].sum()
+        # reference quirk kept: an equal-score pair adds to BOTH neutral
+        # and negative (the ternary runs after the neu += w branch)
+        neg += pw[ds * dl <= 0].sum()
+        neu += pw[ds == 0].sum()
+    return np.float64(pos), np.float64(neg), np.float64(neu)

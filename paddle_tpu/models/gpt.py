@@ -62,9 +62,11 @@ class GPTConfig:
     use_recompute: bool = False
     # remat policy (PaddleNLP recompute_granularity analog): 'full' remats
     # the whole block (min memory, ~4/3x fwd flops); 'selective' keeps
-    # weight-matmul outputs (jax dots_with_no_batch_dims_saveable) and only
-    # recomputes elementwise/attention internals — near-no-remat MFU at a
-    # fraction of the activation memory
+    # weight-matmul outputs (jax dots_with_no_batch_dims_saveable) AND the
+    # flash-attention forward outputs (checkpoint_name-tagged o/lse) so only
+    # cheap elementwise work reruns; 'core_attn' keeps ONLY the flash
+    # outputs (reference PaddleNLP core_attn granularity) — near-'full'
+    # memory but the expensive attention kernel never re-runs in backward
     recompute_granularity: str = "full"
     # remat every k-th block only (reference PipelineLayer recompute_interval):
     # 0 = off, 1 = every block, 2 = blocks 0,2,4,... — trades memory for
@@ -425,10 +427,9 @@ class GPTDecoderLayer(Layer):
 
             from ..ops._primitive import primitive
 
-            if self._recompute_granularity == "selective":
-                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            else:
-                policy = None
+            from ..ops.pallas.flash_attention import granularity_policy
+
+            policy = granularity_policy(self._recompute_granularity)
 
             @primitive
             def _remat(h):

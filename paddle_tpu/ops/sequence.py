@@ -36,6 +36,8 @@ __all__ = [
     "sequence_slice",
     "row_conv",
     "im2sequence",
+    "sequence_topk_avg_pooling",
+    "match_matrix_tensor",
 ]
 
 
@@ -454,3 +456,77 @@ def im2sequence(x, filter_size, stride=1, padding=0, name=None):
         return out
 
     return _im2seq(x)
+
+
+def sequence_topk_avg_pooling(x, row_lengths, col_lengths, topks, channel_num,
+                              name=None):
+    """Top-k average pooling over match-matrix columns
+    (sequence_ops/sequence_topk_avg_pooling_op.h): for every (batch, channel,
+    row), average the top-k column scores for each k in ``topks``.
+
+    Dense+lengths redesign of the LoD op: x is the padded match matrix
+    [B, channel_num, Rmax, Cmax] (the reference's flat per-batch
+    channel-major rows ≙ x[b, c, r]); row_lengths/col_lengths [B] give the
+    valid extent. Returns [B, Rmax, channel_num * len(topks)] with the
+    reference's row-major (row, channel, k) layout; padding rows are zero.
+    When a row has fewer than k valid columns the reference's prefix-sum
+    carry (sum over the valid ones, still divided by k) is reproduced.
+    """
+    topks = [int(k) for k in topks]
+    if any(k <= 0 for k in topks):
+        raise ValueError("sequence_topk_avg_pooling: topks must be positive")
+    max_k = max(topks)
+
+    @primitive
+    def _topk_avg(x, rl, cl):
+        b, c, rmax, cmax = x.shape
+        col_ok = jnp.arange(cmax)[None, :] < cl[:, None]          # [B, Cmax]
+        neg = jnp.asarray(-jnp.inf, x.dtype)
+        masked = jnp.where(col_ok[:, None, None, :], x, neg)
+        # top max_k column values per (b, c, r), descending
+        kk = min(max_k, cmax)
+        vals = jax.lax.top_k(masked, kk)[0]                        # [B,C,R,kk]
+        if kk < max_k:
+            vals = jnp.pad(vals, ((0, 0),) * 3 + ((0, max_k - kk),),
+                           constant_values=-jnp.inf)
+        take = jnp.arange(max_k)[None, :] < cl[:, None]            # [B, max_k]
+        contrib = jnp.where(take[:, None, None, :], vals, 0.0)
+        contrib = jnp.where(jnp.isfinite(contrib), contrib, 0.0)
+        prefix = jnp.cumsum(contrib, axis=-1)                      # [B,C,R,max_k]
+        outs = [prefix[..., k - 1] / k for k in topks]             # each [B,C,R]
+        out = jnp.stack(outs, axis=-1)                             # [B,C,R,K]
+        out = jnp.transpose(out, (0, 2, 1, 3))                     # [B,R,C,K]
+        row_ok = jnp.arange(rmax)[None, :] < rl[:, None]
+        out = jnp.where(row_ok[:, :, None, None], out, 0.0)
+        return out.reshape(b, rmax, c * len(topks))
+
+    return _topk_avg(x, unwrap(row_lengths), unwrap(col_lengths))
+
+
+def match_matrix_tensor(x, y, w, x_lengths, y_lengths, dim_t=None, name=None):
+    """Semantic-matching tensor layer (match_matrix_tensor_op.cc): for each
+    batch pair of sequences, out[b, t, i, j] = x_i^T @ W[:, t, :] @ y_j.
+
+    Dense+lengths redesign: x [B, Lmax, D], y [B, Rmax, D] padded,
+    w [D, dim_t, D], lengths [B]. Returns (out [B, dim_t, Lmax, Rmax] with
+    zero padding, tmp [B, Lmax, dim_t, D] — the reference's Tmp = x @ W
+    intermediate). Differentiable; the reference's LoD output layout
+    (dim_t*len_l*len_r rows per batch) is recovered by slicing valid
+    extents."""
+    w_dim_t = int(unwrap(w).shape[1])
+    if dim_t is not None and int(dim_t) != w_dim_t:
+        raise ValueError(
+            f"match_matrix_tensor: dim_t ({dim_t}) != W.shape[1] ({w_dim_t})")
+
+    @primitive
+    def _mmt(x, y, w, xl, yl):
+        b, lmax, d = x.shape
+        rmax = y.shape[1]
+        tmp = jnp.einsum("bld,dte->blte", x, w)          # [B, L, T, D]
+        out = jnp.einsum("blte,bre->btlr", tmp, y)       # [B, T, L, R]
+        lok = jnp.arange(lmax)[None, :] < xl[:, None]
+        rok = jnp.arange(rmax)[None, :] < yl[:, None]
+        mask = lok[:, None, :, None] & rok[:, None, None, :]
+        return jnp.where(mask, out, 0.0), tmp
+
+    return _mmt(x, y, w, unwrap(x_lengths), unwrap(y_lengths))

@@ -11,8 +11,13 @@ from .optimizers import (  # noqa: F401
     Adam,
     Adamax,
     AdamW,
+    DecayedAdagrad,
+    Dpsgd,
+    Ftrl,
     Lamb,
     Lars,
     Momentum,
+    ProximalAdagrad,
+    ProximalGD,
     RMSProp,
 )

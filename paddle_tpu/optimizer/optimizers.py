@@ -24,6 +24,11 @@ __all__ = [
     "RMSProp",
     "Lamb",
     "Lars",
+    "Ftrl",
+    "Dpsgd",
+    "ProximalGD",
+    "ProximalAdagrad",
+    "DecayedAdagrad",
 ]
 
 
@@ -306,3 +311,160 @@ class Lars(Optimizer):
         )
         v = mu * slots["velocity"] + lr * local_lr * (g32 + wd * p32)
         return (p32 - v).astype(p.dtype), {"velocity": v}
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference: operators/optimizers/ftrl_op.h FTRLFunctor;
+    python FtrlOptimizer). State: squared accumulator n and linear
+    accumulator z; the closed-form proximal step zeroes weights whose
+    |z| <= l1 (the sparsity-inducing part)."""
+
+    _slot_names = ("squared_accum", "linear_accum")
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._l1, self._l2 = float(l1), float(l2)
+        self._lr_power = float(lr_power)
+        self._init_val = float(initial_accumulator_value)
+
+    def _hyper(self):
+        return (self._l1, self._l2, self._lr_power)
+
+    def _init_slots(self, param_arr):
+        return {"squared_accum": jnp.full_like(param_arr, self._init_val),
+                "linear_accum": jnp.zeros_like(param_arr)}
+
+    @staticmethod
+    def _update(p, g, slots, lr, step, hyper):
+        l1, l2, lr_power = hyper
+        lr = lr.astype(p.dtype)
+        n, z = slots["squared_accum"], slots["linear_accum"]
+        n_new = n + jnp.square(g)
+        if lr_power == -0.5:
+            sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+            y = jnp.sqrt(n_new) / lr + 2.0 * l2
+        else:
+            sigma = (jnp.power(n_new, -lr_power) - jnp.power(n, -lr_power)) / lr
+            y = jnp.power(n_new, -lr_power) / lr + 2.0 * l2
+        z_new = z + g - sigma * p
+        x = jnp.sign(z_new) * l1 - z_new
+        p_new = jnp.where(jnp.abs(z_new) > l1, x / y, jnp.zeros_like(p))
+        return p_new, {"squared_accum": n_new, "linear_accum": z_new}
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD (reference: optimizers/dpsgd_op.h; CCS16
+    "Deep Learning with Differential Privacy"): per-step global-L2 clip of
+    the gradient to ``clip`` then one gaussian noise draw scaled by
+    sigma/batch_size added to every element. RNG: jax threefry keyed by
+    (seed, step) instead of the reference's Box-Muller over minstd_rand —
+    same distribution, reproducible under jit."""
+
+    _slot_names = ()
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, parameters=None, weight_decay=None,
+                 grad_clip=None, seed=1, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._clip, self._batch = float(clip), float(batch_size)
+        self._sigma, self._seed = float(sigma), int(seed)
+
+    def _hyper(self):
+        return (self._clip, self._batch, self._sigma, self._seed)
+
+    @staticmethod
+    def _update(p, g, slots, lr, step, hyper):
+        import jax
+
+        clip, batch, sigma, seed = hyper
+        l2_norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+        scale = jnp.where(l2_norm > clip, l2_norm / clip, 1.0).astype(g.dtype)
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+        noise = (jax.random.normal(key, (), jnp.float32) * sigma).astype(g.dtype)
+        return p - lr.astype(p.dtype) * (g / scale + noise / batch), slots
+
+
+class ProximalGD(Optimizer):
+    """Proximal gradient descent with l1/l2 regularisation (reference:
+    optimizers/proximal_gd_op.h): soft-threshold the plain GD step."""
+
+    _slot_names = ()
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._l1, self._l2 = float(l1), float(l2)
+
+    def _hyper(self):
+        return (self._l1, self._l2)
+
+    @staticmethod
+    def _update(p, g, slots, lr, step, hyper):
+        l1, l2 = hyper
+        lr = lr.astype(p.dtype)
+        prox = p - lr * g
+        if l1 > 0:
+            p_new = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+                     / (1.0 + lr * l2))
+        else:
+            p_new = prox / (1.0 + lr * l2)
+        return p_new, slots
+
+
+class ProximalAdagrad(Optimizer):
+    """Proximal Adagrad (reference: optimizers/proximal_adagrad_op.h):
+    adagrad-scaled step followed by the same l1/l2 proximal shrink."""
+
+    _slot_names = ("moment",)
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._l1, self._l2 = float(l1), float(l2)
+        self._init_val = float(initial_accumulator_value)
+
+    def _hyper(self):
+        return (self._l1, self._l2)
+
+    def _init_slots(self, param_arr):
+        return {"moment": jnp.full_like(param_arr, self._init_val)}
+
+    @staticmethod
+    def _update(p, g, slots, lr, step, hyper):
+        l1, l2 = hyper
+        lr = lr.astype(p.dtype)
+        m = slots["moment"] + jnp.square(g)
+        prox = p - lr * g / jnp.sqrt(m)
+        if l1 > 0:
+            p_new = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+                     / (1.0 + lr * l2))
+        else:
+            p_new = prox / (1.0 + lr * l2)
+        return p_new, {"moment": m}
+
+
+class DecayedAdagrad(Optimizer):
+    """Decayed Adagrad (reference: optimizers/decayed_adagrad_op.h):
+    moment = decay*moment + (1-decay)*g^2 — adagrad with a forgetting
+    rate so the effective lr doesn't collapse."""
+
+    _slot_names = ("moment",)
+
+    def __init__(self, learning_rate=0.001, decay=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._decay, self._epsilon = float(decay), float(epsilon)
+
+    def _hyper(self):
+        return (self._decay, self._epsilon)
+
+    @staticmethod
+    def _update(p, g, slots, lr, step, hyper):
+        decay, eps = hyper
+        m = decay * slots["moment"] + (1 - decay) * jnp.square(g)
+        p_new = p - lr.astype(p.dtype) * g / (jnp.sqrt(m) + eps)
+        return p_new, {"moment": m}

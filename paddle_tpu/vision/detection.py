@@ -53,6 +53,7 @@ __all__ = [
     "polygon_box_transform",
     "box_decoder_and_assign",
     "mine_hard_examples",
+    "locality_aware_nms",
 ]
 
 _BBOX_CLIP = math.log(1000.0 / 16.0)  # bbox_util.h kBBoxClipDefault
@@ -1140,3 +1141,117 @@ def mine_hard_examples(cls_loss, match_indices, neg_pos_ratio=3.0,
     if loc_loss is not None:
         total = total + _arr(loc_loss)
     return _mine(total.astype(jnp.float32), _arr(match_indices))
+
+
+def _locality_merge(boxes, scores, nms_threshold, normalized):
+    """The locality-aware pre-pass (locality_aware_nms_op.cc
+    GetMaxScoreIndexWithLocalityAware): walk boxes in input order keeping a
+    running head; an incoming box whose IoU with the head exceeds the
+    threshold is score-weighted-merged INTO the head (head score += its
+    score), otherwise the head is finalised and the incoming box becomes the
+    new head. Returns (merged boxes, merged scores, finalised mask)."""
+    m = boxes.shape[0]
+
+    def body(i, carry):
+        bx, sc, fin, head = carry
+        i32 = jnp.asarray(i, head.dtype)
+
+        def with_head(carry):
+            bx, sc, fin, head = carry
+            hb = lax.dynamic_index_in_dim(bx, head, keepdims=False)
+            hs = lax.dynamic_index_in_dim(sc, head, keepdims=False)
+            ov = _pairwise_iou(bx[i][None], hb[None], normalized)[0, 0]
+
+            def merge(_):
+                num = bx[i] * sc[i] + hb * hs
+                merged = num / (sc[i] + hs)
+                return (bx.at[head].set(merged), sc.at[head].add(sc[i]),
+                        fin, head)
+
+            def finalize(_):
+                return bx, sc, fin.at[head].set(True), i32
+
+            return lax.cond(ov > nms_threshold, merge, finalize, None)
+
+        def no_head(carry):
+            bx, sc, fin, _ = carry
+            return bx, sc, fin, i32
+
+        return lax.cond(head >= 0, with_head, no_head, (bx, sc, fin, head))
+
+    boxes, scores, fin, head = lax.fori_loop(
+        0, m, body, (boxes, scores, jnp.zeros((m,), bool), jnp.int32(-1)))
+    fin = lax.cond(head >= 0, lambda f: f.at[head].set(True), lambda f: f, fin)
+    return boxes, scores, fin
+
+
+def locality_aware_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
+                       keep_top_k=-1, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """Locality-aware NMS (detection/locality_aware_nms_op.cc — the EAST
+    text-detection postprocess): a sequential score-weighted merge of
+    neighbouring boxes followed by standard greedy NMS and multiclass
+    keep_top_k output.
+
+    bboxes [N, M, 4], scores [N, C, M] → (out [N*K, 6] rows
+    [label, score, x1, y1, x2, y2] with -1/zero padding, counts [N]).
+    Only the 4-coordinate rectangle layout is supported; the reference's
+    8..32-point polygon layouts (PolyIoU over gpc polygon clipping) are out
+    of scope v1 and raise. Note the reference kernel mutates the shared box
+    buffer across the class loop (bbox_slice = *bboxes); typical usage is
+    single-class, and this redesign runs each class on the pristine boxes.
+    """
+    bb = _arr(bboxes).astype(jnp.float32)
+    sc = _arr(scores).astype(jnp.float32)
+    if bb.shape[-1] != 4:
+        raise NotImplementedError(
+            "locality_aware_nms: polygon layouts (last dim "
+            f"{bb.shape[-1]}) need gpc polygon clipping — out of scope v1; "
+            "only [x1,y1,x2,y2] boxes are supported")
+
+    @primitive(nondiff=True)
+    def _nms(bb, sc):
+        n, m = bb.shape[0], bb.shape[1]
+        c = sc.shape[1]
+        top = min(nms_top_k, m) if nms_top_k > -1 else m
+
+        def one(b, s):
+            def per_class(cls_scores):
+                mb, ms, fin = _locality_merge(b, cls_scores, nms_threshold,
+                                              normalized)
+                valid = fin & (ms > score_threshold)
+                if top < m:
+                    kth = -jnp.sort(-jnp.where(valid, ms, -jnp.inf))[top - 1]
+                    valid = valid & (ms >= kth)
+                order, keep = _greedy_nms_mask(mb, ms, valid, nms_threshold,
+                                               nms_eta, normalized)
+                mask = jnp.zeros((m,), bool).at[order].set(keep)
+                return mask, mb, ms
+
+            keep_cm, mb_cm, ms_cm = jax.vmap(per_class)(s)  # [C,M],[C,M,4],[C,M]
+            if 0 <= background_label < c:
+                keep_cm = keep_cm.at[background_label].set(False)
+            flat_scores = jnp.where(keep_cm, ms_cm, -jnp.inf).reshape(-1)
+            k = keep_top_k if keep_top_k > -1 else c * m
+            k = min(k, c * m)
+            top_scores, top_idx = lax.top_k(flat_scores, k)
+            sel_valid = top_scores > -jnp.inf
+            cls_id = (top_idx // m).astype(jnp.float32)
+            sel_boxes = jnp.take(mb_cm.reshape(c * m, 4), top_idx, axis=0)
+            order2 = jnp.lexsort(
+                (-top_scores, jnp.where(sel_valid, cls_id, jnp.inf)))
+            top_scores = top_scores[order2]
+            sel_valid = sel_valid[order2]
+            cls_id = cls_id[order2]
+            sel_boxes = sel_boxes[order2]
+            out = jnp.concatenate([
+                jnp.where(sel_valid, cls_id, -1.0)[:, None],
+                jnp.where(sel_valid, top_scores, 0.0)[:, None],
+                jnp.where(sel_valid[:, None], sel_boxes, 0.0),
+            ], axis=1)
+            return out, jnp.sum(sel_valid.astype(jnp.int32))
+
+        out, cnt = jax.vmap(one)(bb, sc)
+        return out.reshape(-1, 6), cnt
+
+    return _nms(bb, sc)

@@ -42,6 +42,10 @@ __all__ = [
     "roi_align",
     "RoIAlign",
     "nms",
+    "affine_grid",
+    "temporal_shift",
+    "correlation",
+    "bilateral_slice",
 ]
 
 
@@ -674,3 +678,186 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return Tensor(jnp.asarray(arr))
+
+
+# ---------------------------------------------------------------------------
+# vision misc tail (VERDICT r4 #4): affine_grid, temporal_shift, correlation,
+# bilateral_slice
+# ---------------------------------------------------------------------------
+
+@primitive
+def _affine_grid_op(theta, hw, align_corners):
+    h, w = hw
+    n = theta.shape[0]
+
+    def lin(count):
+        # affine_grid_op.h Linspace: align_corners=True spans [-1, 1]
+        # inclusive; False shrinks by (count-1)/count (half-pixel centers)
+        start, end = -1.0, 1.0
+        if align_corners:
+            step = (end - start) / (count - 1)
+            s = start
+        else:
+            step = (end - start) / count
+            s = start * (count - 1) / count
+        return s + jnp.arange(count, dtype=theta.dtype) * step
+
+    xs = lin(w)  # [W]
+    ys = lin(h)  # [H]
+    ones = jnp.ones((h, w), theta.dtype)
+    base = jnp.stack([jnp.broadcast_to(xs[None, :], (h, w)),
+                      jnp.broadcast_to(ys[:, None], (h, w)), ones],
+                     axis=-1)  # [H, W, 3]
+    # output = base @ theta^T per batch
+    return jnp.einsum("hwk,nck->nhwc", base, theta)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D affine sampling grid (reference: operators/affine_grid_op.h
+    AffineGridOpKernel; python nn.functional.affine_grid). theta [N, 2, 3],
+    out_shape (N, C, H, W) → grid [N, H, W, 2] of (x, y) in [-1, 1],
+    differentiable w.r.t. theta."""
+    shp = [int(s) for s in (out_shape.tolist() if hasattr(out_shape, "tolist")
+                            else out_shape)]
+    h, w = shp[2], shp[3]
+    return _affine_grid_op(theta, (h, w), bool(align_corners))
+
+
+@primitive
+def _temporal_shift_op(x, seg_num, shift_ratio, data_format):
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    # slide channels [0,c1) back one step (zero-pad at t=0), [c1,c2) forward
+    # one step (zero at t=T-1), remainder identity (temporal_shift_op.h)
+    back = jnp.pad(xr[:, :-1, :c1], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+    fwd = jnp.pad(xr[:, 1:, c1:c2], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+    out = jnp.concatenate([back, fwd, xr[:, :, c2:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal shift (reference: operators/temporal_shift_op.h;
+    python nn.functional.temporal_shift): x [N*T, C, H, W] viewed as T-frame
+    segments; the first c*ratio channels look one frame back, the next
+    c*ratio one frame ahead."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"temporal_shift: bad data_format {data_format}")
+    return _temporal_shift_op(x, int(seg_num), float(shift_ratio), data_format)
+
+
+@primitive
+def _correlation_op(x1, x2, pad_size, kernel_size, max_displacement,
+                    stride1, stride2):
+    n, c, h, w = x1.shape
+    krad = (kernel_size - 1) // 2
+    drad = max_displacement // stride2
+    border = krad + max_displacement
+    ph, pw = h + 2 * pad_size, w + 2 * pad_size
+    out_h = -(-(ph - 2 * border) // stride1)  # ceil div
+    out_w = -(-(pw - 2 * border) // stride1)
+
+    pad = ((0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size))
+    a = jnp.pad(x1, pad)
+    b = jnp.pad(x2, pad)
+    nelems = float(kernel_size * kernel_size * c)
+
+    outs = []
+    for tj in range(-drad, drad + 1):
+        for ti in range(-drad, drad + 1):
+            # x2 displaced by (tj, ti)*stride2 relative to x1
+            shifted = jnp.roll(b, (-tj * stride2, -ti * stride2), axis=(2, 3))
+            prod = (a * shifted).sum(axis=1)  # [N, ph, pw]
+            # kernel window sum around each center
+            ksum = jnp.zeros_like(prod)
+            for j in range(-krad, krad + 1):
+                for i in range(-krad, krad + 1):
+                    ksum = ksum + jnp.roll(prod, (-j, -i), axis=(1, 2))
+            # centers: h1 = hout*stride1 + max_displacement
+            hh = max_displacement + stride1 * jnp.arange(out_h)
+            ww = max_displacement + stride1 * jnp.arange(out_w)
+            outs.append(ksum[:, hh][:, :, ww] / nelems)
+    return jnp.stack(outs, axis=1)  # [N, D*D, out_h, out_w]
+
+
+def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1, name=None):
+    """FlowNet correlation volume (reference: operators/correlation_op.cu
+    correlation_forward): output [N, D*D, Hout, Wout] with
+    D = 2*(max_displacement//stride2)+1; each channel d=(tj,ti) is the
+    channel-mean dot product of a kernel_size^2 window of x with the window
+    of y displaced by (tj, ti)*stride2. Valid centers start at
+    max_displacement in the padded map (border_radius = kernel_rad +
+    max_displacement). jnp.roll wrap-around never reaches valid centers
+    because displacement+kernel stays within the border margin."""
+    if int(kernel_size) % 2 != 1:
+        raise ValueError("correlation: kernel_size must be odd")
+    return _correlation_op(x, y, int(pad_size), int(kernel_size),
+                           int(max_displacement), int(stride1), int(stride2))
+
+
+@primitive
+def _bilateral_slice_op(x, guide, grid, has_offset):
+    n, ci, h, w = x.shape
+    gn, gc, gd, gh, gw = grid.shape
+    coeff_stride = ci + 1 if has_offset else ci
+    co = gc // coeff_stride
+
+    # sample positions (bilateral_slice_op.cu forward): half-pixel centers
+    # scaled to grid resolution; z from the guide map
+    gx = (jnp.arange(w, dtype=x.dtype) + 0.5) * gw / w          # [W]
+    gy = (jnp.arange(h, dtype=x.dtype) + 0.5) * gh / h          # [H]
+    gz = guide * gd                                             # [N, H, W]
+
+    fx = jnp.floor(gx - 0.5)
+    fy = jnp.floor(gy - 0.5)
+    fz = jnp.floor(gz - 0.5)
+
+    def tent(d):
+        return jnp.maximum(1.0 - jnp.abs(d), 0.0)
+
+    # accumulate the 8 trilinear corners; corner indices clamp to the grid
+    coeff = jnp.zeros((n, gc, h, w), x.dtype)
+    for dx in range(2):
+        xx = fx + dx
+        x_ = jnp.clip(xx, 0, gw - 1).astype(jnp.int32)          # [W]
+        wx = tent(xx + 0.5 - gx)                                # [W]
+        for dy in range(2):
+            yy = fy + dy
+            y_ = jnp.clip(yy, 0, gh - 1).astype(jnp.int32)      # [H]
+            wy = tent(yy + 0.5 - gy)                            # [H]
+            for dz in range(2):
+                zz = fz + dz                                    # [N, H, W]
+                z_ = jnp.clip(zz, 0, gd - 1).astype(jnp.int32)
+                wz = tent(zz + 0.5 - gz)                        # [N, H, W]
+                # grid[b, c, z_, y_, x_] gathered per pixel
+                g_zy = grid[:, :, :, y_, :][:, :, :, :, x_]     # [N, gc, gd, H, W]
+                samp = jnp.take_along_axis(
+                    g_zy, z_[:, None, None, :, :], axis=2)[:, :, 0]
+                coeff = coeff + samp * (wx[None, None, None, :]
+                                        * wy[None, None, :, None]
+                                        * wz[:, None, :, :])
+    coeff = coeff.reshape(n, co, coeff_stride, h, w)
+    out = (coeff[:, :, :ci] * x[:, None]).sum(axis=2)
+    if has_offset:
+        out = out + coeff[:, :, ci]
+    return out
+
+
+def bilateral_slice(x, guide, grid, has_offset=False, name=None):
+    """HDRNet bilateral-grid slicing (reference:
+    operators/bilateral_slice_op.cu BilateralSliceCudaForwardKernel;
+    python fluid.contrib.layers.bilateral_slice): per output pixel,
+    trilinearly sample an affine color transform from the bilateral grid at
+    (x/w*gw, y/h*gh, guide*gd) and apply it to the input channels.
+    x [N, Ci, H, W], guide [N, H, W], grid [N, gc, gd, gh, gw] →
+    [N, Co, H, W] with Co = gc/(Ci+1) if has_offset else gc/Ci."""
+    return _bilateral_slice_op(x, guide, grid, bool(has_offset))

@@ -632,3 +632,65 @@ def test_rpn_target_assign_edge_cases():
     si = list(res2["score_index"])
     assert len(si) == len(set(si)), "no duplicate anchors in score_index"
     assert res2["bbox_inside_weight"].sum() == 0.0
+
+
+def test_locality_aware_nms():
+    """Numpy re-derivation of locality_aware_nms_op.cc: the sequential
+    score-weighted merge pass followed by greedy NMS, single class."""
+    boxes = np.array([
+        [0.0, 0.0, 10.0, 10.0],
+        [1.0, 1.0, 11.0, 11.0],   # merges into box 0 (IoU ~0.68)
+        [20.0, 20.0, 30.0, 30.0],
+        [21.0, 21.0, 31.0, 31.0],  # merges into box 2
+        [50.0, 50.0, 60.0, 60.0],
+    ], np.float32)
+    scores = np.array([[0.9, 0.6, 0.8, 0.7, 0.3]], np.float32)  # [C=1, M]
+
+    def np_iou(a, b):
+        ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+        ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+        iw, ih = max(ix2 - ix1, 0), max(iy2 - iy1, 0)
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1]) +
+              (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    # reference merge pass
+    bx, sc = boxes.copy(), scores[0].copy()
+    skip = np.ones(5, bool)
+    head = -1
+    for i in range(5):
+        if head > -1:
+            ov = np_iou(bx[i], bx[head])
+            if ov > 0.5:
+                bx[head] = (bx[i] * sc[i] + bx[head] * sc[head]) / (sc[i] + sc[head])
+                sc[head] += sc[i]
+            else:
+                skip[head] = False
+                head = i
+        else:
+            head = i
+    if head > -1:
+        skip[head] = False
+
+    out, cnt = D.locality_aware_nms(
+        boxes[None], scores[None], score_threshold=0.01, nms_threshold=0.5,
+        normalized=True)
+    out = np.asarray(out._data if hasattr(out, "_data") else out)
+    cnt = np.asarray(cnt._data if hasattr(cnt, "_data") else cnt)
+    assert cnt[0] == 3  # three merged clusters survive
+    got_rows = out[:3]
+    # expected: merged boxes with accumulated scores, score-descending
+    exp = sorted(
+        [(sc[i], bx[i]) for i in range(5) if not skip[i]],
+        key=lambda t: -t[0])
+    for row, (es, eb) in zip(got_rows, exp):
+        assert row[0] == 0.0  # class label
+        np.testing.assert_allclose(row[1], es, rtol=1e-5)
+        np.testing.assert_allclose(row[2:], eb, rtol=1e-5)
+
+
+def test_locality_aware_nms_polygon_raises():
+    with pytest.raises(NotImplementedError):
+        D.locality_aware_nms(np.zeros((1, 3, 8), np.float32),
+                             np.zeros((1, 1, 3), np.float32))

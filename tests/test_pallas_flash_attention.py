@@ -120,3 +120,82 @@ def test_ragged_causal_tail_padding():
         q, k, v, True, scale).sum(), argnums=(0, 1, 2))(q, k, v)
     for a, b_ in zip(g_flash, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5)
+
+
+@pytest.mark.parametrize("policy_name", ["selective", "core_attn", "full"])
+def test_grads_under_remat_policies(policy_name):
+    """Residuals-as-inputs remat design (SAVEABLE_NAMES): grads under
+    jax.checkpoint with the flash-saveable policies must match the plain
+    XLA reference. 'selective' composes dots+names, 'core_attn' names-only,
+    'full' saves nothing (forces the recompute path through the
+    stop_gradient'd pallas forward)."""
+    from paddle_tpu.ops.pallas.flash_attention import saveable_policy
+
+    rng = np.random.default_rng(7)
+    b, h, t, d = 1, 2, 256, 64
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((d, d)) * 0.1, jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    if policy_name == "selective":
+        policy = saveable_policy(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    elif policy_name == "core_attn":
+        policy = saveable_policy()
+    else:
+        policy = None
+
+    def block(w, x, attn):
+        y = jnp.einsum("bhtd,de->bhte", x, w)
+        o = attn(y, y, y)
+        return x + o
+
+    def make_loss(attn):
+        def loss(w, x):
+            f = jax.checkpoint(lambda w, h: block(w, h, attn), policy=policy)
+            h = f(w, x)
+            h = f(w, h)
+            return jnp.sum(h * jnp.sin(h))
+        return loss
+
+    flash = lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                            interpret=True)
+    ref = lambda q, k, v: ref_attention(q, k, v, True, scale)
+    gw_f, gx_f = jax.grad(make_loss(flash), argnums=(0, 1))(w, q)
+    gw_r, gx_r = jax.grad(make_loss(ref), argnums=(0, 1))(w, q)
+    np.testing.assert_allclose(np.asarray(gw_f), np.asarray(gw_r),
+                               atol=3e-4, rtol=3e-4)
+    np.testing.assert_allclose(np.asarray(gx_f), np.asarray(gx_r),
+                               atol=3e-4, rtol=3e-4)
+
+
+def test_remat_saves_flash_forward():
+    """Structural check: under save_only_these_names the backward jaxpr
+    contains exactly one forward flash pallas_call (the primal one) — the
+    saved o/lse feed the backward kernels without a forward replay."""
+    from paddle_tpu.ops.pallas.flash_attention import saveable_policy
+
+    b, h, t, d = 1, 2, 256, 64
+    q = jnp.ones((b, h, t, d), jnp.float32)
+
+    def loss(x):
+        f = jax.checkpoint(
+            lambda h: flash_attention(h, h, h, causal=True, interpret=True),
+            policy=saveable_policy())
+        return jnp.sum(f(x) ** 2)
+
+    jaxpr = str(jax.make_jaxpr(jax.grad(loss))(q))
+    # one fwd pallas_call + dq + dkv backward calls — no forward replay
+    assert jaxpr.count("pallas_call") == 3, jaxpr.count("pallas_call")
+    assert "flash_out" in jaxpr and "flash_lse" in jaxpr
+
+    def loss_dots(x):
+        f = jax.checkpoint(
+            lambda h: flash_attention(h, h, h, causal=True, interpret=True),
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        return jnp.sum(f(x) ** 2)
+
+    # contrast: a policy blind to the names re-runs the flash forward in
+    # backward (4th pallas_call) — the exact recompute the tags eliminate
+    jaxpr_dots = str(jax.make_jaxpr(jax.grad(loss_dots))(q))
+    assert jaxpr_dots.count("pallas_call") == 4, jaxpr_dots.count("pallas_call")

@@ -268,3 +268,150 @@ def test_detection_map_difficult_and_accumulate():
     assert m.accumulate() < 1.0
     m.reset()
     assert m.accumulate() == 0.0
+
+
+def test_precision_recall():
+    """Numpy re-derivation of metrics/precision_recall_op.h with the
+    op_test's reference loop semantics."""
+    from paddle_tpu.metric import precision_recall
+
+    idx = np.array([0, 1, 2, 1, 0], np.int32)
+    lab = np.array([0, 1, 1, 2, 2], np.int32)
+    w = np.array([1.0, 2.0, 1.0, 0.5, 1.0], np.float32)
+    c = 3
+    batch_m, accum_m, states = precision_recall(None, idx, lab, c, weights=w)
+
+    # reference loop
+    exp = np.zeros((c, 4))
+    for i in range(5):
+        wi = w[i]
+        if idx[i] == lab[i]:
+            exp[idx[i], 0] += wi
+            exp[:, 2] += wi
+            exp[idx[i], 2] -= wi
+        else:
+            exp[lab[i], 3] += wi
+            exp[idx[i], 1] += wi
+            exp[:, 2] += wi
+            exp[idx[i], 2] -= wi
+            exp[lab[i], 2] -= wi
+    np.testing.assert_allclose(states, exp, atol=1e-12)
+
+    def calc(st):
+        precs, recs = [], []
+        ttp = tfp = tfn = 0.0
+        for i in range(c):
+            tp, fp, _, fn = st[i]
+            precs.append(tp / (tp + fp) if tp > 0 or fp > 0 else 1.0)
+            recs.append(tp / (tp + fn) if tp > 0 or fn > 0 else 1.0)
+            ttp, tfp, tfn = ttp + tp, tfp + fp, tfn + fn
+        mp, mr = np.mean(precs), np.mean(recs)
+        mf = 2 * mp * mr / (mp + mr) if mp + mr > 0 else 0.0
+        up = ttp / (ttp + tfp) if ttp > 0 or tfp > 0 else 1.0
+        ur = ttp / (ttp + tfn) if ttp > 0 or tfn > 0 else 1.0
+        uf = 2 * up * ur / (up + ur) if up + ur > 0 else 0.0
+        return np.array([mp, mr, mf, up, ur, uf])
+
+    np.testing.assert_allclose(batch_m, calc(exp), atol=1e-12)
+
+    # accumulate path: prior states add into accum metrics only
+    prior = np.ones((c, 4))
+    b2, a2, s2 = precision_recall(None, idx, lab, c, weights=w,
+                                  states_info=prior)
+    np.testing.assert_allclose(b2, batch_m)
+    np.testing.assert_allclose(s2, exp + prior)
+    np.testing.assert_allclose(a2, calc(exp + prior))
+
+
+def test_positive_negative_pair():
+    from paddle_tpu.metric import positive_negative_pair
+
+    score = np.array([0.9, 0.5, 0.5, 0.3, 0.8], np.float32)
+    label = np.array([1.0, 0.0, 1.0, 0.0, 1.0], np.float32)
+    qid = np.array([0, 0, 0, 1, 1], np.int64)
+    pos, neg, neu = positive_negative_pair(score, label, qid)
+    # query 0 pairs with label diff: (0,1): s 0.9>0.5, l 1>0 -> pos
+    #   (1,2): s equal, labels differ -> neu AND neg (reference quirk)
+    # query 1: (3,4): s 0.3<0.8, l 0<1 -> pos
+    assert pos == 2.0 and neg == 1.0 and neu == 1.0
+
+    # accumulate + weights
+    w = np.array([1.0, 3.0, 1.0, 2.0, 2.0], np.float32)
+    pos2, neg2, neu2 = positive_negative_pair(
+        score, label, qid, weight=w, accum_positive=10.0,
+        accum_negative=20.0, accum_neutral=30.0)
+    assert pos2 == 10.0 + 2.0 + 2.0  # pair(0,1) w=(1+3)/2, pair(3,4) w=2
+    assert neg2 == 20.0 + 2.0        # pair(1,2) w=(3+1)/2
+    assert neu2 == 30.0 + 2.0
+
+
+def test_sequence_topk_avg_pooling():
+    """Numpy re-derivation of sequence_topk_avg_pooling_op.h: per (batch,
+    channel, row) average of top-k column scores, prefix-carry when a row
+    has fewer valid columns than k."""
+    rng = np.random.default_rng(5)
+    B, C, Rm, Cm = 2, 2, 3, 5
+    x = rng.standard_normal((B, C, Rm, Cm)).astype(np.float32)
+    rl = np.array([3, 2])
+    cl = np.array([5, 3])
+    topks = [1, 3, 4]
+    out = np.asarray(S.sequence_topk_avg_pooling(
+        Tensor(x), rl, cl, topks, C)._data)
+
+    exp = np.zeros((B, Rm, C * len(topks)), np.float32)
+    for b in range(B):
+        for r in range(int(rl[b])):
+            for c in range(C):
+                row = np.sort(x[b, c, r, :cl[b]])[::-1]
+                for ki, k in enumerate(topks):
+                    s = row[:min(k, len(row))].sum()
+                    exp[b, r, c * len(topks) + ki] = s / k
+    np.testing.assert_allclose(out, exp, atol=1e-5, rtol=1e-5)
+
+
+def test_match_matrix_tensor():
+    """match_matrix_tensor_op.cc: out[b,t,i,j] = x_i^T W[:,t,:] y_j with
+    zero padding outside valid lengths; Tmp = x @ W."""
+    rng = np.random.default_rng(6)
+    B, Lm, Rm, D, T = 2, 3, 4, 5, 2
+    x = rng.standard_normal((B, Lm, D)).astype(np.float32)
+    y = rng.standard_normal((B, Rm, D)).astype(np.float32)
+    w = rng.standard_normal((D, T, D)).astype(np.float32)
+    xl, yl = np.array([3, 2]), np.array([4, 1])
+    out, tmp = S.match_matrix_tensor(Tensor(x), Tensor(y), Tensor(w), xl, yl)
+    out = np.asarray(out._data)
+    tmp = np.asarray(tmp._data)
+
+    exp = np.zeros((B, T, Lm, Rm), np.float32)
+    for b in range(B):
+        for t in range(T):
+            for i in range(int(xl[b])):
+                for j in range(int(yl[b])):
+                    exp[b, t, i, j] = x[b, i] @ w[:, t, :] @ y[b, j]
+    np.testing.assert_allclose(out, exp, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(
+        tmp[0, 0], np.einsum("d,dte->te", x[0, 0], w), atol=2e-5, rtol=2e-5)
+
+
+def test_sequence_topk_avg_pooling_grad():
+    """The top-k average is differentiable through lax.top_k's gather."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.random.default_rng(7).standard_normal((1, 1, 2, 4)),
+                    jnp.float32)
+    rl = jnp.array([2]); cl = jnp.array([4])
+
+    def loss(x):
+        out = S.sequence_topk_avg_pooling(x, rl, cl, [2], 1)
+        a = out._data if hasattr(out, "_data") else out
+        return jnp.sum(a)
+
+    g = np.asarray(jax.grad(loss)(x))
+    # each row's top-2 entries get 1/2 each, others 0
+    for r in range(2):
+        row = np.asarray(x[0, 0, r])
+        top2 = set(np.argsort(-row)[:2])
+        for cidx in range(4):
+            expect = 0.5 if cidx in top2 else 0.0
+            np.testing.assert_allclose(g[0, 0, r, cidx], expect, atol=1e-6)
