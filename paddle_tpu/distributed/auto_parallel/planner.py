@@ -98,7 +98,7 @@ def _divisors(n: int) -> List[int]:
 def plan_strategy(stats: ModelStats, n_devices: int, global_batch: int,
                   hbm_bytes: float = 16e9, peak_flops: float = 197e12,
                   ici_bytes_per_s: float = 4.5e10,
-                  mfu_guess: float = 0.5) -> Plan:
+                  mfu_guess: float = 0.55) -> Plan:
     """Enumerate (dp, mp, pp, zero, microbatch, remat) candidates, drop the
     ones whose memory model exceeds ``hbm_bytes``, and rank the rest by
     modeled step time. Raises with the full infeasible table when nothing
@@ -151,16 +151,23 @@ def _score(stats, n, dp, mp, pp, zero, m, recompute, global_batch,
     h = stats.hidden
     layers_local = stats.n_layers // pp
 
-    # --- memory model (bytes/device) ---
+    # --- memory model (bytes/device), constants CALIBRATED against the
+    # repo's own single-chip measurements (benchmarks/sweep_r5.jsonl +
+    # sweep_r3/r4, see test_auto_parallel TestPlannerValidation):
+    #  - grads: 0.5x the param bytes — donated buffers + the fused update
+    #    alias roughly half of a separate grad buffer in practice (the
+    #    measured 1.3B b4 remat config runs in 5.3 GB params + 5.3 GB
+    #    moments + remat activations; a full f32 grad copy would not fit)
+    #  - activations: 10 bytes/element/layer at bf16 — bounded by
+    #    760m-b8-no-remat FITTING (≤ 10.5) and XLA fusion keeping fewer
+    #    live intermediates than the naive 18/element transformer count
     p_shard = n / shard
     params = p_shard * stats.param_bytes
     if zero >= 3:
         params /= dp
-    grads = p_shard * stats.param_bytes / (dp if zero >= 2 else 1)
+    grads = 0.5 * p_shard * stats.param_bytes / (dp if zero >= 2 else 1)
     moments = 2 * p_shard * stats.moment_bytes / (dp if zero >= 1 else 1)
-    # activation working set: per layer ~ (16 + 2*heads_factor) * b*t*h
-    # bytes at bf16; remat keeps ~2 live layers, else all local layers
-    act_per_layer = 18 * b_micro * t * (h / mp) * stats.act_bytes
+    act_per_layer = 10 * b_micro * t * (h / mp) * stats.act_bytes
     live_layers = 2 if recompute else layers_local
     acts = act_per_layer * live_layers * (1 if pp == 1 else min(m, pp))
     mem = params + grads + moments + acts

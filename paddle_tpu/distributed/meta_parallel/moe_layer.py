@@ -242,9 +242,15 @@ class MoELayer(Layer):
                     continue
                 eid, pos, _gt, keepm = p
                 flat_slot = eid * cap + pos
+                # each kept token owns a distinct (expert, slot) target;
+                # dropped tokens get DISTINCT out-of-range indices
+                # (e*cap + token) so the index set is globally unique and
+                # mode="drop" discards them — unique_indices then lets XLA
+                # lower a parallel scatter instead of the serialized
+                # conservative path
                 slot_src = slot_src.at[
-                    jnp.where(keepm, flat_slot, e * cap)
-                ].set(gt, mode="drop")
+                    jnp.where(keepm, flat_slot, e * cap + gt)
+                ].set(gt, mode="drop", unique_indices=True)
             g_pad = jnp.concatenate(
                 [g, jnp.zeros((1, g.shape[-1]), g.dtype)], axis=0)
             xin = jnp.take(g_pad, slot_src, axis=0).reshape(e, cap, -1)

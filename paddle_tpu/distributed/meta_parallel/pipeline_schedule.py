@@ -773,10 +773,15 @@ class GPTPipelineModule(PipelineModule):
             picked = mp_allreduce_array(picked)
             ll = picked - jnp.log(sum_exp[..., 0])
         else:
+            # log_softmax in the logits' NATIVE dtype — the same numerics
+            # as the plain path's F.cross_entropy (nn/functional.py), and
+            # under compute_dtype=bf16 it halves the [B, T, V] softmax
+            # traffic (the f32 upcast here cost ~9% step time at 350m,
+            # benchmarks/sweep_r5b)
             logits = jnp.einsum("bth,vh->btv", hn, shared["wte"])
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            logp = jax.nn.log_softmax(logits, axis=-1)
             ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-        ll = jnp.where(valid, ll, 0.0)
+        ll = jnp.where(valid, ll.astype(jnp.float32), 0.0)
         return -ll.sum() / jnp.maximum(valid.sum(), 1)
 
     def sync_to_model(self, stage_params, shared):
@@ -1225,6 +1230,7 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
 
     step.pipe = pipe
     step.state = state
+    step.jitted = jitted  # exposed for AOT lowering / cost analysis
     step.sync_to_model = lambda: pipe.sync_to_model(
         pipe.maybe_from_stage3(state["params"]["stages"]),
         state["params"]["shared"])

@@ -246,6 +246,61 @@ def pd_or(a, b):
     return bool(np.asarray(pa).reshape(())) or bool(np.asarray(pb).reshape(()))
 
 
+def pd_list_append(lst, value):
+    """``lst.append(v)`` in assignment form (reference list_transformer
+    role, list_transformer.py:1): rewriting the statement to
+    ``lst = __pd_list_append__(lst, v)`` makes the list an *assigned* name,
+    so the if/while converters carry it as a pytree output — a traced-
+    predicate branch appending to a list works through ``lax.cond`` (both
+    branches must append compatible shapes, jax's structure check is the
+    teachable error). Appends that GROW a ``lax.while_loop`` carry still
+    raise jax's structure mismatch — XLA has no dynamic arrays (the
+    reference's LoDTensorArray relies on its dynamic executor)."""
+    if isinstance(lst, list):
+        return lst + [value]
+    lst.append(value)
+    return lst
+
+
+def pd_print(*args, **kw):
+    """print() that renders VALUES under trace (reference
+    print_transformer → Print op): traced args go through
+    jax.debug.print, concrete ones through plain print."""
+    vals = [_pred_value(a) for a in args]
+    if any(_is_traced(v) for v in vals):
+        import jax
+
+        fmt = " ".join("{}" for _ in vals)
+        jax.debug.print(fmt, *vals, **{k: v for k, v in kw.items()
+                                       if k in ("ordered",)})
+        return None
+    return print(*args, **kw)
+
+
+def pd_assert(test, msg=None):
+    """assert that survives tracing (reference assert_transformer →
+    Assert op): concrete predicates keep PYTHON truthiness (``bool(x)`` —
+    an empty list fails, exactly like the untransformed assert); traced
+    ones check all elements at run time via a host callback that raises
+    (the reference Assert op's all-elements semantics)."""
+    p = _pred_value(test)
+    if not _is_traced(p):
+        if not bool(test):
+            raise AssertionError(msg if msg is not None else "")
+        return None
+    import jax
+
+    def _check(ok):
+        import numpy as np
+
+        if not bool(np.asarray(ok).reshape(-1).all()):
+            raise AssertionError(msg if msg is not None else
+                                 "Assert failed on traced predicate")
+
+    jax.debug.callback(_check, p)
+    return None
+
+
 def pd_range_len(start, stop, step):
     """Trip count of range(start, stop, step), traceable."""
     s, e, st = (_pred_value(v) for v in (start, stop, step))
@@ -740,6 +795,66 @@ class _BreakContinueTransformer(ast.NodeTransformer):
         return out
 
 
+class _StatementTransformer(ast.NodeTransformer):
+    """Pre-pass for statement-level rewrites (reference list_transformer /
+    print_transformer / assert_transformer roles):
+
+    - ``name.append(v)`` → ``name = __pd_list_append__(name, v)`` for
+      names local to the CURRENT scope, so list mutation becomes an
+      assignment the control-flow converters can carry as a pytree output.
+      A nested function mutating an ENCLOSING scope's list is left alone —
+      the rewrite would turn the closure mutation into an unbound local.
+    - ``print(...)`` statements → ``__pd_print__(...)`` (value rendering
+      under trace).
+    - ``assert t[, msg]`` → ``__pd_assert__(t, msg)``.
+
+    Applied per scope (each FunctionDef with its own locals); nested
+    FunctionDefs are skipped and visited by their own pass.
+    """
+
+    def __init__(self, fn_locals: Set[str]):
+        self.fn_locals = fn_locals
+        self.changed = False
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        return node  # nested scopes get their own pass with their locals
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Expr(self, node: ast.Expr):
+        self.generic_visit(node)
+        call = node.value
+        if not isinstance(call, ast.Call):
+            return node
+        # name.append(v)
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "append"
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id in self.fn_locals
+                and len(call.args) == 1 and not call.keywords):
+            name = call.func.value.id
+            self.changed = True
+            return ast.Assign(
+                targets=[ast.Name(id=name, ctx=ast.Store())],
+                value=_call("__pd_list_append__",
+                            [ast.Name(id=name, ctx=ast.Load()),
+                             call.args[0]]))
+        # print(...)
+        if (isinstance(call.func, ast.Name) and call.func.id == "print"
+                and not call.keywords):
+            self.changed = True
+            return ast.Expr(value=_call("__pd_print__", list(call.args)))
+        return node
+
+    def visit_Assert(self, node: ast.Assert):
+        self.generic_visit(node)
+        self.changed = True
+        args = [node.test]
+        if node.msg is not None:
+            args.append(node.msg)
+        return ast.Expr(value=_call("__pd_assert__", args))
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self, fn_locals: Set[str], root=None):
         self.counter = 0
@@ -868,22 +983,41 @@ def _convert_cached(fn):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
     fdef.decorator_list = []  # drop @to_static etc.
-    # pre-passes (ordered): for-range lowering → return threading →
-    # break/continue flags; then the closure-extracting if/while pass
+    # pre-passes (ordered): statement rewrites (append/print/assert) →
+    # for-range lowering → return threading → break/continue flags; then
+    # the closure-extracting if/while pass. Nested function declarations
+    # (reference program_translator.py:768) are converted as their OWN
+    # scopes, innermost first — each gets its own return threading and
+    # control-flow pass with its own locals; by the time an outer scope is
+    # processed, inner raw control flow is already lowered to calls.
     pre_changed = False
     lower = _ForRangeLowering()
     lower.visit(tree)
     pre_changed |= lower.changed
-    try:
-        pre_changed |= _transform_returns(fdef)
-    except _Unsupported:
-        return None  # keep the original function untouched
-    bc = _BreakContinueTransformer()
-    bc.visit(tree)
-    pre_changed |= bc.changed
-    tr = _ControlFlowTransformer(_fn_locals(fdef), root=tree)
-    tr.visit(tree)
-    if tr.converted == 0 and not pre_changed:
+
+    scopes = [n for n in ast.walk(fdef) if isinstance(n, ast.FunctionDef)]
+    converted_total = 0
+    for scope in reversed(scopes):  # ast.walk lists outer first
+        stmts = _StatementTransformer(_fn_locals(scope))
+        scope.body = [stmts.visit(st) for st in scope.body]
+        pre_changed |= stmts.changed
+        try:
+            pre_changed |= _transform_returns(scope)
+        except _Unsupported:
+            if scope is fdef:
+                return None  # keep the original function untouched
+            continue  # leave just this nested fn unconverted
+        bc = _BreakContinueTransformer()
+        bc.visit(scope)
+        pre_changed |= bc.changed
+        tr = _ControlFlowTransformer(_fn_locals(scope), root=scope)
+        # visit the scope's BODY statements (visiting the FunctionDef node
+        # itself would re-enter nested defs already converted)
+        scope.body = [st for part in scope.body
+                      for st in (lambda r: r if isinstance(r, list) else [r])(
+                          tr.visit(part))]
+        converted_total += tr.converted
+    if converted_total == 0 and not pre_changed:
         return None
     ast.fix_missing_locations(tree)
     code = compile(tree, f"<dy2static:{fn.__qualname__}>", "exec")
@@ -895,6 +1029,9 @@ def _convert_cached(fn):
     glb["__pd_and__"] = pd_and
     glb["__pd_or__"] = pd_or
     glb["__pd_range_len__"] = pd_range_len
+    glb["__pd_list_append__"] = pd_list_append
+    glb["__pd_print__"] = pd_print
+    glb["__pd_assert__"] = pd_assert
     # closures: rebuild free variables from the original function
     if fn.__closure__:
         for name, cellv in zip(fn.__code__.co_freevars, fn.__closure__):

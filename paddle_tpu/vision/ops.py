@@ -22,6 +22,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..nn.layer import Layer
 from ..nn import initializer as init_mod
@@ -770,17 +771,28 @@ def _correlation_op(x1, x2, pad_size, kernel_size, max_displacement,
     b = jnp.pad(x2, pad)
     nelems = float(kernel_size * kernel_size * c)
 
+    # zero-filled static shifts (no wrap-around): extra margin covers the
+    # largest combined displacement+kernel tap, so every slice is in-bounds
+    # and out-of-map taps read zeros
+    marg = drad * stride2 + krad
+    bm = jnp.pad(b, ((0, 0), (0, 0), (marg, marg), (marg, marg)))
+
+    def shifted_b(dy, dx):
+        return lax.dynamic_slice(
+            bm, (0, 0, marg + dy, marg + dx), b.shape)
+
     outs = []
     for tj in range(-drad, drad + 1):
         for ti in range(-drad, drad + 1):
             # x2 displaced by (tj, ti)*stride2 relative to x1
-            shifted = jnp.roll(b, (-tj * stride2, -ti * stride2), axis=(2, 3))
-            prod = (a * shifted).sum(axis=1)  # [N, ph, pw]
-            # kernel window sum around each center
+            prod = (a * shifted_b(tj * stride2, ti * stride2)).sum(axis=1)
+            # kernel window sum around each center (zero-filled taps)
+            pm = jnp.pad(prod, ((0, 0), (krad, krad), (krad, krad)))
             ksum = jnp.zeros_like(prod)
             for j in range(-krad, krad + 1):
                 for i in range(-krad, krad + 1):
-                    ksum = ksum + jnp.roll(prod, (-j, -i), axis=(1, 2))
+                    ksum = ksum + lax.dynamic_slice(
+                        pm, (0, krad + j, krad + i), prod.shape)
             # centers: h1 = hout*stride1 + max_displacement
             hh = max_displacement + stride1 * jnp.arange(out_h)
             ww = max_displacement + stride1 * jnp.arange(out_w)
@@ -796,8 +808,9 @@ def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
     channel-mean dot product of a kernel_size^2 window of x with the window
     of y displaced by (tj, ti)*stride2. Valid centers start at
     max_displacement in the padded map (border_radius = kernel_rad +
-    max_displacement). jnp.roll wrap-around never reaches valid centers
-    because displacement+kernel stays within the border margin."""
+    max_displacement); displaced/kernel taps beyond the padded map read
+    zeros (explicit zero-filled shifts — the reference CUDA kernel reads
+    out of bounds there for kernel_size > 2*pad_size+1 configs)."""
     if int(kernel_size) % 2 != 1:
         raise ValueError("correlation: kernel_size must be odd")
     return _correlation_op(x, y, int(pad_size), int(kernel_size),
@@ -824,7 +837,10 @@ def _bilateral_slice_op(x, guide, grid, has_offset):
     def tent(d):
         return jnp.maximum(1.0 - jnp.abs(d), 0.0)
 
-    # accumulate the 8 trilinear corners; corner indices clamp to the grid
+    # accumulate the 8 trilinear corners; corner indices clamp to the grid.
+    # Per-pixel flat gather into the [gd*gh*gw] cell axis — never
+    # materializes a depth-expanded [N, gc, gd, H, W] intermediate
+    grid_flat = grid.reshape(n, gc, gd * gh * gw)
     coeff = jnp.zeros((n, gc, h, w), x.dtype)
     for dx in range(2):
         xx = fx + dx
@@ -838,10 +854,10 @@ def _bilateral_slice_op(x, guide, grid, has_offset):
                 zz = fz + dz                                    # [N, H, W]
                 z_ = jnp.clip(zz, 0, gd - 1).astype(jnp.int32)
                 wz = tent(zz + 0.5 - gz)                        # [N, H, W]
-                # grid[b, c, z_, y_, x_] gathered per pixel
-                g_zy = grid[:, :, :, y_, :][:, :, :, :, x_]     # [N, gc, gd, H, W]
+                lin = (z_ * gh + y_[None, :, None]) * gw + x_[None, None, :]
                 samp = jnp.take_along_axis(
-                    g_zy, z_[:, None, None, :, :], axis=2)[:, :, 0]
+                    grid_flat, lin.reshape(n, 1, h * w), axis=2
+                ).reshape(n, gc, h, w)
                 coeff = coeff + samp * (wx[None, None, None, :]
                                         * wy[None, None, :, None]
                                         * wz[:, None, :, :])

@@ -694,3 +694,309 @@ def test_locality_aware_nms_polygon_raises():
     with pytest.raises(NotImplementedError):
         D.locality_aware_nms(np.zeros((1, 3, 8), np.float32),
                              np.zeros((1, 1, 3), np.float32))
+
+
+def test_generate_proposal_labels():
+    """Numpy re-derivation of generate_proposal_labels_op.cc
+    SampleRoisForOneImage (use_random=False for determinism)."""
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    gt_boxes = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+    gt_classes = np.array([3, 7], np.int64)
+    is_crowd = np.array([0, 0], np.int64)
+    rois = np.array([
+        [1, 1, 11, 11],     # IoU with gt0 high -> fg label 3
+        [19, 19, 29, 29],   # fg label 7
+        [40, 40, 50, 50],   # no overlap -> bg
+        [0, 0, 40, 40],     # IoU ~0.07 with gt0 -> bg
+    ], np.float32)
+    im_info = np.array([[60, 60, 1.0]], np.float32)
+    cls = 8
+    (res,) = D.generate_proposal_labels(
+        rois, gt_classes, is_crowd, gt_boxes, im_info,
+        batch_size_per_im=6, fg_fraction=0.5, fg_thresh=0.5,
+        bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+        bbox_reg_weights=(1.0, 1.0, 1.0, 1.0), class_nums=cls,
+        use_random=False)
+
+    # boxes = [gt0, gt1, roi0..roi3]; fg = gt0(label3), gt1(label7),
+    # roi0(label3), roi1(label7) but capped at 6*0.5=3 fg -> first 3
+    labels = res["labels_int32"]
+    assert list(labels[:3]) == [3, 7, 3]
+    assert np.all(labels[3:] == 0)
+    assert res["rois"].shape[1] == 4
+    assert res["bbox_targets"].shape == (len(labels), 4 * cls)
+    # fg rows put their delta in the class slot, inside weights 1 there
+    for i, lbl in enumerate(labels):
+        if lbl > 0:
+            sl = res["bbox_inside_weights"][i, 4 * lbl: 4 * lbl + 4]
+            np.testing.assert_array_equal(sl, 1.0)
+            assert res["bbox_inside_weights"][i].sum() == 4.0
+        else:
+            assert res["bbox_inside_weights"][i].sum() == 0.0
+    # the gt rows ride along as perfect-overlap fg: delta == 0
+    np.testing.assert_allclose(res["bbox_targets"][0, 12:16], 0.0, atol=1e-6)
+    np.testing.assert_allclose(res["max_overlap_with_gt"][0], 1.0)
+
+    # im_scale round trip: rpn_rois arrive in the scaled image (divided by
+    # im_scale internally), gt_boxes stay in original coordinates — 2x-
+    # scaled rois with im_scale=2 make the same selection, and output rois
+    # come back multiplied by im_scale
+    im_info2 = np.array([[120, 120, 2.0]], np.float32)
+    (res2,) = D.generate_proposal_labels(
+        rois * 2.0, gt_classes, is_crowd, gt_boxes, im_info2,
+        batch_size_per_im=6, fg_fraction=0.5, fg_thresh=0.5,
+        bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+        bbox_reg_weights=(1.0, 1.0, 1.0, 1.0), class_nums=cls,
+        use_random=False)
+    np.testing.assert_array_equal(res2["labels_int32"], labels)
+    np.testing.assert_allclose(res2["rois"], res["rois"] * 2.0, rtol=1e-5)
+
+
+def test_generate_proposal_labels_crowd_and_cascade():
+    gt_boxes = np.array([[0, 0, 10, 10]], np.float32)
+    rois = np.array([[1, 1, 11, 11], [2, 2, 12, 12]], np.float32)
+    im_info = np.array([[60, 60, 1.0]], np.float32)
+    # crowd gt: its own row must not become fg
+    (res,) = D.generate_proposal_labels(
+        rois, np.array([5]), np.array([1]), gt_boxes, im_info,
+        batch_size_per_im=4, use_random=False, class_nums=6)
+    assert res["labels_int32"][0] == 0 or len(res["labels_int32"]) <= 3
+    # cascade: max_overlap filter keeps only confident rois, no subsample
+    (resc,) = D.generate_proposal_labels(
+        rois, np.array([5]), np.array([0]), gt_boxes, im_info,
+        is_cascade_rcnn=True, max_overlap=np.array([0.9, 0.1]),
+        fg_thresh=0.5, use_random=False, class_nums=6)
+    # roi1 (overlap 0.1) filtered out; gt + roi0 remain as candidates
+    assert len(resc["labels_int32"]) == 2
+
+
+def test_roi_perspective_transform_axis_aligned():
+    """An axis-aligned rectangular quad reduces the perspective warp to a
+    plain resize sample of the sub-rectangle — re-derivable in numpy
+    (roi_perspective_transform_op.cc get_transform_matrix/get_source_coords).
+    """
+    rng = np.random.default_rng(8)
+    H, W = 12, 12
+    img = rng.standard_normal((1, 2, H, W)).astype(np.float32)
+    # rectangle (2,3)-(9,3)-(9,8)-(2,8) in clockwise point order
+    rois = np.array([[2, 3, 9, 3, 9, 8, 2, 8]], np.float32)
+    th, tw = 4, 6
+    out, mask, tm = D.roi_perspective_transform(img, rois, th, tw, 1.0)
+    out = np.asarray(out._data if hasattr(out, "_data") else out)
+    mask = np.asarray(mask._data if hasattr(mask, "_data") else mask)
+    tm = np.asarray(tm._data if hasattr(tm, "_data") else tm)
+    assert out.shape == (1, 2, th, tw) and mask.shape == (1, 1, th, tw)
+    assert tm.shape == (1, 9)
+
+    # numpy re-derivation of the matrix + sampling for this quad
+    x0, y0, x1, y1, x2, y2, x3, y3 = rois[0]
+    len1 = np.hypot(x0 - x1, y0 - y1); len2 = np.hypot(x1 - x2, y1 - y2)
+    len3 = np.hypot(x2 - x3, y2 - y3); len4 = np.hypot(x3 - x0, y3 - y0)
+    est_h = (len2 + len4) / 2; est_w = (len1 + len3) / 2
+    nh = max(2, th)
+    nw = np.round(est_w * (nh - 1) / est_h) + 1
+    nw = max(2, min(nw, tw))
+    dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+    dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+    den = dx1 * dy2 - dx2 * dy1 + 1e-5
+    m = np.zeros(9)
+    m[6] = (dx3 * dy2 - dx2 * dy3) / den / (nw - 1)
+    m[7] = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+    m[8] = 1
+    m[3] = (y1 - y0 + m[6] * (nw - 1) * y1) / (nw - 1)
+    m[4] = (y3 - y0 + m[7] * (nh - 1) * y3) / (nh - 1)
+    m[5] = y0
+    m[0] = (x1 - x0 + m[6] * (nw - 1) * x1) / (nw - 1)
+    m[1] = (x3 - x0 + m[7] * (nh - 1) * x3) / (nh - 1)
+    m[2] = x0
+    np.testing.assert_allclose(tm[0], m, atol=1e-5, rtol=1e-4)
+
+    def bilinear(img_c, ih, iw):
+        iwc, ihc = np.clip(iw, 0, W - 1), np.clip(ih, 0, H - 1)
+        wf, hf = int(np.floor(iwc)), int(np.floor(ihc))
+        wc, hc = min(wf + 1, W - 1), min(hf + 1, H - 1)
+        fw, fh = iwc - wf, ihc - hf
+        return (img_c[hf, wf] * (1 - fw) * (1 - fh)
+                + img_c[hc, wf] * (1 - fw) * fh
+                + img_c[hc, wc] * fw * fh + img_c[hf, wc] * fw * (1 - fh))
+
+    for oh in range(th):
+        for ow in range(tw):
+            u = m[0] * ow + m[1] * oh + m[2]
+            v = m[3] * ow + m[4] * oh + m[5]
+            wq = m[6] * ow + m[7] * oh + m[8]
+            iw_, ih_ = u / wq, v / wq
+            inside = (2 - 1e-4 <= iw_ <= 9 + 1e-4
+                      and 3 - 1e-4 <= ih_ <= 8 + 1e-4)
+            if mask[0, 0, oh, ow]:
+                assert inside
+                np.testing.assert_allclose(
+                    out[0, 0, oh, ow], bilinear(img[0, 0], ih_, iw_),
+                    atol=1e-4, rtol=1e-4)
+            else:
+                assert out[0, 0, oh, ow] == 0.0
+
+
+def test_roi_perspective_transform_mask_outside():
+    """Grid points the quad doesn't cover are zero/masked."""
+    img = np.ones((1, 1, 10, 10), np.float32)
+    # narrow diagonal-ish quad leaves grid corners outside
+    rois = np.array([[0, 0, 9, 0, 9, 2, 0, 2]], np.float32)
+    out, mask, _ = D.roi_perspective_transform(img, rois, 8, 8, 1.0)
+    mask = np.asarray(mask._data if hasattr(mask, "_data") else mask)
+    out = np.asarray(out._data if hasattr(out, "_data") else out)
+    # some rows map below y=2 -> still inside; all sampled values are 1
+    assert mask.sum() > 0
+    np.testing.assert_allclose(out[0, 0][mask[0, 0] > 0], 1.0)
+
+
+def test_generate_mask_labels():
+    """Rectangle polygons give exact rasterized targets; class-slot
+    expansion follows ExpandMaskTarget (-1 elsewhere)."""
+    im_info = np.array([[60, 60, 1.0]], np.float32)
+    gt_classes = np.array([2, 3], np.int64)
+    is_crowd = np.array([0, 0], np.int64)
+    # gt0: square (0,0)-(8,8); gt1: square (20,20)-(28,28)
+    segms = [[[0.0, 0.0, 8.0, 0.0, 8.0, 8.0, 0.0, 8.0]],
+             [[20.0, 20.0, 28.0, 20.0, 28.0, 28.0, 20.0, 28.0]]]
+    rois = np.array([
+        [0, 0, 8, 8],       # fg on gt0
+        [19, 19, 29, 29],   # fg on gt1
+        [40, 40, 50, 50],   # bg
+    ], np.float32)
+    labels = np.array([2, 3, 0], np.int32)
+    res = 8
+    ncls = 5
+    (r,) = D.generate_mask_labels(im_info, gt_classes, is_crowd, segms, rois,
+                                  labels, num_classes=ncls, resolution=res)
+    assert r["mask_rois"].shape == (2, 4)
+    np.testing.assert_array_equal(r["roi_has_mask_int32"], [0, 1])
+    mt = r["mask_int32"]
+    assert mt.shape == (2, ncls * res * res)
+    m_sq = res * res
+    # roi0/class2 slot: roi == polygon box -> full ones
+    slot = mt[0, m_sq * 2: m_sq * 3].reshape(res, res)
+    np.testing.assert_array_equal(slot, 1)
+    # other slots stay -1
+    assert np.all(mt[0, : m_sq * 2] == -1) and np.all(mt[0, m_sq * 3:] == -1)
+    # roi1 covers gt1's square (20..28) within (19..29): interior ones,
+    # border ring zeros — check center vs corner
+    slot1 = mt[1, m_sq * 3: m_sq * 4].reshape(res, res)
+    assert slot1[res // 2, res // 2] == 1
+    assert slot1[0, 0] == 0
+
+    # no fg rois: degenerate -1 target
+    (r2,) = D.generate_mask_labels(im_info, gt_classes, is_crowd, segms,
+                                   rois, np.zeros(3, np.int32),
+                                   num_classes=ncls, resolution=res)
+    assert r2["mask_int32"].shape == (1, ncls * m_sq)
+    assert np.all(r2["mask_int32"] == -1)
+
+
+def test_deformable_psroi_pooling():
+    """Numpy re-derivation of deformable_psroi_pooling_op.cu (forward)."""
+    rng = np.random.default_rng(9)
+    N, od, gh, gw = 1, 2, 2, 2
+    C = od * gh * gw
+    H = W = 8
+    x = rng.standard_normal((N, C, H, W)).astype(np.float32)
+    rois = np.array([[1, 1, 5, 5], [0, 2, 6, 7]], np.float32)
+    ph = pw = 2
+    sp = 2
+    trans = rng.uniform(-0.5, 0.5, (2, 2, ph, pw)).astype(np.float32)
+    tstd = 0.1
+    out, cnt = D.deformable_psroi_pooling(
+        x, rois, trans, spatial_scale=1.0, output_dim=od,
+        group_size=(gh, gw), pooled_height=ph, pooled_width=pw,
+        sample_per_part=sp, trans_std=tstd)
+    out = np.asarray(out._data if hasattr(out, "_data") else out)
+    cnt = np.asarray(cnt._data if hasattr(cnt, "_data") else cnt)
+
+    def bilinear(plane, wq, hq):
+        wq, hq = min(max(wq, 0.0), W - 1), min(max(hq, 0.0), H - 1)
+        wf, hf = int(np.floor(wq)), int(np.floor(hq))
+        wc, hc = min(wf + 1, W - 1), min(hf + 1, H - 1)
+        fw, fh = wq - wf, hq - hf
+        return (plane[hf, wf] * (1 - fw) * (1 - fh)
+                + plane[hc, wf] * (1 - fw) * fh
+                + plane[hc, wc] * fw * fh + plane[hf, wc] * fw * (1 - fh))
+
+    ncls = trans.shape[1] // 2
+    cec = od // ncls
+    exp = np.zeros((2, od, ph, pw), np.float32)
+    expc = np.zeros((2, od, ph, pw), np.float32)
+    for n in range(2):
+        r = rois[n]
+        rsw, rsh = round(r[0]) - 0.5, round(r[1]) - 0.5
+        rew, reh = round(r[2]) + 1 - 0.5, round(r[3]) + 1 - 0.5
+        rw, rh = max(rew - rsw, 0.1), max(reh - rsh, 0.1)
+        bh, bw = rh / ph, rw / pw
+        sbh, sbw = bh / sp, bw / sp
+        for ct in range(od):
+            cid = ct // cec
+            for phi in range(ph):
+                for pwi in range(pw):
+                    pth = int(np.floor(phi / ph * ph))
+                    ptw = int(np.floor(pwi / pw * pw))
+                    tx = trans[n, 2 * cid, pth, ptw] * tstd
+                    ty = trans[n, 2 * cid + 1, pth, ptw] * tstd
+                    ws = pwi * bw + rsw + tx * rw
+                    hs = phi * bh + rsh + ty * rh
+                    g_w = min(max(pwi * gw // pw, 0), gw - 1)
+                    g_h = min(max(phi * gh // ph, 0), gh - 1)
+                    ch = (ct * gh + g_h) * gw + g_w
+                    s = 0.0; k = 0
+                    for ih in range(sp):
+                        for iw in range(sp):
+                            wq = ws + iw * sbw
+                            hq = hs + ih * sbh
+                            if (wq < -0.5 or wq > W - 0.5
+                                    or hq < -0.5 or hq > H - 0.5):
+                                continue
+                            s += bilinear(x[0, ch], wq, hq)
+                            k += 1
+                    exp[n, ct, phi, pwi] = 0.0 if k == 0 else s / k
+                    expc[n, ct, phi, pwi] = k
+    np.testing.assert_allclose(out, exp, atol=1e-4, rtol=1e-4)
+    np.testing.assert_array_equal(cnt, expc)
+
+
+def test_deformable_psroi_pooling_no_trans_grad():
+    """no_trans mode == plain PS-RoI average; grads flow to x and trans."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.vision.detection import deformable_psroi_pooling as dp
+
+    x = jnp.asarray(np.random.default_rng(10).standard_normal((1, 4, 6, 6)),
+                    jnp.float32)
+    rois = jnp.asarray([[0, 0, 5, 5]], jnp.float32)
+
+    def loss(x):
+        out, _ = dp(x, rois, no_trans=True, output_dim=1, group_size=(2, 2),
+                    pooled_height=2, pooled_width=2, sample_per_part=2)
+        a = out._data if hasattr(out, "_data") else out
+        return jnp.sum(a ** 2)
+
+    g = jax.grad(loss)(x)
+    assert np.isfinite(np.asarray(g)).all() and np.abs(np.asarray(g)).sum() > 0
+
+
+def test_roi_perspective_transform_grad_flows():
+    """Review r5: the warp is differentiable w.r.t. the feature map (the
+    reference registers an X-grad kernel)."""
+    import jax
+    import jax.numpy as jnp
+
+    img = jnp.asarray(np.random.default_rng(12).standard_normal((1, 1, 10, 10)),
+                      jnp.float32)
+    rois = np.array([[1, 1, 8, 1, 8, 8, 1, 8]], np.float32)
+
+    def loss(x):
+        out, _m, _t = D.roi_perspective_transform(x, rois, 4, 4, 1.0)
+        a = out._data if hasattr(out, "_data") else out
+        return jnp.sum(a ** 2)
+
+    g = np.asarray(jax.grad(loss)(img))
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0

@@ -405,3 +405,201 @@ class TestLoopBreadth:
         w = f(paddle.to_tensor([0.0]), paddle.to_tensor([1.0, 2.0]),
               paddle.to_tensor([2.0, 4.0]))
         assert abs(float(w._data[0]) - 2.0) < 0.1
+
+
+class TestContainersAndIteration:
+    """Ported reference dygraph_to_static patterns (VERDICT r4 #5):
+    test_for_enumerate.py (for-in-range-over-tensor, for-iter-list,
+    for-enumerate-list, for-iter-var, for-enumerate-var),
+    test_list.py (append without control flow / in if / in for+concat),
+    test_print.py, test_assert.py, nested function conversion
+    (program_translator.py:768)."""
+
+    def test_for_in_range_tensor_bound(self):
+        # test_for_enumerate.py for_in_range: trip count from a tensor VALUE
+        @to_static
+        def f(n):
+            z = paddle.to_tensor(0)
+            for i in range(n[0]):
+                z = z + i
+            return z
+
+        assert int(np.asarray(f(paddle.to_tensor([5]))._data)) == 10
+        assert int(np.asarray(f(paddle.to_tensor([0]))._data)) == 0
+
+    def test_for_iter_list(self):
+        @to_static
+        def f(xs):
+            z = paddle.to_tensor(0.0)
+            for x in xs:
+                z = z + x
+            return z
+
+        vals = [paddle.to_tensor(v) for v in (1.0, 2.0, 3.0)]
+        np.testing.assert_allclose(np.asarray(f(vals)._data), 6.0)
+
+    def test_for_enumerate_list(self):
+        @to_static
+        def f(xs):
+            z = paddle.to_tensor(0.0)
+            for i, x in enumerate(xs):
+                z = z + x + i
+            return z
+
+        vals = [paddle.to_tensor(v) for v in (1.0, 2.0)]
+        np.testing.assert_allclose(np.asarray(f(vals)._data), 4.0)
+
+    def test_for_iter_over_tensor(self):
+        # loop_transformer.py for-over-tensor: rows unroll on the static
+        # leading dim
+        @to_static
+        def f(x):
+            z = x[0] * 0.0
+            for row in x:
+                z = z + row
+            return z
+
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+        np.testing.assert_allclose(np.asarray(f(x)._data), [6.0, 9.0])
+
+    def test_for_enumerate_over_tensor(self):
+        @to_static
+        def f(x):
+            y = x[0] * 0.0
+            z = x[0] * 0.0
+            for i, row in enumerate(x):
+                y = y + i
+                z = z + row
+            return y, z
+
+        x = paddle.to_tensor(np.ones((3, 2), np.float32))
+        y, z = f(x)
+        np.testing.assert_allclose(np.asarray(y._data), [3.0, 3.0])
+        np.testing.assert_allclose(np.asarray(z._data), [3.0, 3.0])
+
+    def test_list_append_without_control_flow(self):
+        @to_static
+        def f(x):
+            a = []
+            a.append(x)
+            a.append(x * 2.0)
+            return a[0] + a[1]
+
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([1.0]))._data), [3.0])
+
+    def test_list_append_in_if_traced_pred(self):
+        # test_list.py test_list_append_in_if: both branches append one
+        # same-shaped value; the list rides through lax.cond as a pytree
+        @to_static
+        def f(x):
+            a = []
+            if x.sum() > 0:
+                a.append(x)
+            else:
+                a.append(x * -1.0)
+            return a[0]
+
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([2.0]))._data), [2.0])
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([-3.0]))._data), [3.0])
+
+    def test_list_append_in_for_with_concat(self):
+        # test_list.py test_list_append_in_for_subscript: the shape-derived
+        # bound is static under XLA, so appends unroll and concat sees a
+        # fixed-length list
+        @to_static
+        def f(x):
+            a = []
+            for i in range(x.shape[0]):
+                x = x + 1.0
+                a.append(x)
+            import paddle_tpu as pd
+
+            return pd.concat(a)[0]
+
+        x = paddle.to_tensor(np.zeros((3, 2), np.float32))
+        np.testing.assert_allclose(np.asarray(f(x)._data), [1.0, 1.0])
+
+    def test_print_traced(self, capfd):
+        @to_static
+        def f(x):
+            print("value:", x)
+            return x * 2.0
+
+        out = f(paddle.to_tensor([1.5]))
+        np.testing.assert_allclose(np.asarray(out._data), [3.0])
+        # traced print renders through jax.debug.print (async host cb)
+        import jax
+
+        jax.effects_barrier()
+        captured = capfd.readouterr()
+        assert "1.5" in captured.out
+
+    def test_assert_concrete_and_traced(self):
+        @to_static
+        def f(x):
+            assert x.shape[0] == 2, "static shape assert"
+            return x + 1.0
+
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([1.0, 2.0]))._data), [2.0, 3.0])
+        with pytest.raises(AssertionError):
+            f(paddle.to_tensor([1.0, 2.0, 3.0]))
+
+    def test_nested_function_conversion(self):
+        # program_translator.py:768: functions DEFINED inside the converted
+        # function get their control flow converted too
+        @to_static
+        def f(x):
+            def inner(v):
+                if v.sum() > 0:
+                    return v * 2.0
+                return v - 1.0
+
+            return inner(x) + inner(x * -1.0)
+
+        got = np.asarray(f(paddle.to_tensor([1.0]))._data)
+        # inner(1) = 2; inner(-1) = -2  -> 0... inner(-1): sum<0 -> -1-1=-2
+        np.testing.assert_allclose(got, [0.0])
+
+
+class TestStatementRewriteScoping:
+    """Review r5: the append rewrite must not capture closure mutation, and
+    pd_assert must keep Python truthiness for non-tensor predicates."""
+
+    def test_nested_closure_append_untouched(self):
+        @to_static
+        def f(x):
+            a = []
+
+            def add(v):
+                a.append(v)
+
+            add(x)
+            add(x * 2.0)
+            return a[0] + a[1]
+
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([1.0]))._data), [3.0])
+
+    def test_assert_empty_list_fails(self):
+        @to_static
+        def f(x):
+            results = []
+            assert results, "no detections"
+            return x
+
+        with pytest.raises(AssertionError, match="no detections"):
+            f(paddle.to_tensor([1.0]))
+
+    def test_assert_nonempty_list_passes(self):
+        @to_static
+        def f(x):
+            results = [1]
+            assert results
+            return x + len(results)
+
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([1.0]))._data), [2.0])

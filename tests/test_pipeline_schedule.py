@@ -316,6 +316,10 @@ class TestZeRO3Pipeline:
         {"pp": 2, "sharding": 2, "dp": 2},
         {"pp": 2, "sharding": 4},
         {"pp": 2, "mp": 2, "sharding": 2},
+        # the COMPLETE north-star composition: all four axes on one mesh
+        # (dp degenerate at 1 on 8 devices but present in every spec —
+        # sharding_optimizer.py:140's mp x sharding x pp x dp shape)
+        {"pp": 2, "mp": 2, "sharding": 2, "dp": 1},
     ])
     def test_stage3_step_matches_dense(self, axes):
         dist.init_mesh(axes)

@@ -2,10 +2,13 @@
 unittests/test_fake_quantize_op.py, test_imperative_qat.py)."""
 import numpy as np
 
+import jax.numpy as jnp
+
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 import paddle_tpu.optimizer as opt
 from paddle_tpu import quantization as Q
+from paddle_tpu.tensor import Tensor
 
 
 rng = np.random.default_rng(9)
@@ -138,3 +141,97 @@ class TestPTQ:
         qmodel.eval()
         qmodel(paddle.to_tensor(100 * rng.standard_normal((16, 4)).astype("float32")))
         assert qmodel[0].act_scale == scale_after_cal
+
+
+class TestInt8ArtifactEndToEnd:
+    """VERDICT r4 #6: calibration -> baked-scale int8 artifact -> Predictor.
+
+    Reference: trt_int8_calibrator.cc collects activation ranges from
+    sample batches and bakes them into the engine; here the calibrated EMA
+    scales ride the traced StableHLO as frozen buffers and the weights are
+    stored per-channel int8."""
+
+    def _calibrate_and_export(self, model, calib_x, spec, tmp_path, tag):
+        from paddle_tpu.quantization import (
+            PostTrainingQuantization, save_quantized_model)
+
+        loader = [(Tensor(jnp.asarray(b)),) for b in calib_x]
+        ptq = PostTrainingQuantization(model, loader)
+        qmodel = ptq.quantize()
+        path = str(tmp_path / tag)
+        save_quantized_model(qmodel, path, input_spec=spec)
+        return qmodel, path
+
+    def _predict(self, path, x):
+        from paddle_tpu.inference import Config, create_predictor
+
+        cfg = Config(path)
+        pred = create_predictor(cfg)
+        names = pred.get_input_names()
+        h = pred.get_input_handle(names[0])
+        h.copy_from_cpu(np.asarray(x))
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0])
+        return out.copy_to_cpu()
+
+    def test_vision_conv_net(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(0)
+        model = nn.Sequential(
+            nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(8, 8, 3, padding=1), nn.ReLU(),
+            nn.Flatten(), nn.Linear(8 * 8 * 8, 10))
+        rng = np.random.default_rng(0)
+        calib = [rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+                 for _ in range(4)]
+        x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+        model.eval()
+        fp_out = np.asarray(model(Tensor(jnp.asarray(x)))._data)
+
+        qmodel, path = self._calibrate_and_export(
+            model, calib, [InputSpec([-1, 3, 8, 8], "float32")], tmp_path,
+            "vision_int8")
+        got = self._predict(path, x)
+        # int8 QDQ keeps outputs close to fp (abs_max symmetric, 8 bits)
+        np.testing.assert_allclose(got, fp_out, atol=0.15, rtol=0.1)
+        err = np.abs(got - fp_out).mean() / (np.abs(fp_out).mean() + 1e-9)
+        assert err < 0.05, f"relative int8 error too large: {err}"
+
+        # measured size row: int8 artifact params ~4x smaller than f32
+        import os
+        sz_q = os.path.getsize(path + ".pdiparams")
+        n_params = sum(int(np.prod(p._data.shape))
+                       for p in qmodel.parameters())
+        assert sz_q < n_params * 4 * 0.5, (sz_q, n_params * 4)
+
+    def test_gpt_head(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(1)
+
+        class Head(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(64, 256)
+                self.act = nn.GELU()
+                self.fc2 = nn.Linear(256, 64)
+
+            def forward(self, x):
+                return self.fc2(self.act(self.fc1(x)))
+
+        model = Head()
+        rng = np.random.default_rng(1)
+        calib = [rng.standard_normal((4, 16, 64)).astype(np.float32)
+                 for _ in range(4)]
+        x = rng.standard_normal((4, 16, 64)).astype(np.float32)
+        model.eval()
+        fp_out = np.asarray(model(Tensor(jnp.asarray(x)))._data)
+        _, path = self._calibrate_and_export(
+            model, calib, [InputSpec([-1, 16, 64], "float32")], tmp_path,
+            "gpt_head_int8")
+        got = self._predict(path, x)
+        err = np.abs(got - fp_out).mean() / (np.abs(fp_out).mean() + 1e-9)
+        assert err < 0.08, f"relative int8 error too large: {err}"

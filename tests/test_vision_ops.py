@@ -612,3 +612,53 @@ class TestBilateralSlice:
             paddle.to_tensor(grid), has_offset)._data)
         exp = self._np_ref(x, guide, grid, has_offset)
         np.testing.assert_allclose(got, exp, atol=1e-4, rtol=1e-4)
+
+
+class TestCorrelationKernel3:
+    def test_vs_numpy_k3(self):
+        """kernel_size=3 exercises the zero-filled combined
+        displacement+kernel taps (the reference CUDA kernel reads out of
+        bounds there; this op defines them as zeros)."""
+        rng = np.random.default_rng(11)
+        n, c, h, w = 1, 2, 8, 8
+        pad, ksize, maxd, s1, s2 = 2, 3, 2, 1, 1
+        x1 = rng.standard_normal((n, c, h, w)).astype(np.float32)
+        x2 = rng.standard_normal((n, c, h, w)).astype(np.float32)
+        got = np.asarray(V.correlation(
+            paddle.to_tensor(x1), paddle.to_tensor(x2), pad, ksize, maxd,
+            s1, s2)._data)
+
+        krad = (ksize - 1) // 2
+        drad = maxd // s2
+        border = krad + maxd
+        ph_, pw_ = h + 2 * pad, w + 2 * pad
+        out_h = int(np.ceil((ph_ - 2 * border) / s1))
+        out_w = int(np.ceil((pw_ - 2 * border) / s1))
+        a = np.pad(x1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        b = np.pad(x2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+
+        def read(arr, bi, ch, y, x):
+            if 0 <= y < ph_ and 0 <= x < pw_:
+                return arr[bi, ch, y, x]
+            return 0.0
+
+        D_ = 2 * drad + 1
+        exp = np.zeros((n, D_ * D_, out_h, out_w), np.float32)
+        nelems = ksize * ksize * c
+        for oh in range(out_h):
+            for ow in range(out_w):
+                h1, w1 = oh * s1 + maxd, ow * s1 + maxd
+                d = 0
+                for tj in range(-drad, drad + 1):
+                    for ti in range(-drad, drad + 1):
+                        h2, w2 = h1 + tj * s2, w1 + ti * s2
+                        acc = 0.0
+                        for j in range(-krad, krad + 1):
+                            for i in range(-krad, krad + 1):
+                                for ch in range(c):
+                                    acc += (read(a, 0, ch, h1 + j, w1 + i)
+                                            * read(b, 0, ch, h2 + j, w2 + i))
+                        exp[0, d, oh, ow] = acc / nelems
+                        d += 1
+        assert got.shape == exp.shape
+        np.testing.assert_allclose(got, exp, atol=1e-4, rtol=1e-4)
