@@ -131,11 +131,45 @@ def _hop_kind(my, src, causal):
     return jnp.where(src == my, 1, jnp.where(src < my, 2, 0)).astype(jnp.int32)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _ring_flash(q, k, v, axis_name, causal, scale, block_q, block_k, interpret):
-    o, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q,
-                           block_k, interpret)
+# Residuals-as-inputs remat structure (same design as
+# ops/pallas/flash_attention.py): the ring forward runs on stop_gradient'd
+# operands, its (o, lse) outputs are checkpoint_name-tagged with the SAME
+# names the flash policies save, and the gradient attaches via a
+# custom_vjp whose residuals are its inputs — a remat'd long-context layer
+# under 'selective'/'core_attn' never replays the n-hop ring forward
+# (ppermutes included) in backward.
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _ring_attach(q, k, v, o, lse, axis_name, causal, scale, block_q,
+                 block_k, interpret):
     return o
+
+
+def _ring_attach_fwd(q, k, v, o, lse, axis_name, causal, scale, block_q,
+                     block_k, interpret):
+    return o, (q, k, v, o, lse)
+
+
+def _ring_attach_bwd(axis_name, causal, scale, block_q, block_k, interpret,
+                     res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _ring_flash_bwd(axis_name, causal, scale, block_q, block_k,
+                                 interpret, res, do)
+    return dq, dk, dv, jnp.zeros_like(o), jnp.zeros_like(lse)
+
+
+_ring_attach.defvjp(_ring_attach_fwd, _ring_attach_bwd)
+
+
+def _ring_flash(q, k, v, axis_name, causal, scale, block_q, block_k, interpret):
+    from jax.ad_checkpoint import checkpoint_name
+
+    o, (_, _, _, _, lse) = _ring_flash_fwd(
+        lax.stop_gradient(q), lax.stop_gradient(k), lax.stop_gradient(v),
+        axis_name, causal, scale, block_q, block_k, interpret)
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return _ring_attach(q, k, v, o, lse, axis_name, causal, scale, block_q,
+                        block_k, interpret)
 
 
 def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
@@ -238,9 +272,6 @@ def _ring_flash_bwd(axis_name, causal, scale, block_q, block_k, interpret,
         dk_acc = lax.ppermute(dk_acc, axis_name, perm)
         dv_acc = lax.ppermute(dv_acc, axis_name, perm)
     return dq.astype(q.dtype), dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
-
-
-_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def _ring_attention_flash(q, k, v, axis_name, causal, sm_scale, interpret):

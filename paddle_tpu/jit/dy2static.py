@@ -116,6 +116,13 @@ def _probe_structs(fn, args):
     return structs, kinds
 
 
+def _copy_list_args(args):
+    """Fresh shallow copies of list-valued args — traced control flow
+    invokes branch/body closures several times (probe + trace), and
+    in-place list appends inside must not accumulate across calls."""
+    return tuple(list(a) if isinstance(a, list) else a for a in args)
+
+
 def pd_cond(pred, true_fn, false_fn, args=(), soft=()):
     """if/else dispatch: Python for concrete preds, lax.cond for traced.
 
@@ -135,8 +142,8 @@ def pd_cond(pred, true_fn, false_fn, args=(), soft=()):
 
     from ..tensor import Tensor
 
-    st_t, kinds_t = _probe_structs(true_fn, args)
-    st_f, kinds_f = _probe_structs(false_fn, args)
+    st_t, kinds_t = _probe_structs(true_fn, _copy_list_args(args))
+    st_f, kinds_f = _probe_structs(false_fn, _copy_list_args(args))
     n = len(st_t)
     # per position: either a constant (improper on both sides), or a
     # ref subtree whose leaves go through lax.cond
@@ -184,7 +191,7 @@ def pd_cond(pred, true_fn, false_fn, args=(), soft=()):
 
     def wrap(fn):
         def f(_):
-            out = fn(*args)
+            out = fn(*_copy_list_args(args))
             arrs = []
             for i in keep:
                 v = out[i]
@@ -256,8 +263,10 @@ def pd_list_append(lst, value):
     teachable error). Appends that GROW a ``lax.while_loop`` carry still
     raise jax's structure mismatch — XLA has no dynamic arrays (the
     reference's LoDTensorArray relies on its dynamic executor)."""
-    if isinstance(lst, list):
-        return lst + [value]
+    # mutate IN PLACE and return the same object: `b = a; a.append(x)`
+    # keeps b aliased exactly as in the untransformed code. The traced
+    # control-flow paths (pd_cond/pd_while) shallow-copy list args per
+    # branch invocation so repeated probe/trace calls don't double-append.
     lst.append(value)
     return lst
 
@@ -351,7 +360,7 @@ def pd_while(cond_fn, body_fn, init, soft=()):
                 "a tensor-dependent while carries a variable that is "
                 "undefined at loop entry; assign it before the loop")
         # aval discovery via eval_shape (no ops emitted into the trace)
-        structs, kinds = _probe_structs(body_fn, tuple(init))
+        structs, kinds = _probe_structs(body_fn, _copy_list_args(tuple(init)))
         for i in bad:
             if i in kinds:
                 const_pos[i] = init[i]  # never assigned a tensor: constant

@@ -1836,3 +1836,131 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
         return jnp.where(x >= 0, x, x * slope)
 
     return _op(x)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference:
+    operators/hierarchical_sigmoid_op.h + math/matrix_bit_code.h SimpleCode).
+
+    Default tree: class c encodes as ``c + num_classes``; walking the
+    code's bits gives, per level j, the internal-node weight row
+    ``(code >> (j+1)) - 1`` and the binary target ``(code >> j) & 1``.
+    ``pre_out[i, j] = clip(bias[node] + w[node] . x[i], -40, 40)`` for
+    levels on the path (zero off-path — the reference's padded slots
+    contribute the constant ln 2 via softplus, kept for parity), and
+    ``loss_i = sum_j softplus(pre_out) - sum_{j: bit set} pre_out``.
+
+    Custom tree: ``path_table``/``path_code`` rows give the node ids /
+    binary codes (entry < 0 = padding); rows are indexed by the sample's
+    label, or taken per sample when the leading dim equals the batch.
+    weight: [num_classes - 1, D] (default tree). Returns [N, 1] losses.
+    """
+    nc = int(num_classes)
+
+    @primitive
+    def _hs(x, lbl, w, b, ptab, pcode):
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        bsz = x.shape[0]
+        if ptab is None:
+            L = max(int(nc - 1).bit_length(), 1)
+            c = lbl + nc  # SimpleCode: root id 1 => encode as c + num_classes
+            js = jnp.arange(L)
+            node = (c[:, None] >> (js[None, :] + 1)) - 1      # [B, L]
+            bit = (c[:, None] >> js[None, :]) & 1
+            valid = ((c[:, None] >> (js[None, :] + 1)) > 0)
+        else:
+            rows = ptab if ptab.shape[0] == bsz else jnp.take(
+                ptab, lbl, axis=0)
+            codes = pcode if pcode.shape[0] == bsz else jnp.take(
+                pcode, lbl, axis=0)
+            node = rows.astype(jnp.int32)
+            bit = codes.astype(jnp.int32)
+            valid = node >= 0
+            node = jnp.where(valid, node, 0)
+        wn = jnp.take(w, node, axis=0)                        # [B, L, D]
+        pre = jnp.einsum("bld,bd->bl", wn, x)
+        if b is not None:
+            pre = pre + jnp.take(b.reshape(-1), node, axis=0)
+        pre = jnp.clip(pre, -40.0, 40.0)
+        pre = jnp.where(valid, pre, 0.0)
+        soft = jnp.log1p(jnp.exp(pre))                        # softplus
+        loss = soft.sum(-1) - jnp.where(valid & (bit > 0), pre, 0.0).sum(-1)
+        return loss[:, None]
+
+    return _hs(input, unwrap(label), weight,
+               None if bias is None else unwrap(bias),
+               None if path_table is None else unwrap(path_table),
+               None if path_code is None else unwrap(path_code))
+
+
+def nce(input, label, num_total_classes, weight, bias=None,  # noqa: A002
+        num_neg_samples=10, sampler="uniform", custom_dist=None,
+        sample_weight=None, seed=None, is_test=False, name=None):
+    """Noise-contrastive estimation loss (reference: operators/nce_op.h
+    NCEKernel; python fluid.layers.nce): per row, the true classes and
+    ``num_neg_samples`` sampled noise classes get logits
+    sigmoid(bias[c] + x . w[c]); cost sums -log(o/(o+b)) over true and
+    -log(b/(o+b)) over noise with b = P_noise(c) * num_neg_samples.
+
+    Samplers: 'uniform', 'log_uniform' (inverse-CDF draw of the reference
+    LogUniformSampler's (log(v+2)-log(v+1))/log(range+2) distribution) and
+    'custom_dist' (categorical over ``custom_dist`` — the reference's
+    alias tables are a CPU sampling trick and are not needed here).
+    Noise draws come from the framework PRNG each call. Returns [N, 1].
+    """
+    from ..random import split_key
+
+    n_neg = int(num_neg_samples)
+    nt = int(num_total_classes)
+    mode = {"uniform": 0, "log_uniform": 1, "custom_dist": 2}[sampler]
+    probs = None
+    if mode == 2:
+        if custom_dist is None:
+            raise ValueError("custom_dist sampler needs custom_dist probs")
+        probs = unwrap(custom_dist)
+    kd = jax.random.key_data(split_key())
+
+    @primitive
+    def _nce(x, lbl, w, b, sw, probs, kd):
+        key = jax.random.wrap_key_data(kd)
+        bsz = x.shape[0]
+        lbl2 = lbl.reshape(bsz, -1).astype(jnp.int32)
+        n_true = lbl2.shape[1]
+        rng_range = nt - 1  # reference samplers draw over [0, range]
+        if mode == 0:
+            neg = jax.random.randint(key, (bsz, n_neg), 0, rng_range + 1)
+            p_of = lambda c: jnp.full(c.shape, 1.0 / (rng_range + 1),
+                                      jnp.float32)
+        elif mode == 1:
+            u = jax.random.uniform(key, (bsz, n_neg))
+            log_range = jnp.log(float(rng_range + 2))
+            neg = jnp.clip(jnp.exp(u * log_range).astype(jnp.int32) - 1,
+                           0, rng_range)
+            p_of = lambda c: (jnp.log((c.astype(jnp.float32) + 2.0)
+                                      / (c.astype(jnp.float32) + 1.0))
+                              / log_range)
+        else:
+            neg = jax.random.categorical(
+                key, jnp.log(jnp.maximum(probs, 1e-30))[None, :],
+                shape=(bsz, n_neg))
+            p_of = lambda c: jnp.take(probs, c)
+        samples = jnp.concatenate([lbl2, neg.astype(jnp.int32)], axis=1)
+        logits = jnp.einsum("bd,bsd->bs", x, jnp.take(w, samples, axis=0))
+        if b is not None:
+            logits = logits + jnp.take(b.reshape(-1), samples, axis=0)
+        o = jax.nn.sigmoid(logits)
+        pb = p_of(samples) * n_neg
+        is_true = jnp.arange(samples.shape[1])[None, :] < n_true
+        cost = jnp.where(is_true, -jnp.log(o / (o + pb)),
+                         -jnp.log(pb / (o + pb)))
+        row = cost.sum(axis=1)
+        if sw is not None:
+            row = row * sw.reshape(-1)
+        return row[:, None]
+
+    return _nce(input, unwrap(label), weight,
+                None if bias is None else unwrap(bias),
+                None if sample_weight is None else unwrap(sample_weight),
+                probs, kd)

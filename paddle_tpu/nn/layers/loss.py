@@ -189,65 +189,6 @@ class CTCLoss(Layer):
         return _ctc(log_probs, unwrap(labels), unwrap(input_lengths), unwrap(label_lengths))
 
 
-class HSigmoidLoss(Layer):
-    """Hierarchical sigmoid (parity: hierarchical_sigmoid op) — default
-    complete-binary-tree mode."""
-
-    def __init__(self, feature_size, num_classes, weight_attr=None, bias_attr=None,
-                 is_custom=False, is_sparse=False, name=None):
-        super().__init__()
-        if is_custom:
-            raise NotImplementedError("custom-tree hsigmoid not supported in v1")
-        self.num_classes = num_classes
-        self.weight = self.create_parameter([num_classes - 1, feature_size], attr=weight_attr)
-        if bias_attr is False:
-            self.bias = None
-        else:
-            self.bias = self.create_parameter([num_classes - 1], attr=bias_attr, is_bias=True)
-
-    def forward(self, input, label):  # noqa: A002
-        import jax.numpy as jnp
-        import numpy as np
-
-        from ...ops._primitive import primitive, unwrap
-
-        num_classes = self.num_classes
-        # precompute path codes on host (labels are data-dependent: eager-only)
-        lbl = np.asarray(unwrap(label)).reshape(-1)
-        max_depth = int(np.ceil(np.log2(num_classes)))
-        paths = np.zeros((len(lbl), max_depth), np.int32)
-        codes = np.zeros((len(lbl), max_depth), np.float32)
-        mask = np.zeros((len(lbl), max_depth), np.float32)
-        for i, y in enumerate(lbl):
-            node = int(y) + num_classes - 1  # leaf index in full tree
-            d = 0
-            chain = []
-            while node > 0:
-                parent = (node - 1) // 2
-                is_right = node == 2 * parent + 2
-                chain.append((parent, 1.0 if is_right else 0.0))
-                node = parent
-            for d, (p, c) in enumerate(reversed(chain)):
-                if d < max_depth and p < num_classes - 1:
-                    paths[i, d] = p
-                    codes[i, d] = c
-                    mask[i, d] = 1.0
-
-        paths_j, codes_j, mask_j = jnp.asarray(paths), jnp.asarray(codes), jnp.asarray(mask)
-
-        @primitive
-        def _hs(input, weight, bias):
-            w = weight[paths_j]  # [N, D, feat]
-            logits = jnp.einsum("nf,ndf->nd", input, w)
-            if bias is not None:
-                logits = logits + bias[paths_j]
-            # sigmoid cross entropy with code targets
-            loss = jnp.maximum(logits, 0) - logits * codes_j + jnp.log1p(jnp.exp(-jnp.abs(logits)))
-            return jnp.sum(loss * mask_j, axis=1, keepdims=True)
-
-        return _hs(input, self.weight, self.bias)
-
-
 class SoftMarginLoss(Layer):
     def __init__(self, reduction="mean", name=None):
         super().__init__()
@@ -321,3 +262,28 @@ class MultiLabelSoftMarginLoss(Layer):
     def forward(self, input, label):  # noqa: A002
         return F.multi_label_soft_margin_loss(input, label, self.weight,
                                               self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid (reference python/paddle/nn/layer/loss.py
+    HSigmoidLoss over operators/hierarchical_sigmoid_op.h)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        from .. import initializer as init_mod
+
+        if not is_custom and num_classes < 2:
+            raise ValueError("num_classes must be >= 2 for the default tree")
+        self._num_classes = num_classes
+        rows = num_classes - 1 if not is_custom else num_classes
+        self.weight = self.create_parameter(
+            [rows, feature_size], attr=weight_attr,
+            default_initializer=init_mod.XavierNormal())
+        self.bias = (None if bias_attr is False
+                     else self.create_parameter([rows, 1], attr=bias_attr,
+                                                is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               self.bias, path_table, path_code)

@@ -32,6 +32,10 @@ __all__ = [
     "random_crop",
     "partial_concat",
     "partial_sum",
+    "cvm",
+    "shuffle_batch",
+    "data_norm",
+    "batch_fc",
 ]
 
 
@@ -276,3 +280,84 @@ def partial_sum(inputs, start_index=0, length=-1, name=None):
         return acc
 
     return _ps(*inputs)
+
+
+def cvm(input, cvm_ref, use_cvm=True, name=None):  # noqa: A002
+    """Click-value-model feature transform (cvm_op.h CvmComputeKernel):
+    the first two columns are (show, click); with use_cvm the output keeps
+    all columns with show -> log(show+1) and click -> log(click+1) -
+    log(show+1) (ctr in log space); without it the two cvm columns are
+    dropped. ``cvm_ref`` is the op-signature CVM input (used only by the
+    backward in the reference; accepted for parity)."""
+
+    @primitive
+    def _cvm(x):
+        if use_cvm:
+            c0 = jnp.log(x[:, 0:1] + 1.0)
+            c1 = jnp.log(x[:, 1:2] + 1.0) - c0
+            return jnp.concatenate([c0, c1, x[:, 2:]], axis=1)
+        return x[:, 2:]
+
+    return _cvm(input)
+
+
+def shuffle_batch(x, seed=None, startup_seed=0, name=None):
+    """Random row shuffle (shuffle_batch_op.h): rows (all leading dims
+    flattened) are permuted with a seeded engine. Returns (out,
+    shuffle_idx, seed_out) like the reference (seed_out = seed + 1 so
+    chained calls keep advancing). Uses the given int seed, else the
+    framework PRNG."""
+    from ..random import split_key
+
+    if seed is not None and not isinstance(seed, (int, np.integer)):
+        seed = int(np.asarray(unwrap(seed)).reshape(()))
+    if seed is None:
+        key = split_key()
+        seed_out = 0
+    else:
+        key = jax.random.PRNGKey(int(seed) if seed else int(startup_seed))
+        seed_out = (int(seed) if seed else int(startup_seed)) + 1
+    kd = jax.random.key_data(key)
+
+    @primitive(aux=1)
+    def _shuffle(x, kd):
+        key = jax.random.wrap_key_data(kd)
+        lead = int(np.prod(x.shape[:-1]))
+        idx = jax.random.permutation(key, lead)
+        flat = x.reshape(lead, x.shape[-1])
+        return jnp.take(flat, idx, axis=0).reshape(x.shape), idx
+
+    out, idx = _shuffle(x, kd)
+    return out, idx, np.int64(seed_out)
+
+
+def data_norm(x, batch_size, batch_sum, batch_square_sum, epsilon=1e-4,
+              name=None):
+    """Running-statistics normalization (data_norm_op.cc DataNormKernel —
+    the rec-sys feature normalizer): mean = batch_sum/batch_size,
+    scale = sqrt(batch_size/batch_square_sum), y = (x - mean) * scale.
+    Returns (y, means, scales); the statistics tensors are updated by the
+    training framework (the reference's stat accumulation lives in its
+    gradient op)."""
+
+    @primitive(aux=2)
+    def _dn(x, bsz, bsum, bsq):
+        means = bsum / bsz
+        scales = jnp.sqrt(bsz / bsq)
+        return (x - means[None, :]) * scales[None, :], means, scales
+
+    return _dn(x, unwrap(batch_size), unwrap(batch_sum),
+               unwrap(batch_square_sum))
+
+
+def batch_fc(input, w, bias, name=None):  # noqa: A002
+    """Per-slot batched fully connected (batch_fc_op.cu BatchedGEMM):
+    input [slot_pairs, ins, in_dim] x w [slot_pairs, in_dim, out_dim]
+    + bias [slot_pairs, out_dim] -> [slot_pairs, ins, out_dim]."""
+
+    @primitive
+    def _bfc(x, w, b):
+        out = jnp.einsum("sni,sio->sno", x, w)
+        return out + b[:, None, :]
+
+    return _bfc(input, w, bias)

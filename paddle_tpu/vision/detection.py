@@ -1429,9 +1429,9 @@ def roi_perspective_transform(x, rois, transformed_height, transformed_width,
     if rois_num is None:
         batch_ids = jnp.zeros((total,), jnp.int32)
     else:
-        bn = _arr(rois_num)
-        batch_ids = jnp.repeat(jnp.arange(bn.shape[0], dtype=jnp.int32), bn,
-                               total_repeat_length=total)
+        from .ops import _box_batch_ids
+
+        batch_ids = _box_batch_ids(_arr(rois_num), total)
 
     # differentiable w.r.t. x through the bilinear sample (the reference op
     # registers an X-grad kernel); mask/matrix ride as aux outputs
@@ -1680,9 +1680,9 @@ def deformable_psroi_pooling(x, rois, trans=None, rois_num=None,
     if rois_num is None:
         batch_ids = jnp.zeros((total,), jnp.int32)
     else:
-        bn = _arr(rois_num)
-        batch_ids = jnp.repeat(jnp.arange(bn.shape[0], dtype=jnp.int32), bn,
-                               total_repeat_length=total)
+        from .ops import _box_batch_ids
+
+        batch_ids = _box_batch_ids(_arr(rois_num), total)
     if no_trans or trans is None:
         tv = jnp.zeros((total, 2, part_h, part_w), jnp.float32)
         num_classes = 1

@@ -603,3 +603,59 @@ class TestStatementRewriteScoping:
 
         np.testing.assert_allclose(
             np.asarray(f(paddle.to_tensor([1.0]))._data), [2.0])
+
+
+class TestDictLenIsinstance:
+    """Ported reference patterns: test_dict.py (dict containers),
+    test_len.py (len of tensors), test_isinstance.py."""
+
+    def test_dict_of_tensors(self):
+        @to_static
+        def f(x):
+            cache = {}
+            cache["k"] = x * 2.0
+            cache["v"] = x + 1.0
+            if x.sum() > 0:
+                out = cache["k"]
+            else:
+                out = cache["v"]
+            return out
+
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([1.0]))._data), [2.0])
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([-1.0]))._data), [0.0])
+
+    def test_len_of_tensor(self):
+        @to_static
+        def f(x):
+            n = len(x)  # static leading dim
+            return x.sum() / n
+
+        np.testing.assert_allclose(
+            float(np.asarray(f(paddle.to_tensor([2.0, 4.0]))._data)), 3.0)
+
+    def test_isinstance_dispatch(self):
+        from paddle_tpu.tensor import Tensor as T
+
+        @to_static
+        def f(x):
+            if isinstance(x, T):
+                return x * 2.0
+            return x
+
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([3.0]))._data), [6.0])
+
+
+def test_list_alias_preserved_eager():
+    """Review r5: `b = a; a.append(x)` keeps b aliased (in-place append)."""
+    @to_static
+    def f(x):
+        a = []
+        b = a
+        a.append(x)
+        return b[0]
+
+    np.testing.assert_allclose(
+        np.asarray(f(paddle.to_tensor([7.0]))._data), [7.0])

@@ -2,6 +2,7 @@
 (OpTest pattern; reference kernels named per-op in the module)."""
 import math
 
+import jax.numpy as jnp
 import numpy as np
 
 import paddle_tpu as paddle
@@ -201,3 +202,61 @@ def test_retinanet_pixel_convention_and_im_scale():
     # x1 = 8-16 = -8, x2 = 8+16-1 = 23; y stays [0, 15]
     # /scale 2 -> [-4, 0, 11.5, 7.5], clip to [0, 31]
     np.testing.assert_allclose(out[0, 2:], [0.0, 0.0, 11.5, 7.5], atol=1e-3)
+
+
+def test_cvm():
+    from paddle_tpu.ops.misc_catalog import cvm
+
+    x = np.array([[3.0, 1.0, 5.0, 6.0], [0.0, 0.0, 7.0, 8.0]], np.float32)
+    got = _np(cvm(Tensor(jnp.asarray(x)), None, use_cvm=True))
+    exp = x.copy()
+    exp[:, 0] = np.log(x[:, 0] + 1)
+    exp[:, 1] = np.log(x[:, 1] + 1) - exp[:, 0]
+    np.testing.assert_allclose(got, exp, rtol=1e-6)
+    got2 = _np(cvm(Tensor(jnp.asarray(x)), None, use_cvm=False))
+    np.testing.assert_allclose(got2, x[:, 2:])
+
+
+def test_shuffle_batch():
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.misc_catalog import shuffle_batch
+
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    out, idx, seed_out = shuffle_batch(Tensor(jnp.asarray(x)), seed=5)
+    out, idx = _np(out), np.asarray(_np(idx))
+    assert sorted(idx.tolist()) == list(range(6))
+    np.testing.assert_allclose(out, x[idx])
+    assert seed_out == 6
+    # deterministic for the same seed
+    out2, idx2, _ = shuffle_batch(Tensor(jnp.asarray(x)), seed=5)
+    np.testing.assert_array_equal(idx, np.asarray(_np(idx2)))
+
+
+def test_data_norm():
+    from paddle_tpu.ops.misc_catalog import data_norm
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 3)).astype(np.float32)
+    bsz = np.full((3,), 10.0, np.float32)
+    bsum = rng.standard_normal(3).astype(np.float32) * 10
+    bsq = np.abs(rng.standard_normal(3)).astype(np.float32) * 10 + 5
+    y, means, scales = data_norm(Tensor(jnp.asarray(x)), bsz, bsum, bsq)
+    m = bsum / bsz
+    s = np.sqrt(bsz / bsq)
+    np.testing.assert_allclose(_np(means), m, rtol=1e-6)
+    np.testing.assert_allclose(_np(scales), s, rtol=1e-6)
+    np.testing.assert_allclose(_np(y), (x - m) * s, rtol=1e-5)
+
+
+def test_batch_fc():
+    from paddle_tpu.ops.misc_catalog import batch_fc
+
+    rng = np.random.default_rng(1)
+    s_, n_, i_, o_ = 3, 4, 5, 2
+    x = rng.standard_normal((s_, n_, i_)).astype(np.float32)
+    w = rng.standard_normal((s_, i_, o_)).astype(np.float32)
+    b = rng.standard_normal((s_, o_)).astype(np.float32)
+    got = _np(batch_fc(Tensor(jnp.asarray(x)), Tensor(jnp.asarray(w)),
+                       Tensor(jnp.asarray(b))))
+    exp = np.einsum("sni,sio->sno", x, w) + b[:, None, :]
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)

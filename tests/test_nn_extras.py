@@ -418,3 +418,171 @@ class TestRound2GapFill:
         s = paddle.bitwise_left_shift(
             paddle.to_tensor(np.asarray([1, 2], "int32")), 2)
         np.testing.assert_array_equal(np.asarray(s._data), [4, 8])
+
+
+class TestHSigmoidLoss:
+    """OpTest numpy re-derivation of hierarchical_sigmoid_op.h +
+    matrix_bit_code.h SimpleCode (default tree) and the custom-table path."""
+
+    def _np_ref(self, x, lbl, w, b, nc):
+        B, D = x.shape
+        L = max((nc - 1).bit_length(), 1)
+        out = np.zeros((B, 1), np.float64)
+        for i in range(B):
+            c = int(lbl[i]) + nc
+            length = c.bit_length() - 1
+            pre = np.zeros(L)
+            for j in range(L):
+                if j < length:
+                    node = (c >> (j + 1)) - 1
+                    v = w[node] @ x[i] + (b[node] if b is not None else 0.0)
+                    pre[j] = np.clip(v, -40.0, 40.0)
+            s = np.log1p(np.exp(pre)).sum()  # padded slots add ln 2 (parity)
+            for j in range(min(length, L)):
+                if (c >> j) & 1:
+                    s -= pre[j]
+            out[i, 0] = s
+        return out
+
+    def test_default_tree_matches_numpy(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(0)
+        B, D, nc = 5, 6, 7
+        x = rng.standard_normal((B, D)).astype(np.float32)
+        lbl = rng.integers(0, nc, (B,)).astype(np.int64)
+        w = rng.standard_normal((nc - 1, D)).astype(np.float32)
+        b = rng.standard_normal((nc - 1,)).astype(np.float32)
+        got = _np(F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(lbl),
+                                  nc, paddle.to_tensor(w), paddle.to_tensor(b)))
+        want = self._np_ref(x, lbl, w, b, nc)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_custom_path_table(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(1)
+        B, D, nc = 3, 4, 5
+        x = rng.standard_normal((B, D)).astype(np.float32)
+        lbl = np.array([0, 2, 4], np.int64)
+        w = rng.standard_normal((nc, D)).astype(np.float32)
+        # per-class node rows/codes, -1 = padding
+        ptab = np.array([[0, 1, -1], [0, 2, 3], [1, 2, -1],
+                         [0, 1, 2], [3, 4, -1]], np.int64)
+        pcode = np.array([[1, 0, 0], [0, 1, 1], [1, 1, 0],
+                          [0, 0, 1], [1, 0, 0]], np.int64)
+        got = _np(F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(lbl),
+                                  nc, paddle.to_tensor(w), None,
+                                  path_table=paddle.to_tensor(ptab),
+                                  path_code=paddle.to_tensor(pcode)))
+        want = np.zeros((B, 1))
+        for i in range(B):
+            rows, codes = ptab[lbl[i]], pcode[lbl[i]]
+            s = 0.0
+            for j in range(3):
+                if rows[j] < 0:
+                    s += np.log(2.0)  # padded slot parity (pre_out = 0)
+                    continue
+                v = np.clip(w[rows[j]] @ x[i], -40, 40)
+                s += np.log1p(np.exp(v))
+                if codes[j]:
+                    s -= v
+            want[i, 0] = s
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_layer_trains(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+
+        paddle.seed(0)
+        layer = nn.HSigmoidLoss(8, 6)
+        o = opt.SGD(0.2, parameters=layer.parameters())
+        rng = np.random.default_rng(2)
+        x = paddle.to_tensor(rng.standard_normal((16, 8)).astype("float32"))
+        lbl = paddle.to_tensor(rng.integers(0, 6, (16,)).astype("int64"))
+        first = None
+        for _ in range(30):
+            loss = layer(x, lbl).mean()
+            o.clear_grad(); loss.backward(); o.step()
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first
+
+
+class TestNCE:
+    """OpTest re-derivation of nce_op.h (uniform sampler, fixed samples by
+    seeding the framework PRNG)."""
+
+    def test_matches_numpy_uniform(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(3)
+        B, D, nc, nneg = 4, 5, 9, 6
+        x = rng.standard_normal((B, D)).astype(np.float32)
+        lbl = rng.integers(0, nc, (B, 1)).astype(np.int64)
+        w = rng.standard_normal((nc, D)).astype(np.float32)
+        b = rng.standard_normal((nc,)).astype(np.float32)
+        paddle.seed(7)
+        got = _np(F.nce(paddle.to_tensor(x), paddle.to_tensor(lbl), nc,
+                        paddle.to_tensor(w), paddle.to_tensor(b),
+                        num_neg_samples=nneg))
+        assert got.shape == (B, 1)
+        # per-row lower bound: the true-class term alone with o in (0,1)
+        assert np.isfinite(got).all() and (got > 0).all()
+
+        # deterministic under the framework PRNG: same seed, same loss
+        paddle.seed(7)
+        got2 = _np(F.nce(paddle.to_tensor(x), paddle.to_tensor(lbl), nc,
+                         paddle.to_tensor(w), paddle.to_tensor(b),
+                         num_neg_samples=nneg))
+        np.testing.assert_array_equal(got, got2)
+
+        # exact re-derivation for the TRUE-class terms: subtracting the
+        # numpy-computed true part leaves only noise terms (all >= 0 since
+        # -log(b/(o+b)) > 0)
+        o_true = 1.0 / (1.0 + np.exp(-(np.einsum("bd,bd->b", x, w[lbl[:, 0]])
+                                       + b[lbl[:, 0]])))
+        pb = (1.0 / nc) * nneg
+        true_cost = -np.log(o_true / (o_true + pb))
+        noise_part = got[:, 0] - true_cost
+        assert (noise_part > 0).all()
+
+    def test_log_uniform_and_custom(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(4)
+        B, D, nc = 3, 4, 8
+        x = rng.standard_normal((B, D)).astype(np.float32)
+        lbl = rng.integers(0, nc, (B, 1)).astype(np.int64)
+        w = rng.standard_normal((nc, D)).astype(np.float32)
+        paddle.seed(1)
+        a = _np(F.nce(paddle.to_tensor(x), paddle.to_tensor(lbl), nc,
+                      paddle.to_tensor(w), sampler="log_uniform"))
+        assert np.isfinite(a).all()
+        probs = np.full((nc,), 1.0 / nc, np.float32)
+        paddle.seed(1)
+        c = _np(F.nce(paddle.to_tensor(x), paddle.to_tensor(lbl), nc,
+                      paddle.to_tensor(w), sampler="custom_dist",
+                      custom_dist=paddle.to_tensor(probs)))
+        assert np.isfinite(c).all()
+
+    def test_grad_flows(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as opt
+
+        paddle.seed(0)
+        w = nn.Parameter(np.random.default_rng(5).standard_normal(
+            (6, 4)).astype(np.float32))
+        w.name = "w"
+        o = opt.SGD(0.1, parameters=[w])
+        x = paddle.to_tensor(np.random.default_rng(6).standard_normal(
+            (8, 4)).astype(np.float32))
+        lbl = paddle.to_tensor(np.zeros((8, 1), np.int64))
+        first = None
+        for _ in range(20):
+            loss = F.nce(x, lbl, 6, w, num_neg_samples=3).mean()
+            o.clear_grad(); loss.backward(); o.step()
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first
