@@ -16,6 +16,7 @@ from . import (
     logic,
     manipulation,
     math,
+    array,
     misc_catalog,
     random_ops,
     search,
@@ -23,6 +24,7 @@ from . import (
 )
 from ._primitive import inplace_guard, primitive, unwrap, wrap
 from .creation import *  # noqa: F401,F403
+from .array import *  # noqa: F401,F403
 from .misc_catalog import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403

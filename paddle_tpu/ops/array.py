@@ -1,0 +1,63 @@
+"""Tensor-array ops (reference: python/paddle/tensor/array.py over the
+write_to_array / read_from_array / lod_array_length framework ops,
+operators/controlflow — SURVEY App. A control-flow family).
+
+TPU-native redesign: a LoDTensorArray is a plain Python list of Tensors at
+trace time (static program = unrolled writes/reads). Concrete indices
+index the list exactly like the reference's dynamic executor; a TRACED
+index raises the teachable XLA error — dynamic array growth has no
+static-shape analog (use lax.scan-carried buffers for fixed-capacity
+dynamic indexing)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["create_array", "array_write", "array_read", "array_length"]
+
+
+def _idx(i):
+    v = i._data if isinstance(i, Tensor) else i
+    try:
+        return int(np.asarray(v).reshape(()))
+    except Exception as e:  # jax tracer
+        raise TypeError(
+            "tensor-array indices must be concrete under XLA (the reference "
+            "executes write_to_array dynamically; here the program is "
+            "traced once) — use python ints or eager tensors") from e
+
+
+def create_array(dtype=None, initialized_list=None, name=None):
+    """New tensor array, optionally seeded from a list."""
+    arr = []
+    if initialized_list is not None:
+        for v in initialized_list:
+            arr.append(v if isinstance(v, Tensor) else Tensor(v))
+    return arr
+
+
+def array_write(x, i, array=None, name=None):
+    """Write ``x`` at position ``i`` (extends the array when i == len)."""
+    if array is None:
+        array = []
+    i = _idx(i)
+    if i > len(array):
+        raise IndexError(
+            f"array_write index {i} beyond array length {len(array)}")
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    if i == len(array):
+        array.append(x)
+    else:
+        array[i] = x
+    return array
+
+
+def array_read(array, i, name=None):
+    return array[_idx(i)]
+
+
+def array_length(array, name=None):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(len(array), jnp.int64))

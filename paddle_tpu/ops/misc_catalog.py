@@ -36,6 +36,9 @@ __all__ = [
     "shuffle_batch",
     "data_norm",
     "batch_fc",
+    "tdm_child",
+    "filter_by_instag",
+    "sample_logits",
 ]
 
 
@@ -361,3 +364,131 @@ def batch_fc(input, w, bias, name=None):  # noqa: A002
         return out + b[:, None, :]
 
     return _bfc(input, w, bias)
+
+
+def tdm_child(x, tree_info, child_nums, name=None):
+    """TDM tree-index child lookup (tdm_child_op.h TDMChildInner — the
+    tree-based deep match retrieval structure, SURVEY App. A note): for
+    each node id, return its ``child_nums`` child ids from the tree_info
+    table (rows [item_id, layer, parent, child0..childN-1]) plus a mask of
+    which children are leaf items (tree_info[child][0] != 0). Nodes
+    without children (id 0 or child slot 0) emit zeros."""
+
+    @primitive(aux=1)
+    def _tdm(x, info):
+        ids = x.reshape(-1).astype(jnp.int32)
+        has_child = (ids != 0) & (info[ids, 3] != 0)
+        children = jnp.take(info[:, 3: 3 + int(child_nums)], ids, axis=0)
+        children = jnp.where(has_child[:, None], children, 0)
+        is_item = (jnp.take(info[:, 0], children.astype(jnp.int32)) != 0)
+        mask = jnp.where(has_child[:, None], is_item, False)
+        shape = x.shape + (int(child_nums),)
+        return (children.astype(jnp.int32).reshape(shape),
+                mask.astype(jnp.int32).reshape(shape))
+
+    return _tdm(x, unwrap(tree_info))
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0, ins_lengths=None, tag_lengths=None,
+                     name=None):
+    """Instance filtering by tag membership (filter_by_instag_op.h — the
+    rec-sys multi-task router): keep every instance whose tag list
+    intersects ``filter_tag``.
+
+    Dense+lengths redesign of the LoD interface: ``ins`` [N, D] rows with
+    optional ``ins_lengths`` grouping rows into instances (is_lod=True ≙
+    the reference's LoD level; default one row per instance), ``ins_tag``
+    flat tag ids with ``tag_lengths`` per instance. Host op (the reference
+    kernel is CPU-only). Returns (out rows, index_map [kept, 3] rows of
+    (out_start, in_start, length), loss_weight [kept, 1]); when nothing
+    matches, one zero row filled with ``out_val_if_empty`` and loss_weight
+    0 (reference empty-case contract)."""
+    x = np.asarray(unwrap(ins))
+    tags = np.asarray(unwrap(ins_tag), np.int64).reshape(-1)
+    ftag = set(np.asarray(unwrap(filter_tag), np.int64).reshape(-1).tolist())
+    n_inst = (len(ins_lengths) if (is_lod and ins_lengths is not None)
+              else x.shape[0])
+    il = (np.asarray(ins_lengths, np.int64) if (is_lod and ins_lengths is not None)
+          else np.ones(n_inst, np.int64))
+    tl = (np.asarray(tag_lengths, np.int64) if tag_lengths is not None
+          else np.ones(n_inst, np.int64))
+    ins_starts = np.concatenate([[0], np.cumsum(il)[:-1]])
+    tag_starts = np.concatenate([[0], np.cumsum(tl)[:-1]])
+
+    rows, maps = [], []
+    out_start = 0
+    for i in range(n_inst):
+        t = tags[tag_starts[i]: tag_starts[i] + tl[i]]
+        if ftag.intersection(t.tolist()):
+            s, ln = int(ins_starts[i]), int(il[i])
+            rows.append(x[s: s + ln])
+            maps.append([out_start, s, ln])
+            out_start += ln
+    if rows:
+        out = np.concatenate(rows, axis=0)
+        index_map = np.asarray(maps, np.int64)
+        loss_weight = np.ones((len(maps), 1), np.float32)
+    else:
+        out = np.full((1, x.shape[1]), out_val_if_empty, x.dtype)
+        index_map = np.zeros((1, 3), np.int64)
+        loss_weight = np.zeros((1, 1), np.float32)
+    return out, index_map, loss_weight
+
+
+def sample_logits(logits, labels, num_samples, remove_accidental_hits=True,
+                  use_customized_samples=False, customized_samples=None,
+                  customized_probabilities=None, seed=None, name=None):
+    """Sampled-softmax helper (sample_logits_op.h SampleLogitsKernel):
+    gather the true-label and sampled-class logits, knock 1e20 off sampled
+    columns that collide with a row's true labels, and subtract log q so a
+    plain softmax-CE over [B, num_true + num_samples] with labels 0..T-1
+    trains the full-vocab softmax.
+
+    Sampling: shared log-uniform candidates with the expected-count
+    probability q(v) = 1 - (1 - p(v))^num_samples (the reference's
+    SampleWithProb draws unique candidates via retries; the closed form is
+    the same expectation, TF candidate-sampler convention). Pass
+    ``use_customized_samples`` for exact externally-chosen candidates.
+    Returns (samples [B, T+S], probabilities, sampled_logits,
+    sampled_labels [B, T] = arange(T))."""
+    from ..random import split_key
+
+    lg = unwrap(logits)
+    lbl = np.asarray(unwrap(labels), np.int64)
+    if lbl.ndim == 1:
+        lbl = lbl[:, None]
+    bsz, n_true = lbl.shape
+    nc = int(lg.shape[1])
+    s = int(num_samples)
+
+    if use_customized_samples:
+        samples = np.asarray(unwrap(customized_samples), np.int64)
+        probs = np.asarray(unwrap(customized_probabilities))
+    else:
+        key = (jax.random.PRNGKey(int(seed)) if seed is not None
+               else split_key())
+        u = np.asarray(jax.random.uniform(key, (s,)))
+        log_range = np.log(nc + 1.0)
+        cand = np.clip(np.exp(u * log_range).astype(np.int64) - 1, 0, nc - 1)
+        samples = np.concatenate(
+            [lbl, np.broadcast_to(cand, (bsz, s))], axis=1)
+        p = np.log((samples + 2.0) / (samples + 1.0)) / log_range
+        probs = 1.0 - np.power(1.0 - p, s)
+
+    @primitive(aux=3)
+    def _sl(lg, samples, probs):
+        sam = jnp.asarray(samples, jnp.int32)
+        sl = jnp.take_along_axis(lg, sam, axis=1)
+        if remove_accidental_hits:
+            true_part = sam[:, :n_true]                     # [B, T]
+            hits = (sam[:, None, n_true:] == true_part[:, :, None]).any(1)
+            sl = sl.at[:, n_true:].add(jnp.where(hits, -1e20, 0.0))
+        sl = sl - jnp.log(jnp.maximum(jnp.asarray(probs, sl.dtype), 1e-30))
+        sl = jnp.clip(sl, -1e10, 1e10)  # TolerableValue
+        lbls = jnp.broadcast_to(jnp.arange(n_true, dtype=jnp.int64),
+                                (lg.shape[0], n_true))
+        return sl, jnp.asarray(samples), jnp.asarray(probs), lbls
+
+    sl, sam, pr, lab = _sl(lg, samples, probs)
+    return sam, pr, sl, lab

@@ -58,6 +58,7 @@ __all__ = [
     "roi_perspective_transform",
     "generate_mask_labels",
     "deformable_psroi_pooling",
+    "retinanet_target_assign",
 ]
 
 _BBOX_CLIP = math.log(1000.0 / 16.0)  # bbox_util.h kBBoxClipDefault
@@ -1018,15 +1019,12 @@ def rpn_target_assign(anchors, gt_boxes, im_info, gt_counts=None,
                 "bbox_inside_weight": np.zeros((0, 4), np.float32),
             })
             continue
-        iou = np.asarray(_pairwise_iou(
-            jnp.asarray(a, jnp.float32), jnp.asarray(gt, jnp.float32), False))
-        a2g_max = iou.max(axis=1) if len(gt) else np.zeros(len(a))
-        a2g_arg = iou.argmax(axis=1) if len(gt) else np.zeros(len(a), int)
-        g2a_max = iou.max(axis=0) if len(gt) else np.zeros(0)
-        is_best = np.zeros(len(a), bool)
-        for j in range(len(gt)):
-            if g2a_max[j] > 0:  # a gt overlapping nothing marks no anchor
-                is_best |= np.abs(iou[:, j] - g2a_max[j]) < 1e-5
+        if len(gt):
+            a2g_max, a2g_arg, is_best = _match_anchors_np(a, gt)
+        else:
+            a2g_max = np.zeros(len(a))
+            a2g_arg = np.zeros(len(a), int)
+            is_best = np.zeros(len(a), bool)
         fg_mask = is_best | (a2g_max >= rpn_positive_overlap)
         fg_inds = np.where(fg_mask)[0]
         n_fg = int(rpn_fg_fraction * rpn_batch_size_per_im)
@@ -1048,19 +1046,7 @@ def rpn_target_assign(anchors, gt_boxes, im_info, gt_counts=None,
             inside_w = np.zeros((1, 4), np.float32)
         # encoded regression targets for the fg anchors
         if len(gt) and len(fg_inds):
-            ga = gt[a2g_arg[fg_inds]]
-            aa = a[fg_inds]
-            aw = aa[:, 2] - aa[:, 0] + 1.0
-            ah = aa[:, 3] - aa[:, 1] + 1.0
-            acx = aa[:, 0] + 0.5 * aw
-            acy = aa[:, 1] + 0.5 * ah
-            gw = ga[:, 2] - ga[:, 0] + 1.0
-            gh = ga[:, 3] - ga[:, 1] + 1.0
-            gcx = ga[:, 0] + 0.5 * gw
-            gcy = ga[:, 1] + 0.5 * gh
-            tgt_bbox = np.stack([
-                (gcx - acx) / aw, (gcy - acy) / ah,
-                np.log(gw / aw), np.log(gh / ah)], axis=1).astype(np.float32)
+            tgt_bbox = _encode_deltas_np(a[fg_inds], gt[a2g_arg[fg_inds]])
         else:
             tgt_bbox = np.zeros((len(fg_inds), 4), np.float32)
         score_index = np.concatenate([fg_inds, bg_inds]).astype(np.int64)
@@ -1260,6 +1246,39 @@ def locality_aware_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
         return out.reshape(-1, 6), cnt
 
     return _nms(bb, sc)
+
+
+def _match_anchors_np(anchors, gt):
+    """Anchor↔gt matching stats shared by the target-assign family
+    (rpn_target_assign_op.cc ScoreAssign): per-anchor max/argmax IoU plus
+    the is-some-gt's-best-anchor mask (1e-5 tie tolerance)."""
+    iou = np.asarray(_pairwise_iou(
+        jnp.asarray(anchors, jnp.float32), jnp.asarray(gt, jnp.float32),
+        False))
+    a_max = iou.max(axis=1)
+    a_arg = iou.argmax(axis=1)
+    g_max = iou.max(axis=0)
+    is_best = np.zeros(len(anchors), bool)
+    for j in range(len(gt)):
+        if g_max[j] > 0:  # a gt overlapping nothing marks no anchor
+            is_best |= np.abs(iou[:, j] - g_max[j]) < 1e-5
+    return a_max, a_arg, is_best
+
+
+def _encode_deltas_np(anchors, gts):
+    """(+1)-width center/size deltas (bbox_util.h BoxToDelta, unweighted) —
+    the rpn/retinanet regression-target encoding."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    gw = gts[:, 2] - gts[:, 0] + 1.0
+    gh = gts[:, 3] - gts[:, 1] + 1.0
+    gcx = gts[:, 0] + 0.5 * gw
+    gcy = gts[:, 1] + 0.5 * gh
+    return np.stack([
+        (gcx - acx) / aw, (gcy - acy) / ah,
+        np.log(gw / aw), np.log(gh / ah)], axis=1).astype(np.float32)
 
 
 def _box_to_delta(ex, gt, weights, normalized=False):
@@ -1763,3 +1782,63 @@ def deformable_psroi_pooling(x, rois, trans=None, rois_num=None,
 
     out, cnt = _dpsroi(xv, rv, tv, batch_ids)
     return out, cnt
+
+
+def retinanet_target_assign(anchors, gt_boxes, gt_labels, is_crowd, im_info,
+                            gt_counts=None, positive_overlap=0.5,
+                            negative_overlap=0.4, name=None):
+    """RetinaNet training targets (rpn_target_assign_op.cc
+    RetinanetTargetAssignKernel): the rpn assignment WITHOUT subsampling —
+    every anchor whose max IoU >= positive_overlap (or that is some gt's
+    best anchor) is fg with the gt's CLASS label, every anchor below
+    negative_overlap is bg (label 0), the rest are ignored; crowd gts are
+    filtered out before matching. Outputs per image add the fg count
+    (focal loss normalizer). Host op like the rpn sibling."""
+    an = np.asarray(_arr(anchors), np.float64).reshape(-1, 4)
+    gtb_all = np.asarray(_arr(gt_boxes), np.float64).reshape(-1, 4)
+    gtl_all = np.asarray(_arr(gt_labels), np.int64).reshape(-1)
+    crowd_all = np.asarray(_arr(is_crowd), np.int64).reshape(-1)
+    # im_info accepted for op-signature parity; the retinanet kernel does
+    # no straddle filtering (unlike the rpn sibling)
+    if gt_counts is None:
+        gcs = np.asarray([len(gtb_all)], np.int64)
+    else:
+        gcs = np.asarray(_arr(gt_counts), np.int64).reshape(-1)
+
+    out = []
+    g_off = 0
+    for b in range(len(gcs)):
+        gtb = gtb_all[g_off: g_off + int(gcs[b])]
+        gtl = gtl_all[g_off: g_off + int(gcs[b])]
+        crowd = crowd_all[g_off: g_off + int(gcs[b])]
+        g_off += int(gcs[b])
+        keep_gt = ~crowd.astype(bool)
+        gtb, gtl = gtb[keep_gt], gtl[keep_gt]
+
+        if len(gtb):
+            a_max, a_arg, is_best = _match_anchors_np(an, gtb)
+            fg_mask = is_best | (a_max >= positive_overlap)
+        else:
+            a_max = np.zeros(len(an))
+            a_arg = np.zeros(len(an), int)
+            fg_mask = np.zeros(len(an), bool)
+        fg_inds = np.where(fg_mask)[0]
+        bg_inds = np.where((a_max < negative_overlap) & ~fg_mask)[0]
+
+        if len(gtb) and len(fg_inds):
+            tgt_bbox = _encode_deltas_np(an[fg_inds], gtb[a_arg[fg_inds]])
+            labels = gtl[a_arg[fg_inds]].astype(np.int32)
+        else:
+            tgt_bbox = np.zeros((len(fg_inds), 4), np.float32)
+            labels = np.zeros(len(fg_inds), np.int32)
+
+        out.append({
+            "loc_index": fg_inds.astype(np.int64),
+            "score_index": np.concatenate([fg_inds, bg_inds]).astype(np.int64),
+            "tgt_bbox": tgt_bbox,
+            "tgt_label": np.concatenate(
+                [labels, np.zeros(len(bg_inds), np.int32)]),
+            "bbox_inside_weight": np.ones((len(fg_inds), 4), np.float32),
+            "fg_num": np.int32(len(fg_inds) + 1),  # reference: fg + 1
+        })
+    return out

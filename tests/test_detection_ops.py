@@ -1000,3 +1000,30 @@ def test_roi_perspective_transform_grad_flows():
 
     g = np.asarray(jax.grad(loss)(img))
     assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_retinanet_target_assign():
+    """rpn_target_assign_op.cc RetinanetTargetAssignKernel: no subsampling,
+    class labels for fg, crowd gts filtered, fg_num = fg + 1."""
+    anchors = np.array([
+        [0, 0, 10, 10],    # high IoU with gt0 -> fg class 3
+        [20, 20, 30, 30],  # high IoU with gt1 (crowd -> filtered)
+        [50, 50, 60, 60],  # no overlap -> bg
+        [3, 3, 12, 12],    # IoU ~0.41 with gt0 -> between 0.4/0.5 -> ignored
+    ], np.float32)
+    gt = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+    gtl = np.array([3, 5], np.int64)
+    crowd = np.array([0, 1], np.int64)
+    im_info = np.array([[100, 100, 1.0]], np.float32)
+    (res,) = D.retinanet_target_assign(anchors, gt, gtl, crowd, im_info,
+                                       positive_overlap=0.5,
+                                       negative_overlap=0.4)
+    assert list(res["loc_index"]) == [0]
+    assert res["tgt_label"][0] == 3              # class label, not binary
+    # anchor1 no longer matches anything after crowd filtering -> bg;
+    # anchor3 sits in the ignore band
+    si = set(res["score_index"].tolist())
+    assert 1 in si and 2 in si and 3 not in si
+    assert res["fg_num"] == 2                    # fg(1) + 1
+    # encoded deltas are zero for the exact-match anchor
+    np.testing.assert_allclose(res["tgt_bbox"][0], 0.0, atol=1e-6)

@@ -260,3 +260,85 @@ def test_batch_fc():
                        Tensor(jnp.asarray(b))))
     exp = np.einsum("sni,sio->sno", x, w) + b[:, None, :]
     np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_tdm_child():
+    from paddle_tpu.ops.misc_catalog import tdm_child
+
+    # tree rows: [item_id, layer, parent, child0, child1]
+    info = np.array([
+        [0, 0, 0, 0, 0],    # node 0: pad
+        [0, 0, 0, 2, 3],    # node 1: internal, children 2,3
+        [7, 1, 1, 0, 0],    # node 2: leaf item 7
+        [0, 1, 1, 4, 0],    # node 3: internal, child 4
+        [9, 2, 3, 0, 0],    # node 4: leaf item 9
+    ], np.int64)
+    x = np.array([[1], [2], [3]], np.int64)
+    child, mask = tdm_child(Tensor(jnp.asarray(x)), info, child_nums=2)
+    child, mask = _np(child), _np(mask)
+    np.testing.assert_array_equal(child[0, 0], [2, 3])   # node 1 children
+    np.testing.assert_array_equal(mask[0, 0], [1, 0])    # 2 is item, 3 not
+    np.testing.assert_array_equal(child[1, 0], [0, 0])   # leaf: no children
+    np.testing.assert_array_equal(mask[1, 0], [0, 0])
+    np.testing.assert_array_equal(child[2, 0], [4, 0])   # child slot + pad
+    np.testing.assert_array_equal(mask[2, 0], [1, 0])
+
+
+def test_filter_by_instag():
+    from paddle_tpu.ops.misc_catalog import filter_by_instag
+
+    # 3 instances of 2/1/1 rows; tags: {1,2}, {3}, {2}
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+    tags = np.array([1, 2, 3, 2], np.int64)
+    out, imap, lw = filter_by_instag(
+        x, tags, np.array([2], np.int64), is_lod=True,
+        ins_lengths=[2, 1, 1], tag_lengths=[2, 1, 1])
+    # instances 0 (tags 1,2) and 2 (tag 2) kept
+    np.testing.assert_allclose(out, np.concatenate([x[0:2], x[3:4]]))
+    np.testing.assert_array_equal(imap, [[0, 0, 2], [2, 3, 1]])
+    np.testing.assert_allclose(lw, [[1.0], [1.0]])
+
+    # nothing matches -> out_val_if_empty row, zero weight
+    out2, imap2, lw2 = filter_by_instag(
+        x, tags, np.array([9], np.int64), is_lod=True,
+        ins_lengths=[2, 1, 1], tag_lengths=[2, 1, 1], out_val_if_empty=7)
+    np.testing.assert_allclose(out2, np.full((1, 2), 7.0))
+    np.testing.assert_allclose(lw2, [[0.0]])
+
+
+def test_sample_logits_customized():
+    """Exact path with externally-chosen candidates (sample_logits_op.h:
+    gather + accidental-hit -1e20 + -log q + TolerableValue clamp)."""
+    from paddle_tpu.ops.misc_catalog import sample_logits
+
+    rng = np.random.default_rng(2)
+    B, C, T, S = 3, 10, 1, 4
+    logits = rng.standard_normal((B, C)).astype(np.float32)
+    labels = np.array([[2], [5], [7]], np.int64)
+    cust = np.concatenate(
+        [labels, np.tile(np.array([[1, 2, 8, 9]], np.int64), (B, 1))], axis=1)
+    probs = np.full((B, T + S), 0.25, np.float32)
+    sam, pr, sl, lab = sample_logits(
+        Tensor(jnp.asarray(logits)), labels, S,
+        use_customized_samples=True, customized_samples=cust,
+        customized_probabilities=probs)
+    sl = _np(sl)
+    exp = np.take_along_axis(logits, cust, axis=1).astype(np.float64)
+    exp[0, 1 + 1] -= 1e20  # row 0: sampled col '2' collides with label 2
+    exp = exp - np.log(0.25)
+    exp = np.clip(exp, -1e10, 1e10)
+    np.testing.assert_allclose(sl, exp, rtol=1e-5)
+    np.testing.assert_array_equal(_np(lab), np.zeros((B, 1), np.int64))
+
+
+def test_sample_logits_sampled_path():
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.misc_catalog import sample_logits
+
+    paddle.seed(3)
+    logits = np.random.default_rng(3).standard_normal((2, 20)).astype(np.float32)
+    labels = np.array([[4], [6]], np.int64)
+    sam, pr, sl, lab = sample_logits(Tensor(jnp.asarray(logits)), labels, 5)
+    assert _np(sam).shape == (2, 6) and _np(sl).shape == (2, 6)
+    assert (_np(sam)[:, 0] == labels[:, 0]).all()
+    assert np.isfinite(_np(pr)).all() and (_np(pr) > 0).all()

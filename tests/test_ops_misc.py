@@ -139,3 +139,48 @@ class TestSequenceOps:
         sm = paddle.sequence_softmax(seq, paddle.to_tensor(np.array([2, 4], "int64")))
         np.testing.assert_allclose(_np(sm).sum(-1), [1, 1], rtol=1e-6)
         assert np.allclose(_np(sm)[0, 2:], 0)
+
+
+class TestTensorArray:
+    """create_array/array_write/array_read/array_length (reference
+    python/paddle/tensor/array.py over write_to_array framework ops)."""
+
+    def test_write_read_length(self):
+        import paddle_tpu as paddle
+
+        arr = paddle.create_array()
+        x0 = paddle.to_tensor([1.0, 2.0])
+        x1 = paddle.to_tensor([3.0, 4.0])
+        arr = paddle.array_write(x0, 0, arr)
+        arr = paddle.array_write(x1, paddle.to_tensor(1), arr)
+        assert int(np.asarray(paddle.array_length(arr)._data)) == 2
+        np.testing.assert_allclose(
+            np.asarray(paddle.array_read(arr, 1)._data), [3.0, 4.0])
+        # overwrite
+        arr = paddle.array_write(x1 * 2.0, 0, arr)
+        np.testing.assert_allclose(
+            np.asarray(paddle.array_read(arr, 0)._data), [6.0, 8.0])
+
+    def test_initialized_list_and_bounds(self):
+        import paddle_tpu as paddle
+        import pytest as _pytest
+
+        arr = paddle.create_array(
+            initialized_list=[paddle.to_tensor([1.0])])
+        assert int(np.asarray(paddle.array_length(arr)._data)) == 1
+        with _pytest.raises(IndexError):
+            paddle.array_write(paddle.to_tensor([2.0]), 5, arr)
+
+    def test_under_to_static_concrete_indices(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def f(x):
+            arr = paddle.create_array()
+            for i in range(3):
+                arr = paddle.array_write(x * float(i + 1), i, arr)
+            return (paddle.array_read(arr, 0) + paddle.array_read(arr, 2))
+
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor([1.0]))._data), [4.0])

@@ -662,3 +662,59 @@ class TestCorrelationKernel3:
                         d += 1
         assert got.shape == exp.shape
         np.testing.assert_allclose(got, exp, atol=1e-4, rtol=1e-4)
+
+
+class TestPrRoIPool:
+    def test_vs_numerical_integration(self):
+        """The closed-form tent-integral contraction must match brute-force
+        numerical integration of the bilinear interpolant (prroi_pool_op.h
+        PrRoIPoolingMatCalculation semantics)."""
+        rng = np.random.default_rng(13)
+        H = W = 8
+        x = rng.standard_normal((1, 2, H, W)).astype(np.float32)
+        boxes = np.array([[1.3, 0.7, 6.2, 5.9], [0.0, 0.0, 3.0, 3.0]],
+                         np.float32)
+        ph = pw = 2
+        got = np.asarray(V.prroi_pool(
+            paddle.to_tensor(x), paddle.to_tensor(boxes), np.array([2]),
+            (ph, pw), 1.0)._data)
+
+        def bilin(img, yy, xx):
+            # zero outside the grid (PrRoIPoolingGetData)
+            val = 0.0
+            y0, x0 = int(np.floor(yy)), int(np.floor(xx))
+            for (yi, wy_) in ((y0, 1 - (yy - y0)), (y0 + 1, yy - y0)):
+                for (xi, wx_) in ((x0, 1 - (xx - x0)), (x0 + 1, xx - x0)):
+                    if 0 <= yi < H and 0 <= xi < W:
+                        val += wy_ * wx_ * img[yi, xi]
+            return val
+
+        S = 64  # quadrature points per axis
+        for b in range(2):
+            x1, y1, x2, y2 = boxes[b]
+            bh, bw = (y2 - y1) / ph, (x2 - x1) / pw
+            for c in range(2):
+                for i in range(ph):
+                    for j in range(pw):
+                        ys = y1 + (i + (np.arange(S) + 0.5) / S) * bh
+                        xs = x1 + (j + (np.arange(S) + 0.5) / S) * bw
+                        acc = np.mean([bilin(x[0, c], yy, xx)
+                                       for yy in ys for xx in xs])
+                        np.testing.assert_allclose(
+                            got[b, c, i, j], acc, atol=5e-3, rtol=5e-3)
+
+    def test_grad_flows_to_input(self):
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.asarray(np.random.default_rng(14).standard_normal((1, 1, 6, 6)),
+                        jnp.float32)
+        boxes = np.array([[0.5, 0.5, 5.0, 5.0]], np.float32)
+
+        def loss(x):
+            out = V.prroi_pool(x, boxes, np.array([1]), (2, 2), 1.0)
+            a = out._data if hasattr(out, "_data") else out
+            return jnp.sum(a ** 2)
+
+        g = np.asarray(jax.grad(loss)(x))
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
