@@ -9,6 +9,7 @@ Import as a drop-in shape: ``import paddle_tpu as paddle``.
 """
 from __future__ import annotations
 
+from . import _jax_compat  # noqa: F401  (must run before any lax.axis_size use)
 from . import device as _device_mod
 from . import dtype as _dtype_mod
 from . import random as _random_mod

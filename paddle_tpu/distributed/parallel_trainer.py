@@ -21,6 +21,7 @@ jitted over the global mesh with:
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -28,6 +29,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding
 
 from ..nn.layer import Layer
+from ..profiler.scope import scope as prof_scope
+from ..profiler.scope import timer_registry, timers_enabled
 from ..tensor import Tensor
 from .env import get_mesh
 from .spmd import P, sanitize_spec
@@ -259,9 +262,10 @@ class ParallelTrainer:
                 loss_fn_ = base_loss_fn
 
             if acc <= 1:
-                (loss, new_buffers), grads = jax.value_and_grad(loss_fn_, has_aux=True)(
-                    params, buffers, xb, yb, rng_key
-                )
+                with prof_scope("trainer.loss_grad"):
+                    (loss, new_buffers), grads = jax.value_and_grad(loss_fn_, has_aux=True)(
+                        params, buffers, xb, yb, rng_key
+                    )
             else:
                 # gradient merge (reference: gradient_merge_optimizer.py) as
                 # a lax.scan over microbatches
@@ -281,10 +285,11 @@ class ParallelTrainer:
                 zero_g = jax.tree_util.tree_map(
                     lambda a: jnp.zeros(a.shape, jnp.float32), params
                 )
-                (grads, loss_sum, new_buffers), _ = jax.lax.scan(
-                    body, (zero_g, jnp.zeros((), jnp.float32), buffers),
-                    (micro_x, micro_y, keys),
-                )
+                with prof_scope("trainer.loss_grad"):
+                    (grads, loss_sum, new_buffers), _ = jax.lax.scan(
+                        body, (zero_g, jnp.zeros((), jnp.float32), buffers),
+                        (micro_x, micro_y, keys),
+                    )
                 grads = jax.tree_util.tree_map(lambda g: g / acc, grads)
                 loss = loss_sum / acc
 
@@ -295,8 +300,9 @@ class ParallelTrainer:
                 finite = jnp.asarray(True)
                 for g in jax.tree_util.tree_leaves(grads):
                     finite = finite & jnp.all(jnp.isfinite(g))
-                new_params, new_opt = self.optimizer.apply_gradients(
-                    params, grads, opt_state, lr=lr)
+                with prof_scope("trainer.optimizer_apply"):
+                    new_params, new_opt = self.optimizer.apply_gradients(
+                        params, grads, opt_state, lr=lr)
                 keep = lambda new, old: jax.tree_util.tree_map(
                     lambda a, b: jnp.where(finite, a, b), new, old)
                 new_params = keep(new_params, params)
@@ -317,8 +323,9 @@ class ParallelTrainer:
                 else:
                     new_scale_state = scale_state
             else:
-                new_params, new_opt = self.optimizer.apply_gradients(
-                    params, grads, opt_state, lr=lr)
+                with prof_scope("trainer.optimizer_apply"):
+                    new_params, new_opt = self.optimizer.apply_gradients(
+                        params, grads, opt_state, lr=lr)
                 new_scale_state = scale_state
 
             return new_params, new_opt, new_buffers, loss, new_scale_state
@@ -396,10 +403,14 @@ class ParallelTrainer:
         # lr enters as a runtime scalar so LR schedules take effect on the
         # compiled step (read at trace time it would be baked as a constant)
         lr_now = jnp.asarray(float(self.optimizer.get_lr()), jnp.float32)
+        t0 = time.perf_counter() if timers_enabled() else None
         self.params, self.opt_state, self.buffers, loss, self.scale_state = self._jit_step(
             self.params, self.opt_state, self.buffers, xb, yb, split_key(),
             self.scale_state, lr_now,
         )
+        if t0 is not None:
+            timer_registry.record("trainer.step.host_dispatch",
+                                  time.perf_counter() - t0)
         return Tensor(loss)
 
     def _host_apply(self, grads):

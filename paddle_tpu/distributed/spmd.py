@@ -10,18 +10,38 @@ over the global mesh + XLA GSPMD does all of it at compile time.
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Any, Callable, Optional
 
 import jax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..tensor import Tensor
 from .env import get_mesh
 
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map as _jax_shard_map
+except ImportError:  # older jax: experimental module
+    from jax.experimental.shard_map import shard_map as _jax_shard_map
+
+# the replication-check kwarg was renamed check_rep -> check_vma; detect
+# which one this jax spells so every call site can say check_vma
+_VMA_KW = next((k for k in ("check_vma", "check_rep")
+                if k in inspect.signature(_jax_shard_map).parameters), None)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False, **kw):
+    """Version-portable ``jax.shard_map``: accepts the current ``check_vma``
+    spelling and forwards it as whatever this jax calls it."""
+    if _VMA_KW is not None:
+        kw[_VMA_KW] = check_vma
+    return _jax_shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+
 P = PartitionSpec
 
-__all__ = ["P", "PartitionSpec", "run_on_mesh", "shard_array", "sanitize_spec", "with_sharding_constraint", "shard_tensor_to", "replicate"]
+__all__ = ["P", "PartitionSpec", "run_on_mesh", "shard_array", "sanitize_spec", "with_sharding_constraint", "shard_tensor_to", "replicate", "shard_map"]
 
 
 def run_on_mesh(fn: Callable, in_specs, out_specs, mesh: Optional[Mesh] = None, jit: bool = True):

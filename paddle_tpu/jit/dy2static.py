@@ -116,11 +116,40 @@ def _probe_structs(fn, args):
     return structs, kinds
 
 
+def _copy_value(v):
+    """Fresh containers around every list reachable through list/tuple/dict
+    nesting (leaves — tensors, arrays, scalars — are shared, not copied).
+    Container TYPES survive: namedtuples rebuild via their constructor,
+    dict subclasses via ``.copy()`` + per-key assignment (preserving e.g.
+    defaultdict's factory and Counter's counts). A subclass we cannot
+    rebuild safely is passed through unchanged (the pre-r6 behavior)."""
+    try:
+        if isinstance(v, list):
+            out = [_copy_value(x) for x in v]
+            return out if type(v) is list else type(v)(out)
+        if isinstance(v, tuple):
+            if hasattr(v, "_fields"):  # namedtuple
+                return type(v)(*(_copy_value(x) for x in v))
+            out = tuple(_copy_value(x) for x in v)
+            return out if type(v) is tuple else type(v)(out)
+        if isinstance(v, dict):
+            if type(v) is dict:
+                return {k: _copy_value(x) for k, x in v.items()}
+            out = v.copy()  # keeps type + metadata (default_factory, …)
+            for k in out:
+                out[k] = _copy_value(out[k])
+            return out
+    except Exception:
+        return v
+    return v
+
+
 def _copy_list_args(args):
-    """Fresh shallow copies of list-valued args — traced control flow
-    invokes branch/body closures several times (probe + trace), and
-    in-place list appends inside must not accumulate across calls."""
-    return tuple(list(a) if isinstance(a, list) else a for a in args)
+    """Fresh copies of list-valued args AT ANY NESTING LEVEL (inside
+    tuples/dicts too, ADVICE r5 #3) — traced control flow invokes
+    branch/body closures several times (probe + trace), and in-place list
+    appends inside must not accumulate across calls."""
+    return tuple(_copy_value(a) for a in args)
 
 
 def pd_cond(pred, true_fn, false_fn, args=(), soft=()):
@@ -1004,7 +1033,11 @@ def _convert_cached(fn):
     lower.visit(tree)
     pre_changed |= lower.changed
 
-    scopes = [n for n in ast.walk(fdef) if isinstance(n, ast.FunctionDef)]
+    # AsyncFunctionDef included: an async def passes the fdef type check
+    # above, and without it here the per-scope passes would silently skip
+    # the whole function (ADVICE r5 #4)
+    scopes = [n for n in ast.walk(fdef)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
     converted_total = 0
     for scope in reversed(scopes):  # ast.walk lists outer first
         stmts = _StatementTransformer(_fn_locals(scope))

@@ -15,6 +15,14 @@ RAII spans (platform/profiler.h:130), aggregates them into a summary table on
 * Device-side tracing is XLA's own: ``tracer_option='All'`` brackets the range
   with ``jax.profiler.start_trace`` so TensorBoard xplane dumps land next to
   the host trace (replacing the CUPTI DeviceTracer).
+* :func:`scope` / :func:`annotate` (scope.py) — trace-aware region naming
+  that survives into the lowered HLO (and so into xplane/perfetto device
+  traces), plus an off-by-default host :class:`TimerRegistry`; zero overhead
+  when disabled (the annotations compile away).
+* :mod:`pipeline` (pipeline.py) — the per-tick pipeline-step breakdown
+  (stage compute vs. boundary ppermute vs. inject/head vs. optimizer apply
+  vs. host dispatch) measured by direct probes, feeding
+  ``benchmarks/pipeline_profile_r6.json``.
 """
 from __future__ import annotations
 
@@ -26,6 +34,18 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from .scope import (  # noqa: F401
+    TimerRegistry,
+    annotate,
+    disable_timers,
+    enable_timers,
+    reset_timers,
+    scope,
+    timer_registry,
+    timer_report,
+    timers_enabled,
+)
+
 __all__ = [
     "RecordEvent",
     "record_event",
@@ -35,6 +55,15 @@ __all__ = [
     "export_chrome_tracing",
     "summary",
     "reset",
+    "scope",
+    "annotate",
+    "TimerRegistry",
+    "timer_registry",
+    "enable_timers",
+    "disable_timers",
+    "timers_enabled",
+    "timer_report",
+    "reset_timers",
 ]
 
 _lock = threading.Lock()

@@ -1,0 +1,180 @@
+"""Region annotation + host timer registry (the r6 pipeline-profiling layer).
+
+Two composable pieces, both zero-cost when idle:
+
+* :func:`scope` / :func:`annotate` — name a region of a program.  Inside a
+  jax trace the name is attached via ``jax.named_scope`` so it survives into
+  the lowered XLA/HLO metadata (and thence into perfetto/xplane device
+  traces); that path exists only at trace time and compiles away entirely —
+  a jitted function annotated with ``scope`` lowers to the identical
+  computation.  Outside a trace, when timers are enabled, the span is
+  additionally wall-clocked into the :class:`TimerRegistry` and bracketed
+  with ``jax.profiler.TraceAnnotation`` so host spans line up with device
+  trace rows.  When timers are disabled (the default) the host path does no
+  clock reads and touches no shared state.
+
+* :class:`TimerRegistry` — aggregate host-side wall times by name, queried
+  by ``bench.py`` and the pipeline driver for the per-step breakdown
+  (dispatch vs. blocked-on-device time).  Off by default; ``enable_timers``
+  arms it.
+
+Parity role: the reference's ``platform::RecordEvent`` spans already exist
+in this package (``RecordEvent`` in ``__init__``); ``scope`` is the
+trace-aware sibling that reaches THROUGH jit into the compiled program,
+which RecordEvent (host-only, nanosecond stack) cannot.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = [
+    "scope",
+    "annotate",
+    "TimerRegistry",
+    "timer_registry",
+    "enable_timers",
+    "disable_timers",
+    "timers_enabled",
+    "timer_report",
+    "reset_timers",
+]
+
+_timers_enabled = False
+
+_trace_state_clean = None
+
+
+def _resolve_trace_probe():
+    """``trace_state_clean`` moved between jax versions (public jax.core on
+    0.4.x, internal-but-stable jax._src.core on newer); resolve whichever
+    this install has ONCE and cache it."""
+    global _trace_state_clean
+    for modname in ("jax.core", "jax._src.core"):
+        try:
+            import importlib
+
+            fn = getattr(importlib.import_module(modname),
+                         "trace_state_clean", None)
+            if fn is not None:
+                fn()  # probe it actually works
+                _trace_state_clean = fn
+                return fn
+        except Exception:
+            continue
+    _trace_state_clean = lambda: True  # last resort: assume not tracing
+    return _trace_state_clean
+
+
+def _tracing() -> bool:
+    """True while inside a jax trace (jit/scan/vmap tracing pass)."""
+    fn = _trace_state_clean or _resolve_trace_probe()
+    return not fn()
+
+
+class TimerRegistry:
+    """Thread-safe name → (count, total seconds) aggregation."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total: Dict[str, float] = {}
+        self._count: Dict[str, int] = {}
+
+    def record(self, name: str, seconds: float):
+        with self._lock:
+            self._total[name] = self._total.get(name, 0.0) + seconds
+            self._count[name] = self._count.get(name, 0) + 1
+
+    def totals(self) -> Dict[str, dict]:
+        """{name: {count, total_s, avg_s}} snapshot."""
+        with self._lock:
+            return {
+                n: {
+                    "count": self._count[n],
+                    "total_s": self._total[n],
+                    "avg_s": self._total[n] / self._count[n],
+                }
+                for n in self._total
+            }
+
+    def total(self, name: str) -> float:
+        with self._lock:
+            return self._total.get(name, 0.0)
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            return self._count.get(name, 0)
+
+    def reset(self):
+        with self._lock:
+            self._total.clear()
+            self._count.clear()
+
+
+timer_registry = TimerRegistry()
+
+
+def enable_timers():
+    """Arm the host-span side of :func:`scope` (off by default — the
+    disabled path reads no clocks and records nothing)."""
+    global _timers_enabled
+    _timers_enabled = True
+
+
+def disable_timers():
+    global _timers_enabled
+    _timers_enabled = False
+
+
+def timers_enabled() -> bool:
+    return _timers_enabled
+
+
+def timer_report() -> Dict[str, dict]:
+    return timer_registry.totals()
+
+
+def reset_timers():
+    timer_registry.reset()
+
+
+@contextlib.contextmanager
+def scope(name: str):
+    """``with profiler.scope("pp.stage_compute"):`` — see module docstring.
+
+    Inside a trace: pure HLO-metadata naming (compiles away).  Outside a
+    trace with timers enabled: wall-clocked host span + TraceAnnotation.
+    Outside a trace with timers disabled: HLO-metadata naming only.
+    """
+    import jax
+
+    if _timers_enabled and not _tracing():
+        t0 = time.perf_counter()
+        try:
+            with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+                yield
+        finally:
+            timer_registry.record(name, time.perf_counter() - t0)
+    else:
+        with jax.named_scope(name):
+            yield
+
+
+def annotate(name: Optional[str] = None):
+    """Decorator form: ``@profiler.annotate()`` (uses the qualified function
+    name) or ``@profiler.annotate("pipeline.local_loss")``."""
+
+    def deco(fn):
+        region = name or getattr(fn, "__qualname__", fn.__name__)
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with scope(region):
+                return fn(*a, **k)
+
+        return wrapper
+
+    return deco

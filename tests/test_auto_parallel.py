@@ -240,3 +240,67 @@ class TestPlannerValidation:
         assert plan.best.mem_bytes < 16e9
         assert plan.best.mem_breakdown["grads"] == pytest.approx(
             0.5 * plan.best.mem_breakdown["params"], rel=1e-6)
+
+
+class TestGradFactorGate:
+    """ADVICE r5 #2: the calibrated 0.5x grad-bytes factor holds only for
+    the fused donated-buffer step; held grad accumulators (user-level
+    accumulate_steps, pipeline microbatching, non-fused optimizers) need
+    the full 1.0x, so plan_strategy must stop admitting plans that OOM."""
+
+    def _stats_13b(self):
+        from paddle_tpu.distributed.auto_parallel.planner import ModelStats
+
+        return ModelStats(n_params=1_315_819_520, n_layers=24, hidden=2048,
+                          seq_len=1024, moment_bytes=2)
+
+    def test_accumulation_doubles_grad_bytes(self):
+        from paddle_tpu.distributed.auto_parallel.planner import (
+            ModelStats, plan_strategy)
+
+        stats = ModelStats(n_params=355_919_872, n_layers=24, hidden=1024,
+                           seq_len=1024, moment_bytes=2)
+        fused = plan_strategy(stats, 1, global_batch=8)
+        held = plan_strategy(stats, 1, global_batch=8, accumulate_steps=2)
+        by_key = {(c.dp, c.mp, c.pp, c.zero_stage, c.microbatches,
+                   c.recompute): c for c in fused.candidates}
+        for c in held.candidates:
+            twin = by_key[(c.dp, c.mp, c.pp, c.zero_stage, c.microbatches,
+                           c.recompute)]
+            assert c.mem_breakdown["grads"] == pytest.approx(
+                2 * twin.mem_breakdown["grads"])
+
+    def test_13b_with_held_grads_does_not_fit_one_chip(self):
+        """params 5.3G + bf16 moments 5.3G + FULL f32 grads 5.3G ~= 15.9G
+        before activations: the measured feasibility boundary (1.3b b4
+        fits only because the fused step aliases grads)."""
+        from paddle_tpu.distributed.auto_parallel.planner import plan_strategy
+
+        stats = self._stats_13b()
+        assert plan_strategy(stats, 1, global_batch=4).best is not None
+        with pytest.raises(ValueError, match="no parallel strategy fits"):
+            plan_strategy(stats, 1, global_batch=4, accumulate_steps=2)
+        with pytest.raises(ValueError, match="no parallel strategy fits"):
+            plan_strategy(stats, 1, global_batch=4, fused_grad_buffers=False)
+
+    def test_pipeline_candidates_hold_grads(self):
+        from paddle_tpu.distributed.auto_parallel.planner import (
+            ModelStats, plan_strategy)
+
+        stats = ModelStats(n_params=355_919_872, n_layers=24, hidden=1024,
+                           seq_len=1024, moment_bytes=2)
+        plan = plan_strategy(stats, 4, global_batch=8)
+        cands = [c for c in plan.candidates
+                 if c.mp == 1 and c.zero_stage == 0 and not c.recompute]
+        by_key = {(c.pp, c.dp, c.microbatches): c for c in cands}
+        # EVERY pp>1 candidate (any m) holds a full grad accumulator
+        # across the tick scan: 1.0x its param shard...
+        pp2 = by_key[(2, 2, 1)]
+        assert pp2.mem_breakdown["grads"] == pytest.approx(
+            1.0 * stats.n_params / 2 * stats.param_bytes)
+        assert by_key[(2, 2, 2)].mem_breakdown["grads"] == pytest.approx(
+            pp2.mem_breakdown["grads"])
+        # ...while the fused single-microbatch pp=1 step aliases (0.5x)
+        pp1 = by_key[(1, 4, 1)]
+        assert pp1.mem_breakdown["grads"] == pytest.approx(
+            0.5 * stats.n_params * stats.param_bytes)

@@ -659,3 +659,79 @@ def test_list_alias_preserved_eager():
 
     np.testing.assert_allclose(
         np.asarray(f(paddle.to_tensor([7.0]))._data), [7.0])
+
+
+class TestR6AdviceFixes:
+    """ADVICE r5 #3/#4: async-def scope collection + nested list copies."""
+
+    def test_async_function_converted(self):
+        import asyncio
+
+        from paddle_tpu.jit.dy2static import convert_function
+
+        async def f(x):
+            if x.sum() > 0:
+                y = x + 1.0
+            else:
+                y = x - 1.0
+            return y
+
+        g = convert_function(f)
+        # the per-scope passes must SEE the async scope (previously the
+        # FunctionDef-only collection returned zero scopes -> original fn)
+        assert g is not f
+        x = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"))
+        out = asyncio.run(g(x))
+        np.testing.assert_allclose(np.asarray(out._data), [2.0, 3.0])
+        out = asyncio.run(g(paddle.to_tensor(
+            np.array([-1.0, -2.0], dtype="float32"))))
+        np.testing.assert_allclose(np.asarray(out._data), [-2.0, -3.0])
+
+    def test_copy_list_args_copies_nested_lists(self):
+        from paddle_tpu.jit.dy2static import _copy_list_args
+
+        inner_d = [1]
+        inner_t = [2]
+        top = [3]
+        args = ({"k": inner_d}, (inner_t,), top)
+        copies = _copy_list_args(args)
+        copies[0]["k"].append(10)
+        copies[1][0].append(20)
+        copies[2].append(30)
+        # probe-time appends must not leak back into the caller's lists
+        assert inner_d == [1] and inner_t == [2] and top == [3]
+
+    def test_copy_list_args_shares_leaves(self):
+        from paddle_tpu.jit.dy2static import _copy_list_args
+
+        t = paddle.to_tensor(np.array([1.0], dtype="float32"))
+        (copy,) = _copy_list_args(({"a": [t]},))
+        assert copy["a"][0] is t  # tensors are shared, containers fresh
+
+    def test_copy_list_args_preserves_container_types(self):
+        import collections
+
+        from paddle_tpu.jit.dy2static import _copy_list_args
+
+        Pt = collections.namedtuple("Pt", "x y")
+        od = collections.OrderedDict([("a", [1])])
+        (pt, odc) = _copy_list_args((Pt([1], 2), od))
+        assert type(pt) is Pt and pt.x == [1] and pt.y == 2
+        assert type(odc) is collections.OrderedDict
+        odc["a"].append(9)
+        assert od["a"] == [1]
+
+    def test_copy_list_args_defaultdict_and_counter(self):
+        import collections
+
+        from paddle_tpu.jit.dy2static import _copy_list_args
+
+        dd = collections.defaultdict(list, {"a": [1]})
+        cn = collections.Counter({"a": 2})
+        (ddc, cnc) = _copy_list_args((dd, cn))
+        assert type(ddc) is collections.defaultdict
+        assert ddc.default_factory is list
+        ddc["a"].append(9)
+        ddc["new"].append(1)  # factory still works
+        assert dd["a"] == [1] and "new" not in dd
+        assert type(cnc) is collections.Counter and cnc["a"] == 2

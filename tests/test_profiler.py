@@ -1,0 +1,193 @@
+"""profiler.scope / annotate / timer registry + the pipeline profile probes.
+
+r6 CI tier (fast): annotations must compose under jit and compile away when
+disabled; the timer registry must aggregate sanely and stay inert by
+default; the pipeline profile JSON schema must be stable; and one
+pp=2-emulated pipeline step must profile end-to-end on CPU.
+"""
+import json
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import profiler
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    profiler.disable_timers()
+    profiler.reset_timers()
+    yield
+    profiler.disable_timers()
+    profiler.reset_timers()
+    dist.clear_mesh()
+
+
+class TestScope:
+    def test_scope_composes_under_jit(self):
+        @jax.jit
+        def f(x):
+            with profiler.scope("test.mul"):
+                y = x * 2.0
+            with profiler.scope("test.add"):
+                return y + 1.0
+
+        assert float(f(2.0)) == 5.0
+
+    def test_annotate_decorator(self):
+        @profiler.annotate("test.fn")
+        def f(x):
+            return x + 1
+
+        assert f(1) == 2
+        assert f.__name__ == "f"
+
+    def test_disabled_annotations_compile_away(self):
+        """The lowered computation with scopes is structurally identical to
+        the plain one — same equations, same primitives (names only touch
+        HLO metadata)."""
+
+        def with_scopes(x):
+            with profiler.scope("a"):
+                y = x * 2.0
+            with profiler.scope("b"):
+                return y + 1.0
+
+        def plain(x):
+            return x * 2.0 + 1.0
+
+        ja = jax.make_jaxpr(with_scopes)(1.0).jaxpr
+        jb = jax.make_jaxpr(plain)(1.0).jaxpr
+        assert [e.primitive for e in ja.eqns] == [e.primitive for e in jb.eqns]
+
+    def test_enabled_timers_do_not_change_jaxpr(self):
+        profiler.enable_timers()
+
+        def with_scopes(x):
+            with profiler.scope("a"):
+                return x * 2.0
+
+        ja = jax.make_jaxpr(with_scopes)(1.0).jaxpr
+        jb = jax.make_jaxpr(lambda x: x * 2.0)(1.0).jaxpr
+        assert [e.primitive for e in ja.eqns] == [e.primitive for e in jb.eqns]
+
+
+class TestTimerRegistry:
+    def test_disabled_by_default_records_nothing(self):
+        with profiler.scope("idle.region"):
+            time.sleep(0.002)
+        assert profiler.timer_report() == {}
+
+    def test_enabled_records_host_spans(self):
+        profiler.enable_timers()
+        for _ in range(3):
+            with profiler.scope("host.region"):
+                time.sleep(0.002)
+        rep = profiler.timer_report()
+        assert rep["host.region"]["count"] == 3
+        assert 0.002 <= rep["host.region"]["avg_s"] < 0.5
+        assert rep["host.region"]["total_s"] == pytest.approx(
+            3 * rep["host.region"]["avg_s"])
+
+    def test_reset(self):
+        profiler.enable_timers()
+        with profiler.scope("r"):
+            pass
+        profiler.reset_timers()
+        assert profiler.timer_report() == {}
+
+    def test_tracing_spans_not_timed(self):
+        """Inside a trace the scope must not record wall time (trace time
+        is not runtime)."""
+        profiler.enable_timers()
+
+        @jax.jit
+        def f(x):
+            with profiler.scope("traced.region"):
+                return x + 1
+
+        f(1.0)
+        assert "traced.region" not in profiler.timer_report()
+
+
+def _tiny_pp2_step(microbatches=2):
+    from paddle_tpu.distributed.meta_parallel.pipeline_schedule import (
+        build_gpt_pipeline_step,
+    )
+    from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+    from paddle_tpu.optimizer.optimizers import AdamW
+
+    cfg = gpt_config("gpt2-small", vocab_size=64, hidden_size=32,
+                     num_layers=4, num_attention_heads=4,
+                     max_position_embeddings=32, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    paddle.seed(0)
+    dist.init_mesh({"pp": 2})
+    model = GPTForPretraining(cfg)
+    opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = build_gpt_pipeline_step(model, opt, microbatches=microbatches)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 64, (4, 16)).astype("int32")
+    return step, x
+
+
+class TestPipelineProfile:
+    TICK_REGIONS = {"stage_compute", "boundary_ppermute", "inject",
+                    "head_loss", "tick_bookkeeping"}
+    STEP_REGIONS = {"forward_backward", "grad_reduce", "optimizer_apply"}
+
+    def test_pp2_tick_under_profiler_smoke(self, tmp_path):
+        """One pp=2-emulated pipeline step profiles end-to-end on CPU: the
+        schema is exactly the frozen one and every named region measured."""
+        from paddle_tpu.profiler.pipeline import (
+            PROFILE_SCHEMA,
+            profile_pipeline_step,
+            write_profile,
+        )
+
+        step, x = _tiny_pp2_step()
+        prof = profile_pipeline_step(step, x, x, steps=2, reps=1)
+        assert prof["schema"] == PROFILE_SCHEMA
+        assert prof["config"]["pp"] == 2
+        assert prof["config"]["ticks"] == step.pipe.schedule_ticks()
+        assert set(prof["per_tick_ms"]["regions"]) == self.TICK_REGIONS
+        assert set(prof["per_step_ms"]["regions"]) == self.STEP_REGIONS
+        assert prof["per_tick_ms"]["total_forward"] > 0
+        assert prof["per_step_ms"]["total"] > 0
+        assert prof["per_tick_ms"]["regions"]["stage_compute"] > 0
+        assert prof["per_tick_ms"]["regions"]["boundary_ppermute"] > 0
+        assert prof["per_step_ms"]["host_dispatch"] > 0
+        assert prof["per_tick_ms"]["attributed_fraction"] > 0.5
+        # the caller's timer state is restored (disabled here) and the
+        # registry is NOT reset (only the profiler's own dispatch spans
+        # may have landed)
+        assert not profiler.timers_enabled()
+        assert set(profiler.timer_report()) <= {"pipeline.step.host_dispatch"}
+        # round-trips as json
+        p = write_profile(str(tmp_path / "prof.json"), prof)
+        with open(p) as f:
+            assert json.load(f)["schema"] == PROFILE_SCHEMA
+
+    def test_committed_artifact_schema(self):
+        """benchmarks/pipeline_profile_r6.json stays valid against the
+        frozen schema (whatever device generated it last)."""
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                            "pipeline_profile_r6.json")
+        with open(path) as f:
+            prof = json.load(f)
+        assert prof["schema"] == "paddle_tpu.pipeline_profile.v1"
+        legs = prof["legs"]
+        assert any(k.startswith("pp") for k in legs)
+        for name, leg in legs.items():
+            if not name.startswith("pp"):
+                continue
+            assert set(leg["per_tick_ms"]["regions"]) == self.TICK_REGIONS
+            # the headline property: per-tick wall time is attributed to
+            # named regions, not left as an unexplained residual
+            assert leg["per_tick_ms"]["attributed_fraction"] >= 0.75
