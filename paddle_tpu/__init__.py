@@ -111,6 +111,7 @@ _LAZY_SUBMODULES = (
     "quantization",
     "distribution",
     "regularizer",
+    "resilience",
     "hub",
     "dataset",
     "reader",

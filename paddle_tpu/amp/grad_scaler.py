@@ -104,6 +104,18 @@ class GradScaler:
         self.step(optimizer)
         self.update()
 
+    def mark_anomaly(self):
+        """Resilience hook (eager skip-and-rescale): treat the CURRENT step
+        as bad regardless of grad finiteness — ``step`` will skip the
+        optimizer and ``update`` will shrink the scale. The anomaly
+        sentinel's jitted variant folds the same decision into the in-graph
+        scale machine (ParallelTrainer); this is the eager-loop sibling.
+        Call after backward, before ``step``/``update``."""
+        if not self._enable:
+            return
+        self._found_inf = True
+        self._unscaled = True  # freeze unscale_ so the verdict sticks
+
     # ------------------------------------------------------------------
     def state_dict(self):
         return {

@@ -13,6 +13,14 @@ GCS-fuse; single host works out of the box) and by SIGTERM-based preemption
 hooks (TPU preemption notice), wired to auto-checkpoint for resume. The
 restart protocol (exit code 101, endpoint env rewrite) is kept verbatim so
 reference launch scripts port unchanged.
+
+Self-healing (the resilience layer): every store operation retries with
+exponential backoff + jitter (one transient ConnectionError must never kill
+the heartbeat thread — the etcd client's retry policy, re-homed in
+resilience/retry.py); the beat thread contains ALL exceptions, and when the
+store stays unreachable past its TTL the manager degrades to single-node
+operation (training continues, membership watch answers "no change") and
+rejoins automatically on the first successful beat after the store returns.
 """
 from __future__ import annotations
 
@@ -23,12 +31,17 @@ import subprocess
 import sys
 import threading
 import time
+import warnings
 from typing import Callable, List, Optional
 
-__all__ = ["ElasticManager", "ElasticStatus", "enable_elastic", "launch_elastic",
-           "ELASTIC_EXIT_CODE"]
+__all__ = ["ElasticManager", "ElasticStatus", "StoreUnavailable",
+           "enable_elastic", "launch_elastic", "ELASTIC_EXIT_CODE"]
 
 ELASTIC_EXIT_CODE = 101  # child exit code meaning "please relaunch me"
+
+
+class StoreUnavailable(ConnectionError):
+    """The elastic registry could not be reached even after retries."""
 
 
 class ElasticStatus:
@@ -89,32 +102,71 @@ class _FileStore:
 class _TcpStore:
     """KV/heartbeat registry over the HTTP KV server — the cross-host etcd
     equivalent (reference manager.py:103 etcd registry). Same interface as
-    :class:`_FileStore`, liveness by server-side write timestamps."""
+    :class:`_FileStore`, liveness by server-side write timestamps.
 
-    def __init__(self, addr: str, scope: str, ttl: float = 10.0):
+    Every operation retries with exponential backoff + jitter (per-attempt
+    timeouts budgeted so a full retry burst stays well under the TTL) and
+    raises
+    :class:`StoreUnavailable` only after the budget is exhausted — a single
+    transient ConnectionError never surfaces to the beat thread."""
+
+    def __init__(self, addr: str, scope: str, ttl: float = 10.0,
+                 retries: int = 3):
         from ..utils.http_server import KVClient
 
-        self.client = KVClient(addr)
+        # budget the WHOLE burst (attempts x timeout + backoff sleeps) well
+        # under the TTL: a timeout-bound stall (black-holed store, not
+        # connection-refused) must not silence the heartbeat long enough
+        # for peers to expire this node — that restart is exactly what the
+        # retry layer exists to prevent
+        self.client = KVClient(
+            addr, timeout=max(ttl / 4 / (int(retries) + 1), 0.25))
         self.scope = f"elastic_{scope}"
         self.ttl = ttl
+        self.retries = int(retries)
         self._values = {}
+
+    def _retrying(self, name: str, fn, ok=lambda r: True):
+        from ....resilience.retry import RetryError, call_with_retries
+
+        try:
+            return call_with_retries(
+                fn, retries=self.retries, base=0.05,
+                max_delay=max(min(self.ttl / 8, 1.0), 0.05),
+                # ValueError: a scan response truncated mid-flight parses as
+                # malformed JSON — transient, same treatment as a dead socket
+                retry_on=(OSError, ValueError), ok=ok)
+        except RetryError as e:
+            raise StoreUnavailable(
+                f"elastic store {self.client.addr} unreachable "
+                f"({name}, {self.retries + 1} attempts)") from e
 
     def register(self, node_id: str, value: str):
         self._values[node_id] = value
-        self.client.put(self.scope, node_id, value)
+        self._retrying(
+            "register",
+            lambda: self.client.put(self.scope, node_id, value, strict=True),
+            ok=bool)
 
     def heartbeat(self, node_id: str):
         val = self._values.get(node_id, "")
-        self.client.put(self.scope, node_id, val)
+        self._retrying(
+            "heartbeat",
+            lambda: self.client.put(self.scope, node_id, val, strict=True),
+            ok=bool)
 
     def deregister(self, node_id: str):
-        self.client.delete(self.scope, node_id)
+        self._retrying(
+            "deregister",
+            lambda: self.client.delete(self.scope, node_id, strict=True),
+            ok=bool)
 
     def _alive(self):
         """One snapshot: {node_id: endpoint} for live nodes (a second scan
         could race a concurrent registration)."""
-        return {k: v for k, (v, age) in self.client.scan(self.scope).items()
-                if age <= self.ttl}
+        snap = self._retrying(
+            "scan", lambda: self.client.scan(self.scope, strict=True))
+        return {k: v for k, (v, age) in snap.items() if age <= self.ttl}
 
     def nodes(self) -> List[str]:
         return sorted(self._alive())
@@ -161,44 +213,120 @@ class ElasticManager:
         self._hb_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._membership_at_launch: List[str] = []
+        self._last_endpoints: List[str] = [self.endpoint]
+        self._last_beat_ok = time.time()
+        self.degraded = False  # store unreachable past TTL: single-node mode
         self.preempted = False
 
     # -- registry -------------------------------------------------------
     def register(self):
-        self.store.register(self.node_id, self.endpoint)
-        self._membership_at_launch = self.store.nodes()
+        try:
+            self.store.register(self.node_id, self.endpoint)
+            self._membership_at_launch = self.store.nodes()
+            self._last_endpoints = self.store.endpoints()
+            self._last_beat_ok = time.time()
+            self.degraded = False
+        except StoreUnavailable as e:
+            # graceful start: training proceeds single-node; the beat thread
+            # keeps probing and rejoins when the registry returns
+            warnings.warn(
+                f"elastic store unreachable at registration ({e}); "
+                "continuing single-node, will rejoin when it returns",
+                RuntimeWarning)
+            self.degraded = True
+            self._membership_at_launch = [self.node_id]
         if self._hb_thread is None:
             self._hb_thread = threading.Thread(target=self._beat, daemon=True)
             self._hb_thread.start()
 
     def _beat(self):
+        """Heartbeat loop with full exception containment: a store outage
+        flips ``degraded`` once the silence exceeds the TTL (the other nodes
+        have expired us by then anyway) and the FIRST successful write after
+        recovery re-registers this node (rejoin). The thread itself never
+        dies of a store error."""
         while not self._stop.wait(min(2.0, self.store.ttl / 3)):
             try:
-                self.store.heartbeat(self.node_id)
+                if self.degraded:
+                    self.store.register(self.node_id, self.endpoint)
+                    self.degraded = False
+                    warnings.warn(
+                        "elastic store reachable again; node re-registered",
+                        RuntimeWarning)
+                else:
+                    self.store.heartbeat(self.node_id)
+                self._last_beat_ok = time.time()
             except FileNotFoundError:
-                self.store.register(self.node_id, self.endpoint)
+                try:
+                    self.store.register(self.node_id, self.endpoint)
+                    self._last_beat_ok = time.time()
+                except Exception:
+                    pass
+            except Exception:
+                if (not self.degraded
+                        and time.time() - self._last_beat_ok > self.store.ttl):
+                    self.degraded = True
+                    warnings.warn(
+                        f"elastic store unreachable for over ttl="
+                        f"{self.store.ttl}s; degrading to single-node "
+                        "operation (training continues)", RuntimeWarning)
 
     def exit(self):
         self._stop.set()
-        self.store.deregister(self.node_id)
+        try:
+            self.store.deregister(self.node_id)
+        except (StoreUnavailable, OSError) as e:
+            warnings.warn(f"elastic deregister failed ({e}); node will "
+                          "expire by TTL", RuntimeWarning)
 
     # -- membership -----------------------------------------------------
     def changed(self) -> bool:
-        return self.store.nodes() != self._membership_at_launch
+        """Membership differs from launch. While the STORE is down this
+        answers False — a registry outage must not restart training (the
+        degraded node keeps working; it rejoins when the store returns)."""
+        if self.degraded:
+            return False
+        try:
+            return self.store.nodes() != self._membership_at_launch
+        except (StoreUnavailable, OSError):
+            return False
+
+    def refresh_membership(self):
+        """Re-snapshot the launch membership (after a relaunch); keeps the
+        old snapshot when the store is unreachable."""
+        try:
+            self._membership_at_launch = self.store.nodes()
+        except (StoreUnavailable, OSError):
+            pass
 
     def endpoints_env(self) -> str:
-        return ",".join(self.store.endpoints())
+        """Current live endpoints; falls back to the last successful
+        snapshot (at minimum this node) when the store is unreachable."""
+        try:
+            eps = self.store.endpoints()
+            if eps:
+                self._last_endpoints = eps
+            return ",".join(eps)
+        except (StoreUnavailable, OSError):
+            return ",".join(self._last_endpoints)
 
     def wait_for_np(self, np: Optional[int] = None) -> bool:
         """Hold until the registry has the target node count (parity:
         manager.py wait/HOLD state). Returns False on timeout."""
         want = np or self.np
+
+        def count():
+            try:
+                return len(self.store.nodes())
+            except (StoreUnavailable, OSError):
+                return 0
+
         deadline = time.time() + self.timeout
         while time.time() < deadline:
-            if len(self.store.nodes()) >= want:
+            if count() >= want:
                 return True
             time.sleep(0.5)
-        return len(self.store.nodes()) >= want
+        return count() >= want
 
     # -- preemption -----------------------------------------------------
     def install_preemption_handler(self, on_preempt: Optional[Callable] = None):
@@ -254,7 +382,7 @@ def launch_elastic(cmd: List[str], max_restarts: int = 3,
             if relaunchable and restarts < max_restarts:
                 restarts += 1
                 mgr.register()  # re-register after a kill/preemption
-                mgr._membership_at_launch = mgr.store.nodes()
+                mgr.refresh_membership()
                 continue
             return code
     finally:

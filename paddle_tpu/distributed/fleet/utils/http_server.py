@@ -112,7 +112,13 @@ class KVServer:
 
 
 class KVClient:
-    """Client for :class:`KVServer` (reference KVHandler http client role)."""
+    """Client for :class:`KVServer` (reference KVHandler http client role).
+
+    Deliberately dumb: one attempt per call. With ``strict=True`` transport
+    failures raise OSError so a caller's retry policy (the elastic store's
+    backoff, resilience/retry.py) can distinguish "store down" from a
+    legitimately absent key / empty scope; the default swallows them into
+    False/None/{} for casual callers."""
 
     def __init__(self, addr: str, timeout: float = 5.0):
         self.addr = addr  # "host:port"
@@ -122,7 +128,7 @@ class KVClient:
         host, port = self.addr.rsplit(":", 1)
         return http.client.HTTPConnection(host, int(port), timeout=self.timeout)
 
-    def put(self, scope: str, key: str, value: str) -> bool:
+    def put(self, scope: str, key: str, value: str, strict: bool = False) -> bool:
         try:
             c = self._conn()
             c.request("PUT", f"/{scope}/{key}", body=value.encode())
@@ -130,9 +136,11 @@ class KVClient:
             c.close()
             return ok
         except OSError:
+            if strict:
+                raise
             return False
 
-    def get(self, scope: str, key: str) -> Optional[str]:
+    def get(self, scope: str, key: str, strict: bool = False) -> Optional[str]:
         try:
             c = self._conn()
             c.request("GET", f"/{scope}/{key}")
@@ -141,9 +149,11 @@ class KVClient:
             c.close()
             return out
         except OSError:
+            if strict:
+                raise
             return None
 
-    def delete(self, scope: str, key: str) -> bool:
+    def delete(self, scope: str, key: str, strict: bool = False) -> bool:
         try:
             c = self._conn()
             c.request("DELETE", f"/{scope}/{key}")
@@ -151,9 +161,11 @@ class KVClient:
             c.close()
             return ok
         except OSError:
+            if strict:
+                raise
             return False
 
-    def scan(self, scope: str) -> Dict[str, Tuple[str, float]]:
+    def scan(self, scope: str, strict: bool = False) -> Dict[str, Tuple[str, float]]:
         """{key: (value, age_seconds)} for the whole scope."""
         try:
             c = self._conn()
@@ -166,4 +178,6 @@ class KVClient:
             c.close()
             return {k: (v[0], float(v[1])) for k, v in data.items()}
         except (OSError, ValueError):
+            if strict:
+                raise
             return {}

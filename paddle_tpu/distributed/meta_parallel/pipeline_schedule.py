@@ -1201,13 +1201,16 @@ def _apply_updates(optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
     return new_p, {"slots": new_s, "step": step}
 
 
-def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
+def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None,
+                         sentinel=None):
     """Assemble the jitted hybrid train step for any PipelineModule:
     pp x mp x ep x dp x sharding composed in ONE shard_map program (the
     reference's north-star hybrid, sharding_optimizer.py:140 degrees
     assertion). ``compute_dtype`` (e.g. bfloat16) casts floating params
     inside the loss so the MXU runs bf16 while masters/grads stay f32 (AMP
-    O2 master-weight pattern)."""
+    O2 master-weight pattern). ``sentinel`` (resilience.SentinelConfig)
+    adds in-graph anomaly detection + skip gating; disabled/None leaves the
+    trace untouched (the sentinel carry is an empty pytree)."""
     has_dp = DP_AXIS in mesh.shape and int(mesh.shape[DP_AXIS]) > 1
     has_sh = SH_AXIS in mesh.shape and int(mesh.shape[SH_AXIS]) > 1
     has_ep = EP_AXIS in mesh.shape and int(mesh.shape[EP_AXIS]) > 1
@@ -1228,13 +1231,22 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
         "slots": slot_tree,
         "step": jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P())),
     }
+    use_sentinel = sentinel is not None and sentinel.enabled
+    if use_sentinel:
+        from ...resilience.sentinel import SENTINEL_OK, sentinel_init_state, sentinel_observe
+
+        repl_sh = NamedSharding(mesh, P())
+        sent_state = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, repl_sh), sentinel_init_state())
+    else:
+        sent_state = {}
     slot_specs = {
         grp: {n: {sn: layouts[grp][n][2] for sn in slot_tree[grp][n]}
               for n in slot_tree[grp]}
         for grp in slot_tree
     }
 
-    def spmd_step(params, opt_state, x, y, kd, lr):
+    def spmd_step(params, opt_state, x, y, kd, lr, sent):
         key = jax.random.wrap_key_data(kd)
 
         def loss_fn(params):
@@ -1278,6 +1290,24 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
             loss = lax.pmean(loss, EP_AXIS)
         if has_sh:
             loss = lax.pmean(loss, SH_AXIS)
+        # anomaly sentinel: loss is replicated by the reductions above, but
+        # grads differ per rank (pp stages own distinct layers, ZeRO ranks
+        # distinct slices) — pmin the finite verdict over EVERY mesh axis so
+        # all ranks take the same keep/skip branch, or the params would
+        # silently diverge across the mesh
+        if use_sentinel:
+            finite = jnp.asarray(True)
+            if sentinel.check_nonfinite:
+                for g in jax.tree_util.tree_leaves(grads):
+                    finite = finite & jnp.all(jnp.isfinite(g))
+            fin = finite.astype(jnp.int32)
+            for ax in mesh.shape:
+                fin = lax.pmin(fin, ax)
+            code, new_sent = sentinel_observe(sent, loss, fin > 0, sentinel)
+            ok = code == SENTINEL_OK
+        else:
+            new_sent = sent
+            ok = None
         # slots arrive in their local layouts — param-shaped (natural),
         # [1, 1, 1, sz] (ZeRO-2) or [1, 1, R, 1, szl] (ZeRO-3); each leaf
         # reshapes (or not) for its own update and restores the layout
@@ -1285,7 +1315,12 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
             new_params, new_opt = _apply_updates(
                 optimizer, params, grads, opt_state, n_shard, has_sh, pipe,
                 mesh_axes, lr)
-        return new_params, new_opt, loss
+        if ok is not None:
+            keep = lambda new, old: jax.tree_util.tree_map(
+                lambda a, b: jnp.where(ok, a, b), new, old)
+            new_params = keep(new_params, params)
+            new_opt = keep(new_opt, opt_state)
+        return new_params, new_opt, loss, new_sent
 
     opt_prefix = {"slots": slot_specs, "step": P()}
     data_axes = tuple(a for a in (DP_AXIS, SH_AXIS, EP_AXIS)
@@ -1296,13 +1331,13 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
 
     mapped = shard_map(
         spmd_step, mesh=mesh,
-        in_specs=(param_specs, opt_prefix, data_spec, data_spec, P(), P()),
-        out_specs=(param_specs, opt_prefix, P()),
+        in_specs=(param_specs, opt_prefix, data_spec, data_spec, P(), P(), P()),
+        out_specs=(param_specs, opt_prefix, P(), P()),
         check_vma=False,
     )
     jitted = jax.jit(mapped, donate_argnums=(0, 1))
 
-    state = {"params": params, "opt": opt_state}
+    state = {"params": params, "opt": opt_state, "sentinel": sent_state}
 
     def step(x, y):
         from ...random import split_key
@@ -1316,8 +1351,9 @@ def _build_pipeline_step(pipe, optimizer, mesh, compute_dtype=None):
         # NOT included — jit returns after enqueue). No clock reads when
         # timers are disabled (the default).
         t0 = time.perf_counter() if timers_enabled() else None
-        state["params"], state["opt"], loss = jitted(
-            state["params"], state["opt"], x, y, kd, lr_now)
+        state["params"], state["opt"], loss, state["sentinel"] = jitted(
+            state["params"], state["opt"], x, y, kd, lr_now,
+            state["sentinel"])
         if t0 is not None:
             timer_registry.record("pipeline.step.host_dispatch",
                                   time.perf_counter() - t0)
@@ -1339,7 +1375,7 @@ def build_gpt_pipeline_step(model, optimizer, *, microbatches: int,
                             num_stages: Optional[int] = None, mesh=None,
                             num_virtual_stages: int = 1, compute_dtype=None,
                             remat_policy: str = "full", scan_unroll: int = 1,
-                            sharding_stage: int = 2):
+                            sharding_stage: int = 2, sentinel=None):
     """Build the jitted hybrid train step for a GPT model over a mesh with
     any subset of {'pp' (required), 'mp', 'ep', 'dp', 'sharding'} axes.
     Batch dim 0 is sharded over dp x sharding x ep. Per-param AdamW decay
@@ -1367,13 +1403,14 @@ def build_gpt_pipeline_step(model, optimizer, *, microbatches: int,
     }
     if "wpe" in pipe.shared_params:
         pipe._shared_param_tensors["wpe"] = emb.position_embeddings.weight
-    return _build_pipeline_step(pipe, optimizer, mesh, compute_dtype)
+    return _build_pipeline_step(pipe, optimizer, mesh, compute_dtype,
+                                sentinel=sentinel)
 
 
 def build_pipeline_layer_step(pipe_layer, optimizer, *, microbatches: int,
                               num_stages: Optional[int] = None, mesh=None,
                               num_virtual_stages: int = 1, loss_fn=None,
-                              compute_dtype=None):
+                              compute_dtype=None, sentinel=None):
     """Real stage-parallel step for a generic ``PipelineLayer``: the
     structurally-uniform body rotates over 'pp' (ppermute-scan), edge layers
     run pp-replicated with psum'd grads. Raises ValueError when no uniform
@@ -1386,4 +1423,5 @@ def build_pipeline_layer_step(pipe_layer, optimizer, *, microbatches: int,
     pipe = _LayerStackPipelineModule(
         pipe_layer, num_stages, microbatches, mesh=mesh,
         num_virtual_stages=num_virtual_stages, loss_fn=loss_fn)
-    return _build_pipeline_step(pipe, optimizer, mesh, compute_dtype)
+    return _build_pipeline_step(pipe, optimizer, mesh, compute_dtype,
+                                sentinel=sentinel)

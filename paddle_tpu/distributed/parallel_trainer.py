@@ -78,6 +78,7 @@ class ParallelTrainer:
         accumulate_steps: int = 1,
         donate: bool = True,
         scaler=None,
+        sentinel=None,
         offload_optimizer: bool = False,
         strategy=None,
     ):
@@ -123,6 +124,18 @@ class ParallelTrainer:
         else:
             self.scale_state = {}
 
+        # in-step anomaly sentinel (resilience.sentinel): rolling loss
+        # statistics ride in the jitted step's carry exactly like the
+        # scaler's scale_state; disabled ⇒ empty pytree ⇒ identical jaxpr
+        self._sentinel = sentinel if (
+            sentinel is not None and sentinel.enabled) else None
+        if self._sentinel is not None:
+            from ..resilience.sentinel import sentinel_init_state
+
+            self.sentinel_state = sentinel_init_state()
+        else:
+            self.sentinel_state = {}
+
         # --- parameter placement ---------------------------------------
         self._param_tensors = dict(model.named_parameters())
         self._buffer_tensors = dict(model.named_buffers())
@@ -151,6 +164,10 @@ class ParallelTrainer:
             if self._scaler is not None:
                 raise NotImplementedError(
                     "offload_optimizer with a GradScaler is not composed yet")
+            if self._sentinel is not None:
+                raise NotImplementedError(
+                    "offload_optimizer with an anomaly sentinel is not "
+                    "composed yet (the update runs host-side)")
             import numpy as np
 
             from ..core import PinnedPool
@@ -249,8 +266,14 @@ class ParallelTrainer:
             decr_ratio = float(self._scaler._decr_ratio)
             decr_every = int(self._scaler._decr_every_n_nan_or_inf)
             dynamic = bool(self._scaler.is_use_dynamic_loss_scaling())
+        use_sentinel = self._sentinel is not None
+        if use_sentinel:
+            from ..resilience.sentinel import SENTINEL_OK, sentinel_observe
 
-        def step(params, opt_state, buffers, xb, yb, rng_key, scale_state, lr):
+            sent_cfg = self._sentinel
+
+        def step(params, opt_state, buffers, xb, yb, rng_key, scale_state,
+                 sent_state, lr):
             scale = scale_state["loss_scale"] if use_scaling else None
 
             base_loss_fn = loss_fn
@@ -297,38 +320,55 @@ class ParallelTrainer:
                 # check_finite_and_unscale
                 grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
                 loss = loss / scale
+            finite = None
+            if use_scaling or (use_sentinel and sent_cfg.check_nonfinite):
                 finite = jnp.asarray(True)
                 for g in jax.tree_util.tree_leaves(grads):
                     finite = finite & jnp.all(jnp.isfinite(g))
-                with prof_scope("trainer.optimizer_apply"):
-                    new_params, new_opt = self.optimizer.apply_gradients(
-                        params, grads, opt_state, lr=lr)
+
+            # anomaly sentinel: classify the (unscaled) loss against the
+            # rolling statistics; `ok` gates the update (skip policy) AND
+            # drives the GradScaler machine, so a loss spike is treated as
+            # a bad step and shrinks the scale (skip-and-rescale)
+            if use_sentinel:
+                code, new_sent = sentinel_observe(
+                    sent_state, loss, finite, sent_cfg)
+                ok = code == SENTINEL_OK
+                if finite is not None:
+                    ok = ok & finite  # check_nonfinite=False still skips
+            else:
+                new_sent = sent_state
+                ok = finite
+
+            with prof_scope("trainer.optimizer_apply"):
+                new_params, new_opt = self.optimizer.apply_gradients(
+                    params, grads, opt_state, lr=lr)
+            if ok is not None:
                 keep = lambda new, old: jax.tree_util.tree_map(
-                    lambda a, b: jnp.where(finite, a, b), new, old)
+                    lambda a, b: jnp.where(ok, a, b), new, old)
                 new_params = keep(new_params, params)
                 new_opt = keep(new_opt, opt_state)
+            if use_scaling:
                 # update_loss_scaling state machine (mirror of the eager
                 # GradScaler.update incl. static-scale + decr_every modes)
                 if dynamic:
-                    good = jnp.where(finite, scale_state["good_steps"] + 1, 0)
-                    bad = jnp.where(finite, 0, scale_state["bad_steps"] + 1)
+                    good = jnp.where(ok, scale_state["good_steps"] + 1, 0)
+                    bad = jnp.where(ok, 0, scale_state["bad_steps"] + 1)
                     grown = jnp.where(good >= incr_every, scale * incr_ratio, scale)
                     good = jnp.where(good >= incr_every, 0, good)
                     shrunk = jnp.where(bad >= decr_every,
                                        jnp.maximum(scale * decr_ratio, 1.0), scale)
                     bad = jnp.where(bad >= decr_every, 0, bad)
-                    new_scale = jnp.where(finite, grown, shrunk)
+                    new_scale = jnp.where(ok, grown, shrunk)
                     new_scale_state = {"loss_scale": new_scale,
                                        "good_steps": good, "bad_steps": bad}
                 else:
                     new_scale_state = scale_state
             else:
-                with prof_scope("trainer.optimizer_apply"):
-                    new_params, new_opt = self.optimizer.apply_gradients(
-                        params, grads, opt_state, lr=lr)
                 new_scale_state = scale_state
 
-            return new_params, new_opt, new_buffers, loss, new_scale_state
+            return (new_params, new_opt, new_buffers, loss, new_scale_state,
+                    new_sent)
 
         param_sh = {n: NamedSharding(mesh, s) for n, s in self.param_specs.items()}
 
@@ -377,13 +417,14 @@ class ParallelTrainer:
         batch_sh = NamedSharding(mesh, P(dp) if dp else P())
         repl = NamedSharding(mesh, P())
         scale_sh = {k: repl for k in self.scale_state}
+        sent_sh = {k: repl for k in self.sentinel_state}
         self._jit_step = jax.jit(
             step,
             in_shardings=(param_sh, opt_sh, buf_sh, batch_sh, batch_sh, None,
-                          scale_sh, None),
+                          scale_sh, sent_sh, None),
             # pin outputs to the input placements so donated buffers round-
             # trip bit-identically across steps
-            out_shardings=(param_sh, opt_sh, buf_sh, repl, scale_sh),
+            out_shardings=(param_sh, opt_sh, buf_sh, repl, scale_sh, sent_sh),
             donate_argnums=(0, 1) if self.donate else (),
         )
 
@@ -404,9 +445,10 @@ class ParallelTrainer:
         # compiled step (read at trace time it would be baked as a constant)
         lr_now = jnp.asarray(float(self.optimizer.get_lr()), jnp.float32)
         t0 = time.perf_counter() if timers_enabled() else None
-        self.params, self.opt_state, self.buffers, loss, self.scale_state = self._jit_step(
+        (self.params, self.opt_state, self.buffers, loss, self.scale_state,
+         self.sentinel_state) = self._jit_step(
             self.params, self.opt_state, self.buffers, xb, yb, split_key(),
-            self.scale_state, lr_now,
+            self.scale_state, self.sentinel_state, lr_now,
         )
         if t0 is not None:
             timer_registry.record("trainer.step.host_dispatch",
@@ -470,8 +512,76 @@ class ParallelTrainer:
             self._scaler._good_steps = int(self.scale_state["good_steps"])
             self._scaler._bad_steps = int(self.scale_state["bad_steps"])
 
+    # -- resilience hooks ----------------------------------------------
+    def sentinel_report(self):
+        """Host copy of the sentinel statistics ({} when disabled)."""
+        if not self.sentinel_state:
+            return {}
+        from ..resilience.sentinel import sentinel_to_host
 
-def build_pipeline_step(pipe_layer, hcg, optimizer, accumulate_steps: int = 1, scaler=None):
+        return sentinel_to_host(self.sentinel_state)
+
+    def capture_state(self):
+        """Checkpointable snapshot of the full jitted-training state:
+        params, optimizer slots, buffers, in-graph scale state and sentinel
+        statistics. Leaves are HOST numpy copies — with ``donate=True`` the
+        next step() deletes the current device buffers, so a snapshot that
+        merely referenced them would be dead by the time an emergency save
+        (or a sentinel rollback several steps later) reads it. Hand the dict
+        straight to CheckpointManager.save."""
+        if self.offload:
+            raise NotImplementedError(
+                "capture_state with offload_optimizer is not composed yet")
+        import numpy as np
+
+        # np.array (not asarray): on the CPU backend asarray can alias the
+        # device buffer zero-copy, which donation would invalidate just the
+        # same — force an owned copy
+        return jax.tree_util.tree_map(lambda a: np.array(a), {
+            "params": dict(self.params),
+            "opt_state": self.opt_state,
+            "buffers": dict(self.buffers),
+            "scale_state": dict(self.scale_state),
+            "sentinel_state": dict(self.sentinel_state),
+        })
+
+    def restore_state(self, state):
+        """Inverse of :meth:`capture_state`: re-place every leaf on the mesh
+        with its live sharding (a checkpoint loaded on a different topology
+        reshards here). Restores scaler/sentinel carries only when both the
+        snapshot and this trainer have them enabled."""
+        mesh = self.mesh
+        self.params = {
+            n: jax.device_put(jnp.asarray(a),
+                              NamedSharding(mesh, self.param_specs[n]))
+            for n, a in state["params"].items()
+        }
+        self.opt_state = jax.tree_util.tree_map(
+            lambda old, new: jax.device_put(
+                jnp.asarray(new, getattr(old, "dtype", None)), old.sharding),
+            self.opt_state, state["opt_state"])
+        self.buffers = {
+            n: jax.device_put(jnp.asarray(a), NamedSharding(mesh, P()))
+            for n, a in state["buffers"].items()
+        }
+        repl = NamedSharding(mesh, P())
+        if self.scale_state and state.get("scale_state"):
+            self.scale_state = {
+                k: jax.device_put(jnp.asarray(v, self.scale_state[k].dtype),
+                                  repl)
+                for k, v in state["scale_state"].items()
+            }
+            self.sync_scaler()
+        if self.sentinel_state and state.get("sentinel_state"):
+            self.sentinel_state = {
+                k: jax.device_put(jnp.asarray(v, self.sentinel_state[k].dtype),
+                                  repl)
+                for k, v in state["sentinel_state"].items()
+            }
+
+
+def build_pipeline_step(pipe_layer, hcg, optimizer, accumulate_steps: int = 1,
+                        scaler=None, sentinel=None):
     """PipelineLayer train step. On a mesh with pp > 1 this builds the REAL
     ppermute-scan stage-parallel program
     (meta_parallel.pipeline_schedule.build_pipeline_layer_step); when the
@@ -488,7 +598,7 @@ def build_pipeline_step(pipe_layer, hcg, optimizer, accumulate_steps: int = 1, s
             step = build_pipeline_layer_step(
                 pipe_layer, optimizer,
                 microbatches=max(accumulate_steps, 1),
-                num_virtual_stages=n_virtual, mesh=mesh)
+                num_virtual_stages=n_virtual, mesh=mesh, sentinel=sentinel)
         except ValueError as e:
             import warnings
 
@@ -512,6 +622,7 @@ def build_pipeline_step(pipe_layer, hcg, optimizer, accumulate_steps: int = 1, s
         optimizer,
         accumulate_steps=accumulate_steps,
         scaler=scaler,
+        sentinel=sentinel,
     )
 
     def run(x, y):

@@ -13,14 +13,26 @@ load (the reference's reshard.py concern) reduce to a fresh ``device_put``.
 Snapshots are written to a temp dir then atomically renamed (crash-safe), old
 snapshots pruned, and saving can run on a background thread (async save like
 the reference's async checkpoint saver).
+
+Fault tolerance (the resilience layer's storage contract): every array is
+stamped with a crc32 checksum in ``meta.json`` at save time; ``load``
+re-hashes on read and raises :class:`CheckpointCorruptionError` on mismatch
+or on unreadable files, and a ``load()`` without an explicit step falls back
+to the newest INTACT snapshot with a warning instead of crashing. Async
+writer threads are joined before a new save, on ``wait()``, and at
+interpreter exit, so a snapshot is never half-renamed.
 """
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import shutil
 import tempfile
 import threading
+import warnings
+import weakref
+import zlib
 from typing import Any, Dict, Optional
 
 import jax
@@ -28,11 +40,35 @@ import numpy as np
 
 from ..tensor import Tensor
 
-__all__ = ["CheckpointManager", "save_checkpoint", "load_checkpoint"]
+__all__ = ["CheckpointManager", "CheckpointCorruptionError",
+           "build_train_state", "save_checkpoint", "load_checkpoint"]
 
 _META = "meta.json"
 _ARRAYS = "arrays.npz"
 _PYTREE = "pytree.json"
+
+# async-writer managers alive in this process: one interpreter-exit hook
+# joins them all so a daemon writer thread is never killed mid-write
+_LIVE_MANAGERS: "weakref.WeakSet[CheckpointManager]" = weakref.WeakSet()
+
+
+@atexit.register
+def _join_live_managers():
+    for mgr in list(_LIVE_MANAGERS):
+        try:
+            mgr.wait()
+        except Exception:
+            pass
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A snapshot failed its integrity check (checksum mismatch, truncated
+    or unreadable file). ``load(step=None)`` treats this as "try the next
+    older snapshot"; an explicit-step load propagates it."""
+
+
+def _crc32(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
 
 
 def _py_default(obj):
@@ -98,10 +134,21 @@ class CheckpointManager:
         self.keep_max = keep_max
         self.async_save = async_save
         self._thread: Optional[threading.Thread] = None
+        # RLock, not Lock: a SIGTERM handler (resilience.PreemptionGuard)
+        # runs on the main thread and may re-enter save()/wait() while the
+        # interrupted frame is already inside them — a plain lock would
+        # self-deadlock exactly when the emergency save matters most
+        self._lock = threading.RLock()
+        self.last_loaded_step: Optional[int] = None
         os.makedirs(directory, exist_ok=True)
+        _LIVE_MANAGERS.add(self)
 
     # -- save -----------------------------------------------------------
-    def save(self, step: int, state: Any, metadata: Optional[Dict] = None):
+    def save(self, step: int, state: Any, metadata: Optional[Dict] = None,
+             sync: bool = False):
+        """Snapshot ``state`` under ``step``. ``sync=True`` forces the write
+        onto the caller's thread even for an async manager (the emergency
+        preemption path must not race process teardown)."""
         flat = _flatten_state(state)
         # materialize on host NOW (so async write sees a consistent snapshot)
         arrays = {}
@@ -127,23 +174,39 @@ class CheckpointManager:
         # non-JSON value must raise here, not vanish inside the async writer
         tree_blob = json.dumps({"treedef": treedef.to_json(),
                                 "pyvals": pyvals}, default=_py_default)
+        checksums = {path: _crc32(arr) for path, arr in arrays.items()}
         meta_blob = json.dumps({"step": step, "specs": specs,
                                 "prng_keys": prng_keys,
+                                "checksums": checksums,
+                                "tree_crc": zlib.crc32(tree_blob.encode()),
                                 "metadata": metadata or {}},
                                default=_py_default)
 
-        if self.async_save:
-            self.wait()
-            self._thread = threading.Thread(
-                target=self._write,
-                args=(step, arrays, tree_blob, meta_blob),
-                daemon=True,
-            )
-            self._thread.start()
-        else:
-            self._write(step, arrays, tree_blob, meta_blob)
+        with self._lock:
+            # a second save() while a prior write is in flight joins the
+            # previous thread FIRST — two writers racing the same step dir
+            # (or the prune) could otherwise publish a torn snapshot
+            self._join_locked()
+            if self.async_save and not sync:
+                t = threading.Thread(
+                    target=self._write,
+                    args=(step, arrays, tree_blob, meta_blob),
+                    daemon=True,
+                )
+                # start BEFORE publishing: a signal handler re-entering
+                # wait() on this thread must never join an unstarted thread
+                t.start()
+                self._thread = t
+            else:
+                self._write(step, arrays, tree_blob, meta_blob)
 
     def wait(self):
+        """Join any in-flight async write (public: call before reading the
+        snapshot back, handing off the directory, or exiting)."""
+        with self._lock:
+            self._join_locked()
+
+    def _join_locked(self):
         if self._thread is not None:
             self._thread.join()
             self._thread = None
@@ -187,18 +250,48 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
-    def load(self, step: Optional[int] = None, mesh=None):
+    def load(self, step: Optional[int] = None, mesh=None, verify: bool = True):
         """Rebuild the state pytree; sharded arrays are re-placed on ``mesh``
         (default: the current global mesh) per their saved PartitionSpec —
         the spec is validated against the mesh so a topology change reshards
-        instead of failing."""
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        instead of failing.
+
+        Integrity: with ``verify`` (default) every array is re-hashed
+        against the crc32 stamped at save time. An explicit ``step`` raises
+        :class:`CheckpointCorruptionError` on damage; ``step=None`` walks
+        newest → oldest and returns the first INTACT snapshot, warning about
+        each corrupt one it skips (a preemption mid-write must cost at most
+        one snapshot, never the job)."""
+        if step is not None:
+            return self._load_step(step, mesh, verify)
+        steps = self.all_steps()
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        last_err: Optional[Exception] = None
+        for s in reversed(steps):
+            try:
+                return self._load_step(s, mesh, verify)
+            except (CheckpointCorruptionError, OSError, ValueError,
+                    KeyError) as e:
+                warnings.warn(
+                    f"checkpoint step_{s} in {self.directory} is corrupt "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    f"previous snapshot", RuntimeWarning)
+                last_err = e
+        raise CheckpointCorruptionError(
+            f"no intact checkpoint in {self.directory} "
+            f"(tried steps {steps}): {last_err}")
+
+    def _load_step(self, step: int, mesh=None, verify: bool = True):
         d = os.path.join(self.directory, f"step_{step}")
-        with open(os.path.join(d, _META)) as f:
-            meta = json.load(f)
+        try:
+            with open(os.path.join(d, _META)) as f:
+                meta = json.load(f)
+        except FileNotFoundError:
+            raise
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptionError(
+                f"{d}/{_META} unreadable: {e}") from e
         tree_path = os.path.join(d, _PYTREE)
         if not os.path.exists(tree_path) and os.path.exists(
                 os.path.join(d, "pytree.pkl")):
@@ -207,11 +300,37 @@ class CheckpointManager:
                 "format was dropped (loading untrusted pickles can execute "
                 "code). Re-save it with the current version, or load the "
                 "arrays directly from arrays.npz.")
-        with open(tree_path) as f:
-            raw = json.load(f)
+        checksums = meta.get("checksums")  # absent on pre-resilience saves
+        try:
+            with open(tree_path) as f:
+                tree_blob = f.read()
+            raw = json.loads(tree_blob)
+        except FileNotFoundError as e:
+            raise CheckpointCorruptionError(f"{tree_path} missing") from e
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptionError(
+                f"{tree_path} unreadable: {e}") from e
+        if (verify and meta.get("tree_crc") is not None
+                and zlib.crc32(tree_blob.encode()) != meta["tree_crc"]):
+            raise CheckpointCorruptionError(
+                f"{tree_path} checksum mismatch (truncated or bit-rotted)")
         tree = {"treedef": _TreeSpec.from_json(raw["treedef"]),
                 "pyvals": raw["pyvals"]}
-        data = np.load(os.path.join(d, _ARRAYS), allow_pickle=False)
+        try:
+            data = np.load(os.path.join(d, _ARRAYS), allow_pickle=False)
+        except FileNotFoundError as e:
+            raise CheckpointCorruptionError(f"{d}/{_ARRAYS} missing") from e
+        except Exception as e:  # zipfile.BadZipFile, OSError, ValueError...
+            raise CheckpointCorruptionError(
+                f"{d}/{_ARRAYS} unreadable: {e}") from e
+
+        if verify and checksums is not None:
+            have = {k.replace("|", "/") for k in data.files}
+            if have != set(checksums):
+                raise CheckpointCorruptionError(
+                    f"{d}/{_ARRAYS} array set does not match meta.json "
+                    f"(missing: {sorted(set(checksums) - have)[:4]}, "
+                    f"extra: {sorted(have - set(checksums))[:4]})")
 
         if mesh is None:
             from ..distributed.env import get_mesh
@@ -222,7 +341,16 @@ class CheckpointManager:
         arrays = {}
         for key in data.files:
             path = key.replace("|", "/")
-            arr = data[key]
+            try:
+                arr = data[key]
+            except Exception as e:  # truncated member: zip/zlib/EOF errors
+                raise CheckpointCorruptionError(
+                    f"{d}/{_ARRAYS}[{key}] unreadable: {e}") from e
+            if verify and checksums is not None:
+                want = checksums.get(path)
+                if want is None or _crc32(arr) != want:
+                    raise CheckpointCorruptionError(
+                        f"{d}/{_ARRAYS}[{key}] checksum mismatch")
             if path in prng_keys:
                 arrays[path] = jax.random.wrap_key_data(jax.numpy.asarray(arr))
                 continue
@@ -237,6 +365,7 @@ class CheckpointManager:
                 arrays[path] = jax.device_put(arr, NamedSharding(mesh, ps))
             else:
                 arrays[path] = jax.numpy.asarray(arr)
+        self.last_loaded_step = step
         return tree["treedef"].unflatten(arrays, tree["pyvals"]), meta["metadata"]
 
 
@@ -304,36 +433,59 @@ class _TreeSpec:
         return vals if self.kind == "list" else tuple(vals)
 
 
-def save_checkpoint(directory: str, step: int, model=None, optimizer=None,
-                    extra: Optional[Dict] = None, keep_max: int = 3,
-                    async_save: bool = False):
-    """One-call training snapshot: model + optimizer state_dicts + extras
-    (parity: fleet.save_persistables + .pdopt side files)."""
-    state = {"extra": extra or {}}
+def build_train_state(model=None, optimizer=None, scaler=None,
+                      extra: Optional[Dict] = None) -> Dict[str, Any]:
+    """THE resume-critical state schema — model + optimizer state_dicts,
+    GradScaler state, RNG state, extras. Single assembly point shared by
+    :func:`save_checkpoint` (periodic snapshots) and
+    ``resilience.capture_train_state`` (emergency preemption snapshots), so
+    the two kinds of snapshot can never silently diverge."""
+    state: Dict[str, Any] = {"extra": extra or {}}
     if model is not None:
         state["model"] = dict(model.state_dict())
     if optimizer is not None:
         state["optimizer"] = dict(optimizer.state_dict())
+    if scaler is not None:
+        state["scaler"] = scaler.state_dict()
     from ..random import get_rng_state
 
     state["rng"] = get_rng_state()
+    return state
+
+
+def save_checkpoint(directory: str, step: int, model=None, optimizer=None,
+                    extra: Optional[Dict] = None, keep_max: int = 3,
+                    async_save: bool = False, scaler=None):
+    """One-call training snapshot: model + optimizer state_dicts + GradScaler
+    state + extras (parity: fleet.save_persistables + .pdopt side files).
+    Persisting the scaler means resume reproduces the exact loss scale and
+    good/bad-step counters instead of restarting the dynamic-scale machine."""
+    state = build_train_state(model=model, optimizer=optimizer, scaler=scaler,
+                              extra=extra)
     mgr = CheckpointManager(directory, keep_max=keep_max, async_save=async_save)
     mgr.save(step, state)
     mgr.wait()
     return mgr
 
 
-def load_checkpoint(directory: str, model=None, optimizer=None, step=None, mesh=None):
-    """Restore a save_checkpoint snapshot; returns (step, extra)."""
+def load_checkpoint(directory: str, model=None, optimizer=None, step=None,
+                    mesh=None, scaler=None):
+    """Restore a save_checkpoint snapshot; returns (step, extra). With
+    ``step=None`` the newest INTACT snapshot wins (corrupt ones are skipped
+    with a warning — see CheckpointManager.load)."""
     mgr = CheckpointManager(directory)
-    step = step if step is not None else mgr.latest_step()
-    if step is None:
+    if step is None and mgr.latest_step() is None:
         return None, None
     state, _meta = mgr.load(step, mesh=mesh)
+    if step is None:
+        step = mgr.last_loaded_step  # may differ from latest_step() if the
+        # newest snapshot was corrupt and the loader fell back
     if model is not None and "model" in state:
         model.set_state_dict(state["model"])
     if optimizer is not None and "optimizer" in state:
         optimizer.set_state_dict(state["optimizer"])
+    if scaler is not None and "scaler" in state:
+        scaler.load_state_dict(state["scaler"])
     if "rng" in state:
         from ..random import set_rng_state
 

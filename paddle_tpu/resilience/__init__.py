@@ -1,0 +1,37 @@
+"""Fault-tolerant training runtime.
+
+Three layers, wired through the training stack:
+
+* :mod:`.sentinel` — jitted in-step anomaly detection (non-finite + loss
+  spike) with skip / halt / rollback policies; zero trace-level overhead
+  when disabled. Wired into ``ParallelTrainer`` and the pipeline step.
+* :mod:`.preemption` — SIGTERM/SIGINT + deadline-watchdog emergency
+  synchronous checkpointing (step counter, RNG, scaler, optimizer state).
+* :mod:`.retry` — exponential backoff with jitter, used by the elastic
+  store so one transient failure never kills the heartbeat.
+
+Parity: FLAGS_check_nan_inf, incubate.checkpoint.auto_checkpoint and the
+fleet elastic etcd heartbeats, redesigned as a TPU-native runtime (see
+PARITY.md "Fault tolerance").
+"""
+from .preemption import DEADLINE_ENV, PreemptionGuard, capture_train_state  # noqa: F401
+from .retry import RetryError, backoff_delays, call_with_retries  # noqa: F401
+from .sentinel import (  # noqa: F401
+    SENTINEL_NONFINITE,
+    SENTINEL_OK,
+    SENTINEL_SPIKE,
+    AnomalyHalt,
+    SentinelConfig,
+    SentinelMonitor,
+    sentinel_init_state,
+    sentinel_observe,
+    sentinel_to_host,
+)
+
+__all__ = [
+    "SentinelConfig", "SentinelMonitor", "AnomalyHalt",
+    "SENTINEL_OK", "SENTINEL_NONFINITE", "SENTINEL_SPIKE",
+    "sentinel_init_state", "sentinel_observe", "sentinel_to_host",
+    "PreemptionGuard", "capture_train_state", "DEADLINE_ENV",
+    "RetryError", "backoff_delays", "call_with_retries",
+]

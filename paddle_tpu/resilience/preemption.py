@@ -1,0 +1,236 @@
+"""Preemption-aware checkpointing: signal handlers + deadline watchdog.
+
+Parity: the reference's elastic fault-tolerance levels (fleet/elastic —
+SIGTERM means the scheduler is about to reclaim the node) and the
+auto-checkpoint snapshot layer (incubate/checkpoint). On TPU the dominant
+real-world failure is preemption: spot/preemptible TPU VMs get SIGTERM with
+a short grace window, and maintenance events publish a wall-clock deadline.
+
+:class:`PreemptionGuard` owns the last line of defence: on SIGTERM/SIGINT
+(or ``grace`` seconds before a known deadline) it performs ONE emergency
+SYNCHRONOUS save of the full training state — step counter, RNG keys,
+GradScaler and optimizer state — through a :class:`CheckpointManager`
+(which stamps per-array checksums, so a save cut off mid-write is detected
+and skipped on reload). The state is captured at step boundaries via
+:meth:`update` (or lazily via ``state_fn``), so a signal landing mid-step
+snapshots the last CONSISTENT state, never a half-applied update.
+
+The restart protocol is untouched: with ``exit_code=ELASTIC_EXIT_CODE``
+(101) the relaunch loop in fleet/elastic treats the exit as "please
+relaunch me", and the resumed process falls back to the newest intact
+snapshot (framework/checkpoint.py corruption fallback).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = ["PreemptionGuard", "DEADLINE_ENV", "capture_train_state"]
+
+DEADLINE_ENV = "PADDLE_TPU_PREEMPTION_DEADLINE"  # absolute epoch seconds
+
+
+def capture_train_state(step: int, model=None, optimizer=None, scaler=None,
+                        trainer=None, extra: Optional[Dict] = None):
+    """Standard snapshot pytree for a training loop: the
+    :func:`framework.checkpoint.build_train_state` schema (model + optimizer
+    state_dicts, GradScaler state, RNG) plus the step counter. With a
+    ``trainer`` (ParallelTrainer) its sharded device arrays are captured as
+    host copies (and its in-graph scale state synced back first)."""
+    from ..framework.checkpoint import build_train_state
+
+    state: Dict[str, Any] = build_train_state(
+        model=model, optimizer=optimizer, scaler=scaler, extra=extra)
+    state["step"] = int(step)
+    if trainer is not None:
+        trainer.sync_scaler()
+        state["trainer"] = trainer.capture_state()
+    return state
+
+
+class PreemptionGuard:
+    """Install with a manager and a way to read the current state::
+
+        guard = PreemptionGuard(mgr, exit_code=ELASTIC_EXIT_CODE)
+        guard.install()
+        for step in range(start, total):
+            loss = trainer.step(x, y)
+            guard.update(step, lambda: capture_train_state(step, trainer=trainer))
+
+    ``update`` stores the (step, state-thunk) pair atomically; the signal
+    handler and the deadline watchdog both funnel into
+    :meth:`emergency_save`, which runs at most once.
+
+    ``deadline``: absolute epoch seconds (defaults to $PADDLE_TPU_PREEMPTION_
+    DEADLINE when set); the watchdog saves ``grace`` seconds before it.
+    ``exit_code``: when not None the signal handler exits the process with
+    it after saving (101 = the elastic relaunch protocol); None returns
+    control to the training loop, which should check ``guard.preempted``.
+    """
+
+    def __init__(self, manager, state_fn: Optional[Callable[[], Tuple[int, Any]]] = None,
+                 *, signals=(signal.SIGTERM, signal.SIGINT),
+                 deadline: Optional[float] = None, grace: float = 30.0,
+                 exit_code: Optional[int] = None,
+                 watchdog_interval: float = 1.0,
+                 on_preempt: Optional[Callable[[], None]] = None):
+        self.manager = manager
+        self.state_fn = state_fn
+        self.signals = tuple(signals)
+        if deadline is None and os.environ.get(DEADLINE_ENV):
+            deadline = float(os.environ[DEADLINE_ENV])
+        self.deadline = deadline
+        self.grace = float(grace)
+        self.exit_code = exit_code
+        self.watchdog_interval = float(watchdog_interval)
+        self.on_preempt = on_preempt
+        self.preempted = False
+        self.saved_step: Optional[int] = None
+        self._latest: Optional[Tuple[int, Any]] = None  # (step, state|thunk)
+        self._prev_handlers: Dict[int, Any] = {}
+        # RLock + in-progress flag: a signal can interrupt the main thread
+        # INSIDE emergency_save and re-enter it from the handler — the
+        # nested call must return, not deadlock and not double-save
+        self._save_lock = threading.RLock()
+        self._saving = False
+        self._saving_thread: Optional[threading.Thread] = None
+        self._saved = False
+        self._stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
+
+    # -- state capture --------------------------------------------------
+    def update(self, step: int, state):
+        """Record the latest CONSISTENT state (call at step boundaries).
+        ``state`` may be the pytree itself or a zero-arg thunk producing it
+        (thunks defer the device→host copies to save time)."""
+        self._latest = (int(step), state)
+
+    def _current(self) -> Optional[Tuple[int, Any]]:
+        if self._latest is not None:
+            step, state = self._latest
+            return step, (state() if callable(state) else state)
+        if self.state_fn is not None:
+            return self.state_fn()
+        return None
+
+    # -- the emergency path ---------------------------------------------
+    def emergency_save(self, reason: str = "preemption") -> bool:
+        """Synchronous, at-most-once snapshot. Returns True when a snapshot
+        was written (False: nothing to save or already saved)."""
+        with self._save_lock:
+            if self._saved or self._saving:
+                return False
+            try:
+                cur = self._current()
+            except Exception as e:
+                # a thunk can legitimately fail at signal time: with donated
+                # buffers a signal landing between the jitted step returning
+                # and the trainer rebinding its state reads deleted arrays.
+                # Losing the emergency snapshot must not lose the exit
+                # protocol — resume falls back to the last periodic snapshot
+                # (the corruption-fallback loader makes that safe).
+                warnings.warn(
+                    f"PreemptionGuard: state capture failed "
+                    f"({type(e).__name__}: {e}); emergency save skipped — "
+                    "resume will use the newest periodic snapshot",
+                    RuntimeWarning)
+                return False
+            if cur is None:
+                warnings.warn(
+                    "PreemptionGuard: no state registered (call update() or "
+                    "pass state_fn) — emergency save skipped", RuntimeWarning)
+                return False
+            self._saving = True
+            self._saving_thread = threading.current_thread()
+            try:
+                step, state = cur
+                # join any in-flight async write first so the emergency
+                # snapshot can never interleave with a half-written one
+                self.manager.wait()
+                self.manager.save(
+                    step, state,
+                    metadata={"preempted": True, "reason": reason},
+                    sync=True)
+                self.manager.wait()
+                self._saved = True
+                self.saved_step = step
+            finally:
+                self._saving = False
+                self._saving_thread = None
+            return True
+
+    # -- signal + watchdog wiring ----------------------------------------
+    def _handler(self, signum, frame):
+        self.preempted = True
+        if self._saving:
+            if self._saving_thread is threading.current_thread():
+                # re-entered mid-write on this very thread (repeated
+                # SIGTERM): raising would unwind the interrupted _write
+                # frame and discard the snapshot — record the signal and
+                # return; the outer save completes and its caller exits
+                return
+            # the watchdog thread is writing: block until it finishes
+            # (cross-thread acquire really waits), then honor exit_code
+            with self._save_lock:
+                pass
+            if self.exit_code is not None:
+                raise SystemExit(self.exit_code)
+            return
+        # nothing before the exit protocol may escape: a failed save (disk
+        # full, capture race) must still produce the relaunchable exit code
+        try:
+            self.emergency_save(reason=f"signal {signum}")
+        except Exception as e:
+            warnings.warn(f"PreemptionGuard: emergency save failed "
+                          f"({type(e).__name__}: {e})", RuntimeWarning)
+        if self.on_preempt is not None:
+            try:
+                self.on_preempt()
+            except Exception as e:
+                warnings.warn(f"PreemptionGuard: on_preempt hook failed "
+                              f"({type(e).__name__}: {e})", RuntimeWarning)
+        if self.exit_code is not None:
+            raise SystemExit(self.exit_code)
+        prev = self._prev_handlers.get(signum)
+        if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
+            prev(signum, frame)
+
+    def _watch(self):
+        fire_at = self.deadline - self.grace
+        while not self._stop.wait(self.watchdog_interval):
+            if time.time() >= fire_at:
+                self.preempted = True
+                try:
+                    self.emergency_save(reason="deadline")
+                except Exception as e:
+                    warnings.warn(f"PreemptionGuard: deadline save failed "
+                                  f"({type(e).__name__}: {e})",
+                                  RuntimeWarning)
+                return
+
+    def install(self):
+        for sig in self.signals:
+            self._prev_handlers[sig] = signal.signal(sig, self._handler)
+        if self.deadline is not None and self._watchdog is None:
+            self._watchdog = threading.Thread(target=self._watch, daemon=True)
+            self._watchdog.start()
+        return self
+
+    def uninstall(self):
+        for sig, prev in self._prev_handlers.items():
+            signal.signal(sig, prev)
+        self._prev_handlers.clear()
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5.0)
+            self._watchdog = None
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()

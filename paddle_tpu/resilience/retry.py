@@ -1,0 +1,64 @@
+"""Retry-with-backoff primitives shared by the resilience layer.
+
+Parity: the reference's etcd elastic manager retries transient registry
+failures inside the etcd client; our HTTP KV store (fleet/utils/http_server)
+deliberately has a dumb client that reports failure, so the retry policy
+lives here — exponential backoff with decorrelated jitter, the standard
+recipe for not stampeding a recovering store.
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Iterator, Optional, Tuple, Type
+
+__all__ = ["backoff_delays", "call_with_retries", "RetryError"]
+
+
+class RetryError(RuntimeError):
+    """All attempts failed; ``last`` holds the final exception (or None when
+    the callable signalled failure by return value)."""
+
+    def __init__(self, msg: str, last: Optional[BaseException] = None):
+        super().__init__(msg)
+        self.last = last
+
+
+def backoff_delays(retries: int, base: float = 0.05, max_delay: float = 2.0,
+                   jitter: float = 0.5) -> Iterator[float]:
+    """Yield ``retries`` sleep intervals: base * 2^k, capped at ``max_delay``,
+    each scaled by a uniform factor in [1-jitter, 1+jitter] so a fleet of
+    clients retrying the same dead store spreads out instead of thundering."""
+    for k in range(retries):
+        d = min(base * (2.0 ** k), max_delay)
+        yield d * (1.0 + jitter * (2.0 * random.random() - 1.0))
+
+
+def call_with_retries(fn: Callable, *, retries: int = 4, base: float = 0.05,
+                      max_delay: float = 2.0, jitter: float = 0.5,
+                      retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+                      ok: Callable = lambda r: True,
+                      sleep: Callable[[float], None] = time.sleep):
+    """Run ``fn()`` up to ``retries + 1`` times.
+
+    A failure is either an exception in ``retry_on`` or a return value that
+    ``ok`` rejects (the KV client reports failure as False/None rather than
+    raising). Returns the first accepted value; raises :class:`RetryError`
+    when every attempt failed."""
+    last_exc: Optional[BaseException] = None
+    delays = backoff_delays(retries, base=base, max_delay=max_delay,
+                            jitter=jitter)
+    for attempt in range(retries + 1):
+        try:
+            result = fn()
+        except retry_on as e:
+            last_exc = e
+        else:
+            if ok(result):
+                return result
+            last_exc = None
+        if attempt < retries:
+            sleep(next(delays))
+    raise RetryError(
+        f"{getattr(fn, '__name__', 'call')} failed after {retries + 1} "
+        f"attempts", last=last_exc)

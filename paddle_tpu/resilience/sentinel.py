@@ -1,0 +1,231 @@
+"""In-step anomaly sentinel: jitted non-finite and loss-spike detection.
+
+Parity: the reference's ``FLAGS_check_nan_inf`` device guards
+(/root/reference/paddle/fluid/framework/details/nan_inf_utils_detail.* —
+every op output is scanned for nan/inf and the run aborts) and the
+``check_finite_and_unscale`` amp op. Both are reactive: the reference aborts
+the process, and the GradScaler only notices a blow-up after the grads are
+already non-finite.
+
+TPU-native redesign: detection runs INSIDE the jitted train step, costs one
+reduction over values the step already computed, and feeds a policy that is
+itself pure computation:
+
+* non-finite guard — loss/grad finiteness, one ``jnp.isfinite`` reduce;
+* spike guard — rolling loss statistics (exponentially-weighted mean and
+  variance) ride in the step carry; a finite loss that jumps more than
+  ``spike_factor`` standard deviations above the rolling mean after
+  ``warmup_steps`` clean observations is flagged;
+* skip policy — the parameter/optimizer update is gated with ``jnp.where``
+  (the same keep-machinery the in-graph GradScaler uses), so an anomalous
+  step costs its compute but mutates nothing. With a GradScaler attached the
+  anomaly is folded into its state machine, so spikes also shrink the loss
+  scale (skip-and-rescale);
+* halt / rollback — host policies applied by :class:`SentinelMonitor` from
+  the returned sentinel state (the device step always skips; the monitor
+  decides whether to additionally raise :class:`AnomalyHalt` or restore the
+  newest intact snapshot).
+
+When ``enabled`` is False the wiring contributes NOTHING to the trace — the
+sentinel state is an empty pytree and no detection ops are emitted, so the
+train step compiles to the identical jaxpr (the same zero-overhead bar the
+r6 profiler meets; enforced by tests/test_resilience.py jaxpr-identity).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+__all__ = [
+    "SentinelConfig",
+    "SentinelMonitor",
+    "AnomalyHalt",
+    "SENTINEL_OK",
+    "SENTINEL_NONFINITE",
+    "SENTINEL_SPIKE",
+    "sentinel_init_state",
+    "sentinel_observe",
+    "sentinel_to_host",
+]
+
+SENTINEL_OK = 0
+SENTINEL_NONFINITE = 1
+SENTINEL_SPIKE = 2
+
+_POLICIES = ("skip", "halt", "rollback")
+
+
+@dataclasses.dataclass
+class SentinelConfig:
+    """Anomaly-sentinel knobs.
+
+    ``policy`` names what happens AFTER the in-graph skip: ``"skip"`` does
+    nothing more, ``"halt"`` makes the monitor raise :class:`AnomalyHalt`,
+    ``"rollback"`` makes it call its restore hook. ``spike_factor`` is in
+    rolling standard deviations; ``min_spike_delta`` is an absolute floor so
+    a flat loss curve (tiny variance) does not flag noise."""
+
+    enabled: bool = True
+    policy: str = "skip"
+    check_nonfinite: bool = True
+    spike_factor: float = 8.0
+    min_spike_delta: float = 0.0
+    ema_beta: float = 0.95
+    warmup_steps: int = 20
+    # livelock escape: after this many CONSECUTIVE spike classifications the
+    # elevated level is treated as a genuine regime change (new data domain,
+    # LR ramp) — observations are absorbed into the statistics instead of
+    # skipped forever. 0 disables absorption (spikes always skip).
+    max_consecutive_spikes: int = 8
+
+    def __post_init__(self):
+        if self.policy not in _POLICIES:
+            raise ValueError(
+                f"sentinel policy must be one of {_POLICIES}, got "
+                f"{self.policy!r}")
+        if not (0.0 < self.ema_beta < 1.0):
+            raise ValueError("ema_beta must be in (0, 1)")
+        if self.max_consecutive_spikes < 0:
+            raise ValueError("max_consecutive_spikes must be >= 0")
+
+
+def sentinel_init_state() -> Dict[str, jnp.ndarray]:
+    """Fresh rolling-statistics carry (all scalars; lives in the jitted
+    step's donated state alongside the GradScaler's scale_state)."""
+    return {
+        "count": jnp.zeros((), jnp.int32),         # clean observations seen
+        "ema_mean": jnp.zeros((), jnp.float32),
+        "ema_var": jnp.zeros((), jnp.float32),
+        "anomaly_count": jnp.zeros((), jnp.int32),
+        "last_code": jnp.zeros((), jnp.int32),     # SENTINEL_* of last step
+        "spike_streak": jnp.zeros((), jnp.int32),  # consecutive spikes seen
+    }
+
+
+def sentinel_observe(state, loss, grads_finite, config: SentinelConfig):
+    """Pure observation: classify this step's loss and advance the rolling
+    statistics. Returns ``(code, new_state)`` where ``code`` is a traced
+    int32 scalar (SENTINEL_OK / _NONFINITE / _SPIKE).
+
+    ``grads_finite``: optional traced bool (e.g. the GradScaler's finite
+    flag) AND-ed into the non-finite guard so one reduction is shared.
+    Anomalous steps do NOT update the statistics — a spike must not drag the
+    mean up and mask the next one."""
+    loss = loss.astype(jnp.float32)
+    finite = jnp.isfinite(loss)
+    if config.check_nonfinite and grads_finite is not None:
+        finite = finite & grads_finite
+    warmed = state["count"] >= config.warmup_steps
+    std = jnp.sqrt(jnp.maximum(state["ema_var"], 0.0))
+    threshold = config.spike_factor * std + config.min_spike_delta
+    spike_raw = warmed & finite & (loss - state["ema_mean"] > threshold)
+    # livelock escape: past the consecutive-spike cap the elevated level is
+    # a regime change, not an anomaly — absorb it into the statistics (the
+    # streak holds at the cap until a genuinely sub-threshold loss resets
+    # it, so the whole shifted plateau is absorbed and the mean catches up)
+    streak = state["spike_streak"]
+    absorb = spike_raw & (config.max_consecutive_spikes > 0) & (
+        streak >= config.max_consecutive_spikes)
+    spike = spike_raw & ~absorb
+    code = jnp.where(
+        ~finite, SENTINEL_NONFINITE,
+        jnp.where(spike, SENTINEL_SPIKE, SENTINEL_OK)).astype(jnp.int32)
+    anomaly = code > 0
+
+    # exponentially-weighted mean/variance (West's recurrence), frozen on
+    # anomalous steps and seeded by the first clean observation
+    incr = 1.0 - config.ema_beta
+    first = state["count"] == 0
+    delta = loss - state["ema_mean"]
+    mean_upd = jnp.where(first, loss, state["ema_mean"] + incr * delta)
+    var_upd = jnp.where(
+        first, 0.0,
+        (1.0 - incr) * (state["ema_var"] + incr * delta * delta))
+    clean = ~anomaly
+    new_state = {
+        "count": state["count"] + clean.astype(jnp.int32),
+        "ema_mean": jnp.where(clean, mean_upd, state["ema_mean"]),
+        "ema_var": jnp.where(clean, var_upd, state["ema_var"]),
+        "anomaly_count": state["anomaly_count"] + anomaly.astype(jnp.int32),
+        "last_code": code,
+        "spike_streak": jnp.where(
+            spike, streak + 1,
+            jnp.where(absorb, streak, 0)).astype(jnp.int32),
+    }
+    return code, new_state
+
+
+def sentinel_to_host(state) -> Dict[str, float]:
+    """Device state → plain python numbers (one host sync)."""
+    return {
+        "count": int(state["count"]),
+        "ema_mean": float(state["ema_mean"]),
+        "ema_var": float(state["ema_var"]),
+        "anomaly_count": int(state["anomaly_count"]),
+        "last_code": int(state["last_code"]),
+        "spike_streak": int(state["spike_streak"]),
+    }
+
+
+class AnomalyHalt(RuntimeError):
+    """Raised by the monitor under policy='halt' (FLAGS_check_nan_inf abort
+    parity — but AFTER the in-graph skip kept the params clean)."""
+
+    def __init__(self, report: Dict[str, float]):
+        super().__init__(
+            f"anomaly sentinel halt: {report['anomaly_count']} anomalous "
+            f"step(s), last code {report['last_code']} "
+            f"(1=non-finite, 2=loss spike)")
+        self.report = report
+
+
+class SentinelMonitor:
+    """Host-side policy driver over the device sentinel state.
+
+    Reading device scalars forces a sync, so the monitor polls every
+    ``poll_every`` calls (the in-graph skip already protected the params on
+    the anomalous step itself — the host reaction can lag). ``restore_fn``
+    is the rollback hook (e.g. reload the newest intact snapshot into the
+    trainer); after it runs the monitor re-bases its counter so the restored
+    (older) anomaly_count is not itself treated as a new anomaly."""
+
+    def __init__(self, config: SentinelConfig,
+                 restore_fn: Optional[Callable[[], None]] = None,
+                 poll_every: int = 1):
+        if config.policy == "rollback" and restore_fn is None:
+            raise ValueError("policy='rollback' needs a restore_fn")
+        self.config = config
+        self.restore_fn = restore_fn
+        self.poll_every = max(int(poll_every), 1)
+        self._calls = 0
+        self._seen_anomalies: Optional[int] = 0
+
+    def after_step(self, trainer) -> Optional[str]:
+        """Convenience for ParallelTrainer loops: polls
+        ``trainer.sentinel_state``."""
+        return self.poll(trainer.sentinel_state)
+
+    def poll(self, sentinel_state) -> Optional[str]:
+        """Check the state every ``poll_every``-th call; returns the action
+        taken ('skip' | 'rollback' | None), raises AnomalyHalt under
+        policy='halt'."""
+        self._calls += 1
+        if not sentinel_state or self._calls % self.poll_every:
+            return None
+        host = sentinel_to_host(sentinel_state)
+        if self._seen_anomalies is None:
+            # first poll after a rollback: re-base, don't re-trigger
+            self._seen_anomalies = host["anomaly_count"]
+            return None
+        if host["anomaly_count"] == self._seen_anomalies:
+            return None
+        self._seen_anomalies = host["anomaly_count"]
+        if self.config.policy == "halt":
+            raise AnomalyHalt(host)
+        if self.config.policy == "rollback":
+            self.restore_fn()
+            self._seen_anomalies = None
+            return "rollback"
+        return "skip"
