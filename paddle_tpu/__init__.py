@@ -112,6 +112,7 @@ _LAZY_SUBMODULES = (
     "distribution",
     "regularizer",
     "resilience",
+    "serving",
     "hub",
     "dataset",
     "reader",

@@ -252,16 +252,16 @@ class Predictor:
             params, buffers = self._jit_state
             feeds = [jnp.asarray(self._feeds[n]) for n in self._feed_names]
             if self._jitted is not None:
-                outs = self._jitted(params, buffers, jax.random.key(0), *feeds)
+                outs = self._jitted(params, buffers, jax.random.PRNGKey(0), *feeds)
             else:
                 outs, _ = self._layer._exported.call(
-                    params, buffers, jax.random.key(0), *feeds)
+                    params, buffers, jax.random.PRNGKey(0), *feeds)
             outs = [np.asarray(o) for o in outs]
             if self._fetch_names is None:
                 self._fetch_names = [f"out{i}" for i in range(len(outs))]
         elif self._jitted is not None:
             feeds = [jnp.asarray(self._feeds[n]) for n in self._feed_names]
-            outs = self._jitted(self._prog._captures, jax.random.key(0), *feeds)
+            outs = self._jitted(self._prog._captures, jax.random.PRNGKey(0), *feeds)
             outs = [np.asarray(o) for o in outs]
         else:
             outs = self._prog.run(self._feeds)

@@ -15,11 +15,12 @@ result a single fused XLA program instead of an op-by-op interpreter loop.
 ``save``/``load`` export the traced function as serialized StableHLO
 (jax.export) + a params archive — the pdmodel/pdiparams equivalent.
 """
+from .dy2static import checked  # noqa: F401
 from .static_function import StaticFunction, to_static, not_to_static  # noqa: F401
 from .save_load import load, save, TranslatedLayer  # noqa: F401
 from .input_spec import InputSpec  # noqa: F401
 
-__all__ = ["to_static", "not_to_static", "StaticFunction", "save", "load", "InputSpec", "TranslatedLayer"]
+__all__ = ["to_static", "not_to_static", "StaticFunction", "save", "load", "InputSpec", "TranslatedLayer", "checked"]
 
 
 class TracedLayer:

@@ -32,7 +32,7 @@ import inspect
 import textwrap
 from typing import Callable, List, Optional, Set
 
-__all__ = ["convert_function", "pd_cond", "pd_while"]
+__all__ = ["convert_function", "pd_cond", "pd_while", "checked"]
 
 
 # ---------------------------------------------------------------------------
@@ -319,24 +319,94 @@ def pd_assert(test, msg=None):
     """assert that survives tracing (reference assert_transformer →
     Assert op): concrete predicates keep PYTHON truthiness (``bool(x)`` —
     an empty list fails, exactly like the untransformed assert); traced
-    ones check all elements at run time via a host callback that raises
-    (the reference Assert op's all-elements semantics)."""
+    ones check all elements at run time (the reference Assert op's
+    all-elements semantics).
+
+    Traced-failure semantics depend on how the caller runs the program:
+
+    * Under :func:`checked` (``paddle_tpu.jit.checked``) the assert lowers
+      to ``jax.experimental.checkify.check`` — a **synchronous** checked
+      error: ``err.throw()`` raises exactly at the assert's program point,
+      like the reference Assert op halting the executor.
+    * Otherwise it falls back to ``jax.debug.callback``, whose failure
+      surfaces **asynchronously**: under jit the AssertionError is raised
+      from the runtime when the host callback drains (at block/readback
+      time), so ops AFTER the assert may already have run. This matches
+      jax's execution model — there is no synchronous host abort inside a
+      compiled program without checkify functionalization.
+    """
     p = _pred_value(test)
     if not _is_traced(p):
         if not bool(test):
             raise AssertionError(msg if msg is not None else "")
         return None
     import jax
+    import jax.numpy as jnp
+
+    message = msg if msg is not None else "Assert failed on traced predicate"
+    if _in_checked():
+        # synchronous checked-error path: a bare checkify.check staged
+        # OUTSIDE a checkify functionalization fails at LOWERING time (after
+        # this frame returned), so the check is only emitted under
+        # :func:`checked`'s explicit functionalization flag
+        from jax.experimental import checkify
+
+        # checkify treats the message as a .format template: escape braces
+        # so literal "{0,1}"-style messages don't raise at throw() time
+        safe = str(message).replace("{", "{{").replace("}", "}}")
+        checkify.check(jnp.asarray(p).reshape(-1).all(), safe)
+        return None
 
     def _check(ok):
         import numpy as np
 
         if not bool(np.asarray(ok).reshape(-1).all()):
-            raise AssertionError(msg if msg is not None else
-                                 "Assert failed on traced predicate")
+            raise AssertionError(message)
 
     jax.debug.callback(_check, p)
     return None
+
+
+import threading as _threading
+
+_checkify_state = _threading.local()
+
+
+def _in_checked() -> bool:
+    """True while :func:`checked` is driving the trace — the only context
+    where staging a bare ``checkify.check`` is sound."""
+    return getattr(_checkify_state, "active", False)
+
+
+def checked(fn):
+    """Wrap ``fn`` so traced ``assert``/:func:`pd_assert` failures raise
+    SYNCHRONOUSLY at the assert's program point (reference Assert-op
+    executor semantics), via ``jax.experimental.checkify``.
+
+    ``checked(fn)(*args)`` functionalizes user checks, runs the program,
+    and calls ``err.throw()`` before returning — a failed assert raises
+    ``checkify.JaxRuntimeError`` at the call site with the assert's
+    message; nothing after the failed check is observable. Composes with
+    jit (``checked(jitted_fn)`` re-functionalizes through the call) and
+    with ``to_static`` conversion (asserts become pd_assert first)."""
+    import functools
+
+    from jax.experimental import checkify
+
+    cfn = checkify.checkify(convert_function(fn))
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        prev = _in_checked()
+        _checkify_state.active = True
+        try:
+            err, out = cfn(*args, **kwargs)
+        finally:
+            _checkify_state.active = prev
+        err.throw()
+        return out
+
+    return wrapper
 
 
 def pd_range_len(start, stop, step):

@@ -19,6 +19,8 @@ import pickle
 from typing import Optional, Sequence
 
 import jax
+import jax.export  # noqa: F401  (0.4.x: jax.export is NOT auto-imported —
+#                    bare `jax.export.export` raises AttributeError there)
 import jax.numpy as jnp
 import numpy as np
 
@@ -118,7 +120,7 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
                 tuple(Tensor(a) for a in arrays), is_leaf=lambda x: isinstance(x, Tensor)
             ), list(range(len(arrays))), {})
             jitted, cell = entry
-            key = jax.random.key(0)
+            key = jax.random.PRNGKey(0)  # raw uint32 key: typed key dtypes don't serialize through 0.4.x jax.export
             specs = _symbolic_specs(input_spec)
             param_specs = {n: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype) for n, a in params.items()}
             buffer_specs = {n: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype) for n, a in buffers.items()}
@@ -215,7 +217,7 @@ class TranslatedLayer(Layer):
         arrays = [i._data if isinstance(i, Tensor) else jnp.asarray(i) for i in inputs]
         params = {n: p._data for n, p in self._loaded_params.items()}
         buffers = {n: b._data for n, b in self._loaded_buffers.items()}
-        key = jax.random.key(0)
+        key = jax.random.PRNGKey(0)  # raw uint32 key: typed key dtypes don't serialize through 0.4.x jax.export
         out_arrays, _new_buffers = self._exported.call(params, buffers, key, *arrays)
         outs = [Tensor(a) for a in out_arrays]
         return outs[0] if len(outs) == 1 else tuple(outs)

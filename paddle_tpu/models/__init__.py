@@ -6,7 +6,7 @@ directly on paddle_tpu.distributed.meta_parallel so every parallelism
 axis (dp/mp/pp/sharding/sp/ep) applies to each family.
 """
 from . import bert, generation, gpt  # noqa: F401
-from .generation import generate  # noqa: F401
+from .generation import generate, sample_tokens  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig,
     BertForPretraining,

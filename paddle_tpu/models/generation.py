@@ -23,7 +23,7 @@ from ..ops._primitive import unwrap, wrap
 from ..random import split_key
 from ..tensor import Tensor
 
-__all__ = ["generate"]
+__all__ = ["generate", "sample_tokens"]
 
 
 def _attn_layers(model):
@@ -32,23 +32,90 @@ def _attn_layers(model):
     return [m for m in model.sublayers() if isinstance(m, GPTAttention)]
 
 
+def _per_row(value, default, batch, dtype):
+    """Broadcast a scalar-or-(B,) sampling param to a (B,) array."""
+    if value is None:
+        value = default
+    arr = jnp.asarray(value, dtype).reshape(-1)
+    return jnp.broadcast_to(arr, (batch,))
+
+
+def _is_key_batch(key, batch):
+    """True when ``key`` is a per-row batch of PRNG keys (typed keys of
+    shape (B,), or raw uint32 keys of shape (B, 2))."""
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        return key.ndim == 1 and key.shape[0] == batch
+    return key.ndim == 2 and key.shape[0] == batch
+
+
+def sample_tokens(logits, key, temperature=0.0, top_k=None, top_p=None):
+    """Batched, PRNG-key-driven sampling: logits (B, V) -> token ids (B,).
+
+    ``temperature``/``top_k``/``top_p`` each accept a python scalar OR a
+    per-row (B,) array, so one compiled program serves a batch that mixes
+    greedy and sampled requests with different nucleus settings (the serving
+    engine's continuous batches). Per-row semantics:
+
+    - ``temperature <= 0`` → greedy argmax for that row (no RNG consumed by
+      the caller's key for greedy-only calls when ``key is None``);
+    - ``top_k <= 0`` (or ``None``) → top-k filter disabled for that row;
+    - ``top_p >= 1`` (or ``None``) → nucleus filter disabled for that row.
+
+    ``key``: a single jax PRNG key (typed or raw uint32[2]) shared by the
+    batch, a per-row batch of keys (typed (B,) or raw (B, 2) — each row draws
+    from its own stream, so slot outputs don't depend on who shares the
+    batch), or ``None`` (pure greedy — any row with temperature > 0 would
+    need randomness, so ``None`` forces argmax everywhere).
+
+    Fully in-graph (jit/vmap-safe, shape-polymorphic over B): filters use a
+    full descending sort + per-row rank thresholds instead of the static-k
+    ``lax.top_k``.
+    """
+    logits = logits.astype(jnp.float32)
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+    if key is None:
+        return greedy
+    temp = _per_row(temperature, 0.0, b, jnp.float32)
+    kk = _per_row(top_k, 0, b, jnp.int32)
+    pp = _per_row(top_p, 1.0, b, jnp.float32)
+    scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+    # per-row top-k: kth-largest via full sort + rank gather (k clamps to
+    # [1, V]; rows with k<=0 keep everything)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(sorted_desc,
+                              (jnp.clip(kk, 1, v) - 1)[:, None], axis=-1)
+    use_k = (kk > 0) & (kk < v)
+    scaled = jnp.where(use_k[:, None] & (scaled < kth), -1e9, scaled)
+    # per-row top-p on the (possibly top-k-filtered) distribution: smallest
+    # prefix with cumulative prob >= top_p, per-row cutoff logit
+    sorted_p = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_p, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_n = jnp.sum(cum - probs < pp[:, None], axis=-1, keepdims=True)
+    cutoff = jnp.take_along_axis(sorted_p, jnp.maximum(keep_n - 1, 0), axis=-1)
+    scaled = jnp.where((pp < 1.0)[:, None] & (scaled < cutoff), -1e9, scaled)
+    key = jnp.asarray(key) if not isinstance(key, jax.Array) else key
+    if _is_key_batch(key, b):
+        sampled = jax.vmap(
+            lambda k_, l_: jax.random.categorical(k_, l_))(key, scaled)
+    else:
+        sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temp <= 0.0, greedy, sampled)
+
+
 def _sample(logits, temperature, top_k, top_p):
-    """logits (B, V) -> token ids (B,)."""
+    """logits (B, V) -> token ids (B,) from the GLOBAL seeded RNG stream
+    (scalar-param form used by :func:`generate`; greedy calls draw no key so
+    paddle.seed-reproducible programs are unchanged by sampling refactors)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
-    logits = logits / jnp.maximum(temperature, 1e-6)
-    if top_k is not None and top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-        logits = jnp.where(logits < kth, -1e9, logits)
-    if top_p is not None and top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # smallest set with cumulative prob >= top_p; find its cutoff logit
-        keep_n = jnp.sum(cum - probs < top_p, axis=-1, keepdims=True)
-        cutoff = jnp.take_along_axis(sorted_logits, keep_n - 1, axis=-1)
-        logits = jnp.where(logits < cutoff, -1e9, logits)
-    return jax.random.categorical(split_key(), logits, axis=-1)
+    if (top_k is None or top_k <= 0) and (top_p is None or top_p >= 1.0):
+        # params are concrete scalars here: skip the batched form's sort-
+        # based filters entirely on the plain-temperature hot path
+        scaled = logits / jnp.maximum(temperature, 1e-6)
+        return jax.random.categorical(split_key(), scaled, axis=-1)
+    return sample_tokens(logits, split_key(), temperature, top_k, top_p)
 
 
 def generate(model, input_ids, max_new_tokens=32, eos_token_id=None,

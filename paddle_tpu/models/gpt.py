@@ -250,18 +250,34 @@ class GPTAttention(Layer):
                 import jax.numpy as jnp
                 from jax import lax
 
-                pos = pos.astype(jnp.int32).reshape(())
+                pos = pos.astype(jnp.int32)
                 z = jnp.zeros((), jnp.int32)
-                bufk = lax.dynamic_update_slice(
-                    bufk, k.astype(bufk.dtype), (z, z, pos, z))
-                bufv = lax.dynamic_update_slice(
-                    bufv, v.astype(bufv.dtype), (z, z, pos, z))
+                if pos.ndim >= 1 and pos.shape[0] > 1:
+                    # per-ROW write positions [B] (serving continuous
+                    # batching: each slot decodes at its own offset); vmap
+                    # of dynamic_update_slice lowers to a batched scatter
+                    pos = pos.reshape(-1)
+
+                    def _write(buf, new, p):
+                        return lax.dynamic_update_slice(
+                            buf, new.astype(buf.dtype), (z, p, z))
+
+                    bufk = jax.vmap(_write)(bufk, k, pos)
+                    bufv = jax.vmap(_write)(bufv, v, pos)
+                    posb = pos[:, None, None, None]  # [B,1,1,1]
+                else:
+                    pos = pos.reshape(())
+                    bufk = lax.dynamic_update_slice(
+                        bufk, k.astype(bufk.dtype), (z, z, pos, z))
+                    bufv = lax.dynamic_update_slice(
+                        bufv, v.astype(bufv.dtype), (z, z, pos, z))
+                    posb = pos
                 s = bufk.shape[2]
                 tq = q.shape[2]
                 scores = jnp.einsum("bhtd,bhsd->bhts", q, bufk) * scale
                 j = jnp.arange(s)[None, None, None, :]
                 r = jnp.arange(tq)[None, None, :, None]
-                mask = j <= (pos + r)
+                mask = j <= (posb + r)
                 scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
                 probs = jax.nn.softmax(
                     scores.astype(jnp.float32), axis=-1).astype(q.dtype)

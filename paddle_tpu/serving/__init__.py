@@ -1,0 +1,35 @@
+"""paddle_tpu.serving — continuous-batching inference serving.
+
+Parity role: the reference's production serving plane (AnalysisPredictor /
+ZeroCopyRun + Paddle Serving's batching HTTP front-end), rebuilt TPU-native:
+iteration-level (Orca-style) slot scheduling over ONE fixed-shape jitted
+decode step and a bounded bucketed-prefill compile cache, instead of a
+dynamic-batching executor over paged GPU kernels.
+
+    engine    — slot-based continuous batcher (fixed [n_slots, S] KV cache)
+    scheduler — bounded FCFS admission, power-of-2 prefill buckets, drain
+    server    — threaded HTTP submit/poll/stream front-end + retrying client
+    metrics   — TTFT / token latency / throughput / occupancy / compile stats
+"""
+from .engine import ContinuousBatchingEngine  # noqa: F401
+from .metrics import ServingMetrics  # noqa: F401
+from .scheduler import (  # noqa: F401
+    FCFSScheduler,
+    QueueFullError,
+    Request,
+    SchedulerClosed,
+    power_of_two_buckets,
+)
+from .server import ServingClient, ServingServer  # noqa: F401
+
+__all__ = [
+    "ContinuousBatchingEngine",
+    "ServingMetrics",
+    "FCFSScheduler",
+    "QueueFullError",
+    "Request",
+    "SchedulerClosed",
+    "power_of_two_buckets",
+    "ServingClient",
+    "ServingServer",
+]

@@ -1,0 +1,167 @@
+"""Serving metrics — the observability half of the serving engine.
+
+Parity: the reference's serving stack exports per-request latency and
+throughput counters from its brpc workers (Paddle Serving's
+``op_latency``/``qps`` vars); here one thread-safe registry owns the
+continuous-batching engine's numbers:
+
+* **TTFT** (time-to-first-token: submit → first sampled token),
+* **per-token latency** (decode-step wall time — every active slot gets
+  exactly one token per step),
+* **throughput** (generated tokens/sec over the emission window),
+* **queue depth** and **slot occupancy** gauges,
+* **compile-cache counters** (bucketed prefill + decode-step traces vs
+  calls — the bounded-compile-cache guarantee, observable).
+
+The engine also brackets its prefill/step dispatches with
+``profiler.scope("serving.prefill"/"serving.decode_step")`` so the same
+regions land in the profiler's :class:`TimerRegistry` when timers are armed
+(host spans) and in HLO metadata inside the traced programs (device traces);
+:meth:`snapshot` folds any ``serving.*`` timer rows in, which is what the
+``/metrics`` endpoint serves.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+__all__ = ["ServingMetrics", "percentile"]
+
+
+def percentile(samples, q: float) -> Optional[float]:
+    """Nearest-rank percentile (q in [0, 100]) of a sequence; None if empty."""
+    if not samples:
+        return None
+    s = sorted(samples)
+    idx = max(0, min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[idx]
+
+
+class ServingMetrics:
+    """Thread-safe counters/gauges/samples for one serving engine."""
+
+    def __init__(self, max_samples: int = 4096):
+        self._lock = threading.Lock()
+        self.requests_submitted = 0
+        self.requests_rejected = 0
+        self.requests_completed = 0
+        self.tokens_generated = 0
+        self.prefill_calls = 0
+        self.prefill_compiles = 0
+        self.step_calls = 0
+        self.step_compiles = 0
+        self.queue_depth = 0
+        self.active_slots = 0
+        self.n_slots = 0
+        self._ttft = deque(maxlen=max_samples)
+        self._token_lat = deque(maxlen=max_samples)
+        self._first_emit: Optional[float] = None
+        self._last_emit: Optional[float] = None
+
+    # -- counters -----------------------------------------------------------
+    def on_submit(self):
+        with self._lock:
+            self.requests_submitted += 1
+
+    def on_reject(self):
+        with self._lock:
+            self.requests_rejected += 1
+
+    def on_complete(self):
+        with self._lock:
+            self.requests_completed += 1
+
+    def on_first_token(self, ttft_seconds: float):
+        with self._lock:
+            self._ttft.append(ttft_seconds)
+
+    def on_tokens(self, n: int, step_seconds: Optional[float] = None):
+        now = time.perf_counter()
+        with self._lock:
+            self.tokens_generated += n
+            if self._first_emit is None:
+                self._first_emit = now
+            self._last_emit = now
+            if step_seconds is not None and n > 0:
+                self._token_lat.append(step_seconds)
+
+    def on_prefill(self, compiled: bool):
+        with self._lock:
+            self.prefill_calls += 1
+            if compiled:
+                self.prefill_compiles += 1
+
+    def on_step(self, compiled: bool):
+        with self._lock:
+            self.step_calls += 1
+            if compiled:
+                self.step_compiles += 1
+
+    # -- gauges (engine-owned, set each tick) -------------------------------
+    def set_gauges(self, queue_depth: int, active_slots: int, n_slots: int):
+        with self._lock:
+            self.queue_depth = queue_depth
+            self.active_slots = active_slots
+            self.n_slots = n_slots
+
+    # -- snapshot -----------------------------------------------------------
+    def tokens_per_sec(self) -> Optional[float]:
+        with self._lock:
+            if (self._first_emit is None or self._last_emit is None
+                    or self._last_emit <= self._first_emit):
+                return None
+            return self.tokens_generated / (self._last_emit - self._first_emit)
+
+    def snapshot(self) -> Dict:
+        """JSON-ready view (the ``/metrics`` endpoint body)."""
+        tput = self.tokens_per_sec()
+        with self._lock:
+            ttft = list(self._ttft)
+            lat = list(self._token_lat)
+            out = {
+                "requests": {
+                    "submitted": self.requests_submitted,
+                    "rejected": self.requests_rejected,
+                    "completed": self.requests_completed,
+                },
+                "tokens_generated": self.tokens_generated,
+                "throughput_tokens_per_sec": tput,
+                "ttft_seconds": {
+                    "count": len(ttft),
+                    "p50": percentile(ttft, 50),
+                    "p95": percentile(ttft, 95),
+                },
+                "token_latency_seconds": {
+                    "count": len(lat),
+                    "p50": percentile(lat, 50),
+                    "p95": percentile(lat, 95),
+                },
+                "queue_depth": self.queue_depth,
+                "slot_occupancy": {
+                    "active": self.active_slots,
+                    "total": self.n_slots,
+                    "fraction": (self.active_slots / self.n_slots
+                                 if self.n_slots else 0.0),
+                },
+                "compile_cache": {
+                    "prefill_calls": self.prefill_calls,
+                    "prefill_compiles": self.prefill_compiles,
+                    "prefill_hits": self.prefill_calls - self.prefill_compiles,
+                    "step_calls": self.step_calls,
+                    "step_compiles": self.step_compiles,
+                    "step_hits": self.step_calls - self.step_compiles,
+                },
+            }
+        # fold in any armed profiler host spans for the serving regions
+        try:
+            from ..profiler.scope import timer_report
+
+            spans = {k: v for k, v in timer_report().items()
+                     if k.startswith("serving.")}
+            if spans:
+                out["profiler_spans"] = spans
+        except Exception:
+            pass
+        return out

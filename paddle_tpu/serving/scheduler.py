@@ -1,0 +1,245 @@
+"""Admission control for the continuous-batching engine.
+
+Parity: Paddle Serving's front-end batches requests FCFS into a bounded
+task queue (its ``BatchTasks``/dag scheduler) and rejects on overflow; the
+TPU-native twist is the **compile-cache bound**: prompts are padded to
+power-of-2 length buckets, so over any workload the engine traces at most
+``len(buckets)`` prefill programs plus ONE decode-step program — iteration-
+level (Orca-style) slot scheduling with a provably bounded program cache
+instead of a paged-KV GPU kernel zoo.
+
+Pieces:
+
+* :class:`Request` — one generation request: prompt + per-request sampling
+  params + a thread-safe incremental token log (the streaming front-end
+  tails it).
+* :class:`FCFSScheduler` — bounded FIFO admission queue (reject-with-429
+  semantics via :class:`QueueFullError` when full, :class:`SchedulerClosed`
+  after drain starts), power-of-2 prefill buckets, and the prefill/decode
+  interleave knob ``max_prefills_per_tick`` (how many waiting requests may
+  prefill between two decode steps — prefills are the expensive programs,
+  so unbounded admission would starve in-flight decodes).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "Request",
+    "FCFSScheduler",
+    "QueueFullError",
+    "SchedulerClosed",
+    "power_of_two_buckets",
+]
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue is at capacity — HTTP 429 Too Many Requests."""
+
+    http_status = 429
+
+
+class SchedulerClosed(RuntimeError):
+    """Drain has started; no new admissions — HTTP 503 Service Unavailable."""
+
+    http_status = 503
+
+
+def power_of_two_buckets(max_prompt_len: int, min_bucket: int = 16) -> List[int]:
+    """Power-of-2 prefill buckets covering [1, max_prompt_len]: the compile
+    cache holds at most ``len(buckets)`` prefill programs + 1 decode step."""
+    if max_prompt_len < 1:
+        raise ValueError("max_prompt_len must be >= 1")
+    buckets = []
+    b = max(1, int(min_bucket))
+    while b < max_prompt_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(int(max_prompt_len))
+    return buckets
+
+
+_req_ids = itertools.count(1)
+
+
+class Request:
+    """One in-flight generation: immutable inputs + a growing token log.
+
+    ``tokens`` holds GENERATED ids only (including the eos token when hit —
+    mirroring ``models.generate`` which appends eos before stopping);
+    ``result()`` returns prompt + generated. The condition variable makes
+    ``wait()``/``iter_tokens()`` safe to call from server threads while the
+    engine appends from its loop thread.
+    """
+
+    PENDING, RUNNING, DONE, FAILED = "pending", "running", "done", "failed"
+
+    def __init__(self, prompt, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, seed: Optional[int] = None,
+                 request_id: Optional[str] = None):
+        self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.eos_token_id = None if eos_token_id is None else int(eos_token_id)
+        self.temperature = float(temperature)
+        self.top_k = None if top_k is None else int(top_k)
+        self.top_p = None if top_p is None else float(top_p)
+        self.seed = seed
+        self.request_id = request_id or f"req-{next(_req_ids)}"
+        self.tokens: List[int] = []
+        self.state = Request.PENDING
+        self.error: Optional[str] = None
+        self.bucket: Optional[int] = None
+        self.submitted_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self._cond = threading.Condition()
+
+    # -- engine side --------------------------------------------------------
+    def _append(self, token: int):
+        with self._cond:
+            if self.first_token_at is None:
+                self.first_token_at = time.perf_counter()
+            self.tokens.append(int(token))
+            self._cond.notify_all()
+
+    def _finish(self, state: str = DONE, error: Optional[str] = None):
+        with self._cond:
+            self.state = state
+            self.error = error
+            self.finished_at = time.perf_counter()
+            self._cond.notify_all()
+
+    # -- client side --------------------------------------------------------
+    @property
+    def done(self) -> bool:
+        return self.state in (Request.DONE, Request.FAILED)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request finishes; True when done."""
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        with self._cond:
+            while not self.done:
+                rem = None if deadline is None else deadline - time.perf_counter()
+                if rem is not None and rem <= 0:
+                    return False
+                self._cond.wait(rem)
+        return True
+
+    def iter_tokens(self, timeout: Optional[float] = None):
+        """Yield generated tokens incrementally (the streaming endpoint's
+        source); returns when the request finishes."""
+        idx = 0
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        while True:
+            with self._cond:
+                while idx >= len(self.tokens) and not self.done:
+                    rem = (None if deadline is None
+                           else deadline - time.perf_counter())
+                    if rem is not None and rem <= 0:
+                        return
+                    self._cond.wait(rem)
+                chunk = self.tokens[idx:]
+                finished = self.done
+            for t in chunk:
+                yield t
+            idx += len(chunk)
+            if finished and idx >= len(self.tokens):
+                return
+
+    def result(self) -> np.ndarray:
+        """prompt + generated tokens as int64 (models.generate's shape)."""
+        return np.concatenate(
+            [self.prompt.astype(np.int64),
+             np.asarray(self.tokens, dtype=np.int64)])
+
+    def ttft(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+
+class FCFSScheduler:
+    """Bounded FIFO admission queue with bucketed prefill lengths."""
+
+    def __init__(self, buckets: Sequence[int], max_queue: int = 64,
+                 max_prefills_per_tick: int = 2):
+        if not buckets:
+            raise ValueError("need at least one prefill bucket")
+        self.buckets = sorted(int(b) for b in buckets)
+        self.max_queue = int(max_queue)
+        self.max_prefills_per_tick = max(1, int(max_prefills_per_tick))
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    # -- admission ----------------------------------------------------------
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest prefill bucket "
+            f"{self.buckets[-1]}")
+
+    def submit(self, req: Request) -> Request:
+        """FCFS enqueue. Raises :class:`SchedulerClosed` after drain started
+        and :class:`QueueFullError` at capacity (the server maps these to
+        503/429)."""
+        req.bucket = self.bucket_for(req.prompt.size)  # validate first
+        with self._cond:
+            if self._closed:
+                raise SchedulerClosed("scheduler is draining; not admitting")
+            if len(self._q) >= self.max_queue:
+                raise QueueFullError(
+                    f"admission queue full ({self.max_queue})")
+            self._q.append(req)
+            self._cond.notify_all()
+        return req
+
+    # -- engine side --------------------------------------------------------
+    def take_admissions(self, free_slots: int) -> List[Request]:
+        """Pop up to min(free_slots, max_prefills_per_tick) requests FCFS —
+        the prefill/decode interleaving policy: at most this many prefill
+        programs run between two decode steps."""
+        out: List[Request] = []
+        n = min(int(free_slots), self.max_prefills_per_tick)
+        with self._cond:
+            while self._q and len(out) < n:
+                out.append(self._q.popleft())
+        return out
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    def wait_for_work(self, timeout: float = 0.05) -> bool:
+        """Engine idle-wait: True when the queue is non-empty."""
+        with self._cond:
+            if not self._q:
+                self._cond.wait(timeout)
+            return bool(self._q)
+
+    # -- drain --------------------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+    def close(self):
+        """Stop admitting (graceful drain step 1); queued requests still
+        run to completion."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

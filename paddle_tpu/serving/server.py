@@ -1,0 +1,324 @@
+"""Threaded HTTP front-end for the continuous-batching engine.
+
+Parity: Paddle Serving's HTTP front-end (submit → queue → batched workers →
+poll/stream results) and the reference's AnalysisPredictor service demos;
+the implementation reuses the ``fleet/utils/http_server.py`` idiom — a
+``ThreadingHTTPServer`` with a per-server bound handler class — so the
+serving plane looks like the rendezvous plane operators already run.
+
+Endpoints (JSON in/out):
+
+* ``POST /v1/generate``  body ``{"prompt": [ids...], "max_new_tokens": n,
+  "temperature": t, "top_k": k, "top_p": p, "eos_token_id": e, "seed": s}``
+  → ``202 {"id": ...}``; **429** when the admission queue is full
+  (backpressure), **503** while draining, **400** on bad requests.
+* ``GET /v1/result/<id>`` → ``{"status", "prompt", "tokens", "text?"}`` —
+  poll-style retrieval.
+* ``GET /v1/stream/<id>`` → incremental token streaming: newline-delimited
+  JSON (``{"token": t}`` per generated token, final ``{"done": true, ...}``),
+  written as tokens land in the request's log — a client reads tokens while
+  the engine is still decoding other slots.
+* ``GET /metrics`` → ``ServingMetrics.snapshot()`` (TTFT/latency/throughput
+  percentiles, queue depth, slot occupancy, compile-cache hit counters).
+
+Graceful drain: :meth:`ServingServer.drain` stops admissions (subsequent
+submits get 503), lets in-flight and queued requests finish, then
+:meth:`stop` tears the HTTP plane down.
+
+:class:`ServingClient` wraps the wire protocol with ``resilience/retry.py``
+backoff on transport faults (connection refused/reset while a server
+restarts), mirroring how the elastic store hardens its KV client.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import numpy as np
+
+from .engine import ContinuousBatchingEngine
+from .scheduler import QueueFullError, Request, SchedulerClosed
+
+__all__ = ["ServingServer", "ServingClient"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_ref: "ServingServer"  # bound per-server subclass
+
+    protocol_version = "HTTP/1.0"  # close-delimited bodies (streaming)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    # -- helpers ------------------------------------------------------------
+    def _json(self, status: int, payload: Dict):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _request_or_404(self, rid: str) -> Optional[Request]:
+        req = self.server_ref._requests.get(rid)
+        if req is None:
+            self._json(404, {"error": f"unknown request id {rid!r}"})
+        return req
+
+    # -- routes -------------------------------------------------------------
+    def do_POST(self):
+        if self.path.rstrip("/") != "/v1/generate":
+            self._json(404, {"error": "unknown endpoint"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            spec = json.loads(self.rfile.read(n).decode() or "{}")
+            prompt = spec.pop("prompt")
+        except Exception as e:
+            self._json(400, {"error": f"bad request body: {e}"})
+            return
+        try:
+            req = Request(prompt, **{
+                k: spec[k] for k in ("max_new_tokens", "eos_token_id",
+                                     "temperature", "top_k", "top_p", "seed")
+                if k in spec})
+            self.server_ref.engine.submit(req)
+        except QueueFullError as e:
+            self._json(429, {"error": str(e)})
+            return
+        except SchedulerClosed as e:
+            self._json(503, {"error": str(e)})
+            return
+        except (TypeError, ValueError) as e:
+            self._json(400, {"error": str(e)})
+            return
+        self.server_ref._register(req)
+        self._json(202, {"id": req.request_id})
+
+    def do_GET(self):
+        parts = [p for p in self.path.split("/") if p]
+        if parts == ["metrics"]:
+            self._json(200, self.server_ref.engine.metrics.snapshot())
+            return
+        if len(parts) == 3 and parts[:2] == ["v1", "result"]:
+            req = self._request_or_404(parts[2])
+            if req is None:
+                return
+            self._json(200, {
+                "id": req.request_id,
+                "status": req.state,
+                "prompt": req.prompt.tolist(),
+                "tokens": list(req.tokens),
+                "error": req.error,
+            })
+            return
+        if len(parts) == 3 and parts[:2] == ["v1", "stream"]:
+            req = self._request_or_404(parts[2])
+            if req is None:
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            try:
+                for tok in req.iter_tokens(
+                        timeout=self.server_ref.stream_timeout):
+                    self.wfile.write(
+                        (json.dumps({"token": int(tok)}) + "\n").encode())
+                    self.wfile.flush()
+                self.wfile.write((json.dumps(
+                    {"done": True, "status": req.state,
+                     "n_tokens": len(req.tokens)}) + "\n").encode())
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client went away mid-stream
+            return
+        self._json(404, {"error": "unknown endpoint"})
+
+
+class ServingServer:
+    """HTTP front-end + engine loop thread. ``with ServingServer(engine):``
+    or start()/drain()/stop()."""
+
+    def __init__(self, engine: ContinuousBatchingEngine, port: int = 0,
+                 host: str = "127.0.0.1", stream_timeout: float = 60.0,
+                 max_kept_requests: int = 4096):
+        self.engine = engine
+        self.stream_timeout = float(stream_timeout)
+        self.max_kept_requests = int(max_kept_requests)
+        self._requests: "OrderedDict[str, Request]" = OrderedDict()
+        self._requests_lock = threading.Lock()
+        handler = type("_BoundHandler", (_Handler,), {"server_ref": self})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self.addr = f"{host}:{self.port}"
+        self._http_thread: Optional[threading.Thread] = None
+        self._engine_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def _register(self, req: Request):
+        """Track a request for poll/stream, evicting the OLDEST finished
+        ones past ``max_kept_requests`` — a long-running server must not
+        accumulate every token log ever served (in-flight entries are never
+        evicted, so a full queue can exceed the cap transiently)."""
+        with self._requests_lock:
+            self._requests[req.request_id] = req
+            while len(self._requests) > self.max_kept_requests:
+                victim = next((k for k, r in self._requests.items() if r.done),
+                              None)
+                if victim is None:
+                    break
+                del self._requests[victim]
+
+    def start(self):
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._http_thread.start()
+        self._engine_thread = threading.Thread(
+            target=self.engine.serve_forever, args=(self._stop,), daemon=True)
+        self._engine_thread.start()
+        return self
+
+    def drain(self, timeout: Optional[float] = None):
+        """Graceful drain: stop admitting (new submits → 503), finish every
+        queued and in-flight request, stop the engine loop."""
+        self.engine.scheduler.close()
+        self._stop.set()
+        if self._engine_thread is not None:
+            self._engine_thread.join(timeout)
+            if self._engine_thread.is_alive():
+                raise TimeoutError("engine did not drain in time")
+            self._engine_thread = None
+
+    def stop(self, timeout: Optional[float] = 30.0):
+        self.drain(timeout)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout)
+            self._http_thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class ServingClient:
+    """Wire client with transport-fault retries (resilience/retry.py)."""
+
+    def __init__(self, addr: str, timeout: float = 30.0, retries: int = 3):
+        self.addr = addr  # "host:port"
+        self.timeout = timeout
+        self.retries = retries
+
+    def _conn(self):
+        import http.client
+
+        host, port = self.addr.rsplit(":", 1)
+        return http.client.HTTPConnection(host, int(port),
+                                          timeout=self.timeout)
+
+    def _call(self, method: str, path: str, body: Optional[Dict] = None,
+              retries: Optional[int] = None):
+        from ..resilience.retry import call_with_retries
+
+        def attempt():
+            c = self._conn()
+            try:
+                c.request(method, path,
+                          body=None if body is None else json.dumps(body).encode(),
+                          headers={"Content-Type": "application/json"})
+                r = c.getresponse()
+                return r.status, json.loads(r.read().decode() or "{}")
+            finally:
+                c.close()
+
+        # retry TRANSPORT faults only — 4xx/5xx are semantic answers
+        # (429 backpressure must surface to the caller, not be retried away)
+        return call_with_retries(
+            attempt, retries=self.retries if retries is None else retries,
+            retry_on=(OSError,))
+
+    def submit(self, prompt, **kwargs) -> str:
+        # NO transport retry: a lost 202 after the server enqueued would
+        # silently duplicate the generation (submit is not idempotent)
+        status, out = self._call("POST", "/v1/generate",
+                                 {"prompt": np.asarray(prompt).tolist(),
+                                  **kwargs}, retries=0)
+        if status == 429:
+            raise QueueFullError(out.get("error", "queue full"))
+        if status == 503:
+            raise SchedulerClosed(out.get("error", "draining"))
+        if status != 202:
+            raise RuntimeError(f"submit failed ({status}): {out}")
+        return out["id"]
+
+    def result(self, request_id: str) -> Dict:
+        status, out = self._call("GET", f"/v1/result/{request_id}")
+        if status != 200:
+            raise RuntimeError(f"result failed ({status}): {out}")
+        return out
+
+    def wait(self, request_id: str, timeout: float = 60.0,
+             poll: float = 0.02) -> Dict:
+        import time
+
+        deadline = time.perf_counter() + timeout
+        while True:
+            out = self.result(request_id)
+            if out["status"] in (Request.DONE, Request.FAILED):
+                return out
+            if time.perf_counter() > deadline:
+                raise TimeoutError(f"request {request_id} not done in time")
+            time.sleep(poll)
+
+    def stream(self, request_id: str):
+        """Yield generated tokens incrementally from the NDJSON stream.
+
+        The server's final line carries the request state; anything other
+        than "done" (engine failure → "failed", server-side stream timeout
+        → still "running") raises so a truncated stream can't be mistaken
+        for a complete generation."""
+        c = self._conn()
+        try:
+            c.request("GET", f"/v1/stream/{request_id}")
+            r = c.getresponse()
+            if r.status != 200:
+                raise RuntimeError(f"stream failed ({r.status})")
+            buf = b""
+            while True:
+                chunk = r.read1(65536) if hasattr(r, "read1") else r.read(1)
+                if not chunk:
+                    # transport EOF before the done sentinel: the server (or
+                    # its handler thread) died mid-stream — truncation must
+                    # raise, never masquerade as completion
+                    raise RuntimeError(
+                        f"stream for {request_id} closed without completing")
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line.strip():
+                        continue
+                    msg = json.loads(line.decode())
+                    if msg.get("done"):
+                        if msg.get("status") != Request.DONE:
+                            raise RuntimeError(
+                                f"stream for {request_id} ended incomplete "
+                                f"(status={msg.get('status')!r} after "
+                                f"{msg.get('n_tokens')} tokens)")
+                        return
+                    yield msg["token"]
+        finally:
+            c.close()
+
+    def metrics(self) -> Dict:
+        status, out = self._call("GET", "/metrics")
+        if status != 200:
+            raise RuntimeError(f"metrics failed ({status})")
+        return out

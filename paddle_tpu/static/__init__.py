@@ -15,6 +15,7 @@ import json
 from typing import List, Optional, Sequence
 
 import jax
+import jax.export  # noqa: F401  (0.4.x: lazy submodule, not an attribute)
 import jax.numpy as jnp
 import numpy as np
 
@@ -172,7 +173,7 @@ def save_inference_model(path_prefix: str, feed_vars: Sequence[Variable],
         shape = tuple(next(syms) if s is None else int(s) for s in declared)
         specs.append(jax.ShapeDtypeStruct(shape, v._data.dtype))
     cap_specs = [jax.ShapeDtypeStruct(tuple(a.shape), a.dtype) for a in capture_arrays]
-    key = jax.random.key(0)
+    key = jax.random.PRNGKey(0)  # raw uint32 key: typed key dtypes don't serialize through 0.4.x jax.export
     key_spec = jax.ShapeDtypeStruct(key.shape, key.dtype)
 
     exported = jax.export.export(jax.jit(infer_fn))(cap_specs, key_spec, *specs)
@@ -198,7 +199,7 @@ class LoadedProgram:
     def run(self, feed: dict):
         feeds = [jnp.asarray(feed[n]._data if isinstance(feed[n], Tensor) else feed[n])
                  for n in self.feed_names]
-        outs = self._exported.call(self._captures, jax.random.key(0), *feeds)
+        outs = self._exported.call(self._captures, jax.random.PRNGKey(0), *feeds)
         return [np.asarray(o) for o in outs]
 
 

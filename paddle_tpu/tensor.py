@@ -312,8 +312,24 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tenso
 
 # jax pytree registration: a Tensor flattens to its array. This is what lets
 # whole Layers / optimizer states cross the jit boundary as pytrees.
+def _tensor_unflatten(aux, children):
+    # the custom-pytree contract: unflatten must accept ARBITRARY leaf
+    # objects — jax transforms (shard_map on 0.4.x, tree broadcasting)
+    # rebuild trees with object() placeholders that are only inspected
+    # structurally, so non-array leaves bypass jnp.asarray validation
+    data = children[0]
+    if isinstance(data, (jax.Array, np.ndarray, np.generic,
+                         int, float, bool, complex)):
+        return Tensor(data, stop_gradient=aux[0], name=aux[1])
+    # reuse __init__ for every slot (single source of truth for Tensor
+    # state), then plant the opaque leaf without coercion
+    t = Tensor(0.0, stop_gradient=aux[0], name=aux[1])
+    t._data = data
+    return t
+
+
 jax.tree_util.register_pytree_node(
     Tensor,
     lambda t: ((t._data,), (t.stop_gradient, t.name)),
-    lambda aux, children: Tensor(children[0], stop_gradient=aux[0], name=aux[1]),
+    _tensor_unflatten,
 )

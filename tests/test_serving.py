@@ -1,0 +1,418 @@
+"""Continuous-batching serving engine (ISSUE 3): exact-match decode vs
+sequential models.generate, slot reuse, bounded compile cache, backpressure,
+graceful drain, streaming HTTP e2e — all on CPU."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import generate, sample_tokens
+from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+from paddle_tpu.serving import (
+    ContinuousBatchingEngine,
+    FCFSScheduler,
+    QueueFullError,
+    Request,
+    SchedulerClosed,
+    ServingClient,
+    ServingServer,
+    power_of_two_buckets,
+)
+
+VOCAB = 64
+
+
+def _tiny_model():
+    paddle.seed(0)
+    cfg = gpt_config("gpt2-small", vocab_size=VOCAB, hidden_size=32,
+                     num_layers=2, num_attention_heads=4,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+def _sequential(model, prompt, n, eos=None):
+    out = generate(model, paddle.to_tensor(np.asarray(prompt)[None]),
+                   max_new_tokens=n, eos_token_id=eos)
+    return np.asarray(out._data)[0]
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+# ---------------------------------------------------------------------------
+# engine correctness
+# ---------------------------------------------------------------------------
+class TestEngineExactMatch:
+    def test_concurrent_matches_sequential_greedy(self, model):
+        """N=8 staggered mixed-length greedy requests through 4 slots ==
+        sequential models.generate token-for-token, within the bounded
+        compile budget (acceptance criterion)."""
+        rng = np.random.default_rng(0)
+        lens = [3, 5, 7, 4, 9, 6, 2, 8]
+        news = [6, 4, 8, 5, 3, 7, 6, 5]
+        prompts = [rng.integers(0, VOCAB, (l,)).astype(np.int32)
+                   for l in lens]
+        want = [_sequential(model, p, n) for p, n in zip(prompts, news)]
+
+        buckets = [4, 8, 16]
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=4,
+                                       prefill_buckets=buckets)
+        # stagger arrivals: first wave, a few ticks, second wave
+        first = [eng.submit(Request(p, max_new_tokens=n))
+                 for p, n in zip(prompts[:5], news[:5])]
+        for _ in range(3):
+            eng.step_once()
+        second = [eng.submit(Request(p, max_new_tokens=n))
+                  for p, n in zip(prompts[5:], news[5:])]
+        eng.run_until_idle(timeout=300)
+
+        for req, w in zip(first + second, want):
+            np.testing.assert_array_equal(req.result(), w)
+        # bounded compile cache: <= len(buckets) prefills + 1 decode step
+        assert eng.trace_count <= len(buckets) + 1
+        assert eng.trace_counts["step"] == 1
+
+    def test_slot_reuse_after_eos(self, model):
+        """A request finishing early (eos) frees its slot mid-run; a queued
+        request reuses it and still decodes exactly."""
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, VOCAB, (4 + i % 3,)).astype(np.int32)
+                   for i in range(6)]
+        # derive a real eos: token the first request actually emits early
+        probe = _sequential(model, prompts[0], 6)
+        eos = int(probe[len(prompts[0]) + 1])  # its 2nd generated token
+        want = [_sequential(model, p, 6, eos=eos) for p in prompts]
+
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2,
+                                       prefill_buckets=[8],
+                                       max_prefills_per_tick=2)
+        reqs = [Request(p, max_new_tokens=6, eos_token_id=eos)
+                for p in prompts]
+        got = eng.generate_batch(reqs, timeout=300)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+        # 6 requests over 2 slots => slots were reused
+        assert eng.metrics.requests_completed == 6
+
+    def test_prefill_bucket_compile_bound(self, model):
+        """Many mixed-length requests; trace counter stays <= buckets + 1
+        (the compile-cache guarantee the scheduler's bucketing buys)."""
+        rng = np.random.default_rng(2)
+        buckets = power_of_two_buckets(16, min_bucket=4)  # [4, 8, 16]
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=3,
+                                       prefill_buckets=buckets, max_queue=64)
+        reqs = [Request(rng.integers(0, VOCAB, (int(l),)).astype(np.int32),
+                        max_new_tokens=3)
+                for l in rng.integers(1, 17, size=12)]
+        eng.generate_batch(reqs, timeout=300)
+        assert eng.trace_count <= len(buckets) + 1
+        snap = eng.metrics.snapshot()
+        assert snap["compile_cache"]["prefill_compiles"] <= len(buckets)
+        assert snap["compile_cache"]["step_compiles"] == 1
+        # cache HITS dominate once the buckets are warm
+        assert snap["compile_cache"]["prefill_hits"] >= 12 - len(buckets)
+
+    def test_mixed_sampling_single_program(self, model):
+        """Greedy and sampled requests share the one compiled step; greedy
+        outputs stay exact while sampled rows stay in-vocab."""
+        rng = np.random.default_rng(3)
+        greedy_p = rng.integers(0, VOCAB, (5,)).astype(np.int32)
+        sampled_p = rng.integers(0, VOCAB, (6,)).astype(np.int32)
+        want = _sequential(model, greedy_p, 5)
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2,
+                                       prefill_buckets=[8])
+        g = eng.submit(Request(greedy_p, max_new_tokens=5))
+        s = eng.submit(Request(sampled_p, max_new_tokens=5, temperature=0.9,
+                               top_k=8, top_p=0.95, seed=7))
+        eng.run_until_idle(timeout=300)
+        np.testing.assert_array_equal(g.result(), want)
+        assert len(s.tokens) == 5
+        assert all(0 <= t < VOCAB for t in s.tokens)
+        assert eng.trace_counts["step"] == 1
+        # same seed => same sampled continuation on a fresh engine
+        eng2 = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2,
+                                        prefill_buckets=[8])
+        s2 = eng2.submit(Request(sampled_p, max_new_tokens=5, temperature=0.9,
+                                 top_k=8, top_p=0.95, seed=7))
+        eng2.run_until_idle(timeout=300)
+        assert s2.tokens == s.tokens
+
+    def test_capacity_validation(self, model):
+        eng = ContinuousBatchingEngine(model, max_seq_len=16, n_slots=1,
+                                       prefill_buckets=[8])
+        with pytest.raises(ValueError, match="KV capacity"):
+            eng.submit(Request(np.arange(8, dtype=np.int32),
+                               max_new_tokens=16))
+        with pytest.raises(ValueError, match="bucket"):
+            eng.submit(Request(np.arange(12, dtype=np.int32),
+                               max_new_tokens=1))
+
+
+# ---------------------------------------------------------------------------
+# scheduler policy
+# ---------------------------------------------------------------------------
+class TestScheduler:
+    def test_power_of_two_buckets(self):
+        assert power_of_two_buckets(64, min_bucket=16) == [16, 32, 64]
+        assert power_of_two_buckets(20, min_bucket=4) == [4, 8, 16, 20]
+        assert power_of_two_buckets(4, min_bucket=8) == [4]
+
+    def test_queue_backpressure(self):
+        sched = FCFSScheduler([8], max_queue=2)
+        sched.submit(Request([1, 2], max_new_tokens=1))
+        sched.submit(Request([1, 2], max_new_tokens=1))
+        with pytest.raises(QueueFullError):
+            sched.submit(Request([1, 2], max_new_tokens=1))
+
+    def test_fcfs_and_interleave_cap(self):
+        sched = FCFSScheduler([8], max_queue=8, max_prefills_per_tick=2)
+        reqs = [sched.submit(Request([i + 1], max_new_tokens=1))
+                for i in range(5)]
+        # prefill/decode interleave: at most 2 admissions per tick even
+        # with more free slots
+        takes = sched.take_admissions(free_slots=4)
+        assert takes == reqs[:2]
+        assert sched.take_admissions(free_slots=4) == reqs[2:4]
+
+    def test_closed_rejects(self):
+        sched = FCFSScheduler([8])
+        sched.close()
+        with pytest.raises(SchedulerClosed):
+            sched.submit(Request([1], max_new_tokens=1))
+
+
+# ---------------------------------------------------------------------------
+# HTTP front-end
+# ---------------------------------------------------------------------------
+class TestServer:
+    def test_streaming_endpoint_e2e(self, model):
+        """Tokens arrive over the stream endpoint incrementally and match
+        both the poll endpoint and sequential generate."""
+        rng = np.random.default_rng(4)
+        prompt = rng.integers(0, VOCAB, (5,)).astype(np.int32)
+        want = _sequential(model, prompt, 8)
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2,
+                                       prefill_buckets=[8])
+        with ServingServer(eng) as srv:
+            cli = ServingClient(srv.addr)
+            rid = cli.submit(prompt, max_new_tokens=8)
+            toks = list(cli.stream(rid))
+            assert toks == list(want[5:])
+            res = cli.wait(rid, timeout=60)
+            assert res["status"] == "done"
+            assert res["tokens"] == toks
+            mx = cli.metrics()
+            assert mx["ttft_seconds"]["count"] >= 1
+            assert mx["tokens_generated"] >= 8
+            assert mx["compile_cache"]["step_compiles"] == 1
+
+    def test_backpressure_429_and_drain_503(self, model):
+        """Queue overflow surfaces as 429 through the wire; after drain
+        starts new submissions get 503 while in-flight requests finish."""
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(0, VOCAB, (4,)).astype(np.int32)
+                   for _ in range(6)]
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=1,
+                                       prefill_buckets=[8], max_queue=2)
+        srv = ServingServer(eng)
+        # don't start the engine loop yet: force the queue to fill
+        srv._http_thread = threading.Thread(
+            target=srv._httpd.serve_forever, daemon=True)
+        srv._http_thread.start()
+        cli = ServingClient(srv.addr)
+        ids = [cli.submit(p, max_new_tokens=4) for p in prompts[:2]]
+        with pytest.raises(QueueFullError):
+            cli.submit(prompts[2], max_new_tokens=4)
+        # now start the engine and drain: queued requests must complete
+        srv._engine_thread = threading.Thread(
+            target=eng.serve_forever, args=(srv._stop,), daemon=True)
+        srv._engine_thread.start()
+        srv.drain(timeout=120)
+        for rid in ids:
+            res = cli.result(rid)
+            assert res["status"] == "done"
+            assert len(res["tokens"]) == 4
+        with pytest.raises(SchedulerClosed):
+            cli.submit(prompts[3], max_new_tokens=4)
+        srv._httpd.shutdown()
+        srv._httpd.server_close()
+
+    def test_bad_requests(self, model):
+        eng = ContinuousBatchingEngine(model, max_seq_len=16, n_slots=1,
+                                       prefill_buckets=[8])
+        with ServingServer(eng) as srv:
+            cli = ServingClient(srv.addr)
+            with pytest.raises(RuntimeError, match="submit failed \\(400\\)"):
+                cli.submit(list(range(8)), max_new_tokens=64)  # capacity
+            status, out = cli._call("GET", "/v1/result/nope")
+            assert status == 404
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+class TestMetrics:
+    def test_snapshot_fields(self, model):
+        rng = np.random.default_rng(6)
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2,
+                                       prefill_buckets=[8])
+        reqs = [Request(rng.integers(0, VOCAB, (4,)).astype(np.int32),
+                        max_new_tokens=4) for _ in range(3)]
+        eng.generate_batch(reqs, timeout=300)
+        snap = eng.metrics.snapshot()
+        assert snap["requests"]["submitted"] == 3
+        assert snap["requests"]["completed"] == 3
+        assert snap["tokens_generated"] == 12
+        assert snap["ttft_seconds"]["count"] == 3
+        assert snap["ttft_seconds"]["p50"] is not None
+        assert snap["ttft_seconds"]["p95"] >= snap["ttft_seconds"]["p50"]
+        assert snap["token_latency_seconds"]["count"] >= 1
+        assert 0.0 <= snap["slot_occupancy"]["fraction"] <= 1.0
+        assert snap["throughput_tokens_per_sec"] is None or \
+            snap["throughput_tokens_per_sec"] > 0
+
+    def test_profiler_scope_integration(self, model):
+        """serving.prefill / serving.decode_step land in the profiler
+        TimerRegistry when timers are armed, and in /metrics."""
+        from paddle_tpu.profiler.scope import (
+            disable_timers,
+            enable_timers,
+            reset_timers,
+            timer_report,
+        )
+
+        rng = np.random.default_rng(7)
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=1,
+                                       prefill_buckets=[8])
+        reset_timers()
+        enable_timers()
+        try:
+            eng.generate_batch(
+                [Request(rng.integers(0, VOCAB, (4,)).astype(np.int32),
+                         max_new_tokens=3)], timeout=300)
+            rep = timer_report()
+        finally:
+            disable_timers()
+            reset_timers()
+        assert rep["serving.prefill"]["count"] >= 1
+        assert rep["serving.decode_step"]["count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# batched key-driven sampler (ISSUE 3 satellite)
+# ---------------------------------------------------------------------------
+class TestSampleTokens:
+    def test_greedy_rows_exact(self):
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.standard_normal((4, 16)).astype("float32"))
+        assert (np.asarray(sample_tokens(logits, None))
+                == np.asarray(jnp.argmax(logits, -1))).all()
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(4))
+        out = np.asarray(sample_tokens(
+            logits, keys, temperature=jnp.array([0.0, 1.0, 0.0, 0.5]),
+            top_k=jnp.array([0, 3, 0, 2]), top_p=1.0))
+        want = np.asarray(jnp.argmax(logits, -1))
+        assert out[0] == want[0] and out[2] == want[2]
+
+    def test_per_row_top_k_respected(self):
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(1)
+        logits = jnp.asarray(rng.standard_normal((2, 32)).astype("float32"))
+        top3 = set(np.argsort(np.asarray(logits[1]))[-3:].tolist())
+        for s in range(16):
+            keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(2) + 10 * s)
+            out = sample_tokens(logits, keys,
+                                temperature=jnp.array([1.0, 1.0]),
+                                top_k=jnp.array([0, 3]), top_p=1.0)
+            assert int(out[1]) in top3
+
+    def test_row_independence_of_batch(self):
+        """A row's sample depends only on its own key/params — slots can't
+        perturb each other's sampling."""
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(2)
+        logits = jnp.asarray(rng.standard_normal((3, 16)).astype("float32"))
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray([5, 6, 7]))
+        t = jnp.array([0.8, 0.8, 0.8])
+        full = np.asarray(sample_tokens(logits, keys, t, 5, 0.9))
+        solo = np.asarray(sample_tokens(logits[1:2], keys[1:2], t[1:2],
+                                        5, 0.9))
+        assert solo[0] == full[1]
+
+    def test_one_trace_for_mixed_params(self):
+        import jax
+        import jax.numpy as jnp
+
+        calls = [0]
+
+        def f(lg, kk, t, k, p):
+            calls[0] += 1
+            return sample_tokens(lg, kk, t, k, p)
+
+        jf = jax.jit(f)
+        rng = np.random.default_rng(3)
+        lg = jnp.asarray(rng.standard_normal((2, 8)).astype("float32"))
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(2))
+        for t0 in (0.0, 0.5, 1.0):
+            jf(lg, keys, jnp.full((2,), t0, jnp.float32),
+               jnp.array([0, 4], jnp.int32), jnp.array([1.0, 0.9], jnp.float32))
+        assert calls[0] == 1
+
+    def test_generate_greedy_unchanged(self):
+        """The refactor keeps generate()'s greedy path byte-identical and
+        RNG-free (seeded programs reproduce)."""
+        m = _tiny_model()
+        rng = np.random.default_rng(4)
+        prompt = rng.integers(0, VOCAB, (2, 5)).astype(np.int32)
+        import jax
+
+        paddle.seed(123)
+        a = np.asarray(generate(m, paddle.to_tensor(prompt),
+                                max_new_tokens=5)._data)
+        state = np.asarray(jax.random.key_data(paddle.get_rng_state()))
+        paddle.seed(123)
+        b = np.asarray(generate(m, paddle.to_tensor(prompt),
+                                max_new_tokens=5)._data)
+        np.testing.assert_array_equal(a, b)
+        # greedy draws no keys: rng state equals a fresh seed's state
+        paddle.seed(123)
+        np.testing.assert_array_equal(
+            state, np.asarray(jax.random.key_data(paddle.get_rng_state())))
+
+
+class TestEngineFailureContainment:
+    def test_tick_failure_fails_requests_not_thread(self, model):
+        """An exception inside a tick marks affected requests FAILED (with
+        the error recorded) instead of silently killing the loop thread,
+        and the client stream surfaces the incompleteness."""
+        rng = np.random.default_rng(8)
+        prompt = rng.integers(0, VOCAB, (4,)).astype(np.int32)
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=1,
+                                       prefill_buckets=[8])
+
+        def boom(*a, **k):
+            raise RuntimeError("injected device fault")
+
+        eng._prefill_jit = boom
+        with ServingServer(eng) as srv:
+            cli = ServingClient(srv.addr)
+            rid = cli.submit(prompt, max_new_tokens=4)
+            res = cli.wait(rid, timeout=60)
+            assert res["status"] == "failed"
+            assert "injected device fault" in res["error"]
+            with pytest.raises(RuntimeError, match="incomplete"):
+                list(cli.stream(rid))
