@@ -1,0 +1,80 @@
+"""paddle_tpu.analysis — the jaxpr/HLO static-analysis layer ("graph doctor").
+
+Parity role: the reference framework's compile-time program checks —
+ProgramDesc verification passes, the inference pass registry's graph
+validation, ``FLAGS_check_nan_inf``-style instrumentation — rebuilt over
+the three IR surfaces this TPU-native reproduction actually produces:
+closed jaxprs (recursing through scan/cond/while/pjit/shard_map/custom_vjp),
+the ``static.Program`` op-record IR, and lowered StableHLO text.
+
+Quick use::
+
+    from paddle_tpu import analysis
+
+    target = analysis.AnalysisTarget("step", jitted_fn, example_args)
+    for f in analysis.run_rules(target):
+        print(f)
+
+    guard = analysis.TraceGuard(jitted_fn)       # runtime recompile doctor
+    ...
+    guard.findings()
+
+``python -m paddle_tpu.analysis`` lints every shipped entry point and
+writes ``benchmarks/analysis_report.json``.
+"""
+from .findings import (
+    AnalysisReport,
+    AnalysisWarning,
+    Finding,
+    Severity,
+    warn_finding,
+)
+from .graph import (
+    AnalysisTarget,
+    DefUseGraph,
+    build_graph,
+    target_from_program,
+)
+from .rules import (
+    CollectiveOrderRule,
+    ConstantBloatRule,
+    DonationRule,
+    DtypePromotionRule,
+    HostSyncRule,
+    ProgramRule,
+    RecompileHazardRule,
+    Rule,
+    ShardingPropagationRule,
+    analyze_targets,
+    default_rules,
+    register_rule,
+    run_rules,
+)
+from .traceguard import RecompileEvent, TraceGuard
+
+__all__ = [
+    "AnalysisReport",
+    "AnalysisWarning",
+    "Finding",
+    "Severity",
+    "warn_finding",
+    "AnalysisTarget",
+    "DefUseGraph",
+    "build_graph",
+    "target_from_program",
+    "Rule",
+    "register_rule",
+    "default_rules",
+    "run_rules",
+    "analyze_targets",
+    "DtypePromotionRule",
+    "ConstantBloatRule",
+    "DonationRule",
+    "HostSyncRule",
+    "RecompileHazardRule",
+    "CollectiveOrderRule",
+    "ShardingPropagationRule",
+    "ProgramRule",
+    "TraceGuard",
+    "RecompileEvent",
+]

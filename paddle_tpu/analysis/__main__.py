@@ -1,0 +1,22 @@
+"""``python -m paddle_tpu.analysis`` entry.
+
+The lint wants >= 2 devices for the pipeline entry point, but by the time
+this module runs the parent package import has already initialized the jax
+backend — env changes here are too late.  When the host-device-count flag
+is absent, re-exec once with it set (its presence breaks the recursion).
+The flag only affects the CPU host platform, so a TPU/GPU host still lints
+on its real backend; JAX_PLATFORMS is never overridden.
+"""
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+    os.execv(sys.executable,
+             [sys.executable, "-m", "paddle_tpu.analysis"] + sys.argv[1:])
+
+from .cli import main  # noqa: E402
+
+sys.exit(main())

@@ -1,0 +1,91 @@
+"""``python -m paddle_tpu.analysis`` — lint the shipped entry points.
+
+Builds every shipped program family (trainer step, pipeline 1F1B step,
+serving prefill/decode, exported inference, static Program), runs the full
+rule registry, prints a findings table, and writes the JSON report to
+``benchmarks/analysis_report.json`` (the artifact the zero-HIGH CI smoke
+test and ``bench.py _analysis_overhead`` read).
+
+Exit status: 0 when no finding reaches ``--fail-on`` (default HIGH), 1
+otherwise, 2 when an entry point could not even be built.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="Static TPU-hazard linter over shipped entry points")
+    parser.add_argument("--out", default=None,
+                        help="JSON report path (default "
+                             "benchmarks/analysis_report.json)")
+    from .entrypoints import builder_names
+
+    parser.add_argument("--only", action="append", default=[],
+                        choices=builder_names(),
+                        help="entry-point builder(s) to lint; an unknown "
+                             "name is a usage error, not an empty lint")
+    parser.add_argument("--fail-on", default="high",
+                        choices=["high", "medium", "low", "info", "never"],
+                        help="exit 1 when a finding at/above this severity "
+                             "exists (default high)")
+    parser.add_argument("--keep-going", action="store_true",
+                        help="lint the buildable entry points even when "
+                             "some builders fail")
+    args = parser.parse_args(argv)
+    # NOTE: platform/device-count env setup lives in __main__.py (re-exec
+    # before jax initializes); mutating os.environ here would be both too
+    # late for this process and a leak into child processes.
+
+    import jax
+
+    from .entrypoints import shipped_entry_points
+    from .findings import Severity
+    from .rules import analyze_targets
+
+    t0 = time.perf_counter()
+    # always collect builder failures so they reach the report (and exit 2)
+    # instead of escaping as a raw traceback
+    targets, errors = shipped_entry_points(
+        skip_errors=True, only=tuple(args.only))
+    report = analyze_targets(
+        targets,
+        meta={"tool": "paddle_tpu.analysis", "backend": jax.default_backend(),
+              "n_devices": len(jax.devices()), "build_errors": errors})
+    report.meta["total_s"] = round(time.perf_counter() - t0, 3)
+
+    out = args.out
+    if out is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        bench_dir = os.path.join(root, "benchmarks")
+        out = (os.path.join(bench_dir, "analysis_report.json")
+               if os.path.isdir(bench_dir) else "analysis_report.json")
+    report.save(out)
+
+    print(f"linted {len(targets)} entry points in "
+          f"{report.meta['total_s']}s -> {out}")
+    for name, err in errors.items():
+        print(f"  BUILD FAILED {name}: {err}")
+    print()
+    print(report.table())
+    counts = report.counts()
+    print()
+    print("findings:", ", ".join(f"{k}={v}" for k, v in counts.items()))
+
+    if errors and not args.keep_going:
+        return 2
+    if args.fail_on != "never":
+        gate = Severity[args.fail_on.upper()]
+        if report.at_least(gate):
+            return 1
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,163 @@
+"""Findings model for the static-analysis layer ("graph doctor").
+
+Parity role: the reference framework reports compile-time program problems
+through ProgramDesc verification passes and the inference pass registry's
+pass-failure diagnostics; ``FLAGS_check_nan_inf`` instruments at runtime.
+Here every check produces a structured :class:`Finding` — severity-ranked,
+source-attributed (jaxpr ``source_info`` + the r6 profiler ``scope`` names
+that survive into HLO metadata) — collected into an :class:`AnalysisReport`
+that serializes to the JSON artifact under ``benchmarks/``.
+
+:class:`AnalysisWarning` is the *warning-channel* form of a Finding: rules
+that run inline inside another subsystem (e.g. the dy2static strictness
+pass) emit their findings through :func:`warn_finding` so callers see a
+normal, filterable Python warning that still carries the structured record.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import time
+import warnings
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "Severity",
+    "Finding",
+    "AnalysisWarning",
+    "AnalysisReport",
+    "warn_finding",
+]
+
+
+class Severity(enum.IntEnum):
+    """Ranked severities; HIGH findings gate CI (zero-HIGH smoke test)."""
+
+    INFO = 0
+    LOW = 1
+    MEDIUM = 2
+    HIGH = 3
+
+    def __str__(self):  # "HIGH" not "Severity.HIGH" in reports
+        return self.name
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnostic from one rule on one program point.
+
+    ``scope`` is the profiler name_stack at the offending eqn (the same
+    names ``profiler.scope``/``annotate`` thread into HLO metadata, r6);
+    ``source`` is the Python ``file:line (function)`` that traced it.
+    """
+
+    rule: str
+    severity: Severity
+    message: str
+    entry_point: str = ""
+    scope: str = ""
+    source: str = ""
+    details: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": str(self.severity),
+            "message": self.message,
+            "entry_point": self.entry_point,
+            "scope": self.scope,
+            "source": self.source,
+            "details": _jsonable(self.details),
+        }
+
+    def __str__(self):
+        loc = " @ ".join(x for x in (self.scope, self.source) if x)
+        head = f"[{self.severity}] {self.rule}: {self.message}"
+        return f"{head} ({loc})" if loc else head
+
+
+class AnalysisWarning(UserWarning):
+    """Structured warning wrapping a :class:`Finding` (``.finding``)."""
+
+    def __init__(self, finding: Finding):
+        self.finding = finding
+        super().__init__(str(finding))
+
+
+def warn_finding(finding: Finding, stacklevel: int = 2):
+    """Emit ``finding`` through the Python warning machinery (inline rules
+    like the dy2static strictness pass report this way)."""
+    warnings.warn(AnalysisWarning(finding), stacklevel=stacklevel + 1)
+    return finding
+
+
+class AnalysisReport:
+    """Findings for one or more entry points + run metadata."""
+
+    def __init__(self, findings: Optional[List[Finding]] = None,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.findings: List[Finding] = list(findings or [])
+        self.meta: Dict[str, Any] = dict(meta or {})
+
+    def extend(self, findings):
+        self.findings.extend(findings)
+        return self
+
+    def by_severity(self, severity: Severity) -> List[Finding]:
+        return [f for f in self.findings if f.severity == severity]
+
+    def at_least(self, severity: Severity) -> List[Finding]:
+        return [f for f in self.findings if f.severity >= severity]
+
+    def high(self) -> List[Finding]:
+        return self.by_severity(Severity.HIGH)
+
+    def counts(self) -> Dict[str, int]:
+        out = {str(s): 0 for s in Severity}
+        for f in self.findings:
+            out[str(f.severity)] += 1
+        return out
+
+    def to_dict(self) -> dict:
+        ordered = sorted(self.findings,
+                         key=lambda f: (-int(f.severity), f.entry_point, f.rule))
+        return {
+            "meta": dict(self.meta, generated_at=time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.gmtime())),
+            "counts": self.counts(),
+            "findings": [f.to_dict() for f in ordered],
+        }
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    def save(self, path: str):
+        with open(path, "w") as fh:
+            fh.write(self.to_json() + "\n")
+        return path
+
+    def table(self) -> str:
+        """Fixed-width findings table (the CLI's human-readable view)."""
+        if not self.findings:
+            return "no findings"
+        rows = [("SEV", "ENTRY POINT", "RULE", "MESSAGE")]
+        for f in sorted(self.findings,
+                        key=lambda f: (-int(f.severity), f.entry_point)):
+            rows.append((str(f.severity), f.entry_point, f.rule, f.message))
+        widths = [min(max(len(r[i]) for r in rows), 44) for i in range(3)]
+        lines = []
+        for r in rows:
+            cells = [r[i][: widths[i]].ljust(widths[i]) for i in range(3)]
+            lines.append("  ".join(cells) + "  " + r[3])
+        return "\n".join(lines)
+
+
+def _jsonable(v):
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple, set, frozenset)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)

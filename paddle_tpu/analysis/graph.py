@@ -1,0 +1,648 @@
+"""Jaxpr walker: def-use dataflow graph over every IR surface we produce.
+
+The walker recurses through ``pjit`` / ``scan`` / ``while`` / ``cond`` /
+``shard_map`` / ``custom_vjp`` sub-jaxprs and flattens the whole program
+into a list of :class:`Node` records carrying
+
+* **source attribution** — the eqn's ``source_info`` traceback summary plus
+  the ``name_stack`` (the r6 profiler ``scope``/``annotate`` names that
+  survive into HLO metadata), so a finding points at *our* region names,
+* **def-use edges** — global producer index per operand, crossing sub-jaxpr
+  boundaries (an outer convert feeding an inner dot is one edge),
+* **mesh-uniformity taint** — per value, the set of mesh axes along which
+  it MAY differ between ranks.  ``axis_index('x')`` taints with ``{x}``, a
+  ``shard_map`` input sharded over 'x' likewise; ``psum``/``pmin``/
+  ``pmax``/``all_gather`` over 'x' REMOVE 'x' (the result is provably
+  uniform along the reduced axis).  The collective-order rule uses this to
+  prove a ``lax.cond`` predicate uniform along the axes of the collectives
+  it gates — the static form of the r7 sentinel's pmin'd verdict.
+
+Three IR front doors:
+
+* :class:`AnalysisTarget` — any callable (jitted or not) + example args;
+  ``.jaxpr()`` / ``.graph()`` / ``.stablehlo()`` are built lazily and
+  cached.
+* :func:`target_from_program` — wraps a ``paddle_tpu.static.Program``
+  (op-record IR) by compiling its Executor replay, so every jaxpr rule
+  applies to static-mode programs too.
+* ``donate_argnums`` override — lints the *intended* donation of entry
+  points whose live jit gates donation on backend (serving gates it off on
+  CPU where XLA ignores aliasing hints).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # pinned-version internal (public jax.core deprecates these re-exports)
+    from jax._src import core as _jcore
+except ImportError:  # pragma: no cover
+    import jax.core as _jcore
+
+try:
+    from jax._src import source_info_util as _siu
+except ImportError:  # pragma: no cover
+    _siu = None
+
+__all__ = [
+    "Node",
+    "DefUseGraph",
+    "AnalysisTarget",
+    "build_graph",
+    "target_from_program",
+    "COLLECTIVE_PRIMS",
+    "UNIFORMIZING_PRIMS",
+]
+
+# collectives that must execute in lockstep across the ranks of their axes
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmin", "pmax", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "psum_scatter", "reduce_scatter", "pgather",
+})
+# collectives whose OUTPUT is uniform along the reduced/gathered axes
+UNIFORMIZING_PRIMS = frozenset({"psum", "pmin", "pmax", "all_gather"})
+
+# host round-trip primitives (the host-sync rule's trigger set)
+CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "outside_call",
+    "host_callback_call",
+})
+
+
+def _axes_of(params: dict) -> Tuple[str, ...]:
+    """Mesh axis names referenced by a collective eqn's params."""
+    ax = params.get("axes", params.get("axis_name", ()))
+    if isinstance(ax, (str, int)):
+        ax = (ax,)
+    return tuple(a for a in ax if isinstance(a, str))
+
+
+def _aval_info(v):
+    aval = getattr(v, "aval", v)
+    shape = tuple(getattr(aval, "shape", ()))
+    dtype = getattr(aval, "dtype", None)
+    return (shape, str(dtype) if dtype is not None else None,
+            bool(getattr(aval, "weak_type", False)))
+
+
+def _nbytes(aval_info) -> int:
+    shape, dtype, _ = aval_info
+    if dtype is None:
+        return 0
+    try:
+        item = np.dtype(dtype).itemsize
+    except TypeError:  # extended dtypes (typed PRNG keys)
+        item = 16
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n * item
+
+
+@dataclasses.dataclass
+class Node:
+    """One eqn, anywhere in the (possibly nested) program."""
+
+    idx: int
+    prim: str
+    path: Tuple[str, ...]          # enclosing sub-jaxpr labels
+    name_stack: str                # profiler scope names (HLO metadata)
+    source: str                    # "file:line (function)"
+    in_avals: Tuple                # ((shape, dtype, weak_type), ...)
+    out_avals: Tuple
+    in_defs: Tuple[int, ...]       # producing Node idx; -1 input, -2 const
+    axes: Tuple[str, ...]          # collective axes ((),) for others
+    nonuniform: FrozenSet[str]     # mesh axes the outputs may differ along
+
+    @property
+    def where(self) -> str:
+        return " @ ".join(x for x in (self.name_stack, self.source) if x)
+
+
+@dataclasses.dataclass
+class DonationSite:
+    path: Tuple[str, ...]
+    name: str
+    donated: Tuple[bool, ...]          # per pjit invar
+    in_avals: Tuple                    # per pjit invar
+    out_avals: Tuple
+    in_labels: Tuple[str, ...]         # arg paths where known, else ""
+
+
+@dataclasses.dataclass
+class ConstInfo:
+    path: Tuple[str, ...]
+    shape: Tuple[int, ...]
+    dtype: str
+    nbytes: int
+
+
+@dataclasses.dataclass
+class CondSite:
+    node: int
+    pred_nonuniform: FrozenSet[str]
+    branch_collectives: Tuple[Tuple[Tuple[str, Tuple[str, ...]], ...], ...]
+    name_stack: str
+    source: str
+
+
+@dataclasses.dataclass
+class WhileSite:
+    node: int
+    pred_nonuniform: FrozenSet[str]
+    body_collectives: Tuple[Tuple[str, Tuple[str, ...]], ...]
+    name_stack: str
+    source: str
+
+
+class DefUseGraph:
+    """Flattened def-use view of one closed jaxpr (all nesting levels)."""
+
+    def __init__(self, closed_jaxpr):
+        self.closed = closed_jaxpr
+        self.nodes: List[Node] = []
+        self.donation_sites: List[DonationSite] = []
+        self.consts: List[ConstInfo] = []
+        self.conds: List[CondSite] = []
+        self.whiles: List[WhileSite] = []
+        self.invar_labels: Dict[Any, str] = {}  # top-level Var -> arg path
+
+    # -- queries --------------------------------------------------------
+    def producer(self, node: Node, operand: int) -> Optional[Node]:
+        i = node.in_defs[operand]
+        return self.nodes[i] if i >= 0 else None
+
+    def prims(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for n in self.nodes:
+            out[n.prim] = out.get(n.prim, 0) + 1
+        return out
+
+    def const_bytes(self) -> int:
+        return sum(c.nbytes for c in self.consts)
+
+
+def _source_of(eqn) -> str:
+    if _siu is None:
+        return ""
+    try:
+        return _siu.summarize(eqn.source_info)
+    except Exception:
+        return ""
+
+
+def _name_stack_of(eqn) -> str:
+    try:
+        return str(eqn.source_info.name_stack)
+    except Exception:
+        return ""
+
+
+def _taint_out(prim, params, union):
+    """Output nonuniformity of one eqn given the union of input taints."""
+    if prim == "axis_index":
+        return union | set(_axes_of(params))
+    if prim in UNIFORMIZING_PRIMS:
+        return union - set(_axes_of(params))
+    if prim in COLLECTIVE_PRIMS:
+        return union | set(_axes_of(params))
+    return union
+
+
+def _taint_closed(closed, in_taints):
+    """Taint-only propagation through a (Closed)Jaxpr — no node recording.
+    Used to stabilize while/scan loop-carry taints to a FIXPOINT before the
+    recorded walk: a body that writes ``axis_index`` into a carry the
+    predicate reads makes the trip count rank-divergent, which a single
+    forward pass over the initial carry taints cannot see."""
+    jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    env = {cv: frozenset() for cv in jaxpr.constvars}
+    invars = jaxpr.invars
+    if len(in_taints) == len(invars):
+        env.update(zip(invars, in_taints))
+    else:
+        union = frozenset().union(*in_taints) if in_taints else frozenset()
+        for v in invars:
+            env[v] = union
+
+    def read(v):
+        return frozenset() if isinstance(v, _jcore.Literal) \
+            else env.get(v, frozenset())
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        union = frozenset().union(*(read(v) for v in eqn.invars)) \
+            if eqn.invars else frozenset()
+        out = _taint_out(prim, eqn.params, union)
+        if prim == "cond":
+            branch_outs = [
+                _taint_closed(br, [read(v) for v in eqn.invars[1:]])
+                for br in eqn.params.get("branches", ())]
+            pred = read(eqn.invars[0])
+            outs = [frozenset().union(pred, *(b[i] for b in branch_outs))
+                    for i in range(len(eqn.outvars))] if branch_outs else None
+            for v, t in zip(eqn.outvars, outs or []):
+                env[v] = t
+            if outs is not None:
+                continue
+        elif prim == "while":
+            cn = eqn.params.get("cond_nconsts", 0)
+            bn = eqn.params.get("body_nconsts", 0)
+            ins = [read(v) for v in eqn.invars]
+            carry = _while_fixpoint(eqn.params, ins[:cn], ins[cn:cn + bn],
+                                    ins[cn + bn:])
+            for v, t in zip(eqn.outvars, carry):
+                env[v] = t
+            continue
+        elif prim == "scan":
+            ins = [read(v) for v in eqn.invars]
+            outs = _scan_fixpoint(eqn.params, ins)
+            for v, t in zip(eqn.outvars, outs):
+                env[v] = t
+            continue
+        elif prim == "shard_map":
+            # mirror _Walker._recurse: sharded inputs are nonuniform along
+            # their in_names axes (the generic branch would under-taint a
+            # shard_map inside a while/scan body and certify a deadlock)
+            in_names = eqn.params.get("in_names", ())
+            mapped = []
+            for i, v in enumerate(eqn.invars):
+                names = in_names[i] if i < len(in_names) else {}
+                ax = set()
+                for nv in (names.values() if hasattr(names, "values")
+                           else ()):
+                    ax.update(a for a in (nv if isinstance(nv, (tuple, list))
+                                          else (nv,)) if isinstance(a, str))
+                mapped.append(read(v) | ax)
+            o = _taint_closed(eqn.params["jaxpr"], mapped)
+            if len(o) == len(eqn.outvars):
+                for v, t in zip(eqn.outvars, o):
+                    env[v] = t
+                continue
+        else:
+            subs = [v for v in eqn.params.values()
+                    if isinstance(v, (_jcore.Jaxpr, _jcore.ClosedJaxpr))]
+            done = False
+            for sub in subs:
+                o = _taint_closed(sub, [read(v) for v in eqn.invars])
+                if len(o) == len(eqn.outvars):
+                    for v, t in zip(eqn.outvars, o):
+                        env[v] = t | out
+                    done = True
+            if done:
+                continue
+        for v in eqn.outvars:
+            env[v] = out
+    return [read(v) for v in jaxpr.outvars]
+
+
+def _while_fixpoint(params, cond_consts, body_consts, carry):
+    """Stabilized per-carry-slot taints for a while loop (taints only grow;
+    the lattice is finite, so this terminates)."""
+    carry = list(carry)
+    for _ in range(32):
+        out = _taint_closed(params["body_jaxpr"], body_consts + carry)
+        pred = _taint_closed(params["cond_jaxpr"], cond_consts + carry)
+        pred_t = pred[0] if pred else frozenset()
+        # a rank-divergent trip count taints every carry slot
+        new = [c | o | pred_t for c, o in zip(carry, out)]
+        if new == carry:
+            break
+        carry = new
+    return carry
+
+
+def _scan_fixpoint(params, in_taints):
+    """Stabilized taints for scan (consts + carry + xs -> carry + ys)."""
+    nc = params.get("num_consts", 0)
+    nk = params.get("num_carry", 0)
+    consts, carry, xs = (in_taints[:nc], list(in_taints[nc:nc + nk]),
+                         in_taints[nc + nk:])
+    out = None
+    for _ in range(32):
+        out = _taint_closed(params["jaxpr"], consts + carry + xs)
+        new = [c | o for c, o in zip(carry, out[:nk])]
+        if new == carry:
+            break
+        carry = new
+    ys = out[nk:] if out is not None else []
+    return carry + list(ys)
+
+
+class _Walker:
+    def __init__(self, graph: DefUseGraph):
+        self.g = graph
+
+    def _record_consts(self, closed, path):
+        for c in getattr(closed, "consts", ()):
+            shape = tuple(getattr(c, "shape", ()))
+            dtype = getattr(c, "dtype", None)
+            if dtype is None:
+                continue
+            self.g.consts.append(ConstInfo(
+                path, shape, str(dtype),
+                _nbytes((shape, str(dtype), False))))
+
+    def walk_closed(self, closed, operand_info, path):
+        """Walk a ClosedJaxpr given per-operand (taint, def) info aligned
+        with its jaxpr invars; returns per-outvar (taint, def)."""
+        self._record_consts(closed, path)
+        jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+        env: Dict[Any, Tuple[FrozenSet[str], int]] = {}
+        for cv in jaxpr.constvars:
+            env[cv] = (frozenset(), -2)
+        invars = jaxpr.invars
+        if len(operand_info) == len(invars):
+            pairs = zip(invars, operand_info)
+        else:  # conservative alignment: trailing args match, rest union
+            union = frozenset().union(*(t for t, _ in operand_info)) \
+                if operand_info else frozenset()
+            k = min(len(operand_info), len(invars))
+            pairs = [(v, (union, -1)) for v in invars[: len(invars) - k]]
+            pairs += list(zip(invars[len(invars) - k:], operand_info[-k:] if k else []))
+        for v, info in pairs:
+            env[v] = info
+        return self._walk_jaxpr(jaxpr, env, path)
+
+    def _read(self, env, v):
+        if isinstance(v, _jcore.Literal):
+            return (frozenset(), -1)
+        return env.get(v, (frozenset(), -1))
+
+    def _walk_jaxpr(self, jaxpr, env, path):
+        g = self.g
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            in_info = [self._read(env, v) for v in eqn.invars]
+            in_taints = [t for t, _ in in_info]
+            union = frozenset().union(*in_taints) if in_taints else frozenset()
+            axes = _axes_of(eqn.params) if (
+                prim in COLLECTIVE_PRIMS or prim == "axis_index") else ()
+            # ONE transfer function, shared with the fixpoint pre-pass —
+            # diverging copies would silently corrupt collective verdicts
+            out_taint = _taint_out(prim, eqn.params, union)
+
+            idx = len(g.nodes)
+            node = Node(
+                idx=idx, prim=prim, path=path,
+                name_stack=_name_stack_of(eqn), source=_source_of(eqn),
+                in_avals=tuple(_aval_info(v) for v in eqn.invars),
+                out_avals=tuple(_aval_info(v) for v in eqn.outvars),
+                in_defs=tuple(d for _, d in in_info),
+                axes=axes, nonuniform=out_taint,
+            )
+            g.nodes.append(node)
+
+            out_info = self._recurse(eqn, node, in_info, out_taint, path)
+            if out_info is None:
+                out_info = [(out_taint, idx)] * len(eqn.outvars)
+            for v, info in zip(eqn.outvars, out_info):
+                env[v] = info
+        return [self._read(env, v) for v in jaxpr.outvars]
+
+    # -- sub-jaxpr recursion -------------------------------------------
+    def _recurse(self, eqn, node, in_info, out_taint, path):
+        prim = eqn.primitive.name
+        params = eqn.params
+        g = self.g
+        sub_path = path + (f"{prim}@{node.idx}",)
+
+        if prim == "pjit":
+            closed = params["jaxpr"]
+            donated = tuple(params.get("donated_invars", ()))
+            labels = tuple(
+                "" if isinstance(v, _jcore.Literal)
+                else g.invar_labels.get(v, "") for v in eqn.invars)
+            g.donation_sites.append(DonationSite(
+                path=path, name=str(params.get("name", "")),
+                donated=donated,
+                in_avals=node.in_avals, out_avals=node.out_avals,
+                in_labels=labels))
+            return self.walk_closed(closed, in_info, sub_path)
+
+        if prim == "shard_map":
+            inner = params["jaxpr"]
+            in_names = params.get("in_names", ())
+            mapped = []
+            for i, (t, d) in enumerate(in_info):
+                names = in_names[i] if i < len(in_names) else {}
+                ax = set()
+                for v in (names.values() if hasattr(names, "values") else ()):
+                    ax.update(a for a in (v if isinstance(v, (tuple, list))
+                                          else (v,)) if isinstance(a, str))
+                mapped.append((t | ax, d))
+            return self.walk_closed(inner, mapped, sub_path)
+
+        if prim == "cond":
+            branches = params.get("branches", ())
+            pred_t, _ = in_info[0]
+            seqs = []
+            outs = None
+            for bi, br in enumerate(branches):
+                mark = len(g.nodes)
+                o = self.walk_closed(br, in_info[1:],
+                                     sub_path + (f"branch{bi}",))
+                seqs.append(tuple(
+                    (n.prim, n.axes) for n in g.nodes[mark:]
+                    if n.prim in COLLECTIVE_PRIMS))
+                outs = o if outs is None else [
+                    (a[0] | b[0], node.idx) for a, b in zip(outs, o)]
+            g.conds.append(CondSite(
+                node=node.idx, pred_nonuniform=pred_t,
+                branch_collectives=tuple(seqs),
+                name_stack=node.name_stack, source=node.source))
+            if outs is not None:
+                return [(t | pred_t, node.idx) for t, _ in outs]
+            return None
+
+        if prim == "while":
+            cn = params.get("cond_nconsts", 0)
+            bn = params.get("body_nconsts", 0)
+            # stabilize loop-carry taints to a fixpoint FIRST: a body that
+            # writes axis_index into a carry slot the predicate reads makes
+            # the trip count rank-divergent, invisible to a single pass
+            stable = _while_fixpoint(
+                params, [t for t, _ in in_info[:cn]],
+                [t for t, _ in in_info[cn:cn + bn]],
+                [t for t, _ in in_info[cn + bn:]])
+            carry = [(t, d) for t, (_, d) in zip(stable, in_info[cn + bn:])]
+            mark = len(self.g.nodes)
+            cond_out = self.walk_closed(
+                params["cond_jaxpr"], in_info[:cn] + carry,
+                sub_path + ("cond",))
+            pred_t = cond_out[0][0] if cond_out else frozenset()
+            body_out = self.walk_closed(
+                params["body_jaxpr"], in_info[cn:cn + bn] + carry,
+                sub_path + ("body",))
+            # the cond jaxpr executes once per iteration too: its
+            # collectives must match across ranks just like the body's
+            body_seq = tuple((n.prim, n.axes) for n in g.nodes[mark:]
+                             if n.prim in COLLECTIVE_PRIMS)
+            g.whiles.append(WhileSite(
+                node=node.idx, pred_nonuniform=pred_t,
+                body_collectives=body_seq,
+                name_stack=node.name_stack, source=node.source))
+            return [(t | pred_t, node.idx) for t, _ in body_out]
+
+        if prim == "scan":
+            nc = params.get("num_consts", 0)
+            nk = params.get("num_carry", 0)
+            stable = _scan_fixpoint(params, [t for t, _ in in_info])
+            mapped = list(in_info[:nc]) + [
+                (t, d) for t, (_, d) in zip(stable[:nk], in_info[nc:nc + nk])
+            ] + list(in_info[nc + nk:])
+            return self.walk_closed(params["jaxpr"], mapped, sub_path)
+
+        # generic: custom_vjp/jvp, remat, closed_call, named_call, ...
+        subs = [(k, v) for k, v in params.items()
+                if isinstance(v, (_jcore.Jaxpr, _jcore.ClosedJaxpr))]
+        outs = None
+        for k, sub in subs:
+            o = self.walk_closed(sub, in_info, sub_path + (k,))
+            if len(o) == len(eqn.outvars):
+                outs = o
+        return outs
+
+
+def build_graph(closed_jaxpr, invar_labels: Optional[Dict] = None) -> DefUseGraph:
+    g = DefUseGraph(closed_jaxpr)
+    if invar_labels:
+        g.invar_labels.update(invar_labels)
+    w = _Walker(g)
+    jaxpr = closed_jaxpr.jaxpr
+    w._record_consts(closed_jaxpr, ())
+    env = {cv: (frozenset(), -2) for cv in jaxpr.constvars}
+    for v in jaxpr.invars:
+        env[v] = (frozenset(), -1)
+    w._walk_jaxpr(jaxpr, env, ())
+    return g
+
+
+# ---------------------------------------------------------------------------
+# analysis targets
+# ---------------------------------------------------------------------------
+class AnalysisTarget:
+    """A lintable entry point: callable + example args (+ metadata).
+
+    ``donate_argnums`` overrides donation info for the donation rule —
+    positions into ``args`` whose leaves are *intended* donated (used when
+    the live jit gates donation on backend, e.g. serving on CPU).
+    ``tags`` steer rule applicability ({"train", "serving", "inference",
+    "static", "spmd"}).
+    """
+
+    def __init__(self, name: str, fn: Callable, args: Sequence = (),
+                 kwargs: Optional[dict] = None, *,
+                 tags: Sequence[str] = (),
+                 donate_argnums: Optional[Sequence[int]] = None,
+                 program=None, compute_dtype=None):
+        self.name = name
+        self.fn = fn
+        self.args = tuple(args)
+        self.kwargs = dict(kwargs or {})
+        self.tags = frozenset(tags)
+        self.donate_argnums = (tuple(donate_argnums)
+                               if donate_argnums is not None else None)
+        self.program = program
+        self.compute_dtype = compute_dtype
+        self._jaxpr = None
+        self._graph = None
+        self._stablehlo = None
+
+    # -- lazy IR surfaces ----------------------------------------------
+    def jaxpr(self):
+        if self._jaxpr is None:
+            self._jaxpr = jax.make_jaxpr(self.fn)(*self.args, **self.kwargs)
+        return self._jaxpr
+
+    def arg_labels(self) -> List[str]:
+        """Flat leaf labels like ``args[0]['params']['w']`` aligned with the
+        top-level jaxpr invars."""
+        labels = []
+        for i, a in enumerate(self.args):
+            leaves = jax.tree_util.tree_flatten_with_path(a)[0]
+            for p, _ in leaves:
+                labels.append(f"args[{i}]" + jax.tree_util.keystr(p))
+        return labels
+
+    def graph(self) -> DefUseGraph:
+        if self._graph is None:
+            closed = self.jaxpr()
+            labels = self.arg_labels()
+            invars = closed.jaxpr.invars
+            mapping = dict(zip(invars, labels)) \
+                if len(labels) == len(invars) else {}
+            self._graph = build_graph(closed, mapping)
+        return self._graph
+
+    def donated_mask(self) -> Optional[Tuple[bool, ...]]:
+        """Flat per-leaf intended-donation mask aligned with arg_labels(),
+        from the ``donate_argnums`` override (None when not overridden)."""
+        if self.donate_argnums is None:
+            return None
+        mask = []
+        for i, a in enumerate(self.args):
+            n = len(jax.tree_util.tree_leaves(a))
+            mask.extend([i in self.donate_argnums] * n)
+        return tuple(mask)
+
+    def stablehlo(self) -> str:
+        if self._stablehlo is None:
+            fn = self.fn
+            lowered = (fn.lower(*self.args, **self.kwargs)
+                       if hasattr(fn, "lower")
+                       else jax.jit(fn).lower(*self.args, **self.kwargs))
+            self._stablehlo = lowered.as_text()
+        return self._stablehlo
+
+
+def target_from_program(program, name: str = "static_program",
+                        feed: Optional[Dict[str, Any]] = None,
+                        lr: float = 0.01) -> AnalysisTarget:
+    """Wrap a ``static.Program`` as an AnalysisTarget by compiling its
+    Executor replay (forward + ``jax.grad`` backward + optimizer update —
+    exactly what ``Executor.run`` jits), so every jaxpr rule covers the
+    op-record IR too."""
+    from ..static.executor import Executor
+
+    feed = feed or {}
+    feed_names = sorted(n for n in program.feed_vars if n != "__rng_key__")
+    feed_arrays = []
+    for n in feed_names:
+        if n in feed:
+            feed_arrays.append(jnp.asarray(feed[n]))
+            continue
+        v = program.feed_vars[n]
+        decl = v._declared_shape or list(v._data.shape)
+        shape = tuple(2 if (d is None or d < 0) else int(d) for d in decl)
+        feed_arrays.append(jnp.zeros(shape, v._data.dtype))
+
+    if program.loss_var is not None:
+        fetch_vars = [program.loss_var]
+    elif program.ops:
+        fetch_vars = [program.ops[-1].out_vars[0]]
+    else:
+        fetch_vars = []
+    captures = program.captures()
+    capture_arrays = [t._data for (t, _) in captures]
+    exe = Executor()
+    compiled = exe._compile(program, feed_names, fetch_vars, captures)
+
+    rng_args = ()
+    if program.rng_used:
+        rng_args = (jax.random.key(0),)
+    if program.optimizer is not None:
+        opt_state = program._opt_state
+        if opt_state is None:
+            opt_state = program.optimizer.init_state(
+                [p._data for p in program.opt_params])
+        args = (feed_arrays, capture_arrays, opt_state,
+                jnp.asarray(lr, jnp.float32)) + rng_args
+    else:
+        args = (feed_arrays, capture_arrays) + rng_args
+    return AnalysisTarget(name, compiled, args, tags=("static",),
+                          program=program)

@@ -1,0 +1,172 @@
+"""TraceGuard: runtime recompile interception + arg-signature attribution.
+
+The static ``recompile-hazard`` rule can only point at weak-typed entry
+args; the expensive failure mode — a training/serving step silently
+re-tracing every call because one argument's shape/dtype/static value
+drifts — is a *runtime* phenomenon.  ``TraceGuard`` wraps a jitted callable
+and, on every call, snapshots the jit cache-key-relevant signature of the
+arguments (shape, dtype, weak_type per array leaf; ``repr`` per static
+leaf).  When the underlying jit compiles a new program (observed through
+``fn._cache_size()``; signature novelty is the fallback for plain
+callables) the guard diffs the new signature against the *closest*
+previously-seen one and records exactly which components differ — the
+answer to "what made step #N recompile?".
+
+Parity role: the reference logs cache misses in its executor scope cache;
+this is the TPU-native equivalent for jit program caches, feeding the same
+Finding/report machinery as the static rules.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .findings import Finding, Severity
+
+__all__ = ["TraceGuard", "RecompileEvent"]
+
+
+@dataclasses.dataclass
+class RecompileEvent:
+    """One observed recompile, attributed to the differing components."""
+
+    call_index: int                 # which call to the guard recompiled
+    n_compiles: int                 # total compiles seen so far
+    diffs: List[dict]               # [{component, before, after}]
+    signature: Tuple                # full new signature
+
+    def describe(self) -> str:
+        if not self.diffs:
+            return "recompile with no visible arg-signature change"
+        parts = [f"{d['component']}: {d['before']} -> {d['after']}"
+                 for d in self.diffs]
+        return "; ".join(parts)
+
+
+def _leaf_sig(leaf):
+    data = getattr(leaf, "_data", leaf)  # paddle Tensor -> array
+    shape = getattr(data, "shape", None)
+    dtype = getattr(data, "dtype", None)
+    if shape is not None and dtype is not None:
+        weak = bool(getattr(data, "weak_type", False))
+        return f"{dtype}[{','.join(str(s) for s in shape)}]" + (
+            "~weak" if weak else "")
+    return f"static:{repr(leaf)[:80]}"
+
+
+def signature_of(args, kwargs) -> Tuple[Tuple[str, str], ...]:
+    """((component label, component signature), ...) over all leaves."""
+    import jax
+
+    out = []
+    for i, a in enumerate(args):
+        for path, leaf in jax.tree_util.tree_flatten_with_path(a)[0]:
+            out.append((f"args[{i}]" + jax.tree_util.keystr(path),
+                        _leaf_sig(leaf)))
+    for k in sorted(kwargs):
+        for path, leaf in jax.tree_util.tree_flatten_with_path(kwargs[k])[0]:
+            out.append((f"{k}" + jax.tree_util.keystr(path),
+                        _leaf_sig(leaf)))
+    return tuple(out)
+
+
+def _diff(old: Tuple, new: Tuple) -> List[dict]:
+    olds, news = dict(old), dict(new)
+    diffs = []
+    for comp, sig in news.items():
+        prev = olds.get(comp)
+        if prev is None:
+            diffs.append({"component": comp, "before": "<absent>",
+                          "after": sig})
+        elif prev != sig:
+            diffs.append({"component": comp, "before": prev, "after": sig})
+    for comp, sig in olds.items():
+        if comp not in news:
+            diffs.append({"component": comp, "before": sig,
+                          "after": "<absent>"})
+    return diffs
+
+
+class TraceGuard:
+    """Wrap a (jitted) callable; intercept cache misses; attribute them.
+
+    Usage::
+
+        guard = TraceGuard(trainer._jit_step, name="trainer.step")
+        ... run steps through guard(...) ...
+        guard.findings()   # -> [Finding(rule="recompile-hazard", ...)]
+    """
+
+    def __init__(self, fn: Callable, name: Optional[str] = None,
+                 max_compiles: int = 2):
+        self._fn = fn
+        self.name = name or getattr(fn, "__name__", "jit_fn")
+        self.max_compiles = max_compiles
+        self.events: List[RecompileEvent] = []
+        self.calls = 0
+        self._sigs: List[Tuple] = []
+        self._compiles = 0
+
+    # -- cache probe ----------------------------------------------------
+    def _cache_size(self) -> Optional[int]:
+        probe = getattr(self._fn, "_cache_size", None)
+        if probe is None:
+            return None
+        try:
+            return int(probe())
+        except Exception:
+            return None
+
+    def __call__(self, *args, **kwargs):
+        sig = signature_of(args, kwargs)
+        before = self._cache_size()
+        out = self._fn(*args, **kwargs)
+        after = self._cache_size()
+        if after is not None and before is not None:
+            missed = after > before
+            self._compiles = after
+        else:  # plain callable: signature novelty mirrors the jit cache key
+            missed = sig not in self._sigs
+            if missed:
+                self._compiles += 1
+        if missed and self._sigs:
+            closest = min(self._sigs, key=lambda s: len(_diff(s, sig)))
+            self.events.append(RecompileEvent(
+                call_index=self.calls, n_compiles=self._compiles,
+                diffs=_diff(closest, sig), signature=sig))
+        if sig not in self._sigs:
+            self._sigs.append(sig)
+        self.calls += 1
+        return out
+
+    def reset(self):
+        self.events.clear()
+        self._sigs.clear()
+        self.calls = 0
+        self._compiles = 0
+
+    # -- reporting ------------------------------------------------------
+    def findings(self) -> List[Finding]:
+        """Recompile events as Findings (HIGH once the compile count passes
+        ``max_compiles`` — a hot step re-tracing repeatedly)."""
+        out = []
+        for ev in self.events:
+            sev = (Severity.HIGH if ev.n_compiles > self.max_compiles
+                   else Severity.MEDIUM)
+            out.append(Finding(
+                rule="recompile-hazard", severity=sev,
+                message=(f"{self.name} recompiled on call #{ev.call_index} "
+                         f"(compile #{ev.n_compiles}): {ev.describe()}"),
+                entry_point=self.name,
+                details={"diffs": ev.diffs,
+                         "n_compiles": ev.n_compiles}))
+        return out
+
+    def report(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "calls": self.calls,
+            "compiles": self._compiles,
+            "recompiles": len(self.events),
+            "events": [dataclasses.asdict(e) for e in self.events],
+        }

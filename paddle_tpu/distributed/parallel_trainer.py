@@ -146,12 +146,22 @@ class ParallelTrainer:
                 spec = _fsdp_spec(tuple(p._data.shape), self.fsdp_axis,
                                   int(mesh.shape[self.fsdp_axis]), spec)
             self.param_specs[n] = spec
+        def _owned_put(arr, sharding):
+            # device_put ALIASES the source buffer when the placement
+            # already matches (a distinct wrapper over the same memory —
+            # e.g. any replicated array on a 1-device mesh).  With donation
+            # on, the jitted step would then delete the model Tensor's own
+            # buffer out from under eager reads; force an owned copy.
+            if donate:
+                arr = jnp.copy(arr)
+            return jax.device_put(arr, sharding)
+
         self.params = {
-            n: jax.device_put(p._data, NamedSharding(mesh, self.param_specs[n]))
+            n: _owned_put(p._data, NamedSharding(mesh, self.param_specs[n]))
             for n, p in self._param_tensors.items()
         }
         self.buffers = {
-            n: jax.device_put(b._data, NamedSharding(mesh, P()))
+            n: _owned_put(b._data, NamedSharding(mesh, P()))
             for n, b in self._buffer_tensors.items()
         }
 
@@ -425,7 +435,13 @@ class ParallelTrainer:
             # pin outputs to the input placements so donated buffers round-
             # trip bit-identically across steps
             out_shardings=(param_sh, opt_sh, buf_sh, repl, scale_sh, sent_sh),
-            donate_argnums=(0, 1) if self.donate else (),
+            # donate every carried-state arg, not just params/opt: buffers
+            # (BN running stats) and the scaler/sentinel carries also round-
+            # trip through the step, and an un-donated round-trip is a
+            # silent HBM copy per step (analysis donation-miss finding, r9;
+            # step() rebinds all five from the outputs, so the stale inputs
+            # are never read again)
+            donate_argnums=(0, 1, 2, 6, 7) if self.donate else (),
         )
 
     # ------------------------------------------------------------------
@@ -497,11 +513,17 @@ class ParallelTrainer:
         return Tensor(self._jit_eval(self.params, self.buffers, xb, yb, split_key()))
 
     def sync_to_model(self):
-        """Write the trained arrays back into the Layer's Tensors."""
+        """Write the trained arrays back into the Layer's Tensors.
+
+        With donation on, the model gets OWNED copies: handing it the live
+        ``self.params``/``self.buffers`` arrays would let the next
+        ``step()`` donate them away and leave the model's Tensors holding
+        deleted buffers (same aliasing discipline as ``capture_state``)."""
+        own = (lambda a: jnp.copy(a)) if self.donate else (lambda a: a)
         for n, arr in self.params.items():
-            self._param_tensors[n]._set_data(arr)
+            self._param_tensors[n]._set_data(own(arr))
         for n, arr in self.buffers.items():
-            self._buffer_tensors[n]._set_data(arr)
+            self._buffer_tensors[n]._set_data(own(arr))
         self.sync_scaler()
 
     def sync_scaler(self):

@@ -1077,6 +1077,89 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return [cond_def, body_def, *prelude, assign]
 
 
+# constructs whose converted form silently diverges from eager semantics
+_MUTATING_METHODS = frozenset({
+    "append", "extend", "insert", "pop", "remove", "clear", "update",
+    "setdefault", "add", "discard", "popitem", "set_value", "add_",
+    "copy_", "scatter_", "fill_", "zero_",
+})
+
+
+def _strictness_scan(fn, fdef):
+    """dy2static strictness (analysis rule ``dy2static-strictness``):
+    detect constructs the converted function cannot honor — writes to
+    module globals / nonlocal cells (the converted code executes against a
+    COPY of the enclosing scopes, so the write would be lost) and mutation
+    of closure-captured containers/Tensors (traced control flow invokes
+    branch/body closures several times — probe + trace — so in-place
+    effects on captured state double-apply).  Returns a reason string, or
+    None when the function is clean."""
+    code = getattr(fn, "__code__", None)  # jitted callables have no __code__
+    freevars = set(code.co_freevars) if code is not None else set()
+    # the double-apply hazard exists only INSIDE converted control flow
+    # (probe + trace each invoke the branch/body closures); straight-line
+    # closure mutation executes once per trace exactly as plain tracing
+    # would, so it must keep converting
+    in_cf = set()
+    for cf in ast.walk(fdef):
+        if isinstance(cf, (ast.If, ast.While, ast.For)):
+            for sub in ast.walk(cf):
+                in_cf.add(id(sub))
+    for node in ast.walk(fdef):
+        if isinstance(node, ast.Global):
+            return f"writes to global(s) {', '.join(node.names)}"
+        if isinstance(node, ast.Nonlocal):
+            # only writes that ESCAPE the converted function are hazardous;
+            # a nonlocal binding a cell internal to this function converts
+            # together with it and stays correct
+            escaping = [n for n in node.names if n in freevars]
+            if escaping:
+                return f"writes to nonlocal(s) {', '.join(escaping)}"
+            continue
+        if id(node) not in in_cf:
+            continue
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            base = node.func.value
+            if (isinstance(base, ast.Name) and base.id in freevars
+                    and node.func.attr in _MUTATING_METHODS):
+                return (f"mutates closure-captured '{base.id}' via "
+                        f".{node.func.attr}() (line {node.lineno})")
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                inner = t
+                while isinstance(inner, (ast.Subscript, ast.Attribute)):
+                    inner = inner.value
+                if (t is not inner and isinstance(inner, ast.Name)
+                        and inner.id in freevars):
+                    return (f"mutates closure-captured '{inner.id}' "
+                            f"(line {t.lineno})")
+    return None
+
+
+def _warn_unconvertible(fn, reason):
+    """Surface an unconvertible construct as a structured AnalysisWarning
+    (instead of the pre-r9 silent fallback to tracing)."""
+    from ..analysis.findings import Finding, Severity, warn_finding
+
+    code = getattr(fn, "__code__", None)
+    qn = getattr(fn, "__qualname__", getattr(fn, "__name__", repr(fn)))
+    warn_finding(Finding(
+        rule="dy2static-strictness", severity=Severity.MEDIUM,
+        message=(f"@to_static: {qn} {reason}; dy2static "
+                 "conversion is disabled for this function and it falls "
+                 "back to plain tracing (tensor-dependent control flow "
+                 "inside will raise jax's tracer error instead of lowering "
+                 "to lax.cond/while_loop)"),
+        entry_point=qn,
+        source=(f"{code.co_filename}:{code.co_firstlineno} ({qn})"
+                if code is not None else ""),
+        details={"reason": reason},
+    ), stacklevel=4)
+
+
 @functools.lru_cache(maxsize=256)
 def _convert_cached(fn):
     try:
@@ -1089,6 +1172,10 @@ def _convert_cached(fn):
         return None
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    hazard = _strictness_scan(fn, fdef)
+    if hazard is not None:
+        _warn_unconvertible(fn, hazard)
         return None
     fdef.decorator_list = []  # drop @to_static etc.
     # pre-passes (ordered): statement rewrites (append/print/assert) →
@@ -1115,8 +1202,10 @@ def _convert_cached(fn):
         pre_changed |= stmts.changed
         try:
             pre_changed |= _transform_returns(scope)
-        except _Unsupported:
+        except _Unsupported as e:
             if scope is fdef:
+                # structured fallback (pre-r9 this was silent)
+                _warn_unconvertible(fn, f"uses an unsupported construct: {e}")
                 return None  # keep the original function untouched
             continue  # leave just this nested fn unconverted
         bc = _BreakContinueTransformer()

@@ -202,13 +202,20 @@ class ContinuousBatchingEngine:
             return nxt, new_tok, new_pos, new_keys, ks.astype(kc.dtype), \
                 vs.astype(vc.dtype)
 
-        # donate the K/V caches: the engine replaces them with the returned
-        # buffers every call, so XLA can update in place instead of copying
-        # the full [L, n_slots, H, S, D] pair per token (CPU doesn't support
-        # donation and would warn per program)
-        donate = (9, 10) if jax.default_backend() != "cpu" else ()
-        self._prefill_jit = jax.jit(prefill_fn, donate_argnums=donate)
-        self._step_jit = jax.jit(step_fn, donate_argnums=donate)
+        # donate the K/V caches and the PRNG key chains: the engine replaces
+        # them with the returned buffers every call, so XLA can update in
+        # place instead of copying the full [L, n_slots, H, S, D] pair per
+        # token.  The intended donation is recorded unconditionally (the
+        # analysis donation-miss rule lints against it — the TPU deployment
+        # contract) but applied only off-CPU, where XLA honors aliasing
+        # (donating on CPU just warns per program).
+        self._donate_prefill = (5, 9, 10)   # key, kc, vc
+        self._donate_step = (8, 9, 10)      # keys, kc, vc
+        on_cpu = jax.default_backend() == "cpu"
+        self._prefill_jit = jax.jit(
+            prefill_fn, donate_argnums=() if on_cpu else self._donate_prefill)
+        self._step_jit = jax.jit(
+            step_fn, donate_argnums=() if on_cpu else self._donate_step)
 
     # -- public API ---------------------------------------------------------
     @property

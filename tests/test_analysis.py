@@ -1,0 +1,706 @@
+"""paddle_tpu.analysis — the jaxpr/HLO static-analysis layer (ISSUE 4).
+
+Per-rule contract: one minimal synthetic program that triggers exactly that
+rule, plus a clean program with zero findings.  Runtime half: TraceGuard
+recompile attribution.  Integration: the shipped entry points must lint
+HIGH-clean (the CI gate the satellite fixes established), and findings must
+carry r6 profiler scope names as source attribution.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis as an
+from paddle_tpu.analysis import (
+    AnalysisTarget,
+    AnalysisWarning,
+    CollectiveOrderRule,
+    ConstantBloatRule,
+    DonationRule,
+    DtypePromotionRule,
+    HostSyncRule,
+    ProgramRule,
+    RecompileHazardRule,
+    Severity,
+    ShardingPropagationRule,
+    TraceGuard,
+)
+
+
+def _sev(findings, severity):
+    return [f for f in findings if f.severity == severity]
+
+
+def _mesh2x2():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    return Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("x", "y"))
+
+
+# ---------------------------------------------------------------------------
+# dtype-promotion
+# ---------------------------------------------------------------------------
+class TestDtypePromotion:
+    def test_bf16_upcast_fed_dot_flagged(self):
+        def f(x, w):
+            h = jnp.dot(x, w)  # legitimate bf16 matmul
+            return jnp.dot(h.astype(jnp.float32),
+                           w.astype(jnp.float32)).sum()
+
+        t = AnalysisTarget("t", f, (jnp.ones((8, 8), jnp.bfloat16),
+                                    jnp.ones((8, 8), jnp.bfloat16)))
+        fs = an.run_rules(t, [DtypePromotionRule()])
+        assert _sev(fs, Severity.HIGH), fs
+        assert "upcast" in _sev(fs, Severity.HIGH)[0].message
+
+    def test_clean_bf16_program(self):
+        def f(x, w):
+            return jnp.dot(x, w).astype(jnp.float32).sum()  # f32 loss is fine
+
+        t = AnalysisTarget("t", f, (jnp.ones((8, 8), jnp.bfloat16),
+                                    jnp.ones((8, 8), jnp.bfloat16)))
+        assert an.run_rules(t, [DtypePromotionRule()]) == []
+
+    def test_incidental_half_dot_does_not_flood_f32_program(self):
+        """One bf16 matmul in a mostly-f32 program is not an amp program:
+        the 'predominantly half-precision' MEDIUM needs a majority."""
+        def f(x, w, hx, hw):
+            y = jnp.dot(x, w)
+            y = jnp.dot(y, w)
+            y = jnp.dot(y, w)
+            return y.sum() + jnp.dot(hx, hw).sum().astype(jnp.float32)
+
+        t = AnalysisTarget("t", f, (jnp.ones((8, 8), jnp.float32),
+                                    jnp.ones((8, 8), jnp.float32),
+                                    jnp.ones((8, 8), jnp.bfloat16),
+                                    jnp.ones((8, 8), jnp.bfloat16)))
+        assert an.run_rules(t, [DtypePromotionRule()]) == []
+
+    def test_scope_attribution_from_profiler(self):
+        """Findings carry the r6 profiler scope names (HLO metadata)."""
+        from paddle_tpu.profiler.scope import scope
+
+        def f(x, w):
+            with scope("model.head"):
+                return jnp.dot(x.astype(jnp.float32),
+                               w.astype(jnp.float32)).sum() \
+                    + jnp.dot(x, w).sum().astype(jnp.float32)
+
+        t = AnalysisTarget("t", f, (jnp.ones((8, 8), jnp.bfloat16),
+                                    jnp.ones((8, 8), jnp.bfloat16)))
+        highs = _sev(an.run_rules(t, [DtypePromotionRule()]), Severity.HIGH)
+        assert highs and "model.head" in highs[0].scope
+
+
+# ---------------------------------------------------------------------------
+# constant-bloat
+# ---------------------------------------------------------------------------
+class TestConstantBloat:
+    def test_closure_captured_weight_flagged(self):
+        W = jnp.zeros((256, 256), jnp.float32)  # 256 KiB baked in
+
+        t = AnalysisTarget("t", jax.jit(lambda x: x @ W),
+                           (jnp.ones((4, 256), jnp.float32),))
+        fs = an.run_rules(t, [ConstantBloatRule()])
+        assert _sev(fs, Severity.HIGH), fs
+        assert fs[0].details["bytes"] == 256 * 256 * 4
+
+    def test_weight_as_argument_clean(self):
+        t = AnalysisTarget("t", jax.jit(lambda x, w: x @ w),
+                           (jnp.ones((4, 256), jnp.float32),
+                            jnp.zeros((256, 256), jnp.float32)))
+        assert an.run_rules(t, [ConstantBloatRule()]) == []
+
+
+# ---------------------------------------------------------------------------
+# donation-miss
+# ---------------------------------------------------------------------------
+class TestDonation:
+    def test_carried_state_not_donated_flagged(self):
+        s = jnp.zeros((1024,), jnp.float32)  # 4 KiB carried state
+        f = jax.jit(lambda st, x: (st + x, x.sum()))
+        fs = an.run_rules(AnalysisTarget("t", f, (s, s)), [DonationRule()])
+        highs = _sev(fs, Severity.HIGH)
+        assert highs and "args[0]" in highs[0].details["arg"]
+
+    def test_donated_clean(self):
+        s = jnp.zeros((1024,), jnp.float32)
+        f = jax.jit(lambda st, x: (st + x, x.sum()), donate_argnums=(0,))
+        assert an.run_rules(AnalysisTarget("t", f, (s, s)),
+                            [DonationRule()]) == []
+
+    def test_donated_but_unmatched_flagged(self):
+        s = jnp.zeros((1024,), jnp.float32)
+        f = jax.jit(lambda st: st.sum(), donate_argnums=(0,))
+        fs = an.run_rules(AnalysisTarget("t", f, (s,)), [DonationRule()])
+        assert _sev(fs, Severity.MEDIUM), fs
+
+    def test_intended_donation_override(self):
+        """donate_argnums metadata lints the TPU deployment contract even
+        when the live jit gated donation off (serving on CPU)."""
+        s = jnp.zeros((1024,), jnp.float32)
+        f = jax.jit(lambda st, x: (st + x, x.sum()))  # no actual donation
+        t = AnalysisTarget("t", f, (s, s), donate_argnums=(0,))
+        assert an.run_rules(t, [DonationRule()]) == []
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------------
+class TestHostSync:
+    def test_pure_callback_flagged_high(self):
+        def f(x):
+            return jax.pure_callback(
+                lambda a: np.asarray(a) + 1,
+                jax.ShapeDtypeStruct((3,), jnp.float32), x)
+
+        fs = an.run_rules(AnalysisTarget("t", jax.jit(f), (jnp.ones(3),)),
+                          [HostSyncRule()])
+        assert _sev(fs, Severity.HIGH), fs
+
+    def test_debug_callback_flagged_medium(self):
+        def f(x):
+            jax.debug.print("x={x}", x=x.sum())
+            return x * 2
+
+        fs = an.run_rules(AnalysisTarget("t", jax.jit(f), (jnp.ones(3),)),
+                          [HostSyncRule()])
+        assert _sev(fs, Severity.MEDIUM), fs
+
+    def test_clean(self):
+        fs = an.run_rules(
+            AnalysisTarget("t", jax.jit(lambda x: x * 2), (jnp.ones(3),)),
+            [HostSyncRule()])
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard (static half)
+# ---------------------------------------------------------------------------
+class TestRecompileHazard:
+    def test_weak_typed_arg_flagged(self):
+        t = AnalysisTarget("t", jax.jit(lambda x, s: x * s),
+                           (jnp.ones(3), 2.0))
+        fs = an.run_rules(t, [RecompileHazardRule()])
+        assert _sev(fs, Severity.LOW) and "args[1]" in fs[0].details["arg"]
+
+    def test_explicit_arrays_clean(self):
+        t = AnalysisTarget("t", jax.jit(lambda x, s: x * s),
+                           (jnp.ones(3), jnp.asarray(2.0, jnp.float32)))
+        assert an.run_rules(t, [RecompileHazardRule()]) == []
+
+
+# ---------------------------------------------------------------------------
+# collective-order (static deadlock/divergence detector)
+# ---------------------------------------------------------------------------
+class TestCollectiveOrder:
+    def test_rank_varying_pred_gating_collective_flagged(self):
+        from paddle_tpu.distributed.spmd import shard_map
+
+        mesh = _mesh2x2()
+
+        def inner(a):
+            r = lax.axis_index("x")
+            return lax.cond(r == 0, lambda v: lax.psum(v, "x"),
+                            lambda v: v, a)
+
+        sm = shard_map(inner, mesh=mesh, in_specs=P("x"), out_specs=P("x"))
+        fs = an.run_rules(AnalysisTarget("t", sm, (jnp.ones(8),)),
+                          [CollectiveOrderRule()])
+        highs = _sev(fs, Severity.HIGH)
+        assert highs and highs[0].details["axes"] == ["x"]
+
+    def test_reduced_pred_proven_uniform(self):
+        """A psum'd predicate (the r7 sentinel pattern) is provably uniform
+        along the gated collective's axis — no finding."""
+        from paddle_tpu.distributed.spmd import shard_map
+
+        mesh = _mesh2x2()
+
+        def inner(a):
+            s = lax.psum(a.sum(), "x")
+            return lax.cond(s > 0, lambda v: lax.psum(v, "x"),
+                            lambda v: v, a)
+
+        sm = shard_map(inner, mesh=mesh, in_specs=P("x"), out_specs=P("x"))
+        assert an.run_rules(AnalysisTarget("t", sm, (jnp.ones(8),)),
+                            [CollectiveOrderRule()]) == []
+
+    def test_disjoint_axis_pred_safe(self):
+        """Pred varying over 'y' gating a psum over 'x': every 'x' peer
+        group shares the predicate — safe (the pipeline head pattern:
+        stage-index cond gating mp collectives)."""
+        from paddle_tpu.distributed.spmd import shard_map
+
+        mesh = _mesh2x2()
+
+        def inner(a):
+            r = lax.axis_index("y")
+            return lax.cond(r == 0, lambda v: lax.psum(v, "x"),
+                            lambda v: v, a)
+
+        sm = shard_map(inner, mesh=mesh, in_specs=P(), out_specs=P(None),
+                       )
+        assert an.run_rules(AnalysisTarget("t", sm, (jnp.ones(8),)),
+                            [CollectiveOrderRule()]) == []
+
+    def test_carry_written_divergence_found_by_fixpoint(self):
+        """The body writes axis_index into the carry slot the predicate
+        reads: only a taint FIXPOINT over the loop carry sees the
+        rank-divergent trip count (single-pass propagation misses it)."""
+        from paddle_tpu.distributed.spmd import shard_map
+
+        mesh = _mesh2x2()
+
+        def inner(a):
+            def body(c):
+                i, v = c
+                return (lax.axis_index("x").astype(jnp.int32),
+                        lax.psum(v, "x"))
+
+            return lax.while_loop(lambda c: c[0] < 1, body,
+                                  (jnp.int32(0), a))[1]
+
+        sm = shard_map(inner, mesh=mesh, in_specs=P(), out_specs=P(None))
+        fs = an.run_rules(AnalysisTarget("t", sm, (jnp.ones(8),)),
+                          [CollectiveOrderRule()])
+        assert _sev(fs, Severity.HIGH), fs
+
+    def test_shard_map_inside_while_body_taints_carry(self):
+        """The fixpoint pre-pass must apply shard_map in_names taints: a
+        while whose carry is fed by a shard_map over sharded data has a
+        rank-divergent trip count around the body's psum."""
+        from paddle_tpu.distributed.spmd import shard_map
+
+        mesh = _mesh2x2()
+        inner = shard_map(lambda v: (v + lax.axis_index("x"),
+                                     lax.psum(v, "x")),
+                          mesh=mesh, in_specs=P("x"),
+                          out_specs=(P("x"), P("x")))
+
+        def f(a):
+            def body(c):
+                i, v = inner(c[1])
+                return (c[0] + i.sum().astype(jnp.float32), v)
+
+            return lax.while_loop(lambda c: c[0] < 10.0, body,
+                                  (jnp.float32(0), a))[1]
+
+        fs = an.run_rules(AnalysisTarget("t", f, (jnp.ones(8),)),
+                          [CollectiveOrderRule()])
+        assert _sev(fs, Severity.HIGH), fs
+
+    def test_nonuniform_while_trip_count_flagged(self):
+        from paddle_tpu.distributed.spmd import shard_map
+
+        mesh = _mesh2x2()
+
+        def inner(a):
+            r = lax.axis_index("x")
+
+            def body(c):
+                return (c[0] + 1, lax.psum(c[1], "x"))
+
+            return lax.while_loop(lambda c: c[0] < r, body,
+                                  (jnp.int32(0), a))[1]
+
+        sm = shard_map(inner, mesh=mesh, in_specs=P("x"), out_specs=P("x"))
+        fs = an.run_rules(AnalysisTarget("t", sm, (jnp.ones(8),)),
+                          [CollectiveOrderRule()])
+        assert _sev(fs, Severity.HIGH), fs
+
+
+# ---------------------------------------------------------------------------
+# sharding-propagation (StableHLO surface)
+# ---------------------------------------------------------------------------
+class TestShardingPropagation:
+    def test_replicated_spmd_entry_flagged(self):
+        t = AnalysisTarget("t", jax.jit(lambda x: x * 2), (jnp.ones(8),),
+                           tags=("spmd",))
+        fs = an.run_rules(t, [ShardingPropagationRule()])
+        assert _sev(fs, Severity.MEDIUM), fs
+
+    def test_sharded_entry_clean(self):
+        from jax.sharding import NamedSharding
+
+        mesh = _mesh2x2()
+        sh = NamedSharding(mesh, P("x"))
+        f = jax.jit(lambda x: x * 2, in_shardings=(sh,), out_shardings=sh)
+        t = AnalysisTarget("t", f, (jax.device_put(jnp.ones(8), sh),),
+                           tags=("spmd",))
+        assert an.run_rules(t, [ShardingPropagationRule()]) == []
+
+    def test_untagged_target_skipped(self):
+        t = AnalysisTarget("t", jax.jit(lambda x: x * 2), (jnp.ones(8),))
+        assert an.run_rules(t, [ShardingPropagationRule()]) == []
+
+
+# ---------------------------------------------------------------------------
+# program-check (static.Program op-record IR)
+# ---------------------------------------------------------------------------
+class TestProgramRule:
+    def _clean(self):
+        paddle.disable_static()
+
+    def test_dead_feed_flagged(self):
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [None, 4], "float32")
+                static.data("unused", [None, 2], "float32")
+                y = x * 2.0 + 1.0
+            t = an.target_from_program(main, name="p")
+            fs = an.run_rules(t, [ProgramRule()])
+            lows = _sev(fs, Severity.LOW)
+            assert lows and lows[0].details["feed"] == "unused"
+        finally:
+            self._clean()
+
+    def test_frozen_trainable_capture_flagged(self):
+        from paddle_tpu import static
+        from paddle_tpu.nn import Linear
+        from paddle_tpu.optimizer.optimizers import SGD
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [None, 4], "float32")
+                a = Linear(4, 4)
+                b = Linear(4, 1)
+                loss = b(a(x)).mean()
+                # only b's params handed to the optimizer: a is frozen by
+                # accident
+                SGD(learning_rate=0.1,
+                    parameters=b.parameters()).minimize(loss)
+            t = an.target_from_program(main, name="p")
+            fs = an.run_rules(t, [ProgramRule()])
+            assert _sev(fs, Severity.MEDIUM), fs
+        finally:
+            self._clean()
+
+    def test_clean_training_program_and_jaxpr_rules_apply(self):
+        from paddle_tpu import static
+        from paddle_tpu.nn import Linear
+        from paddle_tpu.optimizer.optimizers import SGD
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [None, 4], "float32")
+                tgt = static.data("t", [None, 1], "float32")
+                lin = Linear(4, 1)
+                loss = ((lin(x) - tgt) ** 2).mean()
+                SGD(learning_rate=0.1,
+                    parameters=lin.parameters()).minimize(loss)
+            t = an.target_from_program(main, name="p")
+            assert an.run_rules(t, [ProgramRule()]) == []
+            # the op-record IR flows through the full jaxpr rule set too
+            assert t.graph().nodes
+            assert an.run_rules(t, [HostSyncRule(),
+                                    DtypePromotionRule()]) == []
+        finally:
+            self._clean()
+
+
+# ---------------------------------------------------------------------------
+# TraceGuard (runtime recompile attribution)
+# ---------------------------------------------------------------------------
+class TestTraceGuard:
+    def test_no_events_on_stable_signature(self):
+        g = TraceGuard(jax.jit(lambda x: x * 2))
+        for _ in range(3):
+            g(jnp.ones(3))
+        assert g.events == [] and g.calls == 3
+
+    def test_recompile_attributed_to_component(self):
+        g = TraceGuard(jax.jit(lambda d: d["a"] * d["b"]), name="step")
+        g({"a": jnp.ones(3), "b": jnp.ones(3)})
+        g({"a": jnp.ones(3), "b": jnp.ones(3)})        # cache hit
+        g({"a": jnp.ones(4), "b": jnp.ones(4)})        # miss: shape
+        assert len(g.events) == 1
+        comps = {d["component"] for d in g.events[0].diffs}
+        assert comps == {"args[0]['a']", "args[0]['b']"}
+        fs = g.findings()
+        assert fs and fs[0].rule == "recompile-hazard"
+        assert fs[0].severity == Severity.MEDIUM
+
+    def test_repeated_recompiles_escalate_high(self):
+        g = TraceGuard(jax.jit(lambda x: x * 2), max_compiles=2)
+        for n in (3, 4, 5, 6):
+            g(jnp.ones(n))
+        assert any(f.severity == Severity.HIGH for f in g.findings())
+
+    def test_weak_type_flip_attributed(self):
+        g = TraceGuard(jax.jit(lambda x, s: x * s), name="step")
+        g(jnp.ones(3), 2.0)
+        g(jnp.ones(3), jnp.asarray(2.0, jnp.float32))  # weak -> strong
+        assert len(g.events) == 1
+        assert any("args[1]" in d["component"] for d in g.events[0].diffs)
+
+
+# ---------------------------------------------------------------------------
+# dy2static strictness (satellite: AnalysisWarning instead of silent fallback)
+# ---------------------------------------------------------------------------
+class TestDy2StaticStrictness:
+    def test_global_write_warns_and_falls_back(self):
+        from paddle_tpu.jit.dy2static import convert_function
+
+        def f(x):
+            global _some_counter
+            _some_counter = 1
+            if x.sum() > 0:
+                return x + 1.0
+            return x
+
+        with pytest.warns(AnalysisWarning) as rec:
+            g = convert_function(f)
+        assert g is f  # fell back to tracing
+        w = rec[0].message
+        assert w.finding.rule == "dy2static-strictness"
+        assert "_some_counter" in str(w)
+
+    def test_closure_mutation_in_branch_warns_and_falls_back(self):
+        """Mutation INSIDE converted control flow double-applies (probe +
+        trace) — refused with a warning."""
+        from paddle_tpu.jit.dy2static import convert_function
+
+        seen = []
+
+        def f(x):
+            if x.sum() > 0:
+                seen.append(x)
+                return x + 1.0
+            return x
+
+        with pytest.warns(AnalysisWarning) as rec:
+            g = convert_function(f)
+        assert g is f
+        assert "seen" in str(rec[0].message)
+
+    def test_straight_line_closure_mutation_still_converts(self):
+        """Top-level closure mutation executes once per trace exactly as
+        plain tracing would — conversion must not be refused for it."""
+        import warnings as _w
+
+        from paddle_tpu.jit.dy2static import convert_function
+
+        d = {}
+
+        def f(x):
+            d["calls"] = 1
+            if x.sum() > 0:
+                return x + 1.0
+            return x - 1.0
+
+        with _w.catch_warnings():
+            _w.simplefilter("error", AnalysisWarning)
+            g = convert_function(f)
+        assert g is not f
+        out = g(paddle.to_tensor(np.asarray([2.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(out._data), [3.0])
+        assert d == {"calls": 1}
+
+    def test_nonlocal_write_warns(self):
+        from paddle_tpu.jit.dy2static import convert_function
+
+        def outer():
+            state = 0
+
+            def f(x):
+                nonlocal state
+                state = 1
+                if x.sum() > 0:
+                    return x + 1.0
+                return x
+
+            return f
+
+        with pytest.warns(AnalysisWarning):
+            g = convert_function(outer())
+        assert g.__name__ == "f"
+
+    def test_internal_nonlocal_still_converts(self):
+        """A nonlocal binding a cell INTERNAL to the decorated function is
+        safe (the whole function converts together) — no warning, and the
+        tensor-dependent control flow still lowers."""
+        import warnings as _w
+
+        from paddle_tpu.jit.dy2static import convert_function
+
+        def f(x):
+            acc = x * 0.0
+
+            def add(v):
+                nonlocal acc
+                acc = acc + v
+
+            add(x)
+            if x.sum() > 0:
+                return acc + 1.0
+            return acc - 1.0
+
+        with _w.catch_warnings():
+            _w.simplefilter("error", AnalysisWarning)
+            g = convert_function(f)
+        assert g is not f
+        out = g(paddle.to_tensor(np.asarray([2.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(out._data), [3.0])
+
+    def test_clean_function_converts_without_warning(self):
+        import warnings as _w
+
+        from paddle_tpu.jit.dy2static import convert_function
+
+        def f(x):
+            if x.sum() > 0:
+                return x + 1.0
+            return x - 1.0
+
+        with _w.catch_warnings():
+            _w.simplefilter("error", AnalysisWarning)
+            g = convert_function(f)
+        assert g is not f  # converted
+        out = g(paddle.to_tensor(np.asarray([2.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(out._data), [3.0])
+
+
+# ---------------------------------------------------------------------------
+# satellite donation fixes: regressions
+# ---------------------------------------------------------------------------
+class TestTrainerDonationSafety:
+    def test_model_buffers_survive_donated_step(self):
+        """Donating the buffer carry must not delete the model Layer's own
+        arrays: device_put can alias on a 1-device mesh, and the jitted
+        step would consume the Tensor's _data (regression for the r9
+        donation fix)."""
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.optimizer as popt
+        from paddle_tpu.nn import BatchNorm1D, Linear, ReLU, Sequential
+
+        prev = dist.get_mesh()
+        dist.init_mesh({"dp": 1})
+        try:
+            paddle.seed(0)
+            model = Sequential(Linear(8, 16), BatchNorm1D(16), ReLU(),
+                               Linear(16, 1))
+            tr = dist.ParallelTrainer(
+                model, lambda o, y: ((o - y) ** 2).mean(), popt.SGD(0.01),
+                dp_axis=None)
+            X = np.zeros((4, 8), np.float32)
+            Y = np.zeros((4, 1), np.float32)
+            tr.step(paddle.to_tensor(X), paddle.to_tensor(Y))
+            # the model's own tensors must still be readable (no deleted
+            # buffers), and an eager forward must work
+            for _, b in model.named_buffers():
+                np.asarray(b._data)
+            for _, p in model.named_parameters():
+                np.asarray(p._data)
+            model.eval()
+            model(paddle.to_tensor(X))
+        finally:
+            dist.set_mesh(prev)
+
+
+# ---------------------------------------------------------------------------
+# shipped entry points: the CI gate (tier-1 smoke)
+# ---------------------------------------------------------------------------
+class TestShippedEntryPoints:
+    def test_zero_high_findings_across_entry_points(self):
+        """ISSUE 4 acceptance: >= 5 shipped entry points lint HIGH-clean
+        after the satellite fixes (trainer/serving donation, CE head)."""
+        from paddle_tpu.analysis.entrypoints import shipped_entry_points
+        from paddle_tpu.analysis.rules import analyze_targets
+
+        targets, errors = shipped_entry_points()
+        assert errors == {}
+        assert len(targets) >= 5
+        names = {t.name for t in targets}
+        assert {"trainer_step", "pipeline_step", "serving_prefill",
+                "serving_decode", "exported_infer",
+                "static_program"} <= names
+        report = analyze_targets(targets)
+        highs = report.high()
+        assert highs == [], "\n".join(str(f) for f in highs)
+        crashed = [f for f in report.findings if "rule crashed" in f.message]
+        assert crashed == [], "\n".join(str(f) for f in crashed)
+
+    def test_report_shape_and_json(self, tmp_path):
+        from paddle_tpu.analysis.entrypoints import static_program_target
+        from paddle_tpu.analysis.rules import analyze_targets
+
+        report = analyze_targets([static_program_target()])
+        d = report.to_dict()
+        assert set(d) == {"meta", "counts", "findings"}
+        assert "static_program" in d["meta"]["timings_s"]
+        p = tmp_path / "report.json"
+        report.save(str(p))
+        import json
+
+        assert json.loads(p.read_text())["counts"]["HIGH"] == 0
+
+    def test_bf16_pipeline_ce_head_dtype_clean(self):
+        """Satellite check: the r6 fused-f32-statistics CE head leaves no
+        residual f32 matmul in the bf16 pipeline step."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.meta_parallel.pipeline_schedule import (
+            build_gpt_pipeline_step,
+        )
+        from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+        from paddle_tpu.optimizer.optimizers import AdamW
+        from paddle_tpu.random import split_key
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        prev = dist.get_mesh()
+        dist.init_mesh({"pp": 2})
+        try:
+            paddle.seed(0)
+            cfg = gpt_config(
+                "gpt2-small", vocab_size=64, hidden_size=32, num_layers=2,
+                num_attention_heads=4, max_position_embeddings=32,
+                hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+            model = GPTForPretraining(cfg)
+            step = build_gpt_pipeline_step(
+                model, AdamW(1e-3, parameters=model.parameters()),
+                microbatches=2, compute_dtype=jnp.bfloat16)
+            x = jnp.zeros((4, 16), jnp.int32)
+            args = (step.state["params"], step.state["opt"], x, x,
+                    jax.random.key_data(split_key()),
+                    jnp.asarray(1e-3, jnp.float32), step.state["sentinel"])
+            t = AnalysisTarget("pipeline_bf16", step.jitted, args)
+            fs = an.run_rules(t, [DtypePromotionRule()])
+            assert _sev(fs, Severity.HIGH) == [], fs
+        finally:
+            dist.set_mesh(prev)
+
+    def test_cli_end_to_end(self, tmp_path):
+        from paddle_tpu.analysis.cli import main
+
+        out = tmp_path / "r.json"
+        rc = main(["--only", "static_program", "--out", str(out)])
+        assert rc == 0
+        import json
+
+        data = json.loads(out.read_text())
+        assert data["meta"]["entry_points"] == ["static_program"]
+
+    def test_unknown_only_is_an_error_not_an_empty_lint(self, tmp_path):
+        from paddle_tpu.analysis.cli import main
+        from paddle_tpu.analysis.entrypoints import shipped_entry_points
+
+        with pytest.raises(ValueError, match="unknown entry-point"):
+            shipped_entry_points(only=("trainer",))  # typo of trainer_step
+        with pytest.raises(SystemExit) as e:  # argparse usage error
+            main(["--only", "trainer", "--out", str(tmp_path / "r.json")])
+        assert e.value.code == 2
