@@ -20,7 +20,10 @@ Quick use::
     guard.findings()
 
 ``python -m paddle_tpu.analysis`` lints every shipped entry point and
-writes ``benchmarks/analysis_report.json``.
+writes ``benchmarks/analysis_report.json``; ``--memory`` adds the
+liveness-based peak-HBM report (``analysis_memory.json``) and
+``--sanitize`` replays each entry point eqn-by-eqn hunting the first
+non-finite intermediate (``FLAGS_check_nan_inf`` parity with *where*).
 """
 from .findings import (
     AnalysisReport,
@@ -50,9 +53,49 @@ from .rules import (
     register_rule,
     run_rules,
 )
+from .cost import (
+    EqnCost,
+    GraphCost,
+    classify_intensity,
+    cost_eqn,
+    graph_cost,
+)
+from .memory import (
+    LowIntensityDotRule,
+    MemoryBudgetRule,
+    MemoryEstimate,
+    RematAdvisorRule,
+    estimate_memory,
+    memory_estimate,
+    planner_drift_findings,
+)
+from .sanitizer import (
+    NonFiniteReport,
+    SanitizeResult,
+    SanitizerConfig,
+    sanitize,
+    sanitize_target,
+)
 from .traceguard import RecompileEvent, TraceGuard
 
 __all__ = [
+    "EqnCost",
+    "GraphCost",
+    "classify_intensity",
+    "cost_eqn",
+    "graph_cost",
+    "MemoryEstimate",
+    "MemoryBudgetRule",
+    "LowIntensityDotRule",
+    "RematAdvisorRule",
+    "estimate_memory",
+    "memory_estimate",
+    "planner_drift_findings",
+    "NonFiniteReport",
+    "SanitizeResult",
+    "SanitizerConfig",
+    "sanitize",
+    "sanitize_target",
     "AnalysisReport",
     "AnalysisWarning",
     "Finding",

@@ -1,10 +1,22 @@
 """``python -m paddle_tpu.analysis`` — lint the shipped entry points.
 
 Builds every shipped program family (trainer step, pipeline 1F1B step,
-serving prefill/decode, exported inference, static Program), runs the full
-rule registry, prints a findings table, and writes the JSON report to
-``benchmarks/analysis_report.json`` (the artifact the zero-HIGH CI smoke
-test and ``bench.py _analysis_overhead`` read).
+serving prefill/decode, exported inference, static Program) and runs one of
+three modes:
+
+* default          — the full hazard-rule registry (now including the
+  quantitative ``oom-risk`` / ``low-intensity-dot`` / ``remat-advisor``
+  rules) → ``benchmarks/analysis_report.json``;
+* ``--memory``     — the liveness-based peak-HBM/cost report per entry
+  point (+ the planner-drift cross-check) →
+  ``benchmarks/analysis_memory.json``;
+* ``--sanitize``   — eqn-by-eqn non-finite replay of every entry point
+  with its example args → ``benchmarks/analysis_sanitize.json``.
+
+``--device-budget <bytes>`` re-parameterizes the memory rules so an
+``oom-risk`` HIGH against YOUR chip gates exit-1.  Unknown primitives hit
+by the cost model are reported per entry point (never silently
+zero-costed).  All artifacts carry a schema_version.
 
 Exit status: 0 when no finding reaches ``--fail-on`` (default HIGH), 1
 otherwise, 2 when an entry point could not even be built.
@@ -17,13 +29,30 @@ import sys
 import time
 
 
+def _default_out(name: str) -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    bench_dir = os.path.join(root, "benchmarks")
+    return (os.path.join(bench_dir, name)
+            if os.path.isdir(bench_dir) else name)
+
+
+def _save_json(path: str, payload: dict):
+    import json
+
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=2)
+        fh.write("\n")
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="Static TPU-hazard linter over shipped entry points")
     parser.add_argument("--out", default=None,
-                        help="JSON report path (default "
-                             "benchmarks/analysis_report.json)")
+                        help="JSON report path (default benchmarks/"
+                             "analysis_report.json, or analysis_memory/"
+                             "analysis_sanitize.json per mode)")
     from .entrypoints import builder_names
 
     parser.add_argument("--only", action="append", default=[],
@@ -37,7 +66,27 @@ def main(argv=None) -> int:
     parser.add_argument("--keep-going", action="store_true",
                         help="lint the buildable entry points even when "
                              "some builders fail")
+    mode = parser.add_mutually_exclusive_group()
+    mode.add_argument("--memory", action="store_true",
+                      help="liveness-based peak-HBM + cost report per "
+                           "entry point (writes analysis_memory.json)")
+    mode.add_argument("--sanitize", action="store_true",
+                      help="replay each entry point eqn-by-eqn and "
+                           "report the first non-finite intermediate "
+                           "(writes analysis_sanitize.json)")
+    parser.add_argument("--device-budget", type=float, default=None,
+                        metavar="BYTES",
+                        help="HBM budget for oom-risk/remat-advisor "
+                             "(default one v5e chip, 16 GiB); an oom-risk "
+                             "HIGH against it gates exit-1")
+    parser.add_argument("--nan-only", action="store_true",
+                        help="--sanitize: flag NaN only (programs that "
+                             "mask with infinities)")
     args = parser.parse_args(argv)
+    if args.nan_only and not args.sanitize:
+        parser.error("--nan-only only applies to --sanitize")
+    if args.device_budget is not None and args.sanitize:
+        parser.error("--device-budget applies to the lint/--memory modes")
     # NOTE: platform/device-count env setup lives in __main__.py (re-exec
     # before jax initializes); mutating os.environ here would be both too
     # late for this process and a leak into child processes.
@@ -46,29 +95,37 @@ def main(argv=None) -> int:
 
     from .entrypoints import shipped_entry_points
     from .findings import Severity
-    from .rules import analyze_targets
 
     t0 = time.perf_counter()
     # always collect builder failures so they reach the report (and exit 2)
     # instead of escaping as a raw traceback
     targets, errors = shipped_entry_points(
         skip_errors=True, only=tuple(args.only))
-    report = analyze_targets(
-        targets,
-        meta={"tool": "paddle_tpu.analysis", "backend": jax.default_backend(),
-              "n_devices": len(jax.devices()), "build_errors": errors})
+    meta = {"tool": "paddle_tpu.analysis",
+            "backend": jax.default_backend(),
+            "n_devices": len(jax.devices()), "build_errors": errors}
+
+    overrides = {}
+    if args.device_budget is not None:
+        budget = int(args.device_budget)
+        overrides = {"oom-risk": {"budget_bytes": budget},
+                     "remat-advisor": {"budget_bytes": budget}}
+
+    if args.memory:
+        report, out, extra = _memory_mode(targets, meta, overrides, args)
+    elif args.sanitize:
+        report, out, extra = _sanitize_mode(targets, meta, args)
+    else:
+        report, out, extra = _lint_mode(targets, meta, overrides, args)
+
+    # total_s must land BEFORE the artifact is written (round tracking
+    # reads wall time from the JSON, not the console)
     report.meta["total_s"] = round(time.perf_counter() - t0, 3)
-
-    out = args.out
-    if out is None:
-        root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        bench_dir = os.path.join(root, "benchmarks")
-        out = (os.path.join(bench_dir, "analysis_report.json")
-               if os.path.isdir(bench_dir) else "analysis_report.json")
-    report.save(out)
-
-    print(f"linted {len(targets)} entry points in "
+    if extra is None:
+        report.save(out)
+    else:
+        _save_json(out, dict(report.to_dict(), **extra))
+    print(f"analyzed {len(targets)} entry points in "
           f"{report.meta['total_s']}s -> {out}")
     for name, err in errors.items():
         print(f"  BUILD FAILED {name}: {err}")
@@ -85,6 +142,115 @@ def main(argv=None) -> int:
         if report.at_least(gate):
             return 1
     return 0
+
+
+def _lint_mode(targets, meta, overrides, args):
+    from .rules import analyze_targets, default_rules
+
+    rules = default_rules(**overrides) if overrides else None
+    report = analyze_targets(targets, rules=rules, meta=meta)
+    return report, args.out or _default_out("analysis_report.json"), None
+
+
+def _memory_mode(targets, meta, overrides, args):
+    """Per-entry-point peak-HBM/cost JSON + memory rules + planner drift."""
+    from .cost import graph_cost
+    from .findings import Finding, Severity
+    from .memory import (
+        MEMORY_SCHEMA_VERSION,
+        LowIntensityDotRule,
+        MemoryBudgetRule,
+        RematAdvisorRule,
+        memory_estimate,
+        planner_drift_findings,
+    )
+    from .rules import analyze_targets
+
+    rules = [MemoryBudgetRule(**overrides.get("oom-risk", {})),
+             LowIntensityDotRule(),
+             RematAdvisorRule(**overrides.get("remat-advisor", {}))]
+    report = analyze_targets(targets, rules=rules, meta=meta)
+    entries = {}
+    for t in targets:
+        try:
+            est = memory_estimate(t)
+            cost = graph_cost(t.graph(), t.mesh_axes)
+            entries[t.name] = dict(est.to_dict(), cost=cost.to_dict())
+            if cost.unknown:
+                report.extend([Finding(
+                    rule="cost-model", severity=Severity.INFO,
+                    entry_point=t.name,
+                    message=("unknown primitive(s) fell back to bytes-only "
+                             f"cost: {sorted(cost.unknown)} — extend "
+                             "analysis/cost.py if they matter"),
+                    details={"unknown_prims": dict(cost.unknown)})])
+        except Exception as e:  # mirrors run_rules' crashed-rule policy
+            entries[t.name] = {"error": f"{type(e).__name__}: {e}"}
+            report.extend([Finding(
+                rule="memory-report", severity=Severity.MEDIUM,
+                entry_point=t.name,
+                message=f"memory estimate crashed: "
+                        f"{type(e).__name__}: {e}")])
+    # the cross-check builds its own GPT trainer — only worth it on a full
+    # sweep, not when --only narrowed the run (or every builder failed)
+    if targets and not args.only:
+        try:
+            report.extend(planner_drift_findings())
+        except Exception as e:
+            report.extend([Finding(
+                rule="planner-drift", severity=Severity.MEDIUM,
+                message=f"planner cross-check crashed: "
+                        f"{type(e).__name__}: {e}")])
+    out = args.out or _default_out("analysis_memory.json")
+    for name, e in entries.items():
+        peak = e.get("peak_hbm_bytes")
+        if peak is not None:
+            print(f"  {name}: peak {peak / 1e6:.2f} MB, resident "
+                  f"{e['resident_bytes'] / 1e6:.2f} MB @ "
+                  f"{e['peak_site']['prim']}")
+    return report, out, {"schema_version": MEMORY_SCHEMA_VERSION,
+                         "entry_points": entries}
+
+
+def _sanitize_mode(targets, meta, args):
+    from .findings import AnalysisReport, Finding, Severity
+    from .sanitizer import SanitizerConfig, sanitize_target
+
+    report = AnalysisReport(meta=dict(
+        meta, mode="sanitize", nan_only=bool(args.nan_only)))
+    cfg = SanitizerConfig(check_inf=not args.nan_only)
+    entries = {}
+    timings = {}
+    for t in targets:
+        t0 = time.perf_counter()
+        try:
+            res = sanitize_target(t, cfg)
+            entries[t.name] = res.to_dict()
+            if res.first is not None:
+                f = Finding(
+                    rule="sanitizer-nonfinite", severity=Severity.HIGH,
+                    entry_point=t.name, message=str(res.first),
+                    details=res.first.to_dict())
+                f.scope = res.first.scope
+                f.source = res.first.source
+                report.extend([f])
+        except Exception as e:
+            entries[t.name] = {"error": f"{type(e).__name__}: {e}"}
+            report.extend([Finding(
+                rule="sanitizer-replay", severity=Severity.MEDIUM,
+                entry_point=t.name,
+                message=f"sanitizer replay crashed: "
+                        f"{type(e).__name__}: {e}")])
+        timings[t.name] = round(time.perf_counter() - t0, 4)
+    report.meta["timings_s"] = timings
+    report.meta["entry_points"] = [t.name for t in targets]
+    out = args.out or _default_out("analysis_sanitize.json")
+    for name, e in entries.items():
+        status = ("ERROR" if "error" in e
+                  else "clean" if e.get("ok") else "NON-FINITE")
+        print(f"  {name}: {status} ({e.get('checked_values', 0)} values "
+              f"checked)")
+    return report, out, {"entry_points": entries}
 
 
 if __name__ == "__main__":  # pragma: no cover

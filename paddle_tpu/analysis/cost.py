@@ -1,0 +1,324 @@
+"""Static per-eqn cost model: FLOPs, bytes accessed, arithmetic intensity.
+
+The Roofline question (Williams et al.): for every eqn the graph walker
+records, how much compute does it do and how many HBM bytes does it touch?
+The ratio (flops / bytes) against the device ridge point classifies the eqn
+compute-bound or memory-bound — the static form of "this dot will not feed
+the MXU".  The liveness analyzer (:mod:`.memory`) reuses the same per-eqn
+costs to price rematerialization candidates.
+
+Conventions (pinned so tests can hand-compute them — estimates, not
+simulator truth):
+
+* ``dot_general``      — ``2 * out_elems * K`` (K = contracted extent).
+* ``conv``             — ``2 * out_elems * rhs_elems / out_channels``.
+* elementwise          — 1 flop/element; transcendentals (exp, log, tanh,
+  rsqrt, pow, erf, ...) cost :data:`TRANSCENDENTAL_FLOPS` each.
+* reductions           — 1 flop per *input* element; windowed reductions
+  ``out_elems * window``.
+* data movement        — 0 flops (bytes only): reshape/transpose/slice/
+  gather/convert/iota/select_n/...
+* collectives          — ``comm_bytes`` over the wire from the per-axis
+  mesh sizes (ring allreduce ``2(n-1)/n``, all_gather ``(n-1)/n``, ...);
+  the axis extents come from :class:`AnalysisTarget.mesh_axes`.
+* control-flow containers (pjit/scan/while/cond/shard_map/custom_*) cost
+  nothing themselves — their inner eqns are separate walker nodes;
+  :func:`graph_cost` multiplies scan bodies by trip count.
+
+Unknown primitives are NEVER silently zero-costed: they fall back to
+bytes-only with ``known=False`` and are tallied in ``GraphCost.unknown``
+(the CLI and the memory report surface the list).
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .graph import COLLECTIVE_PRIMS, _axes_of
+
+__all__ = [
+    "EqnCost",
+    "GraphCost",
+    "cost_eqn",
+    "graph_cost",
+    "classify_intensity",
+    "TRANSCENDENTAL_FLOPS",
+    "DEFAULT_RIDGE_FLOPS_PER_BYTE",
+    "CONTAINER_PRIMS",
+]
+
+#: nominal flop cost of one transcendental evaluation (polynomial approx)
+TRANSCENDENTAL_FLOPS = 8
+
+#: v5e ridge point: 197 TFLOP/s bf16 over ~819 GB/s HBM ≈ 240 flops/byte
+DEFAULT_RIDGE_FLOPS_PER_BYTE = 240.0
+
+# control-flow / call containers: the walker records their inner eqns as
+# separate nodes, so the container itself contributes no flops or bytes
+CONTAINER_PRIMS = frozenset({
+    "pjit", "scan", "while", "cond", "shard_map", "remat", "remat2",
+    "checkpoint", "closed_call", "core_call", "named_call", "custom_lin",
+    "custom_vjp_call", "custom_jvp_call", "custom_vjp_call_jaxpr",
+    "custom_jvp_call_jaxpr",
+})
+
+_MOVEMENT = frozenset({
+    "broadcast_in_dim", "reshape", "transpose", "convert_element_type",
+    "slice", "dynamic_slice", "dynamic_update_slice", "concatenate", "pad",
+    "rev", "squeeze", "expand_dims", "copy", "gather", "iota", "select_n",
+    "stop_gradient", "bitcast_convert_type", "device_put", "real", "imag",
+    "scatter", "random_seed", "random_wrap", "random_unwrap",
+    "random_fold_in", "random_bits", "random_split", "split",
+    "sharding_constraint",
+})
+
+_ELEMENTWISE_1 = frozenset({
+    "add", "add_any", "sub", "mul", "div", "max", "min", "neg", "abs",
+    "sign",
+    "floor", "ceil", "round", "rem", "nextafter", "clamp", "square",
+    "integer_pow", "and", "or", "xor", "not", "shift_left",
+    "shift_right_logical", "shift_right_arithmetic", "eq", "ne", "lt",
+    "gt", "le", "ge", "is_finite", "reduce_precision", "complex", "conj",
+})
+
+_TRANSCENDENTAL = frozenset({
+    "exp", "exp2", "expm1", "log", "log1p", "tanh", "sin", "cos", "tan",
+    "asin", "acos", "atan", "atan2", "sinh", "cosh", "asinh", "acosh",
+    "atanh", "erf", "erfc", "erf_inv", "logistic", "sqrt", "rsqrt",
+    "cbrt", "pow", "lgamma", "digamma", "igamma", "igammac",
+    "bessel_i0e", "bessel_i1e", "threefry2x32",
+})
+
+_REDUCTION = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "reduce_xor", "argmax", "argmin", "cumsum", "cumprod",
+    "cummax", "cummin",
+})
+
+_SCATTER_COMBINE = frozenset({
+    "scatter-add", "scatter_add", "scatter-mul", "scatter_mul",
+    "scatter-min", "scatter_min", "scatter-max", "scatter_max",
+})
+
+
+@dataclasses.dataclass
+class EqnCost:
+    """Estimated cost of one eqn (per execution, per device)."""
+
+    flops: float = 0.0
+    bytes_in: int = 0
+    bytes_out: int = 0
+    comm_bytes: float = 0.0        # inter-chip payload (collectives)
+    container: bool = False        # inner eqns carry the cost
+    known: bool = True             # False = fallback estimate
+    estimated: bool = False        # some input (axis size) was guessed
+
+    @property
+    def bytes_accessed(self) -> int:
+        return self.bytes_in + self.bytes_out
+
+    @property
+    def intensity(self) -> float:
+        b = self.bytes_accessed
+        return self.flops / b if b else 0.0
+
+
+def classify_intensity(intensity: float,
+                       ridge: float = DEFAULT_RIDGE_FLOPS_PER_BYTE) -> str:
+    return "compute-bound" if intensity >= ridge else "memory-bound"
+
+
+def _elems(aval_info) -> int:
+    shape = aval_info[0]
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def _nbytes(aval_info) -> int:
+    dtype = aval_info[1]
+    if dtype is None:
+        return 0
+    try:
+        item = np.dtype(dtype).itemsize
+    except TypeError:  # extended dtypes (typed PRNG keys)
+        item = 16
+    return _elems(aval_info) * item
+
+
+def _group_size(params, mesh_axes) -> Tuple[int, bool]:
+    """(#ranks in the collective's group, any-axis-size-guessed)."""
+    n, estimated = 1, False
+    for a in _axes_of(params):
+        if mesh_axes and a in mesh_axes:
+            n *= int(mesh_axes[a])
+        else:
+            estimated = True
+    return n, estimated
+
+
+def cost_eqn(prim: str, in_avals, out_avals, params: dict,
+             mesh_axes: Optional[Dict[str, int]] = None) -> EqnCost:
+    """Cost one eqn given the walker's ``(shape, dtype, weak)`` aval infos
+    and its (light) params.  Unknown primitives return ``known=False`` with
+    bytes-only cost — never a silent zero."""
+    bytes_in = sum(_nbytes(a) for a in in_avals)
+    bytes_out = sum(_nbytes(a) for a in out_avals)
+    out_elems = sum(_elems(a) for a in out_avals)
+    in_elems = sum(_elems(a) for a in in_avals)
+
+    if prim in CONTAINER_PRIMS:
+        return EqnCost(container=True)
+
+    if prim == "dot_general":
+        (lhs_c, _), (lhs_b, _) = params["dimension_numbers"]
+        lhs_shape = in_avals[0][0]
+        k = 1
+        for d in lhs_c:
+            k *= int(lhs_shape[d])
+        return EqnCost(flops=2.0 * out_elems * k,
+                       bytes_in=bytes_in, bytes_out=bytes_out)
+
+    if prim == "conv_general_dilated":
+        dn = params.get("dimension_numbers")
+        rhs_shape = in_avals[1][0]
+        rhs_elems = _elems(in_avals[1])
+        out_ch = 1
+        if dn is not None and hasattr(dn, "rhs_spec") and rhs_shape:
+            out_ch = int(rhs_shape[dn.rhs_spec[0]])
+        return EqnCost(flops=2.0 * out_elems * rhs_elems / max(out_ch, 1),
+                       bytes_in=bytes_in, bytes_out=bytes_out)
+
+    if prim in COLLECTIVE_PRIMS:
+        n, est = _group_size(params, mesh_axes)
+        payload = max(bytes_in, bytes_out)
+        if prim in ("psum", "pmin", "pmax"):
+            comm = 2.0 * (n - 1) / n * payload if n > 1 else 0.0
+        elif prim == "all_gather":
+            comm = (n - 1) / n * bytes_out if n > 1 else 0.0
+        elif prim in ("psum_scatter", "reduce_scatter"):
+            comm = (n - 1) / n * bytes_in if n > 1 else 0.0
+        elif prim == "all_to_all":
+            comm = (n - 1) / n * payload if n > 1 else 0.0
+        else:  # ppermute / pshuffle / pgather: point-to-point payload
+            comm = float(payload)
+        reduce_flops = in_elems if prim in ("psum", "pmin", "pmax") else 0
+        return EqnCost(flops=float(reduce_flops),
+                       bytes_in=bytes_in, bytes_out=bytes_out,
+                       comm_bytes=comm, estimated=est)
+
+    if prim == "axis_index":
+        return EqnCost(bytes_out=bytes_out)
+
+    if prim in _TRANSCENDENTAL:
+        return EqnCost(flops=float(TRANSCENDENTAL_FLOPS * out_elems),
+                       bytes_in=bytes_in, bytes_out=bytes_out)
+    if prim in _ELEMENTWISE_1:
+        return EqnCost(flops=float(out_elems),
+                       bytes_in=bytes_in, bytes_out=bytes_out)
+    if prim in _REDUCTION:
+        return EqnCost(flops=float(in_elems),
+                       bytes_in=bytes_in, bytes_out=bytes_out)
+    if prim in ("reduce_window_sum", "reduce_window_max",
+                "reduce_window_min"):
+        window = 1
+        for w in params.get("window_dimensions", ()):
+            window *= int(w)
+        return EqnCost(flops=float(out_elems * window),
+                       bytes_in=bytes_in, bytes_out=bytes_out)
+    if prim in _SCATTER_COMBINE:
+        updates = _elems(in_avals[2]) if len(in_avals) >= 3 else in_elems
+        return EqnCost(flops=float(updates),
+                       bytes_in=bytes_in, bytes_out=bytes_out)
+    if prim in _MOVEMENT:
+        return EqnCost(bytes_in=bytes_in, bytes_out=bytes_out)
+
+    # unknown primitive: bytes-only fallback, reported via GraphCost.unknown
+    return EqnCost(bytes_in=bytes_in, bytes_out=bytes_out, known=False,
+                   estimated=True)
+
+
+@dataclasses.dataclass
+class GraphCost:
+    """Whole-program totals over a :class:`DefUseGraph` walk."""
+
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    comm_bytes: float = 0.0
+    by_prim: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict)
+    unknown: Dict[str, int] = dataclasses.field(default_factory=dict)
+    estimated: bool = False        # while trip counts / guessed axis sizes
+    n_eqns: int = 0
+
+    @property
+    def intensity(self) -> float:
+        return self.flops / self.bytes_accessed if self.bytes_accessed else 0.0
+
+    def to_dict(self) -> dict:
+        top = sorted(self.by_prim.items(),
+                     key=lambda kv: -kv[1]["flops"])[:12]
+        return {
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "comm_bytes": self.comm_bytes,
+            "intensity_flops_per_byte": round(self.intensity, 3),
+            "classification": classify_intensity(self.intensity),
+            "n_eqns": self.n_eqns,
+            "estimated": self.estimated,
+            "unknown_prims": dict(self.unknown),
+            "by_prim_top": {k: {m: round(x, 1) for m, x in v.items()}
+                            for k, v in top},
+        }
+
+
+_SCAN_AT = re.compile(r"^scan@(\d+)$")
+_ESTIMATED_AT = re.compile(r"^(while|cond)@(\d+)$")
+
+
+def _multiplier(graph, path) -> Tuple[float, bool]:
+    """Execution count of a node from its enclosing scans ('scan@IDX' path
+    elements carry the trip count in the container node's params); while
+    loops (unknown trip count, multiplier 1) and cond branches (BOTH
+    counted — an upper bound) flag the totals estimated."""
+    mult, estimated = 1.0, False
+    for part in path:
+        m = _SCAN_AT.match(part)
+        if m:
+            node = graph.nodes[int(m.group(1))]
+            mult *= float(node.params.get("length", 1) or 1)
+            continue
+        if _ESTIMATED_AT.match(part):
+            estimated = True
+    return mult, estimated
+
+
+def graph_cost(graph, mesh_axes: Optional[Dict[str, int]] = None) -> GraphCost:
+    """Aggregate :func:`cost_eqn` over every non-container node, scaling
+    scan bodies by trip count.  Both cond branches are counted (an upper
+    bound, flagged ``estimated``)."""
+    total = GraphCost()
+    for node in graph.nodes:
+        c = cost_eqn(node.prim, node.in_avals, node.out_avals, node.params,
+                     mesh_axes)
+        if c.container:
+            continue
+        mult, est = _multiplier(graph, node.path)
+        if est or c.estimated:
+            total.estimated = True
+        if not c.known:
+            total.unknown[node.prim] = total.unknown.get(node.prim, 0) + 1
+        total.flops += c.flops * mult
+        total.bytes_accessed += c.bytes_accessed * mult
+        total.comm_bytes += c.comm_bytes * mult
+        total.n_eqns += 1
+        agg = total.by_prim.setdefault(
+            node.prim, {"count": 0, "flops": 0.0, "bytes": 0.0})
+        agg["count"] += 1
+        agg["flops"] += c.flops * mult
+        agg["bytes"] += c.bytes_accessed * mult
+    return total

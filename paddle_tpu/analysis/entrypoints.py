@@ -85,7 +85,8 @@ def trainer_target() -> AnalysisTarget:
                 jnp.asarray(0.01, jnp.float32))
         t = AnalysisTarget("trainer_step", trainer._jit_step, args,
                            tags=("train", "spmd"),
-                           compute_dtype="bfloat16")
+                           compute_dtype="bfloat16",
+                           mesh_axes={"dp": dp})
         t.jaxpr()  # materialize while the mesh is installed
         return t
 
@@ -122,7 +123,8 @@ def pipeline_target() -> AnalysisTarget:
         args = (step.state["params"], step.state["opt"], x, x, kd,
                 jnp.asarray(1e-3, jnp.float32), step.state["sentinel"])
         t = AnalysisTarget("pipeline_step", step.jitted, args,
-                           tags=("train", "spmd", "pipeline"))
+                           tags=("train", "spmd", "pipeline"),
+                           mesh_axes={"pp": 2})
         t.jaxpr()
         return t
 

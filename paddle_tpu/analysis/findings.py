@@ -28,7 +28,12 @@ __all__ = [
     "AnalysisWarning",
     "AnalysisReport",
     "warn_finding",
+    "REPORT_SCHEMA_VERSION",
 ]
+
+#: version of the analysis_report.json layout (bumped when keys change);
+#: the ``--memory`` artifact carries its own MEMORY_SCHEMA_VERSION
+REPORT_SCHEMA_VERSION = 2
 
 
 class Severity(enum.IntEnum):
@@ -123,6 +128,7 @@ class AnalysisReport:
         ordered = sorted(self.findings,
                          key=lambda f: (-int(f.severity), f.entry_point, f.rule))
         return {
+            "schema_version": REPORT_SCHEMA_VERSION,
             "meta": dict(self.meta, generated_at=time.strftime(
                 "%Y-%m-%dT%H:%M:%S", time.gmtime())),
             "counts": self.counts(),

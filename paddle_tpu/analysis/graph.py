@@ -89,6 +89,22 @@ def _aval_info(v):
             bool(getattr(aval, "weak_type", False)))
 
 
+def _light_params(params: dict) -> dict:
+    """Eqn params minus sub-jaxprs (which the walker recurses separately):
+    keeps the scalars the cost model needs (dimension_numbers, scan length,
+    collective axes, donated_invars, in_shardings, ...)."""
+    out = {}
+    for k, v in params.items():
+        if isinstance(v, (_jcore.Jaxpr, _jcore.ClosedJaxpr)):
+            continue
+        if isinstance(v, (tuple, list)) and any(
+                isinstance(x, (_jcore.Jaxpr, _jcore.ClosedJaxpr))
+                for x in v):
+            continue
+        out[k] = v
+    return out
+
+
 def _nbytes(aval_info) -> int:
     shape, dtype, _ = aval_info
     if dtype is None:
@@ -117,6 +133,7 @@ class Node:
     in_defs: Tuple[int, ...]       # producing Node idx; -1 input, -2 const
     axes: Tuple[str, ...]          # collective axes ((),) for others
     nonuniform: FrozenSet[str]     # mesh axes the outputs may differ along
+    params: dict = dataclasses.field(default_factory=dict)  # _light_params
 
     @property
     def where(self) -> str:
@@ -394,6 +411,7 @@ class _Walker:
                 out_avals=tuple(_aval_info(v) for v in eqn.outvars),
                 in_defs=tuple(d for _, d in in_info),
                 axes=axes, nonuniform=out_taint,
+                params=_light_params(eqn.params),
             )
             g.nodes.append(node)
 
@@ -532,14 +550,18 @@ class AnalysisTarget:
     positions into ``args`` whose leaves are *intended* donated (used when
     the live jit gates donation on backend, e.g. serving on CPU).
     ``tags`` steer rule applicability ({"train", "serving", "inference",
-    "static", "spmd"}).
+    "static", "spmd"}).  ``mesh_axes`` records the mesh the program was
+    traced under ({axis: size}) for the quantitative rules — collective
+    comm bytes and per-device sharded sizes need the axis extents after the
+    builder's mesh context has been torn down.
     """
 
     def __init__(self, name: str, fn: Callable, args: Sequence = (),
                  kwargs: Optional[dict] = None, *,
                  tags: Sequence[str] = (),
                  donate_argnums: Optional[Sequence[int]] = None,
-                 program=None, compute_dtype=None):
+                 program=None, compute_dtype=None,
+                 mesh_axes: Optional[Dict[str, int]] = None):
         self.name = name
         self.fn = fn
         self.args = tuple(args)
@@ -549,6 +571,7 @@ class AnalysisTarget:
                                if donate_argnums is not None else None)
         self.program = program
         self.compute_dtype = compute_dtype
+        self.mesh_axes = dict(mesh_axes) if mesh_axes else {}
         self._jaxpr = None
         self._graph = None
         self._stablehlo = None

@@ -1,0 +1,888 @@
+"""Liveness-based peak-HBM estimator + the quantitative memory rules.
+
+The question the qualitative graph doctor (PR 4) could not answer: **will
+this step fit in HBM?**  This module runs a def-use liveness pass over the
+same jaxpr surfaces the walker covers — the analysis underlying Checkmate's
+rematerialization planning (Jain et al.) — and produces an estimated
+peak-HBM watermark plus a live-set timeline per entry point.
+
+Accounting conventions (pinned; tests hand-compute against them):
+
+* **args** — entry arguments are resident for the whole step *unless
+  donated* (donation read from the pjit ``donated_invars`` or the target's
+  intended-donation override); donated args are freed at their last use
+  and their bytes are reused by matching outputs.
+* **consts** — closure-baked constants are resident for the whole program
+  (the executable holds them across calls).
+* **intermediates** — allocated when their eqn executes (the eqn's inputs
+  and outputs are live simultaneously — the transient term), freed after
+  their last consumer.
+* **scan** — the stacked ``ys`` accumulators and the final carry are
+  allocated up front; the body is walked once (per-iteration peak) with
+  consts/carry/one xs-slice live; the full stacked xs stays live in the
+  enclosing scope for the duration.
+* **while/cond** — carry/operands held across the sub-walk; both cond
+  branches are walked (peak = max over branches, conservatively).
+* **sharding** — per-*device* bytes: ``pjit`` ``in_shardings``/
+  ``out_shardings`` divide entry sizes by the product of their mesh axis
+  extents; ``shard_map`` bodies use the inner (per-shard) avals directly.
+
+Everything is a static upper-bound estimate of XLA's allocator, not a
+simulation — the bench secondary tracks estimator-vs-measured on the real
+trainer step.
+
+Rules fed by the estimate: ``oom-risk`` (peak vs a configurable device
+budget), ``low-intensity-dot`` (Roofline-memory-bound matmuls), and
+``remat-advisor`` (cheapest recompute candidates live on the peak path).
+:func:`planner_drift_findings` cross-checks the auto_parallel planner's
+analytic byte model against this analyzer on a GPT config.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from .cost import cost_eqn
+from .findings import Finding, Severity
+from .graph import (
+    AnalysisTarget,
+    _aval_info,
+    _jcore,
+    _light_params,
+    _name_stack_of,
+    _nbytes,
+    _source_of,
+)
+from .rules import Rule, register_rule
+
+__all__ = [
+    "MemoryEstimate",
+    "TimelinePoint",
+    "estimate_memory",
+    "memory_estimate",
+    "MemoryBudgetRule",
+    "LowIntensityDotRule",
+    "RematAdvisorRule",
+    "planner_drift_findings",
+    "MEMORY_SCHEMA_VERSION",
+]
+
+#: version of the ``--memory`` JSON artifact layout
+MEMORY_SCHEMA_VERSION = 1
+
+_DEFAULT_BUDGET = 16 * 1024 ** 3        # one v5e chip's HBM
+
+
+@dataclasses.dataclass
+class TimelinePoint:
+    step: int
+    prim: str
+    scope: str
+    source: str
+    live_bytes: int
+
+
+@dataclasses.dataclass
+class MemoryEstimate:
+    """Per-device peak/residency estimate for one program."""
+
+    peak_bytes: int = 0
+    peak_step: int = -1
+    peak_prim: str = ""
+    peak_scope: str = ""
+    peak_source: str = ""
+    args_bytes: int = 0
+    consts_bytes: int = 0
+    donated_bytes: int = 0
+    out_bytes: int = 0
+    live_at_peak: List[dict] = dataclasses.field(default_factory=list)
+    timeline: List[TimelinePoint] = dataclasses.field(default_factory=list)
+    sharded: bool = False
+    estimated: bool = False
+    n_eqns: int = 0
+    #: per-device bytes per entry-arg leaf, labelled ``args[i]<keypath>``
+    #: (the planner-drift cross-check sums these by prefix)
+    arg_entries: List[dict] = dataclasses.field(default_factory=list)
+
+    def arg_bytes(self, label_prefix: str) -> int:
+        """Sum of per-device input bytes whose label starts with
+        ``label_prefix`` (e.g. ``"args[0]"`` for the first arg's tree)."""
+        return sum(e["bytes"] for e in self.arg_entries
+                   if e["label"].startswith(label_prefix))
+
+    @property
+    def resident_bytes(self) -> int:
+        """Steady-state residency across repeated calls: args + consts +
+        the output bytes that cannot alias a donated input."""
+        return (self.args_bytes + self.consts_bytes
+                + max(self.out_bytes - self.donated_bytes, 0))
+
+    @property
+    def peak_where(self) -> str:
+        return " @ ".join(x for x in (self.peak_scope, self.peak_source)
+                          if x)
+
+    def to_dict(self, timeline_points: int = 256) -> dict:
+        tl = self.timeline
+        if len(tl) > timeline_points:
+            stride = len(tl) // timeline_points + 1
+            tl = tl[::stride]
+        return {
+            "schema_version": MEMORY_SCHEMA_VERSION,
+            "peak_hbm_bytes": int(self.peak_bytes),
+            "resident_bytes": int(self.resident_bytes),
+            "args_bytes": int(self.args_bytes),
+            "consts_bytes": int(self.consts_bytes),
+            "donated_bytes": int(self.donated_bytes),
+            "out_bytes": int(self.out_bytes),
+            "peak_site": {"step": self.peak_step, "prim": self.peak_prim,
+                          "scope": self.peak_scope,
+                          "source": self.peak_source},
+            "sharded": self.sharded,
+            "estimated": self.estimated,
+            "n_eqns": self.n_eqns,
+            "live_at_peak_top": [
+                {"bytes": int(e["bytes"]), "origin": e["origin"],
+                 "label": e["label"], "scope": e["scope"]}
+                for e in sorted(self.live_at_peak,
+                                key=lambda e: -e["bytes"])[:16]],
+            "timeline": [
+                {"step": p.step, "prim": p.prim,
+                 "live_bytes": int(p.live_bytes)} for p in tl],
+        }
+
+
+def _entry(nbytes, origin, label="", scope="", source="", flops=0.0,
+           held=True):
+    return {"bytes": int(nbytes), "origin": origin, "label": label,
+            "scope": scope, "source": source, "flops": float(flops),
+            "held": held, "donated": False}
+
+
+def _sharding_divisor(sh) -> int:
+    """#shards a NamedSharding splits an array into (1 when unknown)."""
+    spec = getattr(sh, "spec", None)
+    mesh = getattr(sh, "mesh", None)
+    if spec is None or mesh is None:
+        return 1
+    sizes = dict(mesh.shape)
+    d = 1
+    for part in spec:
+        axes = part if isinstance(part, (tuple, list)) else (part,)
+        for a in axes:
+            if isinstance(a, str):
+                d *= int(sizes.get(a, 1))
+    return d
+
+
+def _names_divisor(names, mesh_axes: Dict[str, int]) -> int:
+    """#shards from a shard_map in_names/out_names entry ({dim: axes})."""
+    d = 1
+    values = names.values() if hasattr(names, "values") else ()
+    for v in values:
+        axes = v if isinstance(v, (tuple, list)) else (v,)
+        for a in axes:
+            if isinstance(a, str):
+                d *= int(mesh_axes.get(a, 1))
+    return d
+
+
+def _is_var(v) -> bool:
+    return isinstance(v, _jcore.Var)
+
+
+class _LivenessWalker:
+    def __init__(self, mesh_axes: Optional[Dict[str, int]] = None):
+        self.mesh_axes = dict(mesh_axes or {})
+        self.step = 0
+        self.peak = 0
+        self.peak_info = (-1, "", "", "")
+        self.live_at_peak: List[dict] = []
+        self.timeline: List[TimelinePoint] = []
+        self.sharded = False
+        self.estimated = False
+        self.consts_bytes = 0      # across ALL scopes (executable-held)
+
+    # -- bookkeeping ----------------------------------------------------
+    def _point(self, eqn, live, snapshot_fn):
+        """``snapshot_fn`` is a thunk: the full live-entry snapshot is
+        only materialised when this eqn sets a new peak — building it
+        eagerly per eqn would make the sweep O(eqns * live-entries)."""
+        self.step += 1
+        prim = eqn.primitive.name
+        scope = _name_stack_of(eqn)
+        source = _source_of(eqn)
+        self.timeline.append(
+            TimelinePoint(self.step, prim, scope, source, int(live)))
+        if live > self.peak:
+            self.peak = int(live)
+            self.peak_info = (self.step, prim, scope, source)
+            self.live_at_peak = [dict(e) for e in snapshot_fn()
+                                 if e["bytes"] > 0]
+
+    def _out_entries(self, eqn, last_use, sizes=None):
+        """Entries for the eqn's consumed outputs (dead outvars skipped —
+        XLA DCEs them)."""
+        out = []
+        c = cost_eqn(eqn.primitive.name,
+                     tuple(_aval_info(v) for v in eqn.invars),
+                     tuple(_aval_info(v) for v in eqn.outvars),
+                     _light_params(eqn.params), self.mesh_axes)
+        if not c.known:
+            self.estimated = True
+        n_out = max(len(eqn.outvars), 1)
+        for j, v in enumerate(eqn.outvars):
+            if not _is_var(v) or v not in last_use:
+                out.append((v, None))
+                continue
+            nb = (sizes[j] if sizes is not None
+                  else _nbytes(_aval_info(v)))
+            out.append((v, _entry(
+                nb, "intermediate", eqn.primitive.name,
+                _name_stack_of(eqn), _source_of(eqn),
+                flops=c.flops / n_out, held=False)))
+        return out
+
+    # -- the pass -------------------------------------------------------
+    def walk(self, closed, in_entries, ambient, outer_entries, path):
+        """Walk one (Closed)Jaxpr scope.  ``in_entries`` align with its
+        invars and are counted HERE (the caller subtracted any bytes it had
+        already counted for passed-through operands); ``ambient`` is
+        everything live in enclosing scopes beyond those entries."""
+        jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+        local: Dict = {}
+        total = 0
+        consts = list(getattr(closed, "consts", ()))
+        for k, cv in enumerate(jaxpr.constvars):
+            nb = (_nbytes(_aval_info(consts[k])) if k < len(consts)
+                  else _nbytes(_aval_info(cv)))
+            e = _entry(nb, "const", "const")
+            local[cv] = e
+            total += e["bytes"]
+            self.consts_bytes += e["bytes"]
+        for v, e in zip(jaxpr.invars, in_entries):
+            local[v] = e
+            total += e["bytes"]
+
+        last_use: Dict = {}
+        for i, eqn in enumerate(jaxpr.eqns):
+            for v in eqn.invars:
+                if _is_var(v):
+                    last_use[v] = i
+        n = len(jaxpr.eqns)
+        for v in jaxpr.outvars:
+            if _is_var(v):
+                last_use[v] = n
+
+        for i, eqn in enumerate(jaxpr.eqns):
+            total = self._eqn(eqn, i, local, total, ambient,
+                              outer_entries, path, last_use)
+        return total
+
+    def _free_dead(self, eqn, i, local, total, last_use):
+        for v in set(x for x in eqn.invars if _is_var(x)):
+            e = local.get(v)
+            if e is None or last_use.get(v) != i:
+                continue
+            if e["held"] and not e["donated"]:
+                continue
+            total -= e["bytes"]
+            del local[v]
+        return total
+
+    def _snapshot(self, outer_entries, local, exclude=()):
+        ex = set(map(id, exclude))
+        return outer_entries + [e for e in local.values()
+                                if id(e) not in ex]
+
+    def _eqn(self, eqn, i, local, total, ambient, outer_entries, path,
+             last_use):
+        prim = eqn.primitive.name
+        params = eqn.params
+
+        if prim == "pjit":
+            return self._pjit(eqn, i, local, total, ambient, outer_entries,
+                              path, last_use)
+        if prim == "scan":
+            return self._scan(eqn, i, local, total, ambient, outer_entries,
+                              path, last_use)
+        if prim == "while":
+            return self._while(eqn, i, local, total, ambient, outer_entries,
+                               path, last_use)
+        if prim == "cond":
+            return self._cond(eqn, i, local, total, ambient, outer_entries,
+                              path, last_use)
+        if prim == "shard_map":
+            return self._shard_map(eqn, i, local, total, ambient,
+                                   outer_entries, path, last_use)
+        subs = [(k, v) for k, v in params.items()
+                if isinstance(v, (_jcore.Jaxpr, _jcore.ClosedJaxpr))]
+        if subs:
+            return self._generic(eqn, i, local, total, ambient,
+                                 outer_entries, path, last_use, subs)
+
+        # -- leaf eqn ---------------------------------------------------
+        outs = self._out_entries(eqn, last_use)
+        out_total = sum(e["bytes"] for _, e in outs if e is not None)
+        self._point(eqn, ambient + total + out_total,
+                    lambda: self._snapshot(outer_entries, local)
+                    + [e for _, e in outs if e is not None])
+        for v, e in outs:
+            if e is not None:
+                local[v] = e
+                total += e["bytes"]
+        return self._free_dead(eqn, i, local, total, last_use)
+
+    def _passthrough(self, eqn, operands, local):
+        """Held copies of operand entries for a sub-scope (the sub-scope
+        must not free the enclosing scope's buffers), plus the bytes the
+        caller should subtract from its ambient (the copies are re-counted
+        inside)."""
+        entries, live, shared = [], 0, []
+        for v in operands:
+            if _is_var(v) and v in local:
+                e = local[v]
+                c = dict(e, held=True, donated=False)
+                entries.append(c)
+                live += e["bytes"]
+                shared.append(e)
+            else:
+                nb = _nbytes(_aval_info(v))
+                entries.append(_entry(nb, "intermediate", "literal",
+                                      held=True))
+                shared.append(None)
+        return entries, live, shared
+
+    def _alloc_outs(self, eqn, i, local, total, last_use, label=None,
+                    sizes=None, accumulator_from=None):
+        outs = self._out_entries(eqn, last_use, sizes=sizes)
+        out_total = 0
+        for j, (v, e) in enumerate(outs):
+            if e is None:
+                continue
+            if label:
+                e["label"] = label
+            if accumulator_from is not None and j >= accumulator_from:
+                e["origin"] = "accumulator"
+            local[v] = e
+            total += e["bytes"]
+            out_total += e["bytes"]
+        return total, out_total
+
+    def _pjit(self, eqn, i, local, total, ambient, outer_entries, path,
+              last_use):
+        params = eqn.params
+        inner = params["jaxpr"]
+        donated = tuple(params.get("donated_invars", ()))
+        inner_entries, passthrough_live = [], 0
+        shared_ops = []
+        for k, v in enumerate(eqn.invars):
+            if _is_var(v) and v in local:
+                e = local[v]
+                if k < len(donated) and donated[k]:
+                    e["held"] = False
+                    e["donated"] = True
+                inner_entries.append(e)      # shared: donation frees it
+                passthrough_live += e["bytes"]
+                shared_ops.append((v, e))
+            else:
+                inner_entries.append(_entry(
+                    _nbytes(_aval_info(v)), "intermediate", "literal",
+                    held=True))
+                shared_ops.append((None, None))
+        sub_outer = self._snapshot(
+            outer_entries, local, exclude=[e for _, e in shared_ops if e])
+        self.walk(inner, inner_entries,
+                  ambient + total - passthrough_live, sub_outer,
+                  path + (f"pjit:{params.get('name', '')}",))
+        # call returns: donated operands are consumed, outputs alias them
+        donated_live = 0
+        for v, e in shared_ops:
+            if e is not None and e["donated"] and v in local:
+                donated_live += e["bytes"]
+                total -= e["bytes"]
+                del local[v]
+        out_sizes = []
+        out_sh = params.get("out_shardings", ())
+        for j, ov in enumerate(eqn.outvars):
+            nb = _nbytes(_aval_info(ov))
+            if j < len(out_sh):
+                nb //= max(_sharding_divisor(out_sh[j]), 1)
+            out_sizes.append(nb)
+        out_total_probe = sum(
+            s for s, v in zip(out_sizes, eqn.outvars)
+            if _is_var(v) and v in last_use)
+        self._point(eqn, ambient + total + out_total_probe,
+                    lambda: self._snapshot(outer_entries, local))
+        total, _ = self._alloc_outs(eqn, i, local, total, last_use,
+                                    sizes=out_sizes)
+        return self._free_dead(eqn, i, local, total, last_use)
+
+    def _scan(self, eqn, i, local, total, ambient, outer_entries, path,
+              last_use):
+        params = eqn.params
+        nc = params.get("num_consts", 0)
+        nk = params.get("num_carry", 0)
+        body = params["jaxpr"]
+        inner_jaxpr = body.jaxpr if hasattr(body, "jaxpr") else body
+        # stacked ys accumulators + final carry allocated up front
+        probe = sum(_nbytes(_aval_info(v)) for v in eqn.outvars
+                    if _is_var(v) and v in last_use)
+        self._point(eqn, ambient + total + probe,
+                    lambda: self._snapshot(outer_entries, local))
+        total, _ = self._alloc_outs(eqn, i, local, total, last_use,
+                                    label="scan", accumulator_from=nk)
+        held_ops = eqn.invars[:nc + nk]
+        pt_entries, pt_live, _ = self._passthrough(eqn, held_ops, local)
+        # xs enter the body as per-iteration slices (inner avals)
+        xs_entries = [
+            _entry(_nbytes(_aval_info(v)), "intermediate", "scan:x-slice",
+                   held=True)
+            for v in inner_jaxpr.invars[nc + nk:]]
+        self.walk(body, pt_entries + xs_entries,
+                  ambient + total - pt_live,
+                  self._snapshot(outer_entries, local),
+                  path + (f"scan@{self.step}",))
+        return self._free_dead(eqn, i, local, total, last_use)
+
+    def _while(self, eqn, i, local, total, ambient, outer_entries, path,
+               last_use):
+        params = eqn.params
+        cn = params.get("cond_nconsts", 0)
+        bn = params.get("body_nconsts", 0)
+        probe = sum(_nbytes(_aval_info(v)) for v in eqn.outvars
+                    if _is_var(v) and v in last_use)
+        self._point(eqn, ambient + total + probe,
+                    lambda: self._snapshot(outer_entries, local))
+        total, _ = self._alloc_outs(eqn, i, local, total, last_use,
+                                    label="while-carry")
+        carry = eqn.invars[cn + bn:]
+        self.estimated = True        # trip count unknowable statically
+        for label, sub, ops in (
+                ("cond", params["cond_jaxpr"], eqn.invars[:cn] + list(carry)),
+                ("body", params["body_jaxpr"],
+                 eqn.invars[cn:cn + bn] + list(carry))):
+            entries, live, _ = self._passthrough(eqn, ops, local)
+            self.walk(sub, entries, ambient + total - live,
+                      self._snapshot(outer_entries, local),
+                      path + (f"while@{self.step}", label))
+        return self._free_dead(eqn, i, local, total, last_use)
+
+    def _cond(self, eqn, i, local, total, ambient, outer_entries, path,
+              last_use):
+        branches = eqn.params.get("branches", ())
+        probe = sum(_nbytes(_aval_info(v)) for v in eqn.outvars
+                    if _is_var(v) and v in last_use)
+        self._point(eqn, ambient + total + probe,
+                    lambda: self._snapshot(outer_entries, local))
+        total, _ = self._alloc_outs(eqn, i, local, total, last_use,
+                                    label="cond")
+        args = eqn.invars[1:]
+        for bi, br in enumerate(branches):
+            entries, live, _ = self._passthrough(eqn, args, local)
+            self.walk(br, entries, ambient + total - live,
+                      self._snapshot(outer_entries, local),
+                      path + (f"cond@{self.step}", f"branch{bi}"))
+        return self._free_dead(eqn, i, local, total, last_use)
+
+    def _shard_map(self, eqn, i, local, total, ambient, outer_entries,
+                   path, last_use):
+        params = eqn.params
+        inner = params["jaxpr"]
+        inner_jaxpr = inner.jaxpr if hasattr(inner, "jaxpr") else inner
+        self.sharded = True
+        # inner avals are the per-shard shapes — the per-device truth; the
+        # outer (global-view) operand bytes are swapped out for them
+        op_live = sum(local[v]["bytes"] for v in eqn.invars
+                      if _is_var(v) and v in local)
+        inner_entries = [
+            _entry(_nbytes(_aval_info(v)), "intermediate", "shard-input",
+                   held=True)
+            for v in inner_jaxpr.invars]
+        ops = [e for v in eqn.invars
+               if _is_var(v) and (e := local.get(v)) is not None]
+        self.walk(inner, inner_entries, ambient + total - op_live,
+                  self._snapshot(outer_entries, local, exclude=ops),
+                  path + (f"shard_map@{self.step}",))
+        out_names = params.get("out_names", ())
+        out_sizes = []
+        for j, ov in enumerate(eqn.outvars):
+            nb = _nbytes(_aval_info(ov))
+            if j < len(out_names):
+                nb //= max(_names_divisor(out_names[j], self.mesh_axes), 1)
+            out_sizes.append(nb)
+        probe = sum(s for s, v in zip(out_sizes, eqn.outvars)
+                    if _is_var(v) and v in last_use)
+        self._point(eqn, ambient + total - op_live + probe,
+                    lambda: self._snapshot(outer_entries, local,
+                                           exclude=ops))
+        total, _ = self._alloc_outs(eqn, i, local, total, last_use,
+                                    sizes=out_sizes)
+        return self._free_dead(eqn, i, local, total, last_use)
+
+    def _generic(self, eqn, i, local, total, ambient, outer_entries, path,
+                 last_use, subs):
+        recursed = False
+        for k, sub in subs:
+            sub_jaxpr = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+            if len(sub_jaxpr.outvars) != len(eqn.outvars):
+                continue
+            entries, live, _ = self._passthrough(eqn, eqn.invars, local)
+            if len(entries) != len(sub_jaxpr.invars):
+                continue
+            self.walk(sub, entries, ambient + total - live,
+                      self._snapshot(outer_entries, local),
+                      path + (f"{eqn.primitive.name}@{self.step}", k))
+            recursed = True
+        if not recursed:  # opaque call: cost it as a leaf
+            self.estimated = True
+        outs = self._out_entries(eqn, last_use)
+        out_total = sum(e["bytes"] for _, e in outs if e is not None)
+        self._point(eqn, ambient + total + out_total,
+                    lambda: self._snapshot(outer_entries, local))
+        for v, e in outs:
+            if e is not None:
+                local[v] = e
+                total += e["bytes"]
+        return self._free_dead(eqn, i, local, total, last_use)
+
+
+def _top_divisors_and_donation(jaxpr, override_mask):
+    """Per-top-invar (divisor, donated) via a single-eqn lookahead: a
+    jitted entry point is one top pjit eqn (in_shardings + donated_invars),
+    a bare shard_map entry is one shard_map eqn (in_names)."""
+    n = len(jaxpr.invars)
+    div = [1] * n
+    don = [bool(override_mask[i]) if override_mask and i < len(override_mask)
+           else False for i in range(n)]
+    if len(jaxpr.eqns) == 1:
+        eqn = jaxpr.eqns[0]
+        pos = {v: k for k, v in enumerate(eqn.invars) if _is_var(v)}
+        if eqn.primitive.name == "pjit":
+            ins = eqn.params.get("in_shardings", ())
+            dnv = eqn.params.get("donated_invars", ())
+            for i, v in enumerate(jaxpr.invars):
+                k = pos.get(v)
+                if k is None:
+                    continue
+                if k < len(ins):
+                    div[i] = max(_sharding_divisor(ins[k]), 1)
+                if k < len(dnv) and dnv[k]:
+                    don[i] = True
+        elif eqn.primitive.name == "shard_map":
+            mesh = eqn.params.get("mesh")
+            sizes = dict(getattr(mesh, "shape", {}) or {})
+            in_names = eqn.params.get("in_names", ())
+            for i, v in enumerate(jaxpr.invars):
+                k = pos.get(v)
+                if k is not None and k < len(in_names):
+                    div[i] = max(_names_divisor(in_names[k], sizes), 1)
+    return div, don
+
+
+def estimate_memory(target, *, donated_mask=None,
+                    mesh_axes: Optional[Dict[str, int]] = None,
+                    labels: Optional[List[str]] = None) -> MemoryEstimate:
+    """Liveness-based peak-HBM estimate for an :class:`AnalysisTarget` or a
+    ClosedJaxpr.  ``donated_mask`` marks entry leaves *intended* donated
+    (defaults to the target's override)."""
+    if isinstance(target, AnalysisTarget):
+        closed = target.jaxpr()
+        if donated_mask is None:
+            donated_mask = target.donated_mask()
+        if mesh_axes is None:
+            mesh_axes = target.mesh_axes
+        if labels is None:
+            labels = target.arg_labels()
+    else:
+        closed = target
+    jaxpr = closed.jaxpr
+    labels = labels or []
+
+    div, don = _top_divisors_and_donation(jaxpr, donated_mask)
+    w = _LivenessWalker(mesh_axes)
+    in_entries = []
+    for i, v in enumerate(jaxpr.invars):
+        nb = _nbytes(_aval_info(v)) // div[i]
+        label = labels[i] if i < len(labels) else f"arg{i}"
+        in_entries.append(_entry(nb, "arg", label,
+                                 held=not don[i]))
+        if don[i]:
+            in_entries[-1]["donated"] = True
+    args_bytes = sum(e["bytes"] for e in in_entries)
+    donated_bytes = sum(e["bytes"] for e in in_entries if e["donated"])
+
+    w.walk(closed, in_entries, 0, [], ())
+    consts_bytes = w.consts_bytes   # all scopes (the pjit's closure too)
+
+    # output bytes through the single-top-eqn shardings when present
+    out_div = [1] * len(jaxpr.outvars)
+    if len(jaxpr.eqns) == 1:
+        eqn = jaxpr.eqns[0]
+        opos = {v: k for k, v in enumerate(eqn.outvars)}
+        if eqn.primitive.name == "pjit":
+            osh = eqn.params.get("out_shardings", ())
+            for j, ov in enumerate(jaxpr.outvars):
+                k = opos.get(ov)
+                if k is not None and k < len(osh):
+                    out_div[j] = max(_sharding_divisor(osh[k]), 1)
+        elif eqn.primitive.name == "shard_map":
+            mesh = eqn.params.get("mesh")
+            sizes = dict(getattr(mesh, "shape", {}) or {})
+            onames = eqn.params.get("out_names", ())
+            for j, ov in enumerate(jaxpr.outvars):
+                k = opos.get(ov)
+                if k is not None and k < len(onames):
+                    out_div[j] = max(_names_divisor(onames[k], sizes), 1)
+    out_bytes = sum(_nbytes(_aval_info(v)) // out_div[j]
+                    for j, v in enumerate(jaxpr.outvars))
+
+    est = MemoryEstimate(
+        peak_bytes=int(w.peak), peak_step=w.peak_info[0],
+        peak_prim=w.peak_info[1], peak_scope=w.peak_info[2],
+        peak_source=w.peak_info[3],
+        args_bytes=int(args_bytes), consts_bytes=int(consts_bytes),
+        donated_bytes=int(donated_bytes), out_bytes=int(out_bytes),
+        live_at_peak=w.live_at_peak, timeline=w.timeline,
+        sharded=w.sharded, estimated=w.estimated, n_eqns=w.step,
+        arg_entries=[{"label": e["label"], "bytes": e["bytes"],
+                      "donated": e["donated"]} for e in in_entries])
+    return est
+
+
+def memory_estimate(target: AnalysisTarget) -> MemoryEstimate:
+    """Memoized :func:`estimate_memory` (several rules share one pass)."""
+    est = getattr(target, "_memory_estimate", None)
+    if est is None:
+        est = estimate_memory(target)
+        target._memory_estimate = est
+    return est
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+@register_rule
+class MemoryBudgetRule(Rule):
+    """``oom-risk``: estimated peak HBM vs a configurable device budget."""
+
+    name = "oom-risk"
+
+    def __init__(self, budget_bytes: int = _DEFAULT_BUDGET,
+                 headroom: float = 0.92):
+        self.budget_bytes = int(budget_bytes)
+        self.headroom = headroom
+
+    def run(self, target):
+        est = memory_estimate(target)
+        peak = est.peak_bytes
+        if peak <= self.headroom * self.budget_bytes:
+            return []
+        top = sorted(est.live_at_peak, key=lambda e: -e["bytes"])[:5]
+        hot = ", ".join(f"{e['label'] or e['origin']}={e['bytes']}B"
+                        for e in top)
+        sev = (Severity.HIGH if peak > self.budget_bytes
+               else Severity.MEDIUM)
+        verb = ("exceeds" if sev is Severity.HIGH
+                else f"is within {100 * (1 - self.headroom):.0f}% of")
+        f = self.finding(
+            sev,
+            f"estimated peak HBM {peak} B {verb} the device budget "
+            f"{self.budget_bytes} B at {est.peak_prim} "
+            f"(largest live: {hot}) — shrink the batch, shard, donate, "
+            "or rematerialize (see remat-advisor)",
+            peak_bytes=peak, budget_bytes=self.budget_bytes,
+            peak_prim=est.peak_prim, estimated=est.estimated)
+        f.scope = est.peak_scope
+        f.source = est.peak_source
+        return [f]
+
+
+@register_rule
+class LowIntensityDotRule(Rule):
+    """``low-intensity-dot``: matmuls far below the Roofline ridge."""
+
+    name = "low-intensity-dot"
+
+    def __init__(self, threshold: float = 16.0, min_bytes: int = 1 << 20,
+                 max_findings: int = 8):
+        self.threshold = threshold
+        self.min_bytes = int(min_bytes)
+        self.max_findings = max_findings
+
+    def run(self, target):
+        findings = []
+        g = target.graph()
+        for n in g.nodes:
+            if n.prim != "dot_general":
+                continue
+            c = cost_eqn(n.prim, n.in_avals, n.out_avals, n.params,
+                         target.mesh_axes)
+            if c.bytes_accessed < self.min_bytes:
+                continue
+            if c.intensity >= self.threshold:
+                continue
+            findings.append(self.finding(
+                Severity.MEDIUM,
+                f"dot_general moves {c.bytes_accessed} B for only "
+                f"{c.flops:.0f} flops ({c.intensity:.1f} flops/byte, "
+                f"threshold {self.threshold}) — memory-bound on TPU; "
+                "batch more rows into the matmul or fuse it with its "
+                "neighbours",
+                node=n, flops=c.flops, bytes=c.bytes_accessed,
+                intensity=round(c.intensity, 2)))
+            if len(findings) >= self.max_findings:
+                break
+        return findings
+
+
+@register_rule
+class RematAdvisorRule(Rule):
+    """``remat-advisor``: cheapest recompute candidates on the peak path."""
+
+    name = "remat-advisor"
+
+    def __init__(self, min_bytes: int = 1 << 20,
+                 cheap_flops_per_byte: float = 4.0, top_k: int = 3,
+                 budget_bytes: int = _DEFAULT_BUDGET):
+        self.min_bytes = int(min_bytes)
+        self.cheap = cheap_flops_per_byte
+        self.top_k = top_k
+        self.budget_bytes = int(budget_bytes)
+
+    def run(self, target):
+        est = memory_estimate(target)
+        inter = [e for e in est.live_at_peak
+                 if e["origin"] in ("intermediate", "accumulator")
+                 and e["bytes"] > 0]
+        inter_bytes = sum(e["bytes"] for e in inter)
+        if inter_bytes < self.min_bytes:
+            return []
+        cands = sorted(
+            (e for e in inter
+             if e["origin"] == "intermediate" and not e["held"]
+             and e["flops"] / max(e["bytes"], 1) <= self.cheap),
+            key=lambda e: -e["bytes"])[: self.top_k]
+        if not cands:
+            return []
+        named = "; ".join(
+            f"{e['label']}({e['bytes']}B, ~{e['flops']:.0f} flops to "
+            f"recompute{', ' + e['scope'] if e['scope'] else ''})"
+            for e in cands)
+        sev = (Severity.MEDIUM if est.peak_bytes > self.budget_bytes
+               else Severity.LOW)
+        f = self.finding(
+            sev,
+            f"{inter_bytes} B of intermediates live at the peak "
+            f"({est.peak_bytes} B @ {est.peak_prim}); cheapest recompute "
+            f"candidates: {named} — jax.checkpoint the producing segment "
+            "to trade these bytes for flops",
+            peak_bytes=est.peak_bytes, intermediate_bytes=inter_bytes,
+            candidates=[{"label": e["label"], "bytes": e["bytes"],
+                         "flops": e["flops"], "scope": e["scope"]}
+                        for e in cands])
+        f.scope = est.peak_scope
+        f.source = est.peak_source
+        return [f]
+
+
+# ---------------------------------------------------------------------------
+# planner cross-check (satellite: planner-drift)
+# ---------------------------------------------------------------------------
+def planner_drift_findings(tolerance: float = 0.15,
+                           stats=None) -> List[Finding]:
+    """Cross-check the auto_parallel planner's analytic byte model against
+    the liveness analyzer's exact per-arg accounting on a (CPU-sized) GPT
+    trainer step.  Components compared: parameter bytes and optimizer
+    moment bytes (the statically exact ones); drift beyond ``tolerance``
+    is a MEDIUM ``planner-drift`` finding.  ``stats`` overrides the
+    planner-side :class:`ModelStats` (tests)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ..distributed import env as dist_env
+    from ..distributed.auto_parallel.planner import ModelStats
+    from ..distributed.parallel_trainer import ParallelTrainer
+    from ..models.gpt import (
+        GPTForPretraining,
+        GPTPretrainingCriterion,
+        gpt_config,
+    )
+    from ..optimizer.optimizers import AdamW
+    from ..random import split_key
+
+    seq = 16
+    cfg = gpt_config("gpt2-small", vocab_size=64, hidden_size=32,
+                     num_layers=2, num_attention_heads=4,
+                     max_position_embeddings=32, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    prev = dist_env.get_mesh()
+    dist_env.init_mesh({"dp": 1})
+    try:
+        paddle.seed(0)
+        model = GPTForPretraining(cfg)
+        crit = GPTPretrainingCriterion(cfg)
+        trainer = ParallelTrainer(
+            model, lambda out, y: crit(out, y),
+            AdamW(learning_rate=1e-4, parameters=model.parameters()),
+            dp_axis=None)
+        trainer._build()
+        x = jnp.zeros((2, seq), jnp.int32)
+        args = (trainer.params, trainer.opt_state, trainer.buffers, x, x,
+                split_key(), trainer.scale_state, trainer.sentinel_state,
+                jnp.asarray(1e-4, jnp.float32))
+        target = AnalysisTarget("planner_drift_gpt", trainer._jit_step,
+                                args, tags=("train",),
+                                mesh_axes={"dp": 1})
+        target.jaxpr()
+    finally:
+        dist_env.set_mesh(prev)
+
+    # baseline = the liveness analyzer's per-arg accounting of the traced
+    # step (args: params, opt_state, buffers, x, y, key, ...)
+    est = memory_estimate(target)
+    measured_params = est.arg_bytes("args[0]")
+    measured_moments = est.arg_bytes("args[1]['slots']")
+    if not (measured_params and measured_moments):  # label scheme drifted
+        measured_params = sum(
+            int(a.nbytes) for a in trainer.params.values())
+        measured_moments = sum(
+            int(a.nbytes)
+            for a in jax.tree_util.tree_leaves(trainer.opt_state["slots"]))
+
+    if stats is None:
+        stats = ModelStats.from_gpt_config(cfg, seq_len=seq)
+    est_params = stats.n_params * stats.param_bytes
+    est_moments = 2 * stats.n_params * stats.moment_bytes
+
+    findings: List[Finding] = []
+    comps = (("params", est_params, measured_params),
+             ("moments", est_moments, measured_moments))
+    for name, planned, measured in comps:
+        drift = abs(planned - measured) / max(measured, 1)
+        if drift > tolerance:
+            findings.append(Finding(
+                rule="planner-drift", severity=Severity.MEDIUM,
+                entry_point="planner_drift_gpt",
+                message=(
+                    f"auto_parallel planner {name} estimate {planned} B "
+                    f"drifts {drift:.0%} from the liveness analyzer's "
+                    f"{measured} B (tolerance {tolerance:.0%}) — "
+                    "ModelStats' analytic param count no longer matches "
+                    "the model family"),
+                details={"component": name, "planner_bytes": planned,
+                         "measured_bytes": measured,
+                         "drift": round(drift, 4)}))
+    findings.append(Finding(
+        rule="planner-drift", severity=Severity.INFO,
+        entry_point="planner_drift_gpt",
+        message=(
+            "planner-vs-liveness cross-check: "
+            + ", ".join(f"{n} {p}B planned / {m}B measured "
+                        f"({abs(p - m) / max(m, 1):.1%} drift)"
+                        for n, p, m in comps)),
+        details={"tolerance": tolerance,
+                 "liveness_resident_bytes": est.resident_bytes,
+                 "liveness_peak_bytes": est.peak_bytes}))
+    return findings

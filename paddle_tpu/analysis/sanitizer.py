@@ -1,0 +1,397 @@
+"""NaN-attributing sanitizer: eqn-by-eqn jaxpr replay with finite checks.
+
+``FLAGS_check_nan_inf`` parity — the reference framework instruments every
+op output and aborts on the first nan/inf.  The r7 sentinel is the cheap
+in-graph half ("something went non-finite"); this module is the missing
+*where*: replay the step's jaxpr one eqn at a time, check every
+floating-point intermediate, and attribute the **first** offender to its
+producing eqn with the r6 profiler scope (``name_stack``) and Python
+traceback.
+
+Execution strategy (the "jitted per-eqn or chunked" requirement): each eqn
+is bound eagerly (one compiled XLA op per primitive — no tracing of the
+whole program), and the per-output ``isfinite().all()`` flags stay ON
+DEVICE; the host syncs them in chunks of ``check_every`` eqns, so the
+replay costs one blocking transfer per chunk instead of one per eqn.  On
+the first chunk containing a failure the replay stops and reports.
+
+Control flow is replayed structurally, so attribution descends INTO the
+region that actually ran:
+
+* ``pjit``   — inner jaxpr replayed eqn-by-eqn;
+* ``cond``   — the predicate is concrete, so only the taken branch runs;
+* ``scan``   — iterated manually; the report carries the iteration index;
+* ``while``  — iterated manually with the real predicate;
+* custom_vjp/jvp & friends — the call jaxpr is replayed when its signature
+  matches, else the eqn is bound whole (attribution stops at the call).
+
+``shard_map``/collectives are bound whole (their bodies need the mesh
+context to execute) and attributed at the eqn level.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .graph import _jcore, _name_stack_of, _source_of
+
+__all__ = [
+    "SanitizerConfig",
+    "NonFiniteReport",
+    "SanitizeResult",
+    "sanitize",
+    "sanitize_target",
+]
+
+
+@dataclasses.dataclass
+class SanitizerConfig:
+    """``check_inf=False`` restricts to NaN (inf-based masking schemes);
+    ``check_every`` is the device→host sync chunk; ``recurse=False`` stays
+    at the top scope (container eqns attributed whole)."""
+
+    check_inf: bool = True
+    check_every: int = 32
+    recurse: bool = True
+    max_while_iters: int = 100_000
+    # jnp.var/where-style guards materialize a literal nan/inf that a
+    # select immediately masks; the materializing eqn (literal operand) is
+    # skipped — a genuinely propagating NaN is still caught at its next
+    # consumer, whose operands are Vars.  strict=True checks everything.
+    skip_nonfinite_literals: bool = True
+
+
+@dataclasses.dataclass
+class NonFiniteReport:
+    """First non-finite intermediate, attributed to its producing eqn."""
+
+    eqn_index: int                 # flattened replay order
+    prim: str
+    path: Tuple[str, ...]          # enclosing control-flow labels
+    scope: str                     # r6 profiler name_stack (HLO metadata)
+    source: str                    # file:line (function)
+    out_slot: int
+    shape: Tuple[int, ...]
+    dtype: str
+    n_nonfinite: int
+    n_total: int
+    n_nan: int
+    iteration: Optional[int] = None   # scan/while iteration, if inside one
+
+    @property
+    def where(self) -> str:
+        return " @ ".join(x for x in (self.scope, self.source) if x)
+
+    def __str__(self):
+        it = f" (iteration {self.iteration})" if self.iteration is not None \
+            else ""
+        loc = f" [{self.where}]" if self.where else ""
+        return (f"first non-finite value produced by eqn #{self.eqn_index} "
+                f"'{self.prim}'{it}: {self.n_nonfinite}/{self.n_total} "
+                f"bad ({self.n_nan} NaN) in output {self.out_slot} "
+                f"{self.dtype}{list(self.shape)}{loc}")
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["path"] = list(self.path)
+        d["shape"] = list(self.shape)
+        d["where"] = self.where
+        return d
+
+
+@dataclasses.dataclass
+class SanitizeResult:
+    first: Optional[NonFiniteReport]
+    checked_eqns: int
+    checked_values: int
+    outputs: Any = None            # None when the replay stopped early
+
+    @property
+    def ok(self) -> bool:
+        return self.first is None
+
+    def to_dict(self) -> dict:
+        return {"ok": self.ok,
+                "checked_eqns": self.checked_eqns,
+                "checked_values": self.checked_values,
+                "first_nonfinite": (self.first.to_dict()
+                                    if self.first else None)}
+
+
+class _Stop(Exception):
+    """Internal: first offender located — unwind the replay."""
+
+
+class _State:
+    def __init__(self, config: SanitizerConfig):
+        self.config = config
+        self.eqn_counter = 0
+        self.checked_values = 0
+        self.pending: List[tuple] = []   # (flag, value, meta) in exec order
+        self.report: Optional[NonFiniteReport] = None
+
+    def check(self, eqn, outs, path, iteration):
+        import jax.numpy as jnp
+
+        idx = self.eqn_counter
+        self.eqn_counter += 1
+        for slot, o in enumerate(outs):
+            dtype = getattr(o, "dtype", None)
+            if dtype is None or not jnp.issubdtype(dtype, jnp.inexact):
+                continue
+            self.checked_values += 1
+            flag = (jnp.isfinite(o).all() if self.config.check_inf
+                    else ~jnp.isnan(o).any())
+            meta = (idx, eqn.primitive.name, path, _name_stack_of(eqn),
+                    _source_of(eqn), slot, tuple(np.shape(o)), str(dtype),
+                    iteration)
+            self.pending.append((flag, o, meta))
+        if len(self.pending) >= self.config.check_every:
+            self.flush()
+
+    def flush(self):
+        if not self.pending:
+            return
+        import jax.numpy as jnp
+
+        flags = np.asarray(jnp.stack([f for f, _, _ in self.pending]))
+        pending, self.pending = self.pending, []
+        for ok, (_, value, meta) in zip(flags, pending):
+            if ok:
+                continue
+            (idx, prim, path, scope, source, slot, shape, dtype,
+             iteration) = meta
+            if value.dtype != bool:
+                asf = np.asarray(value, np.float64)
+                nan = np.isnan(asf)
+                # nan-only mode: intentional infs must not inflate the
+                # bad-value count the report attributes
+                bad = (~np.isfinite(asf) if self.config.check_inf
+                       else nan)
+            else:
+                bad = nan = np.zeros(1, bool)
+            self.report = NonFiniteReport(
+                eqn_index=idx, prim=prim, path=path, scope=scope,
+                source=source, out_slot=slot, shape=shape, dtype=dtype,
+                n_nonfinite=int(bad.sum()), n_total=int(np.size(value)),
+                n_nan=int(nan.sum()), iteration=iteration)
+            raise _Stop()
+
+
+def _as_list(ans, eqn):
+    return list(ans) if eqn.primitive.multiple_results else [ans]
+
+
+def _bind_whole(eqn, invals):
+    """Execute one eqn as a unit — with donation STRIPPED: a pjit eqn's
+    ``donated_invars`` would otherwise delete the caller's live arrays
+    (e.g. the training state ``sanitize_step`` promises to leave intact)."""
+    subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
+    donated = bind_params.get("donated_invars")
+    if donated is not None and any(donated):
+        bind_params = dict(bind_params,
+                           donated_invars=(False,) * len(donated))
+    ans = eqn.primitive.bind(*subfuns, *invals, **bind_params)
+    return _as_list(ans, eqn)
+
+
+def _nonfinite_literal(val) -> bool:
+    try:
+        import jax.numpy as jnp
+
+        arr = np.asarray(val)
+        # jnp.issubdtype, not np: bfloat16/float16 literals (the bf16
+        # -inf attention-mask idiom) are ml_dtypes, invisible to
+        # np.issubdtype(..., np.floating)
+        if not jnp.issubdtype(arr.dtype, jnp.inexact):
+            return False
+        if not np.issubdtype(arr.dtype, np.complexfloating):
+            arr = arr.astype(np.float64)
+        return bool(np.any(~np.isfinite(arr)))
+    except Exception:
+        return False
+
+
+def _closed_parts(sub):
+    if hasattr(sub, "jaxpr"):
+        return sub.jaxpr, list(sub.consts)
+    return sub, []
+
+
+def _replay(jaxpr, consts, args, state: _State, path, iteration=None):
+    cfg = state.config
+    env = {}
+
+    def read(v):
+        return v.val if isinstance(v, _jcore.Literal) else env[v]
+
+    def write(vs, vals):
+        for v, val in zip(vs, vals):
+            env[v] = val
+
+    write(jaxpr.constvars, consts)
+    write(jaxpr.invars, args)
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        invals = [read(v) for v in eqn.invars]
+        outs = None
+        if cfg.skip_nonfinite_literals and any(
+                isinstance(v, _jcore.Literal) and _nonfinite_literal(v.val)
+                for v in eqn.invars):
+            state.eqn_counter += 1
+            write(eqn.outvars, _bind_whole(eqn, invals))
+            continue
+        if cfg.recurse:
+            try:
+                outs = _replay_structured(eqn, prim, invals, state, path,
+                                          iteration)
+            except _Stop:
+                raise
+            except Exception:
+                # fall back to binding the eqn whole.  First drain the
+                # partial descent's pending flags: those values really
+                # were computed, so a bad one must be reported with ITS
+                # meta (a flush may already have run mid-descent, so
+                # rolling indices back would misattribute whatever was
+                # queued after it).
+                state.flush()
+                outs = None
+        if outs is None:
+            outs = _bind_whole(eqn, invals)
+            state.check(eqn, outs, path, iteration)
+        write(eqn.outvars, outs)
+    return [read(v) for v in jaxpr.outvars]
+
+
+def _replay_structured(eqn, prim, invals, state, path, iteration):
+    """Descend into the control flow that actually executes; returns None
+    when the eqn should be bound whole instead."""
+    import jax.numpy as jnp
+
+    params = eqn.params
+    if prim == "pjit":
+        inner, iconsts = _closed_parts(params["jaxpr"])
+        name = params.get("name", "")
+        return _replay(inner, iconsts, invals, state,
+                       path + (f"pjit:{name}",), iteration)
+
+    if prim == "cond":
+        idx = int(np.clip(int(np.asarray(invals[0])), 0,
+                          len(params["branches"]) - 1))
+        inner, iconsts = _closed_parts(params["branches"][idx])
+        state.eqn_counter += 1     # the cond eqn itself
+        return _replay(inner, iconsts, invals[1:], state,
+                       path + (f"cond.branch{idx}",), iteration)
+
+    if prim == "scan":
+        nc = params.get("num_consts", 0)
+        nk = params.get("num_carry", 0)
+        length = int(params.get("length", 0))
+        reverse = bool(params.get("reverse", False))
+        inner, iconsts = _closed_parts(params["jaxpr"])
+        consts_in = invals[:nc]
+        carry = list(invals[nc:nc + nk])
+        xs = invals[nc + nk:]
+        ys_acc: List[List[Any]] = None
+        state.eqn_counter += 1     # the scan eqn itself
+        order = range(length - 1, -1, -1) if reverse else range(length)
+        for t in order:
+            sliced = [x[t] for x in xs]
+            outs = _replay(inner, iconsts, consts_in + carry + sliced,
+                           state, path + ("scan",), iteration=t)
+            carry = list(outs[:nk])
+            ys = outs[nk:]
+            if ys_acc is None:
+                ys_acc = [[] for _ in ys]
+            for acc, y in zip(ys_acc, ys):
+                acc.append(y)
+        if ys_acc is None:
+            ys_acc = [[] for _ in range(len(eqn.outvars) - nk)]
+        stacked = []
+        for j, acc in enumerate(ys_acc):
+            if reverse:
+                acc = acc[::-1]
+            if acc:
+                stacked.append(jnp.stack(acc))
+            else:  # zero-length scan: shape the empty ys from the outvar
+                ov = eqn.outvars[nk + j].aval
+                stacked.append(jnp.zeros(ov.shape, ov.dtype))
+        return carry + stacked
+
+    if prim == "while":
+        cn = params.get("cond_nconsts", 0)
+        bn = params.get("body_nconsts", 0)
+        cond_j, cond_c = _closed_parts(params["cond_jaxpr"])
+        body_j, body_c = _closed_parts(params["body_jaxpr"])
+        cond_consts = invals[:cn]
+        body_consts = invals[cn:cn + bn]
+        carry = list(invals[cn + bn:])
+        state.eqn_counter += 1     # the while eqn itself
+        it = 0
+        while True:
+            pred = _replay(cond_j, cond_c, cond_consts + carry, state,
+                           path + ("while.cond",), iteration=it)[0]
+            if not bool(np.asarray(pred)):
+                break
+            carry = list(_replay(body_j, body_c, body_consts + carry,
+                                 state, path + ("while.body",),
+                                 iteration=it))
+            it += 1
+            if it >= state.config.max_while_iters:
+                raise RuntimeError(
+                    f"sanitizer: while loop exceeded "
+                    f"{state.config.max_while_iters} iterations")
+        return carry
+
+    # custom_vjp/jvp, remat, closed_call, ...: replay a sub-jaxpr whose
+    # signature matches the eqn (primal path), else bind whole
+    if prim != "shard_map":
+        for key in ("call_jaxpr", "fun_jaxpr", "jaxpr"):
+            sub = params.get(key)
+            if sub is None:
+                continue
+            inner, iconsts = _closed_parts(sub)
+            if (len(inner.invars) == len(invals)
+                    and len(inner.outvars) == len(eqn.outvars)):
+                state.eqn_counter += 1
+                return _replay(inner, iconsts, invals, state,
+                               path + (f"{prim}",), iteration)
+    return None
+
+
+def sanitize(fn, args: Sequence = (), kwargs: Optional[dict] = None,
+             config: Optional[SanitizerConfig] = None,
+             closed_jaxpr=None) -> SanitizeResult:
+    """Replay ``fn(*args, **kwargs)`` eqn-by-eqn and report the first
+    non-finite intermediate (or ``ok``).  ``closed_jaxpr`` skips the
+    re-trace when the caller already has one for these args."""
+    import jax
+
+    config = config or SanitizerConfig()
+    kwargs = kwargs or {}
+    closed = (closed_jaxpr if closed_jaxpr is not None
+              else jax.make_jaxpr(fn)(*args, **kwargs))
+    flat_args = [a._data if hasattr(a, "_data") else a
+                 for a in jax.tree_util.tree_leaves((tuple(args), kwargs))]
+    state = _State(config)
+    outputs = None
+    try:
+        outputs = _replay(closed.jaxpr, list(closed.consts), flat_args,
+                          state, ())
+        state.flush()
+    except _Stop:
+        pass
+    return SanitizeResult(first=state.report,
+                          checked_eqns=state.eqn_counter,
+                          checked_values=state.checked_values,
+                          outputs=outputs if state.report is None else None)
+
+
+def sanitize_target(target, config: Optional[SanitizerConfig] = None
+                    ) -> SanitizeResult:
+    """Replay an :class:`AnalysisTarget` with its example args."""
+    return sanitize(target.fn, target.args, target.kwargs, config=config,
+                    closed_jaxpr=target.jaxpr())

@@ -23,7 +23,30 @@ import dataclasses
 import math
 from typing import List, Optional
 
-__all__ = ["ModelStats", "Plan", "Candidate", "plan_strategy"]
+__all__ = ["ModelStats", "Plan", "Candidate", "plan_strategy",
+           "GRAD_FACTOR_ALIASED", "GRAD_FACTOR_HELD",
+           "ACT_BYTES_PER_ELEMENT_LAYER", "OVERLAP_TAX",
+           "ALLREDUCE_RING_FACTOR"]
+
+# ---------------------------------------------------------------------------
+# calibrated model constants — exposed by name so the analysis layer's
+# planner-drift cross-check (analysis/memory.planner_drift_findings, r10)
+# and future re-calibrations reference ONE definition:
+#
+#: grad bytes as a fraction of param bytes when the jitted step's donated
+#: buffers + fused update alias the grad storage (ADVICE r5 #2)
+GRAD_FACTOR_ALIASED = 0.5
+#: ... and when a separate accumulator survives the step (gradient
+#: accumulation / pipeline microbatching / non-fused optimizers)
+GRAD_FACTOR_HELD = 1.0
+#: live activation bytes per element per layer at bf16 — bounded by the
+#: 760m-b8-no-remat config FITTING (≤ 10.5); XLA fusion keeps fewer live
+#: intermediates than the naive 18/element transformer count
+ACT_BYTES_PER_ELEMENT_LAYER = 10
+#: fraction of comm time NOT hidden under compute (imperfect overlap)
+OVERLAP_TAX = 0.2
+#: ring allreduce moves ~2x the payload across the slowest link
+ALLREDUCE_RING_FACTOR = 2
 
 
 @dataclasses.dataclass
@@ -141,7 +164,9 @@ def plan_strategy(stats: ModelStats, n_devices: int, global_batch: int,
                         c = _score(stats, n, dp, mp, pp, zero, m, recompute,
                                    global_batch, hbm_bytes, peak_flops,
                                    ici_bytes_per_s, mfu_guess,
-                                   grad_factor=0.5 if aliased else 1.0)
+                                   grad_factor=(GRAD_FACTOR_ALIASED
+                                                if aliased
+                                                else GRAD_FACTOR_HELD))
                         if c.mem_bytes <= hbm_bytes:
                             cands.append(c)
                         else:
@@ -159,7 +184,8 @@ def plan_strategy(stats: ModelStats, n_devices: int, global_batch: int,
 
 
 def _score(stats, n, dp, mp, pp, zero, m, recompute, global_batch,
-           hbm_bytes, peak_flops, ici_bw, mfu_guess, grad_factor=0.5):
+           hbm_bytes, peak_flops, ici_bw, mfu_guess,
+           grad_factor=GRAD_FACTOR_ALIASED):
     shard = mp * pp           # param split over model axes
     b_local = global_batch // dp
     b_micro = b_local // m
@@ -186,7 +212,8 @@ def _score(stats, n, dp, mp, pp, zero, m, recompute, global_batch,
         params /= dp
     grads = grad_factor * p_shard * stats.param_bytes / (dp if zero >= 2 else 1)
     moments = 2 * p_shard * stats.moment_bytes / (dp if zero >= 1 else 1)
-    act_per_layer = 10 * b_micro * t * (h / mp) * stats.act_bytes
+    act_per_layer = (ACT_BYTES_PER_ELEMENT_LAYER * b_micro * t * (h / mp)
+                     * stats.act_bytes)
     live_layers = 2 if recompute else layers_local
     acts = act_per_layer * live_layers * (1 if pp == 1 else min(m, pp))
     mem = params + grads + moments + acts
@@ -197,12 +224,14 @@ def _score(stats, n, dp, mp, pp, zero, m, recompute, global_batch,
     compute = flops / (peak_flops * mfu_guess)
     bubble = (pp - 1) / (m + pp - 1) if pp > 1 else 0.0
     compute = compute / (1 - bubble) if bubble < 1 else float("inf")
-    dp_comm = (2 * p_shard * stats.param_bytes / ici_bw) if dp > 1 else 0.0
+    dp_comm = (ALLREDUCE_RING_FACTOR * p_shard * stats.param_bytes
+               / ici_bw) if dp > 1 else 0.0
     mp_comm = (4 * layers_local * m * b_micro * t * (h / 1) * stats.act_bytes
                / ici_bw) if mp > 1 else 0.0
-    zero3_comm = (2 * p_shard * stats.param_bytes / ici_bw) if zero >= 3 else 0.0
+    zero3_comm = (ALLREDUCE_RING_FACTOR * p_shard * stats.param_bytes
+                  / ici_bw) if zero >= 3 else 0.0
     step = max(compute, dp_comm + mp_comm + zero3_comm) \
-        + 0.2 * (dp_comm + mp_comm + zero3_comm)  # imperfect overlap tax
+        + OVERLAP_TAX * (dp_comm + mp_comm + zero3_comm)
     return Candidate(
         dp=dp, mp=mp, pp=pp, zero_stage=zero, microbatches=m,
         recompute=recompute, mem_bytes=mem, step_time_s=step,

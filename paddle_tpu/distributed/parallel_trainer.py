@@ -461,9 +461,13 @@ class ParallelTrainer:
         # compiled step (read at trace time it would be baked as a constant)
         lr_now = jnp.asarray(float(self.optimizer.get_lr()), jnp.float32)
         t0 = time.perf_counter() if timers_enabled() else None
+        # the key is kept so sanitize_step can replay THIS step faithfully
+        # (a fresh key would draw different dropout masks); the key arg is
+        # not donated, so the array stays readable after the step
+        self.last_step_key = key = split_key()
         (self.params, self.opt_state, self.buffers, loss, self.scale_state,
          self.sentinel_state) = self._jit_step(
-            self.params, self.opt_state, self.buffers, xb, yb, split_key(),
+            self.params, self.opt_state, self.buffers, xb, yb, key,
             self.scale_state, self.sentinel_state, lr_now,
         )
         if t0 is not None:
@@ -535,6 +539,51 @@ class ParallelTrainer:
             self._scaler._bad_steps = int(self.scale_state["bad_steps"])
 
     # -- resilience hooks ----------------------------------------------
+    def sanitize_step(self, x, y, *, state=None, key=None, config=None):
+        """Replay ONE train step eqn-by-eqn under the analysis sanitizer
+        and return its :class:`~paddle_tpu.analysis.sanitizer.SanitizeResult`
+        — the ``FLAGS_check_nan_inf`` "which eqn made the NaN" answer the
+        in-graph sentinel cannot give.
+
+        ``state`` is an optional :meth:`capture_state` snapshot (replay the
+        *failing* step from just before it ran); default is the live state.
+        ``key`` defaults to the LAST step()'s RNG key, so a stochastic
+        model (dropout) replays the failing step's exact masks.  The
+        replay binds each primitive eagerly with donation stripped, so the
+        live training state is untouched."""
+        from ..analysis.sanitizer import sanitize
+        from ..random import split_key
+
+        if self.offload:
+            raise NotImplementedError(
+                "sanitize_step with offload_optimizer is not composed yet")
+        if self._jit_step is None:
+            self._build()
+        if state is not None:
+            params = {n: jnp.asarray(a) for n, a in state["params"].items()}
+            opt_state = jax.tree_util.tree_map(jnp.asarray,
+                                               state["opt_state"])
+            buffers = {n: jnp.asarray(a)
+                       for n, a in state["buffers"].items()}
+            scale = {k: jnp.asarray(v)
+                     for k, v in state.get("scale_state", {}).items()}
+            sent = {k: jnp.asarray(v)
+                    for k, v in state.get("sentinel_state", {}).items()}
+        else:
+            params, opt_state, buffers = (self.params, self.opt_state,
+                                          self.buffers)
+            scale, sent = self.scale_state, self.sentinel_state
+        xb = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        yb = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        lr_now = jnp.asarray(float(self.optimizer.get_lr()), jnp.float32)
+        if key is None:
+            key = getattr(self, "last_step_key", None)
+        if key is None:
+            key = split_key()
+        args = (params, opt_state, buffers, xb, yb, key, scale, sent,
+                lr_now)
+        return sanitize(self._jit_step, args, config=config)
+
     def sentinel_report(self):
         """Host copy of the sentinel statistics ({} when disabled)."""
         if not self.sentinel_state:

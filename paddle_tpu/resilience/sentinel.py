@@ -174,11 +174,16 @@ class AnomalyHalt(RuntimeError):
     parity — but AFTER the in-graph skip kept the params clean)."""
 
     def __init__(self, report: Dict[str, float]):
-        super().__init__(
-            f"anomaly sentinel halt: {report['anomaly_count']} anomalous "
-            f"step(s), last code {report['last_code']} "
-            f"(1=non-finite, 2=loss spike)")
+        msg = (f"anomaly sentinel halt: {report['anomaly_count']} anomalous "
+               f"step(s), last code {report['last_code']} "
+               f"(1=non-finite, 2=loss spike)")
+        san = report.get("sanitizer")
+        if isinstance(san, dict) and san.get("first_nonfinite"):
+            first = san["first_nonfinite"]
+            msg += (f"; sanitizer: first non-finite at "
+                    f"'{first.get('prim')}' {first.get('where', '')}")
         self.report = report
+        super().__init__(msg)
 
 
 class SentinelMonitor:
@@ -189,16 +194,28 @@ class SentinelMonitor:
     the anomalous step itself — the host reaction can lag). ``restore_fn``
     is the rollback hook (e.g. reload the newest intact snapshot into the
     trainer); after it runs the monitor re-bases its counter so the restored
-    (older) anomaly_count is not itself treated as a new anomaly."""
+    (older) anomaly_count is not itself treated as a new anomaly.
+
+    ``sanitize_fn`` (off by default) is the bridge to the analysis
+    sanitizer: a zero-arg callable that replays the captured failing step
+    eqn-by-eqn (e.g. ``lambda: trainer.sanitize_step(x, y).to_dict()``) —
+    the sentinel knows *something* went non-finite, the sanitizer answers
+    *which eqn*.  Its result lands in the monitor's report under
+    ``"sanitizer"`` (and in :class:`AnomalyHalt`'s message) on every
+    anomaly reaction; failures are contained (the policy action must never
+    be lost to a broken replay)."""
 
     def __init__(self, config: SentinelConfig,
                  restore_fn: Optional[Callable[[], None]] = None,
-                 poll_every: int = 1):
+                 poll_every: int = 1,
+                 sanitize_fn: Optional[Callable[[], Dict]] = None):
         if config.policy == "rollback" and restore_fn is None:
             raise ValueError("policy='rollback' needs a restore_fn")
         self.config = config
         self.restore_fn = restore_fn
         self.poll_every = max(int(poll_every), 1)
+        self.sanitize_fn = sanitize_fn
+        self.last_sanitize: Optional[Dict] = None
         self._calls = 0
         self._seen_anomalies: Optional[int] = 0
 
@@ -222,6 +239,13 @@ class SentinelMonitor:
         if host["anomaly_count"] == self._seen_anomalies:
             return None
         self._seen_anomalies = host["anomaly_count"]
+        if self.sanitize_fn is not None:
+            try:
+                self.last_sanitize = self.sanitize_fn()
+            except Exception as e:  # the policy action must still happen
+                self.last_sanitize = {
+                    "error": f"{type(e).__name__}: {e}"}
+            host["sanitizer"] = self.last_sanitize
         if self.config.policy == "halt":
             raise AnomalyHalt(host)
         if self.config.policy == "rollback":

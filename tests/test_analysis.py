@@ -641,7 +641,8 @@ class TestShippedEntryPoints:
 
         report = analyze_targets([static_program_target()])
         d = report.to_dict()
-        assert set(d) == {"meta", "counts", "findings"}
+        assert set(d) == {"schema_version", "meta", "counts", "findings"}
+        assert d["schema_version"] >= 2    # r10: versioned report layout
         assert "static_program" in d["meta"]["timings_s"]
         p = tmp_path / "report.json"
         report.save(str(p))

@@ -1,0 +1,566 @@
+"""Quantitative graph doctor (ISSUE 5): cost model, liveness/peak-HBM
+estimator, memory rules, planner cross-check, and the NaN-attributing
+sanitizer interpreter.
+
+Cost/liveness tests hand-compute the documented conventions on minimal
+jaxprs (dot chain, donated update, scan carry, shard_map-sharded sizes);
+the sanitizer tests assert exact first-offender attribution (eqn + r6
+profiler scope); the estimator-vs-measured test enforces the 15%
+acceptance bound against a real (CPU) trainer step.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis as an
+from paddle_tpu.analysis import (
+    AnalysisTarget,
+    LowIntensityDotRule,
+    MemoryBudgetRule,
+    RematAdvisorRule,
+    SanitizerConfig,
+    Severity,
+    estimate_memory,
+    graph_cost,
+    planner_drift_findings,
+    sanitize,
+)
+
+
+def _sev(findings, severity):
+    return [f for f in findings if f.severity == severity]
+
+
+def _mesh(n, axes=("x",)):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} virtual devices")
+    shape = (n,) if len(axes) == 1 else None
+    return Mesh(np.array(jax.devices()[:n]).reshape(shape), axes)
+
+
+# ---------------------------------------------------------------------------
+# cost model
+# ---------------------------------------------------------------------------
+class TestCostModel:
+    def test_dot_chain_exact_flops_and_bytes(self):
+        def f(x, w1, w2):
+            return (x @ w1) @ w2
+
+        t = AnalysisTarget("t", f, (jnp.ones((4, 8), jnp.float32),
+                                    jnp.ones((8, 16), jnp.float32),
+                                    jnp.ones((16, 2), jnp.float32)))
+        gc = graph_cost(t.graph())
+        # dot1 = 2*4*16*8 = 1024, dot2 = 2*4*2*16 = 256
+        assert gc.flops == 1024 + 256
+        # dot1: in (4*8 + 8*16)*4 = 640, out 4*16*4 = 256
+        # dot2: in (4*16 + 16*2)*4 = 384, out 4*2*4  = 32
+        assert gc.bytes_accessed == 640 + 256 + 384 + 32
+        assert gc.unknown == {} and not gc.estimated
+
+    def test_elementwise_transcendental_reduction(self):
+        from paddle_tpu.analysis.cost import TRANSCENDENTAL_FLOPS
+
+        def f(x):
+            return jnp.tanh(x * x).sum()
+
+        t = AnalysisTarget("t", f, (jnp.ones((4, 8), jnp.float32),))
+        gc = graph_cost(t.graph())
+        # mul 32 + tanh 8*32 + reduce_sum 32 (per input element)
+        assert gc.flops == 32 + TRANSCENDENTAL_FLOPS * 32 + 32
+
+    def test_scan_body_multiplied_by_trip_count(self):
+        def f(c, xs):
+            return lax.scan(lambda c, x: (c * x, ()), c, xs)[0]
+
+        t = AnalysisTarget("t", f, (jnp.ones(4), jnp.ones((5, 4))))
+        gc = graph_cost(t.graph())
+        assert gc.flops == 5 * 4            # one mul of 4 elems, 5 iters
+
+    def test_collective_comm_bytes_from_mesh_axes(self):
+        from paddle_tpu.distributed.spmd import shard_map
+
+        mesh = _mesh(4)
+        sm = shard_map(lambda a: lax.psum(a, "x"), mesh=mesh,
+                       in_specs=P("x"), out_specs=P())
+        t = AnalysisTarget("t", sm, (jnp.ones(8, jnp.float32),),
+                           mesh_axes={"x": 4})
+        gc = graph_cost(t.graph(), t.mesh_axes)
+        # per-shard payload 2 f32 = 8 B; ring allreduce 2*(4-1)/4 * 8 = 12
+        assert gc.comm_bytes == pytest.approx(12.0)
+
+    def test_unknown_prim_reported_never_zero_costed(self):
+        def f(x):
+            return lax.sort(x)
+
+        t = AnalysisTarget("t", f, (jnp.ones(16, jnp.float32),))
+        gc = graph_cost(t.graph())
+        assert "sort" in gc.unknown and gc.estimated
+        # fallback still carries the bytes moved
+        assert gc.bytes_accessed >= 2 * 16 * 4
+
+    def test_intensity_classification(self):
+        from paddle_tpu.analysis.cost import classify_intensity, cost_eqn
+
+        c = cost_eqn("dot_general",
+                      (((512, 512), "float32", False),
+                       ((512, 512), "float32", False)),
+                      (((512, 512), "float32", False),),
+                      {"dimension_numbers": (((1,), (0,)), ((), ()))})
+        assert c.flops == 2 * 512 ** 3
+        assert classify_intensity(c.intensity, ridge=80.0) == "compute-bound"
+        assert classify_intensity(c.intensity, ridge=240.0) == "memory-bound"
+
+
+# ---------------------------------------------------------------------------
+# liveness / peak HBM
+# ---------------------------------------------------------------------------
+class TestLiveness:
+    def test_dot_chain_peak_exact(self):
+        def f(x, w1, w2):
+            return (x @ w1) @ w2
+
+        t = AnalysisTarget("t", f, (jnp.ones((4, 8), jnp.float32),
+                                    jnp.ones((8, 16), jnp.float32),
+                                    jnp.ones((16, 2), jnp.float32)))
+        est = estimate_memory(t)
+        args = (4 * 8 + 8 * 16 + 16 * 2) * 4        # 768, held throughout
+        # peak at dot2: args + h1 (4*16*4=256) + out (4*2*4=32)
+        assert est.args_bytes == args
+        assert est.peak_bytes == args + 256 + 32
+        assert est.resident_bytes == args + 32      # args + out, no consts
+        assert est.peak_prim == "dot_general"
+
+    def test_donated_update_aliases_output(self):
+        s = jnp.zeros((1024,), jnp.float32)         # 4096 B
+        plain = estimate_memory(AnalysisTarget(
+            "t", jax.jit(lambda st, x: (st + x, x.sum())), (s, s)))
+        donated = estimate_memory(AnalysisTarget(
+            "t", jax.jit(lambda st, x: (st + x, x.sum()),
+                         donate_argnums=(0,)), (s, s)))
+        assert donated.donated_bytes == 4096
+        # non-donated: both input copies + new state + loss stay resident
+        assert plain.resident_bytes == 2 * 4096 + 4096 + 4
+        # donated: the new state reuses the donated buffer
+        assert donated.resident_bytes == 2 * 4096 + 4
+        assert donated.peak_bytes < plain.peak_bytes
+
+    def test_intended_donation_override(self):
+        """donate_argnums metadata models the TPU deployment even when the
+        live jit gated donation off (serving on CPU)."""
+        s = jnp.zeros((1024,), jnp.float32)
+        f = jax.jit(lambda st, x: (st + x, x.sum()))    # no actual donation
+        est = estimate_memory(AnalysisTarget("t", f, (s, s),
+                                             donate_argnums=(0,)))
+        assert est.donated_bytes == 4096
+        assert est.resident_bytes == 2 * 4096 + 4
+
+    def test_scan_carry_and_accumulator(self):
+        def f(c, xs):
+            def body(c, x):
+                c = c + x
+                return c, c * 2
+
+            return lax.scan(body, c, xs)
+
+        t = AnalysisTarget("t", f, (jnp.zeros(4, jnp.float32),
+                                    jnp.ones((8, 4), jnp.float32)))
+        est = estimate_memory(t)
+        # args 16+128; outs (final carry 16 + stacked ys 128) allocated up
+        # front; body peak adds carry-passthrough(16)+x-slice(16)+c1(16)
+        # while ambient holds args+outs minus the carry passthrough
+        assert est.args_bytes == 144
+        assert est.peak_bytes == (144 + 144) - 16 + (16 + 16 + 16) + 16
+        assert est.out_bytes == 144
+
+    def test_shard_map_uses_per_shard_sizes(self):
+        from paddle_tpu.distributed.spmd import shard_map
+
+        mesh = _mesh(2)
+        sm = shard_map(lambda a: a * 2, mesh=mesh, in_specs=P("x"),
+                       out_specs=P("x"))
+        est = estimate_memory(AnalysisTarget(
+            "t", sm, (jnp.ones(8, jnp.float32),), mesh_axes={"x": 2}))
+        # 8 f32 sharded over x=2 -> 16 B per device, inputs AND outputs
+        assert est.args_bytes == 16
+        assert est.out_bytes == 16
+        assert est.sharded
+        assert est.peak_bytes < 8 * 4 * 2       # well under the global view
+
+    def test_sharded_pjit_entry_divides_arg_bytes(self):
+        from jax.sharding import NamedSharding
+
+        mesh = _mesh(2)
+        sh = NamedSharding(mesh, P("x"))
+        f = jax.jit(lambda x: x * 2, in_shardings=(sh,), out_shardings=sh)
+        est = estimate_memory(AnalysisTarget(
+            "t", f, (jax.device_put(jnp.ones(8, jnp.float32), sh),)))
+        assert est.args_bytes == 16
+        assert est.out_bytes == 16
+
+    def test_consts_counted_resident(self):
+        W = jnp.zeros((256, 256), jnp.float32)      # 256 KiB closure const
+        est = estimate_memory(AnalysisTarget(
+            "t", jax.jit(lambda x: x @ W), (jnp.ones((4, 256)),)))
+        assert est.consts_bytes == 256 * 256 * 4
+        assert est.resident_bytes >= est.consts_bytes
+
+    def test_timeline_and_peak_site_attribution(self):
+        from paddle_tpu.profiler.scope import scope
+
+        def f(x):
+            with scope("model.ffn"):
+                h = x @ x
+                return h.sum()
+
+        est = estimate_memory(AnalysisTarget(
+            "t", f, (jnp.ones((64, 64), jnp.float32),)))
+        assert est.timeline and est.peak_bytes >= est.args_bytes
+        assert "model.ffn" in est.peak_scope
+
+
+# ---------------------------------------------------------------------------
+# memory rules: trigger + clean pairs
+# ---------------------------------------------------------------------------
+class TestMemoryRules:
+    def _dot_chain(self):
+        def f(x, w1, w2):
+            return (x @ w1) @ w2
+
+        return AnalysisTarget("t", f, (jnp.ones((4, 8), jnp.float32),
+                                       jnp.ones((8, 16), jnp.float32),
+                                       jnp.ones((16, 2), jnp.float32)))
+
+    def test_oom_risk_trigger_and_clean(self):
+        t = self._dot_chain()                       # peak 1056 B
+        fs = an.run_rules(t, [MemoryBudgetRule(budget_bytes=1000)])
+        assert _sev(fs, Severity.HIGH), fs
+        assert fs[0].details["peak_bytes"] == 1056
+        t2 = self._dot_chain()
+        assert an.run_rules(t2, [MemoryBudgetRule(budget_bytes=1 << 20)]) == []
+
+    def test_oom_risk_headroom_medium(self):
+        t = self._dot_chain()
+        fs = an.run_rules(t, [MemoryBudgetRule(budget_bytes=1100)])
+        assert _sev(fs, Severity.MEDIUM) and not _sev(fs, Severity.HIGH)
+
+    def test_low_intensity_dot_trigger_and_clean(self):
+        # GEMV: 2*4096*4096 flops over a 64 MiB weight read -> ~0.5 f/B
+        gemv = AnalysisTarget(
+            "t", lambda x, w: x @ w,
+            (jnp.ones((1, 4096), jnp.float32),
+             jnp.ones((4096, 4096), jnp.float32)))
+        fs = an.run_rules(gemv, [LowIntensityDotRule()])
+        assert _sev(fs, Severity.MEDIUM), fs
+        assert fs[0].details["intensity"] < 1.0
+        # square 512 matmul: ~85 f/B, compute-bound -> clean
+        sq = AnalysisTarget(
+            "t", lambda x, w: x @ w,
+            (jnp.ones((512, 512), jnp.float32),
+             jnp.ones((512, 512), jnp.float32)))
+        assert an.run_rules(sq, [LowIntensityDotRule()]) == []
+
+    def test_remat_advisor_trigger_and_clean(self):
+        def f(x):
+            a = jnp.tanh(x)         # cheap-to-recompute, live at the peak
+            b = x * 2.0
+            return (a * b).sum()
+
+        t = AnalysisTarget("t", f, (jnp.ones((256, 256), jnp.float32),))
+        fs = an.run_rules(t, [RematAdvisorRule(min_bytes=1024)])
+        assert fs and fs[0].rule == "remat-advisor"
+        assert fs[0].details["candidates"]
+        # same program, default 1 MiB floor: too small to advise on
+        t2 = AnalysisTarget("t", f, (jnp.ones((8, 8), jnp.float32),))
+        assert an.run_rules(t2, [RematAdvisorRule()]) == []
+
+    def test_remat_advisor_escalates_over_budget(self):
+        def f(x):
+            return (jnp.tanh(x) * (x * 2.0)).sum()
+
+        t = AnalysisTarget("t", f, (jnp.ones((256, 256), jnp.float32),))
+        fs = an.run_rules(t, [RematAdvisorRule(min_bytes=1024,
+                                               budget_bytes=1024)])
+        assert _sev(fs, Severity.MEDIUM), fs
+
+
+# ---------------------------------------------------------------------------
+# planner cross-check (satellite)
+# ---------------------------------------------------------------------------
+class TestPlannerDrift:
+    def test_gpt_config_within_tolerance(self):
+        fs = planner_drift_findings()
+        assert _sev(fs, Severity.MEDIUM) == [], fs
+        info = _sev(fs, Severity.INFO)
+        assert info and "params" in info[0].message
+
+    def test_drifting_stats_flagged_medium(self):
+        from paddle_tpu.distributed.auto_parallel.planner import ModelStats
+
+        bad = ModelStats(n_params=1000, n_layers=2, hidden=32, seq_len=16)
+        fs = planner_drift_findings(stats=bad)
+        meds = _sev(fs, Severity.MEDIUM)
+        assert meds and meds[0].rule == "planner-drift"
+        assert meds[0].details["component"] == "params"
+
+
+# ---------------------------------------------------------------------------
+# sanitizer: first-NaN attribution
+# ---------------------------------------------------------------------------
+class TestSanitizer:
+    def _nan_net(self):
+        from paddle_tpu.profiler.scope import scope
+
+        def f(x, w):
+            h = x @ w
+            with scope("model.blk2"):
+                h = jnp.log(h - 10.0)       # negative under zeros -> NaN
+            return (h @ w).sum()
+
+        return f
+
+    def test_first_nan_exact_eqn_and_scope(self):
+        r = sanitize(self._nan_net(),
+                     (jnp.ones((2, 4), jnp.float32),
+                      jnp.ones((4, 4), jnp.float32)))
+        assert not r.ok
+        assert r.first.prim == "log"              # the producer, not users
+        assert "model.blk2" in r.first.scope
+        assert "test_analysis_quant" in r.first.source
+        assert r.first.n_nan == r.first.n_nonfinite == 8
+
+    def test_clean_run_returns_outputs(self):
+        f = self._nan_net()
+        args = (jnp.full((2, 4), 10.0, jnp.float32),
+                jnp.ones((4, 4), jnp.float32))
+        r = sanitize(f, args)
+        assert r.ok and r.checked_values > 0
+        np.testing.assert_allclose(np.asarray(r.outputs[0]),
+                                   np.asarray(f(*args)), rtol=1e-6)
+
+    def test_pjit_recursion_preserves_attribution(self):
+        r = sanitize(jax.jit(self._nan_net()),
+                     (jnp.ones((2, 4), jnp.float32),
+                      jnp.ones((4, 4), jnp.float32)))
+        assert r.first.prim == "log" and "model.blk2" in r.first.scope
+        assert any(p.startswith("pjit") for p in r.first.path)
+
+    def test_scan_iteration_attributed(self):
+        def f(x):
+            def body(c, t):
+                c = c / (t - 2.0)           # t == 2 -> division by zero
+                return c, c
+
+            return lax.scan(body, x, jnp.arange(5, dtype=jnp.float32))
+
+        r = sanitize(f, (jnp.ones(3, jnp.float32),))
+        assert r.first.prim == "div" and r.first.iteration == 2
+
+    def test_cond_takes_concrete_branch(self):
+        def f(x):
+            return lax.cond(x.sum() > 0,
+                            lambda v: jnp.log(v - 10.0),   # NaN branch
+                            lambda v: v, x)
+
+        r = sanitize(f, (jnp.ones(4, jnp.float32),))
+        assert r.first.prim == "log"
+        assert any("branch1" in p for p in r.first.path)
+        r2 = sanitize(f, (-jnp.ones(4, jnp.float32),))     # clean branch
+        assert r2.ok
+
+    def test_chunk_size_does_not_change_attribution(self):
+        f = self._nan_net()
+        args = (jnp.ones((2, 4), jnp.float32),
+                jnp.ones((4, 4), jnp.float32))
+        r1 = sanitize(f, args, config=SanitizerConfig(check_every=1))
+        r2 = sanitize(f, args, config=SanitizerConfig(check_every=1000))
+        assert (r1.first.prim, r1.first.eqn_index) == \
+            (r2.first.prim, r2.first.eqn_index)
+
+    def test_nan_only_mode_ignores_inf(self):
+        def f(x):
+            return x / jnp.zeros_like(x)    # inf, never NaN
+
+        args = (jnp.ones(4, jnp.float32),)
+        assert sanitize(f, args).first.prim == "div"
+        assert sanitize(
+            f, args, config=SanitizerConfig(check_inf=False)).ok
+
+    def test_masked_nan_literal_skipped_but_strict_flags(self):
+        def f(x):
+            return jnp.var(x)               # where(n>0, var, nan) guard
+
+        args = (jnp.ones(8, jnp.float32),)
+        assert sanitize(f, args).ok
+        strict = sanitize(f, args, config=SanitizerConfig(
+            skip_nonfinite_literals=False))
+        assert not strict.ok
+
+    def test_half_precision_inf_mask_literal_skipped(self):
+        """bf16 -inf mask literals are ml_dtypes — np.issubdtype(...,
+        np.floating) misses them, so the intentional-literal skip must
+        use jnp dtype logic (the bf16 attention-mask idiom)."""
+        def f(x):
+            return jnp.where(x > 0, x,
+                             jnp.asarray(-jnp.inf, jnp.bfloat16)).sum()
+
+        args = (jnp.ones((2, 4), jnp.bfloat16),)
+        assert sanitize(f, args).ok
+        strict = sanitize(f, args, config=SanitizerConfig(
+            skip_nonfinite_literals=False))
+        assert not strict.ok
+
+    def test_nan_only_count_excludes_intentional_inf(self):
+        """check_inf=False: the report's bad-value count is NaNs only —
+        intentional infs sharing the offending output are not counted."""
+        def f(x, m):
+            return (x / jnp.zeros_like(x)) * m   # [inf, inf, inf, nan]
+
+        args = (jnp.ones(4, jnp.float32),
+                jnp.asarray([1.0, 1.0, 1.0, 0.0], jnp.float32))
+        r = sanitize(f, args, config=SanitizerConfig(check_inf=False))
+        assert not r.ok and r.first.prim == "mul"
+        assert r.first.n_nan == 1
+        assert r.first.n_nonfinite == 1          # not the 3 masked infs
+
+    def test_bind_whole_strips_donation(self):
+        """The bind-whole path (recurse=False, or any structured-descent
+        failure) must not honor a pjit's donated_invars — that would
+        delete the caller's live arrays out from under it."""
+        s = jnp.ones((64,), jnp.float32)
+        f = jax.jit(lambda st, x: (st + x, x.sum()), donate_argnums=(0,))
+        r = sanitize(f, (s, s), config=SanitizerConfig(recurse=False))
+        assert r.ok
+        np.testing.assert_allclose(np.asarray(s), 1.0)   # s still alive
+
+    def test_while_replay_fidelity(self):
+        def f(x):
+            return lax.while_loop(lambda c: c[0] < 5,
+                                  lambda c: (c[0] + 1, c[1] * 2.0),
+                                  (jnp.int32(0), x))[1]
+
+        args = (jnp.ones(3, jnp.float32),)
+        r = sanitize(f, args)
+        assert r.ok
+        np.testing.assert_allclose(np.asarray(r.outputs[-1]),
+                                   np.asarray(f(*args)))
+
+
+# ---------------------------------------------------------------------------
+# trainer sanitize_step (satellite wiring half)
+# ---------------------------------------------------------------------------
+class TestTrainerSanitize:
+    def test_planted_nan_attributed_from_snapshot(self):
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.optimizer as popt
+        from paddle_tpu.nn import Linear, Sequential
+
+        prev = dist.get_mesh()
+        dist.init_mesh({"dp": 1})
+        try:
+            paddle.seed(0)
+            model = Sequential(Linear(8, 16), Linear(16, 1))
+            tr = dist.ParallelTrainer(
+                model, lambda o, y: ((o - y) ** 2).mean(), popt.SGD(0.01),
+                dp_axis=None)
+            X = np.zeros((4, 8), np.float32)
+            Y = np.zeros((4, 1), np.float32)
+            tr.step(paddle.to_tensor(X), paddle.to_tensor(Y))
+            snap = tr.capture_state()
+            bad = X.copy()
+            bad[0, 0] = np.nan
+            res = tr.sanitize_step(bad, Y, state=snap)
+            assert not res.ok
+            # the planted input NaN surfaces at its first consumer
+            assert res.first.n_nonfinite >= 1
+            # the live training state was untouched by the eager replay
+            tr.step(paddle.to_tensor(X), paddle.to_tensor(Y))
+            # same guarantee on the bind-whole path (recurse=False binds
+            # the donating top pjit as a unit; donation must be stripped)
+            from paddle_tpu.analysis import SanitizerConfig as SC
+
+            tr.sanitize_step(X, Y, config=SC(recurse=False))
+            tr.step(paddle.to_tensor(X), paddle.to_tensor(Y))
+        finally:
+            dist.set_mesh(prev)
+
+
+# ---------------------------------------------------------------------------
+# estimator vs measured (ISSUE 5 acceptance: <= 15% on the CPU arm)
+# ---------------------------------------------------------------------------
+class TestEstimatorVsMeasured:
+    def test_trainer_step_within_15_percent(self):
+        import paddle_tpu.distributed as dist
+        from bench import _analysis_estimator_vs_measured
+
+        prev = dist.get_mesh()
+        try:
+            out = _analysis_estimator_vs_measured()
+        finally:
+            dist.set_mesh(prev)
+        assert out["memory_measured_live_bytes"] > 0
+        assert abs(out["memory_est_vs_measured"]) <= 0.15, out
+
+
+# ---------------------------------------------------------------------------
+# CLI: --memory / --sanitize / --device-budget
+# ---------------------------------------------------------------------------
+class TestCLIQuant:
+    def test_memory_mode_end_to_end(self, tmp_path):
+        import json
+
+        from paddle_tpu.analysis.cli import main
+
+        out = tmp_path / "mem.json"
+        rc = main(["--memory", "--only", "static_program",
+                   "--out", str(out)])
+        assert rc == 0
+        data = json.loads(out.read_text())
+        assert data["schema_version"] == 1
+        entry = data["entry_points"]["static_program"]
+        assert entry["peak_hbm_bytes"] > 0
+        assert entry["resident_bytes"] > 0
+        assert "cost" in entry and entry["timeline"]
+        # zero crashed rules (acceptance)
+        assert not any("crashed" in f["message"] for f in data["findings"])
+
+    def test_sanitize_mode_end_to_end(self, tmp_path):
+        import json
+
+        from paddle_tpu.analysis.cli import main
+
+        out = tmp_path / "san.json"
+        rc = main(["--sanitize", "--only", "static_program",
+                   "--out", str(out)])
+        assert rc == 0
+        data = json.loads(out.read_text())
+        assert data["entry_points"]["static_program"]["ok"] is True
+        assert data["entry_points"]["static_program"]["checked_values"] > 0
+
+    def test_conflicting_modes_are_usage_errors(self, tmp_path):
+        from paddle_tpu.analysis.cli import main
+
+        for argv in (["--memory", "--sanitize"],
+                     ["--nan-only"],
+                     ["--sanitize", "--device-budget", "100"]):
+            with pytest.raises(SystemExit) as e:
+                main(argv + ["--out", str(tmp_path / "x.json")])
+            assert e.value.code == 2       # argparse usage error
+
+    def test_device_budget_gates_exit_one(self, tmp_path):
+        import json
+
+        from paddle_tpu.analysis.cli import main
+
+        out = tmp_path / "mem.json"
+        rc = main(["--memory", "--only", "static_program",
+                   "--device-budget", "64", "--out", str(out)])
+        assert rc == 1
+        data = json.loads(out.read_text())
+        assert any(f["rule"] == "oom-risk" and f["severity"] == "HIGH"
+                   for f in data["findings"])

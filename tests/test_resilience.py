@@ -279,6 +279,89 @@ class TestTrainerSentinel:
 
 
 # =====================================================================
+# sentinel × sanitizer wiring (ISSUE 5 satellite): on halt/rollback the
+# monitor can replay the captured failing step eqn-by-eqn and name the
+# eqn that produced the first NaN (off by default)
+# =====================================================================
+class TestSentinelSanitizerWiring:
+    def _guarded_trainer(self):
+        from paddle_tpu.distributed.env import clear_mesh, init_mesh
+        from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+        from paddle_tpu.optimizer.optimizers import SGD
+        from paddle_tpu.profiler.scope import scope as prof_scope
+
+        def loss_fn(o, y):
+            # planted mid-network hazard: log goes NaN once mse exceeds 3
+            with prof_scope("loss.guard"):
+                return paddle.log(3.0 - ((o - y) ** 2).mean())
+
+        paddle.seed(0)
+        clear_mesh()
+        init_mesh({"dp": 1})
+        net = paddle.nn.Linear(4, 4)
+        return ParallelTrainer(
+            net, loss_fn, SGD(learning_rate=1e-3,
+                              parameters=net.parameters()),
+            dp_axis=None, donate=False,
+            sentinel=SentinelConfig(warmup_steps=2, policy="halt"))
+
+    def test_halt_report_names_offending_eqn_and_scope(self):
+        tr = self._guarded_trainer()
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor((rng.standard_normal((8, 4)) * 0.01
+                              ).astype("float32"))
+        y = paddle.to_tensor((rng.standard_normal((8, 4)) * 0.01
+                              ).astype("float32"))
+        for _ in range(3):
+            tr.step(x, y)
+        snap = tr.capture_state()
+        bad_x = paddle.to_tensor(
+            (rng.standard_normal((8, 4)) * 100.0).astype("float32"))
+        monitor = SentinelMonitor(
+            tr._sentinel,
+            sanitize_fn=lambda: tr.sanitize_step(
+                bad_x, y, state=snap).to_dict())
+        tr.step(bad_x, y)      # mse >> 3 -> log(NaN); in-graph skip fires
+        with pytest.raises(AnomalyHalt) as e:
+            monitor.after_step(tr)
+        san = e.value.report["sanitizer"]
+        assert san["ok"] is False
+        first = san["first_nonfinite"]
+        assert first["prim"] == "log"
+        assert "loss.guard" in first["scope"]
+        assert first["n_nan"] >= 1
+        assert "log" in str(e.value)        # the halt message names it
+        assert monitor.last_sanitize is san
+
+    def test_off_by_default_and_failure_contained(self):
+        tr = self._guarded_trainer()
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor((rng.standard_normal((8, 4)) * 0.01
+                              ).astype("float32"))
+        for _ in range(3):
+            tr.step(x, x)
+        bad_x = paddle.to_tensor(
+            (rng.standard_normal((8, 4)) * 100.0).astype("float32"))
+        # default: no sanitizer in the report
+        mon = SentinelMonitor(tr._sentinel)
+        tr.step(bad_x, x)
+        with pytest.raises(AnomalyHalt) as e:
+            mon.after_step(tr)
+        assert "sanitizer" not in e.value.report
+        # a broken sanitize_fn must not mask the policy action
+        tr2 = self._guarded_trainer()
+        for _ in range(3):
+            tr2.step(x, x)
+        mon2 = SentinelMonitor(
+            tr2._sentinel,
+            sanitize_fn=lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+        tr2.step(bad_x, x)
+        with pytest.raises(AnomalyHalt) as e2:
+            mon2.after_step(tr2)
+        assert "boom" in e2.value.report["sanitizer"]["error"]
+
+
+# =====================================================================
 # sentinel wired into the pipeline step
 # =====================================================================
 def _pipeline_step(sentinel):
