@@ -466,6 +466,102 @@ def _serving_tput(on_tpu):
     }
 
 
+def _router_failover(on_tpu):
+    """Serving-router chaos secondary (ISSUE 6): two engine replicas behind
+    the health-checked router, the loaded replica killed abruptly (no
+    drain — the in-process equivalent of a replica SIGKILL) while a queued
+    request streams. Records recovery time (kill → first token of the
+    failed-over request on the survivor) and how many queued requests were
+    dropped (the acceptance criterion says zero)."""
+    import gc
+    import threading
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+    from paddle_tpu.serving import (ContinuousBatchingEngine, Request,
+                                    ServingRouter, ServingServer)
+
+    if on_tpu:
+        overrides = {}
+        name, max_new, s = "gpt3-350m", 64, 512
+    else:
+        name, max_new, s = "gpt2-small", 48, 128
+        overrides = dict(vocab_size=64, hidden_size=16, num_layers=1,
+                         num_attention_heads=2, max_position_embeddings=128)
+    cfg = gpt_config(name, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0, **overrides)
+    paddle.seed(0)
+    clear_mesh()
+    gc.collect()
+    init_mesh({"dp": 1})
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+
+    def replica():
+        eng = ContinuousBatchingEngine(model, max_seq_len=s, n_slots=1,
+                                       prefill_buckets=[8], max_queue=16)
+        return ServingServer(eng).start()
+
+    servers = {srv.addr: srv for srv in (replica(), replica())}
+    addrs = list(servers)
+    prompt = rng.integers(0, cfg.vocab_size, (4,)).tolist()
+    try:
+        with ServingRouter(addrs, health_interval_s=0.1, cooldown_s=30.0,
+                           request_timeout=10.0) as router:
+            router.check_health()
+            # warm both replicas: compiles out of the recovery-time path
+            for rr in [router.submit(prompt, max_new_tokens=2)
+                       for _ in range(2)]:
+                router.wait(rr, timeout=600)
+            router.check_health()
+            # n_slots=1: each replica holds one runner + queued extras
+            rrs = [router.submit(prompt, max_new_tokens=max_new)
+                   for _ in range(4)]
+            placed = {}
+            for rr in rrs:
+                placed.setdefault(rr.replica_addr, []).append(rr)
+            victim = next(a for a, v in placed.items() if len(v) >= 2)
+            queued = placed[victim][-1]
+            tokens = []
+            thread = threading.Thread(
+                target=lambda: tokens.extend(router.stream(queued)))
+            thread.start()
+            time.sleep(0.05)
+            t_kill = time.perf_counter()
+            servers[victim].kill()
+            thread.join(600)
+            # None = the kill race did not leave a queued request to
+            # re-home (it had already started generating) — recording
+            # thread-join time as "recovery" would be meaningless
+            recovery_s = (
+                round(queued.failover_first_token_at - t_kill, 4)
+                if queued.failover_first_token_at is not None else None)
+            for rr in rrs:
+                try:
+                    router.wait(rr, timeout=600)
+                except TimeoutError:
+                    pass
+            dropped = sum(1 for rr in rrs
+                          if rr.state == Request.FAILED and not rr.tokens)
+            snap = router.snapshot()
+            return {
+                "router_failover_recovery_s": recovery_s,
+                "router_failover_dropped_requests": dropped,
+                "router_failover_resubmits": snap["resubmits"],
+                "router_failover_inflight_failures":
+                    snap["inflight_failures"],
+                "router_failover_streamed_tokens": len(tokens),
+            }
+    finally:
+        for srv in servers.values():
+            try:
+                srv.kill()
+            except Exception:
+                pass
+
+
 def _eager_jit_speedup():
     """Eager GPT-block fwd+bwd: op-by-op dispatch vs the transparent
     per-layer jit cache (FLAGS_eager_layer_jit) — SURVEY §7 hard-part 4."""
@@ -571,6 +667,11 @@ def main():
         except Exception as e:  # pragma: no cover - device dependent
             secondary["analysis_lint_s"] = f"failed: {type(e).__name__}"
         try:
+            # robustness: replica-kill failover recovery time (ISSUE 6)
+            secondary.update(_router_failover(True))
+        except Exception as e:  # pragma: no cover - device dependent
+            secondary["router_failover_recovery_s"] = f"failed: {type(e).__name__}"
+        try:
             # same-remat, same-accumulation A/B (VERDICT r4 weak #3): the
             # plain arm runs selective remat AND 2-step gradient merge, so
             # pipeline_step_ratio isolates the schedule machinery itself.
@@ -618,6 +719,10 @@ def main():
             secondary.update(_analysis_overhead())
         except Exception as e:  # pragma: no cover
             secondary["analysis_lint_s"] = f"failed: {type(e).__name__}"
+        try:
+            secondary.update(_router_failover(False))
+        except Exception as e:  # pragma: no cover
+            secondary["router_failover_recovery_s"] = f"failed: {type(e).__name__}"
         metric = "gpt_tiny_train_tokens_per_sec_chip"
 
     print(json.dumps({

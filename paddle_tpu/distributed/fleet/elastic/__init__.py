@@ -1,3 +1,9 @@
+from .collective import (  # noqa: F401
+    ElasticCollective,
+    RankFailure,
+    pack_arrays,
+    unpack_arrays,
+)
 from .manager import (  # noqa: F401
     ELASTIC_EXIT_CODE,
     ElasticManager,

@@ -1,0 +1,278 @@
+"""Elastic rank collective: rendezvous + blob exchange over the KV store.
+
+Parity: the reference's multi-node data plane is gloo/NCCL bootstrapped from
+an etcd/HTTP rendezvous (fleet elastic manager + Gloo HTTP store). This
+module is the TPU-pod stand-in for the *control + small-tensor* plane: rank
+processes agree on (generation, rank, world) through the same elastic
+:class:`~.manager._TcpStore` that tracks their heartbeats, and exchange
+small payloads (gradient blobs for CPU-multiprocess data parallelism,
+gathered checkpoint shards) through its sibling KV scope.
+
+Failure model — the part the single-process r7 stack could not cover:
+
+* Liveness is the membership scope's heartbeat TTL (server-side monotonic
+  stamps). A rank that stops beating *expires*; it is never declared dead by
+  a timeout alone.
+* :meth:`ElasticCollective.allgather` polls for every member's payload. A
+  missing payload whose owner is still alive means "slow" (keep waiting); a
+  missing payload whose owner has expired raises :class:`RankFailure`
+  naming the dead ranks — the trainer's signal to re-rendezvous on the
+  surviving world and reshard its newest intact checkpoint.
+* Rendezvous is generation-numbered and two-phase: ranks join
+  ``rdv<gen>``, then every member publishes its membership VIEW and waits
+  until all views agree — two survivors can never commit to different rank
+  orders after a death race.
+
+Payloads ride as base64 npz blobs (:func:`pack_arrays`/:func:`unpack_arrays`)
+— plain strings through the HTTP KV protocol, no pickling.
+"""
+from __future__ import annotations
+
+import base64
+import io
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["ElasticCollective", "RankFailure", "pack_arrays",
+           "unpack_arrays"]
+
+
+class RankFailure(RuntimeError):
+    """One or more member ranks stopped heartbeating mid-collective."""
+
+    def __init__(self, msg: str, dead: List[str]):
+        super().__init__(msg)
+        self.dead = list(dead)
+
+
+def pack_arrays(tree: Dict[str, np.ndarray]) -> str:
+    """{name: array} → base64 npz string (KV-store safe, no pickle)."""
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in tree.items()})
+    return base64.b64encode(buf.getvalue()).decode("ascii")
+
+
+def unpack_arrays(blob: str) -> Dict[str, np.ndarray]:
+    data = np.load(io.BytesIO(base64.b64decode(blob.encode("ascii"))),
+                   allow_pickle=False)
+    return {k: data[k] for k in data.files}
+
+
+class ElasticCollective:
+    """Rank coordination over an elastic ``_TcpStore``-style registry.
+
+    ``store`` needs the membership plane (``nodes()``, ``ttl``) and the raw
+    KV plane (``put/get/delete/scan``) — :class:`~.manager._TcpStore`
+    provides both (the shared-FS ``_FileStore`` does not; cross-process
+    collectives need the HTTP store). Heartbeats are NOT this class's job:
+    run an :class:`~.manager.ElasticManager` (or your own beat loop) so the
+    membership scope stays fresh.
+    """
+
+    def __init__(self, store, node_id: str, poll: float = 0.02):
+        self.store = store
+        self.node_id = node_id
+        self.poll = float(poll)
+        self.generation = -1
+        self.rank: Optional[int] = None
+        self.world = 0
+        self.members: List[str] = []
+        self._last_ag_key: Optional[str] = None
+
+    # -- helpers --------------------------------------------------------
+    def _sleep_iter(self):
+        from ....resilience.retry import backoff_delays
+
+        return backoff_delays(1 << 30, base=self.poll, max_delay=0.25)
+
+    def _kv_scan(self, keys_only: bool = False,
+                 prefix: Optional[str] = None) -> Dict[str, tuple]:
+        """Store scan with the r11 server-side filters; falls back to a
+        full scan + client-side filtering for duck-typed stores that
+        predate the ``keys_only``/``prefix`` options."""
+        try:
+            return self.store.scan(keys_only=keys_only, prefix=prefix)
+        except TypeError:
+            out = self.store.scan()
+            if prefix:
+                out = {k: v for k, v in out.items() if k.startswith(prefix)}
+            return out
+
+    def _scan_prefix(self, prefix: str, fresh: bool = False,
+                     keys_only: bool = False) -> Dict[str, str]:
+        """KV-plane snapshot filtered to ``prefix`` (key suffix → value).
+        ``fresh`` additionally age-filters by the membership TTL — used for
+        JOIN stamps, which double as liveness; data blobs are returned at
+        any age (a gradient from 30s ago is still the gradient).
+        ``keys_only`` skips payload transfer (suffix → None) — the poll
+        loops need presence, not W gradient blobs per iteration."""
+        out = {}
+        scan = self._kv_scan(keys_only=keys_only, prefix=prefix)
+        for k, (v, age) in scan.items():
+            if k.startswith(prefix) and (not fresh or age <= self.store.ttl):
+                out[k[len(prefix):]] = v
+        return out
+
+    def _parse_rdv(self, scan):
+        """{gen: ({fresh join owners}, {view owner: view})} from a raw KV
+        snapshot. Join stamps are liveness-filtered (a waiting rank keeps
+        refreshing them); views are kept at ANY age — a published view is
+        commit evidence, and a committed rank stops refreshing its stamps
+        the moment it returns to training."""
+        ttl = self.store.ttl
+        gens: Dict[int, tuple] = {}
+        for k, (v, age) in scan.items():
+            for prefix, views in (("rdvview", True), ("rdv", False)):
+                if not k.startswith(prefix):
+                    continue
+                head, _, owner = k[len(prefix):].partition(":")
+                if not head.isdigit() or not owner:
+                    continue
+                joins, view_map = gens.setdefault(int(head), (set(), {}))
+                if views:
+                    view_map[owner] = v
+                elif age <= ttl:
+                    joins.add(owner)
+                break
+        return gens
+
+    def latest_generation(self) -> int:
+        """Highest generation any rank has ever tried to join (−1 when the
+        store is virgin) — a (re)joining process adopts max+1 so it can
+        meet the incumbents at their next re-rendezvous instead of waiting
+        at a generation everyone else has left behind."""
+        gens = self._parse_rdv(self._kv_scan(prefix="rdv"))
+        return max(gens) if gens else -1
+
+    # -- rendezvous -----------------------------------------------------
+    def rendezvous(self, gen: int, min_ranks: int = 1,
+                   timeout: float = 60.0) -> int:
+        """Join generation ``gen`` (or any HIGHER generation a peer
+        proposes while we wait — racing proposers must converge on one
+        number, not deadlock one generation apart); block until the live
+        membership has all joined and every member confirms the SAME view.
+        Returns this node's rank; sets
+        ``rank``/``world``/``members``/``generation``.
+
+        Convergence after a death: the dead node sits in the membership
+        scope until its TTL expires and never joins ``gen``, so the loop
+        holds exactly one TTL and then commits on the survivors. A member
+        that already committed is recognized by its published view (any
+        age), so a slow joiner still converges after the fast ones have
+        gone back to training.
+        """
+        deadline = time.monotonic() + timeout
+        delays = self._sleep_iter()
+        alive, joined = set(), set()
+        while time.monotonic() < deadline:
+            # prefix scan: membership views only — never the data-plane
+            # gradient blobs sharing the scope
+            gens = self._parse_rdv(self._kv_scan(prefix="rdv"))
+            live_gens = [g for g, (j, vw) in gens.items() if j or vw]
+            if live_gens and max(live_gens) > gen:
+                gen = max(live_gens)  # adopt the highest live proposal
+            # (re)stamp our join: join keys are liveness-filtered, so they
+            # must be refreshed while we wait
+            self.store.put(f"rdv{gen}:{self.node_id}", "1")
+            alive = set(self.store.nodes())
+            joins, view_map = gens.get(gen, (set(), {}))
+            joined = joins | set(view_map) | {self.node_id}
+            cand = sorted(alive & joined)
+            if (self.node_id in cand and len(cand) >= min_ranks
+                    and alive <= joined):
+                view = ",".join(cand)
+                self.store.put(f"rdvview{gen}:{self.node_id}", view)
+                view_map = dict(view_map, **{self.node_id: view})
+                if all(view_map.get(m) == view for m in cand):
+                    self.generation = gen
+                    self.members = cand
+                    self.world = len(cand)
+                    self.rank = cand.index(self.node_id)
+                    self._gc_generation(gen - 1)
+                    return self.rank
+            time.sleep(min(next(delays), max(deadline - time.monotonic(), 0)))
+        raise TimeoutError(
+            f"rendezvous gen={gen} did not converge within {timeout}s "
+            f"(node {self.node_id}, alive={sorted(alive)}, "
+            f"joined={sorted(joined)})")
+
+    def _gc_generation(self, gen: int):
+        """Drop OUR keys from a finished generation (each rank cleans after
+        itself; a dead rank's leftovers are harmless — new generations use
+        new key prefixes)."""
+        if gen < 0:
+            return
+        try:
+            for k in self._kv_scan(keys_only=True):
+                if (k.endswith(f":{self.node_id}")
+                        and (k.startswith(f"rdv{gen}:")
+                             or k.startswith(f"rdvview{gen}:"))):
+                    self.store.delete(k)
+        except Exception:
+            pass  # GC is best-effort; the job-scoped store dies with the job
+
+    # -- data plane -----------------------------------------------------
+    def allgather(self, tag: str, payload: str,
+                  timeout: float = 60.0) -> List[str]:
+        """Publish ``payload`` under ``tag`` and return every member's
+        payload in RANK ORDER (deterministic reduction order — the
+        bit-identical-recovery contract). Raises :class:`RankFailure` when
+        a member expires before publishing, :class:`TimeoutError` when a
+        member stays alive but silent past ``timeout``."""
+        if self.rank is None:
+            raise RuntimeError("rendezvous before allgather")
+        prefix = f"ag{self.generation}:{tag}:"
+        my_key = f"{prefix}{self.rank}"
+        self.store.put(my_key, payload)
+        deadline = time.monotonic() + timeout
+        delays = self._sleep_iter()
+        while True:
+            # poll on key PRESENCE only — every iteration of this loop
+            # re-runs while a peer is slow, and shipping all W payload
+            # blobs per poll would melt the single KV server exactly when
+            # a rank is struggling. Payload values transfer exactly once,
+            # after the round is complete (blobs are never GC'd before
+            # the NEXT round completes, so the fetch cannot miss).
+            present = self._scan_prefix(prefix, keys_only=True)
+            if all(str(r) in present for r in range(self.world)):
+                got = self._scan_prefix(prefix)
+                # GC our blob from the PREVIOUS gather — only NOW is it
+                # provably consumed: this gather completing means every
+                # peer has published this round, which it can only do
+                # after finishing the previous one. Deleting at publish
+                # time instead would yank the blob from under a slower
+                # peer still reading the previous round.
+                if self._last_ag_key not in (None, my_key):
+                    try:
+                        self.store.delete(self._last_ag_key)
+                    except Exception:
+                        pass
+                self._last_ag_key = my_key
+                return [got[str(r)] for r in range(self.world)]
+            missing = [r for r in range(self.world) if str(r) not in present]
+            alive = set(self.store.nodes())
+            dead = [self.members[r] for r in missing
+                    if self.members[r] not in alive]
+            if dead:
+                raise RankFailure(
+                    f"rank(s) {[self.members.index(d) for d in dead]} "
+                    f"({dead}) died during allgather '{tag}' "
+                    f"(gen {self.generation})", dead=dead)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"allgather '{tag}' missing ranks {missing} after "
+                    f"{timeout}s (all still alive — stalled, not dead)")
+            time.sleep(min(next(delays), 0.25))
+
+    def barrier(self, tag: str, timeout: float = 60.0):
+        self.allgather(f"bar:{tag}", "1", timeout=timeout)
+
+    def membership_changed(self) -> bool:
+        """Live membership differs from the committed rendezvous view —
+        the trainer's step-boundary scale-up/scale-down probe."""
+        try:
+            return sorted(self.store.nodes()) != self.members
+        except Exception:
+            return False

@@ -80,6 +80,10 @@ class _FileStore:
             pass
 
     def nodes(self) -> List[str]:
+        # file mtimes are inherently wall-clock; cross-host shared-FS TTLs
+        # can't use a monotonic base. The in-process TTL bookkeeping
+        # (ElasticManager._beat) and the TCP store's server-side stamps ARE
+        # monotonic — this path is the single-host/shared-NFS fallback.
         now = time.time()
         alive = []
         for name in os.listdir(self.path):
@@ -122,6 +126,11 @@ class _TcpStore:
         self.client = KVClient(
             addr, timeout=max(ttl / 4 / (int(retries) + 1), 0.25))
         self.scope = f"elastic_{scope}"
+        # SIBLING scope for the raw KV plane: membership liveness is
+        # "every key in self.scope with a fresh stamp is a node", so data
+        # keys (rendezvous views, gradient blobs) must live next door or
+        # they'd register as phantom nodes
+        self.kv_scope = f"elastic_{scope}_kv"
         self.ttl = ttl
         self.retries = int(retries)
         self._values = {}
@@ -175,6 +184,35 @@ class _TcpStore:
         live = self._alive()
         return [live[k] for k in sorted(live)]
 
+    # -- raw KV plane (retrying) ---------------------------------------
+    # The elastic coordinator rides the SAME store for its data plane
+    # (rendezvous views, gradient blobs) under a sibling scope; these
+    # accessors get the identical backoff/StoreUnavailable policy as the
+    # membership operations above.
+    def put(self, key: str, value: str):
+        self._retrying(
+            "put", lambda: self.client.put(self.kv_scope, key, value,
+                                           strict=True), ok=bool)
+
+    def get(self, key: str) -> Optional[str]:
+        # absence is a legitimate answer (None), not a transport failure
+        return self._retrying(
+            "get", lambda: self.client.get(self.kv_scope, key, strict=True))
+
+    def delete(self, key: str):
+        self._retrying(
+            "delete", lambda: self.client.delete(self.kv_scope, key,
+                                                 strict=True), ok=bool)
+
+    def scan(self, keys_only: bool = False, prefix: str = None):
+        """{key: (value, age_seconds)} snapshot of the KV plane.
+        ``keys_only`` ships (None, age) pairs — presence without payload;
+        ``prefix`` filters server-side (both: see KVClient.scan)."""
+        return self._retrying(
+            "scan_kv", lambda: self.client.scan(
+                self.kv_scope, strict=True, keys_only=keys_only,
+                prefix=prefix))
+
 
 class ElasticManager:
     """Registers this node, watches membership, decides restart/exit.
@@ -214,7 +252,7 @@ class ElasticManager:
         self._stop = threading.Event()
         self._membership_at_launch: List[str] = []
         self._last_endpoints: List[str] = [self.endpoint]
-        self._last_beat_ok = time.time()
+        self._last_beat_ok = time.monotonic()
         self.degraded = False  # store unreachable past TTL: single-node mode
         self.preempted = False
 
@@ -224,7 +262,7 @@ class ElasticManager:
             self.store.register(self.node_id, self.endpoint)
             self._membership_at_launch = self.store.nodes()
             self._last_endpoints = self.store.endpoints()
-            self._last_beat_ok = time.time()
+            self._last_beat_ok = time.monotonic()
             self.degraded = False
         except StoreUnavailable as e:
             # graceful start: training proceeds single-node; the beat thread
@@ -255,16 +293,16 @@ class ElasticManager:
                         RuntimeWarning)
                 else:
                     self.store.heartbeat(self.node_id)
-                self._last_beat_ok = time.time()
+                self._last_beat_ok = time.monotonic()
             except FileNotFoundError:
                 try:
                     self.store.register(self.node_id, self.endpoint)
-                    self._last_beat_ok = time.time()
+                    self._last_beat_ok = time.monotonic()
                 except Exception:
                     pass
             except Exception:
                 if (not self.degraded
-                        and time.time() - self._last_beat_ok > self.store.ttl):
+                        and time.monotonic() - self._last_beat_ok > self.store.ttl):
                     self.degraded = True
                     warnings.warn(
                         f"elastic store unreachable for over ttl="
@@ -312,7 +350,14 @@ class ElasticManager:
 
     def wait_for_np(self, np: Optional[int] = None) -> bool:
         """Hold until the registry has the target node count (parity:
-        manager.py wait/HOLD state). Returns False on timeout."""
+        manager.py wait/HOLD state). Returns False on timeout.
+
+        The poll backs off with jitter (resilience/retry.py) instead of a
+        fixed 0.5s cadence: a whole pod waking up polls the registry in
+        lockstep otherwise, and the stampede is worst exactly when the
+        store is busiest (everyone rendezvousing after a restart)."""
+        from ....resilience.retry import backoff_delays
+
         want = np or self.np
 
         def count():
@@ -321,11 +366,13 @@ class ElasticManager:
             except (StoreUnavailable, OSError):
                 return 0
 
-        deadline = time.time() + self.timeout
-        while time.time() < deadline:
+        delays = backoff_delays(1 << 30, base=0.1, max_delay=2.0)
+        deadline = time.monotonic() + self.timeout
+        while time.monotonic() < deadline:
             if count() >= want:
                 return True
-            time.sleep(0.5)
+            time.sleep(min(next(delays),
+                           max(deadline - time.monotonic(), 0.0)))
         return count() >= want
 
     # -- preemption -----------------------------------------------------

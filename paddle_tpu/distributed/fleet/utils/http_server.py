@@ -35,8 +35,16 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _parts(self):
-        parts = [p for p in self.path.split("/") if p]
+        path = self.path.split("?", 1)[0]
+        parts = [p for p in path.split("/") if p]
         return (parts[0], parts[1]) if len(parts) >= 2 else (parts[0] if parts else "", None)
+
+    def _query(self) -> Dict[str, str]:
+        import urllib.parse
+        if "?" not in self.path:
+            return {}
+        return {k: v[-1] for k, v in urllib.parse.parse_qs(
+            self.path.split("?", 1)[1]).items()}
 
     def do_PUT(self):
         scope, key = self._parts()
@@ -46,8 +54,11 @@ class _Handler(BaseHTTPRequestHandler):
             return
         n = int(self.headers.get("Content-Length", 0))
         val = self.rfile.read(n).decode()
+        # monotonic stamps: key ages drive heartbeat-TTL liveness, and a
+        # wall-clock step (NTP slew/adjtime) must never fake node death or
+        # resurrect an expired one
         with self.lock:
-            self.store.setdefault(scope, {})[key] = (val, time.time())
+            self.store.setdefault(scope, {})[key] = (val, time.monotonic())
         self.send_response(200)
         self.end_headers()
 
@@ -56,9 +67,22 @@ class _Handler(BaseHTTPRequestHandler):
         with self.lock:
             bucket = dict(self.store.get(scope, {}))
         if key is None:
-            now = time.time()
-            body = json.dumps(
-                {k: [v, now - ts] for k, (v, ts) in bucket.items()}).encode()
+            now = time.monotonic()
+            q = self._query()
+            pfx = q.get("prefix", "")
+            if pfx:
+                bucket = {k: kv for k, kv in bucket.items()
+                          if k.startswith(pfx)}
+            if q.get("keys") == "1":
+                # presence/age only: elastic poll loops scan every
+                # iteration, and shipping each rank's full gradient blob
+                # per poll turns a slow peer into an O(W^2 x blob) stampede
+                body = json.dumps(
+                    {k: [None, now - ts]
+                     for k, (v, ts) in bucket.items()}).encode()
+            else:
+                body = json.dumps(
+                    {k: [v, now - ts] for k, (v, ts) in bucket.items()}).encode()
             self.send_response(200)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
@@ -165,11 +189,21 @@ class KVClient:
                 raise
             return False
 
-    def scan(self, scope: str, strict: bool = False) -> Dict[str, Tuple[str, float]]:
-        """{key: (value, age_seconds)} for the whole scope."""
+    def scan(self, scope: str, strict: bool = False, keys_only: bool = False,
+             prefix: Optional[str] = None) -> Dict[str, Tuple[str, float]]:
+        """{key: (value, age_seconds)} for the whole scope. ``keys_only``
+        returns (None, age) pairs — presence/liveness without shipping
+        values; ``prefix`` filters keys server-side."""
         try:
+            import urllib.parse
+            q = {}
+            if keys_only:
+                q["keys"] = "1"
+            if prefix:
+                q["prefix"] = prefix
+            qs = f"?{urllib.parse.urlencode(q)}" if q else ""
             c = self._conn()
-            c.request("GET", f"/{scope}")
+            c.request("GET", f"/{scope}{qs}")
             r = c.getresponse()
             if r.status != 200:
                 c.close()

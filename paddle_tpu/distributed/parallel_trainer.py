@@ -616,12 +616,38 @@ class ParallelTrainer:
             "sentinel_state": dict(self.sentinel_state),
         })
 
+    def state_layout(self):
+        """JSON-able sharding metadata for a :meth:`capture_state` snapshot
+        — per-param PartitionSpec entries plus the mesh axis sizes they were
+        captured under. Pass as ``CheckpointManager.save(layout=...)`` so a
+        later load on a DIFFERENT topology knows how the arrays were laid
+        out (the snapshot arrays themselves are global host copies; the
+        in-process reshard happens in :meth:`restore_state`)."""
+        def entries(spec):
+            return [list(e) if isinstance(e, (tuple, list)) else e
+                    for e in spec]
+
+        mesh_axes = {str(k): int(v) for k, v in self.mesh.shape.items()}
+        return {
+            f"/params/{n}": {"axes": entries(self.param_specs[n]),
+                             "mesh": mesh_axes}
+            for n in self.params
+        }
+
     def restore_state(self, state):
         """Inverse of :meth:`capture_state`: re-place every leaf on the mesh
         with its live sharding (a checkpoint loaded on a different topology
-        reshards here). Restores scaler/sentinel carries only when both the
+        reshards here — validated first, so an extent the new mesh cannot
+        divide raises :class:`CheckpointReshardError` instead of an opaque
+        XLA failure). Restores scaler/sentinel carries only when both the
         snapshot and this trainer have them enabled."""
+        from ..framework.checkpoint import _check_reshardable
+
         mesh = self.mesh
+        for n, a in state["params"].items():
+            if n in self.param_specs:
+                _check_reshardable(f"params/{n}", jnp.shape(a),
+                                   self.param_specs[n], mesh)
         self.params = {
             n: jax.device_put(jnp.asarray(a),
                               NamedSharding(mesh, self.param_specs[n]))

@@ -30,10 +30,11 @@ import os
 import shutil
 import tempfile
 import threading
+import time
 import warnings
 import weakref
 import zlib
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -41,7 +42,9 @@ import numpy as np
 from ..tensor import Tensor
 
 __all__ = ["CheckpointManager", "CheckpointCorruptionError",
-           "build_train_state", "save_checkpoint", "load_checkpoint"]
+           "CheckpointReshardError", "build_train_state", "save_checkpoint",
+           "load_checkpoint", "reshard_train_state", "shard_bounds",
+           "shard_slice", "unshard"]
 
 _META = "meta.json"
 _ARRAYS = "arrays.npz"
@@ -67,8 +70,38 @@ class CheckpointCorruptionError(RuntimeError):
     older snapshot"; an explicit-step load propagates it."""
 
 
+class CheckpointReshardError(RuntimeError):
+    """The snapshot is INTACT but its sharded layout cannot be mapped onto
+    the requested topology (e.g. a dim sharded over dp=3 loaded at dp=2
+    with an indivisible extent). Deliberately NOT a corruption error: the
+    newest-intact fallback must not walk past it — every older snapshot
+    shares the same layout, so retrying older steps only hides the real
+    problem."""
+
+
 def _crc32(arr: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+def _check_reshardable(path: str, shape, spec, mesh):
+    """Pre-validate a saved PartitionSpec against the CURRENT mesh so a
+    topology change that cannot host the array raises
+    :class:`CheckpointReshardError` (the snapshot is fine!) instead of an
+    opaque XLA sharding failure deep inside device_put."""
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        n = 1
+        for ax in axes:
+            n *= int(mesh.shape.get(ax, 1))
+        if n > 1 and int(shape[dim]) % n:
+            raise CheckpointReshardError(
+                f"{path}: dim {dim} (extent {shape[dim]}) is sharded over "
+                f"mesh axes {tuple(axes)} (total {n} parts) but the extent "
+                f"is not divisible on the current mesh "
+                f"{dict(mesh.shape)} — the snapshot is intact; pick a "
+                f"topology whose axis sizes divide the array")
 
 
 def _py_default(obj):
@@ -120,6 +153,91 @@ def _flatten_state(state):
     return flat
 
 
+def shard_bounds(extent: int, world: int) -> List[Tuple[int, int]]:
+    """Deterministic 1-D partition of ``extent`` rows over ``world`` ranks:
+    the first ``extent % world`` ranks get one extra row (numpy's
+    ``array_split`` convention). Shared by the elastic trainer's ZeRO-style
+    slot sharding and :func:`reshard_train_state`, so the rank that WRITES
+    a shard and the rank that RELOADS it after a topology change always
+    agree on the cut points."""
+    if world < 1:
+        raise ValueError("world must be >= 1")
+    base, extra = divmod(int(extent), int(world))
+    bounds, start = [], 0
+    for r in range(world):
+        n = base + (1 if r < extra else 0)
+        bounds.append((start, start + n))
+        start += n
+    return bounds
+
+
+def shard_slice(arr: np.ndarray, world: int, rank: int,
+                axis: int = 0) -> np.ndarray:
+    """This rank's partition of a GLOBAL array along ``axis``."""
+    lo, hi = shard_bounds(arr.shape[axis], world)[rank]
+    idx = [slice(None)] * arr.ndim
+    idx[axis] = slice(lo, hi)
+    return arr[tuple(idx)]
+
+
+def unshard(parts: List[np.ndarray], axis: int = 0) -> np.ndarray:
+    """Reassemble rank-ordered partitions into the global array."""
+    return np.concatenate([np.asarray(p) for p in parts], axis=axis)
+
+
+def reshard_train_state(state: Any, layout: Dict[str, Dict], world: int,
+                        rank: int) -> Any:
+    """Slice a GLOBAL train-state pytree into ``rank``'s shard for a
+    ``world``-rank data-parallel topology.
+
+    ``layout`` is the snapshot's sharding metadata (``meta.json``'s
+    ``layout`` field, written via ``CheckpointManager.save(layout=...)``):
+    ``{path: {"axis": dim, "world": N_at_save, "even": bool}}``. Arrays at
+    listed paths are global in the snapshot (the saving rank gathered its
+    peers' shards first); everything else (replicated params, step
+    counters) passes through untouched. ``world`` may differ from the
+    save-time world — that is the point: a snapshot saved at dp=N loads at
+    dp=N±k by re-cutting the same global arrays.
+
+    ``even=True`` records a layout whose consumer requires equal shards
+    (the jax-mesh contract — XLA rejects uneven partitions); an extent the
+    new world cannot divide raises :class:`CheckpointReshardError`."""
+    if not (0 <= int(rank) < int(world)):
+        raise ValueError(f"rank {rank} outside world {world}")
+    layout = layout or {}
+
+    def transform(prefix, obj):
+        if isinstance(obj, Tensor):
+            return Tensor(transform(prefix, obj._data))
+        if isinstance(obj, dict):
+            return {k: transform(f"{prefix}/{k}", v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            vals = [transform(f"{prefix}/{i}", v) for i, v in enumerate(obj)]
+            return vals if isinstance(obj, list) else tuple(vals)
+        entry = layout.get(prefix)
+        if entry is None or not isinstance(obj, (np.ndarray, jax.Array)):
+            return obj
+        if "axis" not in entry:
+            # a mesh-spec layout (ParallelTrainer.state_layout()'s
+            # {"axes", "mesh"} schema) is resharded in-process by the
+            # trainer's restore_state — cutting it here as an axis-0 dp
+            # shard would silently corrupt model-parallel params
+            raise CheckpointReshardError(
+                f"{prefix}: layout entry keys {sorted(entry)} are not the "
+                f"dp-shard schema {{'axis', 'world', 'even'}}; mesh-spec "
+                f"layouts must go through the trainer's restore_state")
+        arr = np.asarray(obj)
+        axis = int(entry["axis"])
+        if entry.get("even") and arr.shape[axis] % int(world):
+            raise CheckpointReshardError(
+                f"{prefix}: dim {axis} (extent {arr.shape[axis]}) cannot be "
+                f"evenly resharded over world={world} (saved at "
+                f"world={entry.get('world')})")
+        return shard_slice(arr, int(world), int(rank), axis=axis)
+
+    return transform("", state)
+
+
 class CheckpointManager:
     """Step-keyed snapshot directory: ``<dir>/step_<N>/``.
 
@@ -140,15 +258,43 @@ class CheckpointManager:
         # self-deadlock exactly when the emergency save matters most
         self._lock = threading.RLock()
         self.last_loaded_step: Optional[int] = None
+        self.last_loaded_meta: Optional[Dict] = None
         os.makedirs(directory, exist_ok=True)
+        self._sweep_stale_tmp()
         _LIVE_MANAGERS.add(self)
+
+    def _sweep_stale_tmp(self, max_age_s: float = 3600.0):
+        """Remove temp dirs abandoned by a writer that died mid-save (the
+        atomic-rename protocol means they were never published, so deleting
+        them can never lose a snapshot). Age-gated: a sibling process may
+        legitimately be mid-write in the same directory."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        now = time.time()  # file mtimes are wall-clock by nature
+        for name in names:
+            if not name.startswith(".tmp_step_"):
+                continue
+            p = os.path.join(self.directory, name)
+            try:
+                if now - os.path.getmtime(p) > max_age_s:
+                    shutil.rmtree(p, ignore_errors=True)
+            except OSError:
+                pass
 
     # -- save -----------------------------------------------------------
     def save(self, step: int, state: Any, metadata: Optional[Dict] = None,
-             sync: bool = False):
+             sync: bool = False, layout: Optional[Dict] = None):
         """Snapshot ``state`` under ``step``. ``sync=True`` forces the write
         onto the caller's thread even for an async manager (the emergency
-        preemption path must not race process teardown)."""
+        preemption path must not race process teardown).
+
+        ``layout`` records per-array data-parallel sharding metadata
+        (``{path: {"axis": a, "world": N, "even": bool}}``) for snapshots
+        whose arrays were gathered to GLOBAL from sharded ranks — the
+        contract :func:`reshard_train_state` consumes to reload the
+        snapshot at a different world size."""
         flat = _flatten_state(state)
         # materialize on host NOW (so async write sees a consistent snapshot)
         arrays = {}
@@ -175,10 +321,27 @@ class CheckpointManager:
         tree_blob = json.dumps({"treedef": treedef.to_json(),
                                 "pyvals": pyvals}, default=_py_default)
         checksums = {path: _crc32(arr) for path, arr in arrays.items()}
+        # topology metadata: every array's GLOBAL shape, the save-time mesh
+        # axis sizes, and (for gathered-from-ranks snapshots) the explicit
+        # dp layout — enough for a later load to resolve dp=N±k resharding
+        # instead of assuming the world it was saved under
+        mesh_axes: Dict[str, int] = {}
+        try:
+            from ..distributed.env import get_mesh
+
+            mesh = get_mesh()
+            if mesh is not None:
+                mesh_axes = {str(k): int(v) for k, v in mesh.shape.items()}
+        except Exception:
+            pass
         meta_blob = json.dumps({"step": step, "specs": specs,
                                 "prng_keys": prng_keys,
                                 "checksums": checksums,
                                 "tree_crc": zlib.crc32(tree_blob.encode()),
+                                "shapes": {p: list(a.shape)
+                                           for p, a in arrays.items()},
+                                "mesh_axes": mesh_axes,
+                                "layout": layout or {},
                                 "metadata": metadata or {}},
                                default=_py_default)
 
@@ -214,20 +377,51 @@ class CheckpointManager:
     def _write(self, step, arrays, tree_blob, meta_blob):
         final = os.path.join(self.directory, f"step_{step}")
         tmp = tempfile.mkdtemp(prefix=f".tmp_step_{step}_", dir=self.directory)
+
+        def _durable(name, data, mode):
+            # write-to-temp + flush + fsync: an os-crash between the data
+            # write and the dir rename must never publish a step dir whose
+            # files are still in the page cache — that torn state would
+            # carry a stale-but-CRC-consistent meta.json next to truncated
+            # arrays, defeating the newest-intact fallback
+            with open(os.path.join(tmp, name), mode) as f:
+                if callable(data):
+                    data(f)
+                else:
+                    f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+
         try:
-            with open(os.path.join(tmp, _ARRAYS), "wb") as f:
-                np.savez(f, **{k.replace("/", "|"): v for k, v in arrays.items()})
-            with open(os.path.join(tmp, _PYTREE), "w") as f:
-                f.write(tree_blob)
-            with open(os.path.join(tmp, _META), "w") as f:
-                f.write(meta_blob)
+            _durable(_ARRAYS, lambda f: np.savez(
+                f, **{k.replace("/", "|"): v for k, v in arrays.items()}),
+                "wb")
+            _durable(_PYTREE, tree_blob, "w")
+            _durable(_META, meta_blob, "w")
+            self._fsync_dir(tmp)
             if os.path.exists(final):
                 shutil.rmtree(final)
             os.rename(tmp, final)  # atomic publish
+            # make the rename itself durable: the parent dir entry must hit
+            # disk before save() reports success (preemption follows fast)
+            self._fsync_dir(self.directory)
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
         self._prune()
+
+    @staticmethod
+    def _fsync_dir(path):
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            return  # platform without O_RDONLY dir opens: best effort
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass
+        finally:
+            os.close(fd)
 
     def _prune(self):
         steps = self.all_steps()
@@ -362,10 +556,12 @@ class CheckpointManager:
 
                 entries = [tuple(e) if isinstance(e, list) else e for e in spec]
                 ps = sanitize_spec(PartitionSpec(*entries), mesh)
+                _check_reshardable(path, arr.shape, ps, mesh)
                 arrays[path] = jax.device_put(arr, NamedSharding(mesh, ps))
             else:
                 arrays[path] = jax.numpy.asarray(arr)
         self.last_loaded_step = step
+        self.last_loaded_meta = meta
         return tree["treedef"].unflatten(arrays, tree["pyvals"]), meta["metadata"]
 
 

@@ -14,6 +14,7 @@ Parity: FLAGS_check_nan_inf, incubate.checkpoint.auto_checkpoint and the
 fleet elastic etcd heartbeats, redesigned as a TPU-native runtime (see
 PARITY.md "Fault tolerance").
 """
+from .elastic_trainer import ElasticDPTrainer  # noqa: F401
 from .preemption import DEADLINE_ENV, PreemptionGuard, capture_train_state  # noqa: F401
 from .retry import RetryError, backoff_delays, call_with_retries  # noqa: F401
 from .sentinel import (  # noqa: F401
@@ -34,4 +35,5 @@ __all__ = [
     "sentinel_init_state", "sentinel_observe", "sentinel_to_host",
     "PreemptionGuard", "capture_train_state", "DEADLINE_ENV",
     "RetryError", "backoff_delays", "call_with_retries",
+    "ElasticDPTrainer",
 ]

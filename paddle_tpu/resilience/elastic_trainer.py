@@ -1,0 +1,316 @@
+"""Elastic multi-process data-parallel training with resharded recovery.
+
+Parity: Fleet's elastic multi-node training (the reference's etcd-driven
+``ElasticManager`` + collective trainer relaunch). The r7 layer already
+survives failures *within* one process (sentinel, preemption checkpoints);
+this module survives the failure that dominates production TPU fleets — a
+whole RANK preempted mid-run:
+
+* dp rank processes coordinate membership through the elastic
+  :class:`~paddle_tpu.distributed.fleet.elastic.manager.ElasticManager`
+  (heartbeat TTL liveness) and exchange gradients through the store's KV
+  plane (:class:`~paddle_tpu.distributed.fleet.elastic.collective
+  .ElasticCollective`) in deterministic rank order;
+* momentum slots are ZeRO-style sharded: each rank owns a contiguous
+  row-partition of every slot array (``checkpoint.shard_bounds``) and
+  updates only its partition of the params, allgathering the shards back —
+  the update is elementwise, so the global result is independent of the
+  partitioning;
+* rank 0 periodically gathers the slot shards and writes ONE global
+  snapshot stamped with the dp layout
+  (``CheckpointManager.save(layout=...)``) — the snapshot is
+  world-size-agnostic;
+* when a rank's heartbeat lapses mid-collective (:class:`RankFailure`),
+  survivors bump the rendezvous generation, agree on the new world size,
+  reshard the newest INTACT snapshot
+  (:func:`~paddle_tpu.framework.checkpoint.reshard_train_state`) and
+  continue — the recovery leader broadcasts the chosen snapshot step so
+  two survivors can never resume from different checkpoints.
+
+Because gradients are averaged in rank order and the data stream is keyed
+by ``(step, rank, world)``, the survivors' post-recovery loss trajectory is
+bit-identical to a fresh (N−k)-rank run restored from the same resharded
+snapshot — the e2e acceptance test SIGKILLs a rank and asserts exactly
+that.
+"""
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..distributed.fleet.elastic.collective import (
+    ElasticCollective,
+    RankFailure,
+    pack_arrays,
+    unpack_arrays,
+)
+from ..framework.checkpoint import (
+    CheckpointManager,
+    reshard_train_state,
+    shard_bounds,
+    shard_slice,
+    unshard,
+)
+
+__all__ = ["ElasticDPTrainer"]
+
+GradFn = Callable[[Dict[str, np.ndarray], int, int, int],
+                  Tuple[float, Dict[str, np.ndarray]]]
+
+
+class ElasticDPTrainer:
+    """Data-parallel momentum-SGD driver for one elastic rank process.
+
+    ``grad_fn(params, step, rank, world) -> (loss, grads)`` computes this
+    rank's local loss/gradients on its shard of the global batch — it must
+    be a pure function of its arguments (the data stream keyed by
+    ``(step, rank, world)``), which is what makes recovery trajectories
+    reproducible. ``init_params()`` must return identical arrays on every
+    rank (seed it).
+
+    The manager's store must be a ``_TcpStore`` (HTTP KV server): the
+    shared-filesystem fallback has no KV data plane.
+    """
+
+    def __init__(self, manager, ckpt_dir: str, grad_fn: GradFn,
+                 init_params: Callable[[], Dict[str, np.ndarray]], *,
+                 lr: float = 0.1, momentum: float = 0.9, min_ranks: int = 1,
+                 save_every: int = 1, keep_max: int = 10,
+                 step_timeout: float = 60.0, rendezvous_timeout: float = 60.0,
+                 on_step: Optional[Callable] = None,
+                 on_event: Optional[Callable[[str], None]] = None):
+        if not hasattr(manager.store, "scan"):
+            raise TypeError(
+                "ElasticDPTrainer needs a KV-plane store (_TcpStore via "
+                "PADDLE_ELASTIC_SERVER); the shared-FS _FileStore only "
+                "does membership")
+        self.manager = manager
+        self.collective = ElasticCollective(manager.store, manager.node_id)
+        self.ckpt = CheckpointManager(ckpt_dir, keep_max=keep_max)
+        self.grad_fn = grad_fn
+        self.init_params = init_params
+        self.lr = float(lr)
+        self.momentum = float(momentum)
+        self.min_ranks = int(min_ranks)
+        self.save_every = max(1, int(save_every))
+        self.step_timeout = float(step_timeout)
+        self.rendezvous_timeout = float(rendezvous_timeout)
+        self.on_step = on_step
+        self.on_event = on_event or (lambda msg: None)
+        self.params: Dict[str, np.ndarray] = {}
+        self.velocity: Dict[str, np.ndarray] = {}  # THIS RANK'S shards only
+        self.step = 0
+        self.recoveries = 0
+        # rank 0's _pick_snapshot already fully loads the newest snapshot
+        # to resolve its step; _restore reuses that load instead of paying
+        # the read+CRC cost twice per recovery
+        self._pick_cache: Optional[tuple] = None
+        self.history: List[Tuple[int, int, float]] = []  # (step, world, loss)
+
+    # -- state shape ----------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return self.collective.rank
+
+    @property
+    def world(self) -> int:
+        return self.collective.world
+
+    def _layout(self) -> Dict[str, Dict]:
+        """Snapshot layout: slot arrays are gathered-from-sharded (axis 0
+        over the CURRENT world); params are replicated (absent)."""
+        return {f"/velocity/{n}": {"axis": 0, "world": self.world}
+                for n in self.params}
+
+    @staticmethod
+    def _check_shardable(params: Dict[str, np.ndarray]):
+        """Momentum slots are row-sharded over axis 0, so every parameter
+        needs at least one axis — fail a 0-d (scalar) param up front with
+        guidance instead of an IndexError deep inside step 1."""
+        bad = sorted(n for n, p in params.items() if np.ndim(p) == 0)
+        if bad:
+            raise ValueError(
+                f"ElasticDPTrainer cannot row-shard 0-d parameter(s) "
+                f"{bad}: reshape scalars to (1,) in init_params()")
+
+    def _fresh_velocity(self):
+        self.velocity = {
+            n: np.zeros_like(shard_slice(p, self.world, self.rank))
+            for n, p in self.params.items()
+        }
+
+    # -- lifecycle ------------------------------------------------------
+    def _join(self, gen: int, min_ranks: Optional[int] = None):
+        self.collective.rendezvous(gen,
+                                   min_ranks=min_ranks or self.min_ranks,
+                                   timeout=self.rendezvous_timeout)
+        self.on_event(f"rendezvous gen={gen} rank={self.rank}/"
+                      f"{self.world} members={self.collective.members}")
+
+    def _pick_snapshot(self, prefer: Optional[int] = None) -> Optional[int]:
+        """Leader-broadcast snapshot decision, run by EVERY member after
+        EVERY rendezvous commit (initial join and recovery alike — a rank
+        on the initial path and a rank mid-recovery meet in the same
+        generation, so the protocol must be symmetric or the non-leader
+        waits for a broadcast that never comes). Rank 0 resolves the step
+        (``prefer`` if forced, else the newest INTACT snapshot — corrupt
+        ones are skipped with a warning by CheckpointManager.load) and
+        broadcasts it; peers poll for the decision instead of each walking
+        the directory — two survivors must never resume from different
+        steps."""
+        key = f"recover{self.collective.generation}"
+        if self.rank == 0:
+            if prefer is not None:
+                chosen: Optional[int] = prefer
+            else:
+                try:
+                    state, metadata = self.ckpt.load()
+                    chosen = self.ckpt.last_loaded_step
+                    self._pick_cache = (chosen, state,
+                                        self.ckpt.last_loaded_meta or {},
+                                        metadata)
+                except FileNotFoundError:
+                    chosen = None
+            self.manager.store.put(key, json.dumps({"step": chosen}))
+            return chosen
+        import time as _time
+
+        deadline = _time.monotonic() + self.rendezvous_timeout
+        while _time.monotonic() < deadline:
+            raw = self.manager.store.get(key)
+            if raw is not None:
+                return json.loads(raw)["step"]
+            leader = self.collective.members[0]
+            if leader not in self.manager.store.nodes():
+                raise RankFailure("recovery leader died before "
+                                  "broadcasting the snapshot step",
+                                  dead=[leader])
+            _time.sleep(0.05)
+        raise TimeoutError("no snapshot decision from the recovery leader")
+
+    def _restore(self, snapshot_step: Optional[int]):
+        """Load + reshard ``snapshot_step`` (None ⇒ virgin start)."""
+        cache, self._pick_cache = self._pick_cache, None
+        if snapshot_step is None:
+            self.params = {n: np.array(a)
+                           for n, a in self.init_params().items()}
+            self._check_shardable(self.params)
+            self._fresh_velocity()
+            self.step = 0
+            self.on_event("restore: no snapshot, starting from init")
+            return
+        if cache is not None and cache[0] == snapshot_step:
+            state, full_meta, _meta = cache[1], cache[2], cache[3]
+        else:
+            state, _meta = self.ckpt.load(step=snapshot_step)
+            full_meta = self.ckpt.last_loaded_meta or {}
+        layout = full_meta.get("layout", {})
+        local = reshard_train_state(state, layout, self.world, self.rank)
+        self.params = {n: np.array(a) for n, a in state["params"].items()}
+        self._check_shardable(self.params)
+        self.velocity = {n: np.array(a)
+                         for n, a in local["velocity"].items()}
+        self.step = int(state["step"]) + 1
+        self.on_event(
+            f"restore: snapshot step={snapshot_step} "
+            f"(saved at world={_meta.get('world')}) resharded to "
+            f"world={self.world}, resuming at step {self.step}")
+
+    def _recover(self, reason: str, prefer: Optional[int] = None):
+        """Re-rendezvous on the survivors and reload/reshard. Loops when a
+        FURTHER rank dies mid-recovery (e.g. the recovery leader), bounded
+        by the rendezvous timeout per attempt. ``prefer`` forwards an
+        explicit snapshot step (the initial-restore path retrying after
+        the leader died pre-broadcast must not lose its ``resume_step``)."""
+        self.recoveries += 1
+        while True:
+            self.on_event(f"recovering ({reason})")
+            try:
+                self._join(self.collective.generation + 1)
+                self._restore(self._pick_snapshot(prefer=prefer))
+                return
+            except RankFailure as e:
+                reason = str(e)
+
+    # -- one step --------------------------------------------------------
+    def _train_one_step(self) -> float:
+        s, world, rank = self.step, self.world, self.rank
+        loss, grads = self.grad_fn(self.params, s, rank, world)
+        blobs = self.collective.allgather(
+            f"g{s}", pack_arrays({"loss": np.asarray([loss], np.float64),
+                                  **grads}),
+            timeout=self.step_timeout)
+        trees = [unpack_arrays(b) for b in blobs]  # rank order
+        mean_loss = float(np.mean(np.stack(
+            [t["loss"][0] for t in trees])))
+        save_now = (s % self.save_every) == 0
+        out: Dict[str, np.ndarray] = {}
+        for n in sorted(self.params):
+            # slice each peer's gradient to OUR row shard before the
+            # mean: elementwise over the same W values in the same stack
+            # order, so bit-identical to averaging the full arrays, and
+            # W× cheaper on the hot path
+            lo, hi = shard_bounds(self.params[n].shape[0], world)[rank]
+            g = np.mean(np.stack([t[n][lo:hi] for t in trees]), axis=0)
+            v = self.momentum * self.velocity[n] + g
+            self.velocity[n] = v
+            out[f"p:{n}"] = self.params[n][lo:hi] - self.lr * v
+            if save_now:
+                out[f"v:{n}"] = v
+        shard_blobs = self.collective.allgather(
+            f"p{s}", pack_arrays(out), timeout=self.step_timeout)
+        shards = [unpack_arrays(b) for b in shard_blobs]
+        for n in self.params:
+            self.params[n] = unshard([t[f"p:{n}"] for t in shards])
+        if save_now and rank == 0:
+            velocity = {n: unshard([t[f"v:{n}"] for t in shards])
+                        for n in self.params}
+            self.ckpt.save(s, {"params": dict(self.params),
+                               "velocity": velocity, "step": s},
+                           metadata={"world": world},
+                           layout=self._layout())
+        return mean_loss
+
+    # -- driver ----------------------------------------------------------
+    def run(self, total_steps: int, resume_step: Optional[int] = None,
+            wait_world: Optional[int] = None) -> List[Tuple[int, int, float]]:
+        """Train to ``total_steps`` global steps, recovering from rank
+        failures along the way. ``resume_step`` forces the initial restore
+        to an explicit snapshot (the fresh-run-from-resharded-snapshot
+        comparison arm); default is newest-intact-or-init. ``wait_world``
+        makes the INITIAL rendezvous hold out for that many ranks (a
+        cohort launched together must not let its fastest starter commit
+        a world of one and train ahead); recoveries still commit on
+        whatever survives (``min_ranks``)."""
+        self.manager.register()
+        # join one PAST the highest generation ever proposed: incumbents
+        # (if any) will meet us there on their next membership check, and
+        # racing fresh starters adopt the max inside rendezvous()
+        self._join(self.collective.latest_generation() + 1,
+                   min_ranks=max(self.min_ranks, wait_world or 0))
+        try:
+            self._restore(self._pick_snapshot(prefer=resume_step))
+        except RankFailure as e:
+            # the leader died between committing the rendezvous and
+            # broadcasting the snapshot step — recover exactly like a
+            # mid-training death (keeping the explicit resume preference)
+            self._recover(str(e), prefer=resume_step)
+        while self.step < total_steps:
+            if self.collective.membership_changed():
+                self._recover("membership changed at step boundary")
+                continue
+            try:
+                loss = self._train_one_step()
+            except RankFailure as e:
+                self._recover(str(e))
+                continue
+            self.history.append((self.step, self.world, loss))
+            if self.on_step is not None:
+                self.on_step(self.step, self.world, loss)
+            self.step += 1
+        return self.history
+
+    def close(self):
+        self.manager.exit()

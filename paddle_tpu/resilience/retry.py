@@ -30,7 +30,10 @@ def backoff_delays(retries: int, base: float = 0.05, max_delay: float = 2.0,
     each scaled by a uniform factor in [1-jitter, 1+jitter] so a fleet of
     clients retrying the same dead store spreads out instead of thundering."""
     for k in range(retries):
-        d = min(base * (2.0 ** k), max_delay)
+        # cap the exponent: 2.0**k overflows float (OverflowError) near
+        # k=1024, and long-lived poll loops (elastic wait_for_np) drive k
+        # far past the point where max_delay already dominates
+        d = min(base * (2.0 ** min(k, 63)), max_delay)
         yield d * (1.0 + jitter * (2.0 * random.random() - 1.0))
 
 

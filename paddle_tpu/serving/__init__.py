@@ -10,6 +10,9 @@ dynamic-batching executor over paged GPU kernels.
     scheduler — bounded FCFS admission, power-of-2 prefill buckets, drain
     server    — threaded HTTP submit/poll/stream front-end + retrying client
     metrics   — TTFT / token latency / throughput / occupancy / compile stats
+    router    — N-replica least-loaded failover (health checks, circuit
+                breaker, resubmit of never-started requests, drain-aware
+                takedown)
 """
 from .engine import ContinuousBatchingEngine  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
@@ -20,7 +23,13 @@ from .scheduler import (  # noqa: F401
     SchedulerClosed,
     power_of_two_buckets,
 )
-from .server import ServingClient, ServingServer  # noqa: F401
+from .router import NoReplicaAvailable, RoutedRequest, ServingRouter  # noqa: F401
+from .server import (  # noqa: F401
+    RequestFailedError,
+    ServingClient,
+    ServingServer,
+    StreamIncompleteError,
+)
 
 __all__ = [
     "ContinuousBatchingEngine",
@@ -32,4 +41,9 @@ __all__ = [
     "power_of_two_buckets",
     "ServingClient",
     "ServingServer",
+    "RequestFailedError",
+    "StreamIncompleteError",
+    "ServingRouter",
+    "RoutedRequest",
+    "NoReplicaAvailable",
 ]

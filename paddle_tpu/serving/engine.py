@@ -35,6 +35,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -43,6 +44,22 @@ from .metrics import ServingMetrics
 from .scheduler import FCFSScheduler, Request, power_of_two_buckets
 
 __all__ = ["ContinuousBatchingEngine"]
+
+# Tracing prefill_fn/step_fn temporarily hangs `_gen_cache` off the model's
+# attention layers; two engines sharing one model object (multi-replica
+# tests, A/B harnesses) must not trace concurrently or the attrs race —
+# one trace reads the other's tracers and the tick dies. One lock per
+# model, held only while a call may trace (first use of a bucket / step).
+_MODEL_TRACE_LOCKS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_MODEL_TRACE_LOCKS_GUARD = threading.Lock()
+
+
+def _model_trace_lock(model) -> threading.RLock:
+    with _MODEL_TRACE_LOCKS_GUARD:
+        lock = _MODEL_TRACE_LOCKS.get(model)
+        if lock is None:
+            lock = _MODEL_TRACE_LOCKS[model] = threading.RLock()
+        return lock
 
 
 class ContinuousBatchingEngine:
@@ -117,7 +134,10 @@ class ContinuousBatchingEngine:
         self.trace_counts: Dict[str, int] = {"prefill": 0, "step": 0}
         self._step_jit = None
         self._prefill_jit = None
+        self._trace_lock = _model_trace_lock(model)
+        self._traced_buckets: set = set()  # prefill avals already compiled
         self._lock = threading.Lock()  # engine tick mutual exclusion
+        self._abort = threading.Event()  # crash simulation: loop exits, NO drain
         self._build_programs()
 
     # -- traced programs ----------------------------------------------------
@@ -266,7 +286,11 @@ class ContinuousBatchingEngine:
             seed = int(req.seed)
         key = jax.random.PRNGKey(seed)
         before = self.trace_counts["prefill"]
-        with scope("serving.prefill"):
+        # first use of a bucket traces, and tracing mutates the SHARED
+        # model's attention layers — exclude other engines on this model
+        guard = (contextlib.nullcontext() if bucket in self._traced_buckets
+                 else self._trace_lock)
+        with scope("serving.prefill"), guard:
             first, key, self._kc, self._vc = self._prefill_jit(
                 self._params, self._buffers, jnp.asarray(ids),
                 jnp.asarray(np.int32(t0)), jnp.asarray(np.int32(slot_idx)),
@@ -274,6 +298,7 @@ class ContinuousBatchingEngine:
                 jnp.int32(-1 if req.top_k is None else req.top_k),
                 jnp.float32(1.0 if req.top_p is None else req.top_p),
                 self._kc, self._vc)
+        self._traced_buckets.add(bucket)
         self.metrics.on_prefill(self.trace_counts["prefill"] > before)
         first = int(first)
         req.state = Request.RUNNING
@@ -334,13 +359,17 @@ class ContinuousBatchingEngine:
                                     self._slots[j] = None
                                     self._active[j] = False
                             self._reset_cache()
+                    finally:
+                        self.scheduler.admission_settled()
                     if not occupied:
                         free.append(slot)  # finished/failed at prefill
                     did = True
             if self._active.any():
                 before = self.trace_counts["step"]
                 t_step = time.perf_counter()
-                with scope("serving.decode_step"):
+                guard = (self._trace_lock if self.trace_counts["step"] == 0
+                         else contextlib.nullcontext())
+                with scope("serving.decode_step"), guard:
                     nxt, tok, pos, keys, self._kc, self._vc = self._step_jit(
                         self._params, self._buffers,
                         jnp.asarray(self._tok[:, None]),
@@ -413,10 +442,19 @@ class ContinuousBatchingEngine:
             while self.scheduler.depth() > 0:  # interleave cap bounds each pop
                 for req in self.scheduler.take_admissions(self.scheduler.depth()):
                     req._finish(Request.FAILED, error)
+                    self.scheduler.admission_settled()
             if self._cache_lost():
                 self._reset_cache()
             self.metrics.set_gauges(self.scheduler.depth(),
                                     self.active_slots(), self.n_slots)
+
+    def abort(self):
+        """Abrupt-death hook (chaos testing / emergency teardown): the loop
+        thread exits at its next iteration WITHOUT draining — queued and
+        in-flight requests are simply orphaned, exactly like a SIGKILLed
+        replica process. Failover responsibility moves to the serving
+        router, which is the point of simulating it."""
+        self._abort.set()
 
     def serve_forever(self, stop_event: threading.Event, idle_wait: float = 0.02):
         """Engine loop for a server thread: tick while there is work; block
@@ -424,7 +462,7 @@ class ContinuousBatchingEngine:
         is set AND all admitted work has drained (graceful drain). A tick
         that raises fails the affected requests (state FAILED, error
         recorded) instead of silently killing the loop thread."""
-        while True:
+        while not self._abort.is_set():
             try:
                 did = self.step_once()
             except Exception as e:  # contain: fail work, keep serving

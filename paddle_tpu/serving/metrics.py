@@ -106,6 +106,22 @@ class ServingMetrics:
             self.active_slots = active_slots
             self.n_slots = n_slots
 
+    def retry_after_hint(self, queue_depth: Optional[int] = None) -> float:
+        """Seconds a 429'd client should wait before retrying: the queued
+        work ahead of it (queue depth × mean generated tokens per completed
+        request) at the CURRENT measured token rate. Floors at 1s when the
+        engine has no rate history yet; capped at 60s so a stale rate can't
+        tell clients to go away for minutes."""
+        tput = self.tokens_per_sec()
+        with self._lock:
+            depth = self.queue_depth if queue_depth is None else int(queue_depth)
+            completed = self.requests_completed
+            tokens = self.tokens_generated
+        if not tput or tput <= 0 or completed <= 0 or depth <= 0:
+            return 1.0
+        eta = depth * (tokens / completed) / tput
+        return float(min(max(eta, 1.0), 60.0))
+
     # -- snapshot -----------------------------------------------------------
     def tokens_per_sec(self) -> Optional[float]:
         with self._lock:

@@ -1,0 +1,547 @@
+"""Multi-replica serving router: health-checked least-loaded failover.
+
+Parity: PaddlePaddle deploys inference behind Paddle Serving's multi-worker
+front-end (N brpc workers behind a dispatcher) and the fleet
+parameter-server's liveness-tracked worker pool; this is that capability for
+the continuous-batching engine — N :class:`~.server.ServingServer` replicas
+behind one router, surviving a replica dying mid-stream.
+
+Mechanics:
+
+* **Health + load** come from each replica's ``/metrics`` endpoint (the
+  :class:`~.metrics.ServingMetrics` snapshot): liveness is "the endpoint
+  answers", load is ``queue_depth + active_slots`` — new requests go to the
+  least-loaded CLOSED replica (drain-marked replicas are never picked).
+* **Circuit breaker** per replica: ``failure_threshold`` consecutive
+  transport failures OPEN the breaker (ejected from routing); after
+  ``cooldown_s`` it goes HALF_OPEN and the next health probe (or routed
+  call) decides — success rejoins (CLOSED), failure re-opens.
+* **Failover**: every routed request remembers how many tokens the router
+  has OBSERVED. When a replica dies, requests with zero observed tokens
+  (queued / not yet prefilled — the engine's slot scheduler had not
+  started them, so re-running loses nothing) are resubmitted with backoff
+  onto a surviving replica; requests that already streamed tokens are
+  surfaced as FAILED through poll/stream — a half-finished generation must
+  never be silently truncated OR silently restarted with different
+  sampling.
+* **Drain-aware takedown**: :meth:`ServingRouter.drain` stops routing to a
+  replica, asks it to close admissions (``POST /admin/drain``), and polls
+  its metrics until queue and slots are empty — the replica can then be
+  stopped with zero dropped queued requests.
+"""
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..resilience.retry import RetryError, backoff_delays
+from .scheduler import QueueFullError, Request, SchedulerClosed
+from .server import RequestFailedError, ServingClient, StreamIncompleteError
+
+__all__ = ["ServingRouter", "RoutedRequest", "NoReplicaAvailable"]
+
+
+class NoReplicaAvailable(RuntimeError):
+    """Every replica is ejected, draining, or unreachable — HTTP 503."""
+
+    http_status = 503
+
+
+class _Replica:
+    """Router-side view of one engine replica (breaker + load gauges)."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, addr: str, timeout: float, probe_timeout: float):
+        self.addr = addr
+        # retries=0: the ROUTER owns retry policy — a dead replica must
+        # surface immediately so failover starts, not after 4 backoffs
+        self.client = ServingClient(addr, timeout=timeout, retries=0)
+        # health probes get their own short-deadline client: the single
+        # health thread walks every replica sequentially, so one SYN
+        # black hole (host partitioned, not RST-ing) must cost
+        # probe_timeout, not a full request_timeout per cycle — otherwise
+        # the survivors' load gauges go stale and the corpse's breaker
+        # takes threshold×request_timeout to open
+        self.probe_client = ServingClient(addr, timeout=probe_timeout,
+                                          retries=0)
+        self.state = _Replica.CLOSED
+        self.consecutive_failures = 0
+        self.opened_at: Optional[float] = None
+        self.draining = False
+        self.alive = True
+        self.queue_depth = 0
+        self.active_slots = 0
+        self.n_slots = 0
+        self.tokens_per_sec: Optional[float] = None
+
+    def load(self) -> float:
+        return self.queue_depth + self.active_slots
+
+    def snapshot(self) -> Dict:
+        return {"addr": self.addr, "state": self.state,
+                "draining": self.draining, "alive": self.alive,
+                "queue_depth": self.queue_depth,
+                "active_slots": self.active_slots, "n_slots": self.n_slots,
+                "consecutive_failures": self.consecutive_failures}
+
+
+class RoutedRequest:
+    """One generation request as the ROUTER tracks it: the immutable spec
+    (so it can be replayed on a survivor), where it currently lives, and
+    how many tokens the router has observed (the resubmit-eligibility
+    line)."""
+
+    def __init__(self, prompt, **spec):
+        self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1).tolist()
+        self.spec = dict(spec)
+        self.replica_addr: Optional[str] = None
+        self.remote_id: Optional[str] = None
+        self.tokens: List[int] = []
+        self.state = Request.PENDING
+        self.error: Optional[str] = None
+        # "request" (replica answered: request-level verdict) vs
+        # "transport" (replica death) — _replay_settled re-raises the same
+        # exception class a live poll/stream of the failure would have
+        self.failure_kind: Optional[str] = None
+        self.resubmits = 0
+        self.submitted_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+        self.failover_first_token_at: Optional[float] = None
+        # serializes failover: poll() and stream() may race on the same
+        # request, and both observing the same death must not resubmit
+        # the prompt twice
+        self._failover_lock = threading.Lock()
+        self._tokens_lock = threading.Lock()
+
+    @property
+    def done(self) -> bool:
+        return self.state in (Request.DONE, Request.FAILED)
+
+    def _observe(self, tokens: Sequence[int]):
+        # the length check and the assignment must be one atomic unit: a
+        # poll thread and a stream thread observe the same request, and a
+        # stream preempted between check and write could REGRESS a longer
+        # log a racing poll just recorded — _replay_settled would then
+        # yield the truncated log as a complete generation
+        with self._tokens_lock:
+            if len(tokens) <= len(self.tokens):
+                return
+            now = time.perf_counter()
+            if self.first_token_at is None:
+                self.first_token_at = now
+            if self.resubmits and self.failover_first_token_at is None:
+                self.failover_first_token_at = now
+            self.tokens = list(tokens)
+
+
+class ServingRouter:
+    """Spread requests over N engine replicas with failover.
+
+    ``with ServingRouter([addr1, addr2]) as r:`` starts the health-check
+    thread; ``submit``/``wait``/``stream`` mirror :class:`ServingClient`
+    but survive a replica death for requests the dead replica had not
+    started generating.
+    """
+
+    def __init__(self, replicas: Sequence[str], *,
+                 failure_threshold: int = 3, cooldown_s: float = 1.0,
+                 health_interval_s: float = 0.2, request_timeout: float = 10.0,
+                 probe_timeout_s: float = 1.0,
+                 resubmit_retries: int = 4, poll_s: float = 0.02):
+        if not replicas:
+            raise ValueError("need at least one replica address")
+        probe_timeout = min(float(probe_timeout_s), float(request_timeout))
+        self.replicas: Dict[str, _Replica] = {
+            a: _Replica(a, timeout=request_timeout,
+                        probe_timeout=probe_timeout) for a in replicas}
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.health_interval_s = float(health_interval_s)
+        self.resubmit_retries = int(resubmit_retries)
+        self.poll_s = float(poll_s)
+        self.failovers = 0        # replica deaths that triggered resubmits
+        self.resubmits = 0        # requests re-homed onto a survivor
+        self.inflight_failures = 0  # requests surfaced FAILED (had tokens)
+        self._lock = threading.RLock()
+        self._health_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self):
+        self._health_thread = threading.Thread(target=self._health_loop,
+                                               daemon=True)
+        self._health_thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(5.0)
+            self._health_thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- breaker bookkeeping --------------------------------------------
+    def _record_failure(self, rep: _Replica):
+        with self._lock:
+            rep.consecutive_failures += 1
+            rep.alive = False
+            if (rep.state == _Replica.HALF_OPEN
+                    or rep.consecutive_failures >= self.failure_threshold):
+                rep.state = _Replica.OPEN
+                rep.opened_at = time.monotonic()
+
+    def _record_success(self, rep: _Replica):
+        with self._lock:
+            rep.consecutive_failures = 0
+            rep.alive = True
+            if rep.state != _Replica.CLOSED:
+                rep.state = _Replica.CLOSED
+                rep.opened_at = None
+
+    def _tick_breaker(self, rep: _Replica):
+        with self._lock:
+            if (rep.state == _Replica.OPEN and rep.opened_at is not None
+                    and time.monotonic() - rep.opened_at >= self.cooldown_s):
+                rep.state = _Replica.HALF_OPEN  # next probe decides
+
+    # -- health ----------------------------------------------------------
+    def _probe(self, rep: _Replica):
+        """One health check: /metrics answers → liveness + load gauges; a
+        HALF_OPEN replica that answers rejoins (the half-open probe)."""
+        self._tick_breaker(rep)
+        if rep.state == _Replica.OPEN:
+            return
+        try:
+            snap = rep.probe_client.metrics()
+        except (OSError, RetryError, RuntimeError, ValueError,
+                http.client.HTTPException):
+            # HTTPException covers a replica killed mid-response
+            # (IncompleteRead/BadStatusLine are NOT OSErrors)
+            self._record_failure(rep)
+            return
+        with self._lock:
+            rep.queue_depth = int(snap.get("queue_depth", 0))
+            occ = snap.get("slot_occupancy", {})
+            rep.active_slots = int(occ.get("active", 0))
+            rep.n_slots = int(occ.get("total", 0))
+            rep.tokens_per_sec = snap.get("throughput_tokens_per_sec")
+            # MIRROR the replica's drain state rather than latching it: a
+            # replica restarted on the same address (reporting
+            # draining=false) must rejoin the rotation. A request racing
+            # the brief window between drain()'s flag and the replica
+            # closing admissions just completes on the draining replica —
+            # drain() polls until empty, so nothing is dropped.
+            rep.draining = bool(snap.get("draining"))
+        self._record_success(rep)
+
+    def _health_loop(self):
+        while not self._stop.wait(self.health_interval_s):
+            for rep in list(self.replicas.values()):
+                try:
+                    self._probe(rep)
+                except Exception:
+                    # a probe failure mode we did not anticipate must
+                    # count as the probe failing, never kill the daemon
+                    # health thread (breakers would freeze OPEN forever)
+                    self._record_failure(rep)
+
+    def check_health(self):
+        """Synchronous probe of every replica (tests / just-started
+        routers that have not accumulated health history yet)."""
+        for rep in list(self.replicas.values()):
+            self._probe(rep)
+
+    # -- routing ----------------------------------------------------------
+    def _candidates(self) -> List[_Replica]:
+        with self._lock:
+            reps = [r for r in self.replicas.values() if not r.draining]
+            for r in reps:
+                self._tick_breaker(r)
+            closed = [r for r in reps if r.state == _Replica.CLOSED]
+            half = [r for r in reps if r.state == _Replica.HALF_OPEN]
+        # least-loaded first; replicas OBSERVED dead (alive=False, breaker
+        # not yet open) go last so a failover resubmit doesn't re-dial the
+        # corpse (and block a request_timeout) while a live peer is free;
+        # HALF_OPEN replicas are probe targets of last resort (their first
+        # real request decides the breaker)
+        key = lambda r: (not r.alive, _Replica.load(r))
+        return sorted(closed, key=key) + sorted(half, key=key)
+
+    def _submit_somewhere(self, rr: RoutedRequest) -> None:
+        last_exc: Optional[Exception] = None
+        for rep in self._candidates():
+            try:
+                rid = rep.client.submit(rr.prompt, **rr.spec)
+            except (OSError, RetryError, ValueError,
+                    http.client.HTTPException) as e:  # transport: breaker
+                self._record_failure(rep)
+                last_exc = e
+                continue
+            except (QueueFullError, SchedulerClosed) as e:
+                # semantic backpressure: the replica is healthy, just full/
+                # draining — spill to the next one, surface if ALL are
+                last_exc = e
+                continue
+            self._record_success(rep)
+            with self._lock:
+                rep.queue_depth += 1  # optimistic, until the next probe
+            # remote_id MUST be published before replica_addr: poll/stream
+            # read addr first, so addr=new ⇒ id=new (addr=old + id=new just
+            # dials the corpse → transport error → addr-mismatch retry).
+            # The reverse order lets a racing poll send the OLD id to the
+            # NEW replica, whose 404 is a permanent request-level FAILED.
+            rr.remote_id = rid
+            rr.replica_addr = rep.addr
+            return
+        if isinstance(last_exc, (QueueFullError, SchedulerClosed)):
+            raise last_exc
+        raise NoReplicaAvailable(
+            f"no replica accepted the request "
+            f"({[r.snapshot() for r in self.replicas.values()]})"
+        ) from last_exc
+
+    def submit(self, prompt, **spec) -> RoutedRequest:
+        """Route one request to the least-loaded healthy replica. Raises
+        :class:`QueueFullError`/:class:`SchedulerClosed` only when EVERY
+        healthy replica says so, :class:`NoReplicaAvailable` when none is
+        reachable."""
+        rr = RoutedRequest(prompt, **spec)
+        self._submit_somewhere(rr)
+        return rr
+
+    # -- failover ---------------------------------------------------------
+    def _handle_replica_death(self, rr: RoutedRequest, err: Exception,
+                              addr: str) -> bool:
+        """A call for ``rr`` against replica ``addr`` hit a dead replica.
+        Returns True when the request was re-homed (safe: router never
+        observed a token), False when it must surface as FAILED
+        (generation had started). ``addr`` is the replica the CALLER was
+        talking to: a poll and a stream racing on the same request must
+        charge the breaker of the replica that actually died (never a
+        survivor the other thread already re-homed onto) and resubmit the
+        prompt at most once."""
+        with rr._failover_lock:
+            if rr.done:
+                return rr.state == Request.DONE
+            if rr.replica_addr != addr:
+                # another caller already re-homed rr onto a survivor while
+                # this one was timing out against the corpse
+                return True
+            return self._handle_replica_death_locked(rr, err)
+
+    def _handle_replica_death_locked(self, rr: RoutedRequest,
+                                     err: Exception) -> bool:
+        rep = self.replicas.get(rr.replica_addr)
+        if rep is not None:
+            # confirm the death before acting on ONE caller-side transport
+            # error: a healthy replica stalled past request_timeout (e.g.
+            # GIL-held jit of a new prefill bucket) times out a poll yet
+            # answers /metrics fine — declaring death would permanently
+            # FAIL an in-flight request the replica will finish, or
+            # resubmit a still-running prompt (two concurrent
+            # generations). Probe says alive ⇒ transient: leave the
+            # request in place, the caller's next poll/stream retries.
+            try:
+                rep.probe_client.metrics()
+            except (OSError, RetryError, RuntimeError, ValueError,
+                    http.client.HTTPException):
+                pass  # probe agrees: confirmed dead
+            else:
+                self._record_success(rep)
+                return True
+            self._record_failure(rep)
+        if rr.tokens:
+            with self._lock:
+                self.inflight_failures += 1
+            rr.failure_kind = "transport"
+            rr.state = Request.FAILED
+            rr.error = (f"replica {rr.replica_addr} died after "
+                        f"{len(rr.tokens)} tokens: {err}")
+            return False
+        with self._lock:
+            self.failovers += 1
+        delays = backoff_delays(self.resubmit_retries)
+        for attempt in range(self.resubmit_retries + 1):
+            try:
+                self._submit_somewhere(rr)
+                with self._lock:
+                    self.resubmits += 1
+                rr.resubmits += 1
+                return True
+            except (QueueFullError, SchedulerClosed, NoReplicaAvailable):
+                if attempt >= self.resubmit_retries:
+                    break
+                time.sleep(next(delays))
+        rr.failure_kind = "transport"
+        rr.state = Request.FAILED
+        rr.error = (f"replica {rr.replica_addr} died and no survivor "
+                    f"accepted the resubmit: {err}")
+        return False
+
+    # -- retrieval ---------------------------------------------------------
+    def poll(self, rr: RoutedRequest) -> Dict:
+        """One status poll, with failover. Returns the /v1/result payload
+        shape (id/status/tokens/error) from wherever ``rr`` currently
+        lives."""
+        if rr.done:
+            return {"id": rr.remote_id, "status": rr.state,
+                    "tokens": list(rr.tokens), "error": rr.error}
+        addr = rr.replica_addr
+        rep = self.replicas.get(addr)
+        try:
+            out = rep.client.result(rr.remote_id)
+        except RequestFailedError as e:
+            # the replica ANSWERED: a request-level verdict (unknown or
+            # evicted id), not a death — the breaker stays untouched and
+            # the request is NOT replayed elsewhere
+            self._record_success(rep)
+            rr.failure_kind = "request"
+            rr.state = Request.FAILED
+            rr.error = str(e)
+            return {"id": rr.remote_id, "status": rr.state,
+                    "tokens": list(rr.tokens), "error": rr.error}
+        except (OSError, RetryError, RuntimeError, ValueError,
+                http.client.HTTPException) as e:
+            # ValueError: a response truncated by the death parses as
+            # garbage JSON — same event as the connection dropping
+            self._handle_replica_death(rr, e, addr)
+            return {"id": rr.remote_id, "status": rr.state,
+                    "tokens": list(rr.tokens), "error": rr.error}
+        self._record_success(rep)
+        rr._observe(out.get("tokens", ()))
+        if out.get("status") in (Request.DONE, Request.FAILED):
+            rr.state = out["status"]
+            rr.error = out.get("error")
+        return out
+
+    def wait(self, rr: RoutedRequest, timeout: float = 60.0) -> Dict:
+        """Poll until ``rr`` finishes (surviving replica deaths along the
+        way); raises TimeoutError if it neither completes nor fails."""
+        deadline = time.perf_counter() + timeout
+        while True:
+            out = self.poll(rr)
+            if rr.done:
+                return out
+            if time.perf_counter() > deadline:
+                raise TimeoutError(f"request not done within {timeout}s "
+                                   f"(on {rr.replica_addr})")
+            time.sleep(self.poll_s)
+
+    def stream(self, rr: RoutedRequest):
+        """Yield generated tokens incrementally, failing over mid-stream:
+        a replica death before the first token transparently re-streams
+        from a survivor; after the first token it raises (the router must
+        not splice two generations together)."""
+        if rr.done:
+            # already settled (e.g. polled to completion, replica since
+            # dead): replay the recorded outcome — never reconnect to a
+            # corpse for tokens the router already has
+            yield from self._replay_settled(rr, 0)
+            return
+        while True:
+            addr = rr.replica_addr
+            rep = self.replicas.get(addr)
+            # the replica's stream replays from token 0 and is the
+            # authoritative sequence: observe THAT, never append to
+            # rr.tokens (a poll racing this stream may already have
+            # recorded tokens the stream is still catching up to)
+            streamed: List[int] = []
+            try:
+                for tok in rep.client.stream(rr.remote_id):
+                    streamed.append(int(tok))
+                    rr._observe(streamed)
+                    yield int(tok)
+                rr.state = Request.DONE
+                return
+            except RequestFailedError as e:
+                # the replica is healthy and says THE REQUEST failed: no
+                # breaker hit, no resubmit (a poison request replayed on
+                # every replica would open every breaker in turn)
+                self._record_success(rep)
+                rr.failure_kind = "request"
+                rr.state = Request.FAILED
+                rr.error = str(e)
+                raise
+            except StreamIncompleteError:
+                # server-side stream timeout: the request is still RUNNING
+                # on a healthy replica — surface to the caller (who can
+                # re-stream or poll), touch neither breaker nor request
+                self._record_success(rep)
+                raise
+            except (OSError, RetryError, RuntimeError, ValueError,
+                    http.client.HTTPException) as e:
+                # transport truncation/refusal (incl. a death-truncated
+                # body parsing as garbage JSON): the replica (or its
+                # handler) died mid-stream — the failover rule applies
+                if self._handle_replica_death(rr, e, addr):
+                    if rr.done:
+                        # settled while this observer was timing out (a
+                        # racing poll finished it): replay the remainder
+                        # instead of re-dialing the dead replica forever
+                        yield from self._replay_settled(rr, len(streamed))
+                        return
+                    continue  # re-homed: stream from the survivor
+                # a racing poll may have settled rr with a REQUEST-level
+                # verdict while this stream was failing on transport:
+                # surface the class the verdict contract promises
+                if rr.failure_kind == "request":
+                    raise RequestFailedError(rr.error or str(e)) from e
+                raise RuntimeError(rr.error or str(e)) from e
+
+    def _replay_settled(self, rr: RoutedRequest, skip: int):
+        """Yield a settled request's recorded tokens after ``skip`` (the
+        count a live stream already delivered); raise if it FAILED.
+        rr.tokens is safe to replay: state only reaches DONE after the
+        full token log was observed, and a re-home never happens once a
+        token exists, so the log is a single generation."""
+        if rr.state == Request.FAILED:
+            # same exception class a LIVE observation of this failure
+            # raised: request-level verdicts are RequestFailedError (the
+            # documented switch point), deaths stay RuntimeError
+            if rr.failure_kind == "request":
+                raise RequestFailedError(rr.error or "request failed")
+            raise RuntimeError(rr.error or "request failed")
+        for tok in list(rr.tokens)[skip:]:
+            yield int(tok)
+
+    # -- drain -------------------------------------------------------------
+    def drain(self, addr: str, timeout: float = 60.0):
+        """Take ``addr`` out of rotation with zero dropped queued requests:
+        stop routing to it, close its admissions, and block until its
+        queue and slots are empty. The replica process can then be stopped
+        (or killed) with nothing in flight."""
+        rep = self.replicas[addr]
+        with self._lock:
+            rep.draining = True
+        rep.client.admin_drain()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            snap = rep.client.metrics()
+            occ = snap.get("slot_occupancy", {})
+            if (int(snap.get("queue_depth", 0)) == 0
+                    and int(snap.get("in_admission", 0)) == 0
+                    and int(occ.get("active", 0)) == 0):
+                return
+            time.sleep(self.poll_s)
+        raise TimeoutError(f"replica {addr} did not drain within {timeout}s")
+
+    # -- observability ------------------------------------------------------
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "replicas": {a: r.snapshot()
+                             for a, r in self.replicas.items()},
+                "failovers": self.failovers,
+                "resubmits": self.resubmits,
+                "inflight_failures": self.inflight_failures,
+            }

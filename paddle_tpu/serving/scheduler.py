@@ -40,9 +40,18 @@ __all__ = [
 
 
 class QueueFullError(RuntimeError):
-    """Admission queue is at capacity — HTTP 429 Too Many Requests."""
+    """Admission queue is at capacity — HTTP 429 Too Many Requests.
+
+    ``retry_after`` (seconds, optional) is the backpressure hint the server
+    derives from current throughput and queue depth
+    (``ServingMetrics.retry_after_hint``) and ships in the ``Retry-After``
+    header; the client re-attaches it here."""
 
     http_status = 429
+
+    def __init__(self, msg: str = "queue full", retry_after=None):
+        super().__init__(msg)
+        self.retry_after = None if retry_after is None else float(retry_after)
 
 
 class SchedulerClosed(RuntimeError):
@@ -183,6 +192,12 @@ class FCFSScheduler:
         self._q: deque = deque()
         self._cond = threading.Condition()
         self._closed = False
+        # popped by take_admissions but not yet settled into a slot (or
+        # retired/failed) by the engine: during a prefill compile these
+        # requests are in NEITHER the queue nor a slot, and a drain that
+        # trusts depth()+active alone would declare the engine empty
+        # mid-prefill and orphan them
+        self._in_admission = 0
 
     # -- admission ----------------------------------------------------------
     def bucket_for(self, prompt_len: int) -> int:
@@ -218,7 +233,21 @@ class FCFSScheduler:
         with self._cond:
             while self._q and len(out) < n:
                 out.append(self._q.popleft())
+            # counted under the SAME lock as the pop: a concurrent
+            # metrics read sees each request as queued or in-admission,
+            # never neither
+            self._in_admission += len(out)
         return out
+
+    def admission_settled(self, n: int = 1):
+        """The engine finished placing ``n`` taken requests (active slot,
+        retired at prefill, or failed)."""
+        with self._cond:
+            self._in_admission = max(0, self._in_admission - int(n))
+
+    def in_admission(self) -> int:
+        with self._cond:
+            return self._in_admission
 
     def depth(self) -> int:
         with self._cond:

@@ -32,6 +32,7 @@ restarts), mirroring how the elastic store hardens its KV client.
 from __future__ import annotations
 
 import json
+import socket
 import threading
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -42,7 +43,32 @@ import numpy as np
 from .engine import ContinuousBatchingEngine
 from .scheduler import QueueFullError, Request, SchedulerClosed
 
-__all__ = ["ServingServer", "ServingClient"]
+__all__ = ["ServingServer", "ServingClient", "RequestFailedError",
+           "StreamIncompleteError"]
+
+
+class RequestFailedError(RuntimeError):
+    """The replica ANSWERED and its verdict is about the REQUEST (engine
+    reported it failed, or the id is unknown/evicted) — the replica
+    itself is healthy. Routers must not count this against the replica's
+    circuit breaker or resubmit the request elsewhere (a poison request
+    would cascade through every replica opening every breaker)."""
+
+
+class StreamIncompleteError(RuntimeError):
+    """The server's stream ended while the request was still RUNNING (the
+    server-side stream timeout). The request may yet finish — poll it;
+    neither a replica death nor a request failure."""
+
+
+class _QuietHTTPServer(ThreadingHTTPServer):
+    """handle_error lives on the SERVER (socketserver.BaseServer), not the
+    request handler — kill() severs established sockets, and every handler
+    thread's ConnectionResetError lands here instead of a stderr
+    traceback per open connection."""
+
+    def handle_error(self, request, client_address):  # quiet
+        pass
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -52,6 +78,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     def log_message(self, *args):  # quiet
         pass
+
+    def setup(self):
+        super().setup()
+        self.server_ref._track_conn(self.connection)
+
+    def finish(self):
+        self.server_ref._untrack_conn(self.connection)
+        super().finish()
 
     # -- helpers ------------------------------------------------------------
     def _json(self, status: int, payload: Dict):
@@ -70,7 +104,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- routes -------------------------------------------------------------
     def do_POST(self):
-        if self.path.rstrip("/") != "/v1/generate":
+        path = self.path.rstrip("/")
+        if path == "/admin/drain":
+            # drain-aware takedown, step 1: stop admitting. Queued and
+            # in-flight requests still run to completion; the router polls
+            # /metrics until the replica is empty before retiring it.
+            self.server_ref.engine.scheduler.close()
+            self._json(200, {"draining": True})
+            return
+        if path != "/v1/generate":
             self._json(404, {"error": "unknown endpoint"})
             return
         try:
@@ -87,7 +129,18 @@ class _Handler(BaseHTTPRequestHandler):
                 if k in spec})
             self.server_ref.engine.submit(req)
         except QueueFullError as e:
-            self._json(429, {"error": str(e)})
+            # backpressure with a USEFUL hint: seconds of queued work ahead
+            # at the measured token rate (RFC 7231 Retry-After)
+            hint = self.server_ref.engine.metrics.retry_after_hint(
+                queue_depth=self.server_ref.engine.scheduler.depth())
+            body = json.dumps({"error": str(e),
+                               "retry_after_s": hint}).encode()
+            self.send_response(429)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Retry-After", str(int(hint + 0.5) or 1))
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
             return
         except SchedulerClosed as e:
             self._json(503, {"error": str(e)})
@@ -101,7 +154,24 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         parts = [p for p in self.path.split("/") if p]
         if parts == ["metrics"]:
-            self._json(200, self.server_ref.engine.metrics.snapshot())
+            eng = self.server_ref.engine
+            snap = eng.metrics.snapshot()
+            # the router's routing/drain decisions ride on these, so they
+            # must be LIVE admission state — the registry's gauges are only
+            # as fresh as the last engine tick (stale while the loop is
+            # compiling, idle, or wedged, which is exactly when a router
+            # must not believe the replica is empty)
+            snap["queue_depth"] = eng.scheduler.depth()
+            # popped from the queue but not yet active (mid-prefill): a
+            # drain that ignored these would orphan a request whose
+            # first compile outlasts the poll interval
+            snap["in_admission"] = eng.scheduler.in_admission()
+            active = eng.active_slots()
+            snap["slot_occupancy"] = {
+                "active": active, "total": eng.n_slots,
+                "fraction": active / eng.n_slots if eng.n_slots else 0.0}
+            snap["draining"] = eng.scheduler.closed
+            self._json(200, snap)
             return
         if len(parts) == 3 and parts[:2] == ["v1", "result"]:
             req = self._request_or_404(parts[2])
@@ -133,8 +203,8 @@ class _Handler(BaseHTTPRequestHandler):
                     {"done": True, "status": req.state,
                      "n_tokens": len(req.tokens)}) + "\n").encode())
                 self.wfile.flush()
-            except (BrokenPipeError, ConnectionResetError):
-                pass  # client went away mid-stream
+            except OSError:
+                pass  # client went away / kill() severed the socket
             return
         self._json(404, {"error": "unknown endpoint"})
 
@@ -145,20 +215,37 @@ class ServingServer:
 
     def __init__(self, engine: ContinuousBatchingEngine, port: int = 0,
                  host: str = "127.0.0.1", stream_timeout: float = 60.0,
-                 max_kept_requests: int = 4096):
+                 max_kept_requests: int = 4096, drain_timeout_s: float = 30.0):
         self.engine = engine
         self.stream_timeout = float(stream_timeout)
         self.max_kept_requests = int(max_kept_requests)
+        # graceful-drain deadline: how long stop()/drain() wait for queued +
+        # in-flight work before declaring the engine stuck (was an implicit
+        # hard-coded default; operators sizing long generations need it)
+        self.drain_timeout_s = float(drain_timeout_s)
         self._requests: "OrderedDict[str, Request]" = OrderedDict()
         self._requests_lock = threading.Lock()
+        # established handler connections: kill() must sever these so a
+        # client mid-stream sees a reset (like a real process SIGKILL),
+        # not a silent socket that only dies at its own read timeout
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         handler = type("_BoundHandler", (_Handler,), {"server_ref": self})
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd = _QuietHTTPServer((host, port), handler)
         self.host = host
         self.port = self._httpd.server_address[1]
         self.addr = f"{host}:{self.port}"
         self._http_thread: Optional[threading.Thread] = None
         self._engine_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+
+    def _track_conn(self, sock):
+        with self._conns_lock:
+            self._conns.add(sock)
+
+    def _untrack_conn(self, sock):
+        with self._conns_lock:
+            self._conns.discard(sock)
 
     def _register(self, req: Request):
         """Track a request for poll/stream, evicting the OLDEST finished
@@ -185,22 +272,58 @@ class ServingServer:
 
     def drain(self, timeout: Optional[float] = None):
         """Graceful drain: stop admitting (new submits → 503), finish every
-        queued and in-flight request, stop the engine loop."""
+        queued and in-flight request, stop the engine loop. ``timeout``
+        defaults to the server's configured ``drain_timeout_s``."""
+        timeout = self.drain_timeout_s if timeout is None else timeout
         self.engine.scheduler.close()
         self._stop.set()
         if self._engine_thread is not None:
             self._engine_thread.join(timeout)
             if self._engine_thread.is_alive():
-                raise TimeoutError("engine did not drain in time")
+                raise TimeoutError(
+                    f"engine did not drain within {timeout}s "
+                    f"(drain_timeout_s={self.drain_timeout_s})")
             self._engine_thread = None
 
-    def stop(self, timeout: Optional[float] = 30.0):
+    def stop(self, timeout: Optional[float] = None):
+        timeout = self.drain_timeout_s if timeout is None else timeout
         self.drain(timeout)
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._http_thread is not None:
             self._http_thread.join(timeout)
             self._http_thread = None
+
+    def kill(self):
+        """Abrupt-death chaos hook: tear down the HTTP plane and abort the
+        engine loop with NO drain — queued/in-flight requests are orphaned
+        exactly as if the replica process took a SIGKILL. Clients see
+        connection-refused; recovery is the ROUTER's job (resubmit of
+        never-prefilled requests, surfaced failure for in-flight ones)."""
+        self.engine.abort()
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        # sever established connections: a client blocked on an open
+        # stream must see the reset NOW (as with a real SIGKILL), not
+        # discover the death at its own socket timeout
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._http_thread is not None:
+            self._http_thread.join(5.0)
+            self._http_thread = None
+        if self._engine_thread is not None:
+            self._engine_thread.join(5.0)
+            self._engine_thread = None
 
     def __enter__(self):
         return self.start()
@@ -252,7 +375,8 @@ class ServingClient:
                                  {"prompt": np.asarray(prompt).tolist(),
                                   **kwargs}, retries=0)
         if status == 429:
-            raise QueueFullError(out.get("error", "queue full"))
+            raise QueueFullError(out.get("error", "queue full"),
+                                 retry_after=out.get("retry_after_s"))
         if status == 503:
             raise SchedulerClosed(out.get("error", "draining"))
         if status != 202:
@@ -261,6 +385,10 @@ class ServingClient:
 
     def result(self, request_id: str) -> Dict:
         status, out = self._call("GET", f"/v1/result/{request_id}")
+        if status == 404:
+            raise RequestFailedError(
+                f"unknown request {request_id!r} (finished + evicted, or "
+                f"never submitted here): {out}")
         if status != 200:
             raise RuntimeError(f"result failed ({status}): {out}")
         return out
@@ -282,13 +410,19 @@ class ServingClient:
         """Yield generated tokens incrementally from the NDJSON stream.
 
         The server's final line carries the request state; anything other
-        than "done" (engine failure → "failed", server-side stream timeout
-        → still "running") raises so a truncated stream can't be mistaken
-        for a complete generation."""
+        than "done" raises so a truncated stream can't be mistaken for a
+        complete generation — :class:`RequestFailedError` when the engine
+        reported the request failed (replica healthy),
+        :class:`StreamIncompleteError` on the server-side stream timeout
+        (request still running), plain RuntimeError only for transport
+        truncation (the replica or its handler died mid-stream)."""
         c = self._conn()
         try:
             c.request("GET", f"/v1/stream/{request_id}")
             r = c.getresponse()
+            if r.status == 404:
+                raise RequestFailedError(
+                    f"unknown request {request_id!r} on this replica")
             if r.status != 200:
                 raise RuntimeError(f"stream failed ({r.status})")
             buf = b""
@@ -307,8 +441,12 @@ class ServingClient:
                         continue
                     msg = json.loads(line.decode())
                     if msg.get("done"):
+                        if msg.get("status") == Request.FAILED:
+                            raise RequestFailedError(
+                                f"request {request_id} failed after "
+                                f"{msg.get('n_tokens')} tokens")
                         if msg.get("status") != Request.DONE:
-                            raise RuntimeError(
+                            raise StreamIncompleteError(
                                 f"stream for {request_id} ended incomplete "
                                 f"(status={msg.get('status')!r} after "
                                 f"{msg.get('n_tokens')} tokens)")
@@ -321,4 +459,13 @@ class ServingClient:
         status, out = self._call("GET", "/metrics")
         if status != 200:
             raise RuntimeError(f"metrics failed ({status})")
+        return out
+
+    def admin_drain(self) -> Dict:
+        """Ask the replica to stop admitting (drain step 1); poll
+        :meth:`metrics` until queue depth and active slots hit zero to know
+        the drain finished."""
+        status, out = self._call("POST", "/admin/drain")
+        if status != 200:
+            raise RuntimeError(f"drain failed ({status}): {out}")
         return out

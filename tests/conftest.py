@@ -58,6 +58,10 @@ _SLOW_FILES = {
 def pytest_configure(config):
     config.addinivalue_line("markers", "fast: quick tier (<3 min total)")
     config.addinivalue_line("markers", "full: heavy integration/parity tier")
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process chaos/e2e tests (>10s), excluded from the "
+        "tier-1 `-m 'not slow'` run")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,580 @@
+"""Serving router: health-checked failover over N engine replicas (ISSUE 6).
+
+Fast tier: least-loaded routing off /metrics, circuit-breaker
+eject/half-open rejoin, 429 spillover + Retry-After backpressure hints,
+drain-aware zero-drop takedown, in-process replica-kill failover
+(queued request re-homed, in-flight failure surfaced, never silently
+truncated), configurable graceful-drain deadline.
+
+Slow tier (CPU-multiprocess): SIGKILL one of two replica PROCESSES
+mid-stream — queued requests complete on the survivor, recovery time
+(kill → first token on the survivor) is measured.
+"""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+from paddle_tpu.serving import (
+    ContinuousBatchingEngine,
+    NoReplicaAvailable,
+    QueueFullError,
+    Request,
+    ServingClient,
+    ServingRouter,
+    ServingServer,
+)
+
+VOCAB = 32
+
+
+def _tiny_model():
+    paddle.seed(0)
+    cfg = gpt_config("gpt2-small", vocab_size=VOCAB, hidden_size=16,
+                     num_layers=1, num_attention_heads=2,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+def _server(model, n_slots=1, max_queue=16, port=0, **kw):
+    eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=n_slots,
+                                   prefill_buckets=[8], max_queue=max_queue)
+    return ServingServer(eng, port=port, **kw).start()
+
+
+def _frozen_server(model, max_queue=1):
+    """HTTP plane up, engine loop NOT running: submissions pile up in the
+    admission queue and stay there — deterministic backpressure."""
+    eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=1,
+                                   prefill_buckets=[8], max_queue=max_queue)
+    srv = ServingServer(eng)
+    srv._http_thread = threading.Thread(target=srv._httpd.serve_forever,
+                                        daemon=True)
+    srv._http_thread.start()
+    return srv
+
+
+def _prompt(rng=None, n=4):
+    rng = rng or np.random.default_rng(0)
+    return rng.integers(0, VOCAB, (n,)).tolist()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_metrics(addr, pred, timeout=60.0):
+    """Poll a replica's /metrics until ``pred(snapshot)`` holds (engine
+    gauges update per tick; the first tick includes a compile)."""
+    c = ServingClient(addr)
+    deadline = time.perf_counter() + timeout
+    while True:
+        snap = c.metrics()
+        if pred(snap):
+            return snap
+        assert time.perf_counter() < deadline, f"metrics never settled: {snap}"
+        time.sleep(0.02)
+
+
+# =====================================================================
+# routing + breaker
+# =====================================================================
+class TestRouting:
+    def test_least_loaded_routing(self, model):
+        # A's engine loop is frozen so its preloaded queue CANNOT drain —
+        # the load difference the router must see is pinned, not raced
+        a = _frozen_server(model, max_queue=8)
+        b = _server(model, n_slots=2)
+        try:
+            with ServingRouter([a.addr, b.addr], health_interval_s=5.0,
+                               request_timeout=5.0) as router:
+                # pre-load replica A directly (bypassing the router)
+                direct = ServingClient(a.addr)
+                for _ in range(3):
+                    direct.submit(_prompt(), max_new_tokens=24)
+                assert direct.metrics()["queue_depth"] == 3  # live gauge
+                router.check_health()
+                rr = router.submit(_prompt(), max_new_tokens=2)
+                assert rr.replica_addr == b.addr  # the idle one
+                router.wait(rr, timeout=60)
+                assert rr.state == Request.DONE
+        finally:
+            a.kill()
+            b.stop()
+
+    def test_breaker_ejects_and_halfopen_rejoins(self, model):
+        port = _free_port()
+        router = ServingRouter([f"127.0.0.1:{port}"], failure_threshold=2,
+                               cooldown_s=0.2, request_timeout=1.0)
+        rep = router.replicas[f"127.0.0.1:{port}"]
+        router.check_health()
+        router.check_health()
+        assert rep.state == "open"  # consecutive failures ejected it
+        with pytest.raises(NoReplicaAvailable):
+            router.submit(_prompt(), max_new_tokens=1)
+        # replica comes up on that port → cooldown elapses → half-open
+        # probe succeeds → rejoined
+        srv = _server(model, port=port)
+        try:
+            time.sleep(0.25)
+            router.check_health()
+            assert rep.state == "closed"
+            rr = router.submit(_prompt(), max_new_tokens=2)
+            router.wait(rr, timeout=60)
+            assert rr.state == Request.DONE
+        finally:
+            srv.stop()
+
+    def test_429_spillover_and_retry_after(self, model):
+        """A full replica spills to the next one; when EVERY replica is
+        full the 429 surfaces WITH the Retry-After hint. Frozen engine
+        loops keep the queues deterministically full."""
+        a = _frozen_server(model, max_queue=1)
+        b = _frozen_server(model, max_queue=1)
+        try:
+            ServingClient(a.addr).submit(_prompt(), max_new_tokens=8)
+            with ServingRouter([a.addr, b.addr], health_interval_s=5.0,
+                               request_timeout=5.0) as router:
+                rr = router.submit(_prompt(), max_new_tokens=2)
+                assert rr.replica_addr == b.addr  # spilled off full A
+                with pytest.raises(QueueFullError) as ei:  # now B full too
+                    router.submit(_prompt(), max_new_tokens=2)
+                assert ei.value.retry_after is not None
+                assert ei.value.retry_after >= 1.0
+        finally:
+            a.kill()
+            b.kill()
+
+    def test_retry_after_header_from_direct_client(self, model):
+        srv = _frozen_server(model, max_queue=1)
+        try:
+            c = ServingClient(srv.addr)
+            c.submit(_prompt(), max_new_tokens=8)
+            with pytest.raises(QueueFullError) as ei:
+                c.submit(_prompt(), max_new_tokens=2)
+            assert ei.value.retry_after is not None
+        finally:
+            srv.kill()
+
+
+# =====================================================================
+# drain
+# =====================================================================
+class TestDrain:
+    def test_drain_zero_dropped_and_no_new_routing(self, model):
+        a, b = _server(model, n_slots=1), _server(model, n_slots=1)
+        try:
+            with ServingRouter([a.addr, b.addr], health_interval_s=5.0,
+                               request_timeout=10.0) as router:
+                router.check_health()
+                rrs = [router.submit(_prompt(), max_new_tokens=12)
+                       for _ in range(4)]
+                on_a = [r for r in rrs if r.replica_addr == a.addr]
+                assert on_a  # some work is queued/running on A
+                router.drain(a.addr, timeout=60)
+                # zero dropped: everything routed to A completed there
+                for rr in on_a:
+                    out = router.wait(rr, timeout=60)
+                    assert out["status"] == Request.DONE
+                    assert len(out["tokens"]) == 12
+                # A is out of rotation for NEW work, and reports draining
+                assert ServingClient(a.addr).metrics()["draining"] is True
+                rr2 = router.submit(_prompt(), max_new_tokens=2)
+                assert rr2.replica_addr == b.addr
+                router.wait(rr2, timeout=60)
+                for rr in rrs:
+                    router.wait(rr, timeout=60)
+                    assert rr.state == Request.DONE
+        finally:
+            a.kill()
+            b.stop()
+
+    def test_drain_timeout_s_is_configurable(self, model):
+        srv = _server(model, n_slots=1, drain_timeout_s=0.02)
+        assert srv.drain_timeout_s == 0.02
+        # the first prefill compiles (≫ 20ms), so the engine cannot
+        # possibly drain inside the configured deadline
+        ServingClient(srv.addr).submit(_prompt(), max_new_tokens=26)
+        with pytest.raises(TimeoutError, match="drain_timeout_s"):
+            srv.drain()  # the configured (tiny) default applies
+        srv.stop(timeout=120)  # explicit override still wins
+
+    def test_drain_waits_for_mid_prefill_request(self, model, monkeypatch):
+        """A request POPPED from the admission queue but still inside
+        prefill (e.g. the first-bucket compile) is in neither queue_depth
+        nor an active slot: drain must count it (in_admission) instead of
+        declaring the replica empty and letting the operator kill it."""
+        orig = ContinuousBatchingEngine._admit_one
+
+        def slow_admit(self, req, slot):
+            time.sleep(0.6)  # hold the pop→activate window wide open
+            return orig(self, req, slot)
+
+        monkeypatch.setattr(ContinuousBatchingEngine, "_admit_one",
+                            slow_admit)
+        srv = _server(model, n_slots=1)
+        try:
+            with ServingRouter([srv.addr], health_interval_s=5.0,
+                               request_timeout=10.0) as router:
+                router.check_health()
+                rr = router.submit(_prompt(), max_new_tokens=4)
+                time.sleep(0.2)  # tick pops it; now mid-prefill
+                m = ServingClient(srv.addr).metrics()
+                assert (int(m["queue_depth"]) + int(m["in_admission"])
+                        + int(m["slot_occupancy"]["active"])) >= 1
+                router.drain(srv.addr, timeout=120)
+                # drain returned ⇒ the request must already be DONE
+                out = router.poll(rr)
+                assert out["status"] == Request.DONE
+                assert len(out["tokens"]) == 4
+        finally:
+            srv.kill()
+
+
+# =====================================================================
+# in-process replica kill (the fast half of the chaos coverage)
+# =====================================================================
+class TestReplicaKill:
+    def _pair_with_two_on_victim(self, router, addrs):
+        """Submit until one replica holds 2 requests (1 running + 1
+        queued); returns (victim_addr, running_rr, queued_rr, others)."""
+        placed = {a: [] for a in addrs}
+        rrs = []
+        for _ in range(3):
+            rr = router.submit(_prompt(), max_new_tokens=24)
+            rrs.append(rr)
+            placed[rr.replica_addr].append(rr)
+            victim = next((a for a, v in placed.items() if len(v) == 2), None)
+            if victim:
+                running, queued = placed[victim]
+                others = [r for r in rrs if r not in (running, queued)]
+                return victim, running, queued, others
+        raise AssertionError(f"no replica got 2 requests: {placed}")
+
+    def test_kill_requeues_queued_and_surfaces_inflight(self, model):
+        servers = {s.addr: s for s in (_server(model, n_slots=1),
+                                       _server(model, n_slots=1))}
+        addrs = list(servers)
+        try:
+            with ServingRouter(addrs, health_interval_s=0.1,
+                               cooldown_s=30.0, request_timeout=5.0) as router:
+                router.check_health()
+                victim, running, queued, others = \
+                    self._pair_with_two_on_victim(router, addrs)
+                # observe tokens from the RUNNING one (poll) so the router
+                # knows its generation started
+                deadline = time.perf_counter() + 30
+                while not running.tokens:
+                    router.poll(running)
+                    assert time.perf_counter() < deadline
+                    time.sleep(0.01)
+                servers[victim].kill()
+                # in-flight: surfaced as FAILED via poll — with the error
+                # naming the dead replica, not a silent truncation
+                out = router.wait(running, timeout=60)
+                assert out["status"] == Request.FAILED
+                assert "died after" in running.error
+                # queued (never prefilled): completes on the survivor
+                out = router.wait(queued, timeout=60)
+                assert out["status"] == Request.DONE, queued.error
+                assert len(out["tokens"]) == 24
+                assert queued.resubmits == 1
+                assert queued.replica_addr != victim
+                for rr in others:
+                    router.wait(rr, timeout=60)
+                    assert rr.state == Request.DONE, rr.error
+                snap = router.snapshot()
+                assert snap["replicas"][victim]["state"] == "open"
+                assert snap["resubmits"] >= 1
+                assert snap["inflight_failures"] == 1
+        finally:
+            for s in servers.values():
+                try:
+                    s.kill()
+                except Exception:
+                    pass
+
+    def test_stream_of_settled_request_replays_not_reconnects(self, model):
+        """Streaming a request that already completed (polled to DONE)
+        after its replica died must replay the recorded tokens and
+        terminate — not reconnect to the corpse in a busy loop."""
+        srv = _server(model, n_slots=1)
+        try:
+            with ServingRouter([srv.addr], health_interval_s=5.0,
+                               request_timeout=5.0) as router:
+                router.check_health()
+                rr = router.submit(_prompt(), max_new_tokens=6)
+                out = router.wait(rr, timeout=60)
+                assert out["status"] == Request.DONE
+                srv.kill()  # the replica is now a corpse
+                assert list(router.stream(rr)) == out["tokens"]
+        finally:
+            try:
+                srv.kill()
+            except Exception:
+                pass
+
+    def test_settled_failure_replays_typed_exception(self):
+        """stream() of an ALREADY-settled failure must raise the same
+        exception class a live observation raised: RequestFailedError for
+        a request-level verdict (the documented switch point for callers),
+        RuntimeError for a replica death — settling first must not change
+        the type."""
+        router = ServingRouter(["127.0.0.1:1"])  # never dialed: rr.done
+        from paddle_tpu.serving import RequestFailedError
+        from paddle_tpu.serving.router import RoutedRequest
+        verdict = RoutedRequest(_prompt(), max_new_tokens=2)
+        verdict.state = Request.FAILED
+        verdict.failure_kind = "request"
+        verdict.error = "poison prompt"
+        with pytest.raises(RequestFailedError, match="poison"):
+            list(router.stream(verdict))
+        death = RoutedRequest(_prompt(), max_new_tokens=2)
+        death.state = Request.FAILED
+        death.failure_kind = "transport"
+        death.error = "replica 127.0.0.1:1 died after 3 tokens"
+        with pytest.raises(RuntimeError, match="died after") as ei:
+            list(router.stream(death))
+        assert not isinstance(ei.value, RequestFailedError)
+
+    def test_probe_client_uses_short_timeout(self):
+        """Health probes must carry their own short deadline, not the full
+        request_timeout — one black-holed replica would otherwise stall
+        the sequential health loop for every replica."""
+        router = ServingRouter(["127.0.0.1:1", "127.0.0.1:2"],
+                               request_timeout=10.0, probe_timeout_s=0.5)
+        for rep in router.replicas.values():
+            assert rep.probe_client.timeout == 0.5
+            assert rep.client.timeout == 10.0
+        # capped by request_timeout when the request deadline is shorter
+        router = ServingRouter(["127.0.0.1:1"], request_timeout=0.2,
+                               probe_timeout_s=1.0)
+        assert next(iter(router.replicas.values())).probe_client.timeout == 0.2
+
+    def test_transport_error_against_live_replica_is_not_a_death(self, model):
+        """One caller-side transport error (e.g. a poll timing out while
+        the replica GIL-holds a long jit) must NOT trigger failover: the
+        confirming probe sees the replica answering /metrics, so the
+        request stays in place (no duplicate generation on a survivor, no
+        permanent FAILED for a request the replica will finish)."""
+        srv = _server(model, n_slots=1)
+        try:
+            with ServingRouter([srv.addr], health_interval_s=5.0,
+                               request_timeout=5.0) as router:
+                router.check_health()
+                rr = router.submit(_prompt(), max_new_tokens=4)
+                home = rr.replica_addr
+                assert router._handle_replica_death(
+                    rr, OSError("timed out"), home) is True
+                snap = router.snapshot()
+                assert snap["resubmits"] == 0 and snap["failovers"] == 0
+                assert rr.replica_addr == home and not rr.done
+                assert snap["replicas"][home]["consecutive_failures"] == 0
+                out = router.wait(rr, timeout=60)
+                assert out["status"] == Request.DONE and len(out["tokens"]) == 4
+        finally:
+            try:
+                srv.kill()
+            except Exception:
+                pass
+
+    def test_observe_never_regresses_token_log(self):
+        """A stream thread replaying from token 0 races a poll that already
+        recorded a longer log: _observe must be monotonic, never shrinking
+        rr.tokens (a settled replay would yield the truncated log as a
+        complete generation)."""
+        from paddle_tpu.serving.router import RoutedRequest
+        rr = RoutedRequest(_prompt(), max_new_tokens=8)
+        rr._observe([1, 2, 3, 4, 5])
+        rr._observe([1, 2])  # late, shorter observation of the same run
+        assert rr.tokens == [1, 2, 3, 4, 5]
+
+    def test_failover_idempotent_for_racing_observers(self, model):
+        """poll() and stream() may observe the SAME replica death
+        concurrently: the second observer must not resubmit the prompt
+        again (a duplicate generation) nor charge the breaker of the
+        survivor the first observer re-homed onto."""
+        servers = {s.addr: s for s in (_server(model, n_slots=1),
+                                       _server(model, n_slots=1))}
+        try:
+            with ServingRouter(list(servers), health_interval_s=5.0,
+                               cooldown_s=30.0, request_timeout=5.0) as router:
+                router.check_health()
+                rr = router.submit(_prompt(), max_new_tokens=8)
+                dead = rr.replica_addr
+                servers[dead].kill()
+                err = OSError("connection refused")
+                assert router._handle_replica_death(rr, err, dead) is True
+                survivor = rr.replica_addr
+                assert survivor != dead
+                n = router.snapshot()["resubmits"]
+                # the racing second observer of the SAME death: no-op
+                assert router._handle_replica_death(rr, err, dead) is True
+                snap = router.snapshot()
+                assert snap["resubmits"] == n
+                assert rr.replica_addr == survivor
+                assert snap["replicas"][survivor]["consecutive_failures"] == 0
+                out = router.wait(rr, timeout=60)
+                assert out["status"] == Request.DONE and len(out["tokens"]) == 8
+        finally:
+            for s in servers.values():
+                try:
+                    s.kill()
+                except Exception:
+                    pass
+
+    def test_kill_mid_stream_requeues_and_streams_from_survivor(self, model):
+        servers = {s.addr: s for s in (_server(model, n_slots=1),
+                                       _server(model, n_slots=1))}
+        addrs = list(servers)
+        try:
+            with ServingRouter(addrs, health_interval_s=0.1,
+                               cooldown_s=30.0, request_timeout=5.0) as router:
+                router.check_health()
+                victim, running, queued, _ = \
+                    self._pair_with_two_on_victim(router, addrs)
+                got = []
+
+                def consume():
+                    for tok in router.stream(queued):
+                        got.append(tok)
+
+                t = threading.Thread(target=consume)
+                t.start()
+                time.sleep(0.1)  # the stream is blocked on the queued req
+                servers[victim].kill()
+                t.join(60)
+                assert not t.is_alive()
+                # the stream failed over transparently: every token came
+                # from the survivor, none were dropped
+                assert queued.state == Request.DONE
+                assert len(got) == 24
+                assert queued.replica_addr != victim
+        finally:
+            for s in servers.values():
+                try:
+                    s.kill()
+                except Exception:
+                    pass
+
+
+# =====================================================================
+# multiprocess chaos (slow tier): SIGKILL a replica PROCESS mid-stream
+# =====================================================================
+_REPLICA_SCRIPT = textwrap.dedent("""
+    import os, sys, time
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+    from paddle_tpu.serving import ContinuousBatchingEngine, ServingServer
+
+    paddle.seed(0)
+    cfg = gpt_config("gpt2-small", vocab_size=32, hidden_size=16,
+                     num_layers=1, num_attention_heads=2,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    eng = ContinuousBatchingEngine(m, max_seq_len=128, n_slots=1,
+                                   prefill_buckets=[8], max_queue=16)
+    srv = ServingServer(eng).start()
+    print(f"ADDR {srv.addr}", flush=True)
+    while True:
+        time.sleep(1)
+""")
+
+
+@pytest.mark.slow
+def test_replica_process_sigkill_mid_stream(tmp_path):
+    """Kill 1 of 2 engine replica PROCESSES mid-stream: zero queued
+    requests dropped (they complete on the survivor) and the recovery
+    time (kill → first token on the survivor) is measurable — the bench
+    secondary's scenario, asserted."""
+    script = tmp_path / "replica.py"
+    script.write_text(_REPLICA_SCRIPT)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   p for p in (repo, os.environ.get("PYTHONPATH")) if p))
+    procs = [subprocess.Popen([sys.executable, str(script)],
+                              stdout=subprocess.PIPE, text=True, env=env)
+             for _ in range(2)]
+    try:
+        addrs = [p.stdout.readline().split()[1] for p in procs]
+        with ServingRouter(addrs, health_interval_s=0.1, cooldown_s=30.0,
+                           request_timeout=5.0) as router:
+            router.check_health()
+            # warm both replicas (compile prefill+decode out of the way)
+            warm = [router.submit(_prompt(), max_new_tokens=2)
+                    for _ in range(2)]
+            for rr in warm:
+                router.wait(rr, timeout=120)
+            router.check_health()
+            # load both replicas with LONG generations (n_slots=1, so each
+            # replica holds one runner + queued work for ~100 ticks — the
+            # kill must land while the target is still queued)
+            rrs = [router.submit(_prompt(), max_new_tokens=100)
+                   for _ in range(4)]
+            placed = {}
+            for rr in rrs:
+                placed.setdefault(rr.replica_addr, []).append(rr)
+            victim_addr = next(a for a, v in placed.items() if len(v) >= 2)
+            victim_proc = procs[addrs.index(victim_addr)]
+            queued = placed[victim_addr][-1]
+            got = []
+
+            def consume():
+                for tok in router.stream(queued):
+                    got.append(tok)
+
+            t = threading.Thread(target=consume)
+            t.start()
+            time.sleep(0.05)
+            assert not queued.tokens  # still queued behind the runner
+            t_kill = time.perf_counter()
+            victim_proc.kill()  # SIGKILL — no goodbye, no drain
+            t.join(120)
+            assert not t.is_alive()
+            assert queued.state == Request.DONE
+            assert len(got) == 100  # nothing dropped, nothing truncated
+            assert queued.replica_addr != victim_addr
+            assert queued.failover_first_token_at is not None
+            recovery_s = queued.failover_first_token_at - t_kill
+            assert 0 < recovery_s < 60
+            # every request the dead replica had NOT started completes;
+            # in-flight ones surface as failed, never silently truncated
+            for rr in rrs:
+                try:
+                    router.wait(rr, timeout=120)
+                except TimeoutError:
+                    pass
+                assert rr.state in (Request.DONE, Request.FAILED)
+                if rr.state == Request.FAILED:
+                    assert "died after" in rr.error
+            dropped = [rr for rr in rrs
+                       if rr.state == Request.FAILED and not rr.tokens]
+            assert dropped == []  # zero queued requests lost
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()

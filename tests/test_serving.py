@@ -15,6 +15,7 @@ from paddle_tpu.serving import (
     FCFSScheduler,
     QueueFullError,
     Request,
+    RequestFailedError,
     SchedulerClosed,
     ServingClient,
     ServingServer,
@@ -398,7 +399,8 @@ class TestEngineFailureContainment:
     def test_tick_failure_fails_requests_not_thread(self, model):
         """An exception inside a tick marks affected requests FAILED (with
         the error recorded) instead of silently killing the loop thread,
-        and the client stream surfaces the incompleteness."""
+        and the client stream surfaces the failure (as RequestFailedError —
+        the request's verdict, not a replica-health event)."""
         rng = np.random.default_rng(8)
         prompt = rng.integers(0, VOCAB, (4,)).astype(np.int32)
         eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=1,
@@ -414,5 +416,6 @@ class TestEngineFailureContainment:
             res = cli.wait(rid, timeout=60)
             assert res["status"] == "failed"
             assert "injected device fault" in res["error"]
-            with pytest.raises(RuntimeError, match="incomplete"):
+            with pytest.raises(RequestFailedError,
+                               match="failed after 0 tokens"):
                 list(cli.stream(rid))
