@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding
 
 from ..nn.layer import Layer
+from ..observability import trace as obstrace
+from ..observability.flight import flight_recorder
 from ..profiler.scope import scope as prof_scope
 from ..profiler.scope import timer_registry, timers_enabled
 from ..tensor import Tensor
@@ -111,6 +113,7 @@ class ParallelTrainer:
         self.recompute = recompute
         self.accumulate_steps = accumulate_steps
         self.donate = donate
+        self.step_count = 0  # host step counter (telemetry spans + flight)
 
         # in-graph dynamic loss scaling (amp ops check_finite_and_unscale +
         # update_loss_scaling as pure functions in the jitted step)
@@ -461,18 +464,25 @@ class ParallelTrainer:
         # compiled step (read at trace time it would be baked as a constant)
         lr_now = jnp.asarray(float(self.optimizer.get_lr()), jnp.float32)
         t0 = time.perf_counter() if timers_enabled() else None
+        step_idx = self.step_count
+        self.step_count += 1
         # the key is kept so sanitize_step can replay THIS step faithfully
         # (a fresh key would draw different dropout masks); the key arg is
         # not donated, so the array stays readable after the step
         self.last_step_key = key = split_key()
-        (self.params, self.opt_state, self.buffers, loss, self.scale_state,
-         self.sentinel_state) = self._jit_step(
-            self.params, self.opt_state, self.buffers, xb, yb, key,
-            self.scale_state, self.sentinel_state, lr_now,
-        )
+        with obstrace.span("train.step", step=step_idx):
+            (self.params, self.opt_state, self.buffers, loss,
+             self.scale_state, self.sentinel_state) = self._jit_step(
+                self.params, self.opt_state, self.buffers, xb, yb, key,
+                self.scale_state, self.sentinel_state, lr_now,
+            )
         if t0 is not None:
             timer_registry.record("trainer.step.host_dispatch",
                                   time.perf_counter() - t0)
+        fr = flight_recorder()
+        if fr.armed or obstrace.tracing_enabled():
+            # pin the current step so a crash dump can name where it died
+            fr.note(step=step_idx)
         return Tensor(loss)
 
     def _host_apply(self, grads):

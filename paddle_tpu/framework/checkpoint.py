@@ -295,6 +295,16 @@ class CheckpointManager:
         whose arrays were gathered to GLOBAL from sharded ranks — the
         contract :func:`reshard_train_state` consumes to reload the
         snapshot at a different world size."""
+        from ..observability import trace as _obs
+
+        with _obs.span("train.checkpoint_save", step=int(step),
+                       sync=bool(sync)):
+            return self._save_impl(step, state, metadata=metadata,
+                                   sync=sync, layout=layout)
+
+    def _save_impl(self, step: int, state: Any,
+                   metadata: Optional[Dict] = None, sync: bool = False,
+                   layout: Optional[Dict] = None):
         flat = _flatten_state(state)
         # materialize on host NOW (so async write sees a consistent snapshot)
         arrays = {}

@@ -1,0 +1,90 @@
+"""Unified telemetry plane (ISSUE 7).
+
+One coherent observability surface over the islands earlier rounds built
+(r6 profiler scopes, r8 ServingMetrics, r10 analysis JSONs, r11 router
+probes):
+
+* :mod:`.trace` — distributed request tracing: trace IDs minted at the
+  router, propagated via HTTP headers, spans in a bounded ring buffer,
+  chrome-trace export;
+* :mod:`.metrics` — counters / gauges / log-bucketed histograms with
+  Prometheus text exposition and a training-side HTTP exporter;
+* :mod:`.gauges` — predicted-vs-actual: live MFU (cost-model flops over
+  measured step time) and HBM drift (liveness estimate vs
+  ``jax.live_arrays()``) — the analyzer as a runtime component;
+* :mod:`.flight` — crash flight recorder: the span ring + metrics frozen
+  to a versioned JSON snapshot on sentinel halt, SIGTERM, engine tick
+  failure, and router-confirmed replica death;
+* :mod:`.merge` — ``python -m paddle_tpu.observability merge`` stitches
+  multi-process dumps into one timeline by trace ID.
+
+Parity: ``paddle.profiler`` / VisualDL timelines / monitor StatValue
+series / the platform profiler from PAPER.md's L0 row (PARITY.md maps the
+rows).
+"""
+from .flight import (
+    FLIGHT_SCHEMA_VERSION,
+    FlightRecorder,
+    configure_flight,
+    flight_recorder,
+)
+from .gauges import TrainerTelemetry, device_peak_flops_bf16
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsHTTPServer,
+    MetricsRegistry,
+    default_registry,
+    log_buckets,
+    start_http_exporter,
+    wants_prometheus,
+)
+from .trace import (
+    PARENT_HEADER,
+    TRACE_HEADER,
+    Span,
+    disable_tracing,
+    dump_trace,
+    enable_tracing,
+    event,
+    new_trace_id,
+    record_span,
+    snapshot_spans,
+    span,
+    to_chrome_trace,
+    trace_context,
+    tracing_enabled,
+)
+
+__all__ = [
+    "TRACE_HEADER",
+    "PARENT_HEADER",
+    "Span",
+    "span",
+    "event",
+    "record_span",
+    "trace_context",
+    "new_trace_id",
+    "enable_tracing",
+    "disable_tracing",
+    "tracing_enabled",
+    "snapshot_spans",
+    "dump_trace",
+    "to_chrome_trace",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "MetricsHTTPServer",
+    "default_registry",
+    "log_buckets",
+    "start_http_exporter",
+    "wants_prometheus",
+    "TrainerTelemetry",
+    "device_peak_flops_bf16",
+    "FLIGHT_SCHEMA_VERSION",
+    "FlightRecorder",
+    "flight_recorder",
+    "configure_flight",
+]

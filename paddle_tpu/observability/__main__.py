@@ -1,0 +1,51 @@
+"""CLI: ``python -m paddle_tpu.observability merge ...``.
+
+Subcommands:
+
+* ``merge -o OUT [--trace-id ID] DUMP [DUMP ...]`` — stitch per-process
+  trace/flight dumps into one chrome-trace JSON (open in
+  ``ui.perfetto.dev`` or ``chrome://tracing``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .merge import merge_files
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability",
+        description="telemetry-plane tooling")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    m = sub.add_parser(
+        "merge", help="stitch per-process dumps into one chrome-trace")
+    m.add_argument("dumps", nargs="+", help="trace/flight dump JSON files")
+    m.add_argument("-o", "--out", default=None,
+                   help="output path (default: stdout)")
+    m.add_argument("--trace-id", default=None,
+                   help="keep only spans of this trace id")
+    args = parser.parse_args(argv)
+
+    if args.cmd == "merge":
+        try:
+            doc = merge_files(args.dumps, out_path=args.out,
+                              trace_id=args.trace_id)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if args.out is None:
+            json.dump(doc, sys.stdout, indent=1)
+            print()
+        else:
+            meta = doc.get("metadata", {})
+            print(f"wrote {args.out}: {meta.get('n_spans')} spans from "
+                  f"{meta.get('merged_dumps')} dump(s)")
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,186 @@
+"""Crash flight recorder: "what was the system doing when it died".
+
+The trace ring (:mod:`.trace`) always holds the last N spans; this module
+freezes it — plus the process's metric series and a small set of pinned
+notes (current step, replica address, ...) — into ONE versioned JSON
+snapshot at the moments that matter:
+
+* anomaly-sentinel halt / rollback (:class:`~..resilience.sentinel
+  .SentinelMonitor`),
+* :class:`~..resilience.preemption.PreemptionGuard` SIGTERM / deadline,
+* a serving engine tick failing (requests failed, loop survives),
+* a router-CONFIRMED replica death (probe agreed the replica is gone).
+
+Contract: dumping must never make the crash worse. Every ``dump`` is
+exception-contained (a full disk loses the dump, not the exit protocol),
+and the recorder holds the snapshot in memory (``last``) even when no
+directory is configured, so tests and post-mortem debuggers can read it
+without touching the filesystem. ``PADDLE_TPU_FLIGHT_DIR`` arms file
+output process-wide.
+
+Disabled-mode guarantee: notes/dumps are pure host bookkeeping — nothing
+here touches a jax trace, so the r6/r7 jaxpr-identity bar is unaffected.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+import warnings
+import weakref
+from typing import Dict, Optional
+
+from . import trace as _trace
+
+__all__ = [
+    "FLIGHT_SCHEMA_VERSION",
+    "FLIGHT_DIR_ENV",
+    "FlightRecorder",
+    "flight_recorder",
+    "configure_flight",
+    "register_metrics_registry",
+]
+
+#: version of the flight-dump JSON layout (bumped like the analysis JSONs)
+FLIGHT_SCHEMA_VERSION = 1
+
+#: set this to a directory to arm file dumps process-wide
+FLIGHT_DIR_ENV = "PADDLE_TPU_FLIGHT_DIR"
+
+_MAX_NOTES = 64
+
+# per-instance metric registries (serving engines, routers) attached so a
+# crash dump freezes THEIR series too, not just the process registry.
+# Weak values: a retired engine's registry drops out with the engine.
+_EXTRA_REGISTRIES: "weakref.WeakValueDictionary[str, object]" = \
+    weakref.WeakValueDictionary()
+_registry_seq = itertools.count(1)
+
+
+def register_metrics_registry(label: str, registry) -> str:
+    """Attach ``registry`` to every future flight dump under a unique
+    ``label-N`` section (the serving plane registers its per-instance
+    registries here). Returns the section name."""
+    name = f"{label}-{next(_registry_seq)}"
+    _EXTRA_REGISTRIES[name] = registry
+    return name
+
+
+class FlightRecorder:
+    """Bounded notes + dump-on-crash over the shared span ring."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 max_spans: int = 256, process: Optional[str] = None):
+        self._lock = threading.Lock()
+        self.directory = directory or os.environ.get(FLIGHT_DIR_ENV) or None
+        self.max_spans = int(max_spans)
+        self.process = process
+        self._notes: Dict[str, object] = {}
+        self._seq = 0
+        self.last: Optional[dict] = None      # newest dump (in-memory)
+        self.last_path: Optional[str] = None  # where it landed, if on disk
+
+    @property
+    def armed(self) -> bool:
+        """True when dumps land on disk (a directory is configured)."""
+        return self.directory is not None
+
+    def configure(self, directory: Optional[str] = None,
+                  max_spans: Optional[int] = None,
+                  process: Optional[str] = None) -> "FlightRecorder":
+        with self._lock:
+            if directory is not None:
+                self.directory = directory
+            if max_spans is not None:
+                self.max_spans = int(max_spans)
+            if process is not None:
+                self.process = process
+        return self
+
+    def note(self, **kv):
+        """Pin small context values (step=..., replica=...) into every
+        future dump. Bounded: past :data:`_MAX_NOTES` keys new ones are
+        dropped (existing keys always update — the hot path is step=N)."""
+        with self._lock:
+            for k, v in kv.items():
+                if k in self._notes or len(self._notes) < _MAX_NOTES:
+                    self._notes[k] = v
+
+    def notes(self) -> Dict[str, object]:
+        with self._lock:
+            return dict(self._notes)
+
+    def dump(self, reason: str, extra: Optional[dict] = None,
+             directory: Optional[str] = None) -> Optional[dict]:
+        """Freeze the flight snapshot. Returns the document (also kept in
+        ``self.last``); writes ``flight_<reason>_<pid>_<seq>.json`` when a
+        directory is configured (argument overrides the instance/env one).
+        NEVER raises — a recorder failure must not mask the crash."""
+        try:
+            from .metrics import default_registry
+
+            with self._lock:
+                notes = dict(self._notes)
+                self._seq += 1
+                seq = self._seq
+            metrics = {"process": default_registry().to_dict()}
+            for name, reg in sorted(_EXTRA_REGISTRIES.items()):
+                try:
+                    metrics[name] = reg.to_dict()
+                except Exception:
+                    metrics[name] = "failed"
+            doc = {
+                "schema_version": FLIGHT_SCHEMA_VERSION,
+                "reason": str(reason),
+                "wall_time": time.time(),
+                "pid": os.getpid(),
+                "process": self.process or f"pid-{os.getpid()}",
+                "step": notes.get("step"),
+                "notes": notes,
+                "spans": [s.to_dict()
+                          for s in _trace.snapshot_spans(self.max_spans)],
+                "dropped_spans": _trace.span_ring().dropped,
+                "metrics": metrics,
+            }
+            if extra:
+                doc["extra"] = extra
+            self.last, self.last_path = doc, None
+            out_dir = directory or self.directory
+            if out_dir:
+                os.makedirs(out_dir, exist_ok=True)
+                safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                               for c in str(reason))[:64]
+                path = os.path.join(
+                    out_dir, f"flight_{safe}_{os.getpid()}_{seq}.json")
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(doc, f, indent=1)
+                os.replace(tmp, path)
+                self.last_path = path
+            return doc
+        except Exception as e:  # the crash path must survive the recorder
+            try:
+                warnings.warn(
+                    f"flight recorder dump failed ({type(e).__name__}: {e})",
+                    RuntimeWarning)
+            except Exception:
+                pass
+            return None
+
+
+_default = FlightRecorder()
+
+
+def flight_recorder() -> FlightRecorder:
+    """The process-wide recorder (what the built-in crash hooks use)."""
+    return _default
+
+
+def configure_flight(directory: Optional[str] = None,
+                     max_spans: Optional[int] = None,
+                     process: Optional[str] = None) -> FlightRecorder:
+    """Arm the default recorder (file output lands in ``directory``)."""
+    return _default.configure(directory=directory, max_spans=max_spans,
+                              process=process)

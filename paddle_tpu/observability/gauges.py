@@ -1,0 +1,191 @@
+"""Predicted-vs-actual gauges: the r9/r10 analyzer as a RUNTIME component.
+
+Two live series per trainer, both cheap enough for the hot loop:
+
+* **MFU** — the r10 cost model (:func:`analysis.cost.graph_cost`) prices
+  the trainer's jitted step ONCE (flops per step, per device); dividing by
+  the measured step wall time and the device's peak bf16 flops gives a
+  live model-flops-utilization gauge — the same accounting bench.py pins,
+  but continuously, from the real program instead of the 6N formula.
+* **HBM drift** — the r10 liveness estimator's peak/resident prediction
+  sits next to a ``jax.live_arrays()`` census as ``predicted``/``actual``
+  gauges plus a drift fraction: the estimator's 15% acceptance bar,
+  watchable in production instead of only in the bench artifact.
+
+:class:`TrainerTelemetry` wraps a :class:`~..distributed.parallel_trainer
+.ParallelTrainer`; ``prime()`` runs the static analysis (trace-time cost,
+once), ``step()`` times the hot path (host wall time between dispatches —
+back-to-back dispatch converges to device step time under XLA's async
+queue), ``refresh_hbm()`` reads the census. All series land in a
+:class:`~.metrics.MetricsRegistry` (default: the process registry), so the
+training-side exporter serves them to Prometheus unchanged.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+__all__ = ["device_peak_flops_bf16", "TrainerTelemetry"]
+
+#: peak bf16 FLOP/s per chip by device generation (bench.py's table)
+_PEAK_FLOPS_BF16 = {
+    "v6e": 918e12, "v6": 918e12,
+    "v5e": 197e12, "v5litepod": 197e12, "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def device_peak_flops_bf16(device=None) -> float:
+    """Peak bf16 FLOP/s of ``device`` (default: jax.devices()[0]); assumes
+    v5e-class when the kind is unknown (CPU arms report MFU against it so
+    the gauge is populated, not meaningful — same convention as bench)."""
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK_FLOPS_BF16.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+class TrainerTelemetry:
+    """Live MFU + predicted-vs-actual HBM gauges for one trainer."""
+
+    def __init__(self, trainer, registry=None, peak_flops: Optional[float]
+                 = None, name: str = "trainer"):
+        from .metrics import default_registry, log_buckets
+
+        self.trainer = trainer
+        self.name = name
+        self.registry = registry or default_registry()
+        self.peak_flops = (float(peak_flops) if peak_flops
+                           else device_peak_flops_bf16())
+        self.flops_per_step: Optional[float] = None
+        self.predicted_peak_bytes: Optional[int] = None
+        self.predicted_resident_bytes: Optional[int] = None
+        self._last_return: Optional[float] = None
+        self._steps = 0
+        r = self.registry
+        self._g_mfu = r.gauge(
+            "train_mfu", "model flops utilization (cost-model flops / "
+            "measured step time / device peak)", ("trainer",))
+        self._g_flops = r.gauge(
+            "train_step_flops", "static cost-model flops per train step "
+            "per device", ("trainer",))
+        self._h_step = r.histogram(
+            "train_step_seconds", "train step wall time",
+            ("trainer",), buckets=log_buckets(1e-4, 128.0))
+        self._c_steps = r.counter(
+            "train_steps_total", "train steps dispatched", ("trainer",))
+        self._g_hbm_pred = r.gauge(
+            "train_hbm_predicted_peak_bytes",
+            "liveness-estimator predicted per-device peak HBM", ("trainer",))
+        self._g_hbm_live = r.gauge(
+            "train_hbm_live_bytes",
+            "jax.live_arrays() census at last refresh", ("trainer",))
+        self._g_hbm_drift = r.gauge(
+            "train_hbm_drift_frac",
+            "live census / predicted steady-state residency - 1",
+            ("trainer",))
+
+    # -- static side (once) --------------------------------------------
+    def prime(self, x, y) -> "TrainerTelemetry":
+        """Price the jitted step with the r10 analyzers: flops per step
+        (MFU numerator) and predicted peak/resident HBM. ``x``/``y`` are
+        one representative batch (shapes only — nothing is executed)."""
+        import jax.numpy as jnp
+
+        from ..analysis.cost import graph_cost
+        from ..analysis.graph import AnalysisTarget
+        from ..analysis.memory import estimate_memory
+        from ..random import split_key
+        from ..tensor import Tensor
+
+        tr = self.trainer
+        if tr._jit_step is None:
+            tr._build()
+        xb = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        yb = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        lr = jnp.asarray(float(tr.optimizer.get_lr()), jnp.float32)
+        args = (tr.params, tr.opt_state, tr.buffers, xb, yb, split_key(),
+                tr.scale_state, tr.sentinel_state, lr)
+        mesh_axes = {str(k): int(v) for k, v in tr.mesh.shape.items()}
+        target = AnalysisTarget(f"telemetry_{self.name}", tr._jit_step,
+                                args, mesh_axes=mesh_axes)
+        cost = graph_cost(target.graph(), mesh_axes)
+        self.flops_per_step = float(cost.flops)
+        self._g_flops.set(self.flops_per_step, trainer=self.name)
+        est = estimate_memory(target)
+        self.predicted_peak_bytes = int(est.peak_bytes)
+        self.predicted_resident_bytes = int(est.resident_bytes)
+        self._g_hbm_pred.set(self.predicted_peak_bytes, trainer=self.name)
+        return self
+
+    # -- hot path -------------------------------------------------------
+    def step(self, x, y):
+        """``trainer.step`` with step-time + MFU observation. Wall time is
+        measured return-to-return: with async dispatch the host is back-
+        pressured by the device queue, so the steady-state gap IS the
+        device step time (the first gap is dispatch-only and skipped)."""
+        t0 = time.perf_counter()
+        loss = self.trainer.step(x, y)
+        now = time.perf_counter()
+        prev = self._last_return
+        self._last_return = now
+        self._steps += 1
+        self._c_steps.inc(trainer=self.name)
+        dt = now - (prev if prev is not None and prev > t0 - 120.0 else t0)
+        if self._steps > 1:  # first observation is compile + dispatch
+            self.observe_step(dt)
+        return loss
+
+    def observe_step(self, seconds: float):
+        """Record one measured step time and refresh the MFU gauge (use
+        directly when the loop times itself)."""
+        self._h_step.observe(float(seconds), trainer=self.name)
+        if self.flops_per_step and seconds > 0:
+            self._g_mfu.set(
+                self.flops_per_step / (float(seconds) * self.peak_flops),
+                trainer=self.name)
+
+    # -- census side -----------------------------------------------------
+    def refresh_hbm(self) -> Dict[str, float]:
+        """``jax.live_arrays()`` census next to the prediction: sets the
+        live gauge and the drift fraction (census / predicted residency -
+        1; the estimator's steady-state number is the comparable one —
+        the transient peak exists only inside a step)."""
+        import jax
+
+        live = sum(int(a.nbytes) for a in jax.live_arrays())
+        self._g_hbm_live.set(live, trainer=self.name)
+        out = {"live_bytes": float(live)}
+        if self.predicted_resident_bytes:
+            drift = live / self.predicted_resident_bytes - 1.0
+            self._g_hbm_drift.set(drift, trainer=self.name)
+            out["predicted_resident_bytes"] = float(
+                self.predicted_resident_bytes)
+            out["predicted_peak_bytes"] = float(
+                self.predicted_peak_bytes or 0)
+            out["drift_frac"] = drift
+        return out
+
+    def report(self) -> Dict:
+        """Host-side summary of the live gauges (JSON-ready)."""
+        return {
+            "mfu": self._g_mfu.value(trainer=self.name),
+            "flops_per_step": self.flops_per_step,
+            "step_seconds_p50": self._h_step.percentile(
+                50, trainer=self.name),
+            "step_seconds_p95": self._h_step.percentile(
+                95, trainer=self.name),
+            "steps": self._steps,
+            "hbm_predicted_peak_bytes": self.predicted_peak_bytes,
+            "hbm_predicted_resident_bytes": self.predicted_resident_bytes,
+            "hbm_live_bytes": self._g_hbm_live.value(trainer=self.name),
+            "hbm_drift_frac": self._g_hbm_drift.value(trainer=self.name),
+        }

@@ -1,0 +1,66 @@
+"""Multi-process trace stitching: N dumps → one chrome-trace timeline.
+
+``python -m paddle_tpu.observability merge -o out.json a.json b.json ...``
+
+Inputs are the versioned JSON dumps this package writes — trace dumps
+(:func:`.trace.dump_trace`) AND flight-recorder dumps (both carry a
+``spans`` list + ``pid``/``process``). Spans ride wall-clock timestamps,
+so records from a router process and its replica processes line up on the
+shared clock; ``--trace-id`` filters to one request's spans across every
+process (the "where did this request spend its time" view).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+from .trace import to_chrome_trace
+
+__all__ = ["load_dump", "merge_dumps", "merge_files"]
+
+
+def load_dump(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "spans" not in doc:
+        raise ValueError(f"{path}: not a paddle_tpu trace/flight dump "
+                         f"(no 'spans' list)")
+    return doc
+
+
+def merge_dumps(dumps: Sequence[dict],
+                trace_id: Optional[str] = None) -> dict:
+    """One chrome-trace document from several process dumps. Span pids
+    default to the dump's pid (older spans carry their own); process
+    names become chrome metadata so tracks are labelled."""
+    spans: List[dict] = []
+    process_names: Dict[int, str] = {}
+    n_dropped = 0
+    for doc in dumps:
+        pid = int(doc.get("pid", 0))
+        name = str(doc.get("process", "") or f"pid-{pid}")
+        process_names[pid] = name
+        n_dropped += int(doc.get("dropped_spans", 0) or 0)
+        for s in doc.get("spans", ()):
+            d = dict(s)
+            d.setdefault("pid", pid)
+            if trace_id is not None and d.get("trace_id") != trace_id:
+                continue
+            spans.append(d)
+    out = to_chrome_trace(spans, process_names=process_names)
+    out["metadata"] = {
+        "merged_dumps": len(dumps),
+        "n_spans": len(spans),
+        "dropped_spans_total": n_dropped,
+        "trace_id_filter": trace_id,
+    }
+    return out
+
+
+def merge_files(paths: Sequence[str], out_path: Optional[str] = None,
+                trace_id: Optional[str] = None) -> dict:
+    doc = merge_dumps([load_dump(p) for p in paths], trace_id=trace_id)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+    return doc

@@ -1,0 +1,453 @@
+"""Metrics registry: counters, gauges, log-bucketed histograms, Prometheus.
+
+Parity: the reference exports monitor table/StatValue series ("Monitor"
+ops, ``paddle.fluid.monitor``) and Paddle Serving exposes brpc vars; the
+cloud-native form of both is Prometheus text exposition. One registry owns
+a process's series; each metric supports labels; histograms use
+log-spaced (power-of-2) buckets so one layout covers microsecond decode
+ticks and minute-long checkpoint saves, with p50/p95/p99 estimated from
+the bucket counts (what the JSON snapshot reports).
+
+Exposition (:meth:`MetricsRegistry.prometheus_text`) follows the text
+format 0.0.4 rules: ``# HELP``/``# TYPE`` headers, escaped label values,
+cumulative ``_bucket{le=...}`` series ending in ``+Inf``, ``_sum`` and
+``_count`` — a strict parser (the test ships one) must accept a scrape.
+
+:func:`start_http_exporter` mounts a registry on a tiny HTTP endpoint
+(``GET /metrics``) with Accept negotiation — Prometheus text by default,
+the JSON dict under ``Accept: application/json`` — the training-side
+exporter; the serving server and router reuse the same negotiation with
+JSON as *their* default (their JSON bodies predate this module and stay
+byte-compatible).
+"""
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+    "log_buckets",
+    "prometheus_content_type",
+    "wants_prometheus",
+    "MetricsHTTPServer",
+    "start_http_exporter",
+]
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+#: the Prometheus text-format content type served on a negotiated scrape
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def prometheus_content_type() -> str:
+    return PROMETHEUS_CONTENT_TYPE
+
+
+def wants_prometheus(accept: Optional[str]) -> bool:
+    """Accept-header negotiation: does the client want text exposition?
+    JSON stays the default — existing ``ServingClient``/router consumers
+    send no Accept (or ``*/*``) and must keep their byte-compatible body."""
+    if not accept:
+        return False
+    accept = accept.lower()
+    return ("text/plain" in accept or "openmetrics" in accept
+            or "prometheus" in accept)
+
+
+def log_buckets(lo: float = 1e-4, hi: float = 64.0,
+                factor: float = 2.0) -> List[float]:
+    """Log-spaced bucket upper bounds covering [lo, hi] (seconds): 0.1ms
+    decode ticks through minute-long saves in ~20 buckets."""
+    if not (lo > 0 and hi > lo and factor > 1):
+        raise ValueError("need lo > 0, hi > lo, factor > 1")
+    out, b = [], float(lo)
+    while b < hi:
+        out.append(b)
+        b *= factor
+    out.append(float(hi))
+    return out
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return (s.replace("\\", "\\\\").replace("\"", "\\\"")
+             .replace("\n", "\\n"))
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if isinstance(v, float) and math.isnan(v):
+        return "NaN"
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _label_str(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str,
+                 labelnames: Sequence[str] = ()):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"bad metric name {name!r}")
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"bad label name {ln!r}")
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != declared "
+                f"{sorted(self.labelnames)}")
+        return tuple((k, str(labels[k])) for k in self.labelnames)
+
+
+class Counter(_Metric):
+    """Monotonic counter (per label set)."""
+
+    kind = "counter"
+
+    def __init__(self, name, help, labelnames=()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels):
+        if amount < 0:
+            raise ValueError("counters only go up")
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def _samples(self):
+        with self._lock:
+            items = sorted(self._values.items())
+        return [(self.name, k, v) for k, v in items] or (
+            [(self.name, (), 0.0)] if not self.labelnames else [])
+
+    def _to_dict(self):
+        with self._lock:
+            if not self.labelnames:
+                return self._values.get((), 0.0)
+            return {json.dumps(dict(k)): v
+                    for k, v in sorted(self._values.items())}
+
+
+class Gauge(_Metric):
+    """Set-to-current-value metric (per label set)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help, labelnames=()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, **labels):
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels):
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> Optional[float]:
+        with self._lock:
+            return self._values.get(self._key(labels))
+
+    def remove(self, **labels):
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
+    def _samples(self):
+        with self._lock:
+            items = sorted(self._values.items())
+        return [(self.name, k, v) for k, v in items]
+
+    def _to_dict(self):
+        with self._lock:
+            if not self.labelnames:
+                return self._values.get(())
+            return {json.dumps(dict(k)): v
+                    for k, v in sorted(self._values.items())}
+
+
+class Histogram(_Metric):
+    """Log-bucketed histogram with percentile estimation.
+
+    Buckets are UPPER bounds (``le`` semantics); an implicit ``+Inf``
+    bucket catches the tail. Percentiles interpolate linearly inside the
+    selected bucket (0 as the floor of the first), which is the usual
+    Prometheus ``histogram_quantile`` estimate — good to a bucket width.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, help, labelnames=(), buckets=None):
+        super().__init__(name, help, labelnames)
+        bs = sorted(float(b) for b in (buckets or log_buckets()))
+        if not bs:
+            raise ValueError("need at least one bucket")
+        self.buckets = bs
+        self._counts: Dict[Tuple, List[int]] = {}   # per-bucket + +Inf
+        self._sum: Dict[Tuple, float] = {}
+
+    def observe(self, value: float, **labels):
+        k = self._key(labels)
+        v = float(value)
+        with self._lock:
+            counts = self._counts.setdefault(k, [0] * (len(self.buckets) + 1))
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sum[k] = self._sum.get(k, 0.0) + v
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            return sum(self._counts.get(self._key(labels), ()))
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            return self._sum.get(self._key(labels), 0.0)
+
+    def percentile(self, q: float, **labels) -> Optional[float]:
+        """Estimated q-th percentile (q in [0, 100])."""
+        k = self._key(labels)
+        with self._lock:
+            counts = list(self._counts.get(k, ()))
+        total = sum(counts)
+        if not total:
+            return None
+        rank = q / 100.0 * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                lo = self.buckets[i - 1] if 0 < i <= len(self.buckets) else 0.0
+                hi = self.buckets[i] if i < len(self.buckets) else lo
+                frac = (rank - cum) / c
+                return lo + (hi - lo) * max(0.0, min(1.0, frac))
+            cum += c
+        return self.buckets[-1]
+
+    def _samples(self):
+        out = []
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sum)
+        for k, counts in items:
+            cum = 0
+            for b, c in zip(self.buckets, counts):
+                cum += c
+                out.append((self.name + "_bucket",
+                            k + (("le", _fmt(b)),), cum))
+            cum += counts[-1]
+            out.append((self.name + "_bucket", k + (("le", "+Inf"),), cum))
+            out.append((self.name + "_sum", k, sums.get(k, 0.0)))
+            out.append((self.name + "_count", k, cum))
+        return out
+
+    def _to_dict(self):
+        def one(k):
+            with self._lock:
+                counts = list(self._counts.get(k, ()))
+                s = self._sum.get(k, 0.0)
+            n = sum(counts)
+            return {
+                "count": n,
+                "sum": s,
+                "p50": self.percentile(50, **dict(k)),
+                "p95": self.percentile(95, **dict(k)),
+                "p99": self.percentile(99, **dict(k)),
+            }
+        with self._lock:
+            keys = sorted(self._counts)
+        if not self.labelnames:
+            return one(())
+        return {json.dumps(dict(k)): one(k) for k in keys}
+
+
+class MetricsRegistry:
+    """Ordered name → metric registry with get-or-create constructors."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: "Dict[str, _Metric]" = {}
+
+    def _get_or_create(self, cls, name, help, labelnames, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or m.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{m.kind} with labels {m.labelnames}")
+                return m
+            m = cls(name, help, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def clear(self):
+        with self._lock:
+            self._metrics.clear()
+
+    def prometheus_text(self) -> str:
+        """Text exposition format 0.0.4 of every registered series."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            samples = m._samples()
+            if not samples:
+                continue
+            if m.help:
+                lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for name, labels, value in samples:
+                lines.append(f"{name}{_label_str(labels)} {_fmt(value)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: {"type": m.kind, "help": m.help,
+                         "values": m._to_dict()} for m in metrics}
+
+
+_default = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry (training-side series land here)."""
+    return _default
+
+
+# -- HTTP exposition ---------------------------------------------------------
+class MetricsHTTPServer:
+    """Minimal ``GET /metrics`` endpoint with Accept negotiation, on the
+    fleet http_server.py idiom (the serving/router planes reuse exactly
+    this shape). ``json_fn`` produces the default JSON body; ``prom_fn``
+    the Prometheus text body (served when the client asks for text)."""
+
+    def __init__(self, json_fn: Callable[[], dict],
+                 prom_fn: Callable[[], str], host: str = "127.0.0.1",
+                 port: int = 0, default_prometheus: bool = False):
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.rstrip("/") != "/metrics":
+                    body = b'{"error": "unknown endpoint"}'
+                    self.send_response(404)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                accept = self.headers.get("Accept")
+                prom = wants_prometheus(accept) or (
+                    default_prometheus
+                    and "json" not in (accept or "").lower())
+                if prom:
+                    body = prom_fn().encode()
+                    ctype = PROMETHEUS_CONTENT_TYPE
+                else:
+                    body = json.dumps(json_fn()).encode()
+                    ctype = "application/json"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self.addr = f"{host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsHTTPServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def start_http_exporter(registry: Optional[MetricsRegistry] = None,
+                        host: str = "127.0.0.1",
+                        port: int = 0) -> MetricsHTTPServer:
+    """Training-side exporter: mount ``registry`` (default: the process
+    registry) on ``GET /metrics`` — Prometheus text on a negotiated
+    scrape, the JSON dict under ``Accept: application/json``."""
+    reg = registry or _default
+    return MetricsHTTPServer(json_fn=reg.to_dict,
+                             prom_fn=reg.prometheus_text,
+                             host=host, port=port,
+                             default_prometheus=True).start()

@@ -1,0 +1,344 @@
+"""Distributed request tracing: trace IDs, spans, and a bounded ring buffer.
+
+Parity: the reference's observability surface (``paddle.profiler`` +
+VisualDL timelines) answers "where did this step spend its time" for ONE
+process; production serving needs the cross-process form — "where did this
+REQUEST spend its time" as it crosses the router, a replica's admission
+queue, the prefill program, and every decode tick. This module is the wire
+format for that question:
+
+* **Trace IDs** are minted at the request's entry point (the serving
+  router) and propagated through HTTP headers (:data:`TRACE_HEADER` /
+  :data:`PARENT_HEADER`) into the replica's scheduler and engine; training
+  loops mint one per run.
+* **Spans** are host-side wall-clock intervals (name, trace/span/parent
+  ids, attrs) recorded into a bounded in-process ring buffer — old spans
+  fall off, so a long-running server never grows without bound and the
+  flight recorder always has "the last N things that happened".
+* **Export** is Perfetto/chrome-trace JSON (``chrome://tracing`` /
+  ``ui.perfetto.dev``); :mod:`.merge` stitches dumps from multiple
+  processes into one timeline keyed by trace ID.
+
+Zero-perturbation guarantee (the r6/r7 bar, extended to tracing): spans are
+PURE HOST bookkeeping. ``span()`` never calls ``jax.named_scope`` and
+records NOTHING while jax is tracing a program, so a jitted step compiles
+to the identical jaxpr whether tracing is enabled or not (tests pin this
+for the trainer and pipeline steps). Disabled (the default), ``span()`` is
+one module-flag read.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "TRACE_HEADER",
+    "PARENT_HEADER",
+    "TRACE_SCHEMA_VERSION",
+    "Span",
+    "SpanRing",
+    "span",
+    "event",
+    "record_span",
+    "trace_context",
+    "current_trace",
+    "new_trace_id",
+    "new_span_id",
+    "enable_tracing",
+    "disable_tracing",
+    "tracing_enabled",
+    "span_ring",
+    "snapshot_spans",
+    "reset_spans",
+    "to_chrome_trace",
+    "dump_trace",
+]
+
+#: HTTP headers carrying the trace context between router and replicas
+TRACE_HEADER = "X-Trace-Id"
+PARENT_HEADER = "X-Parent-Span"
+
+#: version of the trace-dump JSON layout (``dump_trace`` / flight spans)
+TRACE_SCHEMA_VERSION = 1
+
+_enabled = False
+
+
+def new_trace_id() -> str:
+    """128-bit random id, 16 hex chars (w3c-traceparent-ish, short form)."""
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclasses.dataclass
+class Span:
+    """One host-side wall-clock interval. ``ts`` is epoch seconds (spans
+    from different processes merge on the shared wall clock), ``dur`` is a
+    monotonic-clock duration."""
+
+    name: str
+    trace_id: Optional[str]
+    span_id: str
+    parent_id: Optional[str]
+    ts: float
+    dur: float
+    pid: int = dataclasses.field(default_factory=os.getpid)
+    tid: str = ""
+    attrs: Dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "ts": self.ts,
+            "dur": self.dur,
+            "pid": self.pid,
+            "tid": self.tid,
+            "attrs": dict(self.attrs),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Span":
+        return cls(name=d["name"], trace_id=d.get("trace_id"),
+                   span_id=d.get("span_id", ""),
+                   parent_id=d.get("parent_id"), ts=float(d["ts"]),
+                   dur=float(d.get("dur", 0.0)), pid=int(d.get("pid", 0)),
+                   tid=str(d.get("tid", "")), attrs=dict(d.get("attrs", {})))
+
+
+class SpanRing:
+    """Thread-safe bounded span buffer (oldest spans fall off)."""
+
+    def __init__(self, max_spans: int = 8192):
+        self._lock = threading.Lock()
+        self._ring: "deque[Span]" = deque(maxlen=int(max_spans))
+        self.dropped = 0  # spans that fell off the ring (bounded-loss gauge)
+
+    @property
+    def max_spans(self) -> int:
+        return self._ring.maxlen or 0
+
+    def record(self, s: Span):
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(s)
+
+    def snapshot(self, last: Optional[int] = None) -> List[Span]:
+        with self._lock:
+            spans = list(self._ring)
+        return spans if last is None else spans[-int(last):]
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+_ring = SpanRing()
+
+#: (trace_id, span_id) of the innermost open span in this task/thread
+_ctx: "contextvars.ContextVar[Optional[Tuple[str, Optional[str]]]]" = \
+    contextvars.ContextVar("paddle_tpu_trace_ctx", default=None)
+
+
+def span_ring() -> SpanRing:
+    return _ring
+
+
+def enable_tracing(max_spans: Optional[int] = None):
+    """Arm span collection. ``max_spans`` resizes the ring (and clears it)."""
+    global _enabled, _ring
+    if max_spans is not None and int(max_spans) != _ring.max_spans:
+        _ring = SpanRing(int(max_spans))
+    _enabled = True
+
+
+def disable_tracing():
+    global _enabled
+    _enabled = False
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def snapshot_spans(last: Optional[int] = None) -> List[Span]:
+    return _ring.snapshot(last)
+
+
+def reset_spans():
+    _ring.clear()
+
+
+def _in_jax_trace() -> bool:
+    """True while jax is tracing a program — spans must record nothing
+    there (the jaxpr-identity guarantee); reuses the r6 probe."""
+    from ..profiler.scope import _tracing
+
+    return _tracing()
+
+
+def current_trace() -> Optional[Tuple[str, Optional[str]]]:
+    """(trace_id, span_id) of the innermost open span, or None."""
+    return _ctx.get()
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: str, parent_id: Optional[str] = None):
+    """Install a trace context for the current thread/task — spans opened
+    inside inherit ``trace_id`` and parent onto ``parent_id`` (the receive
+    side of header propagation)."""
+    token = _ctx.set((trace_id, parent_id))
+    try:
+        yield
+    finally:
+        _ctx.reset(token)
+
+
+@contextlib.contextmanager
+def span(name: str, *, trace_id: Optional[str] = None,
+         parent_id: Optional[str] = None, **attrs):
+    """``with span("serving.route", replica=addr) as sp:`` — time a region
+    into the ring. Yields the :class:`Span` (its ``span_id`` is the parent
+    handle for child spans / header propagation; ``attrs`` may be added to
+    while open). Inherits trace/parent from the ambient context when not
+    given. No-op (yields None) when tracing is disabled or jax is tracing.
+    """
+    if not _enabled or _in_jax_trace():
+        yield None
+        return
+    inherited = _ctx.get()
+    if trace_id is None and inherited is not None:
+        trace_id = inherited[0]
+        if parent_id is None:
+            parent_id = inherited[1]
+    s = Span(name=name, trace_id=trace_id, span_id=new_span_id(),
+             parent_id=parent_id, ts=time.time(), dur=0.0,
+             tid=threading.current_thread().name, attrs=dict(attrs))
+    # trace-less spans still nest (parent via context) — a training loop
+    # without a minted trace id keeps its step ⊃ checkpoint_save tree
+    token = _ctx.set((trace_id, s.span_id))
+    t0 = time.perf_counter()
+    try:
+        yield s
+    finally:
+        s.dur = time.perf_counter() - t0
+        _ctx.reset(token)
+        _ring.record(s)
+
+
+def record_span(name: str, *, ts: float, dur: float,
+                trace_id: Optional[str] = None,
+                parent_id: Optional[str] = None,
+                attrs: Optional[Dict] = None) -> Optional[Span]:
+    """Record a retrospective span with explicit timing (e.g. queue wait:
+    the interval is only known once the request leaves the queue). Inherits
+    the ambient trace context when no explicit ids are given (profiler
+    ``scope`` regions nest under the enclosing request/step span). Returns
+    the span (None when disabled / inside a jax trace)."""
+    if not _enabled or _in_jax_trace():
+        return None
+    if trace_id is None:
+        inherited = _ctx.get()
+        if inherited is not None:
+            trace_id = inherited[0]
+            if parent_id is None:
+                parent_id = inherited[1]
+    s = Span(name=name, trace_id=trace_id, span_id=new_span_id(),
+             parent_id=parent_id, ts=float(ts), dur=float(dur),
+             tid=threading.current_thread().name, attrs=dict(attrs or {}))
+    _ring.record(s)
+    return s
+
+
+def event(name: str, *, trace_id: Optional[str] = None,
+          parent_id: Optional[str] = None, **attrs) -> Optional[Span]:
+    """Zero-duration marker span (rank failure, breaker flip, ...)."""
+    if not _enabled or _in_jax_trace():
+        return None
+    inherited = _ctx.get()
+    if trace_id is None and inherited is not None:
+        trace_id = inherited[0]
+        if parent_id is None:
+            parent_id = inherited[1]
+    s = Span(name=name, trace_id=trace_id, span_id=new_span_id(),
+             parent_id=parent_id, ts=time.time(), dur=0.0,
+             tid=threading.current_thread().name, attrs=dict(attrs))
+    _ring.record(s)
+    return s
+
+
+# -- export -----------------------------------------------------------------
+def to_chrome_trace(spans: Sequence, process_names: Optional[Dict[int, str]]
+                    = None) -> dict:
+    """Chrome-trace/Perfetto JSON from spans (:class:`Span` or their
+    dicts): complete ("X") events in microseconds, pid/tid preserved so a
+    merged multi-process dump renders as parallel tracks."""
+    events = []
+    tids: Dict[Tuple[int, str], int] = {}
+    for s in spans:
+        d = s.to_dict() if isinstance(s, Span) else dict(s)
+        key = (int(d.get("pid", 0)), str(d.get("tid", "")))
+        tid = tids.setdefault(key, len(tids) + 1)
+        args = {k: v for k, v in (d.get("attrs") or {}).items()}
+        if d.get("trace_id"):
+            args["trace_id"] = d["trace_id"]
+        if d.get("span_id"):
+            args["span_id"] = d["span_id"]
+        if d.get("parent_id"):
+            args["parent_id"] = d["parent_id"]
+        events.append({
+            "name": d["name"],
+            "ph": "X",
+            "ts": float(d["ts"]) * 1e6,
+            "dur": float(d.get("dur", 0.0)) * 1e6,
+            "pid": int(d.get("pid", 0)),
+            "tid": tid,
+            "args": args,
+        })
+    events.sort(key=lambda e: e["ts"])
+    meta = []
+    for (pid, tname), tid in sorted(tids.items(), key=lambda kv: kv[1]):
+        if tname:
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid, "args": {"name": tname}})
+    for pid, pname in sorted((process_names or {}).items()):
+        meta.append({"name": "process_name", "ph": "M", "pid": int(pid),
+                     "tid": 0, "args": {"name": pname}})
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def dump_trace(path: Optional[str] = None, process: Optional[str] = None,
+               last: Optional[int] = None) -> dict:
+    """Versioned JSON dump of the current ring (one process's record; feed
+    several to ``python -m paddle_tpu.observability merge``)."""
+    doc = {
+        "schema_version": TRACE_SCHEMA_VERSION,
+        "process": process or f"pid-{os.getpid()}",
+        "pid": os.getpid(),
+        "wall_time": time.time(),
+        "dropped_spans": _ring.dropped,
+        "spans": [s.to_dict() for s in _ring.snapshot(last)],
+    }
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+    return doc

@@ -148,16 +148,39 @@ def scope(name: str):
     Inside a trace: pure HLO-metadata naming (compiles away).  Outside a
     trace with timers enabled: wall-clocked host span + TraceAnnotation.
     Outside a trace with timers disabled: HLO-metadata naming only.
+
+    Unified-telemetry integration (r12): with the observability plane's
+    tracing armed, the same host interval ALSO lands as a span in the
+    trace ring (inheriting the ambient trace context), so profiler
+    regions and request traces share one timeline.  The host-side clock
+    reads are gated on the SAME not-``_tracing()`` probe as the timers —
+    a ``scope`` hit while jax is tracing a jitted program contributes
+    HLO metadata only, so enabling tracing cannot perturb the jaxpr
+    (pinned by the trainer/pipeline jaxpr-identity tests).
     """
     import jax
 
-    if _timers_enabled and not _tracing():
+    from ..observability import trace as _obs
+
+    host = not _tracing()
+    want_timer = _timers_enabled and host
+    want_span = host and _obs.tracing_enabled()
+    if want_timer or want_span:
+        ts = time.time()
         t0 = time.perf_counter()
         try:
-            with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
-                yield
+            if want_timer:
+                with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+                    yield
+            else:
+                with jax.named_scope(name):
+                    yield
         finally:
-            timer_registry.record(name, time.perf_counter() - t0)
+            dur = time.perf_counter() - t0
+            if want_timer:
+                timer_registry.record(name, dur)
+            if want_span:
+                _obs.record_span(name, ts=ts, dur=dur)
     else:
         with jax.named_scope(name):
             yield

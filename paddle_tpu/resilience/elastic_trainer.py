@@ -46,6 +46,9 @@ from ..distributed.fleet.elastic.collective import (
     pack_arrays,
     unpack_arrays,
 )
+from ..observability import trace as obstrace
+from ..observability.flight import flight_recorder
+from ..observability.metrics import default_registry
 from ..framework.checkpoint import (
     CheckpointManager,
     reshard_train_state,
@@ -108,6 +111,19 @@ class ElasticDPTrainer:
         # the read+CRC cost twice per recovery
         self._pick_cache: Optional[tuple] = None
         self.history: List[Tuple[int, int, float]] = []  # (step, world, loss)
+        # first-class elastic series (the rendezvous generation and world
+        # size become scrapeable next to the serving/router planes)
+        r = default_registry()
+        self._g_world = r.gauge("elastic_world_size",
+                                "committed dp world size", ("node",))
+        self._g_gen = r.gauge("elastic_rendezvous_generation",
+                              "committed rendezvous generation", ("node",))
+        self._g_rank = r.gauge("elastic_rank", "this process's rank",
+                               ("node",))
+        self._c_recoveries = r.counter(
+            "elastic_recoveries_total",
+            "rank-failure recoveries survived", ("node",))
+        self._node = str(manager.node_id)
 
     # -- state shape ----------------------------------------------------
     @property
@@ -143,9 +159,15 @@ class ElasticDPTrainer:
 
     # -- lifecycle ------------------------------------------------------
     def _join(self, gen: int, min_ranks: Optional[int] = None):
-        self.collective.rendezvous(gen,
-                                   min_ranks=min_ranks or self.min_ranks,
-                                   timeout=self.rendezvous_timeout)
+        with obstrace.span("train.rendezvous", generation=int(gen)):
+            self.collective.rendezvous(gen,
+                                       min_ranks=min_ranks or self.min_ranks,
+                                       timeout=self.rendezvous_timeout)
+        self._g_world.set(self.world, node=self._node)
+        self._g_rank.set(self.rank, node=self._node)
+        self._g_gen.set(int(self.collective.generation), node=self._node)
+        flight_recorder().note(world=self.world, rank=self.rank,
+                               generation=int(self.collective.generation))
         self.on_event(f"rendezvous gen={gen} rank={self.rank}/"
                       f"{self.world} members={self.collective.members}")
 
@@ -192,6 +214,11 @@ class ElasticDPTrainer:
 
     def _restore(self, snapshot_step: Optional[int]):
         """Load + reshard ``snapshot_step`` (None ⇒ virgin start)."""
+        with obstrace.span("train.reshard",
+                           snapshot_step=snapshot_step, world=self.world):
+            self._restore_impl(snapshot_step)
+
+    def _restore_impl(self, snapshot_step: Optional[int]):
         cache, self._pick_cache = self._pick_cache, None
         if snapshot_step is None:
             self.params = {n: np.array(a)
@@ -225,6 +252,8 @@ class ElasticDPTrainer:
         explicit snapshot step (the initial-restore path retrying after
         the leader died pre-broadcast must not lose its ``resume_step``)."""
         self.recoveries += 1
+        self._c_recoveries.inc(node=self._node)
+        obstrace.event("train.rank_failure", reason=str(reason)[:200])
         while True:
             self.on_event(f"recovering ({reason})")
             try:
@@ -237,6 +266,13 @@ class ElasticDPTrainer:
     # -- one step --------------------------------------------------------
     def _train_one_step(self) -> float:
         s, world, rank = self.step, self.world, self.rank
+        fr = flight_recorder()
+        if fr.armed or obstrace.tracing_enabled():
+            fr.note(step=s)
+        with obstrace.span("train.step", step=s, world=world, rank=rank):
+            return self._train_one_step_impl(s, world, rank)
+
+    def _train_one_step_impl(self, s: int, world: int, rank: int) -> float:
         loss, grads = self.grad_fn(self.params, s, rank, world)
         blobs = self.collective.allgather(
             f"g{s}", pack_arrays({"loss": np.asarray([loss], np.float64),

@@ -108,6 +108,11 @@ class PreemptionGuard:
         ``state`` may be the pytree itself or a zero-arg thunk producing it
         (thunks defer the device→host copies to save time)."""
         self._latest = (int(step), state)
+        # pin the step into the flight recorder so a SIGTERM dump names
+        # the final completed step even when the trainer isn't noting it
+        from ..observability.flight import flight_recorder
+
+        flight_recorder().note(step=int(step))
 
     def _current(self) -> Optional[Tuple[int, Any]]:
         if self._latest is not None:
@@ -187,6 +192,7 @@ class PreemptionGuard:
         except Exception as e:
             warnings.warn(f"PreemptionGuard: emergency save failed "
                           f"({type(e).__name__}: {e})", RuntimeWarning)
+        self._flight_dump(f"preemption_signal_{signum}")
         if self.on_preempt is not None:
             try:
                 self.on_preempt()
@@ -199,6 +205,24 @@ class PreemptionGuard:
         if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
             prev(signum, frame)
 
+    def _flight_dump(self, reason: str):
+        """Flight-recorder snapshot of the preemption moment. Lands in the
+        configured flight directory, defaulting to the checkpoint
+        manager's directory so a SIGTERM'd run always leaves a readable
+        dump naming its final step next to its snapshots. Contained —
+        the exit protocol survives any recorder failure."""
+        try:
+            from ..observability.flight import flight_recorder
+
+            fr = flight_recorder()
+            fr.dump(reason,
+                    extra={"saved_step": self.saved_step,
+                           "deadline": self.deadline},
+                    directory=None if fr.armed else getattr(
+                        self.manager, "directory", None))
+        except Exception:
+            pass
+
     def _watch(self):
         fire_at = self.deadline - self.grace
         while not self._stop.wait(self.watchdog_interval):
@@ -210,6 +234,7 @@ class PreemptionGuard:
                     warnings.warn(f"PreemptionGuard: deadline save failed "
                                   f"({type(e).__name__}: {e})",
                                   RuntimeWarning)
+                self._flight_dump("preemption_deadline")
                 return
 
     def install(self):

@@ -247,8 +247,18 @@ class SentinelMonitor:
                     "error": f"{type(e).__name__}: {e}"}
             host["sanitizer"] = self.last_sanitize
         if self.config.policy == "halt":
+            # freeze the flight record before the halt unwinds the loop:
+            # the ring still holds the spans (and step note) leading in,
+            # so the post-mortem names BOTH the eqn and the step. dump()
+            # is exception-contained — the halt can never be lost to it.
+            from ..observability.flight import flight_recorder
+
+            flight_recorder().dump("sentinel_halt", extra=host)
             raise AnomalyHalt(host)
         if self.config.policy == "rollback":
+            from ..observability.flight import flight_recorder
+
+            flight_recorder().dump("sentinel_rollback", extra=host)
             self.restore_fn()
             self._seen_anomalies = None
             return "rollback"

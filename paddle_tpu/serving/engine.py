@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..observability import trace as obstrace
 from .metrics import ServingMetrics
 from .scheduler import FCFSScheduler, Request, power_of_two_buckets
 
@@ -286,6 +287,17 @@ class ContinuousBatchingEngine:
             seed = int(req.seed)
         key = jax.random.PRNGKey(seed)
         before = self.trace_counts["prefill"]
+        # request-scoped spans: queue wait is recorded retrospectively
+        # (submit → this admission), and the prefill span parents the
+        # per-token decode spans — route ⊃ queue ⊃ prefill ⊃ decode
+        queue_span = None
+        if obstrace.tracing_enabled() and req.trace_id is not None:
+            queue_span = obstrace.record_span(
+                "serving.queue_wait", ts=req.submitted_wall,
+                dur=time.perf_counter() - req.submitted_at,
+                trace_id=req.trace_id, parent_id=req.parent_span_id,
+                attrs={"request_id": req.request_id})
+        t_prefill_wall, t_prefill = time.time(), time.perf_counter()
         # first use of a bucket traces, and tracing mutates the SHARED
         # model's attention layers — exclude other engines on this model
         guard = (contextlib.nullcontext() if bucket in self._traced_buckets
@@ -299,7 +311,20 @@ class ContinuousBatchingEngine:
                 jnp.float32(1.0 if req.top_p is None else req.top_p),
                 self._kc, self._vc)
         self._traced_buckets.add(bucket)
-        self.metrics.on_prefill(self.trace_counts["prefill"] > before)
+        compiled = self.trace_counts["prefill"] > before
+        if queue_span is not None:
+            prefill_span = obstrace.record_span(
+                "serving.prefill", ts=t_prefill_wall,
+                dur=time.perf_counter() - t_prefill,
+                trace_id=req.trace_id, parent_id=queue_span.span_id,
+                attrs={"request_id": req.request_id, "bucket": int(bucket),
+                       "prompt_len": int(t0), "slot": int(slot_idx),
+                       "compiled": compiled})
+            # record_span returns None if tracing was disabled between the
+            # two records — a telemetry toggle must never fail the tick
+            if prefill_span is not None:
+                req._decode_span_parent = prefill_span.span_id
+        self.metrics.on_prefill(compiled)
         first = int(first)
         req.state = Request.RUNNING
         req._append(first)
@@ -366,6 +391,7 @@ class ContinuousBatchingEngine:
                     did = True
             if self._active.any():
                 before = self.trace_counts["step"]
+                t_step_wall = time.time()
                 t_step = time.perf_counter()
                 guard = (self._trace_lock if self.trace_counts["step"] == 0
                          else contextlib.nullcontext())
@@ -386,6 +412,7 @@ class ContinuousBatchingEngine:
                 self._pos = np.array(pos)
                 self._keys = np.array(keys)
                 emitted = 0
+                spans_on = obstrace.tracing_enabled()
                 for i in range(self.n_slots):
                     req = self._slots[i]
                     if req is None or not self._active[i]:
@@ -393,6 +420,16 @@ class ContinuousBatchingEngine:
                     token = int(nxt[i])
                     req._append(token)
                     emitted += 1
+                    if spans_on and req.trace_id is not None:
+                        # one span per generated token: the slot shares the
+                        # batched step's wall interval (they decode together)
+                        obstrace.record_span(
+                            "serving.decode_token", ts=t_step_wall,
+                            dur=step_s, trace_id=req.trace_id,
+                            parent_id=req._decode_span_parent,
+                            attrs={"request_id": req.request_id,
+                                   "token_index": len(req.tokens) - 1,
+                                   "slot": i})
                     if self._request_finished(req, token):
                         self._retire(i, req)
                         self._slots[i] = None
@@ -466,8 +503,14 @@ class ContinuousBatchingEngine:
             try:
                 did = self.step_once()
             except Exception as e:  # contain: fail work, keep serving
-                self.fail_pending(f"engine tick failed: "
-                                  f"{type(e).__name__}: {e}")
+                err = f"engine tick failed: {type(e).__name__}: {e}"
+                # flight-record the failure BEFORE failing the requests:
+                # the ring still holds the spans leading up to the tick
+                from ..observability.flight import flight_recorder
+
+                flight_recorder().dump("engine_tick_failure",
+                                       extra={"error": err})
+                self.fail_pending(err)
                 did = False
             if did:
                 continue

@@ -40,9 +40,19 @@ def percentile(samples, q: float) -> Optional[float]:
 
 
 class ServingMetrics:
-    """Thread-safe counters/gauges/samples for one serving engine."""
+    """Thread-safe counters/gauges/samples for one serving engine.
 
-    def __init__(self, max_samples: int = 4096):
+    Every observation ALSO lands in an :class:`~paddle_tpu.observability
+    .metrics.MetricsRegistry` (one per instance), which is what the
+    Prometheus side of the ``/metrics`` endpoint exposes
+    (:meth:`prometheus_text`); :meth:`snapshot`'s JSON body is unchanged
+    — existing ``ServingClient``/router consumers parse byte-identical
+    output."""
+
+    def __init__(self, max_samples: int = 4096, registry=None):
+        from ..observability.flight import register_metrics_registry
+        from ..observability.metrics import MetricsRegistry, log_buckets
+
         self._lock = threading.Lock()
         self.requests_submitted = 0
         self.requests_rejected = 0
@@ -59,23 +69,62 @@ class ServingMetrics:
         self._token_lat = deque(maxlen=max_samples)
         self._first_emit: Optional[float] = None
         self._last_emit: Optional[float] = None
+        r = self.registry = registry or MetricsRegistry()
+        # crash dumps must freeze THIS engine's series, not just the
+        # process registry (weak attachment: dies with the engine)
+        register_metrics_registry("serving", r)
+        self._c_submitted = r.counter(
+            "serving_requests_submitted_total", "requests admitted")
+        self._c_rejected = r.counter(
+            "serving_requests_rejected_total", "requests rejected (429/503)")
+        self._c_completed = r.counter(
+            "serving_requests_completed_total", "requests finished")
+        self._c_tokens = r.counter(
+            "serving_tokens_generated_total", "generated tokens")
+        self._c_prefills = r.counter(
+            "serving_prefill_calls_total", "prefill program dispatches",
+            ("compiled",))
+        self._c_steps = r.counter(
+            "serving_decode_steps_total", "decode step dispatches",
+            ("compiled",))
+        lat = log_buckets(1e-4, 64.0)
+        self._h_ttft = r.histogram(
+            "serving_ttft_seconds", "submit to first token", buckets=lat)
+        self._h_token = r.histogram(
+            "serving_token_latency_seconds", "decode step wall time",
+            buckets=lat)
+        self._g_queue = r.gauge("serving_queue_depth",
+                                "admission queue depth")
+        self._g_in_admission = r.gauge(
+            "serving_in_admission", "requests popped but not yet placed")
+        self._g_active = r.gauge("serving_active_slots",
+                                 "occupied decode slots")
+        self._g_slots = r.gauge("serving_slots_total", "decode slots")
+        self._g_draining = r.gauge("serving_draining",
+                                   "1 while admissions are closed")
+        self._g_tput = r.gauge("serving_throughput_tokens_per_sec",
+                               "generated-token rate over emission window")
 
     # -- counters -----------------------------------------------------------
     def on_submit(self):
         with self._lock:
             self.requests_submitted += 1
+        self._c_submitted.inc()
 
     def on_reject(self):
         with self._lock:
             self.requests_rejected += 1
+        self._c_rejected.inc()
 
     def on_complete(self):
         with self._lock:
             self.requests_completed += 1
+        self._c_completed.inc()
 
     def on_first_token(self, ttft_seconds: float):
         with self._lock:
             self._ttft.append(ttft_seconds)
+        self._h_ttft.observe(ttft_seconds)
 
     def on_tokens(self, n: int, step_seconds: Optional[float] = None):
         now = time.perf_counter()
@@ -86,18 +135,24 @@ class ServingMetrics:
             self._last_emit = now
             if step_seconds is not None and n > 0:
                 self._token_lat.append(step_seconds)
+        if n > 0:
+            self._c_tokens.inc(n)
+            if step_seconds is not None:
+                self._h_token.observe(step_seconds)
 
     def on_prefill(self, compiled: bool):
         with self._lock:
             self.prefill_calls += 1
             if compiled:
                 self.prefill_compiles += 1
+        self._c_prefills.inc(compiled="true" if compiled else "false")
 
     def on_step(self, compiled: bool):
         with self._lock:
             self.step_calls += 1
             if compiled:
                 self.step_compiles += 1
+        self._c_steps.inc(compiled="true" if compiled else "false")
 
     # -- gauges (engine-owned, set each tick) -------------------------------
     def set_gauges(self, queue_depth: int, active_slots: int, n_slots: int):
@@ -105,6 +160,9 @@ class ServingMetrics:
             self.queue_depth = queue_depth
             self.active_slots = active_slots
             self.n_slots = n_slots
+        self._g_queue.set(queue_depth)
+        self._g_active.set(active_slots)
+        self._g_slots.set(n_slots)
 
     def retry_after_hint(self, queue_depth: Optional[int] = None) -> float:
         """Seconds a 429'd client should wait before retrying: the queued
@@ -181,3 +239,28 @@ class ServingMetrics:
         except Exception:
             pass
         return out
+
+    def prometheus_text(self, *, queue_depth: Optional[int] = None,
+                        in_admission: Optional[int] = None,
+                        active_slots: Optional[int] = None,
+                        n_slots: Optional[int] = None,
+                        draining: Optional[bool] = None) -> str:
+        """Prometheus exposition of this engine's series (the negotiated
+        side of ``/metrics``). Keyword overrides carry the LIVE admission
+        state the server reads at request time — the same freshness rule
+        the JSON body follows for the router's sake."""
+        with self._lock:
+            q = self.queue_depth if queue_depth is None else queue_depth
+            a = self.active_slots if active_slots is None else active_slots
+            n = self.n_slots if n_slots is None else n_slots
+        self._g_queue.set(int(q))
+        self._g_active.set(int(a))
+        self._g_slots.set(int(n))
+        if in_admission is not None:
+            self._g_in_admission.set(int(in_admission))
+        if draining is not None:
+            self._g_draining.set(1 if draining else 0)
+        tput = self.tokens_per_sec()
+        if tput is not None:
+            self._g_tput.set(tput)
+        return self.registry.prometheus_text()

@@ -38,6 +38,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..observability import trace as obstrace
+from ..observability.metrics import MetricsHTTPServer, MetricsRegistry
 from ..resilience.retry import RetryError, backoff_delays
 from .scheduler import QueueFullError, Request, SchedulerClosed
 from .server import RequestFailedError, ServingClient, StreamIncompleteError
@@ -74,6 +76,9 @@ class _Replica:
         self.opened_at: Optional[float] = None
         self.draining = False
         self.alive = True
+        # one flight dump per confirmed death (reset when the replica —
+        # or its restarted successor on the same address — answers again)
+        self.flight_dumped = False
         self.queue_depth = 0
         self.active_slots = 0
         self.n_slots = 0
@@ -99,6 +104,11 @@ class RoutedRequest:
     def __init__(self, prompt, **spec):
         self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1).tolist()
         self.spec = dict(spec)
+        # minted at the router (the request's entry point) and propagated
+        # via headers — the one id stitching router + replica spans
+        self.trace_id: Optional[str] = (
+            obstrace.new_trace_id() if obstrace.tracing_enabled() else None)
+        self.route_span_id: Optional[str] = None
         self.replica_addr: Optional[str] = None
         self.remote_id: Optional[str] = None
         self.tokens: List[int] = []
@@ -170,6 +180,35 @@ class ServingRouter:
         self._lock = threading.RLock()
         self._health_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # first-class series: breaker state, failover accounting, per-
+        # replica load — the Prometheus face of snapshot(); attached to
+        # the flight recorder so a replica-death dump freezes them
+        from ..observability.flight import register_metrics_registry
+
+        r = self.registry = MetricsRegistry()
+        register_metrics_registry("router", r)
+        self._c_failovers = r.counter(
+            "router_failovers_total", "confirmed replica deaths acted on")
+        self._c_resubmits = r.counter(
+            "router_resubmits_total", "requests re-homed onto a survivor")
+        self._c_inflight = r.counter(
+            "router_inflight_failures_total",
+            "requests surfaced FAILED after streaming tokens")
+        self._g_breaker = r.gauge(
+            "router_breaker_state",
+            "per-replica breaker (0=closed 1=half_open 2=open)",
+            ("replica",))
+        self._g_up = r.gauge(
+            "router_replica_up", "last probe answered", ("replica",))
+        self._g_queue = r.gauge(
+            "router_replica_queue_depth", "replica admission queue",
+            ("replica",))
+        self._g_active = r.gauge(
+            "router_replica_active_slots", "replica occupied slots",
+            ("replica",))
+        self._g_draining = r.gauge(
+            "router_replica_draining", "replica drain flag", ("replica",))
+        self._metrics_http: Optional[MetricsHTTPServer] = None
 
     # -- lifecycle ------------------------------------------------------
     def start(self):
@@ -183,6 +222,9 @@ class ServingRouter:
         if self._health_thread is not None:
             self._health_thread.join(5.0)
             self._health_thread = None
+        if self._metrics_http is not None:
+            self._metrics_http.stop()
+            self._metrics_http = None
 
     def __enter__(self):
         return self.start()
@@ -191,22 +233,34 @@ class ServingRouter:
         self.stop()
 
     # -- breaker bookkeeping --------------------------------------------
+    _BREAKER_CODE = {_Replica.CLOSED: 0, _Replica.HALF_OPEN: 1,
+                     _Replica.OPEN: 2}
+
     def _record_failure(self, rep: _Replica):
         with self._lock:
             rep.consecutive_failures += 1
             rep.alive = False
+            opened = False
             if (rep.state == _Replica.HALF_OPEN
                     or rep.consecutive_failures >= self.failure_threshold):
+                opened = rep.state != _Replica.OPEN
                 rep.state = _Replica.OPEN
                 rep.opened_at = time.monotonic()
+        self._g_up.set(0, replica=rep.addr)
+        self._g_breaker.set(self._BREAKER_CODE[rep.state], replica=rep.addr)
+        if opened:
+            obstrace.event("router.breaker_open", replica=rep.addr)
 
     def _record_success(self, rep: _Replica):
         with self._lock:
             rep.consecutive_failures = 0
             rep.alive = True
+            rep.flight_dumped = False
             if rep.state != _Replica.CLOSED:
                 rep.state = _Replica.CLOSED
                 rep.opened_at = None
+        self._g_up.set(1, replica=rep.addr)
+        self._g_breaker.set(0, replica=rep.addr)
 
     def _tick_breaker(self, rep: _Replica):
         with self._lock:
@@ -235,6 +289,11 @@ class ServingRouter:
             rep.active_slots = int(occ.get("active", 0))
             rep.n_slots = int(occ.get("total", 0))
             rep.tokens_per_sec = snap.get("throughput_tokens_per_sec")
+        self._g_queue.set(rep.queue_depth, replica=rep.addr)
+        self._g_active.set(rep.active_slots, replica=rep.addr)
+        self._g_draining.set(1 if snap.get("draining") else 0,
+                             replica=rep.addr)
+        with self._lock:
             # MIRROR the replica's drain state rather than latching it: a
             # replica restarted on the same address (reporting
             # draining=false) must rejoin the rotation. A request racing
@@ -281,7 +340,9 @@ class ServingRouter:
         last_exc: Optional[Exception] = None
         for rep in self._candidates():
             try:
-                rid = rep.client.submit(rr.prompt, **rr.spec)
+                rid = rep.client.submit(
+                    rr.prompt, trace_id=rr.trace_id,
+                    parent_span_id=rr.route_span_id, **rr.spec)
             except (OSError, RetryError, ValueError,
                     http.client.HTTPException) as e:  # transport: breaker
                 self._record_failure(rep)
@@ -314,9 +375,17 @@ class ServingRouter:
         """Route one request to the least-loaded healthy replica. Raises
         :class:`QueueFullError`/:class:`SchedulerClosed` only when EVERY
         healthy replica says so, :class:`NoReplicaAvailable` when none is
-        reachable."""
+        reachable. With tracing armed the request gets a fresh trace id
+        and a ``serving.route`` root span; the replica's queue/prefill/
+        decode spans hang off it through the propagated headers."""
         rr = RoutedRequest(prompt, **spec)
-        self._submit_somewhere(rr)
+        with obstrace.span("serving.route", trace_id=rr.trace_id) as sp:
+            if sp is not None:
+                rr.route_span_id = sp.span_id
+            self._submit_somewhere(rr)
+            if sp is not None:
+                sp.attrs["replica"] = rr.replica_addr
+                sp.attrs["remote_id"] = rr.remote_id
         return rr
 
     # -- failover ---------------------------------------------------------
@@ -360,9 +429,21 @@ class ServingRouter:
                 self._record_success(rep)
                 return True
             self._record_failure(rep)
+            with self._lock:
+                first_confirmation = not rep.flight_dumped
+                rep.flight_dumped = True
+            if first_confirmation:
+                # first CONFIRMED observation of this death (probe agreed):
+                # freeze the flight record once, not per affected request
+                from ..observability.flight import flight_recorder
+
+                flight_recorder().dump(
+                    "replica_death",
+                    extra={"replica": rep.addr, "error": str(err)})
         if rr.tokens:
             with self._lock:
                 self.inflight_failures += 1
+            self._c_inflight.inc()
             rr.failure_kind = "transport"
             rr.state = Request.FAILED
             rr.error = (f"replica {rr.replica_addr} died after "
@@ -370,12 +451,14 @@ class ServingRouter:
             return False
         with self._lock:
             self.failovers += 1
+        self._c_failovers.inc()
         delays = backoff_delays(self.resubmit_retries)
         for attempt in range(self.resubmit_retries + 1):
             try:
                 self._submit_somewhere(rr)
                 with self._lock:
                     self.resubmits += 1
+                self._c_resubmits.inc()
                 rr.resubmits += 1
                 return True
             except (QueueFullError, SchedulerClosed, NoReplicaAvailable):
@@ -545,3 +628,30 @@ class ServingRouter:
                 "resubmits": self.resubmits,
                 "inflight_failures": self.inflight_failures,
             }
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition of the router's series (breaker state,
+        failover accounting, per-replica load — refreshed from the live
+        replica views first)."""
+        with self._lock:
+            reps = list(self.replicas.values())
+        for rep in reps:
+            self._g_breaker.set(self._BREAKER_CODE[rep.state],
+                                replica=rep.addr)
+            self._g_up.set(1 if rep.alive else 0, replica=rep.addr)
+            self._g_queue.set(rep.queue_depth, replica=rep.addr)
+            self._g_active.set(rep.active_slots, replica=rep.addr)
+            self._g_draining.set(1 if rep.draining else 0, replica=rep.addr)
+        return self.registry.prometheus_text()
+
+    def serve_metrics(self, host: str = "127.0.0.1",
+                      port: int = 0) -> str:
+        """Mount the router's metrics on ``GET /metrics`` (the router-side
+        scrape endpoint): JSON :meth:`snapshot` by default, Prometheus
+        text under a negotiated ``Accept``. Returns the bound address;
+        :meth:`stop` tears it down."""
+        if self._metrics_http is None:
+            self._metrics_http = MetricsHTTPServer(
+                json_fn=self.snapshot, prom_fn=self.prometheus_text,
+                host=host, port=port).start()
+        return self._metrics_http.addr

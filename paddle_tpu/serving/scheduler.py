@@ -93,7 +93,9 @@ class Request:
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, seed: Optional[int] = None,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 trace_id: Optional[str] = None,
+                 parent_span_id: Optional[str] = None):
         self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise ValueError("empty prompt")
@@ -106,11 +108,23 @@ class Request:
         self.top_p = None if top_p is None else float(top_p)
         self.seed = seed
         self.request_id = request_id or f"req-{next(_req_ids)}"
+        # distributed-tracing context: the router mints the trace id and
+        # ships it via HTTP headers; a direct submit with tracing armed
+        # mints locally so engine-only runs still get request span trees
+        if trace_id is None:
+            from ..observability import trace as _obs
+
+            if _obs.tracing_enabled():
+                trace_id = _obs.new_trace_id()
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+        self._decode_span_parent: Optional[str] = None  # engine-owned
         self.tokens: List[int] = []
         self.state = Request.PENDING
         self.error: Optional[str] = None
         self.bucket: Optional[int] = None
         self.submitted_at = time.perf_counter()
+        self.submitted_wall = time.time()  # span timestamps are wall-clock
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self._cond = threading.Condition()

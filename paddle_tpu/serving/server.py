@@ -40,6 +40,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..observability import trace as obstrace
+from ..observability.metrics import prometheus_content_type, wants_prometheus
 from .engine import ContinuousBatchingEngine
 from .scheduler import QueueFullError, Request, SchedulerClosed
 
@@ -126,7 +128,11 @@ class _Handler(BaseHTTPRequestHandler):
             req = Request(prompt, **{
                 k: spec[k] for k in ("max_new_tokens", "eos_token_id",
                                      "temperature", "top_k", "top_p", "seed")
-                if k in spec})
+                if k in spec},
+                # trace context rides HEADERS, not the body — the JSON
+                # protocol stays byte-compatible for existing clients
+                trace_id=self.headers.get(obstrace.TRACE_HEADER),
+                parent_span_id=self.headers.get(obstrace.PARENT_HEADER))
             self.server_ref.engine.submit(req)
         except QueueFullError as e:
             # backpressure with a USEFUL hint: seconds of queued work ahead
@@ -155,6 +161,21 @@ class _Handler(BaseHTTPRequestHandler):
         parts = [p for p in self.path.split("/") if p]
         if parts == ["metrics"]:
             eng = self.server_ref.engine
+            if wants_prometheus(self.headers.get("Accept")):
+                # negotiated text exposition; the JSON default below stays
+                # byte-compatible for ServingClient/router consumers
+                active = eng.active_slots()
+                body = eng.metrics.prometheus_text(
+                    queue_depth=eng.scheduler.depth(),
+                    in_admission=eng.scheduler.in_admission(),
+                    active_slots=active, n_slots=eng.n_slots,
+                    draining=eng.scheduler.closed).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", prometheus_content_type())
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             snap = eng.metrics.snapshot()
             # the router's routing/drain decisions ride on these, so they
             # must be LIVE admission state — the registry's gauges are only
@@ -348,15 +369,19 @@ class ServingClient:
                                           timeout=self.timeout)
 
     def _call(self, method: str, path: str, body: Optional[Dict] = None,
-              retries: Optional[int] = None):
+              retries: Optional[int] = None,
+              headers: Optional[Dict[str, str]] = None):
         from ..resilience.retry import call_with_retries
 
         def attempt():
             c = self._conn()
             try:
+                hdrs = {"Content-Type": "application/json"}
+                if headers:
+                    hdrs.update(headers)
                 c.request(method, path,
                           body=None if body is None else json.dumps(body).encode(),
-                          headers={"Content-Type": "application/json"})
+                          headers=hdrs)
                 r = c.getresponse()
                 return r.status, json.loads(r.read().decode() or "{}")
             finally:
@@ -368,12 +393,20 @@ class ServingClient:
             attempt, retries=self.retries if retries is None else retries,
             retry_on=(OSError,))
 
-    def submit(self, prompt, **kwargs) -> str:
+    def submit(self, prompt, trace_id: Optional[str] = None,
+               parent_span_id: Optional[str] = None, **kwargs) -> str:
         # NO transport retry: a lost 202 after the server enqueued would
-        # silently duplicate the generation (submit is not idempotent)
+        # silently duplicate the generation (submit is not idempotent).
+        # Trace context propagates via headers (body stays protocol-stable).
+        headers = {}
+        if trace_id:
+            headers[obstrace.TRACE_HEADER] = trace_id
+        if parent_span_id:
+            headers[obstrace.PARENT_HEADER] = parent_span_id
         status, out = self._call("POST", "/v1/generate",
                                  {"prompt": np.asarray(prompt).tolist(),
-                                  **kwargs}, retries=0)
+                                  **kwargs}, retries=0,
+                                 headers=headers or None)
         if status == 429:
             raise QueueFullError(out.get("error", "queue full"),
                                  retry_after=out.get("retry_after_s"))

@@ -62,6 +62,12 @@ def pytest_configure(config):
         "markers",
         "slow: multi-process chaos/e2e tests (>10s), excluded from the "
         "tier-1 `-m 'not slow'` run")
+    config.addinivalue_line(
+        "markers",
+        "chaos: SIGTERM/SIGKILL process-kill tests (test_resilience / "
+        "test_elastic_dp / test_router_failover) — timing-sensitive under "
+        "concurrent load; rerun in isolation with `pytest -m chaos` "
+        "before calling a failure a regression")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -395,6 +395,7 @@ def _parse_steps(text, world=None):
 
 
 @pytest.mark.slow
+@pytest.mark.chaos
 def test_kill_one_rank_resharded_recovery_bit_identical(tmp_path):
     """SIGKILL 1 of 3 dp ranks mid-training: survivors re-rendezvous at
     dp=2, reshard the newest intact snapshot, continue — and the post-

@@ -1,0 +1,801 @@
+"""Unified telemetry plane (ISSUE 7): distributed tracing, Prometheus
+metrics, live MFU/HBM gauges, crash flight recorder.
+
+Acceptance bars exercised here:
+* one serving request traced across router and replica produces a single
+  trace id with a well-formed span tree (route ⊃ queue ⊃ prefill ⊃ decode
+  tokens) and a merged chrome-trace timeline (CLI e2e);
+* a Prometheus scrape of a LIVE server parses under a strict text-format
+  parser (this file ships one);
+* live MFU and HBM-drift gauges populate on a real trainer step;
+* flight-recorder dumps on a planted sentinel halt / engine tick failure /
+  SIGTERM name the final step and carry the last N spans;
+* tracing enabled vs disabled compiles the IDENTICAL jaxpr for trainer and
+  pipeline steps (the r6/r7 zero-perturbation bar, extended).
+"""
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import flight as obs_flight
+from paddle_tpu.observability import trace as obs_trace
+from paddle_tpu.observability.metrics import (
+    MetricsRegistry,
+    log_buckets,
+    wants_prometheus,
+)
+from paddle_tpu.resilience import AnomalyHalt, SentinelConfig, SentinelMonitor
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    obs.disable_tracing()
+    obs_trace.reset_spans()
+    fr = obs_flight.flight_recorder()
+    fr.directory = None
+    fr.last = fr.last_path = None
+    with fr._lock:
+        fr._notes.clear()
+    yield
+    obs.disable_tracing()
+    obs_trace.reset_spans()
+    fr.directory = None
+
+
+def _tiny_model():
+    from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+
+    paddle.seed(0)
+    cfg = gpt_config("gpt2-small", vocab_size=32, hidden_size=16,
+                     num_layers=1, num_attention_heads=2,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+
+    clear_mesh()
+    init_mesh({"dp": 1})
+    return _tiny_model()
+
+
+def _engine(model, **kw):
+    from paddle_tpu.serving import ContinuousBatchingEngine
+
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("prefill_buckets", [8])
+    kw.setdefault("max_queue", 16)
+    return ContinuousBatchingEngine(model, **kw)
+
+
+def _tiny_trainer(sentinel=None, donate=False):
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+    from paddle_tpu.optimizer.optimizers import AdamW
+
+    paddle.seed(0)
+    clear_mesh()
+    init_mesh({"dp": 1})
+    net = paddle.nn.Linear(4, 4)
+    opt = AdamW(learning_rate=1e-2, parameters=net.parameters())
+    return ParallelTrainer(net, lambda o, y: ((o - y) ** 2).mean(), opt,
+                           dp_axis=None, sentinel=sentinel, donate=donate)
+
+
+# =====================================================================
+# strict Prometheus text-format parser (the acceptance-bar scrape check)
+# =====================================================================
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
+_TYPE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (counter|gauge|histogram|summary|"
+    r"untyped)$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\")*)?)\})?"
+    r" (-?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|\.[0-9]+)|[+-]Inf|NaN)$")
+_LABEL_PAIR_RE = re.compile(
+    r"([a-zA-Z_][a-zA-Z0-9_]*)=\"((?:[^\"\\\n]|\\\\|\\\"|\\n)*)\"")
+
+
+def parse_prometheus_strict(text):
+    """Validate text-format 0.0.4 and return {name: [(labels, value)]}.
+
+    Strictness: every non-comment line must be a grammatical sample, every
+    sample's base name must carry a preceding ``# TYPE``, histogram
+    ``_bucket`` series must be cumulative, end in ``+Inf`` and equal the
+    ``_count`` sample, and the exposition must end with a newline."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    types, samples = {}, {}
+    for line in text.split("\n")[:-1]:
+        assert line.strip() == line and line, f"bad line framing: {line!r}"
+        if line.startswith("# HELP "):
+            assert _HELP_RE.match(line), f"bad HELP: {line!r}"
+            continue
+        if line.startswith("#"):
+            m = _TYPE_RE.match(line)
+            assert m, f"bad comment line: {line!r}"
+            assert m.group(1) not in types, f"duplicate TYPE {m.group(1)}"
+            types[m.group(1)] = m.group(2)
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"bad sample line: {line!r}"
+        name, labelstr, value = m.group(1), m.group(2), m.group(3)
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            stripped = name[:-len(suffix)] if name.endswith(suffix) else None
+            if stripped and types.get(stripped) in ("histogram", "summary"):
+                base = stripped
+        assert base in types, f"sample {name!r} before its # TYPE"
+        labels = tuple(_LABEL_PAIR_RE.findall(labelstr or ""))
+        v = {"+Inf": np.inf, "-Inf": -np.inf, "NaN": np.nan}.get(
+            value, None)
+        v = float(value) if v is None else v
+        samples.setdefault(name, []).append((labels, v))
+    # histogram invariants
+    for name, kind in types.items():
+        if kind != "histogram":
+            continue
+        series = {}
+        for labels, v in samples.get(name + "_bucket", ()):
+            rest = tuple(kv for kv in labels if kv[0] != "le")
+            le = dict(labels)["le"]
+            series.setdefault(rest, []).append((le, v))
+        counts = {tuple(kv for kv in labels): v
+                  for labels, v in samples.get(name + "_count", ())}
+        for rest, buckets in series.items():
+            values = [v for _, v in buckets]
+            assert values == sorted(values), f"{name}: non-cumulative"
+            assert buckets[-1][0] == "+Inf", f"{name}: missing +Inf"
+            assert counts[rest] == buckets[-1][1], f"{name}: count mismatch"
+    return types, samples
+
+
+# =====================================================================
+# span ring + context propagation
+# =====================================================================
+class TestSpans:
+    def test_disabled_records_nothing(self):
+        with obs.span("idle"):
+            pass
+        obs.event("marker")
+        assert obs.snapshot_spans() == []
+
+    def test_ring_bounded_with_drop_count(self):
+        obs.enable_tracing(max_spans=8)
+        for i in range(20):
+            with obs.span(f"s{i}"):
+                pass
+        spans = obs.snapshot_spans()
+        assert len(spans) == 8
+        assert [s.name for s in spans] == [f"s{i}" for i in range(12, 20)]
+        assert obs_trace.span_ring().dropped == 12
+
+    def test_nesting_and_trace_context(self):
+        obs.enable_tracing(max_spans=64)
+        tid = obs.new_trace_id()
+        with obs.trace_context(tid):
+            with obs.span("root") as root:
+                with obs.span("child", k=1) as child:
+                    pass
+        spans = {s.name: s for s in obs.snapshot_spans()}
+        assert spans["root"].trace_id == tid
+        assert spans["child"].trace_id == tid
+        assert spans["child"].parent_id == root.span_id
+        assert spans["child"].span_id == child.span_id
+        assert spans["child"].attrs == {"k": 1}
+        assert spans["root"].dur >= spans["child"].dur >= 0
+
+    def test_zero_footprint_inside_jax_trace(self):
+        """Spans are host-only: a jitted fn using span() records nothing
+        at trace time and lowers to the identical jaxpr."""
+        obs.enable_tracing(max_spans=64)
+
+        def with_span(x):
+            with obs.span("in.trace"):
+                y = x * 2.0
+            obs.event("in.trace.event")
+            return y + 1.0
+
+        ja = jax.make_jaxpr(with_span)(1.0)
+        jb = jax.make_jaxpr(lambda x: x * 2.0 + 1.0)(1.0)
+        assert [e.primitive for e in ja.jaxpr.eqns] == \
+            [e.primitive for e in jb.jaxpr.eqns]
+        assert obs.snapshot_spans() == []
+
+    def test_chrome_trace_export(self):
+        obs.enable_tracing(max_spans=64)
+        with obs.span("outer"):
+            with obs.span("inner"):
+                pass
+        doc = obs.to_chrome_trace(obs.snapshot_spans(),
+                                  process_names={os.getpid(): "me"})
+        events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert [e["name"] for e in events] == ["outer", "inner"]
+        assert all(e["ts"] > 1e15 for e in events)  # epoch micros
+        names = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert any(e["name"] == "process_name" for e in names)
+
+    def test_dump_trace_schema(self, tmp_path):
+        obs.enable_tracing(max_spans=16)
+        with obs.span("a"):
+            pass
+        path = str(tmp_path / "trace.json")
+        doc = obs.dump_trace(path, process="tester")
+        with open(path) as f:
+            ondisk = json.load(f)
+        assert ondisk["schema_version"] == obs_trace.TRACE_SCHEMA_VERSION
+        assert ondisk["process"] == "tester"
+        assert ondisk["spans"] == doc["spans"]
+        assert len(ondisk["spans"]) == 1
+
+
+# =====================================================================
+# metrics registry + strict exposition
+# =====================================================================
+class TestMetricsRegistry:
+    def test_counter_and_gauge(self):
+        r = MetricsRegistry()
+        c = r.counter("reqs_total", "requests", ("code",))
+        c.inc(code="200")
+        c.inc(2, code="500")
+        assert c.value(code="200") == 1
+        assert c.value(code="500") == 2
+        with pytest.raises(ValueError):
+            c.inc(-1, code="200")
+        g = r.gauge("depth", "queue depth")
+        g.set(5)
+        g.inc(2)
+        assert g.value() == 7
+
+    def test_type_conflict_raises(self):
+        r = MetricsRegistry()
+        r.counter("x_total", "")
+        with pytest.raises(ValueError):
+            r.gauge("x_total", "")
+        with pytest.raises(ValueError):
+            r.counter("x_total", "", ("lbl",))
+
+    def test_histogram_percentiles_log_buckets(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat_seconds", "lat", buckets=log_buckets(1e-3, 8.0))
+        for v in [0.002] * 50 + [0.1] * 45 + [4.0] * 5:
+            h.observe(v)
+        assert h.count() == 100
+        assert 0.001 <= h.percentile(50) <= 0.004
+        assert 0.05 <= h.percentile(95) <= 0.21
+        assert h.percentile(99) >= 1.0
+
+    def test_strict_parse_full_registry(self):
+        r = MetricsRegistry()
+        r.counter("a_total", 'with "quotes" and \\slash', ("l",)).inc(
+            l='va"l\\ue')
+        r.gauge("b", "gauge help").set(-1.5)
+        h = r.histogram("c_seconds", "hist", ("op",),
+                        buckets=log_buckets(1e-3, 1.0))
+        h.observe(0.05, op="read")
+        h.observe(2.0, op="read")  # lands in +Inf
+        types, samples = parse_prometheus_strict(r.prometheus_text())
+        assert types == {"a_total": "counter", "b": "gauge",
+                         "c_seconds": "histogram"}
+        assert samples["b"] == [((), -1.5)]
+        (labels, v), = samples["a_total"]
+        assert v == 1 and labels[0][0] == "l"
+        count, = samples["c_seconds_count"]
+        assert count[1] == 2
+
+    def test_http_exporter_negotiation(self):
+        import http.client
+
+        r = MetricsRegistry()
+        r.counter("hits_total", "hits").inc(3)
+        srv = obs.start_http_exporter(r)
+        try:
+            host, port = srv.addr.rsplit(":", 1)
+            c = http.client.HTTPConnection(host, int(port), timeout=5)
+            c.request("GET", "/metrics")  # exporter default: prometheus
+            resp = c.getresponse()
+            body = resp.read().decode()
+            assert "text/plain" in resp.getheader("Content-Type")
+            parse_prometheus_strict(body)
+            assert "hits_total 3" in body
+            c.request("GET", "/metrics",
+                      headers={"Accept": "application/json"})
+            resp = c.getresponse()
+            doc = json.loads(resp.read())
+            assert doc["hits_total"]["values"] == 3
+            c.close()
+        finally:
+            srv.stop()
+
+
+# =====================================================================
+# serving /metrics: Accept negotiation, JSON byte-compatibility
+# =====================================================================
+class TestServingMetricsEndpoint:
+    def _scrape(self, addr, accept=None):
+        import http.client
+
+        host, port = addr.rsplit(":", 1)
+        c = http.client.HTTPConnection(host, int(port), timeout=10)
+        headers = {"Accept": accept} if accept else {}
+        c.request("GET", "/metrics", headers=headers)
+        r = c.getresponse()
+        body = r.read()
+        ctype = r.getheader("Content-Type")
+        c.close()
+        return ctype, body
+
+    def test_json_default_stays_byte_compatible(self, model):
+        from paddle_tpu.serving import ServingServer
+
+        srv = ServingServer(_engine(model)).start()
+        try:
+            ctype, body = self._scrape(srv.addr)
+            assert ctype == "application/json"
+            snap = json.loads(body)
+            # the r8/r11 consumer contract: these keys feed ServingClient
+            # and the router's routing/drain decisions
+            for key in ("requests", "tokens_generated", "queue_depth",
+                        "in_admission", "slot_occupancy", "draining",
+                        "compile_cache", "ttft_seconds"):
+                assert key in snap, key
+            # an explicit JSON Accept gets the same body
+            _, body2 = self._scrape(srv.addr, accept="application/json")
+            assert json.loads(body2).keys() == snap.keys()
+        finally:
+            srv.stop()
+
+    def test_live_scrape_parses_strict(self, model):
+        """Acceptance: Prometheus scrape of a LIVE serving server (mid-
+        traffic) parses under the strict parser with live gauges."""
+        from paddle_tpu.serving import ServingClient, ServingServer
+
+        srv = ServingServer(_engine(model)).start()
+        try:
+            client = ServingClient(srv.addr)
+            rid = client.submit([1, 2, 3], max_new_tokens=4)
+            client.wait(rid, timeout=60)
+            ctype, body = self._scrape(srv.addr, accept="text/plain")
+            assert "text/plain" in ctype and "0.0.4" in ctype
+            types, samples = parse_prometheus_strict(body.decode())
+            assert types["serving_requests_submitted_total"] == "counter"
+            assert types["serving_ttft_seconds"] == "histogram"
+            assert samples["serving_requests_submitted_total"][0][1] == 1
+            assert samples["serving_tokens_generated_total"][0][1] == 4
+            assert samples["serving_slots_total"][0][1] == 2
+            # TTFT histogram observed exactly one request
+            assert samples["serving_ttft_seconds_count"][0][1] == 1
+        finally:
+            srv.stop()
+
+    def test_router_endpoint_negotiates(self, model):
+        import http.client
+
+        from paddle_tpu.serving import ServingRouter, ServingServer
+
+        srv = ServingServer(_engine(model)).start()
+        router = ServingRouter([srv.addr], health_interval_s=0.1).start()
+        try:
+            router.check_health()
+            addr = router.serve_metrics()
+            host, port = addr.rsplit(":", 1)
+            c = http.client.HTTPConnection(host, int(port), timeout=5)
+            c.request("GET", "/metrics")
+            snap = json.loads(c.getresponse().read())
+            assert set(snap) == {"replicas", "failovers", "resubmits",
+                                 "inflight_failures"}
+            c.request("GET", "/metrics", headers={"Accept": "text/plain"})
+            types, samples = parse_prometheus_strict(
+                c.getresponse().read().decode())
+            assert types["router_breaker_state"] == "gauge"
+            assert types["router_failovers_total"] == "counter"
+            (labels, v), = samples["router_replica_up"]
+            assert dict(labels)["replica"] == srv.addr and v == 1
+            c.close()
+        finally:
+            router.stop()
+            srv.stop()
+
+
+# =====================================================================
+# e2e trace propagation + merge CLI (acceptance)
+# =====================================================================
+class TestEndToEndTrace:
+    def test_single_trace_id_with_well_formed_span_tree(self, model,
+                                                        tmp_path):
+        from paddle_tpu.serving import ServingRouter, ServingServer
+
+        obs.enable_tracing(max_spans=4096)
+        servers = [ServingServer(_engine(model)).start() for _ in range(2)]
+        router = ServingRouter([s.addr for s in servers],
+                               health_interval_s=0.1).start()
+        try:
+            router.check_health()
+            rr = router.submit([1, 2, 3, 4], max_new_tokens=5)
+            out = router.wait(rr, timeout=60)
+            assert out["status"] == "done"
+            assert rr.trace_id is not None
+            mine = [s for s in obs.snapshot_spans()
+                    if s.trace_id == rr.trace_id]
+            by_name = {}
+            for s in mine:
+                by_name.setdefault(s.name, []).append(s)
+            # ONE trace id stitches router + replica work
+            assert set(by_name) == {"serving.route", "serving.queue_wait",
+                                    "serving.prefill",
+                                    "serving.decode_token"}
+            route, = by_name["serving.route"]
+            queue, = by_name["serving.queue_wait"]
+            prefill, = by_name["serving.prefill"]
+            decodes = by_name["serving.decode_token"]
+            # tree: route ⊃ queue ⊃ prefill ⊃ decode tokens
+            assert queue.parent_id == route.span_id
+            assert prefill.parent_id == queue.span_id
+            assert all(d.parent_id == prefill.span_id for d in decodes)
+            # prefill samples token 0 in-graph; decode emits the rest
+            assert len(decodes) == len(out["tokens"]) - 1
+            assert sorted(d.attrs["token_index"] for d in decodes) == \
+                list(range(1, len(out["tokens"])))
+            assert prefill.attrs["bucket"] == 8
+            assert route.attrs["replica"] == rr.replica_addr
+
+            # merge CLI: split the ring into two per-"process" dumps (the
+            # in-process harness shares one ring; a real deployment dumps
+            # per process) and stitch them back into ONE timeline
+            router_doc = obs.dump_trace(process="router")
+            router_doc["spans"] = [s.to_dict() for s in mine
+                                   if s.name == "serving.route"]
+            replica_doc = {
+                "schema_version": 1, "process": "replica", "pid":
+                    os.getpid() + 1,
+                "spans": [dict(s.to_dict(), pid=os.getpid() + 1)
+                          for s in mine if s.name != "serving.route"],
+            }
+            pa, pb = tmp_path / "router.json", tmp_path / "replica.json"
+            pa.write_text(json.dumps(router_doc))
+            pb.write_text(json.dumps(replica_doc))
+            out_path = tmp_path / "merged.json"
+            res = subprocess.run(
+                [sys.executable, "-m", "paddle_tpu.observability", "merge",
+                 "-o", str(out_path), "--trace-id", rr.trace_id,
+                 str(pa), str(pb)],
+                capture_output=True, text=True,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=120)
+            assert res.returncode == 0, res.stderr
+            merged = json.loads(out_path.read_text())
+            events = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+            assert merged["metadata"]["n_spans"] == len(events) == len(mine)
+            assert {e["pid"] for e in events} == {os.getpid(),
+                                                 os.getpid() + 1}
+            # one timeline: sorted by wall-clock ts across processes
+            ts = [e["ts"] for e in events]
+            assert ts == sorted(ts)
+            assert all(e["args"]["trace_id"] == rr.trace_id
+                       for e in events)
+        finally:
+            router.stop()
+            for s in servers:
+                s.kill()
+
+    def test_direct_submit_mints_trace_locally(self, model):
+        """Engine-only runs (no router) still get span trees: the Request
+        mints its own id when tracing is armed."""
+        from paddle_tpu.serving import Request
+
+        obs.enable_tracing(max_spans=1024)
+        eng = _engine(model)
+        req = eng.submit(Request([1, 2, 3], max_new_tokens=3))
+        assert req.trace_id is not None
+        eng.run_until_idle(timeout=60)
+        mine = [s for s in obs.snapshot_spans()
+                if s.trace_id == req.trace_id]
+        assert {"serving.queue_wait", "serving.prefill",
+                "serving.decode_token"} <= {s.name for s in mine}
+
+
+# =====================================================================
+# flight recorder (acceptance: dumps name the final step + last spans)
+# =====================================================================
+class TestFlightRecorder:
+    def test_dump_schema_and_file(self, tmp_path):
+        obs.enable_tracing(max_spans=32)
+        with obs.span("work.unit"):
+            pass
+        fr = obs_flight.FlightRecorder(directory=str(tmp_path),
+                                       process="tester")
+        fr.note(step=11, phase="train")
+        doc = fr.dump("unit_test", extra={"k": "v"})
+        assert doc["schema_version"] == obs.FLIGHT_SCHEMA_VERSION
+        assert doc["step"] == 11
+        assert doc["extra"] == {"k": "v"}
+        assert any(s["name"] == "work.unit" for s in doc["spans"])
+        assert fr.last_path and os.path.exists(fr.last_path)
+        with open(fr.last_path) as f:
+            assert json.load(f)["reason"] == "unit_test"
+
+    def test_planted_sentinel_halt_dumps_last_spans_and_step(self):
+        obs.enable_tracing(max_spans=256)
+        tr = _tiny_trainer(SentinelConfig(warmup_steps=2, policy="halt",
+                                          min_spike_delta=0.1))
+        rng = np.random.default_rng(3)
+        x = paddle.to_tensor(rng.standard_normal((8, 4)).astype("float32"))
+        y = paddle.to_tensor(rng.standard_normal((8, 4)).astype("float32"))
+        monitor = SentinelMonitor(tr._sentinel)
+        for _ in range(3):
+            tr.step(x, y)
+            monitor.after_step(tr)
+        xnan = paddle.to_tensor(np.full((8, 4), np.nan, "float32"))
+        tr.step(xnan, y)  # the planted halt: step index 3
+        with pytest.raises(AnomalyHalt):
+            monitor.after_step(tr)
+        doc = obs_flight.flight_recorder().last
+        assert doc is not None and doc["reason"] == "sentinel_halt"
+        assert doc["schema_version"] == obs.FLIGHT_SCHEMA_VERSION
+        # the offending step is named...
+        assert doc["step"] == 3
+        assert doc["extra"]["last_code"] == 1  # SENTINEL_NONFINITE
+        # ...and the last N spans (every train.step incl. the fatal one)
+        steps = [s for s in doc["spans"] if s["name"] == "train.step"]
+        assert [s["attrs"]["step"] for s in steps] == [0, 1, 2, 3]
+
+    def test_engine_tick_failure_dumps(self, model, monkeypatch):
+        from paddle_tpu.serving import Request
+
+        obs.enable_tracing(max_spans=128)
+        eng = _engine(model)
+        req = eng.submit(Request([1, 2, 3], max_new_tokens=4))
+
+        def boom():
+            raise RuntimeError("planted tick fault")
+
+        monkeypatch.setattr(eng, "step_once", boom)
+        stop = threading.Event()
+        t = threading.Thread(target=eng.serve_forever, args=(stop,),
+                             daemon=True)
+        t.start()
+        assert req.wait(timeout=10)
+        stop.set()
+        t.join(10)
+        assert req.state == Request.FAILED
+        doc = obs_flight.flight_recorder().last
+        assert doc is not None and doc["reason"] == "engine_tick_failure"
+        assert "planted tick fault" in doc["extra"]["error"]
+        # the dump freezes THIS engine's serving series, not just the
+        # process registry
+        serving_sections = [m for name, m in doc["metrics"].items()
+                            if name.startswith("serving-")
+                            and "serving_requests_submitted_total" in m]
+        assert any(m["serving_requests_submitted_total"]["values"] == 1
+                   for m in serving_sections)
+
+    def test_sigterm_leaves_dump_naming_final_step(self, tmp_path):
+        """Acceptance: a SIGTERM'd training run leaves a readable flight
+        dump naming its final step (lands next to the checkpoints when no
+        flight directory is configured)."""
+        from paddle_tpu.framework.checkpoint import CheckpointManager
+        from paddle_tpu.resilience import PreemptionGuard
+
+        obs.enable_tracing(max_spans=64)
+        with obs.span("train.step", step=7):
+            pass
+        mgr = CheckpointManager(str(tmp_path))
+        guard = PreemptionGuard(mgr, exit_code=None,
+                                signals=(signal.SIGTERM,))
+        guard.install()
+        try:
+            guard.update(7, {"w": np.zeros(2)})
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert guard.preempted and guard.saved_step == 7
+        finally:
+            guard.uninstall()
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flight_preemption_signal_")]
+        assert len(dumps) == 1
+        with open(tmp_path / dumps[0]) as f:
+            doc = json.load(f)
+        assert doc["schema_version"] == obs.FLIGHT_SCHEMA_VERSION
+        assert doc["step"] == 7                      # the final step
+        assert doc["extra"]["saved_step"] == 7       # and it was saved
+        assert any(s["name"] == "train.step" and s["attrs"]["step"] == 7
+                   for s in doc["spans"])
+
+    def test_replica_death_dumps_once(self, model):
+        from paddle_tpu.serving import Request, ServingRouter, ServingServer
+
+        engines = [_engine(model, max_seq_len=64) for _ in range(2)]
+        # throttle decode so the generation is still in flight at the kill
+        for eng in engines:
+            orig = eng.step_once
+            eng.step_once = (lambda o=orig: (time.sleep(0.05), o())[1])
+        servers = [ServingServer(e).start() for e in engines]
+        # slow health loop: the DEATH CONFIRMATION must come from the
+        # request path (poll → transport error → probe), the hook's trigger
+        router = ServingRouter([s.addr for s in servers],
+                               health_interval_s=5.0,
+                               request_timeout=2.0).start()
+        try:
+            router.check_health()
+            # a long generation keeps the request IN FLIGHT when the
+            # replica dies — polls then observe the death first-hand
+            rr = router.submit([1, 2, 3], max_new_tokens=60)
+            deadline = time.monotonic() + 30
+            while not rr.tokens and time.monotonic() < deadline:
+                router.poll(rr)
+                time.sleep(0.01)
+            assert rr.tokens, "generation never started"
+            victim = rr.replica_addr
+            next(s for s in servers if s.addr == victim).kill()
+            fr = obs_flight.flight_recorder()
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and not rr.done:
+                router.poll(rr)
+                time.sleep(0.02)
+            # in-flight request with observed tokens ⇒ surfaced FAILED
+            assert rr.state == Request.FAILED
+            assert fr.last is not None
+            assert fr.last["reason"] == "replica_death"
+            assert fr.last["extra"]["replica"] == victim
+            # the router's breaker/failover series are in the dump
+            assert any(name.startswith("router-")
+                       and "router_breaker_state" in m
+                       for name, m in fr.last["metrics"].items())
+            seq_after_first = fr.last
+            # a second affected observation must NOT dump again
+            try:
+                router.poll(rr)
+            except Exception:
+                pass
+            assert obs_flight.flight_recorder().last is seq_after_first
+        finally:
+            router.stop()
+            for s in servers:
+                s.kill()
+
+
+# =====================================================================
+# live MFU + HBM-drift gauges on a real trainer step (acceptance)
+# =====================================================================
+class TestTrainerGauges:
+    def test_mfu_and_hbm_gauges_populate(self):
+        reg = MetricsRegistry()
+        tr = _tiny_trainer(donate=False)
+        tel = obs.TrainerTelemetry(tr, registry=reg, name="t0")
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((8, 4)).astype("float32"))
+        y = paddle.to_tensor(rng.standard_normal((8, 4)).astype("float32"))
+        tel.prime(x, y)
+        assert tel.flops_per_step and tel.flops_per_step > 0
+        assert tel.predicted_peak_bytes and tel.predicted_peak_bytes > 0
+        for _ in range(3):
+            tel.step(x, y)
+        census = tel.refresh_hbm()
+        rep = tel.report()
+        assert rep["steps"] == 3
+        # first gap is compile+dispatch and skipped — 2 observations
+        assert reg.get("train_step_seconds").count(trainer="t0") == 2
+        assert rep["mfu"] is not None and rep["mfu"] > 0
+        assert rep["hbm_live_bytes"] and rep["hbm_live_bytes"] > 0
+        assert np.isfinite(rep["hbm_drift_frac"])
+        assert census["live_bytes"] > 0
+        # the series are scrapeable
+        types, samples = parse_prometheus_strict(reg.prometheus_text())
+        assert types["train_mfu"] == "gauge"
+        assert types["train_hbm_predicted_peak_bytes"] == "gauge"
+        mfu, = samples["train_mfu"]
+        assert dict(mfu[0])["trainer"] == "t0" and mfu[1] > 0
+
+    def test_observe_step_direct(self):
+        reg = MetricsRegistry()
+        tr = _tiny_trainer(donate=False)
+        tel = obs.TrainerTelemetry(tr, registry=reg, peak_flops=1e12,
+                                   name="t1")
+        tel.flops_per_step = 2e9
+        tel.observe_step(0.01)  # 2e9 / (0.01 * 1e12) = 0.2
+        assert reg.get("train_mfu").value(trainer="t1") == \
+            pytest.approx(0.2)
+
+
+# =====================================================================
+# jaxpr identity: tracing enabled vs disabled (r6 bar, extended)
+# =====================================================================
+class TestTracingJaxprIdentity:
+    def test_trainer_step_jaxpr_identical(self):
+        def jaxpr_of():
+            tr = _tiny_trainer(donate=False)
+            tr._build()
+            xb = jnp.zeros((8, 4), jnp.float32)
+            key = jax.random.key(0)
+            lr = jnp.asarray(0.01, jnp.float32)
+            return str(jax.make_jaxpr(tr._jit_step)(
+                tr.params, tr.opt_state, tr.buffers, xb, xb, key,
+                tr.scale_state, tr.sentinel_state, lr))
+
+        obs.disable_tracing()
+        plain = jaxpr_of()
+        obs.enable_tracing()
+        traced = jaxpr_of()
+        assert plain == traced
+
+    def test_pipeline_step_jaxpr_identical(self):
+        from paddle_tpu.distributed.env import clear_mesh, init_mesh
+        from paddle_tpu.distributed.meta_parallel.pipeline_schedule import (
+            build_gpt_pipeline_step,
+        )
+        from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+        from paddle_tpu.optimizer.optimizers import AdamW
+
+        def jaxpr_of():
+            cfg = gpt_config("gpt2-small", vocab_size=64, hidden_size=32,
+                             num_layers=2, num_attention_heads=4,
+                             max_position_embeddings=32,
+                             hidden_dropout_prob=0.0,
+                             attention_dropout_prob=0.0)
+            paddle.seed(0)
+            clear_mesh()
+            init_mesh({"pp": 1})
+            model = GPTForPretraining(cfg)
+            opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
+            s = build_gpt_pipeline_step(model, opt, microbatches=2)
+            rng = np.random.default_rng(0)
+            ids = jnp.asarray(rng.integers(0, 64, (4, 16)).astype("int32"))
+            kd = jax.random.key_data(jax.random.key(0))
+            lr = jnp.asarray(1e-3, jnp.float32)
+            return str(jax.make_jaxpr(s.jitted)(
+                s.state["params"], s.state["opt"], ids, ids, kd, lr,
+                s.state["sentinel"]))
+
+        obs.disable_tracing()
+        plain = jaxpr_of()
+        obs.enable_tracing()
+        traced = jaxpr_of()
+        assert plain == traced
+
+    def test_scope_with_tracing_enabled_keeps_jaxpr(self):
+        """The r6 scope/TimerRegistry fix: profiler scopes inside a jit
+        trace stay pure HLO metadata even with tracing + timers armed."""
+        from paddle_tpu import profiler
+
+        obs.enable_tracing()
+        profiler.enable_timers()
+        try:
+            def with_scopes(x):
+                with profiler.scope("a"):
+                    return x * 2.0
+
+            ja = jax.make_jaxpr(with_scopes)(1.0)
+            jb = jax.make_jaxpr(lambda x: x * 2.0)(1.0)
+            assert [e.primitive for e in ja.jaxpr.eqns] == \
+                [e.primitive for e in jb.jaxpr.eqns]
+            # and no host span leaked out of the trace
+            assert obs.snapshot_spans() == []
+        finally:
+            profiler.disable_timers()
+
+    def test_scope_emits_spans_outside_trace(self):
+        from paddle_tpu import profiler
+
+        obs.enable_tracing(max_spans=16)
+        with profiler.scope("host.region"):
+            time.sleep(0.001)
+        spans = obs.snapshot_spans()
+        assert [s.name for s in spans] == ["host.region"]
+        assert spans[0].dur >= 0.001

@@ -780,6 +780,7 @@ def _parse_steps(text):
     return out
 
 
+@pytest.mark.chaos
 def test_kill_and_resume_bit_identical(tmp_path):
     script = tmp_path / "train.py"
     script.write_text(_E2E_SCRIPT)

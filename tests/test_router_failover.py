@@ -505,6 +505,7 @@ _REPLICA_SCRIPT = textwrap.dedent("""
 
 
 @pytest.mark.slow
+@pytest.mark.chaos
 def test_replica_process_sigkill_mid_stream(tmp_path):
     """Kill 1 of 2 engine replica PROCESSES mid-stream: zero queued
     requests dropped (they complete on the survivor) and the recovery
