@@ -136,39 +136,80 @@ class _TcpStore:
         self._values = {}
 
     def _retrying(self, name: str, fn, ok=lambda r: True):
+        from ....resilience.inject import InjectedFault, fire as _inject_fire
         from ....resilience.retry import RetryError, call_with_retries
+
+        # per-ATTEMPT injection seam (`elastic.store.rpc.<op>`): a raised
+        # fault here engages the real backoff/retry path — an `every=1`
+        # persistent fault burns retries exactly like a dead store, which
+        # is what the shared RetryBudget exists to cap. The public-method
+        # seams (`elastic.store.<op>`) stay message-level (drop/duplicate)
+        def attempt():
+            _inject_fire(f"elastic.store.rpc.{name}", store=self.scope)
+            return fn()
 
         try:
             return call_with_retries(
-                fn, retries=self.retries, base=0.05,
+                attempt, retries=self.retries, base=0.05,
                 max_delay=max(min(self.ttl / 8, 1.0), 0.05),
                 # ValueError: a scan response truncated mid-flight parses as
-                # malformed JSON — transient, same treatment as a dead socket
-                retry_on=(OSError, ValueError), ok=ok)
+                # malformed JSON — transient, same treatment as a dead
+                # socket. InjectedFault: faults at this seam model
+                # transport failures WHATEVER class the schedule raises,
+                # so they retry and surface as StoreUnavailable like the
+                # real thing (the message-level seam is the bypass)
+                retry_on=(OSError, ValueError, InjectedFault), ok=ok)
         except RetryError as e:
             raise StoreUnavailable(
                 f"elastic store {self.client.addr} unreachable "
                 f"({name}, {self.retries + 1} attempts)") from e
 
+    @staticmethod
+    def _message_op(point: str, call, *, absent=None, **labels):
+        """MESSAGE-level injection seam shared by every public store op:
+        raise/delay/timeout are handled inside fire(); a ``drop`` fault
+        loses the whole logical RPC (returns ``absent`` without calling),
+        a ``duplicate`` fault performs it twice. No schedule armed ⇒ one
+        None check."""
+        from ....resilience.inject import fire
+
+        f = fire(point, **labels)
+        if f is not None and f.kind == "drop":
+            return absent
+        out = call()
+        if f is not None and f.kind == "duplicate":
+            out = call()
+        return out
+
     def register(self, node_id: str, value: str):
         self._values[node_id] = value
-        self._retrying(
-            "register",
-            lambda: self.client.put(self.scope, node_id, value, strict=True),
-            ok=bool)
+        self._message_op(
+            "elastic.store.register",
+            lambda: self._retrying(
+                "register",
+                lambda: self.client.put(self.scope, node_id, value,
+                                        strict=True), ok=bool),
+            node=node_id)
 
     def heartbeat(self, node_id: str):
         val = self._values.get(node_id, "")
-        self._retrying(
-            "heartbeat",
-            lambda: self.client.put(self.scope, node_id, val, strict=True),
-            ok=bool)
+        self._message_op(
+            "elastic.store.heartbeat",
+            lambda: self._retrying(
+                "heartbeat",
+                lambda: self.client.put(self.scope, node_id, val,
+                                        strict=True), ok=bool),
+            node=node_id)
 
     def deregister(self, node_id: str):
-        self._retrying(
-            "deregister",
-            lambda: self.client.delete(self.scope, node_id, strict=True),
-            ok=bool)
+        # a dropped deregister just means the node expires by TTL
+        self._message_op(
+            "elastic.store.deregister",
+            lambda: self._retrying(
+                "deregister",
+                lambda: self.client.delete(self.scope, node_id,
+                                           strict=True), ok=bool),
+            node=node_id)
 
     def _alive(self):
         """One snapshot: {node_id: endpoint} for live nodes (a second scan
@@ -190,28 +231,43 @@ class _TcpStore:
     # accessors get the identical backoff/StoreUnavailable policy as the
     # membership operations above.
     def put(self, key: str, value: str):
-        self._retrying(
-            "put", lambda: self.client.put(self.kv_scope, key, value,
-                                           strict=True), ok=bool)
+        self._message_op(
+            "elastic.store.kv.put",
+            lambda: self._retrying(
+                "put", lambda: self.client.put(self.kv_scope, key, value,
+                                               strict=True), ok=bool),
+            key=key)
 
     def get(self, key: str) -> Optional[str]:
-        # absence is a legitimate answer (None), not a transport failure
-        return self._retrying(
-            "get", lambda: self.client.get(self.kv_scope, key, strict=True))
+        # absence is a legitimate answer (None), not a transport failure;
+        # a dropped response reads as absence too
+        return self._message_op(
+            "elastic.store.kv.get",
+            lambda: self._retrying(
+                "get", lambda: self.client.get(self.kv_scope, key,
+                                               strict=True)),
+            key=key)
 
     def delete(self, key: str):
-        self._retrying(
-            "delete", lambda: self.client.delete(self.kv_scope, key,
-                                                 strict=True), ok=bool)
+        self._message_op(
+            "elastic.store.kv.delete",
+            lambda: self._retrying(
+                "delete", lambda: self.client.delete(self.kv_scope, key,
+                                                     strict=True), ok=bool),
+            key=key)
 
     def scan(self, keys_only: bool = False, prefix: str = None):
         """{key: (value, age_seconds)} snapshot of the KV plane.
         ``keys_only`` ships (None, age) pairs — presence without payload;
-        ``prefix`` filters server-side (both: see KVClient.scan)."""
-        return self._retrying(
-            "scan_kv", lambda: self.client.scan(
-                self.kv_scope, strict=True, keys_only=keys_only,
-                prefix=prefix))
+        ``prefix`` filters server-side (both: see KVClient.scan). A
+        dropped response reads as an empty plane."""
+        return self._message_op(
+            "elastic.store.kv.scan",
+            lambda: self._retrying(
+                "scan_kv", lambda: self.client.scan(
+                    self.kv_scope, strict=True, keys_only=keys_only,
+                    prefix=prefix)),
+            absent={}, prefix=prefix)
 
 
 class ElasticManager:
@@ -308,6 +364,14 @@ class ElasticManager:
                         f"elastic store unreachable for over ttl="
                         f"{self.store.ttl}s; degrading to single-node "
                         "operation (training continues)", RuntimeWarning)
+
+    def halt_heartbeat(self):
+        """Stop beating WITHOUT deregistering — the deterministic stand-in
+        for a SIGKILLed process: peers see this node's stamps go stale and
+        expire it by TTL, exactly the liveness path a real abrupt death
+        exercises (``exit()`` is the graceful path; this is the chaos
+        plane's)."""
+        self._stop.set()
 
     def exit(self):
         self._stop.set()

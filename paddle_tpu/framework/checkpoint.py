@@ -50,6 +50,14 @@ _META = "meta.json"
 _ARRAYS = "arrays.npz"
 _PYTREE = "pytree.json"
 
+
+def _inject_fire(point: str, **labels):
+    """resilience/inject.py hook (lazy import: framework must not pull the
+    resilience package in at module-import time)."""
+    from ..resilience.inject import fire
+
+    return fire(point, **labels)
+
 # async-writer managers alive in this process: one interpreter-exit hook
 # joins them all so a daemon writer thread is never killed mid-write
 _LIVE_MANAGERS: "weakref.WeakSet[CheckpointManager]" = weakref.WeakSet()
@@ -409,15 +417,34 @@ class CheckpointManager:
             _durable(_PYTREE, tree_blob, "w")
             _durable(_META, meta_blob, "w")
             self._fsync_dir(tmp)
+            # injection seam (resilience/inject.py): the checkpoint
+            # writer's two classic torn states, made deterministic —
+            # crash_after_temp dies here (temp durable, never published;
+            # a REAL crash runs no cleanup, so the temp dir stays for the
+            # stale sweep), torn truncates the published arrays so the
+            # CRC fallback path replays without killing a process
+            fault = _inject_fire("checkpoint.write", step=int(step))
+            if fault is not None and fault.kind == "crash_after_temp":
+                raise fault.build_exception()
             if os.path.exists(final):
                 shutil.rmtree(final)
             os.rename(tmp, final)  # atomic publish
             # make the rename itself durable: the parent dir entry must hit
             # disk before save() reports success (preemption follows fast)
             self._fsync_dir(self.directory)
-        except BaseException:
-            shutil.rmtree(tmp, ignore_errors=True)
+        except BaseException as e:
+            from ..resilience.inject import InjectedCrash
+
+            # a simulated crash must leave the temp dir exactly as a real
+            # one would — cleanup code does not run in a dead process
+            if not isinstance(e, InjectedCrash):
+                shutil.rmtree(tmp, ignore_errors=True)
             raise
+        if fault is not None and fault.kind == "torn":
+            arr_path = os.path.join(final, _ARRAYS)
+            size = os.path.getsize(arr_path)
+            with open(arr_path, "r+b") as f:
+                f.truncate(max(size // 2, 1))
         self._prune()
 
     @staticmethod

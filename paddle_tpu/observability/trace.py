@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "TRACE_HEADER",
     "PARENT_HEADER",
+    "DEADLINE_HEADER",
     "TRACE_SCHEMA_VERSION",
     "Span",
     "SpanRing",
@@ -65,6 +66,11 @@ __all__ = [
 #: HTTP headers carrying the trace context between router and replicas
 TRACE_HEADER = "X-Trace-Id"
 PARENT_HEADER = "X-Parent-Span"
+#: the client deadline rides the same header family as the trace context:
+#: REMAINING seconds at send time (relative — immune to clock skew), so
+#: each hop re-derives its local absolute deadline and re-stamps the
+#: remainder when it forwards
+DEADLINE_HEADER = "X-Deadline-S"
 
 #: version of the trace-dump JSON layout (``dump_trace`` / flight spans)
 TRACE_SCHEMA_VERSION = 1

@@ -8,15 +8,34 @@ Three layers, wired through the training stack:
 * :mod:`.preemption` — SIGTERM/SIGINT + deadline-watchdog emergency
   synchronous checkpointing (step counter, RNG, scaler, optimizer state).
 * :mod:`.retry` — exponential backoff with jitter, used by the elastic
-  store so one transient failure never kills the heartbeat.
+  store so one transient failure never kills the heartbeat; plus the
+  shared :class:`RetryBudget` (persistent faults fail fast process-wide).
+* :mod:`.inject` — the deterministic fault-injection plane: seeded
+  :class:`FaultSchedule`\\ s firing named faults at exact trigger counts
+  through the store/checkpoint/engine/router/replica/rank seams, so every
+  chaos scenario replays bit-identically without process signals.
 
 Parity: FLAGS_check_nan_inf, incubate.checkpoint.auto_checkpoint and the
 fleet elastic etcd heartbeats, redesigned as a TPU-native runtime (see
 PARITY.md "Fault tolerance").
 """
 from .elastic_trainer import ElasticDPTrainer  # noqa: F401
+from .inject import (  # noqa: F401
+    FaultSchedule,
+    FaultSpec,
+    InjectedCrash,
+    InjectedDeath,
+    InjectedFault,
+)
 from .preemption import DEADLINE_ENV, PreemptionGuard, capture_train_state  # noqa: F401
-from .retry import RetryError, backoff_delays, call_with_retries  # noqa: F401
+from .retry import (  # noqa: F401
+    RetryBudget,
+    RetryError,
+    backoff_delays,
+    call_with_retries,
+    default_budget,
+    set_default_budget,
+)
 from .sentinel import (  # noqa: F401
     SENTINEL_NONFINITE,
     SENTINEL_OK,
@@ -35,5 +54,8 @@ __all__ = [
     "sentinel_init_state", "sentinel_observe", "sentinel_to_host",
     "PreemptionGuard", "capture_train_state", "DEADLINE_ENV",
     "RetryError", "backoff_delays", "call_with_retries",
+    "RetryBudget", "set_default_budget", "default_budget",
+    "FaultSchedule", "FaultSpec",
+    "InjectedFault", "InjectedDeath", "InjectedCrash",
     "ElasticDPTrainer",
 ]

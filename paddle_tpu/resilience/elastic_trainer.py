@@ -266,6 +266,17 @@ class ElasticDPTrainer:
     # -- one step --------------------------------------------------------
     def _train_one_step(self) -> float:
         s, world, rank = self.step, self.world, self.rank
+        # injection seam: a scheduled `kill` is this rank's deterministic
+        # SIGKILL — heartbeats halt FIRST (peers must see TTL expiry, not
+        # a goodbye) and InjectedDeath unwinds the rank exactly where a
+        # real kill would: before this step's gradients ever publish
+        from .inject import fire as _inject_fire
+
+        f = _inject_fire("elastic.rank.step", rank=rank, step=s,
+                         node=self._node)
+        if f is not None and f.kind == "kill":
+            self.manager.halt_heartbeat()
+            raise f.build_exception()
         fr = flight_recorder()
         if fr.armed or obstrace.tracing_enabled():
             fr.note(step=s)

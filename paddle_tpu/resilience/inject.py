@@ -1,0 +1,348 @@
+"""Deterministic fault-injection plane: seeded, schedule-driven chaos.
+
+Parity: the reference proves Fleet's elastic fault tolerance with real
+process kills (etcd lease expiry after a SIGKILL'd trainer); our r7/r11
+suites did the same with SIGTERM/SIGKILL — realistic, but *flaky under
+concurrent load* (a slow CI box shifts where the signal lands) and
+impossible to replay. This module makes failure a first-class, replayable
+input instead of an accident of timing:
+
+* **Named injection points** are threaded through the existing failure
+  seams — the elastic ``_TcpStore`` register/heartbeat/KV RPCs, the
+  checkpoint writer, the serving engine tick, the router transport, the
+  replica loop, the elastic rank step, the preemption guard. Each seam
+  calls :func:`fire` with a point name plus context labels; with no
+  schedule armed the call is one ``None`` check (zero-cost in production).
+* A :class:`FaultSchedule` holds :class:`FaultSpec` entries that fire at
+  deterministic **trigger counts** (the Nth matching invocation of a
+  point), so the same schedule over the same workload produces the same
+  fault sequence bit-for-bit — no signals, no sleeps, no races. The
+  ``seed`` stamps the schedule and drives :meth:`FaultSchedule.randomize`
+  so even "random" chaos replays identically.
+* Every fault that fires is appended to :attr:`FaultSchedule.fired` — two
+  runs are replays of each other iff their fired logs match, which is the
+  acceptance check the deterministic chaos tests assert.
+
+Fault kinds and who interprets them:
+
+====================  =====================================================
+kind                  semantics (seam in parentheses)
+====================  =====================================================
+``raise``             :func:`fire` raises ``spec.exception`` (any seam)
+``delay``/``stall``   :func:`fire` sleeps ``spec.seconds`` then proceeds
+``timeout``           :func:`fire` raises ``socket.timeout`` (transport)
+``drop``              the RPC is silently skipped (store register/
+                      heartbeat/put) or answers "absent" (get/scan)
+``duplicate``         the RPC is performed twice (store put/register)
+``garbage``           the HTTP response body is replaced with non-JSON
+                      bytes (router transport)
+``torn``              the published checkpoint's array file is truncated
+                      (checkpoint write)
+``crash_after_temp``  the writer dies after the temp files are durable but
+                      before the atomic rename — the temp dir is LEFT on
+                      disk like a real crash (checkpoint write)
+``kill``              abrupt death: replica ``kill()`` (serving loop),
+                      heartbeat halt + :class:`InjectedDeath` (elastic
+                      rank), emergency-save + :class:`InjectedDeath`
+                      (preemption guard)
+====================  =====================================================
+
+Arming: :meth:`FaultSchedule.arm`/:meth:`disarm` install globally;
+:meth:`FaultSchedule.scope` installs thread-locally (rank threads in one
+process each carry their own schedule — the in-process elastic chaos
+tests). Thread-local wins over global.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "FaultSpec",
+    "FaultSchedule",
+    "InjectedFault",
+    "InjectedDeath",
+    "InjectedCrash",
+    "fire",
+    "active_schedule",
+    "POINTS",
+]
+
+# the documented injection points (instrumented seams); fire() accepts any
+# name so new seams don't need a registry edit, but tests and schedules
+# should prefer these. The `elastic.store.<op>` family is MESSAGE-level
+# (drop/duplicate one logical RPC, before the retry layer); the
+# `elastic.store.rpc.<op>` family is ATTEMPT-level (each retry re-fires —
+# persistent raise faults burn real backoff and meet the RetryBudget)
+POINTS = (
+    "elastic.store.register",
+    "elastic.store.heartbeat",
+    "elastic.store.deregister",
+    "elastic.store.kv.put",
+    "elastic.store.kv.get",
+    "elastic.store.kv.delete",
+    "elastic.store.kv.scan",
+    "elastic.store.rpc.register",
+    "elastic.store.rpc.heartbeat",
+    "elastic.store.rpc.deregister",
+    "elastic.store.rpc.put",
+    "elastic.store.rpc.get",
+    "elastic.store.rpc.delete",
+    "elastic.store.rpc.scan",
+    "elastic.store.rpc.scan_kv",
+    "checkpoint.write",
+    "engine.tick",
+    "replica.tick",
+    "router.transport",
+    "elastic.rank.step",
+    "preemption.update",
+)
+
+
+class InjectedFault(RuntimeError):
+    """An injected failure (the generic ``raise`` kind's default class)."""
+
+    def __init__(self, msg: str, point: str = "", kind: str = "",
+                 count: int = 0):
+        super().__init__(msg)
+        self.point = point
+        self.kind = kind
+        self.count = count
+
+
+class InjectedDeath(InjectedFault):
+    """Abrupt simulated process death: the raising frame's owner (rank
+    thread, training loop) must stop exactly as if SIGKILLed — no cleanup,
+    no deregistration, heartbeats already halted."""
+
+
+class InjectedCrash(InjectedFault):
+    """Simulated crash mid-critical-section. The checkpoint writer treats
+    it specially: temp files are LEFT on disk (a real crash does not run
+    ``except`` cleanup), exercising the stale-temp sweep + newest-intact
+    fallback."""
+
+
+class FaultSpec:
+    """One planned fault: WHERE (point + label match), WHEN (trigger
+    counts), WHAT (kind + parameters).
+
+    ``at``: 1-based matching-invocation count(s) at which to fire (int or
+    iterable). ``every``: fire on every Nth matching invocation instead
+    (persistent faults; ``at`` ignored). ``match``: labels that must be a
+    subset of the ``fire()`` labels for the invocation to count.
+    ``seconds``: sleep for delay/stall. ``exception``: class or instance
+    raised for the ``raise`` kind (default :class:`InjectedFault`).
+    ``max_fires`` bounds ``every``-mode firings (None = unbounded).
+    """
+
+    def __init__(self, point: str, kind: str = "raise", *,
+                 at=1, every: Optional[int] = None,
+                 match: Optional[Dict[str, object]] = None,
+                 seconds: float = 0.05, exception=None,
+                 max_fires: Optional[int] = None):
+        self.point = str(point)
+        self.kind = str(kind)
+        if every is not None and int(every) < 1:
+            raise ValueError("every must be >= 1")
+        self.every = None if every is None else int(every)
+        if isinstance(at, int):
+            at = (at,)
+        self.at: Tuple[int, ...] = tuple(sorted(int(a) for a in at))
+        if self.every is None and any(a < 1 for a in self.at):
+            raise ValueError("trigger counts are 1-based")
+        self.match = dict(match or {})
+        self.seconds = float(seconds)
+        self.exception = exception
+        self.max_fires = None if max_fires is None else int(max_fires)
+        # mutable trigger state (owned by the schedule's lock)
+        self.count = 0   # matching invocations seen
+        self.fires = 0   # times this spec actually fired
+
+    def _matches(self, labels: Dict[str, object]) -> bool:
+        return all(labels.get(k) == v for k, v in self.match.items())
+
+    def _due(self) -> bool:
+        if self.max_fires is not None and self.fires >= self.max_fires:
+            return False
+        if self.every is not None:
+            return self.count % self.every == 0
+        return self.count in self.at
+
+    def build_exception(self) -> BaseException:
+        exc = self.exception
+        if exc is None:
+            cls = {"timeout": socket.timeout,
+                   "crash_after_temp": InjectedCrash,
+                   "kill": InjectedDeath}.get(self.kind, InjectedFault)
+            exc = cls
+        if isinstance(exc, type):
+            if issubclass(exc, InjectedFault):
+                return exc(
+                    f"injected {self.kind} at {self.point} "
+                    f"(count {self.count})",
+                    point=self.point, kind=self.kind, count=self.count)
+            return exc(f"injected {self.kind} at {self.point} "
+                       f"(count {self.count})")
+        return exc
+
+    def to_dict(self) -> Dict:
+        return {"point": self.point, "kind": self.kind, "at": list(self.at),
+                "every": self.every, "match": dict(self.match),
+                "seconds": self.seconds}
+
+
+class FaultSchedule:
+    """A seeded, replayable plan of faults.
+
+    Two runs armed with equal schedules over a deterministic workload see
+    the identical fault sequence — :attr:`fired` (the ordered log of
+    ``(point, kind, count, labels)`` records) is the replay certificate.
+    """
+
+    def __init__(self, specs: Sequence[FaultSpec] = (), seed: int = 0):
+        self.seed = int(seed)
+        self.specs: List[FaultSpec] = list(specs)
+        self.fired: List[Dict] = []
+        self._lock = threading.Lock()
+        self._armed_global = False
+
+    # -- construction ---------------------------------------------------
+    def add(self, point: str, kind: str = "raise", **kw) -> "FaultSchedule":
+        """Append a :class:`FaultSpec` (chainable)."""
+        self.specs.append(FaultSpec(point, kind, **kw))
+        return self
+
+    def randomize(self, points: Sequence[str], n: int = 3,
+                  kinds: Sequence[str] = ("raise",),
+                  max_count: int = 20) -> "FaultSchedule":
+        """Seed-driven random schedule: ``n`` faults drawn from ``points``
+        × ``kinds`` at trigger counts in [1, max_count]. The draw uses ONLY
+        ``self.seed``, so the same seed always plans the same chaos."""
+        import random
+
+        rng = random.Random(self.seed)
+        for _ in range(int(n)):
+            self.add(rng.choice(list(points)), rng.choice(list(kinds)),
+                     at=rng.randint(1, int(max_count)))
+        return self
+
+    # -- the hot path ---------------------------------------------------
+    def _fire(self, point: str, labels: Dict[str, object]) -> Optional[FaultSpec]:
+        hit = None
+        with self._lock:
+            for spec in self.specs:
+                if spec.point != point or not spec._matches(labels):
+                    continue
+                spec.count += 1
+                if hit is None and spec._due():
+                    spec.fires += 1
+                    hit = spec
+                    self.fired.append({
+                        "point": point, "kind": spec.kind,
+                        "count": spec.count,
+                        "labels": {k: v for k, v in labels.items()
+                                   if isinstance(v, (str, int, float, bool,
+                                                     type(None)))},
+                    })
+        return hit
+
+    # -- replay bookkeeping ---------------------------------------------
+    def fired_log(self) -> List[Dict]:
+        """Copy of the ordered fired-fault log (the replay certificate)."""
+        with self._lock:
+            return [dict(f) for f in self.fired]
+
+    def reset(self):
+        """Zero all trigger counters and the fired log (reuse a schedule
+        for a second, independent replay)."""
+        with self._lock:
+            self.fired.clear()
+            for s in self.specs:
+                s.count = 0
+                s.fires = 0
+
+    def to_dict(self) -> Dict:
+        return {"seed": self.seed,
+                "specs": [s.to_dict() for s in self.specs]}
+
+    # -- arming ---------------------------------------------------------
+    def arm(self) -> "FaultSchedule":
+        """Install process-globally (single-scenario tests, CLI runs)."""
+        global _global_schedule
+        _global_schedule = self
+        self._armed_global = True
+        return self
+
+    def disarm(self):
+        global _global_schedule
+        if _global_schedule is self:
+            _global_schedule = None
+        self._armed_global = False
+        if getattr(_tls, "schedule", None) is self:
+            _tls.schedule = None
+
+    def scope(self):
+        """Context manager arming this schedule for the CURRENT THREAD
+        only — rank threads in one process each run their own chaos."""
+        return _ThreadScope(self)
+
+    def __enter__(self):
+        return self.arm()
+
+    def __exit__(self, *exc):
+        self.disarm()
+
+
+class _ThreadScope:
+    def __init__(self, schedule: FaultSchedule):
+        self.schedule = schedule
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "schedule", None)
+        _tls.schedule = self.schedule
+        return self.schedule
+
+    def __exit__(self, *exc):
+        _tls.schedule = self._prev
+
+
+_global_schedule: Optional[FaultSchedule] = None
+_tls = threading.local()
+
+
+def active_schedule() -> Optional[FaultSchedule]:
+    """The schedule governing this thread (thread-local wins, then
+    global, else None)."""
+    sched = getattr(_tls, "schedule", None)
+    return sched if sched is not None else _global_schedule
+
+
+def fire(point: str, **labels) -> Optional[FaultSpec]:
+    """Injection-point hook, called by the instrumented seams.
+
+    Returns ``None`` when nothing fires (the production fast path is one
+    global read + one thread-local read). When a spec fires:
+
+    * ``delay``/``stall`` sleep ``spec.seconds`` here and return ``None``
+      (the operation proceeds, late);
+    * ``raise``/``timeout`` raise here (the seam's normal error handling
+      takes over — that is the point);
+    * every other kind returns the :class:`FaultSpec` for the seam to
+      interpret (drop/duplicate/garbage/torn/crash_after_temp/kill).
+    """
+    sched = active_schedule()
+    if sched is None:
+        return None
+    spec = sched._fire(point, labels)
+    if spec is None:
+        return None
+    if spec.kind in ("delay", "stall"):
+        time.sleep(spec.seconds)
+        return None
+    if spec.kind in ("raise", "timeout"):
+        raise spec.build_exception()
+    return spec

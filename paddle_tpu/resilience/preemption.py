@@ -113,6 +113,42 @@ class PreemptionGuard:
         from ..observability.flight import flight_recorder
 
         flight_recorder().note(step=int(step))
+        # injection seam: a scheduled `kill` here is the deterministic
+        # SIGTERM — the state for THIS step is already registered (exactly
+        # the signal-after-print window the chaos test aims at), the
+        # emergency save runs, and InjectedDeath unwinds the training
+        # loop like a real termination
+        from .inject import fire as _inject_fire
+
+        f = _inject_fire("preemption.update", step=int(step))
+        if f is not None and f.kind == "kill":
+            self.preempt_now(reason=f"injected kill at step {step}")
+            raise f.build_exception()
+
+    def preempt_now(self, reason: str = "injected preemption",
+                    dump_tag: str = "preemption_injected") -> bool:
+        """The preemption protocol minus signal and process exit —
+        at-most-once emergency save (failures warned, never raised: the
+        exit protocol must win), flight dump under ``dump_tag``,
+        ``preempted`` flag, ``on_preempt`` hook. The signal handler
+        funnels through here too; deterministic callers (the injection
+        plane) decide how to unwind afterwards. Returns True when a
+        snapshot was written."""
+        self.preempted = True
+        saved = False
+        try:
+            saved = self.emergency_save(reason=reason)
+        except Exception as e:
+            warnings.warn(f"PreemptionGuard: emergency save failed "
+                          f"({type(e).__name__}: {e})", RuntimeWarning)
+        self._flight_dump(dump_tag)
+        if self.on_preempt is not None:
+            try:
+                self.on_preempt()
+            except Exception as e:
+                warnings.warn(f"PreemptionGuard: on_preempt hook failed "
+                              f"({type(e).__name__}: {e})", RuntimeWarning)
+        return saved
 
     def _current(self) -> Optional[Tuple[int, Any]]:
         if self._latest is not None:
@@ -186,19 +222,10 @@ class PreemptionGuard:
                 raise SystemExit(self.exit_code)
             return
         # nothing before the exit protocol may escape: a failed save (disk
-        # full, capture race) must still produce the relaunchable exit code
-        try:
-            self.emergency_save(reason=f"signal {signum}")
-        except Exception as e:
-            warnings.warn(f"PreemptionGuard: emergency save failed "
-                          f"({type(e).__name__}: {e})", RuntimeWarning)
-        self._flight_dump(f"preemption_signal_{signum}")
-        if self.on_preempt is not None:
-            try:
-                self.on_preempt()
-            except Exception as e:
-                warnings.warn(f"PreemptionGuard: on_preempt hook failed "
-                              f"({type(e).__name__}: {e})", RuntimeWarning)
+        # full, capture race) must still produce the relaunchable exit
+        # code — preempt_now contains save/dump/hook failures
+        self.preempt_now(reason=f"signal {signum}",
+                         dump_tag=f"preemption_signal_{signum}")
         if self.exit_code is not None:
             raise SystemExit(self.exit_code)
         prev = self._prev_handlers.get(signum)

@@ -5,23 +5,110 @@ failures inside the etcd client; our HTTP KV store (fleet/utils/http_server)
 deliberately has a dumb client that reports failure, so the retry policy
 lives here — exponential backoff with decorrelated jitter, the standard
 recipe for not stampeding a recovering store.
+
+:class:`RetryBudget` adds the missing global dimension: per-call retry caps
+bound ONE operation, but a persistent fault (an injected ``every=1`` store
+failure, a dead dependency) makes every caller burn its full per-call
+allowance in lockstep — N subsystems × (retries+1) attempts against a
+dependency that is not coming back. A budget caps total RETRY attempts
+(first attempts are always free) across an operation window; once spent,
+``call_with_retries`` fails fast with ``RetryError.budget_exhausted=True``
+and increments the ``retry_budget_exhausted_total`` counter in the
+observability registry.
 """
 from __future__ import annotations
 
 import random
+import threading
 import time
+from collections import deque
 from typing import Callable, Iterator, Optional, Tuple, Type
 
-__all__ = ["backoff_delays", "call_with_retries", "RetryError"]
+__all__ = ["backoff_delays", "call_with_retries", "RetryError",
+           "RetryBudget", "set_default_budget", "default_budget"]
 
 
 class RetryError(RuntimeError):
     """All attempts failed; ``last`` holds the final exception (or None when
-    the callable signalled failure by return value)."""
+    the callable signalled failure by return value). ``budget_exhausted``
+    is True when the retry BUDGET cut the attempts short (fail-fast under a
+    persistent fault) rather than the per-call retry cap running out."""
 
-    def __init__(self, msg: str, last: Optional[BaseException] = None):
+    def __init__(self, msg: str, last: Optional[BaseException] = None,
+                 budget_exhausted: bool = False):
         super().__init__(msg)
         self.last = last
+        self.budget_exhausted = bool(budget_exhausted)
+
+
+class RetryBudget:
+    """Sliding-window cap on total retry attempts across callers.
+
+    ``max_retries`` retries may be spent per ``window_s`` seconds; first
+    attempts are never charged (a healthy system with zero failures never
+    touches the budget). Thread-safe; one instance is meant to be shared
+    by every retry loop talking to the same dependency."""
+
+    def __init__(self, max_retries: int = 64, window_s: float = 30.0):
+        if int(max_retries) < 0:
+            raise ValueError("max_retries must be >= 0")
+        self.max_retries = int(max_retries)
+        self.window_s = float(window_s)
+        self.exhausted_count = 0  # times try_spend() said no
+        self._spent: deque = deque()
+        self._lock = threading.Lock()
+        self._counter = None  # lazy: observability may not be imported yet
+
+    def _exhausted_counter(self):
+        if self._counter is None:
+            try:
+                from ..observability.metrics import default_registry
+
+                self._counter = default_registry().counter(
+                    "retry_budget_exhausted_total",
+                    "retry attempts refused by the shared retry budget")
+            except Exception:  # pragma: no cover - observability optional
+                self._counter = False
+        return self._counter or None
+
+    def try_spend(self, now: Optional[float] = None) -> bool:
+        """Charge one retry attempt. False = budget spent: the caller must
+        fail fast instead of retrying."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            while self._spent and now - self._spent[0] > self.window_s:
+                self._spent.popleft()
+            if len(self._spent) >= self.max_retries:
+                self.exhausted_count += 1
+                c = self._exhausted_counter()
+                if c is not None:
+                    c.inc()
+                return False
+            self._spent.append(now)
+            return True
+
+    def remaining(self, now: Optional[float] = None) -> int:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            while self._spent and now - self._spent[0] > self.window_s:
+                self._spent.popleft()
+            return max(0, self.max_retries - len(self._spent))
+
+
+_default_budget: Optional[RetryBudget] = None
+
+
+def set_default_budget(budget: Optional[RetryBudget]) -> Optional[RetryBudget]:
+    """Install (or clear, with None) the process-wide retry budget that
+    every ``call_with_retries`` without an explicit ``budget=`` consults.
+    Returns the previous budget."""
+    global _default_budget
+    prev, _default_budget = _default_budget, budget
+    return prev
+
+
+def default_budget() -> Optional[RetryBudget]:
+    return _default_budget
 
 
 def backoff_delays(retries: int, base: float = 0.05, max_delay: float = 2.0,
@@ -41,13 +128,22 @@ def call_with_retries(fn: Callable, *, retries: int = 4, base: float = 0.05,
                       max_delay: float = 2.0, jitter: float = 0.5,
                       retry_on: Tuple[Type[BaseException], ...] = (OSError,),
                       ok: Callable = lambda r: True,
-                      sleep: Callable[[float], None] = time.sleep):
+                      sleep: Callable[[float], None] = time.sleep,
+                      budget: Optional[RetryBudget] = None):
     """Run ``fn()`` up to ``retries + 1`` times.
 
     A failure is either an exception in ``retry_on`` or a return value that
     ``ok`` rejects (the KV client reports failure as False/None rather than
     raising). Returns the first accepted value; raises :class:`RetryError`
-    when every attempt failed."""
+    when every attempt failed.
+
+    ``budget`` (default: the process-wide :func:`default_budget`, when one
+    is installed) charges each RETRY attempt against a shared sliding
+    window; a spent budget fails fast (``budget_exhausted=True``) so a
+    persistent fault degrades in bounded time instead of every caller
+    burning its full backoff sequence."""
+    if budget is None:
+        budget = _default_budget
     last_exc: Optional[BaseException] = None
     delays = backoff_delays(retries, base=base, max_delay=max_delay,
                             jitter=jitter)
@@ -61,6 +157,12 @@ def call_with_retries(fn: Callable, *, retries: int = 4, base: float = 0.05,
                 return result
             last_exc = None
         if attempt < retries:
+            if budget is not None and not budget.try_spend():
+                raise RetryError(
+                    f"{getattr(fn, '__name__', 'call')} failed and the "
+                    f"shared retry budget is exhausted after "
+                    f"{attempt + 1} attempt(s) (fail-fast)",
+                    last=last_exc, budget_exhausted=True)
             sleep(next(delays))
     raise RetryError(
         f"{getattr(fn, '__name__', 'call')} failed after {retries + 1} "

@@ -13,7 +13,16 @@ dynamic-batching executor over paged GPU kernels.
     router    — N-replica least-loaded failover (health checks, circuit
                 breaker, resubmit of never-started requests, drain-aware
                 takedown)
+    admission — memory-aware admission gate (r10 liveness estimator as a
+                runtime component), deadline propagation, and the
+                goodput-preserving overload shed policy
 """
+from .admission import (  # noqa: F401
+    AdmissionGate,
+    AdmissionRejected,
+    DeadlineExceededError,
+    LoadShedPolicy,
+)
 from .engine import ContinuousBatchingEngine  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .scheduler import (  # noqa: F401
@@ -46,4 +55,8 @@ __all__ = [
     "ServingRouter",
     "RoutedRequest",
     "NoReplicaAvailable",
+    "AdmissionGate",
+    "AdmissionRejected",
+    "DeadlineExceededError",
+    "LoadShedPolicy",
 ]

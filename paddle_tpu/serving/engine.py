@@ -78,7 +78,9 @@ class ContinuousBatchingEngine:
                  scheduler: Optional[FCFSScheduler] = None,
                  metrics: Optional[ServingMetrics] = None,
                  max_queue: int = 64, max_prefills_per_tick: int = 2,
-                 cache_dtype: str = "float32"):
+                 cache_dtype: str = "float32",
+                 hbm_budget_bytes: Optional[int] = None,
+                 admission_gate=None, shed_policy=None):
         import jax.numpy as jnp
 
         from ..models.gpt import GPTForPretraining
@@ -140,6 +142,16 @@ class ContinuousBatchingEngine:
         self._lock = threading.Lock()  # engine tick mutual exclusion
         self._abort = threading.Event()  # crash simulation: loop exits, NO drain
         self._build_programs()
+        # overload protection (serving/admission.py), both opt-in: the
+        # gate prices each request's prefill against an HBM budget with
+        # the r10 liveness estimator; the shed policy bounds queue wait
+        # under sustained overload by failing the oldest queued work
+        if admission_gate is None and hbm_budget_bytes is not None:
+            from .admission import AdmissionGate
+
+            admission_gate = AdmissionGate(self, hbm_budget_bytes)
+        self.admission_gate = admission_gate
+        self.shed_policy = shed_policy.bind(self) if shed_policy else None
 
     # -- traced programs ----------------------------------------------------
     def _build_programs(self):
@@ -259,6 +271,21 @@ class ContinuousBatchingEngine:
                 f"prompt ({req.prompt.size}) + max_new_tokens "
                 f"({req.max_new_tokens}) exceeds KV capacity "
                 f"max_seq_len={self.max_seq_len}")
+        if req.deadline_expired():
+            # dead on arrival: refuse up front (503) — queueing it would
+            # only burn a prefill the client has already given up on
+            from .admission import DeadlineExceededError
+
+            self.metrics.on_reject()
+            raise DeadlineExceededError(
+                f"request {req.request_id} arrived with its deadline "
+                f"already elapsed (deadline_s={req.deadline_s})")
+        if self.admission_gate is not None:
+            try:
+                self.admission_gate.check(req)
+            except Exception:
+                self.metrics.on_reject()
+                raise
         try:
             self.scheduler.submit(req)
         except Exception:
@@ -353,6 +380,29 @@ class ContinuousBatchingEngine:
         req._finish(Request.DONE)
         self.metrics.on_complete()
 
+    def _fail_deadline(self, req: Request):
+        from .admission import DEADLINE_ERROR_TYPE
+
+        waited = time.perf_counter() - req.submitted_at
+        req._finish(
+            Request.FAILED,
+            f"{DEADLINE_ERROR_TYPE}: deadline_s={req.deadline_s} elapsed "
+            f"after {waited:.3f}s in queue (shed before prefill)",
+            error_type=DEADLINE_ERROR_TYPE)
+        self.metrics.on_shed("deadline")
+
+    def _fail_shed(self, req: Request):
+        from .admission import SHED_ERROR_TYPE
+
+        hint = self.metrics.retry_after_hint(
+            queue_depth=self.scheduler.depth())
+        req._finish(
+            Request.FAILED,
+            f"{SHED_ERROR_TYPE}: shed under sustained overload before "
+            f"prefill; retry after {hint:.1f}s",
+            error_type=SHED_ERROR_TYPE)
+        self.metrics.on_shed("overload")
+
     def step_once(self) -> bool:
         """One engine tick: admit waiting requests into free slots (bounded
         by the scheduler's interleave policy), then run ONE decode step for
@@ -360,13 +410,38 @@ class ContinuousBatchingEngine:
         import jax.numpy as jnp
 
         from ..profiler.scope import scope
+        from ..resilience.inject import fire as _inject_fire
 
+        # injection seam: a raised fault propagates into serve_forever's
+        # containment (deterministic replay of the poison-tick suite), a
+        # stall sleeps here — both without touching engine state. Fired
+        # only on PRODUCTIVE ticks: idle polls are timing-dependent and
+        # must not advance trigger counts
+        if self._active.any() or self.scheduler.depth() > 0:
+            _inject_fire("engine.tick",
+                         replica=getattr(self, "_replica_addr", None))
         with self._lock:
             did = False
+            # queue hygiene before admissions: drop work whose deadline
+            # already elapsed — it can never start in time, so it must
+            # not consume an admission slot (failed VISIBLY, typed error
+            # via poll/stream, never silently)
+            for req in self.scheduler.sweep_expired():
+                self._fail_deadline(req)
+                did = True
             free = [i for i in range(self.n_slots) if not self._active[i]]
             if free:
                 for req in self.scheduler.take_admissions(len(free)):
                     slot = free.pop(0)
+                    if req.deadline_expired():
+                        # the mid-queue-expiry race: the deadline lapsed
+                        # between the pop and this prefill — shed NOW,
+                        # never burn a prefill on a dead request
+                        self._fail_deadline(req)
+                        self.scheduler.admission_settled()
+                        free.insert(0, slot)
+                        did = True
+                        continue
                     try:
                         occupied = self._admit_one(req, slot)
                     except Exception as e:
@@ -388,6 +463,14 @@ class ContinuousBatchingEngine:
                         self.scheduler.admission_settled()
                     if not occupied:
                         free.append(slot)  # finished/failed at prefill
+                    did = True
+            # overload policy AFTER admissions: everything still queued
+            # here genuinely waits at least a tick, so the shed target
+            # never fails a request that could have started right now
+            # (and free slots are never idled by the trim)
+            if self.shed_policy is not None:
+                for req in self.shed_policy.victims(self.scheduler):
+                    self._fail_shed(req)
                     did = True
             if self._active.any():
                 before = self.trace_counts["step"]
@@ -499,8 +582,34 @@ class ContinuousBatchingEngine:
         is set AND all admitted work has drained (graceful drain). A tick
         that raises fails the affected requests (state FAILED, error
         recorded) instead of silently killing the loop thread."""
+        from ..resilience.inject import fire as _inject_fire
+
         while not self._abort.is_set():
+            # replica-death injection seam: counted only on PRODUCTIVE
+            # ticks (work queued or slots active) so trigger counts are
+            # deterministic — idle-wait iterations are timing-dependent
+            # and must not advance the schedule
             try:
+                # inside the try: a raise-kind fault at this point is
+                # contained like any tick failure below, never a
+                # silently dead loop thread
+                if self._active.any() or self.scheduler.depth() > 0:
+                    f = _inject_fire(
+                        "replica.tick",
+                        replica=getattr(self, "_replica_addr", None))
+                    if f is not None and f.kind == "kill":
+                        # abrupt simulated SIGKILL: tear the whole
+                        # replica down (HTTP plane included, via the
+                        # server's kill hook) from a helper thread —
+                        # kill() joins THIS thread, so it cannot run
+                        # here — and exit the loop with no drain;
+                        # queued/in-flight work is orphaned
+                        kill_cb = getattr(self, "_server_kill", None)
+                        self._abort.set()
+                        if kill_cb is not None:
+                            threading.Thread(target=kill_cb,
+                                             daemon=True).start()
+                        return
                 did = self.step_once()
             except Exception as e:  # contain: fail work, keep serving
                 err = f"engine tick failed: {type(e).__name__}: {e}"

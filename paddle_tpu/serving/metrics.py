@@ -57,6 +57,7 @@ class ServingMetrics:
         self.requests_submitted = 0
         self.requests_rejected = 0
         self.requests_completed = 0
+        self.requests_shed = 0
         self.tokens_generated = 0
         self.prefill_calls = 0
         self.prefill_compiles = 0
@@ -79,6 +80,9 @@ class ServingMetrics:
             "serving_requests_rejected_total", "requests rejected (429/503)")
         self._c_completed = r.counter(
             "serving_requests_completed_total", "requests finished")
+        self._c_shed = r.counter(
+            "serving_requests_shed_total",
+            "queued requests shed before prefill", ("reason",))
         self._c_tokens = r.counter(
             "serving_tokens_generated_total", "generated tokens")
         self._c_prefills = r.counter(
@@ -120,6 +124,13 @@ class ServingMetrics:
         with self._lock:
             self.requests_completed += 1
         self._c_completed.inc()
+
+    def on_shed(self, reason: str = "overload"):
+        """A QUEUED request was failed before prefill (overload policy or
+        deadline sweep) — visible shedding, labelled by why."""
+        with self._lock:
+            self.requests_shed += 1
+        self._c_shed.inc(reason=str(reason))
 
     def on_first_token(self, ttft_seconds: float):
         with self._lock:
@@ -199,6 +210,7 @@ class ServingMetrics:
                     "submitted": self.requests_submitted,
                     "rejected": self.requests_rejected,
                     "completed": self.requests_completed,
+                    "shed": self.requests_shed,
                 },
                 "tokens_generated": self.tokens_generated,
                 "throughput_tokens_per_sec": tput,

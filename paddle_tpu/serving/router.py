@@ -32,6 +32,7 @@ Mechanics:
 from __future__ import annotations
 
 import http.client
+import math
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
@@ -41,6 +42,7 @@ import numpy as np
 from ..observability import trace as obstrace
 from ..observability.metrics import MetricsHTTPServer, MetricsRegistry
 from ..resilience.retry import RetryError, backoff_delays
+from .admission import AdmissionRejected, DeadlineExceededError
 from .scheduler import QueueFullError, Request, SchedulerClosed
 from .server import RequestFailedError, ServingClient, StreamIncompleteError
 
@@ -104,6 +106,14 @@ class RoutedRequest:
     def __init__(self, prompt, **spec):
         self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1).tolist()
         self.spec = dict(spec)
+        # the deadline anchors at the ROUTER (the request's entry point);
+        # every (re)submit forwards only the REMAINING seconds, so a
+        # failover does not silently grant the request a fresh deadline.
+        # NaN would defeat every expiry comparison — reject up front
+        ds = self.spec.pop("deadline_s", None)
+        if ds is not None and not math.isfinite(float(ds)):
+            raise ValueError(f"deadline_s must be finite, got {ds}")
+        self.deadline_s = None if ds is None else float(ds)
         # minted at the router (the request's entry point) and propagated
         # via headers — the one id stitching router + replica spans
         self.trace_id: Optional[str] = (
@@ -120,6 +130,8 @@ class RoutedRequest:
         self.failure_kind: Optional[str] = None
         self.resubmits = 0
         self.submitted_at = time.perf_counter()
+        self.deadline_at = (None if self.deadline_s is None
+                            else self.submitted_at + self.deadline_s)
         self.first_token_at: Optional[float] = None
         self.failover_first_token_at: Optional[float] = None
         # serializes failover: poll() and stream() may race on the same
@@ -337,20 +349,45 @@ class ServingRouter:
         return sorted(closed, key=key) + sorted(half, key=key)
 
     def _submit_somewhere(self, rr: RoutedRequest) -> None:
+        if rr.deadline_at is not None \
+                and rr.deadline_at - time.perf_counter() <= 0:
+            raise DeadlineExceededError(
+                f"deadline_s={rr.deadline_s} elapsed before the request "
+                f"could be (re)submitted")
         last_exc: Optional[Exception] = None
         for rep in self._candidates():
+            # the remaining deadline is re-derived PER ATTEMPT: time
+            # burned timing out against a dead candidate must be deducted
+            # from what the next replica is told, or a later hop
+            # re-anchors a deadline that has already elapsed
+            deadline_remaining: Optional[float] = None
+            if rr.deadline_at is not None:
+                deadline_remaining = rr.deadline_at - time.perf_counter()
+                if deadline_remaining <= 0:
+                    # cannot start anywhere before the deadline: shed at
+                    # the router instead of spending a replica's queue
+                    # slot on it
+                    raise DeadlineExceededError(
+                        f"deadline_s={rr.deadline_s} elapsed before the "
+                        f"request could be (re)submitted")
             try:
                 rid = rep.client.submit(
                     rr.prompt, trace_id=rr.trace_id,
-                    parent_span_id=rr.route_span_id, **rr.spec)
+                    parent_span_id=rr.route_span_id,
+                    deadline_s=deadline_remaining, **rr.spec)
+            except DeadlineExceededError:
+                # the remaining budget evaporated in flight — expired
+                # everywhere by definition, never spill
+                raise
             except (OSError, RetryError, ValueError,
                     http.client.HTTPException) as e:  # transport: breaker
                 self._record_failure(rep)
                 last_exc = e
                 continue
-            except (QueueFullError, SchedulerClosed) as e:
+            except (QueueFullError, SchedulerClosed, AdmissionRejected) as e:
                 # semantic backpressure: the replica is healthy, just full/
-                # draining — spill to the next one, surface if ALL are
+                # draining/over-budget — spill to the next one, surface if
+                # ALL are
                 last_exc = e
                 continue
             self._record_success(rep)
@@ -364,7 +401,8 @@ class ServingRouter:
             rr.remote_id = rid
             rr.replica_addr = rep.addr
             return
-        if isinstance(last_exc, (QueueFullError, SchedulerClosed)):
+        if isinstance(last_exc, (QueueFullError, SchedulerClosed,
+                                 AdmissionRejected)):
             raise last_exc
         raise NoReplicaAvailable(
             f"no replica accepted the request "
@@ -461,7 +499,15 @@ class ServingRouter:
                 self._c_resubmits.inc()
                 rr.resubmits += 1
                 return True
-            except (QueueFullError, SchedulerClosed, NoReplicaAvailable):
+            except DeadlineExceededError as e:
+                # the deadline lapsed during failover: a request-level
+                # verdict (nothing is wrong with the survivors)
+                rr.failure_kind = "request"
+                rr.state = Request.FAILED
+                rr.error = f"{DeadlineExceededError.error_type}: {e}"
+                return False
+            except (QueueFullError, SchedulerClosed, NoReplicaAvailable,
+                    AdmissionRejected):
                 if attempt >= self.resubmit_retries:
                     break
                 time.sleep(next(delays))

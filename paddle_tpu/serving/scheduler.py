@@ -23,6 +23,7 @@ Pieces:
 from __future__ import annotations
 
 import itertools
+import math
 import threading
 import time
 from collections import deque
@@ -95,7 +96,8 @@ class Request:
                  top_p: Optional[float] = None, seed: Optional[int] = None,
                  request_id: Optional[str] = None,
                  trace_id: Optional[str] = None,
-                 parent_span_id: Optional[str] = None):
+                 parent_span_id: Optional[str] = None,
+                 deadline_s: Optional[float] = None):
         self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise ValueError("empty prompt")
@@ -122,8 +124,21 @@ class Request:
         self.tokens: List[int] = []
         self.state = Request.PENDING
         self.error: Optional[str] = None
+        # typed discriminator for failures ("DeadlineExceededError",
+        # "ShedError", ...) — clients switch on this, not message prose
+        self.error_type: Optional[str] = None
         self.bucket: Optional[int] = None
         self.submitted_at = time.perf_counter()
+        # client deadline (propagated as REMAINING seconds via the
+        # X-Deadline-S header): absolute on the local monotonic clock —
+        # work that cannot start before it is shed from the queue. NaN
+        # would compare False against every expiry check and silently
+        # disable the deadline the client believes is set — reject it
+        if deadline_s is not None and not math.isfinite(float(deadline_s)):
+            raise ValueError(f"deadline_s must be finite, got {deadline_s}")
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.deadline_at = (None if deadline_s is None
+                            else self.submitted_at + float(deadline_s))
         self.submitted_wall = time.time()  # span timestamps are wall-clock
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
@@ -137,12 +152,24 @@ class Request:
             self.tokens.append(int(token))
             self._cond.notify_all()
 
-    def _finish(self, state: str = DONE, error: Optional[str] = None):
+    def _finish(self, state: str = DONE, error: Optional[str] = None,
+                error_type: Optional[str] = None):
         with self._cond:
             self.state = state
             self.error = error
+            self.error_type = error_type
             self.finished_at = time.perf_counter()
             self._cond.notify_all()
+
+    # -- deadline -----------------------------------------------------------
+    def deadline_remaining(self) -> Optional[float]:
+        if self.deadline_at is None:
+            return None
+        return self.deadline_at - time.perf_counter()
+
+    def deadline_expired(self) -> bool:
+        rem = self.deadline_remaining()
+        return rem is not None and rem <= 0
 
     # -- client side --------------------------------------------------------
     @property
@@ -212,6 +239,10 @@ class FCFSScheduler:
         # trusts depth()+active alone would declare the engine empty
         # mid-prefill and orphan them
         self._in_admission = 0
+        # queued requests that CARRY a deadline: lets the per-tick expiry
+        # sweep skip the O(queue) walk entirely for deployments that
+        # never set deadlines
+        self._deadlined = 0
 
     # -- admission ----------------------------------------------------------
     def bucket_for(self, prompt_len: int) -> int:
@@ -234,6 +265,8 @@ class FCFSScheduler:
                 raise QueueFullError(
                     f"admission queue full ({self.max_queue})")
             self._q.append(req)
+            if req.deadline_at is not None:
+                self._deadlined += 1
             self._cond.notify_all()
         return req
 
@@ -251,6 +284,41 @@ class FCFSScheduler:
             # metrics read sees each request as queued or in-admission,
             # never neither
             self._in_admission += len(out)
+            self._deadlined -= sum(1 for r in out
+                                   if r.deadline_at is not None)
+        return out
+
+    def shed_oldest(self, n: int) -> List[Request]:
+        """Pop up to ``n`` requests OLDEST-first for load shedding (the
+        overload policy's mechanism — popped requests are no longer
+        queued; the engine fails them visibly). Oldest-first preserves
+        goodput under FCFS + deadlines: the head of the queue has burned
+        the most of its deadline and is the likeliest to be abandoned or
+        already retried by its client."""
+        out: List[Request] = []
+        with self._cond:
+            while self._q and len(out) < int(n):
+                out.append(self._q.popleft())
+            self._deadlined -= sum(1 for r in out
+                                   if r.deadline_at is not None)
+        return out
+
+    def sweep_expired(self) -> List[Request]:
+        """Remove every queued request whose deadline already elapsed
+        (they can never start in time — shedding them early frees queue
+        budget for work that can still meet its deadline). O(1) when no
+        queued request carries a deadline — the engine calls this every
+        tick."""
+        out: List[Request] = []
+        with self._cond:
+            if not self._q or self._deadlined <= 0:
+                return out
+            keep = deque()
+            for req in self._q:
+                (out if req.deadline_expired() else keep).append(req)
+            if out:
+                self._q = keep
+                self._deadlined -= len(out)
         return out
 
     def admission_settled(self, n: int = 1):

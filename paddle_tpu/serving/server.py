@@ -42,6 +42,7 @@ import numpy as np
 
 from ..observability import trace as obstrace
 from ..observability.metrics import prometheus_content_type, wants_prometheus
+from .admission import AdmissionRejected, DeadlineExceededError
 from .engine import ContinuousBatchingEngine
 from .scheduler import QueueFullError, Request, SchedulerClosed
 
@@ -98,6 +99,18 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _json_429(self, payload: Dict, hint: float):
+        """Backpressure response: JSON body + RFC 7231 ``Retry-After``
+        (whole seconds, floored at 1) — one writer for queue-full and
+        admission-gate refusals."""
+        body = json.dumps(payload).encode()
+        self.send_response(429)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Retry-After", str(int(hint + 0.5) or 1))
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _request_or_404(self, rid: str) -> Optional[Request]:
         req = self.server_ref._requests.get(rid)
         if req is None:
@@ -125,6 +138,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(400, {"error": f"bad request body: {e}"})
             return
         try:
+            # the client deadline rides the trace-header family as
+            # REMAINING seconds; a body key is also accepted for direct
+            # JSON callers
+            deadline = self.headers.get(obstrace.DEADLINE_HEADER)
+            if deadline is None:
+                deadline = spec.pop("deadline_s", None)
             req = Request(prompt, **{
                 k: spec[k] for k in ("max_new_tokens", "eos_token_id",
                                      "temperature", "top_k", "top_p", "seed")
@@ -132,27 +151,43 @@ class _Handler(BaseHTTPRequestHandler):
                 # trace context rides HEADERS, not the body — the JSON
                 # protocol stays byte-compatible for existing clients
                 trace_id=self.headers.get(obstrace.TRACE_HEADER),
-                parent_span_id=self.headers.get(obstrace.PARENT_HEADER))
+                parent_span_id=self.headers.get(obstrace.PARENT_HEADER),
+                deadline_s=None if deadline is None else float(deadline))
             self.server_ref.engine.submit(req)
+        except DeadlineExceededError as e:
+            self._json(503, {"error": str(e),
+                             "error_type": e.error_type})
+            return
+        except AdmissionRejected as e:
+            # the refusal CITES the liveness estimate: operators see the
+            # predicted peak vs the budget in the error body itself
+            hint = e.retry_after or 1.0
+            self._json_429({"error": str(e),
+                            "error_type": e.error_type,
+                            "estimate": e.estimate,
+                            "retry_after_s": hint}, hint)
+            return
         except QueueFullError as e:
             # backpressure with a USEFUL hint: seconds of queued work ahead
-            # at the measured token rate (RFC 7231 Retry-After)
+            # at the measured token rate
             hint = self.server_ref.engine.metrics.retry_after_hint(
                 queue_depth=self.server_ref.engine.scheduler.depth())
-            body = json.dumps({"error": str(e),
-                               "retry_after_s": hint}).encode()
-            self.send_response(429)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Retry-After", str(int(hint + 0.5) or 1))
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._json_429({"error": str(e), "retry_after_s": hint}, hint)
             return
         except SchedulerClosed as e:
             self._json(503, {"error": str(e)})
             return
         except (TypeError, ValueError) as e:
             self._json(400, {"error": str(e)})
+            return
+        except Exception as e:
+            # an internal failure (e.g. the admission gate's estimator
+            # tracing a new bucket) must be an HTTP answer, not an
+            # aborted connection — the router reads a dropped connection
+            # as a replica DEATH and opens the breaker on a healthy
+            # replica over a per-request pricing bug
+            self._json(500, {"error": f"submit failed internally: "
+                                      f"{type(e).__name__}: {e}"})
             return
         self.server_ref._register(req)
         self._json(202, {"id": req.request_id})
@@ -204,6 +239,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "prompt": req.prompt.tolist(),
                 "tokens": list(req.tokens),
                 "error": req.error,
+                "error_type": req.error_type,
             })
             return
         if len(parts) == 3 and parts[:2] == ["v1", "stream"]:
@@ -256,6 +292,11 @@ class ServingServer:
         self.host = host
         self.port = self._httpd.server_address[1]
         self.addr = f"{host}:{self.port}"
+        # fault-injection hooks: the engine loop's `replica.tick` point
+        # matches schedules on this address, and an injected `kill` tears
+        # down the WHOLE replica (HTTP plane included) like a SIGKILL
+        engine._replica_addr = self.addr
+        engine._server_kill = self.kill
         self._http_thread: Optional[threading.Thread] = None
         self._engine_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -371,9 +412,16 @@ class ServingClient:
     def _call(self, method: str, path: str, body: Optional[Dict] = None,
               retries: Optional[int] = None,
               headers: Optional[Dict[str, str]] = None):
+        from ..resilience.inject import fire as _inject_fire
         from ..resilience.retry import call_with_retries
 
         def attempt():
+            # transport injection seam: `timeout` raises socket.timeout
+            # before dialing (inside fire); `garbage` lets the request
+            # REACH the server (side effects happen) and corrupts only
+            # the response body — the lost-202 / truncated-read shape
+            f = _inject_fire("router.transport", addr=self.addr,
+                             method=method, path=path)
             c = self._conn()
             try:
                 hdrs = {"Content-Type": "application/json"}
@@ -383,7 +431,10 @@ class ServingClient:
                           body=None if body is None else json.dumps(body).encode(),
                           headers=hdrs)
                 r = c.getresponse()
-                return r.status, json.loads(r.read().decode() or "{}")
+                raw = r.read()
+                if f is not None and f.kind == "garbage":
+                    raw = b"\x00injected-garbage-body\x00"
+                return r.status, json.loads(raw.decode() or "{}")
             finally:
                 c.close()
 
@@ -394,23 +445,35 @@ class ServingClient:
             retry_on=(OSError,))
 
     def submit(self, prompt, trace_id: Optional[str] = None,
-               parent_span_id: Optional[str] = None, **kwargs) -> str:
+               parent_span_id: Optional[str] = None,
+               deadline_s: Optional[float] = None, **kwargs) -> str:
         # NO transport retry: a lost 202 after the server enqueued would
         # silently duplicate the generation (submit is not idempotent).
-        # Trace context propagates via headers (body stays protocol-stable).
+        # Trace context propagates via headers (body stays protocol-stable);
+        # the deadline ships as REMAINING seconds on the same family.
         headers = {}
         if trace_id:
             headers[obstrace.TRACE_HEADER] = trace_id
         if parent_span_id:
             headers[obstrace.PARENT_HEADER] = parent_span_id
+        if deadline_s is not None:
+            headers[obstrace.DEADLINE_HEADER] = repr(float(deadline_s))
         status, out = self._call("POST", "/v1/generate",
                                  {"prompt": np.asarray(prompt).tolist(),
                                   **kwargs}, retries=0,
                                  headers=headers or None)
         if status == 429:
+            if out.get("error_type") == AdmissionRejected.error_type:
+                raise AdmissionRejected(
+                    out.get("error", "admission refused"),
+                    estimate=out.get("estimate"),
+                    retry_after=out.get("retry_after_s"))
             raise QueueFullError(out.get("error", "queue full"),
                                  retry_after=out.get("retry_after_s"))
         if status == 503:
+            if out.get("error_type") == DeadlineExceededError.error_type:
+                raise DeadlineExceededError(
+                    out.get("error", "deadline exceeded"))
             raise SchedulerClosed(out.get("error", "draining"))
         if status != 202:
             raise RuntimeError(f"submit failed ({status}): {out}")
@@ -449,10 +512,19 @@ class ServingClient:
         :class:`StreamIncompleteError` on the server-side stream timeout
         (request still running), plain RuntimeError only for transport
         truncation (the replica or its handler died mid-stream)."""
+        from ..resilience.inject import fire as _inject_fire
+
+        f = _inject_fire("router.transport", addr=self.addr, method="GET",
+                         path=f"/v1/stream/{request_id}")
         c = self._conn()
         try:
             c.request("GET", f"/v1/stream/{request_id}")
             r = c.getresponse()
+            if f is not None and f.kind == "garbage":
+                # the stream connected but the first read is corrupt —
+                # parses as garbage JSON, the death-truncation shape
+                raise ValueError(
+                    f"injected garbage stream body from {self.addr}")
             if r.status == 404:
                 raise RequestFailedError(
                     f"unknown request {request_id!r} on this replica")

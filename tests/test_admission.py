@@ -1,0 +1,336 @@
+"""Memory-aware admission control + overload protection (ISSUE 8).
+
+The admission gate prices each request's KV+prefill HBM with the r10
+liveness estimator and refuses over-budget work citing the estimate; the
+deadline layer sheds queue-expired work before prefill (typed 503); the
+load-shed policy bounds queue wait under sustained overload without ever
+killing a request that reached a slot. The accounting test holds the
+gate's predicted resident footprint against the ``jax.live_arrays()``
+census after prefill — the r10 estimator-vs-measured 15% bound, on the
+serving plane.
+"""
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+from paddle_tpu.serving import (
+    AdmissionGate,
+    AdmissionRejected,
+    ContinuousBatchingEngine,
+    DeadlineExceededError,
+    LoadShedPolicy,
+    QueueFullError,
+    Request,
+    ServingClient,
+    ServingRouter,
+    ServingServer,
+)
+from paddle_tpu.serving.admission import DEADLINE_ERROR_TYPE, SHED_ERROR_TYPE
+
+VOCAB = 64
+
+
+def _tiny_model(layers=1, hidden=32):
+    paddle.seed(0)
+    cfg = gpt_config("gpt2-small", vocab_size=VOCAB, hidden_size=hidden,
+                     num_layers=layers, num_attention_heads=2,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+def _prompt(n=4):
+    return np.arange(1, n + 1, dtype=np.int32)
+
+
+def _drain(eng, reqs, timeout=120.0):
+    deadline = time.perf_counter() + timeout
+    while any(not r.done for r in reqs):
+        assert time.perf_counter() < deadline, "engine did not finish"
+        eng.step_once()
+
+
+# =====================================================================
+# admission gate: liveness pricing vs device budget
+# =====================================================================
+class TestAdmissionGate:
+    def test_refusal_cites_liveness_estimate(self, model):
+        """The acceptance criterion: an over-budget request is refused
+        and the refusal carries the liveness numbers it was judged by."""
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2,
+                                       hbm_budget_bytes=1024)
+        with pytest.raises(AdmissionRejected) as ei:
+            eng.submit(_prompt(), max_new_tokens=2)
+        est = ei.value.estimate
+        assert est["source"] == "analysis.memory liveness estimator"
+        assert est["predicted_peak_hbm_bytes"] > est["budget_bytes"] == 1024
+        assert est["kv_bytes_per_slot"] > 0
+        assert str(est["predicted_peak_hbm_bytes"]) in str(ei.value)
+        assert eng.metrics.requests_rejected == 1
+
+    def test_within_budget_admits_and_generates(self, model):
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2,
+                                       hbm_budget_bytes=1 << 30)
+        reqs = [eng.submit(_prompt(), max_new_tokens=4) for _ in range(2)]
+        _drain(eng, reqs)
+        assert all(r.state == Request.DONE for r in reqs)
+
+    def test_http_refusal_is_429_with_estimate_body(self, model):
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2,
+                                       hbm_budget_bytes=1024)
+        with ServingServer(eng) as srv:
+            with pytest.raises(AdmissionRejected) as ei:
+                ServingClient(srv.addr).submit(_prompt().tolist(),
+                                               max_new_tokens=2)
+            # the typed class survived the wire, estimate body included
+            assert ei.value.estimate["budget_bytes"] == 1024
+            assert ei.value.retry_after is not None
+
+    def test_pricing_does_not_perturb_compile_accounting(self, model):
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2,
+                                       hbm_budget_bytes=1 << 30)
+        gate = eng.admission_gate
+        for b in eng.scheduler.buckets:
+            gate.price(b)
+        assert eng.trace_counts == {"prefill": 0, "step": 0}
+        # pricing is cached: second pass hits the dict
+        before = dict(gate._estimates)
+        gate.price(eng.scheduler.buckets[0])
+        assert dict(gate._estimates) == before
+
+    def test_larger_bucket_prices_no_smaller(self, model):
+        eng = ContinuousBatchingEngine(model, max_seq_len=64, n_slots=2,
+                                       hbm_budget_bytes=1 << 30)
+        gate = eng.admission_gate
+        peaks = [gate.price(b)["predicted_peak_hbm_bytes"]
+                 for b in sorted(eng.scheduler.buckets)]
+        assert peaks == sorted(peaks)
+
+    def test_gate_accounting_within_15pct_of_live_arrays(self):
+        """Predicted resident HBM for N admitted slots vs the
+        ``jax.live_arrays()`` census after prefill — the estimator's 15%
+        certification, exercised on the serving plane it now gates."""
+        import jax
+
+        gc.collect()
+        base = sum(a.nbytes for a in jax.live_arrays())
+        model = _tiny_model(layers=2, hidden=32)
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=4, max_prefills_per_tick=4,
+            hbm_budget_bytes=1 << 30)
+        reqs = [eng.submit(_prompt(), max_new_tokens=16) for _ in range(4)]
+        eng.step_once()  # prefills all four (interleave cap raised)
+        assert eng.active_slots() == 4
+        gc.collect()
+        census = sum(a.nbytes for a in jax.live_arrays()) - base
+        predicted = eng.admission_gate.predicted_live_bytes()
+        assert census > 0
+        drift = abs(predicted - census) / census
+        assert drift <= 0.15, (predicted, census, drift)
+        _drain(eng, reqs)
+
+
+# =====================================================================
+# deadlines: propagation + queue-wait shedding
+# =====================================================================
+class TestDeadlines:
+    def test_expired_on_arrival_is_typed_503(self, model):
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=1)
+        with ServingServer(eng) as srv:
+            with pytest.raises(DeadlineExceededError):
+                ServingClient(srv.addr).submit(_prompt().tolist(),
+                                               max_new_tokens=2,
+                                               deadline_s=-1.0)
+
+    def test_non_finite_deadline_rejected_not_silently_disabled(
+            self, model):
+        """float('nan') compares False against every expiry check, so a
+        NaN deadline would silently mean NO deadline while the client
+        believes one is set — it must be a 400, not an open-ended wait."""
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=1)
+        with pytest.raises(ValueError, match="finite"):
+            eng.submit(_prompt(), max_new_tokens=2,
+                       deadline_s=float("nan"))
+        with ServingServer(eng) as srv:
+            with pytest.raises(RuntimeError, match="400"):
+                ServingClient(srv.addr).submit(_prompt().tolist(),
+                                               max_new_tokens=2,
+                                               deadline_s=float("nan"))
+        from paddle_tpu.serving.router import RoutedRequest
+
+        with pytest.raises(ValueError, match="finite"):
+            RoutedRequest(_prompt(), deadline_s=float("nan"))
+
+    def test_queue_expiry_sheds_before_prefill(self, model):
+        """A request whose deadline elapses while QUEUED fails typed,
+        before any prefill ran for it."""
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=1)
+        blocker = eng.submit(_prompt(), max_new_tokens=12)
+        doomed = eng.submit(_prompt(), max_new_tokens=4, deadline_s=0.01)
+        time.sleep(0.05)  # the deadline lapses in the queue
+        prefills_before = eng.metrics.prefill_calls
+        while not doomed.done:
+            eng.step_once()
+        assert doomed.state == Request.FAILED
+        assert doomed.error_type == DEADLINE_ERROR_TYPE
+        assert doomed.tokens == []
+        # it never prefilled: only the blocker's prefill ever ran
+        assert eng.metrics.prefill_calls == max(prefills_before, 1)
+        _drain(eng, [blocker])
+        assert blocker.state == Request.DONE
+
+    def test_mid_queue_expiry_race_regression(self, model, monkeypatch):
+        """The race: a request is POPPED while its deadline is still
+        valid, but the deadline lapses before prefill begins. The
+        post-pop re-check must shed it — the prefill program must never
+        run for it. (The sweep is disabled so the pop path is the one
+        under test.)"""
+        from paddle_tpu.serving.scheduler import FCFSScheduler
+
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2)
+        monkeypatch.setattr(FCFSScheduler, "sweep_expired",
+                            lambda self: [])
+        req = eng.submit(_prompt(), max_new_tokens=4, deadline_s=60.0)
+        # valid at pop time, expired by the re-check: the pop happens
+        # inside the step_once below — move the deadline into the past
+        # after submit but before the tick, which is exactly the window
+        # between pop and prefill once sweep_expired is inert
+        req.deadline_at = time.perf_counter() - 1e-3
+        prefills = eng.metrics.prefill_calls
+        eng.step_once()
+        assert req.state == Request.FAILED
+        assert req.error_type == DEADLINE_ERROR_TYPE
+        assert eng.metrics.prefill_calls == prefills  # never prefilled
+        assert eng.scheduler.in_admission() == 0      # settled, not leaked
+        # the slot freed by the shed is immediately usable
+        ok = eng.submit(_prompt(), max_new_tokens=2)
+        _drain(eng, [ok])
+        assert ok.state == Request.DONE
+
+    def test_deadline_rides_header_through_router(self, model):
+        srv = ServingServer(
+            ContinuousBatchingEngine(model, max_seq_len=32, n_slots=1)
+        ).start()
+        try:
+            with ServingRouter([srv.addr], health_interval_s=5.0,
+                               request_timeout=5.0) as router:
+                router.check_health()
+                rr = router.submit(_prompt(), max_new_tokens=4,
+                                   deadline_s=60.0)
+                out = router.wait(rr, timeout=60)
+                assert out["status"] == Request.DONE
+                # an already-expired deadline is shed AT THE ROUTER
+                with pytest.raises(DeadlineExceededError):
+                    router.submit(_prompt(), max_new_tokens=4,
+                                  deadline_s=-0.5)
+        finally:
+            srv.kill()
+
+    def test_poll_surfaces_typed_deadline_failure(self, model):
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=1)
+        with ServingServer(eng) as srv:
+            c = ServingClient(srv.addr)
+            blocker = c.submit(_prompt().tolist(), max_new_tokens=12)
+            rid = c.submit(_prompt().tolist(), max_new_tokens=2,
+                           deadline_s=0.01)
+            out = c.wait(rid, timeout=60)
+            assert out["status"] == Request.FAILED
+            assert out["error_type"] == DEADLINE_ERROR_TYPE
+            c.wait(blocker, timeout=60)
+
+
+# =====================================================================
+# load shedding under sustained overload
+# =====================================================================
+class TestLoadShed:
+    def _overloaded_engine(self, model, shed: bool, n_slots=2, max_new=6):
+        # sustain_s=0: the sustain window is WALL-clock while this test
+        # drives fixed TICK counts — on a fast box a nonzero window fits
+        # arbitrarily many growth ticks before the first shed, making
+        # any queue-depth bound box-speed-dependent (the flake class
+        # this PR exists to kill). With 0 the policy sheds one tick
+        # after the crossing: overshoot is bounded in ticks, not seconds
+        policy = (LoadShedPolicy(sustain_s=0.0) if shed else None)
+        return ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=n_slots, max_queue=256,
+            shed_policy=policy), max_new
+
+    def _drive_overload(self, eng, max_new, rounds=40):
+        """Tick-driven 2× synthetic overload: each request occupies a
+        slot for ~max_new ticks, so the service rate is n_slots/max_new
+        requests per tick; arrivals accumulate at exactly twice that."""
+        warm = eng.submit(_prompt(), max_new_tokens=2)
+        _drain(eng, [warm])  # compiles out of the TTFT samples
+        rate = 2.0 * eng.n_slots / max_new
+        reqs, depths = [], []
+        acc = 0.0
+        for _ in range(rounds):
+            acc += rate
+            while acc >= 1.0:
+                reqs.append(eng.submit(_prompt(), max_new_tokens=max_new))
+                acc -= 1.0
+            eng.step_once()
+            depths.append(eng.scheduler.depth())
+        _drain(eng, reqs)
+        return reqs, depths
+
+    def test_sustained_overload_sheds_visibly_never_kills_admitted(
+            self, model):
+        """The overload acceptance in one drive: shedding is VISIBLE
+        (typed failures + Retry-After hints, no silent drops), zero
+        requests that started decoding are killed by it, and the shed
+        counter lands in the Prometheus exposition."""
+        eng, max_new = self._overloaded_engine(model, shed=True)
+        reqs, _ = self._drive_overload(eng, max_new)
+        done = [r for r in reqs if r.state == Request.DONE]
+        failed = [r for r in reqs if r.state == Request.FAILED]
+        # every request settled one way — nothing dropped silently
+        assert len(done) + len(failed) == len(reqs)
+        assert all(r.error_type == SHED_ERROR_TYPE and r.error
+                   for r in failed)
+        assert eng.metrics.requests_shed == len(failed)
+        assert len(failed) > 0  # 2× overload really shed
+        assert all("retry after" in r.error for r in failed)
+        # zero ADMITTED (started decoding) requests were shed
+        assert all(not r.tokens for r in failed)
+        assert all(len(r.tokens) == max_new or
+                   r.tokens[-1:] == [r.eos_token_id] for r in done)
+        text = eng.metrics.prometheus_text()
+        assert "serving_requests_shed_total" in text
+        assert 'reason="overload"' in text
+
+    def test_shed_bounds_queue_vs_no_shed(self, model):
+        """Goodput shape, asserted on the TICK-DETERMINISTIC invariant
+        (wall-clock TTFT comparisons flake under concurrent CI load —
+        bench owns the timing claims): with shedding the queue is bounded
+        near the watermark, so admitted queue WAIT is bounded; without,
+        the queue grows with the overload for the whole drive."""
+        eng_a, max_new = self._overloaded_engine(model, shed=True)
+        reqs_a, depths_a = self._drive_overload(eng_a, max_new)
+        eng_b, _ = self._overloaded_engine(model, shed=False)
+        reqs_b, depths_b = self._drive_overload(eng_b, max_new)
+        # overshoot past the watermark is bounded by growth during the
+        # sustain window (a few ticks' arrivals)
+        assert max(depths_a) <= eng_a.shed_policy.high_watermark \
+            + eng_a.n_slots + 2, depths_a
+        # the unprotected arm's queue grows well past the shed arm's cap
+        assert max(depths_b) > max(depths_a)
+        # no-shed admitted everything; shed arm failed only queued work
+        assert all(r.state == Request.DONE for r in reqs_b)
+        assert any(r.state == Request.FAILED for r in reqs_a)
+
+    def test_watermarks_default_to_slot_fractions(self, model):
+        eng, _ = self._overloaded_engine(model, shed=True, n_slots=3)
+        assert eng.shed_policy.high_watermark == 3
+        assert eng.shed_policy.low_watermark == 1

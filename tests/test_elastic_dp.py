@@ -339,6 +339,154 @@ class TestCollective:
 
 
 # =====================================================================
+# deterministic kill-one-rank (tier-1): the SIGKILL replaced by an
+# injected `kill` at the elastic.rank.step seam — rank THREADS in one
+# process, each with its own thread-local FaultSchedule; heartbeats halt
+# and the thread dies abruptly, so survivors see the same TTL-expiry
+# liveness path as a real process kill. Replays bit-identically.
+# =====================================================================
+_W_STAR = np.arange(12.0).reshape(4, 3) / 10.0
+
+
+def _dp_grad_fn(params, step, rank, world):
+    rng = np.random.default_rng(100000 + 1000 * step + 10 * world + rank)
+    X = rng.standard_normal((8, 4))
+    E = X @ params["w"] + params["b"] - X @ _W_STAR
+    loss = float((E ** 2).mean())
+    return loss, {"w": 2 * X.T @ E / E.size,
+                  "b": 2 * E.sum(axis=0) / E.size}
+
+
+def _dp_init_params():
+    return {"w": np.zeros((4, 3)), "b": np.zeros((3,))}
+
+
+class TestInjectedRankLoss:
+    TOTAL = 6
+    KILL_STEP = 2
+
+    def _run_cohort(self, addr, job, ckpt, n_ranks, *, victim=None,
+                    schedule=None, resume_step=None, wait_world=None,
+                    ttl=1.2):
+        """Drive ``n_ranks`` ElasticDPTrainer threads over one KV server.
+        ``victim`` (rank-thread index) runs under ``schedule.scope()`` and
+        is expected to die of InjectedDeath. Returns (history, events)
+        per thread index."""
+        import contextlib
+
+        from paddle_tpu.distributed.fleet.elastic.manager import ElasticManager
+        from paddle_tpu.resilience import InjectedDeath
+        from paddle_tpu.resilience.elastic_trainer import ElasticDPTrainer
+
+        histories = {i: [] for i in range(n_ranks)}
+        events = {i: [] for i in range(n_ranks)}
+        errors = {}
+
+        def rank_fn(i):
+            st = _TcpStore(addr, job, ttl=ttl, retries=1)
+            mgr = ElasticManager(store=st)
+            mgr.endpoint = f"127.0.0.1:{7600 + i}"
+            mgr.node_id = f"node_{i}"
+            tr = ElasticDPTrainer(
+                mgr, ckpt, _dp_grad_fn, _dp_init_params, lr=0.3,
+                momentum=0.9, min_ranks=1, step_timeout=60,
+                rendezvous_timeout=60,
+                on_step=lambda s, w, l: histories[i].append(
+                    (s, w, np.float64(l).hex())),
+                on_event=events[i].append)
+            ctx = (schedule.scope() if schedule is not None and i == victim
+                   else contextlib.nullcontext())
+            try:
+                with ctx:
+                    tr.run(self.TOTAL, resume_step=resume_step,
+                           wait_world=wait_world or n_ranks)
+            except InjectedDeath:
+                events[i].append("DIED")
+                return  # abrupt: no tr.close(), no deregister
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors[i] = e
+                raise
+            tr.close()
+
+        threads = [threading.Thread(target=rank_fn, args=(i,), daemon=True)
+                   for i in range(n_ranks)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(240)
+            assert not t.is_alive(), "rank thread hung"
+        assert not errors, errors
+        return histories, events
+
+    def _kill_schedule(self):
+        from paddle_tpu.resilience import FaultSchedule
+
+        return FaultSchedule(seed=11).add(
+            "elastic.rank.step", "kill", match={"step": self.KILL_STEP})
+
+    def _injected_leg(self, tmp_path, tag):
+        srv = KVServer().start()
+        try:
+            sched = self._kill_schedule()
+            hist, events = self._run_cohort(
+                f"127.0.0.1:{srv.port}", f"job_{tag}",
+                str(tmp_path / f"ckpt_{tag}"), 3, victim=2,
+                schedule=sched)
+        finally:
+            srv.stop()
+        return hist, events, sched.fired_log()
+
+    def test_injected_rank_loss_resharded_recovery_bit_identical(
+            self, tmp_path):
+        """The tier-1 twin of the slow SIGKILL e2e, plus the replay
+        acceptance: two runs of the injected scenario produce the
+        identical fault sequence AND bit-identical trajectories, and the
+        post-recovery trajectory matches a fresh dp=2 run restored from
+        the same resharded snapshot."""
+        hist_a, events_a, log_a = self._injected_leg(tmp_path, "a")
+        hist_b, _, log_b = self._injected_leg(tmp_path, "b")
+
+        # replay certificate: same fault sequence, bit-identical histories
+        assert log_a == log_b == [
+            {"point": "elastic.rank.step", "kind": "kill", "count": 1,
+             "labels": {"rank": 2, "step": self.KILL_STEP,
+                        "node": "node_2"}}]
+        assert hist_a == hist_b
+
+        # survivors ran the full trajectory, identically; victim died
+        steps0 = {s: (w, l) for s, w, l in hist_a[0]}
+        assert sorted(steps0) == list(range(self.TOTAL))
+        assert hist_a[0] == hist_a[1]
+        assert "DIED" in events_a[2]
+        assert max(s for s, _, _ in hist_a[2]) < self.KILL_STEP
+
+        # exactly one recovery, resharded from the newest intact snapshot
+        recover = [e for e in events_a[0]
+                   if e.startswith("restore: snapshot")]
+        assert len(recover) == 1, events_a[0]
+        snap = int(recover[0].split("step=")[1].split()[0])
+        assert snap == self.KILL_STEP - 1  # the kill step never published
+        post = {s: v for s, v in steps0.items() if s > snap}
+        assert post and all(w == 2 for w, _ in post.values())
+        assert all(w == 3 for s, (w, _) in steps0.items() if s <= snap)
+
+        # fresh dp=2 arm restored from the SAME resharded snapshot
+        ckpt2 = str(tmp_path / "ckpt_fresh")
+        shutil.copytree(str(tmp_path / "ckpt_a"), ckpt2)
+        srv2 = KVServer().start()
+        try:
+            fresh_hist, _ = self._run_cohort(
+                f"127.0.0.1:{srv2.port}", "job_fresh", ckpt2, 2,
+                resume_step=snap, wait_world=2)
+        finally:
+            srv2.stop()
+        fsteps = {s: (w, l) for s, w, l in fresh_hist[0]}
+        assert fresh_hist[0] == fresh_hist[1]
+        # the acceptance criterion: bit-identical post-recovery trajectory
+        assert {s: v for s, v in fsteps.items() if s > snap} == post
+
+
+# =====================================================================
 # kill-one-rank e2e (CPU-multiprocess, slow tier)
 # =====================================================================
 _RANK_SCRIPT = textwrap.dedent("""

@@ -1,0 +1,525 @@
+"""Deterministic fault-injection plane (ISSUE 8 tentpole).
+
+Covers the schedule machinery (trigger counts, label matching, seeded
+randomization, thread-local scoping, the fired-log replay certificate) and
+each instrumented seam: elastic store message + RPC-attempt faults, the
+retry budget's fail-fast interplay, checkpoint torn/crash-after-temp
+writes, engine-tick faults contained by the serving loop, and router
+transport timeout/garbage faults.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic.manager import (
+    StoreUnavailable,
+    _TcpStore,
+)
+from paddle_tpu.distributed.fleet.utils.http_server import KVServer
+from paddle_tpu.framework.checkpoint import CheckpointManager
+from paddle_tpu.resilience.inject import (
+    FaultSchedule,
+    FaultSpec,
+    InjectedCrash,
+    InjectedDeath,
+    InjectedFault,
+    active_schedule,
+    fire,
+)
+from paddle_tpu.resilience.retry import (
+    RetryBudget,
+    RetryError,
+    call_with_retries,
+    set_default_budget,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_schedule():
+    yield
+    sched = active_schedule()
+    if sched is not None:
+        sched.disarm()
+
+
+@pytest.fixture()
+def kv():
+    srv = KVServer().start()
+    yield f"127.0.0.1:{srv.port}"
+    srv.stop()
+
+
+# =====================================================================
+# schedule machinery
+# =====================================================================
+class TestFaultSchedule:
+    def test_unarmed_fire_is_none(self):
+        assert fire("anything", foo=1) is None
+
+    def test_trigger_count_fires_exactly_once(self):
+        s = FaultSchedule().add("p", "drop", at=3)
+        with s:
+            assert fire("p") is None
+            assert fire("p") is None
+            assert fire("p").kind == "drop"
+            assert fire("p") is None
+        assert [f["count"] for f in s.fired_log()] == [3]
+
+    def test_multiple_trigger_counts(self):
+        s = FaultSchedule().add("p", "drop", at=(2, 4))
+        with s:
+            hits = [fire("p") is not None for _ in range(5)]
+        assert hits == [False, True, False, True, False]
+
+    def test_every_mode_with_max_fires(self):
+        s = FaultSchedule().add("p", "drop", every=2, max_fires=2)
+        with s:
+            hits = [fire("p") is not None for _ in range(8)]
+        assert hits == [False, True, False, True, False, False, False, False]
+
+    def test_label_match_counts_only_matching(self):
+        s = FaultSchedule().add("p", "drop", at=2, match={"node": "b"})
+        with s:
+            assert fire("p", node="a") is None  # does not count
+            assert fire("p", node="b") is None  # count 1
+            assert fire("p", node="a") is None
+            assert fire("p", node="b").kind == "drop"  # count 2
+        log = s.fired_log()
+        assert log == [{"point": "p", "kind": "drop", "count": 2,
+                        "labels": {"node": "b"}}]
+
+    def test_raise_kind_default_and_custom_exception(self):
+        s = (FaultSchedule()
+             .add("p", "raise", at=1)
+             .add("q", "raise", at=1, exception=OSError))
+        with s:
+            with pytest.raises(InjectedFault) as ei:
+                fire("p")
+            assert ei.value.point == "p" and ei.value.count == 1
+            with pytest.raises(OSError):
+                fire("q")
+
+    def test_timeout_kind_raises_socket_timeout(self):
+        import socket
+
+        s = FaultSchedule().add("p", "timeout", at=1)
+        with s:
+            with pytest.raises(socket.timeout):
+                fire("p")
+
+    def test_delay_sleeps_and_proceeds(self):
+        s = FaultSchedule().add("p", "delay", at=1, seconds=0.05)
+        with s:
+            t0 = time.perf_counter()
+            assert fire("p") is None
+            assert time.perf_counter() - t0 >= 0.04
+
+    def test_seeded_randomize_is_deterministic(self):
+        a = FaultSchedule(seed=42).randomize(["x", "y"], n=5,
+                                             kinds=("raise", "drop"))
+        b = FaultSchedule(seed=42).randomize(["x", "y"], n=5,
+                                             kinds=("raise", "drop"))
+        assert a.to_dict() == b.to_dict()
+        c = FaultSchedule(seed=43).randomize(["x", "y"], n=5,
+                                             kinds=("raise", "drop"))
+        assert a.to_dict() != c.to_dict()
+
+    def test_reset_allows_identical_replay(self):
+        s = FaultSchedule().add("p", "drop", at=2)
+
+        def run():
+            out = []
+            for _ in range(3):
+                out.append(fire("p") is not None)
+            return out
+
+        with s:
+            first = run()
+            log1 = s.fired_log()
+            s.reset()
+            second = run()
+            log2 = s.fired_log()
+        assert first == second
+        assert log1 == log2  # the replay certificate
+
+    def test_thread_scope_isolates_schedules(self):
+        """Two rank threads in one process each run their own chaos; the
+        main thread sees none of it."""
+        results = {}
+
+        def worker(name, sched):
+            with sched.scope():
+                hit = []
+                for _ in range(2):
+                    try:
+                        fire("p")
+                        hit.append(False)
+                    except InjectedFault:
+                        hit.append(True)
+                results[name] = hit
+
+        s1 = FaultSchedule().add("p", "raise", at=1)
+        s2 = FaultSchedule().add("p", "raise", at=2)
+        ts = [threading.Thread(target=worker, args=("a", s1)),
+              threading.Thread(target=worker, args=("b", s2))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert results == {"a": [True, False], "b": [False, True]}
+        assert fire("p") is None  # main thread: nothing armed
+
+    def test_thread_local_wins_over_global(self):
+        g = FaultSchedule().add("p", "raise", every=1)
+        local = FaultSchedule()  # empty: suppresses the global chaos
+        with g:
+            with local.scope():
+                assert fire("p") is None
+            with pytest.raises(InjectedFault):
+                fire("p")
+
+
+# =====================================================================
+# elastic store seams
+# =====================================================================
+class TestStoreSeams:
+    def test_kv_put_drop_loses_the_write(self, kv):
+        st = _TcpStore(kv, "dropjob", ttl=5.0, retries=0)
+        with FaultSchedule().add("elastic.store.kv.put", "drop", at=1):
+            st.put("k", "v1")       # dropped in flight
+            st.put("k", "v2")       # delivered
+        assert st.get("k") == "v2"
+
+    def test_kv_get_drop_reads_as_absence(self, kv):
+        st = _TcpStore(kv, "dropjob2", ttl=5.0, retries=0)
+        st.put("k", "v")
+        with FaultSchedule().add("elastic.store.kv.get", "drop", at=1):
+            assert st.get("k") is None
+            assert st.get("k") == "v"
+
+    def test_kv_scan_drop_reads_empty(self, kv):
+        st = _TcpStore(kv, "dropjob3", ttl=5.0, retries=0)
+        st.put("k", "v")
+        with FaultSchedule().add("elastic.store.kv.scan", "drop", at=1):
+            assert st.scan() == {}
+            assert "k" in st.scan()
+
+    def test_heartbeat_drop_skips_one_beat(self, kv):
+        st = _TcpStore(kv, "beatjob", ttl=0.6, retries=0)
+        st.register("n1", "ep1")
+        with FaultSchedule().add("elastic.store.heartbeat", "drop",
+                                 every=1):
+            # every beat dropped: the server-side stamp goes stale
+            deadline = time.monotonic() + 3.0
+            while st.nodes() and time.monotonic() < deadline:
+                st.heartbeat("n1")
+                time.sleep(0.1)
+        assert st.nodes() == []  # expired despite "beating"
+
+    def test_duplicate_put_is_idempotent_on_the_kv_plane(self, kv):
+        st = _TcpStore(kv, "dupjob", ttl=5.0, retries=0)
+        with FaultSchedule().add("elastic.store.kv.put", "duplicate", at=1):
+            st.put("k", "v")
+        assert st.get("k") == "v"
+
+    def test_rpc_attempt_fault_engages_retry_then_succeeds(self, kv):
+        """A transient attempt-level OSError is absorbed by the retry
+        layer — the operation still succeeds (the r7 self-healing
+        contract, now provable without a flaky store)."""
+        st = _TcpStore(kv, "rpcjob", ttl=5.0, retries=2)
+        with FaultSchedule().add("elastic.store.rpc.put", "raise", at=1,
+                                 exception=OSError) as s:
+            st.put("k", "v")
+        assert st.get("k") == "v"
+        assert len(s.fired_log()) == 1
+
+    def test_rpc_persistent_fault_exhausts_retries(self, kv):
+        st = _TcpStore(kv, "rpcjob2", ttl=5.0, retries=1)
+        with FaultSchedule().add("elastic.store.rpc.get", "raise",
+                                 every=1, exception=OSError):
+            with pytest.raises(StoreUnavailable):
+                st.get("k")
+
+    def test_rpc_default_fault_class_still_engages_retry(self, kv):
+        """An attempt-level fault with the DEFAULT exception class
+        (InjectedFault) must behave like a transport failure: retried,
+        then surfaced as StoreUnavailable — never escaping unwrapped
+        past the seam's contract."""
+        st = _TcpStore(kv, "rpcjob3", ttl=5.0, retries=1)
+        with FaultSchedule().add("elastic.store.rpc.get", "raise",
+                                 every=1) as s:
+            with pytest.raises(StoreUnavailable):
+                st.get("k")
+        assert len(s.fired_log()) == 2  # first attempt + 1 retry
+        # transient default-class fault: absorbed, op succeeds
+        st.put("k", "v")
+        with FaultSchedule().add("elastic.store.rpc.get", "raise", at=1):
+            assert st.get("k") == "v"
+
+
+# =====================================================================
+# retry budget (satellite)
+# =====================================================================
+class TestRetryBudget:
+    def test_budget_caps_total_retries(self):
+        budget = RetryBudget(max_retries=3, window_s=60.0)
+        calls = []
+
+        def failing():
+            calls.append(1)
+            raise OSError("down")
+
+        with pytest.raises(RetryError) as ei:
+            call_with_retries(failing, retries=10, base=0.001,
+                              budget=budget, sleep=lambda s: None)
+        assert ei.value.budget_exhausted
+        # 1 first attempt + 3 budgeted retries, NOT 11 attempts
+        assert len(calls) == 4
+        assert budget.exhausted_count == 1
+        assert budget.remaining() == 0
+
+    def test_first_attempts_are_free(self):
+        budget = RetryBudget(max_retries=1, window_s=60.0)
+        for _ in range(5):
+            assert call_with_retries(lambda: 7, retries=3,
+                                     budget=budget) == 7
+        assert budget.remaining() == 1  # healthy calls never charged
+
+    def test_window_replenishes(self):
+        budget = RetryBudget(max_retries=1, window_s=0.05)
+        assert budget.try_spend()
+        assert not budget.try_spend()
+        time.sleep(0.08)
+        assert budget.try_spend()
+
+    def test_exhausted_counter_exported(self):
+        from paddle_tpu.observability.metrics import default_registry
+
+        budget = RetryBudget(max_retries=0, window_s=60.0)
+        c = default_registry().get("retry_budget_exhausted_total")
+        before = c.value() if c is not None else 0.0
+        assert not budget.try_spend()
+        c = default_registry().get("retry_budget_exhausted_total")
+        assert c is not None and c.value() == before + 1
+
+    def test_default_budget_applies_and_restores(self):
+        budget = RetryBudget(max_retries=0, window_s=60.0)
+        prev = set_default_budget(budget)
+        try:
+            with pytest.raises(RetryError) as ei:
+                call_with_retries(lambda: (_ for _ in ()).throw(OSError()),
+                                  retries=4, sleep=lambda s: None)
+            assert ei.value.budget_exhausted
+        finally:
+            set_default_budget(prev)
+
+    def test_injected_persistent_store_fault_fails_fast(self, kv):
+        """The satellite acceptance: an injected every-attempt fault plus
+        the shared budget = bounded total attempts across OPERATIONS, not
+        unbounded per-op retry burn."""
+        st = _TcpStore(kv, "budgetjob", ttl=5.0, retries=3)
+        budget = RetryBudget(max_retries=2, window_s=60.0)
+        prev = set_default_budget(budget)
+        try:
+            with FaultSchedule().add("elastic.store.rpc.get", "raise",
+                                     every=1, exception=OSError) as s:
+                with pytest.raises(StoreUnavailable):
+                    st.get("k1")
+                with pytest.raises(StoreUnavailable):
+                    st.get("k2")  # budget already spent: fails fast
+            # op1: 1 first + 2 budgeted retries; op2: 1 first + 0 retries
+            assert len(s.fired_log()) == 4
+            assert budget.exhausted_count >= 1
+        finally:
+            set_default_budget(prev)
+
+
+# =====================================================================
+# checkpoint write seams
+# =====================================================================
+class TestCheckpointSeams:
+    STATE = {"params": {"w": np.arange(12.0).reshape(3, 4)}, "step": 0}
+
+    def test_torn_write_falls_back_to_newest_intact(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(0, dict(self.STATE, step=0))
+        with FaultSchedule().add("checkpoint.write", "torn",
+                                 match={"step": 1}):
+            mgr.save(1, dict(self.STATE, step=1))
+        assert mgr.all_steps() == [0, 1]
+        with pytest.warns(RuntimeWarning, match="falling back"):
+            state, _ = mgr.load()
+        assert state["step"] == 0  # step 1 is torn: CRC fallback took 0
+
+    def test_crash_after_temp_leaves_temp_never_publishes(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(0, dict(self.STATE, step=0))
+        with FaultSchedule().add("checkpoint.write", "crash_after_temp",
+                                 match={"step": 1}):
+            with pytest.raises(InjectedCrash):
+                mgr.save(1, dict(self.STATE, step=1))
+        # never published...
+        assert mgr.all_steps() == [0]
+        # ...but the temp dir survives like a real crash would leave it
+        tmps = [d for d in os.listdir(tmp_path) if d.startswith(".tmp_step_")]
+        assert len(tmps) == 1
+        state, _ = mgr.load()
+        assert state["step"] == 0
+        # a fresh manager's stale sweep cleans genuinely old temps
+        old = os.path.join(tmp_path, tmps[0])
+        past = time.time() - 7200
+        os.utime(old, (past, past))
+        CheckpointManager(str(tmp_path))
+        assert not any(d.startswith(".tmp_step_") for d in os.listdir(tmp_path))
+
+    def test_same_schedule_replays_identical_fault_log(self, tmp_path):
+        logs = []
+        for leg in ("a", "b"):
+            sched = FaultSchedule(seed=3).add(
+                "checkpoint.write", "torn", at=2)
+            mgr = CheckpointManager(str(tmp_path / leg))
+            with sched:
+                for s in range(3):
+                    mgr.save(s, dict(self.STATE, step=s))
+            logs.append(sched.fired_log())
+        assert logs[0] == logs[1]
+        assert logs[0] == [{"point": "checkpoint.write", "kind": "torn",
+                            "count": 2, "labels": {"step": 1}}]
+
+
+# =====================================================================
+# engine tick + transport seams
+# =====================================================================
+@pytest.fixture(scope="module")
+def model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+
+    paddle.seed(0)
+    cfg = gpt_config("gpt2-small", vocab_size=64, hidden_size=32,
+                     num_layers=1, num_attention_heads=2,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+def _prompt(n=4):
+    return np.arange(1, n + 1, dtype=np.int32)
+
+
+class TestEngineAndTransportSeams:
+    def test_injected_tick_fault_is_contained(self, model):
+        """Deterministic replay of the poison-tick suite: the Nth tick
+        raises, the loop thread survives, affected requests surface
+        FAILED, and later requests complete."""
+        import threading as th
+
+        from paddle_tpu.serving import ContinuousBatchingEngine, Request
+
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2)
+        stop = th.Event()
+        with FaultSchedule().add("engine.tick", "raise", at=1) as s:
+            t = th.Thread(target=eng.serve_forever, args=(stop,),
+                          daemon=True)
+            t.start()
+            req = eng.submit(_prompt(), max_new_tokens=4)
+            assert req.wait(timeout=60)
+            assert req.state == Request.FAILED
+            assert "InjectedFault" in req.error
+            # the loop survived: a fresh request completes
+            req2 = eng.submit(_prompt(), max_new_tokens=4)
+            assert req2.wait(timeout=60)
+            assert req2.state == Request.DONE
+            assert len(req2.tokens) == 4
+            stop.set()
+            t.join(30)
+            assert not t.is_alive()
+        assert [f["point"] for f in s.fired_log()] == ["engine.tick"]
+
+    def test_raise_at_replica_tick_is_contained_not_thread_death(
+            self, model):
+        """A raise-kind fault at replica.tick (not the kill kind) must be
+        contained like a tick failure — requests fail visibly and the
+        loop thread keeps serving, never a silently dead engine behind a
+        live HTTP plane."""
+        import threading as th
+
+        from paddle_tpu.serving import ContinuousBatchingEngine, Request
+
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2)
+        stop = th.Event()
+        with FaultSchedule().add("replica.tick", "raise", at=1):
+            t = th.Thread(target=eng.serve_forever, args=(stop,),
+                          daemon=True)
+            t.start()
+            req = eng.submit(_prompt(), max_new_tokens=4)
+            assert req.wait(timeout=60)
+            assert req.state == Request.FAILED
+            req2 = eng.submit(_prompt(), max_new_tokens=4)
+            assert req2.wait(timeout=60)
+            assert req2.state == Request.DONE
+            stop.set()
+            t.join(30)
+            assert not t.is_alive()
+
+    def test_transport_timeout_and_garbage_fault(self, model):
+        """Transport faults at the client seam: an injected timeout is an
+        OSError (the retry/breaker classes treat it as a dead socket); a
+        garbage body lets the request REACH the server — the engine has
+        the request even though the caller saw garbage (the lost-202
+        shape submit() must never retry through)."""
+        import socket
+
+        from paddle_tpu.resilience.retry import RetryError
+        from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                        ServingClient, ServingServer)
+
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2)
+        with ServingServer(eng) as srv:
+            c = ServingClient(srv.addr, retries=0)
+            with FaultSchedule().add("router.transport", "timeout", at=1):
+                # retries=0: the single attempt dies on the injected
+                # socket.timeout and surfaces through the retry wrapper
+                with pytest.raises(RetryError) as ei:
+                    c.metrics()
+                assert isinstance(ei.value.last, socket.timeout)
+            # a timeout injected with retry headroom is absorbed: the
+            # second attempt goes through
+            c2 = ServingClient(srv.addr, retries=2)
+            with FaultSchedule().add("router.transport", "timeout", at=1):
+                assert "requests" in c2.metrics()
+            before = eng.metrics.requests_submitted
+            with FaultSchedule().add(
+                    "router.transport", "garbage", at=1,
+                    match={"path": "/v1/generate"}):
+                with pytest.raises(ValueError):
+                    c.submit(_prompt().tolist(), max_new_tokens=2)
+            assert eng.metrics.requests_submitted == before + 1
+
+    def test_router_survives_injected_poll_timeout_on_live_replica(
+            self, model):
+        """One injected poll timeout against a HEALTHY replica must not
+        trigger failover — the confirming probe sees it alive (the
+        deterministic form of the GIL-held-jit false-death scenario)."""
+        from paddle_tpu.serving import (ContinuousBatchingEngine, Request,
+                                        ServingRouter, ServingServer)
+
+        eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2)
+        with ServingServer(eng) as srv:
+            with ServingRouter([srv.addr], health_interval_s=5.0,
+                               request_timeout=5.0) as router:
+                router.check_health()
+                rr = router.submit(_prompt(), max_new_tokens=6)
+                with FaultSchedule().add(
+                        "router.transport", "timeout", at=1,
+                        match={"path": f"/v1/result/{rr.remote_id}"}):
+                    out = router.wait(rr, timeout=60)
+                assert out["status"] == Request.DONE
+                assert rr.resubmits == 0  # never failed over
+                snap = router.snapshot()
+                assert snap["replicas"][srv.addr]["state"] == "closed"

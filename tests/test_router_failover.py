@@ -479,6 +479,94 @@ class TestReplicaKill:
 
 
 # =====================================================================
+# deterministic replica kill (tier-1): the process SIGKILL replaced by an
+# injected `kill` at the replica.tick seam — the engine loop tears the
+# whole replica down (HTTP plane severed, no drain) at an exact
+# productive-tick count, so the failover scenario replays identically
+# =====================================================================
+class TestInjectedReplicaKill:
+    def _run_scenario(self, model):
+        """One full injected-failover pass; returns (fired_log,
+        failover_tokens, runner_state, victim_addr, survivor_tokens)."""
+        from paddle_tpu.resilience import FaultSchedule
+
+        servers = {s.addr: s for s in (_server(model, n_slots=1),
+                                       _server(model, n_slots=1))}
+        addrs = list(servers)
+        try:
+            with ServingRouter(addrs, health_interval_s=0.1,
+                               cooldown_s=30.0, request_timeout=5.0) as router:
+                router.check_health()
+                # place 1 running + 1 queued on a victim (deterministic:
+                # least-loaded off identical gauges is insertion-ordered)
+                placed = {a: [] for a in addrs}
+                rrs = []
+                for _ in range(3):
+                    rr = router.submit(_prompt(), max_new_tokens=14)
+                    rrs.append(rr)
+                    placed[rr.replica_addr].append(rr)
+                victim = next(a for a, v in placed.items() if len(v) == 2)
+                running, queued = placed[victim]
+                other = next(r for r in rrs if r not in (running, queued))
+                # observe tokens from the RUNNING one so the router knows
+                # its generation started (resubmit ineligible — the
+                # in-flight-failure half of the scenario)
+                deadline = time.perf_counter() + 30
+                while not running.tokens:
+                    router.poll(running)
+                    assert time.perf_counter() < deadline
+                    time.sleep(0.01)
+                # arm AFTER placement so earlier ticks don't advance the
+                # trigger count; the victim dies at its 3rd productive
+                # tick from now
+                sched = FaultSchedule(seed=5).add(
+                    "replica.tick", "kill", at=3,
+                    match={"replica": victim})
+                with sched:
+                    out_q = router.wait(queued, timeout=120)
+                    out_r = router.wait(running, timeout=120)
+                    router.wait(other, timeout=120)
+                assert out_q["status"] == Request.DONE, queued.error
+                assert queued.replica_addr != victim
+                assert queued.resubmits == 1
+                assert out_r["status"] == Request.FAILED
+                assert other.state == Request.DONE
+                # normalize the ephemeral victim address out of the log:
+                # the replay certificate is (point, kind, count, WHICH
+                # replica by position), not which OS port it got
+                log = sched.fired_log()
+                for entry in log:
+                    if entry["labels"].get("replica") == victim:
+                        entry["labels"]["replica"] = "victim"
+                return (log, list(queued.tokens),
+                        running.state, addrs.index(victim),
+                        list(other.tokens))
+        finally:
+            for s in servers.values():
+                try:
+                    s.kill()
+                except Exception:
+                    pass
+
+    def test_injected_replica_kill_token_identical_replay(self, model):
+        """Tier-1 twin of the SIGKILL-a-replica chaos test PLUS the
+        replay acceptance: the queued request (zero observed tokens)
+        re-homes and completes on the survivor, the in-flight one
+        surfaces FAILED, and two runs of the same schedule produce the
+        identical fault sequence and a token-identical failover
+        transcript."""
+        run_a = self._run_scenario(model)
+        run_b = self._run_scenario(model)
+        assert run_a == run_b  # fault log + transcripts, bit for bit
+        log, failover_tokens, runner_state, _, other_tokens = run_a
+        assert log == [{"point": "replica.tick", "kind": "kill",
+                        "count": 3, "labels": {"replica": "victim"}}]
+        assert len(failover_tokens) == 14  # nothing dropped or truncated
+        assert len(other_tokens) == 14
+        assert runner_state == Request.FAILED
+
+
+# =====================================================================
 # multiprocess chaos (slow tier): SIGKILL a replica PROCESS mid-stream
 # =====================================================================
 _REPLICA_SCRIPT = textwrap.dedent("""
