@@ -364,6 +364,9 @@ def _observability_overhead(on_tpu):
         "observability_serving_overhead_frac": round(sfrac, 4),
         "observability_serving_overhead_ok": bool(sfrac < 0.02),
         "observability_flight_schema_version": obs.FLIGHT_SCHEMA_VERSION,
+        # r14: the serving latency histograms carry exemplars now, so the
+        # <2% overhead booleans above are measured WITH exemplars enabled
+        "observability_exemplars_enabled": True,
     })
     return out
 
@@ -982,13 +985,45 @@ def main():
             secondary["overload_shed_arm"] = f"failed: {type(e).__name__}"
         metric = "gpt_tiny_train_tokens_per_sec_chip"
 
-    print(json.dumps({
+    payload = {
         "metric": metric,
         "value": round(tput, 2),
         "unit": "tokens/s",
         "vs_baseline": round(mfu(tput, n_params, cfg, seq) / 0.40, 4),
         "secondary": secondary,
-    }))
+    }
+    try:
+        # bench regression watchdog (ISSUE 9): trailing self-check of this
+        # round's numbers against the committed lineage baseline — the
+        # same compare `python -m paddle_tpu.observability bench-diff`
+        # gates CI with. Self-referential by design: the verdict rides in
+        # the payload AFTER comparison, so it never compares itself.
+        # TPU arm only: the lineage is measured on-chip, and the CPU
+        # smoke arm shares metric names (vs_baseline) whose values are
+        # not comparable across arms.
+        import os
+
+        from paddle_tpu.observability.baseline import compare, load_baseline
+
+        bl_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "benchmarks", "bench_baseline.json")
+        if not on_tpu:
+            secondary["bench_diff"] = "skipped (CPU arm; lineage is on-chip)"
+        elif not os.path.exists(bl_path):
+            # a round that never ran its self-check must say so — an
+            # absent key would be indistinguishable from pre-r14 rounds
+            secondary["bench_diff"] = "skipped (no bench_baseline.json)"
+        else:
+            verdict = compare(payload, load_baseline(bl_path))
+            secondary["bench_diff"] = {
+                "ok": verdict["ok"],
+                "compared": verdict["compared"],
+                "regressions": [r["describe"]
+                                for r in verdict["regressions"]],
+            }
+    except Exception as e:  # pragma: no cover - must not void the round
+        secondary["bench_diff"] = f"failed: {type(e).__name__}"
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

@@ -42,8 +42,11 @@ from .graph import COLLECTIVE_PRIMS, _axes_of
 __all__ = [
     "EqnCost",
     "GraphCost",
+    "ScopeCost",
     "cost_eqn",
     "graph_cost",
+    "scope_costs",
+    "execution_multiplier",
     "classify_intensity",
     "TRANSCENDENTAL_FLOPS",
     "DEFAULT_RIDGE_FLOPS_PER_BYTE",
@@ -280,7 +283,7 @@ _SCAN_AT = re.compile(r"^scan@(\d+)$")
 _ESTIMATED_AT = re.compile(r"^(while|cond)@(\d+)$")
 
 
-def _multiplier(graph, path) -> Tuple[float, bool]:
+def execution_multiplier(graph, path) -> Tuple[float, bool]:
     """Execution count of a node from its enclosing scans ('scan@IDX' path
     elements carry the trip count in the container node's params); while
     loops (unknown trip count, multiplier 1) and cond branches (BOTH
@@ -295,6 +298,9 @@ def _multiplier(graph, path) -> Tuple[float, bool]:
         if _ESTIMATED_AT.match(part):
             estimated = True
     return mult, estimated
+
+
+_multiplier = execution_multiplier  # r10 internal name, kept for callers
 
 
 def graph_cost(graph, mesh_axes: Optional[Dict[str, int]] = None) -> GraphCost:
@@ -322,3 +328,81 @@ def graph_cost(graph, mesh_axes: Optional[Dict[str, int]] = None) -> GraphCost:
         agg["flops"] += c.flops * mult
         agg["bytes"] += c.bytes_accessed * mult
     return total
+
+
+@dataclasses.dataclass
+class ScopeCost:
+    """Aggregated roofline cost of one profiler-scope path (r14).
+
+    One row of the scope-attribution table: every non-container eqn whose
+    normalized ``name_stack`` (:func:`~.graph.scope_components`) equals
+    ``scope`` contributes its :func:`cost_eqn`, scaled by the same scan
+    trip-count multipliers :func:`graph_cost` applies — so the rows sum to
+    the whole-graph totals EXACTLY (the reconciliation invariant the perf
+    doctor pins)."""
+
+    scope: Tuple[str, ...]
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    comm_bytes: float = 0.0
+    n_eqns: int = 0
+    estimated: bool = False
+    by_prim: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return "/".join(self.scope) if self.scope else "(unscoped)"
+
+    @property
+    def intensity(self) -> float:
+        b = self.bytes_accessed
+        return self.flops / b if b else 0.0
+
+    def bound(self, ridge: float = DEFAULT_RIDGE_FLOPS_PER_BYTE) -> str:
+        return classify_intensity(self.intensity, ridge)
+
+    @property
+    def dominant_prim(self) -> Optional[str]:
+        """The primitive contributing the most flops in this scope (falls
+        back to most bytes for flop-free scopes) — lets a report say 'this
+        scope is a dot_general scope' without the reader re-deriving it."""
+        if not self.by_prim:
+            return None
+        return max(self.by_prim.items(),
+                   key=lambda kv: (kv[1]["flops"], kv[1]["bytes"]))[0]
+
+
+def scope_costs(graph, mesh_axes: Optional[Dict[str, int]] = None,
+                ) -> Dict[Tuple[str, ...], ScopeCost]:
+    """Slice the graph's roofline cost by profiler scope (r6 ``scope``/
+    ``annotate`` names surviving in eqn ``name_stack`` metadata): scope
+    path → :class:`ScopeCost`. Eqns outside any scope land under the
+    ``()`` key (reported as ``(unscoped)``); containers are skipped and
+    scan bodies scaled exactly as :func:`graph_cost` does, so summing the
+    returned rows reproduces its totals."""
+    from .graph import scope_components
+
+    out: Dict[Tuple[str, ...], ScopeCost] = {}
+    for node in graph.nodes:
+        c = cost_eqn(node.prim, node.in_avals, node.out_avals, node.params,
+                     mesh_axes)
+        if c.container:
+            continue
+        mult, est = execution_multiplier(graph, node.path)
+        key = scope_components(node.name_stack)
+        sc = out.get(key)
+        if sc is None:
+            sc = out[key] = ScopeCost(scope=key)
+        sc.flops += c.flops * mult
+        sc.bytes_accessed += c.bytes_accessed * mult
+        sc.comm_bytes += c.comm_bytes * mult
+        sc.n_eqns += 1
+        if est or c.estimated:
+            sc.estimated = True
+        agg = sc.by_prim.setdefault(
+            node.prim, {"count": 0, "flops": 0.0, "bytes": 0.0})
+        agg["count"] += 1
+        agg["flops"] += c.flops * mult
+        agg["bytes"] += c.bytes_accessed * mult
+    return out

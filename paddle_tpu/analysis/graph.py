@@ -32,6 +32,7 @@ Three IR front doors:
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Any, Callable, Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 import jax
@@ -54,6 +55,7 @@ __all__ = [
     "AnalysisTarget",
     "build_graph",
     "target_from_program",
+    "scope_components",
     "COLLECTIVE_PRIMS",
     "UNIFORMIZING_PRIMS",
 ]
@@ -71,6 +73,39 @@ CALLBACK_PRIMS = frozenset({
     "pure_callback", "io_callback", "debug_callback", "outside_call",
     "host_callback_call",
 })
+
+
+# jax transform wrappers that decorate name-stack components: the scope
+# NAME is what attribution groups by, so `transpose(jvp(gpt.attn))` (the
+# backward pass of the gpt.attn region) must collapse to `gpt.attn`
+_NAME_STACK_WRAPPERS = (
+    "jvp", "transpose", "vmap", "pmap", "remat", "checkpoint", "rematted",
+    "custom_jvp", "custom_vjp", "vjp",
+)
+_WRAP_RE = re.compile(
+    r"^(?:%s)\((.*)\)$" % "|".join(_NAME_STACK_WRAPPERS))
+
+
+def scope_components(name_stack: str) -> Tuple[str, ...]:
+    """Normalize an eqn's rendered ``name_stack`` into the profiler-scope
+    path it belongs to: strip transform wrappers (``jvp(x)`` /
+    ``transpose(jvp(x))`` → ``x``) and drop re-entries of an enclosing
+    scope (``trainer.loss_grad/transpose(trainer.loss_grad)/jvp(gpt.attn)``
+    → ``('trainer.loss_grad', 'gpt.attn')``), so the forward and backward
+    halves of one :func:`profiler.scope` region land in the SAME row of
+    the scope-attribution table."""
+    out: List[str] = []
+    for comp in (name_stack or "").split("/"):
+        comp = comp.strip()
+        while True:
+            m = _WRAP_RE.match(comp)
+            if m is None:
+                break
+            comp = m.group(1)
+        if not comp or comp in out:
+            continue
+        out.append(comp)
+    return tuple(out)
 
 
 def _axes_of(params: dict) -> Tuple[str, ...]:

@@ -106,6 +106,7 @@ class TraceGuard:
         self.calls = 0
         self._sigs: List[Tuple] = []
         self._compiles = 0
+        self._polled = False
 
     # -- cache probe ----------------------------------------------------
     def _cache_size(self) -> Optional[int]:
@@ -116,6 +117,22 @@ class TraceGuard:
             return int(probe())
         except Exception:
             return None
+
+    def poll(self) -> bool:
+        """Cache-miss probe WITHOUT routing a call through the guard — for
+        observers of a jit they do not dispatch themselves (the r12
+        ``TrainerTelemetry`` wraps ``trainer.step``, which calls the jit
+        internally). Returns True when the underlying jit compiled at
+        least one new program since the last ``poll``/``__call__``; the
+        first poll absorbs the current cache size (priming is not a miss).
+        Always False for plain callables without a cache probe."""
+        size = self._cache_size()
+        if size is None:
+            return False
+        missed = self._polled and size > self._compiles
+        self._polled = True
+        self._compiles = max(self._compiles, size)
+        return missed
 
     def __call__(self, *args, **kwargs):
         sig = signature_of(args, kwargs)
@@ -144,6 +161,7 @@ class TraceGuard:
         self._sigs.clear()
         self.calls = 0
         self._compiles = 0
+        self._polled = False
 
     # -- reporting ------------------------------------------------------
     def findings(self) -> List[Finding]:

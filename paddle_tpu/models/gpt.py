@@ -430,8 +430,18 @@ class GPTDecoderLayer(Layer):
         self._recompute_granularity = config.recompute_granularity
 
     def _block(self, x):
-        x = x + self.dropout1(self.attn(self.ln_1(x)))
-        x = x + self.dropout2(self.mlp(self.ln_2(x)))
+        # profiler scopes (r6): pure HLO-metadata names inside a trace —
+        # they compile away, but the perf doctor's scope-attribution table
+        # (observability/perf.py) slices roofline cost by them, so the
+        # attention and FFN matmuls are nameable Pallas targets
+        from ..profiler.scope import scope
+
+        with scope("gpt.attn"):
+            a = self.attn(self.ln_1(x))
+        x = x + self.dropout1(a)
+        with scope("gpt.mlp"):
+            m = self.mlp(self.ln_2(x))
+        x = x + self.dropout2(m)
         return x
 
     def forward(self, x):
@@ -473,6 +483,12 @@ class GPTEmbeddings(Layer):
         self.sequence_parallel = config.sequence_parallel
 
     def forward(self, input_ids, position_ids=None):
+        from ..profiler.scope import scope
+
+        with scope("gpt.embed"):
+            return self._embed(input_ids, position_ids)
+
+    def _embed(self, input_ids, position_ids=None):
         if not self.use_wpe:
             return self.dropout(self.word_embeddings(input_ids))
         t = input_ids.shape[-1]
@@ -620,13 +636,15 @@ class GPTForPretraining(Layer):
         x = self.gpt(input_ids, position_ids)
         w = self.gpt.embeddings.word_embeddings.weight  # [V, H], vocab on 'mp'
         from ..ops._primitive import primitive
+        from ..profiler.scope import scope
         import jax.numpy as jnp
 
         @primitive
         def _logits(h, w):
             return jnp.matmul(h, w.T)
 
-        return _logits(x, w)
+        with scope("gpt.lm_head"):
+            return _logits(x, w)
 
     def aux_loss(self):
         return self.gpt.aux_loss()
@@ -640,6 +658,9 @@ class GPTPretrainingCriterion(Layer):
         self.ce = ParallelCrossEntropy(ignore_index=-100)
 
     def forward(self, logits, labels):
+        from ..profiler.scope import scope
+
         # logits [B, T, V]; labels [B, T] — shift happens in data prep
-        loss = self.ce(logits, labels)  # [B, T, 1]
-        return loss.mean()
+        with scope("gpt.loss"):
+            loss = self.ce(logits, labels)  # [B, T, 1]
+            return loss.mean()

@@ -16,12 +16,20 @@ probes):
   to a versioned JSON snapshot on sentinel halt, SIGTERM, engine tick
   failure, and router-confirmed replica death;
 * :mod:`.merge` — ``python -m paddle_tpu.observability merge`` stitches
-  multi-process dumps into one timeline by trace ID.
+  multi-process dumps into one timeline by trace ID;
+* :mod:`.perf` — the perf doctor (r14): scope-level roofline attribution
+  fusing the r6 scopes, r10 cost model, and measured wall time into the
+  ranked MFU-gap table (``python -m paddle_tpu.observability perf``);
+* :mod:`.baseline` — bench regression watchdog (r14): BENCH_* lineage →
+  per-metric noise-banded baselines → ``bench-diff`` CI gate.
 
 Parity: ``paddle.profiler`` / VisualDL timelines / monitor StatValue
 series / the platform profiler from PAPER.md's L0 row (PARITY.md maps the
 rows).
 """
+from .baseline import compare as bench_compare
+from .baseline import load_baseline
+from .baseline import rebuild as rebuild_baseline
 from .flight import (
     FLIGHT_SCHEMA_VERSION,
     FlightRecorder,
@@ -36,9 +44,18 @@ from .metrics import (
     MetricsHTTPServer,
     MetricsRegistry,
     default_registry,
+    dump_metrics,
     log_buckets,
     start_http_exporter,
+    wants_openmetrics,
     wants_prometheus,
+)
+from .perf import (
+    PERF_SCHEMA_VERSION,
+    PerfAttribution,
+    attribute,
+    build_perf_report,
+    device_peak_hbm_bw,
 )
 from .trace import (
     PARENT_HEADER,
@@ -81,10 +98,20 @@ __all__ = [
     "log_buckets",
     "start_http_exporter",
     "wants_prometheus",
+    "wants_openmetrics",
+    "dump_metrics",
     "TrainerTelemetry",
     "device_peak_flops_bf16",
     "FLIGHT_SCHEMA_VERSION",
     "FlightRecorder",
     "flight_recorder",
     "configure_flight",
+    "PERF_SCHEMA_VERSION",
+    "PerfAttribution",
+    "attribute",
+    "build_perf_report",
+    "device_peak_hbm_bw",
+    "bench_compare",
+    "load_baseline",
+    "rebuild_baseline",
 ]

@@ -1,18 +1,73 @@
-"""CLI: ``python -m paddle_tpu.observability merge ...``.
+"""CLI: ``python -m paddle_tpu.observability <cmd> ...``.
 
 Subcommands:
 
 * ``merge -o OUT [--trace-id ID] DUMP [DUMP ...]`` — stitch per-process
-  trace/flight dumps into one chrome-trace JSON (open in
-  ``ui.perfetto.dev`` or ``chrome://tracing``).
+  trace/flight/metric dumps into one chrome-trace JSON (open in
+  ``ui.perfetto.dev`` or ``chrome://tracing``); histogram exemplars
+  render as instant events linking buckets to trace ids.
+* ``perf [-o benchmarks/perf_attribution.json]`` — run the trainer step
+  and the warmed serving decode on this host and write the scope-level
+  roofline attribution artifact (the Pallas target list, ISSUE 9).
+* ``bench-diff BENCH_new.json [--baseline PATH]`` — compare one bench
+  payload against the committed lineage baseline; exit 1 naming every
+  regressed metric (CI gate).
+* ``baseline --rebuild [FILES...]`` — regenerate
+  ``benchmarks/bench_baseline.json`` from the BENCH_* lineage.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from .merge import merge_files
+
+
+def _add_merge(sub):
+    m = sub.add_parser(
+        "merge", help="stitch per-process dumps into one chrome-trace")
+    m.add_argument("dumps", nargs="+",
+                   help="trace/flight/metrics dump JSON files")
+    m.add_argument("-o", "--out", default=None,
+                   help="output path (default: stdout)")
+    m.add_argument("--trace-id", default=None,
+                   help="keep only spans/exemplars of this trace id")
+
+
+def _add_perf(sub):
+    p = sub.add_parser(
+        "perf", help="scope-level roofline attribution of the hot paths")
+    p.add_argument("-o", "--out", default=None,
+                   help="artifact path (default: "
+                        "<repo>/benchmarks/perf_attribution.json)")
+    p.add_argument("--steps", type=int, default=8,
+                   help="timed trainer steps (default 8)")
+    p.add_argument("--ticks", type=int, default=16,
+                   help="timed decode ticks (default 16)")
+    p.add_argument("--top", type=int, default=5,
+                   help="ranked rows to print per entry (default 5)")
+
+
+def _add_bench_diff(sub):
+    d = sub.add_parser(
+        "bench-diff",
+        help="gate one bench payload against the lineage baseline")
+    d.add_argument("payload", help="bench JSON (BENCH_rXX.json or raw)")
+    d.add_argument("--baseline", default=None,
+                   help="baseline path (default: "
+                        "benchmarks/bench_baseline.json)")
+    d.add_argument("--json", action="store_true",
+                   help="print the full verdict as JSON")
+
+
+def _add_baseline(sub):
+    b = sub.add_parser(
+        "baseline", help="rebuild the bench baseline from the lineage")
+    b.add_argument("--rebuild", action="store_true")
+    b.add_argument("files", nargs="*")
+    b.add_argument("-o", "--out", default=None)
 
 
 def main(argv=None) -> int:
@@ -20,13 +75,10 @@ def main(argv=None) -> int:
         prog="python -m paddle_tpu.observability",
         description="telemetry-plane tooling")
     sub = parser.add_subparsers(dest="cmd", required=True)
-    m = sub.add_parser(
-        "merge", help="stitch per-process dumps into one chrome-trace")
-    m.add_argument("dumps", nargs="+", help="trace/flight dump JSON files")
-    m.add_argument("-o", "--out", default=None,
-                   help="output path (default: stdout)")
-    m.add_argument("--trace-id", default=None,
-                   help="keep only spans of this trace id")
+    _add_merge(sub)
+    _add_perf(sub)
+    _add_bench_diff(sub)
+    _add_baseline(sub)
     args = parser.parse_args(argv)
 
     if args.cmd == "merge":
@@ -41,9 +93,62 @@ def main(argv=None) -> int:
             print()
         else:
             meta = doc.get("metadata", {})
-            print(f"wrote {args.out}: {meta.get('n_spans')} spans from "
+            print(f"wrote {args.out}: {meta.get('n_spans')} spans + "
+                  f"{meta.get('n_exemplars', 0)} exemplars from "
                   f"{meta.get('merged_dumps')} dump(s)")
         return 0
+
+    if args.cmd == "perf":
+        from .baseline import _repo_root
+        from .perf import build_perf_report
+
+        out = args.out or os.path.join(_repo_root(), "benchmarks",
+                                       "perf_attribution.json")
+        doc = build_perf_report(out_path=out, steps=args.steps,
+                                ticks=args.ticks)
+        for name, entry in doc["entries"].items():
+            rec = entry["reconciliation"]
+            print(f"{name}: measured {entry['measured_total_s']:.6f}s, "
+                  f"roofline floor {entry['roofline_total_s']:.6f}s, "
+                  f"mfu {entry['mfu']}, reconciliation "
+                  f"{'OK' if rec['ok'] else 'FAILED'}")
+            for row in entry["rows"][:max(args.top, 0)]:
+                print(f"  {row['scope']:45s} measured {row['measured_s']:.6f}s"
+                      f" roofline {row['roofline_min_s']:.2e}s "
+                      f"[{row['bound']}, {row['dominant_prim']}]")
+        print(f"wrote {out}")
+        return 0 if all(e["reconciliation"]["ok"]
+                        for e in doc["entries"].values()) else 1
+
+    if args.cmd == "bench-diff":
+        from .baseline import compare, load_baseline
+
+        try:
+            with open(args.payload) as f:
+                payload = json.load(f)
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        verdict = compare(payload, baseline)
+        if args.json:
+            json.dump(verdict, sys.stdout, indent=1)
+            print()
+        if verdict["ok"]:
+            print(f"bench-diff OK: {verdict['compared']} metrics within "
+                  f"band", file=sys.stderr)
+            return 0
+        for r in verdict["regressions"]:
+            print(f"REGRESSION {r['describe']}", file=sys.stderr)
+        return 1
+
+    if args.cmd == "baseline":
+        from .baseline import main as baseline_main
+
+        argv2 = (["--rebuild"] if args.rebuild else []) + list(args.files)
+        if args.out:
+            argv2 += ["-o", args.out]
+        return baseline_main(argv2)
     return 2
 
 

@@ -1,0 +1,334 @@
+"""Bench regression watchdog: BENCH_* lineage → baselines → bench-diff.
+
+The ``BENCH_r01..r05`` trajectory (and every future round artifact) is a
+machine-readable record of what this repo could do on real hardware — but
+until r14 it was curated by hand: nothing CHECKED that a PR regressed
+``pipeline_step_ratio`` or serving TTFT. This module closes the loop
+(VisualDL's run-over-run comparison, done natively):
+
+* :func:`rebuild` parses the committed ``BENCH_*.json`` lineage into
+  per-metric baselines: median over the observed samples plus a noise
+  band — ``median ± tolerance`` per metric class, WIDENED to cover the
+  observed lineage spread (every historical payload passes its own
+  baseline by construction; only genuinely-worse-than-ever results gate).
+* :func:`compare` diffs one new bench payload against the baseline and
+  names every primary/secondary metric that regressed beyond its band.
+* ``python -m paddle_tpu.observability bench-diff BENCH_new.json`` exits 1
+  on any regression (CI-runnable); ``bench.py`` runs the same compare as a
+  trailing self-check and reports it in the round artifact.
+
+Metric classes (by name pattern, first match wins):
+
+* ``higher`` — throughput-like (tokens/sec, speedup, MFU, goodput,
+  pipeline ratio): regress = new below the band floor.
+* ``lower`` — latency-like (TTFT, overhead, recovery): regress = new
+  above the band ceiling.
+* ``magnitude`` — signed zero-is-ideal metrics (drift fractions, est-vs-
+  measured deltas): banded on ``abs(value)``, so an improvement TOWARD
+  zero from a negative lineage never gates.
+* ``count_max`` — must-stay-zero-ish counters (silent drops, dropped
+  requests): regress = new exceeds the lineage maximum.
+* ``flag`` — booleans (``*_ok``, ``*_within_3x``): regress = was always
+  true in the lineage, now false.
+* ``info`` — tracked for the record, never gates (configs, wall times of
+  box-dependent tooling, byte counts).
+"""
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "BASELINE_SCHEMA_VERSION",
+    "DEFAULT_TOLERANCES",
+    "flatten_payload",
+    "classify_metric",
+    "rebuild",
+    "load_baseline",
+    "compare",
+    "default_bench_glob",
+    "default_baseline_path",
+    "main",
+]
+
+BASELINE_SCHEMA_VERSION = 1
+
+#: per-class relative noise tolerance around the lineage median
+DEFAULT_TOLERANCES = {"higher": 0.10, "lower": 0.35}
+#: extra pad past the observed lineage extreme (measurement noise floor)
+LINEAGE_PAD = 0.05
+
+_HIGHER = ("tokens_per_sec", "speedup", "mfu", "goodput", "vs_baseline",
+           "attributed_fraction", "pipeline_step_ratio", "_hits",
+           "efficiency")
+_LOWER = ("overhead", "ttft", "latency", "_ms", "recovery_s",
+          "step_seconds", "gap_s")
+# signed, zero-is-ideal: banded on |value| (a negative-lineage drift must
+# not flag a later PERFECT 0.0 as "above the band ceiling")
+_MAGNITUDE = ("drift", "est_vs_measured")
+_COUNT_MAX = ("silent_drops", "dropped_requests", "inflight_failures",
+              "admitted_killed")
+
+
+def classify_metric(name: str, value) -> str:
+    if isinstance(value, bool):
+        return "flag"
+    if not isinstance(value, (int, float)):
+        return "info"
+    for pat in _COUNT_MAX:
+        if pat in name:
+            return "count_max"
+    for pat in _MAGNITUDE:
+        if pat in name:
+            return "magnitude"
+    for pat in _HIGHER:
+        if pat in name:
+            return "higher"
+    for pat in _LOWER:
+        if pat in name:
+            return "lower"
+    return "info"
+
+
+def _parsed(doc: dict) -> dict:
+    """Accept a raw bench payload OR the round-artifact wrapper that the
+    BENCH_rXX.json files use ({"parsed": {...}, "tail": ...})."""
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        return doc["parsed"]
+    return doc
+
+
+def flatten_payload(doc: dict) -> Dict[str, object]:
+    """One flat {metric: value} view of a bench payload: the primary
+    metric under its own name, ``vs_baseline``, and every numeric/boolean
+    secondary (nested dicts dotted)."""
+    p = _parsed(doc)
+    flat: Dict[str, object] = {}
+    if "metric" in p and isinstance(p.get("value"), (int, float)):
+        flat[str(p["metric"])] = p["value"]
+    if isinstance(p.get("vs_baseline"), (int, float)):
+        flat["vs_baseline"] = p["vs_baseline"]
+
+    def rec(prefix: str, d: dict):
+        for k, v in d.items():
+            if isinstance(v, dict):
+                rec(f"{prefix}{k}.", v)
+            elif isinstance(v, (bool, int, float)):
+                flat[f"{prefix}{k}"] = v
+
+    sec = p.get("secondary")
+    if isinstance(sec, dict):
+        rec("", sec)
+    return flat
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_bench_glob() -> List[str]:
+    return sorted(_glob.glob(os.path.join(_repo_root(), "BENCH_*.json")))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(_repo_root(), "benchmarks", "bench_baseline.json")
+
+
+def rebuild(paths: Optional[Sequence[str]] = None,
+            tolerances: Optional[Dict[str, float]] = None,
+            out_path: Optional[str] = None) -> dict:
+    """Parse the BENCH lineage into the versioned baseline document."""
+    paths = list(paths) if paths else default_bench_glob()
+    if not paths:
+        raise ValueError("no BENCH_*.json lineage files found")
+    tol = dict(DEFAULT_TOLERANCES)
+    tol.update(tolerances or {})
+    samples: Dict[str, List] = {}
+    primaries = set()
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        p = _parsed(doc)
+        if "metric" in p:
+            primaries.add(str(p["metric"]))
+        for name, value in flatten_payload(doc).items():
+            samples.setdefault(name, []).append(value)
+    metrics = {}
+    for name, values in sorted(samples.items()):
+        cls = classify_metric(name, values[0])
+        entry = {"class": cls, "n": len(values), "values": values,
+                 "primary": name in primaries}
+        if cls == "flag":
+            entry["expect_true"] = all(bool(v) for v in values)
+        elif cls == "count_max":
+            entry["max"] = max(float(v) for v in values)
+        elif cls == "magnitude":
+            # banded on |value| with the lower-class tolerance: only a
+            # magnitude GROWTH past the band gates; sign and direction
+            # toward zero are always improvements
+            vs = sorted(abs(float(v)) for v in values)
+            median = vs[len(vs) // 2]
+            entry["median"] = median
+            entry["tolerance"] = tol["lower"]
+            entry["band_hi"] = max(median * (1 + tol["lower"]),
+                                   vs[-1] * (1 + LINEAGE_PAD))
+        elif cls in ("higher", "lower"):
+            vs = sorted(float(v) for v in values)
+            median = vs[len(vs) // 2]
+            entry["median"] = median
+            entry["tolerance"] = tol[cls]
+            # sign-aware widening: subtract/add |v|*frac instead of
+            # multiplying (a negative extreme times 1+pad moves the bound
+            # the WRONG way — e.g. a drift lineage of [-0.05, -0.01]
+            # would band its own best sample out)
+            if cls == "higher":
+                # band floor: median - tol, widened past the worst sample
+                # so the lineage itself always passes
+                entry["band_lo"] = min(
+                    median - abs(median) * tol[cls],
+                    vs[0] - abs(vs[0]) * LINEAGE_PAD)
+            else:
+                entry["band_hi"] = max(
+                    median + abs(median) * tol[cls],
+                    vs[-1] + abs(vs[-1]) * LINEAGE_PAD)
+        metrics[name] = entry
+    doc = {
+        "schema_version": BASELINE_SCHEMA_VERSION,
+        "generated_by": "paddle_tpu.observability.baseline --rebuild",
+        "source_files": [os.path.basename(p) for p in paths],
+        "tolerances": tol,
+        "lineage_pad": LINEAGE_PAD,
+        "metrics": metrics,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+    return doc
+
+
+def load_baseline(path: Optional[str] = None) -> dict:
+    with open(path or default_baseline_path()) as f:
+        doc = json.load(f)
+    if doc.get("schema_version") != BASELINE_SCHEMA_VERSION:
+        raise ValueError(
+            f"unsupported baseline schema {doc.get('schema_version')!r} "
+            f"(want {BASELINE_SCHEMA_VERSION})")
+    return doc
+
+
+@dataclasses.dataclass
+class Regression:
+    metric: str
+    cls: str
+    value: float
+    bound: float
+    median: Optional[float] = None
+    primary: bool = False
+
+    def describe(self) -> str:
+        arrow = {"higher": "<", "lower": ">", "count_max": ">",
+                 "magnitude": "|value| >", "flag": "!="}.get(self.cls, "?")
+        tag = "PRIMARY " if self.primary else ""
+        med = f" (lineage median {self.median:g})" if self.median else ""
+        return (f"{tag}{self.metric}: {self.value:g} {arrow} "
+                f"band {self.bound:g}{med}")
+
+
+def compare(payload: dict, baseline: dict) -> dict:
+    """Diff one bench payload against the baseline. Returns a JSON-ready
+    verdict: regressed metrics (most severe first: primaries lead),
+    how many metrics were compared, and which baseline metrics the
+    payload no longer reports (informational — a renamed metric must not
+    silently drop out of the watchdog)."""
+    flat = flatten_payload(payload)
+    metrics = baseline.get("metrics", {})
+    regressions: List[Regression] = []
+    compared = 0
+    type_changed: List[str] = []
+    for name, entry in metrics.items():
+        if name not in flat:
+            continue
+        value = flat[name]
+        cls = entry.get("class", "info")
+        if cls == "info":
+            continue
+        primary = bool(entry.get("primary"))
+        if cls == "flag":
+            compared += 1
+            if entry.get("expect_true") and not bool(value):
+                regressions.append(Regression(
+                    metric=name, cls=cls, value=float(bool(value)),
+                    bound=1.0, primary=primary))
+            continue
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            # a metric whose TYPE changed is NOT compared — surface it
+            # with the missing ones rather than counting it as checked
+            type_changed.append(name)
+            continue
+        compared += 1
+        value = float(value)
+        if cls == "count_max" and value > entry["max"]:
+            regressions.append(Regression(
+                metric=name, cls=cls, value=value, bound=entry["max"],
+                primary=primary))
+        elif cls == "magnitude" and abs(value) > entry["band_hi"]:
+            regressions.append(Regression(
+                metric=name, cls=cls, value=value, bound=entry["band_hi"],
+                median=entry.get("median"), primary=primary))
+        elif cls == "higher" and value < entry["band_lo"]:
+            regressions.append(Regression(
+                metric=name, cls=cls, value=value, bound=entry["band_lo"],
+                median=entry.get("median"), primary=primary))
+        elif cls == "lower" and value > entry["band_hi"]:
+            regressions.append(Regression(
+                metric=name, cls=cls, value=value, bound=entry["band_hi"],
+                median=entry.get("median"), primary=primary))
+    regressions.sort(key=lambda r: (not r.primary, r.metric))
+    missing = sorted(set(
+        n for n, e in metrics.items()
+        if e.get("class") != "info" and n not in flat) | set(type_changed))
+    return {
+        "ok": not regressions,
+        "compared": compared,
+        "regressions": [dataclasses.asdict(r) | {"describe": r.describe()}
+                        for r in regressions],
+        "missing_metrics": missing,
+    }
+
+
+# ---------------------------------------------------------------------------
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python -m paddle_tpu.observability.baseline --rebuild [FILES...]``
+    (also mounted as the ``baseline`` / ``bench-diff`` subcommands of
+    ``python -m paddle_tpu.observability``)."""
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.baseline",
+        description="bench lineage baselines")
+    parser.add_argument("--rebuild", action="store_true",
+                        help="regenerate the baseline from the lineage")
+    parser.add_argument("files", nargs="*",
+                        help="BENCH_*.json lineage (default: repo root)")
+    parser.add_argument("-o", "--out", default=None,
+                        help=f"output path (default: "
+                             f"{default_baseline_path()})")
+    args = parser.parse_args(argv)
+    if not args.rebuild:
+        parser.error("nothing to do (pass --rebuild)")
+    out = args.out or default_baseline_path()
+    doc = rebuild(args.files or None, out_path=out)
+    print(f"wrote {out}: {len(doc['metrics'])} metrics from "
+          f"{len(doc['source_files'])} lineage files", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -125,10 +125,13 @@ class FlightRecorder:
                 notes = dict(self._notes)
                 self._seq += 1
                 seq = self._seq
-            metrics = {"process": default_registry().to_dict()}
+            # exemplars included: flight dumps feed the merge CLI, which
+            # renders each bucket's last trace_id beside the span tree
+            metrics = {"process": default_registry().to_dict(
+                include_exemplars=True)}
             for name, reg in sorted(_EXTRA_REGISTRIES.items()):
                 try:
-                    metrics[name] = reg.to_dict()
+                    metrics[name] = reg.to_dict(include_exemplars=True)
                 except Exception:
                     metrics[name] = "failed"
             doc = {

@@ -70,6 +70,10 @@ class TrainerTelemetry:
         self.predicted_resident_bytes: Optional[int] = None
         self._last_return: Optional[float] = None
         self._steps = 0
+        self._guard = None          # TraceGuard on the priced jit step
+        self._priced_shapes = None  # (x.shape, y.shape) the flops price
+        self.reprices = 0
+        self.reprice_errors = 0
         r = self.registry
         self._g_mfu = r.gauge(
             "train_mfu", "model flops utilization (cost-model flops / "
@@ -92,6 +96,9 @@ class TrainerTelemetry:
             "train_hbm_drift_frac",
             "live census / predicted steady-state residency - 1",
             ("trainer",))
+        self._c_reprices = r.counter(
+            "train_telemetry_reprices_total",
+            "MFU re-pricings after an observed step recompile", ("trainer",))
 
     # -- static side (once) --------------------------------------------
     def prime(self, x, y) -> "TrainerTelemetry":
@@ -111,6 +118,7 @@ class TrainerTelemetry:
             tr._build()
         xb = x._data if isinstance(x, Tensor) else jnp.asarray(x)
         yb = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        self._priced_shapes = (tuple(xb.shape), tuple(yb.shape))
         lr = jnp.asarray(float(tr.optimizer.get_lr()), jnp.float32)
         args = (tr.params, tr.opt_state, tr.buffers, xb, yb, split_key(),
                 tr.scale_state, tr.sentinel_state, lr)
@@ -124,6 +132,15 @@ class TrainerTelemetry:
         self.predicted_peak_bytes = int(est.peak_bytes)
         self.predicted_resident_bytes = int(est.resident_bytes)
         self._g_hbm_pred.set(self.predicted_peak_bytes, trainer=self.name)
+        # arm the recompile hook: the r9 TraceGuard's cache probe tells us
+        # when the jit compiles a NEW program (reshaped batch, rebuild) —
+        # the priced flops would silently go stale otherwise (r14 fix)
+        from ..analysis.traceguard import TraceGuard
+
+        if self._guard is None or self._guard._fn is not tr._jit_step:
+            self._guard = TraceGuard(tr._jit_step,
+                                     name=f"telemetry_{self.name}")
+        self._guard.poll()  # absorb the current cache size — not a miss
         return self
 
     # -- hot path -------------------------------------------------------
@@ -131,7 +148,14 @@ class TrainerTelemetry:
         """``trainer.step`` with step-time + MFU observation. Wall time is
         measured return-to-return: with async dispatch the host is back-
         pressured by the device queue, so the steady-state gap IS the
-        device step time (the first gap is dispatch-only and skipped)."""
+        device step time (the first gap is dispatch-only and skipped).
+
+        Recompile invalidation (r14): after every step the r9 TraceGuard
+        cache probe is polled; when the jit compiled a new program (a
+        reshaped batch re-traces), the step is RE-PRICED with this batch's
+        shapes instead of reporting MFU against stale flops, and the
+        recompiled step's wall time (trace + compile, not execution) is
+        excluded from the step histogram."""
         t0 = time.perf_counter()
         loss = self.trainer.step(x, y)
         now = time.perf_counter()
@@ -139,10 +163,50 @@ class TrainerTelemetry:
         self._last_return = now
         self._steps += 1
         self._c_steps.inc(trainer=self.name)
+        recompiled = self._poll_recompile(x, y)
         dt = now - (prev if prev is not None and prev > t0 - 120.0 else t0)
-        if self._steps > 1:  # first observation is compile + dispatch
+        if self._steps > 1 and not recompiled:
             self.observe_step(dt)
+        if recompiled:
+            # the reprice itself (re-trace + liveness estimate) ran AFTER
+            # `now` was stamped — re-stamp so the NEXT step's
+            # return-to-return gap doesn't absorb the pricing wall time
+            self._last_return = time.perf_counter()
         return loss
+
+    def _poll_recompile(self, x, y) -> bool:
+        """True when the observed jit step compiled a new program this
+        call. Re-prices when the compile changes the priced shapes (a
+        reshaped batch); the PRIMING compile itself — the first executed
+        step, whose shapes the price already covers — only skips the
+        timing observation (trace + compile wall time is not a step)."""
+        fn = getattr(self.trainer, "_jit_step", None)
+        if fn is None or self._guard is None:
+            return False
+        rebuilt = self._guard._fn is not fn
+        if not rebuilt and not self._guard.poll():
+            return False
+        shapes = (tuple(getattr(x, "shape", ())),
+                  tuple(getattr(y, "shape", ())))
+        if rebuilt or shapes != self._priced_shapes:
+            try:
+                self.prime(x, y)
+                self.reprices += 1
+                self._c_reprices.inc(trainer=self.name)
+            except Exception:  # pricing must never break the train loop
+                self.reprice_errors += 1
+                # re-arm the probe on the CURRENT jit even though pricing
+                # failed: without this, a rebuilt trainer whose pricing
+                # raises would re-run the full-trace prime on EVERY step
+                # and suppress step observation forever — stale-but-live
+                # gauges plus one counted error beat a retry storm
+                from ..analysis.traceguard import TraceGuard
+
+                if self._guard._fn is not fn:
+                    self._guard = TraceGuard(fn,
+                                             name=f"telemetry_{self.name}")
+                self._guard.poll()
+        return True
 
     def observe_step(self, seconds: float):
         """Record one measured step time and refresh the MFU gauge (use
@@ -188,4 +252,6 @@ class TrainerTelemetry:
             "hbm_predicted_resident_bytes": self.predicted_resident_bytes,
             "hbm_live_bytes": self._g_hbm_live.value(trainer=self.name),
             "hbm_drift_frac": self._g_hbm_drift.value(trainer=self.name),
+            "reprices": self.reprices,
+            "reprice_errors": self.reprice_errors,
         }

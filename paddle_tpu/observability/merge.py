@@ -3,11 +3,15 @@
 ``python -m paddle_tpu.observability merge -o out.json a.json b.json ...``
 
 Inputs are the versioned JSON dumps this package writes — trace dumps
-(:func:`.trace.dump_trace`) AND flight-recorder dumps (both carry a
-``spans`` list + ``pid``/``process``). Spans ride wall-clock timestamps,
-so records from a router process and its replica processes line up on the
-shared clock; ``--trace-id`` filters to one request's spans across every
-process (the "where did this request spend its time" view).
+(:func:`.trace.dump_trace`), flight-recorder dumps (``spans`` +
+``metrics``), and metric dumps (:func:`.metrics.dump_metrics`). Spans ride
+wall-clock timestamps, so records from a router process and its replica
+processes line up on the shared clock; exemplar-bearing histograms (r14)
+render each bucket's last exemplar as an instant event carrying its
+``trace_id``, so a p99 TTFT bucket points INTO the span tree next to it.
+``--trace-id`` filters both spans and exemplars to one request across
+every process. A dump with neither ``spans`` nor ``metrics`` is an error
+(never silently skipped).
 """
 from __future__ import annotations
 
@@ -16,26 +20,84 @@ from typing import Dict, List, Optional, Sequence
 
 from .trace import to_chrome_trace
 
-__all__ = ["load_dump", "merge_dumps", "merge_files"]
+__all__ = ["load_dump", "merge_dumps", "merge_files", "exemplar_events"]
 
 
 def load_dump(path: str) -> dict:
     with open(path) as f:
         doc = json.load(f)
-    if not isinstance(doc, dict) or "spans" not in doc:
-        raise ValueError(f"{path}: not a paddle_tpu trace/flight dump "
-                         f"(no 'spans' list)")
+    if not isinstance(doc, dict) or (
+            "spans" not in doc and "metrics" not in doc):
+        raise ValueError(f"{path}: not a paddle_tpu trace/flight/metrics "
+                         f"dump (no 'spans' or 'metrics' section)")
     return doc
+
+
+def _iter_metric_sections(doc: dict):
+    """Metric registries in a dump: a metrics dump has ONE under
+    ``metrics``; a flight dump may carry several labelled sections."""
+    m = doc.get("metrics")
+    if not isinstance(m, dict):
+        return
+    # either {metric_name: {type, values}} directly, or {section: {...}}
+    if all(isinstance(v, dict) and "type" in v for v in m.values()):
+        yield "", m
+        return
+    for section, series in m.items():
+        if isinstance(series, dict):
+            yield str(section), series
+
+
+def exemplar_events(doc: dict, pid: int,
+                    trace_id: Optional[str] = None) -> List[dict]:
+    """Chrome-trace instant events for every histogram exemplar in a
+    metric dump — each links a bucket (``le``) to the last ``trace_id``
+    observed into it."""
+    events: List[dict] = []
+    for section, series in _iter_metric_sections(doc):
+        for mname, m in series.items():
+            if not isinstance(m, dict) or m.get("type") != "histogram":
+                continue
+            values = m.get("values")
+            if not isinstance(values, dict):
+                continue
+            # unlabelled histograms carry exemplars at top level;
+            # labelled ones nest one dict per label set
+            sets = ([("", values)] if "exemplars" in values or "count"
+                    in values else list(values.items()))
+            for labelstr, v in sets:
+                for le, ex in (v.get("exemplars") or {}).items():
+                    if trace_id is not None and \
+                            ex.get("trace_id") != trace_id:
+                        continue
+                    name = f"{mname}_bucket[le={le}]"
+                    if section:
+                        name = f"{section}/{name}"
+                    events.append({
+                        "name": name,
+                        "ph": "i",
+                        "s": "p",
+                        "ts": float(ex.get("ts", 0.0)) * 1e6,
+                        "pid": pid,
+                        "tid": 0,
+                        "args": {"trace_id": ex.get("trace_id"),
+                                 "value": ex.get("value"),
+                                 "labels": labelstr},
+                    })
+    return events
 
 
 def merge_dumps(dumps: Sequence[dict],
                 trace_id: Optional[str] = None) -> dict:
     """One chrome-trace document from several process dumps. Span pids
     default to the dump's pid (older spans carry their own); process
-    names become chrome metadata so tracks are labelled."""
+    names become chrome metadata so tracks are labelled; histogram
+    exemplars become instant events on their process's track."""
     spans: List[dict] = []
     process_names: Dict[int, str] = {}
     n_dropped = 0
+    extra_events: List[dict] = []
+    n_exemplars = 0
     for doc in dumps:
         pid = int(doc.get("pid", 0))
         name = str(doc.get("process", "") or f"pid-{pid}")
@@ -47,10 +109,17 @@ def merge_dumps(dumps: Sequence[dict],
             if trace_id is not None and d.get("trace_id") != trace_id:
                 continue
             spans.append(d)
+        ex = exemplar_events(doc, pid, trace_id=trace_id)
+        n_exemplars += len(ex)
+        extra_events.extend(ex)
     out = to_chrome_trace(spans, process_names=process_names)
+    if extra_events:
+        out["traceEvents"] = out["traceEvents"] + sorted(
+            extra_events, key=lambda e: e["ts"])
     out["metadata"] = {
         "merged_dumps": len(dumps),
         "n_spans": len(spans),
+        "n_exemplars": n_exemplars,
         "dropped_spans_total": n_dropped,
         "trace_id_filter": trace_id,
     }

@@ -24,8 +24,10 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -37,7 +39,11 @@ __all__ = [
     "default_registry",
     "log_buckets",
     "prometheus_content_type",
+    "openmetrics_content_type",
     "wants_prometheus",
+    "wants_openmetrics",
+    "dump_metrics",
+    "METRICS_DUMP_SCHEMA_VERSION",
     "MetricsHTTPServer",
     "start_http_exporter",
 ]
@@ -47,10 +53,31 @@ _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
 #: the Prometheus text-format content type served on a negotiated scrape
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+#: the OpenMetrics content type (exemplar-bearing exposition, r14)
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+#: version of the JSON metric-dump layout (:func:`dump_metrics`)
+METRICS_DUMP_SCHEMA_VERSION = 1
 
 
 def prometheus_content_type() -> str:
     return PROMETHEUS_CONTENT_TYPE
+
+
+def openmetrics_content_type() -> str:
+    return OPENMETRICS_CONTENT_TYPE
+
+
+def wants_openmetrics(accept: Optional[str]) -> bool:
+    """True when the client explicitly negotiates the OpenMetrics
+    exposition (``Accept: application/openmetrics-text``) — the ONLY way
+    to receive exemplar syntax. Handlers check this BEFORE
+    :func:`wants_prometheus` (which accepts any text-ish Accept), so the
+    0.0.4 body stays byte-identical for every pre-r14 client."""
+    if not accept:
+        return False
+    return "application/openmetrics-text" in accept.lower()
 
 
 def wants_prometheus(accept: Optional[str]) -> bool:
@@ -209,22 +236,43 @@ class Histogram(_Metric):
     bucket catches the tail. Percentiles interpolate linearly inside the
     selected bucket (0 as the floor of the first), which is the usual
     Prometheus ``histogram_quantile`` estimate — good to a bucket width.
+
+    Exemplars (r14, opt-in via ``exemplars=True``): each observation that
+    carries a trace id (explicit ``trace_id=`` or inherited from the
+    ambient :func:`~.trace.current_trace` context) is remembered as the
+    bucket's LAST exemplar — bounded at one per bucket per label set, so
+    a p99 TTFT bucket always links to a real trace the merge CLI can
+    pull. Exemplars surface ONLY in the OpenMetrics exposition
+    (``# {trace_id="..."} value ts`` suffix) and in :func:`dump_metrics`;
+    the Prometheus 0.0.4 text and the JSON snapshot are byte-identical
+    with exemplars on or off.
     """
 
     kind = "histogram"
 
-    def __init__(self, name, help, labelnames=(), buckets=None):
+    def __init__(self, name, help, labelnames=(), buckets=None,
+                 exemplars: bool = False):
         super().__init__(name, help, labelnames)
         bs = sorted(float(b) for b in (buckets or log_buckets()))
         if not bs:
             raise ValueError("need at least one bucket")
         self.buckets = bs
+        self.exemplars_enabled = bool(exemplars)
         self._counts: Dict[Tuple, List[int]] = {}   # per-bucket + +Inf
         self._sum: Dict[Tuple, float] = {}
+        # label set -> bucket index -> (trace_id, value, unix ts)
+        self._exemplars: Dict[Tuple, Dict[int, Tuple[str, float, float]]] = {}
 
-    def observe(self, value: float, **labels):
+    def observe(self, value: float, trace_id: Optional[str] = None,
+                **labels):
         k = self._key(labels)
         v = float(value)
+        if self.exemplars_enabled and trace_id is None:
+            from .trace import current_trace
+
+            ctx = current_trace()
+            if ctx is not None:
+                trace_id = ctx[0]
         with self._lock:
             counts = self._counts.setdefault(k, [0] * (len(self.buckets) + 1))
             for i, b in enumerate(self.buckets):
@@ -232,8 +280,22 @@ class Histogram(_Metric):
                     counts[i] += 1
                     break
             else:
+                i = len(self.buckets)
                 counts[-1] += 1
             self._sum[k] = self._sum.get(k, 0.0) + v
+            if self.exemplars_enabled and trace_id:
+                self._exemplars.setdefault(k, {})[i] = (
+                    str(trace_id), v, time.time())
+
+    def exemplars(self, **labels) -> Dict[str, dict]:
+        """{le: {"trace_id", "value", "ts"}} for one label set — the
+        bucket→last-trace join the merge CLI renders."""
+        k = self._key(labels)
+        with self._lock:
+            ex = dict(self._exemplars.get(k, {}))
+        les = [_fmt(b) for b in self.buckets] + ["+Inf"]
+        return {les[i]: {"trace_id": t, "value": v, "ts": ts}
+                for i, (t, v, ts) in sorted(ex.items())}
 
     def count(self, **labels) -> int:
         with self._lock:
@@ -281,19 +343,50 @@ class Histogram(_Metric):
             out.append((self.name + "_count", k, cum))
         return out
 
-    def _to_dict(self):
+    def _samples_om(self):
+        """(name, labels, value, exemplar) rows for the OpenMetrics
+        exposition — same series as :meth:`_samples`, with each bucket's
+        last exemplar attached where one was captured."""
+        out = []
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sum)
+            exs = {k: dict(v) for k, v in self._exemplars.items()}
+        for k, counts in items:
+            ex = exs.get(k, {})
+            cum = 0
+            for i, (b, c) in enumerate(zip(self.buckets, counts)):
+                cum += c
+                out.append((self.name + "_bucket",
+                            k + (("le", _fmt(b)),), cum, ex.get(i)))
+            cum += counts[-1]
+            out.append((self.name + "_bucket", k + (("le", "+Inf"),), cum,
+                        ex.get(len(self.buckets))))
+            out.append((self.name + "_sum", k, sums.get(k, 0.0), None))
+            out.append((self.name + "_count", k, cum, None))
+        return out
+
+    def _to_dict(self, include_exemplars: bool = False):
+        # exemplars ride ONLY when explicitly asked for (dump_metrics /
+        # flight dumps): the default JSON snapshot stays byte-identical
+        # with exemplars on or off — the same contract as the 0.0.4 text
         def one(k):
             with self._lock:
                 counts = list(self._counts.get(k, ()))
                 s = self._sum.get(k, 0.0)
             n = sum(counts)
-            return {
+            out = {
                 "count": n,
                 "sum": s,
                 "p50": self.percentile(50, **dict(k)),
                 "p95": self.percentile(95, **dict(k)),
                 "p99": self.percentile(99, **dict(k)),
             }
+            if include_exemplars and self.exemplars_enabled:
+                ex = self.exemplars(**dict(k))
+                if ex:
+                    out["exemplars"] = ex
+            return out
         with self._lock:
             keys = sorted(self._counts)
         if not self.labelnames:
@@ -331,9 +424,10 @@ class MetricsRegistry:
 
     def histogram(self, name: str, help: str = "",
                   labelnames: Sequence[str] = (),
-                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+                  buckets: Optional[Sequence[float]] = None,
+                  exemplars: bool = False) -> Histogram:
         return self._get_or_create(Histogram, name, help, labelnames,
-                                   buckets=buckets)
+                                   buckets=buckets, exemplars=exemplars)
 
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:
@@ -359,11 +453,58 @@ class MetricsRegistry:
                 lines.append(f"{name}{_label_str(labels)} {_fmt(value)}")
         return "\n".join(lines) + ("\n" if lines else "")
 
-    def to_dict(self) -> dict:
+    def openmetrics_text(self) -> str:
+        """OpenMetrics 1.0 exposition of every registered series — the
+        ONLY exposition that carries exemplars. Counter families follow
+        the spec (``# TYPE x counter`` + ``x_total`` samples); histogram
+        ``_bucket`` lines append ``# {trace_id="..."} value ts`` where an
+        exemplar was captured; the body ends with ``# EOF``. Served only
+        under ``Accept: application/openmetrics-text`` so the 0.0.4 text
+        (:meth:`prometheus_text`) stays byte-identical for old scrapers.
+        """
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            if m.kind == "histogram":
+                samples = [(n, l, v, e) for n, l, v, e in m._samples_om()]
+            else:
+                samples = [(n, l, v, None) for n, l, v in m._samples()]
+            if not samples:
+                continue
+            family = m.name
+            if m.kind == "counter" and family.endswith("_total"):
+                family = family[: -len("_total")]
+            lines.append(f"# TYPE {family} {m.kind}")
+            if m.help:
+                lines.append(f"# HELP {family} {_escape_help(m.help)}")
+            for name, labels, value, ex in samples:
+                if m.kind == "counter" and not name.endswith("_total"):
+                    name += "_total"
+                line = f"{name}{_label_str(labels)} {_fmt(value)}"
+                if ex is not None:
+                    trace_id, exv, exts = ex
+                    line += (f' # {{trace_id="{_escape_label(trace_id)}"}} '
+                             f"{_fmt(exv)} {exts:.3f}")
+                lines.append(line)
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+    def to_dict(self, include_exemplars: bool = False) -> dict:
+        """JSON snapshot of every series. Byte-identical with exemplars
+        on or off by default (the pre-r14 consumer contract — this body
+        is what the training exporter serves as JSON);
+        ``include_exemplars=True`` adds each exemplar-enabled histogram's
+        bucket exemplars (used by :func:`dump_metrics` and the flight
+        recorder, whose dumps feed the merge CLI)."""
         with self._lock:
             metrics = list(self._metrics.values())
         return {m.name: {"type": m.kind, "help": m.help,
-                         "values": m._to_dict()} for m in metrics}
+                         "values": (m._to_dict(include_exemplars=True)
+                                    if include_exemplars
+                                    and isinstance(m, Histogram)
+                                    else m._to_dict())}
+                for m in metrics}
 
 
 _default = MetricsRegistry()
@@ -383,7 +524,8 @@ class MetricsHTTPServer:
 
     def __init__(self, json_fn: Callable[[], dict],
                  prom_fn: Callable[[], str], host: str = "127.0.0.1",
-                 port: int = 0, default_prometheus: bool = False):
+                 port: int = 0, default_prometheus: bool = False,
+                 om_fn: Optional[Callable[[], str]] = None):
 
         class _Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
@@ -399,10 +541,15 @@ class MetricsHTTPServer:
                     self.wfile.write(body)
                     return
                 accept = self.headers.get("Accept")
-                prom = wants_prometheus(accept) or (
-                    default_prometheus
-                    and "json" not in (accept or "").lower())
-                if prom:
+                # OpenMetrics wins when explicitly negotiated (the only
+                # exposition carrying exemplars); 0.0.4 and JSON bodies
+                # stay byte-compatible for every pre-r14 consumer
+                if om_fn is not None and wants_openmetrics(accept):
+                    body = om_fn().encode()
+                    ctype = OPENMETRICS_CONTENT_TYPE
+                elif wants_prometheus(accept) or (
+                        default_prometheus
+                        and "json" not in (accept or "").lower()):
                     body = prom_fn().encode()
                     ctype = PROMETHEUS_CONTENT_TYPE
                 else:
@@ -449,5 +596,28 @@ def start_http_exporter(registry: Optional[MetricsRegistry] = None,
     reg = registry or _default
     return MetricsHTTPServer(json_fn=reg.to_dict,
                              prom_fn=reg.prometheus_text,
+                             om_fn=reg.openmetrics_text,
                              host=host, port=port,
                              default_prometheus=True).start()
+
+
+def dump_metrics(registry: Optional[MetricsRegistry] = None,
+                 path: Optional[str] = None,
+                 process: Optional[str] = None) -> dict:
+    """Versioned JSON dump of a registry's series (exemplars included for
+    exemplar-enabled histograms) — the metric-side sibling of
+    :func:`~.trace.dump_trace`; ``python -m paddle_tpu.observability
+    merge`` accepts these alongside span dumps and renders each exemplar
+    as an instant event linking to its trace."""
+    reg = registry or _default
+    doc = {
+        "schema_version": METRICS_DUMP_SCHEMA_VERSION,
+        "process": process or f"pid-{os.getpid()}",
+        "pid": os.getpid(),
+        "wall_time": time.time(),
+        "metrics": reg.to_dict(include_exemplars=True),
+    }
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+    return doc

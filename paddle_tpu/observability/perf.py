@@ -1,0 +1,526 @@
+"""Perf doctor: scope-level roofline attribution (ISSUE 9 tentpole).
+
+The r12 telemetry plane answers *that* a step took 12 ms and the r10 cost
+model answers *how much work* the whole program does; neither says WHICH
+region eats the MFU gap. This module fuses three earlier layers into one
+attribution table:
+
+* the r6 ``profiler.scope`` names embedded in eqn ``name_stack`` metadata
+  (normalized by :func:`analysis.graph.scope_components` so forward and
+  backward halves of a region share one row),
+* the r10 per-eqn roofline cost model, sliced per scope by
+  :func:`analysis.cost.scope_costs`,
+* measured wall time — host spans from the r6 :class:`TimerRegistry` /
+  r12 trace ring where a scope is host-visible, the measured whole-step
+  time apportioned by roofline share where it is not (in-graph scopes
+  execute inside one compiled program; the device does not expose their
+  individual times, so apportioned rows are explicitly tagged
+  ``measured_source`` and never pretend to be direct measurements).
+
+Per scope the report carries: measured time, roofline-minimum time
+(``max(flops/peak_flops, bytes/peak_bw)``), efficiency (roofline / measured
+— the scope's share of the achievable), a memory- vs compute-bound verdict,
+and the dominant primitive. Ranked by absolute MFU-gap seconds, the table
+is the canonical target list for the planned Pallas-kernel round (ROADMAP
+item 2): the top rows name exactly the scopes a fused kernel must move.
+
+``python -m paddle_tpu.observability perf`` runs the trainer step and the
+warmed serving decode tick on this host and writes
+``benchmarks/perf_attribution.json`` (``schema_version`` 1). The
+scope-summed flops/bytes reconcile with the whole-graph
+:func:`~paddle_tpu.analysis.cost.graph_cost` totals exactly (pinned within
+1% by the acceptance test — same walk, same multipliers).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "PERF_SCHEMA_VERSION",
+    "device_peak_hbm_bw",
+    "ScopeRow",
+    "PerfAttribution",
+    "attribute",
+    "measured_from_timers",
+    "measured_from_ring",
+    "build_perf_report",
+]
+
+#: version of the ``perf_attribution.json`` layout
+PERF_SCHEMA_VERSION = 1
+
+#: peak HBM bandwidth (bytes/s) per chip by device generation — the
+#: roofline's memory leg (same table family as the bf16 flops in .gauges)
+_PEAK_HBM_BW = {
+    "v6e": 1.64e12, "v6": 1.64e12,
+    "v5e": 8.19e11, "v5litepod": 8.19e11, "v5 lite": 8.19e11,
+    "v5p": 2.765e12,
+    "v4": 1.2288e12,
+    "v3": 9.0e11,
+    "v2": 7.0e11,
+}
+
+
+def device_peak_hbm_bw(device=None) -> float:
+    """Peak HBM bytes/s of ``device`` (default: jax.devices()[0]); assumes
+    v5e-class when unknown — the CPU arm's convention, matching
+    :func:`~.gauges.device_peak_flops_bf16` so CPU-arm efficiencies are
+    populated (comparable round-over-round) rather than meaningful."""
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK_HBM_BW.items():
+        if key in kind:
+            return val
+    return 8.19e11
+
+
+@dataclasses.dataclass
+class ScopeRow:
+    """One ranked row of the attribution table (JSON-ready via to_dict)."""
+
+    scope: str
+    flops: float
+    bytes_accessed: float
+    comm_bytes: float
+    n_eqns: int
+    intensity: float
+    bound: str                      # memory-bound | compute-bound
+    dominant_prim: Optional[str]
+    compute_s: float                # flops / peak_flops
+    memory_s: float                 # bytes / peak_bw
+    roofline_min_s: float           # max(compute_s, memory_s)
+    measured_s: Optional[float] = None
+    measured_source: Optional[str] = None
+    efficiency: Optional[float] = None   # roofline_min_s / measured_s
+    gap_s: Optional[float] = None        # measured_s - roofline_min_s
+    estimated: bool = False
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        for k in ("flops", "bytes_accessed", "comm_bytes"):
+            d[k] = float(d[k])
+        d["intensity"] = round(self.intensity, 3)
+        return d
+
+
+@dataclasses.dataclass
+class PerfAttribution:
+    """Scope rows + whole-graph totals + the reconciliation check."""
+
+    rows: List[ScopeRow]
+    peak_flops: float
+    peak_bw: float
+    measured_total_s: Optional[float]
+    graph_cost: dict                 # whole-graph GraphCost.to_dict()
+    reconciliation: dict             # scope-sum vs graph totals
+
+    @property
+    def roofline_total_s(self) -> float:
+        return sum(r.roofline_min_s for r in self.rows)
+
+    @property
+    def mfu(self) -> Optional[float]:
+        """Whole-entry model-flops-utilization over the measured time."""
+        if not self.measured_total_s or self.measured_total_s <= 0:
+            return None
+        flops = sum(r.flops for r in self.rows)
+        return flops / (self.measured_total_s * self.peak_flops)
+
+    def top(self, n: int = 5) -> List[ScopeRow]:
+        return self.rows[:n]
+
+    def to_dict(self, max_rows: Optional[int] = None) -> dict:
+        rows = self.rows if max_rows is None else self.rows[:max_rows]
+        return {
+            "peak_flops": self.peak_flops,
+            "peak_hbm_bw": self.peak_bw,
+            "measured_total_s": self.measured_total_s,
+            "roofline_total_s": self.roofline_total_s,
+            "mfu": (round(self.mfu, 6) if self.mfu is not None else None),
+            "graph_cost": self.graph_cost,
+            "reconciliation": self.reconciliation,
+            "rows": [r.to_dict() for r in rows],
+        }
+
+
+def measured_from_timers(prefix: str = "") -> Dict[str, float]:
+    """Measured per-scope seconds from the r6 host TimerRegistry: name →
+    mean seconds per recorded span (scopes that bracket a dispatch on the
+    host side — ``serving.prefill``, ``serving.decode_step``, ...)."""
+    from ..profiler.scope import timer_registry
+
+    return timer_registry.averages(prefix)
+
+
+def measured_from_ring(names: Optional[Sequence[str]] = None,
+                       ) -> Dict[str, float]:
+    """Measured per-scope seconds from the r12 trace ring: span name →
+    mean duration over the ring's current contents (optionally filtered to
+    ``names``). The ring sees the same host intervals as the timers when
+    tracing is armed, plus request spans (``serving.route`` trees)."""
+    from .trace import snapshot_spans
+
+    want = set(names) if names is not None else None
+    total: Dict[str, float] = {}
+    count: Dict[str, int] = {}
+    for s in snapshot_spans():
+        if want is not None and s.name not in want:
+            continue
+        total[s.name] = total.get(s.name, 0.0) + float(s.dur)
+        count[s.name] = count.get(s.name, 0) + 1
+    return {n: total[n] / count[n] for n in total}
+
+
+def _match_measured(scope: Tuple[str, ...],
+                    measured: Dict[str, float]) -> Optional[str]:
+    """Deepest scope-path component with a direct measurement, or None."""
+    for comp in reversed(scope):
+        if comp in measured:
+            return comp
+    return None
+
+
+def attribute(target_or_graph, *, mesh_axes: Optional[Dict[str, int]] = None,
+              peak_flops: Optional[float] = None,
+              peak_bw: Optional[float] = None,
+              ridge: Optional[float] = None,
+              measured: Optional[Dict[str, float]] = None,
+              measured_total_s: Optional[float] = None) -> PerfAttribution:
+    """Build the ranked scope-attribution table for one entry point.
+
+    ``target_or_graph`` is an :class:`~paddle_tpu.analysis.graph
+    .AnalysisTarget` or a built :class:`DefUseGraph`. ``measured`` maps
+    host-visible scope names to measured seconds per execution
+    (:func:`measured_from_timers` / :func:`measured_from_ring`);
+    ``measured_total_s`` is the whole-entry measured wall time (one step /
+    one decode tick). Join semantics:
+
+    * a row whose path contains a measured scope name takes its share of
+      that scope's measured budget, split by roofline-minimum share among
+      the rows under the same name (``measured_source`` =
+      ``"scope-timer"``);
+    * remaining rows split the RESIDUAL of ``measured_total_s`` (whole
+      minus directly-measured scopes) the same way (``"step-apportioned"``
+      — per-scope efficiency then inherits the entry-level gap, which is
+      exactly what a host without per-op device timing can honestly say);
+    * with no measurement at all, ``measured_s`` stays None and the table
+      still ranks by roofline share.
+    """
+    from ..analysis.cost import (
+        DEFAULT_RIDGE_FLOPS_PER_BYTE,
+        graph_cost,
+        scope_costs,
+    )
+    from .gauges import device_peak_flops_bf16
+
+    graph = (target_or_graph.graph()
+             if hasattr(target_or_graph, "graph") else target_or_graph)
+    if mesh_axes is None and hasattr(target_or_graph, "mesh_axes"):
+        mesh_axes = target_or_graph.mesh_axes or None
+    peak_flops = float(peak_flops) if peak_flops else device_peak_flops_bf16()
+    peak_bw = float(peak_bw) if peak_bw else device_peak_hbm_bw()
+    ridge = float(ridge) if ridge else DEFAULT_RIDGE_FLOPS_PER_BYTE
+    measured = dict(measured or {})
+
+    table = scope_costs(graph, mesh_axes)
+    gc = graph_cost(graph, mesh_axes)
+
+    rows: List[ScopeRow] = []
+    for sc in table.values():
+        compute_s = sc.flops / peak_flops
+        memory_s = sc.bytes_accessed / peak_bw
+        rows.append(ScopeRow(
+            scope=sc.name, flops=sc.flops,
+            bytes_accessed=sc.bytes_accessed, comm_bytes=sc.comm_bytes,
+            n_eqns=sc.n_eqns, intensity=sc.intensity, bound=sc.bound(ridge),
+            dominant_prim=sc.dominant_prim, compute_s=compute_s,
+            memory_s=memory_s, roofline_min_s=max(compute_s, memory_s),
+            estimated=sc.estimated))
+
+    # --- measured join -----------------------------------------------------
+    groups: Dict[Optional[str], List[ScopeRow]] = {}
+    for row, sc in zip(rows, table.values()):
+        groups.setdefault(_match_measured(sc.scope, measured), []).append(row)
+
+    def _apportion(group: List[ScopeRow], budget: float, source: str):
+        share_total = sum(r.roofline_min_s for r in group)
+        for r in group:
+            share = (r.roofline_min_s / share_total if share_total > 0
+                     else 1.0 / len(group))
+            r.measured_s = budget * share
+            r.measured_source = source
+
+    direct_total = 0.0
+    for key, group in groups.items():
+        if key is None:
+            continue
+        budget = float(measured[key])
+        direct_total += budget
+        _apportion(group, budget, "scope-timer")
+    unmatched = groups.get(None, [])
+    if unmatched and measured_total_s is not None:
+        residual = max(float(measured_total_s) - direct_total, 0.0)
+        _apportion(unmatched, residual, "step-apportioned")
+    for r in rows:
+        if r.measured_s is not None:
+            r.gap_s = r.measured_s - r.roofline_min_s
+            r.efficiency = (r.roofline_min_s / r.measured_s
+                            if r.measured_s > 0 else None)
+
+    rows.sort(key=lambda r: (-(r.gap_s if r.gap_s is not None else -1.0),
+                             -r.roofline_min_s))
+
+    # --- reconciliation: rows must SUM to the whole-graph totals -----------
+    sflops = sum(r.flops for r in rows)
+    sbytes = sum(r.bytes_accessed for r in rows)
+    flops_frac = abs(sflops - gc.flops) / gc.flops if gc.flops else 0.0
+    bytes_frac = (abs(sbytes - gc.bytes_accessed) / gc.bytes_accessed
+                  if gc.bytes_accessed else 0.0)
+    reconciliation = {
+        "scope_flops": sflops, "graph_flops": gc.flops,
+        "flops_frac": round(flops_frac, 6),
+        "scope_bytes": sbytes, "graph_bytes": gc.bytes_accessed,
+        "bytes_frac": round(bytes_frac, 6),
+        "ok": bool(flops_frac <= 0.01 and bytes_frac <= 0.01),
+    }
+    return PerfAttribution(
+        rows=rows, peak_flops=peak_flops, peak_bw=peak_bw,
+        measured_total_s=measured_total_s, graph_cost=gc.to_dict(),
+        reconciliation=reconciliation)
+
+
+# ===========================================================================
+# the CLI workhorse: run both shipped hot paths on THIS host and attribute
+# ===========================================================================
+def _median(xs: Sequence[float]) -> float:
+    s = sorted(xs)
+    return s[len(s) // 2]
+
+
+def _trainer_entry(on_tpu: bool, steps: int, peak_flops: float,
+                   peak_bw: float) -> dict:
+    """Measure + attribute the eager ParallelTrainer step (bench configs:
+    gpt3-350m on TPU, the tiny gpt2-small smoke shapes on CPU)."""
+    import gc
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from ..analysis.graph import AnalysisTarget
+    from ..distributed.env import clear_mesh, init_mesh
+    from ..distributed.parallel_trainer import ParallelTrainer
+    from ..models.gpt import (
+        GPTForPretraining,
+        GPTPretrainingCriterion,
+        gpt_config,
+    )
+    from ..optimizer.optimizers import AdamW
+    from ..random import split_key
+
+    if on_tpu:
+        name, batch, seq, warmup = "gpt3-350m", 8, 1024, 3
+        overrides = {}
+    else:
+        name, batch, seq, warmup = "gpt2-small", 4, 32, 2
+        overrides = dict(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_attention_heads=4, max_position_embeddings=64)
+    cfg = gpt_config(name, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0, **overrides)
+    paddle.seed(0)
+    clear_mesh()
+    gc.collect()
+    init_mesh({"dp": 1})
+    model = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                moment_dtype="bfloat16")
+    trainer = ParallelTrainer(model, lambda out, y: crit(out, y), opt,
+                              dp_axis=None,
+                              compute_dtype="bfloat16" if on_tpu else None)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+    for _ in range(warmup):
+        loss = trainer.step(ids, ids)
+    float(np.asarray(loss._data))
+    per_step = []
+    for _ in range(max(steps, 1)):
+        t0 = time.perf_counter()
+        loss = trainer.step(ids, ids)
+        float(np.asarray(loss._data))  # block: measured = full step wall
+        per_step.append(time.perf_counter() - t0)
+    measured_s = _median(per_step)
+
+    args = (trainer.params, trainer.opt_state, trainer.buffers,
+            ids._data, ids._data, split_key(), trainer.scale_state,
+            trainer.sentinel_state, jnp.asarray(1e-4, jnp.float32))
+    target = AnalysisTarget("trainer_step", trainer._jit_step, args,
+                            mesh_axes={"dp": 1})
+    att = attribute(target, mesh_axes={"dp": 1}, peak_flops=peak_flops,
+                    peak_bw=peak_bw, measured=measured_from_timers("trainer."),
+                    measured_total_s=measured_s)
+    entry = att.to_dict()
+    entry["config"] = {"model": name, "batch": batch, "seq": seq,
+                       "steps_timed": len(per_step)}
+    entry["per_step_s"] = [round(t, 6) for t in per_step]
+    del trainer, model
+    gc.collect()
+    return entry
+
+
+def _serving_entry(on_tpu: bool, ticks: int, peak_flops: float,
+                   peak_bw: float) -> dict:
+    """Measure + attribute ONE warmed decode tick of the continuous-
+    batching engine (all slots active — the serving hot path)."""
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from ..analysis.graph import AnalysisTarget
+    from ..distributed.env import clear_mesh, init_mesh
+    from ..models.gpt import GPTForPretraining, gpt_config
+    from ..serving import ContinuousBatchingEngine, Request
+
+    if on_tpu:
+        name, s_len, n_slots, buckets = "gpt3-350m", 512, 8, [64, 128]
+        lo, hi = 16, 120
+        overrides = {}
+    else:
+        name, s_len, n_slots, buckets = "gpt2-small", 64, 4, [8, 16]
+        lo, hi = 3, 8
+        overrides = dict(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_attention_heads=4, max_position_embeddings=64)
+    cfg = gpt_config(name, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0, **overrides)
+    paddle.seed(0)
+    clear_mesh()
+    gc.collect()
+    init_mesh({"dp": 1})
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    eng = ContinuousBatchingEngine(model, max_seq_len=s_len, n_slots=n_slots,
+                                   prefill_buckets=buckets,
+                                   max_queue=4 * n_slots)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(l),)).astype("int32")
+               for l in rng.integers(lo, hi, size=2 * n_slots)]
+    # warm every bucket + the decode step (compiles out of the timed ticks)
+    eng.generate_batch([Request(p, max_new_tokens=4) for p in prompts])
+
+    # fill every slot, absorb admissions, then time ticks individually:
+    # each timed tick is one batched decode step over n_slots active slots
+    reqs = [eng.submit(p, max_new_tokens=ticks + 8)
+            for p in prompts[:n_slots]]
+    eng.step_once()  # admissions + prefills + first decode
+    per_tick = []
+    for _ in range(max(ticks, 1)):
+        t0 = time.perf_counter()
+        eng.step_once()
+        per_tick.append(time.perf_counter() - t0)
+    measured_s = _median(per_tick)
+    for r in reqs:  # drain: bounded by max_new_tokens
+        while not r.done:
+            if not eng.step_once():
+                break
+
+    n = eng.n_slots
+    step_args = (
+        eng._params, eng._buffers, jnp.zeros((n, 1), jnp.int32),
+        jnp.zeros((n,), jnp.int32), jnp.ones((n,), bool),
+        jnp.zeros((n,), jnp.float32), jnp.full((n,), -1, jnp.int32),
+        jnp.ones((n,), jnp.float32), jnp.zeros((n, 2), jnp.uint32),
+        eng._kc, eng._vc)
+    target = AnalysisTarget("serving_decode", eng._step_jit, step_args)
+    att = attribute(target, peak_flops=peak_flops, peak_bw=peak_bw,
+                    measured=measured_from_timers("serving.decode"),
+                    measured_total_s=measured_s)
+    entry = att.to_dict()
+    entry["config"] = {"model": name, "n_slots": n_slots,
+                       "max_seq_len": s_len, "buckets": list(buckets),
+                       "ticks_timed": len(per_tick)}
+    entry["per_tick_s"] = [round(t, 6) for t in per_tick]
+    entry["host_timers"] = {
+        k: round(v, 6) for k, v in measured_from_timers("serving.").items()}
+    del eng, model
+    gc.collect()
+    return entry
+
+
+def build_perf_report(out_path: Optional[str] = None, steps: int = 8,
+                      ticks: int = 16) -> dict:
+    """Run both shipped hot paths (trainer step, warmed serving decode) on
+    this host, attribute each, and return/write the versioned artifact.
+
+    The mesh and profiler-timer state are restored afterwards so the
+    report can run inside a live process (tests call it in-process)."""
+    import jax
+
+    from ..distributed.env import get_mesh, set_mesh
+    from ..profiler.scope import (
+        disable_timers,
+        enable_timers,
+        timer_registry,
+        timers_enabled,
+    )
+    from .gauges import device_peak_flops_bf16
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    peak_flops = device_peak_flops_bf16(dev)
+    peak_bw = device_peak_hbm_bw(dev)
+    from ..random import (
+        get_rng_state,
+        get_rng_state_tracker,
+        set_rng_state,
+    )
+
+    prev_mesh = get_mesh()
+    had_timers = timers_enabled()
+    # borrow the shared registry: start clean so the measured join sees
+    # only THIS report's spans, and hand the caller's accumulated state
+    # back afterwards (a live serving/training process must not lose its
+    # measurements to a diagnostic run). The global RNG is restored the
+    # same way — the entry builders paddle.seed(0) for reproducible
+    # artifacts, which must not replay a live run's dropout/sampling
+    # streams from seed 0 afterwards.
+    saved_timers = timer_registry.save_state()
+    saved_rng = get_rng_state()
+    saved_tracker = get_rng_state_tracker().get_states_tracker()
+    timer_registry.reset()
+    enable_timers()  # host-visible scopes land in the TimerRegistry join
+    entries = {}
+    try:
+        entries["trainer_step"] = _trainer_entry(on_tpu, steps, peak_flops,
+                                                 peak_bw)
+        entries["serving_decode"] = _serving_entry(on_tpu, ticks, peak_flops,
+                                                   peak_bw)
+    finally:
+        if not had_timers:
+            disable_timers()
+        timer_registry.restore_state(saved_timers)
+        set_rng_state(saved_rng)
+        get_rng_state_tracker().set_states_tracker(saved_tracker)
+        set_mesh(prev_mesh)
+    doc = {
+        "schema_version": PERF_SCHEMA_VERSION,
+        "generated_by": "python -m paddle_tpu.observability perf",
+        "device": {"platform": dev.platform,
+                   "kind": getattr(dev, "device_kind", "")},
+        "peak_flops": peak_flops,
+        "peak_hbm_bw": peak_bw,
+        "entries": entries,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+    return doc

@@ -58,6 +58,7 @@ __all__ = [
     "tracing_enabled",
     "span_ring",
     "snapshot_spans",
+    "spans_for_trace",
     "reset_spans",
     "to_chrome_trace",
     "dump_trace",
@@ -188,6 +189,12 @@ def tracing_enabled() -> bool:
 
 def snapshot_spans(last: Optional[int] = None) -> List[Span]:
     return _ring.snapshot(last)
+
+
+def spans_for_trace(trace_id: str) -> List[Span]:
+    """All ring spans belonging to one trace — the span tree an exemplar's
+    ``trace_id`` points at (the pull side of the r14 exemplar join)."""
+    return [s for s in _ring.snapshot() if s.trace_id == trace_id]
 
 
 def reset_spans():

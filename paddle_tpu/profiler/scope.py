@@ -82,11 +82,13 @@ class TimerRegistry:
         self._lock = threading.Lock()
         self._total: Dict[str, float] = {}
         self._count: Dict[str, int] = {}
+        self._last: Dict[str, float] = {}
 
     def record(self, name: str, seconds: float):
         with self._lock:
             self._total[name] = self._total.get(name, 0.0) + seconds
             self._count[name] = self._count.get(name, 0) + 1
+            self._last[name] = seconds
 
     def totals(self) -> Dict[str, dict]:
         """{name: {count, total_s, avg_s}} snapshot."""
@@ -108,10 +110,39 @@ class TimerRegistry:
         with self._lock:
             return self._count.get(name, 0)
 
+    def last(self, name: str) -> Optional[float]:
+        """Most recent recorded duration for ``name`` (None if never)."""
+        with self._lock:
+            return self._last.get(name)
+
+    def averages(self, prefix: str = "") -> Dict[str, float]:
+        """{name: mean seconds per recorded span}, optionally filtered by
+        name prefix — the measured side of the perf doctor's scope join."""
+        with self._lock:
+            return {n: self._total[n] / self._count[n]
+                    for n in self._total if n.startswith(prefix)}
+
     def reset(self):
         with self._lock:
             self._total.clear()
             self._count.clear()
+            self._last.clear()
+
+    def save_state(self) -> dict:
+        """Opaque snapshot of the accumulated spans (pair with
+        :meth:`restore_state` so a tool that needs a clean registry —
+        the perf doctor — can borrow it without destroying a live
+        process's measurements)."""
+        with self._lock:
+            return {"total": dict(self._total),
+                    "count": dict(self._count),
+                    "last": dict(self._last)}
+
+    def restore_state(self, state: dict):
+        with self._lock:
+            self._total = dict(state["total"])
+            self._count = dict(state["count"])
+            self._last = dict(state["last"])
 
 
 timer_registry = TimerRegistry()

@@ -161,6 +161,7 @@ class ContinuousBatchingEngine:
         from ..autograd.tape import no_grad
         from ..models.generation import sample_tokens
         from ..ops._primitive import unwrap, wrap
+        from ..profiler.scope import scope
 
         model, attns = self.model, self._attns
         heads, hd, s = self._heads, self._head_dim, self.max_seq_len
@@ -203,8 +204,12 @@ class ContinuousBatchingEngine:
                          jnp.zeros((), jnp.int32)),
                 (1, 1, logits.shape[-1]))[:, 0]
             key, sub = jax.random.split(key)
-            first = sample_tokens(last.astype(jnp.float32), sub,
-                                  temp, topk, topp)[0]
+            # named region (r6 scope, r14 perf-doctor row): the sampling
+            # machinery is real per-token work, not model compute — it
+            # must be attributable, not "(unscoped)"
+            with scope("serving.sample"):
+                first = sample_tokens(last.astype(jnp.float32), sub,
+                                      temp, topk, topp)[0]
             return first.astype(jnp.int32), key, kc, vc
 
         def step_fn(params, buffers, tok, pos, active, temp, topk, topp,
@@ -226,8 +231,10 @@ class ContinuousBatchingEngine:
                     if hasattr(a, "_gen_cache"):
                         del a._gen_cache
             pair = jax.vmap(lambda k_: jax.random.split(k_))(keys)
-            nxt = sample_tokens(logits[:, -1].astype(jnp.float32),
-                                pair[:, 1], temp, topk, topp).astype(jnp.int32)
+            with scope("serving.sample"):
+                nxt = sample_tokens(
+                    logits[:, -1].astype(jnp.float32),
+                    pair[:, 1], temp, topk, topp).astype(jnp.int32)
             nxt = jnp.where(active, nxt, 0)
             new_tok = jnp.where(active, nxt, tok[:, 0])[:, None]
             new_pos = jnp.where(active, posj + 1, posj)
@@ -355,7 +362,8 @@ class ContinuousBatchingEngine:
         first = int(first)
         req.state = Request.RUNNING
         req._append(first)
-        self.metrics.on_first_token(req.first_token_at - req.submitted_at)
+        self.metrics.on_first_token(req.first_token_at - req.submitted_at,
+                                    trace_id=req.trace_id)
         self.metrics.on_tokens(1)
         if self._request_finished(req, first):
             # done at prefill (max_new=1 or instant eos): never activate

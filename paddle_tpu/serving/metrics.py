@@ -92,11 +92,16 @@ class ServingMetrics:
             "serving_decode_steps_total", "decode step dispatches",
             ("compiled",))
         lat = log_buckets(1e-4, 64.0)
+        # exemplars on (r14): each latency bucket remembers the last
+        # trace_id observed into it, so a p99 TTFT bucket links to the
+        # exact serving.route span tree (OpenMetrics exposition only —
+        # the 0.0.4 text and JSON snapshot stay byte-identical)
         self._h_ttft = r.histogram(
-            "serving_ttft_seconds", "submit to first token", buckets=lat)
+            "serving_ttft_seconds", "submit to first token", buckets=lat,
+            exemplars=True)
         self._h_token = r.histogram(
             "serving_token_latency_seconds", "decode step wall time",
-            buckets=lat)
+            buckets=lat, exemplars=True)
         self._g_queue = r.gauge("serving_queue_depth",
                                 "admission queue depth")
         self._g_in_admission = r.gauge(
@@ -132,10 +137,11 @@ class ServingMetrics:
             self.requests_shed += 1
         self._c_shed.inc(reason=str(reason))
 
-    def on_first_token(self, ttft_seconds: float):
+    def on_first_token(self, ttft_seconds: float,
+                       trace_id: Optional[str] = None):
         with self._lock:
             self._ttft.append(ttft_seconds)
-        self._h_ttft.observe(ttft_seconds)
+        self._h_ttft.observe(ttft_seconds, trace_id=trace_id)
 
     def on_tokens(self, n: int, step_seconds: Optional[float] = None):
         now = time.perf_counter()
@@ -252,15 +258,11 @@ class ServingMetrics:
             pass
         return out
 
-    def prometheus_text(self, *, queue_depth: Optional[int] = None,
-                        in_admission: Optional[int] = None,
-                        active_slots: Optional[int] = None,
-                        n_slots: Optional[int] = None,
-                        draining: Optional[bool] = None) -> str:
-        """Prometheus exposition of this engine's series (the negotiated
-        side of ``/metrics``). Keyword overrides carry the LIVE admission
-        state the server reads at request time — the same freshness rule
-        the JSON body follows for the router's sake."""
+    def _refresh_live(self, queue_depth=None, in_admission=None,
+                      active_slots=None, n_slots=None, draining=None):
+        """Fold the LIVE admission state the server reads at request time
+        into the gauges — the same freshness rule the JSON body follows
+        for the router's sake (shared by both text expositions)."""
         with self._lock:
             q = self.queue_depth if queue_depth is None else queue_depth
             a = self.active_slots if active_slots is None else active_slots
@@ -275,4 +277,16 @@ class ServingMetrics:
         tput = self.tokens_per_sec()
         if tput is not None:
             self._g_tput.set(tput)
+
+    def prometheus_text(self, **live) -> str:
+        """Prometheus 0.0.4 exposition of this engine's series (the
+        negotiated side of ``/metrics``); keyword overrides as
+        :meth:`_refresh_live`. Byte-identical with exemplars on or off."""
+        self._refresh_live(**live)
         return self.registry.prometheus_text()
+
+    def openmetrics_text(self, **live) -> str:
+        """OpenMetrics exposition — same series, plus latency-bucket
+        exemplars (``# {trace_id="..."}``) linking to request traces."""
+        self._refresh_live(**live)
+        return self.registry.openmetrics_text()

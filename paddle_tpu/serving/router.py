@@ -675,10 +675,7 @@ class ServingRouter:
                 "inflight_failures": self.inflight_failures,
             }
 
-    def prometheus_text(self) -> str:
-        """Prometheus exposition of the router's series (breaker state,
-        failover accounting, per-replica load — refreshed from the live
-        replica views first)."""
+    def _refresh_replica_gauges(self):
         with self._lock:
             reps = list(self.replicas.values())
         for rep in reps:
@@ -688,16 +685,30 @@ class ServingRouter:
             self._g_queue.set(rep.queue_depth, replica=rep.addr)
             self._g_active.set(rep.active_slots, replica=rep.addr)
             self._g_draining.set(1 if rep.draining else 0, replica=rep.addr)
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition of the router's series (breaker state,
+        failover accounting, per-replica load — refreshed from the live
+        replica views first)."""
+        self._refresh_replica_gauges()
         return self.registry.prometheus_text()
+
+    def openmetrics_text(self) -> str:
+        """OpenMetrics exposition of the same series (exemplar-capable;
+        served only under ``Accept: application/openmetrics-text``)."""
+        self._refresh_replica_gauges()
+        return self.registry.openmetrics_text()
 
     def serve_metrics(self, host: str = "127.0.0.1",
                       port: int = 0) -> str:
         """Mount the router's metrics on ``GET /metrics`` (the router-side
         scrape endpoint): JSON :meth:`snapshot` by default, Prometheus
-        text under a negotiated ``Accept``. Returns the bound address;
-        :meth:`stop` tears it down."""
+        text under a negotiated ``Accept``, OpenMetrics (with exemplars)
+        under ``Accept: application/openmetrics-text``. Returns the bound
+        address; :meth:`stop` tears it down."""
         if self._metrics_http is None:
             self._metrics_http = MetricsHTTPServer(
                 json_fn=self.snapshot, prom_fn=self.prometheus_text,
+                om_fn=self.openmetrics_text,
                 host=host, port=port).start()
         return self._metrics_http.addr

@@ -41,7 +41,12 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..observability import trace as obstrace
-from ..observability.metrics import prometheus_content_type, wants_prometheus
+from ..observability.metrics import (
+    openmetrics_content_type,
+    prometheus_content_type,
+    wants_openmetrics,
+    wants_prometheus,
+)
 from .admission import AdmissionRejected, DeadlineExceededError
 from .engine import ContinuousBatchingEngine
 from .scheduler import QueueFullError, Request, SchedulerClosed
@@ -196,17 +201,26 @@ class _Handler(BaseHTTPRequestHandler):
         parts = [p for p in self.path.split("/") if p]
         if parts == ["metrics"]:
             eng = self.server_ref.engine
-            if wants_prometheus(self.headers.get("Accept")):
+            accept = self.headers.get("Accept")
+            if wants_openmetrics(accept) or wants_prometheus(accept):
                 # negotiated text exposition; the JSON default below stays
-                # byte-compatible for ServingClient/router consumers
-                active = eng.active_slots()
-                body = eng.metrics.prometheus_text(
+                # byte-compatible for ServingClient/router consumers.
+                # OpenMetrics (checked FIRST — it is the only exposition
+                # carrying exemplars) needs the explicit Accept; any other
+                # text-ish Accept keeps the byte-stable 0.0.4 body
+                live = dict(
                     queue_depth=eng.scheduler.depth(),
                     in_admission=eng.scheduler.in_admission(),
-                    active_slots=active, n_slots=eng.n_slots,
-                    draining=eng.scheduler.closed).encode()
+                    active_slots=eng.active_slots(), n_slots=eng.n_slots,
+                    draining=eng.scheduler.closed)
+                if wants_openmetrics(accept):
+                    body = eng.metrics.openmetrics_text(**live).encode()
+                    ctype = openmetrics_content_type()
+                else:
+                    body = eng.metrics.prometheus_text(**live).encode()
+                    ctype = prometheus_content_type()
                 self.send_response(200)
-                self.send_header("Content-Type", prometheus_content_type())
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

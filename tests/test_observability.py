@@ -715,6 +715,325 @@ class TestTrainerGauges:
 
 
 # =====================================================================
+# exemplars + OpenMetrics negotiation (r14)
+# =====================================================================
+class TestExemplarsAndOpenMetrics:
+    def _two_registries(self):
+        """Same observations into an exemplar-enabled and a plain
+        registry — the byte-compatibility pair."""
+        regs = []
+        for ex in (True, False):
+            r = MetricsRegistry()
+            h = r.histogram("ttft_seconds", "ttft", buckets=[0.01, 0.1, 1.0],
+                            exemplars=ex)
+            h.observe(0.005, trace_id="trace-a")
+            h.observe(0.5, trace_id="trace-b")
+            h.observe(0.5, trace_id="trace-c")  # last exemplar wins
+            r.counter("reqs_total", "requests").inc(3)
+            regs.append(r)
+        return regs
+
+    def test_exemplars_bounded_one_per_bucket_last_wins(self):
+        reg, _ = self._two_registries()
+        ex = reg.get("ttft_seconds").exemplars()
+        assert set(ex) == {"0.01", "1"}
+        assert ex["0.01"]["trace_id"] == "trace-a"
+        assert ex["1"]["trace_id"] == "trace-c"  # last observation kept
+        assert ex["1"]["value"] == 0.5
+        assert ex["1"]["ts"] > 0
+
+    def test_prometheus_004_byte_identical_with_exemplars_enabled(self):
+        with_ex, without_ex = self._two_registries()
+        assert with_ex.prometheus_text() == without_ex.prometheus_text()
+        # and the 0.0.4 body still parses strict, with no exemplar syntax
+        types, _ = parse_prometheus_strict(with_ex.prometheus_text())
+        assert "ttft_seconds" in types
+        assert "# {" not in with_ex.prometheus_text()
+
+    def test_openmetrics_exposition_carries_exemplars_and_eof(self):
+        reg, _ = self._two_registries()
+        om = reg.openmetrics_text()
+        assert om.endswith("# EOF\n")
+        assert '# {trace_id="trace-a"} 0.005' in om
+        assert '# {trace_id="trace-c"} 0.5' in om
+        # counter family per the OpenMetrics spec: TYPE names the family
+        # (no _total), the sample keeps the _total suffix
+        assert "# TYPE reqs counter" in om
+        assert "\nreqs_total 3" in om
+        # histogram series unchanged otherwise
+        assert 'ttft_seconds_bucket{le="+Inf"} 3' in om
+
+    def test_registry_json_byte_identical_unless_asked(self):
+        """Review fix: to_dict() (the training exporter's JSON body) is
+        byte-identical with exemplars on or off; dumps opt in."""
+        with_ex, without_ex = self._two_registries()
+        assert json.dumps(with_ex.to_dict()) == \
+            json.dumps(without_ex.to_dict())
+        asked = with_ex.to_dict(include_exemplars=True)
+        assert asked["ttft_seconds"]["values"]["exemplars"]["0.01"][
+            "trace_id"] == "trace-a"
+
+    def test_ambient_trace_context_feeds_exemplar(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "l", buckets=[1.0], exemplars=True)
+        with obs_trace.trace_context("ctx-trace"):
+            h.observe(0.5)
+        h.observe(0.7)  # no context, no explicit id -> no exemplar update
+        assert h.exemplars()["1"]["trace_id"] == "ctx-trace"
+        assert h.exemplars()["1"]["value"] == 0.5
+
+    def test_wants_openmetrics_is_explicit(self):
+        from paddle_tpu.observability.metrics import wants_openmetrics
+
+        assert wants_openmetrics("application/openmetrics-text")
+        assert wants_openmetrics(
+            "application/openmetrics-text; version=1.0.0")
+        assert not wants_openmetrics("text/plain")
+        assert not wants_openmetrics("*/*")
+        assert not wants_openmetrics(None)
+        # the pre-r14 wants_prometheus keeps matching openmetrics-ish
+        # Accepts, so ordering (openmetrics checked first) is the contract
+        assert wants_prometheus("application/openmetrics-text")
+
+    def test_server_endpoint_negotiates_openmetrics(self, model):
+        """A request with a trace id lands a TTFT exemplar; the OM scrape
+        carries it, the 0.0.4 scrape is byte-identical to before and the
+        JSON body is untouched (the ServingClient/router contract)."""
+        import http.client
+
+        from paddle_tpu.serving import ServingClient, ServingServer
+
+        srv = ServingServer(_engine(model)).start()
+        try:
+            client = ServingClient(srv.addr)
+            rid = client.submit([1, 2, 3], max_new_tokens=2,
+                                trace_id="abcd1234deadbeef")
+            client.wait(rid, timeout=60)
+
+            def scrape(accept):
+                host, port = srv.addr.rsplit(":", 1)
+                c = http.client.HTTPConnection(host, int(port), timeout=10)
+                c.request("GET", "/metrics",
+                          headers={"Accept": accept} if accept else {})
+                r = c.getresponse()
+                body, ctype = r.read(), r.getheader("Content-Type")
+                c.close()
+                return ctype, body.decode()
+
+            ctype, om = scrape("application/openmetrics-text")
+            assert "application/openmetrics-text" in ctype
+            assert om.endswith("# EOF\n")
+            assert 'trace_id="abcd1234deadbeef"' in om
+            ctype, prom = scrape("text/plain")
+            assert "0.0.4" in ctype
+            parse_prometheus_strict(prom)
+            assert "# {" not in prom  # exemplars never leak into 0.0.4
+            ctype, js = scrape(None)
+            assert ctype == "application/json"
+            assert "exemplars" not in json.loads(js)
+        finally:
+            srv.stop()
+
+    def test_router_endpoint_negotiates_openmetrics(self, model):
+        import http.client
+
+        from paddle_tpu.serving import ServingRouter, ServingServer
+
+        srv = ServingServer(_engine(model)).start()
+        router = ServingRouter([srv.addr], health_interval_s=0.1).start()
+        try:
+            router.check_health()
+            addr = router.serve_metrics()
+            host, port = addr.rsplit(":", 1)
+            c = http.client.HTTPConnection(host, int(port), timeout=5)
+            c.request("GET", "/metrics",
+                      headers={"Accept": "application/openmetrics-text"})
+            r = c.getresponse()
+            assert "application/openmetrics-text" in \
+                r.getheader("Content-Type")
+            body = r.read().decode()
+            assert body.endswith("# EOF\n")
+            assert "# TYPE router_replica_up gauge" in body
+            c.close()
+        finally:
+            router.stop()
+            srv.stop()
+
+
+# =====================================================================
+# metric dumps through the merge CLI (r14 satellite)
+# =====================================================================
+class TestMetricDumpMerge:
+    def _metric_dump(self, tmp_path, name="metrics.json"):
+        from paddle_tpu.observability.metrics import dump_metrics
+
+        reg = MetricsRegistry()
+        h = reg.histogram("ttft_seconds", "t", buckets=[0.01, 1.0],
+                          exemplars=True)
+        h.observe(0.005, trace_id="trace-x")
+        h.observe(0.5, trace_id="trace-y")
+        path = str(tmp_path / name)
+        doc = dump_metrics(reg, path=path, process="replica-0")
+        assert doc["schema_version"] == 1
+        ex = doc["metrics"]["ttft_seconds"]["values"]["exemplars"]
+        assert ex["0.01"]["trace_id"] == "trace-x"
+        return path
+
+    def test_merge_renders_exemplars_next_to_spans(self, tmp_path):
+        from paddle_tpu.observability.merge import merge_files
+
+        obs.enable_tracing(max_spans=64)
+        with obs_trace.span("serving.route", trace_id="trace-x"):
+            pass
+        span_path = str(tmp_path / "trace.json")
+        obs_trace.dump_trace(span_path, process="router")
+        metric_path = self._metric_dump(tmp_path)
+        doc = merge_files([span_path, metric_path])
+        assert doc["metadata"]["n_spans"] == 1
+        assert doc["metadata"]["n_exemplars"] == 2
+        instants = [e for e in doc["traceEvents"] if e.get("ph") == "i"]
+        assert len(instants) == 2
+        names = {e["name"] for e in instants}
+        assert any("ttft_seconds_bucket[le=" in n for n in names)
+        assert {e["args"]["trace_id"] for e in instants} == \
+            {"trace-x", "trace-y"}
+        # --trace-id filters spans AND exemplars to one request
+        doc = merge_files([span_path, metric_path], trace_id="trace-x")
+        assert doc["metadata"]["n_spans"] == 1
+        assert doc["metadata"]["n_exemplars"] == 1
+
+    def test_merge_accepts_flight_dump_metric_sections(self, tmp_path):
+        from paddle_tpu.observability.merge import merge_dumps
+
+        doc = merge_dumps([{
+            "pid": 7, "process": "engine", "spans": [],
+            "metrics": {"serving-1": {
+                "lat_seconds": {"type": "histogram", "help": "",
+                                "values": {"count": 1, "sum": 0.5,
+                                           "exemplars": {"1": {
+                                               "trace_id": "t", "value": 0.5,
+                                               "ts": 1.0}}}}}}}])
+        assert doc["metadata"]["n_exemplars"] == 1
+        (ev,) = [e for e in doc["traceEvents"] if e.get("ph") == "i"]
+        assert ev["name"].startswith("serving-1/")
+
+    def test_non_dump_errors_instead_of_silently_ignoring(self, tmp_path):
+        from paddle_tpu.observability.__main__ import main as obs_main
+        from paddle_tpu.observability.merge import load_dump
+
+        bogus = tmp_path / "bogus.json"
+        bogus.write_text(json.dumps({"foo": 1}))
+        with pytest.raises(ValueError, match="no 'spans' or 'metrics'"):
+            load_dump(str(bogus))
+        assert obs_main(["merge", str(bogus)]) == 2
+
+
+# =====================================================================
+# recompile-aware MFU pricing (r14 satellite fix)
+# =====================================================================
+class TestTelemetryReprice:
+    def test_reshaped_batch_reprices_instead_of_stale_flops(self):
+        reg = MetricsRegistry()
+        tr = _tiny_trainer(donate=False)
+        tel = obs.TrainerTelemetry(tr, registry=reg, name="rp")
+        rng = np.random.default_rng(0)
+        x8 = paddle.to_tensor(rng.standard_normal((8, 4)).astype("float32"))
+        x2 = paddle.to_tensor(rng.standard_normal((2, 4)).astype("float32"))
+        tel.prime(x8, x8)
+        f8 = tel.flops_per_step
+        assert f8 and f8 > 0
+        tel.step(x8, x8)            # first step: compile, observation skipped
+        tel.step(x8, x8)            # steady state: observed, no reprice
+        assert tel.reprices == 0
+        assert reg.get("train_step_seconds").count(trainer="rp") == 1
+        tel.step(x2, x2)            # reshaped batch -> jit cache miss
+        assert tel.reprices == 1
+        assert tel.reprice_errors == 0
+        f2 = tel.flops_per_step
+        assert f2 and f2 < f8       # re-priced for the SMALLER batch
+        # the recompiled step's wall time (trace+compile) is NOT observed
+        assert reg.get("train_step_seconds").count(trainer="rp") == 1
+        assert reg.get("train_telemetry_reprices_total").value(
+            trainer="rp") == 1
+        tel.step(x2, x2)            # steady again: observed at new shape
+        assert tel.reprices == 1
+        assert reg.get("train_step_seconds").count(trainer="rp") == 2
+        assert tel.report()["reprices"] == 1
+
+    def test_reprice_restamps_return_clock(self):
+        """Review fix: the reprice (re-trace + liveness estimate) runs
+        AFTER the step's return timestamp — the next step's return-to-
+        return gap must not absorb the pricing wall time."""
+        reg = MetricsRegistry()
+        tr = _tiny_trainer(donate=False)
+        tel = obs.TrainerTelemetry(tr, registry=reg, name="rpt")
+        rng = np.random.default_rng(0)
+        x8 = paddle.to_tensor(rng.standard_normal((8, 4)).astype("float32"))
+        x2 = paddle.to_tensor(rng.standard_normal((2, 4)).astype("float32"))
+        tel.prime(x8, x8)
+        tel.step(x8, x8)
+        marker = {}
+        orig_prime = tel.prime
+
+        def marking_prime(xx, yy):
+            out = orig_prime(xx, yy)
+            marker["end"] = time.perf_counter()
+            return out
+
+        tel.prime = marking_prime
+        tel.step(x2, x2)            # reshaped -> reprice fires
+        assert "end" in marker
+        # the return clock was re-stamped AFTER the pricing finished
+        assert tel._last_return >= marker["end"]
+
+    def test_failed_reprice_retries_at_most_once_per_compile(self):
+        """Review fix: a rebuilt trainer whose pricing RAISES must not
+        re-run the full-trace prime on every subsequent step, and step
+        observation must resume (stale-but-live gauges + counted error)."""
+        reg = MetricsRegistry()
+        tr = _tiny_trainer(donate=False)
+        tel = obs.TrainerTelemetry(tr, registry=reg, name="rpf")
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((8, 4)).astype("float32"))
+        tel.prime(x, x)
+        tel.step(x, x)              # first: compile, skipped
+        tel.step(x, x)              # observed
+        assert reg.get("train_step_seconds").count(trainer="rpf") == 1
+        tr._build()                 # rebuild: wholly new jit identity
+        calls = {"n": 0}
+
+        def boom(xx, yy):
+            calls["n"] += 1
+            raise RuntimeError("pricing broke")
+
+        tel.prime = boom
+        tel.step(x, x)              # rebuilt -> reprice attempt fails ONCE
+        assert calls["n"] == 1
+        assert tel.reprice_errors == 1
+        tel.step(x, x)              # no retry storm; observation resumes
+        tel.step(x, x)
+        assert calls["n"] == 1
+        assert tel.reprice_errors == 1
+        assert reg.get("train_step_seconds").count(trainer="rpf") == 3
+
+    def test_mfu_uses_repriced_flops(self):
+        reg = MetricsRegistry()
+        tr = _tiny_trainer(donate=False)
+        tel = obs.TrainerTelemetry(tr, registry=reg, peak_flops=1e12,
+                                   name="rp2")
+        rng = np.random.default_rng(0)
+        x8 = paddle.to_tensor(rng.standard_normal((8, 4)).astype("float32"))
+        x2 = paddle.to_tensor(rng.standard_normal((2, 4)).astype("float32"))
+        tel.prime(x8, x8)
+        tel.step(x8, x8)
+        tel.step(x2, x2)            # repriced here
+        f2 = tel.flops_per_step
+        tel.observe_step(0.01)
+        assert reg.get("train_mfu").value(trainer="rp2") == \
+            pytest.approx(f2 / (0.01 * 1e12))
+
+
+# =====================================================================
 # jaxpr identity: tracing enabled vs disabled (r6 bar, extended)
 # =====================================================================
 class TestTracingJaxprIdentity:

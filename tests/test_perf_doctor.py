@@ -1,0 +1,396 @@
+"""Perf doctor (ISSUE 9): scope-level roofline attribution + bench
+regression watchdog.
+
+Acceptance bars exercised here:
+* scope-summed flops/bytes reconcile with whole-graph ``graph_cost``
+  totals (within 1% — same walk, so exactly);
+* the committed ``benchmarks/perf_attribution.json`` carries measured_s /
+  roofline_min_s / efficiency / bound per scope and its ranked top
+  trainer entry names an attention/matmul scope;
+* ``bench-diff`` exits 0 on the known-good BENCH_r05 payload and 1 on a
+  synthetic regression, naming the metric.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.analysis.cost import graph_cost, scope_costs
+from paddle_tpu.analysis.graph import AnalysisTarget, scope_components
+from paddle_tpu.observability import baseline as bl
+from paddle_tpu.observability import perf as perf_mod
+from paddle_tpu.observability.__main__ import main as obs_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# =====================================================================
+# name-stack normalization
+# =====================================================================
+class TestScopeComponents:
+    def test_plain_path(self):
+        assert scope_components("a/b") == ("a", "b")
+
+    def test_strips_transform_wrappers(self):
+        assert scope_components("jvp(gpt.attn)") == ("gpt.attn",)
+        assert scope_components("transpose(jvp(gpt.attn))") == ("gpt.attn",)
+
+    def test_backward_reentry_collapses_to_forward_row(self):
+        # the rendered backward stack of a region under value_and_grad
+        ns = "trainer.loss_grad/transpose(trainer.loss_grad)/jvp(gpt.attn)"
+        assert scope_components(ns) == ("trainer.loss_grad", "gpt.attn")
+
+    def test_empty_and_dedupe(self):
+        assert scope_components("") == ()
+        assert scope_components("a/a/b") == ("a", "b")
+
+
+# =====================================================================
+# scope-sliced roofline costs
+# =====================================================================
+def _toy_target():
+    def f(p, x):
+        with jax.named_scope("region.attn"):
+            h = x @ p["w"]          # dot: 2*2*4*4 = 64 flops
+            h = jnp.tanh(h)
+        with jax.named_scope("region.mlp"):
+            h = h @ p["w2"]         # dot: 64 flops
+        return h.sum()              # unscoped reduction
+
+    p = {"w": jnp.ones((4, 4), jnp.float32),
+         "w2": jnp.ones((4, 4), jnp.float32)}
+    return AnalysisTarget("toy", f, (p, jnp.ones((2, 4), jnp.float32)))
+
+
+class TestScopeCosts:
+    def test_hand_computed_dot_flops_per_scope(self):
+        table = scope_costs(_toy_target().graph())
+        by_name = {sc.name: sc for sc in table.values()}
+        attn = by_name["region.attn"]
+        # 2 * out_elems(2x4) * K(4) = 64 dot flops + 8 elems * 8 tanh flops
+        assert attn.by_prim["dot_general"]["flops"] == 64.0
+        assert attn.by_prim["tanh"]["flops"] == 64.0
+        assert by_name["region.mlp"].by_prim["dot_general"]["flops"] == 64.0
+        assert attn.dominant_prim in ("dot_general", "tanh")
+        assert by_name["(unscoped)"].n_eqns >= 1  # the sum reduction
+
+    def test_rows_reconcile_with_graph_cost_exactly(self):
+        target = _toy_target()
+        table = scope_costs(target.graph())
+        gc = graph_cost(target.graph())
+        assert sum(sc.flops for sc in table.values()) == gc.flops
+        assert sum(sc.bytes_accessed
+                   for sc in table.values()) == gc.bytes_accessed
+        assert sum(sc.n_eqns for sc in table.values()) == gc.n_eqns
+
+
+# =====================================================================
+# measured join + ranking
+# =====================================================================
+class TestAttribute:
+    def test_measured_total_apportioned_and_ranked(self):
+        att = perf_mod.attribute(_toy_target(), peak_flops=1e12,
+                                 peak_bw=1e12, measured_total_s=1.0)
+        assert att.reconciliation["ok"]
+        assert abs(sum(r.measured_s for r in att.rows) - 1.0) < 1e-9
+        for r in att.rows:
+            assert r.measured_source == "step-apportioned"
+            assert r.efficiency is not None and 0 < r.efficiency <= 1
+            assert r.bound in ("memory-bound", "compute-bound")
+        gaps = [r.gap_s for r in att.rows]
+        assert gaps == sorted(gaps, reverse=True)
+        assert att.mfu is not None and att.mfu > 0
+
+    def test_scope_timer_join_takes_direct_budget(self):
+        att = perf_mod.attribute(
+            _toy_target(), peak_flops=1e12, peak_bw=1e12,
+            measured={"region.attn": 0.25}, measured_total_s=1.0)
+        by_name = {r.scope: r for r in att.rows}
+        attn = by_name["region.attn"]
+        assert attn.measured_source == "scope-timer"
+        assert attn.measured_s == pytest.approx(0.25)
+        rest = [r for r in att.rows if r.scope != "region.attn"]
+        assert all(r.measured_source == "step-apportioned" for r in rest)
+        # the residual budget is the whole minus the directly-measured
+        assert sum(r.measured_s for r in rest) == pytest.approx(0.75)
+
+    def test_no_measurement_still_ranks_by_roofline(self):
+        att = perf_mod.attribute(_toy_target(), peak_flops=1e12,
+                                 peak_bw=1e12)
+        assert all(r.measured_s is None for r in att.rows)
+        rl = [r.roofline_min_s for r in att.rows]
+        assert rl == sorted(rl, reverse=True)
+        assert att.mfu is None
+
+    def test_trainer_integration_rows_carry_trainer_scopes(self):
+        """The REAL ParallelTrainer jit step attributes into the r6
+        in-graph scopes (loss_grad / optimizer_apply)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.env import clear_mesh, get_mesh, init_mesh, set_mesh
+        from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+        from paddle_tpu.optimizer.optimizers import SGD
+        from paddle_tpu.random import split_key
+
+        prev = get_mesh()
+        try:
+            clear_mesh()
+            init_mesh({"dp": 1})
+            paddle.seed(0)
+            net = paddle.nn.Linear(8, 8)
+            tr = ParallelTrainer(net, lambda o, y: ((o - y) ** 2).mean(),
+                                 SGD(0.01), dp_axis=None)
+            tr._build()
+            xb = jnp.zeros((4, 8), jnp.float32)
+            args = (tr.params, tr.opt_state, tr.buffers, xb, xb,
+                    split_key(), tr.scale_state, tr.sentinel_state,
+                    jnp.asarray(0.01, jnp.float32))
+            target = AnalysisTarget("t", tr._jit_step, args,
+                                    mesh_axes={"dp": 1})
+            att = perf_mod.attribute(target, measured_total_s=0.001)
+            names = {r.scope for r in att.rows}
+            assert any("trainer.loss_grad" in n for n in names)
+            assert any("trainer.optimizer_apply" in n for n in names)
+            assert att.reconciliation["ok"]
+        finally:
+            set_mesh(prev)
+
+
+# =====================================================================
+# the committed artifact (acceptance anchors, zero runtime cost)
+# =====================================================================
+class TestCommittedPerfArtifact:
+    @pytest.fixture(scope="class")
+    def doc(self):
+        path = os.path.join(REPO, "benchmarks", "perf_attribution.json")
+        with open(path) as f:
+            return json.load(f)
+
+    def test_schema_and_entries(self, doc):
+        assert doc["schema_version"] == perf_mod.PERF_SCHEMA_VERSION
+        assert set(doc["entries"]) >= {"trainer_step", "serving_decode"}
+
+    def test_rows_carry_the_required_columns(self, doc):
+        for entry in doc["entries"].values():
+            assert entry["measured_total_s"] > 0
+            for row in entry["rows"]:
+                assert row["measured_s"] is not None
+                assert row["roofline_min_s"] >= 0
+                assert row["efficiency"] is None or row["efficiency"] > 0
+                assert row["bound"] in ("memory-bound", "compute-bound")
+
+    def test_scope_sums_reconcile_within_1pct(self, doc):
+        for entry in doc["entries"].values():
+            rec = entry["reconciliation"]
+            assert rec["ok"], rec
+            assert rec["flops_frac"] <= 0.01
+            assert rec["bytes_frac"] <= 0.01
+
+    def test_trainer_top_entry_is_a_matmul_scope(self, doc):
+        """Sanity anchor for the Pallas target list: the biggest MFU-gap
+        scope of the trainer step is attention/FFN matmul work."""
+        top = doc["entries"]["trainer_step"]["rows"][0]
+        assert top["dominant_prim"] == "dot_general"
+        assert any(t in top["scope"]
+                   for t in ("attn", "mlp", "lm_head", "matmul"))
+
+    def test_serving_decode_names_model_and_sampling_scopes(self, doc):
+        names = [r["scope"] for r in doc["entries"]["serving_decode"]["rows"]]
+        assert any("gpt.attn" in n for n in names)
+        assert any("serving.sample" in n for n in names)
+
+
+@pytest.mark.slow
+class TestPerfReportEndToEnd:
+    def test_build_perf_report_regenerates(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.profiler.scope import timer_registry, timers_enabled
+
+        # a live process's accumulated spans AND its RNG stream must
+        # survive the diagnostic run (review fixes: the report borrows,
+        # snapshots, and restores the shared registry, and the entry
+        # builders' paddle.seed(0) is undone afterwards)
+        timer_registry.record("caller.span", 1.23)
+        paddle.seed(12345)
+        try:
+            out = str(tmp_path / "perf.json")
+            doc = perf_mod.build_perf_report(out_path=out, steps=2, ticks=4)
+            with open(out) as f:
+                on_disk = json.load(f)
+            assert on_disk["schema_version"] == perf_mod.PERF_SCHEMA_VERSION
+            for entry in doc["entries"].values():
+                assert entry["reconciliation"]["ok"]
+                assert entry["rows"][0]["measured_s"] > 0
+            assert timer_registry.total("caller.span") == 1.23
+            assert not timers_enabled()
+            # and the report's own spans did not leak into the caller's view
+            assert "serving.decode_step" not in timer_registry.averages()
+            # the RNG continues the caller's seed-12345 stream, not seed 0
+            after = np.asarray(paddle.randn([4])._data)
+            paddle.seed(12345)
+            control = np.asarray(paddle.randn([4])._data)
+            np.testing.assert_array_equal(after, control)
+        finally:
+            timer_registry.reset()
+
+
+# =====================================================================
+# bench regression watchdog
+# =====================================================================
+def _lineage_files():
+    return sorted(
+        os.path.join(REPO, f) for f in os.listdir(REPO)
+        if f.startswith("BENCH_r0") and f.endswith(".json"))
+
+
+class TestBaselineRebuild:
+    def test_flatten_payload_primary_secondary_nested(self):
+        flat = bl.flatten_payload({
+            "metric": "m_tokens_per_sec", "value": 10.0, "vs_baseline": 1.1,
+            "secondary": {"a_ms": 2.0, "nested": {"ok": True, "x": 1}}})
+        assert flat == {"m_tokens_per_sec": 10.0, "vs_baseline": 1.1,
+                        "a_ms": 2.0, "nested.ok": True, "nested.x": 1}
+
+    def test_classify_patterns(self):
+        assert bl.classify_metric("gpt_tokens_per_sec", 1.0) == "higher"
+        assert bl.classify_metric("serving_cb_ttft_p50_ms", 1.0) == "lower"
+        assert bl.classify_metric("x_overhead_frac", 0.1) == "lower"
+        assert bl.classify_metric("overload_shed_ttft_within_3x",
+                                  True) == "flag"
+        assert bl.classify_metric("a.silent_drops", 0) == "count_max"
+        assert bl.classify_metric("serving_compiled_programs", 4) == "info"
+
+    def test_rebuild_covers_its_own_lineage(self, tmp_path):
+        out = str(tmp_path / "baseline.json")
+        doc = bl.rebuild(_lineage_files(), out_path=out)
+        assert doc["schema_version"] == bl.BASELINE_SCHEMA_VERSION
+        # every lineage payload passes its own baseline by construction
+        for path in _lineage_files():
+            with open(path) as f:
+                payload = json.load(f)
+            verdict = bl.compare(payload, doc)
+            assert verdict["ok"], (path, verdict["regressions"])
+
+    def test_negative_valued_lineage_covers_itself(self, tmp_path):
+        """Review fixes: sign-aware band widening (a negative extreme
+        times (1+pad) moves the bound the WRONG way) and the `magnitude`
+        class for zero-is-ideal drift metrics (an all-negative lineage
+        must not flag a later PERFECT 0.0 as above the band ceiling)."""
+        payloads = [
+            {"metric": "m_tokens_per_sec", "value": 10.0,
+             "secondary": {"observability_hbm_drift_frac": drift,
+                           "weird_mfu": mfu}}
+            for drift, mfu in ((-0.05, -2.0), (-0.02, -1.5), (-0.01, -1.0))]
+        files = []
+        for i, p in enumerate(payloads):
+            f = tmp_path / f"BENCH_neg{i}.json"
+            f.write_text(json.dumps(p))
+            files.append(str(f))
+        doc = bl.rebuild(files)
+        assert doc["metrics"]["observability_hbm_drift_frac"]["class"] == \
+            "magnitude"
+        assert doc["metrics"]["weird_mfu"]["class"] == "higher"
+        for p in payloads:
+            verdict = bl.compare(p, doc)
+            assert verdict["ok"], verdict["regressions"]
+        # drift improving to a perfect 0.0 (or flipping sign inside the
+        # magnitude band) is an IMPROVEMENT, never a regression
+        perfect = {"metric": "m_tokens_per_sec", "value": 10.0,
+                   "secondary": {"observability_hbm_drift_frac": 0.0,
+                                 "weird_mfu": -1.0}}
+        assert bl.compare(perfect, doc)["ok"]
+        flipped = dict(perfect,
+                       secondary={"observability_hbm_drift_frac": 0.04,
+                                  "weird_mfu": -1.0})
+        assert bl.compare(flipped, doc)["ok"]
+        # genuinely-worse values still gate in both directions
+        bad = {"metric": "m_tokens_per_sec", "value": 10.0,
+               "secondary": {"observability_hbm_drift_frac": 0.5,
+                             "weird_mfu": -5.0}}
+        names = {r["metric"] for r in bl.compare(bad, doc)["regressions"]}
+        assert names == {"observability_hbm_drift_frac", "weird_mfu"}
+
+    def test_committed_baseline_matches_rebuild(self):
+        committed = bl.load_baseline()
+        fresh = bl.rebuild(_lineage_files())
+        assert committed["metrics"] == json.loads(
+            json.dumps(fresh["metrics"]))
+
+
+class TestBenchDiff:
+    def _regressed_payload(self, tmp_path):
+        with open(os.path.join(REPO, "BENCH_r05.json")) as f:
+            doc = json.load(f)
+        doc["parsed"]["value"] = doc["parsed"]["value"] * 0.5
+        doc["parsed"]["secondary"]["pipeline_step_ratio"] = 0.3
+        p = tmp_path / "regressed.json"
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_known_good_r05_exits_0(self, capsys):
+        rc = obs_main(["bench-diff", os.path.join(REPO, "BENCH_r05.json")])
+        assert rc == 0
+
+    def test_synthetic_regression_exits_1_naming_metric(self, tmp_path,
+                                                        capsys):
+        rc = obs_main(["bench-diff", self._regressed_payload(tmp_path)])
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "gpt3_1.3b_train_tokens_per_sec_chip" in err
+        assert "pipeline_step_ratio" in err
+        assert "PRIMARY" in err
+
+    def test_compare_primary_regressions_lead(self, tmp_path):
+        with open(self._regressed_payload(tmp_path)) as f:
+            payload = json.load(f)
+        verdict = bl.compare(payload, bl.load_baseline())
+        assert not verdict["ok"]
+        assert verdict["regressions"][0]["primary"] is True
+
+    def test_flag_regression_gates(self):
+        base = bl.rebuild(_lineage_files())
+        base["metrics"]["fake_overhead_ok"] = {
+            "class": "flag", "expect_true": True, "n": 1, "values": [True],
+            "primary": False}
+        verdict = bl.compare(
+            {"metric": "x", "value": 1.0,
+             "secondary": {"fake_overhead_ok": False}}, base)
+        assert not verdict["ok"]
+        assert verdict["regressions"][0]["metric"] == "fake_overhead_ok"
+
+    def test_type_changed_metric_surfaces_as_missing_not_compared(self):
+        """Review fix: a lineage float that a refactor turns into a bool
+        must not be silently 'compared' — it can't gate, so it surfaces
+        with the missing metrics."""
+        base = bl.load_baseline()
+        with open(os.path.join(REPO, "BENCH_r05.json")) as f:
+            payload = json.load(f)
+        good = bl.compare(payload, base)
+        payload["parsed"]["secondary"]["pipeline_step_ratio"] = True
+        verdict = bl.compare(payload, base)
+        assert "pipeline_step_ratio" in verdict["missing_metrics"]
+        assert verdict["compared"] == good["compared"] - 1
+
+    def test_missing_metric_reported_not_silent(self):
+        base = bl.load_baseline()
+        verdict = bl.compare({"metric": "other", "value": 1.0,
+                              "secondary": {}}, base)
+        assert verdict["ok"]  # nothing regressed ...
+        assert "pipeline_step_ratio" in verdict["missing_metrics"]
+
+    def test_cli_subprocess_fidelity(self, tmp_path):
+        """One real subprocess run: the committed baseline + r05 payload
+        through the installed CLI exits 0 (the exact CI invocation)."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.pathsep.join(
+                       p for p in (REPO, os.environ.get("PYTHONPATH"))
+                       if p))
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.observability", "bench-diff",
+             os.path.join(REPO, "BENCH_r05.json")],
+            capture_output=True, text=True, env=env, cwd=REPO, timeout=240)
+        assert proc.returncode == 0, proc.stderr
