@@ -563,11 +563,14 @@ def _serving_tput(on_tpu):
         acc += fu
     seq_tput = n_req * max_new / sum(fulls)
 
-    # -- continuous-batching arm --------------------------------------------
+    # -- continuous-batching arm (SLOT layout: the r8 baseline the paged
+    # arm below is judged against — kv_layout now defaults to "paged", so
+    # the baseline must ask for the slot cache explicitly) ------------------
     # ONE engine: its jit caches hold the bucket/step programs, so the
     # warmup pass absorbs every compile and the measured pass replays
     eng = ContinuousBatchingEngine(model, max_seq_len=s, n_slots=n_slots,
-                                   prefill_buckets=buckets, max_queue=n_req)
+                                   prefill_buckets=buckets, max_queue=n_req,
+                                   kv_layout="slot")
 
     def engine_pass():
         reqs = [Request(p, max_new_tokens=max_new) for p in prompts]
@@ -580,7 +583,7 @@ def _serving_tput(on_tpu):
     cb_ttft = [r.ttft() for r in reqs]
     cb_tput = n_req * max_new / dt
 
-    return {
+    out = {
         "serving_cb_tokens_per_sec": round(cb_tput, 2),
         "serving_seq_tokens_per_sec": round(seq_tput, 2),
         "serving_cb_speedup": round(cb_tput / seq_tput, 3),
@@ -592,6 +595,111 @@ def _serving_tput(on_tpu):
         "serving_trace": {"n_requests": n_req, "max_new_tokens": max_new,
                           "n_slots": n_slots, "buckets": buckets},
     }
+
+    # -- paged arm (ISSUE 11): same trace through the block-paged KV pool --
+    if on_tpu:
+        page_size, px_len, px_tail, px_buckets, px_new, px_n = 32, 416, \
+            64, [64, 512], 16, 32
+    else:
+        page_size, px_len, px_tail, px_buckets, px_new, px_n = 8, 100, 8, \
+            [16, 112], 4, 16
+    paged = ContinuousBatchingEngine(
+        model, max_seq_len=s, n_slots=n_slots, prefill_buckets=buckets,
+        max_queue=n_req, page_size=page_size)
+
+    def paged_pass():
+        preqs = [Request(p, max_new_tokens=max_new) for p in prompts]
+        t0 = time.perf_counter()
+        paged.generate_batch(preqs)
+        return preqs, time.perf_counter() - t0
+
+    paged_pass()  # warmup: chunk buckets + step compile
+    preqs, pdt = paged_pass()
+    paged_tput = n_req * max_new / pdt
+    paged_exact = all(pr.tokens == sr.tokens for pr, sr in zip(preqs, reqs))
+    out.update({
+        "serving_paged_tokens_per_sec": round(paged_tput, 2),
+        "serving_paged_speedup_vs_slot": round(paged_tput / cb_tput, 3),
+        "serving_paged_exact_vs_slot": bool(paged_exact),
+        "serving_paged_compiled_programs": paged.trace_count,
+        "serving_paged_compile_bound_ok": bool(
+            paged.trace_count <= len(paged.chunk_buckets) + 1),
+    })
+
+    # secondary 1: per-stream KV HBM — live pages x page bytes vs the slot
+    # layout's whole-row share, sampled with every slot active mid-decode
+    meter = ContinuousBatchingEngine(
+        model, max_seq_len=s, n_slots=n_slots, prefill_buckets=buckets,
+        max_queue=n_req, page_size=page_size, prefix_sharing=False)
+    meter.generate_batch(
+        [Request(p, max_new_tokens=2) for p in prompts[:n_slots]])  # warm
+    mreqs = [meter.submit(Request(p, max_new_tokens=max_new))
+             for p in prompts[:n_slots]]
+    meter.step_once()
+    per_stream = meter.kv_bytes_per_stream() or 0.0
+    live_pages = max((len(getattr(r, "_pages", [])) for r in mreqs),
+                     default=0)
+    slot_stream_bytes = (2 * cfg.num_layers * cfg.num_attention_heads
+                         * s * cfg.head_dim * 4)  # float32 slot row pair
+    out.update({
+        "kv_hbm_per_stream_bytes": int(per_stream),
+        "kv_hbm_per_stream_slot_bytes": int(slot_stream_bytes),
+        "kv_hbm_per_stream_ok": bool(
+            per_stream <= live_pages * meter.page_bytes + meter.page_bytes),
+    })
+    meter.run_until_idle()
+
+    # secondary 2: shared-system-prompt TTFT — every request carries the
+    # same long prefix; with radix sharing the repeats skip that prefill.
+    # The CPU arm uses a model big enough that prefill COMPUTE dominates
+    # host dispatch, so the hit-vs-nohit margin is signal, not noise
+    if on_tpu:
+        px_model = model
+    else:
+        px_cfg = gpt_config(name, hidden_dropout_prob=0.0,
+                            attention_dropout_prob=0.0,
+                            vocab_size=256, hidden_size=256, num_layers=4,
+                            num_attention_heads=4,
+                            max_position_embeddings=128)
+        paddle.seed(0)
+        px_model = GPTForPretraining(px_cfg)
+        px_model.eval()
+    px = rng.integers(0, 256, (px_len,)).astype("int32")
+    px_prompts = [np.concatenate(
+        [px, rng.integers(0, 256, (int(t),)).astype("int32")])
+        for t in rng.integers(1, px_tail + 1, size=px_n)]
+
+    def prefix_ttft_p50(sharing):
+        e = ContinuousBatchingEngine(
+            px_model, max_seq_len=px_buckets[-1],
+            n_slots=n_slots, prefill_buckets=px_buckets,
+            max_queue=2 * px_n, page_size=page_size,
+            prefix_sharing=sharing)
+        # warm BOTH chunk buckets + the step (and, sharing arm, seed the
+        # radix tree) so the measured pass replays compiled programs only
+        e.generate_batch([Request(px_prompts[0], max_new_tokens=px_new),
+                          Request(px_prompts[0][:8], max_new_tokens=1)])
+        ttfts = []
+        for p in px_prompts:
+            r = e.submit(Request(p, max_new_tokens=px_new))
+            e.run_until_idle()
+            ttfts.append(r.ttft())
+        hit_rate = (e.page_state().get("prefix_hits", 0)
+                    / max(e.page_state().get("prefix_queries", 1), 1))
+        return percentile(ttfts, 50), hit_rate
+
+    hit_p50, hit_rate = prefix_ttft_p50(True)
+    nohit_p50, _ = prefix_ttft_p50(False)
+    out.update({
+        "prefix_hit_ttft_p50_ms": round(hit_p50 * 1e3, 2),
+        "prefix_nohit_ttft_p50_ms": round(nohit_p50 * 1e3, 2),
+        "prefix_hit_ttft_improved": bool(hit_p50 < nohit_p50),
+        "prefix_hit_rate": round(hit_rate, 3),
+        "serving_paged_trace": {
+            "page_size": page_size, "prefix_len": px_len,
+            "chunk_buckets": list(paged.chunk_buckets)},
+    })
+    return out
 
 
 def _overload_shed(on_tpu):
@@ -989,6 +1097,10 @@ def main():
         "metric": metric,
         "value": round(tput, 2),
         "unit": "tokens/s",
+        # arm tag (r15): baselines and bench-diff are arm-segregated —
+        # CPU smoke values share metric names with the on-chip lineage
+        # but are not comparable to it
+        "arm": "tpu" if on_tpu else "cpu",
         "vs_baseline": round(mfu(tput, n_params, cfg, seq) / 0.40, 4),
         "secondary": secondary,
     }
@@ -998,18 +1110,16 @@ def main():
         # same compare `python -m paddle_tpu.observability bench-diff`
         # gates CI with. Self-referential by design: the verdict rides in
         # the payload AFTER comparison, so it never compares itself.
-        # TPU arm only: the lineage is measured on-chip, and the CPU
-        # smoke arm shares metric names (vs_baseline) whose values are
-        # not comparable across arms.
         import os
 
         from paddle_tpu.observability.baseline import compare, load_baseline
 
         bl_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "benchmarks", "bench_baseline.json")
-        if not on_tpu:
-            secondary["bench_diff"] = "skipped (CPU arm; lineage is on-chip)"
-        elif not os.path.exists(bl_path):
+        # both arms self-check (r15): compare() picks the band set
+        # matching the payload's arm, so a CPU smoke run is judged only
+        # against the committed CPU-arm lineage
+        if not os.path.exists(bl_path):
             # a round that never ran its self-check must say so — an
             # absent key would be indistinguishable from pre-r14 rounds
             secondary["bench_diff"] = "skipped (no bench_baseline.json)"

@@ -130,10 +130,10 @@ def pipeline_target() -> AnalysisTarget:
 
 
 def serving_targets() -> List[AnalysisTarget]:
-    """The continuous-batching engine's prefill + decode programs."""
-    import jax
-    import jax.numpy as jnp
-
+    """The continuous-batching engine's prefill + decode programs (paged
+    KV layout — the production default since ISSUE 11; the rules must
+    prove the page pool donated and the gather-based attention free of
+    per-tick copies)."""
     import paddle_tpu as paddle
     from ..models.gpt import GPTForPretraining, gpt_config
     from ..serving.engine import ContinuousBatchingEngine
@@ -146,26 +146,14 @@ def serving_targets() -> List[AnalysisTarget]:
     model = GPTForPretraining(cfg)
     model.eval()
     eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=4)
-    n = eng.n_slots
-    prefill_args = (
-        eng._params, eng._buffers, jnp.zeros((1, 8), jnp.int32),
-        jnp.asarray(5, jnp.int32), jnp.asarray(0, jnp.int32),
-        jax.random.PRNGKey(0), jnp.float32(0.0), jnp.int32(-1),
-        jnp.float32(1.0), eng._kc, eng._vc)
-    step_args = (
-        eng._params, eng._buffers, jnp.zeros((n, 1), jnp.int32),
-        jnp.zeros((n,), jnp.int32), jnp.ones((n,), bool),
-        jnp.zeros((n,), jnp.float32), jnp.full((n,), -1, jnp.int32),
-        jnp.ones((n,), jnp.float32), jnp.zeros((n, 2), jnp.uint32),
-        eng._kc, eng._vc)
     prefill = AnalysisTarget(
-        "serving_prefill", eng._prefill_jit, prefill_args,
+        "serving_prefill", eng._prefill_jit, eng._prefill_arg_specs(8),
         tags=("serving",),
-        donate_argnums=getattr(eng, "_donate_prefill", (9, 10)))
+        donate_argnums=getattr(eng, "_donate_prefill", ()))
     decode = AnalysisTarget(
-        "serving_decode", eng._step_jit, step_args,
+        "serving_decode", eng._step_jit, eng._step_args_example(),
         tags=("serving",),
-        donate_argnums=getattr(eng, "_donate_step", (9, 10)))
+        donate_argnums=getattr(eng, "_donate_step", ()))
     return [prefill, decode]
 
 

@@ -230,6 +230,79 @@ class GPTAttention(Layer):
         # incremental-decoding KV cache (models/generation.py owns the
         # lifecycle; None = normal training/eval forward)
         cache = getattr(self, "_gen_cache", None)
+        if cache is not None and cache.get("mode") == "paged":
+            # block-paged KV pool (serving continuous batching, ISSUE 11):
+            # K/V live in a [n_pages, H, page_size, D] pool shared by every
+            # slot; each slot reads/writes through a padded page table
+            # [B, max_pages]. Writes are per-position scatters into
+            # (table[pos // ps], pos % ps); reads gather the table's pages
+            # back into position order and mask past the live length —
+            # static shapes throughout, so the one-jitted-decode-step /
+            # bounded-compile-cache invariants of the slot cache survive.
+            if self.use_rope:
+                raise NotImplementedError(
+                    "paged KV cache with rope positions is not wired "
+                    "(learned-position GPT configs only)")
+            from ..ops._primitive import primitive
+            from ..profiler.scope import scope
+
+            scale = 1.0 / (self.head_dim ** 0.5)
+            ps = int(cache["page_size"])
+
+            @primitive
+            def _paged_attn(q, k, v, poolk, poolv, pages, pos):
+                import jax
+                import jax.numpy as jnp
+
+                bb, hh, tt, dd = q.shape
+                mp = pages.shape[1]
+                cap = mp * ps
+                pos = pos.astype(jnp.int32).reshape(-1)  # [B]
+                # absolute write position of query row r in slot b
+                wpos = pos[:, None] + jnp.arange(tt, dtype=jnp.int32)[None, :]
+                # positions past the slot's page capacity (chunk padding)
+                # are redirected to the reserved trash page 0 — they are
+                # never gathered unmasked
+                pidx = jnp.clip(wpos // ps, 0, mp - 1)
+                pg = jnp.take_along_axis(pages, pidx, axis=1)
+                pg = jnp.where(wpos < cap, pg, 0)
+                off = wpos % ps
+                kw = k.transpose(0, 2, 1, 3).reshape(bb * tt, hh, dd)
+                vw = v.transpose(0, 2, 1, 3).reshape(bb * tt, hh, dd)
+                poolk = poolk.at[pg.reshape(-1), :, off.reshape(-1), :].set(
+                    kw.astype(poolk.dtype))
+                poolv = poolv.at[pg.reshape(-1), :, off.reshape(-1), :].set(
+                    vw.astype(poolv.dtype))
+                # gather the table's pages back into position order: the
+                # j axis below IS absolute sequence position, so the mask
+                # and reductions match the contiguous slot buffer bit for
+                # bit (trailing pad is where()-masked to exactly -1e30)
+                gk = poolk[pages].transpose(0, 2, 1, 3, 4).reshape(
+                    bb, hh, cap, dd)
+                gv = poolv[pages].transpose(0, 2, 1, 3, 4).reshape(
+                    bb, hh, cap, dd)
+                scores = jnp.einsum("bhtd,bhsd->bhts",
+                                    q, gk.astype(q.dtype)) * scale
+                j = jnp.arange(cap)[None, None, None, :]
+                mask = j <= wpos[:, None, :, None]
+                scores = jnp.where(mask, scores,
+                                   jnp.asarray(-1e30, scores.dtype))
+                probs = jax.nn.softmax(
+                    scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+                out = jnp.einsum("bhts,bhsd->bhtd", probs,
+                                 gv.astype(q.dtype))
+                return out, poolk, poolv
+
+            # named region (r6 scope): the perf doctor ranks the gather-
+            # based attention row as serving.paged_attn
+            with scope("serving.paged_attn"):
+                out, new_k, new_v = _paged_attn(
+                    q, k, v, cache["k"], cache["v"], cache["pages"],
+                    cache["pos"])
+            self._gen_cache = {"mode": "paged", "k": new_k, "v": new_v,
+                               "pages": cache["pages"], "pos": cache["pos"],
+                               "page_size": ps}
+            return self._finish(out, b, t)
         if cache is not None and cache.get("mode") == "buffer":
             # fixed-capacity export mode (inference.save_for_generation):
             # K/V live in a [B, H, S, D] buffer written at `pos` via

@@ -45,6 +45,7 @@ __all__ = [
     "BASELINE_SCHEMA_VERSION",
     "DEFAULT_TOLERANCES",
     "flatten_payload",
+    "payload_arm",
     "classify_metric",
     "rebuild",
     "load_baseline",
@@ -101,6 +102,15 @@ def _parsed(doc: dict) -> dict:
     return doc
 
 
+def payload_arm(doc: dict) -> str:
+    """Which bench arm produced a payload: ``"cpu"`` or ``"tpu"``.
+    Metric NAMES are shared across arms but their values are not
+    comparable (a CPU smoke run must never band a TPU lineage metric, and
+    vice versa), so baselines and compares are arm-segregated. Pre-r15
+    payloads carry no ``arm`` key — the historical lineage is on-chip."""
+    return str(_parsed(doc).get("arm", "tpu")).lower()
+
+
 def flatten_payload(doc: dict) -> Dict[str, object]:
     """One flat {metric: value} view of a bench payload: the primary
     metric under its own name, ``vs_baseline``, and every numeric/boolean
@@ -131,7 +141,12 @@ def _repo_root() -> str:
 
 
 def default_bench_glob() -> List[str]:
-    return sorted(_glob.glob(os.path.join(_repo_root(), "BENCH_*.json")))
+    """The lineage: on-chip round artifacts at the repo root plus any
+    CPU-arm artifacts committed under ``benchmarks/`` (arm-tagged payloads
+    are segregated by :func:`payload_arm` at rebuild time)."""
+    root = _repo_root()
+    return sorted(_glob.glob(os.path.join(root, "BENCH_*.json"))) + sorted(
+        _glob.glob(os.path.join(root, "benchmarks", "BENCH_cpu_*.json")))
 
 
 def default_baseline_path() -> str:
@@ -147,16 +162,42 @@ def rebuild(paths: Optional[Sequence[str]] = None,
         raise ValueError("no BENCH_*.json lineage files found")
     tol = dict(DEFAULT_TOLERANCES)
     tol.update(tolerances or {})
-    samples: Dict[str, List] = {}
-    primaries = set()
+    # arm-segregated: CPU smoke payloads share metric NAMES with the
+    # on-chip lineage but not comparable values — each arm gets its own
+    # band set ("metrics" = tpu, the historical default; "metrics_cpu")
+    samples_by_arm: Dict[str, Dict[str, List]] = {}
+    primaries_by_arm: Dict[str, set] = {}
     for path in paths:
         with open(path) as f:
             doc = json.load(f)
         p = _parsed(doc)
+        arm = payload_arm(doc)
+        samples = samples_by_arm.setdefault(arm, {})
+        primaries = primaries_by_arm.setdefault(arm, set())
         if "metric" in p:
             primaries.add(str(p["metric"]))
         for name, value in flatten_payload(doc).items():
             samples.setdefault(name, []).append(value)
+    doc = {
+        "schema_version": BASELINE_SCHEMA_VERSION,
+        "generated_by": "paddle_tpu.observability.baseline --rebuild",
+        "source_files": [os.path.basename(p) for p in paths],
+        "tolerances": tol,
+        "lineage_pad": LINEAGE_PAD,
+        "metrics": _build_metrics(samples_by_arm.get("tpu", {}),
+                                  primaries_by_arm.get("tpu", set()), tol),
+    }
+    if "cpu" in samples_by_arm:
+        doc["metrics_cpu"] = _build_metrics(
+            samples_by_arm["cpu"], primaries_by_arm.get("cpu", set()), tol)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+    return doc
+
+
+def _build_metrics(samples: Dict[str, List], primaries: set,
+                   tol: Dict[str, float]) -> dict:
     metrics = {}
     for name, values in sorted(samples.items()):
         cls = classify_metric(name, values[0])
@@ -196,18 +237,7 @@ def rebuild(paths: Optional[Sequence[str]] = None,
                     median + abs(median) * tol[cls],
                     vs[-1] + abs(vs[-1]) * LINEAGE_PAD)
         metrics[name] = entry
-    doc = {
-        "schema_version": BASELINE_SCHEMA_VERSION,
-        "generated_by": "paddle_tpu.observability.baseline --rebuild",
-        "source_files": [os.path.basename(p) for p in paths],
-        "tolerances": tol,
-        "lineage_pad": LINEAGE_PAD,
-        "metrics": metrics,
-    }
-    if out_path:
-        with open(out_path, "w") as f:
-            json.dump(doc, f, indent=1, sort_keys=True)
-    return doc
+    return metrics
 
 
 def load_baseline(path: Optional[str] = None) -> dict:
@@ -245,7 +275,12 @@ def compare(payload: dict, baseline: dict) -> dict:
     payload no longer reports (informational — a renamed metric must not
     silently drop out of the watchdog)."""
     flat = flatten_payload(payload)
-    metrics = baseline.get("metrics", {})
+    # arm-matched bands: a CPU payload is judged only against CPU-arm
+    # baselines (empty verdict when the lineage has none yet)
+    if payload_arm(payload) == "cpu":
+        metrics = baseline.get("metrics_cpu", {})
+    else:
+        metrics = baseline.get("metrics", {})
     regressions: List[Regression] = []
     compared = 0
     type_changed: List[str] = []

@@ -381,8 +381,6 @@ def _serving_entry(on_tpu: bool, ticks: int, peak_flops: float,
     batching engine (all slots active — the serving hot path)."""
     import gc
 
-    import jax
-    import jax.numpy as jnp
     import numpy as np
 
     import paddle_tpu as paddle
@@ -433,14 +431,11 @@ def _serving_entry(on_tpu: bool, ticks: int, peak_flops: float,
             if not eng.step_once():
                 break
 
-    n = eng.n_slots
-    step_args = (
-        eng._params, eng._buffers, jnp.zeros((n, 1), jnp.int32),
-        jnp.zeros((n,), jnp.int32), jnp.ones((n,), bool),
-        jnp.zeros((n,), jnp.float32), jnp.full((n,), -1, jnp.int32),
-        jnp.ones((n,), jnp.float32), jnp.zeros((n, 2), jnp.uint32),
-        eng._kc, eng._vc)
-    target = AnalysisTarget("serving_decode", eng._step_jit, step_args)
+    # layout-agnostic: the engine hands back args matching its compiled
+    # step (paged default since ISSUE 11 — the attribution table ranks
+    # the serving.paged_attn gather row)
+    target = AnalysisTarget("serving_decode", eng._step_jit,
+                            eng._step_args_example())
     att = attribute(target, peak_flops=peak_flops, peak_bw=peak_bw,
                     measured=measured_from_timers("serving.decode"),
                     measured_total_s=measured_s)

@@ -95,6 +95,7 @@ POINTS = (
     "checkpoint.write",
     "engine.tick",
     "replica.tick",
+    "serving.pages.exhausted",
     "router.transport",
     "elastic.rank.step",
     "preemption.update",

@@ -6,7 +6,13 @@ iteration-level (Orca-style) slot scheduling over ONE fixed-shape jitted
 decode step and a bounded bucketed-prefill compile cache, instead of a
 dynamic-batching executor over paged GPU kernels.
 
-    engine    — slot-based continuous batcher (fixed [n_slots, S] KV cache)
+    engine    — continuous batcher over a block-paged KV pool (fixed
+                [L, n_pages, H, page_size, D] pool + per-slot page tables,
+                radix prefix sharing, chunked prefill; the r8 slot cache
+                stays behind kv_layout="slot" as the bit-comparison
+                fallback)
+    paged     — host-side page allocator (refcounts, trash page) + radix
+                prefix tree (match/insert/LRU-evict)
     scheduler — bounded FCFS admission, power-of-2 prefill buckets, drain
     server    — threaded HTTP submit/poll/stream front-end + retrying client
     metrics   — TTFT / token latency / throughput / occupancy / compile stats
@@ -25,6 +31,11 @@ from .admission import (  # noqa: F401
 )
 from .engine import ContinuousBatchingEngine  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
+from .paged import (  # noqa: F401
+    PagePool,
+    PagesExhaustedError,
+    RadixCache,
+)
 from .scheduler import (  # noqa: F401
     FCFSScheduler,
     QueueFullError,
@@ -59,4 +70,7 @@ __all__ = [
     "AdmissionRejected",
     "DeadlineExceededError",
     "LoadShedPolicy",
+    "PagePool",
+    "RadixCache",
+    "PagesExhaustedError",
 ]

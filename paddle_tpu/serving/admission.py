@@ -19,7 +19,12 @@ Three layers, composable and individually optional:
   resident breakdown, the per-slot KV share, and the budget — operators
   see WHY in the error body, not in a log. Estimates are cached per
   bucket; pricing holds the engine's trace lock and restores the compile
-  counters (pricing is a trace, not a compile).
+  counters (pricing is a trace, not a compile). Paged KV layout (r15):
+  the gate ALSO prices the predicted **page-pool watermark** — pages
+  resident + reserved for queued admissions + this request's worst-case
+  need net of radix-resident prefixes — against the page budget; pages
+  are the allocation unit, so predicted-resident tracks true occupancy,
+  and the 429 cites ``pages{predicted/free/budget}``.
 * **Deadline propagation** — a request's ``deadline_s`` rides the r12
   header family (:data:`~paddle_tpu.observability.trace.DEADLINE_HEADER`,
   remaining-seconds relative so clock skew cannot bite). A request whose
@@ -95,36 +100,34 @@ class AdmissionGate:
     conservative against that bound)."""
 
     def __init__(self, engine, budget_bytes: int, *,
-                 safety_frac: float = 1.0, precompute: bool = False):
+                 safety_frac: float = 1.0, precompute: bool = False,
+                 page_budget: Optional[int] = None):
         self.engine = engine
         self.budget_bytes = int(budget_bytes)
         self.safety_frac = float(safety_frac)
         self._estimates: Dict[int, object] = {}  # bucket -> MemoryEstimate
         self._lock = threading.Lock()
+        # page-pool watermark (paged KV layout): pages are the allocation
+        # unit, so predicted-resident tracks true occupancy — the gate
+        # reserves each admitted request's worst-case page need until the
+        # engine allocates (or the request fails), and refuses work whose
+        # predicted watermark would exceed the pool
+        paged = getattr(engine, "kv_layout", "slot") == "paged"
+        if page_budget is None and paged:
+            page_budget = engine._pool.capacity
+        self.page_budget = None if page_budget is None else int(page_budget)
+        self._committed_pages = 0
         if precompute:
             for b in engine.scheduler.buckets:
                 self.estimate_for_bucket(b)
 
     # -- pricing --------------------------------------------------------
     def _build_estimate(self, bucket: int):
-        import jax
-
         from ..analysis.graph import AnalysisTarget
         from ..analysis.memory import estimate_memory
 
         eng = self.engine
-        sds = jax.ShapeDtypeStruct
-        params = {n: sds(p.shape, p.dtype) for n, p in eng._params.items()}
-        buffers = {n: sds(b.shape, b.dtype) for n, b in eng._buffers.items()}
-        i32 = jax.numpy.int32
-        args = (
-            params, buffers, sds((1, int(bucket)), i32), sds((), i32),
-            sds((), i32), sds((2,), jax.numpy.uint32),
-            sds((), jax.numpy.float32), sds((), i32),
-            sds((), jax.numpy.float32),
-            sds(eng._cache_shape, eng._cache_dtype),
-            sds(eng._cache_shape, eng._cache_dtype),
-        )
+        args = eng._prefill_arg_specs(bucket)
         target = AnalysisTarget(
             f"serving_prefill_b{int(bucket)}", eng._prefill_jit, args,
             tags=("serving",), donate_argnums=eng._donate_prefill)
@@ -154,10 +157,15 @@ class AdmissionGate:
         return est
 
     def kv_bytes_per_slot(self) -> int:
-        """One slot's share of the paired K/V cache."""
+        """One slot's worst-case share of the paired K/V state: the whole
+        ``[L, S, H, D]`` row for the slot layout, ``max_pages_per_slot``
+        pages for the paged layout (actual paged usage is live pages —
+        see the ``pages`` dict in :meth:`price`)."""
         eng = self.engine
         import numpy as np
 
+        if getattr(eng, "kv_layout", "slot") == "paged":
+            return eng.max_pages_per_slot * eng.page_bytes
         per_el = np.dtype(eng._cache_dtype).itemsize
         l, n, h, s, d = eng._cache_shape
         return 2 * l * h * s * d * per_el
@@ -192,27 +200,99 @@ class AdmissionGate:
         est = self.estimate_for_bucket(bucket)
         return int(est.args_bytes + est.consts_bytes)
 
+    # -- page-pool watermark (paged layout) -----------------------------
+    def page_watermark(self, req=None) -> Optional[Dict]:
+        """Predicted page-pool occupancy if ``req`` were admitted now:
+        pages currently allocated + pages reserved for queued admissions
+        + this request's worst-case need (net of resident shared
+        prefixes). None for the slot layout."""
+        eng = self.engine
+        if getattr(eng, "kv_layout", "slot") != "paged":
+            return None
+        state = eng.page_state()
+        need = eng.pages_needed(req) if req is not None else 0
+        with self._lock:
+            committed = self._committed_pages
+        return {
+            "predicted": state["used"] + committed + need,
+            "needed": need,
+            "committed_queued": committed,
+            "used": state["used"],
+            "free": state["free"],
+            "budget": self.page_budget,
+            "page_bytes": state["page_bytes"],
+        }
+
+    def settle(self, req):
+        """The engine placed (or failed) a request whose page reservation
+        this gate holds — release it. Idempotent per request."""
+        n = getattr(req, "_page_commit", None)
+        if n:
+            req._page_commit = None
+            with self._lock:
+                self._committed_pages = max(self._committed_pages - int(n), 0)
+
     # -- the gate -------------------------------------------------------
     def check(self, req) -> Dict:
         """Admit or refuse ``req``; returns the price on admit, raises
-        :class:`AdmissionRejected` (estimate attached) on refusal."""
+        :class:`AdmissionRejected` (estimate attached) on refusal. Paged
+        layout: the refusal cites the predicted page-pool watermark
+        (predicted/free/budget) alongside the liveness bytes."""
         bucket = req.bucket or self.engine.scheduler.bucket_for(
             req.prompt.size)
         price = self.price(bucket)
         if price["predicted_peak_hbm_bytes"] > self.budget_bytes:
-            try:
-                hint = self.engine.metrics.retry_after_hint(
-                    queue_depth=self.engine.scheduler.depth())
-            except Exception:
-                hint = 1.0
+            pages = self.page_watermark(req)
+            if pages is not None:
+                price["pages"] = pages
             raise AdmissionRejected(
                 f"admission refused: predicted KV+prefill HBM "
                 f"{price['predicted_peak_hbm_bytes']} bytes exceeds the "
                 f"device budget {self.budget_bytes} bytes "
                 f"(bucket {bucket}, liveness peak at "
                 f"{price['peak_site'] or 'entry'})",
-                estimate=price, retry_after=hint)
+                estimate=price, retry_after=self._hint())
+        eng = self.engine
+        if getattr(eng, "kv_layout", "slot") == "paged":
+            state = eng.page_state()
+            need = eng.pages_needed(req)
+            # predict-compare-COMMIT under one lock: two concurrent
+            # submits must not both read the pre-commit reservation count
+            # and jointly over-admit past the page budget
+            with self._lock:
+                pages = {
+                    "predicted": state["used"] + self._committed_pages
+                                 + need,
+                    "needed": need,
+                    "committed_queued": self._committed_pages,
+                    "used": state["used"],
+                    "free": state["free"],
+                    "budget": self.page_budget,
+                    "page_bytes": state["page_bytes"],
+                }
+                admitted = pages["predicted"] <= pages["budget"]
+                if admitted:
+                    req._page_commit = need
+                    self._committed_pages += need
+            price["pages"] = pages
+            if not admitted:
+                raise AdmissionRejected(
+                    f"admission refused: predicted page-pool watermark "
+                    f"{pages['predicted']} pages (resident "
+                    f"{pages['used']} + queued "
+                    f"{pages['committed_queued']} + this request "
+                    f"{pages['needed']}) exceeds the page budget "
+                    f"{pages['budget']} ({pages['free']} free, "
+                    f"{pages['page_bytes']} B/page)",
+                    estimate=price, retry_after=self._hint())
         return price
+
+    def _hint(self) -> float:
+        try:
+            return self.engine.metrics.retry_after_hint(
+                queue_depth=self.engine.scheduler.depth())
+        except Exception:
+            return 1.0
 
 
 class LoadShedPolicy:

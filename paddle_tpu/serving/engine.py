@@ -1,4 +1,4 @@
-"""Slot-based continuous-batching engine — iteration-level scheduling on TPU.
+"""Continuous-batching engine — iteration-level scheduling on TPU.
 
 Parity: the reference serves production decoding through AnalysisPredictor's
 ZeroCopyRun over exported programs and batches requests in Paddle Serving's
@@ -7,28 +7,29 @@ batching** (Orca, OSDI'22; popularized by vLLM): requests join and leave a
 shared decode batch *between* iterations instead of waiting for a full batch
 to finish.
 
-TPU-native design — fixed shapes, bounded compile cache, no paged kernels:
+TPU-native design — fixed shapes, bounded compile cache, no dynamic kernels.
+Two KV layouts, selected by ``kv_layout``:
 
-* ONE jitted decode step over a fixed ``[L, n_slots, H, S, D]`` K/V cache.
-  Per-slot position vectors drive per-row ``dynamic_update_slice`` writes and
-  per-row causal masks (models/gpt.py buffer-mode attention), so slots at
-  different sequence positions decode together with zero recompilation.
-* Sequences JOIN by prefilling into a free slot: the prompt is padded to a
-  power-of-2 bucket (``scheduler.power_of_two_buckets``), the prefill program
-  writes the slot's K/V rows via ``dynamic_update_slice`` and samples the
-  first token in-graph. Compile cache over any workload: ``len(buckets)``
-  prefill programs + 1 decode step (asserted by ``trace_count``).
-* Sequences LEAVE when they emit eos / hit max_new_tokens — the slot is freed
-  host-side (the freed row keeps computing garbage that nothing reads; rows
-  are independent through the network, so active slots are unaffected).
-* Per-request sampling params ride IN-GRAPH as per-slot arrays (temperature /
-  top_k / top_p + per-slot PRNG key chains split inside the step), so a batch
-  mixing greedy and nucleus requests shares the single compiled step
-  (``models.generation.sample_tokens``).
+* ``"paged"`` (default, ISSUE 11): a block-paged KV pool — one fixed
+  ``[L, n_pages, H, page_size, D]`` array pair plus a per-slot page table
+  padded to ``max_pages_per_slot`` (attention gathers the table's pages
+  back into position order and masks past the live length, so the step
+  stays ONE jitted program). Pages are allocated lazily (prompt pages at
+  admission, decode pages on demand), refcounted, and shared across
+  requests through a host-side radix tree over prompt prefixes
+  (``serving/paged.py``): a request whose prompt prefix is already
+  resident skips that part of prefill entirely, with copy-on-write of the
+  final page when the WHOLE prompt is resident. Long prompts prefill in
+  page-aligned **chunks** (``prefill_chunk``) interleaved with decode
+  ticks, so a 4k-token prompt no longer stalls every in-flight stream.
+  Compile cache: at most ``len(chunk_buckets)`` prefill programs + 1
+  decode step (asserted by ``trace_count``).
+* ``"slot"`` (the r8 fallback, kept for bit-comparison): a monolithic
+  ``[L, n_slots, H, S, D]`` cache where every slot pays max-seq-len HBM.
 
-Greedy decoding through the engine is token-for-token identical to
+Greedy decoding through either layout is token-for-token identical to
 sequential ``models.generate`` (tested), which is what makes continuous
-batching a pure throughput win rather than a quality trade.
+batching — and paging — a pure throughput/memory win, not a quality trade.
 """
 from __future__ import annotations
 
@@ -42,6 +43,7 @@ import numpy as np
 
 from ..observability import trace as obstrace
 from .metrics import ServingMetrics
+from .paged import TRASH_PAGE, PagePool, PagesExhaustedError, RadixCache
 from .scheduler import FCFSScheduler, Request, power_of_two_buckets
 
 __all__ = ["ContinuousBatchingEngine"]
@@ -71,6 +73,13 @@ class ContinuousBatchingEngine:
     ``inference.save_for_generation``). ``max_seq_len``: per-slot KV capacity
     S (prompt + generated must fit). ``prefill_buckets``: padded prompt
     lengths; defaults to power-of-2 buckets up to S.
+
+    Paged-layout knobs: ``page_size`` (tokens per KV page), ``n_pages``
+    (pool capacity; default fully provisions ``n_slots`` slots — set it
+    lower to overcommit and let prefix sharing make up the difference),
+    ``prefill_chunk`` (max tokens prefilled per tick for one request; None
+    = whole prompt in one program), ``prefix_sharing`` (radix-tree prompt
+    reuse on/off).
     """
 
     def __init__(self, model, max_seq_len: int, n_slots: int = 8,
@@ -80,7 +89,11 @@ class ContinuousBatchingEngine:
                  max_queue: int = 64, max_prefills_per_tick: int = 2,
                  cache_dtype: str = "float32",
                  hbm_budget_bytes: Optional[int] = None,
-                 admission_gate=None, shed_policy=None):
+                 admission_gate=None, shed_policy=None,
+                 kv_layout: str = "paged", page_size: int = 16,
+                 n_pages: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_sharing: bool = True):
         import jax.numpy as jnp
 
         from ..models.gpt import GPTForPretraining
@@ -94,10 +107,14 @@ class ContinuousBatchingEngine:
                 "(learned-position configs only)")
         from ..models.generation import _attn_layers
 
+        if kv_layout not in ("paged", "slot"):
+            raise ValueError("kv_layout must be 'paged' or 'slot'")
         model.eval()
         self.model = model
         self.n_slots = int(n_slots)
         self.max_seq_len = int(max_seq_len)
+        self.kv_layout = kv_layout
+        self._paged = kv_layout == "paged"
         self._layers = cfg.num_layers
         self._heads = cfg.num_attention_heads
         self._head_dim = cfg.head_dim
@@ -106,9 +123,66 @@ class ContinuousBatchingEngine:
                    else power_of_two_buckets(self.max_seq_len))
         if max(buckets) > self.max_seq_len:
             raise ValueError("prefill bucket exceeds max_seq_len")
+        self._cache_dtype = jnp.dtype(cache_dtype)
+
+        # -- paged-layout state (ISSUE 11) ------------------------------
+        if self._paged:
+            self.page_size = int(page_size)
+            if self.page_size < 1:
+                raise ValueError("page_size must be >= 1")
+            self.max_pages_per_slot = -(-self.max_seq_len // self.page_size)
+            per_el = np.dtype(self._cache_dtype).itemsize
+            # one page's K+V bytes across all layers — the allocation unit
+            self.page_bytes = (2 * self._layers * self._heads
+                               * self.page_size * self._head_dim * per_el)
+            if n_pages is None:
+                n_pages = 1 + self.n_slots * self.max_pages_per_slot
+            self.n_pages = int(n_pages)
+            if self.n_pages < 2:
+                raise ValueError("n_pages must be >= 2 (trash + 1)")
+            self._pool = PagePool(self.n_pages, page_bytes=self.page_bytes)
+            self._radix = (RadixCache(self._pool, self.page_size)
+                           if prefix_sharing else None)
+            if prefill_chunk is not None:
+                prefill_chunk = int(prefill_chunk)
+                if prefill_chunk < 1:
+                    raise ValueError("prefill_chunk must be >= 1")
+            self.prefill_chunk = prefill_chunk
+            limit = (prefill_chunk if prefill_chunk is not None
+                     else max(buckets))
+            self.chunk_buckets = sorted(
+                {b for b in buckets if b <= limit} | {limit})
+            self._chunk_limit = limit
+            self._pool_shape = (self._layers, self.n_pages, self._heads,
+                                self.page_size, self._head_dim)
+            self._pool_k = jnp.zeros(self._pool_shape, self._cache_dtype)
+            self._pool_v = jnp.zeros(self._pool_shape, self._cache_dtype)
+            self._page_tables = np.zeros(
+                (self.n_slots, self.max_pages_per_slot), np.int32)
+            # slot -> chunked-prefill progress ({"req", "next", "key",
+            # "cow", "t0_span" ...}); a slot here is occupied but not yet
+            # decoding
+            self._prefill_slots: Dict[int, dict] = {}
+            self.cow_pages = 0  # copy-on-write events (metrics)
+        else:
+            self.page_size = None
+            self.prefill_chunk = None
+            self.chunk_buckets = list(buckets)
+            self._pool = None
+            self._radix = None
+            self._prefill_slots = {}
+            self._cache_shape = (self._layers, self.n_slots, self._heads,
+                                 self.max_seq_len, self._head_dim)
+            self._kc = jnp.zeros(self._cache_shape, self._cache_dtype)
+            self._vc = jnp.zeros(self._cache_shape, self._cache_dtype)
+
         self.scheduler = scheduler or FCFSScheduler(
             buckets, max_queue=max_queue,
             max_prefills_per_tick=max_prefills_per_tick)
+        if self._paged and self.prefill_chunk is not None:
+            # chunked prefill admits prompts longer than the largest
+            # bucket (they split); the scheduler buckets only the chunk
+            self.scheduler.bucket_cap = self._chunk_limit
         self.metrics = metrics or ServingMetrics()
         self.metrics.n_slots = self.n_slots
 
@@ -116,11 +190,6 @@ class ContinuousBatchingEngine:
         self._params = {n: p._data for n, p in model.named_parameters()}
         self._buffers = {n: b._data for n, b in model.named_buffers()}
 
-        self._cache_dtype = jnp.dtype(cache_dtype)
-        self._cache_shape = (self._layers, self.n_slots, self._heads,
-                             self.max_seq_len, self._head_dim)
-        self._kc = jnp.zeros(self._cache_shape, self._cache_dtype)
-        self._vc = jnp.zeros(self._cache_shape, self._cache_dtype)
         # per-slot decode-state (host mirrors, shipped to device each tick)
         self._tok = np.zeros((self.n_slots,), np.int32)
         self._pos = np.zeros((self.n_slots,), np.int32)
@@ -133,7 +202,7 @@ class ContinuousBatchingEngine:
         self._seed_counter = 0
         # trace counters: the jitted bodies below run ONLY when jax traces a
         # new program, so these count compiles — the bounded-compile-cache
-        # acceptance gauge (len(buckets) prefills + 1 step)
+        # acceptance gauge (len(chunk_buckets) prefills + 1 step)
         self.trace_counts: Dict[str, int] = {"prefill": 0, "step": 0}
         self._step_jit = None
         self._prefill_jit = None
@@ -144,8 +213,9 @@ class ContinuousBatchingEngine:
         self._build_programs()
         # overload protection (serving/admission.py), both opt-in: the
         # gate prices each request's prefill against an HBM budget with
-        # the r10 liveness estimator; the shed policy bounds queue wait
-        # under sustained overload by failing the oldest queued work
+        # the r10 liveness estimator and (paged) the predicted page-pool
+        # watermark; the shed policy bounds queue wait under sustained
+        # overload by failing the oldest queued work
         if admission_gate is None and hbm_budget_bytes is not None:
             from .admission import AdmissionGate
 
@@ -155,6 +225,12 @@ class ContinuousBatchingEngine:
 
     # -- traced programs ----------------------------------------------------
     def _build_programs(self):
+        if self._paged:
+            self._build_programs_paged()
+        else:
+            self._build_programs_slot()
+
+    def _build_programs_slot(self):
         import jax
         import jax.numpy as jnp
 
@@ -257,17 +333,214 @@ class ContinuousBatchingEngine:
         self._step_jit = jax.jit(
             step_fn, donate_argnums=() if on_cpu else self._donate_step)
 
+    def _build_programs_paged(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..autograd.tape import no_grad
+        from ..models.generation import sample_tokens
+        from ..ops._primitive import unwrap, wrap
+        from ..profiler.scope import scope
+
+        model, attns = self.model, self._attns
+        ps = self.page_size
+
+        def _forward(params, buffers, ids_t, position_ids_t):
+            out, _ = model.functional_call_with_state(
+                params, buffers, ids_t, position_ids_t)
+            return unwrap(out)
+
+        def _set_caches(pk, pv, pages, pos):
+            for li, a in enumerate(attns):
+                a._gen_cache = {"mode": "paged", "k": pk[li], "v": pv[li],
+                                "pages": pages, "pos": pos,
+                                "page_size": ps}
+
+        def _collect_caches():
+            pk = jnp.stack([unwrap(a._gen_cache["k"]) for a in attns])
+            pv = jnp.stack([unwrap(a._gen_cache["v"]) for a in attns])
+            return pk, pv
+
+        def _clear_caches():
+            for a in attns:
+                if hasattr(a, "_gen_cache"):
+                    del a._gen_cache
+
+        def prefill_fn(params, buffers, ids, start, rlen, is_final, pages,
+                       key, temp, topk, topp, cow_src, cow_dst, pk, pv):
+            # ONE page-aligned-or-COW chunk of a prompt: ids [1, Tc]
+            # chunk-bucket-padded, start = absolute position of ids[0,0],
+            # rlen = real tokens in this chunk. The chunk attends to the
+            # slot's resident pages (shared prefix + earlier chunks)
+            # through `pages` and writes its own K/V into them. Sampling
+            # happens every call (one program per chunk LENGTH only) but
+            # the key advances — and the token matters — only when
+            # is_final is set.
+            self.trace_counts["prefill"] += 1
+            # copy-on-write BEFORE any write lands: duplicate one page
+            # (src==dst==0 is the trash-page no-op) so a whole-prompt
+            # prefix hit can recompute its final token into a private
+            # copy without mutating the shared page
+            pk = pk.at[:, cow_dst].set(jnp.take(pk, cow_src, axis=1))
+            pv = pv.at[:, cow_dst].set(jnp.take(pv, cow_src, axis=1))
+            start = start.astype(jnp.int32)
+            tc = ids.shape[1]
+            pos_ids = (start + jnp.arange(tc, dtype=jnp.int32))[None, :]
+            _set_caches(pk, pv, pages[None, :], start[None])
+            try:
+                with no_grad():
+                    logits = _forward(params, buffers, wrap(ids),
+                                      wrap(pos_ids))
+                pk, pv = _collect_caches()
+            finally:
+                _clear_caches()
+            last = jax.lax.dynamic_slice(
+                logits, (jnp.zeros((), jnp.int32), rlen - 1,
+                         jnp.zeros((), jnp.int32)),
+                (1, 1, logits.shape[-1]))[:, 0]
+            key2, sub = jax.random.split(key)
+            with scope("serving.sample"):
+                tok = sample_tokens(last.astype(jnp.float32), sub,
+                                    temp, topk, topp)[0]
+            first = jnp.where(is_final, tok.astype(jnp.int32),
+                              jnp.zeros((), jnp.int32))
+            new_key = jnp.where(is_final, key2, key)
+            return first, new_key, pk, pv
+
+        def step_fn(params, buffers, tok, pos, active, temp, topk, topp,
+                    keys, tables, pk, pv):
+            # one decode token for every active slot, through the pool:
+            # writes scatter into (tables[slot, pos//ps], pos%ps); reads
+            # gather the tables' pages back into position order
+            self.trace_counts["step"] += 1
+            posj = pos.astype(jnp.int32)
+            _set_caches(pk, pv, tables, posj)
+            try:
+                with no_grad():
+                    logits = _forward(params, buffers, wrap(tok),
+                                      wrap(posj[:, None]))
+                pk, pv = _collect_caches()
+            finally:
+                _clear_caches()
+            pair = jax.vmap(lambda k_: jax.random.split(k_))(keys)
+            with scope("serving.sample"):
+                nxt = sample_tokens(
+                    logits[:, -1].astype(jnp.float32),
+                    pair[:, 1], temp, topk, topp).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, 0)
+            new_tok = jnp.where(active, nxt, tok[:, 0])[:, None]
+            new_pos = jnp.where(active, posj + 1, posj)
+            new_keys = jnp.where(active[:, None], pair[:, 0], keys)
+            return nxt, new_tok, new_pos, new_keys, pk, pv
+
+        # donate the page pool and PRNG key chains: the pool is the ONLY
+        # large mutable state, threaded through every call — donation
+        # makes each tick an in-place update instead of a full-pool copy
+        # (recorded unconditionally for the analysis donation lint — the
+        # TPU deployment contract — applied off-CPU where XLA honors it)
+        self._donate_prefill = (7, 13, 14)  # key, pool_k, pool_v
+        self._donate_step = (8, 10, 11)     # keys, pool_k, pool_v
+        on_cpu = jax.default_backend() == "cpu"
+        self._prefill_jit = jax.jit(
+            prefill_fn, donate_argnums=() if on_cpu else self._donate_prefill)
+        self._step_jit = jax.jit(
+            step_fn, donate_argnums=() if on_cpu else self._donate_step)
+
+    # -- program arg specs (admission pricing, analysis, perf doctor) ------
+    def _prefill_arg_specs(self, bucket: int):
+        """ShapeDtypeStruct tuple matching ``_prefill_jit`` at ``bucket``
+        (the admission gate prices this without compiling)."""
+        import jax
+
+        sds = jax.ShapeDtypeStruct
+        i32, f32, u32 = np.int32, np.float32, np.uint32
+        params = {n: sds(p.shape, p.dtype) for n, p in self._params.items()}
+        buffers = {n: sds(b.shape, b.dtype) for n, b in self._buffers.items()}
+        if self._paged:
+            return (params, buffers, sds((1, int(bucket)), i32),
+                    sds((), i32), sds((), i32), sds((), np.bool_),
+                    sds((self.max_pages_per_slot,), i32), sds((2,), u32),
+                    sds((), f32), sds((), i32), sds((), f32),
+                    sds((), i32), sds((), i32),
+                    sds(self._pool_shape, self._cache_dtype),
+                    sds(self._pool_shape, self._cache_dtype))
+        return (params, buffers, sds((1, int(bucket)), i32), sds((), i32),
+                sds((), i32), sds((2,), u32), sds((), f32), sds((), i32),
+                sds((), f32),
+                sds(self._cache_shape, self._cache_dtype),
+                sds(self._cache_shape, self._cache_dtype))
+
+    def _step_args_example(self):
+        """Concrete arrays matching ``_step_jit`` (analysis entry points,
+        perf doctor) — every slot marked active."""
+        import jax.numpy as jnp
+
+        n = self.n_slots
+        common = (self._params, self._buffers,
+                  jnp.zeros((n, 1), jnp.int32), jnp.zeros((n,), jnp.int32),
+                  jnp.ones((n,), bool), jnp.zeros((n,), jnp.float32),
+                  jnp.full((n,), -1, jnp.int32), jnp.ones((n,), jnp.float32),
+                  jnp.zeros((n, 2), jnp.uint32))
+        if self._paged:
+            return common + (jnp.asarray(self._page_tables),
+                             self._pool_k, self._pool_v)
+        return common + (self._kc, self._vc)
+
     # -- public API ---------------------------------------------------------
     @property
     def trace_count(self) -> int:
-        """Total compiled programs (prefill buckets used + decode step)."""
+        """Total compiled programs (prefill chunk buckets used + decode
+        step)."""
         return self.trace_counts["prefill"] + self.trace_counts["step"]
 
     def free_slots(self) -> int:
-        return int((~self._active).sum())
+        return sum(1 for r in self._slots if r is None)
 
     def active_slots(self) -> int:
-        return int(self._active.sum())
+        """Occupied slots: decoding OR mid-chunked-prefill (both hold
+        pages and both must block a drain)."""
+        return self.n_slots - self.free_slots()
+
+    def _busy(self) -> bool:
+        return bool(self._active.any()) or bool(self._prefill_slots)
+
+    # -- page accounting (paged layout) -------------------------------------
+    def pages_needed(self, req: Request) -> int:
+        """Worst-case NEW pages this request will allocate over its
+        lifetime, net of the prefix pages currently resident in the radix
+        tree — the admission gate's per-request watermark increment."""
+        if not self._paged:
+            return 0
+        total = -(-(req.prompt.size + req.max_new_tokens) // self.page_size)
+        shared = self._radix.peek(req.prompt) if self._radix else 0
+        # a whole-prompt hit still copies one page (copy-on-write)
+        if shared * self.page_size >= req.prompt.size and shared > 0:
+            shared -= 1
+        return max(total - shared, 1)
+
+    def page_state(self) -> Dict[str, int]:
+        """Live pool occupancy (free/used/shared/capacity/page_bytes) plus
+        prefix-sharing counters; empty dict for the slot layout."""
+        if not self._paged:
+            return {}
+        st = self._pool.state()
+        st["cow_pages"] = self.cow_pages
+        if self._radix is not None:
+            st["prefix_queries"] = self._radix.queries
+            st["prefix_hits"] = self._radix.hits
+            st["prefix_hit_tokens"] = self._radix.hit_tokens
+        return st
+
+    def kv_bytes_per_stream(self) -> Optional[float]:
+        """Measured KV HBM per occupied stream: allocated pages × page
+        bytes / occupied slots (None when idle). The paged win over the
+        slot layout's ``2·L·H·S·D`` per slot, as a live gauge."""
+        if not self._paged:
+            return None
+        occupied = self.active_slots()
+        if not occupied:
+            return None
+        return self._pool.used_count() * self.page_bytes / occupied
 
     def submit(self, prompt, **kwargs) -> Request:
         """Admit one request (FCFS). Raises QueueFullError / SchedulerClosed
@@ -297,12 +570,37 @@ class ContinuousBatchingEngine:
             self.scheduler.submit(req)
         except Exception:
             self.metrics.on_reject()
+            self._settle_gate(req)
             raise
         self.metrics.on_submit()
         return req
 
+    def _settle_gate(self, req: Request):
+        """Release the admission gate's page-watermark reservation for a
+        request that left the queue (allocated its pages, or failed)."""
+        gate = self.admission_gate
+        if gate is not None:
+            try:
+                gate.settle(req)
+            except Exception:
+                pass
+
     # -- engine ticks -------------------------------------------------------
     def _admit_one(self, req: Request, slot_idx: int) -> bool:
+        if self._paged:
+            return self._admit_one_paged(req, slot_idx)
+        return self._admit_one_slot(req, slot_idx)
+
+    def _record_queue_span(self, req: Request):
+        if obstrace.tracing_enabled() and req.trace_id is not None:
+            return obstrace.record_span(
+                "serving.queue_wait", ts=req.submitted_wall,
+                dur=time.perf_counter() - req.submitted_at,
+                trace_id=req.trace_id, parent_id=req.parent_span_id,
+                attrs={"request_id": req.request_id})
+        return None
+
+    def _admit_one_slot(self, req: Request, slot_idx: int) -> bool:
         """Prefill ``req`` into ``slot_idx``; False when the request finished
         at prefill (slot stays free)."""
         import jax
@@ -314,23 +612,12 @@ class ContinuousBatchingEngine:
         bucket = req.bucket or self.scheduler.bucket_for(t0)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :t0] = req.prompt
-        if req.seed is None:
-            self._seed_counter += 1
-            seed = self._seed_counter
-        else:
-            seed = int(req.seed)
-        key = jax.random.PRNGKey(seed)
+        key = jax.random.PRNGKey(self._seed_for(req))
         before = self.trace_counts["prefill"]
         # request-scoped spans: queue wait is recorded retrospectively
         # (submit → this admission), and the prefill span parents the
         # per-token decode spans — route ⊃ queue ⊃ prefill ⊃ decode
-        queue_span = None
-        if obstrace.tracing_enabled() and req.trace_id is not None:
-            queue_span = obstrace.record_span(
-                "serving.queue_wait", ts=req.submitted_wall,
-                dur=time.perf_counter() - req.submitted_at,
-                trace_id=req.trace_id, parent_id=req.parent_span_id,
-                attrs={"request_id": req.request_id})
+        queue_span = self._record_queue_span(req)
         t_prefill_wall, t_prefill = time.time(), time.perf_counter()
         # first use of a bucket traces, and tracing mutates the SHARED
         # model's attention layers — exclude other engines on this model
@@ -370,14 +657,253 @@ class ContinuousBatchingEngine:
             self._retire(slot_idx, req)
             return False
         self._slots[slot_idx] = req
+        self._activate(slot_idx, req, first, t0, key)
+        return True
+
+    def _activate(self, slot_idx: int, req: Request, first: int, pos: int,
+                  key):
         self._active[slot_idx] = True
         self._tok[slot_idx] = first
-        self._pos[slot_idx] = t0
+        self._pos[slot_idx] = pos
         self._temp[slot_idx] = req.temperature
         self._topk[slot_idx] = -1 if req.top_k is None else req.top_k
         self._topp[slot_idx] = 1.0 if req.top_p is None else req.top_p
         self._keys[slot_idx] = np.asarray(key, np.uint32)
+
+    def _seed_for(self, req: Request) -> int:
+        if req.seed is None:
+            self._seed_counter += 1
+            return self._seed_counter
+        return int(req.seed)
+
+    # -- paged admission + chunked prefill ----------------------------------
+    def _alloc_pages(self, n: int, phase: str):
+        """Allocate ``n`` pages, evicting cold radix prefixes under
+        pressure. The ``serving.pages.exhausted`` injection point fires
+        here (deterministic trigger counts — one per allocation event),
+        so the r13 inject plane can prove the victim-only failure path
+        without actually shrinking the pool."""
+        from ..resilience.inject import fire as _inject_fire
+
+        if n <= 0:
+            return []
+        _inject_fire("serving.pages.exhausted", phase=phase, n=int(n))
+        evict = self._radix.evict if self._radix is not None else None
+        return self._pool.alloc(n, evict=evict)
+
+    def _release_request_pages(self, req: Request, slot_idx: Optional[int]):
+        pages = getattr(req, "_pages", None)
+        if pages:
+            self._pool.release(pages)
+            req._pages = []
+        if slot_idx is not None:
+            self._page_tables[slot_idx] = TRASH_PAGE
+
+    def _admit_one_paged(self, req: Request, slot_idx: int) -> bool:
+        """Match the prompt's shared prefix, allocate private prompt
+        pages, and run the FIRST prefill chunk; further chunks (long
+        prompts) continue on later ticks interleaved with decode. False
+        when the request finished (or failed) without occupying the
+        slot."""
+        ps = self.page_size
+        t0 = req.prompt.size
+        req._pages = []
+        try:
+            matched: List[int] = []
+            if self._radix is not None:
+                matched = self._radix.match(req.prompt)
+                req._pages.extend(matched)
+            resume = len(matched) * ps
+            cow = (0, 0)
+            if matched and resume >= t0:
+                # whole prompt resident: recompute only the LAST token's
+                # KV (its logits seed sampling) into a copy-on-write
+                # duplicate of the final shared page
+                cow_page = self._alloc_pages(1, "cow")[0]
+                req._pages.append(cow_page)
+                cow = (matched[-1], cow_page)
+                resume = t0 - 1
+                self.cow_pages += 1
+                self.metrics.on_cow()
+            # private pages covering the unmatched prompt tail (decode
+            # pages are allocated lazily, tick by tick)
+            first_pi = resume // ps if cow == (0, 0) else len(matched)
+            last_pi = (t0 - 1) // ps
+            fresh = self._alloc_pages(max(last_pi - first_pi + 1, 0)
+                                      if cow == (0, 0) else 0, "prompt")
+            req._pages.extend(fresh)
+            table = self._page_tables[slot_idx]
+            table[:] = TRASH_PAGE
+            for i, p in enumerate(matched):
+                table[i] = p
+            if cow != (0, 0):
+                table[len(matched) - 1] = cow[1]
+            for i, p in enumerate(fresh):
+                table[first_pi + i] = p
+        except Exception:
+            self._release_request_pages(req, slot_idx)
+            raise
+        self._settle_gate(req)
+        queue_span = self._record_queue_span(req)
+        import jax
+
+        key = jax.random.PRNGKey(self._seed_for(req))
+        state = {"req": req, "next": int(resume), "key": key, "cow": cow,
+                 "queue_span": queue_span, "chunks": 0}
+        self._slots[slot_idx] = req
+        self._prefill_slots[slot_idx] = state
+        try:
+            return self._run_chunk(slot_idx, state)
+        except Exception:
+            self._free_paged_slot(slot_idx, req)
+            raise
+
+    def _free_paged_slot(self, slot_idx: int, req: Request):
+        self._release_request_pages(req, slot_idx)
+        self._prefill_slots.pop(slot_idx, None)
+        self._slots[slot_idx] = None
+        self._active[slot_idx] = False
+
+    def _chunk_bucket_for(self, rlen: int) -> int:
+        for b in self.chunk_buckets:
+            if rlen <= b:
+                return b
+        return self.chunk_buckets[-1]
+
+    def _run_chunk(self, slot_idx: int, state: dict) -> bool:
+        """Dispatch ONE prefill chunk for a mid-prefill slot. Returns True
+        while the slot stays occupied (more chunks, or activated for
+        decode); False when the request finished at prefill."""
+        import jax.numpy as jnp
+
+        from ..profiler.scope import scope
+
+        req: Request = state["req"]
+        t0 = req.prompt.size
+        start = state["next"]
+        rlen = min(t0 - start, self._chunk_limit)
+        bucket = self._chunk_bucket_for(rlen)
+        is_final = start + rlen >= t0
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :rlen] = req.prompt[start:start + rlen]
+        cow = state["cow"] if state["chunks"] == 0 else (0, 0)
+        before = self.trace_counts["prefill"]
+        t_prefill_wall, t_prefill = time.time(), time.perf_counter()
+        guard = (contextlib.nullcontext() if bucket in self._traced_buckets
+                 else self._trace_lock)
+        with scope("serving.prefill"), guard:
+            first, key, self._pool_k, self._pool_v = self._prefill_jit(
+                self._params, self._buffers, jnp.asarray(ids),
+                jnp.asarray(np.int32(start)), jnp.asarray(np.int32(rlen)),
+                jnp.asarray(bool(is_final)),
+                jnp.asarray(self._page_tables[slot_idx]),
+                state["key"], jnp.float32(req.temperature),
+                jnp.int32(-1 if req.top_k is None else req.top_k),
+                jnp.float32(1.0 if req.top_p is None else req.top_p),
+                jnp.asarray(np.int32(cow[0])), jnp.asarray(np.int32(cow[1])),
+                self._pool_k, self._pool_v)
+        self._traced_buckets.add(bucket)
+        compiled = self.trace_counts["prefill"] > before
+        state["key"] = key
+        state["next"] = start + rlen
+        state["chunks"] += 1
+        if state["queue_span"] is not None:
+            prefill_span = obstrace.record_span(
+                "serving.prefill", ts=t_prefill_wall,
+                dur=time.perf_counter() - t_prefill,
+                trace_id=req.trace_id,
+                parent_id=state["queue_span"].span_id,
+                attrs={"request_id": req.request_id, "bucket": int(bucket),
+                       "prompt_len": int(t0), "slot": int(slot_idx),
+                       "chunk_start": int(start), "compiled": compiled})
+            if prefill_span is not None:
+                req._decode_span_parent = prefill_span.span_id
+        self.metrics.on_prefill(compiled)
+        if not is_final:
+            return True  # slot stays in _prefill_slots; decode interleaves
+        # final chunk: the first token was sampled in-graph
+        del self._prefill_slots[slot_idx]
+        if self._radix is not None:
+            full = t0 // self.page_size
+            if full:
+                self._radix.insert(
+                    req.prompt, [int(p) for p in
+                                 self._page_tables[slot_idx][:full]])
+        first = int(first)
+        req.state = Request.RUNNING
+        req._append(first)
+        self.metrics.on_first_token(req.first_token_at - req.submitted_at,
+                                    trace_id=req.trace_id)
+        self.metrics.on_tokens(1)
+        if self._request_finished(req, first):
+            self._retire(slot_idx, req)
+            self._free_paged_slot(slot_idx, req)
+            return False
+        self._activate(slot_idx, req, first, t0, key)
         return True
+
+    def _advance_prefills(self, budget: int) -> int:
+        """Continue chunked prefills (oldest slot first), re-checking each
+        request's deadline BEFORE its next chunk: a request admitted
+        pre-chunking can expire mid-prefill and must be shed with the
+        typed 503 instead of burning more prefill programs. Returns the
+        number of chunk programs dispatched."""
+        ran = 0
+        for slot_idx in sorted(self._prefill_slots):
+            if ran >= budget:
+                break
+            if slot_idx not in self._prefill_slots:
+                # a previous chunk's failure took the whole pool with it
+                # (donated call died) and fail_pending already cleared
+                # every mid-prefill slot — nothing left to advance
+                continue
+            state = self._prefill_slots[slot_idx]
+            req = state["req"]
+            if req.deadline_expired():
+                # deadline re-check after chunked-prefill waits: typed
+                # 503, sweep counters intact, pages released
+                self._fail_deadline(req, where="mid-prefill")
+                self._free_paged_slot(slot_idx, req)
+                continue
+            try:
+                self._run_chunk(slot_idx, state)
+            except Exception as e:
+                msg = f"prefill failed: {type(e).__name__}: {e}"
+                req._finish(Request.FAILED, msg)
+                self._free_paged_slot(slot_idx, req)
+                if self._cache_lost():
+                    self.fail_pending(msg, _locked=True)
+            ran += 1
+        return ran
+
+    def _ensure_decode_pages(self):
+        """Lazy decode-page allocation: before the step, every active slot
+        whose next write position crosses into an unallocated page gets
+        one. Exhaustion (real or injected) fails ONLY the victim request
+        and releases its refcounted pages — every other slot decodes on."""
+        ps = self.page_size
+        for i in range(self.n_slots):
+            if not self._active[i]:
+                continue
+            pi = int(self._pos[i]) // ps
+            if pi >= self.max_pages_per_slot:
+                continue
+            if self._page_tables[i, pi] != TRASH_PAGE:
+                continue
+            req = self._slots[i]
+            try:
+                page = self._alloc_pages(1, "decode")[0]
+            except Exception as e:
+                req._finish(
+                    Request.FAILED,
+                    f"{PagesExhaustedError.error_type}: page pool "
+                    f"exhausted mid-generation after {len(req.tokens)} "
+                    f"tokens: {e}",
+                    error_type=PagesExhaustedError.error_type)
+                self._free_paged_slot(i, req)
+                continue
+            req._pages.append(page)
+            self._page_tables[i, pi] = page
 
     def _request_finished(self, req: Request, token: int) -> bool:
         if req.eos_token_id is not None and token == req.eos_token_id:
@@ -387,17 +913,21 @@ class ContinuousBatchingEngine:
     def _retire(self, slot_idx: int, req: Request):
         req._finish(Request.DONE)
         self.metrics.on_complete()
+        if self._paged:
+            self._release_request_pages(req, slot_idx)
 
-    def _fail_deadline(self, req: Request):
+    def _fail_deadline(self, req: Request, where: str = "queue"):
         from .admission import DEADLINE_ERROR_TYPE
 
         waited = time.perf_counter() - req.submitted_at
         req._finish(
             Request.FAILED,
             f"{DEADLINE_ERROR_TYPE}: deadline_s={req.deadline_s} elapsed "
-            f"after {waited:.3f}s in queue (shed before prefill)",
+            f"after {waited:.3f}s (shed {where}, before "
+            f"{'its next chunk' if where == 'mid-prefill' else 'prefill'})",
             error_type=DEADLINE_ERROR_TYPE)
         self.metrics.on_shed("deadline")
+        self._settle_gate(req)
 
     def _fail_shed(self, req: Request):
         from .admission import SHED_ERROR_TYPE
@@ -410,11 +940,13 @@ class ContinuousBatchingEngine:
             f"prefill; retry after {hint:.1f}s",
             error_type=SHED_ERROR_TYPE)
         self.metrics.on_shed("overload")
+        self._settle_gate(req)
 
     def step_once(self) -> bool:
-        """One engine tick: admit waiting requests into free slots (bounded
-        by the scheduler's interleave policy), then run ONE decode step for
-        every active slot. Returns False when there was nothing to do."""
+        """One engine tick: continue chunked prefills, admit waiting
+        requests into free slots (bounded by the scheduler's interleave
+        policy), then run ONE decode step for every active slot. Returns
+        False when there was nothing to do."""
         import jax.numpy as jnp
 
         from ..profiler.scope import scope
@@ -425,7 +957,7 @@ class ContinuousBatchingEngine:
         # stall sleeps here — both without touching engine state. Fired
         # only on PRODUCTIVE ticks: idle polls are timing-dependent and
         # must not advance trigger counts
-        if self._active.any() or self.scheduler.depth() > 0:
+        if self._busy() or self.scheduler.depth() > 0:
             _inject_fire("engine.tick",
                          replica=getattr(self, "_replica_addr", None))
         with self._lock:
@@ -437,9 +969,16 @@ class ContinuousBatchingEngine:
             for req in self.scheduler.sweep_expired():
                 self._fail_deadline(req)
                 did = True
-            free = [i for i in range(self.n_slots) if not self._active[i]]
-            if free:
-                for req in self.scheduler.take_admissions(len(free)):
+            budget = self.scheduler.max_prefills_per_tick
+            if self._prefill_slots:
+                ran = self._advance_prefills(budget)
+                budget -= ran
+                did = did or ran > 0
+            free = [i for i in range(self.n_slots)
+                    if self._slots[i] is None and not self._active[i]]
+            if free and budget > 0:
+                for req in self.scheduler.take_admissions(
+                        min(len(free), budget)):
                     slot = free.pop(0)
                     if req.deadline_expired():
                         # the mid-queue-expiry race: the deadline lapsed
@@ -457,16 +996,12 @@ class ContinuousBatchingEngine:
                         # fail IT (it already left the scheduler) and move on
                         msg = f"prefill failed: {type(e).__name__}: {e}"
                         req._finish(Request.FAILED, msg)
+                        self._settle_gate(req)
                         occupied = False
                         if self._cache_lost():
                             # the donated cache died with the call: in-flight
                             # slots lost their K/V — fail them, fresh cache
-                            for j, r2 in enumerate(self._slots):
-                                if r2 is not None:
-                                    r2._finish(Request.FAILED, msg)
-                                    self._slots[j] = None
-                                    self._active[j] = False
-                            self._reset_cache()
+                            self.fail_pending(msg, _locked=True)
                     finally:
                         self.scheduler.admission_settled()
                     if not occupied:
@@ -480,20 +1015,31 @@ class ContinuousBatchingEngine:
                 for req in self.shed_policy.victims(self.scheduler):
                     self._fail_shed(req)
                     did = True
+            if self._paged and self._active.any():
+                self._ensure_decode_pages()
             if self._active.any():
                 before = self.trace_counts["step"]
                 t_step_wall = time.time()
                 t_step = time.perf_counter()
                 guard = (self._trace_lock if self.trace_counts["step"] == 0
                          else contextlib.nullcontext())
+                common = (self._params, self._buffers,
+                          jnp.asarray(self._tok[:, None]),
+                          jnp.asarray(self._pos),
+                          jnp.asarray(self._active),
+                          jnp.asarray(self._temp),
+                          jnp.asarray(self._topk),
+                          jnp.asarray(self._topp),
+                          jnp.asarray(self._keys))
                 with scope("serving.decode_step"), guard:
-                    nxt, tok, pos, keys, self._kc, self._vc = self._step_jit(
-                        self._params, self._buffers,
-                        jnp.asarray(self._tok[:, None]),
-                        jnp.asarray(self._pos), jnp.asarray(self._active),
-                        jnp.asarray(self._temp), jnp.asarray(self._topk),
-                        jnp.asarray(self._topp), jnp.asarray(self._keys),
-                        self._kc, self._vc)
+                    if self._paged:
+                        nxt, tok, pos, keys, self._pool_k, self._pool_v = \
+                            self._step_jit(
+                                *common, jnp.asarray(self._page_tables),
+                                self._pool_k, self._pool_v)
+                    else:
+                        nxt, tok, pos, keys, self._kc, self._vc = \
+                            self._step_jit(*common, self._kc, self._vc)
                 nxt = np.asarray(nxt)  # device sync: tokens must stream out
                 step_s = time.perf_counter() - t_step
                 self.metrics.on_step(self.trace_counts["step"] > before)
@@ -529,13 +1075,15 @@ class ContinuousBatchingEngine:
                 did = True
             self.metrics.set_gauges(self.scheduler.depth(),
                                     self.active_slots(), self.n_slots)
+            if self._paged:
+                self.metrics.set_page_gauges(self.page_state())
             return did
 
     def run_until_idle(self, timeout: Optional[float] = None):
         """Drive ticks until the queue is empty and every slot is free
         (used by tests, bench, and graceful drain)."""
         deadline = None if timeout is None else time.perf_counter() + timeout
-        while self.scheduler.depth() > 0 or self._active.any():
+        while self.scheduler.depth() > 0 or self._busy():
             if deadline is not None and time.perf_counter() > deadline:
                 raise TimeoutError("engine did not drain in time")
             self.step_once()
@@ -544,6 +1092,9 @@ class ContinuousBatchingEngine:
         """True when a failed DONATED call already consumed the K/V buffers
         (jax invalidates donated inputs even if the computation errors)."""
         try:
+            if self._paged:
+                return bool(self._pool_k.is_deleted()
+                            or self._pool_v.is_deleted())
             return bool(self._kc.is_deleted() or self._vc.is_deleted())
         except Exception:
             return False
@@ -551,30 +1102,58 @@ class ContinuousBatchingEngine:
     def _reset_cache(self):
         import jax.numpy as jnp
 
-        self._kc = jnp.zeros(self._cache_shape, self._cache_dtype)
-        self._vc = jnp.zeros(self._cache_shape, self._cache_dtype)
+        if self._paged:
+            self._pool_k = jnp.zeros(self._pool_shape, self._cache_dtype)
+            self._pool_v = jnp.zeros(self._pool_shape, self._cache_dtype)
+            # page CONTENT is gone with the pool: forget every allocation
+            # and resident prefix (radix pages point at reallocated zeros)
+            if self._radix is not None:
+                self._radix.clear()
+            self._pool.reset()
+            self._page_tables[:] = TRASH_PAGE
+        else:
+            self._kc = jnp.zeros(self._cache_shape, self._cache_dtype)
+            self._vc = jnp.zeros(self._cache_shape, self._cache_dtype)
 
     def fail_pending(self, error: str, _locked: bool = False):
-        """Fail every in-flight slot and queued request with ``error`` —
-        the engine loop's containment path: clients polling/streaming see
-        state FAILED instead of hanging on a silently dead loop thread.
-        Reallocates the K/V cache if the failed call donated it away, so
-        the engine keeps serving future requests."""
+        """Fail every in-flight slot (decoding or mid-prefill) and queued
+        request with ``error`` — the engine loop's containment path:
+        clients polling/streaming see state FAILED instead of hanging on a
+        silently dead loop thread. Reallocates the K/V pool if the failed
+        call donated it away, so the engine keeps serving future
+        requests."""
         ctx = contextlib.nullcontext() if _locked else self._lock
         with ctx:
             for i, req in enumerate(self._slots):
                 if req is not None:
                     req._finish(Request.FAILED, error)
+                    if self._paged:
+                        req._pages = []  # pool reset below reclaims all
                     self._slots[i] = None
                     self._active[i] = False
+            self._prefill_slots.clear()
             while self.scheduler.depth() > 0:  # interleave cap bounds each pop
                 for req in self.scheduler.take_admissions(self.scheduler.depth()):
                     req._finish(Request.FAILED, error)
+                    self._settle_gate(req)
                     self.scheduler.admission_settled()
-            if self._cache_lost():
+            if self._paged:
+                # refcounts are unrecoverable once their owners failed:
+                # rebuild the allocator (and the pool array if donated
+                # away) so future requests start from a clean pool
+                lost = self._cache_lost()
+                if self._radix is not None:
+                    self._radix.clear()
+                self._pool.reset()
+                self._page_tables[:] = TRASH_PAGE
+                if lost:
+                    self._reset_cache()
+            elif self._cache_lost():
                 self._reset_cache()
             self.metrics.set_gauges(self.scheduler.depth(),
                                     self.active_slots(), self.n_slots)
+            if self._paged:
+                self.metrics.set_page_gauges(self.page_state())
 
     def abort(self):
         """Abrupt-death hook (chaos testing / emergency teardown): the loop
@@ -601,7 +1180,7 @@ class ContinuousBatchingEngine:
                 # inside the try: a raise-kind fault at this point is
                 # contained like any tick failure below, never a
                 # silently dead loop thread
-                if self._active.any() or self.scheduler.depth() > 0:
+                if self._busy() or self.scheduler.depth() > 0:
                     f = _inject_fire(
                         "replica.tick",
                         replica=getattr(self, "_replica_addr", None))
@@ -632,7 +1211,7 @@ class ContinuousBatchingEngine:
             if did:
                 continue
             if stop_event.is_set() and self.scheduler.depth() == 0 \
-                    and not self._active.any():
+                    and not self._busy():
                 return
             self.scheduler.wait_for_work(idle_wait)
 

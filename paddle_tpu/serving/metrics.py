@@ -113,6 +113,27 @@ class ServingMetrics:
                                    "1 while admissions are closed")
         self._g_tput = r.gauge("serving_throughput_tokens_per_sec",
                                "generated-token rate over emission window")
+        # block-paged KV pool (ISSUE 11): occupancy gauges + sharing
+        # counters — zero/absent for the slot layout
+        self._g_pages_free = r.gauge("serving_kv_pages_free",
+                                     "free pages in the KV pool")
+        self._g_pages_used = r.gauge("serving_kv_pages_used",
+                                     "allocated pages in the KV pool")
+        self._g_pages_shared = r.gauge(
+            "serving_kv_pages_shared",
+            "pages referenced by more than one owner (prefix sharing)")
+        self._c_prefix_hits = r.counter(
+            "serving_prefix_hits_total",
+            "prompts that reused at least one resident prefix page")
+        self._c_prefix_tokens = r.counter(
+            "serving_prefix_tokens_shared_total",
+            "prompt tokens whose prefill was skipped via prefix sharing")
+        self._c_cow = r.counter(
+            "serving_cow_pages_total",
+            "copy-on-write page duplications (whole-prompt prefix hits)")
+        self._page_state: Dict = {}
+        self._prefix_hits_seen = 0
+        self._prefix_tokens_seen = 0
 
     # -- counters -----------------------------------------------------------
     def on_submit(self):
@@ -170,6 +191,34 @@ class ServingMetrics:
             if compiled:
                 self.step_compiles += 1
         self._c_steps.inc(compiled="true" if compiled else "false")
+
+    def on_cow(self):
+        """One copy-on-write page duplication (a whole-prompt prefix hit
+        recomputing its final token into a private page copy)."""
+        self._c_cow.inc()
+
+    def set_page_gauges(self, state: Dict):
+        """Fold the engine's :meth:`~ContinuousBatchingEngine.page_state`
+        into the registry (gauges) and the prefix-sharing counters
+        (monotonic — the engine reports totals, the registry wants
+        increments)."""
+        if not state:
+            return
+        with self._lock:
+            self._page_state = dict(state)
+            hits = int(state.get("prefix_hits", 0))
+            toks = int(state.get("prefix_hit_tokens", 0))
+            d_hits = max(hits - self._prefix_hits_seen, 0)
+            d_toks = max(toks - self._prefix_tokens_seen, 0)
+            self._prefix_hits_seen = hits
+            self._prefix_tokens_seen = toks
+        self._g_pages_free.set(int(state.get("free", 0)))
+        self._g_pages_used.set(int(state.get("used", 0)))
+        self._g_pages_shared.set(int(state.get("shared", 0)))
+        if d_hits:
+            self._c_prefix_hits.inc(d_hits)
+        if d_toks:
+            self._c_prefix_tokens.inc(d_toks)
 
     # -- gauges (engine-owned, set each tick) -------------------------------
     def set_gauges(self, queue_depth: int, active_slots: int, n_slots: int):
@@ -246,6 +295,20 @@ class ServingMetrics:
                     "step_hits": self.step_calls - self.step_compiles,
                 },
             }
+            if self._page_state:
+                ps = dict(self._page_state)
+                queries = ps.get("prefix_queries", 0)
+                out["kv_pages"] = {
+                    "capacity": ps.get("capacity"),
+                    "free": ps.get("free"),
+                    "used": ps.get("used"),
+                    "shared": ps.get("shared"),
+                    "page_bytes": ps.get("page_bytes"),
+                    "cow_pages": ps.get("cow_pages", 0),
+                    "prefix_hit_rate": (ps.get("prefix_hits", 0) / queries
+                                        if queries else None),
+                    "prefix_hit_tokens": ps.get("prefix_hit_tokens", 0),
+                }
         # fold in any armed profiler host spans for the serving regions
         try:
             from ..profiler.scope import timer_report

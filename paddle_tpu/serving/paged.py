@@ -1,0 +1,316 @@
+"""Host-side bookkeeping for the block-paged KV cache (ISSUE 11).
+
+Parity: Paddle Inference's ``memory_optimize`` pass reuses activation
+buffers by liveness analysis at graph-build time; vLLM's PagedAttention
+(Kwon et al., SOSP 2023) applies the same idea to serving KV state at
+RUNTIME — a fixed pool of fixed-size pages, a page table per sequence,
+refcounted sharing. SGLang's RadixAttention (Zheng et al., 2024) adds a
+radix tree over prompt prefixes so identical system prompts are prefilled
+ONCE. This module is the host half of that design, TPU-native: the device
+side stays one fixed ``[L, n_pages, H, page_size, D]`` pool array and a
+padded page-table tensor (static shapes, bounded compile cache — no
+dynamic paged kernels), while everything that is actually *dynamic*
+(allocation, refcounts, prefix matching, eviction) lives here as plain
+deterministic Python:
+
+* :class:`PagePool` — free-list allocator over page ids with refcounts.
+  Page 0 is the reserved TRASH page: padded page-table entries point at
+  it, so masked/pad writes land somewhere harmless that nothing ever
+  reads. Exhaustion raises :class:`PagesExhaustedError` after an optional
+  eviction callback (the radix cache releasing cold prefixes).
+* :class:`RadixCache` — a radix tree keyed by full ``page_size``-token
+  chunks of prompt token ids. ``match`` returns (and refcounts) the
+  longest resident full-page prefix; ``insert`` registers a finished
+  prompt's full pages for future sharing (the tree holds its own
+  reference, so prefixes stay resident across requests); ``evict``
+  releases least-recently-used leaves under pool pressure.
+
+Determinism: allocation is FIFO over a deque and matching/eviction are
+pure functions of the call sequence, so a replayed workload (the r13
+fault-injection twins) sees bit-identical page assignments.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["PagePool", "RadixCache", "PagesExhaustedError", "TRASH_PAGE"]
+
+#: page id 0 is never allocated: padded page-table entries and masked pad
+#: writes target it, so garbage lands where no gather is ever unmasked
+TRASH_PAGE = 0
+
+
+class PagesExhaustedError(RuntimeError):
+    """The page pool cannot satisfy an allocation even after eviction —
+    the over-committed victim request is failed (visibly, typed) and its
+    pages are released; everything else keeps decoding."""
+
+    http_status = 503
+    error_type = "PagesExhaustedError"
+
+
+class PagePool:
+    """Refcounted free-list allocator over ``n_pages`` fixed-size pages.
+
+    ``page_bytes`` is the per-page K+V footprint (both cache halves, all
+    layers) used for gauges and admission pricing; the pool itself only
+    tracks ids. Thread-safe: the engine allocates under its tick lock but
+    the admission gate reads occupancy from server threads.
+    """
+
+    def __init__(self, n_pages: int, page_bytes: int = 0):
+        if n_pages < 2:
+            raise ValueError("need at least 2 pages (trash + 1 usable)")
+        self.n_pages = int(n_pages)
+        self.page_bytes = int(page_bytes)
+        self._lock = threading.Lock()
+        self._refs = [0] * self.n_pages
+        self._refs[TRASH_PAGE] = -1  # reserved, never allocated/released
+        self._free: deque = deque(range(1, self.n_pages))
+
+    # -- capacity -------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (the trash page is never handed out)."""
+        return self.n_pages - 1
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def used_count(self) -> int:
+        with self._lock:
+            return self.capacity - len(self._free)
+
+    def shared_count(self) -> int:
+        """Pages referenced more than once (prefix sharing in effect)."""
+        with self._lock:
+            return sum(1 for r in self._refs[1:] if r >= 2)
+
+    # -- allocation -----------------------------------------------------
+    def alloc(self, n: int, evict=None) -> List[int]:
+        """Allocate ``n`` pages (refcount 1 each), FIFO for replay
+        determinism. ``evict(n_missing)`` is called once under pressure
+        (the radix cache's LRU release); still short afterwards raises
+        :class:`PagesExhaustedError`."""
+        n = int(n)
+        if n <= 0:
+            return []
+        with self._lock:
+            missing = n - len(self._free)
+        if missing > 0 and evict is not None:
+            evict(missing)
+        with self._lock:
+            if len(self._free) < n:
+                raise PagesExhaustedError(
+                    f"page pool exhausted: need {n} pages, "
+                    f"{len(self._free)}/{self.capacity} free "
+                    f"(refcounted prefix pages may be pinned by "
+                    f"in-flight requests)")
+            out = [self._free.popleft() for _ in range(n)]
+            for p in out:
+                self._refs[p] = 1
+        return out
+
+    def retain(self, pages: Sequence[int]):
+        with self._lock:
+            for p in pages:
+                if p == TRASH_PAGE:
+                    continue
+                if self._refs[p] <= 0:
+                    raise ValueError(f"retain of unallocated page {p}")
+                self._refs[p] += 1
+
+    def release(self, pages: Sequence[int]):
+        """Drop one reference per page; pages hitting zero return to the
+        free list (content is NOT erased — stale bytes are only ever
+        reachable through a page table, and freed pages leave every
+        table)."""
+        with self._lock:
+            for p in pages:
+                if p == TRASH_PAGE:
+                    continue
+                if self._refs[p] <= 0:
+                    raise ValueError(f"release of unallocated page {p}")
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    self._free.append(p)
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._refs[page]
+
+    def reset(self):
+        """Forget every allocation (tick-failure containment: the pool
+        array was reallocated, so all page content is gone)."""
+        with self._lock:
+            self._refs = [0] * self.n_pages
+            self._refs[TRASH_PAGE] = -1
+            self._free = deque(range(1, self.n_pages))
+
+    def state(self) -> Dict[str, int]:
+        with self._lock:
+            free = len(self._free)
+            shared = sum(1 for r in self._refs[1:] if r >= 2)
+        return {
+            "capacity": self.capacity,
+            "free": free,
+            "used": self.capacity - free,
+            "shared": shared,
+            "page_bytes": self.page_bytes,
+        }
+
+
+class _RadixNode:
+    __slots__ = ("children", "page", "stamp")
+
+    def __init__(self, page: int, stamp: int):
+        self.children: Dict[Tuple[int, ...], "_RadixNode"] = {}
+        self.page = page
+        self.stamp = stamp
+
+
+class RadixCache:
+    """Radix tree over full ``page_size``-token prompt chunks → page ids.
+
+    Granularity is one PAGE per edge: only prompts sharing an entire
+    page-aligned chunk share its KV (a divergence inside a page keeps
+    that page private — the engine copy-on-writes only when a request's
+    next WRITE would land in a shared page, i.e. the whole-prompt-match
+    case). The tree holds one reference per resident page so prefixes
+    outlive the request that prefilled them; ``evict`` releases
+    least-recently-used leaves whose only reference is the tree's.
+    """
+
+    def __init__(self, pool: PagePool, page_size: int):
+        self.pool = pool
+        self.page_size = int(page_size)
+        self._root: Dict[Tuple[int, ...], _RadixNode] = {}
+        self._clock = 0
+        self.hits = 0        # match() calls that found >= 1 page
+        self.queries = 0     # match() calls
+        self.hit_tokens = 0  # prompt tokens skipped via sharing
+
+    def _chunks(self, tokens) -> List[Tuple[int, ...]]:
+        ps = self.page_size
+        n_full = len(tokens) // ps
+        return [tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+                for i in range(n_full)]
+
+    # -- lookup ---------------------------------------------------------
+    def match(self, tokens) -> List[int]:
+        """Longest resident full-page prefix of ``tokens``; the returned
+        pages are RETAINED for the caller (release when the request
+        terminates)."""
+        self._clock += 1
+        self.queries += 1
+        pages: List[int] = []
+        level = self._root
+        for chunk in self._chunks(tokens):
+            node = level.get(chunk)
+            if node is None:
+                break
+            node.stamp = self._clock
+            pages.append(node.page)
+            level = node.children
+        if pages:
+            self.pool.retain(pages)
+            self.hits += 1
+            self.hit_tokens += len(pages) * self.page_size
+        return pages
+
+    def peek(self, tokens) -> int:
+        """Number of full pages a :meth:`match` would return, without
+        retaining (admission-gate watermark prediction)."""
+        n = 0
+        level = self._root
+        for chunk in self._chunks(tokens):
+            node = level.get(chunk)
+            if node is None:
+                break
+            n += 1
+            level = node.children
+        return n
+
+    # -- registration ---------------------------------------------------
+    def insert(self, tokens, pages: Sequence[int]):
+        """Register a prefilled prompt's FULL pages (``pages[i]`` holds
+        chunk i's KV). Existing nodes keep their original page (the new
+        request's private copy stays private); new nodes retain one tree
+        reference on their page."""
+        self._clock += 1
+        level = self._root
+        for chunk, page in zip(self._chunks(tokens), pages):
+            node = level.get(chunk)
+            if node is None:
+                node = _RadixNode(int(page), self._clock)
+                self.pool.retain([int(page)])
+                level[chunk] = node
+            else:
+                node.stamp = self._clock
+            level = node.children
+
+    # -- eviction -------------------------------------------------------
+    def _leaves(self):
+        out = []
+
+        def walk(level):
+            for key, node in level.items():
+                if node.children:
+                    walk(node.children)
+                if not node.children:
+                    out.append((level, key, node))
+
+        walk(self._root)
+        return out
+
+    def evict(self, n: int) -> int:
+        """Release up to ``n`` least-recently-used leaf pages whose ONLY
+        reference is the tree's (pages pinned by in-flight requests are
+        never evicted). Cascades: a parent whose children were all
+        evicted becomes a leaf candidate in the next round."""
+        freed = 0
+        while freed < n:
+            candidates = [(level, key, node)
+                          for level, key, node in self._leaves()
+                          if self.pool.refcount(node.page) == 1]
+            if not candidates:
+                break
+            candidates.sort(key=lambda c: c[2].stamp)
+            for level, key, node in candidates:
+                if freed >= n:
+                    break
+                self.pool.release([node.page])
+                del level[key]
+                freed += 1
+        return freed
+
+    def resident_pages(self) -> int:
+        n = 0
+
+        def walk(level):
+            nonlocal n
+            for node in level.values():
+                n += 1
+                walk(node.children)
+
+        walk(self._root)
+        return n
+
+    def clear(self):
+        """Drop every tree reference (engine reset after pool loss)."""
+
+        def walk(level):
+            for node in level.values():
+                walk(node.children)
+                self.pool.release([node.page])
+
+        walk(self._root)
+        self._root = {}
+
+    def hit_rate(self) -> Optional[float]:
+        if not self.queries:
+            return None
+        return self.hits / self.queries

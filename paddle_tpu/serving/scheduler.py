@@ -230,6 +230,10 @@ class FCFSScheduler:
         self.buckets = sorted(int(b) for b in buckets)
         self.max_queue = int(max_queue)
         self.max_prefills_per_tick = max(1, int(max_prefills_per_tick))
+        # chunked-prefill engines (paged KV layout) admit prompts LONGER
+        # than the largest bucket: each chunk is bucketed, not the whole
+        # prompt. None = whole-prompt bucketing (the r8 behavior).
+        self.bucket_cap: Optional[int] = None
         self._q: deque = deque()
         self._cond = threading.Condition()
         self._closed = False
@@ -246,6 +250,11 @@ class FCFSScheduler:
 
     # -- admission ----------------------------------------------------------
     def bucket_for(self, prompt_len: int) -> int:
+        if self.bucket_cap is not None:
+            # chunked prefill: only the (capped) first chunk is bucketed;
+            # the engine validates total capacity against max_seq_len
+            prompt_len = min(int(prompt_len), self.bucket_cap,
+                             self.buckets[-1])
         for b in self.buckets:
             if prompt_len <= b:
                 return b

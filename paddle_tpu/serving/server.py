@@ -201,6 +201,13 @@ class _Handler(BaseHTTPRequestHandler):
         parts = [p for p in self.path.split("/") if p]
         if parts == ["metrics"]:
             eng = self.server_ref.engine
+            try:
+                # LIVE page-pool occupancy (paged layout): the registry's
+                # page gauges are only as fresh as the last engine tick,
+                # and admission/drain decisions ride on them
+                eng.metrics.set_page_gauges(eng.page_state())
+            except Exception:
+                pass
             accept = self.headers.get("Accept")
             if wants_openmetrics(accept) or wants_prometheus(accept):
                 # negotiated text exposition; the JSON default below stays

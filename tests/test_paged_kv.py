@@ -1,0 +1,504 @@
+"""Block-paged KV cache + radix prefix sharing + chunked prefill
+(ISSUE 11): greedy bit-equivalence against the slot-cache engine AND
+sequential ``models.generate``, page-pool accounting, copy-on-write,
+victim-only exhaustion (real and injected), mid-prefill deadline shedding,
+and the page-watermark admission gate — all on CPU.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import generate
+from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+from paddle_tpu.resilience.inject import FaultSchedule
+from paddle_tpu.serving import (
+    AdmissionRejected,
+    ContinuousBatchingEngine,
+    PagePool,
+    PagesExhaustedError,
+    RadixCache,
+    Request,
+)
+from paddle_tpu.serving.admission import DEADLINE_ERROR_TYPE
+from paddle_tpu.serving.paged import TRASH_PAGE
+
+VOCAB = 64
+
+
+def _tiny_model():
+    paddle.seed(0)
+    cfg = gpt_config("gpt2-small", vocab_size=VOCAB, hidden_size=32,
+                     num_layers=2, num_attention_heads=4,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+def _sequential(model, prompt, n, eos=None):
+    out = generate(model, paddle.to_tensor(np.asarray(prompt)[None]),
+                   max_new_tokens=n, eos_token_id=eos)
+    return np.asarray(out._data)[0]
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+# =====================================================================
+# host-side pool + radix tree
+# =====================================================================
+class TestPagePool:
+    def test_trash_page_reserved(self):
+        pool = PagePool(4)
+        pages = pool.alloc(3)
+        assert TRASH_PAGE not in pages
+        assert sorted(pages) == [1, 2, 3]
+        assert pool.free_count() == 0
+
+    def test_refcount_lifecycle(self):
+        pool = PagePool(4)
+        (p,) = pool.alloc(1)
+        pool.retain([p])
+        pool.release([p])
+        assert pool.used_count() == 1  # second ref still held
+        pool.release([p])
+        assert pool.used_count() == 0
+        with pytest.raises(ValueError):
+            pool.release([p])
+
+    def test_shared_count_and_state(self):
+        pool = PagePool(5, page_bytes=128)
+        a, b = pool.alloc(2)
+        pool.retain([a])
+        st = pool.state()
+        assert st == {"capacity": 4, "free": 2, "used": 2, "shared": 1,
+                      "page_bytes": 128}
+
+    def test_exhaustion_raises_typed(self):
+        pool = PagePool(3)
+        pool.alloc(2)
+        with pytest.raises(PagesExhaustedError, match="exhausted"):
+            pool.alloc(1)
+
+    def test_alloc_calls_evictor_then_retries(self):
+        pool = PagePool(3)
+        held = pool.alloc(2)
+
+        def evict(n):
+            pool.release(held[:n])
+
+        got = pool.alloc(1, evict=evict)
+        assert len(got) == 1
+
+    def test_fifo_reuse_is_deterministic(self):
+        pool = PagePool(4)
+        a = pool.alloc(3)
+        pool.release(a)
+        assert pool.alloc(3) == a  # FIFO: same order back
+
+
+class TestRadixCache:
+    def _tree(self, n_pages=16, ps=4):
+        pool = PagePool(n_pages)
+        return pool, RadixCache(pool, ps)
+
+    def test_match_full_pages_only(self):
+        pool, tree = self._tree()
+        toks = np.arange(10)  # 2 full pages + 2 remainder @ ps=4
+        pages = pool.alloc(2)
+        tree.insert(toks, pages)
+        got = tree.match(toks)
+        assert got == pages            # remainder page never shared
+        assert tree.peek(toks[:9]) == 2
+        assert tree.peek(toks[:7]) == 1
+        # divergence INSIDE a page keeps that page private
+        div = np.array(list(toks[:7]) + [63])
+        assert tree.peek(div) == 1
+
+    def test_match_retains_insert_holds_tree_ref(self):
+        pool, tree = self._tree()
+        pages = pool.alloc(1)
+        tree.insert(np.arange(4), pages)      # tree ref: refs == 2
+        assert pool.refcount(pages[0]) == 2
+        got = tree.match(np.arange(4))
+        assert got == pages and pool.refcount(pages[0]) == 3
+        pool.release(got)                      # request done
+        pool.release(pages)                    # prefiller done
+        assert pool.refcount(pages[0]) == 1    # the tree keeps it resident
+
+    def test_evict_lru_leaves_only_unpinned(self):
+        pool, tree = self._tree(n_pages=8)
+        a = pool.alloc(1)
+        b = pool.alloc(1)
+        tree.insert(np.arange(4), a)
+        tree.insert(np.arange(4, 8), b)
+        pool.release(a)
+        pool.release(b)                # only tree refs remain
+        tree.match(np.arange(4))       # touch a: b becomes LRU (and pins a)
+        freed = tree.evict(1)
+        assert freed == 1
+        assert pool.refcount(b[0]) == 0
+        assert tree.peek(np.arange(4, 8)) == 0
+        assert tree.peek(np.arange(4)) == 1
+
+    def test_hit_counters(self):
+        pool, tree = self._tree()
+        pages = pool.alloc(1)
+        tree.insert(np.arange(4), pages)
+        tree.match(np.arange(4))
+        tree.match(np.arange(32, 36))  # miss
+        assert tree.queries == 2 and tree.hits == 1
+        assert tree.hit_tokens == 4
+        assert tree.hit_rate() == 0.5
+
+
+# =====================================================================
+# bit-equivalence: paged == slot == sequential generate (acceptance)
+# =====================================================================
+class TestPagedBitEquivalence:
+    def test_paged_vs_slot_vs_sequential(self, model):
+        """Staggered mixed-length greedy requests through the CHUNKED
+        paged engine == the slot-cache engine == sequential generate,
+        token for token — including a request that joins via a shared
+        prefix and one that exhausts its pages mid-generation (victim
+        fails typed; every survivor stays exact)."""
+        rng = np.random.default_rng(0)
+        base = rng.integers(0, VOCAB, (8,)).astype(np.int32)  # 2 pages @4
+        lens = [3, 5, 7, 4, 9, 6]
+        prompts = [rng.integers(0, VOCAB, (l,)).astype(np.int32)
+                   for l in lens]
+        prompts.append(np.concatenate(
+            [base, rng.integers(0, VOCAB, (3,)).astype(np.int32)]))
+        prompts.append(base.copy())  # joins fully via the shared prefix
+        news = [6, 4, 8, 5, 3, 7, 6, 5]
+        want = [_sequential(model, p, n) for p, n in zip(prompts, news)]
+
+        def drive(eng):
+            first = [eng.submit(Request(p, max_new_tokens=n))
+                     for p, n in zip(prompts[:5], news[:5])]
+            for _ in range(3):
+                eng.step_once()
+            second = [eng.submit(Request(p, max_new_tokens=n))
+                      for p, n in zip(prompts[5:], news[5:])]
+            eng.run_until_idle(timeout=300)
+            return first + second
+
+        buckets = [4, 8, 16]
+        slot_eng = ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=4, prefill_buckets=buckets,
+            kv_layout="slot")
+        paged_eng = ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=4, prefill_buckets=buckets,
+            page_size=4, prefill_chunk=8)
+        for eng in (slot_eng, paged_eng):
+            got = drive(eng)
+            for req, w in zip(got, want):
+                assert req.state == Request.DONE, (req.state, req.error)
+                np.testing.assert_array_equal(req.result(), w)
+        # compile cache: <= len(chunk_buckets) prefill programs + 1 step,
+        # counted by the in-trace counter (acceptance criterion)
+        assert paged_eng.trace_count <= len(paged_eng.chunk_buckets) + 1
+        assert paged_eng.trace_counts["step"] == 1
+        # prefix sharing engaged for the shared-prefix joiners
+        st = paged_eng.page_state()
+        assert st["prefix_hits"] >= 1
+        assert st["prefix_hit_tokens"] >= 8
+
+    def test_exhaustion_mid_generation_fails_only_victim(self, model):
+        """A pool too small for every stream's decode growth: the starved
+        slot fails typed (pages released), survivors decode on and stay
+        exact vs sequential generate."""
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(0, VOCAB, (6,)).astype(np.int32)
+                   for _ in range(3)]
+        want = [_sequential(model, p, 14) for p in prompts]
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=3, prefill_buckets=[8],
+            page_size=4, n_pages=1 + 9, prefix_sharing=False)
+        reqs = [eng.submit(Request(p, max_new_tokens=14)) for p in prompts]
+        eng.run_until_idle(timeout=300)
+        done = [i for i, r in enumerate(reqs) if r.state == Request.DONE]
+        failed = [r for r in reqs if r.state == Request.FAILED]
+        assert done and failed  # over-committed: someone starved
+        for r in failed:
+            assert r.error_type == PagesExhaustedError.error_type
+            assert "page pool exhausted" in r.error
+        for i in done:
+            np.testing.assert_array_equal(reqs[i].result(), want[i])
+        # every victim's refcounted pages came back
+        assert eng.page_state()["used"] == 0
+
+    def test_cow_whole_prompt_match_exact(self, model):
+        """A prompt fully resident in the radix tree recomputes only its
+        final token into a copy-on-write page — and still decodes
+        exactly."""
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(0, VOCAB, (8,)).astype(np.int32)  # 2 pages
+        want = _sequential(model, prompt, 5)
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=2, prefill_buckets=[4, 8],
+            page_size=4)
+        a = eng.submit(Request(prompt, max_new_tokens=5))
+        eng.run_until_idle(timeout=300)
+        b = eng.submit(Request(prompt, max_new_tokens=5))
+        eng.run_until_idle(timeout=300)
+        np.testing.assert_array_equal(a.result(), want)
+        np.testing.assert_array_equal(b.result(), want)
+        assert eng.cow_pages == 1
+        assert eng.page_state()["cow_pages"] == 1
+        snap = eng.metrics.snapshot()
+        assert snap["kv_pages"]["cow_pages"] == 1
+        assert snap["kv_pages"]["prefix_hit_rate"] == 0.5
+
+    def test_slot_flag_still_available(self, model):
+        """The old slot cache stays reachable behind kv_layout='slot' (the
+        bit-comparison fallback)."""
+        eng = ContinuousBatchingEngine(model, max_seq_len=16, n_slots=1,
+                                       prefill_buckets=[8],
+                                       kv_layout="slot")
+        assert eng.kv_layout == "slot"
+        assert eng.page_state() == {}
+        assert eng.kv_bytes_per_stream() is None
+        p = np.arange(1, 5, dtype=np.int32)
+        req = eng.submit(Request(p, max_new_tokens=3))
+        eng.run_until_idle(timeout=120)
+        np.testing.assert_array_equal(req.result(), _sequential(model, p, 3))
+
+
+# =====================================================================
+# chunked prefill: interleaving + mid-prefill deadline (satellites)
+# =====================================================================
+class TestChunkedPrefill:
+    def test_long_prompt_exceeding_largest_bucket(self, model):
+        """Chunked prefill admits prompts LONGER than the largest prefill
+        bucket (the whole point of chunking) and stays exact."""
+        rng = np.random.default_rng(4)
+        prompt = rng.integers(0, VOCAB, (24,)).astype(np.int32)
+        want = _sequential(model, prompt, 4)
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=40, n_slots=2, prefill_buckets=[4, 8],
+            page_size=4, prefill_chunk=8)
+        req = eng.submit(Request(prompt, max_new_tokens=4))
+        eng.run_until_idle(timeout=300)
+        np.testing.assert_array_equal(req.result(), want)
+        assert eng.trace_count <= len(eng.chunk_buckets) + 1
+
+    def test_decode_interleaves_with_chunks(self, model):
+        """A long prompt's prefill no longer stalls in-flight streams:
+        between its chunks, active slots keep emitting one token per tick
+        (tick-deterministic — the head-of-line TTFT fix)."""
+        rng = np.random.default_rng(5)
+        short = rng.integers(0, VOCAB, (3,)).astype(np.int32)
+        long_p = rng.integers(0, VOCAB, (16,)).astype(np.int32)
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=2, prefill_buckets=[4],
+            page_size=4, prefill_chunk=4, max_prefills_per_tick=1)
+        a = eng.submit(Request(short, max_new_tokens=10))
+        eng.step_once()  # admit + prefill + first decode
+        assert len(a.tokens) >= 1
+        b = eng.submit(Request(long_p, max_new_tokens=3))
+        grew = []
+        for _ in range(3):  # 3 of long's 4 chunks: b must not be done
+            before = len(a.tokens)
+            eng.step_once()
+            grew.append(len(a.tokens) - before)
+        assert all(g == 1 for g in grew), grew  # one token per tick
+        assert b.tokens == [] and eng._prefill_slots  # still prefilling
+        eng.run_until_idle(timeout=300)
+        np.testing.assert_array_equal(
+            b.result(), _sequential(model, long_p, 3))
+        np.testing.assert_array_equal(
+            a.result(), _sequential(model, short, 10))
+
+    def test_deadline_expiry_mid_prefill_sheds_typed(self, model):
+        """A request admitted pre-chunking can expire mid-prefill: the
+        engine re-checks the deadline before each next chunk and sheds
+        with the typed 503, pages released, no further prefill burned
+        (satellite: scheduler admission re-checks deadline expiry after
+        chunked-prefill waits)."""
+        rng = np.random.default_rng(6)
+        long_p = rng.integers(0, VOCAB, (16,)).astype(np.int32)
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=1, prefill_buckets=[4],
+            page_size=4, prefill_chunk=4, prefix_sharing=False)
+        req = eng.submit(Request(long_p, max_new_tokens=3, deadline_s=0.05))
+        eng.step_once()  # first chunk runs (deadline still valid)
+        assert req.state != Request.FAILED
+        prefills = eng.metrics.prefill_calls
+        shed_before = eng.metrics.requests_shed
+        time.sleep(0.08)  # the deadline lapses BETWEEN chunks
+        eng.step_once()
+        assert req.state == Request.FAILED
+        assert req.error_type == DEADLINE_ERROR_TYPE
+        assert "mid-prefill" in req.error
+        assert eng.metrics.prefill_calls == prefills  # no next chunk
+        assert eng.metrics.requests_shed == shed_before + 1
+        assert eng.page_state()["used"] == 0          # pages released
+        assert not eng._prefill_slots
+        # the freed slot is immediately usable
+        ok = eng.submit(Request(long_p[:3], max_new_tokens=2))
+        eng.run_until_idle(timeout=120)
+        assert ok.state == Request.DONE
+
+
+# =====================================================================
+# injected exhaustion twin (r13 inject plane satellite)
+# =====================================================================
+class TestInjectedExhaustion:
+    def _run(self, model, prompts):
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=3, prefill_buckets=[8],
+            page_size=4, prefix_sharing=False)
+        sched = FaultSchedule(seed=7).add(
+            "serving.pages.exhausted", "raise", at=5,
+            exception=PagesExhaustedError)
+        with sched:
+            reqs = [eng.submit(Request(p, max_new_tokens=14))
+                    for p in prompts]
+            eng.run_until_idle(timeout=300)
+        return ([(r.state, tuple(r.tokens)) for r in reqs],
+                sched.fired_log(), eng.page_state()["used"])
+
+    def test_victim_only_and_bit_identical_replay(self, model):
+        """A seeded fault at page-allocation exhaustion fails ONLY the
+        victim request, releases its refcounted pages, and the whole run
+        replays bit-identically (transcripts AND fired logs equal)."""
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, VOCAB, (6,)).astype(np.int32)
+                   for _ in range(3)]
+        a, fired_a, used_a = self._run(model, prompts)
+        b, fired_b, used_b = self._run(model, prompts)
+        assert a == b
+        assert fired_a == fired_b
+        assert fired_a[0]["point"] == "serving.pages.exhausted"
+        states = [s for s, _ in a]
+        assert states.count(Request.FAILED) == 1  # ONLY the victim
+        assert states.count(Request.DONE) == 2
+        assert used_a == used_b == 0              # victim pages released
+
+
+# =====================================================================
+# page-watermark admission gate (tentpole: AdmissionGate over pages)
+# =====================================================================
+class TestPageWatermarkGate:
+    def test_refusal_cites_pages(self, model):
+        """The 429 body cites the predicted page-pool watermark
+        (predicted/free/budget) — pages are the allocation unit, so
+        predicted-resident tracks true occupancy (acceptance)."""
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=2, prefill_buckets=[8],
+            page_size=4, n_pages=1 + 4, prefix_sharing=False,
+            hbm_budget_bytes=1 << 30)
+        # needs ceil((6+6)/4) = 3 pages; budget is 4: first fits,
+        # second's predicted watermark 3+3=6 > 4 while still queued
+        p = np.arange(1, 7, dtype=np.int32)
+        eng.submit(Request(p, max_new_tokens=6))
+        with pytest.raises(AdmissionRejected) as ei:
+            eng.submit(Request(p, max_new_tokens=6))
+        pages = ei.value.estimate["pages"]
+        assert pages["predicted"] == 6
+        assert pages["budget"] == 4
+        assert pages["needed"] == 3
+        assert pages["committed_queued"] == 3
+        assert "page-pool watermark" in str(ei.value)
+        assert "free" in pages and pages["page_bytes"] > 0
+
+    def test_commit_settles_at_allocation(self, model):
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=2, prefill_buckets=[8],
+            page_size=4, n_pages=1 + 8, hbm_budget_bytes=1 << 30)
+        gate = eng.admission_gate
+        p = np.arange(1, 7, dtype=np.int32)
+        req = eng.submit(Request(p, max_new_tokens=6))
+        assert gate._committed_pages == 3
+        eng.step_once()  # allocates real pages; the reservation settles
+        assert gate._committed_pages == 0
+        wm = gate.page_watermark()
+        assert wm["used"] >= 1 and wm["committed_queued"] == 0
+        eng.run_until_idle(timeout=120)
+        assert req.state == Request.DONE
+
+    def test_shed_and_failed_requests_settle(self, model):
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=1, prefill_buckets=[8],
+            page_size=4, hbm_budget_bytes=1 << 30)
+        gate = eng.admission_gate
+        blocker = eng.submit(Request(np.arange(1, 5, dtype=np.int32),
+                                     max_new_tokens=8))
+        doomed = eng.submit(Request(np.arange(1, 5, dtype=np.int32),
+                                    max_new_tokens=4, deadline_s=0.01))
+        assert gate._committed_pages > 0
+        time.sleep(0.03)
+        while not doomed.done:
+            eng.step_once()
+        assert doomed.error_type == DEADLINE_ERROR_TYPE
+        eng.run_until_idle(timeout=120)
+        assert blocker.state == Request.DONE
+        assert gate._committed_pages == 0
+
+    def test_watermark_admits_after_sharing(self, model):
+        """pages_needed is net of resident shared prefixes: a request the
+        pool could never fit cold IS admissible once its prefix is
+        resident — predicted-resident tracks true occupancy."""
+        rng = np.random.default_rng(8)
+        prompt = rng.integers(0, VOCAB, (12,)).astype(np.int32)  # 3 pages
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=2, prefill_buckets=[4, 8, 16],
+            page_size=4)
+        cold = eng.pages_needed(Request(prompt, max_new_tokens=4))
+        a = eng.submit(Request(prompt, max_new_tokens=4))
+        eng.run_until_idle(timeout=120)
+        assert a.state == Request.DONE
+        warm = eng.pages_needed(Request(prompt, max_new_tokens=4))
+        assert warm < cold  # the radix-resident prefix is free
+
+
+# =====================================================================
+# gauges + per-stream HBM accounting
+# =====================================================================
+class TestPagedMetrics:
+    def test_page_gauges_and_prometheus_series(self, model):
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=2, prefill_buckets=[8],
+            page_size=4)
+        reqs = [eng.submit(Request(np.arange(1, 6, dtype=np.int32),
+                                   max_new_tokens=4)) for _ in range(2)]
+        eng.run_until_idle(timeout=120)
+        assert all(r.state == Request.DONE for r in reqs)
+        snap = eng.metrics.snapshot()
+        kv = snap["kv_pages"]
+        assert kv["capacity"] == eng.n_pages - 1
+        assert kv["free"] + kv["used"] == kv["capacity"]
+        assert kv["page_bytes"] == eng.page_bytes
+        text = eng.metrics.prometheus_text()
+        for series in ("serving_kv_pages_free", "serving_kv_pages_used",
+                       "serving_kv_pages_shared",
+                       "serving_prefix_hits_total",
+                       "serving_cow_pages_total"):
+            assert series in text
+
+    def test_kv_hbm_per_stream_bounded_by_live_pages(self, model):
+        """Acceptance: per-stream KV HBM <= (live pages x page bytes) +
+        one page of slack — the paged win over the slot layout's fixed
+        2·L·H·S·D per stream."""
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=2, prefill_buckets=[8],
+            page_size=4, prefix_sharing=False)
+        reqs = [eng.submit(Request(np.arange(1, 6, dtype=np.int32),
+                                   max_new_tokens=8)) for _ in range(2)]
+        eng.step_once()
+        assert eng.active_slots() == 2
+        per_stream = eng.kv_bytes_per_stream()
+        live_pages_per_stream = max(
+            len(getattr(r, "_pages", [])) for r in reqs)
+        bound = live_pages_per_stream * eng.page_bytes + eng.page_bytes
+        assert per_stream is not None and per_stream <= bound
+        # and strictly below the slot layout's worst-case share
+        slot_share = eng.max_pages_per_slot * eng.page_bytes
+        assert per_stream < slot_share
+        eng.run_until_idle(timeout=120)

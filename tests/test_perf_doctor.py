@@ -335,6 +335,45 @@ class TestBenchDiff:
         rc = obs_main(["bench-diff", os.path.join(REPO, "BENCH_r05.json")])
         assert rc == 0
 
+    def test_cpu_arm_payload_judged_against_cpu_bands_only(self, tmp_path):
+        """r15 arm segregation: CPU smoke payloads share metric NAMES
+        with the on-chip lineage but not comparable values — compare()
+        must pick the band set matching the payload's arm, and untagged
+        (pre-r15) payloads default to the tpu lineage."""
+        assert bl.payload_arm({"metric": "m", "value": 1.0}) == "tpu"
+        assert bl.payload_arm({"arm": "cpu", "metric": "m"}) == "cpu"
+        tpu = {"metric": "m_tokens_per_sec", "value": 100.0}
+        cpu = {"arm": "cpu", "metric": "m_tokens_per_sec", "value": 1.0}
+        files = []
+        for i, p in enumerate((tpu, cpu)):
+            f = tmp_path / f"BENCH_arm{i}.json"
+            f.write_text(json.dumps(p))
+            files.append(str(f))
+        doc = bl.rebuild(files)
+        # each arm's own payload passes; the bands never cross arms (the
+        # CPU value is 100x below the tpu band floor and vice versa)
+        assert bl.compare(tpu, doc)["ok"]
+        assert bl.compare(cpu, doc)["ok"]
+        assert not bl.compare(dict(tpu, value=1.0), doc)["ok"]
+        assert not bl.compare(dict(cpu, value=0.01), doc)["ok"]
+        # a CPU payload against a baseline with NO cpu lineage is an
+        # empty (trivially ok) verdict, not a false regression
+        tpu_only = bl.rebuild(files[:1])
+        v = bl.compare(cpu, tpu_only)
+        assert v["ok"] and v["compared"] == 0
+
+    def test_committed_baseline_carries_cpu_arm_bands(self):
+        committed = bl.load_baseline()
+        cpu = committed.get("metrics_cpu", {})
+        # the r15 paged serving numbers are guarded on their own arm
+        for name in ("serving_paged_tokens_per_sec",
+                     "prefix_hit_ttft_p50_ms",
+                     "prefix_hit_ttft_improved",
+                     "serving_paged_exact_vs_slot"):
+            assert name in cpu, name
+        assert cpu["serving_paged_exact_vs_slot"]["class"] == "flag"
+        assert cpu["serving_paged_exact_vs_slot"]["expect_true"]
+
     def test_synthetic_regression_exits_1_naming_metric(self, tmp_path,
                                                         capsys):
         rc = obs_main(["bench-diff", self._regressed_payload(tmp_path)])
