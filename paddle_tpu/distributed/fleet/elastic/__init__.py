@@ -8,6 +8,7 @@ from .manager import (  # noqa: F401
     ELASTIC_EXIT_CODE,
     ElasticManager,
     ElasticStatus,
+    StoreUnavailable,
     enable_elastic,
     launch_elastic,
 )

@@ -167,31 +167,39 @@ class ElasticCollective:
         delays = self._sleep_iter()
         alive, joined = set(), set()
         while time.monotonic() < deadline:
-            # prefix scan: membership views only — never the data-plane
-            # gradient blobs sharing the scope
-            gens = self._parse_rdv(self._kv_scan(prefix="rdv"))
-            live_gens = [g for g, (j, vw) in gens.items() if j or vw]
-            if live_gens and max(live_gens) > gen:
-                gen = max(live_gens)  # adopt the highest live proposal
-            # (re)stamp our join: join keys are liveness-filtered, so they
-            # must be refreshed while we wait
-            self.store.put(f"rdv{gen}:{self.node_id}", "1")
-            alive = set(self.store.nodes())
-            joins, view_map = gens.get(gen, (set(), {}))
-            joined = joins | set(view_map) | {self.node_id}
-            cand = sorted(alive & joined)
-            if (self.node_id in cand and len(cand) >= min_ranks
-                    and alive <= joined):
-                view = ",".join(cand)
-                self.store.put(f"rdvview{gen}:{self.node_id}", view)
-                view_map = dict(view_map, **{self.node_id: view})
-                if all(view_map.get(m) == view for m in cand):
-                    self.generation = gen
-                    self.members = cand
-                    self.world = len(cand)
-                    self.rank = cand.index(self.node_id)
-                    self._gc_generation(gen - 1)
-                    return self.rank
+            try:
+                # prefix scan: membership views only — never the data-plane
+                # gradient blobs sharing the scope
+                gens = self._parse_rdv(self._kv_scan(prefix="rdv"))
+                live_gens = [g for g, (j, vw) in gens.items() if j or vw]
+                if live_gens and max(live_gens) > gen:
+                    gen = max(live_gens)  # adopt the highest live proposal
+                # (re)stamp our join: join keys are liveness-filtered, so
+                # they must be refreshed while we wait
+                self.store.put(f"rdv{gen}:{self.node_id}", "1")
+                alive = set(self.store.nodes())
+                joins, view_map = gens.get(gen, (set(), {}))
+                joined = joins | set(view_map) | {self.node_id}
+                cand = sorted(alive & joined)
+                if (self.node_id in cand and len(cand) >= min_ranks
+                        and alive <= joined):
+                    view = ",".join(cand)
+                    self.store.put(f"rdvview{gen}:{self.node_id}", view)
+                    view_map = dict(view_map, **{self.node_id: view})
+                    if all(view_map.get(m) == view for m in cand):
+                        self.generation = gen
+                        self.members = cand
+                        self.world = len(cand)
+                        self.rank = cand.index(self.node_id)
+                        self._gc_generation(gen - 1)
+                        return self.rank
+            except OSError:
+                # store briefly unreachable (replicated-store failover,
+                # transient outage): the retry burst below the store layer
+                # is already exhausted — keep POLLING until the rendezvous
+                # deadline instead of crashing the join. Liveness is safe:
+                # nobody can expire while the store everyone reads is down.
+                pass
             time.sleep(min(next(delays), max(deadline - time.monotonic(), 0)))
         raise TimeoutError(
             f"rendezvous gen={gen} did not converge within {timeout}s "
@@ -225,34 +233,55 @@ class ElasticCollective:
             raise RuntimeError("rendezvous before allgather")
         prefix = f"ag{self.generation}:{tag}:"
         my_key = f"{prefix}{self.rank}"
-        self.store.put(my_key, payload)
         deadline = time.monotonic() + timeout
         delays = self._sleep_iter()
+        published = False
         while True:
-            # poll on key PRESENCE only — every iteration of this loop
-            # re-runs while a peer is slow, and shipping all W payload
-            # blobs per poll would melt the single KV server exactly when
-            # a rank is struggling. Payload values transfer exactly once,
-            # after the round is complete (blobs are never GC'd before
-            # the NEXT round completes, so the fetch cannot miss).
-            present = self._scan_prefix(prefix, keys_only=True)
-            if all(str(r) in present for r in range(self.world)):
-                got = self._scan_prefix(prefix)
-                # GC our blob from the PREVIOUS gather — only NOW is it
-                # provably consumed: this gather completing means every
-                # peer has published this round, which it can only do
-                # after finishing the previous one. Deleting at publish
-                # time instead would yank the blob from under a slower
-                # peer still reading the previous round.
-                if self._last_ag_key not in (None, my_key):
-                    try:
-                        self.store.delete(self._last_ag_key)
-                    except Exception:
-                        pass
-                self._last_ag_key = my_key
-                return [got[str(r)] for r in range(self.world)]
-            missing = [r for r in range(self.world) if str(r) not in present]
-            alive = set(self.store.nodes())
+            try:
+                # (re)publish inside the loop: a store failover can
+                # swallow the first attempt's retry burst, and publishing
+                # the same key/payload again is idempotent
+                if not published:
+                    self.store.put(my_key, payload)
+                    published = True
+                # poll on key PRESENCE only — every iteration of this loop
+                # re-runs while a peer is slow, and shipping all W payload
+                # blobs per poll would melt the single KV server exactly
+                # when a rank is struggling. Payload values transfer
+                # exactly once, after the round is complete (blobs are
+                # never GC'd before the NEXT round completes, so the fetch
+                # cannot miss).
+                present = self._scan_prefix(prefix, keys_only=True)
+                if all(str(r) in present for r in range(self.world)):
+                    got = self._scan_prefix(prefix)
+                    # GC our blob from the PREVIOUS gather — only NOW is
+                    # it provably consumed: this gather completing means
+                    # every peer has published this round, which it can
+                    # only do after finishing the previous one. Deleting
+                    # at publish time instead would yank the blob from
+                    # under a slower peer still reading the previous
+                    # round.
+                    if self._last_ag_key not in (None, my_key):
+                        try:
+                            self.store.delete(self._last_ag_key)
+                        except Exception:
+                            pass
+                    self._last_ag_key = my_key
+                    return [got[str(r)] for r in range(self.world)]
+                missing = [r for r in range(self.world)
+                           if str(r) not in present]
+                alive = set(self.store.nodes())
+            except OSError:
+                # store briefly unreachable (failover window): neither a
+                # dead rank nor a failed gather — keep polling until the
+                # allgather deadline (nobody expires while the store is
+                # down for everyone)
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"allgather '{tag}' store unreachable past "
+                        f"{timeout}s")
+                time.sleep(min(next(delays), 0.25))
+                continue
             dead = [self.members[r] for r in missing
                     if self.members[r] not in alive]
             if dead:

@@ -98,8 +98,14 @@ class _FileStore:
     def endpoints(self) -> List[str]:
         eps = []
         for name in self.nodes():
-            with open(os.path.join(self.path, name)) as f:
-                eps.append(f.read().strip())
+            # same guard nodes() has: a node expiring between the scan and
+            # the open (deregister raced the TTL walk) is skipped, not a
+            # crash in the caller's membership poll
+            try:
+                with open(os.path.join(self.path, name)) as f:
+                    eps.append(f.read().strip())
+            except FileNotFoundError:
+                pass
         return eps
 
 
@@ -117,14 +123,27 @@ class _TcpStore:
     def __init__(self, addr: str, scope: str, ttl: float = 10.0,
                  retries: int = 3):
         from ..utils.http_server import KVClient
+        from ..utils.replicated_store import ReplicatedKVClient
 
         # budget the WHOLE burst (attempts x timeout + backoff sleeps) well
         # under the TTL: a timeout-bound stall (black-holed store, not
         # connection-refused) must not silence the heartbeat long enough
         # for peers to expire this node — that restart is exactly what the
-        # retry layer exists to prevent
-        self.client = KVClient(
-            addr, timeout=max(ttl / 4 / (int(retries) + 1), 0.25))
+        # retry layer exists to prevent. With a replica SET the budget is
+        # per PASS (one attempt visits up to every replica sequentially),
+        # so the per-hop timeout divides by the fan-out too.
+        n_addr = addr.count(",") + 1
+        timeout = max(ttl / 4 / (int(retries) + 1) / n_addr, 0.25)
+        if "," in addr:
+            # multi-address spec = the quorum-replicated store (r16):
+            # leader discovery, NotLeader redirects and failover live in
+            # the client; THIS layer's retry/backoff/StoreUnavailable
+            # policy is identical either way. Single-address behavior is
+            # unchanged (the bit-comparison fallback).
+            self.client = ReplicatedKVClient(addr.split(","),
+                                             timeout=timeout)
+        else:
+            self.client = KVClient(addr, timeout=timeout)
         self.scope = f"elastic_{scope}"
         # SIBLING scope for the raw KV plane: membership liveness is
         # "every key in self.scope with a fresh stamp is a node", so data
@@ -278,7 +297,9 @@ class ElasticManager:
       PADDLE_ELASTIC_JOB_ID        job key
       PADDLE_ELASTIC_TIMEOUT       seconds to hold for stragglers (default 120)
       PADDLE_ELASTIC_SERVER        host:port of the HTTP KV store (the etcd
-                                   stand-in; cross-host)
+                                   stand-in; cross-host), or a comma list
+                                   of replica addresses for the quorum-
+                                   replicated store (r16)
       PADDLE_ELASTIC_STORE_PATH    shared dir fallback registry (single host
                                    / shared FS)
       PADDLE_CURRENT_ENDPOINT      this node's endpoint

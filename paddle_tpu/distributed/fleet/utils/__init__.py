@@ -1,1 +1,6 @@
 from .http_server import KVClient, KVServer  # noqa: F401
+from .replicated_store import (  # noqa: F401
+    ReplicatedKVClient,
+    ReplicatedKVServer,
+    ReplicatedStoreCluster,
+)

@@ -25,14 +25,25 @@ from typing import Dict, Optional, Tuple
 __all__ = ["KVServer", "KVClient"]
 
 
-class _Handler(BaseHTTPRequestHandler):
-    # `store`/`lock` are set per-server on a subclass (KVServer.__init__) —
-    # a class-level store would cross-contaminate servers in one process
-    store: Dict[str, Dict[str, Tuple[str, float]]]
-    lock: threading.Lock
+class _BaseHandler(BaseHTTPRequestHandler):
+    """Wire plumbing shared by the KV-protocol handlers (this module's
+    KVServer and the replicated store's replica handler) — one place owns
+    the response framing and the scan rendering."""
+
+    # HTTP/1.1 so clients can keep connections alive across RPCs (the
+    # KVClient keep-alive reuse); every response therefore MUST carry
+    # Content-Length or the client would block reading to EOF
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, *args):  # quiet
         pass
+
+    def _reply(self, status: int, body: bytes = b""):
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
 
     def _parts(self):
         path = self.path.split("?", 1)[0]
@@ -46,11 +57,49 @@ class _Handler(BaseHTTPRequestHandler):
         return {k: v[-1] for k, v in urllib.parse.parse_qs(
             self.path.split("?", 1)[1]).items()}
 
+    def _render_scan(self, bucket: Dict[str, Tuple[str, float]]) -> bytes:
+        """Scope-scan JSON body ({key: [value, age]}) honoring the
+        ``prefix``/``keys`` query filters (see KVClient.scan)."""
+        now = time.monotonic()
+        q = self._query()
+        pfx = q.get("prefix", "")
+        if pfx:
+            bucket = {k: kv for k, kv in bucket.items()
+                      if k.startswith(pfx)}
+        if q.get("keys") == "1":
+            # presence/age only: elastic poll loops scan every iteration,
+            # and shipping each rank's full gradient blob per poll turns
+            # a slow peer into an O(W^2 x blob) stampede
+            return json.dumps({k: [None, now - ts]
+                               for k, (v, ts) in bucket.items()}).encode()
+        return json.dumps({k: [v, now - ts]
+                           for k, (v, ts) in bucket.items()}).encode()
+
+
+class _Handler(_BaseHandler):
+    # `store`/`lock` are set per-server on a subclass (KVServer.__init__) —
+    # a class-level store would cross-contaminate servers in one process
+    store: Dict[str, Dict[str, Tuple[str, float]]]
+    lock: threading.Lock
+
+    # flipped by KVServer.stop()/kill(): a stopped server's lingering
+    # keep-alive handler threads must go SILENT (drop the connection,
+    # answer nothing), or a cached client connection would keep talking
+    # to a server whose listener is long closed
+    dead = False
+
+    def _gone(self) -> bool:
+        if type(self).dead:
+            self.close_connection = True
+            return True
+        return False
+
     def do_PUT(self):
+        if self._gone():
+            return
         scope, key = self._parts()
         if key is None:
-            self.send_response(400)
-            self.end_headers()
+            self._reply(400)
             return
         n = int(self.headers.get("Content-Length", 0))
         val = self.rfile.read(n).decode()
@@ -59,52 +108,30 @@ class _Handler(BaseHTTPRequestHandler):
         # resurrect an expired one
         with self.lock:
             self.store.setdefault(scope, {})[key] = (val, time.monotonic())
-        self.send_response(200)
-        self.end_headers()
+        self._reply(200)
 
     def do_GET(self):
+        if self._gone():
+            return
         scope, key = self._parts()
         with self.lock:
             bucket = dict(self.store.get(scope, {}))
         if key is None:
-            now = time.monotonic()
-            q = self._query()
-            pfx = q.get("prefix", "")
-            if pfx:
-                bucket = {k: kv for k, kv in bucket.items()
-                          if k.startswith(pfx)}
-            if q.get("keys") == "1":
-                # presence/age only: elastic poll loops scan every
-                # iteration, and shipping each rank's full gradient blob
-                # per poll turns a slow peer into an O(W^2 x blob) stampede
-                body = json.dumps(
-                    {k: [None, now - ts]
-                     for k, (v, ts) in bucket.items()}).encode()
-            else:
-                body = json.dumps(
-                    {k: [v, now - ts] for k, (v, ts) in bucket.items()}).encode()
-            self.send_response(200)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._reply(200, self._render_scan(bucket))
             return
         hit = bucket.get(key)
         if hit is None:
-            self.send_response(404)
-            self.end_headers()
+            self._reply(404)
             return
-        body = hit[0].encode()
-        self.send_response(200)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        self._reply(200, hit[0].encode())
 
     def do_DELETE(self):
+        if self._gone():
+            return
         scope, key = self._parts()
         with self.lock:
             self.store.get(scope, {}).pop(key, None)
-        self.send_response(200)
-        self.end_headers()
+        self._reply(200)
 
 
 class KVServer:
@@ -125,6 +152,10 @@ class KVServer:
         return self
 
     def stop(self):
+        # silence lingering keep-alive handler threads BEFORE closing the
+        # listener: their next request gets a dropped connection, which a
+        # reusing client treats as stale → redial → connection refused
+        self._httpd.RequestHandlerClass.dead = True
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -142,23 +173,92 @@ class KVClient:
     failures raise OSError so a caller's retry policy (the elastic store's
     backoff, resilience/retry.py) can distinguish "store down" from a
     legitimately absent key / empty scope; the default swallows them into
-    False/None/{} for casual callers."""
+    False/None/{} for casual callers.
+
+    Connections are kept alive and reused (bounded: one idle connection per
+    THREAD — the beat thread and the collective poll loop each keep their
+    own, so neither serializes behind the other's in-flight RPC). A reused
+    connection the server has since closed fails the first write/read; that
+    one stale case redials transparently, so a failover retry burst against
+    a surviving replica costs one dial, not one SYN per RPC."""
+
+    #: redial a kept-alive connection after this many RPCs — bounds how
+    #: long one TCP stream is trusted (mirrors HTTP keep-alive max)
+    MAX_CONN_REQUESTS = 1000
 
     def __init__(self, addr: str, timeout: float = 5.0):
         self.addr = addr  # "host:port"
         self.timeout = timeout
+        self._tls = threading.local()  # per-thread cached connection
 
     def _conn(self):
         host, port = self.addr.rsplit(":", 1)
         return http.client.HTTPConnection(host, int(port), timeout=self.timeout)
 
+    def close(self):
+        """Drop THIS thread's cached connection (other threads' cached
+        connections age out on their next stale-dial)."""
+        c = getattr(self._tls, "conn", None)
+        self._tls.conn = None
+        if c is not None:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _roundtrip(self, c, method: str, path: str, body):
+        c.request(method, path, body=body)
+        r = c.getresponse()
+        return r, r.read()
+
+    def _request(self, method: str, path: str,
+                 body: Optional[bytes] = None) -> Tuple[int, bytes]:
+        """One RPC over the kept-alive connection; a STALE cached
+        connection (server closed it between requests) gets exactly one
+        fresh dial, a fresh dial's failure is the caller's (OSError).
+        Returns (status, body bytes)."""
+        c = getattr(self._tls, "conn", None)
+        cached = c is not None
+        self._tls.conn = None
+        if c is None:
+            c = self._conn()
+            self._tls.uses = 0
+        try:
+            r, data = self._roundtrip(c, method, path, body)
+        except (OSError, http.client.HTTPException) as e:
+            c.close()
+            if not cached:
+                if isinstance(e, OSError):
+                    raise
+                # a malformed/torn response on a FRESH connection is a
+                # transport failure too — surface it in the OSError family
+                # the retry layer already handles
+                raise ConnectionError(f"bad response from {self.addr}: "
+                                      f"{type(e).__name__}") from e
+            c = self._conn()  # dial-on-stale fallback
+            self._tls.uses = 0
+            try:
+                r, data = self._roundtrip(c, method, path, body)
+            except (OSError, http.client.HTTPException) as e2:
+                c.close()
+                if isinstance(e2, OSError):
+                    raise
+                raise ConnectionError(f"bad response from {self.addr}: "
+                                      f"{type(e2).__name__}") from e2
+        n = getattr(self._tls, "uses", 0) + 1
+        if r.will_close or n >= self.MAX_CONN_REQUESTS:
+            c.close()
+            self._tls.uses = 0
+        else:
+            self._tls.conn = c
+            self._tls.uses = n
+        return r.status, data
+
     def put(self, scope: str, key: str, value: str, strict: bool = False) -> bool:
         try:
-            c = self._conn()
-            c.request("PUT", f"/{scope}/{key}", body=value.encode())
-            ok = c.getresponse().status == 200
-            c.close()
-            return ok
+            status, _ = self._request("PUT", f"/{scope}/{key}",
+                                      body=value.encode())
+            return status == 200
         except OSError:
             if strict:
                 raise
@@ -166,12 +266,8 @@ class KVClient:
 
     def get(self, scope: str, key: str, strict: bool = False) -> Optional[str]:
         try:
-            c = self._conn()
-            c.request("GET", f"/{scope}/{key}")
-            r = c.getresponse()
-            out = r.read().decode() if r.status == 200 else None
-            c.close()
-            return out
+            status, data = self._request("GET", f"/{scope}/{key}")
+            return data.decode() if status == 200 else None
         except OSError:
             if strict:
                 raise
@@ -179,15 +275,23 @@ class KVClient:
 
     def delete(self, scope: str, key: str, strict: bool = False) -> bool:
         try:
-            c = self._conn()
-            c.request("DELETE", f"/{scope}/{key}")
-            ok = c.getresponse().status == 200
-            c.close()
-            return ok
+            status, _ = self._request("DELETE", f"/{scope}/{key}")
+            return status == 200
         except OSError:
             if strict:
                 raise
             return False
+
+    @staticmethod
+    def _scan_path(scope: str, keys_only: bool, prefix: Optional[str]) -> str:
+        import urllib.parse
+        q = {}
+        if keys_only:
+            q["keys"] = "1"
+        if prefix:
+            q["prefix"] = prefix
+        qs = f"?{urllib.parse.urlencode(q)}" if q else ""
+        return f"/{scope}{qs}"
 
     def scan(self, scope: str, strict: bool = False, keys_only: bool = False,
              prefix: Optional[str] = None) -> Dict[str, Tuple[str, float]]:
@@ -195,22 +299,12 @@ class KVClient:
         returns (None, age) pairs — presence/liveness without shipping
         values; ``prefix`` filters keys server-side."""
         try:
-            import urllib.parse
-            q = {}
-            if keys_only:
-                q["keys"] = "1"
-            if prefix:
-                q["prefix"] = prefix
-            qs = f"?{urllib.parse.urlencode(q)}" if q else ""
-            c = self._conn()
-            c.request("GET", f"/{scope}{qs}")
-            r = c.getresponse()
-            if r.status != 200:
-                c.close()
+            status, data = self._request(
+                "GET", self._scan_path(scope, keys_only, prefix))
+            if status != 200:
                 return {}
-            data = json.loads(r.read().decode())
-            c.close()
-            return {k: (v[0], float(v[1])) for k, v in data.items()}
+            parsed = json.loads(data.decode())
+            return {k: (v[0], float(v[1])) for k, v in parsed.items()}
         except (OSError, ValueError):
             if strict:
                 raise

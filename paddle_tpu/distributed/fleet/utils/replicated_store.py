@@ -1,0 +1,960 @@
+"""Quorum-replicated coordination store: leader leases, epoch fencing,
+client-transparent failover.
+
+Parity: the reference Fleet layer rests on an etcd REGISTRY
+(fleet/elastic/manager.py:103) that is assumed highly available — etcd is
+itself a raft quorum. Our port collapsed it into ONE
+:class:`~.http_server.KVServer` at one address, which after the r11 rank
+recovery and r14 replica failover left the coordination plane the last
+single point of failure: kill that host and heartbeats, rendezvous, and
+gradient allgather all stall at once. This module replicates the store the
+same way etcd does, scaled to the codebase's idiom (HTTP + threads, no new
+dependencies):
+
+* **N replicas, one leader.** Every replica serves the full KVServer
+  client protocol, but only the leader ACCEPTS it — followers answer
+  ``409 {"not_leader": <hint>}`` and the client follows the hint
+  (client-transparent failover; the ``_TcpStore`` retry/backoff layer
+  above is unchanged).
+* **Epoch-numbered leader lease.** The lease record is replicated like
+  any key: the leader renews it every ``lease_ttl/3`` through the same
+  quorum append path as client writes, and every accepted append refreshes
+  the followers' lease deadline. A leader that cannot reach a quorum keeps
+  serving only until its OWN lease deadline, then steps down.
+* **Quorum acks + epoch fencing.** Writes carry ``(epoch, seq)``; the
+  leader acknowledges a client only after ⌊N/2⌋+1 replicas (itself
+  included) applied the record, and followers REJECT appends from a lower
+  epoch — a partitioned deposed leader can keep trying, but its appends
+  bounce (``stale_epoch``) and its clients get 503, never a false ack. An
+  acknowledged write therefore lives on a quorum, and any electable
+  successor intersects that quorum.
+* **Deterministic election.** On lease expiry a survivor stands for
+  ``epoch+1`` and wins with a quorum of votes. A vote is granted only to a
+  candidate whose ``(last_epoch, last_seq, node_id)`` is >= the voter's
+  own, so only the most-caught-up survivor (id as the tiebreak) can ever
+  collect a quorum; a refused candidate that learns of a better peer
+  defers instead of re-standing, so contested elections converge in a
+  round or two instead of livelocking.
+* **Snapshot catch-up.** A follower that answers an append with
+  ``behind`` (seq gap — it missed writes while down) gets the leader's
+  full state pushed (``/_install``) and the append retried: lagging
+  rejoiners catch up in one transfer, not one RPC per missed write.
+
+Failure seams (the r13 inject plane): ``store.replica.append`` fires
+per-peer per-append on the leader (raise/timeout/drop = that peer lost
+this append), ``store.lease.renew`` in the leader's renewal tick,
+``store.replica.kill`` in every replica's monitor tick (kind ``kill`` =
+this replica's deterministic SIGKILL), ``store.election.start`` /
+``store.election.won`` around candidacy. Observability (r12):
+``store_role`` / ``store_epoch`` / ``store_replication_lag`` gauges,
+``store_failovers_total``, and a flight dump on every leader change.
+
+Replica-plane protocol (JSON over the same HTTP server):
+  POST /_replicate  {epoch, seq, op, scope?, key?, value?, age}
+  POST /_vote       {epoch, last: [last_epoch, last_seq], id}
+  POST /_install    full snapshot (leader → lagging follower)
+  GET  /_snapshot   full snapshot (pull form)
+  GET  /_status     {id, role, epoch, seq, leader} (debug/bench/tests)
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from .http_server import KVClient, _BaseHandler
+
+__all__ = ["ReplicatedKVServer", "ReplicatedKVClient",
+           "ReplicatedStoreCluster", "quorum_size"]
+
+ROLE_FOLLOWER = "follower"
+ROLE_CANDIDATE = "candidate"
+ROLE_LEADER = "leader"
+_ROLE_CODE = {ROLE_FOLLOWER: 0, ROLE_CANDIDATE: 1, ROLE_LEADER: 2}
+
+#: reserved scope holding the replicated lease record
+_SYS_SCOPE = "_sys"
+
+
+def quorum_size(n: int) -> int:
+    return n // 2 + 1
+
+
+def _fire(point: str, **labels):
+    from ....resilience.inject import fire
+
+    return fire(point, **labels)
+
+
+class _ReplicaHandler(_BaseHandler):
+    """Per-server-bound handler (subclassed with ``server_obj`` set) —
+    client plane answered only by the leader, replica plane by everyone
+    (unless partitioned). Wire framing + scan rendering come from the
+    shared :class:`~.http_server._BaseHandler`."""
+
+    server_obj: "ReplicatedKVServer"
+
+    def _reply_json(self, status: int, doc: dict):
+        self._reply(status, json.dumps(doc).encode())
+
+    def _gone(self) -> bool:
+        # a killed replica answers NOTHING, including on lingering
+        # keep-alive connections — the client sees a dropped connection
+        # exactly like a SIGKILLed process's
+        if self.server_obj.dead:
+            self.close_connection = True
+            return True
+        return False
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(n)
+        try:
+            return json.loads(raw.decode()) if raw else {}
+        except ValueError:
+            return {}
+
+    # -- replica plane ---------------------------------------------------
+    def do_POST(self):
+        if self._gone():
+            return
+        srv = self.server_obj
+        path = self.path.split("?", 1)[0]
+        body = self._body()
+        if srv.partitioned:
+            # a partitioned replica is unreachable on the REPLICA plane
+            # (peers' appends/votes never arrive); 503 reads as "no ack"
+            self._reply_json(503, {"error": "partitioned"})
+            return
+        if path == "/_replicate":
+            status, doc = srv.handle_replicate(body)
+        elif path == "/_vote":
+            status, doc = srv.handle_vote(body)
+        elif path == "/_install":
+            status, doc = srv.handle_install(body)
+        else:
+            status, doc = 404, {"error": "unknown"}
+        self._reply_json(status, doc)
+
+    # -- client plane ----------------------------------------------------
+    def _not_leader(self):
+        self._reply_json(409, {"not_leader": self.server_obj.leader_hint})
+
+    def do_PUT(self):
+        if self._gone():
+            return
+        scope, key = self._parts()
+        if key is None:
+            self._reply(400)
+            return
+        n = int(self.headers.get("Content-Length", 0))
+        val = self.rfile.read(n).decode()
+        srv = self.server_obj
+        if not srv.is_leader():
+            self._not_leader()
+            return
+        ok = srv.leader_write("put", scope, key, val)
+        if ok is None:  # deposed mid-write
+            self._not_leader()
+        else:
+            self._reply_json(200 if ok else 503,
+                             {} if ok else {"error": "no_quorum"})
+
+    def do_DELETE(self):
+        if self._gone():
+            return
+        scope, key = self._parts()
+        srv = self.server_obj
+        if not srv.is_leader():
+            self._not_leader()
+            return
+        ok = srv.leader_write("delete", scope, key, "")
+        if ok is None:
+            self._not_leader()
+        else:
+            self._reply_json(200 if ok else 503,
+                             {} if ok else {"error": "no_quorum"})
+
+    def do_GET(self):
+        if self._gone():
+            return
+        srv = self.server_obj
+        path = self.path.split("?", 1)[0]
+        if path == "/_status":
+            self._reply_json(200, srv.status())
+            return
+        if path == "/_snapshot":
+            if srv.partitioned:
+                self._reply_json(503, {"error": "partitioned"})
+                return
+            self._reply_json(200, srv.snapshot())
+            return
+        scope, key = self._parts()
+        # reads are served by the leader only: a follower's state may lag
+        # the ack point, and the lease bounds how long a deposed leader
+        # can serve stale reads (the etcd model)
+        if not srv.is_leader():
+            self._not_leader()
+            return
+        bucket = srv.read_scope(scope)
+        if key is None:
+            self._reply(200, self._render_scan(bucket))
+            return
+        hit = bucket.get(key)
+        if hit is None:
+            self._reply(404)
+            return
+        self._reply(200, hit[0].encode())
+
+
+class ReplicatedKVServer:
+    """One replica of the quorum store.
+
+    Construct all N with the shared ``addrs`` list (``addrs[index]`` is
+    this replica; port 0 is allowed when built through
+    :class:`ReplicatedStoreCluster`, which collects the bound ports before
+    starting the protocol threads)."""
+
+    def __init__(self, index: int, addrs: List[str], *,
+                 lease_ttl: float = 2.0, host: str = "127.0.0.1",
+                 rpc_timeout: Optional[float] = None):
+        self.index = int(index)
+        self.node_id = f"s{index}"
+        self.lease_ttl = float(lease_ttl)
+        # peer RPCs must resolve well inside a monitor tick: a hung peer
+        # (vs a refused connection) cannot be allowed to stall the
+        # leader's renewal loop past its own lease
+        self.rpc_timeout = (float(rpc_timeout) if rpc_timeout is not None
+                            else max(self.lease_ttl / 4, 0.1))
+        port = int(addrs[index].rsplit(":", 1)[1])
+        handler = type("_BoundReplicaHandler", (_ReplicaHandler,), {})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        handler.server_obj = self
+        self.port = self._httpd.server_address[1]
+        self.addrs = list(addrs)
+        self.addrs[index] = f"{host}:{self.port}"
+        self.addr = self.addrs[index]
+        self.quorum = quorum_size(len(addrs))
+
+        # replicated state (all under _lock)
+        self._lock = threading.RLock()
+        self._kv: Dict[str, Dict[str, Tuple[str, float]]] = {}
+        self.epoch = 0        # highest epoch seen/voted/served
+        self.seq = 0          # last applied log position
+        self.last_epoch = 0   # epoch of the last applied record
+        self.role = ROLE_FOLLOWER
+        self.leader_hint: Optional[str] = None
+        self._voted: Dict[int, Tuple] = {}  # epoch -> (last, id) granted
+        self._peer_seq: Dict[str, int] = {}
+        # nobody is leader at boot: half a TTL of grace for peers to come
+        # up, then elect (a premature candidacy just fails and retries)
+        self._lease_deadline = time.monotonic() + self.lease_ttl / 2.0
+        self._defer_until = 0.0
+        self._last_renew = 0.0
+
+        self.dead = False
+        self.partitioned = False
+        self._stop = threading.Event()
+        self._wlock = threading.Lock()  # serializes the append pipeline
+        self._threads: List[threading.Thread] = []
+        self._peer_clients = {
+            a: KVClient(a, timeout=self.rpc_timeout)
+            for i, a in enumerate(self.addrs) if i != self.index}
+
+        from ....observability.metrics import default_registry
+
+        r = default_registry()
+        self._g_role = r.gauge(
+            "store_role",
+            "replica role (0 follower, 1 candidate, 2 leader)", ("node",))
+        self._g_epoch = r.gauge("store_epoch", "replica current epoch",
+                                ("node",))
+        self._g_lag = r.gauge(
+            "store_replication_lag",
+            "leader seq minus this peer's acked seq", ("node", "peer"))
+        self._c_failovers = r.counter(
+            "store_failovers_total", "leader elections won", ("node",))
+        self._g_role.set(0, node=self.node_id)
+        self._g_epoch.set(0, node=self.node_id)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ReplicatedKVServer":
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+        m = threading.Thread(target=self._monitor, daemon=True)
+        m.start()
+        self._threads.append(m)
+        return self
+
+    def _halt_http(self):
+        try:
+            if self._threads:  # shutdown() hangs if serve_forever never ran
+                self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+
+    def stop(self):
+        """Graceful stop (tests' cleanup path — NOT the chaos path)."""
+        self._stop.set()
+        self.dead = True
+        self._halt_http()
+
+    def kill(self):
+        """Abrupt death — the in-process SIGKILL: stop answering
+        ANYTHING, immediately, with no goodbye. Lingering keep-alive
+        handler threads drop their connections unanswered."""
+        self.dead = True
+        self._stop.set()
+        self._halt_http()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def partition(self, on: bool = True):
+        """Test/chaos hook: isolate this replica — its outbound replica
+        RPCs fail and inbound replica-plane requests answer 503 (both
+        directions dark, like a cut network). The CLIENT plane keeps
+        answering: a partitioned stale leader still accepting writes is
+        exactly the scenario epoch fencing must defeat."""
+        self.partitioned = bool(on)
+
+    # -- introspection ---------------------------------------------------
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.role == ROLE_LEADER and not self.dead
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"id": self.node_id, "role": self.role,
+                    "epoch": self.epoch, "seq": self.seq,
+                    "last_epoch": self.last_epoch,
+                    "leader": self.leader_hint}
+
+    def read_scope(self, scope: str) -> Dict[str, Tuple[str, float]]:
+        with self._lock:
+            return dict(self._kv.get(scope, {}))
+
+    def snapshot(self) -> dict:
+        """Full-state transfer document (ages, not stamps: monotonic
+        clocks don't travel between processes)."""
+        with self._lock:
+            now = time.monotonic()
+            return {
+                "epoch": self.epoch, "seq": self.seq,
+                "last_epoch": self.last_epoch,
+                "kv": {s: {k: [v, now - ts] for k, (v, ts) in b.items()}
+                       for s, b in self._kv.items()},
+            }
+
+    # -- the replicated log ----------------------------------------------
+    def _apply(self, rec: dict):
+        """Apply one record locally (caller holds the lock). Ages ride the
+        record so the stamp a replica keeps reflects the WRITE time, not
+        the replication time — heartbeat TTLs survive failover."""
+        op = rec["op"]
+        stamp = time.monotonic() - float(rec.get("age", 0.0))
+        if op == "put":
+            self._kv.setdefault(rec["scope"], {})[rec["key"]] = (
+                rec["value"], stamp)
+        elif op == "delete":
+            self._kv.get(rec["scope"], {}).pop(rec["key"], None)
+        elif op == "lease":
+            info = json.loads(rec["value"])
+            self.leader_hint = info["addr"]
+            self._kv.setdefault(_SYS_SCOPE, {})["lease"] = (
+                rec["value"], stamp)
+        self.seq = int(rec["seq"])
+        self.last_epoch = int(rec["epoch"])
+
+    def handle_replicate(self, rec: dict) -> Tuple[int, dict]:
+        with self._lock:
+            if int(rec.get("epoch", -1)) < self.epoch:
+                # FENCE: a deposed leader's append — reject, tell it why
+                return 409, {"error": "stale_epoch", "epoch": self.epoch}
+            if int(rec["epoch"]) > self.epoch or self.role != ROLE_FOLLOWER:
+                self._step_down(int(rec["epoch"]))
+            if int(rec["seq"]) <= self.seq:
+                # same-position record already present. It is a safe
+                # duplicate ONLY when this replica's tail was written by
+                # the SAME epoch's (single) leader; a tail from an older
+                # epoch may hold a locally-applied-but-never-acked record
+                # at this seq (a deposed leader's phantom) — dup-acking
+                # that would count divergent state toward the quorum and
+                # lose an acknowledged write. Force a snapshot instead.
+                if self.last_epoch == int(rec["epoch"]):
+                    self._touch_lease()
+                    return 200, {"seq": self.seq}
+                return 409, {"error": "behind", "seq": self.seq}
+            if int(rec["seq"]) != self.seq + 1:
+                # missed writes while down: ask for a snapshot
+                return 409, {"error": "behind", "seq": self.seq}
+            # Raft log-matching: the append names the epoch of the record
+            # preceding it; a mismatch means OUR tail diverged (phantom
+            # records from a deposed leadership) even though the seq
+            # numbers line up — snapshot, don't append on top
+            prev = rec.get("prev_epoch")
+            if prev is not None and int(prev) != self.last_epoch:
+                return 409, {"error": "behind", "seq": self.seq}
+            self._apply(rec)
+            self.leader_hint = rec.get("leader", self.leader_hint)
+            self._touch_lease()
+            return 200, {"seq": self.seq}
+
+    def handle_install(self, snap: dict) -> Tuple[int, dict]:
+        with self._lock:
+            if int(snap.get("epoch", -1)) < self.epoch:
+                return 409, {"error": "stale_epoch", "epoch": self.epoch}
+            # the current-epoch leader's snapshot is authoritative even
+            # when OUR seq is higher: a longer local tail from an older
+            # epoch is a deposed leadership's never-acked phantom state,
+            # and install is exactly the repair that truncates it
+            now = time.monotonic()
+            self._kv = {
+                s: {k: (v, now - float(age))
+                    for k, (v, age) in b.items()}
+                for s, b in snap["kv"].items()}
+            self.seq = int(snap["seq"])
+            self.last_epoch = int(snap["last_epoch"])
+            self._step_down(int(snap["epoch"]))
+            lease = self._kv.get(_SYS_SCOPE, {}).get("lease")
+            if lease is not None:
+                self.leader_hint = json.loads(lease[0])["addr"]
+            self._touch_lease()
+            return 200, {"seq": self.seq}
+
+    def handle_vote(self, req: dict) -> Tuple[int, dict]:
+        with self._lock:
+            target = int(req["epoch"])
+            cand = ((int(req["last"][0]), int(req["last"][1])),
+                    str(req["id"]))
+            mine = ((self.last_epoch, self.seq), self.node_id)
+            refuse = {"granted": False, "epoch": self.epoch,
+                      "last": [self.last_epoch, self.seq],
+                      "id": self.node_id}
+            if target <= self.epoch:
+                return 200, refuse
+            if (time.monotonic() < self._lease_deadline
+                    and (self.role == ROLE_LEADER
+                         or (self.role == ROLE_FOLLOWER
+                             and self.leader_hint is not None))):
+                # a live lease (mine as leader, or my leader's as
+                # follower) outranks any candidacy — no election needed
+                return 200, refuse
+            if target in self._voted:
+                return 200, refuse
+            if cand < mine:
+                # the candidate is behind me (or ties with a lower id):
+                # my refusal carries my tuple so it defers to a better
+                # survivor instead of burning epochs
+                return 200, refuse
+            self._voted[target] = cand
+            # granting adopts the epoch (Raft term semantics): the old
+            # leader is fenced here even before the winner's first append
+            self._step_down(target)
+            self.leader_hint = None
+            # ... and resets the election timer: the winner gets one full
+            # TTL to land its first lease append, or this voter's own
+            # candidacy in the gap would bump epochs that later fence the
+            # leader it just elected (churn)
+            self._touch_lease()
+            return 200, {"granted": True, "epoch": target}
+
+    # -- leader paths ----------------------------------------------------
+    def _post_peer(self, addr: str, path: str, doc: dict):
+        """One replica-plane RPC. Returns (status, body dict) or raises
+        OSError (unreachable / partitioned)."""
+        if self.partitioned:
+            raise ConnectionError("partitioned (outbound)")
+        status, data = self._peer_clients[addr]._request(
+            "POST", path, body=json.dumps(doc).encode())
+        try:
+            return status, (json.loads(data.decode()) if data else {})
+        except ValueError:
+            return status, {}
+
+    def _append_to_peer(self, addr: str, rec: dict) -> bool:
+        """Replicate one record to one peer; pushes a snapshot first when
+        the peer reports it is behind. True = peer applied (ack)."""
+        try:
+            status, doc = self._post_peer(addr, "/_replicate", rec)
+            if status == 409 and doc.get("error") == "behind":
+                snap = self.snapshot()
+                status, _ = self._post_peer(addr, "/_install", snap)
+                if status != 200:
+                    return False
+                status, doc = self._post_peer(addr, "/_replicate", rec)
+            if status == 409 and doc.get("error") == "stale_epoch":
+                with self._lock:
+                    self._step_down(int(doc.get("epoch", self.epoch)))
+                return False
+            if status == 200:
+                with self._lock:
+                    self._peer_seq[addr] = int(rec["seq"])
+                    self._g_lag.set(self.seq - self._peer_seq[addr],
+                                    node=self.node_id, peer=addr)
+                return True
+            return False
+        except OSError:
+            return False
+
+    def _replicate_record(self, op: str, scope: str, key: str,
+                          value: str) -> Optional[bool]:
+        """Build, locally apply, and quorum-replicate one record. Returns
+        True = acknowledged (quorum applied), False = no quorum (NOT
+        acknowledged; the record may or may not survive — exactly the
+        client contract of an unacked write), None = not leader anymore."""
+        with self._wlock:
+            with self._lock:
+                if self.role != ROLE_LEADER or self.dead:
+                    return None
+                rec = {"epoch": self.epoch, "seq": self.seq + 1, "op": op,
+                       "scope": scope, "key": key, "value": value,
+                       "age": 0.0, "leader": self.addr,
+                       # log-matching anchor: the epoch of the record this
+                       # one follows (followers verify their tail matches)
+                       "prev_epoch": self.last_epoch}
+                self._apply(rec)
+            acks = 1  # self
+            for i, addr in enumerate(self.addrs):
+                if i == self.index:
+                    continue
+                try:
+                    f = _fire("store.replica.append", node=self.node_id,
+                              peer=f"s{i}", op=op)
+                except Exception:
+                    continue  # injected transport failure: THIS peer only
+                if f is not None and f.kind == "drop":
+                    continue  # this peer never sees the append
+                try:
+                    if self._append_to_peer(addr, rec):
+                        acks += 1
+                except OSError:
+                    pass
+            if acks >= self.quorum:
+                return True
+            with self._lock:
+                if self.role != ROLE_LEADER:
+                    return None
+            return False
+
+    def leader_write(self, op: str, scope: str, key: str,
+                     value: str) -> Optional[bool]:
+        try:
+            return self._replicate_record(op, scope, key, value)
+        except Exception:
+            return False
+
+    def _renew_lease(self) -> Optional[bool]:
+        return self._replicate_record(
+            "lease", _SYS_SCOPE, "lease",
+            json.dumps({"id": self.node_id, "addr": self.addr,
+                        "epoch": self.epoch}))
+
+    # -- role transitions ------------------------------------------------
+    def _touch_lease(self):
+        self._lease_deadline = time.monotonic() + self.lease_ttl
+
+    def _step_down(self, epoch: int):
+        """Adopt ``epoch`` as a follower (caller holds the lock)."""
+        was_leader = self.role == ROLE_LEADER
+        self.epoch = max(self.epoch, int(epoch))
+        self.role = ROLE_FOLLOWER
+        self._g_role.set(0, node=self.node_id)
+        self._g_epoch.set(self.epoch, node=self.node_id)
+        if was_leader:
+            self.leader_hint = None
+
+    def _become_leader(self, epoch: int):
+        from ....observability.flight import flight_recorder
+
+        with self._lock:
+            self.epoch = int(epoch)
+            self.role = ROLE_LEADER
+            self.leader_hint = self.addr
+            self._peer_seq = {}
+            self._g_role.set(2, node=self.node_id)
+            self._g_epoch.set(self.epoch, node=self.node_id)
+        self._c_failovers.inc(node=self.node_id)
+        _fire("store.election.won", node=self.node_id, epoch=int(epoch))
+        # leader changes are exactly the moments a post-mortem needs:
+        # freeze the span ring + store series (in-memory unless armed)
+        flight_recorder().dump(
+            "store_leader_change",
+            extra={"node": self.node_id, "epoch": int(epoch),
+                   "seq": self.seq})
+        # the first append at the new epoch both announces the lease and
+        # fences every lower epoch on a quorum
+        ok = self._renew_lease()
+        if ok:
+            with self._lock:
+                self._touch_lease()
+            self._last_renew = time.monotonic()
+        else:
+            with self._lock:
+                if self.role == ROLE_LEADER:
+                    self._step_down(self.epoch)
+
+    def _stand_for_election(self):
+        with self._lock:
+            if self.role == ROLE_LEADER:
+                return
+            self.role = ROLE_CANDIDATE
+            self._g_role.set(1, node=self.node_id)
+            target = self.epoch + 1
+            my_last = (self.last_epoch, self.seq)
+            # a candidate votes for itself — recorded so a lesser rival
+            # asking at the same epoch is refused
+            self._voted.setdefault(target, (my_last, self.node_id))
+        _fire("store.election.start", node=self.node_id, epoch=target)
+        votes = 1
+        better_peer = False
+        for i, addr in enumerate(self.addrs):
+            if i == self.index:
+                continue
+            try:
+                status, doc = self._post_peer(addr, "/_vote", {
+                    "epoch": target, "last": list(my_last),
+                    "id": self.node_id})
+            except OSError:
+                continue
+            if status != 200:
+                continue
+            if doc.get("granted"):
+                votes += 1
+                continue
+            if int(doc.get("epoch", 0)) > target:
+                with self._lock:
+                    self._step_down(int(doc["epoch"]))
+                return
+            peer_last = doc.get("last")
+            if (peer_last is not None
+                    and ((int(peer_last[0]), int(peer_last[1])),
+                         str(doc.get("id", ""))) > (my_last, self.node_id)):
+                better_peer = True
+        if votes >= self.quorum:
+            self._become_leader(target)
+            return
+        with self._lock:
+            if self.role == ROLE_CANDIDATE:
+                self.role = ROLE_FOLLOWER
+                self._g_role.set(0, node=self.node_id)
+            if better_peer:
+                # a more-caught-up survivor exists: give it a full TTL to
+                # win before this replica considers standing again —
+                # the deterministic anti-livelock rule
+                self._defer_until = time.monotonic() + self.lease_ttl
+            self.epoch = max(self.epoch, target)
+
+    # -- monitor thread --------------------------------------------------
+    def _monitor(self):
+        tick = max(self.lease_ttl / 5.0, 0.02)
+        # stagger candidacies so simultaneous expiry does not produce N
+        # simultaneous candidates; HIGHEST id soonest — on equal (epoch,
+        # seq) only the highest id can win (the vote tiebreak), so letting
+        # it stand first converges in one round instead of two
+        stagger = (len(self.addrs) - 1 - self.index) * tick / 2.0
+        while not self._stop.wait(tick):
+            try:
+                f = _fire("store.replica.kill", node=self.node_id)
+                if f is not None and f.kind == "kill":
+                    self.kill()
+                    return
+                if self.dead:
+                    return
+                with self._lock:
+                    role = self.role
+                    expired = time.monotonic() > self._lease_deadline
+                    deferred = time.monotonic() < self._defer_until
+                if role == ROLE_LEADER:
+                    now = time.monotonic()
+                    if now - self._last_renew >= self.lease_ttl / 3.0:
+                        try:
+                            _fire("store.lease.renew", node=self.node_id,
+                                  epoch=self.epoch)
+                        except Exception:
+                            continue  # injected renewal failure: skip round
+                        if self._renew_lease():
+                            self._last_renew = now
+                            with self._lock:
+                                self._touch_lease()
+                        # re-stamp AFTER the (blocking) renewal RPCs: the
+                        # pre-renewal stamp would let a quorumless leader
+                        # serve reads past its own lease by the RPC time
+                        elif time.monotonic() > self._lease_deadline:
+                            # could not hold a quorum for a full lease:
+                            # deposed or partitioned — stop serving
+                            with self._lock:
+                                self._step_down(self.epoch)
+                elif expired and not deferred and not self.partitioned:
+                    if stagger:
+                        time.sleep(stagger)
+                        with self._lock:
+                            if (self.role == ROLE_LEADER or time.monotonic()
+                                    < self._lease_deadline):
+                                continue
+                    self._stand_for_election()
+            except Exception:
+                # the monitor is the replica's heart — it must survive
+                # any single failed round (peer down mid-vote, etc.)
+                pass
+
+
+class ReplicatedKVClient:
+    """Drop-in :class:`~.http_server.KVClient` over a replica set.
+
+    Same method surface and strict/lenient semantics; each logical RPC is
+    ONE discovery pass over the replicas — cached leader first, then
+    ``NotLeader`` hints, then the rest of the list — and raises OSError
+    (strict) only when the whole pass fails, so the caller's retry policy
+    (``_TcpStore`` backoff + ``RetryBudget``) sees a replicated store
+    exactly like a single one. Per-replica connections are kept alive
+    through the underlying clients."""
+
+    def __init__(self, addrs: List[str], timeout: float = 5.0):
+        if not addrs:
+            raise ValueError("need at least one replica address")
+        self.addrs = [a.strip() for a in addrs if a.strip()]
+        self.timeout = timeout
+        self._clients = {a: KVClient(a, timeout=timeout)
+                         for a in self.addrs}
+        self._leader: Optional[str] = None
+
+    @property
+    def addr(self) -> str:
+        return ",".join(self.addrs)
+
+    def _candidates(self) -> List[str]:
+        lead = self._leader
+        rest = [a for a in self.addrs if a != lead]
+        return ([lead] + rest) if lead else list(self.addrs)
+
+    def _call(self, method: str, path: str, body: Optional[bytes] = None
+              ) -> Tuple[int, bytes]:
+        """One leader-discovering pass. Statuses other than 409 come from
+        a replica CLAIMING leadership and are the caller's to interpret;
+        409 follows the hint; transport failure moves on. Raises
+        ConnectionError when no replica answered as leader."""
+        tried = set()
+        queue = self._candidates()
+        hops = 0
+        last_err: Optional[str] = None
+        while queue and hops < len(self.addrs) + 3:
+            addr = queue.pop(0)
+            if addr in tried or addr not in self._clients:
+                continue
+            tried.add(addr)
+            hops += 1
+            try:
+                status, data = self._clients[addr]._request(
+                    method, path, body=body)
+            except OSError as e:
+                if self._leader == addr:
+                    self._leader = None
+                last_err = f"{addr}: {type(e).__name__}"
+                continue
+            if status == 409:
+                if self._leader == addr:
+                    self._leader = None
+                try:
+                    hint = json.loads(data.decode()).get("not_leader")
+                except ValueError:
+                    hint = None
+                if hint and hint not in tried:
+                    queue.insert(0, hint)
+                    # a redirect target outside the configured list is
+                    # still followable (a replica knows best), one hop
+                    self._clients.setdefault(
+                        hint, KVClient(hint, timeout=self.timeout))
+                continue
+            if status == 503:
+                # a leader that cannot reach quorum: not a success, and
+                # not worth trying followers (they would redirect back) —
+                # fail the pass so the retry layer backs off
+                last_err = f"{addr}: no_quorum"
+                continue
+            self._leader = addr
+            return status, data
+        raise ConnectionError(
+            f"no reachable leader among {self.addr} ({last_err})")
+
+    def close(self):
+        for c in self._clients.values():
+            c.close()
+
+    # -- KVClient surface ------------------------------------------------
+    def put(self, scope: str, key: str, value: str,
+            strict: bool = False) -> bool:
+        try:
+            status, _ = self._call("PUT", f"/{scope}/{key}",
+                                   body=value.encode())
+            return status == 200
+        except OSError:
+            if strict:
+                raise
+            return False
+
+    def get(self, scope: str, key: str, strict: bool = False
+            ) -> Optional[str]:
+        try:
+            status, data = self._call("GET", f"/{scope}/{key}")
+            return data.decode() if status == 200 else None
+        except OSError:
+            if strict:
+                raise
+            return None
+
+    def delete(self, scope: str, key: str, strict: bool = False) -> bool:
+        try:
+            status, _ = self._call("DELETE", f"/{scope}/{key}")
+            return status == 200
+        except OSError:
+            if strict:
+                raise
+            return False
+
+    def scan(self, scope: str, strict: bool = False, keys_only: bool = False,
+             prefix: Optional[str] = None) -> Dict[str, Tuple[str, float]]:
+        try:
+            status, data = self._call(
+                "GET", KVClient._scan_path(scope, keys_only, prefix))
+            if status != 200:
+                return {}
+            parsed = json.loads(data.decode())
+            return {k: (v[0], float(v[1])) for k, v in parsed.items()}
+        except (OSError, ValueError):
+            if strict:
+                raise
+            return {}
+
+    def leader_status(self) -> Optional[dict]:
+        """{id, role, epoch, seq, leader} of the current leader, or None
+        when no replica claims leadership (bench/test introspection)."""
+        for addr in self._candidates():
+            try:
+                status, data = self._clients[addr]._request(
+                    "GET", "/_status")
+            except OSError:
+                continue
+            if status != 200:
+                continue
+            try:
+                doc = json.loads(data.decode())
+            except ValueError:
+                continue
+            if doc.get("role") == ROLE_LEADER:
+                self._leader = addr
+                doc["addr"] = addr
+                return doc
+        return None
+
+
+class ReplicatedStoreCluster:
+    """Build + run N replicas in-process (tests, bench, single host).
+
+    Ephemeral ports: replicas are bound one by one and the discovered
+    address list is shared before any protocol thread starts."""
+
+    def __init__(self, n: int = 3, *, lease_ttl: float = 2.0,
+                 host: str = "127.0.0.1"):
+        if n < 1:
+            raise ValueError("need at least one replica")
+        addrs = [f"{host}:0"] * n
+        self.servers: List[ReplicatedKVServer] = []
+        for i in range(n):
+            srv = ReplicatedKVServer(i, addrs, lease_ttl=lease_ttl,
+                                     host=host)
+            addrs[i] = srv.addr
+            self.servers.append(srv)
+        for srv in self.servers:
+            srv.addrs = list(addrs)
+            srv._peer_clients = {
+                a: KVClient(a, timeout=srv.rpc_timeout)
+                for j, a in enumerate(addrs) if j != srv.index}
+        self.addrs = list(addrs)
+
+    @property
+    def addr_spec(self) -> str:
+        """The multi-address ``_TcpStore`` spec ("a,b,c")."""
+        return ",".join(self.addrs)
+
+    def start(self) -> "ReplicatedStoreCluster":
+        for srv in self.servers:
+            srv.start()
+        return self
+
+    def stop(self):
+        for srv in self.servers:
+            srv.stop()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def leader(self, timeout: float = 10.0) -> ReplicatedKVServer:
+        """Block until exactly one live replica is leader; returns it."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            leaders = [s for s in self.servers
+                       if not s.dead and s.is_leader()]
+            if len(leaders) == 1:
+                return leaders[0]
+            time.sleep(0.02)
+        raise TimeoutError("no (single) leader elected within "
+                           f"{timeout}s: "
+                           f"{[(s.node_id, s.role) for s in self.servers]}")
+
+    def wait_for_leader_change(self, old_id: str,
+                               timeout: float = 10.0) -> ReplicatedKVServer:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            leaders = [s for s in self.servers
+                       if not s.dead and s.is_leader()
+                       and s.node_id != old_id]
+            if leaders:
+                return leaders[0]
+            time.sleep(0.02)
+        raise TimeoutError(f"no successor to {old_id} within {timeout}s")
+
+
+def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
+    """Run ONE replica as a process (the SIGKILL chaos drills):
+
+    python -m paddle_tpu.distributed.fleet.utils.replicated_store \\
+        --index 0 --addrs 127.0.0.1:7501,127.0.0.1:7502,127.0.0.1:7503
+    """
+    import argparse
+    import signal as _signal
+    import sys
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--index", type=int, required=True)
+    parser.add_argument("--addrs", required=True,
+                        help="comma-separated replica addresses")
+    parser.add_argument("--lease-ttl", type=float, default=2.0)
+    args = parser.parse_args(argv)
+    addrs = args.addrs.split(",")
+    host, port = addrs[args.index].rsplit(":", 1)
+    srv = ReplicatedKVServer(args.index, addrs, lease_ttl=args.lease_ttl,
+                             host=host).start()
+    print(f"READY {srv.node_id} {srv.addr}", flush=True)
+    stop = threading.Event()
+    _signal.signal(_signal.SIGTERM, lambda *_: stop.set())
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

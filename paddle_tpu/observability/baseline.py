@@ -71,7 +71,7 @@ _LOWER = ("overhead", "ttft", "latency", "_ms", "recovery_s",
 # not flag a later PERFECT 0.0 as "above the band ceiling")
 _MAGNITUDE = ("drift", "est_vs_measured")
 _COUNT_MAX = ("silent_drops", "dropped_requests", "inflight_failures",
-              "admitted_killed")
+              "admitted_killed", "writes_lost")
 
 
 def classify_metric(name: str, value) -> str:

@@ -36,6 +36,7 @@ that.
 from __future__ import annotations
 
 import json
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -46,6 +47,7 @@ from ..distributed.fleet.elastic.collective import (
     pack_arrays,
     unpack_arrays,
 )
+from ..distributed.fleet.elastic.manager import StoreUnavailable
 from ..observability import trace as obstrace
 from ..observability.flight import flight_recorder
 from ..observability.metrics import default_registry
@@ -195,21 +197,32 @@ class ElasticDPTrainer:
                                         metadata)
                 except FileNotFoundError:
                     chosen = None
-            self.manager.store.put(key, json.dumps({"step": chosen}))
+            deadline = time.monotonic() + self.rendezvous_timeout
+            while True:
+                try:
+                    self.manager.store.put(key, json.dumps({"step": chosen}))
+                    break
+                except OSError:
+                    # store failover window: the members are all polling
+                    # for this broadcast — keep trying to land it
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)
             return chosen
-        import time as _time
-
-        deadline = _time.monotonic() + self.rendezvous_timeout
-        while _time.monotonic() < deadline:
-            raw = self.manager.store.get(key)
-            if raw is not None:
-                return json.loads(raw)["step"]
-            leader = self.collective.members[0]
-            if leader not in self.manager.store.nodes():
-                raise RankFailure("recovery leader died before "
-                                  "broadcasting the snapshot step",
-                                  dead=[leader])
-            _time.sleep(0.05)
+        deadline = time.monotonic() + self.rendezvous_timeout
+        while time.monotonic() < deadline:
+            try:
+                raw = self.manager.store.get(key)
+                if raw is not None:
+                    return json.loads(raw)["step"]
+                leader = self.collective.members[0]
+                if leader not in self.manager.store.nodes():
+                    raise RankFailure("recovery leader died before "
+                                      "broadcasting the snapshot step",
+                                      dead=[leader])
+            except OSError:
+                pass  # store failover window: keep polling to deadline
+            time.sleep(0.05)
         raise TimeoutError("no snapshot decision from the recovery leader")
 
     def _restore(self, snapshot_step: Optional[int]):
@@ -344,6 +357,7 @@ class ElasticDPTrainer:
             # broadcasting the snapshot step — recover exactly like a
             # mid-training death (keeping the explicit resume preference)
             self._recover(str(e), prefer=resume_step)
+        store_deadline = None  # bounds consecutive store-outage retries
         while self.step < total_steps:
             if self.collective.membership_changed():
                 self._recover("membership changed at step boundary")
@@ -353,6 +367,24 @@ class ElasticDPTrainer:
             except RankFailure as e:
                 self._recover(str(e))
                 continue
+            except StoreUnavailable as e:
+                # coordination-store outage outlasting the collective's
+                # own in-loop tolerance (e.g. a replicated-store failover
+                # colliding with a retry burst): retry the SAME step —
+                # grad_fn is pure and the allgather keys/payloads are
+                # keyed by (generation, step, rank), so the replay is
+                # idempotent and the trajectory unchanged. Bounded: a
+                # store that stays dead past step_timeout re-raises.
+                now = time.monotonic()
+                if store_deadline is None:
+                    store_deadline = now + self.step_timeout
+                if now > store_deadline:
+                    raise
+                self.on_event(
+                    f"store unavailable at step {self.step}; retrying")
+                time.sleep(0.1)
+                continue
+            store_deadline = None
             self.history.append((self.step, self.world, loss))
             if self.on_step is not None:
                 self.on_step(self.step, self.world, loss)

@@ -44,7 +44,8 @@ kind                  semantics (seam in parentheses)
 ``kill``              abrupt death: replica ``kill()`` (serving loop),
                       heartbeat halt + :class:`InjectedDeath` (elastic
                       rank), emergency-save + :class:`InjectedDeath`
-                      (preemption guard)
+                      (preemption guard), store replica ``kill()``
+                      (replicated coordination store monitor)
 ====================  =====================================================
 
 Arming: :meth:`FaultSchedule.arm`/:meth:`disarm` install globally;
@@ -92,6 +93,16 @@ POINTS = (
     "elastic.store.rpc.delete",
     "elastic.store.rpc.scan",
     "elastic.store.rpc.scan_kv",
+    # replicated coordination store (r16): append is per-peer on the
+    # leader (raise/timeout/drop = that peer misses this append), renew
+    # fires in the leader's lease tick, kill in EVERY replica's monitor
+    # tick (kind `kill` = that replica's deterministic SIGKILL), and the
+    # election points mark candidacy/victory (raise delays candidacy)
+    "store.replica.append",
+    "store.lease.renew",
+    "store.replica.kill",
+    "store.election.start",
+    "store.election.won",
     "checkpoint.write",
     "engine.tick",
     "replica.tick",

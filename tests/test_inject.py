@@ -523,3 +523,19 @@ class TestEngineAndTransportSeams:
                 assert rr.resubmits == 0  # never failed over
                 snap = router.snapshot()
                 assert snap["replicas"][srv.addr]["state"] == "closed"
+
+
+# =====================================================================
+# r16: replicated-store seams are documented injection points
+# =====================================================================
+class TestReplicatedStorePoints:
+    def test_store_seams_documented(self):
+        """The r16 coordination-store seams belong to the documented
+        POINTS registry (schedules and tests should name them from
+        here); behavioral coverage lives in test_replicated_store."""
+        from paddle_tpu.resilience.inject import POINTS
+
+        for point in ("store.replica.append", "store.lease.renew",
+                      "store.replica.kill", "store.election.start",
+                      "store.election.won"):
+            assert point in POINTS

@@ -1,0 +1,713 @@
+"""Quorum-replicated coordination store (ISSUE 12).
+
+Fast tier: leader election + lease mechanics, quorum-acked writes with the
+durability invariant across a leader kill, epoch fencing of a partitioned
+stale leader, snapshot catch-up for lagging rejoiners, client-transparent
+failover through `ReplicatedKVClient` and the `_TcpStore` multi-address
+spec, the r13 inject seams (append drop / lease-renew faults / replica
+kill), KVClient keep-alive reuse, and the deterministic injected twins:
+leader-kill-during-rendezvous and leader-kill-during-allgather — both
+replayed twice with identical fired logs and a training trajectory
+bit-identical to the uninterrupted run.
+
+Slow tier (``-m chaos``): the real-SIGKILL leader e2e — three replica
+PROCESSES, the leader killed mid-elastic-DP-training.
+"""
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic.manager import (
+    ElasticManager,
+    StoreUnavailable,
+    _TcpStore,
+)
+from paddle_tpu.distributed.fleet.utils.http_server import KVClient, KVServer
+from paddle_tpu.distributed.fleet.utils.replicated_store import (
+    ReplicatedKVClient,
+    ReplicatedStoreCluster,
+    quorum_size,
+)
+from paddle_tpu.resilience import FaultSchedule
+from paddle_tpu.resilience.elastic_trainer import ElasticDPTrainer
+
+LEASE = 0.5  # every in-process cluster in this file
+
+
+@pytest.fixture()
+def cluster():
+    cl = ReplicatedStoreCluster(3, lease_ttl=LEASE).start()
+    yield cl
+    cl.stop()
+
+
+def _client(cl, timeout=2.0):
+    return ReplicatedKVClient(cl.addrs, timeout=timeout)
+
+
+# =====================================================================
+# quorum basics: election, replication, acks
+# =====================================================================
+class TestQuorumBasics:
+    def test_quorum_size(self):
+        assert [quorum_size(n) for n in (1, 2, 3, 4, 5)] == [1, 2, 2, 3, 3]
+
+    def test_single_deterministic_leader_at_boot(self, cluster):
+        lead = cluster.leader(timeout=10)
+        # all replicas boot with equal (epoch, seq); the vote tiebreak
+        # means only the highest id can collect a quorum
+        assert lead.node_id == "s2"
+        assert lead.epoch >= 1
+        assert sum(s.is_leader() for s in cluster.servers) == 1
+
+    def test_put_get_delete_scan_roundtrip(self, cluster):
+        cluster.leader(timeout=10)
+        c = _client(cluster)
+        assert c.put("job", "k", "v", strict=True)
+        assert c.get("job", "k", strict=True) == "v"
+        assert c.get("job", "absent") is None
+        assert c.put("job", "k2", "w", strict=True)
+        scan = c.scan("job", strict=True)
+        assert {k: v for k, (v, _a) in scan.items()} == {"k": "v", "k2": "w"}
+        keys = c.scan("job", keys_only=True, prefix="k2")
+        assert set(keys) == {"k2"} and keys["k2"][0] is None
+        assert c.delete("job", "k", strict=True)
+        assert c.get("job", "k") is None
+
+    def test_follower_redirects_to_leader(self, cluster):
+        import json
+
+        lead = cluster.leader(timeout=10)
+        follower = next(s for s in cluster.servers if not s.is_leader())
+        raw = KVClient(follower.addr, timeout=2.0)
+        # the hint is None in the brief window between granting the vote
+        # and the winner's first lease append landing — poll past it
+        deadline = time.monotonic() + 10
+        hint = None
+        while time.monotonic() < deadline and hint is None:
+            status, body = raw._request("PUT", "/job/k", body=b"v")
+            assert status == 409
+            hint = json.loads(body.decode())["not_leader"]
+            if hint is None:
+                time.sleep(0.05)
+        assert hint == lead.addr
+        # the replicated client follows the hint transparently
+        c = ReplicatedKVClient([follower.addr], timeout=2.0)
+        assert c.put("job", "k", "v", strict=True)
+        assert c.get("job", "k", strict=True) == "v"
+
+    def test_write_needs_quorum(self, cluster):
+        lead = cluster.leader(timeout=10)
+        c = _client(cluster)
+        assert c.put("job", "pre", "1", strict=True)
+        for s in cluster.servers:
+            if s is not lead:
+                s.kill()
+        # 1 of 3 alive: the survivor may still think itself leader but can
+        # never ack — no false acknowledgements, strict raises
+        assert c.put("job", "lost", "x") is False
+        with pytest.raises(OSError):
+            c.put("job", "lost", "x", strict=True)
+
+    def test_replicated_ages_preserve_ttl_liveness(self, cluster):
+        """Key ages ride the replication records, so TTL liveness judged
+        on the NEW leader after a failover continues from the write time,
+        not from the failover."""
+        lead = cluster.leader(timeout=10)
+        c = _client(cluster)
+        assert c.put("job", "hb", "ep", strict=True)
+        time.sleep(0.3)
+        lead.kill()
+        cluster.wait_for_leader_change(lead.node_id, timeout=10)
+        deadline = time.monotonic() + 10
+        age = None
+        while time.monotonic() < deadline:
+            try:
+                age = c.scan("job", strict=True)["hb"][1]
+                break
+            except OSError:
+                time.sleep(0.05)
+        assert age is not None and age >= 0.3
+
+
+# =====================================================================
+# failover: durability invariant + client transparency
+# =====================================================================
+class TestFailover:
+    def test_acked_writes_survive_leader_kill(self, cluster):
+        """THE durability invariant: every write acknowledged before the
+        leader is killed is readable after the election."""
+        lead = cluster.leader(timeout=10)
+        c = _client(cluster)
+        acked = {}
+        for i in range(25):
+            assert c.put("job", f"key{i}", f"val{i}", strict=True)
+            acked[f"key{i}"] = f"val{i}"
+        lead.kill()
+        new = cluster.wait_for_leader_change(lead.node_id, timeout=10)
+        assert new.epoch > lead.epoch
+        deadline = time.monotonic() + 10
+        got = None
+        while time.monotonic() < deadline:
+            try:
+                got = {k: v for k, (v, _a) in
+                       c.scan("job", strict=True).items()}
+                break
+            except OSError:
+                time.sleep(0.05)
+        assert got is not None
+        lost = {k: v for k, v in acked.items() if got.get(k) != v}
+        assert lost == {}
+        # and the new leader accepts writes
+        assert c.put("job", "after", "x", strict=True)
+
+    def test_tcpstore_multi_address_spec(self, cluster):
+        cluster.leader(timeout=10)
+        st = _TcpStore(cluster.addr_spec, "mjob", ttl=2.5, retries=5)
+        assert isinstance(st.client, ReplicatedKVClient)
+        st.register("node_a", "1.2.3.4:1")
+        st.put("k", "v")
+        assert st.get("k") == "v"
+        assert st.nodes() == ["node_a"]
+        assert st.endpoints() == ["1.2.3.4:1"]
+
+    def test_tcpstore_single_address_unchanged(self):
+        """The bit-comparison fallback: one address = the plain KVClient
+        path, byte-for-byte the pre-r16 behavior."""
+        with KVServer(0, host="127.0.0.1") as srv:
+            st = _TcpStore(f"127.0.0.1:{srv.port}", "sjob", ttl=2.0)
+            assert isinstance(st.client, KVClient)
+            assert not isinstance(st.client, ReplicatedKVClient)
+            st.register("n", "e")
+            assert st.nodes() == ["n"]
+
+    def test_heartbeat_rides_out_failover(self, cluster):
+        lead = cluster.leader(timeout=10)
+        st = _TcpStore(cluster.addr_spec, "hjob", ttl=2.5, retries=5)
+        st.register("node_a", "ep")
+        lead.kill()
+        st.heartbeat("node_a")  # retry burst + redirects mask the election
+        assert st.nodes() == ["node_a"]
+
+    def test_unreachable_cluster_raises_store_unavailable(self):
+        st = _TcpStore("127.0.0.1:1,127.0.0.1:2", "djob", ttl=0.4,
+                       retries=1)
+        with pytest.raises(StoreUnavailable):
+            st.heartbeat("n")
+
+    def test_lagging_follower_catches_up_via_snapshot(self, cluster):
+        """A partitioned (≙ down) follower misses writes; on heal, the
+        next append finds it behind and pushes a full snapshot."""
+        cluster.leader(timeout=10)
+        c = _client(cluster)
+        assert c.put("job", "k0", "v0", strict=True)
+        lag = next(s for s in cluster.servers if not s.is_leader())
+        lag.partition(True)
+        for i in range(1, 8):
+            assert c.put("job", f"k{i}", f"v{i}", strict=True)
+        assert lag.read_scope("job").get("k5") is None
+        lag.partition(False)
+        # the next replicated record (a write or a lease renewal) triggers
+        # behind → install; renewals tick every lease/3
+        assert c.put("job", "heal", "1", strict=True)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            got = {k: v for k, (v, _a) in lag.read_scope("job").items()}
+            if got.get("k5") == "v5" and got.get("heal") == "1":
+                break
+            time.sleep(0.05)
+        got = {k: v for k, (v, _a) in lag.read_scope("job").items()}
+        assert got.get("k5") == "v5" and got.get("heal") == "1"
+
+
+# =====================================================================
+# epoch fencing: the stale-leader satellite
+# =====================================================================
+class TestFencing:
+    def test_fenced_stale_leader_write_rejected(self, cluster):
+        """A partitioned deposed leader keeps accepting client RPCs but
+        its appends carry a lower epoch: followers reject them, the write
+        is NEVER acknowledged, and the key never reaches the new epoch."""
+        lead = cluster.leader(timeout=10)
+        c = _client(cluster)
+        assert c.put("job", "pre", "1", strict=True)
+        lead.partition(True)
+        stale = KVClient(lead.addr, timeout=2.0)
+        status, _ = stale._request("PUT", "/job/stale_key", body=b"evil")
+        assert status == 503  # accepted by nobody: NOT acknowledged
+        new = cluster.wait_for_leader_change(lead.node_id, timeout=10)
+        assert new.epoch > lead.epoch
+        # client-transparent: the same client object now lands on the new
+        # leader; the unacked stale write is invisible
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                assert c.get("job", "stale_key", strict=True) is None
+                assert c.get("job", "pre", strict=True) == "1"
+                break
+            except OSError:
+                time.sleep(0.05)
+        assert c.put("job", "post", "2", strict=True)
+        # heal: the deposed leader adopts the higher epoch, follows, and
+        # its phantom record is TRUNCATED by snapshot install (not just
+        # hidden behind the leader redirect)
+        lead.partition(False)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            got = {k: v for k, (v, _a) in lead.read_scope("job").items()}
+            if (lead.role == "follower" and lead.epoch >= new.epoch
+                    and got.get("post") == "2"
+                    and "stale_key" not in got):
+                break
+            time.sleep(0.05)
+        assert lead.role == "follower"
+        assert lead.epoch >= new.epoch
+        got = {k: v for k, (v, _a) in lead.read_scope("job").items()}
+        assert got.get("post") == "2" and "stale_key" not in got
+        # the healed ex-leader is now safely electable: kill the current
+        # leader — whoever wins must serve every acked write, no phantom
+        new.kill()
+        cluster.wait_for_leader_change(new.node_id, timeout=10)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                assert c.get("job", "pre", strict=True) == "1"
+                assert c.get("job", "post", strict=True) == "2"
+                assert c.get("job", "stale_key", strict=True) is None
+                break
+            except OSError:
+                time.sleep(0.05)
+        assert c.get("job", "stale_key") is None
+
+    def test_phantom_tail_never_acks_new_leaders_append(self):
+        """Log matching (the durability invariant's teeth): a replica
+        whose tail was written by a DEPOSED leadership — locally applied,
+        never acked — must not dup-ack the new leader's same-seq record
+        nor accept a gap-free append on top; both demand a snapshot,
+        which truncates the phantom even when seqs tie."""
+        cl = ReplicatedStoreCluster(3, lease_ttl=30.0)  # never started
+        try:
+            a = cl.servers[0]
+            a.epoch = 1
+            a._apply({"epoch": 1, "seq": 1, "op": "put", "scope": "s",
+                      "key": "k", "value": "phantom", "age": 0.0})
+            # new leader (epoch 2) replicates ITS record at the SAME seq:
+            # a false "already applied" ack here would count divergent
+            # state toward the quorum and lose the acknowledged write
+            status, doc = a.handle_replicate(
+                {"epoch": 2, "seq": 1, "op": "put", "scope": "s",
+                 "key": "k", "value": "acked", "age": 0.0,
+                 "prev_epoch": 0, "leader": "x"})
+            assert (status, doc["error"]) == (409, "behind")
+            # the snapshot repairs the divergence even at equal seq
+            status, _ = a.handle_install(
+                {"epoch": 2, "seq": 1, "last_epoch": 2,
+                 "kv": {"s": {"k": ["acked", 0.0]}}})
+            assert status == 200
+            assert a.read_scope("s")["k"][0] == "acked"
+            assert (a.seq, a.last_epoch) == (1, 2)
+            # gap-free append onto a mismatched tail is refused too
+            b = cl.servers[1]
+            b.epoch = 1
+            b._apply({"epoch": 1, "seq": 1, "op": "put", "scope": "s",
+                      "key": "k", "value": "phantom", "age": 0.0})
+            status, doc = b.handle_replicate(
+                {"epoch": 2, "seq": 2, "op": "put", "scope": "s",
+                 "key": "k2", "value": "v", "age": 0.0,
+                 "prev_epoch": 0, "leader": "x"})
+            assert (status, doc["error"]) == (409, "behind")
+        finally:
+            cl.stop()
+
+    def test_observability_series_and_flight_dump(self, cluster):
+        from paddle_tpu.observability.flight import flight_recorder
+        from paddle_tpu.observability.metrics import default_registry
+
+        lead = cluster.leader(timeout=10)
+        r = default_registry()
+        assert r.get("store_role").value(node=lead.node_id) == 2
+        follower = next(s for s in cluster.servers if not s.is_leader())
+        assert r.get("store_role").value(node=follower.node_id) == 0
+        assert r.get("store_epoch").value(node=lead.node_id) == lead.epoch
+        before = r.get("store_failovers_total").value(node="s1")
+        lead.kill()
+        new = cluster.wait_for_leader_change(lead.node_id, timeout=10)
+        assert r.get("store_role").value(node=new.node_id) == 2
+        if new.node_id == "s1":
+            assert (r.get("store_failovers_total").value(node="s1")
+                    >= before + 1)
+        # a leader change freezes a flight snapshot (in-memory even when
+        # no directory is armed); the dump lands just after the role
+        # flips, so poll briefly
+        deadline = time.monotonic() + 5
+        last = None
+        while time.monotonic() < deadline:
+            last = flight_recorder().last
+            if (last is not None
+                    and last["reason"] == "store_leader_change"
+                    and last["extra"]["node"] == new.node_id):
+                break
+            time.sleep(0.02)
+        assert last is not None
+        assert last["reason"] == "store_leader_change"
+        assert last["extra"]["node"] == new.node_id
+
+
+# =====================================================================
+# inject seams
+# =====================================================================
+class TestInjectSeams:
+    def test_append_drop_single_peer_still_acks(self, cluster):
+        """Dropping the append to ONE peer leaves a 2/3 quorum — the
+        write still acknowledges; the fired log records the drop."""
+        cluster.leader(timeout=10)
+        c = _client(cluster)
+        sched = FaultSchedule(seed=3).add(
+            "store.replica.append", "drop", match={"peer": "s0"}, at=1)
+        with sched:
+            assert c.put("job", "k", "v", strict=True)
+        assert [f["point"] for f in sched.fired_log()] == [
+            "store.replica.append"]
+        assert c.get("job", "k", strict=True) == "v"
+
+    def test_append_drop_both_peers_no_ack(self, cluster):
+        """Dropping the appends to BOTH peers starves the quorum: the
+        client gets a failure, never a false ack."""
+        lead = cluster.leader(timeout=10)
+        c = _client(cluster)
+        sched = (FaultSchedule(seed=4)
+                 .add("store.replica.append", "drop",
+                      match={"node": lead.node_id, "op": "put"}, every=1))
+        with sched:
+            assert c.put("job", "k", "v") is False
+        assert len(sched.fired_log()) == 2  # one drop per peer
+
+    def test_lease_renew_fault_forces_failover(self, cluster):
+        """A leader whose every renewal raises cannot hold its lease: the
+        survivors elect a successor and the deposed leader steps down."""
+        lead = cluster.leader(timeout=10)
+        sched = (FaultSchedule(seed=5)
+                 .add("store.lease.renew", "raise",
+                      match={"node": lead.node_id}, every=1))
+        with sched:
+            new = cluster.wait_for_leader_change(lead.node_id, timeout=15)
+        assert new.node_id != lead.node_id
+        assert len(sched.fired_log()) >= 1
+        deadline = time.monotonic() + 10
+        while lead.role == "leader" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert lead.role != "leader"
+
+    def test_replica_kill_replays_identically(self):
+        """Two runs of the same seeded kill schedule produce the same
+        fired log — the replay certificate."""
+        logs = []
+        for _ in range(2):
+            sched = FaultSchedule(seed=11).add(
+                "store.replica.kill", "kill", match={"node": "s2"}, at=4)
+            cl = ReplicatedStoreCluster(3, lease_ttl=LEASE)
+            with sched:
+                cl.start()
+                try:
+                    new = cl.wait_for_leader_change("s2", timeout=15)
+                    assert new.node_id in ("s0", "s1")
+                    assert cl.servers[2].dead
+                finally:
+                    cl.stop()
+            logs.append(sched.fired_log())
+        assert logs[0] == logs[1] == [
+            {"point": "store.replica.kill", "kind": "kill", "count": 4,
+             "labels": {"node": "s2"}}]
+
+
+# =====================================================================
+# KVClient keep-alive reuse (satellite)
+# =====================================================================
+class TestKVClientKeepAlive:
+    def test_connection_reused_across_rpcs(self):
+        with KVServer(0, host="127.0.0.1") as srv:
+            c = KVClient(f"127.0.0.1:{srv.port}", timeout=2.0)
+            dials = {"n": 0}
+            real = c._conn
+
+            def counting():
+                dials["n"] += 1
+                return real()
+
+            c._conn = counting
+            for i in range(10):
+                assert c.put("s", f"k{i}", "v")
+            assert c.get("s", "k0") == "v"
+            assert c.scan("s") and dials["n"] == 1
+
+    def test_stale_connection_redials_transparently(self):
+        srv = KVServer(0, host="127.0.0.1").start()
+        port = srv.port
+        c = KVClient(f"127.0.0.1:{port}", timeout=2.0)
+        assert c.put("s", "k", "v")
+        srv.stop()
+        srv2 = KVServer(port, host="127.0.0.1").start()
+        try:
+            # cached connection is stale (old server gone): one redial,
+            # no error surfaced to the caller
+            assert c.put("s", "k2", "v2", strict=True)
+            assert c.get("s", "k2", strict=True) == "v2"
+        finally:
+            srv2.stop()
+
+    def test_dead_server_still_raises_for_strict(self):
+        srv = KVServer(0, host="127.0.0.1").start()
+        port = srv.port
+        c = KVClient(f"127.0.0.1:{port}", timeout=1.0)
+        assert c.put("s", "k", "v")
+        srv.stop()
+        with pytest.raises(OSError):
+            c.put("s", "k", "v", strict=True)
+        assert c.put("s", "k", "v") is False
+
+
+# =====================================================================
+# deterministic injected twins: leader kill under elastic DP training
+# =====================================================================
+_W_STAR = np.arange(12.0).reshape(4, 3) / 10.0
+
+
+def _dp_grad_fn(params, step, rank, world):
+    rng = np.random.default_rng(100000 + 1000 * step + 10 * world + rank)
+    X = rng.standard_normal((8, 4))
+    E = X @ params["w"] + params["b"] - X @ _W_STAR
+    loss = float((E ** 2).mean())
+    return loss, {"w": 2 * X.T @ E / E.size,
+                  "b": 2 * E.sum(axis=0) / E.size}
+
+
+def _dp_init_params():
+    return {"w": np.zeros((4, 3)), "b": np.zeros((3,))}
+
+
+class TestLeaderKillTwins:
+    TOTAL = 5
+
+    def _run_cohort(self, tag, ckpt, n_ranks, *, schedule=None,
+                    start_delays=None, total=None):
+        """Elastic-DP rank THREADS over a fresh 3-replica cluster;
+        ``schedule`` (armed globally — the kill fires in a store monitor
+        thread, not a rank thread) drives store chaos. Returns per-rank
+        histories."""
+        cl = ReplicatedStoreCluster(3, lease_ttl=LEASE)
+        if schedule is not None:
+            schedule.arm()
+        cl.start()
+        histories = {i: [] for i in range(n_ranks)}
+        errors = {}
+
+        def rank_fn(i):
+            try:
+                if start_delays:
+                    time.sleep(start_delays[i])
+                st = _TcpStore(cl.addr_spec, f"job_{tag}", ttl=2.5,
+                               retries=5)
+                mgr = ElasticManager(store=st)
+                mgr.endpoint = f"127.0.0.1:{7700 + i}"
+                mgr.node_id = f"node_{i}"
+                tr = ElasticDPTrainer(
+                    mgr, ckpt, _dp_grad_fn, _dp_init_params, lr=0.3,
+                    momentum=0.9, min_ranks=1, step_timeout=60,
+                    rendezvous_timeout=60,
+                    on_step=lambda s, w, l: histories[i].append(
+                        (s, w, np.float64(l).hex())))
+                tr.run(total or self.TOTAL, wait_world=n_ranks)
+                tr.close()
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors[i] = repr(e)
+
+        threads = [threading.Thread(target=rank_fn, args=(i,), daemon=True)
+                   for i in range(n_ranks)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(180)
+                assert not t.is_alive(), "rank thread hung"
+        finally:
+            if schedule is not None:
+                schedule.disarm()
+            cl.stop()
+        assert not errors, errors
+        return histories
+
+    def _kill_schedule(self, at):
+        # the boot-time leader is deterministically s2 (highest id wins
+        # the equal-tuple tiebreak); kill it at its Nth monitor tick
+        return FaultSchedule(seed=11).add(
+            "store.replica.kill", "kill", match={"node": "s2"}, at=at)
+
+    def test_leader_kill_during_allgather_bit_identical(self, tmp_path):
+        """Kill the store leader mid-training (the ranks are inside the
+        gradient allgather loop by then): training continues through the
+        failover and the trajectory is bit-identical to an uninterrupted
+        run — with identical fired logs across two replays."""
+        runs, logs = [], []
+        for leg in ("a", "b"):
+            sched = self._kill_schedule(at=8)  # ~8 ticks ≈ mid-training
+            runs.append(self._run_cohort(
+                f"ag_{leg}", str(tmp_path / f"ck_{leg}"), 2,
+                schedule=sched))
+            logs.append(sched.fired_log())
+        assert logs[0] == logs[1] == [
+            {"point": "store.replica.kill", "kind": "kill", "count": 8,
+             "labels": {"node": "s2"}}]
+        plain = self._run_cohort("ag_p", str(tmp_path / "ck_p"), 2)
+        assert runs[0] == runs[1] == plain
+        steps = {s: (w, l) for s, w, l in runs[0][0]}
+        assert sorted(steps) == list(range(self.TOTAL))
+        assert all(w == 2 for w, _l in steps.values())
+
+    def test_leader_kill_during_rendezvous_bit_identical(self, tmp_path):
+        """Two ranks wait mid-rendezvous for a delayed third while the
+        store leader is killed: rendezvous converges after the election
+        and the trajectory matches the uninterrupted 3-rank run."""
+        delays = [0.0, 0.0, 2.0]  # rank 2 joins after the failover
+        runs, logs = [], []
+        for leg in ("a", "b"):
+            sched = self._kill_schedule(at=4)  # fires while 0/1 poll
+            runs.append(self._run_cohort(
+                f"rdv_{leg}", str(tmp_path / f"ck_{leg}"), 3,
+                schedule=sched, start_delays=delays, total=3))
+            logs.append(sched.fired_log())
+        assert logs[0] == logs[1] == [
+            {"point": "store.replica.kill", "kind": "kill", "count": 4,
+             "labels": {"node": "s2"}}]
+        plain = self._run_cohort("rdv_p", str(tmp_path / "ck_p"), 3,
+                                 start_delays=delays, total=3)
+        assert runs[0] == runs[1] == plain
+        steps = {s: (w, l) for s, w, l in runs[0][0]}
+        assert sorted(steps) == [0, 1, 2]
+        assert all(w == 3 for w, _l in steps.values())
+
+
+# =====================================================================
+# real-SIGKILL leader e2e (chaos tier, like the other three suites)
+# =====================================================================
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigkill_store_leader_mid_training_bit_identical(tmp_path):
+    """Three replica PROCESSES; SIGKILL the leader process mid-elastic-DP
+    training: rendezvous and allgather continue after lease expiry and
+    the trajectory is bit-identical to an uninterrupted run."""
+    import socket
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   p for p in (repo, os.environ.get("PYTHONPATH")) if p))
+
+    def launch_cluster():
+        addrs = [f"127.0.0.1:{free_port()}" for _ in range(3)]
+        spec = ",".join(addrs)
+        procs = []
+        for i in range(3):
+            p = subprocess.Popen(
+                [sys.executable, "-m",
+                 "paddle_tpu.distributed.fleet.utils.replicated_store",
+                 "--index", str(i), "--addrs", spec,
+                 "--lease-ttl", "1.0"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env)
+            procs.append(p)
+        return addrs, spec, procs
+
+    def wait_leader(addrs, timeout=30.0):
+        c = ReplicatedKVClient(addrs, timeout=2.0)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            doc = c.leader_status()
+            if doc is not None:
+                return doc
+            time.sleep(0.1)
+        raise TimeoutError("no leader in the process cluster")
+
+    def run_training(tag, spec, ckpt, kill=None):
+        """Two rank threads; ``kill`` = (leader_pid, after_step): SIGKILL
+        that pid once rank 0 passes the step."""
+        histories = {0: [], 1: []}
+        errors = {}
+        killed = threading.Event()
+
+        def on_step(i, s, w, l):
+            histories[i].append((s, w, np.float64(l).hex()))
+            if kill and i == 0 and s >= kill[1] and not killed.is_set():
+                killed.set()
+                os.kill(kill[0], signal.SIGKILL)
+
+        def rank_fn(i):
+            try:
+                st = _TcpStore(spec, f"job_{tag}", ttl=4.0, retries=6)
+                mgr = ElasticManager(store=st)
+                mgr.endpoint = f"127.0.0.1:{7800 + i}"
+                mgr.node_id = f"node_{i}"
+                tr = ElasticDPTrainer(
+                    mgr, ckpt, _dp_grad_fn, _dp_init_params, lr=0.3,
+                    momentum=0.9, min_ranks=1, step_timeout=120,
+                    rendezvous_timeout=120,
+                    on_step=lambda s, w, l: on_step(i, s, w, l))
+                tr.run(8, wait_world=2)
+                tr.close()
+            except Exception as e:  # pragma: no cover
+                errors[i] = repr(e)
+
+        threads = [threading.Thread(target=rank_fn, args=(i,), daemon=True)
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(240)
+            assert not t.is_alive(), "rank thread hung"
+        assert not errors, errors
+        if kill:
+            assert killed.is_set(), "kill trigger never reached"
+        return histories
+
+    # -- interrupted arm -------------------------------------------------
+    addrs, spec, procs = launch_cluster()
+    try:
+        doc = wait_leader(addrs)
+        leader_idx = int(doc["id"][1:])
+        hist_kill = run_training("kill", spec, str(tmp_path / "ck_kill"),
+                                 kill=(procs[leader_idx].pid, 2))
+        assert procs[leader_idx].poll() is not None  # really died
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    # -- uninterrupted arm ----------------------------------------------
+    addrs2, spec2, procs2 = launch_cluster()
+    try:
+        wait_leader(addrs2)
+        hist_plain = run_training("plain", spec2,
+                                  str(tmp_path / "ck_plain"))
+    finally:
+        for p in procs2:
+            if p.poll() is None:
+                p.kill()
+
+    # the acceptance criterion: bit-identical trajectories, all steps at
+    # world 2, both ranks agreeing
+    assert hist_kill == hist_plain
+    steps = {s: (w, l) for s, w, l in hist_kill[0]}
+    assert sorted(steps) == list(range(8))
+    assert all(w == 2 for w, _l in steps.values())
+    assert hist_kill[0] == hist_kill[1]

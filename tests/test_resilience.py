@@ -625,6 +625,26 @@ class TestRetry:
 # self-healing elastic store
 # =====================================================================
 class TestElasticSelfHealing:
+    def test_file_store_endpoints_skips_vanished_node(self, tmp_path):
+        """Regression: a node file expiring between the nodes() scan and
+        the endpoints() open (deregister racing the TTL walk) must be
+        skipped — endpoints() had no FileNotFoundError guard while
+        nodes() did, so the caller's membership poll crashed."""
+        from paddle_tpu.distributed.fleet.elastic.manager import _FileStore
+
+        store = _FileStore(str(tmp_path), ttl=60.0)
+        store.register("node_a", "1.1.1.1:1")
+        store.register("node_b", "2.2.2.2:2")
+        real_nodes = store.nodes
+
+        def nodes_then_vanish():
+            out = real_nodes()
+            (tmp_path / "node_a").unlink(missing_ok=True)
+            return out
+
+        store.nodes = nodes_then_vanish
+        assert store.endpoints() == ["2.2.2.2:2"]
+
     def test_tcp_store_retries_transient_failure(self):
         from paddle_tpu.distributed.fleet.elastic.manager import _TcpStore
         from paddle_tpu.distributed.fleet.utils import KVServer
