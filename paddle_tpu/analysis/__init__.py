@@ -69,6 +69,15 @@ from .memory import (
     memory_estimate,
     planner_drift_findings,
 )
+from .plan import (
+    CandidateSpec,
+    DeviceSpec,
+    PlannedCandidate,
+    PlanV2,
+    RematPolicy,
+    plan_consistency_findings,
+    plan_gpt,
+)
 from .sanitizer import (
     NonFiniteReport,
     SanitizeResult,
@@ -91,6 +100,13 @@ __all__ = [
     "estimate_memory",
     "memory_estimate",
     "planner_drift_findings",
+    "CandidateSpec",
+    "DeviceSpec",
+    "PlannedCandidate",
+    "PlanV2",
+    "RematPolicy",
+    "plan_consistency_findings",
+    "plan_gpt",
     "NonFiniteReport",
     "SanitizeResult",
     "SanitizerConfig",

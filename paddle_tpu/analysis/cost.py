@@ -48,6 +48,11 @@ __all__ = [
     "scope_costs",
     "execution_multiplier",
     "classify_intensity",
+    "collective_comm_bytes",
+    "ring_all_reduce_bytes",
+    "all_gather_bytes",
+    "reduce_scatter_bytes",
+    "all_to_all_bytes",
     "TRANSCENDENTAL_FLOPS",
     "DEFAULT_RIDGE_FLOPS_PER_BYTE",
     "CONTAINER_PRIMS",
@@ -134,6 +139,72 @@ def classify_intensity(intensity: float,
     return "compute-bound" if intensity >= ridge else "memory-bound"
 
 
+# ---------------------------------------------------------------------------
+# first-class collective payload models
+# ---------------------------------------------------------------------------
+# One definition per collective family: bytes moved over the slowest link per
+# participating device, as a function of payload and group size n.  Shared by
+# the per-eqn cost model below AND the auto-parallel planner's analytic
+# collective pricing (analysis/plan.py prices dp grad sync, ZeRO
+# reduce_scatter/all_gather, mp activation allreduces and MoE all_to_all with
+# THESE functions, so the two never drift apart).
+
+def ring_all_reduce_bytes(payload_bytes: float, n: int) -> float:
+    """Ring allreduce: reduce-scatter + all-gather, ``2(n-1)/n`` each way."""
+    return 2.0 * (n - 1) / n * payload_bytes if n > 1 else 0.0
+
+
+def all_gather_bytes(out_bytes: float, n: int) -> float:
+    """Each device receives the other ``n-1`` shards of the gathered OUT."""
+    return (n - 1) / n * out_bytes if n > 1 else 0.0
+
+
+def reduce_scatter_bytes(in_bytes: float, n: int) -> float:
+    """Each device sends ``(n-1)/n`` of its INPUT around the ring (the half
+    of ring-allreduce that lands sharded — the honest ZeRO-2 grad-sync
+    term)."""
+    return (n - 1) / n * in_bytes if n > 1 else 0.0
+
+
+def all_to_all_bytes(payload_bytes: float, n: int) -> float:
+    """Every device keeps ``1/n`` of its payload and ships the remaining
+    ``(n-1)/n`` (the MoE dispatch/combine term)."""
+    return (n - 1) / n * payload_bytes if n > 1 else 0.0
+
+
+def _point_to_point_bytes(payload_bytes: float, n: int) -> float:
+    return float(payload_bytes)
+
+
+#: collective prim → (bytes_in, bytes_out, n) → wire bytes.  A prim listed
+#: in COLLECTIVE_PRIMS but absent here is priced bytes-only with
+#: ``known=False`` and tallied in ``GraphCost.unknown`` — never silently
+#: zero-costed.
+_COLLECTIVE_MODELS = {
+    "psum": lambda bi, bo, n: ring_all_reduce_bytes(max(bi, bo), n),
+    "pmin": lambda bi, bo, n: ring_all_reduce_bytes(max(bi, bo), n),
+    "pmax": lambda bi, bo, n: ring_all_reduce_bytes(max(bi, bo), n),
+    "all_gather": lambda bi, bo, n: all_gather_bytes(bo, n),
+    "psum_scatter": lambda bi, bo, n: reduce_scatter_bytes(bi, n),
+    "reduce_scatter": lambda bi, bo, n: reduce_scatter_bytes(bi, n),
+    "all_to_all": lambda bi, bo, n: all_to_all_bytes(max(bi, bo), n),
+    "ppermute": lambda bi, bo, n: _point_to_point_bytes(max(bi, bo), n),
+    "pshuffle": lambda bi, bo, n: _point_to_point_bytes(max(bi, bo), n),
+    "pgather": lambda bi, bo, n: _point_to_point_bytes(max(bi, bo), n),
+}
+
+
+def collective_comm_bytes(prim: str, bytes_in: float, bytes_out: float,
+                          n: int) -> Tuple[float, bool]:
+    """(wire bytes, modeled?) for one collective execution over an
+    ``n``-rank group.  ``modeled=False`` = unknown collective family — the
+    caller must surface it (bytes-only fallback, GraphCost.unknown)."""
+    model = _COLLECTIVE_MODELS.get(prim)
+    if model is None:
+        return _point_to_point_bytes(max(bytes_in, bytes_out), n), False
+    return model(float(bytes_in), float(bytes_out), int(n)), True
+
+
 def _elems(aval_info) -> int:
     shape = aval_info[0]
     n = 1
@@ -198,21 +269,12 @@ def cost_eqn(prim: str, in_avals, out_avals, params: dict,
 
     if prim in COLLECTIVE_PRIMS:
         n, est = _group_size(params, mesh_axes)
-        payload = max(bytes_in, bytes_out)
-        if prim in ("psum", "pmin", "pmax"):
-            comm = 2.0 * (n - 1) / n * payload if n > 1 else 0.0
-        elif prim == "all_gather":
-            comm = (n - 1) / n * bytes_out if n > 1 else 0.0
-        elif prim in ("psum_scatter", "reduce_scatter"):
-            comm = (n - 1) / n * bytes_in if n > 1 else 0.0
-        elif prim == "all_to_all":
-            comm = (n - 1) / n * payload if n > 1 else 0.0
-        else:  # ppermute / pshuffle / pgather: point-to-point payload
-            comm = float(payload)
+        comm, modeled = collective_comm_bytes(prim, bytes_in, bytes_out, n)
         reduce_flops = in_elems if prim in ("psum", "pmin", "pmax") else 0
         return EqnCost(flops=float(reduce_flops),
                        bytes_in=bytes_in, bytes_out=bytes_out,
-                       comm_bytes=comm, estimated=est)
+                       comm_bytes=comm, estimated=est or not modeled,
+                       known=modeled)
 
     if prim == "axis_index":
         return EqnCost(bytes_out=bytes_out)

@@ -24,11 +24,12 @@ from jax.sharding import PartitionSpec as P
 from ...tensor import Tensor
 
 __all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine",
-           "ModelStats", "Plan", "plan_strategy"]
+           "ModelStats", "Plan", "plan_strategy", "plan_strategy_v2"]
 
 
 def __getattr__(name):
-    if name in ("ModelStats", "Plan", "Candidate", "plan_strategy"):
+    if name in ("ModelStats", "Plan", "Candidate", "plan_strategy",
+                "plan_strategy_v2"):
         from . import planner
 
         return getattr(planner, name)

@@ -16,6 +16,14 @@ with the standard TPU scaling model (jax-ml.github.io/scaling-book):
 Every candidate that fits HBM is kept with its full cost/memory breakdown
 (`Plan.candidates`) so users get DIAGNOSTICS, not just a winner — the gap
 VERDICT r3 called out for the annotation-only front door.
+
+Since planner v2 (``paddle_tpu.analysis.plan``) this constant model is the
+**fast-path prior and fallback**: :func:`plan_strategy_v2` runs the
+static-analysis-driven search — every candidate's actual trainer step is
+lowered to a ShapeDtypeStruct jaxpr and priced by the liveness peak-HBM
+estimator + roofline cost model — and uses the constants below only to
+order the lowering queue and to price candidates the host cannot lower
+(pp pipelines, meshes wider than the local device count).
 """
 from __future__ import annotations
 
@@ -24,6 +32,7 @@ import math
 from typing import List, Optional
 
 __all__ = ["ModelStats", "Plan", "Candidate", "plan_strategy",
+           "plan_strategy_v2",
            "GRAD_FACTOR_ALIASED", "GRAD_FACTOR_HELD",
            "ACT_BYTES_PER_ELEMENT_LAYER", "OVERLAP_TAX",
            "ALLREDUCE_RING_FACTOR"]
@@ -112,6 +121,19 @@ class Plan:
                 f"{c.microbatches:1d} {str(c.recompute):5s} "
                 f"{c.mem_bytes / 1e9:8.2f} {c.step_time_s * 1e3:9.2f}  yes")
         return "\n".join(lines)
+
+
+def plan_strategy_v2(cfg, n_devices: int, global_batch: int, **kwargs):
+    """The v2 front door: static-analysis-driven search over lowered
+    candidate steps (see :func:`paddle_tpu.analysis.plan.plan_gpt` for the
+    full keyword surface — device spec, budget, moment dtype,
+    ``max_lowered``).  Takes a :class:`~paddle_tpu.models.gpt.GPTConfig`
+    (the search lowers real model programs, so the analytic
+    :class:`ModelStats` summary is not enough) and returns an
+    :class:`~paddle_tpu.analysis.plan.PlanV2`."""
+    from ...analysis.plan import plan_gpt
+
+    return plan_gpt(cfg, n_devices, global_batch, **kwargs)
 
 
 def _divisors(n: int) -> List[int]:

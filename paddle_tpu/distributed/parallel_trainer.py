@@ -75,6 +75,7 @@ class ParallelTrainer:
         *,
         dp_axis: Optional[str] = "dp",
         fsdp_axis: Optional[str] = None,
+        slot_shard_axis: Optional[str] = None,
         compute_dtype=None,
         recompute: bool = False,
         accumulate_steps: int = 1,
@@ -83,6 +84,8 @@ class ParallelTrainer:
         sentinel=None,
         offload_optimizer: bool = False,
         strategy=None,
+        remat_policy=None,
+        abstract: bool = False,
     ):
         # DistributedStrategy wiring (the meta-optimizer config surface):
         # sharding_configs.optimize_offload ≙ offload_helper.py,
@@ -109,10 +112,27 @@ class ParallelTrainer:
         self.mesh = mesh
         self.dp_axis = dp_axis if dp_axis in mesh.shape else None
         self.fsdp_axis = fsdp_axis if fsdp_axis and fsdp_axis in mesh.shape else None
+        # ZeRO-1/2 without param sharding: shard ONLY the optimizer slots
+        # over this axis (the planner's lowered candidates use it to price
+        # slot sharding with a replicated local batch)
+        self.slot_shard_axis = (slot_shard_axis
+                                if slot_shard_axis and slot_shard_axis in mesh.shape
+                                else None)
         self.compute_dtype = compute_dtype
         self.recompute = recompute
         self.accumulate_steps = accumulate_steps
         self.donate = donate
+        # planner-emitted remat policy (analysis.plan.RematPolicy): applied
+        # here so the jitted step this trainer builds IS the priced program;
+        # a disabled policy must leave the jaxpr untouched (bit-for-bit)
+        self.remat_policy = remat_policy
+        if remat_policy is not None:
+            remat_policy.apply(self)
+        # abstract mode: params/opt-state/buffers are ShapeDtypeStructs and
+        # the jitted step is only ever TRACED (make_jaxpr/eval_shape), never
+        # dispatched — the planner lowers full-size candidates through the
+        # exact _build() code path without allocating device memory
+        self.abstract = bool(abstract)
         self.step_count = 0  # host step counter (telemetry spans + flight)
 
         # in-graph dynamic loss scaling (amp ops check_finite_and_unscale +
@@ -149,6 +169,15 @@ class ParallelTrainer:
                 spec = _fsdp_spec(tuple(p._data.shape), self.fsdp_axis,
                                   int(mesh.shape[self.fsdp_axis]), spec)
             self.param_specs[n] = spec
+        if self.abstract:
+            if offload_optimizer:
+                raise NotImplementedError(
+                    "abstract lowering with offload_optimizer is not "
+                    "composed (the update runs host-side, outside the "
+                    "jitted step the planner prices)")
+            self._init_abstract_state()
+            return
+
         def _owned_put(arr, sharding):
             # device_put ALIASES the source buffer when the placement
             # already matches (a distinct wrapper over the same memory —
@@ -209,7 +238,7 @@ class ParallelTrainer:
 
         # --- optimizer state placement (ZeRO-1/2 ≙ slot sharding) ------
         self.opt_state = optimizer.init_state(self.params)
-        shard_axis = self.fsdp_axis or self.dp_axis
+        shard_axis = self.slot_shard_axis or self.fsdp_axis or self.dp_axis
         if shard_axis:
             n_shard = int(mesh.shape[shard_axis])
             slot_specs = jax.tree_util.tree_map(
@@ -234,6 +263,59 @@ class ParallelTrainer:
 
         self._jit_step = None
         self._jit_eval = None
+
+    # ------------------------------------------------------------------
+    def _init_abstract_state(self):
+        """Abstract-mode state: the same placement DECISIONS as the concrete
+        path (param specs, ZeRO slot sharding, replication) recorded as
+        in_shardings over the real mesh, but every array is a
+        ShapeDtypeStruct — nothing is allocated, the step is only traced."""
+        import numpy as np
+
+        mesh = self.mesh
+
+        def _sds(arr):
+            if isinstance(arr, jax.ShapeDtypeStruct):
+                return arr
+            return jax.ShapeDtypeStruct(tuple(arr.shape), np.dtype(arr.dtype))
+
+        self.params = {n: _sds(p._data)
+                       for n, p in self._param_tensors.items()}
+        self.buffers = {n: _sds(b._data)
+                        for n, b in self._buffer_tensors.items()}
+        self.offload = False
+        self.opt_state = jax.eval_shape(self.optimizer.init_state,
+                                        self.params)
+        shard_axis = self.slot_shard_axis or self.fsdp_axis or self.dp_axis
+        repl = NamedSharding(mesh, P())
+        if shard_axis:
+            n_shard = int(mesh.shape[shard_axis])
+            slot_sh = jax.tree_util.tree_map(
+                lambda a: NamedSharding(mesh, _fsdp_spec(
+                    tuple(a.shape), shard_axis, n_shard, P())),
+                self.opt_state["slots"])
+        else:
+            slot_sh = jax.tree_util.tree_map(lambda a: repl,
+                                             self.opt_state["slots"])
+        # mirror of the concrete path's `a.sharding` read in _build()
+        self._opt_shardings = {
+            "slots": slot_sh,
+            "step": repl,
+        }
+        self._jit_step = None
+        self._jit_eval = None
+
+    def lowered_step_args(self, xb, yb, rng_key=None, lr: float = 1e-4):
+        """The abstract argument tuple for tracing ``_jit_step`` —
+        ShapeDtypeStruct state plus the caller's batch specs (the planner's
+        AnalysisTarget args)."""
+        from ..random import split_key
+
+        if rng_key is None:
+            rng_key = split_key()
+        return (self.params, self.opt_state, self.buffers, xb, yb, rng_key,
+                self.scale_state, self.sentinel_state,
+                jnp.asarray(lr, jnp.float32))
 
     # ------------------------------------------------------------------
     def _loss_from_tree(self, params, buffers, xb, yb, rng_key):
@@ -422,10 +504,15 @@ class ParallelTrainer:
             )
             return
 
-        opt_sh = jax.tree_util.tree_map(
-            lambda a: a.sharding if isinstance(a, jax.Array) else None,
-            self.opt_state,
-        )
+        if self.abstract:
+            # ShapeDtypeStructs carry no placement; the recorded decisions
+            # from _init_abstract_state are the in_shardings
+            opt_sh = self._opt_shardings
+        else:
+            opt_sh = jax.tree_util.tree_map(
+                lambda a: a.sharding if isinstance(a, jax.Array) else None,
+                self.opt_state,
+            )
         buf_sh = {n: NamedSharding(mesh, P()) for n in self.buffers}
         batch_sh = NamedSharding(mesh, P(dp) if dp else P())
         repl = NamedSharding(mesh, P())
@@ -451,6 +538,11 @@ class ParallelTrainer:
     def step(self, x, y):
         from ..random import split_key
 
+        if self.abstract:
+            raise RuntimeError(
+                "abstract trainer: the jitted step exists only to be traced "
+                "(analysis/plan.py candidate pricing); build a concrete "
+                "ParallelTrainer to execute")
         if self._jit_step is None:
             self._build()
         xb = x._data if isinstance(x, Tensor) else jnp.asarray(x)

@@ -680,6 +680,23 @@ class GPTModel(Layer):
             x = block(x)
         return self.ln_f(x)
 
+    def set_recompute(self, enabled: bool = True, *,
+                      granularity: str = "full", interval: int = 1):
+        """Re-flag per-block rematerialization after construction — the
+        application hook for a planner-emitted
+        :class:`~paddle_tpu.analysis.plan.RematPolicy` (same semantics as
+        constructing with ``use_recompute``/``recompute_granularity``/
+        ``recompute_interval``; MoE blocks stay un-rematerialized)."""
+        interval = int(interval)
+        self.config.use_recompute = bool(enabled)
+        self.config.recompute_granularity = granularity
+        self.config.recompute_interval = interval
+        for i, block in enumerate(self.h):
+            block._use_recompute = (bool(enabled) and not block.is_moe
+                                    and interval >= 1
+                                    and i % interval == 0)
+            block._recompute_granularity = granularity
+
     def aux_loss(self):
         """Sum of MoE load-balancing losses from the latest forward (same
         trace), pre-scaled by ``moe_aux_loss_weight``; 0.0 for dense models."""
@@ -704,6 +721,15 @@ class GPTForPretraining(Layer):
         return Layer.set_state_dict(self, state_dict, use_structured_name)
 
     load_dict = set_state_dict
+
+    def set_recompute(self, enabled: bool = True, *,
+                      granularity: str = "full", interval: int = 1):
+        self.gpt.set_recompute(enabled, granularity=granularity,
+                               interval=interval)
+
+    @property
+    def config(self):
+        return self.gpt.config
 
     def forward(self, input_ids, position_ids=None):
         x = self.gpt(input_ids, position_ids)

@@ -19,6 +19,8 @@ from ..random import split_key
 
 __all__ = [
     "Initializer",
+    "abstract_init",
+    "abstract_init_active",
     "Constant",
     "Uniform",
     "Normal",
@@ -45,6 +47,44 @@ def _fans(shape: Sequence[int]):
     # conv [out_c, in_c, *k] (paddle conv layout)
     receptive = int(np.prod(shape[2:]))
     return shape[1] * receptive, shape[0] * receptive
+
+
+# ---------------------------------------------------------------------------
+# abstract initialization (the auto-parallel planner's lowering path)
+# ---------------------------------------------------------------------------
+# Under ``abstract_init()`` Layer.create_parameter skips the initializer and
+# hands the Parameter a jax.ShapeDtypeStruct instead of a materialized array:
+# a multi-GB model becomes constructible in microseconds for shape-level
+# tracing (jax.make_jaxpr / jax.eval_shape see exactly the same program).
+# Thread-local so a planner search in one thread cannot leak abstract params
+# into a concurrently-constructed real model.
+import threading as _threading
+
+_abstract_tls = _threading.local()
+
+
+def abstract_init_active() -> bool:
+    """True inside an :func:`abstract_init` block (this thread only)."""
+    return bool(getattr(_abstract_tls, "depth", 0))
+
+
+class abstract_init:
+    """Context manager: parameters created inside are ShapeDtypeStructs.
+
+    The resulting Layer can be traced (``functional_call_with_state`` swaps
+    tracer values in for the stored specs) but never executed eagerly —
+    reading a parameter's VALUE raises, by construction, because the spec is
+    not an array.  Used by ``analysis.plan`` to lower full-size candidate
+    train steps without allocating a byte of HBM.
+    """
+
+    def __enter__(self):
+        _abstract_tls.depth = getattr(_abstract_tls, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _abstract_tls.depth -= 1
+        return False
 
 
 class Initializer:

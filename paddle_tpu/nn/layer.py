@@ -162,8 +162,17 @@ class Layer:
         # startup-program role (params exist before Executor.run)
         from ..static.program import dygraph_guard
 
-        with dygraph_guard():
-            data = init(tuple(int(s) for s in shape), dtype)
+        if init_mod.abstract_init_active():
+            # planner lowering path: a shape/dtype spec instead of a
+            # materialized array — full-size models become constructible
+            # without allocating (analysis/plan.py candidate lowering)
+            import jax as _jax
+
+            data = _jax.ShapeDtypeStruct(
+                tuple(int(s) for s in shape), np.dtype(dtype))
+        else:
+            with dygraph_guard():
+                data = init(tuple(int(s) for s in shape), dtype)
         p = Parameter(data, trainable=(attr.trainable if attr else True))
         p.name = attr.name if attr and attr.name else _unique_name(self._full_name + ".w")
         if attr is not None:

@@ -45,7 +45,10 @@ class Tensor:
     def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None):
         if isinstance(data, Tensor):
             data = data._data
-        if not isinstance(data, jax.Array):
+        # ShapeDtypeStructs ride as-is: the analysis planner's abstract
+        # lowering (analysis/plan.py) builds full-size models whose params
+        # are shape/dtype specs only — never materialized, only traced
+        if not isinstance(data, (jax.Array, jax.ShapeDtypeStruct)):
             data = jnp.asarray(data)
         self._data = data
         self.stop_gradient = stop_gradient

@@ -1,0 +1,631 @@
+"""Auto-parallel planner v2 (analysis.plan): static-analysis-driven search.
+
+Covers the ISSUE-13 acceptance surface:
+
+* first-class collective models with hand-computed bytes (the honest
+  ZeRO / MoE pricing terms);
+* abstract lowering fidelity — the ShapeDtypeStruct trainer builds the
+  bit-identical jaxpr of the concrete trainer, at zero allocation;
+* the ROADMAP-mandated validation: planner v2 reproduces the known-good
+  1.3B single-chip config (remat REQUIRED and chosen) and refuses the
+  measured BENCH_r02 16 GB OOM config (f32 moments), both on lowered-but-
+  never-executed 1.3B targets;
+* <0.5% self-consistency between the chosen plan's recorded peak and a
+  fresh liveness estimate on the same target (equality by construction),
+  with the legacy-constant fallback still drift-checked;
+* the planner-emitted jax.checkpoint policy: bit-identical trajectories
+  where remat is optional, identical jaxpr where no remat is planned;
+* the --plan CLI exit contract and the committed plan_table.json artifact.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis.cost import (
+    all_gather_bytes,
+    all_to_all_bytes,
+    collective_comm_bytes,
+    cost_eqn,
+    reduce_scatter_bytes,
+    ring_all_reduce_bytes,
+)
+from paddle_tpu.analysis.plan import (
+    CandidateSpec,
+    DeviceSpec,
+    RematPolicy,
+    enumerate_candidates,
+    plan_consistency_findings,
+    plan_gpt,
+)
+from paddle_tpu.distributed.env import clear_mesh, init_mesh
+from paddle_tpu.models.gpt import (
+    GPTForPretraining,
+    GPTPretrainingCriterion,
+    gpt_config,
+)
+from paddle_tpu.optimizer.optimizers import AdamW
+
+_GiB = 1024 ** 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    yield
+    clear_mesh()
+
+
+def _small_cfg(**over):
+    base = dict(vocab_size=64, hidden_size=32, num_layers=2,
+                num_attention_heads=4, max_position_embeddings=32,
+                hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    base.update(over)
+    return gpt_config("gpt2-small", **base)
+
+
+def _trainer(model, crit, **kw):
+    from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+
+    return ParallelTrainer(
+        model, lambda o, y: crit(o, y),
+        AdamW(learning_rate=1e-3, parameters=model.parameters()),
+        dp_axis=None, **kw)
+
+
+# ---------------------------------------------------------------------------
+# collective models (hand-computed bytes)
+# ---------------------------------------------------------------------------
+class TestCollectiveModels:
+    def test_ring_all_reduce_hand_computed(self):
+        # 4 ranks, 100 B payload: 2 * (4-1)/4 * 100 = 150 B over the ring
+        assert ring_all_reduce_bytes(100, 4) == pytest.approx(150.0)
+        assert ring_all_reduce_bytes(100, 1) == 0.0
+
+    def test_reduce_scatter_hand_computed(self):
+        # the ZeRO grad-sync half: (n-1)/n of the INPUT
+        assert reduce_scatter_bytes(100, 4) == pytest.approx(75.0)
+        assert reduce_scatter_bytes(4096, 8) == pytest.approx(3584.0)
+        assert reduce_scatter_bytes(100, 1) == 0.0
+
+    def test_all_gather_hand_computed(self):
+        assert all_gather_bytes(80, 8) == pytest.approx(70.0)
+        assert all_gather_bytes(80, 1) == 0.0
+
+    def test_all_to_all_hand_computed(self):
+        # MoE dispatch: each rank keeps 1/n, ships (n-1)/n
+        assert all_to_all_bytes(64, 4) == pytest.approx(48.0)
+        assert all_to_all_bytes(64, 1) == 0.0
+
+    def test_cost_eqn_delegates_to_the_shared_models(self):
+        # one psum_scatter of a [16, 16] f32 over a 4-way axis: input
+        # 1024 B, comm = (4-1)/4 * 1024 = 768 B — the SAME function the
+        # planner prices ZeRO with
+        c = cost_eqn("psum_scatter",
+                     ((((16, 16), "float32", False)),),
+                     ((((4, 16), "float32", False)),),
+                     {"axes": ("x",)}, {"x": 4})
+        assert c.comm_bytes == pytest.approx(
+            reduce_scatter_bytes(16 * 16 * 4, 4))
+        assert c.known
+        c2 = cost_eqn("all_to_all",
+                      ((((16, 16), "float32", False)),),
+                      ((((16, 16), "float32", False)),),
+                      {"axis_name": "x"}, {"x": 4})
+        assert c2.comm_bytes == pytest.approx(all_to_all_bytes(1024, 4))
+
+    def test_unknown_collective_is_never_silently_zero_costed(self):
+        comm, modeled = collective_comm_bytes("future_collective",
+                                              1000, 2000, 4)
+        assert not modeled and comm == pytest.approx(2000.0)
+
+    def test_unmodeled_collective_prim_lands_in_unknown(self, monkeypatch):
+        # a prim in COLLECTIVE_PRIMS with no model entry must fall back
+        # bytes-only with known=False (→ GraphCost.unknown), not zero
+        from paddle_tpu.analysis import cost as cost_mod
+
+        models = dict(cost_mod._COLLECTIVE_MODELS)
+        models.pop("psum")
+        monkeypatch.setattr(cost_mod, "_COLLECTIVE_MODELS", models)
+        c = cost_eqn("psum", ((((8,), "float32", False)),),
+                     ((((8,), "float32", False)),),
+                     {"axes": ("x",)}, {"x": 4})
+        assert not c.known and c.comm_bytes > 0
+
+
+# ---------------------------------------------------------------------------
+# abstract lowering fidelity
+# ---------------------------------------------------------------------------
+class TestAbstractLowering:
+    def test_abstract_model_matches_real_param_tree(self):
+        from paddle_tpu.nn.initializer import abstract_init
+
+        cfg = _small_cfg()
+        paddle.seed(0)
+        real = GPTForPretraining(cfg)
+        with abstract_init():
+            abstr = GPTForPretraining(cfg)
+        rp = {n: p._data for n, p in real.named_parameters()}
+        ap = {n: p._data for n, p in abstr.named_parameters()}
+        assert set(rp) == set(ap)
+        for n in rp:
+            assert isinstance(ap[n], jax.ShapeDtypeStruct), n
+            assert tuple(ap[n].shape) == tuple(rp[n].shape), n
+            assert ap[n].dtype == rp[n].dtype, n
+
+    def test_abstract_trainer_jaxpr_identical_to_concrete(self):
+        from paddle_tpu.nn.initializer import abstract_init
+        from paddle_tpu.random import split_key
+
+        cfg = _small_cfg()
+        init_mesh({"dp": 1})
+        paddle.seed(0)
+        m1 = GPTForPretraining(cfg)
+        t1 = _trainer(m1, GPTPretrainingCriterion(cfg))
+        t1._build()
+        key = split_key()
+        x = jnp.zeros((2, 16), jnp.int32)
+        j1 = jax.make_jaxpr(t1._jit_step)(
+            t1.params, t1.opt_state, t1.buffers, x, x, key,
+            t1.scale_state, t1.sentinel_state,
+            jnp.asarray(1e-3, jnp.float32))
+
+        with abstract_init():
+            m2 = GPTForPretraining(cfg)
+        t2 = _trainer(m2, GPTPretrainingCriterion(cfg), abstract=True)
+        t2._build()
+        xs = jax.ShapeDtypeStruct((2, 16), jnp.int32)
+        j2 = jax.make_jaxpr(t2._jit_step)(
+            *t2.lowered_step_args(xs, xs, rng_key=key, lr=1e-3))
+        assert str(j1) == str(j2)
+
+    def test_abstract_trainer_refuses_to_execute(self):
+        from paddle_tpu.nn.initializer import abstract_init
+
+        cfg = _small_cfg()
+        init_mesh({"dp": 1})
+        with abstract_init():
+            m = GPTForPretraining(cfg)
+        t = _trainer(m, GPTPretrainingCriterion(cfg), abstract=True)
+        with pytest.raises(RuntimeError, match="abstract trainer"):
+            t.step(jnp.zeros((2, 16), jnp.int32),
+                   jnp.zeros((2, 16), jnp.int32))
+
+    def test_slot_shard_axis_shards_slots_only(self):
+        # ZeRO-1/2 realization: moments sharded over 'sharding', params
+        # replicated — the in_shardings divisor the planner prices
+        from jax.sharding import PartitionSpec as P
+
+        cfg = _small_cfg()
+        init_mesh({"sharding": 4})
+        paddle.seed(0)
+        m = GPTForPretraining(cfg)
+        t = _trainer(m, GPTPretrainingCriterion(cfg),
+                     slot_shard_axis="sharding")
+        del P
+        wname = "gpt.h.0.mlp.fc_in.weight"
+        # params replicated (no mesh axis in the spec)...
+        assert not any(d for d in t.params[wname].sharding.spec)
+        # ...while the Adam moments are sharded over the slot axis
+        slot = t.opt_state["slots"][wname]["moment1"]
+        assert "sharding" in str(slot.sharding.spec)
+
+
+# ---------------------------------------------------------------------------
+# the search on a small config
+# ---------------------------------------------------------------------------
+class TestSearchSmall:
+    @pytest.fixture(scope="class")
+    def plan(self):
+        cfg = _small_cfg()
+        return plan_gpt(cfg, 4, 8, seq_len=16, max_lowered=12)
+
+    def test_enumeration_lattice(self):
+        from paddle_tpu.distributed.auto_parallel.planner import ModelStats
+
+        stats = ModelStats(n_params=1000, n_layers=2, hidden=32, seq_len=16)
+        specs = enumerate_candidates(stats, 4, 8)
+        ids = {s.plan_id for s in specs}
+        assert "dp4-mp1-pp1-zero1-m1-remat1" in ids
+        assert "dp1-mp4-pp1-zero0-m1-remat0" in ids
+        assert "dp2-mp1-pp2-zero0-m2-remat0" in ids
+        # dp=1 never carries a ZeRO stage
+        assert not any(s.dp == 1 and s.zero_stage for s in specs)
+
+    def test_chosen_is_analysis_priced_and_feasible(self, plan):
+        assert plan.chosen is not None
+        assert plan.n_lowered > 0
+        ranked = [c for c in plan.candidates if c.feasible]
+        assert ranked[0] is plan.chosen
+        # analysis-priced rows outrank the legacy fallback; step time is
+        # monotone within each pricing tier
+        assert plan.chosen.priced_by == "analysis"
+        tiers = [c.priced_by != "analysis" for c in ranked]
+        assert tiers == sorted(tiers)
+        exact = [c.step_time_s for c in ranked
+                 if c.priced_by == "analysis"]
+        assert exact == sorted(exact)
+
+    def test_table_schema(self, plan):
+        tb = plan.table()
+        assert tb["schema_version"] == 1
+        assert tb["chosen"] == plan.chosen.spec.plan_id
+        row = tb["candidates"][0]
+        for key in ("plan_id", "priced_by", "feasible", "predicted_step_s",
+                    "predicted_peak_hbm_bytes", "binding_term",
+                    "collective_bytes", "runtime_axes"):
+            assert key in row, key
+
+    def test_zero_slot_sharding_shrinks_peak(self, plan):
+        rows = {c.spec.plan_id: c for c in plan.candidates
+                if c.priced_by == "analysis"}
+        z0 = rows.get("dp4-mp1-pp1-zero0-m1-remat0")
+        z1 = rows.get("dp4-mp1-pp1-zero1-m1-remat0")
+        if z0 is None or z1 is None:
+            pytest.skip("both zero twins were not in the lowered set")
+        assert z1.peak_hbm_bytes < z0.peak_hbm_bytes
+
+    def test_dp_candidates_price_grad_sync(self, plan):
+        dp_rows = [c for c in plan.candidates
+                   if c.priced_by == "analysis" and c.spec.dp > 1]
+        assert dp_rows
+        for c in dp_rows:
+            keys = set(c.collective_bytes)
+            if c.spec.zero_stage >= 3:
+                assert "reduce_scatter:grads@dp" in keys
+                assert "all_gather:params@dp" in keys
+            else:
+                assert "all_reduce:grads@dp" in keys
+
+    def test_mp_candidates_price_activation_allreduce(self, plan):
+        mp_rows = [c for c in plan.candidates
+                   if c.priced_by == "analysis" and c.spec.mp > 1]
+        assert mp_rows
+        for c in mp_rows:
+            assert "all_reduce:activations@mp" in c.collective_bytes
+            # hand-check: 4 allreduces/layer of b_local*t*h*act_bytes
+            expect = 4 * 2 * ring_all_reduce_bytes(
+                (8 // c.spec.dp) * 16 * 32 * 2, c.spec.mp)
+            assert c.collective_bytes["all_reduce:activations@mp"] == \
+                pytest.approx(expect)
+
+    def test_self_consistency_by_construction(self, plan):
+        fs = plan_consistency_findings(plan)
+        assert all(f.severity.name != "HIGH" for f in fs), fs
+        info = [f for f in fs if f.rule == "planner-consistency"]
+        assert info and "by construction" in info[0].message
+        assert info[0].details["drift"] < 0.005
+
+    def test_tampered_peak_is_flagged_high(self, plan):
+        import copy
+
+        tampered = copy.copy(plan)
+        tampered.chosen = copy.copy(plan.chosen)
+        tampered.chosen.peak_hbm_bytes = int(
+            plan.chosen.peak_hbm_bytes * 1.02)
+        fs = plan_consistency_findings(tampered)
+        assert any(f.severity.name == "HIGH" for f in fs)
+
+    def test_legacy_fallback_mode_stays_drift_checked(self):
+        # max_lowered=0 forces every row onto the legacy prior — the
+        # consistency check must then run the old constant-model drift
+        # check (satellite: fallback path keeps its gate)
+        cfg = _small_cfg()
+        plan = plan_gpt(cfg, 1, 2, seq_len=16, max_lowered=0)
+        assert plan.chosen is not None
+        assert plan.chosen.priced_by == "legacy-prior"
+        fs = plan_consistency_findings(plan)
+        rules = {f.rule for f in fs}
+        assert "planner-drift" in rules
+        assert "planner-consistency" in rules
+        assert all(f.severity.name != "HIGH" for f in fs), fs
+
+    def test_pp_candidates_fall_back_to_legacy_prior(self, plan):
+        pp_rows = [c for c in plan.candidates if c.spec.pp > 1]
+        assert pp_rows
+        assert all(c.priced_by == "legacy-prior" for c in pp_rows)
+        assert all(c.lowering_error for c in pp_rows)
+
+
+# ---------------------------------------------------------------------------
+# ROADMAP validation: 1.3B known-good + BENCH_r02 OOM, on SDS targets
+# ---------------------------------------------------------------------------
+def _cfg_13b(seq):
+    return gpt_config("gpt3-1.3b", hidden_dropout_prob=0.0,
+                      attention_dropout_prob=0.0,
+                      max_position_embeddings=seq)
+
+
+class TestValidation13B:
+    @pytest.fixture(scope="class")
+    def known_good(self):
+        # BENCH_r05 lineage: 1.3B, batch 4, seq 1024, bf16 Adam moments —
+        # measured 14.8k tok/s/chip WITH remat; no-remat compile-OOMs
+        return plan_gpt(_cfg_13b(1024), 1, 4, seq_len=1024,
+                        moment_dtype="bfloat16", max_lowered=4)
+
+    @pytest.fixture(scope="class")
+    def oom_r02(self):
+        # BENCH_r02: f32 params + Adam moments ~15.6 GB — measured OOM on
+        # a 16 GB v5e-1 with AND without remat
+        return plan_gpt(_cfg_13b(1024), 1, 4, seq_len=1024,
+                        moment_dtype="float32", max_lowered=4)
+
+    def test_known_good_chooses_remat(self, known_good):
+        chosen = known_good.require_feasible()
+        assert chosen.spec.remat is True
+        assert chosen.priced_by == "analysis"
+        assert chosen.peak_hbm_bytes <= 16 * _GiB
+
+    def test_known_good_refuses_no_remat(self, known_good):
+        twin = next(c for c in known_good.candidates
+                    if not c.spec.remat and c.priced_by == "analysis")
+        assert not twin.feasible
+        assert twin.refusal and twin.spec.plan_id in twin.refusal
+        assert twin.peak_hbm_bytes > 16 * _GiB
+
+    def test_known_good_self_consistency(self, known_good):
+        fs = plan_consistency_findings(known_good)
+        assert all(f.severity.name != "HIGH" for f in fs), fs
+        info = [f for f in fs if f.rule == "planner-consistency"][0]
+        assert info.details["drift"] < 0.005
+
+    def test_known_good_emits_remat_policy(self, known_good):
+        pol = known_good.remat_policy()
+        assert pol.enabled
+        assert pol.plan_id == known_good.chosen.spec.plan_id
+        assert pol.scopes  # peak-path profiler scopes named
+
+    def test_oom_config_refused_with_named_candidates(self, oom_r02):
+        assert oom_r02.chosen is None
+        assert all(not c.feasible for c in oom_r02.candidates)
+        analysis_rows = [c for c in oom_r02.candidates
+                         if c.priced_by == "analysis"]
+        assert analysis_rows
+        for c in analysis_rows:
+            assert c.refusal and c.spec.plan_id in c.refusal
+            assert c.peak_hbm_bytes > 16 * _GiB
+        with pytest.raises(ValueError, match="no candidate fits"):
+            oom_r02.require_feasible()
+        assert not oom_r02.remat_policy().enabled
+
+    def test_peaks_track_the_measured_boundary(self, known_good, oom_r02):
+        # the liveness estimator must separate the two configs the way the
+        # hardware did: bf16-moments+remat under 16 GiB, everything else
+        # decisively over
+        rows = {c.spec.remat: c.peak_hbm_bytes
+                for c in known_good.candidates if c.priced_by == "analysis"}
+        assert rows[True] < 16 * _GiB < rows[False]
+        oom_rows = [c.peak_hbm_bytes for c in oom_r02.candidates
+                    if c.priced_by == "analysis"]
+        assert min(oom_rows) > 16 * _GiB
+
+
+# ---------------------------------------------------------------------------
+# planner-emitted remat policy: applied by the trainer
+# ---------------------------------------------------------------------------
+class TestRematPolicyApplication:
+    def _run_steps(self, trainer, ids, n=4):
+        losses = []
+        for _ in range(n):
+            losses.append(np.asarray(trainer.step(ids, ids)._data).copy())
+        return losses
+
+    def test_policy_vs_unremated_bitwise_forward_tight_trajectory(self):
+        # a config that fits with or without remat.  Pinned invariants:
+        # (1) from identical state the FORWARD loss is bit-identical (remat
+        #     only restructures the backward);
+        # (2) the loss/param trajectories track to tight f32 tolerance.
+        # Strict grad bit-identity remat-vs-no-remat is NOT a property jax
+        # provides — the checkpoint transpose reassociates the cotangent
+        # accumulation (measured: ulp-level diffs even under
+        # jax.disable_jit, i.e. with no XLA fusion at all).  The
+        # bit-for-bit guarantee lives one test down: the policy-applied
+        # program IS the priced remat program, jaxpr-identical.
+        cfg = _small_cfg()
+        ids = paddle.to_tensor(np.random.default_rng(0).integers(
+            0, 64, (4, 16)).astype("int32"))
+
+        init_mesh({"dp": 1})
+        paddle.seed(7)
+        m_plain = GPTForPretraining(cfg)
+        t_plain = _trainer(m_plain, GPTPretrainingCriterion(cfg))
+        paddle.seed(7)
+        ref = self._run_steps(t_plain, ids)
+
+        paddle.seed(7)
+        m_pol = GPTForPretraining(cfg)
+        pol = RematPolicy(enabled=True, granularity="full", interval=1,
+                          scopes=("gpt.attn", "gpt.mlp"))
+        t_pol = _trainer(m_pol, GPTPretrainingCriterion(cfg),
+                         remat_policy=pol)
+        assert m_pol.gpt.h[0]._use_recompute  # policy reached the blocks
+        paddle.seed(7)
+        got = self._run_steps(t_pol, ids)
+
+        # (1) step-1 loss: same params, same forward → same bits
+        np.testing.assert_array_equal(ref[0], got[0])
+        # (2) the whole trajectory stays within f32 noise
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                                   rtol=1e-5, atol=1e-6)
+        sa = t_plain.capture_state()["params"]
+        sb = t_pol.capture_state()["params"]
+        for n in sa:
+            # absolute bound: Adam's rsqrt amplifies ulp-level grad diffs
+            # on near-zero second moments, so relative tolerance is
+            # meaningless for near-zero bias entries
+            np.testing.assert_allclose(sa[n], sb[n], rtol=0,
+                                       atol=2e-3, err_msg=n)
+
+    def test_policy_realizes_the_priced_program(self):
+        # trainer(policy) ≡ trainer(model constructed with use_recompute):
+        # the program the planner priced is the program the policy builds
+        from paddle_tpu.random import split_key
+
+        init_mesh({"dp": 1})
+        key = split_key()
+        x = jnp.zeros((2, 16), jnp.int32)
+
+        def jaxpr_of(trainer):
+            trainer._build()
+            return str(jax.make_jaxpr(trainer._jit_step)(
+                trainer.params, trainer.opt_state, trainer.buffers, x, x,
+                key, trainer.scale_state, trainer.sentinel_state,
+                jnp.asarray(1e-3, jnp.float32)))
+
+        paddle.seed(3)
+        m_cfg = GPTForPretraining(_small_cfg(use_recompute=True))
+        j_cfg = jaxpr_of(_trainer(m_cfg, GPTPretrainingCriterion(
+            _small_cfg(use_recompute=True))))
+
+        paddle.seed(3)
+        m_pol = GPTForPretraining(_small_cfg())
+        pol = RematPolicy(enabled=True, granularity="full", interval=1)
+        j_pol = jaxpr_of(_trainer(m_pol, GPTPretrainingCriterion(
+            _small_cfg()), remat_policy=pol))
+        assert j_cfg == j_pol
+        assert "remat2" in j_pol
+
+    def test_disabled_policy_is_a_jaxpr_noop(self):
+        from paddle_tpu.random import split_key
+
+        init_mesh({"dp": 1})
+        key = split_key()
+        x = jnp.zeros((2, 16), jnp.int32)
+
+        def jaxpr_of(trainer):
+            trainer._build()
+            return str(jax.make_jaxpr(trainer._jit_step)(
+                trainer.params, trainer.opt_state, trainer.buffers, x, x,
+                key, trainer.scale_state, trainer.sentinel_state,
+                jnp.asarray(1e-3, jnp.float32)))
+
+        cfg = _small_cfg()
+        paddle.seed(5)
+        m1 = GPTForPretraining(cfg)
+        j1 = jaxpr_of(_trainer(m1, GPTPretrainingCriterion(cfg)))
+        paddle.seed(5)
+        m2 = GPTForPretraining(cfg)
+        j2 = jaxpr_of(_trainer(m2, GPTPretrainingCriterion(cfg),
+                               remat_policy=RematPolicy(enabled=False)))
+        assert j1 == j2
+        assert "remat2" not in j2
+
+    def test_policy_falls_back_to_loss_checkpoint_for_non_gpt(self):
+        from paddle_tpu.nn import Linear, ReLU, Sequential
+        from paddle_tpu.optimizer.optimizers import SGD
+        from paddle_tpu.distributed.parallel_trainer import ParallelTrainer
+        from paddle_tpu.random import split_key
+
+        init_mesh({"dp": 1})
+        paddle.seed(0)
+        model = Sequential(Linear(8, 16), ReLU(), Linear(16, 4))
+        pol = RematPolicy(enabled=True)
+        t = ParallelTrainer(model, lambda o, y: ((o - y) ** 2).mean(),
+                            SGD(0.1), dp_axis=None, remat_policy=pol)
+        assert t.recompute is True
+        t._build()
+        j = str(jax.make_jaxpr(t._jit_step)(
+            t.params, t.opt_state, t.buffers,
+            jnp.zeros((2, 8), jnp.float32), jnp.zeros((2, 4), jnp.float32),
+            split_key(), t.scale_state, t.sentinel_state,
+            jnp.asarray(0.1, jnp.float32)))
+        assert "remat2" in j
+
+
+# ---------------------------------------------------------------------------
+# CLI + committed artifact
+# ---------------------------------------------------------------------------
+class TestPlanCLI:
+    def _argv(self, tmp_path, *extra):
+        return ["--plan", "--plan-model", "gpt2-small",
+                "--plan-devices", "1", "--plan-batch", "2",
+                "--plan-seq", "16", "--plan-max-lowered", "2",
+                "--plan-hidden", "32", "--plan-layers", "2",
+                "--plan-vocab", "64", "--plan-heads", "4",
+                "--out", str(tmp_path / "plan.json"), *extra]
+
+    def test_custom_plan_writes_table_and_exits_zero(self, tmp_path):
+        from paddle_tpu.analysis.cli import main
+
+        rc = main(self._argv(tmp_path))
+        assert rc == 0
+        doc = json.loads((tmp_path / "plan.json").read_text())
+        assert doc["schema_version"] == 1
+        (key, tb), = doc["scenarios"].items()
+        assert tb["chosen"] is not None
+        assert tb["candidates"][0]["priced_by"] == "analysis"
+
+    def test_infeasible_under_budget_exits_one(self, tmp_path):
+        from paddle_tpu.analysis.cli import main
+
+        rc = main(self._argv(tmp_path, "--device-budget", "100000"))
+        assert rc == 1
+        doc = json.loads((tmp_path / "plan.json").read_text())
+        (key, tb), = doc["scenarios"].items()
+        assert tb["chosen"] is None
+        assert all(r["refusal"] for r in tb["candidates"]
+                   if r["priced_by"] == "analysis")
+
+    def test_pinned_candidate_gates_exit(self, tmp_path):
+        from paddle_tpu.analysis.cli import main
+
+        rc = main(self._argv(tmp_path, "--plan-pin",
+                             "dp1-mp1-pp1-zero0-m1-remat0"))
+        assert rc == 0
+        rc = main(self._argv(tmp_path, "--plan-pin", "no-such-plan"))
+        assert rc == 1
+
+    def test_plan_flags_require_plan_mode(self):
+        from paddle_tpu.analysis.cli import main
+
+        with pytest.raises(SystemExit):
+            main(["--plan-model", "gpt2-small"])
+
+    def test_committed_artifact_anchors(self):
+        # the committed benchmarks/plan_table.json IS the validation run:
+        # known-good 1.3B chose a remat plan, BENCH_r02 refused everything
+        path = os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                            "plan_table.json")
+        doc = json.load(open(path))
+        assert doc["schema_version"] == 1
+        assert doc["all_expectations_met"] is True
+        good = doc["scenarios"]["gpt3-1.3b_v5e1_bf16moments"]
+        assert good["chosen"] and good["chosen"].endswith("remat1")
+        assert good["remat_policy"]["enabled"] is True
+        chosen_row = next(r for r in good["candidates"]
+                          if r["plan_id"] == good["chosen"])
+        assert chosen_row["predicted_peak_hbm_bytes"] <= 16 * _GiB
+        oom = doc["scenarios"]["gpt3-1.3b_v5e1_f32moments_bench_r02"]
+        assert oom["chosen"] is None
+        assert all(not r["feasible"] for r in oom["candidates"])
+
+    def test_committed_artifact_peak_matches_estimator_to_half_percent(
+            self):
+        # acceptance: the committed chosen-plan peak must match the
+        # liveness estimator on a freshly lowered target to <0.5% (same
+        # estimator, same lowering — equality in practice)
+        from paddle_tpu.analysis.memory import estimate_memory
+        from paddle_tpu.analysis.plan import _gpt_builder, lower_candidate
+
+        path = os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                            "plan_table.json")
+        doc = json.load(open(path))
+        good = doc["scenarios"]["gpt3-1.3b_v5e1_bf16moments"]
+        row = next(r for r in good["candidates"]
+                   if r["plan_id"] == good["chosen"])
+        spec = CandidateSpec(
+            dp=row["dp"], mp=row["mp"], pp=row["pp"],
+            zero_stage=row["zero_stage"], microbatches=row["microbatches"],
+            remat=row["remat"])
+        target = lower_candidate(
+            spec, _gpt_builder(_cfg_13b(1024), moment_dtype="bfloat16"),
+            global_batch=good["global_batch"], seq_len=good["seq_len"])
+        est = estimate_memory(target)
+        drift = (abs(est.peak_bytes - row["predicted_peak_hbm_bytes"])
+                 / row["predicted_peak_hbm_bytes"])
+        assert drift < 0.005, (est.peak_bytes,
+                               row["predicted_peak_hbm_bytes"])
