@@ -423,6 +423,80 @@ def _analysis_overhead():
     return out
 
 
+def _host_analysis():
+    """Concurrency-doctor secondary (ISSUE 14): host-lint coverage
+    (modules scanned, findings by severity, lock/edge counts, wall time)
+    plus the instrumented-lock recorder's measured wall tax on the suites
+    it arms. The tax is computed from MEASURED pieces, never modeled
+    constants: (acquires recorded by the committed tier-1 journal) x
+    (micro-measured per-acquire wrapper delta on this box) / (the
+    journal's armed wall seconds) — the <2% acceptance bound gates as a
+    boolean."""
+    import time as _time
+
+    from paddle_tpu.analysis import lockmodel
+    from paddle_tpu.analysis.hostrace import analyze_host, default_journal_path
+
+    report = analyze_host()  # merges the committed journal when present
+    counts = report.counts()
+    out = {
+        "host_analysis_modules": report.meta["n_modules"],
+        "host_analysis_locks": report.meta["n_locks"],
+        "host_analysis_lint_s": report.meta["total_s"],
+        "host_findings_high": counts["HIGH"],
+        "host_findings_medium": counts["MEDIUM"],
+        "host_findings_low": counts["LOW"],
+        "host_findings_info": counts["INFO"],
+        "host_lock_graph_acyclic": bool(report.meta["lock_graph_acyclic"]),
+        "host_static_edges": report.meta["n_static_edges"],
+        "host_runtime_edges": report.meta["n_runtime_edges"],
+    }
+    import os
+
+    jpath = default_journal_path()
+    if not os.path.exists(jpath):
+        out["host_journal_overhead_ok"] = "skipped (no journal)"
+        return out
+    import json as _json
+
+    with open(jpath) as fh:
+        jmeta = _json.load(fh).get("meta", {})
+    acquires = int(jmeta.get("acquires", 0))
+    armed_wall = float(jmeta.get("armed_wall_s", 0.0))
+
+    # per-acquire wrapper delta: tight uncontended acquire/release loop on
+    # a bare lock vs an instrumented one (median of 5 reps each)
+    n = 200_000
+
+    def loop(lock):
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            lock.acquire()
+            lock.release()
+        return _time.perf_counter() - t0
+
+    rec = lockmodel.LockOrderRecorder()
+    import threading as _threading
+
+    bare = sorted(loop(_threading.Lock()) for _ in range(5))[2]
+    wrapped = sorted(
+        loop(lockmodel.InstrumentedLock(_threading.Lock(),
+                                        ("bench", 0), rec))
+        for _ in range(5))[2]
+    delta_per_acquire = max((wrapped - bare) / n, 0.0)
+    frac = (acquires * delta_per_acquire / armed_wall
+            if armed_wall > 0 else 0.0)
+    out.update({
+        "host_journal_acquires": acquires,
+        "host_journal_armed_wall_s": armed_wall,
+        "host_journal_per_acquire_delta_us": round(
+            delta_per_acquire * 1e6, 4),
+        "host_journal_wall_delta_frac": round(frac, 6),
+        "host_journal_overhead_ok": bool(frac < 0.02),
+    })
+    return out
+
+
 def _planner_search(on_tpu):
     """Auto-parallel planner v2 secondary (ISSUE 13): search wall time and
     candidate accounting for a real search (every analysis-priced row is a
@@ -1154,6 +1228,11 @@ def main():
         except Exception as e:  # pragma: no cover - device dependent
             secondary["analysis_lint_s"] = f"failed: {type(e).__name__}"
         try:
+            # concurrency doctor: host lint + lock-journal tax (ISSUE 14)
+            secondary.update(_host_analysis())
+        except Exception as e:  # pragma: no cover - device dependent
+            secondary["host_analysis_lint_s"] = f"failed: {type(e).__name__}"
+        try:
             # robustness: replica-kill failover recovery time (ISSUE 6)
             secondary.update(_router_failover(True))
         except Exception as e:  # pragma: no cover - device dependent
@@ -1228,6 +1307,10 @@ def main():
             secondary.update(_analysis_overhead())
         except Exception as e:  # pragma: no cover
             secondary["analysis_lint_s"] = f"failed: {type(e).__name__}"
+        try:
+            secondary.update(_host_analysis())
+        except Exception as e:  # pragma: no cover
+            secondary["host_analysis_lint_s"] = f"failed: {type(e).__name__}"
         try:
             secondary.update(_router_failover(False))
         except Exception as e:  # pragma: no cover

@@ -74,6 +74,30 @@ def main(argv=None) -> int:
                       help="replay each entry point eqn-by-eqn and "
                            "report the first non-finite intermediate "
                            "(writes analysis_sanitize.json)")
+    mode.add_argument("--host", action="store_true",
+                      help="concurrency doctor: lock-discipline & race "
+                           "lint over the threaded host control plane "
+                           "(serving/resilience/fleet/observability) — "
+                           "AST only, no entry-point tracing/lowering "
+                           "(writes analysis_host.json)")
+    from .rules import host_rule_names
+
+    parser.add_argument("--host-only", action="append", default=[],
+                        choices=host_rule_names(), metavar="RULE",
+                        help="--host: run only these host rules "
+                             f"({', '.join(host_rule_names())}); an "
+                             "unknown name is a usage error, not an "
+                             "empty lint")
+    parser.add_argument("--host-path", action="append", default=[],
+                        metavar="FILE_OR_DIR",
+                        help="--host: scan these files/dirs INSTEAD of "
+                             "the default control-plane set (planted-bug "
+                             "twins, out-of-tree modules)")
+    parser.add_argument("--host-journal", default=None, metavar="PATH",
+                        help="--host: runtime lock-order journal to merge "
+                             "into the static graph (default: the "
+                             "committed benchmarks/hostrace_journal.json "
+                             "when present; 'none' disables the merge)")
     mode.add_argument("--plan", action="store_true",
                       help="auto-parallel planner v2: enumerate dp/mp/pp/"
                            "ZeRO/remat candidates, price each on a lowered "
@@ -126,10 +150,18 @@ def main(argv=None) -> int:
                      "modes")
     if (args.plan_pin or args.plan_model) and not args.plan:
         parser.error("--plan-* options apply to --plan")
+    if (args.host_only or args.host_path or args.host_journal) \
+            and not args.host:
+        parser.error("--host-* options apply to --host")
     # NOTE: platform/device-count env setup lives in __main__.py (re-exec
     # before jax initializes); mutating os.environ here would be both too
     # late for this process and a leak into child processes.
 
+    if args.host:
+        # AST over host source only: no entry point is traced or lowered
+        # (the 0.5s lint wall; process startup still pays the package
+        # import — paddle_tpu itself imports jax)
+        return _host_mode(args)
     if args.plan:
         return _plan_mode(args)
 
@@ -179,6 +211,78 @@ def main(argv=None) -> int:
 
     if errors and not args.keep_going:
         return 2
+    if args.fail_on != "never":
+        gate = Severity[args.fail_on.upper()]
+        if report.at_least(gate):
+            return 1
+    return 0
+
+
+def _host_mode(args) -> int:
+    """``--host``: concurrency doctor over the host control plane.
+
+    Exit contract mirrors the jaxpr lint: 0 when no finding reaches
+    ``--fail-on`` (default HIGH), 1 otherwise.  A crashed rule and an
+    unparseable module both surface as MEDIUM findings — a broken check
+    must never silently pass the gate."""
+    from .findings import Severity
+    from .hostrace import analyze_host
+    from .lockmodel import default_host_paths
+    from .rules import default_host_rules
+
+    paths = None
+    if args.host_path:
+        paths = []
+        seen = set()
+
+        def add(name, full):
+            # two files sharing a basename must not shadow each other in
+            # the module dict (a shadowed planted HIGH would silently
+            # pass the gate) — disambiguate with a stable suffix
+            base, n = name, 2
+            while name in seen:
+                name = f"{base}.{n}"
+                n += 1
+            seen.add(name)
+            paths.append((name, full))
+
+        for p in args.host_path:
+            if os.path.isdir(p):
+                for fn in sorted(os.listdir(p)):
+                    if fn.endswith(".py"):
+                        add(os.path.splitext(fn)[0], os.path.join(p, fn))
+            elif os.path.exists(p):
+                add(os.path.splitext(os.path.basename(p))[0], p)
+            else:
+                print(f"--host-path {p}: no such file or directory",
+                      file=sys.stderr)
+                return 2
+    else:
+        paths = default_host_paths()
+
+    rules = (default_host_rules(only=tuple(args.host_only))
+             if args.host_only else None)
+    try:
+        report = analyze_host(paths=paths, journal=args.host_journal,
+                              rules=rules)
+    except (OSError, ValueError) as e:
+        # an explicitly named journal that is missing/corrupt is a usage
+        # error, not an empty merge
+        print(f"--host-journal {args.host_journal}: {e}", file=sys.stderr)
+        return 2
+    out = args.out or _default_out("analysis_host.json")
+    report.save(out)
+    print(f"linted {report.meta['n_modules']} host modules "
+          f"({report.meta['n_locks']} locks, "
+          f"{report.meta['n_static_edges']} static + "
+          f"{report.meta['n_runtime_edges']} runtime order edges) in "
+          f"{report.meta['total_s']}s -> {out}")
+    print(f"lock graph acyclic: {report.meta['lock_graph_acyclic']}")
+    print()
+    print(report.table())
+    counts = report.counts()
+    print()
+    print("findings:", ", ".join(f"{k}={v}" for k, v in counts.items()))
     if args.fail_on != "never":
         gate = Severity[args.fail_on.upper()]
         if report.at_least(gate):

@@ -1,0 +1,457 @@
+"""Concurrency doctor: lock-discipline & race rules over the host plane.
+
+Parity role: the reference framework statically verifies its *device*
+programs (ProgramDesc checks) and stress-tests its threaded C++ runtime in
+CI (``WITH_TESTING`` thread-stress suites); this module gives the jax_graft
+host runtime the static half of that story — lockdep-style lock-order
+validation plus RacerD/Clang-``GUARDED_BY``-style annotation checking over
+the ~6k-line threaded control plane (serving/, resilience/,
+distributed/fleet/, observability/).  Four ranked rules, same
+:class:`~paddle_tpu.analysis.findings.Finding` schema as the jaxpr rules,
+driven by ``python -m paddle_tpu.analysis --host``:
+
+* ``host-guarded-by``      — a ``# guarded-by: self._lock`` annotation on a
+  shared mutable attribute makes every bare access a finding (HIGH for
+  writes); with no annotation, an attribute accessed under one lock in
+  >=80% of its sites is flagged wherever accessed bare (inference,
+  MEDIUM/LOW — heuristics never gate alone).
+* ``host-lock-order``      — static ``with a: ... with b:`` nesting edges
+  (plus one-level call-through footprints) unioned with the runtime
+  instrumented-lock journal; any cycle is a HIGH potential deadlock.
+* ``host-blocking-under-lock`` — socket/HTTP/sleep/thread-join/compile
+  calls while a lock is held (the r11 health-loop stall class).  Locks
+  annotated ``hostrace: blocking-ok`` (tick locks, trace locks, failover
+  serializers) and sites annotated ``hostrace: ok(...)`` report INFO —
+  recognized as intentional, never silently dropped.
+* ``host-toctou``          — a guarded read whose value feeds a branch
+  that re-acquires the same lock before the dependent write: the state
+  may have changed between check and act (the r11 drain / r16
+  admission-gate bug shapes; atomic ``setdefault`` writes are exempt).
+
+The model layer (AST scan, annotations, order graph, runtime recorder)
+lives in :mod:`paddle_tpu.analysis.lockmodel`.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import lockmodel
+from .findings import AnalysisReport, Finding, Severity
+from .lockmodel import HostModel, LockOrderGraph
+from .rules import HostRule, default_host_rules, register_host_rule
+
+__all__ = [
+    "HOST_SCHEMA_VERSION",
+    "HostAnalysisContext",
+    "GuardedByRule",
+    "LockOrderRule",
+    "BlockingUnderLockRule",
+    "ToctouRule",
+    "build_context",
+    "analyze_host",
+    "default_journal_path",
+]
+
+HOST_SCHEMA_VERSION = 1
+
+#: guard-inference thresholds: an attribute needs this many access sites,
+#: at least one write, and one lock covering this fraction of sites
+#: before bare sites are flagged (inference stays MEDIUM — only declared
+#: annotations produce gating HIGHs)
+INFER_MIN_SITES = 5
+INFER_FRACTION = 0.8
+
+
+class HostAnalysisContext:
+    """Everything the host rules consume: the scanned model, the merged
+    lock-order graph, and where the journal came from."""
+
+    def __init__(self, model: HostModel, graph: LockOrderGraph,
+                 journal_edges: Sequence[dict] = (),
+                 journal_path: Optional[str] = None,
+                 journal_error: Optional[str] = None):
+        self.model = model
+        self.graph = graph
+        self.journal_edges = list(journal_edges)
+        self.journal_path = journal_path
+        self.journal_error = journal_error
+
+    def scan_errors(self) -> Dict[str, str]:
+        return {name: m.error for name, m in self.model.modules.items()
+                if m.error}
+
+
+def _src(path: str, line: int, method: str = "") -> str:
+    rel = path
+    for marker in ("paddle_tpu" + os.sep, "tests" + os.sep):
+        idx = path.rfind(marker)
+        if idx >= 0:
+            rel = path[idx:]
+            break
+    loc = f"{rel}:{line}"
+    return f"{loc} ({method})" if method else loc
+
+
+# ---------------------------------------------------------------------------
+@register_host_rule
+class GuardedByRule(HostRule):
+    name = "host-guarded-by"
+
+    def __init__(self, infer_min_sites: int = INFER_MIN_SITES,
+                 infer_fraction: float = INFER_FRACTION):
+        self.infer_min_sites = int(infer_min_sites)
+        self.infer_fraction = float(infer_fraction)
+
+    def run(self, ctx: HostAnalysisContext) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in ctx.model.modules.values():
+            for cls in mod.classes.values():
+                out.extend(self._check_class(mod, cls))
+                out.extend(self._check_requires_callers(ctx, mod, cls))
+        return out
+
+    def _check_requires_callers(self, ctx, mod, cls) -> List[Finding]:
+        """A ``hostrace: requires(L)`` method trusted with a seeded held
+        set must actually be called with L held — verify every recorded
+        call site (same-class and typed cross-class receivers)."""
+        out: List[Finding] = []
+        targets = {mi.name: mi for mi in cls.methods.values()
+                   if mi.requires}
+        if not targets:
+            return out
+        for m2 in ctx.model.modules.values():
+            for c2 in m2.classes.values():
+                for caller in c2.methods.values():
+                    for recv_cls, meth, line, held in caller.calls:
+                        mi = targets.get(meth)
+                        if mi is None or (recv_cls or c2.name) != cls.name:
+                            continue
+                        for lid in mi.requires:
+                            if held & cls.guard_equiv(lid):
+                                continue
+                            # a requires-method calling a sibling
+                            # requires-method inherits the seeded set via
+                            # `held`, so only genuinely bare calls land here
+                            out.append(Finding(
+                                rule=self.name, severity=Severity.HIGH,
+                                entry_point=m2.modname,
+                                message=(
+                                    f"{c2.name}.{caller.name}() calls "
+                                    f"{cls.name}.{meth}() which is declared "
+                                    f"hostrace: requires({_short(lid)}) "
+                                    "(line "
+                                    f"{mi.line}) without holding it — the "
+                                    "helper mutates guarded state assuming "
+                                    "the caller's lock"),
+                                source=_src(m2.path, line, caller.name),
+                                details={"callee": f"{cls.name}.{meth}",
+                                         "requires": lid,
+                                         "held": sorted(held)}))
+        return out
+
+    def _check_class(self, mod, cls) -> List[Finding]:
+        out: List[Finding] = []
+        by_attr: Dict[str, list] = {}
+        for acc in cls.accesses:
+            if acc.method == "__init__":
+                continue  # pre-publication: the object is not shared yet
+            by_attr.setdefault(acc.attr, []).append(acc)
+        # declared guards first
+        for attr, decl in cls.guards.items():
+            if decl.guard_id is None:
+                out.append(Finding(
+                    rule=self.name, severity=Severity.MEDIUM,
+                    entry_point=mod.modname,
+                    message=f"{cls.name}.{attr} declares guarded-by: "
+                            f"{decl.guard_expr} but no such lock exists on "
+                            f"{cls.name} — annotation names an unknown "
+                            "lock (typo, or the lock was removed)",
+                    source=_src(mod.path, decl.line)))
+                continue
+            equiv = cls.guard_equiv(decl.guard_id)
+            for acc in by_attr.get(attr, ()):
+                if acc.held & equiv:
+                    continue
+                if self.name in acc.suppressed:
+                    out.append(self._finding(
+                        mod, cls, attr, acc, decl, Severity.INFO,
+                        suppressed=True))
+                    continue
+                sev = (Severity.HIGH if acc.kind == "write"
+                       else Severity.MEDIUM)
+                out.append(self._finding(mod, cls, attr, acc, decl, sev))
+        # inference for annotation-less attributes
+        for attr, accs in sorted(by_attr.items()):
+            if attr in cls.guards or attr.startswith("__"):
+                continue
+            if len(accs) < self.infer_min_sites:
+                continue
+            if not any(a.kind == "write" for a in accs):
+                continue
+            counts: Dict[str, int] = {}
+            for a in accs:
+                for lid in a.held:
+                    if lid.startswith(f"{mod.modname}.{cls.name}."):
+                        counts[lid] = counts.get(lid, 0) + 1
+            if not counts:
+                continue
+            guard, n = max(counts.items(), key=lambda kv: kv[1])
+            if n / len(accs) < self.infer_fraction:
+                continue
+            equiv = cls.guard_equiv(guard)
+            for a in accs:
+                if a.held & equiv:
+                    continue
+                if self.name in a.suppressed:
+                    continue
+                sev = Severity.MEDIUM if a.kind == "write" else Severity.LOW
+                out.append(Finding(
+                    rule=self.name, severity=sev, entry_point=mod.modname,
+                    message=(
+                        f"{cls.name}.{attr} is accessed under "
+                        f"{_short(guard)} at {n}/{len(accs)} sites but "
+                        f"{a.kind} bare in {a.method}() — either take the "
+                        "lock or declare the real discipline with a "
+                        "`# guarded-by:` annotation"),
+                    source=_src(mod.path, a.line, a.method),
+                    details={"attr": attr, "inferred_guard": guard,
+                             "guarded_sites": n, "total_sites": len(accs),
+                             "kind": a.kind}))
+        return out
+
+    def _finding(self, mod, cls, attr, acc, decl, sev,
+                 suppressed: bool = False) -> Finding:
+        note = (" [suppressed: hostrace ok — intentional, e.g. a "
+                "read-after-publication]" if suppressed else "")
+        return Finding(
+            rule=self.name, severity=sev, entry_point=mod.modname,
+            message=(
+                f"{cls.name}.{attr} is declared guarded-by "
+                f"{decl.guard_expr} (line {decl.line}) but {acc.kind}s "
+                f"WITHOUT it in {acc.method}() — a concurrent holder can "
+                f"observe or destroy the update{note}"),
+            source=_src(mod.path, acc.line, acc.method),
+            details={"attr": attr, "guard": decl.guard_id,
+                     "declared_at": decl.line, "kind": acc.kind,
+                     "held": sorted(acc.held), "suppressed": suppressed})
+
+
+def _short(node_id: str) -> str:
+    return node_id.rsplit(".", 2)[-2] + "." + node_id.rsplit(".", 1)[-1] \
+        if node_id.count(".") >= 2 else node_id
+
+
+# ---------------------------------------------------------------------------
+@register_host_rule
+class LockOrderRule(HostRule):
+    name = "host-lock-order"
+
+    def run(self, ctx: HostAnalysisContext) -> List[Finding]:
+        out: List[Finding] = []
+        cycles = ctx.graph.cycles()
+        for cyc in cycles:
+            hops = []
+            sites = []
+            for a, b in zip(cyc, cyc[1:]):
+                e = ctx.graph.site(a, b)
+                where = (f"{_src(e.file, e.line)} [{e.origin}]"
+                         if e else "?")
+                hops.append(f"{a} -> {b} at {where}")
+                if e:
+                    sites.append({"src": a, "dst": b, "file": e.file,
+                                  "line": e.line, "origin": e.origin})
+            out.append(Finding(
+                rule=self.name, severity=Severity.HIGH,
+                entry_point="lock-graph",
+                message=("lock-order cycle (potential deadlock): two "
+                         "threads entering from different points block "
+                         "forever — " + "; ".join(hops)),
+                source=_src(sites[0]["file"], sites[0]["line"])
+                if sites else "",
+                details={"cycle": cyc, "edges": sites}))
+        return out
+
+
+# ---------------------------------------------------------------------------
+@register_host_rule
+class BlockingUnderLockRule(HostRule):
+    name = "host-blocking-under-lock"
+
+    #: categories that stall every other waiter for an UNBOUNDED time
+    _HIGH = {"net", "sleep", "join", "proc"}
+
+    def run(self, ctx: HostAnalysisContext) -> List[Finding]:
+        out: List[Finding] = []
+        locks = ctx.model.locks()
+        for mod in ctx.model.modules.values():
+            for cls in mod.classes.values():
+                for bc in cls.blocking:
+                    if not bc.held:
+                        continue
+                    f = self._one(mod, cls, bc, locks)
+                    if f is not None:
+                        out.append(f)
+        return out
+
+    def _one(self, mod, cls, bc, locks) -> Optional[Finding]:
+        strict = [lid for lid in sorted(bc.held)
+                  if not (locks.get(lid) and locks[lid].blocking_ok)]
+        allowed = not strict
+        suppressed = self.name in bc.suppressed
+        if allowed or suppressed:
+            why = ("every held lock is annotated hostrace: blocking-ok "
+                   "(an intentional serialization lock)" if allowed
+                   else "site annotated hostrace: ok")
+            sev, note = Severity.INFO, f" [intentional: {why}]"
+        elif bc.category in self._HIGH:
+            sev, note = Severity.HIGH, ""
+        else:
+            sev, note = Severity.MEDIUM, ""
+        kind = {"net": "a network round-trip", "sleep": "a sleep",
+                "join": "a thread join/wait", "proc": "a subprocess",
+                "compile": "a trace/compile"}.get(bc.category, bc.category)
+        held_txt = ", ".join(sorted(bc.held))
+        return Finding(
+            rule=self.name, severity=sev, entry_point=mod.modname,
+            message=(
+                f"{cls.name}.{bc.method}() performs {kind} "
+                f"({bc.what}) while holding {held_txt} — every thread "
+                "queued on the lock stalls for the full call "
+                f"(the r11 health-loop class){note}"),
+            source=_src(mod.path, bc.line, bc.method),
+            details={"call": bc.what, "category": bc.category,
+                     "held": sorted(bc.held),
+                     "intentional": allowed or suppressed})
+
+
+# ---------------------------------------------------------------------------
+@register_host_rule
+class ToctouRule(HostRule):
+    name = "host-toctou"
+
+    def run(self, ctx: HostAnalysisContext) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in ctx.model.modules.values():
+            for cls in mod.classes.values():
+                for t in cls.toctou:
+                    suppressed = self.name in t.suppressed
+                    sev = Severity.INFO if suppressed else Severity.HIGH
+                    note = (" [suppressed: hostrace ok — revalidated "
+                            "under the lock]" if suppressed else "")
+                    out.append(Finding(
+                        rule=self.name, severity=sev,
+                        entry_point=mod.modname,
+                        message=(
+                            f"check-then-act on {cls.name}.{t.attr}: read "
+                            f"under {_short(t.lock)} (line {t.read_line}), "
+                            f"lock released, branch at line {t.test_line} "
+                            "decides on the STALE value, then re-acquires "
+                            "the lock for the dependent write (line "
+                            f"{t.write_line}) — the state may have changed "
+                            "in the window; hold the lock across "
+                            "check+act, or re-validate before the "
+                            f"write{note}"),
+                        source=_src(mod.path, t.test_line, t.method),
+                        details={"attr": t.attr, "lock": t.lock,
+                                 "read_line": t.read_line,
+                                 "test_line": t.test_line,
+                                 "write_line": t.write_line,
+                                 "suppressed": suppressed}))
+        return out
+
+
+# ---------------------------------------------------------------------------
+def default_journal_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "benchmarks", "hostrace_journal.json")
+
+
+def build_context(paths: Optional[Sequence[Tuple[str, str]]] = None,
+                  journal: Optional[str] = None) -> HostAnalysisContext:
+    """Scan the host modules and merge the runtime journal (explicit path,
+    else the committed default when present)."""
+    paths = list(paths) if paths else lockmodel.default_host_paths()
+    model = lockmodel.scan_modules(paths)
+    jpath = journal
+    implicit = False
+    if jpath in ("", "none"):
+        jpath = None  # explicit off: skip even the committed default
+    elif jpath is None and os.path.exists(default_journal_path()):
+        jpath = default_journal_path()
+        implicit = True
+    edges: List[dict] = []
+    journal_error = None
+    if jpath:
+        try:
+            edges = lockmodel.load_journal(jpath)
+        except (OSError, ValueError) as e:
+            if not implicit:
+                raise  # an explicitly named journal must not half-work
+            # the COMMITTED default is stale/corrupt: degrade to a
+            # static-only scan and surface it as a finding — nothing
+            # about the user's invocation is wrong
+            journal_error = f"{type(e).__name__}: {e}"
+            jpath = None
+    graph = lockmodel.build_order_graph(model, edges)
+    return HostAnalysisContext(model, graph, edges, jpath, journal_error)
+
+
+def analyze_host(paths: Optional[Sequence[Tuple[str, str]]] = None,
+                 journal: Optional[str] = None,
+                 rules: Optional[Sequence[HostRule]] = None,
+                 meta: Optional[dict] = None) -> AnalysisReport:
+    """Run the host rules over the control plane -> AnalysisReport.
+
+    Crashed rules report MEDIUM (never silently pass the gate); modules
+    that fail to parse do the same and are listed in ``meta``.
+    """
+    t0 = time.perf_counter()
+    ctx = build_context(paths, journal)
+    report = AnalysisReport(meta=dict(meta or {}))
+    if ctx.journal_error:
+        msg = (f"committed lock-order journal failed to load "
+               f"({ctx.journal_error}) — the cycle check ran on static "
+               "edges only; regenerate with HOSTRACE_JOURNAL_OUT over "
+               "the armed suites")
+        report.extend([Finding(rule="host-journal",
+                               severity=Severity.MEDIUM,
+                               entry_point="lock-graph", message=msg)])
+    errors = ctx.scan_errors()
+    for name, err in errors.items():
+        report.extend([Finding(
+            rule="host-scan", severity=Severity.MEDIUM, entry_point=name,
+            message=f"module failed to parse ({err}) — its locks and "
+                    "accesses are INVISIBLE to every host rule")])
+    timings = {}
+    for rule in (rules if rules is not None else default_host_rules()):
+        r0 = time.perf_counter()
+        try:
+            report.extend(rule.run(ctx))
+        except Exception as e:
+            report.extend([Finding(
+                rule=rule.name, severity=Severity.MEDIUM,
+                message=f"rule crashed: {type(e).__name__}: {e}")])
+        timings[rule.name] = round(time.perf_counter() - r0, 4)
+    modules = sorted(ctx.model.modules)
+    n_locks = len(ctx.model.locks())
+    report.meta.update({
+        "mode": "host",
+        "host_schema_version": HOST_SCHEMA_VERSION,
+        "modules": modules,
+        "n_modules": len(modules),
+        "n_classes": len(ctx.model.classes),
+        "n_locks": n_locks,
+        "n_static_edges": sum(1 for e in ctx.graph.edges
+                              if e.origin != "runtime"),
+        "n_runtime_edges": sum(1 for e in ctx.graph.edges
+                               if e.origin == "runtime"),
+        "journal": ctx.journal_path,
+        "lock_graph_acyclic": not ctx.graph.cycles(),
+        "scan_errors": errors,
+        "rule_timings_s": timings,
+        "total_s": round(time.perf_counter() - t0, 3),
+    })
+    return report

@@ -1,0 +1,1509 @@
+"""Static + runtime lock model of the threaded host control plane.
+
+The r9-r17 analysis plane lints what reaches the TPU (jaxprs, HLO); the
+bugs that escaped to review in r11-r16 lived one layer up, in the ~6k-line
+threaded HOST runtime (serving/, resilience/, distributed/fleet/,
+observability/): the drain TOCTOU, the double-resubmit failover race, the
+admission-gate over-admit window, the health-loop stall from a blocking
+probe under a shared loop.  This module is the model layer those checks
+run on — the host analog of :mod:`paddle_tpu.analysis.graph`:
+
+* **Static half** — an AST scan of each control-plane module extracting
+  (a) every lock object (``threading.Lock/RLock/Condition`` attributes,
+  aliased locals, Conditions wrapping an explicit lock), (b) the
+  ``# guarded-by: self._lock`` annotation convention on shared mutable
+  attributes, (c) a per-method def-use walk that tracks the held-lock set
+  through ``with`` blocks, manual ``acquire``/``finally: release`` pairs
+  and lock-local aliases, recording every ``self.<attr>`` access, every
+  potentially-blocking call and every lock-acquired-while-holding edge,
+  and (d) a one-level interprocedural pass: each known method's *lock
+  footprint* (everything it may acquire, transitively) turns
+  ``with self._lock: self.scheduler.take()`` into the static order edge
+  ``Engine._lock -> FCFSScheduler._cond``.
+* **Runtime half** — an opt-in instrumented-lock recorder (lockdep-style):
+  while armed, ``threading.Lock``/``RLock`` constructions inside this
+  repo return a recording wrapper that notes *held -> acquired* pairs per
+  thread.  The conftest fixture arms it for the serving/router/store
+  suites and dumps a journal; :func:`merge_journal` folds those observed
+  edges into the static graph (creation ``file:line`` -> static lock name)
+  so the cycle check sees orders the AST cannot (callbacks, cross-object
+  calls through untyped receivers).
+
+Annotation conventions (all plain comments, parsed from source text):
+
+* ``self.attr = ...  # guarded-by: self._lock`` — declares the guard of a
+  shared mutable attribute (same line or the line directly above).
+* ``self._lock = threading.Lock()  # hostrace: blocking-ok <why>`` —
+  declares a *serialization* lock that intentionally holds across
+  blocking work (tick locks, trace locks, failover serializers); blocking
+  calls under ONLY such locks report INFO instead of HIGH.
+* ``<offending line>  # hostrace: ok(<rule>[, <rule>]) <why>`` —
+  suppresses a specific rule at a specific site (the r15 trace-lock-held
+  pricing pattern); suppressed findings surface as INFO, never silently.
+
+The four rules that consume this model live in
+:mod:`paddle_tpu.analysis.hostrace`.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+import threading
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "LockInfo",
+    "GuardDecl",
+    "Access",
+    "BlockingCall",
+    "ToctouSite",
+    "OrderEdge",
+    "MethodInfo",
+    "ClassModel",
+    "ModuleModel",
+    "HostModel",
+    "scan_module",
+    "scan_modules",
+    "default_host_paths",
+    "LockOrderGraph",
+    "LockOrderRecorder",
+    "InstrumentedLock",
+    "arm",
+    "disarm",
+    "armed",
+    "write_journal",
+    "load_journal",
+    "JOURNAL_SCHEMA_VERSION",
+]
+
+JOURNAL_SCHEMA_VERSION = 1
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+#: attribute names treated as locks even when assigned through a helper
+#: (e.g. ``self._trace_lock = _model_trace_lock(model)``)
+_LOCKISH_NAME = re.compile(r"(^|_)(lock|cond|rlock|mutex)$")
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+_BLOCKING_OK_RE = re.compile(r"#\s*hostrace:\s*blocking-ok")
+_SUPPRESS_RE = re.compile(r"#\s*hostrace:\s*ok\(([\w,\s-]+)\)")
+_REQUIRES_RE = re.compile(r"#\s*hostrace:\s*requires\(([A-Za-z_][\w.]*)\)")
+
+#: method names that mutate their receiver (a call on a guarded container
+#: attribute counts as a WRITE to it)
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse", "rotate", "inc", "dec",
+}
+#: check-and-set receivers: atomic by construction, never the "act" half
+#: of a check-then-act finding
+_ATOMIC_MUTATORS = {"setdefault"}
+
+# -- blocking-call classification -------------------------------------------
+#: dotted-call names that block on the host (network / clock / process)
+_BLOCKING_CALLS = {
+    "time.sleep": "sleep",
+    "sleep": "sleep",
+    "socket.create_connection": "net",
+    "urllib.request.urlopen": "net",
+    "urlopen": "net",
+    "subprocess.run": "proc",
+    "subprocess.check_output": "proc",
+    "os.system": "proc",
+}
+#: method names that block when called on a socket/HTTP-ish receiver
+_BLOCKING_METHODS = {
+    "connect": "net", "accept": "net", "recv": "net", "recv_into": "net",
+    "sendall": "net", "getresponse": "net", "makefile": "net",
+}
+#: any call on a receiver whose name contains one of these is treated as a
+#: network round-trip (``rep.probe_client.metrics()``, ``self.store.get()``)
+_NET_RECEIVER_HINTS = ("client", "session", "sock", "conn")
+#: compile/trace-shaped stalls: bounded but long (the r15 pricing class)
+_COMPILE_METHODS = {"jaxpr", "lower", "compile", "stablehlo", "trace"}
+_COMPILE_SUFFIX = "_jit"
+#: receiver-name hints for ``.join()`` / ``.wait()`` being thread-ish
+_THREADISH = ("thread", "proc", "worker", "loop", "server", "stop", "event",
+              "done", "ready")
+
+
+# ---------------------------------------------------------------------------
+# dataclasses
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class LockInfo:
+    """One lock-valued attribute (or module global)."""
+
+    node_id: str              # "serving.scheduler.FCFSScheduler._cond"
+    attr: str                 # "_cond"
+    kind: str                 # "lock" | "rlock" | "condition" | "opaque"
+    line: int                 # assignment line (runtime creation site)
+    blocking_ok: bool = False
+    wraps: Optional[str] = None   # condition wrapping an explicit lock
+
+
+@dataclasses.dataclass
+class GuardDecl:
+    attr: str
+    guard_expr: str           # raw annotation text, e.g. "self._lock"
+    guard_id: Optional[str]   # resolved node_id (None = unresolvable)
+    line: int
+
+
+@dataclasses.dataclass
+class Access:
+    attr: str
+    kind: str                 # "read" | "write"
+    method: str
+    line: int
+    held: FrozenSet[str]
+    suppressed: FrozenSet[str] = frozenset()
+
+
+@dataclasses.dataclass
+class BlockingCall:
+    what: str                 # dotted call text
+    category: str             # "net" | "sleep" | "join" | "proc" | "compile"
+    method: str
+    line: int
+    held: FrozenSet[str]
+    suppressed: FrozenSet[str] = frozenset()
+
+
+@dataclasses.dataclass
+class ToctouSite:
+    attr: str
+    lock: str
+    read_line: int
+    test_line: int
+    write_line: int
+    method: str
+    suppressed: FrozenSet[str] = frozenset()
+
+
+@dataclasses.dataclass(frozen=True)
+class OrderEdge:
+    src: str
+    dst: str
+    file: str
+    line: int
+    origin: str               # "static" | "static-call" | "runtime"
+
+
+@dataclasses.dataclass
+class MethodInfo:
+    name: str
+    acquires: Set[str] = dataclasses.field(default_factory=set)
+    calls: List[Tuple[Optional[str], str, int, FrozenSet[str]]] = \
+        dataclasses.field(default_factory=list)  # (recv_cls, meth, line, held)
+    requires: FrozenSet[str] = frozenset()  # declared held-on-entry locks
+    line: int = 0
+
+
+class ClassModel:
+    def __init__(self, name: str, modname: str):
+        self.name = name
+        self.modname = modname
+        self.bases: List[str] = []
+        self.locks: Dict[str, LockInfo] = {}
+        self.guards: Dict[str, GuardDecl] = {}
+        self.accesses: List[Access] = []
+        self.blocking: List[BlockingCall] = []
+        self.toctou: List[ToctouSite] = []
+        self.methods: Dict[str, MethodInfo] = {}
+        self.attr_types: Dict[str, str] = {}
+
+    def lock_id(self, attr: str, _seen=None) -> Optional[str]:
+        """Resolve a lock attr on this class or (transitively) a base —
+        ``Counter._values`` is guarded by ``_Metric._lock``."""
+        info = self.locks.get(attr)
+        if info:
+            return info.node_id
+        _seen = _seen or {self.name}
+        for b in self.bases:
+            bc = _KNOWN_CLASSES.get(b)
+            if bc is not None and bc.name not in _seen:
+                _seen.add(bc.name)
+                lid = bc.lock_id(attr, _seen)
+                if lid:
+                    return lid
+        return None
+
+    def guard_equiv(self, guard_id: str) -> FrozenSet[str]:
+        """A guard and every lock equivalent to holding it: a Condition
+        wrapping lock L guards the same state as L itself."""
+        out = {guard_id}
+        for info in self.locks.values():
+            if info.wraps == guard_id:
+                out.add(info.node_id)
+            if info.node_id == guard_id and info.wraps:
+                out.add(info.wraps)
+        return frozenset(out)
+
+
+class ModuleModel:
+    def __init__(self, modname: str, path: str):
+        self.modname = modname
+        self.path = path
+        self.classes: Dict[str, ClassModel] = {}
+        self.module_locks: Dict[str, LockInfo] = {}
+        #: (realpath, line) AND (repo-relative path, line) -> node_id for
+        #: the runtime journal merge (journals persist relative paths so
+        #: they survive checkout moves)
+        self.creation_sites: Dict[Tuple[str, int], str] = {}
+        self.order_edges: List[OrderEdge] = []
+        self.error: Optional[str] = None
+
+    def add_creation_site(self, real: str, line: int, node_id: str):
+        self.creation_sites[(real, line)] = node_id
+        self.creation_sites[(_rel_site(real), line)] = node_id
+
+    def all_locks(self) -> Dict[str, LockInfo]:
+        out = dict(self.module_locks)
+        for c in self.classes.values():
+            for info in c.locks.values():
+                out[info.node_id] = info
+        return out
+
+
+class HostModel:
+    """Every scanned module + the whole-program views the rules consume."""
+
+    def __init__(self, modules: Dict[str, ModuleModel]):
+        self.modules = modules
+        self.classes: Dict[str, ClassModel] = {}
+        for m in modules.values():
+            for c in m.classes.values():
+                # first definition wins on (rare) cross-module name clashes
+                self.classes.setdefault(c.name, c)
+        self._footprints: Optional[Dict[Tuple[str, str], Set[str]]] = None
+
+    def locks(self) -> Dict[str, LockInfo]:
+        out: Dict[str, LockInfo] = {}
+        for m in self.modules.values():
+            out.update(m.all_locks())
+        return out
+
+    def lock_for_site(self, path: str, line: int) -> Optional[str]:
+        """Resolve a journal creation site to its static lock name. Sites
+        are matched by repo-RELATIVE path (``paddle_tpu/...``) so a
+        journal recorded on one checkout resolves on another; absolute
+        paths from same-machine journals still match via their realpath
+        key."""
+        keys = ((os.path.realpath(path), int(line)),
+                (_rel_site(path), int(line)))
+        for m in self.modules.values():
+            for key in keys:
+                node = m.creation_sites.get(key)
+                if node:
+                    return node
+        return None
+
+    # -- interprocedural lock footprints --------------------------------
+    def footprints(self) -> Dict[Tuple[str, str], Set[str]]:
+        """(class, method) -> every lock the method may acquire, including
+        through calls to other known methods (fixpoint, bounded)."""
+        if self._footprints is not None:
+            return self._footprints
+        fp: Dict[Tuple[str, str], Set[str]] = {}
+        for c in self.classes.values():
+            for mi in c.methods.values():
+                fp[(c.name, mi.name)] = set(mi.acquires)
+        for _ in range(12):
+            changed = False
+            for c in self.classes.values():
+                for mi in c.methods.values():
+                    cur = fp[(c.name, mi.name)]
+                    for recv_cls, meth, _line, _held in mi.calls:
+                        callee = fp.get((recv_cls or c.name, meth))
+                        if callee and not callee <= cur:
+                            cur |= callee
+                            changed = True
+            if not changed:
+                break
+        self._footprints = fp
+        return fp
+
+    def static_edges(self) -> List[OrderEdge]:
+        """Direct ``with a: with b`` nesting edges plus call-through edges
+        (held locks x callee footprint)."""
+        edges: List[OrderEdge] = []
+        seen: Set[Tuple[str, str, int]] = set()
+        for m in self.modules.values():
+            for e in m.order_edges:
+                key = (e.src, e.dst, e.line)
+                if key not in seen:
+                    seen.add(key)
+                    edges.append(e)
+        fp = self.footprints()
+        for m in self.modules.values():
+            for c in m.classes.values():
+                for mi in c.methods.values():
+                    for recv_cls, meth, line, held in mi.calls:
+                        if not held:
+                            continue
+                        callee = fp.get((recv_cls or c.name, meth))
+                        if not callee:
+                            continue
+                        for src in held:
+                            if src.startswith("?."):
+                                continue
+                            for dst in callee:
+                                if src == dst or dst.startswith("?."):
+                                    continue
+                                key = (src, dst, line)
+                                if key in seen:
+                                    continue
+                                seen.add(key)
+                                edges.append(OrderEdge(
+                                    src=src, dst=dst, file=m.path,
+                                    line=line, origin="static-call"))
+        return edges
+
+
+# ---------------------------------------------------------------------------
+# source-comment annotations
+# ---------------------------------------------------------------------------
+class _Annotations:
+    def __init__(self, source: str):
+        self.guarded: Dict[int, str] = {}
+        self.blocking_ok: Set[int] = set()
+        self.suppress: Dict[int, FrozenSet[str]] = {}
+        self.requires: Dict[int, str] = {}
+        #: lines that are comment-ONLY: a trailing annotation binds to its
+        #: own statement, never to the statement on the next line
+        self.comment_only: Set[int] = set()
+        for i, text in enumerate(source.splitlines(), start=1):
+            if text.lstrip().startswith("#"):
+                self.comment_only.add(i)
+            m = _GUARDED_BY_RE.search(text)
+            if m:
+                self.guarded[i] = m.group(1)
+            if _BLOCKING_OK_RE.search(text):
+                self.blocking_ok.add(i)
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                rules = frozenset(
+                    r.strip() for r in m.group(1).split(",") if r.strip())
+                self.suppress[i] = rules
+            m = _REQUIRES_RE.search(text)
+            if m:
+                self.requires[i] = m.group(1)
+
+    def _above(self, line: int) -> Optional[int]:
+        return line - 1 if (line - 1) in self.comment_only else None
+
+    def guard_at(self, line: int) -> Optional[str]:
+        """Annotation on the statement line itself, or a comment-only
+        line directly above (a trailing comment never leaks downward)."""
+        return self.guarded.get(line) or \
+            self.guarded.get(self._above(line) or -1)
+
+    def blocking_ok_at(self, line: int) -> bool:
+        return line in self.blocking_ok or \
+            (self._above(line) or -1) in self.blocking_ok
+
+    def suppressed_at(self, line: int) -> FrozenSet[str]:
+        return self.suppress.get(line, frozenset()) | \
+            self.suppress.get(self._above(line) or -1, frozenset())
+
+    def requires_at(self, line: int) -> Optional[str]:
+        """``# hostrace: requires(self._lock)`` on the ``def`` line (or
+        the comment line above): the method is documented as
+        called-with-lock-held — the walker seeds its held set and the
+        guarded-by rule verifies every recorded CALLER actually holds
+        it."""
+        return self.requires.get(line) or \
+            self.requires.get(self._above(line) or -1)
+
+
+# ---------------------------------------------------------------------------
+# AST helpers
+# ---------------------------------------------------------------------------
+def _dotted(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _ctor_kind(call: ast.AST) -> Optional[str]:
+    """'lock'/'rlock'/'condition' when ``call`` constructs a lock."""
+    if not isinstance(call, ast.Call):
+        return None
+    name = _dotted(call.func) or ""
+    tail = name.rsplit(".", 1)[-1]
+    if tail in _LOCK_CTORS:
+        return {"Lock": "lock", "RLock": "rlock",
+                "Condition": "condition"}[tail]
+    if tail == "InstrumentedLock":
+        return "lock"
+    return None
+
+
+def _unwrap_annotation(node: Optional[ast.AST]) -> Optional[str]:
+    """Optional[Foo] / "Foo" / Foo -> "Foo" (best effort)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        node_txt = node.value
+        return node_txt.split("[")[-1].rstrip("]").split(".")[-1] or None
+    if isinstance(node, ast.Subscript):
+        return _unwrap_annotation(node.slice)
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        d = _dotted(node)
+        return d.rsplit(".", 1)[-1] if d else None
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the per-function walker
+# ---------------------------------------------------------------------------
+class _FuncWalker:
+    """Tracks the held-lock set through one method, recording accesses,
+    blocking calls, static nesting edges and callee sites."""
+
+    def __init__(self, module: ModuleModel, cls: Optional[ClassModel],
+                 func: ast.AST, ann: _Annotations,
+                 param_types: Dict[str, str]):
+        self.module = module
+        self.cls = cls
+        self.func = func
+        self.ann = ann
+        self.method = func.name
+        self.param_types = param_types
+        self.info = MethodInfo(name=func.name)
+        # flow-insensitive local alias map: name -> lock node_id
+        self.lock_aliases: Dict[str, str] = {}
+        # name -> attr of self it aliases (for receiver typing)
+        self.attr_aliases: Dict[str, str] = {}
+        self._prescan_aliases()
+
+    # -- alias prescan ---------------------------------------------------
+    def _prescan_aliases(self):
+        for node in ast.walk(self.func):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            name = node.targets[0].id
+            attr = _self_attr(node.value)
+            if attr is None:
+                continue
+            self.attr_aliases.setdefault(name, attr)
+            lid = self._attr_lock_id(attr)
+            if lid:
+                self.lock_aliases.setdefault(name, lid)
+
+    def _attr_lock_id(self, attr: str) -> Optional[str]:
+        if self.cls is not None:
+            lid = self.cls.lock_id(attr)
+            if lid:
+                return lid
+        return None
+
+    # -- lock-expression resolution --------------------------------------
+    def resolve_lock(self, node: ast.AST) -> Optional[str]:
+        """``self._lock`` / module lock / aliased local / (best-effort)
+        typed foreign attr -> node_id; None when not a lock."""
+        attr = _self_attr(node)
+        if attr is not None:
+            return self._attr_lock_id(attr)
+        if isinstance(node, ast.Name):
+            if node.id in self.lock_aliases:
+                return self.lock_aliases[node.id]
+            info = self.module.module_locks.get(node.id)
+            return info.node_id if info else None
+        if isinstance(node, ast.Attribute):
+            # foreign lock: <recv>.<lockish-attr> — resolve through the
+            # receiver's inferred type when known, else an opaque held-id
+            # that participates in guard/blocking checks but NOT the order
+            # graph (a wildcard "?._lock" node would unify unrelated locks)
+            recv_cls = self._receiver_class(node.value)
+            if recv_cls is not None:
+                lid = recv_cls.lock_id(node.attr)
+                if lid:
+                    return lid
+            if _LOCKISH_NAME.search(node.attr):
+                return f"?.{node.attr}"
+        return None
+
+    def _receiver_class(self, node: ast.AST) -> Optional[ClassModel]:
+        """Type a receiver expression: self, self.<attr>, annotated param,
+        or a local aliasing one of those."""
+        classes = _KNOWN_CLASSES
+        if isinstance(node, ast.Name):
+            if node.id == "self":
+                return self.cls
+            if node.id in self.attr_aliases and self.cls is not None:
+                tname = self.cls.attr_types.get(self.attr_aliases[node.id])
+                return classes.get(tname) if tname else None
+            tname = self.param_types.get(node.id)
+            return classes.get(tname) if tname else None
+        attr = _self_attr(node)
+        if attr is not None and self.cls is not None:
+            tname = self.cls.attr_types.get(attr)
+            return classes.get(tname) if tname else None
+        return None
+
+    # -- main walk --------------------------------------------------------
+    def run(self):
+        held0: FrozenSet[str] = frozenset()
+        req = self.ann.requires_at(self.func.lineno)
+        if req is not None:
+            try:
+                lid = self.resolve_lock(ast.parse(req, mode="eval").body)
+            except SyntaxError:
+                lid = None
+            if lid:
+                self.info.requires = frozenset({lid})
+                held0 = self._expand(lid)
+        self.info.line = self.func.lineno
+        held = self.walk_block(self.func.body, held0)
+        self._toctou_scan(self.func.body, [], held0)
+        return held
+
+    def walk_block(self, stmts: Sequence[ast.stmt],
+                   held: FrozenSet[str]) -> FrozenSet[str]:
+        for st in stmts:
+            held = self.walk_stmt(st, held)
+        return held
+
+    def _with_locks(self, node: ast.With, record: bool = True) -> List[str]:
+        out = []
+        for item in node.items:
+            lid = self.resolve_lock(item.context_expr)
+            if lid:
+                out.append(lid)
+            elif record:
+                self.scan_expr(item.context_expr, frozenset(), node.lineno)
+        return out
+
+    def _expand(self, lid: str) -> FrozenSet[str]:
+        """Holding a Condition holds its wrapped lock too."""
+        out = {lid}
+        info = _lock_info(self.module, self.cls, lid)
+        if info is not None and info.wraps:
+            out.add(info.wraps)
+        return frozenset(out)
+
+    def walk_stmt(self, st: ast.stmt, held: FrozenSet[str]) -> FrozenSet[str]:
+        if isinstance(st, ast.With):
+            locks = self._with_locks(st)
+            new = held
+            for lid in locks:
+                self._record_acquire(lid, new, st.lineno)
+                new = new | self._expand(lid)
+            self.walk_block(st.body, new)
+            return held
+        if isinstance(st, ast.Try):
+            after_body = self.walk_block(st.body, held)
+            for h in st.handlers:
+                self.walk_block(h.body, held)
+            after_body = self.walk_block(st.orelse, after_body)
+            return self.walk_block(st.finalbody, after_body)
+        if isinstance(st, (ast.If,)):
+            self.scan_expr(st.test, held, st.lineno)
+            self.walk_block(st.body, held)
+            self.walk_block(st.orelse, held)
+            return held
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self.scan_expr(st.iter, held, st.lineno)
+            self._record_store(st.target, held, st.lineno)
+            self.walk_block(st.body, held)
+            self.walk_block(st.orelse, held)
+            return held
+        if isinstance(st, ast.While):
+            self.scan_expr(st.test, held, st.lineno)
+            self.walk_block(st.body, held)
+            self.walk_block(st.orelse, held)
+            return held
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs (closures, worker bodies): walked with an EMPTY
+            # held set — they run later, on some other thread's schedule
+            sub = _FuncWalker(self.module, self.cls, st, self.ann,
+                              dict(self.param_types))
+            sub.method = f"{self.method}.{st.name}"
+            sub.info = self.info   # accumulate acquires/calls into parent
+            sub.walk_block(st.body, frozenset())
+            sub._toctou_scan(st.body, [], frozenset())
+            return held
+        if isinstance(st, ast.Expr):
+            held = self._maybe_acquire_release(st.value, held)
+            self.scan_expr(st.value, held, st.lineno)
+            return held
+        if isinstance(st, ast.Assign):
+            self.scan_expr(st.value, held, st.lineno)
+            for t in st.targets:
+                self._record_store(t, held, st.lineno)
+            return held
+        if isinstance(st, ast.AugAssign):
+            self.scan_expr(st.value, held, st.lineno)
+            # aug-assign reads AND writes its target
+            self._record_load(st.target, held, st.lineno)
+            self._record_store(st.target, held, st.lineno)
+            return held
+        if isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self.scan_expr(st.value, held, st.lineno)
+                self._record_store(st.target, held, st.lineno)
+            return held
+        if isinstance(st, (ast.Return, ast.Raise)):
+            v = st.value if isinstance(st, ast.Return) else st.exc
+            if v is not None:
+                self.scan_expr(v, held, st.lineno)
+            return held
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._record_store(t, held, st.lineno)
+            return held
+        if isinstance(st, ast.Assert):
+            self.scan_expr(st.test, held, st.lineno)
+            return held
+        return held
+
+    # -- acquire / release -------------------------------------------------
+    def _maybe_acquire_release(self, node: ast.AST,
+                               held: FrozenSet[str]) -> FrozenSet[str]:
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("acquire", "release")):
+            return held
+        lid = self.resolve_lock(node.func.value)
+        if lid is None:
+            return held
+        if node.func.attr == "acquire":
+            self._record_acquire(lid, held, node.lineno)
+            return held | self._expand(lid)
+        return held - self._expand(lid)
+
+    def _record_acquire(self, lid: str, held: FrozenSet[str], line: int):
+        self.info.acquires.add(lid)
+        if lid.startswith("?."):
+            return  # opaque locks stay out of the order graph
+        for src in held:
+            if src == lid or src.startswith("?."):
+                continue
+            self.module.order_edges.append(OrderEdge(
+                src=src, dst=lid, file=self.module.path, line=line,
+                origin="static"))
+
+    # -- accesses ----------------------------------------------------------
+    def _record(self, attr: str, kind: str, held: FrozenSet[str], line: int):
+        if self.cls is None:
+            return
+        if attr in self.cls.locks:
+            return
+        self.cls.accesses.append(Access(
+            attr=attr, kind=kind, method=self.method, line=line, held=held,
+            suppressed=self.ann.suppressed_at(line)))
+
+    def _record_store(self, target: ast.AST, held: FrozenSet[str], line: int):
+        attr = _self_attr(target)
+        if attr is not None:
+            self._record(attr, "write", held, line)
+            return
+        if isinstance(target, ast.Subscript):
+            inner = _self_attr(target.value)
+            if inner is not None:
+                self._record(inner, "write", held, line)
+                return
+            self.scan_expr(target.value, held, line)
+            self.scan_expr(target.slice, held, line)
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._record_store(el, held, line)
+            return
+        if isinstance(target, ast.Starred):
+            self._record_store(target.value, held, line)
+            return
+        if isinstance(target, ast.Attribute):
+            self.scan_expr(target.value, held, line)
+
+    def _record_load(self, node: ast.AST, held: FrozenSet[str], line: int):
+        attr = _self_attr(node)
+        if attr is not None:
+            self._record(attr, "read", held, line)
+        elif isinstance(node, ast.Subscript):
+            inner = _self_attr(node.value)
+            if inner is not None:
+                self._record(inner, "read", held, line)
+
+    def scan_expr(self, node: ast.AST, held: FrozenSet[str], line: int):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute):
+                attr = _self_attr(sub)
+                if attr is not None and isinstance(sub.ctx, ast.Load):
+                    self._record(attr, "read", held, sub.lineno)
+            elif isinstance(sub, ast.Call):
+                self._scan_call(sub, held)
+
+    def _scan_call(self, call: ast.Call, held: FrozenSet[str]):
+        line = call.lineno
+        func = call.func
+        # mutating method call on a guarded container: a WRITE
+        if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+            recv_attr = _self_attr(func.value)
+            if recv_attr is not None:
+                self._record(recv_attr, "write", held, line)
+        # callee recording for the interprocedural footprint pass
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                self.info.calls.append(
+                    (self.cls.name if self.cls else None, func.attr,
+                     line, held))
+            else:
+                recv_cls = self._receiver_class(func.value)
+                if recv_cls is not None:
+                    self.info.calls.append(
+                        (recv_cls.name, func.attr, line, held))
+        # blocking classification
+        cat = self._blocking_category(call)
+        if cat is not None and self.cls is not None:
+            self.cls.blocking.append(BlockingCall(
+                what=_dotted(func) or ast.unparse(func),
+                category=cat, method=self.method, line=line, held=held,
+                suppressed=self.ann.suppressed_at(line)))
+
+    def _blocking_category(self, call: ast.Call) -> Optional[str]:
+        name = _dotted(call.func)
+        if name:
+            tail = name.split(".", 1)[-1] if "." in name else name
+            if name in _BLOCKING_CALLS:
+                return _BLOCKING_CALLS[name]
+            if tail in _BLOCKING_CALLS:
+                return _BLOCKING_CALLS[tail]
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        meth = call.func.attr
+        recv = call.func.value
+        recv_txt = (_dotted(recv) or "").lower()
+        if isinstance(recv, ast.Constant):
+            return None  # ", ".join(...)
+        # lock/condition methods are never "blocking" here (wait releases)
+        if self.resolve_lock(recv) is not None:
+            return None
+        if meth in _BLOCKING_METHODS:
+            return _BLOCKING_METHODS[meth]
+        if meth in ("join", "wait"):
+            if any(h in recv_txt for h in _THREADISH):
+                return "join"
+            return None
+        if meth in _COMPILE_METHODS or meth.endswith(_COMPILE_SUFFIX):
+            return "compile"
+        if meth in _MUTATORS or meth in ("get", "items", "keys", "values",
+                                         "copy", "count", "index"):
+            # container ops on client-ish NAMES (self._conns.add) are
+            # memory ops, not I/O
+            return None
+        if any(h in recv_txt for h in _NET_RECEIVER_HINTS):
+            return "net"
+        return None
+
+    # -- check-then-act (TOCTOU) ------------------------------------------
+    def _toctou_scan(self, stmts: Sequence[ast.stmt],
+                     candidates: List[Tuple[str, str, str, int]],
+                     held: FrozenSet[str]):
+        """candidates: (localvar, attr, lock, read_line) read under a lock
+        that has since been released; an If testing the stale value whose
+        body re-acquires the lock and writes the attr is the bug shape."""
+        candidates = list(candidates)
+        for st in stmts:
+            if isinstance(st, ast.With):
+                locks = self._with_locks(st, record=False)
+                inner_held = held
+                for lid in locks:
+                    inner_held = inner_held | self._expand(lid)
+                for lid in locks:
+                    for var, attr in self._guarded_reads(st.body):
+                        candidates.append((var, attr, lid, st.lineno))
+                self._toctou_scan(st.body, candidates, inner_held)
+            elif isinstance(st, ast.If) and self.cls is not None:
+                test_names = {n.id for n in ast.walk(st.test)
+                              if isinstance(n, ast.Name)}
+                test_attrs = {a for n in ast.walk(st.test)
+                              if (a := _self_attr(n)) is not None}
+                for var, attr, lock, read_line in candidates:
+                    if lock in held:
+                        continue  # still held: check and act are atomic
+                    if var not in test_names and attr not in test_attrs:
+                        continue
+                    wl = self._reacquired_write(st, lock, attr)
+                    if wl is not None:
+                        self.cls.toctou.append(ToctouSite(
+                            attr=attr, lock=lock, read_line=read_line,
+                            test_line=st.lineno, write_line=wl,
+                            method=self.method,
+                            suppressed=self.ann.suppressed_at(st.lineno)
+                            | self.ann.suppressed_at(read_line)))
+                self._toctou_scan(st.body, candidates, held)
+                self._toctou_scan(st.orelse, candidates, held)
+            elif isinstance(st, (ast.For, ast.While, ast.Try)):
+                for block in (getattr(st, "body", []),
+                              getattr(st, "orelse", []),
+                              getattr(st, "finalbody", [])):
+                    self._toctou_scan(block, candidates, held)
+                for h in getattr(st, "handlers", []):
+                    self._toctou_scan(h.body, candidates, held)
+
+    def _guarded_reads(self, body: Sequence[ast.stmt]):
+        """(localvar, attr) pairs assigned from a self-attr read inside a
+        with-block body (top level of the body only)."""
+        out = []
+        for st in body:
+            if (isinstance(st, ast.Assign) and len(st.targets) == 1
+                    and isinstance(st.targets[0], ast.Name)):
+                for sub in ast.walk(st.value):
+                    attr = _self_attr(sub)
+                    if attr is not None and self.cls is not None \
+                            and attr not in self.cls.locks:
+                        out.append((st.targets[0].id, attr))
+        return out
+
+    def _reacquired_write(self, if_node: ast.If, lock: str,
+                          attr: str) -> Optional[int]:
+        """Line of a write to ``attr`` under a re-acquired ``lock`` inside
+        the If body (atomic check-and-set receivers excluded)."""
+        for sub in ast.walk(if_node):
+            if not isinstance(sub, ast.With):
+                continue
+            if lock not in [self.resolve_lock(i.context_expr)
+                            for i in sub.items]:
+                continue
+            for inner in ast.walk(sub):
+                if isinstance(inner, ast.Assign):
+                    for t in inner.targets:
+                        ta = _self_attr(t) or (
+                            _self_attr(t.value)
+                            if isinstance(t, ast.Subscript) else None)
+                        if ta == attr:
+                            return inner.lineno
+                elif isinstance(inner, ast.AugAssign):
+                    if _self_attr(inner.target) == attr:
+                        return inner.lineno
+                elif (isinstance(inner, ast.Call)
+                      and isinstance(inner.func, ast.Attribute)
+                      and inner.func.attr in (_MUTATORS - _ATOMIC_MUTATORS)
+                      and _self_attr(inner.func.value) == attr):
+                    return inner.lineno
+        return None
+
+
+def _lock_info(module: ModuleModel, cls: Optional[ClassModel],
+               lid: str) -> Optional[LockInfo]:
+    if cls is not None:
+        for info in cls.locks.values():
+            if info.node_id == lid:
+                return info
+    for info in module.module_locks.values():
+        if info.node_id == lid:
+            return info
+    for c in module.classes.values():
+        for info in c.locks.values():
+            if info.node_id == lid:
+                return info
+    return None
+
+
+# ---------------------------------------------------------------------------
+# module scan
+# ---------------------------------------------------------------------------
+_KNOWN_CLASSES: Dict[str, ClassModel] = {}
+
+
+def scan_module(path: str, modname: Optional[str] = None,
+                full: bool = True) -> ModuleModel:
+    """Scan one module. ``full=False`` stops after lock/class/annotation
+    discovery (what :func:`scan_modules`' first pass needs to seed
+    cross-module receiver typing) — the per-method walks are the
+    expensive part and only run on the second pass."""
+    modname = modname or os.path.splitext(os.path.basename(path))[0]
+    model = ModuleModel(modname, path)
+    try:
+        with open(path) as fh:
+            source = fh.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError) as e:
+        model.error = f"{type(e).__name__}: {e}"
+        return model
+    ann = _Annotations(source)
+    real = os.path.realpath(path)
+
+    # pass 1: classes, locks, guard declarations, attr types
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            kind = _ctor_kind(node.value)
+            if kind:
+                name = node.targets[0].id
+                info = LockInfo(
+                    node_id=f"{modname}.{name}", attr=name, kind=kind,
+                    line=node.lineno,
+                    blocking_ok=ann.blocking_ok_at(node.lineno))
+                model.module_locks[name] = info
+                model.add_creation_site(real, node.lineno, info.node_id)
+        elif isinstance(node, ast.ClassDef):
+            _scan_class(model, node, ann, real)
+
+    if not full:
+        return model
+
+    # register classes globally BEFORE the method walk so cross-class
+    # receiver typing sees every class of this module set
+    for c in model.classes.values():
+        _KNOWN_CLASSES.setdefault(c.name, c)
+
+    # pass 2: per-method walks (methods + module functions)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            cls = model.classes[node.name]
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    ptypes = _param_types(item)
+                    w = _FuncWalker(model, cls, item, ann, ptypes)
+                    cls.methods[item.name] = w.info
+                    w.run()
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            w = _FuncWalker(model, None, node, ann, _param_types(node))
+            w.run()
+    return model
+
+
+def _param_types(func: ast.AST) -> Dict[str, str]:
+    out = {}
+    for a in list(func.args.args) + list(func.args.kwonlyargs):
+        t = _unwrap_annotation(a.annotation)
+        if t:
+            out[a.arg] = t
+    return out
+
+
+def _scan_class(model: ModuleModel, node: ast.ClassDef, ann: _Annotations,
+                real: str):
+    cls = ClassModel(node.name, model.modname)
+    cls.bases = [d.rsplit(".", 1)[-1] for b in node.bases
+                 if (d := _dotted(b))]
+    model.classes[node.name] = cls
+    base = f"{model.modname}.{node.name}"
+    # find lock attrs + guard annotations + attr construction types in
+    # EVERY method (locks are usually born in __init__ but not always;
+    # guard annotations may precede the lock's assignment — two passes
+    # make declaration order irrelevant)
+    assigns: List[Tuple[str, ast.AST, int]] = []
+    for item in node.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for sub in ast.walk(item):
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        assigns.append((attr, sub.value, sub.lineno))
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                attr = _self_attr(sub.target)
+                if attr is not None:
+                    assigns.append((attr, sub.value, sub.lineno))
+    # locks first (guard resolution needs them all)
+    for attr, value, line in assigns:
+        kind = _ctor_kind(value)
+        if kind is None and _LOCKISH_NAME.search(attr):
+            # lock-valued attr assigned through a helper or parameter
+            # (e.g. self._trace_lock = _model_trace_lock(model)); kind is
+            # opaque but it still participates in held-set tracking
+            if isinstance(value, ast.Call) or isinstance(value, ast.Name):
+                kind = "opaque"
+        if kind is None:
+            continue
+        if attr in cls.locks:
+            continue
+        info = LockInfo(node_id=f"{base}.{attr}", attr=attr, kind=kind,
+                        line=line,
+                        blocking_ok=ann.blocking_ok_at(line))
+        cls.locks[attr] = info
+        if _ctor_kind(value):
+            model.add_creation_site(real, line, info.node_id)
+    # condition wrapping: self._cond = threading.Condition(self._lock)
+    for attr, value, line in assigns:
+        info = cls.locks.get(attr)
+        if info is None or info.kind != "condition":
+            continue
+        if isinstance(value, ast.Call) and value.args:
+            wrapped = _self_attr(value.args[0])
+            if wrapped and wrapped in cls.locks:
+                info.wraps = cls.locks[wrapped].node_id
+    # guard declarations + attr types
+    for attr, value, line in assigns:
+        g = ann.guard_at(line)
+        if g and attr not in cls.locks:
+            cls.guards.setdefault(attr, GuardDecl(
+                attr=attr, guard_expr=g,
+                guard_id=_resolve_guard(model, cls, g), line=line))
+        t = _construction_type(value)
+        if t:
+            cls.attr_types.setdefault(attr, t)
+    # param-annotation types for self.<attr> = <param> in __init__
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and item.name == "__init__":
+            ptypes = _param_types(item)
+            for sub in ast.walk(item):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    attr = _self_attr(sub.targets[0])
+                    if attr is None:
+                        continue
+                    v = sub.value
+                    if isinstance(v, ast.Name) and v.id in ptypes:
+                        cls.attr_types.setdefault(attr, ptypes[v.id])
+                    elif isinstance(v, ast.BoolOp):
+                        for piece in v.values:
+                            if isinstance(piece, ast.Name) \
+                                    and piece.id in ptypes:
+                                cls.attr_types.setdefault(
+                                    attr, ptypes[piece.id])
+                            t = _construction_type(piece)
+                            if t:
+                                cls.attr_types.setdefault(attr, t)
+
+
+def _construction_type(value: ast.AST) -> Optional[str]:
+    if isinstance(value, ast.Call):
+        name = _dotted(value.func)
+        if name:
+            tail = name.rsplit(".", 1)[-1]
+            if tail and tail[0].isupper() and tail not in _LOCK_CTORS:
+                return tail
+    if isinstance(value, ast.IfExp):
+        return _construction_type(value.body) or \
+            _construction_type(value.orelse)
+    return None
+
+
+def _resolve_guard(model: ModuleModel, cls: ClassModel,
+                   expr: str) -> Optional[str]:
+    expr = expr.strip()
+    if expr.startswith("self."):
+        return cls.lock_id(expr[5:])
+    info = model.module_locks.get(expr)
+    return info.node_id if info else None
+
+
+def scan_modules(paths: Sequence[Tuple[str, str]]) -> HostModel:
+    """paths: (modname, filesystem path) pairs -> whole-program model."""
+    _KNOWN_CLASSES.clear()
+    # two passes so cross-module receiver typing is order-independent:
+    # first a DISCOVERY-ONLY scan (classes/locks/attr types — no method
+    # walks), then the real scan with every class registered
+    discovered: Dict[str, ModuleModel] = {}
+    for modname, path in paths:
+        discovered[modname] = scan_module(path, modname, full=False)
+    _KNOWN_CLASSES.clear()
+    for m in discovered.values():
+        for c in m.classes.values():
+            _KNOWN_CLASSES.setdefault(c.name, c)
+    modules: Dict[str, ModuleModel] = {}
+    for modname, path in paths:
+        modules[modname] = scan_module(path, modname)
+    return HostModel(modules)
+
+
+def default_host_paths(root: Optional[str] = None) -> List[Tuple[str, str]]:
+    """The host control plane: every module of serving/, resilience/,
+    observability/, distributed/fleet/ plus the checkpoint manager."""
+    pkg = root or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out: List[Tuple[str, str]] = []
+
+    def add_dir(rel: str):
+        d = os.path.join(pkg, rel)
+        if not os.path.isdir(d):
+            return
+        for fn in sorted(os.listdir(d)):
+            if not fn.endswith(".py") or fn == "__main__.py":
+                continue
+            mod = rel.replace(os.sep, ".").replace("/", ".")
+            name = os.path.splitext(fn)[0]
+            modname = mod if name == "__init__" else f"{mod}.{name}"
+            out.append((modname, os.path.join(d, fn)))
+
+    add_dir("serving")
+    add_dir("resilience")
+    add_dir("observability")
+    add_dir(os.path.join("distributed", "fleet"))
+    add_dir(os.path.join("distributed", "fleet", "elastic"))
+    add_dir(os.path.join("distributed", "fleet", "utils"))
+    ckpt = os.path.join(pkg, "framework", "checkpoint.py")
+    if os.path.exists(ckpt):
+        out.append(("framework.checkpoint", ckpt))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# lock-order graph
+# ---------------------------------------------------------------------------
+class LockOrderGraph:
+    """Directed acquired-while-holding graph; any cycle is a potential
+    deadlock (two threads taking the cycle from different entry points)."""
+
+    def __init__(self, edges: Sequence[OrderEdge] = ()):
+        self.edges: List[OrderEdge] = []
+        self._adj: Dict[str, Set[str]] = {}
+        self._sites: Dict[Tuple[str, str], OrderEdge] = {}
+        for e in edges:
+            self.add(e)
+
+    def add(self, e: OrderEdge):
+        if e.src == e.dst:
+            # same NAME, two instances (recorded by the runtime half when
+            # the underlying objects differ): a real same-class nesting
+            self.edges.append(e)
+            self._adj.setdefault(e.src, set()).add(e.dst)
+            self._sites.setdefault((e.src, e.dst), e)
+            return
+        self.edges.append(e)
+        self._adj.setdefault(e.src, set()).add(e.dst)
+        self._adj.setdefault(e.dst, set())
+        self._sites.setdefault((e.src, e.dst), e)
+
+    def nodes(self) -> List[str]:
+        return sorted(self._adj)
+
+    def site(self, src: str, dst: str) -> Optional[OrderEdge]:
+        return self._sites.get((src, dst))
+
+    def cycles(self) -> List[List[str]]:
+        """One representative cycle per strongly-connected component with
+        >1 node (or a self-loop)."""
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Dict[str, bool] = {}
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str):
+            work = [(v, iter(sorted(self._adj.get(v, ()))))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack[v] = True
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack[w] = True
+                        work.append((w, iter(sorted(self._adj.get(w, ())))))
+                        advanced = True
+                        break
+                    elif on_stack.get(w):
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack[w] = False
+                        scc.append(w)
+                        if w == node:
+                            break
+                    sccs.append(scc)
+
+        for v in sorted(self._adj):
+            if v not in index:
+                strongconnect(v)
+        out = []
+        for scc in sccs:
+            if len(scc) > 1:
+                out.append(self._order_cycle(scc))
+            elif scc[0] in self._adj.get(scc[0], ()):
+                out.append([scc[0], scc[0]])
+        return out
+
+    def _order_cycle(self, scc: List[str]) -> List[str]:
+        """Walk an actual edge path around the SCC for a readable report."""
+        members = set(scc)
+        start = sorted(scc)[0]
+        path = [start]
+        seen = {start}
+        cur = start
+        while True:
+            nxts = [n for n in sorted(self._adj.get(cur, ()))
+                    if n in members]
+            if not nxts:
+                break
+            nxt = next((n for n in nxts if n not in seen), nxts[0])
+            path.append(nxt)
+            if nxt in seen:
+                break
+            seen.add(nxt)
+            cur = nxt
+        return path
+
+
+# ---------------------------------------------------------------------------
+# runtime recorder (the lockdep half)
+# ---------------------------------------------------------------------------
+_THIS_FILE = os.path.realpath(__file__)
+
+
+class _HeldStack(threading.local):
+    def __init__(self):
+        self.stack: List[object] = []
+
+
+class LockOrderRecorder:
+    """Accumulates (held -> acquired) creation-site pairs per thread.
+
+    No internal locking on purpose: edge inserts are single dict/set ops
+    (atomic under the GIL), and the recorder must never serialize the
+    code it observes.
+    """
+
+    def __init__(self):
+        self._tls = _HeldStack()
+        self.edges: Dict[Tuple[Tuple[str, int], Tuple[str, int]], int] = {}
+        self.acquires = 0
+        self.locks_created = 0
+        #: cumulative wall seconds spent armed (the denominator of the
+        #: bench-side overhead fraction: acquires x per-acquire tax / wall)
+        self.armed_wall_s = 0.0
+        self.enabled = True
+
+    def _on_acquire(self, lk: "InstrumentedLock"):
+        st = self._tls.stack
+        if self.enabled:
+            self.acquires += 1
+            if not any(h is lk for h in st):
+                held_sites = []
+                seen = set()
+                for h in st:
+                    if id(h) in seen or h is lk:
+                        continue
+                    seen.add(id(h))
+                    held_sites.append(h._site)
+                for src in held_sites:
+                    key = (src, lk._site)
+                    self.edges[key] = self.edges.get(key, 0) + 1
+        st.append(lk)
+
+    def _on_release(self, lk: "InstrumentedLock"):
+        st = self._tls.stack
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] is lk:
+                del st[i]
+                return
+        # released on a thread that never acquired it (hand-off pattern):
+        # nothing to pop, nothing to record
+
+    def edge_list(self) -> List[dict]:
+        # repo-relative paths: the persisted journal must resolve against
+        # the static model on ANY checkout, not just the recording one
+        return [
+            {"src_file": _rel_site(s[0]), "src_line": s[1],
+             "dst_file": _rel_site(d[0]), "dst_line": d[1], "count": n}
+            for (s, d), n in sorted(self.edges.items())
+        ]
+
+
+class InstrumentedLock:
+    """Recording wrapper around a real Lock/RLock. Transparent: context
+    manager, acquire/release signature, and everything else (``locked``,
+    ``_is_owned``, ``_release_save`` — Condition needs those on RLocks)
+    delegates to the wrapped lock."""
+
+    def __init__(self, inner, site: Tuple[str, int],
+                 recorder: LockOrderRecorder):
+        self._inner = inner
+        self._site = site
+        self._recorder = recorder
+        recorder.locks_created += 1
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._recorder._on_acquire(self)
+        return ok
+
+    def release(self):
+        self._inner.release()
+        self._recorder._on_release(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __repr__(self):
+        return f"<InstrumentedLock {self._site[0]}:{self._site[1]} " \
+               f"of {self._inner!r}>"
+
+
+_ARM_STATE: Dict[str, object] = {}
+
+
+def _creation_site() -> Optional[Tuple[str, int]]:
+    """(realpath, line) of the first caller frame inside this repo; None
+    for foreign locks (left unwrapped: zero overhead, zero noise)."""
+    f = sys._getframe(2)
+    repo_hint = os.sep + "paddle_tpu" + os.sep
+    for _ in range(12):
+        if f is None:
+            return None
+        fn = f.f_code.co_filename
+        if fn != _THIS_FILE and not fn.endswith("threading.py"):
+            real = os.path.realpath(fn)
+            if repo_hint in real and os.sep + "analysis" + os.sep not in real:
+                return (real, f.f_lineno)
+            return None
+        f = f.f_back
+    return None
+
+
+def arm(recorder: LockOrderRecorder):
+    """Patch ``threading.Lock``/``RLock`` so locks constructed by repo
+    code record into ``recorder``. Idempotent per recorder; :func:`disarm`
+    restores the real factories (already-wrapped locks keep recording
+    until ``recorder.enabled`` is cleared)."""
+    if _ARM_STATE:
+        raise RuntimeError("lock instrumentation already armed")
+    real_lock, real_rlock = threading.Lock, threading.RLock
+
+    def make(factory):
+        def build(*a, **k):
+            inner = factory(*a, **k)
+            site = _creation_site()
+            if site is None:
+                return inner
+            return InstrumentedLock(inner, site, recorder)
+        return build
+
+    import time as _time
+
+    _ARM_STATE.update(lock=real_lock, rlock=real_rlock, recorder=recorder,
+                      armed_at=_time.perf_counter())
+    threading.Lock = make(real_lock)
+    threading.RLock = make(real_rlock)
+    recorder.enabled = True
+    return recorder
+
+
+def disarm():
+    if not _ARM_STATE:
+        return
+    import time as _time
+
+    threading.Lock = _ARM_STATE.pop("lock")
+    threading.RLock = _ARM_STATE.pop("rlock")
+    armed_at = _ARM_STATE.pop("armed_at")
+    rec = _ARM_STATE.pop("recorder")
+    rec.armed_wall_s += _time.perf_counter() - armed_at
+    rec.enabled = False
+
+
+class armed:
+    """``with armed(recorder): ...`` — scoped arm/disarm."""
+
+    def __init__(self, recorder: LockOrderRecorder):
+        self.recorder = recorder
+
+    def __enter__(self):
+        arm(self.recorder)
+        return self.recorder
+
+    def __exit__(self, *exc):
+        disarm()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+def write_journal(recorder: LockOrderRecorder, path: str,
+                  meta: Optional[dict] = None) -> str:
+    doc = {
+        "schema_version": JOURNAL_SCHEMA_VERSION,
+        "meta": dict(meta or {},
+                     acquires=recorder.acquires,
+                     locks_created=recorder.locks_created,
+                     armed_wall_s=round(recorder.armed_wall_s, 3)),
+        "edges": recorder.edge_list(),
+    }
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def load_journal(path: str) -> List[dict]:
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("schema_version") != JOURNAL_SCHEMA_VERSION:
+        raise ValueError(
+            f"unsupported lock-journal schema {doc.get('schema_version')!r} "
+            f"(want {JOURNAL_SCHEMA_VERSION})")
+    return list(doc.get("edges", ()))
+
+
+def journal_order_edges(model: HostModel,
+                        journal_edges: Sequence[dict]) -> List[OrderEdge]:
+    """Resolve journal creation sites to static lock names; sites the
+    static model does not know keep a ``file:line`` identity (they still
+    participate in cycle detection — a cycle through an unnamed lock is
+    no less a deadlock)."""
+    out = []
+    for e in journal_edges:
+        src = model.lock_for_site(e["src_file"], e["src_line"]) or \
+            _site_name(e["src_file"], e["src_line"])
+        dst = model.lock_for_site(e["dst_file"], e["dst_line"]) or \
+            _site_name(e["dst_file"], e["dst_line"])
+        out.append(OrderEdge(src=src, dst=dst, file=e["src_file"],
+                             line=int(e["src_line"]), origin="runtime"))
+    return out
+
+
+def _rel_site(path: str) -> str:
+    """Repo-relative identity of a creation-site path (the portion from
+    ``paddle_tpu/`` on): journals keyed this way survive checkout moves."""
+    parts = path.replace("\\", "/").split("/")
+    if "paddle_tpu" in parts:
+        return "/".join(parts[parts.index("paddle_tpu"):])
+    return parts[-1]
+
+
+def _site_name(path: str, line: int) -> str:
+    return f"{_rel_site(path)}:{line}"
+
+
+def build_order_graph(model: HostModel,
+                      journal_edges: Sequence[dict] = ()) -> LockOrderGraph:
+    g = LockOrderGraph(model.static_edges())
+    for e in journal_order_edges(model, journal_edges):
+        g.add(e)
+    return g

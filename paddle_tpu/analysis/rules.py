@@ -43,8 +43,12 @@ from .graph import (
 
 __all__ = [
     "Rule",
+    "HostRule",
     "register_rule",
+    "register_host_rule",
     "default_rules",
+    "default_host_rules",
+    "host_rule_names",
     "run_rules",
     "analyze_targets",
     "DtypePromotionRule",
@@ -90,6 +94,39 @@ def default_rules(**overrides) -> List[Rule]:
     """Fresh instances of every registered rule; ``overrides`` maps rule
     name → ctor kwargs (e.g. thresholds for tests)."""
     return [cls(**overrides.get(name, {})) for name, cls in _RULES.items()]
+
+
+class HostRule(Rule):
+    """A rule over the HOST control plane (``--host`` mode): ``run`` takes
+    a :class:`~paddle_tpu.analysis.hostrace.HostAnalysisContext` — the
+    whole-program lock model — instead of a per-entry-point jaxpr target.
+    Registered separately so the jaxpr sweep never tries to feed a host
+    rule an AnalysisTarget (and vice versa)."""
+
+
+_HOST_RULES: Dict[str, type] = {}
+
+
+def register_host_rule(cls):
+    _HOST_RULES[cls.name] = cls
+    return cls
+
+
+def host_rule_names() -> List[str]:
+    from . import hostrace  # noqa: F401 — populate the registry
+
+    return sorted(_HOST_RULES)
+
+
+def default_host_rules(only=(), **overrides) -> List[Rule]:
+    """Fresh instances of the host-rule registry (optionally narrowed to
+    ``only`` — names are validated by the CLI's argparse choices)."""
+    from . import hostrace  # noqa: F401 — populate the registry
+
+    names = sorted(_HOST_RULES)
+    if only:
+        names = [n for n in names if n in set(only)]
+    return [_HOST_RULES[n](**overrides.get(n, {})) for n in names]
 
 
 # ---------------------------------------------------------------------------

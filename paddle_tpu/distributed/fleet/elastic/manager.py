@@ -329,8 +329,18 @@ class ElasticManager:
         self._stop = threading.Event()
         self._membership_at_launch: List[str] = []
         self._last_endpoints: List[str] = [self.endpoint]
-        self._last_beat_ok = time.monotonic()
-        self.degraded = False  # store unreachable past TTL: single-node mode
+        # degraded/_last_beat_ok are written by the heartbeat thread and
+        # read by the trainer thread (changed()/endpoints_env()): the
+        # degrade decision compares a stamp against the TTL, and a torn
+        # read there flips a node into (or out of) single-node mode on
+        # stale evidence
+        self._state_lock = threading.Lock()
+        self._last_beat_ok = time.monotonic()  # guarded-by: self._state_lock
+        # store unreachable past TTL: single-node mode
+        self.degraded = False  # guarded-by: self._state_lock
+        # set from the SIGTERM path only (main thread): never guarded —
+        # a signal handler taking a lock the interrupted frame holds
+        # would self-deadlock
         self.preempted = False
 
     # -- registry -------------------------------------------------------
@@ -339,8 +349,9 @@ class ElasticManager:
             self.store.register(self.node_id, self.endpoint)
             self._membership_at_launch = self.store.nodes()
             self._last_endpoints = self.store.endpoints()
-            self._last_beat_ok = time.monotonic()
-            self.degraded = False
+            with self._state_lock:
+                self._last_beat_ok = time.monotonic()
+                self.degraded = False
         except StoreUnavailable as e:
             # graceful start: training proceeds single-node; the beat thread
             # keeps probing and rejoins when the registry returns
@@ -348,7 +359,8 @@ class ElasticManager:
                 f"elastic store unreachable at registration ({e}); "
                 "continuing single-node, will rejoin when it returns",
                 RuntimeWarning)
-            self.degraded = True
+            with self._state_lock:
+                self.degraded = True
             self._membership_at_launch = [self.node_id]
         if self._hb_thread is None:
             self._hb_thread = threading.Thread(target=self._beat, daemon=True)
@@ -362,25 +374,41 @@ class ElasticManager:
         dies of a store error."""
         while not self._stop.wait(min(2.0, self.store.ttl / 3)):
             try:
-                if self.degraded:
+                with self._state_lock:
+                    was_degraded = self.degraded
+                # check-then-act is safe here: the re-register RPC must
+                # not run under the lock, and the acted-on transition
+                # (degraded -> False after a successful register) is
+                # idempotent against a concurrent register()
+                # hostrace: ok(host-toctou)
+                if was_degraded:
                     self.store.register(self.node_id, self.endpoint)
-                    self.degraded = False
+                    with self._state_lock:
+                        self.degraded = False
                     warnings.warn(
                         "elastic store reachable again; node re-registered",
                         RuntimeWarning)
                 else:
                     self.store.heartbeat(self.node_id)
-                self._last_beat_ok = time.monotonic()
+                with self._state_lock:
+                    self._last_beat_ok = time.monotonic()
             except FileNotFoundError:
                 try:
                     self.store.register(self.node_id, self.endpoint)
-                    self._last_beat_ok = time.monotonic()
+                    with self._state_lock:
+                        self._last_beat_ok = time.monotonic()
                 except Exception:
                     pass
             except Exception:
-                if (not self.degraded
-                        and time.monotonic() - self._last_beat_ok > self.store.ttl):
-                    self.degraded = True
+                # stamp-vs-TTL comparison and the degrade flip must be one
+                # atomic decision against a consistent stamp
+                with self._state_lock:
+                    degrade = (not self.degraded
+                               and time.monotonic() - self._last_beat_ok
+                               > self.store.ttl)
+                    if degrade:
+                        self.degraded = True
+                if degrade:
                     warnings.warn(
                         f"elastic store unreachable for over ttl="
                         f"{self.store.ttl}s; degrading to single-node "
@@ -407,8 +435,9 @@ class ElasticManager:
         """Membership differs from launch. While the STORE is down this
         answers False — a registry outage must not restart training (the
         degraded node keeps working; it rejoins when the store returns)."""
-        if self.degraded:
-            return False
+        with self._state_lock:
+            if self.degraded:
+                return False
         try:
             return self.store.nodes() != self._membership_at_launch
         except (StoreUnavailable, OSError):

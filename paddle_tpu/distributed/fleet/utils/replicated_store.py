@@ -240,11 +240,11 @@ class ReplicatedKVServer:
 
         # replicated state (all under _lock)
         self._lock = threading.RLock()
-        self._kv: Dict[str, Dict[str, Tuple[str, float]]] = {}
-        self.epoch = 0        # highest epoch seen/voted/served
-        self.seq = 0          # last applied log position
-        self.last_epoch = 0   # epoch of the last applied record
-        self.role = ROLE_FOLLOWER
+        self._kv: Dict[str, Dict[str, Tuple[str, float]]] = {}  # guarded-by: self._lock
+        self.epoch = 0        # guarded-by: self._lock
+        self.seq = 0          # guarded-by: self._lock
+        self.last_epoch = 0   # guarded-by: self._lock
+        self.role = ROLE_FOLLOWER  # guarded-by: self._lock
         self.leader_hint: Optional[str] = None
         self._voted: Dict[int, Tuple] = {}  # epoch -> (last, id) granted
         self._peer_seq: Dict[str, int] = {}
@@ -257,7 +257,10 @@ class ReplicatedKVServer:
         self.dead = False
         self.partitioned = False
         self._stop = threading.Event()
-        self._wlock = threading.Lock()  # serializes the append pipeline
+        # serializes the append pipeline: one record is built, applied
+        # and quorum-replicated (peer RPCs and all) before the next — the
+        # blocking hold IS the single-writer log discipline
+        self._wlock = threading.Lock()  # hostrace: blocking-ok
         self._threads: List[threading.Thread] = []
         self._peer_clients = {
             a: KVClient(a, timeout=self.rpc_timeout)
@@ -354,6 +357,7 @@ class ReplicatedKVServer:
             }
 
     # -- the replicated log ----------------------------------------------
+    # hostrace: requires(self._lock)
     def _apply(self, rec: dict):
         """Apply one record locally (caller holds the lock). Ages ride the
         record so the stamp a replica keeps reflects the WRITE time, not
@@ -552,15 +556,18 @@ class ReplicatedKVServer:
             return False
 
     def _renew_lease(self) -> Optional[bool]:
+        with self._lock:
+            epoch_now = self.epoch
         return self._replicate_record(
             "lease", _SYS_SCOPE, "lease",
             json.dumps({"id": self.node_id, "addr": self.addr,
-                        "epoch": self.epoch}))
+                        "epoch": epoch_now}))
 
     # -- role transitions ------------------------------------------------
     def _touch_lease(self):
         self._lease_deadline = time.monotonic() + self.lease_ttl
 
+    # hostrace: requires(self._lock)
     def _step_down(self, epoch: int):
         """Adopt ``epoch`` as a follower (caller holds the lock)."""
         was_leader = self.role == ROLE_LEADER
@@ -581,6 +588,7 @@ class ReplicatedKVServer:
             self._peer_seq = {}
             self._g_role.set(2, node=self.node_id)
             self._g_epoch.set(self.epoch, node=self.node_id)
+            seq_now = self.seq  # captured under the lock for the dump
         self._c_failovers.inc(node=self.node_id)
         _fire("store.election.won", node=self.node_id, epoch=int(epoch))
         # leader changes are exactly the moments a post-mortem needs:
@@ -588,7 +596,7 @@ class ReplicatedKVServer:
         flight_recorder().dump(
             "store_leader_change",
             extra={"node": self.node_id, "epoch": int(epoch),
-                   "seq": self.seq})
+                   "seq": seq_now})
         # the first append at the new epoch both announces the lease and
         # fences every lower epoch on a quorum
         ok = self._renew_lease()
@@ -670,6 +678,7 @@ class ReplicatedKVServer:
                     return
                 with self._lock:
                     role = self.role
+                    epoch_now = self.epoch
                     expired = time.monotonic() > self._lease_deadline
                     deferred = time.monotonic() < self._defer_until
                 if role == ROLE_LEADER:
@@ -677,7 +686,7 @@ class ReplicatedKVServer:
                     if now - self._last_renew >= self.lease_ttl / 3.0:
                         try:
                             _fire("store.lease.renew", node=self.node_id,
-                                  epoch=self.epoch)
+                                  epoch=epoch_now)
                         except Exception:
                             continue  # injected renewal failure: skip round
                         if self._renew_lease():

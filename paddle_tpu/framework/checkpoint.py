@@ -259,12 +259,15 @@ class CheckpointManager:
         self.directory = directory
         self.keep_max = keep_max
         self.async_save = async_save
+        # in-flight async writer; guarded-by: self._lock
         self._thread: Optional[threading.Thread] = None
         # RLock, not Lock: a SIGTERM handler (resilience.PreemptionGuard)
         # runs on the main thread and may re-enter save()/wait() while the
         # interrupted frame is already inside them — a plain lock would
-        # self-deadlock exactly when the emergency save matters most
-        self._lock = threading.RLock()
+        # self-deadlock exactly when the emergency save matters most.
+        # It intentionally holds across the in-flight writer join: that
+        # serialization is the torn-snapshot guarantee.
+        self._lock = threading.RLock()  # hostrace: blocking-ok
         self.last_loaded_step: Optional[int] = None
         self.last_loaded_meta: Optional[Dict] = None
         os.makedirs(directory, exist_ok=True)
@@ -387,6 +390,7 @@ class CheckpointManager:
         with self._lock:
             self._join_locked()
 
+    # hostrace: requires(self._lock)
     def _join_locked(self):
         if self._thread is not None:
             self._thread.join()

@@ -71,7 +71,11 @@ _LOWER = ("overhead", "ttft", "latency", "_ms", "recovery_s",
 # not flag a later PERFECT 0.0 as "above the band ceiling")
 _MAGNITUDE = ("drift", "est_vs_measured")
 _COUNT_MAX = ("silent_drops", "dropped_requests", "inflight_failures",
-              "admitted_killed", "writes_lost")
+              "admitted_killed", "writes_lost",
+              # concurrency-doctor finding counts (r18): a PR that
+              # re-introduces a HIGH/MEDIUM host-race finding regresses
+              # past the lineage maximum and gates
+              "host_findings_high", "host_findings_medium")
 
 
 def classify_metric(name: str, value) -> str:

@@ -77,8 +77,8 @@ class FlightRecorder:
         self.directory = directory or os.environ.get(FLIGHT_DIR_ENV) or None
         self.max_spans = int(max_spans)
         self.process = process
-        self._notes: Dict[str, object] = {}
-        self._seq = 0
+        self._notes: Dict[str, object] = {}  # guarded-by: self._lock
+        self._seq = 0                        # guarded-by: self._lock
         self.last: Optional[dict] = None      # newest dump (in-memory)
         self.last_path: Optional[str] = None  # where it landed, if on disk
 

@@ -162,7 +162,7 @@ class Counter(_Metric):
 
     def __init__(self, name, help, labelnames=()):
         super().__init__(name, help, labelnames)
-        self._values: Dict[Tuple, float] = {}
+        self._values: Dict[Tuple, float] = {}  # guarded-by: self._lock
 
     def inc(self, amount: float = 1.0, **labels):
         if amount < 0:
@@ -399,7 +399,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: "Dict[str, _Metric]" = {}
+        self._metrics: "Dict[str, _Metric]" = {}  # guarded-by: self._lock
 
     def _get_or_create(self, cls, name, help, labelnames, **kw):
         with self._lock:

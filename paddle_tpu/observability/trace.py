@@ -131,11 +131,15 @@ class SpanRing:
 
     def __init__(self, max_spans: int = 8192):
         self._lock = threading.Lock()
+        # guarded-by: self._lock
         self._ring: "deque[Span]" = deque(maxlen=int(max_spans))
-        self.dropped = 0  # spans that fell off the ring (bounded-loss gauge)
+        # spans that fell off the ring (bounded-loss gauge)
+        self.dropped = 0  # guarded-by: self._lock
 
     @property
     def max_spans(self) -> int:
+        # maxlen is immutable after construction — safe bare read
+        # hostrace: ok(host-guarded-by)
         return self._ring.maxlen or 0
 
     def record(self, s: Span):

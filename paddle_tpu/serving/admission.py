@@ -105,7 +105,8 @@ class AdmissionGate:
         self.engine = engine
         self.budget_bytes = int(budget_bytes)
         self.safety_frac = float(safety_frac)
-        self._estimates: Dict[int, object] = {}  # bucket -> MemoryEstimate
+        # bucket -> MemoryEstimate; guarded-by: self._lock
+        self._estimates: Dict[int, object] = {}
         self._lock = threading.Lock()
         # page-pool watermark (paged KV layout): pages are the allocation
         # unit, so predicted-resident tracks true occupancy — the gate
@@ -116,7 +117,7 @@ class AdmissionGate:
         if page_budget is None and paged:
             page_budget = engine._pool.capacity
         self.page_budget = None if page_budget is None else int(page_budget)
-        self._committed_pages = 0
+        self._committed_pages = 0  # guarded-by: self._lock
         if precompute:
             for b in engine.scheduler.buckets:
                 self.estimate_for_bucket(b)
@@ -139,6 +140,10 @@ class AdmissionGate:
         with eng._trace_lock:
             before = dict(eng.trace_counts)
             try:
+                # pricing IS a trace by design (r15): the model trace lock
+                # must be held for the whole jaxpr build or a concurrent
+                # engine trace reads our tracers
+                # hostrace: ok(host-blocking-under-lock)
                 target.jaxpr()
             finally:
                 eng.trace_counts.update(before)
@@ -313,9 +318,10 @@ class LoadShedPolicy:
         self.high_watermark = high_watermark
         self.low_watermark = low_watermark
         self.sustain_s = float(sustain_s)
-        self.shed_total = 0
+        self.shed_total = 0        # guarded-by: self._lock
+        # guarded-by: self._lock
         self._over_since: Optional[float] = None
-        self._episode_dumped = False
+        self._episode_dumped = False  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._bound_engine = None
 
@@ -356,6 +362,7 @@ class LoadShedPolicy:
         out = scheduler.shed_oldest(depth - self.low_watermark)
         with self._lock:
             self.shed_total += len(out)
+            shed_total_now = self.shed_total  # captured for the dump
             first_of_episode = out and not self._episode_dumped
             if first_of_episode:
                 self._episode_dumped = True
@@ -370,5 +377,5 @@ class LoadShedPolicy:
                 extra={"queue_depth": depth,
                        "high_watermark": self.high_watermark,
                        "shed_now": len(out),
-                       "shed_total": self.shed_total})
+                       "shed_total": shed_total_now})
         return out

@@ -206,9 +206,13 @@ class ContinuousBatchingEngine:
         self.trace_counts: Dict[str, int] = {"prefill": 0, "step": 0}
         self._step_jit = None
         self._prefill_jit = None
-        self._trace_lock = _model_trace_lock(model)
+        # intentionally holds across traces (that is its whole job)
+        self._trace_lock = _model_trace_lock(model)  # hostrace: blocking-ok
         self._traced_buckets: set = set()  # prefill avals already compiled
-        self._lock = threading.Lock()  # engine tick mutual exclusion
+        # engine tick mutual exclusion: one tick = compile-if-needed +
+        # device step + slot bookkeeping, serialized BY DESIGN — waiters
+        # are other tick callers, never request threads
+        self._lock = threading.Lock()  # hostrace: blocking-ok
         self._abort = threading.Event()  # crash simulation: loop exits, NO drain
         self._build_programs()
         # overload protection (serving/admission.py), both opt-in: the

@@ -54,21 +54,23 @@ class ServingMetrics:
         from ..observability.metrics import MetricsRegistry, log_buckets
 
         self._lock = threading.Lock()
-        self.requests_submitted = 0
-        self.requests_rejected = 0
-        self.requests_completed = 0
-        self.requests_shed = 0
-        self.tokens_generated = 0
-        self.prefill_calls = 0
-        self.prefill_compiles = 0
-        self.step_calls = 0
-        self.step_compiles = 0
-        self.queue_depth = 0
-        self.active_slots = 0
-        self.n_slots = 0
-        self._ttft = deque(maxlen=max_samples)
-        self._token_lat = deque(maxlen=max_samples)
+        self.requests_submitted = 0   # guarded-by: self._lock
+        self.requests_rejected = 0    # guarded-by: self._lock
+        self.requests_completed = 0   # guarded-by: self._lock
+        self.requests_shed = 0        # guarded-by: self._lock
+        self.tokens_generated = 0     # guarded-by: self._lock
+        self.prefill_calls = 0        # guarded-by: self._lock
+        self.prefill_compiles = 0     # guarded-by: self._lock
+        self.step_calls = 0           # guarded-by: self._lock
+        self.step_compiles = 0        # guarded-by: self._lock
+        self.queue_depth = 0          # guarded-by: self._lock
+        self.active_slots = 0         # guarded-by: self._lock
+        self.n_slots = 0              # guarded-by: self._lock
+        self._ttft = deque(maxlen=max_samples)       # guarded-by: self._lock
+        self._token_lat = deque(maxlen=max_samples)  # guarded-by: self._lock
+        # guarded-by: self._lock
         self._first_emit: Optional[float] = None
+        # guarded-by: self._lock
         self._last_emit: Optional[float] = None
         r = self.registry = registry or MetricsRegistry()
         # crash dumps must freeze THIS engine's series, not just the

@@ -66,8 +66,9 @@ class PagePool:
         self.n_pages = int(n_pages)
         self.page_bytes = int(page_bytes)
         self._lock = threading.Lock()
-        self._refs = [0] * self.n_pages
+        self._refs = [0] * self.n_pages      # guarded-by: self._lock
         self._refs[TRASH_PAGE] = -1  # reserved, never allocated/released
+        # guarded-by: self._lock
         self._free: deque = deque(range(1, self.n_pages))
 
     # -- capacity -------------------------------------------------------
@@ -187,11 +188,18 @@ class RadixCache:
     def __init__(self, pool: PagePool, page_size: int):
         self.pool = pool
         self.page_size = int(page_size)
+        # the tree mutates on the engine thread (match/insert/evict under
+        # the tick) but peek() prices admissions from SERVER threads and
+        # the hit gauges feed /metrics — a bare dict walk racing a del
+        # mid-evict reads torn state. Ordering: RadixCache._lock is taken
+        # BEFORE PagePool._lock (retain/release inside), never after.
+        self._lock = threading.RLock()
+        # guarded-by: self._lock
         self._root: Dict[Tuple[int, ...], _RadixNode] = {}
-        self._clock = 0
-        self.hits = 0        # match() calls that found >= 1 page
-        self.queries = 0     # match() calls
-        self.hit_tokens = 0  # prompt tokens skipped via sharing
+        self._clock = 0      # guarded-by: self._lock
+        self.hits = 0        # guarded-by: self._lock
+        self.queries = 0     # guarded-by: self._lock
+        self.hit_tokens = 0  # guarded-by: self._lock
 
     def _chunks(self, tokens) -> List[Tuple[int, ...]]:
         ps = self.page_size
@@ -204,35 +212,37 @@ class RadixCache:
         """Longest resident full-page prefix of ``tokens``; the returned
         pages are RETAINED for the caller (release when the request
         terminates)."""
-        self._clock += 1
-        self.queries += 1
-        pages: List[int] = []
-        level = self._root
-        for chunk in self._chunks(tokens):
-            node = level.get(chunk)
-            if node is None:
-                break
-            node.stamp = self._clock
-            pages.append(node.page)
-            level = node.children
-        if pages:
-            self.pool.retain(pages)
-            self.hits += 1
-            self.hit_tokens += len(pages) * self.page_size
-        return pages
+        with self._lock:
+            self._clock += 1
+            self.queries += 1
+            pages: List[int] = []
+            level = self._root
+            for chunk in self._chunks(tokens):
+                node = level.get(chunk)
+                if node is None:
+                    break
+                node.stamp = self._clock
+                pages.append(node.page)
+                level = node.children
+            if pages:
+                self.pool.retain(pages)
+                self.hits += 1
+                self.hit_tokens += len(pages) * self.page_size
+            return pages
 
     def peek(self, tokens) -> int:
         """Number of full pages a :meth:`match` would return, without
         retaining (admission-gate watermark prediction)."""
-        n = 0
-        level = self._root
-        for chunk in self._chunks(tokens):
-            node = level.get(chunk)
-            if node is None:
-                break
-            n += 1
-            level = node.children
-        return n
+        with self._lock:
+            n = 0
+            level = self._root
+            for chunk in self._chunks(tokens):
+                node = level.get(chunk)
+                if node is None:
+                    break
+                n += 1
+                level = node.children
+            return n
 
     # -- registration ---------------------------------------------------
     def insert(self, tokens, pages: Sequence[int]):
@@ -240,19 +250,21 @@ class RadixCache:
         chunk i's KV). Existing nodes keep their original page (the new
         request's private copy stays private); new nodes retain one tree
         reference on their page."""
-        self._clock += 1
-        level = self._root
-        for chunk, page in zip(self._chunks(tokens), pages):
-            node = level.get(chunk)
-            if node is None:
-                node = _RadixNode(int(page), self._clock)
-                self.pool.retain([int(page)])
-                level[chunk] = node
-            else:
-                node.stamp = self._clock
-            level = node.children
+        with self._lock:
+            self._clock += 1
+            level = self._root
+            for chunk, page in zip(self._chunks(tokens), pages):
+                node = level.get(chunk)
+                if node is None:
+                    node = _RadixNode(int(page), self._clock)
+                    self.pool.retain([int(page)])
+                    level[chunk] = node
+                else:
+                    node.stamp = self._clock
+                level = node.children
 
     # -- eviction -------------------------------------------------------
+    # hostrace: requires(self._lock)
     def _leaves(self):
         out = []
 
@@ -272,19 +284,20 @@ class RadixCache:
         never evicted). Cascades: a parent whose children were all
         evicted becomes a leaf candidate in the next round."""
         freed = 0
-        while freed < n:
-            candidates = [(level, key, node)
-                          for level, key, node in self._leaves()
-                          if self.pool.refcount(node.page) == 1]
-            if not candidates:
-                break
-            candidates.sort(key=lambda c: c[2].stamp)
-            for level, key, node in candidates:
-                if freed >= n:
+        with self._lock:
+            while freed < n:
+                candidates = [(level, key, node)
+                              for level, key, node in self._leaves()
+                              if self.pool.refcount(node.page) == 1]
+                if not candidates:
                     break
-                self.pool.release([node.page])
-                del level[key]
-                freed += 1
+                candidates.sort(key=lambda c: c[2].stamp)
+                for level, key, node in candidates:
+                    if freed >= n:
+                        break
+                    self.pool.release([node.page])
+                    del level[key]
+                    freed += 1
         return freed
 
     def resident_pages(self) -> int:
@@ -296,7 +309,8 @@ class RadixCache:
                 n += 1
                 walk(node.children)
 
-        walk(self._root)
+        with self._lock:
+            walk(self._root)
         return n
 
     def clear(self):
@@ -307,10 +321,12 @@ class RadixCache:
                 walk(node.children)
                 self.pool.release([node.page])
 
-        walk(self._root)
-        self._root = {}
+        with self._lock:
+            walk(self._root)
+            self._root = {}
 
     def hit_rate(self) -> Optional[float]:
-        if not self.queries:
-            return None
-        return self.hits / self.queries
+        with self._lock:
+            if not self.queries:
+                return None
+            return self.hits / self.queries

@@ -121,7 +121,7 @@ class RoutedRequest:
         self.route_span_id: Optional[str] = None
         self.replica_addr: Optional[str] = None
         self.remote_id: Optional[str] = None
-        self.tokens: List[int] = []
+        self.tokens: List[int] = []   # guarded-by: self._tokens_lock
         self.state = Request.PENDING
         self.error: Optional[str] = None
         # "request" (replica answered: request-level verdict) vs
@@ -132,12 +132,16 @@ class RoutedRequest:
         self.submitted_at = time.perf_counter()
         self.deadline_at = (None if self.deadline_s is None
                             else self.submitted_at + self.deadline_s)
+        # guarded-by: self._tokens_lock
         self.first_token_at: Optional[float] = None
+        # guarded-by: self._tokens_lock
         self.failover_first_token_at: Optional[float] = None
         # serializes failover: poll() and stream() may race on the same
         # request, and both observing the same death must not resubmit
-        # the prompt twice
-        self._failover_lock = threading.Lock()
+        # the prompt twice. It intentionally holds across the confirming
+        # probe, the backoff sleeps and the resubmit RPCs — that
+        # serialization IS the at-most-once guarantee.
+        self._failover_lock = threading.Lock()  # hostrace: blocking-ok
         self._tokens_lock = threading.Lock()
 
     @property
@@ -186,9 +190,12 @@ class ServingRouter:
         self.health_interval_s = float(health_interval_s)
         self.resubmit_retries = int(resubmit_retries)
         self.poll_s = float(poll_s)
-        self.failovers = 0        # replica deaths that triggered resubmits
-        self.resubmits = 0        # requests re-homed onto a survivor
-        self.inflight_failures = 0  # requests surfaced FAILED (had tokens)
+        # replica deaths acted on; guarded-by: self._lock
+        self.failovers = 0
+        # requests re-homed onto a survivor; guarded-by: self._lock
+        self.resubmits = 0
+        # requests surfaced FAILED (had tokens); guarded-by: self._lock
+        self.inflight_failures = 0
         self._lock = threading.RLock()
         self._health_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -446,6 +453,7 @@ class ServingRouter:
                 return True
             return self._handle_replica_death_locked(rr, err)
 
+    # hostrace: requires(rr._failover_lock)
     def _handle_replica_death_locked(self, rr: RoutedRequest,
                                      err: Exception) -> bool:
         rep = self.replicas.get(rr.replica_addr)

@@ -121,12 +121,12 @@ class Request:
         self.trace_id = trace_id
         self.parent_span_id = parent_span_id
         self._decode_span_parent: Optional[str] = None  # engine-owned
-        self.tokens: List[int] = []
-        self.state = Request.PENDING
-        self.error: Optional[str] = None
+        self.tokens: List[int] = []      # guarded-by: self._cond
+        self.state = Request.PENDING     # guarded-by: self._cond
+        self.error: Optional[str] = None  # guarded-by: self._cond
         # typed discriminator for failures ("DeadlineExceededError",
         # "ShedError", ...) — clients switch on this, not message prose
-        self.error_type: Optional[str] = None
+        self.error_type: Optional[str] = None  # guarded-by: self._cond
         self.bucket: Optional[int] = None
         self.submitted_at = time.perf_counter()
         # client deadline (propagated as REMAINING seconds via the
@@ -174,6 +174,10 @@ class Request:
     # -- client side --------------------------------------------------------
     @property
     def done(self) -> bool:
+        # a bare read of the state REFERENCE is the documented contract:
+        # transitions are monotonic (PENDING->RUNNING->DONE/FAILED) and a
+        # stale read only delays the observer one poll
+        # hostrace: ok(host-guarded-by)
         return self.state in (Request.DONE, Request.FAILED)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -202,16 +206,20 @@ class Request:
                     self._cond.wait(rem)
                 chunk = self.tokens[idx:]
                 finished = self.done
+                total = len(self.tokens)  # consistent with chunk/finished
             for t in chunk:
                 yield t
             idx += len(chunk)
-            if finished and idx >= len(self.tokens):
+            if finished and idx >= total:
                 return
 
     def result(self) -> np.ndarray:
-        """prompt + generated tokens as int64 (models.generate's shape)."""
+        """prompt + generated tokens as int64 (models.generate's shape).
+        Read-after-done by contract: callers wait() first, and _finish
+        publishes under the condition this read pairs with."""
         return np.concatenate(
             [self.prompt.astype(np.int64),
+             # hostrace: ok(host-guarded-by)
              np.asarray(self.tokens, dtype=np.int64)])
 
     def ttft(self) -> Optional[float]:
@@ -234,19 +242,19 @@ class FCFSScheduler:
         # than the largest bucket: each chunk is bucketed, not the whole
         # prompt. None = whole-prompt bucketing (the r8 behavior).
         self.bucket_cap: Optional[int] = None
-        self._q: deque = deque()
+        self._q: deque = deque()  # guarded-by: self._cond
         self._cond = threading.Condition()
-        self._closed = False
+        self._closed = False      # guarded-by: self._cond
         # popped by take_admissions but not yet settled into a slot (or
         # retired/failed) by the engine: during a prefill compile these
         # requests are in NEITHER the queue nor a slot, and a drain that
         # trusts depth()+active alone would declare the engine empty
         # mid-prefill and orphan them
-        self._in_admission = 0
+        self._in_admission = 0    # guarded-by: self._cond
         # queued requests that CARRY a deadline: lets the per-tick expiry
         # sweep skip the O(queue) walk entirely for deployments that
         # never set deadlines
-        self._deadlined = 0
+        self._deadlined = 0       # guarded-by: self._cond
 
     # -- admission ----------------------------------------------------------
     def bucket_for(self, prompt_len: int) -> int:

@@ -301,12 +301,13 @@ class ServingServer:
         # in-flight work before declaring the engine stuck (was an implicit
         # hard-coded default; operators sizing long generations need it)
         self.drain_timeout_s = float(drain_timeout_s)
+        # guarded-by: self._requests_lock
         self._requests: "OrderedDict[str, Request]" = OrderedDict()
         self._requests_lock = threading.Lock()
         # established handler connections: kill() must sever these so a
         # client mid-stream sees a reset (like a real process SIGKILL),
         # not a silent socket that only dies at its own read timeout
-        self._conns: set = set()
+        self._conns: set = set()  # guarded-by: self._conns_lock
         self._conns_lock = threading.Lock()
         handler = type("_BoundHandler", (_Handler,), {"server_ref": self})
         self._httpd = _QuietHTTPServer((host, port), handler)

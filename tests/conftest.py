@@ -86,3 +86,69 @@ def _seed_everything():
     paddle.seed(2024)
     np.random.seed(2024)
     yield
+
+
+# ---------------------------------------------------------------------------
+# Runtime lock-order journal (concurrency doctor, ISSUE 14): the suites
+# that exercise the threaded control plane hardest run with instrumented
+# locks; at session end the observed held->acquired edges are merged into
+# the STATIC lock model and the union must be acyclic. Set
+# HOSTRACE_JOURNAL_OUT=<path> to also persist the journal (that is how
+# benchmarks/hostrace_journal.json is regenerated).
+# ---------------------------------------------------------------------------
+_HOSTRACE_SUITES = {
+    "test_serving.py",
+    "test_router_failover.py",
+    "test_replicated_store.py",
+}
+_hostrace_recorder = None
+
+
+def _get_hostrace_recorder():
+    global _hostrace_recorder
+    if _hostrace_recorder is None:
+        from paddle_tpu.analysis.lockmodel import LockOrderRecorder
+
+        _hostrace_recorder = LockOrderRecorder()
+    return _hostrace_recorder
+
+
+@pytest.fixture(autouse=True)
+def _hostrace_arm(request):
+    if os.environ.get("HOSTRACE_ARM", "1") == "0":  # escape hatch
+        yield
+        return
+    if os.path.basename(str(request.node.fspath)) not in _HOSTRACE_SUITES:
+        yield
+        return
+    from paddle_tpu.analysis import lockmodel
+
+    rec = _get_hostrace_recorder()
+    try:
+        lockmodel.arm(rec)
+    except RuntimeError:  # already armed (nested/re-entrant collection)
+        yield
+        return
+    try:
+        yield
+    finally:
+        lockmodel.disarm()
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _hostrace_journal_check():
+    yield
+    rec = _hostrace_recorder
+    if rec is None or not rec.edges:
+        return
+    from paddle_tpu.analysis import lockmodel
+
+    out = os.environ.get("HOSTRACE_JOURNAL_OUT")
+    if out:
+        lockmodel.write_journal(rec, out, meta={"source": "pytest-tier1"})
+    model = lockmodel.scan_modules(lockmodel.default_host_paths())
+    graph = lockmodel.build_order_graph(model, rec.edge_list())
+    cycles = graph.cycles()
+    assert not cycles, (
+        f"runtime lock-order journal introduced cycles into the static "
+        f"lock graph (potential deadlocks observed live): {cycles}")
