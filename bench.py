@@ -1123,6 +1123,211 @@ def _store_failover(on_tpu):
         }
 
 
+def _ckpt_durability(on_tpu):
+    """Replicated checkpoint data plane secondary (ISSUE 15): (a) steady-
+    state replication tax — per-step wall time of a 2-rank elastic dp
+    cohort with the replicated plane (per-rank shard snapshots + K=1 peer
+    pushes + manifest commits) vs the replication-OFF single-writer path,
+    on identical workloads (`ckpt_replication_overhead_ok` bounds it at
+    2% of step time); (b) disk-loss recovery — SIGKILL-equivalent injected
+    kill AND directory wipe of one of 3 ranks mid-run, a replacement rank
+    with an empty disk rejoins from peer replicas; recovery_s = death →
+    first post-recovery step; (c) `ckpt_acked_snapshots_lost` — every
+    manifest ever committed must still reassemble CRC-clean from the
+    survivors afterwards (must be 0). Identical on both arms (pure
+    host/store path, no device)."""
+    del on_tpu  # checkpoint plane is device-independent
+    import contextlib
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    from paddle_tpu.distributed.fleet.elastic.manager import (
+        ElasticManager,
+        _TcpStore,
+    )
+    from paddle_tpu.distributed.fleet.utils.http_server import KVServer
+    from paddle_tpu.resilience import (
+        DurabilityConfig,
+        FaultSchedule,
+        InjectedDeath,
+    )
+    from paddle_tpu.resilience.durability import CheckpointDataPlane
+    from paddle_tpu.resilience.elastic_trainer import ElasticDPTrainer
+
+    W_STAR = np.arange(32.0 * 16).reshape(32, 16) / 100.0
+
+    def grad_fn(params, step, rank, world):
+        rng = np.random.default_rng(700000 + 1000 * step + 10 * world + rank)
+        X = rng.standard_normal((16, 32))
+        E = X @ params["w"] - X @ W_STAR
+        return float((E ** 2).mean()), {"w": 2 * X.T @ E / E.size}
+
+    def init_params():
+        return {"w": np.zeros((32, 16))}
+
+    def durability_cfg():
+        return DurabilityConfig(replicas=1, push_confirm_timeout_s=0.25,
+                                manifest_timeout_s=20.0)
+
+    def run_cohort(n, total, base, replicated, save_every=2,
+                   victim_step=None, ttl=1.2):
+        srv = KVServer().start()
+        addr = f"127.0.0.1:{srv.port}"
+        stamps = {}   # node -> [(wall, step, world)]
+        events = {}   # node -> [(wall, message)]
+        errors = {}
+        threads = {}
+
+        def start_rank(idx, node, schedule=None, wait_world=None):
+            stamps.setdefault(node, [])
+            events.setdefault(node, [])
+
+            def run():
+                st = _TcpStore(addr, "benchckpt", ttl=ttl, retries=1)
+                mgr = ElasticManager(store=st)
+                mgr.endpoint = f"127.0.0.1:{7900 + idx}"
+                mgr.node_id = node
+                ckpt_dir = (os.path.join(base, node) if replicated
+                            else os.path.join(base, "shared"))
+                tr = ElasticDPTrainer(
+                    mgr, ckpt_dir, grad_fn, init_params, lr=0.2,
+                    momentum=0.9, min_ranks=1, save_every=save_every,
+                    step_timeout=60, rendezvous_timeout=60,
+                    durability=durability_cfg() if replicated else None,
+                    on_step=lambda s, w, _l: stamps[node].append(
+                        (time.perf_counter(), s, w)),
+                    on_event=lambda m: events[node].append(
+                        (time.perf_counter(), m)))
+                ctx = (schedule.scope() if schedule is not None
+                       else contextlib.nullcontext())
+                try:
+                    with ctx:
+                        tr.run(total, wait_world=wait_world)
+                except InjectedDeath:
+                    stamps[node].append((time.perf_counter(), -1, 0))
+                    events[node].append((time.perf_counter(), "DIED"))
+                    return
+                except Exception as e:  # pragma: no cover - surfaced below
+                    errors[node] = f"{type(e).__name__}: {e}"
+                    return
+                tr.close()
+
+            t = threading.Thread(target=run, daemon=True)
+            threads[node] = t
+            t.start()
+
+        try:
+            for i in range(n):
+                start_rank(i, f"node_{i}",
+                           schedule=(FaultSchedule(seed=17).add(
+                               "ckpt.disk.loss", "kill",
+                               match={"step": victim_step})
+                               if victim_step is not None and i == n - 1
+                               else None),
+                           wait_world=n)
+            if victim_step is not None:
+                victim = f"node_{n - 1}"
+                deadline = time.monotonic() + 120
+                while (time.monotonic() < deadline
+                       and not any(m == "DIED"
+                                   for _t, m in events[victim])):
+                    time.sleep(0.01)
+                start_rank(n, f"node_{n}", wait_world=1)
+            for t in threads.values():
+                t.join(240)
+            manifests = {}
+            if replicated:
+                manifests = dict(_TcpStore(addr, "benchckpt", ttl=5.0,
+                                           retries=1).scan(prefix="ckmf:"))
+        finally:
+            srv.stop()
+        if errors:
+            raise RuntimeError(f"bench cohort rank failures: {errors}")
+        return stamps, events, manifests
+
+    def median_step_s(stamps, node="node_0", skip=2):
+        ts = [w for w, _s, _v in stamps[node]]
+        diffs = [b - a for a, b in zip(ts[:-1], ts[1:])][skip:]  # warmup off
+        diffs.sort()
+        return diffs[len(diffs) // 2]
+
+    STEPS = 24
+    with tempfile.TemporaryDirectory() as base_on:
+        on_stamps, _ev, _mf = run_cohort(2, STEPS, base_on, replicated=True)
+        step_on = median_step_s(on_stamps)
+    with tempfile.TemporaryDirectory() as base_off:
+        off_stamps, _ev, _mf = run_cohort(2, STEPS, base_off,
+                                          replicated=False)
+        step_off = median_step_s(off_stamps)
+    overhead = step_on / step_off - 1.0
+
+    # disk-loss chaos: kill + wipe one of 3 ranks, empty-disk replacement
+    base_chaos = tempfile.mkdtemp()
+    try:
+        stamps, events, manifests = run_cohort(
+            3, 12, base_chaos, replicated=True, save_every=1,
+            victim_step=6)
+        victim = "node_2"
+        t_death = next(w for w, s, _v in stamps[victim] if s == -1)
+        # recovery end = node_0's first completed step AFTER its
+        # post-death restore event. A step already in flight when the
+        # victim died can land after t_death, which would credit recovery
+        # before detection/rendezvous/restore even began.
+        t_restore = min((t for t, m in events["node_0"]
+                         if t > t_death and m.startswith("restore:")),
+                        default=float("nan"))
+        t_rec = min((w for w, _s, _v in stamps["node_0"] if w > t_restore),
+                    default=float("nan"))
+        recovery_s = t_rec - t_death
+        # acked-durability audit: every committed manifest must still
+        # assemble from the survivors (victim's disk is gone)
+        lost = 0
+        n_manifests = len(manifests)
+        srv = KVServer().start()
+        planes = []
+        try:
+            vstore = _TcpStore(f"127.0.0.1:{srv.port}", "verify",
+                               ttl=5.0, retries=1)
+            for k, (v, _age) in manifests.items():
+                vstore.put(k, v)
+            for node in ("node_0", "node_1", "node_3"):
+                d = os.path.join(base_chaos, node)
+                if os.path.exists(d):
+                    planes.append(CheckpointDataPlane(
+                        _TcpStore(f"127.0.0.1:{srv.port}", "verify",
+                                  ttl=5.0, retries=1), node, d,
+                        durability_cfg()))
+            with tempfile.TemporaryDirectory() as vdir:
+                verifier = CheckpointDataPlane(
+                    _TcpStore(f"127.0.0.1:{srv.port}", "verify",
+                              ttl=5.0, retries=1), "verifier", vdir,
+                    durability_cfg())
+                planes.append(verifier)
+                for s in verifier.manifest_steps():
+                    try:
+                        verifier.load_step(s, timeout=15)
+                    except Exception:
+                        lost += 1
+        finally:
+            for p in planes:
+                p.close()
+            srv.stop()
+    finally:
+        shutil.rmtree(base_chaos, ignore_errors=True)
+
+    return {
+        "ckpt_replication_step_seconds": round(step_on, 5),
+        "ckpt_baseline_step_seconds": round(step_off, 5),
+        "ckpt_replication_overhead_frac": round(overhead, 4),
+        "ckpt_replication_overhead_ok": bool(overhead < 0.02),
+        "ckpt_disk_loss_recovery_s": round(recovery_s, 3),
+        "ckpt_acked_snapshots_lost": lost,
+        "ckpt_manifests_committed": n_manifests,
+    }
+
+
 def _eager_jit_speedup():
     """Eager GPT-block fwd+bwd: op-by-op dispatch vs the transparent
     per-layer jit cache (FLAGS_eager_layer_jit) — SURVEY §7 hard-part 4."""
@@ -1255,6 +1460,12 @@ def main():
         except Exception as e:  # pragma: no cover - device dependent
             secondary["store_failover_recovery_s"] = f"failed: {type(e).__name__}"
         try:
+            # robustness: replicated checkpoint plane — replication tax +
+            # disk-loss recovery + acked-durability audit (ISSUE 15)
+            secondary.update(_ckpt_durability(True))
+        except Exception as e:  # pragma: no cover - device dependent
+            secondary["ckpt_disk_loss_recovery_s"] = f"failed: {type(e).__name__}"
+        try:
             # auto-parallel planner v2 search (ISSUE 13)
             secondary.update(_planner_search(True))
         except Exception as e:  # pragma: no cover
@@ -1328,6 +1539,10 @@ def main():
             secondary.update(_store_failover(False))
         except Exception as e:  # pragma: no cover
             secondary["store_failover_recovery_s"] = f"failed: {type(e).__name__}"
+        try:
+            secondary.update(_ckpt_durability(False))
+        except Exception as e:  # pragma: no cover
+            secondary["ckpt_disk_loss_recovery_s"] = f"failed: {type(e).__name__}"
         try:
             secondary.update(_planner_search(False))
         except Exception as e:  # pragma: no cover

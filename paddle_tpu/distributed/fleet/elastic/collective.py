@@ -236,6 +236,12 @@ class ElasticCollective:
         deadline = time.monotonic() + timeout
         delays = self._sleep_iter()
         published = False
+        # growth-deadlock guard pacing: first rdv scan only after the wait
+        # has outlived normal straggle, then at most every 2s — the scan
+        # carries every view blob, and running it per poll tick would
+        # re-create the payload-per-poll load the presence-only poll above
+        # exists to avoid
+        guard_at = time.monotonic() + 0.5
         while True:
             try:
                 # (re)publish inside the loop: a store failover can
@@ -289,6 +295,34 @@ class ElasticCollective:
                     f"rank(s) {[self.members.index(d) for d in dead]} "
                     f"({dead}) died during allgather '{tag}' "
                     f"(gen {self.generation})", dead=dead)
+            # elastic GROWTH deadlock guard: a missing member that is
+            # still ALIVE may have left for a higher rendezvous
+            # generation (it saw a new node register at its step
+            # boundary; we checked a beat earlier and missed it). It will
+            # never publish this round's payload — without this check the
+            # two incumbents mutually stall: one in the new rendezvous
+            # waiting for us, us here waiting for its gradient. Gated off
+            # the hot path: a healthy peer is at most a few poll ticks
+            # behind, so the extra rdv scan runs only once the wait has
+            # outlived any normal straggle (the stall it exists to break
+            # holds for the full step timeout otherwise).
+            if time.monotonic() >= guard_at:
+                guard_at = time.monotonic() + 2.0
+                try:
+                    gens = self._parse_rdv(self._kv_scan(prefix="rdv"))
+                except OSError:
+                    gens = {}
+                moved = [self.members[r] for r in missing
+                         if any(g > self.generation
+                                and (self.members[r] in joins
+                                     or self.members[r] in views)
+                                for g, (joins, views) in gens.items())]
+                if moved:
+                    raise RankFailure(
+                        f"rank(s) {[self.members.index(m) for m in moved]} "
+                        f"({moved}) left allgather '{tag}' for a newer "
+                        f"rendezvous generation (> {self.generation}) — "
+                        "regrouping", dead=[])
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"allgather '{tag}' missing ranks {missing} after "

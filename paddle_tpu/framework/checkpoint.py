@@ -44,7 +44,7 @@ from ..tensor import Tensor
 __all__ = ["CheckpointManager", "CheckpointCorruptionError",
            "CheckpointReshardError", "build_train_state", "save_checkpoint",
            "load_checkpoint", "reshard_train_state", "shard_bounds",
-           "shard_slice", "unshard"]
+           "shard_slice", "unshard", "durable_write_bytes"]
 
 _META = "meta.json"
 _ARRAYS = "arrays.npz"
@@ -89,6 +89,32 @@ class CheckpointReshardError(RuntimeError):
 
 def _crc32(arr: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+def durable_write_bytes(path: str, data: bytes):
+    """THE crash-safe publish protocol for a single file, factored from the
+    snapshot writer so the replicated checkpoint data plane
+    (:mod:`~paddle_tpu.resilience.durability`) shares one write path:
+    write to a dot-temp sibling, flush + fsync, atomically rename onto
+    ``path``, then fsync the parent directory so the rename itself is
+    durable. A crash at any point leaves either the old file or the new
+    one — never a torn published file."""
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(prefix=f".tmp_{os.path.basename(path)}_",
+                               dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    CheckpointManager._fsync_dir(d)
 
 
 def _check_reshardable(path: str, shape, spec, mesh):
@@ -465,10 +491,55 @@ class CheckpointManager:
             os.close(fd)
 
     def _prune(self):
+        """Evict snapshots past ``keep_max`` — but NEVER the newest intact
+        one. keep_max counts by step number, so a torn newest publish (a
+        crash or an injected ``torn`` fault lands a corrupt step dir ABOVE
+        the intact ones) would otherwise rotate every intact snapshot out
+        while the only retained dirs are garbage: with keep_max=1, save(1)
+        then a torn save(2) must leave step_1 on disk or the newest-intact
+        fallback has nothing to fall back to."""
+        if not self.keep_max:
+            return
         steps = self.all_steps()
-        for s in steps[: -self.keep_max] if self.keep_max else []:
+        doomed = steps[: -self.keep_max]
+        if not doomed:
+            return
+        if not any(self._intact_light(s) for s in steps[-self.keep_max:]):
+            # every retained snapshot is damaged — spare the newest intact
+            # one from the doomed range (the fallback loader's lifeline)
+            for s in reversed(doomed):
+                if self._intact_light(s):
+                    doomed = [d for d in doomed if d != s]
+                    break
+        for s in doomed:
             shutil.rmtree(os.path.join(self.directory, f"step_{s}"),
                           ignore_errors=True)
+
+    def _intact_light(self, step: int) -> bool:
+        """Cheap structural intactness probe used by the prune guard:
+        meta.json parses, the tree blob matches its CRC, and arrays.npz
+        opens as a zip whose member set matches the stamped checksums.
+        Deliberately does NOT hash array payloads (that full verify is
+        load()'s and the durability scrubber's job) — it only needs to
+        catch the torn-publish shapes (truncated/missing files)."""
+        d = os.path.join(self.directory, f"step_{step}")
+        try:
+            with open(os.path.join(d, _META)) as f:
+                meta = json.load(f)
+            with open(os.path.join(d, _PYTREE)) as f:
+                tree_blob = f.read()
+            if (meta.get("tree_crc") is not None
+                    and zlib.crc32(tree_blob.encode()) != meta["tree_crc"]):
+                return False
+            data = np.load(os.path.join(d, _ARRAYS), allow_pickle=False)
+            checksums = meta.get("checksums")
+            if checksums is not None:
+                have = {k.replace("|", "/") for k in data.files}
+                if have != set(checksums):
+                    return False
+            return True
+        except Exception:
+            return False
 
     # -- load -----------------------------------------------------------
     def all_steps(self):
@@ -503,19 +574,56 @@ class CheckpointManager:
         if not steps:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
         last_err: Optional[Exception] = None
+        corrupt_steps: List[int] = []
         for s in reversed(steps):
             try:
-                return self._load_step(s, mesh, verify)
+                out = self._load_step(s, mesh, verify)
             except (CheckpointCorruptionError, OSError, ValueError,
                     KeyError) as e:
                 warnings.warn(
                     f"checkpoint step_{s} in {self.directory} is corrupt "
                     f"({type(e).__name__}: {e}); falling back to the "
                     f"previous snapshot", RuntimeWarning)
+                corrupt_steps.append(s)
                 last_err = e
+                continue
+            if corrupt_steps:
+                # a corrupt-snapshot fallback is an OPERATIONAL EVENT, not
+                # just a warning string: count it next to the serving/
+                # elastic series and freeze a flight dump naming the steps
+                # skipped and the step actually served
+                self._record_corruption_fallback(corrupt_steps, s, last_err)
+            return out
         raise CheckpointCorruptionError(
             f"no intact checkpoint in {self.directory} "
             f"(tried steps {steps}): {last_err}")
+
+    def _record_corruption_fallback(self, corrupt_steps: List[int],
+                                    loaded_step: int,
+                                    err: Optional[Exception]):
+        """First-class observability for the newest-intact fallback:
+        ``ckpt_corruption_fallbacks_total`` counts every snapshot skipped,
+        and one flight dump per load episode records which steps were
+        corrupt and which step was loaded instead. Exception-contained —
+        the fallback load must win even if telemetry fails."""
+        try:
+            from ..observability.flight import flight_recorder
+            from ..observability.metrics import default_registry
+
+            default_registry().counter(
+                "ckpt_corruption_fallbacks_total",
+                "corrupt snapshots skipped by the newest-intact fallback",
+                ("directory",)).inc(len(corrupt_steps),
+                                    directory=self.directory)
+            flight_recorder().dump(
+                "ckpt_corruption_fallback",
+                extra={"directory": self.directory,
+                       "corrupt_steps": list(corrupt_steps),
+                       "loaded_step": int(loaded_step),
+                       "error": f"{type(err).__name__}: {err}"
+                       if err is not None else None})
+        except Exception:
+            pass
 
     def _load_step(self, step: int, mesh=None, verify: bool = True):
         d = os.path.join(self.directory, f"step_{step}")

@@ -72,6 +72,10 @@ _LOWER = ("overhead", "ttft", "latency", "_ms", "recovery_s",
 _MAGNITUDE = ("drift", "est_vs_measured")
 _COUNT_MAX = ("silent_drops", "dropped_requests", "inflight_failures",
               "admitted_killed", "writes_lost",
+              # replicated checkpoint plane (r19): a manifest-committed
+              # snapshot that cannot be reassembled after disk loss is a
+              # durability-contract violation — must stay zero
+              "snapshots_lost",
               # concurrency-doctor finding counts (r18): a PR that
               # re-introduces a HIGH/MEDIUM host-race finding regresses
               # past the lineage maximum and gates

@@ -14,11 +14,23 @@ Three layers, wired through the training stack:
   :class:`FaultSchedule`\\ s firing named faults at exact trigger counts
   through the store/checkpoint/engine/router/replica/rank seams, so every
   chaos scenario replays bit-identically without process signals.
+* :mod:`.durability` — the replicated checkpoint data plane (r19): each
+  elastic rank durably writes its own shard snapshot, replicates it to K
+  peer ranks over the KV plane, and the snapshot becomes visible only
+  when a manifest commits to the quorum store; scrub/quarantine/repair
+  keep the redundancy factor, and an empty-disk replacement rank
+  recovers entirely from peer replicas.
 
 Parity: FLAGS_check_nan_inf, incubate.checkpoint.auto_checkpoint and the
 fleet elastic etcd heartbeats, redesigned as a TPU-native runtime (see
 PARITY.md "Fault tolerance").
 """
+from .durability import (  # noqa: F401
+    BlobCorruptionError,
+    BlobTransport,
+    CheckpointDataPlane,
+    DurabilityConfig,
+)
 from .elastic_trainer import ElasticDPTrainer  # noqa: F401
 from .inject import (  # noqa: F401
     FaultSchedule,
@@ -58,4 +70,6 @@ __all__ = [
     "FaultSchedule", "FaultSpec",
     "InjectedFault", "InjectedDeath", "InjectedCrash",
     "ElasticDPTrainer",
+    "DurabilityConfig", "CheckpointDataPlane", "BlobTransport",
+    "BlobCorruptionError",
 ]

@@ -19,7 +19,14 @@ whole RANK preempted mid-run:
 * rank 0 periodically gathers the slot shards and writes ONE global
   snapshot stamped with the dp layout
   (``CheckpointManager.save(layout=...)``) — the snapshot is
-  world-size-agnostic;
+  world-size-agnostic; with ``durability=`` (r19) the single writer is
+  replaced by the replicated checkpoint data plane
+  (:mod:`~paddle_tpu.resilience.durability`): each rank durably writes
+  its OWN shard snapshot locally, pushes CRC-stamped replicas to K peer
+  ranks, and the snapshot becomes visible only when a manifest commits
+  to the (quorum-replicated) store — so losing a rank AND its disk
+  costs nothing as long as redundancy holds, and a replacement rank
+  with an empty disk recovers entirely from peer replicas;
 * when a rank's heartbeat lapses mid-collective (:class:`RankFailure`),
   survivors bump the rendezvous generation, agree on the new world size,
   reshard the newest INTACT snapshot
@@ -58,6 +65,7 @@ from ..framework.checkpoint import (
     shard_slice,
     unshard,
 )
+from .durability import CheckpointDataPlane, DurabilityConfig
 
 __all__ = ["ElasticDPTrainer"]
 
@@ -85,7 +93,8 @@ class ElasticDPTrainer:
                  save_every: int = 1, keep_max: int = 10,
                  step_timeout: float = 60.0, rendezvous_timeout: float = 60.0,
                  on_step: Optional[Callable] = None,
-                 on_event: Optional[Callable[[str], None]] = None):
+                 on_event: Optional[Callable[[str], None]] = None,
+                 durability: Optional[DurabilityConfig] = None):
         if not hasattr(manager.store, "scan"):
             raise TypeError(
                 "ElasticDPTrainer needs a KV-plane store (_TcpStore via "
@@ -93,7 +102,17 @@ class ElasticDPTrainer:
                 "does membership")
         self.manager = manager
         self.collective = ElasticCollective(manager.store, manager.node_id)
-        self.ckpt = CheckpointManager(ckpt_dir, keep_max=keep_max)
+        if durability is not None:
+            # replicated data plane (r19): ckpt_dir is THIS RANK'S private
+            # directory; each rank persists its own shard, replicates to K
+            # peers and the snapshot is visible only via a committed
+            # manifest in the (quorum-replicated) store
+            self.plane: Optional[CheckpointDataPlane] = CheckpointDataPlane(
+                manager.store, manager.node_id, ckpt_dir, durability)
+            self.ckpt: Optional[CheckpointManager] = None
+        else:
+            self.plane = None
+            self.ckpt = CheckpointManager(ckpt_dir, keep_max=keep_max)
         self.grad_fn = grad_fn
         self.init_params = init_params
         self.lr = float(lr)
@@ -188,6 +207,16 @@ class ElasticDPTrainer:
         if self.rank == 0:
             if prefer is not None:
                 chosen: Optional[int] = prefer
+            elif self.plane is not None:
+                # replicated plane: the newest COMMITTED manifest whose
+                # every shard still has a live holder — the cluster-level
+                # newest-intact rule (an uncommitted snapshot was never
+                # visible, a coverage-lost one is walked past)
+                try:
+                    live = set(self.manager.store.nodes())
+                except OSError:
+                    live = set(self.collective.members)
+                chosen = self.plane.newest_recoverable(live)
             else:
                 try:
                     state, metadata = self.ckpt.load()
@@ -241,12 +270,22 @@ class ElasticDPTrainer:
             self.step = 0
             self.on_event("restore: no snapshot, starting from init")
             return
-        if cache is not None and cache[0] == snapshot_step:
+        if self.plane is not None:
+            # assemble from local blobs + peer replicas (a replacement
+            # rank with an EMPTY disk recovers entirely over the wire),
+            # CRC-verified against the committed manifest
+            state, layout = self.plane.load_step(
+                snapshot_step, timeout=self.rendezvous_timeout,
+                live_nodes=list(self.collective.members))
+            _meta = {"world": next((e.get("world")
+                                    for e in layout.values()), None)}
+        elif cache is not None and cache[0] == snapshot_step:
             state, full_meta, _meta = cache[1], cache[2], cache[3]
+            layout = full_meta.get("layout", {})
         else:
             state, _meta = self.ckpt.load(step=snapshot_step)
             full_meta = self.ckpt.last_loaded_meta or {}
-        layout = full_meta.get("layout", {})
+            layout = full_meta.get("layout", {})
         local = reshard_train_state(state, layout, self.world, self.rank)
         self.params = {n: np.array(a) for n, a in state["params"].items()}
         self._check_shardable(self.params)
@@ -290,6 +329,22 @@ class ElasticDPTrainer:
         if f is not None and f.kind == "kill":
             self.manager.halt_heartbeat()
             raise f.build_exception()
+        # the double failure the replicated plane exists for: the rank
+        # dies AND its local checkpoint storage is gone (preemption with
+        # local SSD). Heartbeats halt first (peers must see TTL expiry),
+        # the directory is wiped like a reclaimed disk, and InjectedDeath
+        # unwinds the rank before this step's gradients ever publish.
+        f = _inject_fire("ckpt.disk.loss", rank=rank, step=s,
+                         node=self._node)
+        if f is not None and f.kind == "kill":
+            self.manager.halt_heartbeat()
+            if self.plane is not None:
+                self.plane.wipe()
+            elif self.ckpt is not None:
+                import shutil as _shutil
+
+                _shutil.rmtree(self.ckpt.directory, ignore_errors=True)
+            raise f.build_exception()
         fr = flight_recorder()
         if fr.armed or obstrace.tracing_enabled():
             fr.note(step=s)
@@ -317,20 +372,37 @@ class ElasticDPTrainer:
             v = self.momentum * self.velocity[n] + g
             self.velocity[n] = v
             out[f"p:{n}"] = self.params[n][lo:hi] - self.lr * v
-            if save_now:
+            if save_now and self.plane is None:
+                # the single-writer path gathers every velocity shard to
+                # rank 0; the replicated plane does NOT — each rank saves
+                # its own shard locally, so the save costs zero extra
+                # allgather bandwidth
                 out[f"v:{n}"] = v
         shard_blobs = self.collective.allgather(
             f"p{s}", pack_arrays(out), timeout=self.step_timeout)
         shards = [unpack_arrays(b) for b in shard_blobs]
         for n in self.params:
             self.params[n] = unshard([t[f"p:{n}"] for t in shards])
-        if save_now and rank == 0:
-            velocity = {n: unshard([t[f"v:{n}"] for t in shards])
-                        for n in self.params}
-            self.ckpt.save(s, {"params": dict(self.params),
-                               "velocity": velocity, "step": s},
-                           metadata={"world": world},
-                           layout=self._layout())
+        if save_now:
+            if self.plane is not None:
+                # every rank persists {replicated params, OWN velocity
+                # shard}; the worker replicates to K peers and rank 0
+                # commits the manifest once every shard reports durable
+                # + confirmed — visibility is the manifest, not the file
+                self.plane.save_shard(
+                    s, {"params": dict(self.params),
+                        "velocity": dict(self.velocity), "step": s},
+                    rank=rank, world=world,
+                    members=list(self.collective.members),
+                    layout=self._layout(),
+                    generation=int(self.collective.generation))
+            elif rank == 0:
+                velocity = {n: unshard([t[f"v:{n}"] for t in shards])
+                            for n in self.params}
+                self.ckpt.save(s, {"params": dict(self.params),
+                                   "velocity": velocity, "step": s},
+                               metadata={"world": world},
+                               layout=self._layout())
         return mean_loss
 
     # -- driver ----------------------------------------------------------
@@ -392,4 +464,6 @@ class ElasticDPTrainer:
         return self.history
 
     def close(self):
+        if self.plane is not None:
+            self.plane.close()
         self.manager.exit()

@@ -104,6 +104,20 @@ POINTS = (
     "store.election.start",
     "store.election.won",
     "checkpoint.write",
+    # replicated checkpoint data plane (r19): `ckpt.replica.push` fires
+    # per (step, shard, peer) push attempt on the plane's pusher thread
+    # (drop = the push is skipped, garbage/torn = the pushed bytes are
+    # corrupted/truncated so the receiver's CRC check rejects them —
+    # the owner re-pushes after the confirm timeout); `ckpt.scrub.corrupt`
+    # fires per resident blob in the scrub pass (kind corrupt/garbage =
+    # a byte of the FILE is flipped first, so the scrubber detects rot it
+    # planted itself — deterministic bit-rot); `ckpt.disk.loss` fires in
+    # the elastic rank step (kind `kill` = halt heartbeats, WIPE this
+    # rank's checkpoint directory, then die of InjectedDeath — the
+    # preemption-with-local-SSD double failure)
+    "ckpt.replica.push",
+    "ckpt.scrub.corrupt",
+    "ckpt.disk.loss",
     "engine.tick",
     "replica.tick",
     "serving.pages.exhausted",

@@ -77,7 +77,9 @@ class PreemptionGuard:
                  deadline: Optional[float] = None, grace: float = 30.0,
                  exit_code: Optional[int] = None,
                  watchdog_interval: float = 1.0,
-                 on_preempt: Optional[Callable[[], None]] = None):
+                 on_preempt: Optional[Callable[[], None]] = None,
+                 publisher: Optional[Callable[[int], Any]] = None,
+                 publish_deadline_s: float = 2.0):
         self.manager = manager
         self.state_fn = state_fn
         self.signals = tuple(signals)
@@ -88,6 +90,14 @@ class PreemptionGuard:
         self.exit_code = exit_code
         self.watchdog_interval = float(watchdog_interval)
         self.on_preempt = on_preempt
+        # replicated-plane hook (r19): after the synchronous local write,
+        # a best-effort manifest-commit/replica-push runs in a worker
+        # thread joined with a hard cap — a stalled store may cost the
+        # cluster the final-step replicas, but it can NEVER delay the
+        # exit-101 relaunch protocol
+        self.publisher = publisher
+        self.publish_deadline_s = float(publish_deadline_s)
+        self.publish_completed: Optional[bool] = None
         self.preempted = False
         self.saved_step: Optional[int] = None
         self._latest: Optional[Tuple[int, Any]] = None  # (step, state|thunk)
@@ -199,10 +209,47 @@ class PreemptionGuard:
                 self.manager.wait()
                 self._saved = True
                 self.saved_step = step
+                # best-effort replica push + manifest commit so the final
+                # step is recoverable by PEERS even if this disk never
+                # comes back — deadline-capped AFTER the durable local
+                # write, so a stalled store cannot hold the exit hostage
+                self._publish_capped(step)
             finally:
                 self._saving = False
                 self._saving_thread = None
             return True
+
+    def _publish_capped(self, step: int):
+        """Run ``publisher(step)`` on a daemon thread joined with the
+        configured cap. The thread may outlive the join (a store stalled
+        mid-RPC keeps it blocked) — that is the point: the exit protocol
+        proceeds; the orphan either finishes in the grace window or dies
+        with the process, and resume falls back to peer replicas of the
+        previous manifest."""
+        if self.publisher is None:
+            return
+        done = threading.Event()
+
+        def _run():
+            try:
+                self.publisher(step)
+            except Exception as e:
+                warnings.warn(
+                    f"PreemptionGuard: emergency publish failed "
+                    f"({type(e).__name__}: {e})", RuntimeWarning)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_run, daemon=True)
+        t.start()
+        t.join(self.publish_deadline_s)
+        self.publish_completed = done.is_set()
+        if not self.publish_completed:
+            warnings.warn(
+                f"PreemptionGuard: emergency publish still in flight at "
+                f"the {self.publish_deadline_s}s cap; proceeding with the "
+                "exit protocol (peers recover from the previous manifest)",
+                RuntimeWarning)
 
     # -- signal + watchdog wiring ----------------------------------------
     def _handler(self, signum, frame):

@@ -1,0 +1,921 @@
+"""Replicated checkpoint data plane (ISSUE 15, r19).
+
+Fast tier, all deterministic (no signals, no SIGKILL):
+
+* state blob pack/unpack + global reassembly, chunked blob transport over
+  the KV plane (head-last commit, CRC rejection, bandwidth gate),
+* the local blob store's atomic-rename + CRC-sidecar protocol,
+* save → replica push → manifest commit end to end; the visibility rule
+  (an incomplete multi-rank snapshot is NEVER observable as a manifest),
+* push-fault recovery (drop / garbage / torn re-pushed after the confirm
+  timeout) with two-run replay certificates,
+* scrub & repair: injected bit-rot is quarantined (renamed, never
+  deleted), counted, flight-dumped and re-replicated from peers,
+* the HEADLINE chaos twin: kill one of 3 dp ranks AND wipe its checkpoint
+  directory mid-run → survivors recover from the newest committed
+  manifest, a replacement rank with an EMPTY disk joins the recovery
+  rendezvous and pulls every shard from peer replicas, and the trajectory
+  is bit-identical to an uninterrupted run; identical fired logs across
+  two runs; zero committed manifests lost or torn,
+* elastic world GROWTH: a dp=2 cohort grows to dp=3 when a replacement
+  joins mid-run; the post-growth trajectory is bit-identical to a fresh
+  dp=3 run resumed from the same manifest,
+* PreemptionGuard's deadline-capped emergency publish (a stalled
+  replicated store — ``store.replica.append`` stall — cannot delay the
+  exit protocol past the cap),
+* the CheckpointManager._prune audit: pruning can never delete the newest
+  INTACT snapshot even when the newest published snapshot is torn,
+* the corrupt-snapshot fallback's first-class telemetry (counter + flight
+  dump naming corrupt and loaded steps).
+"""
+import contextlib
+import json
+import os
+import shutil
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic.manager import (
+    ElasticManager,
+    _TcpStore,
+)
+from paddle_tpu.distributed.fleet.utils.http_server import KVServer
+from paddle_tpu.framework.checkpoint import (
+    CheckpointManager,
+    durable_write_bytes,
+)
+from paddle_tpu.observability.flight import flight_recorder
+from paddle_tpu.observability.metrics import default_registry
+from paddle_tpu.resilience import (
+    BlobCorruptionError,
+    BlobTransport,
+    CheckpointDataPlane,
+    DurabilityConfig,
+    FaultSchedule,
+    InjectedDeath,
+    PreemptionGuard,
+)
+from paddle_tpu.resilience.durability import (
+    _BandwidthGate,
+    assemble_global_state,
+    pack_state,
+    unpack_state,
+)
+from paddle_tpu.resilience.elastic_trainer import ElasticDPTrainer
+
+
+@pytest.fixture()
+def kv():
+    srv = KVServer().start()
+    yield f"127.0.0.1:{srv.port}"
+    srv.stop()
+
+
+def _store(addr, job="job", ttl=2.0):
+    return _TcpStore(addr, job, ttl=ttl, retries=1)
+
+
+def _fast_cfg(**kw):
+    kw.setdefault("replicas", 1)
+    kw.setdefault("push_confirm_timeout_s", 0.25)
+    kw.setdefault("manifest_timeout_s", 10.0)
+    kw.setdefault("pull_hop_timeout_s", 1.0)
+    return DurabilityConfig(**kw)
+
+
+def _wait(pred, timeout=15.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# =====================================================================
+# state blobs + transport
+# =====================================================================
+class TestStateBlobs:
+    def test_pack_unpack_roundtrip(self):
+        state = {"params": {"w": np.arange(6.0).reshape(2, 3),
+                            "b": np.ones((3,), np.float32)},
+                 "velocity": {"w": np.zeros((2, 3))},
+                 "step": 7, "note": "hello"}
+        out = unpack_state(pack_state(state))
+        np.testing.assert_array_equal(out["params"]["w"],
+                                      state["params"]["w"])
+        assert out["params"]["b"].dtype == np.float32
+        assert out["step"] == 7 and out["note"] == "hello"
+
+    def test_assemble_concatenates_layout_paths_only(self):
+        layout = {"/velocity/w": {"axis": 0, "world": 2}}
+        s0 = {"params": {"w": np.arange(4.0)}, "velocity": {"w": np.ones((1, 2))},
+              "step": 3}
+        s1 = {"params": {"w": np.arange(4.0)}, "velocity": {"w": np.ones((1, 2)) * 2},
+              "step": 3}
+        g = assemble_global_state([s0, s1], layout)
+        np.testing.assert_array_equal(g["velocity"]["w"],
+                                      np.asarray([[1.0, 1.0], [2.0, 2.0]]))
+        np.testing.assert_array_equal(g["params"]["w"], np.arange(4.0))
+        assert g["step"] == 3
+
+
+class TestBlobTransport:
+    def test_roundtrip_and_chunk_bound(self, kv):
+        st = _store(kv)
+        tx = BlobTransport(st, chunk_bytes=64)
+        data = os.urandom(500)
+        head = tx.put("blob:a", data)
+        assert head["chunks"] > 1 and head["nbytes"] == 500
+        # every stored chunk record respects the configured bound
+        for k, (v, _age) in st.scan(prefix="blob:a.c").items():
+            assert len(v) <= tx.chunk_chars
+        assert tx.get("blob:a") == data
+        tx.delete("blob:a")
+        assert tx.get("blob:a") is None
+        assert st.scan(prefix="blob:a") == {}
+
+    def test_head_last_commit_point(self, kv):
+        """Chunks without a head are invisible — a reader can never
+        observe a half-written transfer."""
+        st = _store(kv)
+        tx = BlobTransport(st, chunk_bytes=64)
+        st.put("blob:b.c0", "QUJD")  # chunks present, head absent
+        assert tx.get("blob:b") is None
+
+    def test_corrupt_transfer_rejected(self, kv):
+        st = _store(kv)
+        tx = BlobTransport(st, chunk_bytes=1 << 16)
+        data = b"x" * 100
+        tx.put("blob:c", data)
+        # rot one chunk in place: the head's CRC convicts it
+        st.put("blob:c.c0", "Z" + st.get("blob:c.c0")[1:])
+        with pytest.raises(BlobCorruptionError):
+            tx.get("blob:c")
+
+    def test_bandwidth_gate_bounds_inflight(self):
+        gate = _BandwidthGate(100)
+        gate.acquire(80)
+        assert gate.inflight == 80
+        blocked = threading.Event()
+
+        def second():
+            gate.acquire(50)  # 80+50 > 100: must wait
+            blocked.set()
+            gate.release(50)
+
+        t = threading.Thread(target=second, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        assert not blocked.is_set()
+        gate.release(80)
+        t.join(5)
+        assert blocked.is_set() and gate.inflight == 0
+        # an oversize blob is admitted ALONE rather than deadlocking
+        gate.acquire(500)
+        gate.release(500)
+
+
+class TestLocalBlobStore:
+    def test_durable_write_bytes_atomic(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        durable_write_bytes(p, b"one")
+        durable_write_bytes(p, b"two")
+        assert open(p, "rb").read() == b"two"
+        assert [n for n in os.listdir(tmp_path)
+                if n.startswith(".tmp_")] == []
+
+    def test_write_read_verify_and_quarantine(self, kv, tmp_path):
+        plane = CheckpointDataPlane(_store(kv), "n0", str(tmp_path),
+                                    _fast_cfg())
+        try:
+            plane._write_local(3, 0, b"payload", source="own")
+            assert plane._read_local(3, 0) == b"payload"
+            assert (3, 0) in plane.resident()
+            # rot the file: read raises, quarantine renames (never deletes)
+            path = plane._blob_path(3, 0)
+            with open(path, "r+b") as f:
+                f.write(b"XX")
+            with pytest.raises(BlobCorruptionError):
+                plane._read_local(3, 0)
+            plane._quarantine(3, 0)
+            assert (3, 0) not in plane.resident()
+            q = os.listdir(plane.quarantine_dir)
+            assert any(n.startswith("b_") and ".npz.q" in n for n in q)
+        finally:
+            plane.close()
+
+
+# =====================================================================
+# plane protocol: save -> push -> manifest commit; visibility rule
+# =====================================================================
+def _mk_state(step, rank, rows=2):
+    return {"params": {"w": np.arange(8.0).reshape(4, 2)},
+            "velocity": {"w": np.full((rows, 2), float(rank + 1))},
+            "step": step}
+
+
+_LAYOUT2 = {"/velocity/w": {"axis": 0, "world": 2}}
+
+
+class TestPlaneProtocol:
+    def test_save_replicate_commit_and_empty_disk_recovery(self, kv, tmp_path):
+        members = ["node_0", "node_1"]
+        p0 = CheckpointDataPlane(_store(kv), "node_0",
+                                 str(tmp_path / "r0"), _fast_cfg())
+        p1 = CheckpointDataPlane(_store(kv), "node_1",
+                                 str(tmp_path / "r1"), _fast_cfg())
+        try:
+            p0.save_shard(3, _mk_state(3, 0), rank=0, world=2,
+                          members=members, layout=_LAYOUT2)
+            p1.save_shard(3, _mk_state(3, 1), rank=1, world=2,
+                          members=members, layout=_LAYOUT2)
+            _wait(lambda: p0.manifest(3) is not None, msg="manifest commit")
+            m = p0.manifest(3)
+            assert sorted(m["shards"]) == ["0", "1"]
+            assert m["shards"]["0"]["owner"] == "node_0"
+            assert m["shards"]["0"]["replicas"] == ["node_1"]
+            # replicas became resident on the peers' DISKS
+            _wait(lambda: (3, 1) in p0.resident(), msg="replica resident")
+            assert (3, 0) in p1.resident()
+            # a replacement rank with an EMPTY disk assembles the global
+            # snapshot entirely from peer replicas, CRC-checked
+            p2 = CheckpointDataPlane(_store(kv), "node_2",
+                                     str(tmp_path / "r2"), _fast_cfg())
+            try:
+                state, layout = p2.load_step(3, timeout=10)
+                np.testing.assert_array_equal(
+                    state["velocity"]["w"],
+                    np.asarray([[1.0, 1.0], [1.0, 1.0],
+                                [2.0, 2.0], [2.0, 2.0]]))
+                assert layout == _LAYOUT2
+                # recovery restored redundancy: the pulled copies are now
+                # resident and announced
+                assert {(3, 0), (3, 1)} <= set(p2.resident())
+            finally:
+                p2.close()
+        finally:
+            p0.close()
+            p1.close()
+
+    def test_incomplete_snapshot_never_observable(self, kv, tmp_path):
+        """Only rank 0 of a world-2 snapshot saves: NO manifest may ever
+        appear — the commit requires every shard's ready record."""
+        p0 = CheckpointDataPlane(
+            _store(kv), "node_0", str(tmp_path / "r0"),
+            _fast_cfg(manifest_timeout_s=0.5))
+        try:
+            p0.save_shard(5, _mk_state(5, 0), rank=0, world=2,
+                          members=["node_0", "node_1"], layout=_LAYOUT2)
+            time.sleep(1.2)  # past the commit deadline
+            assert p0.manifest_steps() == []
+            assert p0.newest_recoverable() is None
+        finally:
+            p0.close()
+
+    def test_stale_ready_records_cannot_poison_recommit(self, kv, tmp_path):
+        """Shard-ready records left behind by an ABANDONED commit must
+        never satisfy a later commit of the same step number (the step is
+        re-executed after an elastic regroup, under a HIGHER rendezvous
+        generation): the manifest would carry CRCs matching no surviving
+        data, and every recovery pull would then fail its manifest CRC
+        check. The generation fence holds the commit until the
+        re-executed save publishes fresh records."""
+        members = ["node_0", "node_1"]
+        admin = _store(kv)
+        stale_crc = 1234567
+        for j in (0, 1):
+            admin.put(f"ckrdy:9:{j}",
+                      json.dumps({"owner": members[j], "replicas": [],
+                                  "crc": stale_crc, "generation": 1,
+                                  "nbytes": 11}))
+        p0 = CheckpointDataPlane(_store(kv), "node_0",
+                                 str(tmp_path / "r0"), _fast_cfg())
+        p1 = CheckpointDataPlane(_store(kv), "node_1",
+                                 str(tmp_path / "r1"), _fast_cfg())
+        try:
+            p0.save_shard(9, _mk_state(9, 0), rank=0, world=2,
+                          members=members, layout=_LAYOUT2, generation=2)
+            p1.save_shard(9, _mk_state(9, 1), rank=1, world=2,
+                          members=members, layout=_LAYOUT2, generation=2)
+            _wait(lambda: p0.manifest(9) is not None, msg="recommit")
+            m = p0.manifest(9)
+            assert m["generation"] == 2
+            assert all(int(info["crc"]) != stale_crc
+                       for info in m["shards"].values())
+            # the committed snapshot actually assembles, CRC-clean
+            state, _layout = p0.load_step(9, timeout=10)
+            np.testing.assert_array_equal(
+                state["velocity"]["w"],
+                np.asarray([[1.0, 1.0], [1.0, 1.0],
+                            [2.0, 2.0], [2.0, 2.0]]))
+        finally:
+            p0.close()
+            p1.close()
+
+    def test_retired_manifests_gcd_and_blobs_pruned_on_every_rank(
+            self, kv, tmp_path):
+        """Rotation past ``keep_manifests``: the committer DELETES the
+        retired manifests (and residency receipts) from the store, and
+        every rank — replica holders included, not just the committer —
+        prunes the backing blobs; retained snapshots keep loading."""
+        members = ["node_0", "node_1"]
+        cfg = lambda: _fast_cfg(keep_manifests=2)  # noqa: E731
+        p0 = CheckpointDataPlane(_store(kv), "node_0",
+                                 str(tmp_path / "r0"), cfg())
+        p1 = CheckpointDataPlane(_store(kv), "node_1",
+                                 str(tmp_path / "r1"), cfg())
+        try:
+            for s in (1, 2, 3, 4, 5):
+                p0.save_shard(s, _mk_state(s, 0), rank=0, world=2,
+                              members=members, layout=_LAYOUT2)
+                p1.save_shard(s, _mk_state(s, 1), rank=1, world=2,
+                              members=members, layout=_LAYOUT2)
+                _wait(lambda s=s: p0.manifest(s) is not None,
+                      msg=f"manifest {s}")
+            _wait(lambda: p0.manifest_steps() == [4, 5],
+                  msg="manifest retirement")
+            # no stale advertisement: receipts for retired steps are gone
+            assert p0.store.scan(keys_only=True, prefix="ckres:1:") == {}
+            # blobs pruned on BOTH ranks once the worker's prune tick ran
+            _wait(lambda: {s for s, _j in p0.resident()} <= {4, 5},
+                  msg="committer blobs pruned")
+            _wait(lambda: {s for s, _j in p1.resident()} <= {4, 5},
+                  msg="replica-holder blobs pruned")
+            # retained snapshots still assemble
+            state, _ = p1.load_step(5, timeout=10)
+            assert int(state["step"]) == 5
+        finally:
+            p0.close()
+            p1.close()
+
+    def test_coverage_lost_manifest_walked_past(self, kv, tmp_path):
+        """The cluster-level newest-intact rule: a manifest whose shard
+        has NO live holder is walked past; the newest manifest with full
+        live coverage wins."""
+        st = _store(kv)
+        plane = CheckpointDataPlane(st, "node_0", str(tmp_path),
+                                    _fast_cfg())
+        try:
+            m1 = {"step": 1, "world": 2, "layout": {}, "shards": {
+                "0": {"owner": "node_0", "replicas": ["node_1"],
+                      "crc": 1, "nbytes": 1},
+                "1": {"owner": "node_1", "replicas": ["node_0"],
+                      "crc": 2, "nbytes": 1}}}
+            # step 2 committed with shard 1 resident ONLY on node_1
+            m2 = {"step": 2, "world": 2, "layout": {}, "shards": {
+                "0": {"owner": "node_0", "replicas": [],
+                      "crc": 3, "nbytes": 1},
+                "1": {"owner": "node_1", "replicas": [],
+                      "crc": 4, "nbytes": 1}}}
+            st.put("ckmf:%012d" % 1, json.dumps(m1))
+            st.put("ckmf:%012d" % 2, json.dumps(m2))
+            # node_1 died: step 2's shard 1 has no live holder left, but
+            # step 1's shard 1 replica lives on node_0
+            assert plane.newest_recoverable(["node_0"]) == 1
+            # with node_1 alive the newest manifest wins
+            assert plane.newest_recoverable(["node_0", "node_1"]) == 2
+            # the asking node always counts itself live (it IS running)
+            assert plane.newest_recoverable([]) == 1
+        finally:
+            plane.close()
+
+
+class TestPushFaults:
+    def _run_leg(self, tmp_path, tag, kind):
+        srv = KVServer().start()
+        sched = FaultSchedule(seed=3).add(
+            "ckpt.replica.push", kind, at=1, match={"peer": "node_1"})
+        try:
+            with sched.scope():
+                p0 = CheckpointDataPlane(
+                    _store(f"127.0.0.1:{srv.port}"), "node_0",
+                    str(tmp_path / f"r0_{tag}"), _fast_cfg())
+            p1 = CheckpointDataPlane(
+                _store(f"127.0.0.1:{srv.port}"), "node_1",
+                str(tmp_path / f"r1_{tag}"), _fast_cfg())
+            try:
+                p0.save_shard(4, _mk_state(4, 0), rank=0, world=2,
+                              members=["node_0", "node_1"], layout=_LAYOUT2)
+                p1.save_shard(4, _mk_state(4, 1), rank=1, world=2,
+                              members=["node_0", "node_1"], layout=_LAYOUT2)
+                _wait(lambda: p0.manifest(4) is not None,
+                      msg=f"manifest after {kind} push fault")
+                _wait(lambda: (4, 0) in p1.resident(),
+                      msg="replica resident after re-push")
+                # the replica the peer persisted is the CLEAN bytes
+                assert zlib.crc32(p1._read_local(4, 0)) == int(
+                    p0.manifest(4)["shards"]["0"]["crc"])
+            finally:
+                p0.close()
+                p1.close()
+        finally:
+            srv.stop()
+        return sched.fired_log()
+
+    @pytest.mark.parametrize("kind", ["drop", "garbage", "torn"])
+    def test_faulted_push_repushed_and_replay_deterministic(
+            self, tmp_path, kind):
+        """A dropped/corrupted/truncated push costs one confirm timeout,
+        never the snapshot: the owner re-pushes, the receiver CRC-gates,
+        and the manifest still commits. Two runs fire identically."""
+        log_a = self._run_leg(tmp_path, f"{kind}_a", kind)
+        log_b = self._run_leg(tmp_path, f"{kind}_b", kind)
+        assert log_a == log_b
+        assert [(f["point"], f["kind"], f["count"]) for f in log_a] == [
+            ("ckpt.replica.push", kind, 1)]
+
+
+# =====================================================================
+# scrub & repair
+# =====================================================================
+class TestScrubRepair:
+    def test_injected_bitrot_quarantined_counted_dumped_repaired(
+            self, kv, tmp_path):
+        members = ["node_0", "node_1"]
+        p0 = CheckpointDataPlane(_store(kv), "node_0",
+                                 str(tmp_path / "r0"), _fast_cfg())
+        p1 = CheckpointDataPlane(_store(kv), "node_1",
+                                 str(tmp_path / "r1"), _fast_cfg())
+        try:
+            p0.save_shard(2, _mk_state(2, 0), rank=0, world=2,
+                          members=members, layout=_LAYOUT2)
+            p1.save_shard(2, _mk_state(2, 1), rank=1, world=2,
+                          members=members, layout=_LAYOUT2)
+            _wait(lambda: p0.manifest(2) is not None
+                  and (2, 1) in p0.resident(), msg="replicated snapshot")
+            c0 = p0._c_scrub.value(node="node_0")
+            # deterministic bit-rot on the FIRST resident blob only
+            sched = FaultSchedule(seed=5).add(
+                "ckpt.scrub.corrupt", "corrupt", at=1)
+            with sched.scope():
+                found = p0.scrub_once()
+            assert found["corrupt"] == 1 and found["checked"] >= 2
+            assert found["repaired"] == 1
+            assert p0._c_scrub.value(node="node_0") == c0 + 1
+            # quarantine holds the forensic copy (renamed, not deleted)
+            assert any(".npz.q" in n
+                       for n in os.listdir(p0.quarantine_dir))
+            # the flight recorder froze the episode
+            dump = flight_recorder().last
+            assert dump is not None
+            assert dump["reason"] == "ckpt_scrub_corruption"
+            assert dump["extra"]["node"] == "node_0"
+            # repair restored the clean copy from the peer: CRC matches
+            # the manifest again and BOTH blobs are resident + intact
+            m = p0.manifest(2)
+            for j in (0, 1):
+                data = p0._read_local(2, j)
+                assert data is not None
+                assert zlib.crc32(data) == int(m["shards"][str(j)]["crc"])
+        finally:
+            p0.close()
+            p1.close()
+
+    def test_scrub_never_touches_intact_copies(self, kv, tmp_path):
+        plane = CheckpointDataPlane(_store(kv), "n0", str(tmp_path),
+                                    _fast_cfg())
+        try:
+            plane._write_local(1, 0, b"alpha", source="own")
+            plane._write_local(2, 0, b"beta", source="own")
+            found = plane.scrub_once()
+            assert found == {"checked": 2, "corrupt": 0, "repaired": 0}
+            assert plane._read_local(1, 0) == b"alpha"
+            assert plane._read_local(2, 0) == b"beta"
+            assert os.listdir(plane.quarantine_dir) == []
+        finally:
+            plane.close()
+
+
+# =====================================================================
+# elastic cohort harness (threads; per-rank private checkpoint dirs)
+# =====================================================================
+_W_STAR = np.arange(12.0).reshape(4, 3) / 10.0
+
+
+def _dp_grad_fn(params, step, rank, world):
+    rng = np.random.default_rng(500000 + 1000 * step + 10 * world + rank)
+    X = rng.standard_normal((8, 4))
+    E = X @ params["w"] + params["b"] - X @ _W_STAR
+    loss = float((E ** 2).mean())
+    return loss, {"w": 2 * X.T @ E / E.size,
+                  "b": 2 * E.sum(axis=0) / E.size}
+
+
+def _dp_init_params():
+    return {"w": np.zeros((4, 3)), "b": np.zeros((3,))}
+
+
+class _Cohort:
+    """Drive ElasticDPTrainer rank THREADS (durability mode, per-rank
+    dirs) over one KV server; ranks can be added mid-run (growth /
+    replacement)."""
+
+    def __init__(self, addr, job, base_dir, total, ttl=1.2):
+        self.addr = addr
+        self.job = job
+        self.base = base_dir
+        self.total = total
+        self.ttl = ttl
+        self.hist = {}
+        self.events = {}
+        self.errors = {}
+        self.threads = {}
+
+    def start_rank(self, idx, node, *, schedule=None, resume_step=None,
+                   wait_world=None):
+        self.hist.setdefault(node, [])
+        self.events.setdefault(node, [])
+
+        def run():
+            st = _TcpStore(self.addr, self.job, ttl=self.ttl, retries=1)
+            mgr = ElasticManager(store=st)
+            mgr.endpoint = f"127.0.0.1:{7800 + idx}"
+            mgr.node_id = node
+            tr = ElasticDPTrainer(
+                mgr, os.path.join(self.base, node), _dp_grad_fn,
+                _dp_init_params, lr=0.3, momentum=0.9, min_ranks=1,
+                step_timeout=60, rendezvous_timeout=60,
+                durability=_fast_cfg(),
+                on_step=lambda s, w, l: self.hist[node].append(
+                    (s, w, np.float64(l).hex())),
+                on_event=self.events[node].append)
+            ctx = (schedule.scope() if schedule is not None
+                   else contextlib.nullcontext())
+            try:
+                with ctx:
+                    tr.run(self.total, resume_step=resume_step,
+                           wait_world=wait_world)
+            except InjectedDeath:
+                self.events[node].append("DIED")
+                return
+            except Exception as e:  # pragma: no cover - surfaced by join
+                self.errors[node] = e
+                raise
+            tr.close()
+
+        t = threading.Thread(target=run, daemon=True)
+        self.threads[node] = t
+        t.start()
+        return t
+
+    def join(self, timeout=240):
+        for node, t in self.threads.items():
+            t.join(timeout)
+            assert not t.is_alive(), f"rank thread {node} hung"
+        assert not self.errors, self.errors
+
+    def steps(self, node, world=None):
+        return {s: (w, l) for s, w, l in self.hist[node]
+                if world is None or w == world}
+
+
+# =====================================================================
+# HEADLINE: disk-loss chaos twin
+# =====================================================================
+class TestDiskLossChaos:
+    TOTAL = 6
+    KILL_STEP = 3
+
+    def _chaos_leg(self, tmp_path, tag):
+        srv = KVServer().start()
+        addr = f"127.0.0.1:{srv.port}"
+        sched = FaultSchedule(seed=11).add(
+            "ckpt.disk.loss", "kill", match={"step": self.KILL_STEP})
+        co = _Cohort(addr, f"job_{tag}", str(tmp_path / tag), self.TOTAL)
+        try:
+            for i in range(3):
+                co.start_rank(i, f"node_{i}",
+                              schedule=sched if i == 2 else None,
+                              wait_world=3)
+            _wait(lambda: "DIED" in co.events["node_2"], timeout=120,
+                  msg="victim death")
+            # the victim's disk is GONE with it
+            assert not os.path.exists(
+                os.path.join(str(tmp_path / tag), "node_2"))
+            # replacement with an EMPTY disk joins the recovery rendezvous
+            co.start_rank(3, "node_3", wait_world=1)
+            co.join()
+            # snapshot the committed manifests before the store goes down
+            manifests = dict(_TcpStore(addr, f"job_{tag}", ttl=5.0,
+                                       retries=1).scan(prefix="ckmf:"))
+        finally:
+            srv.stop()
+        return co, sched.fired_log(), manifests
+
+    def _verify_no_manifest_lost(self, tmp_path, tag, manifests):
+        """Re-serve the surviving ranks' blob dirs under a fresh store and
+        prove every step that ever committed a manifest still assembles
+        CRC-clean — zero committed snapshots lost, zero observed torn."""
+        assert manifests, "no manifests ever committed"
+        srv = KVServer().start()
+        addr = f"127.0.0.1:{srv.port}"
+        dst = _TcpStore(addr, "verify", ttl=5.0, retries=1)
+        for k, (v, _age) in manifests.items():
+            dst.put(k, v)
+        planes = []
+        try:
+            for node in ("node_0", "node_1", "node_3"):
+                d = os.path.join(str(tmp_path / tag), node)
+                if os.path.exists(d):
+                    planes.append(CheckpointDataPlane(
+                        _store(addr, "verify"), node, d, _fast_cfg()))
+            verifier = CheckpointDataPlane(
+                _store(addr, "verify"), "verifier",
+                str(tmp_path / f"verify_{tag}"), _fast_cfg())
+            planes.append(verifier)
+            steps = verifier.manifest_steps()
+            assert steps
+            for s in steps:
+                state, _layout = verifier.load_step(s, timeout=30)
+                assert int(state["step"]) == s
+            return steps
+        finally:
+            for p in planes:
+                p.close()
+            srv.stop()
+
+    def test_disk_loss_recovery_bit_identical_and_replayable(self, tmp_path):
+        co_a, log_a, manifests_a = self._chaos_leg(tmp_path, "a")
+        committed = self._verify_no_manifest_lost(tmp_path, "a",
+                                                  manifests_a)
+        co_b, log_b, _manifests_b = self._chaos_leg(tmp_path, "b")
+
+        # replay certificate: identical fired logs across the two runs
+        assert log_a == log_b == [
+            {"point": "ckpt.disk.loss", "kind": "kill", "count": 1,
+             "labels": {"rank": 2, "step": self.KILL_STEP,
+                        "node": "node_2"}}]
+
+        # survivors + replacement covered every step at dp=3, identically
+        for co in (co_a, co_b):
+            s0 = co.steps("node_0")
+            assert sorted(s0) == list(range(self.TOTAL))
+            assert all(w == 3 for w, _l in s0.values())
+            assert co.steps("node_1") == s0
+            # the victim never got past the kill step
+            assert max(s for s, _w, _l in co.hist["node_2"]) < self.KILL_STEP
+            # the replacement's steps agree with the survivors'
+            s3 = co.steps("node_3")
+            assert s3 and all(s0[s] == v for s, v in s3.items())
+            # exactly one recovery, resharded from a committed manifest
+            recover = [e for e in co.events["node_0"]
+                       if e.startswith("restore: snapshot")]
+            assert len(recover) == 1, co.events["node_0"]
+        assert co_a.steps("node_0") == co_b.steps("node_0")
+
+        # bit-identical to the UNINTERRUPTED run: a fresh dp=3 cohort
+        # with no chaos produces the same per-step losses
+        srv = KVServer().start()
+        co_u = _Cohort(f"127.0.0.1:{srv.port}", "job_u",
+                       str(tmp_path / "u"), self.TOTAL)
+        try:
+            for i in range(3):
+                co_u.start_rank(i, f"node_{i}", wait_world=3)
+            co_u.join()
+        finally:
+            srv.stop()
+        assert co_u.steps("node_0") == co_a.steps("node_0")
+        # and the manifests the chaos run committed survived it all
+        assert committed
+
+
+# =====================================================================
+# elastic world GROWTH during recovery (satellite)
+# =====================================================================
+class TestWorldGrowth:
+    TOTAL = 6
+
+    def test_growth_reshard_bit_identical_to_fresh_dp3(self, tmp_path):
+        srv = KVServer().start()
+        addr = f"127.0.0.1:{srv.port}"
+        co = _Cohort(addr, "job_g", str(tmp_path / "g"), self.TOTAL)
+        try:
+            for i in range(2):
+                co.start_rank(i, f"node_{i}", wait_world=2)
+            # let the dp=2 cohort commit at least one manifest, then grow
+            _wait(lambda: len(co.hist["node_0"]) >= 2, timeout=60,
+                  msg="dp=2 progress")
+            co.start_rank(2, "node_2", wait_world=1)
+            co.join()
+            manifests = dict(_TcpStore(addr, "job_g", ttl=5.0,
+                                       retries=1).scan(prefix="ckmf:"))
+        finally:
+            srv.stop()
+
+        # the cohort grew: a recovery rendezvous committed dp=3 and
+        # resharded the dp=2 manifest onto three ranks — including the
+        # JOINER, whose disk was empty (it pulled every shard from peers)
+        s0 = co.steps("node_0")
+        assert sorted(s0) == list(range(self.TOTAL))
+        grown = {s: v for s, v in co.steps("node_0", world=3).items()}
+        assert grown, "cohort never grew to dp=3"
+        recover = [e for e in co.events["node_0"]
+                   if e.startswith("restore: snapshot")]
+        assert len(recover) == 1, co.events["node_0"]
+        snap = int(recover[0].split("step=")[1].split()[0])
+        assert "resharded to world=3" in recover[0]
+        # the empty-disk joiner's steps agree with the incumbents'
+        joiner = co.steps("node_2", world=3)
+        assert joiner and all(grown[s] == v for s, v in joiner.items())
+
+        # fresh dp=3 arm resumed from the SAME manifest: node_0/node_1
+        # bring copies of their dirs, node_2 starts empty; the manifests
+        # are copied into the fresh store
+        srv2 = KVServer().start()
+        addr2 = f"127.0.0.1:{srv2.port}"
+        base2 = str(tmp_path / "g3")
+        for node in ("node_0", "node_1"):
+            shutil.copytree(os.path.join(str(tmp_path / "g"), node),
+                            os.path.join(base2, node))
+        dst = _TcpStore(addr2, "job_g3", ttl=5.0, retries=1)
+        for k, (v, _age) in manifests.items():
+            dst.put(k, v)
+        co3 = _Cohort(addr2, "job_g3", base2, self.TOTAL)
+        try:
+            for i in range(3):
+                co3.start_rank(i, f"node_{i}", resume_step=snap,
+                               wait_world=3)
+            co3.join()
+        finally:
+            srv2.stop()
+        fresh = co3.steps("node_0")
+        assert co3.steps("node_1") == fresh
+        # the acceptance criterion: post-growth trajectory bit-identical
+        # to the fresh dp=3 run from the same snapshot
+        post = {s: v for s, v in grown.items() if s > snap}
+        assert post
+        assert {s: v for s, v in fresh.items() if s > snap} == post
+
+
+# =====================================================================
+# PreemptionGuard: deadline-capped emergency publish (satellite)
+# =====================================================================
+class TestPreemptionPublish:
+    def test_emergency_flush_makes_final_step_peer_recoverable(
+            self, kv, tmp_path):
+        """The dying rank's final shard reaches its peer through the
+        capped flush even though its own worker never runs (interval
+        pinned huge) — its disk can then vanish and the snapshot still
+        commits and assembles from the survivors."""
+        members = ["node_0", "node_1", "node_2"]
+        layout3 = {"/velocity/w": {"axis": 0, "world": 3}}
+        p0 = CheckpointDataPlane(_store(kv), "node_0",
+                                 str(tmp_path / "r0"), _fast_cfg())
+        p1 = CheckpointDataPlane(_store(kv), "node_1",
+                                 str(tmp_path / "r1"), _fast_cfg())
+        # the DYING rank: frozen worker, everything must ride the flush
+        p2 = CheckpointDataPlane(_store(kv), "node_2",
+                                 str(tmp_path / "r2"),
+                                 _fast_cfg(worker_interval_s=999.0))
+        try:
+            for rank, plane in enumerate((p0, p1, p2)):
+                plane.save_shard(
+                    7, {"params": {"w": np.arange(8.0).reshape(4, 2)},
+                        "velocity": {"w": np.full((1, 2), float(rank))},
+                        "step": 7},
+                    rank=rank, world=3, members=members, layout=layout3)
+            out = p2.emergency_flush(deadline_s=5.0)
+            assert out["pushed"] >= 1 and out["ready"] >= 1
+            # p2's shard reached its replica peer's DISK
+            assert (7, 2) in p0.resident()
+            _wait(lambda: p0.manifest(7) is not None, msg="manifest")
+            # the dying rank's disk goes away — the step survives
+            p2.wipe()
+            verifier = CheckpointDataPlane(_store(kv), "node_9",
+                                           str(tmp_path / "r9"),
+                                           _fast_cfg())
+            try:
+                state, _ = verifier.load_step(7, timeout=15)
+                assert int(state["step"]) == 7
+                np.testing.assert_array_equal(
+                    state["velocity"]["w"],
+                    np.asarray([[0.0, 0.0], [1.0, 1.0], [2.0, 2.0]]))
+            finally:
+                verifier.close()
+        finally:
+            for p in (p0, p1, p2):
+                p.close()
+
+    def test_stalled_store_cannot_delay_exit_past_cap(self, tmp_path):
+        """A publisher blocked on a stalled replicated store
+        (``store.replica.append`` stall seam) is abandoned at the cap;
+        the local emergency save and the exit protocol are unaffected."""
+        from paddle_tpu.distributed.fleet.utils.replicated_store import (
+            ReplicatedStoreCluster,
+        )
+
+        with ReplicatedStoreCluster(3, lease_ttl=0.5) as cl:
+            cl.leader(timeout=30)
+            # a production-shaped client: generous TTL and retry budget,
+            # so a stalled store burns real backoff for many seconds —
+            # exactly what the publish cap must cut off
+            st = _TcpStore(cl.addr_spec, "pubjob", ttl=60.0, retries=5)
+            st.put("warm", "1")  # leader discovered before the stall arms
+            mgr = CheckpointManager(str(tmp_path))
+            sched = FaultSchedule(seed=9).add(
+                "store.replica.append", "stall", every=1, seconds=4.0)
+            guard = PreemptionGuard(
+                mgr, publisher=lambda step: st.put(f"final{step}", "x"),
+                publish_deadline_s=1.0)
+            guard.update(5, {"w": np.arange(3.0), "step": 5})
+            sched.arm()
+            try:
+                t0 = time.monotonic()
+                saved = guard.preempt_now(reason="test")
+                wall = time.monotonic() - t0
+            finally:
+                sched.disarm()
+            assert saved is True
+            assert guard.saved_step == 5
+            assert mgr.all_steps() == [5]
+            # the stalled publish was cut at the 1s cap, not the 8s+ the
+            # stalled quorum appends would have taken
+            assert wall < 3.0, wall
+            assert guard.publish_completed is False
+
+    def test_publisher_runs_within_cap_flag(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        hit = []
+        guard = PreemptionGuard(mgr, publisher=lambda step: hit.append(step),
+                                publish_deadline_s=2.0)
+        guard.update(3, {"w": np.ones(2)})
+        assert guard.preempt_now(reason="test") is True
+        assert hit == [3]
+        assert guard.publish_completed is True
+
+
+# =====================================================================
+# CheckpointManager._prune audit (satellite)
+# =====================================================================
+class TestPruneAudit:
+    def test_torn_newest_publish_cannot_evict_newest_intact(self, tmp_path):
+        """keep_max=1 + a torn publish of step 2: pruning must spare
+        step 1 (the newest INTACT snapshot) even though by step-count it
+        is past the keep window — otherwise the newest-intact fallback
+        has nothing left to fall back to."""
+        mgr = CheckpointManager(str(tmp_path), keep_max=1)
+        mgr.save(1, {"w": np.arange(4.0)})
+        sched = FaultSchedule(seed=2).add("checkpoint.write", "torn", at=1)
+        with sched.scope():
+            mgr.save(2, {"w": np.arange(4.0) * 2})
+        assert set(mgr.all_steps()) == {1, 2}  # step 1 spared
+        with pytest.warns(RuntimeWarning, match="corrupt"):
+            state, _ = mgr.load()
+        assert mgr.last_loaded_step == 1
+        np.testing.assert_array_equal(state["w"], np.arange(4.0))
+
+    def test_async_save_with_torn_publish_keeps_newest_intact(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_max=1, async_save=True)
+        mgr.save(1, {"w": np.arange(3.0)})
+        mgr.wait()
+        sched = FaultSchedule(seed=2).add("checkpoint.write", "torn", at=1)
+        sched.arm()  # async writer thread: thread-local scope won't reach
+        try:
+            mgr.save(2, {"w": np.arange(3.0) * 3})
+            mgr.wait()
+        finally:
+            sched.disarm()
+        assert 1 in mgr.all_steps()
+        with pytest.warns(RuntimeWarning, match="corrupt"):
+            mgr.load()
+        assert mgr.last_loaded_step == 1
+
+    def test_prune_still_evicts_when_kept_set_is_intact(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_max=2)
+        for s in (1, 2, 3, 4):
+            mgr.save(s, {"w": np.full(3, float(s))})
+        assert mgr.all_steps() == [3, 4]
+
+
+# =====================================================================
+# corrupt-fallback telemetry (satellite)
+# =====================================================================
+class TestCorruptionFallbackTelemetry:
+    def test_counter_and_flight_dump_on_fallback(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_max=10)
+        mgr.save(1, {"w": np.arange(4.0)})
+        mgr.save(2, {"w": np.arange(4.0) * 2})
+        good = tmp_path / "step_2"
+        torn = tmp_path / "step_3"
+        shutil.copytree(good, torn)
+        blob = (torn / "meta.json").read_bytes()
+        (torn / "meta.json").write_bytes(blob[: len(blob) // 2])
+        ctr = default_registry().counter(
+            "ckpt_corruption_fallbacks_total",
+            "corrupt snapshots skipped by the newest-intact fallback",
+            ("directory",))
+        before = ctr.value(directory=str(tmp_path))
+        with pytest.warns(RuntimeWarning, match="corrupt"):
+            mgr.load()
+        assert mgr.last_loaded_step == 2
+        assert ctr.value(directory=str(tmp_path)) == before + 1
+        dump = flight_recorder().last
+        assert dump is not None
+        assert dump["reason"] == "ckpt_corruption_fallback"
+        assert dump["extra"]["corrupt_steps"] == [3]
+        assert dump["extra"]["loaded_step"] == 2
