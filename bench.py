@@ -796,6 +796,33 @@ def _serving_tput(on_tpu):
             paged.trace_count <= len(paged.chunk_buckets) + 1),
     })
 
+    # -- paged-flash arm (ISSUE 16): same trace, the Pallas flash-decode
+    # kernel in place of the XLA gather. Off-TPU the kernel runs in
+    # interpret mode, so the CPU speedup is expected to be < 1 — the CPU
+    # number pins greedy exactness vs the gather arm, not a win --------------
+    flash = ContinuousBatchingEngine(
+        model, max_seq_len=s, n_slots=n_slots, prefill_buckets=buckets,
+        max_queue=n_req, page_size=page_size, attn_impl="pallas")
+
+    def flash_pass():
+        freqs = [Request(p, max_new_tokens=max_new) for p in prompts]
+        t0 = time.perf_counter()
+        flash.generate_batch(freqs)
+        return freqs, time.perf_counter() - t0
+
+    flash_pass()  # warmup: chunk buckets + step compile
+    freqs, fdt = flash_pass()
+    flash_tput = n_req * max_new / fdt
+    out.update({
+        "serving_paged_flash_tokens_per_sec": round(flash_tput, 2),
+        "serving_paged_flash_speedup_vs_gather": round(
+            flash_tput / paged_tput, 3),
+        "serving_paged_flash_exact_vs_gather": bool(all(
+            fr.tokens == pr.tokens for fr, pr in zip(freqs, preqs))),
+        "serving_paged_flash_compiled_programs": flash.trace_count,
+        "serving_paged_flash_interpret": not on_tpu,
+    })
+
     # secondary 1: per-stream KV HBM — live pages x page bytes vs the slot
     # layout's whole-row share, sampled with every slot active mid-decode
     meter = ContinuousBatchingEngine(
@@ -870,6 +897,80 @@ def _serving_tput(on_tpu):
             "chunk_buckets": list(paged.chunk_buckets)},
     })
     return out
+
+
+def _kernel_speedups(on_tpu, reps=10):
+    """Per-kernel microbench (ISSUE 16): each r20 Pallas kernel against a
+    jitted XLA implementation of the same math, both arms compiled and
+    warmed, median of ``reps``. Off-TPU the kernels execute in Pallas
+    INTERPRET mode, which loses to XLA by construction — the CPU arm
+    pins lineage + wiring (both arms run, finite times, same outputs),
+    and only the TPU arm's speedup is a performance claim."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.paged_attention import (
+        paged_attention_reference,
+        paged_flash_attention,
+    )
+    from paddle_tpu.ops.pallas.softmax_ce import (
+        softmax_ce_loss,
+        softmax_ce_reference,
+    )
+
+    rng = np.random.default_rng(0)
+
+    def med_ms(fn, *args):
+        jax.block_until_ready(fn(*args))  # compile/warm
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2] * 1e3
+
+    # paged decode attention: one tick over a half-full page table
+    if on_tpu:
+        b, h, d, ps, mp, n_pages = 8, 16, 128, 32, 16, 512
+    else:
+        b, h, d, ps, mp, n_pages = 4, 4, 32, 8, 6, 64
+    q = jnp.asarray(rng.normal(size=(b, h, 1, d)), jnp.float32)
+    pk = jnp.asarray(rng.normal(size=(n_pages, h, ps, d)), jnp.float32)
+    pv = jnp.asarray(rng.normal(size=(n_pages, h, ps, d)), jnp.float32)
+    pages = jnp.asarray(
+        rng.integers(1, n_pages, (b, mp)).astype("int32"))
+    pos = jnp.asarray(rng.integers(ps, (mp - 1) * ps, (b,)).astype("int32"))
+
+    flash = jax.jit(lambda q, pk, pv: paged_flash_attention(
+        q, pk, pv, pages, pos, page_size=ps))
+    gather = jax.jit(lambda q, pk, pv: paged_attention_reference(
+        q, pk, pv, pages, pos, page_size=ps))
+    pa_pl = med_ms(flash, q, pk, pv)
+    pa_xla = med_ms(gather, q, pk, pv)
+
+    # fused softmax-CE head fwd+bwd vs the jnp log-softmax reference
+    if on_tpu:
+        n, t, v = 8, 1024, 50304
+    else:
+        n, t, v = 4, 32, 512
+    logits = jnp.asarray(rng.normal(size=(n, t, v)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, v, (n, t)).astype("int32"))
+
+    ce_pl = jax.jit(jax.grad(lambda x: jnp.sum(softmax_ce_loss(x, labels))))
+    ce_xla = jax.jit(jax.grad(
+        lambda x: jnp.sum(softmax_ce_reference(x, labels))))
+    ce_pl_ms = med_ms(ce_pl, logits)
+    ce_xla_ms = med_ms(ce_xla, logits)
+
+    return {
+        "kernel_paged_attn_pallas_ms": round(pa_pl, 3),
+        "kernel_paged_attn_xla_ms": round(pa_xla, 3),
+        "kernel_paged_attn_speedup": round(pa_xla / pa_pl, 3),
+        "kernel_softmax_ce_pallas_ms": round(ce_pl_ms, 3),
+        "kernel_softmax_ce_xla_ms": round(ce_xla_ms, 3),
+        "kernel_softmax_ce_speedup": round(ce_xla_ms / ce_pl_ms, 3),
+        "kernel_bench_interpret": not on_tpu,
+    }
 
 
 def _overload_shed(on_tpu):
@@ -1428,6 +1529,11 @@ def main():
         except Exception as e:  # pragma: no cover - device dependent
             secondary["serving_cb_tokens_per_sec"] = f"failed: {type(e).__name__}"
         try:
+            # per-kernel Pallas-vs-XLA microbench (ISSUE 16)
+            secondary.update(_kernel_speedups(True))
+        except Exception as e:  # pragma: no cover - device dependent
+            secondary["kernel_paged_attn_speedup"] = f"failed: {type(e).__name__}"
+        try:
             # static analysis: lint wall-time + finding counts (ISSUE 4)
             secondary.update(_analysis_overhead())
         except Exception as e:  # pragma: no cover - device dependent
@@ -1514,6 +1620,10 @@ def main():
             secondary.update(_serving_tput(False))
         except Exception as e:  # pragma: no cover
             secondary["serving_cb_tokens_per_sec"] = f"failed: {type(e).__name__}"
+        try:
+            secondary.update(_kernel_speedups(False))
+        except Exception as e:  # pragma: no cover
+            secondary["kernel_paged_attn_speedup"] = f"failed: {type(e).__name__}"
         try:
             secondary.update(_analysis_overhead())
         except Exception as e:  # pragma: no cover

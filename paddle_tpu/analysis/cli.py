@@ -407,7 +407,8 @@ def _memory_mode(targets, meta, overrides, args):
                     message=("unknown primitive(s) fell back to bytes-only "
                              f"cost: {sorted(cost.unknown)} — extend "
                              "analysis/cost.py if they matter"),
-                    details={"unknown_prims": dict(cost.unknown)})])
+                    details={"unknown_prims": dict(cost.unknown),
+                             "unknown_where": dict(cost.unknown_where)})])
         except Exception as e:  # mirrors run_rules' crashed-rule policy
             entries[t.name] = {"error": f"{type(e).__name__}: {e}"}
             report.extend([Finding(

@@ -37,7 +37,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from .graph import COLLECTIVE_PRIMS, _axes_of
+from .graph import COLLECTIVE_PRIMS, _axes_of, scope_components
 
 __all__ = [
     "EqnCost",
@@ -302,6 +302,22 @@ def cost_eqn(prim: str, in_avals, out_avals, params: dict,
     if prim in _MOVEMENT:
         return EqnCost(bytes_in=bytes_in, bytes_out=bytes_out)
 
+    if prim == "pallas_call":
+        # price from the kernel cost registry (r20): kernels register
+        # analytic (flops, bytes) models under the explicit name= they
+        # pass to pl.pallas_call.  Unregistered kernels keep the loud
+        # bytes-only fallback below — never silently zero-costed.
+        name = getattr(params.get("name_and_src_info"), "name", None)
+        try:
+            from ..ops.pallas.cost_registry import kernel_cost_model
+            model = kernel_cost_model(name)
+            if model is not None:
+                flops, bts = model(in_avals, out_avals, params)
+                return EqnCost(flops=float(flops), bytes_in=int(bts),
+                               bytes_out=0)
+        except Exception:
+            pass  # malformed model → loud fallback, same as unregistered
+
     # unknown primitive: bytes-only fallback, reported via GraphCost.unknown
     return EqnCost(bytes_in=bytes_in, bytes_out=bytes_out, known=False,
                    estimated=True)
@@ -317,6 +333,9 @@ class GraphCost:
     by_prim: Dict[str, Dict[str, float]] = dataclasses.field(
         default_factory=dict)
     unknown: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: prim → r14 scope path of the FIRST offending eqn, so an unpriced
+    #: primitive is attributable to a model region without a jaxpr dig
+    unknown_where: Dict[str, str] = dataclasses.field(default_factory=dict)
     estimated: bool = False        # while trip counts / guessed axis sizes
     n_eqns: int = 0
 
@@ -336,6 +355,7 @@ class GraphCost:
             "n_eqns": self.n_eqns,
             "estimated": self.estimated,
             "unknown_prims": dict(self.unknown),
+            "unknown_where": dict(self.unknown_where),
             "by_prim_top": {k: {m: round(x, 1) for m, x in v.items()}
                             for k, v in top},
         }
@@ -343,6 +363,15 @@ class GraphCost:
 
 _SCAN_AT = re.compile(r"^scan@(\d+)$")
 _ESTIMATED_AT = re.compile(r"^(while|cond)@(\d+)$")
+_PALLAS_AT = re.compile(r"^pallas_call@\d+$")
+
+
+def _inside_pallas_body(path) -> bool:
+    """True for nodes the walker recorded INSIDE a pallas_call body jaxpr.
+    The pallas_call eqn itself carries the whole kernel's cost (registry
+    model or bytes-only fallback); pricing the body's per-block eqns too
+    would double count — and at per-BLOCK shapes, not per-launch ones."""
+    return any(_PALLAS_AT.match(p) for p in path)
 
 
 def execution_multiplier(graph, path) -> Tuple[float, bool]:
@@ -371,6 +400,8 @@ def graph_cost(graph, mesh_axes: Optional[Dict[str, int]] = None) -> GraphCost:
     bound, flagged ``estimated``)."""
     total = GraphCost()
     for node in graph.nodes:
+        if _inside_pallas_body(node.path):
+            continue
         c = cost_eqn(node.prim, node.in_avals, node.out_avals, node.params,
                      mesh_axes)
         if c.container:
@@ -380,6 +411,9 @@ def graph_cost(graph, mesh_axes: Optional[Dict[str, int]] = None) -> GraphCost:
             total.estimated = True
         if not c.known:
             total.unknown[node.prim] = total.unknown.get(node.prim, 0) + 1
+            total.unknown_where.setdefault(
+                node.prim,
+                "/".join(scope_components(node.name_stack)) or "(unscoped)")
         total.flops += c.flops * mult
         total.bytes_accessed += c.bytes_accessed * mult
         total.comm_bytes += c.comm_bytes * mult
@@ -447,6 +481,8 @@ def scope_costs(graph, mesh_axes: Optional[Dict[str, int]] = None,
 
     out: Dict[Tuple[str, ...], ScopeCost] = {}
     for node in graph.nodes:
+        if _inside_pallas_body(node.path):
+            continue
         c = cost_eqn(node.prim, node.in_avals, node.out_avals, node.params,
                      mesh_axes)
         if c.container:

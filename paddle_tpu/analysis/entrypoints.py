@@ -154,7 +154,22 @@ def serving_targets() -> List[AnalysisTarget]:
         "serving_decode", eng._step_jit, eng._step_args_example(),
         tags=("serving",),
         donate_argnums=getattr(eng, "_donate_step", ()))
-    return [prefill, decode]
+    # kernel-on arm (r20): same model, paged flash-decode Pallas kernel in
+    # place of the XLA gather — linted side by side so the cost registry's
+    # pricing of the pallas_call eqns is itself under test
+    eng_pl = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=4,
+                                      attn_impl="pallas")
+    prefill_pl = AnalysisTarget(
+        "serving_prefill_pallas", eng_pl._prefill_jit,
+        eng_pl._prefill_arg_specs(8),
+        tags=("serving", "pallas"),
+        donate_argnums=getattr(eng_pl, "_donate_prefill", ()))
+    decode_pl = AnalysisTarget(
+        "serving_decode_pallas", eng_pl._step_jit,
+        eng_pl._step_args_example(),
+        tags=("serving", "pallas"),
+        donate_argnums=getattr(eng_pl, "_donate_step", ()))
+    return [prefill, decode, prefill_pl, decode_pl]
 
 
 def exported_target() -> AnalysisTarget:

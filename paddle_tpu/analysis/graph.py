@@ -388,6 +388,12 @@ def _scan_fixpoint(params, in_taints):
 class _Walker:
     def __init__(self, graph: DefUseGraph):
         self.g = graph
+        # enclosing eqns' rendered name stacks: jax stores the profiler
+        # scope path on the WRAPPING eqn only (an inner-jit body eqn has
+        # an empty name_stack), so inner nodes inherit the prefix here —
+        # without it every eqn under e.g. jnp.sort's internal jit lands
+        # in the "(unscoped)" row
+        self._ns: List[str] = []
 
     def _record_consts(self, closed, path):
         for c in getattr(closed, "consts", ()):
@@ -438,10 +444,13 @@ class _Walker:
             # diverging copies would silently corrupt collective verdicts
             out_taint = _taint_out(prim, eqn.params, union)
 
+            own_ns = _name_stack_of(eqn)
+            prefix = self._ns[-1] if self._ns else ""
+            full_ns = "/".join(x for x in (prefix, own_ns) if x)
             idx = len(g.nodes)
             node = Node(
                 idx=idx, prim=prim, path=path,
-                name_stack=_name_stack_of(eqn), source=_source_of(eqn),
+                name_stack=full_ns, source=_source_of(eqn),
                 in_avals=tuple(_aval_info(v) for v in eqn.invars),
                 out_avals=tuple(_aval_info(v) for v in eqn.outvars),
                 in_defs=tuple(d for _, d in in_info),
@@ -450,7 +459,11 @@ class _Walker:
             )
             g.nodes.append(node)
 
-            out_info = self._recurse(eqn, node, in_info, out_taint, path)
+            self._ns.append(full_ns)
+            try:
+                out_info = self._recurse(eqn, node, in_info, out_taint, path)
+            finally:
+                self._ns.pop()
             if out_info is None:
                 out_info = [(out_taint, idx)] * len(eqn.outvars)
             for v, info in zip(eqn.outvars, out_info):

@@ -235,14 +235,27 @@ class ParallelCrossEntropy(Layer):
         self.ignore_index = ignore_index
 
     def forward(self, input, label):  # noqa: A002
+        from ...framework.flags import flag
+
+        # r20: the fused Pallas softmax-CE head covers BOTH branches; the
+        # jnp paths below stay the default and the parity oracle
+        use_fused = bool(flag("FLAGS_use_pallas_softmax_ce"))
+        ignore = self.ignore_index
         if not mp_axis_bound():
+            if use_fused:
+                from ...ops.pallas.softmax_ce import softmax_ce_loss
+
+                @primitive
+                def _fused_ce(logits, label):
+                    return softmax_ce_loss(
+                        logits, label, ignore_index=ignore)[..., None]
+
+                return _fused_ce(input, unwrap(label))
             loss = F.cross_entropy(input, label, reduction="none", ignore_index=self.ignore_index)
             from ...ops.manipulation import unsqueeze
 
             return unsqueeze(loss, -1)
         per = None  # local vocab size derived inside
-
-        ignore = self.ignore_index
 
         @primitive
         def _pce(logits, label):
@@ -251,15 +264,26 @@ class ParallelCrossEntropy(Layer):
             start = rank * vocab_local
             m = lax.pmax(lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True)), MP_AXIS)
             shifted = logits - m
-            sum_exp = mp_allreduce_array(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True))
             lbl = label.astype(jnp.int32)
             valid = lbl != ignore
             safe_lbl = jnp.where(valid, lbl, 0)
             local = safe_lbl - start
             in_range = (local >= 0) & (local < vocab_local)
-            picked = jnp.take_along_axis(shifted, jnp.where(in_range, local, 0)[..., None], axis=-1)[..., 0]
-            picked = jnp.where(in_range, picked, 0.0)
-            picked = mp_allreduce_array(picked)
+            if use_fused:
+                # local (sum-exp, picked) partials in one fused pass; the
+                # pmax above and the allreduces below stay outside the
+                # kernel (reference: c_softmax_with_cross_entropy_op)
+                from ...ops.pallas.softmax_ce import softmax_ce_partials
+
+                loc = jnp.where(in_range & valid, local, -1)
+                se, picked = softmax_ce_partials(shifted, loc)
+                sum_exp = mp_allreduce_array(se[..., None])
+                picked = mp_allreduce_array(picked)
+            else:
+                sum_exp = mp_allreduce_array(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True))
+                picked = jnp.take_along_axis(shifted, jnp.where(in_range, local, 0)[..., None], axis=-1)[..., 0]
+                picked = jnp.where(in_range, picked, 0.0)
+                picked = mp_allreduce_array(picked)
             loss = jnp.log(sum_exp[..., 0]) - picked
             loss = jnp.where(valid, loss, 0.0)
             return loss[..., None]

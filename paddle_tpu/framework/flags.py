@@ -131,6 +131,9 @@ register_flag("FLAGS_cudnn_deterministic", False,
               on_change=_on_deterministic)
 register_flag("FLAGS_use_pallas_attention", True,
               "route nn attention through the Pallas flash kernel on TPU")
+register_flag("FLAGS_use_pallas_softmax_ce", False,
+              "route the softmax-cross-entropy loss head (both mp and "
+              "non-mp branches) through the fused Pallas kernel")
 register_flag("FLAGS_eager_layer_jit", "true", type=str,
               help="transparently jit-cache per-Layer forwards in dygraph "
                    "mode: true (TPU only) | force (any backend) | false")

@@ -248,6 +248,9 @@ class GPTAttention(Layer):
 
             scale = 1.0 / (self.head_dim ** 0.5)
             ps = int(cache["page_size"])
+            # r20 engine flag: "xla" = gather path (default, and the
+            # bit-comparison oracle); "pallas" = paged flash-decode kernel
+            attn_impl = str(cache.get("attn_impl", "xla"))
 
             @primitive
             def _paged_attn(q, k, v, poolk, poolv, pages, pos):
@@ -273,6 +276,18 @@ class GPTAttention(Layer):
                     kw.astype(poolk.dtype))
                 poolv = poolv.at[pg.reshape(-1), :, off.reshape(-1), :].set(
                     vw.astype(poolv.dtype))
+                if attn_impl == "pallas":
+                    # paged flash-decode kernel (r20): reads the pool
+                    # through the page table block by block — the gathered
+                    # [B, H, cap, D] tensor below never materializes
+                    from ..ops.pallas.paged_attention import (
+                        paged_flash_attention,
+                    )
+
+                    out = paged_flash_attention(
+                        q, poolk, poolv, pages, pos, page_size=ps,
+                        sm_scale=scale)
+                    return out, poolk, poolv
                 # gather the table's pages back into position order: the
                 # j axis below IS absolute sequence position, so the mask
                 # and reductions match the contiguous slot buffer bit for
@@ -301,7 +316,7 @@ class GPTAttention(Layer):
                     cache["pos"])
             self._gen_cache = {"mode": "paged", "k": new_k, "v": new_v,
                                "pages": cache["pages"], "pos": cache["pos"],
-                               "page_size": ps}
+                               "page_size": ps, "attn_impl": attn_impl}
             return self._finish(out, b, t)
         if cache is not None and cache.get("mode") == "buffer":
             # fixed-capacity export mode (inference.save_for_generation):

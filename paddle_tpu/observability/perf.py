@@ -376,7 +376,7 @@ def _trainer_entry(on_tpu: bool, steps: int, peak_flops: float,
 
 
 def _serving_entry(on_tpu: bool, ticks: int, peak_flops: float,
-                   peak_bw: float) -> dict:
+                   peak_bw: float, attn_impl: str = "xla") -> dict:
     """Measure + attribute ONE warmed decode tick of the continuous-
     batching engine (all slots active — the serving hot path)."""
     import gc
@@ -409,7 +409,8 @@ def _serving_entry(on_tpu: bool, ticks: int, peak_flops: float,
     rng = np.random.default_rng(0)
     eng = ContinuousBatchingEngine(model, max_seq_len=s_len, n_slots=n_slots,
                                    prefill_buckets=buckets,
-                                   max_queue=4 * n_slots)
+                                   max_queue=4 * n_slots,
+                                   attn_impl=attn_impl)
     prompts = [rng.integers(0, cfg.vocab_size, (int(l),)).astype("int32")
                for l in rng.integers(lo, hi, size=2 * n_slots)]
     # warm every bucket + the decode step (compiles out of the timed ticks)
@@ -442,7 +443,8 @@ def _serving_entry(on_tpu: bool, ticks: int, peak_flops: float,
     entry = att.to_dict()
     entry["config"] = {"model": name, "n_slots": n_slots,
                        "max_seq_len": s_len, "buckets": list(buckets),
-                       "ticks_timed": len(per_tick)}
+                       "ticks_timed": len(per_tick),
+                       "attn_impl": attn_impl}
     entry["per_tick_s"] = [round(t, 6) for t in per_tick]
     entry["host_timers"] = {
         k: round(v, 6) for k, v in measured_from_timers("serving.").items()}
@@ -499,6 +501,14 @@ def build_perf_report(out_path: Optional[str] = None, steps: int = 8,
                                                  peak_bw)
         entries["serving_decode"] = _serving_entry(on_tpu, ticks, peak_flops,
                                                    peak_bw)
+        # r20 kernel-on arm: the paged flash-decode Pallas kernel in place
+        # of the XLA gather; the committed artifact keeps both rows so the
+        # serving.paged_attn roofline verdict is comparable within one file.
+        # Fresh timers so the arm's measured join sees only its own spans
+        # (both arms record under the same serving.* scope names).
+        timer_registry.reset()
+        entries["serving_decode_pallas"] = _serving_entry(
+            on_tpu, ticks, peak_flops, peak_bw, attn_impl="pallas")
     finally:
         if not had_timers:
             disable_timers()

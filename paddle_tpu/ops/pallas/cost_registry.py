@@ -1,0 +1,110 @@
+"""Kernel cost registry: ``pallas_call`` name → ``(flops, bytes)`` model.
+
+The analysis plane walks jaxprs, not kernel bodies: a ``pallas_call`` eqn
+is opaque to the per-prim cost tables in :mod:`paddle_tpu.analysis.cost`,
+so until r20 every kernel was priced by the loud bytes-only fallback and
+tallied in ``GraphCost.unknown`` — planner v2 and the perf doctor treated
+a kernel-enabled program as free memory traffic.  This registry closes
+the loop: each shipped kernel registers an analytic ``(flops, bytes)``
+model under the explicit ``name=`` it passes to ``pl.pallas_call``, and
+``cost_eqn`` prices the eqn from the registry.  Unregistered kernels keep
+the bytes-only fallback (never silently zero-costed).
+
+The contract
+------------
+* A model is ``model(in_avals, out_avals, params) -> (flops, bytes)``.
+  ``in_avals`` / ``out_avals`` are the walker's ``(shape, dtype, weak)``
+  triples in eqn operand order (scalar-prefetch operands first when the
+  kernel uses ``PrefetchScalarGridSpec``); ``params`` are the eqn's light
+  params (``grid_mapping`` etc. — the ``jaxpr`` param is dropped).
+* ``bytes`` is total HBM traffic the kernel actually moves — which is the
+  whole point: the paged-attention kernel reads each touched K/V page
+  once, while the XLA gather path it replaces materializes (and re-reads)
+  the full gathered ``[B, S, H, D]`` tensor plus the score matrix.
+* Registration happens at kernel-module import; the cost model pulls the
+  built-in kernels in lazily via :func:`kernel_cost_model` so
+  ``analysis.cost`` never imports pallas at module import time.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "register_kernel_cost",
+    "kernel_cost_model",
+    "registered_kernels",
+]
+
+CostModel = Callable[[tuple, tuple, dict], Tuple[float, float]]
+
+_REGISTRY: Dict[str, CostModel] = {}
+_BUILTIN_LOADED = False
+
+
+def register_kernel_cost(name: str, model: CostModel) -> CostModel:
+    """Register ``model`` under kernel ``name`` (the explicit ``name=`` the
+    kernel passes to ``pl.pallas_call``).  Re-registration replaces —
+    kernel modules own their names."""
+    if not name:
+        raise ValueError("kernel cost model needs a non-empty name")
+    _REGISTRY[str(name)] = model
+    return model
+
+
+def _ensure_builtin():
+    """Import the in-tree kernel modules once so their import-time
+    registrations land before the first lookup (the analysis plane may
+    price a jaxpr traced elsewhere without importing ops.pallas itself)."""
+    global _BUILTIN_LOADED
+    if _BUILTIN_LOADED:
+        return
+    _BUILTIN_LOADED = True
+    from . import (  # noqa: F401
+        flash_attention,
+        fused_ln,
+        paged_attention,
+        rope,
+        softmax_ce,
+        swiglu,
+    )
+
+
+def kernel_cost_model(name: Optional[str]) -> Optional[CostModel]:
+    """The registered model for kernel ``name``, or None (→ the caller
+    keeps the bytes-only unknown fallback)."""
+    if not name:
+        return None
+    _ensure_builtin()
+    return _REGISTRY.get(str(name))
+
+
+def registered_kernels():
+    _ensure_builtin()
+    return sorted(_REGISTRY)
+
+
+# -- shared helpers for the in-tree models ----------------------------------
+def aval_bytes(aval_info) -> int:
+    shape, dtype, _ = aval_info
+    if dtype is None:
+        return 0
+    try:
+        item = np.dtype(dtype).itemsize
+    except TypeError:
+        item = 16
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n * item
+
+
+def itemsize(aval_info) -> int:
+    dtype = aval_info[1]
+    if dtype is None:
+        return 0
+    try:
+        return np.dtype(dtype).itemsize
+    except TypeError:
+        return 16

@@ -126,6 +126,7 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=interpret,
+        name="flash_attention_fwd",
     )(q, k, v)
     return o, lse[:, :, 0]
 
@@ -253,6 +254,7 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
+        name="flash_attention_bwd_dq",
     )(q, k, v, do, lse_b, delta_b)
 
     dk, dv = pl.pallas_call(
@@ -280,6 +282,7 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
+        name="flash_attention_bwd_dkv",
     )(q, k, v, do, lse_b, delta_b)
     return dq, dk, dv
 
@@ -446,3 +449,49 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
     if t_pad:
         o = o[..., : t_len - t_pad, :]
     return o
+
+
+# ----------------------------------------------------------------------
+# cost models (analysis/cost.py prices pallas_call eqns from these)
+# ----------------------------------------------------------------------
+_TRANSCENDENTAL_FLOPS = 8  # matches analysis.cost.TRANSCENDENTAL_FLOPS
+
+
+def _attn_dims(in_avals):
+    (bh, t, d), _, _ = in_avals[0]
+    s = int(in_avals[1][0][1])
+    return int(bh), int(t), int(s), int(d)
+
+
+def _io_bytes(in_avals, out_avals):
+    from .cost_registry import aval_bytes
+    return sum(aval_bytes(a) for a in in_avals) \
+        + sum(aval_bytes(a) for a in out_avals)
+
+
+def _flash_fwd_cost(in_avals, out_avals, params):
+    bh, t, s, d = _attn_dims(in_avals)
+    flops = 4.0 * bh * t * s * d + 2.0 * _TRANSCENDENTAL_FLOPS * bh * t * s
+    return flops, _io_bytes(in_avals, out_avals)
+
+
+def _flash_bwd_dq_cost(in_avals, out_avals, params):
+    bh, t, s, d = _attn_dims(in_avals)
+    flops = 6.0 * bh * t * s * d + _TRANSCENDENTAL_FLOPS * bh * t * s
+    return flops, _io_bytes(in_avals, out_avals)
+
+
+def _flash_bwd_dkv_cost(in_avals, out_avals, params):
+    bh, t, s, d = _attn_dims(in_avals)
+    flops = 8.0 * bh * t * s * d + _TRANSCENDENTAL_FLOPS * bh * t * s
+    return flops, _io_bytes(in_avals, out_avals)
+
+
+def _register_costs():
+    from .cost_registry import register_kernel_cost
+    register_kernel_cost("flash_attention_fwd", _flash_fwd_cost)
+    register_kernel_cost("flash_attention_bwd_dq", _flash_bwd_dq_cost)
+    register_kernel_cost("flash_attention_bwd_dkv", _flash_bwd_dkv_cost)
+
+
+_register_costs()

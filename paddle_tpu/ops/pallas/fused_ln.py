@@ -87,6 +87,7 @@ def _fwd_raw(x, residual, mask, gamma, beta, p, epsilon, block_m, interpret):
             jax.ShapeDtypeStruct((m, h), x.dtype),
         ],
         interpret=interpret,
+        name="fused_residual_dropout_ln_fwd",
     )(x, residual, mask, gamma, beta)
 
 
@@ -158,3 +159,25 @@ def fused_residual_dropout_ln(x, residual, gamma, beta, *, p: float = 0.0,
     out, y = _fused(x2, r2, mk, gamma, beta, float(p), float(epsilon), bm,
                     bool(interpret))
     return out.reshape(*lead, h), y.reshape(*lead, h)
+
+
+def _fused_ln_cost(in_avals, out_avals, params):
+    """Bandwidth-bound single pass: dropout-scale + residual add + two
+    moment reductions + normalize ≈ 9 VPU ops/element (rsqrt ~ the
+    transcendental budget amortized over H)."""
+    from .cost_registry import aval_bytes
+    x_av = in_avals[0]
+    n = 1
+    for s in x_av[0]:
+        n *= int(s)
+    bts = sum(aval_bytes(a) for a in in_avals) \
+        + sum(aval_bytes(a) for a in out_avals)
+    return 9.0 * n, bts
+
+
+def _register_costs():
+    from .cost_registry import register_kernel_cost
+    register_kernel_cost("fused_residual_dropout_ln_fwd", _fused_ln_cost)
+
+
+_register_costs()

@@ -1,0 +1,206 @@
+"""Paged flash-decode attention as a Pallas TPU kernel (ISSUE 16).
+
+The serving engine's paged mode (ISSUE 11/15) keeps K/V in a fixed
+``[n_pages, H, page_size, D]`` pool per layer and reads it through a
+padded per-slot page table ``[B, max_pages]``.  The XLA path in
+``models/gpt.py`` gathers ``pool[pages]`` back into a contiguous
+``[B, H, S, D]`` tensor before a masked softmax — memory-bound by
+construction: the gather materializes (then re-reads) the whole live
+cache plus the ``[B, H, T, S]`` score matrix every decode tick, and the
+r14 perf doctor ranks exactly that ``serving.paged_attn`` row at the top
+of the serving MFU-gap table.
+
+This kernel is the FlashAttention-style (Dao et al., 2022) replacement in
+the spirit of vLLM's PagedAttention (Kwon et al., SOSP 2023): the grid
+runs (slot, page-table entry) with the table as a scalar-prefetch
+operand, so each K/V pool block is DMA'd straight from its page — the
+gathered tensor never exists — and the online-softmax accumulator in
+VMEM carries ``(m, l, acc)`` across a slot's page entries.  Masking
+reproduces the gather path's semantics exactly:
+
+* query row ``r`` of slot ``b`` sits at absolute position ``pos[b] + r``
+  and attends keys at absolute positions ``<= pos[b] + r`` (works for
+  single-token decode ``T == 1`` and chunked prefill ``T > 1`` alike —
+  the chunk's own keys are scattered into the pool before the call, same
+  as the XLA path);
+* padded table entries point at the reserved trash page 0, whose
+  absolute positions ``entry * page_size + offset`` lie past the live
+  length, so they are always masked — trash contents are never read
+  unmasked, and COW-duplicated pages are read through the table like any
+  other page (the kernel never writes the pool).
+
+Forward-only by design: decode runs under ``no_grad`` (the training-side
+flash kernel in :mod:`.flash_attention` owns fwd+bwd).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .cost_registry import aval_bytes, itemsize, register_kernel_cost
+
+__all__ = ["paged_flash_attention", "paged_attention_reference",
+           "PAGED_ATTENTION_KERNEL_NAME"]
+
+NEG_INF = -1e30  # matches flash_attention.py / the gather path's mask fill
+
+#: explicit ``pl.pallas_call`` name — the cost-registry key
+PAGED_ATTENTION_KERNEL_NAME = "paged_flash_attention"
+
+
+def paged_attention_reference(q, pool_k, pool_v, pages, pos, *, page_size,
+                              sm_scale=None):
+    """The XLA gather-path read (models/gpt.py ``_paged_attn`` after its
+    scatter writes) — the bit-comparison oracle for the kernel."""
+    b, h, t, d = q.shape
+    mp = pages.shape[1]
+    cap = mp * int(page_size)
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    pos = pos.astype(jnp.int32).reshape(-1)
+    wpos = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    gk = pool_k[pages].transpose(0, 2, 1, 3, 4).reshape(b, h, cap, d)
+    gv = pool_v[pages].transpose(0, 2, 1, 3, 4).reshape(b, h, cap, d)
+    scores = jnp.einsum("bhtd,bhsd->bhts", q, gk.astype(q.dtype)) * sm_scale
+    j = jnp.arange(cap)[None, None, None, :]
+    mask = j <= wpos[:, None, :, None]
+    scores = jnp.where(mask, scores, jnp.asarray(NEG_INF, scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bhsd->bhtd", probs, gv.astype(q.dtype))
+
+
+def _paged_kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, sm_scale, page_size, n_entries):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32)          # [H, T, D]
+    k = k_ref[0].astype(jnp.float32)          # [H, ps, D]
+    v = v_ref[0].astype(jnp.float32)
+
+    s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * sm_scale
+
+    t = q.shape[1]
+    # absolute positions: query row r writes/sits at pos[b] + r; this
+    # page entry's keys sit at j * page_size + offset.  Trash-page-0
+    # entries only ever appear at j with j * page_size >= live length,
+    # so kpos > wpos masks them unconditionally.
+    wpos = pos_ref[b] + jax.lax.broadcasted_iota(
+        jnp.int32, (t, page_size), 0)
+    kpos = j * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (t, page_size), 1)
+    s = jnp.where((kpos <= wpos)[None], s, NEG_INF)   # [H, T, ps]
+
+    m_prev = m_ref[...][:, :, :1]             # [H, T, 1]
+    l_prev = l_ref[...][:, :, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # first entry always holds an unmasked key (kpos 0 <= wpos >= 0), so
+    # m_new is finite from j == 0 on; a fully-masked later entry yields
+    # p == 0 and alpha == 1 — a no-op, exactly like the gather path's
+    # exp(-1e30 - m) underflow
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p, v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == n_entries - 1)
+    def _finish():
+        l = l_ref[...][:, :, :1]
+        o_ref[0] = (acc_ref[...] /
+                    jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def paged_flash_attention(q, pool_k, pool_v, pages, pos, *, page_size: int,
+                          sm_scale=None, interpret=None):
+    """Decode/chunk-prefill attention straight off the paged KV pool.
+
+    ``q`` ``[B, H, T, D]`` (``T == 1`` decode, ``T > 1`` chunked prefill —
+    the chunk's keys must already be scattered into the pool, as the
+    engine does); ``pool_k``/``pool_v`` ``[n_pages, H, page_size, D]``
+    per-layer pools; ``pages`` ``[B, max_pages]`` int32 page table (pad
+    entries = trash page 0); ``pos`` ``[B]`` int32 absolute position of
+    ``q``'s first row.  Returns ``[B, H, T, D]`` in ``q.dtype``.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, t, d = q.shape
+    n_entries = pages.shape[1]
+    ps = int(page_size)
+    if pool_k.shape[2] != ps or pool_v.shape[2] != ps:
+        raise ValueError(
+            f"pool page_size {pool_k.shape[2]} != engine page_size {ps}")
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+
+    kernel = functools.partial(
+        _paged_kernel, sm_scale=float(sm_scale), page_size=ps,
+        n_entries=int(n_entries))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,      # pages, pos
+        grid=(b, n_entries),        # entry axis innermost: scratch carries
+        in_specs=[
+            pl.BlockSpec((1, h, t, d), lambda b_, j, pages, pos: (b_, 0, 0, 0)),
+            pl.BlockSpec((1, h, ps, d),
+                         lambda b_, j, pages, pos: (pages[b_, j], 0, 0, 0)),
+            pl.BlockSpec((1, h, ps, d),
+                         lambda b_, j, pages, pos: (pages[b_, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, t, d),
+                               lambda b_, j, pages, pos: (b_, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, t, d), jnp.float32),
+            pltpu.VMEM((h, t, 128), jnp.float32),
+            pltpu.VMEM((h, t, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        interpret=interpret,
+        name=PAGED_ATTENTION_KERNEL_NAME,
+    )(pages.astype(jnp.int32), pos.astype(jnp.int32).reshape(-1),
+      q, pool_k, pool_v)
+
+
+# -- cost model (analysis/cost.py prices the pallas_call eqn from this) ----
+_TRANSCENDENTAL_FLOPS = 8  # matches analysis.cost.TRANSCENDENTAL_FLOPS
+
+
+def _paged_attention_cost(in_avals, out_avals, params):
+    """flops: the two attention contractions over the table capacity
+    S = max_pages * page_size, plus the online-softmax exp traffic.
+    bytes: each TOUCHED page is streamed once per slot (B * max_pages
+    K+V blocks) plus q/out/table — NOT the gather path's materialized
+    [B, S, H, D] round-trip, which is the whole intensity win."""
+    pages_av, pos_av, q_av, pk_av, pv_av = in_avals[:5]
+    b, n_entries = (int(x) for x in pages_av[0])
+    _, h, t, d = (int(x) for x in q_av[0])
+    ps = int(pk_av[0][2])
+    s = n_entries * ps
+    flops = 4.0 * b * h * t * s * d \
+        + 2.0 * _TRANSCENDENTAL_FLOPS * b * h * t * s
+    kv_bytes = float(b * n_entries * h * ps * d) \
+        * (itemsize(pk_av) + itemsize(pv_av))
+    io = aval_bytes(q_av) + aval_bytes(pages_av) + aval_bytes(pos_av) \
+        + sum(aval_bytes(o) for o in out_avals)
+    return flops, kv_bytes + io
+
+
+register_kernel_cost(PAGED_ATTENTION_KERNEL_NAME, _paged_attention_cost)

@@ -69,6 +69,7 @@ def _rope_fwd_raw(x, cos, sin, block_t, interpret):
         out_specs=pl.BlockSpec((1, block_t, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), x.dtype),
         interpret=interpret,
+        name="rope_fwd",
     )(x, cos, sin)
 
 
@@ -113,3 +114,24 @@ def rope(x, cos, sin, *, block_t: int = BLOCK_T, interpret=None):
     if squeeze4:
         out = out.reshape(b, h, tt, dd)
     return out
+
+
+def _rope_cost(in_avals, out_avals, params):
+    """Bandwidth-bound: one read + one write of x plus the tables; 4 VPU
+    ops per element (mul, mul, mul, add — the roll is free lane traffic)."""
+    from .cost_registry import aval_bytes
+    x_av = in_avals[0]
+    n = 1
+    for s in x_av[0]:
+        n *= int(s)
+    bts = sum(aval_bytes(a) for a in in_avals) \
+        + sum(aval_bytes(a) for a in out_avals)
+    return 4.0 * n, bts
+
+
+def _register_costs():
+    from .cost_registry import register_kernel_cost
+    register_kernel_cost("rope_fwd", _rope_cost)
+
+
+_register_costs()

@@ -1,0 +1,363 @@
+"""Fused softmax-cross-entropy head as Pallas TPU kernels (ISSUE 16).
+
+The GPT loss head (``gpt.loss`` in the r14 MFU-gap table) materializes
+full-vocab log-softmax logits every step: ``F.cross_entropy`` lowers to
+log_softmax → gather → mask, three full passes over the ``[N, V]`` logits
+plus an ``[N, V]`` intermediate.  These kernels fuse the whole head into
+one streaming pass with f32 statistics (max / sum-exp / picked logit kept
+in f32 VMEM scratch regardless of logits dtype — the r6 fused-f32-stats
+convention), with a custom_vjp backward that recomputes softmax from the
+saved log-sum-exp instead of storing it.
+
+Two entry points mirror the two branches of
+``ParallelCrossEntropy.forward``:
+
+* :func:`softmax_ce_loss` — the non-mp branch: full-vocab loss, parity
+  with ``F.cross_entropy(..., reduction="none")``.
+* :func:`softmax_ce_partials` — the mp branch's local half: given
+  globally max-shifted logits of THIS shard and shard-local label
+  indices, one pass produces (sum-exp, picked-logit) partials; the
+  ``pmax`` / ``mp_allreduce`` collectives stay outside the kernel in
+  ``ParallelCrossEntropy`` (reference: c_softmax_with_cross_entropy_op).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .cost_registry import aval_bytes, register_kernel_cost
+
+__all__ = [
+    "softmax_ce_loss",
+    "softmax_ce_partials",
+    "softmax_ce_reference",
+]
+
+NEG_INF = -1e30
+
+
+def softmax_ce_reference(logits, labels, *, ignore_index=-100):
+    """F.cross_entropy(reduction="none") math — the parity oracle."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    lbl = labels.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return jnp.where(valid, -picked, 0.0)
+
+
+# -- full-vocab loss (non-mp branch) ----------------------------------------
+def _ce_fwd_kernel(x_ref, lab_ref, loss_ref, lse_ref, m_ref, l_ref, p_ref, *,
+                   vocab, block_v, n_cols, ignore_index):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        p_ref[...] = jnp.zeros_like(p_ref)
+
+    x = x_ref[...].astype(jnp.float32)               # [bn, bv]
+    col = j * block_v + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    x = jnp.where(col < vocab, x, NEG_INF)           # vocab tail
+    lbl = lab_ref[...][:, None]                      # [bn, 1] int32
+
+    m_prev = m_ref[...][:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(x, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_ref[...][:, :1] \
+        + jnp.sum(jnp.exp(x - m_new), axis=-1, keepdims=True)
+    # the label's raw logit: exactly one hit across the whole row (none
+    # for ignore rows — lbl never equals a column index)
+    hit = jnp.sum(jnp.where(col == lbl, x, 0.0), axis=-1, keepdims=True)
+    p_new = p_ref[...][:, :1] + hit
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+    p_ref[...] = jnp.broadcast_to(p_new, p_ref.shape)
+
+    @pl.when(j == n_cols - 1)
+    def _finish():
+        lse = m_ref[...][:, :1] + jnp.log(l_ref[...][:, :1])
+        valid = lab_ref[...][:, None] != ignore_index
+        loss = jnp.where(valid, lse - p_ref[...][:, :1], 0.0)
+        loss_ref[...] = jnp.broadcast_to(loss, loss_ref.shape)
+        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+
+
+def _ce_bwd_kernel(x_ref, lab_ref, lse_ref, g_ref, dx_ref, *,
+                   vocab, block_v, ignore_index):
+    j = pl.program_id(1)
+    x = x_ref[...].astype(jnp.float32)
+    col = j * block_v + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    lbl = lab_ref[...][:, None]
+    lse = lse_ref[...][:, :1]
+    g = g_ref[...][:, :1]
+    p = jnp.where(col < vocab, jnp.exp(x - lse), 0.0)
+    onehot = (col == lbl).astype(jnp.float32)
+    valid = (lbl != ignore_index).astype(jnp.float32)
+    dx_ref[...] = ((p - onehot) * g * valid).astype(dx_ref.dtype)
+
+
+def softmax_ce_loss(logits, labels, *, ignore_index=-100, interpret=None,
+                    block_n=32, block_v=128):
+    """Fused softmax-CE loss, ``F.cross_entropy(reduction="none")`` parity.
+
+    ``logits`` ``[..., V]``, ``labels`` ``[...]`` int — returns per-row
+    loss with ``labels``' shape in ``logits.dtype`` (statistics in f32).
+    Differentiable w.r.t. ``logits`` via a fused custom_vjp backward.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    vocab = logits.shape[-1]
+    lead = logits.shape[:-1]
+    if not interpret and (vocab % 128 or vocab < 128):
+        return softmax_ce_reference(
+            logits, labels, ignore_index=ignore_index).astype(logits.dtype)
+
+    n = 1
+    for s in lead:
+        n *= int(s)
+    x2 = logits.reshape(n, vocab)
+    lab = labels.astype(jnp.int32).reshape(n)
+    bn = min(block_n, max(n, 1))
+    bv = min(block_v, vocab)
+    n_pad = -n % bn
+    if n_pad:
+        x2 = jnp.pad(x2, ((0, n_pad), (0, 0)))
+        lab = jnp.pad(lab, (0, n_pad), constant_values=ignore_index)
+    np_, ni, nv = n + n_pad, (n + n_pad) // bn, pl.cdiv(vocab, bv)
+
+    def _fwd_raw(x2, lab):
+        fwd = functools.partial(_ce_fwd_kernel, vocab=vocab, block_v=bv,
+                                n_cols=nv, ignore_index=ignore_index)
+        return pl.pallas_call(
+            fwd,
+            grid=(ni, nv),
+            in_specs=[
+                pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
+                pl.BlockSpec((bn,), lambda i, j: (i,)),
+            ],
+            out_specs=[
+                pl.BlockSpec((bn, 128), lambda i, j: (i, 0)),
+                pl.BlockSpec((bn, 128), lambda i, j: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((np_, 128), jnp.float32),
+                jax.ShapeDtypeStruct((np_, 128), jnp.float32),
+            ],
+            scratch_shapes=[pltpu.VMEM((bn, 128), jnp.float32)] * 3,
+            interpret=interpret,
+            name="softmax_ce_fwd",
+        )(x2, lab)
+
+    def _bwd_raw(x2, lab, lse, g):
+        bwd = functools.partial(_ce_bwd_kernel, vocab=vocab, block_v=bv,
+                                ignore_index=ignore_index)
+        return pl.pallas_call(
+            bwd,
+            grid=(ni, nv),
+            in_specs=[
+                pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
+                pl.BlockSpec((bn,), lambda i, j: (i,)),
+                pl.BlockSpec((bn, 128), lambda i, j: (i, 0)),
+                pl.BlockSpec((bn, 128), lambda i, j: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((np_, vocab), x2.dtype),
+            interpret=interpret,
+            name="softmax_ce_bwd",
+        )(x2, lab, lse, g)
+
+    @jax.custom_vjp
+    def _loss(x2):
+        out, _ = _fwd_raw(x2, lab)
+        return out[:, 0]
+
+    def _loss_fwd(x2):
+        out, lse = _fwd_raw(x2, lab)
+        return out[:, 0], (x2, lse)
+
+    def _loss_bwd(res, g):
+        x2, lse = res
+        g2 = jnp.broadcast_to(g.astype(jnp.float32)[:, None], (np_, 128))
+        return (_bwd_raw(x2, lab, lse, g2),)
+
+    _loss.defvjp(_loss_fwd, _loss_bwd)
+    return _loss(x2)[:n].reshape(lead).astype(logits.dtype)
+
+
+# -- mp partials (vocab-sharded branch) -------------------------------------
+def _partials_fwd_kernel(x_ref, lab_ref, se_ref, pk_ref, se_acc, pk_acc, *,
+                         vocab, block_v, n_cols):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        se_acc[...] = jnp.zeros_like(se_acc)
+        pk_acc[...] = jnp.zeros_like(pk_acc)
+
+    x = x_ref[...].astype(jnp.float32)
+    col = j * block_v + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    in_vocab = col < vocab
+    lbl = lab_ref[...][:, None]          # local index, or -1 (other shard)
+    # shifted logits are <= 0 globally (global max already subtracted by
+    # the caller), so plain exp is stable — no online max pass needed
+    se = jnp.sum(jnp.where(in_vocab, jnp.exp(x), 0.0), axis=-1,
+                 keepdims=True)
+    pk = jnp.sum(jnp.where(col == lbl, x, 0.0), axis=-1, keepdims=True)
+    se_acc[...] = se_acc[...] + jnp.broadcast_to(se, se_acc.shape)
+    pk_acc[...] = pk_acc[...] + jnp.broadcast_to(pk, pk_acc.shape)
+
+    @pl.when(j == n_cols - 1)
+    def _finish():
+        se_ref[...] = se_acc[...]
+        pk_ref[...] = pk_acc[...]
+
+
+def _partials_bwd_kernel(x_ref, lab_ref, gse_ref, gpk_ref, dx_ref, *,
+                         vocab, block_v):
+    j = pl.program_id(1)
+    x = x_ref[...].astype(jnp.float32)
+    col = j * block_v + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    lbl = lab_ref[...][:, None]
+    gse = gse_ref[...][:, :1]
+    gpk = gpk_ref[...][:, :1]
+    dse = jnp.where(col < vocab, jnp.exp(x), 0.0) * gse
+    dpk = (col == lbl).astype(jnp.float32) * gpk
+    dx_ref[...] = (dse + dpk).astype(dx_ref.dtype)
+
+
+def softmax_ce_partials(shifted, local_labels, *, interpret=None,
+                        block_n=32, block_v=128):
+    """One-pass (sum-exp, picked-logit) partials over THIS shard's logits.
+
+    ``shifted`` ``[..., V_local]`` logits minus the GLOBAL max (caller's
+    ``pmax``); ``local_labels`` ``[...]`` int32 shard-local label index,
+    or any negative value when the label lives on another shard / is the
+    ignore index.  Returns ``(sum_exp, picked)`` with ``local_labels``'
+    shape in f32 — the caller allreduces both and finishes
+    ``log(sum_exp) - picked``.  Differentiable w.r.t. ``shifted``.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    vocab = shifted.shape[-1]
+    lead = shifted.shape[:-1]
+    if not interpret and (vocab % 128 or vocab < 128):
+        lbl = local_labels.astype(jnp.int32)
+        col = jnp.arange(vocab, dtype=jnp.int32)
+        se = jnp.sum(jnp.exp(shifted.astype(jnp.float32)), axis=-1)
+        pk = jnp.sum(jnp.where(col == lbl[..., None],
+                               shifted.astype(jnp.float32), 0.0), axis=-1)
+        return se, pk
+
+    n = 1
+    for s in lead:
+        n *= int(s)
+    x2 = shifted.reshape(n, vocab)
+    lab = local_labels.astype(jnp.int32).reshape(n)
+    bn = min(block_n, max(n, 1))
+    bv = min(block_v, vocab)
+    n_pad = -n % bn
+    if n_pad:
+        x2 = jnp.pad(x2, ((0, n_pad), (0, 0)), constant_values=NEG_INF)
+        lab = jnp.pad(lab, (0, n_pad), constant_values=-1)
+    np_, ni, nv = n + n_pad, (n + n_pad) // bn, pl.cdiv(vocab, bv)
+
+    def _fwd_raw(x2, lab):
+        fwd = functools.partial(_partials_fwd_kernel, vocab=vocab,
+                                block_v=bv, n_cols=nv)
+        return pl.pallas_call(
+            fwd,
+            grid=(ni, nv),
+            in_specs=[
+                pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
+                pl.BlockSpec((bn,), lambda i, j: (i,)),
+            ],
+            out_specs=[
+                pl.BlockSpec((bn, 128), lambda i, j: (i, 0)),
+                pl.BlockSpec((bn, 128), lambda i, j: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((np_, 128), jnp.float32),
+                jax.ShapeDtypeStruct((np_, 128), jnp.float32),
+            ],
+            scratch_shapes=[pltpu.VMEM((bn, 128), jnp.float32)] * 2,
+            interpret=interpret,
+            name="softmax_ce_partials_fwd",
+        )(x2, lab)
+
+    def _bwd_raw(x2, lab, gse, gpk):
+        bwd = functools.partial(_partials_bwd_kernel, vocab=vocab, block_v=bv)
+        return pl.pallas_call(
+            bwd,
+            grid=(ni, nv),
+            in_specs=[
+                pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
+                pl.BlockSpec((bn,), lambda i, j: (i,)),
+                pl.BlockSpec((bn, 128), lambda i, j: (i, 0)),
+                pl.BlockSpec((bn, 128), lambda i, j: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((np_, vocab), x2.dtype),
+            interpret=interpret,
+            name="softmax_ce_partials_bwd",
+        )(x2, lab, gse, gpk)
+
+    @jax.custom_vjp
+    def _partials(x2):
+        se, pk = _fwd_raw(x2, lab)
+        return se[:, 0], pk[:, 0]
+
+    def _partials_fwd(x2):
+        se, pk = _fwd_raw(x2, lab)
+        return (se[:, 0], pk[:, 0]), x2
+
+    def _partials_bwd(x2, gs):
+        gse, gpk = gs
+        gse2 = jnp.broadcast_to(gse.astype(jnp.float32)[:, None], (np_, 128))
+        gpk2 = jnp.broadcast_to(gpk.astype(jnp.float32)[:, None], (np_, 128))
+        return (_bwd_raw(x2, lab, gse2, gpk2),)
+
+    _partials.defvjp(_partials_fwd, _partials_bwd)
+    se, pk = _partials(x2)
+    return se[:n].reshape(lead), pk[:n].reshape(lead)
+
+
+# -- cost models ------------------------------------------------------------
+_TRANSCENDENTAL_FLOPS = 8  # matches analysis.cost.TRANSCENDENTAL_FLOPS
+
+
+def _rows_vocab(in_avals):
+    x_av = in_avals[0]
+    shape = x_av[0]
+    n = 1
+    for s in shape[:-1]:
+        n *= int(s)
+    return n, int(shape[-1]), x_av
+
+
+def _ce_fwd_cost(in_avals, out_avals, params):
+    n, v, x_av = _rows_vocab(in_avals)
+    # one streaming pass: max + exp + sum + picked-hit per element
+    flops = float(n * v) * (_TRANSCENDENTAL_FLOPS + 3)
+    bts = aval_bytes(x_av) + sum(aval_bytes(a) for a in in_avals[1:]) \
+        + sum(aval_bytes(a) for a in out_avals)
+    return flops, bts
+
+
+def _ce_bwd_cost(in_avals, out_avals, params):
+    n, v, x_av = _rows_vocab(in_avals)
+    flops = float(n * v) * (_TRANSCENDENTAL_FLOPS + 3)
+    bts = aval_bytes(x_av) + sum(aval_bytes(a) for a in in_avals[1:]) \
+        + sum(aval_bytes(a) for a in out_avals)
+    return flops, bts
+
+
+register_kernel_cost("softmax_ce_fwd", _ce_fwd_cost)
+register_kernel_cost("softmax_ce_bwd", _ce_bwd_cost)
+register_kernel_cost("softmax_ce_partials_fwd", _ce_fwd_cost)
+register_kernel_cost("softmax_ce_partials_bwd", _ce_bwd_cost)

@@ -58,6 +58,7 @@ def _swiglu_fwd_raw(x, wg, wu, block_m, block_n, interpret):
         out_specs=pl.BlockSpec((block_m, block_n), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
         interpret=interpret,
+        name="swiglu_fwd",
     )(x, wg, wu)
 
 
@@ -110,3 +111,24 @@ def swiglu(x, w_gate, w_up, *, block_m: int = BLOCK_M, block_n: int = BLOCK_N,
     bn = min(block_n, n)
     out = _swiglu(x2, w_gate, w_up, bm, bn, bool(interpret))
     return out.reshape(*lead, n)
+
+
+def _swiglu_cost(in_avals, out_avals, params):
+    """Two [M,K]x[K,N] MXU projections + the fused silu*up elementwise;
+    the [M,N] intermediates never touch HBM (that's the fusion win)."""
+    from .cost_registry import aval_bytes
+    (m, k), _, _ = in_avals[0]
+    n = int(in_avals[1][0][1])
+    m, k = int(m), int(k)
+    flops = 4.0 * m * k * n + 10.0 * m * n  # sigmoid ~8 + mul + mul
+    bts = sum(aval_bytes(a) for a in in_avals) \
+        + sum(aval_bytes(a) for a in out_avals)
+    return flops, bts
+
+
+def _register_costs():
+    from .cost_registry import register_kernel_cost
+    register_kernel_cost("swiglu_fwd", _swiglu_cost)
+
+
+_register_costs()

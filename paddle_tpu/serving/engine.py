@@ -93,7 +93,8 @@ class ContinuousBatchingEngine:
                  kv_layout: str = "paged", page_size: int = 16,
                  n_pages: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 prefix_sharing: bool = True):
+                 prefix_sharing: bool = True,
+                 attn_impl: str = "xla"):
         import jax.numpy as jnp
 
         from ..models.gpt import GPTForPretraining
@@ -109,6 +110,13 @@ class ContinuousBatchingEngine:
 
         if kv_layout not in ("paged", "slot"):
             raise ValueError("kv_layout must be 'paged' or 'slot'")
+        if attn_impl not in ("xla", "pallas"):
+            raise ValueError("attn_impl must be 'xla' or 'pallas'")
+        if attn_impl == "pallas" and kv_layout != "paged":
+            raise ValueError(
+                "attn_impl='pallas' is the paged flash-decode kernel; it "
+                "requires kv_layout='paged'")
+        self.attn_impl = attn_impl
         model.eval()
         self.model = model
         self.n_slots = int(n_slots)
@@ -358,7 +366,8 @@ class ContinuousBatchingEngine:
             for li, a in enumerate(attns):
                 a._gen_cache = {"mode": "paged", "k": pk[li], "v": pv[li],
                                 "pages": pages, "pos": pos,
-                                "page_size": ps}
+                                "page_size": ps,
+                                "attn_impl": self.attn_impl}
 
         def _collect_caches():
             pk = jnp.stack([unwrap(a._gen_cache["k"]) for a in attns])

@@ -68,6 +68,11 @@ def pytest_configure(config):
         "test_elastic_dp / test_router_failover) — timing-sensitive under "
         "concurrent load; rerun in isolation with `pytest -m chaos` "
         "before calling a failure a regression")
+    config.addinivalue_line(
+        "markers",
+        "pallas: interpret-mode Pallas kernel suites (CPU tier-1 runs "
+        "them; TPU-only shape/tiling parametrizations can be targeted or "
+        "excluded with one `-m pallas` expression)")
 
 
 def pytest_collection_modifyitems(config, items):

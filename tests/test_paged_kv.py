@@ -255,6 +255,87 @@ class TestPagedBitEquivalence:
         assert snap["kv_pages"]["cow_pages"] == 1
         assert snap["kv_pages"]["prefix_hit_rate"] == 0.5
 
+    @pytest.mark.pallas
+    def test_pallas_arm_staggered_cow_matches_gather_and_sequential(
+            self, model):
+        """ISSUE 16 acceptance: the paged flash-decode kernel arm
+        (attn_impl='pallas', interpret mode on CPU) greedy output is
+        token-for-token equal to the XLA-gather arm AND sequential
+        generate over staggered mixed-length requests, including the
+        shared-prefix COW joiners — same gauntlet as the gather-arm
+        test above, with the kernel handling both chunked prefill
+        (T > 1) and decode (T = 1) blocks."""
+        rng = np.random.default_rng(0)
+        base = rng.integers(0, VOCAB, (8,)).astype(np.int32)
+        lens = [3, 5, 7, 4, 9, 6]
+        prompts = [rng.integers(0, VOCAB, (l,)).astype(np.int32)
+                   for l in lens]
+        prompts.append(np.concatenate(
+            [base, rng.integers(0, VOCAB, (3,)).astype(np.int32)]))
+        prompts.append(base.copy())  # joins fully via the shared prefix
+        news = [6, 4, 8, 5, 3, 7, 6, 5]
+        want = [_sequential(model, p, n) for p, n in zip(prompts, news)]
+
+        def drive(eng):
+            first = [eng.submit(Request(p, max_new_tokens=n))
+                     for p, n in zip(prompts[:5], news[:5])]
+            for _ in range(3):
+                eng.step_once()
+            second = [eng.submit(Request(p, max_new_tokens=n))
+                      for p, n in zip(prompts[5:], news[5:])]
+            eng.run_until_idle(timeout=300)
+            return first + second
+
+        results = {}
+        for impl in ("xla", "pallas"):
+            eng = ContinuousBatchingEngine(
+                model, max_seq_len=32, n_slots=4,
+                prefill_buckets=[4, 8, 16], page_size=4, prefill_chunk=8,
+                attn_impl=impl)
+            got = drive(eng)
+            for req, w in zip(got, want):
+                assert req.state == Request.DONE, \
+                    (impl, req.state, req.error)
+                np.testing.assert_array_equal(req.result(), w)
+            results[impl] = [req.result() for req in got]
+            if impl == "pallas":  # COW joiners engaged under the kernel
+                st = eng.page_state()
+                assert st["prefix_hits"] >= 1
+                assert st["prefix_hit_tokens"] >= 8
+        for a, b in zip(results["xla"], results["pallas"]):
+            np.testing.assert_array_equal(a, b)
+
+    @pytest.mark.pallas
+    def test_pallas_arm_exhaustion_fails_only_victim(self, model):
+        """Mid-generation page exhaustion under the kernel arm: victim
+        fails typed, survivors stay exact vs sequential generate."""
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(0, VOCAB, (6,)).astype(np.int32)
+                   for _ in range(3)]
+        want = [_sequential(model, p, 14) for p in prompts]
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=32, n_slots=3, prefill_buckets=[8],
+            page_size=4, n_pages=1 + 9, prefix_sharing=False,
+            attn_impl="pallas")
+        reqs = [eng.submit(Request(p, max_new_tokens=14)) for p in prompts]
+        eng.run_until_idle(timeout=300)
+        done = [i for i, r in enumerate(reqs) if r.state == Request.DONE]
+        failed = [r for r in reqs if r.state == Request.FAILED]
+        assert done and failed
+        for r in failed:
+            assert r.error_type == PagesExhaustedError.error_type
+        for i in done:
+            np.testing.assert_array_equal(reqs[i].result(), want[i])
+        assert eng.page_state()["used"] == 0
+
+    def test_pallas_requires_paged_layout(self, model):
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2,
+                                     kv_layout="slot", attn_impl="pallas")
+        with pytest.raises(ValueError, match="attn_impl"):
+            ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2,
+                                     attn_impl="cuda")
+
     def test_slot_flag_still_available(self, model):
         """The old slot cache stays reachable behind kv_layout='slot' (the
         bit-comparison fallback)."""

@@ -1,0 +1,408 @@
+"""Paged flash-decode + fused softmax-CE Pallas kernels (r20, interpret
+mode on the CPU harness) and the kernel cost registry that prices them:
+kernel-vs-reference parity, cost-model pricing of pallas_call eqns,
+unknown-prim scope attribution, and the committed perf-attribution pins.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas.cost_registry import (
+    kernel_cost_model,
+    registered_kernels,
+)
+from paddle_tpu.ops.pallas.paged_attention import (
+    paged_attention_reference,
+    paged_flash_attention,
+)
+from paddle_tpu.ops.pallas.softmax_ce import (
+    softmax_ce_loss,
+    softmax_ce_partials,
+    softmax_ce_reference,
+)
+
+BENCH_DIR = os.path.join(os.path.dirname(__file__), "..", "benchmarks")
+
+
+def _paged_fixture(rng, b=3, h=4, d=16, ps=8, mp=6, n_pages=20,
+                   lens=(5, 13, 40)):
+    """Pools + tables for slots with mixed live lengths; table entries
+    past each slot's pages point at the reserved trash page 0."""
+    pk = jnp.asarray(rng.normal(size=(n_pages, h, ps, d)), jnp.float32)
+    pv = jnp.asarray(rng.normal(size=(n_pages, h, ps, d)), jnp.float32)
+    pages = np.zeros((b, mp), np.int32)
+    nxt = iter(range(1, n_pages))
+    for i, ln in enumerate(lens):
+        for j in range(-(-(ln + 1) // ps)):
+            pages[i, j] = next(nxt)
+    pos = jnp.asarray(list(lens), jnp.int32)
+    return pk, pv, jnp.asarray(pages), pos, ps
+
+
+@pytest.mark.pallas
+class TestPagedFlashKernel:
+    def test_decode_matches_gather_reference(self):
+        rng = np.random.default_rng(0)
+        pk, pv, pages, pos, ps = _paged_fixture(rng)
+        q = jnp.asarray(rng.normal(size=(3, 4, 1, 16)), jnp.float32)
+        out = paged_flash_attention(q, pk, pv, pages, pos, page_size=ps,
+                                    interpret=True)
+        ref = paged_attention_reference(q, pk, pv, pages, pos, page_size=ps)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-6)
+
+    def test_chunk_prefill_matches_reference(self):
+        """T > 1 (chunked prefill): causal within the chunk AND against
+        the resident pages, same masking as the gather path."""
+        rng = np.random.default_rng(1)
+        pk, pv, pages, pos, ps = _paged_fixture(rng)
+        q = jnp.asarray(rng.normal(size=(3, 4, 5, 16)), jnp.float32)
+        out = paged_flash_attention(q, pk, pv, pages, pos, page_size=ps,
+                                    interpret=True)
+        ref = paged_attention_reference(q, pk, pv, pages, pos, page_size=ps)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-6)
+
+    def test_trash_pages_never_leak(self):
+        """Scribbling on trash page 0 must not change any slot's output —
+        padded table entries are masked by position, not by page id."""
+        rng = np.random.default_rng(2)
+        pk, pv, pages, pos, ps = _paged_fixture(rng)
+        q = jnp.asarray(rng.normal(size=(3, 4, 1, 16)), jnp.float32)
+        base = paged_flash_attention(q, pk, pv, pages, pos, page_size=ps,
+                                     interpret=True)
+        pk2 = pk.at[0].set(1e6)
+        pv2 = pv.at[0].set(-1e6)
+        poisoned = paged_flash_attention(q, pk2, pv2, pages, pos,
+                                         page_size=ps, interpret=True)
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(poisoned))
+
+    def test_shared_page_two_tables(self):
+        """COW-safety precondition: two slots whose tables reference the
+        SAME page (shared prefix) read identical values through it."""
+        rng = np.random.default_rng(3)
+        pk, pv, pages, pos, ps = _paged_fixture(rng, lens=(7, 7, 7))
+        shared = np.array(pages)
+        shared[1] = shared[0]  # slot 1 aliases slot 0's pages wholesale
+        pages2 = jnp.asarray(shared)
+        q = jnp.asarray(rng.normal(size=(3, 4, 1, 16)), jnp.float32)
+        q = q.at[1].set(q[0])
+        out = paged_flash_attention(q, pk, pv, pages2, pos, page_size=ps,
+                                    interpret=True)
+        np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(out[1]))
+
+
+@pytest.mark.pallas
+class TestSoftmaxCEKernel:
+    def test_loss_matches_reference(self):
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(size=(4, 16, 64)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 64, (4, 16)), jnp.int32)
+        labels = labels.at[0, 3].set(-100).at[2, 0].set(-100)
+        loss = softmax_ce_loss(logits, labels, interpret=True)
+        ref = softmax_ce_reference(logits, labels)
+        np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+        # ignore rows are exactly zero, not merely small
+        assert float(loss[0, 3]) == 0.0 and float(loss[2, 0]) == 0.0
+
+    def test_grad_matches_reference(self):
+        rng = np.random.default_rng(1)
+        logits = jnp.asarray(rng.normal(size=(2, 8, 64)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 64, (2, 8)), jnp.int32)
+        labels = labels.at[1, 5].set(-100)
+        g1 = jax.grad(lambda x: jnp.sum(jnp.sin(
+            softmax_ce_loss(x, labels, interpret=True))))(logits)
+        g2 = jax.grad(lambda x: jnp.sum(jnp.sin(
+            softmax_ce_reference(x, labels))))(logits)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_vocab_not_multiple_of_block(self):
+        rng = np.random.default_rng(2)
+        logits = jnp.asarray(rng.normal(size=(8, 200)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 200, (8,)), jnp.int32)
+        loss = softmax_ce_loss(logits, labels, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(loss), np.asarray(softmax_ce_reference(logits, labels)),
+            rtol=1e-5, atol=1e-6)
+
+    def test_partials_match_and_grad(self):
+        """The mp branch's local kernel: sum-exp + picked partials on
+        globally-shifted logits; collectives stay outside."""
+        rng = np.random.default_rng(3)
+        v = 64
+        logits = jnp.asarray(rng.normal(size=(4, 8, v)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, v, (4, 8)), jnp.int32)
+        labels = labels.at[0, 0].set(-100)
+        shifted = logits - jnp.max(logits, -1, keepdims=True)
+        loc = jnp.where(labels >= 0, labels, -1)
+        se, pk = softmax_ce_partials(shifted, loc, interpret=True)
+        se_ref = jnp.sum(jnp.exp(shifted), -1)
+        pk_ref = jnp.where(
+            labels >= 0,
+            jnp.take_along_axis(shifted, jnp.where(labels >= 0, labels, 0)
+                                [..., None], -1)[..., 0], 0.0)
+        np.testing.assert_allclose(np.asarray(se), np.asarray(se_ref),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(pk), np.asarray(pk_ref),
+                                   rtol=1e-6)
+
+        def f(x):
+            se, pk = softmax_ce_partials(x, loc, interpret=True)
+            return jnp.sum(jnp.log(se)) - jnp.sum(pk)
+
+        def fr(x):
+            se = jnp.sum(jnp.exp(x), -1)
+            pk = jnp.sum(jnp.where(
+                jnp.arange(v, dtype=jnp.int32) == loc[..., None], x, 0.0), -1)
+            return jnp.sum(jnp.log(se)) - jnp.sum(pk)
+
+        np.testing.assert_allclose(np.asarray(jax.grad(f)(shifted)),
+                                   np.asarray(jax.grad(fr)(shifted)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_criterion_flag_parity(self):
+        """GPTPretrainingCriterion under the flag == without, fwd + grad
+        (the non-mp ParallelCrossEntropy branch, f32 inputs)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.framework.flags import set_flags
+        from paddle_tpu.models.gpt import (
+            GPTForPretraining,
+            GPTPretrainingCriterion,
+            gpt_config,
+        )
+
+        paddle.seed(0)
+        cfg = gpt_config("gpt2-small", vocab_size=64, hidden_size=32,
+                         num_layers=2, num_attention_heads=4,
+                         max_position_embeddings=64, hidden_dropout_prob=0.0,
+                         attention_dropout_prob=0.0)
+        model = GPTForPretraining(cfg)
+        crit = GPTPretrainingCriterion()
+        ids = paddle.to_tensor(
+            np.random.default_rng(0).integers(0, 64, (2, 8)).astype("int32"))
+
+        def loss_and_grad():
+            loss = crit(model(ids), ids)
+            loss.backward()
+            g = {n: np.asarray(p.grad._data)
+                 for n, p in model.named_parameters() if p.grad is not None}
+            model.clear_gradients()
+            return float(loss._data), g
+
+        l0, g0 = loss_and_grad()
+        set_flags({"FLAGS_use_pallas_softmax_ce": True})
+        try:
+            l1, g1 = loss_and_grad()
+        finally:
+            set_flags({"FLAGS_use_pallas_softmax_ce": False})
+        assert abs(l0 - l1) < 1e-5, (l0, l1)
+        assert g0.keys() == g1.keys()
+        for n in g0:
+            np.testing.assert_allclose(g0[n], g1[n], rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.pallas
+class TestKernelCostRegistry:
+    def test_shipped_kernels_registered(self):
+        names = registered_kernels()
+        for k in ("paged_flash_attention", "softmax_ce_fwd",
+                  "softmax_ce_bwd", "softmax_ce_partials_fwd",
+                  "flash_attention_fwd", "flash_attention_bwd_dq",
+                  "flash_attention_bwd_dkv", "rope_fwd", "swiglu_fwd",
+                  "fused_residual_dropout_ln_fwd"):
+            assert k in names, (k, names)
+        assert kernel_cost_model("no_such_kernel") is None
+
+    def test_pallas_eqn_priced_not_unknown(self):
+        """graph_cost over a program containing the paged kernel: the
+        pallas_call eqn is priced from the registry (flops > 0, no
+        GraphCost.unknown tally) and the kernel-body inner eqns are not
+        double counted."""
+        from paddle_tpu.analysis.cost import graph_cost
+        from paddle_tpu.analysis.graph import AnalysisTarget
+
+        rng = np.random.default_rng(0)
+        pk, pv, pages, pos, ps = _paged_fixture(rng)
+        q = jnp.asarray(rng.normal(size=(3, 4, 1, 16)), jnp.float32)
+
+        def fn(q, pk, pv):
+            return paged_flash_attention(q, pk, pv, pages, pos,
+                                         page_size=ps, interpret=True)
+
+        t = AnalysisTarget("paged_kernel", fn, (q, pk, pv))
+        gc = graph_cost(t.graph(), t.mesh_axes)
+        assert "pallas_call" not in gc.unknown, gc.unknown
+        assert gc.flops > 0
+        model = kernel_cost_model("paged_flash_attention")
+        # hand-check the registered model against the kernel's operands:
+        # bytes = touched pages (B*MP K+V blocks) + q/out/table — far less
+        # than the gather path's materialized [B, cap, H, D] round-trip
+        b, mp = pages.shape
+        _, h, t_, d = q.shape
+        in_avals = [((b, mp), "int32", False), ((b,), "int32", False),
+                    (tuple(q.shape), "float32", False),
+                    (tuple(pk.shape), "float32", False),
+                    (tuple(pv.shape), "float32", False)]
+        out_avals = [(tuple(q.shape), "float32", False)]
+        flops, bts = model(in_avals, out_avals, {})
+        s = mp * ps
+        assert flops == 4.0 * b * h * t_ * s * d + 16.0 * b * h * t_ * s
+        assert bts == (b * mp * h * ps * d * 8      # K+V pages, f32
+                       + q.size * 4 * 2 + pages.size * 4 + pos.size * 4)
+
+    def test_unregistered_kernel_keeps_loud_fallback(self):
+        """A pallas_call without a registered cost model still lands in
+        GraphCost.unknown (bytes-only) — never silently zero-costed."""
+        from jax.experimental import pallas as pl
+
+        from paddle_tpu.analysis.cost import graph_cost
+        from paddle_tpu.analysis.graph import AnalysisTarget
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+
+        def fn(x):
+            return pl.pallas_call(
+                kern, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                interpret=True, name="not_in_registry")(x)
+
+        t = AnalysisTarget("anon_kernel", fn,
+                          (jnp.ones((8, 128), jnp.float32),))
+        gc = graph_cost(t.graph(), t.mesh_axes)
+        assert gc.unknown.get("pallas_call") == 1
+        assert gc.estimated
+
+    def test_unknown_where_scope_attribution(self):
+        """Satellite: GraphCost.unknown entries carry the r14 scope path
+        of the first offending eqn, so an unpriced prim is attributable
+        without a jaxpr dig."""
+        from paddle_tpu.analysis.cost import graph_cost
+        from paddle_tpu.analysis.graph import AnalysisTarget
+        from paddle_tpu.profiler.scope import scope
+
+        def fn(x):
+            with scope("model.sorter"):
+                y = jnp.sort(x, axis=-1)
+            return y + jnp.sort(x, axis=0)
+
+        t = AnalysisTarget("sorty", fn, (jnp.ones((8, 16), jnp.float32),))
+        gc = graph_cost(t.graph(), t.mesh_axes)
+        assert "sort" in gc.unknown
+        assert gc.unknown_where["sort"] == "model.sorter"  # FIRST offender
+        assert "unknown_where" in gc.to_dict()
+
+    def test_planner_prices_shift_when_ce_kernel_flips(self):
+        """Acceptance pin: analysis/plan.py candidate prices provably
+        change when the softmax-CE kernel flag flips (the lowered loss
+        head changes, and the registry prices its pallas_call eqns)."""
+        from paddle_tpu.analysis.plan import plan_gpt
+        from paddle_tpu.framework.flags import set_flags
+        from paddle_tpu.models.gpt import gpt_config
+
+        cfg = gpt_config("gpt2-small", vocab_size=128, hidden_size=32,
+                         num_layers=2, num_attention_heads=4,
+                         max_position_embeddings=32,
+                         hidden_dropout_prob=0.0,
+                         attention_dropout_prob=0.0)
+
+        def prices():
+            plan = plan_gpt(cfg, n_devices=2, global_batch=4, seq_len=16,
+                            max_lowered=1)
+            return {str(r.spec): (r.flops_per_device,
+                                  r.hbm_bytes_per_device, r.step_time_s)
+                    for r in plan.candidates if r.priced_by == "analysis"}
+
+        off = prices()
+        set_flags({"FLAGS_use_pallas_softmax_ce": True})
+        try:
+            on = prices()
+        finally:
+            set_flags({"FLAGS_use_pallas_softmax_ce": False})
+        assert off and on
+        common = set(off) & set(on)
+        assert common and any(off[k] != on[k] for k in common), (off, on)
+
+
+@pytest.mark.pallas
+class TestServingEntryPointPins:
+    @pytest.fixture(scope="class")
+    def serving(self):
+        from paddle_tpu.analysis.entrypoints import serving_targets
+
+        return {t.name: t for t in serving_targets()}
+
+    def test_kernel_on_decode_zero_unknown_pallas(self, serving):
+        """Acceptance pin: the kernel-on serving entry points lint with
+        ZERO unknown-prim pallas entries."""
+        from paddle_tpu.analysis.cost import graph_cost
+
+        for name in ("serving_decode_pallas", "serving_prefill_pallas"):
+            t = serving[name]
+            gc = graph_cost(t.graph(), t.mesh_axes)
+            assert "pallas_call" not in gc.unknown, (name, gc.unknown)
+
+    def test_paged_attn_intensity_improves(self, serving):
+        """The serving.paged_attn scope's arithmetic intensity under the
+        flash kernel beats the XLA gather arm (the gather materializes
+        the [B, cap, H, D] tensor; the kernel streams pages once)."""
+        from paddle_tpu.analysis.cost import scope_costs
+
+        def attn_intensity(name):
+            sc = scope_costs(serving[name].graph(),
+                             serving[name].mesh_axes)
+            fl = by = 0.0
+            for key, row in sc.items():
+                if "serving.paged_attn" in key:
+                    fl += row.flops
+                    by += row.bytes_accessed
+            assert by > 0, name
+            return fl / by
+
+        assert attn_intensity("serving_decode_pallas") \
+            > 2.0 * attn_intensity("serving_decode")
+
+
+@pytest.mark.pallas
+class TestCommittedArtifactPins:
+    """Pins over the regenerated benchmarks/perf_attribution.json: both
+    serving arms are committed side by side, the kernel-on arm prices
+    every pallas_call, and its paged-attn row's roofline position
+    improves on the gather row."""
+
+    @pytest.fixture(scope="class")
+    def perf(self):
+        path = os.path.join(BENCH_DIR, "perf_attribution.json")
+        with open(path) as f:
+            return json.load(f)
+
+    def test_both_serving_arms_committed(self, perf):
+        entries = perf["entries"]
+        assert "serving_decode" in entries
+        assert "serving_decode_pallas" in entries
+        assert entries["serving_decode_pallas"]["config"]["attn_impl"] \
+            == "pallas"
+
+    def test_kernel_arm_zero_unknown_pallas(self, perf):
+        unk = perf["entries"]["serving_decode_pallas"]["graph_cost"][
+            "unknown_prims"]
+        assert "pallas_call" not in unk, unk
+
+    def test_paged_attn_row_improves_vs_gather(self, perf):
+        def attn_rows(entry):
+            fl = by = 0.0
+            for row in perf["entries"][entry]["rows"]:
+                if "serving.paged_attn" in row["scope"]:
+                    fl += row["flops"]
+                    by += row["bytes_accessed"]
+            assert by > 0, entry
+            return fl / by
+
+        gather = attn_rows("serving_decode")
+        flash = attn_rows("serving_decode_pallas")
+        assert flash > 2.0 * gather, (gather, flash)
