@@ -1180,6 +1180,100 @@ def _router_failover(on_tpu):
                 pass
 
 
+def _stream_resurrection(on_tpu):
+    """Zero-loss stream secondary (ISSUE 17): two engine replicas behind
+    the router, the replica holding an IN-FLIGHT stream killed abruptly
+    after it has streamed tokens. The router resurrects the stream on the
+    survivor as a continuation join; records how many observed tokens the
+    resurrection preserved, the recovery time (kill → first CONTINUED
+    token on the survivor) and the duplicate count (the zero-loss
+    acceptance says zero dropped AND zero duplicated)."""
+    import gc
+    import threading
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+    from paddle_tpu.serving import (ContinuousBatchingEngine, Request,
+                                    ServingRouter, ServingServer)
+
+    if on_tpu:
+        overrides = {}
+        name, max_new, s = "gpt3-350m", 64, 512
+    else:
+        name, max_new, s = "gpt2-small", 48, 128
+        overrides = dict(vocab_size=64, hidden_size=16, num_layers=1,
+                         num_attention_heads=2, max_position_embeddings=128)
+    cfg = gpt_config(name, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0, **overrides)
+    paddle.seed(0)
+    clear_mesh()
+    gc.collect()
+    init_mesh({"dp": 1})
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+
+    def replica():
+        eng = ContinuousBatchingEngine(model, max_seq_len=s, n_slots=1,
+                                       prefill_buckets=[8], max_queue=16)
+        return ServingServer(eng).start()
+
+    servers = {srv.addr: srv for srv in (replica(), replica())}
+    addrs = list(servers)
+    prompt = rng.integers(0, cfg.vocab_size, (4,)).tolist()
+    try:
+        with ServingRouter(addrs, health_interval_s=0.1, cooldown_s=30.0,
+                           request_timeout=10.0) as router:
+            router.check_health()
+            # warm both replicas: compiles out of the recovery-time path
+            for rr in [router.submit(prompt, max_new_tokens=2)
+                       for _ in range(2)]:
+                router.wait(rr, timeout=600)
+            router.check_health()
+            rr = router.submit(prompt, max_new_tokens=max_new,
+                               temperature=0.9, seed=17)
+            victim = rr.replica_addr
+            got = []
+            thread = threading.Thread(
+                target=lambda: got.extend(router.stream(rr)))
+            thread.start()
+            # kill only after the stream is visibly mid-generation: the
+            # resurrection path (not the queued-resubmit path) must run
+            deadline = time.perf_counter() + 600
+            while len(got) < 5:
+                if time.perf_counter() > deadline:
+                    raise TimeoutError("stream never reached 5 tokens")
+                time.sleep(0.002)
+            preserved = len(rr.tokens)
+            t_kill = time.perf_counter()
+            servers[victim].kill()
+            thread.join(600)
+            snap = router.snapshot()
+            recovery_s = (
+                round(rr.failover_first_token_at - t_kill, 4)
+                if rr.failover_first_token_at is not None else None)
+            return {
+                "stream_resurrection_recovery_s": recovery_s,
+                "stream_resurrection_tokens_preserved": preserved,
+                # got is the caller-visible stream across the death;
+                # equality with the settled transcript means zero
+                # duplicated AND zero dropped tokens
+                "stream_resurrection_duplicate_tokens":
+                    len(got) - len(rr.tokens),
+                "stream_resurrection_dropped_tokens":
+                    max_new - len(got),
+                "stream_resurrection_resurrections":
+                    snap["resurrections"],
+            }
+    finally:
+        for srv in servers.values():
+            try:
+                srv.kill()
+            except Exception:
+                pass
+
+
 def _store_failover(on_tpu):
     """Coordination-store chaos secondary (ISSUE 12): a 3-replica quorum
     store with a heartbeating client, the LEADER killed abruptly.
@@ -1549,6 +1643,13 @@ def main():
         except Exception as e:  # pragma: no cover - device dependent
             secondary["router_failover_recovery_s"] = f"failed: {type(e).__name__}"
         try:
+            # robustness: in-flight stream resurrected as a continuation
+            # join on replica death (ISSUE 17)
+            secondary.update(_stream_resurrection(True))
+        except Exception as e:  # pragma: no cover - device dependent
+            secondary["stream_resurrection_recovery_s"] = \
+                f"failed: {type(e).__name__}"
+        try:
             # observability: telemetry-plane tax on both hot paths (ISSUE 7)
             secondary.update(_observability_overhead(True))
         except Exception as e:  # pragma: no cover - device dependent
@@ -1636,6 +1737,11 @@ def main():
             secondary.update(_router_failover(False))
         except Exception as e:  # pragma: no cover
             secondary["router_failover_recovery_s"] = f"failed: {type(e).__name__}"
+        try:
+            secondary.update(_stream_resurrection(False))
+        except Exception as e:  # pragma: no cover
+            secondary["stream_resurrection_recovery_s"] = \
+                f"failed: {type(e).__name__}"
         try:
             secondary.update(_observability_overhead(False))
         except Exception as e:  # pragma: no cover

@@ -23,7 +23,7 @@ from ..ops._primitive import unwrap, wrap
 from ..random import split_key
 from ..tensor import Tensor
 
-__all__ = ["generate", "sample_tokens"]
+__all__ = ["generate", "sample_tokens", "fast_forward_key"]
 
 
 def _attn_layers(model):
@@ -46,6 +46,30 @@ def _is_key_batch(key, batch):
     if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
         return key.ndim == 1 and key.shape[0] == batch
     return key.ndim == 2 and key.shape[0] == batch
+
+
+def fast_forward_key(key, n):
+    """Advance a per-request PRNG key chain by ``n`` draws.
+
+    The serving engine's decode chain is ``key -> split(key)[0]`` once per
+    emitted token (the final prefill chunk consumes the first draw, every
+    decode step one more — both keep index ``[0]`` as the carried chain and
+    spend index ``[1]`` on sampling). After ``n`` emitted tokens the carried
+    chain state is therefore ``split`` applied ``n`` times taking ``[0]``,
+    which is what this computes — the continuation-join resume point for a
+    stream resurrected (or migrated) with ``n`` observed tokens, so the
+    continued trajectory samples from exactly the keys the uninterrupted
+    run would have drawn. Accepts typed or raw ``uint32[2]`` keys; jittable
+    (``n`` is a static python int here — one program per distinct n is
+    avoided by the ``fori_loop``).
+    """
+    n = int(n)
+    if n < 0:
+        raise ValueError(f"cannot fast-forward a key chain by {n} draws")
+    if n == 0:
+        return key
+    return jax.lax.fori_loop(
+        0, n, lambda _, k: jax.random.split(k)[0], key)
 
 
 def sample_tokens(logits, key, temperature=0.0, top_k=None, top_p=None):

@@ -72,6 +72,10 @@ _LOWER = ("overhead", "ttft", "latency", "_ms", "recovery_s",
 _MAGNITUDE = ("drift", "est_vs_measured")
 _COUNT_MAX = ("silent_drops", "dropped_requests", "inflight_failures",
               "admitted_killed", "writes_lost",
+              # zero-loss streams (r21): a resurrection or migration that
+              # duplicates or drops even one token breaks the continuation
+              # contract — must stay zero
+              "duplicate_tokens", "dropped_tokens",
               # replicated checkpoint plane (r19): a manifest-committed
               # snapshot that cannot be reassembled after disk loss is a
               # durability-contract violation — must stay zero

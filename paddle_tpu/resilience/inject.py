@@ -122,6 +122,13 @@ POINTS = (
     "replica.tick",
     "serving.pages.exhausted",
     "router.transport",
+    # zero-loss streams (r21): `router.resurrect` fires at the head of a
+    # continuation re-home (stall = wall-clock the recovery burns before
+    # the resubmit, for deadline tests; raise = the recovery machinery
+    # itself dying), `router.migrate` fires per migration stage
+    # (labels src/dst/stage=export|import) before each hop's RPC
+    "router.resurrect",
+    "router.migrate",
     "elastic.rank.step",
     "preemption.update",
 )

@@ -29,7 +29,12 @@ from .admission import (  # noqa: F401
     DeadlineExceededError,
     LoadShedPolicy,
 )
-from .engine import ContinuousBatchingEngine  # noqa: F401
+from .engine import (  # noqa: F401
+    MIGRATED_ERROR_TYPE,
+    ContinuousBatchingEngine,
+    make_continuation_record,
+    verify_continuation_record,
+)
 from .metrics import ServingMetrics  # noqa: F401
 from .paged import (  # noqa: F401
     PagePool,
@@ -43,7 +48,12 @@ from .scheduler import (  # noqa: F401
     SchedulerClosed,
     power_of_two_buckets,
 )
-from .router import NoReplicaAvailable, RoutedRequest, ServingRouter  # noqa: F401
+from .router import (  # noqa: F401
+    NoReplicaAvailable,
+    ResurrectionFailedError,
+    RoutedRequest,
+    ServingRouter,
+)
 from .server import (  # noqa: F401
     RequestFailedError,
     ServingClient,
@@ -66,6 +76,10 @@ __all__ = [
     "ServingRouter",
     "RoutedRequest",
     "NoReplicaAvailable",
+    "ResurrectionFailedError",
+    "MIGRATED_ERROR_TYPE",
+    "make_continuation_record",
+    "verify_continuation_record",
     "AdmissionGate",
     "AdmissionRejected",
     "DeadlineExceededError",

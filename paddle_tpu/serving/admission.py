@@ -243,8 +243,12 @@ class AdmissionGate:
         :class:`AdmissionRejected` (estimate attached) on refusal. Paged
         layout: the refusal cites the predicted page-pool watermark
         (predicted/free/budget) alongside the liveness bytes."""
+        # the gate runs BEFORE scheduler.submit assigns req.bucket, so the
+        # fallback must price what will actually be prefilled: for a
+        # continuation join that is prompt+observed (net of radix-resident
+        # pages on the page side), not the bare prompt
         bucket = req.bucket or self.engine.scheduler.bucket_for(
-            req.prompt.size)
+            req.prefill_len)
         price = self.price(bucket)
         if price["predicted_peak_hbm_bytes"] > self.budget_bytes:
             pages = self.page_watermark(req)

@@ -46,7 +46,65 @@ from .metrics import ServingMetrics
 from .paged import TRASH_PAGE, PagePool, PagesExhaustedError, RadixCache
 from .scheduler import FCFSScheduler, Request, power_of_two_buckets
 
-__all__ = ["ContinuousBatchingEngine"]
+__all__ = ["ContinuousBatchingEngine", "MIGRATED_ERROR_TYPE",
+           "make_continuation_record", "verify_continuation_record"]
+
+#: ``error_type`` stamped on a request whose stream was exported to another
+#: replica (live migration): the id is retired HERE but the stream lives on
+#: — routers treat this as "moved", never as a request-level failure
+MIGRATED_ERROR_TYPE = "MigratedError"
+
+
+def _record_crc(record: Dict) -> int:
+    import json
+    import zlib
+
+    payload = {k: v for k, v in record.items() if k != "crc"}
+    blob = json.dumps(payload, sort_keys=True,
+                      separators=(",", ":")).encode()
+    return zlib.crc32(blob) & 0xFFFFFFFF
+
+
+def make_continuation_record(req: Request, deadline_remaining=None) -> Dict:
+    """CRC-stamped continuation record for one in-flight stream: the full
+    transcript + sampling params + key-chain position (= len(tokens)).
+    Everything a peer needs to continuation-join the stream bit-identically;
+    the CRC covers the canonical JSON so a torn transfer is detected at
+    import, mirroring the r19 blob plane's integrity discipline."""
+    record = {
+        "v": 1,
+        "kind": "continuation",
+        "request_id": req.request_id,
+        "prompt": [int(t) for t in req.prompt],
+        "tokens": [int(t) for t in req.tokens],
+        "max_new_tokens": int(req.max_new_tokens),
+        "eos_token_id": req.eos_token_id,
+        "temperature": float(req.temperature),
+        "top_k": req.top_k,
+        "top_p": req.top_p,
+        # the seed the engine ACTUALLY keyed this stream's chain with —
+        # a sampled request submitted without one still resumes exactly
+        "seed": int(getattr(req, "effective_seed", req.seed or 0)),
+        "deadline_remaining": (None if deadline_remaining is None
+                               else float(deadline_remaining)),
+    }
+    record["crc"] = _record_crc(record)
+    return record
+
+
+def verify_continuation_record(record: Dict) -> Dict:
+    """Validate a continuation record's shape + CRC; raises ValueError on
+    a torn/corrupt/alien payload (the import endpoint maps this to 400)."""
+    if not isinstance(record, dict) or record.get("kind") != "continuation":
+        raise ValueError("not a continuation record")
+    if "crc" not in record or "prompt" not in record or "tokens" not in record:
+        raise ValueError("continuation record missing required fields")
+    if int(record["crc"]) != _record_crc(record):
+        raise ValueError(
+            "continuation record CRC mismatch (torn or corrupted transfer)")
+    if not record["tokens"]:
+        raise ValueError("continuation record carries no observed tokens")
+    return record
 
 # Tracing prefill_fn/step_fn temporarily hangs `_gen_cache` off the model's
 # attention layers; two engines sharing one model object (multi-replica
@@ -187,9 +245,13 @@ class ContinuousBatchingEngine:
         self.scheduler = scheduler or FCFSScheduler(
             buckets, max_queue=max_queue,
             max_prefills_per_tick=max_prefills_per_tick)
-        if self._paged and self.prefill_chunk is not None:
-            # chunked prefill admits prompts longer than the largest
-            # bucket (they split); the scheduler buckets only the chunk
+        if self._paged:
+            # chunked prefill admits sequences longer than the largest
+            # bucket (they split; the paged path ALWAYS runs the chunk
+            # loop, capped at max(buckets) without prefill_chunk), so the
+            # scheduler buckets only the chunk — this is what lets a
+            # continuation join (prompt + observed transcript) re-home
+            # onto a replica whose buckets the bare prompt was sized for
             self.scheduler.bucket_cap = self._chunk_limit
         self.metrics = metrics or ServingMetrics()
         self.metrics.n_slots = self.n_slots
@@ -525,9 +587,14 @@ class ContinuousBatchingEngine:
         if not self._paged:
             return 0
         total = -(-(req.prompt.size + req.max_new_tokens) // self.page_size)
-        shared = self._radix.peek(req.prompt) if self._radix else 0
-        # a whole-prompt hit still copies one page (copy-on-write)
-        if shared * self.page_size >= req.prompt.size and shared > 0:
+        # continuation joins price against the JOIN sequence (prompt +
+        # observed[:-1]): that is what prefill writes and what the radix
+        # tree can discount — a mass resurrection after a replica death is
+        # gated on what it will truly allocate, not the raw prompt
+        seq = req.prefill_ids()
+        shared = self._radix.peek(seq) if self._radix else 0
+        # a whole-prefix hit still copies one page (copy-on-write)
+        if shared * self.page_size >= seq.size and shared > 0:
             shared -= 1
         return max(total - shared, 1)
 
@@ -573,6 +640,15 @@ class ContinuousBatchingEngine:
             raise DeadlineExceededError(
                 f"request {req.request_id} arrived with its deadline "
                 f"already elapsed (deadline_s={req.deadline_s})")
+        if req.observed_terminal:
+            # the observed transcript already finished (max_new_tokens or
+            # eos) on its previous home: nothing to prefill or decode —
+            # complete immediately so poll/stream replay the full log
+            self.metrics.on_submit()
+            req.state = Request.RUNNING
+            req._finish(Request.DONE)
+            self.metrics.on_complete()
+            return req
         if self.admission_gate is not None:
             try:
                 self.admission_gate.check(req)
@@ -587,6 +663,45 @@ class ContinuousBatchingEngine:
             raise
         self.metrics.on_submit()
         return req
+
+    def export_stream(self, request_id: str) -> Dict:
+        """Live-migration source half: drain ONE active stream between
+        ticks — build its CRC-stamped continuation record, free its slot
+        and pages, and retire the local id with the typed
+        :data:`MIGRATED_ERROR_TYPE` (routers read that as "moved", not
+        failed). Raises KeyError for an id this engine is not decoding
+        (unknown, queued, finished) and ValueError for a mid-prefill slot
+        (its KV is incomplete — nothing coherent to export yet)."""
+        with self._lock:
+            slot_idx = next(
+                (i for i in range(self.n_slots)
+                 if self._slots[i] is not None
+                 and self._slots[i].request_id == request_id), None)
+            if slot_idx is None:
+                raise KeyError(
+                    f"request {request_id!r} holds no slot on this replica "
+                    f"(unknown, still queued, or already finished)")
+            req = self._slots[slot_idx]
+            if not self._active[slot_idx]:
+                raise ValueError(
+                    f"request {request_id!r} is mid-prefill; only actively "
+                    f"decoding streams are exportable")
+            record = make_continuation_record(
+                req, deadline_remaining=req.deadline_remaining())
+            if self._paged:
+                self._free_paged_slot(slot_idx, req)
+            else:
+                self._slots[slot_idx] = None
+                self._active[slot_idx] = False
+            req._finish(
+                Request.FAILED,
+                f"{MIGRATED_ERROR_TYPE}: stream exported off this replica "
+                f"after {len(req.tokens)} tokens",
+                error_type=MIGRATED_ERROR_TYPE)
+            self.metrics.on_export()
+            self.metrics.set_gauges(self.scheduler.depth(),
+                                    self.active_slots(), self.n_slots)
+        return record
 
     def _settle_gate(self, req: Request):
         """Release the admission gate's page-watermark reservation for a
@@ -621,11 +736,13 @@ class ContinuousBatchingEngine:
 
         from ..profiler.scope import scope
 
-        t0 = req.prompt.size
+        seq = req.prefill_ids()
+        t0 = seq.size
         bucket = req.bucket or self.scheduler.bucket_for(t0)
         ids = np.zeros((1, bucket), np.int32)
-        ids[0, :t0] = req.prompt
-        key = jax.random.PRNGKey(self._seed_for(req))
+        ids[0, :t0] = seq
+        seed = self._seed_for(req)
+        key = jax.random.PRNGKey(seed)
         before = self.trace_counts["prefill"]
         # request-scoped spans: queue wait is recorded retrospectively
         # (submit → this admission), and the prefill span parents the
@@ -659,8 +776,15 @@ class ContinuousBatchingEngine:
             if prefill_span is not None:
                 req._decode_span_parent = prefill_span.span_id
         self.metrics.on_prefill(compiled)
-        first = int(first)
+        first, key = self._resume_state(req, seed, first, key)
         req.state = Request.RUNNING
+        if req.observed:
+            # continuation join (see _run_chunk): resume decode from the
+            # last observed token with the fast-forwarded key chain
+            self.metrics.on_continuation(len(req.observed))
+            self._slots[slot_idx] = req
+            self._activate(slot_idx, req, first, t0, key)
+            return True
         req._append(first)
         self.metrics.on_first_token(req.first_token_at - req.submitted_at,
                                     trace_id=req.trace_id)
@@ -686,8 +810,30 @@ class ContinuousBatchingEngine:
     def _seed_for(self, req: Request) -> int:
         if req.seed is None:
             self._seed_counter += 1
+            # recorded so a later export (live migration) can pin the key
+            # chain the engine actually used for this stream
+            req.effective_seed = self._seed_counter
             return self._seed_counter
+        req.effective_seed = int(req.seed)
         return int(req.seed)
+
+    def _resume_state(self, req: Request, seed: int, sampled_first,
+                      sampled_key):
+        """The (first, key) pair to activate decode with after the final
+        prefill chunk. Fresh request: the in-graph sampled token and the
+        advanced chain. Continuation join: the sampled token/key belong to
+        a draw the ORIGINAL run already spent — discard them, resume from
+        the last observed token with the chain fast-forwarded by
+        len(observed) draws (bit-identical to the uninterrupted run)."""
+        if not req.observed:
+            return int(sampled_first), sampled_key
+        import jax
+
+        from ..models.generation import fast_forward_key
+
+        key = fast_forward_key(jax.random.PRNGKey(int(seed)),
+                               len(req.observed))
+        return int(req.observed[-1]), key
 
     # -- paged admission + chunked prefill ----------------------------------
     def _alloc_pages(self, n: int, phase: str):
@@ -719,12 +865,17 @@ class ContinuousBatchingEngine:
         when the request finished (or failed) without occupying the
         slot."""
         ps = self.page_size
-        t0 = req.prompt.size
+        # the JOIN sequence: the whole prompt, plus — for a continuation
+        # (resurrected/migrated stream) — every observed token but the
+        # last; KV must cover exactly the positions the uninterrupted run
+        # had written when it was interrupted
+        seq = req.prefill_ids()
+        t0 = seq.size
         req._pages = []
         try:
             matched: List[int] = []
             if self._radix is not None:
-                matched = self._radix.match(req.prompt)
+                matched = self._radix.match(seq)
                 req._pages.extend(matched)
             resume = len(matched) * ps
             cow = (0, 0)
@@ -760,9 +911,11 @@ class ContinuousBatchingEngine:
         queue_span = self._record_queue_span(req)
         import jax
 
-        key = jax.random.PRNGKey(self._seed_for(req))
-        state = {"req": req, "next": int(resume), "key": key, "cow": cow,
-                 "queue_span": queue_span, "chunks": 0}
+        seed = self._seed_for(req)
+        key = jax.random.PRNGKey(seed)
+        state = {"req": req, "seq": seq, "seed": seed, "next": int(resume),
+                 "key": key, "cow": cow, "queue_span": queue_span,
+                 "chunks": 0}
         self._slots[slot_idx] = req
         self._prefill_slots[slot_idx] = state
         try:
@@ -792,13 +945,14 @@ class ContinuousBatchingEngine:
         from ..profiler.scope import scope
 
         req: Request = state["req"]
-        t0 = req.prompt.size
+        seq = state["seq"]
+        t0 = seq.size
         start = state["next"]
         rlen = min(t0 - start, self._chunk_limit)
         bucket = self._chunk_bucket_for(rlen)
         is_final = start + rlen >= t0
         ids = np.zeros((1, bucket), np.int32)
-        ids[0, :rlen] = req.prompt[start:start + rlen]
+        ids[0, :rlen] = seq[start:start + rlen]
         cow = state["cow"] if state["chunks"] == 0 else (0, 0)
         before = self.trace_counts["prefill"]
         t_prefill_wall, t_prefill = time.time(), time.perf_counter()
@@ -840,10 +994,17 @@ class ContinuousBatchingEngine:
             full = t0 // self.page_size
             if full:
                 self._radix.insert(
-                    req.prompt, [int(p) for p in
-                                 self._page_tables[slot_idx][:full]])
-        first = int(first)
+                    seq, [int(p) for p in
+                          self._page_tables[slot_idx][:full]])
+        first, key = self._resume_state(req, state["seed"], first, key)
         req.state = Request.RUNNING
+        if req.observed:
+            # continuation join: the observed tokens were emitted (and
+            # counted) on the previous home; decode resumes FROM the last
+            # observed token — no append, no first-token latency sample
+            self.metrics.on_continuation(len(req.observed))
+            self._activate(slot_idx, req, first, t0, key)
+            return True
         req._append(first)
         self.metrics.on_first_token(req.first_token_at - req.submitted_at,
                                     trace_id=req.trace_id)

@@ -133,6 +133,15 @@ class ServingMetrics:
         self._c_cow = r.counter(
             "serving_cow_pages_total",
             "copy-on-write page duplications (whole-prompt prefix hits)")
+        self._c_continuations = r.counter(
+            "serving_continuation_joins_total",
+            "streams admitted mid-transcript (resurrection/migration joins)")
+        self._c_continuation_tokens = r.counter(
+            "serving_continuation_tokens_total",
+            "observed tokens carried into continuation joins")
+        self._c_exports = r.counter(
+            "serving_streams_exported_total",
+            "active streams exported to a peer (live migration source)")
         self._page_state: Dict = {}
         self._prefix_hits_seen = 0
         self._prefix_tokens_seen = 0
@@ -193,6 +202,19 @@ class ServingMetrics:
             if compiled:
                 self.step_compiles += 1
         self._c_steps.inc(compiled="true" if compiled else "false")
+
+    def on_continuation(self, n_observed: int):
+        """One continuation join admitted (a resurrected or migrated
+        stream resuming mid-transcript), carrying ``n_observed`` tokens
+        already generated elsewhere — those are NOT re-counted as emitted
+        tokens here (their first home counted them)."""
+        self._c_continuations.inc()
+        if n_observed > 0:
+            self._c_continuation_tokens.inc(int(n_observed))
+
+    def on_export(self):
+        """One active stream exported to a peer (live-migration source)."""
+        self._c_exports.inc()
 
     def on_cow(self):
         """One copy-on-write page duplication (a whole-prompt prefix hit

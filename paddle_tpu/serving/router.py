@@ -16,14 +16,20 @@ Mechanics:
   transport failures OPEN the breaker (ejected from routing); after
   ``cooldown_s`` it goes HALF_OPEN and the next health probe (or routed
   call) decides — success rejoins (CLOSED), failure re-opens.
-* **Failover**: every routed request remembers how many tokens the router
-  has OBSERVED. When a replica dies, requests with zero observed tokens
-  (queued / not yet prefilled — the engine's slot scheduler had not
-  started them, so re-running loses nothing) are resubmitted with backoff
-  onto a surviving replica; requests that already streamed tokens are
-  surfaced as FAILED through poll/stream — a half-finished generation must
-  never be silently truncated OR silently restarted with different
-  sampling.
+* **Failover**: every routed request remembers the tokens the router has
+  OBSERVED. When a replica dies, requests with zero observed tokens
+  (queued / not yet prefilled) are resubmitted with backoff onto a
+  surviving replica; requests that already streamed tokens are
+  RESURRECTED — the observed transcript rides along as a continuation
+  join, the survivor prefills prompt+observed and fast-forwards the PRNG
+  key chain, and the continued stream is bit-identical to the
+  uninterrupted run (greedy and sampled; the router pins seeds at entry).
+  Only when no survivor can take the continuation does the stream settle
+  FAILED, typed as :class:`ResurrectionFailedError`.
+* **Live migration**: :meth:`ServingRouter.migrate` drains one stream off
+  a replica between decode ticks — the source exports a CRC-stamped
+  continuation record, the target continuation-prefills it, routing flips
+  atomically, and a mid-migration death falls back to resurrection.
 * **Drain-aware takedown**: :meth:`ServingRouter.drain` stops routing to a
   replica, asks it to close admissions (``POST /admin/drain``), and polls
   its metrics until queue and slots are empty — the replica can then be
@@ -41,18 +47,29 @@ import numpy as np
 
 from ..observability import trace as obstrace
 from ..observability.metrics import MetricsHTTPServer, MetricsRegistry
+from ..resilience.inject import fire as _inject_fire
 from ..resilience.retry import RetryError, backoff_delays
 from .admission import AdmissionRejected, DeadlineExceededError
+from .engine import MIGRATED_ERROR_TYPE
 from .scheduler import QueueFullError, Request, SchedulerClosed
 from .server import RequestFailedError, ServingClient, StreamIncompleteError
 
-__all__ = ["ServingRouter", "RoutedRequest", "NoReplicaAvailable"]
+__all__ = ["ServingRouter", "RoutedRequest", "NoReplicaAvailable",
+           "ResurrectionFailedError"]
 
 
 class NoReplicaAvailable(RuntimeError):
     """Every replica is ejected, draining, or unreachable — HTTP 503."""
 
     http_status = 503
+
+
+class ResurrectionFailedError(RuntimeError):
+    """A confirmed replica death orphaned an in-flight stream and NO
+    survivor could take the continuation (all full/draining/unreachable,
+    or the retry budget ran out) — the typed terminal verdict for the
+    zero-loss path, never a silent retry loop. The router's observed
+    token log is still intact on the RoutedRequest for salvage."""
 
 
 class _Replica:
@@ -114,6 +131,11 @@ class RoutedRequest:
         if ds is not None and not math.isfinite(float(ds)):
             raise ValueError(f"deadline_s must be finite, got {ds}")
         self.deadline_s = None if ds is None else float(ds)
+        # transcript-memory bound (and the resurrection sanity line): the
+        # observed token log can never legitimately exceed the generation
+        # limit, so _observe caps there — an unbounded stream race must
+        # not grow router memory past it
+        self.max_new_tokens = int(self.spec.get("max_new_tokens", 32))
         # minted at the router (the request's entry point) and propagated
         # via headers — the one id stitching router + replica spans
         self.trace_id: Optional[str] = (
@@ -129,6 +151,9 @@ class RoutedRequest:
         # exception class a live poll/stream of the failure would have
         self.failure_kind: Optional[str] = None
         self.resubmits = 0
+        # continuation re-homes of THIS stream (death resurrection or
+        # migration-fallback) — distinct from zero-token resubmits
+        self.resurrections = 0
         self.submitted_at = time.perf_counter()
         self.deadline_at = (None if self.deadline_s is None
                             else self.submitted_at + self.deadline_s)
@@ -155,6 +180,10 @@ class RoutedRequest:
         # log a racing poll just recorded — _replay_settled would then
         # yield the truncated log as a complete generation
         with self._tokens_lock:
+            # cap at the generation limit: a racing stream must not grow
+            # the log past what the engine can legitimately emit (the
+            # registry eviction path asserts the same bound server-side)
+            tokens = list(tokens)[:self.max_new_tokens]
             if len(tokens) <= len(self.tokens):
                 return
             now = time.perf_counter()
@@ -162,7 +191,7 @@ class RoutedRequest:
                 self.first_token_at = now
             if self.resubmits and self.failover_first_token_at is None:
                 self.failover_first_token_at = now
-            self.tokens = list(tokens)
+            self.tokens = tokens
 
 
 class ServingRouter:
@@ -196,6 +225,21 @@ class ServingRouter:
         self.resubmits = 0
         # requests surfaced FAILED (had tokens); guarded-by: self._lock
         self.inflight_failures = 0
+        # in-flight streams resurrected as continuations after a confirmed
+        # replica death; guarded-by: self._lock
+        self.resurrections = 0
+        # observed tokens those resurrections preserved; guarded-by: self._lock
+        self.resurrected_tokens = 0
+        # live migrations completed; guarded-by: self._lock
+        self.migrations = 0
+        # migrations whose import failed and fell back to resurrection;
+        # guarded-by: self._lock
+        self.migration_fallbacks = 0
+        # seeds minted for sampled requests submitted without one: the
+        # engine's fallback seed is replica-local, so a resurrection could
+        # not reproduce the key chain — the router pins one up front;
+        # guarded-by: self._lock
+        self._seed_mint = 0
         self._lock = threading.RLock()
         self._health_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -213,6 +257,17 @@ class ServingRouter:
         self._c_inflight = r.counter(
             "router_inflight_failures_total",
             "requests surfaced FAILED after streaming tokens")
+        self._c_resurrections = r.counter(
+            "router_resurrections_total",
+            "in-flight streams re-homed as continuations after a death")
+        self._c_resurrected_tokens = r.counter(
+            "router_resurrected_tokens_total",
+            "observed tokens preserved across stream resurrections")
+        self._c_migrations = r.counter(
+            "router_migrations_total", "live stream migrations completed")
+        self._c_migration_fallbacks = r.counter(
+            "router_migration_fallbacks_total",
+            "migrations that fell back to resurrection mid-flight")
         self._g_breaker = r.gauge(
             "router_breaker_state",
             "per-replica breaker (0=closed 1=half_open 2=open)",
@@ -361,6 +416,13 @@ class ServingRouter:
             raise DeadlineExceededError(
                 f"deadline_s={rr.deadline_s} elapsed before the request "
                 f"could be (re)submitted")
+        # continuation join: tokens the router has already observed ride
+        # along, so a survivor resumes the stream mid-transcript instead
+        # of regenerating from scratch (zero-token requests submit the
+        # plain prompt — the original fresh-resubmit path, unchanged)
+        with rr._tokens_lock:
+            observed = list(rr.tokens)
+        extra = {"observed_tokens": observed} if observed else {}
         last_exc: Optional[Exception] = None
         for rep in self._candidates():
             # the remaining deadline is re-derived PER ATTEMPT: time
@@ -381,7 +443,7 @@ class ServingRouter:
                 rid = rep.client.submit(
                     rr.prompt, trace_id=rr.trace_id,
                     parent_span_id=rr.route_span_id,
-                    deadline_s=deadline_remaining, **rr.spec)
+                    deadline_s=deadline_remaining, **extra, **rr.spec)
             except DeadlineExceededError:
                 # the remaining budget evaporated in flight — expired
                 # everywhere by definition, never spill
@@ -424,6 +486,15 @@ class ServingRouter:
         and a ``serving.route`` root span; the replica's queue/prefill/
         decode spans hang off it through the propagated headers."""
         rr = RoutedRequest(prompt, **spec)
+        if (rr.spec.get("seed") is None
+                and float(rr.spec.get("temperature") or 0.0) > 0.0):
+            # pin a seed for sampled requests at the ENTRY point: the
+            # engine's fallback seed is replica-local state, so without
+            # this a resurrection could never fast-forward the key chain
+            # the dead replica was actually sampling from
+            with self._lock:
+                self._seed_mint += 1
+                rr.spec["seed"] = self._seed_mint
         with obstrace.span("serving.route", trace_id=rr.trace_id) as sp:
             if sp is not None:
                 rr.route_span_id = sp.span_id
@@ -487,14 +558,9 @@ class ServingRouter:
                     "replica_death",
                     extra={"replica": rep.addr, "error": str(err)})
         if rr.tokens:
-            with self._lock:
-                self.inflight_failures += 1
-            self._c_inflight.inc()
-            rr.failure_kind = "transport"
-            rr.state = Request.FAILED
-            rr.error = (f"replica {rr.replica_addr} died after "
-                        f"{len(rr.tokens)} tokens: {err}")
-            return False
+            # in-flight stream: resurrect it on a survivor as a
+            # continuation join instead of surfacing the death
+            return self._rehome_continuation(rr, err, dead=rr.replica_addr)
         with self._lock:
             self.failovers += 1
         self._c_failovers.inc()
@@ -525,6 +591,91 @@ class ServingRouter:
                     f"accepted the resubmit: {err}")
         return False
 
+    # hostrace: requires(rr._failover_lock)
+    def _rehome_continuation(self, rr: RoutedRequest, err: Exception,
+                             dead: Optional[str] = None) -> bool:
+        """Re-home an in-flight stream as a CONTINUATION JOIN: the token
+        log the router observed (``rr.tokens``, authoritative — every
+        delivered token passed through :meth:`RoutedRequest._observe`)
+        rides along in the resubmit, the survivor prefills
+        prompt+observed through the ordinary chunk-bucket programs and
+        fast-forwards the PRNG key chain, and the continued trajectory is
+        bit-identical to the uninterrupted run — greedy AND sampled,
+        because :meth:`submit` minted the seed at the entry point.
+        Returns True when re-homed, False when the stream settles FAILED:
+        deadline lapsed (``failure_kind='request'``) or no survivor could
+        take the continuation (``failure_kind='resurrection'``, replayed
+        to observers as :class:`ResurrectionFailedError` — a typed
+        terminal verdict, never a silent retry loop)."""
+        with rr._tokens_lock:
+            n_observed = len(rr.tokens)
+        # deterministic inject seam: a stall here models the wall-clock a
+        # real recovery burns before the resubmit (deadline tests), a
+        # raise models the recovery machinery itself dying
+        _inject_fire("router.resurrect", request=rr.remote_id,
+                     replica=dead or rr.replica_addr, tokens=n_observed)
+        if (float(rr.spec.get("temperature") or 0.0) > 0.0
+                and rr.spec.get("seed") is None):
+            # a sampled stream without a pinned seed (request constructed
+            # around submit()'s seed mint): the dead replica's key chain
+            # is unrecoverable, so the continuation can never bit-match
+            with self._lock:
+                self.inflight_failures += 1
+            self._c_inflight.inc()
+            rr.failure_kind = "resurrection"
+            rr.state = Request.FAILED
+            rr.error = (f"{n_observed}-token sampled stream on "
+                        f"{rr.replica_addr} has no pinned seed — the key "
+                        f"chain died with the replica: {err}")
+            return False
+        from ..observability.flight import flight_recorder
+
+        flight_recorder().dump(
+            "stream_resurrection",
+            extra={"replica": dead or rr.replica_addr,
+                   "request": rr.remote_id, "tokens_observed": n_observed,
+                   "error": str(err)})
+        with self._lock:
+            self.failovers += 1
+        self._c_failovers.inc()
+        delays = backoff_delays(self.resubmit_retries)
+        for attempt in range(self.resubmit_retries + 1):
+            try:
+                self._submit_somewhere(rr)
+            except DeadlineExceededError as e:
+                # the deadline lapsed during recovery (time burned on the
+                # dead replica counts against the SAME deadline_at — a
+                # request-level verdict, nothing wrong with the survivors)
+                rr.failure_kind = "request"
+                rr.state = Request.FAILED
+                rr.error = f"{DeadlineExceededError.error_type}: {e}"
+                return False
+            except (QueueFullError, SchedulerClosed, NoReplicaAvailable,
+                    AdmissionRejected):
+                if attempt >= self.resubmit_retries:
+                    break
+                time.sleep(next(delays))
+            else:
+                with self._lock:
+                    self.resubmits += 1
+                    self.resurrections += 1
+                    self.resurrected_tokens += n_observed
+                self._c_resubmits.inc()
+                self._c_resurrections.inc()
+                self._c_resurrected_tokens.inc(n_observed)
+                rr.resubmits += 1
+                rr.resurrections += 1
+                return True
+        with self._lock:
+            self.inflight_failures += 1
+        self._c_inflight.inc()
+        rr.failure_kind = "resurrection"
+        rr.state = Request.FAILED
+        rr.error = (f"{n_observed}-token stream orphaned by the death of "
+                    f"{dead or rr.replica_addr} and no survivor accepted "
+                    f"the continuation: {err}")
+        return False
+
     # -- retrieval ---------------------------------------------------------
     def poll(self, rr: RoutedRequest) -> Dict:
         """One status poll, with failover. Returns the /v1/result payload
@@ -534,10 +685,20 @@ class ServingRouter:
             return {"id": rr.remote_id, "status": rr.state,
                     "tokens": list(rr.tokens), "error": rr.error}
         addr = rr.replica_addr
+        rid = rr.remote_id
         rep = self.replicas.get(addr)
         try:
-            out = rep.client.result(rr.remote_id)
+            out = rep.client.result(rid)
         except RequestFailedError as e:
+            if (getattr(e, "error_type", None) == MIGRATED_ERROR_TYPE
+                    or (rr.replica_addr, rr.remote_id) != (addr, rid)):
+                # the stream MOVED while this poll was in flight (a
+                # migration exported it off ``addr``, or a racing caller
+                # re-homed it): transient, not a settlement — the next
+                # poll reads the new home
+                self._record_success(rep)
+                return {"id": rr.remote_id, "status": Request.RUNNING,
+                        "tokens": list(rr.tokens), "error": None}
             # the replica ANSWERED: a request-level verdict (unknown or
             # evicted id), not a death — the breaker stays untouched and
             # the request is NOT replayed elsewhere
@@ -555,6 +716,12 @@ class ServingRouter:
             return {"id": rr.remote_id, "status": rr.state,
                     "tokens": list(rr.tokens), "error": rr.error}
         self._record_success(rep)
+        if (out.get("status") == Request.FAILED
+                and out.get("error_type") == MIGRATED_ERROR_TYPE):
+            # polled the migration SOURCE after export but before the
+            # router flipped routing: the stream lives on, elsewhere
+            return {"id": rr.remote_id, "status": Request.RUNNING,
+                    "tokens": list(rr.tokens), "error": None}
         rr._observe(out.get("tokens", ()))
         if out.get("status") in (Request.DONE, Request.FAILED):
             rr.state = out["status"]
@@ -579,14 +746,21 @@ class ServingRouter:
         a replica death before the first token transparently re-streams
         from a survivor; after the first token it raises (the router must
         not splice two generations together)."""
-        if rr.done:
-            # already settled (e.g. polled to completion, replica since
-            # dead): replay the recorded outcome — never reconnect to a
-            # corpse for tokens the router already has
-            yield from self._replay_settled(rr, 0)
-            return
+        # tokens already handed to THIS caller: a reconnect (resurrection
+        # or migration) replays the replica's full transcript from token
+        # 0, and only indices >= delivered may be yielded again — the
+        # zero-duplicate half of the zero-loss contract
+        delivered = 0
         while True:
+            if rr.done:
+                # settled (polled to completion, replica since dead, or
+                # re-homed and finished between attempts): replay the
+                # recorded outcome — never reconnect to a corpse for
+                # tokens the router already has
+                yield from self._replay_settled(rr, delivered)
+                return
             addr = rr.replica_addr
+            rid = rr.remote_id
             rep = self.replicas.get(addr)
             # the replica's stream replays from token 0 and is the
             # authoritative sequence: observe THAT, never append to
@@ -594,13 +768,23 @@ class ServingRouter:
             # recorded tokens the stream is still catching up to)
             streamed: List[int] = []
             try:
-                for tok in rep.client.stream(rr.remote_id):
+                for tok in rep.client.stream(rid):
                     streamed.append(int(tok))
                     rr._observe(streamed)
-                    yield int(tok)
+                    if len(streamed) > delivered:
+                        delivered = len(streamed)
+                        yield int(tok)
                 rr.state = Request.DONE
                 return
             except RequestFailedError as e:
+                if (getattr(e, "error_type", None) == MIGRATED_ERROR_TYPE
+                        or (rr.replica_addr, rr.remote_id) != (addr, rid)):
+                    # the stream MOVED mid-attempt (migration export, or
+                    # a racing caller re-homed it): reconnect to wherever
+                    # it lives now — delivered dedups the replay
+                    self._record_success(rep)
+                    time.sleep(self.poll_s)
+                    continue
                 # the replica is healthy and says THE REQUEST failed: no
                 # breaker hit, no resubmit (a poison request replayed on
                 # every replica would open every breaker in turn)
@@ -625,7 +809,7 @@ class ServingRouter:
                         # settled while this observer was timing out (a
                         # racing poll finished it): replay the remainder
                         # instead of re-dialing the dead replica forever
-                        yield from self._replay_settled(rr, len(streamed))
+                        yield from self._replay_settled(rr, delivered)
                         return
                     continue  # re-homed: stream from the survivor
                 # a racing poll may have settled rr with a REQUEST-level
@@ -633,6 +817,9 @@ class ServingRouter:
                 # surface the class the verdict contract promises
                 if rr.failure_kind == "request":
                     raise RequestFailedError(rr.error or str(e)) from e
+                if rr.failure_kind == "resurrection":
+                    raise ResurrectionFailedError(
+                        rr.error or str(e)) from e
                 raise RuntimeError(rr.error or str(e)) from e
 
     def _replay_settled(self, rr: RoutedRequest, skip: int):
@@ -644,12 +831,157 @@ class ServingRouter:
         if rr.state == Request.FAILED:
             # same exception class a LIVE observation of this failure
             # raised: request-level verdicts are RequestFailedError (the
-            # documented switch point), deaths stay RuntimeError
+            # documented switch point), exhausted continuation re-homes
+            # are ResurrectionFailedError, other deaths stay RuntimeError
             if rr.failure_kind == "request":
                 raise RequestFailedError(rr.error or "request failed")
+            if rr.failure_kind == "resurrection":
+                raise ResurrectionFailedError(rr.error or "request failed")
             raise RuntimeError(rr.error or "request failed")
         for tok in list(rr.tokens)[skip:]:
             yield int(tok)
+
+    # -- migration ---------------------------------------------------------
+    def migrate(self, rr: RoutedRequest, to_addr: str) -> None:
+        """Live-migrate one in-flight stream onto ``to_addr`` between
+        decode ticks, zero tokens dropped or duplicated: the source
+        exports a CRC-stamped continuation record (transcript + sampling
+        params + key-chain position), the target imports it as a
+        continuation join, and routing flips atomically (remote_id
+        published before replica_addr, the failover ordering). Observers
+        polling/streaming the source inside the window see the
+        ``MigratedError`` verdict and treat it as "moved", not settled.
+        A mid-migration target death (or refusal) falls back to
+        resurrection — the stream is never lost to a failed migration.
+        Raises KeyError for an unknown target, ValueError for a settled
+        request, :class:`RequestFailedError` when the source answers the
+        stream is not exportable (unknown / still queued / finished), and
+        RuntimeError when the migration aborted with the stream intact on
+        the source."""
+        target = self.replicas.get(to_addr)
+        if target is None:
+            raise KeyError(f"unknown replica {to_addr!r}")
+        with rr._failover_lock:
+            if rr.done:
+                raise ValueError(
+                    f"cannot migrate {rr.remote_id!r}: already settled "
+                    f"({rr.state})")
+            src_addr = rr.replica_addr
+            if src_addr == to_addr:
+                return
+            src = self.replicas.get(src_addr)
+            if src is None:
+                raise KeyError(f"request lives on unknown replica "
+                               f"{src_addr!r}")
+            _inject_fire("router.migrate", request=rr.remote_id,
+                         src=src_addr, dst=to_addr, stage="export")
+            try:
+                record = src.client.migrate_export(rr.remote_id)
+            except RequestFailedError as nx:
+                # the source ANSWERED: not exportable (unknown id, still
+                # queued, or already finished) — nothing moved, nothing
+                # to recover. A stream that raced to completion before
+                # the export gets the same verdict as the early rr.done
+                # check (the caller's next poll settles rr normally).
+                self._record_success(src)
+                try:
+                    out = src.probe_client.result(rr.remote_id)
+                except (OSError, RetryError, RuntimeError, ValueError,
+                        RequestFailedError, http.client.HTTPException):
+                    raise nx
+                if out.get("status") in (Request.DONE, Request.FAILED):
+                    raise ValueError(
+                        f"cannot migrate {rr.remote_id!r}: already "
+                        f"settled ({out['status']} on {src_addr})"
+                    ) from nx
+                raise nx
+            except (OSError, RetryError, RuntimeError, ValueError,
+                    http.client.HTTPException) as e:
+                # ambiguous: the export may or may not have committed
+                # before the transport tore (or the source refused with a
+                # 409/500). Ask the source: a settled MigratedError
+                # verdict means the slot WAS freed and the record was
+                # lost in transit — fall back to resurrection from the
+                # router's own observed log (safe: a continuation from
+                # ANY prefix of the transcript regenerates the identical
+                # trajectory). Still RUNNING means nothing was exported.
+                # An unreachable source is the ordinary confirmed-death
+                # path, which itself resurrects.
+                try:
+                    out = src.probe_client.result(rr.remote_id)
+                except RequestFailedError:
+                    committed = True  # registry evicted it post-export
+                except (OSError, RetryError, RuntimeError, ValueError,
+                        http.client.HTTPException):
+                    self._handle_replica_death_locked(rr, e)
+                    raise RuntimeError(
+                        f"migration aborted at export ({src_addr} "
+                        f"unreachable): {e}") from e
+                else:
+                    committed = (
+                        out.get("status") == Request.FAILED
+                        and out.get("error_type") == MIGRATED_ERROR_TYPE)
+                if not committed:
+                    self._record_failure(src)
+                    raise RuntimeError(
+                        f"migration aborted at export (stream intact on "
+                        f"{src_addr}): {e}") from e
+                return self._migration_fallback(rr, e)
+            self._record_success(src)
+            # the engine's transcript is authoritative and may be ahead
+            # of the router's: adopt it before the import (or fallback)
+            rr._observe(record.get("tokens", ()))
+            _inject_fire("router.migrate", request=rr.remote_id,
+                         src=src_addr, dst=to_addr, stage="import")
+            deadline_remaining: Optional[float] = None
+            if rr.deadline_at is not None:
+                deadline_remaining = rr.deadline_at - time.perf_counter()
+            try:
+                if (deadline_remaining is not None
+                        and deadline_remaining <= 0):
+                    raise DeadlineExceededError(
+                        f"deadline_s={rr.deadline_s} elapsed mid-"
+                        f"migration")
+                new_id = target.client.migrate_import(
+                    record, trace_id=rr.trace_id,
+                    parent_span_id=rr.route_span_id,
+                    deadline_s=deadline_remaining)
+            except (OSError, RetryError,
+                    http.client.HTTPException) as e:
+                # the TARGET died under the import: the record is gone
+                # with it but the transcript is not — resurrect
+                self._record_failure(target)
+                return self._migration_fallback(rr, e)
+            except (QueueFullError, SchedulerClosed, AdmissionRejected,
+                    DeadlineExceededError, ValueError, RuntimeError) as e:
+                # target refused (backpressure / bad record / 500): the
+                # source already gave the stream up, so a survivor must
+                # take the continuation
+                return self._migration_fallback(rr, e)
+            self._record_success(target)
+            with self._lock:
+                target.queue_depth += 1
+            rr.remote_id = new_id
+            rr.replica_addr = to_addr
+            with self._lock:
+                self.migrations += 1
+            self._c_migrations.inc()
+
+    # hostrace: requires(rr._failover_lock)
+    def _migration_fallback(self, rr: RoutedRequest,
+                            err: Exception) -> None:
+        """The source exported (its slot is free) but the target never
+        took the stream: re-home it as a plain resurrection. Raises the
+        typed verdict when even that fails — the caller of
+        :meth:`migrate` must not believe the stream survived."""
+        with self._lock:
+            self.migration_fallbacks += 1
+        self._c_migration_fallbacks.inc()
+        if self._rehome_continuation(rr, err):
+            return
+        if rr.failure_kind == "request":
+            raise RequestFailedError(rr.error or str(err)) from err
+        raise ResurrectionFailedError(rr.error or str(err)) from err
 
     # -- drain -------------------------------------------------------------
     def drain(self, addr: str, timeout: float = 60.0):
@@ -681,6 +1013,10 @@ class ServingRouter:
                 "failovers": self.failovers,
                 "resubmits": self.resubmits,
                 "inflight_failures": self.inflight_failures,
+                "resurrections": self.resurrections,
+                "resurrected_tokens": self.resurrected_tokens,
+                "migrations": self.migrations,
+                "migration_fallbacks": self.migration_fallbacks,
             }
 
     def _refresh_replica_gauges(self):

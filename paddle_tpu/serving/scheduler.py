@@ -97,7 +97,8 @@ class Request:
                  request_id: Optional[str] = None,
                  trace_id: Optional[str] = None,
                  parent_span_id: Optional[str] = None,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 observed_tokens: Optional[Sequence[int]] = None):
         self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise ValueError("empty prompt")
@@ -109,6 +110,26 @@ class Request:
         self.top_k = None if top_k is None else int(top_k)
         self.top_p = None if top_p is None else float(top_p)
         self.seed = seed
+        # continuation join (stream resurrection / live migration): tokens
+        # this stream ALREADY generated elsewhere. The engine prefills
+        # prompt+observed[:-1], fast-forwards the PRNG key chain by
+        # len(observed) draws, and resumes decode — bit-identical to the
+        # uninterrupted run, so the transcript log starts pre-populated
+        self.observed: List[int] = (
+            [] if observed_tokens is None
+            else [int(t) for t in observed_tokens])
+        if len(self.observed) > self.max_new_tokens:
+            raise ValueError(
+                f"continuation carries {len(self.observed)} observed tokens, "
+                f"past its generation limit max_new_tokens="
+                f"{self.max_new_tokens}")
+        if self.observed and self.temperature > 0.0 and seed is None:
+            # without the original seed the key chain cannot be
+            # reconstructed — a resumed sampled stream would silently
+            # diverge from the uninterrupted trajectory
+            raise ValueError(
+                "sampled continuation requires an explicit seed (the PRNG "
+                "key chain cannot be fast-forwarded without it)")
         self.request_id = request_id or f"req-{next(_req_ids)}"
         # distributed-tracing context: the router mints the trace id and
         # ships it via HTTP headers; a direct submit with tracing armed
@@ -121,7 +142,10 @@ class Request:
         self.trace_id = trace_id
         self.parent_span_id = parent_span_id
         self._decode_span_parent: Optional[str] = None  # engine-owned
-        self.tokens: List[int] = []      # guarded-by: self._cond
+        # pre-populated with the observed prefix for continuations: eos /
+        # max_new_tokens checks, result() and stream replay all see ONE
+        # transcript regardless of which replica generated which token
+        self.tokens: List[int] = list(self.observed)  # guarded-by: self._cond
         self.state = Request.PENDING     # guarded-by: self._cond
         self.error: Optional[str] = None  # guarded-by: self._cond
         # typed discriminator for failures ("DeadlineExceededError",
@@ -160,6 +184,37 @@ class Request:
             self.error_type = error_type
             self.finished_at = time.perf_counter()
             self._cond.notify_all()
+
+    # -- continuation join --------------------------------------------------
+    @property
+    def prefill_len(self) -> int:
+        """Tokens the engine must prefill before decode can resume: the
+        whole prompt, plus — for a continuation — every observed token but
+        the last (whose KV the first resumed decode step writes, exactly
+        as the uninterrupted run's step did)."""
+        return self.prompt.size + max(len(self.observed) - 1, 0)
+
+    def prefill_ids(self) -> np.ndarray:
+        """The continuation-join prefill sequence: ``prompt`` for a fresh
+        request, ``prompt + observed[:-1]`` for a continuation (int32 —
+        what the chunk programs, radix matching and page tables key on)."""
+        if not self.observed:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt,
+             np.asarray(self.observed[:-1], dtype=np.int32)])
+
+    @property
+    def observed_terminal(self) -> bool:
+        """True when the observed transcript already finished generation
+        (hit max_new_tokens or eos) — nothing to prefill or decode; the
+        engine completes the request at admission."""
+        if not self.observed:
+            return False
+        if len(self.observed) >= self.max_new_tokens:
+            return True
+        return (self.eos_token_id is not None
+                and self.observed[-1] == self.eos_token_id)
 
     # -- deadline -----------------------------------------------------------
     def deadline_remaining(self) -> Optional[float]:
@@ -274,7 +329,9 @@ class FCFSScheduler:
         """FCFS enqueue. Raises :class:`SchedulerClosed` after drain started
         and :class:`QueueFullError` at capacity (the server maps these to
         503/429)."""
-        req.bucket = self.bucket_for(req.prompt.size)  # validate first
+        # continuations bucket the JOIN length (prompt + observed[:-1]) —
+        # that is what the prefill programs will actually run over
+        req.bucket = self.bucket_for(req.prefill_len)  # validate first
         with self._cond:
             if self._closed:
                 raise SchedulerClosed("scheduler is draining; not admitting")

@@ -60,7 +60,15 @@ class RequestFailedError(RuntimeError):
     reported it failed, or the id is unknown/evicted) — the replica
     itself is healthy. Routers must not count this against the replica's
     circuit breaker or resubmit the request elsewhere (a poison request
-    would cascade through every replica opening every breaker)."""
+    would cascade through every replica opening every breaker).
+
+    ``error_type`` carries the request's typed discriminator when the
+    replica shipped one — routers switch on it (a ``MigratedError``
+    verdict means the stream MOVED, not failed)."""
+
+    def __init__(self, msg: str, error_type: Optional[str] = None):
+        super().__init__(msg)
+        self.error_type = error_type
 
 
 class StreamIncompleteError(RuntimeError):
@@ -132,6 +140,12 @@ class _Handler(BaseHTTPRequestHandler):
             self.server_ref.engine.scheduler.close()
             self._json(200, {"draining": True})
             return
+        if path == "/admin/migrate_export":
+            self._migrate_export()
+            return
+        if path == "/admin/migrate_import":
+            self._migrate_import()
+            return
         if path != "/v1/generate":
             self._json(404, {"error": "unknown endpoint"})
             return
@@ -151,7 +165,8 @@ class _Handler(BaseHTTPRequestHandler):
                 deadline = spec.pop("deadline_s", None)
             req = Request(prompt, **{
                 k: spec[k] for k in ("max_new_tokens", "eos_token_id",
-                                     "temperature", "top_k", "top_p", "seed")
+                                     "temperature", "top_k", "top_p", "seed",
+                                     "observed_tokens")
                 if k in spec},
                 # trace context rides HEADERS, not the body — the JSON
                 # protocol stays byte-compatible for existing clients
@@ -192,6 +207,89 @@ class _Handler(BaseHTTPRequestHandler):
             # as a replica DEATH and opens the breaker on a healthy
             # replica over a per-request pricing bug
             self._json(500, {"error": f"submit failed internally: "
+                                      f"{type(e).__name__}: {e}"})
+            return
+        self.server_ref._register(req)
+        self._json(202, {"id": req.request_id})
+
+    # -- live stream migration ---------------------------------------------
+    def _migrate_export(self):
+        """Source half of a live migration: drain one active stream into a
+        CRC-stamped continuation record. 404 for an id this engine is not
+        decoding, 409 for a mid-prefill slot (retry next tick)."""
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n).decode() or "{}")
+            rid = str(body["id"])
+        except Exception as e:
+            self._json(400, {"error": f"bad request body: {e}"})
+            return
+        try:
+            record = self.server_ref.engine.export_stream(rid)
+        except KeyError as e:
+            self._json(404, {"error": str(e)})
+            return
+        except ValueError as e:
+            self._json(409, {"error": str(e)})
+            return
+        except Exception as e:
+            self._json(500, {"error": f"export failed internally: "
+                                      f"{type(e).__name__}: {e}"})
+            return
+        self._json(200, record)
+
+    def _migrate_import(self):
+        """Target half: verify the record's CRC, admit the stream as a
+        continuation join (same admission gate/queue discipline as a fresh
+        submit — a migration must not over-admit past the page budget)."""
+        from .engine import verify_continuation_record
+
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            record = json.loads(self.rfile.read(n).decode() or "{}")
+            verify_continuation_record(record)
+        except Exception as e:
+            self._json(400, {"error": f"bad continuation record: {e}"})
+            return
+        try:
+            deadline = self.headers.get(obstrace.DEADLINE_HEADER)
+            if deadline is None:
+                deadline = record.get("deadline_remaining")
+            req = Request(
+                record["prompt"],
+                observed_tokens=record["tokens"],
+                max_new_tokens=record["max_new_tokens"],
+                eos_token_id=record.get("eos_token_id"),
+                temperature=record.get("temperature", 0.0),
+                top_k=record.get("top_k"),
+                top_p=record.get("top_p"),
+                seed=record.get("seed"),
+                trace_id=self.headers.get(obstrace.TRACE_HEADER),
+                parent_span_id=self.headers.get(obstrace.PARENT_HEADER),
+                deadline_s=None if deadline is None else float(deadline))
+            self.server_ref.engine.submit(req)
+        except DeadlineExceededError as e:
+            self._json(503, {"error": str(e), "error_type": e.error_type})
+            return
+        except AdmissionRejected as e:
+            hint = e.retry_after or 1.0
+            self._json_429({"error": str(e), "error_type": e.error_type,
+                            "estimate": e.estimate,
+                            "retry_after_s": hint}, hint)
+            return
+        except QueueFullError as e:
+            hint = self.server_ref.engine.metrics.retry_after_hint(
+                queue_depth=self.server_ref.engine.scheduler.depth())
+            self._json_429({"error": str(e), "retry_after_s": hint}, hint)
+            return
+        except SchedulerClosed as e:
+            self._json(503, {"error": str(e)})
+            return
+        except (TypeError, ValueError) as e:
+            self._json(400, {"error": str(e)})
+            return
+        except Exception as e:
+            self._json(500, {"error": f"import failed internally: "
                                       f"{type(e).__name__}: {e}"})
             return
         self.server_ref._register(req)
@@ -279,7 +377,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self.wfile.flush()
                 self.wfile.write((json.dumps(
                     {"done": True, "status": req.state,
-                     "n_tokens": len(req.tokens)}) + "\n").encode())
+                     "n_tokens": len(req.tokens),
+                     "error_type": req.error_type}) + "\n").encode())
                 self.wfile.flush()
             except OSError:
                 pass  # client went away / kill() severed the socket
@@ -343,7 +442,15 @@ class ServingServer:
                               None)
                 if victim is None:
                     break
-                del self._requests[victim]
+                v = self._requests.pop(victim)
+                # registry eviction is the last observer of a finished
+                # transcript: the token log is bounded by the generation
+                # limit BY CONSTRUCTION (continuation joins validate the
+                # observed prefix; decode retires at max_new_tokens) — a
+                # longer log here means a splice bug upstream
+                assert len(v.tokens) <= v.max_new_tokens, (
+                    f"evicting {victim!r} with {len(v.tokens)} tokens past "
+                    f"max_new_tokens={v.max_new_tokens}")
 
     def start(self):
         self._http_thread = threading.Thread(
@@ -571,7 +678,8 @@ class ServingClient:
                         if msg.get("status") == Request.FAILED:
                             raise RequestFailedError(
                                 f"request {request_id} failed after "
-                                f"{msg.get('n_tokens')} tokens")
+                                f"{msg.get('n_tokens')} tokens",
+                                error_type=msg.get("error_type"))
                         if msg.get("status") != Request.DONE:
                             raise StreamIncompleteError(
                                 f"stream for {request_id} ended incomplete "
@@ -587,6 +695,58 @@ class ServingClient:
         if status != 200:
             raise RuntimeError(f"metrics failed ({status})")
         return out
+
+    def migrate_export(self, request_id: str) -> Dict:
+        """Ask the replica to drain one active stream into a continuation
+        record (live-migration source half). Raises
+        :class:`RequestFailedError` when the replica answers that the id
+        is not exportable (unknown/finished: 404) and RuntimeError with
+        the 409 body for a mid-prefill slot (retry next tick)."""
+        status, out = self._call("POST", "/admin/migrate_export",
+                                 {"id": request_id}, retries=0)
+        if status == 404:
+            raise RequestFailedError(
+                f"request {request_id!r} not exportable: {out.get('error')}")
+        if status != 200:
+            raise RuntimeError(
+                f"migrate_export failed ({status}): {out.get('error', out)}")
+        return out
+
+    def migrate_import(self, record: Dict,
+                       trace_id: Optional[str] = None,
+                       parent_span_id: Optional[str] = None,
+                       deadline_s: Optional[float] = None) -> str:
+        """Hand a continuation record to the target replica (live-migration
+        import half). NO transport retry — like submit, a lost 202 would
+        duplicate the continuation. Raises the same typed backpressure
+        errors as :meth:`submit`."""
+        headers = {}
+        if trace_id:
+            headers[obstrace.TRACE_HEADER] = trace_id
+        if parent_span_id:
+            headers[obstrace.PARENT_HEADER] = parent_span_id
+        if deadline_s is not None:
+            headers[obstrace.DEADLINE_HEADER] = repr(float(deadline_s))
+        status, out = self._call("POST", "/admin/migrate_import", record,
+                                 retries=0, headers=headers or None)
+        if status == 429:
+            if out.get("error_type") == AdmissionRejected.error_type:
+                raise AdmissionRejected(
+                    out.get("error", "admission refused"),
+                    estimate=out.get("estimate"),
+                    retry_after=out.get("retry_after_s"))
+            raise QueueFullError(out.get("error", "queue full"),
+                                 retry_after=out.get("retry_after_s"))
+        if status == 503:
+            if out.get("error_type") == DeadlineExceededError.error_type:
+                raise DeadlineExceededError(
+                    out.get("error", "deadline exceeded"))
+            raise SchedulerClosed(out.get("error", "draining"))
+        if status == 400:
+            raise ValueError(out.get("error", "bad continuation record"))
+        if status != 202:
+            raise RuntimeError(f"migrate_import failed ({status}): {out}")
+        return out["id"]
 
     def admin_drain(self) -> Dict:
         """Ask the replica to stop admitting (drain step 1); poll

@@ -402,7 +402,9 @@ class TestServingMetricsEndpoint:
             c.request("GET", "/metrics")
             snap = json.loads(c.getresponse().read())
             assert set(snap) == {"replicas", "failovers", "resubmits",
-                                 "inflight_failures"}
+                                 "inflight_failures", "resurrections",
+                                 "resurrected_tokens", "migrations",
+                                 "migration_fallbacks"}
             c.request("GET", "/metrics", headers={"Accept": "text/plain"})
             types, samples = parse_prometheus_strict(
                 c.getresponse().read().decode())
@@ -643,28 +645,37 @@ class TestFlightRecorder:
                 time.sleep(0.01)
             assert rr.tokens, "generation never started"
             victim = rr.replica_addr
-            next(s for s in servers if s.addr == victim).kill()
             fr = obs_flight.flight_recorder()
-            deadline = time.monotonic() + 20
+            seq_before = fr._seq
+            next(s for s in servers if s.addr == victim).kill()
+            deadline = time.monotonic() + 30
             while time.monotonic() < deadline and not rr.done:
                 router.poll(rr)
                 time.sleep(0.02)
-            # in-flight request with observed tokens ⇒ surfaced FAILED
-            assert rr.state == Request.FAILED
+            # r21: in-flight stream with observed tokens is RESURRECTED
+            # on the survivor as a continuation join, not surfaced FAILED
+            assert rr.state == Request.DONE
+            assert rr.resurrections == 1
+            assert rr.replica_addr != victim
+            # exactly TWO dumps: one replica_death for the confirmed
+            # death (not one per affected observation) and one
+            # stream_resurrection for the re-homed stream
+            assert fr._seq == seq_before + 2
             assert fr.last is not None
-            assert fr.last["reason"] == "replica_death"
+            assert fr.last["reason"] == "stream_resurrection"
             assert fr.last["extra"]["replica"] == victim
             # the router's breaker/failover series are in the dump
             assert any(name.startswith("router-")
                        and "router_breaker_state" in m
                        for name, m in fr.last["metrics"].items())
             seq_after_first = fr.last
-            # a second affected observation must NOT dump again
+            # a second observation of the settled request must NOT dump
             try:
                 router.poll(rr)
             except Exception:
                 pass
             assert obs_flight.flight_recorder().last is seq_after_first
+            assert obs_flight.flight_recorder()._seq == seq_before + 2
         finally:
             router.stop()
             for s in servers:

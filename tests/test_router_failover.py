@@ -3,8 +3,8 @@
 Fast tier: least-loaded routing off /metrics, circuit-breaker
 eject/half-open rejoin, 429 spillover + Retry-After backpressure hints,
 drain-aware zero-drop takedown, in-process replica-kill failover
-(queued request re-homed, in-flight failure surfaced, never silently
-truncated), configurable graceful-drain deadline.
+(queued request re-homed, in-flight stream resurrected as a
+continuation join — ISSUE 17), configurable graceful-drain deadline.
 
 Slow tier (CPU-multiprocess): SIGKILL one of two replica PROCESSES
 mid-stream — queued requests complete on the survivor, recovery time
@@ -271,7 +271,7 @@ class TestReplicaKill:
                 return victim, running, queued, others
         raise AssertionError(f"no replica got 2 requests: {placed}")
 
-    def test_kill_requeues_queued_and_surfaces_inflight(self, model):
+    def test_kill_requeues_queued_and_resurrects_inflight(self, model):
         servers = {s.addr: s for s in (_server(model, n_slots=1),
                                        _server(model, n_slots=1))}
         addrs = list(servers)
@@ -288,12 +288,18 @@ class TestReplicaKill:
                     router.poll(running)
                     assert time.perf_counter() < deadline
                     time.sleep(0.01)
+                prefix = list(running.tokens)
+                n_before = len(prefix)
                 servers[victim].kill()
-                # in-flight: surfaced as FAILED via poll — with the error
-                # naming the dead replica, not a silent truncation
+                # in-flight: RESURRECTED as a continuation join on the
+                # survivor — completes with the full transcript, never a
+                # truncation or a from-scratch regeneration
                 out = router.wait(running, timeout=60)
-                assert out["status"] == Request.FAILED
-                assert "died after" in running.error
+                assert out["status"] == Request.DONE, running.error
+                assert len(out["tokens"]) == 24
+                assert out["tokens"][:n_before] == prefix
+                assert running.resurrections == 1
+                assert running.replica_addr != victim
                 # queued (never prefilled): completes on the survivor
                 out = router.wait(queued, timeout=60)
                 assert out["status"] == Request.DONE, queued.error
@@ -306,7 +312,9 @@ class TestReplicaKill:
                 snap = router.snapshot()
                 assert snap["replicas"][victim]["state"] == "open"
                 assert snap["resubmits"] >= 1
-                assert snap["inflight_failures"] == 1
+                assert snap["inflight_failures"] == 0
+                assert snap["resurrections"] == 1
+                assert snap["resurrected_tokens"] >= n_before
         finally:
             for s in servers.values():
                 try:
@@ -487,7 +495,8 @@ class TestReplicaKill:
 class TestInjectedReplicaKill:
     def _run_scenario(self, model):
         """One full injected-failover pass; returns (fired_log,
-        failover_tokens, runner_state, victim_addr, survivor_tokens)."""
+        failover_tokens, (runner_state, runner_tokens), victim_addr,
+        survivor_tokens)."""
         from paddle_tpu.resilience import FaultSchedule
 
         servers = {s.addr: s for s in (_server(model, n_slots=1),
@@ -509,8 +518,8 @@ class TestInjectedReplicaKill:
                 running, queued = placed[victim]
                 other = next(r for r in rrs if r not in (running, queued))
                 # observe tokens from the RUNNING one so the router knows
-                # its generation started (resubmit ineligible — the
-                # in-flight-failure half of the scenario)
+                # its generation started (the resurrection half of the
+                # scenario: it re-homes as a continuation, not a resubmit)
                 deadline = time.perf_counter() + 30
                 while not running.tokens:
                     router.poll(running)
@@ -529,7 +538,12 @@ class TestInjectedReplicaKill:
                 assert out_q["status"] == Request.DONE, queued.error
                 assert queued.replica_addr != victim
                 assert queued.resubmits == 1
-                assert out_r["status"] == Request.FAILED
+                # the in-flight one is RESURRECTED: full transcript on the
+                # survivor, bit-identical continuation (asserted by the
+                # twin-run comparison below)
+                assert out_r["status"] == Request.DONE, running.error
+                assert running.resurrections == 1
+                assert running.replica_addr != victim
                 assert other.state == Request.DONE
                 # normalize the ephemeral victim address out of the log:
                 # the replay certificate is (point, kind, count, WHICH
@@ -539,8 +553,8 @@ class TestInjectedReplicaKill:
                     if entry["labels"].get("replica") == victim:
                         entry["labels"]["replica"] = "victim"
                 return (log, list(queued.tokens),
-                        running.state, addrs.index(victim),
-                        list(other.tokens))
+                        (running.state, list(running.tokens)),
+                        addrs.index(victim), list(other.tokens))
         finally:
             for s in servers.values():
                 try:
@@ -551,19 +565,21 @@ class TestInjectedReplicaKill:
     def test_injected_replica_kill_token_identical_replay(self, model):
         """Tier-1 twin of the SIGKILL-a-replica chaos test PLUS the
         replay acceptance: the queued request (zero observed tokens)
-        re-homes and completes on the survivor, the in-flight one
-        surfaces FAILED, and two runs of the same schedule produce the
-        identical fault sequence and a token-identical failover
-        transcript."""
+        re-homes and completes on the survivor, the in-flight one is
+        RESURRECTED as a continuation join with its full transcript, and
+        two runs of the same schedule produce the identical fault
+        sequence and token-identical failover transcripts."""
         run_a = self._run_scenario(model)
         run_b = self._run_scenario(model)
         assert run_a == run_b  # fault log + transcripts, bit for bit
-        log, failover_tokens, runner_state, _, other_tokens = run_a
+        log, failover_tokens, (runner_state, runner_tokens), _, \
+            other_tokens = run_a
         assert log == [{"point": "replica.tick", "kind": "kill",
                         "count": 3, "labels": {"replica": "victim"}}]
         assert len(failover_tokens) == 14  # nothing dropped or truncated
         assert len(other_tokens) == 14
-        assert runner_state == Request.FAILED
+        assert runner_state == Request.DONE
+        assert len(runner_tokens) == 14  # resurrected, not truncated
 
 
 # =====================================================================
@@ -650,19 +666,14 @@ def test_replica_process_sigkill_mid_stream(tmp_path):
             assert queued.failover_first_token_at is not None
             recovery_s = queued.failover_first_token_at - t_kill
             assert 0 < recovery_s < 60
-            # every request the dead replica had NOT started completes;
-            # in-flight ones surface as failed, never silently truncated
+            # EVERY request survives the death: queued ones re-home,
+            # in-flight ones resurrect as continuation joins — nothing
+            # truncated, nothing regenerated from scratch
             for rr in rrs:
-                try:
-                    router.wait(rr, timeout=120)
-                except TimeoutError:
-                    pass
-                assert rr.state in (Request.DONE, Request.FAILED)
-                if rr.state == Request.FAILED:
-                    assert "died after" in rr.error
-            dropped = [rr for rr in rrs
-                       if rr.state == Request.FAILED and not rr.tokens]
-            assert dropped == []  # zero queued requests lost
+                router.wait(rr, timeout=120)
+                assert rr.state == Request.DONE, rr.error
+                assert len(rr.tokens) == 100
+            assert router.snapshot()["inflight_failures"] == 0
     finally:
         for p in procs:
             if p.poll() is None:

@@ -151,9 +151,19 @@ class TestEngineExactMatch:
         with pytest.raises(ValueError, match="KV capacity"):
             eng.submit(Request(np.arange(8, dtype=np.int32),
                                max_new_tokens=16))
+        # r21: a PAGED engine chunks a prompt past the largest bucket
+        # (the chunk loop always runs) — it is admitted, not rejected
+        long = eng.submit(Request(np.arange(12, dtype=np.int32),
+                                  max_new_tokens=1))
+        eng.run_until_idle(timeout=300)
+        assert long.state == Request.DONE
+        # the slot layout has no chunk loop: over-bucket still rejects
+        slot = ContinuousBatchingEngine(model, max_seq_len=16, n_slots=1,
+                                        prefill_buckets=[8],
+                                        kv_layout="slot")
         with pytest.raises(ValueError, match="bucket"):
-            eng.submit(Request(np.arange(12, dtype=np.int32),
-                               max_new_tokens=1))
+            slot.submit(Request(np.arange(12, dtype=np.int32),
+                                max_new_tokens=1))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,608 @@
+"""Zero-loss streams (ISSUE 17): deterministic stream resurrection on
+replica death + live stream migration.
+
+Both recovery paths share one mechanism — the CONTINUATION JOIN: an
+engine admits a request whose transcript is already partially generated,
+prefills prompt+observed through the ordinary chunk-bucket programs,
+fast-forwards the per-request PRNG key chain by len(observed) draws, and
+resumes decode at the right position. The continued trajectory is
+bit-identical to the uninterrupted run for greedy AND sampled requests.
+
+Covered here: engine-level join equivalence (mixed greedy/sampled
+batch), continuation validation and pricing, the CRC-stamped
+continuation record, export_stream, router resurrection certificates
+(two-run injected-twin + uninterrupted-reference equality),
+ResurrectionFailedError, the deadline-remainder stall regression, live
+migration (zero dropped/duplicated tokens while a neighbor slot keeps
+decoding), and the mid-migration death fallback.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+from paddle_tpu.serving import (
+    ContinuousBatchingEngine,
+    Request,
+    RequestFailedError,
+    ResurrectionFailedError,
+    ServingRouter,
+    ServingServer,
+    make_continuation_record,
+    verify_continuation_record,
+)
+
+VOCAB = 32
+
+
+def _tiny_model():
+    paddle.seed(0)
+    cfg = gpt_config("gpt2-small", vocab_size=VOCAB, hidden_size=16,
+                     num_layers=1, num_attention_heads=2,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+def _prompt(n=4, seed=0):
+    return np.random.default_rng(seed).integers(0, VOCAB, (n,)).tolist()
+
+
+def _engine(model, n_slots=2, **kw):
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("prefill_buckets", [8])
+    kw.setdefault("max_queue", 16)
+    return ContinuousBatchingEngine(model, n_slots=n_slots, **kw)
+
+
+def _run_engine(model, reqs, n_slots=4):
+    """Submit ``reqs`` to a fresh engine, run to completion, return the
+    per-request transcripts."""
+    eng = _engine(model, n_slots=n_slots)
+    stop = threading.Event()
+    t = threading.Thread(target=eng.serve_forever, args=(stop,),
+                         daemon=True)
+    t.start()
+    try:
+        for r in reqs:
+            eng.submit(r)
+        for r in reqs:
+            r.wait(120)
+            assert r.state == Request.DONE, (r.state, r.error)
+    finally:
+        stop.set()
+        t.join(30)
+    return [list(r.tokens) for r in reqs]
+
+
+def _server(model, n_slots=1, throttle_s=None, **kw):
+    eng = _engine(model, n_slots=n_slots, **kw)
+    if throttle_s:
+        # slow decode so a stream is still in flight when the test acts
+        # on it (the engine generates independently of router polls)
+        orig = eng.step_once
+        eng.step_once = lambda o=orig: (time.sleep(throttle_s), o())[1]
+    return ServingServer(eng).start()
+
+
+# =====================================================================
+# engine level: the continuation join itself
+# =====================================================================
+class TestContinuationJoin:
+    def _specs(self):
+        # per-row mixed greedy/sampled batch: the certificate must hold
+        # for every sampling mode side by side in the same engine
+        return [dict(max_new_tokens=16),
+                dict(max_new_tokens=16, temperature=0.9, seed=7),
+                dict(max_new_tokens=12, temperature=0.7, top_k=8, seed=11),
+                dict(max_new_tokens=12, temperature=1.1, top_p=0.9,
+                     seed=13)]
+
+    def test_join_bit_identical_mixed_batch(self, model):
+        """Uninterrupted reference vs continuation joins cut at several
+        points, all rows running CONCURRENTLY in one engine: every
+        continued transcript equals its uninterrupted twin bit for bit —
+        greedy, temperature, top-k and top-p rows alike."""
+        specs = self._specs()
+        prompt = _prompt()
+        refs = _run_engine(model,
+                           [Request(prompt, **s) for s in specs])
+        for cut in (1, 5):
+            cont = _run_engine(model, [
+                Request(prompt, observed_tokens=ref[:cut], **s)
+                for s, ref in zip(specs, refs)])
+            assert cont == refs, f"cut={cut}"
+
+    def test_terminal_continuation_completes_without_prefill(self, model):
+        """An observed transcript that already hit max_new_tokens (or
+        eos) has nothing left to generate: submit() settles it DONE
+        immediately — no slot, no prefill, poll/stream just replay."""
+        prompt = _prompt()
+        [ref] = _run_engine(model, [Request(prompt, max_new_tokens=8)])
+        eng = _engine(model)
+        req = eng.submit(Request(prompt, max_new_tokens=8,
+                                 observed_tokens=ref))
+        assert req.state == Request.DONE  # engine loop never ran
+        assert list(req.tokens) == ref
+        # eos-terminal: same short-circuit
+        req = eng.submit(Request(prompt, max_new_tokens=8,
+                                 eos_token_id=ref[2],
+                                 observed_tokens=ref[:3]))
+        assert req.state == Request.DONE
+        assert list(req.tokens) == ref[:3]
+
+    def test_continuation_validation(self):
+        prompt = _prompt()
+        # the observed log can never legitimately exceed the generation
+        # budget
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            Request(prompt, max_new_tokens=4, observed_tokens=[1] * 5)
+        # a sampled continuation without a pinned seed cannot reproduce
+        # the dead replica's key chain
+        with pytest.raises(ValueError, match="seed"):
+            Request(prompt, max_new_tokens=8, temperature=0.8,
+                    observed_tokens=[1, 2])
+        # join math: prompt + observed[:-1] is what prefill runs over
+        req = Request(prompt, max_new_tokens=8, observed_tokens=[9, 8, 7])
+        assert req.prefill_len == len(prompt) + 2
+        assert req.prefill_ids().tolist() == prompt + [9, 8]
+        assert list(req.tokens) == [9, 8, 7]  # pre-populated for replay
+
+    def test_fast_forward_key_matches_manual_chain(self):
+        import jax
+
+        from paddle_tpu.models.generation import fast_forward_key
+
+        key = jax.random.PRNGKey(7)
+        manual = key
+        for _ in range(5):
+            manual = jax.random.split(manual)[0]
+        assert np.array_equal(np.asarray(fast_forward_key(key, 5)),
+                              np.asarray(manual))
+        assert np.array_equal(np.asarray(fast_forward_key(key, 0)),
+                              np.asarray(key))
+        with pytest.raises(ValueError):
+            fast_forward_key(key, -1)
+
+
+class TestContinuationRecord:
+    def _record(self):
+        req = Request(_prompt(), max_new_tokens=8, temperature=0.9,
+                      seed=3, observed_tokens=[4, 5])
+        return make_continuation_record(req, deadline_remaining=1.5)
+
+    def test_roundtrip(self):
+        rec = self._record()
+        out = verify_continuation_record(rec)
+        assert out["tokens"] == [4, 5]
+        assert out["seed"] == 3
+        assert out["deadline_remaining"] == 1.5
+
+    def test_crc_rejects_tampering(self):
+        rec = self._record()
+        rec["tokens"] = [4, 6]  # one flipped token
+        with pytest.raises(ValueError, match="CRC"):
+            verify_continuation_record(rec)
+
+    def test_rejects_wrong_shape(self):
+        with pytest.raises(ValueError):
+            verify_continuation_record({"kind": "nonsense"})
+        rec = self._record()
+        del rec["seed"]
+        with pytest.raises(ValueError):
+            verify_continuation_record(rec)
+
+
+class TestExportStream:
+    def test_export_frees_slot_and_settles_migrated(self, model):
+        from paddle_tpu.serving import MIGRATED_ERROR_TYPE
+
+        eng = _engine(model, n_slots=1)
+        stop = threading.Event()
+        t = threading.Thread(target=eng.serve_forever, args=(stop,),
+                             daemon=True)
+        t.start()
+        try:
+            req = eng.submit(Request(_prompt(), max_new_tokens=24,
+                                     temperature=0.9, seed=5))
+            deadline = time.perf_counter() + 30
+            while len(req.tokens) < 3:
+                assert time.perf_counter() < deadline
+                time.sleep(0.005)
+            rec = eng.export_stream(req.request_id)
+            verify_continuation_record(rec)
+            assert rec["tokens"] == list(req.tokens)
+            assert rec["seed"] == 5 and rec["temperature"] == 0.9
+            # the source half settles with the typed "moved" verdict and
+            # the slot frees for new work
+            assert req.state == Request.FAILED
+            assert req.error_type == MIGRATED_ERROR_TYPE
+            assert eng.metrics.snapshot()["slot_occupancy"]["active"] == 0
+            # importing the record elsewhere resumes the identical run
+            cont = _run_engine(model, [Request(
+                rec["prompt"], observed_tokens=rec["tokens"],
+                max_new_tokens=rec["max_new_tokens"],
+                temperature=rec["temperature"], seed=rec["seed"])])
+            [ref] = _run_engine(model, [Request(
+                _prompt(), max_new_tokens=24, temperature=0.9, seed=5)])
+            assert cont == [ref]
+        finally:
+            stop.set()
+            t.join(30)
+
+    def test_export_unknown_or_queued_raises(self, model):
+        eng = _engine(model)
+        with pytest.raises(KeyError):
+            eng.export_stream("no-such-id")
+
+
+# =====================================================================
+# admission gate: continuation pricing (satellite 1)
+# =====================================================================
+class TestContinuationAdmission:
+    def test_gate_prices_join_not_bare_prompt(self, model):
+        from paddle_tpu.serving import AdmissionGate
+
+        eng = _engine(model, prefill_buckets=[4, 8], max_seq_len=40)
+        gate = AdmissionGate(eng, 1 << 40)
+        prompt = _prompt(n=3)
+        bare = gate.check(Request(prompt, max_new_tokens=20))
+        join = gate.check(Request(prompt, max_new_tokens=20,
+                                  observed_tokens=list(range(6))))
+        # join length 3+5=8 lands in the 8-bucket, the bare prompt in 4:
+        # the gate prices what prefill will actually run over
+        assert bare["bucket"] == 4
+        assert join["bucket"] == 8
+        assert (join["predicted_peak_hbm_bytes"]
+                > bare["predicted_peak_hbm_bytes"])
+
+    def test_pages_needed_nets_radix_resident_join(self, model):
+        """A re-homed stream whose prompt prefix is radix-resident on the
+        survivor is nearly free page-wise: pages_needed discounts the
+        shared pages against the JOIN sequence."""
+        eng = _engine(model, page_size=4, max_seq_len=32)
+        prompt = _prompt(n=8)
+        cold = eng.pages_needed(Request(prompt, max_new_tokens=8,
+                                        observed_tokens=[1, 2, 3, 4, 5]))
+        # make the join's first pages resident (as a prior request's
+        # prefill would have): 2 pages cover the 8-token prompt
+        eng._radix.insert(np.asarray(prompt, np.int32),
+                          eng._pool.alloc(2))
+        warm = eng.pages_needed(Request(prompt, max_new_tokens=8,
+                                        observed_tokens=[1, 2, 3, 4, 5]))
+        assert warm == cold - 2
+
+
+# =====================================================================
+# router level: resurrection
+# =====================================================================
+def _routed_pair(model, n_slots=1, throttle_s=None):
+    servers = {s.addr: s
+               for s in (_server(model, n_slots=n_slots,
+                                 throttle_s=throttle_s),
+                         _server(model, n_slots=n_slots,
+                                 throttle_s=throttle_s))}
+    router = ServingRouter(list(servers), health_interval_s=0.1,
+                           cooldown_s=30.0, request_timeout=5.0)
+    return servers, router
+
+
+def _kill_all(servers):
+    for s in servers.values():
+        try:
+            s.kill()
+        except Exception:
+            pass
+
+
+def _warm(router, n=2, prompt=None):
+    for rr in [router.submit(prompt or _prompt(), max_new_tokens=2)
+               for _ in range(n)]:
+        router.wait(rr, timeout=120)
+    router.check_health()
+
+
+class TestResurrection:
+    def _run_sampled_scenario(self, model):
+        """Kill the replica mid-SAMPLED-stream at a deterministic tick;
+        returns (fired_log, transcript, resurrections)."""
+        from paddle_tpu.resilience import FaultSchedule
+
+        servers, router = _routed_pair(model)
+        try:
+            with router:
+                router.check_health()
+                _warm(router)
+                rr = router.submit(_prompt(), max_new_tokens=24,
+                                   temperature=0.9, seed=21)
+                victim = rr.replica_addr
+                deadline = time.perf_counter() + 30
+                while not rr.tokens:
+                    router.poll(rr)
+                    assert time.perf_counter() < deadline
+                    time.sleep(0.005)
+                # arm as soon as generation visibly started: the victim
+                # dies at its NEXT productive tick, well inside the
+                # 24-token run
+                sched = FaultSchedule(seed=9).add(
+                    "replica.tick", "kill", at=1,
+                    match={"replica": victim})
+                with sched:
+                    out = router.wait(rr, timeout=120)
+                assert out["status"] == Request.DONE, rr.error
+                assert rr.replica_addr != victim
+                log = sched.fired_log()
+                for e in log:
+                    if e["labels"].get("replica") == victim:
+                        e["labels"]["replica"] = "victim"
+                return (log, list(rr.tokens),
+                        router.snapshot()["resurrections"])
+        finally:
+            _kill_all(servers)
+
+    def test_sampled_resurrection_bit_identical_two_run(self, model):
+        """The acceptance certificate: a SAMPLED stream killed
+        mid-generation resumes token-for-token identical to the
+        uninterrupted run, and two injected-twin replays produce the
+        identical fired log and transcript."""
+        # uninterrupted reference (same spec, no chaos, single replica)
+        [ref] = _run_engine(model, [Request(
+            _prompt(), max_new_tokens=24, temperature=0.9, seed=21)])
+        run_a = self._run_sampled_scenario(model)
+        run_b = self._run_sampled_scenario(model)
+        assert run_a == run_b  # fired log + transcript, bit for bit
+        log, tokens, resurrections = run_a
+        assert log == [{"point": "replica.tick", "kind": "kill",
+                        "count": 1, "labels": {"replica": "victim"}}]
+        assert tokens == ref  # continuation == uninterrupted, bitwise
+        assert resurrections == 1
+
+    def test_router_mints_seed_for_sampled_requests(self, model):
+        """A sampled request submitted WITHOUT a seed must still be
+        resurrectable: the router pins a deterministic seed at the entry
+        point (the engine's fallback seed would die with the replica)."""
+        servers, router = _routed_pair(model)
+        try:
+            with router:
+                router.check_health()
+                rr = router.submit(_prompt(), max_new_tokens=4,
+                                   temperature=0.9)
+                assert rr.spec["seed"] is not None
+                greedy = router.submit(_prompt(), max_new_tokens=4)
+                assert greedy.spec.get("seed") is None  # greedy untouched
+                router.wait(rr, timeout=120)
+                router.wait(greedy, timeout=120)
+        finally:
+            _kill_all(servers)
+
+    def test_no_survivor_raises_resurrection_failed(self, model):
+        """Single replica, stream started, replica dies: the typed
+        terminal verdict is ResurrectionFailedError — live AND on settled
+        replay — never a silent retry loop."""
+        srv = _server(model)
+        router = ServingRouter([srv.addr], health_interval_s=5.0,
+                               request_timeout=5.0, resubmit_retries=0)
+        try:
+            with router:
+                router.check_health()
+                rr = router.submit(_prompt(), max_new_tokens=24)
+                deadline = time.perf_counter() + 30
+                while len(rr.tokens) < 2:
+                    router.poll(rr)
+                    assert time.perf_counter() < deadline
+                    time.sleep(0.01)
+                srv.kill()
+                with pytest.raises(ResurrectionFailedError,
+                                   match="no survivor"):
+                    list(router.stream(rr))
+                assert rr.state == Request.FAILED
+                assert rr.failure_kind == "resurrection"
+                # the observed log survives for salvage
+                assert len(rr.tokens) >= 2
+                # settled replay keeps the type
+                with pytest.raises(ResurrectionFailedError):
+                    list(router.stream(rr))
+                snap = router.snapshot()
+                assert snap["inflight_failures"] == 1
+                assert snap["resurrections"] == 0
+        finally:
+            try:
+                srv.kill()
+            except Exception:
+                pass
+
+    def test_resurrection_stall_burns_the_same_deadline(self, model):
+        """Deadline-remainder regression (satellite 3): time burned on
+        the dead replica AND in the recovery machinery is deducted from
+        the request's ONE deadline — an injected stall at the
+        resurrection seam longer than the remainder must surface the
+        typed deadline verdict, not grant the continuation a fresh
+        clock."""
+        from paddle_tpu.resilience import FaultSchedule
+
+        servers, router = _routed_pair(model)
+        try:
+            with router:
+                router.check_health()
+                _warm(router)
+                rr = router.submit(_prompt(), max_new_tokens=24,
+                                   deadline_s=2.0)
+                victim = rr.replica_addr
+                deadline = time.perf_counter() + 30
+                while len(rr.tokens) < 2:
+                    router.poll(rr)
+                    assert time.perf_counter() < deadline
+                    time.sleep(0.01)
+                sched = FaultSchedule(seed=3).add(
+                    "router.resurrect", "stall", at=1, seconds=2.5)
+                with sched:
+                    servers[victim].kill()
+                    with pytest.raises(RequestFailedError,
+                                       match="[Dd]eadline"):
+                        for _ in router.stream(rr):
+                            pass
+                assert rr.state == Request.FAILED
+                assert rr.failure_kind == "request"
+                assert sched.fired_log()[0]["point"] == "router.resurrect"
+        finally:
+            _kill_all(servers)
+
+    def test_observed_log_capped_at_max_new_tokens(self):
+        """Satellite 2: the router-side transcript can never grow past
+        the generation budget, whatever a racing stream replays."""
+        from paddle_tpu.serving import RoutedRequest
+
+        rr = RoutedRequest(_prompt(), max_new_tokens=4)
+        rr._observe(list(range(10)))
+        assert rr.tokens == [0, 1, 2, 3]
+        rr._observe(list(range(8)))  # longer replay: still capped
+        assert rr.tokens == [0, 1, 2, 3]
+
+
+# =====================================================================
+# router level: live migration
+# =====================================================================
+class TestLiveMigration:
+    def test_migration_zero_drop_zero_dup_neighbor_decoding(self, model):
+        """Drain one stream off a replica mid-generation while a
+        NEIGHBOR slot on the target keeps decoding: the migrated
+        transcript equals the uninterrupted reference exactly (zero
+        dropped, zero duplicated) and the neighbor is undisturbed."""
+        [ref] = _run_engine(model, [Request(
+            _prompt(), max_new_tokens=20, temperature=0.8, seed=17)])
+        [ref_n] = _run_engine(model, [Request(
+            _prompt(n=5, seed=2), max_new_tokens=20)])
+        servers, router = _routed_pair(model, n_slots=2, throttle_s=0.04)
+        try:
+            with router:
+                router.check_health()
+                _warm(router)
+                rr = router.submit(_prompt(), max_new_tokens=20,
+                                   temperature=0.8, seed=17)
+                src = rr.replica_addr
+                dst = next(a for a in servers if a != src)
+                # neighbor decodes on the TARGET throughout
+                neighbor = None
+                while neighbor is None or neighbor.replica_addr != dst:
+                    neighbor = router.submit(_prompt(n=5, seed=2),
+                                             max_new_tokens=20)
+                got = []
+                t = threading.Thread(
+                    target=lambda: got.extend(router.stream(rr)))
+                t.start()
+                deadline = time.perf_counter() + 30
+                while len(got) < 5:
+                    assert time.perf_counter() < deadline
+                    time.sleep(0.005)
+                router.migrate(rr, dst)
+                t.join(120)
+                assert not t.is_alive()
+                assert got == ref  # bitwise: no drop, no dup, no fork
+                assert rr.replica_addr == dst
+                assert rr.state == Request.DONE
+                router.wait(neighbor, timeout=120)
+                assert list(neighbor.tokens) == ref_n
+                snap = router.snapshot()
+                assert snap["migrations"] == 1
+                assert snap["migration_fallbacks"] == 0
+        finally:
+            _kill_all(servers)
+
+    def test_mid_migration_death_falls_back_to_resurrection(self, model):
+        """The import hop dying mid-migration must NOT lose the stream:
+        the source already exported (slot freed), so the router re-homes
+        the continuation exactly like a death resurrection."""
+        from paddle_tpu.resilience import FaultSchedule
+
+        [ref] = _run_engine(model, [Request(
+            _prompt(), max_new_tokens=16, temperature=0.9, seed=23)])
+        servers, router = _routed_pair(model, throttle_s=0.04)
+        try:
+            with router:
+                router.check_health()
+                _warm(router)
+                rr = router.submit(_prompt(), max_new_tokens=16,
+                                   temperature=0.9, seed=23)
+                src = rr.replica_addr
+                dst = next(a for a in servers if a != src)
+                deadline = time.perf_counter() + 30
+                while len(rr.tokens) < 3:
+                    router.poll(rr)
+                    assert time.perf_counter() < deadline
+                    time.sleep(0.01)
+                sched = FaultSchedule(seed=7).add(
+                    "router.transport", "raise", at=1,
+                    match={"path": "/admin/migrate_import"})
+                with sched:
+                    router.migrate(rr, dst)  # falls back, does not raise
+                assert [f["labels"]["path"] for f in sched.fired_log()] \
+                    == ["/admin/migrate_import"]
+                out = router.wait(rr, timeout=120)
+                assert out["status"] == Request.DONE, rr.error
+                assert list(rr.tokens) == ref  # still bit-identical
+                snap = router.snapshot()
+                assert snap["migrations"] == 0
+                assert snap["migration_fallbacks"] == 1
+                assert snap["resurrections"] == 1
+        finally:
+            _kill_all(servers)
+
+    def test_poll_of_exported_source_is_transient(self, model):
+        """The poll/export race: a poll hitting the SOURCE after the
+        export but before the router flips routing sees the MigratedError
+        verdict and must report RUNNING (moved), never settle the
+        stream."""
+        servers, router = _routed_pair(model)
+        try:
+            with router:
+                router.check_health()
+                rr = router.submit(_prompt(), max_new_tokens=24)
+                deadline = time.perf_counter() + 30
+                while len(rr.tokens) < 2:
+                    router.poll(rr)
+                    assert time.perf_counter() < deadline
+                    time.sleep(0.01)
+                src = rr.replica_addr
+                dst = next(a for a in servers if a != src)
+                # simulate the mid-migration window: exported, not yet
+                # flipped
+                rec = servers[src].engine.export_stream(rr.remote_id)
+                out = router.poll(rr)
+                assert out["status"] == Request.RUNNING
+                assert not rr.done
+                # finish the flip by hand (what migrate() does)
+                rr.remote_id = router.replicas[dst].client.migrate_import(
+                    rec)
+                rr.replica_addr = dst
+                out = router.wait(rr, timeout=120)
+                assert out["status"] == Request.DONE
+                assert len(rr.tokens) == 24
+        finally:
+            _kill_all(servers)
+
+    def test_migrate_validation(self, model):
+        servers, router = _routed_pair(model)
+        try:
+            with router:
+                router.check_health()
+                rr = router.submit(_prompt(), max_new_tokens=4)
+                with pytest.raises(KeyError, match="unknown replica"):
+                    router.migrate(rr, "127.0.0.1:1")
+                home = rr.replica_addr
+                router.migrate(rr, home)  # same-home: a no-op
+                assert router.snapshot()["migrations"] == 0
+                router.wait(rr, timeout=120)
+                with pytest.raises(ValueError, match="settled"):
+                    router.migrate(rr, home)
+        finally:
+            _kill_all(servers)
