@@ -899,6 +899,158 @@ def _serving_tput(on_tpu):
     return out
 
 
+def _int8_kv(on_tpu):
+    """Int8 paged KV (ISSUE 18): the same mixed-length trace through the
+    quantized pool vs the fp pool — per-stream KV HBM (sampled mid-decode
+    with every slot live), the page-bytes ratio the admission gate prices,
+    and the pinned greedy-divergence certificate. The acceptance bound:
+    int8 per-stream bytes <= 55% of the fp layout's."""
+    import gc
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+    from paddle_tpu.serving import ContinuousBatchingEngine, Request
+
+    if on_tpu:
+        name, n_req, max_new, s, n_slots = "gpt3-350m", 16, 16, 1024, 8
+        lo, hi, buckets, page_size = 64, 512, [64, 128, 256, 512], 32
+        overrides = {}
+    else:
+        name, n_req, max_new, s, n_slots = "gpt2-small", 8, 6, 64, 4
+        lo, hi, buckets, page_size = 3, 14, [4, 8, 16], 8
+        overrides = dict(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_attention_heads=4, max_position_embeddings=64)
+    cfg = gpt_config(name, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0, **overrides)
+    paddle.seed(0)
+    clear_mesh()
+    gc.collect()
+    init_mesh({"dp": 1})
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.default_rng(18)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(l),)).astype("int32")
+               for l in rng.integers(lo, hi, size=n_req)]
+
+    def run(kv_dtype):
+        kw = {"kv_dtype": kv_dtype} if kv_dtype else {}
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=s, n_slots=n_slots, prefill_buckets=buckets,
+            max_queue=n_req, page_size=page_size, prefix_sharing=False, **kw)
+        eng.generate_batch(
+            [Request(p, max_new_tokens=2) for p in prompts[:n_slots]])  # warm
+        live = [eng.submit(Request(p, max_new_tokens=max_new))
+                for p in prompts[:n_slots]]
+        eng.step_once()
+        per_stream = eng.kv_bytes_per_stream() or 0.0
+        eng.run_until_idle()
+        reqs = [Request(p, max_new_tokens=max_new) for p in prompts]
+        t0 = time.perf_counter()
+        eng.generate_batch(reqs)
+        dt = time.perf_counter() - t0
+        del live
+        return eng, per_stream, reqs, n_req * max_new / dt
+
+    fp, fp_stream, fp_reqs, fp_tput = run(None)
+    q, q_stream, q_reqs, q_tput = run("int8")
+    div = sum(int(a != b) for qr, fr in zip(q_reqs, fp_reqs)
+              for a, b in zip(qr.tokens, fr.tokens))
+    tot = sum(len(r.tokens) for r in fp_reqs)
+    ratio = q_stream / fp_stream if fp_stream else 0.0
+    return {
+        "int8_kv_hbm_per_stream_bytes": int(q_stream),
+        "int8_kv_hbm_per_stream_fp_bytes": int(fp_stream),
+        "int8_kv_hbm_stream_ratio": round(ratio, 4),
+        "int8_kv_hbm_ratio_ok": bool(0.0 < ratio <= 0.55),
+        "int8_kv_page_bytes_ratio": round(q.page_bytes / fp.page_bytes, 4),
+        "int8_kv_tokens_per_sec": round(q_tput, 2),
+        "int8_kv_fp_tokens_per_sec": round(fp_tput, 2),
+        "int8_kv_greedy_divergence_rate": round(div / tot, 4),
+        "int8_kv_trace": {"n_requests": n_req, "max_new_tokens": max_new,
+                          "page_size": page_size, "n_slots": n_slots},
+    }
+
+
+def _spec_decode_tput(on_tpu):
+    """Speculative decoding (ISSUE 18): the same trace through the plain
+    paged engine and the spec engine under self-speculation (draft ==
+    target), where every greedy proposal verifies — so acceptance_rate
+    and accepted_per_verify measure the real propose/verify machinery at
+    its acceptance ceiling, and exactness vs the plain arm is the replay
+    certificate. The acceptance criterion: accepted_per_verify > 1 (each
+    batched verify emits more than one token). Off-TPU the draft re-runs
+    the full target per proposed token, so tok/s is NOT expected to beat
+    the plain arm — the win claim is TPU-arm only."""
+    import gc
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.env import clear_mesh, init_mesh
+    from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+    from paddle_tpu.serving import (
+        ContinuousBatchingEngine,
+        Request,
+        SpecDecodeConfig,
+    )
+
+    if on_tpu:
+        name, n_req, max_new, s, n_slots, k = "gpt3-350m", 16, 24, 1024, 8, 4
+        lo, hi, buckets, page_size = 64, 512, [64, 128, 256, 512], 32
+        overrides = {}
+    else:
+        name, n_req, max_new, s, n_slots, k = "gpt2-small", 8, 8, 64, 4, 3
+        lo, hi, buckets, page_size = 3, 14, [4, 8, 16], 8
+        overrides = dict(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_attention_heads=4, max_position_embeddings=64)
+    cfg = gpt_config(name, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0, **overrides)
+    paddle.seed(0)
+    clear_mesh()
+    gc.collect()
+    init_mesh({"dp": 1})
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.default_rng(18)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(l),)).astype("int32")
+               for l in rng.integers(lo, hi, size=n_req)]
+
+    def run(spec):
+        kw = {"spec_decode": SpecDecodeConfig(model, k=k)} if spec else {}
+        eng = ContinuousBatchingEngine(
+            model, max_seq_len=s, n_slots=n_slots, prefill_buckets=buckets,
+            max_queue=n_req, page_size=page_size, **kw)
+
+        def one_pass():
+            reqs = [Request(p, max_new_tokens=max_new) for p in prompts]
+            t0 = time.perf_counter()
+            eng.generate_batch(reqs)
+            return reqs, time.perf_counter() - t0
+
+        one_pass()  # warmup: chunk buckets + (draft/verify or step) compile
+        reqs, dt = one_pass()
+        return eng, reqs, n_req * max_new / dt
+
+    plain_eng, plain_reqs, plain_tput = run(False)
+    spec_eng, spec_reqs, spec_tput = run(True)
+    sd = spec_eng.metrics.snapshot()["spec_decode"]
+    return {
+        "spec_decode_tokens_per_sec": round(spec_tput, 2),
+        "spec_decode_plain_tokens_per_sec": round(plain_tput, 2),
+        "spec_decode_speedup_vs_plain": round(spec_tput / plain_tput, 3),
+        "spec_decode_acceptance_rate": round(sd["acceptance_rate"] or 0.0, 4),
+        "spec_decode_accepted_per_verify": round(
+            sd["accepted_per_verify"] or 0.0, 4),
+        "spec_decode_accepted_per_verify_ok": bool(
+            (sd["accepted_per_verify"] or 0.0) > 1.0),
+        "spec_decode_exact_vs_plain": bool(all(
+            sr.tokens == pr.tokens
+            for sr, pr in zip(spec_reqs, plain_reqs))),
+        "spec_decode_compiled_programs": dict(spec_eng._spec.trace_counts),
+        "spec_decode_trace": {"k": k, "n_requests": n_req,
+                              "max_new_tokens": max_new, "n_slots": n_slots},
+    }
+
+
 def _kernel_speedups(on_tpu, reps=10):
     """Per-kernel microbench (ISSUE 16): each r20 Pallas kernel against a
     jitted XLA implementation of the same math, both arms compiled and
@@ -1623,6 +1775,18 @@ def main():
         except Exception as e:  # pragma: no cover - device dependent
             secondary["serving_cb_tokens_per_sec"] = f"failed: {type(e).__name__}"
         try:
+            # quantization: int8 paged-KV HBM + divergence (ISSUE 18)
+            secondary.update(_int8_kv(True))
+        except Exception as e:  # pragma: no cover - device dependent
+            secondary["int8_kv_hbm_per_stream_bytes"] = \
+                f"failed: {type(e).__name__}"
+        try:
+            # speculative decoding vs plain paged decode (ISSUE 18)
+            secondary.update(_spec_decode_tput(True))
+        except Exception as e:  # pragma: no cover - device dependent
+            secondary["spec_decode_tokens_per_sec"] = \
+                f"failed: {type(e).__name__}"
+        try:
             # per-kernel Pallas-vs-XLA microbench (ISSUE 16)
             secondary.update(_kernel_speedups(True))
         except Exception as e:  # pragma: no cover - device dependent
@@ -1721,6 +1885,16 @@ def main():
             secondary.update(_serving_tput(False))
         except Exception as e:  # pragma: no cover
             secondary["serving_cb_tokens_per_sec"] = f"failed: {type(e).__name__}"
+        try:
+            secondary.update(_int8_kv(False))
+        except Exception as e:  # pragma: no cover
+            secondary["int8_kv_hbm_per_stream_bytes"] = \
+                f"failed: {type(e).__name__}"
+        try:
+            secondary.update(_spec_decode_tput(False))
+        except Exception as e:  # pragma: no cover
+            secondary["spec_decode_tokens_per_sec"] = \
+                f"failed: {type(e).__name__}"
         try:
             secondary.update(_kernel_speedups(False))
         except Exception as e:  # pragma: no cover

@@ -12,6 +12,9 @@ CPU-lintable size and handed to the rule engine:
   against the engine's *intended* donation (the live jit gates donation
   off on CPU where XLA ignores aliasing), so the report reflects the TPU
   deployment.
+* ``serving_*_int8kv`` / ``serving_*_int8w`` — the quantized serving
+  plane (int8 paged KV, int8 weights); the dequant-materialization
+  check must come back clean here.
 * ``exported_infer``    — a ``jit.save``/``jit.load`` StableHLO artifact
   replayed through ``Exported.call``.
 * ``static_program``    — a ``static.Program`` op-record IR with
@@ -34,6 +37,7 @@ __all__ = [
     "trainer_target",
     "pipeline_target",
     "serving_targets",
+    "serving_int8_targets",
     "exported_target",
     "static_program_target",
     "shipped_entry_points",
@@ -172,6 +176,51 @@ def serving_targets() -> List[AnalysisTarget]:
     return [prefill, decode, prefill_pl, decode_pl]
 
 
+def serving_int8_targets() -> List[AnalysisTarget]:
+    """The quantized serving plane (ISSUE 18): the engine's programs with
+    int8 paged KV and with int8 weights, linted side by side with the fp
+    arm.  The dtype-promotion rule's dequant-materialization check must
+    come back clean: the weight matmuls stay ``int8 x int8 -> int32``
+    with scales folded into the accumulator, and the per-page KV dequant
+    (gather-fed) is exempt by construction."""
+    import paddle_tpu as paddle
+    from ..models.gpt import GPTForPretraining, gpt_config
+    from ..serving.engine import ContinuousBatchingEngine
+
+    cfg = gpt_config("gpt2-small", vocab_size=64, hidden_size=32,
+                     num_layers=2, num_attention_heads=4,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    out: List[AnalysisTarget] = []
+    paddle.seed(0)
+    kv_model = GPTForPretraining(cfg)
+    kv_model.eval()
+    kv = ContinuousBatchingEngine(kv_model, max_seq_len=32, n_slots=4,
+                                  kv_dtype="int8")
+    out.append(AnalysisTarget(
+        "serving_prefill_int8kv", kv._prefill_jit, kv._prefill_arg_specs(8),
+        tags=("serving", "int8"),
+        donate_argnums=getattr(kv, "_donate_prefill", ())))
+    out.append(AnalysisTarget(
+        "serving_decode_int8kv", kv._step_jit, kv._step_args_example(),
+        tags=("serving", "int8"),
+        donate_argnums=getattr(kv, "_donate_step", ())))
+    paddle.seed(0)
+    w8_model = GPTForPretraining(cfg)
+    w8_model.eval()
+    w8 = ContinuousBatchingEngine(w8_model, max_seq_len=32, n_slots=4,
+                                  weight_dtype="int8")
+    out.append(AnalysisTarget(
+        "serving_prefill_int8w", w8._prefill_jit, w8._prefill_arg_specs(8),
+        tags=("serving", "int8"),
+        donate_argnums=getattr(w8, "_donate_prefill", ())))
+    out.append(AnalysisTarget(
+        "serving_decode_int8w", w8._step_jit, w8._step_args_example(),
+        tags=("serving", "int8"),
+        donate_argnums=getattr(w8, "_donate_step", ())))
+    return out
+
+
 def exported_target() -> AnalysisTarget:
     """jit.save → jit.load StableHLO artifact, replayed via Exported.call."""
     import os
@@ -238,6 +287,7 @@ _BUILDERS = (
     ("trainer_step", lambda: [trainer_target()]),
     ("pipeline_step", lambda: [pipeline_target()]),
     ("serving", serving_targets),
+    ("serving_int8", serving_int8_targets),
     ("exported_infer", lambda: [exported_target()]),
     ("static_program", lambda: [static_program_target()]),
 )

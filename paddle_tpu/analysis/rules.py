@@ -134,6 +134,35 @@ def default_host_rules(only=(), **overrides) -> List[Rule]:
 class DtypePromotionRule(Rule):
     name = "dtype-promotion"
 
+    #: ops the int8-dequant walk descends through (the rescale/reshape
+    #: chain between a dequantized weight and the dot that consumes it)
+    _DEQUANT_WALK = ("mul", "add", "sub", "div", "broadcast_in_dim",
+                     "reshape", "transpose", "convert_element_type")
+
+    def _int8_weight_dequant(self, g, dot, operand, max_depth=4):
+        """The ``convert_element_type`` node dequantizing an int8 ENTRY
+        array into this dot operand at full precision, or None.
+
+        A convert fed by a producer (gather, dynamic_slice, ...) is the
+        paged-KV per-page dequant — bounded by the gathered working set,
+        not a weight copy — and is deliberately not matched."""
+        frontier = [(g.producer(dot, operand), 0)]
+        seen = set()
+        while frontier:
+            node, depth = frontier.pop()
+            if node is None or node.idx in seen or depth > max_depth:
+                continue
+            seen.add(node.idx)
+            if (node.prim == "convert_element_type" and node.in_avals
+                    and node.in_avals[0][1] in ("int8", "uint8")):
+                if g.producer(node, 0) is None:
+                    return node  # entry array/const: a stored weight
+                continue  # gather-fed: per-page KV dequant, exempt
+            if node.prim in self._DEQUANT_WALK:
+                for j in range(len(node.in_avals)):
+                    frontier.append((g.producer(node, j), depth + 1))
+        return None
+
     def run(self, target):
         g = target.graph()
         findings: List[Finding] = []
@@ -158,6 +187,29 @@ class DtypePromotionRule(Rule):
                         node=n, operand=i,
                         upcast_source=prod.source))
                     flagged.add(n.idx)
+                    break
+        # int8 dequant materialization (ISSUE 18): a float dot fed by a
+        # dequantized int8 WEIGHT (int8->float convert on an entry array,
+        # rescaled/reshaped on the way in) re-materializes the full-
+        # precision weight copy on every call — the quantized path must
+        # keep the matmul int8 x int8 -> int32 and fold both scales into
+        # the accumulator (nn/functional._linear_int8 does)
+        for n in dots:
+            if not n.out_avals or n.out_avals[0][1] not in (
+                    ("float32", "float64") + _HALF):
+                continue
+            for i in range(len(n.in_avals)):
+                src = self._int8_weight_dequant(g, n, i)
+                if src is not None:
+                    findings.append(self.finding(
+                        Severity.HIGH,
+                        f"{n.out_avals[0][1]} {n.prim} fed by a dequantized "
+                        f"int8 weight ({src.in_avals[0][1]}->float "
+                        "convert_element_type of an entry array): the full-"
+                        "precision weight copy is materialized on every "
+                        "call; keep the matmul int8 x int8 -> int32 and "
+                        "fold the scales into the accumulator",
+                        node=n, operand=i, dequant_source=src.source))
                     break
         # "predominantly half-precision" means a MAJORITY of the matmuls:
         # one incidental bf16 dot in an ordinary f32 program is not an amp

@@ -175,7 +175,9 @@ class ColumnParallelLinear(Layer):
             return out
         from ..spmd import with_sharding_constraint
 
-        out = F.linear(x, self.weight, self.bias)
+        out = F.linear(x, self.weight, self.bias,
+                       weight_scale=getattr(self, "weight_scale", None),
+                       act_scale=getattr(self, "act_scale", None))
         if self.gather_output:
             out = with_sharding_constraint(out, P())
         else:
@@ -215,7 +217,9 @@ class RowParallelLinear(Layer):
                 return y
 
             return _row(x, self.weight, self.bias)
-        out = F.linear(x, self.weight, self.bias)
+        out = F.linear(x, self.weight, self.bias,
+                       weight_scale=getattr(self, "weight_scale", None),
+                       act_scale=getattr(self, "act_scale", None))
         from ..spmd import with_sharding_constraint
 
         return with_sharding_constraint(out, P())

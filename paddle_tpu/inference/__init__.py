@@ -29,7 +29,8 @@ from typing import List, Optional
 import numpy as np
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor",
-           "PaddlePassBuilder", "save_for_generation", "GenerationPredictor"]
+           "PaddlePassBuilder", "save_for_generation", "GenerationPredictor",
+           "save_quantized", "load_quantized"]
 
 
 def __getattr__(name):
@@ -37,6 +38,10 @@ def __getattr__(name):
         from . import generation
 
         return getattr(generation, name)
+    if name in ("save_quantized", "load_quantized"):
+        from . import quantized
+
+        return getattr(quantized, name)
     raise AttributeError(name)
 
 _DEFAULT_PASSES = [

@@ -1,0 +1,160 @@
+"""Quantized-weight deployment artifacts (ISSUE 18).
+
+Parity: Paddle Inference consumes PaddleSlim's post-training-quantized
+programs as ordinary saved inference models whose weights are int8 plus
+scale tensors.  Here the artifact is framework-native: one file holding a
+length-prefixed JSON meta block (format version, layer list, per-tensor
+shapes/dtypes, payload CRC) followed by an npz payload of the int8
+weights and their ``weight_scale`` / ``act_scale`` buffers.
+
+The file is published through
+:func:`paddle_tpu.framework.checkpoint.durable_write_bytes` (write
+dot-temp sibling, fsync, atomic rename, fsync dir), so a crash mid-save
+leaves the previous artifact intact; the CRC pins torn/corrupted
+payloads at load — a flipped scale byte fails loudly with
+:class:`ValueError` instead of silently mis-scaling every matmul.
+
+``load_quantized`` applies the artifact onto a same-architecture fp
+model in place (weights become int8, scale buffers registered), after
+which the model serves through the W8A8 path exactly as if
+``quantize_model_weights_`` had run locally.
+"""
+from __future__ import annotations
+
+import io
+import json
+import struct
+import zlib
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["save_quantized", "load_quantized", "QUANT_FORMAT_VERSION"]
+
+QUANT_FORMAT_VERSION = 1
+_MAGIC = b"PDQ8"
+
+
+def _layer_map(model) -> Dict[str, object]:
+    return {name or type(layer).__name__: layer
+            for name, layer in model.named_sublayers(include_self=True)}
+
+
+def save_quantized(model, path: str) -> List[str]:
+    """Serialize ``model``'s int8 layers (weights + scales) to ``path``.
+
+    The model must already be quantized (:func:`~paddle_tpu.quantization
+    .quantize_model_weights_` / engine ``weight_dtype="int8"``).  Returns
+    the layer names captured.  Raises :class:`ValueError` when the model
+    holds no int8 layers — saving an fp model as a "quantized artifact"
+    would only defer the surprise to load time.
+    """
+    from ..framework.checkpoint import durable_write_bytes
+    from ..quantization.ptq import _np_dtype_name, _target_layers
+
+    arrays: Dict[str, np.ndarray] = {}
+    layers_meta: Dict[str, Dict] = {}
+    for name, layer in _target_layers(model):
+        if _np_dtype_name(layer.weight) != "int8":
+            continue
+        scale = getattr(layer, "weight_scale", None)
+        if scale is None:
+            raise ValueError(
+                f"layer {name} has int8 weight but no weight_scale buffer")
+        w = np.asarray(layer.weight._data)
+        s = np.asarray(scale._data)
+        arrays[f"{name}.weight"] = w
+        arrays[f"{name}.weight_scale"] = s
+        entry = {"weight_shape": list(w.shape),
+                 "weight_dtype": str(w.dtype),
+                 "scale_shape": list(s.shape)}
+        act = getattr(layer, "act_scale", None)
+        if act is not None:
+            arrays[f"{name}.act_scale"] = np.asarray(act._data)
+            entry["act_scale"] = True
+        layers_meta[name] = entry
+    if not layers_meta:
+        raise ValueError(
+            "model holds no int8 layers — run quantize_model_weights_ "
+            "(or post_training_quantize_) before save_quantized")
+
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    payload = buf.getvalue()
+    meta = {
+        "format": "paddle_tpu.quantized",
+        "version": QUANT_FORMAT_VERSION,
+        "scheme": "w8a8-per-channel-absmax",
+        "layers": layers_meta,
+        "payload_crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+        "payload_bytes": len(payload),
+    }
+    meta_bytes = json.dumps(meta, sort_keys=True).encode("utf-8")
+    blob = (_MAGIC + struct.pack("<I", len(meta_bytes)) + meta_bytes
+            + payload)
+    durable_write_bytes(path, blob)
+    return sorted(layers_meta)
+
+
+def load_quantized(model, path: str) -> List[str]:
+    """Apply a :func:`save_quantized` artifact onto ``model`` in place.
+
+    Verifies the payload CRC before touching the model — a corrupt
+    artifact raises :class:`ValueError` and leaves the model untouched.
+    Returns the layer names applied.
+    """
+    with open(path, "rb") as f:
+        blob = f.read()
+    if len(blob) < len(_MAGIC) + 4 or blob[:len(_MAGIC)] != _MAGIC:
+        raise ValueError(f"{path}: not a paddle_tpu quantized artifact")
+    (meta_len,) = struct.unpack_from("<I", blob, len(_MAGIC))
+    meta_off = len(_MAGIC) + 4
+    if meta_off + meta_len > len(blob):
+        raise ValueError(f"{path}: truncated meta block")
+    meta = json.loads(blob[meta_off:meta_off + meta_len].decode("utf-8"))
+    if meta.get("version") != QUANT_FORMAT_VERSION:
+        raise ValueError(
+            f"{path}: unsupported quantized-artifact version "
+            f"{meta.get('version')!r}")
+    payload = blob[meta_off + meta_len:]
+    if len(payload) != int(meta.get("payload_bytes", -1)):
+        raise ValueError(
+            f"{path}: payload length {len(payload)} != recorded "
+            f"{meta.get('payload_bytes')}")
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    if crc != int(meta["payload_crc32"]):
+        raise ValueError(
+            f"{path}: payload CRC mismatch (stored "
+            f"{int(meta['payload_crc32']):#010x}, computed {crc:#010x}) — "
+            "artifact corrupt, refusing to load mis-scaled weights")
+
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor
+
+    with np.load(io.BytesIO(payload)) as z:
+        arrays = {k: z[k] for k in z.files}
+    layers = _layer_map(model)
+    applied = []
+    for name, entry in meta["layers"].items():
+        layer = layers.get(name)
+        if layer is None:
+            raise ValueError(
+                f"{path}: artifact layer {name!r} not found in model")
+        w = arrays[f"{name}.weight"]
+        if list(w.shape) != list(np.asarray(layer.weight._data).shape):
+            raise ValueError(
+                f"{path}: layer {name!r} weight shape {list(w.shape)} != "
+                f"model {list(np.asarray(layer.weight._data).shape)}")
+        layer.weight._set_data(jnp.asarray(w))
+        layer.register_buffer(
+            "weight_scale",
+            Tensor(jnp.asarray(arrays[f"{name}.weight_scale"],
+                               jnp.float32)))
+        if entry.get("act_scale"):
+            layer.register_buffer(
+                "act_scale",
+                Tensor(jnp.asarray(arrays[f"{name}.act_scale"],
+                                   jnp.float32)))
+        applied.append(name)
+    return sorted(applied)

@@ -251,9 +251,12 @@ class GPTAttention(Layer):
             # r20 engine flag: "xla" = gather path (default, and the
             # bit-comparison oracle); "pallas" = paged flash-decode kernel
             attn_impl = str(cache.get("attn_impl", "xla"))
+            # int8 KV layout (ISSUE 18): per-token f32 absmax scales ride
+            # alongside the pool — quant on scatter-in, dequant on gather
+            quant = cache.get("k_scale") is not None
 
             @primitive
-            def _paged_attn(q, k, v, poolk, poolv, pages, pos):
+            def _paged_attn(q, k, v, poolk, poolv, pages, pos, *scales):
                 import jax
                 import jax.numpy as jnp
 
@@ -272,22 +275,60 @@ class GPTAttention(Layer):
                 off = wpos % ps
                 kw = k.transpose(0, 2, 1, 3).reshape(bb * tt, hh, dd)
                 vw = v.transpose(0, 2, 1, 3).reshape(bb * tt, hh, dd)
-                poolk = poolk.at[pg.reshape(-1), :, off.reshape(-1), :].set(
-                    kw.astype(poolk.dtype))
-                poolv = poolv.at[pg.reshape(-1), :, off.reshape(-1), :].set(
-                    vw.astype(poolv.dtype))
+                if scales:
+                    sk_pool, sv_pool = scales
+                    # one f32 absmax scale per written TOKEN (shared
+                    # across heads and head_dim — [L, n_pages, ps] rides
+                    # beside the pool); floor keeps all-zero rows finite
+                    ks = jnp.maximum(
+                        jnp.max(jnp.abs(kw), axis=(1, 2)) / 127.0, 1e-8)
+                    vs = jnp.maximum(
+                        jnp.max(jnp.abs(vw), axis=(1, 2)) / 127.0, 1e-8)
+                    kq = jnp.clip(jnp.round(kw / ks[:, None, None]),
+                                  -127, 127)
+                    vq = jnp.clip(jnp.round(vw / vs[:, None, None]),
+                                  -127, 127)
+                    poolk = poolk.at[
+                        pg.reshape(-1), :, off.reshape(-1), :].set(
+                        kq.astype(poolk.dtype))
+                    poolv = poolv.at[
+                        pg.reshape(-1), :, off.reshape(-1), :].set(
+                        vq.astype(poolv.dtype))
+                    sk_pool = sk_pool.at[
+                        pg.reshape(-1), off.reshape(-1)].set(
+                        ks.astype(sk_pool.dtype))
+                    sv_pool = sv_pool.at[
+                        pg.reshape(-1), off.reshape(-1)].set(
+                        vs.astype(sv_pool.dtype))
+                    scales = (sk_pool, sv_pool)
+                else:
+                    poolk = poolk.at[
+                        pg.reshape(-1), :, off.reshape(-1), :].set(
+                        kw.astype(poolk.dtype))
+                    poolv = poolv.at[
+                        pg.reshape(-1), :, off.reshape(-1), :].set(
+                        vw.astype(poolv.dtype))
                 if attn_impl == "pallas":
                     # paged flash-decode kernel (r20): reads the pool
                     # through the page table block by block — the gathered
                     # [B, H, cap, D] tensor below never materializes
-                    from ..ops.pallas.paged_attention import (
-                        paged_flash_attention,
-                    )
+                    if scales:
+                        from ..ops.pallas.paged_attention import (
+                            paged_flash_attention_int8,
+                        )
 
-                    out = paged_flash_attention(
-                        q, poolk, poolv, pages, pos, page_size=ps,
-                        sm_scale=scale)
-                    return out, poolk, poolv
+                        out = paged_flash_attention_int8(
+                            q, poolk, poolv, scales[0], scales[1],
+                            pages, pos, page_size=ps, sm_scale=scale)
+                    else:
+                        from ..ops.pallas.paged_attention import (
+                            paged_flash_attention,
+                        )
+
+                        out = paged_flash_attention(
+                            q, poolk, poolv, pages, pos, page_size=ps,
+                            sm_scale=scale)
+                    return (out, poolk, poolv) + tuple(scales)
                 # gather the table's pages back into position order: the
                 # j axis below IS absolute sequence position, so the mask
                 # and reductions match the contiguous slot buffer bit for
@@ -296,27 +337,41 @@ class GPTAttention(Layer):
                     bb, hh, cap, dd)
                 gv = poolv[pages].transpose(0, 2, 1, 3, 4).reshape(
                     bb, hh, cap, dd)
-                scores = jnp.einsum("bhtd,bhsd->bhts",
-                                    q, gk.astype(q.dtype)) * scale
+                gk = gk.astype(q.dtype)
+                gv = gv.astype(q.dtype)
+                if scales:
+                    # dequant on gather: the int8 page entries scale back
+                    # by their per-token factors — the convert is fed by
+                    # the GATHER (pool-sized int8 stays the resident form;
+                    # no dequantized full-pool copy materializes)
+                    gsk = scales[0][pages].reshape(bb, 1, cap, 1)
+                    gsv = scales[1][pages].reshape(bb, 1, cap, 1)
+                    gk = gk * gsk.astype(q.dtype)
+                    gv = gv * gsv.astype(q.dtype)
+                scores = jnp.einsum("bhtd,bhsd->bhts", q, gk) * scale
                 j = jnp.arange(cap)[None, None, None, :]
                 mask = j <= wpos[:, None, :, None]
                 scores = jnp.where(mask, scores,
                                    jnp.asarray(-1e30, scores.dtype))
                 probs = jax.nn.softmax(
                     scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-                out = jnp.einsum("bhts,bhsd->bhtd", probs,
-                                 gv.astype(q.dtype))
-                return out, poolk, poolv
+                out = jnp.einsum("bhts,bhsd->bhtd", probs, gv)
+                return (out, poolk, poolv) + tuple(scales)
 
             # named region (r6 scope): the perf doctor ranks the gather-
             # based attention row as serving.paged_attn
+            extra = (cache["k_scale"], cache["v_scale"]) if quant else ()
             with scope("serving.paged_attn"):
-                out, new_k, new_v = _paged_attn(
+                res = _paged_attn(
                     q, k, v, cache["k"], cache["v"], cache["pages"],
-                    cache["pos"])
+                    cache["pos"], *extra)
+            out, new_k, new_v = res[0], res[1], res[2]
             self._gen_cache = {"mode": "paged", "k": new_k, "v": new_v,
                                "pages": cache["pages"], "pos": cache["pos"],
                                "page_size": ps, "attn_impl": attn_impl}
+            if quant:
+                self._gen_cache["k_scale"] = res[3]
+                self._gen_cache["v_scale"] = res[4]
             return self._finish(out, b, t)
         if cache is not None and cache.get("mode") == "buffer":
             # fixed-capacity export mode (inference.save_for_generation):

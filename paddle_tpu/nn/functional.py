@@ -136,14 +136,51 @@ def temperature_scaled_softmax(x, temperature=1.0, axis=-1):
 # ---------------------------------------------------------------------------
 
 
-@primitive
-def linear(x, weight, bias=None):
-    """y = x @ W (+ b); paddle weight layout [in_features, out_features]
-    (reference: matmul_v2 + elementwise_add, python/paddle/nn/functional/common.py)."""
+def _linear_fp_raw(x, weight, bias=None):
     y = jnp.matmul(x, weight)
     if bias is not None:
         y = y + bias
     return y
+
+
+_linear_fp = primitive(_linear_fp_raw, name="linear")
+
+
+@primitive(nondiff=True)
+def _linear_int8(x, weight, weight_scale, act_scale, bias=None):
+    """W8A8 int8 matmul (ISSUE 18): ``weight`` is int8
+    ``[in_features, out_features]`` with per-out-channel f32
+    ``weight_scale`` ``[out]``; the activation is quantized per-tensor
+    (calibrated ``act_scale`` when present, dynamic absmax otherwise),
+    the contraction runs int8 x int8 -> int32 on the MXU, and BOTH
+    scales fuse into the int32 accumulator — the f32 weight copy is
+    never materialized (the analysis dtype rule certifies this)."""
+    if act_scale is None:
+        sx = jnp.maximum(jnp.max(jnp.abs(x)) / 127.0, 1e-8)
+    else:
+        sx = jnp.maximum(act_scale.astype(jnp.float32).reshape(()), 1e-8)
+    sx = sx.astype(jnp.float32)
+    xq = jnp.clip(jnp.round(x / sx), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, weight, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    y = (acc.astype(jnp.float32)
+         * (sx * weight_scale.astype(jnp.float32))).astype(x.dtype)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def linear(x, weight, bias=None, weight_scale=None, act_scale=None):
+    """y = x @ W (+ b); paddle weight layout [in_features, out_features]
+    (reference: matmul_v2 + elementwise_add, python/paddle/nn/functional/common.py).
+
+    When ``weight_scale`` is given the weight is taken as PTQ int8
+    (``quantization/ptq.py``) and the matmul runs through the scale-fused
+    int8 path instead."""
+    if weight_scale is not None:
+        return _linear_int8(x, weight, weight_scale, act_scale, bias)
+    return _linear_fp(x, weight, bias)
 
 
 @primitive

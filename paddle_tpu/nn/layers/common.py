@@ -53,7 +53,12 @@ class Linear(Layer):
             self.bias = self.create_parameter([out_features], attr=bias_attr, is_bias=True)
 
     def forward(self, x):
-        return F.linear(x, self.weight, self.bias)
+        # PTQ (quantization/ptq.py) registers weight_scale / act_scale
+        # buffers in-place; their presence flips F.linear to the
+        # scale-fused int8 path
+        return F.linear(x, self.weight, self.bias,
+                        weight_scale=getattr(self, "weight_scale", None),
+                        act_scale=getattr(self, "act_scale", None))
 
     def extra_repr(self):
         return f"in={self.in_features}, out={self.out_features}"

@@ -43,13 +43,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .cost_registry import aval_bytes, itemsize, register_kernel_cost
 
-__all__ = ["paged_flash_attention", "paged_attention_reference",
-           "PAGED_ATTENTION_KERNEL_NAME"]
+__all__ = ["paged_flash_attention", "paged_flash_attention_int8",
+           "paged_attention_reference", "PAGED_ATTENTION_KERNEL_NAME",
+           "PAGED_ATTENTION_INT8_KERNEL_NAME"]
 
 NEG_INF = -1e30  # matches flash_attention.py / the gather path's mask fill
 
 #: explicit ``pl.pallas_call`` name — the cost-registry key
 PAGED_ATTENTION_KERNEL_NAME = "paged_flash_attention"
+#: int8-pool variant (ISSUE 18): same grid, per-token dequant in VMEM
+PAGED_ATTENTION_INT8_KERNEL_NAME = "paged_flash_attention_int8"
 
 
 def paged_attention_reference(q, pool_k, pool_v, pages, pos, *, page_size,
@@ -73,11 +76,11 @@ def paged_attention_reference(q, pool_k, pool_v, pages, pos, *, page_size,
     return jnp.einsum("bhts,bhsd->bhtd", probs, gv.astype(q.dtype))
 
 
-def _paged_kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_ref, m_ref, l_ref, *, sm_scale, page_size, n_entries):
-    b = pl.program_id(0)
-    j = pl.program_id(1)
-
+def _online_update(b, j, pos_ref, q_ref, k, v, o_ref, acc_ref, m_ref,
+                   l_ref, *, sm_scale, page_size, n_entries):
+    """One (slot, page-entry) step of the online-softmax accumulation —
+    shared by the fp and int8 kernels; ``k``/``v`` arrive as f32
+    ``[H, ps, D]`` (the int8 kernel dequantizes in VMEM first)."""
     @pl.when(j == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
@@ -85,8 +88,6 @@ def _paged_kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     q = q_ref[0].astype(jnp.float32)          # [H, T, D]
-    k = k_ref[0].astype(jnp.float32)          # [H, ps, D]
-    v = v_ref[0].astype(jnp.float32)
 
     s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
                             preferred_element_type=jnp.float32) * sm_scale
@@ -124,6 +125,34 @@ def _paged_kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         l = l_ref[...][:, :, :1]
         o_ref[0] = (acc_ref[...] /
                     jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def _paged_kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, sm_scale, page_size, n_entries):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)          # [H, ps, D]
+    v = v_ref[0].astype(jnp.float32)
+    _online_update(b, j, pos_ref, q_ref, k, v, o_ref, acc_ref, m_ref,
+                   l_ref, sm_scale=sm_scale, page_size=page_size,
+                   n_entries=n_entries)
+
+
+def _paged_int8_kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, sk_ref,
+                       sv_ref, o_ref, acc_ref, m_ref, l_ref, *, sm_scale,
+                       page_size, n_entries):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    # per-token dequant inside VMEM: the pool block arrives int8 (half
+    # the HBM stream of the f16 layout) and is widened only here, one
+    # page at a time — no dequantized pool copy ever exists in HBM
+    sk = sk_ref[0].astype(jnp.float32)        # [ps]
+    sv = sv_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32) * sk[None, :, None]
+    v = v_ref[0].astype(jnp.float32) * sv[None, :, None]
+    _online_update(b, j, pos_ref, q_ref, k, v, o_ref, acc_ref, m_ref,
+                   l_ref, sm_scale=sm_scale, page_size=page_size,
+                   n_entries=n_entries)
 
 
 def paged_flash_attention(q, pool_k, pool_v, pages, pos, *, page_size: int,
@@ -179,6 +208,64 @@ def paged_flash_attention(q, pool_k, pool_v, pages, pos, *, page_size: int,
       q, pool_k, pool_v)
 
 
+def paged_flash_attention_int8(q, pool_k, pool_v, scale_k, scale_v, pages,
+                               pos, *, page_size: int, sm_scale=None,
+                               interpret=None):
+    """Int8-pool variant (ISSUE 18): ``pool_k``/``pool_v`` are int8
+    ``[n_pages, H, page_size, D]`` with per-token f32 absmax scales
+    ``scale_k``/``scale_v`` ``[n_pages, page_size]`` riding alongside.
+    Each page block is DMA'd as int8 (half the f16 HBM stream) and
+    dequantized in VMEM; masking/accumulation identical to the fp kernel.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, t, d = q.shape
+    n_entries = pages.shape[1]
+    ps = int(page_size)
+    if pool_k.shape[2] != ps or pool_v.shape[2] != ps:
+        raise ValueError(
+            f"pool page_size {pool_k.shape[2]} != engine page_size {ps}")
+    if scale_k.shape != (pool_k.shape[0], ps):
+        raise ValueError(
+            f"scale_k shape {scale_k.shape} != {(pool_k.shape[0], ps)}")
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+
+    kernel = functools.partial(
+        _paged_int8_kernel, sm_scale=float(sm_scale), page_size=ps,
+        n_entries=int(n_entries))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,      # pages, pos
+        grid=(b, n_entries),
+        in_specs=[
+            pl.BlockSpec((1, h, t, d), lambda b_, j, pages, pos: (b_, 0, 0, 0)),
+            pl.BlockSpec((1, h, ps, d),
+                         lambda b_, j, pages, pos: (pages[b_, j], 0, 0, 0)),
+            pl.BlockSpec((1, h, ps, d),
+                         lambda b_, j, pages, pos: (pages[b_, j], 0, 0, 0)),
+            pl.BlockSpec((1, ps),
+                         lambda b_, j, pages, pos: (pages[b_, j], 0)),
+            pl.BlockSpec((1, ps),
+                         lambda b_, j, pages, pos: (pages[b_, j], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, t, d),
+                               lambda b_, j, pages, pos: (b_, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, t, d), jnp.float32),
+            pltpu.VMEM((h, t, 128), jnp.float32),
+            pltpu.VMEM((h, t, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        interpret=interpret,
+        name=PAGED_ATTENTION_INT8_KERNEL_NAME,
+    )(pages.astype(jnp.int32), pos.astype(jnp.int32).reshape(-1),
+      q, pool_k, pool_v, scale_k, scale_v)
+
+
 # -- cost model (analysis/cost.py prices the pallas_call eqn from this) ----
 _TRANSCENDENTAL_FLOPS = 8  # matches analysis.cost.TRANSCENDENTAL_FLOPS
 
@@ -204,3 +291,28 @@ def _paged_attention_cost(in_avals, out_avals, params):
 
 
 register_kernel_cost(PAGED_ATTENTION_KERNEL_NAME, _paged_attention_cost)
+
+
+def _paged_attention_int8_cost(in_avals, out_avals, params):
+    """Same contraction flops as the fp kernel plus the per-element
+    dequant multiply; KV bytes are the int8 stream (itemsize 1) plus the
+    per-token scale rows — the ~2x intensity win over the f16 pool is
+    exactly what this registry row makes visible to the perf doctor."""
+    pages_av, pos_av, q_av, pk_av, pv_av, sk_av, sv_av = in_avals[:7]
+    b, n_entries = (int(x) for x in pages_av[0])
+    _, h, t, d = (int(x) for x in q_av[0])
+    ps = int(pk_av[0][2])
+    s = n_entries * ps
+    flops = 4.0 * b * h * t * s * d \
+        + 2.0 * _TRANSCENDENTAL_FLOPS * b * h * t * s \
+        + 2.0 * b * h * s * d                      # dequant multiplies
+    kv_bytes = float(b * n_entries * h * ps * d) \
+        * (itemsize(pk_av) + itemsize(pv_av)) \
+        + float(b * n_entries * ps) * (itemsize(sk_av) + itemsize(sv_av))
+    io = aval_bytes(q_av) + aval_bytes(pages_av) + aval_bytes(pos_av) \
+        + sum(aval_bytes(o) for o in out_avals)
+    return flops, kv_bytes + io
+
+
+register_kernel_cost(PAGED_ATTENTION_INT8_KERNEL_NAME,
+                     _paged_attention_int8_cost)

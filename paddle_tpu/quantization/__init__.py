@@ -27,6 +27,14 @@ from ..nn.layer import Layer
 from ..ops._primitive import primitive, unwrap, wrap
 from ..tensor import Tensor
 
+from .ptq import (  # noqa: E402  (serving-side PTQ, ISSUE 18)
+    calibrate_activations_,
+    post_training_quantize_,
+    quality_delta,
+    quantize_model_weights_,
+    quantized_layer_names,
+)
+
 __all__ = [
     "fake_quantize_abs_max",
     "fake_channel_wise_quantize_abs_max",
@@ -37,6 +45,11 @@ __all__ = [
     "ImperativeQuantAware",
     "PostTrainingQuantization",
     "save_quantized_model",
+    "quantize_model_weights_",
+    "calibrate_activations_",
+    "post_training_quantize_",
+    "quantized_layer_names",
+    "quality_delta",
 ]
 
 

@@ -1,0 +1,212 @@
+"""Post-training int8 weight quantization for the serving plane (ISSUE 18).
+
+Parity: PaddleSlim ``PostTrainingQuantization``
+(python/paddle/fluid/contrib/slim/quantization/post_training_quantization.py)
+— the offline calibrate-then-quantize flow that Paddle Inference's int8
+passes consume.  The TPU-native shape: instead of rewriting a static
+program, we quantize the live layer tree in place — each Linear-family
+layer's f32 weight becomes an int8 array plus a per-out-channel f32
+``weight_scale`` buffer, and ``F.linear`` dispatches to a scale-fused
+``int8 x int8 -> int32`` ``dot_general`` when the buffer is present
+(nn/functional.py ``_linear_int8``).  Buffers ride the engine's
+``functional_call_with_state`` params/buffers split, so the scales flow
+into the jitted serving programs like any other state.
+
+Calibration (optional): run N prompts through the fp model first and
+record each target layer's input absmax; the recorded value becomes a
+static per-tensor ``act_scale`` buffer (PaddleSlim's ``abs_max``
+activation strategy).  Without calibration the int8 path falls back to
+dynamic per-tensor activation absmax computed in-graph.
+
+Outlier awareness (LLM.int8, Dettmers et al. 2022 — the cheap variant):
+a layer whose per-channel absmax spread is extreme (one channel's scale
+``outlier_ratio`` x the median) loses too much precision under pure
+absmax int8; such layers are left in fp when a ratio is given.
+
+Bit-exact greedy parity is NOT promised; :func:`quality_delta` pins the
+actual per-token logit max-abs-err and greedy divergence rate on a fixed
+prompt set — the certificate the tests and bench commit.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import no_grad
+from ..tensor import Tensor
+
+__all__ = [
+    "quantize_model_weights_",
+    "calibrate_activations_",
+    "post_training_quantize_",
+    "quantized_layer_names",
+    "quality_delta",
+]
+
+#: layer classes whose forward routes through ``F.linear`` and therefore
+#: understands the ``weight_scale`` / ``act_scale`` buffers
+_QUANTIZABLE_TYPES = ("Linear", "ColumnParallelLinear", "RowParallelLinear")
+
+
+def _np_dtype_name(t) -> str:
+    """numpy dtype name of a Tensor/array (Tensor.dtype is the paddle
+    dtype wrapper, which numpy cannot interpret — read the array's)."""
+    d = getattr(t, "_data", t)
+    return str(np.dtype(d.dtype))
+
+
+def _target_layers(model):
+    """Yield ``(dotted_name, layer)`` for every quantizable sublayer."""
+    for name, layer in model.named_sublayers(include_self=True):
+        if type(layer).__name__ not in _QUANTIZABLE_TYPES:
+            continue
+        w = getattr(layer, "weight", None)
+        if w is None or getattr(w, "ndim", 0) != 2:
+            continue
+        yield name or type(layer).__name__, layer
+
+
+def quantized_layer_names(model) -> List[str]:
+    """Names of sublayers already carrying int8 weights."""
+    out = []
+    for name, layer in _target_layers(model):
+        if _np_dtype_name(layer.weight) == "int8":
+            out.append(name)
+    return out
+
+
+def quantize_model_weights_(model, *, skip: Optional[Callable[[str], bool]] = None,
+                            outlier_ratio: Optional[float] = None) -> List[str]:
+    """Quantize every Linear-family weight in ``model`` to int8, in place.
+
+    Per-out-channel absmax: ``scale[o] = max|W[:, o]| / 127`` (weight
+    layout is paddle's ``[in, out]``), weight becomes
+    ``round(W / scale).clip(-127, 127).astype(int8)`` and the scale is
+    registered as a ``weight_scale`` buffer.  Idempotent — already-int8
+    layers are skipped, so two engines sharing one model tree coexist.
+
+    ``skip(name) -> True`` keeps a layer fp; ``outlier_ratio`` keeps
+    outlier-heavy layers fp (see module docstring).  Returns the names
+    of layers quantized by THIS call.
+    """
+    done: List[str] = []
+    for name, layer in _target_layers(model):
+        w = layer.weight
+        if _np_dtype_name(w) == "int8":
+            continue  # idempotent re-entry
+        if skip is not None and skip(name):
+            continue
+        wd = w._data if isinstance(w._data, jnp.ndarray) else jnp.asarray(
+            np.asarray(w._data))
+        absmax = jnp.max(jnp.abs(wd), axis=0)              # [out]
+        scale = jnp.maximum(absmax.astype(jnp.float32) / 127.0, 1e-8)
+        if outlier_ratio is not None:
+            med = float(jnp.median(scale))
+            if med > 0 and float(jnp.max(scale)) / med > float(outlier_ratio):
+                continue  # outlier channel dominates — keep fp
+        q = jnp.clip(jnp.round(wd / scale[None, :]), -127, 127).astype(
+            jnp.int8)
+        w._set_data(q)
+        layer.register_buffer("weight_scale", Tensor(scale))
+        done.append(name)
+    return done
+
+
+def calibrate_activations_(model, batches: Iterable) -> Dict[str, float]:
+    """Run calibration batches through the (still-fp) model and register a
+    static per-tensor ``act_scale`` buffer on every quantizable layer.
+
+    ``batches`` is an iterable of model inputs (e.g. ``[B, T]`` token-id
+    arrays); each is fed to ``model(batch)`` under ``no_grad``.  The
+    recorded per-layer input absmax becomes ``act_scale = absmax / 127``.
+    Returns the raw absmax per dotted layer name (for inspection/tests).
+    """
+    targets = list(_target_layers(model))
+    records: Dict[str, float] = {}
+    originals = []
+
+    def _hook(name, orig):
+        def forward(x, *a, **k):
+            arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+            v = float(jnp.max(jnp.abs(arr)))
+            if np.isfinite(v):
+                records[name] = max(records.get(name, 0.0), v)
+            return orig(x, *a, **k)
+        return forward
+
+    for name, layer in targets:
+        orig = layer.forward
+        originals.append((layer, orig))
+        layer.forward = _hook(name, orig)
+    try:
+        with no_grad():
+            for batch in batches:
+                model(batch if isinstance(batch, Tensor)
+                      else Tensor(jnp.asarray(batch)))
+    finally:
+        for layer, orig in originals:
+            layer.forward = orig
+    for name, layer in targets:
+        amax = records.get(name)
+        if amax:
+            layer.register_buffer(
+                "act_scale",
+                Tensor(jnp.asarray(max(amax / 127.0, 1e-8), jnp.float32)))
+    return records
+
+
+def post_training_quantize_(model, calibration_batches: Optional[Iterable] = None,
+                            **quant_kwargs) -> List[str]:
+    """PaddleSlim-shaped one-call flow: calibrate (optional) then quantize.
+
+    Calibration MUST see the fp weights, so it runs first; the returned
+    list names the layers quantized.
+    """
+    if calibration_batches is not None:
+        calibrate_activations_(model, calibration_batches)
+    return quantize_model_weights_(model, **quant_kwargs)
+
+
+def quality_delta(fp_model, quant_model, prompts: Sequence,
+                  eps: float = 1e-9) -> Dict[str, float]:
+    """The pinned PTQ quality certificate (ISSUE 18): teacher-forced
+    forward of both models over a fixed prompt set, reporting
+
+    - ``logit_max_abs_err``: max over all (prompt, position, vocab) of
+      ``|logits_fp - logits_int8|``;
+    - ``greedy_divergence_rate``: fraction of positions whose argmax
+      next-token differs;
+    - ``positions``: number of positions compared.
+
+    ``prompts`` is a sequence of 1-D token-id arrays/lists.
+    """
+    max_err = 0.0
+    diverged = 0
+    total = 0
+    modes = [(m, m.training) for m in (fp_model, quant_model)]
+    for m, _ in modes:
+        m.eval()
+    with no_grad():
+        for ids in prompts:
+            arr = np.asarray(ids, dtype=np.int32).reshape(1, -1)
+            t = Tensor(jnp.asarray(arr))
+            lf = np.asarray((fp_model(t))._data, dtype=np.float32)
+            lq = np.asarray((quant_model(t))._data, dtype=np.float32)
+            if lf.shape != lq.shape:
+                raise ValueError(
+                    f"logit shapes differ: {lf.shape} vs {lq.shape}")
+            max_err = max(max_err, float(np.max(np.abs(lf - lq))))
+            gf = np.argmax(lf, axis=-1)
+            gq = np.argmax(lq, axis=-1)
+            diverged += int(np.sum(gf != gq))
+            total += int(gf.size)
+    for m, was_training in modes:
+        if was_training:
+            m.train()
+    return {
+        "logit_max_abs_err": max_err,
+        "greedy_divergence_rate": float(diverged) / max(total, 1),
+        "positions": total,
+    }

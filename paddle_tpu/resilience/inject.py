@@ -121,6 +121,10 @@ POINTS = (
     "engine.tick",
     "replica.tick",
     "serving.pages.exhausted",
+    # speculative decoding (ISSUE 18): fires per active stream right
+    # before the batched verify; a raise-kind fault fails ONLY the
+    # matched streams and the tick falls back to plain decode
+    "serving.spec.verify",
     "router.transport",
     # zero-loss streams (r21): `router.resurrect` fires at the head of a
     # continuation re-home (stall = wall-clock the recovery burns before

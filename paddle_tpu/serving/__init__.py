@@ -22,6 +22,10 @@ dynamic-batching executor over paged GPU kernels.
     admission — memory-aware admission gate (r10 liveness estimator as a
                 runtime component), deadline propagation, and the
                 goodput-preserving overload shed policy
+    spec_decode — speculative decoding: a draft model proposes k tokens
+                per tick, the target verifies them in one batched step
+                (greedy output token-for-token identical to the plain
+                engine); rides the paged pool + COW + continuation joins
 """
 from .admission import (  # noqa: F401
     AdmissionGate,
@@ -60,6 +64,10 @@ from .server import (  # noqa: F401
     ServingServer,
     StreamIncompleteError,
 )
+from .spec_decode import (  # noqa: F401
+    SpecDecodeConfig,
+    SpecDecodeState,
+)
 
 __all__ = [
     "ContinuousBatchingEngine",
@@ -87,4 +95,6 @@ __all__ = [
     "PagePool",
     "RadixCache",
     "PagesExhaustedError",
+    "SpecDecodeConfig",
+    "SpecDecodeState",
 ]

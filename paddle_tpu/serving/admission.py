@@ -226,6 +226,8 @@ class AdmissionGate:
             "free": state["free"],
             "budget": self.page_budget,
             "page_bytes": state["page_bytes"],
+            "kv_dtype": str(getattr(eng, "kv_dtype", None)
+                            or eng._cache_dtype),
         }
 
     def settle(self, req):
@@ -278,6 +280,11 @@ class AdmissionGate:
                     "free": state["free"],
                     "budget": self.page_budget,
                     "page_bytes": state["page_bytes"],
+                    # the quantized layout the budget was priced for: int8
+                    # pages are ~half the f16 bytes, so the SAME budget
+                    # admits ~2x the pages — cite which layout this is
+                    "kv_dtype": str(getattr(eng, "kv_dtype", None)
+                                    or eng._cache_dtype),
                 }
                 admitted = pages["predicted"] <= pages["budget"]
                 if admitted:
@@ -292,7 +299,8 @@ class AdmissionGate:
                     f"{pages['committed_queued']} + this request "
                     f"{pages['needed']}) exceeds the page budget "
                     f"{pages['budget']} ({pages['free']} free, "
-                    f"{pages['page_bytes']} B/page)",
+                    f"{pages['page_bytes']} B/page, "
+                    f"kv_dtype {pages['kv_dtype']})",
                     estimate=price, retry_after=self._hint())
         return price
 

@@ -152,7 +152,10 @@ class ContinuousBatchingEngine:
                  n_pages: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  prefix_sharing: bool = True,
-                 attn_impl: str = "xla"):
+                 attn_impl: str = "xla",
+                 kv_dtype: Optional[str] = None,
+                 weight_dtype: Optional[str] = None,
+                 spec_decode=None):
         import jax.numpy as jnp
 
         from ..models.gpt import GPTForPretraining
@@ -191,16 +194,44 @@ class ContinuousBatchingEngine:
             raise ValueError("prefill bucket exceeds max_seq_len")
         self._cache_dtype = jnp.dtype(cache_dtype)
 
+        # -- quantized inference plane (ISSUE 18) -----------------------
+        # kv_dtype="int8": the paged pool stores int8 K/V with per-token
+        # f32 absmax scales riding alongside ([L, n_pages, page_size] per
+        # half) — quant on scatter-in, dequant on gather/flash read.
+        # weight_dtype="int8": the model's Linear weights are loaded as a
+        # per-out-channel int8 tree (quantization/ptq.py), dequantized
+        # INSIDE the dot (scale-fused int8 dot_general, never an f32
+        # weight copy — the extended dtype-promotion rule lints this).
+        if kv_dtype is not None and str(kv_dtype) != "int8":
+            raise ValueError("kv_dtype must be None (= cache_dtype) or 'int8'")
+        self._kv_quant = kv_dtype == "int8"
+        if self._kv_quant and kv_layout != "paged":
+            raise ValueError("kv_dtype='int8' requires kv_layout='paged'")
+        self.kv_dtype = (jnp.dtype(np.int8) if self._kv_quant
+                         else self._cache_dtype)
+        if weight_dtype is not None and str(weight_dtype) != "int8":
+            raise ValueError("weight_dtype must be None or 'int8'")
+        self.weight_dtype = weight_dtype
+        if weight_dtype == "int8":
+            from ..quantization.ptq import quantize_model_weights_
+
+            # idempotent: an already-PTQ'd model (load_quantized) is left
+            # untouched; a fresh fp model is weight-quantized in place
+            quantize_model_weights_(model)
+
         # -- paged-layout state (ISSUE 11) ------------------------------
         if self._paged:
             self.page_size = int(page_size)
             if self.page_size < 1:
                 raise ValueError("page_size must be >= 1")
             self.max_pages_per_slot = -(-self.max_seq_len // self.page_size)
-            per_el = np.dtype(self._cache_dtype).itemsize
+            per_el = np.dtype(self.kv_dtype).itemsize
             # one page's K+V bytes across all layers — the allocation unit
             self.page_bytes = (2 * self._layers * self._heads
                                * self.page_size * self._head_dim * per_el)
+            if self._kv_quant:
+                # the per-token f32 scales are part of the layout's cost
+                self.page_bytes += 2 * self._layers * self.page_size * 4
             if n_pages is None:
                 n_pages = 1 + self.n_slots * self.max_pages_per_slot
             self.n_pages = int(n_pages)
@@ -221,8 +252,12 @@ class ContinuousBatchingEngine:
             self._chunk_limit = limit
             self._pool_shape = (self._layers, self.n_pages, self._heads,
                                 self.page_size, self._head_dim)
-            self._pool_k = jnp.zeros(self._pool_shape, self._cache_dtype)
-            self._pool_v = jnp.zeros(self._pool_shape, self._cache_dtype)
+            self._pool_k = jnp.zeros(self._pool_shape, self.kv_dtype)
+            self._pool_v = jnp.zeros(self._pool_shape, self.kv_dtype)
+            self._scale_shape = (self._layers, self.n_pages, self.page_size)
+            if self._kv_quant:
+                self._scale_k = jnp.zeros(self._scale_shape, jnp.float32)
+                self._scale_v = jnp.zeros(self._scale_shape, jnp.float32)
             self._page_tables = np.zeros(
                 (self.n_slots, self.max_pages_per_slot), np.int32)
             # slot -> chunked-prefill progress ({"req", "next", "key",
@@ -285,6 +320,17 @@ class ContinuousBatchingEngine:
         self._lock = threading.Lock()  # hostrace: blocking-ok
         self._abort = threading.Event()  # crash simulation: loop exits, NO drain
         self._build_programs()
+        # speculative decoding (ISSUE 18): a draft model proposes k tokens
+        # per tick, the target verifies them in ONE batched step — greedy
+        # output is token-for-token identical to the plain engine
+        self._spec = None
+        if spec_decode is not None:
+            if not self._paged:
+                raise ValueError(
+                    "speculative decoding requires kv_layout='paged'")
+            from .spec_decode import SpecDecodeState
+
+            self._spec = SpecDecodeState(self, spec_decode)
         # overload protection (serving/admission.py), both opt-in: the
         # gate prices each request's prefill against an HBM budget with
         # the r10 liveness estimator and (paged) the predicted page-pool
@@ -418,23 +464,35 @@ class ContinuousBatchingEngine:
 
         model, attns = self.model, self._attns
         ps = self.page_size
+        quant = self._kv_quant
 
         def _forward(params, buffers, ids_t, position_ids_t):
             out, _ = model.functional_call_with_state(
                 params, buffers, ids_t, position_ids_t)
             return unwrap(out)
 
-        def _set_caches(pk, pv, pages, pos):
+        def _set_caches(pk, pv, pages, pos, scales=()):
             for li, a in enumerate(attns):
-                a._gen_cache = {"mode": "paged", "k": pk[li], "v": pv[li],
-                                "pages": pages, "pos": pos,
-                                "page_size": ps,
-                                "attn_impl": self.attn_impl}
+                c = {"mode": "paged", "k": pk[li], "v": pv[li],
+                     "pages": pages, "pos": pos,
+                     "page_size": ps,
+                     "attn_impl": self.attn_impl}
+                if scales:
+                    # int8 KV layout: per-token f32 absmax scales ride
+                    # alongside the pool halves (quant on scatter-in,
+                    # dequant on gather — models/gpt.py's _paged_attn)
+                    c["k_scale"] = scales[0][li]
+                    c["v_scale"] = scales[1][li]
+                a._gen_cache = c
 
         def _collect_caches():
             pk = jnp.stack([unwrap(a._gen_cache["k"]) for a in attns])
             pv = jnp.stack([unwrap(a._gen_cache["v"]) for a in attns])
-            return pk, pv
+            if not quant:
+                return pk, pv, ()
+            sk = jnp.stack([unwrap(a._gen_cache["k_scale"]) for a in attns])
+            sv = jnp.stack([unwrap(a._gen_cache["v_scale"]) for a in attns])
+            return pk, pv, (sk, sv)
 
         def _clear_caches():
             for a in attns:
@@ -442,7 +500,8 @@ class ContinuousBatchingEngine:
                     del a._gen_cache
 
         def prefill_fn(params, buffers, ids, start, rlen, is_final, pages,
-                       key, temp, topk, topp, cow_src, cow_dst, pk, pv):
+                       key, temp, topk, topp, cow_src, cow_dst, pk, pv,
+                       *scales):
             # ONE page-aligned-or-COW chunk of a prompt: ids [1, Tc]
             # chunk-bucket-padded, start = absolute position of ids[0,0],
             # rlen = real tokens in this chunk. The chunk attends to the
@@ -458,15 +517,20 @@ class ContinuousBatchingEngine:
             # copy without mutating the shared page
             pk = pk.at[:, cow_dst].set(jnp.take(pk, cow_src, axis=1))
             pv = pv.at[:, cow_dst].set(jnp.take(pv, cow_src, axis=1))
+            if scales:
+                sk, sv = scales
+                scales = (
+                    sk.at[:, cow_dst].set(jnp.take(sk, cow_src, axis=1)),
+                    sv.at[:, cow_dst].set(jnp.take(sv, cow_src, axis=1)))
             start = start.astype(jnp.int32)
             tc = ids.shape[1]
             pos_ids = (start + jnp.arange(tc, dtype=jnp.int32))[None, :]
-            _set_caches(pk, pv, pages[None, :], start[None])
+            _set_caches(pk, pv, pages[None, :], start[None], scales)
             try:
                 with no_grad():
                     logits = _forward(params, buffers, wrap(ids),
                                       wrap(pos_ids))
-                pk, pv = _collect_caches()
+                pk, pv, scales = _collect_caches()
             finally:
                 _clear_caches()
             last = jax.lax.dynamic_slice(
@@ -480,21 +544,21 @@ class ContinuousBatchingEngine:
             first = jnp.where(is_final, tok.astype(jnp.int32),
                               jnp.zeros((), jnp.int32))
             new_key = jnp.where(is_final, key2, key)
-            return first, new_key, pk, pv
+            return (first, new_key, pk, pv) + tuple(scales)
 
         def step_fn(params, buffers, tok, pos, active, temp, topk, topp,
-                    keys, tables, pk, pv):
+                    keys, tables, pk, pv, *scales):
             # one decode token for every active slot, through the pool:
             # writes scatter into (tables[slot, pos//ps], pos%ps); reads
             # gather the tables' pages back into position order
             self.trace_counts["step"] += 1
             posj = pos.astype(jnp.int32)
-            _set_caches(pk, pv, tables, posj)
+            _set_caches(pk, pv, tables, posj, scales)
             try:
                 with no_grad():
                     logits = _forward(params, buffers, wrap(tok),
                                       wrap(posj[:, None]))
-                pk, pv = _collect_caches()
+                pk, pv, scales = _collect_caches()
             finally:
                 _clear_caches()
             pair = jax.vmap(lambda k_: jax.random.split(k_))(keys)
@@ -506,7 +570,8 @@ class ContinuousBatchingEngine:
             new_tok = jnp.where(active, nxt, tok[:, 0])[:, None]
             new_pos = jnp.where(active, posj + 1, posj)
             new_keys = jnp.where(active[:, None], pair[:, 0], keys)
-            return nxt, new_tok, new_pos, new_keys, pk, pv
+            return (nxt, new_tok, new_pos, new_keys, pk, pv) \
+                + tuple(scales)
 
         # donate the page pool and PRNG key chains: the pool is the ONLY
         # large mutable state, threaded through every call — donation
@@ -515,6 +580,10 @@ class ContinuousBatchingEngine:
         # TPU deployment contract — applied off-CPU where XLA honors it)
         self._donate_prefill = (7, 13, 14)  # key, pool_k, pool_v
         self._donate_step = (8, 10, 11)     # keys, pool_k, pool_v
+        if quant:
+            # the scale planes are donated state exactly like the pool
+            self._donate_prefill += (15, 16)
+            self._donate_step += (12, 13)
         on_cpu = jax.default_backend() == "cpu"
         self._prefill_jit = jax.jit(
             prefill_fn, donate_argnums=() if on_cpu else self._donate_prefill)
@@ -532,13 +601,17 @@ class ContinuousBatchingEngine:
         params = {n: sds(p.shape, p.dtype) for n, p in self._params.items()}
         buffers = {n: sds(b.shape, b.dtype) for n, b in self._buffers.items()}
         if self._paged:
-            return (params, buffers, sds((1, int(bucket)), i32),
+            args = (params, buffers, sds((1, int(bucket)), i32),
                     sds((), i32), sds((), i32), sds((), np.bool_),
                     sds((self.max_pages_per_slot,), i32), sds((2,), u32),
                     sds((), f32), sds((), i32), sds((), f32),
                     sds((), i32), sds((), i32),
-                    sds(self._pool_shape, self._cache_dtype),
-                    sds(self._pool_shape, self._cache_dtype))
+                    sds(self._pool_shape, self.kv_dtype),
+                    sds(self._pool_shape, self.kv_dtype))
+            if self._kv_quant:
+                args += (sds(self._scale_shape, f32),
+                         sds(self._scale_shape, f32))
+            return args
         return (params, buffers, sds((1, int(bucket)), i32), sds((), i32),
                 sds((), i32), sds((2,), u32), sds((), f32), sds((), i32),
                 sds((), f32),
@@ -557,8 +630,11 @@ class ContinuousBatchingEngine:
                   jnp.full((n,), -1, jnp.int32), jnp.ones((n,), jnp.float32),
                   jnp.zeros((n, 2), jnp.uint32))
         if self._paged:
-            return common + (jnp.asarray(self._page_tables),
+            args = common + (jnp.asarray(self._page_tables),
                              self._pool_k, self._pool_v)
+            if self._kv_quant:
+                args += (self._scale_k, self._scale_v)
+            return args
         return common + (self._kc, self._vc)
 
     # -- public API ---------------------------------------------------------
@@ -806,6 +882,10 @@ class ContinuousBatchingEngine:
         self._topk[slot_idx] = -1 if req.top_k is None else req.top_k
         self._topp[slot_idx] = 1.0 if req.top_p is None else req.top_p
         self._keys[slot_idx] = np.asarray(key, np.uint32)
+        if self._spec is not None:
+            # draft catch-up: prefill the draft model's KV over this
+            # stream's full sequence-so-far through the SAME page table
+            self._spec.on_activate(slot_idx, req, int(first), int(pos))
 
     def _seed_for(self, req: Request) -> int:
         if req.seed is None:
@@ -929,6 +1009,8 @@ class ContinuousBatchingEngine:
         self._prefill_slots.pop(slot_idx, None)
         self._slots[slot_idx] = None
         self._active[slot_idx] = False
+        if self._spec is not None:
+            self._spec.on_free(slot_idx)
 
     def _chunk_bucket_for(self, rlen: int) -> int:
         for b in self.chunk_buckets:
@@ -958,9 +1040,7 @@ class ContinuousBatchingEngine:
         t_prefill_wall, t_prefill = time.time(), time.perf_counter()
         guard = (contextlib.nullcontext() if bucket in self._traced_buckets
                  else self._trace_lock)
-        with scope("serving.prefill"), guard:
-            first, key, self._pool_k, self._pool_v = self._prefill_jit(
-                self._params, self._buffers, jnp.asarray(ids),
+        args = (self._params, self._buffers, jnp.asarray(ids),
                 jnp.asarray(np.int32(start)), jnp.asarray(np.int32(rlen)),
                 jnp.asarray(bool(is_final)),
                 jnp.asarray(self._page_tables[slot_idx]),
@@ -969,6 +1049,15 @@ class ContinuousBatchingEngine:
                 jnp.float32(1.0 if req.top_p is None else req.top_p),
                 jnp.asarray(np.int32(cow[0])), jnp.asarray(np.int32(cow[1])),
                 self._pool_k, self._pool_v)
+        if self._kv_quant:
+            args += (self._scale_k, self._scale_v)
+        with scope("serving.prefill"), guard:
+            if self._kv_quant:
+                (first, key, self._pool_k, self._pool_v,
+                 self._scale_k, self._scale_v) = self._prefill_jit(*args)
+            else:
+                first, key, self._pool_k, self._pool_v = \
+                    self._prefill_jit(*args)
         self._traced_buckets.add(bucket)
         compiled = self.trace_counts["prefill"] > before
         state["key"] = key
@@ -1089,6 +1178,8 @@ class ContinuousBatchingEngine:
         self.metrics.on_complete()
         if self._paged:
             self._release_request_pages(req, slot_idx)
+        if self._spec is not None:
+            self._spec.on_free(slot_idx)
 
     def _fail_deadline(self, req: Request, where: str = "queue"):
         from .admission import DEADLINE_ERROR_TYPE
@@ -1192,66 +1283,98 @@ class ContinuousBatchingEngine:
             if self._paged and self._active.any():
                 self._ensure_decode_pages()
             if self._active.any():
-                before = self.trace_counts["step"]
-                t_step_wall = time.time()
-                t_step = time.perf_counter()
-                guard = (self._trace_lock if self.trace_counts["step"] == 0
-                         else contextlib.nullcontext())
-                common = (self._params, self._buffers,
-                          jnp.asarray(self._tok[:, None]),
-                          jnp.asarray(self._pos),
-                          jnp.asarray(self._active),
-                          jnp.asarray(self._temp),
-                          jnp.asarray(self._topk),
-                          jnp.asarray(self._topp),
-                          jnp.asarray(self._keys))
-                with scope("serving.decode_step"), guard:
-                    if self._paged:
-                        nxt, tok, pos, keys, self._pool_k, self._pool_v = \
-                            self._step_jit(
-                                *common, jnp.asarray(self._page_tables),
-                                self._pool_k, self._pool_v)
-                    else:
-                        nxt, tok, pos, keys, self._kc, self._vc = \
-                            self._step_jit(*common, self._kc, self._vc)
-                nxt = np.asarray(nxt)  # device sync: tokens must stream out
-                step_s = time.perf_counter() - t_step
-                self.metrics.on_step(self.trace_counts["step"] > before)
-                # np.array COPIES: device views are read-only, and slots
-                # mutate these between steps
-                self._tok = np.array(tok)[:, 0]
-                self._pos = np.array(pos)
-                self._keys = np.array(keys)
-                emitted = 0
-                spans_on = obstrace.tracing_enabled()
-                for i in range(self.n_slots):
-                    req = self._slots[i]
-                    if req is None or not self._active[i]:
-                        continue
-                    token = int(nxt[i])
-                    req._append(token)
-                    emitted += 1
-                    if spans_on and req.trace_id is not None:
-                        # one span per generated token: the slot shares the
-                        # batched step's wall interval (they decode together)
-                        obstrace.record_span(
-                            "serving.decode_token", ts=t_step_wall,
-                            dur=step_s, trace_id=req.trace_id,
-                            parent_id=req._decode_span_parent,
-                            attrs={"request_id": req.request_id,
-                                   "token_index": len(req.tokens) - 1,
-                                   "slot": i})
-                    if self._request_finished(req, token):
-                        self._retire(i, req)
-                        self._slots[i] = None
-                        self._active[i] = False
-                self.metrics.on_tokens(emitted, step_seconds=step_s)
+                if self._spec is not None:
+                    self._spec.tick()
+                else:
+                    self._decode_tick_plain()
                 did = True
             self.metrics.set_gauges(self.scheduler.depth(),
                                     self.active_slots(), self.n_slots)
             if self._paged:
                 self.metrics.set_page_gauges(self.page_state())
             return did
+
+    def _decode_tables(self):
+        """Page tables as shipped to the decode/verify programs: inactive
+        slots' rows are masked to the trash page so a stale ``_pos``/
+        ``_tok`` pair can never scatter into a mid-prefill slot's (possibly
+        radix-shared) pages."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(np.where(self._active[:, None],
+                                    self._page_tables,
+                                    np.int32(TRASH_PAGE)))
+
+    def _decode_tick_plain(self):
+        """ONE batched decode step for every active slot (lock held).
+        The non-speculative decode path — also the per-tick fallback when
+        a speculative verify is faulted out."""
+        import jax.numpy as jnp
+
+        from ..profiler.scope import scope
+
+        before = self.trace_counts["step"]
+        t_step_wall = time.time()
+        t_step = time.perf_counter()
+        guard = (self._trace_lock if self.trace_counts["step"] == 0
+                 else contextlib.nullcontext())
+        common = (self._params, self._buffers,
+                  jnp.asarray(self._tok[:, None]),
+                  jnp.asarray(self._pos),
+                  jnp.asarray(self._active),
+                  jnp.asarray(self._temp),
+                  jnp.asarray(self._topk),
+                  jnp.asarray(self._topp),
+                  jnp.asarray(self._keys))
+        with scope("serving.decode_step"), guard:
+            if self._paged and self._kv_quant:
+                (nxt, tok, pos, keys, self._pool_k, self._pool_v,
+                 self._scale_k, self._scale_v) = self._step_jit(
+                    *common, self._decode_tables(),
+                    self._pool_k, self._pool_v,
+                    self._scale_k, self._scale_v)
+            elif self._paged:
+                nxt, tok, pos, keys, self._pool_k, self._pool_v = \
+                    self._step_jit(
+                        *common, self._decode_tables(),
+                        self._pool_k, self._pool_v)
+            else:
+                nxt, tok, pos, keys, self._kc, self._vc = \
+                    self._step_jit(*common, self._kc, self._vc)
+        nxt = np.asarray(nxt)  # device sync: tokens must stream out
+        step_s = time.perf_counter() - t_step
+        self.metrics.on_step(self.trace_counts["step"] > before)
+        # np.array COPIES: device views are read-only, and slots
+        # mutate these between steps
+        self._tok = np.array(tok)[:, 0]
+        self._pos = np.array(pos)
+        self._keys = np.array(keys)
+        emitted = 0
+        spans_on = obstrace.tracing_enabled()
+        for i in range(self.n_slots):
+            req = self._slots[i]
+            if req is None or not self._active[i]:
+                continue
+            token = int(nxt[i])
+            req._append(token)
+            if self._spec is not None:
+                self._spec.on_token(i, token)
+            emitted += 1
+            if spans_on and req.trace_id is not None:
+                # one span per generated token: the slot shares the
+                # batched step's wall interval (they decode together)
+                obstrace.record_span(
+                    "serving.decode_token", ts=t_step_wall,
+                    dur=step_s, trace_id=req.trace_id,
+                    parent_id=req._decode_span_parent,
+                    attrs={"request_id": req.request_id,
+                           "token_index": len(req.tokens) - 1,
+                           "slot": i})
+            if self._request_finished(req, token):
+                self._retire(i, req)
+                self._slots[i] = None
+                self._active[i] = False
+        self.metrics.on_tokens(emitted, step_seconds=step_s)
 
     def run_until_idle(self, timeout: Optional[float] = None):
         """Drive ticks until the queue is empty and every slot is free
@@ -1267,8 +1390,12 @@ class ContinuousBatchingEngine:
         (jax invalidates donated inputs even if the computation errors)."""
         try:
             if self._paged:
-                return bool(self._pool_k.is_deleted()
+                lost = bool(self._pool_k.is_deleted()
                             or self._pool_v.is_deleted())
+                if self._kv_quant:
+                    lost = lost or bool(self._scale_k.is_deleted()
+                                        or self._scale_v.is_deleted())
+                return lost
             return bool(self._kc.is_deleted() or self._vc.is_deleted())
         except Exception:
             return False
@@ -1277,14 +1404,19 @@ class ContinuousBatchingEngine:
         import jax.numpy as jnp
 
         if self._paged:
-            self._pool_k = jnp.zeros(self._pool_shape, self._cache_dtype)
-            self._pool_v = jnp.zeros(self._pool_shape, self._cache_dtype)
+            self._pool_k = jnp.zeros(self._pool_shape, self.kv_dtype)
+            self._pool_v = jnp.zeros(self._pool_shape, self.kv_dtype)
+            if self._kv_quant:
+                self._scale_k = jnp.zeros(self._scale_shape, jnp.float32)
+                self._scale_v = jnp.zeros(self._scale_shape, jnp.float32)
             # page CONTENT is gone with the pool: forget every allocation
             # and resident prefix (radix pages point at reallocated zeros)
             if self._radix is not None:
                 self._radix.clear()
             self._pool.reset()
             self._page_tables[:] = TRASH_PAGE
+            if self._spec is not None:
+                self._spec.reset()
         else:
             self._kc = jnp.zeros(self._cache_shape, self._cache_dtype)
             self._vc = jnp.zeros(self._cache_shape, self._cache_dtype)
@@ -1322,6 +1454,8 @@ class ContinuousBatchingEngine:
                 self._page_tables[:] = TRASH_PAGE
                 if lost:
                     self._reset_cache()
+                elif self._spec is not None:
+                    self._spec.reset()
             elif self._cache_lost():
                 self._reset_cache()
             self.metrics.set_gauges(self.scheduler.depth(),

@@ -142,6 +142,28 @@ class ServingMetrics:
         self._c_exports = r.counter(
             "serving_streams_exported_total",
             "active streams exported to a peer (live migration source)")
+        # speculative decoding (ISSUE 18): proposal/acceptance accounting
+        self.spec_proposed = 0        # guarded-by: self._lock
+        self.spec_accepted = 0        # guarded-by: self._lock
+        self.spec_emitted = 0         # guarded-by: self._lock
+        self.spec_verify_steps = 0    # guarded-by: self._lock
+        self.spec_fallback_ticks = 0  # guarded-by: self._lock
+        self.spec_rollback_pages = 0  # guarded-by: self._lock
+        self._c_spec_proposed = r.counter(
+            "serving_spec_tokens_proposed_total",
+            "draft tokens proposed to the verifier")
+        self._c_spec_accepted = r.counter(
+            "serving_spec_tokens_accepted_total",
+            "draft tokens accepted by the target verifier")
+        self._c_spec_verifies = r.counter(
+            "serving_spec_verify_steps_total",
+            "per-stream verify passes (one target forward covers a batch)")
+        self._c_spec_fallbacks = r.counter(
+            "serving_spec_fallback_ticks_total",
+            "ticks that fell back to plain decode (verify seam fault)")
+        self._c_spec_rollbacks = r.counter(
+            "serving_spec_rollback_pages_total",
+            "lookahead KV pages released after draft-suffix rejection")
         self._page_state: Dict = {}
         self._prefix_hits_seen = 0
         self._prefix_tokens_seen = 0
@@ -215,6 +237,38 @@ class ServingMetrics:
     def on_export(self):
         """One active stream exported to a peer (live-migration source)."""
         self._c_exports.inc()
+
+    def on_spec_verify(self, proposed: int, accepted: int, emitted: int):
+        """One stream's verify outcome this tick: ``proposed`` draft
+        tokens went in, ``accepted`` matched the target's samples, and
+        ``emitted`` tokens actually landed on the request (``accepted+1``
+        unless the stream finished mid-block)."""
+        with self._lock:
+            self.spec_proposed += proposed
+            self.spec_accepted += accepted
+            self.spec_emitted += emitted
+            self.spec_verify_steps += 1
+        if proposed > 0:
+            self._c_spec_proposed.inc(proposed)
+        if accepted > 0:
+            self._c_spec_accepted.inc(accepted)
+        self._c_spec_verifies.inc()
+
+    def on_spec_fallback(self):
+        """One tick degraded to the plain (non-speculative) decode step
+        after a verify-seam fault — correctness preserved, speedup lost."""
+        with self._lock:
+            self.spec_fallback_ticks += 1
+        self._c_spec_fallbacks.inc()
+
+    def on_spec_rollback(self, pages: int):
+        """Lookahead pages released because the draft suffix they were
+        allocated for was rejected by the verifier."""
+        if pages <= 0:
+            return
+        with self._lock:
+            self.spec_rollback_pages += pages
+        self._c_spec_rollbacks.inc(int(pages))
 
     def on_cow(self):
         """One copy-on-write page duplication (a whole-prompt prefix hit
@@ -319,6 +373,21 @@ class ServingMetrics:
                     "step_hits": self.step_calls - self.step_compiles,
                 },
             }
+            if self.spec_verify_steps or self.spec_fallback_ticks:
+                out["spec_decode"] = {
+                    "proposed": self.spec_proposed,
+                    "accepted": self.spec_accepted,
+                    "emitted": self.spec_emitted,
+                    "verify_steps": self.spec_verify_steps,
+                    "fallback_ticks": self.spec_fallback_ticks,
+                    "rollback_pages": self.spec_rollback_pages,
+                    "acceptance_rate": (
+                        self.spec_accepted / self.spec_proposed
+                        if self.spec_proposed else None),
+                    "accepted_per_verify": (
+                        self.spec_emitted / self.spec_verify_steps
+                        if self.spec_verify_steps else None),
+                }
             if self._page_state:
                 ps = dict(self._page_state)
                 queries = ps.get("prefix_queries", 0)

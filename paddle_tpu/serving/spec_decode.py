@@ -1,0 +1,584 @@
+"""Speculative decoding under the tick scheduler (ISSUE 18).
+
+Leviathan et al., "Fast Inference from Transformers via Speculative
+Decoding" (ICML 2023), composed with the paged engine: a small DRAFT
+model proposes ``k`` greedy tokens per tick, and the TARGET model
+verifies all of them in ONE batched multi-token step — the same paged
+programs machinery the chunked prefill already uses, so the verify step
+is one jitted program regardless of ``k``.
+
+The acceptance rule is exact-match prefix accept against the target's
+OWN samples: at every proposed position the target draws its token from
+its own logits with the stream's real PRNG chain (greedy when
+``temperature <= 0``), and the draft's proposal only decides whether the
+NEXT position's logits had the right context.  Emitted tokens are
+therefore always the target's tokens with the baseline key discipline —
+spec output is token-for-token identical to the non-speculative engine
+(greedy AND sampled), which is the replay certificate; the draft only
+changes how many tokens one target step amortizes (1..k+1).
+
+Composition with the rest of the serving plane:
+
+* **paged COW / prefix sharing** — the draft keeps its OWN fp pools
+  (``[L_d, n_pages, H_d, page_size, D_d]``) indexed by the SAME per-slot
+  page tables; on activation it chunk-prefills the stream's sequence
+  through the slot's table, so radix-shared and COW pages simply get the
+  draft's (deterministic, identical) K/V written once more — harmless.
+* **page accounting** — verify writes positions ``pos..pos+k``, so the
+  tick pre-allocates the lookahead pages (victim-only failure, exactly
+  like ``_ensure_decode_pages``); pages past the accepted frontier are
+  released immediately after verify (``spec_rollback_pages``).
+* **r21 continuation joins** — a resurrected spec stream re-homes
+  through the ordinary join path; ``on_activate`` rebuilds the history
+  from ``prefill_ids() + [first]`` so the key-chain position invariant
+  (splits == emitted tokens) is untouched.
+* **r13 fault injection** — the ``serving.spec.verify`` seam fires per
+  active stream before the verify program; a raise-kind fault fails ONLY
+  the matched request(s), and the remaining streams fall back to the
+  plain decode step for that tick (``spec_fallback_ticks``).
+
+Staleness safety: positions past the accepted frontier hold rejected
+K/V in the target pool (and mispredicted K/V in the draft pool), but the
+next round's writes start exactly at the frontier and every program
+scatters before it gathers, with reads masked to ``j <= wpos`` — stale
+entries are always overwritten before an unmasked read, the same
+argument the chunked-prefill padding already relies on.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .paged import TRASH_PAGE, PagesExhaustedError
+
+__all__ = ["SpecDecodeConfig", "SpecDecodeState"]
+
+
+class SpecDecodeConfig:
+    """Knobs for the speculative plane: ``draft_model`` (a small
+    GPTForPretraining sharing the target's tokenizer/vocab) and ``k``
+    (draft tokens proposed per verify step)."""
+
+    def __init__(self, draft_model, k: int = 4):
+        self.draft_model = draft_model
+        self.k = int(k)
+        if self.k < 1:
+            raise ValueError("spec_decode k must be >= 1")
+
+
+class SpecDecodeState:
+    """Per-engine speculative-decoding state + programs (lock discipline:
+    every method except construction runs with the engine tick lock
+    held)."""
+
+    def __init__(self, engine, config):
+        if not isinstance(config, SpecDecodeConfig):
+            raise TypeError("spec_decode expects a SpecDecodeConfig")
+        import jax.numpy as jnp
+
+        from ..models.generation import _attn_layers
+        from ..models.gpt import GPTForPretraining
+        from .engine import _model_trace_lock
+
+        draft = config.draft_model
+        if not isinstance(draft, GPTForPretraining):
+            raise TypeError("draft_model must be a GPTForPretraining")
+        dcfg = draft.gpt.config
+        tcfg = engine.model.gpt.config
+        if dcfg.position_embedding == "rope":
+            raise NotImplementedError(
+                "draft model must be learned-position (same engine "
+                "restriction as the target)")
+        if dcfg.vocab_size != tcfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {dcfg.vocab_size} != target vocab "
+                f"{tcfg.vocab_size}: proposals would not be token ids "
+                f"the target understands")
+        draft.eval()
+        self.engine = engine
+        self.config = config
+        self.k = config.k
+        self.draft = draft
+        self._draft_attns = _attn_layers(draft)
+        self._d_layers = dcfg.num_layers
+        self._d_heads = dcfg.num_attention_heads
+        self._d_head_dim = dcfg.head_dim
+        self._draft_params = {n: p._data for n, p in draft.named_parameters()}
+        self._draft_buffers = {n: b._data for n, b in draft.named_buffers()}
+        # draft pools: same page geometry as the engine's, draft widths,
+        # always fp (the draft is small — quantizing it buys nothing)
+        self._draft_pool_shape = (self._d_layers, engine.n_pages,
+                                  self._d_heads, engine.page_size,
+                                  self._d_head_dim)
+        self._dpool_k = jnp.zeros(self._draft_pool_shape,
+                                  engine._cache_dtype)
+        self._dpool_v = jnp.zeros(self._draft_pool_shape,
+                                  engine._cache_dtype)
+        # per-slot host state: full token history (prompt + generated;
+        # hist[p] is the token AT position p, len == pos + 1) and the
+        # draft KV frontier (positions 0..dp-1 hold valid draft K/V)
+        self._hist: List[Optional[List[int]]] = [None] * engine.n_slots
+        self._draft_pos = np.zeros((engine.n_slots,), np.int64)
+        self.trace_counts: Dict[str, int] = {
+            "draft_prefill": 0, "draft_step": 0, "verify": 0}
+        self._draft_trace_lock = _model_trace_lock(draft)
+        self._draft_traced_buckets: set = set()
+        self._build_programs()
+
+    # -- traced programs ---------------------------------------------------
+    def _build_programs(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..autograd.tape import no_grad
+        from ..models.generation import sample_tokens
+        from ..ops._primitive import unwrap, wrap
+        from ..profiler.scope import scope
+
+        eng = self.engine
+        draft, dattns = self.draft, self._draft_attns
+        tattns = eng._attns
+        ps = eng.page_size
+        k = self.k
+        quant = eng._kv_quant
+
+        def _draft_forward(params, buffers, ids_t, position_ids_t):
+            out, _ = draft.functional_call_with_state(
+                params, buffers, ids_t, position_ids_t)
+            return unwrap(out)
+
+        def _target_forward(params, buffers, ids_t, position_ids_t):
+            out, _ = eng.model.functional_call_with_state(
+                params, buffers, ids_t, position_ids_t)
+            return unwrap(out)
+
+        def _set_draft_caches(pk, pv, pages, pos):
+            for li, a in enumerate(dattns):
+                a._gen_cache = {"mode": "paged", "k": pk[li], "v": pv[li],
+                                "pages": pages, "pos": pos,
+                                "page_size": ps, "attn_impl": "xla"}
+
+        def _collect_draft_caches():
+            pk = jnp.stack([unwrap(a._gen_cache["k"]) for a in dattns])
+            pv = jnp.stack([unwrap(a._gen_cache["v"]) for a in dattns])
+            return pk, pv
+
+        def _clear(attns):
+            for a in attns:
+                if hasattr(a, "_gen_cache"):
+                    del a._gen_cache
+
+        def draft_prefill_fn(params, buffers, ids, start, pages, pk, pv):
+            # one chunk of the draft's catch-up prefill: write K/V only,
+            # no sampling (the first propose step refeeds hist[pos])
+            self.trace_counts["draft_prefill"] += 1
+            start = start.astype(jnp.int32)
+            tc = ids.shape[1]
+            pos_ids = (start + jnp.arange(tc, dtype=jnp.int32))[None, :]
+            _set_draft_caches(pk, pv, pages[None, :], start[None])
+            try:
+                with no_grad():
+                    _draft_forward(params, buffers, wrap(ids),
+                                   wrap(pos_ids))
+                pk, pv = _collect_draft_caches()
+            finally:
+                _clear(dattns)
+            return pk, pv
+
+        def draft_step_fn(params, buffers, tok, pos, tables, pk, pv):
+            # one greedy draft token for every slot row (used both for
+            # catch-up rewrites and for the k propose steps)
+            self.trace_counts["draft_step"] += 1
+            posj = pos.astype(jnp.int32)
+            _set_draft_caches(pk, pv, tables, posj)
+            try:
+                with no_grad():
+                    logits = _draft_forward(params, buffers, wrap(tok),
+                                            wrap(posj[:, None]))
+                pk, pv = _collect_draft_caches()
+            finally:
+                _clear(dattns)
+            nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            return nxt, pk, pv
+
+        def _set_target_caches(pk, pv, pages, pos, scales):
+            for li, a in enumerate(tattns):
+                c = {"mode": "paged", "k": pk[li], "v": pv[li],
+                     "pages": pages, "pos": pos, "page_size": ps,
+                     "attn_impl": eng.attn_impl}
+                if scales:
+                    c["k_scale"] = scales[0][li]
+                    c["v_scale"] = scales[1][li]
+                a._gen_cache = c
+
+        def _collect_target_caches():
+            pk = jnp.stack([unwrap(a._gen_cache["k"]) for a in tattns])
+            pv = jnp.stack([unwrap(a._gen_cache["v"]) for a in tattns])
+            if not quant:
+                return pk, pv, ()
+            sk = jnp.stack([unwrap(a._gen_cache["k_scale"])
+                            for a in tattns])
+            sv = jnp.stack([unwrap(a._gen_cache["v_scale"])
+                            for a in tattns])
+            return pk, pv, (sk, sv)
+
+        def verify_fn(params, buffers, toks, pos, active, temp, topk,
+                      topp, keys, tables, pk, pv, *scales):
+            # toks [n, k+1]: column 0 = the stream's last sampled token
+            # (position pos), columns 1..k the draft proposals.  ONE
+            # target forward writes K/V for all k+1 positions and yields
+            # logits for positions pos+1..pos+k+1; the unrolled accept
+            # loop then samples each position with the stream's real key
+            # chain, emitting while the accept chain holds.  The key
+            # chain advances by EXACTLY the emitted count per slot —
+            # the baseline splits == tokens invariant.
+            self.trace_counts["verify"] += 1
+            posj = pos.astype(jnp.int32)
+            pos_ids = posj[:, None] + jnp.arange(k + 1,
+                                                 dtype=jnp.int32)[None, :]
+            _set_target_caches(pk, pv, tables, posj, scales)
+            try:
+                with no_grad():
+                    logits = _target_forward(params, buffers, wrap(toks),
+                                             wrap(pos_ids))
+                pk, pv, scales = _collect_target_caches()
+            finally:
+                _clear(tattns)
+            logits = logits.astype(jnp.float32)
+            acc = active
+            cur_keys = keys
+            outs = []
+            emitted = jnp.zeros(active.shape, jnp.int32)
+            for j in range(k + 1):
+                pair = jax.vmap(lambda k_: jax.random.split(k_))(cur_keys)
+                with scope("serving.sample"):
+                    tok_j = sample_tokens(logits[:, j], pair[:, 1], temp,
+                                          topk, topp).astype(jnp.int32)
+                emit = acc
+                outs.append(jnp.where(emit, tok_j, 0))
+                cur_keys = jnp.where(emit[:, None], pair[:, 0], cur_keys)
+                emitted = emitted + emit.astype(jnp.int32)
+                if j < k:
+                    acc = acc & (tok_j == toks[:, j + 1])
+            out = jnp.stack(outs, axis=1)          # [n, k+1]
+            return (out, emitted, cur_keys, pk, pv) + tuple(scales)
+
+        # donation mirrors the engine: pools + key chains are the only
+        # large threaded state (recorded always, applied off-CPU)
+        self._donate_draft_prefill = (5, 6)        # pk, pv
+        self._donate_draft_step = (5, 6)           # pk, pv
+        self._donate_verify = (8, 10, 11)          # keys, pk, pv
+        if quant:
+            self._donate_verify += (12, 13)
+        on_cpu = jax.default_backend() == "cpu"
+        self._draft_prefill_jit = jax.jit(
+            draft_prefill_fn,
+            donate_argnums=() if on_cpu else self._donate_draft_prefill)
+        self._draft_step_jit = jax.jit(
+            draft_step_fn,
+            donate_argnums=() if on_cpu else self._donate_draft_step)
+        self._verify_jit = jax.jit(
+            verify_fn, donate_argnums=() if on_cpu else self._donate_verify)
+
+    # -- lifecycle hooks (engine tick lock held) ---------------------------
+    def on_activate(self, slot: int, req, first: int, pos: int):
+        """A stream entered decode: rebuild its token history and chunk-
+        prefill the draft's KV over positions ``0..pos-1`` through the
+        slot's page table (shared/COW pages get identical values —
+        harmless rewrites)."""
+        import jax.numpy as jnp
+
+        eng = self.engine
+        hist = [int(t) for t in req.prefill_ids()] + [int(first)]
+        assert len(hist) == pos + 1, (len(hist), pos)
+        self._hist[slot] = hist
+        self._draft_pos[slot] = 0
+        seq = np.asarray(hist[:pos], np.int32)
+        table = eng._page_tables[slot]
+        start = 0
+        while start < pos:
+            rlen = min(pos - start, eng._chunk_limit)
+            bucket = eng._chunk_bucket_for(rlen)
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :rlen] = seq[start:start + rlen]
+            guard = (contextlib.nullcontext()
+                     if bucket in self._draft_traced_buckets
+                     else self._draft_trace_lock)
+            with guard:
+                self._dpool_k, self._dpool_v = self._draft_prefill_jit(
+                    self._draft_params, self._draft_buffers,
+                    jnp.asarray(ids), jnp.asarray(np.int32(start)),
+                    jnp.asarray(table), self._dpool_k, self._dpool_v)
+            self._draft_traced_buckets.add(bucket)
+            start += rlen
+        self._draft_pos[slot] = pos
+
+    def on_token(self, slot: int, token: int):
+        """A token emitted OUTSIDE the spec path (plain-decode fallback
+        tick): extend the history; the draft frontier lags and the next
+        spec tick's catch-up loop closes the gap."""
+        h = self._hist[slot]
+        if h is not None:
+            h.append(int(token))
+
+    def on_free(self, slot: int):
+        self._hist[slot] = None
+        self._draft_pos[slot] = 0
+
+    def reset(self):
+        """Pool-loss / fail-pending recovery: every stream is gone, so
+        drop all spec state and re-zero the draft pools (page content is
+        meaningless once the engine pool was reset)."""
+        import jax.numpy as jnp
+
+        self._hist = [None] * self.engine.n_slots
+        self._draft_pos[:] = 0
+        self._dpool_k = jnp.zeros(self._draft_pool_shape,
+                                  self.engine._cache_dtype)
+        self._dpool_v = jnp.zeros(self._draft_pool_shape,
+                                  self.engine._cache_dtype)
+
+    # -- per-tick helpers --------------------------------------------------
+    def _active_slots(self) -> List[int]:
+        eng = self.engine
+        return [i for i in range(eng.n_slots)
+                if eng._active[i] and self._hist[i] is not None]
+
+    def _run_draft_step(self, tok, pos, tables):
+        import jax.numpy as jnp
+
+        guard = (self._draft_trace_lock
+                 if self.trace_counts["draft_step"] == 0
+                 else contextlib.nullcontext())
+        with guard:
+            nxt, self._dpool_k, self._dpool_v = self._draft_step_jit(
+                self._draft_params, self._draft_buffers,
+                jnp.asarray(tok[:, None]), jnp.asarray(pos), tables,
+                self._dpool_k, self._dpool_v)
+        return np.asarray(nxt)
+
+    def _catch_up(self, slots, tables):
+        """Advance every lagging stream's draft frontier to ``pos`` with
+        batched draft steps; caught-up rows run the idempotent rewrite
+        ``(hist[pos-1], pos-1)`` (same token, same position — a no-op
+        write) so the batch shape never changes."""
+        eng = self.engine
+        gaps = [int(eng._pos[i]) - int(self._draft_pos[i]) for i in slots]
+        for _ in range(max(gaps, default=0)):
+            tok = np.zeros((eng.n_slots,), np.int32)
+            pos = np.zeros((eng.n_slots,), np.int32)
+            for i in slots:
+                h = self._hist[i]
+                dp = int(self._draft_pos[i])
+                p = int(eng._pos[i])
+                if dp < p:
+                    tok[i], pos[i] = h[dp], dp
+                else:
+                    tok[i], pos[i] = h[p - 1], p - 1
+            self._run_draft_step(tok, pos, tables)
+            for i in slots:
+                if int(self._draft_pos[i]) < int(eng._pos[i]):
+                    self._draft_pos[i] += 1
+
+    def _propose(self, slots, tables) -> np.ndarray:
+        """k greedy draft steps from each stream's last sampled token;
+        returns drafts ``[n_slots, k]`` (garbage on inactive rows — the
+        verify masks them)."""
+        eng = self.engine
+        drafts = np.zeros((eng.n_slots, self.k), np.int32)
+        cur = np.zeros((eng.n_slots,), np.int32)
+        base = np.zeros((eng.n_slots,), np.int32)
+        for i in slots:
+            cur[i] = self._hist[i][int(eng._pos[i])]
+            base[i] = int(eng._pos[i])
+        for j in range(self.k):
+            nxt = self._run_draft_step(cur, base + j, tables)
+            for i in slots:
+                drafts[i, j] = int(nxt[i])
+                cur[i] = int(nxt[i])
+        for i in slots:
+            self._draft_pos[i] = int(eng._pos[i]) + self.k
+        return drafts
+
+    def _ensure_lookahead_pages(self, slots) -> List[int]:
+        """Verify writes positions ``pos..pos+k``: allocate the pages
+        those positions need (clamped to the request's priced worst case
+        so the admission gate's math stays an upper bound).  Exhaustion
+        fails ONLY the victim stream — everyone else keeps going.
+        Returns the slots still alive."""
+        eng = self.engine
+        ps = eng.page_size
+        alive = []
+        for i in slots:
+            req = eng._slots[i]
+            p = int(eng._pos[i])
+            hi = min(p + self.k,
+                     int(req.prompt.size) + int(req.max_new_tokens) - 1)
+            ok = True
+            for pi in range(p // ps, min(hi // ps + 1,
+                                         eng.max_pages_per_slot)):
+                if eng._page_tables[i, pi] != TRASH_PAGE:
+                    continue
+                try:
+                    page = eng._alloc_pages(1, "spec_lookahead")[0]
+                except Exception as e:
+                    req._finish(
+                        req.FAILED,
+                        f"{PagesExhaustedError.error_type}: page pool "
+                        f"exhausted in speculative lookahead after "
+                        f"{len(req.tokens)} tokens: {e}",
+                        error_type=PagesExhaustedError.error_type)
+                    eng._free_paged_slot(i, req)
+                    ok = False
+                    break
+                req._pages.append(page)
+                eng._page_tables[i, pi] = page
+            if ok:
+                alive.append(i)
+        return alive
+
+    def _rollback_pages(self, slot: int, req, new_pos: int) -> int:
+        """Release lookahead pages past the accepted frontier: any table
+        entry at a page index strictly beyond ``new_pos // ps`` was
+        allocated THIS tick (the pre-tick table never extends past the
+        write frontier) and holds only rejected-suffix K/V."""
+        eng = self.engine
+        ps = eng.page_size
+        dropped = 0
+        for pi in range(new_pos // ps + 1, eng.max_pages_per_slot):
+            page = int(eng._page_tables[slot, pi])
+            if page == TRASH_PAGE:
+                continue
+            eng._page_tables[slot, pi] = TRASH_PAGE
+            try:
+                req._pages.remove(page)
+            except ValueError:
+                pass
+            eng._pool.release([page])
+            dropped += 1
+        return dropped
+
+    # -- the spec tick (engine tick lock held) -----------------------------
+    def tick(self):
+        """One speculative decode round for every active stream: draft
+        catch-up -> k proposals -> ONE batched target verify -> host
+        accept/rollback bookkeeping.  Replaces ``_decode_tick_plain``
+        for the tick; falls back to it when the ``serving.spec.verify``
+        seam faults a stream out."""
+        import jax.numpy as jnp
+
+        from ..profiler.scope import scope
+        from ..resilience.inject import fire as _inject_fire
+
+        eng = self.engine
+        slots = self._active_slots()
+        if not slots:
+            # defensive: active slots whose history is gone (can only
+            # happen after a partial reset) decode plainly
+            eng._decode_tick_plain()
+            return
+        t_tick = time.perf_counter()
+        # fault seam: a raise-kind fault fails ONLY the matched streams;
+        # the survivors decode plainly this tick (certificate: two runs
+        # with the same schedule produce identical fired logs)
+        faulted = False
+        for i in list(slots):
+            req = eng._slots[i]
+            try:
+                _inject_fire("serving.spec.verify",
+                             request_id=req.request_id, slot=i)
+            except Exception as e:
+                req._finish(
+                    req.FAILED,
+                    f"speculative verify failed: {type(e).__name__}: {e}",
+                    error_type=type(e).__name__)
+                eng._free_paged_slot(i, req)
+                slots.remove(i)
+                faulted = True
+        if faulted:
+            eng.metrics.on_spec_fallback()
+            if eng._active.any():
+                eng._decode_tick_plain()
+            return
+        # pages BEFORE the draft runs: propose writes draft K/V at
+        # positions pos..pos+k-1 and verify writes target K/V at
+        # pos..pos+k — both through the same lookahead pages
+        slots = self._ensure_lookahead_pages(slots)
+        if not slots:
+            return
+        tables = eng._decode_tables()
+        with scope("serving.spec_draft"):
+            self._catch_up(slots, tables)
+            drafts = self._propose(slots, tables)
+        # the verify batch: toks[:, 0] = last sampled token, 1..k drafts
+        toks = np.zeros((eng.n_slots, self.k + 1), np.int32)
+        for i in slots:
+            toks[i, 0] = self._hist[i][int(eng._pos[i])]
+            toks[i, 1:] = drafts[i]
+        active = np.zeros((eng.n_slots,), bool)
+        for i in slots:
+            active[i] = True
+        before = self.trace_counts["verify"]
+        guard = (eng._trace_lock if before == 0
+                 else contextlib.nullcontext())
+        args = (eng._params, eng._buffers, jnp.asarray(toks),
+                jnp.asarray(eng._pos), jnp.asarray(active),
+                jnp.asarray(eng._temp), jnp.asarray(eng._topk),
+                jnp.asarray(eng._topp), jnp.asarray(eng._keys),
+                tables, eng._pool_k, eng._pool_v)
+        if eng._kv_quant:
+            args += (eng._scale_k, eng._scale_v)
+        with scope("serving.spec_verify"), guard:
+            if eng._kv_quant:
+                (out, counts, keys, eng._pool_k, eng._pool_v,
+                 eng._scale_k, eng._scale_v) = self._verify_jit(*args)
+            else:
+                out, counts, keys, eng._pool_k, eng._pool_v = \
+                    self._verify_jit(*args)
+        out = np.asarray(out)
+        counts = np.asarray(counts)
+        keys = np.array(keys)
+        step_s = time.perf_counter() - t_tick
+        eng.metrics.on_step(self.trace_counts["verify"] > before)
+        emitted_total = 0
+        for i in slots:
+            req = eng._slots[i]
+            e = int(counts[i])            # tokens the device emitted
+            h = self._hist[i]
+            p = int(eng._pos[i])
+            appended = 0
+            finished = False
+            for j in range(e):
+                token = int(out[i, j])
+                req._append(token)
+                h.append(token)
+                appended += 1
+                if eng._request_finished(req, token):
+                    finished = True
+                    break
+            emitted_total += appended
+            eng.metrics.on_spec_verify(proposed=self.k, accepted=e - 1,
+                                       emitted=appended)
+            if finished:
+                # the device chain advanced e splits but the stream ends
+                # here — the slot retires and its chain is discarded, so
+                # the truncation is unobservable (exactly like eos in
+                # the plain engine)
+                eng._retire(i, req)
+                eng._slots[i] = None
+                eng._active[i] = False
+                continue
+            new_pos = p + appended
+            eng._pos[i] = new_pos
+            eng._tok[i] = int(out[i, appended - 1])
+            eng._keys[i] = keys[i]
+            # draft K/V is valid exactly through the accepted prefix
+            self._draft_pos[i] = p + min(appended, self.k)
+            dropped = self._rollback_pages(i, req, new_pos)
+            if dropped:
+                eng.metrics.on_spec_rollback(dropped)
+        eng.metrics.on_tokens(emitted_total, step_seconds=step_s)

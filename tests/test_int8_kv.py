@@ -1,0 +1,169 @@
+"""Int8 paged KV cache (ISSUE 18): per-page-scale quantized pool halves
+the per-stream KV HBM (ratio pinned <= 55% of the fp layout), greedy
+divergence vs the fp engine is pinned on fixed seeds, the Pallas int8
+flash-decode kernel matches the XLA gather-dequant path bit-for-bit, and
+admission 429 bodies cite the quantized page layout.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+from paddle_tpu.serving import (
+    AdmissionRejected,
+    ContinuousBatchingEngine,
+    Request,
+)
+from paddle_tpu.serving.admission import AdmissionGate
+
+VOCAB = 64
+
+
+def _tiny_model(seed=0):
+    paddle.seed(seed)
+    cfg = gpt_config("gpt2-small", vocab_size=VOCAB, hidden_size=32,
+                     num_layers=2, num_attention_heads=4,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model(0)
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("prefill_buckets", [4, 8, 16])
+    kw.setdefault("page_size", 4)
+    return ContinuousBatchingEngine(model, **kw)
+
+
+def _drive(eng, prompts, news):
+    reqs = [eng.submit(Request(p, max_new_tokens=n))
+            for p, n in zip(prompts[:3], news[:3])]
+    for _ in range(2):
+        eng.step_once()
+    reqs += [eng.submit(Request(p, max_new_tokens=n))
+             for p, n in zip(prompts[3:], news[3:])]
+    eng.run_until_idle(timeout=300)
+    return reqs
+
+
+class TestInt8KV:
+    def test_page_bytes_at_most_55pct_of_fp(self, model):
+        """The acceptance bound: int8 pages (payload + per-token scale
+        rows) cost <= 55% of the fp pages, so one HBM budget admits
+        ~2x the streams."""
+        fp = _engine(model)
+        q = _engine(model, kv_dtype="int8")
+        assert q.page_bytes / fp.page_bytes <= 0.55
+        # per-slot worst case the admission gate prices shrinks too
+        g_fp = AdmissionGate(fp, budget_bytes=1 << 30)
+        g_q = AdmissionGate(q, budget_bytes=1 << 30)
+        assert (g_q.kv_bytes_per_slot() / g_fp.kv_bytes_per_slot()
+                <= 0.55)
+
+    def test_greedy_divergence_pinned(self, model):
+        """Quantized KV is NOT bit-exact; the pinned certificate: on
+        fixed seeds, all streams complete and greedy divergence vs the
+        fp engine stays under 15% of positions."""
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, VOCAB, (l,)).astype(np.int32)
+                   for l in [3, 5, 7, 4, 9]]
+        news = [6, 4, 8, 5, 7]
+        want = [np.asarray(r.result())
+                for r in _drive(_engine(model), prompts, news)]
+        got = _drive(_engine(model, kv_dtype="int8"), prompts, news)
+        div = tot = 0
+        for r, w in zip(got, want):
+            assert r.state == Request.DONE, (r.state, r.error)
+            g = np.asarray(r.result())
+            assert len(g) == len(w)
+            div += int((g != w).sum())
+            tot += len(w)
+        assert div / tot <= 0.15, f"divergence {div}/{tot}"
+
+    def test_pallas_int8_matches_xla_int8(self, model):
+        """The int8 flash-decode kernel (interpret mode on CPU) is
+        bit-identical to the XLA gather-dequant reference."""
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, VOCAB, (l,)).astype(np.int32)
+                   for l in [3, 5, 7, 4]]
+        news = [6, 5, 7, 6]
+        xla = _drive(_engine(model, kv_dtype="int8"), prompts, news)
+        pl = _drive(_engine(model, kv_dtype="int8", attn_impl="pallas"),
+                    prompts, news)
+        for a, b in zip(pl, xla):
+            assert a.state == Request.DONE, (a.state, a.error)
+            np.testing.assert_array_equal(
+                np.asarray(a.result()), np.asarray(b.result()))
+
+    def test_int8_kernel_priced_in_cost_registry(self):
+        from paddle_tpu.ops.pallas.paged_attention import (
+            PAGED_ATTENTION_INT8_KERNEL_NAME,
+        )
+        from paddle_tpu.ops.pallas.cost_registry import kernel_cost_model
+
+        assert kernel_cost_model(
+            PAGED_ATTENTION_INT8_KERNEL_NAME) is not None
+
+    def test_429_body_cites_quantized_layout(self, model):
+        """A page-budget refusal on the int8 engine names kv_dtype in
+        both the estimate dict and the message — operators see WHICH
+        layout the budget was priced for."""
+        eng = _engine(model, kv_dtype="int8", prefix_sharing=False)
+        eng.admission_gate = AdmissionGate(
+            eng, budget_bytes=1 << 30, page_budget=2)
+        with pytest.raises(AdmissionRejected) as ei:
+            eng.submit(Request(np.arange(1, 12, dtype=np.int32),
+                               max_new_tokens=8))
+        pages = ei.value.estimate["pages"]
+        assert pages["kv_dtype"] == "int8"
+        assert "kv_dtype int8" in str(ei.value)
+        # the fp engine cites its own layout the same way
+        fp = _engine(model, prefix_sharing=False)
+        fp.admission_gate = AdmissionGate(
+            fp, budget_bytes=1 << 30, page_budget=2)
+        with pytest.raises(AdmissionRejected) as ei2:
+            fp.submit(Request(np.arange(1, 12, dtype=np.int32),
+                              max_new_tokens=8))
+        assert ei2.value.estimate["pages"]["kv_dtype"] == "float32"
+
+    def test_same_budget_admits_double_the_pages(self, model):
+        """The operational payoff: a fixed HBM byte budget converts to
+        >= 2x the page budget under the int8 layout."""
+        fp = _engine(model)
+        q = _engine(model, kv_dtype="int8")
+        hbm = 64 * fp.page_bytes  # an arbitrary fixed byte budget
+        assert hbm // q.page_bytes >= 2 * (hbm // fp.page_bytes)
+
+    def test_int8_requires_paged_layout(self, model):
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatchingEngine(model, max_seq_len=32, n_slots=2,
+                                     kv_layout="slot", kv_dtype="int8")
+
+    def test_pool_reset_reallocates_scales(self, model):
+        """Cache-loss recovery re-zeros the scale tensors alongside the
+        pools (a stale scale would mis-dequantize every later write)."""
+        eng = _engine(model, kv_dtype="int8", prefix_sharing=False)
+        r = eng.submit(Request(np.arange(1, 6, dtype=np.int32),
+                               max_new_tokens=4))
+        eng.run_until_idle(timeout=300)
+        assert r.state == Request.DONE
+        assert float(np.asarray(eng._scale_k).max()) > 0  # scales written
+        eng.fail_pending("test reset")
+        eng._reset_cache()
+        assert float(np.asarray(eng._scale_k).max()) == 0.0
+        assert float(np.asarray(eng._scale_v).max()) == 0.0
+        # the engine still serves correctly after the reset
+        r2 = eng.submit(Request(np.arange(1, 6, dtype=np.int32),
+                                max_new_tokens=4))
+        eng.run_until_idle(timeout=300)
+        assert r2.state == Request.DONE
+        np.testing.assert_array_equal(np.asarray(r2.result()),
+                                      np.asarray(r.result()))

@@ -1,0 +1,254 @@
+"""Int8 weight PTQ (ISSUE 18): per-channel absmax quantization math,
+calibration, the pinned quality-delta certificate, the engine's
+``weight_dtype="int8"`` plane, the durable quantized artifact
+(save/load round trip + corrupt-scale detection), and the
+dequant-materialization lint (positive, negative, and KV-exempt cases)
+with the shipped int8 entry points coming back zero HIGH.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.analysis.graph import AnalysisTarget
+from paddle_tpu.analysis.rules import DtypePromotionRule, analyze_targets
+from paddle_tpu.inference import load_quantized, save_quantized
+from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+from paddle_tpu.quantization import (
+    calibrate_activations_,
+    post_training_quantize_,
+    quality_delta,
+    quantize_model_weights_,
+    quantized_layer_names,
+)
+from paddle_tpu.serving import ContinuousBatchingEngine, Request
+
+VOCAB = 64
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def _tiny_model(seed=0):
+    paddle.seed(seed)
+    cfg = gpt_config("gpt2-small", vocab_size=VOCAB, hidden_size=32,
+                     num_layers=2, num_attention_heads=4,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+class TestWeightQuant:
+    def test_per_channel_absmax_roundtrip_error_bounded(self):
+        paddle.seed(0)
+        lin = nn.Linear(16, 8)
+        w = _np(lin.weight).copy()
+        (name,) = quantize_model_weights_(lin)
+        q = _np(lin.weight)
+        assert q.dtype == np.int8
+        scale = _np(lin.weight_scale)
+        assert scale.shape == (8,)
+        np.testing.assert_allclose(
+            scale, np.maximum(np.abs(w).max(axis=0) / 127.0, 1e-8),
+            rtol=1e-6)
+        # dequantized weight within half a quantization level per channel
+        assert np.abs(q.astype(np.float32) * scale[None, :] - w).max() \
+            <= scale.max() / 2 + 1e-7
+
+    def test_idempotent_and_skip(self):
+        paddle.seed(0)
+        lin = nn.Linear(8, 4)
+        assert quantize_model_weights_(lin)
+        assert quantize_model_weights_(lin) == []  # already int8
+        paddle.seed(0)
+        lin2 = nn.Linear(8, 4)
+        assert quantize_model_weights_(lin2, skip=lambda n: True) == []
+        assert _np(lin2.weight).dtype == np.float32
+
+    def test_outlier_ratio_keeps_fp(self):
+        paddle.seed(0)
+        lin = nn.Linear(8, 4)
+        w = _np(lin.weight).copy()
+        w[:, 0] *= 1e4  # one channel's absmax dominates
+        lin.weight._set_data(jnp.asarray(w))
+        assert quantize_model_weights_(lin, outlier_ratio=100.0) == []
+        assert quantize_model_weights_(lin) != []  # no guard: quantizes
+
+    def test_quantized_layer_names(self):
+        model = _tiny_model()
+        assert quantized_layer_names(model) == []
+        done = quantize_model_weights_(model)
+        assert sorted(done) == sorted(quantized_layer_names(model))
+        assert len(done) == 8  # 4 linears x 2 blocks
+
+    def test_calibration_registers_act_scale(self):
+        model = _tiny_model()
+        rng = np.random.default_rng(0)
+        batches = [rng.integers(0, VOCAB, (1, 8)).astype(np.int32)
+                   for _ in range(2)]
+        records = calibrate_activations_(model, batches)
+        assert records  # absmax observed per layer
+        done = quantize_model_weights_(model)
+        for name in done:
+            layer = dict(model.named_sublayers(include_self=True))[name]
+            assert float(_np(layer.act_scale)) > 0
+
+
+class TestQualityDelta:
+    def test_pinned_certificate(self):
+        """The ISSUE's pinned quality delta on fixed seeds: small logit
+        error, low greedy divergence — NOT bit-exactness."""
+        fp = _tiny_model(0)
+        q = _tiny_model(0)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, VOCAB, (8,)) for _ in range(4)]
+        cal = [rng.integers(0, VOCAB, (1, 8)).astype(np.int32)
+               for _ in range(2)]
+        post_training_quantize_(q, calibration_batches=cal)
+        qd = quality_delta(fp, q, prompts)
+        assert qd["positions"] == 32
+        assert qd["logit_max_abs_err"] < 0.25
+        assert qd["greedy_divergence_rate"] <= 0.15
+
+    def test_identical_models_are_exact(self):
+        m = _tiny_model(0)
+        qd = quality_delta(m, m, [np.arange(1, 7)])
+        assert qd["logit_max_abs_err"] == 0.0
+        assert qd["greedy_divergence_rate"] == 0.0
+
+
+class TestServingInt8Weights:
+    def test_engine_weight_dtype_int8_serves(self):
+        """weight_dtype="int8" quantizes at engine build; greedy output
+        stays within the pinned divergence of the fp engine."""
+        fp_model = _tiny_model(0)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, VOCAB, (l,)).astype(np.int32)
+                   for l in [3, 5, 7]]
+        fp = ContinuousBatchingEngine(
+            fp_model, max_seq_len=32, n_slots=3, prefill_buckets=[8],
+            page_size=4)
+        want = [fp.submit(Request(p, max_new_tokens=6)) for p in prompts]
+        fp.run_until_idle(timeout=300)
+
+        q_model = _tiny_model(0)
+        q = ContinuousBatchingEngine(
+            q_model, max_seq_len=32, n_slots=3, prefill_buckets=[8],
+            page_size=4, weight_dtype="int8")
+        assert quantized_layer_names(q_model)  # engine ran the PTQ pass
+        got = [q.submit(Request(p, max_new_tokens=6)) for p in prompts]
+        q.run_until_idle(timeout=300)
+        div = tot = 0
+        for r, w in zip(got, want):
+            assert r.state == Request.DONE, (r.state, r.error)
+            g, ww = np.asarray(r.result()), np.asarray(w.result())
+            div += int((g != ww).sum())
+            tot += len(ww)
+        assert div / tot <= 0.15, f"divergence {div}/{tot}"
+
+
+class TestQuantizedArtifact:
+    def test_save_load_round_trip_exact(self, tmp_path):
+        q = _tiny_model(0)
+        rng = np.random.default_rng(0)
+        cal = [rng.integers(0, VOCAB, (1, 8)).astype(np.int32)]
+        names = post_training_quantize_(q, calibration_batches=cal)
+        path = os.path.join(str(tmp_path), "model.pdq8")
+        assert save_quantized(q, path) == sorted(names)
+        # overlay onto the SAME fp base: bit-identical logits
+        fresh = _tiny_model(0)
+        assert load_quantized(fresh, path) == sorted(names)
+        qd = quality_delta(q, fresh, [rng.integers(0, VOCAB, (6,))])
+        assert qd["logit_max_abs_err"] == 0.0
+
+    def test_corrupt_scale_detected(self, tmp_path):
+        q = _tiny_model(0)
+        quantize_model_weights_(q)
+        path = os.path.join(str(tmp_path), "model.pdq8")
+        save_quantized(q, path)
+        blob = bytearray(open(path, "rb").read())
+        blob[-5] ^= 0xFF  # flip a payload (scale-region) byte
+        bad = path + ".bad"
+        with open(bad, "wb") as f:
+            f.write(bytes(blob))
+        fresh = _tiny_model(0)
+        before = {n: _np(l.weight).copy()
+                  for n, l in fresh.named_sublayers(include_self=True)
+                  if hasattr(l, "weight") and getattr(
+                      l.weight, "ndim", 0) == 2}
+        with pytest.raises(ValueError, match="CRC mismatch"):
+            load_quantized(fresh, bad)
+        # the model was left untouched
+        for n, l in fresh.named_sublayers(include_self=True):
+            if n in before:
+                np.testing.assert_array_equal(_np(l.weight), before[n])
+
+    def test_unquantized_model_refused(self, tmp_path):
+        with pytest.raises(ValueError, match="no int8 layers"):
+            save_quantized(_tiny_model(0),
+                           os.path.join(str(tmp_path), "x.pdq8"))
+
+
+class TestDequantLint:
+    def _target(self, fn, args, name):
+        return AnalysisTarget(name, fn, args)
+
+    def test_materialized_dequant_flagged_high(self):
+        def bad(x, wq, scale):
+            w = wq.astype(jnp.float32) * scale[None, :]
+            return x @ w
+
+        sds = jax.ShapeDtypeStruct
+        args = (sds((4, 16), np.float32), sds((16, 8), np.int8),
+                sds((8,), np.float32))
+        fs = DtypePromotionRule().run(self._target(bad, args, "bad"))
+        assert any("dequantized int8 weight" in f.message
+                   and str(f.severity).upper().endswith("HIGH")
+                   for f in fs)
+
+    def test_w8a8_scale_fused_clean(self):
+        def good(x, wq, scale):
+            sx = jnp.maximum(jnp.max(jnp.abs(x)) / 127.0, 1e-8)
+            xq = jnp.clip(jnp.round(x / sx), -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, wq, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            return acc.astype(jnp.float32) * (sx * scale)
+
+        sds = jax.ShapeDtypeStruct
+        args = (sds((4, 16), np.float32), sds((16, 8), np.int8),
+                sds((8,), np.float32))
+        assert not DtypePromotionRule().run(
+            self._target(good, args, "good"))
+
+    def test_gather_fed_kv_dequant_exempt(self):
+        def kvlike(q, pool, scales, pages):
+            g = pool[pages]
+            s = scales[pages]
+            k = g.astype(jnp.float32) * s[:, :, None]
+            return jnp.einsum("nd,npd->np", q, k)
+
+        sds = jax.ShapeDtypeStruct
+        args = (sds((2, 8), np.float32), sds((16, 4, 8), np.int8),
+                sds((16, 4), np.float32), sds((2,), np.int32))
+        fs = DtypePromotionRule().run(self._target(kvlike, args, "kv"))
+        assert not [f for f in fs
+                    if "dequantized int8 weight" in f.message]
+
+    def test_shipped_int8_entry_points_zero_high(self):
+        """The acceptance criterion: the quantized serving programs lint
+        clean — no materialized dequant anywhere in the int8 plane."""
+        from paddle_tpu.analysis.entrypoints import serving_int8_targets
+
+        report = analyze_targets(serving_int8_targets())
+        highs = [f for f in report.findings
+                 if str(f.severity).upper().endswith("HIGH")]
+        assert not highs, [f.message for f in highs]

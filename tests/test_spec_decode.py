@@ -1,0 +1,277 @@
+"""Speculative decoding under the tick scheduler (ISSUE 18): exact-match
+verify keeps greedy (and seeded sampled) output token-for-token identical
+to the plain paged engine over staggered mixed-length requests — including
+shared-prefix joins and COW — while acceptance / rollback accounting and
+the ``serving.spec.verify`` fault seam (typed failure, plain-decode
+fallback, two-run replay certificate) are pinned on CPU.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTForPretraining, gpt_config
+from paddle_tpu.resilience.inject import FaultSchedule
+from paddle_tpu.serving import (
+    ContinuousBatchingEngine,
+    Request,
+    SpecDecodeConfig,
+)
+
+VOCAB = 64
+
+
+def _tiny_model(seed=0):
+    paddle.seed(seed)
+    cfg = gpt_config("gpt2-small", vocab_size=VOCAB, hidden_size=32,
+                     num_layers=2, num_attention_heads=4,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model(0)
+
+
+@pytest.fixture(scope="module")
+def draft():
+    # independently-initialized draft: proposals are usually WRONG, so
+    # the rejection/rollback paths run for real
+    return _tiny_model(1)
+
+
+def _mixed_prompts(rng, with_prefix=True):
+    lens = [3, 5, 7, 4, 9, 6]
+    prompts = [rng.integers(0, VOCAB, (l,)).astype(np.int32) for l in lens]
+    news = [6, 4, 8, 5, 3, 7]
+    if with_prefix:
+        base = rng.integers(0, VOCAB, (8,)).astype(np.int32)  # 2 pages @4
+        prompts.append(np.concatenate(
+            [base, rng.integers(0, VOCAB, (3,)).astype(np.int32)]))
+        prompts.append(base.copy())  # whole-prompt prefix hit -> COW
+        news += [6, 5]
+    return prompts, news
+
+
+def _drive_staggered(eng, prompts, news, **req_kw):
+    cut = len(prompts) - 3
+    reqs = [eng.submit(Request(p, max_new_tokens=n, **req_kw))
+            for p, n in zip(prompts[:cut], news[:cut])]
+    for _ in range(3):
+        eng.step_once()
+    reqs += [eng.submit(Request(p, max_new_tokens=n, **req_kw))
+             for p, n in zip(prompts[cut:], news[cut:])]
+    eng.run_until_idle(timeout=300)
+    return reqs
+
+
+def _spec_engine(model, dm, k=3, **kw):
+    return ContinuousBatchingEngine(
+        model, max_seq_len=32, n_slots=4, prefill_buckets=[4, 8, 16],
+        page_size=4, spec_decode=SpecDecodeConfig(dm, k=k), **kw)
+
+
+def _plain_engine(model, **kw):
+    return ContinuousBatchingEngine(
+        model, max_seq_len=32, n_slots=4, prefill_buckets=[4, 8, 16],
+        page_size=4, **kw)
+
+
+class TestSpecExactness:
+    def test_greedy_identical_to_baseline_self_draft(self, model):
+        """Self-speculation (draft == target): every proposal accepted,
+        output still token-for-token the baseline's (the acceptance
+        criterion's replay certificate)."""
+        rng = np.random.default_rng(0)
+        prompts, news = _mixed_prompts(rng)
+        want = [np.asarray(r.result()) for r in
+                _drive_staggered(_plain_engine(model), prompts, news)]
+        eng = _spec_engine(model, model, k=3)
+        got = _drive_staggered(eng, prompts, news)
+        for r, w in zip(got, want):
+            assert r.state == Request.DONE, (r.state, r.error)
+            np.testing.assert_array_equal(np.asarray(r.result()), w)
+        sd = eng.metrics.snapshot()["spec_decode"]
+        assert sd["acceptance_rate"] == 1.0
+        assert sd["accepted_per_verify"] > 1.0
+        # COW / prefix sharing engaged alongside speculation
+        st = eng.page_state()
+        assert st["prefix_hits"] >= 1
+
+    def test_greedy_identical_to_baseline_real_draft(self, model, draft):
+        """A draft that is usually WRONG: rejections, rollbacks, and the
+        catch-up path all fire, and the output is still bit-identical —
+        emitted tokens are always the target's own samples."""
+        rng = np.random.default_rng(1)
+        prompts, news = _mixed_prompts(rng)
+        want = [np.asarray(r.result()) for r in
+                _drive_staggered(_plain_engine(model), prompts, news)]
+        eng = _spec_engine(model, draft, k=4)
+        got = _drive_staggered(eng, prompts, news)
+        for r, w in zip(got, want):
+            assert r.state == Request.DONE, (r.state, r.error)
+            np.testing.assert_array_equal(np.asarray(r.result()), w)
+        sd = eng.metrics.snapshot()["spec_decode"]
+        assert sd["acceptance_rate"] < 1.0  # real rejections happened
+        assert sd["accepted_per_verify"] >= 1.0  # never slower than plain
+
+    def test_sampled_identical_to_baseline(self, model):
+        """temperature > 0 with an explicit seed: verify consumes the
+        SAME per-slot key chain as the plain step (one split per emitted
+        token), so even sampled streams replay bit-identically."""
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(0, VOCAB, (l,)).astype(np.int32)
+                   for l in [3, 5, 7, 4]]
+        news = [6, 5, 7, 6]
+
+        def drive(eng):
+            reqs = [eng.submit(Request(p, max_new_tokens=n, temperature=0.8,
+                                       top_k=8, seed=123 + i))
+                    for i, (p, n) in enumerate(zip(prompts, news))]
+            eng.run_until_idle(timeout=300)
+            return reqs
+
+        want = [np.asarray(r.result()) for r in drive(_plain_engine(model))]
+        got = drive(_spec_engine(model, model, k=3))
+        for r, w in zip(got, want):
+            assert r.state == Request.DONE, (r.state, r.error)
+            np.testing.assert_array_equal(np.asarray(r.result()), w)
+
+
+class TestSpecAccounting:
+    def test_acceptance_counters_self_draft(self, model):
+        eng = _spec_engine(model, model, k=3, prefix_sharing=False)
+        rng = np.random.default_rng(3)
+        reqs = [eng.submit(Request(
+            rng.integers(0, VOCAB, (5,)).astype(np.int32), max_new_tokens=7))
+            for _ in range(2)]
+        eng.run_until_idle(timeout=300)
+        assert all(r.state == Request.DONE for r in reqs)
+        sd = eng.metrics.snapshot()["spec_decode"]
+        assert sd["accepted"] == sd["proposed"]  # self-draft: all accepted
+        assert sd["accepted"] <= sd["proposed"]
+        # every verify emits [1, k+1] tokens
+        assert sd["verify_steps"] <= sd["emitted"] \
+            <= sd["accepted"] + sd["verify_steps"]
+        assert sd["rollback_pages"] == 0  # nothing ever rejected
+
+    def test_rollback_accounting_and_no_page_leak(self, model, draft):
+        eng = _spec_engine(model, draft, k=4, prefix_sharing=False)
+        rng = np.random.default_rng(4)
+        reqs = [eng.submit(Request(
+            rng.integers(0, VOCAB, (6,)).astype(np.int32),
+            max_new_tokens=9)) for _ in range(3)]
+        eng.run_until_idle(timeout=300)
+        assert all(r.state == Request.DONE for r in reqs)
+        sd = eng.metrics.snapshot()["spec_decode"]
+        # a mostly-wrong draft must have had lookahead pages rolled back
+        assert sd["accepted"] < sd["proposed"]
+        assert sd["rollback_pages"] >= 1
+        # rolled-back pages were actually RELEASED: pool drains to empty
+        assert eng.page_state()["used"] == 0
+
+    def test_emitted_tokens_counted_once(self, model):
+        eng = _spec_engine(model, model, k=3, prefix_sharing=False)
+        r = eng.submit(Request(np.arange(1, 6, dtype=np.int32),
+                               max_new_tokens=8))
+        eng.run_until_idle(timeout=300)
+        assert r.state == Request.DONE
+        assert eng.metrics.tokens_generated == 8
+        sd = eng.metrics.snapshot()["spec_decode"]
+        # the first token is sampled by prefill; spec emits the rest
+        assert sd["emitted"] == 7
+
+    def test_spec_requires_paged_layout(self, model):
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatchingEngine(
+                model, max_seq_len=32, n_slots=2, kv_layout="slot",
+                spec_decode=SpecDecodeConfig(model, k=2))
+
+    def test_bounded_compile(self, model):
+        """Spec adds its OWN bounded program set (draft prefill buckets +
+        draft step + verify) without disturbing the engine's gauge."""
+        eng = _spec_engine(model, model, k=3)
+        rng = np.random.default_rng(5)
+        for _ in range(2):
+            reqs = [eng.submit(Request(
+                rng.integers(0, VOCAB, (5,)).astype(np.int32),
+                max_new_tokens=6)) for _ in range(3)]
+            eng.run_until_idle(timeout=300)
+            assert all(r.state == Request.DONE for r in reqs)
+        assert eng.trace_counts["step"] <= 1  # plain step possibly unused
+        sc = eng._spec.trace_counts
+        assert sc["verify"] == 1
+        assert sc["draft_step"] == 1
+        assert sc["draft_prefill"] <= len(eng.chunk_buckets)
+
+
+class TestSpecVerifySeam:
+    def _run(self, model, sched=None):
+        eng = _spec_engine(model, model, k=3)
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(0, VOCAB, (l,)).astype(np.int32)
+                   for l in [5, 7, 4]]
+        reqs = [eng.submit(Request(p, max_new_tokens=n,
+                                   request_id=f"r{i}"))
+                for i, (p, n) in enumerate(zip(prompts, [8, 6, 7]))]
+        if sched is not None:
+            with sched:
+                eng.run_until_idle(timeout=300)
+        else:
+            eng.run_until_idle(timeout=300)
+        return eng, reqs
+
+    def test_fault_fails_only_victim_and_falls_back(self, model):
+        _, base = self._run(model)
+        want = [np.asarray(r.result()) for r in base]
+        s = FaultSchedule().add("serving.spec.verify", "raise", at=2)
+        eng, got = self._run(model, s)
+        failed = [r for r in got if r.state == Request.FAILED]
+        done = [r for r in got if r.state == Request.DONE]
+        assert len(failed) == 1 and len(done) == 2
+        assert "speculative verify failed" in failed[0].error
+        # survivors fell back to plain decode that tick AND stayed exact
+        sd = eng.metrics.snapshot()["spec_decode"]
+        assert sd["fallback_ticks"] >= 1
+        for r, w in zip(got, want):
+            if r.state == Request.DONE:
+                np.testing.assert_array_equal(np.asarray(r.result()), w)
+        # the seam labels the victim
+        (f,) = s.fired_log()
+        assert f["point"] == "serving.spec.verify"
+        assert failed[0].request_id == f["labels"]["request_id"]
+
+    def test_two_run_replay_certificate(self, model):
+        """Same schedule, two runs: identical fired logs, identical
+        terminal states, identical survivor transcripts."""
+        s1 = FaultSchedule().add("serving.spec.verify", "raise", at=2)
+        _, got1 = self._run(model, s1)
+        s2 = FaultSchedule().add("serving.spec.verify", "raise", at=2)
+        _, got2 = self._run(model, s2)
+        assert s1.fired_log() == s2.fired_log()
+        for a, b in zip(got1, got2):
+            assert a.state == b.state
+            if a.state == Request.DONE:
+                np.testing.assert_array_equal(
+                    np.asarray(a.result()), np.asarray(b.result()))
+
+
+class TestSpecConfigValidation:
+    def test_k_must_be_positive(self, model):
+        with pytest.raises(ValueError):
+            SpecDecodeConfig(model, k=0)
+
+    def test_vocab_mismatch_rejected(self, model):
+        paddle.seed(7)
+        cfg = gpt_config("gpt2-small", vocab_size=32, hidden_size=32,
+                         num_layers=2, num_attention_heads=4,
+                         max_position_embeddings=64,
+                         hidden_dropout_prob=0.0,
+                         attention_dropout_prob=0.0)
+        bad = GPTForPretraining(cfg)
+        bad.eval()
+        with pytest.raises(ValueError, match="vocab"):
+            _spec_engine(model, bad)
