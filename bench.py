@@ -497,6 +497,34 @@ def _host_analysis():
     return out
 
 
+def _determinism_lint():
+    """Determinism-doctor secondary (ISSUE 19): host-plane finding counts
+    by severity (the jaxpr key-flow plane already rides the default-rule
+    counts in ``_analysis_overhead``) plus the replay-certificate seam
+    coverage — ``det_findings_high``/``det_findings_medium`` and
+    ``det_seams_uncovered`` are count_max baseline classes, so a PR that
+    re-introduces a HIGH determinism hazard or strands an inject seam
+    without its twin certificate regresses past the lineage maximum and
+    gates."""
+    from paddle_tpu.analysis import analyze_determinism
+
+    report = analyze_determinism()
+    counts = report.counts()
+    cov = report.meta.get("seam_coverage", {})
+    return {
+        "det_modules": report.meta["n_modules"],
+        "det_lint_s": report.meta["scan_s"],
+        "det_findings_high": counts["HIGH"],
+        "det_findings_medium": counts["MEDIUM"],
+        "det_findings_low": counts["LOW"],
+        "det_findings_info": counts["INFO"],
+        "det_seam_points": cov.get("n_points", 0),
+        "det_seams_covered": cov.get("n_covered", 0),
+        "det_seams_uncovered": (cov.get("n_points", 0)
+                                - cov.get("n_covered", 0)),
+    }
+
+
 def _planner_search(on_tpu):
     """Auto-parallel planner v2 secondary (ISSUE 13): search wall time and
     candidate accounting for a real search (every analysis-priced row is a
@@ -1802,6 +1830,11 @@ def main():
         except Exception as e:  # pragma: no cover - device dependent
             secondary["host_analysis_lint_s"] = f"failed: {type(e).__name__}"
         try:
+            # determinism doctor: host findings + seam coverage (ISSUE 19)
+            secondary.update(_determinism_lint())
+        except Exception as e:  # pragma: no cover - device dependent
+            secondary["det_lint_s"] = f"failed: {type(e).__name__}"
+        try:
             # robustness: replica-kill failover recovery time (ISSUE 6)
             secondary.update(_router_failover(True))
         except Exception as e:  # pragma: no cover - device dependent
@@ -1907,6 +1940,10 @@ def main():
             secondary.update(_host_analysis())
         except Exception as e:  # pragma: no cover
             secondary["host_analysis_lint_s"] = f"failed: {type(e).__name__}"
+        try:
+            secondary.update(_determinism_lint())
+        except Exception as e:  # pragma: no cover
+            secondary["det_lint_s"] = f"failed: {type(e).__name__}"
         try:
             secondary.update(_router_failover(False))
         except Exception as e:  # pragma: no cover

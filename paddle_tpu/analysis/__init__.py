@@ -21,9 +21,13 @@ Quick use::
 
 ``python -m paddle_tpu.analysis`` lints every shipped entry point and
 writes ``benchmarks/analysis_report.json``; ``--memory`` adds the
-liveness-based peak-HBM report (``analysis_memory.json``) and
+liveness-based peak-HBM report (``analysis_memory.json``),
 ``--sanitize`` replays each entry point eqn-by-eqn hunting the first
-non-finite intermediate (``FLAGS_check_nan_inf`` parity with *where*).
+non-finite intermediate (``FLAGS_check_nan_inf`` parity with *where*),
+and ``--determinism`` runs the determinism doctor: PRNG key-flow lint +
+host-nondeterminism rules + replay-certificate seam coverage
+(``paddle.seed`` / ``FLAGS_cudnn_deterministic`` parity), with
+``--bisect-demo`` exercising the twin-replay divergence bisector.
 """
 from .findings import (
     AnalysisReport,
@@ -85,6 +89,27 @@ from .sanitizer import (
     sanitize,
     sanitize_target,
 )
+from .keyflow import (
+    ClosureKeyRule,
+    KeyDiscardRule,
+    KeyReuseRule,
+    NonuniformKeyRule,
+    keyflow_rules,
+)
+from .determinism import (
+    analyze_determinism,
+    coverage_findings,
+    run_det_rules,
+    seam_coverage,
+)
+from .bisect import (
+    BisectConfig,
+    BisectResult,
+    DivergenceReport,
+    bisect_runs,
+    demo_divergence,
+    diff_fired_logs,
+)
 from .traceguard import RecompileEvent, TraceGuard
 
 __all__ = [
@@ -134,6 +159,21 @@ __all__ = [
     "CollectiveOrderRule",
     "ShardingPropagationRule",
     "ProgramRule",
+    "KeyReuseRule",
+    "KeyDiscardRule",
+    "ClosureKeyRule",
+    "NonuniformKeyRule",
+    "keyflow_rules",
+    "analyze_determinism",
+    "run_det_rules",
+    "seam_coverage",
+    "coverage_findings",
+    "BisectConfig",
+    "BisectResult",
+    "DivergenceReport",
+    "bisect_runs",
+    "demo_divergence",
+    "diff_fired_logs",
     "TraceGuard",
     "RecompileEvent",
 ]

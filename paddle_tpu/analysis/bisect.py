@@ -1,0 +1,475 @@
+"""Divergence bisector: localize the first differing eqn between two
+supposedly-identical runs (sanitizer-style twin replay, r10).
+
+When a twin test goes red — two runs under one fault schedule that should
+be bit-identical but aren't — the fired-log diff says *that* they
+diverged; this module says *where*.  Both transcripts are replayed
+through ONE jaxpr eqn-by-eqn (two environments threaded side by side),
+every output pair is compared bitwise ON DEVICE, and the host syncs the
+difference flags in chunks of ``check_every`` eqns — the exact execution
+strategy of the r10 NaN attributor, with equality in place of
+``isfinite``.  The first diverging eqn is reported with its profiler
+scope (r6 name_stack), source line, control-flow path, tick index and —
+inside scan/while — the iteration.
+
+Control flow descends structurally (pjit/cond/scan/while): a *control*
+divergence (the two runs disagree on a cond predicate or a while
+continuation) is reported at the container eqn itself, which is exactly
+the "rank-divergent branch" failure mode the key-flow rules guard
+against.
+
+:func:`diff_fired_logs` is the host-side half: first differing entry of
+two replay certificates.  :func:`demo_divergence` builds the CLI demo —
+a sampled serving-style decode loop whose key chain is deliberately
+desynced at one tick, then localized back to that tick's first drawing
+eqn under the ``serving.sample`` scope.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .graph import _jcore, _name_stack_of, _source_of
+from .sanitizer import _bind_whole, _closed_parts
+
+__all__ = [
+    "BISECT_SCHEMA_VERSION",
+    "BisectConfig",
+    "DivergenceReport",
+    "BisectResult",
+    "bisect_runs",
+    "diff_fired_logs",
+    "demo_divergence",
+]
+
+#: layout version of the bisector's JSON block
+BISECT_SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass
+class BisectConfig:
+    check_every: int = 32          # device→host sync chunk (r10 idiom)
+    recurse: bool = True
+    max_while_iters: int = 100_000
+
+
+@dataclasses.dataclass
+class DivergenceReport:
+    """First diverging value (or control decision), attributed."""
+
+    tick: int                      # index into the transcript pairs
+    eqn_index: int                 # flattened replay order within the tick
+    prim: str
+    path: Tuple[str, ...]
+    scope: str                     # r6 profiler name_stack
+    source: str                    # file:line (function)
+    out_slot: int
+    shape: Tuple[int, ...]
+    dtype: str
+    n_diff: int
+    n_total: int
+    kind: str = "value"            # "value" | "control" | "input"
+    iteration: Optional[int] = None
+
+    @property
+    def where(self) -> str:
+        return " @ ".join(x for x in (self.scope, self.source) if x)
+
+    def __str__(self):
+        it = f" (iteration {self.iteration})" if self.iteration is not None \
+            else ""
+        loc = f" [{self.where}]" if self.where else ""
+        if self.kind == "control":
+            return (f"runs diverge at tick {self.tick}: control decision "
+                    f"of eqn #{self.eqn_index} '{self.prim}'{it} "
+                    f"differs{loc}")
+        if self.kind == "input":
+            return (f"runs diverge at tick {self.tick}: entry argument "
+                    f"{self.out_slot} ({self.dtype}{list(self.shape)}) "
+                    f"already differs — {self.n_diff}/{self.n_total} "
+                    f"elements")
+        return (f"runs diverge at tick {self.tick}: first diverging "
+                f"value from eqn #{self.eqn_index} '{self.prim}'{it}: "
+                f"{self.n_diff}/{self.n_total} elements in output "
+                f"{self.out_slot} {self.dtype}{list(self.shape)}{loc}")
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["path"] = list(self.path)
+        d["shape"] = list(self.shape)
+        d["where"] = self.where
+        d["schema_version"] = BISECT_SCHEMA_VERSION
+        return d
+
+
+@dataclasses.dataclass
+class BisectResult:
+    first: Optional[DivergenceReport]
+    checked_ticks: int
+    checked_eqns: int
+
+    @property
+    def identical(self) -> bool:
+        return self.first is None
+
+    def to_dict(self) -> dict:
+        return {"identical": self.identical,
+                "checked_ticks": self.checked_ticks,
+                "checked_eqns": self.checked_eqns,
+                "first_divergence": (self.first.to_dict()
+                                     if self.first else None)}
+
+
+class _Stop(Exception):
+    pass
+
+
+def _key_data(x):
+    """Comparable view: typed PRNG keys expose their uint32 words."""
+    import jax
+
+    dt = getattr(x, "dtype", None)
+    if dt is not None and str(dt).startswith("key<"):
+        return jax.random.key_data(x)
+    return x
+
+
+def _neq_count(a, b):
+    """Device scalar: element count where a != b (bitwise; NaN==NaN)."""
+    import jax.numpy as jnp
+
+    a, b = _key_data(a), _key_data(b)
+    try:
+        ne = a != b
+    except TypeError:
+        return jnp.asarray(int(not (a == b)))
+    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+        both_nan = jnp.isnan(a) & jnp.isnan(b)
+        ne = ne & ~both_nan
+    return jnp.sum(ne)
+
+
+class _State:
+    def __init__(self, config: BisectConfig, tick: int):
+        self.config = config
+        self.tick = tick
+        self.eqn_counter = 0
+        self.pending: List[tuple] = []   # (count_dev, meta)
+        self.report: Optional[DivergenceReport] = None
+
+    def check(self, eqn, outs_a, outs_b, path, iteration):
+        idx = self.eqn_counter
+        self.eqn_counter += 1
+        for slot, (a, b) in enumerate(zip(outs_a, outs_b)):
+            n_total = 1
+            for s in np.shape(_key_data(a)):
+                n_total *= int(s)
+            meta = (idx, eqn.primitive.name, path, _name_stack_of(eqn),
+                    _source_of(eqn), slot, tuple(np.shape(a)),
+                    str(getattr(a, "dtype", type(a).__name__)),
+                    max(n_total, 1), iteration)
+            self.pending.append((_neq_count(a, b), meta))
+        if len(self.pending) >= self.config.check_every:
+            self.flush()
+
+    def flush(self):
+        if not self.pending:
+            return
+        import jax.numpy as jnp
+
+        counts = np.asarray(jnp.stack([c for c, _ in self.pending]))
+        pending, self.pending = self.pending, []
+        for n_diff, (_, meta) in zip(counts, pending):
+            if int(n_diff) == 0:
+                continue
+            (idx, prim, path, scope, source, slot, shape, dtype,
+             n_total, iteration) = meta
+            self.report = DivergenceReport(
+                tick=self.tick, eqn_index=idx, prim=prim, path=path,
+                scope=scope, source=source, out_slot=slot, shape=shape,
+                dtype=dtype, n_diff=int(n_diff), n_total=n_total,
+                iteration=iteration)
+            raise _Stop()
+
+    def control(self, eqn, path, iteration, tag):
+        """The two runs took different control decisions: everything
+        downstream is incomparable — the container IS the divergence.
+        Earlier pending values might still hold the first difference,
+        so flush before reporting."""
+        self.flush()
+        self.report = DivergenceReport(
+            tick=self.tick, eqn_index=self.eqn_counter,
+            prim=eqn.primitive.name, path=path,
+            scope=_name_stack_of(eqn), source=_source_of(eqn),
+            out_slot=0, shape=(), dtype=tag, n_diff=1, n_total=1,
+            kind="control", iteration=iteration)
+        raise _Stop()
+
+
+def _replay2(jaxpr, consts, args_a, args_b, state: _State, path,
+             iteration=None):
+    env_a, env_b = {}, {}
+
+    def read(env, v):
+        return v.val if isinstance(v, _jcore.Literal) else env[v]
+
+    def write(env, vs, vals):
+        for v, val in zip(vs, vals):
+            env[v] = val
+
+    write(env_a, jaxpr.constvars, consts)
+    write(env_b, jaxpr.constvars, consts)
+    write(env_a, jaxpr.invars, args_a)
+    write(env_b, jaxpr.invars, args_b)
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        in_a = [read(env_a, v) for v in eqn.invars]
+        in_b = [read(env_b, v) for v in eqn.invars]
+        outs = None
+        if state.config.recurse:
+            try:
+                outs = _replay2_structured(eqn, prim, in_a, in_b, state,
+                                           path, iteration)
+            except _Stop:
+                raise
+            except Exception:
+                # partial-descent flags are real computations: drain them
+                # before falling back (mirrors the r10 fallback contract)
+                state.flush()
+                outs = None
+        if outs is None:
+            oa = _bind_whole(eqn, in_a)
+            ob = _bind_whole(eqn, in_b)
+            state.check(eqn, oa, ob, path, iteration)
+            outs = (oa, ob)
+        write(env_a, eqn.outvars, outs[0])
+        write(env_b, eqn.outvars, outs[1])
+    return ([read(env_a, v) for v in jaxpr.outvars],
+            [read(env_b, v) for v in jaxpr.outvars])
+
+
+def _replay2_structured(eqn, prim, in_a, in_b, state, path, iteration):
+    import jax.numpy as jnp
+
+    params = eqn.params
+    if prim == "pjit":
+        inner, iconsts = _closed_parts(params["jaxpr"])
+        name = params.get("name", "")
+        return _replay2(inner, iconsts, in_a, in_b, state,
+                        path + (f"pjit:{name}",), iteration)
+
+    if prim == "cond":
+        ia = int(np.clip(int(np.asarray(in_a[0])), 0,
+                         len(params["branches"]) - 1))
+        ib = int(np.clip(int(np.asarray(in_b[0])), 0,
+                         len(params["branches"]) - 1))
+        if ia != ib:
+            state.control(eqn, path, iteration, "branch-index")
+        inner, iconsts = _closed_parts(params["branches"][ia])
+        state.eqn_counter += 1
+        return _replay2(inner, iconsts, in_a[1:], in_b[1:], state,
+                        path + (f"cond.branch{ia}",), iteration)
+
+    if prim == "scan":
+        nc = params.get("num_consts", 0)
+        nk = params.get("num_carry", 0)
+        length = int(params.get("length", 0))
+        reverse = bool(params.get("reverse", False))
+        inner, iconsts = _closed_parts(params["jaxpr"])
+        ca, cb = list(in_a[nc:nc + nk]), list(in_b[nc:nc + nk])
+        xs_a, xs_b = in_a[nc + nk:], in_b[nc + nk:]
+        ys_a = ys_b = None
+        state.eqn_counter += 1
+        order = range(length - 1, -1, -1) if reverse else range(length)
+        for t in order:
+            oa, ob = _replay2(
+                inner, iconsts,
+                in_a[:nc] + ca + [x[t] for x in xs_a],
+                in_b[:nc] + cb + [x[t] for x in xs_b],
+                state, path + ("scan",), iteration=t)
+            ca, cb = list(oa[:nk]), list(ob[:nk])
+            if ys_a is None:
+                ys_a = [[] for _ in oa[nk:]]
+                ys_b = [[] for _ in ob[nk:]]
+            for acc, y in zip(ys_a, oa[nk:]):
+                acc.append(y)
+            for acc, y in zip(ys_b, ob[nk:]):
+                acc.append(y)
+        n_ys = len(eqn.outvars) - nk
+        if ys_a is None:
+            ys_a = [[] for _ in range(n_ys)]
+            ys_b = [[] for _ in range(n_ys)]
+
+        def stack(accs, side):
+            out = []
+            for j, acc in enumerate(accs):
+                if reverse:
+                    acc = acc[::-1]
+                if acc:
+                    out.append(jnp.stack(acc))
+                else:
+                    ov = eqn.outvars[nk + j].aval
+                    out.append(jnp.zeros(ov.shape, ov.dtype))
+            return out
+
+        return (ca + stack(ys_a, 0), cb + stack(ys_b, 1))
+
+    if prim == "while":
+        cn = params.get("cond_nconsts", 0)
+        bn = params.get("body_nconsts", 0)
+        cond_j, cond_c = _closed_parts(params["cond_jaxpr"])
+        body_j, body_c = _closed_parts(params["body_jaxpr"])
+        ca, cb = list(in_a[cn + bn:]), list(in_b[cn + bn:])
+        state.eqn_counter += 1
+        it = 0
+        while True:
+            pa, pb = _replay2(cond_j, cond_c,
+                              in_a[:cn] + ca, in_b[:cn] + cb,
+                              state, path + ("while.cond",), iteration=it)
+            cont_a = bool(np.asarray(pa[0]))
+            cont_b = bool(np.asarray(pb[0]))
+            if cont_a != cont_b:
+                state.control(eqn, path, it, "while-continuation")
+            if not cont_a:
+                break
+            oa, ob = _replay2(body_j, body_c,
+                              in_a[cn:cn + bn] + ca,
+                              in_b[cn:cn + bn] + cb,
+                              state, path + ("while.body",), iteration=it)
+            ca, cb = list(oa), list(ob)
+            it += 1
+            if it >= state.config.max_while_iters:
+                raise RuntimeError(
+                    f"bisect: while loop exceeded "
+                    f"{state.config.max_while_iters} iterations")
+        return (ca, cb)
+
+    if prim != "shard_map":
+        for key in ("call_jaxpr", "fun_jaxpr", "jaxpr"):
+            sub = params.get(key)
+            if sub is None:
+                continue
+            inner, iconsts = _closed_parts(sub)
+            if (len(inner.invars) == len(in_a)
+                    and len(inner.outvars) == len(eqn.outvars)):
+                state.eqn_counter += 1
+                return _replay2(inner, iconsts, in_a, in_b, state,
+                                path + (prim,), iteration)
+    return None
+
+
+def _flatten(args, kwargs=None):
+    import jax
+
+    return [a._data if hasattr(a, "_data") else a
+            for a in jax.tree_util.tree_leaves((tuple(args),
+                                                kwargs or {}))]
+
+
+def bisect_runs(fn: Callable, ticks_a: Sequence[Sequence],
+                ticks_b: Sequence[Sequence],
+                config: Optional[BisectConfig] = None) -> BisectResult:
+    """Replay two per-tick transcripts of ``fn`` side by side and report
+    the first diverging eqn (+ tick, scope, source).
+
+    ``ticks_a``/``ticks_b`` are equal-length sequences of argument tuples
+    — one entry per tick of the run (e.g. per decode step).  The jaxpr is
+    traced once from tick 0 and reused: identical transcripts by
+    construction run the identical program.  A tick whose *inputs*
+    already differ still descends, so the report names the first eqn that
+    *computes* on the divergent state (usually the key consumer) rather
+    than just the arg index; entry-arg divergence is recoverable from the
+    report's path being empty and eqn 0.
+    """
+    import jax
+
+    if len(ticks_a) != len(ticks_b):
+        raise ValueError(
+            f"transcripts must pair tick-for-tick: {len(ticks_a)} vs "
+            f"{len(ticks_b)} ticks")
+    config = config or BisectConfig()
+    closed = None
+    checked_eqns = 0
+    for t, (a, b) in enumerate(zip(ticks_a, ticks_b)):
+        if closed is None:
+            closed = jax.make_jaxpr(fn)(*a)
+        state = _State(config, t)
+        try:
+            _replay2(closed.jaxpr, list(closed.consts),
+                     _flatten(a), _flatten(b), state, ())
+            state.flush()
+        except _Stop:
+            checked_eqns += state.eqn_counter
+            return BisectResult(first=state.report, checked_ticks=t + 1,
+                                checked_eqns=checked_eqns)
+        checked_eqns += state.eqn_counter
+    return BisectResult(first=None, checked_ticks=len(ticks_a),
+                        checked_eqns=checked_eqns)
+
+
+def diff_fired_logs(log_a: Sequence[dict], log_b: Sequence[dict]
+                    ) -> Optional[dict]:
+    """First differing entry of two replay certificates (or None)."""
+    for i, (a, b) in enumerate(zip(log_a, log_b)):
+        if a != b:
+            keys = sorted(set(a) | set(b))
+            fields = [k for k in keys if a.get(k) != b.get(k)]
+            return {"index": i, "a": a, "b": b, "fields": fields}
+    if len(log_a) != len(log_b):
+        i = min(len(log_a), len(log_b))
+        longer = log_a if len(log_a) > len(log_b) else log_b
+        return {"index": i,
+                "a": log_a[i] if i < len(log_a) else None,
+                "b": log_b[i] if i < len(log_b) else None,
+                "fields": ["length"],
+                "extra_in": "a" if longer is log_a else "b",
+                "lengths": [len(log_a), len(log_b)]}
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the CLI demo: a planted key-chain desync in a sampled decode loop
+# ---------------------------------------------------------------------------
+def demo_divergence(n_ticks: int = 6, desync_tick: int = 3,
+                    seed: int = 0, vocab: int = 64,
+                    config: Optional[BisectConfig] = None) -> BisectResult:
+    """Serving-shaped repro: a per-tick sampled decode step (logits →
+    split → categorical under the ``serving.sample`` scope).  Transcript
+    B's key chain is fold_in-desynced at ``desync_tick``; the bisector
+    must localize the first diverging eqn to that exact tick, inside the
+    ``serving.sample`` scope, at the drawing prim."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..profiler.scope import scope
+
+    table = jax.random.normal(jax.random.PRNGKey(seed + 1), (vocab, vocab))
+
+    def step(tok, key):
+        with scope("serving.decode"):
+            logits = table[tok] * 1.5
+        with scope("serving.sample"):
+            k_next, k_draw = jax.random.split(key)
+            # int32 regardless of the x64 mode: the eager transcript must
+            # feed the jaxpr traced from tick 0 at every later tick
+            nxt = jax.random.categorical(k_draw, logits).astype(jnp.int32)
+        return nxt, k_next
+
+    def transcript(desync_at=None):
+        ticks = []
+        tok = jnp.asarray(0, jnp.int32)
+        key = jax.random.PRNGKey(seed)
+        for t in range(n_ticks):
+            if t == desync_at:
+                # the planted bug: one run folds an extra derivation into
+                # the chain (a lost fast_forward join, a double fold_in)
+                key = jax.random.fold_in(key, 1)
+            ticks.append((tok, key))
+            tok, key = step(tok, key)
+        return ticks
+
+    return bisect_runs(step, transcript(None), transcript(desync_tick),
+                       config=config)

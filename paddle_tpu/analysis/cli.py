@@ -11,7 +11,11 @@ three modes:
   point (+ the planner-drift cross-check) →
   ``benchmarks/analysis_memory.json``;
 * ``--sanitize``   — eqn-by-eqn non-finite replay of every entry point
-  with its example args → ``benchmarks/analysis_sanitize.json``.
+  with its example args → ``benchmarks/analysis_sanitize.json``;
+* ``--determinism`` — the determinism doctor: PRNG key-flow lint over
+  every entry point (jaxpr plane) + host-nondeterminism AST rules +
+  replay-certificate seam coverage; ``--bisect-demo`` appends a planted
+  key-desync localization → ``benchmarks/analysis_determinism.json``.
 
 ``--device-budget <bytes>`` re-parameterizes the memory rules so an
 ``oom-risk`` HIGH against YOUR chip gates exit-1.  Unknown primitives hit
@@ -98,6 +102,20 @@ def main(argv=None) -> int:
                              "into the static graph (default: the "
                              "committed benchmarks/hostrace_journal.json "
                              "when present; 'none' disables the merge)")
+    mode.add_argument("--determinism", action="store_true",
+                      help="determinism doctor: PRNG key-flow lint over "
+                           "every entry point + host-nondeterminism AST "
+                           "rules + replay-certificate seam coverage "
+                           "(writes analysis_determinism.json)")
+    parser.add_argument("--bisect-demo", action="store_true",
+                        help="--determinism: run the divergence-bisector "
+                             "demo (planted key-chain desync in a sampled "
+                             "decode loop) and append its localization to "
+                             "the artifact")
+    parser.add_argument("--bisect-tick", type=int, default=3,
+                        metavar="T",
+                        help="--bisect-demo: tick at which to plant the "
+                             "key desync (default 3)")
     mode.add_argument("--plan", action="store_true",
                       help="auto-parallel planner v2: enumerate dp/mp/pp/"
                            "ZeRO/remat candidates, price each on a lowered "
@@ -153,6 +171,8 @@ def main(argv=None) -> int:
     if (args.host_only or args.host_path or args.host_journal) \
             and not args.host:
         parser.error("--host-* options apply to --host")
+    if args.bisect_demo and not args.determinism:
+        parser.error("--bisect-demo applies to --determinism")
     # NOTE: platform/device-count env setup lives in __main__.py (re-exec
     # before jax initializes); mutating os.environ here would be both too
     # late for this process and a leak into child processes.
@@ -164,6 +184,8 @@ def main(argv=None) -> int:
         return _host_mode(args)
     if args.plan:
         return _plan_mode(args)
+    if args.determinism:
+        return _determinism_mode(args)
 
     import jax
 
@@ -283,6 +305,76 @@ def _host_mode(args) -> int:
     counts = report.counts()
     print()
     print("findings:", ", ".join(f"{k}={v}" for k, v in counts.items()))
+    if args.fail_on != "never":
+        gate = Severity[args.fail_on.upper()]
+        if report.at_least(gate):
+            return 1
+    return 0
+
+
+def _determinism_mode(args) -> int:
+    """``--determinism``: the determinism doctor.
+
+    Three planes in one artifact: the key-flow lint (jaxpr) over every
+    shipped entry point, the host-nondeterminism AST rules with their
+    ``# det-ok:`` downgrades, and the replay-certificate seam coverage
+    audit (every ``resilience/inject.py`` seam must be pinned by a
+    two-run identical-fired-log twin test).  ``--bisect-demo`` appends a
+    planted key-chain desync localized by :mod:`.bisect` to its exact
+    tick / eqn / profiler scope.  Exit contract mirrors the jaxpr lint:
+    1 when any finding reaches ``--fail-on`` (default HIGH), 2 when an
+    entry point could not be built."""
+    import jax
+
+    from .determinism import analyze_determinism
+    from .entrypoints import shipped_entry_points
+    from .findings import Severity
+    from .keyflow import keyflow_rules
+    from .rules import analyze_targets
+
+    t0 = time.perf_counter()
+    # host plane first: pure AST, doubles as the inject-registry audit
+    report = analyze_determinism()
+    # jaxpr plane: the four key-flow rules over every shipped program
+    targets, errors = shipped_entry_points(
+        skip_errors=True, only=tuple(args.only))
+    rules = keyflow_rules()
+    kf = analyze_targets(targets, rules=rules, meta={})
+    report.extend(kf.findings)
+    report.meta.update(
+        tool="paddle_tpu.analysis --determinism",
+        backend=jax.default_backend(), n_devices=len(jax.devices()),
+        build_errors=errors,
+        entry_points=[t.name for t in targets],
+        keyflow_rules=[r.name for r in rules])
+
+    extra = {}
+    if args.bisect_demo:
+        from .bisect import demo_divergence
+
+        res = demo_divergence(desync_tick=args.bisect_tick)
+        extra["bisect_demo"] = dict(res.to_dict(),
+                                    planted_tick=args.bisect_tick)
+        print("bisect demo:",
+              str(res.first) if res.first is not None else "identical")
+
+    report.meta["total_s"] = round(time.perf_counter() - t0, 3)
+    out = args.out or _default_out("analysis_determinism.json")
+    _save_json(out, dict(report.to_dict(), **extra))
+    cov = report.meta.get("seam_coverage", {})
+    print(f"determinism: {len(targets)} entry points, "
+          f"{report.meta['n_modules']} host modules, seam coverage "
+          f"{cov.get('n_covered', '?')}/{cov.get('n_points', '?')} in "
+          f"{report.meta['total_s']}s -> {out}")
+    for name, err in errors.items():
+        print(f"  BUILD FAILED {name}: {err}")
+    print()
+    print(report.table())
+    counts = report.counts()
+    print()
+    print("findings:", ", ".join(f"{k}={v}" for k, v in counts.items()))
+    if errors and not args.keep_going:
+        return 2
     if args.fail_on != "never":
         gate = Severity[args.fail_on.upper()]
         if report.at_least(gate):

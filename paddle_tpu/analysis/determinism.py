@@ -1,0 +1,664 @@
+"""Host-plane determinism rules + replay-certificate (seam) coverage.
+
+The jaxpr half of the determinism doctor lives in
+:mod:`paddle_tpu.analysis.keyflow`; this module is the HOST half, on the
+r18 lockmodel machinery's turf (same module set, same annotation
+philosophy as ``# hostrace:``):
+
+* ``det-unordered-iter`` — iteration over a ``set``/``frozenset`` (or a
+  ``next(iter(...))`` pick from one) feeding code in the serving /
+  resilience planes.  CPython dicts iterate in insertion order, so the
+  only iteration-order nondeterminism that can enter this codebase is a
+  set — HIGH inside an ordering-decision function (tick/admit/schedule/
+  route/...), MEDIUM elsewhere.
+* ``det-wallclock`` — ``time.time``/``monotonic``/``perf_counter``
+  influencing control flow inside an ordering-decision function: replay
+  of the same transcript takes a different branch on a slower machine.
+* ``det-ambient-rng`` — ambient ``random.*`` (the module-global stream),
+  ``os.urandom``/``secrets``, ``uuid.uuid4`` and builtin ``hash()`` in
+  the scanned planes.  ``random.Random(seed)`` instances are the
+  sanctioned spelling and are exempt.
+
+Audited intentional uses carry ``# det-ok: <reason>`` on the offending
+line (or a comment-only line directly above, exactly like ``hostrace:``);
+a suppressed site is reported at INFO with its reason so the audit trail
+stays in the artifact.
+
+**Replay-certificate coverage** (:func:`seam_coverage`): every seam name
+registered in ``resilience/inject.py::POINTS`` must be (a) actually fired
+somewhere in the package and (b) exercised by at least one *two-run
+identical-fired-log twin test* — a test that runs a workload twice under
+one schedule and asserts the ``fired_log()`` transcripts equal.  The scan
+is static (AST over ``paddle_tpu/`` fire sites and ``tests/``), so a new
+seam cannot land uncertified: uncovered ⇒ HIGH, fired-but-unregistered or
+registered-but-never-fired ⇒ MEDIUM.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import time
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .findings import AnalysisReport, Finding, Severity
+from .lockmodel import default_host_paths
+
+__all__ = [
+    "DET_SCHEMA_VERSION",
+    "DetFileContext",
+    "det_rule_names",
+    "run_det_rules",
+    "seam_coverage",
+    "coverage_findings",
+    "analyze_determinism",
+]
+
+#: layout version of benchmarks/analysis_determinism.json
+DET_SCHEMA_VERSION = 1
+
+_DET_OK_RE = re.compile(r"#\s*det-ok:\s*(.*\S)")
+
+#: function names that make an ordering DECISION (who runs / in what
+#: order / who is evicted) — wall-clock or set-order inside these changes
+#: the schedule itself, not just a metric
+_ORDER_RE = re.compile(
+    r"(tick|admit|schedul|rout|pick|select|take_|victim|sweep|assign|"
+    r"shed|evict|order)", re.I)
+
+_CLOCK_CALLS = {("time", "time"), ("time", "monotonic"),
+                ("time", "perf_counter"), ("time", "time_ns"),
+                ("time", "monotonic_ns"), ("time", "perf_counter_ns")}
+
+
+class _DetAnnotations:
+    """``# det-ok: reason`` sites (line → reason), with the same binding
+    rule as the r18 hostrace annotations: a trailing comment binds to its
+    own statement; a comment-ONLY line binds to the statement below."""
+
+    def __init__(self, source: str):
+        self.ok: Dict[int, str] = {}
+        self.comment_only: Set[int] = set()
+        for i, text in enumerate(source.splitlines(), start=1):
+            if text.lstrip().startswith("#"):
+                self.comment_only.add(i)
+            m = _DET_OK_RE.search(text)
+            if m:
+                self.ok[i] = m.group(1).strip()
+
+    def reason_at(self, line: int) -> Optional[str]:
+        if line in self.ok:
+            return self.ok[line]
+        # a contiguous comment-only block directly above binds to this
+        # statement (multi-line reasons read naturally)
+        ln = line - 1
+        while ln in self.comment_only:
+            if ln in self.ok:
+                return self.ok[ln]
+            ln -= 1
+        return None
+
+
+class DetFileContext:
+    """One scanned module: parsed tree + annotations + attribution."""
+
+    def __init__(self, modname: str, path: str):
+        self.modname = modname
+        self.path = path
+        with open(path, "r") as fh:
+            self.source = fh.read()
+        self.tree = ast.parse(self.source)
+        self.ann = _DetAnnotations(self.source)
+        self._func_of: Dict[int, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                end = getattr(node, "end_lineno", node.lineno)
+                for ln in range(node.lineno, end + 1):
+                    # innermost wins: later (nested) defs overwrite
+                    self._func_of.setdefault(ln, node.name)
+
+    def func_at(self, line: int) -> str:
+        return self._func_of.get(line, "<module>")
+
+    def where(self, line: int) -> Tuple[str, str]:
+        fn = self.func_at(line)
+        return (f"{self.modname}:{fn}",
+                f"{os.path.basename(self.path)}:{line} ({fn})")
+
+
+def _call_name(func: ast.AST) -> Tuple[Optional[str], Optional[str]]:
+    """(base, attr) for ``base.attr(...)``, (None, name) for ``name(...)``."""
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return func.value.id, func.attr
+    if isinstance(func, ast.Name):
+        return None, func.id
+    return None, None
+
+
+def _mk(ctx: DetFileContext, rule: str, sev: Severity, line: int,
+        message: str, **details) -> Finding:
+    reason = ctx.ann.reason_at(line)
+    scope, source = ctx.where(line)
+    if reason is not None:
+        sev = Severity.INFO
+        message = f"audited (det-ok: {reason}) — {message}"
+        details["det_ok"] = reason
+    return Finding(rule=rule, severity=sev, message=message,
+                   entry_point=ctx.modname, scope=scope, source=source,
+                   details=dict(details, line=line))
+
+
+# ---------------------------------------------------------------------------
+# rule 1: unordered set iteration
+# ---------------------------------------------------------------------------
+def _set_names(fn: ast.AST) -> Set[str]:
+    """Local names bound to set-typed values inside ``fn``."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _is_set_expr(node.value, out):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(node, ast.AugAssign) and \
+                isinstance(node.target, ast.Name) and \
+                _is_set_expr(node.value, out):
+            out.add(node.target.id)
+    return out
+
+
+def _is_set_expr(node: ast.AST, set_names: Set[str]) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Name) and node.id in set_names:
+        return True
+    if isinstance(node, ast.Call):
+        base, attr = _call_name(node.func)
+        if base is None and attr in ("set", "frozenset"):
+            return True
+        # s.union(...), s.intersection(...), s.difference(...) on a set
+        if attr in ("union", "intersection", "difference",
+                    "symmetric_difference", "copy") and \
+                isinstance(node.func, ast.Attribute) and \
+                _is_set_expr(node.func.value, set_names):
+            return True
+    if isinstance(node, ast.BinOp) and \
+            isinstance(node.op, (ast.BitOr, ast.BitAnd, ast.Sub,
+                                 ast.BitXor)):
+        return _is_set_expr(node.left, set_names) or \
+            _is_set_expr(node.right, set_names)
+    return False
+
+
+def _rule_unordered_iter(ctx: DetFileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        names = _set_names(fn)
+        ordering = bool(_ORDER_RE.search(fn.name))
+        sev = Severity.HIGH if ordering else Severity.MEDIUM
+
+        def flag(node, what):
+            findings.append(_mk(
+                ctx, "det-unordered-iter", sev, node.lineno,
+                f"{what} in {'ordering-decision ' if ordering else ''}"
+                f"function '{fn.name}': set iteration order varies per "
+                f"process (PYTHONHASHSEED) — sort or use an "
+                f"insertion-ordered structure", function=fn.name))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.For) and \
+                    _is_set_expr(node.iter, names):
+                flag(node, "iteration over a set")
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.GeneratorExp, ast.DictComp)):
+                for gen in node.generators:
+                    if _is_set_expr(gen.iter, names):
+                        flag(node, "comprehension over a set")
+            elif isinstance(node, ast.Call):
+                # next(iter(s)) / min-free pick from a set
+                base, attr = _call_name(node.func)
+                if base is None and attr == "next" and node.args and \
+                        isinstance(node.args[0], ast.Call):
+                    inner = node.args[0]
+                    ib, ia = _call_name(inner.func)
+                    if ib is None and ia == "iter" and inner.args and \
+                            _is_set_expr(inner.args[0], names):
+                        flag(node, "next(iter(<set>)) pick")
+                elif base is None and attr in ("sorted", "min", "max"):
+                    continue  # order-normalizing consumers are the fix
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule 2: wall-clock influencing ordering decisions
+# ---------------------------------------------------------------------------
+def _clock_calls(fn: ast.AST) -> List[ast.Call]:
+    out = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                _call_name(node.func) in _CLOCK_CALLS:
+            out.append(node)
+    return out
+
+
+def _test_exprs(fn: ast.AST) -> List[ast.AST]:
+    """Every expression that steers control flow inside ``fn``."""
+    tests: List[ast.AST] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            tests.append(node.test)
+        elif isinstance(node, ast.Assert):
+            tests.append(node.test)
+        elif isinstance(node, (ast.ListComp, ast.SetComp,
+                               ast.GeneratorExp, ast.DictComp)):
+            for gen in node.generators:
+                tests.extend(gen.ifs)
+    return tests
+
+
+def _rule_wallclock(ctx: DetFileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _ORDER_RE.search(fn.name):
+            continue
+        clocks = _clock_calls(fn)
+        if not clocks:
+            continue
+        tests = _test_exprs(fn)
+        test_nodes = set()
+        for t in tests:
+            test_nodes.update(id(x) for x in ast.walk(t))
+        # names assigned a clock VALUE: the call itself or arithmetic on
+        # it (a clock passed as an argument to another call — telemetry
+        # spans, log records — does not make the result a time)
+        def clock_valued(e: ast.AST) -> bool:
+            if isinstance(e, ast.Call):
+                return _call_name(e.func) in _CLOCK_CALLS
+            if isinstance(e, ast.BinOp):
+                return clock_valued(e.left) or clock_valued(e.right)
+            if isinstance(e, ast.UnaryOp):
+                return clock_valued(e.operand)
+            if isinstance(e, ast.IfExp):
+                return clock_valued(e.body) or clock_valued(e.orelse)
+            return False
+
+        clock_names: Dict[str, int] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and clock_valued(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        clock_names[t.id] = node.lineno
+        flagged: Set[int] = set()
+
+        def flag(line, how):
+            if line in flagged:
+                return
+            flagged.add(line)
+            findings.append(_mk(
+                ctx, "det-wallclock", Severity.HIGH, line,
+                f"wall-clock {how} steers control flow in "
+                f"ordering-decision function '{fn.name}': replay takes a "
+                f"different branch at a different speed — thread an "
+                f"injectable 'now' (tick time) instead",
+                function=fn.name))
+
+        for c in clocks:                       # clock call inside a test
+            if id(c) in test_nodes:
+                flag(c.lineno, "call")
+        for t in tests:                        # clock-derived name in one
+            for x in ast.walk(t):
+                if isinstance(x, ast.Name) and x.id in clock_names:
+                    flag(clock_names[x.id], f"value '{x.id}'")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule 3: ambient RNG / hash / urandom
+# ---------------------------------------------------------------------------
+def _rule_ambient_rng(ctx: DetFileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        base, attr = _call_name(node.func)
+        if base == "random" and attr is not None:
+            if attr in ("Random", "SystemRandom"):
+                continue  # seeded instance: the sanctioned spelling
+            findings.append(_mk(
+                ctx, "det-ambient-rng", Severity.HIGH, node.lineno,
+                f"ambient random.{attr}(): the module-global stream is "
+                f"invisible to replay — derive from a seeded "
+                f"random.Random or the key chain", call=f"random.{attr}"))
+        elif base == "os" and attr == "urandom":
+            findings.append(_mk(
+                ctx, "det-ambient-rng", Severity.HIGH, node.lineno,
+                "os.urandom(): kernel entropy can never replay",
+                call="os.urandom"))
+        elif base == "secrets":
+            findings.append(_mk(
+                ctx, "det-ambient-rng", Severity.HIGH, node.lineno,
+                f"secrets.{attr}(): CSPRNG output can never replay",
+                call=f"secrets.{attr}"))
+        elif base == "uuid" and attr in ("uuid1", "uuid4"):
+            findings.append(_mk(
+                ctx, "det-ambient-rng", Severity.MEDIUM, node.lineno,
+                f"uuid.{attr}(): random ids diverge across twin runs — "
+                f"fine for telemetry, poison for anything ordered or "
+                f"persisted", call=f"uuid.{attr}"))
+        elif base is None and attr == "hash" and node.args:
+            findings.append(_mk(
+                ctx, "det-ambient-rng", Severity.MEDIUM, node.lineno,
+                "builtin hash(): salted per process (PYTHONHASHSEED) — "
+                "use a stable digest", call="hash"))
+    return findings
+
+
+_DET_RULES = (
+    ("det-unordered-iter", _rule_unordered_iter),
+    ("det-wallclock", _rule_wallclock),
+    ("det-ambient-rng", _rule_ambient_rng),
+)
+
+
+def det_rule_names() -> List[str]:
+    return [n for n, _ in _DET_RULES]
+
+
+def run_det_rules(paths: Optional[Sequence[Tuple[str, str]]] = None
+                  ) -> List[Finding]:
+    """The three AST rules over the host control plane (r18 module set)."""
+    findings: List[Finding] = []
+    for modname, path in (paths if paths is not None
+                          else default_host_paths()):
+        try:
+            ctx = DetFileContext(modname, path)
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding(
+                rule="det-scan", severity=Severity.MEDIUM,
+                message=f"could not scan {modname}: {e}",
+                entry_point=modname))
+            continue
+        for name, rule in _DET_RULES:
+            try:
+                findings.extend(rule(ctx))
+            except Exception as e:  # a broken rule must stay visible
+                findings.append(Finding(
+                    rule=name, severity=Severity.MEDIUM,
+                    message=f"rule crashed on {modname}: "
+                            f"{type(e).__name__}: {e}",
+                    entry_point=modname))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# replay-certificate (seam) coverage
+# ---------------------------------------------------------------------------
+def _registered_points(pkg_root: str) -> List[str]:
+    from ..resilience.inject import POINTS
+
+    return list(POINTS)
+
+
+_FIRE_FUNCS = {"fire", "_fire", "_inject_fire", "_message_op",
+               "_retrying"}
+_SEAM_RE = re.compile(r"^[a-z_]+(\.[a-z_]+)+$")
+
+
+def _fire_sites(pkg_root: str) -> Tuple[Dict[str, List[str]],
+                                        Dict[str, List[str]]]:
+    """(exact fire literals, f-string fire prefixes), each → [modname]."""
+    exact: Dict[str, List[str]] = {}
+    prefix: Dict[str, List[str]] = {}
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, pkg_root)
+            modname = rel[:-3].replace(os.sep, ".")
+            try:
+                with open(path) as fh:
+                    tree = ast.parse(fh.read())
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                _, attr = _call_name(node.func)
+                if attr not in _FIRE_FUNCS:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    exact.setdefault(arg.value, []).append(modname)
+                elif isinstance(arg, ast.JoinedStr) and arg.values and \
+                        isinstance(arg.values[0], ast.Constant) and \
+                        isinstance(arg.values[0].value, str):
+                    prefix.setdefault(arg.values[0].value,
+                                      []).append(modname)
+    return exact, prefix
+
+
+class _TestFn:
+    def __init__(self, qualname: str, node: ast.AST):
+        self.qualname = qualname
+        self.node = node
+        self.literals: Set[str] = set()
+        self.calls: Set[str] = set()
+        self.names: Set[str] = set()
+        self.uses_fired_log = False
+        for x in ast.walk(node):
+            if isinstance(x, ast.Constant) and isinstance(x.value, str):
+                self.literals.add(x.value)
+            elif isinstance(x, ast.Attribute) and x.attr == "fired_log":
+                self.uses_fired_log = True
+            elif isinstance(x, ast.Call):
+                _, attr = _call_name(x.func)
+                if attr:
+                    self.calls.add(attr)
+            elif isinstance(x, ast.Name):
+                self.names.add(x.id)
+
+
+def _is_twin(fn: _TestFn, log_sources: Set[str]) -> bool:
+    """``assert <log-ish> == <log-ish>`` — both sides derived from
+    ``fired_log()`` output (directly, via tainted locals, or via calls to
+    same-module log-returning helpers)."""
+
+    def logish_expr(e: ast.AST, tainted: Set[str]) -> bool:
+        for x in ast.walk(e):
+            if isinstance(x, ast.Attribute) and x.attr == "fired_log":
+                return True
+            if isinstance(x, ast.Name) and x.id in tainted:
+                return True
+            if isinstance(x, ast.Call):
+                _, attr = _call_name(x.func)
+                if attr in log_sources:
+                    return True
+        return False
+
+    tainted: Set[str] = set()
+    for _ in range(2):  # two passes: taint through one reassignment level
+        for x in ast.walk(fn.node):
+            if isinstance(x, ast.Assign) and \
+                    logish_expr(x.value, tainted):
+                for t in x.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+            elif isinstance(x, ast.Expr) and isinstance(x.value, ast.Call):
+                # logs.append(<log-ish>) taints the list
+                f = x.value.func
+                if isinstance(f, ast.Attribute) and f.attr == "append" \
+                        and isinstance(f.value, ast.Name) \
+                        and x.value.args \
+                        and logish_expr(x.value.args[0], tainted):
+                    tainted.add(f.value.id)
+    for x in ast.walk(fn.node):
+        if isinstance(x, ast.Assert) and isinstance(x.test, ast.Compare) \
+                and all(isinstance(op, ast.Eq) for op in x.test.ops):
+            sides = [x.test.left] + list(x.test.comparators)
+            if sum(logish_expr(s, tainted) for s in sides) >= 2:
+                return True
+    return False
+
+
+def _scan_test_module(path: str, modname: str
+                      ) -> Tuple[List[_TestFn], Dict[str, Set[str]]]:
+    with open(path) as fh:
+        tree = ast.parse(fh.read())
+    module_lits: Dict[str, Set[str]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            lits = {x.value for x in ast.walk(node.value)
+                    if isinstance(x, ast.Constant)
+                    and isinstance(x.value, str)}
+            if lits:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        module_lits[t.id] = lits
+    fns: List[_TestFn] = []
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.append(_TestFn(f"{prefix}{child.name}", child))
+                visit(child, f"{prefix}{child.name}.")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+
+    visit(tree, f"{modname}::")
+    return fns, module_lits
+
+
+def seam_coverage(pkg_root: Optional[str] = None,
+                  tests_dir: Optional[str] = None) -> dict:
+    """Static cross-check: registry ↔ fire sites ↔ twin-certificate
+    tests.  Returns the per-seam report the CLI serializes."""
+    pkg = pkg_root or os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    tests = tests_dir or os.path.join(os.path.dirname(pkg), "tests")
+    points = _registered_points(pkg)
+    exact, prefixes = _fire_sites(pkg)
+
+    # -- twin-test scan ----------------------------------------------------
+    certified: Dict[str, List[str]] = {p: [] for p in points}
+    test_files = []
+    if os.path.isdir(tests):
+        test_files = [os.path.join(tests, f) for f in sorted(
+            os.listdir(tests)) if f.endswith(".py")]
+    for path in test_files:
+        modname = os.path.splitext(os.path.basename(path))[0]
+        try:
+            fns, module_lits = _scan_test_module(path, modname)
+        except (OSError, SyntaxError):
+            continue
+        by_name: Dict[str, List[_TestFn]] = {}
+        for f in fns:
+            by_name.setdefault(f.qualname.rsplit(".", 1)[-1]
+                               .rsplit("::", 1)[-1], []).append(f)
+        log_sources = {f.qualname.rsplit(".", 1)[-1].rsplit("::", 1)[-1]
+                       for f in fns if f.uses_fired_log}
+        for f in fns:
+            name = f.qualname.rsplit("::", 1)[-1].rsplit(".", 1)[-1]
+            if not name.startswith("test"):
+                continue
+            # closure: literals + fired_log reach through same-module
+            # helper calls (one level is how these tests are written)
+            lits = set(f.literals)
+            uses_log = f.uses_fired_log
+            for callee in f.calls:
+                for g in by_name.get(callee, ()):
+                    lits |= g.literals
+                    uses_log = uses_log or g.uses_fired_log
+            for ref in (f.names | f.calls):
+                lits |= module_lits.get(ref, set())
+            if not uses_log or not _is_twin(f, log_sources):
+                continue
+            for p in points:
+                if p in lits:
+                    certified[p].append(f.qualname)
+
+    fired = {p: sorted(set(exact.get(p, ())))
+             for p in points if p in exact}
+    for p in points:
+        if p in fired:
+            continue
+        mods = sorted({m for pre, ms in prefixes.items()
+                       if p.startswith(pre) for m in ms})
+        if mods:
+            fired[p] = mods
+    unregistered = sorted(
+        lit for lit in exact
+        if _SEAM_RE.match(lit) and lit not in points)
+    return {
+        "points": list(points),
+        "covered": {p: sorted(set(ts)) for p, ts in certified.items()
+                    if ts},
+        "uncovered": [p for p in points if not certified[p]],
+        "never_fired": [p for p in points if p not in fired],
+        "fired_in": fired,
+        "unregistered_fire_literals": unregistered,
+        "n_points": len(points),
+        "n_covered": sum(1 for p in points if certified[p]),
+    }
+
+
+def coverage_findings(cov: dict) -> List[Finding]:
+    out: List[Finding] = []
+    for p in cov["uncovered"]:
+        out.append(Finding(
+            rule="det-seam-coverage", severity=Severity.HIGH,
+            message=f"inject seam '{p}' has no two-run identical-"
+                    f"fired-log twin certificate test — replay of this "
+                    f"fault path is unverified",
+            entry_point="seam-coverage", details={"seam": p}))
+    for p in cov["never_fired"]:
+        out.append(Finding(
+            rule="det-seam-coverage", severity=Severity.MEDIUM,
+            message=f"registered seam '{p}' is never fired anywhere in "
+                    f"the package — dead registry entry",
+            entry_point="seam-coverage", details={"seam": p}))
+    for lit in cov["unregistered_fire_literals"]:
+        out.append(Finding(
+            rule="det-seam-coverage", severity=Severity.MEDIUM,
+            message=f"fire site uses literal '{lit}' that is not in the "
+                    f"POINTS registry — schedules can never match it",
+            entry_point="seam-coverage", details={"literal": lit}))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# report assembly
+# ---------------------------------------------------------------------------
+def analyze_determinism(paths: Optional[Sequence[Tuple[str, str]]] = None,
+                        pkg_root: Optional[str] = None,
+                        tests_dir: Optional[str] = None,
+                        include_seams: bool = True) -> AnalysisReport:
+    """Full host-determinism plane: AST rules + seam coverage."""
+    t0 = time.perf_counter()
+    findings = run_det_rules(paths)
+    cov = None
+    if include_seams:
+        cov = seam_coverage(pkg_root, tests_dir)
+        findings.extend(coverage_findings(cov))
+    report = AnalysisReport(findings, meta={
+        "plane": "determinism",
+        "det_schema_version": DET_SCHEMA_VERSION,
+        "det_rules": det_rule_names() + ["det-seam-coverage"],
+        "n_modules": len(paths if paths is not None
+                         else default_host_paths()),
+        "scan_s": round(time.perf_counter() - t0, 4),
+    })
+    if cov is not None:
+        report.meta["seam_coverage"] = {
+            "n_points": cov["n_points"], "n_covered": cov["n_covered"],
+            "uncovered": cov["uncovered"],
+            "never_fired": cov["never_fired"],
+            "unregistered_fire_literals": cov["unregistered_fire_literals"],
+        }
+    return report

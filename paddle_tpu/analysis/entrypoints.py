@@ -38,6 +38,7 @@ __all__ = [
     "pipeline_target",
     "serving_targets",
     "serving_int8_targets",
+    "spec_verify_target",
     "exported_target",
     "static_program_target",
     "shipped_entry_points",
@@ -221,6 +222,52 @@ def serving_int8_targets() -> List[AnalysisTarget]:
     return out
 
 
+def spec_verify_target() -> AnalysisTarget:
+    """The speculative-decoding verify program (ISSUE 19 lint surface):
+    one batched target forward + the unrolled k+1 accept loop whose key
+    chain must advance by exactly the emitted count per slot — the
+    program the key-flow rules exist to certify."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from ..models.gpt import GPTForPretraining, gpt_config
+    from ..serving.engine import ContinuousBatchingEngine
+    from ..serving.spec_decode import SpecDecodeConfig
+
+    paddle.seed(0)
+    cfg = gpt_config("gpt2-small", vocab_size=64, hidden_size=32,
+                     num_layers=2, num_attention_heads=4,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    paddle.seed(1)
+    dcfg = gpt_config("gpt2-small", vocab_size=64, hidden_size=16,
+                      num_layers=1, num_attention_heads=2,
+                      max_position_embeddings=64, hidden_dropout_prob=0.0,
+                      attention_dropout_prob=0.0)
+    draft = GPTForPretraining(dcfg)
+    draft.eval()
+    k = 2
+    eng = ContinuousBatchingEngine(model, max_seq_len=32, n_slots=4,
+                                   page_size=4,
+                                   spec_decode=SpecDecodeConfig(draft, k=k))
+    sd = eng._spec
+    args = (eng._params, eng._buffers,
+            jnp.zeros((eng.n_slots, k + 1), jnp.int32),
+            jnp.asarray(eng._pos),
+            jnp.asarray(np.ones((eng.n_slots,), bool)),
+            jnp.asarray(eng._temp), jnp.asarray(eng._topk),
+            jnp.asarray(eng._topp), jnp.asarray(eng._keys),
+            eng._decode_tables(), eng._pool_k, eng._pool_v)
+    t = AnalysisTarget("serving_spec_verify", sd._verify_jit, args,
+                       tags=("serving", "spec"),
+                       donate_argnums=getattr(sd, "_donate_verify", ()))
+    t.jaxpr()
+    return t
+
+
 def exported_target() -> AnalysisTarget:
     """jit.save → jit.load StableHLO artifact, replayed via Exported.call."""
     import os
@@ -288,6 +335,7 @@ _BUILDERS = (
     ("pipeline_step", lambda: [pipeline_target()]),
     ("serving", serving_targets),
     ("serving_int8", serving_int8_targets),
+    ("spec_verify", lambda: [spec_verify_target()]),
     ("exported_infer", lambda: [exported_target()]),
     ("static_program", lambda: [static_program_target()]),
 )

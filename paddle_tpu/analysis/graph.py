@@ -61,12 +61,16 @@ __all__ = [
 ]
 
 # collectives that must execute in lockstep across the ranks of their axes
+# (psum2 / all_gather_invariant are the spellings shard_map bodies lower
+# psum / all_gather to on jax 0.4.x — same lockstep semantics)
 COLLECTIVE_PRIMS = frozenset({
     "psum", "pmin", "pmax", "ppermute", "pshuffle", "all_gather",
     "all_to_all", "psum_scatter", "reduce_scatter", "pgather",
+    "psum2", "all_gather_invariant",
 })
 # collectives whose OUTPUT is uniform along the reduced/gathered axes
-UNIFORMIZING_PRIMS = frozenset({"psum", "pmin", "pmax", "all_gather"})
+UNIFORMIZING_PRIMS = frozenset({"psum", "pmin", "pmax", "all_gather",
+                                "psum2", "all_gather_invariant"})
 
 # host round-trip primitives (the host-sync rule's trigger set)
 CALLBACK_PRIMS = frozenset({
@@ -165,9 +169,11 @@ class Node:
     source: str                    # "file:line (function)"
     in_avals: Tuple                # ((shape, dtype, weak_type), ...)
     out_avals: Tuple
-    in_defs: Tuple[int, ...]       # producing Node idx; -1 input, -2 const
+    in_defs: Tuple[int, ...]       # producing Node idx; -1 literal/unknown,
+    #                                -2 const, <= -3 top-level arg (-3 - pos)
     axes: Tuple[str, ...]          # collective axes ((),) for others
     nonuniform: FrozenSet[str]     # mesh axes the outputs may differ along
+    in_lits: Tuple[bool, ...] = () # per-operand: jaxpr Literal?
     params: dict = dataclasses.field(default_factory=dict)  # _light_params
 
     @property
@@ -222,6 +228,9 @@ class DefUseGraph:
         self.conds: List[CondSite] = []
         self.whiles: List[WhileSite] = []
         self.invar_labels: Dict[Any, str] = {}  # top-level Var -> arg path
+        # def ids whose value escapes some jaxpr level (reaches outvars of
+        # the top program or any sub-jaxpr: carries, branch outputs, ...)
+        self.escaping: set = set()
 
     # -- queries --------------------------------------------------------
     def producer(self, node: Node, operand: int) -> Optional[Node]:
@@ -455,6 +464,8 @@ class _Walker:
                 out_avals=tuple(_aval_info(v) for v in eqn.outvars),
                 in_defs=tuple(d for _, d in in_info),
                 axes=axes, nonuniform=out_taint,
+                in_lits=tuple(isinstance(v, _jcore.Literal)
+                              for v in eqn.invars),
                 params=_light_params(eqn.params),
             )
             g.nodes.append(node)
@@ -468,7 +479,11 @@ class _Walker:
                 out_info = [(out_taint, idx)] * len(eqn.outvars)
             for v, info in zip(eqn.outvars, out_info):
                 env[v] = info
-        return [self._read(env, v) for v in jaxpr.outvars]
+        outs = [self._read(env, v) for v in jaxpr.outvars]
+        # every level's outvars escape: top-level results, loop carries,
+        # branch outputs — consumers the def-use edges can't see
+        self.g.escaping.update(d for _, d in outs if d >= 0)
+        return outs
 
     # -- sub-jaxpr recursion -------------------------------------------
     def _recurse(self, eqn, node, in_info, out_taint, path):
@@ -582,8 +597,10 @@ def build_graph(closed_jaxpr, invar_labels: Optional[Dict] = None) -> DefUseGrap
     jaxpr = closed_jaxpr.jaxpr
     w._record_consts(closed_jaxpr, ())
     env = {cv: (frozenset(), -2) for cv in jaxpr.constvars}
-    for v in jaxpr.invars:
-        env[v] = (frozenset(), -1)
+    for k, v in enumerate(jaxpr.invars):
+        # distinct pseudo-def per entry arg so dataflow rules can tell two
+        # different inputs apart (both used to collapse to -1)
+        env[v] = (frozenset(), -3 - k)
     w._walk_jaxpr(jaxpr, env, ())
     return g
 

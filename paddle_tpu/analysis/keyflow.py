@@ -1,0 +1,362 @@
+"""PRNG key-flow lint: dataflow rules over the def-use graph (r9 walker).
+
+Parity role: the reference framework's determinism surface —
+``paddle.seed`` / ``FLAGS_cudnn_deterministic`` / deterministic-op lists —
+is a *runtime switch*; on TPU the equivalent discipline is structural:
+every random draw must consume a key derived exactly once from the chain
+(``split``/``fold_in``), or replay (resurrection r21, spec-decode r22,
+``fast_forward_key`` continuation joins) silently diverges.  These rules
+certify that statically, per entry point, on the flattened jaxpr:
+
+* ``key-reuse``        — one key value consumed by ≥2 drawing prims
+  without an intervening split (HIGH).  Sibling ``cond`` branches are
+  exclusive and exempt; ``fold_in`` is the sanctioned multi-derivation
+  and never pairs.
+* ``key-discard``      — ``random_split`` outputs that are never consumed
+  and never escape (MEDIUM): a chain desync waiting to happen — the
+  producer advanced the chain, nobody owns the subkey.
+* ``key-closure-const``— a key/seed baked into the program at trace time
+  (closure-captured key constant, or ``random_seed`` of a literal):
+  replay across process restarts re-traces with the same stream no matter
+  what the caller seeds (HIGH).
+* ``key-nonuniform``   — a draw whose key is rank-divergent along mesh
+  axes (taint lattice) feeding a collective over those axes: every rank
+  samples different values inside a region that must agree (HIGH).
+
+Key identity is resolved through pure aliasing prims (``slice`` index
+signatures keep ``split(k)[0]`` and ``split(k)[1]`` distinct); opaque
+reshuffles (gather/dynamic_slice/...) resolve to a unique root so they
+can never collide into a false pair.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+from .findings import Finding, Severity
+from .graph import COLLECTIVE_PRIMS, DefUseGraph, Node
+from .rules import Rule, register_rule
+
+__all__ = [
+    "KeyReuseRule",
+    "KeyDiscardRule",
+    "ClosureKeyRule",
+    "NonuniformKeyRule",
+    "keyflow_rules",
+    "DRAWING_PRIMS",
+    "RANDOM_PRIMS",
+]
+
+
+def keyflow_rules():
+    """Fresh instances of just the four key-flow rules (the
+    ``--determinism`` jaxpr plane; the default gate gets them via
+    :func:`..rules.default_rules`)."""
+    return [KeyReuseRule(), KeyDiscardRule(), ClosureKeyRule(),
+            NonuniformKeyRule()]
+
+#: prims that SPEND a key (each consumption must be a distinct derivation)
+DRAWING_PRIMS = frozenset({
+    "random_split", "random_bits", "random_gamma", "threefry2x32",
+})
+
+#: every PRNG prim (drawing + derivation + packing)
+RANDOM_PRIMS = DRAWING_PRIMS | frozenset({
+    "random_fold_in", "random_seed", "random_wrap", "random_unwrap",
+})
+
+#: value-preserving aliases the resolver walks BACKWARD through; ``slice``
+#: contributes an index signature, the rest are transparent
+_PASSTHROUGH = frozenset({
+    "slice", "squeeze", "reshape", "broadcast_in_dim", "transpose",
+    "convert_element_type", "random_wrap", "random_unwrap", "copy",
+    "bitcast_convert_type", "stop_gradient",
+})
+
+#: reshuffles whose output is *some* key material but with data-dependent
+#: or merged identity — resolved to a unique per-node root (conservative:
+#: can never produce a reuse pair)
+_OPAQUE = frozenset({
+    "gather", "dynamic_slice", "select_n", "concatenate", "rev",
+    "scatter", "dynamic_update_slice", "pad",
+})
+
+
+def _is_key_aval(aval) -> bool:
+    """(shape, dtype, weak) triple: typed PRNG key or raw uint32 pair."""
+    if not aval:
+        return False
+    shape, dtype, _ = aval
+    if isinstance(dtype, str) and dtype.startswith("key<"):
+        return True
+    return (dtype == "uint32" and len(shape) >= 1
+            and int(shape[-1]) == 2)
+
+
+def _resolve(g: DefUseGraph, node: Node, operand: int,
+             _max_depth: int = 64):
+    """(root, signature) identity of ``node``'s ``operand`` value.
+
+    ``root`` is a node idx, or a negative pseudo-def (entry arg / const /
+    literal), or ``("opaque", idx)`` for unresolvable reshuffles.
+    ``signature`` records the slice path taken from the root, so the two
+    halves of one ``split`` stay distinct keys.
+    """
+    sig: List[Tuple] = []
+    d = node.in_defs[operand]
+    cur = g.nodes[d] if d >= 0 else None
+    for _ in range(_max_depth):
+        if cur is None:
+            return d, tuple(sig)
+        p = cur.prim
+        if p == "slice":
+            sig.append(("slice",
+                        tuple(cur.params.get("start_indices", ()) or ()),
+                        tuple(cur.params.get("limit_indices", ()) or ()),
+                        tuple(cur.params.get("strides") or ())))
+        elif p in _OPAQUE:
+            return ("opaque", cur.idx), ()
+        elif p not in _PASSTHROUGH:
+            return cur.idx, tuple(sig)
+        d = cur.in_defs[0] if cur.in_defs else -1
+        cur = g.nodes[d] if d >= 0 else None
+    return ("opaque", node.idx), ()  # depth bail-out: unique, no pairs
+
+
+def _sibling_branches(p1: Tuple[str, ...], p2: Tuple[str, ...]) -> bool:
+    """True when the two paths sit in different branches of one cond."""
+    for a, b in zip(p1, p2):
+        if a != b:
+            return a.startswith("branch") and b.startswith("branch")
+    return False
+
+
+def _rev_adjacency(g: DefUseGraph) -> Dict[int, List[int]]:
+    rev: Dict[int, List[int]] = defaultdict(list)
+    for n in g.nodes:
+        for d in set(n.in_defs):
+            rev[d].append(n.idx)
+    return rev
+
+
+def _value_used(g: DefUseGraph, idx: int, rev, _seen=None) -> bool:
+    """Does the value produced by node ``idx`` reach a real consumer or
+    escape a jaxpr level?  Pure-passthrough consumers only count if their
+    own outputs are used."""
+    if _seen is None:
+        _seen = set()
+    if idx in _seen:
+        return False
+    _seen.add(idx)
+    if idx in g.escaping:
+        return True
+    for c in rev.get(idx, ()):
+        cn = g.nodes[c]
+        if cn.prim in _PASSTHROUGH:
+            if _value_used(g, c, rev, _seen):
+                return True
+        else:
+            return True
+    return False
+
+
+def _key_operands(node: Node) -> List[int]:
+    """Operand positions of ``node`` that carry key material."""
+    if node.prim in ("random_split", "random_bits", "random_fold_in",
+                     "random_gamma"):
+        return [0] if node.in_avals else []
+    if node.prim == "threefry2x32":
+        return [0, 1][: len(node.in_avals)]
+    return [i for i, a in enumerate(node.in_avals) if _is_key_aval(a)]
+
+
+@register_rule
+class KeyReuseRule(Rule):
+    """One key consumed by ≥2 drawing prims without an intervening split."""
+
+    name = "key-reuse"
+
+    def run(self, target) -> List[Finding]:
+        g = target.graph()
+        groups: Dict[Tuple, List[Tuple[int, int]]] = defaultdict(list)
+        for n in g.nodes:
+            if n.prim not in DRAWING_PRIMS:
+                continue
+            for op in _key_operands(n):
+                if not _is_key_aval(n.in_avals[op]):
+                    continue
+                groups[_resolve(g, n, op)].append((n.idx, op))
+        findings: List[Finding] = []
+        for (root, sig), consumers in groups.items():
+            if isinstance(root, tuple):       # opaque: never a proven pair
+                continue
+            if len(consumers) < 2:
+                continue
+            # drop pairs that live in mutually-exclusive cond branches
+            kept = []
+            for c, _ in consumers:
+                cn = g.nodes[c]
+                if all(not _sibling_branches(cn.path, g.nodes[k].path)
+                       for k, _ in kept):
+                    kept.append((c, 0))
+            if len(kept) < 2:
+                continue
+            first, second = g.nodes[kept[0][0]], g.nodes[kept[1][0]]
+            where = " and ".join(
+                f"eqn #{g.nodes[c].idx} '{g.nodes[c].prim}'"
+                + (f" [{g.nodes[c].where}]" if g.nodes[c].where else "")
+                for c, _ in kept)
+            findings.append(self.finding(
+                Severity.HIGH,
+                f"key reused: one key value spent by {len(kept)} drawing "
+                f"prims without an intervening split — {where}",
+                node=second,
+                root=root if isinstance(root, int) else str(root),
+                signature=[list(s) for s in sig],
+                consumers=[g.nodes[c].idx for c, _ in kept],
+                consumer_prims=[g.nodes[c].prim for c, _ in kept],
+                first_scope=first.name_stack, first_source=first.source))
+        return findings
+
+
+@register_rule
+class KeyDiscardRule(Rule):
+    """Split results (whole or subkey) that nothing consumes or escapes."""
+
+    name = "key-discard"
+
+    def run(self, target) -> List[Finding]:
+        g = target.graph()
+        rev = _rev_adjacency(g)
+        findings: List[Finding] = []
+        for n in g.nodes:
+            if n.prim != "random_split":
+                continue
+            if not _value_used(g, n.idx, rev):
+                findings.append(self.finding(
+                    Severity.MEDIUM,
+                    "split result entirely discarded: the chain advanced "
+                    "but no subkey is consumed or escapes — dead "
+                    "derivation (or a desynced continuation join)",
+                    node=n, split=n.idx))
+                continue
+            # a subkey peeled off (slice/squeeze chain, possibly through
+            # a random_unwrap for raw uint32 keys) and then dropped
+            frontier = list(rev.get(n.idx, ()))
+            seen = set(frontier)
+            chain = []
+            while frontier:
+                c = frontier.pop()
+                cn = g.nodes[c]
+                if cn.prim == "slice":
+                    chain.append(c)
+                elif cn.prim in _PASSTHROUGH:
+                    for c2 in rev.get(c, ()):
+                        if c2 not in seen:
+                            seen.add(c2)
+                            frontier.append(c2)
+            for c in sorted(chain):
+                cn = g.nodes[c]
+                if not _value_used(g, c, rev):
+                    start = tuple(cn.params.get("start_indices", ()) or ())
+                    findings.append(self.finding(
+                        Severity.MEDIUM,
+                        f"subkey discarded: split output index "
+                        f"{start[0] if start else '?'} is peeled off but "
+                        f"never consumed — a chain desync waiting to "
+                        f"happen",
+                        node=cn, split=n.idx, slice_start=list(start)))
+        return findings
+
+
+@register_rule
+class ClosureKeyRule(Rule):
+    """Key/seed baked into the traced program (const or literal)."""
+
+    name = "key-closure-const"
+
+    def run(self, target) -> List[Finding]:
+        g = target.graph()
+        findings: List[Finding] = []
+        for n in g.nodes:
+            if n.prim == "random_seed":
+                d = n.in_defs[0] if n.in_defs else -1
+                lit = bool(n.in_lits[0]) if n.in_lits else False
+                if lit or d == -2:
+                    what = "literal" if lit else "closure constant"
+                    findings.append(self.finding(
+                        Severity.HIGH,
+                        f"seed baked at trace time ({what}): every replay "
+                        f"of this program restarts the same stream "
+                        f"regardless of the caller's seed",
+                        node=n, kind=what))
+                continue
+            if n.prim not in DRAWING_PRIMS and n.prim != "random_fold_in":
+                continue
+            for op in _key_operands(n):
+                if not _is_key_aval(n.in_avals[op]):
+                    continue
+                root, _ = _resolve(g, n, op)
+                if root == -2:
+                    findings.append(self.finding(
+                        Severity.HIGH,
+                        f"closure-captured key constant consumed by "
+                        f"'{n.prim}': the key chain is frozen into the "
+                        f"executable — replay across process restarts "
+                        f"diverges from the seeded stream",
+                        node=n, operand=op))
+        return findings
+
+
+@register_rule
+class NonuniformKeyRule(Rule):
+    """Rank-divergent key feeding a draw whose result reaches a
+    collective over the divergent axes (taint lattice, r9)."""
+
+    name = "key-nonuniform"
+
+    def run(self, target) -> List[Finding]:
+        g = target.graph()
+        rev = _rev_adjacency(g)
+        findings: List[Finding] = []
+        for n in g.nodes:
+            if n.prim not in DRAWING_PRIMS:
+                continue
+            taint = frozenset()
+            for op in _key_operands(n):
+                d = n.in_defs[op]
+                if d >= 0:
+                    taint |= g.nodes[d].nonuniform
+            if not taint:
+                continue
+            hit = self._reaches_collective(g, rev, n.idx, taint)
+            if hit is None:
+                continue
+            axes = sorted(set(hit.axes) & taint)
+            findings.append(self.finding(
+                Severity.HIGH,
+                f"rank-divergent sampling: '{n.prim}' draws from a key "
+                f"nonuniform along mesh axes {sorted(taint)} and the "
+                f"result reaches collective '{hit.prim}' over "
+                f"{axes} (eqn #{hit.idx}"
+                + (f" [{hit.where}]" if hit.where else "") + ")",
+                node=n, key_axes=sorted(taint),
+                collective=hit.idx, collective_prim=hit.prim,
+                collective_axes=axes))
+        return findings
+
+    @staticmethod
+    def _reaches_collective(g, rev, start, taint):
+        seen = {start}
+        frontier = [start]
+        while frontier:
+            cur = frontier.pop()
+            for c in rev.get(cur, ()):
+                if c in seen:
+                    continue
+                seen.add(c)
+                cn = g.nodes[c]
+                if cn.prim in COLLECTIVE_PRIMS and set(cn.axes) & taint:
+                    return cn
+                frontier.append(c)
+        return None

@@ -93,6 +93,8 @@ def register_rule(cls):
 def default_rules(**overrides) -> List[Rule]:
     """Fresh instances of every registered rule; ``overrides`` maps rule
     name → ctor kwargs (e.g. thresholds for tests)."""
+    from . import keyflow  # noqa: F401 — populate the key-flow rules
+
     return [cls(**overrides.get(name, {})) for name, cls in _RULES.items()]
 
 

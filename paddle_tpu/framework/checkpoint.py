@@ -309,7 +309,9 @@ class CheckpointManager:
             names = os.listdir(self.directory)
         except OSError:
             return
-        now = time.time()  # file mtimes are wall-clock by nature
+        # det-ok: stale-tmp GC compares against file mtimes, which are
+        # wall-clock by nature; published snapshots are never touched
+        now = time.time()
         for name in names:
             if not name.startswith(".tmp_step_"):
                 continue

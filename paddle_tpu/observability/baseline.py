@@ -83,7 +83,12 @@ _COUNT_MAX = ("silent_drops", "dropped_requests", "inflight_failures",
               # concurrency-doctor finding counts (r18): a PR that
               # re-introduces a HIGH/MEDIUM host-race finding regresses
               # past the lineage maximum and gates
-              "host_findings_high", "host_findings_medium")
+              "host_findings_high", "host_findings_medium",
+              # determinism-doctor counts (ISSUE 19): a re-introduced
+              # HIGH/MEDIUM nondeterminism hazard, or an inject seam left
+              # without its two-run replay certificate, gates the same way
+              "det_findings_high", "det_findings_medium",
+              "det_seams_uncovered")
 
 
 def classify_metric(name: str, value) -> str:

@@ -81,10 +81,13 @@ _enabled = False
 
 def new_trace_id() -> str:
     """128-bit random id, 16 hex chars (w3c-traceparent-ish, short form)."""
+    # det-ok: trace ids are telemetry-only (w3c semantics want global
+    # uniqueness); nothing ordered or replayed keys off them
     return uuid.uuid4().hex[:16]
 
 
 def new_span_id() -> str:
+    # det-ok: span ids are telemetry-only, same contract as trace ids
     return uuid.uuid4().hex[:16]
 
 

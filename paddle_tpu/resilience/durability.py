@@ -785,9 +785,12 @@ class CheckpointDataPlane:
         # every rank prunes its own retired blobs (replica holders too —
         # the committer only retires the manifests); throttled to one
         # store scan per second
+        # det-ok: prune/scrub throttles pace MAINTENANCE against real
+        # time; commit/push ordering is store-sequenced, not clocked
         if time.monotonic() - self._last_prune >= 1.0:
             self._prune_local()
         if (self.cfg.scrub_interval_s is not None
+                # det-ok: same maintenance throttle as the prune above
                 and time.monotonic() - self._last_scrub
                 >= self.cfg.scrub_interval_s):
             self.scrub_once()

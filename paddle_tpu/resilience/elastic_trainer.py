@@ -226,6 +226,8 @@ class ElasticDPTrainer:
                                         metadata)
                 except FileNotFoundError:
                     chosen = None
+            # det-ok: rendezvous timeouts bound LIVENESS (give up on a
+            # dead store); the chosen step is store-content, not clocked
             deadline = time.monotonic() + self.rendezvous_timeout
             while True:
                 try:
@@ -234,11 +236,14 @@ class ElasticDPTrainer:
                 except OSError:
                     # store failover window: the members are all polling
                     # for this broadcast — keep trying to land it
+                    # det-ok: liveness bound only (see deadline above)
                     if time.monotonic() > deadline:
                         raise
                     time.sleep(0.1)
             return chosen
+        # det-ok: rendezvous poll deadline — liveness bound only
         deadline = time.monotonic() + self.rendezvous_timeout
+        # det-ok: poll loop bounded by the liveness deadline above
         while time.monotonic() < deadline:
             try:
                 raw = self.manager.store.get(key)

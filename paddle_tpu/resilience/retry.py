@@ -121,6 +121,9 @@ def backoff_delays(retries: int, base: float = 0.05, max_delay: float = 2.0,
         # k=1024, and long-lived poll loops (elastic wait_for_np) drive k
         # far past the point where max_delay already dominates
         d = min(base * (2.0 ** min(k, 63)), max_delay)
+        # det-ok: backoff jitter is deliberately decorrelated across
+        # processes (thundering-herd control); no replayed decision
+        # depends on the delay value
         yield d * (1.0 + jitter * (2.0 * random.random() - 1.0))
 
 

@@ -358,6 +358,8 @@ class LoadShedPolicy:
         """The requests to shed THIS tick (popped oldest-first from the
         queue; empty while overload is not sustained). The caller fails
         them — the policy only decides."""
+        # det-ok: sustained-overload timing (Retry-After family) is
+        # wall-clock by contract; deterministic callers inject `now`
         now = time.monotonic() if now is None else now
         depth = scheduler.depth()
         with self._lock:

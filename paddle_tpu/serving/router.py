@@ -339,6 +339,9 @@ class ServingRouter:
     def _tick_breaker(self, rep: _Replica):
         with self._lock:
             if (rep.state == _Replica.OPEN and rep.opened_at is not None
+                    # det-ok: breaker probe timers pace RECOVERY against
+                    # real outage duration; request ordering never
+                    # observes the cooldown clock
                     and time.monotonic() - rep.opened_at >= self.cooldown_s):
                 rep.state = _Replica.HALF_OPEN  # next probe decides
 
