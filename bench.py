@@ -525,6 +525,42 @@ def _determinism_lint():
     }
 
 
+def _kernel_lint():
+    """Pallas kernel doctor secondary (ISSUE 20): findings by severity
+    over the shipped kernel manifest (coverage proofs + f32-accumulation
+    lint + VMEM budget + registry drift certification) plus the sweep
+    row count.  ``kernel_findings_high``/``kernel_findings_medium`` are
+    count_max baseline classes — a PR that breaks a BlockSpec coverage
+    proof, drops an f32 accumulator cast, or lets a registry model drift
+    past tolerance regresses past the lineage maximum and gates.
+    ``kernel_drift_max_frac`` records the worst derived-vs-registered
+    flops deviation ("drift" → magnitude class)."""
+    import time as _time
+
+    from paddle_tpu.analysis.kernels import analyze_kernels, kernel_sweep
+
+    t0 = _time.perf_counter()
+    report = analyze_kernels()
+    lint_s = _time.perf_counter() - t0
+    counts = report.counts()
+    drift = 0.0
+    for row in report.meta["kernels"]:
+        ratio = row.get("flops_ratio")
+        if ratio:
+            drift = max(drift, abs(ratio - 1.0), abs(1.0 / ratio - 1.0))
+    sweep = kernel_sweep()
+    return {
+        "kernel_manifest_cases": report.meta["n_cases"],
+        "kernel_lint_s": round(lint_s, 3),
+        "kernel_findings_high": counts["HIGH"],
+        "kernel_findings_medium": counts["MEDIUM"],
+        "kernel_findings_low": counts["LOW"],
+        "kernel_findings_info": counts["INFO"],
+        "kernel_drift_max_frac": round(drift, 4),
+        "kernel_sweep_rows": len(sweep["rows"]),
+    }
+
+
 def _planner_search(on_tpu):
     """Auto-parallel planner v2 secondary (ISSUE 13): search wall time and
     candidate accounting for a real search (every analysis-priced row is a
@@ -1835,6 +1871,11 @@ def main():
         except Exception as e:  # pragma: no cover - device dependent
             secondary["det_lint_s"] = f"failed: {type(e).__name__}"
         try:
+            # Pallas kernel doctor: coverage/dtype/VMEM/drift (ISSUE 20)
+            secondary.update(_kernel_lint())
+        except Exception as e:  # pragma: no cover - device dependent
+            secondary["kernel_lint_s"] = f"failed: {type(e).__name__}"
+        try:
             # robustness: replica-kill failover recovery time (ISSUE 6)
             secondary.update(_router_failover(True))
         except Exception as e:  # pragma: no cover - device dependent
@@ -1944,6 +1985,10 @@ def main():
             secondary.update(_determinism_lint())
         except Exception as e:  # pragma: no cover
             secondary["det_lint_s"] = f"failed: {type(e).__name__}"
+        try:
+            secondary.update(_kernel_lint())
+        except Exception as e:  # pragma: no cover
+            secondary["kernel_lint_s"] = f"failed: {type(e).__name__}"
         try:
             secondary.update(_router_failover(False))
         except Exception as e:  # pragma: no cover

@@ -28,6 +28,11 @@ and ``--determinism`` runs the determinism doctor: PRNG key-flow lint +
 host-nondeterminism rules + replay-certificate seam coverage
 (``paddle.seed`` / ``FLAGS_cudnn_deterministic`` parity), with
 ``--bisect-demo`` exercising the twin-replay divergence bisector.
+``--kernels`` runs the Pallas kernel doctor (block-spec coverage proofs
++ f32-accumulation lint + VMEM budget + cost-registry drift
+certification over the shipped kernel manifest — OpDesc/InferShape
+verification parity for the kernel plane), and ``--kernels-sweep``
+prints the predicted VMEM/roofline table over serving shapes.
 """
 from .findings import (
     AnalysisReport,
@@ -110,6 +115,12 @@ from .bisect import (
     demo_divergence,
     diff_fired_logs,
 )
+from .kernels import (
+    KernelAudit,
+    analyze_kernels,
+    collect_pallas_eqns,
+    kernel_sweep,
+)
 from .traceguard import RecompileEvent, TraceGuard
 
 __all__ = [
@@ -174,6 +185,10 @@ __all__ = [
     "bisect_runs",
     "demo_divergence",
     "diff_fired_logs",
+    "KernelAudit",
+    "analyze_kernels",
+    "collect_pallas_eqns",
+    "kernel_sweep",
     "TraceGuard",
     "RecompileEvent",
 ]

@@ -15,7 +15,15 @@ three modes:
 * ``--determinism`` — the determinism doctor: PRNG key-flow lint over
   every entry point (jaxpr plane) + host-nondeterminism AST rules +
   replay-certificate seam coverage; ``--bisect-demo`` appends a planted
-  key-desync localization → ``benchmarks/analysis_determinism.json``.
+  key-desync localization → ``benchmarks/analysis_determinism.json``;
+* ``--kernels``     — the Pallas kernel doctor: block-spec coverage
+  proofs (every output block written exactly once), f32-accumulation
+  lint over the kernel-body jaxprs, VMEM budgeting, and cost-registry
+  drift certification over the shipped kernel manifest →
+  ``benchmarks/analysis_kernels.json``;
+* ``--kernels-sweep`` — predicted VMEM/roofline table over serving
+  shapes (page_size 16/32 × the real-vocab tiling lattice) →
+  ``benchmarks/analysis_kernels_sweep.json``.
 
 ``--device-budget <bytes>`` re-parameterizes the memory rules so an
 ``oom-risk`` HIGH against YOUR chip gates exit-1.  Unknown primitives hit
@@ -116,6 +124,17 @@ def main(argv=None) -> int:
                         metavar="T",
                         help="--bisect-demo: tick at which to plant the "
                              "key desync (default 3)")
+    mode.add_argument("--kernels", action="store_true",
+                      help="Pallas kernel doctor: coverage proofs + "
+                           "f32-accumulation lint + VMEM budget + "
+                           "cost-registry drift certification over the "
+                           "shipped kernel manifest (writes "
+                           "analysis_kernels.json)")
+    mode.add_argument("--kernels-sweep", action="store_true",
+                      help="predicted VMEM/roofline table over serving "
+                           "shapes: page_size 16/32 x real-vocab "
+                           "lattice (writes analysis_kernels_sweep"
+                           ".json)")
     mode.add_argument("--plan", action="store_true",
                       help="auto-parallel planner v2: enumerate dp/mp/pp/"
                            "ZeRO/remat candidates, price each on a lowered "
@@ -186,6 +205,8 @@ def main(argv=None) -> int:
         return _plan_mode(args)
     if args.determinism:
         return _determinism_mode(args)
+    if args.kernels or args.kernels_sweep:
+        return _kernels_mode(args)
 
     import jax
 
@@ -233,6 +254,45 @@ def main(argv=None) -> int:
 
     if errors and not args.keep_going:
         return 2
+    if args.fail_on != "never":
+        gate = Severity[args.fail_on.upper()]
+        if report.at_least(gate):
+            return 1
+    return 0
+
+
+def _kernels_mode(args) -> int:
+    """``--kernels`` / ``--kernels-sweep``: the Pallas kernel doctor.
+
+    ``--kernels`` audits every manifest kernel (coverage proof, dtype
+    safety, VMEM budget, registry drift) and gates exit status on the
+    standard ``--fail-on`` contract; ``--kernels-sweep`` is pure shape
+    arithmetic (no kernel runs) and never gates."""
+    from .findings import Severity
+    from .kernels import analyze_kernels, kernel_sweep, sweep_table
+
+    if args.kernels_sweep:
+        sweep = kernel_sweep()
+        out = args.out or _default_out("analysis_kernels_sweep.json")
+        _save_json(out, sweep)
+        print(f"swept {len(sweep['rows'])} kernel shapes in "
+              f"{sweep['elapsed_s']}s -> {out}")
+        print()
+        print(sweep_table(sweep))
+        return 0
+
+    t0 = time.perf_counter()
+    report = analyze_kernels()
+    report.meta["total_s"] = round(time.perf_counter() - t0, 3)
+    out = args.out or _default_out("analysis_kernels.json")
+    report.save(out)
+    print(f"audited {report.meta['n_cases']} manifest kernels in "
+          f"{report.meta['total_s']}s -> {out}")
+    print()
+    print(report.table())
+    counts = report.counts()
+    print()
+    print("findings:", ", ".join(f"{k}={v}" for k, v in counts.items()))
     if args.fail_on != "never":
         gate = Severity[args.fail_on.upper()]
         if report.at_least(gate):

@@ -41,6 +41,7 @@ __all__ = [
     "spec_verify_target",
     "exported_target",
     "static_program_target",
+    "kernel_targets",
     "shipped_entry_points",
 ]
 
@@ -328,6 +329,22 @@ def static_program_target() -> AnalysisTarget:
     return target_from_program(main, name="static_program",
                                feed={"x": np.zeros((4, 8), np.float32),
                                      "t": np.zeros((4, 1), np.float32)})
+
+
+def kernel_targets() -> List[AnalysisTarget]:
+    """One :class:`AnalysisTarget` per shipped Pallas kernel manifest
+    case (r24) — lets the generic rule registry / sanitizer replay the
+    kernel *launch* programs too, not just the model entry points.  The
+    kernel doctor itself (``analysis.kernels``) consumes the manifest
+    directly (it needs the raw eqns, not a target)."""
+    from ..ops.pallas import kernel_manifest
+
+    out = []
+    for case in kernel_manifest():
+        fn, args = case.build()
+        out.append(AnalysisTarget(f"kernel_{case.name}", fn, args,
+                                  tags={"kernel"}))
+    return out
 
 
 _BUILDERS = (

@@ -124,6 +124,15 @@ class AnalysisReport:
             out[str(f.severity)] += 1
         return out
 
+    def counts_by_rule(self) -> Dict[str, int]:
+        """Rule name → finding count (sorted by count desc, then name) —
+        the per-rule histogram the bench secondaries fold into their
+        payloads."""
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return dict(sorted(out.items(), key=lambda kv: (-kv[1], kv[0])))
+
     def to_dict(self) -> dict:
         ordered = sorted(self.findings,
                          key=lambda f: (-int(f.severity), f.entry_point, f.rule))

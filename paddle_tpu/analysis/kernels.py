@@ -1,0 +1,988 @@
+"""Pallas kernel doctor: block-spec coverage proofs, f32-accumulation
+lint, VMEM budgeting, and cost-registry drift certification (r24).
+
+The reference framework ships a per-op shape-inference + OpDesc
+verification pass (``InferShapeContext``/``OpProtoMaker`` checks run at
+program-build time); the kernels we hand-write in Pallas sit UNDER that
+surface — a wrong ``BlockSpec`` index map silently reads garbage or
+drops writes, and nothing in the jaxpr type system objects.  This module
+is the equivalent compile-time doctor for the kernel plane.  It consumes
+the kernel manifest (:func:`paddle_tpu.ops.pallas.kernel_manifest` — one
+representative launch per shipped ``pl.pallas_call``) and proves, per
+kernel:
+
+**Coverage** — every BlockSpec index map is a pure function of the grid
+indices plus the scalar-prefetch arrays, so over a concrete grid it can
+be evaluated EXACTLY (no abstraction): every output block must be
+written by exactly one contiguous run of grid steps (Pallas revisits a
+block legally only while the index is unchanged between consecutive
+steps — the pipeline holds the block in VMEM and flushes on change; a
+*non-contiguous* revisit overwrites flushed data → write race, and a
+never-visited block ships uninitialized HBM → garbage).  Input blocks
+must stay in bounds; visits to a non-dividing tail block are legal but
+require the kernel body to mask (cross-checked against the body's
+iota→compare→select idiom).
+
+**Dtype safety** — the body jaxpr rides the same def-use walker as every
+other rule surface (:func:`~.graph.build_graph` consumes the kernel
+jaxpr directly): accumulating ops (``dot_general`` without
+``preferred_element_type=f32``, ``reduce_sum``/``cumsum``) on half
+inputs are HIGH — on the MXU/VPU those accumulate in bf16 and lose the
+mantissa the online-softmax algebra depends on.  ``reduce_max`` in bf16
+is exact and deliberately NOT flagged.
+
+**VMEM budget** — per-grid-step resident bytes (double-buffered in/out
+blocks + scratch) against the per-generation VMEM capacity table; the
+``--kernels-sweep`` CLI mode prices real serving shapes (page_size
+16/32 × the real-vocab lattice, roadmap item 1a) through the same
+estimator plus the registry roofline.
+
+**Registry drift** — flops derived from the body jaxpr
+(:func:`~.cost.graph_cost` × grid trip count) and bytes derived from the
+coverage proof's block-visit runs are certified against the registered
+analytic model (:mod:`paddle_tpu.ops.pallas.cost_registry`).  Derived
+bytes form a band: ``unique`` (each distinct block once — perfect reuse)
+to ``runs`` (one fetch per contiguous visit run — what the pipeline
+actually moves); a registered model outside ``[unique/tol, runs*tol]``
+is stale.  Manifest↔registry name mismatches are HIGH in both
+directions: an unregistered first-party kernel is priced by the loud
+bytes-only fallback (planner v2 regresses), a registry entry with no
+manifest kernel is dead weight that will rot.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax._src import core as _jcore
+except ImportError:  # pragma: no cover
+    import jax.core as _jcore
+
+from jax._src.state import discharge as _state_discharge
+
+from .findings import Finding, Severity, AnalysisReport
+from .graph import build_graph
+from .cost import graph_cost
+
+__all__ = [
+    "KERNELS_SCHEMA_VERSION",
+    "VMEM_BYTES",
+    "TPU_GENERATIONS",
+    "KernelAudit",
+    "analyze_kernels",
+    "kernel_sweep",
+    "sweep_table",
+    "collect_pallas_eqns",
+]
+
+#: layout version of the ``analysis_kernels.json`` artifact
+KERNELS_SCHEMA_VERSION = 1
+
+#: per-generation VMEM capacity (bytes/core).  All current generations
+#: expose ~16 MiB of VMEM to Mosaic (the guide's planning number); kept
+#: as a per-generation table so a future part with a different budget is
+#: a one-line change, not a refactor.
+VMEM_BYTES: Dict[str, int] = {
+    "v4": 16 * 2 ** 20,
+    "v5e": 16 * 2 ** 20,
+    "v5p": 16 * 2 ** 20,
+}
+
+#: fraction of VMEM the estimator may claim before warning — Mosaic adds
+#: its own spill/semaphore slack on top of our double-buffer lower bound
+VMEM_HEADROOM_FRAC = 0.75
+
+#: flops certification band: derived/registered ratio must stay within
+#: a factor of (1 + tol).  The analytic models count algorithm flops;
+#: the derived number counts every VPU op the body jaxpr executes
+#: (compare/select/broadcast overhead), so an exact match is not the
+#: contract — catching a forgotten grid factor or a wrong S is.
+FLOPS_DRIFT_TOL = 1.0
+
+#: bytes certification band half-width: registered bytes must fall in
+#: ``[unique_bytes / tol, runs_bytes * tol]``
+BYTES_DRIFT_TOL = 2.0
+
+#: coverage proofs enumerate the full grid; past this many steps the
+#: proof is skipped (INFO) rather than stalling the lint — manifest
+#: cases are chosen small precisely so the proof stays exact
+MAX_COVERAGE_STEPS = 65536
+
+_HALF_DTYPES = frozenset({"bfloat16", "float16"})
+
+#: accumulating reductions — unsafe in half precision (reduce_max /
+#: reduce_min are exact in any dtype and deliberately not listed)
+_ACCUM_REDUCTIONS = frozenset({
+    "reduce_sum", "reduce_prod", "cumsum", "cumprod", "cumlogsumexp",
+})
+
+#: transcendentals whose half-precision evaluation loses the tail the
+#: online-softmax rescaling algebra needs
+_TRANSCENDENTALS = frozenset({"exp", "log", "log1p", "expm1", "logistic"})
+
+_COMPARES = frozenset({"lt", "le", "gt", "ge", "eq", "ne"})
+_IOTAS = frozenset({"iota", "broadcasted_iota"})
+
+
+# ---------------------------------------------------------------------------
+# peak tables (shared with the observability plane — import, don't fork)
+# ---------------------------------------------------------------------------
+def _peaks() -> Dict[str, Dict[str, float]]:
+    """Per-generation peak flops / HBM BW, read from the observability
+    plane's tables so the doctor and the live gauges can never disagree
+    about what a v5e is."""
+    from ..observability.gauges import _PEAK_FLOPS_BF16
+    from ..observability.perf import _PEAK_HBM_BW
+    out: Dict[str, Dict[str, float]] = {}
+    for gen, vmem in VMEM_BYTES.items():
+        out[gen] = {
+            "vmem_bytes": float(vmem),
+            "peak_flops_bf16": float(_PEAK_FLOPS_BF16.get(gen, 0.0)),
+            "peak_hbm_bw": float(_PEAK_HBM_BW.get(gen, 0.0)),
+        }
+    return out
+
+
+def TPU_GENERATIONS() -> Dict[str, Dict[str, float]]:
+    """Public accessor for the generation table (function, not constant,
+    so the observability import stays lazy)."""
+    return _peaks()
+
+
+# ---------------------------------------------------------------------------
+# pallas_call collection
+# ---------------------------------------------------------------------------
+def collect_pallas_eqns(jaxpr) -> List[Any]:
+    """Every ``pallas_call`` eqn anywhere in (possibly nested) ``jaxpr``
+    — recurses through pjit/custom_vjp/cond/scan sub-jaxprs, so a
+    ``jax.grad`` trace yields the fwd AND bwd kernels."""
+    out: List[Any] = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "pallas_call":
+                out.append(eqn)
+            for v in eqn.params.values():
+                for sub in _sub_jaxprs(v):
+                    walk(sub)
+
+    walk(jaxpr)
+    return out
+
+
+def _sub_jaxprs(v):
+    if isinstance(v, _jcore.ClosedJaxpr):
+        yield v.jaxpr
+    elif isinstance(v, _jcore.Jaxpr):
+        yield v
+    elif isinstance(v, (tuple, list)):
+        for x in v:
+            yield from _sub_jaxprs(x)
+
+
+def _eqn_name(eqn) -> str:
+    info = eqn.params.get("name_and_src_info")
+    return getattr(info, "name", "") or eqn.params.get("name", "")
+
+
+def _aval_triple(v):
+    aval = getattr(v, "aval", v)
+    shape = tuple(int(s) for s in getattr(aval, "shape", ()))
+    dtype = getattr(aval, "dtype", None)
+    return (shape, str(dtype) if dtype is not None else None,
+            bool(getattr(aval, "weak_type", False)))
+
+
+def _light_params(params: dict) -> dict:
+    out = {}
+    for k, v in params.items():
+        if isinstance(v, (_jcore.Jaxpr, _jcore.ClosedJaxpr)):
+            continue
+        if isinstance(v, (tuple, list)) and any(
+                isinstance(x, (_jcore.Jaxpr, _jcore.ClosedJaxpr))
+                for x in v):
+            continue
+        out[k] = v
+    return out
+
+
+def _triple_bytes(triple) -> int:
+    shape, dtype, _ = triple
+    if dtype is None:
+        return 0
+    try:
+        item = np.dtype(dtype).itemsize
+    except TypeError:
+        item = 16
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n * item
+
+
+def _block_bytes(block_shape, dtype) -> int:
+    try:
+        item = np.dtype(dtype).itemsize
+    except TypeError:
+        item = 16
+    n = 1
+    for s in block_shape:
+        n *= int(s) if s is not None else 1
+    return n * item
+
+
+# ---------------------------------------------------------------------------
+# per-operand coverage facts
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class OperandCoverage:
+    """Concrete block-visit record for one pallas operand."""
+
+    role: str                       # registry role or BlockSpec origin
+    is_output: bool
+    block_shape: Tuple[int, ...]
+    array_shape: Tuple[int, ...]
+    dtype: str
+    nblocks: Tuple[int, ...]        # cdiv(array, block) per dim
+    visits: List[Tuple[int, ...]]   # block index per grid step (row-major)
+    data_dependent: bool            # index map consumes prefetch values
+
+    @property
+    def runs(self) -> List[Tuple[int, ...]]:
+        """Contiguous-duplicate-merged visit sequence — one entry per
+        actual HBM fetch/flush the Pallas pipeline performs."""
+        out: List[Tuple[int, ...]] = []
+        for b in self.visits:
+            if not out or out[-1] != b:
+                out.append(b)
+        return out
+
+    @property
+    def unique(self) -> set:
+        return set(self.visits)
+
+    def tail_dims(self) -> List[int]:
+        """Dims where a visited last block overhangs the array."""
+        dims = []
+        for d, (a, b, n) in enumerate(
+                zip(self.array_shape, self.block_shape, self.nblocks)):
+            if a % b != 0 and any(v[d] == n - 1 for v in self.visits):
+                dims.append(d)
+        return dims
+
+
+@dataclasses.dataclass
+class KernelAudit:
+    """Everything the doctor derived about one manifest kernel — the
+    per-kernel row of the ``analysis_kernels.json`` artifact."""
+
+    name: str
+    grid: Tuple[int, ...]
+    num_prefetch: int
+    operands: List[OperandCoverage]
+    vmem_bytes: int
+    scratch_bytes: int
+    derived_flops: float
+    derived_bytes_unique: float
+    derived_bytes_runs: float
+    registered_flops: Optional[float]
+    registered_bytes: Optional[float]
+    coverage_proved: bool
+    mask_idiom: bool
+
+    def to_row(self, peaks: Dict[str, Dict[str, float]]) -> dict:
+        reg_f = self.registered_flops
+        reg_b = self.registered_bytes
+        flops_ratio = (self.derived_flops / reg_f
+                       if reg_f else None)
+        row = {
+            "kernel": self.name,
+            "grid": list(self.grid),
+            "steps": int(np.prod(self.grid)) if self.grid else 1,
+            "vmem_bytes": int(self.vmem_bytes),
+            "scratch_bytes": int(self.scratch_bytes),
+            "derived_flops": self.derived_flops,
+            "derived_bytes_unique": self.derived_bytes_unique,
+            "derived_bytes_runs": self.derived_bytes_runs,
+            "registered_flops": reg_f,
+            "registered_bytes": reg_b,
+            "flops_ratio": (round(flops_ratio, 3)
+                            if flops_ratio is not None else None),
+            "coverage_proved": self.coverage_proved,
+            "mask_idiom": self.mask_idiom,
+        }
+        for gen, p in peaks.items():
+            row[f"vmem_frac_{gen}"] = round(
+                self.vmem_bytes / p["vmem_bytes"], 4)
+        if reg_f and reg_b:
+            intensity = reg_f / reg_b
+            row["intensity"] = round(intensity, 2)
+            for gen, p in peaks.items():
+                if p["peak_hbm_bw"]:
+                    ridge = p["peak_flops_bf16"] / p["peak_hbm_bw"]
+                    row[f"bound_{gen}"] = (
+                        "compute" if intensity >= ridge else "memory")
+        return row
+
+
+# ---------------------------------------------------------------------------
+# index-map evaluation
+# ---------------------------------------------------------------------------
+def _index_map_callable(bm):
+    """A concrete evaluator for one BlockMapping's index map.
+
+    Scalar-prefetch operands reach the map as SMEM refs; discharging the
+    jaxpr (exactly what interpret-mode ``compute_start_indices`` does)
+    turns them into plain array args, after which the map is an ordinary
+    pure function of ``(*grid_indices, *prefetch_arrays)``."""
+    closed = bm.index_map_jaxpr
+    dis, consts = _state_discharge.discharge_state(closed.jaxpr,
+                                                   closed.consts)
+    fn = _jcore.jaxpr_as_fun(_jcore.ClosedJaxpr(dis, consts))
+    n_out = len(bm.block_shape)
+
+    def call(step: Tuple[int, ...], prefetch: Tuple[np.ndarray, ...]):
+        outs = fn(*(jnp.int32(i) for i in step), *prefetch)
+        return tuple(int(np.asarray(o)) for o in outs[:n_out])
+
+    return call
+
+
+def _map_uses_prefetch(bm, n_grid: int) -> bool:
+    """True when the index map actually READS a scalar-prefetch operand
+    (every map in a PrefetchScalarGridSpec kernel *receives* them)."""
+    jaxpr = bm.index_map_jaxpr.jaxpr
+    extra = set(jaxpr.invars[n_grid:])
+    if not extra:
+        return False
+    def used(jx):
+        for eqn in jx.eqns:
+            if any(v in extra for v in eqn.invars
+                   if not isinstance(v, _jcore.Literal)):
+                return True
+            for v in eqn.params.values():
+                for sub in _sub_jaxprs(v):
+                    if used(sub):
+                        return True
+        return any(v in extra for v in jx.outvars
+                   if not isinstance(v, _jcore.Literal))
+    return used(jaxpr)
+
+
+# ---------------------------------------------------------------------------
+# body-jaxpr rules (dtype safety + mask idiom) — ride the r9 walker
+# ---------------------------------------------------------------------------
+def _body_graph(eqn):
+    body = eqn.params["jaxpr"]
+    closed = body if isinstance(body, _jcore.ClosedJaxpr) \
+        else _jcore.ClosedJaxpr(body, ())
+    return build_graph(closed)
+
+
+def _consumers(graph) -> Dict[int, List[int]]:
+    out: Dict[int, List[int]] = {}
+    for node in graph.nodes:
+        for d in node.in_defs:
+            if d >= 0:
+                out.setdefault(d, []).append(node.idx)
+    return out
+
+
+def _reaches(graph, cons, start_idx: int, prims: frozenset,
+             max_hops: int = 8) -> Optional[int]:
+    """BFS forward along def-use edges from node ``start_idx``; returns
+    the first reached node whose prim is in ``prims``."""
+    seen = {start_idx}
+    frontier = [start_idx]
+    for _ in range(max_hops):
+        nxt: List[int] = []
+        for i in frontier:
+            for j in cons.get(i, ()):
+                if j in seen:
+                    continue
+                seen.add(j)
+                if graph.nodes[j].prim in prims:
+                    return j
+                nxt.append(j)
+        frontier = nxt
+        if not frontier:
+            break
+    return None
+
+
+def _has_mask_idiom(graph) -> bool:
+    """iota → compare → select_n within the body: the canonical Pallas
+    tail/validity mask (``jnp.where(col < vocab, x, sentinel)``)."""
+    cons = _consumers(graph)
+    for node in graph.nodes:
+        if node.prim not in _IOTAS:
+            continue
+        cmp_idx = _reaches(graph, cons, node.idx, _COMPARES)
+        if cmp_idx is None:
+            continue
+        if _reaches(graph, cons, cmp_idx, frozenset({"select_n"})) \
+                is not None:
+            return True
+    return False
+
+
+def _dtype_findings(name: str, graph) -> List[Finding]:
+    """f32-accumulation lint over the kernel body's def-use graph."""
+    out: List[Finding] = []
+    for node in graph.nodes:
+        in_half = any(a[1] in _HALF_DTYPES for a in node.in_avals)
+        if not in_half:
+            continue
+        if node.prim == "dot_general":
+            pet = str(node.params.get("preferred_element_type"))
+            if pet not in ("float32", "float64"):
+                out.append(Finding(
+                    "kernel-dot-accum", Severity.HIGH,
+                    f"{name}: dot_general on half-precision operands "
+                    f"without preferred_element_type=f32 "
+                    f"(accumulates in {pet})",
+                    entry_point=name, scope=node.name_stack,
+                    source=node.source,
+                    details={"eqn": node.idx, "prim": node.prim,
+                             "in_dtypes": [a[1] for a in node.in_avals],
+                             "preferred_element_type": pet}))
+        elif node.prim in _ACCUM_REDUCTIONS:
+            out.append(Finding(
+                "kernel-reduction-dtype", Severity.HIGH,
+                f"{name}: {node.prim} accumulates in half precision — "
+                f"cast the operand to f32 first",
+                entry_point=name, scope=node.name_stack,
+                source=node.source,
+                details={"eqn": node.idx, "prim": node.prim,
+                         "in_dtypes": [a[1] for a in node.in_avals]}))
+        elif node.prim in _TRANSCENDENTALS:
+            out.append(Finding(
+                "kernel-transcendental-halfprec", Severity.MEDIUM,
+                f"{name}: {node.prim} evaluated in half precision — "
+                f"softmax-style rescaling wants f32 stats",
+                entry_point=name, scope=node.name_stack,
+                source=node.source,
+                details={"eqn": node.idx, "prim": node.prim,
+                         "in_dtypes": [a[1] for a in node.in_avals]}))
+    return out
+
+
+def _scratch_findings(name: str, eqn, gm) -> List[Finding]:
+    out: List[Finding] = []
+    n_scratch = int(getattr(gm, "num_scratch_operands", 0) or 0)
+    if not n_scratch:
+        return out
+    body = eqn.params["jaxpr"]
+    jaxpr = body.jaxpr if isinstance(body, _jcore.ClosedJaxpr) else body
+    for v in jaxpr.invars[len(jaxpr.invars) - n_scratch:]:
+        shape, dtype, _ = _aval_triple(v)
+        if dtype in _HALF_DTYPES:
+            out.append(Finding(
+                "kernel-scratch-halfprec", Severity.MEDIUM,
+                f"{name}: VMEM scratch accumulator is {dtype} — online "
+                f"accumulation state belongs in f32",
+                entry_point=name,
+                details={"scratch_shape": list(shape), "dtype": dtype}))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the audit of one kernel eqn
+# ---------------------------------------------------------------------------
+def _audit_eqn(case, eqn, report: AnalysisReport) -> Optional[KernelAudit]:
+    from ..ops.pallas.cost_registry import kernel_cost_model, kernel_meta
+
+    name = case.name
+    gm = eqn.params["grid_mapping"]
+    grid = tuple(int(g) for g in gm.grid)
+    n_steps = int(np.prod(grid)) if grid else 1
+    n_prefetch = int(getattr(gm, "num_index_operands", 0) or 0)
+    bms = list(gm.block_mappings)
+    n_out = int(gm.num_outputs)
+    in_bms, out_bms = bms[:len(bms) - n_out], bms[len(bms) - n_out:]
+
+    meta = kernel_meta(name)
+    roles = list(meta.operand_roles) if meta else []
+
+    prefetch = tuple(np.asarray(a) for a in case.scalar_prefetch())
+    if len(prefetch) != n_prefetch:
+        report.findings.append(Finding(
+            "kernel-manifest-prefetch", Severity.HIGH,
+            f"{name}: manifest provides {len(prefetch)} scalar-prefetch "
+            f"arrays but the launch declares {n_prefetch}",
+            entry_point=name,
+            details={"declared": n_prefetch, "provided": len(prefetch)}))
+        return None
+
+    # ---- coverage: evaluate every index map over the concrete grid ----
+    proved = n_steps <= MAX_COVERAGE_STEPS
+    operands: List[OperandCoverage] = []
+    steps = list(np.ndindex(*grid)) if (grid and proved) else [()]
+    if not proved:
+        report.findings.append(Finding(
+            "kernel-coverage-skipped", Severity.INFO,
+            f"{name}: grid has {n_steps} steps "
+            f"(> {MAX_COVERAGE_STEPS}); coverage proof skipped",
+            entry_point=name, details={"grid": list(grid)}))
+
+    for k, bm in enumerate(in_bms + out_bms):
+        is_out = k >= len(in_bms)
+        role = ""
+        if roles:
+            ri = n_prefetch + k if not is_out else -1
+            if not is_out and ri < len(roles):
+                role = roles[ri]
+        if not role:
+            role = str(getattr(bm, "origin", "") or
+                       (f"out[{k - len(in_bms)}]" if is_out
+                        else f"args[{k}]"))
+        arr_sds = bm.array_shape_dtype
+        arr_shape = tuple(int(s) for s in arr_sds.shape)
+        block = tuple(int(s) if s is not None else 1
+                      for s in bm.block_shape)
+        nblocks = tuple(-(-a // b) for a, b in zip(arr_shape, block))
+        visits: List[Tuple[int, ...]] = []
+        if proved:
+            call = _index_map_callable(bm)
+            for step in steps:
+                visits.append(call(step, prefetch))
+        operands.append(OperandCoverage(
+            role=role, is_output=is_out, block_shape=block,
+            array_shape=arr_shape, dtype=str(arr_sds.dtype),
+            nblocks=nblocks, visits=visits,
+            data_dependent=_map_uses_prefetch(
+                bm, len(grid)) if n_prefetch else False))
+
+    body_graph = _body_graph(eqn)
+    mask_idiom = _has_mask_idiom(body_graph)
+
+    if proved:
+        _coverage_findings(case, name, grid, steps, operands, mask_idiom,
+                           report)
+
+    # ---- dtype safety over the body graph ----
+    report.findings.extend(_dtype_findings(name, body_graph))
+    report.findings.extend(_scratch_findings(name, eqn, gm))
+
+    # ---- VMEM budget ----
+    scratch_bytes = _scratch_vmem_bytes(eqn, gm)
+    block_io = sum(_block_bytes(op.block_shape, op.dtype)
+                   for op in operands)
+    vmem = 2 * block_io + scratch_bytes  # double-buffered pipeline
+    peaks = _peaks()
+    for gen, p in peaks.items():
+        frac = vmem / p["vmem_bytes"]
+        if frac > 1.0:
+            report.findings.append(Finding(
+                "kernel-vmem-over", Severity.HIGH,
+                f"{name}: estimated per-step VMEM {vmem} B exceeds "
+                f"{gen} capacity {int(p['vmem_bytes'])} B",
+                entry_point=name,
+                details={"generation": gen, "vmem_bytes": vmem,
+                         "capacity": int(p["vmem_bytes"])}))
+        elif frac > VMEM_HEADROOM_FRAC:
+            report.findings.append(Finding(
+                "kernel-vmem-headroom", Severity.MEDIUM,
+                f"{name}: estimated per-step VMEM {vmem} B is "
+                f"{frac:.0%} of {gen} capacity — Mosaic slack will "
+                f"likely spill",
+                entry_point=name,
+                details={"generation": gen, "vmem_bytes": vmem,
+                         "frac": round(frac, 3)}))
+
+    # ---- derived cost + registry drift ----
+    body_cost = graph_cost(body_graph)
+    derived_flops = body_cost.flops * n_steps
+    pf_bytes = sum(a.nbytes for a in prefetch)
+    uniq_b = pf_bytes + sum(
+        len(op.unique) * _block_bytes(op.block_shape, op.dtype)
+        for op in operands) if proved else 0.0
+    runs_b = pf_bytes + sum(
+        len(op.runs) * _block_bytes(op.block_shape, op.dtype)
+        for op in operands) if proved else 0.0
+
+    model = kernel_cost_model(name)
+    reg_f = reg_b = None
+    if model is not None:
+        in_avals = tuple(_aval_triple(v) for v in eqn.invars)
+        out_avals = tuple(_aval_triple(v) for v in eqn.outvars)
+        reg_f, reg_b = model(in_avals, out_avals,
+                             _light_params(eqn.params))
+        reg_f, reg_b = float(reg_f), float(reg_b)
+        if derived_flops > 0 and reg_f > 0:
+            ratio = derived_flops / reg_f
+            if ratio > 1.0 + FLOPS_DRIFT_TOL or \
+                    ratio < 1.0 / (1.0 + FLOPS_DRIFT_TOL):
+                report.findings.append(Finding(
+                    "kernel-flops-drift", Severity.MEDIUM,
+                    f"{name}: registered flops model drifted from the "
+                    f"body jaxpr — derived {derived_flops:.3g} vs "
+                    f"registered {reg_f:.3g} (ratio {ratio:.2f})",
+                    entry_point=name,
+                    details={"derived_flops": derived_flops,
+                             "registered_flops": reg_f,
+                             "ratio": round(ratio, 3),
+                             "tolerance": FLOPS_DRIFT_TOL}))
+        if proved and reg_b > 0 and runs_b > 0:
+            lo = uniq_b / BYTES_DRIFT_TOL
+            hi = runs_b * BYTES_DRIFT_TOL
+            if not (lo <= reg_b <= hi):
+                report.findings.append(Finding(
+                    "kernel-bytes-drift", Severity.MEDIUM,
+                    f"{name}: registered bytes {reg_b:.3g} outside the "
+                    f"derived traffic band [{uniq_b:.3g} unique, "
+                    f"{runs_b:.3g} runs] x{BYTES_DRIFT_TOL}",
+                    entry_point=name,
+                    details={"registered_bytes": reg_b,
+                             "unique_bytes": uniq_b,
+                             "runs_bytes": runs_b,
+                             "tolerance": BYTES_DRIFT_TOL}))
+    if meta is not None:
+        if not meta.family or not meta.operand_roles:
+            report.findings.append(Finding(
+                "kernel-meta-empty", Severity.LOW,
+                f"{name}: registry entry has no "
+                f"family/operand_roles metadata",
+                entry_point=name, details=meta.to_dict() if meta else {}))
+        elif len(meta.operand_roles) != len(eqn.invars):
+            report.findings.append(Finding(
+                "kernel-roles-arity", Severity.MEDIUM,
+                f"{name}: registry names {len(meta.operand_roles)} "
+                f"operand roles but the launch takes "
+                f"{len(eqn.invars)} operands",
+                entry_point=name,
+                details={"operand_roles": list(meta.operand_roles),
+                         "n_operands": len(eqn.invars)}))
+
+    return KernelAudit(
+        name=name, grid=grid, num_prefetch=n_prefetch,
+        operands=operands, vmem_bytes=int(vmem),
+        scratch_bytes=int(scratch_bytes),
+        derived_flops=float(derived_flops),
+        derived_bytes_unique=float(uniq_b),
+        derived_bytes_runs=float(runs_b),
+        registered_flops=reg_f, registered_bytes=reg_b,
+        coverage_proved=proved, mask_idiom=mask_idiom)
+
+
+def _scratch_vmem_bytes(eqn, gm) -> int:
+    n_scratch = int(getattr(gm, "num_scratch_operands", 0) or 0)
+    if not n_scratch:
+        return 0
+    body = eqn.params["jaxpr"]
+    jaxpr = body.jaxpr if isinstance(body, _jcore.ClosedJaxpr) else body
+    total = 0
+    for v in jaxpr.invars[len(jaxpr.invars) - n_scratch:]:
+        total += _triple_bytes(_aval_triple(v))
+    return total
+
+
+def _coverage_findings(case, name, grid, steps, operands, mask_idiom,
+                       report: AnalysisReport) -> None:
+    overhang_roles: List[str] = []
+    for op in operands:
+        # bounds: every visited block index inside [0, nblocks) per dim
+        for si, v in enumerate(op.visits):
+            bad = [d for d, (i, n) in enumerate(zip(v, op.nblocks))
+                   if i < 0 or i >= n]
+            if bad:
+                report.findings.append(Finding(
+                    "kernel-block-out-of-range", Severity.HIGH,
+                    f"{name}: operand '{op.role}' block index {v} out "
+                    f"of range {op.nblocks} at grid step {steps[si]}",
+                    entry_point=name,
+                    details={"operand": op.role, "block_index": list(v),
+                             "nblocks": list(op.nblocks),
+                             "grid_step": list(steps[si]),
+                             "dims": bad}))
+                break  # one example per operand is enough
+
+        if op.tail_dims():
+            overhang_roles.append(op.role)
+
+        if op.data_dependent:
+            sev = Severity.INFO if op.role in case.data_dependent_ok \
+                else Severity.MEDIUM
+            report.findings.append(Finding(
+                "kernel-data-dependent-map",
+                sev,
+                f"{name}: operand '{op.role}' index map reads "
+                f"scalar-prefetch data — coverage holds for the "
+                f"manifest's example table"
+                + ("" if sev == Severity.INFO
+                   else " but the manifest does not declare it"),
+                entry_point=name,
+                details={"operand": op.role,
+                         "declared": op.role in case.data_dependent_ok}))
+
+        if not op.is_output:
+            continue
+
+        # ---- exactly-once write proof ----
+        run_count: Dict[Tuple[int, ...], int] = {}
+        run_first: Dict[Tuple[int, ...], List[int]] = {}
+        prev = None
+        for si, v in enumerate(op.visits):
+            if v != prev:
+                run_count[v] = run_count.get(v, 0) + 1
+                run_first.setdefault(v, []).append(si)
+            prev = v
+        holes = [b for b in np.ndindex(*op.nblocks)
+                 if tuple(b) not in run_count]
+        if holes:
+            report.findings.append(Finding(
+                "kernel-write-hole", Severity.HIGH,
+                f"{name}: output '{op.role}' block {tuple(holes[0])} "
+                f"(of {len(holes)} holes) is never written — it ships "
+                f"uninitialized memory",
+                entry_point=name,
+                details={"operand": op.role,
+                         "missing_block": list(holes[0]),
+                         "n_holes": len(holes),
+                         "nblocks": list(op.nblocks)}))
+        races = {b: c for b, c in run_count.items() if c > 1}
+        if races:
+            b, c = next(iter(sorted(races.items())))
+            firsts = [list(steps[i]) for i in run_first[b][:2]]
+            report.findings.append(Finding(
+                "kernel-write-race", Severity.HIGH,
+                f"{name}: output '{op.role}' block {b} is written by "
+                f"{c} non-contiguous grid runs (first at steps "
+                f"{firsts}) — later runs clobber flushed data",
+                entry_point=name,
+                details={"operand": op.role, "block_index": list(b),
+                         "n_runs": c, "grid_steps": firsts,
+                         "n_raced_blocks": len(races)}))
+
+    # ---- tail masking cross-check ----
+    if overhang_roles:
+        if not mask_idiom:
+            report.findings.append(Finding(
+                "kernel-unmasked-tail", Severity.HIGH,
+                f"{name}: operands {overhang_roles} visit non-dividing "
+                f"tail blocks but the body has no iota→compare→select "
+                f"mask idiom — tail lanes read/feed garbage",
+                entry_point=name,
+                details={"operands": overhang_roles}))
+        elif not case.tail_masked:
+            report.findings.append(Finding(
+                "kernel-tail-undeclared", Severity.MEDIUM,
+                f"{name}: body masks its non-dividing tails but the "
+                f"manifest case does not declare tail_masked=True",
+                entry_point=name,
+                details={"operands": overhang_roles}))
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+def analyze_kernels(cases: Optional[Sequence] = None, *,
+                    check_registry: bool = True) -> AnalysisReport:
+    """Run the kernel doctor over ``cases`` (default: the shipped
+    manifest) and return the findings report; ``report.meta['kernels']``
+    carries the per-kernel audit rows."""
+    from ..ops.pallas import kernel_manifest
+    from ..ops.pallas.cost_registry import registered_kernels
+
+    t0 = time.time()
+    if cases is None:
+        cases = kernel_manifest()
+    report = AnalysisReport(meta={
+        "schema_version": KERNELS_SCHEMA_VERSION,
+        "generations": _peaks(),
+    })
+
+    if check_registry:
+        reg = registered_kernels()
+        case_names = {c.name for c in cases}
+        for n in sorted(case_names - set(reg)):
+            report.findings.append(Finding(
+                "kernel-unregistered", Severity.HIGH,
+                f"{n}: shipped kernel has no cost-registry entry — "
+                f"planner v2 prices it with the bytes-only fallback",
+                entry_point=n, details={"registered": sorted(reg)}))
+        for n in sorted(set(reg) - case_names):
+            report.findings.append(Finding(
+                "kernel-registry-stale", Severity.HIGH,
+                f"{n}: cost-registry entry has no manifest kernel — "
+                f"stale registration (kernel renamed or removed?)",
+                entry_point=n, details={"manifest": sorted(case_names)}))
+
+    rows: List[dict] = []
+    peaks = _peaks()
+    for case in cases:
+        try:
+            fn, args = case.build()
+            jaxpr = jax.make_jaxpr(fn)(*args)
+            eqns = [e for e in collect_pallas_eqns(jaxpr.jaxpr)
+                    if _eqn_name(e) == case.name]
+            if not eqns:
+                report.findings.append(Finding(
+                    "kernel-manifest-trace", Severity.HIGH,
+                    f"{case.name}: manifest case traced no pallas_call "
+                    f"with that name",
+                    entry_point=case.name,
+                    details={"found": sorted({
+                        _eqn_name(e) for e in
+                        collect_pallas_eqns(jaxpr.jaxpr)})}))
+                continue
+            audit = _audit_eqn(case, eqns[0], report)
+            if audit is not None:
+                rows.append(audit.to_row(peaks))
+        except Exception as e:  # crashed rule → MEDIUM, house contract
+            report.findings.append(Finding(
+                "kernel-doctor-crash", Severity.MEDIUM,
+                f"{case.name}: kernel audit crashed: "
+                f"{type(e).__name__}: {e}",
+                entry_point=case.name,
+                details={"error": type(e).__name__}))
+    report.meta["kernels"] = rows
+    report.meta["n_cases"] = len(list(cases))
+    report.meta["elapsed_s"] = round(time.time() - t0, 3)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# the serving-shape sweep (roadmap 1a: page_size 16/32 × real vocabs)
+# ---------------------------------------------------------------------------
+#: real model vocab sizes for the softmax-CE tiling lattice
+SWEEP_VOCABS = (32000, 50304, 151936)
+#: paged-attention sweep: page_size × table capacity (tokens)
+SWEEP_PAGE_SIZES = (16, 32)
+SWEEP_SEQ_LENS = (1024, 2048)
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _sweep_specs():
+    """(label, kernel_name, fn, abstract args) for every sweep point —
+    traced with ShapeDtypeStructs, so real-vocab shapes cost nothing."""
+    from ..ops.pallas.paged_attention import (
+        paged_flash_attention, paged_flash_attention_int8)
+    from ..ops.pallas.softmax_ce import softmax_ce_loss
+    import functools
+
+    specs = []
+    b, h, d, t = 8, 8, 128, 1
+    for ps in SWEEP_PAGE_SIZES:
+        for s in SWEEP_SEQ_LENS:
+            mp = s // ps
+            n_pages = b * mp + 1
+            common = dict(page_size=ps, interpret=True)
+            args_fp = (_sds((b, h, t, d), jnp.bfloat16),
+                       _sds((n_pages, h, ps, d), jnp.bfloat16),
+                       _sds((n_pages, h, ps, d), jnp.bfloat16),
+                       _sds((b, mp), jnp.int32),
+                       _sds((b,), jnp.int32))
+            specs.append((
+                f"paged ps={ps} S={s}", "paged_flash_attention",
+                functools.partial(paged_flash_attention, **common),
+                args_fp))
+            args_i8 = (_sds((b, h, t, d), jnp.bfloat16),
+                       _sds((n_pages, h, ps, d), jnp.int8),
+                       _sds((n_pages, h, ps, d), jnp.int8),
+                       _sds((n_pages, ps), jnp.float32),
+                       _sds((n_pages, ps), jnp.float32),
+                       _sds((b, mp), jnp.int32),
+                       _sds((b,), jnp.int32))
+            specs.append((
+                f"paged_int8 ps={ps} S={s}",
+                "paged_flash_attention_int8",
+                functools.partial(paged_flash_attention_int8, **common),
+                args_i8))
+    rows = 4096
+    for vocab in SWEEP_VOCABS:
+        specs.append((
+            f"softmax_ce vocab={vocab}", "softmax_ce_fwd",
+            functools.partial(softmax_ce_loss, interpret=True),
+            (_sds((rows, vocab), jnp.float32),
+             _sds((rows,), jnp.int32))))
+    return specs
+
+
+def kernel_sweep() -> dict:
+    """Predicted VMEM/roofline table over serving shapes.  Pure shape
+    arithmetic (abstract tracing + the registered cost models) — no
+    kernel execution, so 151k-vocab rows are free."""
+    from ..ops.pallas.cost_registry import kernel_cost_model
+
+    t0 = time.time()
+    peaks = _peaks()
+    rows: List[dict] = []
+    for label, name, fn, args in _sweep_specs():
+        jaxpr = jax.make_jaxpr(fn)(*args)
+        eqns = [e for e in collect_pallas_eqns(jaxpr.jaxpr)
+                if _eqn_name(e) == name]
+        if not eqns:
+            rows.append({"label": label, "kernel": name,
+                         "error": "no pallas_call traced"})
+            continue
+        eqn = eqns[0]
+        gm = eqn.params["grid_mapping"]
+        grid = tuple(int(g) for g in gm.grid)
+        bms = list(gm.block_mappings)
+        block_io = sum(
+            _block_bytes(tuple(int(s) if s is not None else 1
+                               for s in bm.block_shape),
+                         bm.array_shape_dtype.dtype)
+            for bm in bms)
+        scratch = _scratch_vmem_bytes(eqn, gm)
+        vmem = 2 * block_io + scratch
+        row = {
+            "label": label, "kernel": name, "grid": list(grid),
+            "steps": int(np.prod(grid)) if grid else 1,
+            "vmem_bytes": int(vmem), "scratch_bytes": int(scratch),
+        }
+        for gen, p in peaks.items():
+            row[f"vmem_frac_{gen}"] = round(vmem / p["vmem_bytes"], 4)
+        model = kernel_cost_model(name)
+        if model is not None:
+            in_avals = tuple(_aval_triple(v) for v in eqn.invars)
+            out_avals = tuple(_aval_triple(v) for v in eqn.outvars)
+            flops, bts = model(in_avals, out_avals,
+                               _light_params(eqn.params))
+            row["flops"] = float(flops)
+            row["bytes"] = float(bts)
+            intensity = flops / bts if bts else 0.0
+            row["intensity"] = round(intensity, 2)
+            for gen, p in peaks.items():
+                if not p["peak_hbm_bw"]:
+                    continue
+                ridge = p["peak_flops_bf16"] / p["peak_hbm_bw"]
+                row[f"bound_{gen}"] = (
+                    "compute" if intensity >= ridge else "memory")
+                row[f"est_us_{gen}"] = round(1e6 * max(
+                    flops / p["peak_flops_bf16"],
+                    bts / p["peak_hbm_bw"]), 2)
+        rows.append(row)
+    return {
+        "schema_version": KERNELS_SCHEMA_VERSION,
+        "generations": peaks,
+        "rows": rows,
+        "elapsed_s": round(time.time() - t0, 3),
+    }
+
+
+def sweep_table(sweep: dict) -> str:
+    """Render the sweep dict as the aligned text table the CLI prints."""
+    cols = ("label", "grid", "vmem_bytes", "vmem_frac_v5e", "intensity",
+            "bound_v5e", "est_us_v5e", "est_us_v5p")
+    lines = ["  ".join(f"{c:>14s}" for c in cols)]
+    for row in sweep["rows"]:
+        cells = []
+        for c in cols:
+            v = row.get(c, "")
+            if isinstance(v, list):
+                v = "x".join(str(x) for x in v)
+            cells.append(f"{v!s:>14s}")
+        lines.append("  ".join(cells))
+    return "\n".join(lines)

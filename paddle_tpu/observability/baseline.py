@@ -88,7 +88,11 @@ _COUNT_MAX = ("silent_drops", "dropped_requests", "inflight_failures",
               # HIGH/MEDIUM nondeterminism hazard, or an inject seam left
               # without its two-run replay certificate, gates the same way
               "det_findings_high", "det_findings_medium",
-              "det_seams_uncovered")
+              "det_seams_uncovered",
+              # Pallas kernel-doctor counts (ISSUE 20): a broken BlockSpec
+              # coverage proof, a dropped f32-accumulator cast, or a
+              # registry model past drift tolerance gates identically
+              "kernel_findings_high", "kernel_findings_medium")
 
 
 def classify_metric(name: str, value) -> str:

@@ -27,29 +27,60 @@ The contract
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
 __all__ = [
+    "KernelMeta",
     "register_kernel_cost",
     "kernel_cost_model",
+    "kernel_meta",
     "registered_kernels",
 ]
 
 CostModel = Callable[[tuple, tuple, dict], Tuple[float, float]]
 
+
+@dataclasses.dataclass(frozen=True)
+class KernelMeta:
+    """Per-kernel registry metadata the kernel doctor (r24) consumes.
+
+    ``family`` groups variants of one algorithm ("flash_attention",
+    "paged_attention", ...) so lint findings and sweep rows aggregate;
+    ``operand_roles`` names the eqn operands in *pallas_call operand
+    order* (scalar-prefetch operands first for PrefetchScalarGridSpec
+    kernels) so coverage proofs and drift rows read as prose, not
+    ``args[3]``."""
+
+    family: str = ""
+    operand_roles: Tuple[str, ...] = ()
+
+    def to_dict(self) -> dict:
+        return {"family": self.family,
+                "operand_roles": list(self.operand_roles)}
+
+
 _REGISTRY: Dict[str, CostModel] = {}
+_META: Dict[str, KernelMeta] = {}
 _BUILTIN_LOADED = False
 
 
-def register_kernel_cost(name: str, model: CostModel) -> CostModel:
+def register_kernel_cost(name: str, model: CostModel, *,
+                         family: str = "",
+                         operand_roles: Tuple[str, ...] = ()) -> CostModel:
     """Register ``model`` under kernel ``name`` (the explicit ``name=`` the
     kernel passes to ``pl.pallas_call``).  Re-registration replaces —
-    kernel modules own their names."""
+    kernel modules own their names.  ``family``/``operand_roles`` are the
+    doctor-facing metadata (see :class:`KernelMeta`); registering without
+    them keeps the r20 call signature working but the kernel doctor flags
+    the empty metadata as a LOW finding."""
     if not name:
         raise ValueError("kernel cost model needs a non-empty name")
     _REGISTRY[str(name)] = model
+    _META[str(name)] = KernelMeta(family=str(family),
+                                  operand_roles=tuple(operand_roles))
     return model
 
 
@@ -80,9 +111,20 @@ def kernel_cost_model(name: Optional[str]) -> Optional[CostModel]:
     return _REGISTRY.get(str(name))
 
 
-def registered_kernels():
+def kernel_meta(name: Optional[str]) -> Optional[KernelMeta]:
+    """The :class:`KernelMeta` registered for ``name``, or None."""
+    if not name:
+        return None
     _ensure_builtin()
-    return sorted(_REGISTRY)
+    return _META.get(str(name))
+
+
+def registered_kernels() -> Dict[str, KernelMeta]:
+    """Name → :class:`KernelMeta` for every registered kernel, sorted by
+    name.  (r24: was a bare name list; a dict keeps ``in``/iteration
+    working for existing callers while giving the doctor its metadata.)"""
+    _ensure_builtin()
+    return {name: _META[name] for name in sorted(_REGISTRY)}
 
 
 # -- shared helpers for the in-tree models ----------------------------------

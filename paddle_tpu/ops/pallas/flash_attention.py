@@ -489,9 +489,17 @@ def _flash_bwd_dkv_cost(in_avals, out_avals, params):
 
 def _register_costs():
     from .cost_registry import register_kernel_cost
-    register_kernel_cost("flash_attention_fwd", _flash_fwd_cost)
-    register_kernel_cost("flash_attention_bwd_dq", _flash_bwd_dq_cost)
-    register_kernel_cost("flash_attention_bwd_dkv", _flash_bwd_dkv_cost)
+    register_kernel_cost(
+        "flash_attention_fwd", _flash_fwd_cost,
+        family="flash_attention", operand_roles=("q", "k", "v"))
+    register_kernel_cost(
+        "flash_attention_bwd_dq", _flash_bwd_dq_cost,
+        family="flash_attention",
+        operand_roles=("q", "k", "v", "do", "lse", "delta"))
+    register_kernel_cost(
+        "flash_attention_bwd_dkv", _flash_bwd_dkv_cost,
+        family="flash_attention",
+        operand_roles=("q", "k", "v", "do", "lse", "delta"))
 
 
 _register_costs()

@@ -177,7 +177,9 @@ def _fused_ln_cost(in_avals, out_avals, params):
 
 def _register_costs():
     from .cost_registry import register_kernel_cost
-    register_kernel_cost("fused_residual_dropout_ln_fwd", _fused_ln_cost)
+    register_kernel_cost(
+        "fused_residual_dropout_ln_fwd", _fused_ln_cost, family="fused_ln",
+        operand_roles=("x", "residual", "mask", "gamma", "beta"))
 
 
 _register_costs()

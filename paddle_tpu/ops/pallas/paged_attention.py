@@ -290,7 +290,9 @@ def _paged_attention_cost(in_avals, out_avals, params):
     return flops, kv_bytes + io
 
 
-register_kernel_cost(PAGED_ATTENTION_KERNEL_NAME, _paged_attention_cost)
+register_kernel_cost(PAGED_ATTENTION_KERNEL_NAME, _paged_attention_cost,
+                     family="paged_attention",
+                     operand_roles=("pages", "pos", "q", "pool_k", "pool_v"))
 
 
 def _paged_attention_int8_cost(in_avals, out_avals, params):
@@ -315,4 +317,7 @@ def _paged_attention_int8_cost(in_avals, out_avals, params):
 
 
 register_kernel_cost(PAGED_ATTENTION_INT8_KERNEL_NAME,
-                     _paged_attention_int8_cost)
+                     _paged_attention_int8_cost,
+                     family="paged_attention",
+                     operand_roles=("pages", "pos", "q", "pool_k", "pool_v",
+                                    "scale_k", "scale_v"))

@@ -131,7 +131,8 @@ def _rope_cost(in_avals, out_avals, params):
 
 def _register_costs():
     from .cost_registry import register_kernel_cost
-    register_kernel_cost("rope_fwd", _rope_cost)
+    register_kernel_cost("rope_fwd", _rope_cost, family="rope",
+                         operand_roles=("x", "cos", "sin"))
 
 
 _register_costs()

@@ -357,7 +357,14 @@ def _ce_bwd_cost(in_avals, out_avals, params):
     return flops, bts
 
 
-register_kernel_cost("softmax_ce_fwd", _ce_fwd_cost)
-register_kernel_cost("softmax_ce_bwd", _ce_bwd_cost)
-register_kernel_cost("softmax_ce_partials_fwd", _ce_fwd_cost)
-register_kernel_cost("softmax_ce_partials_bwd", _ce_bwd_cost)
+register_kernel_cost("softmax_ce_fwd", _ce_fwd_cost, family="softmax_ce",
+                     operand_roles=("logits", "labels"))
+register_kernel_cost("softmax_ce_bwd", _ce_bwd_cost, family="softmax_ce",
+                     operand_roles=("logits", "labels", "lse", "g"))
+register_kernel_cost("softmax_ce_partials_fwd", _ce_fwd_cost,
+                     family="softmax_ce",
+                     operand_roles=("logits", "labels"))
+register_kernel_cost("softmax_ce_partials_bwd", _ce_bwd_cost,
+                     family="softmax_ce",
+                     operand_roles=("logits", "labels", "g_sum_exp",
+                                    "g_picked"))

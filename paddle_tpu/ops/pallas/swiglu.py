@@ -128,7 +128,8 @@ def _swiglu_cost(in_avals, out_avals, params):
 
 def _register_costs():
     from .cost_registry import register_kernel_cost
-    register_kernel_cost("swiglu_fwd", _swiglu_cost)
+    register_kernel_cost("swiglu_fwd", _swiglu_cost, family="swiglu",
+                         operand_roles=("x", "w_gate", "w_up"))
 
 
 _register_costs()

@@ -1,0 +1,324 @@
+"""Pallas kernel doctor (r24): planted-violation proofs + clean pins.
+
+The coverage prover is only trustworthy if it catches the failure modes
+it claims to catch, with enough detail to fix them: each planted toy
+kernel here carries exactly one violation (a write hole, a
+non-contiguous overlapping write, a bf16 accumulator) and the tests
+assert the exact HIGH details — block index, grid coords, offending eqn
+dtypes — not just "a finding exists".  The clean-pin tests hold the
+shipped tree at zero HIGH/MEDIUM, and the CLI tests pin the exit-1
+contract per planted kind.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from paddle_tpu.analysis.findings import Severity
+from paddle_tpu.analysis.kernels import analyze_kernels, kernel_sweep
+from paddle_tpu.ops.pallas import KernelCase, kernel_manifest
+from paddle_tpu.ops.pallas.cost_registry import registered_kernels
+
+BENCH_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks")
+
+
+# ---------------------------------------------------------------------------
+# planted toy kernels
+# ---------------------------------------------------------------------------
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def _toy_hole():
+    """Output has 4 row blocks but the grid only visits 2 → blocks
+    (2,0) and (3,0) ship uninitialized memory."""
+    x = np.ones((256, 128), np.float32)
+
+    def fn(x):
+        return pl.pallas_call(
+            _copy_kernel, grid=(2,),
+            in_specs=[pl.BlockSpec((64, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((64, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((256, 128), jnp.float32),
+            interpret=True, name="toy_write_hole")(x)
+
+    return KernelCase(name="toy_write_hole", build=lambda: (fn, (x,)))
+
+
+def _toy_race():
+    """grid (4,) writes block (i % 2, 0): each output block is written
+    by TWO non-contiguous runs — the second clobbers flushed data."""
+    x = np.ones((128, 128), np.float32)
+
+    def fn(x):
+        return pl.pallas_call(
+            _copy_kernel, grid=(4,),
+            in_specs=[pl.BlockSpec((64, 128), lambda i: (i % 2, 0))],
+            out_specs=pl.BlockSpec((64, 128), lambda i: (i % 2, 0)),
+            out_shape=jax.ShapeDtypeStruct((128, 128), jnp.float32),
+            interpret=True, name="toy_write_race")(x)
+
+    return KernelCase(name="toy_write_race", build=lambda: (fn, (x,)))
+
+
+def _toy_bf16_dot():
+    """dot_general on bf16 operands without preferred_element_type=f32
+    — accumulates in bf16 on the MXU."""
+    x = np.ones((128, 128), np.float32).astype(jnp.bfloat16)
+
+    def kern(x_ref, y_ref, o_ref):
+        o_ref[...] = jax.lax.dot(x_ref[...], y_ref[...])
+
+    def fn(x, y):
+        return pl.pallas_call(
+            kern, grid=(1,),
+            in_specs=[pl.BlockSpec((128, 128), lambda i: (0, 0)),
+                      pl.BlockSpec((128, 128), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((128, 128), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((128, 128), jnp.bfloat16),
+            interpret=True, name="toy_bf16_dot")(x, y)
+
+    return KernelCase(name="toy_bf16_dot", build=lambda: (fn, (x, x)))
+
+
+def _toy_bf16_reduce():
+    """A true bf16 ``reduce_sum`` (bound directly — ``jnp.sum`` upcasts
+    half floats to f32 for the accumulation, which is exactly the safe
+    idiom; the lint hunts code that bypasses it)."""
+    x = np.ones((128, 128), np.float32).astype(jnp.bfloat16)
+
+    def kern(x_ref, o_ref):
+        s = jax.lax.reduce_sum_p.bind(x_ref[...], axes=(1,))
+        o_ref[...] = jnp.broadcast_to(s[:, None], o_ref.shape)
+
+    def fn(x):
+        return pl.pallas_call(
+            kern, grid=(1,),
+            in_specs=[pl.BlockSpec((128, 128), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((128, 128), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((128, 128), jnp.bfloat16),
+            interpret=True, name="toy_bf16_reduce")(x)
+
+    return KernelCase(name="toy_bf16_reduce", build=lambda: (fn, (x,)))
+
+
+def _findings(report, rule):
+    return [f for f in report.findings if f.rule == rule]
+
+
+class TestPlantedViolations:
+    def test_write_hole_details(self):
+        rep = analyze_kernels(cases=[_toy_hole()], check_registry=False)
+        hits = _findings(rep, "kernel-write-hole")
+        assert len(hits) == 1
+        f = hits[0]
+        assert f.severity == Severity.HIGH
+        assert f.entry_point == "toy_write_hole"
+        # blocks (2,0) and (3,0) of the 4x1 block grid are the holes
+        assert f.details["missing_block"] == [2, 0]
+        assert f.details["n_holes"] == 2
+        assert f.details["nblocks"] == [4, 1]
+        # nothing else fired HIGH — the hole is the one violation
+        assert [x.rule for x in rep.high()] == ["kernel-write-hole"]
+
+    def test_write_race_details(self):
+        rep = analyze_kernels(cases=[_toy_race()], check_registry=False)
+        hits = _findings(rep, "kernel-write-race")
+        assert len(hits) == 1
+        f = hits[0]
+        assert f.severity == Severity.HIGH
+        assert f.details["block_index"] == [0, 0]
+        assert f.details["n_runs"] == 2
+        # written at grid steps 0 and 2 (the two non-contiguous runs)
+        assert f.details["grid_steps"] == [[0], [2]]
+        assert f.details["n_raced_blocks"] == 2
+        # a race is not a hole: every block IS visited
+        assert not _findings(rep, "kernel-write-hole")
+
+    def test_bf16_dot_accum_details(self):
+        rep = analyze_kernels(cases=[_toy_bf16_dot()],
+                              check_registry=False)
+        hits = _findings(rep, "kernel-dot-accum")
+        assert len(hits) == 1
+        f = hits[0]
+        assert f.severity == Severity.HIGH
+        assert f.details["prim"] == "dot_general"
+        assert f.details["in_dtypes"] == ["bfloat16", "bfloat16"]
+        assert f.details["preferred_element_type"] not in (
+            "float32", "float64")
+        assert isinstance(f.details["eqn"], int)
+        # coverage of the single-block launch is clean
+        assert not _findings(rep, "kernel-write-hole")
+        assert not _findings(rep, "kernel-write-race")
+
+    def test_bf16_reduction_details(self):
+        rep = analyze_kernels(cases=[_toy_bf16_reduce()],
+                              check_registry=False)
+        hits = _findings(rep, "kernel-reduction-dtype")
+        assert len(hits) == 1
+        f = hits[0]
+        assert f.severity == Severity.HIGH
+        assert f.details["prim"] == "reduce_sum"
+        assert "bfloat16" in f.details["in_dtypes"]
+
+    def test_fixed_twins_are_clean(self):
+        """The f32-corrected twins of the dtype toys pass the lint —
+        the rule keys on the accumulator dtype, not on bf16 inputs."""
+        x = np.ones((128, 128), np.float32).astype(jnp.bfloat16)
+
+        def kern(x_ref, y_ref, o_ref):
+            acc = jax.lax.dot(x_ref[...], y_ref[...],
+                              preferred_element_type=jnp.float32)
+            s = jnp.sum(x_ref[...].astype(jnp.float32), axis=-1,
+                        keepdims=True)
+            o_ref[...] = (acc + s).astype(o_ref.dtype)
+
+        def fn(x, y):
+            return pl.pallas_call(
+                kern, grid=(1,),
+                in_specs=[pl.BlockSpec((128, 128), lambda i: (0, 0)),
+                          pl.BlockSpec((128, 128), lambda i: (0, 0))],
+                out_specs=pl.BlockSpec((128, 128), lambda i: (0, 0)),
+                out_shape=jax.ShapeDtypeStruct((128, 128), jnp.bfloat16),
+                interpret=True, name="toy_fixed")(x, y)
+
+        rep = analyze_kernels(
+            cases=[KernelCase(name="toy_fixed",
+                              build=lambda: (fn, (x, x)))],
+            check_registry=False)
+        assert rep.high() == []
+
+
+class TestRegistryCrossCheck:
+    def test_unregistered_kernel_is_high(self):
+        rep = analyze_kernels(cases=[_toy_hole()], check_registry=True)
+        rules = {f.rule for f in rep.high()}
+        assert "kernel-unregistered" in rules      # toy not in registry
+        assert "kernel-registry-stale" in rules    # 12 entries unmatched
+
+    def test_manifest_matches_registry_exactly(self):
+        names = {c.name for c in kernel_manifest()}
+        assert names == set(registered_kernels())
+
+    def test_registry_metadata_complete(self):
+        for name, meta in registered_kernels().items():
+            assert meta.family, name
+            assert meta.operand_roles, name
+
+
+class TestShippedTreeClean:
+    def test_zero_high_zero_medium(self):
+        """The committed-artifact anchor: the shipped kernels prove
+        coverage, pass the dtype lint, fit VMEM, and certify against
+        their registered cost models."""
+        rep = analyze_kernels()
+        assert rep.high() == []
+        assert rep.by_severity(Severity.MEDIUM) == []
+        # every manifest kernel produced an audit row
+        rows = {r["kernel"] for r in rep.meta["kernels"]}
+        assert rows == {c.name for c in kernel_manifest()}
+
+    def test_coverage_proved_everywhere(self):
+        rep = analyze_kernels()
+        for row in rep.meta["kernels"]:
+            assert row["coverage_proved"], row["kernel"]
+
+    def test_drift_within_tolerance(self):
+        rep = analyze_kernels()
+        for row in rep.meta["kernels"]:
+            assert row["registered_flops"] is not None, row["kernel"]
+            assert 0.5 <= row["flops_ratio"] <= 2.0, row
+            lo = row["derived_bytes_unique"] / 2.0
+            hi = row["derived_bytes_runs"] * 2.0
+            assert lo <= row["registered_bytes"] <= hi, row
+
+    def test_data_dependent_maps_declared(self):
+        """The paged kernels' pool maps are data-dependent by design —
+        declared in the manifest, so they surface as INFO, not MEDIUM."""
+        rep = analyze_kernels()
+        dd = _findings(rep, "kernel-data-dependent-map")
+        assert dd, "paged pool maps should be flagged data-dependent"
+        assert all(f.severity == Severity.INFO for f in dd)
+
+
+class TestSweep:
+    def test_sweep_covers_roadmap_lattice(self):
+        sweep = kernel_sweep()
+        assert sweep["schema_version"] == 1
+        labels = [r["label"] for r in sweep["rows"]]
+        assert any("ps=16" in l for l in labels)
+        assert any("ps=32" in l for l in labels)
+        assert any("vocab=151936" in l for l in labels)
+        for row in sweep["rows"]:
+            assert "error" not in row, row
+            assert row["vmem_bytes"] > 0
+            # serving shapes must actually fit
+            assert row["vmem_frac_v5e"] < 1.0, row
+            assert row["bound_v5e"] in ("compute", "memory")
+            assert row["est_us_v5p"] <= row["est_us_v5e"], row
+
+
+class TestKernelDoctorCLI:
+    def _run(self, monkeypatch, tmp_path, cases, extra=()):
+        from paddle_tpu.analysis import cli
+        import paddle_tpu.ops.pallas as pallas_pkg
+
+        if cases is not None:
+            monkeypatch.setattr(pallas_pkg, "kernel_manifest",
+                                lambda: cases)
+        out = tmp_path / "kernels.json"
+        rc = cli.main(["--kernels", "--out", str(out)] + list(extra))
+        return rc, json.loads(out.read_text())
+
+    def test_clean_tree_exits_zero(self, monkeypatch, tmp_path):
+        rc, payload = self._run(monkeypatch, tmp_path, None)
+        assert rc == 0
+        assert payload["counts"]["HIGH"] == 0
+
+    @pytest.mark.parametrize("toy,rule", [
+        (_toy_hole, "kernel-write-hole"),
+        (_toy_race, "kernel-write-race"),
+        (_toy_bf16_dot, "kernel-dot-accum"),
+        (_toy_bf16_reduce, "kernel-reduction-dtype"),
+    ])
+    def test_planted_violation_exits_one(self, monkeypatch, tmp_path,
+                                         toy, rule):
+        rc, payload = self._run(monkeypatch, tmp_path, [toy()])
+        assert rc == 1
+        assert rule in {f["rule"] for f in payload["findings"]}
+
+    def test_sweep_exits_zero(self, tmp_path):
+        from paddle_tpu.analysis import cli
+
+        out = tmp_path / "sweep.json"
+        rc = cli.main(["--kernels-sweep", "--out", str(out)])
+        assert rc == 0
+        payload = json.loads(out.read_text())
+        assert payload["rows"]
+
+
+class TestCommittedKernelArtifacts:
+    def test_kernels_artifact_pinned(self):
+        path = os.path.join(BENCH_DIR, "analysis_kernels.json")
+        assert os.path.exists(path), "run: python -m paddle_tpu.analysis --kernels"
+        payload = json.load(open(path))
+        assert payload["schema_version"] == 2      # report schema
+        assert payload["meta"]["schema_version"] == 1
+        assert payload["counts"]["HIGH"] == 0
+        assert payload["counts"]["MEDIUM"] == 0
+        assert {r["kernel"] for r in payload["meta"]["kernels"]} \
+            == set(registered_kernels())
+
+    def test_sweep_artifact_pinned(self):
+        path = os.path.join(BENCH_DIR, "analysis_kernels_sweep.json")
+        assert os.path.exists(path), \
+            "run: python -m paddle_tpu.analysis --kernels-sweep"
+        payload = json.load(open(path))
+        assert payload["schema_version"] == 1
+        assert len(payload["rows"]) >= 8
